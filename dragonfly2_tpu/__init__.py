@@ -1,0 +1,36 @@
+"""dragonfly2_tpu — a TPU-native P2P file-distribution framework with learned scheduling.
+
+A ground-up rebuild of the capabilities of Dragonfly2 (a CNCF P2P
+file-distribution / image-acceleration system: manager, scheduler, peer
+daemon, trainer), designed TPU-first rather than ported:
+
+- The control plane (scheduler resource state machines, parent-peer
+  scheduling, network-topology probe store, manager model registry) is
+  implemented as an embeddable runtime with native (C++) storage engines.
+- The ML scheduling loop that the reference left as a stub
+  (reference: trainer/training/training.go:82-99, and the ML evaluator
+  fallback at scheduler/scheduling/evaluator/evaluator.go:84-86) is
+  first-class here: schedulers produce download records and probe graphs,
+  the trainer trains an MLP bandwidth regressor and a GNN (GraphSAGE/GAT)
+  parent ranker with JAX/XLA — data-parallel and graph-partitioned over a
+  `jax.sharding.Mesh` — and publishes versioned models back through the
+  manager to the scheduler's evaluator.
+
+Package map (mirrors SURVEY.md §2's component inventory):
+
+- ``utils``    — shared kernel: idgen, digest, DAG, TTL cache, GC, hostinfo.
+- ``records``  — record schemas (Download / NetworkTopology), columnar
+                 storage, synthetic swarm generators.
+- ``models``   — MLP regressor, GraphSAGE, GAT ranker (flax, bf16).
+- ``ops``      — neighbor gather/aggregation ops (+ pallas kernels).
+- ``parallel`` — mesh construction, sharding rules, edge-partitioned
+                 aggregation with ring collectives.
+- ``trainer``  — ingest pipeline, train loops, checkpointing, eval.
+- ``scheduler``— resource FSMs, peer DAG, evaluators (default/nt/ml),
+                 scheduling engine, record storage, network topology.
+- ``manager``  — model registry (versioned, single-active), searcher.
+- ``daemon``   — peer daemon data plane (piece storage, conductor, upload).
+- ``native``   — C++ runtime pieces + ctypes bindings.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,18 @@
+"""Training-record layer: schemas, storage, columnar TPU ingest format, synthesis.
+
+Mirrors the reference's scheduler/storage (record schemas + rotating files,
+scheduler/storage/types.go, storage.go) but replaces the CSV bottleneck with
+a fixed-width columnar binary format that feeds the TPU input pipeline
+directly (SURVEY.md §2.1 rebuild target for scheduler/storage).
+"""
+
+from .schema import (  # noqa: F401
+    Download,
+    DownloadError,
+    HostRecord,
+    NetworkTopologyRecord,
+    Parent,
+    Piece,
+    ProbeStats,
+    TaskRecord,
+)

@@ -1,0 +1,177 @@
+"""Fixed-width columnar record files — the TPU ingest format.
+
+The reference streams gocsv-encoded text (scheduler/storage/storage.go,
+announcer.go:173-237); parsing that at 1B-records/10min is hopeless.  Here
+every record is featurized *at write time* into a fixed-width float32 row
+(see features.py), and files are raw row-major matrices with a small JSON
+header:
+
+    [4B magic "DFC1"][4B little-endian header length][header JSON][rows...]
+
+- Append is O(row) with no serialization beyond ``ndarray.tobytes``.
+- Read is zero-copy ``np.memmap`` — the host input pipeline slices batches
+  straight out of the page cache into device transfers.
+- Fixed width ⇒ static shapes ⇒ XLA compiles the train step once.
+
+The C++ record engine (native/) implements this same format for the
+scheduler's hot write path; this module is the canonical spec and the
+Python reader/writer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+MAGIC = b"DFC1"
+_LEN_FMT = "<I"
+
+
+@dataclass(frozen=True)
+class ColumnarHeader:
+    columns: tuple
+    dtype: str = "float32"
+    created_at_ns: int = 0
+
+    @property
+    def row_nbytes(self) -> int:
+        return np.dtype(self.dtype).itemsize * len(self.columns)
+
+
+def _encode_header(header: ColumnarHeader) -> bytes:
+    payload = json.dumps(
+        {
+            "columns": list(header.columns),
+            "dtype": header.dtype,
+            "created_at_ns": header.created_at_ns,
+        }
+    ).encode("utf-8")
+    return MAGIC + struct.pack(_LEN_FMT, len(payload)) + payload
+
+
+def read_header(path: str) -> tuple[ColumnarHeader, int]:
+    """Returns (header, data_offset)."""
+    with open(path, "rb") as f:
+        magic = f.read(4)
+        if magic != MAGIC:
+            raise ValueError(f"{path}: bad magic {magic!r}")
+        (hlen,) = struct.unpack(_LEN_FMT, f.read(4))
+        meta = json.loads(f.read(hlen).decode("utf-8"))
+    header = ColumnarHeader(
+        columns=tuple(meta["columns"]),
+        dtype=meta.get("dtype", "float32"),
+        created_at_ns=meta.get("created_at_ns", 0),
+    )
+    return header, 8 + hlen
+
+
+class ColumnarWriter:
+    """Append-only writer. Safe for a single writer; readers may mmap live files
+    (rows are only visible once fully flushed, tracked by file size)."""
+
+    def __init__(self, path: str, columns: Sequence[str], dtype: str = "float32"):
+        self.path = path
+        self.header = ColumnarHeader(columns=tuple(columns), dtype=dtype)
+        exists = os.path.exists(path) and os.path.getsize(path) > 0
+        if exists:
+            existing, self._data_offset = read_header(path)
+            if existing.columns != self.header.columns:
+                raise ValueError(
+                    f"{path}: existing columns {existing.columns} != {self.header.columns}"
+                )
+            self.header = existing
+            self._f = open(path, "ab")
+        else:
+            self._f = open(path, "wb")
+            raw = _encode_header(self.header)
+            self._f.write(raw)
+            self._data_offset = len(raw)
+        self._width = len(self.header.columns)
+        self._np_dtype = np.dtype(self.header.dtype)
+
+    def append(self, rows: np.ndarray) -> int:
+        """Append a [n, ncols] (or [ncols]) array; returns rows written."""
+        rows = np.ascontiguousarray(rows, dtype=self._np_dtype)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if rows.shape[-1] != self._width:
+            raise ValueError(f"row width {rows.shape[-1]} != {self._width}")
+        self._f.write(rows.tobytes())
+        return rows.shape[0]
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def tell_rows(self) -> int:
+        return (self._f.tell() - self._data_offset) // (
+            self._np_dtype.itemsize * self._width
+        )
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "ColumnarWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class ColumnarReader:
+    """Zero-copy mmap reader over one columnar file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.header, self._data_offset = read_header(path)
+        self._np_dtype = np.dtype(self.header.dtype)
+        self._width = len(self.header.columns)
+        size = os.path.getsize(path) - self._data_offset
+        self.num_rows = size // (self._np_dtype.itemsize * self._width)
+        if self.num_rows > 0:
+            self._mm = np.memmap(
+                path,
+                dtype=self._np_dtype,
+                mode="r",
+                offset=self._data_offset,
+                shape=(self.num_rows, self._width),
+            )
+        else:
+            self._mm = np.empty((0, self._width), dtype=self._np_dtype)
+
+    @property
+    def columns(self) -> tuple:
+        return self.header.columns
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    def __getitem__(self, idx) -> np.ndarray:
+        return self._mm[idx]
+
+    def to_array(self) -> np.ndarray:
+        return np.asarray(self._mm)
+
+    def batches(self, batch_size: int, drop_remainder: bool = False) -> Iterator[np.ndarray]:
+        n = self.num_rows
+        for start in range(0, n, batch_size):
+            end = start + batch_size
+            if end > n and drop_remainder:
+                return
+            yield np.asarray(self._mm[start:end])
+
+
+def concat_readers(paths: Sequence[str]) -> np.ndarray:
+    """Materialize multiple shards into one array (small datasets / tests)."""
+    readers = [ColumnarReader(p) for p in paths if os.path.getsize(p) > 0]
+    if not readers:
+        raise ValueError("no non-empty shards")
+    cols = readers[0].columns
+    for r in readers[1:]:
+        if r.columns != cols:
+            raise ValueError(f"{r.path}: column mismatch")
+    return np.concatenate([r.to_array() for r in readers], axis=0)

@@ -1,0 +1,1 @@
+"""Shared kernel utilities (mirrors the reference's pkg/ + internal/ layer)."""

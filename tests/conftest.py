@@ -1,0 +1,32 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip hardware is unavailable in CI; all sharding tests run against
+``--xla_force_host_platform_device_count=8`` (the driver separately
+dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
+
+Must run before any jax import, hence the env mutation at module import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cluster():
+    from dragonfly2_tpu.records.synthetic import SyntheticCluster
+
+    return SyntheticCluster(num_hosts=48, seed=42)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

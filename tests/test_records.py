@@ -1,0 +1,208 @@
+"""Record layer tests: schema roundtrip, columnar format, rotating storage,
+featurization, synthetic generator consistency."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.records import schema
+from dragonfly2_tpu.records.columnar import ColumnarReader, ColumnarWriter, concat_readers
+from dragonfly2_tpu.records.features import (
+    DOWNLOAD_COLUMNS,
+    DOWNLOAD_FEATURE_DIM,
+    HOST_FEATURE_DIM,
+    TOPO_COLUMNS,
+    download_to_rows,
+    host_features,
+    topology_to_rows,
+)
+from dragonfly2_tpu.records.storage import Storage
+from dragonfly2_tpu.records.synthetic import SyntheticCluster
+
+
+class TestSchema:
+    def test_download_dict_roundtrip(self, cluster):
+        d = cluster.generate_download()
+        data = schema.to_dict(d)
+        restored = schema.from_dict(schema.Download, json.loads(json.dumps(data)))
+        assert restored == d
+
+    def test_topology_dict_roundtrip(self, cluster):
+        r = cluster.generate_topology_record()
+        restored = schema.from_dict(
+            schema.NetworkTopologyRecord, json.loads(json.dumps(schema.to_dict(r)))
+        )
+        assert restored == r
+
+    def test_observed_bandwidth(self):
+        p = schema.Parent(pieces=[schema.Piece(length=1 << 20, cost=int(1e9))])
+        assert p.observed_bandwidth() == pytest.approx(1 << 20)
+        assert schema.Parent().observed_bandwidth() == 0.0
+
+
+class TestColumnar:
+    def test_write_read_roundtrip(self, tmp_path):
+        path = str(tmp_path / "t.dfc")
+        rows = np.random.default_rng(0).normal(size=(100, 5)).astype(np.float32)
+        with ColumnarWriter(path, [f"c{i}" for i in range(5)]) as w:
+            w.append(rows[:50])
+            w.append(rows[50:])
+            assert w.tell_rows() == 100
+        r = ColumnarReader(path)
+        assert r.num_rows == 100
+        assert r.columns == tuple(f"c{i}" for i in range(5))
+        np.testing.assert_array_equal(r.to_array(), rows)
+
+    def test_append_to_existing(self, tmp_path):
+        path = str(tmp_path / "t.dfc")
+        with ColumnarWriter(path, ["a", "b"]) as w:
+            w.append(np.ones((3, 2), dtype=np.float32))
+        with ColumnarWriter(path, ["a", "b"]) as w:
+            w.append(np.zeros((2, 2), dtype=np.float32))
+        r = ColumnarReader(path)
+        assert r.num_rows == 5
+        assert r.to_array()[-1, 0] == 0.0
+
+    def test_column_mismatch_rejected(self, tmp_path):
+        path = str(tmp_path / "t.dfc")
+        with ColumnarWriter(path, ["a"]) as w:
+            w.append(np.ones((1, 1), dtype=np.float32))
+        with pytest.raises(ValueError):
+            ColumnarWriter(path, ["x"])
+
+    def test_batches(self, tmp_path):
+        path = str(tmp_path / "t.dfc")
+        with ColumnarWriter(path, ["a"]) as w:
+            w.append(np.arange(10, dtype=np.float32)[:, None])
+        r = ColumnarReader(path)
+        got = list(r.batches(4))
+        assert [len(b) for b in got] == [4, 4, 2]
+        got = list(r.batches(4, drop_remainder=True))
+        assert [len(b) for b in got] == [4, 4]
+
+    def test_concat(self, tmp_path):
+        paths = []
+        for i in range(3):
+            p = str(tmp_path / f"{i}.dfc")
+            with ColumnarWriter(p, ["a"]) as w:
+                w.append(np.full((2, 1), i, dtype=np.float32))
+            paths.append(p)
+        arr = concat_readers(paths)
+        assert arr.shape == (6, 1)
+
+
+class TestFeatures:
+    def test_host_feature_dim(self, cluster):
+        f = host_features(cluster.host_record(0))
+        assert f.shape == (HOST_FEATURE_DIM,)
+        assert np.all(np.isfinite(f))
+
+    def test_download_rows(self, cluster):
+        d = cluster.generate_download()
+        rows = download_to_rows(d)
+        assert rows.shape[1] == len(DOWNLOAD_COLUMNS)
+        assert rows.shape[0] == len(d.parents)
+        assert np.all(np.isfinite(rows))
+        # target is log1p(bandwidth), positive for real transfers
+        assert np.all(rows[:, -1] > 0)
+
+    def test_topology_rows(self, cluster):
+        r = cluster.generate_topology_record()
+        rows = topology_to_rows(r)
+        assert rows.shape == (len(r.dest_hosts), len(TOPO_COLUMNS))
+        rtt = rows[:, TOPO_COLUMNS.index("avg_rtt_norm")]
+        assert np.all((rtt >= 0) & (rtt <= 1))
+
+    def test_target_matches_ground_truth(self, cluster):
+        # featurized target ≈ log1p of the latent bandwidth (up to injected noise)
+        d = cluster.generate_download()
+        rows = download_to_rows(d)
+        for parent, row in zip(d.parents, rows):
+            assert row[-1] == pytest.approx(np.log1p(parent.observed_bandwidth()), rel=1e-5)
+
+
+class TestStorage:
+    def test_create_flush_list(self, tmp_path, cluster):
+        st = Storage(str(tmp_path), buffer_size=10)
+        downloads = cluster.generate_downloads(25)
+        for d in downloads:
+            st.create_download(d)
+        # 20 flushed (2 full buffers), 5 still buffered
+        listed = st.list_download()
+        assert len(listed) == 25
+        assert listed[0] == downloads[0]
+
+    def test_columnar_mirrors_jsonl(self, tmp_path, cluster):
+        st = Storage(str(tmp_path), buffer_size=5)
+        for d in cluster.generate_downloads(12):
+            st.create_download(d)
+        st.flush()
+        arr = concat_readers(st.download_columnar_paths())
+        total_parents = sum(len(d.parents) for d in st.list_download())
+        assert arr.shape == (total_parents, len(DOWNLOAD_COLUMNS))
+
+    def test_rotation(self, tmp_path, cluster):
+        st = Storage(str(tmp_path), buffer_size=1, max_size=20_000, max_backups=3)
+        for d in cluster.generate_downloads(40):
+            st.create_download(d)
+        st.flush()
+        paths = st.download_raw_paths()
+        assert len(paths) > 1
+        assert len(paths) <= 4  # active + 3 backups
+        # all shards remain parseable
+        assert len(st.list_download()) > 0
+
+    def test_topology_storage(self, tmp_path, cluster):
+        st = Storage(str(tmp_path), buffer_size=4)
+        recs = cluster.generate_topology_records(9)
+        for r in recs:
+            st.create_network_topology(r)
+        assert len(st.list_network_topology()) == 9
+        arr = concat_readers(st.network_topology_columnar_paths())
+        assert arr.shape[1] == len(TOPO_COLUMNS)
+
+    def test_clear(self, tmp_path, cluster):
+        st = Storage(str(tmp_path), buffer_size=2)
+        for d in cluster.generate_downloads(4):
+            st.create_download(d)
+        st.flush()
+        st.clear()
+        assert st.list_download() == []
+        assert st.download_columnar_paths() == []
+
+
+class TestSynthetic:
+    def test_bandwidth_structure(self, cluster):
+        # same-idc edges should on average beat cross-region edges
+        n = cluster.num_hosts
+        rng = np.random.default_rng(1)
+        same, cross = [], []
+        for _ in range(400):
+            a, b = rng.integers(0, n, 2)
+            if a == b:
+                continue
+            bw = cluster.bandwidth(int(a), int(b), noise=False)
+            if cluster.idc[a] == cluster.idc[b]:
+                same.append(bw)
+            elif cluster.region[a] != cluster.region[b]:
+                cross.append(bw)
+        assert np.mean(same) > 2.0 * np.mean(cross)
+
+    def test_rtt_structure(self, cluster):
+        intra = cluster.rtt_ns(0, 0, noise=False)
+        assert intra < 2e6  # same host → intra-idc baseline
+
+    def test_vectorized_rows_shape(self, cluster):
+        rows = cluster.generate_feature_rows(1000, seed=7)
+        assert rows.shape == (1000, len(DOWNLOAD_COLUMNS))
+        assert np.all(np.isfinite(rows))
+        # learnable: target correlates with parent upload capacity feature region
+        assert rows[:, -1].std() > 0.1
+
+    def test_probe_edges(self, cluster):
+        src, dst, rtt = cluster.probe_edges(density=0.05)
+        assert len(src) == len(dst) == len(rtt)
+        assert np.all(src != dst)
+        assert np.all(rtt > 0)

@@ -1,0 +1,229 @@
+"""Shared-kernel unit tests (idgen, digest, DAG, TTL cache, FSM, GC)."""
+
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.utils import cache, dag, digest, fsm, gc as gcmod, idgen
+from dragonfly2_tpu.utils.types import HostType, SizeScope
+
+
+class TestDigest:
+    def test_sha256_from_strings_deterministic(self):
+        a = digest.sha256_from_strings("10.0.0.1", "host-a")
+        b = digest.sha256_from_strings("10.0.0.1", "host-a")
+        assert a == b and len(a) == 64
+
+    def test_separator_matters(self):
+        # ("ab", "c") must differ from ("a", "bc")
+        assert digest.sha256_from_strings("ab", "c") != digest.sha256_from_strings("a", "bc")
+
+    def test_parse_roundtrip(self):
+        d = digest.new("sha256", digest.sha256_from_bytes(b"hello"))
+        algo, enc = digest.parse(d)
+        assert algo == "sha256" and len(enc) == 64
+
+    def test_parse_rejects_bad(self):
+        with pytest.raises(ValueError):
+            digest.parse("sha256:short")
+        with pytest.raises(ValueError):
+            digest.parse("nope:aa")
+
+
+class TestIDGen:
+    def test_host_id_v2_stable(self):
+        assert idgen.host_id_v2("1.2.3.4", "h") == idgen.host_id_v2("1.2.3.4", "h")
+        assert idgen.host_id_v2("1.2.3.4", "h") != idgen.host_id_v2("1.2.3.4", "h", seed_peer=True)
+
+    def test_task_id_filters_query_params(self):
+        meta = idgen.URLMeta(filtered_query_params=("token",))
+        a = idgen.task_id("https://x.com/f?token=1&v=2", meta)
+        b = idgen.task_id("https://x.com/f?token=9&v=2", meta)
+        assert a == b
+
+    def test_task_id_canonical_param_order(self):
+        a = idgen.task_id("https://x.com/f?a=1&b=2", idgen.URLMeta())
+        b = idgen.task_id("https://x.com/f?b=2&a=1", idgen.URLMeta())
+        assert a == b
+
+    def test_task_id_range_vs_parent(self):
+        meta = idgen.URLMeta(range="0-100")
+        assert idgen.task_id("https://x.com/f", meta) != idgen.parent_task_id("https://x.com/f", meta)
+        assert idgen.parent_task_id("https://x.com/f", meta) == idgen.task_id("https://x.com/f", idgen.URLMeta())
+
+    def test_peer_id_unique(self):
+        assert idgen.peer_id("1.2.3.4", "h") != idgen.peer_id("1.2.3.4", "h")
+        assert idgen.peer_id("1.2.3.4", "h", seed=True).endswith("-seed")
+
+
+class TestDAG:
+    def test_add_edge_and_cycle_rejection(self):
+        g = dag.DAG()
+        for v in "abc":
+            g.add_vertex(v, v)
+        g.add_edge("a", "b")
+        g.add_edge("b", "c")
+        assert not g.can_add_edge("c", "a")
+        with pytest.raises(dag.CycleError):
+            g.add_edge("c", "a")
+        assert g.can_add_edge("a", "c")
+
+    def test_degrees_and_delete(self):
+        g = dag.DAG()
+        for v in "abc":
+            g.add_vertex(v, v)
+        g.add_edge("a", "b")
+        g.add_edge("a", "c")
+        assert g.get_vertex("a").out_degree() == 2
+        assert g.get_vertex("b").in_degree() == 1
+        g.delete_vertex("a")
+        assert g.get_vertex("b").in_degree() == 0
+        assert len(g) == 2
+
+    def test_delete_in_edges(self):
+        g = dag.DAG()
+        for v in "abc":
+            g.add_vertex(v, v)
+        g.add_edge("a", "c")
+        g.add_edge("b", "c")
+        g.delete_vertex_in_edges("c")
+        assert g.get_vertex("c").in_degree() == 0
+        assert g.get_vertex("a").out_degree() == 0
+
+    def test_topo_order(self):
+        g = dag.DAG()
+        for v in "abcd":
+            g.add_vertex(v, v)
+        g.add_edge("a", "b")
+        g.add_edge("b", "c")
+        g.add_edge("a", "d")
+        order = [v.id for v in g.topo_order()]
+        assert order.index("a") < order.index("b") < order.index("c")
+
+    def test_concurrent_mutation(self):
+        g = dag.DAG()
+        for i in range(100):
+            g.add_vertex(str(i), i)
+
+        errors = []
+
+        def worker(base):
+            try:
+                for i in range(base, 99):
+                    if g.can_add_edge(str(i), str(i + 1)):
+                        try:
+                            g.add_edge(str(i), str(i + 1))
+                        except dag.DAGError:
+                            pass
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        # still acyclic
+        list(g.topo_order())
+
+
+class TestTTLCache:
+    def test_set_get_expire(self):
+        t = [0.0]
+        c = cache.TTLCache(default_ttl=10.0, clock=lambda: t[0])
+        c.set("k", "v")
+        assert c.get("k") == "v"
+        t[0] = 11.0
+        assert c.get("k") is None
+
+    def test_add_only_if_absent(self):
+        c = cache.TTLCache()
+        assert c.add("k", 1)
+        assert not c.add("k", 2)
+        assert c.get("k") == 1
+
+    def test_scan(self):
+        c = cache.TTLCache()
+        c.set("networktopology:a:b", 1)
+        c.set("networktopology:a:c", 2)
+        c.set("other", 3)
+        found = dict(c.scan(r"^networktopology:a:"))
+        assert set(found.values()) == {1, 2}
+
+    def test_purge(self):
+        t = [0.0]
+        c = cache.TTLCache(default_ttl=5.0, clock=lambda: t[0])
+        for i in range(10):
+            c.set(str(i), i)
+        t[0] = 6.0
+        assert c.purge_expired() == 10
+        assert len(c) == 0
+
+
+class TestFSM:
+    def make(self):
+        return fsm.FSM(
+            initial="pending",
+            events=[
+                fsm.EventDesc("register", ["pending"], "running"),
+                fsm.EventDesc("succeed", ["running"], "succeeded"),
+                fsm.EventDesc("fail", ["pending", "running"], "failed"),
+            ],
+        )
+
+    def test_transitions(self):
+        m = self.make()
+        assert m.current == "pending"
+        m.event("register")
+        assert m.is_("running")
+        m.event("succeed")
+        assert m.is_("succeeded")
+
+    def test_illegal_event_raises(self):
+        m = self.make()
+        with pytest.raises(fsm.InvalidEventError):
+            m.event("succeed")
+        assert m.current == "pending"
+
+    def test_can(self):
+        m = self.make()
+        assert m.can("register") and m.can("fail") and not m.can("succeed")
+
+    def test_callbacks(self):
+        calls = []
+        m = fsm.FSM(
+            "a",
+            [fsm.EventDesc("go", ["a"], "b")],
+            callbacks={"enter_b": lambda f, e, s, d: calls.append((e, s, d))},
+        )
+        m.event("go")
+        assert calls == [("go", "a", "b")]
+
+
+class TestGC:
+    def test_interval_and_manual_run(self):
+        runs = []
+        g = gcmod.GC()
+        g.add(gcmod.Task(id="t", interval=0.05, timeout=0.05, runner=lambda: runs.append(1)))
+        g.run("t")
+        time.sleep(0.02)
+        assert len(runs) == 1
+        g.start()
+        time.sleep(0.18)
+        g.stop()
+        assert len(runs) >= 3
+
+    def test_bad_task_rejected(self):
+        with pytest.raises(ValueError):
+            gcmod.Task(id="x", interval=1.0, timeout=2.0, runner=lambda: None)
+
+
+class TestTypes:
+    def test_host_type(self):
+        assert not HostType.NORMAL.is_seed
+        assert HostType.SUPER_SEED.is_seed
+
+    def test_size_scope_enum(self):
+        assert SizeScope.TINY.value == 2
