@@ -310,6 +310,18 @@ class SyntheticCluster:
         out[:, 9] = (self.host_type == 1).astype(np.float32)
         return out
 
+    def _bucket_table(self) -> np.ndarray:
+        """crc32 hash buckets per host — the SAME node keys as the
+        record-level path (features.host_bucket), so vectorized bench data
+        and record-level data index one node space."""
+        if not hasattr(self, "_bucket_cache"):
+            from .features import host_bucket
+
+            self._bucket_cache = np.array(
+                [host_bucket(h.id) for h in self.hosts], dtype=np.float32
+            )
+        return self._bucket_cache
+
     def _location_affinity_vec(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
         # location = region|zone|rack (3 segments)
         same_region = (self.region[a] == self.region[b]).astype(np.float32)
@@ -344,8 +356,9 @@ class SyntheticCluster:
         edge[:, 7] = np.log1p(n_pieces)
 
         target = np.log1p(bw).astype(np.float32)[:, None]
-        src_b = (parent % (1 << 20)).astype(np.float32)[:, None]
-        dst_b = (child % (1 << 20)).astype(np.float32)[:, None]
+        buckets = self._bucket_table()
+        src_b = buckets[parent][:, None]
+        dst_b = buckets[child][:, None]
         return np.concatenate(
             [src_b, dst_b, host_f[child], host_f[parent], edge, target], axis=1
         ).astype(np.float32)

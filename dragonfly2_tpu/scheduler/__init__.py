@@ -1,0 +1,24 @@
+"""Scheduler control plane (reference: scheduler/).
+
+In-memory cluster state (hosts/tasks/peers with FSMs and a per-task peer
+DAG), the parent-selection engine with its pluggable evaluators, the
+network-topology probe store, and the training-record production path.
+
+The TPU-first twist versus the reference: the ML evaluator is real here
+(the reference's is a TODO at scheduler/scheduling/evaluator/evaluator.go:84-86).
+Instead of a Triton RPC on the scheduling hot path, the trainer exports a
+score table / compiled scorer that the evaluator consults locally.
+"""
+
+from .resource import (  # noqa: F401
+    Host,
+    HostManager,
+    Peer,
+    PeerManager,
+    Resource,
+    Task,
+    TaskManager,
+)
+from .evaluator import Evaluator, MLEvaluator, new_evaluator  # noqa: F401
+from .networktopology import NetworkTopology, Probe, ProbeAgent, TopologyConfig  # noqa: F401
+from .scheduling import ScheduleResult, ScheduleResultKind, Scheduling, SchedulingConfig  # noqa: F401

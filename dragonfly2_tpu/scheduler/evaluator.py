@@ -1,0 +1,272 @@
+"""Parent-peer evaluators: rule-based, network-topology, and ML.
+
+Reference parity (scheduler/scheduling/evaluator/):
+- algorithm dispatch by name default/nt/ml/plugin (evaluator.go:28-46,
+  :76-90).  In the reference, ``ml`` is a TODO that falls back to the base
+  evaluator (evaluator.go:84-86); here it is real.
+- base scoring: 6 weighted features summing to 1.0 — finished-piece 0.2,
+  upload-success 0.2, free-upload 0.15, host-type 0.15, IDC 0.15,
+  location 0.15 (evaluator_base.go:28-45, evaluate :71-84).
+- nt scoring: adds probe-RTT weight 0.12 and lowers host-type/IDC/location
+  to 0.11 each; RTT is normalized against the 1 s ping timeout
+  (evaluator_network_topology.go:30-56, :215-224).
+- bad-node test: needs ≥2 piece-cost samples; <30 samples → last cost >
+  20× mean of the rest; ≥30 → last cost > mean + 3σ (evaluator.go:92-129).
+
+ML evaluator (the TPU-native design): instead of a Triton RPC per
+scheduling decision (the reference's planned KServe client,
+pkg/rpc/inference/client/client_v1.go:86-100), the trainer exports a
+**local scorer** — model weights applied host-side via numpy (microsecond
+cost, no RPC on the hot path).  See ``trainer/export.py`` for the scorer
+artifact.  When no model is loaded the ML evaluator degrades to the base
+rules, exactly like the reference's fallback.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import TYPE_CHECKING, List, Optional, Protocol, Sequence
+
+import numpy as np
+
+from ..records.features import edge_features as _edge_features
+from ..records.features import host_features as _host_features
+from ..records.schema import Download, Parent
+from ..utils.types import HostType
+from .resource import (
+    PEER_BACK_TO_SOURCE,
+    PEER_FAILED,
+    PEER_LEAVE,
+    PEER_PENDING,
+    PEER_RECEIVED_EMPTY,
+    PEER_RECEIVED_NORMAL,
+    PEER_RECEIVED_SMALL,
+    PEER_RECEIVED_TINY,
+    PEER_RUNNING,
+    Peer,
+)
+
+if TYPE_CHECKING:
+    from .networktopology import NetworkTopology
+
+DEFAULT_ALGORITHM = "default"
+NETWORK_TOPOLOGY_ALGORITHM = "nt"
+ML_ALGORITHM = "ml"
+
+MAX_SCORE = 1.0
+MIN_SCORE = 0.0
+
+# Location affinity looks at up to 5 '|'-separated elements (evaluator.go maxElementLen).
+MAX_ELEMENT_LEN = 5
+# ≥30 cost samples ⇒ treat as normal distribution (evaluator.go normalDistributionLen).
+NORMAL_DISTRIBUTION_LEN = 30
+MIN_AVAILABLE_COST_LEN = 2
+
+PING_TIMEOUT_NS = 1_000_000_000  # 1 s (evaluator_network_topology.go defaultPingTimeout)
+
+_BAD_STATES = (
+    PEER_FAILED,
+    PEER_LEAVE,
+    PEER_PENDING,
+    PEER_RECEIVED_EMPTY,
+    PEER_RECEIVED_TINY,
+    PEER_RECEIVED_SMALL,
+    PEER_RECEIVED_NORMAL,
+)
+
+
+def piece_score(parent: Peer, child: Peer, total_piece_count: int) -> float:
+    if total_piece_count > 0:
+        return parent.finished_piece_count() / total_piece_count
+    return float(parent.finished_piece_count() - child.finished_piece_count())
+
+
+def upload_success_score(parent: Peer) -> float:
+    uploads = parent.host.upload_count
+    failed = parent.host.upload_failed_count
+    if uploads < failed:
+        return MIN_SCORE
+    if uploads == 0 and failed == 0:
+        return MAX_SCORE  # never scheduled → try it first
+    return (uploads - failed) / uploads
+
+
+def free_upload_score(parent: Peer) -> float:
+    limit = parent.host.concurrent_upload_limit
+    free = parent.host.free_upload_count()
+    if limit > 0 and free > 0:
+        return free / limit
+    return MIN_SCORE
+
+
+def host_type_score(parent: Peer) -> float:
+    """Seed peers win on first download (still fetching), dfdaemon peers
+    otherwise (evaluator_base.go:126-143)."""
+    if parent.host.type is not HostType.NORMAL:
+        if parent.fsm.current in (PEER_RECEIVED_NORMAL, PEER_RUNNING):
+            return MAX_SCORE
+        return MIN_SCORE
+    return MAX_SCORE * 0.5
+
+
+def idc_affinity_score(dst: str, src: str) -> float:
+    if not dst or not src:
+        return MIN_SCORE
+    return MAX_SCORE if dst.lower() == src.lower() else MIN_SCORE
+
+
+def location_affinity_score(dst: str, src: str) -> float:
+    if not dst or not src:
+        return MIN_SCORE
+    if dst.lower() == src.lower():
+        return MAX_SCORE
+    de, se = dst.split("|"), src.split("|")
+    n = min(len(de), len(se), MAX_ELEMENT_LEN)
+    score = 0
+    for i in range(n):
+        if de[i].lower() != se[i].lower():
+            break
+        score += 1
+    return score / MAX_ELEMENT_LEN
+
+
+class Evaluator:
+    """Base (rule-based) evaluator + shared bad-node detection."""
+
+    def evaluate(self, parent: Peer, child: Peer, total_piece_count: int) -> float:
+        return (
+            0.2 * piece_score(parent, child, total_piece_count)
+            + 0.2 * upload_success_score(parent)
+            + 0.15 * free_upload_score(parent)
+            + 0.15 * host_type_score(parent)
+            + 0.15 * idc_affinity_score(parent.host.stats.network.idc, child.host.stats.network.idc)
+            + 0.15
+            * location_affinity_score(
+                parent.host.stats.network.location, child.host.stats.network.location
+            )
+        )
+
+    def evaluate_parents(
+        self, parents: List[Peer], child: Peer, total_piece_count: int
+    ) -> List[Peer]:
+        return sorted(
+            parents,
+            key=lambda p: self.evaluate(p, child, total_piece_count),
+            reverse=True,
+        )
+
+    def is_bad_node(self, peer: Peer) -> bool:
+        if peer.fsm.current in _BAD_STATES:
+            return True
+        costs = peer.piece_costs()
+        n = len(costs)
+        if n < MIN_AVAILABLE_COST_LEN:
+            return False
+        last = costs[-1]
+        mean = statistics.fmean(costs[:-1])
+        if n < NORMAL_DISTRIBUTION_LEN:
+            return last > mean * 20
+        stdev = statistics.pstdev(costs[:-1])
+        return last > mean + 3 * stdev
+
+
+class NetworkTopologyEvaluator(Evaluator):
+    """Adds probe-RTT affinity (evaluator_network_topology.go)."""
+
+    def __init__(self, networktopology: "NetworkTopology") -> None:
+        self._nt = networktopology
+
+    def _rtt_score(self, parent_host_id: str, child_host_id: str) -> float:
+        rtt_ns = self._nt.average_rtt(parent_host_id, child_host_id)
+        if rtt_ns is None:
+            return MIN_SCORE
+        return (PING_TIMEOUT_NS - rtt_ns) / PING_TIMEOUT_NS
+
+    def evaluate(self, parent: Peer, child: Peer, total_piece_count: int) -> float:
+        return (
+            0.2 * piece_score(parent, child, total_piece_count)
+            + 0.2 * upload_success_score(parent)
+            + 0.15 * free_upload_score(parent)
+            + 0.11 * host_type_score(parent)
+            + 0.11 * idc_affinity_score(parent.host.stats.network.idc, child.host.stats.network.idc)
+            + 0.11
+            * location_affinity_score(
+                parent.host.stats.network.location, child.host.stats.network.location
+            )
+            + 0.12 * self._rtt_score(parent.host.id, child.host.id)
+        )
+
+
+class EdgeScorer(Protocol):
+    """What the trainer exports for the scheduler (trainer/export.py).
+
+    Scores [n] candidate edges given featurized inputs; higher = better
+    parent.  Implementations must be cheap (numpy, no device transfer) —
+    this sits on the scheduling hot path.
+    """
+
+    def score(self, features: np.ndarray) -> np.ndarray:
+        """features: [n, DOWNLOAD_FEATURE_DIM] → [n] scores."""
+        ...
+
+
+class MLEvaluator(Evaluator):
+    """Learned evaluator: ranks parents with the trainer's exported scorer.
+
+    The reference reserved this slot (evaluator.go:84 `case MLAlgorithm:
+    // TODO`) and planned a Triton round-trip; we featurize the candidate
+    edges exactly like training rows (records/features.py) and apply the
+    exported model locally.  No model → base-rule fallback, mirroring the
+    reference's fallback behavior.
+    """
+
+    def __init__(self, scorer: Optional[EdgeScorer] = None) -> None:
+        self._scorer = scorer
+
+    def set_scorer(self, scorer: Optional[EdgeScorer]) -> None:
+        self._scorer = scorer
+
+    @property
+    def has_model(self) -> bool:
+        return self._scorer is not None
+
+    def _featurize(self, parents: Sequence[Peer], child: Peer) -> np.ndarray:
+        """Build [n, DOWNLOAD_FEATURE_DIM] rows matching features.py layout
+        (child host feats ++ parent host feats ++ edge feats)."""
+        child_rec = child.host.to_record()
+        child_f = _host_features(child_rec)
+        # A lightweight Download shell so edge_features sees task context.
+        dl = Download(task=child.task.to_record(), host=child_rec)
+        rows = []
+        for p in parents:
+            parent_rec = p.to_parent_record(child)
+            rows.append(
+                np.concatenate(
+                    [child_f, _host_features(parent_rec.host), _edge_features(dl, parent_rec)]
+                )
+            )
+        return np.stack(rows).astype(np.float32)
+
+    def evaluate_parents(
+        self, parents: List[Peer], child: Peer, total_piece_count: int
+    ) -> List[Peer]:
+        if self._scorer is None or not parents:
+            return super().evaluate_parents(parents, child, total_piece_count)
+        feats = self._featurize(parents, child)
+        scores = np.asarray(self._scorer.score(feats))
+        order = np.argsort(-scores, kind="stable")
+        return [parents[i] for i in order]
+
+
+def new_evaluator(
+    algorithm: str = DEFAULT_ALGORITHM,
+    *,
+    networktopology: Optional["NetworkTopology"] = None,
+    scorer: Optional[EdgeScorer] = None,
+) -> Evaluator:
+    """Algorithm dispatch (evaluator.go:76-90)."""
+    if algorithm == NETWORK_TOPOLOGY_ALGORITHM and networktopology is not None:
+        return NetworkTopologyEvaluator(networktopology)
+    if algorithm == ML_ALGORITHM:
+        return MLEvaluator(scorer)
+    return Evaluator()

@@ -41,9 +41,13 @@ class GC:
 
     def add(self, task: Task) -> None:
         with self._mu:
+            respawn = self._started and task.id not in self._threads
             self._tasks[task.id] = task
-            if self._started:
-                self._spawn(task)
+            # Re-adding an id only swaps the task object; the existing loop
+            # thread reads the task from the registry each tick, so cadence
+            # changes take effect without spawning a duplicate runner.
+            if respawn:
+                self._spawn(task.id)
 
     def run(self, task_id: str) -> None:
         """Run one task immediately (reference: gc.Run)."""
@@ -75,22 +79,31 @@ class GC:
         if not done.wait(task.timeout):
             logger.warning("gc task %s timed out after %.1fs", task.id, task.timeout)
 
-    def _spawn(self, task: Task) -> None:
+    def _spawn(self, task_id: str) -> None:
         def loop() -> None:
-            while not self._stop.wait(task.interval):
-                self._run_once(task)
+            while True:
+                with self._mu:
+                    task = self._tasks.get(task_id)
+                if task is None:
+                    return
+                if self._stop.wait(task.interval):
+                    return
+                with self._mu:
+                    task = self._tasks.get(task_id)
+                if task is not None:
+                    self._run_once(task)
 
-        th = threading.Thread(target=loop, name=f"gc-{task.id}", daemon=True)
+        th = threading.Thread(target=loop, name=f"gc-{task_id}", daemon=True)
         th.start()
-        self._threads[task.id] = th
+        self._threads[task_id] = th
 
     def start(self) -> None:
         with self._mu:
             if self._started:
                 return
             self._started = True
-            for task in self._tasks.values():
-                self._spawn(task)
+            for task_id in self._tasks:
+                self._spawn(task_id)
 
     def stop(self) -> None:
         self._stop.set()

@@ -140,7 +140,14 @@ def _read_disk(path: str = "/") -> DiskStat:
     return stat
 
 
+# Previous (busy, total) jiffies sample: cpu.percent is the utilization over
+# the window since the last _read_cpu() call (gopsutil-style delta), not the
+# since-boot average — a host busy last week but idle now must read ~0.
+_prev_cpu_sample: Optional[tuple] = None
+
+
 def _read_cpu() -> CPUStat:
+    global _prev_cpu_sample
     stat = CPUStat(logical_count=os.cpu_count() or 0, physical_count=os.cpu_count() or 0)
     try:
         with open("/proc/stat") as f:
@@ -152,7 +159,13 @@ def _read_cpu() -> CPUStat:
                 setattr(stat.times, name, v)
             busy = sum(vals) - stat.times.idle - stat.times.iowait
             total = sum(vals)
-            if total:
+            prev = _prev_cpu_sample
+            _prev_cpu_sample = (busy, total)
+            if prev is not None and total > prev[1]:
+                stat.percent = 100.0 * (busy - prev[0]) / (total - prev[1])
+            elif total:
+                # First sample in this process: since-boot average is the
+                # only data available.
                 stat.percent = 100.0 * busy / total
     except OSError:
         pass

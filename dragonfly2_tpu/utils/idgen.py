@@ -44,7 +44,16 @@ def host_id_v2(ip: str, hostname: str, seed_peer: bool = False) -> str:
 
 
 def _filter_query_params(url: str, filtered: Sequence[str]) -> str:
-    """Drop the named query params and sort the rest for a canonical URL."""
+    """Drop the named query params and sort the rest for a canonical URL.
+
+    With no params to filter the raw URL is returned unchanged, so
+    ``task_id(url)`` and ``task_id(url, URLMeta())`` agree (the reference's
+    FilterQueryParams is likewise a no-op on an empty filter list,
+    pkg/net/url/url.go:24-27 — canonicalization only kicks in when
+    filtering already rewrites the query).
+    """
+    if not any(f.strip() for f in filtered):
+        return url
     try:
         parts = urllib.parse.urlsplit(url)
         query = urllib.parse.parse_qsl(parts.query, keep_blank_values=True)

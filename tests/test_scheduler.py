@@ -1,0 +1,414 @@
+"""Scheduler core tests: resource FSMs, DAG edges, evaluators, scheduling.
+
+Models the reference's in-process swarm tests
+(scheduler/scheduling/scheduling_test.go builds multi-peer DAGs and
+asserts parent ranking).
+"""
+
+import pytest
+
+from dragonfly2_tpu.scheduler import (
+    Evaluator,
+    MLEvaluator,
+    NetworkTopology,
+    Probe,
+    ProbeAgent,
+    Resource,
+    ScheduleResultKind,
+    Scheduling,
+    SchedulingConfig,
+    new_evaluator,
+)
+from dragonfly2_tpu.scheduler.evaluator import (
+    NetworkTopologyEvaluator,
+    host_type_score,
+    idc_affinity_score,
+    location_affinity_score,
+)
+from dragonfly2_tpu.scheduler.resource import (
+    PEER_BACK_TO_SOURCE,
+    PEER_RUNNING,
+    PEER_SUCCEEDED,
+    Host,
+    Peer,
+    Task,
+)
+from dragonfly2_tpu.utils.fsm import InvalidEventError
+from dragonfly2_tpu.utils.types import HostType, SizeScope
+
+
+def make_host(i, type=HostType.NORMAL, idc="idc-a", location="r1|z1|rk1", upload_limit=50):
+    h = Host(
+        id=f"host-{i}",
+        hostname=f"host-{i}",
+        ip=f"10.0.0.{i}",
+        type=type,
+        concurrent_upload_limit=upload_limit,
+    )
+    h.stats.network.idc = idc
+    h.stats.network.location = location
+    return h
+
+
+def make_task(tid="task-0", pieces=10, length=40 << 20):
+    t = Task(tid, "https://example.com/blob")
+    t.content_length = length
+    t.total_piece_count = pieces
+    return t
+
+
+def make_peer(i, task, host):
+    p = Peer(f"peer-{i}", task, host)
+    task.store_peer(p)
+    host.store_peer(p)
+    return p
+
+
+def running_parent(i, task, host, finished=5):
+    """A peer in Running state that has back-to-source (can serve pieces)."""
+    p = make_peer(i, task, host)
+    p.fsm.event("RegisterNormal")
+    p.fsm.event("DownloadBackToSource")
+    for n in range(finished):
+        p.finish_piece(n, 10_000_000)
+    return p
+
+
+class TestPeerFSM:
+    def test_normal_lifecycle(self):
+        t, h = make_task(), make_host(1)
+        p = make_peer(1, t, h)
+        p.fsm.event("RegisterNormal")
+        p.fsm.event("Download")
+        assert p.fsm.current == PEER_RUNNING
+        p.fsm.event("DownloadSucceeded")
+        assert p.fsm.current == PEER_SUCCEEDED
+
+    def test_illegal_transition_raises(self):
+        t, h = make_task(), make_host(1)
+        p = make_peer(1, t, h)
+        with pytest.raises(InvalidEventError):
+            p.fsm.event("Download")  # must register first
+
+    def test_back_to_source_from_running(self):
+        t, h = make_task(), make_host(1)
+        p = make_peer(1, t, h)
+        p.fsm.event("RegisterNormal")
+        p.fsm.event("Download")
+        p.fsm.event("DownloadBackToSource")
+        assert p.fsm.current == PEER_BACK_TO_SOURCE
+
+    def test_task_redownload_from_terminal(self):
+        t = make_task()
+        t.fsm.event("Download")
+        t.fsm.event("DownloadSucceeded")
+        t.fsm.event("Download")  # re-download allowed (task.go:199)
+        assert t.fsm.current == "Running"
+
+
+class TestSizeScope:
+    def test_scopes(self):
+        t = make_task(pieces=10, length=40 << 20)
+        assert t.size_scope() is SizeScope.NORMAL
+        t = make_task(pieces=1, length=1 << 20)
+        assert t.size_scope() is SizeScope.SMALL
+        t = make_task(pieces=1, length=100)
+        assert t.size_scope() is SizeScope.TINY
+        t = make_task(pieces=0, length=0)
+        assert t.size_scope() is SizeScope.EMPTY
+        t = Task("t", "u")
+        assert t.size_scope() is SizeScope.UNKNOWN
+
+
+class TestTaskDAG:
+    def test_add_edge_consumes_upload_slot(self):
+        t = make_task()
+        h1, h2 = make_host(1, upload_limit=1), make_host(2)
+        p1, p2 = make_peer(1, t, h1), make_peer(2, t, h2)
+        assert t.add_peer_edge(p1, p2)
+        assert h1.free_upload_count() == 0
+        assert t.peer_in_degree(p2.id) == 1
+
+    def test_edge_rejected_when_no_upload_slot(self):
+        t = make_task()
+        h1 = make_host(1, upload_limit=1)
+        h2, h3 = make_host(2), make_host(3)
+        p1, p2, p3 = make_peer(1, t, h1), make_peer(2, t, h2), make_peer(3, t, h3)
+        assert t.add_peer_edge(p1, p2)
+        assert not t.add_peer_edge(p1, p3)  # slot exhausted
+        assert t.peer_in_degree(p3.id) == 0
+
+    def test_cycle_rejected(self):
+        t = make_task()
+        h1, h2 = make_host(1), make_host(2)
+        p1, p2 = make_peer(1, t, h1), make_peer(2, t, h2)
+        assert t.add_peer_edge(p1, p2)
+        assert not t.can_add_peer_edge(p2.id, p1.id)
+
+    def test_delete_in_edges_releases_slots(self):
+        t = make_task()
+        h1, h2 = make_host(1, upload_limit=2), make_host(2)
+        p1, p2 = make_peer(1, t, h1), make_peer(2, t, h2)
+        t.add_peer_edge(p1, p2)
+        assert h1.free_upload_count() == 1
+        t.delete_peer_in_edges(p2.id)
+        assert h1.free_upload_count() == 2
+        assert h1.upload_count == 1
+
+
+class TestEvaluator:
+    def test_affinity_scores(self):
+        assert idc_affinity_score("idc-a", "idc-a") == 1.0
+        assert idc_affinity_score("idc-a", "idc-b") == 0.0
+        assert idc_affinity_score("", "idc-b") == 0.0
+        assert location_affinity_score("a|b|c", "a|b|c") == 1.0
+        assert location_affinity_score("a|b|c", "a|b|x") == 2 / 5
+        assert location_affinity_score("a|b", "x|b") == 0.0
+
+    def test_seed_peer_preferred_while_fetching(self):
+        t = make_task()
+        seed = make_peer(1, t, make_host(1, type=HostType.SUPER_SEED))
+        seed.fsm.event("RegisterNormal")
+        seed.fsm.event("Download")
+        assert host_type_score(seed) == 1.0
+        seed2 = make_peer(2, t, make_host(2, type=HostType.SUPER_SEED))
+        seed2.fsm.event("RegisterNormal")
+        seed2.fsm.event("Download")
+        seed2.fsm.event("DownloadSucceeded")
+        assert host_type_score(seed2) == 0.0  # finished seed scores min
+        normal = make_peer(3, t, make_host(3))
+        assert host_type_score(normal) == 0.5
+
+    def test_ranking_prefers_same_idc(self):
+        t = make_task()
+        child = make_peer(0, t, make_host(0, idc="idc-a", location="r1|z1|rk1"))
+        same = running_parent(1, t, make_host(1, idc="idc-a", location="r1|z1|rk1"))
+        far = running_parent(2, t, make_host(2, idc="idc-b", location="r2|z9|rk9"))
+        ev = Evaluator()
+        ranked = ev.evaluate_parents([far, same], child, t.total_piece_count)
+        assert ranked[0] is same
+
+    def test_bad_node_by_state_and_cost(self):
+        t = make_task()
+        p = make_peer(1, t, make_host(1))
+        ev = Evaluator()
+        assert ev.is_bad_node(p)  # Pending
+        p.fsm.event("RegisterNormal")
+        p.fsm.event("Download")
+        assert not ev.is_bad_node(p)  # no cost samples yet
+        p.append_piece_cost(100)
+        p.append_piece_cost(100)
+        assert not ev.is_bad_node(p)
+        p.append_piece_cost(100 * 25)  # > 20x mean
+        assert ev.is_bad_node(p)
+
+    def test_bad_node_three_sigma(self):
+        t = make_task()
+        p = make_peer(1, t, make_host(1))
+        p.fsm.event("RegisterNormal")
+        p.fsm.event("Download")
+        for _ in range(35):
+            p.append_piece_cost(100)
+        assert not ev_is_bad(p)
+        p.append_piece_cost(101)  # zero stdev → anything above mean is bad
+        assert ev_is_bad(p)
+
+
+def ev_is_bad(p):
+    return Evaluator().is_bad_node(p)
+
+
+class TestNetworkTopologyEvaluator:
+    def test_rtt_shifts_ranking(self):
+        nt = NetworkTopology()
+        t = make_task()
+        child = make_peer(0, t, make_host(0, idc="idc-x"))
+        a = running_parent(1, t, make_host(1, idc="idc-x"))
+        b = running_parent(2, t, make_host(2, idc="idc-x"))
+        # a has terrible RTT to child, b has great RTT.
+        nt.enqueue_probe(a.host.id, child.host.id, Probe(child.host.id, 900_000_000))
+        nt.enqueue_probe(b.host.id, child.host.id, Probe(child.host.id, 1_000_000))
+        ev = new_evaluator("nt", networktopology=nt)
+        assert isinstance(ev, NetworkTopologyEvaluator)
+        ranked = ev.evaluate_parents([a, b], child, t.total_piece_count)
+        assert ranked[0] is b
+
+
+class TestScheduling:
+    def _swarm(self, n_parents=6, upload_limit=50):
+        t = make_task()
+        child_host = make_host(0, idc="idc-a")
+        child = make_peer(0, t, child_host)
+        child.fsm.event("RegisterNormal")
+        parents = [
+            running_parent(i + 1, t, make_host(i + 1, upload_limit=upload_limit))
+            for i in range(n_parents)
+        ]
+        return t, child, parents
+
+    def test_schedule_attaches_parents(self):
+        t, child, parents = self._swarm()
+        s = Scheduling(Evaluator(), SchedulingConfig(retry_interval=0))
+        res = s.schedule_candidate_parents(child)
+        assert res.kind is ScheduleResultKind.PARENTS
+        assert 1 <= len(res.parents) <= 4
+        assert t.peer_in_degree(child.id) == len(res.parents)
+
+    def test_same_host_filtered(self):
+        t = make_task()
+        shared = make_host(9)
+        child = make_peer(0, t, shared)
+        child.fsm.event("RegisterNormal")
+        running_parent(1, t, shared)
+        s = Scheduling(Evaluator(), SchedulingConfig(retry_interval=0, retry_back_to_source_limit=1))
+        res = s.schedule_candidate_parents(child)
+        assert res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE
+
+    def test_back_to_source_when_no_parents(self):
+        t = make_task()
+        child = make_peer(0, t, make_host(0))
+        child.fsm.event("RegisterNormal")
+        s = Scheduling(Evaluator(), SchedulingConfig(retry_interval=0))
+        res = s.schedule_candidate_parents(child)
+        assert res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE
+        assert res.retries == 4
+
+    def test_hard_fail_when_no_back_to_source_budget(self):
+        t = make_task()
+        t.back_to_source_limit = 0
+        t.back_to_source_peers.add("someone")  # budget consumed
+        child = make_peer(0, t, make_host(0))
+        child.fsm.event("RegisterNormal")
+        s = Scheduling(Evaluator(), SchedulingConfig(retry_interval=0))
+        res = s.schedule_candidate_parents(child)
+        assert res.kind is ScheduleResultKind.FAILED
+        assert res.retries == 5
+
+    def test_need_back_to_source_flag_short_circuits(self):
+        t, child, _ = self._swarm()
+        child.need_back_to_source = True
+        s = Scheduling(Evaluator(), SchedulingConfig(retry_interval=0))
+        res = s.schedule_candidate_parents(child)
+        assert res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE
+
+    def test_blocklist_respected(self):
+        t, child, parents = self._swarm(n_parents=2)
+        s = Scheduling(Evaluator(), SchedulingConfig(retry_interval=0))
+        res = s.schedule_candidate_parents(child, blocklist={p.id for p in parents})
+        assert res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE
+
+    def test_find_success_parent(self):
+        t, child, parents = self._swarm(n_parents=3)
+        parents[1].fsm.event("DownloadSucceeded")
+        s = Scheduling(Evaluator(), SchedulingConfig(retry_interval=0))
+        got = s.find_success_parent(child)
+        assert got is parents[1]
+
+
+class TestMLEvaluatorFallback:
+    def test_no_model_falls_back_to_rules(self):
+        ev = MLEvaluator()
+        assert not ev.has_model
+        t = make_task()
+        child = make_peer(0, t, make_host(0, idc="idc-a"))
+        same = running_parent(1, t, make_host(1, idc="idc-a"))
+        far = running_parent(2, t, make_host(2, idc="idc-b", location="r9|z9|rk9"))
+        ranked = ev.evaluate_parents([far, same], child, t.total_piece_count)
+        assert ranked[0] is same
+
+    def test_scorer_overrides_rules(self):
+        class Inverse:
+            def score(self, feats):
+                import numpy as np
+
+                # Score by parent cpu feature ascending → deterministic control.
+                return -feats[:, 12]
+
+        t = make_task()
+        child = make_peer(0, t, make_host(0))
+        a = running_parent(1, t, make_host(1))
+        b = running_parent(2, t, make_host(2))
+        a.host.stats.cpu.percent = 90.0
+        b.host.stats.cpu.percent = 10.0
+        ev = MLEvaluator(Inverse())
+        ranked = ev.evaluate_parents([a, b], child, t.total_piece_count)
+        assert ranked[0] is b
+
+
+class TestResourceGC:
+    def test_peer_gc_reaps_left_peers(self):
+        r = Resource()
+        t = make_task()
+        h = make_host(1)
+        r.store_task(t)
+        r.store_host(h)
+        p = make_peer(1, t, h)
+        r.store_peer(p)
+        p.fsm.event("Leave")
+        reaped = r.peer_manager.run_gc()
+        assert reaped == 1
+        assert t.peer_count() == 0
+        assert h.peer_count() == 0
+
+
+class TestNetworkTopologyStore:
+    def test_ema_and_queue_cap(self):
+        nt = NetworkTopology()
+        for i in range(8):  # queue caps at 5
+            nt.enqueue_probe("s", "d", Probe("d", 100 + i))
+        assert len(nt.probes("s", "d")) == 5
+        # EMA folds left-to-right with 0.1 on the accumulator.
+        rtts = [103, 104, 105, 106, 107]
+        avg = float(rtts[0])
+        for r in rtts[1:]:
+            avg = avg * 0.1 + r * 0.9
+        assert nt.average_rtt("s", "d") == int(avg)
+        assert nt.probed_count("d") == 8
+
+    def test_find_probed_hosts_least_probed(self):
+        from dragonfly2_tpu.scheduler.resource import HostManager
+
+        hm = HostManager()
+        hosts = [make_host(i) for i in range(10)]
+        for h in hosts:
+            hm.store(h.id, h)
+        nt = NetworkTopology(hm)
+        # Load up probe counts on hosts 0..4 so 5..9 are least-probed.
+        for i in range(5):
+            nt.enqueue_probe("x", f"host-{i}", Probe(f"host-{i}", 100))
+        got = nt.find_probed_hosts("host-0")
+        assert len(got) == 5
+        got_ids = {h.id for h in got}
+        assert got_ids == {f"host-{i}" for i in range(5, 10)}
+
+    def test_probe_agent_and_snapshot(self):
+        from dragonfly2_tpu.scheduler.resource import HostManager
+
+        hm = HostManager()
+        hosts = [make_host(i) for i in range(6)]
+        for h in hosts:
+            hm.store(h.id, h)
+        nt = NetworkTopology(hm)
+        agent = ProbeAgent(hosts[0], nt, ping=lambda h: 5_000_000)
+        assert agent.sync_probes() == 5
+        records = nt.snapshot()
+        assert len(records) == 1
+        assert records[0].host.id == hosts[0].id
+        assert len(records[0].dest_hosts) == 5
+        assert all(d.probes.average_rtt == 5_000_000 for d in records[0].dest_hosts)
+
+    def test_edge_arrays_export(self):
+        nt = NetworkTopology()
+        nt.enqueue_probe("a", "b", Probe("b", 10))
+        nt.enqueue_probe("b", "c", Probe("c", 20))
+        ids, src, dst, rtt = nt.to_edge_arrays()
+        assert len(ids) == 3
+        assert src.shape == dst.shape == rtt.shape == (2,)
+
+    def test_delete_host(self):
+        nt = NetworkTopology()
+        nt.enqueue_probe("a", "b", Probe("b", 10))
+        nt.enqueue_probe("c", "a", Probe("a", 10))
+        nt.enqueue_probe("c", "d", Probe("d", 10))
+        nt.delete_host("a")
+        assert nt.edge_count() == 1

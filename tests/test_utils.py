@@ -42,9 +42,19 @@ class TestIDGen:
         b = idgen.task_id("https://x.com/f?token=9&v=2", meta)
         assert a == b
 
-    def test_task_id_canonical_param_order(self):
+    def test_task_id_no_filter_is_raw(self):
+        # Empty filter list ⇒ raw URL hashed: task_id(url) == task_id(url, URLMeta())
+        # (reference pkg/net/url/url.go:24-27 no-ops on an empty filter).
+        url = "https://x.com/f?b=2&a=1"
+        assert idgen.task_id(url) == idgen.task_id(url, idgen.URLMeta())
         a = idgen.task_id("https://x.com/f?a=1&b=2", idgen.URLMeta())
-        b = idgen.task_id("https://x.com/f?b=2&a=1", idgen.URLMeta())
+        b = idgen.task_id(url, idgen.URLMeta())
+        assert a != b  # param order matters when nothing is filtered
+
+    def test_task_id_canonical_param_order_when_filtering(self):
+        meta = idgen.URLMeta(filtered_query_params=("sig",))
+        a = idgen.task_id("https://x.com/f?a=1&b=2&sig=XYZ", meta)
+        b = idgen.task_id("https://x.com/f?b=2&sig=ABC&a=1", meta)
         assert a == b
 
     def test_task_id_range_vs_parent(self):
