@@ -1,0 +1,122 @@
+"""Benchmark: GNN training records/sec/chip (BASELINE.md headline metric).
+
+Trains the GAT parent-peer ranker's jitted train step on a synthetic probe
+graph + download-edge workload and reports steady-state records (edges)
+per second per chip.
+
+vs_baseline is measured against the north-star requirement
+(BASELINE.json): 1B records / 10 min on v5e-16 ⇒ ~104,167 records/sec/chip.
+The reference itself publishes no numbers (its trainer is a stub —
+trainer/training/training.go:82-99), so the north-star rate is the bar.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# North star: 1e9 records / 600 s / 16 chips.
+BASELINE_RECORDS_PER_SEC_PER_CHIP = 1e9 / 600.0 / 16.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.models import GATRanker, GNNConfig, build_neighbor_table
+    from dragonfly2_tpu.parallel.mesh import batch_sharding, create_mesh, replicated
+    from dragonfly2_tpu.records.synthetic import SyntheticCluster
+    from dragonfly2_tpu.trainer.train import (
+        TrainConfig,
+        TrainState,
+        _graph_train_step,
+        _make_optimizer,
+    )
+
+    n_devices = len(jax.devices())
+    on_tpu = jax.devices()[0].platform != "cpu"
+
+    # Workload at the north-star's shape: 100k-node probe graph (BASELINE
+    # "1B records over a 100k-node peer graph"), K=16 neighbors, 128k-edge
+    # batches. CPU fallback shrinks for CI smoke only.
+    n_nodes = 100_000 if on_tpu else 4096
+    batch = 131_072 if on_tpu else 8192
+    cluster = SyntheticCluster(num_hosts=n_nodes, seed=0)
+    avg_degree = 16
+    density = avg_degree / max(n_nodes - 1, 1)
+    src, dst, rtt = cluster.probe_edges(density=density, seed=0)
+    table = build_neighbor_table(n_nodes, src, dst, rtt / 1e9, max_neighbors=16)
+    node_feats = jnp.asarray(cluster._host_feature_matrix())
+
+    rng = np.random.default_rng(0)
+    e_src = rng.integers(0, n_nodes, batch).astype(np.int32)
+    e_dst = (e_src + rng.integers(1, n_nodes, batch).astype(np.int32)) % n_nodes
+    bw = cluster._bandwidth_vec(e_src, e_dst)
+    target = np.log1p(bw).astype(np.float32)
+
+    model = GATRanker(GNNConfig())  # production config: 128 hidden, 2 layers, 4 heads
+    params = model.init(
+        jax.random.PRNGKey(0),
+        node_feats,
+        table,
+        jnp.asarray(e_src[:2]),
+        jnp.asarray(e_dst[:2]),
+    )["params"]
+    cfg = TrainConfig()
+    state = TrainState.create(
+        apply_fn=model.apply,
+        params=params,
+        tx=_make_optimizer(cfg, 100),
+        dropout_rng=jax.random.PRNGKey(1),
+    )
+
+    mesh = create_mesh()
+    repl = replicated(mesh)
+    data_shard = batch_sharding(mesh)
+    state = jax.device_put(state, repl)
+    node_feats = jax.device_put(node_feats, repl)
+    table = jax.device_put(table, repl)
+
+    step = jax.jit(
+        lambda s, nf, t, a, b, y: _graph_train_step(s, nf, t, a, b, y, None),
+        in_shardings=(repl, repl, repl, data_shard, data_shard, data_shard),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+
+    a = jax.device_put(jnp.asarray(e_src), data_shard)
+    b = jax.device_put(jnp.asarray(e_dst), data_shard)
+    y = jax.device_put(jnp.asarray(target), data_shard)
+
+    # Warmup/compile.
+    state, loss = step(state, node_feats, table, a, b, y)
+    jax.block_until_ready(loss)
+
+    n_steps = 30 if on_tpu else 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, loss = step(state, node_feats, table, a, b, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    records_per_sec_per_chip = batch * n_steps / dt / n_devices
+    print(
+        json.dumps(
+            {
+                "metric": "gat_ranker_train_records_per_sec_per_chip",
+                "value": round(records_per_sec_per_chip, 1),
+                "unit": "records/s/chip",
+                "vs_baseline": round(
+                    records_per_sec_per_chip / BASELINE_RECORDS_PER_SEC_PER_CHIP, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

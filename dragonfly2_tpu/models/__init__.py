@@ -1,0 +1,23 @@
+"""Model zoo for the learned scheduling pipeline.
+
+The reference defines exactly two model *types* in its registry —
+``mlp`` and ``gnn`` (manager/models/model.go:35-46) — and never implements
+either (trainer/training/training.go:82-99 is the stub).  Here:
+
+- ``mlp``  — bandwidth regressor over download-record edge features
+             (BASELINE configs[0]).
+- ``gnn``  — GraphSAGE encoder over the probe graph (configs[1]) and a
+             GAT parent ranker (configs[2]); both use static-shape padded
+             neighbor tables so XLA compiles once.
+
+All models compute in bfloat16 on the MXU with float32 params/reductions.
+"""
+
+from .mlp import MLPRegressor, MLPConfig  # noqa: F401
+from .gnn import (  # noqa: F401
+    GATRanker,
+    GNNConfig,
+    GraphSAGE,
+    NeighborTable,
+    build_neighbor_table,
+)

@@ -1,0 +1,54 @@
+"""MLP bandwidth regressor (the reference's ``mlp`` model type).
+
+Implements the model the reference only named: ``trainMLP`` at
+trainer/training/training.go:92-99 is a 4-line TODO ("load download,
+preprocess dataset, train MLP model, upload model and metadata"), and the
+manager's registry stores ``type=mlp`` with MSE/MAE evaluation
+(manager/rpcserver/manager_server_v1.go:874-900).
+
+Input: DOWNLOAD_FEATURE_DIM (32) features per parent→child edge
+(records/features.py — child host ++ parent host ++ edge/transfer feats).
+Target: log1p(bandwidth bytes/s).
+
+TPU notes: feature width 32 and hidden widths are multiples the MXU tiles
+cleanly; compute in bf16, params + loss in f32.  The whole model is a few
+fused matmuls — the win over the reference design is not this model but
+the ingest path feeding it (columnar mmap → device).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..records.features import DOWNLOAD_FEATURE_DIM
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = DOWNLOAD_FEATURE_DIM
+    hidden: Tuple[int, ...] = (256, 256, 128)
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+
+class MLPRegressor(nn.Module):
+    """feats [B, in_dim] → predicted log-bandwidth [B]."""
+
+    config: MLPConfig = field(default_factory=MLPConfig)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        cfg = self.config
+        x = x.astype(cfg.dtype)
+        for width in cfg.hidden:
+            x = nn.Dense(width, dtype=cfg.dtype, param_dtype=jnp.float32)(x)
+            x = nn.gelu(x)
+            if cfg.dropout > 0:
+                x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        x = nn.Dense(1, dtype=jnp.float32, param_dtype=jnp.float32)(x)
+        return x[..., 0]

@@ -1,0 +1,17 @@
+"""Distributed execution over TPU meshes.
+
+The reference's distributed backend is gRPC + Redis + gossip (SURVEY.md
+§5.8); its trainer was meant to be a single process.  Here the trainer's
+internal communication is JAX collectives over ICI/DCN: a
+``jax.sharding.Mesh`` with ``data`` (batch / edge partition) and ``model``
+axes, shardings annotated with NamedSharding, XLA inserting the
+all-reduce/all-gather traffic.
+"""
+
+from .mesh import (  # noqa: F401
+    MeshSpec,
+    batch_sharding,
+    create_mesh,
+    host_local_batch,
+    replicated,
+)

@@ -1,0 +1,75 @@
+"""Mesh construction and sharding rules.
+
+Design (scaling-book recipe): pick a mesh, annotate shardings on the
+arguments, let XLA insert collectives.
+
+- ``data`` axis: batch dimension of download-record batches / edge
+  partitions of the probe graph.  Gradient all-reduce rides ICI.
+- ``model`` axis: reserved for large embedding tables (node embeddings of
+  the 100k+-host graph are sharded here when they outgrow one chip's HBM).
+
+The trainer's standard configs (BASELINE.md):
+- 1 chip    → mesh (1, 1): everything local, jit only.
+- v5e-16    → mesh (16, 1): pure DP, psum over ICI.
+- multi-slice → mesh (slices*chips, 1) with DCN-aware partitioning: JAX
+  exposes slice boundaries via device attributes; keeping ``data``
+  innermost-major over ICI keeps the heavy gradient traffic off DCN.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    data: int = -1   # -1 → all remaining devices
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> tuple:
+        model = max(self.model, 1)
+        data = self.data if self.data > 0 else n_devices // model
+        if data * model != n_devices:
+            raise ValueError(
+                f"mesh {data}x{model} does not tile {n_devices} devices"
+            )
+        return data, model
+
+
+def create_mesh(
+    spec: Optional[MeshSpec] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build the (data, model) mesh over the given (default: all) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    spec = spec or MeshSpec()
+    data, model = spec.resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(data, model)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard dim 0 (batch / edges) over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def host_local_batch(global_batch: int, mesh: Mesh) -> int:
+    """Per-host slice of the global batch (multi-host input pipelines feed
+    only their addressable shard)."""
+    return global_batch // max(jax.process_count(), 1)
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Round up so shards are equal-size (static shapes; XLA compiles once)."""
+    return ((n + multiple - 1) // multiple) * multiple

@@ -31,7 +31,7 @@ import numpy as np
 
 from ..records.features import edge_features as _edge_features
 from ..records.features import host_features as _host_features
-from ..records.schema import Download, Parent
+from ..records.schema import Download
 from ..utils.types import HostType
 from .resource import (
     PEER_BACK_TO_SOURCE,

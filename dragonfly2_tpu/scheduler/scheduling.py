@@ -179,6 +179,13 @@ class Scheduling:
             for parent in parents:
                 if peer.task.add_peer_edge(parent, peer):
                     attached.append(parent)
+            if not attached:
+                # Every edge-add lost its upload-slot race — treat like a
+                # found-nothing round so the peer keeps progressing toward
+                # back-to-source instead of stalling with zero parents.
+                n += 1
+                self._sleep(self.config.retry_interval)
+                continue
             return ScheduleResult(
                 kind=ScheduleResultKind.PARENTS, parents=attached, retries=n
             )

@@ -1,0 +1,28 @@
+"""Trainer service — the north-star component (reference: trainer/).
+
+The reference's trainer ingests scheduler CSV uploads and stubs the
+training (trainer/training/training.go:82-99 — ``trainGNN``/``trainMLP``
+are TODO bodies).  This package is the real implementation, TPU-native:
+
+- ``ingest``     — columnar shards → shuffled, static-shape, mesh-sharded
+                   device batches (replaces the 128 MiB CSV chunk stream,
+                   scheduler/announcer/announcer.go:173-237).
+- ``train``      — jit/pjit train loops for the MLP regressor and the
+                   GraphSAGE/GAT graph models; data-parallel over the
+                   ``data`` mesh axis; orbax checkpointing.
+- ``export``     — model → local scorer artifact for the scheduler's ML
+                   evaluator + model push to the manager registry.
+- ``service``    — the Train ingest boundary (per-host dataset keying,
+                   trainer/service/service_v1.go:59-160) and the
+                   train-on-EOF kick.
+"""
+
+from .ingest import EdgeBatches, load_download_dataset, split_columns  # noqa: F401
+from .train import (  # noqa: F401
+    EvalMetrics,
+    TrainConfig,
+    train_gat_ranker,
+    train_graphsage,
+    train_mlp,
+)
+from .export import MLPScorer, export_from_state, export_mlp_scorer, load_scorer  # noqa: F401

@@ -140,36 +140,61 @@ def _read_disk(path: str = "/") -> DiskStat:
     return stat
 
 
-# Previous (busy, total) jiffies sample: cpu.percent is the utilization over
-# the window since the last _read_cpu() call (gopsutil-style delta), not the
-# since-boot average — a host busy last week but idle now must read ~0.
-_prev_cpu_sample: Optional[tuple] = None
+class CPUSampler:
+    """Delta-window CPU utilization (gopsutil-style): percent over the
+    interval since THIS sampler's previous read, not the since-boot average.
+
+    Each periodic caller owns a sampler so concurrent loops don't steal each
+    other's windows; reads under a lock; a re-read before the jiffy counter
+    advances returns the last computed percent instead of degrading to the
+    since-boot average.
+    """
+
+    def __init__(self) -> None:
+        import threading
+
+        self._mu = threading.Lock()
+        self._prev: Optional[tuple] = None
+        self._last_percent: Optional[float] = None
+
+    def read(self) -> CPUStat:
+        stat = CPUStat(
+            logical_count=os.cpu_count() or 0, physical_count=os.cpu_count() or 0
+        )
+        try:
+            with open("/proc/stat") as f:
+                first = f.readline().split()
+        except OSError:
+            return stat
+        if not first or first[0] != "cpu":
+            return stat
+        vals = [float(v) for v in first[1:]]
+        names = ["user", "nice", "system", "idle", "iowait", "irq", "softirq", "steal", "guest"]
+        for name, v in zip(names, vals):
+            setattr(stat.times, name, v)
+        busy = sum(vals) - stat.times.idle - stat.times.iowait
+        total = sum(vals)
+        with self._mu:
+            prev = self._prev
+            if prev is not None and total > prev[1]:
+                self._prev = (busy, total)
+                self._last_percent = 100.0 * (busy - prev[0]) / (total - prev[1])
+                stat.percent = self._last_percent
+            elif prev is not None:
+                # Counter hasn't advanced — keep the last window's value.
+                stat.percent = self._last_percent or 0.0
+            else:
+                self._prev = (busy, total)
+                # First sample ever: since-boot average is all we have.
+                stat.percent = 100.0 * busy / total if total else 0.0
+        return stat
+
+
+_default_cpu_sampler = CPUSampler()
 
 
 def _read_cpu() -> CPUStat:
-    global _prev_cpu_sample
-    stat = CPUStat(logical_count=os.cpu_count() or 0, physical_count=os.cpu_count() or 0)
-    try:
-        with open("/proc/stat") as f:
-            first = f.readline().split()
-        if first and first[0] == "cpu":
-            vals = [float(v) for v in first[1:]]
-            names = ["user", "nice", "system", "idle", "iowait", "irq", "softirq", "steal", "guest"]
-            for name, v in zip(names, vals):
-                setattr(stat.times, name, v)
-            busy = sum(vals) - stat.times.idle - stat.times.iowait
-            total = sum(vals)
-            prev = _prev_cpu_sample
-            _prev_cpu_sample = (busy, total)
-            if prev is not None and total > prev[1]:
-                stat.percent = 100.0 * (busy - prev[0]) / (total - prev[1])
-            elif total:
-                # First sample in this process: since-boot average is the
-                # only data available.
-                stat.percent = 100.0 * busy / total
-    except OSError:
-        pass
-    return stat
+    return _default_cpu_sampler.read()
 
 
 def _local_ip() -> str:

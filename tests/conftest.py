@@ -9,12 +9,20 @@ Must run before any jax import, hence the env mutation at module import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the environment presets JAX_PLATFORMS=axon (the real TPU
+# tunnel) and its sitecustomize re-prepends "axon" to jax_platforms at
+# interpreter startup, so the env var alone cannot win — unit tests must
+# run on the virtual 8-device CPU mesh, forced via jax.config below.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
