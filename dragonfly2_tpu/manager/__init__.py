@@ -1,0 +1,24 @@
+"""Manager control plane (reference: manager/).
+
+The pieces of the reference manager the learned-scheduling loop depends
+on, rebuilt as an embeddable runtime:
+
+- ``registry``  — the model registry: versioned immutable scorer
+  artifacts with transactional single-active activation per scheduler
+  (reference: manager/rpcserver/manager_server_v1.go:802-901 CreateModel,
+  manager/service/model.go:103-190 activation, manager/models/model.go
+  schema).  Artifacts are the trainer's local-scorer blobs rather than
+  Triton ``model.graphdef`` dirs.
+- ``searcher``  — scheduler-cluster selection for joining daemons by
+  weighted affinity (manager/searcher/searcher.go:106-287).
+- ``dynconfig`` — manager-sourced dynamic config with observer
+  notification and disk-cache fallback (internal/dynconfig/dynconfig.go,
+  scheduler/config/dynconfig.go:58-137).
+- ``cluster``   — scheduler/seed-peer cluster records + keepalive state
+  (manager/models, keepalive at manager_server_v2.go:749).
+"""
+
+from .registry import Model, ModelRegistry, ModelState  # noqa: F401
+from .searcher import ClusterScopes, SchedulerCluster, Searcher  # noqa: F401
+from .dynconfig import Dynconfig, DynconfigServer  # noqa: F401
+from .cluster import ClusterManager, SchedulerInstance, SeedPeerInstance  # noqa: F401

@@ -1,0 +1,153 @@
+"""Dynamic configuration: manager-sourced, observer-notified, disk-cached.
+
+Reference semantics (internal/dynconfig/dynconfig.go:45-136,
+scheduler/config/dynconfig.go:58-137, client/config/dynconfig_manager.go):
+- clients poll the manager every ``refresh_interval`` for cluster-scoped
+  config (scheduler lists, cluster overrides like candidate/filter parent
+  limits, active model versions);
+- observers register and are notified on change;
+- every successful fetch is cached to disk; when the manager is
+  unreachable the cached copy keeps the service running.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class DynconfigServer:
+    """Manager-side: per-scope config versions (the source of truth)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        self._data: Dict[str, Dict[str, Any]] = {}
+        self._version: Dict[str, int] = {}
+
+    def set(self, scope: str, config: Dict[str, Any]) -> int:
+        with self._mu:
+            self._data[scope] = dict(config)
+            self._version[scope] = self._version.get(scope, 0) + 1
+            return self._version[scope]
+
+    def update(self, scope: str, **fields: Any) -> int:
+        with self._mu:
+            merged = dict(self._data.get(scope, {}))
+            merged.update(fields)
+            return self.set(scope, merged)
+
+    def get(self, scope: str) -> tuple:
+        """Returns (config, version); raises KeyError for unknown scope."""
+        with self._mu:
+            return dict(self._data[scope]), self._version[scope]
+
+
+class Dynconfig:
+    """Client-side cached fetcher with observers and disk fallback."""
+
+    def __init__(
+        self,
+        fetch: Callable[[], Dict[str, Any]],
+        *,
+        refresh_interval: float = 300.0,
+        cache_path: Optional[str] = None,
+    ) -> None:
+        self._fetch = fetch
+        self._interval = refresh_interval
+        self._cache_path = cache_path
+        self._mu = threading.RLock()
+        self._data: Optional[Dict[str, Any]] = None
+        self._fetched_at = 0.0
+        self._observers: List[Callable[[Dict[str, Any]], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- observers (dynconfig.go:361-412 observer pattern) -------------------
+
+    def register(self, observer: Callable[[Dict[str, Any]], None]) -> None:
+        with self._mu:
+            self._observers.append(observer)
+            data = self._data
+        if data is not None:
+            observer(dict(data))
+
+    def deregister(self, observer: Callable[[Dict[str, Any]], None]) -> None:
+        with self._mu:
+            if observer in self._observers:
+                self._observers.remove(observer)
+
+    # -- fetch / cache -------------------------------------------------------
+
+    def _load_disk_cache(self) -> Optional[Dict[str, Any]]:
+        if not self._cache_path or not os.path.exists(self._cache_path):
+            return None
+        try:
+            with open(self._cache_path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def _store_disk_cache(self, data: Dict[str, Any]) -> None:
+        if not self._cache_path:
+            return
+        tmp = self._cache_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self._cache_path)
+        except OSError:
+            pass
+
+    def refresh(self) -> bool:
+        """One fetch; on failure fall back to memory then disk cache.
+        Returns True if new data was obtained and observers notified."""
+        try:
+            data = self._fetch()
+        except Exception:  # noqa: BLE001 — manager outage must not kill clients
+            with self._mu:
+                if self._data is None:
+                    disk = self._load_disk_cache()
+                    if disk is not None:
+                        self._data = disk
+            return False
+        with self._mu:
+            changed = data != self._data
+            self._data = data
+            self._fetched_at = time.time()
+            observers = list(self._observers) if changed else []
+        self._store_disk_cache(data)
+        for obs in observers:
+            obs(dict(data))
+        return changed
+
+    def get(self) -> Dict[str, Any]:
+        with self._mu:
+            if self._data is not None and (
+                time.time() - self._fetched_at < self._interval
+            ):
+                return dict(self._data)
+        self.refresh()
+        with self._mu:
+            if self._data is None:
+                raise RuntimeError("dynconfig: no data and manager unreachable")
+            return dict(self._data)
+
+    # -- background serve ----------------------------------------------------
+
+    def serve(self) -> None:
+        if self._thread is not None:
+            return
+        self.refresh()
+
+        def loop() -> None:
+            while not self._stop.wait(self._interval):
+                self.refresh()
+
+        self._thread = threading.Thread(target=loop, name="dynconfig", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

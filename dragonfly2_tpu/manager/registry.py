@@ -1,0 +1,214 @@
+"""Versioned model registry with single-active activation.
+
+Reference semantics (manager/):
+- models are immutable versioned objects keyed (scheduler_id, name, type,
+  version); CreateModel writes the artifact to object storage and records
+  a DB row with evaluation metrics, state=inactive
+  (manager_server_v1.go:802-901, models/model.go:35-46);
+- activation is transactional and single-active per scheduler: activating
+  version V first deactivates the currently-active version, then flips V
+  (service/model.go:103-190 — the config.pbtxt version-policy rewrite
+  becomes a pointer update here);
+- model types: ``gnn`` | ``mlp`` (models/model.go).
+
+The artifact bytes here are trainer/export.py scorer blobs (npz), stored
+in a content-addressed blob store (filesystem dir or in-memory), replacing
+the reference's S3/OSS Triton layout (types/model.go:66-73).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import idgen
+
+
+class ModelState(str, enum.Enum):
+    ACTIVE = "active"
+    INACTIVE = "inactive"
+
+
+@dataclass
+class Model:
+    """One model version (manager/models/model.go:35-46)."""
+
+    id: str
+    name: str
+    type: str                      # "gnn" | "mlp"
+    version: int
+    scheduler_id: str
+    state: ModelState = ModelState.INACTIVE
+    evaluation: Dict[str, float] = field(default_factory=dict)
+    blob_key: str = ""
+    created_at: float = field(default_factory=time.time)
+    updated_at: float = field(default_factory=time.time)
+
+
+class BlobStore:
+    """Content-addressed artifact store (objectstorage replacement).
+
+    ``directory=None`` keeps blobs in memory (tests / embedded runs).
+    """
+
+    def __init__(self, directory: Optional[str] = None) -> None:
+        self._dir = directory
+        self._mem: Dict[str, bytes] = {}
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+
+    def put(self, key: str, data: bytes) -> None:
+        if self._dir:
+            path = os.path.join(self._dir, key)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)  # atomic: readers never see partial blobs
+        else:
+            self._mem[key] = data
+
+    def get(self, key: str) -> bytes:
+        if self._dir:
+            with open(os.path.join(self._dir, key), "rb") as f:
+                return f.read()
+        return self._mem[key]
+
+    def exists(self, key: str) -> bool:
+        if self._dir:
+            return os.path.exists(os.path.join(self._dir, key))
+        return key in self._mem
+
+
+class ModelRegistry:
+    """The registry service (manager CreateModel + model REST CRUD)."""
+
+    def __init__(self, blob_store: Optional[BlobStore] = None) -> None:
+        self._mu = threading.RLock()
+        self._models: Dict[str, Model] = {}
+        self.blobs = blob_store or BlobStore()
+
+    # -- CreateModel (manager_server_v1.go:802-901) -------------------------
+
+    def create_model(
+        self,
+        *,
+        name: str,
+        type: str,
+        scheduler_id: str,
+        artifact: bytes,
+        evaluation: Optional[Dict[str, float]] = None,
+        ip: str = "",
+        hostname: str = "",
+    ) -> Model:
+        if type not in ("gnn", "mlp"):
+            raise ValueError(f"unknown model type {type!r}")
+        with self._mu:
+            version = (
+                max(
+                    (
+                        m.version
+                        for m in self._models.values()
+                        if m.scheduler_id == scheduler_id and m.name == name
+                    ),
+                    default=0,
+                )
+                + 1
+            )
+            model_id = idgen.model_id(ip or scheduler_id, hostname or scheduler_id, name)
+            # Hash the full scheduler id — a prefix truncation would let two
+            # schedulers with a shared id prefix overwrite each other's blobs.
+            from ..utils.digest import sha256_from_strings
+
+            sched_key = sha256_from_strings(scheduler_id)[:24]
+            blob_key = f"{name}-{sched_key}-v{version}.npz"
+            self.blobs.put(blob_key, artifact)
+            model = Model(
+                id=f"{model_id}-v{version}",
+                name=name,
+                type=type,
+                version=version,
+                scheduler_id=scheduler_id,
+                evaluation=dict(evaluation or {}),
+                blob_key=blob_key,
+            )
+            self._models[model.id] = model
+            return model
+
+    # -- activation (service/model.go:103-190) ------------------------------
+
+    def activate(self, model_id: str) -> Model:
+        """Single-active per (scheduler, name): flips the previous active
+        version to inactive and the named version to active, atomically."""
+        with self._mu:
+            model = self._models.get(model_id)
+            if model is None:
+                raise KeyError(model_id)
+            for other in self._models.values():
+                if (
+                    other.scheduler_id == model.scheduler_id
+                    and other.name == model.name
+                    and other.state is ModelState.ACTIVE
+                ):
+                    other.state = ModelState.INACTIVE
+                    other.updated_at = time.time()
+            model.state = ModelState.ACTIVE
+            model.updated_at = time.time()
+            return model
+
+    def deactivate(self, model_id: str) -> Model:
+        with self._mu:
+            model = self._models[model_id]
+            model.state = ModelState.INACTIVE
+            model.updated_at = time.time()
+            return model
+
+    def delete(self, model_id: str) -> None:
+        with self._mu:
+            self._models.pop(model_id, None)
+
+    # -- reads ---------------------------------------------------------------
+
+    def get(self, model_id: str) -> Optional[Model]:
+        with self._mu:
+            return self._models.get(model_id)
+
+    def list(
+        self,
+        *,
+        scheduler_id: Optional[str] = None,
+        name: Optional[str] = None,
+        type: Optional[str] = None,
+        state: Optional[ModelState] = None,
+    ) -> List[Model]:
+        with self._mu:
+            out = []
+            for m in self._models.values():
+                if scheduler_id is not None and m.scheduler_id != scheduler_id:
+                    continue
+                if name is not None and m.name != name:
+                    continue
+                if type is not None and m.type != type:
+                    continue
+                if state is not None and m.state is not state:
+                    continue
+                out.append(m)
+            return sorted(out, key=lambda m: (m.name, m.version))
+
+    def active_model(self, scheduler_id: str, name: str) -> Optional[Model]:
+        """What the scheduler's dynconfig poll asks: the active version."""
+        with self._mu:
+            for m in self._models.values():
+                if (
+                    m.scheduler_id == scheduler_id
+                    and m.name == name
+                    and m.state is ModelState.ACTIVE
+                ):
+                    return m
+            return None
+
+    def load_artifact(self, model: Model) -> bytes:
+        return self.blobs.get(model.blob_key)

@@ -64,7 +64,7 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def host_local_batch(global_batch: int, mesh: Mesh) -> int:
+def host_local_batch(global_batch: int) -> int:
     """Per-host slice of the global batch (multi-host input pipelines feed
     only their addressable shard)."""
     return global_batch // max(jax.process_count(), 1)

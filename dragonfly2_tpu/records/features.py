@@ -104,6 +104,29 @@ DOWNLOAD_FEATURE_NAMES = (
 )
 DOWNLOAD_FEATURE_DIM = len(DOWNLOAD_FEATURE_NAMES)  # 32
 
+# Features measured DURING/AFTER the very transfer being predicted — known
+# in a completed Download record but NOT at scheduling time (the evaluator
+# ranks parents before any piece moves).  The deployed scorer must train
+# with these zeroed so train and serve distributions match; leaving them in
+# lets the model key on the leak and collapse at serve time.
+POST_HOC_FEATURE_NAMES = (
+    "piece_count_log",          # pieces this parent served to this child
+    "mean_piece_size_log",
+    "parent_cost_log_s",        # duration of this parent's transfers
+    "parent_upload_pieces_log",
+)
+POST_HOC_FEATURE_IDX = tuple(
+    i for i, n in enumerate(DOWNLOAD_FEATURE_NAMES)
+    if n in POST_HOC_FEATURE_NAMES
+)
+
+
+def mask_post_hoc(features: np.ndarray) -> np.ndarray:
+    """Zero the post-hoc columns of [n, DOWNLOAD_FEATURE_DIM] rows (copy)."""
+    out = np.array(features, dtype=np.float32, copy=True)
+    out[..., list(POST_HOC_FEATURE_IDX)] = 0.0
+    return out
+
 # Full columnar row = src hash bucket, dst hash bucket, features..., target.
 DOWNLOAD_COLUMNS = ("src_bucket", "dst_bucket") + DOWNLOAD_FEATURE_NAMES + ("target_log_bw",)
 

@@ -245,6 +245,8 @@ class MLEvaluator(Evaluator):
                     [child_f, _host_features(parent_rec.host), _edge_features(dl, parent_rec)]
                 )
             )
+        # Raw features; the scorer artifact applies its own post-hoc mask
+        # (MLPScorer.score) so the train/serve contract travels with it.
         return np.stack(rows).astype(np.float32)
 
     def evaluate_parents(

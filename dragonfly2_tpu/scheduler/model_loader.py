@@ -1,0 +1,80 @@
+"""Scheduler-side model subscription: registry → MLEvaluator scorer.
+
+The reference intended the scheduler to call Triton over gRPC for every
+evaluation (evaluator.go:84 TODO + the unwired KServe client); instead the
+scheduler polls the manager registry (via dynconfig cadence) for the
+active scorer version and hot-swaps the local MLEvaluator's scorer — a
+pointer flip, never an RPC during scheduling.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..manager.registry import ModelRegistry
+from .evaluator import MLEvaluator
+
+logger = logging.getLogger(__name__)
+
+
+class ModelSubscriber:
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        evaluator: MLEvaluator,
+        *,
+        scheduler_id: str,
+        model_name: str = "parent-bandwidth-mlp",
+        refresh_interval: float = 300.0,
+    ) -> None:
+        self.registry = registry
+        self.evaluator = evaluator
+        self.scheduler_id = scheduler_id
+        self.model_name = model_name
+        self.refresh_interval = refresh_interval
+        self._loaded_version: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def refresh(self) -> bool:
+        """Pull the active version if it changed; returns True on swap."""
+        model = self.registry.active_model(self.scheduler_id, self.model_name)
+        if model is None:
+            if self._loaded_version is not None:
+                self.evaluator.set_scorer(None)  # deactivated → rule fallback
+                self._loaded_version = None
+                return True
+            return False
+        if model.version == self._loaded_version:
+            return False
+        from ..trainer.export import load_scorer
+
+        try:
+            scorer = load_scorer(self.registry.load_artifact(model))
+        except Exception:  # noqa: BLE001 — a bad artifact must not break scheduling
+            logger.exception("loading model %s failed", model.id)
+            return False
+        self.evaluator.set_scorer(scorer)
+        self._loaded_version = model.version
+        logger.info("ML evaluator now serving %s v%d", model.name, model.version)
+        return True
+
+    def serve(self) -> None:
+        if self._thread is not None:
+            return
+        self.refresh()
+
+        def loop() -> None:
+            while not self._stop.wait(self.refresh_interval):
+                try:
+                    self.refresh()
+                except Exception:  # noqa: BLE001
+                    logger.exception("model refresh failed")
+
+        self._thread = threading.Thread(target=loop, name="model-subscriber", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

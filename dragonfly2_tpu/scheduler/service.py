@@ -1,0 +1,210 @@
+"""Scheduler service layer: peer lifecycle handling + training-record birth.
+
+Transport-neutral port of the reference's gRPC handler logic
+(scheduler/service/service_v1.go, service_v2.go).  The daemon (or the
+in-process swarm simulator) calls these methods where the reference
+demuxes stream messages:
+
+- ``register_peer``       — service_v2.go:866 handleRegisterPeerRequest /
+  service_v1.go:95 RegisterPeerTask: load-or-create host/task/peer, FSM
+  register event by size scope, schedule.
+- ``report_piece_finished`` — service_v2.go:1157: piece cost bookkeeping
+  on the child peer (parent-attributed — the training signal).
+- ``report_peer_finished``  — service_v1.go:1284 handlePeerSuccess →
+  :1418 createDownloadRecord: FSM success + **Download record written to
+  storage** (the row the trainer trains on; v1 is the only record-writing
+  path in the reference too).
+- ``report_peer_failed``   — FSM failure + reschedule bookkeeping.
+- ``leave_peer`` / ``leave_host`` — teardown.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Set
+
+from ..records import schema
+from ..records.storage import Storage
+from ..utils import idgen
+from ..utils.types import HostType, Priority, SizeScope
+from .networktopology import NetworkTopology, Probe
+from .resource import Host, Peer, Piece, Resource, Task
+from .scheduling import ScheduleResult, ScheduleResultKind, Scheduling
+
+
+@dataclass
+class RegisterResult:
+    peer: Peer
+    size_scope: SizeScope
+    schedule: Optional[ScheduleResult] = None
+    direct_piece: bytes = b""
+
+
+class SchedulerService:
+    """The composition the rpcserver binds (scheduler/scheduler.go:69-301)."""
+
+    def __init__(
+        self,
+        resource: Resource,
+        scheduling: Scheduling,
+        storage: Optional[Storage] = None,
+        networktopology: Optional[NetworkTopology] = None,
+    ) -> None:
+        self.resource = resource
+        self.scheduling = scheduling
+        self.storage = storage
+        self.networktopology = networktopology
+        self._mu = threading.Lock()
+
+    # -- registration -------------------------------------------------------
+
+    def register_peer(
+        self,
+        *,
+        host: Host,
+        url: str,
+        peer_id: Optional[str] = None,
+        task_id: Optional[str] = None,
+        priority: Priority = Priority.LEVEL0,
+        tag: str = "",
+        application: str = "",
+        blocklist: Optional[Set[str]] = None,
+    ) -> RegisterResult:
+        host = self.resource.store_host(host)
+        host.touch()
+        tid = task_id or idgen.task_id(url)
+        task = self.resource.store_task(Task(tid, url, tag=tag, application=application))
+        task.touch()
+        peer = Peer(
+            peer_id or idgen.peer_id(host.ip, host.hostname),
+            task,
+            host,
+            priority=priority,
+            tag=tag,
+            application=application,
+        )
+        peer = self.resource.store_peer(peer)
+        task.store_peer(peer)
+        host.store_peer(peer)
+
+        if task.fsm.can("Download"):
+            task.fsm.event("Download")
+
+        scope = task.size_scope()
+        if scope is SizeScope.EMPTY:
+            peer.fsm.event("RegisterEmpty")
+            return RegisterResult(peer=peer, size_scope=scope)
+        if scope is SizeScope.TINY and task.can_reuse_direct_piece():
+            peer.fsm.event("RegisterTiny")
+            return RegisterResult(
+                peer=peer, size_scope=scope, direct_piece=task.direct_piece
+            )
+        if scope is SizeScope.SMALL:
+            peer.fsm.event("RegisterSmall")
+        else:
+            peer.fsm.event("RegisterNormal")
+        schedule = self.scheduling.schedule_candidate_parents(peer, blocklist)
+        if schedule.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
+            task.back_to_source_peers.add(peer.id)
+            if peer.fsm.can("DownloadBackToSource"):
+                peer.fsm.event("DownloadBackToSource")
+        elif schedule.kind is ScheduleResultKind.PARENTS and peer.fsm.can("Download"):
+            peer.fsm.event("Download")
+        return RegisterResult(peer=peer, size_scope=scope, schedule=schedule)
+
+    # -- piece / peer results ----------------------------------------------
+
+    def report_piece_finished(
+        self,
+        peer: Peer,
+        piece_number: int,
+        *,
+        parent_id: str = "",
+        length: int = 0,
+        cost_ns: int = 0,
+    ) -> None:
+        """DownloadPieceFinishedRequest (service_v2.go:1157)."""
+        peer.finish_piece(piece_number, cost_ns, parent_id=parent_id, length=length)
+        peer.task.store_piece(
+            Piece(piece_number, parent_id=parent_id, length=length, cost_ns=cost_ns)
+        )
+
+    def report_piece_failed(self, peer: Peer, parent_id: str) -> ScheduleResult:
+        """Piece failure → blocklist the parent and reschedule
+        (service handleDownloadPieceFailedRequest)."""
+        peer.block_parents.add(parent_id)
+        return self.scheduling.schedule_candidate_parents(peer)
+
+    def report_peer_finished(self, peer: Peer) -> None:
+        """handlePeerSuccess (:1284) + createDownloadRecord (:1418-1629)."""
+        if peer.fsm.can("DownloadSucceeded"):
+            peer.fsm.event("DownloadSucceeded")
+        peer.cost_ns = int((time.time() - peer.created_at) * 1e9)
+        task = peer.task
+        if task.fsm.can("DownloadSucceeded"):
+            task.fsm.event("DownloadSucceeded")
+        if self.storage is not None:
+            self.storage.create_download(self._build_download_record(peer))
+
+    def report_peer_failed(self, peer: Peer) -> None:
+        if peer.fsm.can("DownloadFailed"):
+            peer.fsm.event("DownloadFailed")
+        if self.storage is not None:
+            self.storage.create_download(
+                self._build_download_record(peer, state="Failed")
+            )
+
+    def leave_peer(self, peer: Peer) -> None:
+        if peer.fsm.can("Leave"):
+            peer.fsm.event("Leave")
+        peer.task.delete_peer_in_edges(peer.id)
+        peer.task.delete_peer_out_edges(peer.id)
+
+    def leave_host(self, host: Host) -> None:
+        host.leave_peers()
+        if self.networktopology is not None:
+            self.networktopology.delete_host(host.id)
+
+    # -- probes (service_v2.go:721-866 SyncProbes) ---------------------------
+
+    def sync_probes_start(self, host: Host) -> List[Host]:
+        if self.networktopology is None:
+            return []
+        return self.networktopology.find_probed_hosts(host.id)
+
+    def sync_probes_finished(
+        self, host: Host, results: List[tuple]
+    ) -> None:
+        """results: [(dest_host_id, rtt_ns)]"""
+        if self.networktopology is None:
+            return
+        for dest_id, rtt_ns in results:
+            self.networktopology.store(host.id, dest_id)
+            self.networktopology.enqueue_probe(
+                host.id, dest_id, Probe(host_id=dest_id, rtt_ns=int(rtt_ns))
+            )
+
+    # -- record construction (service_v1.go:1418-1629) -----------------------
+
+    def _build_download_record(
+        self, peer: Peer, state: Optional[str] = None
+    ) -> schema.Download:
+        parents = [
+            parent.to_parent_record(peer)
+            for parent in peer.task.load_parents(peer.id)
+        ][: schema.MAX_PARENTS_PER_DOWNLOAD]
+        return schema.Download(
+            id=peer.id,
+            tag=peer.tag,
+            application=peer.application,
+            state=state or peer.fsm.current,
+            cost=peer.cost_ns,
+            finished_piece_count=peer.finished_piece_count(),
+            task=peer.task.to_record(),
+            host=peer.host.to_record(),
+            parents=parents,
+            created_at=int(peer.created_at * 1e9),
+            updated_at=int(peer.updated_at * 1e9),
+        )

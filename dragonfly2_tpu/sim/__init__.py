@@ -1,0 +1,12 @@
+"""In-process multi-node simulation (SURVEY §4 tier 2).
+
+The reference simulates whole swarms in-process for scheduling tests
+(scheduler/scheduling/scheduling_test.go) and fakes Redis for topology
+tests; it has no end-to-end data→train loop to simulate (the trainer is a
+stub).  This package drives the REAL components — SchedulerService,
+NetworkTopology, record Storage, TrainerService, ModelRegistry — against
+the SyntheticCluster's ground-truth bandwidth/RTT model, closing the loop
+the reference never closed, deterministically and without sockets.
+"""
+
+from .swarm import SwarmSimulator, SwarmConfig  # noqa: F401

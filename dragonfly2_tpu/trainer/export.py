@@ -40,12 +40,21 @@ class MLPScorer:
     weights: List[Tuple[np.ndarray, np.ndarray]]  # [(W, b), ...]
     feat_mean: Optional[np.ndarray] = None
     feat_std: Optional[np.ndarray] = None
+    # True when the model was trained with post-hoc transfer features zeroed
+    # (records/features.mask_post_hoc). The scorer applies the SAME mask at
+    # serve time so the train/serve contract travels WITH the artifact —
+    # callers never pre-mask.
+    post_hoc_masked: bool = True
     feature_names: Tuple[str, ...] = DOWNLOAD_FEATURE_NAMES
     model_type: str = "mlp"
     version: int = SCORER_SCHEMA_VERSION
 
     def score(self, features: np.ndarray) -> np.ndarray:
         x = np.asarray(features, dtype=np.float32)
+        if self.post_hoc_masked:
+            from ..records.features import mask_post_hoc
+
+            x = mask_post_hoc(x)
         if self.feat_mean is not None:
             x = (x - self.feat_mean) / self.feat_std
         n = len(self.weights)
@@ -72,20 +81,30 @@ def export_mlp_scorer(
     *,
     feat_mean: Optional[np.ndarray] = None,
     feat_std: Optional[np.ndarray] = None,
+    post_hoc_masked: bool = True,
     feature_names: Tuple[str, ...] = DOWNLOAD_FEATURE_NAMES,
 ) -> MLPScorer:
     return MLPScorer(
         weights=_flatten_mlp_params(params),
         feat_mean=None if feat_mean is None else np.asarray(feat_mean, np.float32),
         feat_std=None if feat_std is None else np.asarray(feat_std, np.float32),
+        post_hoc_masked=post_hoc_masked,
         feature_names=feature_names,
     )
 
 
-def export_from_state(state) -> MLPScorer:
-    """TrainState (trainer/train.py) → scorer with its normalizer."""
+def export_from_state(state, *, post_hoc_masked: bool = True) -> MLPScorer:
+    """TrainState (trainer/train.py) → scorer with its normalizer.
+
+    ``post_hoc_masked`` must state how the training rows were prepared:
+    True when they went through features.mask_post_hoc (the deployment
+    pipeline, trainer/service.py), False for raw-row experiments.
+    """
     return export_mlp_scorer(
-        state.params, feat_mean=state.feat_mean, feat_std=state.feat_std
+        state.params,
+        feat_mean=state.feat_mean,
+        feat_std=state.feat_std,
+        post_hoc_masked=post_hoc_masked,
     )
 
 
@@ -102,6 +121,7 @@ def _pack(scorer: MLPScorer) -> Dict[str, np.ndarray]:
             "model_type": scorer.model_type,
             "version": scorer.version,
             "n_layers": len(scorer.weights),
+            "post_hoc_masked": scorer.post_hoc_masked,
             "feature_names": list(scorer.feature_names),
         }
     )
@@ -135,6 +155,7 @@ def load_scorer(path_or_bytes) -> MLPScorer:
         weights=weights,
         feat_mean=feat_mean,
         feat_std=feat_std,
+        post_hoc_masked=meta.get("post_hoc_masked", True),
         feature_names=tuple(meta["feature_names"]),
         model_type=meta["model_type"],
         version=meta["version"],

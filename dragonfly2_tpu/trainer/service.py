@@ -1,0 +1,278 @@
+"""Trainer service: dataset ingest boundary + train-on-EOF + model push.
+
+Reference (trainer/service/service_v1.go:59-160): the ``Train`` client
+stream keys per-host dataset files by HostIDV2(ip, hostname), demuxes
+TrainMlpRequest → download data and TrainGnnRequest → networktopology
+data, and on EOF kicks ``training.Train`` in a goroutine, which was a stub
+(training/training.go:82-99).  Here training is real:
+
+1. train the MLP bandwidth regressor on the download rows;
+2. train the GAT ranker on the probe graph + download edges (when the
+   topology dataset is non-empty);
+3. evaluate (MSE/MAE + ranking P/R/F1), export local-scorer artifacts,
+   and CreateModel into the manager registry (the reference's
+   managerclient.CreateModel → manager_server_v1.go:802).
+
+Ingest accepts shard *paths* (co-located zero-copy) or raw bytes (remote
+chunked stream), mirroring trainer/storage's per-host files
+(storage.go:143-151).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..manager.registry import ModelRegistry
+from ..records.columnar import ColumnarReader, concat_readers
+from ..records.features import (
+    DOWNLOAD_COLUMNS,
+    HOST_FEATURE_DIM,
+    TOPO_COLUMNS,
+)
+from ..utils import idgen
+from ..utils.types import TrainingModelType
+from .export import export_from_state, scorer_to_bytes
+from .ingest import EdgeBatches
+from .train import EvalMetrics, TrainConfig, train_mlp
+
+logger = logging.getLogger(__name__)
+
+MLP_MODEL_NAME = "parent-bandwidth-mlp"
+GNN_MODEL_NAME = "parent-ranker-gnn"
+
+
+@dataclass
+class TrainRun:
+    key: str
+    scheduler_id: str
+    download_rows: int = 0
+    topology_rows: int = 0
+    models: List[str] = field(default_factory=list)  # registry model ids
+    metrics: Dict[str, EvalMetrics] = field(default_factory=dict)
+    error: Optional[str] = None
+    done: threading.Event = field(default_factory=threading.Event)
+
+
+class TrainSession:
+    """One open Train stream (per announcing scheduler)."""
+
+    def __init__(self, service: "TrainerService", host_key: str, scheduler_id: str):
+        self._service = service
+        self.host_key = host_key
+        self.scheduler_id = scheduler_id
+        self.download_shards: List[str] = []
+        self.topology_shards: List[str] = []
+
+    def send_download_shard(self, path: str) -> None:
+        self.download_shards.append(
+            self._service._stage_shard(self.host_key, "download", path)
+        )
+
+    def send_network_topology_shard(self, path: str) -> None:
+        self.topology_shards.append(
+            self._service._stage_shard(self.host_key, "networktopology", path)
+        )
+
+    def close_and_train(self, *, synchronous: bool = True) -> str:
+        """EOF: kick training (service_v1.go:153-158 runs it in a goroutine;
+        ``synchronous=False`` matches that)."""
+        return self._service._train(
+            self, synchronous=synchronous
+        )
+
+
+class TrainerService:
+    def __init__(
+        self,
+        registry: Optional[ModelRegistry] = None,
+        *,
+        data_dir: Optional[str] = None,
+        train_config: Optional[TrainConfig] = None,
+        mlp_epochs: int = 30,
+    ) -> None:
+        self.registry = registry or ModelRegistry()
+        self.data_dir = data_dir
+        self.train_config = train_config or TrainConfig(
+            epochs=mlp_epochs, learning_rate=3e-3, warmup_steps=20
+        )
+        self.runs: Dict[str, TrainRun] = {}
+        self._mu = threading.Lock()
+        self._counter = 0
+
+    # -- ingest --------------------------------------------------------------
+
+    def open_train_stream(
+        self, *, ip: str, hostname: str, scheduler_id: str
+    ) -> TrainSession:
+        host_key = idgen.host_id_v2(ip, hostname)[:24]
+        return TrainSession(self, host_key, scheduler_id)
+
+    def _stage_shard(self, host_key: str, kind: str, path: str) -> str:
+        """Co-located: reference the shard in place. With a data_dir:
+        copy into per-host staging (the remote-upload landing zone)."""
+        if self.data_dir is None:
+            return path
+        staged_dir = os.path.join(self.data_dir, host_key)
+        os.makedirs(staged_dir, exist_ok=True)
+        staged = os.path.join(staged_dir, f"{kind}_{os.path.basename(path)}")
+        shutil.copyfile(path, staged)
+        return staged
+
+    def receive_shard_bytes(
+        self, session: TrainSession, kind: str, name: str, data: bytes
+    ) -> None:
+        """Remote path: raw columnar bytes land in the staging dir."""
+        if self.data_dir is None:
+            raise RuntimeError("byte ingest requires a data_dir")
+        staged_dir = os.path.join(self.data_dir, session.host_key)
+        os.makedirs(staged_dir, exist_ok=True)
+        staged = os.path.join(staged_dir, f"{kind}_{name}")
+        with open(staged, "wb") as f:
+            f.write(data)
+        if kind == "download":
+            session.download_shards.append(staged)
+        else:
+            session.topology_shards.append(staged)
+
+    # -- training ------------------------------------------------------------
+
+    def _train(self, session: TrainSession, *, synchronous: bool) -> str:
+        with self._mu:
+            self._counter += 1
+            key = f"train-{session.host_key}-{self._counter}"
+        run = TrainRun(key=key, scheduler_id=session.scheduler_id)
+        self.runs[key] = run
+        if synchronous:
+            self._run_training(run, session)
+        else:
+            threading.Thread(
+                target=self._run_training, args=(run, session), daemon=True
+            ).start()
+        return key
+
+    def _run_training(self, run: TrainRun, session: TrainSession) -> None:
+        try:
+            self._train_mlp(run, session)
+            self._train_gnn(run, session)
+        except Exception as exc:  # noqa: BLE001 — surfaced on the run record
+            logger.exception("training run %s failed", run.key)
+            run.error = str(exc)
+        finally:
+            run.done.set()
+
+    def _train_mlp(self, run: TrainRun, session: TrainSession) -> None:
+        shards = [p for p in session.download_shards if os.path.getsize(p) > 0]
+        if not shards:
+            return
+        rows = concat_readers(shards)
+        run.download_rows = rows.shape[0]
+        if rows.shape[0] < 64:
+            logger.info("run %s: too few download rows (%d)", run.key, rows.shape[0])
+            return
+        # The deployed scorer ranks parents BEFORE any piece moves: train on
+        # serve-time-available features only (features.mask_post_hoc).
+        from ..records.features import DOWNLOAD_FEATURE_DIM, mask_post_hoc
+
+        rows = np.array(rows, copy=True)
+        rows[:, 2 : 2 + DOWNLOAD_FEATURE_DIM] = mask_post_hoc(
+            rows[:, 2 : 2 + DOWNLOAD_FEATURE_DIM]
+        )
+        rng = np.random.default_rng(0)
+        order = rng.permutation(rows.shape[0])
+        n_val = max(int(rows.shape[0] * 0.1), 1)
+        batch = int(min(4096, max(64, 2 ** int(np.log2(max(rows.shape[0] // 8, 64))))))
+        train_rows, val_rows = rows[order[n_val:]], rows[order[:n_val]]
+        train = EdgeBatches(train_rows, batch_size=min(batch, len(train_rows)), seed=0)
+        val = EdgeBatches(
+            val_rows,
+            batch_size=min(batch, len(val_rows)),
+            shuffle=False,
+            drop_remainder=False,
+        )
+        state, metrics, _ = train_mlp(train, val, config=self.train_config)
+        scorer = export_from_state(state)
+        model = self.registry.create_model(
+            name=MLP_MODEL_NAME,
+            type=TrainingModelType.MLP.value,
+            scheduler_id=run.scheduler_id,
+            artifact=scorer_to_bytes(scorer),
+            evaluation=metrics.to_dict(),
+        )
+        run.models.append(model.id)
+        run.metrics[MLP_MODEL_NAME] = metrics
+
+    def _train_gnn(self, run: TrainRun, session: TrainSession) -> None:
+        """GNN over the probe graph; needs both topology and download rows."""
+        topo_shards = [p for p in session.topology_shards if os.path.getsize(p) > 0]
+        dl_shards = [p for p in session.download_shards if os.path.getsize(p) > 0]
+        if not topo_shards or not dl_shards:
+            return
+        topo = concat_readers(topo_shards)
+        run.topology_rows = topo.shape[0]
+        dl = concat_readers(dl_shards)
+        if topo.shape[0] < 8 or dl.shape[0] < 256:
+            return
+
+        from ..models.gnn import GNNConfig, build_neighbor_table
+        from .train import train_gat_ranker
+
+        # Node index = dense renumbering of the hash buckets seen anywhere.
+        buckets = np.unique(
+            np.concatenate(
+                [topo[:, 0], topo[:, 1], dl[:, 0], dl[:, 1]]
+            ).astype(np.int64)
+        )
+        n_nodes = len(buckets)
+
+        def reindex(col: np.ndarray) -> np.ndarray:
+            # buckets is sorted-unique (np.unique) — searchsorted is the
+            # vectorized bucket→dense-index map (the Python-dict version is
+            # interpreter-bound and would dominate north-star-scale ingest).
+            return np.searchsorted(buckets, col.astype(np.int64)).astype(np.int32)
+
+        # Probe graph: src → dst with normalized RTT as the edge feature.
+        p_src, p_dst = reindex(topo[:, 0]), reindex(topo[:, 1])
+        rtt = topo[:, 2].astype(np.float32)
+        table = build_neighbor_table(n_nodes, p_src, p_dst, rtt, max_neighbors=8)
+
+        # Node features averaged from download rows (parent-side features
+        # appear under the src bucket, child-side under dst).
+        node_feats = np.zeros((n_nodes, HOST_FEATURE_DIM), dtype=np.float32)
+        counts = np.zeros(n_nodes, dtype=np.float32)
+        d_src, d_dst = reindex(dl[:, 0]), reindex(dl[:, 1])
+        child_f = dl[:, 2 : 2 + HOST_FEATURE_DIM]
+        parent_f = dl[:, 2 + HOST_FEATURE_DIM : 2 + 2 * HOST_FEATURE_DIM]
+        np.add.at(node_feats, d_src, parent_f)
+        np.add.at(counts, d_src, 1.0)
+        np.add.at(node_feats, d_dst, child_f)
+        np.add.at(counts, d_dst, 1.0)
+        node_feats /= np.maximum(counts[:, None], 1.0)
+
+        target = dl[:, -1].astype(np.float32)
+        cfg = GNNConfig(hidden=64, out_dim=32, num_layers=1, num_heads=2, dropout=0.0)
+        state, metrics, _ = train_gat_ranker(
+            node_feats,
+            table,
+            d_src,
+            d_dst,
+            target,
+            model_config=cfg,
+            config=self.train_config,
+            batch_size=min(2048, max(len(d_src) // 4, 64)),
+        )
+        model = self.registry.create_model(
+            name=GNN_MODEL_NAME,
+            type=TrainingModelType.GNN.value,
+            scheduler_id=run.scheduler_id,
+            artifact=b"",  # GNN artifact export lands with the GNN scorer (next round)
+            evaluation=metrics.to_dict(),
+        )
+        run.models.append(model.id)
+        run.metrics[GNN_MODEL_NAME] = metrics

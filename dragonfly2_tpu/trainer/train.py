@@ -31,7 +31,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.gnn import GATRanker, GNNConfig, GraphSAGE, NeighborTable
 from ..models.mlp import MLPConfig, MLPRegressor
-from ..parallel.mesh import DATA_AXIS, batch_sharding, create_mesh, replicated
+from ..parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    create_mesh,
+    replicated,
+)
 from .ingest import EdgeBatches
 
 
@@ -130,13 +135,30 @@ def train_mlp(
     mesh = mesh or create_mesh()
     model = MLPRegressor(mcfg)
 
+    # Batch dim shards over the data axis — round the batch to a multiple.
+    data_n = mesh.shape[DATA_AXIS]
+    if train_data.batch_size % data_n:
+        rounded = max((train_data.batch_size // data_n) * data_n, data_n)
+        train_data = EdgeBatches(
+            train_data.rows,
+            batch_size=rounded,
+            shuffle=train_data.shuffle,
+            seed=train_data.seed,
+            drop_remainder=train_data.drop_remainder,
+        )
+
     rng = jax.random.PRNGKey(cfg.seed)
     init_rng, dropout_rng = jax.random.split(rng)
     sample = jnp.zeros((2, mcfg.in_dim), jnp.float32)
     params = model.init(init_rng, sample)["params"]
     train_feats = train_data.rows[:, 2 : 2 + mcfg.in_dim]
     feat_mean = jnp.asarray(train_feats.mean(axis=0), jnp.float32)
-    feat_std = jnp.asarray(train_feats.std(axis=0) + 1e-6, jnp.float32)
+    raw_std = train_feats.std(axis=0)
+    # Columns (near-)constant in training carry no signal — scale them by 1,
+    # not by a tiny std that would amplify any serve-time deviation into a
+    # distribution explosion (e.g. a single-content-length training corpus
+    # meeting a different length at scheduling time).
+    feat_std = jnp.asarray(np.where(raw_std < 1e-3, 1.0, raw_std), jnp.float32)
     state = TrainState.create(
         apply_fn=model.apply,
         params=params,
@@ -325,6 +347,9 @@ def _train_graph_model(
     init_rng, dropout_rng = jax.random.split(jrng)
     nf = jnp.asarray(node_feats, jnp.float32)
     b0 = min(batch_size, max(len(train_idx), 2))
+    # The batch dim shards over the data axis — round down to a multiple.
+    data_n = mesh.shape[DATA_AXIS]
+    b0 = max((b0 // data_n) * data_n, data_n)
     sample_args = (
         nf,
         table,

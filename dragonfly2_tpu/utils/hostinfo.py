@@ -187,6 +187,7 @@ class CPUSampler:
                 self._prev = (busy, total)
                 # First sample ever: since-boot average is all we have.
                 stat.percent = 100.0 * busy / total if total else 0.0
+                self._last_percent = stat.percent
         return stat
 
 

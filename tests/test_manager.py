@@ -1,0 +1,153 @@
+"""Manager tests: registry versioning/activation, searcher, dynconfig, cluster."""
+
+import pytest
+
+from dragonfly2_tpu.manager import (
+    ClusterManager,
+    ClusterScopes,
+    Dynconfig,
+    DynconfigServer,
+    ModelRegistry,
+    ModelState,
+    SchedulerCluster,
+    SchedulerInstance,
+    Searcher,
+)
+from dragonfly2_tpu.manager.registry import BlobStore
+
+
+class TestRegistry:
+    def test_versions_increment_per_scheduler(self):
+        reg = ModelRegistry()
+        a1 = reg.create_model(name="m", type="mlp", scheduler_id="s1", artifact=b"1")
+        a2 = reg.create_model(name="m", type="mlp", scheduler_id="s1", artifact=b"2")
+        b1 = reg.create_model(name="m", type="mlp", scheduler_id="s2", artifact=b"3")
+        assert (a1.version, a2.version, b1.version) == (1, 2, 1)
+        assert reg.load_artifact(a2) == b"2"
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(ValueError):
+            ModelRegistry().create_model(
+                name="m", type="transformer", scheduler_id="s", artifact=b""
+            )
+
+    def test_activation_is_exclusive_per_name(self):
+        reg = ModelRegistry()
+        m1 = reg.create_model(name="m", type="mlp", scheduler_id="s", artifact=b"")
+        m2 = reg.create_model(name="m", type="mlp", scheduler_id="s", artifact=b"")
+        other = reg.create_model(name="other", type="gnn", scheduler_id="s", artifact=b"")
+        reg.activate(m1.id)
+        reg.activate(other.id)
+        reg.activate(m2.id)
+        assert reg.get(m1.id).state is ModelState.INACTIVE
+        assert reg.get(m2.id).state is ModelState.ACTIVE
+        assert reg.get(other.id).state is ModelState.ACTIVE  # different name untouched
+        assert reg.active_model("s", "m").id == m2.id
+
+    def test_blob_store_disk_roundtrip(self, tmp_path):
+        bs = BlobStore(str(tmp_path))
+        bs.put("k.npz", b"\x00\x01")
+        assert bs.get("k.npz") == b"\x00\x01"
+        assert bs.exists("k.npz")
+        assert not bs.exists("missing")
+
+
+class TestSearcher:
+    def _clusters(self):
+        return [
+            SchedulerCluster(
+                id="c-idc",
+                scopes=ClusterScopes(idc="idc-a|idc-b"),
+                scheduler_ids=["s1"],
+            ),
+            SchedulerCluster(
+                id="c-cidr",
+                scopes=ClusterScopes(cidrs=("10.1.0.0/16",)),
+                scheduler_ids=["s2"],
+            ),
+            SchedulerCluster(id="c-default", is_default=True, scheduler_ids=["s3"]),
+            SchedulerCluster(id="c-empty", scheduler_ids=[]),  # no live schedulers
+        ]
+
+    def test_cidr_wins_for_matching_ip(self):
+        s = Searcher()
+        ranked = s.find_scheduler_clusters(self._clusters(), ip="10.1.2.3")
+        assert ranked[0].id == "c-cidr"
+
+    def test_idc_condition_ranks_idc_cluster(self):
+        s = Searcher()
+        ranked = s.find_scheduler_clusters(
+            self._clusters(), ip="192.168.0.1", conditions={"idc": "idc-b"}
+        )
+        assert ranked[0].id == "c-idc"
+
+    def test_empty_clusters_filtered_and_default_last_resort(self):
+        s = Searcher()
+        ranked = s.find_scheduler_clusters(self._clusters(), ip="192.168.0.1")
+        assert "c-empty" not in [c.id for c in ranked]
+        assert ranked[0].id == "c-default"
+
+    def test_no_live_clusters_raises(self):
+        with pytest.raises(LookupError):
+            Searcher().find_scheduler_clusters(
+                [SchedulerCluster(id="x", scheduler_ids=[])]
+            )
+
+    def test_hostname_regex(self):
+        s = Searcher()
+        c = SchedulerCluster(
+            id="c",
+            scopes=ClusterScopes(hostnames=(r"^edge-\d+$",)),
+            scheduler_ids=["s"],
+        )
+        assert s.evaluate(c, hostname="edge-42") > s.evaluate(c, hostname="core-1")
+
+
+class TestDynconfig:
+    def test_observer_notified_on_change(self, tmp_path):
+        server = DynconfigServer()
+        server.set("scheduler-1", {"filter_parent_limit": 15})
+        seen = []
+        dc = Dynconfig(
+            lambda: server.get("scheduler-1")[0],
+            cache_path=str(tmp_path / "cache.json"),
+        )
+        dc.register(seen.append)
+        assert dc.refresh() is True
+        server.update("scheduler-1", filter_parent_limit=30)
+        assert dc.refresh() is True
+        assert dc.refresh() is False  # unchanged
+        assert seen[-1]["filter_parent_limit"] == 30
+
+    def test_disk_fallback_on_manager_outage(self, tmp_path):
+        cache = str(tmp_path / "cache.json")
+        server = DynconfigServer()
+        server.set("s", {"x": 1})
+        dc = Dynconfig(lambda: server.get("s")[0], cache_path=cache)
+        dc.refresh()
+
+        def down():
+            raise ConnectionError("manager unreachable")
+
+        dc2 = Dynconfig(down, cache_path=cache)
+        assert dc2.get() == {"x": 1}  # served from disk cache
+
+    def test_no_cache_no_manager_raises(self):
+        def down():
+            raise ConnectionError()
+
+        with pytest.raises(RuntimeError):
+            Dynconfig(down).get()
+
+
+class TestClusterManager:
+    def test_keepalive_expiry(self):
+        cm = ClusterManager(keepalive_ttl=0.0)
+        cm.register_scheduler(SchedulerInstance(id="s1", cluster_id="c"))
+        import time
+
+        time.sleep(0.01)
+        assert cm.active_schedulers() == []
+        cm.keepalive("s1")
+        cm.ttl = 60.0
+        assert [s.id for s in cm.active_schedulers()] == ["s1"]
