@@ -61,6 +61,7 @@ class Dynconfig:
         self._mu = threading.RLock()
         self._data: Optional[Dict[str, Any]] = None
         self._fetched_at = 0.0
+        self._notified = False  # observers have seen SOME config
         self._observers: List[Callable[[Dict[str, Any]], None]] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -103,21 +104,35 @@ class Dynconfig:
 
     def refresh(self) -> bool:
         """One fetch; on failure fall back to memory then disk cache.
-        Returns True if new data was obtained and observers notified."""
+        Returns True if new data was obtained and observers notified.
+
+        Observers are guaranteed to see config at least once even when the
+        first data comes from the disk cache during a manager outage, and
+        even when post-recovery data equals the cached copy.
+        """
         try:
             data = self._fetch()
         except Exception:  # noqa: BLE001 — manager outage must not kill clients
+            observers: List[Callable[[Dict[str, Any]], None]] = []
             with self._mu:
                 if self._data is None:
                     disk = self._load_disk_cache()
                     if disk is not None:
                         self._data = disk
+                        if not self._notified:
+                            observers = list(self._observers)
+                            self._notified = bool(observers)
+                fallback = self._data
+            for obs in observers:
+                obs(dict(fallback))
             return False
         with self._mu:
-            changed = data != self._data
+            changed = data != self._data or not self._notified
             self._data = data
             self._fetched_at = time.time()
             observers = list(self._observers) if changed else []
+            if observers:
+                self._notified = True
         self._store_disk_cache(data)
         for obs in observers:
             obs(dict(data))

@@ -25,9 +25,6 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from ..utils import idgen
-
-
 class ModelState(str, enum.Enum):
     ACTIVE = "active"
     INACTIVE = "inactive"
@@ -118,11 +115,13 @@ class ModelRegistry:
                 )
                 + 1
             )
-            model_id = idgen.model_id(ip or scheduler_id, hostname or scheduler_id, name)
-            # Hash the full scheduler id — a prefix truncation would let two
-            # schedulers with a shared id prefix overwrite each other's blobs.
+            # Model identity is keyed by (scheduler_id, name): hashing only
+            # ip/hostname would let two schedulers on one machine overwrite
+            # each other's registry rows.  Full-id hash (no prefix
+            # truncation) for the blob key too.
             from ..utils.digest import sha256_from_strings
 
+            model_id = sha256_from_strings(scheduler_id, name)[:32]
             sched_key = sha256_from_strings(scheduler_id)[:24]
             blob_key = f"{name}-{sched_key}-v{version}.npz"
             self.blobs.put(blob_key, artifact)

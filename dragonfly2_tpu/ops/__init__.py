@@ -1,0 +1,26 @@
+"""Graph aggregation ops — the GNN's hot path, XLA + pallas.
+
+The reference has no tensor ops (its "aggregation" is Go loops over Redis
+lists, scheduler/networktopology/probes.go).  Here neighbor aggregation is
+the FLOPs-heavy core of the trainer, with three implementations:
+
+- ``aggregate``      — XLA reference ops: padded-table masked mean (one
+  gather + reduce) and sorted-edge segment ops.  Always available; the
+  numerics oracle for the kernel tests.
+- ``pallas_segment`` — TPU pallas kernel computing edge→node segment-sum
+  as a sequence of one-hot MXU matmuls over bucketed edge blocks (the
+  TPU-native way to scatter-accumulate: the MXU does the reduction,
+  no serialized scatter).
+- ``parallel.graph_sharding`` (sibling package) — shard_map-partitioned
+  aggregation for graphs larger than one chip.
+"""
+
+from .aggregate import (  # noqa: F401
+    masked_mean_aggregate,
+    segment_mean,
+    segment_sum,
+)
+from .pallas_segment import (  # noqa: F401
+    bucket_edges_by_block,
+    segment_sum_pallas,
+)

@@ -1,0 +1,36 @@
+"""XLA reference implementations of the aggregation ops.
+
+These are the semantics the pallas kernels must match and the fallback
+when pallas is unavailable (CPU tests, non-TPU backends).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_mean_aggregate(
+    h: jax.Array, indices: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Padded-table neighbor mean: [N, D], [N, K], [N, K] → [N, D].
+
+    The models' SAGELayer inlines this; exposed here as the canonical op.
+    """
+    nbr = jnp.take(h, indices, axis=0)                 # [N, K, D]
+    m = mask[..., None].astype(h.dtype)                # [N, K, 1]
+    denom = jnp.maximum(m.sum(axis=1), 1.0)
+    return (nbr * m).sum(axis=1) / denom
+
+
+def segment_sum(values: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    """Edge→node scatter-add: [E, D], [E] → [num_segments, D]."""
+    return jax.ops.segment_sum(values, segment_ids, num_segments=num_segments)
+
+
+def segment_mean(values: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    total = segment_sum(values, segment_ids, num_segments)
+    counts = jax.ops.segment_sum(
+        jnp.ones((values.shape[0],), values.dtype), segment_ids, num_segments=num_segments
+    )
+    return total / jnp.maximum(counts[:, None], 1.0)

@@ -1,0 +1,171 @@
+"""Pallas TPU kernel: segment-sum as one-hot MXU matmuls.
+
+Edge→node scatter-add is the op XLA lowers worst on TPU (scatter
+serializes; sort+segmented-scan burns VPU cycles).  The TPU-native trick:
+a block of E edges writing into a block of N nodes is exactly
+
+    out[NB, D] += onehot[NB, EB] @ values[EB, D]
+
+— a matmul the MXU eats.  The kernel tiles the edge stream into blocks
+pre-bucketed by destination node block (host prep pads each node block's
+edge run), prefetches the per-block output index + first-visit flag as
+scalars, and accumulates in VMEM across sequential grid steps that revisit
+the same output block.
+
+Status (measured on v5e-1, 1M edges × 128 feats): correctness matches the
+XLA oracle to 4e-6, but XLA's sort-based segment_sum lowering is currently
+~10× faster — the one-hot formulation spends node_block× redundant FLOPs
+per edge and the f32-HIGHEST 128×128 tiles underfeed the MXU.  XLA remains
+the default (ops/aggregate); this kernel is the scaffold for the bf16 /
+larger-tile / double-buffered variant.
+
+Correctness oracle: ops/aggregate.segment_sum.  CPU tests run the same
+kernel in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def bucket_edges_by_block(
+    segment_ids: np.ndarray,
+    num_segments: int,
+    *,
+    node_block: int = 128,
+    edge_block: int = 128,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Host prep: bucket the edge stream by destination node block.
+
+    Returns (perm, dst_local, weight, block_node, is_first):
+    - perm      [E_pad] — edge index into the original stream (0 for pads)
+    - dst_local [E_pad] — destination offset within its node block
+    - weight    [E_pad] — 1.0 real edge / 0.0 padding
+    - block_node[n_edge_blocks] — node-block index each edge block writes
+    - is_first  [n_edge_blocks] — 1 on the first edge block of a node block
+    """
+    segment_ids = np.asarray(segment_ids)
+    order = np.argsort(segment_ids, kind="stable")
+    n_node_blocks = (num_segments + node_block - 1) // node_block
+    sorted_ids = segment_ids[order]
+    # Edge run boundaries per node block.
+    bounds = np.searchsorted(
+        sorted_ids, np.arange(n_node_blocks + 1) * node_block
+    )
+    perm_parts, dstl_parts, w_parts = [], [], []
+    block_node, is_first = [], []
+    for j in range(n_node_blocks):
+        lo, hi = bounds[j], bounds[j + 1]
+        run = order[lo:hi]
+        n = len(run)
+        # A node block with no edges still needs one all-padding block so
+        # its (is_first) visit zero-initializes the output tile.
+        n_pad = max(((n + edge_block - 1) // edge_block) * edge_block, edge_block)
+        pad = n_pad - n
+        perm_parts.append(np.concatenate([run, np.zeros(pad, dtype=run.dtype)]))
+        dstl = segment_ids[run] - j * node_block
+        dstl_parts.append(
+            np.concatenate([dstl, np.zeros(pad, dtype=dstl.dtype)])
+        )
+        w_parts.append(
+            np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        )
+        n_blocks_j = n_pad // edge_block
+        block_node.extend([j] * n_blocks_j)
+        is_first.extend([1] + [0] * (n_blocks_j - 1))
+    return (
+        np.concatenate(perm_parts).astype(np.int32),
+        np.concatenate(dstl_parts).astype(np.int32),
+        np.concatenate(w_parts),
+        np.asarray(block_node, np.int32),
+        np.asarray(is_first, np.int32),
+    )
+
+
+def _segment_kernel(
+    block_node_ref,  # scalar prefetch [n_edge_blocks]
+    is_first_ref,    # scalar prefetch [n_edge_blocks]
+    vals_ref,        # [EB, D]
+    dstl_ref,        # [EB, 1] int32
+    w_ref,           # [EB, 1] f32
+    out_ref,         # [NB, D] f32 — revisited across blocks of one node block
+    *,
+    node_block: int,
+    edge_block: int,
+):
+    i = pl.program_id(0)
+
+    @pl.when(is_first_ref[i] == 1)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    dstl = dstl_ref[:].reshape(1, edge_block)            # [1, EB]
+    w = w_ref[:].reshape(1, edge_block)                  # [1, EB]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (node_block, edge_block), 0)
+    onehot = jnp.where(rows == dstl, w, 0.0)             # [NB, EB]
+    # HIGHEST keeps the f32 accumulate exact (the TPU default matmul
+    # precision is bf16, which injects ~1e-2 error into the segment sums).
+    out_ref[:] += jnp.dot(
+        onehot,
+        vals_ref[:].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+def segment_sum_pallas(
+    values: jax.Array,
+    segment_ids: np.ndarray,
+    num_segments: int,
+    *,
+    node_block: int = 128,
+    edge_block: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Segment-sum [E, D] by dst id → [num_segments, D] on the MXU.
+
+    ``segment_ids`` is host-side (numpy): bucketing runs once per graph
+    snapshot and is reused across training steps (the graph changes far
+    slower than the weights).  ``values`` may be traced.
+    """
+    perm, dstl, w, block_node, is_first = bucket_edges_by_block(
+        segment_ids, num_segments, node_block=node_block, edge_block=edge_block
+    )
+    d = values.shape[-1]
+    n_node_blocks = (num_segments + node_block - 1) // node_block
+    n_edge_blocks = len(block_node)
+
+    vals = jnp.take(values, jnp.asarray(perm), axis=0)   # [E_pad, D]
+    dstl_d = jnp.asarray(dstl).reshape(-1, 1)
+    w_d = jnp.asarray(w).reshape(-1, 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_edge_blocks,),
+        in_specs=[
+            pl.BlockSpec((edge_block, d), lambda i, bn, fi: (i, 0)),
+            pl.BlockSpec((edge_block, 1), lambda i, bn, fi: (i, 0)),
+            pl.BlockSpec((edge_block, 1), lambda i, bn, fi: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((node_block, d), lambda i, bn, fi: (bn[i], 0)),
+    )
+    kernel = functools.partial(
+        _segment_kernel, node_block=node_block, edge_block=edge_block
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (n_node_blocks * node_block, d), jnp.float32
+        ),
+        interpret=interpret,
+    )(jnp.asarray(block_node), jnp.asarray(is_first), vals, dstl_d, w_d)
+    return out[:num_segments]

@@ -43,8 +43,10 @@ class Announcer:
         self.ip = ip
         self.hostname = hostname
         self.train_interval = train_interval
+        self.keepalive_interval = 20.0  # < ClusterManager TTL (60 s)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._keepalive_thread: Optional[threading.Thread] = None
 
     def announce_to_manager(self) -> None:
         """Register + keepalive (announcer.go:84-127)."""
@@ -84,7 +86,7 @@ class Announcer:
             return
         self.announce_to_manager()
 
-        def loop() -> None:
+        def train_loop() -> None:
             while not self._stop.wait(self.train_interval):
                 try:
                     self.announce_to_trainer()
@@ -93,8 +95,19 @@ class Announcer:
 
                     logging.getLogger(__name__).exception("announce_to_trainer failed")
 
-        self._thread = threading.Thread(target=loop, name="announcer", daemon=True)
+        def keepalive_loop() -> None:
+            # The manager marks schedulers inactive past its keepalive TTL
+            # (manager/cluster.py); tick well inside it (announcer.go:119-127).
+            while not self._stop.wait(self.keepalive_interval):
+                self.keepalive()
+
+        self._thread = threading.Thread(target=train_loop, name="announcer", daemon=True)
         self._thread.start()
+        if self.cluster_manager is not None:
+            self._keepalive_thread = threading.Thread(
+                target=keepalive_loop, name="announcer-keepalive", daemon=True
+            )
+            self._keepalive_thread.start()
 
     def stop(self) -> None:
         self._stop.set()

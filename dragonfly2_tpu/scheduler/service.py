@@ -85,9 +85,9 @@ class SchedulerService:
             tag=tag,
             application=application,
         )
+        # Resource.store_peer inserts into the task DAG and host peer map
+        # for newly created peers — single insertion point.
         peer = self.resource.store_peer(peer)
-        task.store_peer(peer)
-        host.store_peer(peer)
 
         if task.fsm.can("Download"):
             task.fsm.event("Download")

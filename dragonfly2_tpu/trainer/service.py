@@ -196,7 +196,13 @@ class TrainerService:
             shuffle=False,
             drop_remainder=False,
         )
-        state, metrics, _ = train_mlp(train, val, config=self.train_config)
+        try:
+            state, metrics, _ = train_mlp(train, val, config=self.train_config)
+        except ValueError as exc:
+            # Corpus too small for the mesh (no full batches) — skip this
+            # model rather than registering untrained weights.
+            logger.warning("run %s: MLP skipped: %s", run.key, exc)
+            return
         scorer = export_from_state(state)
         model = self.registry.create_model(
             name=MLP_MODEL_NAME,
@@ -257,16 +263,20 @@ class TrainerService:
 
         target = dl[:, -1].astype(np.float32)
         cfg = GNNConfig(hidden=64, out_dim=32, num_layers=1, num_heads=2, dropout=0.0)
-        state, metrics, _ = train_gat_ranker(
-            node_feats,
-            table,
-            d_src,
-            d_dst,
-            target,
-            model_config=cfg,
-            config=self.train_config,
-            batch_size=min(2048, max(len(d_src) // 4, 64)),
-        )
+        try:
+            state, metrics, _ = train_gat_ranker(
+                node_feats,
+                table,
+                d_src,
+                d_dst,
+                target,
+                model_config=cfg,
+                config=self.train_config,
+                batch_size=min(2048, max(len(d_src) // 4, 64)),
+            )
+        except ValueError as exc:
+            logger.warning("run %s: GNN skipped: %s", run.key, exc)
+            return
         model = self.registry.create_model(
             name=GNN_MODEL_NAME,
             type=TrainingModelType.GNN.value,

@@ -146,6 +146,13 @@ def train_mlp(
             seed=train_data.seed,
             drop_remainder=train_data.drop_remainder,
         )
+    if len(train_data) == 0:
+        # Silently running zero steps would export an untrained (random)
+        # model — fail loudly instead.
+        raise ValueError(
+            f"no full batches: {train_data.rows.shape[0]} rows < batch "
+            f"{train_data.batch_size} (data axis {data_n})"
+        )
 
     rng = jax.random.PRNGKey(cfg.seed)
     init_rng, dropout_rng = jax.random.split(rng)
@@ -350,6 +357,11 @@ def _train_graph_model(
     # The batch dim shards over the data axis — round down to a multiple.
     data_n = mesh.shape[DATA_AXIS]
     b0 = max((b0 // data_n) * data_n, data_n)
+    if len(train_idx) < b0:
+        raise ValueError(
+            f"no full batches: {len(train_idx)} train edges < batch {b0} "
+            f"(data axis {data_n})"
+        )
     sample_args = (
         nf,
         table,
