@@ -1,0 +1,207 @@
+"""Download conductor: the per-task engine turning a schedule into bytes.
+
+Reference: client/daemon/peer/peertask_conductor.go — register with the
+scheduler (:255-368), consume parent lists, run piece workers
+(:1009-1077), report per-piece results, fall back to source when P2P
+fails (:493-531); plus piece_manager.go's digest-verified piece writes.
+
+Transport-neutral: a ``PieceFetcher`` abstracts "read piece N of task T
+from parent P" (in-process: the parent daemon's UploadManager; over the
+wire: HTTP range GET to the parent's upload port).  The conductor drives
+the REAL scheduler service — the same filter/rank/DAG path production
+uses — so daemon-level tests exercise the whole control loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Protocol
+
+from ..scheduler.resource import Host, Peer
+from ..scheduler.service import RegisterResult, SchedulerService
+from ..scheduler.scheduling import ScheduleResultKind
+from .storage import DaemonStorage
+from .traffic_shaper import TrafficShaper
+
+
+class PieceFetcher(Protocol):
+    def fetch(self, parent_host_id: str, task_id: str, number: int) -> bytes:
+        """Fetch one piece from a parent; raises on failure."""
+        ...
+
+
+class SourceFetcher(Protocol):
+    def fetch(self, url: str, number: int, piece_size: int) -> bytes:
+        """Back-to-source: fetch piece N of the origin content."""
+        ...
+
+
+@dataclass
+class DownloadResult:
+    ok: bool
+    task_id: str
+    peer_id: str
+    pieces: int = 0
+    bytes: int = 0
+    back_to_source: bool = False
+    failed_pieces: int = 0
+    cost_s: float = 0.0
+
+
+class Conductor:
+    def __init__(
+        self,
+        host: Host,
+        storage: DaemonStorage,
+        scheduler: SchedulerService,
+        piece_fetcher: PieceFetcher,
+        source_fetcher: Optional[SourceFetcher] = None,
+        *,
+        traffic_shaper: Optional[TrafficShaper] = None,
+        max_piece_retries: int = 2,
+    ) -> None:
+        self.host = host
+        self.storage = storage
+        self.scheduler = scheduler
+        self.piece_fetcher = piece_fetcher
+        self.source_fetcher = source_fetcher
+        self.traffic_shaper = traffic_shaper
+        self.max_piece_retries = max_piece_retries
+
+    # -- the main flow (peertask_conductor.go:370 start → pullPieces) --------
+
+    def download(
+        self,
+        url: str,
+        *,
+        piece_size: int = 4 << 20,
+        content_length: Optional[int] = None,
+        expected_pieces: Optional[int] = None,
+    ) -> DownloadResult:
+        t0 = time.monotonic()
+        reg = self.scheduler.register_peer(host=self.host, url=url)
+        peer = reg.peer
+        task = peer.task
+
+        # First peer in the swarm learns content length from the origin.
+        if task.content_length < 0:
+            if content_length is None:
+                return self._fail(peer, t0, "unknown content length")
+            task.content_length = content_length
+            task.total_piece_count = (
+                expected_pieces
+                if expected_pieces is not None
+                else (content_length + piece_size - 1) // piece_size
+            )
+            task.piece_size = piece_size
+        piece_size = task.piece_size or piece_size
+        n_pieces = task.total_piece_count
+
+        self.storage.register_task(
+            task.id, piece_size=piece_size, content_length=task.content_length
+        )
+        if self.traffic_shaper is not None:
+            self.traffic_shaper.add_task(task.id)
+        try:
+            if reg.schedule is not None and reg.schedule.kind is ScheduleResultKind.PARENTS:
+                result = self._pull_from_parents(peer, reg.schedule.parents, n_pieces, t0)
+                if result is not None:
+                    return result
+                # P2P path exhausted → back-to-source (dfget.go:141 fallback).
+            return self._pull_from_source(peer, n_pieces, piece_size, t0)
+        finally:
+            if self.traffic_shaper is not None:
+                self.traffic_shaper.remove_task(task.id)
+
+    def _pull_from_parents(
+        self, peer: Peer, parents: List[Peer], n_pieces: int, t0: float
+    ) -> Optional[DownloadResult]:
+        """Piece workers over the assigned parents; None → fall to source."""
+        task = peer.task
+        failed = 0
+        nbytes = 0
+        parents = list(parents)
+        for number in range(n_pieces):
+            if not parents:
+                return None
+            done = False
+            for attempt in range(self.max_piece_retries + 1):
+                parent = parents[(number + attempt) % len(parents)]
+                try:
+                    t_piece = time.monotonic()
+                    data = self.piece_fetcher.fetch(parent.host.id, task.id, number)
+                    cost_ns = max(int((time.monotonic() - t_piece) * 1e9), 1)
+                except Exception:
+                    failed += 1
+                    res = self.scheduler.report_piece_failed(peer, parent.id)
+                    if res.kind is ScheduleResultKind.PARENTS and res.parents:
+                        parents = list(res.parents)
+                    elif res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
+                        return None
+                    continue
+                self.storage.write_piece(task.id, number, data)
+                nbytes += len(data)
+                if self.traffic_shaper is not None:
+                    self.traffic_shaper.record(task.id, len(data))
+                self.scheduler.report_piece_finished(
+                    peer, number, parent_id=parent.id, length=len(data), cost_ns=cost_ns
+                )
+                done = True
+                break
+            if not done:
+                return None
+        self.scheduler.report_peer_finished(peer)
+        return DownloadResult(
+            ok=True,
+            task_id=task.id,
+            peer_id=peer.id,
+            pieces=n_pieces,
+            bytes=nbytes,
+            failed_pieces=failed,
+            cost_s=time.monotonic() - t0,
+        )
+
+    def _pull_from_source(
+        self, peer: Peer, n_pieces: int, piece_size: int, t0: float
+    ) -> DownloadResult:
+        task = peer.task
+        if self.source_fetcher is None:
+            return self._fail(peer, t0, "no source fetcher")
+        if peer.fsm.can("DownloadBackToSource"):
+            peer.fsm.event("DownloadBackToSource")
+        task.back_to_source_peers.add(peer.id)
+        nbytes = 0
+        for number in range(n_pieces):
+            t_piece = time.monotonic()
+            try:
+                data = self.source_fetcher.fetch(task.url, number, piece_size)
+            except Exception:
+                return self._fail(peer, t0, f"source fetch piece {number}")
+            cost_ns = max(int((time.monotonic() - t_piece) * 1e9), 1)
+            self.storage.write_piece(task.id, number, data)
+            nbytes += len(data)
+            self.scheduler.report_piece_finished(
+                peer, number, parent_id="", length=len(data), cost_ns=cost_ns
+            )
+        self.scheduler.report_peer_finished(peer)
+        return DownloadResult(
+            ok=True,
+            task_id=task.id,
+            peer_id=peer.id,
+            pieces=n_pieces,
+            bytes=nbytes,
+            back_to_source=True,
+            cost_s=time.monotonic() - t0,
+        )
+
+    def _fail(self, peer: Peer, t0: float, reason: str) -> DownloadResult:
+        self.scheduler.report_peer_failed(peer)
+        return DownloadResult(
+            ok=False,
+            task_id=peer.task.id,
+            peer_id=peer.id,
+            cost_s=time.monotonic() - t0,
+        )

@@ -1,0 +1,116 @@
+"""Daemon composition root (reference: client/daemon/daemon.go:118-417).
+
+Wires storage, upload, conductor, pex, and the probe agent around one
+Host identity.  ``InProcessFetcher`` is the piece transport seam: it
+resolves a parent host id to that daemon's UploadManager — the in-process
+stand-in for the HTTP piece data plane, with identical semantics
+(concurrency caps, crc-verified reads).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..scheduler.networktopology import ProbeAgent
+from ..scheduler.resource import Host
+from ..scheduler.service import SchedulerService
+from .conductor import Conductor, DownloadResult
+from .pex import GossipBus, MemberMeta, PeerExchange
+from .storage import DaemonStorage
+from .traffic_shaper import TrafficShaper
+from .upload import UploadManager
+
+
+class InProcessFetcher:
+    """Piece transport: parent host id → its daemon's upload manager."""
+
+    def __init__(self, registry: Dict[str, "Daemon"]):
+        self._registry = registry
+
+    def fetch(self, parent_host_id: str, task_id: str, number: int) -> bytes:
+        daemon = self._registry.get(parent_host_id)
+        if daemon is None:
+            raise KeyError(f"no daemon for host {parent_host_id}")
+        return daemon.upload.serve_piece(task_id, number)
+
+
+class Daemon:
+    def __init__(
+        self,
+        host: Host,
+        scheduler: SchedulerService,
+        *,
+        storage_root: str,
+        daemon_registry: Optional[Dict[str, "Daemon"]] = None,
+        gossip_bus: Optional[GossipBus] = None,
+        source_fetcher=None,
+        quota_bytes: int = 10 << 30,
+        total_rate: float = 1e9,
+        prefer_native: bool = True,
+    ) -> None:
+        self.host = host
+        self.scheduler = scheduler
+        self.storage = DaemonStorage(
+            storage_root, quota_bytes=quota_bytes, prefer_native=prefer_native
+        )
+        self.upload = UploadManager(
+            self.storage, concurrent_limit=host.concurrent_upload_limit
+        )
+        self.traffic_shaper = TrafficShaper(total_rate)
+        self._registry = daemon_registry if daemon_registry is not None else {}
+        self._registry[host.id] = self
+        self.conductor = Conductor(
+            host,
+            self.storage,
+            scheduler,
+            piece_fetcher=InProcessFetcher(self._registry),
+            source_fetcher=source_fetcher,
+            traffic_shaper=self.traffic_shaper,
+        )
+        self.pex: Optional[PeerExchange] = None
+        if gossip_bus is not None:
+            self.pex = PeerExchange(
+                MemberMeta(host_id=host.id, ip=host.ip, port=host.download_port),
+                gossip_bus,
+            )
+            self.pex.serve()
+        self.probe_agent: Optional[ProbeAgent] = None
+
+    def enable_probes(self, ping) -> None:
+        """Attach the probe agent (client/daemon/networktopology)."""
+        if self.scheduler.networktopology is not None:
+            self.probe_agent = ProbeAgent(
+                self.host, self.scheduler.networktopology, ping
+            )
+
+    def probe_round(self) -> int:
+        return self.probe_agent.sync_probes() if self.probe_agent else 0
+
+    def download(self, url: str, **kwargs) -> DownloadResult:
+        result = self.conductor.download(url, **kwargs)
+        if result.ok and self.pex is not None:
+            self.pex.advertise(result.task_id, set(range(result.pieces)))
+        return result
+
+    def reload(self) -> int:
+        """Crash-restart recovery: reopen on-disk tasks and re-advertise."""
+        loaded = self.storage.reload_persistent_tasks(self.storage.scan_disk_tasks())
+        if self.pex is not None:
+            for task_id in loaded:
+                # True piece-count bound from the task header, not a guess —
+                # a daemon holding only the tail pieces must still advertise.
+                cl = self.storage.engine.content_length(task_id)
+                ps = self.storage.engine.piece_size(task_id)
+                n_pieces = (cl + ps - 1) // ps if cl > 0 and ps > 0 else 0
+                if n_pieces <= 0:
+                    continue
+                bm = self.storage.piece_bitmap(task_id, n_pieces)
+                self.pex.advertise(task_id, {int(i) for i in bm.nonzero()[0]})
+        return len(loaded)
+
+    def stop(self) -> None:
+        if self.pex is not None:
+            self.pex.stop()
+        self._registry.pop(self.host.id, None)
+        self.storage.close()

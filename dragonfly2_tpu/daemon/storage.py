@@ -1,0 +1,274 @@
+"""Daemon local storage: piece files + quota GC.
+
+Uses the native C++ piece store when buildable (dragonfly2_tpu/native),
+else a pure-Python engine with the same on-disk layout semantics.
+Reference: client/daemon/storage/storage_manager.go (TaskStorageDriver
+:54-135, ReloadPersistentTask :703-760, Reclaimer :82-91).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import native
+
+
+@dataclass
+class PieceInfo:
+    number: int
+    length: int
+    crc32: int
+
+
+class _PyPieceStore:
+    """Pure-Python fallback with the same API as native.NativePieceStore."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._meta: Dict[str, dict] = {}
+        self._mu = threading.Lock()
+
+    def _dir(self, task_id: str) -> str:
+        return os.path.join(self.root, task_id)
+
+    def _load_meta(self, task_id: str) -> Optional[dict]:
+        with self._mu:
+            if task_id in self._meta:
+                return self._meta[task_id]
+        header_path = os.path.join(self._dir(task_id), "header.json")
+        if not os.path.exists(header_path):
+            return None
+        with open(header_path) as f:
+            meta = json.load(f)
+        meta["pieces"] = {}
+        # Piece commits are an append-only journal (one JSON line each) so
+        # per-piece metadata I/O is O(1), matching the native engine; a torn
+        # trailing line (crash mid-append) is skipped.
+        journal = os.path.join(self._dir(task_id), "pieces.jsonl")
+        if os.path.exists(journal):
+            with open(journal) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    meta["pieces"][int(rec["n"])] = {
+                        "length": rec["length"],
+                        "crc": rec["crc"],
+                    }
+        with self._mu:
+            self._meta[task_id] = meta
+        return meta
+
+    def _append_journal(self, task_id: str, number: int, info: dict) -> None:
+        journal = os.path.join(self._dir(task_id), "pieces.jsonl")
+        with open(journal, "a") as f:
+            f.write(
+                json.dumps({"n": number, "length": info["length"], "crc": info["crc"]})
+                + "\n"
+            )
+
+    def create_task(self, task_id: str, piece_size: int, content_length: int) -> None:
+        os.makedirs(self._dir(task_id), exist_ok=True)
+        if self._load_meta(task_id) is None:
+            meta = {
+                "piece_size": piece_size,
+                "content_length": content_length,
+                "pieces": {},
+            }
+            with self._mu:
+                self._meta[task_id] = meta
+            header_path = os.path.join(self._dir(task_id), "header.json")
+            tmp = header_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"piece_size": piece_size, "content_length": content_length}, f)
+            os.replace(tmp, header_path)
+
+    def load_task(self, task_id: str) -> bool:
+        return self._load_meta(task_id) is not None
+
+    def write_piece(self, task_id: str, number: int, data: bytes) -> int:
+        meta = self._load_meta(task_id)
+        if meta is None:
+            raise KeyError(task_id)
+        path = os.path.join(self._dir(task_id), "data")
+        with open(path, "r+b" if os.path.exists(path) else "wb") as f:
+            f.seek(number * meta["piece_size"])
+            f.write(data)
+        info = {"length": len(data), "crc": zlib.crc32(data)}
+        meta["pieces"][number] = info
+        self._append_journal(task_id, number, info)
+        return len(data)
+
+    def piece_size(self, task_id: str) -> int:
+        meta = self._load_meta(task_id)
+        return meta["piece_size"] if meta else -1
+
+    def read_piece(self, task_id: str, number: int, *, max_len: Optional[int] = None, verify: bool = True) -> bytes:
+        meta = self._load_meta(task_id)
+        if meta is None or number not in meta["pieces"]:
+            raise KeyError(f"piece {number} of {task_id}")
+        info = meta["pieces"][number]
+        with open(os.path.join(self._dir(task_id), "data"), "rb") as f:
+            f.seek(number * meta["piece_size"])
+            data = f.read(info["length"])
+        if verify and zlib.crc32(data) != info["crc"]:
+            raise IOError(f"crc mismatch piece {number} of {task_id}")
+        return data
+
+    def piece_count(self, task_id: str) -> int:
+        meta = self._load_meta(task_id)
+        return len(meta["pieces"]) if meta else 0
+
+    def piece_bitmap(self, task_id: str, n_pieces: int) -> np.ndarray:
+        out = np.zeros(n_pieces, dtype=np.uint8)
+        meta = self._load_meta(task_id)
+        if meta:
+            for n in meta["pieces"]:
+                if n < n_pieces:
+                    out[n] = 1
+        return out
+
+    def task_bytes(self, task_id: str) -> int:
+        meta = self._load_meta(task_id)
+        if not meta:
+            return 0
+        return sum(p["length"] for p in meta["pieces"].values())
+
+    def content_length(self, task_id: str) -> int:
+        meta = self._load_meta(task_id)
+        return meta["content_length"] if meta else -1
+
+    def delete_task(self, task_id: str) -> None:
+        import shutil
+
+        with self._mu:
+            self._meta.pop(task_id, None)
+        shutil.rmtree(self._dir(task_id), ignore_errors=True)
+
+    def close(self) -> None:
+        pass
+
+
+class DaemonStorage:
+    """Task-level storage manager with quota GC.
+
+    ``prefer_native=True`` uses the C++ engine when it builds; tests can
+    force the Python engine for hermeticity.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        quota_bytes: int = 10 << 30,
+        prefer_native: bool = True,
+    ) -> None:
+        self.root = root
+        self.quota_bytes = quota_bytes
+        engine = None
+        if prefer_native and native.available():
+            try:
+                engine = native.NativePieceStore(root)
+            except native.NativeError:
+                engine = None
+        self.engine = engine or _PyPieceStore(root)
+        self._mu = threading.Lock()
+        self._tasks: Dict[str, dict] = {}  # task_id → {piece_size, atime}
+
+    @property
+    def is_native(self) -> bool:
+        return not isinstance(self.engine, _PyPieceStore)
+
+    # -- task lifecycle ------------------------------------------------------
+
+    def register_task(self, task_id: str, *, piece_size: int, content_length: int) -> None:
+        self.engine.create_task(task_id, piece_size, content_length)
+        with self._mu:
+            self._tasks[task_id] = {"piece_size": piece_size, "atime": time.time()}
+
+    def reload_persistent_tasks(self, task_ids: List[str]) -> List[str]:
+        """Crash restart: reopen tasks that survived on disk
+        (storage_manager.go:703-760 ReloadPersistentTask)."""
+        loaded = []
+        for tid in task_ids:
+            if self.engine.load_task(tid):
+                with self._mu:
+                    self._tasks[tid] = {
+                        "piece_size": 0,
+                        "atime": time.time(),
+                    }
+                loaded.append(tid)
+        return loaded
+
+    def scan_disk_tasks(self) -> List[str]:
+        """Task dirs present on disk (restart discovery)."""
+        try:
+            return sorted(
+                d
+                for d in os.listdir(self.root)
+                if os.path.isdir(os.path.join(self.root, d))
+            )
+        except FileNotFoundError:
+            return []
+
+    # -- pieces --------------------------------------------------------------
+
+    def write_piece(self, task_id: str, number: int, data: bytes) -> int:
+        with self._mu:
+            if task_id in self._tasks:
+                self._tasks[task_id]["atime"] = time.time()
+        return self.engine.write_piece(task_id, number, data)
+
+    def read_piece(self, task_id: str, number: int, *, verify: bool = True) -> bytes:
+        with self._mu:
+            if task_id in self._tasks:
+                self._tasks[task_id]["atime"] = time.time()
+        return self.engine.read_piece(task_id, number, verify=verify)
+
+    def piece_bitmap(self, task_id: str, n_pieces: int) -> np.ndarray:
+        return self.engine.piece_bitmap(task_id, n_pieces)
+
+    def has_piece(self, task_id: str, number: int) -> bool:
+        bm = self.engine.piece_bitmap(task_id, number + 1)
+        return bool(bm[number])
+
+    def task_bytes(self, task_id: str) -> int:
+        return self.engine.task_bytes(task_id)
+
+    def total_bytes(self) -> int:
+        with self._mu:
+            tids = list(self._tasks)
+        return sum(self.engine.task_bytes(t) for t in tids)
+
+    def delete_task(self, task_id: str) -> None:
+        with self._mu:
+            self._tasks.pop(task_id, None)
+        self.engine.delete_task(task_id)
+
+    # -- quota GC (Reclaimer) ------------------------------------------------
+
+    def reclaim(self) -> List[str]:
+        """Evict least-recently-used tasks until under quota
+        (storage_manager.go Reclaimer :82-91)."""
+        reclaimed: List[str] = []
+        while self.total_bytes() > self.quota_bytes:
+            with self._mu:
+                if not self._tasks:
+                    break
+                victim = min(self._tasks, key=lambda t: self._tasks[t]["atime"])
+            self.delete_task(victim)
+            reclaimed.append(victim)
+        return reclaimed
+
+    def close(self) -> None:
+        self.engine.close()

@@ -1,0 +1,69 @@
+"""Per-task bandwidth allocation (reference: client/daemon/peer/traffic_shaper.go:36-133).
+
+The reference's "sampling" shaper re-divides total bandwidth across active
+tasks each second, proportional to observed need.  Same model: tasks
+register, record consumed bytes, and ``allocate`` computes each task's
+budget for the next window — used bandwidth attracts budget, idle tasks
+shrink to a floor.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+
+class TrafficShaper:
+    def __init__(self, total_rate: float, *, min_share: float = 0.05) -> None:
+        """total_rate: bytes/sec across all tasks."""
+        self.total_rate = total_rate
+        self.min_share = min_share
+        self._mu = threading.Lock()
+        self._used: Dict[str, int] = {}
+        self._budget: Dict[str, float] = {}
+        self._window_start = time.monotonic()
+
+    def add_task(self, task_id: str) -> None:
+        with self._mu:
+            self._used.setdefault(task_id, 0)
+            n = len(self._used)
+            for t in self._used:
+                self._budget[t] = self.total_rate / n
+
+    def remove_task(self, task_id: str) -> None:
+        with self._mu:
+            self._used.pop(task_id, None)
+            self._budget.pop(task_id, None)
+
+    def record(self, task_id: str, nbytes: int) -> None:
+        with self._mu:
+            if task_id in self._used:
+                self._used[task_id] += nbytes
+
+    def budget(self, task_id: str) -> float:
+        with self._mu:
+            return self._budget.get(task_id, 0.0)
+
+    def allocate(self) -> Dict[str, float]:
+        """Close the sampling window: re-divide rate proportional to use."""
+        with self._mu:
+            n = len(self._used)
+            if n == 0:
+                return {}
+            total_used = sum(self._used.values())
+            # Clamp the floor so n·floor never exceeds the total rate — with
+            # many tasks an unclamped floor turns `distributable` negative
+            # and inverts the allocation (busiest task gets least).
+            floor = min(self.total_rate * self.min_share, self.total_rate / n)
+            if total_used == 0:
+                for t in self._used:
+                    self._budget[t] = self.total_rate / n
+            else:
+                distributable = self.total_rate - floor * n
+                for t, used in self._used.items():
+                    self._budget[t] = floor + distributable * (used / total_used)
+            for t in self._used:
+                self._used[t] = 0
+            self._window_start = time.monotonic()
+            return dict(self._budget)

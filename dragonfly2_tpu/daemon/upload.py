@@ -1,0 +1,70 @@
+"""Upload manager: serve local pieces to other peers.
+
+Reference: client/daemon/upload/upload_manager.go:59-76 — an HTTP piece
+server answering range requests from peers.  Transport-neutral core: the
+in-process swarm calls ``serve_piece`` directly; an HTTP binding wraps the
+same method.  Concurrency is capped the way the scheduler models it
+(Host.concurrent_upload_limit).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .storage import DaemonStorage
+
+
+class UploadBusy(RuntimeError):
+    pass
+
+
+class UploadManager:
+    def __init__(self, storage: DaemonStorage, *, concurrent_limit: int = 50) -> None:
+        self.storage = storage
+        self.concurrent_limit = concurrent_limit
+        self._mu = threading.Lock()
+        self._active = 0
+        self.upload_count = 0
+        self.upload_failed_count = 0
+
+    @property
+    def active(self) -> int:
+        with self._mu:
+            return self._active
+
+    def serve_piece(self, task_id: str, number: int) -> bytes:
+        """One piece upload; raises UploadBusy past the concurrency cap,
+        KeyError when the piece isn't local."""
+        with self._mu:
+            if self._active >= self.concurrent_limit:
+                raise UploadBusy(f"{self._active} active uploads")
+            self._active += 1
+        try:
+            data = self.storage.read_piece(task_id, number)
+            with self._mu:
+                self.upload_count += 1
+            return data
+        except Exception:
+            with self._mu:
+                self.upload_failed_count += 1
+            raise
+        finally:
+            with self._mu:
+                self._active -= 1
+
+    def serve_range(self, task_id: str, start: int, length: int, piece_size: int) -> bytes:
+        """Byte-range read assembled from pieces (HTTP Range semantics)."""
+        out = bytearray()
+        pos = start
+        end = start + length
+        while pos < end:
+            num = pos // piece_size
+            piece = self.serve_piece(task_id, num)
+            off = pos - num * piece_size
+            take = min(len(piece) - off, end - pos)
+            if take <= 0:
+                break
+            out += piece[off : off + take]
+            pos += take
+        return bytes(out)

@@ -1,0 +1,252 @@
+"""ctypes bindings for the native (C++) runtime.
+
+``load()`` builds the shared library on first use (g++ via the Makefile —
+pybind11 isn't available in this image, and ctypes keeps the ABI surface
+explicit).  Services treat native as an optimization: ``available()``
+gates it, and the Python implementations (records/columnar.py) remain the
+spec & fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libdragonfly_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    i64, u32, i32 = ctypes.c_int64, ctypes.c_uint32, ctypes.c_int
+    lib.re_open.restype = i64
+    lib.re_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p, u32]
+    lib.re_append.restype = i64
+    lib.re_append.argtypes = [i64, ctypes.POINTER(ctypes.c_float), i64]
+    lib.re_flush.restype = i32
+    lib.re_flush.argtypes = [i64]
+    lib.re_rows.restype = i64
+    lib.re_rows.argtypes = [i64]
+    lib.re_close.restype = i32
+    lib.re_close.argtypes = [i64]
+
+    p8 = ctypes.POINTER(ctypes.c_uint8)
+    lib.ps_open.restype = i64
+    lib.ps_open.argtypes = [ctypes.c_char_p]
+    lib.ps_create_task.restype = i32
+    lib.ps_create_task.argtypes = [i64, ctypes.c_char_p, u32, i64]
+    lib.ps_load_task.restype = i32
+    lib.ps_load_task.argtypes = [i64, ctypes.c_char_p]
+    lib.ps_write_piece.restype = i64
+    lib.ps_write_piece.argtypes = [i64, ctypes.c_char_p, u32, p8, u32]
+    lib.ps_read_piece.restype = i64
+    lib.ps_read_piece.argtypes = [i64, ctypes.c_char_p, u32, p8, u32, i32]
+    lib.ps_piece_count.restype = i64
+    lib.ps_piece_count.argtypes = [i64, ctypes.c_char_p]
+    lib.ps_piece_bitmap.restype = i32
+    lib.ps_piece_bitmap.argtypes = [i64, ctypes.c_char_p, p8, u32]
+    lib.ps_task_bytes.restype = i64
+    lib.ps_task_bytes.argtypes = [i64, ctypes.c_char_p]
+    lib.ps_piece_size.restype = i64
+    lib.ps_piece_size.argtypes = [i64, ctypes.c_char_p]
+    lib.ps_content_length.restype = i64
+    lib.ps_content_length.argtypes = [i64, ctypes.c_char_p]
+    lib.ps_delete_task.restype = i32
+    lib.ps_delete_task.argtypes = [i64, ctypes.c_char_p]
+    lib.ps_close.restype = i32
+    lib.ps_close.argtypes = [i64]
+
+
+def load(rebuild: bool = False) -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the native library; None on failure."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None and not rebuild:
+            return _lib
+        if _build_error is not None and not rebuild:
+            return None
+        if rebuild or not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _DIR, "-s"] + (["clean", "all"] if rebuild else []),
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                    timeout=120,
+                )
+            except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError) as exc:
+                _build_error = getattr(exc, "stderr", None) or str(exc)
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            _declare(lib)
+        except OSError as exc:
+            _build_error = str(exc)
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def build_error() -> Optional[str]:
+    return _build_error
+
+
+# ---------------------------------------------------------------------------
+# Pythonic wrappers
+# ---------------------------------------------------------------------------
+
+
+class NativeError(RuntimeError):
+    pass
+
+
+class NativeColumnarWriter:
+    """Drop-in for records.columnar.ColumnarWriter backed by the C++ engine.
+
+    Same on-disk format — ColumnarReader reads its files unchanged.
+    """
+
+    def __init__(self, path: str, columns, dtype: str = "float32"):
+        if dtype != "float32":
+            raise ValueError("native writer is float32-only")
+        lib = load()
+        if lib is None:
+            raise NativeError(f"native library unavailable: {_build_error}")
+        self._lib = lib
+        self.path = path
+        self.columns = tuple(columns)
+        header = json.dumps(
+            {"columns": list(self.columns), "dtype": "float32", "created_at_ns": 0}
+        ).encode()
+        self._h = lib.re_open(path.encode(), header, len(self.columns))
+        if self._h < 0:
+            raise NativeError(f"re_open({path}) -> {self._h}")
+
+    def append(self, rows: np.ndarray) -> int:
+        rows = np.ascontiguousarray(rows, dtype=np.float32)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if rows.shape[-1] != len(self.columns):
+            raise ValueError(f"row width {rows.shape[-1]} != {len(self.columns)}")
+        n = self._lib.re_append(
+            self._h,
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            rows.shape[0],
+        )
+        if n < 0:
+            raise NativeError(f"re_append -> {n}")
+        return int(n)
+
+    def flush(self) -> None:
+        self._lib.re_flush(self._h)
+
+    def tell_rows(self) -> int:
+        return int(self._lib.re_rows(self._h))
+
+    def close(self) -> None:
+        if self._h >= 0:
+            self._lib.re_close(self._h)
+            self._h = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NativePieceStore:
+    """The daemon's local piece store (C++ engine).
+
+    Mirrors client/daemon/storage semantics: per-task metadata+data files,
+    crc-verified reads, crash reload (re-open sees committed pieces).
+    """
+
+    def __init__(self, root: str):
+        lib = load()
+        if lib is None:
+            raise NativeError(f"native library unavailable: {_build_error}")
+        self._lib = lib
+        self.root = root
+        self._h = lib.ps_open(root.encode())
+        if self._h < 0:
+            raise NativeError(f"ps_open({root}) -> {self._h}")
+
+    def create_task(self, task_id: str, piece_size: int, content_length: int) -> None:
+        rc = self._lib.ps_create_task(self._h, task_id.encode(), piece_size, content_length)
+        if rc != 0:
+            raise NativeError(f"ps_create_task -> {rc}")
+
+    def load_task(self, task_id: str) -> bool:
+        """Open an existing task (crash reload); False if absent."""
+        return self._lib.ps_load_task(self._h, task_id.encode()) == 0
+
+    def write_piece(self, task_id: str, number: int, data: bytes) -> int:
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        n = self._lib.ps_write_piece(self._h, task_id.encode(), number, buf, len(data))
+        if n < 0:
+            raise NativeError(f"ps_write_piece -> {n}")
+        return int(n)
+
+    def piece_size(self, task_id: str) -> int:
+        return int(self._lib.ps_piece_size(self._h, task_id.encode()))
+
+    def read_piece(self, task_id: str, number: int, *, max_len: Optional[int] = None, verify: bool = True) -> bytes:
+        if max_len is None:
+            # A committed piece is never longer than the task's piece size.
+            ps = self.piece_size(task_id)
+            max_len = ps if ps > 0 else 8 << 20
+        buf = (ctypes.c_uint8 * max_len)()
+        n = self._lib.ps_read_piece(
+            self._h, task_id.encode(), number, buf, max_len, 1 if verify else 0
+        )
+        if n == -3:
+            raise KeyError(f"piece {number} of {task_id} not present")
+        if n == -6:
+            raise NativeError(f"piece {number} of {task_id} failed crc verification")
+        if n < 0:
+            raise NativeError(f"ps_read_piece -> {n}")
+        return bytes(buf[: int(n)])
+
+    def piece_count(self, task_id: str) -> int:
+        n = self._lib.ps_piece_count(self._h, task_id.encode())
+        return max(int(n), 0)
+
+    def piece_bitmap(self, task_id: str, n_pieces: int) -> np.ndarray:
+        buf = (ctypes.c_uint8 * n_pieces)()
+        rc = self._lib.ps_piece_bitmap(self._h, task_id.encode(), buf, n_pieces)
+        if rc != 0:
+            raise NativeError(f"ps_piece_bitmap -> {rc}")
+        return np.frombuffer(bytes(buf), dtype=np.uint8)
+
+    def task_bytes(self, task_id: str) -> int:
+        return max(int(self._lib.ps_task_bytes(self._h, task_id.encode())), 0)
+
+    def content_length(self, task_id: str) -> int:
+        return int(self._lib.ps_content_length(self._h, task_id.encode()))
+
+    def delete_task(self, task_id: str) -> None:
+        self._lib.ps_delete_task(self._h, task_id.encode())
+
+    def close(self) -> None:
+        if self._h >= 0:
+            self._lib.ps_close(self._h)
+            self._h = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

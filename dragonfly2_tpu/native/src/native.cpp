@@ -1,0 +1,448 @@
+// dragonfly2_tpu native runtime: columnar record engine + piece store.
+//
+// The reference's data plane is compiled Go (client/daemon/storage/
+// storage_manager.go, local_storage.go: per-task metadata+data files,
+// piece-granular writes, crash reload; scheduler/storage/storage.go:
+// buffered record files).  This is the C++ equivalent for the rebuild:
+//
+//  * record engine — appends fixed-width float32 rows to DFC1 columnar
+//    files (the format spec lives in records/columnar.py); append is a
+//    single buffered write, no serialization.
+//  * piece store  — per-task {meta,data} file pairs. Piece writes land at
+//    piece_number*piece_size offsets; each commit appends a fixed-size
+//    metadata record (number, offset, length, crc32, flags) fsync-ordered
+//    after the data write, so a crash can lose at most the in-flight
+//    piece; reload scans metadata and re-validates lengths.
+//
+// Exposed as a C ABI for the ctypes bindings in ../__init__.py.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// crc32 (IEEE) — table-driven, no external deps.
+// ---------------------------------------------------------------------------
+
+uint32_t crc32_table[256];
+std::once_flag crc_once;
+
+void crc32_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc32_table[i] = c;
+  }
+}
+
+uint32_t crc32(const uint8_t* data, size_t len) {
+  std::call_once(crc_once, crc32_init);
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) c = crc32_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// Columnar record engine (DFC1; spec: records/columnar.py)
+// ---------------------------------------------------------------------------
+
+constexpr char kMagic[4] = {'D', 'F', 'C', '1'};
+
+struct RecordFile {
+  FILE* f = nullptr;
+  uint32_t width = 0;       // columns
+  int64_t data_offset = 0;
+  std::mutex mu;
+};
+
+std::mutex g_records_mu;
+std::map<int64_t, RecordFile*> g_records;
+std::atomic<int64_t> g_next_handle{1};
+
+// ---------------------------------------------------------------------------
+// Piece store
+// ---------------------------------------------------------------------------
+
+#pragma pack(push, 1)
+struct PieceMeta {
+  uint32_t number;
+  uint32_t length;
+  int64_t offset;
+  uint32_t crc;
+  uint32_t flags;  // 1 = committed
+};
+
+struct TaskHeader {
+  char magic[4];          // "DFPS"
+  uint32_t piece_size;
+  int64_t content_length;
+};
+#pragma pack(pop)
+
+struct TaskStore {
+  std::string dir;
+  FILE* data = nullptr;
+  FILE* meta = nullptr;
+  TaskHeader header{};
+  std::map<uint32_t, PieceMeta> pieces;
+  std::mutex mu;
+  bool closed = false;  // set by delete/close; late readers must bail
+};
+
+using TaskPtr = std::shared_ptr<TaskStore>;
+
+struct PieceStore {
+  std::string root;
+  std::map<std::string, TaskPtr> tasks;
+  std::mutex mu;
+};
+
+std::mutex g_stores_mu;
+std::map<int64_t, PieceStore*> g_stores;
+
+std::string task_dir(const PieceStore* ps, const char* task_id) {
+  return ps->root + "/" + task_id;
+}
+
+bool load_task(TaskStore* ts) {
+  // Re-read committed piece metadata; tolerate a torn trailing record.
+  fseeko(ts->meta, 0, SEEK_END);
+  off_t size = ftello(ts->meta);
+  if (size < (off_t)sizeof(TaskHeader)) return false;
+  fseeko(ts->meta, 0, SEEK_SET);
+  if (fread(&ts->header, sizeof(TaskHeader), 1, ts->meta) != 1) return false;
+  if (memcmp(ts->header.magic, "DFPS", 4) != 0) return false;
+  size_t n = (size - sizeof(TaskHeader)) / sizeof(PieceMeta);
+  for (size_t i = 0; i < n; i++) {
+    PieceMeta pm;
+    if (fread(&pm, sizeof(PieceMeta), 1, ts->meta) != 1) break;
+    if (pm.flags & 1) ts->pieces[pm.number] = pm;
+  }
+  fseeko(ts->meta, 0, SEEK_END);
+  return true;
+}
+
+TaskPtr open_task(PieceStore* ps, const char* task_id, uint32_t piece_size,
+                  int64_t content_length, bool create) {
+  std::lock_guard<std::mutex> lk(ps->mu);
+  auto it = ps->tasks.find(task_id);
+  if (it != ps->tasks.end()) return it->second;
+
+  std::string dir = task_dir(ps, task_id);
+  std::string meta_path = dir + "/meta";
+  std::string data_path = dir + "/data";
+  struct stat st;
+  bool exists = stat(meta_path.c_str(), &st) == 0;
+  if (!exists && !create) return nullptr;
+  if (!exists) {
+    if (mkdir(dir.c_str(), 0755) != 0 && errno != EEXIST) return nullptr;
+  }
+
+  TaskPtr ts = std::make_shared<TaskStore>();
+  ts->dir = dir;
+  ts->meta = fopen(meta_path.c_str(), exists ? "r+b" : "w+b");
+  ts->data = fopen(data_path.c_str(), exists ? "r+b" : "w+b");
+  if (!ts->meta || !ts->data) {
+    if (ts->meta) fclose(ts->meta);
+    if (ts->data) fclose(ts->data);
+    return nullptr;
+  }
+  if (exists) {
+    if (!load_task(ts.get())) {
+      fclose(ts->meta);
+      fclose(ts->data);
+      return nullptr;
+    }
+  } else {
+    memcpy(ts->header.magic, "DFPS", 4);
+    ts->header.piece_size = piece_size;
+    ts->header.content_length = content_length;
+    fwrite(&ts->header, sizeof(TaskHeader), 1, ts->meta);
+    fflush(ts->meta);
+  }
+  ps->tasks[task_id] = ts;
+  return ts;
+}
+
+int remove_tree(const std::string& dir) {
+  DIR* d = opendir(dir.c_str());
+  if (!d) return -1;
+  struct dirent* e;
+  while ((e = readdir(d)) != nullptr) {
+    if (strcmp(e->d_name, ".") == 0 || strcmp(e->d_name, "..") == 0) continue;
+    std::string path = dir + "/" + e->d_name;
+    unlink(path.c_str());
+  }
+  closedir(d);
+  return rmdir(dir.c_str());
+}
+
+}  // namespace
+
+extern "C" {
+
+// -- record engine ----------------------------------------------------------
+
+int64_t re_open(const char* path, const char* header_json, uint32_t width) {
+  struct stat st;
+  bool exists = stat(path, &st) == 0 && st.st_size > 0;
+  FILE* f = fopen(path, exists ? "r+b" : "w+b");
+  if (!f) return -1;
+  RecordFile* rf = new RecordFile();
+  rf->f = f;
+  rf->width = width;
+  if (exists) {
+    char magic[4];
+    uint32_t hlen = 0;
+    if (fread(magic, 4, 1, f) != 1 || memcmp(magic, kMagic, 4) != 0 ||
+        fread(&hlen, 4, 1, f) != 1) {
+      fclose(f);
+      delete rf;
+      return -2;
+    }
+    rf->data_offset = 8 + hlen;
+    fseeko(f, 0, SEEK_END);
+  } else {
+    uint32_t hlen = (uint32_t)strlen(header_json);
+    fwrite(kMagic, 4, 1, f);
+    fwrite(&hlen, 4, 1, f);
+    fwrite(header_json, 1, hlen, f);
+    rf->data_offset = 8 + hlen;
+    fflush(f);
+  }
+  std::lock_guard<std::mutex> lk(g_records_mu);
+  int64_t h = g_next_handle++;
+  g_records[h] = rf;
+  return h;
+}
+
+int64_t re_append(int64_t handle, const float* rows, int64_t n_rows) {
+  RecordFile* rf;
+  {
+    std::lock_guard<std::mutex> lk(g_records_mu);
+    auto it = g_records.find(handle);
+    if (it == g_records.end()) return -1;
+    rf = it->second;
+  }
+  std::lock_guard<std::mutex> lk(rf->mu);
+  size_t wrote = fwrite(rows, sizeof(float) * rf->width, n_rows, rf->f);
+  return (int64_t)wrote;
+}
+
+int re_flush(int64_t handle) {
+  std::lock_guard<std::mutex> lk(g_records_mu);
+  auto it = g_records.find(handle);
+  if (it == g_records.end()) return -1;
+  std::lock_guard<std::mutex> lk2(it->second->mu);
+  fflush(it->second->f);
+  return 0;
+}
+
+int64_t re_rows(int64_t handle) {
+  std::lock_guard<std::mutex> lk(g_records_mu);
+  auto it = g_records.find(handle);
+  if (it == g_records.end()) return -1;
+  RecordFile* rf = it->second;
+  std::lock_guard<std::mutex> lk2(rf->mu);
+  fflush(rf->f);
+  off_t end = ftello(rf->f);
+  return (end - rf->data_offset) / (sizeof(float) * rf->width);
+}
+
+int re_close(int64_t handle) {
+  RecordFile* rf;
+  {
+    std::lock_guard<std::mutex> lk(g_records_mu);
+    auto it = g_records.find(handle);
+    if (it == g_records.end()) return -1;
+    rf = it->second;
+    g_records.erase(it);
+  }
+  fclose(rf->f);
+  delete rf;
+  return 0;
+}
+
+// -- piece store ------------------------------------------------------------
+
+int64_t ps_open(const char* root) {
+  if (mkdir(root, 0755) != 0 && errno != EEXIST) return -1;
+  PieceStore* ps = new PieceStore();
+  ps->root = root;
+  std::lock_guard<std::mutex> lk(g_stores_mu);
+  int64_t h = g_next_handle++;
+  g_stores[h] = ps;
+  return h;
+}
+
+static PieceStore* get_store(int64_t handle) {
+  std::lock_guard<std::mutex> lk(g_stores_mu);
+  auto it = g_stores.find(handle);
+  return it == g_stores.end() ? nullptr : it->second;
+}
+
+int ps_create_task(int64_t handle, const char* task_id, uint32_t piece_size,
+                   int64_t content_length) {
+  PieceStore* ps = get_store(handle);
+  if (!ps) return -1;
+  TaskPtr ts = open_task(ps, task_id, piece_size, content_length, true);
+  return ts ? 0 : -2;
+}
+
+int ps_load_task(int64_t handle, const char* task_id) {
+  PieceStore* ps = get_store(handle);
+  if (!ps) return -1;
+  TaskPtr ts = open_task(ps, task_id, 0, 0, false);
+  return ts ? 0 : -2;
+}
+
+int64_t ps_write_piece(int64_t handle, const char* task_id, uint32_t number,
+                       const uint8_t* data, uint32_t length) {
+  PieceStore* ps = get_store(handle);
+  if (!ps) return -1;
+  TaskPtr ts = open_task(ps, task_id, 0, 0, false);
+  if (!ts) return -2;
+  std::lock_guard<std::mutex> lk(ts->mu);
+  if (ts->closed) return -7;
+  int64_t offset = (int64_t)number * ts->header.piece_size;
+  fseeko(ts->data, offset, SEEK_SET);
+  if (fwrite(data, 1, length, ts->data) != length) return -3;
+  fflush(ts->data);
+  // Data durable before metadata commit: a crash between the two leaves an
+  // uncommitted piece that reload simply redownloads.
+  fsync(fileno(ts->data));
+  PieceMeta pm{number, length, offset, crc32(data, length), 1};
+  fseeko(ts->meta, 0, SEEK_END);
+  if (fwrite(&pm, sizeof(PieceMeta), 1, ts->meta) != 1) return -4;
+  fflush(ts->meta);
+  fsync(fileno(ts->meta));
+  ts->pieces[number] = pm;
+  return (int64_t)length;
+}
+
+int64_t ps_read_piece(int64_t handle, const char* task_id, uint32_t number,
+                      uint8_t* buf, uint32_t buf_len, int verify) {
+  PieceStore* ps = get_store(handle);
+  if (!ps) return -1;
+  TaskPtr ts = open_task(ps, task_id, 0, 0, false);
+  if (!ts) return -2;
+  std::lock_guard<std::mutex> lk(ts->mu);
+  if (ts->closed) return -7;
+  auto it = ts->pieces.find(number);
+  if (it == ts->pieces.end()) return -3;
+  const PieceMeta& pm = it->second;
+  if (pm.length > buf_len) return -4;
+  fseeko(ts->data, pm.offset, SEEK_SET);
+  if (fread(buf, 1, pm.length, ts->data) != pm.length) return -5;
+  if (verify && crc32(buf, pm.length) != pm.crc) return -6;
+  return (int64_t)pm.length;
+}
+
+int64_t ps_piece_count(int64_t handle, const char* task_id) {
+  PieceStore* ps = get_store(handle);
+  if (!ps) return -1;
+  TaskPtr ts = open_task(ps, task_id, 0, 0, false);
+  if (!ts) return -2;
+  std::lock_guard<std::mutex> lk(ts->mu);
+  return (int64_t)ts->pieces.size();
+}
+
+// Fill `bitmap` (caller-allocated, n_pieces bytes) with 1 per present piece.
+int ps_piece_bitmap(int64_t handle, const char* task_id, uint8_t* bitmap,
+                    uint32_t n_pieces) {
+  PieceStore* ps = get_store(handle);
+  if (!ps) return -1;
+  TaskPtr ts = open_task(ps, task_id, 0, 0, false);
+  if (!ts) return -2;
+  std::lock_guard<std::mutex> lk(ts->mu);
+  memset(bitmap, 0, n_pieces);
+  for (auto& kv : ts->pieces)
+    if (kv.first < n_pieces) bitmap[kv.first] = 1;
+  return 0;
+}
+
+int64_t ps_task_bytes(int64_t handle, const char* task_id) {
+  PieceStore* ps = get_store(handle);
+  if (!ps) return -1;
+  TaskPtr ts = open_task(ps, task_id, 0, 0, false);
+  if (!ts) return -2;
+  std::lock_guard<std::mutex> lk(ts->mu);
+  int64_t total = 0;
+  for (auto& kv : ts->pieces) total += kv.second.length;
+  return total;
+}
+
+int64_t ps_content_length(int64_t handle, const char* task_id) {
+  PieceStore* ps = get_store(handle);
+  if (!ps) return -1;
+  TaskPtr ts = open_task(ps, task_id, 0, 0, false);
+  if (!ts) return -2;
+  return ts->header.content_length;
+}
+
+int64_t ps_piece_size(int64_t handle, const char* task_id) {
+  PieceStore* ps = get_store(handle);
+  if (!ps) return -1;
+  TaskPtr ts = open_task(ps, task_id, 0, 0, false);
+  if (!ts) return -2;
+  return (int64_t)ts->header.piece_size;
+}
+
+int ps_delete_task(int64_t handle, const char* task_id) {
+  PieceStore* ps = get_store(handle);
+  if (!ps) return -1;
+  TaskPtr ts;
+  {
+    std::lock_guard<std::mutex> lk(ps->mu);
+    auto it = ps->tasks.find(task_id);
+    if (it != ps->tasks.end()) {
+      ts = it->second;  // shared_ptr keeps the struct alive for in-flight readers
+      ps->tasks.erase(it);
+    }
+  }
+  if (ts) {
+    std::lock_guard<std::mutex> tlk(ts->mu);
+    fclose(ts->meta);
+    fclose(ts->data);
+    ts->closed = true;
+  }
+  return remove_tree(task_dir(ps, task_id));
+}
+
+int ps_close(int64_t handle) {
+  PieceStore* ps;
+  {
+    std::lock_guard<std::mutex> lk(g_stores_mu);
+    auto it = g_stores.find(handle);
+    if (it == g_stores.end()) return -1;
+    ps = it->second;
+    g_stores.erase(it);
+  }
+  std::lock_guard<std::mutex> lk(ps->mu);
+  for (auto& kv : ps->tasks) {
+    std::lock_guard<std::mutex> tlk(kv.second->mu);
+    if (!kv.second->closed) {
+      fclose(kv.second->meta);
+      fclose(kv.second->data);
+      kv.second->closed = true;
+    }
+  }
+  ps->tasks.clear();
+  delete ps;
+  return 0;
+}
+
+}  // extern "C"

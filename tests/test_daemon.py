@@ -1,0 +1,308 @@
+"""Daemon data plane tests: native/python piece stores, upload caps,
+conductor-driven P2P transfer through the real scheduler, pex, shaper,
+quota GC, crash reload."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu import native
+from dragonfly2_tpu.daemon import (
+    Daemon,
+    DaemonStorage,
+    TrafficShaper,
+    UploadManager,
+)
+from dragonfly2_tpu.daemon.pex import GossipBus, MemberMeta, PeerExchange
+from dragonfly2_tpu.daemon.upload import UploadBusy
+from dragonfly2_tpu.records.storage import Storage
+from dragonfly2_tpu.scheduler import (
+    Evaluator,
+    NetworkTopology,
+    Resource,
+    SchedulerService,
+    Scheduling,
+    SchedulingConfig,
+)
+from dragonfly2_tpu.scheduler.resource import Host
+from dragonfly2_tpu.utils.types import HostType
+
+PIECE = 64 * 1024  # 64 KiB pieces keep the tests fast
+
+
+def make_host(i, **kw):
+    h = Host(
+        id=f"host-{i}", hostname=f"host-{i}", ip=f"10.0.0.{i}", port=8002,
+        download_port=8001, **kw,
+    )
+    h.stats.network.idc = "idc-a"
+    return h
+
+
+class FakeOrigin:
+    """Deterministic origin content, piece-addressable."""
+
+    def __init__(self, total_pieces=4):
+        self.total_pieces = total_pieces
+        self.fetches = 0
+
+    def content(self, url, number):
+        seed = (hash(url) ^ number) & 0xFFFF
+        return bytes((seed + i) % 256 for i in range(PIECE))
+
+    def fetch(self, url, number, piece_size):
+        self.fetches += 1
+        return self.content(url, number)
+
+
+@pytest.fixture(params=["native", "python"])
+def engine_pref(request):
+    if request.param == "native" and not native.available():
+        pytest.skip("native library not buildable")
+    return request.param == "native"
+
+
+class TestDaemonStorage:
+    def test_write_read_bitmap(self, tmp_path, engine_pref):
+        st = DaemonStorage(str(tmp_path / "s"), prefer_native=engine_pref)
+        assert st.is_native == engine_pref
+        st.register_task("t1", piece_size=PIECE, content_length=4 * PIECE)
+        st.write_piece("t1", 0, b"a" * PIECE)
+        st.write_piece("t1", 2, b"c" * 100)
+        assert st.read_piece("t1", 0) == b"a" * PIECE
+        assert st.read_piece("t1", 2) == b"c" * 100
+        assert list(st.piece_bitmap("t1", 4)) == [1, 0, 1, 0]
+        assert st.task_bytes("t1") == PIECE + 100
+
+    def test_crash_reload(self, tmp_path, engine_pref):
+        root = str(tmp_path / "s")
+        st = DaemonStorage(root, prefer_native=engine_pref)
+        st.register_task("t1", piece_size=PIECE, content_length=2 * PIECE)
+        st.write_piece("t1", 1, b"x" * PIECE)
+        st.close()
+        st2 = DaemonStorage(root, prefer_native=engine_pref)
+        assert st2.reload_persistent_tasks(st2.scan_disk_tasks()) == ["t1"]
+        assert st2.read_piece("t1", 1) == b"x" * PIECE
+
+    def test_quota_reclaims_lru(self, tmp_path, engine_pref):
+        st = DaemonStorage(
+            str(tmp_path / "s"), quota_bytes=3 * PIECE, prefer_native=engine_pref
+        )
+        import time
+
+        for i, tid in enumerate(["old", "mid", "new"]):
+            st.register_task(tid, piece_size=PIECE, content_length=2 * PIECE)
+            st.write_piece(tid, 0, b"d" * PIECE)
+            st.write_piece(tid, 1, b"d" * PIECE)
+            time.sleep(0.01)
+        reclaimed = st.reclaim()
+        assert "old" in reclaimed
+        assert st.total_bytes() <= 3 * PIECE
+
+
+class TestUploadManager:
+    def test_concurrency_cap(self, tmp_path):
+        st = DaemonStorage(str(tmp_path / "s"), prefer_native=False)
+        st.register_task("t", piece_size=PIECE, content_length=PIECE)
+        st.write_piece("t", 0, b"z" * PIECE)
+        um = UploadManager(st, concurrent_limit=0)
+        with pytest.raises(UploadBusy):
+            um.serve_piece("t", 0)
+        um.concurrent_limit = 1
+        assert um.serve_piece("t", 0) == b"z" * PIECE
+        assert um.upload_count == 1
+
+    def test_serve_range(self, tmp_path):
+        st = DaemonStorage(str(tmp_path / "s"), prefer_native=False)
+        st.register_task("t", piece_size=4, content_length=12)
+        st.write_piece("t", 0, b"abcd")
+        st.write_piece("t", 1, b"efgh")
+        st.write_piece("t", 2, b"ijkl")
+        um = UploadManager(st)
+        assert um.serve_range("t", 2, 8, 4) == b"cdefghij"
+
+
+class TestTrafficShaper:
+    def test_proportional_allocation(self):
+        ts = TrafficShaper(100.0, min_share=0.1)
+        ts.add_task("a")
+        ts.add_task("b")
+        assert ts.budget("a") == 50.0
+        ts.record("a", 900)
+        ts.record("b", 100)
+        alloc = ts.allocate()
+        assert alloc["a"] > alloc["b"]
+        assert alloc["a"] + alloc["b"] == pytest.approx(100.0)
+        assert alloc["b"] >= 10.0  # floor
+
+
+class TestPeerExchange:
+    def test_advertise_and_reclaim(self):
+        bus = GossipBus()
+        a = PeerExchange(MemberMeta("host-a"), bus)
+        b = PeerExchange(MemberMeta("host-b"), bus)
+        a.serve()
+        b.serve()
+        a.advertise("task-1", {0, 1, 2})
+        assert b.find_peers_with_task("task-1") == ["host-a"]
+        assert b.find_peers_with_piece("task-1", 1) == ["host-a"]
+        assert b.find_peers_with_piece("task-1", 9) == []
+        # Late joiner learns existing holdings.
+        c = PeerExchange(MemberMeta("host-c"), bus)
+        c.serve()
+        assert c.find_peers_with_task("task-1") == ["host-a"]
+        # Leave reclaims.
+        a.stop()
+        assert b.find_peers_with_task("task-1") == []
+        assert {m.host_id for m in b.members()} == {"host-c"}
+
+
+class _Swarm:
+    """Scheduler + N daemons in one process."""
+
+    def __init__(self, tmp_path, n_hosts=4, record_storage=None):
+        self.resource = Resource()
+        self.scheduler = SchedulerService(
+            self.resource,
+            Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+            record_storage,
+            NetworkTopology(self.resource.host_manager),
+        )
+        self.origin = FakeOrigin()
+        self.registry = {}
+        self.bus = GossipBus()
+        self.daemons = []
+        for i in range(n_hosts):
+            host = make_host(i)
+            self.resource.store_host(host)
+            d = Daemon(
+                host,
+                self.scheduler,
+                storage_root=str(tmp_path / f"d{i}"),
+                daemon_registry=self.registry,
+                gossip_bus=self.bus,
+                source_fetcher=self.origin,
+                prefer_native=False,
+            )
+            self.daemons.append(d)
+
+
+class TestConductorE2E:
+    def test_first_peer_back_to_source_then_p2p(self, tmp_path):
+        swarm = _Swarm(tmp_path)
+        url = "https://origin/blob-1"
+        # First peer: no parents → back-to-source.
+        r0 = swarm.daemons[0].download(
+            url, piece_size=PIECE, content_length=4 * PIECE
+        )
+        assert r0.ok and r0.back_to_source and r0.pieces == 4
+        fetches_after_seed = swarm.origin.fetches
+        assert fetches_after_seed == 4
+
+        # Second peer: scheduler must hand it daemon 0 as parent; the bytes
+        # flow through daemon 0's upload manager, not the origin.
+        r1 = swarm.daemons[1].download(url, piece_size=PIECE)
+        assert r1.ok and not r1.back_to_source
+        assert swarm.origin.fetches == fetches_after_seed  # origin untouched
+        assert swarm.daemons[0].upload.upload_count == 4
+        # Bytes identical to origin content.
+        for n in range(4):
+            assert swarm.daemons[1].storage.read_piece(r1.task_id, n) == \
+                swarm.origin.content(url, n)
+
+        # Third peer: two candidate parents now.
+        r2 = swarm.daemons[2].download(url, piece_size=PIECE)
+        assert r2.ok and not r2.back_to_source
+        # pex knows the holders.
+        assert set(
+            swarm.daemons[3].pex.find_peers_with_task(r1.task_id)
+        ) >= {"host-0", "host-1"}
+
+    def test_download_records_written(self, tmp_path):
+        store = Storage(str(tmp_path / "records"), buffer_size=1)
+        swarm = _Swarm(tmp_path, record_storage=store)
+        url = "https://origin/blob-2"
+        swarm.daemons[0].download(url, piece_size=PIECE, content_length=2 * PIECE)
+        swarm.daemons[1].download(url, piece_size=PIECE)
+        store.flush()
+        downloads = store.list_download()
+        assert len(downloads) == 2
+        p2p = [d for d in downloads if d.parents]
+        assert len(p2p) == 1
+        assert p2p[0].parents[0].observed_bandwidth() > 0
+
+    def test_parent_failure_reschedules(self, tmp_path):
+        swarm = _Swarm(tmp_path)
+        url = "https://origin/blob-3"
+        swarm.daemons[0].download(url, piece_size=PIECE, content_length=2 * PIECE)
+        swarm.daemons[1].download(url, piece_size=PIECE)
+        # Sabotage daemon 0's storage so piece fetches from it fail; the
+        # conductor must blocklist it and still finish via daemon 1 or source.
+        task_id = swarm.daemons[1].storage.scan_disk_tasks()[0]
+        swarm.daemons[0].storage.delete_task(task_id)
+        r = swarm.daemons[2].download(url, piece_size=PIECE)
+        assert r.ok
+
+    def test_daemon_reload_advertises(self, tmp_path):
+        swarm = _Swarm(tmp_path)
+        url = "https://origin/blob-4"
+        r = swarm.daemons[0].download(url, piece_size=PIECE, content_length=2 * PIECE)
+        # Simulate restart: new daemon object on the same storage root.
+        swarm.daemons[0].stop()
+        d0b = Daemon(
+            make_host(0),
+            swarm.scheduler,
+            storage_root=str(tmp_path / "d0"),
+            daemon_registry=swarm.registry,
+            gossip_bus=swarm.bus,
+            source_fetcher=swarm.origin,
+            prefer_native=False,
+        )
+        assert d0b.reload() == 1
+        assert swarm.daemons[1].pex.find_peers_with_task(r.task_id) == ["host-0"]
+
+
+class TestReviewRegressions:
+    def test_large_piece_native_roundtrip(self, tmp_path):
+        """Pieces larger than the old 8 MiB buffer cap must read back."""
+        import pytest as _pytest
+        from dragonfly2_tpu import native as _native
+
+        if not _native.available():
+            _pytest.skip("native library not buildable")
+        st = DaemonStorage(str(tmp_path / "big"), prefer_native=True)
+        big = 12 << 20
+        st.register_task("t", piece_size=big, content_length=big)
+        data = bytes(range(256)) * (big // 256)
+        st.write_piece("t", 0, data)
+        assert st.read_piece("t", 0) == data
+
+    def test_shaper_many_tasks_no_negative_budget(self):
+        ts = TrafficShaper(100.0, min_share=0.05)
+        for i in range(40):
+            ts.add_task(f"t{i}")
+        ts.record("t0", 10_000)  # t0 hogs the window
+        alloc = ts.allocate()
+        assert all(v >= 0 for v in alloc.values()), alloc
+        assert alloc["t0"] == max(alloc.values())
+        assert sum(alloc.values()) == pytest.approx(100.0, rel=1e-6)
+
+    def test_reload_advertises_tail_pieces(self, tmp_path):
+        """A daemon holding only tail pieces must advertise them after reload."""
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        d = swarm.daemons[0]
+        tid = "tail-task"
+        d.storage.register_task(tid, piece_size=PIECE, content_length=300 * PIECE)
+        for n in range(250, 300):
+            d.storage.write_piece(tid, n, b"x" * 10)
+        d.stop()
+        d0b = Daemon(
+            make_host(0),
+            swarm.scheduler,
+            storage_root=str(tmp_path / "d0"),
+            daemon_registry=swarm.registry,
+            gossip_bus=swarm.bus,
+            prefer_native=False,
+        )
+        assert d0b.reload() == 1
+        holders = swarm.daemons[1].pex.find_peers_with_piece(tid, 299)
+        assert holders == ["host-0"]
