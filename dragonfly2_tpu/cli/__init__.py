@@ -1,0 +1,17 @@
+"""CLI entry points (reference: cmd/ — manager, scheduler, trainer, dfget,
+dfcache, dfstore via cobra).
+
+argparse equivalents, runnable as ``python -m dragonfly2_tpu.cli.<tool>``:
+
+- ``dfget``     — one-shot download through an embedded daemon+scheduler
+                  stack (the reference's dfget self-spawns a daemon,
+                  cmd/dfget/cmd/root.go:234-260; embedded here).
+- ``dfcache``   — import/export/stat of cache tasks against the local
+                  piece store (client/dfcache).
+- ``scheduler`` / ``trainer`` / ``manager`` / ``dfdaemon`` — service
+  binaries: load config, boot the composition, serve (or run a bounded
+  simulation round in --simulate mode for smoke checks).
+
+Shared flags mirror cmd/dependency/dependency.go: --config, --verbose,
+--console.
+"""

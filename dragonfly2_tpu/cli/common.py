@@ -1,0 +1,29 @@
+"""Shared CLI plumbing (reference: cmd/dependency/dependency.go:59-120)."""
+
+from __future__ import annotations
+
+import argparse
+
+from .. import __version__
+from ..utils import dflog
+
+
+def base_parser(prog: str, description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=prog, description=description)
+    p.add_argument("--config", default=None, help="YAML config file path")
+    p.add_argument("--verbose", action="store_true", help="debug logging")
+    p.add_argument("--console", action="store_true", help="log to stdout")
+    p.add_argument("--log-dir", default=None, help="rotating log file directory")
+    p.add_argument(
+        "--version", action="version", version=f"%(prog)s {__version__}"
+    )
+    return p
+
+
+def init_logging(args, service: str) -> None:
+    dflog.setup(
+        level="debug" if args.verbose else "info",
+        log_dir=args.log_dir,
+        console=args.console or not args.log_dir,
+        service=service,
+    )

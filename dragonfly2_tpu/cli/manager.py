@@ -1,0 +1,62 @@
+"""manager service binary (reference: cmd/manager + manager/manager.go).
+
+Boots the control-plane composition: model registry (versioned blobs),
+cluster manager with keepalive TTLs, searcher, dynconfig server, job
+broker.  ``--list-models DIR`` prints the registry persisted under DIR
+(the ops inspection path the reference serves via console/REST).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from ..config import ManagerConfig, load_config
+from ..jobs import JobQueue
+from ..manager import ClusterManager, DynconfigServer, ModelRegistry, Searcher
+from ..manager.registry import BlobStore
+from .common import base_parser, init_logging
+
+
+def build(cfg: ManagerConfig):
+    registry = ModelRegistry(BlobStore(cfg.registry.blob_dir))
+    clusters = ClusterManager(keepalive_ttl=cfg.keepalive_ttl_s)
+    return {
+        "registry": registry,
+        "clusters": clusters,
+        "searcher": Searcher(),
+        "dynconfig": DynconfigServer(),
+        "jobs": JobQueue(),
+    }
+
+
+def run(argv=None) -> int:
+    p = base_parser("manager", "Control-plane manager service")
+    p.add_argument("--list-models", action="store_true")
+    args = p.parse_args(argv)
+    init_logging(args, "manager")
+
+    cfg = load_config(ManagerConfig, args.config)
+    parts = build(cfg)
+
+    if args.list_models:
+        models = parts["registry"].list()
+        if not models:
+            print("manager: registry empty")
+        for m in models:
+            print(
+                f"manager: {m.name} v{m.version} type={m.type} state={m.state.value} "
+                f"scheduler={m.scheduler_id} eval={m.evaluation}"
+            )
+        return 0
+
+    print(f"manager: serving on {cfg.server.host}:{cfg.server.port} (ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -1,0 +1,80 @@
+"""trainer service binary (reference: cmd/trainer + trainer/trainer.go).
+
+Boots the trainer composition (registry client, ingest service, training)
+on a TPU-VM.  ``--train-once DIR`` ingests columnar shards from DIR and
+runs one training round synchronously (the smoke/e2e mode); without it the
+process serves and waits for announcer uploads.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+import time
+
+from ..config import TrainerConfigFile, load_config
+from ..manager.registry import BlobStore, ModelRegistry
+from ..trainer.service import TrainerService
+from ..trainer.train import TrainConfig
+from .common import base_parser, init_logging
+
+
+def run(argv=None) -> int:
+    p = base_parser("trainer", "Model training service")
+    p.add_argument("--train-once", default=None, metavar="DIR",
+                   help="ingest DIR's columnar shards, train one round, exit")
+    p.add_argument("--scheduler-id", default="scheduler-local")
+    args = p.parse_args(argv)
+    init_logging(args, "trainer")
+
+    cfg = load_config(TrainerConfigFile, args.config)
+    registry = ModelRegistry()
+    service = TrainerService(
+        registry,
+        data_dir=None,
+        train_config=TrainConfig(
+            epochs=cfg.training.epochs,
+            learning_rate=cfg.training.learning_rate,
+            warmup_steps=cfg.training.warmup_steps,
+        ),
+    )
+
+    if args.train_once:
+        session = service.open_train_stream(
+            ip="127.0.0.1", hostname=os.uname().nodename, scheduler_id=args.scheduler_id
+        )
+        dl = sorted(glob.glob(os.path.join(args.train_once, "download*.dfc")))
+        topo = sorted(glob.glob(os.path.join(args.train_once, "networktopology*.dfc")))
+        if not dl:
+            print(f"trainer: no download*.dfc shards in {args.train_once}", file=sys.stderr)
+            return 1
+        for path in dl:
+            session.send_download_shard(path)
+        for path in topo:
+            session.send_network_topology_shard(path)
+        key = session.close_and_train()
+        run_rec = service.runs[key]
+        if run_rec.error:
+            print(f"trainer: run failed: {run_rec.error}", file=sys.stderr)
+            return 1
+        for name, metrics in run_rec.metrics.items():
+            print(
+                f"trainer: {name}: mae={metrics.mae:.4f} mse={metrics.mse:.4f} "
+                f"f1={metrics.f1:.3f} ({run_rec.download_rows} rows)"
+            )
+        for mid in run_rec.models:
+            m = registry.get(mid)
+            print(f"trainer: registered {m.name} v{m.version} ({m.type})")
+        return 0
+
+    print("trainer: serving (waiting for dataset uploads; ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

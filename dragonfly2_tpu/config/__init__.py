@@ -1,0 +1,24 @@
+"""Configuration system (reference: per-service config/ + cmd/dependency).
+
+Three tiers, mirroring the reference (SURVEY §5.6):
+(a) static YAML + env overrides + validation/defaults here;
+(b) dynconfig — manager-sourced dynamic values (manager/dynconfig.py);
+(c) cluster-scoped overrides served through dynconfig (candidate/filter
+    parent limits, consumed by scheduling).
+
+``load_config(cls, path)`` reads YAML into nested dataclasses;
+``DRAGONFLY_<SECTION>_<FIELD>`` env vars override scalar leaves;
+``validate()`` enforces the reference's invariants.
+"""
+
+from .schema import (  # noqa: F401
+    ConfigError,
+    DaemonConfig,
+    ManagerConfig,
+    MetricsConfig,
+    SchedulerConfigFile,
+    ServerConfig,
+    StorageConfig,
+    TrainerConfigFile,
+    load_config,
+)

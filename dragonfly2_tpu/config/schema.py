@@ -1,0 +1,259 @@
+"""Config dataclasses + YAML/env loading + validation.
+
+Defaults track the reference's constants (scheduler/config/constants.go:
+candidate/filter parent limits 4/15 :34-37, retry limits 4/5 :66-70,
+retry interval 500ms :73, probe queue/count 5/5 :112-115, trainer upload
+interval 7d :198; client/config/peerhost.go daemon defaults).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional, Type, TypeVar, get_args, get_origin
+
+T = TypeVar("T")
+
+ENV_PREFIX = "DRAGONFLY"
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class ServerConfig:
+    host: str = "0.0.0.0"
+    port: int = 8002
+    advertise_ip: str = ""
+
+    def validate(self) -> None:
+        if not (0 < self.port < 65536):
+            raise ConfigError(f"server.port {self.port} out of range")
+
+
+@dataclass
+class MetricsConfig:
+    enable: bool = True
+    port: int = 8000
+
+
+@dataclass
+class LogConfig:
+    level: str = "info"
+    dir: str = ""
+    console: bool = False
+    max_bytes: int = 50 << 20
+    backups: int = 5
+
+    def validate(self) -> None:
+        if self.level not in ("debug", "info", "warning", "error"):
+            raise ConfigError(f"log.level {self.level!r} unknown")
+
+
+@dataclass
+class StorageConfig:
+    dir: str = "/var/lib/dragonfly/records"
+    buffer_size: int = 100
+    max_size: int = 100 << 20
+    max_backups: int = 10
+
+
+@dataclass
+class SchedulingSection:
+    algorithm: str = "default"        # default | nt | ml (evaluator.go:28-46)
+    candidate_parent_limit: int = 4
+    filter_parent_limit: int = 15
+    retry_limit: int = 5
+    retry_back_to_source_limit: int = 4
+    retry_interval_s: float = 0.5
+    back_to_source_count: int = 3
+
+    def validate(self) -> None:
+        if self.algorithm not in ("default", "nt", "ml"):
+            raise ConfigError(f"scheduling.algorithm {self.algorithm!r} unknown")
+        if self.candidate_parent_limit > self.filter_parent_limit:
+            raise ConfigError("candidate_parent_limit > filter_parent_limit")
+        if self.candidate_parent_limit < 1:
+            raise ConfigError("candidate_parent_limit < 1")
+
+
+@dataclass
+class NetworkTopologySection:
+    enable: bool = True
+    probe_queue_length: int = 5
+    probe_count: int = 5
+    collect_interval_s: float = 2 * 3600.0
+
+
+@dataclass
+class TrainerLinkSection:
+    enable: bool = False
+    addr: str = ""
+    interval_s: float = 7 * 24 * 3600.0  # constants.go:198
+
+
+@dataclass
+class GCSection:
+    host_ttl_s: float = 6 * 3600.0
+    task_ttl_s: float = 2 * 3600.0
+    peer_ttl_s: float = 24 * 3600.0
+    interval_s: float = 60.0
+
+
+@dataclass
+class SchedulerConfigFile:
+    server: ServerConfig = field(default_factory=ServerConfig)
+    scheduling: SchedulingSection = field(default_factory=SchedulingSection)
+    network_topology: NetworkTopologySection = field(default_factory=NetworkTopologySection)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    trainer: TrainerLinkSection = field(default_factory=TrainerLinkSection)
+    gc: GCSection = field(default_factory=GCSection)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    log: LogConfig = field(default_factory=LogConfig)
+    manager_addr: str = ""
+    cluster_id: str = "default"
+
+    def validate(self) -> None:
+        self.server.validate()
+        self.scheduling.validate()
+        self.log.validate()
+
+
+@dataclass
+class TrainingSection:
+    epochs: int = 30
+    learning_rate: float = 3e-3
+    warmup_steps: int = 20
+    batch_size: int = 4096
+    checkpoint_dir: str = ""
+
+    def validate(self) -> None:
+        if self.learning_rate <= 0:
+            raise ConfigError("training.learning_rate must be > 0")
+        if self.epochs < 1:
+            raise ConfigError("training.epochs must be >= 1")
+
+
+@dataclass
+class TrainerConfigFile:
+    server: ServerConfig = field(default_factory=lambda: ServerConfig(port=9090))
+    training: TrainingSection = field(default_factory=TrainingSection)
+    data_dir: str = "/var/lib/dragonfly/trainer"
+    manager_addr: str = ""
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    log: LogConfig = field(default_factory=LogConfig)
+
+    def validate(self) -> None:
+        self.server.validate()
+        self.training.validate()
+        self.log.validate()
+
+
+@dataclass
+class ModelRegistrySection:
+    blob_dir: str = "/var/lib/dragonfly/models"
+
+
+@dataclass
+class ManagerConfig:
+    server: ServerConfig = field(default_factory=lambda: ServerConfig(port=65003))
+    registry: ModelRegistrySection = field(default_factory=ModelRegistrySection)
+    keepalive_ttl_s: float = 60.0
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    log: LogConfig = field(default_factory=LogConfig)
+
+    def validate(self) -> None:
+        self.server.validate()
+        self.log.validate()
+
+
+@dataclass
+class DaemonStorageSection:
+    dir: str = "/var/lib/dragonfly/daemon"
+    quota_bytes: int = 10 << 30
+
+
+@dataclass
+class ProxySection:
+    enable: bool = False
+    port: int = 65001
+
+
+@dataclass
+class DaemonConfig:
+    server: ServerConfig = field(default_factory=lambda: ServerConfig(port=65000))
+    storage: DaemonStorageSection = field(default_factory=DaemonStorageSection)
+    proxy: ProxySection = field(default_factory=ProxySection)
+    scheduler_addr: str = ""
+    piece_size: int = 4 << 20
+    concurrent_upload_limit: int = 50
+    total_rate_limit: float = 1e9
+    probe_interval_s: float = 20 * 60.0
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    log: LogConfig = field(default_factory=LogConfig)
+
+    def validate(self) -> None:
+        self.server.validate()
+        self.log.validate()
+        if self.piece_size < 4096:
+            raise ConfigError(f"piece_size {self.piece_size} too small")
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+
+def _from_dict(cls: Type[T], data: dict) -> T:
+    kwargs = {}
+    hints = {f.name: f.type for f in dataclasses.fields(cls)}
+    import typing
+
+    resolved = typing.get_type_hints(cls)
+    for name, value in (data or {}).items():
+        if name not in hints:
+            raise ConfigError(f"{cls.__name__}: unknown key {name!r}")
+        ftype = resolved[name]
+        if dataclasses.is_dataclass(ftype) and isinstance(value, dict):
+            kwargs[name] = _from_dict(ftype, value)
+        else:
+            kwargs[name] = value
+    return cls(**kwargs)
+
+
+def _apply_env(obj: Any, prefix: str) -> None:
+    for f in dataclasses.fields(obj):
+        value = getattr(obj, f.name)
+        key = f"{prefix}_{f.name}".upper()
+        if dataclasses.is_dataclass(value):
+            _apply_env(value, key)
+            continue
+        raw = os.environ.get(key)
+        if raw is None:
+            continue
+        if isinstance(value, bool):
+            setattr(obj, f.name, raw.lower() in ("1", "true", "yes", "on"))
+        elif isinstance(value, int):
+            setattr(obj, f.name, int(raw))
+        elif isinstance(value, float):
+            setattr(obj, f.name, float(raw))
+        else:
+            setattr(obj, f.name, raw)
+
+
+def load_config(cls: Type[T], path: Optional[str] = None, *, env: bool = True) -> T:
+    """YAML file (optional) → dataclass; env overrides; validate()."""
+    data: dict = {}
+    if path:
+        import yaml
+
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+    cfg = _from_dict(cls, data)
+    if env:
+        _apply_env(cfg, f"{ENV_PREFIX}_{cls.__name__.replace('ConfigFile', '').replace('Config', '')}")
+    if hasattr(cfg, "validate"):
+        cfg.validate()
+    return cfg

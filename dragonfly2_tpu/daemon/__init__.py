@@ -18,7 +18,7 @@ The download engine that turns scheduler decisions into bytes on disk:
 - ``daemon``         — composition root (client/daemon/daemon.go).
 """
 
-from .storage import DaemonStorage, PieceInfo  # noqa: F401
+from .storage import DaemonStorage  # noqa: F401
 from .upload import UploadManager  # noqa: F401
 from .conductor import Conductor, DownloadResult, PieceFetcher  # noqa: F401
 from .traffic_shaper import TrafficShaper  # noqa: F401

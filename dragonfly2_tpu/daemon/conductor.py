@@ -14,14 +14,12 @@ uses — so daemon-level tests exercise the whole control loop.
 
 from __future__ import annotations
 
-import threading
 import time
-import zlib
-from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Protocol
+from dataclasses import dataclass
+from typing import List, Optional, Protocol
 
 from ..scheduler.resource import Host, Peer
-from ..scheduler.service import RegisterResult, SchedulerService
+from ..scheduler.service import SchedulerService
 from ..scheduler.scheduling import ScheduleResultKind
 from .storage import DaemonStorage
 from .traffic_shaper import TrafficShaper
@@ -175,6 +173,12 @@ class Conductor:
         task.back_to_source_peers.add(peer.id)
         nbytes = 0
         for number in range(n_pieces):
+            # Resume, don't restart: pieces already fetched from parents
+            # stay on disk with their parent attribution intact — the
+            # origin only serves what P2P didn't (piece_manager.go resumes
+            # from the persisted piece bitmap the same way).
+            if self.storage.has_piece(task.id, number):
+                continue
             t_piece = time.monotonic()
             try:
                 data = self.source_fetcher.fetch(task.url, number, piece_size)

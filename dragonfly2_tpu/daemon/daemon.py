@@ -9,7 +9,6 @@ stand-in for the HTTP piece data plane, with identical semantics
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional
 
 from ..scheduler.networktopology import ProbeAgent
@@ -92,6 +91,21 @@ class Daemon:
         if result.ok and self.pex is not None:
             self.pex.advertise(result.task_id, set(range(result.pieces)))
         return result
+
+    def delete_task(self, task_id: str) -> None:
+        """Evict local data and withdraw the pex advertisement."""
+        self.storage.delete_task(task_id)
+        if self.pex is not None:
+            self.pex.retract(task_id)
+
+    def reclaim(self) -> list:
+        """Quota GC with advertisement retraction (use instead of calling
+        storage.reclaim directly when pex is enabled)."""
+        reclaimed = self.storage.reclaim()
+        if self.pex is not None:
+            for task_id in reclaimed:
+                self.pex.retract(task_id)
+        return reclaimed
 
     def reload(self) -> int:
         """Crash-restart recovery: reopen on-disk tasks and re-advertise."""

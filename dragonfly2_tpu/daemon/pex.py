@@ -13,8 +13,8 @@ round-trip — the daemon's subtask-reuse and seed-peer discovery path.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from dataclasses import dataclass
+from typing import Dict, List, Set
 
 
 @dataclass
@@ -55,6 +55,12 @@ class GossipBus:
         for other in others:
             other._on_advertise(src_host_id, task_id, pieces)
 
+    def broadcast_retract(self, src_host_id: str, task_id: str) -> None:
+        with self._mu:
+            others = [p for h, p in self._members.items() if h != src_host_id]
+        for other in others:
+            other._on_retract(src_host_id, task_id)
+
 
 class PeerExchange:
     def __init__(self, meta: MemberMeta, bus: GossipBus) -> None:
@@ -81,6 +87,13 @@ class PeerExchange:
             self._local.setdefault(task_id, set()).update(pieces)
             snapshot = set(self._local[task_id])
         self.bus.broadcast_advertise(self.meta.host_id, task_id, snapshot)
+
+    def retract(self, task_id: str) -> None:
+        """Local data evicted (quota reclaim / delete): withdraw the
+        advertisement so peers stop routing piece fetches here."""
+        with self._mu:
+            self._local.pop(task_id, None)
+        self.bus.broadcast_retract(self.meta.host_id, task_id)
 
     def local_holdings(self) -> List[tuple]:
         with self._mu:
@@ -119,3 +132,9 @@ class PeerExchange:
     def _on_advertise(self, host_id: str, task_id: str, pieces: Set[int]) -> None:
         with self._mu:
             self._pool.setdefault(task_id, {}).setdefault(host_id, set()).update(pieces)
+
+    def _on_retract(self, host_id: str, task_id: str) -> None:
+        with self._mu:
+            pool = self._pool.get(task_id)
+            if pool is not None:
+                pool.pop(host_id, None)

@@ -13,19 +13,11 @@ import os
 import threading
 import time
 import zlib
-from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from .. import native
-
-
-@dataclass
-class PieceInfo:
-    number: int
-    length: int
-    crc32: int
 
 
 class _PyPieceStore:
@@ -101,12 +93,17 @@ class _PyPieceStore:
         if meta is None:
             raise KeyError(task_id)
         path = os.path.join(self._dir(task_id), "data")
-        with open(path, "r+b" if os.path.exists(path) else "wb") as f:
-            f.seek(number * meta["piece_size"])
-            f.write(data)
-        info = {"length": len(data), "crc": zlib.crc32(data)}
-        meta["pieces"][number] = info
-        self._append_journal(task_id, number, info)
+        with self._mu:
+            # Serialized create+write: a concurrent first-write pair must
+            # not both open "wb" (the second truncates the first's piece).
+            if not os.path.exists(path):
+                open(path, "wb").close()
+            with open(path, "r+b") as f:
+                f.seek(number * meta["piece_size"])
+                f.write(data)
+            info = {"length": len(data), "crc": zlib.crc32(data)}
+            meta["pieces"][number] = info
+            self._append_journal(task_id, number, info)
         return len(data)
 
     def piece_size(self, task_id: str) -> int:

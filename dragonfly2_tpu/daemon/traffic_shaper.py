@@ -10,7 +10,6 @@ shrink to a floor.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict
 
 
@@ -22,7 +21,6 @@ class TrafficShaper:
         self._mu = threading.Lock()
         self._used: Dict[str, int] = {}
         self._budget: Dict[str, float] = {}
-        self._window_start = time.monotonic()
 
     def add_task(self, task_id: str) -> None:
         with self._mu:
@@ -65,5 +63,4 @@ class TrafficShaper:
                     self._budget[t] = floor + distributable * (used / total_used)
             for t in self._used:
                 self._used[t] = 0
-            self._window_start = time.monotonic()
             return dict(self._budget)

@@ -10,7 +10,6 @@ same method.  Concurrency is capped the way the scheduler models it
 from __future__ import annotations
 
 import threading
-from typing import Optional
 
 from .storage import DaemonStorage
 

@@ -1,0 +1,12 @@
+"""Async job system (reference: internal/job + manager/job + scheduler/job).
+
+The reference runs machinery (Redis-brokered task queue) with group jobs:
+the manager fans a preheat out to scheduler clusters and aggregates group
+state (preheat.go:126-167, internal/job/job.go:48-147).  Here the broker
+is an in-process queue bus with the same model — named queues, workers,
+group jobs with aggregated state — and the preheat job drives seed-peer
+downloads through the real scheduler/daemon stack.
+"""
+
+from .queue import GroupJob, JobQueue, JobState, Worker  # noqa: F401
+from .preheat import PreheatJob, preheat  # noqa: F401

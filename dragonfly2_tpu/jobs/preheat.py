@@ -1,0 +1,64 @@
+"""Preheat: warm content into seed peers ahead of demand.
+
+Reference flow (SURVEY §3.5): console → manager resolves image layers /
+file URLs → machinery group job fanned to scheduler clusters
+(manager/job/preheat.go:126-167) → each scheduler's job worker triggers a
+seed-peer download (scheduler/job/job.go:203-283 → seed_peer.go
+TriggerDownloadTask).
+
+Here: ``preheat()`` creates the group job over the target schedulers'
+queues; each scheduler's worker handler drives a seed daemon's conductor
+to fetch the URL, so subsequent peers find a warm parent.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from .queue import GroupJob, JobQueue, Worker
+
+PREHEAT = "preheat"
+
+
+@dataclass
+class PreheatJob:
+    group: GroupJob
+    urls: List[str]
+
+
+def preheat(
+    broker: JobQueue,
+    urls: Sequence[str],
+    scheduler_queues: Sequence[str],
+    *,
+    piece_size: int = 4 << 20,
+) -> PreheatJob:
+    """Fan a preheat of the URLs out to every target scheduler's queue."""
+    per_queue = {
+        q: {"urls": list(urls), "piece_size": piece_size} for q in scheduler_queues
+    }
+    group = broker.create_group_job(PREHEAT, per_queue)
+    return PreheatJob(group=group, urls=list(urls))
+
+
+def make_preheat_handler(seed_daemon, *, content_length_for=None):
+    """Handler for a scheduler's worker: seed daemon downloads each URL.
+
+    ``content_length_for(url)`` supplies origin sizes (HEAD request in a
+    wire deployment); defaults to one piece.
+    """
+
+    def handler(args: Dict) -> Dict:
+        results = {}
+        for url in args["urls"]:
+            cl = content_length_for(url) if content_length_for else args["piece_size"]
+            r = seed_daemon.download(
+                url, piece_size=args["piece_size"], content_length=cl
+            )
+            if not r.ok:
+                raise RuntimeError(f"preheat of {url} failed")
+            results[url] = r.pieces
+        return results
+
+    return handler

@@ -1,0 +1,170 @@
+"""Queue bus + workers + group-job state aggregation.
+
+Semantics from internal/job: jobs land on named queues (GLOBAL, per
+scheduler, per host — queue.go); workers consume concurrently; a group
+job's state is SUCCESS only when every member succeeded, FAILURE as soon
+as any member failed (job.go:111-147 GetGroupJobState).
+"""
+
+from __future__ import annotations
+
+import enum
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+GLOBAL_QUEUE = "global"
+
+
+class JobState(str, enum.Enum):
+    PENDING = "PENDING"
+    STARTED = "STARTED"
+    SUCCESS = "SUCCESS"
+    FAILURE = "FAILURE"
+
+
+@dataclass
+class Job:
+    id: str
+    type: str
+    queue: str
+    args: Dict[str, Any] = field(default_factory=dict)
+    group_id: Optional[str] = None
+    state: JobState = JobState.PENDING
+    result: Any = None
+    error: str = ""
+    created_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class GroupJob:
+    id: str
+    job_ids: List[str] = field(default_factory=list)
+
+    def state(self, jobs: Dict[str, Job]) -> JobState:
+        states = [jobs[j].state for j in self.job_ids if j in jobs]
+        if any(s is JobState.FAILURE for s in states):
+            return JobState.FAILURE
+        if all(s is JobState.SUCCESS for s in states) and states:
+            return JobState.SUCCESS
+        if any(s is JobState.STARTED for s in states):
+            return JobState.STARTED
+        return JobState.PENDING
+
+
+class JobQueue:
+    """The broker: named queues + job/group registry."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._queues: Dict[str, "queue.Queue[Job]"] = {}
+        self.jobs: Dict[str, Job] = {}
+        self.groups: Dict[str, GroupJob] = {}
+
+    def _q(self, name: str) -> "queue.Queue[Job]":
+        with self._mu:
+            if name not in self._queues:
+                self._queues[name] = queue.Queue()
+            return self._queues[name]
+
+    def enqueue(
+        self,
+        type: str,
+        args: Dict[str, Any],
+        *,
+        queue_name: str = GLOBAL_QUEUE,
+        group_id: Optional[str] = None,
+    ) -> Job:
+        job = Job(
+            id=uuid.uuid4().hex, type=type, queue=queue_name, args=args, group_id=group_id
+        )
+        with self._mu:
+            self.jobs[job.id] = job
+            if group_id is not None:
+                self.groups.setdefault(group_id, GroupJob(group_id)).job_ids.append(job.id)
+        self._q(queue_name).put(job)
+        return job
+
+    def create_group_job(
+        self, type: str, per_queue_args: Dict[str, Dict[str, Any]]
+    ) -> GroupJob:
+        """Fan one logical job out to many queues (machinery group jobs)."""
+        gid = uuid.uuid4().hex
+        with self._mu:
+            self.groups[gid] = GroupJob(gid)
+        for queue_name, args in per_queue_args.items():
+            self.enqueue(type, args, queue_name=queue_name, group_id=gid)
+        return self.groups[gid]
+
+    def group_state(self, group_id: str) -> JobState:
+        with self._mu:
+            group = self.groups.get(group_id)
+            if group is None:
+                raise KeyError(group_id)
+            return group.state(self.jobs)
+
+    def get(self, queue_name: str, timeout: Optional[float] = None) -> Optional[Job]:
+        try:
+            return self._q(queue_name).get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class Worker:
+    """Consumes one queue; handlers registered per job type
+    (scheduler/job/job.go:125 Serve with named consumers)."""
+
+    def __init__(self, broker: JobQueue, queue_name: str) -> None:
+        self.broker = broker
+        self.queue_name = queue_name
+        self._handlers: Dict[str, Callable[[Dict[str, Any]], Any]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, job_type: str, handler: Callable[[Dict[str, Any]], Any]) -> None:
+        self._handlers[job_type] = handler
+
+    def _run_job(self, job: Job) -> None:
+        handler = self._handlers.get(job.type)
+        if handler is None:
+            job.state = JobState.FAILURE
+            job.error = f"no handler for {job.type}"
+            return
+        job.state = JobState.STARTED
+        try:
+            job.result = handler(job.args)
+            job.state = JobState.SUCCESS
+        except Exception as exc:  # noqa: BLE001 — job errors land on the job record
+            job.state = JobState.FAILURE
+            job.error = str(exc)
+
+    def drain(self, timeout: float = 0.0) -> int:
+        """Synchronously process everything queued (tests / embedded mode)."""
+        n = 0
+        while True:
+            job = self.broker.get(self.queue_name, timeout=timeout)
+            if job is None:
+                return n
+            self._run_job(job)
+            n += 1
+
+    def serve(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                job = self.broker.get(self.queue_name, timeout=0.2)
+                if job is not None:
+                    self._run_job(job)
+
+        self._thread = threading.Thread(
+            target=loop, name=f"worker-{self.queue_name}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

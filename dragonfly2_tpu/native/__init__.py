@@ -127,6 +127,16 @@ class NativeColumnarWriter:
         self._lib = lib
         self.path = path
         self.columns = tuple(columns)
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            # Same contract as the Python writer: appending to an existing
+            # shard requires an identical column set (columnar.py:83-86).
+            from ..records.columnar import read_header
+
+            existing, _ = read_header(path)
+            if existing.columns != self.columns:
+                raise ValueError(
+                    f"{path}: existing columns {existing.columns} != {self.columns}"
+                )
         header = json.dumps(
             {"columns": list(self.columns), "dtype": "float32", "created_at_ns": 0}
         ).encode()
@@ -147,6 +157,12 @@ class NativeColumnarWriter:
         )
         if n < 0:
             raise NativeError(f"re_append -> {n}")
+        if n != rows.shape[0]:
+            # Short write (disk full): silently dropped rows would corrupt
+            # the shard for every downstream reader.
+            raise NativeError(
+                f"re_append wrote {n}/{rows.shape[0]} rows (disk full?)"
+            )
         return int(n)
 
     def flush(self) -> None:

@@ -66,10 +66,13 @@ struct RecordFile {
   uint32_t width = 0;       // columns
   int64_t data_offset = 0;
   std::mutex mu;
+  bool closed = false;  // re_close sets it; in-flight appends must bail
 };
 
+using RecordPtr = std::shared_ptr<RecordFile>;
+
 std::mutex g_records_mu;
-std::map<int64_t, RecordFile*> g_records;
+std::map<int64_t, RecordPtr> g_records;
 std::atomic<int64_t> g_next_handle{1};
 
 // ---------------------------------------------------------------------------
@@ -201,7 +204,7 @@ int64_t re_open(const char* path, const char* header_json, uint32_t width) {
   bool exists = stat(path, &st) == 0 && st.st_size > 0;
   FILE* f = fopen(path, exists ? "r+b" : "w+b");
   if (!f) return -1;
-  RecordFile* rf = new RecordFile();
+  RecordPtr rf = std::make_shared<RecordFile>();
   rf->f = f;
   rf->width = width;
   if (exists) {
@@ -210,11 +213,18 @@ int64_t re_open(const char* path, const char* header_json, uint32_t width) {
     if (fread(magic, 4, 1, f) != 1 || memcmp(magic, kMagic, 4) != 0 ||
         fread(&hlen, 4, 1, f) != 1) {
       fclose(f);
-      delete rf;
       return -2;
     }
     rf->data_offset = 8 + hlen;
+    // Width consistency against the existing payload: the data section
+    // must be a whole number of rows at the claimed width, else appends
+    // would land misaligned (the bindings also validate the header JSON).
     fseeko(f, 0, SEEK_END);
+    off_t payload = ftello(f) - rf->data_offset;
+    if (payload % (off_t)(sizeof(float) * width) != 0) {
+      fclose(f);
+      return -3;
+    }
   } else {
     uint32_t hlen = (uint32_t)strlen(header_json);
     fwrite(kMagic, 4, 1, f);
@@ -230,40 +240,50 @@ int64_t re_open(const char* path, const char* header_json, uint32_t width) {
 }
 
 int64_t re_append(int64_t handle, const float* rows, int64_t n_rows) {
-  RecordFile* rf;
+  RecordPtr rf;
+  {
+    std::lock_guard<std::mutex> lk(g_records_mu);
+    auto it = g_records.find(handle);
+    if (it == g_records.end()) return -1;
+    rf = it->second;  // shared_ptr outlives a concurrent re_close
+  }
+  std::lock_guard<std::mutex> lk(rf->mu);
+  if (rf->closed) return -2;
+  size_t wrote = fwrite(rows, sizeof(float) * rf->width, n_rows, rf->f);
+  return (int64_t)wrote;
+}
+
+int re_flush(int64_t handle) {
+  RecordPtr rf;
   {
     std::lock_guard<std::mutex> lk(g_records_mu);
     auto it = g_records.find(handle);
     if (it == g_records.end()) return -1;
     rf = it->second;
   }
-  std::lock_guard<std::mutex> lk(rf->mu);
-  size_t wrote = fwrite(rows, sizeof(float) * rf->width, n_rows, rf->f);
-  return (int64_t)wrote;
-}
-
-int re_flush(int64_t handle) {
-  std::lock_guard<std::mutex> lk(g_records_mu);
-  auto it = g_records.find(handle);
-  if (it == g_records.end()) return -1;
-  std::lock_guard<std::mutex> lk2(it->second->mu);
-  fflush(it->second->f);
+  std::lock_guard<std::mutex> lk2(rf->mu);
+  if (rf->closed) return -2;
+  fflush(rf->f);
   return 0;
 }
 
 int64_t re_rows(int64_t handle) {
-  std::lock_guard<std::mutex> lk(g_records_mu);
-  auto it = g_records.find(handle);
-  if (it == g_records.end()) return -1;
-  RecordFile* rf = it->second;
+  RecordPtr rf;
+  {
+    std::lock_guard<std::mutex> lk(g_records_mu);
+    auto it = g_records.find(handle);
+    if (it == g_records.end()) return -1;
+    rf = it->second;
+  }
   std::lock_guard<std::mutex> lk2(rf->mu);
+  if (rf->closed) return -2;
   fflush(rf->f);
   off_t end = ftello(rf->f);
   return (end - rf->data_offset) / (sizeof(float) * rf->width);
 }
 
 int re_close(int64_t handle) {
-  RecordFile* rf;
+  RecordPtr rf;
   {
     std::lock_guard<std::mutex> lk(g_records_mu);
     auto it = g_records.find(handle);
@@ -271,8 +291,11 @@ int re_close(int64_t handle) {
     rf = it->second;
     g_records.erase(it);
   }
-  fclose(rf->f);
-  delete rf;
+  std::lock_guard<std::mutex> lk(rf->mu);
+  if (!rf->closed) {
+    fclose(rf->f);
+    rf->closed = true;
+  }
   return 0;
 }
 

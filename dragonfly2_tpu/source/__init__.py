@@ -1,0 +1,20 @@
+"""Back-to-source protocol clients (reference: pkg/source/).
+
+A registry of scheme → client (the reference loads http, s3, oss, hdfs,
+oci clients via pkg/source/loader); each client answers content length
+and range reads, and ``PieceSourceFetcher`` adapts any client to the
+conductor's piece interface.
+
+Shipped clients: ``file`` (local paths; also the e2e fixture transport)
+and ``http/https`` (urllib range GETs).  Object-store schemes register at
+deploy time the way the reference's plugin loader does.
+"""
+
+from .client import (  # noqa: F401
+    FileSourceClient,
+    HTTPSourceClient,
+    PieceSourceFetcher,
+    SourceClient,
+    SourceRegistry,
+    default_registry,
+)

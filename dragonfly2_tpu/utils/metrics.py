@@ -1,0 +1,173 @@
+"""Prometheus-style metrics registry (reference: scheduler/metrics/,
+trainer/metrics/, grpc_prometheus interceptors).
+
+Counters/gauges/histograms with label support and text exposition
+(Prometheus format), dependency-free.  Services define their metric sets
+at module scope the way the reference does (metrics.go:44-180).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()) -> None:
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._mu = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != {sorted(self.label_names)}"
+            )
+        return tuple(labels[n] for n in self.label_names)
+
+    def _fmt_labels(self, key: Tuple[str, ...]) -> str:
+        if not key:
+            return ""
+        inner = ",".join(f'{n}="{v}"' for n, v in zip(self.label_names, key))
+        return "{" + inner + "}"
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()) -> None:
+        super().__init__(name, help, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = self._key(labels)
+        with self._mu:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._mu:
+            return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._mu:
+            for key, v in sorted(self._values.items()):
+                out.append(f"{self.name}{self._fmt_labels(key)} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()) -> None:
+        super().__init__(name, help, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._mu:
+            self._values[self._key(labels)] = value
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._mu:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: str) -> float:
+        with self._mu:
+            return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._mu:
+            for key, v in sorted(self._values.items()):
+                out.append(f"{self.name}{self._fmt_labels(key)} {v}")
+        return out
+
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+
+
+class Histogram(_Metric):
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        label_names: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> None:
+        super().__init__(name, help, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._mu:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            idx = bisect.bisect_left(self.buckets, value)
+            for i in range(idx, len(self.buckets)):
+                counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._mu:
+            for key, counts in sorted(self._counts.items()):
+                base = self._fmt_labels(key)[1:-1] if key else ""
+                for le, c in zip(self.buckets, counts):
+                    sep = "," if base else ""
+                    out.append(f'{self.name}_bucket{{{base}{sep}le="{le}"}} {c}')
+                sep = "," if base else ""
+                out.append(f'{self.name}_bucket{{{base}{sep}le="+Inf"}} {self._totals[key]}')
+                lbl = "{" + base + "}" if base else ""
+                out.append(f"{self.name}_sum{lbl} {self._sums[key]}")
+                out.append(f"{self.name}_count{lbl} {self._totals[key]}")
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def counter(self, name: str, help: str = "", label_names: Sequence[str] = ()) -> Counter:
+        return self._register(Counter(name, help, label_names))
+
+    def gauge(self, name: str, help: str = "", label_names: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge(name, help, label_names))
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        label_names: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._register(Histogram(name, help, label_names, buckets))
+
+    def _register(self, metric):
+        with self._mu:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if type(existing) is not type(metric):
+                    raise ValueError(f"metric {metric.name} re-registered as different type")
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def expose_text(self) -> str:
+        with self._mu:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+# Process-default registry (services may create their own for isolation).
+default_registry = Registry()

@@ -306,3 +306,40 @@ class TestReviewRegressions:
         assert d0b.reload() == 1
         holders = swarm.daemons[1].pex.find_peers_with_piece(tid, 299)
         assert holders == ["host-0"]
+
+    def test_reclaim_retracts_pex_advertisement(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        url = "https://origin/evictable"
+        r = swarm.daemons[0].download(url, piece_size=PIECE, content_length=2 * PIECE)
+        assert swarm.daemons[1].pex.find_peers_with_task(r.task_id) == ["host-0"]
+        swarm.daemons[0].delete_task(r.task_id)
+        assert swarm.daemons[1].pex.find_peers_with_task(r.task_id) == []
+
+    def test_back_to_source_resumes_not_restarts(self, tmp_path):
+        """P2P pieces already on disk are not re-fetched from the origin,
+        and their parent attribution survives."""
+        swarm = _Swarm(tmp_path)
+        url = "https://origin/resume"
+        swarm.daemons[0].download(url, piece_size=PIECE, content_length=4 * PIECE)
+        fetches_before = swarm.origin.fetches
+
+        # Child daemon: manually drive the conductor so the parent dies
+        # mid-download (after serving half the pieces).
+        child = swarm.daemons[1]
+        reg = swarm.scheduler.register_peer(host=child.host, url=url)
+        task = reg.peer.task
+        child.storage.register_task(task.id, piece_size=PIECE, content_length=task.content_length)
+        parents = reg.schedule.parents
+        for n in (0, 1):
+            data = child.conductor.piece_fetcher.fetch(parents[0].host.id, task.id, n)
+            child.storage.write_piece(task.id, n, data)
+            swarm.scheduler.report_piece_finished(
+                reg.peer, n, parent_id=parents[0].id, length=len(data), cost_ns=1000
+            )
+        # Origin serves only the remaining pieces.
+        res = child.conductor._pull_from_source(reg.peer, 4, PIECE, 0.0)
+        assert res.ok
+        assert swarm.origin.fetches == fetches_before + 2  # pieces 2,3 only
+        # Parent attribution for pieces 0,1 intact on the peer record.
+        assert reg.peer.pieces[0].parent_id == parents[0].id
+        assert reg.peer.pieces[2].parent_id == ""

@@ -1,0 +1,226 @@
+"""Platform layer tests: config, metrics, logging, jobs/preheat, source
+clients, CLI smoke."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.config import (
+    ConfigError,
+    SchedulerConfigFile,
+    TrainerConfigFile,
+    load_config,
+)
+from dragonfly2_tpu.jobs import JobQueue, JobState, Worker, preheat
+from dragonfly2_tpu.jobs.preheat import PREHEAT, make_preheat_handler
+from dragonfly2_tpu.source import FileSourceClient, PieceSourceFetcher, default_registry
+from dragonfly2_tpu.utils.metrics import Registry
+
+
+class TestConfig:
+    def test_defaults_valid(self):
+        cfg = load_config(SchedulerConfigFile, None, env=False)
+        assert cfg.scheduling.candidate_parent_limit == 4
+        assert cfg.scheduling.filter_parent_limit == 15
+        assert cfg.network_topology.probe_count == 5
+        assert cfg.trainer.interval_s == 7 * 24 * 3600.0
+
+    def test_yaml_load_and_validate(self, tmp_path):
+        path = tmp_path / "s.yaml"
+        path.write_text(
+            "scheduling:\n  algorithm: nt\n  candidate_parent_limit: 8\n"
+            "  filter_parent_limit: 20\nserver:\n  port: 9999\n"
+        )
+        cfg = load_config(SchedulerConfigFile, str(path), env=False)
+        assert cfg.scheduling.algorithm == "nt"
+        assert cfg.server.port == 9999
+
+    def test_invalid_rejected(self, tmp_path):
+        path = tmp_path / "bad.yaml"
+        path.write_text("scheduling:\n  algorithm: quantum\n")
+        with pytest.raises(ConfigError):
+            load_config(SchedulerConfigFile, str(path), env=False)
+        path.write_text("scheduling:\n  candidate_parent_limit: 99\n")
+        with pytest.raises(ConfigError):
+            load_config(SchedulerConfigFile, str(path), env=False)
+        path.write_text("nonsense_key: 1\n")
+        with pytest.raises(ConfigError):
+            load_config(SchedulerConfigFile, str(path), env=False)
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("DRAGONFLY_TRAINER_TRAINING_EPOCHS", "99")
+        monkeypatch.setenv("DRAGONFLY_TRAINER_METRICS_ENABLE", "false")
+        cfg = load_config(TrainerConfigFile, None)
+        assert cfg.training.epochs == 99
+        assert cfg.metrics.enable is False
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self):
+        reg = Registry()
+        c = reg.counter("requests_total", "reqs", ["code"])
+        c.inc(code="200")
+        c.inc(2, code="500")
+        assert c.value(code="500") == 2
+        with pytest.raises(ValueError):
+            c.inc(-1, code="200")
+        g = reg.gauge("peers", "live peers")
+        g.set(5)
+        g.dec()
+        assert g.value() == 4
+        h = reg.histogram("latency_seconds", "lat", buckets=(0.1, 1, 10))
+        h.observe(0.05)
+        h.observe(5)
+        text = reg.expose_text()
+        assert 'requests_total{code="500"} 2' in text
+        assert "# TYPE latency_seconds histogram" in text
+        assert 'latency_seconds_bucket{le="0.1"} 1' in text
+        assert 'latency_seconds_bucket{le="+Inf"} 2' in text
+
+    def test_reregistration_returns_same(self):
+        reg = Registry()
+        a = reg.counter("x", "x")
+        b = reg.counter("x", "x")
+        assert a is b
+        with pytest.raises(ValueError):
+            reg.gauge("x", "x")
+
+
+class TestJobs:
+    def test_group_job_aggregation(self):
+        broker = JobQueue()
+        group = broker.create_group_job(
+            "t", {"q1": {"v": 1}, "q2": {"v": 2}}
+        )
+        assert broker.group_state(group.id) is JobState.PENDING
+        w1, w2 = Worker(broker, "q1"), Worker(broker, "q2")
+        w1.register("t", lambda args: args["v"])
+        w2.register("t", lambda args: args["v"])
+        assert w1.drain() == 1
+        assert broker.group_state(group.id) is JobState.PENDING  # q2 pending
+        assert w2.drain() == 1
+        assert broker.group_state(group.id) is JobState.SUCCESS
+
+    def test_group_failure_propagates(self):
+        broker = JobQueue()
+        group = broker.create_group_job("t", {"q1": {}, "q2": {}})
+        w1, w2 = Worker(broker, "q1"), Worker(broker, "q2")
+        w1.register("t", lambda args: None)
+
+        def boom(args):
+            raise RuntimeError("nope")
+
+        w2.register("t", boom)
+        w1.drain()
+        w2.drain()
+        assert broker.group_state(group.id) is JobState.FAILURE
+
+    def test_preheat_warms_seed_daemon(self, tmp_path):
+        from tests.test_daemon import _Swarm
+
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        broker = JobQueue()
+        worker = Worker(broker, "scheduler-1")
+        worker.register(
+            PREHEAT,
+            make_preheat_handler(
+                swarm.daemons[0], content_length_for=lambda url: 2 * 65536
+            ),
+        )
+        job = preheat(
+            broker,
+            ["https://origin/preheat-me"],
+            ["scheduler-1"],
+            piece_size=65536,
+        )
+        worker.drain()
+        assert broker.group_state(job.group.id) is JobState.SUCCESS
+        # The content is now warm: a fresh peer downloads P2P.
+        r = swarm.daemons[1].download("https://origin/preheat-me", piece_size=65536)
+        assert r.ok and not r.back_to_source
+
+
+class TestSource:
+    def test_file_client_roundtrip(self, tmp_path):
+        path = tmp_path / "blob.bin"
+        payload = bytes(range(256)) * 100
+        path.write_bytes(payload)
+        fetcher = PieceSourceFetcher()
+        url = f"file://{path}"
+        assert fetcher.content_length(url) == len(payload)
+        assert fetcher.fetch(url, 0, 1000) == payload[:1000]
+        assert fetcher.fetch(url, 3, 1000) == payload[3000:4000]
+
+    def test_unknown_scheme_raises(self):
+        with pytest.raises(KeyError):
+            default_registry.client_for("s3://bucket/key")
+
+
+class TestCLI:
+    def test_dfget_file_url(self, tmp_path, capsys):
+        from dragonfly2_tpu.cli.dfget import run as dfget
+
+        src = tmp_path / "src.bin"
+        payload = os.urandom(300_000)
+        src.write_bytes(payload)
+        out = tmp_path / "out.bin"
+        rc = dfget(
+            [
+                f"file://{src}",
+                "-O", str(out),
+                "--piece-size", "65536",
+                "--work-dir", str(tmp_path / "work"),
+            ]
+        )
+        assert rc == 0
+        assert out.read_bytes() == payload
+
+    def test_dfcache_import_stat_export(self, tmp_path, capsys):
+        from dragonfly2_tpu.cli.dfcache import run as dfcache
+
+        src = tmp_path / "artifact.bin"
+        payload = os.urandom(150_000)
+        src.write_bytes(payload)
+        work = str(tmp_path / "cache")
+        assert dfcache(["import", str(src), "--work-dir", work, "--piece-size", "65536"]) == 0
+        cache_id = capsys.readouterr().out.split(" as ")[1].split(" ")[0]
+        assert dfcache(["stat", cache_id, "--work-dir", work]) == 0
+        out = tmp_path / "restored.bin"
+        assert dfcache(["export", cache_id, "-O", str(out), "--work-dir", work]) == 0
+        assert out.read_bytes() == payload
+
+    def test_scheduler_simulate(self, tmp_path, capsys):
+        from dragonfly2_tpu.cli.scheduler import run as sched
+
+        cfg = tmp_path / "s.yaml"
+        cfg.write_text(f"storage:\n  dir: {tmp_path}/records\n")
+        rc = sched(["--config", str(cfg), "--simulate", "40"])
+        assert rc == 0
+        assert "download records" in capsys.readouterr().out
+
+    def test_trainer_train_once(self, tmp_path, capsys, cluster):
+        from dragonfly2_tpu.cli.trainer import run as trainer
+        from dragonfly2_tpu.records.columnar import ColumnarWriter
+        from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
+
+        shard_dir = tmp_path / "shards"
+        shard_dir.mkdir()
+        rows = cluster.generate_feature_rows(2000, seed=5)
+        with ColumnarWriter(str(shard_dir / "download-0.dfc"), DOWNLOAD_COLUMNS) as w:
+            w.append(rows)
+        cfg = tmp_path / "t.yaml"
+        cfg.write_text("training:\n  epochs: 3\n")
+        rc = trainer(["--config", str(cfg), "--train-once", str(shard_dir)])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "registered parent-bandwidth-mlp v1" in out
+
+    def test_manager_list_models(self, tmp_path, capsys):
+        from dragonfly2_tpu.cli.manager import run as manager
+
+        cfg = tmp_path / "m.yaml"
+        cfg.write_text(f"registry:\n  blob_dir: {tmp_path}/blobs\n")
+        assert manager(["--config", str(cfg), "--list-models"]) == 0
+        assert "registry empty" in capsys.readouterr().out
