@@ -84,17 +84,17 @@ class Conductor:
         peer = reg.peer
         task = peer.task
 
-        # First peer in the swarm learns content length from the origin.
+        # First peer in the swarm learns content length from the origin and
+        # reports it through the scheduler API (so remote schedulers learn).
         if task.content_length < 0:
             if content_length is None:
                 return self._fail(peer, t0, "unknown content length")
-            task.content_length = content_length
-            task.total_piece_count = (
+            n_pieces = (
                 expected_pieces
                 if expected_pieces is not None
                 else (content_length + piece_size - 1) // piece_size
             )
-            task.piece_size = piece_size
+            self.scheduler.set_task_info(peer, content_length, n_pieces, piece_size)
         piece_size = task.piece_size or piece_size
         n_pieces = task.total_piece_count
 
@@ -168,9 +168,7 @@ class Conductor:
         task = peer.task
         if self.source_fetcher is None:
             return self._fail(peer, t0, "no source fetcher")
-        if peer.fsm.can("DownloadBackToSource"):
-            peer.fsm.event("DownloadBackToSource")
-        task.back_to_source_peers.add(peer.id)
+        self.scheduler.mark_back_to_source(peer)
         nbytes = 0
         for number in range(n_pieces):
             # Resume, don't restart: pieces already fetched from parents

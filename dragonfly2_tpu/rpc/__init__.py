@@ -1,0 +1,24 @@
+"""Wire transport (reference: pkg/rpc — the distributed communication
+backend, SURVEY §5.8).
+
+The reference's fabric is gRPC streams for control + HTTP ranges for piece
+data + a consistent-hashing client balancer.  Same split here, stdlib-only:
+
+- ``scheduler_server`` / ``scheduler_client`` — HTTP/JSON control plane
+  binding the real SchedulerService; the client maintains local mirrors of
+  Host/Task/Peer so the daemon's Conductor runs unchanged against a remote
+  scheduler.
+- ``piece_transport`` — HTTP piece data plane: a threading server over the
+  daemon's UploadManager (GET /pieces/<task>/<n>, Range supported) and the
+  matching fetcher.
+- ``balancer``  — consistent-hash ring: task-affine scheduler pick
+  (pkg/balancer/consistent_hashing.go).
+- ``retry``     — exponential backoff for client calls
+  (pkg/rpc retry interceptors).
+"""
+
+from .balancer import HashRing  # noqa: F401
+from .piece_transport import HTTPPieceFetcher, PieceHTTPServer  # noqa: F401
+from .retry import retry_call  # noqa: F401
+from .scheduler_client import RemoteScheduler  # noqa: F401
+from .scheduler_server import SchedulerHTTPServer  # noqa: F401

@@ -1,0 +1,157 @@
+"""HTTP piece data plane (reference: client/daemon/upload's HTTP piece
+server + the daemon's piece-download HTTP client).
+
+Server: GET /pieces/<task_id>/<number> → piece bytes (whole-piece), plus
+GET /tasks/<task_id> with a Range header → assembled byte range
+(upload_manager.go range semantics).  503 when the upload concurrency cap
+is hit, 404 for missing pieces — the conductor treats both as piece
+failures and reschedules.
+
+Client: HTTPPieceFetcher resolves a parent host id to its announced
+(ip, download_port) — carried in the scheduler's parent responses — and
+range-GETs pieces with retry/backoff.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+from ..daemon.upload import UploadBusy, UploadManager
+from .retry import retry_call
+
+
+class PieceHTTPServer:
+    def __init__(self, upload: UploadManager, host: str = "127.0.0.1", port: int = 0):
+        self.upload = upload
+        upload_ref = upload
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str = "application/octet-stream"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                try:
+                    if len(parts) == 3 and parts[0] == "pieces":
+                        task_id, number = parts[1], int(parts[2])
+                        data = upload_ref.serve_piece(task_id, number)
+                        self._send(200, data)
+                        return
+                    if len(parts) == 2 and parts[0] == "tasks":
+                        task_id = parts[1]
+                        rng = self.headers.get("Range", "")
+                        if not rng.startswith("bytes="):
+                            self.send_error(416)
+                            return
+                        total = upload_ref.storage.engine.content_length(task_id)
+                        spec = rng[len("bytes=") :]
+                        try:
+                            start_s, end_s = spec.split("-", 1)
+                            if start_s == "":      # suffix: bytes=-N
+                                length = int(end_s)
+                                start, end = max(total - length, 0), total - 1
+                            elif end_s == "":      # open end: bytes=S-
+                                start, end = int(start_s), total - 1
+                            else:
+                                start, end = int(start_s), int(end_s)
+                        except ValueError:
+                            self.send_error(416)
+                            return
+                        if total >= 0:
+                            end = min(end, total - 1)
+                        if start > end:
+                            self.send_error(416)
+                            return
+                        piece_size = upload_ref.storage.engine.piece_size(task_id)
+                        data = upload_ref.serve_range(
+                            task_id, start, end - start + 1, piece_size
+                        )
+                        self._send(206, data)
+                        return
+                    self.send_error(404)
+                except UploadBusy:
+                    self.send_error(503)
+                except KeyError:
+                    self.send_error(404)
+                except Exception:  # noqa: BLE001 — wire boundary
+                    self.send_error(500)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.address: Tuple[str, int] = self._httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    def serve(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="piece-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class HTTPPieceFetcher:
+    """Conductor's PieceFetcher over HTTP.
+
+    ``resolve(host_id) → (ip, port)``: in the wire flow the scheduler's
+    parent entries carry the announced address (scheduler_client mirrors
+    them into Host objects); an explicit table also works for tests.
+    """
+
+    def __init__(
+        self,
+        resolve: Callable[[str], Tuple[str, int]],
+        *,
+        timeout: float = 30.0,
+    ):
+        self._resolve = resolve
+        self.timeout = timeout
+
+    def fetch(self, parent_host_id: str, task_id: str, number: int) -> bytes:
+        ip, port = self._resolve(parent_host_id)
+        url = f"http://{ip}:{port}/pieces/{task_id}/{number}"
+
+        class _PieceUnavailable(Exception):
+            pass
+
+        def once() -> bytes:
+            try:
+                with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+                    return resp.read()
+            except urllib.error.HTTPError as exc:
+                if exc.code == 503:
+                    raise ConnectionError("parent busy") from exc  # retried
+                # 404 etc.: permanent for this parent — fail immediately so
+                # the conductor reschedules (HTTPError is an OSError
+                # subclass, which retry_call's default would retry).
+                raise _PieceUnavailable(f"HTTP {exc.code} from {url}") from exc
+
+        return retry_call(once, attempts=2, retry_on=(ConnectionError, TimeoutError))
+
+
+def resolver_from_hosts(hosts: Dict[str, "object"]) -> Callable[[str], Tuple[str, int]]:
+    """Resolve from a host-id → Host mapping (the client's mirror table)."""
+
+    def resolve(host_id: str) -> Tuple[str, int]:
+        host = hosts[host_id]
+        return host.ip, host.download_port
+
+    return resolve

@@ -1,0 +1,33 @@
+"""Retry with exponential backoff (reference: pkg/retry + the rpc clients'
+retry interceptors, pkg/rpc/interceptor.go)."""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def retry_call(
+    fn: Callable[[], T],
+    *,
+    attempts: int = 3,
+    base_delay: float = 0.1,
+    max_delay: float = 2.0,
+    retry_on: Tuple[Type[BaseException], ...] = (ConnectionError, TimeoutError, OSError),
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    last: BaseException | None = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except retry_on as exc:  # noqa: PERF203
+            last = exc
+            if i == attempts - 1:
+                break
+            delay = min(base_delay * (2**i), max_delay)
+            sleep(delay * (0.5 + random.random() / 2))  # jitter
+    assert last is not None
+    raise last

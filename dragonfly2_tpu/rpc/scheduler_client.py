@@ -1,0 +1,218 @@
+"""RemoteScheduler: the client side of the scheduler wire API.
+
+Implements the SchedulerService surface the daemon's Conductor uses
+(register_peer / report_* / sync_probes_*) by forwarding over HTTP and
+maintaining **local mirrors** of Host/Task/Peer — real resource classes —
+so the conductor's code path is identical in embedded and remote modes
+(the reference daemon likewise keeps local peer state synchronized with
+the scheduler's view through the gRPC stream).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..scheduler.resource import Host, Peer, Task
+from ..scheduler.scheduling import ScheduleResult, ScheduleResultKind
+from ..scheduler.service import RegisterResult
+from ..utils.types import SizeScope
+from .retry import retry_call
+from .scheduler_server import host_from_wire, host_to_wire
+
+
+class RPCError(RuntimeError):
+    pass
+
+
+class RemoteScheduler:
+    def __init__(self, base_url: str, *, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._mu = threading.Lock()
+        self._tasks: Dict[str, Task] = {}
+        self._hosts: Dict[str, Host] = {}
+        self._peers: Dict[str, Peer] = {}
+        # Remote transport has no probe store mirrored locally.
+        self.networktopology = None
+
+    # -- wire ---------------------------------------------------------------
+
+    def _call(self, method: str, req: dict) -> dict:
+        def once() -> dict:
+            body = json.dumps(req).encode()
+            http_req = urllib.request.Request(
+                f"{self.base_url}/rpc/{method}",
+                data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(http_req, timeout=self.timeout) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as exc:
+                payload = exc.read()
+                try:
+                    message = json.loads(payload).get("error", "")
+                except json.JSONDecodeError:
+                    message = payload[:200].decode(errors="replace")
+                raise RPCError(f"{method}: HTTP {exc.code}: {message}") from exc
+
+        import urllib.error
+
+        return retry_call(once, retry_on=(ConnectionError, TimeoutError, OSError))
+
+    # -- mirrors ------------------------------------------------------------
+
+    def _mirror_host(self, data: dict) -> Host:
+        with self._mu:
+            existing = self._hosts.get(data["id"])
+            if existing is not None:
+                # Refresh addresses: the server's parent entries carry the
+                # host's CURRENT announce (a restarted daemon has a new
+                # download_port) and resolve_host must follow it.
+                existing.ip = data.get("ip", existing.ip)
+                existing.port = data.get("port", existing.port)
+                existing.download_port = data.get(
+                    "download_port", existing.download_port
+                )
+                return existing
+            host = host_from_wire(data)
+            self._hosts[host.id] = host
+            return host
+
+    def _mirror_task(self, task_id: str, url: str) -> Task:
+        with self._mu:
+            task = self._tasks.get(task_id)
+            if task is None:
+                task = Task(task_id, url)
+                self._tasks[task_id] = task
+            return task
+
+    def _mirror_parent(self, task: Task, data: dict) -> Peer:
+        with self._mu:
+            peer = self._peers.get(data["peer_id"])
+        if peer is None:
+            host = self._mirror_host(data["host"])
+            peer = Peer(data["peer_id"], task, host)
+            # Mirror state: remote parents are serveable by definition.
+            peer.fsm.set_state("Running")
+            with self._mu:
+                self._peers[peer.id] = peer
+        return peer
+
+    # -- SchedulerService surface -------------------------------------------
+
+    def announce_host(self, host: Host) -> None:
+        self._call("announce_host", {"host": host_to_wire(host)})
+        with self._mu:
+            self._hosts[host.id] = host
+
+    def register_peer(self, *, host: Host, url: str, **kwargs) -> RegisterResult:
+        self.announce_host(host)
+        resp = self._call(
+            "register_peer",
+            {"host_id": host.id, "url": url,
+             "tag": kwargs.get("tag", ""), "application": kwargs.get("application", "")},
+        )
+        task = self._mirror_task(resp["task_id"], url)
+        task.content_length = resp["content_length"]
+        task.total_piece_count = resp["total_piece_count"]
+        task.piece_size = resp.get("piece_size", 0)
+        peer = Peer(resp["peer_id"], task, host)
+        peer.fsm.set_state("ReceivedNormal")
+        with self._mu:
+            self._peers[peer.id] = peer
+
+        schedule: Optional[ScheduleResult] = None
+        if resp.get("need_back_to_source"):
+            schedule = ScheduleResult(kind=ScheduleResultKind.NEED_BACK_TO_SOURCE)
+        elif resp.get("failed"):
+            schedule = ScheduleResult(kind=ScheduleResultKind.FAILED)
+        elif resp.get("parents"):
+            parents = [self._mirror_parent(task, p) for p in resp["parents"]]
+            schedule = ScheduleResult(kind=ScheduleResultKind.PARENTS, parents=parents)
+        else:
+            schedule = ScheduleResult(kind=ScheduleResultKind.NEED_BACK_TO_SOURCE)
+        return RegisterResult(
+            peer=peer, size_scope=SizeScope(resp["size_scope"]), schedule=schedule
+        )
+
+    def set_task_info(
+        self, peer: Peer, content_length: int, total_piece_count: int, piece_size: int
+    ) -> None:
+        resp = self._call(
+            "set_task_info",
+            {
+                "peer_id": peer.id,
+                "content_length": content_length,
+                "total_piece_count": total_piece_count,
+                "piece_size": piece_size,
+            },
+        )
+        task = peer.task
+        task.content_length = resp["content_length"]
+        task.total_piece_count = resp["total_piece_count"]
+        task.piece_size = resp["piece_size"]
+
+    def report_piece_finished(
+        self, peer: Peer, number: int, *, parent_id: str = "", length: int = 0, cost_ns: int = 0
+    ) -> None:
+        peer.finish_piece(number, cost_ns, parent_id=parent_id, length=length)
+        self._call(
+            "report_piece_finished",
+            {"peer_id": peer.id, "number": number, "parent_id": parent_id,
+             "length": length, "cost_ns": cost_ns},
+        )
+
+    def report_piece_failed(self, peer: Peer, parent_id: str) -> ScheduleResult:
+        peer.block_parents.add(parent_id)
+        resp = self._call(
+            "report_piece_failed", {"peer_id": peer.id, "parent_id": parent_id}
+        )
+        if resp.get("parents"):
+            parents = [self._mirror_parent(peer.task, p) for p in resp["parents"]]
+            return ScheduleResult(kind=ScheduleResultKind.PARENTS, parents=parents)
+        if resp.get("need_back_to_source"):
+            return ScheduleResult(kind=ScheduleResultKind.NEED_BACK_TO_SOURCE)
+        return ScheduleResult(kind=ScheduleResultKind.FAILED)
+
+    def report_peer_finished(self, peer: Peer) -> None:
+        if peer.fsm.can("DownloadSucceeded"):
+            peer.fsm.event("DownloadSucceeded")
+        self._call("report_peer_finished", {"peer_id": peer.id})
+
+    def report_peer_failed(self, peer: Peer) -> None:
+        if peer.fsm.can("DownloadFailed"):
+            peer.fsm.event("DownloadFailed")
+        self._call("report_peer_failed", {"peer_id": peer.id})
+
+    def mark_back_to_source(self, peer: Peer) -> None:
+        if peer.fsm.can("DownloadBackToSource"):
+            peer.fsm.event("DownloadBackToSource")
+        peer.task.back_to_source_peers.add(peer.id)
+        self._call("mark_back_to_source", {"peer_id": peer.id})
+
+    def leave_peer(self, peer: Peer) -> None:
+        if peer.fsm.can("Leave"):
+            peer.fsm.event("Leave")
+        self._call("leave_peer", {"peer_id": peer.id})
+
+    def resolve_host(self, host_id: str) -> Tuple[str, int]:
+        """host id → (ip, download_port) from the mirror table — the piece
+        fetcher's address resolver."""
+        with self._mu:
+            host = self._hosts[host_id]
+        return host.ip, host.download_port
+
+    def sync_probes_start(self, host: Host) -> List[Host]:
+        resp = self._call("sync_probes_start", {"host_id": host.id})
+        return [self._mirror_host(t) for t in resp.get("targets", [])]
+
+    def sync_probes_finished(self, host: Host, results: List[Tuple[str, int]]) -> None:
+        self._call(
+            "sync_probes_finished",
+            {"host_id": host.id, "results": [[d, int(r)] for d, r in results]},
+        )

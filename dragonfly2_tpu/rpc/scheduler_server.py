@@ -1,0 +1,285 @@
+"""Scheduler HTTP/JSON server: the wire binding of SchedulerService.
+
+Reference counterpart: scheduler/rpcserver + pkg/rpc/scheduler/server —
+a gRPC surface over the service layer.  Here the same service methods are
+exposed as POST /rpc/<method> with JSON bodies (stdlib ThreadingHTTPServer;
+a gRPC binding can sit on the identical adapter).  The server owns the
+authoritative Host/Task/Peer state; clients hold ids.
+
+Wire methods:
+  announce_host      {host: {...stats}}                 → {}
+  register_peer      {host_id, url, peer_id?, ...}      → registration view
+  set_task_info      {task_id, content_length, total_piece_count, piece_size}
+  report_piece_finished / report_piece_failed / report_peer_finished /
+  report_peer_failed / leave_peer                        (by peer_id)
+  sync_probes_start  {host_id}                          → {targets: [...]}
+  sync_probes_finished {host_id, results: [[dest, rtt]]}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from ..scheduler.resource import Host, Peer
+from ..scheduler.scheduling import ScheduleResultKind
+from ..scheduler.service import SchedulerService
+from ..utils.types import HostType
+
+
+def host_from_wire(data: dict) -> Host:
+    h = Host(
+        id=data["id"],
+        hostname=data.get("hostname", ""),
+        ip=data.get("ip", ""),
+        port=data.get("port", 0),
+        download_port=data.get("download_port", 0),
+        type=HostType(data.get("type", 0)),
+        concurrent_upload_limit=data.get("concurrent_upload_limit", 50),
+    )
+    net = data.get("network", {})
+    h.stats.network.idc = net.get("idc", "")
+    h.stats.network.location = net.get("location", "")
+    h.stats.cpu.percent = data.get("cpu_percent", 0.0)
+    h.stats.memory.used_percent = data.get("mem_used_percent", 0.0)
+    return h
+
+
+def host_to_wire(h: Host) -> dict:
+    return {
+        "id": h.id,
+        "hostname": h.hostname,
+        "ip": h.ip,
+        "port": h.port,
+        "download_port": h.download_port,
+        "type": int(h.type),
+        "concurrent_upload_limit": h.concurrent_upload_limit,
+        "network": {"idc": h.stats.network.idc, "location": h.stats.network.location},
+    }
+
+
+class SchedulerRPCAdapter:
+    """Maps wire dicts ↔ the in-memory service (transport-independent)."""
+
+    def __init__(self, service: SchedulerService) -> None:
+        self.service = service
+        self._mu = threading.Lock()
+        # Weak values: when the resource layer's GC reaps a peer, the wire
+        # mapping evaporates with it instead of leaking one entry per
+        # download for the scheduler's lifetime.
+        import weakref
+
+        self._peers: "weakref.WeakValueDictionary[str, Peer]" = (
+            weakref.WeakValueDictionary()
+        )
+
+    def _peer(self, peer_id: str) -> Peer:
+        with self._mu:
+            peer = self._peers.get(peer_id)
+        if peer is None:
+            raise KeyError(f"unknown peer {peer_id}")
+        return peer
+
+    def _track(self, peer: Peer) -> None:
+        with self._mu:
+            self._peers[peer.id] = peer
+
+    # -- methods -------------------------------------------------------------
+
+    def announce_host(self, req: dict) -> dict:
+        host = host_from_wire(req["host"])
+        stored = self.service.resource.store_host(host)
+        if stored is not host:
+            # Refresh announce-time stats AND addresses on the existing
+            # record — a restarted daemon announces a fresh download_port
+            # and children must not be handed the dead one.
+            stored.stats = host.stats
+            stored.concurrent_upload_limit = host.concurrent_upload_limit
+            stored.ip = host.ip
+            stored.port = host.port
+            stored.download_port = host.download_port
+            stored.touch()
+        return {}
+
+    def register_peer(self, req: dict) -> dict:
+        host = self.service.resource.host_manager.load(req["host_id"])
+        if host is None:
+            raise KeyError(f"unknown host {req['host_id']} (announce first)")
+        result = self.service.register_peer(
+            host=host,
+            url=req["url"],
+            peer_id=req.get("peer_id"),
+            task_id=req.get("task_id"),
+            tag=req.get("tag", ""),
+            application=req.get("application", ""),
+        )
+        peer = result.peer
+        self._track(peer)
+        task = peer.task
+        out = {
+            "peer_id": peer.id,
+            "task_id": task.id,
+            "size_scope": int(result.size_scope),
+            "content_length": task.content_length,
+            "total_piece_count": task.total_piece_count,
+            "piece_size": task.piece_size,
+            "need_back_to_source": False,
+            "parents": [],
+        }
+        if result.schedule is not None:
+            if result.schedule.kind is ScheduleResultKind.PARENTS:
+                out["parents"] = [
+                    {"peer_id": p.id, "host": host_to_wire(p.host)}
+                    for p in result.schedule.parents
+                ]
+            elif result.schedule.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
+                out["need_back_to_source"] = True
+            else:
+                out["failed"] = True
+        return out
+
+    def set_task_info(self, req: dict) -> dict:
+        peer = self._peer(req["peer_id"])
+        self.service.set_task_info(
+            peer,
+            int(req["content_length"]),
+            int(req["total_piece_count"]),
+            int(req.get("piece_size", 4 << 20)),
+        )
+        task = peer.task
+        return {
+            "content_length": task.content_length,
+            "total_piece_count": task.total_piece_count,
+            "piece_size": task.piece_size,
+        }
+
+    def report_piece_finished(self, req: dict) -> dict:
+        self.service.report_piece_finished(
+            self._peer(req["peer_id"]),
+            int(req["number"]),
+            parent_id=req.get("parent_id", ""),
+            length=int(req.get("length", 0)),
+            cost_ns=int(req.get("cost_ns", 0)),
+        )
+        return {}
+
+    def report_piece_failed(self, req: dict) -> dict:
+        res = self.service.report_piece_failed(
+            self._peer(req["peer_id"]), req.get("parent_id", "")
+        )
+        out = {"need_back_to_source": False, "parents": []}
+        if res.kind is ScheduleResultKind.PARENTS:
+            out["parents"] = [
+                {"peer_id": p.id, "host": host_to_wire(p.host)} for p in res.parents
+            ]
+        elif res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
+            out["need_back_to_source"] = True
+        return out
+
+    def report_peer_finished(self, req: dict) -> dict:
+        self.service.report_peer_finished(self._peer(req["peer_id"]))
+        return {}
+
+    def report_peer_failed(self, req: dict) -> dict:
+        self.service.report_peer_failed(self._peer(req["peer_id"]))
+        return {}
+
+    def mark_back_to_source(self, req: dict) -> dict:
+        self.service.mark_back_to_source(self._peer(req["peer_id"]))
+        return {}
+
+    def leave_peer(self, req: dict) -> dict:
+        self.service.leave_peer(self._peer(req["peer_id"]))
+        return {}
+
+    def sync_probes_start(self, req: dict) -> dict:
+        host = self.service.resource.host_manager.load(req["host_id"])
+        if host is None:
+            return {"targets": []}
+        targets = self.service.sync_probes_start(host)
+        return {"targets": [host_to_wire(t) for t in targets]}
+
+    def sync_probes_finished(self, req: dict) -> dict:
+        host = self.service.resource.host_manager.load(req["host_id"])
+        if host is not None:
+            self.service.sync_probes_finished(
+                host, [(d, int(r)) for d, r in req.get("results", [])]
+            )
+        return {}
+
+    METHODS = frozenset(
+        {
+            "announce_host",
+            "register_peer",
+            "set_task_info",
+            "report_piece_finished",
+            "report_piece_failed",
+            "report_peer_finished",
+            "report_peer_failed",
+            "mark_back_to_source",
+            "leave_peer",
+            "sync_probes_start",
+            "sync_probes_finished",
+        }
+    )
+
+    def dispatch(self, method: str, req: dict) -> dict:
+        if method not in self.METHODS:
+            raise KeyError(f"unknown method {method}")
+        return getattr(self, method)(req)
+
+
+class SchedulerHTTPServer:
+    """POST /rpc/<method> with JSON bodies over ThreadingHTTPServer."""
+
+    def __init__(self, service: SchedulerService, host: str = "127.0.0.1", port: int = 0):
+        self.adapter = SchedulerRPCAdapter(service)
+        adapter = self.adapter
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_POST(self):
+                if not self.path.startswith("/rpc/"):
+                    self.send_error(404)
+                    return
+                method = self.path[len("/rpc/") :]
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    resp = adapter.dispatch(method, req)
+                    body = json.dumps(resp).encode()
+                    self.send_response(200)
+                except KeyError as exc:
+                    body = json.dumps({"error": str(exc)}).encode()
+                    self.send_response(404)
+                except Exception as exc:  # noqa: BLE001 — wire boundary
+                    body = json.dumps({"error": str(exc)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.address: Tuple[str, int] = self._httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.address[0]}:{self.address[1]}"
+
+    def serve(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="scheduler-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -28,10 +28,27 @@ from typing import List, Optional, Set
 from ..records import schema
 from ..records.storage import Storage
 from ..utils import idgen
+from ..utils.fsm import FSM, InvalidEventError
 from ..utils.types import HostType, Priority, SizeScope
 from .networktopology import NetworkTopology, Probe
 from .resource import Host, Peer, Piece, Resource, Task
 from .scheduling import ScheduleResult, ScheduleResultKind, Scheduling
+
+
+def _try_event(fsm: FSM, name: str) -> bool:
+    """Fire an event if currently legal, atomically.
+
+    ``if fsm.can(x): fsm.event(x)`` is check-then-act — under the wire
+    binding two handler threads race it and the loser crashes the RPC with
+    InvalidEventError.  The FSM's own event() is atomic; losing the race
+    is a legal no-op here (the state the event wanted is already reached
+    or superseded).
+    """
+    try:
+        fsm.event(name)
+        return True
+    except InvalidEventError:
+        return False
 
 
 @dataclass
@@ -89,8 +106,7 @@ class SchedulerService:
         # for newly created peers — single insertion point.
         peer = self.resource.store_peer(peer)
 
-        if task.fsm.can("Download"):
-            task.fsm.event("Download")
+        _try_event(task.fsm, "Download")
 
         scope = task.size_scope()
         if scope is SizeScope.EMPTY:
@@ -108,11 +124,31 @@ class SchedulerService:
         schedule = self.scheduling.schedule_candidate_parents(peer, blocklist)
         if schedule.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
             task.back_to_source_peers.add(peer.id)
-            if peer.fsm.can("DownloadBackToSource"):
-                peer.fsm.event("DownloadBackToSource")
-        elif schedule.kind is ScheduleResultKind.PARENTS and peer.fsm.can("Download"):
-            peer.fsm.event("Download")
+            _try_event(peer.fsm, "DownloadBackToSource")
+        elif schedule.kind is ScheduleResultKind.PARENTS:
+            _try_event(peer.fsm, "Download")
         return RegisterResult(peer=peer, size_scope=scope, schedule=schedule)
+
+    def set_task_info(
+        self,
+        peer: Peer,
+        content_length: int,
+        total_piece_count: int,
+        piece_size: int,
+    ) -> None:
+        """First peer reports origin metadata (the reference carries this on
+        RegisterPeerTask / piece results)."""
+        task = peer.task
+        with self._mu:
+            if task.content_length < 0:
+                task.content_length = content_length
+                task.total_piece_count = total_piece_count
+                task.piece_size = piece_size
+
+    def mark_back_to_source(self, peer: Peer) -> None:
+        """Peer fell back to origin download (conductor's source path)."""
+        _try_event(peer.fsm, "DownloadBackToSource")
+        peer.task.back_to_source_peers.add(peer.id)
 
     # -- piece / peer results ----------------------------------------------
 
@@ -139,26 +175,22 @@ class SchedulerService:
 
     def report_peer_finished(self, peer: Peer) -> None:
         """handlePeerSuccess (:1284) + createDownloadRecord (:1418-1629)."""
-        if peer.fsm.can("DownloadSucceeded"):
-            peer.fsm.event("DownloadSucceeded")
+        _try_event(peer.fsm, "DownloadSucceeded")
         peer.cost_ns = int((time.time() - peer.created_at) * 1e9)
         task = peer.task
-        if task.fsm.can("DownloadSucceeded"):
-            task.fsm.event("DownloadSucceeded")
+        _try_event(task.fsm, "DownloadSucceeded")
         if self.storage is not None:
             self.storage.create_download(self._build_download_record(peer))
 
     def report_peer_failed(self, peer: Peer) -> None:
-        if peer.fsm.can("DownloadFailed"):
-            peer.fsm.event("DownloadFailed")
+        _try_event(peer.fsm, "DownloadFailed")
         if self.storage is not None:
             self.storage.create_download(
                 self._build_download_record(peer, state="Failed")
             )
 
     def leave_peer(self, peer: Peer) -> None:
-        if peer.fsm.can("Leave"):
-            peer.fsm.event("Leave")
+        _try_event(peer.fsm, "Leave")
         peer.task.delete_peer_in_edges(peer.id)
         peer.task.delete_peer_out_edges(peer.id)
 
