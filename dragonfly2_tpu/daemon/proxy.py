@@ -1,0 +1,137 @@
+"""HTTP forward proxy diverting matched requests into P2P.
+
+Reference: client/daemon/proxy — regex rules route GETs into the P2P
+download path (proxy.go:275-310), registry-mirror rewriting, pass-through
+for everything else; transport.go's round-tripper is the divert seam.
+
+Here: a stdlib HTTP proxy server whose rule set maps URL regexes →
+P2P download via the daemon's conductor; unmatched requests are fetched
+directly (urllib).  HTTPS CONNECT tunneling is pass-through bytes.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Pattern, Tuple
+
+
+@dataclass
+class ProxyRule:
+    """proxy.go's Proxy rules: regex + use-p2p flag (+ optional rewrite)."""
+
+    pattern: Pattern
+    use_p2p: bool = True
+    redirect: str = ""  # registry-mirror style prefix rewrite
+
+    @classmethod
+    def compile(cls, regex: str, *, use_p2p: bool = True, redirect: str = "") -> "ProxyRule":
+        return cls(pattern=re.compile(regex), use_p2p=use_p2p, redirect=redirect)
+
+
+class ProxyRouter:
+    """Rule matching + divert decision (transport.go shouldUseDragonfly)."""
+
+    def __init__(self, rules: Optional[List[ProxyRule]] = None):
+        self.rules = rules or []
+
+    def route(self, url: str) -> Tuple[bool, str]:
+        """→ (use_p2p, effective_url)."""
+        for rule in self.rules:
+            if rule.pattern.search(url):
+                effective = url
+                if rule.redirect:
+                    effective = rule.pattern.sub(rule.redirect, url, count=1)
+                return rule.use_p2p, effective
+        return False, url
+
+
+class P2PProxy:
+    def __init__(
+        self,
+        daemon,
+        router: ProxyRouter,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        piece_size: int = 4 << 20,
+        direct_timeout: float = 30.0,
+    ):
+        self.daemon = daemon
+        self.router = router
+        self.piece_size = piece_size
+        self.direct_timeout = direct_timeout
+        self.stats = {"p2p": 0, "direct": 0}
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                # Absolute-form (true forward-proxy clients send
+                # `GET http://host/path`) or path-embedded
+                # (`GET /http://host/path`, gateway-style callers).
+                url = self.path
+                if url.startswith("/http://") or url.startswith("/https://"):
+                    url = url[1:]
+                use_p2p, effective = proxy.router.route(url)
+                try:
+                    if use_p2p:
+                        body = proxy._fetch_p2p(effective)
+                        proxy.stats["p2p"] += 1
+                    else:
+                        body = proxy._fetch_direct(effective)
+                        proxy.stats["direct"] += 1
+                except Exception:  # noqa: BLE001 — proxy boundary
+                    self.send_error(502)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.address = self._httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def _fetch_p2p(self, url: str) -> bytes:
+        source = self.daemon.conductor.source_fetcher
+        content_length = None
+        if source is not None and hasattr(source, "content_length"):
+            content_length = source.content_length(url)
+        result = self.daemon.download(
+            url, piece_size=self.piece_size, content_length=content_length
+        )
+        if not result.ok:
+            raise IOError(f"p2p download of {url} failed")
+        out = bytearray()
+        remaining = self.daemon.storage.engine.content_length(result.task_id)
+        for n in range(result.pieces):
+            piece = self.daemon.storage.read_piece(result.task_id, n)
+            out += piece[: min(len(piece), remaining)]
+            remaining -= len(piece)
+        return bytes(out)
+
+    def _fetch_direct(self, url: str) -> bytes:
+        with urllib.request.urlopen(url, timeout=self.direct_timeout) as resp:
+            return resp.read()
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    def serve(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="p2p-proxy", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

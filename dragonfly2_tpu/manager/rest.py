@@ -1,0 +1,141 @@
+"""Manager REST API (reference: manager/router + handlers — the gin REST
+surface the console drives; swagger'd CRUD for models/clusters/schedulers).
+
+Minimal JSON binding over stdlib HTTP:
+
+  GET    /api/v1/models?scheduler_id=&name=      list models
+  POST   /api/v1/models/<id>:activate            single-active activation
+  POST   /api/v1/models/<id>:deactivate
+  GET    /api/v1/schedulers                      active scheduler instances
+  GET    /api/v1/clusters:search?ip=&hostname=&idc=&location=
+  GET    /api/v1/healthy                         liveness
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+from http.server import BaseHTTPRequestHandler
+from typing import List, Optional, Tuple
+
+from ..rpc._server import ThreadedHTTPService
+
+from .cluster import ClusterManager
+from .registry import Model, ModelRegistry
+from .searcher import SchedulerCluster, Searcher
+
+
+def _model_to_json(m: Model) -> dict:
+    return {
+        "id": m.id,
+        "name": m.name,
+        "type": m.type,
+        "version": m.version,
+        "scheduler_id": m.scheduler_id,
+        "state": m.state.value,
+        "evaluation": m.evaluation,
+    }
+
+
+class ManagerRESTServer:
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        clusters: ClusterManager,
+        searcher: Optional[Searcher] = None,
+        scheduler_clusters: Optional[List[SchedulerCluster]] = None,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.registry = registry
+        self.clusters = clusters
+        self.searcher = searcher or Searcher()
+        self.scheduler_clusters = scheduler_clusters or []
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                parsed = urllib.parse.urlsplit(self.path)
+                q = dict(urllib.parse.parse_qsl(parsed.query))
+                path = parsed.path
+                if path == "/api/v1/healthy":
+                    self._json(200, {"ok": True})
+                elif path == "/api/v1/models":
+                    models = server.registry.list(
+                        scheduler_id=q.get("scheduler_id") or None,
+                        name=q.get("name") or None,
+                    )
+                    self._json(200, [_model_to_json(m) for m in models])
+                elif path == "/api/v1/schedulers":
+                    self._json(
+                        200,
+                        [
+                            {
+                                "id": s.id,
+                                "cluster_id": s.cluster_id,
+                                "ip": s.ip,
+                                "port": s.port,
+                                "state": s.state,
+                            }
+                            for s in server.clusters.active_schedulers()
+                        ],
+                    )
+                elif path == "/api/v1/clusters:search":
+                    try:
+                        ranked = server.searcher.find_scheduler_clusters(
+                            server.scheduler_clusters,
+                            ip=q.get("ip", ""),
+                            hostname=q.get("hostname", ""),
+                            conditions={
+                                "idc": q.get("idc", ""),
+                                "location": q.get("location", ""),
+                            },
+                        )
+                        self._json(200, [c.id for c in ranked])
+                    except LookupError as exc:
+                        self._json(404, {"error": str(exc)})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                path = urllib.parse.urlsplit(self.path).path
+                if path.startswith("/api/v1/models/") and ":" in path:
+                    model_id, _, action = path[len("/api/v1/models/") :].rpartition(":")
+                    try:
+                        if action == "activate":
+                            m = server.registry.activate(model_id)
+                        elif action == "deactivate":
+                            m = server.registry.deactivate(model_id)
+                        else:
+                            self._json(404, {"error": f"unknown action {action}"})
+                            return
+                        self._json(200, _model_to_json(m))
+                    except KeyError:
+                        self._json(404, {"error": f"model {model_id} not found"})
+                    return
+                self._json(404, {"error": "not found"})
+
+        self._svc = ThreadedHTTPService(Handler, host, port, "manager-rest")
+        self.address: Tuple[str, int] = self._svc.address
+
+    @property
+    def url(self) -> str:
+        return self._svc.url
+
+    def serve(self) -> None:
+        self._svc.serve()
+
+    def stop(self) -> None:
+        self._svc.stop()

@@ -1,0 +1,15 @@
+"""Object storage backends (reference: pkg/objectstorage/).
+
+One interface (objectstorage.go:179-212) over pluggable backends; the
+reference ships S3/OSS/OBS.  Here the filesystem backend is built in (and
+is what the e2e fixtures use); cloud backends register into the same
+registry at deploy time.
+"""
+
+from .backend import (  # noqa: F401
+    FilesystemBackend,
+    ObjectMetadata,
+    ObjectStorageBackend,
+    ObjectStorageRegistry,
+    default_backends,
+)

@@ -1,0 +1,38 @@
+"""Shared HTTP server scaffold for the rpc package's services."""
+
+from __future__ import annotations
+
+import threading
+from http.server import ThreadingHTTPServer
+from typing import Optional, Tuple, Type
+
+
+class ThreadedHTTPService:
+    """Owns a ThreadingHTTPServer + its serve thread (one lifecycle impl
+    for the scheduler RPC, piece, and REST servers)."""
+
+    def __init__(self, handler_cls: Type, host: str, port: int, name: str):
+        self._httpd = ThreadingHTTPServer((host, port), handler_cls)
+        self.address: Tuple[str, int] = self._httpd.server_address
+        self._name = name
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.address[0]}:{self.address[1]}"
+
+    def serve(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name=self._name, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -14,13 +14,13 @@ range-GETs pieces with retry/backoff.
 
 from __future__ import annotations
 
-import threading
 import urllib.error
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Optional, Tuple
+from http.server import BaseHTTPRequestHandler
+from typing import Callable, Dict, Tuple
 
 from ..daemon.upload import UploadBusy, UploadManager
+from ._server import ThreadedHTTPService
 from .retry import retry_call
 
 
@@ -87,25 +87,18 @@ class PieceHTTPServer:
                 except Exception:  # noqa: BLE001 — wire boundary
                     self.send_error(500)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self.address: Tuple[str, int] = self._httpd.server_address
-        self._thread: Optional[threading.Thread] = None
+        self._svc = ThreadedHTTPService(Handler, host, port, "piece-http")
+        self.address: Tuple[str, int] = self._svc.address
 
     @property
     def port(self) -> int:
-        return self.address[1]
+        return self._svc.port
 
     def serve(self) -> None:
-        if self._thread is not None:
-            return
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="piece-http", daemon=True
-        )
-        self._thread.start()
+        self._svc.serve()
 
     def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._svc.stop()
 
 
 class HTTPPieceFetcher:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -35,6 +36,7 @@ class RemoteScheduler:
         self._tasks: Dict[str, Task] = {}
         self._hosts: Dict[str, Host] = {}
         self._peers: Dict[str, Peer] = {}
+        self._announced: Set[str] = set()
         # Remote transport has no probe store mirrored locally.
         self.networktopology = None
 
@@ -59,8 +61,6 @@ class RemoteScheduler:
                 except json.JSONDecodeError:
                     message = payload[:200].decode(errors="replace")
                 raise RPCError(f"{method}: HTTP {exc.code}: {message}") from exc
-
-        import urllib.error
 
         return retry_call(once, retry_on=(ConnectionError, TimeoutError, OSError))
 
@@ -109,13 +109,35 @@ class RemoteScheduler:
         self._call("announce_host", {"host": host_to_wire(host)})
         with self._mu:
             self._hosts[host.id] = host
+            self._announced.add(host.id)
 
-    def register_peer(self, *, host: Host, url: str, **kwargs) -> RegisterResult:
-        self.announce_host(host)
+    def register_peer(
+        self,
+        *,
+        host: Host,
+        url: str,
+        peer_id: Optional[str] = None,
+        task_id: Optional[str] = None,
+        tag: str = "",
+        application: str = "",
+        **_ignored,
+    ) -> RegisterResult:
+        with self._mu:
+            announced = host.id in self._announced
+        if not announced:
+            # One announce per host per client; periodic re-announce is the
+            # announcer's job, not every registration's.
+            self.announce_host(host)
+        # Client-generated peer id = idempotency key: a retried POST after a
+        # timeout re-registers the SAME peer (the server's load_or_store
+        # dedupes) instead of leaking an orphan.
+        from ..utils import idgen
+
+        peer_id = peer_id or idgen.peer_id(host.ip, host.hostname)
         resp = self._call(
             "register_peer",
-            {"host_id": host.id, "url": url,
-             "tag": kwargs.get("tag", ""), "application": kwargs.get("application", "")},
+            {"host_id": host.id, "url": url, "peer_id": peer_id,
+             "task_id": task_id, "tag": tag, "application": application},
         )
         task = self._mirror_task(resp["task_id"], url)
         task.content_length = resp["content_length"]

@@ -8,8 +8,9 @@ authoritative Host/Task/Peer state; clients hold ids.
 
 Wire methods:
   announce_host      {host: {...stats}}                 → {}
-  register_peer      {host_id, url, peer_id?, ...}      → registration view
-  set_task_info      {task_id, content_length, total_piece_count, piece_size}
+  register_peer      {host_id, url, peer_id?, task_id?, tag?, application?}
+                                                        → registration view
+  set_task_info      {peer_id, content_length, total_piece_count, piece_size}
   report_piece_finished / report_piece_failed / report_peer_finished /
   report_peer_failed / leave_peer                        (by peer_id)
   sync_probes_start  {host_id}                          → {targets: [...]}
@@ -20,8 +21,10 @@ from __future__ import annotations
 
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Dict, Optional, Tuple
+
+from ._server import ThreadedHTTPService
 
 from ..scheduler.resource import Host, Peer
 from ..scheduler.scheduling import ScheduleResultKind
@@ -264,22 +267,15 @@ class SchedulerHTTPServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self.address: Tuple[str, int] = self._httpd.server_address
-        self._thread: Optional[threading.Thread] = None
+        self._svc = ThreadedHTTPService(Handler, host, port, "scheduler-http")
+        self.address: Tuple[str, int] = self._svc.address
 
     @property
     def url(self) -> str:
-        return f"http://{self.address[0]}:{self.address[1]}"
+        return self._svc.url
 
     def serve(self) -> None:
-        if self._thread is not None:
-            return
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="scheduler-http", daemon=True
-        )
-        self._thread.start()
+        self._svc.serve()
 
     def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._svc.stop()

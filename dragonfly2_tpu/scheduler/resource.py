@@ -501,6 +501,10 @@ class Peer:
         length: int = 0,
     ) -> None:
         with self._mu:
+            if number in self.finished_pieces:
+                # Idempotent: a retried report (wire client re-sending after
+                # a timeout) must not double-count the piece cost.
+                return
             self.finished_pieces.add(number)
             self.piece_costs_ns.append(cost_ns)
             self.pieces[number] = Piece(

@@ -109,18 +109,21 @@ class SchedulerService:
         _try_event(task.fsm, "Download")
 
         scope = task.size_scope()
+        # _try_event: a retried registration (same client-generated peer_id
+        # re-sent after a wire timeout) finds the peer already registered —
+        # the event is then a legal no-op, not an error.
         if scope is SizeScope.EMPTY:
-            peer.fsm.event("RegisterEmpty")
+            _try_event(peer.fsm, "RegisterEmpty")
             return RegisterResult(peer=peer, size_scope=scope)
         if scope is SizeScope.TINY and task.can_reuse_direct_piece():
-            peer.fsm.event("RegisterTiny")
+            _try_event(peer.fsm, "RegisterTiny")
             return RegisterResult(
                 peer=peer, size_scope=scope, direct_piece=task.direct_piece
             )
         if scope is SizeScope.SMALL:
-            peer.fsm.event("RegisterSmall")
+            _try_event(peer.fsm, "RegisterSmall")
         else:
-            peer.fsm.event("RegisterNormal")
+            _try_event(peer.fsm, "RegisterNormal")
         schedule = self.scheduling.schedule_candidate_parents(peer, blocklist)
         if schedule.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
             task.back_to_source_peers.add(peer.id)

@@ -1,0 +1,231 @@
+"""Federated multi-cluster training (BASELINE configs[3]).
+
+The reference's deployment model is many scheduler clusters federated by
+one manager (SURVEY §2.6 cluster sharding); its intended trainer design
+uploads every cluster's records to one trainer.  At fleet scale the
+records should stay near their cluster: each cluster trains on its own
+shard (its slice's ICI doing the in-cluster data parallelism) and only
+**model deltas** cross the WAN/DCN to the manager — classic cross-silo
+federated averaging, coordinated through the same model registry the
+single-cluster path uses.
+
+Protocol per round (manager-coordinated):
+ 1. coordinator broadcasts the current global params (round 0: init);
+ 2. each cluster runs ``local_epochs`` on its own records starting from
+    the global params;
+ 3. coordinator aggregates: FedAvg — weighted mean of params by local
+    sample count (McMahan et al. 2017's weighting);
+ 4. the aggregated model is evaluated on a held-out global split and
+    registered (state inactive → operator/auto activation).
+
+Normalization stats federate the same way: weighted moments merge, so one
+global scorer artifact serves every cluster.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.mlp import MLPConfig, MLPRegressor
+from ..records.features import DOWNLOAD_FEATURE_DIM, mask_post_hoc
+from .export import MLPScorer, export_mlp_scorer
+from .ingest import EdgeBatches
+from .train import (
+    EvalMetrics,
+    TrainConfig,
+    TrainState,
+    _huber,
+    _make_optimizer,
+    _regression_metrics,
+)
+
+
+@dataclass
+class FederatedConfig:
+    rounds: int = 5
+    local_epochs: int = 3
+    batch_size: int = 1024
+    learning_rate: float = 1e-3
+    warmup_steps: int = 10
+    seed: int = 0
+
+
+@dataclass
+class ClusterShard:
+    """One scheduler cluster's local dataset (rows in DOWNLOAD_COLUMNS)."""
+
+    cluster_id: str
+    rows: np.ndarray
+
+    @property
+    def n_samples(self) -> int:
+        return self.rows.shape[0]
+
+
+def _tree_weighted_mean(trees: Sequence, weights: Sequence[float]):
+    total = float(sum(weights))
+    scaled = [
+        jax.tree_util.tree_map(lambda x, w=w: np.asarray(x) * (w / total), t)
+        for t, w in zip(trees, weights)
+    ]
+    out = scaled[0]
+    for t in scaled[1:]:
+        out = jax.tree_util.tree_map(np.add, out, t)
+    return out
+
+
+class FederatedTrainer:
+    """Cross-cluster FedAvg of the MLP bandwidth regressor.
+
+    ``train_local`` is overridable: the default runs in-process (each
+    cluster's shard trained sequentially); a deployment runs it as the per
+    cluster TPU job and ships params back through the manager.
+    """
+
+    def __init__(
+        self,
+        shards: Sequence[ClusterShard],
+        *,
+        config: Optional[FederatedConfig] = None,
+        model_config: Optional[MLPConfig] = None,
+    ) -> None:
+        if not shards:
+            raise ValueError("no cluster shards")
+        self.shards = list(shards)
+        self.config = config or FederatedConfig()
+        self.model_config = model_config or MLPConfig()
+        self.model = MLPRegressor(self.model_config)
+        self._rng = jax.random.PRNGKey(self.config.seed)
+        # Global normalizer from pooled moment merge (post-hoc masked).
+        ms, ws = [], []
+        for s in self.shards:
+            feats = mask_post_hoc(s.rows[:, 2 : 2 + self.model_config.in_dim])
+            ms.append((feats.mean(axis=0), feats.var(axis=0)))
+            ws.append(s.n_samples)
+        total = float(sum(ws))
+        mean = sum(m * (w / total) for (m, _), w in zip(ms, ws))
+        var = sum(
+            (v + (m - mean) ** 2) * (w / total) for (m, v), w in zip(ms, ws)
+        )
+        std = np.sqrt(var)
+        self.feat_mean = mean.astype(np.float32)
+        self.feat_std = np.where(std < 1e-3, 1.0, std).astype(np.float32)
+        sample = jnp.zeros((2, self.model_config.in_dim), jnp.float32)
+        self.global_params = self.model.init(self._rng, sample)["params"]
+        # Output bias starts at the global target mean: with Huber's linear
+        # tail, a zero-init regressor ~17 log-units from the targets needs
+        # many federated rounds just to close the constant offset.
+        target_mean = float(
+            sum(float(s.rows[:, -1].sum()) for s in self.shards)
+            / max(sum(s.n_samples for s in self.shards), 1)
+        )
+        last = max(
+            (k for k in self.global_params if k.startswith("Dense_")),
+            key=lambda k: int(k.split("_")[1]),
+        )
+        self.global_params = dict(self.global_params)
+        self.global_params[last] = dict(self.global_params[last])
+        self.global_params[last]["bias"] = (
+            jnp.asarray(self.global_params[last]["bias"]) + target_mean
+        )
+        self.history: List[Dict] = []
+
+    # -- local work ----------------------------------------------------------
+
+    def train_local(self, shard: ClusterShard, params) -> Tuple[dict, int]:
+        """One cluster's round: local_epochs of SGD from the global params.
+        Returns (new_params, n_samples)."""
+        cfg = self.config
+        feats_all = mask_post_hoc(
+            shard.rows[:, 2 : 2 + self.model_config.in_dim]
+        )
+        feats_all = (feats_all - self.feat_mean) / self.feat_std
+        targets_all = shard.rows[:, -1].astype(np.float32)
+
+        tx = _make_optimizer(
+            TrainConfig(
+                learning_rate=cfg.learning_rate,
+                warmup_steps=cfg.warmup_steps,
+                epochs=cfg.local_epochs,
+            ),
+            max(len(shard.rows) // cfg.batch_size, 1),
+        )
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, opt_state, feats, target):
+            def loss_fn(p):
+                pred = self.model.apply({"params": p}, feats)
+                return _huber(pred, target)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            import optax
+
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        rng = np.random.default_rng(cfg.seed)
+        b = min(cfg.batch_size, len(feats_all))
+        for epoch in range(cfg.local_epochs):
+            order = rng.permutation(len(feats_all))
+            for start in range(0, len(order) - b + 1, b):
+                idx = order[start : start + b]
+                params, opt_state, _ = step(
+                    params,
+                    opt_state,
+                    jnp.asarray(feats_all[idx]),
+                    jnp.asarray(targets_all[idx]),
+                )
+        return params, shard.n_samples
+
+    # -- coordination --------------------------------------------------------
+
+    def run_round(self) -> None:
+        results = [self.train_local(s, self.global_params) for s in self.shards]
+        params_list = [p for p, _ in results]
+        weights = [n for _, n in results]
+        self.global_params = jax.tree_util.tree_map(
+            jnp.asarray, _tree_weighted_mean(params_list, weights)
+        )
+
+    def run(self, eval_rows: Optional[np.ndarray] = None) -> EvalMetrics:
+        metrics = EvalMetrics()
+        for r in range(self.config.rounds):
+            self.run_round()
+            if eval_rows is not None:
+                metrics = self.evaluate(eval_rows)
+                self.history.append({"round": r, "mae": metrics.mae})
+        return metrics
+
+    def evaluate(self, rows: np.ndarray) -> EvalMetrics:
+        feats = mask_post_hoc(rows[:, 2 : 2 + self.model_config.in_dim])
+        feats = (feats - self.feat_mean) / self.feat_std
+        pred = np.asarray(
+            self.model.apply({"params": self.global_params}, jnp.asarray(feats))
+        )
+        return _regression_metrics(pred, rows[:, -1].astype(np.float32))
+
+    def export_scorer(self) -> MLPScorer:
+        return export_mlp_scorer(
+            self.global_params,
+            feat_mean=self.feat_mean,
+            feat_std=self.feat_std,
+            post_hoc_masked=True,
+        )
+
+    def publish(self, registry, *, scheduler_id: str = "federated") -> "object":
+        """Register the aggregated model (manager CreateModel path)."""
+        from .export import scorer_to_bytes
+
+        return registry.create_model(
+            name="parent-bandwidth-mlp",
+            type="mlp",
+            scheduler_id=scheduler_id,
+            artifact=scorer_to_bytes(self.export_scorer()),
+            evaluation=self.history[-1] if self.history else {},
+        )

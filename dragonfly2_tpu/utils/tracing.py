@@ -1,0 +1,117 @@
+"""Tracing (reference: OpenTelemetry throughout — otelgrpc handlers on
+every server/client, explicit spans around task/piece lifecycles,
+SURVEY §5.1).
+
+A minimal otel-shaped tracer: named spans with attributes, parent/child
+nesting via a context stack, exporters (in-memory for tests, JSONL for
+ops).  Services instrument the same seams the reference does: download
+task, piece fetch, schedule round, train run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start_ns: int
+    end_ns: int = 0
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    status: str = "ok"
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def set(self, **attrs: Any) -> None:
+        self.attributes.update(attrs)
+
+
+class Tracer:
+    def __init__(self, service: str = "dragonfly", exporter: Optional["SpanExporter"] = None):
+        self.service = service
+        self.exporter = exporter or InMemoryExporter()
+        self._local = threading.local()
+
+    def _stack(self) -> List[Span]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes: Any) -> Iterator[Span]:
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        span = Span(
+            name=name,
+            trace_id=parent.trace_id if parent else uuid.uuid4().hex,
+            span_id=uuid.uuid4().hex[:16],
+            parent_id=parent.span_id if parent else None,
+            start_ns=time.time_ns(),
+            attributes=dict(attributes),
+        )
+        stack.append(span)
+        try:
+            yield span
+        except BaseException as exc:
+            span.status = f"error: {type(exc).__name__}"
+            raise
+        finally:
+            span.end_ns = time.time_ns()
+            stack.pop()
+            self.exporter.export(span)
+
+
+class SpanExporter:
+    def export(self, span: Span) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class InMemoryExporter(SpanExporter):
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self.spans: List[Span] = []
+
+    def export(self, span: Span) -> None:
+        with self._mu:
+            self.spans.append(span)
+
+    def find(self, name: str) -> List[Span]:
+        with self._mu:
+            return [s for s in self.spans if s.name == name]
+
+
+class JSONLExporter(SpanExporter):
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._mu = threading.Lock()
+
+    def export(self, span: Span) -> None:
+        record = {
+            "name": span.name,
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "parent_id": span.parent_id,
+            "start_ns": span.start_ns,
+            "duration_ms": span.duration_ms,
+            "status": span.status,
+            "attributes": span.attributes,
+        }
+        with self._mu:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+
+
+# Process-default tracer (services may construct scoped ones).
+default_tracer = Tracer()

@@ -1,0 +1,205 @@
+"""Breadth components: object storage + gateway + dfstore, proxy, tracing,
+plugins, manager REST."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.daemon.gateway import GatewayConfig, GatewaySourceFetcher, ObjectGateway
+from dragonfly2_tpu.daemon.proxy import P2PProxy, ProxyRouter, ProxyRule
+from dragonfly2_tpu.manager import ClusterManager, ModelRegistry, SchedulerInstance
+from dragonfly2_tpu.manager.rest import ManagerRESTServer
+from dragonfly2_tpu.objectstorage import FilesystemBackend
+from dragonfly2_tpu.utils.plugin import PluginError, list_plugins, load_plugin, plugin_filename
+from dragonfly2_tpu.utils.tracing import InMemoryExporter, Tracer
+
+from tests.test_daemon import PIECE, _Swarm
+
+
+class TestFilesystemBackend:
+    def test_crud(self, tmp_path):
+        b = FilesystemBackend(str(tmp_path))
+        b.create_bucket("bkt")
+        meta = b.put_object("bkt", "a/b/key.bin", b"hello")
+        assert meta.content_length == 5
+        assert b.get_object("bkt", "a/b/key.bin") == b"hello"
+        assert b.object_exists("bkt", "a/b/key.bin")
+        b.copy_object("bkt", "a/b/key.bin", "copy.bin")
+        keys = [m.key for m in b.list_objects("bkt")]
+        assert sorted(keys) == ["a/b/key.bin", "copy.bin"]
+        assert [m.key for m in b.list_objects("bkt", prefix="a/")] == ["a/b/key.bin"]
+        b.delete_object("bkt", "copy.bin")
+        assert not b.object_exists("bkt", "copy.bin")
+        with pytest.raises(KeyError):
+            b.get_object("bkt", "missing")
+
+    def test_path_traversal_rejected(self, tmp_path):
+        b = FilesystemBackend(str(tmp_path))
+        b.create_bucket("bkt")
+        with pytest.raises(ValueError):
+            b.put_object("bkt", "../escape", b"x")
+        with pytest.raises(ValueError):
+            b.create_bucket("../up")
+
+
+class TestObjectGateway:
+    def test_put_seeds_p2p_and_peer_gets_from_swarm(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        backend = FilesystemBackend(str(tmp_path / "objects"))
+        gws = []
+        for d in swarm.daemons[:2]:
+            d.conductor.source_fetcher = GatewaySourceFetcher(backend)
+            gws.append(ObjectGateway(d, backend, GatewayConfig(piece_size=PIECE)))
+        payload = os.urandom(3 * PIECE + 100)
+        gws[0].put_object("models/v1.bin", payload)
+        assert gws[0].object_exists("models/v1.bin")
+
+        # Second daemon reads: P2P from daemon 0 (it seeded the pieces).
+        got = gws[1].get_object("models/v1.bin")
+        assert got == payload
+        assert swarm.daemons[0].upload.upload_count > 0
+
+    def test_delete_evicts_pieces(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        backend = FilesystemBackend(str(tmp_path / "objects"))
+        d = swarm.daemons[0]
+        d.conductor.source_fetcher = GatewaySourceFetcher(backend)
+        gw = ObjectGateway(d, backend, GatewayConfig(piece_size=PIECE))
+        gw.put_object("k", b"x" * PIECE)
+        tid = gw._task_id("k")
+        assert d.storage.engine.piece_count(tid) == 1
+        gw.delete_object("k")
+        assert not gw.object_exists("k")
+        assert d.storage.engine.piece_count(tid) == 0
+
+
+class TestProxy:
+    def test_rules_route_and_rewrite(self):
+        router = ProxyRouter(
+            [
+                ProxyRule.compile(r"^http://registry\.local/", redirect="http://mirror.local/"),
+                ProxyRule.compile(r"\.layer$", use_p2p=True),
+                ProxyRule.compile(r"^http://direct\.", use_p2p=False),
+            ]
+        )
+        use, url = router.route("http://registry.local/v2/blob")
+        assert use and url == "http://mirror.local/v2/blob"
+        assert router.route("http://x/foo.layer") == (True, "http://x/foo.layer")
+        assert router.route("http://direct.example/a") == (False, "http://direct.example/a")
+        assert router.route("http://other/a") == (False, "http://other/a")
+
+    def test_proxy_serves_p2p_content(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        proxy = P2PProxy(
+            swarm.daemons[0],
+            ProxyRouter([ProxyRule.compile(r"^https://origin/")]),
+            piece_size=PIECE,
+        )
+        proxy.serve()
+        try:
+            url = f"http://127.0.0.1:{proxy.port}/https://origin/blob-via-proxy"
+            # content_length resolvable? FakeOrigin has no content_length →
+            # conductor needs it; give the origin a content_length method.
+            swarm.origin.content_length = lambda u: 2 * PIECE
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                body = resp.read()
+            assert len(body) == 2 * PIECE
+            assert proxy.stats["p2p"] == 1
+        finally:
+            proxy.stop()
+
+
+class TestTracing:
+    def test_nested_spans_and_status(self):
+        exp = InMemoryExporter()
+        tracer = Tracer(exporter=exp)
+        with tracer.span("download", task="t1") as outer:
+            with tracer.span("piece", number=3):
+                pass
+            outer.set(pieces=1)
+        with pytest.raises(ValueError):
+            with tracer.span("boom"):
+                raise ValueError("x")
+        piece = exp.find("piece")[0]
+        download = exp.find("download")[0]
+        assert piece.parent_id == download.span_id
+        assert piece.trace_id == download.trace_id
+        assert download.attributes == {"task": "t1", "pieces": 1}
+        assert exp.find("boom")[0].status == "error: ValueError"
+        assert download.duration_ms >= 0
+
+
+class TestPlugins:
+    def test_load_and_list(self, tmp_path):
+        (tmp_path / plugin_filename("evaluator", "myeval")).write_text(
+            "def create_plugin(weight=1.0):\n"
+            "    class Eval:\n"
+            "        def evaluate_parents(self, parents, child, total):\n"
+            "            return sorted(parents, key=lambda p: p.id)\n"
+            "        w = weight\n"
+            "    return Eval()\n"
+        )
+        plug = load_plugin(str(tmp_path), "evaluator", "myeval", weight=2.5)
+        assert plug.w == 2.5
+        listed = list_plugins(str(tmp_path))
+        assert listed == [
+            {"type": "evaluator", "name": "myeval", "file": plugin_filename("evaluator", "myeval")}
+        ]
+        with pytest.raises(PluginError):
+            load_plugin(str(tmp_path), "evaluator", "missing")
+
+    def test_factory_required(self, tmp_path):
+        (tmp_path / plugin_filename("searcher", "bad")).write_text("x = 1\n")
+        with pytest.raises(PluginError):
+            load_plugin(str(tmp_path), "searcher", "bad")
+
+
+class TestManagerREST:
+    @pytest.fixture()
+    def rest(self):
+        registry = ModelRegistry()
+        clusters = ClusterManager()
+        clusters.register_scheduler(SchedulerInstance(id="s1", cluster_id="c1", ip="10.0.0.1"))
+        m = registry.create_model(
+            name="parent-bandwidth-mlp", type="mlp", scheduler_id="s1",
+            artifact=b"blob", evaluation={"mae": 0.4},
+        )
+        server = ManagerRESTServer(registry, clusters)
+        server.serve()
+        yield server, registry, m
+        server.stop()
+
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    def _post(self, url):
+        req = urllib.request.Request(url, data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    def test_list_and_activate(self, rest):
+        server, registry, m = rest
+        assert self._get(server.url + "/api/v1/healthy") == {"ok": True}
+        models = self._get(server.url + "/api/v1/models?scheduler_id=s1")
+        assert len(models) == 1 and models[0]["state"] == "inactive"
+        out = self._post(server.url + f"/api/v1/models/{m.id}:activate")
+        assert out["state"] == "active"
+        assert registry.active_model("s1", "parent-bandwidth-mlp") is not None
+        scheds = self._get(server.url + "/api/v1/schedulers")
+        assert [s["id"] for s in scheds] == ["s1"]
+
+    def test_unknown_model_404(self, rest):
+        server, _, _ = rest
+        req = urllib.request.Request(
+            server.url + "/api/v1/models/nope:activate", data=b"", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 404
+
+
+import urllib.error  # noqa: E402  (used in the 404 assertion above)
