@@ -65,11 +65,7 @@ def run(argv=None) -> int:
         return 1
 
     with open(args.output, "wb") as out:
-        remaining = content_length
-        for n in range(result.pieces):
-            piece = daemon.storage.read_piece(result.task_id, n)
-            out.write(piece[: min(len(piece), remaining)])
-            remaining -= len(piece)
+        out.write(daemon.read_task_bytes(result.task_id))
     mode = "back-to-source" if result.back_to_source else "p2p"
     print(
         f"dfget: {content_length} bytes in {result.cost_s:.2f}s "

@@ -86,13 +86,7 @@ class ObjectGateway:
             content_length=content_length,
         )
         if result.ok:
-            out = bytearray()
-            remaining = self.daemon.storage.engine.content_length(result.task_id)
-            for n in range(result.pieces):
-                piece = self.daemon.storage.read_piece(result.task_id, n)
-                out += piece[: min(len(piece), remaining)]
-                remaining -= len(piece)
-            return bytes(out)
+            return self.daemon.read_task_bytes(result.task_id)
         # P2P completely failed → straight backend read.
         return self.backend.get_object(self.config.bucket, key)
 

@@ -5,8 +5,10 @@ download path (proxy.go:275-310), registry-mirror rewriting, pass-through
 for everything else; transport.go's round-tripper is the divert seam.
 
 Here: a stdlib HTTP proxy server whose rule set maps URL regexes →
-P2P download via the daemon's conductor; unmatched requests are fetched
-directly (urllib).  HTTPS CONNECT tunneling is pass-through bytes.
+P2P download via the daemon's conductor; unmatched GETs are fetched
+directly (urllib).  HTTPS CONNECT tunneling is NOT yet implemented
+(clients receive 501) — the reference's SNI-hijack path is a round-2
+target.
 """
 
 from __future__ import annotations
@@ -108,13 +110,7 @@ class P2PProxy:
         )
         if not result.ok:
             raise IOError(f"p2p download of {url} failed")
-        out = bytearray()
-        remaining = self.daemon.storage.engine.content_length(result.task_id)
-        for n in range(result.pieces):
-            piece = self.daemon.storage.read_piece(result.task_id, n)
-            out += piece[: min(len(piece), remaining)]
-            remaining -= len(piece)
-        return bytes(out)
+        return self.daemon.read_task_bytes(result.task_id)
 
     def _fetch_direct(self, url: str) -> bytes:
         with urllib.request.urlopen(url, timeout=self.direct_timeout) as resp:

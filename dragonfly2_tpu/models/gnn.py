@@ -244,6 +244,7 @@ class GATRanker(nn.Module):
         query_edge_feats: Optional[jax.Array] = None,  # [B, F] transfer feats
         *,
         train: bool = False,
+        return_embeddings: bool = False,
     ) -> jax.Array:
         cfg = self.config
         per_head = max(cfg.hidden // cfg.num_heads, 1)
@@ -253,6 +254,10 @@ class GATRanker(nn.Module):
             if cfg.dropout > 0:
                 h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
         emb = nn.Dense(cfg.out_dim, dtype=jnp.float32, param_dtype=jnp.float32)(h)
+        if return_embeddings:
+            # Export path: the scorer artifact stores this table and runs
+            # only the head at serve time (trainer/export.py GNNScorer).
+            return emb
 
         s = jnp.take(emb, src, axis=0)                     # [B, out]
         d = jnp.take(emb, dst, axis=0)

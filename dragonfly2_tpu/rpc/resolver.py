@@ -1,0 +1,47 @@
+"""Dynconfig-fed scheduler resolver (reference: pkg/resolver — gRPC
+resolvers that watch dynconfig for the live scheduler list and feed the
+consistent-hashing balancer, resolver/scheduler_resolver.go).
+
+``SchedulerResolver`` observes a Dynconfig whose payload carries
+``schedulers: [{id, url}]``, keeps the hash ring in sync, and answers
+``pick(task_id) → url`` — the daemon's scheduler-selection seam.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from .balancer import HashRing
+
+
+class SchedulerResolver:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._ring = HashRing()
+        self._urls: Dict[str, str] = {}
+
+    # Dynconfig observer signature (manager/dynconfig.py register()).
+    def on_config(self, config: dict) -> None:
+        entries = config.get("schedulers", [])
+        with self._mu:
+            current = set(self._urls)
+            incoming = {e["id"]: e["url"] for e in entries}
+            for gone in current - set(incoming):
+                self._ring.remove(gone)
+                del self._urls[gone]
+            for sid, url in incoming.items():
+                if sid not in self._urls:
+                    self._ring.add(sid)
+                self._urls[sid] = url
+
+    def pick(self, task_id: str) -> Optional[str]:
+        """Scheduler URL owning the task (consistent hashing keeps one
+        task's swarm on one scheduler, pkg/balancer semantics)."""
+        with self._mu:
+            sid = self._ring.pick(task_id)
+            return self._urls.get(sid) if sid else None
+
+    def all_urls(self) -> List[str]:
+        with self._mu:
+            return sorted(self._urls.values())

@@ -134,11 +134,17 @@ class RemoteScheduler:
         from ..utils import idgen
 
         peer_id = peer_id or idgen.peer_id(host.ip, host.hostname)
-        resp = self._call(
-            "register_peer",
-            {"host_id": host.id, "url": url, "peer_id": peer_id,
-             "task_id": task_id, "tag": tag, "application": application},
-        )
+        req = {"host_id": host.id, "url": url, "peer_id": peer_id,
+               "task_id": task_id, "tag": tag, "application": application}
+        try:
+            resp = self._call("register_peer", req)
+        except RPCError as exc:
+            if "unknown host" not in str(exc):
+                raise
+            # Scheduler restarted (or GC'd the host) since our announce:
+            # re-announce and retry once.
+            self.announce_host(host)
+            resp = self._call("register_peer", req)
         task = self._mirror_task(resp["task_id"], url)
         task.content_length = resp["content_length"]
         task.total_piece_count = resp["total_piece_count"]

@@ -254,8 +254,21 @@ class MLEvaluator(Evaluator):
     ) -> List[Peer]:
         if self._scorer is None or not parents:
             return super().evaluate_parents(parents, child, total_piece_count)
-        feats = self._featurize(parents, child)
-        scores = np.asarray(self._scorer.score(feats))
+        from ..records.features import host_bucket
+
+        # Identity-only scorers (GNN embedding lookup) skip featurization —
+        # building the feature matrix is the expensive part of this path.
+        if getattr(self._scorer, "wants_features", True):
+            feats = self._featurize(parents, child)
+        else:
+            feats = np.zeros((len(parents), 0), dtype=np.float32)
+        src_buckets = np.asarray([host_bucket(p.host.id) for p in parents], np.int64)
+        dst_buckets = np.full(
+            len(parents), host_bucket(child.host.id), dtype=np.int64
+        )
+        scores = np.asarray(
+            self._scorer.score(feats, src_buckets=src_buckets, dst_buckets=dst_buckets)
+        )
         order = np.argsort(-scores, kind="stable")
         return [parents[i] for i in order]
 

@@ -49,7 +49,9 @@ class MLPScorer:
     model_type: str = "mlp"
     version: int = SCORER_SCHEMA_VERSION
 
-    def score(self, features: np.ndarray) -> np.ndarray:
+    def score(self, features: np.ndarray, **_buckets) -> np.ndarray:
+        # _buckets: src/dst host buckets offered uniformly by the evaluator;
+        # the feature-based MLP ignores them (the GNN scorer consumes them).
         x = np.asarray(features, dtype=np.float32)
         if self.post_hoc_masked:
             from ..records.features import mask_post_hoc
@@ -139,13 +141,22 @@ def scorer_to_bytes(scorer: MLPScorer) -> bytes:
     return buf.getvalue()
 
 
-def load_scorer(path_or_bytes) -> MLPScorer:
+def load_scorer(path_or_bytes):
     if isinstance(path_or_bytes, (bytes, bytearray)):
         src = io.BytesIO(bytes(path_or_bytes))
     else:
         src = path_or_bytes
     with np.load(src) as data:
         meta = json.loads(bytes(data["meta"]).decode("utf-8"))
+        if meta["model_type"] == "gnn":
+            return GNNScorer(
+                buckets=data["buckets"],
+                embeddings=data["embeddings"],
+                head_weights=[
+                    (data[f"w{i}"], data[f"b{i}"]) for i in range(meta["n_layers"])
+                ],
+                version=meta["version"],
+            )
         weights = [
             (data[f"w{i}"], data[f"b{i}"]) for i in range(meta["n_layers"])
         ]
@@ -160,3 +171,132 @@ def load_scorer(path_or_bytes) -> MLPScorer:
         model_type=meta["model_type"],
         version=meta["version"],
     )
+
+
+# ---------------------------------------------------------------------------
+# GNN scorer: embedding table + head, served host-side by bucket lookup
+# ---------------------------------------------------------------------------
+
+
+def _np_gelu(x: np.ndarray) -> np.ndarray:
+    return 0.5 * x * (1.0 + np.tanh(0.7978845608 * (x + 0.044715 * x**3)))
+
+
+@dataclass
+class GNNScorer:
+    """The GAT ranker's serve-time form.
+
+    The trainer bakes the encoder INTO an embedding table (one forward pass
+    per training round — node embeddings change with the graph, not per
+    request) and exports table + head.  Serving is two table lookups and a
+    3-layer numpy head — same no-RPC hot-path budget as the MLP scorer.
+    Hosts unseen at training time fall back to the mean embedding.
+    """
+
+    buckets: np.ndarray                       # [N] sorted hash buckets
+    embeddings: np.ndarray                    # [N, D]
+    head_weights: List[Tuple[np.ndarray, np.ndarray]]
+    model_type: str = "gnn"
+    version: int = SCORER_SCHEMA_VERSION
+    # The evaluator skips per-parent featurization for scorers that rank
+    # purely from host identity (scheduler hot-path economy).
+    wants_features: bool = False
+
+    def __post_init__(self) -> None:
+        self._mean_emb = self.embeddings.mean(axis=0)
+
+    def _lookup(self, bucket_ids: np.ndarray) -> np.ndarray:
+        idx = np.searchsorted(self.buckets, bucket_ids)
+        idx = np.clip(idx, 0, len(self.buckets) - 1)
+        hit = self.buckets[idx] == bucket_ids
+        emb = self.embeddings[idx]
+        emb[~hit] = self._mean_emb
+        return emb
+
+    def score(
+        self,
+        features: np.ndarray,
+        *,
+        src_buckets: Optional[np.ndarray] = None,
+        dst_buckets: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        if src_buckets is None or dst_buckets is None:
+            raise ValueError("GNNScorer needs src/dst host buckets")
+        s = self._lookup(np.asarray(src_buckets, np.int64))
+        d = self._lookup(np.asarray(dst_buckets, np.int64))
+        x = np.concatenate([s, d, s * d], axis=-1).astype(np.float32)
+        n = len(self.head_weights)
+        for i, (w, b) in enumerate(self.head_weights):
+            x = x @ w + b
+            if i < n - 1:
+                x = _np_gelu(x)
+        return x[..., 0]
+
+
+def export_gnn_scorer(
+    model,
+    params: Dict,
+    node_feats: np.ndarray,
+    table,
+    buckets: np.ndarray,
+) -> GNNScorer:
+    """Bake the trained GATRanker into a scorer artifact.
+
+    ``buckets[i]`` is the hash bucket of graph node i (the trainer's dense
+    index ↔ host keyspace map).
+    """
+    import jax.numpy as jnp
+
+    emb = np.asarray(
+        model.apply(
+            {"params": params},
+            jnp.asarray(node_feats, jnp.float32),
+            table,
+            jnp.zeros((1,), jnp.int32),
+            jnp.zeros((1,), jnp.int32),
+            return_embeddings=True,
+        )
+    )
+    # Head layers: the Dense stack AFTER the embedding projection (Dense_0).
+    head_names = sorted(
+        (k for k in params if k.startswith("Dense_") and k != "Dense_0"),
+        key=lambda k: int(k.split("_")[1]),
+    )
+    head = [
+        (np.asarray(params[k]["kernel"], np.float32), np.asarray(params[k]["bias"], np.float32))
+        for k in head_names
+    ]
+    expected_in = 3 * emb.shape[1]
+    if head and head[0][0].shape[0] != expected_in:
+        raise ValueError(
+            f"head expects input width {head[0][0].shape[0]} but the scorer "
+            f"serves [s,d,s*d] = {expected_in}: models trained with "
+            "query_edge_feats are not exportable as a GNNScorer"
+        )
+    order = np.argsort(buckets)
+    return GNNScorer(
+        buckets=np.asarray(buckets, np.int64)[order],
+        embeddings=emb[order].astype(np.float32),
+        head_weights=head,
+    )
+
+
+def gnn_scorer_to_bytes(scorer: GNNScorer) -> bytes:
+    arrays: Dict[str, np.ndarray] = {
+        "buckets": scorer.buckets,
+        "embeddings": scorer.embeddings,
+    }
+    for i, (w, b) in enumerate(scorer.head_weights):
+        arrays[f"w{i}"] = w
+        arrays[f"b{i}"] = b
+    meta = json.dumps(
+        {
+            "model_type": "gnn",
+            "version": scorer.version,
+            "n_layers": len(scorer.head_weights),
+        }
+    )
+    arrays["meta"] = np.frombuffer(meta.encode("utf-8"), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()

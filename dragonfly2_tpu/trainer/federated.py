@@ -137,25 +137,23 @@ class FederatedTrainer:
 
     # -- local work ----------------------------------------------------------
 
-    def train_local(self, shard: ClusterShard, params) -> Tuple[dict, int]:
-        """One cluster's round: local_epochs of SGD from the global params.
-        Returns (new_params, n_samples)."""
+    def _local_step(self):
+        """One shared jitted SGD step: compiled ONCE for the whole
+        federation (S shards × R rounds would otherwise recompile S·R
+        identical programs).  The optimizer schedule uses the mean shard
+        size — per-shard step counts differ only in LR decay pacing."""
+        if getattr(self, "_step_fn", None) is not None:
+            return self._tx, self._step_fn
         cfg = self.config
-        feats_all = mask_post_hoc(
-            shard.rows[:, 2 : 2 + self.model_config.in_dim]
-        )
-        feats_all = (feats_all - self.feat_mean) / self.feat_std
-        targets_all = shard.rows[:, -1].astype(np.float32)
-
+        mean_rows = int(np.mean([s.n_samples for s in self.shards]))
         tx = _make_optimizer(
             TrainConfig(
                 learning_rate=cfg.learning_rate,
                 warmup_steps=cfg.warmup_steps,
                 epochs=cfg.local_epochs,
             ),
-            max(len(shard.rows) // cfg.batch_size, 1),
+            max(mean_rows // cfg.batch_size, 1),
         )
-        opt_state = tx.init(params)
 
         @jax.jit
         def step(params, opt_state, feats, target):
@@ -169,6 +167,21 @@ class FederatedTrainer:
             updates, opt_state = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
+        self._tx, self._step_fn = tx, step
+        return tx, step
+
+    def train_local(self, shard: ClusterShard, params) -> Tuple[dict, int]:
+        """One cluster's round: local_epochs of SGD from the global params.
+        Returns (new_params, n_samples)."""
+        cfg = self.config
+        feats_all = mask_post_hoc(
+            shard.rows[:, 2 : 2 + self.model_config.in_dim]
+        )
+        feats_all = (feats_all - self.feat_mean) / self.feat_std
+        targets_all = shard.rows[:, -1].astype(np.float32)
+
+        tx, step = self._local_step()
+        opt_state = tx.init(params)
         rng = np.random.default_rng(cfg.seed)
         b = min(cfg.batch_size, len(feats_all))
         for epoch in range(cfg.local_epochs):

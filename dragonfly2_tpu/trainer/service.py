@@ -277,11 +277,17 @@ class TrainerService:
         except ValueError as exc:
             logger.warning("run %s: GNN skipped: %s", run.key, exc)
             return
+        from ..models.gnn import GATRanker
+        from .export import export_gnn_scorer, gnn_scorer_to_bytes
+
+        scorer = export_gnn_scorer(
+            GATRanker(cfg), state.params, node_feats, table, buckets
+        )
         model = self.registry.create_model(
             name=GNN_MODEL_NAME,
             type=TrainingModelType.GNN.value,
             scheduler_id=run.scheduler_id,
-            artifact=b"",  # GNN artifact export lands with the GNN scorer (next round)
+            artifact=gnn_scorer_to_bytes(scorer),
             evaluation=metrics.to_dict(),
         )
         run.models.append(model.id)

@@ -1,0 +1,42 @@
+"""RTT probing (reference: pkg/net/ping — the ICMP prober behind the
+daemon's probe agent).
+
+ICMP needs raw sockets (CAP_NET_RAW); the deployable default here is a
+TCP-connect prober: RTT of a SYN/accept round to the target's announced
+port — measurable as an unprivileged process and monotone with network
+distance, which is all the EMA/topology pipeline needs.  An ICMP
+implementation can register behind the same callable shape.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Optional
+
+
+def tcp_ping(ip: str, port: int, *, timeout: float = 1.0) -> Optional[int]:
+    """RTT in nanoseconds of a TCP connect, or None on timeout/refusal.
+
+    1s default timeout matches the reference's ping timeout (the evaluator
+    normalizes RTT against it, evaluator_network_topology.go:53-56).
+    """
+    t0 = time.monotonic_ns()
+    try:
+        with socket.create_connection((ip, port), timeout=timeout):
+            return time.monotonic_ns() - t0
+    except OSError:
+        return None
+
+
+def make_host_pinger(*, timeout: float = 1.0):
+    """ProbeAgent-shaped pinger: Host → rtt_ns | None (ping the announced
+    download port; it is the port peers actually fetch from)."""
+
+    def ping(host) -> Optional[int]:
+        port = host.download_port or host.port
+        if not host.ip or not port:
+            return None
+        return tcp_ping(host.ip, port, timeout=timeout)
+
+    return ping

@@ -162,3 +162,22 @@ class TestMLEvaluatorLoop:
         # BASELINE configs[2]: the learned evaluator must beat the
         # rule-based one on achieved bandwidth of the chosen parent.
         assert ml_bw > rules_bw, (ml_bw, rules_bw)
+
+
+class TestGNNServing:
+    def test_gnn_scorer_artifact_serves(self, loop_artifacts):
+        """The GNN model's artifact is a real scorer: embedding-table lookup
+        + head, loadable by the subscriber and usable for ranking."""
+        reg = loop_artifacts["registry"]
+        sim = loop_artifacts["sim"]
+        gnn = reg.list(scheduler_id="scheduler-1", name=GNN_MODEL_NAME)[0]
+        assert len(reg.load_artifact(gnn)) > 0
+        reg.activate(gnn.id)
+        ev = MLEvaluator()
+        sub = ModelSubscriber(
+            reg, ev, scheduler_id="scheduler-1", model_name=GNN_MODEL_NAME
+        )
+        assert sub.refresh() is True
+        assert ev.has_model
+        quality = sim.measure_parent_choice_quality(ev, n_trials=40)
+        assert np.isfinite(quality) and quality > 0

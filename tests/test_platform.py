@@ -224,3 +224,66 @@ class TestCLI:
         cfg.write_text(f"registry:\n  blob_dir: {tmp_path}/blobs\n")
         assert manager(["--config", str(cfg), "--list-models"]) == 0
         assert "registry empty" in capsys.readouterr().out
+
+
+class TestSmallKernel:
+    def test_tcp_ping_and_pinger(self):
+        from http.server import BaseHTTPRequestHandler
+        from dragonfly2_tpu.rpc._server import ThreadedHTTPService
+        from dragonfly2_tpu.utils.ping import make_host_pinger, tcp_ping
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a): pass
+
+        svc = ThreadedHTTPService(H, "127.0.0.1", 0, "ping-target")
+        svc.serve()
+        try:
+            rtt = tcp_ping("127.0.0.1", svc.port)
+            assert rtt is not None and rtt > 0
+            from dragonfly2_tpu.scheduler.resource import Host
+
+            host = Host(id="h", hostname="h", ip="127.0.0.1", download_port=svc.port)
+            assert make_host_pinger()(host) > 0
+        finally:
+            svc.stop()
+        assert tcp_ping("127.0.0.1", 1, timeout=0.2) is None  # closed port
+
+    def test_dferrors_codes(self):
+        from dragonfly2_tpu.utils.dferrors import (
+            Code, NotFoundError, UnavailableError, is_retryable,
+        )
+
+        assert NotFoundError("x").code is Code.NOT_FOUND
+        assert is_retryable(UnavailableError("y"))
+        assert not is_retryable(NotFoundError("x"))
+        assert not is_retryable(ValueError("z"))
+
+    def test_version_metadata(self):
+        from dragonfly2_tpu.version import build_info
+
+        info = build_info()
+        assert info.version and info.python_version
+        assert "/" in info.platform
+        assert set(info.to_dict()) == {"version", "git_commit", "python_version", "platform"}
+
+    def test_scheduler_resolver_follows_dynconfig(self):
+        from dragonfly2_tpu.manager import Dynconfig, DynconfigServer
+        from dragonfly2_tpu.rpc.resolver import SchedulerResolver
+
+        server = DynconfigServer()
+        server.set("daemon", {"schedulers": [
+            {"id": "s1", "url": "http://s1:80"}, {"id": "s2", "url": "http://s2:80"}
+        ]})
+        resolver = SchedulerResolver()
+        dc = Dynconfig(lambda: server.get("daemon")[0])
+        dc.register(resolver.on_config)
+        dc.refresh()
+        assert resolver.all_urls() == ["http://s1:80", "http://s2:80"]
+        picked = {resolver.pick(f"task-{i}") for i in range(50)}
+        assert picked == {"http://s1:80", "http://s2:80"}
+        # Task affinity is stable.
+        assert resolver.pick("task-7") == resolver.pick("task-7")
+        server.set("daemon", {"schedulers": [{"id": "s1", "url": "http://s1:80"}]})
+        dc.refresh()
+        assert resolver.all_urls() == ["http://s1:80"]
+        assert resolver.pick("task-7") == "http://s1:80"

@@ -318,7 +318,7 @@ class TestMLEvaluatorFallback:
 
     def test_scorer_overrides_rules(self):
         class Inverse:
-            def score(self, feats):
+            def score(self, feats, **buckets):
                 import numpy as np
 
                 # Score by parent cpu feature ascending → deterministic control.
