@@ -135,7 +135,13 @@ class Dynconfig:
                 self._notified = True
         self._store_disk_cache(data)
         for obs in observers:
-            obs(dict(data))
+            try:
+                obs(dict(data))
+            except Exception:  # noqa: BLE001 — one bad observer must not
+                # starve the others or kill the refresh thread.
+                import logging
+
+                logging.getLogger(__name__).exception("dynconfig observer failed")
         return changed
 
     def get(self) -> Dict[str, Any]:
@@ -159,7 +165,12 @@ class Dynconfig:
 
         def loop() -> None:
             while not self._stop.wait(self._interval):
-                self.refresh()
+                try:
+                    self.refresh()
+                except Exception:  # noqa: BLE001 — the refresh thread is forever
+                    import logging
+
+                    logging.getLogger(__name__).exception("dynconfig refresh failed")
 
         self._thread = threading.Thread(target=loop, name="dynconfig", daemon=True)
         self._thread.start()

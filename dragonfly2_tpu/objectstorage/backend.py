@@ -52,9 +52,9 @@ class FilesystemBackend:
 
     def _path(self, bucket: str, key: str) -> str:
         safe = key.strip("/")
-        if not safe:
-            # An empty key would resolve to the bucket directory itself.
-            raise ValueError("empty object key")
+        if not safe or safe == ".":
+            # Would resolve to the bucket directory itself.
+            raise ValueError(f"invalid object key {key!r}")
         if ".." in safe.split("/"):
             raise ValueError(f"invalid key {key!r}")
         return os.path.join(self._bucket_dir(bucket), safe)

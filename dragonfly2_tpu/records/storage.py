@@ -36,6 +36,19 @@ DEFAULT_MAX_SIZE = 100 << 20       # bytes before rotation
 DEFAULT_MAX_BACKUPS = 10
 
 
+def _make_columnar_writer(path: str, columns: Sequence[str]):
+    """C++ engine when buildable, Python otherwise — same DFC1 format, so
+    readers never care which wrote the shard (native/src/native.cpp)."""
+    from .. import native
+
+    if native.available():
+        try:
+            return native.NativeColumnarWriter(path, columns)
+        except native.NativeError:
+            pass
+    return ColumnarWriter(path, columns)
+
+
 class _RotatingRecordFile:
     def __init__(
         self,
@@ -89,7 +102,7 @@ class _RotatingRecordFile:
         rows = [self._featurize(r) for r in records]
         rows = [r for r in rows if r.shape[0] > 0]
         if rows:
-            with ColumnarWriter(self._dfc_path, self._columns) as w:
+            with _make_columnar_writer(self._dfc_path, self._columns) as w:
                 w.append(np.concatenate(rows, axis=0))
         if os.path.getsize(self._jsonl_path) >= self._max_size:
             self._rotate_locked()

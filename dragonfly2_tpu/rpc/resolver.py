@@ -26,7 +26,13 @@ class SchedulerResolver:
         entries = config.get("schedulers", [])
         with self._mu:
             current = set(self._urls)
-            incoming = {e["id"]: e["url"] for e in entries}
+            # Skip malformed entries rather than raising — an observer
+            # exception would take down the dynconfig refresh for everyone.
+            incoming = {
+                e["id"]: e["url"]
+                for e in entries
+                if isinstance(e, dict) and e.get("id") and e.get("url")
+            }
             for gone in current - set(incoming):
                 self._ring.remove(gone)
                 del self._urls[gone]

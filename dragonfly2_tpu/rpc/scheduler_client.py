@@ -25,7 +25,9 @@ from .scheduler_server import host_from_wire, host_to_wire
 
 
 class RPCError(RuntimeError):
-    pass
+    def __init__(self, message: str, *, code: int = 0):
+        super().__init__(message)
+        self.code = code
 
 
 class RemoteScheduler:
@@ -56,11 +58,16 @@ class RemoteScheduler:
                     return json.loads(resp.read())
             except urllib.error.HTTPError as exc:
                 payload = exc.read()
+                code = 0
                 try:
-                    message = json.loads(payload).get("error", "")
+                    parsed = json.loads(payload)
+                    message = parsed.get("error", "")
+                    code = int(parsed.get("code", 0))
                 except json.JSONDecodeError:
                     message = payload[:200].decode(errors="replace")
-                raise RPCError(f"{method}: HTTP {exc.code}: {message}") from exc
+                raise RPCError(
+                    f"{method}: HTTP {exc.code}: {message}", code=code
+                ) from exc
 
         return retry_call(once, retry_on=(ConnectionError, TimeoutError, OSError))
 
@@ -139,7 +146,9 @@ class RemoteScheduler:
         try:
             resp = self._call("register_peer", req)
         except RPCError as exc:
-            if "unknown host" not in str(exc):
+            from ..utils.dferrors import Code
+
+            if exc.code != int(Code.NOT_FOUND):
                 raise
             # Scheduler restarted (or GC'd the host) since our announce:
             # re-announce and retry once.

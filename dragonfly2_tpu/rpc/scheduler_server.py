@@ -29,6 +29,7 @@ from ._server import ThreadedHTTPService
 from ..scheduler.resource import Host, Peer
 from ..scheduler.scheduling import ScheduleResultKind
 from ..scheduler.service import SchedulerService
+from ..utils.dferrors import Code
 from ..utils.types import HostType
 
 
@@ -257,10 +258,16 @@ class SchedulerHTTPServer:
                     body = json.dumps(resp).encode()
                     self.send_response(200)
                 except KeyError as exc:
-                    body = json.dumps({"error": str(exc)}).encode()
+                    # Typed code rides the payload so clients branch on it,
+                    # never on the human-readable message text.
+                    body = json.dumps(
+                        {"error": str(exc), "code": int(Code.NOT_FOUND)}
+                    ).encode()
                     self.send_response(404)
                 except Exception as exc:  # noqa: BLE001 — wire boundary
-                    body = json.dumps({"error": str(exc)}).encode()
+                    body = json.dumps(
+                        {"error": str(exc), "code": int(Code.UNKNOWN)}
+                    ).encode()
                     self.send_response(500)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))

@@ -205,8 +205,17 @@ class EdgeScorer(Protocol):
     this sits on the scheduling hot path.
     """
 
-    def score(self, features: np.ndarray) -> np.ndarray:
-        """features: [n, DOWNLOAD_FEATURE_DIM] → [n] scores."""
+    def score(
+        self,
+        features: np.ndarray,
+        *,
+        src_buckets: Optional[np.ndarray] = None,
+        dst_buckets: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """[n, DOWNLOAD_FEATURE_DIM] features (+ parent/child host hash
+        buckets) → [n] scores. Feature-based scorers may ignore the
+        buckets; identity-based scorers (GNN) may ignore the features and
+        set ``wants_features = False`` to skip featurization entirely."""
         ...
 
 

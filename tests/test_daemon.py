@@ -343,3 +343,78 @@ class TestReviewRegressions:
         # Parent attribution for pieces 0,1 intact on the peer record.
         assert reg.peer.pieces[0].parent_id == parents[0].id
         assert reg.peer.pieces[2].parent_id == ""
+
+
+class TestHostAnnouncer:
+    def test_embedded_and_wire_announce(self, tmp_path):
+        from dragonfly2_tpu.daemon.host_announcer import HostAnnouncer
+
+        swarm = _Swarm(tmp_path, n_hosts=1)
+        host = swarm.daemons[0].host
+        host.stats.cpu.percent = 0.0
+        ann = HostAnnouncer(host, swarm.scheduler, collect_stats=True)
+        ann.announce_once()
+        stored = swarm.scheduler.resource.host_manager.load(host.id)
+        assert stored is host
+        # Stats were refreshed from the real machine (memory is nonzero).
+        assert host.stats.memory.total > 0
+
+
+class TestSwarmChurn:
+    def test_quota_eviction_mid_swarm_recovers(self, tmp_path):
+        """A parent evicts a hot task under quota pressure mid-swarm; later
+        children still finish (reschedule or back-to-source) and pex no
+        longer routes to the evicted holder."""
+        swarm = _Swarm(tmp_path)
+        url = "https://origin/churn"
+        r0 = swarm.daemons[0].download(url, piece_size=PIECE, content_length=3 * PIECE)
+        swarm.daemons[1].download(url, piece_size=PIECE)
+        # Daemon 0 hits quota: its copy of the task evicts + retracts.
+        swarm.daemons[0].storage.quota_bytes = 0
+        evicted = swarm.daemons[0].reclaim()
+        assert r0.task_id in evicted
+        assert swarm.daemons[2].pex.find_peers_with_task(r0.task_id) == ["host-1"]
+        # New child still completes.
+        r2 = swarm.daemons[2].download(url, piece_size=PIECE)
+        assert r2.ok
+
+    def test_host_leave_reaps_peers_and_topology(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        url = "https://origin/leaver"
+        swarm.daemons[0].download(url, piece_size=PIECE, content_length=2 * PIECE)
+        # Probe edges exist touching host-0.
+        swarm.scheduler.networktopology.enqueue_probe(
+            "host-0", "host-1", __import__("dragonfly2_tpu.scheduler.networktopology",
+                fromlist=["Probe"]).Probe("host-1", 1000)
+        )
+        host0 = swarm.scheduler.resource.host_manager.load("host-0")
+        swarm.scheduler.leave_host(host0)
+        assert swarm.scheduler.networktopology.edge_count() == 0
+        # Peers on host-0 are in Leave and get reaped by GC.
+        reaped = swarm.scheduler.resource.peer_manager.run_gc()
+        assert reaped >= 1
+
+
+class TestNativeRecordPath:
+    def test_storage_flush_uses_native_when_available(self, tmp_path):
+        from dragonfly2_tpu import native
+        from dragonfly2_tpu.records.columnar import ColumnarReader
+        from dragonfly2_tpu.records.storage import Storage
+        from dragonfly2_tpu.records.synthetic import SyntheticCluster
+
+        st = Storage(str(tmp_path / "recs"), buffer_size=10)
+        cluster = SyntheticCluster(num_hosts=16, seed=0)
+        for dl in cluster.generate_downloads(25):
+            st.create_download(dl)
+        st.flush()
+        paths = st.download_columnar_paths()
+        assert paths
+        r = ColumnarReader(paths[0])
+        assert len(r) > 0
+        assert np.isfinite(r.to_array()).all()
+        # Mixed writers across flushes stay format-compatible.
+        for dl in cluster.generate_downloads(5):
+            st.create_download(dl)
+        st.flush()
+        r2 = ColumnarReader(paths[0])
+        assert len(r2) > len(r)
