@@ -1,0 +1,130 @@
+"""dfdaemon: the peer daemon service binary (reference: cmd/dfget daemon
+mode + client/daemon/daemon.go).
+
+Boots the full data plane against a remote scheduler: piece storage
+(native engine), HTTP piece server, host announcer, probe agent, and an
+optional P2P proxy.  ``--download URL`` performs one download through the
+running daemon and exits (smoke mode).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import time
+
+from ..config import DaemonConfig, load_config
+from ..daemon import DaemonStorage, UploadManager
+from ..daemon.conductor import Conductor
+from ..daemon.host_announcer import HostAnnouncer
+from ..rpc import HTTPPieceFetcher, PieceHTTPServer, RemoteScheduler
+from ..scheduler.resource import Host
+from ..source import PieceSourceFetcher
+from ..utils import idgen
+from ..utils.ping import make_host_pinger
+from .common import base_parser, init_logging
+
+
+def build(cfg: DaemonConfig, scheduler_url: str):
+    """Daemon composition against a wire scheduler (daemon.go:118-417)."""
+    storage = DaemonStorage(cfg.storage.dir, quota_bytes=cfg.storage.quota_bytes)
+    upload = UploadManager(storage, concurrent_limit=cfg.concurrent_upload_limit)
+    piece_server = PieceHTTPServer(upload, host=cfg.server.host)
+    piece_server.serve()
+
+    hostname = socket.gethostname()
+    from ..utils.hostinfo import local_ip
+
+    # Advertise a routable address — peers on OTHER machines dial it.
+    ip = cfg.server.advertise_ip or local_ip()
+    host = Host(
+        # The piece port joins the identity so multiple daemons on one
+        # machine are distinct hosts (reference: hostname-port host ids,
+        # pkg/idgen/host_id.go v1).
+        id=idgen.host_id_v2(ip, f"{hostname}-{piece_server.port}"),
+        hostname=hostname,
+        ip=ip,
+        port=cfg.server.port,
+        download_port=piece_server.port,
+        concurrent_upload_limit=cfg.concurrent_upload_limit,
+    )
+    client = RemoteScheduler(scheduler_url)
+    conductor = Conductor(
+        host,
+        storage,
+        client,
+        piece_fetcher=HTTPPieceFetcher(client.resolve_host),
+        source_fetcher=PieceSourceFetcher(),
+    )
+    announcer = HostAnnouncer(host, client)
+    return {
+        "storage": storage,
+        "upload": upload,
+        "piece_server": piece_server,
+        "host": host,
+        "client": client,
+        "conductor": conductor,
+        "announcer": announcer,
+    }
+
+
+def run(argv=None) -> int:
+    p = base_parser("dfdaemon", "Peer daemon service")
+    p.add_argument("--scheduler", required=True, help="scheduler RPC URL")
+    p.add_argument("--download", default=None, metavar="URL",
+                   help="download one URL through the daemon and exit")
+    p.add_argument("-O", "--output", default=None, help="output path (--download)")
+    args = p.parse_args(argv)
+    init_logging(args, "dfdaemon")
+
+    cfg = load_config(DaemonConfig, args.config)
+    parts = build(cfg, args.scheduler)
+    parts["announcer"].serve()
+
+    if args.download:
+        source = parts["conductor"].source_fetcher
+        content_length = source.content_length(args.download)
+        if content_length < 0:
+            print(f"dfdaemon: cannot size {args.download}", file=sys.stderr)
+            return 1
+        result = parts["conductor"].download(
+            args.download, piece_size=cfg.piece_size, content_length=content_length
+        )
+        if not result.ok:
+            print("dfdaemon: download failed", file=sys.stderr)
+            return 1
+        if args.output:
+            with open(args.output, "wb") as f:
+                f.write(parts["storage"].read_task_bytes(result.task_id))
+        mode = "back-to-source" if result.back_to_source else "p2p"
+        print(f"dfdaemon: {result.pieces} pieces via {mode} in {result.cost_s:.2f}s")
+        return 0
+
+    # Probe loop against the remote scheduler.
+    ping = make_host_pinger()
+    print(
+        f"dfdaemon: serving pieces on :{parts['piece_server'].port}, "
+        f"scheduler {args.scheduler} (ctrl-c to stop)"
+    )
+    try:
+        while True:
+            time.sleep(cfg.probe_interval_s)
+            try:
+                targets = parts["client"].sync_probes_start(parts["host"])
+                results = []
+                for t in targets:
+                    rtt = ping(t)
+                    if rtt is not None:
+                        results.append((t.id, rtt))
+                if results:
+                    parts["client"].sync_probes_finished(parts["host"], results)
+            except Exception:  # noqa: BLE001 — probe failures must not kill the daemon
+                pass
+    except KeyboardInterrupt:
+        parts["piece_server"].stop()
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

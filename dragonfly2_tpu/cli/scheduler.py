@@ -100,11 +100,16 @@ def run(argv=None) -> int:
         return 0
 
     runner.start()
-    print(f"scheduler: serving on {cfg.server.host}:{cfg.server.port} (ctrl-c to stop)")
+    from ..rpc import SchedulerHTTPServer
+
+    rpc_server = SchedulerHTTPServer(service, host=cfg.server.host, port=cfg.server.port)
+    rpc_server.serve()
+    print(f"scheduler: serving rpc on {rpc_server.url} (ctrl-c to stop)")
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        rpc_server.stop()
         return 0
 
 

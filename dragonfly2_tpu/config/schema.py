@@ -29,7 +29,8 @@ class ServerConfig:
     advertise_ip: str = ""
 
     def validate(self) -> None:
-        if not (0 < self.port < 65536):
+        # 0 = OS-assigned ephemeral port (tests / sidecar deployments).
+        if not (0 <= self.port < 65536):
             raise ConfigError(f"server.port {self.port} out of range")
 
 

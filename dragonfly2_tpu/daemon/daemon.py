@@ -93,21 +93,9 @@ class Daemon:
         return result
 
     def read_task_bytes(self, task_id: str) -> bytes:
-        """Reassemble a completed task's content from its pieces (shared by
-        dfget output, the object gateway, and the proxy)."""
-        total = self.storage.engine.content_length(task_id)
-        ps = self.storage.engine.piece_size(task_id)
-        if total < 0 or ps <= 0:
-            raise KeyError(f"task {task_id} has no header")
-        out = bytearray()
-        remaining = total
-        n = 0
-        while remaining > 0:
-            piece = self.storage.read_piece(task_id, n)
-            out += piece[: min(len(piece), remaining)]
-            remaining -= len(piece)
-            n += 1
-        return bytes(out)
+        """Reassemble a completed task's content (storage-level impl, shared
+        by dfget output, the object gateway, the proxy, and dfdaemon)."""
+        return self.storage.read_task_bytes(task_id)
 
     def delete_task(self, task_id: str) -> None:
         """Evict local data and withdraw the pex advertisement."""

@@ -242,6 +242,22 @@ class DaemonStorage:
     def task_bytes(self, task_id: str) -> int:
         return self.engine.task_bytes(task_id)
 
+    def read_task_bytes(self, task_id: str) -> bytes:
+        """Reassemble a completed task's content from its pieces."""
+        total = self.engine.content_length(task_id)
+        ps = self.engine.piece_size(task_id)
+        if total < 0 or ps <= 0:
+            raise KeyError(f"task {task_id} has no header")
+        out = bytearray()
+        remaining = total
+        n = 0
+        while remaining > 0:
+            piece = self.read_piece(task_id, n)
+            out += piece[: min(len(piece), remaining)]
+            remaining -= len(piece)
+            n += 1
+        return bytes(out)
+
     def total_bytes(self) -> int:
         with self._mu:
             tids = list(self._tasks)

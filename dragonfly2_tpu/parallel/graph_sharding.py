@@ -83,3 +83,143 @@ def pad_nodes_for_mesh(n_nodes: int, mesh: Mesh, *, axis: str = DATA_AXIS) -> in
     """Node count rounded up so every shard is equal (static shapes)."""
     n = mesh.shape[axis]
     return ((n_nodes + n - 1) // n) * n
+
+
+# ---------------------------------------------------------------------------
+# Halo exchange: ship only the boundary rows, not the whole table
+# ---------------------------------------------------------------------------
+
+
+class HaloPlan:
+    """Host-side exchange plan for one graph snapshot.
+
+    The full all-gather moves N·D floats to every device per layer; with a
+    locality-partitioned graph each shard's neighbors mostly live on-shard,
+    so only the **halo** — the off-shard rows its table references — needs
+    to move.  The plan is static-shape (max-halo padded) so XLA compiles
+    once; rebuild it when the graph snapshot changes, not per step.
+
+    - send_idx   [n, n, H]  — for src device i: local rows to ship to each
+                              dest j (row i used inside shard i).
+    - local_idx  [N, K]     — the table's global indices remapped into each
+                              shard's local space: [0,S) own rows, then
+                              halo slots [S + j·H + p].
+    - halo       H          — max off-shard rows needed from any one shard.
+    """
+
+    def __init__(
+        self, n_shards: int, shard_size: int, send_idx, local_idx, halo: int,
+        table_digest: str = "",
+    ):
+        self.n_shards = n_shards
+        self.shard_size = shard_size
+        self.send_idx = send_idx
+        self.local_idx = local_idx
+        self.halo = halo
+        # Fingerprint of the table's indices at plan time: the plan remaps
+        # THOSE indices, so pairing it with a resampled table would
+        # silently misalign features.
+        self.table_digest = table_digest
+
+
+def _table_digest(table: NeighborTable) -> str:
+    import hashlib
+    import numpy as np
+
+    return hashlib.sha1(np.asarray(table.indices).tobytes()).hexdigest()[:16]
+
+
+def build_halo_plan(table: NeighborTable, mesh: Mesh, *, axis: str = DATA_AXIS) -> HaloPlan:
+    import numpy as np
+
+    n = mesh.shape[axis]
+    indices = np.asarray(table.indices)
+    N, K = indices.shape
+    if N % n:
+        raise ValueError(f"node count {N} not divisible by {n} shards")
+    S = N // n
+
+    # needed[j][i]: sorted unique global rows shard j needs from shard i.
+    needed = [[None] * n for _ in range(n)]
+    halo = 0
+    for j in range(n):
+        block = indices[j * S : (j + 1) * S]
+        uniq = np.unique(block)
+        for i in range(n):
+            rows = uniq[(uniq >= i * S) & (uniq < (i + 1) * S)]
+            if i == j:
+                rows = rows[:0]  # own rows need no exchange
+            needed[j][i] = rows
+            halo = max(halo, len(rows))
+    halo = max(halo, 1)
+
+    # send_idx[i][j]: local offsets shard i ships to shard j (pad with 0).
+    send_idx = np.zeros((n, n, halo), dtype=np.int32)
+    # position map for remapping: global id → local slot on shard j.
+    local_idx = np.empty_like(indices)
+    for j in range(n):
+        remap = {}
+        for p in range(S):
+            remap[j * S + p] = p
+        for i in range(n):
+            rows = needed[j][i]
+            send_idx[i, j, : len(rows)] = rows - i * S
+            for p, g in enumerate(rows):
+                remap[int(g)] = S + i * halo + p
+        block = indices[j * S : (j + 1) * S]
+        flat = np.array([remap[int(g)] for g in block.ravel()], dtype=np.int32)
+        local_idx[j * S : (j + 1) * S] = flat.reshape(S, K)
+    return HaloPlan(
+        n, S, jnp.asarray(send_idx), jnp.asarray(local_idx), halo,
+        table_digest=_table_digest(table),
+    )
+
+
+def halo_neighbor_aggregate(
+    mesh: Mesh,
+    h: jax.Array,
+    table: NeighborTable,
+    plan: HaloPlan,
+    *,
+    axis: str = DATA_AXIS,
+) -> jax.Array:
+    """Masked-mean aggregation with boundary-only exchange.
+
+    Per layer, one all-to-all of [n·H, D] rows replaces the [N, D]
+    all-gather — with a locality-aware partition H ≪ S and the collective
+    traffic drops by ~S/H.  Numerically identical to the full exchange.
+    """
+    if plan.table_digest and plan.table_digest != _table_digest(table):
+        raise ValueError(
+            "HaloPlan was built for a different table sampling — rebuild "
+            "the plan whenever build_neighbor_table resamples (per epoch)"
+        )
+
+    def body(h_block, my_send_idx, local_idx, mask, edge_feats):
+        # h_block [S, D]; my_send_idx [1, n, H] (this device's row of the
+        # plan); gather outgoing halo rows and all-to-all them.
+        send = jnp.take(h_block, my_send_idx[0], axis=0)        # [n, H, D]
+        recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0, tiled=False)
+        # recv [n, H, D]: slice i = rows shipped by shard i to this shard.
+        local = jnp.concatenate(
+            [h_block, recv.reshape(-1, h_block.shape[-1])], axis=0
+        )                                                        # [S + n·H, D]
+        nbr = jnp.take(local, local_idx, axis=0)                 # [S, K, D]
+        nbr = jnp.concatenate([nbr, edge_feats.astype(nbr.dtype)], axis=-1)
+        m = mask.astype(nbr.dtype)[..., None]
+        denom = jnp.maximum(m.sum(axis=1), 1.0)
+        return (nbr * m).sum(axis=1) / denom
+
+    sharded = P(axis)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(sharded, sharded, sharded, sharded, sharded),
+        out_specs=sharded,
+    )(
+        h,
+        plan.send_idx,            # dim 0 (src device) sharded
+        plan.local_idx,
+        table.mask,
+        table.edge_feats,
+    )

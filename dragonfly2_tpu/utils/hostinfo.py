@@ -198,6 +198,11 @@ def _read_cpu() -> CPUStat:
     return _default_cpu_sampler.read()
 
 
+def local_ip() -> str:
+    """Best-effort routable local IP (the address peers should dial)."""
+    return _local_ip()
+
+
 def _local_ip() -> str:
     try:
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)

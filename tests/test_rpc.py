@@ -209,3 +209,76 @@ class TestConcurrentWire:
         t2 = threading.Thread(target=dl, args=(2,))
         t1.start(); t2.start(); t1.join(); t2.join()
         assert results[1].ok and results[2].ok
+
+
+class TestServiceBinaries:
+    def test_scheduler_process_and_dfdaemon_builds(self, tmp_path):
+        """Real deployment shape: the scheduler CLI binary serving RPC in a
+        separate OS process; two dfdaemon compositions downloading through
+        it — one seeds from a file:// origin, the second gets P2P."""
+        import os
+        import subprocess
+        import sys
+        import time
+
+        cfg = tmp_path / "sched.yaml"
+        cfg.write_text(
+            f"storage:\n  dir: {tmp_path}/records\nserver:\n  host: 127.0.0.1\n  port: 0\n"
+        )
+        # port 0 → need the bound port; patch: run a tiny launcher that prints it.
+        launcher = (
+            "import sys\n"
+            "from dragonfly2_tpu.cli.scheduler import build\n"
+            "from dragonfly2_tpu.config import SchedulerConfigFile, load_config\n"
+            "from dragonfly2_tpu.rpc import SchedulerHTTPServer\n"
+            "cfg = load_config(SchedulerConfigFile, sys.argv[1])\n"
+            "service, storage, runner = build(cfg)\n"
+            "srv = SchedulerHTTPServer(service, port=0)\n"
+            "srv.serve()\n"
+            "print('READY', srv.url, flush=True)\n"
+            "import time; time.sleep(60)\n"
+        )
+        env = {**os.environ, "PYTHONPATH": os.getcwd()}
+        proc = subprocess.Popen(
+            [sys.executable, "-c", launcher, str(cfg)],
+            stdout=subprocess.PIPE, text=True, env=env,
+        )
+        try:
+            line = proc.stdout.readline().strip()
+            assert line.startswith("READY"), line
+            url = line.split()[1]
+
+            from dragonfly2_tpu.cli.dfdaemon import build as build_daemon
+            from dragonfly2_tpu.config import DaemonConfig
+
+            payload = os.urandom(200_000)
+            blob = tmp_path / "origin.bin"
+            blob.write_bytes(payload)
+            src_url = f"file://{blob}"
+
+            nodes = []
+            for i in range(2):
+                dc = DaemonConfig()
+                dc.storage.dir = str(tmp_path / f"dd{i}")
+                dc.piece_size = 65536
+                nodes.append(build_daemon(dc, url))
+            for n in nodes:
+                n["announcer"].announce_once()
+            r0 = nodes[0]["conductor"].download(
+                src_url, piece_size=65536, content_length=len(payload)
+            )
+            assert r0.ok and r0.back_to_source
+            r1 = nodes[1]["conductor"].download(src_url, piece_size=65536)
+            assert r1.ok and not r1.back_to_source
+            assert nodes[0]["upload"].upload_count == r1.pieces
+            got = bytearray()
+            rem = len(payload)
+            for n in range(r1.pieces):
+                piece = nodes[1]["storage"].read_piece(r1.task_id, n)
+                got += piece[: min(len(piece), rem)]
+                rem -= len(piece)
+            assert bytes(got) == payload
+            for n in nodes:
+                n["piece_server"].stop()
+        finally:
+            proc.terminate()
