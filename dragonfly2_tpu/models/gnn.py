@@ -199,12 +199,21 @@ class GATLayer(nn.Module):
         H, W = self.num_heads, self.width
         h = h.astype(self.dtype)
         q = nn.Dense(H * W, dtype=self.dtype, param_dtype=jnp.float32)(h)
-        k = nn.Dense(H * W, dtype=self.dtype, param_dtype=jnp.float32)(h)
-        v = nn.Dense(H * W, dtype=self.dtype, param_dtype=jnp.float32)(h)
         N, K = table.indices.shape
         q = q.reshape(N, H, W)
-        k_n = jnp.take(k, table.indices, axis=0).reshape(N, K, H, W)
-        v_n = jnp.take(v, table.indices, axis=0).reshape(N, K, H, W)
+        # Gather the raw neighbor rows ONCE and project k/v AFTER the
+        # gather: identical linear algebra, but one [N,K,D] gather (and one
+        # backward scatter) instead of two — the gather traffic, not the
+        # extra post-gather matmul FLOPs, dominates this layer on TPU
+        # (BENCHMARKS.md lever #2; measured ~25 ms per gather+grad at
+        # [100k,16,128]).
+        h_n = jnp.take(h, table.indices, axis=0)               # [N, K, D]
+        k_n = nn.Dense(H * W, dtype=self.dtype, param_dtype=jnp.float32)(h_n).reshape(
+            N, K, H, W
+        )
+        v_n = nn.Dense(H * W, dtype=self.dtype, param_dtype=jnp.float32)(h_n).reshape(
+            N, K, H, W
+        )
         # Edge features bias the attention logit per head.
         e_bias = nn.Dense(H, dtype=self.dtype, param_dtype=jnp.float32)(
             table.edge_feats.astype(self.dtype)
