@@ -1,0 +1,38 @@
+"""Scheduler metrics (reference: scheduler/metrics/metrics.go:44-180 —
+~40 prometheus series: announce/register/download/piece totals+failures,
+traffic by type, concurrency gauges).
+
+Defined on the process-default registry; the service layer incs them at
+the same seams the reference's handlers do. `expose_text()` is served by
+the metrics port.
+"""
+
+from __future__ import annotations
+
+from ..utils.metrics import default_registry as _reg
+
+REGISTER_PEER_TOTAL = _reg.counter(
+    "scheduler_register_peer_total", "RegisterPeer requests", ["result"]
+)
+SCHEDULE_TOTAL = _reg.counter(
+    "scheduler_schedule_total", "Scheduling outcomes", ["outcome"]
+)
+SCHEDULE_RETRIES = _reg.histogram(
+    "scheduler_schedule_retries", "Retries per scheduling round",
+    buckets=(0, 1, 2, 3, 4, 5),
+)
+PIECE_RESULT_TOTAL = _reg.counter(
+    "scheduler_piece_result_total", "Reported piece results", ["result"]
+)
+PEER_RESULT_TOTAL = _reg.counter(
+    "scheduler_peer_result_total", "Reported peer results", ["result"]
+)
+DOWNLOAD_RECORDS_TOTAL = _reg.counter(
+    "scheduler_download_records_total", "Training records written"
+)
+PROBE_SYNC_TOTAL = _reg.counter(
+    "scheduler_probe_sync_total", "SyncProbes rounds", ["phase"]
+)
+HOSTS_GAUGE = _reg.gauge("scheduler_hosts", "Registered hosts")
+PEERS_GAUGE = _reg.gauge("scheduler_peers", "Live peers")
+TASKS_GAUGE = _reg.gauge("scheduler_tasks", "Live tasks")

@@ -30,6 +30,7 @@ from ..records.storage import Storage
 from ..utils import idgen
 from ..utils.fsm import FSM, InvalidEventError
 from ..utils.types import HostType, Priority, SizeScope
+from . import metrics
 from .networktopology import NetworkTopology, Probe
 from .resource import Host, Peer, Piece, Resource, Task
 from .scheduling import ScheduleResult, ScheduleResultKind, Scheduling
@@ -114,9 +115,13 @@ class SchedulerService:
         # the event is then a legal no-op, not an error.
         if scope is SizeScope.EMPTY:
             _try_event(peer.fsm, "RegisterEmpty")
+            metrics.REGISTER_PEER_TOTAL.inc(result="ok")
+            self._refresh_gauges()
             return RegisterResult(peer=peer, size_scope=scope)
         if scope is SizeScope.TINY and task.can_reuse_direct_piece():
             _try_event(peer.fsm, "RegisterTiny")
+            metrics.REGISTER_PEER_TOTAL.inc(result="ok")
+            self._refresh_gauges()
             return RegisterResult(
                 peer=peer, size_scope=scope, direct_piece=task.direct_piece
             )
@@ -125,12 +130,21 @@ class SchedulerService:
         else:
             _try_event(peer.fsm, "RegisterNormal")
         schedule = self.scheduling.schedule_candidate_parents(peer, blocklist)
+        metrics.SCHEDULE_TOTAL.inc(outcome=schedule.kind.name.lower())
+        metrics.SCHEDULE_RETRIES.observe(schedule.retries)
+        metrics.REGISTER_PEER_TOTAL.inc(result="ok")
+        self._refresh_gauges()
         if schedule.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
             task.back_to_source_peers.add(peer.id)
             _try_event(peer.fsm, "DownloadBackToSource")
         elif schedule.kind is ScheduleResultKind.PARENTS:
             _try_event(peer.fsm, "Download")
         return RegisterResult(peer=peer, size_scope=scope, schedule=schedule)
+
+    def _refresh_gauges(self) -> None:
+        metrics.HOSTS_GAUGE.set(len(self.resource.host_manager))
+        metrics.PEERS_GAUGE.set(len(self.resource.peer_manager))
+        metrics.TASKS_GAUGE.set(len(self.resource.task_manager))
 
     def set_task_info(
         self,
@@ -165,6 +179,7 @@ class SchedulerService:
         cost_ns: int = 0,
     ) -> None:
         """DownloadPieceFinishedRequest (service_v2.go:1157)."""
+        metrics.PIECE_RESULT_TOTAL.inc(result="finished")
         peer.finish_piece(piece_number, cost_ns, parent_id=parent_id, length=length)
         peer.task.store_piece(
             Piece(piece_number, parent_id=parent_id, length=length, cost_ns=cost_ns)
@@ -173,40 +188,51 @@ class SchedulerService:
     def report_piece_failed(self, peer: Peer, parent_id: str) -> ScheduleResult:
         """Piece failure → blocklist the parent and reschedule
         (service handleDownloadPieceFailedRequest)."""
+        metrics.PIECE_RESULT_TOTAL.inc(result="failed")
         peer.block_parents.add(parent_id)
-        return self.scheduling.schedule_candidate_parents(peer)
+        result = self.scheduling.schedule_candidate_parents(peer)
+        metrics.SCHEDULE_TOTAL.inc(outcome=result.kind.name.lower())
+        metrics.SCHEDULE_RETRIES.observe(result.retries)
+        return result
 
     def report_peer_finished(self, peer: Peer) -> None:
         """handlePeerSuccess (:1284) + createDownloadRecord (:1418-1629)."""
+        metrics.PEER_RESULT_TOTAL.inc(result="succeeded")
         _try_event(peer.fsm, "DownloadSucceeded")
         peer.cost_ns = int((time.time() - peer.created_at) * 1e9)
         task = peer.task
         _try_event(task.fsm, "DownloadSucceeded")
         if self.storage is not None:
             self.storage.create_download(self._build_download_record(peer))
+            metrics.DOWNLOAD_RECORDS_TOTAL.inc()
 
     def report_peer_failed(self, peer: Peer) -> None:
+        metrics.PEER_RESULT_TOTAL.inc(result="failed")
         _try_event(peer.fsm, "DownloadFailed")
         if self.storage is not None:
             self.storage.create_download(
                 self._build_download_record(peer, state="Failed")
             )
+            metrics.DOWNLOAD_RECORDS_TOTAL.inc()
 
     def leave_peer(self, peer: Peer) -> None:
         _try_event(peer.fsm, "Leave")
         peer.task.delete_peer_in_edges(peer.id)
         peer.task.delete_peer_out_edges(peer.id)
+        self._refresh_gauges()
 
     def leave_host(self, host: Host) -> None:
         host.leave_peers()
         if self.networktopology is not None:
             self.networktopology.delete_host(host.id)
+        self._refresh_gauges()
 
     # -- probes (service_v2.go:721-866 SyncProbes) ---------------------------
 
     def sync_probes_start(self, host: Host) -> List[Host]:
         if self.networktopology is None:
             return []
+        metrics.PROBE_SYNC_TOTAL.inc(phase="start")
         return self.networktopology.find_probed_hosts(host.id)
 
     def sync_probes_finished(
@@ -215,6 +241,7 @@ class SchedulerService:
         """results: [(dest_host_id, rtt_ns)]"""
         if self.networktopology is None:
             return
+        metrics.PROBE_SYNC_TOTAL.inc(phase="finished")
         for dest_id, rtt_ns in results:
             self.networktopology.store(host.id, dest_id)
             self.networktopology.enqueue_probe(

@@ -24,6 +24,7 @@ import logging
 import os
 import shutil
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -38,6 +39,7 @@ from ..records.features import (
 )
 from ..utils import idgen
 from ..utils.types import TrainingModelType
+from . import metrics as trainer_metrics
 from .export import export_from_state, scorer_to_bytes
 from .ingest import EdgeBatches
 from .train import EvalMetrics, TrainConfig, train_mlp
@@ -158,13 +160,18 @@ class TrainerService:
         return key
 
     def _run_training(self, run: TrainRun, session: TrainSession) -> None:
+        t0 = time.perf_counter()
         try:
             self._train_mlp(run, session)
             self._train_gnn(run, session)
         except Exception as exc:  # noqa: BLE001 — surfaced on the run record
             logger.exception("training run %s failed", run.key)
             run.error = str(exc)
+            trainer_metrics.TRAINING_TOTAL.inc(model="all", result="failure")
+        else:
+            trainer_metrics.TRAINING_TOTAL.inc(model="all", result="success")
         finally:
+            trainer_metrics.TRAINING_DURATION.observe(time.perf_counter() - t0)
             run.done.set()
 
     def _train_mlp(self, run: TrainRun, session: TrainSession) -> None:
@@ -213,6 +220,8 @@ class TrainerService:
         )
         run.models.append(model.id)
         run.metrics[MLP_MODEL_NAME] = metrics
+        trainer_metrics.TRAINING_RECORDS.inc(run.download_rows, model="mlp")
+        trainer_metrics.MODELS_PUBLISHED.inc(model="mlp")
 
     def _train_gnn(self, run: TrainRun, session: TrainSession) -> None:
         """GNN over the probe graph; needs both topology and download rows."""
@@ -292,3 +301,5 @@ class TrainerService:
         )
         run.models.append(model.id)
         run.metrics[GNN_MODEL_NAME] = metrics
+        trainer_metrics.TRAINING_RECORDS.inc(len(d_src), model="gnn")
+        trainer_metrics.MODELS_PUBLISHED.inc(model="gnn")

@@ -287,3 +287,48 @@ class TestSmallKernel:
         dc.refresh()
         assert resolver.all_urls() == ["http://s1:80"]
         assert resolver.pick("task-7") == "http://s1:80"
+
+
+class TestServiceMetrics:
+    def test_scheduler_metrics_increment(self, tmp_path):
+        from dragonfly2_tpu.scheduler import metrics as sm
+        from tests.test_daemon import _Swarm
+
+        before = sm.PEER_RESULT_TOTAL.value(result="succeeded")
+        before_rec = sm.DOWNLOAD_RECORDS_TOTAL.value()
+        from dragonfly2_tpu.records.storage import Storage
+
+        store = Storage(str(tmp_path / "r"), buffer_size=1)
+        swarm = _Swarm(tmp_path, n_hosts=2, record_storage=store)
+        swarm.daemons[0].download(
+            "https://origin/m", piece_size=65536, content_length=2 * 65536
+        )
+        assert sm.PEER_RESULT_TOTAL.value(result="succeeded") == before + 1
+        assert sm.DOWNLOAD_RECORDS_TOTAL.value() == before_rec + 1
+        assert sm.PIECE_RESULT_TOTAL.value(result="finished") >= 2
+        from dragonfly2_tpu.utils.metrics import default_registry
+
+        text = default_registry.expose_text()
+        assert "scheduler_peer_result_total" in text
+
+    def test_trainer_metrics_increment(self, tmp_path, cluster):
+        from dragonfly2_tpu.manager import ModelRegistry
+        from dragonfly2_tpu.records.columnar import ColumnarWriter
+        from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
+        from dragonfly2_tpu.trainer import metrics as tm
+        from dragonfly2_tpu.trainer.service import TrainerService
+        from dragonfly2_tpu.trainer.train import TrainConfig
+
+        before = tm.MODELS_PUBLISHED.value(model="mlp")
+        shard = tmp_path / "download-0.dfc"
+        with ColumnarWriter(str(shard), DOWNLOAD_COLUMNS) as w:
+            w.append(cluster.generate_feature_rows(1500, seed=9))
+        svc = TrainerService(
+            ModelRegistry(), train_config=TrainConfig(epochs=2, warmup_steps=5)
+        )
+        session = svc.open_train_stream(ip="1.1.1.1", hostname="t", scheduler_id="s")
+        session.send_download_shard(str(shard))
+        key = session.close_and_train()
+        assert svc.runs[key].error is None
+        assert tm.MODELS_PUBLISHED.value(model="mlp") == before + 1
+        assert tm.TRAINING_TOTAL.value(model="all", result="success") >= 1
