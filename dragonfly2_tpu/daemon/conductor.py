@@ -21,6 +21,7 @@ from typing import List, Optional, Protocol
 from ..scheduler.resource import Host, Peer
 from ..scheduler.service import SchedulerService
 from ..scheduler.scheduling import ScheduleResultKind
+from ..utils.types import TINY_FILE_SIZE
 from .storage import DaemonStorage
 from .traffic_shaper import TrafficShaper
 
@@ -83,6 +84,22 @@ class Conductor:
         reg = self.scheduler.register_peer(host=self.host, url=url)
         peer = reg.peer
         task = peer.task
+
+        if reg.direct_piece:
+            # TINY shortcut: the content arrived inline with registration —
+            # no piece transfer at all (service_v1 tiny response).
+            self.storage.register_task(
+                task.id, piece_size=piece_size, content_length=len(reg.direct_piece)
+            )
+            self.storage.write_piece(task.id, 0, reg.direct_piece)
+            self.scheduler.report_piece_finished(
+                peer, 0, parent_id="", length=len(reg.direct_piece), cost_ns=1
+            )
+            self.scheduler.report_peer_finished(peer)
+            return DownloadResult(
+                ok=True, task_id=task.id, peer_id=peer.id, pieces=1,
+                bytes=len(reg.direct_piece), cost_s=time.monotonic() - t0,
+            )
 
         # First peer in the swarm learns content length from the origin and
         # reports it through the scheduler API (so remote schedulers learn).
@@ -188,6 +205,16 @@ class Conductor:
             self.scheduler.report_piece_finished(
                 peer, number, parent_id="", length=len(data), cost_ns=cost_ns
             )
+            # First fetcher of a TINY task publishes the bytes inline so
+            # later peers skip the transfer entirely.
+            if (
+                number == 0
+                and 0 < task.content_length <= TINY_FILE_SIZE
+                and hasattr(self.scheduler, "set_task_direct_piece")
+            ):
+                self.scheduler.set_task_direct_piece(
+                    peer, data[: task.content_length]
+                )
         self.scheduler.report_peer_finished(peer)
         return DownloadResult(
             ok=True,

@@ -10,6 +10,7 @@ the scheduler's view through the gRPC stream).
 
 from __future__ import annotations
 
+import base64
 import json
 import threading
 import urllib.error
@@ -173,8 +174,12 @@ class RemoteScheduler:
             schedule = ScheduleResult(kind=ScheduleResultKind.PARENTS, parents=parents)
         else:
             schedule = ScheduleResult(kind=ScheduleResultKind.NEED_BACK_TO_SOURCE)
+        direct = base64.b64decode(resp.get("direct_piece", "") or "")
         return RegisterResult(
-            peer=peer, size_scope=SizeScope(resp["size_scope"]), schedule=schedule
+            peer=peer,
+            size_scope=SizeScope(resp["size_scope"]),
+            schedule=schedule,
+            direct_piece=direct,
         )
 
     def set_task_info(
@@ -225,6 +230,12 @@ class RemoteScheduler:
         if peer.fsm.can("DownloadFailed"):
             peer.fsm.event("DownloadFailed")
         self._call("report_peer_failed", {"peer_id": peer.id})
+
+    def set_task_direct_piece(self, peer: Peer, data: bytes) -> None:
+        self._call(
+            "set_task_direct_piece",
+            {"peer_id": peer.id, "data_b64": base64.b64encode(data).decode()},
+        )
 
     def mark_back_to_source(self, peer: Peer) -> None:
         if peer.fsm.can("DownloadBackToSource"):

@@ -19,6 +19,7 @@ Wire methods:
 
 from __future__ import annotations
 
+import base64
 import json
 import threading
 from http.server import BaseHTTPRequestHandler
@@ -126,6 +127,9 @@ class SchedulerRPCAdapter:
             "peer_id": peer.id,
             "task_id": task.id,
             "size_scope": int(result.size_scope),
+            "direct_piece": base64.b64encode(result.direct_piece).decode()
+            if result.direct_piece
+            else "",
             "content_length": task.content_length,
             "total_piece_count": task.total_piece_count,
             "piece_size": task.piece_size,
@@ -190,6 +194,12 @@ class SchedulerRPCAdapter:
         self.service.report_peer_failed(self._peer(req["peer_id"]))
         return {}
 
+    def set_task_direct_piece(self, req: dict) -> dict:
+        self.service.set_task_direct_piece(
+            self._peer(req["peer_id"]), base64.b64decode(req["data_b64"])
+        )
+        return {}
+
     def mark_back_to_source(self, req: dict) -> dict:
         self.service.mark_back_to_source(self._peer(req["peer_id"]))
         return {}
@@ -222,6 +232,7 @@ class SchedulerRPCAdapter:
             "report_piece_failed",
             "report_peer_finished",
             "report_peer_failed",
+            "set_task_direct_piece",
             "mark_back_to_source",
             "leave_peer",
             "sync_probes_start",

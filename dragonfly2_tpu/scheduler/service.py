@@ -29,7 +29,7 @@ from ..records import schema
 from ..records.storage import Storage
 from ..utils import idgen
 from ..utils.fsm import FSM, InvalidEventError
-from ..utils.types import HostType, Priority, SizeScope
+from ..utils.types import TINY_FILE_SIZE, HostType, Priority, SizeScope
 from . import metrics
 from .networktopology import NetworkTopology, Probe
 from .resource import Host, Peer, Piece, Resource, Task
@@ -69,12 +69,19 @@ class SchedulerService:
         scheduling: Scheduling,
         storage: Optional[Storage] = None,
         networktopology: Optional[NetworkTopology] = None,
+        *,
+        seed_peer_trigger=None,
     ) -> None:
         self.resource = resource
         self.scheduling = scheduling
         self.storage = storage
         self.networktopology = networktopology
+        # Optional callable(url, task_id) -> bool: asks a seed peer to warm
+        # the task (resource/seed_peer.go:93-229 TriggerDownloadTask; wired
+        # to a seed daemon's conductor in-process, an RPC in deployments).
+        self.seed_peer_trigger = seed_peer_trigger
         self._mu = threading.Lock()
+        self._seed_triggered: set = set()  # task ids already warmed
 
     # -- registration -------------------------------------------------------
 
@@ -130,6 +137,32 @@ class SchedulerService:
         else:
             _try_event(peer.fsm, "RegisterNormal")
         schedule = self.scheduling.schedule_candidate_parents(peer, blocklist)
+        if (
+            schedule.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE
+            and self.seed_peer_trigger is not None
+            and not task.has_available_peer()
+        ):
+            # Cold task: warm a seed peer first, then reschedule once —
+            # the child gets a parent instead of hitting the origin
+            # (service_v2.go:1370 downloadTaskBySeedPeer).  Once per task,
+            # claimed under the lock: the seed's OWN registration re-enters
+            # this path (observed: unbounded recursive triggering without
+            # the claim), and concurrent cold registrations must not launch
+            # duplicate seed downloads.  The trigger is synchronous here
+            # (in-process seed); the wire deployment should pass an async
+            # trigger and rely on the client's reschedule-on-piece-failure.
+            with self._mu:
+                first = task.id not in self._seed_triggered
+                if first:
+                    self._seed_triggered.add(task.id)
+            triggered = False
+            if first:
+                try:
+                    triggered = self.seed_peer_trigger(task.url, task.id)
+                except Exception:  # noqa: BLE001 — trigger failure → back-to-source
+                    triggered = False
+            if triggered:
+                schedule = self.scheduling.schedule_candidate_parents(peer, blocklist)
         metrics.SCHEDULE_TOTAL.inc(outcome=schedule.kind.name.lower())
         metrics.SCHEDULE_RETRIES.observe(schedule.retries)
         metrics.REGISTER_PEER_TOTAL.inc(result="ok")
@@ -161,6 +194,21 @@ class SchedulerService:
                 task.content_length = content_length
                 task.total_piece_count = total_piece_count
                 task.piece_size = piece_size
+
+    def set_task_direct_piece(self, peer: Peer, data: bytes) -> None:
+        """First peer of a TINY task publishes the content inline; later
+        registrations get the bytes in the response instead of scheduling
+        (task.go DirectPiece / service_v1 tiny shortcut)."""
+        task = peer.task
+        with self._mu:
+            if (
+                not task.direct_piece
+                and 0 < len(data) <= TINY_FILE_SIZE
+                and len(data) == task.content_length
+            ):
+                # Must cover the WHOLE content (can_reuse_direct_piece
+                # compares lengths) — a short read would poison the slot.
+                task.direct_piece = data
 
     def mark_back_to_source(self, peer: Peer) -> None:
         """Peer fell back to origin download (conductor's source path)."""

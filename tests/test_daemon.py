@@ -418,3 +418,41 @@ class TestNativeRecordPath:
         st.flush()
         r2 = ColumnarReader(paths[0])
         assert len(r2) > len(r)
+
+
+class TestTinyAndSeedTrigger:
+    def test_tiny_direct_piece_roundtrip(self, tmp_path):
+        """First peer publishes a <=128B task inline; the second peer gets
+        the bytes with registration — zero transfers."""
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        url = "https://origin/tiny-manifest"
+        payload = b"x" * 100
+
+        class TinyOrigin:
+            def fetch(self, u, n, ps):
+                return payload
+
+        swarm.daemons[0].conductor.source_fetcher = TinyOrigin()
+        r0 = swarm.daemons[0].download(url, piece_size=65536, content_length=100)
+        assert r0.ok and r0.back_to_source
+        task = swarm.scheduler.resource.task_manager.load(r0.task_id)
+        assert task.direct_piece == payload
+        # Second peer: inline bytes, no fetch, no parent.
+        r1 = swarm.daemons[1].download(url, piece_size=65536)
+        assert r1.ok and not r1.back_to_source and r1.bytes == 100
+        assert swarm.daemons[1].storage.read_piece(r1.task_id, 0) == payload
+        assert swarm.daemons[0].upload.upload_count == 0
+
+    def test_seed_peer_trigger_warms_cold_task(self, tmp_path):
+        """A cold task triggers a seed-peer download so the first normal
+        peer gets a parent instead of going back-to-source."""
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        seed = swarm.daemons[0]
+        swarm.scheduler.seed_peer_trigger = lambda url, tid: seed.download(
+            url, piece_size=PIECE, content_length=2 * PIECE
+        ).ok
+        r = swarm.daemons[1].download(
+            "https://origin/cold", piece_size=PIECE, content_length=2 * PIECE
+        )
+        assert r.ok and not r.back_to_source
+        assert seed.upload.upload_count == 2  # served both pieces
