@@ -19,7 +19,12 @@ from .common import base_parser, init_logging
 
 
 def build(cfg: ManagerConfig):
-    registry = ModelRegistry(BlobStore(cfg.registry.blob_dir))
+    import os
+
+    registry = ModelRegistry(
+        BlobStore(cfg.registry.blob_dir),
+        db_path=os.path.join(cfg.registry.blob_dir, "manager.db"),
+    )
     clusters = ClusterManager(keepalive_ttl=cfg.keepalive_ttl_s)
     return {
         "registry": registry,
@@ -50,11 +55,19 @@ def run(argv=None) -> int:
             )
         return 0
 
-    print(f"manager: serving on {cfg.server.host}:{cfg.server.port} (ctrl-c to stop)")
+    from ..manager.rest import ManagerRESTServer
+
+    rest = ManagerRESTServer(
+        parts["registry"], parts["clusters"], parts["searcher"],
+        host=cfg.server.host, port=cfg.server.port,
+    )
+    rest.serve()
+    print(f"manager: serving REST on {rest.url} (ctrl-c to stop)")
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        rest.stop()
         return 0
 
 

@@ -80,13 +80,106 @@ class BlobStore:
         return key in self._mem
 
 
-class ModelRegistry:
-    """The registry service (manager CreateModel + model REST CRUD)."""
+class _SQLiteModelStore:
+    """Durable model rows (reference: manager/models + database — GORM over
+    MySQL/Postgres; sqlite is the embedded equivalent).  The registry is
+    the source of truth in memory; every mutation writes through, and a
+    restarted manager reloads the full model table."""
 
-    def __init__(self, blob_store: Optional[BlobStore] = None) -> None:
+    def __init__(self, path: str) -> None:
+        import sqlite3
+
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._mu = threading.Lock()
+        with self._mu:
+            self._conn.execute(
+                """CREATE TABLE IF NOT EXISTS models (
+                    id TEXT PRIMARY KEY,
+                    name TEXT NOT NULL,
+                    type TEXT NOT NULL,
+                    version INTEGER NOT NULL,
+                    scheduler_id TEXT NOT NULL,
+                    state TEXT NOT NULL,
+                    evaluation TEXT NOT NULL,
+                    blob_key TEXT NOT NULL,
+                    created_at REAL NOT NULL,
+                    updated_at REAL NOT NULL
+                )"""
+            )
+            self._conn.commit()
+
+    def upsert_many(self, models) -> None:
+        """All rows in ONE transaction — activation flips two rows and a
+        crash between separate commits would leave two ACTIVE versions."""
+        import json
+
+        rows = [
+            (
+                m.id, m.name, m.type, m.version, m.scheduler_id,
+                m.state.value, json.dumps(m.evaluation), m.blob_key,
+                m.created_at, m.updated_at,
+            )
+            for m in models
+        ]
+        with self._mu:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO models VALUES (?,?,?,?,?,?,?,?,?,?)", rows
+            )
+            self._conn.commit()
+
+    def upsert(self, m: Model) -> None:
+        self.upsert_many([m])
+
+    def delete(self, model_id: str) -> None:
+        with self._mu:
+            self._conn.execute("DELETE FROM models WHERE id = ?", (model_id,))
+            self._conn.commit()
+
+    def load_all(self) -> Dict[str, Model]:
+        import json
+
+        with self._mu:
+            rows = self._conn.execute("SELECT * FROM models").fetchall()
+        out: Dict[str, Model] = {}
+        for r in rows:
+            out[r[0]] = Model(
+                id=r[0], name=r[1], type=r[2], version=r[3], scheduler_id=r[4],
+                state=ModelState(r[5]), evaluation=json.loads(r[6]),
+                blob_key=r[7], created_at=r[8], updated_at=r[9],
+            )
+        return out
+
+    def close(self) -> None:
+        with self._mu:
+            self._conn.close()
+
+
+class ModelRegistry:
+    """The registry service (manager CreateModel + model REST CRUD).
+
+    ``db_path`` enables durable rows (sqlite): every mutation writes
+    through and a restart reloads the table — models survive the manager
+    the way the reference's DB rows do.
+    """
+
+    def __init__(
+        self,
+        blob_store: Optional[BlobStore] = None,
+        *,
+        db_path: Optional[str] = None,
+    ) -> None:
         self._mu = threading.RLock()
         self._models: Dict[str, Model] = {}
         self.blobs = blob_store or BlobStore()
+        self._db: Optional[_SQLiteModelStore] = None
+        if db_path:
+            self._db = _SQLiteModelStore(db_path)
+            self._models = self._db.load_all()
+
+    def _persist(self, *models: Model) -> None:
+        if self._db is not None:
+            self._db.upsert_many(models)
 
     # -- CreateModel (manager_server_v1.go:802-901) -------------------------
 
@@ -135,6 +228,7 @@ class ModelRegistry:
                 blob_key=blob_key,
             )
             self._models[model.id] = model
+            self._persist(model)
             return model
 
     # -- activation (service/model.go:103-190) ------------------------------
@@ -146,6 +240,7 @@ class ModelRegistry:
             model = self._models.get(model_id)
             if model is None:
                 raise KeyError(model_id)
+            changed = [model]
             for other in self._models.values():
                 if (
                     other.scheduler_id == model.scheduler_id
@@ -154,8 +249,10 @@ class ModelRegistry:
                 ):
                     other.state = ModelState.INACTIVE
                     other.updated_at = time.time()
+                    changed.append(other)
             model.state = ModelState.ACTIVE
             model.updated_at = time.time()
+            self._persist(*changed)
             return model
 
     def deactivate(self, model_id: str) -> Model:
@@ -163,11 +260,14 @@ class ModelRegistry:
             model = self._models[model_id]
             model.state = ModelState.INACTIVE
             model.updated_at = time.time()
+            self._persist(model)
             return model
 
     def delete(self, model_id: str) -> None:
         with self._mu:
             self._models.pop(model_id, None)
+            if self._db is not None:
+                self._db.delete(model_id)
 
     # -- reads ---------------------------------------------------------------
 

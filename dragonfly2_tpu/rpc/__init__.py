@@ -22,3 +22,4 @@ from .piece_transport import HTTPPieceFetcher, PieceHTTPServer  # noqa: F401
 from .retry import retry_call  # noqa: F401
 from .scheduler_client import RemoteScheduler  # noqa: F401
 from .scheduler_server import SchedulerHTTPServer  # noqa: F401
+from .trainer_transport import RemoteTrainer, TrainerHTTPServer  # noqa: F401

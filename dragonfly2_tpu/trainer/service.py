@@ -71,6 +71,7 @@ class TrainSession:
         self.scheduler_id = scheduler_id
         self.download_shards: List[str] = []
         self.topology_shards: List[str] = []
+        self.chunk_seq: Dict = {}  # (kind, name) -> last applied chunk seq
 
     def send_download_shard(self, path: str) -> None:
         self.download_shards.append(
@@ -128,20 +129,33 @@ class TrainerService:
         return staged
 
     def receive_shard_bytes(
-        self, session: TrainSession, kind: str, name: str, data: bytes
+        self, session: TrainSession, kind: str, name: str, data: bytes, *, seq: int = 0
     ) -> None:
-        """Remote path: raw columnar bytes land in the staging dir."""
+        """Remote path: raw columnar bytes land in the staging dir.
+
+        Chunks append in ``seq`` order; a RETRIED chunk (same or lower seq
+        than already applied) is a no-op — wire clients retry on lost
+        responses and a blind append would duplicate 128 MiB blocks into
+        the dataset.
+        """
         if self.data_dir is None:
             raise RuntimeError("byte ingest requires a data_dir")
         staged_dir = os.path.join(self.data_dir, session.host_key)
         os.makedirs(staged_dir, exist_ok=True)
         staged = os.path.join(staged_dir, f"{kind}_{name}")
-        with open(staged, "wb") as f:
+        applied = session.chunk_seq.get((kind, name), -1)
+        if seq <= applied:
+            return  # duplicate delivery
+        if seq != applied + 1:
+            raise ValueError(f"chunk gap for {kind}/{name}: got {seq}, want {applied + 1}")
+        with open(staged, "wb" if seq == 0 else "ab") as f:
             f.write(data)
-        if kind == "download":
-            session.download_shards.append(staged)
-        else:
-            session.topology_shards.append(staged)
+        session.chunk_seq[(kind, name)] = seq
+        if seq == 0:
+            if kind == "download":
+                session.download_shards.append(staged)
+            else:
+                session.topology_shards.append(staged)
 
     # -- training ------------------------------------------------------------
 

@@ -151,3 +151,30 @@ class TestClusterManager:
         cm.keepalive("s1")
         cm.ttl = 60.0
         assert [s.id for s in cm.active_schedulers()] == ["s1"]
+
+
+class TestRegistryPersistence:
+    def test_models_survive_restart(self, tmp_path):
+        from dragonfly2_tpu.manager.registry import BlobStore
+
+        db = str(tmp_path / "manager.db")
+        blobs = str(tmp_path / "blobs")
+        reg = ModelRegistry(BlobStore(blobs), db_path=db)
+        m1 = reg.create_model(name="m", type="mlp", scheduler_id="s1", artifact=b"v1")
+        m2 = reg.create_model(name="m", type="mlp", scheduler_id="s1", artifact=b"v2")
+        reg.activate(m2.id)
+        reg.create_model(name="g", type="gnn", scheduler_id="s1", artifact=b"gg")
+
+        # "Restart": a new registry over the same db + blob dir.
+        reg2 = ModelRegistry(BlobStore(blobs), db_path=db)
+        models = reg2.list(scheduler_id="s1", name="m")
+        assert [m.version for m in models] == [1, 2]
+        assert reg2.active_model("s1", "m").version == 2
+        assert reg2.load_artifact(models[1]) == b"v2"
+        # Versioning continues past the restart.
+        m3 = reg2.create_model(name="m", type="mlp", scheduler_id="s1", artifact=b"v3")
+        assert m3.version == 3
+        # Deletion persists.
+        reg2.delete(m1.id)
+        reg3 = ModelRegistry(BlobStore(blobs), db_path=db)
+        assert [m.version for m in reg3.list(scheduler_id="s1", name="m")] == [2, 3]
