@@ -113,6 +113,7 @@ class StreamingTrainer:
         self.moments = RunningMoments(self.model_config.in_dim)
         self.records_seen = 0
         self._leftover: Optional[np.ndarray] = None
+        self._bias_initialized = False
         self._init_state()
         self._step_fn = jax.jit(self._train_step, donate_argnums=(0, 1))
 
@@ -206,6 +207,21 @@ class StreamingTrainer:
                 break
             feats = mask_post_hoc(batch[:, 2 : 2 + DOWNLOAD_FEATURE_DIM])
             target = batch[:, -1].astype(np.float32)
+            if not self._bias_initialized:
+                # Start the output bias at the first batch's target mean:
+                # with Huber's linear tail a zero-init regressor ~17
+                # log-units from the targets needs thousands of steps just
+                # to close the constant offset (same fix as federated.py).
+                last = max(
+                    (k for k in self.params if k.startswith("Dense_")),
+                    key=lambda k: int(k.split("_")[1]),
+                )
+                self.params = dict(self.params)
+                self.params[last] = dict(self.params[last])
+                self.params[last]["bias"] = (
+                    jnp.asarray(self.params[last]["bias"]) + float(target.mean())
+                )
+                self._bias_initialized = True
             self.moments.update(feats)
             self.records_seen += len(batch)
             self.params, self.opt_state, loss = self._step_fn(
@@ -239,6 +255,7 @@ class StreamingTrainer:
             "opt_state": self.opt_state,
             "step": self.step,
             "records_seen": self.records_seen,
+            "bias_initialized": int(self._bias_initialized),
             "moments": self.moments.to_arrays(),
         }
         ckptr.save(self._ckpt_path(), payload, force=True)
@@ -257,6 +274,7 @@ class StreamingTrainer:
             "opt_state": self.opt_state,
             "step": 0,
             "records_seen": 0,
+            "bias_initialized": 0,
             "moments": self.moments.to_arrays(),
         }
         restored = ckptr.restore(path, abstract)
@@ -264,6 +282,7 @@ class StreamingTrainer:
         self.opt_state = restored["opt_state"]
         self.step = int(restored["step"])
         self.records_seen = int(restored["records_seen"])
+        self._bias_initialized = bool(restored.get("bias_initialized", 1))
         self.moments = RunningMoments.from_arrays(restored["moments"])
         return True
 
