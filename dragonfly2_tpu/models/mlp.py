@@ -52,3 +52,24 @@ class MLPRegressor(nn.Module):
                 x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         x = nn.Dense(1, dtype=jnp.float32, param_dtype=jnp.float32)(x)
         return x[..., 0]
+
+
+def warm_start_output_bias(params: dict, target_mean: float) -> dict:
+    """Return params with the OUTPUT layer's bias shifted by target_mean.
+
+    Regression warm start: with Huber's linear tail, a zero-init head that
+    is many log-units from the targets spends thousands of steps closing a
+    constant offset.  The output layer is the highest-numbered top-level
+    Dense submodule (flax auto-naming); streaming and federated trainers
+    share this single definition.
+    """
+    import jax.numpy as jnp
+
+    last = max(
+        (k for k in params if k.startswith("Dense_")),
+        key=lambda k: int(k.split("_")[1]),
+    )
+    out = dict(params)
+    out[last] = dict(out[last])
+    out[last]["bias"] = jnp.asarray(out[last]["bias"]) + float(target_mean)
+    return out

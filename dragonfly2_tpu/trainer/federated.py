@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.mlp import MLPConfig, MLPRegressor
+from ..models.mlp import MLPConfig, MLPRegressor, warm_start_output_bias
 from ..records.features import DOWNLOAD_FEATURE_DIM, mask_post_hoc
 from .export import MLPScorer, export_mlp_scorer
 from .ingest import EdgeBatches
@@ -124,15 +124,7 @@ class FederatedTrainer:
             sum(float(s.rows[:, -1].sum()) for s in self.shards)
             / max(sum(s.n_samples for s in self.shards), 1)
         )
-        last = max(
-            (k for k in self.global_params if k.startswith("Dense_")),
-            key=lambda k: int(k.split("_")[1]),
-        )
-        self.global_params = dict(self.global_params)
-        self.global_params[last] = dict(self.global_params[last])
-        self.global_params[last]["bias"] = (
-            jnp.asarray(self.global_params[last]["bias"]) + target_mean
-        )
+        self.global_params = warm_start_output_bias(self.global_params, target_mean)
         self.history: List[Dict] = []
 
     # -- local work ----------------------------------------------------------

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.mlp import MLPConfig, MLPRegressor
+from ..models.mlp import MLPConfig, MLPRegressor, warm_start_output_bias
 from ..records.features import DOWNLOAD_FEATURE_DIM, mask_post_hoc
 from .train import TrainConfig, _huber, _make_optimizer
 
@@ -208,18 +208,11 @@ class StreamingTrainer:
             feats = mask_post_hoc(batch[:, 2 : 2 + DOWNLOAD_FEATURE_DIM])
             target = batch[:, -1].astype(np.float32)
             if not self._bias_initialized:
-                # Start the output bias at the first batch's target mean:
-                # with Huber's linear tail a zero-init regressor ~17
-                # log-units from the targets needs thousands of steps just
-                # to close the constant offset (same fix as federated.py).
-                last = max(
-                    (k for k in self.params if k.startswith("Dense_")),
-                    key=lambda k: int(k.split("_")[1]),
-                )
-                self.params = dict(self.params)
-                self.params[last] = dict(self.params[last])
-                self.params[last]["bias"] = (
-                    jnp.asarray(self.params[last]["bias"]) + float(target.mean())
+                # First batch's target mean warm-starts the output bias
+                # (models.mlp.warm_start_output_bias — shared with the
+                # federated trainer).
+                self.params = warm_start_output_bias(
+                    self.params, float(target.mean())
                 )
                 self._bias_initialized = True
             self.moments.update(feats)
@@ -277,12 +270,19 @@ class StreamingTrainer:
             "bias_initialized": 0,
             "moments": self.moments.to_arrays(),
         }
-        restored = ckptr.restore(path, abstract)
+        try:
+            restored = ckptr.restore(path, abstract)
+            self._bias_initialized = bool(restored["bias_initialized"])
+        except Exception:  # noqa: BLE001 — legacy checkpoint (pre-flag)
+            del abstract["bias_initialized"]
+            restored = ckptr.restore(path, abstract)
+            # A legacy checkpoint has trained params: the bias offset is
+            # already baked in — re-applying it would corrupt the model.
+            self._bias_initialized = True
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         self.step = int(restored["step"])
         self.records_seen = int(restored["records_seen"])
-        self._bias_initialized = bool(restored.get("bias_initialized", 1))
         self.moments = RunningMoments.from_arrays(restored["moments"])
         return True
 
