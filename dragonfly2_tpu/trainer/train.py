@@ -371,6 +371,12 @@ def _train_graph_model(
     if query_edge_feats is not None:
         sample_args = sample_args + (jnp.zeros((b0, query_edge_feats.shape[1]), jnp.float32),)
     params = model.init(init_rng, *sample_args)["params"]
+    # Output-bias warm start at the training-split target mean (shared fix:
+    # models.mlp.warm_start_output_bias — Huber's linear tail otherwise
+    # spends the whole run closing the constant offset on short schedules).
+    from ..models.mlp import warm_start_output_bias
+
+    params = warm_start_output_bias(params, float(edge_target[train_idx].mean()))
 
     steps_per_epoch = max(len(train_idx) // b0, 1)
     state = TrainState.create(
