@@ -31,6 +31,10 @@ class PieceFetcher(Protocol):
         """Fetch one piece from a parent; raises on failure."""
         ...
 
+    def piece_bitmap(self, parent_host_id: str, task_id: str):
+        """Optional piece-metadata sync: bytes (1 per held piece) or None."""
+        ...
+
 
 class SourceFetcher(Protocol):
     def fetch(self, url: str, number: int, piece_size: int) -> bytes:
@@ -139,12 +143,31 @@ class Conductor:
         failed = 0
         nbytes = 0
         parents = list(parents)
+        # Piece-metadata sync (SyncPieceTasks analog): ask each parent which
+        # pieces it holds so workers skip guaranteed 404s — partial holders
+        # (mid-download parents, tail-only reloads) stop costing a failed
+        # fetch per missing piece.
+        bitmaps = {}
+        if hasattr(self.piece_fetcher, "piece_bitmap"):
+            for p in parents:
+                bm = self.piece_fetcher.piece_bitmap(p.host.id, task.id)
+                if bm is not None:
+                    bitmaps[p.id] = bm
+
+        def holds(parent, number):
+            bm = bitmaps.get(parent.id)
+            return bm is None or (number < len(bm) and bm[number])
+
         for number in range(n_pieces):
             if not parents:
                 return None
             done = False
             for attempt in range(self.max_piece_retries + 1):
-                parent = parents[(number + attempt) % len(parents)]
+                # Recomputed each attempt: a mid-piece reschedule replaces
+                # `parents` and the fresh assignment must be tried NOW, not
+                # after the retry budget burns on the dead one.
+                preferred = [p for p in parents if holds(p, number)] or parents
+                parent = preferred[(number + attempt) % len(preferred)]
                 try:
                     t_piece = time.monotonic()
                     data = self.piece_fetcher.fetch(parent.host.id, task.id, number)
@@ -154,6 +177,15 @@ class Conductor:
                     res = self.scheduler.report_piece_failed(peer, parent.id)
                     if res.kind is ScheduleResultKind.PARENTS and res.parents:
                         parents = list(res.parents)
+                        for p in parents:
+                            if p.id not in bitmaps and hasattr(
+                                self.piece_fetcher, "piece_bitmap"
+                            ):
+                                bm = self.piece_fetcher.piece_bitmap(
+                                    p.host.id, task.id
+                                )
+                                if bm is not None:
+                                    bitmaps[p.id] = bm
                     elif res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
                         return None
                     continue

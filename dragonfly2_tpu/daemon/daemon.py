@@ -33,6 +33,17 @@ class InProcessFetcher:
             raise KeyError(f"no daemon for host {parent_host_id}")
         return daemon.upload.serve_piece(task_id, number)
 
+    def piece_bitmap(self, parent_host_id: str, task_id: str):
+        """Piece-metadata sync for the in-process transport (same contract
+        as HTTPPieceFetcher.piece_bitmap)."""
+        daemon = self._registry.get(parent_host_id)
+        if daemon is None:
+            return None
+        n = daemon.storage.n_pieces(task_id)
+        if n <= 0:
+            return None
+        return bytes(daemon.storage.piece_bitmap(task_id, n))
+
 
 class Daemon:
     def __init__(
@@ -119,9 +130,7 @@ class Daemon:
             for task_id in loaded:
                 # True piece-count bound from the task header, not a guess —
                 # a daemon holding only the tail pieces must still advertise.
-                cl = self.storage.engine.content_length(task_id)
-                ps = self.storage.engine.piece_size(task_id)
-                n_pieces = (cl + ps - 1) // ps if cl > 0 and ps > 0 else 0
+                n_pieces = self.storage.n_pieces(task_id)
                 if n_pieces <= 0:
                     continue
                 bm = self.storage.piece_bitmap(task_id, n_pieces)

@@ -242,6 +242,15 @@ class DaemonStorage:
     def task_bytes(self, task_id: str) -> int:
         return self.engine.task_bytes(task_id)
 
+    def n_pieces(self, task_id: str) -> int:
+        """Piece count from the task header; -1 when the header is absent
+        or invalid (single owner of the ceil-div + validity idiom)."""
+        total = self.engine.content_length(task_id)
+        ps = self.engine.piece_size(task_id)
+        if total < 0 or ps <= 0:
+            return -1
+        return (total + ps - 1) // ps
+
     def read_task_bytes(self, task_id: str) -> bytes:
         """Reassemble a completed task's content from its pieces."""
         total = self.engine.content_length(task_id)

@@ -48,6 +48,19 @@ class PieceHTTPServer:
                         data = upload_ref.serve_piece(task_id, number)
                         self._send(200, data)
                         return
+                    if len(parts) == 3 and parts[0] == "tasks" and parts[2] == "pieces":
+                        # Piece-metadata sync (reference: SyncPieceTasks —
+                        # peers learn which pieces a parent holds before
+                        # fetching).  Body: the piece bitmap, one byte per
+                        # piece.
+                        task_id = parts[1]
+                        n_pieces = upload_ref.storage.n_pieces(task_id)
+                        if n_pieces <= 0:
+                            self.send_error(404)
+                            return
+                        bm = upload_ref.storage.piece_bitmap(task_id, n_pieces)
+                        self._send(200, bytes(bm))
+                        return
                     if len(parts) == 2 and parts[0] == "tasks":
                         task_id = parts[1]
                         rng = self.headers.get("Range", "")
@@ -114,9 +127,13 @@ class HTTPPieceFetcher:
         resolve: Callable[[str], Tuple[str, int]],
         *,
         timeout: float = 30.0,
+        metadata_timeout: float = 2.0,
     ):
         self._resolve = resolve
         self.timeout = timeout
+        # Bitmap queries are a pre-fetch optimization — a blackholed parent
+        # must not stall the download for the full piece timeout.
+        self.metadata_timeout = metadata_timeout
 
     def fetch(self, parent_host_id: str, task_id: str, number: int) -> bytes:
         ip, port = self._resolve(parent_host_id)
@@ -138,6 +155,19 @@ class HTTPPieceFetcher:
                 raise _PieceUnavailable(f"HTTP {exc.code} from {url}") from exc
 
         return retry_call(once, attempts=2, retry_on=(ConnectionError, TimeoutError))
+
+    def piece_bitmap(self, parent_host_id: str, task_id: str):
+        """Which pieces the parent holds (None when unknown/unreachable)."""
+        try:
+            ip, port = self._resolve(parent_host_id)
+        except KeyError:
+            return None
+        url = f"http://{ip}:{port}/tasks/{task_id}/pieces"
+        try:
+            with urllib.request.urlopen(url, timeout=self.metadata_timeout) as resp:
+                return resp.read()
+        except (urllib.error.URLError, OSError):
+            return None
 
 
 def resolver_from_hosts(hosts: Dict[str, "object"]) -> Callable[[str], Tuple[str, int]]:

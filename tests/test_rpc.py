@@ -361,3 +361,43 @@ class TestTrainerWire:
             assert ColumnarReader(staged).num_rows == 4000
         finally:
             server.stop()
+
+
+class TestPieceMetadataSync:
+    def test_bitmap_endpoint_and_partial_parent(self, wire_swarm):
+        """A partial holder's bitmap steers piece workers to the full
+        holder instead of burning a failed fetch per missing piece."""
+        import urllib.request
+
+        nodes = wire_swarm["nodes"]
+        url = "https://origin/partial"
+        r0 = nodes[0].conductor.download(url, piece_size=PIECE, content_length=4 * PIECE)
+        # node-1 becomes a PARTIAL holder: manually store only piece 0.
+        task_id = r0.task_id
+        nodes[1].storage.register_task(task_id, piece_size=PIECE, content_length=4 * PIECE)
+        nodes[1].storage.write_piece(
+            task_id, 0, nodes[0].storage.read_piece(task_id, 0)
+        )
+        # Bitmap endpoint reflects the holdings.
+        bm_url = f"http://127.0.0.1:{nodes[1].piece_server.port}/tasks/{task_id}/pieces"
+        with urllib.request.urlopen(bm_url, timeout=5) as resp:
+            bm = resp.read()
+        assert list(bm) == [1, 0, 0, 0]
+        # Unknown host → None (mirror hasn't seen node-1 yet), known → bitmap.
+        assert nodes[2].conductor.piece_fetcher.piece_bitmap("node-1", task_id) is None
+        got = nodes[1].conductor.piece_fetcher.piece_bitmap("node-1", task_id)
+        assert got is None or list(got) == [1, 0, 0, 0]
+        nodes[1].client.announce_host(nodes[1].host)
+        got = nodes[1].conductor.piece_fetcher.piece_bitmap("node-1", task_id)
+        assert list(got) == [1, 0, 0, 0]
+        # Register node-1 as a "succeeded" peer so the scheduler offers it;
+        # node-2 must still complete cleanly (workers avoid the holes).
+        reg = nodes[1].client.register_peer(host=nodes[1].host, url=url)
+        for n in range(4):
+            nodes[1].client.report_piece_finished(reg.peer, n, length=PIECE, cost_ns=1000)
+        nodes[1].client.report_peer_finished(reg.peer)
+        r2 = nodes[2].conductor.download(url, piece_size=PIECE)
+        assert r2.ok
+        for n in range(4):
+            assert nodes[2].storage.read_piece(r2.task_id, n) == \
+                wire_swarm["origin"].content(url, n)
