@@ -9,7 +9,6 @@ running daemon and exits (smoke mode).
 
 from __future__ import annotations
 
-import os
 import socket
 import sys
 import time

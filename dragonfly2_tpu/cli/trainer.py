@@ -14,7 +14,7 @@ import sys
 import time
 
 from ..config import TrainerConfigFile, load_config
-from ..manager.registry import BlobStore, ModelRegistry
+from ..manager.registry import ModelRegistry
 from ..trainer.service import TrainerService
 from ..trainer.train import TrainConfig
 from .common import base_parser, init_logging

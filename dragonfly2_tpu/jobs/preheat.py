@@ -14,9 +14,9 @@ to fetch the URL, so subsequent peers find a warm parent.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
-from .queue import GroupJob, JobQueue, Worker
+from .queue import GroupJob, JobQueue
 
 PREHEAT = "preheat"
 

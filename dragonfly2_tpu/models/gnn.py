@@ -28,7 +28,7 @@ bf16 compute, f32 params and softmax/loss reductions.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional
 
 import flax.linen as nn
 import jax

@@ -19,7 +19,7 @@ the ingest path feeding it (columnar mmap → device).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Sequence, Tuple
+from typing import Tuple
 
 import flax.linen as nn
 import jax

@@ -10,11 +10,9 @@ from __future__ import annotations
 
 import hashlib
 import os
-import shutil
 import threading
-import time
 from dataclasses import dataclass
-from typing import Dict, Iterator, List, Optional, Protocol
+from typing import Dict, List, Optional, Protocol
 
 
 @dataclass

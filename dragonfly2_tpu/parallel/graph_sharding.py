@@ -21,8 +21,6 @@ boundary node set per shard.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax import shard_map

@@ -24,7 +24,7 @@ import json
 import os
 import struct
 from dataclasses import dataclass
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, Sequence
 
 import numpy as np
 

@@ -18,12 +18,10 @@ Feature engineering notes (TPU-first):
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional
 
 import numpy as np
 
-from ..utils.types import HostType
 from .schema import Download, HostRecord, NetworkTopologyRecord, Parent
 
 # ---------------------------------------------------------------------------

@@ -23,7 +23,7 @@ import base64
 import json
 import threading
 from http.server import BaseHTTPRequestHandler
-from typing import Dict, Optional, Tuple
+from typing import Tuple
 
 from ._server import ThreadedHTTPService
 

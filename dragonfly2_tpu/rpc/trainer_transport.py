@@ -23,7 +23,7 @@ import threading
 import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 from ..trainer.service import TrainerService, TrainSession
 from ._server import ThreadedHTTPService

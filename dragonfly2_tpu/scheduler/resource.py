@@ -29,7 +29,6 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
 from ..records import schema
-from ..utils import idgen
 from ..utils.dag import DAG, DAGError
 from ..utils.fsm import FSM, EventDesc
 from ..utils.hostinfo import BuildInfo, CPUStat, DiskStat, MemoryStat, NetworkStat

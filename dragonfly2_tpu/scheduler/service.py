@@ -29,7 +29,7 @@ from ..records import schema
 from ..records.storage import Storage
 from ..utils import idgen
 from ..utils.fsm import FSM, InvalidEventError
-from ..utils.types import TINY_FILE_SIZE, HostType, Priority, SizeScope
+from ..utils.types import TINY_FILE_SIZE, Priority, SizeScope
 from . import metrics
 from .networktopology import NetworkTopology, Probe
 from .resource import Host, Peer, Piece, Resource, Task

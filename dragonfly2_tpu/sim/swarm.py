@@ -13,7 +13,6 @@ parents for a fresh child and compare achieved ground-truth bandwidth.
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 

@@ -24,21 +24,19 @@ global scorer artifact serves every cluster.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..models.mlp import MLPConfig, MLPRegressor, warm_start_output_bias
-from ..records.features import DOWNLOAD_FEATURE_DIM, mask_post_hoc
+from ..records.features import mask_post_hoc
 from .export import MLPScorer, export_mlp_scorer
-from .ingest import EdgeBatches
 from .train import (
     EvalMetrics,
     TrainConfig,
-    TrainState,
     _huber,
     _make_optimizer,
     _regression_metrics,

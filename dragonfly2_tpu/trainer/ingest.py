@@ -17,14 +17,13 @@ batch is assembled by the sharding, not by any host.
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
-from ..records.columnar import ColumnarReader, concat_readers
+from ..records.columnar import concat_readers
 from ..records.features import DOWNLOAD_COLUMNS, DOWNLOAD_FEATURE_DIM
 
 

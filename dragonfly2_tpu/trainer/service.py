@@ -31,12 +31,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..manager.registry import ModelRegistry
-from ..records.columnar import ColumnarReader, concat_readers
-from ..records.features import (
-    DOWNLOAD_COLUMNS,
-    HOST_FEATURE_DIM,
-    TOPO_COLUMNS,
-)
+from ..records.columnar import concat_readers
+from ..records.features import HOST_FEATURE_DIM
 from ..utils import idgen
 from ..utils.types import TrainingModelType
 from . import metrics as trainer_metrics

@@ -22,10 +22,8 @@ from __future__ import annotations
 
 import os
 import queue
-import threading
-import time
-from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional
+from dataclasses import dataclass
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -33,7 +31,7 @@ import numpy as np
 
 from ..models.mlp import MLPConfig, MLPRegressor, warm_start_output_bias
 from ..records.features import DOWNLOAD_FEATURE_DIM, mask_post_hoc
-from .train import TrainConfig, _huber, _make_optimizer
+from .train import _huber
 
 
 @dataclass

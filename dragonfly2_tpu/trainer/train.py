@@ -18,8 +18,7 @@ GNN → additionally precision/recall/F1 of "good parent" classification
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
-from functools import partial
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -27,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from flax.training import train_state
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..models.gnn import GATRanker, GNNConfig, GraphSAGE, NeighborTable
 from ..models.mlp import MLPConfig, MLPRegressor
@@ -158,6 +157,11 @@ def train_mlp(
     init_rng, dropout_rng = jax.random.split(rng)
     sample = jnp.zeros((2, mcfg.in_dim), jnp.float32)
     params = model.init(init_rng, sample)["params"]
+    from ..models.mlp import warm_start_output_bias
+
+    params = warm_start_output_bias(
+        params, float(train_data.rows[:, -1].mean())
+    )
     train_feats = train_data.rows[:, 2 : 2 + mcfg.in_dim]
     feat_mean = jnp.asarray(train_feats.mean(axis=0), jnp.float32)
     raw_std = train_feats.std(axis=0)

@@ -13,7 +13,7 @@ peer streams concurrently.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Generic, Iterator, Optional, Set, TypeVar
+from typing import Dict, Generic, Iterator, Set, TypeVar
 
 V = TypeVar("V")
 

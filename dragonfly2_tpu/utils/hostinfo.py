@@ -12,7 +12,6 @@ from __future__ import annotations
 import os
 import platform
 import socket
-import time
 from dataclasses import dataclass, field, asdict
 from typing import Optional
 
