@@ -13,6 +13,7 @@ Minimal JSON binding over stdlib HTTP:
 
 from __future__ import annotations
 
+import base64
 import json
 import urllib.parse
 from http.server import BaseHTTPRequestHandler
@@ -78,6 +79,36 @@ class ManagerRESTServer:
                         name=q.get("name") or None,
                     )
                     self._json(200, [_model_to_json(m) for m in models])
+                elif path == "/api/v1/models:active":
+                    m = server.registry.active_model(
+                        q.get("scheduler_id", ""), q.get("name", "")
+                    )
+                    if m is None:
+                        self._json(404, {"error": "no active model"})
+                    else:
+                        self._json(200, _model_to_json(m))
+                elif path == "/api/v1/models:artifact":
+                    m = server.registry.get(q.get("id", ""))
+                    if m is None:
+                        self._json(404, {"error": "model not found"})
+                    else:
+                        try:
+                            blob = server.registry.load_artifact(m)
+                        except (KeyError, OSError) as exc:
+                            # Row exists but the blob is gone (mismatched
+                            # blob dir after restart) — a clean 404 beats a
+                            # dead handler thread + connection reset.
+                            self._json(404, {"error": f"artifact missing: {exc}"})
+                            return
+                        self._json(
+                            200, {"artifact_b64": base64.b64encode(blob).decode()}
+                        )
+                elif path == "/api/v1/models:get":
+                    m = server.registry.get(q.get("id", ""))
+                    if m is None:
+                        self._json(404, {"error": "model not found"})
+                    else:
+                        self._json(200, _model_to_json(m))
                 elif path == "/api/v1/schedulers":
                     self._json(
                         200,
@@ -111,6 +142,22 @@ class ManagerRESTServer:
 
             def do_POST(self):
                 path = urllib.parse.urlsplit(self.path).path
+                if path == "/api/v1/models":
+                    # CreateModel (reference: manager_server_v1.go:802).
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(length) or b"{}")
+                        m = server.registry.create_model(
+                            name=req["name"],
+                            type=req["type"],
+                            scheduler_id=req["scheduler_id"],
+                            artifact=base64.b64decode(req.get("artifact_b64", "")),
+                            evaluation=req.get("evaluation") or {},
+                        )
+                        self._json(200, _model_to_json(m))
+                    except (KeyError, ValueError) as exc:
+                        self._json(400, {"error": str(exc)})
+                    return
                 if path.startswith("/api/v1/models/") and ":" in path:
                     model_id, _, action = path[len("/api/v1/models/") :].rpartition(":")
                     try:

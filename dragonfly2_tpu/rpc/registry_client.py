@@ -1,0 +1,152 @@
+"""RemoteRegistry: the manager model registry over its REST surface.
+
+Reference counterparts: the trainer's managerclient.CreateModel
+(pkg/rpc/manager/client/client_v1.go:101-122) and the scheduler's
+model-version pull through dynconfig.  Implements the registry surface
+that TrainerService (create_model) and ModelSubscriber
+(active_model / load_artifact) consume, so both run unchanged against a
+manager in another process.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+from ..manager.registry import Model, ModelState
+from .retry import retry_call
+
+
+def _model_from_json(data: dict) -> Model:
+    return Model(
+        id=data["id"],
+        name=data["name"],
+        type=data["type"],
+        version=data["version"],
+        scheduler_id=data["scheduler_id"],
+        state=ModelState(data["state"]),
+        evaluation=data.get("evaluation") or {},
+    )
+
+
+class RemoteRegistry:
+    def __init__(self, base_url: str, *, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    @staticmethod
+    def _translate(exc: urllib.error.HTTPError):
+        """HTTP status → the LOCAL registry's exception types, so callers
+        written against ModelRegistry behave identically remotely."""
+        try:
+            message = json.loads(exc.read()).get("error", "")
+        except (json.JSONDecodeError, ValueError):
+            message = str(exc)
+        if exc.code == 404:
+            return KeyError(message or "not found")
+        if exc.code == 400:
+            return ValueError(message or "bad request")
+        return RuntimeError(f"manager: HTTP {exc.code}: {message}")
+
+    def _get(self, path: str) -> Optional[dict]:
+        def once():
+            try:
+                with urllib.request.urlopen(
+                    self.base_url + path, timeout=self.timeout
+                ) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as exc:
+                if exc.code == 404:
+                    return None
+                raise self._translate(exc) from exc
+
+        # HTTPError is handled inside once(); connect-refused arrives as
+        # URLError (an OSError, NOT ConnectionError) — include OSError so
+        # transient manager restarts actually retry (scheduler_client's
+        # pattern).
+        return retry_call(once, retry_on=(ConnectionError, TimeoutError, OSError))
+
+    def _post(self, path: str, payload: dict) -> dict:
+        def once():
+            req = urllib.request.Request(
+                self.base_url + path,
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as exc:
+                raise self._translate(exc) from exc
+
+        return retry_call(once, retry_on=(ConnectionError, TimeoutError, OSError))
+
+    # -- the surfaces TrainerService / ModelSubscriber use -------------------
+
+    def create_model(
+        self,
+        *,
+        name: str,
+        type: str,
+        scheduler_id: str,
+        artifact: bytes,
+        evaluation: Optional[Dict[str, float]] = None,
+        **_ignored,
+    ) -> Model:
+        data = self._post(
+            "/api/v1/models",
+            {
+                "name": name,
+                "type": type,
+                "scheduler_id": scheduler_id,
+                "artifact_b64": base64.b64encode(artifact).decode(),
+                "evaluation": evaluation or {},
+            },
+        )
+        return _model_from_json(data)
+
+    def active_model(self, scheduler_id: str, name: str) -> Optional[Model]:
+        data = self._get(
+            "/api/v1/models:active?"
+            + urllib.parse.urlencode({"scheduler_id": scheduler_id, "name": name})
+        )
+        return None if data is None else _model_from_json(data)
+
+    def load_artifact(self, model: Model) -> bytes:
+        data = self._get(
+            "/api/v1/models:artifact?" + urllib.parse.urlencode({"id": model.id})
+        )
+        if data is None:
+            raise KeyError(f"artifact for {model.id} not found")
+        return base64.b64decode(data["artifact_b64"])
+
+    def list(
+        self,
+        *,
+        scheduler_id: Optional[str] = None,
+        name: Optional[str] = None,
+        **_ignored,
+    ) -> List[Model]:
+        params = {}
+        if scheduler_id:
+            params["scheduler_id"] = scheduler_id
+        if name:
+            params["name"] = name
+        data = self._get("/api/v1/models?" + urllib.parse.urlencode(params))
+        return [_model_from_json(d) for d in (data or [])]
+
+    def activate(self, model_id: str) -> Model:
+        return _model_from_json(
+            self._post(f"/api/v1/models/{model_id}:activate", {})
+        )
+
+    def get(self, model_id: str) -> Optional[Model]:
+        data = self._get(
+            "/api/v1/models:get?" + urllib.parse.urlencode({"id": model_id})
+        )
+        return None if data is None else _model_from_json(data)

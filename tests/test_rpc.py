@@ -401,3 +401,113 @@ class TestPieceMetadataSync:
         for n in range(4):
             assert nodes[2].storage.read_piece(r2.task_id, n) == \
                 wire_swarm["origin"].content(url, n)
+
+
+class TestFullWireLoop:
+    def test_four_process_architecture(self, tmp_path, cluster):
+        """Every arrow in the architecture is a real wire: manager (REST,
+        own process), scheduler (RPC, own process), trainer (HTTP, own
+        process, RemoteRegistry to the manager), daemons (this process)
+        download P2P -> records -> announcer streams to the trainer ->
+        models land in the MANAGER process -> activation over REST ->
+        the scheduler-side ML evaluator pulls the artifact."""
+        import os
+        import subprocess
+        import sys
+
+        env = {**os.environ, "PYTHONPATH": os.getcwd()}
+
+        def spawn(code, *argv):
+            proc = subprocess.Popen(
+                [sys.executable, "-c", code, *argv],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+            )
+            procs.append(proc)  # before any assert: finally always reaps it
+            import select
+
+            ready, _, _ = select.select([proc.stdout], [], [], 30)
+            assert ready, "child did not print READY within 30s"
+            line = proc.stdout.readline().strip()
+            assert line.startswith("READY"), (line, proc.stderr.read()[:500] if proc.poll() is not None else "")
+            return proc, line.split()[1]
+
+        manager_code = (
+            "import sys, time\n"
+            "from dragonfly2_tpu.manager import ClusterManager, ModelRegistry\n"
+            "from dragonfly2_tpu.manager.registry import BlobStore\n"
+            "from dragonfly2_tpu.manager.rest import ManagerRESTServer\n"
+            "reg = ModelRegistry(BlobStore(sys.argv[1]), db_path=sys.argv[1]+'/m.db')\n"
+            "srv = ManagerRESTServer(reg, ClusterManager())\n"
+            "srv.serve(); print('READY', srv.url, flush=True); time.sleep(120)\n"
+        )
+        scheduler_code = (
+            "import sys, time\n"
+            "from dragonfly2_tpu.records.storage import Storage\n"
+            "from dragonfly2_tpu.rpc import SchedulerHTTPServer\n"
+            "from dragonfly2_tpu.scheduler import Evaluator, Resource, SchedulerService, Scheduling, SchedulingConfig\n"
+            "res = Resource()\n"
+            "svc = SchedulerService(res, Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)), Storage(sys.argv[1], buffer_size=1))\n"
+            "srv = SchedulerHTTPServer(svc)\n"
+            "srv.serve(); print('READY', srv.url, flush=True); time.sleep(120)\n"
+        )
+        trainer_code = (
+            "import sys, time\n"
+            "from dragonfly2_tpu.rpc import RemoteRegistry, TrainerHTTPServer\n"
+            "from dragonfly2_tpu.trainer.service import TrainerService\n"
+            "from dragonfly2_tpu.trainer.train import TrainConfig\n"
+            "svc = TrainerService(RemoteRegistry(sys.argv[1]), data_dir=sys.argv[2],\n"
+            "    train_config=TrainConfig(epochs=6, learning_rate=3e-3, warmup_steps=10))\n"
+            "srv = TrainerHTTPServer(svc)\n"
+            "srv.serve(); print('READY', srv.url, flush=True); time.sleep(300)\n"
+        )
+
+        procs = []
+        try:
+            mproc, murl = spawn(manager_code, str(tmp_path / "manager"))
+            sproc, surl = spawn(scheduler_code, str(tmp_path / "records"))
+            tproc, turl = spawn(trainer_code, murl, str(tmp_path / "staged"))
+
+            # Daemons in this process, wired entirely over TCP.
+            origin = WireOrigin()
+            nodes = [WireNode(i, surl, tmp_path, origin) for i in range(3)]
+            url_a = "https://origin/wire-a"
+            nodes[0].conductor.download(url_a, piece_size=PIECE, content_length=4 * PIECE)
+            for i in (1, 2):
+                for u in range(6):
+                    nodes[i].conductor.download(url_a, piece_size=PIECE)
+
+            # Announcer (scheduler side would run this; here driven directly
+            # against the scheduler's record files) → remote trainer.
+            from dragonfly2_tpu.records.columnar import ColumnarWriter
+            from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
+            from dragonfly2_tpu.rpc import RemoteRegistry, RemoteTrainer
+
+            shard = tmp_path / "synth.dfc"
+            with ColumnarWriter(str(shard), DOWNLOAD_COLUMNS) as w:
+                w.append(cluster.generate_feature_rows(2000, seed=11))
+            client = RemoteTrainer(turl, timeout=300)
+            session = client.open_train_stream(
+                ip="10.0.0.1", hostname="sched", scheduler_id="sched-wire"
+            )
+            session.send_download_shard(str(shard))
+            key = session.close_and_train()
+            run = client.runs[key]
+            assert run.error is None, run.error
+
+            # Models are in the MANAGER process; activate + pull over REST.
+            registry = RemoteRegistry(murl)
+            models = registry.list(scheduler_id="sched-wire", name="parent-bandwidth-mlp")
+            assert len(models) == 1
+            registry.activate(models[0].id)
+
+            from dragonfly2_tpu.scheduler import MLEvaluator, ModelSubscriber
+
+            ev = MLEvaluator()
+            sub = ModelSubscriber(registry, ev, scheduler_id="sched-wire")
+            assert sub.refresh() is True
+            assert ev.has_model
+            for n in nodes:
+                n.stop()
+        finally:
+            for p in procs:
+                p.terminate()
