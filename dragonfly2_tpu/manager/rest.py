@@ -48,11 +48,16 @@ class ManagerRESTServer:
         *,
         host: str = "127.0.0.1",
         port: int = 0,
+        token_verifier=None,
     ):
         self.registry = registry
         self.clusters = clusters
         self.searcher = searcher or Searcher()
         self.scheduler_clusters = scheduler_clusters or []
+        # Optional RBAC: with a verifier configured, mutations require a
+        # bearer token of sufficient role (security/tokens.py); reads stay
+        # open (matching the reference's authenticated-writes posture).
+        self.token_verifier = token_verifier
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -140,8 +145,29 @@ class ManagerRESTServer:
                 else:
                     self._json(404, {"error": "not found"})
 
+            def _authorized(self, required_role) -> bool:
+                if server.token_verifier is None:
+                    return True
+                auth = self.headers.get("Authorization", "")
+                token = auth[len("Bearer ") :] if auth.startswith("Bearer ") else None
+                return server.token_verifier.authorize(token, required_role) is not None
+
             def do_POST(self):
+                from ..security.tokens import Role
+
                 path = urllib.parse.urlsplit(self.path).path
+                # Role per route, declared at the route (tokens.py tiers):
+                # model CREATION is the trainer's automated flow → PEER;
+                # activation/deactivation are operator decisions.
+                if path == "/api/v1/models":
+                    required = Role.PEER
+                elif path.endswith(":activate") or path.endswith(":deactivate"):
+                    required = Role.OPERATOR
+                else:
+                    required = Role.ADMIN  # unknown mutations: locked down
+                if not self._authorized(required):
+                    self._json(401, {"error": "unauthorized"})
+                    return
                 if path == "/api/v1/models":
                     # CreateModel (reference: manager_server_v1.go:802).
                     try:

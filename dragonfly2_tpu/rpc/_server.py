@@ -9,10 +9,30 @@ from typing import Optional, Tuple, Type
 
 class ThreadedHTTPService:
     """Owns a ThreadingHTTPServer + its serve thread (one lifecycle impl
-    for the scheduler RPC, piece, and REST servers)."""
+    for the scheduler RPC, piece, and REST servers).
 
-    def __init__(self, handler_cls: Type, host: str, port: int, name: str):
+    ``ssl_context`` wraps the listening socket — with a mutual-TLS context
+    (security.tls.server_context) every connecting client must present a
+    CA-issued certificate."""
+
+    def __init__(
+        self, handler_cls: Type, host: str, port: int, name: str, ssl_context=None
+    ):
+        # Per-connection read timeout: a stalled client must not pin a
+        # handler thread forever (and, with TLS, must not stall handshakes).
+        handler_cls.timeout = 60
         self._httpd = ThreadingHTTPServer((host, port), handler_cls)
+        self._tls = ssl_context is not None
+        if ssl_context is not None:
+            # Handshake deferred to first read, which happens in the
+            # per-connection HANDLER thread — with the default
+            # do_handshake_on_connect=True the handshake runs inside
+            # accept() on the single serve thread, so one stalled client
+            # would block every other connection.
+            self._httpd.socket = ssl_context.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
         self.address: Tuple[str, int] = self._httpd.server_address
         self._name = name
         self._thread: Optional[threading.Thread] = None
@@ -23,7 +43,8 @@ class ThreadedHTTPService:
 
     @property
     def url(self) -> str:
-        return f"http://{self.address[0]}:{self.address[1]}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{self.address[0]}:{self.address[1]}"
 
     def serve(self) -> None:
         if self._thread is not None:

@@ -25,7 +25,14 @@ from .retry import retry_call
 
 
 class PieceHTTPServer:
-    def __init__(self, upload: UploadManager, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        upload: UploadManager,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        ssl_context=None,
+    ):
         self.upload = upload
         upload_ref = upload
 
@@ -100,7 +107,7 @@ class PieceHTTPServer:
                 except Exception:  # noqa: BLE001 — wire boundary
                     self.send_error(500)
 
-        self._svc = ThreadedHTTPService(Handler, host, port, "piece-http")
+        self._svc = ThreadedHTTPService(Handler, host, port, "piece-http", ssl_context)
         self.address: Tuple[str, int] = self._svc.address
 
     @property
@@ -128,23 +135,30 @@ class HTTPPieceFetcher:
         *,
         timeout: float = 30.0,
         metadata_timeout: float = 2.0,
+        ssl_context=None,
     ):
         self._resolve = resolve
         self.timeout = timeout
         # Bitmap queries are a pre-fetch optimization — a blackholed parent
         # must not stall the download for the full piece timeout.
         self.metadata_timeout = metadata_timeout
+        # mTLS: present this daemon's CA-issued identity to parents running
+        # TLS piece servers (security.tls.client_context).
+        self.ssl_context = ssl_context
+        self._scheme = "https" if ssl_context is not None else "http"
 
     def fetch(self, parent_host_id: str, task_id: str, number: int) -> bytes:
         ip, port = self._resolve(parent_host_id)
-        url = f"http://{ip}:{port}/pieces/{task_id}/{number}"
+        url = f"{self._scheme}://{ip}:{port}/pieces/{task_id}/{number}"
 
         class _PieceUnavailable(Exception):
             pass
 
         def once() -> bytes:
             try:
-                with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+                with urllib.request.urlopen(
+                    url, timeout=self.timeout, context=self.ssl_context
+                ) as resp:
                     return resp.read()
             except urllib.error.HTTPError as exc:
                 if exc.code == 503:
@@ -162,9 +176,11 @@ class HTTPPieceFetcher:
             ip, port = self._resolve(parent_host_id)
         except KeyError:
             return None
-        url = f"http://{ip}:{port}/tasks/{task_id}/pieces"
+        url = f"{self._scheme}://{ip}:{port}/tasks/{task_id}/pieces"
         try:
-            with urllib.request.urlopen(url, timeout=self.metadata_timeout) as resp:
+            with urllib.request.urlopen(
+                url, timeout=self.metadata_timeout, context=self.ssl_context
+            ) as resp:
                 return resp.read()
         except (urllib.error.URLError, OSError):
             return None

@@ -34,9 +34,20 @@ def _model_from_json(data: dict) -> Model:
 
 
 class RemoteRegistry:
-    def __init__(self, base_url: str, *, timeout: float = 30.0):
+    def __init__(
+        self, base_url: str, *, timeout: float = 30.0, token: Optional[str] = None
+    ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # Bearer token for managers running RBAC (security/tokens.py); the
+        # trainer's create_model needs PEER, activation needs OPERATOR.
+        self.token = token
+
+    def _headers(self) -> dict:
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        return headers
 
     @staticmethod
     def _translate(exc: urllib.error.HTTPError):
@@ -75,7 +86,7 @@ class RemoteRegistry:
             req = urllib.request.Request(
                 self.base_url + path,
                 data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"},
+                headers=self._headers(),
                 method="POST",
             )
             try:

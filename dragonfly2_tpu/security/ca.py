@@ -1,0 +1,170 @@
+"""Certificate authority + peer identity (pkg/issuer analog).
+
+The manager hosts the CA; schedulers/daemons generate a key + CSR at boot
+and request a short-lived certificate carrying their host identity in the
+SAN — the auto-issued-mTLS flow the reference builds on certify.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+from dataclasses import dataclass
+from typing import List, Optional
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+DEFAULT_CERT_TTL = datetime.timedelta(hours=24)
+
+
+def _name(common_name: str) -> x509.Name:
+    return x509.Name(
+        [
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, "dragonfly2-tpu"),
+            x509.NameAttribute(NameOID.COMMON_NAME, common_name),
+        ]
+    )
+
+
+def _san(hostnames: List[str], ips: List[str]) -> x509.SubjectAlternativeName:
+    entries: list = [x509.DNSName(h) for h in hostnames]
+    for ip in ips:
+        entries.append(x509.IPAddress(ipaddress.ip_address(ip)))
+    return x509.SubjectAlternativeName(entries)
+
+
+class CertificateAuthority:
+    """Self-signed EC-P256 root that signs peer CSRs with short validity."""
+
+    def __init__(self, common_name: str = "dragonfly2-tpu-ca") -> None:
+        self._key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        self.certificate = (
+            x509.CertificateBuilder()
+            .subject_name(_name(common_name))
+            .issuer_name(_name(common_name))
+            .public_key(self._key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=3650))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=0), critical=True)
+            .add_extension(
+                x509.KeyUsage(
+                    digital_signature=True, key_cert_sign=True, crl_sign=True,
+                    content_commitment=False, key_encipherment=False,
+                    data_encipherment=False, key_agreement=False,
+                    encipher_only=False, decipher_only=False,
+                ),
+                critical=True,
+            )
+            .sign(self._key, hashes.SHA256())
+        )
+
+    @property
+    def cert_pem(self) -> bytes:
+        return self.certificate.public_bytes(serialization.Encoding.PEM)
+
+    def sign_csr(
+        self,
+        csr_pem: bytes,
+        *,
+        ttl: datetime.timedelta = DEFAULT_CERT_TTL,
+    ) -> bytes:
+        """Issue a peer certificate from a CSR (manager-side issuance).
+
+        The CSR's subject and SAN are honored; validity is capped short so
+        revocation is simply non-renewal (the reference's certify flow).
+        """
+        csr = x509.load_pem_x509_csr(csr_pem)
+        if not csr.is_signature_valid:
+            raise ValueError("CSR signature invalid")
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(csr.subject)
+            .issuer_name(self.certificate.subject)
+            .public_key(csr.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + ttl)
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
+            .add_extension(
+                x509.ExtendedKeyUsage(
+                    [x509.oid.ExtendedKeyUsageOID.SERVER_AUTH,
+                     x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH]
+                ),
+                critical=False,
+            )
+        )
+        try:
+            san = csr.extensions.get_extension_for_class(x509.SubjectAlternativeName)
+            builder = builder.add_extension(san.value, critical=False)
+        except x509.ExtensionNotFound:
+            pass
+        cert = builder.sign(self._key, hashes.SHA256())
+        return cert.public_bytes(serialization.Encoding.PEM)
+
+
+@dataclass
+class PeerIdentity:
+    """A peer's key + CA-issued certificate (daemon/scheduler side)."""
+
+    key_pem: bytes
+    cert_pem: bytes
+    ca_pem: bytes
+
+    @classmethod
+    def issue(
+        cls,
+        ca: CertificateAuthority,
+        *,
+        common_name: str,
+        hostnames: Optional[List[str]] = None,
+        ips: Optional[List[str]] = None,
+        ttl: datetime.timedelta = DEFAULT_CERT_TTL,
+    ) -> "PeerIdentity":
+        """Generate a key, CSR against the CA, receive the signed cert —
+        the whole certify bootstrap in one call (in-process CA; over the
+        wire the CSR posts to the manager)."""
+        key = ec.generate_private_key(ec.SECP256R1())
+        csr = (
+            x509.CertificateSigningRequestBuilder()
+            .subject_name(_name(common_name))
+            .add_extension(
+                _san(hostnames or [common_name], ips or []), critical=False
+            )
+            .sign(key, hashes.SHA256())
+        )
+        cert_pem = ca.sign_csr(
+            csr.public_bytes(serialization.Encoding.PEM), ttl=ttl
+        )
+        return cls(
+            key_pem=key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            ),
+            cert_pem=cert_pem,
+            ca_pem=ca.cert_pem,
+        )
+
+    def write(self, directory: str) -> dict:
+        """Materialize to files (ssl contexts need paths); returns paths."""
+        import os
+
+        os.makedirs(directory, exist_ok=True)
+        paths = {}
+        for name, data in (
+            ("key.pem", self.key_pem),
+            ("cert.pem", self.cert_pem),
+            ("ca.pem", self.ca_pem),
+        ):
+            path = os.path.join(directory, name)
+            with open(path, "wb") as f:
+                f.write(data)
+            os.chmod(path, 0o600)
+            paths[name.split(".")[0]] = path
+        return paths

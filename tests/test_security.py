@@ -1,0 +1,211 @@
+"""Security: CA issuance + chain validation, mTLS piece transfer, tokens
+and REST RBAC enforcement."""
+
+import json
+import ssl
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dragonfly2_tpu.security import (
+    CertificateAuthority,
+    PeerIdentity,
+    Role,
+    TokenIssuer,
+    TokenVerifier,
+    client_context,
+    server_context,
+)
+
+
+class TestCA:
+    def test_issue_and_chain_validates(self, tmp_path):
+        ca = CertificateAuthority()
+        ident = PeerIdentity.issue(
+            ca, common_name="daemon-1", hostnames=["daemon-1"], ips=["127.0.0.1"]
+        )
+        from cryptography import x509
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        cert = x509.load_pem_x509_certificate(ident.cert_pem)
+        ca_cert = x509.load_pem_x509_certificate(ident.ca_pem)
+        # Signed by the CA (signature verification against the CA key).
+        ca_cert.public_key().verify(
+            cert.signature,
+            cert.tbs_certificate_bytes,
+            ec.ECDSA(cert.signature_hash_algorithm),
+        )
+        san = cert.extensions.get_extension_for_class(x509.SubjectAlternativeName)
+        assert "daemon-1" in san.value.get_values_for_type(x509.DNSName)
+        paths = ident.write(str(tmp_path / "id"))
+        assert set(paths) == {"key", "cert", "ca"}
+
+    def test_bad_csr_rejected(self):
+        ca = CertificateAuthority()
+        with pytest.raises(Exception):
+            ca.sign_csr(b"-----BEGIN CERTIFICATE REQUEST-----\nnope\n-----END CERTIFICATE REQUEST-----\n")
+
+
+class TestMTLSPieceTransfer:
+    def test_mutual_tls_roundtrip_and_reject_anonymous(self, tmp_path):
+        from dragonfly2_tpu.daemon import DaemonStorage, UploadManager
+        from dragonfly2_tpu.rpc import PieceHTTPServer
+
+        ca = CertificateAuthority()
+        server_id = PeerIdentity.issue(
+            ca, common_name="parent", hostnames=["localhost"], ips=["127.0.0.1"]
+        )
+        client_id = PeerIdentity.issue(ca, common_name="child")
+
+        st = DaemonStorage(str(tmp_path / "s"), prefer_native=False)
+        st.register_task("t", piece_size=1024, content_length=1024)
+        st.write_piece("t", 0, b"secret" * 100)
+        server = PieceHTTPServer(
+            UploadManager(st), ssl_context=server_context(server_id)
+        )
+        server.serve()
+        try:
+            url = f"https://127.0.0.1:{server.port}/pieces/t/0"
+            ctx = client_context(client_id)
+            ctx.check_hostname = False  # IP connect in test
+            with urllib.request.urlopen(url, context=ctx, timeout=5) as resp:
+                assert resp.read() == b"secret" * 100
+
+            # Anonymous client (no cert) must be rejected by mTLS.
+            anon = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            anon.check_hostname = False
+            anon.verify_mode = ssl.CERT_NONE
+            with pytest.raises((urllib.error.URLError, ssl.SSLError, ConnectionError, OSError)):
+                urllib.request.urlopen(url, context=anon, timeout=5).read()
+        finally:
+            server.stop()
+
+
+class TestTokens:
+    def test_roundtrip_roles_expiry(self):
+        issuer = TokenIssuer(b"super-secret-key-0123456789")
+        verifier = TokenVerifier(b"super-secret-key-0123456789")
+        tok = issuer.issue("daemon-1", Role.PEER)
+        claims = verifier.verify(tok)
+        assert claims.subject == "daemon-1" and claims.role is Role.PEER
+        assert verifier.authorize(tok, Role.PEER) is not None
+        assert verifier.authorize(tok, Role.OPERATOR) is None  # insufficient
+        # Tampered token fails.
+        assert verifier.verify(tok[:-4] + "AAAA") is None
+        # Wrong secret fails.
+        assert TokenVerifier(b"another-secret-key-xxxxxxxx").verify(tok) is None
+        # Expired token fails.
+        old = issuer.issue("x", Role.ADMIN, ttl_s=-1)
+        assert verifier.verify(old) is None
+
+    def test_weak_secret_rejected(self):
+        with pytest.raises(ValueError):
+            TokenIssuer(b"short")
+
+
+class TestRESTAuth:
+    def test_mutations_require_operator(self):
+        from dragonfly2_tpu.manager import ClusterManager, ModelRegistry
+        from dragonfly2_tpu.manager.rest import ManagerRESTServer
+
+        secret = b"manager-secret-0123456789abcd"
+        registry = ModelRegistry()
+        m = registry.create_model(name="m", type="mlp", scheduler_id="s", artifact=b"a")
+        server = ManagerRESTServer(
+            registry, ClusterManager(), token_verifier=TokenVerifier(secret)
+        )
+        server.serve()
+        try:
+            url = server.url + f"/api/v1/models/{m.id}:activate"
+            # No token → 401.
+            req = urllib.request.Request(url, data=b"", method="POST")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=5)
+            assert exc.value.code == 401
+            # PEER-role token → still 401 for activation.
+            issuer = TokenIssuer(secret)
+            peer_tok = issuer.issue("d", Role.PEER)
+            req = urllib.request.Request(
+                url, data=b"", method="POST",
+                headers={"Authorization": f"Bearer {peer_tok}"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=5)
+            assert exc.value.code == 401
+            # OPERATOR token → activation succeeds.
+            op_tok = issuer.issue("ops", Role.OPERATOR)
+            req = urllib.request.Request(
+                url, data=b"", method="POST",
+                headers={"Authorization": f"Bearer {op_tok}"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                assert json.loads(resp.read())["state"] == "active"
+            # Reads stay open.
+            with urllib.request.urlopen(server.url + "/api/v1/models", timeout=5) as r:
+                assert json.loads(r.read())
+        finally:
+            server.stop()
+
+
+class TestClientSideWiring:
+    def test_mtls_piece_fetcher_end_to_end(self, tmp_path):
+        """The framework's own fetcher (not hand-rolled urllib) fetches
+        through mutual TLS."""
+        from dragonfly2_tpu.daemon import DaemonStorage, UploadManager
+        from dragonfly2_tpu.rpc import HTTPPieceFetcher, PieceHTTPServer
+
+        ca = CertificateAuthority()
+        server_id = PeerIdentity.issue(ca, common_name="p", ips=["127.0.0.1"])
+        client_id = PeerIdentity.issue(ca, common_name="c")
+
+        st = DaemonStorage(str(tmp_path / "s"), prefer_native=False)
+        st.register_task("t", piece_size=512, content_length=1024)
+        st.write_piece("t", 0, b"a" * 512)
+        st.write_piece("t", 1, b"b" * 512)
+        server = PieceHTTPServer(UploadManager(st), ssl_context=server_context(server_id))
+        server.serve()
+        try:
+            assert server._svc.url.startswith("https://")
+            ctx = client_context(client_id)
+            ctx.check_hostname = False
+            fetcher = HTTPPieceFetcher(
+                lambda hid: ("127.0.0.1", server.port), ssl_context=ctx
+            )
+            assert fetcher.fetch("p", "t", 0) == b"a" * 512
+            assert list(fetcher.piece_bitmap("p", "t")) == [1, 1]
+        finally:
+            server.stop()
+
+    def test_remote_registry_with_token(self):
+        """RemoteRegistry authenticates against an RBAC-enabled manager:
+        PEER token creates models (the trainer's flow), OPERATOR activates."""
+        from dragonfly2_tpu.manager import ClusterManager, ModelRegistry
+        from dragonfly2_tpu.manager.rest import ManagerRESTServer
+        from dragonfly2_tpu.rpc import RemoteRegistry
+
+        secret = b"manager-secret-0123456789abcd"
+        issuer = TokenIssuer(secret)
+        server = ManagerRESTServer(
+            ModelRegistry(), ClusterManager(), token_verifier=TokenVerifier(secret)
+        )
+        server.serve()
+        try:
+            # Trainer-side client with a PEER token can create…
+            peer_reg = RemoteRegistry(server.url, token=issuer.issue("trainer", Role.PEER))
+            m = peer_reg.create_model(
+                name="m", type="mlp", scheduler_id="s", artifact=b"w"
+            )
+            # …but not activate.
+            with pytest.raises(RuntimeError):
+                peer_reg.activate(m.id)
+            # No token at all → refused.
+            anon = RemoteRegistry(server.url)
+            with pytest.raises(RuntimeError):
+                anon.create_model(name="m", type="mlp", scheduler_id="s", artifact=b"")
+            # Operator client activates; artifact pull (a read) works.
+            op_reg = RemoteRegistry(server.url, token=issuer.issue("ops", Role.OPERATOR))
+            assert op_reg.activate(m.id).state.value == "active"
+            assert op_reg.load_artifact(m) == b"w"
+        finally:
+            server.stop()
