@@ -25,11 +25,19 @@ def run(argv=None) -> int:
     p.add_argument("--train-once", default=None, metavar="DIR",
                    help="ingest DIR's columnar shards, train one round, exit")
     p.add_argument("--scheduler-id", default="scheduler-local")
+    p.add_argument("--manager", default=None, metavar="URL",
+                   help="remote manager REST URL (models publish there)")
+    p.add_argument("--manager-token", default=None, help="bearer token for the manager")
     args = p.parse_args(argv)
     init_logging(args, "trainer")
 
     cfg = load_config(TrainerConfigFile, args.config)
-    registry = ModelRegistry()
+    if args.manager:
+        from ..rpc import RemoteRegistry
+
+        registry = RemoteRegistry(args.manager, token=args.manager_token)
+    else:
+        registry = ModelRegistry()
     service = TrainerService(
         registry,
         data_dir=None,

@@ -6,14 +6,16 @@ for everything else; transport.go's round-tripper is the divert seam.
 
 Here: a stdlib HTTP proxy server whose rule set maps URL regexes →
 P2P download via the daemon's conductor; unmatched GETs are fetched
-directly (urllib).  HTTPS CONNECT tunneling is NOT yet implemented
-(clients receive 501) — the reference's SNI-hijack path is a round-2
-target.
+directly (urllib); CONNECT requests are tunneled as raw byte relays
+(HTTPS pass-through — proxy.go's tunnel path; SNI-hijack into P2P is a
+round-2 target).
 """
 
 from __future__ import annotations
 
 import re
+import select
+import socket
 import threading
 import urllib.request
 from dataclasses import dataclass, field
@@ -61,12 +63,14 @@ class P2PProxy:
         port: int = 0,
         piece_size: int = 4 << 20,
         direct_timeout: float = 30.0,
+        tunnel_idle_timeout: float = 300.0,
     ):
         self.daemon = daemon
         self.router = router
         self.piece_size = piece_size
         self.direct_timeout = direct_timeout
-        self.stats = {"p2p": 0, "direct": 0}
+        self.tunnel_idle_timeout = tunnel_idle_timeout
+        self.stats = {"p2p": 0, "direct": 0, "tunnel": 0}
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -95,6 +99,62 @@ class P2PProxy:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_CONNECT(self):
+                # HTTPS pass-through: relay raw bytes between the client
+                # and the target (the handler thread owns the tunnel).
+                try:
+                    host_part, _, port_part = self.path.rpartition(":")
+                    upstream = socket.create_connection(
+                        (host_part, int(port_part)), timeout=10
+                    )
+                except (OSError, ValueError):
+                    self.send_error(502)
+                    return
+                self.send_response(200, "Connection Established")
+                self.end_headers()
+                proxy.stats["tunnel"] += 1
+                client = self.connection
+                try:
+                    # Bytes the client pipelined behind the CONNECT headers
+                    # (e.g. a TLS ClientHello racing the 200) are sitting in
+                    # rfile's buffer, NOT the socket — forward them first or
+                    # the handshake stalls.
+                    try:
+                        buffered = self.rfile.read1(65536) if self.rfile.peek(1) else b""
+                    except (OSError, ValueError):
+                        buffered = b""
+                    if buffered:
+                        upstream.sendall(buffered)
+                    # Half-close-correct relay: EOF on one side shuts only
+                    # the OTHER side's write half; data keeps flowing the
+                    # remaining direction until both halves close.
+                    open_dirs = {client: upstream, upstream: client}
+                    while open_dirs:
+                        readable, _, _ = select.select(
+                            list(open_dirs), [], [], proxy.tunnel_idle_timeout
+                        )
+                        if not readable:
+                            break  # idle past the (long) budget
+                        for sock in readable:
+                            dst = open_dirs.get(sock)
+                            if dst is None:
+                                continue
+                            try:
+                                data = sock.recv(65536)
+                            except OSError:
+                                data = b""
+                            if not data:
+                                try:
+                                    dst.shutdown(socket.SHUT_WR)
+                                except OSError:
+                                    pass
+                                del open_dirs[sock]
+                            else:
+                                dst.sendall(data)
+                finally:
+                    upstream.close()
+                self.close_connection = True
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.address = self._httpd.server_address

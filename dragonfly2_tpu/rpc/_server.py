@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import ssl
 import threading
 from http.server import ThreadingHTTPServer
 from typing import Optional, Tuple, Type
@@ -18,10 +19,23 @@ class ThreadedHTTPService:
     def __init__(
         self, handler_cls: Type, host: str, port: int, name: str, ssl_context=None
     ):
-        # Per-connection read timeout: a stalled client must not pin a
-        # handler thread forever (and, with TLS, must not stall handshakes).
-        handler_cls.timeout = 60
-        self._httpd = ThreadingHTTPServer((host, port), handler_cls)
+        # A per-SERVICE subclass (never mutate the caller's class — that
+        # would leak a timeout into every other user of it): adds the
+        # per-connection read timeout so a stalled client can't pin a
+        # handler thread, and swallows TLS handshake failures quietly (the
+        # deferred handshake surfaces SSLError on first read; an anonymous
+        # client or port scanner is routine, not a traceback).
+        class _Handler(handler_cls):  # type: ignore[misc,valid-type]
+            timeout = 60
+
+            def handle(self):
+                try:
+                    super().handle()
+                except (ssl.SSLError, ConnectionError, TimeoutError):
+                    self.close_connection = True
+
+        _Handler.__name__ = f"{handler_cls.__name__}@{name}"
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._tls = ssl_context is not None
         if ssl_context is not None:
             # Handshake deferred to first read, which happens in the

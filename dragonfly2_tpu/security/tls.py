@@ -39,11 +39,22 @@ def server_context(identity: PeerIdentity) -> ssl.SSLContext:
     return ctx
 
 
-def client_context(identity: PeerIdentity) -> ssl.SSLContext:
+def client_context(
+    identity: PeerIdentity, *, check_hostname: bool = False
+) -> ssl.SSLContext:
+    """Client mTLS context.
+
+    ``check_hostname`` defaults OFF: peers dial each other by announced IP
+    and the trust anchor here is CERT_REQUIRED chain verification against
+    the private CA (only CA-issued identities connect at all) — hostname
+    matching adds value only when server identities embed their IP/DNS
+    SANs (PeerIdentity.issue(..., ips=[...]) supports that; turn this on
+    then)."""
     with _materialized(identity) as paths:
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
         ctx.load_cert_chain(paths["cert"], paths["key"])
         ctx.load_verify_locations(paths["ca"])
-    ctx.check_hostname = True
+    ctx.check_hostname = check_hostname
+    ctx.verify_mode = ssl.CERT_REQUIRED
     ctx.minimum_version = ssl.TLSVersion.TLSv1_2
     return ctx

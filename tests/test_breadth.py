@@ -203,3 +203,52 @@ class TestManagerREST:
 
 
 import urllib.error  # noqa: E402  (used in the 404 assertion above)
+
+
+class TestProxyConnect:
+    def test_https_tunnel_passthrough(self, tmp_path):
+        """CONNECT relays raw bytes: an http.client through the tunnel
+        reaches a local origin server."""
+        import http.client
+        from http.server import BaseHTTPRequestHandler
+        from dragonfly2_tpu.rpc._server import ThreadedHTTPService
+
+        class Origin(BaseHTTPRequestHandler):
+            def log_message(self, *a): pass
+            def do_GET(self):
+                body = b"tunneled!"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        origin = ThreadedHTTPService(Origin, "127.0.0.1", 0, "origin")
+        origin.serve()
+        swarm = _Swarm(tmp_path, n_hosts=1)
+        proxy = P2PProxy(swarm.daemons[0], ProxyRouter([]))
+        proxy.serve()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", proxy.port, timeout=10)
+            conn.set_tunnel("127.0.0.1", origin.port)
+            conn.request("GET", "/anything")
+            resp = conn.getresponse()
+            assert resp.status == 200 and resp.read() == b"tunneled!"
+            assert proxy.stats["tunnel"] == 1
+        finally:
+            proxy.stop()
+            origin.stop()
+
+    def test_connect_bad_target_502(self, tmp_path):
+        import http.client
+
+        swarm = _Swarm(tmp_path, n_hosts=1)
+        proxy = P2PProxy(swarm.daemons[0], ProxyRouter([]))
+        proxy.serve()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", proxy.port, timeout=5)
+            conn.set_tunnel("127.0.0.1", 1)  # closed port
+            with pytest.raises(OSError):
+                conn.request("GET", "/")
+                conn.getresponse()
+        finally:
+            proxy.stop()
