@@ -26,6 +26,8 @@ def run(argv=None) -> int:
     p.add_argument("-O", "--output", required=True, help="output file path")
     p.add_argument("--piece-size", type=int, default=4 << 20)
     p.add_argument("--work-dir", default=None, help="piece storage dir")
+    p.add_argument("--recursive", action="store_true",
+                   help="download a directory tree (file:// sources)")
     args = p.parse_args(argv)
     init_logging(args, "dfget")
 
@@ -51,6 +53,53 @@ def run(argv=None) -> int:
         storage_root=os.path.join(work_dir, "storage"),
         source_fetcher=source,
     )
+
+    if args.recursive:
+        # Directory tree (reference: recursive dir download,
+        # rpcserver.go:407+): each file goes through the same P2P path.
+        import urllib.parse
+
+        parsed = urllib.parse.urlsplit(args.url)
+        if parsed.scheme not in ("", "file"):
+            print("dfget: --recursive supports file:// sources only", file=sys.stderr)
+            return 1
+        src_root = parsed.path or args.url
+        if not os.path.isdir(src_root):
+            print("dfget: --recursive needs a directory source", file=sys.stderr)
+            return 1
+        count = 0
+        for dirpath, dirs, files in os.walk(src_root):
+            # Preserve empty directories: the restored tree must be
+            # structurally identical to the source.
+            for d in dirs:
+                os.makedirs(
+                    os.path.join(args.output, os.path.relpath(os.path.join(dirpath, d), src_root)),
+                    exist_ok=True,
+                )
+            for name in files:
+                src = os.path.join(dirpath, name)
+                rel = os.path.relpath(src, src_root)
+                dst = os.path.join(args.output, rel)
+                os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+                try:
+                    size = os.path.getsize(src)
+                except OSError as exc:
+                    # Dangling symlink etc: report and continue.
+                    print(f"dfget: skipped {rel}: {exc}", file=sys.stderr)
+                    continue
+                # Percent-encode: '#'/'?' in filenames must survive urlsplit.
+                url = "file://" + urllib.parse.quote(src)
+                result = daemon.download(
+                    url, piece_size=args.piece_size, content_length=size
+                )
+                if not result.ok:
+                    print(f"dfget: failed {rel}", file=sys.stderr)
+                    return 1
+                with open(dst, "wb") as out:
+                    out.write(daemon.read_task_bytes(result.task_id))
+                count += 1
+        print(f"dfget: downloaded {count} files -> {args.output}")
+        return 0
 
     content_length = source.content_length(args.url)
     if content_length < 0:

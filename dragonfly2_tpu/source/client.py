@@ -25,7 +25,8 @@ class FileSourceClient:
     def _path(self, url: str) -> str:
         parsed = urllib.parse.urlsplit(url)
         if parsed.scheme == "file":
-            return parsed.path
+            # Percent-decoded: writers quote paths so '#'/'?' survive.
+            return urllib.parse.unquote(parsed.path)
         return url
 
     def content_length(self, url: str) -> int:

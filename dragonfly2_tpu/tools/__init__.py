@@ -1,0 +1,2 @@
+"""Operational tools (reference: test/tools/ — stress load generator,
+fixture servers)."""

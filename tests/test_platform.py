@@ -332,3 +332,66 @@ class TestServiceMetrics:
         assert svc.runs[key].error is None
         assert tm.MODELS_PUBLISHED.value(model="mlp") == before + 1
         assert tm.TRAINING_TOTAL.value(model="all", result="success") >= 1
+
+
+class TestStressAndRecursive:
+    def test_stress_tool_over_swarm(self, tmp_path):
+        from dragonfly2_tpu.tools.stress import run_stress
+        from tests.test_daemon import PIECE, _Swarm
+
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        urls = [f"https://origin/stress-{t}" for t in range(3)]
+        for u in urls:
+            swarm.daemons[0].download(u, piece_size=PIECE, content_length=2 * PIECE)
+
+        def dl(url):
+            return swarm.daemons[1].download(url, piece_size=PIECE)
+
+        report = run_stress(dl, urls, concurrency=4, total=20)
+        s = report.summary()
+        assert s["succeeded"] == 20 and s["failed"] == 0
+        assert s["throughput_MBps"] > 0 and s["latency_p95_ms"] > 0
+
+    def test_dfget_recursive(self, tmp_path, capsys):
+        from dragonfly2_tpu.cli.dfget import run as dfget
+
+        src = tmp_path / "tree"
+        (src / "sub").mkdir(parents=True)
+        (src / "a.bin").write_bytes(os.urandom(70_000))
+        (src / "sub" / "b.bin").write_bytes(os.urandom(130_000))
+        out = tmp_path / "restored"
+        rc = dfget([
+            f"file://{src}", "-O", str(out), "--recursive",
+            "--piece-size", "65536", "--work-dir", str(tmp_path / "w"),
+        ])
+        assert rc == 0
+        assert (out / "a.bin").read_bytes() == (src / "a.bin").read_bytes()
+        assert (out / "sub" / "b.bin").read_bytes() == (src / "sub" / "b.bin").read_bytes()
+
+    def test_dfget_recursive_odd_names_and_empty_dirs(self, tmp_path, capsys):
+        from dragonfly2_tpu.cli.dfget import run as dfget
+
+        src = tmp_path / "tree2"
+        (src / "empty_sub").mkdir(parents=True)
+        (src / "a#1.bin").write_bytes(os.urandom(40_000))
+        (src / "dangling").symlink_to("/nonexistent-target")
+        out = tmp_path / "restored2"
+        rc = dfget([
+            f"file://{src}", "-O", str(out), "--recursive",
+            "--piece-size", "65536", "--work-dir", str(tmp_path / "w2"),
+        ])
+        assert rc == 0
+        assert (out / "a#1.bin").read_bytes() == (src / "a#1.bin").read_bytes()
+        assert (out / "empty_sub").is_dir()
+        err = capsys.readouterr().err
+        assert "skipped dangling" in err
+
+    def test_stress_percentile_and_empty_urls(self):
+        from dragonfly2_tpu.tools.stress import StressReport, run_stress
+
+        r = StressReport()
+        r.latencies_s = [i / 1000 for i in range(1, 101)]  # 1..100 ms
+        assert r.percentile(99) == pytest.approx(0.099)  # 99th, not max
+        assert r.percentile(50) == pytest.approx(0.050)
+        with pytest.raises(ValueError):
+            run_stress(lambda u: None, [], total=5)
