@@ -55,6 +55,7 @@ def build(cfg: DaemonConfig, scheduler_url: str):
         client,
         piece_fetcher=HTTPPieceFetcher(client.resolve_host),
         source_fetcher=PieceSourceFetcher(),
+        concurrent_source_groups=cfg.concurrent_source_groups,
     )
     announcer = HostAnnouncer(host, client)
     return {

@@ -63,7 +63,13 @@ def run(argv=None) -> int:
         if parsed.scheme not in ("", "file"):
             print("dfget: --recursive supports file:// sources only", file=sys.stderr)
             return 1
-        src_root = parsed.path or args.url
+        # abspath: a relative bare path must not become a URL netloc when
+        # "file://" + path is parsed back (urlsplit would eat the first
+        # component as the host).
+        src_root = os.path.abspath(
+            urllib.parse.unquote(parsed.path) if parsed.scheme == "file"
+            else args.url
+        )
         if not os.path.isdir(src_root):
             print("dfget: --recursive needs a directory source", file=sys.stderr)
             return 1
@@ -71,7 +77,17 @@ def run(argv=None) -> int:
         for dirpath, dirs, files in os.walk(src_root):
             # Preserve empty directories: the restored tree must be
             # structurally identical to the source.
-            for d in dirs:
+            for d in list(dirs):
+                if os.path.islink(os.path.join(dirpath, d)):
+                    # os.walk(followlinks=False) won't descend — an empty
+                    # dir here would be a silently incomplete restore.
+                    print(
+                        f"dfget: skipped symlinked dir "
+                        f"{os.path.relpath(os.path.join(dirpath, d), src_root)}",
+                        file=sys.stderr,
+                    )
+                    dirs.remove(d)
+                    continue
                 os.makedirs(
                     os.path.join(args.output, os.path.relpath(os.path.join(dirpath, d), src_root)),
                     exist_ok=True,

@@ -190,6 +190,9 @@ class DaemonConfig:
     scheduler_addr: str = ""
     piece_size: int = 4 << 20
     concurrent_upload_limit: int = 50
+    # Concurrent back-to-source range groups (peerhost.go ConcurrentOption
+    # GoroutineCount); 1 = sequential origin fetch.
+    concurrent_source_groups: int = 1
     total_rate_limit: float = 1e9
     probe_interval_s: float = 20 * 60.0
     metrics: MetricsConfig = field(default_factory=MetricsConfig)

@@ -14,9 +14,11 @@ uses — so daemon-level tests exercise the whole control loop.
 
 from __future__ import annotations
 
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import List, Optional, Protocol
+from typing import List, Optional, Protocol, Sequence
 
 from ..scheduler.resource import Host, Peer
 from ..scheduler.service import SchedulerService
@@ -42,6 +44,10 @@ class SourceFetcher(Protocol):
         ...
 
 
+class _SourceFetchError(Exception):
+    """Internal: a back-to-source piece fetch failed (task-fatal)."""
+
+
 @dataclass
 class DownloadResult:
     ok: bool
@@ -65,6 +71,8 @@ class Conductor:
         *,
         traffic_shaper: Optional[TrafficShaper] = None,
         max_piece_retries: int = 2,
+        concurrent_source_groups: int = 1,
+        concurrent_source_threshold: int = 2,
     ) -> None:
         self.host = host
         self.storage = storage
@@ -73,6 +81,16 @@ class Conductor:
         self.source_fetcher = source_fetcher
         self.traffic_shaper = traffic_shaper
         self.max_piece_retries = max_piece_retries
+        # Concurrent back-to-source (piece_manager.go:793-873 semantics):
+        # split the remaining pieces into `groups` contiguous range groups,
+        # one worker per group, any worker failure cancels the task.  Only
+        # engages when at least `threshold` pieces remain — tiny remainders
+        # aren't worth the fan-out.
+        self.concurrent_source_groups = max(1, concurrent_source_groups)
+        self.concurrent_source_threshold = max(1, concurrent_source_threshold)
+        # Storage writes and scheduler reports from concurrent source
+        # workers are serialized; only the origin fetch itself overlaps.
+        self._report_lock = threading.Lock()
 
     # -- the main flow (peertask_conductor.go:370 start → pullPieces) --------
 
@@ -218,22 +236,45 @@ class Conductor:
         if self.source_fetcher is None:
             return self._fail(peer, t0, "no source fetcher")
         self.scheduler.mark_back_to_source(peer)
-        nbytes = 0
-        for number in range(n_pieces):
-            # Resume, don't restart: pieces already fetched from parents
-            # stay on disk with their parent attribution intact — the
-            # origin only serves what P2P didn't (piece_manager.go resumes
-            # from the persisted piece bitmap the same way).
-            if self.storage.has_piece(task.id, number):
-                continue
-            t_piece = time.monotonic()
-            try:
-                data = self.source_fetcher.fetch(task.url, number, piece_size)
-            except Exception:
-                return self._fail(peer, t0, f"source fetch piece {number}")
-            cost_ns = max(int((time.monotonic() - t_piece) * 1e9), 1)
+        # Resume, don't restart: pieces already fetched from parents stay
+        # on disk with their parent attribution intact — the origin only
+        # serves what P2P didn't (piece_manager.go resumes from the
+        # persisted piece bitmap the same way).
+        missing = [
+            n for n in range(n_pieces) if not self.storage.has_piece(task.id, n)
+        ]
+        groups = min(self.concurrent_source_groups, len(missing))
+        try:
+            if groups > 1 and len(missing) >= self.concurrent_source_threshold:
+                nbytes = self._source_piece_groups(peer, missing, piece_size, groups)
+            else:
+                nbytes = 0
+                for number in missing:
+                    nbytes += self._source_one_piece(peer, number, piece_size)
+        except _SourceFetchError as e:
+            return self._fail(peer, t0, str(e))
+        self.scheduler.report_peer_finished(peer)
+        return DownloadResult(
+            ok=True,
+            task_id=task.id,
+            peer_id=peer.id,
+            pieces=n_pieces,
+            bytes=nbytes,
+            back_to_source=True,
+            cost_s=time.monotonic() - t0,
+        )
+
+    def _source_one_piece(self, peer: Peer, number: int, piece_size: int) -> int:
+        """Fetch piece `number` from the origin, persist + report it."""
+        task = peer.task
+        t_piece = time.monotonic()
+        try:
+            data = self.source_fetcher.fetch(task.url, number, piece_size)
+        except Exception:
+            raise _SourceFetchError(f"source fetch piece {number}")
+        cost_ns = max(int((time.monotonic() - t_piece) * 1e9), 1)
+        with self._report_lock:
             self.storage.write_piece(task.id, number, data)
-            nbytes += len(data)
             self.scheduler.report_piece_finished(
                 peer, number, parent_id="", length=len(data), cost_ns=cost_ns
             )
@@ -247,16 +288,50 @@ class Conductor:
                 self.scheduler.set_task_direct_piece(
                     peer, data[: task.content_length]
                 )
-        self.scheduler.report_peer_finished(peer)
-        return DownloadResult(
-            ok=True,
-            task_id=task.id,
-            peer_id=peer.id,
-            pieces=n_pieces,
-            bytes=nbytes,
-            back_to_source=True,
-            cost_s=time.monotonic() - t0,
-        )
+        return len(data)
+
+    def _source_piece_groups(
+        self, peer: Peer, missing: Sequence[int], piece_size: int, groups: int
+    ) -> int:
+        """Concurrent back-to-source by contiguous piece groups.
+
+        piece_manager.go:793-873: `con` workers each own a contiguous slice
+        of the remaining pieces (the first `remainder` groups take one extra);
+        the first worker failure cancels the whole task.
+        """
+        per, rem = divmod(len(missing), groups)
+        slices: List[Sequence[int]] = []
+        start = 0
+        for i in range(groups):
+            size = per + (1 if i < rem else 0)
+            slices.append(missing[start : start + size])
+            start += size
+        cancelled = threading.Event()
+
+        def run_group(numbers: Sequence[int]) -> int:
+            nbytes = 0
+            for number in numbers:
+                if cancelled.is_set():
+                    raise _SourceFetchError("cancelled by sibling group")
+                try:
+                    nbytes += self._source_one_piece(peer, number, piece_size)
+                except _SourceFetchError:
+                    cancelled.set()
+                    raise
+            return nbytes
+
+        with ThreadPoolExecutor(max_workers=groups) as pool:
+            futures = [pool.submit(run_group, s) for s in slices]
+            total = 0
+            error: Optional[_SourceFetchError] = None
+            for fut in futures:
+                try:
+                    total += fut.result()
+                except _SourceFetchError as e:
+                    error = error or e
+        if error is not None:
+            raise error
+        return total
 
     def _fail(self, peer: Peer, t0: float, reason: str) -> DownloadResult:
         self.scheduler.report_peer_failed(peer)

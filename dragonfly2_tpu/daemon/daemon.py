@@ -58,6 +58,7 @@ class Daemon:
         quota_bytes: int = 10 << 30,
         total_rate: float = 1e9,
         prefer_native: bool = True,
+        concurrent_source_groups: int = 1,
     ) -> None:
         self.host = host
         self.scheduler = scheduler
@@ -77,6 +78,7 @@ class Daemon:
             piece_fetcher=InProcessFetcher(self._registry),
             source_fetcher=source_fetcher,
             traffic_shaper=self.traffic_shaper,
+            concurrent_source_groups=concurrent_source_groups,
         )
         self.pex: Optional[PeerExchange] = None
         if gossip_bus is not None:

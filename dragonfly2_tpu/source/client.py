@@ -25,8 +25,15 @@ class FileSourceClient:
     def _path(self, url: str) -> str:
         parsed = urllib.parse.urlsplit(url)
         if parsed.scheme == "file":
-            # Percent-decoded: writers quote paths so '#'/'?' survive.
-            return urllib.parse.unquote(parsed.path)
+            # Writers quote paths so '#'/'?' survive urlsplit — but raw
+            # unquoted URLs whose filenames contain literal '%' predate
+            # that convention, so prefer the decoded path only when it
+            # actually exists (or the raw one doesn't).
+            decoded = urllib.parse.unquote(parsed.path)
+            if decoded != parsed.path and not os.path.exists(decoded) \
+                    and os.path.exists(parsed.path):
+                return parsed.path
+            return decoded
         return url
 
     def content_length(self, url: str) -> int:

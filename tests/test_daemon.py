@@ -217,6 +217,60 @@ class TestConductorE2E:
             swarm.daemons[3].pex.find_peers_with_task(r1.task_id)
         ) >= {"host-0", "host-1"}
 
+    def test_concurrent_back_to_source_groups(self, tmp_path):
+        """piece_manager.go:793-873: range groups fetched concurrently."""
+        import threading
+        import time as _time
+
+        class SlowOrigin(FakeOrigin):
+            def __init__(self):
+                super().__init__(total_pieces=8)
+                self.in_flight = 0
+                self.max_in_flight = 0
+                self._lock = threading.Lock()
+
+            def fetch(self, url, number, piece_size):
+                with self._lock:
+                    self.in_flight += 1
+                    self.max_in_flight = max(self.max_in_flight, self.in_flight)
+                _time.sleep(0.02)
+                try:
+                    return super().fetch(url, number, piece_size)
+                finally:
+                    with self._lock:
+                        self.in_flight -= 1
+
+        swarm = _Swarm(tmp_path)
+        origin = SlowOrigin()
+        d = swarm.daemons[0]
+        d.conductor.source_fetcher = origin
+        d.conductor.concurrent_source_groups = 4
+        url = "https://origin/concurrent-blob"
+        r = d.download(url, piece_size=PIECE, content_length=8 * PIECE)
+        assert r.ok and r.back_to_source and r.pieces == 8
+        assert origin.max_in_flight > 1  # groups genuinely overlapped
+        for n in range(8):
+            assert d.storage.read_piece(r.task_id, n) == origin.content(url, n)
+        # Next peer still gets the bytes over P2P.
+        r1 = swarm.daemons[1].download(url, piece_size=PIECE)
+        assert r1.ok and not r1.back_to_source
+
+    def test_concurrent_back_to_source_group_failure_cancels(self, tmp_path):
+        class FlakyOrigin(FakeOrigin):
+            def fetch(self, url, number, piece_size):
+                if number == 5:
+                    raise IOError("origin 500")
+                return super().fetch(url, number, piece_size)
+
+        swarm = _Swarm(tmp_path)
+        d = swarm.daemons[0]
+        d.conductor.source_fetcher = FlakyOrigin()
+        d.conductor.concurrent_source_groups = 4
+        r = d.download(
+            "https://origin/flaky-blob", piece_size=PIECE, content_length=8 * PIECE
+        )
+        assert not r.ok
+
     def test_download_records_written(self, tmp_path):
         store = Storage(str(tmp_path / "records"), buffer_size=1)
         swarm = _Swarm(tmp_path, record_storage=store)
