@@ -9,6 +9,7 @@ running daemon and exits (smoke mode).
 
 from __future__ import annotations
 
+import os
 import socket
 import sys
 import time
@@ -27,6 +28,10 @@ from .common import base_parser, init_logging
 
 def build(cfg: DaemonConfig, scheduler_url: str):
     """Daemon composition against a wire scheduler (daemon.go:118-417)."""
+    if cfg.source:
+        from ..source import configure_sources
+
+        configure_sources(cfg.source)
     storage = DaemonStorage(cfg.storage.dir, quota_bytes=cfg.storage.quota_bytes)
     upload = UploadManager(storage, concurrent_limit=cfg.concurrent_upload_limit)
     piece_server = PieceHTTPServer(upload, host=cfg.server.host)
@@ -100,6 +105,45 @@ def run(argv=None) -> int:
         mode = "back-to-source" if result.back_to_source else "p2p"
         print(f"dfdaemon: {result.pieces} pieces via {mode} in {result.cost_s:.2f}s")
         return 0
+
+    if cfg.proxy.sni_enable:
+        from ..daemon.sni import SNIProxy
+        from ..security.ca import CertificateAuthority
+
+        class _DaemonShim:
+            """SNIProxy's daemon surface over the CLI's parts."""
+
+            def __init__(self, conductor, storage):
+                self.conductor = conductor
+                self._storage = storage
+
+            def download(self, url, piece_size, content_length=None):
+                return self.conductor.download(
+                    url, piece_size=piece_size, content_length=content_length
+                )
+
+            def read_task_bytes(self, task_id):
+                return self._storage.read_task_bytes(task_id)
+
+        # Persistent: restarts keep the same trust anchor, so clients that
+        # installed sni-ca.pem don't break on every deploy.
+        ca = CertificateAuthority.persistent(
+            os.path.join(cfg.storage.dir, "sni-ca")
+        )
+        ca_path = os.path.join(cfg.storage.dir, "sni-ca.pem")
+        os.makedirs(cfg.storage.dir, exist_ok=True)
+        with open(ca_path, "wb") as f:
+            f.write(ca.cert_pem)
+        sni = SNIProxy(
+            _DaemonShim(parts["conductor"], parts["storage"]),
+            ca=ca,
+            hijack=cfg.proxy.sni_hijack_hosts,
+            host=cfg.server.host,
+            port=cfg.proxy.sni_port,
+            piece_size=cfg.piece_size,
+        )
+        sni.serve()
+        print(f"dfdaemon: SNI proxy on :{sni.port}, trust anchor {ca_path}")
 
     # Probe loop against the remote scheduler.
     ping = make_host_pinger()

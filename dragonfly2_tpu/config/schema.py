@@ -180,6 +180,12 @@ class DaemonStorageSection:
 class ProxySection:
     enable: bool = False
     port: int = 65001
+    # SNI hijack (client/daemon/proxy hijackHTTPS): TLS listener that
+    # terminates matched SNI hosts with CA-minted leaf certs and serves
+    # them from P2P; unmatched hosts relay untouched.
+    sni_enable: bool = False
+    sni_port: int = 65443
+    sni_hijack_hosts: list = field(default_factory=list)  # regexes
 
 
 @dataclass
@@ -193,6 +199,10 @@ class DaemonConfig:
     # Concurrent back-to-source range groups (peerhost.go ConcurrentOption
     # GoroutineCount); 1 = sequential origin fetch.
     concurrent_source_groups: int = 1
+    # Cloud back-to-source credentials by scheme (peerhost.go source
+    # plugins): {"s3": {...}, "oss": {...}, "hdfs": {...}, "oras": {...}}
+    # — see dragonfly2_tpu.source.configure_sources.
+    source: dict = field(default_factory=dict)
     total_rate_limit: float = 1e9
     probe_interval_s: float = 20 * 60.0
     metrics: MetricsConfig = field(default_factory=MetricsConfig)

@@ -315,9 +315,18 @@ class Conductor:
                     raise _SourceFetchError("cancelled by sibling group")
                 try:
                     nbytes += self._source_one_piece(peer, number, piece_size)
-                except _SourceFetchError:
+                except Exception as e:
+                    # Not just fetch failures: a write/report error
+                    # (disk full, scheduler unreachable) is equally
+                    # task-fatal and must cancel the siblings rather
+                    # than escape past download()'s DownloadResult
+                    # contract.
                     cancelled.set()
-                    raise
+                    if isinstance(e, _SourceFetchError):
+                        raise
+                    raise _SourceFetchError(
+                        f"piece {number}: {type(e).__name__}: {e}"
+                    ) from e
             return nbytes
 
         with ThreadPoolExecutor(max_workers=groups) as pool:

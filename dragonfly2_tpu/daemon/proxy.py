@@ -14,13 +14,14 @@ round-2 target).
 from __future__ import annotations
 
 import re
-import select
 import socket
 import threading
 import urllib.request
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Pattern, Tuple
+
+from .relay import fetch_via_p2p, relay_bytes
 
 
 @dataclass
@@ -126,32 +127,7 @@ class P2PProxy:
                         buffered = b""
                     if buffered:
                         upstream.sendall(buffered)
-                    # Half-close-correct relay: EOF on one side shuts only
-                    # the OTHER side's write half; data keeps flowing the
-                    # remaining direction until both halves close.
-                    open_dirs = {client: upstream, upstream: client}
-                    while open_dirs:
-                        readable, _, _ = select.select(
-                            list(open_dirs), [], [], proxy.tunnel_idle_timeout
-                        )
-                        if not readable:
-                            break  # idle past the (long) budget
-                        for sock in readable:
-                            dst = open_dirs.get(sock)
-                            if dst is None:
-                                continue
-                            try:
-                                data = sock.recv(65536)
-                            except OSError:
-                                data = b""
-                            if not data:
-                                try:
-                                    dst.shutdown(socket.SHUT_WR)
-                                except OSError:
-                                    pass
-                                del open_dirs[sock]
-                            else:
-                                dst.sendall(data)
+                    relay_bytes(client, upstream, proxy.tunnel_idle_timeout)
                 finally:
                     upstream.close()
                 self.close_connection = True
@@ -161,16 +137,7 @@ class P2PProxy:
         self._thread: Optional[threading.Thread] = None
 
     def _fetch_p2p(self, url: str) -> bytes:
-        source = self.daemon.conductor.source_fetcher
-        content_length = None
-        if source is not None and hasattr(source, "content_length"):
-            content_length = source.content_length(url)
-        result = self.daemon.download(
-            url, piece_size=self.piece_size, content_length=content_length
-        )
-        if not result.ok:
-            raise IOError(f"p2p download of {url} failed")
-        return self.daemon.read_task_bytes(result.task_id)
+        return fetch_via_p2p(self.daemon, url, self.piece_size)
 
     def _fetch_direct(self, url: str) -> bytes:
         with urllib.request.urlopen(url, timeout=self.direct_timeout) as resp:

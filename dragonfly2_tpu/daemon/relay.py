@@ -1,0 +1,51 @@
+"""Half-close-correct byte relay shared by the CONNECT tunnel and the
+SNI pass-through (client/daemon/proxy's tunnel path).
+
+EOF on one side shuts only the OTHER side's write half; data keeps
+flowing the remaining direction until both halves close or the idle
+budget expires.
+"""
+
+from __future__ import annotations
+
+import select
+import socket
+
+
+def relay_bytes(a: socket.socket, b: socket.socket, idle_timeout: float) -> None:
+    open_dirs = {a: b, b: a}
+    while open_dirs:
+        readable, _, _ = select.select(list(open_dirs), [], [], idle_timeout)
+        if not readable:
+            return  # idle past the budget
+        for sock in readable:
+            dst = open_dirs.get(sock)
+            if dst is None:
+                continue
+            try:
+                data = sock.recv(65536)
+            except OSError:
+                data = b""
+            if not data:
+                try:
+                    dst.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+                del open_dirs[sock]
+            else:
+                dst.sendall(data)
+
+
+def fetch_via_p2p(daemon, url: str, piece_size: int) -> bytes:
+    """Route one URL through the daemon's P2P engine and return the bytes
+    (transport.go's divert seam, shared by both proxy faces)."""
+    source = daemon.conductor.source_fetcher
+    content_length = None
+    if source is not None and hasattr(source, "content_length"):
+        content_length = source.content_length(url)
+    result = daemon.download(
+        url, piece_size=piece_size, content_length=content_length
+    )
+    if not result.ok:
+        raise IOError(f"p2p download of {url} failed")
+    return daemon.read_task_bytes(result.task_id)

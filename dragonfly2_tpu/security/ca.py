@@ -67,6 +67,46 @@ class CertificateAuthority:
     def cert_pem(self) -> bytes:
         return self.certificate.public_bytes(serialization.Encoding.PEM)
 
+    @property
+    def key_pem(self) -> bytes:
+        return self._key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+
+    @classmethod
+    def from_pem(cls, key_pem: bytes, cert_pem: bytes) -> "CertificateAuthority":
+        """Reload a persisted CA — a restarting daemon must keep its trust
+        anchor or every already-distributed sni-ca.pem goes stale."""
+        ca = cls.__new__(cls)
+        ca._key = serialization.load_pem_private_key(key_pem, password=None)
+        ca.certificate = x509.load_pem_x509_certificate(cert_pem)
+        return ca
+
+    @classmethod
+    def persistent(cls, directory: str, common_name: str = "dragonfly2-tpu-ca") -> "CertificateAuthority":
+        """Load the CA from `directory`, creating + saving it on first use."""
+        import os
+
+        key_path = os.path.join(directory, "ca-key.pem")
+        cert_path = os.path.join(directory, "ca-cert.pem")
+        if os.path.exists(key_path) and os.path.exists(cert_path):
+            with open(key_path, "rb") as f:
+                key_pem = f.read()
+            with open(cert_path, "rb") as f:
+                cert_pem = f.read()
+            return cls.from_pem(key_pem, cert_pem)
+        ca = cls(common_name)
+        os.makedirs(directory, exist_ok=True)
+        for path, data in ((key_path, ca.key_pem), (cert_path, ca.cert_pem)):
+            # 0600 from the first byte: no default-umask window where
+            # another local user could read the signing key.
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+        return ca
+
     def sign_csr(
         self,
         csr_pem: bytes,

@@ -19,6 +19,42 @@ class SourceClient(Protocol):
         ...
 
 
+def default_transport(req: urllib.request.Request, timeout: float):
+    """The injectable-transport default shared by the cloud clients
+    (tests swap in local fixture servers)."""
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+class RangedHTTPClient:
+    """Shared HEAD-length / range-GET / exists over a ``_request`` hook.
+
+    Subclasses implement ``_request(url, method, extra_headers)`` doing
+    their own URL mapping and signing.  Errors in content_length are
+    answered with -1 across the board — including network-level OSError
+    (DNS, refused), not just HTTP status errors.
+    """
+
+    def _request(self, url: str, method: str, extra_headers=None):
+        raise NotImplementedError
+
+    def content_length(self, url: str) -> int:
+        try:
+            with self._request(url, "HEAD") as resp:
+                cl = resp.headers.get("Content-Length")
+                return int(cl) if cl is not None else -1
+        except (OSError, ValueError):
+            return -1
+
+    def read_range(self, url: str, start: int, length: int) -> bytes:
+        with self._request(
+            url, "GET", {"Range": f"bytes={start}-{start + length - 1}"}
+        ) as resp:
+            return resp.read()
+
+    def exists(self, url: str) -> bool:
+        return self.content_length(url) >= 0
+
+
 class FileSourceClient:
     """file:// and bare paths — the test/e2e fixture origin."""
 

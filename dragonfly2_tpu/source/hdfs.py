@@ -1,0 +1,63 @@
+"""hdfs:// source client over the WebHDFS REST API.
+
+Reference (pkg/source/clients/hdfsprotocol) speaks the native Hadoop RPC
+protocol via colinmarc/hdfs.  The TPU build deliberately uses WebHDFS —
+plain HTTP with offset/length reads maps 1:1 onto the piece-range access
+pattern and needs no protocol library.  URL form stays
+``hdfs://<namenode>:<port>/<path>`` with the port interpreted as the
+WebHDFS (HTTP) port.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Callable, Optional
+
+from .client import default_transport
+
+
+class HDFSSourceClient:
+    def __init__(
+        self,
+        *,
+        user: str = "",
+        timeout: float = 30.0,
+        transport: Optional[Callable] = None,
+    ) -> None:
+        self.user = user
+        self.timeout = timeout
+        self.transport = transport or default_transport
+
+    def _rest_url(self, url: str, op: str, **params) -> str:
+        parsed = urllib.parse.urlsplit(url)
+        qs = {"op": op, **params}
+        if self.user:
+            qs["user.name"] = self.user
+        return (
+            f"http://{parsed.netloc}/webhdfs/v1"
+            f"{urllib.parse.quote(parsed.path)}?{urllib.parse.urlencode(qs)}"
+        )
+
+    def content_length(self, url: str) -> int:
+        req = urllib.request.Request(self._rest_url(url, "GETFILESTATUS"))
+        try:
+            with self.transport(req, self.timeout) as resp:
+                status = json.loads(resp.read()).get("FileStatus", {})
+                return int(status.get("length", -1))
+        except (OSError, ValueError):
+            # OSError covers URLError/HTTPError AND network-level failures
+            # (DNS, connection refused) — all answer "size unknown".
+            return -1
+
+    def read_range(self, url: str, start: int, length: int) -> bytes:
+        # WebHDFS OPEN redirects namenode→datanode; urllib follows it.
+        req = urllib.request.Request(
+            self._rest_url(url, "OPEN", offset=start, length=length)
+        )
+        with self.transport(req, self.timeout) as resp:
+            return resp.read()
+
+    def exists(self, url: str) -> bool:
+        return self.content_length(url) >= 0

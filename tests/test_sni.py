@@ -1,0 +1,177 @@
+"""SNI-hijack proxy: ClientHello parsing, TLS interception into P2P,
+and byte-faithful relay of unmatched hosts."""
+
+import socket
+import ssl
+import threading
+
+from dragonfly2_tpu.daemon.sni import SNIProxy, parse_client_hello_sni
+from dragonfly2_tpu.security.ca import CertificateAuthority, PeerIdentity
+from dragonfly2_tpu.utils import idgen
+
+from tests.test_daemon import PIECE, _Swarm
+
+
+def _capture_client_hello(server_hostname: str) -> bytes:
+    """Record the raw bytes the ssl module actually sends for an SNI."""
+    listener = socket.create_server(("127.0.0.1", 0))
+    captured = {}
+
+    def server():
+        conn, _ = listener.accept()
+        captured["hello"] = conn.recv(16384)
+        conn.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = True
+    try:
+        with socket.create_connection(listener.getsockname()) as raw:
+            with ctx.wrap_socket(raw, server_hostname=server_hostname):
+                pass
+    except (ssl.SSLError, OSError):
+        pass  # handshake can't complete; we only want the ClientHello
+    t.join(timeout=5)
+    listener.close()
+    return captured["hello"]
+
+
+class TestClientHelloParser:
+    def test_parses_real_ssl_module_hello(self):
+        hello = _capture_client_hello("origin.internal.example")
+        assert parse_client_hello_sni(hello) == "origin.internal.example"
+
+    def test_garbage_and_short_input(self):
+        assert parse_client_hello_sni(b"") is None
+        assert parse_client_hello_sni(b"GET / HTTP/1.1\r\n") is None
+        assert parse_client_hello_sni(b"\x16\x03\x01\x00\x05ab") is None
+
+    def test_hello_without_sni(self):
+        hello = _capture_client_hello("no-sni.example")
+        # Strip the server_name extension bytes wholesale → parser must
+        # return None, not crash.
+        idx = hello.find(b"no-sni.example")
+        assert idx > 0
+        broken = hello[: idx - 9]  # truncate inside the extension block
+        assert parse_client_hello_sni(broken) is None
+
+
+class TestCAPersistence:
+    def test_persistent_ca_survives_restart(self, tmp_path):
+        d = str(tmp_path / "ca")
+        ca1 = CertificateAuthority.persistent(d)
+        ca2 = CertificateAuthority.persistent(d)
+        assert ca1.cert_pem == ca2.cert_pem
+        # The reloaded CA can still issue working identities.
+        identity = PeerIdentity.issue(ca2, common_name="x", hostnames=["x"])
+        assert b"BEGIN CERTIFICATE" in identity.cert_pem
+
+    def test_slow_client_hello_times_out_not_spins(self):
+        import time as _time
+
+        from dragonfly2_tpu.daemon.sni import _peek_client_hello
+
+        listener = socket.create_server(("127.0.0.1", 0))
+        client = socket.create_connection(listener.getsockname())
+        conn, _ = listener.accept()
+        client.sendall(b"\x16\x03\x01")  # 3 bytes of a record, then stall
+        t0 = _time.monotonic()
+        data = _peek_client_hello(conn, timeout=0.5)
+        elapsed = _time.monotonic() - t0
+        assert data == b"\x16\x03\x01"
+        assert 0.3 < elapsed < 5.0  # returned at the deadline, no hang
+        client.close()
+        conn.close()
+        listener.close()
+
+
+def _client_ctx(ca: CertificateAuthority) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_verify_locations(cadata=ca.cert_pem.decode())
+    return ctx
+
+
+class TestSNIHijack:
+    def test_hijacked_host_served_from_p2p(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        swarm.origin.content_length = lambda u: 3 * PIECE
+        ca = CertificateAuthority()
+        proxy = SNIProxy(
+            swarm.daemons[0], ca=ca, hijack=[r"\.hijack\.test$"],
+            piece_size=PIECE,
+        )
+        proxy.serve()
+        try:
+            ctx = _client_ctx(ca)
+            with socket.create_connection(("127.0.0.1", proxy.port)) as raw:
+                with ctx.wrap_socket(
+                    raw, server_hostname="origin.hijack.test"
+                ) as tls:
+                    # The leaf cert was minted on the fly for this SNI and
+                    # chains to the daemon CA (check_hostname verified it).
+                    tls.sendall(
+                        b"GET /blob-sni HTTP/1.1\r\n"
+                        b"Host: origin.hijack.test\r\n\r\n"
+                    )
+                    resp = b""
+                    while True:
+                        chunk = tls.recv(65536)
+                        if not chunk:
+                            break
+                        resp += chunk
+            head, _, body = resp.partition(b"\r\n\r\n")
+            assert head.startswith(b"HTTP/1.1 200")
+            expected = b"".join(
+                swarm.origin.content("https://origin.hijack.test/blob-sni", n)
+                for n in range(3)
+            )
+            assert body == expected
+            assert proxy.stats["hijacked"] == 1
+            # The bytes came through the P2P engine, not a direct fetch.
+            tid = idgen.task_id("https://origin.hijack.test/blob-sni")
+            assert swarm.daemons[0].storage.engine.piece_count(tid) == 3
+        finally:
+            proxy.stop()
+
+    def test_unmatched_host_relayed_to_origin(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=1)
+        ca = CertificateAuthority()
+        # Real TLS upstream for "localhost", its own CA-issued identity.
+        upstream_id = PeerIdentity.issue(
+            ca, common_name="localhost", hostnames=["localhost"]
+        )
+        upstream_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            paths = upstream_id.write(d)
+            upstream_ctx.load_cert_chain(paths["cert"], paths["key"])
+        listener = socket.create_server(("127.0.0.1", 0))
+
+        def upstream():
+            conn, _ = listener.accept()
+            with upstream_ctx.wrap_socket(conn, server_side=True) as tls:
+                data = tls.recv(1024)
+                tls.sendall(b"echo:" + data)
+
+        t = threading.Thread(target=upstream, daemon=True)
+        t.start()
+
+        proxy = SNIProxy(
+            swarm.daemons[0], ca=ca, hijack=[r"\.hijack\.test$"],
+            relay_port=listener.getsockname()[1],
+        )
+        proxy.serve()
+        try:
+            ctx = _client_ctx(ca)
+            with socket.create_connection(("127.0.0.1", proxy.port)) as raw:
+                with ctx.wrap_socket(raw, server_hostname="localhost") as tls:
+                    tls.sendall(b"ping")
+                    assert tls.recv(1024) == b"echo:ping"
+            assert proxy.stats["relayed"] == 1
+            assert proxy.stats["hijacked"] == 0
+        finally:
+            proxy.stop()
+            listener.close()
+        t.join(timeout=5)

@@ -1,0 +1,427 @@
+"""Back-to-source cloud clients: SigV4 vector, S3/OSS/WebHDFS/ORAS against
+local fixture servers that validate auth server-side, and conductor
+integration through the piece fetcher."""
+
+import base64
+import hashlib
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dragonfly2_tpu.source import (
+    HDFSSourceClient,
+    ORASSourceClient,
+    OSSSourceClient,
+    PieceSourceFetcher,
+    S3SourceClient,
+    SourceRegistry,
+    configure_sources,
+    default_registry,
+)
+from dragonfly2_tpu.source import sigv4
+from dragonfly2_tpu.source.oss import sign_oss
+
+BLOB = bytes(i % 251 for i in range(300 * 1024))  # 300 KiB, prime modulus
+
+
+class TestSigV4:
+    def test_aws_documented_vector(self):
+        """The published AWS doc example (GET iam ListUsers)."""
+        url = "https://iam.amazonaws.com/?Action=ListUsers&Version=2010-05-08"
+        headers = {
+            "Host": "iam.amazonaws.com",
+            "Content-Type": "application/x-www-form-urlencoded; charset=utf-8",
+            "X-Amz-Date": "20150830T123600Z",
+        }
+        canon, signed = sigv4.canonical_request(
+            "GET", url, headers, sigv4.EMPTY_SHA256
+        )
+        assert signed == "content-type;host;x-amz-date"
+        assert hashlib.sha256(canon.encode()).hexdigest() == (
+            "f536975d06c0309214f805bb90ccff089219ecd68b2577efef23edd43b7e1a59"
+        )
+        auth = sigv4.sign_request(
+            "GET", url, headers,
+            access_key="AKIDEXAMPLE",
+            secret_key="wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+            region="us-east-1", service="iam",
+            amz_date="20150830T123600Z",
+        )
+        assert auth.endswith(
+            "Signature=5d672d79c15b13162d9279b0855cfba6789a8edb4c82c400e06b5924a6f2b5d7"
+        )
+
+
+def _serve(handler_cls):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _range_slice(range_header, payload):
+    spec = range_header.split("=", 1)[1]
+    start, end = spec.split("-")
+    return payload[int(start): int(end) + 1]
+
+
+ACCESS, SECRET = "AKIDTEST", "secret-test-key"
+
+
+class _S3Handler(BaseHTTPRequestHandler):
+    """Path-style S3: /bucket/key. Re-derives the SigV4 signature."""
+
+    def _check_auth(self):
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256"):
+            return False
+        fields = dict(
+            kv.split("=", 1) for kv in auth.split(" ", 1)[1].replace(",", "").split()
+        )
+        signed_names = fields["SignedHeaders"].split(";")
+        headers = {}
+        for name in signed_names:
+            headers[name] = (
+                self.headers.get(name)
+                if name != "host" else self.headers.get("Host")
+            )
+        expected = sigv4.sign_request(
+            self.command,
+            f"http://{self.headers.get('Host')}{self.path}",
+            headers,
+            access_key=ACCESS, secret_key=SECRET,
+            region="us-east-1", service="s3",
+            amz_date=self.headers["x-amz-date"],
+            payload_sha256=self.headers["x-amz-content-sha256"],
+        )
+        return expected == auth
+
+    def do_HEAD(self):
+        if not self._check_auth():
+            self.send_error(403)
+            return
+        if self.path != "/bkt/data/obj.bin":
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(BLOB)))
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._check_auth():
+            self.send_error(403)
+            return
+        body = _range_slice(self.headers["Range"], BLOB)
+        self.send_response(206)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+class TestS3Client:
+    @pytest.fixture
+    def client(self):
+        srv = _serve(_S3Handler)
+        yield S3SourceClient(
+            access_key=ACCESS, secret_key=SECRET, region="us-east-1",
+            endpoint=f"http://127.0.0.1:{srv.server_address[1]}",
+        )
+        srv.shutdown()
+
+    def test_head_and_ranges(self, client):
+        url = "s3://bkt/data/obj.bin"
+        assert client.content_length(url) == len(BLOB)
+        assert client.read_range(url, 0, 1024) == BLOB[:1024]
+        assert client.read_range(url, 100_000, 4096) == BLOB[100_000:104_096]
+        assert client.exists(url)
+        assert not client.exists("s3://bkt/missing")
+
+    def test_bad_credentials_rejected(self, client):
+        bad = S3SourceClient(
+            access_key=ACCESS, secret_key="wrong", region="us-east-1",
+            endpoint=client.endpoint,
+        )
+        assert bad.content_length("s3://bkt/data/obj.bin") == -1
+
+
+class _OSSHandler(BaseHTTPRequestHandler):
+    def _check_auth(self):
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith(f"OSS {ACCESS}:"):
+            return False
+        bucket, key = self.path.lstrip("/").split("/", 1)
+        expected = sign_oss(
+            SECRET, self.command, date=self.headers["Date"],
+            bucket=bucket, key=key,
+        )
+        return auth.split(":", 1)[1] == expected
+
+    def do_HEAD(self):
+        if not self._check_auth():
+            self.send_error(403)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(BLOB)))
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._check_auth():
+            self.send_error(403)
+            return
+        body = _range_slice(self.headers["Range"], BLOB)
+        self.send_response(206)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+class TestOSSClient:
+    def test_signed_roundtrip(self):
+        srv = _serve(_OSSHandler)
+        try:
+            client = OSSSourceClient(
+                access_key_id=ACCESS, access_key_secret=SECRET,
+                endpoint=f"http://127.0.0.1:{srv.server_address[1]}",
+            )
+            url = "oss://bkt/dir/obj.bin"
+            assert client.content_length(url) == len(BLOB)
+            assert client.read_range(url, 5000, 100) == BLOB[5000:5100]
+            bad = OSSSourceClient(
+                access_key_id=ACCESS, access_key_secret="nope",
+                endpoint=client.endpoint,
+            )
+            assert bad.content_length(url) == -1
+        finally:
+            srv.shutdown()
+
+
+class _WebHDFSHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        from urllib.parse import parse_qs, urlsplit
+
+        parsed = urlsplit(self.path)
+        qs = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        if not parsed.path.startswith("/webhdfs/v1/data/file.bin"):
+            self.send_error(404)
+            return
+        if qs["op"] == "GETFILESTATUS":
+            body = json.dumps(
+                {"FileStatus": {"length": len(BLOB), "type": "FILE"}}
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif qs["op"] == "OPEN":
+            if "redirected" not in qs:
+                # namenode → datanode redirect, as real WebHDFS does
+                self.send_response(307)
+                self.send_header(
+                    "Location",
+                    f"http://127.0.0.1:{self.server.server_address[1]}"
+                    f"{parsed.path}?{parsed.query}&redirected=1",
+                )
+                self.end_headers()
+                return
+            off, ln = int(qs.get("offset", 0)), int(qs["length"])
+            body = BLOB[off: off + ln]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(400)
+
+    def log_message(self, *a):
+        pass
+
+
+class TestHDFSClient:
+    def test_status_open_redirect(self):
+        srv = _serve(_WebHDFSHandler)
+        try:
+            client = HDFSSourceClient(user="hadoop")
+            url = f"hdfs://127.0.0.1:{srv.server_address[1]}/data/file.bin"
+            assert client.content_length(url) == len(BLOB)
+            assert client.read_range(url, 0, 512) == BLOB[:512]
+            assert client.read_range(url, 9999, 2000) == BLOB[9999:11999]
+            missing = f"hdfs://127.0.0.1:{srv.server_address[1]}/nope"
+            assert client.content_length(missing) == -1
+        finally:
+            srv.shutdown()
+
+
+TOKEN = "tok-abc123"
+
+
+class _ORASHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        if self.path.startswith("/service/token/"):
+            assert "scope=repository:proj/art:pull" in self.path
+            body = json.dumps({"token": TOKEN}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/v2/proj/art/manifests/v1":
+            if self.headers.get("Authorization") != f"Bearer {TOKEN}":
+                self.send_error(401)
+                return
+            body = json.dumps({
+                "layers": [
+                    {"digest": "sha256:aaa", "size": 11},
+                    {"digest": "sha256:bbb", "size": len(BLOB)},
+                ]
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/v2/proj/art/blobs/sha256:bbb":
+            if self.headers.get("Authorization") != f"Bearer {TOKEN}":
+                self.send_error(401)
+                return
+            body = _range_slice(self.headers["Range"], BLOB)
+            self.send_response(206)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(404)
+
+    def log_message(self, *a):
+        pass
+
+
+class TestORASClient:
+    def test_token_manifest_blob_flow(self):
+        srv = _serve(_ORASHandler)
+        try:
+            client = ORASSourceClient(
+                auth_header="Basic " + base64.b64encode(b"u:p").decode(),
+                insecure_http=True,
+            )
+            url = f"oras://127.0.0.1:{srv.server_address[1]}/proj/art:v1"
+            # content_length comes from the manifest's LAST layer size,
+            # no blob transfer.
+            assert client.content_length(url) == len(BLOB)
+            assert client.read_range(url, 0, 64) == BLOB[:64]
+            assert client.read_range(url, 200_000, 8192) == BLOB[200_000:208_192]
+        finally:
+            srv.shutdown()
+
+
+class _ExpiringORASHandler(_ORASHandler):
+    """First token expires after one blob read: 401 must trigger a
+    transparent re-auth + retry inside read_range."""
+
+    issued = []
+
+    def do_GET(self):
+        if self.path.startswith("/service/token/"):
+            tok = f"tok-{len(self.issued)}"
+            self.issued.append(tok)
+            body = json.dumps({"token": tok}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        auth = self.headers.get("Authorization", "")
+        current = f"Bearer {self.issued[-1]}" if self.issued else None
+        if "/blobs/" in self.path and auth != current:
+            self.send_error(401)  # stale token
+            return
+        # Delegate manifest/blob serving with the live token expectation.
+        if self.path == "/v2/proj/art/manifests/v1":
+            body = json.dumps(
+                {"layers": [{"digest": "sha256:bbb", "size": len(BLOB)}]}
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/v2/proj/art/blobs/sha256:bbb":
+            body = _range_slice(self.headers["Range"], BLOB)
+            self.send_response(206)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(404)
+
+
+class TestORASTokenRefresh:
+    def test_401_triggers_reauth_and_retry(self):
+        _ExpiringORASHandler.issued = []
+        srv = _serve(_ExpiringORASHandler)
+        try:
+            client = ORASSourceClient(insecure_http=True)
+            url = f"oras://127.0.0.1:{srv.server_address[1]}/proj/art:v1"
+            assert client.read_range(url, 0, 16) == BLOB[:16]
+            # Simulate expiry: registry rotates; cached token now stale.
+            _ExpiringORASHandler.issued.append("tok-rotated")
+            assert client.read_range(url, 16, 16) == BLOB[16:32]
+            # A fresh token was fetched (>=3: initial + rotation + re-auth).
+            assert len(_ExpiringORASHandler.issued) >= 3
+        finally:
+            srv.shutdown()
+
+
+class TestNetworkErrorHandling:
+    def test_unreachable_endpoints_answer_minus_one(self):
+        # connection refused, not a traceback (URLError ⊂ OSError).
+        s3 = S3SourceClient(access_key="a", secret_key="b",
+                            endpoint="http://127.0.0.1:1")
+        assert s3.content_length("s3://b/k") == -1
+        assert not s3.exists("s3://b/k")
+        oss = OSSSourceClient(access_key_id="a", access_key_secret="b",
+                              endpoint="http://127.0.0.1:1")
+        assert oss.content_length("oss://b/k") == -1
+        hdfs = HDFSSourceClient()
+        assert hdfs.content_length("hdfs://127.0.0.1:1/x") == -1
+        oci = ORASSourceClient(insecure_http=True)
+        assert oci.content_length("oras://127.0.0.1:1/r:t") == -1
+
+
+class TestRegistryIntegration:
+    def test_configure_sources_and_piece_fetcher(self):
+        srv = _serve(_ORASHandler)
+        try:
+            reg = SourceRegistry()
+            configure_sources(
+                {"oras": {"insecure_http": True}}, registry=reg
+            )
+            fetcher = PieceSourceFetcher(registry=reg)
+            url = f"oras://127.0.0.1:{srv.server_address[1]}/proj/art:v1"
+            piece = fetcher.fetch(url, 2, 65536)
+            assert piece == BLOB[131072: 131072 + 65536]
+            assert fetcher.content_length(url) == len(BLOB)
+        finally:
+            srv.shutdown()
+
+    def test_default_registry_has_all_schemes_after_configure(self):
+        reg = SourceRegistry()
+        configure_sources(
+            {
+                "s3": {"access_key": "a", "secret_key": "b"},
+                "oss": {"access_key_id": "a", "access_key_secret": "b",
+                        "endpoint": "http://x"},
+                "hdfs": {},
+                "oci": {},
+            },
+            registry=reg,
+        )
+        for scheme in ("s3", "oss", "hdfs", "oras", "oci"):
+            assert reg.client_for(f"{scheme}://h/p:t") is not None
+
+    def test_unknown_scheme_raises(self):
+        with pytest.raises(KeyError):
+            default_registry.client_for("gopher://x/y")
