@@ -57,12 +57,28 @@ def run(argv=None) -> int:
 
     from ..manager.rest import ManagerRESTServer
 
+    auth = {}
+    if cfg.token_secret:
+        from ..manager.users import UserStore
+        from ..security.tokens import TokenIssuer, TokenVerifier
+
+        secret = cfg.token_secret.encode()
+        users = UserStore(cfg.users_db or None)
+        if cfg.root_password:
+            users.ensure_root(cfg.root_password)
+        auth = {
+            "token_verifier": TokenVerifier(secret),
+            "token_issuer": TokenIssuer(secret),
+            "users": users,
+        }
     rest = ManagerRESTServer(
         parts["registry"], parts["clusters"], parts["searcher"],
-        host=cfg.server.host, port=cfg.server.port,
+        host=cfg.server.host, port=cfg.server.port, **auth,
     )
     rest.serve()
-    print(f"manager: serving REST on {rest.url} (ctrl-c to stop)")
+    # flush: under a pipe (supervisors, e2e harnesses) the ready line must
+    # be visible immediately, not at buffer-fill.
+    print(f"manager: serving REST on {rest.url} (ctrl-c to stop)", flush=True)
     try:
         while True:
             time.sleep(3600)

@@ -162,6 +162,11 @@ class ManagerConfig:
     server: ServerConfig = field(default_factory=lambda: ServerConfig(port=65003))
     registry: ModelRegistrySection = field(default_factory=ModelRegistrySection)
     keepalive_ttl_s: float = 60.0
+    # RBAC (manager users + PATs): token_secret (>=16 bytes) turns auth
+    # on; users_db persists accounts; root_password seeds the first admin.
+    token_secret: str = ""
+    users_db: str = ""
+    root_password: str = ""
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     log: LogConfig = field(default_factory=LogConfig)
 

@@ -16,9 +16,15 @@ on, rebuilt as an embeddable runtime:
   scheduler/config/dynconfig.go:58-137).
 - ``cluster``   — scheduler/seed-peer cluster records + keepalive state
   (manager/models, keepalive at manager_server_v2.go:749).
+- ``users``     — user accounts, pbkdf2 passwords, personal access
+  tokens (manager/models/user.go, personal_access_token.go, handlers).
+- ``oauth``     — OAuth2 authorization-code sign-in seam
+  (manager/models/oauth.go).
 """
 
 from .registry import Model, ModelRegistry, ModelState  # noqa: F401
 from .searcher import ClusterScopes, SchedulerCluster, Searcher  # noqa: F401
 from .dynconfig import Dynconfig, DynconfigServer  # noqa: F401
 from .cluster import ClusterManager, SchedulerInstance, SeedPeerInstance  # noqa: F401
+from .users import PersonalAccessToken, User, UserStore  # noqa: F401
+from .oauth import OAuthProvider, OAuthSignin  # noqa: F401

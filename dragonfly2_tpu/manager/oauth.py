@@ -1,0 +1,138 @@
+"""OAuth2 sign-in seam (reference: manager/models/oauth.go + handlers —
+configurable oauth providers backing console sign-in).
+
+Standard authorization-code flow with an injectable transport: the
+manager redirects to the provider's authorize URL, exchanges the
+callback code for an access token, fetches the profile, and maps it to
+a local user (get-or-create by email, READONLY by default — an admin
+raises roles afterwards).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from ..security.tokens import Role
+from .users import User, UserStore
+
+
+def _default_transport(req: urllib.request.Request, timeout: float):
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+@dataclass
+class OAuthProvider:
+    """One configured provider (oauth.go rows: name, client id/secret,
+    endpoints)."""
+
+    name: str
+    client_id: str
+    client_secret: str
+    auth_url: str
+    token_url: str
+    profile_url: str
+    scopes: str = "openid email"
+
+
+class OAuthSignin:
+    def __init__(
+        self,
+        users: UserStore,
+        *,
+        timeout: float = 15.0,
+        transport: Optional[Callable] = None,
+    ) -> None:
+        self.users = users
+        self.timeout = timeout
+        self.transport = transport or _default_transport
+        self._providers: Dict[str, OAuthProvider] = {}
+        # state → (provider name, issued_at).  The authorize-url endpoint
+        # is unauthenticated: entries expire and the map is pruned so it
+        # can't be grown without bound remotely.
+        self._states: Dict[str, tuple] = {}
+        self.state_ttl_s = 600.0
+
+    def register(self, provider: OAuthProvider) -> None:
+        self._providers[provider.name] = provider
+
+    def providers(self):
+        return sorted(self._providers)
+
+    def _prune_states(self) -> None:
+        import time
+
+        cutoff = time.time() - self.state_ttl_s
+        for s in [s for s, (_, t) in self._states.items() if t < cutoff]:
+            self._states.pop(s, None)
+
+    def authorize_url(self, provider_name: str, redirect_uri: str) -> str:
+        import time
+
+        self._prune_states()
+        p = self._providers[provider_name]
+        state = secrets.token_urlsafe(16)
+        self._states[state] = (p.name, time.time())
+        return p.auth_url + "?" + urllib.parse.urlencode(
+            {
+                "client_id": p.client_id,
+                "redirect_uri": redirect_uri,
+                "response_type": "code",
+                "scope": p.scopes,
+                "state": state,
+            }
+        )
+
+    def signin(
+        self, provider_name: str, code: str, state: str, redirect_uri: str
+    ) -> User:
+        """Code exchange → profile fetch → local user (get-or-create)."""
+        self._prune_states()
+        entry = self._states.pop(state, None)
+        if entry is None or entry[0] != provider_name:
+            raise PermissionError("oauth state mismatch (CSRF)")
+        p = self._providers[provider_name]
+        body = urllib.parse.urlencode(
+            {
+                "client_id": p.client_id,
+                "client_secret": p.client_secret,
+                "code": code,
+                "grant_type": "authorization_code",
+                "redirect_uri": redirect_uri,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            p.token_url, data=body,
+            headers={"Accept": "application/json"}, method="POST",
+        )
+        with self.transport(req, self.timeout) as resp:
+            token = json.loads(resp.read()).get("access_token", "")
+        if not token:
+            raise PermissionError("oauth code exchange failed")
+        req = urllib.request.Request(
+            p.profile_url, headers={"Authorization": f"Bearer {token}"}
+        )
+        with self.transport(req, self.timeout) as resp:
+            profile = json.loads(resp.read())
+        email = profile.get("email") or ""
+        login = profile.get("login") or profile.get("name") or email
+        if not login:
+            raise PermissionError("oauth profile has no usable identity")
+        username = f"{p.name}:{login}"
+        existing = self.users.by_name(username)
+        if existing is not None:
+            # Same gate verify_password applies: a disabled account must
+            # not regain access through the OAuth door.
+            if existing.state != "enabled":
+                raise PermissionError(f"account {username!r} is disabled")
+            return existing
+        # OAuth users get an unguessable local password (they sign in
+        # through the provider, not with it).
+        return self.users.create_user(
+            username, secrets.token_urlsafe(24), email=email,
+            role=Role.READONLY,
+        )

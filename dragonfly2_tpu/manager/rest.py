@@ -9,6 +9,24 @@ Minimal JSON binding over stdlib HTTP:
   GET    /api/v1/schedulers                      active scheduler instances
   GET    /api/v1/clusters:search?ip=&hostname=&idc=&location=
   GET    /api/v1/healthy                         liveness
+
+User/RBAC surface (manager/handlers/user.go + personal access tokens):
+
+  POST   /api/v1/users:signup                    open signup (READONLY)
+  POST   /api/v1/users:signin                    {name,password} → token
+  GET    /api/v1/users                           ADMIN
+  POST   /api/v1/users/<id>:role                 ADMIN
+  POST   /api/v1/users/<id>:state                ADMIN enable/disable
+  POST   /api/v1/users/<id>:reset-password       self or ADMIN
+  POST   /api/v1/pats                            create PAT (raw shown once)
+  GET    /api/v1/pats                            own tokens (ADMIN: ?user_id=)
+  POST   /api/v1/pats/<id>:revoke                owner or ADMIN
+  GET    /api/v1/oauth:providers
+  GET    /api/v1/oauth/<name>:authorize-url?redirect_uri=
+  POST   /api/v1/oauth/<name>:signin             {code,state,redirect_uri} → token
+
+Authorization accepts EITHER a manager-issued HMAC session token or a
+raw personal access token in ``Authorization: Bearer ...``.
 """
 
 from __future__ import annotations
@@ -20,10 +38,32 @@ from http.server import BaseHTTPRequestHandler
 from typing import List, Optional, Tuple
 
 from ..rpc._server import ThreadedHTTPService
+from ..security.tokens import Role
 
 from .cluster import ClusterManager
 from .registry import Model, ModelRegistry
 from .searcher import SchedulerCluster, Searcher
+
+
+def _user_to_json(u) -> dict:
+    return {
+        "id": u.id,
+        "name": u.name,
+        "email": u.email,
+        "role": u.role.name.lower(),
+        "state": u.state,
+    }
+
+
+def _pat_to_json(p) -> dict:
+    return {
+        "id": p.id,
+        "user_id": p.user_id,
+        "name": p.name,
+        "role": p.role.name.lower(),
+        "expires_at": p.expires_at,
+        "revoked": p.revoked,
+    }
 
 
 def _model_to_json(m: Model) -> dict:
@@ -49,6 +89,9 @@ class ManagerRESTServer:
         host: str = "127.0.0.1",
         port: int = 0,
         token_verifier=None,
+        token_issuer=None,
+        users=None,
+        oauth=None,
     ):
         self.registry = registry
         self.clusters = clusters
@@ -57,7 +100,12 @@ class ManagerRESTServer:
         # Optional RBAC: with a verifier configured, mutations require a
         # bearer token of sufficient role (security/tokens.py); reads stay
         # open (matching the reference's authenticated-writes posture).
+        # With a UserStore attached, PATs authenticate too and the user/
+        # PAT/oauth routes come alive.
         self.token_verifier = token_verifier
+        self.token_issuer = token_issuer
+        self.users = users
+        self.oauth = oauth
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -128,6 +176,41 @@ class ManagerRESTServer:
                             for s in server.clusters.active_schedulers()
                         ],
                     )
+                elif path == "/api/v1/users" and server.users is not None:
+                    if not self._authorized(Role.ADMIN):
+                        self._json(403, {"error": "forbidden"})
+                        return
+                    self._json(200, [_user_to_json(u) for u in server.users.list_users()])
+                elif path == "/api/v1/pats" and server.users is not None:
+                    ident = self._identity()
+                    if ident is None:
+                        self._json(401, {"error": "unauthorized"})
+                        return
+                    subject, role, _kind = ident
+                    target = q.get("user_id") or subject
+                    if target != subject and role < Role.ADMIN:
+                        self._json(403, {"error": "forbidden"})
+                        return
+                    self._json(
+                        200, [_pat_to_json(p) for p in server.users.list_pats(target)]
+                    )
+                elif path == "/api/v1/oauth:providers" and server.oauth is not None:
+                    self._json(200, server.oauth.providers())
+                elif (
+                    path.startswith("/api/v1/oauth/")
+                    and path.endswith(":authorize-url")
+                    and server.oauth is not None
+                ):
+                    name = path[len("/api/v1/oauth/") : -len(":authorize-url")]
+                    try:
+                        self._json(
+                            200,
+                            {"url": server.oauth.authorize_url(
+                                name, q.get("redirect_uri", "")
+                            )},
+                        )
+                    except KeyError:
+                        self._json(404, {"error": f"no provider {name!r}"})
                 elif path == "/api/v1/clusters:search":
                     try:
                         ranked = server.searcher.find_scheduler_clusters(
@@ -145,17 +228,56 @@ class ManagerRESTServer:
                 else:
                     self._json(404, {"error": "not found"})
 
-            def _authorized(self, required_role) -> bool:
-                if server.token_verifier is None:
-                    return True
+            def _identity(self):
+                """→ (subject, Role, kind) from a session token OR a PAT;
+                None when unauthenticated.  kind ∈ {"session", "pat"} —
+                credential-management routes require a session.
+
+                Session tokens are re-checked against the live user store:
+                a disable or demotion takes effect immediately, not at
+                token expiry."""
+                from ..manager.users import PAT_PREFIX
+
                 auth = self.headers.get("Authorization", "")
                 token = auth[len("Bearer ") :] if auth.startswith("Bearer ") else None
-                return server.token_verifier.authorize(token, required_role) is not None
+                if token is None:
+                    return None
+                if server.users is not None and token.startswith(PAT_PREFIX):
+                    user = server.users.authenticate_pat(token)
+                    return None if user is None else (user.id, user.role, "pat")
+                if server.token_verifier is not None:
+                    claims = server.token_verifier.verify(token)
+                    if claims is None:
+                        return None
+                    role = claims.role
+                    if server.users is not None:
+                        user = server.users.get(claims.subject)
+                        if user is not None:
+                            if user.state != "enabled":
+                                return None
+                            role = min(role, user.role)
+                    return (claims.subject, role, "session")
+                return None
+
+            def _authorized(self, required_role) -> bool:
+                if server.token_verifier is None and server.users is None:
+                    return True
+                ident = self._identity()
+                return ident is not None and ident[1] >= required_role
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(length) or b"{}")
 
             def do_POST(self):
-                from ..security.tokens import Role
-
                 path = urllib.parse.urlsplit(self.path).path
+                if (
+                    path.startswith("/api/v1/users")
+                    or path.startswith("/api/v1/pats")
+                    or path.startswith("/api/v1/oauth/")
+                ):
+                    self._user_routes(path)
+                    return
                 # Role per route, declared at the route (tokens.py tiers):
                 # model CREATION is the trainer's automated flow → PEER;
                 # activation/deactivation are operator decisions.
@@ -171,8 +293,7 @@ class ManagerRESTServer:
                 if path == "/api/v1/models":
                     # CreateModel (reference: manager_server_v1.go:802).
                     try:
-                        length = int(self.headers.get("Content-Length", 0))
-                        req = json.loads(self.rfile.read(length) or b"{}")
+                        req = self._body()
                         m = server.registry.create_model(
                             name=req["name"],
                             type=req["type"],
@@ -199,6 +320,124 @@ class ManagerRESTServer:
                         self._json(404, {"error": f"model {model_id} not found"})
                     return
                 self._json(404, {"error": "not found"})
+
+            def _user_routes(self, path: str) -> None:
+                """User / PAT / oauth mutations (handlers/user.go)."""
+                if server.users is None:
+                    self._json(404, {"error": "user store not configured"})
+                    return
+                try:
+                    if path == "/api/v1/users:signup":
+                        req = self._body()
+                        u = server.users.create_user(
+                            req["name"], req["password"],
+                            email=req.get("email", ""),
+                        )
+                        self._json(200, _user_to_json(u))
+                    elif path == "/api/v1/users:signin":
+                        req = self._body()
+                        u = server.users.verify_password(
+                            req.get("name", ""), req.get("password", "")
+                        )
+                        if u is None or server.token_issuer is None:
+                            self._json(401, {"error": "bad credentials"})
+                            return
+                        token = server.token_issuer.issue(u.id, u.role)
+                        self._json(200, {"token": token, "role": u.role.name.lower()})
+                    elif path.startswith("/api/v1/users/") and ":" in path:
+                        user_id, _, action = path[len("/api/v1/users/") :].rpartition(":")
+                        ident = self._identity()
+                        if ident is None:
+                            self._json(401, {"error": "unauthorized"})
+                            return
+                        subject, role, kind = ident
+                        if action == "reset-password":
+                            # Sessions only: a leaked low-role PAT must not
+                            # be able to rotate its owner's password and
+                            # re-signin at the owner's full role.
+                            if kind != "session":
+                                self._json(403, {"error": "session token required"})
+                                return
+                            if subject != user_id and role < Role.ADMIN:
+                                self._json(403, {"error": "forbidden"})
+                                return
+                            server.users.reset_password(
+                                user_id, self._body()["password"]
+                            )
+                            self._json(200, {"ok": True})
+                        elif action in ("role", "state"):
+                            if role < Role.ADMIN:
+                                self._json(403, {"error": "forbidden"})
+                                return
+                            if action == "role":
+                                u = server.users.set_role(
+                                    user_id, Role[self._body()["role"].upper()]
+                                )
+                            else:
+                                u = server.users.set_state(
+                                    user_id, self._body()["state"]
+                                )
+                            self._json(200, _user_to_json(u))
+                        else:
+                            self._json(404, {"error": f"unknown action {action}"})
+                    elif path == "/api/v1/pats":
+                        ident = self._identity()
+                        if ident is None:
+                            self._json(401, {"error": "unauthorized"})
+                            return
+                        subject, effective, _kind = ident
+                        req = self._body()
+                        requested = (
+                            Role[req["role"].upper()] if req.get("role")
+                            else effective
+                        )
+                        # Cap at the CALLER's effective role (a READONLY-
+                        # capped PAT must not mint tokens at its owner's
+                        # full role), on top of create_pat's owner cap.
+                        kwargs = {"role": min(requested, effective)}
+                        if req.get("ttl_s"):
+                            kwargs["ttl_s"] = float(req["ttl_s"])
+                        pat, raw = server.users.create_pat(
+                            subject, req.get("name", ""), **kwargs
+                        )
+                        payload = _pat_to_json(pat)
+                        payload["token"] = raw  # shown exactly once
+                        self._json(200, payload)
+                    elif path.startswith("/api/v1/pats/") and path.endswith(":revoke"):
+                        pat_id = path[len("/api/v1/pats/") : -len(":revoke")]
+                        ident = self._identity()
+                        if ident is None:
+                            self._json(401, {"error": "unauthorized"})
+                            return
+                        subject, role, _kind = ident
+                        owned = {p.id for p in server.users.list_pats(subject)}
+                        if pat_id not in owned and role < Role.ADMIN:
+                            self._json(403, {"error": "forbidden"})
+                            return
+                        server.users.revoke_pat(pat_id)
+                        self._json(200, {"ok": True})
+                    elif (
+                        path.startswith("/api/v1/oauth/")
+                        and path.endswith(":signin")
+                        and server.oauth is not None
+                    ):
+                        name = path[len("/api/v1/oauth/") : -len(":signin")]
+                        req = self._body()
+                        u = server.oauth.signin(
+                            name, req.get("code", ""), req.get("state", ""),
+                            req.get("redirect_uri", ""),
+                        )
+                        if server.token_issuer is None:
+                            self._json(500, {"error": "no token issuer"})
+                            return
+                        token = server.token_issuer.issue(u.id, u.role)
+                        self._json(200, {"token": token, "role": u.role.name.lower()})
+                    else:
+                        self._json(404, {"error": "not found"})
+                except PermissionError as exc:
+                    self._json(403, {"error": str(exc)})
+                except (KeyError, ValueError) as exc:
+                    self._json(400, {"error": str(exc)})
 
         self._svc = ThreadedHTTPService(Handler, host, port, "manager-rest")
         self.address: Tuple[str, int] = self._svc.address

@@ -54,12 +54,16 @@ def configure_sources(
         reg.register("hdfs", HDFSSourceClient(**source_cfg["hdfs"]))
     # oras and oci may target different registries with different creds:
     # each block configures its own scheme; a lone block serves both.
-    if "oras" in source_cfg:
-        reg.register("oras", ORASSourceClient(**source_cfg["oras"]))
-    if "oci" in source_cfg:
-        reg.register("oci", ORASSourceClient(**source_cfg["oci"]))
-    if "oras" in source_cfg and "oci" not in source_cfg:
-        reg.register("oci", reg.client_for("oras://h/p:t"))
-    elif "oci" in source_cfg and "oras" not in source_cfg:
-        reg.register("oras", reg.client_for("oci://h/p:t"))
+    oras_client = (
+        ORASSourceClient(**source_cfg["oras"]) if "oras" in source_cfg else None
+    )
+    oci_client = (
+        ORASSourceClient(**source_cfg["oci"]) if "oci" in source_cfg else None
+    )
+    if oras_client is not None:
+        reg.register("oras", oras_client)
+        reg.register("oci", oci_client or oras_client)
+    elif oci_client is not None:
+        reg.register("oci", oci_client)
+        reg.register("oras", oci_client)
     return reg

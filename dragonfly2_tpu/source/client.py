@@ -49,10 +49,23 @@ class RangedHTTPClient:
         with self._request(
             url, "GET", {"Range": f"bytes={start}-{start + length - 1}"}
         ) as resp:
-            return resp.read()
+            return _ranged_body(resp, start, length)
 
     def exists(self, url: str) -> bool:
         return self.content_length(url) >= 0
+
+
+def _ranged_body(resp, start: int, length: int) -> bytes:
+    """Range responses are optional for some origins (e.g. OCI blob
+    endpoints): a 200 carries the WHOLE object, so slice it down rather
+    than storing the full blob as one corrupt piece."""
+    body = resp.read()
+    status = getattr(resp, "status", None) or getattr(resp, "code", 206)
+    if status == 200:
+        # 200 = the whole object from byte 0 (a range-honoring origin
+        # answers 206), so the piece is a slice of it.
+        return body[start : start + length]
+    return body
 
 
 class FileSourceClient:

@@ -21,7 +21,7 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Dict, Optional, Tuple
 
-from .client import default_transport
+from .client import _ranged_body, default_transport
 
 OCI_MANIFEST_ACCEPT = "application/vnd.oci.image.manifest.v1+json"
 
@@ -113,7 +113,7 @@ class ORASSourceClient:
                 "Range": f"bytes={start}-{start + length - 1}",
             },
         ) as resp:
-            return resp.read()
+            return _ranged_body(resp, start, length)
 
     def read_range(self, url: str, start: int, length: int) -> bytes:
         token, digest, _ = self._resolve(url)

@@ -375,6 +375,42 @@ class TestORASTokenRefresh:
             srv.shutdown()
 
 
+class _NoRangeHandler(BaseHTTPRequestHandler):
+    """An origin that ignores Range and answers 200 with the full body
+    (the OCI spec makes blob ranges optional)."""
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(BLOB)))
+        self.end_headers()
+        self.wfile.write(BLOB)
+
+    def do_HEAD(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(BLOB)))
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+class TestRangeFallback:
+    def test_200_full_body_sliced_to_piece(self):
+        srv = _serve(_NoRangeHandler)
+        try:
+            client = OSSSourceClient(
+                access_key_id=ACCESS, access_key_secret=SECRET,
+                endpoint=f"http://127.0.0.1:{srv.server_address[1]}",
+            )
+            url = "oss://bkt/obj"
+            assert client.read_range(url, 4096, 512) == BLOB[4096:4608]
+            # Tail piece: slice stops at the object end.
+            tail = client.read_range(url, len(BLOB) - 100, 512)
+            assert tail == BLOB[-100:]
+        finally:
+            srv.shutdown()
+
+
 class TestNetworkErrorHandling:
     def test_unreachable_endpoints_answer_minus_one(self):
         # connection refused, not a traceback (URLError ⊂ OSError).
