@@ -42,6 +42,15 @@ def build(cfg: DaemonConfig, scheduler_url: str):
 
     # Advertise a routable address — peers on OTHER machines dial it.
     ip = cfg.server.advertise_ip or local_ip()
+    if scheduler_url.startswith("grpc://"):
+        from ..rpc.grpc_transport import GRPCRemoteScheduler
+
+        scheduler_client_cls = lambda url: GRPCRemoteScheduler(  # noqa: E731
+            url[len("grpc://"):]
+        )
+    else:
+        scheduler_client_cls = RemoteScheduler
+
     host = Host(
         # The piece port joins the identity so multiple daemons on one
         # machine are distinct hosts (reference: hostname-port host ids,
@@ -53,7 +62,7 @@ def build(cfg: DaemonConfig, scheduler_url: str):
         download_port=piece_server.port,
         concurrent_upload_limit=cfg.concurrent_upload_limit,
     )
-    client = RemoteScheduler(scheduler_url)
+    client = scheduler_client_cls(scheduler_url)
     conductor = Conductor(
         host,
         storage,

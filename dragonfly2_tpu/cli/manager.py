@@ -71,6 +71,13 @@ def run(argv=None) -> int:
             "token_issuer": TokenIssuer(secret),
             "users": users,
         }
+        if cfg.oauth_providers:
+            from ..manager.oauth import OAuthProvider, OAuthSignin
+
+            oauth = OAuthSignin(users)
+            for p in cfg.oauth_providers:
+                oauth.register(OAuthProvider(**p))
+            auth["oauth"] = oauth
     rest = ManagerRESTServer(
         parts["registry"], parts["clusters"], parts["searcher"],
         host=cfg.server.host, port=cfg.server.port, **auth,

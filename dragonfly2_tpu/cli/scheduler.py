@@ -104,12 +104,29 @@ def run(argv=None) -> int:
 
     rpc_server = SchedulerHTTPServer(service, host=cfg.server.host, port=cfg.server.port)
     rpc_server.serve()
-    print(f"scheduler: serving rpc on {rpc_server.url} (ctrl-c to stop)")
+    # Both transports bind the SAME adapter: HTTP/JSON and binary gRPC
+    # (pkg/rpc serves gRPC in the reference; JSON stays for curl/debug).
+    grpc_server = None
+    if cfg.server.grpc_port >= 0:
+        from ..rpc.grpc_transport import SchedulerGRPCServer
+
+        grpc_server = SchedulerGRPCServer(
+            service, host=cfg.server.host, port=cfg.server.grpc_port
+        )
+        grpc_server.serve()
+    print(
+        f"scheduler: serving rpc on {rpc_server.url}"
+        + (f" and grpc on {grpc_server.target}" if grpc_server else "")
+        + " (ctrl-c to stop)",
+        flush=True,
+    )
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         rpc_server.stop()
+        if grpc_server is not None:
+            grpc_server.stop()
         return 0
 
 

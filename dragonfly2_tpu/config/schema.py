@@ -27,11 +27,16 @@ class ServerConfig:
     host: str = "0.0.0.0"
     port: int = 8002
     advertise_ip: str = ""
+    # Binary gRPC listener alongside the JSON transport; -1 = disabled,
+    # 0 = OS-assigned ephemeral.
+    grpc_port: int = -1
 
     def validate(self) -> None:
         # 0 = OS-assigned ephemeral port (tests / sidecar deployments).
         if not (0 <= self.port < 65536):
             raise ConfigError(f"server.port {self.port} out of range")
+        if not (-1 <= self.grpc_port < 65536):
+            raise ConfigError(f"server.grpc_port {self.grpc_port} out of range")
 
 
 @dataclass
@@ -167,12 +172,20 @@ class ManagerConfig:
     token_secret: str = ""
     users_db: str = ""
     root_password: str = ""
+    # OAuth2 providers (manager/models/oauth.go rows):
+    # [{name, client_id, client_secret, auth_url, token_url, profile_url}]
+    oauth_providers: list = field(default_factory=list)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     log: LogConfig = field(default_factory=LogConfig)
 
     def validate(self) -> None:
         self.server.validate()
         self.log.validate()
+        if self.token_secret and len(self.token_secret.encode()) < 16:
+            raise ConfigError("token_secret must be >= 16 bytes")
+        for p in self.oauth_providers:
+            if not isinstance(p, dict) or "name" not in p:
+                raise ConfigError(f"oauth provider needs a name: {p!r}")
 
 
 @dataclass

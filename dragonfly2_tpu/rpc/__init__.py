@@ -15,6 +15,9 @@ data + a consistent-hashing client balancer.  Same split here, stdlib-only:
   (pkg/balancer/consistent_hashing.go).
 - ``retry``     — exponential backoff for client calls
   (pkg/rpc retry interceptors).
+- ``grpc_transport`` — binary gRPC bindings of the SAME adapters
+  (scheduler unary RPCs, trainer Train client stream); loaded lazily so
+  the JSON transports don't pay grpc's import cost.
 """
 
 from .balancer import HashRing  # noqa: F401
@@ -24,3 +27,16 @@ from .retry import retry_call  # noqa: F401
 from .scheduler_client import RemoteScheduler  # noqa: F401
 from .scheduler_server import SchedulerHTTPServer  # noqa: F401
 from .trainer_transport import RemoteTrainer, TrainerHTTPServer  # noqa: F401
+
+_GRPC_EXPORTS = {
+    "SchedulerGRPCServer", "GRPCRemoteScheduler",
+    "TrainerGRPCServer", "GRPCTrainerClient",
+}
+
+
+def __getattr__(name: str):
+    if name in _GRPC_EXPORTS:
+        from . import grpc_transport
+
+        return getattr(grpc_transport, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

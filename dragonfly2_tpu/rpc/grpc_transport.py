@@ -1,0 +1,403 @@
+"""gRPC bindings of the wire adapters (binary protobuf transport).
+
+Reference: pkg/rpc — every service speaks gRPC (scheduler server at
+pkg/rpc/scheduler/server/server.go:64-95, trainer Train client stream at
+pkg/rpc/trainer/client/client_v1.go:82-97).  The TPU build's adapters
+(SchedulerRPCAdapter, TrainerService) are transport-independent, so this
+module binds the SAME adapters the HTTP/JSON servers use onto grpc:
+
+- messages: protos/dragonfly.proto, protoc-generated (no grpc codegen
+  plugin in the image → method handlers and stubs are registered through
+  grpc's generic-handler API, which is wire-identical);
+- proto ↔ adapter-dict conversion via protobuf json_format with
+  preserving_proto_field_name (the JSON mapping of the proto IS the
+  HTTP wire schema), plus an int64 fix-up (proto3 JSON renders int64 as
+  strings);
+- GRPCRemoteScheduler reuses RemoteScheduler wholesale — only ``_call``
+  swaps transports, so retry/mirroring/error semantics stay identical;
+- Trainer.Train is a real client-streaming RPC: first chunk keys the
+  session, data chunks append shards, stream end kicks training.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Tuple
+
+import grpc
+from google.protobuf.json_format import MessageToDict, ParseDict
+
+from .protos import dragonfly_pb2 as pb
+from .scheduler_client import RemoteScheduler, RPCError
+
+SCHEDULER_SERVICE = "dragonfly2tpu.Scheduler"
+TRAINER_SERVICE = "dragonfly2tpu.Trainer"
+
+# gRPC status → wire-stable dfcode (utils/dferrors.Code), so client-side
+# recovery branches (e.g. register_peer's NOT_FOUND re-announce) behave
+# identically on both transports.
+def _grpc_to_dfcode():
+    from ..utils.dferrors import Code
+
+    return {
+        grpc.StatusCode.NOT_FOUND: int(Code.NOT_FOUND),
+        grpc.StatusCode.INVALID_ARGUMENT: int(Code.INVALID_ARGUMENT),
+        grpc.StatusCode.UNAVAILABLE: int(Code.UNAVAILABLE),
+        grpc.StatusCode.RESOURCE_EXHAUSTED: int(Code.RESOURCE_EXHAUSTED),
+        grpc.StatusCode.FAILED_PRECONDITION: int(Code.FAILED_PRECONDITION),
+    }
+
+
+_GRPC_TO_DFCODE = _grpc_to_dfcode()
+
+# method → (request message, response message); mirrors
+# SchedulerRPCAdapter.METHODS exactly.
+SCHEDULER_METHODS = {
+    "announce_host": (pb.AnnounceHostRequest, pb.Empty),
+    "register_peer": (pb.RegisterPeerRequest, pb.RegisterPeerResponse),
+    "set_task_info": (pb.SetTaskInfoRequest, pb.TaskInfoResponse),
+    "report_piece_finished": (pb.ReportPieceFinishedRequest, pb.Empty),
+    "report_piece_failed": (pb.ReportPieceFailedRequest, pb.ScheduleResponse),
+    "report_peer_finished": (pb.PeerRequest, pb.Empty),
+    "report_peer_failed": (pb.PeerRequest, pb.Empty),
+    "set_task_direct_piece": (pb.DirectPieceRequest, pb.Empty),
+    "mark_back_to_source": (pb.PeerRequest, pb.Empty),
+    "leave_peer": (pb.PeerRequest, pb.Empty),
+    "sync_probes_start": (pb.HostRequest, pb.SyncProbesStartResponse),
+    "sync_probes_finished": (pb.SyncProbesFinishedRequest, pb.Empty),
+}
+
+# proto3's JSON mapping renders int64 as decimal strings; the adapters
+# expect Python ints for these keys (at any nesting level).
+_INT64_KEYS = frozenset(
+    {"content_length", "length", "cost_ns", "rtt_ns", "seq",
+     "download_rows", "topology_rows"}
+)
+
+
+def _fix_int64(obj):
+    if isinstance(obj, dict):
+        return {
+            k: int(v) if k in _INT64_KEYS and isinstance(v, str) else _fix_int64(v)
+            for k, v in obj.items()
+        }
+    if isinstance(obj, list):
+        return [_fix_int64(v) for v in obj]
+    return obj
+
+
+def proto_to_dict(msg) -> dict:
+    # Defaults emitted: the adapters and RemoteScheduler index required
+    # keys (resp["content_length"]) — the dict must match the HTTP wire
+    # exactly, not protobuf's sparse JSON.
+    return _fix_int64(
+        MessageToDict(
+            msg,
+            preserving_proto_field_name=True,
+            always_print_fields_with_no_presence=True,
+        )
+    )
+
+
+def dict_to_proto(data: dict, msg_cls):
+    return ParseDict(data, msg_cls(), ignore_unknown_fields=True)
+
+
+def _to_wire_probe_results(req: dict) -> dict:
+    """sync_probes_finished carries (dest, rtt) pairs in the dict schema;
+    the proto uses ProbeResult messages."""
+    out = dict(req)
+    out["results"] = [
+        {"dest": d, "rtt_ns": int(r)} for d, r in req.get("results", [])
+    ]
+    return out
+
+
+def _from_wire_probe_results(req: dict) -> dict:
+    out = dict(req)
+    out["results"] = [
+        (r.get("dest", ""), int(r.get("rtt_ns", 0)))
+        for r in req.get("results", [])
+    ]
+    return out
+
+
+class SchedulerGRPCServer:
+    """Binds a SchedulerRPCAdapter onto a grpc server."""
+
+    def __init__(
+        self,
+        service,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        max_workers: int = 16,
+        server_credentials: Optional[grpc.ServerCredentials] = None,
+    ) -> None:
+        from .scheduler_server import SchedulerRPCAdapter
+
+        self.adapter = SchedulerRPCAdapter(service)
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=max_workers))
+
+        handlers = {}
+        for method, (req_cls, resp_cls) in SCHEDULER_METHODS.items():
+            handlers[method] = grpc.unary_unary_rpc_method_handler(
+                self._behavior(method, resp_cls),
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SCHEDULER_SERVICE, handlers),)
+        )
+        addr = f"{host}:{port}"
+        if server_credentials is not None:
+            bound = self._server.add_secure_port(addr, server_credentials)
+        else:
+            bound = self._server.add_insecure_port(addr)
+        self.address: Tuple[str, int] = (host, bound)
+
+    def _behavior(self, method: str, resp_cls):
+        def handle(request, context):
+            req = proto_to_dict(request)
+            if method == "sync_probes_finished":
+                req = _from_wire_probe_results(req)
+            try:
+                out = self.adapter.dispatch(method, req)
+            except KeyError as exc:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+            except (ValueError, TypeError) as exc:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+            return dict_to_proto(out, resp_cls)
+
+        return handle
+
+    @property
+    def target(self) -> str:
+        return f"{self.address[0]}:{self.address[1]}"
+
+    def serve(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+
+class GRPCRemoteScheduler(RemoteScheduler):
+    """RemoteScheduler over gRPC: same mirrors/retries/errors, binary
+    transport.  ``target`` is host:port."""
+
+    def __init__(
+        self,
+        target: str,
+        *,
+        timeout: float = 10.0,
+        channel_credentials: Optional[grpc.ChannelCredentials] = None,
+    ) -> None:
+        # base_url is only used by HTTP _call, which we override.
+        super().__init__(f"grpc://{target}", timeout=timeout)
+        if channel_credentials is not None:
+            self._channel = grpc.secure_channel(target, channel_credentials)
+        else:
+            self._channel = grpc.insecure_channel(target)
+        self._stubs = {}
+        for method, (req_cls, resp_cls) in SCHEDULER_METHODS.items():
+            self._stubs[method] = self._channel.unary_unary(
+                f"/{SCHEDULER_SERVICE}/{method}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=resp_cls.FromString,
+            )
+
+    def _call(self, method: str, req: dict) -> dict:
+        from .retry import retry_call
+
+        req_cls, _ = SCHEDULER_METHODS[method]
+        if method == "sync_probes_finished":
+            req = _to_wire_probe_results(req)
+        msg = dict_to_proto(req, req_cls)
+
+        def once():
+            try:
+                return self._stubs[method](msg, timeout=self.timeout)
+            except grpc.RpcError as exc:
+                code = exc.code()
+                if code in (
+                    grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                ):
+                    # Transient: same retry class as the HTTP transport's
+                    # ConnectionError/TimeoutError set.
+                    raise ConnectionError(
+                        f"{method}: gRPC {code.name}: {exc.details()}"
+                    ) from exc
+                raise RPCError(
+                    f"{method}: gRPC {code.name}: {exc.details()}",
+                    code=_GRPC_TO_DFCODE.get(code, 0),
+                ) from exc
+
+        resp = retry_call(once, retry_on=(ConnectionError, TimeoutError, OSError))
+        return proto_to_dict(resp)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class TrainerGRPCServer:
+    """Trainer.Train client-streaming ingest + run-status lookups."""
+
+    def __init__(
+        self,
+        service,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        max_workers: int = 8,
+    ) -> None:
+        if service.data_dir is None:
+            raise ValueError("remote ingest requires TrainerService(data_dir=...)")
+        self.service = service
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=max_workers))
+        handlers = {
+            "Train": grpc.stream_unary_rpc_method_handler(
+                self._train,
+                request_deserializer=pb.TrainChunk.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
+            "RunStatus": grpc.unary_unary_rpc_method_handler(
+                self._run_status,
+                request_deserializer=pb.RunStatusRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(TRAINER_SERVICE, handlers),)
+        )
+        bound = self._server.add_insecure_port(f"{host}:{port}")
+        self.address: Tuple[str, int] = (host, bound)
+
+    def _train(self, request_iterator, context):
+        session = None
+        for chunk in request_iterator:
+            if session is None:
+                # First message keys the per-host dataset files
+                # (service_v1.go:85-88 HostIDV2 keying).
+                session = self.service.open_train_stream(
+                    ip=chunk.ip, hostname=chunk.hostname,
+                    scheduler_id=chunk.scheduler_id,
+                )
+                if not chunk.data:
+                    continue
+            self.service.receive_shard_bytes(
+                session, chunk.kind or "download", chunk.name or "shard",
+                bytes(chunk.data), seq=int(chunk.seq),
+            )
+        if session is None:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty Train stream")
+        # EOF → train (service_v1.go:153-158; async like the goroutine).
+        key = session.close_and_train(synchronous=False)
+        return pb.TrainReply(run=key)
+
+    def _run_status(self, request, context):
+        run = self.service.runs.get(request.key)
+        if run is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"unknown run {request.key}")
+        return pb.RunStatusReply(
+            key=run.key,
+            done=run.done.is_set(),
+            error=run.error or "",
+            download_rows=run.download_rows,
+            topology_rows=run.topology_rows,
+            models=list(run.models),
+            metrics_json=json.dumps(
+                {k: m.to_dict() for k, m in run.metrics.items()}
+            ),
+        )
+
+    @property
+    def target(self) -> str:
+        return f"{self.address[0]}:{self.address[1]}"
+
+    def serve(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+
+class GRPCTrainerClient:
+    """Scheduler-side Train stream (announcer.go's uploader over gRPC)."""
+
+    CHUNK_BYTES = 128 << 20  # announcer.go:39-41
+
+    def __init__(self, target: str, *, timeout: float = 600.0) -> None:
+        self._channel = grpc.insecure_channel(target)
+        self.timeout = timeout
+        self._train = self._channel.stream_unary(
+            f"/{TRAINER_SERVICE}/Train",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.TrainReply.FromString,
+        )
+        self._status = self._channel.unary_unary(
+            f"/{TRAINER_SERVICE}/RunStatus",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.RunStatusReply.FromString,
+        )
+
+    def train(
+        self,
+        *,
+        ip: str,
+        hostname: str,
+        scheduler_id: str,
+        download_shards=(),
+        topology_shards=(),
+    ) -> str:
+        """Stream both dataset files in 128 MiB chunks over ONE stream
+        (announcer.go:144-171), returning the run key."""
+
+        def chunks():
+            yield pb.TrainChunk(ip=ip, hostname=hostname, scheduler_id=scheduler_id)
+            for kind, paths in (
+                ("download", download_shards),
+                ("networktopology", topology_shards),
+            ):
+                for path in paths:
+                    name = path.rsplit("/", 1)[-1]
+                    seq = 0
+                    with open(path, "rb") as f:
+                        while True:
+                            data = f.read(self.CHUNK_BYTES)
+                            if not data:
+                                break
+                            yield pb.TrainChunk(
+                                kind=kind, name=name, seq=seq, data=data
+                            )
+                            seq += 1
+
+        try:
+            reply = self._train(chunks(), timeout=self.timeout)
+        except grpc.RpcError as exc:
+            raise RPCError(
+                f"Train: gRPC {exc.code().name}: {exc.details()}"
+            ) from exc
+        return reply.run
+
+    def run_status(self, key: str) -> dict:
+        try:
+            r = self._status(pb.RunStatusRequest(key=key), timeout=30.0)
+        except grpc.RpcError as exc:
+            raise RPCError(
+                f"RunStatus: gRPC {exc.code().name}: {exc.details()}"
+            ) from exc
+        return {
+            "key": r.key,
+            "done": r.done,
+            "error": r.error,
+            "download_rows": r.download_rows,
+            "topology_rows": r.topology_rows,
+            "models": list(r.models),
+            "metrics": json.loads(r.metrics_json or "{}"),
+        }
+
+    def close(self) -> None:
+        self._channel.close()

@@ -57,15 +57,27 @@ class RangedHTTPClient:
 
 def _ranged_body(resp, start: int, length: int) -> bytes:
     """Range responses are optional for some origins (e.g. OCI blob
-    endpoints): a 200 carries the WHOLE object, so slice it down rather
-    than storing the full blob as one corrupt piece."""
-    body = resp.read()
+    endpoints): a 200 carries the WHOLE object from byte 0 (a
+    range-honoring origin answers 206), so extract the piece rather than
+    storing the full blob as one corrupt piece.  The prefix is read in
+    chunks and discarded — never the whole object buffered — and the
+    tail past the piece is simply not read (the connection closes)."""
     status = getattr(resp, "status", None) or getattr(resp, "code", 206)
-    if status == 200:
-        # 200 = the whole object from byte 0 (a range-honoring origin
-        # answers 206), so the piece is a slice of it.
-        return body[start : start + length]
-    return body
+    if status != 200:
+        return resp.read()
+    remaining = start
+    while remaining > 0:
+        skipped = resp.read(min(remaining, 1 << 20))
+        if not skipped:
+            return b""  # object shorter than `start`
+        remaining -= len(skipped)
+    out = b""
+    while len(out) < length:
+        chunk = resp.read(length - len(out))
+        if not chunk:
+            break
+        out += chunk
+    return out
 
 
 class FileSourceClient:

@@ -1,0 +1,272 @@
+"""gRPC transport: the same adapters as the HTTP/JSON wire, over binary
+protobuf — scheduler unary RPCs driving a real P2P swarm, trainer Train
+client-streaming ingest, error-code mapping."""
+
+import glob
+import os
+
+import pytest
+
+from dragonfly2_tpu.daemon import DaemonStorage, UploadManager
+from dragonfly2_tpu.daemon.conductor import Conductor
+from dragonfly2_tpu.records.storage import Storage
+from dragonfly2_tpu.rpc import HTTPPieceFetcher, PieceHTTPServer
+from dragonfly2_tpu.rpc.grpc_transport import (
+    GRPCRemoteScheduler,
+    GRPCTrainerClient,
+    SchedulerGRPCServer,
+    TrainerGRPCServer,
+)
+from dragonfly2_tpu.rpc.scheduler_client import RPCError
+from dragonfly2_tpu.scheduler import (
+    Evaluator,
+    NetworkTopology,
+    Resource,
+    SchedulerService,
+    Scheduling,
+    SchedulingConfig,
+)
+from dragonfly2_tpu.scheduler.resource import Host
+
+PIECE = 32 * 1024
+
+
+class WireOrigin:
+    def __init__(self):
+        self.fetches = 0
+
+    def content(self, url, number):
+        seed = (hash(url) ^ number) & 0xFF
+        return bytes((seed + i) % 256 for i in range(PIECE))
+
+    def fetch(self, url, number, piece_size):
+        self.fetches += 1
+        return self.content(url, number)
+
+
+class GRPCNode:
+    def __init__(self, i, target, tmp_path, origin):
+        self.storage = DaemonStorage(str(tmp_path / f"gnode{i}"), prefer_native=False)
+        self.upload = UploadManager(self.storage)
+        self.piece_server = PieceHTTPServer(self.upload)
+        self.piece_server.serve()
+        self.host = Host(
+            id=f"gnode-{i}",
+            hostname=f"gnode-{i}",
+            ip="127.0.0.1",
+            download_port=self.piece_server.port,
+        )
+        self.host.stats.network.idc = "idc-a"
+        self.client = GRPCRemoteScheduler(target)
+        self.conductor = Conductor(
+            self.host,
+            self.storage,
+            self.client,
+            piece_fetcher=HTTPPieceFetcher(self.client.resolve_host),
+            source_fetcher=origin,
+        )
+
+    def stop(self):
+        self.piece_server.stop()
+        self.client.close()
+
+
+@pytest.fixture()
+def grpc_swarm(tmp_path):
+    resource = Resource()
+    service = SchedulerService(
+        resource,
+        Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+        Storage(str(tmp_path / "records"), buffer_size=1),
+        NetworkTopology(resource.host_manager),
+    )
+    server = SchedulerGRPCServer(service)
+    server.serve()
+    origin = WireOrigin()
+    nodes = [GRPCNode(i, server.target, tmp_path, origin) for i in range(3)]
+    yield {"server": server, "service": service, "nodes": nodes, "origin": origin}
+    for n in nodes:
+        n.stop()
+    server.stop()
+
+
+class TestSchedulerGRPC:
+    def test_p2p_over_grpc(self, grpc_swarm):
+        """Whole control plane over binary protobuf: seed back-to-source,
+        second node gets the first as parent, records written."""
+        nodes, origin = grpc_swarm["nodes"], grpc_swarm["origin"]
+        url = "https://origin/grpc-blob"
+        r0 = nodes[0].conductor.download(
+            url, piece_size=PIECE, content_length=4 * PIECE
+        )
+        assert r0.ok and r0.back_to_source and r0.pieces == 4
+        fetches = origin.fetches
+
+        r1 = nodes[1].conductor.download(url, piece_size=PIECE)
+        assert r1.ok and not r1.back_to_source
+        assert origin.fetches == fetches
+        assert nodes[0].upload.upload_count == 4
+        for n in range(4):
+            assert nodes[1].storage.read_piece(r1.task_id, n) == \
+                origin.content(url, n)
+
+        service = grpc_swarm["service"]
+        service.storage.flush()
+        downloads = service.storage.list_download()
+        assert len(downloads) == 2
+        assert [d for d in downloads if d.parents]
+
+    def test_tiny_direct_piece_inline(self, grpc_swarm):
+        """TINY tasks ride back inside RegisterPeerResponse.direct_piece."""
+        nodes = grpc_swarm["nodes"]
+        url = "https://origin/grpc-tiny"
+
+        class TinyOrigin:
+            def content_length(self, u):
+                return 64
+
+            def fetch(self, u, n, ps):
+                return bytes(range(64))
+
+        nodes[0].conductor.source_fetcher = TinyOrigin()
+        r0 = nodes[0].conductor.download(url, piece_size=PIECE, content_length=64)
+        assert r0.ok
+        r1 = nodes[1].conductor.download(url, piece_size=PIECE)
+        assert r1.ok and r1.pieces == 1
+        assert nodes[1].storage.read_piece(r1.task_id, 0)[:64] == bytes(range(64))
+
+    def test_probe_roundtrip_over_grpc(self, grpc_swarm):
+        nodes = grpc_swarm["nodes"]
+        for n in nodes:
+            n.client.announce_host(n.host)
+        targets = nodes[0].client.sync_probes_start(nodes[0].host)
+        assert targets  # other announced hosts offered for probing
+        results = [(t.id, 5_000_000) for t in targets]
+        nodes[0].client.sync_probes_finished(nodes[0].host, results)
+        topo = grpc_swarm["service"].networktopology
+        edges = topo.neighbours(nodes[0].host.id)
+        assert edges
+
+    def test_scheduler_restart_recovery(self, tmp_path):
+        """NOT_FOUND carries the typed dfcode over gRPC, so the client's
+        re-announce-and-retry branch works after a scheduler restart."""
+        def make_server(port=0):
+            resource = Resource()
+            service = SchedulerService(
+                resource,
+                Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+                None,
+                NetworkTopology(resource.host_manager),
+            )
+            srv = SchedulerGRPCServer(service, port=port)
+            srv.serve()
+            return srv
+
+        srv = make_server()
+        port = srv.address[1]
+        client = GRPCRemoteScheduler(srv.target)
+        host = Host(id="r-host", hostname="r", ip="127.0.0.1", download_port=1)
+        client.announce_host(host)
+        # Restart on the SAME port with empty state: the announce is gone.
+        srv.stop()
+        srv2 = make_server(port=port)
+        try:
+            reg = client.register_peer(host=host, url="https://o/restart-blob")
+            assert reg.peer.id  # recovered via re-announce, not an error
+        finally:
+            srv2.stop()
+            client.close()
+
+    def test_unknown_peer_maps_to_rpc_error(self, grpc_swarm):
+        node = grpc_swarm["nodes"][0]
+        import dragonfly2_tpu.rpc.grpc_transport as gt
+
+        with pytest.raises(RPCError) as exc:
+            node.client._call("report_peer_finished", {"peer_id": "ghost"})
+        assert "NOT_FOUND" in str(exc.value)
+        # And the proto round-trip preserves int64 semantics.
+        d = gt.proto_to_dict(
+            gt.dict_to_proto(
+                {"peer_id": "p", "content_length": 5 << 40},
+                gt.pb.SetTaskInfoRequest,
+            )
+        )
+        assert d["content_length"] == 5 << 40 and isinstance(
+            d["content_length"], int
+        )
+
+
+class TestTrainerGRPC:
+    def test_train_stream_end_to_end(self, tmp_path, cluster):
+        """Announcer-shaped upload over a real gRPC client stream: train
+        server-side, model lands in the registry, run status readable."""
+        from dragonfly2_tpu.manager import ModelRegistry
+        from dragonfly2_tpu.records.columnar import ColumnarWriter
+        from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
+        from dragonfly2_tpu.trainer.service import MLP_MODEL_NAME, TrainerService
+        from dragonfly2_tpu.trainer.train import TrainConfig
+
+        registry = ModelRegistry()
+        service = TrainerService(
+            registry,
+            data_dir=str(tmp_path / "staged"),
+            train_config=TrainConfig(epochs=3, warmup_steps=5),
+        )
+        server = TrainerGRPCServer(service)
+        server.serve()
+        try:
+            shard = tmp_path / "download.dfc"
+            with ColumnarWriter(str(shard), DOWNLOAD_COLUMNS) as w:
+                w.append(cluster.generate_feature_rows(1500, seed=3))
+            client = GRPCTrainerClient(server.target)
+            key = client.train(
+                ip="10.0.0.9", hostname="sched-9", scheduler_id="sched-9",
+                download_shards=[str(shard)],
+            )
+            # Async training (the goroutine analog): poll run status.
+            import time
+
+            for _ in range(600):
+                status = client.run_status(key)
+                if status["done"]:
+                    break
+                time.sleep(0.1)
+            assert status["done"] and not status["error"], status
+            assert status["download_rows"] == 1500
+            assert status["models"]
+            assert registry.list(scheduler_id="sched-9", name=MLP_MODEL_NAME)
+            client.close()
+        finally:
+            server.stop()
+
+    def test_chunked_stream_reassembles(self, tmp_path, cluster):
+        from dragonfly2_tpu.records.columnar import ColumnarWriter
+        from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
+        from dragonfly2_tpu.trainer.service import TrainerService
+
+        service = TrainerService(data_dir=str(tmp_path / "staged"))
+        server = TrainerGRPCServer(service)
+        server.serve()
+        try:
+            shard = tmp_path / "big.dfc"
+            with ColumnarWriter(str(shard), DOWNLOAD_COLUMNS) as w:
+                w.append(cluster.generate_feature_rows(4000, seed=4))
+            client = GRPCTrainerClient(server.target)
+            client.CHUNK_BYTES = 64 * 1024  # force many chunks
+            try:
+                client.train(
+                    ip="1.2.3.4", hostname="s", scheduler_id="s",
+                    download_shards=[str(shard)],
+                )
+            except RPCError:
+                pass  # no registry configured: training may no-op/fail;
+                # the assertion below is about BYTES, not training.
+            staged = glob.glob(
+                str(tmp_path / "staged" / "*" / "download_big.dfc")
+            )[0]
+            assert os.path.getsize(staged) == os.path.getsize(shard)
+            with open(staged, "rb") as a, open(shard, "rb") as b:
+                assert a.read() == b.read()
+            client.close()
+        finally:
+            server.stop()

@@ -395,6 +395,32 @@ class _NoRangeHandler(BaseHTTPRequestHandler):
 
 
 class TestRangeFallback:
+    def test_200_body_streamed_not_buffered(self):
+        """The piece extraction reads prefix+piece only — the tail of the
+        object is never pulled off the wire."""
+        from dragonfly2_tpu.source.client import _ranged_body
+
+        class FakeResp:
+            status = 200
+
+            def __init__(self, n):
+                self.remaining = n
+                self.reads = 0
+                self.total_read = 0
+
+            def read(self, n=None):
+                self.reads += 1
+                take = self.remaining if n is None else min(n, self.remaining)
+                self.remaining -= take
+                self.total_read += take
+                return b"x" * take
+
+        resp = FakeResp(1 << 30)  # "1 GiB object"
+        piece = _ranged_body(resp, 100 << 20, 4 << 20)
+        assert len(piece) == 4 << 20
+        # Only prefix + piece consumed, not the remaining ~920 MiB.
+        assert resp.total_read == (100 << 20) + (4 << 20)
+
     def test_200_full_body_sliced_to_piece(self):
         srv = _serve(_NoRangeHandler)
         try:

@@ -246,6 +246,25 @@ class TestUserREST:
                      {"name": "henry", "password": "newpassword1"})["token"]
 
 
+class TestManagerAuthConfig:
+    def test_short_token_secret_is_config_error(self):
+        from dragonfly2_tpu.config import ConfigError
+        from dragonfly2_tpu.config.schema import ManagerConfig
+
+        cfg = ManagerConfig(token_secret="abc")
+        with pytest.raises(ConfigError):
+            cfg.validate()
+        ManagerConfig(token_secret="long-enough-secret-123").validate()
+
+    def test_oauth_provider_needs_name(self):
+        from dragonfly2_tpu.config import ConfigError
+        from dragonfly2_tpu.config.schema import ManagerConfig
+
+        cfg = ManagerConfig(oauth_providers=[{"client_id": "x"}])
+        with pytest.raises(ConfigError):
+            cfg.validate()
+
+
 class _FakeOAuthTransport:
     """Answers the provider's token + profile endpoints in-process."""
 
