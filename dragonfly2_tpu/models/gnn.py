@@ -28,7 +28,7 @@ bf16 compute, f32 params and softmax/loss reductions.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import NamedTuple, Optional
+from typing import Callable, NamedTuple, Optional
 
 import flax.linen as nn
 import jax
@@ -120,6 +120,11 @@ class GNNConfig:
     node_embed_dim: int = 32
     dropout: float = 0.1
     dtype: jnp.dtype = jnp.bfloat16
+    # Optional neighbor-gather override (ops.pallas_segment.
+    # make_neighbor_gather): a custom-VJP gather whose backward
+    # scatter-add runs on the MXU segment kernel.  Must be built from the
+    # SAME [N, K] indices as the NeighborTable passed at call time.
+    gather_fn: Optional[Callable] = None
 
 
 class NodeEmbedding(nn.Module):
@@ -193,6 +198,7 @@ class GATLayer(nn.Module):
     width: int          # per-head width
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
+    gather_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, h: jax.Array, table: NeighborTable) -> jax.Array:
@@ -206,8 +212,19 @@ class GATLayer(nn.Module):
         # backward scatter) instead of two — the gather traffic, not the
         # extra post-gather matmul FLOPs, dominates this layer on TPU
         # (BENCHMARKS.md lever #2; measured ~25 ms per gather+grad at
-        # [100k,16,128]).
-        h_n = jnp.take(h, table.indices, axis=0)               # [N, K, D]
+        # [100k,16,128]).  gather_fn (when set) swaps the backward
+        # scatter-add for the MXU segment kernel.
+        if self.gather_fn is not None:
+            h_n = self.gather_fn(h)                            # [N, K, D]
+            if h_n.shape[:2] != table.indices.shape:
+                raise ValueError(
+                    f"gather_fn output {h_n.shape[:2]} does not match the "
+                    f"neighbor table {table.indices.shape} — rebuild it "
+                    f"with make_neighbor_gather(table.indices, ...) for "
+                    f"THIS graph snapshot"
+                )
+        else:
+            h_n = jnp.take(h, table.indices, axis=0)           # [N, K, D]
         k_n = nn.Dense(H * W, dtype=self.dtype, param_dtype=jnp.float32)(h_n).reshape(
             N, K, H, W
         )
@@ -259,7 +276,7 @@ class GATRanker(nn.Module):
         per_head = max(cfg.hidden // cfg.num_heads, 1)
         h = NodeEmbedding(cfg.node_embed_dim)(node_feats)
         for _ in range(cfg.num_layers):
-            h = GATLayer(per_head, cfg.num_heads, cfg.dtype)(h, table)
+            h = GATLayer(per_head, cfg.num_heads, cfg.dtype, cfg.gather_fn)(h, table)
             if cfg.dropout > 0:
                 h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
         emb = nn.Dense(cfg.out_dim, dtype=jnp.float32, param_dtype=jnp.float32)(h)

@@ -22,5 +22,6 @@ from .aggregate import (  # noqa: F401
 )
 from .pallas_segment import (  # noqa: F401
     bucket_edges_by_block,
+    make_neighbor_gather,
     segment_sum_pallas,
 )

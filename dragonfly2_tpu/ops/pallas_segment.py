@@ -12,12 +12,17 @@ edge run), prefetches the per-block output index + first-visit flag as
 scalars, and accumulates in VMEM across sequential grid steps that revisit
 the same output block.
 
-Status (measured on v5e-1, 1M edges × 128 feats): correctness matches the
-XLA oracle to 4e-6, but XLA's sort-based segment_sum lowering is currently
-~10× faster — the one-hot formulation spends node_block× redundant FLOPs
-per edge and the f32-HIGHEST 128×128 tiles underfeed the MXU.  XLA remains
-the default (ops/aggregate); this kernel is the scaffold for the bf16 /
-larger-tile / double-buffered variant.
+Status (measured on v5e-1, 1M edges × 128 feats → 100k segments,
+chained-slope timing; run-to-run variance on the relay setup is ~±25%):
+**~10-12.5 ms vs XLA's sort-based ~19 ms (1.6-1.9×)** at the default
+512-edge × 256-node blocks.  Precision mode is timing-neutral here (the
+op is grid/memory-bound, not MXU-bound), so ``exact=True`` f32-HIGHEST
+accumulation (~4e-6 vs oracle) is the default; ``exact=False`` runs
+native bf16 MXU passes (rel err ~2e-3) for gradient traffic.  The
+round-1 scaffold (128×128 blocks) measured ~210 ms — the grid is one
+sequential step per edge block, so narrow blocks drown in grid
+overhead; 2048-wide blocks regress again (VMEM pressure).  Full numbers
+and the gather-VJP A/B (not adopted in the GAT step) in BENCHMARKS.md.
 
 Correctness oracle: ops/aggregate.segment_sum.  CPU tests run the same
 kernel in interpreter mode.
@@ -100,6 +105,7 @@ def _segment_kernel(
     *,
     node_block: int,
     edge_block: int,
+    exact: bool,
 ):
     i = pl.program_id(0)
 
@@ -111,14 +117,26 @@ def _segment_kernel(
     w = w_ref[:].reshape(1, edge_block)                  # [1, EB]
     rows = jax.lax.broadcasted_iota(jnp.int32, (node_block, edge_block), 0)
     onehot = jnp.where(rows == dstl, w, 0.0)             # [NB, EB]
-    # HIGHEST keeps the f32 accumulate exact (the TPU default matmul
-    # precision is bf16, which injects ~1e-2 error into the segment sums).
-    out_ref[:] += jnp.dot(
-        onehot,
-        vals_ref[:].astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
+    if exact:
+        # HIGHEST keeps the f32 accumulate exact (6-pass f32 emulation on
+        # the MXU — ~8× the matmul time of the native path).
+        out_ref[:] += jnp.dot(
+            onehot,
+            vals_ref[:].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    else:
+        # Native MXU pass: bf16 multiplicands, f32 accumulate.  The
+        # one-hot matrix is exact in bf16 (0/1 weights), so the only
+        # rounding is the bf16 cast of the values — the right trade for
+        # gradient traffic (the gather VJP), which is bf16 upstream
+        # anyway.
+        out_ref[:] += jnp.dot(
+            onehot.astype(jnp.bfloat16),
+            vals_ref[:].astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
 
 
 def segment_sum_pallas(
@@ -126,8 +144,10 @@ def segment_sum_pallas(
     segment_ids: np.ndarray,
     num_segments: int,
     *,
-    node_block: int = 128,
-    edge_block: int = 128,
+    node_block: int = 256,
+    edge_block: int = 512,
+    exact: bool = True,
+    presorted: bool = False,
     interpret: bool = False,
 ) -> jax.Array:
     """Segment-sum [E, D] by dst id → [num_segments, D] on the MXU.
@@ -135,18 +155,60 @@ def segment_sum_pallas(
     ``segment_ids`` is host-side (numpy): bucketing runs once per graph
     snapshot and is reused across training steps (the graph changes far
     slower than the weights).  ``values`` may be traced.
+
+    ``edge_block`` is the throughput lever: the grid is one sequential
+    step per edge block, so 128-wide blocks drown in grid overhead
+    (~8k steps for 1M edges); 1024-wide blocks amortize it 8×.
+    ``exact=False`` switches to native bf16 MXU passes with f32
+    accumulate (~4× faster, rel err ~2e-3) — the right trade for
+    gradient traffic; the default keeps f32-exact sums.
+    ``presorted=True`` means values are ALREADY in the BUCKETED layout —
+    ``vals[perm]`` for the perm from ``bucket_edges_by_block`` with the
+    SAME block sizes, interior per-block padding included (build the
+    edge stream in this layout at dataset prep to skip the [E, D]
+    permutation gather per step).  A merely destination-sorted stream is
+    NOT this layout; the length check below rejects it.
     """
     perm, dstl, w, block_node, is_first = bucket_edges_by_block(
         segment_ids, num_segments, node_block=node_block, edge_block=edge_block
     )
-    d = values.shape[-1]
+    if presorted:
+        if values.shape[0] != len(perm):
+            raise ValueError(
+                f"presorted values must be in the bucketed layout "
+                f"(len {len(perm)}, interior pads included); got "
+                f"{values.shape[0]} rows — apply vals[perm] from "
+                f"bucket_edges_by_block with the same block sizes"
+            )
+        vals = values
+    else:
+        vals = jnp.take(values, jnp.asarray(perm), axis=0)   # [E_pad, D]
+    return _segment_sum_bucketed(
+        vals, jnp.asarray(dstl), jnp.asarray(w),
+        jnp.asarray(block_node), jnp.asarray(is_first), num_segments,
+        node_block=node_block, edge_block=edge_block, exact=exact,
+        interpret=interpret,
+    )
+
+
+def _segment_sum_bucketed(
+    vals: jax.Array,       # [E_pad, D] already in bucketed order
+    dstl: jax.Array,       # [E_pad]
+    w: jax.Array,          # [E_pad]
+    block_node: jax.Array, # [n_edge_blocks]
+    is_first: jax.Array,   # [n_edge_blocks]
+    num_segments: int,
+    *,
+    node_block: int,
+    edge_block: int,
+    exact: bool,
+    interpret: bool = False,
+) -> jax.Array:
+    """Device half: kernel launch against prebuilt buckets (reused across
+    training steps — the VJP path calls this directly)."""
+    d = vals.shape[-1]
     n_node_blocks = (num_segments + node_block - 1) // node_block
-    n_edge_blocks = len(block_node)
-
-    vals = jnp.take(values, jnp.asarray(perm), axis=0)   # [E_pad, D]
-    dstl_d = jnp.asarray(dstl).reshape(-1, 1)
-    w_d = jnp.asarray(w).reshape(-1, 1)
-
+    n_edge_blocks = block_node.shape[0]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_edge_blocks,),
@@ -158,7 +220,8 @@ def segment_sum_pallas(
         out_specs=pl.BlockSpec((node_block, d), lambda i, bn, fi: (bn[i], 0)),
     )
     kernel = functools.partial(
-        _segment_kernel, node_block=node_block, edge_block=edge_block
+        _segment_kernel, node_block=node_block, edge_block=edge_block,
+        exact=exact,
     )
     out = pl.pallas_call(
         kernel,
@@ -167,5 +230,59 @@ def segment_sum_pallas(
             (n_node_blocks * node_block, d), jnp.float32
         ),
         interpret=interpret,
-    )(jnp.asarray(block_node), jnp.asarray(is_first), vals, dstl_d, w_d)
+    )(block_node, is_first, vals, dstl.reshape(-1, 1), w.reshape(-1, 1))
     return out[:num_segments]
+
+
+def make_neighbor_gather(
+    indices: np.ndarray,
+    num_nodes: int,
+    *,
+    node_block: int = 256,
+    edge_block: int = 512,
+    interpret: bool = False,
+):
+    """→ gather(table [N, D]) → [N, K, D] whose backward scatter-add runs
+    on the MXU segment kernel instead of XLA's sort-based lowering
+    (measured 19 → 7 ms at [1.6M rows → 100k nodes], BENCHMARKS.md §2).
+
+    ``indices`` is the HOST-side neighbor table ([N, K] numpy): bucketing
+    happens once per graph snapshot, and the returned callable closes
+    over the device-resident bucket arrays.  Padded slots (index 0 with
+    mask 0) contribute garbage gradient rows exactly like jnp.take's
+    backward would — masks zero them upstream either way.
+    """
+    indices = np.asarray(indices)
+    flat_ids = indices.reshape(-1).astype(np.int64)
+    perm, dstl, w, block_node, is_first = bucket_edges_by_block(
+        flat_ids, num_nodes, node_block=node_block, edge_block=edge_block
+    )
+    idx_dev = jnp.asarray(indices, dtype=jnp.int32)
+    perm_dev = jnp.asarray(perm)
+    dstl_dev = jnp.asarray(dstl)
+    w_dev = jnp.asarray(w)
+    bn_dev = jnp.asarray(block_node)
+    first_dev = jnp.asarray(is_first)
+
+    @jax.custom_vjp
+    def gather(table: jax.Array) -> jax.Array:
+        return jnp.take(table, idx_dev, axis=0)
+
+    def fwd(table):
+        # Residuals must be jax types: an empty array carries the primal
+        # dtype for the cotangent cast.
+        return gather(table), jnp.zeros((0,), table.dtype)
+
+    def bwd(res, g):
+        dt = res.dtype
+        flat = g.reshape(-1, g.shape[-1])
+        vals = jnp.take(flat, perm_dev, axis=0)
+        grad = _segment_sum_bucketed(
+            vals, dstl_dev, w_dev, bn_dev, first_dev, num_nodes,
+            node_block=node_block, edge_block=edge_block, exact=False,
+            interpret=interpret,
+        )
+        return (grad.astype(dt),)
+
+    gather.defvjp(fwd, bwd)
+    return gather

@@ -78,8 +78,33 @@ class TestPallasSegmentSum:
         e, d, n = 700, 128, 300
         vals = rng.normal(size=(e, d)).astype(np.float32)
         ids = rng.integers(0, n, e)
+        want = np.asarray(segment_sum(jnp.asarray(vals), jnp.asarray(ids), n))
+        # Exact path: f32-HIGHEST accumulate, tight tolerance.
         got = np.asarray(
+            segment_sum_pallas(jnp.asarray(vals), ids, n, exact=True,
+                               interpret=True)
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        # Native MXU path (default): bf16 multiplicands, f32 accumulate.
+        got16 = np.asarray(
             segment_sum_pallas(jnp.asarray(vals), ids, n, interpret=True)
+        )
+        np.testing.assert_allclose(got16, want, rtol=2e-2, atol=2e-2)
+
+    def test_presorted_skips_permutation(self):
+        from dragonfly2_tpu.ops.pallas_segment import bucket_edges_by_block
+
+        rng = np.random.default_rng(5)
+        e, d, n = 500, 64, 200
+        vals = rng.normal(size=(e, d)).astype(np.float32)
+        ids = rng.integers(0, n, e)
+        perm, *_ = bucket_edges_by_block(ids, n, node_block=128, edge_block=128)
+        pre = np.zeros((len(perm), d), np.float32)
+        pre[: len(perm)] = vals[perm]
+        got = np.asarray(
+            segment_sum_pallas(jnp.asarray(pre), ids, n, presorted=True,
+                               node_block=128, edge_block=128, exact=True,
+                               interpret=True)
         )
         want = np.asarray(segment_sum(jnp.asarray(vals), jnp.asarray(ids), n))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
@@ -93,6 +118,95 @@ class TestPallasSegmentSum:
         assert got[5].sum() == 16.0
         assert got[0].sum() == 0.0
         assert got[130].sum() == 0.0
+
+    def test_neighbor_gather_vjp_matches_take(self):
+        import jax
+
+        from dragonfly2_tpu.ops.pallas_segment import make_neighbor_gather
+
+        rng = np.random.default_rng(7)
+        n, k, d = 300, 8, 64
+        idx = rng.integers(0, n, (n, k)).astype(np.int32)
+        table = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        g = make_neighbor_gather(idx, n, edge_block=128, interpret=True)
+        assert bool(jnp.array_equal(
+            g(table), jnp.take(table, jnp.asarray(idx), axis=0)
+        ))
+        gc = jax.grad(lambda t: jnp.sum(jnp.sin(g(t)) * 0.01))(table)
+        gr = jax.grad(
+            lambda t: jnp.sum(jnp.sin(jnp.take(t, jnp.asarray(idx), axis=0)) * 0.01)
+        )(table)
+        rel = float(jnp.max(jnp.abs(gc - gr)) / jnp.max(jnp.abs(gr)))
+        assert rel < 2e-2  # bf16 accumulate in the kernel backward
+
+    def test_gather_fn_through_gatranker(self):
+        """The GNNConfig(gather_fn=...) wiring end to end: same loss and
+        gradients as the default path, and a mismatched table rejected."""
+        import jax
+
+        from dragonfly2_tpu.models import (
+            GATRanker,
+            GNNConfig,
+            build_neighbor_table,
+        )
+        from dragonfly2_tpu.ops.pallas_segment import make_neighbor_gather
+
+        rng = np.random.default_rng(11)
+        n = 200
+        src = rng.integers(0, n, 800)
+        dst = rng.integers(0, n, 800)
+        table = build_neighbor_table(n, src, dst, max_neighbors=8)
+        nf = jnp.asarray(rng.normal(size=(n, 6)).astype(np.float32))
+        es = jnp.asarray(rng.integers(0, n, 32).astype(np.int32))
+        ed = jnp.asarray(rng.integers(0, n, 32).astype(np.int32))
+        y = jnp.asarray(rng.normal(size=32).astype(np.float32))
+
+        def loss_and_gradsum(cfg):
+            model = GATRanker(cfg)
+            params = model.init(
+                jax.random.PRNGKey(0), nf, table, es[:2], ed[:2]
+            )["params"]
+
+            def loss(p):
+                return jnp.mean(
+                    (model.apply({"params": p}, nf, table, es, ed) - y) ** 2
+                )
+
+            l, g = jax.value_and_grad(loss)(params)
+            return float(l), sum(
+                float(jnp.sum(jnp.abs(x))) for x in jax.tree.leaves(g)
+            )
+
+        base_cfg = GNNConfig(hidden=16, num_heads=2, node_embed_dim=4,
+                             dropout=0.0)
+        gf = make_neighbor_gather(
+            np.asarray(table.indices), n, edge_block=128, interpret=True
+        )
+        l0, g0 = loss_and_gradsum(base_cfg)
+        l1, g1 = loss_and_gradsum(
+            GNNConfig(hidden=16, num_heads=2, node_embed_dim=4,
+                      dropout=0.0, gather_fn=gf)
+        )
+        assert abs(l0 - l1) / max(abs(l0), 1e-6) < 1e-3
+        assert abs(g0 - g1) / max(g0, 1e-6) < 5e-2
+        # Wrong-snapshot gather_fn → loud error, not silent garbage.
+        small = build_neighbor_table(50, src % 50, dst % 50, max_neighbors=4)
+        bad = make_neighbor_gather(
+            np.asarray(small.indices), 50, edge_block=128, interpret=True
+        )
+        model = GATRanker(GNNConfig(hidden=16, num_heads=2, node_embed_dim=4,
+                                    dropout=0.0, gather_fn=bad))
+        with pytest.raises((ValueError, TypeError)):
+            model.init(jax.random.PRNGKey(0), nf, table, es[:2], ed[:2])
+
+    def test_presorted_rejects_unbucketed_length(self):
+        rng = np.random.default_rng(3)
+        vals = rng.normal(size=(500, 32)).astype(np.float32)
+        ids = rng.integers(0, 200, 500)
+        with pytest.raises(ValueError):
+            segment_sum_pallas(
+                jnp.asarray(vals), ids, 200, presorted=True, interpret=True
+            )
 
 
 class TestShardedAggregation:
