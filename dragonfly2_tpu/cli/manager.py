@@ -83,14 +83,32 @@ def run(argv=None) -> int:
         host=cfg.server.host, port=cfg.server.port, **auth,
     )
     rest.serve()
+    grpc_server = None
+    if cfg.server.grpc_port >= 0:
+        from ..rpc.grpc_transport import ManagerGRPCServer
+
+        grpc_server = ManagerGRPCServer(
+            parts["registry"], parts["clusters"], parts["searcher"],
+            host=cfg.server.host, port=cfg.server.grpc_port,
+            # Same RBAC as REST: the gRPC port is not a bypass.
+            token_verifier=auth.get("token_verifier"),
+        )
+        grpc_server.serve()
     # flush: under a pipe (supervisors, e2e harnesses) the ready line must
     # be visible immediately, not at buffer-fill.
-    print(f"manager: serving REST on {rest.url} (ctrl-c to stop)", flush=True)
+    print(
+        f"manager: serving REST on {rest.url}"
+        + (f" and grpc on {grpc_server.target}" if grpc_server else "")
+        + " (ctrl-c to stop)",
+        flush=True,
+    )
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         rest.stop()
+        if grpc_server is not None:
+            grpc_server.stop()
         return 0
 
 

@@ -101,7 +101,11 @@ class Conductor:
         piece_size: int = 4 << 20,
         content_length: Optional[int] = None,
         expected_pieces: Optional[int] = None,
+        source_headers: Optional[dict] = None,
     ) -> DownloadResult:
+        """``source_headers`` ride along to the origin fetcher (preheat of
+        authenticated registry blobs carries the pull token this way)."""
+        self._source_headers = source_headers
         t0 = time.monotonic()
         reg = self.scheduler.register_peer(host=self.host, url=url)
         peer = reg.peer
@@ -126,7 +130,10 @@ class Conductor:
         # First peer in the swarm learns content length from the origin and
         # reports it through the scheduler API (so remote schedulers learn).
         if task.content_length < 0:
-            if content_length is None:
+            if content_length is None or content_length < 0:
+                # -1 is the source clients' "origin won't say" sentinel:
+                # proceeding would register a 0-piece task and report a
+                # hollow success.
                 return self._fail(peer, t0, "unknown content length")
             n_pieces = (
                 expected_pieces
@@ -269,7 +276,17 @@ class Conductor:
         task = peer.task
         t_piece = time.monotonic()
         try:
-            data = self.source_fetcher.fetch(task.url, number, piece_size)
+            headers = getattr(self, "_source_headers", None)
+            if headers:
+                try:
+                    data = self.source_fetcher.fetch(
+                        task.url, number, piece_size, headers=headers
+                    )
+                except TypeError:
+                    # Fetcher predates the headers kwarg.
+                    data = self.source_fetcher.fetch(task.url, number, piece_size)
+            else:
+                data = self.source_fetcher.fetch(task.url, number, piece_size)
         except Exception:
             raise _SourceFetchError(f"source fetch piece {number}")
         cost_ns = max(int((time.monotonic() - t_piece) * 1e9), 1)

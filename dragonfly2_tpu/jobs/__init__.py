@@ -9,4 +9,6 @@ downloads through the real scheduler/daemon stack.
 """
 
 from .queue import GroupJob, JobQueue, JobState, Worker  # noqa: F401
-from .preheat import PreheatJob, preheat  # noqa: F401
+from .preheat import PreheatJob, preheat, preheat_image  # noqa: F401
+from .image import ImageResolver, parse_manifest_url  # noqa: F401
+from .sync_peers import SyncPeers, make_sync_peers_handler  # noqa: F401

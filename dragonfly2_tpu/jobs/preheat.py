@@ -42,6 +42,29 @@ def preheat(
     return PreheatJob(group=group, urls=list(urls))
 
 
+def preheat_image(
+    broker: JobQueue,
+    manifest_url: str,
+    scheduler_queues: Sequence[str],
+    resolver,
+    *,
+    piece_size: int = 4 << 20,
+) -> PreheatJob:
+    """Resolve an image's layer blobs and fan them out (the console's
+    type=image preheat: manager/job/preheat.go:90-167)."""
+    resolved = resolver.resolve_layers(manifest_url)
+    per_queue = {
+        q: {
+            "urls": list(resolved.urls),
+            "piece_size": piece_size,
+            "headers": dict(resolved.headers),
+        }
+        for q in scheduler_queues
+    }
+    group = broker.create_group_job(PREHEAT, per_queue)
+    return PreheatJob(group=group, urls=list(resolved.urls))
+
+
 def make_preheat_handler(seed_daemon, *, content_length_for=None):
     """Handler for a scheduler's worker: seed daemon downloads each URL.
 
@@ -50,11 +73,21 @@ def make_preheat_handler(seed_daemon, *, content_length_for=None):
     """
 
     def handler(args: Dict) -> Dict:
+        headers = args.get("headers") or None
         results = {}
         for url in args["urls"]:
-            cl = content_length_for(url) if content_length_for else args["piece_size"]
+            if content_length_for is not None:
+                try:
+                    cl = content_length_for(url, headers=headers)
+                except TypeError:
+                    cl = content_length_for(url)
+            else:
+                cl = args["piece_size"]
+            # The registry pull token rides to the origin fetcher —
+            # private-registry blobs need it on every GET.
             r = seed_daemon.download(
-                url, piece_size=args["piece_size"], content_length=cl
+                url, piece_size=args["piece_size"], content_length=cl,
+                source_headers=headers,
             )
             if not r.ok:
                 raise RuntimeError(f"preheat of {url} failed")

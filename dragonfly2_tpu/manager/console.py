@@ -1,0 +1,151 @@
+"""Embedded operator console (reference: manager/manager.go:61-62 embeds
+the console SPA; manager/router serves it at /).
+
+A single self-contained HTML page driving the REST API with vanilla JS:
+sign-in (token kept in localStorage), model list with activate /
+deactivate, scheduler liveness, users and personal access tokens.  No
+build step, no assets, no external fetches — the whole console is this
+string.
+"""
+
+CONSOLE_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>dragonfly2-tpu manager</title>
+<style>
+  :root { color-scheme: light dark; }
+  body { font: 14px/1.5 system-ui, sans-serif; margin: 2rem auto; max-width: 72rem; padding: 0 1rem; }
+  h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 2rem; }
+  table { border-collapse: collapse; width: 100%; margin: .5rem 0; }
+  th, td { text-align: left; padding: .3rem .6rem; border-bottom: 1px solid #8884; }
+  th { font-weight: 600; }
+  button { cursor: pointer; padding: .15rem .6rem; margin-right: .3rem; }
+  input { padding: .25rem .4rem; margin-right: .4rem; }
+  .pill { padding: .05rem .5rem; border-radius: 999px; font-size: .8rem; }
+  .active { background: #16a34a33; } .inactive { background: #8883; }
+  .err { color: #dc2626; } .ok { color: #16a34a; }
+  #signin, #app { margin-top: 1rem; }
+  .muted { opacity: .65; }
+  code { font-size: .85em; }
+</style>
+</head>
+<body>
+<h1>dragonfly2-tpu manager console</h1>
+<div id="signin">
+  <input id="u" placeholder="username"><input id="p" type="password" placeholder="password">
+  <button onclick="signin()">Sign in</button>
+  <span id="signin-msg" class="err"></span>
+</div>
+<div id="app" style="display:none">
+  <span class="muted">signed in as <b id="who"></b> (<span id="role"></span>)</span>
+  <button onclick="signout()">Sign out</button>
+
+  <h2>Models</h2>
+  <table id="models"><thead><tr>
+    <th>name</th><th>type</th><th>version</th><th>scheduler</th><th>state</th><th>evaluation</th><th></th>
+  </tr></thead><tbody></tbody></table>
+
+  <h2>Schedulers</h2>
+  <table id="schedulers"><thead><tr>
+    <th>id</th><th>cluster</th><th>address</th><th>state</th>
+  </tr></thead><tbody></tbody></table>
+
+  <h2>Users <span class="muted">(admin)</span></h2>
+  <table id="users"><thead><tr>
+    <th>name</th><th>email</th><th>role</th><th>state</th>
+  </tr></thead><tbody></tbody></table>
+
+  <h2>Personal access tokens</h2>
+  <input id="pat-name" placeholder="token name">
+  <select id="pat-role">
+    <option>readonly</option><option>peer</option><option>operator</option><option>admin</option>
+  </select>
+  <button onclick="createPat()">Create</button>
+  <div id="pat-new" class="ok"></div>
+  <table id="pats"><thead><tr>
+    <th>name</th><th>role</th><th>expires</th><th>revoked</th><th></th>
+  </tr></thead><tbody></tbody></table>
+</div>
+<script>
+const tok = () => localStorage.getItem("df_token") || "";
+async function api(path, opts) {
+  opts = opts || {};
+  opts.headers = Object.assign(
+    tok() ? {"Authorization": "Bearer " + tok()} : {},
+    opts.body ? {"Content-Type": "application/json"} : {}, opts.headers || {});
+  const r = await fetch("/api/v1" + path, opts);
+  if (!r.ok) throw new Error((await r.json()).error || r.status);
+  return r.json();
+}
+async function signin() {
+  try {
+    const out = await api("/users:signin", {method: "POST", body: JSON.stringify(
+      {name: document.getElementById("u").value, password: document.getElementById("p").value})});
+    localStorage.setItem("df_token", out.token);
+    localStorage.setItem("df_role", out.role);
+    localStorage.setItem("df_user", document.getElementById("u").value);
+    boot();
+  } catch (e) { document.getElementById("signin-msg").textContent = e.message; }
+}
+function signout() { localStorage.clear(); location.reload(); }
+function fill(id, rows) {
+  document.querySelector("#" + id + " tbody").innerHTML = rows.join("");
+}
+const esc = s => String(s).replace(/[&<>"]/g, c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+async function refresh() {
+  const models = await api("/models");
+  fill("models", models.map(m => `<tr><td>${esc(m.name)}</td><td>${esc(m.type)}</td>
+    <td>v${m.version}</td><td><code>${esc(m.scheduler_id)}</code></td>
+    <td><span class="pill ${m.state}">${m.state}</span></td>
+    <td><code>${esc(JSON.stringify(m.evaluation))}</code></td>
+    <td><button onclick="act('${m.id}','activate')">activate</button>
+        <button onclick="act('${m.id}','deactivate')">deactivate</button></td></tr>`));
+  const scheds = await api("/schedulers");
+  fill("schedulers", scheds.map(s => `<tr><td><code>${esc(s.id)}</code></td>
+    <td>${esc(s.cluster_id)}</td><td>${esc(s.ip)}:${s.port}</td><td>${esc(s.state)}</td></tr>`));
+  try {
+    const users = await api("/users");
+    fill("users", users.map(u => `<tr><td>${esc(u.name)}</td><td>${esc(u.email)}</td>
+      <td>${esc(u.role)}</td><td>${esc(u.state)}</td></tr>`));
+  } catch (e) { fill("users", [`<tr><td colspan=4 class="muted">${esc(e.message)}</td></tr>`]); }
+  try {
+    const pats = await api("/pats");
+    fill("pats", pats.map(p => `<tr><td>${esc(p.name)}</td><td>${esc(p.role)}</td>
+      <td>${new Date(p.expires_at * 1000).toISOString().slice(0,10)}</td>
+      <td>${p.revoked}</td>
+      <td><button onclick="revoke('${p.id}')">revoke</button></td></tr>`));
+  } catch (e) { fill("pats", []); }
+}
+async function act(id, action) {
+  try { await api(`/models/${id}:${action}`, {method: "POST", body: "{}"}); refresh(); }
+  catch (e) { alert(e.message); }
+}
+async function createPat() {
+  try {
+    const out = await api("/pats", {method: "POST", body: JSON.stringify(
+      {name: document.getElementById("pat-name").value,
+       role: document.getElementById("pat-role").value})});
+    document.getElementById("pat-new").textContent =
+      "token (shown once): " + out.token;
+    refresh();
+  } catch (e) { alert(e.message); }
+}
+async function revoke(id) {
+  try { await api(`/pats/${id}:revoke`, {method: "POST", body: "{}"}); refresh(); }
+  catch (e) { alert(e.message); }
+}
+function boot() {
+  if (!tok()) return;
+  document.getElementById("signin").style.display = "none";
+  document.getElementById("app").style.display = "block";
+  document.getElementById("who").textContent = localStorage.getItem("df_user") || "?";
+  document.getElementById("role").textContent = localStorage.getItem("df_role") || "?";
+  refresh();
+  setInterval(refresh, 10000);
+}
+boot();
+</script>
+</body>
+</html>
+"""

@@ -124,7 +124,17 @@ class ManagerRESTServer:
                 parsed = urllib.parse.urlsplit(self.path)
                 q = dict(urllib.parse.parse_qsl(parsed.query))
                 path = parsed.path
-                if path == "/api/v1/healthy":
+                if path in ("/", "/console", "/console/"):
+                    # Embedded console SPA (manager.go:61-62 analog).
+                    from .console import CONSOLE_HTML
+
+                    body = CONSOLE_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/api/v1/healthy":
                     self._json(200, {"ok": True})
                 elif path == "/api/v1/models":
                     models = server.registry.list(

@@ -31,6 +31,7 @@ from .trainer_transport import RemoteTrainer, TrainerHTTPServer  # noqa: F401
 _GRPC_EXPORTS = {
     "SchedulerGRPCServer", "GRPCRemoteScheduler",
     "TrainerGRPCServer", "GRPCTrainerClient",
+    "ManagerGRPCServer", "GRPCRemoteRegistry",
 }
 
 

@@ -324,6 +324,348 @@ class TrainerGRPCServer:
         self._server.stop(grace)
 
 
+MANAGER_SERVICE = "dragonfly2tpu.Manager"
+
+
+def _model_to_proto(m) -> "pb.WireModel":
+    return pb.WireModel(
+        id=m.id, name=m.name, type=m.type, version=m.version,
+        scheduler_id=m.scheduler_id, state=m.state.value,
+        evaluation_json=json.dumps(m.evaluation),
+    )
+
+
+class ManagerGRPCServer:
+    """Manager control plane over gRPC (manager/rpcserver v1/v2 analog):
+    model registry RPCs incl. CreateModel, scheduler registration +
+    keepalive, cluster search.
+
+    With a ``token_verifier``, mutations require a bearer token in call
+    metadata at the SAME role tiers as the REST surface (reads stay
+    open, matching the reference's authenticated-writes posture) — the
+    gRPC port must not be an RBAC bypass."""
+
+    def __init__(
+        self,
+        registry,
+        clusters,
+        searcher=None,
+        scheduler_clusters=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        max_workers: int = 16,
+        token_verifier=None,
+        server_credentials: Optional[grpc.ServerCredentials] = None,
+    ) -> None:
+        from ..manager.searcher import Searcher
+        from ..security.tokens import Role
+
+        self.registry = registry
+        self.clusters = clusters
+        self.searcher = searcher or Searcher()
+        self.scheduler_clusters = scheduler_clusters or []
+        self.token_verifier = token_verifier
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=max_workers))
+        methods = {
+            # name: (fn, req, resp, required role — None = open read)
+            "create_model": (self._create_model, pb.CreateModelRequest, pb.WireModel, Role.PEER),
+            "get_model": (self._get_model, pb.ModelIdRequest, pb.WireModel, None),
+            "list_models": (self._list_models, pb.ListModelsRequest, pb.ListModelsReply, None),
+            "active_model": (self._active_model, pb.ActiveModelRequest, pb.WireModel, None),
+            "activate_model": (self._activate, pb.ModelIdRequest, pb.WireModel, Role.OPERATOR),
+            "deactivate_model": (self._deactivate, pb.ModelIdRequest, pb.WireModel, Role.OPERATOR),
+            "model_artifact": (self._artifact, pb.ModelIdRequest, pb.ArtifactReply, None),
+            "register_scheduler": (self._register_scheduler, pb.RegisterSchedulerRequest, pb.Empty, Role.PEER),
+            "keepalive": (self._keepalive, pb.KeepaliveRequest, pb.KeepaliveReply, Role.PEER),
+            "list_schedulers": (self._list_schedulers, pb.Empty, pb.ListSchedulersReply, None),
+            "search_clusters": (self._search, pb.ClusterSearchRequest, pb.ClusterSearchReply, None),
+        }
+        handlers = {}
+        for name, (fn, req_cls, _resp_cls, role) in methods.items():
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                self._wrap(fn, role),
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(MANAGER_SERVICE, handlers),)
+        )
+        addr = f"{host}:{port}"
+        if server_credentials is not None:
+            bound = self._server.add_secure_port(addr, server_credentials)
+        else:
+            bound = self._server.add_insecure_port(addr)
+        self.address: Tuple[str, int] = (host, bound)
+
+    def _wrap(self, fn, required_role):
+        def handle(request, context):
+            if required_role is not None and self.token_verifier is not None:
+                token = None
+                for key, value in context.invocation_metadata():
+                    if key == "authorization" and value.startswith("Bearer "):
+                        token = value[len("Bearer "):]
+                if self.token_verifier.authorize(token, required_role) is None:
+                    context.abort(
+                        grpc.StatusCode.PERMISSION_DENIED,
+                        f"requires role >= {required_role.name}",
+                    )
+            try:
+                return fn(request, context)
+            except KeyError as exc:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+            except (ValueError, TypeError) as exc:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+
+        return handle
+
+    # -- model registry (manager_server_v1.go:802-901 + service/model.go) --
+
+    def _create_model(self, req, context):
+        m = self.registry.create_model(
+            name=req.name, type=req.type, scheduler_id=req.scheduler_id,
+            artifact=bytes(req.artifact),
+            evaluation=json.loads(req.evaluation_json or "{}"),
+        )
+        return _model_to_proto(m)
+
+    def _get_model(self, req, context):
+        m = self.registry.get(req.id)
+        if m is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"model {req.id}")
+        return _model_to_proto(m)
+
+    def _list_models(self, req, context):
+        models = self.registry.list(
+            scheduler_id=req.scheduler_id or None, name=req.name or None
+        )
+        return pb.ListModelsReply(models=[_model_to_proto(m) for m in models])
+
+    def _active_model(self, req, context):
+        m = self.registry.active_model(req.scheduler_id, req.name)
+        if m is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "no active model")
+        return _model_to_proto(m)
+
+    def _activate(self, req, context):
+        return _model_to_proto(self.registry.activate(req.id))
+
+    def _deactivate(self, req, context):
+        return _model_to_proto(self.registry.deactivate(req.id))
+
+    def _artifact(self, req, context):
+        m = self.registry.get(req.id)
+        if m is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"model {req.id}")
+        try:
+            blob = self.registry.load_artifact(m)
+        except (KeyError, OSError) as exc:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"artifact missing: {exc}")
+        return pb.ArtifactReply(artifact=blob)
+
+    # -- clusters (manager_server_v2.go keepalive, searcher) ----------------
+
+    def _register_scheduler(self, req, context):
+        from ..manager.cluster import SchedulerInstance
+
+        self.clusters.register_scheduler(
+            SchedulerInstance(
+                id=req.id, cluster_id=req.cluster_id, ip=req.ip, port=req.port
+            )
+        )
+        return pb.Empty()
+
+    def _keepalive(self, req, context):
+        return pb.KeepaliveReply(known=self.clusters.keepalive(req.instance_id))
+
+    def _list_schedulers(self, req, context):
+        return pb.ListSchedulersReply(
+            schedulers=[
+                pb.WireScheduler(
+                    id=s.id, cluster_id=s.cluster_id, ip=s.ip, port=s.port,
+                    state=s.state,
+                )
+                for s in self.clusters.active_schedulers()
+            ]
+        )
+
+    def _search(self, req, context):
+        try:
+            ranked = self.searcher.find_scheduler_clusters(
+                self.scheduler_clusters,
+                ip=req.ip, hostname=req.hostname,
+                conditions={"idc": req.idc, "location": req.location},
+            )
+        except LookupError as exc:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+        return pb.ClusterSearchReply(cluster_ids=[c.id for c in ranked])
+
+    @property
+    def target(self) -> str:
+        return f"{self.address[0]}:{self.address[1]}"
+
+    def serve(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+
+class GRPCRemoteRegistry:
+    """Drop-in for rpc.registry_client.RemoteRegistry over gRPC — the
+    trainer publishes models and the scheduler fetches scorers through
+    the same surface."""
+
+    def __init__(
+        self,
+        target: str,
+        *,
+        timeout: float = 60.0,
+        token: str = "",
+        channel_credentials: Optional[grpc.ChannelCredentials] = None,
+    ) -> None:
+        if channel_credentials is not None:
+            self._channel = grpc.secure_channel(target, channel_credentials)
+        else:
+            self._channel = grpc.insecure_channel(target)
+        self.timeout = timeout
+        self.token = token
+        self._stubs = {}
+        for name, (req_cls, resp_cls) in {
+            "create_model": (pb.CreateModelRequest, pb.WireModel),
+            "get_model": (pb.ModelIdRequest, pb.WireModel),
+            "list_models": (pb.ListModelsRequest, pb.ListModelsReply),
+            "active_model": (pb.ActiveModelRequest, pb.WireModel),
+            "activate_model": (pb.ModelIdRequest, pb.WireModel),
+            "deactivate_model": (pb.ModelIdRequest, pb.WireModel),
+            "model_artifact": (pb.ModelIdRequest, pb.ArtifactReply),
+            "register_scheduler": (pb.RegisterSchedulerRequest, pb.Empty),
+            "keepalive": (pb.KeepaliveRequest, pb.KeepaliveReply),
+            "list_schedulers": (pb.Empty, pb.ListSchedulersReply),
+            "search_clusters": (pb.ClusterSearchRequest, pb.ClusterSearchReply),
+        }.items():
+            self._stubs[name] = self._channel.unary_unary(
+                f"/{MANAGER_SERVICE}/{name}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=resp_cls.FromString,
+            )
+
+    def _call(self, name, msg, *, not_found_none: bool = False):
+        """Same exception contract as RemoteRegistry._translate — callers
+        written against the local ModelRegistry behave identically:
+        NOT_FOUND → KeyError (or None), INVALID_ARGUMENT → ValueError,
+        transient UNAVAILABLE/DEADLINE retried."""
+        from .retry import retry_call
+
+        metadata = (
+            [("authorization", f"Bearer {self.token}")] if self.token else None
+        )
+
+        def once():
+            try:
+                return self._stubs[name](
+                    msg, timeout=self.timeout, metadata=metadata
+                )
+            except grpc.RpcError as exc:
+                code = exc.code()
+                if code is grpc.StatusCode.NOT_FOUND:
+                    if not_found_none:
+                        return None
+                    raise KeyError(exc.details()) from exc
+                if code is grpc.StatusCode.INVALID_ARGUMENT:
+                    raise ValueError(exc.details()) from exc
+                if code in (
+                    grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                ):
+                    raise ConnectionError(
+                        f"{name}: gRPC {code.name}: {exc.details()}"
+                    ) from exc
+                raise RPCError(
+                    f"{name}: gRPC {code.name}: {exc.details()}",
+                    code=_GRPC_TO_DFCODE.get(code, 0),
+                ) from exc
+
+        return retry_call(once, retry_on=(ConnectionError, TimeoutError, OSError))
+
+    @staticmethod
+    def _model(m):
+        from ..manager.registry import Model, ModelState
+
+        return Model(
+            id=m.id, name=m.name, type=m.type, version=m.version,
+            scheduler_id=m.scheduler_id, state=ModelState(m.state),
+            evaluation=json.loads(m.evaluation_json or "{}"),
+        )
+
+    def create_model(self, *, name, type, scheduler_id, artifact=b"",
+                     evaluation=None):
+        reply = self._call("create_model", pb.CreateModelRequest(
+            name=name, type=type, scheduler_id=scheduler_id,
+            artifact=artifact, evaluation_json=json.dumps(evaluation or {}),
+        ))
+        return self._model(reply)
+
+    def get(self, model_id):
+        reply = self._call(
+            "get_model", pb.ModelIdRequest(id=model_id), not_found_none=True
+        )
+        return None if reply is None else self._model(reply)
+
+    def list(self, scheduler_id=None, name=None):
+        reply = self._call("list_models", pb.ListModelsRequest(
+            scheduler_id=scheduler_id or "", name=name or ""
+        ))
+        return [self._model(m) for m in reply.models]
+
+    def active_model(self, scheduler_id, name):
+        reply = self._call("active_model", pb.ActiveModelRequest(
+            scheduler_id=scheduler_id, name=name
+        ), not_found_none=True)
+        return None if reply is None else self._model(reply)
+
+    def activate(self, model_id):
+        return self._model(
+            self._call("activate_model", pb.ModelIdRequest(id=model_id))
+        )
+
+    def deactivate(self, model_id):
+        return self._model(
+            self._call("deactivate_model", pb.ModelIdRequest(id=model_id))
+        )
+
+    def load_artifact(self, model):
+        reply = self._call("model_artifact", pb.ModelIdRequest(id=model.id))
+        return bytes(reply.artifact)
+
+    def register_scheduler(self, *, id, cluster_id, ip, port):
+        self._call("register_scheduler", pb.RegisterSchedulerRequest(
+            id=id, cluster_id=cluster_id, ip=ip, port=port
+        ))
+
+    def keepalive(self, instance_id):
+        return self._call(
+            "keepalive", pb.KeepaliveRequest(instance_id=instance_id)
+        ).known
+
+    def list_schedulers(self):
+        reply = self._call("list_schedulers", pb.Empty())
+        return [
+            {"id": s.id, "cluster_id": s.cluster_id, "ip": s.ip,
+             "port": s.port, "state": s.state}
+            for s in reply.schedulers
+        ]
+
+    def search_clusters(self, *, ip="", hostname="", idc="", location=""):
+        reply = self._call("search_clusters", pb.ClusterSearchRequest(
+            ip=ip, hostname=hostname, idc=idc, location=location
+        ))
+        return list(reply.cluster_ids)
+
+    def close(self):
+        self._channel.close()
+
+
 class GRPCTrainerClient:
     """Scheduler-side Train stream (announcer.go's uploader over gRPC)."""
 

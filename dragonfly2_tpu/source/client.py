@@ -110,13 +110,17 @@ class FileSourceClient:
 
 
 class HTTPSourceClient:
-    """http(s):// via urllib range GETs (clients/httpprotocol)."""
+    """http(s):// via urllib range GETs (clients/httpprotocol).
+
+    ``headers`` (per call) carry request auth — preheat of private
+    registry blobs rides the pull token through here.
+    """
 
     def __init__(self, timeout: float = 30.0):
         self.timeout = timeout
 
-    def content_length(self, url: str) -> int:
-        req = urllib.request.Request(url, method="HEAD")
+    def content_length(self, url: str, headers: Optional[dict] = None) -> int:
+        req = urllib.request.Request(url, headers=headers or {}, method="HEAD")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 cl = resp.headers.get("Content-Length")
@@ -124,12 +128,17 @@ class HTTPSourceClient:
         except Exception:
             return -1
 
-    def read_range(self, url: str, start: int, length: int) -> bytes:
-        req = urllib.request.Request(
-            url, headers={"Range": f"bytes={start}-{start + length - 1}"}
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return resp.read()
+    def read_range(
+        self, url: str, start: int, length: int,
+        headers: Optional[dict] = None,
+    ) -> bytes:
+        all_headers = {"Range": f"bytes={start}-{start + length - 1}"}
+        all_headers.update(headers or {})
+        with urllib.request.urlopen(
+            urllib.request.Request(url, headers=all_headers),
+            timeout=self.timeout,
+        ) as resp:
+            return _ranged_body(resp, start, length)
 
 
 class SourceRegistry:
@@ -165,9 +174,25 @@ class PieceSourceFetcher:
     def __init__(self, registry: Optional[SourceRegistry] = None):
         self.registry = registry or default_registry
 
-    def content_length(self, url: str) -> int:
-        return self.registry.client_for(url).content_length(url)
-
-    def fetch(self, url: str, number: int, piece_size: int) -> bytes:
+    def content_length(self, url: str, headers: Optional[dict] = None) -> int:
         client = self.registry.client_for(url)
+        if headers:
+            try:
+                return client.content_length(url, headers=headers)
+            except TypeError:
+                pass
+        return client.content_length(url)
+
+    def fetch(
+        self, url: str, number: int, piece_size: int,
+        headers: Optional[dict] = None,
+    ) -> bytes:
+        client = self.registry.client_for(url)
+        if headers:
+            try:
+                return client.read_range(
+                    url, number * piece_size, piece_size, headers=headers
+                )
+            except TypeError:
+                pass
         return client.read_range(url, number * piece_size, piece_size)

@@ -196,6 +196,112 @@ class TestSchedulerGRPC:
         )
 
 
+class TestManagerGRPC:
+    def test_model_lifecycle_over_grpc(self):
+        from dragonfly2_tpu.manager import ClusterManager, ModelRegistry
+        from dragonfly2_tpu.rpc.grpc_transport import (
+            GRPCRemoteRegistry,
+            ManagerGRPCServer,
+        )
+
+        registry = ModelRegistry()
+        clusters = ClusterManager()
+        server = ManagerGRPCServer(registry, clusters)
+        server.serve()
+        try:
+            client = GRPCRemoteRegistry(server.target)
+            m = client.create_model(
+                name="gnn", type="gnn", scheduler_id="s1",
+                artifact=b"npz-bytes", evaluation={"mae": 0.5},
+            )
+            assert m.version == 1 and m.state.value == "inactive"
+            m2 = client.create_model(
+                name="gnn", type="gnn", scheduler_id="s1", artifact=b"v2"
+            )
+            assert m2.version == 2
+            # Single-active activation flips transactionally.
+            client.activate(m.id)
+            active = client.active_model("s1", "gnn")
+            assert active.id == m.id
+            client.activate(m2.id)
+            assert client.active_model("s1", "gnn").id == m2.id
+            assert client.get(m.id).state.value == "inactive"
+            assert client.load_artifact(m2) == b"v2"
+            assert len(client.list(scheduler_id="s1")) == 2
+            assert client.get("ghost") is None
+            assert client.active_model("s1", "nope") is None
+            client.close()
+        finally:
+            server.stop()
+
+    def test_rbac_enforced_on_grpc_port(self):
+        from dragonfly2_tpu.manager import ClusterManager, ModelRegistry
+        from dragonfly2_tpu.rpc.grpc_transport import (
+            GRPCRemoteRegistry,
+            ManagerGRPCServer,
+        )
+        from dragonfly2_tpu.security.tokens import Role, TokenIssuer, TokenVerifier
+
+        secret = b"grpc-rbac-secret-0123456789"
+        issuer = TokenIssuer(secret)
+        server = ManagerGRPCServer(
+            ModelRegistry(), ClusterManager(),
+            token_verifier=TokenVerifier(secret),
+        )
+        server.serve()
+        try:
+            anon = GRPCRemoteRegistry(server.target)
+            with pytest.raises(RPCError) as exc:
+                anon.create_model(name="m", type="mlp", scheduler_id="s")
+            assert "PERMISSION_DENIED" in str(exc.value)
+            assert anon.list() == []  # reads stay open
+            peer = GRPCRemoteRegistry(
+                server.target, token=issuer.issue("trainer", Role.PEER)
+            )
+            m = peer.create_model(name="m", type="mlp", scheduler_id="s")
+            with pytest.raises(RPCError):  # PEER cannot activate
+                peer.activate(m.id)
+            ops = GRPCRemoteRegistry(
+                server.target, token=issuer.issue("ops", Role.OPERATOR)
+            )
+            assert ops.activate(m.id).state.value == "active"
+            # Typed errors match the local registry contract.
+            with pytest.raises(KeyError):
+                ops.activate("ghost")
+            with pytest.raises(ValueError):
+                peer.create_model(name="x", type="xgb", scheduler_id="s")
+            for c in (anon, peer, ops):
+                c.close()
+        finally:
+            server.stop()
+
+    def test_keepalive_and_scheduler_listing(self):
+        from dragonfly2_tpu.manager import ClusterManager, ModelRegistry
+        from dragonfly2_tpu.rpc.grpc_transport import (
+            GRPCRemoteRegistry,
+            ManagerGRPCServer,
+        )
+
+        clusters = ClusterManager(keepalive_ttl=0.3)
+        server = ManagerGRPCServer(ModelRegistry(), clusters)
+        server.serve()
+        try:
+            client = GRPCRemoteRegistry(server.target)
+            client.register_scheduler(
+                id="sched-g", cluster_id="c1", ip="10.0.0.1", port=8002
+            )
+            assert [s["id"] for s in client.list_schedulers()] == ["sched-g"]
+            assert client.keepalive("sched-g") is True
+            assert client.keepalive("ghost") is False
+            import time
+
+            time.sleep(0.4)  # TTL expiry without keepalive
+            assert client.list_schedulers() == []
+            client.close()
+        finally:
+            server.stop()
+
+
 class TestTrainerGRPC:
     def test_train_stream_end_to_end(self, tmp_path, cluster):
         """Announcer-shaped upload over a real gRPC client stream: train

@@ -1,0 +1,279 @@
+"""sync_peers job and image-manifest preheat resolution."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dragonfly2_tpu.jobs import (
+    ImageResolver,
+    JobQueue,
+    SyncPeers,
+    Worker,
+    make_sync_peers_handler,
+    parse_manifest_url,
+    preheat_image,
+)
+from dragonfly2_tpu.jobs.preheat import PREHEAT
+from dragonfly2_tpu.manager import ClusterManager, SchedulerInstance
+from dragonfly2_tpu.scheduler import Resource
+from dragonfly2_tpu.scheduler.resource import Host
+
+
+def make_host(i):
+    return Host(
+        id=f"sp-host-{i}", hostname=f"sp-{i}", ip=f"10.9.0.{i}",
+        port=8002, download_port=8001,
+    )
+
+
+class TestSyncPeers:
+    def test_merge_and_inactive_marking(self):
+        resource = Resource()
+        for i in range(3):
+            resource.store_host(make_host(i))
+        broker = JobQueue()
+        clusters = ClusterManager()
+        sched = clusters.register_scheduler(
+            SchedulerInstance(id="sched-A", cluster_id="c1", ip="1.1.1.1", port=1)
+        )
+        worker = Worker(broker, f"scheduler:{sched.id}")
+        worker.register("sync_peers", make_sync_peers_handler(resource))
+        worker.serve()
+        try:
+            sp = SyncPeers(broker, clusters, job_timeout_s=10.0)
+            assert sp.run_once() == 1
+            peers = sp.list_peers("sched-A", active_only=True)
+            assert {p.id for p in peers} == {f"sp-host-{i}" for i in range(3)}
+            # Host 1 vanishes from the scheduler → flips inactive.
+            resource.host_manager.delete("sp-host-1")
+            sp.run_once()
+            active = {p.id for p in sp.list_peers("sched-A", active_only=True)}
+            assert active == {"sp-host-0", "sp-host-2"}
+            all_recs = {p.id: p.active for p in sp.list_peers("sched-A")}
+            assert all_recs["sp-host-1"] is False
+        finally:
+            worker.stop()
+
+    def test_unanswered_scheduler_skipped(self):
+        broker = JobQueue()
+        clusters = ClusterManager()
+        clusters.register_scheduler(
+            SchedulerInstance(id="dead", cluster_id="c1", ip="1.1.1.1", port=1)
+        )
+        sp = SyncPeers(broker, clusters, job_timeout_s=0.1)
+        assert sp.run_once() == 0  # no worker: timeout, no crash
+
+
+LAYERS = ["sha256:l1", "sha256:l2", "sha256:l3"]
+TOKEN = "reg-token-1"
+
+
+class _RegistryHandler(BaseHTTPRequestHandler):
+    """Minimal distribution registry: token flow + manifest list + blobs."""
+
+    require_auth = True
+    blobs = {}  # digest → bytes (authenticated range-GET endpoint)
+
+    def _json(self, code, payload, ctype="application/json", extra=None):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        host = f"127.0.0.1:{self.server.server_address[1]}"
+        if self.path.startswith("/token"):
+            self._json(200, {"token": TOKEN})
+            return
+        if self.require_auth and self.headers.get("Authorization") != f"Bearer {TOKEN}":
+            self._json(
+                401, {"errors": [{"code": "UNAUTHORIZED"}]},
+                extra={
+                    "WWW-Authenticate":
+                    f'Bearer realm="http://{host}/token",service="reg"'
+                },
+            )
+            return
+        if self.path == "/v2/proj/app/manifests/v1":
+            # Manifest LIST with two platforms.
+            self._json(
+                200,
+                {
+                    "manifests": [
+                        {"digest": "sha256:amd", "platform":
+                         {"os": "linux", "architecture": "amd64"}},
+                        {"digest": "sha256:arm", "platform":
+                         {"os": "linux", "architecture": "arm64"}},
+                    ]
+                },
+                ctype="application/vnd.oci.image.index.v1+json",
+            )
+        elif self.path == "/v2/proj/app/manifests/sha256:amd":
+            self._json(
+                200,
+                {"layers": [{"digest": d} for d in LAYERS[:2]]},
+                ctype="application/vnd.oci.image.manifest.v1+json",
+            )
+        elif self.path == "/v2/proj/app/manifests/sha256:arm":
+            self._json(
+                200,
+                {"layers": [{"digest": LAYERS[2]}]},
+                ctype="application/vnd.oci.image.manifest.v1+json",
+            )
+        elif self.path.startswith("/v2/proj/app/blobs/"):
+            digest = self.path.rsplit("/", 1)[1]
+            blob = self.blobs.get(digest)
+            if blob is None:
+                self._json(404, {})
+                return
+            rng = self.headers.get("Range")
+            body = blob
+            code = 200
+            if rng:
+                spec = rng.split("=", 1)[1]
+                s, e = spec.split("-")
+                body = blob[int(s): int(e) + 1]
+                code = 206
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._json(404, {})
+
+    def do_HEAD(self):
+        if self.require_auth and self.headers.get("Authorization") != f"Bearer {TOKEN}":
+            self.send_error(401)
+            return
+        digest = self.path.rsplit("/", 1)[1]
+        blob = self.blobs.get(digest)
+        if blob is None or "/blobs/" not in self.path:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def registry():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _RegistryHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+class TestImageResolver:
+    def test_parse_manifest_url(self):
+        base, repo, ref = parse_manifest_url(
+            "https://reg.example/v2/lib/nginx/manifests/1.25"
+        )
+        assert base == "https://reg.example"
+        assert repo == "lib/nginx" and ref == "1.25"
+        with pytest.raises(ValueError):
+            parse_manifest_url("https://reg.example/lib/nginx:1.25")
+
+    def test_token_flow_and_platform_filter(self, registry):
+        r = ImageResolver(username="u", password="p", platform="linux/amd64")
+        resolved = r.resolve_layers(f"{registry}/v2/proj/app/manifests/v1")
+        assert resolved.urls == [
+            f"{registry}/v2/proj/app/blobs/{d}" for d in LAYERS[:2]
+        ]
+        assert resolved.headers["Authorization"] == f"Bearer {TOKEN}"
+
+    def test_all_platforms_when_unspecified(self, registry):
+        r = ImageResolver(username="u", password="p")
+        resolved = r.resolve_layers(f"{registry}/v2/proj/app/manifests/v1")
+        assert len(resolved.urls) == 3
+
+    def test_no_platform_match_raises(self, registry):
+        r = ImageResolver(username="u", password="p", platform="windows/amd64")
+        with pytest.raises(LookupError):
+            r.resolve_layers(f"{registry}/v2/proj/app/manifests/v1")
+
+    def test_preheat_carries_auth_to_blob_fetch(self, registry, tmp_path):
+        """The pull token must reach the actual blob GETs: a seed daemon
+        preheating a private registry downloads layer bytes end to end."""
+        from dragonfly2_tpu.daemon import Daemon
+        from dragonfly2_tpu.jobs import Worker, preheat_image
+        from dragonfly2_tpu.jobs.preheat import make_preheat_handler
+        from dragonfly2_tpu.scheduler import (
+            Evaluator,
+            NetworkTopology,
+            Resource,
+            SchedulerService,
+            Scheduling,
+            SchedulingConfig,
+        )
+        from dragonfly2_tpu.scheduler.resource import Host
+        from dragonfly2_tpu.source import HTTPSourceClient, PieceSourceFetcher, SourceRegistry
+
+        # Registry fixture serves authenticated blobs too.
+        blob_bytes = {d: bytes([i]) * 8192 for i, d in enumerate(LAYERS)}
+        _RegistryHandler.blobs = blob_bytes
+
+        res = Resource()
+        sched = SchedulerService(
+            res,
+            Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+            None,
+            NetworkTopology(res.host_manager),
+        )
+        host = Host(id="seed-0", hostname="seed-0", ip="127.0.0.1",
+                    port=8002, download_port=8001)
+        res.store_host(host)
+        src_registry = SourceRegistry()
+        src_registry.register("http", HTTPSourceClient())
+        fetcher = PieceSourceFetcher(registry=src_registry)
+        seed = Daemon(host, sched, storage_root=str(tmp_path / "seed"),
+                      source_fetcher=fetcher, prefer_native=False)
+        broker = JobQueue()
+        worker = Worker(broker, "scheduler:s1")
+        worker.register(
+            PREHEAT,
+            make_preheat_handler(seed, content_length_for=fetcher.content_length),
+        )
+        resolver = ImageResolver(username="u", password="p",
+                                 platform="linux/amd64")
+        job = preheat_image(
+            broker, f"{registry}/v2/proj/app/manifests/v1",
+            ["scheduler:s1"], resolver, piece_size=4096,
+        )
+        worker.drain()
+        state = broker.group_state(job.group.id)
+        failures = [j.error for j in broker.jobs.values() if j.error]
+        assert state.value == "SUCCESS", failures
+        # Bytes are real layer content, fetched WITH the token.
+        for d in LAYERS[:2]:
+            url = f"{registry}/v2/proj/app/blobs/{d}"
+            from dragonfly2_tpu.utils import idgen
+
+            tid = idgen.task_id(url)
+            assert seed.read_task_bytes(tid) == blob_bytes[d]
+
+    def test_preheat_image_fans_out_layers(self, registry):
+        broker = JobQueue()
+        r = ImageResolver(username="u", password="p", platform="linux/amd64")
+        captured = {}
+        worker = Worker(broker, "scheduler:s1")
+        worker.register(PREHEAT, lambda args: captured.update(args) or {})
+        job = preheat_image(
+            broker, f"{registry}/v2/proj/app/manifests/v1",
+            ["scheduler:s1"], r,
+        )
+        worker.drain()
+        assert broker.group_state(job.group.id).value == "SUCCESS"
+        assert captured["urls"] == [
+            f"{registry}/v2/proj/app/blobs/{d}" for d in LAYERS[:2]
+        ]
+        # Auth header rides along for the seed daemons' blob fetches.
+        assert captured["headers"]["Authorization"] == f"Bearer {TOKEN}"

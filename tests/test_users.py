@@ -246,6 +246,16 @@ class TestUserREST:
                      {"name": "henry", "password": "newpassword1"})["token"]
 
 
+class TestConsole:
+    def test_console_served_at_root(self, rest_server):
+        with urllib.request.urlopen(rest_server.url + "/", timeout=5) as r:
+            body = r.read().decode()
+            assert r.headers.get_content_type() == "text/html"
+        assert "manager console" in body and "/api/v1" in body
+        with urllib.request.urlopen(rest_server.url + "/console", timeout=5) as r:
+            assert r.status == 200
+
+
 class TestManagerAuthConfig:
     def test_short_token_secret_is_config_error(self):
         from dragonfly2_tpu.config import ConfigError
