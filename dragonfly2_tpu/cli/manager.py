@@ -90,8 +90,9 @@ def run(argv=None) -> int:
         grpc_server = ManagerGRPCServer(
             parts["registry"], parts["clusters"], parts["searcher"],
             host=cfg.server.host, port=cfg.server.grpc_port,
-            # Same RBAC as REST: the gRPC port is not a bypass.
+            # Same RBAC as REST, same credentials: session tokens AND PATs.
             token_verifier=auth.get("token_verifier"),
+            users=auth.get("users"),
         )
         grpc_server.serve()
     # flush: under a pipe (supervisors, e2e harnesses) the ready line must

@@ -101,8 +101,12 @@ def run(argv=None) -> int:
 
     runner.start()
     from ..rpc import SchedulerHTTPServer
+    from ..rpc.ratelimit import maybe_bucket
 
-    rpc_server = SchedulerHTTPServer(service, host=cfg.server.host, port=cfg.server.port)
+    bucket = maybe_bucket(cfg.server.rate_limit_qps, cfg.server.rate_limit_burst)
+    rpc_server = SchedulerHTTPServer(
+        service, host=cfg.server.host, port=cfg.server.port, rate_limit=bucket
+    )
     rpc_server.serve()
     # Both transports bind the SAME adapter: HTTP/JSON and binary gRPC
     # (pkg/rpc serves gRPC in the reference; JSON stays for curl/debug).
@@ -110,8 +114,11 @@ def run(argv=None) -> int:
     if cfg.server.grpc_port >= 0:
         from ..rpc.grpc_transport import SchedulerGRPCServer
 
+        # ONE shared bucket: the configured qps bounds the SERVICE, not
+        # each transport separately.
         grpc_server = SchedulerGRPCServer(
-            service, host=cfg.server.host, port=cfg.server.grpc_port
+            service, host=cfg.server.host, port=cfg.server.grpc_port,
+            rate_limit=bucket,
         )
         grpc_server.serve()
     print(

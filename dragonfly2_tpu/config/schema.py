@@ -30,6 +30,9 @@ class ServerConfig:
     # Binary gRPC listener alongside the JSON transport; -1 = disabled,
     # 0 = OS-assigned ephemeral.
     grpc_port: int = -1
+    # Token-bucket server rate limit (pkg/rpc interceptor.go); 0 = off.
+    rate_limit_qps: float = 0.0
+    rate_limit_burst: int = 0
 
     def validate(self) -> None:
         # 0 = OS-assigned ephemeral port (tests / sidecar deployments).

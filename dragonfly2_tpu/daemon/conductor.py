@@ -104,8 +104,10 @@ class Conductor:
         source_headers: Optional[dict] = None,
     ) -> DownloadResult:
         """``source_headers`` ride along to the origin fetcher (preheat of
-        authenticated registry blobs carries the pull token this way)."""
-        self._source_headers = source_headers
+        authenticated registry blobs carries the pull token this way);
+        they travel per-call — the Conductor is shared across concurrent
+        downloads and must not bleed one download's credentials into
+        another's origin requests."""
         t0 = time.monotonic()
         reg = self.scheduler.register_peer(host=self.host, url=url)
         peer = reg.peer
@@ -155,7 +157,9 @@ class Conductor:
                 if result is not None:
                     return result
                 # P2P path exhausted → back-to-source (dfget.go:141 fallback).
-            return self._pull_from_source(peer, n_pieces, piece_size, t0)
+            return self._pull_from_source(
+                peer, n_pieces, piece_size, t0, source_headers
+            )
         finally:
             if self.traffic_shaper is not None:
                 self.traffic_shaper.remove_task(task.id)
@@ -237,7 +241,12 @@ class Conductor:
         )
 
     def _pull_from_source(
-        self, peer: Peer, n_pieces: int, piece_size: int, t0: float
+        self,
+        peer: Peer,
+        n_pieces: int,
+        piece_size: int,
+        t0: float,
+        headers: Optional[dict] = None,
     ) -> DownloadResult:
         task = peer.task
         if self.source_fetcher is None:
@@ -253,11 +262,15 @@ class Conductor:
         groups = min(self.concurrent_source_groups, len(missing))
         try:
             if groups > 1 and len(missing) >= self.concurrent_source_threshold:
-                nbytes = self._source_piece_groups(peer, missing, piece_size, groups)
+                nbytes = self._source_piece_groups(
+                    peer, missing, piece_size, groups, headers
+                )
             else:
                 nbytes = 0
                 for number in missing:
-                    nbytes += self._source_one_piece(peer, number, piece_size)
+                    nbytes += self._source_one_piece(
+                        peer, number, piece_size, headers
+                    )
         except _SourceFetchError as e:
             return self._fail(peer, t0, str(e))
         self.scheduler.report_peer_finished(peer)
@@ -271,22 +284,23 @@ class Conductor:
             cost_s=time.monotonic() - t0,
         )
 
-    def _source_one_piece(self, peer: Peer, number: int, piece_size: int) -> int:
+    def _source_one_piece(
+        self,
+        peer: Peer,
+        number: int,
+        piece_size: int,
+        headers: Optional[dict] = None,
+    ) -> int:
         """Fetch piece `number` from the origin, persist + report it."""
+        from ..source.client import call_with_optional_headers
+
         task = peer.task
         t_piece = time.monotonic()
         try:
-            headers = getattr(self, "_source_headers", None)
-            if headers:
-                try:
-                    data = self.source_fetcher.fetch(
-                        task.url, number, piece_size, headers=headers
-                    )
-                except TypeError:
-                    # Fetcher predates the headers kwarg.
-                    data = self.source_fetcher.fetch(task.url, number, piece_size)
-            else:
-                data = self.source_fetcher.fetch(task.url, number, piece_size)
+            data = call_with_optional_headers(
+                self.source_fetcher.fetch, task.url, number, piece_size,
+                headers=headers,
+            )
         except Exception:
             raise _SourceFetchError(f"source fetch piece {number}")
         cost_ns = max(int((time.monotonic() - t_piece) * 1e9), 1)
@@ -308,7 +322,12 @@ class Conductor:
         return len(data)
 
     def _source_piece_groups(
-        self, peer: Peer, missing: Sequence[int], piece_size: int, groups: int
+        self,
+        peer: Peer,
+        missing: Sequence[int],
+        piece_size: int,
+        groups: int,
+        headers: Optional[dict] = None,
     ) -> int:
         """Concurrent back-to-source by contiguous piece groups.
 
@@ -331,7 +350,9 @@ class Conductor:
                 if cancelled.is_set():
                     raise _SourceFetchError("cancelled by sibling group")
                 try:
-                    nbytes += self._source_one_piece(peer, number, piece_size)
+                    nbytes += self._source_one_piece(
+                        peer, number, piece_size, headers
+                    )
                 except Exception as e:
                     # Not just fetch failures: a write/report error
                     # (disk full, scheduler unreachable) is equally

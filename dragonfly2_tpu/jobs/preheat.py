@@ -73,14 +73,15 @@ def make_preheat_handler(seed_daemon, *, content_length_for=None):
     """
 
     def handler(args: Dict) -> Dict:
+        from ..source.client import call_with_optional_headers
+
         headers = args.get("headers") or None
         results = {}
         for url in args["urls"]:
             if content_length_for is not None:
-                try:
-                    cl = content_length_for(url, headers=headers)
-                except TypeError:
-                    cl = content_length_for(url)
+                cl = call_with_optional_headers(
+                    content_length_for, url, headers=headers
+                )
             else:
                 cl = args["piece_size"]
             # The registry pull token rides to the origin fetcher —

@@ -37,6 +37,10 @@ class Job:
     result: Any = None
     error: str = ""
     created_at: float = field(default_factory=time.time)
+    # Workers skip (FAILURE "expired") jobs past this wall-clock time —
+    # a late-attaching consumer must not replay a backlog of stale
+    # interval jobs whose results nobody reads.  0 = never expires.
+    expires_at: float = 0.0
 
 
 @dataclass
@@ -77,9 +81,11 @@ class JobQueue:
         *,
         queue_name: str = GLOBAL_QUEUE,
         group_id: Optional[str] = None,
+        expires_at: float = 0.0,
     ) -> Job:
         job = Job(
-            id=uuid.uuid4().hex, type=type, queue=queue_name, args=args, group_id=group_id
+            id=uuid.uuid4().hex, type=type, queue=queue_name, args=args,
+            group_id=group_id, expires_at=expires_at,
         )
         with self._mu:
             self.jobs[job.id] = job
@@ -112,6 +118,28 @@ class JobQueue:
         except queue.Empty:
             return None
 
+    def prune(self, max_age_s: float) -> int:
+        """Drop terminal job records (and emptied groups) older than
+        ``max_age_s`` — interval producers (sync_peers every minute for
+        the manager's lifetime) must not grow the registry unboundedly."""
+        cutoff = time.time() - max_age_s
+        removed = 0
+        with self._mu:
+            for jid in [
+                j.id for j in self.jobs.values()
+                if j.state in (JobState.SUCCESS, JobState.FAILURE)
+                and j.created_at < cutoff
+            ]:
+                job = self.jobs.pop(jid)
+                removed += 1
+                if job.group_id and job.group_id in self.groups:
+                    group = self.groups[job.group_id]
+                    if jid in group.job_ids:
+                        group.job_ids.remove(jid)
+                    if not group.job_ids:
+                        self.groups.pop(job.group_id, None)
+        return removed
+
 
 class Worker:
     """Consumes one queue; handlers registered per job type
@@ -128,6 +156,10 @@ class Worker:
         self._handlers[job_type] = handler
 
     def _run_job(self, job: Job) -> None:
+        if job.expires_at and time.time() > job.expires_at:
+            job.state = JobState.FAILURE
+            job.error = "expired before execution"
+            return
         handler = self._handlers.get(job.type)
         if handler is None:
             job.state = JobState.FAILURE

@@ -65,11 +65,16 @@ class SyncPeers:
         *,
         interval_s: float = 60.0,
         job_timeout_s: float = 30.0,
+        prune_age_s: Optional[float] = None,
     ) -> None:
         self.broker = broker
         self.clusters = clusters
         self.interval_s = interval_s
         self.job_timeout_s = job_timeout_s
+        # Terminal job records older than ~10 rounds are history.
+        self.prune_age_s = (
+            prune_age_s if prune_age_s is not None else max(interval_s * 10, 60.0)
+        )
         self._mu = threading.Lock()
         # (scheduler_id, host_id) → PeerRecord
         self.peers: Dict[tuple, PeerRecord] = {}
@@ -82,14 +87,19 @@ class SyncPeers:
         """→ number of schedulers that answered.
 
         All jobs are fanned out FIRST and collected under one shared
-        deadline — N dead schedulers cost one timeout, not N."""
+        deadline — N dead schedulers cost one timeout, not N.  Peers of
+        schedulers that fell OUT of the active set (keepalive expiry)
+        flip inactive too: a crashed scheduler must not leave its
+        inventory reported live forever."""
+        deadline = time.time() + self.job_timeout_s
+        active = self.clusters.active_schedulers()
         pending = [
             (sched.id, self.broker.enqueue(
-                SYNC_PEERS, {}, queue_name=f"scheduler:{sched.id}"
+                SYNC_PEERS, {}, queue_name=f"scheduler:{sched.id}",
+                expires_at=deadline,
             ))
-            for sched in self.clusters.active_schedulers()
+            for sched in active
         ]
-        deadline = time.time() + self.job_timeout_s
         answered = 0
         while pending and time.time() < deadline:
             still = []
@@ -102,6 +112,14 @@ class SyncPeers:
             pending = still
             if pending:
                 time.sleep(0.01)
+        active_ids = {s.id for s in active}
+        now = time.time()
+        with self._mu:
+            for (sched_id, _), rec in self.peers.items():
+                if sched_id not in active_ids and rec.active:
+                    rec.active = False
+                    rec.updated_at = now
+        self.broker.prune(max_age_s=self.prune_age_s)
         return answered
 
     def _merge(self, scheduler_id: str, hosts: List[Dict]) -> None:

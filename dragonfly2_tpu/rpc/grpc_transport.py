@@ -134,11 +134,20 @@ class SchedulerGRPCServer:
         *,
         max_workers: int = 16,
         server_credentials: Optional[grpc.ServerCredentials] = None,
+        rate_limit=None,
     ) -> None:
         from .scheduler_server import SchedulerRPCAdapter
 
         self.adapter = SchedulerRPCAdapter(service)
-        self._server = grpc.server(ThreadPoolExecutor(max_workers=max_workers))
+        interceptors = ()
+        if rate_limit is not None:
+            from .ratelimit import RateLimitInterceptor
+
+            interceptors = (RateLimitInterceptor(rate_limit),)
+        self._server = grpc.server(
+            ThreadPoolExecutor(max_workers=max_workers),
+            interceptors=interceptors,
+        )
 
         handlers = {}
         for method, (req_cls, resp_cls) in SCHEDULER_METHODS.items():
@@ -356,6 +365,7 @@ class ManagerGRPCServer:
         *,
         max_workers: int = 16,
         token_verifier=None,
+        users=None,
         server_credentials: Optional[grpc.ServerCredentials] = None,
     ) -> None:
         from ..manager.searcher import Searcher
@@ -366,6 +376,9 @@ class ManagerGRPCServer:
         self.searcher = searcher or Searcher()
         self.scheduler_clusters = scheduler_clusters or []
         self.token_verifier = token_verifier
+        # With a UserStore, personal access tokens authenticate here
+        # exactly like on REST — both ports accept the same credentials.
+        self.users = users
         self._server = grpc.server(ThreadPoolExecutor(max_workers=max_workers))
         methods = {
             # name: (fn, req, resp, required role — None = open read)
@@ -398,14 +411,31 @@ class ManagerGRPCServer:
             bound = self._server.add_insecure_port(addr)
         self.address: Tuple[str, int] = (host, bound)
 
+    def _authorized(self, token, required_role) -> bool:
+        if token is None:
+            return False
+        if self.users is not None:
+            from ..manager.users import PAT_PREFIX
+
+            if token.startswith(PAT_PREFIX):
+                user = self.users.authenticate_pat(token)
+                return user is not None and user.role >= required_role
+        if self.token_verifier is not None:
+            return (
+                self.token_verifier.authorize(token, required_role) is not None
+            )
+        return False
+
     def _wrap(self, fn, required_role):
         def handle(request, context):
-            if required_role is not None and self.token_verifier is not None:
+            if required_role is not None and (
+                self.token_verifier is not None or self.users is not None
+            ):
                 token = None
                 for key, value in context.invocation_metadata():
                     if key == "authorization" and value.startswith("Bearer "):
                         token = value[len("Bearer "):]
-                if self.token_verifier.authorize(token, required_role) is None:
+                if not self._authorized(token, required_role):
                     context.abort(
                         grpc.StatusCode.PERMISSION_DENIED,
                         f"requires role >= {required_role.name}",

@@ -1,0 +1,66 @@
+"""Server-side rate limiting (reference: pkg/rpc/interceptor.go:69-128 —
+a token-bucket RateLimiterInterceptor on every gRPC server).
+
+``TokenBucket`` is the shared primitive; ``RateLimitInterceptor`` plugs
+into grpc servers (RESOURCE_EXHAUSTED when drained) and the HTTP wire
+servers check the same bucket (429).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+
+class TokenBucket:
+    """qps refill, burst capacity; non-blocking take."""
+
+    def __init__(self, qps: float, burst: int) -> None:
+        if qps <= 0 or burst <= 0:
+            raise ValueError("qps and burst must be positive")
+        self.qps = qps
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._last = time.monotonic()
+        self._mu = threading.Lock()
+
+    def take(self, n: float = 1.0) -> bool:
+        with self._mu:
+            now = time.monotonic()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.qps
+            )
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class RateLimitInterceptor(grpc.ServerInterceptor):
+    """Rejects calls with RESOURCE_EXHAUSTED once the bucket drains
+    (interceptor.go limit() → resource-exhausted conversion)."""
+
+    def __init__(self, bucket: TokenBucket) -> None:
+        self.bucket = bucket
+
+    def intercept_service(self, continuation, handler_call_details):
+        if self.bucket.take():
+            return continuation(handler_call_details)
+
+        def reject(request, context):
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED, "rate limit exceeded"
+            )
+
+        return grpc.unary_unary_rpc_method_handler(reject)
+
+
+def maybe_bucket(qps: Optional[float], burst: Optional[int]) -> Optional[TokenBucket]:
+    """Config helper: None/0 qps disables limiting."""
+    if not qps:
+        return None
+    return TokenBucket(qps, burst or max(int(qps), 1))

@@ -249,7 +249,14 @@ class SchedulerRPCAdapter:
 class SchedulerHTTPServer:
     """POST /rpc/<method> with JSON bodies over ThreadingHTTPServer."""
 
-    def __init__(self, service: SchedulerService, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        service: SchedulerService,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        rate_limit=None,
+    ):
         self.adapter = SchedulerRPCAdapter(service)
         adapter = self.adapter
 
@@ -258,6 +265,18 @@ class SchedulerHTTPServer:
                 pass
 
             def do_POST(self):
+                if rate_limit is not None and not rate_limit.take():
+                    # interceptor.go rate limiter → 429 on the JSON wire.
+                    body = json.dumps(
+                        {"error": "rate limit exceeded",
+                         "code": int(Code.RESOURCE_EXHAUSTED)}
+                    ).encode()
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if not self.path.startswith("/rpc/"):
                     self.send_error(404)
                     return

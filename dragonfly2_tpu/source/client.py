@@ -25,6 +25,42 @@ def default_transport(req: urllib.request.Request, timeout: float):
     return urllib.request.urlopen(req, timeout=timeout)
 
 
+def _accepts_headers(fn) -> bool:
+    """True when `fn` takes a `headers` kwarg (or **kwargs).  Inspected
+    once per callable — a genuine TypeError raised INSIDE a headers-aware
+    call must propagate, never silently retry without auth."""
+    try:
+        cached = fn.__dict__.get("_df_accepts_headers")
+    except AttributeError:
+        cached = None
+    if cached is not None:
+        return cached
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+        ok = "headers" in sig.parameters or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values()
+        )
+    except (ValueError, TypeError):
+        ok = False
+    try:
+        fn.__dict__["_df_accepts_headers"] = ok
+    except AttributeError:
+        pass  # bound methods / builtins: re-inspect next time
+    return ok
+
+
+def call_with_optional_headers(fn, *args, headers=None):
+    """Invoke `fn(*args, headers=headers)` when supported, else
+    `fn(*args)` — but ONLY based on the signature: headers are never
+    dropped because of an exception."""
+    if headers and _accepts_headers(fn):
+        return fn(*args, headers=headers)
+    return fn(*args)
+
+
 class RangedHTTPClient:
     """Shared HEAD-length / range-GET / exists over a ``_request`` hook.
 
@@ -176,23 +212,16 @@ class PieceSourceFetcher:
 
     def content_length(self, url: str, headers: Optional[dict] = None) -> int:
         client = self.registry.client_for(url)
-        if headers:
-            try:
-                return client.content_length(url, headers=headers)
-            except TypeError:
-                pass
-        return client.content_length(url)
+        return call_with_optional_headers(
+            client.content_length, url, headers=headers
+        )
 
     def fetch(
         self, url: str, number: int, piece_size: int,
         headers: Optional[dict] = None,
     ) -> bytes:
         client = self.registry.client_for(url)
-        if headers:
-            try:
-                return client.read_range(
-                    url, number * piece_size, piece_size, headers=headers
-                )
-            except TypeError:
-                pass
-        return client.read_range(url, number * piece_size, piece_size)
+        return call_with_optional_headers(
+            client.read_range, url, number * piece_size, piece_size,
+            headers=headers,
+        )

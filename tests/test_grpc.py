@@ -196,6 +196,81 @@ class TestSchedulerGRPC:
         )
 
 
+class TestRateLimit:
+    def test_token_bucket_refills(self):
+        import time
+
+        from dragonfly2_tpu.rpc.ratelimit import TokenBucket, maybe_bucket
+
+        b = TokenBucket(qps=100.0, burst=3)
+        assert all(b.take() for _ in range(3))
+        assert not b.take()  # drained
+        time.sleep(0.05)     # ~5 tokens refill at 100 qps
+        assert b.take()
+        assert maybe_bucket(0, 0) is None
+        assert maybe_bucket(5.0, None) is not None
+
+    def test_grpc_server_rejects_when_drained(self):
+        from dragonfly2_tpu.rpc.ratelimit import TokenBucket
+
+        resource = Resource()
+        service = SchedulerService(
+            resource,
+            Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+            None,
+            NetworkTopology(resource.host_manager),
+        )
+        server = SchedulerGRPCServer(
+            service, rate_limit=TokenBucket(qps=0.001, burst=2)
+        )
+        server.serve()
+        try:
+            client = GRPCRemoteScheduler(server.target)
+            host = Host(id="rl", hostname="rl", ip="127.0.0.1", download_port=1)
+            client.announce_host(host)  # token 1
+            client.register_peer(host=host, url="https://o/rl-blob")  # token 2
+            with pytest.raises(RPCError) as exc:
+                client.announce_host(
+                    Host(id="rl2", hostname="rl2", ip="127.0.0.1", download_port=1)
+                )
+            assert "RESOURCE_EXHAUSTED" in str(exc.value)
+            client.close()
+        finally:
+            server.stop()
+
+    def test_http_server_answers_429(self):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from dragonfly2_tpu.rpc import SchedulerHTTPServer
+        from dragonfly2_tpu.rpc.ratelimit import TokenBucket
+
+        resource = Resource()
+        service = SchedulerService(
+            resource,
+            Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+            None,
+            NetworkTopology(resource.host_manager),
+        )
+        server = SchedulerHTTPServer(
+            service, rate_limit=TokenBucket(qps=0.001, burst=1)
+        )
+        server.serve()
+        try:
+            req = urllib.request.Request(
+                server.url + "/rpc/announce_host",
+                data=_json.dumps({"host": {"id": "h"}}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            urllib.request.urlopen(req, timeout=5).read()  # token 1
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=5)
+            assert exc.value.code == 429
+        finally:
+            server.stop()
+
+
 class TestManagerGRPC:
     def test_model_lifecycle_over_grpc(self):
         from dragonfly2_tpu.manager import ClusterManager, ModelRegistry
@@ -275,6 +350,37 @@ class TestManagerGRPC:
         finally:
             server.stop()
 
+    def test_pats_authenticate_on_grpc_port(self):
+        """Both ports accept the same credentials: a PAT works over gRPC
+        with its capped role, exactly like REST."""
+        from dragonfly2_tpu.manager import ClusterManager, ModelRegistry, UserStore
+        from dragonfly2_tpu.rpc.grpc_transport import (
+            GRPCRemoteRegistry,
+            ManagerGRPCServer,
+        )
+        from dragonfly2_tpu.security.tokens import Role, TokenVerifier
+
+        users = UserStore()
+        admin = users.create_user("boss", "password123", role=Role.ADMIN)
+        _, peer_pat = users.create_pat(admin.id, "trainer", role=Role.PEER)
+        server = ManagerGRPCServer(
+            ModelRegistry(), ClusterManager(),
+            token_verifier=TokenVerifier(b"grpc-pat-secret-0123456789"),
+            users=users,
+        )
+        server.serve()
+        try:
+            client = GRPCRemoteRegistry(server.target, token=peer_pat)
+            m = client.create_model(name="m", type="mlp", scheduler_id="s")
+            with pytest.raises(RPCError):  # PEER-capped: no activation
+                client.activate(m.id)
+            users.revoke_pat(users.list_pats(admin.id)[0].id)
+            with pytest.raises(RPCError):  # revocation applies here too
+                client.create_model(name="m2", type="mlp", scheduler_id="s")
+            client.close()
+        finally:
+            server.stop()
+
     def test_keepalive_and_scheduler_listing(self):
         from dragonfly2_tpu.manager import ClusterManager, ModelRegistry
         from dragonfly2_tpu.rpc.grpc_transport import (
@@ -349,8 +455,15 @@ class TestTrainerGRPC:
         from dragonfly2_tpu.records.columnar import ColumnarWriter
         from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
         from dragonfly2_tpu.trainer.service import TrainerService
+        from dragonfly2_tpu.trainer.train import TrainConfig
 
-        service = TrainerService(data_dir=str(tmp_path / "staged"))
+        # Tiny config + run-completion wait below: the async training
+        # thread must NOT outlive this test (it would mutate the global
+        # trainer metrics under later tests).
+        service = TrainerService(
+            data_dir=str(tmp_path / "staged"),
+            train_config=TrainConfig(epochs=1, warmup_steps=1),
+        )
         server = TrainerGRPCServer(service)
         server.serve()
         try:
@@ -359,14 +472,22 @@ class TestTrainerGRPC:
                 w.append(cluster.generate_feature_rows(4000, seed=4))
             client = GRPCTrainerClient(server.target)
             client.CHUNK_BYTES = 64 * 1024  # force many chunks
+            key = None
             try:
-                client.train(
+                key = client.train(
                     ip="1.2.3.4", hostname="s", scheduler_id="s",
                     download_shards=[str(shard)],
                 )
             except RPCError:
                 pass  # no registry configured: training may no-op/fail;
                 # the assertion below is about BYTES, not training.
+            if key is not None:
+                import time
+
+                for _ in range(600):
+                    if client.run_status(key)["done"]:
+                        break
+                    time.sleep(0.1)
             staged = glob.glob(
                 str(tmp_path / "staged" / "*" / "download_big.dfc")
             )[0]

@@ -56,6 +56,65 @@ class TestSyncPeers:
         finally:
             worker.stop()
 
+    def test_dead_scheduler_inventory_goes_inactive(self):
+        """A scheduler dropping out of the active set (keepalive expiry)
+        must not leave its peers reported live forever."""
+        import time as _time
+
+        resource = Resource()
+        resource.store_host(make_host(9))
+        broker = JobQueue()
+        clusters = ClusterManager(keepalive_ttl=0.2)
+        sched = clusters.register_scheduler(
+            SchedulerInstance(id="dying", cluster_id="c1", ip="1.1.1.1", port=1)
+        )
+        worker = Worker(broker, f"scheduler:{sched.id}")
+        worker.register("sync_peers", make_sync_peers_handler(resource))
+        worker.serve()
+        try:
+            sp = SyncPeers(broker, clusters, job_timeout_s=10.0)
+            sp.run_once()
+            assert sp.list_peers("dying", active_only=True)
+            _time.sleep(0.3)  # keepalive TTL expires, scheduler vanishes
+            sp.run_once()
+            assert sp.list_peers("dying", active_only=True) == []
+        finally:
+            worker.stop()
+
+    def test_job_records_pruned(self):
+        import time as _time
+
+        resource = Resource()
+        broker = JobQueue()
+        clusters = ClusterManager()
+        sched = clusters.register_scheduler(
+            SchedulerInstance(id="s", cluster_id="c", ip="1.1.1.1", port=1)
+        )
+        worker = Worker(broker, f"scheduler:{sched.id}")
+        worker.register("sync_peers", make_sync_peers_handler(resource))
+        worker.serve()
+        try:
+            sp = SyncPeers(broker, clusters, interval_s=0.001,
+                           job_timeout_s=5.0, prune_age_s=0.01)
+            for _ in range(5):
+                sp.run_once()
+            _time.sleep(0.05)
+            sp.run_once()  # prune of records older than 10×interval runs here
+            assert len(broker.jobs) <= 2  # old terminal records gone
+        finally:
+            worker.stop()
+
+    def test_expired_jobs_not_replayed(self):
+        import time as _time
+
+        broker = JobQueue()
+        job = broker.enqueue("sync_peers", {}, queue_name="q",
+                             expires_at=_time.time() - 1)
+        worker = Worker(broker, "q")
+        worker.register("sync_peers", lambda a: ["should-not-run"])
+        worker.drain()
+        assert job.state.value == "FAILURE" and "expired" in job.error
+
     def test_unanswered_scheduler_skipped(self):
         broker = JobQueue()
         clusters = ClusterManager()
