@@ -60,13 +60,18 @@ class GroupJob:
 
 
 class JobQueue:
-    """The broker: named queues + job/group registry."""
+    """The broker: named queues + job/group registry.
 
-    def __init__(self) -> None:
+    ``max_backlog`` bounds each named queue: once full, the OLDEST
+    queued job is evicted (FAILURE "evicted") — a queue whose consumer
+    never attaches must not grow without bound."""
+
+    def __init__(self, max_backlog: int = 10_000) -> None:
         self._mu = threading.Lock()
         self._queues: Dict[str, "queue.Queue[Job]"] = {}
         self.jobs: Dict[str, Job] = {}
         self.groups: Dict[str, GroupJob] = {}
+        self.max_backlog = max_backlog
 
     def _q(self, name: str) -> "queue.Queue[Job]":
         with self._mu:
@@ -91,7 +96,16 @@ class JobQueue:
             self.jobs[job.id] = job
             if group_id is not None:
                 self.groups.setdefault(group_id, GroupJob(group_id)).job_ids.append(job.id)
-        self._q(queue_name).put(job)
+        q = self._q(queue_name)
+        while q.qsize() >= self.max_backlog:
+            try:
+                evicted = q.get_nowait()
+            except queue.Empty:
+                break
+            if evicted.state is JobState.PENDING:
+                evicted.state = JobState.FAILURE
+                evicted.error = "evicted: queue backlog full"
+        q.put(job)
         return job
 
     def create_group_job(
@@ -121,10 +135,23 @@ class JobQueue:
     def prune(self, max_age_s: float) -> int:
         """Drop terminal job records (and emptied groups) older than
         ``max_age_s`` — interval producers (sync_peers every minute for
-        the manager's lifetime) must not grow the registry unboundedly."""
-        cutoff = time.time() - max_age_s
+        the manager's lifetime) must not grow the registry unboundedly.
+
+        PENDING jobs whose ``expires_at`` passed flip FAILURE first: a
+        queue whose consumer never attached must not exempt its jobs
+        from pruning."""
+        now = time.time()
+        cutoff = now - max_age_s
         removed = 0
         with self._mu:
+            for j in self.jobs.values():
+                if (
+                    j.state is JobState.PENDING
+                    and j.expires_at
+                    and now > j.expires_at
+                ):
+                    j.state = JobState.FAILURE
+                    j.error = "expired before execution"
             for jid in [
                 j.id for j in self.jobs.values()
                 if j.state in (JobState.SUCCESS, JobState.FAILURE)

@@ -241,33 +241,17 @@ class ManagerRESTServer:
             def _identity(self):
                 """→ (subject, Role, kind) from a session token OR a PAT;
                 None when unauthenticated.  kind ∈ {"session", "pat"} —
-                credential-management routes require a session.
-
-                Session tokens are re-checked against the live user store:
-                a disable or demotion takes effect immediately, not at
-                token expiry."""
-                from ..manager.users import PAT_PREFIX
+                credential-management routes require a session.  One
+                shared resolver with the gRPC port (tokens.
+                resolve_credential): disables/demotions bite everywhere
+                immediately."""
+                from ..security.tokens import resolve_credential
 
                 auth = self.headers.get("Authorization", "")
                 token = auth[len("Bearer ") :] if auth.startswith("Bearer ") else None
-                if token is None:
-                    return None
-                if server.users is not None and token.startswith(PAT_PREFIX):
-                    user = server.users.authenticate_pat(token)
-                    return None if user is None else (user.id, user.role, "pat")
-                if server.token_verifier is not None:
-                    claims = server.token_verifier.verify(token)
-                    if claims is None:
-                        return None
-                    role = claims.role
-                    if server.users is not None:
-                        user = server.users.get(claims.subject)
-                        if user is not None:
-                            if user.state != "enabled":
-                                return None
-                            role = min(role, user.role)
-                    return (claims.subject, role, "session")
-                return None
+                return resolve_credential(
+                    token, server.token_verifier, server.users
+                )
 
             def _authorized(self, required_role) -> bool:
                 if server.token_verifier is None and server.users is None:

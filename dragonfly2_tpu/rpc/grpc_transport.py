@@ -412,19 +412,10 @@ class ManagerGRPCServer:
         self.address: Tuple[str, int] = (host, bound)
 
     def _authorized(self, token, required_role) -> bool:
-        if token is None:
-            return False
-        if self.users is not None:
-            from ..manager.users import PAT_PREFIX
+        from ..security.tokens import resolve_credential
 
-            if token.startswith(PAT_PREFIX):
-                user = self.users.authenticate_pat(token)
-                return user is not None and user.role >= required_role
-        if self.token_verifier is not None:
-            return (
-                self.token_verifier.authorize(token, required_role) is not None
-            )
-        return False
+        ident = resolve_credential(token, self.token_verifier, self.users)
+        return ident is not None and ident[1] >= required_role
 
     def _wrap(self, fn, required_role):
         def handle(request, context):

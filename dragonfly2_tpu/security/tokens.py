@@ -97,3 +97,34 @@ class TokenVerifier:
         if claims is None or claims.role < required:
             return None
         return claims
+
+
+def resolve_credential(token, verifier, users):
+    """ONE credential-resolution path for every transport (REST + gRPC):
+    → (subject, Role, kind) or None.  kind ∈ {"session", "pat"}.
+
+    Session tokens are re-checked against the live user store so a
+    disable or demotion takes effect immediately on ALL ports, not at
+    token expiry; PATs resolve through the store with their capped role.
+    """
+    if token is None:
+        return None
+    if users is not None:
+        from ..manager.users import PAT_PREFIX
+
+        if token.startswith(PAT_PREFIX):
+            user = users.authenticate_pat(token)
+            return None if user is None else (user.id, user.role, "pat")
+    if verifier is not None:
+        claims = verifier.verify(token)
+        if claims is None:
+            return None
+        role = claims.role
+        if users is not None:
+            user = users.get(claims.subject)
+            if user is not None:
+                if user.state != "enabled":
+                    return None
+                role = min(role, user.role)
+        return (claims.subject, role, "session")
+    return None

@@ -155,6 +155,47 @@ class TrainerService:
 
     # -- training ------------------------------------------------------------
 
+    @staticmethod
+    def _normalize_shard(path: str, kind: str) -> str:
+        """Accept the REFERENCE's wire format too: a staged shard that is
+        not DFC1 columnar is parsed as the reference's headerless CSV
+        (scheduler/storage CSV via announcer.go upload) and converted —
+        a reference scheduler can stream its datasets here unmodified."""
+        from ..records.columnar import MAGIC
+
+        try:
+            with open(path, "rb") as f:
+                head = f.read(len(MAGIC))
+        except OSError:
+            return path
+        if head == MAGIC or not head:
+            return path
+        from ..records import csv_compat
+
+        converted = path + ".dfc"
+        # Cached: a retrained session must not re-parse a multi-GB CSV.
+        if (
+            os.path.exists(converted)
+            and os.path.getmtime(converted) >= os.path.getmtime(path)
+        ):
+            return converted
+        tmp = converted + ".tmp"
+        if kind == "download":
+            csv_compat.convert_download_csv_to_columnar(path, tmp)
+        else:
+            csv_compat.convert_topology_csv_to_columnar(path, tmp)
+        os.replace(tmp, converted)  # concurrent converters: last one wins whole
+        return converted
+
+    def _normalize_session(self, session: TrainSession) -> None:
+        session.download_shards = [
+            self._normalize_shard(p, "download") for p in session.download_shards
+        ]
+        session.topology_shards = [
+            self._normalize_shard(p, "networktopology")
+            for p in session.topology_shards
+        ]
+
     def _train(self, session: TrainSession, *, synchronous: bool) -> str:
         with self._mu:
             self._counter += 1
@@ -172,6 +213,9 @@ class TrainerService:
     def _run_training(self, run: TrainRun, session: TrainSession) -> None:
         t0 = time.perf_counter()
         try:
+            # Inside the (possibly async) worker: a multi-GB reference-CSV
+            # conversion must not hold the ingest RPC handler thread.
+            self._normalize_session(session)
             self._train_mlp(run, session)
             self._train_gnn(run, session)
         except Exception as exc:  # noqa: BLE001 — surfaced on the run record

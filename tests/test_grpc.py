@@ -350,6 +350,36 @@ class TestManagerGRPC:
         finally:
             server.stop()
 
+    def test_disable_bites_grpc_sessions_immediately(self):
+        """The shared credential resolver: disabling a user kills their
+        outstanding session token on the gRPC port too, not at expiry."""
+        from dragonfly2_tpu.manager import ClusterManager, ModelRegistry, UserStore
+        from dragonfly2_tpu.rpc.grpc_transport import (
+            GRPCRemoteRegistry,
+            ManagerGRPCServer,
+        )
+        from dragonfly2_tpu.security.tokens import Role, TokenIssuer, TokenVerifier
+
+        secret = b"grpc-disable-secret-0123456789"
+        users = UserStore()
+        u = users.create_user("victim", "password123", role=Role.ADMIN)
+        session = TokenIssuer(secret).issue(u.id, u.role)
+        server = ManagerGRPCServer(
+            ModelRegistry(), ClusterManager(),
+            token_verifier=TokenVerifier(secret), users=users,
+        )
+        server.serve()
+        try:
+            client = GRPCRemoteRegistry(server.target, token=session)
+            client.create_model(name="m", type="mlp", scheduler_id="s")
+            users.set_state(u.id, "disabled")
+            with pytest.raises(RPCError) as exc:
+                client.create_model(name="m2", type="mlp", scheduler_id="s")
+            assert "PERMISSION_DENIED" in str(exc.value)
+            client.close()
+        finally:
+            server.stop()
+
     def test_pats_authenticate_on_grpc_port(self):
         """Both ports accept the same credentials: a PAT works over gRPC
         with its capped role, exactly like REST."""

@@ -104,6 +104,23 @@ class TestSyncPeers:
         finally:
             worker.stop()
 
+    def test_consumerless_queue_bounded(self):
+        """No worker ever attaches: the backlog cap evicts the oldest and
+        prune reaps expired PENDING records."""
+        import time as _time
+
+        broker = JobQueue(max_backlog=5)
+        jobs = [
+            broker.enqueue("sync_peers", {}, queue_name="dead",
+                           expires_at=_time.time() + 0.01)
+            for _ in range(12)
+        ]
+        assert broker._q("dead").qsize() <= 5
+        assert sum(1 for j in jobs if "evicted" in j.error) >= 7
+        _time.sleep(0.05)
+        broker.prune(max_age_s=0.01)
+        assert len(broker.jobs) == 0  # expired PENDING + evicted all reaped
+
     def test_expired_jobs_not_replayed(self):
         import time as _time
 
