@@ -234,7 +234,10 @@ class NativePieceStore:
             raise NativeError(f"piece {number} of {task_id} failed crc verification")
         if n < 0:
             raise NativeError(f"ps_read_piece -> {n}")
-        return bytes(buf[: int(n)])
+        # string_at: one memcpy.  Slicing a ctypes array (`buf[:n]`)
+        # materializes n Python ints first — measured 98 ms per 4 MiB
+        # piece vs 1.8 ms for the whole python-engine read.
+        return ctypes.string_at(buf, int(n))
 
     def piece_count(self, task_id: str) -> int:
         n = self._lib.ps_piece_count(self._h, task_id.encode())

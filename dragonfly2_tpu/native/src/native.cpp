@@ -34,24 +34,44 @@
 namespace {
 
 // ---------------------------------------------------------------------------
-// crc32 (IEEE) — table-driven, no external deps.
+// crc32 (IEEE).  Slice-by-8: processes 8 bytes per step through 8 derived
+// tables — ~8x the single-table byte loop (which measured ~400 MB/s and
+// made native piece reads 10x slower than Python's SIMD zlib.crc32).
+// Same polynomial/init/final-xor as zlib, so stored CRCs stay valid.
 // ---------------------------------------------------------------------------
 
-uint32_t crc32_table[256];
+uint32_t crc32_tab8[8][256];
 std::once_flag crc_once;
 
 void crc32_init() {
   for (uint32_t i = 0; i < 256; i++) {
     uint32_t c = i;
     for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-    crc32_table[i] = c;
+    crc32_tab8[0][i] = c;
   }
+  for (uint32_t i = 0; i < 256; i++)
+    for (int t = 1; t < 8; t++)
+      crc32_tab8[t][i] =
+          crc32_tab8[0][crc32_tab8[t - 1][i] & 0xFF] ^ (crc32_tab8[t - 1][i] >> 8);
 }
 
 uint32_t crc32(const uint8_t* data, size_t len) {
   std::call_once(crc_once, crc32_init);
   uint32_t c = 0xFFFFFFFFu;
-  for (size_t i = 0; i < len; i++) c = crc32_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  while (len >= 8) {
+    uint32_t lo, hi;
+    memcpy(&lo, data, 4);
+    memcpy(&hi, data + 4, 4);
+    lo ^= c;
+    c = crc32_tab8[7][lo & 0xFF] ^ crc32_tab8[6][(lo >> 8) & 0xFF] ^
+        crc32_tab8[5][(lo >> 16) & 0xFF] ^ crc32_tab8[4][lo >> 24] ^
+        crc32_tab8[3][hi & 0xFF] ^ crc32_tab8[2][(hi >> 8) & 0xFF] ^
+        crc32_tab8[1][(hi >> 16) & 0xFF] ^ crc32_tab8[0][hi >> 24];
+    data += 8;
+    len -= 8;
+  }
+  for (size_t i = 0; i < len; i++)
+    c = crc32_tab8[0][(c ^ data[i]) & 0xFF] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
 }
 

@@ -163,7 +163,11 @@ def _fmt(value, typ) -> str:
     if typ is str:
         return value or ""
     if typ is float:
-        return f"{value:g}"  # gocsv %v: 0 → "0", 1.5 → "1.5"
+        # Shortest round-trip, like Go's %v (strconv 'g', prec -1):
+        # repr() never truncates (f"{x:g}" clips to 6 significant digits
+        # — 123456.78 → "123457"), and integral floats render bare.
+        s = repr(float(value))
+        return s[:-2] if s.endswith(".0") else s
     return str(int(value))
 
 
@@ -244,8 +248,15 @@ def _parse(cells, pos: int, spec):
                 kwargs[name] = raw
             elif typ is float:
                 kwargs[name] = float(raw) if raw else 0.0
+            elif not raw:
+                kwargs[name] = 0
             else:
-                kwargs[name] = int(float(raw)) if raw else 0
+                try:
+                    # Direct int parse: the float detour rounds int64s
+                    # ≥ 2^53 (nanosecond timestamps) — silent corruption.
+                    kwargs[name] = int(raw)
+                except ValueError:
+                    kwargs[name] = int(float(raw))
     return factory(**kwargs), pos
 
 
@@ -314,10 +325,26 @@ def read_topology_csv(path: str) -> List[NetworkTopologyRecord]:
         return [topology_from_row(row) for row in csv.reader(f) if row]
 
 
+def iter_download_csv(path: str):
+    """Stream Download records row by row — a multi-GB reference dataset
+    must never be materialized as a list of deep dataclasses."""
+    with open(path, newline="") as f:
+        for row in csv.reader(f):
+            if row:
+                yield download_from_row(row)
+
+
+def iter_topology_csv(path: str):
+    with open(path, newline="") as f:
+        for row in csv.reader(f):
+            if row:
+                yield topology_from_row(row)
+
+
 def convert_download_csv_to_columnar(csv_path: str, out_path: str) -> int:
     """Reference CSV dataset → this framework's columnar TPU-ingest shard
     (the migration path for a reference deployment's accumulated data).
-    Returns feature rows written."""
+    Streams record-by-record; returns feature rows written."""
     import numpy as np
 
     from .columnar import ColumnarWriter
@@ -325,7 +352,7 @@ def convert_download_csv_to_columnar(csv_path: str, out_path: str) -> int:
 
     n = 0
     with ColumnarWriter(out_path, DOWNLOAD_COLUMNS) as w:
-        for record in read_download_csv(csv_path):
+        for record in iter_download_csv(csv_path):
             rows = download_to_rows(record)
             if len(rows):
                 w.append(np.asarray(rows, np.float32))
@@ -341,7 +368,7 @@ def convert_topology_csv_to_columnar(csv_path: str, out_path: str) -> int:
 
     n = 0
     with ColumnarWriter(out_path, TOPO_COLUMNS) as w:
-        for record in read_topology_csv(csv_path):
+        for record in iter_topology_csv(csv_path):
             rows = topology_to_rows(record)
             if len(rows):
                 w.append(np.asarray(rows, np.float32))

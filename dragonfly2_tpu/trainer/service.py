@@ -179,12 +179,24 @@ class TrainerService:
             and os.path.getmtime(converted) >= os.path.getmtime(path)
         ):
             return converted
-        tmp = converted + ".tmp"
-        if kind == "download":
-            csv_compat.convert_download_csv_to_columnar(path, tmp)
-        else:
-            csv_compat.convert_topology_csv_to_columnar(path, tmp)
-        os.replace(tmp, converted)  # concurrent converters: last one wins whole
+        import tempfile
+
+        # Per-attempt tmp name: two concurrent retrains over the same
+        # staged shard must never interleave writes into one file.
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path), suffix=".dfc.tmp"
+        )
+        os.close(fd)
+        os.unlink(tmp)  # ColumnarWriter must create the file itself
+        try:
+            if kind == "download":
+                csv_compat.convert_download_csv_to_columnar(path, tmp)
+            else:
+                csv_compat.convert_topology_csv_to_columnar(path, tmp)
+            os.replace(tmp, converted)  # atomic: readers see whole files
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
         return converted
 
     def _normalize_session(self, session: TrainSession) -> None:

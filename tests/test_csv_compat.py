@@ -117,6 +117,18 @@ class TestRoundtrip:
         trow = topology_to_row(make_topology())
         assert topology_to_row(topology_from_row(trow)) == trow
 
+    def test_precision_survives_roundtrip(self):
+        """%g-style truncation and the int(float()) detour both corrupt
+        real values — full precision must survive."""
+        d = make_download()
+        d.created_at = 1_700_000_000_000_000_001      # int64 > 2^53
+        d.host.cpu.times.user = 123456.78             # >6 sig digits
+        d.host.memory.used_percent = 41.333333
+        back = download_from_row(download_to_row(d))
+        assert back.created_at == 1_700_000_000_000_000_001
+        assert back.host.cpu.times.user == 123456.78
+        assert back.host.memory.used_percent == 41.333333
+
     def test_wrong_width_rejected(self):
         with pytest.raises(ValueError):
             download_from_row(["x"] * 10)
