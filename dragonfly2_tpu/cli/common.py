@@ -15,9 +15,27 @@ def base_parser(prog: str, description: str) -> argparse.ArgumentParser:
     p.add_argument("--console", action="store_true", help="log to stdout")
     p.add_argument("--log-dir", default=None, help="rotating log file directory")
     p.add_argument(
+        "--debug-port", type=int, default=None, metavar="PORT",
+        help="loopback debug endpoint: /debug/stacks, /debug/stats, "
+             "/debug/profile (cmd/dependency --pprof-port analog; 0 = "
+             "ephemeral)",
+    )
+    p.add_argument(
         "--version", action="version", version=f"%(prog)s {__version__}"
     )
     return p
+
+
+def init_debug(args) -> None:
+    """Start the debug endpoint when --debug-port is given (every binary,
+    like the reference's pprof wiring in cmd/dependency)."""
+    if getattr(args, "debug_port", None) is None:
+        return
+    from ..utils.debug import DebugServer
+
+    srv = DebugServer(port=args.debug_port)
+    srv.serve()
+    print(f"debug endpoint on {srv.url}/debug/stacks", flush=True)
 
 
 def init_logging(args, service: str) -> None:

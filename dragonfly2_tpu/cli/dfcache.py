@@ -8,7 +8,7 @@ import sys
 
 from ..daemon.storage import DaemonStorage
 from ..utils import idgen
-from .common import base_parser, init_logging
+from .common import base_parser, init_debug, init_logging
 
 
 def run(argv=None) -> int:
@@ -20,6 +20,7 @@ def run(argv=None) -> int:
     p.add_argument("--piece-size", type=int, default=4 << 20)
     args = p.parse_args(argv)
     init_logging(args, "dfcache")
+    init_debug(args)
 
     storage = DaemonStorage(args.work_dir)
 

@@ -23,7 +23,7 @@ from ..scheduler.resource import Host
 from ..source import PieceSourceFetcher
 from ..utils import idgen
 from ..utils.ping import make_host_pinger
-from .common import base_parser, init_logging
+from .common import base_parser, init_debug, init_logging
 
 
 def build(cfg: DaemonConfig, scheduler_url: str):
@@ -91,6 +91,7 @@ def run(argv=None) -> int:
     p.add_argument("-O", "--output", default=None, help="output path (--download)")
     args = p.parse_args(argv)
     init_logging(args, "dfdaemon")
+    init_debug(args)
 
     cfg = load_config(DaemonConfig, args.config)
     parts = build(cfg, args.scheduler)
@@ -154,11 +155,27 @@ def run(argv=None) -> int:
         sni.serve()
         print(f"dfdaemon: SNI proxy on :{sni.port}, trust anchor {ca_path}")
 
+    # Local control API (daemon Download RPC analog) + discovery state
+    # file so dfget finds or spawns this daemon (root.go:234-260).
+    from ..rpc.daemon_control import DaemonControlServer, write_state
+
+    # Ephemeral port: discovery is via the state file, and a fixed port
+    # would make parallel daemons on one machine collide.
+    control = DaemonControlServer(
+        parts["conductor"], parts["storage"], piece_size=cfg.piece_size,
+    )
+    control.serve()
+    # write_state uses state_path() — the SAME resolution dfget reads, so
+    # writer and reader can never disagree on the discovery location.
+    state_file = write_state(control.url)
+
     # Probe loop against the remote scheduler.
     ping = make_host_pinger()
     print(
         f"dfdaemon: serving pieces on :{parts['piece_server'].port}, "
-        f"scheduler {args.scheduler} (ctrl-c to stop)"
+        f"control {control.url} (state {state_file}), "
+        f"scheduler {args.scheduler} (ctrl-c to stop)",
+        flush=True,
     )
     try:
         while True:

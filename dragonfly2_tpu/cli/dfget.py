@@ -17,7 +17,7 @@ from ..scheduler import Evaluator, Resource, SchedulerService, Scheduling, Sched
 from ..scheduler.resource import Host
 from ..source import PieceSourceFetcher
 from ..utils import idgen
-from .common import base_parser, init_logging
+from .common import base_parser, init_debug, init_logging
 
 
 def run(argv=None) -> int:
@@ -28,8 +28,55 @@ def run(argv=None) -> int:
     p.add_argument("--work-dir", default=None, help="piece storage dir")
     p.add_argument("--recursive", action="store_true",
                    help="download a directory tree (file:// sources)")
+    p.add_argument("--daemon", action="store_true",
+                   help="download through a running dfdaemon, spawning one "
+                        "if absent (requires --scheduler for the spawn)")
+    p.add_argument("--scheduler", default=None,
+                   help="scheduler RPC URL (used when spawning a daemon)")
     args = p.parse_args(argv)
     init_logging(args, "dfget")
+    init_debug(args)
+
+    if args.daemon:
+        if args.recursive:
+            print("dfget: --daemon does not support --recursive yet",
+                  file=sys.stderr)
+            return 1
+        # Reference path: dfget talks to a long-lived daemon, spawning it
+        # when absent (cmd/dfget/cmd/root.go:234-260), so downloads share
+        # one piece store + upload server across invocations.
+        from ..rpc.daemon_control import (
+            daemon_healthy,
+            download_via_daemon,
+            ensure_daemon,
+            read_state,
+        )
+
+        state = read_state()
+        if state and daemon_healthy(state["url"]):
+            daemon_url = state["url"]
+        elif args.scheduler:
+            daemon_url = ensure_daemon(
+                args.scheduler,
+                extra_args=["--config", args.config] if args.config else None,
+            )
+        else:
+            print("dfget: no running daemon and no --scheduler to spawn one",
+                  file=sys.stderr)
+            return 1
+        result = download_via_daemon(
+            args.url, daemon_url, output=args.output,
+            piece_size=args.piece_size,
+        )
+        if not result.get("ok"):
+            print(f"dfget: daemon download failed: {result}", file=sys.stderr)
+            return 1
+        mode = "back-to-source" if result.get("back_to_source") else "p2p"
+        print(
+            f"dfget: {result['pieces']} pieces via {mode} through daemon "
+            f"in {result['cost_s']:.2f}s -> {args.output}"
+        )
+        return 0
 
     import socket
     import tempfile

@@ -12,7 +12,7 @@ from ..objectstorage import FilesystemBackend
 from ..scheduler import Evaluator, Resource, SchedulerService, Scheduling, SchedulingConfig
 from ..scheduler.resource import Host
 from ..utils import idgen
-from .common import base_parser, init_logging
+from .common import base_parser, init_debug, init_logging
 
 
 def _gateway(args):
@@ -46,6 +46,7 @@ def run(argv=None) -> int:
     p.add_argument("--work-dir", default=os.path.expanduser("~/.dragonfly/dfstore"))
     args = p.parse_args(argv)
     init_logging(args, "dfstore")
+    init_debug(args)
     gw = _gateway(args)
 
     if args.command == "put":

@@ -15,7 +15,7 @@ from ..config import ManagerConfig, load_config
 from ..jobs import JobQueue
 from ..manager import ClusterManager, DynconfigServer, ModelRegistry, Searcher
 from ..manager.registry import BlobStore
-from .common import base_parser, init_logging
+from .common import base_parser, init_debug, init_logging
 
 
 def build(cfg: ManagerConfig):
@@ -40,6 +40,7 @@ def run(argv=None) -> int:
     p.add_argument("--list-models", action="store_true")
     args = p.parse_args(argv)
     init_logging(args, "manager")
+    init_debug(args)
 
     cfg = load_config(ManagerConfig, args.config)
     parts = build(cfg)

@@ -24,7 +24,7 @@ from ..scheduler import (
     new_evaluator,
 )
 from ..utils import gc as dfgc
-from .common import base_parser, init_logging
+from .common import base_parser, init_debug, init_logging
 
 
 def build(cfg: SchedulerConfigFile):
@@ -80,6 +80,7 @@ def run(argv=None) -> int:
                    help="run an N-download synthetic swarm and exit")
     args = p.parse_args(argv)
     init_logging(args, "scheduler")
+    init_debug(args)
 
     cfg = load_config(SchedulerConfigFile, args.config)
     service, storage, runner = build(cfg)

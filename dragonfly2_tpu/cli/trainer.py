@@ -17,7 +17,7 @@ from ..config import TrainerConfigFile, load_config
 from ..manager.registry import ModelRegistry
 from ..trainer.service import TrainerService
 from ..trainer.train import TrainConfig
-from .common import base_parser, init_logging
+from .common import base_parser, init_debug, init_logging
 
 
 def run(argv=None) -> int:
@@ -30,6 +30,7 @@ def run(argv=None) -> int:
     p.add_argument("--manager-token", default=None, help="bearer token for the manager")
     args = p.parse_args(argv)
     init_logging(args, "trainer")
+    init_debug(args)
 
     cfg = load_config(TrainerConfigFile, args.config)
     if args.manager:

@@ -159,15 +159,39 @@ assert DOWNLOAD_COLUMNS_TOTAL == 1934
 assert NETWORK_TOPOLOGY_COLUMNS_TOTAL == 71
 
 
+def _go_float(value: float) -> str:
+    """Go's %v for float64: strconv.FormatFloat(v, 'g', -1, 64) —
+    shortest round-trip digits; scientific form when the decimal
+    exponent is < -4 or >= 6 (ftoa.go uses eprec=6 for the shortest
+    path), else plain form.  So 123456.78 → "123456.78" but
+    1000000 → "1e+06" and 8589934592 → "8.589934592e+09"."""
+    from decimal import Decimal
+
+    v = float(value)
+    if v == 0.0:
+        return "0"
+    # normalize(): strip non-significant trailing zeros so the mantissa
+    # carries shortest digits (1000000.0 → 1, not 10000000).
+    sign, digits, exp = Decimal(repr(v)).normalize().as_tuple()
+    sci_exp = exp + len(digits) - 1
+    prefix = "-" if sign else ""
+    if -4 <= sci_exp < 6:
+        # Python repr is plain-form throughout this range already.
+        s = repr(v)
+        return s[:-2] if s.endswith(".0") else s
+    mantissa = str(digits[0])
+    if len(digits) > 1:
+        mantissa += "." + "".join(map(str, digits[1:]))
+    return (
+        f"{prefix}{mantissa}e{'+' if sci_exp >= 0 else '-'}{abs(sci_exp):02d}"
+    )
+
+
 def _fmt(value, typ) -> str:
     if typ is str:
         return value or ""
     if typ is float:
-        # Shortest round-trip, like Go's %v (strconv 'g', prec -1):
-        # repr() never truncates (f"{x:g}" clips to 6 significant digits
-        # — 123456.78 → "123457"), and integral floats render bare.
-        s = repr(float(value))
-        return s[:-2] if s.endswith(".0") else s
+        return _go_float(value)
     return str(int(value))
 
 
@@ -306,8 +330,7 @@ def write_download_csv(records: Iterable[Download], path: str) -> int:
 
 
 def read_download_csv(path: str) -> List[Download]:
-    with open(path, newline="") as f:
-        return [download_from_row(row) for row in csv.reader(f) if row]
+    return list(iter_download_csv(path))
 
 
 def write_topology_csv(records: Iterable[NetworkTopologyRecord], path: str) -> int:
@@ -321,8 +344,7 @@ def write_topology_csv(records: Iterable[NetworkTopologyRecord], path: str) -> i
 
 
 def read_topology_csv(path: str) -> List[NetworkTopologyRecord]:
-    with open(path, newline="") as f:
-        return [topology_from_row(row) for row in csv.reader(f) if row]
+    return list(iter_topology_csv(path))
 
 
 def iter_download_csv(path: str):

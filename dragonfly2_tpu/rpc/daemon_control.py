@@ -1,0 +1,209 @@
+"""Daemon local control API + the dfget→daemon contract.
+
+Reference: client/daemon/rpcserver serves a Download RPC on a local unix
+socket and dfget spawns the daemon when absent
+(cmd/dfget/cmd/root.go:234-260 checkAndSpawnDaemon).  TPU-build shape:
+a loopback HTTP control endpoint —
+
+  GET  /healthy                    liveness {ok, pid}
+  POST /download  {url, output?, piece_size?} → download result
+
+— plus a state file (daemon.json under the daemon's storage dir, or
+$DF_DAEMON_STATE) advertising the control URL so dfget can find a
+running daemon or know to spawn one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+from typing import Optional, Tuple
+
+from ._server import ThreadedHTTPService
+
+STATE_ENV = "DF_DAEMON_STATE"
+
+
+def state_path() -> str:
+    """ONE discovery path shared by writer (dfdaemon) and readers (dfget,
+    ensure_daemon): $DF_DAEMON_STATE, else a user-scoped default.  Both
+    sides MUST use this function — a storage-dir-relative location would
+    desynchronize discovery for custom configs."""
+    return os.environ.get(
+        STATE_ENV, os.path.expanduser("~/.dragonfly2-tpu/daemon.json")
+    )
+
+
+def write_state(url: str, path: Optional[str] = None) -> str:
+    path = path or state_path()
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"url": url, "pid": os.getpid()}, f)
+    return path
+
+
+def read_state(path: Optional[str] = None) -> Optional[dict]:
+    try:
+        with open(path or state_path()) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class DaemonControlServer:
+    """Loopback-only control surface over the daemon composition."""
+
+    def __init__(
+        self,
+        conductor,
+        storage,
+        *,
+        piece_size: int = 4 << 20,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        outer_piece_size = piece_size
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthy":
+                    self._json(200, {"ok": True, "pid": os.getpid()})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/download":
+                    self._json(404, {"error": "not found"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    url = req["url"]
+                    piece_size = int(req.get("piece_size") or outer_piece_size)
+                    source = conductor.source_fetcher
+                    content_length = None
+                    if source is not None and hasattr(source, "content_length"):
+                        content_length = source.content_length(url)
+                    result = conductor.download(
+                        url, piece_size=piece_size,
+                        content_length=content_length,
+                    )
+                    out = {
+                        "ok": result.ok,
+                        "task_id": result.task_id,
+                        "pieces": result.pieces,
+                        "bytes": result.bytes,
+                        "back_to_source": result.back_to_source,
+                        "cost_s": result.cost_s,
+                    }
+                    output = req.get("output")
+                    if result.ok and output:
+                        # Same-machine contract (dfget and the daemon share
+                        # the host, like the reference's unix socket).
+                        with open(output, "wb") as f:
+                            f.write(storage.read_task_bytes(result.task_id))
+                        out["output"] = output
+                    self._json(200 if result.ok else 502, out)
+                except (KeyError, ValueError) as exc:
+                    self._json(400, {"error": str(exc)})
+                except OSError as exc:
+                    self._json(500, {"error": str(exc)})
+
+        self._svc = ThreadedHTTPService(Handler, host, port, "daemon-control")
+        self.address: Tuple[str, int] = self._svc.address
+
+    @property
+    def url(self) -> str:
+        return self._svc.url
+
+    def serve(self) -> None:
+        self._svc.serve()
+
+    def stop(self) -> None:
+        self._svc.stop()
+
+
+# -- dfget side (checkAndSpawnDaemon) ----------------------------------------
+
+
+def daemon_healthy(url: str, timeout: float = 2.0) -> bool:
+    try:
+        with urllib.request.urlopen(url + "/healthy", timeout=timeout) as r:
+            return bool(json.loads(r.read()).get("ok"))
+    except Exception:  # noqa: BLE001 — any failure means "not healthy"
+        return False
+
+
+def download_via_daemon(
+    url: str, daemon_url: str, *, output: Optional[str] = None,
+    piece_size: Optional[int] = None, timeout: float = 600.0,
+) -> dict:
+    payload = {"url": url}
+    if output:
+        payload["output"] = os.path.abspath(output)
+    if piece_size:
+        payload["piece_size"] = piece_size
+    req = urllib.request.Request(
+        daemon_url + "/download", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as exc:
+        # Error statuses (400/500/502) still carry the JSON result — the
+        # caller's ok-check handles them, not a raw traceback.
+        try:
+            return json.loads(exc.read())
+        except (ValueError, OSError):
+            return {"ok": False, "error": f"HTTP {exc.code}"}
+
+
+def ensure_daemon(
+    scheduler_url: str,
+    *,
+    spawn_timeout: float = 20.0,
+    extra_args: Optional[list] = None,
+) -> str:
+    """→ control URL of a healthy daemon, spawning one detached if
+    needed (root.go:251 checkAndSpawnDaemon)."""
+    import subprocess
+    import sys
+    import time
+
+    state = read_state()
+    if state and daemon_healthy(state["url"]):
+        return state["url"]
+    log_path = state_path() + ".spawn.log"
+    os.makedirs(os.path.dirname(os.path.abspath(log_path)) or ".", exist_ok=True)
+    with open(log_path, "ab") as log:
+        subprocess.Popen(
+            [sys.executable, "-m", "dragonfly2_tpu.cli.dfdaemon",
+             "--scheduler", scheduler_url, *(extra_args or [])],
+            stdout=log, stderr=log,
+            start_new_session=True,  # outlives dfget, like the reference
+        )
+    deadline = time.time() + spawn_timeout
+    while time.time() < deadline:
+        state = read_state()
+        if state and daemon_healthy(state["url"]):
+            return state["url"]
+        time.sleep(0.2)
+    raise TimeoutError(
+        f"daemon did not become healthy within {spawn_timeout}s "
+        f"(spawn log: {log_path})"
+    )

@@ -117,6 +117,24 @@ class TestRoundtrip:
         trow = topology_to_row(make_topology())
         assert topology_to_row(topology_from_row(trow)) == trow
 
+    def test_go_float_formatting(self):
+        """strconv.FormatFloat(v, 'g', -1, 64) behavior: e-form when the
+        decimal exponent is < -4 or >= 6, shortest digits either way."""
+        from dragonfly2_tpu.records.csv_compat import _go_float
+
+        assert _go_float(0.0) == "0"
+        assert _go_float(1.5) == "1.5"
+        assert _go_float(123456.78) == "123456.78"
+        assert _go_float(100000.0) == "100000"
+        assert _go_float(1000000.0) == "1e+06"
+        assert _go_float(8589934592.0) == "8.589934592e+09"
+        assert _go_float(0.0001) == "0.0001"
+        assert _go_float(0.00001) == "1e-05"
+        assert _go_float(-2500000.5) == "-2.5000005e+06"
+        # Every form round-trips through the reader.
+        for v in (1e6, 8589934592.0, 1e-5, -2500000.5, 123456.78):
+            assert float(_go_float(v)) == v
+
     def test_precision_survives_roundtrip(self):
         """%g-style truncation and the int(float()) detour both corrupt
         real values — full precision must survive."""

@@ -1,0 +1,185 @@
+"""dfget→daemon contract: control API, state-file discovery, auto-spawn,
+and the debug endpoint."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+import pytest
+
+from dragonfly2_tpu.rpc.daemon_control import (
+    DaemonControlServer,
+    daemon_healthy,
+    download_via_daemon,
+    read_state,
+    write_state,
+)
+
+from tests.test_daemon import PIECE, _Swarm
+
+
+class TestControlServer:
+    def test_healthy_and_download(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        swarm.origin.content_length = lambda u: 3 * PIECE
+        d = swarm.daemons[0]
+        srv = DaemonControlServer(d.conductor, d.storage, piece_size=PIECE)
+        srv.serve()
+        try:
+            assert daemon_healthy(srv.url)
+            out_file = str(tmp_path / "via-daemon.bin")
+            result = download_via_daemon(
+                "https://origin/ctl-blob", srv.url, output=out_file,
+                piece_size=PIECE,
+            )
+            assert result["ok"] and result["pieces"] == 3
+            expected = b"".join(
+                swarm.origin.content("https://origin/ctl-blob", n)
+                for n in range(3)
+            )
+            with open(out_file, "rb") as f:
+                assert f.read() == expected
+        finally:
+            srv.stop()
+
+    def test_state_file_roundtrip(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "daemon.json")
+        monkeypatch.setenv("DF_DAEMON_STATE", path)
+        write_state("http://127.0.0.1:1234")
+        state = read_state()
+        assert state["url"] == "http://127.0.0.1:1234"
+        assert state["pid"] == os.getpid()
+        assert not daemon_healthy(state["url"])  # nothing listening
+
+    def test_failed_download_returns_dict_not_traceback(self, tmp_path):
+        """Error statuses carry the JSON result back to the caller — the
+        dfget ok-check path must be reachable."""
+        swarm = _Swarm(tmp_path, n_hosts=1)
+        d = swarm.daemons[0]
+        d.conductor.source_fetcher = None  # downloads will fail
+        srv = DaemonControlServer(d.conductor, d.storage, piece_size=PIECE)
+        srv.serve()
+        try:
+            result = download_via_daemon(
+                "https://origin/doomed", srv.url, piece_size=PIECE
+            )
+            assert result["ok"] is False
+        finally:
+            srv.stop()
+
+    def test_bad_request_rejected(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=1)
+        d = swarm.daemons[0]
+        srv = DaemonControlServer(d.conductor, d.storage)
+        srv.serve()
+        try:
+            req = urllib.request.Request(
+                srv.url + "/download", data=b"{}",
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=5)
+            assert exc.value.code == 400
+        finally:
+            srv.stop()
+
+
+class TestDfgetDaemonSpawn:
+    def test_spawn_download_reuse(self, tmp_path):
+        """dfget --daemon: spawns a dfdaemon against a real scheduler
+        process, downloads through it, and a second dfget reuses the SAME
+        daemon (no second spawn)."""
+        env = {
+            **os.environ,
+            "PYTHONPATH": "/root/repo",
+            "DF_DAEMON_STATE": str(tmp_path / "daemon.json"),
+        }
+        sched_cfg = tmp_path / "sched.yaml"
+        sched_cfg.write_text(
+            f"storage:\n  dir: {tmp_path}/records\n"
+            "server:\n  host: 127.0.0.1\n  port: 0\n"
+        )
+        launcher = (
+            "import sys\n"
+            "from dragonfly2_tpu.cli.scheduler import build\n"
+            "from dragonfly2_tpu.config import SchedulerConfigFile, load_config\n"
+            "from dragonfly2_tpu.rpc import SchedulerHTTPServer\n"
+            "cfg = load_config(SchedulerConfigFile, sys.argv[1])\n"
+            "service, storage, runner = build(cfg)\n"
+            "srv = SchedulerHTTPServer(service, port=0)\nsrv.serve()\n"
+            "print('READY', srv.url, flush=True)\n"
+            "import time; time.sleep(120)\n"
+        )
+        sched = subprocess.Popen(
+            [sys.executable, "-c", launcher, str(sched_cfg)],
+            stdout=subprocess.PIPE, text=True, env=env,
+        )
+        daemon_pid = None
+        try:
+            sched_url = sched.stdout.readline().split()[1]
+            daemon_cfg = tmp_path / "daemon.yaml"
+            daemon_cfg.write_text(
+                f"storage:\n  dir: {tmp_path}/dstore\n"
+                "probe_interval_s: 3600\n"
+            )
+            blob = tmp_path / "origin.bin"
+            blob.write_bytes(os.urandom(300_000))
+            out1 = str(tmp_path / "out1.bin")
+            r = subprocess.run(
+                [sys.executable, "-m", "dragonfly2_tpu.cli.dfget",
+                 f"file://{blob}", "-O", out1, "--daemon",
+                 "--scheduler", sched_url, "--config", str(daemon_cfg),
+                 "--piece-size", str(64 * 1024)],
+                capture_output=True, text=True, env=env, timeout=60,
+            )
+            assert r.returncode == 0, r.stderr
+            assert "through daemon" in r.stdout
+            with open(out1, "rb") as f:
+                assert f.read() == blob.read_bytes()
+            state = json.loads((tmp_path / "daemon.json").read_text())
+            daemon_pid = state["pid"]
+            # Second dfget: reuses the running daemon (same pid in state).
+            out2 = str(tmp_path / "out2.bin")
+            r2 = subprocess.run(
+                [sys.executable, "-m", "dragonfly2_tpu.cli.dfget",
+                 f"file://{blob}", "-O", out2, "--daemon",
+                 "--piece-size", str(64 * 1024)],
+                capture_output=True, text=True, env=env, timeout=60,
+            )
+            assert r2.returncode == 0, r2.stderr
+            assert json.loads(
+                (tmp_path / "daemon.json").read_text()
+            )["pid"] == daemon_pid
+            with open(out2, "rb") as f:
+                assert f.read() == blob.read_bytes()
+        finally:
+            sched.kill()
+            if daemon_pid:
+                try:
+                    os.kill(daemon_pid, 9)
+                except OSError:
+                    pass
+
+
+class TestDebugEndpoint:
+    def test_stacks_stats_profile(self):
+        from dragonfly2_tpu.utils.debug import DebugServer
+
+        srv = DebugServer()
+        srv.serve()
+        try:
+            with urllib.request.urlopen(srv.url + "/debug/stacks", timeout=5) as r:
+                body = r.read().decode()
+            assert "MainThread" in body and "---" in body
+            with urllib.request.urlopen(srv.url + "/debug/stats", timeout=5) as r:
+                stats = json.loads(r.read())
+            assert stats["threads"] >= 1 and "gc_counts" in stats
+            with urllib.request.urlopen(
+                srv.url + "/debug/profile?seconds=0.2", timeout=10
+            ) as r:
+                assert b"cumulative" in r.read()
+        finally:
+            srv.stop()
