@@ -46,24 +46,28 @@ def run(argv=None) -> int:
         # when absent (cmd/dfget/cmd/root.go:234-260), so downloads share
         # one piece store + upload server across invocations.
         from ..rpc.daemon_control import (
-            daemon_healthy,
             download_via_daemon,
             ensure_daemon,
-            read_state,
+            find_healthy_daemon,
         )
 
-        state = read_state()
-        if state and daemon_healthy(state["url"]):
-            daemon_url = state["url"]
-        elif args.scheduler:
-            daemon_url = ensure_daemon(
-                args.scheduler,
-                extra_args=["--config", args.config] if args.config else None,
-            )
+        if args.scheduler:
+            try:
+                daemon_url = ensure_daemon(
+                    args.scheduler,
+                    extra_args=["--config", args.config] if args.config else None,
+                )
+            except TimeoutError as exc:
+                print(f"dfget: {exc}", file=sys.stderr)
+                return 1
         else:
-            print("dfget: no running daemon and no --scheduler to spawn one",
-                  file=sys.stderr)
-            return 1
+            daemon_url = find_healthy_daemon()
+            if daemon_url is None:
+                print(
+                    "dfget: no running daemon and no --scheduler to spawn one",
+                    file=sys.stderr,
+                )
+                return 1
         result = download_via_daemon(
             args.url, daemon_url, output=args.output,
             piece_size=args.piece_size,

@@ -165,20 +165,34 @@ def _go_float(value: float) -> str:
     exponent is < -4 or >= 6 (ftoa.go uses eprec=6 for the shortest
     path), else plain form.  So 123456.78 → "123456.78" but
     1000000 → "1e+06" and 8589934592 → "8.589934592e+09"."""
-    from decimal import Decimal
+    import math
 
     v = float(value)
     if v == 0.0:
         return "0"
-    # normalize(): strip non-significant trailing zeros so the mantissa
-    # carries shortest digits (1000000.0 → 1, not 10000000).
-    sign, digits, exp = Decimal(repr(v)).normalize().as_tuple()
-    sci_exp = exp + len(digits) - 1
-    prefix = "-" if sign else ""
+    if not math.isfinite(v):  # Go fmt: +Inf / -Inf / NaN
+        return "NaN" if math.isnan(v) else ("+Inf" if v > 0 else "-Inf")
+    # Fast path: derive the decimal exponent from repr() without Decimal
+    # (this runs per float across 1934-column rows).
+    s = repr(v)
+    mant_str, _, exp_str = s.partition("e")
+    if exp_str:
+        sci_exp = int(exp_str) + (len(mant_str.split(".")[0].lstrip("-")) - 1)
+    else:
+        digits_str = mant_str.lstrip("-")
+        int_part, _, frac = digits_str.partition(".")
+        if int_part != "0":
+            sci_exp = len(int_part) - 1
+        else:
+            leading_zeros = len(frac) - len(frac.lstrip("0"))
+            sci_exp = -(leading_zeros + 1)
     if -4 <= sci_exp < 6:
         # Python repr is plain-form throughout this range already.
-        s = repr(v)
         return s[:-2] if s.endswith(".0") else s
+    from decimal import Decimal
+
+    sign, digits, _exp = Decimal(s).normalize().as_tuple()
+    prefix = "-" if sign else ""
     mantissa = str(digits[0])
     if len(digits) > 1:
         mantissa += "." + "".join(map(str, digits[1:]))

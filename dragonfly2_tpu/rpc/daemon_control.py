@@ -40,8 +40,12 @@ def state_path() -> str:
 def write_state(url: str, path: Optional[str] = None) -> str:
     path = path or state_path()
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
-    with open(path, "w") as f:
+    # Atomic publish: a concurrent reader must never see a half-written
+    # file (JSONDecodeError → spurious re-spawn).
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
         json.dump({"url": url, "pid": os.getpid()}, f)
+    os.replace(tmp, path)
     return path
 
 
@@ -120,8 +124,10 @@ class DaemonControlServer:
                     self._json(200 if result.ok else 502, out)
                 except (KeyError, ValueError) as exc:
                     self._json(400, {"error": str(exc)})
-                except OSError as exc:
-                    self._json(500, {"error": str(exc)})
+                except Exception as exc:  # noqa: BLE001 — wire boundary:
+                    # any failure (scheduler RpcError, storage, ...) must
+                    # reach the client as JSON, not a closed socket.
+                    self._json(500, {"ok": False, "error": str(exc)})
 
         self._svc = ThreadedHTTPService(Handler, host, port, "daemon-control")
         self.address: Tuple[str, int] = self._svc.address
@@ -173,6 +179,15 @@ def download_via_daemon(
             return {"ok": False, "error": f"HTTP {exc.code}"}
 
 
+def find_healthy_daemon() -> Optional[str]:
+    """→ control URL of a running healthy daemon, else None — the ONE
+    discovery check (dfget and ensure_daemon share it)."""
+    state = read_state()
+    if state and daemon_healthy(state["url"]):
+        return state["url"]
+    return None
+
+
 def ensure_daemon(
     scheduler_url: str,
     *,
@@ -180,29 +195,41 @@ def ensure_daemon(
     extra_args: Optional[list] = None,
 ) -> str:
     """→ control URL of a healthy daemon, spawning one detached if
-    needed (root.go:251 checkAndSpawnDaemon)."""
+    needed (root.go:251 checkAndSpawnDaemon).
+
+    Spawning is serialized through a lock file (the reference does the
+    same): two concurrent dfgets must not each spawn a daemon, orphaning
+    the one that loses the state-file race."""
+    import fcntl
     import subprocess
     import sys
     import time
 
-    state = read_state()
-    if state and daemon_healthy(state["url"]):
-        return state["url"]
-    log_path = state_path() + ".spawn.log"
-    os.makedirs(os.path.dirname(os.path.abspath(log_path)) or ".", exist_ok=True)
-    with open(log_path, "ab") as log:
-        subprocess.Popen(
-            [sys.executable, "-m", "dragonfly2_tpu.cli.dfdaemon",
-             "--scheduler", scheduler_url, *(extra_args or [])],
-            stdout=log, stderr=log,
-            start_new_session=True,  # outlives dfget, like the reference
-        )
-    deadline = time.time() + spawn_timeout
-    while time.time() < deadline:
-        state = read_state()
-        if state and daemon_healthy(state["url"]):
-            return state["url"]
-        time.sleep(0.2)
+    url = find_healthy_daemon()
+    if url:
+        return url
+    lock_path = state_path() + ".lock"
+    os.makedirs(os.path.dirname(os.path.abspath(lock_path)) or ".", exist_ok=True)
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        # The winner of the lock may have spawned while we waited.
+        url = find_healthy_daemon()
+        if url:
+            return url
+        log_path = state_path() + ".spawn.log"
+        with open(log_path, "ab") as log:
+            subprocess.Popen(
+                [sys.executable, "-m", "dragonfly2_tpu.cli.dfdaemon",
+                 "--scheduler", scheduler_url, *(extra_args or [])],
+                stdout=log, stderr=log,
+                start_new_session=True,  # outlives dfget, like the reference
+            )
+        deadline = time.time() + spawn_timeout
+        while time.time() < deadline:
+            url = find_healthy_daemon()
+            if url:
+                return url
+            time.sleep(0.2)
     raise TimeoutError(
         f"daemon did not become healthy within {spawn_timeout}s "
         f"(spawn log: {log_path})"

@@ -438,6 +438,126 @@ class TestManagerGRPC:
             server.stop()
 
 
+class TestFullGRPCLoop:
+    def test_four_process_architecture_over_grpc(self, tmp_path, cluster):
+        """The complete records → train → registry → activation →
+        evaluator loop with EVERY control-plane arrow on binary gRPC:
+        manager, scheduler, and trainer in their own OS processes."""
+        import os
+        import subprocess
+        import sys
+        import time
+
+        env = {**os.environ, "PYTHONPATH": os.getcwd()}
+        procs = []
+
+        def spawn(code, *argv):
+            proc = subprocess.Popen(
+                [sys.executable, "-c", code, *argv],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env,
+            )
+            procs.append(proc)
+            import select
+
+            ready, _, _ = select.select([proc.stdout], [], [], 30)
+            assert ready, "child did not print READY within 30s"
+            line = proc.stdout.readline().strip()
+            assert line.startswith("READY"), (
+                line,
+                proc.stderr.read()[:500] if proc.poll() is not None else "",
+            )
+            return proc, line.split()[1]
+
+        manager_code = (
+            "import sys, time\n"
+            "from dragonfly2_tpu.manager import ClusterManager, ModelRegistry\n"
+            "from dragonfly2_tpu.manager.registry import BlobStore\n"
+            "from dragonfly2_tpu.rpc.grpc_transport import ManagerGRPCServer\n"
+            "reg = ModelRegistry(BlobStore(sys.argv[1]), db_path=sys.argv[1]+'/m.db')\n"
+            "srv = ManagerGRPCServer(reg, ClusterManager())\n"
+            "srv.serve(); print('READY', srv.target, flush=True); time.sleep(180)\n"
+        )
+        scheduler_code = (
+            "import sys, time\n"
+            "from dragonfly2_tpu.records.storage import Storage\n"
+            "from dragonfly2_tpu.rpc.grpc_transport import SchedulerGRPCServer\n"
+            "from dragonfly2_tpu.scheduler import Evaluator, Resource, SchedulerService, Scheduling, SchedulingConfig\n"
+            "res = Resource()\n"
+            "svc = SchedulerService(res, Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)), Storage(sys.argv[1], buffer_size=1))\n"
+            "srv = SchedulerGRPCServer(svc)\n"
+            "srv.serve(); print('READY', srv.target, flush=True); time.sleep(180)\n"
+        )
+        trainer_code = (
+            "import sys, time\n"
+            "from dragonfly2_tpu.rpc.grpc_transport import GRPCRemoteRegistry, TrainerGRPCServer\n"
+            "from dragonfly2_tpu.trainer.service import TrainerService\n"
+            "from dragonfly2_tpu.trainer.train import TrainConfig\n"
+            "svc = TrainerService(GRPCRemoteRegistry(sys.argv[1]), data_dir=sys.argv[2],\n"
+            "    train_config=TrainConfig(epochs=6, learning_rate=3e-3, warmup_steps=10))\n"
+            "srv = TrainerGRPCServer(svc)\n"
+            "srv.serve(); print('READY', srv.target, flush=True); time.sleep(300)\n"
+        )
+
+        try:
+            mproc, mtarget = spawn(manager_code, str(tmp_path / "manager"))
+            sproc, starget = spawn(scheduler_code, str(tmp_path / "records"))
+            tproc, ttarget = spawn(trainer_code, mtarget, str(tmp_path / "staged"))
+
+            # Daemons in this process: control plane over gRPC, pieces HTTP.
+            origin = WireOrigin()
+            nodes = [GRPCNode(i, starget, tmp_path, origin) for i in range(3)]
+            url_a = "https://origin/grpc-wire-a"
+            r0 = nodes[0].conductor.download(
+                url_a, piece_size=PIECE, content_length=4 * PIECE
+            )
+            assert r0.ok
+            for i in (1, 2):
+                r = nodes[i].conductor.download(url_a, piece_size=PIECE)
+                assert r.ok and not r.back_to_source
+
+            # Dataset → trainer over the gRPC Train stream.
+            from dragonfly2_tpu.records.columnar import ColumnarWriter
+            from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
+
+            shard = tmp_path / "synth.dfc"
+            with ColumnarWriter(str(shard), DOWNLOAD_COLUMNS) as w:
+                w.append(cluster.generate_feature_rows(2000, seed=11))
+            tclient = GRPCTrainerClient(ttarget, timeout=300)
+            key = tclient.train(
+                ip="10.0.0.1", hostname="sched", scheduler_id="sched-grpc",
+                download_shards=[str(shard)],
+            )
+            for _ in range(900):
+                status = tclient.run_status(key)
+                if status["done"]:
+                    break
+                time.sleep(0.1)
+            assert status["done"] and not status["error"], status
+
+            # Models live in the MANAGER process; activate + pull over gRPC.
+            from dragonfly2_tpu.rpc.grpc_transport import GRPCRemoteRegistry
+            from dragonfly2_tpu.scheduler import MLEvaluator, ModelSubscriber
+
+            registry = GRPCRemoteRegistry(mtarget)
+            models = registry.list(
+                scheduler_id="sched-grpc", name="parent-bandwidth-mlp"
+            )
+            assert len(models) == 1
+            registry.activate(models[0].id)
+            ev = MLEvaluator()
+            sub = ModelSubscriber(registry, ev, scheduler_id="sched-grpc")
+            assert sub.refresh() is True
+            assert ev.has_model
+            for n in nodes:
+                n.stop()
+            tclient.close()
+            registry.close()
+        finally:
+            for p in procs:
+                p.terminate()
+
+
 class TestTrainerGRPC:
     def test_train_stream_end_to_end(self, tmp_path, cluster):
         """Announcer-shaped upload over a real gRPC client stream: train
