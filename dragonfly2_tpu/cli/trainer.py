@@ -33,15 +33,24 @@ def run(argv=None) -> int:
     init_debug(args)
 
     cfg = load_config(TrainerConfigFile, args.config)
-    if args.manager:
+    manager_addr = args.manager or cfg.manager_addr
+    if manager_addr and manager_addr.startswith("grpc://"):
+        from ..rpc.grpc_transport import GRPCRemoteRegistry
+
+        registry = GRPCRemoteRegistry(
+            manager_addr[len("grpc://"):], token=args.manager_token or ""
+        )
+    elif manager_addr:
         from ..rpc import RemoteRegistry
 
-        registry = RemoteRegistry(args.manager, token=args.manager_token)
+        registry = RemoteRegistry(manager_addr, token=args.manager_token)
     else:
         registry = ModelRegistry()
     service = TrainerService(
         registry,
-        data_dir=None,
+        # --train-once reads local shards (no staging); serve mode ingests
+        # remote uploads into data_dir.
+        data_dir=None if args.train_once else cfg.data_dir,
         train_config=TrainConfig(
             epochs=cfg.training.epochs,
             learning_rate=cfg.training.learning_rate,
@@ -77,11 +86,35 @@ def run(argv=None) -> int:
             print(f"trainer: registered {m.name} v{m.version} ({m.type})")
         return 0
 
-    print("trainer: serving (waiting for dataset uploads; ctrl-c to stop)")
+    # Serve mode: real ingest servers (trainer/rpcserver analog) — HTTP
+    # chunked uploads, plus the gRPC Train client-stream when configured.
+    from ..rpc import TrainerHTTPServer
+
+    http_server = TrainerHTTPServer(
+        service, host=cfg.server.host, port=cfg.server.port
+    )
+    http_server.serve()
+    grpc_server = None
+    if cfg.server.grpc_port >= 0:
+        from ..rpc.grpc_transport import TrainerGRPCServer
+
+        grpc_server = TrainerGRPCServer(
+            service, host=cfg.server.host, port=cfg.server.grpc_port
+        )
+        grpc_server.serve()
+    print(
+        f"trainer: ingest on {http_server.url}"
+        + (f" and grpc on {grpc_server.target}" if grpc_server else "")
+        + f", staging in {cfg.data_dir} (ctrl-c to stop)",
+        flush=True,
+    )
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        http_server.stop()
+        if grpc_server is not None:
+            grpc_server.stop()
         return 0
 
 

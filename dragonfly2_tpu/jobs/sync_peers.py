@@ -120,6 +120,10 @@ class SyncPeers:
                     rec.active = False
                     rec.updated_at = now
         self.broker.prune(max_age_s=self.prune_age_s)
+        from ..rpc.metrics import SYNC_PEERS_ACTIVE, SYNC_PEERS_ROUNDS_TOTAL
+
+        SYNC_PEERS_ROUNDS_TOTAL.inc()
+        SYNC_PEERS_ACTIVE.set(len(self.list_peers(active_only=True)))
         return answered
 
     def _merge(self, scheduler_id: str, hosts: List[Dict]) -> None:

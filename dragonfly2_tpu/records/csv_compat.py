@@ -177,7 +177,8 @@ def _go_float(value: float) -> str:
     s = repr(v)
     mant_str, _, exp_str = s.partition("e")
     if exp_str:
-        sci_exp = int(exp_str) + (len(mant_str.split(".")[0].lstrip("-")) - 1)
+        # repr e-notation is normalized to one integer digit.
+        sci_exp = int(exp_str)
     else:
         digits_str = mant_str.lstrip("-")
         int_part, _, frac = digits_str.partition(".")

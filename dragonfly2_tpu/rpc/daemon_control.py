@@ -121,12 +121,22 @@ class DaemonControlServer:
                         with open(output, "wb") as f:
                             f.write(storage.read_task_bytes(result.task_id))
                         out["output"] = output
+                    # Counted AFTER the output write: a failed write is a
+                    # failed download, and lands in the except below.
+                    from .metrics import DAEMON_CONTROL_DOWNLOADS
+
+                    DAEMON_CONTROL_DOWNLOADS.inc(
+                        result="success" if result.ok else "failure"
+                    )
                     self._json(200 if result.ok else 502, out)
                 except (KeyError, ValueError) as exc:
                     self._json(400, {"error": str(exc)})
                 except Exception as exc:  # noqa: BLE001 — wire boundary:
                     # any failure (scheduler RpcError, storage, ...) must
                     # reach the client as JSON, not a closed socket.
+                    from .metrics import DAEMON_CONTROL_DOWNLOADS
+
+                    DAEMON_CONTROL_DOWNLOADS.inc(result="failure")
                     self._json(500, {"ok": False, "error": str(exc)})
 
         self._svc = ThreadedHTTPService(Handler, host, port, "daemon-control")

@@ -167,17 +167,38 @@ class SchedulerGRPCServer:
         self.address: Tuple[str, int] = (host, bound)
 
     def _behavior(self, method: str, resp_cls):
+        from .metrics import GRPC_REQUESTS_TOTAL
+
         def handle(request, context):
-            req = proto_to_dict(request)
-            if method == "sync_probes_finished":
-                req = _from_wire_probe_results(req)
+            # Exactly ONE count per call, whatever the outcome — error
+            # spikes must be visible in rpc_grpc_requests_total.
+            counted = [False]
+
+            def count(code: str) -> None:
+                if not counted[0]:
+                    counted[0] = True
+                    GRPC_REQUESTS_TOTAL.inc(
+                        service="scheduler", method=method, code=code
+                    )
+
             try:
-                out = self.adapter.dispatch(method, req)
-            except KeyError as exc:
-                context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
-            except (ValueError, TypeError) as exc:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
-            return dict_to_proto(out, resp_cls)
+                req = proto_to_dict(request)
+                if method == "sync_probes_finished":
+                    req = _from_wire_probe_results(req)
+                try:
+                    out = self.adapter.dispatch(method, req)
+                except KeyError as exc:
+                    count("NOT_FOUND")
+                    context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+                except (ValueError, TypeError) as exc:
+                    count("INVALID_ARGUMENT")
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+                resp = dict_to_proto(out, resp_cls)
+            except Exception:
+                count("UNKNOWN")  # no-op on the already-counted abort paths
+                raise
+            count("OK")
+            return resp
 
         return handle
 

@@ -1,0 +1,29 @@
+"""Wire-layer metrics (reference: grpc_prometheus interceptors on every
+gRPC server + the rate-limit interceptor, pkg/rpc/interceptor.go).
+
+Counters shared by the gRPC servers and the rate limiter; the per-service
+metric sets (scheduler/trainer) stay in their own modules.
+"""
+
+from __future__ import annotations
+
+from ..utils.metrics import default_registry as _reg
+
+GRPC_REQUESTS_TOTAL = _reg.counter(
+    "rpc_grpc_requests_total", "gRPC requests handled",
+    ["service", "method", "code"],
+)
+RATE_LIMITED_TOTAL = _reg.counter(
+    "rpc_rate_limited_total", "Requests rejected by the rate limiter",
+    ["transport"],
+)
+SYNC_PEERS_ROUNDS_TOTAL = _reg.counter(
+    "manager_sync_peers_rounds_total", "sync_peers rounds completed"
+)
+SYNC_PEERS_ACTIVE = _reg.gauge(
+    "manager_sync_peers_active_peers", "Active peers in the last merge"
+)
+DAEMON_CONTROL_DOWNLOADS = _reg.counter(
+    "daemon_control_downloads_total", "Downloads via the control API",
+    ["result"],
+)

@@ -50,6 +50,9 @@ class RateLimitInterceptor(grpc.ServerInterceptor):
     def intercept_service(self, continuation, handler_call_details):
         if self.bucket.take():
             return continuation(handler_call_details)
+        from .metrics import RATE_LIMITED_TOTAL
+
+        RATE_LIMITED_TOTAL.inc(transport="grpc")
 
         def reject(request, context):
             context.abort(

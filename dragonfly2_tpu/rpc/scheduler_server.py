@@ -267,6 +267,9 @@ class SchedulerHTTPServer:
             def do_POST(self):
                 if rate_limit is not None and not rate_limit.take():
                     # interceptor.go rate limiter → 429 on the JSON wire.
+                    from .metrics import RATE_LIMITED_TOTAL
+
+                    RATE_LIMITED_TOTAL.inc(transport="http")
                     body = json.dumps(
                         {"error": "rate limit exceeded",
                          "code": int(Code.RESOURCE_EXHAUSTED)}

@@ -196,6 +196,105 @@ class TestSchedulerGRPC:
         )
 
 
+class TestWireMetrics:
+    def test_grpc_and_ratelimit_counters(self):
+        from dragonfly2_tpu.rpc.metrics import (
+            GRPC_REQUESTS_TOTAL,
+            RATE_LIMITED_TOTAL,
+        )
+        from dragonfly2_tpu.rpc.ratelimit import TokenBucket
+
+        resource = Resource()
+        service = SchedulerService(
+            resource,
+            Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+            None,
+            NetworkTopology(resource.host_manager),
+        )
+        server = SchedulerGRPCServer(
+            service, rate_limit=TokenBucket(qps=0.001, burst=2)
+        )
+        server.serve()
+        try:
+            ok_before = GRPC_REQUESTS_TOTAL.value(
+                service="scheduler", method="announce_host", code="OK"
+            )
+            rl_before = RATE_LIMITED_TOTAL.value(transport="grpc")
+            client = GRPCRemoteScheduler(server.target)
+            h = Host(id="m1", hostname="m1", ip="127.0.0.1", download_port=1)
+            client.announce_host(h)
+            client.announce_host(
+                Host(id="m2", hostname="m2", ip="127.0.0.1", download_port=1)
+            )
+            with pytest.raises(RPCError):
+                client.announce_host(
+                    Host(id="m3", hostname="m3", ip="127.0.0.1", download_port=1)
+                )
+            assert GRPC_REQUESTS_TOTAL.value(
+                service="scheduler", method="announce_host", code="OK"
+            ) == ok_before + 2
+            assert RATE_LIMITED_TOTAL.value(transport="grpc") == rl_before + 1
+            client.close()
+        finally:
+            server.stop()
+
+
+class TestTrainerCLIServe:
+    def test_serve_mode_starts_both_transports(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+        import time
+        import urllib.request
+        import json as _json
+
+        cfgp = tmp_path / "trainer.yaml"
+        cfgp.write_text(
+            f"data_dir: {tmp_path}/staging\n"
+            "server:\n  host: 127.0.0.1\n  port: 0\n  grpc_port: 0\n"
+        )
+        env = {**os.environ, "PYTHONPATH": os.getcwd()}
+        p = subprocess.Popen(
+            [sys.executable, "-m", "dragonfly2_tpu.cli.trainer",
+             "--config", str(cfgp), "--console"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        try:
+            import select
+
+            line = ""
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                ready, _, _ = select.select(
+                    [p.stdout], [], [], max(deadline - time.time(), 0.1)
+                )
+                if not ready:
+                    break
+                line = p.stdout.readline()
+                if "ingest on" in line:
+                    break
+            assert "ingest on" in line and "grpc on" in line, line
+            http_url = line.split("ingest on ")[1].split()[0]
+            grpc_target = line.split("grpc on ")[1].split(",")[0]
+            # HTTP ingest answers; gRPC Train stream accepts a session.
+            req = urllib.request.Request(
+                http_url + "/train/open",
+                data=_json.dumps({"ip": "1.2.3.4", "hostname": "s",
+                                  "scheduler_id": "s"}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert _json.loads(r.read())["session"]
+            from dragonfly2_tpu.rpc.grpc_transport import GRPCTrainerClient
+
+            client = GRPCTrainerClient(grpc_target)
+            with pytest.raises(Exception):
+                client.run_status("nonexistent")  # NOT_FOUND, but reachable
+            client.close()
+        finally:
+            p.kill()
+
+
 class TestRateLimit:
     def test_token_bucket_refills(self):
         import time
