@@ -122,9 +122,71 @@ def run(argv=None) -> int:
             rate_limit=bucket,
         )
         grpc_server.serve()
+    # Periodic dataset upload to the trainer (announcer.go:127-142 train
+    # ticker, default 7d) — the link that feeds the learning loop in a
+    # real deployment.
+    announcer = None
+    if cfg.trainer.enable and cfg.trainer.addr:
+        import socket as _socket
+
+        from ..scheduler.announcer import Announcer
+
+        if cfg.trainer.addr.startswith("grpc://"):
+            from ..rpc.grpc_transport import GRPCTrainerClient
+
+            from ..rpc.trainer_transport import RemoteTrainerSession  # noqa: F401
+
+            class _GRPCTrainerLink:
+                """Adapts the Train-stream client to the announcer's
+                open_train_stream session surface."""
+
+                def __init__(self, target):
+                    self._client = GRPCTrainerClient(target)
+
+                def open_train_stream(self, *, ip, hostname, scheduler_id):
+                    client = self._client
+
+                    class _Session:
+                        def __init__(self):
+                            self.downloads = []
+                            self.topologies = []
+
+                        def send_download_shard(self, path):
+                            self.downloads.append(path)
+
+                        def send_network_topology_shard(self, path):
+                            self.topologies.append(path)
+
+                        def close_and_train(self):
+                            return client.train(
+                                ip=ip, hostname=hostname,
+                                scheduler_id=scheduler_id,
+                                download_shards=self.downloads,
+                                topology_shards=self.topologies,
+                            )
+
+                    return _Session()
+
+            trainer_link = _GRPCTrainerLink(cfg.trainer.addr[len("grpc://"):])
+        else:
+            from ..rpc import RemoteTrainer
+
+            trainer_link = RemoteTrainer(cfg.trainer.addr)
+        announcer = Announcer(
+            scheduler_id=f"sched-{_socket.gethostname()}",
+            storage=storage,
+            trainer=trainer_link,
+            ip="127.0.0.1",
+            hostname=_socket.gethostname(),
+            train_interval=cfg.trainer.interval_s,
+        )
+        announcer.serve()
+
     print(
         f"scheduler: serving rpc on {rpc_server.url}"
         + (f" and grpc on {grpc_server.target}" if grpc_server else "")
+        + (f", dataset uploads to {cfg.trainer.addr} every "
+           f"{cfg.trainer.interval_s:.0f}s" if announcer else "")
         + " (ctrl-c to stop)",
         flush=True,
     )
@@ -135,6 +197,8 @@ def run(argv=None) -> int:
         rpc_server.stop()
         if grpc_server is not None:
             grpc_server.stop()
+        if announcer is not None:
+            announcer.stop()
         return 0
 
 

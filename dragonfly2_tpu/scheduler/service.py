@@ -214,6 +214,9 @@ class SchedulerService:
         """Peer fell back to origin download (conductor's source path)."""
         _try_event(peer.fsm, "DownloadBackToSource")
         peer.task.back_to_source_peers.add(peer.id)
+        # peer.go:270-279 (PeerEventDownloadBackToSource callback): the
+        # abandoned parent assignments release their upload slots.
+        peer.task.delete_peer_in_edges(peer.id)
 
     # -- piece / peer results ----------------------------------------------
 
@@ -250,6 +253,13 @@ class SchedulerService:
         peer.cost_ns = int((time.time() - peer.created_at) * 1e9)
         task = peer.task
         _try_event(task.fsm, "DownloadSucceeded")
+        # Reference peer.go:280-292 (PeerEventDownloadSucceeded callback):
+        # a finished child detaches from its parents, RELEASING their
+        # upload slots — without this, every completed download holds a
+        # slot forever and the seed saturates at concurrent_upload_limit
+        # (observed: exactly 50 parent-attributed records, then 100%
+        # back-to-source).
+        peer.task.delete_peer_in_edges(peer.id)
         if self.storage is not None:
             self.storage.create_download(self._build_download_record(peer))
             metrics.DOWNLOAD_RECORDS_TOTAL.inc()
@@ -257,6 +267,8 @@ class SchedulerService:
     def report_peer_failed(self, peer: Peer) -> None:
         metrics.PEER_RESULT_TOTAL.inc(result="failed")
         _try_event(peer.fsm, "DownloadFailed")
+        # peer.go:293-305 (PeerEventDownloadFailed callback).
+        peer.task.delete_peer_in_edges(peer.id)
         if self.storage is not None:
             self.storage.create_download(
                 self._build_download_record(peer, state="Failed")

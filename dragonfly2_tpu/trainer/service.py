@@ -236,6 +236,12 @@ class TrainerService:
             trainer_metrics.TRAINING_TOTAL.inc(model="all", result="failure")
         else:
             trainer_metrics.TRAINING_TOTAL.inc(model="all", result="success")
+            logger.info(
+                "training run %s done in %.1fs: %d download rows, "
+                "%d topology rows, models=%s",
+                run.key, time.perf_counter() - t0, run.download_rows,
+                run.topology_rows, run.models,
+            )
         finally:
             trainer_metrics.TRAINING_DURATION.observe(time.perf_counter() - t0)
             run.done.set()

@@ -35,11 +35,17 @@ def setup(
     backups: int = 5,
     service: str = "dragonfly",
 ) -> None:
-    """Configure the ``dragonfly`` logger tree. Idempotent per service."""
+    """Configure the package logger tree. Idempotent per service.
+
+    Handlers attach to the ``dragonfly2_tpu`` package tree — that is
+    where every module logger (``logging.getLogger(__name__)``) actually
+    lives.  Attaching to a logger named after the service ("trainer")
+    captured NOTHING from the modules doing the work; ``service`` now
+    only names the log files."""
     if _configured.get(service):
         return
     _configured[service] = True
-    root = logging.getLogger(service)
+    root = logging.getLogger("dragonfly2_tpu")
     root.setLevel(_LEVELS.get(level, logging.INFO))
     fmt = logging.Formatter(
         "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
