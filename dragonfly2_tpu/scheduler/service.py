@@ -253,6 +253,12 @@ class SchedulerService:
         peer.cost_ns = int((time.time() - peer.created_at) * 1e9)
         task = peer.task
         _try_event(task.fsm, "DownloadSucceeded")
+        # The record must capture parent attribution BEFORE the DAG edges
+        # are dropped (createDownloadRecord at service_v1.go:1418 runs with
+        # the graph intact; the FSM callback releases slots afterwards).
+        record = (
+            self._build_download_record(peer) if self.storage is not None else None
+        )
         # Reference peer.go:280-292 (PeerEventDownloadSucceeded callback):
         # a finished child detaches from its parents, RELEASING their
         # upload slots — without this, every completed download holds a
@@ -261,18 +267,21 @@ class SchedulerService:
         # back-to-source).
         peer.task.delete_peer_in_edges(peer.id)
         if self.storage is not None:
-            self.storage.create_download(self._build_download_record(peer))
+            self.storage.create_download(record)
             metrics.DOWNLOAD_RECORDS_TOTAL.inc()
 
     def report_peer_failed(self, peer: Peer) -> None:
         metrics.PEER_RESULT_TOTAL.inc(result="failed")
         _try_event(peer.fsm, "DownloadFailed")
+        record = (
+            self._build_download_record(peer, state="Failed")
+            if self.storage is not None
+            else None
+        )
         # peer.go:293-305 (PeerEventDownloadFailed callback).
         peer.task.delete_peer_in_edges(peer.id)
         if self.storage is not None:
-            self.storage.create_download(
-                self._build_download_record(peer, state="Failed")
-            )
+            self.storage.create_download(record)
             metrics.DOWNLOAD_RECORDS_TOTAL.inc()
 
     def leave_peer(self, peer: Peer) -> None:
