@@ -1,15 +1,19 @@
-"""Benchmark: GNN training records/sec/chip (BASELINE.md headline metric).
+"""Benchmark: flagship ranker training records/sec/chip (BASELINE.md headline).
 
-Trains the GAT parent-peer ranker's jitted train step on a synthetic probe
-graph + download-edge workload and reports steady-state records (edges)
-per second per chip.
+Flagship = the hop-feature parent-peer ranker (models/hop.py): neighbor
+aggregation precomputed per graph snapshot, train step is pure dense MXU
+work on edge batches.  Chosen over the round-1 GAT flagship on MEASURED
+evidence (BENCHMARKS.md): identical config[2] workload gives val log-MAE
+0.505 (hop) vs 0.514 (GAT) while the step drops ~93 ms → ~3 ms — the GAT
+step is floored by XLA's sort-based scatter in the neighbor-gather
+backward (~22 ms/layer), which no in-step rewiring beat.
 
 vs_baseline is measured against the north-star requirement
 (BASELINE.json): 1B records / 10 min on v5e-16 ⇒ ~104,167 records/sec/chip.
 The reference itself publishes no numbers (its trainer is a stub —
 trainer/training/training.go:82-99), so the north-star rate is the bar.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
@@ -27,7 +31,12 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from dragonfly2_tpu.models import GATRanker, GNNConfig, build_neighbor_table
+    from dragonfly2_tpu.models import (
+        HopConfig,
+        HopRanker,
+        build_neighbor_table,
+        precompute_hop_features,
+    )
     from dragonfly2_tpu.parallel.mesh import batch_sharding, create_mesh, replicated
     from dragonfly2_tpu.records.synthetic import SyntheticCluster
     from dragonfly2_tpu.trainer.train import (
@@ -52,16 +61,21 @@ def main() -> None:
     table = build_neighbor_table(n_nodes, src, dst, rtt / 1e9, max_neighbors=16)
     node_feats = jnp.asarray(cluster._host_feature_matrix())
 
+    mcfg = HopConfig()  # production config: hidden 128, 2 hops, embed 32
+    hop_feats = jax.jit(lambda nf, t: precompute_hop_features(nf, t, hops=mcfg.hops))(
+        node_feats, table
+    )
+
     rng = np.random.default_rng(0)
     e_src = rng.integers(0, n_nodes, batch).astype(np.int32)
     e_dst = (e_src + rng.integers(1, n_nodes, batch).astype(np.int32)) % n_nodes
     bw = cluster._bandwidth_vec(e_src, e_dst)
     target = np.log1p(bw).astype(np.float32)
 
-    model = GATRanker(GNNConfig())  # production config: 128 hidden, 2 layers, 4 heads
+    model = HopRanker(mcfg)
     params = model.init(
         jax.random.PRNGKey(0),
-        node_feats,
+        hop_feats,
         table,
         jnp.asarray(e_src[:2]),
         jnp.asarray(e_dst[:2]),
@@ -78,7 +92,7 @@ def main() -> None:
     repl = replicated(mesh)
     data_shard = batch_sharding(mesh)
     state = jax.device_put(state, repl)
-    node_feats = jax.device_put(node_feats, repl)
+    hop_feats = jax.device_put(hop_feats, repl)
     table = jax.device_put(table, repl)
 
     # Timing methodology: the device may sit behind a high-latency relay
@@ -86,7 +100,8 @@ def main() -> None:
     # guarantee execution completed.  So N steps run INSIDE one jit via
     # fori_loop (sequentially dependent through the carried state), a
     # scalar fetch forces full sync, and the per-step time is the slope
-    # between two chain lengths — RTT and dispatch cancel out.
+    # between two chain lengths — RTT and dispatch cancel out.  The fetch
+    # touches a real param so the loop body survives dead-code elimination.
     from functools import partial
 
     @partial(jax.jit, static_argnums=(6,), in_shardings=(
@@ -103,40 +118,51 @@ def main() -> None:
     b = jax.device_put(jnp.asarray(e_dst), data_shard)
     y = jax.device_put(jnp.asarray(target), data_shard)
 
-    n_short, n_long = (5, 35) if on_tpu else (2, 8)
-    float(run_chain(state, node_feats, table, a, b, y, n_short))  # compile both
-    float(run_chain(state, node_feats, table, a, b, y, n_long))
+    # Longer chains than the GAT bench: the step is ~3 ms, so the delta
+    # must dominate relay jitter.
+    n_short, n_long = (10, 210) if on_tpu else (2, 8)
+    float(run_chain(state, hop_feats, table, a, b, y, n_short))  # compile both
+    float(run_chain(state, hop_feats, table, a, b, y, n_long))
 
-    t0 = time.perf_counter()
-    float(run_chain(state, node_feats, table, a, b, y, n_short))
-    t_short = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    float(run_chain(state, node_feats, table, a, b, y, n_long))
-    t_long = time.perf_counter() - t0
+    per_step = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(run_chain(state, hop_feats, table, a, b, y, n_short))
+        t_short = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(run_chain(state, hop_feats, table, a, b, y, n_long))
+        t_long = time.perf_counter() - t0
+        est = max((t_long - t_short) / (n_long - n_short), 1e-9)
+        per_step = est if per_step is None else min(per_step, est)
 
-    per_step = max((t_long - t_short) / (n_long - n_short), 1e-9)
     records_per_sec_per_chip = batch / per_step / n_devices
 
-    # MFU from XLA's own cost model: flops of ONE train step (the n_short
-    # chain divided by its length) over achieved step time and peak.
+    # MFU from XLA's own cost model. Cost the train step DIRECTLY (not the
+    # chain): HloCostAnalysis counts a while-loop body once regardless of
+    # trip count, so dividing chain flops by chain length under-reports by
+    # the chain length (round-1 bench reported 0.51% where the true figure
+    # was ~2.5%).
     mfu = None
     try:
-        lowered = run_chain.lower(state, node_feats, table, a, b, y, n_short)
-        cost = lowered.compile().cost_analysis()
+        step_jit = jax.jit(
+            lambda s, nf, t, aa, bb, yy: _graph_train_step(s, nf, t, aa, bb, yy, None)
+        )
+        cost = step_jit.lower(state, hop_feats, table, a, b, y).compile().cost_analysis()
         if cost and "flops" in cost:
-            step_flops = float(cost["flops"]) / n_short
+            step_flops = float(cost["flops"])
             peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak; CPU nominal
             mfu = step_flops / per_step / peak
     except Exception:
         pass
 
     out = {
-        "metric": "gat_ranker_train_records_per_sec_per_chip",
+        "metric": "hop_ranker_train_records_per_sec_per_chip",
         "value": round(records_per_sec_per_chip, 1),
         "unit": "records/s/chip",
         "vs_baseline": round(
             records_per_sec_per_chip / BASELINE_RECORDS_PER_SEC_PER_CHIP, 3
         ),
+        "step_ms": round(per_step * 1e3, 2),
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)

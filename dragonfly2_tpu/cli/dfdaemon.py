@@ -43,9 +43,12 @@ def build(cfg: DaemonConfig, scheduler_url: str):
     # Advertise a routable address — peers on OTHER machines dial it.
     ip = cfg.server.advertise_ip or local_ip()
     if scheduler_url.startswith("grpc://"):
-        from ..rpc.grpc_transport import GRPCRemoteScheduler
+        # Streaming variant: per-peer calls ride the bidi announce_peer
+        # stream so the scheduler can push mid-download reschedules
+        # (unary fallback built in on stream failure).
+        from ..rpc.grpc_transport import GRPCStreamingScheduler
 
-        scheduler_client_cls = lambda url: GRPCRemoteScheduler(  # noqa: E731
+        scheduler_client_cls = lambda url: GRPCStreamingScheduler(  # noqa: E731
             url[len("grpc://"):]
         )
     else:

@@ -122,6 +122,18 @@ def run(argv=None) -> int:
             rate_limit=bucket,
         )
         grpc_server.serve()
+        # Stall sweep: server-initiated reschedules for idle peers on the
+        # bidi wire (push.StallMonitor; needs the hub the gRPC server
+        # attached to the service).
+        if cfg.scheduling.stall_max_idle_s > 0:
+            from ..scheduler.push import StallMonitor
+
+            stall_monitor = StallMonitor(
+                service,
+                max_idle_s=cfg.scheduling.stall_max_idle_s,
+                interval_s=cfg.scheduling.stall_sweep_interval_s,
+            )
+            stall_monitor.start()
     # Periodic dataset upload to the trainer (announcer.go:127-142 train
     # ticker, default 7d) — the link that feeds the learning loop in a
     # real deployment.

@@ -78,6 +78,11 @@ class SchedulingSection:
     retry_back_to_source_limit: int = 4
     retry_interval_s: float = 0.5
     back_to_source_count: int = 3
+    # Server-initiated stall sweep (push.StallMonitor): running peers
+    # idle beyond max_idle get fresh parents pushed down the bidi wire.
+    # 0 disables the monitor.
+    stall_max_idle_s: float = 10.0
+    stall_sweep_interval_s: float = 2.0
 
     def validate(self) -> None:
         if self.algorithm not in ("default", "nt", "ml"):

@@ -187,7 +187,36 @@ class Conductor:
             bm = bitmaps.get(parent.id)
             return bm is None or (number < len(bm) and bm[number])
 
+        def refresh_bitmaps(plist):
+            if hasattr(self.piece_fetcher, "piece_bitmap"):
+                for p in plist:
+                    if p.id not in bitmaps:
+                        bm = self.piece_fetcher.piece_bitmap(p.host.id, task.id)
+                        if bm is not None:
+                            bitmaps[p.id] = bm
+
+        # Server-pushed reschedules (the v2 bidi wire): between pieces,
+        # adopt whatever the scheduler pushed — new parents replace the
+        # current set; a pushed back-to-source aborts the P2P path.
+        take_pushed = getattr(self.scheduler, "take_pushed_schedule", None)
+
+        def apply_push():
+            nonlocal parents
+            if take_pushed is None:
+                return True
+            res = take_pushed(peer)
+            if res is None:
+                return True
+            if res.kind is ScheduleResultKind.PARENTS and res.parents:
+                parents = list(res.parents)
+                refresh_bitmaps(parents)
+            elif res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
+                return False
+            return True
+
         for number in range(n_pieces):
+            if not apply_push():
+                return None
             if not parents:
                 return None
             done = False
@@ -206,15 +235,7 @@ class Conductor:
                     res = self.scheduler.report_piece_failed(peer, parent.id)
                     if res.kind is ScheduleResultKind.PARENTS and res.parents:
                         parents = list(res.parents)
-                        for p in parents:
-                            if p.id not in bitmaps and hasattr(
-                                self.piece_fetcher, "piece_bitmap"
-                            ):
-                                bm = self.piece_fetcher.piece_bitmap(
-                                    p.host.id, task.id
-                                )
-                                if bm is not None:
-                                    bitmaps[p.id] = bm
+                        refresh_bitmaps(parents)
                     elif res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
                         return None
                     continue

@@ -21,3 +21,8 @@ from .gnn import (  # noqa: F401
     NeighborTable,
     build_neighbor_table,
 )
+from .hop import (  # noqa: F401
+    HopConfig,
+    HopRanker,
+    precompute_hop_features,
+)

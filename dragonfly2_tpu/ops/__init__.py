@@ -25,3 +25,7 @@ from .pallas_segment import (  # noqa: F401
     make_neighbor_gather,
     segment_sum_pallas,
 )
+from .transpose_gather import (  # noqa: F401
+    build_transpose_table,
+    make_transpose_gather,
+)

@@ -1,0 +1,141 @@
+"""Scatter-free neighbor gather: the VJP is a gather over the transpose graph.
+
+The backward of ``h[idx]`` ([N, D] table, [N, K] indices) is a scatter-add
+of the [N, K, D] cotangent into the table — the op XLA lowers worst on TPU
+(sort-based, ~20 ms at [100k, 16, 128]).  But the scatter IS a gather over
+the *transpose* graph: for each node ``m``,
+
+    grad_h[m] = sum over { flat edge positions e : idx.flat[e] == m } ct.flat[e]
+
+and that edge set is static (the graph changes far slower than the
+weights).  So we precompute, host-side, a transpose table listing each
+node's out-edge positions padded to ``K_out`` slots, and the VJP becomes
+one [N, K_out, D] gather + masked sum — sequential writes, no sort, no
+serialization.  Over-degree nodes beyond ``K_out`` spill to a tiny COO
+tail handled with one (small) scatter so the gradient stays exact.
+
+Padding slots of the *forward* table (mask 0) are excluded from the
+transpose table: their cotangents are identically zero (masked attention
+and -inf logits cut the gradient upstream), so dropping them is exact —
+and it keeps node 0 (the conventional pad target) from collecting every
+pad slot as a fake out-edge.
+
+Compare ``ops.pallas_segment.make_neighbor_gather`` (MXU segment-sum VJP):
+that path needs a [E, D] permutation gather of the cotangent per step,
+which regressed the full train step (BENCHMARKS.md).  Here the
+permutation is folded into the precomputed transpose table itself.
+
+Reference seam: this is the TPU replacement for the aggregation gradients
+the reference never built (trainer/training/training.go:82-99 stub).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TransposeTable(NamedTuple):
+    """Static transpose adjacency: for each node, its out-edge positions.
+
+    tidx  [N, K_out] int32 — flat positions into the [N*K] edge stream
+    tmask [N, K_out] f32   — 1.0 real, 0.0 padding
+    over_pos [M] int32     — spilled flat positions (over-degree tail)
+    over_dst [M] int32     — node each spilled position belongs to
+    """
+
+    tidx: jax.Array
+    tmask: jax.Array
+    over_pos: jax.Array
+    over_dst: jax.Array
+
+
+def build_transpose_table(
+    indices: np.ndarray,
+    mask: np.ndarray,
+    num_nodes: Optional[int] = None,
+    *,
+    cap: Optional[int] = None,
+    spill_percentile: float = 99.5,
+) -> TransposeTable:
+    """Host prep, vectorized (no Python loop over nodes).
+
+    ``cap`` fixes K_out; by default it is the ``spill_percentile`` of the
+    out-degree distribution rounded up to a multiple of 8, so the dense
+    gather covers ~everything and the COO tail stays tiny.
+    """
+    indices = np.asarray(indices)
+    mask = np.asarray(mask)
+    n = num_nodes or indices.shape[0]
+    flat_src = indices.reshape(-1).astype(np.int64)
+    real = mask.reshape(-1) > 0
+    pos = np.nonzero(real)[0]
+    srcs = flat_src[real]
+
+    order = np.argsort(srcs, kind="stable")
+    pos_s, srcs_s = pos[order], srcs[order]
+    counts = np.bincount(srcs_s, minlength=n)
+    if cap is None:
+        k_out = int(np.percentile(counts, spill_percentile)) if len(counts) else 1
+        k_out = max(8, ((max(k_out, 1) + 7) // 8) * 8)
+    else:
+        k_out = cap
+    starts = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    rank = np.arange(len(srcs_s), dtype=np.int64) - starts[srcs_s]
+
+    keep = rank < k_out
+    tidx = np.zeros((n, k_out), dtype=np.int64)
+    tmask = np.zeros((n, k_out), dtype=np.float32)
+    tidx[srcs_s[keep], rank[keep]] = pos_s[keep]
+    tmask[srcs_s[keep], rank[keep]] = 1.0
+    return TransposeTable(
+        tidx=jnp.asarray(tidx, jnp.int32),
+        tmask=jnp.asarray(tmask),
+        over_pos=jnp.asarray(pos_s[~keep], jnp.int32),
+        over_dst=jnp.asarray(srcs_s[~keep], jnp.int32),
+    )
+
+
+def make_transpose_gather(
+    indices: np.ndarray,
+    mask: np.ndarray,
+    num_nodes: Optional[int] = None,
+    *,
+    cap: Optional[int] = None,
+):
+    """→ ``gather(table [N, D]) → [N, K, D]`` with a scatter-free backward.
+
+    Build once per graph snapshot from the HOST-side neighbor table (the
+    same [N, K] ``indices``/``mask`` as the NeighborTable handed to the
+    model); the callable closes over device-resident transpose arrays and
+    plugs into ``GNNConfig(gather_fn=...)``.
+    """
+    indices = np.asarray(indices)
+    n = num_nodes or indices.shape[0]
+    tt = build_transpose_table(indices, mask, n, cap=cap)
+    idx_dev = jnp.asarray(indices, jnp.int32)
+    has_spill = int(tt.over_pos.shape[0]) > 0
+
+    @jax.custom_vjp
+    def gather(table: jax.Array) -> jax.Array:
+        return jnp.take(table, idx_dev, axis=0)
+
+    def fwd(table):
+        # Residual: an empty array carrying the primal dtype only.
+        return gather(table), jnp.zeros((0,), table.dtype)
+
+    def bwd(res, g):
+        flat = g.reshape(-1, g.shape[-1])                 # [N*K, D]
+        rows = jnp.take(flat, tt.tidx, axis=0)            # [N, K_out, D]
+        grad = (rows * tt.tmask[..., None].astype(rows.dtype)).sum(axis=1)
+        if has_spill:
+            extra = jnp.take(flat, tt.over_pos, axis=0)   # [M, D] — tiny
+            grad = grad.at[tt.over_dst].add(extra)
+        return (grad.astype(res.dtype),)
+
+    gather.defvjp(fwd, bwd)
+    return gather

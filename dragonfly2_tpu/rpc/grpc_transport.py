@@ -51,6 +51,18 @@ def _grpc_to_dfcode():
 
 _GRPC_TO_DFCODE = _grpc_to_dfcode()
 
+
+def _iter_until_closed(request_iterator):
+    """Drain a server-side request stream, treating client cancel/close
+    (grpc.RpcError mid-iteration) as normal end-of-stream."""
+    while True:
+        try:
+            yield next(request_iterator)
+        except StopIteration:
+            return
+        except grpc.RpcError:
+            return
+
 # method → (request message, response message); mirrors
 # SchedulerRPCAdapter.METHODS exactly.
 SCHEDULER_METHODS = {
@@ -104,6 +116,13 @@ def dict_to_proto(data: dict, msg_cls):
     return ParseDict(data, msg_cls(), ignore_unknown_fields=True)
 
 
+def dict_to_proto_into(data: dict, msg) -> None:
+    """Parse into an existing submessage (selects its oneof arm even when
+    every field is default — SetInParent marks presence)."""
+    msg.SetInParent()
+    ParseDict(data, msg, ignore_unknown_fields=True)
+
+
 def _to_wire_probe_results(req: dict) -> dict:
     """sync_probes_finished carries (dest, rtt) pairs in the dict schema;
     the proto uses ProbeResult messages."""
@@ -124,7 +143,14 @@ def _from_wire_probe_results(req: dict) -> dict:
 
 
 class SchedulerGRPCServer:
-    """Binds a SchedulerRPCAdapter onto a grpc server."""
+    """Binds a SchedulerRPCAdapter onto a grpc server.
+
+    Besides the unary methods, serves the bidi ``announce_peer`` stream
+    (service_v2.go:89-207 AnnouncePeer analog): a PeerStreamHub is
+    attached to the service so scheduling decisions made outside a peer's
+    own request cycle (bad parents, parent death, stalls) are PUSHED to
+    connected peers as seq=0 responses.
+    """
 
     def __init__(
         self,
@@ -136,9 +162,15 @@ class SchedulerGRPCServer:
         server_credentials: Optional[grpc.ServerCredentials] = None,
         rate_limit=None,
     ) -> None:
+        from ..scheduler.push import PeerStreamHub
         from .scheduler_server import SchedulerRPCAdapter
 
         self.adapter = SchedulerRPCAdapter(service)
+        # Share the service's hub if the composition root made one;
+        # otherwise create it (tests construct the server directly).
+        if getattr(service, "hub", None) is None:
+            service.hub = PeerStreamHub()
+        self.hub = service.hub
         interceptors = ()
         if rate_limit is not None:
             from .ratelimit import RateLimitInterceptor
@@ -156,6 +188,11 @@ class SchedulerGRPCServer:
                 request_deserializer=req_cls.FromString,
                 response_serializer=lambda m: m.SerializeToString(),
             )
+        handlers["announce_peer"] = grpc.stream_stream_rpc_method_handler(
+            self._announce_peer,
+            request_deserializer=pb.AnnouncePeerRequest.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SCHEDULER_SERVICE, handlers),)
         )
@@ -165,6 +202,102 @@ class SchedulerGRPCServer:
         else:
             bound = self._server.add_insecure_port(addr)
         self.address: Tuple[str, int] = (host, bound)
+
+    # oneof payload field → (adapter method, response oneof field)
+    _STREAM_DISPATCH = {
+        "register": ("register_peer", "registration"),
+        "task_info": ("set_task_info", "task_info"),
+        "piece_finished": ("report_piece_finished", "ack"),
+        "piece_failed": ("report_piece_failed", "schedule"),
+        "peer_finished": ("report_peer_finished", "ack"),
+        "peer_failed": ("report_peer_failed", "ack"),
+        "back_to_source": ("mark_back_to_source", "ack"),
+        "leave": ("leave_peer", "ack"),
+        "direct_piece": ("set_task_direct_piece", "ack"),
+    }
+
+    def _announce_peer(self, request_iterator, context):
+        """One generator per connected daemon: requests demux to the same
+        adapter the unary wire uses; a writer queue serializes the
+        request-paired responses with hub pushes."""
+        import queue
+        import threading
+
+        from .metrics import GRPC_REQUESTS_TOTAL
+        from .scheduler_server import schedule_to_wire
+
+        out: "queue.Queue" = queue.Queue()
+        registered: set = set()
+
+        def make_push(peer_id: str):
+            def push(result) -> None:
+                msg = pb.AnnouncePeerResponse(seq=0, peer_id=peer_id)
+                dict_to_proto_into(schedule_to_wire(result), msg.schedule)
+                out.put(msg)
+            return push
+
+        def reader() -> None:
+            try:
+                it = _iter_until_closed(request_iterator)
+                for req in it:
+                    kind = req.WhichOneof("payload")
+                    resp = pb.AnnouncePeerResponse(seq=req.seq)
+                    entry = self._STREAM_DISPATCH.get(kind)
+                    if entry is None:
+                        resp.error, resp.code = f"unknown payload {kind}", 0
+                        out.put(resp)
+                        continue
+                    method, body_field = entry
+                    try:
+                        body = self.adapter.dispatch(
+                            method, proto_to_dict(getattr(req, kind))
+                        )
+                        dict_to_proto_into(body, getattr(resp, body_field))
+                        GRPC_REQUESTS_TOTAL.inc(
+                            service="scheduler", method=f"stream/{method}",
+                            code="OK",
+                        )
+                        if method == "register_peer":
+                            pid = body["peer_id"]
+                            registered.add(pid)
+                            self.hub.register(pid, make_push(pid))
+                        elif method == "leave_peer":
+                            pid = proto_to_dict(getattr(req, kind)).get(
+                                "peer_id", ""
+                            )
+                            registered.discard(pid)
+                            self.hub.unregister(pid)
+                    except KeyError as exc:
+                        from ..utils.dferrors import Code
+
+                        resp.error, resp.code = str(exc), int(Code.NOT_FOUND)
+                        GRPC_REQUESTS_TOTAL.inc(
+                            service="scheduler", method=f"stream/{method}",
+                            code="NOT_FOUND",
+                        )
+                    except Exception as exc:  # noqa: BLE001 — wire boundary
+                        resp.error, resp.code = str(exc), 0
+                        GRPC_REQUESTS_TOTAL.inc(
+                            service="scheduler", method=f"stream/{method}",
+                            code="UNKNOWN",
+                        )
+                    out.put(resp)
+            finally:
+                # The reader is the SOLE owner of `registered` (the
+                # response generator must not clean up concurrently — a
+                # client cancel would race its iteration against our
+                # adds and leak hub registrations bound to a dead queue).
+                for pid in registered:
+                    self.hub.unregister(pid)
+                out.put(None)
+
+        t = threading.Thread(target=reader, name="announce-reader", daemon=True)
+        t.start()
+        while True:
+            item = out.get()
+            if item is None:
+                return
+            yield item
 
     def _behavior(self, method: str, resp_cls):
         from .metrics import GRPC_REQUESTS_TOTAL
@@ -270,6 +403,193 @@ class GRPCRemoteScheduler(RemoteScheduler):
 
     def close(self) -> None:
         self._channel.close()
+
+
+class GRPCStreamingScheduler(GRPCRemoteScheduler):
+    """RemoteScheduler whose per-peer methods ride ONE bidi
+    ``announce_peer`` stream instead of per-call unary RPCs — the v2 wire:
+    piece results flow up the stream, and the scheduler can PUSH parent
+    lists down mid-download (seq=0 responses), consumed by the conductor
+    via ``take_pushed_schedule``.
+
+    announce_host / sync_probes stay unary (they are host-scoped, not
+    download-scoped — the reference keeps them on separate RPCs too).
+    On any stream failure the affected call falls back to the unary stub,
+    so a mid-download scheduler restart degrades to round-1 behavior
+    instead of failing the download.
+    """
+
+    # adapter method → request oneof field
+    _STREAM_FIELDS = {
+        "register_peer": ("register", pb.RegisterPeerRequest),
+        "set_task_info": ("task_info", pb.SetTaskInfoRequest),
+        "report_piece_finished": ("piece_finished", pb.ReportPieceFinishedRequest),
+        "report_piece_failed": ("piece_failed", pb.ReportPieceFailedRequest),
+        "report_peer_finished": ("peer_finished", pb.PeerRequest),
+        "report_peer_failed": ("peer_failed", pb.PeerRequest),
+        "mark_back_to_source": ("back_to_source", pb.PeerRequest),
+        "leave_peer": ("leave", pb.PeerRequest),
+        "set_task_direct_piece": ("direct_piece", pb.DirectPieceRequest),
+    }
+    _RESPONSE_BODY = {
+        "registration": pb.RegisterPeerResponse,
+        "schedule": pb.ScheduleResponse,
+        "task_info": pb.TaskInfoResponse,
+    }
+
+    def __init__(self, target: str, **kwargs) -> None:
+        super().__init__(target, **kwargs)
+        import queue
+        import threading
+
+        self._stream_mu = threading.Lock()
+        self._sendq: Optional["queue.Queue"] = None
+        self._waiters: dict = {}          # seq → (Event, [resp])
+        self._pushed: dict = {}           # peer_id → latest pushed dict
+        self._seq = 0
+        self._stream_stub = self._channel.stream_stream(
+            f"/{SCHEDULER_SERVICE}/announce_peer",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.AnnouncePeerResponse.FromString,
+        )
+
+    # -- stream lifecycle ---------------------------------------------------
+
+    def _ensure_stream(self):
+        import queue
+        import threading
+
+        with self._stream_mu:
+            if self._sendq is not None:
+                return
+            self._sendq = queue.Queue()
+            sendq = self._sendq
+
+            def request_iter():
+                while True:
+                    item = sendq.get()
+                    if item is None:
+                        return
+                    yield item
+
+            call = self._stream_stub(request_iter())
+
+            def read_loop():
+                try:
+                    for resp in call:
+                        if resp.seq == 0:
+                            body = resp.WhichOneof("body")
+                            if body == "schedule" and resp.peer_id:
+                                with self._stream_mu:
+                                    # Bounded: a push racing a download's
+                                    # completion must not accumulate
+                                    # forever (terminal calls also clear
+                                    # their entry).
+                                    while len(self._pushed) >= 512:
+                                        self._pushed.pop(
+                                            next(iter(self._pushed))
+                                        )
+                                    self._pushed[resp.peer_id] = proto_to_dict(
+                                        resp.schedule
+                                    )
+                            continue
+                        with self._stream_mu:
+                            waiter = self._waiters.pop(resp.seq, None)
+                        if waiter is not None:
+                            waiter[1].append(resp)
+                            waiter[0].set()
+                except Exception:  # noqa: BLE001 — stream died
+                    pass
+                finally:
+                    # Wake every in-flight caller so they fall back to unary
+                    # instead of blocking out the timeout.  Only clear the
+                    # queue if it is still OURS — a reconnect may have
+                    # already installed a fresh one.
+                    with self._stream_mu:
+                        dead = list(self._waiters.values())
+                        self._waiters.clear()
+                        if self._sendq is sendq:
+                            self._sendq = None
+                    for ev, _slot in dead:
+                        ev.set()
+
+            threading.Thread(
+                target=read_loop, name="announce-read", daemon=True
+            ).start()
+
+    def _stream_call(self, method: str, req: dict) -> dict:
+        import threading
+
+        field, req_cls = self._STREAM_FIELDS[method]
+        self._ensure_stream()
+        with self._stream_mu:
+            self._seq += 1
+            seq = self._seq
+            ev: threading.Event = threading.Event()
+            slot: list = []
+            self._waiters[seq] = (ev, slot)
+            sendq = self._sendq
+        msg = pb.AnnouncePeerRequest(seq=seq)
+        dict_to_proto_into(req, getattr(msg, field))
+        try:
+            if sendq is None:
+                raise ConnectionError("announce stream closed")
+            sendq.put(msg)
+            if not ev.wait(self.timeout) or not slot:
+                raise ConnectionError(f"{method}: announce stream no response")
+        finally:
+            with self._stream_mu:
+                self._waiters.pop(seq, None)
+        # A finished download stops consuming pushes — drop any stale one.
+        if method in ("report_peer_finished", "report_peer_failed", "leave_peer"):
+            with self._stream_mu:
+                self._pushed.pop(req.get("peer_id", ""), None)
+        resp = slot[0]
+        if resp.error:
+            raise RPCError(f"{method}: {resp.error}", code=resp.code)
+        body = resp.WhichOneof("body")
+        return proto_to_dict(getattr(resp, body)) if body else {}
+
+    def _call(self, method: str, req: dict) -> dict:
+        if method not in self._STREAM_FIELDS:
+            return super()._call(method, req)
+        try:
+            return self._stream_call(method, req)
+        except ConnectionError:
+            # Stream broken (scheduler restart, network blip): unary
+            # fallback keeps the download alive; next call retries the
+            # stream via _ensure_stream.
+            return super()._call(method, req)
+
+    # -- pushed reschedules (conductor seam) --------------------------------
+
+    def take_pushed_schedule(self, peer) -> Optional["object"]:
+        """Latest server-pushed schedule for this peer, as a
+        ScheduleResult with mirrored parents — or None."""
+        from ..scheduler.scheduling import ScheduleResult, ScheduleResultKind
+
+        with self._stream_mu:
+            resp = self._pushed.pop(peer.id, None)
+        if resp is None:
+            return None
+        if resp.get("parents"):
+            parents = [
+                self._mirror_parent(peer.task, p) for p in resp["parents"]
+            ]
+            return ScheduleResult(
+                kind=ScheduleResultKind.PARENTS, parents=parents
+            )
+        if resp.get("need_back_to_source"):
+            return ScheduleResult(kind=ScheduleResultKind.NEED_BACK_TO_SOURCE)
+        return None
+
+    def close(self) -> None:
+        with self._stream_mu:
+            sendq = self._sendq
+            self._sendq = None
+        if sendq is not None:
+            sendq.put(None)
+        super().close()
 
 
 class TrainerGRPCServer:

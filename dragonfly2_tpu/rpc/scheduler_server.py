@@ -52,6 +52,19 @@ def host_from_wire(data: dict) -> Host:
     return h
 
 
+def schedule_to_wire(res) -> dict:
+    """ScheduleResult → the wire dict both transports use for schedule
+    responses (request-paired and server-pushed alike)."""
+    out = {"need_back_to_source": False, "parents": []}
+    if res.kind is ScheduleResultKind.PARENTS:
+        out["parents"] = [
+            {"peer_id": p.id, "host": host_to_wire(p.host)} for p in res.parents
+        ]
+    elif res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
+        out["need_back_to_source"] = True
+    return out
+
+
 def host_to_wire(h: Host) -> dict:
     return {
         "id": h.id,
@@ -177,14 +190,7 @@ class SchedulerRPCAdapter:
         res = self.service.report_piece_failed(
             self._peer(req["peer_id"]), req.get("parent_id", "")
         )
-        out = {"need_back_to_source": False, "parents": []}
-        if res.kind is ScheduleResultKind.PARENTS:
-            out["parents"] = [
-                {"peer_id": p.id, "host": host_to_wire(p.host)} for p in res.parents
-            ]
-        elif res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
-            out["need_back_to_source"] = True
-        return out
+        return schedule_to_wire(res)
 
     def report_peer_finished(self, req: dict) -> dict:
         self.service.report_peer_finished(self._peer(req["peer_id"]))

@@ -498,18 +498,24 @@ class Peer:
         *,
         parent_id: str = "",
         length: int = 0,
-    ) -> None:
+    ) -> bool:
+        """Record a finished piece; False for a duplicate report.
+
+        Idempotent: a retried report (wire client re-sending after a
+        timeout) must not double-count the piece cost — callers use the
+        return value to gate THEIR side effects (parent serve-cost
+        evidence) on the first delivery only.
+        """
         with self._mu:
             if number in self.finished_pieces:
-                # Idempotent: a retried report (wire client re-sending after
-                # a timeout) must not double-count the piece cost.
-                return
+                return False
             self.finished_pieces.add(number)
             self.piece_costs_ns.append(cost_ns)
             self.pieces[number] = Piece(
                 number, parent_id=parent_id, length=length, cost_ns=cost_ns
             )
         self.updated_at = time.time()
+        return True
 
     def finished_piece_count(self) -> int:
         with self._mu:

@@ -140,6 +140,30 @@ class Scheduling:
 
     # -- the scheduling loop ------------------------------------------------
 
+    def schedule_once(
+        self, peer: Peer, blocklist: Optional[Set[str]] = None
+    ) -> ScheduleResult:
+        """Single-shot reschedule for server-push paths: no retry loop, no
+        sleeping (pushes run on stream handler / stall-monitor threads),
+        and — unlike the retry loop — the peer's CURRENT edges are only
+        detached once replacement candidates exist, so a failed attempt
+        leaves the child's real assignment untouched.
+        """
+        parents = self.find_candidate_parents(peer, blocklist)
+        if not parents:
+            return ScheduleResult(
+                kind=ScheduleResultKind.FAILED,
+                description="no candidates (single-shot)",
+            )
+        peer.task.delete_peer_in_edges(peer.id)
+        attached = [p for p in parents if peer.task.add_peer_edge(p, peer)]
+        if not attached:
+            return ScheduleResult(
+                kind=ScheduleResultKind.FAILED,
+                description="upload-slot races lost (single-shot)",
+            )
+        return ScheduleResult(kind=ScheduleResultKind.PARENTS, parents=attached)
+
     def schedule_candidate_parents(
         self, peer: Peer, blocklist: Optional[Set[str]] = None
     ) -> ScheduleResult:

@@ -71,6 +71,7 @@ class SchedulerService:
         networktopology: Optional[NetworkTopology] = None,
         *,
         seed_peer_trigger=None,
+        hub=None,
     ) -> None:
         self.resource = resource
         self.scheduling = scheduling
@@ -80,6 +81,11 @@ class SchedulerService:
         # the task (resource/seed_peer.go:93-229 TriggerDownloadTask; wired
         # to a seed daemon's conductor in-process, an RPC in deployments).
         self.seed_peer_trigger = seed_peer_trigger
+        # Optional PeerStreamHub (push.py): when a peer is connected over
+        # the bidi wire, scheduling decisions made OUTSIDE its own request
+        # cycle (bad-parent ejection, parent death, stalls) are pushed down
+        # its stream (service_v2.go:89-207 stream.Send semantics).
+        self.hub = hub
         self._mu = threading.Lock()
         self._seed_triggered: set = set()  # task ids already warmed
 
@@ -231,10 +237,31 @@ class SchedulerService:
     ) -> None:
         """DownloadPieceFinishedRequest (service_v2.go:1157)."""
         metrics.PIECE_RESULT_TOTAL.inc(result="finished")
-        peer.finish_piece(piece_number, cost_ns, parent_id=parent_id, length=length)
+        is_new = peer.finish_piece(
+            piece_number, cost_ns, parent_id=parent_id, length=length
+        )
         peer.task.store_piece(
             Piece(piece_number, parent_id=parent_id, length=length, cost_ns=cost_ns)
         )
+        if not is_new or not parent_id:
+            # Retried report (wire client re-sent after a timeout): the
+            # child side already deduped; the parent-side serve evidence
+            # must not double-count either.
+            return
+        # Serve-side evidence: the observed piece cost describes the PARENT
+        # as a server; it feeds the same 3σ/20×-mean bad-node test the
+        # evaluator runs on candidates (evaluator.go:92-129).  Appended on
+        # every transport so is_bad_node sees identical inputs whether or
+        # not a push hub is attached.
+        parent = self.resource.peer_manager.load(parent_id)
+        if parent is None:
+            return
+        parent.append_piece_cost(cost_ns)
+        # Bad-parent ejection push: the cost just appended may tip the
+        # parent over the test — if so, every *connected* child gets fresh
+        # candidates pushed, before any of them fails a piece.
+        if self.hub is not None and self.scheduling.evaluator.is_bad_node(parent):
+            self._push_reschedule_children(parent)
 
     def report_piece_failed(self, peer: Peer, parent_id: str) -> ScheduleResult:
         """Piece failure → blocklist the parent and reschedule
@@ -278,6 +305,10 @@ class SchedulerService:
             if self.storage is not None
             else None
         )
+        # A failed peer can no longer serve: its connected children get
+        # fresh candidates pushed (with it blocklisted) instead of burning
+        # piece retries against it.
+        self._push_reschedule_children(peer)
         # peer.go:293-305 (PeerEventDownloadFailed callback).
         peer.task.delete_peer_in_edges(peer.id)
         if self.storage is not None:
@@ -286,6 +317,10 @@ class SchedulerService:
 
     def leave_peer(self, peer: Peer) -> None:
         _try_event(peer.fsm, "Leave")
+        # A leaving parent strands its children: push them fresh candidates
+        # BEFORE the edges disappear (v2 semantics — the child never has to
+        # fail a piece against the dead parent first).
+        self._push_reschedule_children(peer)
         peer.task.delete_peer_in_edges(peer.id)
         peer.task.delete_peer_out_edges(peer.id)
         self._refresh_gauges()
@@ -295,6 +330,75 @@ class SchedulerService:
         if self.networktopology is not None:
             self.networktopology.delete_host(host.id)
         self._refresh_gauges()
+
+    # -- server push (service_v2.go stream.Send semantics) -------------------
+
+    def _push_reschedule_children(self, parent: Peer) -> None:
+        """Reschedule every *connected* child of ``parent`` away from it and
+        push the fresh candidates down their streams.
+
+        Only hub-subscribed children are touched: rescheduling moves DAG
+        edges, and a child that cannot hear about it must keep its current
+        assignment (it will recover through the report_piece_failed path
+        like the unary wire always did).
+        """
+        if self.hub is None:
+            return
+        try:
+            children = parent.task.load_children(parent.id)
+        except Exception:  # noqa: BLE001 — parent may already be off the DAG
+            return
+        for child in children or []:
+            if child.id == parent.id or child.is_done():
+                continue
+            # Claim the push slot BEFORE touching the DAG; schedule_once
+            # only detaches the child's edges when replacements exist and
+            # never sleeps (this runs on stream handler threads).
+            if not self.hub.claim(child.id):
+                continue
+            result = self.scheduling.schedule_once(child, {parent.id})
+            if result.kind is not ScheduleResultKind.PARENTS:
+                continue
+            if self.hub.push(child.id, result):
+                metrics.SCHEDULE_TOTAL.inc(outcome=f"push_{result.kind.name.lower()}")
+
+    def reschedule_stalled(self, max_idle_s: float) -> int:
+        """Server-initiated stall sweep: running peers with parents that
+        have not finished a piece within ``max_idle_s`` get fresh
+        candidates (current parents blocklisted) pushed.  Returns pushes.
+
+        The unary wire cannot express this — the child would have to fail
+        first.  Driven by push.StallMonitor (or tests) on an interval.
+        """
+        if self.hub is None:
+            return 0
+        now = time.time()
+        pushed = 0
+        for peer in self.resource.peer_manager.items():
+            if peer.is_done() or now - peer.updated_at <= max_idle_s:
+                continue
+            if not self.hub.subscribed(peer.id):
+                continue
+            try:
+                current = peer.task.load_parents(peer.id)
+            except Exception:  # noqa: BLE001 — raced with GC
+                continue
+            if not current:
+                continue
+            if not self.hub.claim(peer.id):
+                continue
+            result = self.scheduling.schedule_once(
+                peer, {p.id for p in current}
+            )
+            if result.kind is not ScheduleResultKind.PARENTS:
+                continue
+            if self.hub.push(peer.id, result):
+                peer.touch()  # restart the idle clock for the new parents
+                pushed += 1
+                metrics.SCHEDULE_TOTAL.inc(
+                    outcome=f"push_{result.kind.name.lower()}"
+                )
+        return pushed
 
     # -- probes (service_v2.go:721-866 SyncProbes) ---------------------------
 

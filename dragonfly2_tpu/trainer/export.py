@@ -257,21 +257,50 @@ def export_gnn_scorer(
             return_embeddings=True,
         )
     )
-    # Head layers: the Dense stack AFTER the embedding projection (Dense_0).
-    head_names = sorted(
-        (k for k in params if k.startswith("Dense_") and k != "Dense_0"),
+    # Head layers: the top-level Dense stack consuming [s, d, s*d].  The
+    # GATRanker carries one leading non-head Dense (the embedding
+    # projection); the HopRanker's encoder Denses live in a submodule so
+    # its head starts at Dense_0 — detect the head start by input width
+    # instead of hard-coding the model family.
+    dense_names = sorted(
+        (k for k in params if k.startswith("Dense_")),
         key=lambda k: int(k.split("_")[1]),
     )
-    head = [
-        (np.asarray(params[k]["kernel"], np.float32), np.asarray(params[k]["bias"], np.float32))
-        for k in head_names
-    ]
     expected_in = 3 * emb.shape[1]
-    if head and head[0][0].shape[0] != expected_in:
+
+    def _head_from(start: int):
+        """Validate the trailing Dense chain [start:]: widths must chain
+        and the final layer must be the scalar score head."""
+        ws = [
+            (np.asarray(params[k]["kernel"], np.float32),
+             np.asarray(params[k]["bias"], np.float32))
+            for k in dense_names[start:]
+        ]
+        if not ws or ws[0][0].shape[0] != expected_in or ws[-1][0].shape[1] != 1:
+            return None
+        for (w1, _), (w2, _) in zip(ws, ws[1:]):
+            if w1.shape[1] != w2.shape[0]:
+                return None
+        return ws
+
+    # LAST matching start wins: a leading non-head Dense (the GAT's
+    # embedding projection) can coincidentally share the input width, but
+    # it cannot chain through to the scalar output — the validation above
+    # rejects it.
+    head = next(
+        (
+            h
+            for i in range(len(dense_names) - 1, -1, -1)
+            if np.asarray(params[dense_names[i]]["kernel"]).shape[0] == expected_in
+            and (h := _head_from(i)) is not None
+        ),
+        None,
+    )
+    if head is None:
         raise ValueError(
-            f"head expects input width {head[0][0].shape[0]} but the scorer "
-            f"serves [s,d,s*d] = {expected_in}: models trained with "
-            "query_edge_feats are not exportable as a GNNScorer"
+            f"no trailing Dense chain consumes [s,d,s*d] width {expected_in} "
+            "and ends in a scalar head: models trained with query_edge_feats "
+            "are not exportable as a GNNScorer"
         )
     order = np.argsort(buckets)
     return GNNScorer(

@@ -95,12 +95,19 @@ class TrainerService:
         data_dir: Optional[str] = None,
         train_config: Optional[TrainConfig] = None,
         mlp_epochs: int = 30,
+        gnn_model: str = "hop",
     ) -> None:
         self.registry = registry or ModelRegistry()
         self.data_dir = data_dir
         self.train_config = train_config or TrainConfig(
             epochs=mlp_epochs, learning_rate=3e-3, warmup_steps=20
         )
+        # GNN family for the ingest-triggered training: "hop" (flagship —
+        # precomputed aggregation, scatter-free step, models/hop.py) or
+        # "gat" (models/gnn.py).  Both export the same GNNScorer artifact.
+        if gnn_model not in ("hop", "gat"):
+            raise ValueError(f"gnn_model {gnn_model!r} not in ('hop', 'gat')")
+        self.gnn_model = gnn_model
         self.runs: Dict[str, TrainRun] = {}
         self._mu = threading.Lock()
         self._counter = 0
@@ -343,26 +350,48 @@ class TrainerService:
         node_feats /= np.maximum(counts[:, None], 1.0)
 
         target = dl[:, -1].astype(np.float32)
-        cfg = GNNConfig(hidden=64, out_dim=32, num_layers=1, num_heads=2, dropout=0.0)
+        batch = min(2048, max(len(d_src) // 4, 64))
         try:
-            state, metrics, _ = train_gat_ranker(
-                node_feats,
-                table,
-                d_src,
-                d_dst,
-                target,
-                model_config=cfg,
-                config=self.train_config,
-                batch_size=min(2048, max(len(d_src) // 4, 64)),
-            )
+            if self.gnn_model == "hop":
+                import jax.numpy as jnp
+
+                from ..models.hop import HopConfig, HopRanker, precompute_hop_features
+                from .train import train_hop_ranker
+
+                cfg = HopConfig(hidden=64, out_dim=32, dropout=0.0)
+                # Compute the hop features ONCE: training and the scorer
+                # export must see the same array.
+                export_feats = np.asarray(
+                    precompute_hop_features(
+                        jnp.asarray(node_feats, jnp.float32), table,
+                        hops=cfg.hops,
+                    )
+                )
+                state, metrics, _ = train_hop_ranker(
+                    node_feats, table, d_src, d_dst, target,
+                    model_config=cfg, config=self.train_config,
+                    batch_size=batch, hop_feats=export_feats,
+                )
+                export_model = HopRanker(cfg)
+            else:
+                cfg = GNNConfig(hidden=64, out_dim=32, num_layers=1,
+                                num_heads=2, dropout=0.0)
+                state, metrics, _ = train_gat_ranker(
+                    node_feats, table, d_src, d_dst, target,
+                    model_config=cfg, config=self.train_config,
+                    batch_size=batch,
+                )
+                from ..models.gnn import GATRanker
+
+                export_model = GATRanker(cfg)
+                export_feats = node_feats
         except ValueError as exc:
             logger.warning("run %s: GNN skipped: %s", run.key, exc)
             return
-        from ..models.gnn import GATRanker
         from .export import export_gnn_scorer, gnn_scorer_to_bytes
 
         scorer = export_gnn_scorer(
-            GATRanker(cfg), state.params, node_feats, table, buckets
+            export_model, state.params, export_feats, table, buckets
         )
         model = self.registry.create_model(
             name=GNN_MODEL_NAME,

@@ -481,3 +481,95 @@ class TestDownloadRecordParents:
         )
         assert rec.state == "Failed"
         assert reg0.peer.id in [p.id for p in rec.parents]
+
+
+class TestServerPush:
+    """Push hub + service triggers (scheduler/push.py): parent death and
+    stalls push fresh schedules to subscribed children."""
+
+    def _service(self, tmp_path=None, cooldown=0.0):
+        from dragonfly2_tpu.scheduler.push import PeerStreamHub
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        hub = PeerStreamHub(push_cooldown_s=cooldown)
+        # One parent per child: the OTHER seed stays a fresh candidate, so
+        # single-shot push rescheduling has somewhere to move the child.
+        service = SchedulerService(
+            Resource(),
+            Scheduling(
+                Evaluator(),
+                SchedulingConfig(retry_interval=0, candidate_parent_limit=1),
+            ),
+            hub=hub,
+        )
+        return service, hub
+
+    def _seed_and_child(self, service):
+        url = "https://origin/push-blob"
+        regs = []
+        for i in range(2):
+            reg = service.register_peer(host=make_host(i), url=url)
+            service.set_task_info(reg.peer, content_length=40 << 20,
+                                  total_piece_count=10, piece_size=4 << 20)
+            for n in range(10):
+                service.report_piece_finished(reg.peer, n, length=4 << 20,
+                                              cost_ns=10_000_000)
+            service.report_peer_finished(reg.peer)
+            regs.append(reg)
+        child = service.register_peer(host=make_host(5), url=url)
+        assert child.schedule.kind is ScheduleResultKind.PARENTS
+        return regs, child
+
+    def test_parent_failure_pushes_children(self):
+        service, hub = self._service()
+        regs, child = self._seed_and_child(service)
+        got = []
+        hub.register(child.peer.id, got.append)
+        parent = child.schedule.parents[0]
+        service.report_peer_failed(parent)
+        assert got, "no push on parent failure"
+        res = got[0]
+        assert res.kind is ScheduleResultKind.PARENTS
+        assert parent.id not in [p.id for p in res.parents]
+
+    def test_leave_peer_pushes_children(self):
+        service, hub = self._service()
+        regs, child = self._seed_and_child(service)
+        got = []
+        hub.register(child.peer.id, got.append)
+        service.leave_peer(child.schedule.parents[0])
+        assert got and got[0].kind is ScheduleResultKind.PARENTS
+
+    def test_stall_sweep_pushes_idle_peers(self):
+        service, hub = self._service()
+        regs, child = self._seed_and_child(service)
+        got = []
+        hub.register(child.peer.id, got.append)
+        child.peer.updated_at -= 60  # pretend nothing happened for a minute
+        pushed = service.reschedule_stalled(max_idle_s=5)
+        assert pushed == 1 and got
+        # fresh parents exclude the stalled assignment
+        old = {p.id for p in child.schedule.parents}
+        assert not old & {p.id for p in got[0].parents}
+        # a repeated sweep immediately after pushes nothing (clock reset)
+        assert service.reschedule_stalled(max_idle_s=5) == 0
+
+    def test_cooldown_damps_push_storm(self):
+        service, hub = self._service(cooldown=60.0)
+        regs, child = self._seed_and_child(service)
+        got = []
+        hub.register(child.peer.id, got.append)
+        child.peer.updated_at -= 120
+        assert service.reschedule_stalled(max_idle_s=5) == 1
+        child.peer.updated_at -= 120
+        assert service.reschedule_stalled(max_idle_s=5) == 0  # cooldown holds
+        assert len(got) == 1
+
+    def test_unsubscribed_children_untouched(self):
+        service, hub = self._service()
+        regs, child = self._seed_and_child(service)
+        before = child.peer.task.load_parents(child.peer.id)
+        service.report_peer_failed(child.schedule.parents[0])
+        # no hub subscription → assignment not churned by the push path
+        after = child.peer.task.load_parents(child.peer.id)
+        assert [p.id for p in before] == [p.id for p in after]
