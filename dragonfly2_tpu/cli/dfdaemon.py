@@ -92,18 +92,100 @@ def run(argv=None) -> int:
     p.add_argument("--download", default=None, metavar="URL",
                    help="download one URL through the daemon and exit")
     p.add_argument("-O", "--output", default=None, help="output path (--download)")
+    p.add_argument("--seed-peer", action="store_true",
+                   help="announce as a seed peer and serve the ObtainSeeds "
+                        "endpoint the scheduler triggers cold tasks through")
+    p.add_argument("--pex-port", type=int, default=-1, metavar="PORT",
+                   help="enable networked peer-exchange gossip on this UDP "
+                        "port (0 = ephemeral, -1 = disabled)")
+    p.add_argument("--pex-join", default="", metavar="HOST:PORT[,...]",
+                   help="gossip seed addresses to join")
     args = p.parse_args(argv)
     init_logging(args, "dfdaemon")
     init_debug(args)
 
     cfg = load_config(DaemonConfig, args.config)
     parts = build(cfg, args.scheduler)
+
+    pex = None
+    if args.pex_port >= 0:
+        # Networked gossip (pex memberlist analog): piece-holder discovery
+        # that keeps serving through scheduler outages.
+        from ..daemon.pex import MemberMeta, PeerExchange
+        from ..daemon.pex_net import NetworkedGossipBus
+
+        seeds = []
+        for part in filter(None, args.pex_join.split(",")):
+            h, _, pp = part.rpartition(":")
+            seeds.append((h or "127.0.0.1", int(pp)))
+        bus = NetworkedGossipBus(
+            host=cfg.server.host, port=args.pex_port, seeds=seeds,
+            advertise_ip=parts["host"].ip,
+        )
+        pex = PeerExchange(
+            MemberMeta(
+                host_id=parts["host"].id,
+                ip=parts["host"].ip,
+                port=parts["piece_server"].port,
+            ),
+            bus,
+        )
+        pex.serve()
+        parts["conductor"].pex = pex
+
+        # Resolver chain: scheduler mirror first, gossip metadata second —
+        # piece fetches keep resolving when the control plane is down.
+        client = parts["client"]
+
+        def resolve(host_id):
+            try:
+                return client.resolve_host(host_id)
+            except KeyError:
+                m = pex.member(host_id)
+                if m is None:
+                    raise
+                return m.ip, m.port
+
+        from ..rpc import HTTPPieceFetcher
+
+        parts["conductor"].piece_fetcher = HTTPPieceFetcher(resolve)
+        print(f"dfdaemon: pex gossip on udp:{bus.address[1]}", flush=True)
+
+    seeder = None
+    if args.seed_peer:
+        # Seed mode (seeder.go:41-151): announce as SUPER_SEED and carry
+        # the control port in the announce so the scheduler's trigger
+        # client (scheduler/seed_client.py) can dial /obtain_seeds.
+        from ..daemon.seeder import Seeder
+        from ..utils.types import HostType
+
+        parts["host"].type = HostType.SUPER_SEED
+        seeder = Seeder(parts["conductor"], parts["storage"])
+
+    # Control API (daemon Download RPC analog): ALWAYS loopback-only —
+    # /download writes local files on behalf of same-machine dfget.
+    from ..rpc.daemon_control import DaemonControlServer, write_state
+
+    control = DaemonControlServer(
+        parts["conductor"], parts["storage"], piece_size=cfg.piece_size,
+    )
+    control.serve()
+    if args.seed_peer:
+        # Separate PUBLIC surface for the scheduler's cross-process
+        # trigger: /obtain_seeds (+/healthy) only, bound on the serving
+        # address and advertised via the host announce's port.
+        seed_endpoint = DaemonControlServer(
+            parts["conductor"], parts["storage"], piece_size=cfg.piece_size,
+            host=cfg.server.host, seeder=seeder, public=True,
+        )
+        seed_endpoint.serve()
+        parts["host"].port = seed_endpoint.address[1]
+
     parts["announcer"].serve()
 
     if args.download:
-        source = parts["conductor"].source_fetcher
-        content_length = source.content_length(args.download)
-        if content_length < 0:
+        content_length = parts["conductor"].probe_content_length(args.download)
+        if content_length is None or content_length < 0:
             print(f"dfdaemon: cannot size {args.download}", file=sys.stderr)
             return 1
         result = parts["conductor"].download(
@@ -158,18 +240,9 @@ def run(argv=None) -> int:
         sni.serve()
         print(f"dfdaemon: SNI proxy on :{sni.port}, trust anchor {ca_path}")
 
-    # Local control API (daemon Download RPC analog) + discovery state
-    # file so dfget finds or spawns this daemon (root.go:234-260).
-    from ..rpc.daemon_control import DaemonControlServer, write_state
-
-    # Ephemeral port: discovery is via the state file, and a fixed port
-    # would make parallel daemons on one machine collide.
-    control = DaemonControlServer(
-        parts["conductor"], parts["storage"], piece_size=cfg.piece_size,
-    )
-    control.serve()
-    # write_state uses state_path() — the SAME resolution dfget reads, so
-    # writer and reader can never disagree on the discovery location.
+    # Discovery state file so dfget finds or spawns this daemon
+    # (root.go:234-260).  write_state uses state_path() — the SAME
+    # resolution dfget reads, so writer and reader can never disagree.
     state_file = write_state(control.url)
 
     # Probe loop against the remote scheduler.

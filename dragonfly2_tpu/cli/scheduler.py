@@ -61,7 +61,15 @@ def build(cfg: SchedulerConfigFile):
         max_size=cfg.storage.max_size,
         max_backups=cfg.storage.max_backups,
     )
-    service = SchedulerService(resource, scheduling, storage, topology)
+    # Cold-task seed trigger: dials an announced seed daemon's
+    # /obtain_seeds stream (seed_peer.go:93-229 TriggerDownloadTask) —
+    # returns fast with False when no seed peer has announced.
+    from ..scheduler.seed_client import RemoteSeedPeerClient
+
+    service = SchedulerService(
+        resource, scheduling, storage, topology,
+        seed_peer_trigger=RemoteSeedPeerClient(resource),
+    )
     runner = dfgc.GC()
     runner.add(
         dfgc.Task(

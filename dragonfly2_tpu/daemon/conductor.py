@@ -23,7 +23,7 @@ from typing import List, Optional, Protocol, Sequence
 from ..scheduler.resource import Host, Peer
 from ..scheduler.service import SchedulerService
 from ..scheduler.scheduling import ScheduleResultKind
-from ..utils.types import TINY_FILE_SIZE
+from ..utils.types import TINY_FILE_SIZE, Priority
 from .storage import DaemonStorage
 from .traffic_shaper import TrafficShaper
 
@@ -73,12 +73,17 @@ class Conductor:
         max_piece_retries: int = 2,
         concurrent_source_groups: int = 1,
         concurrent_source_threshold: int = 2,
+        pex=None,
     ) -> None:
         self.host = host
         self.storage = storage
         self.scheduler = scheduler
         self.piece_fetcher = piece_fetcher
         self.source_fetcher = source_fetcher
+        # Optional PeerExchange (daemon/pex.py): piece-holder discovery
+        # that survives scheduler outages — registration failures fall
+        # back to gossip-discovered parents (pex peer_pool semantics).
+        self.pex = pex
         self.traffic_shaper = traffic_shaper
         self.max_piece_retries = max_piece_retries
         # Concurrent back-to-source (piece_manager.go:793-873 semantics):
@@ -92,6 +97,14 @@ class Conductor:
         # workers are serialized; only the origin fetch itself overlaps.
         self._report_lock = threading.Lock()
 
+    def probe_content_length(self, url: str) -> Optional[int]:
+        """Origin size via the source fetcher, when it can tell (shared by
+        the control API, the seeder, and the CLI --download path)."""
+        source = self.source_fetcher
+        if source is not None and hasattr(source, "content_length"):
+            return source.content_length(url)
+        return None
+
     # -- the main flow (peertask_conductor.go:370 start → pullPieces) --------
 
     def download(
@@ -102,6 +115,8 @@ class Conductor:
         content_length: Optional[int] = None,
         expected_pieces: Optional[int] = None,
         source_headers: Optional[dict] = None,
+        priority: Priority = Priority.LEVEL0,
+        task_id: Optional[str] = None,
     ) -> DownloadResult:
         """``source_headers`` ride along to the origin fetcher (preheat of
         authenticated registry blobs carries the pull token this way);
@@ -109,7 +124,17 @@ class Conductor:
         downloads and must not bleed one download's credentials into
         another's origin requests."""
         t0 = time.monotonic()
-        reg = self.scheduler.register_peer(host=self.host, url=url)
+        try:
+            reg = self.scheduler.register_peer(
+                host=self.host, url=url, priority=priority, task_id=task_id
+            )
+        except Exception:
+            # Scheduler unreachable: gossip keeps the swarm serving
+            # (pex reclaim/pool semantics — peers found WITHOUT the
+            # control plane).  No pex or no sizing → the failure is real.
+            if self.pex is None or not content_length or content_length < 0:
+                raise
+            return self._pull_via_pex(url, piece_size, content_length, t0)
         peer = reg.peer
         task = peer.task
 
@@ -163,6 +188,45 @@ class Conductor:
         finally:
             if self.traffic_shaper is not None:
                 self.traffic_shaper.remove_task(task.id)
+
+    def _pull_via_pex(
+        self, url: str, piece_size: int, content_length: int, t0: float
+    ) -> DownloadResult:
+        """Scheduler-less download: gossip-discovered holders serve pieces
+        directly (the pex pool is the only metadata source)."""
+        from ..utils import idgen
+
+        task_id = idgen.task_id(url)
+        n_pieces = (content_length + piece_size - 1) // piece_size
+        self.storage.register_task(
+            task_id, piece_size=piece_size, content_length=content_length
+        )
+        nbytes = 0
+        for number in range(n_pieces):
+            if self.storage.has_piece(task_id, number):
+                continue
+            fetched = False
+            for holder in self.pex.find_peers_with_piece(task_id, number):
+                if holder == self.host.id:
+                    continue
+                try:
+                    data = self.piece_fetcher.fetch(holder, task_id, number)
+                except Exception:  # noqa: BLE001 — try the next holder
+                    continue
+                self.storage.write_piece(task_id, number, data)
+                nbytes += len(data)
+                fetched = True
+                break
+            if not fetched:
+                return DownloadResult(
+                    ok=False, task_id=task_id, peer_id="", pieces=number,
+                    bytes=nbytes, cost_s=time.monotonic() - t0,
+                )
+        self.pex.advertise(task_id, set(range(n_pieces)))
+        return DownloadResult(
+            ok=True, task_id=task_id, peer_id="", pieces=n_pieces,
+            bytes=nbytes, cost_s=time.monotonic() - t0,
+        )
 
     def _pull_from_parents(
         self, peer: Peer, parents: List[Peer], n_pieces: int, t0: float
@@ -251,6 +315,8 @@ class Conductor:
             if not done:
                 return None
         self.scheduler.report_peer_finished(peer)
+        if self.pex is not None:
+            self.pex.advertise(task.id, set(range(n_pieces)))
         return DownloadResult(
             ok=True,
             task_id=task.id,
@@ -295,6 +361,8 @@ class Conductor:
         except _SourceFetchError as e:
             return self._fail(peer, t0, str(e))
         self.scheduler.report_peer_finished(peer)
+        if self.pex is not None:
+            self.pex.advertise(task.id, set(range(n_pieces)))
         return DownloadResult(
             ok=True,
             task_id=task.id,

@@ -105,6 +105,20 @@ class PeerExchange:
         with self._mu:
             return list(self._members.values())
 
+    def member(self, host_id: str) -> "MemberMeta | None":
+        with self._mu:
+            return self._members.get(host_id)
+
+    def pool_snapshot(self) -> List[tuple]:
+        """[(host_id, task_id, pieces)] — the full advertisement pool (the
+        anti-entropy sync payload)."""
+        with self._mu:
+            return [
+                (h, t, set(p))
+                for t, by_host in self._pool.items()
+                for h, p in by_host.items()
+            ]
+
     def find_peers_with_task(self, task_id: str) -> List[str]:
         with self._mu:
             return list(self._pool.get(task_id, {}))

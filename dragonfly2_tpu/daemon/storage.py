@@ -242,6 +242,14 @@ class DaemonStorage:
     def task_bytes(self, task_id: str) -> int:
         return self.engine.task_bytes(task_id)
 
+    def held_pieces(self, task_id: str) -> int:
+        """Pieces actually written and committed — NOT the header total
+        (n_pieces): progress reporting must count data on disk."""
+        try:
+            return self.engine.piece_count(task_id)
+        except Exception:  # noqa: BLE001 — unknown task → nothing held
+            return 0
+
     def n_pieces(self, task_id: str) -> int:
         """Piece count from the task header; -1 when the header is absent
         or invalid (single owner of the ceil-div + validity idiom)."""

@@ -68,10 +68,26 @@ class DaemonControlServer:
         piece_size: int = 4 << 20,
         host: str = "127.0.0.1",
         port: int = 0,
+        seeder=None,
+        public: bool = False,
     ) -> None:
+        """``seeder`` (daemon/seeder.Seeder) enables POST /obtain_seeds —
+        the scheduler-triggered prioritized seed download with a chunked
+        JSON-line event stream (seeder.go:41-151 ObtainSeeds analog).
+
+        ``public=True`` exposes ONLY /healthy and /obtain_seeds: the full
+        control surface (/download writes arbitrary local files) is a
+        same-machine contract and must never bind a routable interface —
+        seed daemons run one loopback control server AND one public
+        seed-endpoint server.
+        """
         outer_piece_size = piece_size
 
         class Handler(BaseHTTPRequestHandler):
+            # Chunked transfer (the /obtain_seeds event stream) requires 1.1;
+            # plain responses still carry explicit Content-Length.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *args):
                 pass
 
@@ -89,8 +105,65 @@ class DaemonControlServer:
                 else:
                     self._json(404, {"error": "not found"})
 
+            def _obtain_seeds(self):
+                """Chunked JSON-line event stream (ObtainSeeds analog)."""
+                if seeder is None:
+                    self._json(404, {"error": "not a seed peer"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                from ..utils.types import Priority
+
+                try:
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    url = req["url"]
+                    priority = Priority(int(req.get("priority", 0)))
+                except (KeyError, ValueError, TypeError) as exc:
+                    # Network-reachable input: malformed bodies (arrays,
+                    # priority outside 0..6) must get a clean 400, not a
+                    # dropped connection.
+                    self._json(400, {"error": str(exc)})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                closed = [False]
+
+                def emit(event: dict) -> None:
+                    if closed[0]:
+                        return
+                    line = (json.dumps(event) + "\n").encode()
+                    try:
+                        self.wfile.write(f"{len(line):x}\r\n".encode())
+                        self.wfile.write(line + b"\r\n")
+                        self.wfile.flush()
+                    except OSError:
+                        # Scheduler hung up — the seed download continues
+                        # (children still benefit), only the stream stops.
+                        closed[0] = True
+
+                try:
+                    seeder.obtain(
+                        url,
+                        piece_size=int(req.get("piece_size") or outer_piece_size),
+                        priority=priority,
+                        content_length=req.get("content_length"),
+                        task_id=req.get("task_id") or None,
+                        emit=emit,
+                    )
+                finally:
+                    if not closed[0]:
+                        try:
+                            self.wfile.write(b"0\r\n\r\n")
+                            self.wfile.flush()
+                        except OSError:
+                            pass
+
             def do_POST(self):
-                if self.path != "/download":
+                if self.path == "/obtain_seeds":
+                    self._obtain_seeds()
+                    return
+                if public or self.path != "/download":
                     self._json(404, {"error": "not found"})
                     return
                 length = int(self.headers.get("Content-Length", 0))
@@ -98,10 +171,7 @@ class DaemonControlServer:
                     req = json.loads(self.rfile.read(length) or b"{}")
                     url = req["url"]
                     piece_size = int(req.get("piece_size") or outer_piece_size)
-                    source = conductor.source_fetcher
-                    content_length = None
-                    if source is not None and hasattr(source, "content_length"):
-                        content_length = source.content_length(url)
+                    content_length = conductor.probe_content_length(url)
                     result = conductor.download(
                         url, piece_size=piece_size,
                         content_length=content_length,

@@ -128,6 +128,7 @@ class RemoteScheduler:
         task_id: Optional[str] = None,
         tag: str = "",
         application: str = "",
+        priority=None,
         **_ignored,
     ) -> RegisterResult:
         with self._mu:
@@ -143,7 +144,8 @@ class RemoteScheduler:
 
         peer_id = peer_id or idgen.peer_id(host.ip, host.hostname)
         req = {"host_id": host.id, "url": url, "peer_id": peer_id,
-               "task_id": task_id, "tag": tag, "application": application}
+               "task_id": task_id, "tag": tag, "application": application,
+               "priority": int(priority) if priority is not None else 0}
         try:
             resp = self._call("register_peer", req)
         except RPCError as exc:

@@ -125,6 +125,8 @@ class SchedulerRPCAdapter:
         host = self.service.resource.host_manager.load(req["host_id"])
         if host is None:
             raise KeyError(f"unknown host {req['host_id']} (announce first)")
+        from ..utils.types import Priority
+
         result = self.service.register_peer(
             host=host,
             url=req["url"],
@@ -132,6 +134,9 @@ class SchedulerRPCAdapter:
             task_id=req.get("task_id"),
             tag=req.get("tag", ""),
             application=req.get("application", ""),
+            # Clamp: wire clients may send out-of-range levels; an invalid
+            # priority must not fail the registration.
+            priority=Priority(max(0, min(6, int(req.get("priority", 0) or 0)))),
         )
         peer = result.peer
         self._track(peer)
