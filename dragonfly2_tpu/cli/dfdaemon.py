@@ -18,7 +18,7 @@ from ..config import DaemonConfig, load_config
 from ..daemon import DaemonStorage, UploadManager
 from ..daemon.conductor import Conductor
 from ..daemon.host_announcer import HostAnnouncer
-from ..rpc import HTTPPieceFetcher, PieceHTTPServer, RemoteScheduler
+from ..rpc import HTTPPieceFetcher, RemoteScheduler
 from ..scheduler.resource import Host
 from ..source import PieceSourceFetcher
 from ..utils import idgen
@@ -34,7 +34,13 @@ def build(cfg: DaemonConfig, scheduler_url: str):
         configure_sources(cfg.source)
     storage = DaemonStorage(cfg.storage.dir, quota_bytes=cfg.storage.quota_bytes)
     upload = UploadManager(storage, concurrent_limit=cfg.concurrent_upload_limit)
-    piece_server = PieceHTTPServer(upload, host=cfg.server.host)
+    # Native-engine stores serve pieces from the C++ server (sendfile hot
+    # path); Python HTTP remains the fallback/TLS server.
+    from ..rpc.piece_transport import make_piece_server
+
+    piece_server = make_piece_server(
+        upload, host=cfg.server.host,
+    )
     piece_server.serve()
 
     hostname = socket.gethostname()

@@ -63,6 +63,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ps_delete_task.argtypes = [i64, ctypes.c_char_p]
     lib.ps_close.restype = i32
     lib.ps_close.argtypes = [i64]
+    lib.ps_serve.restype = i64
+    lib.ps_serve.argtypes = [i64, ctypes.c_char_p, ctypes.c_uint16, i32]
+    lib.ps_serve_stop.restype = i32
+    lib.ps_serve_stop.argtypes = [i64]
+    lib.ps_serve_stats.restype = i32
+    lib.ps_serve_stats.argtypes = [
+        i64, ctypes.POINTER(i64), ctypes.POINTER(i64)
+    ]
 
 
 def load(rebuild: bool = False) -> Optional[ctypes.CDLL]:
@@ -258,6 +266,26 @@ class NativePieceStore:
 
     def delete_task(self, task_id: str) -> None:
         self._lib.ps_delete_task(self._h, task_id.encode())
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0,
+              *, concurrent_limit: int = 64) -> int:
+        """Start the in-engine HTTP piece server (native.cpp ps_serve):
+        piece/bitmap/range GETs served via sendfile, no GIL on the data
+        path.  Returns the bound port."""
+        p = self._lib.ps_serve(self._h, host.encode(), port, concurrent_limit)
+        if p < 0:
+            raise NativeError(f"ps_serve -> {p}")
+        return int(p)
+
+    def serve_stop(self) -> None:
+        self._lib.ps_serve_stop(self._h)
+
+    def serve_stats(self) -> tuple:
+        """(pieces_served, bytes_served) while the server runs."""
+        p = ctypes.c_int64(0)
+        b = ctypes.c_int64(0)
+        self._lib.ps_serve_stats(self._h, ctypes.byref(p), ctypes.byref(b))
+        return int(p.value), int(b.value)
 
     def close(self) -> None:
         if self._h >= 0:

@@ -1,0 +1,150 @@
+// Concurrency self-test for the native engine (run under TSAN/ASAN via
+// `make tsan` / `make asan` — the SURVEY §5.2 sanitizer gate).
+//
+// Exercises the shared-state paths that matter under threads:
+//   1. concurrent piece writers on distinct tasks + readers on the same
+//      task (TaskStore mutex, PieceStore map);
+//   2. the in-engine HTTP server under 8 concurrent fetchers while a
+//      writer keeps committing new pieces (server threads vs writer);
+//   3. delete-while-reading (shared_ptr lifetime + closed flag).
+//
+// The library source is #included so the sanitizers see one TU.
+
+#include "native.cpp"
+
+#include <cassert>
+#include <cstdlib>
+#include <functional>
+
+namespace {
+
+int http_get(uint16_t port, const std::string& path, std::string& body) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  std::string req = "GET " + path + " HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n";
+  send_all(fd, req.data(), req.size());
+  std::string resp;
+  char buf[8192];
+  ssize_t n;
+  while ((n = recv(fd, buf, sizeof(buf), 0)) > 0) resp.append(buf, (size_t)n);
+  close(fd);
+  size_t hdr = resp.find("\r\n\r\n");
+  if (hdr == std::string::npos) return -2;
+  body = resp.substr(hdr + 4);
+  return atoi(resp.c_str() + 9);
+}
+
+std::vector<uint8_t> piece_bytes(uint32_t task, uint32_t number, size_t len) {
+  std::vector<uint8_t> v(len);
+  for (size_t i = 0; i < len; i++) v[i] = (uint8_t)((task * 31 + number * 7 + i) & 0xFF);
+  return v;
+}
+
+}  // namespace
+
+int main() {
+  char tmpl[] = "/tmp/native_test_XXXXXX";
+  std::string root = mkdtemp(tmpl);
+  int64_t h = ps_open(root.c_str());
+  assert(h > 0);
+  const uint32_t kPiece = 256 * 1024;
+
+  // 1. Concurrent writers on distinct tasks + readers chasing them.
+  {
+    std::vector<std::thread> ts;
+    std::atomic<int> errors{0};
+    for (int t = 0; t < 4; t++) {
+      ts.emplace_back([&, t] {
+        std::string task = "task-" + std::to_string(t);
+        if (ps_create_task(h, task.c_str(), kPiece, 8 * kPiece) != 0) {
+          errors++;
+          return;
+        }
+        for (uint32_t n = 0; n < 8; n++) {
+          auto data = piece_bytes(t, n, kPiece);
+          if (ps_write_piece(h, task.c_str(), n, data.data(), kPiece) < 0) errors++;
+        }
+      });
+      ts.emplace_back([&, t] {
+        std::string task = "task-" + std::to_string(t);
+        std::vector<uint8_t> buf(kPiece);
+        for (int spin = 0; spin < 200; spin++) {
+          int64_t c = ps_piece_count(h, task.c_str());
+          if (c >= 8) {
+            for (uint32_t n = 0; n < 8; n++) {
+              int64_t r = ps_read_piece(h, task.c_str(), n, buf.data(), kPiece, 1);
+              if (r != (int64_t)kPiece) errors++;
+            }
+            return;
+          }
+          usleep(1000);
+        }
+      });
+    }
+    for (auto& t : ts) t.join();
+    assert(errors.load() == 0);
+  }
+
+  // 2. HTTP server under concurrent fetchers while a writer commits.
+  {
+    int64_t port = ps_serve(h, "127.0.0.1", 0, 64);
+    assert(port > 0);
+    std::atomic<int> errors{0};
+    std::thread writer([&] {
+      ps_create_task(h, "live", kPiece, 16 * kPiece);
+      for (uint32_t n = 0; n < 16; n++) {
+        auto data = piece_bytes(99, n, kPiece);
+        if (ps_write_piece(h, "live", n, data.data(), kPiece) < 0) errors++;
+        usleep(2000);
+      }
+    });
+    std::vector<std::thread> fetchers;
+    for (int f = 0; f < 8; f++) {
+      fetchers.emplace_back([&, f] {
+        std::string body;
+        for (int round = 0; round < 30; round++) {
+          uint32_t n = (uint32_t)((f + round) % 8);
+          std::string want_task = "task-" + std::to_string(f % 4);
+          int code = http_get((uint16_t)port, "/pieces/" + want_task + "/" +
+                              std::to_string(n), body);
+          if (code != 200 || body.size() != kPiece) errors++;
+          auto want = piece_bytes((uint32_t)(f % 4), n, kPiece);
+          if (memcmp(body.data(), want.data(), kPiece) != 0) errors++;
+          // bitmap + range while the live task is still being written
+          http_get((uint16_t)port, "/tasks/live/pieces", body);
+        }
+      });
+    }
+    writer.join();
+    for (auto& t : fetchers) t.join();
+    std::string body;
+    assert(http_get((uint16_t)port, "/tasks/task-0/pieces", body) == 200);
+    assert(body.size() == 8);
+    assert(http_get((uint16_t)port, "/pieces/ghost/0", body) == 404);
+    assert(errors.load() == 0);
+    assert(ps_serve_stop(h) == 0);
+  }
+
+  // 3. delete-while-reading.
+  {
+    std::thread reader([&] {
+      std::vector<uint8_t> buf(kPiece);
+      for (int i = 0; i < 200; i++)
+        ps_read_piece(h, "task-1", (uint32_t)(i % 8), buf.data(), kPiece, 1);
+    });
+    usleep(1000);
+    ps_delete_task(h, "task-1");
+    reader.join();
+  }
+
+  assert(ps_close(h) == 0);
+  printf("native_test: OK\n");
+  return 0;
+}

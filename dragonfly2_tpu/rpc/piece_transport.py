@@ -121,6 +121,94 @@ class PieceHTTPServer:
         self._svc.stop()
 
 
+class NativePieceServer:
+    """PieceHTTPServer-compatible facade over the C++ in-engine server
+    (native.cpp ps_serve): same wire contract, but piece/range bodies go
+    kernel→socket via sendfile with no Python on the data path — the
+    upload_manager.go-grade hot path (BENCHMARKS.md piece-plane table).
+
+    Binds AND serves from __init__ (the engine has no separate bind
+    phase); ``serve()`` is a compatibility no-op.
+    """
+
+    def __init__(
+        self,
+        upload: UploadManager,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        concurrent_limit: int = 64,
+    ):
+        import socket as _socket
+
+        from ..native import NativePieceStore
+
+        engine = upload.storage.engine
+        if not isinstance(engine, NativePieceStore):
+            raise TypeError(
+                "NativePieceServer needs a native-engine DaemonStorage "
+                "(prefer_native=True and a built libdragonfly_native.so)"
+            )
+        self.upload = upload
+        self._engine = engine
+        # The engine binds via inet_pton (IPv4 literal only); resolve
+        # hostnames here so configs that worked with the Python server
+        # (server.host: "localhost") keep working.
+        bind_ip = _socket.gethostbyname(host)
+        bound = engine.serve(bind_ip, port, concurrent_limit=concurrent_limit)
+        self.address: Tuple[str, int] = (bind_ip, bound)
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    @property
+    def upload_count(self) -> int:
+        """Pieces served (UploadManager.upload_count parity — the C++
+        server accounts in-engine, ps_serve_stats)."""
+        return self._engine.serve_stats()[0]
+
+    @property
+    def bytes_served(self) -> int:
+        return self._engine.serve_stats()[1]
+
+    def serve(self) -> None:  # already serving — interface parity
+        pass
+
+    def stop(self) -> None:
+        self._engine.serve_stop()
+
+
+def make_piece_server(
+    upload: UploadManager,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    *,
+    ssl_context=None,
+    prefer_native: bool = True,
+):
+    """Best available piece server: the C++ in-engine one when the store
+    runs the native engine (and no TLS is required — the native server
+    speaks plain HTTP; mTLS deployments keep the Python server), else the
+    Python ThreadingHTTPServer.  The upload manager's configured
+    concurrency cap carries into the native server's 503 limit."""
+    from ..native import NativePieceStore
+
+    if (
+        prefer_native
+        and ssl_context is None
+        and isinstance(getattr(upload.storage, "engine", None), NativePieceStore)
+    ):
+        try:
+            return NativePieceServer(
+                upload, host, port,
+                concurrent_limit=getattr(upload, "concurrent_limit", 64),
+            )
+        except Exception:  # noqa: BLE001 — unresolvable host / engine error
+            pass  # Python server below handles what the engine cannot
+    return PieceHTTPServer(upload, host, port, ssl_context=ssl_context)
+
+
 class HTTPPieceFetcher:
     """Conductor's PieceFetcher over HTTP.
 
