@@ -81,7 +81,8 @@ def run(argv=None) -> int:
             auth["oauth"] = oauth
     rest = ManagerRESTServer(
         parts["registry"], parts["clusters"], parts["searcher"],
-        host=cfg.server.host, port=cfg.server.port, **auth,
+        host=cfg.server.host, port=cfg.server.port,
+        jobqueue=parts["jobs"], **auth,
     )
     rest.serve()
     grpc_server = None

@@ -142,6 +142,42 @@ def run(argv=None) -> int:
                 interval_s=cfg.scheduling.stall_sweep_interval_s,
             )
             stall_monitor.start()
+    # Remote job worker (machinery-consumer analog, scheduler/job/job.go):
+    # polls this scheduler's queue on the MANAGER's broker so preheat /
+    # sync_peers fan-outs work across process boundaries.
+    job_worker = None
+    if cfg.manager_addr:
+        import socket as _socket
+
+        from ..jobs.preheat import PREHEAT
+        from ..jobs.remote import RemoteJobWorker
+        from ..jobs.sync_peers import SYNC_PEERS, make_sync_peers_handler
+        from ..utils import idgen
+
+        scheduler_id = f"sched-{_socket.gethostname()}"
+        # Queue naming matches the manager-side producers (SyncPeers fans
+        # to f"scheduler:{sched.id}", jobs/sync_peers.py) so their jobs
+        # land where this worker polls.
+        job_worker = RemoteJobWorker(
+            cfg.manager_addr, f"scheduler:{scheduler_id}"
+        )
+
+        def preheat_handler(args):
+            # Warm each URL into an announced seed daemon via the
+            # ObtainSeeds trigger (job.go:244-283 → TriggerDownloadTask).
+            if service.seed_peer_trigger is None:
+                raise RuntimeError("no seed trigger configured")
+            results = {}
+            for url in args.get("urls", []):
+                if not service.seed_peer_trigger(url, idgen.task_id(url)):
+                    raise RuntimeError(f"preheat of {url}: no seed served it")
+                results[url] = "seeded"
+            return results
+
+        job_worker.register(PREHEAT, preheat_handler)
+        job_worker.register(SYNC_PEERS, make_sync_peers_handler(service.resource))
+        job_worker.serve()
+
     # Periodic dataset upload to the trainer (announcer.go:127-142 train
     # ticker, default 7d) — the link that feeds the learning loop in a
     # real deployment.
@@ -207,6 +243,8 @@ def run(argv=None) -> int:
         + (f" and grpc on {grpc_server.target}" if grpc_server else "")
         + (f", dataset uploads to {cfg.trainer.addr} every "
            f"{cfg.trainer.interval_s:.0f}s" if announcer else "")
+        + (f", job queue {job_worker.queue_name} on {cfg.manager_addr}"
+           if job_worker else "")
         + " (ctrl-c to stop)",
         flush=True,
     )
@@ -219,6 +257,8 @@ def run(argv=None) -> int:
             grpc_server.stop()
         if announcer is not None:
             announcer.stop()
+        if job_worker is not None:
+            job_worker.stop()
         return 0
 
 

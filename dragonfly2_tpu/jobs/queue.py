@@ -41,6 +41,10 @@ class Job:
     # a late-attaching consumer must not replay a backlog of stale
     # interval jobs whose results nobody reads.  0 = never expires.
     expires_at: float = 0.0
+    # Set when a wire worker pops the job (poll): STARTED jobs older than
+    # the visibility window get requeued (at-least-once — the worker may
+    # have died before reporting).
+    started_at: float = 0.0
 
 
 @dataclass
@@ -131,6 +135,91 @@ class JobQueue:
             return self._q(queue_name).get(timeout=timeout)
         except queue.Empty:
             return None
+
+    def poll(
+        self,
+        queue_name: str,
+        timeout: Optional[float] = None,
+        *,
+        requeue_started_after_s: float = 120.0,
+    ) -> Optional[Job]:
+        """Wire-safe pop for remote workers: skips jobs no longer PENDING
+        (pruned/evicted), fails expired ones instead of delivering them
+        (the in-process Worker's expires_at contract), marks the returned
+        job STARTED, and first REQUEUES jobs a dead worker popped but
+        never reported (at-least-once)."""
+        self._requeue_stale_started(queue_name, requeue_started_after_s)
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            remaining = None if deadline is None else max(deadline - time.time(), 0)
+            job = self.get(queue_name, timeout=remaining)
+            if job is None:
+                return None
+            now = time.time()
+            with self._mu:
+                if job.state is not JobState.PENDING:
+                    continue  # pruned/evicted while queued
+                if job.expires_at and now > job.expires_at:
+                    job.state = JobState.FAILURE
+                    job.error = "expired before execution"
+                    continue
+                job.state = JobState.STARTED
+                job.started_at = now
+            return job
+
+    def _requeue_stale_started(self, queue_name: str, max_age_s: float) -> None:
+        if max_age_s <= 0:
+            return
+        cutoff = time.time() - max_age_s
+        stale = []
+        with self._mu:
+            for j in self.jobs.values():
+                if (
+                    j.queue == queue_name
+                    and j.state is JobState.STARTED
+                    and 0 < j.started_at < cutoff
+                ):
+                    j.state = JobState.PENDING
+                    j.started_at = 0.0
+                    stale.append(j)
+        for j in stale:
+            self._q(queue_name).put(j)
+
+    def set_result(
+        self, job_id: str, state: JobState, result: Any = None, error: str = ""
+    ) -> None:
+        """Record a job outcome by id — the wire workers' completion path
+        (in-process Workers mutate the shared Job object directly)."""
+        with self._mu:
+            job = self.jobs.get(job_id)
+            if job is None:
+                raise KeyError(job_id)
+            job.state = state
+            job.result = result
+            job.error = error
+
+    def group_snapshot(self, group_id: str) -> Dict[str, Any]:
+        """Group state + per-job states (the jobs API's GET view)."""
+        with self._mu:
+            group = self.groups.get(group_id)
+            if group is None:
+                raise KeyError(group_id)
+            return {
+                "group_id": group_id,
+                "state": group.state(self.jobs).value,
+                "jobs": [
+                    {
+                        "id": j.id,
+                        "queue": j.queue,
+                        "type": j.type,
+                        "state": j.state.value,
+                        "error": j.error,
+                        "result": j.result,
+                    }
+                    for j in (self.jobs.get(jid) for jid in group.job_ids)
+                    if j is not None
+                ],
+            }
 
     def prune(self, max_age_s: float) -> int:
         """Drop terminal job records (and emptied groups) older than

