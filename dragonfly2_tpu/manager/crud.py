@@ -1,0 +1,163 @@
+"""Manager CRUD resources: applications + scheduler-cluster records.
+
+Reference: the manager's GORM models and REST handlers
+(manager/handlers/application.go, scheduler_cluster.go,
+models/application.go, models/scheduler_cluster.go) — applications tag
+traffic for per-app policy; scheduler-cluster rows carry the CLUSTER
+CONFIG (candidate/filter parent limits, client load limits) that
+schedulers consume through dynconfig (scheduler/scheduling/
+scheduling.go:404-410 reads the limits per scheduling pass).
+
+Storage: one sqlite table of JSON rows (or memory when no db_path) —
+the write-through pattern `_SQLiteModelStore` uses.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class Application:
+    """models/application.go row: per-application traffic identity."""
+
+    id: str
+    name: str
+    url: str = ""
+    bio: str = ""
+    priority: int = 0
+
+
+@dataclass
+class ClusterRecord:
+    """models/scheduler_cluster.go row + its config blobs.
+
+    ``scheduler_cluster_config`` carries the scheduling limits the
+    scheduler's dynconfig applies live (candidate_parent_limit,
+    filter_parent_limit); ``client_config`` the daemon-side knobs
+    (load_limit); ``scopes`` the searcher's affinity inputs.
+    """
+
+    id: str
+    name: str = ""
+    is_default: bool = False
+    scheduler_cluster_config: Dict[str, Any] = field(default_factory=dict)
+    client_config: Dict[str, Any] = field(default_factory=dict)
+    scopes: Dict[str, Any] = field(default_factory=dict)
+
+
+_KINDS = {"application": Application, "cluster": ClusterRecord}
+
+
+class CrudStore:
+    """JSON-row store for the manager's CRUD resources."""
+
+    def __init__(self, db_path: Optional[str] = None) -> None:
+        self._mu = threading.RLock()
+        self._rows: Dict[str, Dict[str, dict]] = {k: {} for k in _KINDS}
+        self._db: Optional[sqlite3.Connection] = None
+        if db_path:
+            self._db = sqlite3.connect(db_path, check_same_thread=False)
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS crud_rows ("
+                "kind TEXT, id TEXT, value TEXT, PRIMARY KEY (kind, id))"
+            )
+            for kind, id_, value in self._db.execute(
+                "SELECT kind, id, value FROM crud_rows"
+            ):
+                if kind in self._rows:
+                    self._rows[kind][id_] = json.loads(value)
+
+    def _persist(self, kind: str, id_: str, row: Optional[dict]) -> None:
+        if self._db is None:
+            return
+        with self._db:
+            if row is None:
+                self._db.execute(
+                    "DELETE FROM crud_rows WHERE kind=? AND id=?", (kind, id_)
+                )
+            else:
+                self._db.execute(
+                    "INSERT OR REPLACE INTO crud_rows (kind, id, value) "
+                    "VALUES (?, ?, ?)",
+                    (kind, id_, json.dumps(row)),
+                )
+
+    # -- generic ops ---------------------------------------------------------
+
+    def create(self, kind: str, **fields: Any):
+        cls = _KINDS[kind]
+        with self._mu:
+            row_id = fields.pop("id", None) or uuid.uuid4().hex[:12]
+            if row_id in self._rows[kind]:
+                raise ValueError(f"{kind} {row_id!r} already exists")
+            obj = cls(id=row_id, **fields)
+            self._rows[kind][row_id] = asdict(obj)
+            self._persist(kind, row_id, self._rows[kind][row_id])
+            return obj
+
+    def get(self, kind: str, row_id: str):
+        cls = _KINDS[kind]
+        with self._mu:
+            row = self._rows[kind].get(row_id)
+            return cls(**row) if row else None
+
+    def list(self, kind: str) -> List[Any]:
+        cls = _KINDS[kind]
+        with self._mu:
+            return [cls(**r) for r in self._rows[kind].values()]
+
+    def update(self, kind: str, row_id: str, **fields: Any):
+        cls = _KINDS[kind]
+        with self._mu:
+            row = self._rows[kind].get(row_id)
+            if row is None:
+                raise KeyError(f"{kind} {row_id!r} not found")
+            allowed = {f for f in row.keys() if f != "id"}
+            for k, v in fields.items():
+                if k not in allowed:
+                    raise ValueError(f"unknown field {k!r} for {kind}")
+                row[k] = v
+            self._persist(kind, row_id, row)
+            return cls(**row)
+
+    def delete(self, kind: str, row_id: str) -> None:
+        with self._mu:
+            if self._rows[kind].pop(row_id, None) is None:
+                raise KeyError(f"{kind} {row_id!r} not found")
+            self._persist(kind, row_id, None)
+
+    # -- cluster conveniences ------------------------------------------------
+
+    def ensure_default_cluster(self) -> ClusterRecord:
+        """The reference seeds a default scheduler cluster at migration
+        time; dynconfig consumers need it to exist."""
+        with self._mu:
+            for row in self._rows["cluster"].values():
+                if row.get("is_default"):
+                    return ClusterRecord(**row)
+        return self.create(
+            "cluster", id="default", name="default", is_default=True,
+            scheduler_cluster_config={
+                "candidate_parent_limit": 4,
+                "filter_parent_limit": 15,
+            },
+            client_config={"load_limit": 50},
+        )
+
+    def cluster_config(self, cluster_id: str) -> Dict[str, Any]:
+        """The dynconfig payload a scheduler polls
+        (scheduling.go:404-410 limit consumption)."""
+        cluster = self.get("cluster", cluster_id)
+        if cluster is None:
+            raise KeyError(f"cluster {cluster_id!r} not found")
+        return {
+            "cluster_id": cluster.id,
+            "scheduler_cluster_config": dict(cluster.scheduler_cluster_config),
+            "client_config": dict(cluster.client_config),
+        }
