@@ -145,21 +145,36 @@ def run(argv=None) -> int:
     # Remote job worker (machinery-consumer analog, scheduler/job/job.go):
     # polls this scheduler's queue on the MANAGER's broker so preheat /
     # sync_peers fan-outs work across process boundaries.
-    job_worker = None
-    if cfg.manager_addr:
-        import socket as _socket
+    # ONE identity for registration, job-queue naming, and the announcer's
+    # keepalive tick — their equality is load-bearing (the keepalive
+    # self-heal only re-registers the id it registered).
+    import socket as _socket
 
+    scheduler_id = f"sched-{_socket.gethostname()}"
+    job_worker = None
+    cluster_link = None
+    if cfg.manager_addr:
         from ..jobs.preheat import PREHEAT
         from ..jobs.remote import RemoteJobWorker
         from ..jobs.sync_peers import SYNC_PEERS, make_sync_peers_handler
+        from ..rpc.cluster_client import RemoteClusterClient
         from ..utils import idgen
 
-        scheduler_id = f"sched-{_socket.gethostname()}"
-        # Queue naming matches the manager-side producers (SyncPeers fans
-        # to f"scheduler:{sched.id}", jobs/sync_peers.py) so their jobs
-        # land where this worker polls.
+        token = cfg.manager_token or None
+        # Register THIS instance with the manager so the manager-side
+        # producers (SyncPeers fans to f"scheduler:{sched.id}" for
+        # *registered* schedulers, jobs/sync_peers.py) target the queue
+        # this worker polls; the keepalive loop re-registers after a
+        # manager restart.  A failed first registration only warns — the
+        # loop keeps retrying while the worker polls.
+        cluster_link = RemoteClusterClient(cfg.manager_addr, token=token)
+        cluster_link.register_scheduler(
+            id=scheduler_id, cluster_id=cfg.cluster_id,
+            hostname=_socket.gethostname(), ip=cfg.server.host,
+            port=cfg.server.port,
+        )
         job_worker = RemoteJobWorker(
-            cfg.manager_addr, f"scheduler:{scheduler_id}"
+            cfg.manager_addr, f"scheduler:{scheduler_id}", token=token
         )
 
         def preheat_handler(args):
@@ -183,8 +198,6 @@ def run(argv=None) -> int:
     # real deployment.
     announcer = None
     if cfg.trainer.enable and cfg.trainer.addr:
-        import socket as _socket
-
         from ..scheduler.announcer import Announcer
 
         if cfg.trainer.addr.startswith("grpc://"):
@@ -229,14 +242,24 @@ def run(argv=None) -> int:
 
             trainer_link = RemoteTrainer(cfg.trainer.addr)
         announcer = Announcer(
-            scheduler_id=f"sched-{_socket.gethostname()}",
+            scheduler_id=scheduler_id,
             storage=storage,
             trainer=trainer_link,
-            ip="127.0.0.1",
+            # The Announcer's own loop drives manager liveness over the
+            # REST wire when both links are configured (one loop, not
+            # two) — same ip/port the CLI registered, so the keepalive
+            # self-heal re-registers a reachable address.
+            cluster_manager=cluster_link,
+            cluster_id=cfg.cluster_id,
+            ip=cfg.server.host,
+            port=cfg.server.port,
             hostname=_socket.gethostname(),
             train_interval=cfg.trainer.interval_s,
         )
         announcer.serve()
+    if cluster_link is not None and announcer is None:
+        # No Announcer to tick liveness → the client's own thin loop.
+        cluster_link.serve()
 
     print(
         f"scheduler: serving rpc on {rpc_server.url}"
@@ -259,6 +282,8 @@ def run(argv=None) -> int:
             announcer.stop()
         if job_worker is not None:
             job_worker.stop()
+        if cluster_link is not None:
+            cluster_link.stop()
         return 0
 
 

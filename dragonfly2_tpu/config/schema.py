@@ -127,6 +127,9 @@ class SchedulerConfigFile:
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     log: LogConfig = field(default_factory=LogConfig)
     manager_addr: str = ""
+    # Bearer credential (PAT or session token) for the manager's RBAC'd
+    # job-poll and registration routes; empty on open managers.
+    manager_token: str = ""
     cluster_id: str = "default"
 
     def validate(self) -> None:

@@ -42,7 +42,10 @@ class RemoteJobClient:
         self.token = token
         self.timeout = timeout
 
-    def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+    def call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        """Bearer-authed JSON request against the manager REST surface —
+        the ONE urllib wrapper shared by the job wire and the cluster
+        registration wire (rpc/cluster_client.py)."""
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"}
         if self.token:
@@ -56,13 +59,13 @@ class RemoteJobClient:
             return json.loads(resp.read() or b"{}")
 
     def create_group(self, type: str, args: Dict[str, Any], queues) -> dict:
-        return self._call(
+        return self.call(
             "POST", "/api/v1/jobs",
             {"type": type, "args": args, "queues": list(queues)},
         )
 
     def group_state(self, group_id: str) -> dict:
-        return self._call("GET", f"/api/v1/jobs/{group_id}")
+        return self.call("GET", f"/api/v1/jobs/{group_id}")
 
 
 class RemoteJobWorker:
@@ -96,10 +99,21 @@ class RemoteJobWorker:
     def poll_once(self) -> bool:
         """Poll, run, report.  True iff a job was processed."""
         try:
-            job = self.client._call(
+            job = self.client.call(
                 "POST", "/api/v1/jobs:poll",
                 {"queue": self.queue_name, "timeout_s": self.poll_timeout_s},
             )
+        except urllib.error.HTTPError as exc:
+            if exc.code in (401, 403):
+                # Not transient: a bad/absent token leaves fan-out jobs
+                # PENDING forever with no other symptom — make it loud.
+                logger.warning(
+                    "job poll on queue %s unauthorized (HTTP %d): check "
+                    "manager token/role", self.queue_name, exc.code,
+                )
+            else:
+                logger.debug("job poll failed: %s", exc)
+            raise ConnectionError(str(exc)) from exc
         except (urllib.error.URLError, OSError, ValueError) as exc:
             logger.debug("job poll failed: %s", exc)
             raise ConnectionError(str(exc)) from exc
@@ -119,7 +133,7 @@ class RemoteJobWorker:
         reported = False
         for attempt in range(3):
             try:
-                self.client._call(
+                self.client.call(
                     "POST", f"/api/v1/jobs/{job['id']}:result",
                     {"state": state, "result": result, "error": error},
                 )

@@ -7,6 +7,8 @@ Minimal JSON binding over stdlib HTTP:
   POST   /api/v1/models/<id>:activate            single-active activation
   POST   /api/v1/models/<id>:deactivate
   GET    /api/v1/schedulers                      active scheduler instances
+  POST   /api/v1/schedulers                      register a scheduler instance
+  POST   /api/v1/schedulers/<id>:keepalive       liveness tick → {known}
   GET    /api/v1/clusters:search?ip=&hostname=&idc=&location=
   GET    /api/v1/healthy                         liveness
 
@@ -302,6 +304,15 @@ class ManagerRESTServer:
                     path.startswith("/api/v1/jobs/") and path.endswith(":result")
                 ):
                     required = Role.PEER
+                elif path == "/api/v1/schedulers" or (
+                    path.startswith("/api/v1/schedulers/")
+                    and path.endswith(":keepalive")
+                ):
+                    # Scheduler instances self-register and tick liveness —
+                    # the automated service flow (UpdateScheduler /
+                    # KeepAlive in manager_server_v1.go run on mTLS'd
+                    # service identities) → PEER.
+                    required = Role.PEER
                 else:
                     required = Role.ADMIN  # unknown mutations: locked down
                 if not self._authorized(required):
@@ -309,6 +320,41 @@ class ManagerRESTServer:
                     return
                 if path.startswith("/api/v1/jobs"):
                     self._job_routes(path)
+                    return
+                if path == "/api/v1/schedulers":
+                    # Scheduler instance registration over REST — the wire
+                    # the CLI uses so sync_peers fan-out (jobs/sync_peers.py
+                    # enqueues to f"scheduler:{sched.id}" for every ACTIVE
+                    # registered scheduler) reaches the instance's job queue.
+                    from .cluster import SchedulerInstance
+
+                    try:
+                        req = self._body()
+                        inst = server.clusters.register_scheduler(
+                            SchedulerInstance(
+                                id=req["id"],
+                                cluster_id=req.get("cluster_id", "default"),
+                                hostname=req.get("hostname", ""),
+                                ip=req.get("ip", ""),
+                                port=int(req.get("port", 8002)),
+                            )
+                        )
+                        self._json(200, {
+                            "id": inst.id, "cluster_id": inst.cluster_id,
+                            "state": inst.state,
+                        })
+                    except (KeyError, ValueError, TypeError) as exc:
+                        # TypeError: int(None)/int([]) from malformed port —
+                        # a 400, not a dropped connection.
+                        self._json(400, {"error": str(exc)})
+                    return
+                if path.startswith("/api/v1/schedulers/") and path.endswith(
+                    ":keepalive"
+                ):
+                    inst_id = path[len("/api/v1/schedulers/"):-len(":keepalive")]
+                    # known=False tells the instance the manager lost it
+                    # (restart) and it must re-register.
+                    self._json(200, {"known": server.clusters.keepalive(inst_id)})
                     return
                 if path == "/api/v1/models":
                     # CreateModel (reference: manager_server_v1.go:802).

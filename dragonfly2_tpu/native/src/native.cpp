@@ -595,6 +595,16 @@ bool piece_verified(TaskStore* ts, PieceMeta& pm) {
   return true;
 }
 
+// Network-supplied task components must stay inside the store root:
+// reject empty, '.', '..', and path separators before open_task — a bare
+// "GET /pieces/../N" would otherwise open <root>/../meta and cache the
+// foreign entry in ps->tasks.
+bool valid_task_id(const std::string& id) {
+  if (id.empty() || id == "." || id == "..") return false;
+  return id.find('/') == std::string::npos &&
+         id.find('\\') == std::string::npos;
+}
+
 // Serve-safe data fd: dup() under the task lock so ps_delete_task's
 // fclose cannot invalidate the descriptor mid-sendfile.  -1 when the
 // task is closed.  Caller close()s it.
@@ -659,7 +669,8 @@ void handle_conn(HttpServer* srv, int fd) {
       size_t slash = rest.find('/');
       int64_t number = -1;
       if (slash == std::string::npos ||
-          !parse_i64(rest.substr(slash + 1), &number)) {
+          !parse_i64(rest.substr(slash + 1), &number) ||
+          !valid_task_id(rest.substr(0, slash))) {
         ok_conn = send_error_http(fd, 404, "Not Found");
       } else {
         std::string task = rest.substr(0, slash);
@@ -696,7 +707,9 @@ void handle_conn(HttpServer* srv, int fd) {
       size_t slash = rest.find('/');
       if (slash != std::string::npos && rest.substr(slash) == "/pieces") {
         std::string task = rest.substr(0, slash);
-        TaskPtr ts = open_task(ps, task.c_str(), 0, 0, false);
+        TaskPtr ts = valid_task_id(task)
+                         ? open_task(ps, task.c_str(), 0, 0, false)
+                         : nullptr;
         int64_t n_pieces =
             (!ts || ts->header.piece_size == 0)
                 ? 0
@@ -717,7 +730,9 @@ void handle_conn(HttpServer* srv, int fd) {
         }
       } else if (slash == std::string::npos) {
         // /tasks/<task> with Range (bytes=S-E / S- / -N)
-        TaskPtr ts = open_task(ps, rest.c_str(), 0, 0, false);
+        TaskPtr ts = valid_task_id(rest)
+                         ? open_task(ps, rest.c_str(), 0, 0, false)
+                         : nullptr;
         int64_t total = ts ? ts->header.content_length : -1;
         uint32_t psz = ts ? ts->header.piece_size : 0;
         int64_t start = -1, end = -1;
@@ -895,7 +910,19 @@ int ps_serve_stop(int64_t handle) {
 }
 
 int ps_close(int64_t handle) {
-  ps_serve_stop(handle);  // no-op when no server is attached
+  // A wedged server (ps_serve_stop → 1: connection threads alive past the
+  // grace) still references the store's TaskStore FILE*s — freeing it here
+  // would be a use-after-free.  Leak the store alongside the leaked server
+  // and report a distinct code; the handle is dead either way.
+  if (ps_serve_stop(handle) == 1) {  // no-op (-1) when no server attached
+    std::lock_guard<std::mutex> lk(g_stores_mu);
+    auto it = g_stores.find(handle);
+    if (it != g_stores.end()) {
+      fprintf(stderr, "ps_close: leaking store (stuck connections)\n");
+      g_stores.erase(it);
+    }
+    return -2;
+  }
   PieceStore* ps;
   {
     std::lock_guard<std::mutex> lk(g_stores_mu);

@@ -227,7 +227,7 @@ class SchedulerGRPCServer:
         from .scheduler_server import schedule_to_wire
 
         out: "queue.Queue" = queue.Queue()
-        registered: set = set()
+        registered: dict = {}  # peer_id → THIS stream's push callback
 
         def make_push(peer_id: str):
             def push(result) -> None:
@@ -242,6 +242,17 @@ class SchedulerGRPCServer:
                 for req in it:
                     kind = req.WhichOneof("payload")
                     resp = pb.AnnouncePeerResponse(seq=req.seq)
+                    if kind == "resume":
+                        # Reconnect: re-attach the push channel for a peer
+                        # registered on a PREVIOUS stream (whose teardown
+                        # unregistered it) — no adapter dispatch, so no
+                        # duplicate peer records (ADVICE r2 finding).
+                        pid = req.resume.peer_id
+                        if pid:
+                            registered[pid] = make_push(pid)
+                            self.hub.register(pid, registered[pid])
+                        out.put(resp)
+                        continue
                     entry = self._STREAM_DISPATCH.get(kind)
                     if entry is None:
                         resp.error, resp.code = f"unknown payload {kind}", 0
@@ -259,14 +270,14 @@ class SchedulerGRPCServer:
                         )
                         if method == "register_peer":
                             pid = body["peer_id"]
-                            registered.add(pid)
-                            self.hub.register(pid, make_push(pid))
+                            registered[pid] = make_push(pid)
+                            self.hub.register(pid, registered[pid])
                         elif method == "leave_peer":
                             pid = proto_to_dict(getattr(req, kind)).get(
                                 "peer_id", ""
                             )
-                            registered.discard(pid)
-                            self.hub.unregister(pid)
+                            own = registered.pop(pid, None)
+                            self.hub.unregister(pid, own)
                     except KeyError as exc:
                         from ..utils.dferrors import Code
 
@@ -287,8 +298,11 @@ class SchedulerGRPCServer:
                 # response generator must not clean up concurrently — a
                 # client cancel would race its iteration against our
                 # adds and leak hub registrations bound to a dead queue).
-                for pid in registered:
-                    self.hub.unregister(pid)
+                # Ownership-aware: only evict channels still bound to THIS
+                # stream — a reconnected stream's resume may already have
+                # replaced them, and this (late) teardown must not undo it.
+                for pid, own in registered.items():
+                    self.hub.unregister(pid, own)
                 out.put(None)
 
         t = threading.Thread(target=reader, name="announce-reader", daemon=True)
@@ -446,6 +460,7 @@ class GRPCStreamingScheduler(GRPCRemoteScheduler):
         self._sendq: Optional["queue.Queue"] = None
         self._waiters: dict = {}          # seq → (Event, [resp])
         self._pushed: dict = {}           # peer_id → latest pushed dict
+        self._active_peers: set = set()   # downloads whose pushes we want
         self._seq = 0
         self._stream_stub = self._channel.stream_stream(
             f"/{SCHEDULER_SERVICE}/announce_peer",
@@ -517,6 +532,16 @@ class GRPCStreamingScheduler(GRPCRemoteScheduler):
                 target=read_loop, name="announce-read", daemon=True
             ).start()
 
+            # Reconnect: the old stream's server-side teardown unregistered
+            # every push channel — re-attach them for in-flight downloads
+            # (resume carries no adapter dispatch, so no duplicate peers).
+            # Fire-and-forget: the acks correlate to seqs nobody waits on.
+            for pid in self._active_peers:
+                self._seq += 1
+                msg = pb.AnnouncePeerRequest(seq=self._seq)
+                msg.resume.peer_id = pid
+                sendq.put(msg)
+
     def _stream_call(self, method: str, req: dict) -> dict:
         import threading
 
@@ -554,12 +579,24 @@ class GRPCStreamingScheduler(GRPCRemoteScheduler):
         if method not in self._STREAM_FIELDS:
             return super()._call(method, req)
         try:
-            return self._stream_call(method, req)
+            out = self._stream_call(method, req)
         except ConnectionError:
             # Stream broken (scheduler restart, network blip): unary
             # fallback keeps the download alive; next call retries the
             # stream via _ensure_stream.
-            return super()._call(method, req)
+            out = super()._call(method, req)
+        # Track in-flight downloads so a reconnected stream can resume
+        # their push registrations (covers unary-registered peers too —
+        # their pushes come alive when a stream next establishes).
+        if method == "register_peer" and out.get("peer_id"):
+            with self._stream_mu:
+                self._active_peers.add(out["peer_id"])
+        elif method in (
+            "report_peer_finished", "report_peer_failed", "leave_peer"
+        ):
+            with self._stream_mu:
+                self._active_peers.discard(req.get("peer_id", ""))
+        return out
 
     # -- pushed reschedules (conductor seam) --------------------------------
 

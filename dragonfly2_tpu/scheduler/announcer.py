@@ -32,15 +32,22 @@ class Announcer:
         trainer: "TrainerService",
         *,
         cluster_manager: Optional["ClusterManager"] = None,
+        cluster_id: str = "default",
         ip: str = "",
+        port: int = 8002,
         hostname: str = "",
         train_interval: float = 7 * 24 * 3600.0,  # constants.go:198 default 7d
     ) -> None:
         self.scheduler_id = scheduler_id
         self.storage = storage
         self.trainer = trainer
+        # Any ClusterManager-shaped object: the in-process manager OR the
+        # REST wire (rpc/cluster_client.RemoteClusterClient) — one
+        # register+keepalive loop implementation either way.
         self.cluster_manager = cluster_manager
+        self.cluster_id = cluster_id
         self.ip = ip
+        self.port = port
         self.hostname = hostname
         self.train_interval = train_interval
         self.keepalive_interval = 20.0  # < ClusterManager TTL (60 s)
@@ -57,9 +64,10 @@ class Announcer:
         self.cluster_manager.register_scheduler(
             SchedulerInstance(
                 id=self.scheduler_id,
-                cluster_id="default",
+                cluster_id=self.cluster_id,
                 hostname=self.hostname,
                 ip=self.ip,
+                port=self.port,
             )
         )
 

@@ -45,8 +45,19 @@ class PeerStreamHub:
         with self._mu:
             self._channels[peer_id] = send
 
-    def unregister(self, peer_id: str) -> None:
+    def unregister(
+        self,
+        peer_id: str,
+        send: Optional[Callable[[ScheduleResult], None]] = None,
+    ) -> None:
+        """With ``send``, only unregister if that exact callback still owns
+        the slot — a dying stream's late teardown must not evict the
+        channel a reconnected stream's `resume` just re-registered (the
+        old reader can linger in its request iterator for tens of seconds
+        after the client reconnects)."""
         with self._mu:
+            if send is not None and self._channels.get(peer_id) is not send:
+                return
             self._channels.pop(peer_id, None)
             self._last_push.pop(peer_id, None)
 
@@ -82,7 +93,7 @@ class PeerStreamHub:
             send(result)
             return True
         except Exception:  # noqa: BLE001 — a dead stream must not kill handlers
-            self.unregister(peer_id)
+            self.unregister(peer_id, send)
             return False
 
 

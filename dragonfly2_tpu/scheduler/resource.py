@@ -360,6 +360,18 @@ class Task:
         for pv in parents:
             pv.value.host.release_upload(succeeded=True)
 
+    def delete_peer_edge(self, parent: "Peer", child_id: str) -> bool:
+        """Detach ONE parent→child edge, releasing that parent's upload
+        slot — the selective form schedule_once needs to swap edge sets
+        attach-first (old parents detach only after replacements hold)."""
+        with self._mu:
+            try:
+                self.dag.delete_edge(parent.id, child_id)
+            except DAGError:
+                return False
+        parent.host.release_upload(succeeded=True)
+        return True
+
     def delete_peer_out_edges(self, peer_id: str) -> None:
         with self._mu:
             if peer_id not in self.dag:

@@ -155,13 +155,29 @@ class Scheduling:
                 kind=ScheduleResultKind.FAILED,
                 description="no candidates (single-shot)",
             )
-        peer.task.delete_peer_in_edges(peer.id)
+        # Attach-first: candidates never include current parents (the
+        # filter's can_add_peer_edge rejects existing edges), so the new
+        # edges land alongside the old ones, and only once at least one
+        # replacement holds do the previous parents detach.  Losing every
+        # upload-slot race therefore leaves the child's real assignment
+        # untouched — the failure mode ADVICE r2 found (detach-first left
+        # the child edgeless and invisible to reschedule_stalled).
+        try:
+            old_parents = peer.task.load_parents(peer.id)
+        except DAGError:
+            # The child left between candidate search and here (its vertex
+            # is gone); attachments below will lose too and report FAILED —
+            # raising would convert an unrelated peer's piece report into
+            # an RPC error on the push path (service.py bad-parent sweep).
+            old_parents = []
         attached = [p for p in parents if peer.task.add_peer_edge(p, peer)]
         if not attached:
             return ScheduleResult(
                 kind=ScheduleResultKind.FAILED,
                 description="upload-slot races lost (single-shot)",
             )
+        for old in old_parents:
+            peer.task.delete_peer_edge(old, peer.id)
         return ScheduleResult(kind=ScheduleResultKind.PARENTS, parents=attached)
 
     def schedule_candidate_parents(

@@ -874,6 +874,68 @@ class TestAnnouncePeerStream:
                 n.stop()
             server.stop()
 
+    def test_stream_reconnect_resumes_push_registration(self, tmp_path):
+        """After a mid-download stream break, the NEXT stream re-attaches
+        the server hub's push channel via the `resume` payload — pushes
+        keep flowing (ADVICE r2: they were silently lost until the next
+        register_peer)."""
+        import time as _time
+
+        from dragonfly2_tpu.scheduler.scheduling import (
+            ScheduleResult,
+            ScheduleResultKind,
+        )
+
+        server, service, nodes, origin = self._swarm(tmp_path)
+        try:
+            url = "https://origin/resume-blob"
+            rA = nodes[0].conductor.download(
+                url, piece_size=PIECE, content_length=2 * PIECE
+            )
+            assert rA.ok
+            client = nodes[1].client
+            reg = client.register_peer(host=nodes[1].host, url=url)
+            peer = reg.peer
+            assert service.hub.subscribed(peer.id)
+
+            # Break the stream: half-close the request iterator; the
+            # server-side teardown unregisters the push channel.
+            with client._stream_mu:
+                sendq = client._sendq
+            sendq.put(None)
+            deadline = _time.time() + 5
+            while (
+                service.hub.subscribed(peer.id) or client._sendq is not None
+            ) and _time.time() < deadline:
+                _time.sleep(0.02)
+            assert not service.hub.subscribed(peer.id)
+
+            # Any next stream traffic reconnects + resumes the peer...
+            client.report_piece_finished(
+                peer, 0, parent_id="", length=PIECE, cost_ns=1
+            )
+            deadline = _time.time() + 5
+            while not service.hub.subscribed(peer.id) and _time.time() < deadline:
+                _time.sleep(0.02)
+            assert service.hub.subscribed(peer.id)
+
+            # ...and a server push actually reaches the client again.
+            assert service.hub.push(
+                peer.id,
+                ScheduleResult(kind=ScheduleResultKind.NEED_BACK_TO_SOURCE),
+            )
+            got = None
+            deadline = _time.time() + 5
+            while got is None and _time.time() < deadline:
+                got = client.take_pushed_schedule(peer)
+                _time.sleep(0.02)
+            assert got is not None
+            assert got.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE
+        finally:
+            for n in nodes:
+                n.stop()
+            server.stop()
+
     def test_stream_falls_back_to_unary(self, tmp_path):
         """A broken stream degrades to the unary stubs instead of failing
         the download."""

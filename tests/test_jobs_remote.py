@@ -264,3 +264,117 @@ class TestBrokerWireSemantics:
         again = jq.poll("qs", timeout=0.1, requeue_started_after_s=120)
         assert again is not None and again.id == job.id
         assert again.state is JobState.STARTED
+
+
+class TestSchedulerRegistration:
+    """The REST registration wire (ADVICE r2 medium): sync_peers fan-out
+    targets f"scheduler:{sched.id}" for REGISTERED schedulers only — the
+    CLI must register under the same id its job worker polls."""
+
+    def test_register_keepalive_and_sync_peers_fanout(self, broker_server):
+        from dragonfly2_tpu.jobs.sync_peers import SYNC_PEERS, SyncPeers
+        from dragonfly2_tpu.rpc.cluster_client import RemoteClusterClient
+
+        server, jq = broker_server
+        link = RemoteClusterClient(server.url)
+        assert link.register_scheduler(
+            id="sched-t", cluster_id="default", hostname="h",
+            ip="1.2.3.4", port=8002,
+        )
+        assert [s.id for s in server.clusters.active_schedulers()] == ["sched-t"]
+        assert link.keepalive("sched-t") is True
+        assert link.keepalive("ghost") is False
+
+        worker = RemoteJobWorker(server.url, "scheduler:sched-t",
+                                 poll_timeout_s=0.2)
+        worker.register(SYNC_PEERS, lambda args: [{
+            "id": "host-1", "hostname": "h1", "ip": "", "port": 0,
+            "download_port": 0, "type": 0, "peer_count": 0,
+        }])
+        sp = SyncPeers(jq, server.clusters, job_timeout_s=5.0)
+        answered = []
+        th = threading.Thread(target=lambda: answered.append(sp.run_once()))
+        th.start()
+        deadline = time.time() + 4
+        while time.time() < deadline and not worker.jobs_done:
+            worker.poll_once()
+        th.join(timeout=5)
+        assert answered == [1]
+        assert [r.id for r in sp.list_peers(active_only=True)] == ["host-1"]
+
+    def test_keepalive_loop_reregisters_after_manager_restart(
+        self, broker_server
+    ):
+        from dragonfly2_tpu.rpc.cluster_client import RemoteClusterClient
+
+        server, jq = broker_server
+        link = RemoteClusterClient(server.url, keepalive_interval_s=0.05)
+        assert link.register_scheduler(id="sched-r")
+        # Manager "restart": the in-memory cluster table is lost.  The
+        # next keepalive self-heals (known=False → re-register) — same
+        # behavior whichever loop ticks it (Announcer or serve()).
+        server.clusters._schedulers.clear()
+        assert link.keepalive("sched-r") is True
+        assert [s.id for s in server.clusters.active_schedulers()] == ["sched-r"]
+        # The standalone loop keeps it alive too.
+        server.clusters._schedulers.clear()
+        link.serve()
+        try:
+            deadline = time.time() + 3
+            while not server.clusters.active_schedulers() and time.time() < deadline:
+                time.sleep(0.02)
+            assert [s.id for s in server.clusters.active_schedulers()] == ["sched-r"]
+        finally:
+            link.stop()
+
+    def test_unauthorized_poll_and_register_log_warnings(self, caplog):
+        """RBAC-enabled manager + tokenless worker: the 401 must surface
+        at WARNING (jobs stuck PENDING with only debug logs was the
+        ADVICE r2 failure mode)."""
+        import logging
+
+        from dragonfly2_tpu.rpc.cluster_client import RemoteClusterClient
+        from dragonfly2_tpu.security.tokens import TokenIssuer, TokenVerifier
+
+        issuer = TokenIssuer(b"k" * 32)
+        server = ManagerRESTServer(
+            ModelRegistry(), ClusterManager(), jobqueue=JobQueue(),
+            token_verifier=TokenVerifier(b"k" * 32),
+        )
+        server.serve()
+        try:
+            worker = RemoteJobWorker(server.url, "scheduler:x",
+                                     poll_timeout_s=0.2)
+            with caplog.at_level(logging.WARNING):
+                with pytest.raises(ConnectionError):
+                    worker.poll_once()
+            assert any("unauthorized" in r.message for r in caplog.records)
+            caplog.clear()
+            link = RemoteClusterClient(server.url)
+            with caplog.at_level(logging.WARNING):
+                assert link.register_scheduler(id="sched-x") is False
+            assert any("unauthorized" in r.message.lower()
+                       for r in caplog.records)
+        finally:
+            server.stop()
+
+    def test_announcer_drives_remote_cluster_link(self, broker_server):
+        """The Announcer's in-process register/keepalive loop works
+        unchanged against the REST wire (one liveness implementation)."""
+        from dragonfly2_tpu.records.storage import Storage
+        from dragonfly2_tpu.rpc.cluster_client import RemoteClusterClient
+        from dragonfly2_tpu.scheduler.announcer import Announcer
+        import tempfile
+
+        server, jq = broker_server
+        link = RemoteClusterClient(server.url)
+        with tempfile.TemporaryDirectory() as d:
+            ann = Announcer(
+                scheduler_id="sched-a", storage=Storage(d),
+                trainer=None, cluster_manager=link, cluster_id="c9",
+                hostname="hh", ip="9.9.9.9",
+            )
+            ann.announce_to_manager()
+            got = server.clusters.active_schedulers()
+            assert [(s.id, s.cluster_id) for s in got] == [("sched-a", "c9")]
+            ann.keepalive()  # ticks through the same wire

@@ -91,6 +91,32 @@ class TestNativePieceServer:
             )
         assert exc.value.code == 404
 
+    def test_path_traversal_rejected(self, served):
+        """Network-supplied task components must stay inside the store
+        root (ADVICE r2: GET /pieces/../N reached <root>/../meta).  Raw
+        socket — urllib would normalize the dot segments away."""
+        import socket
+
+        port = served["server"].port
+        for path, codes in (
+            ("/pieces/../0", (b"404",)),
+            ("/pieces/./0", (b"404",)),
+            ("/tasks/../pieces", (b"404",)),
+            # Rangeless /tasks/<id> 416s for unknown ids (parity with the
+            # Python server); the invariant is "never 200, never opens
+            # outside the root".
+            ("/tasks/..", (b"404", b"416")),
+            ("/tasks/.", (b"404", b"416")),
+        ):
+            sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+            sock.sendall(
+                f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                f"Connection: close\r\n\r\n".encode()
+            )
+            status = sock.makefile("rb").readline()
+            assert any(c in status for c in codes), (path, status)
+            sock.close()
+
     def test_bad_range_416(self, served):
         port = served["server"].port
         req = urllib.request.Request(

@@ -304,6 +304,41 @@ class TestScheduling:
         got = s.find_success_parent(child)
         assert got is parents[1]
 
+    def test_schedule_once_keeps_assignment_when_attach_races_lost(self):
+        """ADVICE r2: losing every upload-slot race must leave the child's
+        REAL edges intact (detach-first left it edgeless and invisible to
+        reschedule_stalled)."""
+        t, child, parents = self._swarm(n_parents=6)
+        s = Scheduling(Evaluator(), SchedulingConfig(retry_interval=0))
+        first = s.schedule_once(child)
+        assert first.kind is ScheduleResultKind.PARENTS
+        before = {p.id for p in t.load_parents(child.id)}
+        assert before
+        real = t.add_peer_edge
+        t.add_peer_edge = lambda parent, peer: False  # every race lost
+        try:
+            res = s.schedule_once(child)
+        finally:
+            t.add_peer_edge = real
+        assert res.kind is ScheduleResultKind.FAILED
+        assert {p.id for p in t.load_parents(child.id)} == before
+
+    def test_schedule_once_swaps_edges_attach_first(self):
+        """A successful single-shot reschedule replaces the edge set: new
+        parents attach, old ones detach and get their upload slots back."""
+        t, child, parents = self._swarm(n_parents=6, upload_limit=2)
+        s = Scheduling(Evaluator(), SchedulingConfig(retry_interval=0))
+        first = s.schedule_once(child)
+        old = {p.id for p in t.load_parents(child.id)}
+        res = s.schedule_once(child)
+        assert res.kind is ScheduleResultKind.PARENTS
+        now = {p.id for p in t.load_parents(child.id)}
+        assert now == {p.id for p in res.parents}
+        assert now.isdisjoint(old)
+        for p in parents:
+            if p.id in old:  # released slot: back to the full limit
+                assert p.host.free_upload_count() == 2
+
 
 class TestMLEvaluatorFallback:
     def test_no_model_falls_back_to_rules(self):
