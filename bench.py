@@ -8,6 +8,13 @@ evidence (BENCHMARKS.md): identical config[2] workload gives val log-MAE
 step is floored by XLA's sort-based scatter in the neighbor-gather
 backward (~22 ms/layer), which no in-step rewiring beat.
 
+Flagship WIDTH = hidden 1024, promoted per the r2 verdict's rule on
+MEASURED quality evidence (tools/ablate_width.py, dropout ON, exact
+config[2] workload): val log-MAE 0.5050 / F1 0.7964 at hidden 1024
+vs 0.5067 / 0.7943 at the old hidden-128 flagship — the compute-bound
+width is BETTER on quality, and it runs the MXU at the ≥30%-MFU
+north-star bar instead of sitting on the HBM bandwidth floor.
+
 vs_baseline is measured against the north-star requirement
 (BASELINE.json): 1B records / 10 min on v5e-16 ⇒ ~104,167 records/sec/chip.
 The reference itself publishes no numbers (its trainer is a stub —
@@ -61,7 +68,9 @@ def main() -> None:
     table = build_neighbor_table(n_nodes, src, dst, rtt / 1e9, max_neighbors=16)
     node_feats = jnp.asarray(cluster._host_feature_matrix())
 
-    mcfg = HopConfig()  # production config: hidden 128, 2 hops, embed 32
+    # Production flagship config: hidden 1024 (quality-validated width,
+    # see module docstring), 2 hops, embed 32, dropout ON.
+    mcfg = HopConfig(hidden=1024)
     hop_feats = jax.jit(lambda nf, t: precompute_hop_features(nf, t, hops=mcfg.hops))(
         node_feats, table
     )
@@ -118,9 +127,10 @@ def main() -> None:
     b = jax.device_put(jnp.asarray(e_dst), data_shard)
     y = jax.device_put(jnp.asarray(target), data_shard)
 
-    # Longer chains than the GAT bench: the step is ~3 ms, so the delta
-    # must dominate relay jitter.
-    n_short, n_long = (10, 210) if on_tpu else (2, 8)
+    # Chain lengths sized to the step: the hidden-1024 step is ~60 ms, so
+    # shorter chains than the 3 ms hidden-128 bench still dominate relay
+    # jitter while keeping the bench under a minute.
+    n_short, n_long = (4, 44) if on_tpu else (2, 8)
     float(run_chain(state, hop_feats, table, a, b, y, n_short))  # compile both
     float(run_chain(state, hop_feats, table, a, b, y, n_long))
 

@@ -1,24 +1,41 @@
 """Download conductor: the per-task engine turning a schedule into bytes.
 
 Reference: client/daemon/peer/peertask_conductor.go — register with the
-scheduler (:255-368), consume parent lists, run piece workers
-(:1009-1077), report per-piece results, fall back to source when P2P
-fails (:493-531); plus piece_manager.go's digest-verified piece writes.
+scheduler (:255-368), consume parent lists, run CONCURRENT piece workers
+pulling a shared piece queue (:1009-1077 initDownloadPieceWorkers /
+downloadPieceWorker), report per-piece results, fall back to source when
+P2P fails (:493-531); peertask_manager.go:328-423 StartFileTask /
+StartStreamTask (reuse-first, stream bytes while downloading);
+peertask_reuse.go:49-61 (completed-task reuse skips the scheduler
+entirely); peertask_piecetask_synchronizer.go (children learn a
+mid-download parent's new pieces as they land — here via bitmap
+subscription polls against the parent's piece plane).
 
 Transport-neutral: a ``PieceFetcher`` abstracts "read piece N of task T
 from parent P" (in-process: the parent daemon's UploadManager; over the
 wire: HTTP range GET to the parent's upload port).  The conductor drives
 the REAL scheduler service — the same filter/rank/DAG path production
 uses — so daemon-level tests exercise the whole control loop.
+
+Concurrency model (downloadPieceWorker semantics, threads not
+goroutines): each active task owns up to ``piece_parallelism`` workers
+draining one shared queue of missing piece numbers.  A worker picks a
+parent that HOLDS its piece (piece-metadata bitmaps, refreshed while the
+swarm is mid-download); a piece nobody holds yet is "no valid piece
+temporarily" — the worker polls holder bitmaps instead of burning fetch
+failures.  Any worker can adopt server-pushed reschedules for the whole
+pool; back-to-source verdicts abort the pool and fall through to the
+origin path.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass
-from typing import List, Optional, Protocol, Sequence
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Protocol, Sequence, Set
 
 from ..scheduler.resource import Host, Peer
 from ..scheduler.service import SchedulerService
@@ -58,6 +75,103 @@ class DownloadResult:
     back_to_source: bool = False
     failed_pieces: int = 0
     cost_s: float = 0.0
+    # True when the bytes came from local storage or a concurrent run of
+    # the same task — no new swarm traffic (peertask_reuse.go:49,
+    # PeerTaskCacheHitCount).
+    reused: bool = False
+
+
+class TaskRun:
+    """Live download state for one task: the subscriber seam streams and
+    duplicate downloads attach to (peertask_manager's conductor map +
+    SubscribeResponse piece channel, peertask_manager.go:428-437).
+
+    Piece commits and completion signal one shared condition; readers
+    wait for "piece N ready" or "run finished".
+    """
+
+    def __init__(self, task_id: str) -> None:
+        self.task_id = task_id
+        self.cond = threading.Condition()
+        self.ready: Set[int] = set()
+        self.n_pieces = -1
+        self.piece_size = 0
+        self.content_length = -1
+        self.done = False
+        self.result: Optional[DownloadResult] = None
+
+    def mark_sized(self, n_pieces: int, piece_size: int, content_length: int) -> None:
+        with self.cond:
+            self.n_pieces = n_pieces
+            self.piece_size = piece_size
+            self.content_length = content_length
+            self.cond.notify_all()
+
+    def mark_piece(self, number: int) -> None:
+        with self.cond:
+            self.ready.add(number)
+            self.cond.notify_all()
+
+    def finish(self, result: DownloadResult) -> None:
+        with self.cond:
+            self.done = True
+            self.result = result
+            self.cond.notify_all()
+
+    def wait_sized(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while self.n_pieces < 0 and not self.done:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self.cond.wait(min(left, 1.0)):
+                    if time.monotonic() >= deadline:
+                        return False
+            return self.n_pieces >= 0
+
+    def wait_piece(self, number: int, timeout: float) -> str:
+        """→ 'ready' | 'eof' (complete, piece out of range) | 'failed' |
+        'timeout'."""
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while True:
+                if number in self.ready:
+                    return "ready"
+                if self.done:
+                    r = self.result
+                    if r is not None and r.ok and 0 <= self.n_pieces <= number:
+                        return "eof"
+                    # A finished-ok run has every in-range piece in
+                    # `ready`; done without this one means failure.
+                    return "failed"
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return "timeout"
+                self.cond.wait(min(left, 1.0))
+
+    def wait_done(self, timeout: Optional[float] = None) -> Optional[DownloadResult]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.cond:
+            while not self.done:
+                left = None if deadline is None else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return None
+                self.cond.wait(1.0 if left is None else min(left, 1.0))
+            return self.result
+
+
+@dataclass
+class _SwarmState:
+    """Worker-pool shared state for one task's P2P phase (the piece
+    dispatcher + peer packet state of peertask_conductor.go, folded into
+    one lock-guarded record)."""
+
+    parents: List[Peer]
+    bitmaps: Dict[str, bytes] = field(default_factory=dict)
+    failed: int = 0
+    nbytes: int = 0
+    last_refresh: float = 0.0
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    abort: threading.Event = field(default_factory=threading.Event)
 
 
 class Conductor:
@@ -71,6 +185,9 @@ class Conductor:
         *,
         traffic_shaper: Optional[TrafficShaper] = None,
         max_piece_retries: int = 2,
+        piece_parallelism: int = 4,
+        piece_poll_interval_s: float = 0.05,
+        piece_wait_timeout_s: float = 60.0,
         concurrent_source_groups: int = 1,
         concurrent_source_threshold: int = 2,
         pex=None,
@@ -86,6 +203,13 @@ class Conductor:
         self.pex = pex
         self.traffic_shaper = traffic_shaper
         self.max_piece_retries = max_piece_retries
+        # Piece workers per task (peertask_conductor.go:1010 count=4).
+        self.piece_parallelism = max(1, piece_parallelism)
+        # "No valid piece temporarily": how often to re-poll holder
+        # bitmaps, and how long a wanted piece may stay unclaimed before
+        # the P2P phase gives up (→ back-to-source).
+        self.piece_poll_interval_s = piece_poll_interval_s
+        self.piece_wait_timeout_s = piece_wait_timeout_s
         # Concurrent back-to-source (piece_manager.go:793-873 semantics):
         # split the remaining pieces into `groups` contiguous range groups,
         # one worker per group, any worker failure cancels the task.  Only
@@ -96,6 +220,10 @@ class Conductor:
         # Storage writes and scheduler reports from concurrent source
         # workers are serialized; only the origin fetch itself overlaps.
         self._report_lock = threading.Lock()
+        # task_id → active TaskRun (findPeerTaskConductor semantics: one
+        # conductor per task; later requests attach, never double-fetch).
+        self._runs: Dict[str, TaskRun] = {}
+        self._runs_mu = threading.Lock()
 
     def probe_content_length(self, url: str) -> Optional[int]:
         """Origin size via the source fetcher, when it can tell (shared by
@@ -104,6 +232,43 @@ class Conductor:
         if source is not None and hasattr(source, "content_length"):
             return source.content_length(url)
         return None
+
+    # -- task id / reuse -----------------------------------------------------
+
+    def _task_id(self, url: str, task_id: Optional[str]) -> str:
+        if task_id:
+            return task_id
+        from ..utils import idgen
+
+        return idgen.task_id(url)
+
+    def _complete_locally(self, task_id: str) -> bool:
+        """True when every piece of the task is committed on disk."""
+        n = self.storage.n_pieces(task_id)
+        return n >= 0 and self.storage.held_pieces(task_id) >= n
+
+    def _reuse_result(self, task_id: str, t0: float) -> DownloadResult:
+        n = max(self.storage.n_pieces(task_id), 0)
+        return DownloadResult(
+            ok=True, task_id=task_id, peer_id="", pieces=n,
+            bytes=self.storage.task_bytes(task_id), reused=True,
+            cost_s=time.monotonic() - t0,
+        )
+
+    def _claim(self, task_id: str):
+        """→ (run, owner): attach to an active run, or own a fresh one."""
+        with self._runs_mu:
+            run = self._runs.get(task_id)
+            if run is not None and not run.done:
+                return run, False
+            run = TaskRun(task_id)
+            self._runs[task_id] = run
+            return run, True
+
+    def active_run(self, task_id: str) -> Optional[TaskRun]:
+        with self._runs_mu:
+            run = self._runs.get(task_id)
+            return run if run is not None and not run.done else None
 
     # -- the main flow (peertask_conductor.go:370 start → pullPieces) --------
 
@@ -124,9 +289,128 @@ class Conductor:
         downloads and must not bleed one download's credentials into
         another's origin requests."""
         t0 = time.monotonic()
+        tid = self._task_id(url, task_id)
+        # Reuse-first (peertask_reuse.go:49): a completed local task
+        # serves from disk with no scheduler contact at all.
+        if self._complete_locally(tid):
+            return self._reuse_result(tid, t0)
+        run, owner = self._claim(tid)
+        if not owner:
+            # Another thread is already downloading this task — attach
+            # instead of double-fetching (findPeerTaskConductor).
+            result = run.wait_done()
+            if result is not None and result.ok:
+                r = self._reuse_result(tid, t0)
+                r.back_to_source = result.back_to_source
+                return r
+            return DownloadResult(
+                ok=False, task_id=tid, peer_id="",
+                cost_s=time.monotonic() - t0,
+            )
+        return self._download_owned(
+            run, url, piece_size=piece_size, content_length=content_length,
+            expected_pieces=expected_pieces, source_headers=source_headers,
+            priority=priority, t0=t0,
+        )
+
+    # -- streaming (StartStreamTask, peertask_manager.go:357-423) ------------
+
+    def open_stream(
+        self,
+        url: str,
+        *,
+        piece_size: int = 4 << 20,
+        content_length: Optional[int] = None,
+        source_headers: Optional[dict] = None,
+        priority: Priority = Priority.LEVEL0,
+        task_id: Optional[str] = None,
+        sizing_timeout_s: float = 30.0,
+    ) -> "StreamHandle":
+        """Serve the task's bytes AS PIECES COMMIT: reuse a completed
+        task, attach to a running one, or start the download in the
+        background — the proxy and the object gateway consume this so a
+        response starts before the task finishes."""
+        tid = self._task_id(url, task_id)
+        if self._complete_locally(tid):
+            return StreamHandle(self, tid, None)
+        run, owner = self._claim(tid)
+        if owner:
+            t = threading.Thread(
+                target=self._download_quiet,
+                args=(run, url),
+                kwargs=dict(
+                    piece_size=piece_size, content_length=content_length,
+                    expected_pieces=None, source_headers=source_headers,
+                    priority=priority, t0=time.monotonic(),
+                ),
+                name=f"stream-dl-{tid[:8]}",
+                daemon=True,
+            )
+            t.start()
+        if not run.wait_sized(sizing_timeout_s):
+            raise IOError(f"stream {tid}: sizing timed out")
+        return StreamHandle(self, tid, run)
+
+    def _download_quiet(self, run: TaskRun, url: str, **kw) -> None:
+        """Background-thread face of _download_owned: failures land on the
+        run (subscribers see 'failed'), not on an orphan thread traceback."""
+        import logging
+
+        try:
+            self._download_owned(run, url, **kw)
+        except Exception:  # noqa: BLE001 — recorded on the run
+            logging.getLogger(__name__).warning(
+                "stream download of %s failed", run.task_id, exc_info=True
+            )
+
+    def _download_owned(
+        self,
+        run: TaskRun,
+        url: str,
+        *,
+        piece_size: int,
+        content_length: Optional[int],
+        expected_pieces: Optional[int],
+        source_headers: Optional[dict],
+        priority: Priority,
+        t0: float,
+    ) -> DownloadResult:
+        try:
+            result = self._download_inner(
+                run, url, piece_size=piece_size,
+                content_length=content_length,
+                expected_pieces=expected_pieces,
+                source_headers=source_headers, priority=priority, t0=t0,
+            )
+        except BaseException:
+            result = DownloadResult(
+                ok=False, task_id=run.task_id, peer_id="",
+                cost_s=time.monotonic() - t0,
+            )
+            raise
+        finally:
+            run.finish(result)
+            with self._runs_mu:
+                if self._runs.get(run.task_id) is run:
+                    self._runs.pop(run.task_id)
+        return result
+
+    def _download_inner(
+        self,
+        run: TaskRun,
+        url: str,
+        *,
+        piece_size: int,
+        content_length: Optional[int],
+        expected_pieces: Optional[int],
+        source_headers: Optional[dict],
+        priority: Priority,
+        t0: float,
+    ) -> DownloadResult:
         try:
             reg = self.scheduler.register_peer(
-                host=self.host, url=url, priority=priority, task_id=task_id
+                host=self.host, url=url, priority=priority,
+                task_id=run.task_id,
             )
         except Exception:
             # Scheduler unreachable: gossip keeps the swarm serving
@@ -134,7 +418,7 @@ class Conductor:
             # control plane).  No pex or no sizing → the failure is real.
             if self.pex is None or not content_length or content_length < 0:
                 raise
-            return self._pull_via_pex(url, piece_size, content_length, t0)
+            return self._pull_via_pex(run, url, piece_size, content_length, t0)
         peer = reg.peer
         task = peer.task
 
@@ -145,6 +429,8 @@ class Conductor:
                 task.id, piece_size=piece_size, content_length=len(reg.direct_piece)
             )
             self.storage.write_piece(task.id, 0, reg.direct_piece)
+            run.mark_sized(1, piece_size, len(reg.direct_piece))
+            run.mark_piece(0)
             self.scheduler.report_piece_finished(
                 peer, 0, parent_id="", length=len(reg.direct_piece), cost_ns=1
             )
@@ -174,36 +460,46 @@ class Conductor:
         self.storage.register_task(
             task.id, piece_size=piece_size, content_length=task.content_length
         )
+        run.mark_sized(n_pieces, piece_size, task.content_length)
+        # Partial reuse: pieces already on disk (crashed/abandoned earlier
+        # run) are ready for subscribers and skipped by the workers
+        # (local_storage_subtask / FindPartialCompletedTask semantics).
+        if n_pieces > 0:
+            for n in self.storage.piece_bitmap(task.id, n_pieces).nonzero()[0]:
+                run.mark_piece(int(n))
         if self.traffic_shaper is not None:
             self.traffic_shaper.add_task(task.id)
         try:
             if reg.schedule is not None and reg.schedule.kind is ScheduleResultKind.PARENTS:
-                result = self._pull_from_parents(peer, reg.schedule.parents, n_pieces, t0)
+                result = self._pull_from_parents(
+                    peer, reg.schedule.parents, n_pieces, t0, run
+                )
                 if result is not None:
                     return result
                 # P2P path exhausted → back-to-source (dfget.go:141 fallback).
             return self._pull_from_source(
-                peer, n_pieces, piece_size, t0, source_headers
+                peer, n_pieces, piece_size, t0, source_headers, run
             )
         finally:
             if self.traffic_shaper is not None:
                 self.traffic_shaper.remove_task(task.id)
 
     def _pull_via_pex(
-        self, url: str, piece_size: int, content_length: int, t0: float
+        self, run: TaskRun, url: str, piece_size: int, content_length: int,
+        t0: float,
     ) -> DownloadResult:
         """Scheduler-less download: gossip-discovered holders serve pieces
         directly (the pex pool is the only metadata source)."""
-        from ..utils import idgen
-
-        task_id = idgen.task_id(url)
+        task_id = run.task_id
         n_pieces = (content_length + piece_size - 1) // piece_size
         self.storage.register_task(
             task_id, piece_size=piece_size, content_length=content_length
         )
+        run.mark_sized(n_pieces, piece_size, content_length)
         nbytes = 0
         for number in range(n_pieces):
             if self.storage.has_piece(task_id, number):
+                run.mark_piece(number)
                 continue
             fetched = False
             for holder in self.pex.find_peers_with_piece(task_id, number):
@@ -214,6 +510,7 @@ class Conductor:
                 except Exception:  # noqa: BLE001 — try the next holder
                     continue
                 self.storage.write_piece(task_id, number, data)
+                run.mark_piece(number)
                 nbytes += len(data)
                 fetched = True
                 break
@@ -228,92 +525,149 @@ class Conductor:
             bytes=nbytes, cost_s=time.monotonic() - t0,
         )
 
+    # -- the concurrent P2P phase -------------------------------------------
+
     def _pull_from_parents(
-        self, peer: Peer, parents: List[Peer], n_pieces: int, t0: float
+        self, peer: Peer, parents: List[Peer], n_pieces: int, t0: float,
+        run: TaskRun,
     ) -> Optional[DownloadResult]:
-        """Piece workers over the assigned parents; None → fall to source."""
+        """Piece workers over the assigned parents; None → fall to source.
+
+        peertask_conductor.go:1009-1077 shape: ``piece_parallelism``
+        workers drain one shared queue of missing pieces; each picks a
+        parent that holds its piece per the bitmap sync, polls for
+        unclaimed pieces (mid-download parents advertise pieces as they
+        land — piecetask_synchronizer semantics), and any worker can
+        adopt server-pushed reschedules for the whole pool.
+        """
         task = peer.task
-        failed = 0
-        nbytes = 0
-        parents = list(parents)
-        # Piece-metadata sync (SyncPieceTasks analog): ask each parent which
-        # pieces it holds so workers skip guaranteed 404s — partial holders
-        # (mid-download parents, tail-only reloads) stop costing a failed
-        # fetch per missing piece.
-        bitmaps = {}
-        if hasattr(self.piece_fetcher, "piece_bitmap"):
-            for p in parents:
-                bm = self.piece_fetcher.piece_bitmap(p.host.id, task.id)
-                if bm is not None:
-                    bitmaps[p.id] = bm
+        state = _SwarmState(parents=list(parents))
+        self._refresh_bitmaps(task.id, state, force=True)
 
-        def holds(parent, number):
-            bm = bitmaps.get(parent.id)
-            return bm is None or (number < len(bm) and bm[number])
+        # Resume: pieces already on disk are NOT refetched and NOT
+        # per-piece reported (a large partial task would cost thousands of
+        # sequential RPCs before the first fetch); the closing
+        # report_peer_finished settles the scheduler's task/peer state,
+        # and other children learn held pieces from the piece plane's
+        # bitmaps, not from the scheduler.
+        held = self.storage.piece_bitmap(task.id, n_pieces) if n_pieces > 0 else []
+        pending = deque(n for n in range(n_pieces) if not held[n])
 
-        def refresh_bitmaps(plist):
-            if hasattr(self.piece_fetcher, "piece_bitmap"):
-                for p in plist:
-                    if p.id not in bitmaps:
-                        bm = self.piece_fetcher.piece_bitmap(p.host.id, task.id)
-                        if bm is not None:
-                            bitmaps[p.id] = bm
-
-        # Server-pushed reschedules (the v2 bidi wire): between pieces,
-        # adopt whatever the scheduler pushed — new parents replace the
-        # current set; a pushed back-to-source aborts the P2P path.
         take_pushed = getattr(self.scheduler, "take_pushed_schedule", None)
 
-        def apply_push():
-            nonlocal parents
+        def apply_push() -> None:
+            """Adopt a server-pushed reschedule (v2 bidi wire) for the
+            whole worker pool."""
             if take_pushed is None:
-                return True
+                return
             res = take_pushed(peer)
             if res is None:
-                return True
+                return
             if res.kind is ScheduleResultKind.PARENTS and res.parents:
-                parents = list(res.parents)
-                refresh_bitmaps(parents)
+                with state.lock:
+                    state.parents = list(res.parents)
+                self._refresh_bitmaps(task.id, state, force=True)
             elif res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
-                return False
-            return True
+                state.abort.set()  # pool stops; caller falls to source
 
-        for number in range(n_pieces):
-            if not apply_push():
-                return None
-            if not parents:
-                return None
-            done = False
-            for attempt in range(self.max_piece_retries + 1):
-                # Recomputed each attempt: a mid-piece reschedule replaces
-                # `parents` and the fresh assignment must be tried NOW, not
-                # after the retry budget burns on the dead one.
-                preferred = [p for p in parents if holds(p, number)] or parents
-                parent = preferred[(number + attempt) % len(preferred)]
+        def holds(parent: Peer, number: int) -> bool:
+            with state.lock:
+                bm = state.bitmaps.get(parent.id)
+            return bm is None or (number < len(bm) and bool(bm[number]))
+
+        def fetch_one(number: int) -> bool:
+            """Fetch piece `number`; True on success, False → task-level
+            abort is set."""
+            deadline = time.monotonic() + self.piece_wait_timeout_s
+            attempt = 0
+            while not state.abort.is_set():
+                apply_push()
+                with state.lock:
+                    plist = list(state.parents)
+                if not plist:
+                    state.abort.set()
+                    return False
+                holders = [p for p in plist if holds(p, number)]
+                if not holders:
+                    # "No valid piece temporarily": nobody claims it yet —
+                    # poll holder bitmaps until a mid-download parent
+                    # commits it (synchronizer analog), not a fetch error.
+                    if time.monotonic() >= deadline:
+                        state.abort.set()
+                        return False
+                    self._refresh_bitmaps(task.id, state)
+                    time.sleep(self.piece_poll_interval_s)
+                    continue
+                parent = holders[(number + attempt) % len(holders)]
                 try:
                     t_piece = time.monotonic()
                     data = self.piece_fetcher.fetch(parent.host.id, task.id, number)
                     cost_ns = max(int((time.monotonic() - t_piece) * 1e9), 1)
                 except Exception:
-                    failed += 1
+                    with state.lock:
+                        state.failed += 1
                     res = self.scheduler.report_piece_failed(peer, parent.id)
                     if res.kind is ScheduleResultKind.PARENTS and res.parents:
-                        parents = list(res.parents)
-                        refresh_bitmaps(parents)
+                        with state.lock:
+                            state.parents = list(res.parents)
+                        self._refresh_bitmaps(task.id, state, force=True)
                     elif res.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE:
-                        return None
+                        state.abort.set()
+                        return False
+                    attempt += 1
+                    if attempt > self.max_piece_retries:
+                        state.abort.set()
+                        return False
                     continue
                 self.storage.write_piece(task.id, number, data)
-                nbytes += len(data)
+                run.mark_piece(number)
+                with state.lock:
+                    state.nbytes += len(data)
                 if self.traffic_shaper is not None:
                     self.traffic_shaper.record(task.id, len(data))
                 self.scheduler.report_piece_finished(
-                    peer, number, parent_id=parent.id, length=len(data), cost_ns=cost_ns
+                    peer, number, parent_id=parent.id, length=len(data),
+                    cost_ns=cost_ns,
                 )
-                done = True
-                break
-            if not done:
-                return None
+                return True
+            return False
+
+        def worker() -> None:
+            # Any escape (storage write, shaper, report RPC raising) must
+            # abort the POOL — a silently-dead worker would otherwise let
+            # the siblings drain `pending` and report a "successful"
+            # download with this worker's popped piece missing.
+            try:
+                while not state.abort.is_set():
+                    with state.lock:
+                        if not pending:
+                            return
+                        number = pending.popleft()
+                    if not fetch_one(number):
+                        return
+            except Exception:  # noqa: BLE001 — abort → source fallback
+                import logging
+
+                state.abort.set()
+                logging.getLogger(__name__).warning(
+                    "piece worker aborted task %s", task.id, exc_info=True
+                )
+
+        n_workers = min(self.piece_parallelism, max(len(pending), 1))
+        if pending:
+            threads = [
+                threading.Thread(target=worker, name=f"piece-worker-{i}", daemon=True)
+                for i in range(n_workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        with state.lock:
+            failed, nbytes = state.failed, state.nbytes
+        if state.abort.is_set() or pending:
+            return None  # fall to source (or honor pushed back-to-source)
         self.scheduler.report_peer_finished(peer)
         if self.pex is not None:
             self.pex.advertise(task.id, set(range(n_pieces)))
@@ -327,6 +681,41 @@ class Conductor:
             cost_s=time.monotonic() - t0,
         )
 
+    def _refresh_bitmaps(
+        self, task_id: str, state: _SwarmState, *, force: bool = False
+    ) -> None:
+        """Piece-metadata sync (SyncPieceTasks analog): which pieces does
+        each parent hold RIGHT NOW.  Rate-limited so a pool of pollers
+        doesn't hammer the piece plane; `force` refreshes immediately
+        (new parents adopted)."""
+        if not hasattr(self.piece_fetcher, "piece_bitmap"):
+            return
+        now = time.monotonic()
+        with state.lock:
+            if not force and now - state.last_refresh < self.piece_poll_interval_s:
+                return
+            state.last_refresh = now
+            plist = list(state.parents)
+        for p in plist:
+            wait = getattr(self.piece_fetcher, "wait_piece_bitmap", None)
+            try:
+                if wait is not None and not force:
+                    with state.lock:
+                        have = int(sum(state.bitmaps.get(p.id, b"")))
+                    bm = wait(
+                        p.host.id, task_id, have,
+                        self.piece_poll_interval_s,
+                    )
+                else:
+                    bm = self.piece_fetcher.piece_bitmap(p.host.id, task_id)
+            except Exception:  # noqa: BLE001 — a dead parent just has no bitmap
+                bm = None
+            if bm is not None:
+                with state.lock:
+                    state.bitmaps[p.id] = bm
+
+    # -- back-to-source ------------------------------------------------------
+
     def _pull_from_source(
         self,
         peer: Peer,
@@ -334,6 +723,7 @@ class Conductor:
         piece_size: int,
         t0: float,
         headers: Optional[dict] = None,
+        run: Optional[TaskRun] = None,
     ) -> DownloadResult:
         task = peer.task
         if self.source_fetcher is None:
@@ -350,13 +740,13 @@ class Conductor:
         try:
             if groups > 1 and len(missing) >= self.concurrent_source_threshold:
                 nbytes = self._source_piece_groups(
-                    peer, missing, piece_size, groups, headers
+                    peer, missing, piece_size, groups, headers, run
                 )
             else:
                 nbytes = 0
                 for number in missing:
                     nbytes += self._source_one_piece(
-                        peer, number, piece_size, headers
+                        peer, number, piece_size, headers, run
                     )
         except _SourceFetchError as e:
             return self._fail(peer, t0, str(e))
@@ -379,6 +769,7 @@ class Conductor:
         number: int,
         piece_size: int,
         headers: Optional[dict] = None,
+        run: Optional[TaskRun] = None,
     ) -> int:
         """Fetch piece `number` from the origin, persist + report it."""
         from ..source.client import call_with_optional_headers
@@ -395,6 +786,8 @@ class Conductor:
         cost_ns = max(int((time.monotonic() - t_piece) * 1e9), 1)
         with self._report_lock:
             self.storage.write_piece(task.id, number, data)
+            if run is not None:
+                run.mark_piece(number)
             self.scheduler.report_piece_finished(
                 peer, number, parent_id="", length=len(data), cost_ns=cost_ns
             )
@@ -417,6 +810,7 @@ class Conductor:
         piece_size: int,
         groups: int,
         headers: Optional[dict] = None,
+        run: Optional[TaskRun] = None,
     ) -> int:
         """Concurrent back-to-source by contiguous piece groups.
 
@@ -440,7 +834,7 @@ class Conductor:
                     raise _SourceFetchError("cancelled by sibling group")
                 try:
                     nbytes += self._source_one_piece(
-                        peer, number, piece_size, headers
+                        peer, number, piece_size, headers, run
                     )
                 except Exception as e:
                     # Not just fetch failures: a write/report error
@@ -477,3 +871,56 @@ class Conductor:
             peer_id=peer.id,
             cost_s=time.monotonic() - t0,
         )
+
+
+class StreamHandle:
+    """A started (or reused) stream task: sizing metadata now, bytes as
+    pieces commit (peertask_manager.go StartStreamTask's ReadCloser +
+    attribute map)."""
+
+    def __init__(
+        self, conductor: Conductor, task_id: str, run: Optional[TaskRun]
+    ) -> None:
+        self._conductor = conductor
+        self.task_id = task_id
+        self._run = run  # None → completed on disk (pure reuse)
+        storage = conductor.storage
+        if run is None:
+            self.content_length = storage.content_length(task_id)
+            self.piece_size = storage.piece_size(task_id)
+            self.n_pieces = max(storage.n_pieces(task_id), 0)
+            self.reused = True
+        else:
+            self.content_length = run.content_length
+            self.piece_size = run.piece_size
+            self.n_pieces = run.n_pieces
+            self.reused = False
+
+    def chunks(self, *, piece_timeout_s: float = 60.0) -> Iterator[bytes]:
+        """Yield the task's content piece by piece, IN ORDER, waiting for
+        pieces that have not committed yet.  Raises IOError when the
+        underlying download fails or a piece times out."""
+        storage = self._conductor.storage
+        total = self.content_length
+        ps = self.piece_size
+        for number in range(self.n_pieces):
+            if self._run is not None:
+                status = self._run.wait_piece(number, piece_timeout_s)
+                if status == "failed":
+                    raise IOError(f"stream {self.task_id}: download failed")
+                if status == "timeout":
+                    raise IOError(
+                        f"stream {self.task_id}: piece {number} timed out"
+                    )
+                if status == "eof":
+                    return
+            data = storage.read_piece(self.task_id, number)
+            if total >= 0 and ps > 0:
+                remaining = total - number * ps
+                if remaining < len(data):
+                    data = data[:max(remaining, 0)]
+            if data:
+                yield data
+
+    def read_all(self, *, piece_timeout_s: float = 60.0) -> bytes:
+        return b"".join(self.chunks(piece_timeout_s=piece_timeout_s))

@@ -9,6 +9,7 @@ stand-in for the HTTP piece data plane, with identical semantics
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 from ..scheduler.networktopology import ProbeAgent
@@ -43,6 +44,27 @@ class InProcessFetcher:
         if n <= 0:
             return None
         return bytes(daemon.storage.piece_bitmap(task_id, n))
+
+    def wait_piece_bitmap(
+        self, parent_host_id: str, task_id: str, have: int, wait_s: float
+    ):
+        """Piece-metadata SUBSCRIPTION (piecetask_synchronizer analog):
+        block until the parent holds more than ``have`` pieces (a
+        mid-download parent commits a new one) or ``wait_s`` elapses,
+        then return the current bitmap."""
+        daemon = self._registry.get(parent_host_id)
+        if daemon is None:
+            return None
+        deadline = time.monotonic() + wait_s
+        while True:
+            n = daemon.storage.n_pieces(task_id)
+            grew = n > 0 and daemon.storage.held_pieces(task_id) > have
+            if grew or time.monotonic() >= deadline:
+                return (
+                    bytes(daemon.storage.piece_bitmap(task_id, n))
+                    if n > 0 else None
+                )
+            time.sleep(0.01)
 
 
 class Daemon:
@@ -104,6 +126,11 @@ class Daemon:
         if result.ok and self.pex is not None:
             self.pex.advertise(result.task_id, set(range(result.pieces)))
         return result
+
+    def open_stream(self, url: str, **kwargs):
+        """Stream-task entry (StartStreamTask analog): bytes flow as
+        pieces commit — reuse, attach-to-running, or background download."""
+        return self.conductor.open_stream(url, **kwargs)
 
     def read_task_bytes(self, task_id: str) -> bytes:
         """Reassemble a completed task's content (storage-level impl, shared

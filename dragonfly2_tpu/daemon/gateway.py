@@ -77,18 +77,30 @@ class ObjectGateway:
 
     def get_object(self, key: str) -> bytes:
         """P2P first (other daemons may hold it); backend fallback."""
+        try:
+            return b"".join(self.get_object_stream(key))
+        except (IOError, OSError, KeyError):
+            # P2P completely failed → straight backend read.
+            return self.backend.get_object(self.config.bucket, key)
+
+    def get_object_stream(self, key: str):
+        """Streaming read (StartStreamTask consumer): chunks flow as the
+        P2P download commits pieces — a hot object starts serving before
+        the swarm transfer finishes.  Raises on P2P failure; ``get_object``
+        adds the backend fallback for byte-level callers."""
         url = self._object_url(key)
-        meta = self.backend.head_object(self.config.bucket, key) if self.backend.object_exists(self.config.bucket, key) else None
+        meta = (
+            self.backend.head_object(self.config.bucket, key)
+            if self.backend.object_exists(self.config.bucket, key)
+            else None
+        )
         content_length = meta.content_length if meta else None
-        result = self.daemon.download(
+        handle = self.daemon.open_stream(
             url,
             piece_size=self.config.piece_size,
             content_length=content_length,
         )
-        if result.ok:
-            return self.daemon.read_task_bytes(result.task_id)
-        # P2P completely failed → straight backend read.
-        return self.backend.get_object(self.config.bucket, key)
+        return handle.chunks()
 
     def head_object(self, key: str) -> ObjectMetadata:
         return self.backend.head_object(self.config.bucket, key)

@@ -86,13 +86,34 @@ class P2PProxy:
                 if url.startswith("/http://") or url.startswith("/https://"):
                     url = url[1:]
                 use_p2p, effective = proxy.router.route(url)
+                if use_p2p:
+                    # STREAM the P2P task (StartStreamTask consumer): the
+                    # response body flows piece-by-piece as the download
+                    # commits — a client starts receiving long before the
+                    # task finishes.
+                    try:
+                        handle = proxy._open_p2p_stream(effective)
+                    except Exception:  # noqa: BLE001 — proxy boundary
+                        self.send_error(502)
+                        return
+                    proxy.stats["p2p"] += 1
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Length", str(max(handle.content_length, 0))
+                    )
+                    self.end_headers()
+                    try:
+                        for chunk in handle.chunks():
+                            self.wfile.write(chunk)
+                    except (IOError, OSError):
+                        # Mid-stream failure: the 200 is already on the
+                        # wire — dropping the connection is the only
+                        # honest signal (short body ≠ success).
+                        self.close_connection = True
+                    return
                 try:
-                    if use_p2p:
-                        body = proxy._fetch_p2p(effective)
-                        proxy.stats["p2p"] += 1
-                    else:
-                        body = proxy._fetch_direct(effective)
-                        proxy.stats["direct"] += 1
+                    body = proxy._fetch_direct(effective)
+                    proxy.stats["direct"] += 1
                 except Exception:  # noqa: BLE001 — proxy boundary
                     self.send_error(502)
                     return
@@ -138,6 +159,14 @@ class P2PProxy:
 
     def _fetch_p2p(self, url: str) -> bytes:
         return fetch_via_p2p(self.daemon, url, self.piece_size)
+
+    def _open_p2p_stream(self, url: str):
+        """Divert seam, streaming face: sizing now, bytes as pieces land
+        (conductor.open_stream)."""
+        return self.daemon.open_stream(
+            url, piece_size=self.piece_size,
+            content_length=self.daemon.conductor.probe_content_length(url),
+        )
 
     def _fetch_direct(self, url: str) -> bytes:
         with urllib.request.urlopen(url, timeout=self.direct_timeout) as resp:

@@ -39,12 +39,9 @@ def relay_bytes(a: socket.socket, b: socket.socket, idle_timeout: float) -> None
 def fetch_via_p2p(daemon, url: str, piece_size: int) -> bytes:
     """Route one URL through the daemon's P2P engine and return the bytes
     (transport.go's divert seam, shared by both proxy faces)."""
-    source = daemon.conductor.source_fetcher
-    content_length = None
-    if source is not None and hasattr(source, "content_length"):
-        content_length = source.content_length(url)
     result = daemon.download(
-        url, piece_size=piece_size, content_length=content_length
+        url, piece_size=piece_size,
+        content_length=daemon.conductor.probe_content_length(url),
     )
     if not result.ok:
         raise IOError(f"p2p download of {url} failed")

@@ -250,6 +250,14 @@ class DaemonStorage:
         except Exception:  # noqa: BLE001 — unknown task → nothing held
             return 0
 
+    def content_length(self, task_id: str) -> int:
+        """Header content length; -1 when the task is unknown."""
+        return self.engine.content_length(task_id)
+
+    def piece_size(self, task_id: str) -> int:
+        """Header piece size; -1 when the task is unknown."""
+        return self.engine.piece_size(task_id)
+
     def n_pieces(self, task_id: str) -> int:
         """Piece count from the task header; -1 when the header is absent
         or invalid (single owner of the ceil-div + validity idiom)."""

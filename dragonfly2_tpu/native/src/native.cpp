@@ -595,6 +595,26 @@ bool piece_verified(TaskStore* ts, PieceMeta& pm) {
   return true;
 }
 
+// "key=value" lookup in a raw query string; leaves *out untouched when
+// the key is absent or non-numeric.
+void parse_query_i64(const std::string& query, const char* key, int64_t* out) {
+  std::string needle = std::string(key) + "=";
+  size_t pos = 0;
+  while (pos < query.size()) {
+    size_t amp = query.find('&', pos);
+    std::string pair = query.substr(pos, amp == std::string::npos
+                                             ? std::string::npos
+                                             : amp - pos);
+    if (pair.rfind(needle, 0) == 0) {
+      int64_t v = 0;
+      if (parse_i64(pair.substr(needle.size()), &v)) *out = v;
+      return;
+    }
+    if (amp == std::string::npos) break;
+    pos = amp + 1;
+  }
+}
+
 // Network-supplied task components must stay inside the store root:
 // reject empty, '.', '..', and path separators before open_task — a bare
 // "GET /pieces/../N" would otherwise open <root>/../meta and cache the
@@ -653,6 +673,12 @@ void handle_conn(HttpServer* srv, int fd) {
     }
     size_t sp = line.find(' ', 4);
     std::string path = line.substr(4, sp - 4);
+    std::string query;
+    size_t qpos = path.find('?');
+    if (qpos != std::string::npos) {
+      query = path.substr(qpos + 1);
+      path = path.substr(0, qpos);
+    }
 
     PieceStore* ps = get_store(srv->store_handle);
     if (!ps || srv->active.fetch_add(1) >= srv->limit) {
@@ -707,9 +733,32 @@ void handle_conn(HttpServer* srv, int fd) {
       size_t slash = rest.find('/');
       if (slash != std::string::npos && rest.substr(slash) == "/pieces") {
         std::string task = rest.substr(0, slash);
-        TaskPtr ts = valid_task_id(task)
-                         ? open_task(ps, task.c_str(), 0, 0, false)
-                         : nullptr;
+        // Long-poll subscription (?have=N&wait_ms=M, Python-server wire
+        // parity — peertask_piecetask_synchronizer semantics): defer the
+        // bitmap until this store holds MORE than N committed pieces, so
+        // a child following a mid-download parent sees new pieces as
+        // they land.  Bounded at 30 s; re-opens the task each tick so a
+        // not-yet-registered task can appear during the window.
+        int64_t have = -1, wait_ms = 0;
+        parse_query_i64(query, "have", &have);
+        parse_query_i64(query, "wait_ms", &wait_ms);
+        if (wait_ms > 30000) wait_ms = 30000;
+        TaskPtr ts;
+        int64_t waited_ms = 0;
+        for (;;) {
+          ts = valid_task_id(task) ? open_task(ps, task.c_str(), 0, 0, false)
+                                   : nullptr;
+          int64_t held = 0;
+          if (ts) {
+            std::lock_guard<std::mutex> lk(ts->mu);
+            held = (int64_t)ts->pieces.size();
+          }
+          if ((ts && held > have) || waited_ms >= wait_ms ||
+              srv->stopping.load())
+            break;
+          usleep(20 * 1000);
+          waited_ms += 20;
+        }
         int64_t n_pieces =
             (!ts || ts->header.piece_size == 0)
                 ? 0

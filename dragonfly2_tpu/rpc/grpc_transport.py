@@ -247,8 +247,24 @@ class SchedulerGRPCServer:
                         # registered on a PREVIOUS stream (whose teardown
                         # unregistered it) — no adapter dispatch, so no
                         # duplicate peer records (ADVICE r2 finding).
+                        # Validated against the adapter's live-peer table:
+                        # a bogus/stale id must not leak a hub channel
+                        # (cross-peer trust stays at the transport's mTLS
+                        # layer, as for every other peer_id-carrying
+                        # message on this stream).
                         pid = req.resume.peer_id
+                        known = True
                         if pid:
+                            try:
+                                self.adapter._peer(pid)
+                            except KeyError:
+                                known = False
+                        if pid and not known:
+                            from ..utils.dferrors import Code
+
+                            resp.error = f"resume: unknown peer {pid}"
+                            resp.code = int(Code.NOT_FOUND)
+                        elif pid:
                             registered[pid] = make_push(pid)
                             self.hub.register(pid, registered[pid])
                         out.put(resp)

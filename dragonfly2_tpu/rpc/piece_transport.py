@@ -7,6 +7,13 @@ GET /tasks/<task_id> with a Range header → assembled byte range
 is hit, 404 for missing pieces — the conductor treats both as piece
 failures and reschedules.
 
+Piece-metadata SUBSCRIPTION (peertask_piecetask_synchronizer.go):
+GET /tasks/<task_id>/pieces?have=N&wait_ms=M long-polls — the response
+is deferred until the parent holds MORE than N pieces (a mid-download
+parent commits new data) or M milliseconds pass, so children learn a
+downloading parent's new pieces as they land instead of one-shot
+snapshots.
+
 Client: HTTPPieceFetcher resolves a parent host id to its announced
 (ip, download_port) — carried in the scheduler's parent responses — and
 range-GETs pieces with retry/backoff.
@@ -48,7 +55,11 @@ class PieceHTTPServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                parts = self.path.strip("/").split("/")
+                import time as _time
+                import urllib.parse as _parse
+
+                split = _parse.urlsplit(self.path)
+                parts = split.path.strip("/").split("/")
                 try:
                     if len(parts) == 3 and parts[0] == "pieces":
                         task_id, number = parts[1], int(parts[2])
@@ -59,9 +70,28 @@ class PieceHTTPServer:
                         # Piece-metadata sync (reference: SyncPieceTasks —
                         # peers learn which pieces a parent holds before
                         # fetching).  Body: the piece bitmap, one byte per
-                        # piece.
+                        # piece.  With ?have=N&wait_ms=M this LONG-POLLS:
+                        # the reply defers until the parent holds more
+                        # than N pieces (synchronizer subscription).
                         task_id = parts[1]
-                        n_pieces = upload_ref.storage.n_pieces(task_id)
+                        q = dict(_parse.parse_qsl(split.query))
+                        try:
+                            have = int(q.get("have", -1))
+                            wait_ms = min(int(q.get("wait_ms", 0)), 30_000)
+                        except ValueError:
+                            self.send_error(400)
+                            return
+                        deadline = _time.monotonic() + wait_ms / 1000.0
+                        while True:
+                            n_pieces = upload_ref.storage.n_pieces(task_id)
+                            if (
+                                n_pieces > 0
+                                and upload_ref.storage.held_pieces(task_id) > have
+                            ):
+                                break
+                            if _time.monotonic() >= deadline:
+                                break
+                            _time.sleep(0.02)
                         if n_pieces <= 0:
                             self.send_error(404)
                             return
@@ -260,14 +290,30 @@ class HTTPPieceFetcher:
 
     def piece_bitmap(self, parent_host_id: str, task_id: str):
         """Which pieces the parent holds (None when unknown/unreachable)."""
+        return self._bitmap_get(parent_host_id, f"/tasks/{task_id}/pieces",
+                                self.metadata_timeout)
+
+    def wait_piece_bitmap(
+        self, parent_host_id: str, task_id: str, have: int, wait_s: float
+    ):
+        """Long-poll subscription: returns once the parent holds more than
+        ``have`` pieces or the window closes (synchronizer semantics)."""
+        wait_ms = max(int(wait_s * 1000), 0)
+        return self._bitmap_get(
+            parent_host_id,
+            f"/tasks/{task_id}/pieces?have={have}&wait_ms={wait_ms}",
+            wait_s + self.metadata_timeout,
+        )
+
+    def _bitmap_get(self, parent_host_id: str, path: str, timeout: float):
         try:
             ip, port = self._resolve(parent_host_id)
         except KeyError:
             return None
-        url = f"{self._scheme}://{ip}:{port}/tasks/{task_id}/pieces"
+        url = f"{self._scheme}://{ip}:{port}{path}"
         try:
             with urllib.request.urlopen(
-                url, timeout=self.metadata_timeout, context=self.ssl_context
+                url, timeout=timeout, context=self.ssl_context
             ) as resp:
                 return resp.read()
         except (urllib.error.URLError, OSError):

@@ -147,6 +147,13 @@ class SchedulerService:
             schedule.kind is ScheduleResultKind.NEED_BACK_TO_SOURCE
             and self.seed_peer_trigger is not None
             and not task.has_available_peer()
+            # A SEED registering a cold task IS the warm-up — triggering
+            # for it would call back into the very daemon that is mid-
+            # register (its conductor dedups same-task downloads, so the
+            # nested obtain would join the blocked run: a trigger↔register
+            # deadlock until both sides' timeouts unwind).  Seeds go
+            # straight to source; only normal peers get a seed warmed.
+            and not host.type.is_seed
         ):
             # Cold task: warm a seed peer first, then reschedule once —
             # the child gets a parent instead of hitting the origin

@@ -510,3 +510,207 @@ class TestTinyAndSeedTrigger:
         )
         assert r.ok and not r.back_to_source
         assert seed.upload.upload_count == 2  # served both pieces
+
+
+class TestPeerEngine:
+    """The concurrent peer engine (VERDICT r2 missing-#1/#8 done-
+    conditions): parallel piece workers, streaming tasks, completed-task
+    reuse, piece-metadata subscription to mid-download parents."""
+
+    def _seed(self, swarm, url, n_pieces):
+        r = swarm.daemons[0].download(
+            url, piece_size=PIECE, content_length=n_pieces * PIECE
+        )
+        assert r.ok
+        return r.task_id
+
+    def test_pieces_fetched_concurrently_with_speedup(self, tmp_path):
+        """One task's pieces overlap across 3 parents: wall-clock beats the
+        sequential bound (peertask_conductor.go:1009-1077 worker pool)."""
+        import time
+
+        swarm = _Swarm(tmp_path, n_hosts=5)
+        url = "https://origin/parallel-blob"
+        n_pieces = 12
+        self._seed(swarm, url, n_pieces)
+        for i in (1, 2):  # 3 serveable parents total
+            assert swarm.daemons[i].download(url, piece_size=PIECE).ok
+
+        child = swarm.daemons[4]
+        inner = child.conductor.piece_fetcher
+        served_by = {}
+        delay = 0.05
+
+        class SlowFetcher:
+            def fetch(self, host_id, task_id, number):
+                time.sleep(delay)
+                data = inner.fetch(host_id, task_id, number)
+                served_by.setdefault(host_id, 0)
+                served_by[host_id] += 1
+                return data
+
+            def piece_bitmap(self, host_id, task_id):
+                return inner.piece_bitmap(host_id, task_id)
+
+        child.conductor.piece_fetcher = SlowFetcher()
+        t0 = time.monotonic()
+        r = child.download(url, piece_size=PIECE)
+        wall = time.monotonic() - t0
+        assert r.ok and not r.back_to_source and r.pieces == n_pieces
+        sequential_bound = n_pieces * delay  # 0.6 s
+        # 4 workers over 12 pieces ≈ 3 rounds ≈ 0.15 s; generous margin.
+        assert wall < sequential_bound * 0.75, f"no overlap: {wall:.2f}s"
+        assert len(served_by) >= 2, f"single-parent fan-in: {served_by}"
+
+    def test_completed_task_reuse_skips_scheduler(self, tmp_path):
+        """A locally-complete task serves from disk with zero scheduler
+        contact (peertask_reuse.go:49)."""
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        url = "https://origin/reuse-blob"
+        self._seed(swarm, url, 4)
+        calls = []
+        orig = swarm.scheduler.register_peer
+
+        def counting(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        swarm.scheduler.register_peer = counting
+        try:
+            r = swarm.daemons[0].download(
+                url, piece_size=PIECE, content_length=4 * PIECE
+            )
+        finally:
+            swarm.scheduler.register_peer = orig
+        assert r.ok and r.reused
+        assert r.pieces == 4 and r.bytes == 4 * PIECE
+        assert not calls, "reuse path contacted the scheduler"
+
+    def test_concurrent_same_task_downloads_join(self, tmp_path):
+        """Two simultaneous downloads of one task run ONE conductor; the
+        second attaches (findPeerTaskConductor semantics)."""
+        import threading
+        import time
+
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        url = "https://origin/join-blob"
+        n_pieces = 6
+        self._seed(swarm, url, n_pieces)
+
+        child = swarm.daemons[2]
+        inner = child.conductor.piece_fetcher
+
+        class SlowFetcher:
+            def fetch(self, host_id, task_id, number):
+                time.sleep(0.03)
+                return inner.fetch(host_id, task_id, number)
+
+            def piece_bitmap(self, host_id, task_id):
+                return inner.piece_bitmap(host_id, task_id)
+
+        child.conductor.piece_fetcher = SlowFetcher()
+        results = []
+
+        def dl():
+            results.append(child.download(url, piece_size=PIECE))
+
+        threads = [threading.Thread(target=dl) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(r.ok for r in results)
+        assert any(r.reused for r in results), "both runs fetched"
+        # The parent served each piece exactly once.
+        assert swarm.daemons[0].upload.upload_count == n_pieces
+
+    def test_stream_serves_bytes_before_task_finishes(self, tmp_path):
+        """open_stream yields committed pieces while the download is still
+        running (StartStreamTask, peertask_manager.go:357-423)."""
+        import time
+
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        url = "https://origin/stream-early-blob"
+        n_pieces = 6
+        tid = self._seed(swarm, url, n_pieces)
+        expected = b"".join(swarm.origin.content(url, n) for n in range(n_pieces))
+
+        child = swarm.daemons[2]
+        child.conductor.piece_parallelism = 1  # strictly one piece at a time
+        inner = child.conductor.piece_fetcher
+
+        class SlowFetcher:
+            def fetch(self, host_id, task_id, number):
+                time.sleep(0.08)
+                return inner.fetch(host_id, task_id, number)
+
+            def piece_bitmap(self, host_id, task_id):
+                return inner.piece_bitmap(host_id, task_id)
+
+        child.conductor.piece_fetcher = SlowFetcher()
+        handle = child.open_stream(url, piece_size=PIECE)
+        assert handle.content_length == n_pieces * PIECE
+        chunks = handle.chunks()
+        first = next(chunks)
+        # The run is still alive after the first chunk arrives: bytes
+        # flowed BEFORE the task finished.
+        assert child.conductor.active_run(tid) is not None
+        body = first + b"".join(chunks)
+        assert body == expected
+        # And the finished task is now reusable with no new traffic.
+        h2 = child.open_stream(url, piece_size=PIECE)
+        assert h2.reused and h2.read_all() == expected
+
+    def test_child_completes_from_initially_empty_parent(self, tmp_path):
+        """VERDICT r2 next-#8 done-condition: the child's only parent
+        starts with ZERO pieces; bitmap subscription picks pieces up as
+        the parent commits them mid-download."""
+        import threading
+        import time
+
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        url = "https://origin/empty-parent-blob"
+        n_pieces = 6
+
+        real_fetch = swarm.origin.fetch
+
+        def slow_fetch(u, number, piece_size):
+            time.sleep(0.08)
+            return real_fetch(u, number, piece_size)
+
+        swarm.origin.fetch = slow_fetch
+
+        parent = swarm.daemons[0]
+        child = swarm.daemons[1]
+        child.conductor.piece_poll_interval_s = 0.02
+        results = {}
+
+        def parent_dl():
+            results["parent"] = parent.download(
+                url, piece_size=PIECE, content_length=n_pieces * PIECE
+            )
+
+        t = threading.Thread(target=parent_dl)
+        t.start()
+        # Wait until the parent's run exists and is sized (registered with
+        # the scheduler, zero or near-zero pieces on disk yet).
+        from dragonfly2_tpu.utils import idgen
+
+        tid = idgen.task_id(url)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            run = parent.conductor.active_run(tid)
+            if run is not None and run.n_pieces > 0:
+                break
+            time.sleep(0.01)
+        assert parent.conductor.active_run(tid) is not None
+
+        r = child.download(url, piece_size=PIECE)
+        t.join(timeout=10)
+        assert results["parent"].ok and results["parent"].back_to_source
+        assert r.ok and not r.back_to_source, "child should ride the parent"
+        # Child never touched the origin: 6 fetches total (parent's own).
+        assert swarm.origin.fetches == n_pieces
+        assert child.read_task_bytes(tid) == b"".join(
+            swarm.origin.content(url, n) for n in range(n_pieces)
+        )

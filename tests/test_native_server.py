@@ -91,6 +91,45 @@ class TestNativePieceServer:
             )
         assert exc.value.code == 404
 
+    def test_bitmap_long_poll(self, served):
+        """?have=N&wait_ms=M defers the bitmap until a new piece commits
+        (Python-server wire parity; synchronizer subscription)."""
+        import threading
+        import time
+
+        port = served["server"].port
+        task = served["task"]
+        held = len(served["pieces"])
+
+        # All pieces held already → the window elapses, bitmap returned.
+        t0 = time.monotonic()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/tasks/{task}/pieces?have={held}&wait_ms=300",
+            timeout=5,
+        ) as resp:
+            bm = resp.read()
+        assert time.monotonic() - t0 >= 0.25
+        assert sum(bm) == held
+
+        # A piece landing mid-window releases the poll promptly.
+        storage = served["storage"]
+        t2 = "u" * 16
+        storage.register_task(t2, piece_size=PIECE, content_length=2 * PIECE)
+
+        def commit_late():
+            time.sleep(0.1)
+            storage.write_piece(t2, 0, b"q" * PIECE)
+
+        threading.Thread(target=commit_late).start()
+        t0 = time.monotonic()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/tasks/{t2}/pieces?have=0&wait_ms=5000",
+            timeout=10,
+        ) as resp:
+            bm = resp.read()
+        assert time.monotonic() - t0 < 2.0
+        assert list(bm) == [1, 0]
+
     def test_path_traversal_rejected(self, served):
         """Network-supplied task components must stay inside the store
         root (ADVICE r2: GET /pieces/../N reached <root>/../meta).  Raw
