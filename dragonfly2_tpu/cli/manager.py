@@ -26,12 +26,21 @@ def build(cfg: ManagerConfig):
         db_path=os.path.join(cfg.registry.blob_dir, "manager.db"),
     )
     clusters = ClusterManager(keepalive_ttl=cfg.keepalive_ttl_s)
+    # CRUD rows (applications + scheduler-cluster configs) share the
+    # registry's durable directory — cluster overrides survive restarts.
+    from ..manager.crud import CrudStore
+
+    crud = CrudStore(os.path.join(cfg.registry.blob_dir, "crud.db"))
+    crud.ensure_default_cluster()
+    # NOTE: no DynconfigServer here — the dynconfig payload schedulers
+    # poll is served straight from the CrudStore's cluster rows
+    # (/api/v1/clusters/<id>:config), one source of truth.
     return {
         "registry": registry,
         "clusters": clusters,
         "searcher": Searcher(),
-        "dynconfig": DynconfigServer(),
         "jobs": JobQueue(),
+        "crud": crud,
     }
 
 
@@ -82,7 +91,7 @@ def run(argv=None) -> int:
     rest = ManagerRESTServer(
         parts["registry"], parts["clusters"], parts["searcher"],
         host=cfg.server.host, port=cfg.server.port,
-        jobqueue=parts["jobs"], **auth,
+        jobqueue=parts["jobs"], crud=parts["crud"], **auth,
     )
     rest.serve()
     grpc_server = None

@@ -153,6 +153,7 @@ def run(argv=None) -> int:
     scheduler_id = f"sched-{_socket.gethostname()}"
     job_worker = None
     cluster_link = None
+    dynconfig = None
     if cfg.manager_addr:
         from ..jobs.preheat import PREHEAT
         from ..jobs.remote import RemoteJobWorker
@@ -192,6 +193,81 @@ def run(argv=None) -> int:
         job_worker.register(PREHEAT, preheat_handler)
         job_worker.register(SYNC_PEERS, make_sync_peers_handler(service.resource))
         job_worker.serve()
+
+        # Cluster-scoped scheduling config, applied LIVE (config tier c):
+        # the manager's scheduler-cluster record feeds candidate/filter
+        # limits through dynconfig, and the scheduling pass reads the
+        # shared SchedulingConfig on every call — a console PATCH changes
+        # the very next pass (scheduling.go:404-410 consumption; disk
+        # cache keeps the last-known config through manager outages).
+        import json as _json
+        import os as _os
+        import urllib.request as _request
+
+        from ..manager.dynconfig import Dynconfig
+
+        import logging as _logging
+        import urllib.error as _urlerror
+
+        _dynlog = _logging.getLogger("dragonfly2_tpu.cli.scheduler.dynconfig")
+        _warned_404 = []
+
+        def _fetch_cluster_config():
+            req = _request.Request(
+                f"{cfg.manager_addr}/api/v1/clusters/{cfg.cluster_id}:config"
+            )
+            try:
+                with _request.urlopen(req, timeout=10) as resp:
+                    return _json.loads(resp.read())
+            except _urlerror.HTTPError as exc:
+                if exc.code == 404 and not _warned_404:
+                    # Misconfiguration, not an outage: the manager has no
+                    # record for this cluster_id, so console PATCHes will
+                    # never reach this scheduler — say so ONCE, loudly
+                    # (Dynconfig's refresh swallows fetch errors silently).
+                    _warned_404.append(True)
+                    _dynlog.warning(
+                        "cluster %r has no config record on the manager — "
+                        "live scheduling overrides are inactive until it "
+                        "is created (POST /api/v1/clusters)", cfg.cluster_id,
+                    )
+                raise
+
+        def _apply_cluster_config(data):
+            scc = data.get("scheduler_cluster_config")
+            if not isinstance(scc, dict):
+                return
+            sc = service.scheduling.config
+            # Read-validate EVERYTHING before writing anything — a bad
+            # value must not leave the live config half-updated (the
+            # manager validates writes, but the disk cache or an older
+            # manager may still hand back junk).
+            updates = {}
+            for key in (
+                "candidate_parent_limit",
+                "filter_parent_limit",
+                "retry_limit",
+                "retry_back_to_source_limit",
+            ):
+                if key in scc:
+                    try:
+                        updates[key] = int(scc[key])
+                    except (TypeError, ValueError):
+                        _dynlog.warning(
+                            "ignoring cluster config with bad %s=%r",
+                            key, scc[key],
+                        )
+                        return
+            for key, value in updates.items():
+                setattr(sc, key, value)
+
+        dynconfig = Dynconfig(
+            _fetch_cluster_config,
+            refresh_interval=cfg.dynconfig_refresh_s,
+            cache_path=_os.path.join(cfg.storage.dir, "dynconfig_cache.json"),
+        )
+        dynconfig.register(_apply_cluster_config)
+        dynconfig.serve()
 
     # Periodic dataset upload to the trainer (announcer.go:127-142 train
     # ticker, default 7d) — the link that feeds the learning loop in a
@@ -284,6 +360,8 @@ def run(argv=None) -> int:
             job_worker.stop()
         if cluster_link is not None:
             cluster_link.stop()
+        if dynconfig is not None:
+            dynconfig.stop()
         return 0
 
 

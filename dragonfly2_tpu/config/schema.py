@@ -131,6 +131,10 @@ class SchedulerConfigFile:
     # job-poll and registration routes; empty on open managers.
     manager_token: str = ""
     cluster_id: str = "default"
+    # How often to poll the manager for cluster-scoped scheduling config
+    # (dynconfig.go refresh interval; the reference defaults to 10s for
+    # schedulers).
+    dynconfig_refresh_s: float = 10.0
 
     def validate(self) -> None:
         self.server.validate()

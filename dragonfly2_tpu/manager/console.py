@@ -51,6 +51,19 @@ CONSOLE_HTML = """<!DOCTYPE html>
     <th>id</th><th>cluster</th><th>address</th><th>state</th>
   </tr></thead><tbody></tbody></table>
 
+  <h2>Scheduler clusters <span class="muted">(live scheduling config)</span></h2>
+  <table id="clusters"><thead><tr>
+    <th>id</th><th>name</th><th>default</th><th>scheduler config</th><th>client config</th><th></th>
+  </tr></thead><tbody></tbody></table>
+
+  <h2>Applications</h2>
+  <input id="app-name" placeholder="name"><input id="app-url" placeholder="url">
+  <input id="app-prio" placeholder="priority" size="4">
+  <button onclick="createApp()">Create</button>
+  <table id="applications"><thead><tr>
+    <th>name</th><th>url</th><th>priority</th><th>bio</th><th></th>
+  </tr></thead><tbody></tbody></table>
+
   <h2>Users <span class="muted">(admin)</span></h2>
   <table id="users"><thead><tr>
     <th>name</th><th>email</th><th>role</th><th>state</th>
@@ -104,6 +117,18 @@ async function refresh() {
   const scheds = await api("/schedulers");
   fill("schedulers", scheds.map(s => `<tr><td><code>${esc(s.id)}</code></td>
     <td>${esc(s.cluster_id)}</td><td>${esc(s.ip)}:${s.port}</td><td>${esc(s.state)}</td></tr>`));
+  const clusters = await api("/clusters");
+  // ids ride in data attributes, never inline JS strings — even though
+  // the store rejects quote-bearing ids, the console must not rely on it.
+  fill("clusters", clusters.map(c => `<tr><td><code>${esc(c.id)}</code></td>
+    <td>${esc(c.name)}</td><td>${c.is_default}</td>
+    <td><code>${esc(JSON.stringify(c.scheduler_cluster_config))}</code></td>
+    <td><code>${esc(JSON.stringify(c.client_config))}</code></td>
+    <td><button data-id="${esc(c.id)}" onclick="editCluster(this.dataset.id)">edit config</button></td></tr>`));
+  const apps = await api("/applications");
+  fill("applications", apps.map(a => `<tr><td>${esc(a.name)}</td>
+    <td><code>${esc(a.url)}</code></td><td>${a.priority}</td><td>${esc(a.bio)}</td>
+    <td><button data-id="${esc(a.id)}" onclick="delApp(this.dataset.id)">delete</button></td></tr>`));
   try {
     const users = await api("/users");
     fill("users", users.map(u => `<tr><td>${esc(u.name)}</td><td>${esc(u.email)}</td>
@@ -119,6 +144,30 @@ async function refresh() {
 }
 async function act(id, action) {
   try { await api(`/models/${id}:${action}`, {method: "POST", body: "{}"}); refresh(); }
+  catch (e) { alert(e.message); }
+}
+async function editCluster(id) {
+  const cur = (await api("/clusters")).find(c => c.id === id);
+  const next = prompt("scheduler_cluster_config JSON (applied live by schedulers):",
+                      JSON.stringify(cur.scheduler_cluster_config));
+  if (next === null) return;
+  try {
+    await api(`/clusters/${id}:update`, {method: "POST", body: JSON.stringify(
+      {scheduler_cluster_config: JSON.parse(next)})});
+    refresh();
+  } catch (e) { alert(e.message); }
+}
+async function createApp() {
+  try {
+    await api("/applications", {method: "POST", body: JSON.stringify(
+      {name: document.getElementById("app-name").value,
+       url: document.getElementById("app-url").value,
+       priority: parseInt(document.getElementById("app-prio").value || "0")})});
+    refresh();
+  } catch (e) { alert(e.message); }
+}
+async function delApp(id) {
+  try { await api(`/applications/${id}:delete`, {method: "POST", body: "{}"}); refresh(); }
   catch (e) { alert(e.message); }
 }
 async function createPat() {

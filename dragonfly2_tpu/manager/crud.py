@@ -15,6 +15,7 @@ the write-through pattern `_SQLiteModelStore` uses.
 from __future__ import annotations
 
 import json
+import re
 import sqlite3
 import threading
 import uuid
@@ -52,6 +53,34 @@ class ClusterRecord:
 
 
 _KINDS = {"application": Application, "cluster": ClusterRecord}
+
+# Row ids appear in URLs, sqlite keys, and the console DOM — keep them
+# boring.  (Client-supplied ids with quotes were an XSS vector through the
+# console's inline handlers.)
+_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+# The scheduling limits a cluster row may carry; values must be ints —
+# a half-applied config on the scheduler side (int("oops") mid-loop) is
+# worse than a rejected write, so validation lives on the WRITE path.
+_CLUSTER_INT_KEYS = (
+    "candidate_parent_limit",
+    "filter_parent_limit",
+    "retry_limit",
+    "retry_back_to_source_limit",
+    "load_limit",
+)
+
+
+def _validate_cluster_blobs(fields: Dict[str, Any]) -> None:
+    for blob_key in ("scheduler_cluster_config", "client_config", "scopes"):
+        blob = fields.get(blob_key)
+        if blob is None:
+            continue
+        if not isinstance(blob, dict):
+            raise ValueError(f"{blob_key} must be an object, got {type(blob).__name__}")
+        for k in _CLUSTER_INT_KEYS:
+            if k in blob and not isinstance(blob[k], int):
+                raise ValueError(f"{blob_key}.{k} must be an integer")
 
 
 class CrudStore:
@@ -92,8 +121,12 @@ class CrudStore:
 
     def create(self, kind: str, **fields: Any):
         cls = _KINDS[kind]
+        if kind == "cluster":
+            _validate_cluster_blobs(fields)
         with self._mu:
             row_id = fields.pop("id", None) or uuid.uuid4().hex[:12]
+            if not _ID_RE.match(str(row_id)):
+                raise ValueError(f"invalid {kind} id {row_id!r}")
             if row_id in self._rows[kind]:
                 raise ValueError(f"{kind} {row_id!r} already exists")
             obj = cls(id=row_id, **fields)
@@ -114,6 +147,8 @@ class CrudStore:
 
     def update(self, kind: str, row_id: str, **fields: Any):
         cls = _KINDS[kind]
+        if kind == "cluster":
+            _validate_cluster_blobs(fields)
         with self._mu:
             row = self._rows[kind].get(row_id)
             if row is None:
@@ -141,6 +176,12 @@ class CrudStore:
             for row in self._rows["cluster"].values():
                 if row.get("is_default"):
                     return ClusterRecord(**row)
+            # An id="default" row whose is_default flag was cleared by an
+            # update still satisfies the invariant — re-creating it would
+            # raise "already exists" on every boot (a restart crash loop).
+            row = self._rows["cluster"].get("default")
+            if row is not None:
+                return ClusterRecord(**row)
         return self.create(
             "cluster", id="default", name="default", is_default=True,
             scheduler_cluster_config={

@@ -12,6 +12,20 @@ Minimal JSON binding over stdlib HTTP:
   GET    /api/v1/clusters:search?ip=&hostname=&idc=&location=
   GET    /api/v1/healthy                         liveness
 
+CRUD resources (manager/handlers/application.go, scheduler_cluster.go;
+rows in manager/crud.py CrudStore, sqlite write-through):
+
+  GET    /api/v1/applications                    list
+  POST   /api/v1/applications                    create        (OPERATOR)
+  POST   /api/v1/applications/<id>:update        partial update (OPERATOR)
+  POST   /api/v1/applications/<id>:delete                       (OPERATOR)
+  GET    /api/v1/clusters                        list scheduler clusters
+  POST   /api/v1/clusters                        create        (OPERATOR)
+  POST   /api/v1/clusters/<id>:update            partial update (OPERATOR)
+  POST   /api/v1/clusters/<id>:delete                           (OPERATOR)
+  GET    /api/v1/clusters/<id>:config            the dynconfig payload a
+         scheduler polls (scheduling.go:404-410 limit consumption)
+
 User/RBAC surface (manager/handlers/user.go + personal access tokens):
 
   POST   /api/v1/users:signup                    open signup (READONLY)
@@ -43,6 +57,7 @@ from ..rpc._server import ThreadedHTTPService
 from ..security.tokens import Role
 
 from .cluster import ClusterManager
+from .crud import CrudStore
 from .registry import Model, ModelRegistry
 from .searcher import SchedulerCluster, Searcher
 
@@ -95,11 +110,17 @@ class ManagerRESTServer:
         users=None,
         oauth=None,
         jobqueue=None,
+        crud: Optional[CrudStore] = None,
     ):
         self.registry = registry
         self.clusters = clusters
         self.searcher = searcher or Searcher()
         self.scheduler_clusters = scheduler_clusters or []
+        # CRUD resources (applications + scheduler-cluster records whose
+        # config blobs feed the schedulers' dynconfig).  The default
+        # cluster always exists — dynconfig consumers need one to poll.
+        self.crud = crud or CrudStore()
+        self.crud.ensure_default_cluster()
         # Job broker (machinery-over-Redis analog, jobs/remote.py): the
         # manager hosts the queues; remote scheduler workers poll them
         # over this REST surface.
@@ -238,10 +259,30 @@ class ManagerRESTServer:
                         self._json(200, server.jobqueue.group_snapshot(gid))
                     except KeyError:
                         self._json(404, {"error": f"no group {gid!r}"})
+                elif path == "/api/v1/applications":
+                    from dataclasses import asdict
+
+                    self._json(
+                        200, [asdict(a) for a in server.crud.list("application")]
+                    )
+                elif path == "/api/v1/clusters":
+                    from dataclasses import asdict
+
+                    self._json(200, [asdict(c) for c in server.crud.list("cluster")])
+                elif path.startswith("/api/v1/clusters/") and path.endswith(
+                    ":config"
+                ):
+                    # The dynconfig payload a scheduler polls for its live
+                    # scheduling limits (scheduling.go:404-410).
+                    cid = path[len("/api/v1/clusters/"):-len(":config")]
+                    try:
+                        self._json(200, server.crud.cluster_config(cid))
+                    except KeyError as exc:
+                        self._json(404, {"error": str(exc)})
                 elif path == "/api/v1/clusters:search":
                     try:
                         ranked = server.searcher.find_scheduler_clusters(
-                            server.scheduler_clusters,
+                            server.search_clusters(),
                             ip=q.get("ip", ""),
                             hostname=q.get("hostname", ""),
                             conditions={
@@ -313,6 +354,11 @@ class ManagerRESTServer:
                     # KeepAlive in manager_server_v1.go run on mTLS'd
                     # service identities) → PEER.
                     required = Role.PEER
+                elif path.startswith("/api/v1/applications") or path.startswith(
+                    "/api/v1/clusters"
+                ):
+                    # CRUD mutations are operator console actions.
+                    required = Role.OPERATOR
                 else:
                     required = Role.ADMIN  # unknown mutations: locked down
                 if not self._authorized(required):
@@ -320,6 +366,12 @@ class ManagerRESTServer:
                     return
                 if path.startswith("/api/v1/jobs"):
                     self._job_routes(path)
+                    return
+                if path.startswith("/api/v1/applications") or (
+                    path.startswith("/api/v1/clusters")
+                    and not path.startswith("/api/v1/clusters:")
+                ):
+                    self._crud_routes(path)
                     return
                 if path == "/api/v1/schedulers":
                     # Scheduler instance registration over REST — the wire
@@ -386,6 +438,36 @@ class ManagerRESTServer:
                         self._json(404, {"error": f"model {model_id} not found"})
                     return
                 self._json(404, {"error": "not found"})
+
+            def _crud_routes(self, path: str) -> None:
+                """Applications + scheduler-cluster CRUD
+                (manager/handlers/application.go, scheduler_cluster.go)."""
+                from dataclasses import asdict
+
+                kind, base = (
+                    ("application", "/api/v1/applications")
+                    if path.startswith("/api/v1/applications")
+                    else ("cluster", "/api/v1/clusters")
+                )
+                try:
+                    if path == base:
+                        obj = server.crud.create(kind, **self._body())
+                        self._json(200, asdict(obj))
+                        return
+                    rest = path[len(base) + 1:]
+                    row_id, _, action = rest.rpartition(":")
+                    if action == "update":
+                        obj = server.crud.update(kind, row_id, **self._body())
+                        self._json(200, asdict(obj))
+                    elif action == "delete":
+                        server.crud.delete(kind, row_id)
+                        self._json(200, {"ok": True})
+                    else:
+                        self._json(404, {"error": f"unknown action {action!r}"})
+                except KeyError as exc:
+                    self._json(404, {"error": str(exc)})
+                except (ValueError, TypeError) as exc:
+                    self._json(400, {"error": str(exc)})
 
             def _job_routes(self, path: str) -> None:
                 """Job broker wire (jobs/remote.py contract)."""
@@ -558,6 +640,34 @@ class ManagerRESTServer:
 
         self._svc = ThreadedHTTPService(Handler, host, port, "manager-rest")
         self.address: Tuple[str, int] = self._svc.address
+
+    def search_clusters(self) -> List[SchedulerCluster]:
+        """The searcher's candidate set: the constructor-injected list when
+        provided (tests, static deployments), else the CRUD cluster rows —
+        ONE cluster model, so a cluster created over REST is immediately
+        searchable, with live scheduler ids from the keepalive table."""
+        if self.scheduler_clusters:
+            return self.scheduler_clusters
+        from .searcher import ClusterScopes
+
+        out = []
+        for rec in self.crud.list("cluster"):
+            scopes = rec.scopes or {}
+            out.append(SchedulerCluster(
+                id=rec.id,
+                name=rec.name,
+                is_default=rec.is_default,
+                scopes=ClusterScopes(
+                    idc=scopes.get("idc", ""),
+                    location=scopes.get("location", ""),
+                    cidrs=tuple(scopes.get("cidrs", ())),
+                    hostnames=tuple(scopes.get("hostnames", ())),
+                ),
+                scheduler_ids=[
+                    s.id for s in self.clusters.active_schedulers(rec.id)
+                ],
+            ))
+        return out
 
     @property
     def url(self) -> str:
