@@ -16,7 +16,18 @@ from .common import base_parser, init_debug, init_logging
 
 
 def _gateway(args):
-    backend = FilesystemBackend(args.backend_root)
+    # Backend by config (objectstorage.go:179-212 dispatch): local
+    # filesystem by default; signed S3/OSS endpoints when pointed at one.
+    from ..objectstorage import make_backend
+
+    if args.backend == "fs":
+        backend = FilesystemBackend(args.backend_root)
+    else:
+        backend = make_backend(
+            args.backend, endpoint=args.endpoint,
+            access_key=args.access_key, secret_key=args.secret_key,
+            region=args.region,
+        )
     resource = Resource()
     scheduler = SchedulerService(
         resource, Scheduling(Evaluator(), SchedulingConfig(retry_interval=0))
@@ -42,11 +53,20 @@ def run(argv=None) -> int:
     p.add_argument("dst_key", nargs="?", default="", help="destination key (cp)")
     p.add_argument("-f", "--file", default=None, help="local file (put/get)")
     p.add_argument("--bucket", default="dragonfly")
+    p.add_argument("--backend", choices=["fs", "s3", "oss"], default="fs",
+                   help="object-storage backend (fs=local dir, s3/oss=remote)")
+    p.add_argument("--endpoint", default="",
+                   help="s3/oss endpoint URL (e.g. http://minio:9000)")
+    p.add_argument("--access-key", default=os.environ.get("DF_ACCESS_KEY", ""))
+    p.add_argument("--secret-key", default=os.environ.get("DF_SECRET_KEY", ""))
+    p.add_argument("--region", default="us-east-1")
     p.add_argument("--backend-root", default=os.path.expanduser("~/.dragonfly/objects"))
     p.add_argument("--work-dir", default=os.path.expanduser("~/.dragonfly/dfstore"))
     args = p.parse_args(argv)
     init_logging(args, "dfstore")
     init_debug(args)
+    if args.backend != "fs" and not args.endpoint:
+        p.error(f"--backend {args.backend} requires --endpoint")
     gw = _gateway(args)
 
     if args.command == "put":

@@ -13,7 +13,7 @@ import time
 
 from ..config import ManagerConfig, load_config
 from ..jobs import JobQueue
-from ..manager import ClusterManager, DynconfigServer, ModelRegistry, Searcher
+from ..manager import ClusterManager, ModelRegistry, Searcher
 from ..manager.registry import BlobStore
 from .common import base_parser, init_debug, init_logging
 

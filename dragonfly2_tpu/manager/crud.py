@@ -124,8 +124,10 @@ class CrudStore:
         if kind == "cluster":
             _validate_cluster_blobs(fields)
         with self._mu:
-            row_id = fields.pop("id", None) or uuid.uuid4().hex[:12]
-            if not _ID_RE.match(str(row_id)):
+            # str-coerce BEFORE storing: a JSON-integer id would otherwise
+            # live under an int key the string-keyed REST routes miss.
+            row_id = str(fields.pop("id", None) or uuid.uuid4().hex[:12])
+            if not _ID_RE.match(row_id):
                 raise ValueError(f"invalid {kind} id {row_id!r}")
             if row_id in self._rows[kind]:
                 raise ValueError(f"{kind} {row_id!r} already exists")

@@ -1,9 +1,10 @@
 """Object storage backends (reference: pkg/objectstorage/).
 
-One interface (objectstorage.go:179-212) over pluggable backends; the
-reference ships S3/OSS/OBS.  Here the filesystem backend is built in (and
-is what the e2e fixtures use); cloud backends register into the same
-registry at deploy time.
+One interface (objectstorage.go:179-212) over pluggable backends,
+matching the reference's S3/OSS/OBS dispatch: the filesystem backend is
+built in (the e2e fixtures use it), and ``S3Backend``/``OSSBackend``
+(s3.py) speak signed path-style HTTP to any compatible endpoint —
+selected by config via ``make_backend``.
 """
 
 from .backend import (  # noqa: F401
@@ -12,4 +13,10 @@ from .backend import (  # noqa: F401
     ObjectStorageBackend,
     ObjectStorageRegistry,
     default_backends,
+)
+from .s3 import (  # noqa: F401
+    ObjectStorageError,
+    OSSBackend,
+    S3Backend,
+    make_backend,
 )

@@ -1,0 +1,196 @@
+"""In-memory fake S3 endpoint for backend tests.
+
+Speaks enough path-style S3 for ObjectStorageBackend: bucket PUT/HEAD,
+object PUT/GET/HEAD/DELETE, x-amz-copy-source, ListObjectsV2 XML.  It
+VERIFIES SigV4 signatures (recomputing them with the repo's signer from
+the request it received) so the S3Backend's signing is tested against an
+independent check of the algorithm's inputs, not just echoed back.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import unquote, urlsplit
+
+from dragonfly2_tpu.source import sigv4
+
+ACCESS_KEY = "AKFAKE"
+SECRET_KEY = "sk-fake-secret"
+REGION = "eu-fake-1"
+
+
+class FakeS3:
+    def __init__(self):
+        self.buckets = {}  # bucket → {key: (bytes, mtime)}
+        self.lock = threading.Lock()
+        self.auth_failures = 0
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code, body=b"", headers=None):
+                headers = dict(headers or {})
+                self.send_response(code)
+                # HEAD replies advertise the OBJECT's length, not the
+                # (empty) response body's — don't double the header.
+                headers.setdefault("Content-Length", str(len(body)))
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _check_sig(self, payload: bytes) -> bool:
+                auth = self.headers.get("Authorization", "")
+                if not auth.startswith("AWS4-HMAC-SHA256"):
+                    return False
+                amz_date = self.headers.get("x-amz-date", "")
+                signed_names = ""
+                for part in auth.split(", "):
+                    if part.startswith("SignedHeaders="):
+                        signed_names = part[len("SignedHeaders="):]
+                headers = {
+                    name: self.headers.get(name, "")
+                    for name in signed_names.split(";")
+                }
+                # Host: the client signed what it sent.
+                if "host" in headers:
+                    headers["host"] = self.headers.get("Host", "")
+                expect = sigv4.sign_request(
+                    self.command,
+                    f"http://{self.headers.get('Host','')}{self.path}",
+                    headers,
+                    access_key=ACCESS_KEY, secret_key=SECRET_KEY,
+                    region=REGION, service="s3", amz_date=amz_date,
+                    payload_sha256=hashlib.sha256(payload).hexdigest(),
+                )
+                ok = expect == auth
+                if not ok:
+                    fake.auth_failures += 1
+                return ok
+
+            def _route(self):
+                split = urlsplit(self.path)
+                parts = split.path.lstrip("/").split("/", 1)
+                bucket = unquote(parts[0])
+                key = unquote(parts[1]) if len(parts) > 1 else ""
+                return bucket, key, split.query
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", 0))
+                payload = self.rfile.read(length)
+                if not self._check_sig(payload):
+                    self._reply(403)
+                    return
+                bucket, key, _ = self._route()
+                with fake.lock:
+                    if not key:  # bucket create
+                        fake.buckets.setdefault(bucket, {})
+                        self._reply(200)
+                        return
+                    if bucket not in fake.buckets:
+                        self._reply(404)
+                        return
+                    src = self.headers.get("x-amz-copy-source")
+                    if src:
+                        sb, sk = src.lstrip("/").split("/", 1)
+                        stored = fake.buckets.get(sb, {}).get(sk)
+                        if stored is None:
+                            self._reply(404)
+                            return
+                        payload = stored[0]
+                    fake.buckets[bucket][key] = (payload, time.time())
+                etag = hashlib.md5(payload).hexdigest()
+                self._reply(200, headers={"ETag": f'"{etag}"'})
+
+            def do_GET(self):
+                if not self._check_sig(b""):
+                    self._reply(403)
+                    return
+                bucket, key, query = self._route()
+                with fake.lock:
+                    objs = fake.buckets.get(bucket)
+                    if objs is None:
+                        self._reply(404)
+                        return
+                    if not key:  # list
+                        prefix = ""
+                        for pair in query.split("&"):
+                            if pair.startswith("prefix="):
+                                prefix = unquote(pair[len("prefix="):])
+                        rows = "".join(
+                            "<Contents>"
+                            f"<Key>{k}</Key><Size>{len(v[0])}</Size>"
+                            f"<ETag>\"{hashlib.md5(v[0]).hexdigest()}\"</ETag>"
+                            "<LastModified>"
+                            + time.strftime("%Y-%m-%dT%H:%M:%S.000Z",
+                                            time.gmtime(v[1]))
+                            + "</LastModified></Contents>"
+                            for k, v in sorted(objs.items())
+                            if k.startswith(prefix)
+                        )
+                        body = (
+                            "<?xml version=\"1.0\"?><ListBucketResult>"
+                            + rows + "</ListBucketResult>"
+                        ).encode()
+                        self._reply(200, body,
+                                    {"Content-Type": "application/xml"})
+                        return
+                    stored = objs.get(key)
+                if stored is None:
+                    self._reply(404)
+                    return
+                self._reply(200, stored[0])
+
+            def do_HEAD(self):
+                if not self._check_sig(b""):
+                    self._reply(403)
+                    return
+                bucket, key, _ = self._route()
+                with fake.lock:
+                    objs = fake.buckets.get(bucket)
+                    stored = objs.get(key) if objs and key else None
+                if objs is None or (key and stored is None):
+                    self._reply(404)
+                    return
+                if not key:
+                    self._reply(200)
+                    return
+                self._reply(200, headers={
+                    "Content-Length": str(len(stored[0])),
+                    "ETag": f'"{hashlib.md5(stored[0]).hexdigest()}"',
+                    "Last-Modified": time.strftime(
+                        "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(stored[1])
+                    ),
+                })
+
+            def do_DELETE(self):
+                if not self._check_sig(b""):
+                    self._reply(403)
+                    return
+                bucket, key, _ = self._route()
+                with fake.lock:
+                    objs = fake.buckets.get(bucket, {})
+                    if key in objs:
+                        del objs[key]
+                        self._reply(204)
+                    else:
+                        self._reply(404)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.endpoint = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

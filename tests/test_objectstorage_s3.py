@@ -1,0 +1,151 @@
+"""S3/OSS object-storage backends (VERDICT r2 next-#6): signed HTTP
+backends against a signature-VERIFYING fake S3, config dispatch, and the
+gateway e2e over the S3 backend."""
+
+import pytest
+
+from dragonfly2_tpu.objectstorage import (
+    OSSBackend,
+    S3Backend,
+    make_backend,
+)
+from tests.fake_s3 import ACCESS_KEY, REGION, SECRET_KEY, FakeS3
+
+
+@pytest.fixture()
+def fake_s3():
+    srv = FakeS3()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def s3(fake_s3):
+    return S3Backend(
+        fake_s3.endpoint, access_key=ACCESS_KEY, secret_key=SECRET_KEY,
+        region=REGION,
+    )
+
+
+class TestS3Backend:
+    def test_bucket_and_object_crud(self, fake_s3, s3):
+        assert not s3.bucket_exists("bkt")
+        s3.create_bucket("bkt")
+        assert s3.bucket_exists("bkt")
+        s3.create_bucket("bkt")  # idempotent
+
+        meta = s3.put_object("bkt", "a/b/model.npz", b"\x00\x01payload")
+        assert meta.content_length == 9
+        assert s3.get_object("bkt", "a/b/model.npz") == b"\x00\x01payload"
+        head = s3.head_object("bkt", "a/b/model.npz")
+        assert head.content_length == 9 and head.etag == meta.etag
+        assert s3.object_exists("bkt", "a/b/model.npz")
+        assert not s3.object_exists("bkt", "ghost")
+        with pytest.raises(KeyError):
+            s3.get_object("bkt", "ghost")
+        # Every request above carried a signature the server RECOMPUTED.
+        assert fake_s3.auth_failures == 0
+
+    def test_copy_list_delete(self, fake_s3, s3):
+        s3.create_bucket("bkt")
+        s3.put_object("bkt", "x/one", b"1" * 10)
+        s3.put_object("bkt", "x/two", b"2" * 20)
+        s3.put_object("bkt", "y/three", b"3" * 30)
+        copied = s3.copy_object("bkt", "x/one", "x/copied")
+        assert copied.content_length == 10
+        keys = [m.key for m in s3.list_objects("bkt", prefix="x/")]
+        assert keys == ["x/copied", "x/one", "x/two"]
+        sizes = {m.key: m.content_length for m in s3.list_objects("bkt")}
+        assert sizes["y/three"] == 30
+        s3.delete_object("bkt", "x/one")
+        assert not s3.object_exists("bkt", "x/one")
+        s3.delete_object("bkt", "x/one")  # idempotent
+        assert fake_s3.auth_failures == 0
+
+    def test_bad_credentials_rejected(self, fake_s3):
+        bad = S3Backend(
+            fake_s3.endpoint, access_key=ACCESS_KEY, secret_key="wrong",
+            region=REGION,
+        )
+        from dragonfly2_tpu.objectstorage import ObjectStorageError
+
+        with pytest.raises((ObjectStorageError, OSError)):
+            bad.create_bucket("nope")
+        assert fake_s3.auth_failures > 0
+
+    def test_make_backend_dispatch(self, tmp_path, fake_s3):
+        fs = make_backend("fs", root=str(tmp_path))
+        fs.create_bucket("b")
+        assert fs.bucket_exists("b")
+        s3 = make_backend("s3", endpoint=fake_s3.endpoint,
+                          access_key=ACCESS_KEY, secret_key=SECRET_KEY,
+                          region=REGION)
+        assert isinstance(s3, S3Backend)
+        assert isinstance(
+            make_backend("oss", endpoint="http://x", access_key="a",
+                         secret_key="b"),
+            OSSBackend,
+        )
+        with pytest.raises(ValueError):
+            make_backend("gcs", endpoint="http://x")
+
+
+class TestGatewayOverS3:
+    def test_gateway_e2e_on_fake_s3(self, tmp_path, fake_s3, s3):
+        """VERDICT r2 next-#6 done-condition: the daemon gateway runs its
+        put→seed→P2P-read loop against the S3 backend."""
+        from dragonfly2_tpu.daemon.gateway import (
+            GatewayConfig,
+            GatewaySourceFetcher,
+            ObjectGateway,
+        )
+        from tests.test_daemon import PIECE, _Swarm
+
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        for d in swarm.daemons:
+            d.conductor.source_fetcher = GatewaySourceFetcher(s3)
+        gws = [
+            ObjectGateway(d, s3, GatewayConfig(piece_size=PIECE))
+            for d in swarm.daemons
+        ]
+        payload = bytes(i % 251 for i in range(2 * PIECE + 77))
+        gws[0].put_object("models/ranker.npz", payload)
+        # The object landed in the (fake) S3 bucket...
+        assert s3.get_object("dragonfly", "models/ranker.npz") == payload
+        # ...and the second daemon reads it P2P-first from daemon 0.
+        got = gws[1].get_object("models/ranker.npz")
+        assert got == payload
+        assert swarm.daemons[0].upload.upload_count > 0
+        # Metadata surface.
+        assert gws[1].head_object("models/ranker.npz").content_length == len(payload)
+        assert [m.key for m in gws[1].list_objects("models/")] == ["models/ranker.npz"]
+        gws[0].delete_object("models/ranker.npz")
+        assert not gws[1].object_exists("models/ranker.npz")
+        assert fake_s3.auth_failures == 0
+
+
+class TestOSSSigning:
+    def test_header_signature_shape(self):
+        """Independent recomputation of the OSS HMAC-SHA1 scheme over the
+        canonicalized request the backend signs."""
+        import base64
+        import hashlib
+        import hmac
+
+        b = OSSBackend("http://oss.local", access_key="AK", secret_key="SK")
+        headers = b._sign(
+            "PUT", "http://oss.local/bkt/key.bin",
+            {"x-oss-meta-tag": "v", "Content-Type": "application/json"},
+            b"payload", "bkt", "key.bin",
+        )
+        auth = headers["Authorization"]
+        assert auth.startswith("OSS AK:")
+        date = headers["Date"]
+        to_sign = (
+            "PUT\n\napplication/json\n" + date
+            + "\nx-oss-meta-tag:v\n/bkt/key.bin"
+        )
+        want = base64.b64encode(
+            hmac.new(b"SK", to_sign.encode(), hashlib.sha1).digest()
+        ).decode()
+        assert auth == f"OSS AK:{want}"
