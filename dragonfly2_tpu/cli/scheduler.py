@@ -93,6 +93,29 @@ def run(argv=None) -> int:
     cfg = load_config(SchedulerConfigFile, args.config)
     service, storage, runner = build(cfg)
 
+    # Durable probe graph (the Redis-persistence analog): reload the
+    # saved state at boot so the nt evaluator keeps its RTT scores across
+    # restarts; TopologySync (below) re-saves every interval + on stop.
+    import os as _os
+
+    topology_state_path = None
+    if service.networktopology is not None:
+        topology_state_path = _os.path.join(cfg.storage.dir, "topology_state.json")
+        loaded = service.networktopology.load(topology_state_path)
+        if loaded:
+            print(f"scheduler: reloaded {loaded} probe edges", flush=True)
+        # Periodic checkpoint when no manager is configured — a kill must
+        # cost at most one interval of probes.  With a manager, the
+        # TopologySync loop owns the checkpointing (ONE writer; two
+        # unsynchronized savers would race on the state file).
+        if not cfg.manager_addr:
+            runner.add(
+                dfgc.Task(
+                    "topology-save", interval=60.0, timeout=30.0,
+                    runner=lambda: service.networktopology.save(topology_state_path),
+                )
+            )
+
     if args.simulate:
         from ..sim import SwarmConfig, SwarmSimulator
 
@@ -154,6 +177,7 @@ def run(argv=None) -> int:
     job_worker = None
     cluster_link = None
     dynconfig = None
+    topology_sync = None
     if cfg.manager_addr:
         from ..jobs.preheat import PREHEAT
         from ..jobs.remote import RemoteJobWorker
@@ -269,6 +293,19 @@ def run(argv=None) -> int:
         dynconfig.register(_apply_cluster_config)
         dynconfig.serve()
 
+        # Cross-replica topology sharing through the manager (the Redis
+        # analog): probes landed on OTHER schedulers inform this one's nt
+        # evaluator, and each sync checkpoints the local graph to disk.
+        if service.networktopology is not None:
+            from ..scheduler.topology_sync import TopologySync
+
+            topology_sync = TopologySync(
+                service.networktopology, cfg.manager_addr, scheduler_id,
+                token=token, interval_s=cfg.topology_sync_interval_s,
+                state_path=topology_state_path,
+            )
+            topology_sync.serve()
+
     # Periodic dataset upload to the trainer (announcer.go:127-142 train
     # ticker, default 7d) — the link that feeds the learning loop in a
     # real deployment.
@@ -362,6 +399,10 @@ def run(argv=None) -> int:
             cluster_link.stop()
         if dynconfig is not None:
             dynconfig.stop()
+        if topology_sync is not None:
+            topology_sync.stop()  # final disk checkpoint
+        elif topology_state_path is not None and service.networktopology:
+            service.networktopology.save(topology_state_path)
         return 0
 
 

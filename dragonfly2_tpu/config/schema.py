@@ -135,6 +135,9 @@ class SchedulerConfigFile:
     # (dynconfig.go refresh interval; the reference defaults to 10s for
     # schedulers).
     dynconfig_refresh_s: float = 10.0
+    # Cross-replica probe-graph sync cadence (push own edges, pull the
+    # other schedulers' via the manager — the Redis-sharing analog).
+    topology_sync_interval_s: float = 30.0
 
     def validate(self) -> None:
         self.server.validate()

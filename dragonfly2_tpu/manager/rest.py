@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import base64
 import json
+import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler
 from typing import List, Optional, Tuple
@@ -121,6 +122,16 @@ class ManagerRESTServer:
         # cluster always exists — dynconfig consumers need one to poll.
         self.crud = crud or CrudStore()
         self.crud.ensure_default_cluster()
+        # Shared topology cache (the Redis analog for the probe graph,
+        # network_topology.go:55-88): scheduler_id → its pushed edge
+        # summaries.  Replicas pull everyone else's edges; a scheduler
+        # restart re-pushes within one sync interval.  Entries whose
+        # pusher went quiet past the TTL are evicted on read — a
+        # decommissioned scheduler's stale RTTs must not skew rankings
+        # forever (live schedulers re-push every ~30 s).
+        self.topology_shared: dict = {}
+        self.topology_ttl_s = 600.0
+        self._topology_mu = threading.Lock()
         # Job broker (machinery-over-Redis analog, jobs/remote.py): the
         # manager hosts the queues; remote scheduler workers poll them
         # over this REST surface.
@@ -259,6 +270,28 @@ class ManagerRESTServer:
                         self._json(200, server.jobqueue.group_snapshot(gid))
                     except KeyError:
                         self._json(404, {"error": f"no group {gid!r}"})
+                elif path == "/api/v1/topology":
+                    # Cross-replica pull: every LIVE pusher's edges EXCEPT
+                    # the caller's own (it already has those, fresher).
+                    import time as _time
+
+                    exclude = q.get("exclude", "")
+                    now = _time.time()
+                    with server._topology_mu:
+                        dead = [
+                            sid
+                            for sid, entry in server.topology_shared.items()
+                            if now - entry["pushed_at"] > server.topology_ttl_s
+                        ]
+                        for sid in dead:
+                            del server.topology_shared[sid]
+                        edges = [
+                            e
+                            for sid, entry in server.topology_shared.items()
+                            if sid != exclude
+                            for e in entry["edges"]
+                        ]
+                    self._json(200, {"edges": edges})
                 elif path == "/api/v1/applications":
                     from dataclasses import asdict
 
@@ -354,6 +387,8 @@ class ManagerRESTServer:
                     # KeepAlive in manager_server_v1.go run on mTLS'd
                     # service identities) → PEER.
                     required = Role.PEER
+                elif path == "/api/v1/topology":
+                    required = Role.PEER  # scheduler service flow
                 elif path.startswith("/api/v1/applications") or path.startswith(
                     "/api/v1/clusters"
                 ):
@@ -372,6 +407,30 @@ class ManagerRESTServer:
                     and not path.startswith("/api/v1/clusters:")
                 ):
                     self._crud_routes(path)
+                    return
+                if path == "/api/v1/topology":
+                    # Scheduler push: replace this scheduler's edge set.
+                    try:
+                        req = self._body()
+                        sid = req["scheduler_id"]
+                        # Validate edge shape at the WRITE boundary: one
+                        # malformed push must not poison every replica's
+                        # merge on pull.
+                        edges = [
+                            e for e in (req.get("edges") or [])
+                            if isinstance(e, dict)
+                            and e.get("src") and e.get("dst")
+                            and isinstance(e.get("average_rtt_ns"), int)
+                        ]
+                        import time as _time
+
+                        with server._topology_mu:
+                            server.topology_shared[sid] = {
+                                "edges": edges, "pushed_at": _time.time(),
+                            }
+                        self._json(200, {"ok": True, "edges": len(edges)})
+                    except (KeyError, ValueError, TypeError) as exc:
+                        self._json(400, {"error": str(exc)})
                     return
                 if path == "/api/v1/schedulers":
                     # Scheduler instance registration over REST — the wire

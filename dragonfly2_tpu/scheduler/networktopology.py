@@ -211,6 +211,122 @@ class NetworkTopology:
                 )
         return records
 
+    # -- durability + cross-replica sharing (the Redis analog) ---------------
+    #
+    # The reference's probe graph lives in Redis (network_topology.go:55-88,
+    # pkg/redis): it survives scheduler restarts and is readable by every
+    # replica.  Here durability is a JSON state file per scheduler
+    # (save/load below) and sharing rides the manager: each scheduler
+    # pushes its edge summaries and pulls the other replicas' (scheduler/
+    # topology_sync.py), merged newest-wins into the live store.
+
+    def export_state(self) -> dict:
+        """Full-fidelity state (probe queues + counts) for save/load."""
+        with self._mu:
+            return {
+                "edges": [
+                    {
+                        "src": src, "dst": dst,
+                        "average_rtt_ns": e.average_rtt_ns,
+                        "created_at": e.created_at,
+                        "updated_at": e.updated_at,
+                        "probes": [
+                            {"host_id": p.host_id, "rtt_ns": p.rtt_ns,
+                             "created_at": p.created_at}
+                            for p in e.probes
+                        ],
+                    }
+                    for (src, dst), e in self._edges.items()
+                ],
+                "probed_count": dict(self._probed_count),
+            }
+
+    def import_state(self, state: dict) -> int:
+        """Restore a saved state (restart reload); returns edges loaded."""
+        edges = state.get("edges", [])
+        with self._mu:
+            for rec in edges:
+                edge = _Edge(self.config.probe_queue_length)
+                for p in rec.get("probes", []):
+                    edge.probes.append(Probe(
+                        host_id=p["host_id"], rtt_ns=int(p["rtt_ns"]),
+                        created_at=float(p.get("created_at", 0.0)),
+                    ))
+                edge.average_rtt_ns = rec.get("average_rtt_ns")
+                edge.created_at = float(rec.get("created_at", time.time()))
+                edge.updated_at = float(rec.get("updated_at", edge.created_at))
+                self._edges[(rec["src"], rec["dst"])] = edge
+            for host_id, count in state.get("probed_count", {}).items():
+                self._probed_count[host_id] = max(
+                    self._probed_count.get(host_id, 0), int(count)
+                )
+        return len(edges)
+
+    def save(self, path: str) -> None:
+        import json
+        import os
+        import threading as _threading
+
+        # Per-writer tmp name: even if two savers ever coexist, each
+        # os.replace installs a COMPLETE document (no interleaved writes).
+        tmp = f"{path}.{os.getpid()}.{_threading.get_ident()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.export_state(), f)
+        os.replace(tmp, path)
+
+    def load(self, path: str) -> int:
+        """Reload a persisted probe graph; 0 when absent/corrupt — a bad
+        state file must degrade to an empty graph, never a boot crash."""
+        import json
+
+        try:
+            with open(path) as f:
+                state = json.load(f)
+            return self.import_state(state)
+        except (OSError, ValueError, KeyError, TypeError, AttributeError):
+            return 0
+
+    def export_edges(self) -> List[dict]:
+        """Edge summaries for cross-replica sharing (no probe queues —
+        replicas need the averaged signal, not the raw samples)."""
+        with self._mu:
+            return [
+                {
+                    "src": src, "dst": dst,
+                    "average_rtt_ns": e.average_rtt_ns,
+                    "updated_at": e.updated_at,
+                }
+                for (src, dst), e in self._edges.items()
+                if e.average_rtt_ns is not None
+            ]
+
+    def merge_remote_edges(self, edges: List[dict]) -> int:
+        """Adopt another replica's edge summaries, newest-wins; local
+        probe queues and probed counts stay untouched (remote knowledge
+        must not skew THIS scheduler's probe-target selection).  Returns
+        the number of edges adopted."""
+        adopted = 0
+        with self._mu:
+            for rec in edges:
+                avg = rec.get("average_rtt_ns")
+                src, dst = rec.get("src"), rec.get("dst")
+                # Skip malformed records — one bad replica's push must not
+                # kill sharing for the whole cluster.
+                if avg is None or not src or not dst:
+                    continue
+                key = (src, dst)
+                updated = float(rec.get("updated_at", 0.0))
+                edge = self._edges.get(key)
+                if edge is None:
+                    edge = _Edge(self.config.probe_queue_length)
+                    self._edges[key] = edge
+                elif edge.updated_at >= updated:
+                    continue  # local knowledge is fresher
+                edge.average_rtt_ns = int(avg)
+                edge.updated_at = updated
+                adopted += 1
+        return adopted
+
     def to_edge_arrays(self) -> Tuple[List[str], np.ndarray, np.ndarray, np.ndarray]:
         """Columnar export for the GNN: (host_ids, src_idx, dst_idx, rtt_ns).
 
