@@ -21,9 +21,26 @@ def base_parser(prog: str, description: str) -> argparse.ArgumentParser:
              "ephemeral)",
     )
     p.add_argument(
+        "--trace-file", default=None, metavar="PATH",
+        help="append spans as JSON lines (the --jaeger export analog, "
+             "cmd/dependency/dependency.go:263-297); cross-process trace "
+             "ids from the traceparent wire header land here",
+    )
+    p.add_argument(
         "--version", action="version", version=f"%(prog)s {__version__}"
     )
     return p
+
+
+def init_tracing(args) -> None:
+    """Point the process-default tracer at a JSONL exporter when
+    --trace-file is given (every binary, like the reference's otel
+    wiring in cmd/dependency)."""
+    if not getattr(args, "trace_file", None):
+        return
+    from ..utils.tracing import JSONLExporter, default_tracer
+
+    default_tracer.exporter = JSONLExporter(args.trace_file)
 
 
 def init_debug(args) -> None:

@@ -23,7 +23,7 @@ from ..scheduler.resource import Host
 from ..source import PieceSourceFetcher
 from ..utils import idgen
 from ..utils.ping import make_host_pinger
-from .common import base_parser, init_debug, init_logging
+from .common import base_parser, init_debug, init_logging, init_tracing
 
 
 def build(cfg: DaemonConfig, scheduler_url: str):
@@ -109,6 +109,7 @@ def run(argv=None) -> int:
     args = p.parse_args(argv)
     init_logging(args, "dfdaemon")
     init_debug(args)
+    init_tracing(args)
 
     cfg = load_config(DaemonConfig, args.config)
     parts = build(cfg, args.scheduler)

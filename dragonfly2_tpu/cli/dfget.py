@@ -17,7 +17,65 @@ from ..scheduler import Evaluator, Resource, SchedulerService, Scheduling, Sched
 from ..scheduler.resource import Host
 from ..source import PieceSourceFetcher
 from ..utils import idgen
-from .common import base_parser, init_debug, init_logging
+from .common import base_parser, init_debug, init_logging, init_tracing
+
+
+def _resolve_recursive_root(url: str):
+    """file:// (or bare-path) recursive source → absolute dir, or an
+    error string."""
+    import urllib.parse
+
+    parsed = urllib.parse.urlsplit(url)
+    if parsed.scheme not in ("", "file"):
+        return None, "--recursive supports file:// sources only"
+    # abspath: a relative bare path must not become a URL netloc when
+    # "file://" + path is parsed back (urlsplit would eat the first
+    # component as the host).
+    src_root = os.path.abspath(
+        urllib.parse.unquote(parsed.path) if parsed.scheme == "file" else url
+    )
+    if not os.path.isdir(src_root):
+        return None, "--recursive needs a directory source"
+    return src_root, None
+
+
+def _iter_tree(src_root: str, output: str):
+    """Walk the source tree: creates destination dirs (empty ones too),
+    reports skipped symlinks/unreadables on stderr, yields
+    (file_url, rel, dst, size) for every downloadable file."""
+    import urllib.parse
+
+    for dirpath, dirs, files in os.walk(src_root):
+        # Preserve empty directories: the restored tree must be
+        # structurally identical to the source.
+        for d in list(dirs):
+            if os.path.islink(os.path.join(dirpath, d)):
+                # os.walk(followlinks=False) won't descend — an empty
+                # dir here would be a silently incomplete restore.
+                print(
+                    f"dfget: skipped symlinked dir "
+                    f"{os.path.relpath(os.path.join(dirpath, d), src_root)}",
+                    file=sys.stderr,
+                )
+                dirs.remove(d)
+                continue
+            os.makedirs(
+                os.path.join(output, os.path.relpath(os.path.join(dirpath, d), src_root)),
+                exist_ok=True,
+            )
+        for name in files:
+            src = os.path.join(dirpath, name)
+            rel = os.path.relpath(src, src_root)
+            dst = os.path.join(output, rel)
+            os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+            try:
+                size = os.path.getsize(src)
+            except OSError as exc:
+                # Dangling symlink etc: report and continue.
+                print(f"dfget: skipped {rel}: {exc}", file=sys.stderr)
+                continue
+            # Percent-encode: '#'/'?' in filenames must survive urlsplit.
+            yield "file://" + urllib.parse.quote(src), rel, dst, size
 
 
 def run(argv=None) -> int:
@@ -36,12 +94,9 @@ def run(argv=None) -> int:
     args = p.parse_args(argv)
     init_logging(args, "dfget")
     init_debug(args)
+    init_tracing(args)
 
     if args.daemon:
-        if args.recursive:
-            print("dfget: --daemon does not support --recursive yet",
-                  file=sys.stderr)
-            return 1
         # Reference path: dfget talks to a long-lived daemon, spawning it
         # when absent (cmd/dfget/cmd/root.go:234-260), so downloads share
         # one piece store + upload server across invocations.
@@ -68,6 +123,29 @@ def run(argv=None) -> int:
                     file=sys.stderr,
                 )
                 return 1
+        if args.recursive:
+            # Directory tree through the DAEMON control API (reference:
+            # rpcserver.go:407+ recursive downloads go through the
+            # long-lived daemon like single files do): every file shares
+            # the daemon's piece store and upload server.
+            src_root, err = _resolve_recursive_root(args.url)
+            if err:
+                print(f"dfget: {err}", file=sys.stderr)
+                return 1
+            count = 0
+            for url, rel, dst, _size in _iter_tree(src_root, args.output):
+                result = download_via_daemon(
+                    url, daemon_url, output=dst, piece_size=args.piece_size
+                )
+                if not result.get("ok"):
+                    print(f"dfget: failed {rel}: {result}", file=sys.stderr)
+                    return 1
+                count += 1
+            print(
+                f"dfget: downloaded {count} files through daemon "
+                f"-> {args.output}"
+            )
+            return 0
         result = download_via_daemon(
             args.url, daemon_url, output=args.output,
             piece_size=args.piece_size,
@@ -108,63 +186,21 @@ def run(argv=None) -> int:
     if args.recursive:
         # Directory tree (reference: recursive dir download,
         # rpcserver.go:407+): each file goes through the same P2P path.
-        import urllib.parse
-
-        parsed = urllib.parse.urlsplit(args.url)
-        if parsed.scheme not in ("", "file"):
-            print("dfget: --recursive supports file:// sources only", file=sys.stderr)
-            return 1
-        # abspath: a relative bare path must not become a URL netloc when
-        # "file://" + path is parsed back (urlsplit would eat the first
-        # component as the host).
-        src_root = os.path.abspath(
-            urllib.parse.unquote(parsed.path) if parsed.scheme == "file"
-            else args.url
-        )
-        if not os.path.isdir(src_root):
-            print("dfget: --recursive needs a directory source", file=sys.stderr)
+        src_root, err = _resolve_recursive_root(args.url)
+        if err:
+            print(f"dfget: {err}", file=sys.stderr)
             return 1
         count = 0
-        for dirpath, dirs, files in os.walk(src_root):
-            # Preserve empty directories: the restored tree must be
-            # structurally identical to the source.
-            for d in list(dirs):
-                if os.path.islink(os.path.join(dirpath, d)):
-                    # os.walk(followlinks=False) won't descend — an empty
-                    # dir here would be a silently incomplete restore.
-                    print(
-                        f"dfget: skipped symlinked dir "
-                        f"{os.path.relpath(os.path.join(dirpath, d), src_root)}",
-                        file=sys.stderr,
-                    )
-                    dirs.remove(d)
-                    continue
-                os.makedirs(
-                    os.path.join(args.output, os.path.relpath(os.path.join(dirpath, d), src_root)),
-                    exist_ok=True,
-                )
-            for name in files:
-                src = os.path.join(dirpath, name)
-                rel = os.path.relpath(src, src_root)
-                dst = os.path.join(args.output, rel)
-                os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
-                try:
-                    size = os.path.getsize(src)
-                except OSError as exc:
-                    # Dangling symlink etc: report and continue.
-                    print(f"dfget: skipped {rel}: {exc}", file=sys.stderr)
-                    continue
-                # Percent-encode: '#'/'?' in filenames must survive urlsplit.
-                url = "file://" + urllib.parse.quote(src)
-                result = daemon.download(
-                    url, piece_size=args.piece_size, content_length=size
-                )
-                if not result.ok:
-                    print(f"dfget: failed {rel}", file=sys.stderr)
-                    return 1
-                with open(dst, "wb") as out:
-                    out.write(daemon.read_task_bytes(result.task_id))
-                count += 1
+        for url, rel, dst, size in _iter_tree(src_root, args.output):
+            result = daemon.download(
+                url, piece_size=args.piece_size, content_length=size
+            )
+            if not result.ok:
+                print(f"dfget: failed {rel}", file=sys.stderr)
+                return 1
+            with open(dst, "wb") as out:
+                out.write(daemon.read_task_bytes(result.task_id))
+            count += 1
         print(f"dfget: downloaded {count} files -> {args.output}")
         return 0
 

@@ -15,7 +15,7 @@ from ..config import ManagerConfig, load_config
 from ..jobs import JobQueue
 from ..manager import ClusterManager, ModelRegistry, Searcher
 from ..manager.registry import BlobStore
-from .common import base_parser, init_debug, init_logging
+from .common import base_parser, init_debug, init_logging, init_tracing
 
 
 def build(cfg: ManagerConfig):
@@ -50,6 +50,7 @@ def run(argv=None) -> int:
     args = p.parse_args(argv)
     init_logging(args, "manager")
     init_debug(args)
+    init_tracing(args)
 
     cfg = load_config(ManagerConfig, args.config)
     parts = build(cfg)

@@ -24,7 +24,7 @@ from ..scheduler import (
     new_evaluator,
 )
 from ..utils import gc as dfgc
-from .common import base_parser, init_debug, init_logging
+from .common import base_parser, init_debug, init_logging, init_tracing
 
 
 def build(cfg: SchedulerConfigFile):
@@ -89,6 +89,7 @@ def run(argv=None) -> int:
     args = p.parse_args(argv)
     init_logging(args, "scheduler")
     init_debug(args)
+    init_tracing(args)
 
     cfg = load_config(SchedulerConfigFile, args.config)
     service, storage, runner = build(cfg)

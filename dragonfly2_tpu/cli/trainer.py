@@ -17,7 +17,7 @@ from ..config import TrainerConfigFile, load_config
 from ..manager.registry import ModelRegistry
 from ..trainer.service import TrainerService
 from ..trainer.train import TrainConfig
-from .common import base_parser, init_debug, init_logging
+from .common import base_parser, init_debug, init_logging, init_tracing
 
 
 def run(argv=None) -> int:
@@ -31,6 +31,7 @@ def run(argv=None) -> int:
     args = p.parse_args(argv)
     init_logging(args, "trainer")
     init_debug(args)
+    init_tracing(args)
 
     cfg = load_config(TrainerConfigFile, args.config)
     manager_addr = args.manager or cfg.manager_addr

@@ -407,6 +407,38 @@ class Conductor:
         priority: Priority,
         t0: float,
     ) -> DownloadResult:
+        # Download-scope span: every scheduler RPC made on this thread
+        # injects this context, so the server's handler spans link into
+        # ONE trace per download (otel task-span analog).
+        from ..utils.tracing import default_tracer
+
+        with default_tracer.span(
+            "daemon/download", task_id=run.task_id, url=url
+        ) as span:
+            result = self._download_registered(
+                run, url, piece_size=piece_size,
+                content_length=content_length,
+                expected_pieces=expected_pieces,
+                source_headers=source_headers, priority=priority, t0=t0,
+            )
+            span.set(
+                ok=result.ok, pieces=result.pieces,
+                back_to_source=result.back_to_source,
+            )
+            return result
+
+    def _download_registered(
+        self,
+        run: TaskRun,
+        url: str,
+        *,
+        piece_size: int,
+        content_length: Optional[int],
+        expected_pieces: Optional[int],
+        source_headers: Optional[dict],
+        priority: Priority,
+        t0: float,
+    ) -> DownloadResult:
         try:
             reg = self.scheduler.register_peer(
                 host=self.host, url=url, priority=priority,
@@ -632,19 +664,28 @@ class Conductor:
                 return True
             return False
 
+        # Worker threads have their OWN (empty) span stacks; hand them the
+        # download span's context so their piece reports stay in-trace.
+        from ..utils.tracing import TRACEPARENT_HEADER, default_tracer
+
+        download_tp = default_tracer.inject().get(TRACEPARENT_HEADER)
+
         def worker() -> None:
             # Any escape (storage write, shaper, report RPC raising) must
             # abort the POOL — a silently-dead worker would otherwise let
             # the siblings drain `pending` and report a "successful"
             # download with this worker's popped piece missing.
             try:
-                while not state.abort.is_set():
-                    with state.lock:
-                        if not pending:
+                with default_tracer.remote_span(
+                    "daemon/piece_worker", download_tp, task_id=task.id
+                ):
+                    while not state.abort.is_set():
+                        with state.lock:
+                            if not pending:
+                                return
+                            number = pending.popleft()
+                        if not fetch_one(number):
                             return
-                        number = pending.popleft()
-                    if not fetch_one(number):
-                        return
             except Exception:  # noqa: BLE001 — abort → source fallback
                 import logging
 

@@ -345,11 +345,22 @@ class SchedulerGRPCServer:
                     )
 
             try:
+                from ..utils.tracing import TRACEPARENT_HEADER, default_tracer
+
+                traceparent = None
+                for key, value in context.invocation_metadata():
+                    if key == TRACEPARENT_HEADER:
+                        traceparent = value
                 req = proto_to_dict(request)
                 if method == "sync_probes_finished":
                     req = _from_wire_probe_results(req)
                 try:
-                    out = self.adapter.dispatch(method, req)
+                    # otelgrpc server-interceptor analog: handler span
+                    # linked into the caller's trace.
+                    with default_tracer.remote_span(
+                        f"rpc/{method}", traceparent, transport="grpc"
+                    ):
+                        out = self.adapter.dispatch(method, req)
                 except KeyError as exc:
                     count("NOT_FOUND")
                     context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
@@ -410,8 +421,13 @@ class GRPCRemoteScheduler(RemoteScheduler):
         msg = dict_to_proto(req, req_cls)
 
         def once():
+            from ..utils.tracing import default_tracer
+
+            metadata = tuple(default_tracer.inject().items()) or None
             try:
-                return self._stubs[method](msg, timeout=self.timeout)
+                return self._stubs[method](
+                    msg, timeout=self.timeout, metadata=metadata
+                )
             except grpc.RpcError as exc:
                 code = exc.code()
                 if code in (

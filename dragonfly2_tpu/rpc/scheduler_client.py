@@ -47,11 +47,18 @@ class RemoteScheduler:
 
     def _call(self, method: str, req: dict) -> dict:
         def once() -> dict:
+            from ..utils.tracing import default_tracer
+
             body = json.dumps(req).encode()
+            # Trace propagation (otelgrpc client-interceptor analog): the
+            # caller's active span rides the wire so the server links its
+            # handler span into the SAME trace.
+            headers = {"Content-Type": "application/json"}
+            headers.update(default_tracer.inject())
             http_req = urllib.request.Request(
                 f"{self.base_url}/rpc/{method}",
                 data=body,
-                headers={"Content-Type": "application/json"},
+                headers=headers,
                 method="POST",
             )
             try:

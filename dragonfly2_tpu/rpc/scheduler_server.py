@@ -297,8 +297,21 @@ class SchedulerHTTPServer:
                 method = self.path[len("/rpc/") :]
                 length = int(self.headers.get("Content-Length", 0))
                 try:
+                    from ..utils.tracing import (
+                        TRACEPARENT_HEADER,
+                        default_tracer,
+                    )
+
                     req = json.loads(self.rfile.read(length) or b"{}")
-                    resp = adapter.dispatch(method, req)
+                    # Handler span linked to the caller's trace (otelgrpc
+                    # server-interceptor analog): the §3.1 call stack is
+                    # followable across processes by trace id.
+                    with default_tracer.remote_span(
+                        f"rpc/{method}",
+                        self.headers.get(TRACEPARENT_HEADER),
+                        transport="http",
+                    ):
+                        resp = adapter.dispatch(method, req)
                     body = json.dumps(resp).encode()
                     self.send_response(200)
                 except KeyError as exc:

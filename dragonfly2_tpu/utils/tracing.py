@@ -6,6 +6,13 @@ A minimal otel-shaped tracer: named spans with attributes, parent/child
 nesting via a context stack, exporters (in-memory for tests, JSONL for
 ops).  Services instrument the same seams the reference does: download
 task, piece fetch, schedule round, train run.
+
+Cross-process propagation uses the W3C ``traceparent`` header format
+(``00-<trace_id>-<span_id>-01``) the reference's otelgrpc interceptors
+speak (cmd/dependency/dependency.go:263-297): clients ``inject()`` the
+current context into request headers/metadata, servers open their
+handler span with ``remote_span()`` so one trace id follows a download
+through daemon → scheduler → trainer hops.
 """
 
 from __future__ import annotations
@@ -34,8 +41,30 @@ class Span:
     def duration_ms(self) -> float:
         return (self.end_ns - self.start_ns) / 1e6
 
+    @property
+    def traceparent(self) -> str:
+        """W3C trace-context header value for this span."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
     def set(self, **attrs: Any) -> None:
         self.attributes.update(attrs)
+
+
+TRACEPARENT_HEADER = "traceparent"
+
+
+def parse_traceparent(value: Optional[str]):
+    """→ (trace_id, span_id) or None for absent/malformed headers."""
+    if not value:
+        return None
+    parts = value.split("-")
+    if len(parts) < 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    try:
+        int(parts[1], 16), int(parts[2], 16)
+    except ValueError:
+        return None
+    return parts[1], parts[2]
 
 
 class Tracer:
@@ -50,14 +79,24 @@ class Tracer:
         return self._local.stack
 
     @contextlib.contextmanager
-    def span(self, name: str, **attributes: Any) -> Iterator[Span]:
+    def span(
+        self,
+        name: str,
+        *,
+        _trace_id: Optional[str] = None,
+        _parent_id: Optional[str] = None,
+        **attributes: Any,
+    ) -> Iterator[Span]:
+        """One span lifecycle.  ``_trace_id``/``_parent_id`` seed a REMOTE
+        parent context (remote_span uses them); normally the local stack
+        provides the parentage."""
         stack = self._stack()
         parent = stack[-1] if stack else None
         span = Span(
             name=name,
-            trace_id=parent.trace_id if parent else uuid.uuid4().hex,
+            trace_id=_trace_id or (parent.trace_id if parent else uuid.uuid4().hex),
             span_id=uuid.uuid4().hex[:16],
-            parent_id=parent.span_id if parent else None,
+            parent_id=_parent_id or (parent.span_id if parent else None),
             start_ns=time.time_ns(),
             attributes=dict(attributes),
         )
@@ -72,6 +111,30 @@ class Tracer:
             stack.pop()
             self.exporter.export(span)
 
+    # -- cross-process propagation (otelgrpc-interceptor analog) -------------
+
+    def inject(self) -> Dict[str, str]:
+        """Headers/metadata for an outgoing request: the current span's
+        context, or empty when no span is active (callers just merge)."""
+        stack = self._stack()
+        if not stack:
+            return {}
+        return {TRACEPARENT_HEADER: stack[-1].traceparent}
+
+    @contextlib.contextmanager
+    def remote_span(
+        self, name: str, traceparent: Optional[str], **attributes: Any
+    ) -> Iterator[Span]:
+        """Server-side handler span linked to the CALLER's context: same
+        trace id, parent = the caller's span id.  Falls back to a local
+        root span when the header is absent/malformed."""
+        parsed = parse_traceparent(traceparent)
+        trace_id, parent_span_id = parsed if parsed else (None, None)
+        with self.span(
+            name, _trace_id=trace_id, _parent_id=parent_span_id, **attributes
+        ) as span:
+            yield span
+
 
 class SpanExporter:
     def export(self, span: Span) -> None:  # pragma: no cover - interface
@@ -79,9 +142,15 @@ class SpanExporter:
 
 
 class InMemoryExporter(SpanExporter):
-    def __init__(self) -> None:
+    """Bounded ring of recent spans.  This is the process DEFAULT
+    exporter and every RPC handler/download/piece worker exports through
+    it — unbounded growth would leak a long-running daemon to OOM."""
+
+    def __init__(self, max_spans: int = 4096) -> None:
+        import collections
+
         self._mu = threading.Lock()
-        self.spans: List[Span] = []
+        self.spans = collections.deque(maxlen=max_spans)
 
     def export(self, span: Span) -> None:
         with self._mu:

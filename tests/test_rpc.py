@@ -563,3 +563,48 @@ class TestPieceMetadataSubscription:
         waited = time.monotonic() - t0
         assert bm is not None and sum(bm) == 1
         assert waited < 1.5, f"missed the mid-window commit: {waited:.2f}s"
+
+
+class TestTracePropagation:
+    """VERDICT r2 next-#9: trace-id propagation through the wire — the
+    §3.1 call stack is followable end-to-end by one trace id, like the
+    reference's otelgrpc handlers allow."""
+
+    def test_download_trace_links_across_http_wire(self, wire_swarm):
+        from dragonfly2_tpu.utils.tracing import InMemoryExporter, default_tracer
+
+        old = default_tracer.exporter
+        exp = InMemoryExporter()
+        default_tracer.exporter = exp
+        try:
+            nodes = wire_swarm["nodes"]
+            url = "https://origin/traced-blob"
+            r0 = nodes[0].conductor.download(
+                url, piece_size=PIECE, content_length=2 * PIECE
+            )
+            assert r0.ok
+            r1 = nodes[1].conductor.download(url, piece_size=PIECE)
+            assert r1.ok and not r1.back_to_source
+        finally:
+            default_tracer.exporter = old
+
+        downloads = exp.find("daemon/download")
+        assert len(downloads) == 2
+        handlers = exp.find("rpc/register_peer")
+        assert len(handlers) >= 2
+        for dl in downloads:
+            # The server-side handler spans share the DOWNLOAD's trace id
+            # and parent into the client's context — the id traveled in
+            # the traceparent header, not process memory.
+            linked = [h for h in handlers if h.trace_id == dl.trace_id]
+            assert linked, "no server span joined the download trace"
+            assert linked[0].parent_id == dl.span_id
+            assert linked[0].attributes.get("transport") == "http"
+        # Piece reports from WORKER THREADS stayed in-trace too (the
+        # p2p download's piece_finished handlers).
+        p2p_trace = downloads[1].trace_id
+        piece_handlers = [
+            h for h in exp.find("rpc/report_piece_finished")
+            if h.trace_id == p2p_trace
+        ]
+        assert len(piece_handlers) >= 2

@@ -237,3 +237,77 @@ class TestTypes:
 
     def test_size_scope_enum(self):
         assert SizeScope.TINY.value == 2
+
+
+class TestICMPPing:
+    def test_icmp_echo_loopback(self):
+        from dragonfly2_tpu.utils.ping import icmp_available, icmp_ping
+
+        if not icmp_available():
+            pytest.skip("no ICMP socket capability in this environment")
+        rtt = icmp_ping("127.0.0.1", timeout=2.0)
+        assert rtt is not None and 0 < rtt < 2_000_000_000
+
+    def test_icmp_timeout_returns_none(self):
+        from dragonfly2_tpu.utils.ping import icmp_available, icmp_ping
+
+        if not icmp_available():
+            pytest.skip("no ICMP socket capability in this environment")
+        import time
+
+        t0 = time.monotonic()
+        assert icmp_ping("10.255.255.1", timeout=0.2) is None
+        assert time.monotonic() - t0 < 2.0
+
+    def test_host_pinger_prefers_icmp_with_tcp_fallback(self):
+        import socket
+        import threading
+
+        from dragonfly2_tpu.utils.ping import icmp_available, make_host_pinger
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        accepted = threading.Thread(
+            target=lambda: [srv.accept() for _ in range(2)], daemon=True
+        )
+        accepted.start()
+
+        class H:
+            ip = "127.0.0.1"
+            port = srv.getsockname()[1]
+            download_port = srv.getsockname()[1]
+
+        icmp = make_host_pinger(prefer_icmp=True)
+        tcp_only = make_host_pinger(prefer_icmp=False)
+        assert tcp_only(H()) is not None
+        if icmp_available():
+            assert icmp(H()) is not None
+        srv.close()
+
+
+class TestTraceParent:
+    def test_inject_and_remote_span_link(self):
+        from dragonfly2_tpu.utils.tracing import (
+            InMemoryExporter,
+            Tracer,
+            parse_traceparent,
+        )
+
+        exp = InMemoryExporter()
+        tracer = Tracer(exporter=exp)
+        assert tracer.inject() == {}  # no active span
+        with tracer.span("client/op") as client_span:
+            header = tracer.inject()["traceparent"]
+            assert parse_traceparent(header) == (
+                client_span.trace_id, client_span.span_id
+            )
+        # "Server side": link a handler span from the wire header.
+        with tracer.remote_span("server/handler", header) as server_span:
+            assert server_span.trace_id == client_span.trace_id
+            assert server_span.parent_id == client_span.span_id
+        # Malformed headers degrade to a fresh root span, never raise.
+        with tracer.remote_span("server/handler", "garbage") as s:
+            assert s.parent_id is None
+        assert parse_traceparent(None) is None
+        assert parse_traceparent("00-zz-yy-01") is None
