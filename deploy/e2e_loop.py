@@ -1,0 +1,187 @@
+"""Cluster e2e: dfget + preheat + the train→activate→evaluator loop
+against a COMPOSED cluster (reference: test/e2e run inside kind,
+Makefile:358-366).
+
+Addresses come from the environment, so the same script drives both the
+docker-compose topology (service hostnames) and deploy/run_local.py's
+process topology (loopback).  Exit code 0 = every stage passed.
+
+Stages:
+  1. liveness — manager /healthy, scheduler registered with the manager;
+  2. back-to-source + P2P — daemon A pulls a blob from the origin,
+     daemon B gets the same blob WITHOUT new origin fetches;
+  3. preheat — a REST job fans to the scheduler's queue and the seed
+     daemon warms the layer from the origin;
+  4. learning loop — records stream to the trainer, the model lands in
+     the MANAGER, REST activation flips it live, and a scheduler-side
+     ML evaluator subscriber hot-swaps to the trained scorer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+MANAGER = os.environ.get("MANAGER_URL", "http://127.0.0.1:65003")
+SCHEDULER = os.environ.get("SCHEDULER_URL", "http://127.0.0.1:8002")
+DAEMON_A = os.environ.get("DAEMON_A_CONTROL", "http://127.0.0.1:65010")
+DAEMON_B = os.environ.get("DAEMON_B_CONTROL", "http://127.0.0.1:65011")
+ORIGIN_BIND = os.environ.get("ORIGIN_BIND", "127.0.0.1:8099")
+ORIGIN_URL = os.environ.get("ORIGIN_URL", "http://127.0.0.1:8099")
+PIECE = 64 * 1024
+BLOB = bytes(i % 251 for i in range(4 * PIECE))
+
+
+def log(msg: str) -> None:
+    print(f"e2e: {msg}", flush=True)
+
+
+def call(base, method, path, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        base + path, data=data,
+        headers={"Content-Type": "application/json"}, method=method,
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def wait_for(what, fn, timeout=120):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            out = fn()
+            if out:
+                return out
+            last = "falsy"
+        except Exception as exc:  # noqa: BLE001 — booting cluster
+            last = exc
+        time.sleep(1.0)
+    raise SystemExit(f"e2e: TIMEOUT waiting for {what}: {last}")
+
+
+class _Origin(BaseHTTPRequestHandler):
+    hits = []
+
+    def log_message(self, *args):
+        pass
+
+    def do_HEAD(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(BLOB)))
+        self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+
+    def do_GET(self):
+        type(self).hits.append(self.path)
+        rng = self.headers.get("Range")
+        body, code = BLOB, 200
+        if rng:
+            s, e = rng.split("=", 1)[1].split("-")
+            body = BLOB[int(s): (int(e) if e else len(BLOB) - 1) + 1]
+            code = 206
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def main() -> int:
+    host, port = ORIGIN_BIND.rsplit(":", 1)
+    origin = ThreadingHTTPServer((host, int(port)), _Origin)
+    threading.Thread(target=origin.serve_forever, daemon=True).start()
+
+    # -- 1. liveness --------------------------------------------------------
+    wait_for("manager", lambda: call(MANAGER, "GET", "/api/v1/healthy")["ok"])
+    scheds = wait_for(
+        "scheduler registration",
+        lambda: call(MANAGER, "GET", "/api/v1/schedulers"),
+    )
+    sched_id = scheds[0]["id"]
+    log(f"manager healthy; scheduler {sched_id} registered")
+    wait_for("daemon A", lambda: call(DAEMON_A, "GET", "/healthy")["ok"])
+    wait_for("daemon B", lambda: call(DAEMON_B, "GET", "/healthy")["ok"])
+
+    # -- 2. back-to-source then P2P -----------------------------------------
+    url = f"{ORIGIN_URL}/blob-1"
+    r = call(DAEMON_A, "POST", "/download",
+             {"url": url, "piece_size": PIECE}, timeout=120)
+    assert r.get("ok"), r
+    hits_after_seed = len(_Origin.hits)
+    assert hits_after_seed > 0, "daemon A never reached the origin"
+    log(f"daemon A seeded blob-1 ({r['pieces']} pieces, "
+        f"{'source' if r.get('back_to_source') else 'p2p'})")
+
+    r = call(DAEMON_B, "POST", "/download",
+             {"url": url, "piece_size": PIECE}, timeout=120)
+    assert r.get("ok"), r
+    assert not r.get("back_to_source"), "daemon B fell back to source"
+    assert len(_Origin.hits) == hits_after_seed, "P2P still hit the origin"
+    log("daemon B fetched blob-1 P2P, origin untouched")
+
+    # -- 3. preheat through the job plane -----------------------------------
+    group = call(MANAGER, "POST", "/api/v1/jobs", {
+        "type": "preheat",
+        "args": {"urls": [f"{ORIGIN_URL}/layer-0"], "piece_size": PIECE},
+        "queues": [f"scheduler:{sched_id}"],
+    })
+    state = wait_for(
+        "preheat job",
+        lambda: (lambda s: s if s["state"] in ("SUCCESS", "FAILURE") else None)(
+            call(MANAGER, "GET", f"/api/v1/jobs/{group['group_id']}")
+        ),
+    )
+    assert state["state"] == "SUCCESS", state
+    log("preheat fanned to the scheduler queue and the seed daemon served it")
+
+    # -- 4. the learning loop -----------------------------------------------
+    # Records → trainer ingest → model in the MANAGER → activate → a
+    # scheduler-side ML evaluator pulls the artifact (the evaluator seam).
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from dragonfly2_tpu.records.columnar import ColumnarWriter
+    from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
+    from dragonfly2_tpu.records.synthetic import SyntheticCluster
+    from dragonfly2_tpu.rpc import RemoteRegistry, RemoteTrainer
+    from dragonfly2_tpu.scheduler import MLEvaluator, ModelSubscriber
+
+    trainer_url = os.environ.get("TRAINER_URL", "http://trainer:9090")
+    shard = "/tmp/e2e_download.dfc"
+    cluster = SyntheticCluster(num_hosts=64, seed=3)
+    with ColumnarWriter(shard, DOWNLOAD_COLUMNS) as w:
+        w.append(cluster.generate_feature_rows(2000, seed=7))
+    trainer = RemoteTrainer(trainer_url, timeout=600)
+    session = trainer.open_train_stream(
+        ip="0.0.0.0", hostname="e2e", scheduler_id=sched_id
+    )
+    session.send_download_shard(shard)
+    key = session.close_and_train()
+    run = trainer.runs[key]
+    assert run.error is None, run.error
+    log(f"trainer run {key} finished")
+
+    registry = RemoteRegistry(MANAGER)
+    models = wait_for(
+        "model in manager",
+        lambda: registry.list(scheduler_id=sched_id, name="parent-bandwidth-mlp"),
+    )
+    registry.activate(models[0].id)
+    active = registry.active_model(sched_id, "parent-bandwidth-mlp")
+    assert active is not None and active.id == models[0].id
+    evaluator = MLEvaluator()
+    sub = ModelSubscriber(registry, evaluator, scheduler_id=sched_id)
+    assert sub.refresh() is True and evaluator.has_model
+    log(f"model v{active.version} activated; ML evaluator hot-swapped")
+
+    log("ALL STAGES PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

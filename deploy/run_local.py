@@ -1,0 +1,188 @@
+"""Bring up the full cluster as OS processes and run the e2e loop —
+the docker-compose topology without containers (CI / dev machines
+without a docker daemon; the container path is deploy/docker-compose.yaml
+with the SAME services and the SAME deploy/e2e_loop.py).
+
+  python deploy/run_local.py          # exit 0 = cluster up + loop passed
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import select
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PIECE = 64 * 1024
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="df-local-")
+    # Hermetic JAX: the harness only needs CPU (the trainer's TPU path is
+    # exercised by bench.py / the driver); inheriting an ambient
+    # accelerator-plugin env without its plugin path would crash training.
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+    procs = []
+
+    def write(name: str, text: str) -> str:
+        path = os.path.join(tmp, name)
+        with open(path, "w") as f:
+            f.write(text)
+        return path
+
+    def spawn(name, argv, ready_prefixes, extra_env=None):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", *argv],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env={**env, **(extra_env or {})},
+        )
+        procs.append(proc)
+        # A reader THREAD owns the pipe: mixing select() on the fd with
+        # buffered readline() can strand a ready line in the Python-side
+        # buffer (stderr is merged, so log lines coalesce with it in one
+        # OS read) and falsely declare the service dead.
+        import queue
+
+        # Bounded: after readiness nobody consumes — the pump drops the
+        # oldest instead of retaining every log line for the cluster's
+        # lifetime, and keeps reading so the child never blocks on a
+        # full pipe.
+        lines: "queue.Queue" = queue.Queue(maxsize=1000)
+
+        def pump() -> None:
+            for raw in proc.stdout:
+                while True:
+                    try:
+                        lines.put_nowait(raw)
+                        break
+                    except queue.Full:
+                        try:
+                            lines.get_nowait()
+                        except queue.Empty:
+                            pass
+
+        threading.Thread(target=pump, name=f"pump-{name}", daemon=True).start()
+        found = {}
+        deadline = time.time() + 60
+        while time.time() < deadline and len(found) < len(ready_prefixes):
+            try:
+                line = lines.get(timeout=max(deadline - time.time(), 0.1)).strip()
+            except queue.Empty:
+                break
+            for p in ready_prefixes:
+                if line.startswith(p):
+                    found[p] = line
+        if len(found) != len(ready_prefixes):
+            raise SystemExit(f"run_local: {name} never became ready ({found})")
+        print(f"run_local: {name} up", flush=True)
+        return found
+
+    try:
+        mcfg = write("manager.yaml", (
+            "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
+            f"registry: {{blob_dir: {tmp}/manager}}\n"
+        ))
+        mout = spawn("manager", ["dragonfly2_tpu.cli.manager", "--config", mcfg],
+                     ["manager: serving"])
+        manager_url = re.search(r"REST on (\S+)", mout["manager: serving"]).group(1)
+
+        tcfg = write("trainer.yaml", (
+            "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
+            f"data_dir: {tmp}/trainer\n"
+            "training: {epochs: 6, learning_rate: 0.003, warmup_steps: 10}\n"
+        ))
+        tout = spawn("trainer",
+                     ["dragonfly2_tpu.cli.trainer", "--config", tcfg,
+                      "--manager", manager_url],
+                     ["trainer: ingest"])
+        trainer_url = re.search(r"ingest on (\S+?)[, ]",
+                                tout["trainer: ingest"] + " ").group(1)
+
+        scfg = write("scheduler.yaml", (
+            "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
+            "scheduling: {retry_interval_s: 0.1}\n"
+            f"storage: {{dir: {tmp}/records, buffer_size: 1}}\n"
+            f"manager_addr: {manager_url}\n"
+            "dynconfig_refresh_s: 5.0\n"
+            "topology_sync_interval_s: 10.0\n"
+        ))
+        sout = spawn("scheduler",
+                     ["dragonfly2_tpu.cli.scheduler", "--config", scfg],
+                     ["scheduler: serving"])
+        scheduler_url = re.search(r"rpc on (\S+?),",
+                                  sout["scheduler: serving"] + ",").group(1)
+
+        seedcfg = write("seed.yaml", (
+            "server: {host: 127.0.0.1, port: 0, advertise_ip: 127.0.0.1}\n"
+            f"storage: {{dir: {tmp}/seed}}\n"
+            f"piece_size: {PIECE}\n"
+        ))
+        spawn("seed",
+              ["dragonfly2_tpu.cli.dfdaemon", "--scheduler", scheduler_url,
+               "--config", seedcfg, "--seed-peer"],
+              ["dfdaemon: serving"],
+              {"DF_DAEMON_STATE": f"{tmp}/seed.json"})
+
+        controls = {}
+        for name, port in (("daemon-a", 0), ("daemon-b", 0)):
+            dcfg = write(f"{name}.yaml", (
+                "server: {host: 127.0.0.1, port: 0, advertise_ip: 127.0.0.1}\n"
+                f"storage: {{dir: {tmp}/{name}}}\n"
+                f"piece_size: {PIECE}\n"
+            ))
+            dout = spawn(name,
+                         ["dragonfly2_tpu.cli.dfdaemon", "--scheduler",
+                          scheduler_url, "--config", dcfg],
+                         ["dfdaemon: serving"],
+                         {"DF_DAEMON_STATE": f"{tmp}/{name}.json"})
+            controls[name] = re.search(
+                r"control (\S+?)[, ]", dout["dfdaemon: serving"] + " "
+            ).group(1)
+
+        print("run_local: cluster up, running e2e loop", flush=True)
+        # Ephemeral origin port: concurrent runs on one machine (CI + a
+        # dev shell) must not collide on a fixed port.
+        import socket as _socket
+
+        probe = _socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        origin_port = probe.getsockname()[1]
+        probe.close()
+        e2e_env = {
+            **env,
+            "MANAGER_URL": manager_url,
+            "SCHEDULER_URL": scheduler_url,
+            "TRAINER_URL": trainer_url,
+            "DAEMON_A_CONTROL": controls["daemon-a"],
+            "DAEMON_B_CONTROL": controls["daemon-b"],
+            "ORIGIN_BIND": f"127.0.0.1:{origin_port}",
+            "ORIGIN_URL": f"http://127.0.0.1:{origin_port}",
+        }
+        rc = subprocess.call(
+            [sys.executable, os.path.join(REPO, "deploy", "e2e_loop.py")],
+            env=e2e_env,
+        )
+        print(f"run_local: e2e exit {rc}", flush=True)
+        return rc
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.time() + 5
+        for p in procs:
+            try:
+                p.wait(timeout=max(deadline - time.time(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,12 +169,17 @@ def run(argv=None) -> int:
         parts["host"].type = HostType.SUPER_SEED
         seeder = Seeder(parts["conductor"], parts["storage"])
 
-    # Control API (daemon Download RPC analog): ALWAYS loopback-only —
+    # Control API (daemon Download RPC analog): loopback by DEFAULT —
     # /download writes local files on behalf of same-machine dfget.
+    # `control_host` may widen the bind for trusted pod/compose networks
+    # (deploy/config/daemon.yaml does), which trades that isolation for
+    # in-network drivability — never expose it on a routable interface
+    # outside such a boundary.
     from ..rpc.daemon_control import DaemonControlServer, write_state
 
     control = DaemonControlServer(
         parts["conductor"], parts["storage"], piece_size=cfg.piece_size,
+        host=cfg.control_host, port=cfg.control_port,
     )
     control.serve()
     if args.seed_peer:

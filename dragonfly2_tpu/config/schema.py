@@ -229,6 +229,12 @@ class DaemonConfig:
     server: ServerConfig = field(default_factory=lambda: ServerConfig(port=65000))
     storage: DaemonStorageSection = field(default_factory=DaemonStorageSection)
     proxy: ProxySection = field(default_factory=ProxySection)
+    # Control-API bind (dfget --daemon wire, /download, /obtain_seeds).
+    # Loopback by default — /download writes local files; bind a routable
+    # host only inside trusted pods/compose networks (the container e2e
+    # drives daemons through it).
+    control_host: str = "127.0.0.1"
+    control_port: int = 0
     scheduler_addr: str = ""
     piece_size: int = 4 << 20
     concurrent_upload_limit: int = 50

@@ -58,7 +58,10 @@ def read_state(path: Optional[str] = None) -> Optional[dict]:
 
 
 class DaemonControlServer:
-    """Loopback-only control surface over the daemon composition."""
+    """Control surface over the daemon composition: loopback by default
+    (/download writes local files); configs may bind a trusted pod/compose
+    network instead (DaemonConfig.control_host) — the caller owns that
+    trust boundary."""
 
     def __init__(
         self,
@@ -77,7 +80,8 @@ class DaemonControlServer:
 
         ``public=True`` exposes ONLY /healthy and /obtain_seeds: the full
         control surface (/download writes arbitrary local files) is a
-        same-machine contract and must never bind a routable interface —
+        same-machine contract unless the deployment's own trust boundary
+        (pod/compose network, DaemonConfig.control_host) widens it —
         seed daemons run one loopback control server AND one public
         seed-endpoint server.
         """

@@ -1,0 +1,75 @@
+"""Deploy artifacts (VERDICT r2 next-#7): compose topology sanity and the
+one-command process-cluster e2e (deploy/run_local.py runs the SAME
+e2e_loop.py the compose `e2e` service runs in containers)."""
+
+import os
+import subprocess
+import sys
+
+import yaml
+
+DEPLOY = os.path.join(os.path.dirname(os.path.dirname(__file__)), "deploy")
+
+
+class TestComposeArtifacts:
+    def test_compose_parses_and_covers_all_services(self):
+        with open(os.path.join(DEPLOY, "docker-compose.yaml")) as f:
+            compose = yaml.safe_load(f)
+        services = compose["services"]
+        assert set(services) == {
+            "manager", "scheduler", "trainer", "seed",
+            "daemon-a", "daemon-b", "e2e",
+        }
+        # Every service runs the shared multi-entry image and a real CLI.
+        for name, svc in services.items():
+            if name == "e2e":
+                continue
+            module = svc["command"][0]
+            assert module.startswith("dragonfly2_tpu.cli."), (name, module)
+            __import__(module)  # the entrypoint must actually exist
+
+    def test_service_configs_load_with_real_schemas(self):
+        from dragonfly2_tpu.config import (
+            DaemonConfig,
+            ManagerConfig,
+            SchedulerConfigFile,
+            TrainerConfigFile,
+            load_config,
+        )
+
+        cfgdir = os.path.join(DEPLOY, "config")
+        mapping = {
+            "manager.yaml": ManagerConfig,
+            "scheduler.yaml": SchedulerConfigFile,
+            "trainer.yaml": TrainerConfigFile,
+            "seed.yaml": DaemonConfig,
+            "daemon.yaml": DaemonConfig,
+        }
+        for name, schema in mapping.items():
+            cfg = load_config(schema, os.path.join(cfgdir, name))
+            cfg.validate()
+        sched = load_config(
+            SchedulerConfigFile, os.path.join(cfgdir, "scheduler.yaml")
+        )
+        assert sched.manager_addr == "http://manager:65003"
+        assert sched.trainer.enable and "trainer" in sched.trainer.addr
+
+    def test_dockerfile_builds_native_and_sets_entrypoint(self):
+        with open(os.path.join(DEPLOY, "docker", "Dockerfile")) as f:
+            content = f.read()
+        assert "make -C dragonfly2_tpu/native" in content
+        assert 'ENTRYPOINT ["python", "-m"]' in content
+
+
+class TestClusterE2E:
+    def test_run_local_cluster_loop(self):
+        """One command: the full cluster comes up (manager + scheduler +
+        trainer + seed + 2 daemons, real processes, real wires) and the
+        composed e2e loop passes end to end."""
+        r = subprocess.run(
+            [sys.executable, os.path.join(DEPLOY, "run_local.py")],
+            capture_output=True, text=True, timeout=420,
+            env={**os.environ, "PYTHONPATH": os.getcwd()},
+        )
+        assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+        assert "ALL STAGES PASSED" in r.stdout
