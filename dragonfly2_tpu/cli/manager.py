@@ -32,6 +32,12 @@ def build(cfg: ManagerConfig):
 
     crud = CrudStore(os.path.join(cfg.registry.blob_dir, "crud.db"))
     crud.ensure_default_cluster()
+    objectstorage = None
+    if cfg.objectstorage:
+        from ..objectstorage import make_backend
+
+        kwargs = dict(cfg.objectstorage)
+        objectstorage = make_backend(kwargs.pop("kind", "fs"), **kwargs)
     # NOTE: no DynconfigServer here — the dynconfig payload schedulers
     # poll is served straight from the CrudStore's cluster rows
     # (/api/v1/clusters/<id>:config), one source of truth.
@@ -41,6 +47,7 @@ def build(cfg: ManagerConfig):
         "searcher": Searcher(),
         "jobs": JobQueue(),
         "crud": crud,
+        "objectstorage": objectstorage,
     }
 
 
@@ -92,7 +99,8 @@ def run(argv=None) -> int:
     rest = ManagerRESTServer(
         parts["registry"], parts["clusters"], parts["searcher"],
         host=cfg.server.host, port=cfg.server.port,
-        jobqueue=parts["jobs"], crud=parts["crud"], **auth,
+        jobqueue=parts["jobs"], crud=parts["crud"],
+        objectstorage=parts["objectstorage"], **auth,
     )
     rest.serve()
     grpc_server = None

@@ -193,6 +193,10 @@ class ManagerConfig:
     # OAuth2 providers (manager/models/oauth.go rows):
     # [{name, client_id, client_secret, auth_url, token_url, profile_url}]
     oauth_providers: list = field(default_factory=list)
+    # Object-storage backend the bucket routes manage (handlers/bucket.go
+    # proxies to the configured backend): {"kind": "fs"|"s3"|"oss", ...}
+    # — empty disables the bucket surface.
+    objectstorage: dict = field(default_factory=dict)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     log: LogConfig = field(default_factory=LogConfig)
 

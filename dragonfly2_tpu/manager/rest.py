@@ -25,6 +25,9 @@ rows in manager/crud.py CrudStore, sqlite write-through):
   POST   /api/v1/clusters/<id>:delete                           (OPERATOR)
   GET    /api/v1/clusters/<id>:config            the dynconfig payload a
          scheduler polls (scheduling.go:404-410 limit consumption)
+  GET    /api/v1/buckets                         list (needs a configured
+  POST   /api/v1/buckets                          object-storage backend —
+  POST   /api/v1/buckets/<name>:delete            handlers/bucket.go proxy)
 
 User/RBAC surface (manager/handlers/user.go + personal access tokens):
 
@@ -112,6 +115,7 @@ class ManagerRESTServer:
         oauth=None,
         jobqueue=None,
         crud: Optional[CrudStore] = None,
+        objectstorage=None,
     ):
         self.registry = registry
         self.clusters = clusters
@@ -122,6 +126,9 @@ class ManagerRESTServer:
         # cluster always exists — dynconfig consumers need one to poll.
         self.crud = crud or CrudStore()
         self.crud.ensure_default_cluster()
+        # Optional ObjectStorageBackend the bucket routes proxy to
+        # (manager/handlers/bucket.go semantics); None → 404s.
+        self.objectstorage = objectstorage
         # Shared topology cache (the Redis analog for the probe graph,
         # network_topology.go:55-88): scheduler_id → its pushed edge
         # summaries.  Replicas pull everyone else's edges; a scheduler
@@ -292,6 +299,18 @@ class ManagerRESTServer:
                             for e in entry["edges"]
                         ]
                     self._json(200, {"edges": edges})
+                elif path == "/api/v1/buckets":
+                    # handlers/bucket.go GetBuckets: list through the
+                    # configured object-storage backend.
+                    if server.objectstorage is None:
+                        self._json(404, {"error": "object storage not configured"})
+                        return
+                    try:
+                        names = server.objectstorage.list_buckets()
+                    except Exception as exc:  # noqa: BLE001 — backend boundary
+                        self._json(502, {"error": str(exc)})
+                        return
+                    self._json(200, [{"name": n} for n in names])
                 elif path == "/api/v1/applications":
                     from dataclasses import asdict
 
@@ -389,8 +408,10 @@ class ManagerRESTServer:
                     required = Role.PEER
                 elif path == "/api/v1/topology":
                     required = Role.PEER  # scheduler service flow
-                elif path.startswith("/api/v1/applications") or path.startswith(
-                    "/api/v1/clusters"
+                elif (
+                    path.startswith("/api/v1/applications")
+                    or path.startswith("/api/v1/clusters")
+                    or path.startswith("/api/v1/buckets")
                 ):
                     # CRUD mutations are operator console actions.
                     required = Role.OPERATOR
@@ -431,6 +452,32 @@ class ManagerRESTServer:
                         self._json(200, {"ok": True, "edges": len(edges)})
                     except (KeyError, ValueError, TypeError) as exc:
                         self._json(400, {"error": str(exc)})
+                    return
+                if path.startswith("/api/v1/buckets"):
+                    # handlers/bucket.go CreateBucket / DestroyBucket —
+                    # proxied to the configured backend.
+                    if server.objectstorage is None:
+                        self._json(404, {"error": "object storage not configured"})
+                        return
+                    try:
+                        if path == "/api/v1/buckets":
+                            name = self._body()["name"]
+                            if not name or not isinstance(name, str):
+                                raise ValueError("bucket name required")
+                            server.objectstorage.create_bucket(name)
+                            self._json(200, {"name": name})
+                        elif path.endswith(":delete"):
+                            name = path[len("/api/v1/buckets/"):-len(":delete")]
+                            if not name:
+                                raise ValueError("bucket name required")
+                            server.objectstorage.delete_bucket(name)
+                            self._json(200, {"ok": True})
+                        else:
+                            self._json(404, {"error": "not found"})
+                    except (KeyError, ValueError, TypeError) as exc:
+                        self._json(400, {"error": str(exc)})
+                    except Exception as exc:  # noqa: BLE001 — backend boundary
+                        self._json(502, {"error": str(exc)})
                     return
                 if path == "/api/v1/schedulers":
                     # Scheduler instance registration over REST — the wire

@@ -26,6 +26,8 @@ class ObjectMetadata:
 class ObjectStorageBackend(Protocol):
     def create_bucket(self, bucket: str) -> None: ...
     def bucket_exists(self, bucket: str) -> bool: ...
+    def list_buckets(self) -> List[str]: ...
+    def delete_bucket(self, bucket: str) -> None: ...
     def put_object(self, bucket: str, key: str, data: bytes) -> ObjectMetadata: ...
     def get_object(self, bucket: str, key: str) -> bytes: ...
     def head_object(self, bucket: str, key: str) -> ObjectMetadata: ...
@@ -44,7 +46,10 @@ class FilesystemBackend:
         self._mu = threading.Lock()
 
     def _bucket_dir(self, bucket: str) -> str:
-        if "/" in bucket or bucket in (".", ".."):
+        # Empty names are rejected HERE, not just at the REST boundary:
+        # os.path.join(root, "") is the root itself, so delete_bucket("")
+        # would rmtree the whole store.
+        if not bucket or "/" in bucket or bucket in (".", ".."):
             raise ValueError(f"invalid bucket {bucket!r}")
         return os.path.join(self.root, bucket)
 
@@ -62,6 +67,27 @@ class FilesystemBackend:
 
     def bucket_exists(self, bucket: str) -> bool:
         return os.path.isdir(self._bucket_dir(bucket))
+
+    def list_buckets(self) -> List[str]:
+        try:
+            return sorted(
+                d for d in os.listdir(self.root)
+                if os.path.isdir(os.path.join(self.root, d))
+            )
+        except FileNotFoundError:
+            return []
+
+    def delete_bucket(self, bucket: str) -> None:
+        """Destroy the bucket (handlers/bucket.go DestroyBucket — the
+        reference deletes regardless of contents).  Only a MISSING bucket
+        is ignored (idempotency); a failed deletion must surface, not
+        return success while the bucket still lists."""
+        import shutil
+
+        try:
+            shutil.rmtree(self._bucket_dir(bucket))
+        except FileNotFoundError:
+            pass
 
     def put_object(self, bucket: str, key: str, data: bytes) -> ObjectMetadata:
         if not self.bucket_exists(bucket):

@@ -59,6 +59,16 @@ def _parse_list_xml(body: bytes) -> List[ObjectMetadata]:
     return out
 
 
+def _parse_bucket_names(body: bytes) -> List[str]:
+    """ListAllMyBucketsResult → sorted bucket names (S3 and OSS share
+    the Buckets/Bucket/Name shape)."""
+    root = ET.fromstring(body)
+    ns = root.tag[: root.tag.index("}") + 1] if root.tag.startswith("{") else ""
+    return sorted(
+        b.findtext(f"{ns}Name", "") for b in root.iter(f"{ns}Bucket")
+    )
+
+
 class _HTTPBackendBase:
     """Shared request plumbing: sign → send → translate errors."""
 
@@ -135,6 +145,27 @@ class _HTTPBackendBase:
             if exc.code in (404, 403):
                 return False
             raise
+
+    def list_buckets(self) -> List[str]:
+        """Service-level list (GET /): ListAllMyBucketsResult names —
+        ONE request path and ONE parser for both protocols (the signers
+        get bucket="" and canonicalize the bare "/")."""
+        try:
+            with self._request("GET", "") as resp:
+                return _parse_bucket_names(resp.read())
+        except urllib.error.HTTPError as exc:
+            raise ObjectStorageError(f"list_buckets: HTTP {exc.code}") from exc
+
+    def delete_bucket(self, bucket: str) -> None:
+        """DestroyBucket (handlers/bucket.go); deleting a ghost is
+        idempotent."""
+        try:
+            self._request("DELETE", bucket).close()
+        except urllib.error.HTTPError as exc:
+            if exc.code != 404:
+                raise ObjectStorageError(
+                    f"delete_bucket: HTTP {exc.code}"
+                ) from exc
 
     def put_object(self, bucket: str, key: str, data: bytes) -> ObjectMetadata:
         try:
@@ -281,9 +312,12 @@ class OSSBackend(_HTTPBackendBase):
             bucket=bucket, key=key.strip("/"),
             content_type=signed.get("Content-Type", ""),
             oss_headers=signed,
+            # Service-level requests (list buckets) sign the bare "/".
+            resource=None if bucket else "/",
         )
         signed["Authorization"] = f"OSS {self.access_key}:{sig}"
         return signed
+
 
 
 def make_backend(kind: str, **kwargs):

@@ -31,7 +31,11 @@ def sign_oss(
     content_md5: str = "",
     content_type: str = "",
     oss_headers: Optional[dict] = None,
+    resource: Optional[str] = None,
 ) -> str:
+    """``resource`` overrides the default ``/{bucket}/{key}`` canonical
+    resource — service-level requests (list buckets) sign the bare "/"
+    that the bucket/key form cannot express."""
     canon_headers = ""
     if oss_headers:
         lower = {
@@ -39,9 +43,11 @@ def sign_oss(
             if k.lower().startswith("x-oss-")
         }
         canon_headers = "".join(f"{k}:{lower[k]}\n" for k in sorted(lower))
+    if resource is None:
+        resource = f"/{bucket}/{key}"
     to_sign = (
         f"{method}\n{content_md5}\n{content_type}\n{date}\n"
-        f"{canon_headers}/{bucket}/{key}"
+        f"{canon_headers}{resource}"
     )
     mac = hmac.new(secret.encode(), to_sign.encode(), hashlib.sha1)
     return base64.b64encode(mac.digest()).decode()

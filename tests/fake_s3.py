@@ -115,6 +115,19 @@ class FakeS3:
                     self._reply(403)
                     return
                 bucket, key, query = self._route()
+                if not bucket:  # service-level: list all buckets
+                    with fake.lock:
+                        rows = "".join(
+                            f"<Bucket><Name>{b}</Name></Bucket>"
+                            for b in sorted(fake.buckets)
+                        )
+                    body = (
+                        "<?xml version=\"1.0\"?><ListAllMyBucketsResult>"
+                        f"<Buckets>{rows}</Buckets>"
+                        "</ListAllMyBucketsResult>"
+                    ).encode()
+                    self._reply(200, body, {"Content-Type": "application/xml"})
+                    return
                 with fake.lock:
                     objs = fake.buckets.get(bucket)
                     if objs is None:
@@ -177,6 +190,12 @@ class FakeS3:
                     return
                 bucket, key, _ = self._route()
                 with fake.lock:
+                    if not key:  # bucket delete
+                        if fake.buckets.pop(bucket, None) is None:
+                            self._reply(404)
+                        else:
+                            self._reply(204)
+                        return
                     objs = fake.buckets.get(bucket, {})
                     if key in objs:
                         del objs[key]
