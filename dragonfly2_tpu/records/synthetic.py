@@ -138,7 +138,18 @@ class SyntheticCluster:
     def bandwidth(self, parent: int, child: int, noise: bool = True) -> float:
         return float(self._bandwidth_vec(np.array([parent]), np.array([child]), noise)[0])
 
-    def _bandwidth_vec(self, parent: np.ndarray, child: np.ndarray, noise: bool = True) -> np.ndarray:
+    def _bandwidth_vec(
+        self,
+        parent: np.ndarray,
+        child: np.ndarray,
+        noise: bool = True,
+        rng: Optional[np.random.Generator] = None,
+    ) -> np.ndarray:
+        """``rng`` overrides the cluster's SHARED generator for the
+        measurement noise — position-deterministic streams (the 1B soak's
+        resumable ingest) must not depend on how many draws happened
+        before; the noise model itself (σ=0.12 lognormal, 1 KB/s floor
+        AFTER noise) lives only here."""
         up = self.up_cap[parent] / (1.0 + 0.15 * self.concurrent_uploads[parent])
         eff = np.minimum(up, self.down_cap[child])
         same_idc = self.idc[parent] == self.idc[child]
@@ -147,7 +158,7 @@ class SyntheticCluster:
         cpu_factor = 1.0 - 0.5 * self.cpu_load[parent] ** 2
         bw = eff * factor * cpu_factor
         if noise:
-            bw = bw * np.exp(self.rng.normal(0.0, 0.12, bw.shape))
+            bw = bw * np.exp((rng or self.rng).normal(0.0, 0.12, bw.shape))
         return np.maximum(bw, 1e3)
 
     def rtt_ns(self, src: int, dst: int, noise: bool = True) -> float:

@@ -43,6 +43,15 @@ BATCH = 131_072
 SUPER = 64  # steps per dispatch: 8.39M records ride each relay transfer
 
 
+def _state_hash(jax, np, state) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(
+        {"params": state.params, "opt": state.opt_state}
+    ):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--records", type=float, default=1e9)
@@ -52,9 +61,17 @@ def main() -> int:
     ap.add_argument("--kill-after-dispatch", type=int, default=None)
     ap.add_argument("--resume", action="store_true")
     ap.add_argument("--hash-out", default=None)
+    ap.add_argument("--hash-restored", default=None,
+                    help="with --resume: hash the state right after "
+                         "restore and exit (roundtrip diagnostics)")
+    ap.add_argument("--host-roundtrip-at", type=int, default=None,
+                    help="diagnostics: after dispatch N, pull the state "
+                         "to host numpy and push it back (no checkpoint)")
     ap.add_argument("--nodes", type=int, default=100_000)
     ap.add_argument("--hidden", type=int, default=1024)
     args = ap.parse_args()
+    if args.hash_restored and not args.resume:
+        ap.error("--hash-restored requires --resume")
 
     t_wall0 = time.time()
     import jax
@@ -110,13 +127,22 @@ def main() -> int:
     )
 
     # -- deterministic stream (ingest) ---------------------------------------
+    def edge_targets(rng, es, ed):
+        """Bandwidth targets with the measurement noise drawn from the
+        CALLER's rng — the cluster's shared stateful generator would make
+        the stream depend on how many draws happened before (a resumed
+        process would regenerate a DIFFERENT continuation and break
+        byte-identity).  The noise model stays in ONE place
+        (synthetic.py _bandwidth_vec)."""
+        return np.log1p(cluster._bandwidth_vec(es, ed, rng=rng)).astype(np.float32)
+
     def make_superbatch(d: int):
         """Download records for dispatch d — seeded by the STREAM position
         so a resumed run regenerates the identical continuation."""
         rng = np.random.default_rng(10_000 + d)
         es = rng.integers(0, args.nodes, SUPER * BATCH).astype(np.int32)
         ed = (es + rng.integers(1, args.nodes, SUPER * BATCH).astype(np.int32)) % args.nodes
-        y = np.log1p(cluster._bandwidth_vec(es, ed)).astype(np.float32)
+        y = edge_targets(rng, es, ed)
         return (
             es.reshape(SUPER, BATCH), ed.reshape(SUPER, BATCH),
             y.reshape(SUPER, BATCH),
@@ -126,7 +152,7 @@ def main() -> int:
     vrng = np.random.default_rng(999_999)
     v_es = vrng.integers(0, args.nodes, 2 * BATCH).astype(np.int32)
     v_ed = (v_es + vrng.integers(1, args.nodes, 2 * BATCH).astype(np.int32)) % args.nodes
-    v_y = np.log1p(cluster._bandwidth_vec(v_es, v_ed)).astype(np.float32)
+    v_y = edge_targets(vrng, v_es, v_ed)
     v_es, v_ed, v_y = (jnp.asarray(a) for a in (v_es, v_ed, v_y))
 
     @jax.jit
@@ -172,13 +198,24 @@ def main() -> int:
             "step": 0, "dispatch": 0, "dropout_rng": state.dropout_rng,
         }
         restored = ckptr.restore(ckpt_path, abstract)
+        # step must restore as a STRONG int32 device scalar — the mid-run
+        # state carries one, and a weak-typed Python int would compile a
+        # DIFFERENT XLA program whose float-reduction order diverges from
+        # the uninterrupted run (measured: byte-identity holds only with
+        # matching avals).
         state = state.replace(
             params=restored["params"], opt_state=restored["opt_state"],
-            step=restored["step"], dropout_rng=restored["dropout_rng"],
+            step=jnp.asarray(restored["step"], jnp.int32),
+            dropout_rng=jnp.asarray(restored["dropout_rng"], jnp.uint32),
         )
         start_dispatch = int(restored["dispatch"])
         print(f"soak: resumed at dispatch {start_dispatch} "
               f"(step {int(state.step)})", flush=True)
+        if args.hash_restored:
+            with open(args.hash_restored, "w") as f:
+                f.write(_state_hash(jax, np, state) + "\n")
+            print("soak: restored-state hash written; exiting", flush=True)
+            return 0
 
     # -- producer (bounded queue = ingest backpressure) ----------------------
     feed: "queue.Queue" = queue.Queue(maxsize=2)
@@ -209,10 +246,22 @@ def main() -> int:
             print(f"soak: dispatch {d + 1}/{n_dispatch_total} "
                   f"({records / 1e6:.0f}M records) val_log_mae={mae:.4f} "
                   f"loss={float(loss):.4f}", flush=True)
-        if (d + 1) % args.ckpt_every == 0 or d == n_dispatch_total - 1:
+        if args.host_roundtrip_at is not None and d + 1 == args.host_roundtrip_at:
+            state = jax.tree_util.tree_map(
+                lambda leaf: jnp.asarray(np.asarray(leaf))
+                if hasattr(leaf, "dtype") else leaf,
+                state,
+            )
+            print(f"soak: host roundtrip after dispatch {d + 1}", flush=True)
+        saved = (d + 1) % args.ckpt_every == 0 or d == n_dispatch_total - 1
+        if saved:
             save(d + 1)
         if args.kill_after_dispatch is not None and d + 1 >= args.kill_after_dispatch:
-            save(d + 1)
+            if not saved:  # the periodic branch may have JUST written it
+                save(d + 1)
+            if args.hash_out:
+                with open(args.hash_out + ".at_kill", "w") as f:
+                    f.write(_state_hash(jax, np, state) + "\n")
             print(f"soak: KILLING after dispatch {d + 1} "
                   f"(checkpoint written)", flush=True)
             os._exit(137)
@@ -223,14 +272,10 @@ def main() -> int:
     records_done = (n_dispatch_total - start_dispatch) * SUPER * BATCH
 
     if args.hash_out:
-        h = hashlib.sha256()
-        for leaf in jax.tree_util.tree_leaves(
-            {"params": state.params, "opt": state.opt_state}
-        ):
-            h.update(np.asarray(leaf).tobytes())
+        digest = _state_hash(jax, np, state)
         with open(args.hash_out, "w") as f:
-            f.write(h.hexdigest() + "\n")
-        print(f"soak: state sha256 {h.hexdigest()[:16]}…", flush=True)
+            f.write(digest + "\n")
+        print(f"soak: state sha256 {digest[:16]}…", flush=True)
 
     print(json.dumps({
         "records_this_run": records_done,
