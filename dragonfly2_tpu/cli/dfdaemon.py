@@ -182,6 +182,25 @@ def run(argv=None) -> int:
         host=cfg.control_host, port=cfg.control_port,
     )
     control.serve()
+    if cfg.control_vsock_port >= 0:
+        # VM-guest wire (pkg/rpc/vsock.go): same control handler, vsock
+        # listener — guests dial vsock://2:<port> with no network stack.
+        from ..rpc.vsock import vsock_available
+
+        try:
+            if not vsock_available():
+                raise OSError("AF_VSOCK unavailable")
+            vport = control.serve_vsock(cfg.control_vsock_port)
+            print(f"dfdaemon: control also on vsock:{vport}", flush=True)
+        except OSError as exc:
+            # socket() succeeding does not guarantee bind() does (module
+            # loaded, no transport registered) — degrade to TCP-only
+            # rather than crashing the daemon.
+            import logging
+
+            logging.getLogger("dragonfly2_tpu.cli.dfdaemon").warning(
+                "control_vsock_port set but vsock is unusable: %s", exc
+            )
     if args.seed_peer:
         # Separate PUBLIC surface for the scheduler's cross-process
         # trigger: /obtain_seeds (+/healthy) only, bound on the serving

@@ -239,6 +239,9 @@ class DaemonConfig:
     # drives daemons through it).
     control_host: str = "127.0.0.1"
     control_port: int = 0
+    # AF_VSOCK control listener for VM guests (pkg/rpc/vsock.go analog);
+    # -1 = disabled, 0 = OS-assigned.
+    control_vsock_port: int = -1
     scheduler_addr: str = ""
     piece_size: int = 4 << 20
     concurrent_upload_limit: int = 50

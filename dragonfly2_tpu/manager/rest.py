@@ -186,6 +186,11 @@ class ManagerRESTServer:
                     self.wfile.write(body)
                 elif path == "/api/v1/healthy":
                     self._json(200, {"ok": True})
+                elif path in ("/swagger.json", "/api/v1/openapi"):
+                    # The swagger export (api/manager/swagger.json analog).
+                    from .openapi import spec
+
+                    self._json(200, spec())
                 elif path == "/api/v1/models":
                     models = server.registry.list(
                         scheduler_id=q.get("scheduler_id") or None,

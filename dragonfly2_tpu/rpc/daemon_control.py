@@ -215,6 +215,37 @@ class DaemonControlServer:
 
         self._svc = ThreadedHTTPService(Handler, host, port, "daemon-control")
         self.address: Tuple[str, int] = self._svc.address
+        # VM-guest surface (pkg/rpc/vsock.go): the SAME handler can also
+        # bind an AF_VSOCK listener so guests without a network stack
+        # drive the daemon over vsock://2:<port>.
+        self._handler_cls = Handler
+        self._vsock = None
+
+    def serve_vsock(self, port: int, *, cid=None):
+        """Bind the GUEST-SAFE surface on an AF_VSOCK listener; returns
+        the bound port (vsock.go listener analog).
+
+        /download is NOT exposed: it writes HOST-side files at caller-
+        chosen paths (a same-machine contract), and any guest CID can
+        dial the listener.  Guests get /healthy and /obtain_seeds — the
+        piece/seed plane, which is what the reference serves them."""
+        from .vsock import VMADDR_CID_ANY, VsockService
+
+        base = self._handler_cls
+
+        class VsockHandler(base):
+            def do_POST(self):
+                if self.path == "/download":
+                    self._json(404, {"error": "not on the vsock surface"})
+                    return
+                base.do_POST(self)
+
+        self._vsock = VsockService(
+            VsockHandler, port,
+            cid=VMADDR_CID_ANY if cid is None else cid,
+        )
+        self._vsock.serve()
+        return self._vsock.port
 
     @property
     def url(self) -> str:
@@ -225,6 +256,8 @@ class DaemonControlServer:
 
     def stop(self) -> None:
         self._svc.stop()
+        if self._vsock is not None:
+            self._vsock.stop()
 
 
 # -- dfget side (checkAndSpawnDaemon) ----------------------------------------
