@@ -180,6 +180,10 @@ def run(argv=None) -> int:
     control = DaemonControlServer(
         parts["conductor"], parts["storage"], piece_size=cfg.piece_size,
         host=cfg.control_host, port=cfg.control_port,
+        # The seeder rides the loopback server too (not just the public
+        # seed endpoint) so the vsock guest surface — which reuses this
+        # server's handler — can actually serve /obtain_seeds.
+        seeder=seeder,
     )
     control.serve()
     if cfg.control_vsock_port >= 0:

@@ -9,6 +9,7 @@ http.server runs unchanged over the family — only the bind differs.
 
 from __future__ import annotations
 
+import http.client
 import socket
 import threading
 import urllib.parse
@@ -99,34 +100,28 @@ class VsockService:
         self._httpd.server_close()
 
 
-class VsockHTTPConnection:
-    """Minimal HTTP/1.1 client over a vsock stream (urllib cannot dial
-    AF_VSOCK): request(method, path, body) → (status, body bytes)."""
+class VsockHTTPConnection(http.client.HTTPConnection):
+    """http.client.HTTPConnection whose transport is a vsock stream —
+    the stdlib owns ALL request/response framing (chunked transfer
+    included; the control handler's /obtain_seeds streams chunked);
+    only the dial differs."""
 
     def __init__(self, cid: int, port: int, *, timeout: float = 10.0):
+        super().__init__(f"vsock-{cid}", timeout=timeout)
         self.cid = cid
-        self.port = port
-        self.timeout = timeout
+        self.vsock_port = port
 
-    def request(
+    def connect(self) -> None:
+        self.sock = vsock_connect(self.cid, self.vsock_port, timeout=self.timeout)
+
+    def call(
         self, method: str, path: str, body: bytes = b"",
         headers: Optional[dict] = None,
     ) -> Tuple[int, bytes]:
-        from http.client import HTTPResponse
-
-        s = vsock_connect(self.cid, self.port, timeout=self.timeout)
+        """One-shot convenience: → (status, decoded body bytes)."""
+        self.request(method, path, body=body, headers=headers or {})
+        resp = self.getresponse()
         try:
-            lines = [f"{method} {path} HTTP/1.1", "Host: vsock",
-                     "Connection: close", f"Content-Length: {len(body)}"]
-            for k, v in (headers or {}).items():
-                lines.append(f"{k}: {v}")
-            s.sendall(("\r\n".join(lines) + "\r\n\r\n").encode() + body)
-            # Real HTTP response parsing (chunked transfer included — the
-            # control handler's /obtain_seeds streams chunked), not a
-            # hand-rolled header split.
-            resp = HTTPResponse(s, method=method)
-            resp.begin()
-            payload = resp.read()
-            return resp.status, payload
+            return resp.status, resp.read()
         finally:
-            s.close()
+            self.close()
