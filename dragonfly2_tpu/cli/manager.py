@@ -96,11 +96,17 @@ def run(argv=None) -> int:
             for p in cfg.oauth_providers:
                 oauth.register(OAuthProvider(**p))
             auth["oauth"] = oauth
+    from ..rpc.ratelimit import maybe_bucket
+
     rest = ManagerRESTServer(
         parts["registry"], parts["clusters"], parts["searcher"],
         host=cfg.server.host, port=cfg.server.port,
         jobqueue=parts["jobs"], crud=parts["crud"],
-        objectstorage=parts["objectstorage"], **auth,
+        objectstorage=parts["objectstorage"],
+        rate_limit=maybe_bucket(
+            cfg.server.rate_limit_qps, cfg.server.rate_limit_burst
+        ),
+        **auth,
     )
     rest.serve()
     grpc_server = None

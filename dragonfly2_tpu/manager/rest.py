@@ -116,6 +116,7 @@ class ManagerRESTServer:
         jobqueue=None,
         crud: Optional[CrudStore] = None,
         objectstorage=None,
+        rate_limit=None,
     ):
         self.registry = registry
         self.clusters = clusters
@@ -129,6 +130,9 @@ class ManagerRESTServer:
         # Optional ObjectStorageBackend the bucket routes proxy to
         # (manager/handlers/bucket.go semantics); None → 404s.
         self.objectstorage = objectstorage
+        # Token-bucket middleware (manager/middlewares rate limiter): one
+        # bucket bounds the whole REST surface; None = off.
+        self.rate_limit = rate_limit
         # Shared topology cache (the Redis analog for the probe graph,
         # network_topology.go:55-88): scheduler_id → its pushed edge
         # summaries.  Replicas pull everyone else's edges; a scheduler
@@ -170,7 +174,23 @@ class ManagerRESTServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _rate_limited(self) -> bool:
+                # Liveness stays exempt: the limiter must not convert
+                # overload into an orchestrator-visible outage (probes
+                # 429ing at peak → restarts exactly when busiest).
+                if urllib.parse.urlsplit(self.path).path == "/api/v1/healthy":
+                    return False
+                if server.rate_limit is not None and not server.rate_limit.take():
+                    from ..rpc.metrics import RATE_LIMITED_TOTAL
+
+                    RATE_LIMITED_TOTAL.inc(transport="manager-rest")
+                    self._json(429, {"error": "rate limit exceeded"})
+                    return True
+                return False
+
             def do_GET(self):
+                if self._rate_limited():
+                    return
                 parsed = urllib.parse.urlsplit(self.path)
                 q = dict(urllib.parse.parse_qsl(parsed.query))
                 path = parsed.path
@@ -379,6 +399,8 @@ class ManagerRESTServer:
                 return json.loads(self.rfile.read(length) or b"{}")
 
             def do_POST(self):
+                if self._rate_limited():
+                    return
                 path = urllib.parse.urlsplit(self.path).path
                 if (
                     path.startswith("/api/v1/users")

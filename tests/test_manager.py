@@ -323,3 +323,37 @@ class TestCrudRest:
         again = CrudStore(db)
         rec = again.ensure_default_cluster()
         assert rec.id == "default"
+
+
+class TestManagerRateLimit:
+    def test_rest_429_past_the_bucket(self):
+        import urllib.error
+        import urllib.request
+
+        from dragonfly2_tpu.manager.rest import ManagerRESTServer
+        from dragonfly2_tpu.rpc.ratelimit import TokenBucket
+
+        server = ManagerRESTServer(
+            ModelRegistry(), ClusterManager(),
+            rate_limit=TokenBucket(qps=0.001, burst=3),
+        )
+        server.serve()
+        try:
+            def get(path):
+                try:
+                    with urllib.request.urlopen(
+                        server.url + path, timeout=5
+                    ) as r:
+                        return r.status
+                except urllib.error.HTTPError as exc:
+                    return exc.code
+
+            codes = [get("/api/v1/models") for _ in range(6)]
+            assert 429 in codes and 200 in codes, codes
+            # Liveness is EXEMPT: probes must not 429 under load.
+            assert get("/api/v1/healthy") == 200
+            from dragonfly2_tpu.rpc.metrics import RATE_LIMITED_TOTAL
+
+            assert RATE_LIMITED_TOTAL.value(transport="manager-rest") >= 1
+        finally:
+            server.stop()
