@@ -98,14 +98,13 @@ def run(argv=None) -> int:
             auth["oauth"] = oauth
     from ..rpc.ratelimit import maybe_bucket
 
+    bucket = maybe_bucket(cfg.server.rate_limit_qps, cfg.server.rate_limit_burst)
     rest = ManagerRESTServer(
         parts["registry"], parts["clusters"], parts["searcher"],
         host=cfg.server.host, port=cfg.server.port,
         jobqueue=parts["jobs"], crud=parts["crud"],
         objectstorage=parts["objectstorage"],
-        rate_limit=maybe_bucket(
-            cfg.server.rate_limit_qps, cfg.server.rate_limit_burst
-        ),
+        rate_limit=bucket,
         **auth,
     )
     rest.serve()
@@ -116,9 +115,11 @@ def run(argv=None) -> int:
         grpc_server = ManagerGRPCServer(
             parts["registry"], parts["clusters"], parts["searcher"],
             host=cfg.server.host, port=cfg.server.grpc_port,
-            # Same RBAC as REST, same credentials: session tokens AND PATs.
+            # Same RBAC as REST, same credentials: session tokens AND PATs;
+            # same SHARED rate-limit bucket (qps bounds the service).
             token_verifier=auth.get("token_verifier"),
             users=auth.get("users"),
+            rate_limit=bucket,
         )
         grpc_server.serve()
     # flush: under a pipe (supervisors, e2e harnesses) the ready line must

@@ -175,10 +175,13 @@ class ManagerRESTServer:
                 self.wfile.write(body)
 
             def _rate_limited(self) -> bool:
-                # Liveness stays exempt: the limiter must not convert
-                # overload into an orchestrator-visible outage (probes
-                # 429ing at peak → restarts exactly when busiest).
-                if urllib.parse.urlsplit(self.path).path == "/api/v1/healthy":
+                # Liveness-class routes stay exempt: the limiter must not
+                # convert overload into an outage — 429ing health probes
+                # gets the manager restarted, and 429ing scheduler
+                # keepalives expires HEALTHY schedulers out of the active
+                # set exactly when the cluster is busiest.
+                path = urllib.parse.urlsplit(self.path).path
+                if path == "/api/v1/healthy" or path.endswith(":keepalive"):
                     return False
                 if server.rate_limit is not None and not server.rate_limit.take():
                     from ..rpc.metrics import RATE_LIMITED_TOTAL

@@ -776,6 +776,7 @@ class ManagerGRPCServer:
         max_workers: int = 16,
         token_verifier=None,
         users=None,
+        rate_limit=None,
         server_credentials: Optional[grpc.ServerCredentials] = None,
     ) -> None:
         from ..manager.searcher import Searcher
@@ -789,7 +790,18 @@ class ManagerGRPCServer:
         # With a UserStore, personal access tokens authenticate here
         # exactly like on REST — both ports accept the same credentials.
         self.users = users
-        self._server = grpc.server(ThreadPoolExecutor(max_workers=max_workers))
+        # ONE bucket with the REST surface (cli/manager wires the same
+        # instance): the configured qps bounds the SERVICE, not each
+        # transport separately (scheduler CLI precedent).
+        interceptors = ()
+        if rate_limit is not None:
+            from .ratelimit import RateLimitInterceptor
+
+            interceptors = (RateLimitInterceptor(rate_limit),)
+        self._server = grpc.server(
+            ThreadPoolExecutor(max_workers=max_workers),
+            interceptors=interceptors,
+        )
         methods = {
             # name: (fn, req, resp, required role — None = open read)
             "create_model": (self._create_model, pb.CreateModelRequest, pb.WireModel, Role.PEER),

@@ -336,6 +336,7 @@ def train_hop_ranker(
     mesh: Optional[Mesh] = None,
     batch_size: int = 65_536,
     hop_feats: Optional[np.ndarray] = None,
+    node_sharding: str = "replicated",
 ) -> Tuple[TrainState, EvalMetrics, List[Dict[str, float]]]:
     """Scatter-free flagship ranker (models/hop.py): aggregation is
     precomputed once per snapshot, the train step is pure dense MXU work
@@ -343,7 +344,9 @@ def train_hop_ranker(
     north-star shape with equal-or-better validation quality
     (BENCHMARKS.md).  Pass ``hop_feats`` when the caller already
     precomputed them (the scorer export needs the same array — compute
-    once, use twice)."""
+    once, use twice).  ``node_sharding="model"`` partitions the hop
+    features and embedding table by node over the mesh's model axis —
+    the config[4] scale mode where node tables exceed one chip's HBM."""
     from ..models.hop import HopConfig, HopRanker, precompute_hop_features
 
     cfg = config or TrainConfig()
@@ -359,6 +362,7 @@ def train_hop_ranker(
     return _train_graph_model(
         model, hop_feats, table, edge_src, edge_dst, edge_target,
         query_edge_feats, cfg, mesh, batch_size,
+        node_sharding=node_sharding,
     )
 
 
@@ -376,6 +380,36 @@ def _graph_train_step(state: TrainState, node_feats, table, src, dst, target, qe
     return state.apply_gradients(grads=grads), loss
 
 
+def _node_table_sharding(mesh: Mesh):
+    """THE node-table partition spec: rows sharded over the model axis.
+    Single definition — hop features and the embedding/optimizer leaves
+    must always shard identically."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import MODEL_AXIS
+
+    return NamedSharding(mesh, P(MODEL_AXIS, None))
+
+
+def _node_sharded_state_spec(mesh: Mesh, tree):
+    """Sharding tree for model-parallel node tables: the learnable
+    embedding table (and its optimizer moments — they share the leaf
+    path) partitions by NODE over the model axis; everything else
+    replicates.  The config[4] memory story: at 1B-edge scale the node
+    tables are the floor, so they shard instead of replicating."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    node_tables = _node_table_sharding(mesh)
+
+    def leaf_spec(path, leaf):
+        if any(getattr(p, "key", None) == "embedding" for p in path):
+            return node_tables
+        return repl
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, tree)
+
+
 def _train_graph_model(
     model,
     node_feats: np.ndarray,
@@ -387,6 +421,7 @@ def _train_graph_model(
     cfg: TrainConfig,
     mesh: Mesh,
     batch_size: int,
+    node_sharding: str = "replicated",
 ) -> Tuple[TrainState, EvalMetrics, List[Dict[str, float]]]:
     n_edges = len(edge_src)
     rng = np.random.default_rng(cfg.seed)
@@ -432,25 +467,38 @@ def _train_graph_model(
 
     repl = replicated(mesh)
     data_shard = batch_sharding(mesh)
-    state = jax.device_put(state, repl)
-    nf = jax.device_put(nf, repl)
+    if node_sharding == "model":
+        # Tensor-parallel node tables (VERDICT r2 weak-#7 made a product
+        # option): hop features + the embedding table (and its moments)
+        # partition by node over the model axis; the endpoint gathers
+        # cross shards and XLA inserts the collectives.  Loss parity with
+        # the replicated mode is asserted in tests.
+        nf_shard = _node_table_sharding(mesh)
+        state_shard = _node_sharded_state_spec(mesh, state)
+    elif node_sharding == "replicated":
+        nf_shard = repl
+        state_shard = repl
+    else:
+        raise ValueError(f"unknown node_sharding {node_sharding!r}")
+    state = jax.device_put(state, state_shard)
+    nf = jax.device_put(nf, nf_shard)
     dev_table = jax.device_put(table, repl)
 
     has_qef = query_edge_feats is not None
-    in_shardings = (repl, repl, repl, data_shard, data_shard, data_shard)
+    in_shardings = (state_shard, nf_shard, repl, data_shard, data_shard, data_shard)
     if has_qef:
         in_shardings = in_shardings + (data_shard,)
         step_fn = jax.jit(
             _graph_train_step,
             in_shardings=in_shardings,
-            out_shardings=(repl, repl),
+            out_shardings=(state_shard, repl),
             donate_argnums=(0,),
         )
     else:
         step_fn = jax.jit(
             lambda s, n, t, a, b, y: _graph_train_step(s, n, t, a, b, y, None),
             in_shardings=in_shardings,
-            out_shardings=(repl, repl),
+            out_shardings=(state_shard, repl),
             donate_argnums=(0,),
         )
 
