@@ -270,6 +270,52 @@ class Conductor:
             run = self._runs.get(task_id)
             return run if run is not None and not run.done else None
 
+    def _run_piece_pool(
+        self,
+        pending: "deque",
+        fetch_one,
+        *,
+        abort: threading.Event,
+        name: str,
+    ) -> None:
+        """ONE worker-pool harness for both piece planes (scheduled
+        parents and the pex fallback): min(piece_parallelism, |pending|)
+        workers drain the queue; ``fetch_one(number) -> bool`` returning
+        False — or raising — aborts the POOL (a silently-dead worker
+        would let siblings drain `pending` and report a "successful"
+        download with its popped piece missing).  Joins before returning;
+        `abort or pending` afterwards means failure."""
+        if not pending:
+            return
+        lock = threading.Lock()
+
+        def worker() -> None:
+            try:
+                while not abort.is_set():
+                    with lock:
+                        if not pending:
+                            return
+                        number = pending.popleft()
+                    if not fetch_one(number):
+                        abort.set()
+                        return
+            except Exception:  # noqa: BLE001 — abort, don't die silently
+                import logging
+
+                abort.set()
+                logging.getLogger(__name__).warning(
+                    "piece worker aborted (%s)", name, exc_info=True
+                )
+
+        threads = [
+            threading.Thread(target=worker, name=f"{name}-{i}", daemon=True)
+            for i in range(min(self.piece_parallelism, len(pending)))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
     # -- the main flow (peertask_conductor.go:370 start → pullPieces) --------
 
     def download(
@@ -528,33 +574,45 @@ class Conductor:
             task_id, piece_size=piece_size, content_length=content_length
         )
         run.mark_sized(n_pieces, piece_size, content_length)
-        nbytes = 0
+        pending = deque()
         for number in range(n_pieces):
             if self.storage.has_piece(task_id, number):
                 run.mark_piece(number)
-                continue
-            fetched = False
+            else:
+                pending.append(number)
+        lock = threading.Lock()
+        abort = threading.Event()
+        counters = {"nbytes": 0, "done": 0}
+
+        def fetch_one(number: int) -> bool:
+            # Gossip holders stand in for the parent list (no scheduler
+            # to report to); no holder serving the piece fails the task.
             for holder in self.pex.find_peers_with_piece(task_id, number):
                 if holder == self.host.id:
                     continue
                 try:
                     data = self.piece_fetcher.fetch(holder, task_id, number)
-                except Exception:  # noqa: BLE001 — try the next holder
+                except Exception:  # noqa: BLE001 — next holder
                     continue
                 self.storage.write_piece(task_id, number, data)
                 run.mark_piece(number)
-                nbytes += len(data)
-                fetched = True
-                break
-            if not fetched:
-                return DownloadResult(
-                    ok=False, task_id=task_id, peer_id="", pieces=number,
-                    bytes=nbytes, cost_s=time.monotonic() - t0,
-                )
+                with lock:
+                    counters["nbytes"] += len(data)
+                    counters["done"] += 1
+                return True
+            return False
+
+        self._run_piece_pool(pending, fetch_one, abort=abort, name="pex-worker")
+        if abort.is_set() or pending:
+            return DownloadResult(
+                ok=False, task_id=task_id, peer_id="",
+                pieces=counters["done"], bytes=counters["nbytes"],
+                cost_s=time.monotonic() - t0,
+            )
         self.pex.advertise(task_id, set(range(n_pieces)))
         return DownloadResult(
             ok=True, task_id=task_id, peer_id="", pieces=n_pieces,
-            bytes=nbytes, cost_s=time.monotonic() - t0,
+            bytes=counters["nbytes"], cost_s=time.monotonic() - t0,
         )
 
     # -- the concurrent P2P phase -------------------------------------------
@@ -670,40 +728,16 @@ class Conductor:
 
         download_tp = default_tracer.inject().get(TRACEPARENT_HEADER)
 
-        def worker() -> None:
-            # Any escape (storage write, shaper, report RPC raising) must
-            # abort the POOL — a silently-dead worker would otherwise let
-            # the siblings drain `pending` and report a "successful"
-            # download with this worker's popped piece missing.
-            try:
-                with default_tracer.remote_span(
-                    "daemon/piece_worker", download_tp, task_id=task.id
-                ):
-                    while not state.abort.is_set():
-                        with state.lock:
-                            if not pending:
-                                return
-                            number = pending.popleft()
-                        if not fetch_one(number):
-                            return
-            except Exception:  # noqa: BLE001 — abort → source fallback
-                import logging
+        def fetch_traced(number: int) -> bool:
+            with default_tracer.remote_span(
+                "daemon/piece_worker", download_tp, task_id=task.id,
+                number=number,
+            ):
+                return fetch_one(number)
 
-                state.abort.set()
-                logging.getLogger(__name__).warning(
-                    "piece worker aborted task %s", task.id, exc_info=True
-                )
-
-        n_workers = min(self.piece_parallelism, max(len(pending), 1))
-        if pending:
-            threads = [
-                threading.Thread(target=worker, name=f"piece-worker-{i}", daemon=True)
-                for i in range(n_workers)
-            ]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
+        self._run_piece_pool(
+            pending, fetch_traced, abort=state.abort, name="piece-worker"
+        )
 
         with state.lock:
             failed, nbytes = state.failed, state.nbytes

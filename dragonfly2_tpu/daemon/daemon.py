@@ -109,6 +109,11 @@ class Daemon:
                 gossip_bus,
             )
             self.pex.serve()
+            # The conductor needs the pex handle for its scheduler-down
+            # fallback (gossip-discovered holders) — without this wiring
+            # the fallback silently never engages (the CLI composition
+            # attaches it the same way).
+            self.conductor.pex = self.pex
         self.probe_agent: Optional[ProbeAgent] = None
 
     def enable_probes(self, ping) -> None:

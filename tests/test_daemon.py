@@ -728,3 +728,58 @@ class TestPeerEngine:
         assert child.read_task_bytes(tid) == b"".join(
             swarm.origin.content(url, n) for n in range(n_pieces)
         )
+
+
+class TestPexWorkerPool:
+    def test_scheduler_down_fallback_overlaps_pieces(self, tmp_path):
+        """The pex fallback uses the same worker-pool shape as the
+        scheduled path: pieces overlap across gossip-discovered holders."""
+        import threading
+        import time
+
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        url = "https://origin/pex-pool-blob"
+        n_pieces = 8
+        r = swarm.daemons[0].download(
+            url, piece_size=PIECE, content_length=n_pieces * PIECE
+        )
+        assert r.ok
+        assert swarm.daemons[1].download(url, piece_size=PIECE).ok
+
+        child = swarm.daemons[2]
+        inner = child.conductor.piece_fetcher
+        gauge = {"now": 0, "max": 0}
+        mu = threading.Lock()
+
+        class SlowFetcher:
+            def fetch(self, host_id, task_id, number):
+                with mu:
+                    gauge["now"] += 1
+                    gauge["max"] = max(gauge["max"], gauge["now"])
+                try:
+                    time.sleep(0.03)
+                    return inner.fetch(host_id, task_id, number)
+                finally:
+                    with mu:
+                        gauge["now"] -= 1
+
+            def piece_bitmap(self, host_id, task_id):
+                return inner.piece_bitmap(host_id, task_id)
+
+        child.conductor.piece_fetcher = SlowFetcher()
+
+        # Scheduler down: registration raises → the pex pool takes over.
+        def dead_register(**kw):
+            raise ConnectionError("scheduler down")
+
+        child.conductor.scheduler = type(
+            "Down", (), {"register_peer": staticmethod(dead_register)}
+        )()
+        r2 = child.conductor.download(
+            url, piece_size=PIECE, content_length=n_pieces * PIECE
+        )
+        assert r2.ok and r2.pieces == n_pieces
+        assert gauge["max"] >= 2, f"pex pieces never overlapped: {gauge}"
+        assert child.read_task_bytes(r2.task_id) == b"".join(
+            swarm.origin.content(url, n) for n in range(n_pieces)
+        )[: n_pieces * PIECE]
