@@ -14,7 +14,10 @@ Stages:
      daemon warms the layer from the origin;
   4. learning loop — records stream to the trainer, the model lands in
      the MANAGER, REST activation flips it live, and a scheduler-side
-     ML evaluator subscriber hot-swaps to the trained scorer.
+     ML evaluator subscriber hot-swaps to the trained scorer;
+  5. live cluster config — a PATCH on the manager changes the RUNNING
+     scheduler's candidate-parent limit through dynconfig (observed on
+     the scheduling wire, not just the config endpoint).
 """
 
 from __future__ import annotations
@@ -178,6 +181,30 @@ def main() -> int:
     sub = ModelSubscriber(registry, evaluator, scheduler_id=sched_id)
     assert sub.refresh() is True and evaluator.has_model
     log(f"model v{active.version} activated; ML evaluator hot-swapped")
+
+    # -- 5. live cluster config ----------------------------------------------
+    # PATCH on the manager → the RUNNING scheduler's next pass caps
+    # candidate parents at 1, observed via a real registration.
+    from dragonfly2_tpu.rpc import RemoteScheduler
+    from dragonfly2_tpu.scheduler.resource import Host
+
+    call(MANAGER, "POST", "/api/v1/clusters/default:update",
+         {"scheduler_cluster_config": {
+             "candidate_parent_limit": 1, "filter_parent_limit": 15}})
+    client = RemoteScheduler(SCHEDULER)
+    probe_host = Host(id="e2e-probe", hostname="e2e-probe", ip="127.0.0.1",
+                      download_port=1)
+
+    def parents_now():
+        reg = client.register_peer(host=probe_host, url=url)
+        n = len(reg.schedule.parents) if reg.schedule else 0
+        client.report_peer_failed(reg.peer)
+        return n
+
+    n_parents = wait_for(
+        "live candidate limit", lambda: parents_now() == 1 and 1, timeout=60
+    )
+    log(f"cluster-config PATCH applied live: {n_parents} candidate parent")
 
     log("ALL STAGES PASSED")
     return 0

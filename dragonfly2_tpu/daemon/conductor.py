@@ -277,6 +277,7 @@ class Conductor:
         *,
         abort: threading.Event,
         name: str,
+        traceparent: Optional[str] = None,
     ) -> None:
         """ONE worker-pool harness for both piece planes (scheduled
         parents and the pex fallback): min(piece_parallelism, |pending|)
@@ -289,16 +290,31 @@ class Conductor:
             return
         lock = threading.Lock()
 
+        def drain() -> None:
+            while not abort.is_set():
+                with lock:
+                    if not pending:
+                        return
+                    number = pending.popleft()
+                if not fetch_one(number):
+                    abort.set()
+                    return
+
         def worker() -> None:
             try:
-                while not abort.is_set():
-                    with lock:
-                        if not pending:
-                            return
-                        number = pending.popleft()
-                    if not fetch_one(number):
-                        abort.set()
-                        return
+                if traceparent is not None:
+                    # ONE span per worker (not per piece — a 10k-piece
+                    # task must not emit 10k spans), linked into the
+                    # caller's download trace so the worker thread's own
+                    # RPCs propagate the same trace id.
+                    from ..utils.tracing import default_tracer
+
+                    with default_tracer.remote_span(
+                        f"daemon/{name}", traceparent
+                    ):
+                        drain()
+                else:
+                    drain()
             except Exception:  # noqa: BLE001 — abort, don't die silently
                 import logging
 
@@ -513,6 +529,8 @@ class Conductor:
                 peer, 0, parent_id="", length=len(reg.direct_piece), cost_ns=1
             )
             self.scheduler.report_peer_finished(peer)
+            if self.pex is not None:
+                self.pex.advertise(task.id, {0})
             return DownloadResult(
                 ok=True, task_id=task.id, peer_id=peer.id, pieces=1,
                 bytes=len(reg.direct_piece), cost_s=time.monotonic() - t0,
@@ -602,7 +620,12 @@ class Conductor:
                 return True
             return False
 
-        self._run_piece_pool(pending, fetch_one, abort=abort, name="pex-worker")
+        from ..utils.tracing import TRACEPARENT_HEADER, default_tracer
+
+        self._run_piece_pool(
+            pending, fetch_one, abort=abort, name="pex-worker",
+            traceparent=default_tracer.inject().get(TRACEPARENT_HEADER),
+        )
         if abort.is_set() or pending:
             return DownloadResult(
                 ok=False, task_id=task_id, peer_id="",
@@ -727,16 +750,9 @@ class Conductor:
         from ..utils.tracing import TRACEPARENT_HEADER, default_tracer
 
         download_tp = default_tracer.inject().get(TRACEPARENT_HEADER)
-
-        def fetch_traced(number: int) -> bool:
-            with default_tracer.remote_span(
-                "daemon/piece_worker", download_tp, task_id=task.id,
-                number=number,
-            ):
-                return fetch_one(number)
-
         self._run_piece_pool(
-            pending, fetch_traced, abort=state.abort, name="piece-worker"
+            pending, fetch_one, abort=state.abort, name="piece-worker",
+            traceparent=download_tp,
         )
 
         with state.lock:

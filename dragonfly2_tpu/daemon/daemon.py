@@ -128,7 +128,12 @@ class Daemon:
 
     def download(self, url: str, **kwargs) -> DownloadResult:
         result = self.conductor.download(url, **kwargs)
-        if result.ok and self.pex is not None:
+        # The conductor advertises every download it EXECUTED (all three
+        # planes + tiny); only reuse results — served straight from disk,
+        # e.g. after a restart reload raced ahead of reload()'s
+        # re-advertisement — need one here.  Advertising twice would
+        # double gossip traffic per download on the UDP bus.
+        if result.ok and result.reused and self.pex is not None:
             self.pex.advertise(result.task_id, set(range(result.pieces)))
         return result
 
