@@ -178,7 +178,7 @@ def run(argv=None) -> int:
     from ..rpc.daemon_control import DaemonControlServer, write_state
 
     control = DaemonControlServer(
-        parts["conductor"], parts["storage"], piece_size=cfg.piece_size,
+        parts["conductor"], piece_size=cfg.piece_size,
         host=cfg.control_host, port=cfg.control_port,
         # The seeder rides the loopback server too (not just the public
         # seed endpoint) so the vsock guest surface — which reuses this
@@ -210,7 +210,7 @@ def run(argv=None) -> int:
         # trigger: /obtain_seeds (+/healthy) only, bound on the serving
         # address and advertised via the host announce's port.
         seed_endpoint = DaemonControlServer(
-            parts["conductor"], parts["storage"], piece_size=cfg.piece_size,
+            parts["conductor"], piece_size=cfg.piece_size,
             host=cfg.server.host, seeder=seeder, public=True,
         )
         seed_endpoint.serve()

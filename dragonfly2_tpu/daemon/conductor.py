@@ -210,6 +210,12 @@ class Conductor:
         # the P2P phase gives up (→ back-to-source).
         self.piece_poll_interval_s = piece_poll_interval_s
         self.piece_wait_timeout_s = piece_wait_timeout_s
+        # Subscription window when a worker is STARVED (no holder for its
+        # piece): the long-poll parks on the parent's piece plane for up
+        # to this long instead of hammering it every poll interval — over
+        # HTTP that's the difference between 1 request/s and 20/s per
+        # parent while waiting on a mid-download swarm.
+        self.piece_subscribe_window_s = max(piece_poll_interval_s, 1.0)
         # Concurrent back-to-source (piece_manager.go:793-873 semantics):
         # split the remaining pieces into `groups` contiguous range groups,
         # one worker per group, any worker failure cancels the task.  Only
@@ -781,22 +787,37 @@ class Conductor:
         (new parents adopted)."""
         if not hasattr(self.piece_fetcher, "piece_bitmap"):
             return
+        wait = getattr(self.piece_fetcher, "wait_piece_bitmap", None)
+        # Gate at the width of the refresh itself: with the subscription
+        # available, ONE worker parks for the window while its siblings
+        # skip (claiming last_refresh at entry) — not a fresh long-poller
+        # every poll interval.
+        gate = (
+            self.piece_subscribe_window_s
+            if (wait is not None and not force)
+            else self.piece_poll_interval_s
+        )
         now = time.monotonic()
         with state.lock:
-            if not force and now - state.last_refresh < self.piece_poll_interval_s:
+            if not force and now - state.last_refresh < gate:
                 return
             state.last_refresh = now
             plist = list(state.parents)
+        # The WHOLE refresh is bounded by one window, split across
+        # parents — serial full-window parks would delay abort/push/
+        # deadline checks by len(parents) × window.
+        per_parent_wait = (
+            self.piece_subscribe_window_s / max(len(plist), 1)
+            if plist else 0.0
+        )
         for p in plist:
-            wait = getattr(self.piece_fetcher, "wait_piece_bitmap", None)
+            if state.abort.is_set():
+                return
             try:
                 if wait is not None and not force:
                     with state.lock:
                         have = int(sum(state.bitmaps.get(p.id, b"")))
-                    bm = wait(
-                        p.host.id, task_id, have,
-                        self.piece_poll_interval_s,
-                    )
+                    bm = wait(p.host.id, task_id, have, per_parent_wait)
                 else:
                     bm = self.piece_fetcher.piece_bitmap(p.host.id, task_id)
             except Exception:  # noqa: BLE001 — a dead parent just has no bitmap
@@ -1015,3 +1036,16 @@ class StreamHandle:
 
     def read_all(self, *, piece_timeout_s: float = 60.0) -> bytes:
         return b"".join(self.chunks(piece_timeout_s=piece_timeout_s))
+
+    def result(self) -> Optional[DownloadResult]:
+        """The underlying run's final result (None while running, or for
+        pure-reuse handles that never ran a download)."""
+        return self._run.result if self._run is not None else None
+
+    def wait_result(self, *, timeout_s: float = 30.0) -> Optional[DownloadResult]:
+        """Block for the run's FINAL result — chunks() drains at the last
+        piece commit, moments before the run finishes (reports, advertise),
+        so immediate result() reads race None."""
+        if self._run is None:
+            return None
+        return self._run.wait_done(timeout_s)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler
@@ -66,7 +67,6 @@ class DaemonControlServer:
     def __init__(
         self,
         conductor,
-        storage,
         *,
         piece_size: int = 4 << 20,
         host: str = "127.0.0.1",
@@ -172,10 +172,69 @@ class DaemonControlServer:
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 try:
+                    import time as _time
+
                     req = json.loads(self.rfile.read(length) or b"{}")
                     url = req["url"]
                     piece_size = int(req.get("piece_size") or outer_piece_size)
                     content_length = conductor.probe_content_length(url)
+                    output = req.get("output")
+                    t0 = _time.monotonic()
+                    if output:
+                        # Same-machine contract (dfget and the daemon share
+                        # the host, like the reference's unix socket).
+                        # STREAM the output (StartFileTask semantics):
+                        # bytes land in the file as pieces commit instead
+                        # of buffering the whole task first — and a
+                        # partial file never masquerades as complete
+                        # (tmp + atomic rename on success).
+                        handle = conductor.open_stream(
+                            url, piece_size=piece_size,
+                            content_length=content_length,
+                        )
+                        # Per-REQUEST tmp name: handler threads share a
+                        # pid, and two concurrent downloads to one output
+                        # path must not interleave into the same file.
+                        tmp_out = (
+                            f"{output}.{os.getpid()}."
+                            f"{threading.get_ident()}.part"
+                        )
+                        nbytes = 0
+                        try:
+                            with open(tmp_out, "wb") as f:
+                                for chunk in handle.chunks():
+                                    f.write(chunk)
+                                    nbytes += len(chunk)
+                            os.replace(tmp_out, output)
+                        except BaseException:
+                            try:
+                                os.remove(tmp_out)
+                            except OSError:
+                                pass
+                            raise
+                        # chunks() drains at the LAST piece commit; the
+                        # run's result lands moments later — wait for it
+                        # or back_to_source misreports nondeterministically.
+                        final = handle.wait_result(timeout_s=30.0)
+                        out = {
+                            "ok": True,
+                            "task_id": handle.task_id,
+                            "pieces": handle.n_pieces,
+                            "bytes": nbytes,
+                            "back_to_source": bool(
+                                final.back_to_source if final else False
+                            ),
+                            "cost_s": _time.monotonic() - t0,
+                            "output": output,
+                        }
+                        self._json(200, out)
+                        # AFTER the response write: a client that hung up
+                        # mid-stream raises out of _json and must count
+                        # once (as failure), not as success+failure.
+                        from .metrics import DAEMON_CONTROL_DOWNLOADS
+
+                        DAEMON_CONTROL_DOWNLOADS.inc(result="success")
+                        return
                     result = conductor.download(
                         url, piece_size=piece_size,
                         content_length=content_length,
@@ -188,15 +247,6 @@ class DaemonControlServer:
                         "back_to_source": result.back_to_source,
                         "cost_s": result.cost_s,
                     }
-                    output = req.get("output")
-                    if result.ok and output:
-                        # Same-machine contract (dfget and the daemon share
-                        # the host, like the reference's unix socket).
-                        with open(output, "wb") as f:
-                            f.write(storage.read_task_bytes(result.task_id))
-                        out["output"] = output
-                    # Counted AFTER the output write: a failed write is a
-                    # failed download, and lands in the except below.
                     from .metrics import DAEMON_CONTROL_DOWNLOADS
 
                     DAEMON_CONTROL_DOWNLOADS.inc(

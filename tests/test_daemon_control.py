@@ -26,7 +26,7 @@ class TestControlServer:
         swarm = _Swarm(tmp_path, n_hosts=2)
         swarm.origin.content_length = lambda u: 3 * PIECE
         d = swarm.daemons[0]
-        srv = DaemonControlServer(d.conductor, d.storage, piece_size=PIECE)
+        srv = DaemonControlServer(d.conductor, piece_size=PIECE)
         srv.serve()
         try:
             assert daemon_healthy(srv.url)
@@ -60,7 +60,7 @@ class TestControlServer:
         swarm = _Swarm(tmp_path, n_hosts=1)
         d = swarm.daemons[0]
         d.conductor.source_fetcher = None  # downloads will fail
-        srv = DaemonControlServer(d.conductor, d.storage, piece_size=PIECE)
+        srv = DaemonControlServer(d.conductor, piece_size=PIECE)
         srv.serve()
         try:
             result = download_via_daemon(
@@ -73,7 +73,7 @@ class TestControlServer:
     def test_bad_request_rejected(self, tmp_path):
         swarm = _Swarm(tmp_path, n_hosts=1)
         d = swarm.daemons[0]
-        srv = DaemonControlServer(d.conductor, d.storage)
+        srv = DaemonControlServer(d.conductor)
         srv.serve()
         try:
             req = urllib.request.Request(

@@ -279,7 +279,7 @@ class TestPublicSurfaceLockdown:
         conductor = Conductor(host, storage, service,
                               piece_fetcher=None, source_fetcher=_Origin())
         srv = DaemonControlServer(
-            conductor, storage, piece_size=PIECE,
+            conductor, piece_size=PIECE,
             seeder=Seeder(conductor, storage), public=True,
         )
         srv.serve()
