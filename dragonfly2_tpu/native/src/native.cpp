@@ -499,7 +499,8 @@ namespace {
 struct HttpServer {
   int lfd = -1;
   std::atomic<bool> stopping{false};
-  std::atomic<int> active{0};       // requests being served (503 cap)
+  std::atomic<int> active{0};       // DATA requests being served (503 cap)
+  std::atomic<int> meta_active{0};  // parked bitmap long-polls (degrade cap)
   std::atomic<int> conn_count{0};   // live connection threads
   std::atomic<int64_t> pieces_served{0};
   std::atomic<int64_t> bytes_served{0};
@@ -681,8 +682,16 @@ void handle_conn(HttpServer* srv, int fd) {
     }
 
     PieceStore* ps = get_store(srv->store_handle);
-    if (!ps || srv->active.fetch_add(1) >= srv->limit) {
-      if (ps) srv->active.fetch_sub(1);
+    // The 503 cap protects the DATA plane (sendfile piece/range bodies).
+    // Bitmap requests — including long-poll subscriptions that PARK for
+    // up to 30 s — do not count: a swarm of starved children parked on a
+    // busy seed must not consume its piece-serving slots (they are still
+    // bounded by the per-connection threads).
+    bool metadata = path.rfind("/tasks/", 0) == 0 &&
+                    path.size() >= 7 &&
+                    path.rfind("/pieces") == path.size() - 7;
+    if (!ps || (!metadata && srv->active.fetch_add(1) >= srv->limit)) {
+      if (ps && !metadata) srv->active.fetch_sub(1);
       send_error_http(fd, 503, "Busy");
       if (!keep_alive || !ps) break;
       continue;
@@ -743,6 +752,15 @@ void handle_conn(HttpServer* srv, int fd) {
         parse_query_i64(query, "have", &have);
         parse_query_i64(query, "wait_ms", &wait_ms);
         if (wait_ms > 30000) wait_ms = 30000;
+        // Long-polls don't consume data-plane slots, but they are not
+        // unbounded either: past 4x the serving cap of PARKED pollers,
+        // the subscription degrades to an immediate snapshot (clients
+        // fall back to interval polling) instead of stacking threads.
+        bool parked = false;
+        if (wait_ms > 0) {
+          parked = true;
+          if (srv->meta_active.fetch_add(1) >= srv->limit * 4) wait_ms = 0;
+        }
         TaskPtr ts;
         int64_t waited_ms = 0;
         for (;;) {
@@ -759,6 +777,7 @@ void handle_conn(HttpServer* srv, int fd) {
           usleep(20 * 1000);
           waited_ms += 20;
         }
+        if (parked) srv->meta_active.fetch_sub(1);
         int64_t n_pieces =
             (!ts || ts->header.piece_size == 0)
                 ? 0
@@ -838,7 +857,7 @@ void handle_conn(HttpServer* srv, int fd) {
     } else {
       ok_conn = send_error_http(fd, 404, "Not Found");
     }
-    srv->active.fetch_sub(1);
+    if (!metadata) srv->active.fetch_sub(1);
     if (!ok_conn || !keep_alive) break;
   }
   {

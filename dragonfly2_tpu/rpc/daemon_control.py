@@ -213,17 +213,21 @@ class DaemonControlServer:
                                 pass
                             raise
                         # chunks() drains at the LAST piece commit; the
-                        # run's result lands moments later — wait for it
-                        # or back_to_source misreports nondeterministically.
-                        final = handle.wait_result(timeout_s=30.0)
+                        # run's result normally lands moments later.  The
+                        # wait is SHORT: the file is already complete on
+                        # disk, so a stalled finish phase (hung report
+                        # RPC) must not hold the client's response — the
+                        # telemetry fields just flag themselves pending.
+                        final = handle.wait_result(timeout_s=2.0)
                         out = {
-                            "ok": True,
+                            "ok": True,  # content served: file complete
                             "task_id": handle.task_id,
                             "pieces": handle.n_pieces,
                             "bytes": nbytes,
                             "back_to_source": bool(
                                 final.back_to_source if final else False
                             ),
+                            "result_pending": final is None,
                             "cost_s": _time.monotonic() - t0,
                             "output": output,
                         }

@@ -212,3 +212,42 @@ class TestFactory:
         nstorage = DaemonStorage(str(tmp_path / "n2"), prefer_native=True)
         srv2 = make_piece_server(UploadManager(nstorage), ssl_context=ctx)
         assert isinstance(srv2, PieceHTTPServer)
+
+    def test_bitmap_requests_exempt_from_serving_cap(self, tmp_path):
+        """Long-poll subscriptions parked on a busy seed must not consume
+        its piece-serving 503 slots (the data-plane cap)."""
+        import threading
+        import urllib.request
+
+        storage = DaemonStorage(str(tmp_path / "cap"), prefer_native=True)
+        task = "c" * 16
+        storage.register_task(task, piece_size=PIECE, content_length=4 * PIECE)
+        for n in range(2):
+            storage.write_piece(task, n, bytes(PIECE))
+        upload = UploadManager(storage)
+        server = NativePieceServer(upload, concurrent_limit=2)
+        try:
+            port = server.port
+            # Park MORE long-polls than the cap (have=4 never satisfied).
+            parked = []
+            for _ in range(4):
+                t = threading.Thread(
+                    target=lambda: urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/tasks/{task}/pieces"
+                        f"?have=4&wait_ms=3000", timeout=10,
+                    ).read(),
+                    daemon=True,
+                )
+                t.start()
+                parked.append(t)
+            import time
+
+            time.sleep(0.3)  # all four are parked now
+            # Piece serving still has its full budget.
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/pieces/{task}/0", timeout=5
+            ) as r:
+                assert r.status == 200
+        finally:
+            server.stop()
+            storage.close()
