@@ -52,7 +52,21 @@ class ClusterRecord:
     scopes: Dict[str, Any] = field(default_factory=dict)
 
 
-_KINDS = {"application": Application, "cluster": ClusterRecord}
+@dataclass
+class ConfigRecord:
+    """models/config.go row: a named operator key-value setting."""
+
+    id: str
+    name: str
+    value: str = ""
+    bio: str = ""
+
+
+_KINDS = {
+    "application": Application,
+    "cluster": ClusterRecord,
+    "config": ConfigRecord,
+}
 
 # Row ids appear in URLs, sqlite keys, and the console DOM — keep them
 # boring.  (Client-supplied ids with quotes were an XSS vector through the
@@ -123,6 +137,18 @@ class CrudStore:
         cls = _KINDS[kind]
         if kind == "cluster":
             _validate_cluster_blobs(fields)
+        if kind == "config":
+            # models/config.go declares name UNIQUE — a duplicate-named
+            # setting would resolve ambiguously by consumer ordering.
+            name = fields.get("name")
+            if not name:
+                raise ValueError("config name required")
+            with self._mu:
+                if any(
+                    r.get("name") == name
+                    for r in self._rows["config"].values()
+                ):
+                    raise ValueError(f"config {name!r} already exists")
         with self._mu:
             # str-coerce BEFORE storing: a JSON-integer id would otherwise
             # live under an int key the string-keyed REST routes miss.

@@ -96,6 +96,16 @@ def spec() -> dict:
         "/api/v1/applications/{id}:delete": {
             "post": _op("Delete", roles="OPERATOR"),
         },
+        "/api/v1/configs": {
+            "get": _op("List named config rows"),
+            "post": _op("Create a config (name unique)",
+                        body={"name": STR, "value": STR, "bio": STR},
+                        roles="OPERATOR"),
+        },
+        "/api/v1/configs/{id}:update": {
+            "post": _op("Partial update", roles="OPERATOR"),
+        },
+        "/api/v1/configs/{id}:delete": {"post": _op("Delete", roles="OPERATOR")},
         "/api/v1/buckets": {
             "get": _op("List buckets (configured backend)"),
             "post": _op("Create a bucket", body={"name": STR},

@@ -345,6 +345,10 @@ class ManagerRESTServer:
                     self._json(
                         200, [asdict(a) for a in server.crud.list("application")]
                     )
+                elif path == "/api/v1/configs":
+                    from dataclasses import asdict
+
+                    self._json(200, [asdict(c) for c in server.crud.list("config")])
                 elif path == "/api/v1/clusters":
                     from dataclasses import asdict
 
@@ -442,6 +446,7 @@ class ManagerRESTServer:
                     path.startswith("/api/v1/applications")
                     or path.startswith("/api/v1/clusters")
                     or path.startswith("/api/v1/buckets")
+                    or path.startswith("/api/v1/configs")
                 ):
                     # CRUD mutations are operator console actions.
                     required = Role.OPERATOR
@@ -453,9 +458,13 @@ class ManagerRESTServer:
                 if path.startswith("/api/v1/jobs"):
                     self._job_routes(path)
                     return
-                if path.startswith("/api/v1/applications") or (
-                    path.startswith("/api/v1/clusters")
-                    and not path.startswith("/api/v1/clusters:")
+                if (
+                    path.startswith("/api/v1/applications")
+                    or path.startswith("/api/v1/configs")
+                    or (
+                        path.startswith("/api/v1/clusters")
+                        and not path.startswith("/api/v1/clusters:")
+                    )
                 ):
                     self._crud_routes(path)
                     return
@@ -580,11 +589,12 @@ class ManagerRESTServer:
                 (manager/handlers/application.go, scheduler_cluster.go)."""
                 from dataclasses import asdict
 
-                kind, base = (
-                    ("application", "/api/v1/applications")
-                    if path.startswith("/api/v1/applications")
-                    else ("cluster", "/api/v1/clusters")
-                )
+                if path.startswith("/api/v1/applications"):
+                    kind, base = "application", "/api/v1/applications"
+                elif path.startswith("/api/v1/configs"):
+                    kind, base = "config", "/api/v1/configs"
+                else:
+                    kind, base = "cluster", "/api/v1/clusters"
                 try:
                     if path == base:
                         obj = server.crud.create(kind, **self._body())

@@ -357,3 +357,61 @@ class TestManagerRateLimit:
             assert RATE_LIMITED_TOTAL.value(transport="manager-rest") >= 1
         finally:
             server.stop()
+
+
+class TestConfigCrud:
+    def test_config_rows_roundtrip(self, tmp_path):
+        """handlers/config.go parity: named operator key-value rows with
+        sqlite persistence."""
+        import json
+        import urllib.request
+
+        from dragonfly2_tpu.manager.crud import CrudStore
+        from dragonfly2_tpu.manager.rest import ManagerRESTServer
+
+        def call(base, method, path, body=None):
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(
+                base + path, data=data,
+                headers={"Content-Type": "application/json"}, method=method,
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return json.loads(resp.read() or b"{}")
+
+        db = str(tmp_path / "crud.db")
+        server = ManagerRESTServer(
+            ModelRegistry(), ClusterManager(), crud=CrudStore(db)
+        )
+        server.serve()
+        try:
+            row = call(server.url, "POST", "/api/v1/configs",
+                       {"name": "gc.interval", "value": "60", "bio": "ops"})
+            assert row["name"] == "gc.interval"
+            call(server.url, "POST", f"/api/v1/configs/{row['id']}:update",
+                 {"value": "120"})
+            got = call(server.url, "GET", "/api/v1/configs")
+            assert [(c["name"], c["value"]) for c in got] == [("gc.interval", "120")]
+        finally:
+            server.stop()
+        # Durable across restarts.
+        server2 = ManagerRESTServer(
+            ModelRegistry(), ClusterManager(), crud=CrudStore(db)
+        )
+        server2.serve()
+        try:
+            got = call(server2.url, "GET", "/api/v1/configs")
+            assert got[0]["value"] == "120"
+            call(server2.url, "POST", f"/api/v1/configs/{got[0]['id']}:delete", {})
+            assert call(server2.url, "GET", "/api/v1/configs") == []
+        finally:
+            server2.stop()
+
+    def test_config_name_unique(self):
+        from dragonfly2_tpu.manager.crud import CrudStore
+
+        store = CrudStore()
+        store.create("config", name="x", value="1")
+        with pytest.raises(ValueError):
+            store.create("config", name="x", value="2")
+        with pytest.raises(ValueError):
+            store.create("config", value="no-name")

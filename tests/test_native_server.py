@@ -229,15 +229,18 @@ class TestFactory:
         try:
             port = server.port
             # Park MORE long-polls than the cap (have=4 never satisfied).
-            parked = []
-            for _ in range(4):
-                t = threading.Thread(
-                    target=lambda: urllib.request.urlopen(
+            def park():
+                try:
+                    urllib.request.urlopen(
                         f"http://127.0.0.1:{port}/tasks/{task}/pieces"
                         f"?have=4&wait_ms=3000", timeout=10,
-                    ).read(),
-                    daemon=True,
-                )
+                    ).read()
+                except OSError:
+                    pass  # server shutdown cuts parked polls — expected
+
+            parked = []
+            for _ in range(4):
+                t = threading.Thread(target=park, daemon=True)
                 t.start()
                 parked.append(t)
             import time
