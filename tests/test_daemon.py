@@ -536,6 +536,18 @@ class TestPeerEngine:
         for i in (1, 2):  # 3 serveable parents total
             assert swarm.daemons[i].download(url, piece_size=PIECE).ok
 
+        # The in-process fixture's piece costs are microseconds, so ONE
+        # noisy fetch under full-suite load (GC pause, CPU contention)
+        # trips the 20x-mean bad-node outlier rule on the seed parents
+        # (evaluator.is_bad_node) and the scheduler hands the child a
+        # single candidate — observed as {'host-0': 12} fan-in.  This
+        # test proves the WORKER POOL fans out; bad-node filtering has
+        # its own tests.  Level the stats so the candidate set is
+        # deterministically all three parents.
+        for p in swarm.resource.peer_manager.items():
+            with p._mu:
+                p.piece_costs_ns.clear()
+
         child = swarm.daemons[4]
         inner = child.conductor.piece_fetcher
         served_by = {}
