@@ -62,24 +62,40 @@ def precompute_hop_features(
     at the call site when running per-epoch resampled tables.
     """
     x = jnp.asarray(node_feats, jnp.float32)
-    m = table.mask[..., None]                             # [N, K, 1]
-    denom = jnp.maximum(m.sum(axis=1), 1.0)               # [N, 1]
+    return _hop_parts(
+        x,
+        table.mask,
+        table.edge_feats,
+        lambda h: jnp.take(h, table.indices, axis=0),
+        hops,
+    )
+
+
+def _hop_parts(x, mask, edge_feats, gather, hops: int) -> jax.Array:
+    """THE hop-aggregation math, shared between the replicated precompute
+    and the node-sharded one (parallel/graph_sharding.py) so the two stay
+    numerically identical by construction.  ``gather(h) → [rows, K, D]``
+    supplies each row's neighbor representations — a plain global take
+    here, a halo-exchange gather in the sharded body.
+    """
+    m = mask.astype(jnp.float32)[..., None]               # [rows, K, 1]
+    denom = jnp.maximum(m.sum(axis=1), 1.0)               # [rows, 1]
     # Inverse-RTT weights from the first edge-feature column (normalized
     # RTT at table build): nearer probes describe the node better.
-    rtt = table.edge_feats[..., :1]                       # [N, K, 1]
+    rtt = edge_feats[..., :1].astype(jnp.float32)         # [rows, K, 1]
     w = m / (1.0 + jnp.maximum(rtt, 0.0))
     w_denom = jnp.maximum(w.sum(axis=1), 1e-6)
 
     parts = [x]
     h = x
     for _ in range(hops):
-        nbr = jnp.take(h, table.indices, axis=0)          # [N, K, D]
+        nbr = gather(h)                                   # [rows, K, D]
         mean_agg = (nbr * m).sum(axis=1) / denom
         wmean_agg = (nbr * w).sum(axis=1) / w_denom
         h = mean_agg
         parts.extend([mean_agg, wmean_agg])
-    deg = m.sum(axis=1) / m.shape[1]                      # [N, 1] norm degree
-    mean_rtt = (rtt * m).sum(axis=1) / denom              # [N, 1]
+    deg = m.sum(axis=1) / m.shape[1]                      # [rows, 1] norm degree
+    mean_rtt = (rtt * m).sum(axis=1) / denom              # [rows, 1]
     parts.extend([deg, mean_rtt])
     return jnp.concatenate(parts, axis=-1)
 

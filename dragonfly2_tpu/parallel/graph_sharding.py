@@ -21,6 +21,8 @@ boundary node set per shard.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import shard_map
@@ -127,6 +129,19 @@ def _table_digest(table: NeighborTable) -> str:
     return hashlib.sha1(np.asarray(table.indices).tobytes()).hexdigest()[:16]
 
 
+def _check_plan(plan: "HaloPlan", table: NeighborTable) -> None:
+    """Refuse a plan built for a different table sampling.  Under jit the
+    indices are tracers (no concrete bytes to hash) — the caller owns
+    plan/table pairing there; the eager path stays guarded."""
+    if not plan.table_digest or isinstance(table.indices, jax.core.Tracer):
+        return
+    if plan.table_digest != _table_digest(table):
+        raise ValueError(
+            "HaloPlan was built for a different table sampling — rebuild "
+            "the plan whenever build_neighbor_table resamples (per epoch)"
+        )
+
+
 def build_halo_plan(table: NeighborTable, mesh: Mesh, *, axis: str = DATA_AXIS) -> HaloPlan:
     import numpy as np
 
@@ -138,13 +153,17 @@ def build_halo_plan(table: NeighborTable, mesh: Mesh, *, axis: str = DATA_AXIS) 
     S = N // n
 
     # needed[j][i]: sorted unique global rows shard j needs from shard i.
+    # uniq is sorted, so each source shard's rows are one contiguous
+    # searchsorted slice — no per-element Python (O(N·K) total, numpy).
     needed = [[None] * n for _ in range(n)]
     halo = 0
+    bounds = np.arange(n + 1, dtype=np.int64) * S
     for j in range(n):
         block = indices[j * S : (j + 1) * S]
         uniq = np.unique(block)
+        cuts = np.searchsorted(uniq, bounds)
         for i in range(n):
-            rows = uniq[(uniq >= i * S) & (uniq < (i + 1) * S)]
+            rows = uniq[cuts[i] : cuts[i + 1]]
             if i == j:
                 rows = rows[:0]  # own rows need no exchange
             needed[j][i] = rows
@@ -153,23 +172,102 @@ def build_halo_plan(table: NeighborTable, mesh: Mesh, *, axis: str = DATA_AXIS) 
 
     # send_idx[i][j]: local offsets shard i ships to shard j (pad with 0).
     send_idx = np.zeros((n, n, halo), dtype=np.int32)
-    # position map for remapping: global id → local slot on shard j.
-    local_idx = np.empty_like(indices)
+    # slot[g] = shard j's local slot for global id g; only ids that occur
+    # in shard j's block are ever read, so stale entries are harmless.
+    local_idx = np.empty_like(indices, dtype=np.int32)
+    slot = np.empty(N, dtype=np.int32)
     for j in range(n):
-        remap = {}
-        for p in range(S):
-            remap[j * S + p] = p
+        slot[j * S : (j + 1) * S] = np.arange(S, dtype=np.int32)
         for i in range(n):
             rows = needed[j][i]
             send_idx[i, j, : len(rows)] = rows - i * S
-            for p, g in enumerate(rows):
-                remap[int(g)] = S + i * halo + p
-        block = indices[j * S : (j + 1) * S]
-        flat = np.array([remap[int(g)] for g in block.ravel()], dtype=np.int32)
-        local_idx[j * S : (j + 1) * S] = flat.reshape(S, K)
+            slot[rows] = S + i * halo + np.arange(len(rows), dtype=np.int32)
+        local_idx[j * S : (j + 1) * S] = slot[indices[j * S : (j + 1) * S]]
     return HaloPlan(
         n, S, jnp.asarray(send_idx), jnp.asarray(local_idx), halo,
         table_digest=_table_digest(table),
+    )
+
+
+def _halo_assemble(h_block, my_send_idx, axis: str) -> jax.Array:
+    """Inside a shard_map body: exchange boundary rows and return the
+    shard's LOCAL node table ``[S + n·H, D]`` (own rows first, then halo
+    slots laid out as ``S + src_shard·H + p`` — the order
+    ``build_halo_plan`` remapped ``local_idx`` against)."""
+    send = jnp.take(h_block, my_send_idx[0], axis=0)        # [n, H, D]
+    recv = jax.lax.all_to_all(
+        send, axis, split_axis=0, concat_axis=0, tiled=False
+    )
+    # recv [n, H, D]: slice i = rows shipped by shard i to this shard.
+    return jnp.concatenate(
+        [h_block, recv.reshape(-1, h_block.shape[-1])], axis=0
+    )
+
+
+@partial(jax.jit, static_argnames=("mesh", "hops", "axis"))
+def _sharded_precompute_impl(
+    node_feats, mask, edge_feats, send_idx, local_idx, *, mesh, hops, axis
+):
+    from ..models.hop import _hop_parts
+
+    def body(x_block, my_send_idx, li, m, ef):
+        # Per hop the aggregate keeps D, so ONE plan serves every hop's
+        # exchange; the math itself is models.hop._hop_parts — shared
+        # with the replicated oracle so the two cannot drift.
+        return _hop_parts(
+            x_block.astype(jnp.float32),
+            m,
+            ef,
+            lambda h: jnp.take(_halo_assemble(h, my_send_idx, axis), li, axis=0),
+            hops,
+        )
+
+    sharded = P(axis)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(sharded, sharded, sharded, sharded, sharded),
+        out_specs=sharded,
+    )(node_feats, send_idx, local_idx, mask, edge_feats)
+
+
+def precompute_hop_features_sharded(
+    mesh: Mesh,
+    node_feats: jax.Array,
+    table: NeighborTable,
+    plan: HaloPlan,
+    *,
+    hops: int = 2,
+    axis: str = DATA_AXIS,
+) -> jax.Array:
+    """Node-sharded ``models.hop.precompute_hop_features``.
+
+    The replicated precompute holds the FULL [N, F] feature table (and a
+    [N, K, D] gather) on every chip — at config[4]'s multi-M-node scale
+    that table, not the model, is the memory wall.  Here every chip owns
+    S = N/n node rows; per hop the only cross-chip traffic is the halo
+    all-to-all of [n·H, D] boundary rows (H = max off-shard rows any
+    shard references), after which the gather + both masked means are
+    device-local.  Per-chip working set drops from N·D to (S + n·H)·D
+    and the output stays sharded P(axis) — it feeds straight into
+    ``node_sharding="model"`` training without a host round-trip.
+
+    Jits internally (one fused program; cached on mesh/hops/axis) so
+    eager callers get the same footprint the bench measures.  Numerically
+    identical to the replicated oracle — the hop math IS the oracle's
+    (models.hop._hop_parts); verified in dryrun_multichip and
+    tests/test_ops.py.
+    """
+    _check_plan(plan, table)
+    return _sharded_precompute_impl(
+        node_feats,
+        table.mask,
+        table.edge_feats,
+        plan.send_idx,
+        plan.local_idx,
+        mesh=mesh,
+        hops=hops,
+        axis=axis,
     )
 
 
@@ -187,21 +285,12 @@ def halo_neighbor_aggregate(
     all-gather — with a locality-aware partition H ≪ S and the collective
     traffic drops by ~S/H.  Numerically identical to the full exchange.
     """
-    if plan.table_digest and plan.table_digest != _table_digest(table):
-        raise ValueError(
-            "HaloPlan was built for a different table sampling — rebuild "
-            "the plan whenever build_neighbor_table resamples (per epoch)"
-        )
+    _check_plan(plan, table)
 
     def body(h_block, my_send_idx, local_idx, mask, edge_feats):
         # h_block [S, D]; my_send_idx [1, n, H] (this device's row of the
-        # plan); gather outgoing halo rows and all-to-all them.
-        send = jnp.take(h_block, my_send_idx[0], axis=0)        # [n, H, D]
-        recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0, tiled=False)
-        # recv [n, H, D]: slice i = rows shipped by shard i to this shard.
-        local = jnp.concatenate(
-            [h_block, recv.reshape(-1, h_block.shape[-1])], axis=0
-        )                                                        # [S + n·H, D]
+        # plan); exchange boundary rows, then gather locally.
+        local = _halo_assemble(h_block, my_send_idx, axis)       # [S + n·H, D]
         nbr = jnp.take(local, local_idx, axis=0)                 # [S, K, D]
         nbr = jnp.concatenate([nbr, edge_feats.astype(nbr.dtype)], axis=-1)
         m = mask.astype(nbr.dtype)[..., None]

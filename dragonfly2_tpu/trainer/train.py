@@ -353,11 +353,33 @@ def train_hop_ranker(
     mcfg = model_config or HopConfig()
     mesh = mesh or create_mesh()
     if hop_feats is None:
-        hop_feats = np.asarray(
-            jax.jit(partial(precompute_hop_features, hops=mcfg.hops))(
-                jnp.asarray(node_feats, jnp.float32), table
+        if node_sharding == "model":
+            # config[4] scale mode: the [N, F] hop table is the memory
+            # wall, so the PRECOMPUTE itself runs node-sharded — per hop
+            # one halo all-to-all of boundary rows replaces the full-
+            # table gather, and the output lands already sharded
+            # P(model) for the train step (no host round-trip).
+            from ..parallel.graph_sharding import (
+                build_halo_plan,
+                precompute_hop_features_sharded,
             )
-        )
+            from ..parallel.mesh import MODEL_AXIS
+
+            plan = build_halo_plan(table, mesh, axis=MODEL_AXIS)
+            hop_feats = precompute_hop_features_sharded(
+                mesh,
+                jnp.asarray(node_feats, jnp.float32),
+                table,
+                plan,
+                hops=mcfg.hops,
+                axis=MODEL_AXIS,
+            )
+        else:
+            hop_feats = np.asarray(
+                jax.jit(partial(precompute_hop_features, hops=mcfg.hops))(
+                    jnp.asarray(node_feats, jnp.float32), table
+                )
+            )
     model = HopRanker(mcfg)
     return _train_graph_model(
         model, hop_feats, table, edge_src, edge_dst, edge_target,

@@ -339,6 +339,58 @@ class TestHaloExchange:
         got = halo_neighbor_aggregate(mesh, h_sharded, t_sharded, plan)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
 
+    def test_sharded_precompute_matches_oracle(self):
+        """precompute_hop_features_sharded (node-sharded, halo all-to-all
+        per hop) equals the replicated precompute — the flagship's
+        config[4] precompute path (VERDICT r3 weak-#4)."""
+        from dragonfly2_tpu.models.hop import precompute_hop_features
+        from dragonfly2_tpu.parallel.graph_sharding import (
+            build_halo_plan,
+            precompute_hop_features_sharded,
+        )
+
+        mesh = create_mesh()
+        n, k = 256, 8
+        shard = n // mesh.shape["data"]
+        rng = np.random.default_rng(11)
+        src, dst = self._local_graph(n, shard, rng, locality=0.8, n_edges=4000)
+        feats = rng.random(len(src)).astype(np.float32)
+        table = build_neighbor_table(n, src, dst, feats, max_neighbors=k)
+        nf = rng.normal(size=(n, 12)).astype(np.float32)
+
+        want = precompute_hop_features(jnp.asarray(nf), table, hops=2)
+        plan = build_halo_plan(table, mesh)
+        got = precompute_hop_features_sharded(
+            mesh, jnp.asarray(nf), table, plan, hops=2
+        )
+        assert got.sharding.spec == jax.sharding.PartitionSpec("data")
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
+
+    def test_sharded_precompute_rejects_stale_plan(self):
+        """A plan built for one table sampling must refuse a different
+        table (digest guard), like halo_neighbor_aggregate."""
+        import pytest
+
+        from dragonfly2_tpu.parallel.graph_sharding import (
+            build_halo_plan,
+            precompute_hop_features_sharded,
+        )
+
+        mesh = create_mesh()
+        n = 64
+        rng = np.random.default_rng(3)
+        src, dst = self._local_graph(n, n // mesh.shape["data"], rng, n_edges=500)
+        table = build_neighbor_table(n, src, dst, max_neighbors=4)
+        other = build_neighbor_table(
+            n, dst, src, max_neighbors=4
+        )  # different sampling
+        plan = build_halo_plan(table, mesh)
+        nf = jnp.asarray(rng.normal(size=(n, 4)).astype(np.float32))
+        with pytest.raises(ValueError, match="different table"):
+            precompute_hop_features_sharded(mesh, nf, other, plan)
+
     def test_halo_smaller_than_shard_with_locality(self):
         from dragonfly2_tpu.parallel.graph_sharding import build_halo_plan
 

@@ -1,0 +1,147 @@
+"""Sharded vs replicated hop-feature precompute (VERDICT r3 weak-#4 / next-#3).
+
+Measures, on an n-device mesh (CPU virtual mesh in the sandbox; the same
+program runs on a real TPU slice):
+
+- wall time: jit(precompute_hop_features) with the FULL table on every
+  device vs jit(precompute_hop_features_sharded) (node-sharded, halo
+  all-to-all per hop);
+- per-device working set: XLA memory_analysis (args + temps) for both
+  programs, plus the analytic table bytes (N rows replicated vs
+  S + n_shards*halo rows per shard);
+- the halo itself (H vs S) at each graph locality — the win is
+  locality-dependent, so both the locality-partitioned case (deployment
+  assumption: probes are rack/cluster-biased, SURVEY §5.7) and the
+  random worst case are reported.
+
+Usage:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/bench_sharded_precompute.py [--nodes 131072]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def local_graph(n, shard, rng, locality, k_edges):
+    """Graph where ~locality of edges stay within a node's shard."""
+    dst = rng.integers(0, n, k_edges)
+    local = rng.random(k_edges) < locality
+    shard_of = dst // shard
+    src_local = shard_of * shard + rng.integers(0, shard, k_edges)
+    src_any = rng.integers(0, n, k_edges)
+    src = np.where(local, src_local, src_any)
+    return src.astype(np.int64), dst.astype(np.int64)
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps, out
+
+
+def mem_analysis(jitted, *args):
+    try:
+        m = jitted.lower(*args).compile().memory_analysis()
+        return int(m.argument_size_in_bytes + m.temp_size_in_bytes)
+    except Exception:  # noqa: BLE001 — backend without the analysis
+        return -1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=131_072)
+    ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--k", type=int, default=16)
+    ap.add_argument("--hops", type=int, default=2)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    from dragonfly2_tpu.models import build_neighbor_table
+    from dragonfly2_tpu.models.hop import precompute_hop_features
+    from dragonfly2_tpu.parallel.graph_sharding import (
+        build_halo_plan,
+        precompute_hop_features_sharded,
+    )
+    from dragonfly2_tpu.parallel.mesh import MeshSpec, create_mesh
+
+    n_dev = len(jax.devices())
+    mesh = create_mesh(MeshSpec(data=n_dev, model=1))
+    n, d, k = args.nodes, args.dim, args.k
+    shard = n // n_dev
+    rng = np.random.default_rng(0)
+    nf = rng.normal(size=(n, d)).astype(np.float32)
+
+    rep_fn = jax.jit(lambda x, t: precompute_hop_features(x, t, hops=args.hops))
+    rows = []
+    for locality in (0.9, 0.0):
+        src, dst = local_graph(n, shard, rng, locality, n * 8)
+        feats = rng.random(len(src)).astype(np.float32)
+        table = build_neighbor_table(n, src, dst, feats, max_neighbors=k)
+
+        t_rep, want = timed(rep_fn, jnp.asarray(nf), table, reps=args.reps)
+        mem_rep = mem_analysis(rep_fn, jnp.asarray(nf), table)
+
+        t0 = time.perf_counter()
+        plan = build_halo_plan(table, mesh)
+        t_plan = time.perf_counter() - t0
+        sh_fn = jax.jit(
+            lambda x, t, p=plan: precompute_hop_features_sharded(
+                mesh, x, t, p, hops=args.hops
+            )
+        )
+        t_sh, got = timed(sh_fn, jnp.asarray(nf), table, reps=args.reps)
+        mem_sh = mem_analysis(sh_fn, jnp.asarray(nf), table)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+        )
+
+        # Analytic per-device node-table rows (the memory-wall term).
+        rows_rep = n
+        rows_sh = shard + n_dev * plan.halo
+        rows.append(
+            {
+                "locality": locality,
+                "halo": int(plan.halo),
+                "shard": int(shard),
+                "t_replicated_s": round(t_rep, 4),
+                "t_sharded_s": round(t_sh, 4),
+                "t_plan_build_s": round(t_plan, 4),
+                "mem_replicated_bytes": mem_rep,
+                "mem_sharded_bytes": mem_sh,
+                "table_rows_per_dev_replicated": rows_rep,
+                "table_rows_per_dev_sharded": rows_sh,
+                "table_rows_ratio": round(rows_sh / rows_rep, 4),
+            }
+        )
+        print(json.dumps(rows[-1]), flush=True)
+
+    print(
+        json.dumps(
+            {
+                "bench": "sharded_precompute",
+                "devices": n_dev,
+                "nodes": n,
+                "dim": d,
+                "k": k,
+                "hops": args.hops,
+                "results": rows,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
