@@ -111,9 +111,18 @@ class SyntheticCluster:
         ip = f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}"
         hostname = f"host-{i}"
         htype = "super" if self.host_type[i] == 1 else "normal"
+        # Identity never changes across drift() rebuilds — cache the hash
+        # (drift replay at soak scale would otherwise re-hash 100k ids
+        # per epoch).
+        if not hasattr(self, "_host_id_cache"):
+            self._host_id_cache = {}
+        hid = self._host_id_cache.get(i)
+        if hid is None:
+            hid = idgen.host_id_v2(ip, hostname, seed_peer=htype != "normal")
+            self._host_id_cache[i] = hid
         return LatentHost(
             index=i,
-            id=idgen.host_id_v2(ip, hostname, seed_peer=htype != "normal"),
+            id=hid,
             hostname=hostname,
             ip=ip,
             type=htype,
@@ -164,7 +173,16 @@ class SyntheticCluster:
     def rtt_ns(self, src: int, dst: int, noise: bool = True) -> float:
         return float(self._rtt_vec(np.array([src]), np.array([dst]), noise)[0])
 
-    def _rtt_vec(self, src: np.ndarray, dst: np.ndarray, noise: bool = True) -> np.ndarray:
+    def _rtt_vec(
+        self,
+        src: np.ndarray,
+        dst: np.ndarray,
+        noise: bool = True,
+        rng: Optional[np.random.Generator] = None,
+    ) -> np.ndarray:
+        """``rng`` overrides the shared generator for the jitter, like
+        ``_bandwidth_vec`` — position-deterministic topology streams (the
+        online soak's resumable probe feed) need it."""
         base = np.where(
             self.idc[src] == self.idc[dst],
             0.3e6,  # 0.3 ms intra-idc
@@ -173,7 +191,7 @@ class SyntheticCluster:
         base = base * (1.0 + (self.zone[src] != self.zone[dst]) * 0.5)
         base = base + 0.5e6 * self.cpu_load[dst]
         if noise:
-            base = base * np.exp(self.rng.normal(0.0, 0.08, base.shape))
+            base = base * np.exp((rng or self.rng).normal(0.0, 0.08, base.shape))
         return base
 
     # -- record-level generation --------------------------------------------
@@ -302,6 +320,40 @@ class SyntheticCluster:
 
     def generate_topology_records(self, n: int) -> List[NetworkTopologyRecord]:
         return [self.generate_topology_record() for _ in range(n)]
+
+    def drift(self, rng: np.random.Generator) -> None:
+        """Evolve the cluster's LOAD state in place (the online-trainer
+        story, BASELINE configs[5]): concurrent uploads churn, CPU/mem
+        load random-walks, upload tallies grow.  Ground-truth bandwidth
+        and RTT both depend on these, so after a drift the topology a
+        model was trained on is STALE — the mid-training snapshot
+        refresh exists to chase exactly this.  Capacities and placement
+        (idc/region/zone) stay fixed: machines don't move racks.
+
+        Takes an explicit rng so a position-seeded caller (the resumable
+        1B soak) replays the identical drift sequence.
+        """
+        n = self.num_hosts
+        self.concurrent_uploads = np.clip(
+            self.concurrent_uploads + rng.integers(-6, 7, n), 0, 60
+        )
+        self.cpu_load = np.clip(
+            self.cpu_load + rng.normal(0.0, 0.12, n), 0.0, 1.0
+        )
+        self.mem_load = np.clip(
+            self.mem_load + rng.normal(0.0, 0.08, n), 0.0, 1.0
+        )
+        grown = rng.integers(0, 50, n)
+        self.upload_count = self.upload_count + grown
+        self.upload_failed = self.upload_failed + (
+            grown * np.clip(rng.beta(1, 12, n), 0, 1)
+        ).astype(np.int64)
+        self.upload_conns = np.clip(
+            self.upload_conns + rng.integers(-4, 5, n), 0, 120
+        )
+        # Record-level views (host_record / hosts[i]) must see the same
+        # drifted state as the vectorized path.
+        self.hosts = [self._make_host(i) for i in range(n)]
 
     # -- vectorized generation (bench scale) ---------------------------------
 

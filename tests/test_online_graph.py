@@ -1,0 +1,212 @@
+"""Online graph trainer (BASELINE configs[5]): two-stream ingest,
+mid-training snapshot refresh, byte-identical resume across a refresh
+boundary (trainer/online_graph.py; reference stream demux
+trainer/service/service_v1.go:128-143)."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.models.hop import HopConfig
+from dragonfly2_tpu.records.synthetic import SyntheticCluster
+from dragonfly2_tpu.trainer.online_graph import (
+    OnlineGraphConfig,
+    OnlineGraphTrainer,
+    state_hash,
+)
+from dragonfly2_tpu.trainer.train import TrainConfig
+
+N_NODES = 128
+
+
+def _mk_cluster(seed=0):
+    return SyntheticCluster(num_hosts=N_NODES, seed=seed)
+
+
+def _topo(cluster, seed):
+    rng = np.random.default_rng(seed)
+    n = N_NODES * 8
+    src = rng.integers(0, N_NODES, n)
+    dst = rng.integers(0, N_NODES, n)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    # Deterministic rtt (no shared-rng draw) for replayable streams.
+    return src, dst, (cluster._rtt_vec(src, dst, noise=False) / 1e9).astype(
+        np.float32
+    )
+
+
+def _downloads(cluster, seed, n):
+    rng = np.random.default_rng(seed)
+    es = rng.integers(0, N_NODES, n).astype(np.int32)
+    ed = (es + rng.integers(1, N_NODES, n).astype(np.int32)) % N_NODES
+    y = np.log1p(cluster._bandwidth_vec(es, ed, rng=rng)).astype(np.float32)
+    return es, ed, y
+
+
+def _mk_trainer(cluster, tmp_path=None, **cfg_kw):
+    cfg = OnlineGraphConfig(
+        num_nodes=N_NODES,
+        max_neighbors=8,
+        batch_size=256,
+        super_steps=4,
+        queue_capacity=16,  # tests feed the whole stream before run()
+        model=HopConfig(hidden=16, out_dim=8, node_embed_dim=4, dropout=0.1),
+        train=TrainConfig(warmup_steps=2),
+        total_steps_hint=1000,
+        **cfg_kw,
+    )
+    src, dst, rtt = _topo(cluster, seed=1)
+    return OnlineGraphTrainer(
+        cfg,
+        node_feats=cluster._host_feature_matrix(),
+        topo_src=src, topo_dst=dst, topo_rtt=rtt,
+        checkpoint_dir=str(tmp_path) if tmp_path else None,
+    )
+
+
+def _state_hash(trainer) -> str:
+    return state_hash(trainer.state)
+
+
+class TestSnapshotRefresh:
+    def test_swap_changes_graph_not_optimizer(self):
+        import jax
+
+        cluster = _mk_cluster()
+        tr = _mk_trainer(cluster)
+        es, ed, y = _downloads(cluster, 2, 4 * 256 * 2)
+        tr.feed_downloads(es, ed, y)
+        assert tr.run(max_dispatches=2, idle_timeout=0.1) == 2
+        compiles_before = tr._dispatch_fn._cache_size()
+        step_before = int(tr.state.step)
+        params_before = jax.tree_util.tree_map(np.asarray, tr.state.params)
+        digest_before = tr.snapshot_digest()
+
+        # New topology (drifted load) → refresh swaps the hop tables only.
+        cluster.drift(np.random.default_rng(7))
+        tr.set_node_features(cluster._host_feature_matrix())
+        src, dst, rtt = _topo(cluster, seed=9)
+        tr.feed_topology(src, dst, rtt)
+        assert tr.refresh_snapshot() is not None
+        assert tr.snapshot_digest() != digest_before
+        assert tr.snapshot_idx == 1
+        assert int(tr.state.step) == step_before  # optimizer untouched
+        for a, b in zip(
+            jax.tree_util.tree_leaves(params_before),
+            jax.tree_util.tree_leaves(tr.state.params),
+        ):
+            np.testing.assert_array_equal(a, np.asarray(b))
+
+        # Training continues on the new snapshot with the SAME compiled
+        # program (hop tables are arguments, shapes static).
+        tr.feed_downloads(*_downloads(cluster, 3, 4 * 256))
+        assert tr.run(max_dispatches=1, idle_timeout=0.1) == 1
+        assert int(tr.state.step) == step_before + 4
+        assert compiles_before == 1, "steady-state dispatch recompiled"
+        assert tr._dispatch_fn._cache_size() == compiles_before, (
+            "snapshot swap recompiled"
+        )
+
+    def test_refresh_with_no_new_topology_keeps_old_graph(self):
+        """The bootstrap feed belongs to snapshot 0 — with no probes since,
+        a refresh keeps serving the old graph instead of paying a rebuild
+        for an identical one."""
+        cluster = _mk_cluster()
+        tr = _mk_trainer(cluster, topo_window=100)
+        digest = tr.snapshot_digest()
+        assert tr.refresh_snapshot() is None
+        assert tr.snapshot_digest() == digest
+        assert tr.snapshot_idx == 0
+        # New probes arrive → the next refresh swaps.
+        tr.feed_topology(*_topo(cluster, seed=77))
+        assert tr.refresh_snapshot() is not None
+        assert tr.snapshot_idx == 1
+
+    def test_topology_window_trims_oldest(self):
+        cluster = _mk_cluster()
+        tr = _mk_trainer(cluster, topo_window=500)
+        for seed in range(5):
+            src, dst, rtt = _topo(cluster, seed=seed)
+            tr.feed_topology(src, dst, rtt)
+        src, dst, rtt = tr._drain_window()
+        assert len(src) <= 500
+        # The window holds the MOST RECENT edges (tail of the last feed).
+        last_src, _, _ = _topo(cluster, seed=4)
+        np.testing.assert_array_equal(src[-len(last_src):], last_src[-len(src):])
+
+
+class TestResumeAcrossRefresh:
+    def test_byte_identical_resume_across_refresh_boundary(self, tmp_path):
+        """Kill after a swap, resume, continue → same bytes as the
+        uninterrupted run (the r3 soak's proof, now with a mid-stream
+        graph swap in the window)."""
+        def feed_all(tr, cluster):
+            # Deterministic two-stream schedule: topology for snapshot 1
+            # arrives before dispatch 2's refresh.
+            src, dst, rtt = _topo(cluster, seed=100)
+            tr.feed_topology(src, dst, rtt)
+            for d in range(4):
+                tr.feed_downloads(*_downloads(cluster, 50 + d, 4 * 256))
+
+        # Run A: uninterrupted, refresh every 2 dispatches.
+        ca = _mk_cluster()
+        a = _mk_trainer(ca, tmp_path / "a", refresh_every=2)
+        feed_all(a, ca)
+        assert a.run(max_dispatches=4, idle_timeout=0.1) == 4
+        assert a.snapshot_idx >= 1
+
+        # Run B: same stream, checkpoint at dispatch 3 (PAST the refresh
+        # at 2), then a fresh process resumes and finishes.
+        cb = _mk_cluster()
+        b = _mk_trainer(cb, tmp_path / "b", refresh_every=2)
+        feed_all(b, cb)
+        assert b.run(max_dispatches=3, idle_timeout=0.1) == 3
+        assert b.snapshot_idx >= 1  # the boundary is behind the checkpoint
+        b.checkpoint()
+        del b
+
+        cc = _mk_cluster()
+        c = _mk_trainer(cc, tmp_path / "b", refresh_every=2)
+        assert c.resume()
+        assert c.dispatch == 3 and c.snapshot_idx >= 1
+        # Rebuilt snapshot must equal run A's post-refresh snapshot.
+        assert c.snapshot_digest() == a.snapshot_digest()
+        c.feed_downloads(*_downloads(cc, 53, 4 * 256))
+        assert c.run(max_dispatches=1, idle_timeout=0.1) == 1
+        assert _state_hash(c) == _state_hash(a)
+
+    def test_resume_without_checkpoint_returns_false(self, tmp_path):
+        cluster = _mk_cluster()
+        tr = _mk_trainer(cluster, tmp_path / "none")
+        assert not tr.resume()
+
+
+class TestOnlineQuality:
+    def test_refresh_tracks_drift_better_than_stale(self):
+        """After load drift, FRESH hop features beat STALE ones on new
+        downloads — the evidence that the mid-training refresh loop
+        matters (configs[5]'s defining property)."""
+        cluster = _mk_cluster()
+        tr = _mk_trainer(cluster)
+        # Train a while on the initial graph.
+        for d in range(6):
+            tr.feed_downloads(*_downloads(cluster, 200 + d, 4 * 256))
+        assert tr.run(max_dispatches=6, idle_timeout=0.1) == 6
+
+        # Drift the cluster hard (several epochs of load churn).
+        rng = np.random.default_rng(42)
+        for _ in range(5):
+            cluster.drift(rng)
+
+        v_es, v_ed, v_y = _downloads(cluster, 999, 2048)
+        stale = tr.eval_mae(v_es, v_ed, v_y)
+
+        tr.set_node_features(cluster._host_feature_matrix())
+        tr.feed_topology(*_topo(cluster, seed=300))
+        tr.refresh_snapshot()
+        # Adapt briefly on post-drift downloads, then eval fresh.
+        for d in range(4):
+            tr.feed_downloads(*_downloads(cluster, 400 + d, 4 * 256))
+        tr.run(max_dispatches=4, idle_timeout=0.1)
+        fresh = tr.eval_mae(v_es, v_ed, v_y)
+        assert fresh < stale, (fresh, stale)

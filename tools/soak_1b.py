@@ -29,7 +29,6 @@ invocations):
 from __future__ import annotations
 
 import argparse
-import hashlib
 import json
 import os
 import queue
@@ -41,15 +40,6 @@ import numpy as np
 
 BATCH = 131_072
 SUPER = 64  # steps per dispatch: 8.39M records ride each relay transfer
-
-
-def _state_hash(jax, np, state) -> str:
-    h = hashlib.sha256()
-    for leaf in jax.tree_util.tree_leaves(
-        {"params": state.params, "opt": state.opt_state}
-    ):
-        h.update(np.asarray(leaf).tobytes())
-    return h.hexdigest()
 
 
 def main() -> int:
@@ -85,6 +75,7 @@ def main() -> int:
         precompute_hop_features,
     )
     from dragonfly2_tpu.records.synthetic import SyntheticCluster
+    from dragonfly2_tpu.trainer.online_graph import state_hash
     from dragonfly2_tpu.trainer.train import (
         TrainConfig,
         TrainState,
@@ -213,7 +204,7 @@ def main() -> int:
               f"(step {int(state.step)})", flush=True)
         if args.hash_restored:
             with open(args.hash_restored, "w") as f:
-                f.write(_state_hash(jax, np, state) + "\n")
+                f.write(state_hash(state) + "\n")
             print("soak: restored-state hash written; exiting", flush=True)
             return 0
 
@@ -261,7 +252,7 @@ def main() -> int:
                 save(d + 1)
             if args.hash_out:
                 with open(args.hash_out + ".at_kill", "w") as f:
-                    f.write(_state_hash(jax, np, state) + "\n")
+                    f.write(state_hash(state) + "\n")
             print(f"soak: KILLING after dispatch {d + 1} "
                   f"(checkpoint written)", flush=True)
             os._exit(137)
@@ -272,7 +263,7 @@ def main() -> int:
     records_done = (n_dispatch_total - start_dispatch) * SUPER * BATCH
 
     if args.hash_out:
-        digest = _state_hash(jax, np, state)
+        digest = state_hash(state)
         with open(args.hash_out, "w") as f:
             f.write(digest + "\n")
         print(f"soak: state sha256 {digest[:16]}…", flush=True)
