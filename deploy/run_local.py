@@ -4,6 +4,8 @@ without a docker daemon; the container path is deploy/docker-compose.yaml
 with the SAME services and the SAME deploy/e2e_loop.py).
 
   python deploy/run_local.py          # exit 0 = cluster up + loop passed
+  python deploy/run_local.py --mtls   # same, with auto-issued mTLS on the
+                                      # piece plane (manager-hosted CA)
 """
 
 from __future__ import annotations
@@ -23,6 +25,7 @@ PIECE = 64 * 1024
 
 
 def main() -> int:
+    mtls = "--mtls" in sys.argv[1:]
     tmp = tempfile.mkdtemp(prefix="df-local-")
     # Hermetic JAX: the harness only needs CPU (the trainer's TPU path is
     # exercised by bench.py / the driver); inheriting an ambient
@@ -87,6 +90,7 @@ def main() -> int:
         mcfg = write("manager.yaml", (
             "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
             f"registry: {{blob_dir: {tmp}/manager}}\n"
+            + (f"ca_dir: {tmp}/ca\n" if mtls else "")
         ))
         mout = spawn("manager", ["dragonfly2_tpu.cli.manager", "--config", mcfg],
                      ["manager: serving"])
@@ -111,6 +115,7 @@ def main() -> int:
             f"manager_addr: {manager_url}\n"
             "dynconfig_refresh_s: 5.0\n"
             "topology_sync_interval_s: 10.0\n"
+            + ("security: {auto_issue: true}\n" if mtls else "")
         ))
         sout = spawn("scheduler",
                      ["dragonfly2_tpu.cli.scheduler", "--config", scfg],
@@ -118,10 +123,18 @@ def main() -> int:
         scheduler_url = re.search(r"rpc on (\S+?),",
                                   sout["scheduler: serving"] + ",").group(1)
 
+        # Auto-issued mTLS: every daemon bootstraps its identity from the
+        # manager's cluster CA at boot; the piece plane then moves bytes
+        # over mutual TLS end to end (certify analog).
+        mtls_yaml = (
+            f"manager_addr: {manager_url}\nsecurity: {{auto_issue: true}}\n"
+            if mtls else ""
+        )
         seedcfg = write("seed.yaml", (
             "server: {host: 127.0.0.1, port: 0, advertise_ip: 127.0.0.1}\n"
             f"storage: {{dir: {tmp}/seed}}\n"
             f"piece_size: {PIECE}\n"
+            + mtls_yaml
         ))
         spawn("seed",
               ["dragonfly2_tpu.cli.dfdaemon", "--scheduler", scheduler_url,
@@ -135,6 +148,7 @@ def main() -> int:
                 "server: {host: 127.0.0.1, port: 0, advertise_ip: 127.0.0.1}\n"
                 f"storage: {{dir: {tmp}/{name}}}\n"
                 f"piece_size: {PIECE}\n"
+                + mtls_yaml
             ))
             dout = spawn(name,
                          ["dragonfly2_tpu.cli.dfdaemon", "--scheduler",

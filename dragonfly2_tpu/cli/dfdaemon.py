@@ -34,28 +34,66 @@ def build(cfg: DaemonConfig, scheduler_url: str):
         configure_sources(cfg.source)
     storage = DaemonStorage(cfg.storage.dir, quota_bytes=cfg.storage.quota_bytes)
     upload = UploadManager(storage, concurrent_limit=cfg.concurrent_upload_limit)
-    # Native-engine stores serve pieces from the C++ server (sendfile hot
-    # path); Python HTTP remains the fallback/TLS server.
-    from ..rpc.piece_transport import make_piece_server
-
-    piece_server = make_piece_server(
-        upload, host=cfg.server.host,
-    )
-    piece_server.serve()
 
     hostname = socket.gethostname()
     from ..utils.hostinfo import local_ip
 
     # Advertise a routable address — peers on OTHER machines dial it.
     ip = cfg.server.advertise_ip or local_ip()
+
+    # Auto-issued mTLS (certify analog, scheduler.go:186-222): request
+    # this daemon's identity from the manager's cluster CA at boot; the
+    # piece plane then serves AND fetches over mutual TLS.
+    identity = None
+    serve_ssl = fetch_ssl = None
+    if cfg.security.auto_issue:
+        if not cfg.manager_addr:
+            raise SystemExit("dfdaemon: security.auto_issue needs manager_addr")
+        from ..security.ca import PeerIdentity
+        from ..security.tls import client_context, server_context
+
+        identity = PeerIdentity.request_from_manager(
+            cfg.manager_addr,
+            common_name=f"daemon-{hostname}",
+            hostnames=[hostname],
+            ips=[ip],
+            token=cfg.manager_token or None,
+            ttl_hours=cfg.security.cert_ttl_hours,
+        )
+        if cfg.security.identity_dir:
+            identity.write(cfg.security.identity_dir)
+        serve_ssl = server_context(identity)
+        fetch_ssl = client_context(identity)
+
+    # Native-engine stores serve pieces from the C++ server (sendfile hot
+    # path); Python HTTP remains the fallback/TLS server.
+    from ..rpc.piece_transport import make_piece_server
+
+    piece_server = make_piece_server(
+        upload, host=cfg.server.host, ssl_context=serve_ssl,
+    )
+    piece_server.serve()
     if scheduler_url.startswith("grpc://"):
         # Streaming variant: per-peer calls ride the bidi announce_peer
         # stream so the scheduler can push mid-download reschedules
         # (unary fallback built in on stream failure).
         from ..rpc.grpc_transport import GRPCStreamingScheduler
 
+        channel_creds = None
+        if identity is not None and cfg.security.scheduler_grpc_tls:
+            # The scheduler's gRPC port runs mTLS when the cluster
+            # auto-issues — dial with this daemon's issued identity.
+            # (security.scheduler_grpc_tls: false covers mixed clusters
+            # whose scheduler port is still plaintext.)
+            import grpc as _grpc
+
+            channel_creds = _grpc.ssl_channel_credentials(
+                root_certificates=identity.ca_pem,
+                private_key=identity.key_pem,
+                certificate_chain=identity.cert_pem,
+            )
         scheduler_client_cls = lambda url: GRPCStreamingScheduler(  # noqa: E731
-            url[len("grpc://"):]
+            url[len("grpc://"):], channel_credentials=channel_creds
         )
     else:
         scheduler_client_cls = RemoteScheduler
@@ -76,7 +114,7 @@ def build(cfg: DaemonConfig, scheduler_url: str):
         host,
         storage,
         client,
-        piece_fetcher=HTTPPieceFetcher(client.resolve_host),
+        piece_fetcher=HTTPPieceFetcher(client.resolve_host, ssl_context=fetch_ssl),
         source_fetcher=PieceSourceFetcher(),
         concurrent_source_groups=cfg.concurrent_source_groups,
     )
@@ -89,6 +127,7 @@ def build(cfg: DaemonConfig, scheduler_url: str):
         "client": client,
         "conductor": conductor,
         "announcer": announcer,
+        "identity": identity,
     }
 
 
@@ -155,7 +194,11 @@ def run(argv=None) -> int:
 
         from ..rpc import HTTPPieceFetcher
 
-        parts["conductor"].piece_fetcher = HTTPPieceFetcher(resolve)
+        # Keep the mTLS client identity through the resolver swap.
+        old_fetcher = parts["conductor"].piece_fetcher
+        parts["conductor"].piece_fetcher = HTTPPieceFetcher(
+            resolve, ssl_context=getattr(old_fetcher, "ssl_context", None)
+        )
         print(f"dfdaemon: pex gossip on udp:{bus.address[1]}", flush=True)
 
     seeder = None

@@ -99,12 +99,20 @@ def run(argv=None) -> int:
     from ..rpc.ratelimit import maybe_bucket
 
     bucket = maybe_bucket(cfg.server.rate_limit_qps, cfg.server.rate_limit_burst)
+    ca = None
+    if cfg.ca_dir:
+        from ..security.ca import CertificateAuthority
+
+        # Persistent: restarts keep the cluster trust root, so issued
+        # peer identities stay valid across a manager bounce.
+        ca = CertificateAuthority.persistent(cfg.ca_dir)
     rest = ManagerRESTServer(
         parts["registry"], parts["clusters"], parts["searcher"],
         host=cfg.server.host, port=cfg.server.port,
         jobqueue=parts["jobs"], crud=parts["crud"],
         objectstorage=parts["objectstorage"],
         rate_limit=bucket,
+        ca=ca,
         **auth,
     )
     rest.serve()
@@ -120,6 +128,7 @@ def run(argv=None) -> int:
             token_verifier=auth.get("token_verifier"),
             users=auth.get("users"),
             rate_limit=bucket,
+            ca=ca,
         )
         grpc_server.serve()
     # flush: under a pipe (supervisors, e2e harnesses) the ready line must

@@ -136,6 +136,39 @@ def run(argv=None) -> int:
     from ..rpc import SchedulerHTTPServer
     from ..rpc.ratelimit import maybe_bucket
 
+    # Auto-issued mTLS (certify analog): provision this scheduler's
+    # identity from the manager's cluster CA at boot; the gRPC port then
+    # requires CA-issued client certificates.
+    identity = None
+    if cfg.security.auto_issue:
+        if not cfg.manager_addr:
+            raise SystemExit("scheduler: security.auto_issue needs manager_addr")
+        import socket as _sock
+
+        from ..security.ca import PeerIdentity
+        from ..utils.hostinfo import local_ip
+
+        # The SAN must carry the address clients DIAL (gRPC verifies the
+        # target against it) — the advertise address, never the bind
+        # host (0.0.0.0 would fail every handshake).
+        dial_ip = cfg.server.advertise_ip or (
+            cfg.server.host
+            if cfg.server.host not in ("0.0.0.0", "::")
+            and cfg.server.host[:1].isdigit()
+            else local_ip()
+        )
+        identity = PeerIdentity.request_from_manager(
+            cfg.manager_addr,
+            common_name=f"sched-{_sock.gethostname()}",
+            hostnames=[_sock.gethostname()],
+            ips=[dial_ip],
+            token=cfg.manager_token or None,
+            ttl_hours=cfg.security.cert_ttl_hours,
+        )
+        if cfg.security.identity_dir:
+            identity.write(cfg.security.identity_dir)
+        print("scheduler: mTLS identity issued by manager CA", flush=True)
+
     bucket = maybe_bucket(cfg.server.rate_limit_qps, cfg.server.rate_limit_burst)
     rpc_server = SchedulerHTTPServer(
         service, host=cfg.server.host, port=cfg.server.port, rate_limit=bucket
@@ -147,11 +180,21 @@ def run(argv=None) -> int:
     if cfg.server.grpc_port >= 0:
         from ..rpc.grpc_transport import SchedulerGRPCServer
 
+        grpc_creds = None
+        if identity is not None:
+            import grpc as _grpc
+
+            grpc_creds = _grpc.ssl_server_credentials(
+                [(identity.key_pem, identity.cert_pem)],
+                root_certificates=identity.ca_pem,
+                require_client_auth=True,
+            )
         # ONE shared bucket: the configured qps bounds the SERVICE, not
         # each transport separately.
         grpc_server = SchedulerGRPCServer(
             service, host=cfg.server.host, port=cfg.server.grpc_port,
             rate_limit=bucket,
+            server_credentials=grpc_creds,
         )
         grpc_server.serve()
         # Stall sweep: server-initiated reschedules for idle peers on the

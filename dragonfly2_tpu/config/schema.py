@@ -117,6 +117,24 @@ class GCSection:
 
 
 @dataclass
+class SecuritySection:
+    """Auto-issued mTLS (the reference certify flow, pkg/rpc/security):
+    with ``auto_issue`` on and a manager address configured, the service
+    requests its identity from the manager's cluster CA at boot
+    (security/ca.py request_from_manager) — the key never leaves the
+    process; only the CSR travels."""
+
+    auto_issue: bool = False
+    identity_dir: str = ""     # persist key/cert/ca here (empty = memory only)
+    cert_ttl_hours: int = 0    # 0 = manager default (24h); server-capped at 7d
+    # Daemon-side: dial the scheduler's gRPC port with TLS when this
+    # daemon holds an issued identity.  True assumes a uniformly mTLS'd
+    # cluster (the scheduler auto-issued too); set False for mixed
+    # deployments where the scheduler's gRPC port is still plaintext.
+    scheduler_grpc_tls: bool = True
+
+
+@dataclass
 class SchedulerConfigFile:
     server: ServerConfig = field(default_factory=ServerConfig)
     scheduling: SchedulingSection = field(default_factory=SchedulingSection)
@@ -130,6 +148,7 @@ class SchedulerConfigFile:
     # Bearer credential (PAT or session token) for the manager's RBAC'd
     # job-poll and registration routes; empty on open managers.
     manager_token: str = ""
+    security: SecuritySection = field(default_factory=SecuritySection)
     cluster_id: str = "default"
     # How often to poll the manager for cluster-scoped scheduling config
     # (dynconfig.go refresh interval; the reference defaults to 10s for
@@ -197,6 +216,11 @@ class ManagerConfig:
     # proxies to the configured backend): {"kind": "fs"|"s3"|"oss", ...}
     # — empty disables the bucket surface.
     objectstorage: dict = field(default_factory=dict)
+    # Cluster CA directory (pkg/issuer analog): non-empty turns on the
+    # certificate-issuance surface (POST /api/v1/certs:issue + gRPC twin)
+    # with a persistent CA under this path; peers self-provision mTLS
+    # identities at boot (security/ca.py request_from_manager).
+    ca_dir: str = ""
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     log: LogConfig = field(default_factory=LogConfig)
 
@@ -243,6 +267,11 @@ class DaemonConfig:
     # -1 = disabled, 0 = OS-assigned.
     control_vsock_port: int = -1
     scheduler_addr: str = ""
+    # Manager address for service-identity bootstrap (daemons otherwise
+    # only talk to the scheduler); required when security.auto_issue is on.
+    manager_addr: str = ""
+    manager_token: str = ""
+    security: SecuritySection = field(default_factory=SecuritySection)
     piece_size: int = 4 << 20
     concurrent_upload_limit: int = 50
     # Concurrent back-to-source range groups (peerhost.go ConcurrentOption

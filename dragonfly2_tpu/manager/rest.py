@@ -117,9 +117,15 @@ class ManagerRESTServer:
         crud: Optional[CrudStore] = None,
         objectstorage=None,
         rate_limit=None,
+        ca=None,
     ):
         self.registry = registry
         self.clusters = clusters
+        # Cluster CA (security/ca.py CertificateAuthority): with one
+        # attached, peers self-provision their mTLS identity over the
+        # wire at boot — POST /api/v1/certs:issue (the reference's
+        # certify flow, pkg/issuer + scheduler.go:186-222).  None → 404.
+        self.ca = ca
         self.searcher = searcher or Searcher()
         self.scheduler_clusters = scheduler_clusters or []
         # CRUD resources (applications + scheduler-cluster records whose
@@ -209,6 +215,13 @@ class ManagerRESTServer:
                     self.wfile.write(body)
                 elif path == "/api/v1/healthy":
                     self._json(200, {"ok": True})
+                elif path == "/api/v1/certs:ca":
+                    # Trust-root fetch (open read: peers need the root
+                    # BEFORE they can build a verified TLS context).
+                    if server.ca is None:
+                        self._json(404, {"error": "no cluster CA configured"})
+                    else:
+                        self._json(200, {"ca_pem": server.ca.cert_pem.decode()})
                 elif path in ("/swagger.json", "/api/v1/openapi"):
                     # The swagger export (api/manager/swagger.json analog).
                     from .openapi import spec
@@ -442,6 +455,10 @@ class ManagerRESTServer:
                     required = Role.PEER
                 elif path == "/api/v1/topology":
                     required = Role.PEER  # scheduler service flow
+                elif path == "/api/v1/certs:issue":
+                    # Service-identity bootstrap (certify analog) — the
+                    # automated peer flow, like registration/keepalive.
+                    required = Role.PEER
                 elif (
                     path.startswith("/api/v1/applications")
                     or path.startswith("/api/v1/clusters")
@@ -467,6 +484,28 @@ class ManagerRESTServer:
                     )
                 ):
                     self._crud_routes(path)
+                    return
+                if path == "/api/v1/certs:issue":
+                    # CSR in, cluster-CA-signed cert out (pkg/issuer /
+                    # security_server.go IssueCertificate analog).
+                    if server.ca is None:
+                        self._json(404, {"error": "no cluster CA configured"})
+                        return
+                    try:
+                        from ..security.ca import clamp_ttl
+
+                        req = self._body()
+                        csr_pem = req["csr_pem"].encode()
+                        ttl = clamp_ttl(int(req.get("ttl_hours") or 0))
+                        cert_pem = server.ca.sign_csr(csr_pem, ttl=ttl)
+                        self._json(200, {
+                            "cert_pem": cert_pem.decode(),
+                            "ca_pem": server.ca.cert_pem.decode(),
+                        })
+                    except (KeyError, ValueError, TypeError) as exc:
+                        self._json(400, {"error": str(exc)})
+                    except Exception as exc:  # noqa: BLE001 — x509 parse
+                        self._json(400, {"error": f"bad csr: {exc}"})
                     return
                 if path == "/api/v1/topology":
                     # Scheduler push: replace this scheduler's edge set.

@@ -778,12 +778,17 @@ class ManagerGRPCServer:
         users=None,
         rate_limit=None,
         server_credentials: Optional[grpc.ServerCredentials] = None,
+        ca=None,
     ) -> None:
         from ..manager.searcher import Searcher
         from ..security.tokens import Role
 
         self.registry = registry
         self.clusters = clusters
+        # Cluster CA for wire certificate issuance (certify analog) —
+        # same instance as the REST surface's so both ports sign with
+        # one trust root.  None → NOT_FOUND.
+        self.ca = ca
         self.searcher = searcher or Searcher()
         self.scheduler_clusters = scheduler_clusters or []
         self.token_verifier = token_verifier
@@ -815,6 +820,7 @@ class ManagerGRPCServer:
             "keepalive": (self._keepalive, pb.KeepaliveRequest, pb.KeepaliveReply, Role.PEER),
             "list_schedulers": (self._list_schedulers, pb.Empty, pb.ListSchedulersReply, None),
             "search_clusters": (self._search, pb.ClusterSearchRequest, pb.ClusterSearchReply, None),
+            "issue_certificate": (self._issue_certificate, pb.CertificateRequest, pb.CertificateReply, Role.PEER),
         }
         handlers = {}
         for name, (fn, req_cls, _resp_cls, role) in methods.items():
@@ -906,6 +912,22 @@ class ManagerGRPCServer:
             context.abort(grpc.StatusCode.NOT_FOUND, f"artifact missing: {exc}")
         return pb.ArtifactReply(artifact=blob)
 
+    # -- certificate issuance (pkg/issuer, security_server.go) --------------
+
+    def _issue_certificate(self, req, context):
+        if self.ca is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "no cluster CA configured")
+        from ..security.ca import clamp_ttl
+
+        ttl = clamp_ttl(req.ttl_hours)
+        try:
+            cert_pem = self.ca.sign_csr(bytes(req.csr_pem), ttl=ttl)
+        except ValueError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — x509 parse errors
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad csr: {exc}")
+        return pb.CertificateReply(cert_pem=cert_pem, ca_pem=self.ca.cert_pem)
+
     # -- clusters (manager_server_v2.go keepalive, searcher) ----------------
 
     def _register_scheduler(self, req, context):
@@ -986,6 +1008,7 @@ class GRPCRemoteRegistry:
             "keepalive": (pb.KeepaliveRequest, pb.KeepaliveReply),
             "list_schedulers": (pb.Empty, pb.ListSchedulersReply),
             "search_clusters": (pb.ClusterSearchRequest, pb.ClusterSearchReply),
+            "issue_certificate": (pb.CertificateRequest, pb.CertificateReply),
         }.items():
             self._stubs[name] = self._channel.unary_unary(
                 f"/{MANAGER_SERVICE}/{name}",
@@ -1090,6 +1113,13 @@ class GRPCRemoteRegistry:
         return self._call(
             "keepalive", pb.KeepaliveRequest(instance_id=instance_id)
         ).known
+
+    def issue_certificate(self, csr_pem: bytes, *, ttl_hours: int = 0):
+        """CSR → (cert_pem, ca_pem) signed by the manager's cluster CA."""
+        reply = self._call("issue_certificate", pb.CertificateRequest(
+            csr_pem=csr_pem, ttl_hours=ttl_hours
+        ))
+        return bytes(reply.cert_pem), bytes(reply.ca_pem)
 
     def list_schedulers(self):
         reply = self._call("list_schedulers", pb.Empty())

@@ -18,6 +18,19 @@ from cryptography.hazmat.primitives.asymmetric import ec
 from cryptography.x509.oid import NameOID
 
 DEFAULT_CERT_TTL = datetime.timedelta(hours=24)
+# Server-side ceiling on client-requested TTLs: revocation is
+# non-renewal, so no caller may mint an effectively permanent cert.
+MAX_CERT_TTL = datetime.timedelta(days=7)
+
+
+def clamp_ttl(ttl_hours: int) -> datetime.timedelta:
+    """Requested hours → issued validity: 0/negative → default, anything
+    else capped at MAX_CERT_TTL (and immune to timedelta overflow)."""
+    if ttl_hours <= 0:
+        return DEFAULT_CERT_TTL
+    return min(
+        datetime.timedelta(hours=min(int(ttl_hours), 24 * 365)), MAX_CERT_TTL
+    )
 
 
 def _name(common_name: str) -> x509.Name:
@@ -34,6 +47,26 @@ def _san(hostnames: List[str], ips: List[str]) -> x509.SubjectAlternativeName:
     for ip in ips:
         entries.append(x509.IPAddress(ipaddress.ip_address(ip)))
     return x509.SubjectAlternativeName(entries)
+
+
+def _new_key_and_csr(
+    common_name: str,
+    hostnames: Optional[List[str]],
+    ips: Optional[List[str]],
+):
+    """Fresh EC key + CSR — ONE builder for the in-process and
+    over-the-wire issuance paths, so subject/SAN construction can't
+    diverge between them."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    csr = (
+        x509.CertificateSigningRequestBuilder()
+        .subject_name(_name(common_name))
+        .add_extension(
+            _san(hostnames or [common_name], ips or []), critical=False
+        )
+        .sign(key, hashes.SHA256())
+    )
+    return key, csr
 
 
 class CertificateAuthority:
@@ -169,15 +202,7 @@ class PeerIdentity:
         """Generate a key, CSR against the CA, receive the signed cert —
         the whole certify bootstrap in one call (in-process CA; over the
         wire the CSR posts to the manager)."""
-        key = ec.generate_private_key(ec.SECP256R1())
-        csr = (
-            x509.CertificateSigningRequestBuilder()
-            .subject_name(_name(common_name))
-            .add_extension(
-                _san(hostnames or [common_name], ips or []), critical=False
-            )
-            .sign(key, hashes.SHA256())
-        )
+        key, csr = _new_key_and_csr(common_name, hostnames, ips)
         cert_pem = ca.sign_csr(
             csr.public_bytes(serialization.Encoding.PEM), ttl=ttl
         )
@@ -189,6 +214,67 @@ class PeerIdentity:
             ),
             cert_pem=cert_pem,
             ca_pem=ca.cert_pem,
+        )
+
+    @classmethod
+    def request_from_manager(
+        cls,
+        manager_url: str,
+        *,
+        common_name: str,
+        hostnames: Optional[List[str]] = None,
+        ips: Optional[List[str]] = None,
+        ttl_hours: int = 0,
+        token: Optional[str] = None,
+        timeout: float = 10.0,
+        attempts: int = 5,
+    ) -> "PeerIdentity":
+        """Self-provision an mTLS identity OVER THE WIRE at boot (the
+        reference certify flow, scheduler.go:186-222 / pkg/issuer): the
+        private key is generated HERE and never leaves the process —
+        only the CSR travels; the manager answers with the signed cert
+        and the cluster trust root (POST /api/v1/certs:issue).
+
+        Retries connection failures with backoff — services routinely
+        boot before the manager's port listens (compose/systemd restart
+        order); an HTTP error (401, 400) is terminal and raises as-is."""
+        import json as _json
+        import time as _time
+        import urllib.error
+        import urllib.request
+
+        key, csr = _new_key_and_csr(common_name, hostnames, ips)
+        body = _json.dumps({
+            "csr_pem": csr.public_bytes(serialization.Encoding.PEM).decode(),
+            "ttl_hours": ttl_hours,
+        }).encode()
+        req = urllib.request.Request(
+            manager_url.rstrip("/") + "/api/v1/certs:issue",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        for attempt in range(attempts):
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    reply = _json.loads(resp.read())
+                break
+            except urllib.error.HTTPError:
+                raise  # the manager answered: retrying cannot help
+            except (urllib.error.URLError, ConnectionError, TimeoutError, OSError):
+                if attempt == attempts - 1:
+                    raise
+                _time.sleep(min(0.5 * 2 ** attempt, 5.0))
+        return cls(
+            key_pem=key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            ),
+            cert_pem=reply["cert_pem"].encode(),
+            ca_pem=reply["ca_pem"].encode(),
         )
 
     def write(self, directory: str) -> dict:
