@@ -10,6 +10,7 @@ from ..utils import dflog
 
 def base_parser(prog: str, description: str) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog=prog, description=description)
+    p.set_defaults(_prog=prog)  # OTLP resource service.name
     p.add_argument("--config", default=None, help="YAML config file path")
     p.add_argument("--verbose", action="store_true", help="debug logging")
     p.add_argument("--console", action="store_true", help="log to stdout")
@@ -27,15 +28,30 @@ def base_parser(prog: str, description: str) -> argparse.ArgumentParser:
              "ids from the traceparent wire header land here",
     )
     p.add_argument(
+        "--otlp", default=None, metavar="TARGET",
+        help="export spans as OTLP/JSON: an http(s) collector endpoint "
+             "(Jaeger/otel-collector at :4318/v1/traces) or a file path "
+             "appended one ExportTraceServiceRequest per line — the "
+             "reference's --jaeger flag analog "
+             "(cmd/dependency/dependency.go:263-297)",
+    )
+    p.add_argument(
         "--version", action="version", version=f"%(prog)s {__version__}"
     )
     return p
 
 
 def init_tracing(args) -> None:
-    """Point the process-default tracer at a JSONL exporter when
-    --trace-file is given (every binary, like the reference's otel
-    wiring in cmd/dependency)."""
+    """Point the process-default tracer at the configured exporter
+    (every binary, like the reference's otel wiring in cmd/dependency):
+    --otlp for standard-collector export, --trace-file for raw JSONL."""
+    if getattr(args, "otlp", None):
+        from ..utils.tracing import OTLPJSONExporter, default_tracer
+
+        default_tracer.exporter = OTLPJSONExporter(
+            args.otlp, service=getattr(args, "_prog", None) or "dragonfly"
+        )
+        return
     if not getattr(args, "trace_file", None):
         return
     from ..utils.tracing import JSONLExporter, default_tracer

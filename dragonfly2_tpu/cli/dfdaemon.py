@@ -46,24 +46,34 @@ def build(cfg: DaemonConfig, scheduler_url: str):
     # piece plane then serves AND fetches over mutual TLS.
     identity = None
     serve_ssl = fetch_ssl = None
+    renewer = None
     if cfg.security.auto_issue:
         if not cfg.manager_addr:
             raise SystemExit("dfdaemon: security.auto_issue needs manager_addr")
-        from ..security.ca import PeerIdentity
+        from ..security.ca import IdentityRenewer, PeerIdentity
         from ..security.tls import client_context, server_context
 
-        identity = PeerIdentity.request_from_manager(
-            cfg.manager_addr,
-            common_name=f"daemon-{hostname}",
-            hostnames=[hostname],
-            ips=[ip],
-            token=cfg.manager_token or None,
-            ttl_hours=cfg.security.cert_ttl_hours,
-        )
-        if cfg.security.identity_dir:
-            identity.write(cfg.security.identity_dir)
+        def _issue_identity():
+            ident = PeerIdentity.request_from_manager(
+                cfg.manager_addr,
+                common_name=f"daemon-{hostname}",
+                hostnames=[hostname],
+                ips=[ip],
+                token=cfg.manager_token or None,
+                ttl_hours=cfg.security.cert_ttl_hours,
+            )
+            if cfg.security.identity_dir:
+                ident.write(cfg.security.identity_dir)
+            return ident
+
+        identity = _issue_identity()
         serve_ssl = server_context(identity)
         fetch_ssl = client_context(identity)
+        # Short-TTL certs stay alive: re-issue at half validity and
+        # reload both piece-plane contexts in place.
+        renewer = IdentityRenewer(
+            identity, _issue_identity, [serve_ssl, fetch_ssl]
+        ).start()
 
     # Native-engine stores serve pieces from the C++ server (sendfile hot
     # path); Python HTTP remains the fallback/TLS server.
@@ -128,6 +138,7 @@ def build(cfg: DaemonConfig, scheduler_url: str):
         "conductor": conductor,
         "announcer": announcer,
         "identity": identity,
+        "renewer": renewer,
     }
 
 

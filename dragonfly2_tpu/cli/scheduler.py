@@ -150,18 +150,25 @@ def run(argv=None) -> int:
 
         # The SAN must carry the address clients DIAL (gRPC verifies the
         # target against it) — the advertise address, never the bind
-        # host (0.0.0.0 would fail every handshake).
-        dial_ip = cfg.server.advertise_ip or (
+        # host (0.0.0.0 would fail every handshake).  A non-IP dial
+        # address is a DNS name and belongs in the DNS SANs.
+        import ipaddress as _ipa
+
+        dial = cfg.server.advertise_ip or (
             cfg.server.host
             if cfg.server.host not in ("0.0.0.0", "::")
-            and cfg.server.host[:1].isdigit()
             else local_ip()
         )
+        try:
+            _ipa.ip_address(dial)
+            san_ips, san_names = [dial], [_sock.gethostname()]
+        except ValueError:
+            san_ips, san_names = [local_ip()], [dial, _sock.gethostname()]
         identity = PeerIdentity.request_from_manager(
             cfg.manager_addr,
             common_name=f"sched-{_sock.gethostname()}",
-            hostnames=[_sock.gethostname()],
-            ips=[dial_ip],
+            hostnames=san_names,
+            ips=san_ips,
             token=cfg.manager_token or None,
             ttl_hours=cfg.security.cert_ttl_hours,
         )

@@ -126,7 +126,11 @@ class SecuritySection:
 
     auto_issue: bool = False
     identity_dir: str = ""     # persist key/cert/ca here (empty = memory only)
-    cert_ttl_hours: int = 0    # 0 = manager default (24h); server-capped at 7d
+    # 0 = manager default (24h); server-capped at 7d.  The daemon's
+    # piece-plane contexts auto-renew in place at half validity
+    # (security.ca.IdentityRenewer); gRPC credentials are immutable once
+    # built — clusters running mTLS gRPC restart services within the TTL.
+    cert_ttl_hours: int = 0
     # Daemon-side: dial the scheduler's gRPC port with TLS when this
     # daemon holds an issued identity.  True assumes a uniformly mTLS'd
     # cluster (the scheduler auto-issued too); set False for mixed

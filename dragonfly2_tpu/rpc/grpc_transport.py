@@ -661,6 +661,10 @@ class GRPCStreamingScheduler(GRPCRemoteScheduler):
         super().close()
 
 
+# 128 MiB protocol chunk + proto/field overhead headroom.
+_TRAIN_MSG_CAP = (128 << 20) + (1 << 20)
+
+
 class TrainerGRPCServer:
     """Trainer.Train client-streaming ingest + run-status lookups."""
 
@@ -675,7 +679,14 @@ class TrainerGRPCServer:
         if service.data_dir is None:
             raise ValueError("remote ingest requires TrainerService(data_dir=...)")
         self.service = service
-        self._server = grpc.server(ThreadPoolExecutor(max_workers=max_workers))
+        # The Train protocol frames datasets in 128 MiB chunks
+        # (announcer.go:39-41); gRPC's default 4 MiB message cap would
+        # reject the FIRST real chunk (caught by tools/bench_wire_ingest
+        # — the tests' tiny shards never hit it).
+        self._server = grpc.server(
+            ThreadPoolExecutor(max_workers=max_workers),
+            options=[("grpc.max_receive_message_length", _TRAIN_MSG_CAP)],
+        )
         handlers = {
             "Train": grpc.stream_unary_rpc_method_handler(
                 self._train,
@@ -1145,7 +1156,10 @@ class GRPCTrainerClient:
     CHUNK_BYTES = 128 << 20  # announcer.go:39-41
 
     def __init__(self, target: str, *, timeout: float = 600.0) -> None:
-        self._channel = grpc.insecure_channel(target)
+        self._channel = grpc.insecure_channel(
+            target,
+            options=[("grpc.max_send_message_length", _TRAIN_MSG_CAP)],
+        )
         self.timeout = timeout
         self._train = self._channel.stream_unary(
             f"/{TRAINER_SERVICE}/Train",

@@ -181,6 +181,73 @@ class CertificateAuthority:
         return cert.public_bytes(serialization.Encoding.PEM)
 
 
+class IdentityRenewer:
+    """Keeps an auto-issued identity alive past its TTL: re-requests a
+    fresh certificate at ``fraction`` of the remaining validity and
+    reloads the given ssl contexts IN PLACE (security.tls.reload_context
+    — live piece servers/fetchers pick the new chain up at the next
+    handshake, no restart).  Issue failures retry on a short backoff
+    while the old cert is still valid.
+
+    Scope note: Python ``ssl`` contexts renew live; gRPC channel/server
+    credentials are immutable once built — a cluster running mTLS gRPC
+    rotates those by service restart within the cert TTL (documented in
+    config.SecuritySection).
+    """
+
+    def __init__(
+        self,
+        identity: "PeerIdentity",
+        request_fn,
+        contexts,
+        *,
+        fraction: float = 0.5,
+        min_interval_s: float = 60.0,
+    ) -> None:
+        import threading as _threading
+
+        self.identity = identity
+        self._request_fn = request_fn
+        self._contexts = list(contexts)
+        self.fraction = fraction
+        self.min_interval_s = min_interval_s
+        self.renewals = 0
+        self._stop = _threading.Event()
+        self._thread: Optional[object] = None
+
+    def start(self) -> "IdentityRenewer":
+        import threading as _threading
+
+        self._thread = _threading.Thread(
+            target=self._loop, name="mtls-renew", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        from .tls import reload_context
+
+        while not self._stop.is_set():
+            wait = max(
+                self.identity.seconds_left() * self.fraction,
+                self.min_interval_s,
+            )
+            if self._stop.wait(wait):
+                return
+            try:
+                fresh = self._request_fn()
+                for ctx in self._contexts:
+                    reload_context(ctx, fresh)
+                self.identity = fresh
+                self.renewals += 1
+            except Exception:  # noqa: BLE001 — old cert still valid; retry soon
+                if self._stop.wait(self.min_interval_s):
+                    return
+
+
 @dataclass
 class PeerIdentity:
     """A peer's key + CA-issued certificate (daemon/scheduler side)."""
@@ -276,6 +343,12 @@ class PeerIdentity:
             cert_pem=reply["cert_pem"].encode(),
             ca_pem=reply["ca_pem"].encode(),
         )
+
+    def seconds_left(self) -> float:
+        """Validity remaining on this identity's certificate."""
+        cert = x509.load_pem_x509_certificate(self.cert_pem)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        return (cert.not_valid_after_utc - now).total_seconds()
 
     def write(self, directory: str) -> dict:
         """Materialize to files (ssl contexts need paths); returns paths."""

@@ -39,6 +39,17 @@ def server_context(identity: PeerIdentity) -> ssl.SSLContext:
     return ctx
 
 
+def reload_context(ctx: ssl.SSLContext, identity: PeerIdentity) -> None:
+    """Swap a NEW identity into an existing context in place — live
+    listeners/dialers pick the fresh chain up at their next handshake
+    (ssl reads the context at handshake time, not at wrap time), which
+    is what makes short-TTL auto-issued certs renewable without a
+    restart."""
+    with _materialized(identity) as paths:
+        ctx.load_cert_chain(paths["cert"], paths["key"])
+        ctx.load_verify_locations(paths["ca"])
+
+
 def client_context(
     identity: PeerIdentity, *, check_hostname: bool = False
 ) -> ssl.SSLContext:

@@ -182,5 +182,172 @@ class JSONLExporter(SpanExporter):
                 f.write(json.dumps(record) + "\n")
 
 
+def _otlp_value(v: Any) -> Dict[str, Any]:
+    """Python attribute → OTLP AnyValue (proto3-JSON encoding rules:
+    int64 rides as a string, doubles as numbers)."""
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def span_to_otlp(span: Span) -> Dict[str, Any]:
+    """One Span → the OTLP/JSON span object (trace/span ids are HEX in
+    the OTLP/JSON encoding, unlike generic proto3-JSON's base64 —
+    opentelemetry-proto's documented deviation)."""
+    out: Dict[str, Any] = {
+        "traceId": span.trace_id,
+        "spanId": span.span_id,
+        "name": span.name,
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(span.start_ns),
+        "endTimeUnixNano": str(span.end_ns),
+        "attributes": [
+            {"key": k, "value": _otlp_value(v)}
+            for k, v in span.attributes.items()
+        ],
+        "status": (
+            {"code": 1}
+            if span.status == "ok"
+            else {"code": 2, "message": span.status}
+        ),
+    }
+    if span.parent_id:
+        out["parentSpanId"] = span.parent_id
+    return out
+
+
+class OTLPJSONExporter(SpanExporter):
+    """OTLP/JSON exporter — the reference exports to Jaeger via OTel
+    (cmd/dependency/dependency.go:263-297); this emits the standard
+    ``ExportTraceServiceRequest`` JSON any OTLP collector (Jaeger ≥1.35
+    at ``:4318/v1/traces``, otel-collector, Tempo) ingests.
+
+    ``target`` starting with ``http://``/``https://`` POSTs batches to
+    that endpoint; anything else is a file path appended one request
+    per line (replayable with curl).  Spans buffer up to ``batch_size``
+    then flush; a long-running service's tail flushes on close()/atexit.
+    Export failures are counted, never raised, and HTTP posts happen on
+    a background sender thread behind a bounded queue — a slow/down
+    collector must not stall the span-producing data-plane threads
+    (span end runs inside piece workers and RPC handlers).
+    """
+
+    def __init__(
+        self,
+        target: str,
+        *,
+        service: str = "dragonfly",
+        batch_size: int = 64,
+        queue_batches: int = 16,
+    ) -> None:
+        import atexit
+        import queue as _queue
+
+        self.target = target
+        self.service = service
+        self.batch_size = batch_size
+        self.dropped = 0
+        self._mu = threading.Lock()
+        self._buf: List[Span] = []
+        self._http = target.startswith(("http://", "https://"))
+        if self._http:
+            self._q: "_queue.Queue" = _queue.Queue(maxsize=queue_batches)
+            self._worker = threading.Thread(
+                target=self._drain, name="otlp-export", daemon=True
+            )
+            self._worker.start()
+        atexit.register(self.close)
+
+    def export(self, span: Span) -> None:
+        with self._mu:
+            self._buf.append(span)
+            if len(self._buf) < self.batch_size:
+                return
+            batch, self._buf = self._buf, []
+        self._dispatch(batch)
+
+    def flush(self) -> None:
+        with self._mu:
+            batch, self._buf = self._buf, []
+        if batch:
+            self._dispatch(batch)
+        if self._http:
+            self._q.join()
+
+    def close(self) -> None:
+        self.flush()
+
+    def _dispatch(self, batch: List[Span]) -> None:
+        if not self._http:
+            self._send(batch)
+            return
+        import queue as _queue
+
+        try:
+            self._q.put_nowait(batch)
+        except _queue.Full:
+            # Collector can't keep up: shed THIS batch, never block the
+            # producing thread.
+            with self._mu:
+                self.dropped += len(batch)
+
+    def _drain(self) -> None:
+        while True:
+            batch = self._q.get()
+            try:
+                self._send(batch)
+            finally:
+                self._q.task_done()
+
+    def _request(self, batch: List[Span]) -> Dict[str, Any]:
+        return {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {
+                                "key": "service.name",
+                                "value": {"stringValue": self.service},
+                            }
+                        ]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "dragonfly2_tpu.utils.tracing"},
+                            "spans": [span_to_otlp(s) for s in batch],
+                        }
+                    ],
+                }
+            ]
+        }
+
+    def _send(self, batch: List[Span]) -> None:
+        payload = json.dumps(self._request(batch))
+        try:
+            if self._http:
+                import urllib.request
+
+                req = urllib.request.Request(
+                    self.target,
+                    data=payload.encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                urllib.request.urlopen(req, timeout=10).close()
+            else:
+                # Under the lock: concurrent flushes interleaving their
+                # multi-KB appends would corrupt the JSONL stream.
+                with self._mu:
+                    with open(self.target, "a") as f:
+                        f.write(payload + "\n")
+        except Exception:  # noqa: BLE001 — observability must not crash the plane
+            with self._mu:
+                self.dropped += len(batch)
+
+
 # Process-default tracer (services may construct scoped ones).
 default_tracer = Tracer()

@@ -217,6 +217,66 @@ class TestWireIssuance:
         finally:
             server.stop()
 
+    def test_identity_renewer_reloads_live_contexts(self, tmp_path):
+        """Short-TTL certs renew WITHOUT a restart: the renewer re-issues
+        at half validity and reloads the live contexts in place — an
+        mTLS roundtrip still works on certs issued AFTER the servers
+        were built."""
+        import datetime
+        import time
+        import urllib.request
+
+        from dragonfly2_tpu.daemon import DaemonStorage, UploadManager
+        from dragonfly2_tpu.rpc import PieceHTTPServer
+        from dragonfly2_tpu.security.ca import IdentityRenewer
+
+        ca = CertificateAuthority()
+        short = datetime.timedelta(seconds=2)
+        server_id = PeerIdentity.issue(
+            ca, common_name="p", hostnames=["localhost"], ips=["127.0.0.1"],
+            ttl=short,
+        )
+        client_id = PeerIdentity.issue(ca, common_name="c", ttl=short)
+        sctx, cctx = server_context(server_id), client_context(client_id)
+
+        st = DaemonStorage(str(tmp_path / "s"), prefer_native=False)
+        st.register_task("t", piece_size=64, content_length=64)
+        st.write_piece("t", 0, b"x" * 64)
+        server = PieceHTTPServer(UploadManager(st), ssl_context=sctx)
+        server.serve()
+        renewers = [
+            IdentityRenewer(
+                server_id,
+                lambda: PeerIdentity.issue(
+                    ca, common_name="p", hostnames=["localhost"],
+                    ips=["127.0.0.1"],
+                ),
+                [sctx],
+                min_interval_s=0.2,
+            ).start(),
+            IdentityRenewer(
+                client_id,
+                lambda: PeerIdentity.issue(ca, common_name="c"),
+                [cctx],
+                min_interval_s=0.2,
+            ).start(),
+        ]
+        try:
+            deadline = time.time() + 10
+            while (
+                any(r.renewals == 0 for r in renewers) and time.time() < deadline
+            ):
+                time.sleep(0.1)
+            assert all(r.renewals >= 1 for r in renewers)
+            # Certs on BOTH sides are renewals now; the plane still moves.
+            url = f"https://127.0.0.1:{server.port}/pieces/t/0"
+            with urllib.request.urlopen(url, context=cctx, timeout=5) as r:
+                assert r.read() == b"x" * 64
+        finally:
+            for r in renewers:
+                r.stop()
+            server.stop()
+
     def test_wire_issued_identities_do_mtls_piece_transfer(self, tmp_path):
         """End to end: both sides bootstrap from the manager, then move
         bytes over mutual TLS; anonymous clients stay locked out."""
