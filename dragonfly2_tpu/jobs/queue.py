@@ -221,6 +221,18 @@ class JobQueue:
                 ],
             }
 
+    def list_groups(self, limit: int = 50) -> list:
+        """Most-recent group snapshots (the console's jobs view)."""
+        with self._mu:
+            ids = list(self.groups.keys())[-limit:]
+        out = []
+        for gid in reversed(ids):
+            try:
+                out.append(self.group_snapshot(gid))
+            except KeyError:
+                continue  # pruned between listing and snapshot
+        return out
+
     def prune(self, max_age_s: float) -> int:
         """Drop terminal job records (and emptied groups) older than
         ``max_age_s`` — interval producers (sync_peers every minute for

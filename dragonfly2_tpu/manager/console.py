@@ -64,6 +64,15 @@ CONSOLE_HTML = """<!DOCTYPE html>
     <th>name</th><th>url</th><th>priority</th><th>bio</th><th></th>
   </tr></thead><tbody></tbody></table>
 
+  <h2>Jobs <span class="muted">(async group fan-out: preheat / sync_peers)</span></h2>
+  <select id="job-type"><option>preheat</option><option>sync_peers</option></select>
+  <input id="job-queues" placeholder="queues (sched-a,sched-b)">
+  <input id="job-url" placeholder="url (preheat)">
+  <button onclick="createJob()">Create</button>
+  <table id="jobs"><thead><tr>
+    <th>group</th><th>state</th><th>jobs</th><th>errors</th>
+  </tr></thead><tbody></tbody></table>
+
   <h2>Users <span class="muted">(admin)</span></h2>
   <table id="users"><thead><tr>
     <th>name</th><th>email</th><th>role</th><th>state</th>
@@ -129,6 +138,11 @@ async function refresh() {
   fill("applications", apps.map(a => `<tr><td>${esc(a.name)}</td>
     <td><code>${esc(a.url)}</code></td><td>${a.priority}</td><td>${esc(a.bio)}</td>
     <td><button data-id="${esc(a.id)}" onclick="delApp(this.dataset.id)">delete</button></td></tr>`));
+  const jobs = await api("/jobs");
+  fill("jobs", jobs.map(g => `<tr><td><code>${esc(g.group_id)}</code></td>
+    <td><span class="pill ${g.state === "SUCCESS" ? "active" : "inactive"}">${esc(g.state)}</span></td>
+    <td>${g.jobs.map(j => `${esc(j.queue)}:${esc(j.state)}`).join(" ")}</td>
+    <td class="err">${g.jobs.map(j => esc(j.error || "")).filter(Boolean).join("; ")}</td></tr>`));
   try {
     const users = await api("/users");
     fill("users", users.map(u => `<tr><td>${esc(u.name)}</td><td>${esc(u.email)}</td>
@@ -169,6 +183,25 @@ async function createApp() {
 async function delApp(id) {
   try { await api(`/applications/${id}:delete`, {method: "POST", body: "{}"}); refresh(); }
   catch (e) { alert(e.message); }
+}
+async function createJob() {
+  try {
+    const queues = document.getElementById("job-queues").value
+      .split(",").map(s => s.trim()).filter(Boolean);
+    const type = document.getElementById("job-type").value;
+    // The preheat handler's contract (jobs/preheat.py): urls LIST +
+    // piece_size; sync_peers takes no args.
+    const args = {};
+    const url = document.getElementById("job-url").value;
+    if (type === "preheat") {
+      if (!url) { alert("preheat needs a url"); return; }
+      args.urls = [url];
+      args.piece_size = 4 * 1024 * 1024;
+    }
+    await api("/jobs", {method: "POST", body: JSON.stringify(
+      {type: type, queues: queues, args: args})});
+    refresh();
+  } catch (e) { alert(e.message); }
 }
 async function createPat() {
   try {

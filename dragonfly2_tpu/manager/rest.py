@@ -312,6 +312,9 @@ class ManagerRESTServer:
                         )
                     except KeyError:
                         self._json(404, {"error": f"no provider {name!r}"})
+                elif path == "/api/v1/jobs":
+                    # Recent group jobs (console view; handlers/job.go list).
+                    self._json(200, server.jobqueue.list_groups())
                 elif path.startswith("/api/v1/jobs/"):
                     gid = path[len("/api/v1/jobs/"):]
                     try:

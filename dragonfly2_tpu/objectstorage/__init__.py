@@ -16,6 +16,7 @@ from .backend import (  # noqa: F401
 )
 from .s3 import (  # noqa: F401
     ObjectStorageError,
+    OBSBackend,
     OSSBackend,
     S3Backend,
     make_backend,

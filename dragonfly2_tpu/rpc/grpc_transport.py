@@ -1153,7 +1153,13 @@ class GRPCRemoteRegistry:
 class GRPCTrainerClient:
     """Scheduler-side Train stream (announcer.go's uploader over gRPC)."""
 
-    CHUNK_BYTES = 128 << 20  # announcer.go:39-41
+    # The HTTP transport keeps the announcer's 128 MiB framing
+    # (announcer.go:39-41); grpc-python's per-message copy cost grows
+    # with message size, so THIS client streams 4 MiB chunks — measured
+    # 490 vs 131 MB/s against 128 MiB messages (tools/bench_wire_ingest
+    # sweep, BENCHMARKS.md).  The server accepts either (seq-ordered
+    # appends; receive cap still fits a 128 MiB-chunk sender).
+    CHUNK_BYTES = 4 << 20
 
     def __init__(self, target: str, *, timeout: float = 600.0) -> None:
         self._channel = grpc.insecure_channel(
@@ -1181,8 +1187,8 @@ class GRPCTrainerClient:
         download_shards=(),
         topology_shards=(),
     ) -> str:
-        """Stream both dataset files in 128 MiB chunks over ONE stream
-        (announcer.go:144-171), returning the run key."""
+        """Stream both dataset files in ``CHUNK_BYTES`` chunks over ONE
+        stream (announcer.go:144-171 flow), returning the run key."""
 
         def chunks():
             yield pb.TrainChunk(ip=ip, hostname=hostname, scheduler_id=scheduler_id)

@@ -32,15 +32,19 @@ def sign_oss(
     content_type: str = "",
     oss_headers: Optional[dict] = None,
     resource: Optional[str] = None,
+    header_prefix: str = "x-oss-",
 ) -> str:
     """``resource`` overrides the default ``/{bucket}/{key}`` canonical
     resource — service-level requests (list buckets) sign the bare "/"
-    that the bucket/key form cannot express."""
+    that the bucket/key form cannot express.  ``header_prefix`` selects
+    the vendor header namespace: Huawei OBS uses the SAME HMAC-SHA1
+    canonical scheme with ``x-obs-`` headers (one signer for both,
+    objectstorage.go:179-212 dispatch parity)."""
     canon_headers = ""
     if oss_headers:
         lower = {
             k.lower(): v for k, v in oss_headers.items()
-            if k.lower().startswith("x-oss-")
+            if k.lower().startswith(header_prefix)
         }
         canon_headers = "".join(f"{k}:{lower[k]}\n" for k in sorted(lower))
     if resource is None:

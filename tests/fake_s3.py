@@ -23,9 +23,13 @@ REGION = "eu-fake-1"
 
 
 class FakeS3:
-    def __init__(self):
+    def __init__(self, auth: str = "sigv4"):
+        """``auth``: "sigv4" (S3) or "obs" (Huawei OBS header scheme) —
+        the same in-memory store behind either verifier, so every
+        backend's signing is checked by independent recomputation."""
         self.buckets = {}  # bucket → {key: (bytes, mtime)}
         self.lock = threading.Lock()
+        self.auth = auth
         self.auth_failures = 0
         fake = self
 
@@ -48,6 +52,8 @@ class FakeS3:
                     self.wfile.write(body)
 
             def _check_sig(self, payload: bytes) -> bool:
+                if fake.auth == "obs":
+                    return self._check_obs_sig()
                 auth = self.headers.get("Authorization", "")
                 if not auth.startswith("AWS4-HMAC-SHA256"):
                     return False
@@ -76,6 +82,28 @@ class FakeS3:
                     fake.auth_failures += 1
                 return ok
 
+            def _check_obs_sig(self) -> bool:
+                from dragonfly2_tpu.source.oss import sign_oss
+
+                auth = self.headers.get("Authorization", "")
+                if not auth.startswith(f"OBS {ACCESS_KEY}:"):
+                    fake.auth_failures += 1
+                    return False
+                bucket, key, _ = self._route()
+                expect = sign_oss(
+                    SECRET_KEY, self.command,
+                    date=self.headers.get("Date", ""),
+                    bucket=bucket, key=key,
+                    content_type=self.headers.get("Content-Type", ""),
+                    oss_headers=dict(self.headers),
+                    resource=None if bucket else "/",
+                    header_prefix="x-obs-",
+                )
+                ok = auth == f"OBS {ACCESS_KEY}:{expect}"
+                if not ok:
+                    fake.auth_failures += 1
+                return ok
+
             def _route(self):
                 split = urlsplit(self.path)
                 parts = split.path.lstrip("/").split("/", 1)
@@ -98,7 +126,8 @@ class FakeS3:
                     if bucket not in fake.buckets:
                         self._reply(404)
                         return
-                    src = self.headers.get("x-amz-copy-source")
+                    src = self.headers.get("x-amz-copy-source") or \
+                        self.headers.get("x-obs-copy-source")
                     if src:
                         sb, sk = src.lstrip("/").split("/", 1)
                         stored = fake.buckets.get(sb, {}).get(sk)

@@ -60,6 +60,24 @@ class TestJobsAPI:
         worker2.poll_once()
         assert client.group_state(group["group_id"])["state"] == "SUCCESS"
 
+    def test_list_groups_feeds_console(self, broker_server):
+        """GET /api/v1/jobs: recent group snapshots, newest first — the
+        console's jobs panel view."""
+        server, jq = broker_server
+        client = RemoteJobClient(server.url)
+        g1 = client.create_group("preheat", {"urls": ["u"]}, ["q-1"])
+        g2 = client.create_group("sync_peers", {}, ["q-1", "q-2"])
+        with urllib.request.urlopen(server.url + "/api/v1/jobs", timeout=5) as r:
+            groups = json.loads(r.read())
+        assert [g["group_id"] for g in groups[:2]] == [
+            g2["group_id"], g1["group_id"]
+        ]
+        assert len(groups[0]["jobs"]) == 2
+        # The console SPA ships the panel that drives these routes.
+        from dragonfly2_tpu.manager.console import CONSOLE_HTML
+
+        assert 'api("/jobs"' in CONSOLE_HTML and "createJob" in CONSOLE_HTML
+
     def test_handler_failure_reported(self, broker_server):
         server, jq = broker_server
         client = RemoteJobClient(server.url)

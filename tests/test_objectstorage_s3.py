@@ -1,10 +1,11 @@
-"""S3/OSS object-storage backends (VERDICT r2 next-#6): signed HTTP
-backends against a signature-VERIFYING fake S3, config dispatch, and the
-gateway e2e over the S3 backend."""
+"""S3/OSS/OBS object-storage backends (VERDICT r2 next-#6, r3 next-#8):
+signed HTTP backends against signature-VERIFYING fakes, config dispatch,
+and the gateway e2e over the S3 and OBS backends."""
 
 import pytest
 
 from dragonfly2_tpu.objectstorage import (
+    OBSBackend,
     OSSBackend,
     S3Backend,
     make_backend,
@@ -24,6 +25,21 @@ def s3(fake_s3):
     return S3Backend(
         fake_s3.endpoint, access_key=ACCESS_KEY, secret_key=SECRET_KEY,
         region=REGION,
+    )
+
+
+@pytest.fixture()
+def fake_obs():
+    srv = FakeS3(auth="obs")
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def obs(fake_obs):
+    return make_backend(
+        "obs", endpoint=fake_obs.endpoint,
+        access_key=ACCESS_KEY, secret_key=SECRET_KEY,
     )
 
 
@@ -122,6 +138,62 @@ class TestGatewayOverS3:
         gws[0].delete_object("models/ranker.npz")
         assert not gws[1].object_exists("models/ranker.npz")
         assert fake_s3.auth_failures == 0
+
+
+class TestOBSBackend:
+    """OBS backend selected by config (make_backend("obs")) against the
+    header-signature-verifying fake — the r3 next-#8 done-condition."""
+
+    def test_crud_copy_list_against_verifying_fake(self, fake_obs, obs):
+        assert isinstance(obs, OBSBackend)
+        obs.create_bucket("bkt")
+        assert obs.bucket_exists("bkt")
+        obs.put_object("bkt", "m/a.npz", b"obs-payload")
+        assert obs.get_object("bkt", "m/a.npz") == b"obs-payload"
+        assert obs.head_object("bkt", "m/a.npz").content_length == 11
+        copied = obs.copy_object("bkt", "m/a.npz", "m/b.npz")
+        assert copied.content_length == 11
+        assert [m.key for m in obs.list_objects("bkt", prefix="m/")] == [
+            "m/a.npz", "m/b.npz",
+        ]
+        obs.delete_object("bkt", "m/a.npz")
+        assert not obs.object_exists("bkt", "m/a.npz")
+        # Every request carried an OBS signature the server RECOMPUTED.
+        assert fake_obs.auth_failures == 0
+
+    def test_bad_credentials_rejected(self, fake_obs):
+        from dragonfly2_tpu.objectstorage import ObjectStorageError
+
+        bad = make_backend(
+            "obs", endpoint=fake_obs.endpoint,
+            access_key=ACCESS_KEY, secret_key="wrong",
+        )
+        with pytest.raises((ObjectStorageError, OSError)):
+            bad.create_bucket("nope")
+        assert fake_obs.auth_failures > 0
+
+    def test_gateway_e2e_on_fake_obs(self, tmp_path, fake_obs, obs):
+        """The daemon gateway's put→seed→P2P-read loop over the OBS
+        backend — same suite the S3 backend passes."""
+        from dragonfly2_tpu.daemon.gateway import (
+            GatewayConfig,
+            GatewaySourceFetcher,
+            ObjectGateway,
+        )
+        from tests.test_daemon import PIECE, _Swarm
+
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        for d in swarm.daemons:
+            d.conductor.source_fetcher = GatewaySourceFetcher(obs)
+        gws = [
+            ObjectGateway(d, obs, GatewayConfig(piece_size=PIECE))
+            for d in swarm.daemons
+        ]
+        payload = bytes(i % 249 for i in range(2 * PIECE + 13))
+        gws[0].put_object("models/r.npz", payload)
+        assert obs.get_object("dragonfly", "models/r.npz") == payload
+        assert gws[1].get_object("models/r.npz") == payload
+        assert fake_obs.auth_failures == 0
 
 
 class TestOSSSigning:
