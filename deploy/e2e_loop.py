@@ -191,7 +191,18 @@ def main() -> int:
     call(MANAGER, "POST", "/api/v1/clusters/default:update",
          {"scheduler_cluster_config": {
              "candidate_parent_limit": 1, "filter_parent_limit": 15}})
-    client = RemoteScheduler(SCHEDULER)
+    # Multi-replica: blob-1's swarm state lives on its consistent-hash
+    # owner — register the probe peer THERE (any other replica would
+    # see a brand-new task with no parents).
+    scheduler_for_blob1 = SCHEDULER
+    if os.environ.get("SCHEDULER_B_URL"):
+        from dragonfly2_tpu.rpc.balancer import HashRing
+        from dragonfly2_tpu.utils import idgen
+
+        scheduler_for_blob1 = HashRing(
+            [SCHEDULER, os.environ["SCHEDULER_B_URL"]]
+        ).pick(idgen.task_id(url))
+    client = RemoteScheduler(scheduler_for_blob1)
     probe_host = Host(id="e2e-probe", hostname="e2e-probe", ip="127.0.0.1",
                       download_port=1)
 
@@ -205,6 +216,67 @@ def main() -> int:
         "live candidate limit", lambda: parents_now() == 1 and 1, timeout=60
     )
     log(f"cluster-config PATCH applied live: {n_parents} candidate parent")
+
+    # -- 6. multi-replica: steering + cross-replica topology ----------------
+    scheduler_b = os.environ.get("SCHEDULER_B_URL", "")
+    if scheduler_b:
+        from dragonfly2_tpu.rpc.balancer import HashRing
+        from dragonfly2_tpu.utils import idgen
+
+        ring = HashRing([SCHEDULER, scheduler_b])
+        # Find a blob whose task hashes to EACH replica, download both
+        # through daemon A, and verify the swarm state lives exactly on
+        # the ring-predicted owner (a child registration there sees
+        # daemon A as a parent).
+        owners = {}
+        i = 0
+        while len(set(owners.values())) < 2 and i < 64:
+            name = f"steer-{i}"
+            owners[name] = ring.pick(idgen.task_id(f"{ORIGIN_URL}/{name}"))
+            i += 1
+        assert len(set(owners.values())) == 2, "hash ring never split"
+        picks = {}
+        for name, owner in owners.items():
+            if owner not in picks:
+                picks[owner] = name
+        for owner_url, name in picks.items():
+            url2 = f"{ORIGIN_URL}/{name}"
+            r = call(DAEMON_A, "POST", "/download",
+                     {"url": url2, "piece_size": PIECE}, timeout=120)
+            assert r.get("ok"), r
+            owner_client = RemoteScheduler(owner_url)
+            probe2 = Host(id=f"e2e-steer-{name}", hostname="e2e-steer",
+                          ip="127.0.0.1", download_port=1)
+            reg = owner_client.register_peer(host=probe2, url=url2)
+            parent_hosts = {
+                p.host.id for p in (reg.schedule.parents or [])
+            } if reg.schedule and reg.schedule.parents else set()
+            owner_client.report_peer_failed(reg.peer)
+            assert parent_hosts, (
+                f"task {name} not on its ring owner {owner_url}"
+            )
+        log(f"steering: tasks {sorted(picks.values())} landed on their "
+            f"ring owners across 2 replicas")
+
+        # A probe pushed to replica A becomes ranking input (folded RTT)
+        # on replica B via the manager's shared-topology sync.
+        a = RemoteScheduler(SCHEDULER)
+        src = Host(id="e2e-prober", hostname="e2e-prober", ip="127.0.0.1",
+                   download_port=1)
+        dst = Host(id="e2e-probed", hostname="e2e-probed", ip="127.0.0.2",
+                   download_port=1)
+        a.announce_host(src)
+        a.announce_host(dst)
+        a.sync_probes_finished(src, [(dst.id, 7_500_000)])
+        b = RemoteScheduler(scheduler_b)
+
+        def rtt_on_b():
+            out = b._call("topology_rtt", {"src": src.id, "dst": dst.id})
+            return out.get("rtt_ns")
+
+        rtt = wait_for("cross-replica topology sync", rtt_on_b, timeout=60)
+        assert abs(rtt - 7_500_000) < 2_000_000, rtt
+        log(f"probe pushed to replica A ranks on replica B (rtt {rtt} ns)")
 
     log("ALL STAGES PASSED")
     return 0

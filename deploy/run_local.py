@@ -6,6 +6,10 @@ with the SAME services and the SAME deploy/e2e_loop.py).
   python deploy/run_local.py          # exit 0 = cluster up + loop passed
   python deploy/run_local.py --mtls   # same, with auto-issued mTLS on the
                                       # piece plane (manager-hosted CA)
+  python deploy/run_local.py --replicas N
+                                      # N scheduler replicas: daemons
+                                      # steer tasks by consistent hash,
+                                      # probe graph shared via the manager
 """
 
 from __future__ import annotations
@@ -26,6 +30,15 @@ PIECE = 64 * 1024
 
 def main() -> int:
     mtls = "--mtls" in sys.argv[1:]
+    replicas = 1
+    argv = sys.argv[1:]
+    if "--replicas" in argv:
+        i = argv.index("--replicas")
+        # Value optional: bare "--replicas" means 2.
+        if i + 1 < len(argv) and argv[i + 1].isdigit():
+            replicas = max(int(argv[i + 1]), 1)
+        else:
+            replicas = 2
     tmp = tempfile.mkdtemp(prefix="df-local-")
     # Hermetic JAX: the harness only needs CPU (the trainer's TPU path is
     # exercised by bench.py / the driver); inheriting an ambient
@@ -114,7 +127,8 @@ def main() -> int:
             f"storage: {{dir: {tmp}/records, buffer_size: 1}}\n"
             f"manager_addr: {manager_url}\n"
             "dynconfig_refresh_s: 5.0\n"
-            "topology_sync_interval_s: 10.0\n"
+            + ("topology_sync_interval_s: 3.0\n" if replicas > 1
+               else "topology_sync_interval_s: 10.0\n")
             + ("security: {auto_issue: true}\n" if mtls else "")
         ))
         sout = spawn("scheduler",
@@ -122,6 +136,26 @@ def main() -> int:
                      ["scheduler: serving"])
         scheduler_url = re.search(r"rpc on (\S+?),",
                                   sout["scheduler: serving"] + ",").group(1)
+        replica_urls = []
+        for n in range(1, replicas):
+            # Replica N: same manager, own storage — the probe graph
+            # crosses replicas only through the manager's topology sync.
+            sbcfg = write(f"scheduler-{n}.yaml", (
+                "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
+                "scheduling: {retry_interval_s: 0.1}\n"
+                f"storage: {{dir: {tmp}/records-{n}, buffer_size: 1}}\n"
+                f"manager_addr: {manager_url}\n"
+                "dynconfig_refresh_s: 5.0\n"
+                "topology_sync_interval_s: 3.0\n"
+                + ("security: {auto_issue: true}\n" if mtls else "")
+            ))
+            sbout = spawn(f"scheduler-{n}",
+                          ["dragonfly2_tpu.cli.scheduler", "--config", sbcfg],
+                          ["scheduler: serving"])
+            replica_urls.append(re.search(
+                r"rpc on (\S+?),", sbout["scheduler: serving"] + ","
+            ).group(1))
+        scheduler_b_url = replica_urls[0] if replica_urls else ""
 
         # Auto-issued mTLS: every daemon bootstraps its identity from the
         # manager's cluster CA at boot; the piece plane then moves bytes
@@ -136,8 +170,9 @@ def main() -> int:
             f"piece_size: {PIECE}\n"
             + mtls_yaml
         ))
+        daemon_scheduler_arg = ",".join([scheduler_url] + replica_urls)
         spawn("seed",
-              ["dragonfly2_tpu.cli.dfdaemon", "--scheduler", scheduler_url,
+              ["dragonfly2_tpu.cli.dfdaemon", "--scheduler", daemon_scheduler_arg,
                "--config", seedcfg, "--seed-peer"],
               ["dfdaemon: serving"],
               {"DF_DAEMON_STATE": f"{tmp}/seed.json"})
@@ -152,7 +187,7 @@ def main() -> int:
             ))
             dout = spawn(name,
                          ["dragonfly2_tpu.cli.dfdaemon", "--scheduler",
-                          scheduler_url, "--config", dcfg],
+                          daemon_scheduler_arg, "--config", dcfg],
                          ["dfdaemon: serving"],
                          {"DF_DAEMON_STATE": f"{tmp}/{name}.json"})
             controls[name] = re.search(
@@ -172,6 +207,7 @@ def main() -> int:
             **env,
             "MANAGER_URL": manager_url,
             "SCHEDULER_URL": scheduler_url,
+            "SCHEDULER_B_URL": scheduler_b_url,
             "TRAINER_URL": trainer_url,
             "DAEMON_A_CONTROL": controls["daemon-a"],
             "DAEMON_B_CONTROL": controls["daemon-b"],

@@ -79,34 +79,46 @@ def build(cfg: DaemonConfig, scheduler_url: str):
     # path); Python HTTP remains the fallback/TLS server.
     from ..rpc.piece_transport import make_piece_server
 
+    # Bind the CONFIGURED piece port (0 = ephemeral): deployments pin it
+    # (k8s containerPort / NetworkPolicies key on it) while test
+    # clusters pass 0.
     piece_server = make_piece_server(
-        upload, host=cfg.server.host, ssl_context=serve_ssl,
+        upload, host=cfg.server.host, port=cfg.server.port,
+        ssl_context=serve_ssl,
     )
     piece_server.serve()
-    if scheduler_url.startswith("grpc://"):
-        # Streaming variant: per-peer calls ride the bidi announce_peer
-        # stream so the scheduler can push mid-download reschedules
-        # (unary fallback built in on stream failure).
-        from ..rpc.grpc_transport import GRPCStreamingScheduler
+    channel_creds = None
+    if identity is not None and cfg.security.scheduler_grpc_tls:
+        # The scheduler's gRPC port runs mTLS when the cluster
+        # auto-issues — dial with this daemon's issued identity.
+        # (security.scheduler_grpc_tls: false covers mixed clusters
+        # whose scheduler port is still plaintext.)
+        import grpc as _grpc
 
-        channel_creds = None
-        if identity is not None and cfg.security.scheduler_grpc_tls:
-            # The scheduler's gRPC port runs mTLS when the cluster
-            # auto-issues — dial with this daemon's issued identity.
-            # (security.scheduler_grpc_tls: false covers mixed clusters
-            # whose scheduler port is still plaintext.)
-            import grpc as _grpc
-
-            channel_creds = _grpc.ssl_channel_credentials(
-                root_certificates=identity.ca_pem,
-                private_key=identity.key_pem,
-                certificate_chain=identity.cert_pem,
-            )
-        scheduler_client_cls = lambda url: GRPCStreamingScheduler(  # noqa: E731
-            url[len("grpc://"):], channel_credentials=channel_creds
+        channel_creds = _grpc.ssl_channel_credentials(
+            root_certificates=identity.ca_pem,
+            private_key=identity.key_pem,
+            certificate_chain=identity.cert_pem,
         )
-    else:
-        scheduler_client_cls = RemoteScheduler
+
+    def scheduler_client_cls(url: str):
+        if url.startswith("grpc://"):
+            # Streaming variant: per-peer calls ride the bidi
+            # announce_peer stream so the scheduler can push
+            # mid-download reschedules (unary fallback on stream
+            # failure).
+            from ..rpc.grpc_transport import GRPCStreamingScheduler
+
+            return GRPCStreamingScheduler(
+                url[len("grpc://"):], channel_credentials=channel_creds
+            )
+        return RemoteScheduler(url)
+
+    # Comma-separated scheduler list → consistent-hash steering: each
+    # task's swarm state lives on ONE replica (pkg/balancer semantics,
+    # rpc/steering.py); probes pin per host and reach the other replicas
+    # via the manager's shared-topology sync.
+    scheduler_urls = [u.strip() for u in scheduler_url.split(",") if u.strip()]
 
     host = Host(
         # The piece port joins the identity so multiple daemons on one
@@ -119,7 +131,14 @@ def build(cfg: DaemonConfig, scheduler_url: str):
         download_port=piece_server.port,
         concurrent_upload_limit=cfg.concurrent_upload_limit,
     )
-    client = scheduler_client_cls(scheduler_url)
+    if len(scheduler_urls) > 1:
+        from ..rpc.steering import SteeringSchedulerClient
+
+        client = SteeringSchedulerClient(
+            scheduler_urls, factory=scheduler_client_cls
+        )
+    else:
+        client = scheduler_client_cls(scheduler_urls[0])
     conductor = Conductor(
         host,
         storage,

@@ -221,10 +221,13 @@ def run(argv=None) -> int:
     # sync_peers fan-outs work across process boundaries.
     # ONE identity for registration, job-queue naming, and the announcer's
     # keepalive tick — their equality is load-bearing (the keepalive
-    # self-heal only re-registers the id it registered).
+    # self-heal only re-registers the id it registered).  The serving
+    # port joins the id so REPLICAS on one host (process clusters,
+    # sidecar deployments) stay distinct in the manager's cluster table
+    # and the job broker's queue names.
     import socket as _socket
 
-    scheduler_id = f"sched-{_socket.gethostname()}"
+    scheduler_id = f"sched-{_socket.gethostname()}-{rpc_server.address[1]}"
     job_worker = None
     cluster_link = None
     dynconfig = None

@@ -66,7 +66,7 @@ CONSOLE_HTML = """<!DOCTYPE html>
 
   <h2>Jobs <span class="muted">(async group fan-out: preheat / sync_peers)</span></h2>
   <select id="job-type"><option>preheat</option><option>sync_peers</option></select>
-  <input id="job-queues" placeholder="queues (sched-a,sched-b)">
+  <input id="job-queues" placeholder="scheduler ids (see table above; blank = all active)">
   <input id="job-url" placeholder="url (preheat)">
   <button onclick="createJob()">Create</button>
   <table id="jobs"><thead><tr>
@@ -186,8 +186,16 @@ async function delApp(id) {
 }
 async function createJob() {
   try {
-    const queues = document.getElementById("job-queues").value
+    // Workers poll "scheduler:<id>" (cli/scheduler wiring) — accept bare
+    // scheduler ids and prefix them; blank = every ACTIVE scheduler.
+    let ids = document.getElementById("job-queues").value
       .split(",").map(s => s.trim()).filter(Boolean);
+    if (!ids.length) {
+      ids = (await api("/schedulers"))
+        .filter(s => s.state === "active").map(s => s.id);
+      if (!ids.length) { alert("no active schedulers"); return; }
+    }
+    const queues = ids.map(q => q.includes(":") ? q : "scheduler:" + q);
     const type = document.getElementById("job-type").value;
     // The preheat handler's contract (jobs/preheat.py): urls LIST +
     // piece_size; sync_peers takes no args.

@@ -234,6 +234,17 @@ class SchedulerRPCAdapter:
             )
         return {}
 
+    def topology_rtt(self, req: dict) -> dict:
+        """Observability read: THIS replica's folded probe-graph RTT for
+        one edge (the nt-evaluator's ranking input) — how a deployed
+        multi-replica e2e proves a probe pushed to replica A reached
+        replica B's evaluator via the manager's shared-topology sync
+        (the reference inspects this state in redis)."""
+        nt = getattr(self.service, "networktopology", None)
+        if nt is None:
+            return {"rtt_ns": None}
+        return {"rtt_ns": nt.average_rtt(req["src"], req["dst"])}
+
     METHODS = frozenset(
         {
             "announce_host",
@@ -248,6 +259,7 @@ class SchedulerRPCAdapter:
             "leave_peer",
             "sync_probes_start",
             "sync_probes_finished",
+            "topology_rtt",
         }
     )
 

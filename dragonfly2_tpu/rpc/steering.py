@@ -1,0 +1,154 @@
+"""Multi-scheduler steering client (reference: pkg/balancer's
+consistent-hash gRPC picker + resolver/scheduler_resolver.go).
+
+The reference daemon holds a scheduler LIST and its balancer hashes each
+task id onto the ring so one task's whole swarm state lives on one
+scheduler replica.  ``SteeringSchedulerClient`` is that picker as a
+drop-in for the Conductor's single-scheduler client surface:
+
+- task-scoped calls (register/report/leave/...) route to the replica
+  owning ``peer.task.id`` on the ring — stable for the task's lifetime;
+- host-scoped announces fan out to every replica (each keeps its own
+  host inventory);
+- probe sync (``sync_probes_*``) pins each HOST to one replica by host
+  id — the probe graph still reaches the other replicas through the
+  manager's shared-topology sync (scheduler/topology_sync.py), which is
+  exactly the cross-replica property the deployment e2e asserts;
+- ``resolve_host`` asks the task-agnostic replicas in ring order until
+  one knows the host (parents may have announced anywhere).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .balancer import HashRing
+
+
+def default_scheduler_factory(url: str):
+    """URL scheme → client: grpc://host:port or http(s)://..."""
+    if url.startswith("grpc://"):
+        from .grpc_transport import GRPCStreamingScheduler
+
+        return GRPCStreamingScheduler(url[len("grpc://"):])
+    from .scheduler_client import RemoteScheduler
+
+    return RemoteScheduler(url)
+
+
+class SteeringSchedulerClient:
+    def __init__(
+        self,
+        urls: Sequence[str],
+        *,
+        factory: Optional[Callable] = None,
+    ) -> None:
+        if not urls:
+            raise ValueError("SteeringSchedulerClient needs >= 1 scheduler url")
+        factory = factory or default_scheduler_factory
+        self._clients: Dict[str, object] = {u: factory(u) for u in urls}
+        self._ring = HashRing(list(urls))
+
+    # -- routing -------------------------------------------------------------
+
+    def _owner(self, key: str):
+        return self._clients[self._ring.pick(key)]
+
+    def for_task(self, task_id: str):
+        """The replica owning this task (exposed for tests/debugging)."""
+        return self._owner(task_id)
+
+    def backends(self) -> List[str]:
+        return sorted(self._clients)
+
+    # -- host-scoped ---------------------------------------------------------
+
+    def announce_host(self, host) -> None:
+        # Per-replica isolation: one down replica must not starve the
+        # healthy ones of announces (their host-TTL GC would evict this
+        # daemon).  Raise only when EVERY replica failed.
+        last_exc: Optional[Exception] = None
+        ok = 0
+        for c in self._clients.values():
+            try:
+                c.announce_host(host)
+                ok += 1
+            except Exception as exc:  # noqa: BLE001 — replica outage
+                last_exc = exc
+        if ok == 0 and last_exc is not None:
+            raise last_exc
+
+    def leave_host(self, host) -> None:
+        for c in self._clients.values():
+            leave = getattr(c, "leave_host", None)
+            if leave is None:
+                continue
+            try:
+                leave(host)
+            except Exception:  # noqa: BLE001 — best-effort on shutdown
+                pass
+
+    def sync_probes_start(self, host):
+        return self._owner(host.id).sync_probes_start(host)
+
+    def sync_probes_finished(self, host, results) -> None:
+        self._owner(host.id).sync_probes_finished(host, results)
+
+    def resolve_host(self, host_id: str):
+        last_exc: Optional[Exception] = None
+        for c in self._clients.values():
+            try:
+                return c.resolve_host(host_id)
+            except Exception as exc:  # noqa: BLE001 — try the next replica
+                last_exc = exc
+        raise last_exc if last_exc else KeyError(host_id)
+
+    # -- task-scoped ---------------------------------------------------------
+
+    def register_peer(self, *, host, url, task_id=None, **kw):
+        if task_id is None:
+            from ..utils import idgen
+
+            task_id = idgen.task_id(url)
+        return self._owner(task_id).register_peer(
+            host=host, url=url, task_id=task_id, **kw
+        )
+
+    def _peer_owner(self, peer):
+        return self._owner(peer.task.id)
+
+    def set_task_info(self, peer, *a, **kw):
+        return self._peer_owner(peer).set_task_info(peer, *a, **kw)
+
+    def report_piece_finished(self, peer, *a, **kw):
+        return self._peer_owner(peer).report_piece_finished(peer, *a, **kw)
+
+    def report_piece_failed(self, peer, *a, **kw):
+        return self._peer_owner(peer).report_piece_failed(peer, *a, **kw)
+
+    def report_peer_finished(self, peer):
+        return self._peer_owner(peer).report_peer_finished(peer)
+
+    def report_peer_failed(self, peer):
+        return self._peer_owner(peer).report_peer_failed(peer)
+
+    def set_task_direct_piece(self, peer, data):
+        return self._peer_owner(peer).set_task_direct_piece(peer, data)
+
+    def mark_back_to_source(self, peer):
+        return self._peer_owner(peer).mark_back_to_source(peer)
+
+    def leave_peer(self, peer):
+        return self._peer_owner(peer).leave_peer(peer)
+
+    def take_pushed_schedule(self, peer):
+        """Server-push adoption: only streaming transports have it; a
+        mixed ring degrades to None (no push) for the others."""
+        take = getattr(self._peer_owner(peer), "take_pushed_schedule", None)
+        return take(peer) if take is not None else None
+
+    def close(self) -> None:
+        for c in self._clients.values():
+            close = getattr(c, "close", None)
+            if close is not None:
+                close()

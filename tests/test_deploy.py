@@ -61,6 +61,68 @@ class TestComposeArtifacts:
         assert 'ENTRYPOINT ["python", "-m"]' in content
 
 
+class TestK8sManifests:
+    def test_manifests_parse_and_mirror_compose(self):
+        """deploy/k8s/dragonfly.yaml (VERDICT r3 next-#6): every document
+        is well-formed, the workload set mirrors the compose topology
+        with TWO scheduler replicas, and every CLI entrypoint exists."""
+        with open(os.path.join(DEPLOY, "k8s", "dragonfly.yaml")) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        by_kind = {}
+        for d in docs:
+            assert d["apiVersion"] and d["kind"] and d["metadata"]["name"]
+            by_kind.setdefault(d["kind"], {})[d["metadata"]["name"]] = d
+
+        assert set(by_kind["Service"]) == {"manager", "scheduler", "trainer"}
+        assert set(by_kind["Deployment"]) == {"manager", "trainer", "seed"}
+        assert set(by_kind["StatefulSet"]) == {"scheduler"}
+        assert set(by_kind["DaemonSet"]) == {"daemon"}
+
+        # Two scheduler replicas behind a HEADLESS service (steering
+        # needs per-pod addresses, not a VIP).
+        sched = by_kind["StatefulSet"]["scheduler"]
+        assert sched["spec"]["replicas"] == 2
+        # k8s headless services take the literal string "None".
+        assert by_kind["Service"]["scheduler"]["spec"]["clusterIP"] in (
+            "None", None,
+        )
+
+        workloads = (
+            list(by_kind["Deployment"].values())
+            + list(by_kind["StatefulSet"].values())
+            + list(by_kind["DaemonSet"].values())
+        )
+        for wl in workloads:
+            spec = wl["spec"]["template"]["spec"]
+            c = spec["containers"][0]
+            assert c["image"] == "dragonfly2-tpu"  # the compose image
+            assert c["command"][:2] == ["python", "-m"]
+            __import__(c["command"][2])  # entrypoint exists
+            # Selector must actually match the pod template labels.
+            sel = wl["spec"]["selector"]["matchLabels"]
+            labels = wl["spec"]["template"]["metadata"]["labels"]
+            assert all(labels.get(k) == v for k, v in sel.items())
+            # Config mounted from the shared ConfigMap, like compose
+            # mounts deploy/config.
+            mounts = {m["name"] for m in c["volumeMounts"]}
+            vols = {v["name"] for v in spec.get("volumes", [])}
+            assert "config" in mounts
+            assert "config" in vols
+
+        # Daemons steer over BOTH replicas' stable per-pod DNS names.
+        daemon_cmd = " ".join(
+            by_kind["DaemonSet"]["daemon"]["spec"]["template"]["spec"][
+                "containers"
+            ][0]["command"]
+        )
+        assert "scheduler-0.scheduler" in daemon_cmd
+        assert "scheduler-1.scheduler" in daemon_cmd
+
+        # Service ports target the ports the configs bind.
+        assert by_kind["Service"]["manager"]["spec"]["ports"][0]["port"] == 65003
+        assert by_kind["Service"]["scheduler"]["spec"]["ports"][0]["port"] == 8002
+
+
 class TestClusterE2E:
     def test_run_local_cluster_loop(self):
         """One command: the full cluster comes up (manager + scheduler +
@@ -73,6 +135,22 @@ class TestClusterE2E:
         )
         assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
         assert "ALL STAGES PASSED" in r.stdout
+
+    def test_run_local_two_scheduler_replicas(self):
+        """The DEPLOYED 2-replica topology (VERDICT r3 next-#6): daemons
+        steer tasks onto their consistent-hash owner, and a probe pushed
+        to replica A becomes ranking input on replica B via the
+        manager's shared-topology sync."""
+        r = subprocess.run(
+            [sys.executable, os.path.join(DEPLOY, "run_local.py"),
+             "--replicas"],
+            capture_output=True, text=True, timeout=420,
+            env={**os.environ, "PYTHONPATH": os.getcwd()},
+        )
+        assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+        assert "ALL STAGES PASSED" in r.stdout
+        assert "landed on their ring owners" in r.stdout
+        assert "ranks on replica B" in r.stdout
 
     def test_run_local_cluster_loop_mtls(self):
         """The SAME composed topology with auto-issued mTLS on: every
