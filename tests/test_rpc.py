@@ -261,6 +261,10 @@ class TestServiceBinaries:
                 dc = DaemonConfig()
                 dc.storage.dir = str(tmp_path / f"dd{i}")
                 dc.piece_size = 65536
+                # Two daemons on one host: ephemeral piece ports (the
+                # piece server binds the CONFIGURED port since r4 — the
+                # default 65000 would collide here).
+                dc.server.port = 0
                 nodes.append(build_daemon(dc, url))
             for n in nodes:
                 n["announcer"].announce_once()
