@@ -54,6 +54,16 @@ def _encode_header(header: ColumnarHeader) -> bytes:
     return MAGIC + struct.pack(_LEN_FMT, len(payload)) + payload
 
 
+def _header_from_meta(meta: dict) -> ColumnarHeader:
+    """ONE place that maps the header's JSON meta onto ColumnarHeader —
+    the file reader and the streaming decoder must agree on defaults."""
+    return ColumnarHeader(
+        columns=tuple(meta["columns"]),
+        dtype=meta.get("dtype", "float32"),
+        created_at_ns=meta.get("created_at_ns", 0),
+    )
+
+
 def read_header(path: str) -> tuple[ColumnarHeader, int]:
     """Returns (header, data_offset)."""
     with open(path, "rb") as f:
@@ -62,12 +72,7 @@ def read_header(path: str) -> tuple[ColumnarHeader, int]:
             raise ValueError(f"{path}: bad magic {magic!r}")
         (hlen,) = struct.unpack(_LEN_FMT, f.read(4))
         meta = json.loads(f.read(hlen).decode("utf-8"))
-    header = ColumnarHeader(
-        columns=tuple(meta["columns"]),
-        dtype=meta.get("dtype", "float32"),
-        created_at_ns=meta.get("created_at_ns", 0),
-    )
-    return header, 8 + hlen
+    return _header_from_meta(meta), 8 + hlen
 
 
 class ColumnarWriter:
@@ -120,6 +125,47 @@ class ColumnarWriter:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class StreamingRowDecoder:
+    """Incremental DFC1 decode over a byte stream.
+
+    The mmap reader needs a whole file; the ONLINE ingest path
+    (trainer/service feeding trainer/online_graph straight off the
+    ``Train`` stream, service_v1.go:128-143 semantics) gets arbitrary
+    chunk boundaries mid-flight.  ``feed(data)`` buffers, parses the
+    header once, and returns every COMPLETE row received so far; the
+    partial tail stays buffered for the next chunk.
+    """
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+        self.header: ColumnarHeader | None = None
+        self.rows_decoded = 0
+
+    def feed(self, data: bytes) -> np.ndarray:
+        self._buf += data
+        if self.header is None:
+            if len(self._buf) < 8:
+                return np.zeros((0, 0), np.float32)
+            if bytes(self._buf[:4]) != MAGIC:
+                raise ValueError(f"bad magic {bytes(self._buf[:4])!r}")
+            (hlen,) = struct.unpack(_LEN_FMT, self._buf[4:8])
+            if len(self._buf) < 8 + hlen:
+                return np.zeros((0, 0), np.float32)
+            meta = json.loads(bytes(self._buf[8 : 8 + hlen]).decode("utf-8"))
+            self.header = _header_from_meta(meta)
+            del self._buf[: 8 + hlen]
+        rb = self.header.row_nbytes
+        n = len(self._buf) // rb
+        if n == 0:
+            return np.zeros((0, len(self.header.columns)), np.float32)
+        rows = np.frombuffer(
+            bytes(self._buf[: n * rb]), dtype=self.header.dtype
+        ).reshape(n, len(self.header.columns))
+        del self._buf[: n * rb]
+        self.rows_decoded += n
+        return rows
 
 
 class ColumnarReader:

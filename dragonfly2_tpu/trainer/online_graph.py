@@ -67,6 +67,112 @@ def state_hash(state) -> str:
     return h.hexdigest()
 
 
+class WireIngestAdapter:
+    """Routes the ``Train`` stream's DECODED rows into an
+    ``OnlineGraphTrainer`` — the reference's continuous two-stream feed
+    (service_v1.go:128-143) closed end to end over the real wire:
+    ``TrainerService(online_sink=this)`` + ``StreamingRowDecoder``.
+
+    Row endpoints arrive as HASH BUCKETS (records/features.py); the
+    adapter assigns dense node ids on first sight (capped at the
+    trainer's ``num_nodes`` — overflow edges are counted and dropped,
+    with a WARNING on first overflow, never silently remapped), keeps
+    per-node host-feature sums from the download payloads (the
+    node-feature stream), and hands the trainer a LAZY feature source —
+    the running mean is materialized once per snapshot build, not per
+    wire chunk.
+    """
+
+    def __init__(self, trainer: "OnlineGraphTrainer") -> None:
+        from ..records.features import HOST_FEATURE_DIM, NUM_HASH_BUCKETS
+
+        self.trainer = trainer
+        n = trainer.config.num_nodes
+        # Vectorized bucket → dense-id table (the ingest hot path must
+        # sustain wire rate): -2 = unseen, -1 = overflow.
+        self._id_table = np.full(NUM_HASH_BUCKETS, -2, np.int32)
+        self._next_id = 0
+        self._feat_sum = np.zeros((n, HOST_FEATURE_DIM), np.float32)
+        self._feat_cnt = np.zeros(n, np.float32)
+        self.overflow_edges = 0
+        self._mu = threading.Lock()
+        trainer.node_feature_source = self.node_features
+
+    def _map_ids(self, buckets: np.ndarray) -> np.ndarray:
+        """bucket → dense id; -1 for overflow (node table full).  One
+        vectorized gather in steady state; Python only touches buckets
+        never seen before."""
+        b = buckets.astype(np.int64)
+        out = self._id_table[b]
+        if (out == -2).any():
+            cap = self.trainer.config.num_nodes
+            for nb in np.unique(b[out == -2]):
+                if self._id_table[nb] != -2:
+                    continue
+                if self._next_id >= cap:
+                    self._id_table[nb] = -1
+                    continue
+                self._id_table[nb] = self._next_id
+                self._next_id += 1
+            out = self._id_table[b]
+        return out
+
+    def _count_overflow(self, n_dropped: int) -> None:
+        if n_dropped <= 0:
+            return
+        if self.overflow_edges == 0:
+            logger.warning(
+                "node table full (num_nodes=%d): dropping edges touching "
+                "unmapped hosts", self.trainer.config.num_nodes,
+            )
+        self.overflow_edges += n_dropped
+
+    def node_features(self) -> np.ndarray:
+        """Materialize the running per-node feature means — called by the
+        trainer ONCE per snapshot build (lazy; never per chunk)."""
+        with self._mu:
+            return self._feat_sum / np.maximum(self._feat_cnt[:, None], 1.0)
+
+    def feed_download_rows(self, rows: np.ndarray) -> None:
+        from ..records.features import HOST_FEATURE_DIM
+
+        if rows.size == 0:
+            return
+        with self._mu:
+            src = self._map_ids(rows[:, 0])
+            dst = self._map_ids(rows[:, 1])
+            ok = (src >= 0) & (dst >= 0)
+            self._count_overflow(int((~ok).sum()))
+            src, dst = src[ok], dst[ok]
+            kept = rows[ok]
+            # Node-feature stream: child features live at cols
+            # [2, 2+H), parent at [2+H, 2+2H) (features.py layout; same
+            # attribution the batch GNN path uses).
+            child_f = kept[:, 2 : 2 + HOST_FEATURE_DIM]
+            parent_f = kept[:, 2 + HOST_FEATURE_DIM : 2 + 2 * HOST_FEATURE_DIM]
+            np.add.at(self._feat_sum, src, parent_f)
+            np.add.at(self._feat_cnt, src, 1.0)
+            np.add.at(self._feat_sum, dst, child_f)
+            np.add.at(self._feat_cnt, dst, 1.0)
+        if len(src):
+            self.trainer.feed_downloads(
+                src, dst, kept[:, -1].astype(np.float32)
+            )
+
+    def feed_topology_rows(self, rows: np.ndarray) -> None:
+        if rows.size == 0:
+            return
+        with self._mu:
+            src = self._map_ids(rows[:, 0])
+            dst = self._map_ids(rows[:, 1])
+            ok = (src >= 0) & (dst >= 0)
+            self._count_overflow(int((~ok).sum()))
+            src, dst = src[ok], dst[ok]
+            rtt = rows[ok, 2].astype(np.float32)
+        if len(src):
+            self.trainer.feed_topology(src, dst, rtt)
+
+
 @dataclass
 class OnlineGraphConfig:
     num_nodes: int
@@ -106,6 +212,10 @@ class OnlineGraphTrainer:
         self._topo_count = 0
         self._fed_since_swap = 0
         self.node_feats = np.asarray(node_feats, np.float32)
+        # Optional lazy provider (the wire adapter sets it): consulted at
+        # each snapshot build INSTEAD of the last set_node_features value,
+        # so per-chunk feeds never materialize the full feature matrix.
+        self.node_feature_source = None
         self.feed_topology(topo_src, topo_dst, topo_rtt)
 
         self._downloads: "queue.Queue" = queue.Queue(maxsize=config.queue_capacity)
@@ -263,8 +373,15 @@ class OnlineGraphTrainer:
 
     # -- snapshot refresh ----------------------------------------------------
 
-    def _build_snapshot(self) -> None:
-        """window + node_feats → neighbor table + hop features (device)."""
+    def _build_snapshot(self, *, use_source: bool = True) -> None:
+        """window + node_feats → neighbor table + hop features (device).
+        ``use_source=False`` builds from the CURRENT node_feats — the
+        resume path restored them from the checkpoint and a fresh
+        adapter's (empty) means must not clobber them."""
+        if use_source and self.node_feature_source is not None:
+            self.node_feats = np.asarray(
+                self.node_feature_source(), np.float32
+            )
         src, dst, rtt = self._window
         self.table = build_neighbor_table(
             self.config.num_nodes, src, dst, rtt,
@@ -426,6 +543,11 @@ class OnlineGraphTrainer:
         ckptr.save(self._ckpt_path(), self._payload(), force=True)
         ckptr.wait_until_finished()
 
+    def make_wire_adapter(self) -> "WireIngestAdapter":
+        """An adapter TrainerService(online_sink=...) feeds straight off
+        the Train stream — the full wire → online-trainer path."""
+        return WireIngestAdapter(self)
+
     def resume(self) -> bool:
         """Restore params/opt/step/stream position AND rebuild the graph
         snapshot from the checkpointed topology window; False if no
@@ -476,5 +598,5 @@ class OnlineGraphTrainer:
             self._topo_parts = [pend] if len(pend[0]) else []
             self._topo_count = len(pend[0])
             self._fed_since_swap = int(restored["fed_since_swap"])
-        self._build_snapshot()
+        self._build_snapshot(use_source=False)
         return True

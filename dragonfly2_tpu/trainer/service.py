@@ -68,6 +68,7 @@ class TrainSession:
         self.download_shards: List[str] = []
         self.topology_shards: List[str] = []
         self.chunk_seq: Dict = {}  # (kind, name) -> last applied chunk seq
+        self.decoders: Dict = {}   # (kind, name) -> StreamingRowDecoder (online mode)
 
     def send_download_shard(self, path: str) -> None:
         self.download_shards.append(
@@ -96,6 +97,7 @@ class TrainerService:
         train_config: Optional[TrainConfig] = None,
         mlp_epochs: int = 30,
         gnn_model: str = "hop",
+        online_sink=None,
     ) -> None:
         self.registry = registry or ModelRegistry()
         self.data_dir = data_dir
@@ -108,6 +110,19 @@ class TrainerService:
         if gnn_model not in ("hop", "gat"):
             raise ValueError(f"gnn_model {gnn_model!r} not in ('hop', 'gat')")
         self.gnn_model = gnn_model
+        # ONLINE mode (service_v1.go:128-143 continuous feed): with a
+        # sink attached (OnlineGraphTrainer.make_wire_adapter()), every
+        # chunk landing on the wire ALSO decodes incrementally
+        # (records.columnar.StreamingRowDecoder) and streams into the
+        # online trainer — rows reach the train loop while the stream is
+        # still open, not at EOF.  Staging continues regardless (the
+        # durable record of the stream; batch retraining still works).
+        self.online_sink = online_sink
+        # Rows already fed to the sink per (host_key, kind, name) — the
+        # cross-SESSION dedup: a client that reconnects and resends a
+        # shard (fresh TrainSession, empty chunk_seq) re-decodes the
+        # same prefix, and only rows BEYOND this high-water mark feed.
+        self._online_fed: Dict = {}
         self.runs: Dict[str, TrainRun] = {}
         self._mu = threading.Lock()
         self._counter = 0
@@ -159,6 +174,47 @@ class TrainerService:
                 session.download_shards.append(staged)
             else:
                 session.topology_shards.append(staged)
+        if self.online_sink is not None:
+            self._feed_online(session, kind, name, data, seq)
+
+    def _feed_online(
+        self, session: TrainSession, kind: str, name: str, data: bytes, seq: int
+    ) -> None:
+        """Online mode: decode the chunk incrementally and stream NEW rows
+        to the sink.  Runs after the in-session seq dedup; cross-session
+        resends dedupe on the per-dataset row high-water mark."""
+        from ..records.columnar import MAGIC, StreamingRowDecoder
+
+        key = (kind, name)
+        if key not in session.decoders:
+            # Sniff the format once per dataset: reference-CSV shards
+            # (the compat path _normalize_shard converts at train time)
+            # skip online decode — a ValueError here would kill the
+            # legacy client's stream.
+            session.decoders[key] = (
+                StreamingRowDecoder()
+                if seq == 0 and data[: len(MAGIC)] == MAGIC
+                else None
+            )
+        dec = session.decoders[key]
+        if dec is None:
+            return
+        rows = dec.feed(data)
+        if not rows.size:
+            return
+        fed_key = (session.host_key, kind, name)
+        with self._mu:
+            fed = self._online_fed.get(fed_key, 0)
+            start = dec.rows_decoded - len(rows)
+            skip = max(fed - start, 0)
+            self._online_fed[fed_key] = max(fed, dec.rows_decoded)
+        if skip >= len(rows):
+            return
+        rows = rows[skip:]
+        if kind == "download":
+            self.online_sink.feed_download_rows(rows)
+        else:
+            self.online_sink.feed_topology_rows(rows)
 
     # -- training ------------------------------------------------------------
 

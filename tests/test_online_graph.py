@@ -181,6 +181,162 @@ class TestResumeAcrossRefresh:
         assert not tr.resume()
 
 
+class TestWireIngest:
+    """The Train stream feeds the online trainer DIRECTLY (VERDICT r3's
+    configs[5] wire story): chunks decode incrementally mid-stream and
+    rows reach the train loop before EOF."""
+
+    def test_streaming_decoder_matches_reader_at_awkward_splits(self, tmp_path):
+        from dragonfly2_tpu.records.columnar import (
+            ColumnarReader,
+            ColumnarWriter,
+            StreamingRowDecoder,
+        )
+
+        path = str(tmp_path / "s.dfc")
+        rng = np.random.default_rng(0)
+        want = rng.random((257, 7)).astype(np.float32)
+        with ColumnarWriter(path, tuple(f"c{i}" for i in range(7))) as w:
+            w.append(want)
+        blob = open(path, "rb").read()
+        # Splits that straddle the magic, the header, and row boundaries.
+        dec = StreamingRowDecoder()
+        pos = 0
+        parts = []
+        for cut in (2, 5, 11, 64, 300, 301):
+            parts.append(blob[pos:cut])
+            pos = cut
+        parts.append(blob[pos:])
+        chunks = [dec.feed(p) for p in parts]
+        rows = np.concatenate([c for c in chunks if c.size], axis=0)
+        np.testing.assert_array_equal(rows, ColumnarReader(path).to_array())
+        assert dec.rows_decoded == 257
+
+    def test_train_stream_feeds_online_trainer(self, tmp_path):
+        """Wire e2e: shards stream over the real Train HTTP transport;
+        the online trainer consumes edges and refreshes its graph from
+        the WIRE-fed topology."""
+        from dragonfly2_tpu.records.columnar import ColumnarWriter
+        from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS, TOPO_COLUMNS
+        from dragonfly2_tpu.rpc.trainer_transport import (
+            RemoteTrainer,
+            TrainerHTTPServer,
+        )
+        from dragonfly2_tpu.trainer.service import TrainerService
+
+        cluster = _mk_cluster()
+        tr = _mk_trainer(cluster)
+        adapter = tr.make_wire_adapter()
+        service = TrainerService(
+            data_dir=str(tmp_path / "stage"), online_sink=adapter
+        )
+        # Ingest-only here: EOF batch retraining has its own tests.
+        service._run_training = lambda run, session: run.done.set()
+
+        # Download shard: bucket-space rows from the synthetic swarm.
+        dl = cluster.generate_feature_rows(4 * 256 * 3, seed=5)
+        dl_path = str(tmp_path / "dl.dfc")
+        with ColumnarWriter(dl_path, DOWNLOAD_COLUMNS) as w:
+            w.append(dl)
+        # Topology shard: probe edges in the SAME bucket space.
+        buckets = cluster._bucket_table()
+        src, dst, rtt = _topo(cluster, seed=8)
+        topo = np.zeros((len(src), len(TOPO_COLUMNS)), np.float32)
+        topo[:, 0] = buckets[src]
+        topo[:, 1] = buckets[dst]
+        topo[:, 2] = rtt
+        topo_path = str(tmp_path / "topo.dfc")
+        with ColumnarWriter(topo_path, TOPO_COLUMNS) as w:
+            w.append(topo)
+
+        server = TrainerHTTPServer(service)
+        server.serve()
+        try:
+            client = RemoteTrainer(server.url)
+            session = client.open_train_stream(
+                ip="10.0.0.7", hostname="wire-online", scheduler_id="s"
+            )
+            session.send_download_shard(dl_path)
+            session.send_network_topology_shard(topo_path)
+        finally:
+            server.stop()
+
+        assert adapter.overflow_edges == 0
+        # Edges reached the trainer off the WIRE: a dispatch runs...
+        assert tr.run(max_dispatches=2, idle_timeout=0.5) == 2
+        assert tr.records_seen == 2 * 4 * 256
+        # ...and the wire-fed topology builds the NEXT snapshot.
+        digest = tr.snapshot_digest()
+        assert tr.refresh_snapshot() is not None
+        assert tr.snapshot_digest() != digest
+
+
+    def test_reconnect_resend_feeds_rows_once(self, tmp_path):
+        """A client that reconnects and resends a shard (fresh session,
+        empty chunk_seq) must not double-feed the sink — the service
+        dedupes on a per-dataset row high-water mark."""
+        from dragonfly2_tpu.records.columnar import ColumnarWriter
+        from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
+        from dragonfly2_tpu.trainer.service import TrainerService
+
+        class Sink:
+            def __init__(self):
+                self.download_rows = 0
+                self.topology_rows = 0
+
+            def feed_download_rows(self, rows):
+                self.download_rows += len(rows)
+
+            def feed_topology_rows(self, rows):
+                self.topology_rows += len(rows)
+
+        sink = Sink()
+        service = TrainerService(
+            data_dir=str(tmp_path / "stage"), online_sink=sink
+        )
+        path = str(tmp_path / "d.dfc")
+        with ColumnarWriter(path, DOWNLOAD_COLUMNS) as w:
+            w.append(np.random.default_rng(0).random(
+                (100, len(DOWNLOAD_COLUMNS))).astype(np.float32))
+        blob = open(path, "rb").read()
+
+        s1 = service.open_train_stream(ip="1.2.3.4", hostname="h", scheduler_id="s")
+        service.receive_shard_bytes(s1, "download", "d.dfc", blob, seq=0)
+        assert sink.download_rows == 100
+        # Reconnect: fresh session, SAME shard resent from scratch.
+        s2 = service.open_train_stream(ip="1.2.3.4", hostname="h", scheduler_id="s")
+        service.receive_shard_bytes(s2, "download", "d.dfc", blob, seq=0)
+        assert sink.download_rows == 100  # not 200
+        # A LONGER resend (shard grew) feeds only the new tail.
+        with ColumnarWriter(str(tmp_path / "d2.dfc"), DOWNLOAD_COLUMNS) as w:
+            w.append(np.random.default_rng(0).random(
+                (130, len(DOWNLOAD_COLUMNS))).astype(np.float32))
+        blob2 = open(str(tmp_path / "d2.dfc"), "rb").read()
+        s3 = service.open_train_stream(ip="1.2.3.4", hostname="h", scheduler_id="s")
+        service.receive_shard_bytes(s3, "download", "d.dfc", blob2, seq=0)
+        assert sink.download_rows == 130
+
+    def test_online_mode_tolerates_reference_csv(self, tmp_path):
+        """A legacy CSV shard on the wire (the compat path) must not
+        crash online mode — it skips online decode and stages normally."""
+        from dragonfly2_tpu.trainer.service import TrainerService
+
+        class Sink:
+            def feed_download_rows(self, rows):
+                raise AssertionError("CSV must not online-decode")
+
+            feed_topology_rows = feed_download_rows
+
+        service = TrainerService(
+            data_dir=str(tmp_path / "stage"), online_sink=Sink()
+        )
+        s = service.open_train_stream(ip="1.2.3.4", hostname="h", scheduler_id="s")
+        service.receive_shard_bytes(
+            s, "download", "legacy.csv", b"a,b,c\n1,2,3\n", seq=0
+        )
+        assert len(s.download_shards) == 1  # staged for batch conversion
+
+
 class TestOnlineQuality:
     def test_refresh_tracks_drift_better_than_stale(self):
         """After load drift, FRESH hop features beat STALE ones on new
