@@ -104,14 +104,35 @@ class TestSeederStream:
             Resource(), Scheduling(Evaluator(), SchedulingConfig(retry_interval=0))
         )
         storage, conductor = self._daemon(tmp_path, service)
+
+        # Deterministic mid-download observation: the in-process origin
+        # is instant, so under suite load all 4 pieces could land before
+        # the progress poller's first tick — hold the TAIL pieces until
+        # a "piece" event proves the poller observed progress (direct
+        # evidence, not a timing bet).
+        progress_seen = threading.Event()
+        inner_fetch = conductor.source_fetcher.fetch
+
+        def gated_fetch(url, number, piece_size):
+            if number >= 2:
+                progress_seen.wait(10)
+            return inner_fetch(url, number, piece_size)
+
+        conductor.source_fetcher.fetch = gated_fetch
         seeder = Seeder(conductor, storage)
         events = []
+
+        def emit(e):
+            events.append(e)
+            if e["event"] == "piece":
+                progress_seen.set()
+
         url = "https://origin/seed-blob"
         # content_length comes from the request (the scheduler knows it or
         # the origin is sized by the daemon).
         res = seeder.obtain(
             url, piece_size=PIECE, content_length=4 * PIECE,
-            priority=Priority.LEVEL1, emit=events.append,
+            priority=Priority.LEVEL1, emit=emit,
             poll_interval_s=0.01,
         )
         assert res["ok"] and res["pieces"] == 4
