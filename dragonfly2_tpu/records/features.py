@@ -131,6 +131,31 @@ DOWNLOAD_COLUMNS = ("src_bucket", "dst_bucket") + DOWNLOAD_FEATURE_NAMES + ("tar
 NUM_HASH_BUCKETS = 1 << 20
 
 
+def accumulate_host_feature_sums(
+    rows: np.ndarray,
+    src: np.ndarray,
+    dst: np.ndarray,
+    feat_sum: np.ndarray,
+    feat_cnt: np.ndarray,
+) -> None:
+    """Fold download rows' host features into per-node (sum, count)
+    accumulators: child features at cols [2, 2+H) credit ``dst``, parent
+    features at [2+H, 2+2H) credit ``src``.  THE one implementation of
+    this attribution — the batch trainer and the online wire adapter
+    must agree on it.  Uses bincount, not ``np.add.at``: the fancy-index
+    scatter runs at single-digit M updates/s and measurably capped the
+    online wire soak (BENCHMARKS.md)."""
+    n_nodes = feat_cnt.shape[0]
+    child_f = rows[:, 2 : 2 + HOST_FEATURE_DIM]
+    parent_f = rows[:, 2 + HOST_FEATURE_DIM : 2 + 2 * HOST_FEATURE_DIM]
+    for ids, feats in ((src, parent_f), (dst, child_f)):
+        feat_cnt += np.bincount(ids, minlength=n_nodes).astype(feat_cnt.dtype)
+        for j in range(feats.shape[1]):
+            feat_sum[:, j] += np.bincount(
+                ids, weights=feats[:, j], minlength=n_nodes
+            ).astype(feat_sum.dtype)
+
+
 def host_bucket(host_id: str) -> int:
     """Stable hash bucket for a host id (string → int node key)."""
     import zlib

@@ -133,27 +133,35 @@ class WireIngestAdapter:
         with self._mu:
             return self._feat_sum / np.maximum(self._feat_cnt[:, None], 1.0)
 
-    def feed_download_rows(self, rows: np.ndarray) -> None:
-        from ..records.features import HOST_FEATURE_DIM
+    # Feature-mean accumulation samples at most this many rows per feed:
+    # the means converge long before every row has voted, and the full
+    # per-row bincount pass was a measured chunk of the wire-ingest
+    # budget.  Edges (the training signal) are NEVER sampled.
+    FEATURE_SAMPLE_ROWS = 262_144
 
+    def feed_download_rows(self, rows: np.ndarray) -> None:
         if rows.size == 0:
             return
         with self._mu:
             src = self._map_ids(rows[:, 0])
             dst = self._map_ids(rows[:, 1])
             ok = (src >= 0) & (dst >= 0)
-            self._count_overflow(int((~ok).sum()))
-            src, dst = src[ok], dst[ok]
-            kept = rows[ok]
-            # Node-feature stream: child features live at cols
-            # [2, 2+H), parent at [2+H, 2+2H) (features.py layout; same
-            # attribution the batch GNN path uses).
-            child_f = kept[:, 2 : 2 + HOST_FEATURE_DIM]
-            parent_f = kept[:, 2 + HOST_FEATURE_DIM : 2 + 2 * HOST_FEATURE_DIM]
-            np.add.at(self._feat_sum, src, parent_f)
-            np.add.at(self._feat_cnt, src, 1.0)
-            np.add.at(self._feat_sum, dst, child_f)
-            np.add.at(self._feat_cnt, dst, 1.0)
+            n_bad = int(len(ok) - np.count_nonzero(ok))
+            self._count_overflow(n_bad)
+            if n_bad:
+                src, dst = src[ok], dst[ok]
+                kept = rows[ok]
+            else:
+                kept = rows  # fast path: no 100MB boolean-mask copy
+            # Node-feature stream: ONE shared accumulator with the batch
+            # trainer (records.features.accumulate_host_feature_sums) so
+            # the parent/child attribution cannot drift between paths.
+            from ..records.features import accumulate_host_feature_sums
+
+            m = min(len(kept), self.FEATURE_SAMPLE_ROWS)
+            accumulate_host_feature_sums(
+                kept[:m], src[:m], dst[:m], self._feat_sum, self._feat_cnt
+            )
         if len(src):
             self.trainer.feed_downloads(
                 src, dst, kept[:, -1].astype(np.float32)

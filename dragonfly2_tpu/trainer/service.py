@@ -393,16 +393,14 @@ class TrainerService:
         table = build_neighbor_table(n_nodes, p_src, p_dst, rtt, max_neighbors=8)
 
         # Node features averaged from download rows (parent-side features
-        # appear under the src bucket, child-side under dst).
+        # appear under the src bucket, child-side under dst) — the SAME
+        # accumulator the online wire adapter uses.
+        from ..records.features import accumulate_host_feature_sums
+
         node_feats = np.zeros((n_nodes, HOST_FEATURE_DIM), dtype=np.float32)
         counts = np.zeros(n_nodes, dtype=np.float32)
         d_src, d_dst = reindex(dl[:, 0]), reindex(dl[:, 1])
-        child_f = dl[:, 2 : 2 + HOST_FEATURE_DIM]
-        parent_f = dl[:, 2 + HOST_FEATURE_DIM : 2 + 2 * HOST_FEATURE_DIM]
-        np.add.at(node_feats, d_src, parent_f)
-        np.add.at(counts, d_src, 1.0)
-        np.add.at(node_feats, d_dst, child_f)
-        np.add.at(counts, d_dst, 1.0)
+        accumulate_host_feature_sums(dl, d_src, d_dst, node_feats, counts)
         node_feats /= np.maximum(counts[:, None], 1.0)
 
         target = dl[:, -1].astype(np.float32)

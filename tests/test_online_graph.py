@@ -212,6 +212,15 @@ class TestWireIngest:
         np.testing.assert_array_equal(rows, ColumnarReader(path).to_array())
         assert dec.rows_decoded == 257
 
+        # Fixed-size chunker whose boundary NEVER aligns with rows (the
+        # gRPC framing shape): every split row reassembles exactly once.
+        dec2 = StreamingRowDecoder()
+        got2 = [
+            dec2.feed(blob[i : i + 1000]) for i in range(0, len(blob), 1000)
+        ]
+        rows2 = np.concatenate([c for c in got2 if c.size], axis=0)
+        np.testing.assert_array_equal(rows2, ColumnarReader(path).to_array())
+
     def test_train_stream_feeds_online_trainer(self, tmp_path):
         """Wire e2e: shards stream over the real Train HTTP transport;
         the online trainer consumes edges and refreshes its graph from
