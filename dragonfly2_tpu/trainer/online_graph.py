@@ -47,6 +47,7 @@ import numpy as np
 
 from ..models.gnn import NeighborTable, build_neighbor_table
 from ..models.hop import HopConfig, HopRanker, precompute_hop_features
+from ..parallel.mesh import MODEL_AXIS
 from .train import TrainConfig, TrainState, _graph_train_step, _make_optimizer
 
 logger = logging.getLogger(__name__)
@@ -194,6 +195,13 @@ class OnlineGraphConfig:
     model: HopConfig = field(default_factory=HopConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     total_steps_hint: int = 100_000  # LR schedule horizon
+    # The config[4]×[5] mode: a (data, model) Mesh with
+    # node_sharding="model" partitions the hop table, the embedding
+    # table (+ its optimizer moments) AND the snapshot precompute by
+    # node over the model axis — the online trainer at the scale where
+    # node tables exceed one chip's HBM.  None = single-device.
+    mesh: object = None
+    node_sharding: str = "replicated"
 
 
 class OnlineGraphTrainer:
@@ -266,13 +274,69 @@ class OnlineGraphTrainer:
             apply_fn=self.model.apply, params=params, tx=tx,
             dropout_rng=jax.random.PRNGKey(config.train.seed + 1),
         )
-        # Commit the state once: freshly-created leaves are UNcommitted and
-        # the first dispatch would compile a second program the moment the
-        # (donated, committed) output comes back for dispatch 2.
-        self.state = jax.device_put(self.state, jax.local_devices()[0])
+        if config.node_sharding not in ("replicated", "model"):
+            raise ValueError(f"unknown node_sharding {config.node_sharding!r}")
+        if config.node_sharding == "model":
+            # config[4]×[5]: node tables (hop features, embedding +
+            # moments) partition by node over the mesh's model axis —
+            # the SAME leaf sharding train_hop_ranker's MP mode uses —
+            # and edge batches shard over the data axis.
+            if config.mesh is None:
+                raise ValueError('node_sharding="model" needs a mesh')
+            if config.num_nodes % config.mesh.shape[MODEL_AXIS]:
+                raise ValueError(
+                    f"num_nodes {config.num_nodes} not divisible by the "
+                    f"model axis {config.mesh.shape[MODEL_AXIS]}"
+                )
+            from jax.sharding import NamedSharding, PartitionSpec as P
 
-        self._dispatch_fn = jax.jit(self._train_dispatch, donate_argnums=(0,))
-        self._eval_fn = jax.jit(self._eval_mae)
+            from ..parallel.mesh import DATA_AXIS, batch_sharding, replicated
+            from .train import _node_sharded_state_spec, _node_table_sharding
+
+            mesh = config.mesh
+            if config.batch_size % mesh.shape[DATA_AXIS]:
+                raise ValueError(
+                    f"batch_size {config.batch_size} not divisible by the "
+                    f"data axis {mesh.shape[DATA_AXIS]}"
+                )
+            self._repl = replicated(mesh)
+            self._data_shard = batch_sharding(mesh)
+            # Dispatch blocks are [super_steps, batch]: the BATCH dim
+            # (axis 1) shards over data; the scan dim stays whole.
+            block_shard = NamedSharding(mesh, P(None, DATA_AXIS))
+            self._nf_shard = _node_table_sharding(mesh)
+            self._state_shard = _node_sharded_state_spec(mesh, self.state)
+            self.state = jax.device_put(self.state, self._state_shard)
+            # The bare replicated sharding acts as a pytree PREFIX for
+            # the NeighborTable argument (train.py precedent) — no
+            # per-field spelling to desync if the table grows a field.
+            self._dispatch_fn = jax.jit(
+                self._train_dispatch,
+                in_shardings=(
+                    self._state_shard, self._nf_shard, self._repl,
+                    block_shard, block_shard, block_shard,
+                ),
+                out_shardings=(self._state_shard, self._repl),
+                donate_argnums=(0,),
+            )
+            self._eval_fn = jax.jit(
+                self._eval_mae,
+                in_shardings=(
+                    self._state_shard, self._nf_shard, self._repl,
+                    self._data_shard, self._data_shard, self._data_shard,
+                ),
+                out_shardings=self._repl,
+            )
+        else:
+            # Commit the state once: freshly-created leaves are
+            # UNcommitted and the first dispatch would compile a second
+            # program the moment the (donated, committed) output comes
+            # back for dispatch 2.
+            self.state = jax.device_put(self.state, jax.local_devices()[0])
+            self._dispatch_fn = jax.jit(
+                self._train_dispatch, donate_argnums=(0,)
+            )
+            self._eval_fn = jax.jit(self._eval_mae)
 
     # -- ingest: downloads stream -------------------------------------------
 
@@ -395,10 +459,31 @@ class OnlineGraphTrainer:
             self.config.num_nodes, src, dst, rtt,
             max_neighbors=self.config.max_neighbors,
         )
-        self.hop_feats = _precompute_jit(
-            jnp.asarray(self.node_feats), self.table,
-            hops=self.config.model.hops,
-        )
+        if self.config.node_sharding == "model":
+            # The snapshot precompute itself runs NODE-SHARDED on the
+            # mesh (halo exchange per hop) — at config[4] scale the
+            # [N, F] hop table is the memory wall, so no device ever
+            # materializes it whole; the output lands already
+            # partitioned for the sharded train step.
+            from ..parallel.graph_sharding import (
+                build_halo_plan,
+                precompute_hop_features_sharded,
+            )
+
+            plan = build_halo_plan(self.table, self.config.mesh, axis=MODEL_AXIS)
+            self.hop_feats = precompute_hop_features_sharded(
+                self.config.mesh,
+                jnp.asarray(self.node_feats),
+                self.table,
+                plan,
+                hops=self.config.model.hops,
+                axis=MODEL_AXIS,
+            )
+        else:
+            self.hop_feats = _precompute_jit(
+                jnp.asarray(self.node_feats), self.table,
+                hops=self.config.model.hops,
+            )
         self.hop_feats.block_until_ready()
 
     def refresh_snapshot(self) -> Optional[str]:

@@ -44,7 +44,7 @@ def _downloads(cluster, seed, n):
 
 
 def _mk_trainer(cluster, tmp_path=None, **cfg_kw):
-    cfg = OnlineGraphConfig(
+    defaults = dict(
         num_nodes=N_NODES,
         max_neighbors=8,
         batch_size=256,
@@ -53,8 +53,9 @@ def _mk_trainer(cluster, tmp_path=None, **cfg_kw):
         model=HopConfig(hidden=16, out_dim=8, node_embed_dim=4, dropout=0.1),
         train=TrainConfig(warmup_steps=2),
         total_steps_hint=1000,
-        **cfg_kw,
     )
+    defaults.update(cfg_kw)
+    cfg = OnlineGraphConfig(**defaults)
     src, dst, rtt = _topo(cluster, seed=1)
     return OnlineGraphTrainer(
         cfg,
@@ -344,6 +345,90 @@ class TestWireIngest:
             s, "download", "legacy.csv", b"a,b,c\n1,2,3\n", seq=0
         )
         assert len(s.download_shards) == 1  # staged for batch conversion
+
+
+class TestOnlineMeshMode:
+    """config[4]×[5]: the ONLINE trainer on a (data, model) mesh — node
+    tables AND the snapshot precompute sharded over the model axis."""
+
+    def _mk(self, cluster, tmp_path=None, **kw):
+        from dragonfly2_tpu.parallel.mesh import MeshSpec, create_mesh
+
+        mesh = create_mesh(MeshSpec(data=4, model=2))
+        return _mk_trainer(
+            cluster, tmp_path, mesh=mesh, node_sharding="model", **kw
+        )
+
+    def test_matches_replicated_and_swaps_without_recompile(self, tmp_path):
+        import jax
+
+        cluster_a = _mk_cluster()
+        repl = _mk_trainer(cluster_a)
+        cluster_b = _mk_cluster()
+        mp = self._mk(cluster_b)
+
+        for tr, cl in ((repl, cluster_a), (mp, cluster_b)):
+            tr.feed_downloads(*_downloads(cl, 7, 4 * 256 * 2))
+            assert tr.run(max_dispatches=2, idle_timeout=0.1) == 2
+        # Same stream, same seeds: the sharded program computes the same
+        # training result to float tolerance.
+        v = _downloads(cluster_a, 99, 1024)
+        assert abs(repl.eval_mae(*v) - mp.eval_mae(*v)) < 5e-3
+        # The hop tables live SHARDED over the model axis.
+        from jax.sharding import PartitionSpec as P
+
+        assert mp.hop_feats.sharding.spec == P("model")
+
+        # Snapshot swap on the mesh: sharded precompute re-runs, the
+        # compiled dispatch is reused.
+        compiles = mp._dispatch_fn._cache_size()
+        cluster_b.drift(np.random.default_rng(3))
+        mp.set_node_features(cluster_b._host_feature_matrix())
+        mp.feed_topology(*_topo(cluster_b, seed=31))
+        assert mp.refresh_snapshot() is not None
+        mp.feed_downloads(*_downloads(cluster_b, 8, 4 * 256))
+        assert mp.run(max_dispatches=1, idle_timeout=0.1) == 1
+        assert mp._dispatch_fn._cache_size() == compiles
+
+    def test_mesh_resume_across_refresh(self, tmp_path):
+        def feed(tr, cl):
+            tr.feed_topology(*_topo(cl, seed=100))
+            for d in range(3):
+                tr.feed_downloads(*_downloads(cl, 60 + d, 4 * 256))
+
+        ca = _mk_cluster()
+        a = self._mk(ca, tmp_path / "a", refresh_every=2)
+        feed(a, ca)
+        assert a.run(max_dispatches=3, idle_timeout=0.1) == 3
+        assert a.snapshot_idx >= 1
+
+        cb = _mk_cluster()
+        b = self._mk(cb, tmp_path / "b", refresh_every=2)
+        feed(b, cb)
+        assert b.run(max_dispatches=2, idle_timeout=0.1) == 2
+        b.checkpoint()
+        del b
+        cc = _mk_cluster()
+        c = self._mk(cc, tmp_path / "b", refresh_every=2)
+        assert c.resume()
+        assert c.dispatch == 2 and c.snapshot_idx >= 1
+        c.feed_downloads(*_downloads(cc, 62, 4 * 256))
+        assert c.run(max_dispatches=1, idle_timeout=0.1) == 1
+        assert _state_hash(c) == _state_hash(a)
+
+    def test_bad_configs_rejected(self):
+        from dragonfly2_tpu.parallel.mesh import MeshSpec, create_mesh
+
+        cluster = _mk_cluster()
+        with pytest.raises(ValueError, match="needs a mesh"):
+            _mk_trainer(cluster, node_sharding="model")
+        with pytest.raises(ValueError, match="unknown node_sharding"):
+            _mk_trainer(cluster, node_sharding="bogus")
+        mesh = create_mesh(MeshSpec(data=4, model=2))
+        with pytest.raises(ValueError, match="not divisible"):
+            _mk_trainer(
+                cluster, mesh=mesh, node_sharding="model", batch_size=254
+            )
 
 
 class TestOnlineQuality:
