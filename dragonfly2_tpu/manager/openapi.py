@@ -122,10 +122,20 @@ def spec() -> dict:
                         roles="PEER"),
         },
         "/api/v1/jobs": {
+            "get": _op("Recent group jobs (console view)"),
             "post": _op("Create a group job (preheat, sync_peers)",
                         body={"type": STR, "args": OBJ, "queues":
                               {"type": "array", "items": STR}},
                         roles="OPERATOR"),
+        },
+        "/api/v1/certs:issue": {
+            "post": _op("Issue a cluster-CA-signed certificate from a CSR "
+                        "(certify flow; TTL server-capped)",
+                        body={"csr_pem": STR, "ttl_hours": INT},
+                        roles="PEER"),
+        },
+        "/api/v1/certs:ca": {
+            "get": _op("Cluster trust root (PEM)"),
         },
         "/api/v1/jobs/{group_id}": {"get": _op("Group job state")},
         "/api/v1/jobs:poll": {
