@@ -53,8 +53,10 @@ def run(argv=None) -> int:
     p.add_argument("dst_key", nargs="?", default="", help="destination key (cp)")
     p.add_argument("-f", "--file", default=None, help="local file (put/get)")
     p.add_argument("--bucket", default="dragonfly")
-    p.add_argument("--backend", choices=["fs", "s3", "oss"], default="fs",
-                   help="object-storage backend (fs=local dir, s3/oss=remote)")
+    p.add_argument("--backend", choices=["fs", "s3", "oss", "obs"],
+                   default="fs",
+                   help="object-storage backend (fs=local dir, "
+                        "s3/oss/obs=remote)")
     p.add_argument("--endpoint", default="",
                    help="s3/oss endpoint URL (e.g. http://minio:9000)")
     p.add_argument("--access-key", default=os.environ.get("DF_ACCESS_KEY", ""))
