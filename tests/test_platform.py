@@ -395,3 +395,90 @@ class TestStressAndRecursive:
         assert r.percentile(50) == pytest.approx(0.050)
         with pytest.raises(ValueError):
             run_stress(lambda u: None, [], total=5)
+
+
+class TestSteeringClient:
+    """Multi-replica steering (rpc/steering.py): ring routing and
+    per-replica fault isolation (the deployed behavior lives in
+    deploy/e2e_loop.py stage 6; these are its unit contracts)."""
+
+    class _Fake:
+        def __init__(self, url, fail=False):
+            self.url = url
+            self.fail = fail
+            self.announced = []
+            self.registered = []
+
+        def announce_host(self, host):
+            if self.fail:
+                raise ConnectionError(f"{self.url} down")
+            self.announced.append(host.id)
+
+        def register_peer(self, *, host, url, task_id=None, **kw):
+            if self.fail:
+                raise ConnectionError(f"{self.url} down")
+            self.registered.append(task_id)
+            return ("reg", self.url, task_id)
+
+        def sync_probes_start(self, host):
+            return [self.url]
+
+    def _mk(self, fail_first=False):
+        from dragonfly2_tpu.rpc.steering import SteeringSchedulerClient
+
+        fakes = {}
+
+        def factory(u):
+            fakes[u] = self._Fake(u, fail=(fail_first and u == "http://a"))
+            return fakes[u]
+
+        client = SteeringSchedulerClient(
+            ["http://a", "http://b"], factory=factory
+        )
+        return client, fakes
+
+    def test_task_routing_is_stable_and_splits(self):
+        client, fakes = self._mk()
+
+        class H:
+            id = "h-1"
+
+        owners = set()
+        for i in range(40):
+            tid = f"task-{i}"
+            out = client.register_peer(host=H(), url="u", task_id=tid)
+            owners.add(out[1])
+            # Re-registering the SAME task always lands on the same replica.
+            assert client.for_task(tid).url == out[1]
+        assert owners == {"http://a", "http://b"}  # the ring actually splits
+
+    def test_announce_survives_one_replica_down(self):
+        client, fakes = self._mk(fail_first=True)
+
+        class H:
+            id = "h-2"
+
+        client.announce_host(H())  # must NOT raise
+        assert fakes["http://b"].announced == ["h-2"]
+
+        # With EVERY replica down, the failure surfaces.
+        fakes["http://b"].fail = True
+        import pytest as _pytest
+
+        with _pytest.raises(ConnectionError):
+            client.announce_host(H())
+
+    def test_probes_pin_per_host(self):
+        client, fakes = self._mk()
+
+        class H:
+            def __init__(self, hid):
+                self.id = hid
+
+        picks = {client.sync_probes_start(H(f"host-{i}"))[0] for i in range(40)}
+        assert picks == {"http://a", "http://b"}
+        # Same host always probes through the same replica.
+        assert (
+            client.sync_probes_start(H("host-0"))
+            == client.sync_probes_start(H("host-0"))
+        )

@@ -277,6 +277,40 @@ class TestWireIssuance:
                 r.stop()
             server.stop()
 
+    def test_renewer_retries_failures_and_keeps_old_identity(self):
+        """An issue failure at renewal time must keep serving on the old
+        (still-valid) cert and retry — never crash, never clear state."""
+        import datetime
+        import time
+
+        from dragonfly2_tpu.security.ca import IdentityRenewer
+
+        ca = CertificateAuthority()
+        ident = PeerIdentity.issue(
+            ca, common_name="d", ttl=datetime.timedelta(seconds=1)
+        )
+        calls = {"n": 0}
+
+        def flaky_issue():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("manager down")
+            return PeerIdentity.issue(ca, common_name="d")
+
+        ctx = client_context(ident)
+        r = IdentityRenewer(
+            ident, flaky_issue, [ctx], min_interval_s=0.1
+        ).start()
+        try:
+            deadline = time.time() + 10
+            while r.renewals == 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert r.renewals == 1
+            assert calls["n"] == 3  # two failures retried, old cert kept
+            assert r.identity is not ident  # fresh identity adopted
+        finally:
+            r.stop()
+
     def test_wire_issued_identities_do_mtls_piece_transfer(self, tmp_path):
         """End to end: both sides bootstrap from the manager, then move
         bytes over mutual TLS; anonymous clients stay locked out."""
