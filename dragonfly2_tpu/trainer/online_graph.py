@@ -82,6 +82,25 @@ class WireIngestAdapter:
     node-feature stream), and hands the trainer a LAZY feature source —
     the running mean is materialized once per snapshot build, not per
     wire chunk.
+
+    **Node-id lifecycle** (``OnlineGraphConfig.node_ttl > 0``): real
+    swarms churn, so a full table must not freeze the trainer on the
+    early-arrivals subgraph.  Mirroring the scheduler's host TTL GC
+    (reference scheduler/config/config.go:176-197), a host unseen on
+    either stream for ``node_ttl`` seconds is evicted when capacity is
+    needed: its dense id returns to a free pool, its feature
+    accumulators reset, and the trainer queues an embedding +
+    optimizer-moment row reset (applied on the training thread —
+    ``OnlineGraphTrainer.apply_pending_recycles``).  Drops while the
+    table is full and nothing has expired are TRANSIENT: the same host
+    maps successfully once an eviction frees capacity.  Aliasing —
+    topology-window or queued edges that still reference a recycled id
+    describe the id's previous owner until they age out of the bounded
+    window — matches the reference, where GC'd hosts vanish only at the
+    next probe round.  Lifecycle mode is wall-clock-driven and therefore
+    trades strict byte-identity replay for capacity recycling; the
+    determinism soaks keep ``node_ttl=0`` (the default, which preserves
+    the fixed first-come mapping exactly).
     """
 
     def __init__(self, trainer: "OnlineGraphTrainer") -> None:
@@ -96,25 +115,116 @@ class WireIngestAdapter:
         self._feat_sum = np.zeros((n, HOST_FEATURE_DIM), np.float32)
         self._feat_cnt = np.zeros(n, np.float32)
         self.overflow_edges = 0
+        self.evicted_nodes = 0
+        # Lifecycle state: last time each dense id was seen on any
+        # stream, its current bucket (for reverse unmapping), and the
+        # free pool of recycled ids.
+        self._last_seen = np.zeros(n, np.float64)
+        self._bucket_of = np.full(n, -1, np.int64)
+        self._free: List[int] = []
+        self._last_evict_scan = float("-inf")
+        # EPOCH time, not monotonic: last-seen stamps live in the
+        # checkpoint and must stay comparable across process restarts.
+        self.clock = time.time  # injectable for deterministic tests
         self._mu = threading.Lock()
         trainer.node_feature_source = self.node_features
+        trainer._adapter = self
+        if trainer._adapter_restore is not None:
+            self._apply_restore(trainer._adapter_restore)
 
-    def _map_ids(self, buckets: np.ndarray) -> np.ndarray:
+    def _apply_restore(self, st: dict) -> None:
+        """Re-attach a checkpointed id mapping: the mapping is NOT
+        derivable from the stream in ttl mode (eviction is clock-driven),
+        so it rides in the trainer checkpoint — host X keeps the dense id
+        whose embedding learned X."""
+        with self._mu:
+            self._id_table = np.asarray(st["adapter_id_table"], np.int32).copy()
+            self._bucket_of = np.asarray(st["adapter_bucket_of"], np.int64).copy()
+            self._last_seen = np.asarray(st["adapter_last_seen"], np.float64).copy()
+            self._free = [int(i) for i in st["adapter_free"] if i >= 0]
+            self._next_id = int(st["adapter_next_id"])
+            self._feat_sum = np.asarray(st["adapter_feat_sum"], np.float32).copy()
+            self._feat_cnt = np.asarray(st["adapter_feat_cnt"], np.float32).copy()
+            self.overflow_edges = int(st["adapter_overflow_edges"])
+            self.evicted_nodes = int(st["adapter_evicted_nodes"])
+            self._last_evict_scan = float("-inf")
+
+    def _evict_expired(self, now: float) -> int:
+        """Reclaim dense ids whose hosts fell silent for ``node_ttl``
+        (the scheduler's host GC semantics).  Called under ``_mu`` from
+        the mapping slow path when the table is full; the O(num_nodes)
+        scan is throttled to once per ttl/4."""
+        ttl = float(self.trainer.config.node_ttl)
+        if ttl <= 0 or now - self._last_evict_scan < ttl * 0.25:
+            return 0
+        self._last_evict_scan = now
+        active = self._bucket_of >= 0
+        expired = np.nonzero(active & (now - self._last_seen > ttl))[0]
+        if len(expired) == 0:
+            return 0
+        self._id_table[self._bucket_of[expired]] = -2
+        self._bucket_of[expired] = -1
+        self._feat_sum[expired] = 0.0
+        self._feat_cnt[expired] = 0.0
+        self._free.extend(int(i) for i in expired)
+        self.evicted_nodes += len(expired)
+        # Un-memoize overflow buckets: freed capacity means previously
+        # dropped hosts may claim ids on their next appearance.
+        self._id_table[self._id_table == -1] = -2
+        self.trainer.request_recycle(expired)
+        from .metrics import ONLINE_NODES_EVICTED
+
+        ONLINE_NODES_EVICTED.inc(len(expired))
+        logger.info(
+            "node lifecycle: evicted %d expired hosts (ttl=%.0fs), "
+            "%d ids free", len(expired), ttl, len(self._free),
+        )
+        return len(expired)
+
+    def _map_ids(self, buckets: np.ndarray, now: float) -> np.ndarray:
         """bucket → dense id; -1 for overflow (node table full).  One
         vectorized gather in steady state; Python only touches buckets
-        never seen before."""
+        never seen before (or, in ttl mode, previously dropped)."""
         b = buckets.astype(np.int64)
         out = self._id_table[b]
-        if (out == -2).any():
+        ttl_mode = self.trainer.config.node_ttl > 0
+        if ttl_mode:
+            # Touch BEFORE any eviction: a host present in this very
+            # chunk is alive by definition and must not be reclaimed by
+            # the scan below, however long it was silent before.
+            seen = out[out >= 0]
+            if len(seen):
+                self._last_seen[seen] = now
+        # ttl mode also retries -1 (dropped) buckets: expired capacity
+        # may have freed up since — drops must stay transient even when
+        # no brand-new bucket arrives to trigger the slow path.
+        if (out == -2).any() or (ttl_mode and (out == -1).any()):
             cap = self.trainer.config.num_nodes
+            if not self._free and self._next_id >= cap:
+                if self._evict_expired(now):
+                    # Eviction un-memoized -1 buckets; re-gather so this
+                    # chunk's dropped hosts remap right now.
+                    out = self._id_table[b]
             for nb in np.unique(b[out == -2]):
                 if self._id_table[nb] != -2:
                     continue
-                if self._next_id >= cap:
+                if not self._free and self._next_id >= cap:
+                    # The pre-loop attempt only fires when the pool was
+                    # ALREADY empty; a small leftover pool can drain
+                    # mid-chunk with expired ids still reclaimable (the
+                    # scan throttle keeps repeat calls cheap).
+                    self._evict_expired(now)
+                if self._free:
+                    nid = self._free.pop()
+                elif self._next_id < cap:
+                    nid = self._next_id
+                    self._next_id += 1
+                else:
                     self._id_table[nb] = -1
                     continue
-                self._id_table[nb] = self._next_id
-                self._next_id += 1
+                self._id_table[nb] = nid
+                self._bucket_of[nid] = nb
+                self._last_seen[nid] = now
             out = self._id_table[b]
         return out
 
@@ -124,9 +234,14 @@ class WireIngestAdapter:
         if self.overflow_edges == 0:
             logger.warning(
                 "node table full (num_nodes=%d): dropping edges touching "
-                "unmapped hosts", self.trainer.config.num_nodes,
+                "unmapped hosts%s", self.trainer.config.num_nodes,
+                "" if self.trainer.config.node_ttl > 0
+                else " (node_ttl=0: drops are permanent)",
             )
         self.overflow_edges += n_dropped
+        from .metrics import ONLINE_OVERFLOW_EDGES
+
+        ONLINE_OVERFLOW_EDGES.inc(n_dropped)
 
     def node_features(self) -> np.ndarray:
         """Materialize the running per-node feature means — called by the
@@ -143,9 +258,15 @@ class WireIngestAdapter:
     def feed_download_rows(self, rows: np.ndarray) -> None:
         if rows.size == 0:
             return
+        now = self.clock()
         with self._mu:
-            src = self._map_ids(rows[:, 0])
-            dst = self._map_ids(rows[:, 1])
+            # ONE mapping call over both endpoint columns: every host in
+            # the chunk is touched before any eviction runs, so a live
+            # dst can never be reclaimed by the src column's slow path.
+            both = self._map_ids(
+                np.concatenate([rows[:, 0], rows[:, 1]]), now
+            )
+            src, dst = both[: len(rows)], both[len(rows):]
             ok = (src >= 0) & (dst >= 0)
             n_bad = int(len(ok) - np.count_nonzero(ok))
             self._count_overflow(n_bad)
@@ -171,9 +292,12 @@ class WireIngestAdapter:
     def feed_topology_rows(self, rows: np.ndarray) -> None:
         if rows.size == 0:
             return
+        now = self.clock()
         with self._mu:
-            src = self._map_ids(rows[:, 0])
-            dst = self._map_ids(rows[:, 1])
+            both = self._map_ids(
+                np.concatenate([rows[:, 0], rows[:, 1]]), now
+            )
+            src, dst = both[: len(rows)], both[len(rows):]
             ok = (src >= 0) & (dst >= 0)
             self._count_overflow(int((~ok).sum()))
             src, dst = src[ok], dst[ok]
@@ -191,6 +315,12 @@ class OnlineGraphConfig:
     refresh_every: int = 0           # dispatches between snapshot swaps (0 = static)
     topo_window: int = 1_000_000     # most-recent probe edges kept for the next snapshot
     checkpoint_every: int = 0        # dispatches (0 = off)
+    # Node-id lifecycle for the wire adapter: hosts unseen for this many
+    # seconds are evicted when the table is full and their dense ids
+    # recycled (embedding + moment rows reset).  0 = off: the mapping is
+    # frozen first-come and overflow drops are permanent (the strictly
+    # deterministic mode the byte-identity soaks use).
+    node_ttl: float = 0.0
     queue_capacity: int = 2          # dispatch blocks of ingest backpressure
     model: HopConfig = field(default_factory=HopConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
@@ -240,6 +370,17 @@ class OnlineGraphTrainer:
         self.dispatch = 0
         self.snapshot_idx = 0
         self.records_seen = 0
+        # Recycled ids queued by the (ingest-thread) wire adapter; the
+        # row resets run on the TRAINING thread between dispatches —
+        # the state may be donated mid-dispatch when the adapter fires.
+        self._recycle_lock = threading.Lock()
+        self._pending_recycle: List[np.ndarray] = []
+        self.nodes_recycled = 0
+        # Attached wire adapter (if any) — its id mapping checkpoints
+        # with the trainer; resume() stashes the restored copy here for
+        # the next make_wire_adapter() to re-attach.
+        self._adapter: Optional["WireIngestAdapter"] = None
+        self._adapter_restore: Optional[dict] = None
         self._window: Tuple[np.ndarray, np.ndarray, np.ndarray] = self._drain_window()
         self._fed_since_swap = 0  # bootstrap topology = snapshot 0's input
         # Snapshot 0 builds LAZILY (_ensure_snapshot) — a resume() right
@@ -327,6 +468,12 @@ class OnlineGraphTrainer:
                 ),
                 out_shardings=self._repl,
             )
+            self._recycle_fn = jax.jit(
+                self._recycle_rows,
+                in_shardings=(self._state_shard, self._repl),
+                out_shardings=self._state_shard,
+                donate_argnums=(0,),
+            )
         else:
             # Commit the state once: freshly-created leaves are
             # UNcommitted and the first dispatch would compile a second
@@ -337,6 +484,9 @@ class OnlineGraphTrainer:
                 self._train_dispatch, donate_argnums=(0,)
             )
             self._eval_fn = jax.jit(self._eval_mae)
+            self._recycle_fn = jax.jit(
+                self._recycle_rows, donate_argnums=(0,)
+            )
 
     # -- ingest: downloads stream -------------------------------------------
 
@@ -524,6 +674,64 @@ class OnlineGraphTrainer:
             np.asarray(self.hop_feats).tobytes()
         ).hexdigest()
 
+    # -- node-id lifecycle ---------------------------------------------------
+
+    def request_recycle(self, node_ids: np.ndarray) -> None:
+        """Queue recycled dense ids for an embedding/optimizer row reset.
+        Thread-safe; the reset itself runs between dispatches on the
+        training thread (``apply_pending_recycles``) because the train
+        state is donated while a dispatch is in flight."""
+        ids = np.asarray(node_ids, np.int32)
+        if ids.size:
+            with self._recycle_lock:
+                self._pending_recycle.append(ids)
+
+    def apply_pending_recycles(self) -> int:
+        """Zero the learnable embedding rows AND their Adam moments for
+        every id queued by ``request_recycle`` — a recycled id is a NEW
+        host and must not inherit its predecessor's learned state.  Rows
+        reset to the embedding init's mean (zero), deterministically.
+        Returns the number of distinct rows reset."""
+        with self._recycle_lock:
+            if not self._pending_recycle:
+                return 0
+            ids = np.unique(np.concatenate(self._pending_recycle))
+            self._pending_recycle = []
+        mask = np.zeros(self.config.num_nodes, bool)
+        mask[ids] = True
+        self.state = self._recycle_fn(self.state, jnp.asarray(mask))
+        self.nodes_recycled += int(len(ids))
+        from .metrics import ONLINE_NODES_RECYCLED
+
+        ONLINE_NODES_RECYCLED.inc(len(ids))
+        return int(len(ids))
+
+    def _recycle_rows(self, state, mask):
+        """jitted [N]-mask row reset over every node-table leaf — the
+        SAME path predicate as the model-parallel sharding spec
+        (train._is_node_table_path), so sharded and replicated modes
+        reset identically."""
+        from .train import _is_node_table_path
+
+        n = self.config.num_nodes
+
+        def zero_rows(path, leaf):
+            if (
+                _is_node_table_path(path)
+                and getattr(leaf, "ndim", 0) >= 1
+                and leaf.shape[0] == n
+            ):
+                bmask = mask.reshape((-1,) + (1,) * (leaf.ndim - 1))
+                return jnp.where(bmask, jnp.zeros_like(leaf), leaf)
+            return leaf
+
+        return state.replace(
+            params=jax.tree_util.tree_map_with_path(zero_rows, state.params),
+            opt_state=jax.tree_util.tree_map_with_path(
+                zero_rows, state.opt_state
+            ),
+        )
+
     # -- train loop ----------------------------------------------------------
 
     def _train_dispatch(self, state, hop_feats, table, es, ed, y):
@@ -546,6 +754,7 @@ class OnlineGraphTrainer:
     def eval_mae(self, es, ed, y) -> float:
         """Val MAE against the CURRENT snapshot's hop features."""
         self._ensure_snapshot()
+        self.apply_pending_recycles()
         return float(
             self._eval_fn(
                 self.state, self.hop_feats, self.table,
@@ -567,6 +776,7 @@ class OnlineGraphTrainer:
             block = self._next_dispatch_block(timeout=idle_timeout)
             if block is None:
                 break
+            self.apply_pending_recycles()
             es, ed, y = block
             self.state, loss = self._dispatch_fn(
                 self.state, self.hop_feats, self.table,
@@ -583,6 +793,10 @@ class OnlineGraphTrainer:
                 and self.dispatch % cfg.checkpoint_every == 0
             ):
                 self.checkpoint()
+        # Resets queued after the last dispatch must not linger: an
+        # eval/export/checkpoint after run() returns would otherwise
+        # score recycled ids with their previous owner's embedding.
+        self.apply_pending_recycles()
         return ran
 
     # -- checkpoint / resume -------------------------------------------------
@@ -607,7 +821,57 @@ class OnlineGraphTrainer:
                 np.zeros(0, np.float32),
             )
         src, dst, rtt = self._window
+        # Adapter id-mapping state: clock-driven eviction makes the
+        # mapping non-replayable, so it must travel with the checkpoint.
+        # Live adapter wins; else carry a restored-but-unclaimed stash
+        # forward; else empty-table defaults (same as a fresh adapter).
+        ad = self._adapter
+        if ad is not None:
+            # Consistent pair: the mapping snapshot must not include an
+            # eviction whose row reset is still queued (a restore would
+            # resurrect the previous owner's embedding/moments).  Retry
+            # until no recycle landed between apply and the snapshot.
+            while True:
+                self.apply_pending_recycles()
+                with ad._mu:
+                    with self._recycle_lock:
+                        if self._pending_recycle:
+                            continue  # evicted again before we locked
+                    ad_state = {
+                        "adapter_id_table": ad._id_table.copy(),
+                        "adapter_bucket_of": ad._bucket_of.copy(),
+                        "adapter_last_seen": ad._last_seen.copy(),
+                        # Trailing -1 sentinel: orbax rejects zero-size
+                        # arrays, and free ids are always >= 0.
+                        "adapter_free": np.concatenate(
+                            [np.asarray(ad._free, np.int64), [-1]]
+                        ),
+                        "adapter_next_id": int(ad._next_id),
+                        "adapter_feat_sum": ad._feat_sum.copy(),
+                        "adapter_feat_cnt": ad._feat_cnt.copy(),
+                        "adapter_overflow_edges": int(ad.overflow_edges),
+                        "adapter_evicted_nodes": int(ad.evicted_nodes),
+                    }
+                    break
+        elif self._adapter_restore is not None:
+            ad_state = dict(self._adapter_restore)
+        else:
+            # No adapter: 1-element sentinel arrays (restore detects the
+            # real thing by adapter_id_table's length) — batch-fed
+            # trainers don't pay MB-scale dead payload per checkpoint.
+            ad_state = {
+                "adapter_id_table": np.full(1, -2, np.int32),
+                "adapter_bucket_of": np.full(1, -1, np.int64),
+                "adapter_last_seen": np.zeros(1, np.float64),
+                "adapter_free": np.full(1, -1, np.int64),
+                "adapter_next_id": 0,
+                "adapter_feat_sum": np.zeros((1, 1), np.float32),
+                "adapter_feat_cnt": np.zeros(1, np.float32),
+                "adapter_overflow_edges": 0,
+                "adapter_evicted_nodes": 0,
+            }
         return {
+            **ad_state,
             "pending_src": pend[0],
             "pending_dst": pend[1],
             "pending_rtt": pend[2],
@@ -632,6 +896,10 @@ class OnlineGraphTrainer:
     def checkpoint(self) -> None:
         import orbax.checkpoint as ocp
 
+        # Queued row resets are not part of the payload — fold them into
+        # the state now so a restore cannot resurrect a recycled id's
+        # previous-owner embedding/moments.
+        self.apply_pending_recycles()
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(self._ckpt_path(), self._payload(), force=True)
         ckptr.wait_until_finished()
@@ -660,6 +928,14 @@ class OnlineGraphTrainer:
             "pending_src", "pending_dst", "pending_rtt",
         ):
             abstract[k] = np.zeros(meta[k].shape, abstract[k].dtype)
+        # Adapter arrays restore against their SAVED shapes (sentinel
+        # 1-element when no adapter was attached); checkpoints from
+        # before the adapter rode along restore fine without them.
+        for k in [k for k in abstract if k.startswith("adapter_")]:
+            if k not in meta:
+                del abstract[k]
+            elif hasattr(abstract[k], "dtype"):
+                abstract[k] = np.zeros(meta[k].shape, abstract[k].dtype)
         abstract["node_feats"] = np.zeros(
             meta["node_feats"].shape, np.float32
         )
@@ -691,5 +967,19 @@ class OnlineGraphTrainer:
             self._topo_parts = [pend] if len(pend[0]) else []
             self._topo_count = len(pend[0])
             self._fed_since_swap = int(restored["fed_since_swap"])
+        # Stash the adapter id-mapping for the next make_wire_adapter()
+        # (or re-attach it to an already-live adapter in place).  A
+        # sentinel-length id table means no adapter state was saved.
+        from ..records.features import NUM_HASH_BUCKETS
+
+        saved_table = restored.get("adapter_id_table")
+        if saved_table is not None and len(saved_table) == NUM_HASH_BUCKETS:
+            self._adapter_restore = {
+                k: restored[k] for k in restored if k.startswith("adapter_")
+            }
+            if self._adapter is not None:
+                self._adapter._apply_restore(self._adapter_restore)
+        else:
+            self._adapter_restore = None
         self._build_snapshot(use_source=False)
         return True

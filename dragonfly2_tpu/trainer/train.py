@@ -413,6 +413,16 @@ def _node_table_sharding(mesh: Mesh):
     return NamedSharding(mesh, P(MODEL_AXIS, None))
 
 
+def _is_node_table_path(path) -> bool:
+    """True for leaves that live in per-node tables — the learnable
+    embedding and its optimizer moments (they share the 'embedding' key
+    path).  THE single definition: the model-parallel sharding spec and
+    the online trainer's id-recycling row reset must agree on which
+    leaves are node tables, or a recycled id's state silently survives
+    in one of them."""
+    return any(getattr(p, "key", None) == "embedding" for p in path)
+
+
 def _node_sharded_state_spec(mesh: Mesh, tree):
     """Sharding tree for model-parallel node tables: the learnable
     embedding table (and its optimizer moments — they share the leaf
@@ -425,7 +435,7 @@ def _node_sharded_state_spec(mesh: Mesh, tree):
     node_tables = _node_table_sharding(mesh)
 
     def leaf_spec(path, leaf):
-        if any(getattr(p, "key", None) == "embedding" for p in path):
+        if _is_node_table_path(path):
             return node_tables
         return repl
 

@@ -460,3 +460,199 @@ class TestOnlineQuality:
         tr.run(max_dispatches=4, idle_timeout=0.1)
         fresh = tr.eval_mae(v_es, v_ed, v_y)
         assert fresh < stale, (fresh, stale)
+
+
+class TestNodeLifecycle:
+    """node_ttl > 0: TTL eviction + dense-id recycling in the wire
+    adapter (reference host GC semantics, scheduler/config/config.go:
+    176-197) — churn past capacity must not permanently freeze the
+    trainer on the early-arrivals subgraph."""
+
+    @staticmethod
+    def _rows(src_b, dst_b, rng):
+        from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
+
+        n = len(src_b)
+        rows = rng.random((n, len(DOWNLOAD_COLUMNS))).astype(np.float32)
+        rows[:, 0] = src_b
+        rows[:, 1] = dst_b
+        rows[:, -1] = np.log1p(rng.random(n).astype(np.float32) * 50.0)
+        return rows
+
+    @staticmethod
+    def _embedding_leaves(tree):
+        import jax
+
+        out = []
+
+        def f(path, leaf):
+            if any(getattr(p, "key", None) == "embedding" for p in path):
+                out.append(np.asarray(leaf))
+            return leaf
+
+        jax.tree_util.tree_map_with_path(f, tree)
+        return out
+
+    def test_churn_3x_capacity_recycles_without_permanent_drops(self):
+        cluster = _mk_cluster()
+        tr = _mk_trainer(cluster, node_ttl=10.0)
+        ad = tr.make_wire_adapter()
+        t = {"now": 0.0}
+        ad.clock = lambda: t["now"]
+        rng = np.random.default_rng(0)
+
+        def phase_buckets(phase):
+            return np.arange(N_NODES, dtype=np.int64) + 10_000 * (phase + 1)
+
+        def feed_phase(phase):
+            b = phase_buckets(phase)
+            for _ in range(3):
+                ad.feed_download_rows(self._rows(b, np.roll(b, 1), rng))
+                t["now"] += 1.0
+
+        # Phase 0 fills the table exactly; train so embeddings/moments
+        # are live (recycling must provably clear them later).
+        feed_phase(0)
+        assert ad._next_id == N_NODES and ad.overflow_edges == 0
+        tr.feed_downloads(*_downloads(cluster, 5, 4 * 256 * 2))
+        assert tr.run(max_dispatches=2, idle_timeout=0.1) == 2
+
+        # Full table + nothing expired: the drop is transient, counted.
+        extra = np.array([999_999], dtype=np.int64)
+        ad.feed_download_rows(self._rows(extra, phase_buckets(0)[:1], rng))
+        assert ad.overflow_edges == 1
+
+        # Keep two phase-0 hosts warm via the TOPOLOGY stream at t=20...
+        t["now"] = 20.0
+        ad.feed_topology_rows(
+            np.array([[10_000, 10_001, 0.01]], dtype=np.float32)
+        )
+        survivors = [int(ad._id_table[10_000]), int(ad._id_table[10_001])]
+
+        # ...then a new host wave at t=25: everything else expired.
+        t["now"] = 25.0
+        b1 = phase_buckets(1)[: N_NODES - 2]
+        ad.feed_download_rows(self._rows(b1, np.roll(b1, 1), rng))
+        assert ad.evicted_nodes == N_NODES - 2
+        assert ad.overflow_edges == 1  # eviction freed capacity: no new drops
+        assert all(int(ad._id_table[b]) >= 0 for b in b1)
+
+        # Row resets: evicted embedding rows AND moments zero; the two
+        # survivors keep their learned state.
+        n_reset = tr.apply_pending_recycles()
+        assert n_reset == N_NODES - 2 and tr.nodes_recycled == N_NODES - 2
+        evicted_mask = np.ones(N_NODES, bool)
+        evicted_mask[survivors] = False
+        param_leaves = self._embedding_leaves(tr.state.params)
+        moment_leaves = self._embedding_leaves(tr.state.opt_state)
+        assert param_leaves and moment_leaves
+        for leaf in param_leaves + moment_leaves:
+            assert not leaf[evicted_mask].any(), "recycled row not reset"
+        assert all(
+            np.abs(leaf[survivors]).sum() > 0 for leaf in param_leaves
+        ), "survivor embedding clobbered"
+
+        # The host dropped at capacity returns once ids free again —
+        # drops are transient, never permanent.
+        t["now"] = 40.0
+        ad.feed_download_rows(self._rows(extra, phase_buckets(1)[:1], rng))
+        assert int(ad._id_table[999_999]) >= 0
+        assert ad.evicted_nodes >= N_NODES  # second wave ran
+
+        # Training continues across recycling: loss/eval finite.
+        tr.apply_pending_recycles()
+        tr.feed_downloads(*_downloads(cluster, 6, 4 * 256))
+        assert tr.run(max_dispatches=1, idle_timeout=0.1) == 1
+        v = tr.eval_mae(*_downloads(cluster, 7, 512))
+        assert np.isfinite(v)
+
+    def test_ttl_zero_keeps_frozen_first_come_mapping(self):
+        """The default stays byte-deterministic: no eviction, overflow
+        drops are permanent, the original mapping is never disturbed."""
+        cluster = _mk_cluster()
+        tr = _mk_trainer(cluster)  # node_ttl defaults to 0
+        ad = tr.make_wire_adapter()
+        t = {"now": 0.0}
+        ad.clock = lambda: t["now"]
+        rng = np.random.default_rng(1)
+        b0 = np.arange(N_NODES, dtype=np.int64) + 10_000
+        ad.feed_download_rows(self._rows(b0, np.roll(b0, 1), rng))
+        mapping = ad._id_table[b0].copy()
+        t["now"] = 1e9  # far beyond any ttl
+        extra = np.array([999_999], dtype=np.int64)
+        ad.feed_download_rows(self._rows(extra, b0[:1], rng))
+        assert int(ad._id_table[999_999]) == -1  # permanent drop
+        assert ad.evicted_nodes == 0
+        np.testing.assert_array_equal(ad._id_table[b0], mapping)
+        assert tr.apply_pending_recycles() == 0
+
+    def test_dropped_host_alone_reclaims_expired_capacity(self):
+        """A -1-memoized host must itself trigger eviction when it
+        returns after capacity expired — transience cannot depend on a
+        brand-new bucket arriving to kick the slow path."""
+        cluster = _mk_cluster()
+        tr = _mk_trainer(cluster, node_ttl=10.0)
+        ad = tr.make_wire_adapter()
+        t = {"now": 0.0}
+        ad.clock = lambda: t["now"]
+        rng = np.random.default_rng(2)
+        b0 = np.arange(N_NODES, dtype=np.int64) + 10_000
+        ad.feed_download_rows(self._rows(b0, np.roll(b0, 1), rng))
+        x = np.array([777_777], dtype=np.int64)
+        ad.feed_download_rows(self._rows(x, b0[:1], rng))
+        assert int(ad._id_table[777_777]) == -1  # dropped & memoized
+        t["now"] = 30.0  # the original hosts all expire
+        ad.feed_download_rows(self._rows(x, b0[:1], rng))
+        assert int(ad._id_table[777_777]) >= 0
+        assert ad.evicted_nodes > 0
+
+    def test_returning_host_in_eviction_chunk_is_touched_not_evicted(self):
+        """A long-silent host appearing in the SAME chunk as the new
+        host that triggers eviction is alive right now: it keeps its id,
+        its edges train, and its embedding row survives."""
+        cluster = _mk_cluster()
+        tr = _mk_trainer(cluster, node_ttl=10.0)
+        ad = tr.make_wire_adapter()
+        t = {"now": 0.0}
+        ad.clock = lambda: t["now"]
+        rng = np.random.default_rng(3)
+        b0 = np.arange(N_NODES, dtype=np.int64) + 10_000
+        ad.feed_download_rows(self._rows(b0, np.roll(b0, 1), rng))
+        h_id = int(ad._id_table[10_000])
+        t["now"] = 30.0  # everyone silent past ttl
+        new = np.array([888_888], dtype=np.int64)
+        before = ad.overflow_edges
+        ad.feed_download_rows(self._rows(new, b0[:1], rng))
+        assert int(ad._id_table[10_000]) == h_id, "live host lost its id"
+        assert int(ad._id_table[888_888]) >= 0
+        assert ad.overflow_edges == before, "live host's edge was dropped"
+        tr.apply_pending_recycles()
+        for leaf in self._embedding_leaves(tr.state.params):
+            assert np.abs(leaf[h_id]).sum() > 0, "live host row reset"
+
+    def test_adapter_mapping_survives_checkpoint_resume(self, tmp_path):
+        """ttl-mode id mappings are clock-driven, hence non-replayable:
+        they ride in the checkpoint so a restarted trainer keeps every
+        host on the dense id whose embedding learned it."""
+        cluster = _mk_cluster()
+        tr = _mk_trainer(cluster, tmp_path, node_ttl=10.0)
+        ad = tr.make_wire_adapter()
+        t = {"now": 1000.0}
+        ad.clock = lambda: t["now"]
+        rng = np.random.default_rng(4)
+        b0 = np.arange(N_NODES, dtype=np.int64) + 10_000
+        ad.feed_download_rows(self._rows(b0, np.roll(b0, 1), rng))
+        mapping = ad._id_table[b0].copy()
+        feat_cnt = ad._feat_cnt.copy()
+        tr.checkpoint()
+
+        tr2 = _mk_trainer(cluster, tmp_path, node_ttl=10.0)
+        assert tr2.resume()
+        ad2 = tr2.make_wire_adapter()
+        ad2.clock = lambda: t["now"] + 1.0
+        np.testing.assert_array_equal(ad2._id_table[b0], mapping)
+        assert ad2._next_id == N_NODES
+        np.testing.assert_array_equal(ad2._feat_cnt, feat_cnt)
+        # Hosts keep their ids on their next appearance after restart.
+        ad2.feed_download_rows(self._rows(b0[:4], b0[4:8], rng))
+        np.testing.assert_array_equal(ad2._id_table[b0], mapping)
