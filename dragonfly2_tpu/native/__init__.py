@@ -72,6 +72,41 @@ def _declare(lib: ctypes.CDLL) -> None:
         i64, ctypes.POINTER(i64), ctypes.POINTER(i64)
     ]
 
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(i64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    dbl = ctypes.c_double
+    lib.oi_create.restype = i64
+    lib.oi_create.argtypes = [ctypes.c_int32, i64, ctypes.c_int32,
+                              ctypes.c_int32, dbl, i64]
+    lib.oi_destroy.restype = i32
+    lib.oi_destroy.argtypes = [i64]
+    lib.oi_feed_download_rows.restype = i64
+    lib.oi_feed_download_rows.argtypes = [i64, f32p, i64, dbl, i32]
+    lib.oi_map_buckets.restype = i32
+    lib.oi_map_buckets.argtypes = [i64, f32p, i64, dbl, i32p]
+    lib.oi_lookup.restype = i32
+    lib.oi_lookup.argtypes = [i64, f32p, i64, i32p]
+    lib.oi_take_edges.restype = i64
+    lib.oi_take_edges.argtypes = [i64, i64, i32p, i32p, f32p, i64]
+    lib.oi_eof.restype = None
+    lib.oi_eof.argtypes = [i64]
+    lib.oi_node_features.restype = i32
+    lib.oi_node_features.argtypes = [i64, f32p]
+    lib.oi_take_recycled.restype = i64
+    lib.oi_take_recycled.argtypes = [i64, i32p, i64]
+    lib.oi_pending_recycled.restype = i64
+    lib.oi_pending_recycled.argtypes = [i64]
+    lib.oi_stats.restype = i32
+    lib.oi_stats.argtypes = [i64, i64p, i64p, i64p, i64p]
+    lib.oi_export_state.restype = i64
+    lib.oi_export_state.argtypes = [i64, i32p, i64p, f64p, i32p, i64,
+                                    f32p, f32p, i64p]
+    lib.oi_import_state.restype = i32
+    lib.oi_import_state.argtypes = [i64, i32p, i64p, f64p, i32p, i64,
+                                    f32p, f32p, i64, i64, i64]
+
 
 def load(rebuild: bool = False) -> Optional[ctypes.CDLL]:
     """Build (if needed) and load the native library; None on failure."""
@@ -96,6 +131,23 @@ def load(rebuild: bool = False) -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(_LIB_PATH)
             _declare(lib)
+        except AttributeError:
+            # A prebuilt .so from an older source tree lacks newer
+            # symbols — rebuild once instead of breaking the silent
+            # fallback for every native consumer.
+            if rebuild:
+                _build_error = "stale library persists after rebuild"
+                return None
+            try:
+                subprocess.run(
+                    ["make", "-C", _DIR, "-s", "clean", "all"],
+                    check=True, capture_output=True, text=True, timeout=120,
+                )
+                lib = ctypes.CDLL(_LIB_PATH)
+                _declare(lib)
+            except Exception as exc:  # noqa: BLE001 — fallback gate
+                _build_error = getattr(exc, "stderr", None) or str(exc)
+                return None
         except OSError as exc:
             _build_error = str(exc)
             return None
@@ -297,3 +349,196 @@ class NativePieceStore:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def _fp(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _ip(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _lp(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _dp(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+class NativeOnlineIngest:
+    """The wire→trainer hot path in C++ (oi_* in native.cpp): bucket→id
+    mapping with the TTL lifecycle, host-feature accumulation, and the
+    dispatch-block edge ring — one GIL-free call per wire chunk.
+    ``trainer.online_graph.WireIngestAdapter`` is the semantic spec and
+    delegates here when the library is available."""
+
+    def __init__(self, num_nodes: int, n_buckets: int, feat_dim: int,
+                 row_width: int, node_ttl: float, ring_capacity: int):
+        lib = load()
+        if lib is None:
+            raise NativeError(f"native library unavailable: {_build_error}")
+        self._lib = lib
+        self.num_nodes = int(num_nodes)
+        self.n_buckets = int(n_buckets)
+        self.feat_dim = int(feat_dim)
+        self.row_width = int(row_width)
+        self._h = lib.oi_create(num_nodes, n_buckets, feat_dim, row_width,
+                                float(node_ttl), ring_capacity)
+        if self._h < 0:
+            raise NativeError(f"oi_create -> {self._h}")
+
+    def feed_download_rows(self, rows: np.ndarray, now: float,
+                           *, block: bool = True) -> int:
+        rows = np.ascontiguousarray(rows, np.float32)
+        if rows.ndim != 2 or rows.shape[1] != self.row_width:
+            # The engine strides by ITS row_width — a mismatched shape
+            # would be an out-of-bounds read, not an error.
+            raise NativeError(
+                f"rows shape {rows.shape} != [n, {self.row_width}]"
+            )
+        kept = self._lib.oi_feed_download_rows(
+            self._h, _fp(rows), rows.shape[0], now, 1 if block else 0
+        )
+        if kept < 0:
+            raise NativeError(f"oi_feed_download_rows -> {kept}")
+        return int(kept)
+
+    def map_buckets(self, buckets: np.ndarray, now: float) -> np.ndarray:
+        b = np.ascontiguousarray(buckets, np.float32)
+        out = np.empty(len(b), np.int32)
+        rc = self._lib.oi_map_buckets(self._h, _fp(b), len(b), now, _ip(out))
+        if rc != 0:
+            raise NativeError(f"oi_map_buckets -> {rc}")
+        return out
+
+    def lookup(self, buckets: np.ndarray) -> np.ndarray:
+        """Read-only mapping probe — never allocates ids."""
+        b = np.ascontiguousarray(buckets, np.float32)
+        out = np.empty(len(b), np.int32)
+        rc = self._lib.oi_lookup(self._h, _fp(b), len(b), _ip(out))
+        if rc != 0:
+            raise NativeError(f"oi_lookup -> {rc}")
+        return out
+
+    def take_edges(self, need: int, timeout_s: float):
+        """Exactly-`need` edges as (src, dst, y), or None on timeout/EOF
+        with fewer than `need` buffered."""
+        src = np.empty(need, np.int32)
+        dst = np.empty(need, np.int32)
+        y = np.empty(need, np.float32)
+        got = self._lib.oi_take_edges(
+            self._h, need, _ip(src), _ip(dst), _fp(y),
+            max(int(timeout_s * 1000), 0),
+        )
+        if got < 0:
+            raise NativeError(f"oi_take_edges -> {got}")
+        if got == 0:
+            return None
+        return src, dst, y
+
+    def eof(self) -> None:
+        self._lib.oi_eof(self._h)
+
+    def node_features(self) -> np.ndarray:
+        out = np.empty((self.num_nodes, self.feat_dim), np.float32)
+        rc = self._lib.oi_node_features(self._h, _fp(out))
+        if rc != 0:
+            raise NativeError(f"oi_node_features -> {rc}")
+        return out
+
+    def take_recycled(self, cap: int = 65536) -> np.ndarray:
+        out = np.empty(cap, np.int32)
+        n = self._lib.oi_take_recycled(self._h, _ip(out), cap)
+        if n < 0:
+            raise NativeError(f"oi_take_recycled -> {n}")
+        return out[:n].copy()
+
+    def pending_recycled(self) -> int:
+        return int(self._lib.oi_pending_recycled(self._h))
+
+    def stats(self) -> dict:
+        vals = [ctypes.c_int64(0) for _ in range(4)]
+        rc = self._lib.oi_stats(self._h, *[ctypes.byref(v) for v in vals])
+        if rc != 0:
+            raise NativeError(f"oi_stats -> {rc}")
+        return {
+            "overflow_edges": vals[0].value,
+            "evicted_nodes": vals[1].value,
+            "next_id": vals[2].value,
+            "rows_in": vals[3].value,
+        }
+
+    def export_state(self):
+        """Snapshot the mapping for a checkpoint; None while recycled ids
+        still await their embedding-row reset (caller drains + retries)."""
+        id_table = np.empty(self.n_buckets, np.int32)
+        bucket_of = np.empty(self.num_nodes, np.int64)
+        last_seen = np.empty(self.num_nodes, np.float64)
+        free = np.empty(self.num_nodes, np.int32)
+        feat_sum = np.empty((self.num_nodes, self.feat_dim), np.float32)
+        feat_cnt = np.empty(self.num_nodes, np.float32)
+        scalars = np.zeros(3, np.int64)
+        n = self._lib.oi_export_state(
+            self._h, _ip(id_table), _lp(bucket_of), _dp(last_seen),
+            _ip(free), self.num_nodes, _fp(feat_sum), _fp(feat_cnt),
+            _lp(scalars),
+        )
+        if n == -1:
+            return None
+        if n < 0:
+            raise NativeError(f"oi_export_state -> {n}")
+        return {
+            "id_table": id_table,
+            "bucket_of": bucket_of,
+            "last_seen": last_seen,
+            "free": free[:n].copy(),
+            "feat_sum": feat_sum,
+            "feat_cnt": feat_cnt,
+            "next_id": int(scalars[0]),
+            "overflow_edges": int(scalars[1]),
+            "evicted_nodes": int(scalars[2]),
+        }
+
+    def import_state(self, id_table, bucket_of, last_seen, free,
+                     feat_sum, feat_cnt, next_id, overflow, evicted) -> None:
+        id_table = np.ascontiguousarray(id_table, np.int32)
+        bucket_of = np.ascontiguousarray(bucket_of, np.int64)
+        last_seen = np.ascontiguousarray(last_seen, np.float64)
+        free = np.ascontiguousarray(free, np.int32)
+        feat_sum = np.ascontiguousarray(feat_sum, np.float32)
+        feat_cnt = np.ascontiguousarray(feat_cnt, np.float32)
+        # The engine memcpys its OWN sizes out of these buffers — a
+        # shape mismatch would be an out-of-bounds read, not an error.
+        if (
+            len(id_table) != self.n_buckets
+            or len(bucket_of) != self.num_nodes
+            or len(last_seen) != self.num_nodes
+            or len(feat_cnt) != self.num_nodes
+            or feat_sum.size != self.num_nodes * self.feat_dim
+        ):
+            raise NativeError(
+                f"import_state shape mismatch: engine has num_nodes="
+                f"{self.num_nodes}/n_buckets={self.n_buckets}"
+            )
+        rc = self._lib.oi_import_state(
+            self._h, _ip(id_table), _lp(bucket_of), _dp(last_seen),
+            _ip(free), len(free), _fp(feat_sum), _fp(feat_cnt),
+            int(next_id), int(overflow), int(evicted),
+        )
+        if rc != 0:
+            raise NativeError(
+                f"oi_import_state -> {rc} (corrupt adapter state?)"
+            )
+
+    def close(self) -> None:
+        if self._h >= 0:
+            self._lib.oi_destroy(self._h)
+            self._h = -1
+
+    def __del__(self):  # belt & suspenders; close() is the contract
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -16,8 +16,10 @@
 //
 // Exposed as a C ABI for the ctypes bindings in ../__init__.py.
 
+#include <algorithm>
 #include <atomic>
 #include <cerrno>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -1014,6 +1016,478 @@ int ps_close(int64_t handle) {
     ps->tasks.clear();
   }
   delete ps;
+  return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Online ingest engine (oi_*): the wire→trainer hot path of the online graph
+// trainer.  Semantics mirror trainer/online_graph.py WireIngestAdapter — that
+// Python class is the spec (bucket→dense-id first-come mapping, TTL eviction
+// + id recycling, host-feature accumulation, bounded edge ring with
+// backpressure) — but the whole per-chunk pass runs here without the GIL:
+// the measured ceiling of the composed wire-fed loop was the single Python
+// consumer process (BENCHMARKS.md bottleneck ledger), not any one stage.
+//
+// Parity notes (asserted in tests/test_native_ingest.py):
+//  * id assignment is per-chunk sorted-unique over BOTH endpoint columns in
+//    one call — byte-identical mappings to the Python adapter for the same
+//    arrival order;
+//  * feature accumulation credits parent cols [2+H, 2+2H) to src and child
+//    cols [2, 2+H) to dst (records.features.accumulate_host_feature_sums);
+//    unlike Python's sampled fold it accumulates EVERY kept row (C++ can
+//    afford it; means only converge harder);
+//  * eviction runs under the engine lock with the caller-supplied clock, so
+//    injectable-clock tests drive both implementations identically.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct OnlineIngest {
+  int32_t num_nodes = 0;
+  int64_t n_buckets = 0;
+  int32_t feat_dim = 0;
+  int32_t row_width = 0;
+  double ttl = 0.0;
+
+  std::mutex mu;
+  std::condition_variable cv_space;  // feeders wait for ring room
+  std::condition_variable cv_data;   // taker waits for enough edges
+
+  std::vector<int32_t> id_table;   // [n_buckets]  -2 unseen, -1 overflow
+  std::vector<int64_t> bucket_of;  // [num_nodes]  -1 free
+  std::vector<double> last_seen;   // [num_nodes]
+  std::vector<int32_t> free_ids;   // recycled ids, pop from back
+  int32_t next_id = 0;
+  double last_scan = -1e300;
+  int64_t overflow_edges = 0;
+  int64_t evicted_nodes = 0;
+  int64_t rows_in = 0;
+  std::vector<int32_t> pending_recycle;
+
+  // double internally: the engine folds EVERY kept row (no sampling),
+  // so a hot node passes float32's 2^24 integer ceiling within hours at
+  // wire rate and `cnt += 1.0f` would silently freeze the mean.  The
+  // ABI (export/node_features) stays float32 — the shared state format.
+  std::vector<double> feat_sum;  // [num_nodes * feat_dim]
+  std::vector<double> feat_cnt;  // [num_nodes]
+
+  int64_t cap = 0;  // edge ring capacity
+  std::vector<int32_t> ring_src, ring_dst;
+  std::vector<float> ring_y;
+  int64_t head = 0, size = 0;
+  bool eof = false;
+  bool closed = false;
+
+  std::vector<int32_t> ids_scratch;
+  std::vector<int64_t> new_scratch;
+  // Per-chunk staging (reused; the engine mutex serializes feeders).
+  std::vector<float> cols_scratch;
+  std::vector<int32_t> st_src, st_dst;
+  std::vector<float> st_y;
+};
+
+using IngestPtr = std::shared_ptr<OnlineIngest>;
+
+std::mutex g_oi_mu;
+std::map<int64_t, IngestPtr> g_oi;
+int64_t g_oi_next = 1;
+
+// shared_ptr copy: callers blocked inside the engine (cv waits) keep it
+// alive across a concurrent oi_destroy — destroy unmaps + wakes, the
+// last user frees (the TSAN gate caught the raw-pointer version).
+IngestPtr oi_get(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_oi_mu);
+  auto it = g_oi.find(h);
+  return it == g_oi.end() ? nullptr : it->second;
+}
+
+// Reclaim ids silent past ttl (trainer/online_graph.py _evict_expired):
+// throttled full scan; frees mapping + accumulators, queues the row reset.
+// Caller holds e->mu.
+int64_t oi_evict_locked(OnlineIngest* e, double now) {
+  if (e->ttl <= 0 || now - e->last_scan < e->ttl * 0.25) return 0;
+  e->last_scan = now;
+  int64_t k = 0;
+  for (int32_t id = 0; id < e->num_nodes; id++) {
+    if (e->bucket_of[id] < 0 || now - e->last_seen[id] <= e->ttl) continue;
+    e->id_table[e->bucket_of[id]] = -2;
+    e->bucket_of[id] = -1;
+    std::fill_n(&e->feat_sum[(int64_t)id * e->feat_dim], e->feat_dim, 0.0);
+    e->feat_cnt[id] = 0.0;
+    e->free_ids.push_back(id);
+    e->pending_recycle.push_back(id);
+    k++;
+  }
+  if (k) {
+    e->evicted_nodes += k;
+    // Un-memoize overflow buckets: dropped hosts may claim freed ids.
+    for (int64_t b = 0; b < e->n_buckets; b++)
+      if (e->id_table[b] == -1) e->id_table[b] = -2;
+  }
+  return k;
+}
+
+// bucket → dense id over one flat column (trainer/online_graph.py _map_ids):
+// touch-before-evict, sorted-unique allocation, in-loop eviction retry.
+// Out-of-range buckets map to -1 (hostile wire input must not fault).
+// Caller holds e->mu.
+void oi_map_locked(OnlineIngest* e, const float* buckets, int64_t n,
+                   double now, int32_t* out) {
+  bool any_unseen = false, any_dropped = false;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t b = (int64_t)buckets[i];
+    int32_t v = (b < 0 || b >= e->n_buckets) ? -1 : e->id_table[b];
+    out[i] = v;
+    if (v == -2) any_unseen = true;
+    if (v == -1) any_dropped = true;
+    if (e->ttl > 0 && v >= 0) e->last_seen[v] = now;
+  }
+  if (!any_unseen && !(e->ttl > 0 && any_dropped)) return;
+  if (e->free_ids.empty() && e->next_id >= e->num_nodes) {
+    if (oi_evict_locked(e, now) > 0) {
+      for (int64_t i = 0; i < n; i++) {
+        int64_t b = (int64_t)buckets[i];
+        out[i] = (b < 0 || b >= e->n_buckets) ? -1 : e->id_table[b];
+      }
+    }
+  }
+  e->new_scratch.clear();
+  for (int64_t i = 0; i < n; i++)
+    if (out[i] == -2) e->new_scratch.push_back((int64_t)buckets[i]);
+  std::sort(e->new_scratch.begin(), e->new_scratch.end());
+  e->new_scratch.erase(
+      std::unique(e->new_scratch.begin(), e->new_scratch.end()),
+      e->new_scratch.end());
+  for (int64_t nb : e->new_scratch) {
+    if (e->id_table[nb] != -2) continue;
+    if (e->free_ids.empty() && e->next_id >= e->num_nodes)
+      oi_evict_locked(e, now);  // pool drained mid-chunk; throttled
+    int32_t nid;
+    if (!e->free_ids.empty()) {
+      nid = e->free_ids.back();
+      e->free_ids.pop_back();
+    } else if (e->next_id < e->num_nodes) {
+      nid = e->next_id++;
+    } else {
+      e->id_table[nb] = -1;
+      continue;
+    }
+    e->id_table[nb] = nid;
+    e->bucket_of[nid] = nb;
+    e->last_seen[nid] = now;
+  }
+  for (int64_t i = 0; i < n; i++) {
+    int64_t b = (int64_t)buckets[i];
+    out[i] = (b < 0 || b >= e->n_buckets) ? -1 : e->id_table[b];
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t oi_create(int32_t num_nodes, int64_t n_buckets, int32_t feat_dim,
+                  int32_t row_width, double ttl, int64_t ring_cap) {
+  if (num_nodes <= 0 || n_buckets <= 0 || feat_dim <= 0 ||
+      row_width < 2 + 2 * feat_dim + 1 || ring_cap <= 0)
+    return -1;
+  auto e = std::make_shared<OnlineIngest>();
+  e->num_nodes = num_nodes;
+  e->n_buckets = n_buckets;
+  e->feat_dim = feat_dim;
+  e->row_width = row_width;
+  e->ttl = ttl;
+  e->id_table.assign(n_buckets, -2);
+  e->bucket_of.assign(num_nodes, -1);
+  e->last_seen.assign(num_nodes, 0.0);
+  e->feat_sum.assign((int64_t)num_nodes * feat_dim, 0.0);
+  e->feat_cnt.assign(num_nodes, 0.0);
+  e->cap = ring_cap;
+  e->ring_src.resize(ring_cap);
+  e->ring_dst.resize(ring_cap);
+  e->ring_y.resize(ring_cap);
+  std::lock_guard<std::mutex> lk(g_oi_mu);
+  int64_t h = g_oi_next++;
+  g_oi[h] = e;
+  return h;
+}
+
+// Map + accumulate + ring-append one chunk of download rows ([n, row_width]
+// float32, src bucket col 0, dst col 1, target last col).  Blocks for ring
+// space (backpressure) when block != 0.  Returns edges kept (overflow rows
+// dropped+counted), -1 on bad handle / closed.
+int64_t oi_feed_download_rows(int64_t h, const float* rows, int64_t n,
+                              double now, int32_t block) {
+  IngestPtr e = oi_get(h);
+  if (!e || n < 0) return -1;
+  if (n == 0) return 0;
+  std::unique_lock<std::mutex> lk(e->mu);
+  if (e->closed) return -1;
+  const int32_t w = e->row_width, H = e->feat_dim;
+  e->ids_scratch.resize(2 * n);
+  // ONE mapping pass over both endpoint columns (gathered strided →
+  // flat), matching the Python adapter's combined call: every host in
+  // the chunk is touched before any eviction can reclaim it.
+  e->cols_scratch.resize(2 * n);
+  float* cols = e->cols_scratch.data();
+  for (int64_t i = 0; i < n; i++) {
+    cols[i] = rows[i * w];
+    cols[n + i] = rows[i * w + 1];
+  }
+  oi_map_locked(e.get(), cols, 2 * n, now, e->ids_scratch.data());
+  // Pass 1 (atomic with the mapping — the Python spec's _mu scope):
+  // feature credit + edge staging.  No cv waits happen in here, so a
+  // concurrent eviction during backpressure can't recycle an id between
+  // its mapping and its feature credit.
+  auto& st_src = e->st_src;
+  auto& st_dst = e->st_dst;
+  auto& st_y = e->st_y;
+  st_src.clear();
+  st_dst.clear();
+  st_y.clear();
+  for (int64_t i = 0; i < n; i++) {
+    int32_t s = e->ids_scratch[i], d = e->ids_scratch[n + i];
+    if (s < 0 || d < 0) {
+      e->overflow_edges++;
+      continue;
+    }
+    const float* r = rows + i * w;
+    e->feat_cnt[s] += 1.0;
+    e->feat_cnt[d] += 1.0;
+    double* fs = &e->feat_sum[(int64_t)s * H];
+    double* fd = &e->feat_sum[(int64_t)d * H];
+    for (int32_t j = 0; j < H; j++) {
+      fs[j] += r[2 + H + j];  // parent cols credit src
+      fd[j] += r[2 + j];      // child cols credit dst
+    }
+    st_src.push_back(s);
+    st_dst.push_back(d);
+    st_y.push_back(r[w - 1]);
+  }
+  e->rows_in += n;
+  // Pass 2: ring append with backpressure.  Edges staged here may still
+  // reference an id evicted while we wait — the documented aliasing
+  // window, identical to the Python queue path.
+  int64_t kept = 0;
+  for (size_t i = 0; i < st_src.size(); i++) {
+    while (e->size >= e->cap) {
+      if (!block || e->closed) {
+        // Staged edges that no longer fit are LOST (their features were
+        // already credited; re-feeding would double-count) — account
+        // them so kept + overflow == rows always holds.
+        e->overflow_edges += (int64_t)(st_src.size() - i);
+        e->cv_data.notify_all();
+        return e->closed ? -1 : kept;
+      }
+      e->cv_space.wait(lk);
+    }
+    int64_t tail = (e->head + e->size) % e->cap;
+    e->ring_src[tail] = st_src[i];
+    e->ring_dst[tail] = st_dst[i];
+    e->ring_y[tail] = st_y[i];
+    e->size++;
+    kept++;
+    if ((kept & 0xFFF) == 0) e->cv_data.notify_all();
+  }
+  e->cv_data.notify_all();
+  return kept;
+}
+
+// Topology-path mapping (probe edges don't carry host features); same
+// allocation/touch semantics as the download path.
+int32_t oi_map_buckets(int64_t h, const float* buckets, int64_t n, double now,
+                       int32_t* out) {
+  IngestPtr e = oi_get(h);
+  if (!e) return -1;
+  std::lock_guard<std::mutex> lk(e->mu);
+  oi_map_locked(e.get(), buckets, n, now, out);
+  return 0;
+}
+
+// Read-only probe (tests/diagnostics): current mapping, no allocation.
+int32_t oi_lookup(int64_t h, const float* buckets, int64_t n, int32_t* out) {
+  IngestPtr e = oi_get(h);
+  if (!e) return -1;
+  std::lock_guard<std::mutex> lk(e->mu);
+  for (int64_t i = 0; i < n; i++) {
+    int64_t b = (int64_t)buckets[i];
+    out[i] = (b < 0 || b >= e->n_buckets) ? -1 : e->id_table[b];
+  }
+  return 0;
+}
+
+// All-or-nothing dispatch block: copies exactly `need` edges once enough
+// have accumulated; 0 on timeout/eof-with-partial (the partial stays for
+// a later taker — same leftover semantics as the Python queue path).
+int64_t oi_take_edges(int64_t h, int64_t need, int32_t* src, int32_t* dst,
+                      float* y, int64_t timeout_ms) {
+  IngestPtr e = oi_get(h);
+  if (!e || need <= 0 || need > e->cap) return -1;
+  std::unique_lock<std::mutex> lk(e->mu);
+  // The timeout is an IDLE timeout (the Python queue path renews it per
+  // arriving chunk): any progress since the last wake resets the clock,
+  // so slow-but-steady ingest never ends the run mid-stream.
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  int64_t last_size = e->size;
+  while (e->size < need && !e->eof && !e->closed) {
+    if (e->size != last_size) {
+      last_size = e->size;
+      deadline = std::chrono::steady_clock::now() +
+                 std::chrono::milliseconds(timeout_ms);
+    }
+    if (e->cv_data.wait_until(lk, deadline) == std::cv_status::timeout) {
+      if (e->size != last_size) continue;  // progress raced the timeout
+      break;
+    }
+  }
+  if (e->size < need) return 0;
+  int64_t first = std::min(need, e->cap - e->head);
+  memcpy(src, &e->ring_src[e->head], sizeof(int32_t) * first);
+  memcpy(dst, &e->ring_dst[e->head], sizeof(int32_t) * first);
+  memcpy(y, &e->ring_y[e->head], sizeof(float) * first);
+  if (first < need) {
+    memcpy(src + first, &e->ring_src[0], sizeof(int32_t) * (need - first));
+    memcpy(dst + first, &e->ring_dst[0], sizeof(int32_t) * (need - first));
+    memcpy(y + first, &e->ring_y[0], sizeof(float) * (need - first));
+  }
+  e->head = (e->head + need) % e->cap;
+  e->size -= need;
+  e->cv_space.notify_all();
+  return need;
+}
+
+void oi_eof(int64_t h) {
+  IngestPtr e = oi_get(h);
+  if (!e) return;
+  std::lock_guard<std::mutex> lk(e->mu);
+  e->eof = true;
+  e->cv_data.notify_all();
+}
+
+int32_t oi_node_features(int64_t h, float* out) {
+  IngestPtr e = oi_get(h);
+  if (!e) return -1;
+  std::lock_guard<std::mutex> lk(e->mu);
+  for (int32_t id = 0; id < e->num_nodes; id++) {
+    double c = e->feat_cnt[id] > 1.0 ? e->feat_cnt[id] : 1.0;
+    const double* s = &e->feat_sum[(int64_t)id * e->feat_dim];
+    float* o = out + (int64_t)id * e->feat_dim;
+    for (int32_t j = 0; j < e->feat_dim; j++) o[j] = (float)(s[j] / c);
+  }
+  return 0;
+}
+
+int64_t oi_take_recycled(int64_t h, int32_t* out, int64_t cap) {
+  IngestPtr e = oi_get(h);
+  if (!e) return -1;
+  std::lock_guard<std::mutex> lk(e->mu);
+  int64_t k = std::min<int64_t>(cap, e->pending_recycle.size());
+  if (k > 0) memcpy(out, e->pending_recycle.data(), sizeof(int32_t) * k);
+  e->pending_recycle.erase(e->pending_recycle.begin(),
+                           e->pending_recycle.begin() + k);
+  return k;
+}
+
+int64_t oi_pending_recycled(int64_t h) {
+  IngestPtr e = oi_get(h);
+  if (!e) return -1;
+  std::lock_guard<std::mutex> lk(e->mu);
+  return (int64_t)e->pending_recycle.size();
+}
+
+int32_t oi_stats(int64_t h, int64_t* overflow, int64_t* evicted,
+                 int64_t* next_id, int64_t* rows_in) {
+  IngestPtr e = oi_get(h);
+  if (!e) return -1;
+  std::lock_guard<std::mutex> lk(e->mu);
+  *overflow = e->overflow_edges;
+  *evicted = e->evicted_nodes;
+  *next_id = e->next_id;
+  *rows_in = e->rows_in;
+  return 0;
+}
+
+// Checkpoint export: refuses (-1) while recycled ids await their row reset
+// — the trainer drains + applies, then retries, so a saved mapping can
+// never outrun its embedding resets.  Returns the free-list length.
+int64_t oi_export_state(int64_t h, int32_t* id_table, int64_t* bucket_of,
+                        double* last_seen, int32_t* free_out, int64_t free_cap,
+                        float* feat_sum, float* feat_cnt, int64_t* scalars) {
+  IngestPtr e = oi_get(h);
+  if (!e) return -3;
+  std::lock_guard<std::mutex> lk(e->mu);
+  if (!e->pending_recycle.empty()) return -1;
+  if ((int64_t)e->free_ids.size() > free_cap) return -2;
+  memcpy(id_table, e->id_table.data(), sizeof(int32_t) * e->n_buckets);
+  memcpy(bucket_of, e->bucket_of.data(), sizeof(int64_t) * e->num_nodes);
+  memcpy(last_seen, e->last_seen.data(), sizeof(double) * e->num_nodes);
+  if (!e->free_ids.empty())
+    memcpy(free_out, e->free_ids.data(),
+           sizeof(int32_t) * e->free_ids.size());
+  for (int64_t i = 0; i < (int64_t)e->num_nodes * e->feat_dim; i++)
+    feat_sum[i] = (float)e->feat_sum[i];
+  for (int32_t i = 0; i < e->num_nodes; i++)
+    feat_cnt[i] = (float)e->feat_cnt[i];
+  scalars[0] = e->next_id;
+  scalars[1] = e->overflow_edges;
+  scalars[2] = e->evicted_nodes;
+  return (int64_t)e->free_ids.size();
+}
+
+int32_t oi_import_state(int64_t h, const int32_t* id_table,
+                        const int64_t* bucket_of, const double* last_seen,
+                        const int32_t* free_in, int64_t free_len,
+                        const float* feat_sum, const float* feat_cnt,
+                        int64_t next_id, int64_t overflow, int64_t evicted) {
+  IngestPtr e = oi_get(h);
+  if (!e) return -1;
+  std::lock_guard<std::mutex> lk(e->mu);
+  // Value validation: restored ids become raw indices later — a corrupt
+  // checkpoint must fail cleanly here, not heap-corrupt in the hot path.
+  if (next_id < 0 || next_id > e->num_nodes || free_len > e->num_nodes)
+    return -2;
+  for (int64_t i = 0; i < free_len; i++)
+    if (free_in[i] < 0 || free_in[i] >= e->num_nodes) return -2;
+  for (int32_t i = 0; i < e->num_nodes; i++)
+    if (bucket_of[i] < -1 || bucket_of[i] >= e->n_buckets) return -2;
+  for (int64_t b = 0; b < e->n_buckets; b++)
+    if (id_table[b] < -2 || id_table[b] >= e->num_nodes) return -2;
+  memcpy(e->id_table.data(), id_table, sizeof(int32_t) * e->n_buckets);
+  memcpy(e->bucket_of.data(), bucket_of, sizeof(int64_t) * e->num_nodes);
+  memcpy(e->last_seen.data(), last_seen, sizeof(double) * e->num_nodes);
+  e->free_ids.assign(free_in, free_in + (free_len > 0 ? free_len : 0));
+  for (int64_t i = 0; i < (int64_t)e->num_nodes * e->feat_dim; i++)
+    e->feat_sum[i] = feat_sum[i];
+  for (int32_t i = 0; i < e->num_nodes; i++)
+    e->feat_cnt[i] = feat_cnt[i];
+  e->next_id = (int32_t)next_id;
+  e->overflow_edges = overflow;
+  e->evicted_nodes = evicted;
+  e->pending_recycle.clear();
+  e->last_scan = -1e300;
+  return 0;
+}
+
+int32_t oi_destroy(int64_t h) {
+  IngestPtr e;
+  {
+    std::lock_guard<std::mutex> lk(g_oi_mu);
+    auto it = g_oi.find(h);
+    if (it == g_oi.end()) return -1;
+    e = it->second;
+    g_oi.erase(it);
+  }
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    e->closed = true;
+    e->cv_data.notify_all();
+    e->cv_space.notify_all();
+  }
+  // Blocked feeders/takers hold their own shared_ptr; the engine frees
+  // when the last of them returns.
   return 0;
 }
 

@@ -101,21 +101,81 @@ class WireIngestAdapter:
     trades strict byte-identity replay for capacity recycling; the
     determinism soaks keep ``node_ttl=0`` (the default, which preserves
     the fixed first-come mapping exactly).
+
+    **Native fast path** (``OnlineGraphConfig.native_ingest``, default
+    on, silent fallback): this class is the SPEC; when the C++ engine
+    is available the whole per-chunk pass — mapping, lifecycle,
+    feature accumulation, edge buffering — runs in native.cpp's oi_*
+    engine without the GIL, and the trainer takes dispatch blocks
+    straight from the engine's edge ring (``trainer.block_source``)
+    instead of the Python queue.  The measured ceiling of the composed
+    wire-fed loop was the single Python consumer process compositing
+    every stage under one GIL (BENCHMARKS.md bottleneck ledger), not
+    any stage's algorithm.  One deliberate divergence: the native
+    engine folds EVERY kept row into the feature means (no
+    FEATURE_SAMPLE_ROWS sampling — C++ can afford the full pass).
     """
 
-    def __init__(self, trainer: "OnlineGraphTrainer") -> None:
-        from ..records.features import HOST_FEATURE_DIM, NUM_HASH_BUCKETS
+    def __init__(
+        self, trainer: "OnlineGraphTrainer", *, use_native: bool = None
+    ) -> None:
+        from ..records.features import (
+            DOWNLOAD_COLUMNS,
+            HOST_FEATURE_DIM,
+            NUM_HASH_BUCKETS,
+        )
 
         self.trainer = trainer
         n = trainer.config.num_nodes
+        self._native = None
+        if use_native is None:
+            use_native = trainer.config.native_ingest
+        if use_native:
+            try:
+                from ..native import NativeOnlineIngest
+
+                cfg = trainer.config
+                ring = max(cfg.queue_capacity, 2) * (
+                    cfg.super_steps * cfg.batch_size
+                )
+                self._native = NativeOnlineIngest(
+                    n, NUM_HASH_BUCKETS, HOST_FEATURE_DIM,
+                    len(DOWNLOAD_COLUMNS), cfg.node_ttl, ring,
+                )
+            except Exception as exc:  # noqa: BLE001 — optimization only
+                logger.warning(
+                    "native ingest unavailable (%s); python fallback", exc
+                )
+                self._native = None
+            if self._native is not None:
+                if (
+                    not trainer._downloads.empty()
+                    or trainer._leftover is not None
+                ):
+                    # Switching to the engine's edge ring would silently
+                    # strand edges already in the Python queue.  (When
+                    # the library is UNAVAILABLE the python fallback
+                    # keeps them — so check only after construction.)
+                    self._native.close()
+                    self._native = None
+                    raise RuntimeError(
+                        "cannot attach a native-ingest adapter after "
+                        "feed_downloads: queued edges would be lost "
+                        "(attach the adapter first, or set "
+                        "native_ingest=False)"
+                    )
+                trainer.block_source = self._native_block
         # Vectorized bucket → dense-id table (the ingest hot path must
-        # sustain wire rate): -2 = unseen, -1 = overflow.
+        # sustain wire rate): -2 = unseen, -1 = overflow.  Unused (but
+        # kept allocated) when the native engine owns the mapping.
         self._id_table = np.full(NUM_HASH_BUCKETS, -2, np.int32)
         self._next_id = 0
         self._feat_sum = np.zeros((n, HOST_FEATURE_DIM), np.float32)
         self._feat_cnt = np.zeros(n, np.float32)
-        self.overflow_edges = 0
-        self.evicted_nodes = 0
+        self._py_overflow = 0  # python-path edges + native-path topo drops
+        self._py_evicted = 0
+        self._native_overflow_seen = 0  # engine counter high-water (metrics)
+        self._warned_full = False
         # Lifecycle state: last time each dense id was seen on any
         # stream, its current bucket (for reverse unmapping), and the
         # free pool of recycled ids.
@@ -132,22 +192,134 @@ class WireIngestAdapter:
         if trainer._adapter_restore is not None:
             self._apply_restore(trainer._adapter_restore)
 
+    @property
+    def overflow_edges(self) -> int:
+        if self._native is not None:
+            return self._native.stats()["overflow_edges"] + self._py_overflow
+        return self._py_overflow
+
+    @property
+    def evicted_nodes(self) -> int:
+        if self._native is not None:
+            return self._native.stats()["evicted_nodes"]
+        return self._py_evicted
+
+    def _native_block(self, timeout: float):
+        """trainer.block_source: one [super_steps, batch] dispatch block
+        straight out of the engine's edge ring (a single C++ memcpy —
+        no Python-level queue/concatenate on the hot path)."""
+        cfg = self.trainer.config
+        need = cfg.super_steps * cfg.batch_size
+        got = self._native.take_edges(need, timeout)
+        if got is None:
+            return None
+        shape = (cfg.super_steps, cfg.batch_size)
+        return (
+            got[0].reshape(shape), got[1].reshape(shape),
+            got[2].reshape(shape),
+        )
+
+    def poll_recycled(self) -> None:
+        """Drain engine-side evictions into the trainer's recycle queue
+        (the python path queues them inline in _evict_expired)."""
+        if self._native is None:
+            return
+        from .metrics import ONLINE_NODES_EVICTED
+
+        while True:
+            ids = self._native.take_recycled()
+            if not len(ids):
+                return
+            ONLINE_NODES_EVICTED.inc(len(ids))
+            self.trainer.request_recycle(ids)
+
     def _apply_restore(self, st: dict) -> None:
         """Re-attach a checkpointed id mapping: the mapping is NOT
         derivable from the stream in ttl mode (eviction is clock-driven),
         so it rides in the trainer checkpoint — host X keeps the dense id
-        whose embedding learned X."""
+        whose embedding learned X.  The state format is shared between
+        the python and native engines: either can restore the other's."""
+        n = self.trainer.config.num_nodes
+        if len(st["adapter_bucket_of"]) != n:
+            # A mismatched num_nodes would OOB-read in the native import
+            # (and silently desync the python arrays).
+            raise ValueError(
+                f"checkpoint adapter state is for num_nodes="
+                f"{len(st['adapter_bucket_of'])}, trainer has {n}"
+            )
+        free = [int(i) for i in st["adapter_free"] if i >= 0]
+        if self._native is not None:
+            self._native.import_state(
+                st["adapter_id_table"], st["adapter_bucket_of"],
+                st["adapter_last_seen"], np.asarray(free, np.int32),
+                st["adapter_feat_sum"], st["adapter_feat_cnt"],
+                int(st["adapter_next_id"]),
+                int(st["adapter_overflow_edges"]),
+                int(st["adapter_evicted_nodes"]),
+            )
+            self._py_overflow = 0
+            # Sync the metrics high-water to the imported counter, else
+            # the first post-restore drop re-counts the whole history.
+            self._native_overflow_seen = int(st["adapter_overflow_edges"])
+            return
         with self._mu:
             self._id_table = np.asarray(st["adapter_id_table"], np.int32).copy()
             self._bucket_of = np.asarray(st["adapter_bucket_of"], np.int64).copy()
             self._last_seen = np.asarray(st["adapter_last_seen"], np.float64).copy()
-            self._free = [int(i) for i in st["adapter_free"] if i >= 0]
+            self._free = free
             self._next_id = int(st["adapter_next_id"])
             self._feat_sum = np.asarray(st["adapter_feat_sum"], np.float32).copy()
             self._feat_cnt = np.asarray(st["adapter_feat_cnt"], np.float32).copy()
-            self.overflow_edges = int(st["adapter_overflow_edges"])
-            self.evicted_nodes = int(st["adapter_evicted_nodes"])
+            self._py_overflow = int(st["adapter_overflow_edges"])
+            self._py_evicted = int(st["adapter_evicted_nodes"])
             self._last_evict_scan = float("-inf")
+
+    def snapshot_for_checkpoint(self) -> dict:
+        """A consistent (mapping, applied-row-resets) pair for the
+        trainer checkpoint: drains + applies pending recycles, then
+        snapshots the mapping, retrying if an eviction raced in between
+        — a saved mapping must never outrun its embedding resets."""
+        while True:
+            self.poll_recycled()
+            self.trainer.apply_pending_recycles()
+            if self._native is not None:
+                st = self._native.export_state()
+                if st is None:  # eviction landed after the drain
+                    continue
+                return {
+                    "adapter_id_table": st["id_table"],
+                    "adapter_bucket_of": st["bucket_of"],
+                    "adapter_last_seen": st["last_seen"],
+                    # Trailing -1 sentinel: orbax rejects zero-size
+                    # arrays, and free ids are always >= 0.
+                    "adapter_free": np.concatenate(
+                        [st["free"].astype(np.int64), [-1]]
+                    ),
+                    "adapter_next_id": st["next_id"],
+                    "adapter_feat_sum": st["feat_sum"],
+                    "adapter_feat_cnt": st["feat_cnt"],
+                    "adapter_overflow_edges": (
+                        st["overflow_edges"] + self._py_overflow
+                    ),
+                    "adapter_evicted_nodes": st["evicted_nodes"],
+                }
+            with self._mu:
+                with self.trainer._recycle_lock:
+                    if self.trainer._pending_recycle:
+                        continue
+                return {
+                    "adapter_id_table": self._id_table.copy(),
+                    "adapter_bucket_of": self._bucket_of.copy(),
+                    "adapter_last_seen": self._last_seen.copy(),
+                    "adapter_free": np.concatenate(
+                        [np.asarray(self._free, np.int64), [-1]]
+                    ),
+                    "adapter_next_id": int(self._next_id),
+                    "adapter_feat_sum": self._feat_sum.copy(),
+                    "adapter_feat_cnt": self._feat_cnt.copy(),
+                    "adapter_overflow_edges": int(self._py_overflow),
+                    "adapter_evicted_nodes": int(self._py_evicted),
+                }
 
     def _evict_expired(self, now: float) -> int:
         """Reclaim dense ids whose hosts fell silent for ``node_ttl``
@@ -167,7 +339,7 @@ class WireIngestAdapter:
         self._feat_sum[expired] = 0.0
         self._feat_cnt[expired] = 0.0
         self._free.extend(int(i) for i in expired)
-        self.evicted_nodes += len(expired)
+        self._py_evicted += len(expired)
         # Un-memoize overflow buckets: freed capacity means previously
         # dropped hosts may claim ids on their next appearance.
         self._id_table[self._id_table == -1] = -2
@@ -228,24 +400,48 @@ class WireIngestAdapter:
             out = self._id_table[b]
         return out
 
+    def _warn_table_full_once(self) -> None:
+        """One warning per adapter lifetime, whichever path drops first
+        (callers hold _mu)."""
+        if self._warned_full:
+            return
+        self._warned_full = True
+        logger.warning(
+            "node table full (num_nodes=%d): dropping edges touching "
+            "unmapped hosts%s", self.trainer.config.num_nodes,
+            "" if self.trainer.config.node_ttl > 0
+            else " (node_ttl=0: drops are permanent)",
+        )
+
     def _count_overflow(self, n_dropped: int) -> None:
         if n_dropped <= 0:
             return
-        if self.overflow_edges == 0:
-            logger.warning(
-                "node table full (num_nodes=%d): dropping edges touching "
-                "unmapped hosts%s", self.trainer.config.num_nodes,
-                "" if self.trainer.config.node_ttl > 0
-                else " (node_ttl=0: drops are permanent)",
-            )
-        self.overflow_edges += n_dropped
+        self._warn_table_full_once()
+        self._py_overflow += n_dropped
         from .metrics import ONLINE_OVERFLOW_EDGES
 
         ONLINE_OVERFLOW_EDGES.inc(n_dropped)
 
+    def close(self) -> None:
+        """Release the native engine (its buffers are invisible to the
+        Python gc; a parked wire feeder also keeps it alive).  Final
+        counters fold into the python-side fields so overflow_edges /
+        evicted_nodes stay readable after close.  Idempotent."""
+        if self._native is None:
+            return
+        st = self._native.stats()
+        self._py_overflow += int(st["overflow_edges"])
+        self._py_evicted = int(st["evicted_nodes"])
+        self._native_overflow_seen = 0
+        self._native.close()
+        self._native = None
+        self.trainer.block_source = None
+
     def node_features(self) -> np.ndarray:
         """Materialize the running per-node feature means — called by the
         trainer ONCE per snapshot build (lazy; never per chunk)."""
+        if self._native is not None:
+            return self._native.node_features()
         with self._mu:
             return self._feat_sum / np.maximum(self._feat_cnt[:, None], 1.0)
 
@@ -259,6 +455,23 @@ class WireIngestAdapter:
         if rows.size == 0:
             return
         now = self.clock()
+        if self._native is not None:
+            # The whole per-chunk pass (map, lifecycle, accumulate,
+            # ring append w/ backpressure) is ONE GIL-free call.
+            self._native.feed_download_rows(rows, now)
+            # Engine-side drops must stay observable: same warning +
+            # metric the python path emits, driven by the counter delta
+            # (under _mu — wire threads feed concurrently).
+            with self._mu:
+                ov = self._native.stats()["overflow_edges"]
+                dropped = ov - self._native_overflow_seen
+                if dropped > 0:
+                    self._native_overflow_seen = ov
+                    self._warn_table_full_once()
+                    from .metrics import ONLINE_OVERFLOW_EDGES
+
+                    ONLINE_OVERFLOW_EDGES.inc(dropped)
+            return
         with self._mu:
             # ONE mapping call over both endpoint columns: every host in
             # the chunk is touched before any eviction runs, so a live
@@ -294,9 +507,14 @@ class WireIngestAdapter:
             return
         now = self.clock()
         with self._mu:
-            both = self._map_ids(
-                np.concatenate([rows[:, 0], rows[:, 1]]), now
-            )
+            # Only the mapping call differs between engines; the engine
+            # has its own mutex, so holding _mu around it just keeps the
+            # counter updates below single-writer like the python path.
+            flat = np.concatenate([rows[:, 0], rows[:, 1]])
+            if self._native is not None:
+                both = self._native.map_buckets(flat, now)
+            else:
+                both = self._map_ids(flat, now)
             src, dst = both[: len(rows)], both[len(rows):]
             ok = (src >= 0) & (dst >= 0)
             self._count_overflow(int((~ok).sum()))
@@ -325,6 +543,12 @@ class OnlineGraphConfig:
     model: HopConfig = field(default_factory=HopConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     total_steps_hint: int = 100_000  # LR schedule horizon
+    # C++ wire-ingest fast path (native.cpp oi_* engine): mapping,
+    # lifecycle, feature accumulation and edge buffering run GIL-free,
+    # and the trainer takes dispatch blocks straight from the engine's
+    # ring.  Silently falls back to the (spec) Python adapter when the
+    # native library can't build.
+    native_ingest: bool = True
     # The config[4]×[5] mode: a (data, model) Mesh with
     # node_sharding="model" partitions the hop table, the embedding
     # table (+ its optimizer moments) AND the snapshot precompute by
@@ -366,6 +590,9 @@ class OnlineGraphTrainer:
 
         self._downloads: "queue.Queue" = queue.Queue(maxsize=config.queue_capacity)
         self._leftover: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+        # Set by a native-ingest adapter: dispatch blocks come straight
+        # from the C++ edge ring instead of the Python queue.
+        self.block_source = None
 
         self.dispatch = 0
         self.snapshot_idx = 0
@@ -496,6 +723,12 @@ class OnlineGraphTrainer:
     ) -> bool:
         """Offer download edges (flat arrays; any length).  Blocks when the
         queue is full — ingest backpressure, like the wire handler."""
+        if self.block_source is not None:
+            raise RuntimeError(
+                "native-ingest adapter attached: downloads must arrive "
+                "via the wire adapter, not feed_downloads (the queue "
+                "would be silently ignored)"
+            )
         try:
             self._downloads.put(
                 (
@@ -510,11 +743,19 @@ class OnlineGraphTrainer:
             return False
 
     def end_of_stream(self) -> None:
+        if (
+            self._adapter is not None
+            and getattr(self._adapter, "_native", None) is not None
+        ):
+            self._adapter._native.eof()
+            return
         self._downloads.put(None)
 
     def _next_dispatch_block(self, timeout: Optional[float]):
         """Accumulate queued edges into one [super_steps, batch] block
         (static shapes — one compiled program for the whole run)."""
+        if self.block_source is not None:
+            return self.block_source(timeout if timeout is not None else 3600.0)
         need = self.config.super_steps * self.config.batch_size
         parts: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
         have = 0
@@ -692,6 +933,8 @@ class OnlineGraphTrainer:
         host and must not inherit its predecessor's learned state.  Rows
         reset to the embedding init's mean (zero), deterministically.
         Returns the number of distinct rows reset."""
+        if self._adapter is not None:
+            self._adapter.poll_recycled()  # native evictions queue here
         with self._recycle_lock:
             if not self._pending_recycle:
                 return 0
@@ -829,30 +1072,8 @@ class OnlineGraphTrainer:
         if ad is not None:
             # Consistent pair: the mapping snapshot must not include an
             # eviction whose row reset is still queued (a restore would
-            # resurrect the previous owner's embedding/moments).  Retry
-            # until no recycle landed between apply and the snapshot.
-            while True:
-                self.apply_pending_recycles()
-                with ad._mu:
-                    with self._recycle_lock:
-                        if self._pending_recycle:
-                            continue  # evicted again before we locked
-                    ad_state = {
-                        "adapter_id_table": ad._id_table.copy(),
-                        "adapter_bucket_of": ad._bucket_of.copy(),
-                        "adapter_last_seen": ad._last_seen.copy(),
-                        # Trailing -1 sentinel: orbax rejects zero-size
-                        # arrays, and free ids are always >= 0.
-                        "adapter_free": np.concatenate(
-                            [np.asarray(ad._free, np.int64), [-1]]
-                        ),
-                        "adapter_next_id": int(ad._next_id),
-                        "adapter_feat_sum": ad._feat_sum.copy(),
-                        "adapter_feat_cnt": ad._feat_cnt.copy(),
-                        "adapter_overflow_edges": int(ad.overflow_edges),
-                        "adapter_evicted_nodes": int(ad.evicted_nodes),
-                    }
-                    break
+            # resurrect the previous owner's embedding/moments).
+            ad_state = ad.snapshot_for_checkpoint()
         elif self._adapter_restore is not None:
             ad_state = dict(self._adapter_restore)
         else:
@@ -908,6 +1129,13 @@ class OnlineGraphTrainer:
         """An adapter TrainerService(online_sink=...) feeds straight off
         the Train stream — the full wire → online-trainer path."""
         return WireIngestAdapter(self)
+
+    def close(self) -> None:
+        """Release stream-side resources (the wire adapter's native
+        engine, if any).  Training state is unaffected — checkpoint
+        first if it matters."""
+        if self._adapter is not None:
+            self._adapter.close()
 
     def resume(self) -> bool:
         """Restore params/opt/step/stream position AND rebuild the graph

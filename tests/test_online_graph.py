@@ -495,7 +495,7 @@ class TestNodeLifecycle:
 
     def test_churn_3x_capacity_recycles_without_permanent_drops(self):
         cluster = _mk_cluster()
-        tr = _mk_trainer(cluster, node_ttl=10.0)
+        tr = _mk_trainer(cluster, node_ttl=10.0, native_ingest=False)
         ad = tr.make_wire_adapter()
         t = {"now": 0.0}
         ad.clock = lambda: t["now"]
@@ -517,10 +517,15 @@ class TestNodeLifecycle:
         tr.feed_downloads(*_downloads(cluster, 5, 4 * 256 * 2))
         assert tr.run(max_dispatches=2, idle_timeout=0.1) == 2
 
-        # Full table + nothing expired: the drop is transient, counted.
+        # Full table + nothing expired: the drop is transient, counted
+        # on the adapter AND in the prometheus registry.
+        from dragonfly2_tpu.trainer.metrics import ONLINE_OVERFLOW_EDGES
+
+        metric_before = ONLINE_OVERFLOW_EDGES.value()
         extra = np.array([999_999], dtype=np.int64)
         ad.feed_download_rows(self._rows(extra, phase_buckets(0)[:1], rng))
         assert ad.overflow_edges == 1
+        assert ONLINE_OVERFLOW_EDGES.value() == metric_before + 1
 
         # Keep two phase-0 hosts warm via the TOPOLOGY stream at t=20...
         t["now"] = 20.0
@@ -570,7 +575,7 @@ class TestNodeLifecycle:
         """The default stays byte-deterministic: no eviction, overflow
         drops are permanent, the original mapping is never disturbed."""
         cluster = _mk_cluster()
-        tr = _mk_trainer(cluster)  # node_ttl defaults to 0
+        tr = _mk_trainer(cluster, native_ingest=False)  # node_ttl defaults to 0
         ad = tr.make_wire_adapter()
         t = {"now": 0.0}
         ad.clock = lambda: t["now"]
@@ -591,7 +596,7 @@ class TestNodeLifecycle:
         returns after capacity expired — transience cannot depend on a
         brand-new bucket arriving to kick the slow path."""
         cluster = _mk_cluster()
-        tr = _mk_trainer(cluster, node_ttl=10.0)
+        tr = _mk_trainer(cluster, node_ttl=10.0, native_ingest=False)
         ad = tr.make_wire_adapter()
         t = {"now": 0.0}
         ad.clock = lambda: t["now"]
@@ -611,7 +616,7 @@ class TestNodeLifecycle:
         host that triggers eviction is alive right now: it keeps its id,
         its edges train, and its embedding row survives."""
         cluster = _mk_cluster()
-        tr = _mk_trainer(cluster, node_ttl=10.0)
+        tr = _mk_trainer(cluster, node_ttl=10.0, native_ingest=False)
         ad = tr.make_wire_adapter()
         t = {"now": 0.0}
         ad.clock = lambda: t["now"]
@@ -635,7 +640,7 @@ class TestNodeLifecycle:
         they ride in the checkpoint so a restarted trainer keeps every
         host on the dense id whose embedding learned it."""
         cluster = _mk_cluster()
-        tr = _mk_trainer(cluster, tmp_path, node_ttl=10.0)
+        tr = _mk_trainer(cluster, tmp_path, node_ttl=10.0, native_ingest=False)
         ad = tr.make_wire_adapter()
         t = {"now": 1000.0}
         ad.clock = lambda: t["now"]
@@ -646,7 +651,7 @@ class TestNodeLifecycle:
         feat_cnt = ad._feat_cnt.copy()
         tr.checkpoint()
 
-        tr2 = _mk_trainer(cluster, tmp_path, node_ttl=10.0)
+        tr2 = _mk_trainer(cluster, tmp_path, node_ttl=10.0, native_ingest=False)
         assert tr2.resume()
         ad2 = tr2.make_wire_adapter()
         ad2.clock = lambda: t["now"] + 1.0

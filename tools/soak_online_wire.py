@@ -228,6 +228,8 @@ def main() -> int:
         if pr.is_alive():
             pr.terminate()
     server.stop()
+    final_overflow = adapter.overflow_edges
+    trainer.close()  # release the native ingest engine's buffers
 
     import shutil
 
@@ -239,7 +241,7 @@ def main() -> int:
         "rows_off_the_wire": fed,
         "dispatches": d,
         "snapshots": trainer.snapshot_idx,
-        "overflow_edges": adapter.overflow_edges,
+        "overflow_edges": final_overflow,
         "train_s": round(train_s, 1),
         "wall_s": round(time.time() - t_wall0, 1),
         "records_per_s_sustained": round(trainer.records_seen / train_s, 1),
