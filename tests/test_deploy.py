@@ -109,18 +109,8 @@ class TestK8sManifests:
             assert "config" in mounts
             assert "config" in vols
 
-        # Daemons steer over BOTH replicas' stable per-pod DNS names.
-        daemon_cmd = " ".join(
-            by_kind["DaemonSet"]["daemon"]["spec"]["template"]["spec"][
-                "containers"
-            ][0]["command"]
-        )
-        assert "scheduler-0.scheduler" in daemon_cmd
-        assert "scheduler-1.scheduler" in daemon_cmd
-
-        # Service ports target the ports the configs bind.
-        assert by_kind["Service"]["manager"]["spec"]["ports"][0]["port"] == 65003
-        assert by_kind["Service"]["scheduler"]["spec"]["ports"][0]["port"] == 8002
+        # (Steering addresses, ports and the compose diff are covered
+        # programmatically in TestK8sValidation below.)
 
 
 class TestClusterE2E:
@@ -164,3 +154,173 @@ class TestClusterE2E:
         )
         assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
         assert "ALL STAGES PASSED" in r.stdout
+
+
+def _load_validator():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "k8s_validate", os.path.join(DEPLOY, "k8s_validate.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestK8sValidation:
+    """Offline structural validation + programmatic compose diff
+    (VERDICT r4 #4): a schema typo or a mis-nested field must FAIL CI,
+    and the manifest↔compose equivalence is computed, not substring'd."""
+
+    def _docs(self):
+        with open(os.path.join(DEPLOY, "k8s", "dragonfly.yaml")) as f:
+            return [d for d in yaml.safe_load_all(f) if d is not None]
+
+    def test_manifests_pass_structural_validation(self):
+        v = _load_validator()
+        assert v.validate_documents(self._docs()) == []
+
+    def test_deliberately_broken_manifests_fail(self):
+        """Every rot class the old string asserts let through."""
+        import copy
+
+        v = _load_validator()
+        base = self._docs()
+
+        def deployment(docs, name):
+            return next(
+                d for d in docs
+                if d["kind"] == "Deployment" and d["metadata"]["name"] == name
+            )
+
+        def service(docs, name):
+            return next(
+                d for d in docs
+                if d["kind"] == "Service" and d["metadata"]["name"] == name
+            )
+
+        def broken(mutate):
+            docs = copy.deepcopy(base)
+            mutate(docs)
+            return v.validate_documents(docs)
+
+        # 1. Removed beta API group still parses as YAML — must fail.
+        errs = broken(lambda d: deployment(d, "manager").__setitem__(
+            "apiVersion", "apps/v1beta1"))
+        assert any("apiVersion" in e for e in errs), errs
+
+        # 2. Field nested one level too high (containers under spec).
+        def misnest(docs):
+            dep = deployment(docs, "manager")
+            dep["spec"]["containers"] = dep["spec"]["template"]["spec"].pop(
+                "containers"
+            )
+        errs = broken(misnest)
+        assert any("unknown field 'containers'" in e for e in errs), errs
+        assert any("missing required field 'containers'" in e for e in errs)
+
+        # 3. Port out of range / wrong type.
+        errs = broken(lambda d: deployment(d, "trainer")["spec"]["template"][
+            "spec"]["containers"][0]["ports"][0].__setitem__(
+                "containerPort", 99_090))
+        assert any("port" in e for e in errs), errs
+        errs = broken(lambda d: service(d, "manager")["spec"]["ports"][0]
+                      .__setitem__("port", "65003"))
+        assert any("port" in e for e in errs), errs
+
+        # 4. Selector that doesn't match the pod template.
+        errs = broken(lambda d: deployment(d, "manager")["spec"]["selector"][
+            "matchLabels"].__setitem__("component", "managr"))
+        assert any("select" in e for e in errs), errs
+
+        # 5. volumeMount referencing a volume the pod doesn't define.
+        errs = broken(lambda d: deployment(d, "manager")["spec"]["template"][
+            "spec"]["containers"][0]["volumeMounts"][0].__setitem__(
+                "name", "cfg"))
+        assert any("mounts volume" in e for e in errs), errs
+
+        # 6. Typo'd field name at a checked level.
+        def typo(docs):
+            dep = deployment(docs, "seed")
+            dep["spec"]["replica"] = dep["spec"].pop("replicas")
+        errs = broken(typo)
+        assert any("unknown field 'replica'" in e for e in errs), errs
+
+        # 7. DaemonSet with replicas (invalid for the kind).
+        def ds_replicas(docs):
+            ds = next(d for d in docs if d["kind"] == "DaemonSet")
+            ds["spec"]["replicas"] = 3
+        errs = broken(ds_replicas)
+        assert any("DaemonSet has no replicas" in e for e in errs), errs
+
+        # 8. Bad storage quantity in the StatefulSet claim.
+        def bad_qty(docs):
+            ss = next(d for d in docs if d["kind"] == "StatefulSet")
+            ss["spec"]["volumeClaimTemplates"][0]["spec"]["resources"][
+                "requests"]["storage"] = "one-gig"
+        errs = broken(bad_qty)
+        assert any("quantity" in e for e in errs), errs
+
+        # 9a. Selector mistyped as a string (was an unhandled crash).
+        errs = broken(lambda d: service(d, "manager")["spec"].__setitem__(
+            "selector", "manager"))
+        assert any("string→string map" in e for e in errs), errs
+
+        # 9. Service whose selector routes to nothing.
+        errs = broken(lambda d: service(d, "manager")["spec"]["selector"]
+                      .__setitem__("component", "nothing"))
+        assert any("selects no workload" in e for e in errs), errs
+
+    def test_topology_diff_against_compose(self):
+        """The k8s manifests and docker-compose describe the SAME
+        cluster: same entry modules, same config files, and steering
+        addresses derived from the actual replica count."""
+        v = _load_validator()
+        k8s = v.k8s_topology(self._docs())
+        with open(os.path.join(DEPLOY, "docker-compose.yaml")) as f:
+            comp = v.compose_topology(yaml.safe_load(f))
+
+        # Component mapping (compose daemon-a/daemon-b ⇒ the DaemonSet).
+        pairs = {
+            "manager": "manager", "scheduler": "scheduler",
+            "trainer": "trainer", "seed": "seed", "daemon-a": "daemon",
+            "daemon-b": "daemon",
+        }
+        for c_name, k_name in pairs.items():
+            assert comp[c_name]["module"] == k8s[k_name]["module"], (
+                c_name, comp[c_name], k8s[k_name])
+            assert comp[c_name]["config"] == k8s[k_name]["config"], c_name
+        # Nothing unaccounted for on either side (e2e is compose-only —
+        # it is the test job, not a deployed component).
+        assert set(comp) - set(pairs) == {"e2e"}
+        assert set(k8s) == set(pairs.values())
+
+        # One shared image across every workload.
+        assert {w["image"] for w in k8s.values()} == {"dragonfly2-tpu"}
+
+        # The deliberate delta: TWO scheduler replicas in k8s — and the
+        # daemons' steering list must name each per-pod DNS address.
+        replicas = k8s["scheduler"]["replicas"]
+        assert replicas == 2
+        docs = self._docs()
+        for wl in ("seed", "daemon"):
+            doc = next(d for d in docs if d["metadata"]["name"] == wl
+                       and d["kind"] in ("Deployment", "DaemonSet"))
+            cmd = doc["spec"]["template"]["spec"]["containers"][0]["command"]
+            addrs = set(cmd[cmd.index("--scheduler") + 1].split(","))
+            assert addrs == {
+                f"http://scheduler-{i}.scheduler:8002"
+                for i in range(replicas)
+            }, (wl, addrs)
+
+        # Container ports cover the ports the mounted configs bind.
+        cfg = {}
+        for name in ("manager", "scheduler", "trainer", "daemon", "seed"):
+            with open(os.path.join(DEPLOY, "config", f"{name}.yaml")) as f:
+                cfg[name] = yaml.safe_load(f)
+        for comp_name in ("manager", "scheduler", "trainer"):
+            bind = cfg[comp_name]["server"]["port"]
+            assert bind in k8s[comp_name]["ports"], comp_name
+        assert cfg["daemon"]["server"]["port"] in k8s["daemon"]["ports"]
+        assert cfg["daemon"]["control_port"] in k8s["daemon"]["ports"]
+        assert cfg["seed"]["server"]["port"] in k8s["seed"]["ports"]
