@@ -182,6 +182,22 @@ class JSONLExporter(SpanExporter):
                 f.write(json.dumps(record) + "\n")
 
 
+def otlp_trace_schema() -> Dict[str, Any]:
+    """The vendored JSON Schema for ``ExportTraceServiceRequest``
+    (utils/otlp_trace_schema.json — a transcription of
+    opentelemetry-proto's trace/common/resource v1 protos under the
+    proto3 JSON mapping, strict additionalProperties).  Every request
+    this module emits validates against it (tests/test_utils.py); no
+    OTLP-ingesting binary exists in the sandbox, so the schema stands
+    in for the collector the reference proved its wiring with
+    (cmd/dependency/dependency.go:263-297 ran Jaeger)."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "otlp_trace_schema.json")
+    with open(path) as f:
+        return json.load(f)
+
+
 def _otlp_value(v: Any) -> Dict[str, Any]:
     """Python attribute → OTLP AnyValue (proto3-JSON encoding rules:
     int64 rides as a string, doubles as numbers)."""

@@ -369,6 +369,50 @@ class TestOTLPExport:
         assert piece_attrs["cost_s"] == {"doubleValue": 0.5}
         assert all(s["status"]["code"] == 1 for s in spans)
 
+    def test_otlp_requests_validate_against_vendored_schema(self, tmp_path):
+        """Every emitted ExportTraceServiceRequest validates against the
+        vendored opentelemetry-proto JSON Schema (VERDICT r4 #9) — and
+        the schema has TEETH: each known rot class fails it."""
+        import copy
+        import json
+
+        import jsonschema
+
+        from dragonfly2_tpu.utils.tracing import (
+            OTLPJSONExporter,
+            otlp_trace_schema,
+        )
+
+        validator = jsonschema.Draft202012Validator(otlp_trace_schema())
+
+        path = str(tmp_path / "spans.otlp.json")
+        exp = OTLPJSONExporter(path, service="test-svc", batch_size=2)
+        self._traced(exp)
+        exp.flush()
+        reqs = [json.loads(l) for l in open(path)]
+        assert reqs
+        for req in reqs:
+            validator.validate(req)  # raises on any violation
+
+        # Teeth: mutate one valid request per rot class — all must fail.
+        def fails(mutate):
+            bad = copy.deepcopy(reqs[0])
+            mutate(bad)
+            return list(validator.iter_errors(bad))
+
+        span = lambda r: r["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+        assert fails(lambda r: span(r).__setitem__("traceid",
+                     span(r).pop("traceId")))      # misspelled field
+        assert fails(lambda r: span(r).__setitem__("traceId", "xyz"))
+        assert fails(lambda r: span(r).__setitem__(
+            "startTimeUnixNano", 123456))          # int64 must be a string
+        assert fails(lambda r: span(r).__setitem__("status", {"code": 3}))
+        assert fails(lambda r: span(r)["attributes"][0]["value"].update(
+            {"stringValue": "x", "intValue": "1"}))  # AnyValue is a oneof
+        assert fails(lambda r: span(r).__setitem__("kind", 9))
+        assert fails(lambda r: r["resourceSpans"][0].__setitem__(
+            "resource", {"attrs": []}))            # misplaced resource field
+
     def test_otlp_http_endpoint_and_error_status(self, tmp_path):
         import json
         import threading
