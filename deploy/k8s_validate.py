@@ -167,9 +167,18 @@ def _check_pod_spec(ctx: _Ctx, path: str, spec: Any,
 
 def _check_workload(ctx: _Ctx, path: str, doc: Dict[str, Any]) -> None:
     kind = doc["kind"]
-    allowed = {"replicas", "selector", "template", "serviceName",
-               "volumeClaimTemplates", "updateStrategy", "strategy",
-               "minReadySeconds", "revisionHistoryLimit"}
+    # Per-kind field sets: Deployments roll with `strategy`, the other
+    # two with `updateStrategy`; serviceName/volumeClaimTemplates are
+    # StatefulSet-only — a real apiserver rejects the cross-kind mixups.
+    allowed = {"replicas", "selector", "template", "minReadySeconds",
+               "revisionHistoryLimit"}
+    if kind == "Deployment":
+        allowed |= {"strategy", "paused", "progressDeadlineSeconds"}
+    else:
+        allowed.add("updateStrategy")
+    if kind == "StatefulSet":
+        allowed |= {"serviceName", "volumeClaimTemplates",
+                    "podManagementPolicy"}
     required = {"selector", "template"}
     if kind == "StatefulSet":
         required.add("serviceName")
@@ -207,23 +216,32 @@ def _check_workload(ctx: _Ctx, path: str, doc: Dict[str, Any]) -> None:
     pvc_names = set()
     for i, vct in enumerate(spec.get("volumeClaimTemplates", [])):
         vp = f"{path}.spec.volumeClaimTemplates[{i}]"
-        if kind != "StatefulSet":
-            ctx.err(vp, f"{kind} has no volumeClaimTemplates")
-            continue
         if not _check_keys(ctx, vp, vct, {"metadata", "spec"},
                            {"metadata", "spec"}):
+            continue
+        if not isinstance(vct["metadata"], dict):
+            ctx.err(vp + ".metadata", "expected mapping")
             continue
         pvc_names.add(vct["metadata"].get("name"))
         vspec = vct["spec"]
         if _check_keys(ctx, vp + ".spec", vspec,
                        {"accessModes", "resources", "storageClassName"},
                        {"accessModes", "resources"}):
-            for m in vspec["accessModes"]:
+            modes = vspec["accessModes"]
+            if not isinstance(modes, list):
+                ctx.err(vp + ".spec.accessModes", "expected list")
+                modes = []
+            for m in modes:
                 if m not in ("ReadWriteOnce", "ReadOnlyMany",
                              "ReadWriteMany", "ReadWriteOncePod"):
                     ctx.err(vp + ".spec.accessModes", f"bad mode {m!r}")
+            res = vspec["resources"]
             storage = (
-                vspec["resources"].get("requests", {}).get("storage")
+                res.get("requests", {}).get("storage")
+                if isinstance(res, dict) and isinstance(
+                    res.get("requests", {}), dict
+                )
+                else None
             )
             if not isinstance(storage, str) or not _QUANTITY.match(storage):
                 ctx.err(vp + ".spec.resources.requests.storage",
@@ -269,8 +287,10 @@ def validate_documents(docs: List[Dict[str, Any]]) -> List[str]:
         kind = doc.get("kind")
         name = (doc.get("metadata") or {}).get("name", "?")
         path = f"{kind}/{name}"
-        if not _check_keys(ctx, path, doc,
-                           {"apiVersion", "kind", "metadata", "spec", "data"},
+        top = {"apiVersion", "kind", "metadata"}
+        top |= {"data", "binaryData", "immutable"} if kind == "ConfigMap" \
+            else {"spec"}
+        if not _check_keys(ctx, path, doc, top,
                            {"apiVersion", "kind", "metadata"}):
             continue
         if kind not in KIND_API:
@@ -290,6 +310,15 @@ def validate_documents(docs: List[Dict[str, Any]]) -> List[str]:
             _check_workload(ctx, path, doc)
         elif kind == "Service":
             _check_service(ctx, path, doc)
+        elif kind == "ConfigMap":
+            data = doc.get("data") or {}  # bare `data:` parses to None
+            if not isinstance(data, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in data.items()
+            ):
+                ctx.err(path + ".data",
+                        "must be a string→string map (a mis-indented "
+                        "value becomes a nested mapping)")
 
     # Cross-document: every Service selector must select at least one
     # workload pod template (a dangling selector routes nothing).

@@ -261,6 +261,21 @@ class TestK8sValidation:
         errs = broken(bad_qty)
         assert any("quantity" in e for e in errs), errs
 
+        # 10. Cross-kind field mixup: Deployment with updateStrategy.
+        errs = broken(lambda d: deployment(d, "manager")["spec"]
+                      .__setitem__("updateStrategy", {"type": "Recreate"}))
+        assert any("unknown field 'updateStrategy'" in e for e in errs), errs
+
+        # 11. ConfigMap whose mis-indented value became a nested map.
+        def bad_cm(docs):
+            docs.append({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "cm"},
+                "data": {"daemon.yaml": {"server": {"port": 65000}}},
+            })
+        errs = broken(bad_cm)
+        assert any("string→string map" in e for e in errs), errs
+
         # 9a. Selector mistyped as a string (was an unhandled crash).
         errs = broken(lambda d: service(d, "manager")["spec"].__setitem__(
             "selector", "manager"))
@@ -324,3 +339,15 @@ class TestK8sValidation:
         assert cfg["daemon"]["server"]["port"] in k8s["daemon"]["ports"]
         assert cfg["daemon"]["control_port"] in k8s["daemon"]["ports"]
         assert cfg["seed"]["server"]["port"] in k8s["seed"]["ports"]
+
+        # Service ports route to the SAME bound ports: each Service's
+        # port and targetPort must be the selected component's config
+        # bind (clients dial the Service on the config's port).
+        for doc in docs:
+            if doc["kind"] != "Service":
+                continue
+            comp_name = doc["metadata"]["name"]
+            bind = cfg[comp_name]["server"]["port"]
+            for port in doc["spec"]["ports"]:
+                assert port["port"] == bind, (comp_name, port)
+                assert port.get("targetPort", port["port"]) == bind

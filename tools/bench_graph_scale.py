@@ -1,0 +1,295 @@
+"""Mesh-mode online graph trainer at config[5] GRAPH scale (VERDICT r4 #1).
+
+The r4 record topped out at 100k nodes × K=16 (~1.6M table edges); the
+"1B edges fits a v5e-16" claim was extrapolated.  This bench drives the
+REAL pipeline — wire-fed topology rows → WireIngestAdapter (native
+engine) → bounded window → ``build_neighbor_table`` →
+``build_halo_plan`` → node-sharded ``precompute_hop_features_sharded``
+→ mesh-mode training dispatches — at ≥2^20 nodes × K=32 (≥33.5M table
+edges, ~20× the prior record) on an n-device virtual mesh, and measures
+the numbers the extrapolation needs:
+
+- per-device XLA memory (args + temps) of the sharded precompute AND the
+  train dispatch, vs the replicated program;
+- halo size H at each locality (the deployment shape is rack-biased
+  probes, SURVEY §5.7; locality 0 is the adversarial bound);
+- wall time for the full snapshot refresh (table + plan + precompute);
+- sustained training rec/s (CPU-mesh wall times are single-core
+  time-multiplexed — the SHAPE of the scaling is the datum, per-chip
+  rates come from the TPU benches).
+
+The max-graph-per-chip model (validated against the measured points, see
+BENCHMARKS.md): per-chip node-table bytes ≈
+    (S + n·H) · (F + D) · 4   [hop feats + features through the halo]
+  +  S · K · 12               [table rows: idx4 + mask4 + edge feat4]
+  +  S · E · 12               [embedding + 2 Adam moments]
+with S = N/n.  Solving 1B edges (N=2^25, K=32) for per-chip HBM gives
+the v5e-16 claim as a curve instead of a hope.
+
+Usage (single config):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/bench_graph_scale.py --nodes 1048576 --k 32 \
+      --model-axis 8 --locality 0.9
+Sweep (spawns one subprocess per config):
+  python tools/bench_graph_scale.py --sweep
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def local_graph(n, shard, rng, locality, k_edges):
+    """Edges where ~locality of each node's probers live on its shard."""
+    dst = rng.integers(0, n, k_edges)
+    local = rng.random(k_edges) < locality
+    shard_of = dst // shard
+    src_local = shard_of * shard + rng.integers(0, shard, k_edges)
+    src_any = rng.integers(0, n, k_edges)
+    src = np.where(local, src_local, src_any)
+    keep = src != dst
+    return src[keep].astype(np.int64), dst[keep].astype(np.int64)
+
+
+def run_config(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.models.hop import HopConfig, precompute_hop_features
+    from dragonfly2_tpu.parallel.graph_sharding import (
+        build_halo_plan,
+        precompute_hop_features_sharded,
+    )
+    from dragonfly2_tpu.parallel.mesh import MODEL_AXIS, MeshSpec, create_mesh
+    from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
+    from dragonfly2_tpu.trainer.online_graph import (
+        OnlineGraphConfig,
+        OnlineGraphTrainer,
+    )
+    from dragonfly2_tpu.trainer.train import TrainConfig
+
+    from dragonfly2_tpu.records.features import NUM_HASH_BUCKETS
+
+    n_dev = len(jax.devices())
+    data_axis = max(n_dev // args.model_axis, 1)
+    mesh = create_mesh(MeshSpec(data=data_axis, model=args.model_axis))
+    N, K = args.nodes, args.k
+    # The wire keys hosts by hash bucket in float32 rows: the bucket
+    # space is 2^20 (exact in float32).  Beyond it, ids would silently
+    # round (2^24+) or alias — the >2^20 extrapolation in BENCHMARKS.md
+    # is the measured MEMORY model, not a wire-format claim.
+    if N > NUM_HASH_BUCKETS:
+        raise SystemExit(
+            f"--nodes {N} exceeds the wire bucket space "
+            f"({NUM_HASH_BUCKETS}); the composed wire path cannot key "
+            f"that many distinct hosts per trainer"
+        )
+    S = N // args.model_axis
+    rng = np.random.default_rng(0)
+
+    cfg = OnlineGraphConfig(
+        num_nodes=N,
+        max_neighbors=K,
+        batch_size=args.batch,
+        super_steps=args.super_steps,
+        topo_window=N * K + N,  # full edge stream + the registration ring
+        queue_capacity=2,
+        mesh=mesh,
+        node_sharding="model",
+        model=HopConfig(hidden=args.hidden, node_embed_dim=32),
+        train=TrainConfig(warmup_steps=10),
+        total_steps_hint=10_000,
+    )
+    trainer = OnlineGraphTrainer(
+        cfg,
+        node_feats=np.zeros((N, 12), np.float32),
+        topo_src=np.zeros(0, np.int32),
+        topo_dst=np.zeros(0, np.int32),
+        topo_rtt=np.zeros(0, np.float32),
+    )
+    adapter = trainer.make_wire_adapter()
+    native = adapter._native is not None
+
+    # Register buckets in ascending order so bucket→dense-id is identity
+    # and the locality structure survives the wire mapping.  The N ring
+    # edges are noise amid N·K real ones (and counted in the window).
+    ring = np.zeros((N, 3), np.float32)
+    ring[:, 0] = np.arange(N)
+    ring[:, 1] = np.roll(np.arange(N), 1)
+    ring[:, 2] = 0.01
+    t0 = time.perf_counter()
+    for i in range(0, N, 4_000_000):
+        adapter.feed_topology_rows(ring[i : i + 4_000_000])
+    # The real probe stream, wire-shaped chunks.
+    src, dst = local_graph(N, S, rng, args.locality, N * K)
+    edges = len(src)
+    chunk = 4_000_000
+    for i in range(0, edges, chunk):
+        rows = np.zeros((min(chunk, edges - i), 3), np.float32)
+        rows[:, 0] = src[i : i + chunk]
+        rows[:, 1] = dst[i : i + chunk]
+        rows[:, 2] = rng.random(len(rows)).astype(np.float32) * 0.05
+        adapter.feed_topology_rows(rows)
+    t_feed = time.perf_counter() - t0
+    assert adapter.overflow_edges == 0, adapter.overflow_edges
+
+    # Snapshot refresh — the full wire-fed pipeline, timed end to end.
+    t0 = time.perf_counter()
+    assert trainer.refresh_snapshot() is not None
+    t_refresh = time.perf_counter() - t0
+
+    # Memory analysis of the real programs at this shape.
+    def mem(jitted, *a):
+        try:
+            m = jitted.lower(*a).compile().memory_analysis()
+            return int(m.argument_size_in_bytes + m.temp_size_in_bytes)
+        except Exception:  # noqa: BLE001
+            return -1
+
+    table, nf = trainer.table, jnp.asarray(trainer.node_feats)
+    t0 = time.perf_counter()
+    plan = build_halo_plan(table, mesh, axis=MODEL_AXIS)
+    t_plan = time.perf_counter() - t0
+    sh_fn = jax.jit(
+        lambda x, t: precompute_hop_features_sharded(
+            mesh, x, t, plan, hops=cfg.model.hops, axis=MODEL_AXIS
+        )
+    )
+    mem_sh = mem(sh_fn, nf, table)
+    mem_rep = -1
+    if args.replicated_baseline:
+        rep_fn = jax.jit(
+            lambda x, t: precompute_hop_features(x, t, hops=cfg.model.hops)
+        )
+        mem_rep = mem(rep_fn, nf, table)
+    # Train-dispatch program (state + hop tables + block).
+    blk = (args.super_steps, args.batch)
+    mem_dispatch = mem(
+        trainer._dispatch_fn, trainer.state, trainer.hop_feats, trainer.table,
+        jnp.zeros(blk, jnp.int32), jnp.zeros(blk, jnp.int32),
+        jnp.zeros(blk, jnp.float32),
+    )
+
+    # A few training dispatches through the wire adapter (download rows);
+    # the feeder runs concurrently — the edge ring applies backpressure.
+    import threading
+
+    need = args.dispatches * args.super_steps * args.batch
+    w = len(DOWNLOAD_COLUMNS)
+
+    def feeder():
+        frng = np.random.default_rng(1)
+        fed = 0
+        while fed < need:
+            m = min(1_000_000, need - fed)
+            rows = frng.random((m, w)).astype(np.float32)
+            rows[:, 0] = frng.integers(0, N, m)
+            rows[:, 1] = (rows[:, 0] + 1 + frng.integers(0, N - 1, m)) % N
+            adapter.feed_download_rows(rows)
+            fed += m
+        trainer.end_of_stream()
+
+    t0 = time.perf_counter()
+    th = threading.Thread(target=feeder, daemon=True)
+    th.start()
+    ran = trainer.run(max_dispatches=args.dispatches, idle_timeout=60.0)
+    t_train = time.perf_counter() - t0
+    th.join(timeout=60)
+    trainer.close()
+
+    return {
+        "nodes": N,
+        "k": K,
+        "table_edges": int(np.asarray(table.mask).sum()),
+        "devices": n_dev,
+        "mesh": {"data": data_axis, "model": args.model_axis},
+        "locality": args.locality,
+        "native_ingest": native,
+        "halo": int(plan.halo),
+        "shard_rows": S,
+        "rows_per_dev_sharded": int(S + args.model_axis * plan.halo),
+        "t_wire_feed_s": round(t_feed, 1),
+        "t_refresh_total_s": round(t_refresh, 1),
+        "t_plan_s": round(t_plan, 1),
+        "mem_sharded_per_dev_bytes": mem_sh,
+        "mem_replicated_per_dev_bytes": mem_rep,
+        "mem_dispatch_per_dev_bytes": mem_dispatch,
+        "dispatches": ran,
+        "records_trained": trainer.records_seen,
+        "rec_per_s_cpu_mesh": round(trainer.records_seen / max(t_train, 1e-9), 1),
+    }
+
+
+SWEEP = [
+    # (devices, model_axis, locality, nodes, k, replicated_baseline)
+    (8, 8, 0.9, 1 << 20, 32, True),   # headline: 20x the r4 graph record
+    (8, 8, 0.0, 1 << 20, 32, False),  # adversarial locality bound
+    (4, 4, 0.9, 1 << 20, 32, False),  # device-count scaling...
+    (16, 16, 0.9, 1 << 20, 32, False),
+    (8, 8, 0.9, 1 << 17, 32, True),   # continuity point near the r4 shape
+]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1 << 20)
+    ap.add_argument("--k", type=int, default=32)
+    ap.add_argument("--model-axis", type=int, default=8)
+    ap.add_argument("--locality", type=float, default=0.9)
+    ap.add_argument("--batch", type=int, default=65_536)
+    ap.add_argument("--super", dest="super_steps", type=int, default=4)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--dispatches", type=int, default=2)
+    ap.add_argument("--replicated-baseline", action="store_true")
+    ap.add_argument("--sweep", action="store_true")
+    args = ap.parse_args()
+
+    if not args.sweep:
+        out = run_config(args)
+        print(json.dumps(out), flush=True)
+        return 0
+
+    results = []
+    for devs, ma, loc, nodes, k, rep in SWEEP:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devs}"
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            "--nodes", str(nodes), "--k", str(k),
+            "--model-axis", str(ma), "--locality", str(loc),
+        ] + (["--replicated-baseline"] if rep else [])
+        print(f"# sweep: devices={devs} model={ma} locality={loc} "
+              f"nodes={nodes}", flush=True)
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                cmd, env=env, capture_output=True, text=True, timeout=3600
+            )
+        except subprocess.TimeoutExpired:
+            print(f"# TIMEOUT after 3600s: devices={devs} model={ma}",
+                  flush=True)
+            continue
+        line = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        if proc.returncode != 0 or not line:
+            print(f"# FAILED rc={proc.returncode}: {proc.stderr[-800:]}",
+                  flush=True)
+            continue
+        r = json.loads(line[-1])
+        r["wall_s"] = round(time.time() - t0, 1)
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    print(json.dumps({"bench": "graph_scale_sweep", "results": results}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
