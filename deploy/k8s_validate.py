@@ -170,8 +170,10 @@ def _check_workload(ctx: _Ctx, path: str, doc: Dict[str, Any]) -> None:
     # Per-kind field sets: Deployments roll with `strategy`, the other
     # two with `updateStrategy`; serviceName/volumeClaimTemplates are
     # StatefulSet-only — a real apiserver rejects the cross-kind mixups.
-    allowed = {"replicas", "selector", "template", "minReadySeconds",
+    allowed = {"selector", "template", "minReadySeconds",
                "revisionHistoryLimit"}
+    if kind != "DaemonSet":
+        allowed.add("replicas")  # a real apiserver rejects it on DaemonSet
     if kind == "Deployment":
         allowed |= {"strategy", "paused", "progressDeadlineSeconds"}
     else:

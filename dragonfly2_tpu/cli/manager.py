@@ -21,16 +21,31 @@ from .common import base_parser, init_debug, init_logging, init_tracing
 def build(cfg: ManagerConfig):
     import os
 
+    # ONE durable state backend for every manager surface (manager/
+    # state.py seam): registry rows, CRUD rows, the job broker, the
+    # shared topology cache, users — a restart reloads all of it from
+    # one place, and the HA story swaps one backend, not five files.
+    from ..manager.state import make_state_backend, migrate_legacy_sqlite
+
+    backend = make_state_backend(
+        os.path.join(cfg.registry.blob_dir, "manager-state.db")
+    )
+    # Pre-seam deployments kept per-store files; import them once so an
+    # upgrade never silently drops models/CRUD rows.
+    migrated = migrate_legacy_sqlite(
+        backend,
+        models_db=os.path.join(cfg.registry.blob_dir, "manager.db"),
+        crud_db=os.path.join(cfg.registry.blob_dir, "crud.db"),
+    )
+    if migrated:
+        print(f"manager: migrated legacy state {migrated}", flush=True)
     registry = ModelRegistry(
-        BlobStore(cfg.registry.blob_dir),
-        db_path=os.path.join(cfg.registry.blob_dir, "manager.db"),
+        BlobStore(cfg.registry.blob_dir), backend=backend,
     )
     clusters = ClusterManager(keepalive_ttl=cfg.keepalive_ttl_s)
-    # CRUD rows (applications + scheduler-cluster configs) share the
-    # registry's durable directory — cluster overrides survive restarts.
     from ..manager.crud import CrudStore
 
-    crud = CrudStore(os.path.join(cfg.registry.blob_dir, "crud.db"))
+    crud = CrudStore(backend=backend)
     crud.ensure_default_cluster()
     objectstorage = None
     if cfg.objectstorage:
@@ -45,9 +60,10 @@ def build(cfg: ManagerConfig):
         "registry": registry,
         "clusters": clusters,
         "searcher": Searcher(),
-        "jobs": JobQueue(),
+        "jobs": JobQueue(backend=backend),
         "crud": crud,
         "objectstorage": objectstorage,
+        "state_backend": backend,
     }
 
 
@@ -81,7 +97,17 @@ def run(argv=None) -> int:
         from ..security.tokens import TokenIssuer, TokenVerifier
 
         secret = cfg.token_secret.encode()
-        users = UserStore(cfg.users_db or None)
+        # users_db (if set) keeps its own file for operators who isolate
+        # credentials; default shares the one state backend.  Legacy
+        # users/pats tables in that file import once.
+        if cfg.users_db:
+            from ..manager.state import SQLiteBackend, migrate_legacy_sqlite
+
+            user_backend = SQLiteBackend(cfg.users_db)
+            migrate_legacy_sqlite(user_backend, users_db=cfg.users_db)
+            users = UserStore(backend=user_backend)
+        else:
+            users = UserStore(backend=parts["state_backend"])
         if cfg.root_password:
             users.ensure_root(cfg.root_password)
         auth = {
@@ -113,6 +139,8 @@ def run(argv=None) -> int:
         objectstorage=parts["objectstorage"],
         rate_limit=bucket,
         ca=ca,
+        state_backend=parts["state_backend"],
+        jobs_min_requeue_s=cfg.jobs_min_requeue_s,
         **auth,
     )
     rest.serve()

@@ -225,6 +225,12 @@ class ManagerConfig:
     # with a persistent CA under this path; peers self-provision mTLS
     # identities at boot (security/ca.py request_from_manager).
     ca_dir: str = ""
+    # Floor for the job broker's wire-supplied visibility window: a
+    # worker's poll may request faster redelivery of popped-but-
+    # unreported jobs, but never below this — an impatient worker must
+    # not duplicate every in-flight job on its queue.  Operators shrink
+    # it for recovery drills/tests.
+    jobs_min_requeue_s: float = 30.0
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     log: LogConfig = field(default_factory=LogConfig)
 

@@ -70,12 +70,67 @@ class JobQueue:
     queued job is evicted (FAILURE "evicted") — a queue whose consumer
     never attaches must not grow without bound."""
 
-    def __init__(self, max_backlog: int = 10_000) -> None:
+    def __init__(self, max_backlog: int = 10_000, *, backend=None) -> None:
         self._mu = threading.Lock()
         self._queues: Dict[str, "queue.Queue[Job]"] = {}
         self.jobs: Dict[str, Job] = {}
         self.groups: Dict[str, GroupJob] = {}
         self.max_backlog = max_backlog
+        # Durable broker (VERDICT r4 #5): with a manager state backend
+        # attached, jobs/groups write through and a restarted manager
+        # re-enqueues its backlog — a preheat in flight when the manager
+        # dies completes after restart instead of vanishing (reference:
+        # machinery's Redis-backed queues).  Persistence covers the WIRE
+        # worker paths (enqueue/poll/set_result/prune); in-process
+        # Workers mutate Job objects directly and are used with
+        # ephemeral queues (scheduler-side), not the manager broker.
+        self._table = backend.table("jobs") if backend is not None else None
+        self._gtable = (
+            backend.table("job_groups") if backend is not None else None
+        )
+        if self._table is not None:
+            self._reload()
+
+    def _persist_job(self, j: Job) -> None:
+        if self._table is None:
+            return
+        try:
+            self._table.put(j.id, {
+                "id": j.id, "type": j.type, "queue": j.queue, "args": j.args,
+                "group_id": j.group_id, "state": j.state.value,
+                "result": j.result, "error": j.error,
+                "created_at": j.created_at, "expires_at": j.expires_at,
+                "started_at": j.started_at,
+            })
+        except (TypeError, ValueError):
+            # A non-JSON result must not kill the completion path; the
+            # row keeps its last durable state.
+            pass
+
+    def _persist_group(self, g: GroupJob) -> None:
+        if self._gtable is not None:
+            self._gtable.put(g.id, {"id": g.id, "job_ids": list(g.job_ids)})
+
+    def _reload(self) -> None:
+        """Restart recovery: reload every row; PENDING jobs re-enqueue in
+        creation order; STARTED jobs keep their started_at and re-deliver
+        through the stale-visibility requeue (at-least-once, same as a
+        worker that died mid-job)."""
+        for d in self._table.load_all().values():
+            j = Job(
+                id=d["id"], type=d["type"], queue=d["queue"],
+                args=d.get("args") or {}, group_id=d.get("group_id"),
+                state=JobState(d["state"]), result=d.get("result"),
+                error=d.get("error", ""), created_at=d["created_at"],
+                expires_at=d.get("expires_at", 0.0),
+                started_at=d.get("started_at", 0.0),
+            )
+            self.jobs[j.id] = j
+        for d in self._gtable.load_all().values():
+            self.groups[d["id"]] = GroupJob(d["id"], list(d["job_ids"]))
+        for j in sorted(self.jobs.values(), key=lambda x: x.created_at):
+            if j.state is JobState.PENDING:
+                self._q(j.queue).put(j)
 
     def _q(self, name: str) -> "queue.Queue[Job]":
         with self._mu:
@@ -99,16 +154,25 @@ class JobQueue:
         with self._mu:
             self.jobs[job.id] = job
             if group_id is not None:
-                self.groups.setdefault(group_id, GroupJob(group_id)).job_ids.append(job.id)
+                group = self.groups.setdefault(group_id, GroupJob(group_id))
+                group.job_ids.append(job.id)
+                self._persist_group(group)
+            # Persist under _mu, BEFORE the queue put: a worker can poll
+            # the job the instant it lands, and an unlocked write here
+            # could commit a torn STARTED/started_at=0 row that the
+            # stale-requeue can never redeliver after a crash.
+            self._persist_job(job)
         q = self._q(queue_name)
         while q.qsize() >= self.max_backlog:
             try:
                 evicted = q.get_nowait()
             except queue.Empty:
                 break
-            if evicted.state is JobState.PENDING:
-                evicted.state = JobState.FAILURE
-                evicted.error = "evicted: queue backlog full"
+            with self._mu:
+                if evicted.state is JobState.PENDING:
+                    evicted.state = JobState.FAILURE
+                    evicted.error = "evicted: queue backlog full"
+                    self._persist_job(evicted)
         q.put(job)
         return job
 
@@ -162,9 +226,11 @@ class JobQueue:
                 if job.expires_at and now > job.expires_at:
                     job.state = JobState.FAILURE
                     job.error = "expired before execution"
+                    self._persist_job(job)
                     continue
                 job.state = JobState.STARTED
                 job.started_at = now
+                self._persist_job(job)
             return job
 
     def _requeue_stale_started(self, queue_name: str, max_age_s: float) -> None:
@@ -181,6 +247,7 @@ class JobQueue:
                 ):
                     j.state = JobState.PENDING
                     j.started_at = 0.0
+                    self._persist_job(j)
                     stale.append(j)
         for j in stale:
             self._q(queue_name).put(j)
@@ -197,6 +264,7 @@ class JobQueue:
             job.state = state
             job.result = result
             job.error = error
+            self._persist_job(job)
 
     def group_snapshot(self, group_id: str) -> Dict[str, Any]:
         """Group state + per-job states (the jobs API's GET view)."""
@@ -253,6 +321,7 @@ class JobQueue:
                 ):
                     j.state = JobState.FAILURE
                     j.error = "expired before execution"
+                    self._persist_job(j)
             for jid in [
                 j.id for j in self.jobs.values()
                 if j.state in (JobState.SUCCESS, JobState.FAILURE)
@@ -260,12 +329,18 @@ class JobQueue:
             ]:
                 job = self.jobs.pop(jid)
                 removed += 1
+                if self._table is not None:
+                    self._table.delete(jid)
                 if job.group_id and job.group_id in self.groups:
                     group = self.groups[job.group_id]
                     if jid in group.job_ids:
                         group.job_ids.remove(jid)
                     if not group.job_ids:
                         self.groups.pop(job.group_id, None)
+                        if self._gtable is not None:
+                            self._gtable.delete(job.group_id)
+                    else:
+                        self._persist_group(group)
         return removed
 
 

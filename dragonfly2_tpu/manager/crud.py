@@ -8,15 +8,14 @@ CONFIG (candidate/filter parent limits, client load limits) that
 schedulers consume through dynconfig (scheduler/scheduling/
 scheduling.go:404-410 reads the limits per scheduling pass).
 
-Storage: one sqlite table of JSON rows (or memory when no db_path) —
-the write-through pattern `_SQLiteModelStore` uses.
+Storage: JSON rows behind the manager's state seam
+(manager/state.StateBackend — sqlite embedded, external SQL/KV for HA),
+write-through with in-memory reads.
 """
 
 from __future__ import annotations
 
-import json
 import re
-import sqlite3
 import threading
 import uuid
 from dataclasses import asdict, dataclass, field
@@ -100,36 +99,28 @@ def _validate_cluster_blobs(fields: Dict[str, Any]) -> None:
 class CrudStore:
     """JSON-row store for the manager's CRUD resources."""
 
-    def __init__(self, db_path: Optional[str] = None) -> None:
+    def __init__(self, db_path: Optional[str] = None, *, backend=None) -> None:
         self._mu = threading.RLock()
         self._rows: Dict[str, Dict[str, dict]] = {k: {} for k in _KINDS}
-        self._db: Optional[sqlite3.Connection] = None
-        if db_path:
-            self._db = sqlite3.connect(db_path, check_same_thread=False)
-            self._db.execute(
-                "CREATE TABLE IF NOT EXISTS crud_rows ("
-                "kind TEXT, id TEXT, value TEXT, PRIMARY KEY (kind, id))"
-            )
-            for kind, id_, value in self._db.execute(
-                "SELECT kind, id, value FROM crud_rows"
-            ):
+        self._table = None
+        if backend is None and db_path:
+            from .state import SQLiteBackend
+
+            backend = SQLiteBackend(db_path)
+        if backend is not None:
+            self._table = backend.table("crud")
+            for key, row in self._table.load_all().items():
+                kind, _, id_ = key.partition(":")
                 if kind in self._rows:
-                    self._rows[kind][id_] = json.loads(value)
+                    self._rows[kind][id_] = row
 
     def _persist(self, kind: str, id_: str, row: Optional[dict]) -> None:
-        if self._db is None:
+        if self._table is None:
             return
-        with self._db:
-            if row is None:
-                self._db.execute(
-                    "DELETE FROM crud_rows WHERE kind=? AND id=?", (kind, id_)
-                )
-            else:
-                self._db.execute(
-                    "INSERT OR REPLACE INTO crud_rows (kind, id, value) "
-                    "VALUES (?, ?, ?)",
-                    (kind, id_, json.dumps(row)),
-                )
+        if row is None:
+            self._table.delete(f"{kind}:{id_}")
+        else:
+            self._table.put(f"{kind}:{id_}", row)
 
     # -- generic ops ---------------------------------------------------------
 

@@ -80,87 +80,32 @@ class BlobStore:
         return key in self._mem
 
 
-class _SQLiteModelStore:
-    """Durable model rows (reference: manager/models + database — GORM over
-    MySQL/Postgres; sqlite is the embedded equivalent).  The registry is
-    the source of truth in memory; every mutation writes through, and a
-    restarted manager reloads the full model table."""
+def _model_to_doc(m: Model) -> dict:
+    return {
+        "id": m.id, "name": m.name, "type": m.type, "version": m.version,
+        "scheduler_id": m.scheduler_id, "state": m.state.value,
+        "evaluation": m.evaluation, "blob_key": m.blob_key,
+        "created_at": m.created_at, "updated_at": m.updated_at,
+    }
 
-    def __init__(self, path: str) -> None:
-        import sqlite3
 
-        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._mu = threading.Lock()
-        with self._mu:
-            self._conn.execute(
-                """CREATE TABLE IF NOT EXISTS models (
-                    id TEXT PRIMARY KEY,
-                    name TEXT NOT NULL,
-                    type TEXT NOT NULL,
-                    version INTEGER NOT NULL,
-                    scheduler_id TEXT NOT NULL,
-                    state TEXT NOT NULL,
-                    evaluation TEXT NOT NULL,
-                    blob_key TEXT NOT NULL,
-                    created_at REAL NOT NULL,
-                    updated_at REAL NOT NULL
-                )"""
-            )
-            self._conn.commit()
-
-    def upsert_many(self, models) -> None:
-        """All rows in ONE transaction — activation flips two rows and a
-        crash between separate commits would leave two ACTIVE versions."""
-        import json
-
-        rows = [
-            (
-                m.id, m.name, m.type, m.version, m.scheduler_id,
-                m.state.value, json.dumps(m.evaluation), m.blob_key,
-                m.created_at, m.updated_at,
-            )
-            for m in models
-        ]
-        with self._mu:
-            self._conn.executemany(
-                "INSERT OR REPLACE INTO models VALUES (?,?,?,?,?,?,?,?,?,?)", rows
-            )
-            self._conn.commit()
-
-    def upsert(self, m: Model) -> None:
-        self.upsert_many([m])
-
-    def delete(self, model_id: str) -> None:
-        with self._mu:
-            self._conn.execute("DELETE FROM models WHERE id = ?", (model_id,))
-            self._conn.commit()
-
-    def load_all(self) -> Dict[str, Model]:
-        import json
-
-        with self._mu:
-            rows = self._conn.execute("SELECT * FROM models").fetchall()
-        out: Dict[str, Model] = {}
-        for r in rows:
-            out[r[0]] = Model(
-                id=r[0], name=r[1], type=r[2], version=r[3], scheduler_id=r[4],
-                state=ModelState(r[5]), evaluation=json.loads(r[6]),
-                blob_key=r[7], created_at=r[8], updated_at=r[9],
-            )
-        return out
-
-    def close(self) -> None:
-        with self._mu:
-            self._conn.close()
+def _model_from_doc(d: dict) -> Model:
+    return Model(
+        id=d["id"], name=d["name"], type=d["type"], version=d["version"],
+        scheduler_id=d["scheduler_id"], state=ModelState(d["state"]),
+        evaluation=dict(d["evaluation"]), blob_key=d["blob_key"],
+        created_at=d["created_at"], updated_at=d["updated_at"],
+    )
 
 
 class ModelRegistry:
     """The registry service (manager CreateModel + model REST CRUD).
 
-    ``db_path`` enables durable rows (sqlite): every mutation writes
-    through and a restart reloads the table — models survive the manager
-    the way the reference's DB rows do.
+    Durable rows live behind the manager's state seam
+    (manager/state.StateBackend — sqlite embedded, external SQL/KV for
+    HA): every mutation writes through and a restart reloads the table —
+    models survive the manager the way the reference's DB rows do.
+    ``db_path`` is the convenience form (a private SQLiteBackend).
     """
 
     def __init__(
@@ -168,18 +113,27 @@ class ModelRegistry:
         blob_store: Optional[BlobStore] = None,
         *,
         db_path: Optional[str] = None,
+        backend=None,
     ) -> None:
         self._mu = threading.RLock()
         self._models: Dict[str, Model] = {}
         self.blobs = blob_store or BlobStore()
-        self._db: Optional[_SQLiteModelStore] = None
-        if db_path:
-            self._db = _SQLiteModelStore(db_path)
-            self._models = self._db.load_all()
+        self._table = None
+        if backend is None and db_path:
+            from .state import SQLiteBackend
+
+            backend = SQLiteBackend(db_path)
+        if backend is not None:
+            self._table = backend.table("models")
+            self._models = {
+                k: _model_from_doc(d) for k, d in self._table.load_all().items()
+            }
 
     def _persist(self, *models: Model) -> None:
-        if self._db is not None:
-            self._db.upsert_many(models)
+        if self._table is not None:
+            # ONE transaction: activation flips two rows and a crash
+            # between separate commits would leave two ACTIVE versions.
+            self._table.put_many({m.id: _model_to_doc(m) for m in models})
 
     # -- CreateModel (manager_server_v1.go:802-901) -------------------------
 
@@ -266,8 +220,8 @@ class ModelRegistry:
     def delete(self, model_id: str) -> None:
         with self._mu:
             self._models.pop(model_id, None)
-            if self._db is not None:
-                self._db.delete(model_id)
+            if self._table is not None:
+                self._table.delete(model_id)
 
     # -- reads ---------------------------------------------------------------
 

@@ -118,6 +118,8 @@ class ManagerRESTServer:
         objectstorage=None,
         rate_limit=None,
         ca=None,
+        state_backend=None,
+        jobs_min_requeue_s: float = 30.0,
     ):
         self.registry = registry
         self.clusters = clusters
@@ -149,6 +151,15 @@ class ManagerRESTServer:
         self.topology_shared: dict = {}
         self.topology_ttl_s = 600.0
         self._topology_mu = threading.Lock()
+        # With the manager state seam attached, pushed topology survives
+        # a manager crash: replicas keep pulling the merged graph after
+        # a restart instead of waiting a full re-push cycle.
+        self._topology_table = (
+            state_backend.table("topology") if state_backend is not None
+            else None
+        )
+        if self._topology_table is not None:
+            self.topology_shared = self._topology_table.load_all()
         # Job broker (machinery-over-Redis analog, jobs/remote.py): the
         # manager hosts the queues; remote scheduler workers poll them
         # over this REST surface.
@@ -162,6 +173,7 @@ class ManagerRESTServer:
         # open (matching the reference's authenticated-writes posture).
         # With a UserStore attached, PATs authenticate too and the user/
         # PAT/oauth routes come alive.
+        self.jobs_min_requeue_s = jobs_min_requeue_s
         self.token_verifier = token_verifier
         self.token_issuer = token_issuer
         self.users = users
@@ -336,6 +348,8 @@ class ManagerRESTServer:
                         ]
                         for sid in dead:
                             del server.topology_shared[sid]
+                            if server._topology_table is not None:
+                                server._topology_table.delete(sid)
                         edges = [
                             e
                             for sid, entry in server.topology_shared.items()
@@ -530,6 +544,10 @@ class ManagerRESTServer:
                             server.topology_shared[sid] = {
                                 "edges": edges, "pushed_at": _time.time(),
                             }
+                            if server._topology_table is not None:
+                                server._topology_table.put(
+                                    sid, server.topology_shared[sid]
+                                )
                         self._json(200, {"ok": True, "edges": len(edges)})
                     except (KeyError, ValueError, TypeError) as exc:
                         self._json(400, {"error": str(exc)})
@@ -680,7 +698,19 @@ class ManagerRESTServer:
                             self._json(400, {"error": "queue required"})
                             return
                         timeout = min(float(req.get("timeout_s") or 5.0), 30.0)
-                        job = server.jobqueue.poll(queue_name, timeout=timeout)
+                        # Visibility window override (machinery's
+                        # visibility-timeout analog) — floored by the
+                        # operator's jobs_min_requeue_s: an impatient
+                        # worker must not force-redeliver every job
+                        # another worker is still executing.
+                        requeue_after = max(
+                            float(req.get("requeue_started_after_s") or 120.0),
+                            server.jobs_min_requeue_s,
+                        )
+                        job = server.jobqueue.poll(
+                            queue_name, timeout=timeout,
+                            requeue_started_after_s=requeue_after,
+                        )
                         if job is None:
                             self._json(200, {})  # empty poll (204 bodies confuse keep-alive)
                             return

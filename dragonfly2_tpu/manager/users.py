@@ -64,90 +64,71 @@ def _hash_pat(raw: str) -> str:
     return hashlib.sha256(raw.encode()).hexdigest()
 
 
-class _SQLiteUserStore:
-    """Write-through persistence, same pattern as _SQLiteModelStore."""
+class _BackendUserStore:
+    """users/pats as JSON docs behind the manager's state seam
+    (manager/state.StateBackend); binary hash/salt fields ride base64."""
 
-    def __init__(self, path: str) -> None:
-        import sqlite3
+    def __init__(self, backend) -> None:
+        self._users = backend.table("users")
+        self._pats = backend.table("pats")
 
-        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._mu = threading.Lock()
-        with self._mu:
-            self._conn.execute(
-                """CREATE TABLE IF NOT EXISTS users (
-                    id TEXT PRIMARY KEY,
-                    name TEXT UNIQUE NOT NULL,
-                    email TEXT NOT NULL,
-                    role INTEGER NOT NULL,
-                    state TEXT NOT NULL,
-                    password_hash BLOB NOT NULL,
-                    salt BLOB NOT NULL,
-                    created_at REAL NOT NULL
-                )"""
-            )
-            self._conn.execute(
-                """CREATE TABLE IF NOT EXISTS pats (
-                    id TEXT PRIMARY KEY,
-                    user_id TEXT NOT NULL,
-                    name TEXT NOT NULL,
-                    role INTEGER NOT NULL,
-                    token_hash TEXT UNIQUE NOT NULL,
-                    expires_at REAL NOT NULL,
-                    revoked INTEGER NOT NULL,
-                    created_at REAL NOT NULL
-                )"""
-            )
-            self._conn.commit()
+    def upsert_user(self, u: "User", password_hash: bytes, salt: bytes) -> None:
+        import base64
 
-    def upsert_user(self, u: User, password_hash: bytes, salt: bytes) -> None:
-        with self._mu:
-            self._conn.execute(
-                "INSERT OR REPLACE INTO users VALUES (?,?,?,?,?,?,?,?)",
-                (u.id, u.name, u.email, int(u.role), u.state,
-                 password_hash, salt, u.created_at),
-            )
-            self._conn.commit()
+        self._users.put(u.id, {
+            "id": u.id, "name": u.name, "email": u.email,
+            "role": int(u.role), "state": u.state, "created_at": u.created_at,
+            "password_hash": base64.b64encode(password_hash).decode(),
+            "salt": base64.b64encode(salt).decode(),
+        })
 
-    def upsert_pat(self, p: PersonalAccessToken) -> None:
-        with self._mu:
-            self._conn.execute(
-                "INSERT OR REPLACE INTO pats VALUES (?,?,?,?,?,?,?,?)",
-                (p.id, p.user_id, p.name, int(p.role), p.token_hash,
-                 p.expires_at, int(p.revoked), p.created_at),
-            )
-            self._conn.commit()
+    def upsert_pat(self, p: "PersonalAccessToken") -> None:
+        self._pats.put(p.id, {
+            "id": p.id, "user_id": p.user_id, "name": p.name,
+            "role": int(p.role), "token_hash": p.token_hash,
+            "expires_at": p.expires_at, "revoked": p.revoked,
+            "created_at": p.created_at,
+        })
 
     def load_all(self):
-        with self._mu:
-            users = {}
-            creds = {}
-            for row in self._conn.execute("SELECT * FROM users"):
-                u = User(id=row[0], name=row[1], email=row[2],
-                         role=Role(row[3]), state=row[4], created_at=row[7])
-                users[u.id] = u
-                creds[u.id] = (row[5], row[6])
-            pats = {}
-            for row in self._conn.execute("SELECT * FROM pats"):
-                pats[row[0]] = PersonalAccessToken(
-                    id=row[0], user_id=row[1], name=row[2], role=Role(row[3]),
-                    token_hash=row[4], expires_at=row[5],
-                    revoked=bool(row[6]), created_at=row[7],
-                )
+        import base64
+
+        users, creds, pats = {}, {}, {}
+        for d in self._users.load_all().values():
+            u = User(id=d["id"], name=d["name"], email=d["email"],
+                     role=Role(d["role"]), state=d["state"],
+                     created_at=d["created_at"])
+            users[u.id] = u
+            creds[u.id] = (
+                base64.b64decode(d["password_hash"]),
+                base64.b64decode(d["salt"]),
+            )
+        for d in self._pats.load_all().values():
+            pats[d["id"]] = PersonalAccessToken(
+                id=d["id"], user_id=d["user_id"], name=d["name"],
+                role=Role(d["role"]), token_hash=d["token_hash"],
+                expires_at=d["expires_at"], revoked=bool(d["revoked"]),
+                created_at=d["created_at"],
+            )
         return users, creds, pats
 
 
 class UserStore:
-    """In-memory source of truth with optional sqlite write-through."""
+    """In-memory source of truth with write-through persistence via
+    the manager state seam (sqlite embedded; external SQL/KV for HA)."""
 
-    def __init__(self, db_path: Optional[str] = None) -> None:
+    def __init__(self, db_path: Optional[str] = None, *, backend=None) -> None:
         self._mu = threading.RLock()
         self._users: Dict[str, User] = {}
         self._creds: Dict[str, tuple] = {}  # user_id → (hash, salt)
         self._pats: Dict[str, PersonalAccessToken] = {}
-        self._db: Optional[_SQLiteUserStore] = None
-        if db_path:
-            self._db = _SQLiteUserStore(db_path)
+        self._db: Optional[_BackendUserStore] = None
+        if backend is None and db_path:
+            from .state import SQLiteBackend
+
+            backend = SQLiteBackend(db_path)
+        if backend is not None:
+            self._db = _BackendUserStore(backend)
             self._users, self._creds, self._pats = self._db.load_all()
 
     # -- users (handlers/user.go signup/signin) -----------------------------
