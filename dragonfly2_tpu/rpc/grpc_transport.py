@@ -66,7 +66,7 @@ def _iter_until_closed(request_iterator):
 # method → (request message, response message); mirrors
 # SchedulerRPCAdapter.METHODS exactly.
 SCHEDULER_METHODS = {
-    "announce_host": (pb.AnnounceHostRequest, pb.Empty),
+    "announce_host": (pb.AnnounceHostRequest, pb.AnnounceHostResponse),
     "register_peer": (pb.RegisterPeerRequest, pb.RegisterPeerResponse),
     "set_task_info": (pb.SetTaskInfoRequest, pb.TaskInfoResponse),
     "report_piece_finished": (pb.ReportPieceFinishedRequest, pb.Empty),
@@ -166,6 +166,10 @@ class SchedulerGRPCServer:
         from .scheduler_server import SchedulerRPCAdapter
 
         self.adapter = SchedulerRPCAdapter(service)
+        # This binding HAS the bidi push stream; advertise it.
+        self.adapter.capabilities = self.adapter.capabilities + (
+            "push-reschedule",
+        )
         # Share the service's hub if the composition root made one;
         # otherwise create it (tests construct the server directly).
         if getattr(service, "hub", None) is None:
@@ -397,9 +401,13 @@ class GRPCRemoteScheduler(RemoteScheduler):
         *,
         timeout: float = 10.0,
         channel_credentials: Optional[grpc.ChannelCredentials] = None,
+        protocol_version: Optional[int] = None,
     ) -> None:
         # base_url is only used by HTTP _call, which we override.
-        super().__init__(f"grpc://{target}", timeout=timeout)
+        super().__init__(
+            f"grpc://{target}", timeout=timeout,
+            protocol_version=protocol_version,
+        )
         if channel_credentials is not None:
             self._channel = grpc.secure_channel(target, channel_credentials)
         else:

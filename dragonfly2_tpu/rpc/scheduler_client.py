@@ -23,6 +23,7 @@ from ..scheduler.service import RegisterResult
 from ..utils.types import SizeScope
 from .retry import retry_call
 from .scheduler_server import host_from_wire, host_to_wire
+from .version import PROTOCOL_VERSION
 
 
 class RPCError(RuntimeError):
@@ -32,9 +33,26 @@ class RPCError(RuntimeError):
 
 
 class RemoteScheduler:
-    def __init__(self, base_url: str, *, timeout: float = 10.0):
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        timeout: float = 10.0,
+        protocol_version: Optional[int] = None,
+    ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # protocol_version=1 is the N-1 SHIM: requests carry no version
+        # field (byte-identical to pre-handshake clients) and v2-only
+        # features stay off — tests/test_compat.py downloads through it
+        # against the current scheduler every CI run.
+        self.protocol_version = (
+            PROTOCOL_VERSION if protocol_version is None else protocol_version
+        )
+        # What the server negotiated at announce (known after the first
+        # announce_host; assume own version until told otherwise).
+        self.negotiated_version = self.protocol_version
+        self.server_capabilities: tuple = ()
         self._mu = threading.Lock()
         self._tasks: Dict[str, Task] = {}
         self._hosts: Dict[str, Host] = {}
@@ -121,7 +139,26 @@ class RemoteScheduler:
     # -- SchedulerService surface -------------------------------------------
 
     def announce_host(self, host: Host) -> None:
-        self._call("announce_host", {"host": host_to_wire(host)})
+        req = {"host": host_to_wire(host)}
+        if self.protocol_version >= 2:
+            # The v1 shim sends NO version field — that absence is the
+            # legacy dialect's signature (rpc/version.py).
+            req["protocol_version"] = self.protocol_version
+        resp = self._call("announce_host", req)
+        proto = resp.get("protocol")
+        if proto:
+            # Downgrade to what the server negotiated; a v1 server
+            # answers {} and we keep speaking the legacy dialect.
+            self.negotiated_version = int(
+                proto.get("negotiated", self.protocol_version)
+            )
+            self.server_capabilities = tuple(proto.get("capabilities", ()))
+        elif self.protocol_version >= 2:
+            # A pre-handshake server (rollback at the same URL): drop to
+            # the legacy dialect AND forget the old server's advertised
+            # capabilities — they described a different server.
+            self.negotiated_version = 1
+            self.server_capabilities = ()
         with self._mu:
             self._hosts[host.id] = host
             self._announced.add(host.id)

@@ -26,6 +26,12 @@ from http.server import BaseHTTPRequestHandler
 from typing import Tuple
 
 from ._server import ThreadedHTTPService
+from .version import (
+    BASE_CAPABILITIES,
+    UnsupportedProtocolError,
+    negotiate,
+    protocol_info,
+)
 
 from ..scheduler.resource import Host, Peer
 from ..scheduler.scheduling import ScheduleResultKind
@@ -83,6 +89,10 @@ class SchedulerRPCAdapter:
 
     def __init__(self, service: SchedulerService) -> None:
         self.service = service
+        # What THIS transport can do; the gRPC binding appends
+        # "push-reschedule" (its bidi stream) — the HTTP wire must not
+        # advertise pushes it cannot deliver.
+        self.capabilities = tuple(BASE_CAPABILITIES)
         self._mu = threading.Lock()
         # Weak values: when the resource layer's GC reaps a peer, the wire
         # mapping evaporates with it instead of leaking one entry per
@@ -107,19 +117,26 @@ class SchedulerRPCAdapter:
     # -- methods -------------------------------------------------------------
 
     def announce_host(self, req: dict) -> dict:
+        # Versioned handshake (rpc/version.py): a field-less request is
+        # the v1 legacy dialect; too-old dialects get the typed refusal.
+        # proto3 renders an unset int32 as 0 — both absence and 0 mean
+        # the legacy v1 dialect.
+        negotiated = negotiate(int(req.get("protocol_version") or 1))
         host = host_from_wire(req["host"])
+        host.protocol_version = negotiated
         stored = self.service.resource.store_host(host)
         if stored is not host:
             # Refresh announce-time stats AND addresses on the existing
             # record — a restarted daemon announces a fresh download_port
             # and children must not be handed the dead one.
+            stored.protocol_version = negotiated
             stored.stats = host.stats
             stored.concurrent_upload_limit = host.concurrent_upload_limit
             stored.ip = host.ip
             stored.port = host.port
             stored.download_port = host.download_port
             stored.touch()
-        return {}
+        return {"protocol": protocol_info(negotiated, self.capabilities)}
 
     def register_peer(self, req: dict) -> dict:
         host = self.service.resource.host_manager.load(req["host_id"])
@@ -333,6 +350,11 @@ class SchedulerHTTPServer:
                         {"error": str(exc), "code": int(Code.NOT_FOUND)}
                     ).encode()
                     self.send_response(404)
+                except UnsupportedProtocolError as exc:
+                    body = json.dumps(
+                        {"error": str(exc), "code": int(exc.code)}
+                    ).encode()
+                    self.send_response(400)
                 except Exception as exc:  # noqa: BLE001 — wire boundary
                     body = json.dumps(
                         {"error": str(exc), "code": int(Code.UNKNOWN)}

@@ -168,6 +168,9 @@ class Host:
         self.peers: Dict[str, "Peer"] = {}
         self.created_at = time.time()
         self.updated_at = self.created_at
+        # Negotiated wire dialect for this host's connections
+        # (rpc/version.py; 1 = the legacy unversioned dialect).
+        self.protocol_version = 1
 
     def free_upload_count(self) -> int:
         with self._mu:

@@ -1,0 +1,234 @@
+"""Wire-version negotiation + N-1 compatibility (VERDICT r4 #6).
+
+Reference: the scheduler serves gRPC v1 AND v2 concurrently and CI runs
+old client images against new servers (DRAGONFLY_COMPATIBILITY_E2E_TEST
+_MODE, SURVEY §4).  Here: rpc/version.py defines the handshake; the N-1
+shim is ``RemoteScheduler(protocol_version=1)`` — its requests carry NO
+version field, byte-identical to every client built before the
+handshake existed — and the headline test downloads through that shim
+against the current scheduler: the old-protocol daemon completing a
+download against a new scheduler, every CI run.
+"""
+
+import pytest
+
+from dragonfly2_tpu.daemon import DaemonStorage, UploadManager
+from dragonfly2_tpu.daemon.conductor import Conductor
+from dragonfly2_tpu.rpc import (
+    HTTPPieceFetcher,
+    PieceHTTPServer,
+    RemoteScheduler,
+    SchedulerHTTPServer,
+)
+from dragonfly2_tpu.rpc.scheduler_client import RPCError
+from dragonfly2_tpu.rpc.version import MIN_SUPPORTED, PROTOCOL_VERSION
+from dragonfly2_tpu.scheduler.evaluator import Evaluator
+from dragonfly2_tpu.scheduler.networktopology import NetworkTopology
+from dragonfly2_tpu.scheduler.resource import Host, Resource
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from dragonfly2_tpu.records.storage import Storage
+
+PIECE = 16 * 1024
+
+
+class _Origin:
+    def __init__(self):
+        self.fetches = 0
+
+    def content(self, url, i):
+        return bytes((len(url) + i + j) % 256 for j in range(PIECE))
+
+    def fetch(self, url, number, piece_size):
+        self.fetches += 1
+        return self.content(url, number)
+
+
+class _Node:
+    def __init__(self, i, scheduler_url, tmp_path, origin, *, protocol_version):
+        self.storage = DaemonStorage(
+            str(tmp_path / f"compat{i}"), prefer_native=False
+        )
+        self.upload = UploadManager(self.storage)
+        self.piece_server = PieceHTTPServer(self.upload)
+        self.piece_server.serve()
+        self.host = Host(
+            id=f"compat-{i}", hostname=f"compat-{i}", ip="127.0.0.1",
+            download_port=self.piece_server.port,
+        )
+        self.client = RemoteScheduler(
+            scheduler_url, protocol_version=protocol_version
+        )
+        self.conductor = Conductor(
+            self.host, self.storage, self.client,
+            piece_fetcher=HTTPPieceFetcher(self.client.resolve_host),
+            source_fetcher=origin,
+        )
+
+    def stop(self):
+        self.piece_server.stop()
+
+
+@pytest.fixture()
+def scheduler(tmp_path):
+    resource = Resource()
+    service = SchedulerService(
+        resource,
+        Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+        Storage(str(tmp_path / "records"), buffer_size=1),
+        NetworkTopology(resource.host_manager),
+    )
+    server = SchedulerHTTPServer(service)
+    server.serve()
+    yield server, service
+    server.stop()
+
+
+class TestCompatE2E:
+    def test_v1_daemon_completes_download_against_current_scheduler(
+        self, scheduler, tmp_path
+    ):
+        """THE compat e2e: two N-1 (pre-handshake dialect) daemons run
+        the full flow — announce, register, back-to-source, then a P2P
+        re-download with parent attribution — against today's
+        scheduler."""
+        server, service = scheduler
+        origin = _Origin()
+        nodes = [
+            _Node(i, server.url, tmp_path, origin, protocol_version=1)
+            for i in range(2)
+        ]
+        try:
+            url = "https://origin/compat-blob"
+            r0 = nodes[0].conductor.download(
+                url, piece_size=PIECE, content_length=3 * PIECE
+            )
+            assert r0.ok and r0.back_to_source and r0.pieces == 3
+            fetches = origin.fetches
+            r1 = nodes[1].conductor.download(url, piece_size=PIECE)
+            assert r1.ok and not r1.back_to_source
+            assert origin.fetches == fetches  # bytes moved P2P
+            for n in range(3):
+                assert (
+                    nodes[1].storage.read_piece(r1.task_id, n)
+                    == origin.content(url, n)
+                )
+            # The server recorded both hosts at the legacy dialect.
+            for i in range(2):
+                host = service.resource.host_manager.load(f"compat-{i}")
+                assert host.protocol_version == 1
+        finally:
+            for n in nodes:
+                n.stop()
+
+    def test_mixed_dialect_swarm(self, scheduler, tmp_path):
+        """v1 and v2 daemons share one swarm: a v2 child downloads from
+        a v1 parent — skew inside a rolling upgrade."""
+        server, service = scheduler
+        origin = _Origin()
+        old = _Node(0, server.url, tmp_path, origin, protocol_version=1)
+        new = _Node(1, server.url, tmp_path, origin,
+                    protocol_version=PROTOCOL_VERSION)
+        try:
+            url = "https://origin/mixed-blob"
+            assert old.conductor.download(
+                url, piece_size=PIECE, content_length=2 * PIECE
+            ).ok
+            r = new.conductor.download(url, piece_size=PIECE)
+            assert r.ok and not r.back_to_source
+            assert new.client.negotiated_version == PROTOCOL_VERSION
+            # HTTP transport: no push stream, so no push capability —
+            # discovery is per-transport, not a static list.
+            assert "steering" in new.client.server_capabilities
+            assert "push-reschedule" not in new.client.server_capabilities
+            assert service.resource.host_manager.load(
+                "compat-0"
+            ).protocol_version == 1
+            assert service.resource.host_manager.load(
+                "compat-1"
+            ).protocol_version == PROTOCOL_VERSION
+        finally:
+            old.stop()
+            new.stop()
+
+
+class TestHandshake:
+    def _announce(self, server, *, protocol_version):
+        client = RemoteScheduler(
+            server.url, protocol_version=protocol_version
+        )
+        host = Host(id=f"hs-{protocol_version}", hostname="h", ip="127.0.0.1")
+        client.announce_host(host)
+        return client
+
+    def test_v2_negotiates_and_discovers_capabilities(self, scheduler):
+        server, service = scheduler
+        client = self._announce(server, protocol_version=PROTOCOL_VERSION)
+        assert client.negotiated_version == PROTOCOL_VERSION
+        assert set(client.server_capabilities) >= {"steering", "probe-sync"}
+
+    def test_future_client_downgrades_to_server_version(self, scheduler):
+        """A client one release AHEAD speaks the server's dialect after
+        the handshake (the symmetric half of the skew policy)."""
+        server, service = scheduler
+        client = self._announce(
+            server, protocol_version=PROTOCOL_VERSION + 1
+        )
+        assert client.negotiated_version == PROTOCOL_VERSION
+
+    def test_too_old_dialect_gets_typed_refusal(self, scheduler):
+        """When MIN_SUPPORTED moves past 1 (the deprecation policy,
+        DESIGN.md §10d), legacy clients get INVALID_ARGUMENT with an
+        actionable message — not a silent misbehavior."""
+        from unittest import mock
+
+        from dragonfly2_tpu.rpc import version as v
+        from dragonfly2_tpu.utils.dferrors import Code
+
+        server, service = scheduler
+        with mock.patch.object(v, "MIN_SUPPORTED", 2):
+            client = RemoteScheduler(server.url, protocol_version=1)
+            host = Host(id="old", hostname="h", ip="127.0.0.1")
+            with pytest.raises(RPCError) as exc:
+                client.announce_host(host)
+            assert exc.value.code == int(Code.INVALID_ARGUMENT)
+            assert "upgrade the client" in str(exc.value)
+
+    def test_grpc_transport_carries_the_handshake(self, tmp_path):
+        """Same negotiation over the gRPC binding (the proto gained
+        AnnounceHostRequest.protocol_version / AnnounceHostResponse)."""
+        from dragonfly2_tpu.rpc.grpc_transport import (
+            GRPCRemoteScheduler,
+            SchedulerGRPCServer,
+        )
+
+        resource = Resource()
+        service = SchedulerService(
+            resource,
+            Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+            Storage(str(tmp_path / "records"), buffer_size=1),
+            NetworkTopology(resource.host_manager),
+        )
+        srv = SchedulerGRPCServer(service)
+        srv.serve()
+        try:
+            client = GRPCRemoteScheduler(srv.target)
+            host = Host(id="grpc-hs", hostname="h", ip="127.0.0.1")
+            client.announce_host(host)
+            assert client.negotiated_version == PROTOCOL_VERSION
+            assert "push-reschedule" in client.server_capabilities
+            assert resource.host_manager.load(
+                "grpc-hs"
+            ).protocol_version == PROTOCOL_VERSION
+            # The v1 shim over gRPC: unset proto field = legacy dialect.
+            shim = GRPCRemoteScheduler(srv.target, protocol_version=1)
+            host2 = Host(id="grpc-old", hostname="h", ip="127.0.0.1")
+            shim.announce_host(host2)
+            assert resource.host_manager.load(
+                "grpc-old"
+            ).protocol_version == 1
+        finally:
+            srv.stop()
+
+    def test_min_supported_window_is_n_minus_1(self):
+        assert MIN_SUPPORTED == PROTOCOL_VERSION - 1
