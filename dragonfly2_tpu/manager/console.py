@@ -35,6 +35,7 @@ CONSOLE_HTML = """<!DOCTYPE html>
 <div id="signin">
   <input id="u" placeholder="username"><input id="p" type="password" placeholder="password">
   <button onclick="signin()">Sign in</button>
+  <span id="oauth-buttons"></span>
   <span id="signin-msg" class="err"></span>
 </div>
 <div id="app" style="display:none">
@@ -97,7 +98,16 @@ async function api(path, opts) {
     tok() ? {"Authorization": "Bearer " + tok()} : {},
     opts.body ? {"Content-Type": "application/json"} : {}, opts.headers || {});
   const r = await fetch("/api/v1" + path, opts);
-  if (!r.ok) throw new Error((await r.json()).error || r.status);
+  if (r.status === 401 && !opts._retried && localStorage.getItem("df_refresh_id")
+      && path !== "/oauth:refresh") {
+    // Expired session with a refresh grant in hand: renew and retry once.
+    if (await oauthRefresh()) return api(path, Object.assign({}, opts, {_retried: true}));
+  }
+  if (!r.ok) {
+    const err = new Error((await r.json()).error || r.status);
+    err.status = r.status;
+    throw err;
+  }
   return r.json();
 }
 async function signin() {
@@ -111,6 +121,56 @@ async function signin() {
   } catch (e) { document.getElementById("signin-msg").textContent = e.message; }
 }
 function signout() { localStorage.clear(); location.reload(); }
+// -- OAuth sign-in (providers -> authorize redirect -> callback code ->
+//    :signin; sessions renew via /oauth:refresh, falling back to the
+//    authorize flow when the provider revoked the refresh token). --
+async function oauthButtons() {
+  try {
+    const providers = await api("/oauth:providers");
+    document.getElementById("oauth-buttons").innerHTML = providers.map(p =>
+      `<button onclick="oauthStart('${esc(p)}')">Sign in with ${esc(p)}</button>`
+    ).join("");
+  } catch (e) { /* no oauth configured */ }
+}
+async function oauthStart(name) {
+  const cb = location.origin + location.pathname + "?oauth=" + encodeURIComponent(name);
+  const out = await api(`/oauth/${name}:authorize-url?redirect_uri=` + encodeURIComponent(cb));
+  location.href = out.url;
+}
+async function oauthCallback() {
+  const q = new URLSearchParams(location.search);
+  if (!q.get("oauth") || !q.get("code")) return false;
+  const name = q.get("oauth");
+  const cb = location.origin + location.pathname + "?oauth=" + encodeURIComponent(name);
+  const out = await api(`/oauth/${name}:signin`, {method: "POST", body: JSON.stringify(
+    {code: q.get("code"), state: q.get("state"), redirect_uri: cb})});
+  localStorage.setItem("df_token", out.token);
+  localStorage.setItem("df_role", out.role);
+  localStorage.setItem("df_user", out.user || name);
+  if (out.refresh_id) localStorage.setItem("df_refresh_id", out.refresh_id);
+  history.replaceState(null, "", location.pathname);
+  return true;
+}
+async function oauthRefresh() {
+  const rid = localStorage.getItem("df_refresh_id");
+  if (!rid) return false;
+  try {
+    const out = await api("/oauth:refresh", {method: "POST",
+      body: JSON.stringify({refresh_id: rid})});
+    localStorage.setItem("df_token", out.token);
+    localStorage.setItem("df_role", out.role);
+    localStorage.setItem("df_refresh_id", out.refresh_id);
+    return true;
+  } catch (e) {
+    if (e.status === 403) {
+      // Provider revoked the grant: degrade to re-authentication.
+      localStorage.removeItem("df_refresh_id");
+      localStorage.removeItem("df_token");
+    }
+    // Network/5xx: keep the handle — the grant is intact server-side.
+    return false;
+  }
+}
 function fill(id, rows) {
   document.querySelector("#" + id + " tbody").innerHTML = rows.join("");
 }
@@ -225,8 +285,17 @@ async function revoke(id) {
   try { await api(`/pats/${id}:revoke`, {method: "POST", body: "{}"}); refresh(); }
   catch (e) { alert(e.message); }
 }
-function boot() {
-  if (!tok()) return;
+async function boot() {
+  try {
+    if (await oauthCallback()) { /* token stored from the callback */ }
+  } catch (e) {
+    // Expired state / replayed callback: clean the URL, surface the
+    // error, and fall through to the sign-in options.
+    history.replaceState(null, "", location.pathname);
+    document.getElementById("signin-msg").textContent = e.message;
+  }
+  if (!tok() && !(await oauthRefresh())) { oauthButtons(); return; }
+  if (!tok()) { oauthButtons(); return; }
   document.getElementById("signin").style.display = "none";
   document.getElementById("app").style.display = "block";
   document.getElementById("who").textContent = localStorage.getItem("df_user") || "?";

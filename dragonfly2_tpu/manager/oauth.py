@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import secrets
+import urllib.error
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass
@@ -23,6 +24,12 @@ from .users import User, UserStore
 
 def _default_transport(req: urllib.request.Request, timeout: float):
     return urllib.request.urlopen(req, timeout=timeout)
+
+
+class OAuthUnavailable(RuntimeError):
+    """The IdP was unreachable or errored (5xx/timeout) — a TRANSIENT
+    outcome: callers must keep refresh grants intact and retry, never
+    treat it as a revocation."""
 
 
 @dataclass
@@ -56,6 +63,9 @@ class OAuthSignin:
         # can't be grown without bound remotely.
         self._states: Dict[str, tuple] = {}
         self.state_ttl_s = 600.0
+        # refresh handle → (provider, user_id, provider refresh token,
+        # issued_at); see refresh().
+        self._grants: Dict[str, tuple] = {}
 
     def register(self, provider: OAuthProvider) -> None:
         self._providers[provider.name] = provider
@@ -87,34 +97,39 @@ class OAuthSignin:
             }
         )
 
-    def signin(
-        self, provider_name: str, code: str, state: str, redirect_uri: str
-    ) -> User:
-        """Code exchange → profile fetch → local user (get-or-create)."""
-        self._prune_states()
-        entry = self._states.pop(state, None)
-        if entry is None or entry[0] != provider_name:
-            raise PermissionError("oauth state mismatch (CSRF)")
-        p = self._providers[provider_name]
+    def _token_request(self, p: OAuthProvider, grant: Dict[str, str]) -> dict:
         body = urllib.parse.urlencode(
             {
                 "client_id": p.client_id,
                 "client_secret": p.client_secret,
-                "code": code,
-                "grant_type": "authorization_code",
-                "redirect_uri": redirect_uri,
+                **grant,
             }
         ).encode()
         req = urllib.request.Request(
             p.token_url, data=body,
             headers={"Accept": "application/json"}, method="POST",
         )
-        with self.transport(req, self.timeout) as resp:
-            token = json.loads(resp.read()).get("access_token", "")
-        if not token:
-            raise PermissionError("oauth code exchange failed")
+        try:
+            with self.transport(req, self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            if exc.code in (400, 401, 403):
+                # The IdP REJECTED the grant (invalid/revoked) — an auth
+                # outcome.
+                return {}
+            # 5xx/429: the IdP is having a moment, the grant may well be
+            # fine — transient, never destroy state over it.
+            raise OAuthUnavailable(
+                f"provider {p.name} returned HTTP {exc.code}"
+            ) from exc
+        except (urllib.error.URLError, TimeoutError, OSError) as exc:
+            raise OAuthUnavailable(
+                f"provider {p.name} unreachable: {exc}"
+            ) from exc
+
+    def _map_profile(self, p: OAuthProvider, access_token: str) -> User:
         req = urllib.request.Request(
-            p.profile_url, headers={"Authorization": f"Bearer {token}"}
+            p.profile_url, headers={"Authorization": f"Bearer {access_token}"}
         )
         with self.transport(req, self.timeout) as resp:
             profile = json.loads(resp.read())
@@ -136,3 +151,114 @@ class OAuthSignin:
             username, secrets.token_urlsafe(24), email=email,
             role=Role.READONLY,
         )
+
+    def signin(
+        self, provider_name: str, code: str, state: str, redirect_uri: str
+    ) -> User:
+        """Code exchange → profile fetch → local user (get-or-create).
+        Stores NO refresh grant — a caller that discards the handle must
+        not leave orphan grants evicting live users' under the cap."""
+        return self.signin_with_refresh(
+            provider_name, code, state, redirect_uri, store_grant=False
+        )[0]
+
+    def signin_with_refresh(
+        self, provider_name: str, code: str, state: str, redirect_uri: str,
+        *, store_grant: bool = True,
+    ):
+        """The full flow, keeping the provider's refresh grant: returns
+        (user, refresh_id) — refresh_id is an opaque manager-side handle
+        (the provider refresh token itself never leaves the manager) the
+        console presents to ``refresh`` for a new session without an
+        interactive authorize round-trip; None when the IdP issued no
+        refresh token."""
+        self._prune_states()
+        entry = self._states.pop(state, None)
+        if entry is None or entry[0] != provider_name:
+            raise PermissionError("oauth state mismatch (CSRF)")
+        p = self._providers[provider_name]
+        tokens = self._token_request(p, {
+            "code": code,
+            "grant_type": "authorization_code",
+            "redirect_uri": redirect_uri,
+        })
+        access = tokens.get("access_token", "")
+        if not access:
+            raise PermissionError("oauth code exchange failed")
+        user = self._map_profile(p, access)
+        refresh_id = None
+        if store_grant and tokens.get("refresh_token"):
+            refresh_id = self._store_grant(
+                p.name, user.id, tokens["refresh_token"]
+            )
+        return user, refresh_id
+
+    # -- refresh (oauth.go refresh-token semantics) -------------------------
+
+    # Stored provider refresh grants, keyed by the opaque handle the
+    # console holds.  Bounded two ways: a TTL (a browser that never came
+    # back holds no live grant) and a hard cap with oldest-first
+    # eviction.
+    MAX_GRANTS = 10_000
+    GRANT_TTL_S = 30 * 86_400.0
+
+    def _store_grant(self, provider: str, user_id: str, refresh_token: str) -> str:
+        import time
+
+        now = time.time()
+        for rid_ in [
+            r for r, (_, _, _, t) in self._grants.items()
+            if now - t > self.GRANT_TTL_S
+        ]:
+            self._grants.pop(rid_, None)
+        rid = secrets.token_urlsafe(24)
+        self._grants[rid] = (provider, user_id, refresh_token, now)
+        while len(self._grants) > self.MAX_GRANTS:
+            self._grants.pop(next(iter(self._grants)))
+        return rid
+
+    def refresh(self, refresh_id: str):
+        """Renew a session from the stored provider refresh token:
+        re-validates the identity against the IdP (a token the provider
+        revoked — or a deleted/disabled account — degrades to
+        re-authentication, never to a silent session).  Rotates both the
+        handle and, when the IdP sends one, the provider refresh token.
+        Returns (user, new_refresh_id)."""
+        entry = self._grants.get(refresh_id)
+        if entry is None:
+            raise PermissionError("unknown refresh handle; re-authenticate")
+        provider, user_id, refresh_token, issued = entry
+        p = self._providers.get(provider)
+        if p is None:
+            self._grants.pop(refresh_id, None)
+            raise PermissionError(f"provider {provider!r} no longer configured")
+        # May raise OAuthUnavailable — grant INTACT, caller retries.
+        tokens = self._token_request(p, {
+            "refresh_token": refresh_token,
+            "grant_type": "refresh_token",
+        })
+        access = tokens.get("access_token", "")
+        if not access:
+            # The IdP rejected (revoked/expired) the grant: destroy it —
+            # the console falls back to the authorize flow.
+            self._grants.pop(refresh_id, None)
+            raise PermissionError(
+                "oauth refresh rejected by provider; re-authenticate"
+            )
+        # The IdP may have ROTATED the refresh token: record it under the
+        # old handle immediately, so a crash/transport failure below
+        # cannot strand the only copy of the rotated token.
+        new_rt = tokens.get("refresh_token") or refresh_token
+        self._grants[refresh_id] = (provider, user_id, new_rt, issued)
+        try:
+            user = self._map_profile(p, access)
+        except (urllib.error.URLError, TimeoutError, OSError) as exc:
+            raise OAuthUnavailable(
+                f"provider {provider} unreachable: {exc}"
+            ) from exc
+        except PermissionError:
+            self._grants.pop(refresh_id, None)  # disabled/unusable account
+            raise
+        self._grants.pop(refresh_id, None)
+        new_rid = self._store_grant(provider, user.id, new_rt)
+        return user, new_rid

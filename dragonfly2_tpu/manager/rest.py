@@ -443,6 +443,7 @@ class ManagerRESTServer:
                     path.startswith("/api/v1/users")
                     or path.startswith("/api/v1/pats")
                     or path.startswith("/api/v1/oauth/")
+                    or path == "/api/v1/oauth:refresh"
                 ):
                     self._user_routes(path)
                     return
@@ -839,22 +840,55 @@ class ManagerRESTServer:
                         and server.oauth is not None
                     ):
                         name = path[len("/api/v1/oauth/") : -len(":signin")]
-                        req = self._body()
-                        u = server.oauth.signin(
-                            name, req.get("code", ""), req.get("state", ""),
-                            req.get("redirect_uri", ""),
-                        )
+                        # Issuer check FIRST: consuming the single-use
+                        # code/grant and then 500ing would strand it.
                         if server.token_issuer is None:
                             self._json(500, {"error": "no token issuer"})
                             return
+                        req = self._body()
+                        u, refresh_id = server.oauth.signin_with_refresh(
+                            name, req.get("code", ""), req.get("state", ""),
+                            req.get("redirect_uri", ""),
+                        )
                         token = server.token_issuer.issue(u.id, u.role)
-                        self._json(200, {"token": token, "role": u.role.name.lower()})
+                        self._json(200, {
+                            "token": token, "role": u.role.name.lower(),
+                            "user": u.name, "refresh_id": refresh_id,
+                        })
+                    elif (
+                        path == "/api/v1/oauth:refresh"
+                        and server.oauth is not None
+                    ):
+                        # Session renewal WITHOUT an interactive authorize
+                        # round-trip; a provider-revoked refresh token
+                        # 403s here and the console re-authenticates.
+                        if server.token_issuer is None:
+                            self._json(500, {"error": "no token issuer"})
+                            return
+                        req = self._body()
+                        u, refresh_id = server.oauth.refresh(
+                            req.get("refresh_id", "")
+                        )
+                        token = server.token_issuer.issue(u.id, u.role)
+                        self._json(200, {
+                            "token": token, "role": u.role.name.lower(),
+                            "user": u.name, "refresh_id": refresh_id,
+                        })
                     else:
                         self._json(404, {"error": "not found"})
                 except PermissionError as exc:
                     self._json(403, {"error": str(exc)})
                 except (KeyError, ValueError) as exc:
                     self._json(400, {"error": str(exc)})
+                except Exception as exc:  # noqa: BLE001 — IdP outage etc.
+                    from .oauth import OAuthUnavailable
+
+                    if isinstance(exc, OAuthUnavailable):
+                        # Transient provider failure: the grant is
+                        # intact server-side; the console retries.
+                        self._json(503, {"error": str(exc)})
+                    else:
+                        raise
 
         self._svc = ThreadedHTTPService(Handler, host, port, "manager-rest")
         self.address: Tuple[str, int] = self._svc.address
