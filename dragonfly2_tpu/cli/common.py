@@ -78,3 +78,10 @@ def init_logging(args, service: str) -> None:
         console=args.console or not args.log_dir,
         service=service,
     )
+    # Chaos drills hand a fault scenario to service binaries via
+    # DF_FAULTINJECT (utils/faultinject.py) — a child process then
+    # drops/delays/SIGKILLs itself at deterministic call indices, with
+    # no racy external kill timing.  No-op without the env var.
+    from ..utils import faultinject
+
+    faultinject.install_from_env()

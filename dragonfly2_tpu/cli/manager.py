@@ -127,11 +127,18 @@ def run(argv=None) -> int:
     bucket = maybe_bucket(cfg.server.rate_limit_qps, cfg.server.rate_limit_burst)
     ca = None
     if cfg.ca_dir:
-        from ..security.ca import CertificateAuthority
-
-        # Persistent: restarts keep the cluster trust root, so issued
-        # peer identities stay valid across a manager bounce.
-        ca = CertificateAuthority.persistent(cfg.ca_dir)
+        try:
+            from ..security.ca import CertificateAuthority
+        except ImportError:
+            # `cryptography` absent: serve without the CA surface rather
+            # than dying at boot — identity issuance degrades to 404,
+            # everything else (registry, jobs, topology) keeps working.
+            print("manager: ca_dir set but `cryptography` unavailable; "
+                  "serving without CA", flush=True)
+        else:
+            # Persistent: restarts keep the cluster trust root, so issued
+            # peer identities stay valid across a manager bounce.
+            ca = CertificateAuthority.persistent(cfg.ca_dir)
     rest = ManagerRESTServer(
         parts["registry"], parts["clusters"], parts["searcher"],
         host=cfg.server.host, port=cfg.server.port,

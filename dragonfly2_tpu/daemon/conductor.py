@@ -65,6 +65,17 @@ class _SourceFetchError(Exception):
     """Internal: a back-to-source piece fetch failed (task-fatal)."""
 
 
+def _expected_piece_len(content_length: int, piece_size: int, number: int) -> int:
+    """Exact byte length piece `number` must have, or -1 when the task's
+    sizing is unknown.  Every fetch path checks its body against this —
+    a truncated piece (torn connection, misbehaving parent, injected
+    truncate fault) must surface as a FETCH FAILURE to retry/reschedule,
+    never be committed as silent corruption."""
+    if content_length < 0 or piece_size <= 0:
+        return -1
+    return max(0, min(piece_size, content_length - number * piece_size))
+
+
 @dataclass
 class DownloadResult:
     ok: bool
@@ -618,6 +629,10 @@ class Conductor:
                     data = self.piece_fetcher.fetch(holder, task_id, number)
                 except Exception:  # noqa: BLE001 — next holder
                     continue
+                if len(data) != _expected_piece_len(
+                    content_length, piece_size, number
+                ):
+                    continue  # torn body — try the next holder
                 self.storage.write_piece(task_id, number, data)
                 run.mark_piece(number)
                 with lock:
@@ -721,6 +736,14 @@ class Conductor:
                 try:
                     t_piece = time.monotonic()
                     data = self.piece_fetcher.fetch(parent.host.id, task.id, number)
+                    expected = _expected_piece_len(
+                        task.content_length, task.piece_size, number
+                    )
+                    if expected >= 0 and len(data) != expected:
+                        raise IOError(
+                            f"piece {number}: truncated body "
+                            f"({len(data)} != {expected} bytes)"
+                        )
                     cost_ns = max(int((time.monotonic() - t_piece) * 1e9), 1)
                 except Exception:
                     with state.lock:
@@ -895,6 +918,15 @@ class Conductor:
             )
         except Exception:
             raise _SourceFetchError(f"source fetch piece {number}")
+        expected = _expected_piece_len(task.content_length, piece_size, number)
+        if expected >= 0 and len(data) != expected:
+            # A short origin body persisted as a full piece would be
+            # SILENT corruption (digest mismatch at read time, long after
+            # the cause) — fail the task loudly instead.
+            raise _SourceFetchError(
+                f"source piece {number}: truncated body "
+                f"({len(data)} != {expected} bytes)"
+            )
         cost_ns = max(int((time.monotonic() - t_piece) * 1e9), 1)
         with self._report_lock:
             self.storage.write_piece(task.id, number, data)

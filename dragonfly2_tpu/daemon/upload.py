@@ -35,6 +35,12 @@ class UploadManager:
     def serve_piece(self, task_id: str, number: int) -> bytes:
         """One piece upload; raises UploadBusy past the concurrency cap,
         KeyError when the piece isn't local."""
+        from ..utils import faultinject
+
+        # Upload-path chaos seam (drop/delay/dferror before the read,
+        # truncate on the body): covers BOTH piece transports — the HTTP
+        # server and the in-process fetcher call through here.
+        faultinject.fire("daemon.upload.serve_piece")
         with self._mu:
             if self._active >= self.concurrent_limit:
                 raise UploadBusy(f"{self._active} active uploads")
@@ -43,7 +49,7 @@ class UploadManager:
             data = self.storage.read_piece(task_id, number)
             with self._mu:
                 self.upload_count += 1
-            return data
+            return faultinject.fire("daemon.upload.body", data)
         except Exception:
             with self._mu:
                 self.upload_failed_count += 1

@@ -94,18 +94,33 @@ class JobQueue:
     def _persist_job(self, j: Job) -> None:
         if self._table is None:
             return
+        doc = {
+            "id": j.id, "type": j.type, "queue": j.queue, "args": j.args,
+            "group_id": j.group_id, "state": j.state.value,
+            "result": j.result, "error": j.error,
+            "created_at": j.created_at, "expires_at": j.expires_at,
+            "started_at": j.started_at,
+        }
         try:
-            self._table.put(j.id, {
-                "id": j.id, "type": j.type, "queue": j.queue, "args": j.args,
-                "group_id": j.group_id, "state": j.state.value,
-                "result": j.result, "error": j.error,
-                "created_at": j.created_at, "expires_at": j.expires_at,
-                "started_at": j.started_at,
-            })
+            self._table.put(j.id, doc)
         except (TypeError, ValueError):
-            # A non-JSON result must not kill the completion path; the
-            # row keeps its last durable state.
-            pass
+            # Non-JSON result: persist state/error with result=None
+            # rather than dropping the write — leaving the durable row
+            # STARTED would GUARANTEE redelivery (and re-execution) of a
+            # completed job after a manager restart, not just make it
+            # possible on a crash (at-least-once means crash-only
+            # redelivery, not redelivery by construction).
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "job %s: result not JSON-serializable; persisted with "
+                "result=None", j.id,
+            )
+            doc["result"] = None
+            try:
+                self._table.put(j.id, doc)
+            except (TypeError, ValueError):
+                pass  # args themselves unserializable — keep last state
 
     def _persist_group(self, g: GroupJob) -> None:
         if self._gtable is not None:

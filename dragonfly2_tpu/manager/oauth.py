@@ -64,8 +64,15 @@ class OAuthSignin:
         self._states: Dict[str, tuple] = {}
         self.state_ttl_s = 600.0
         # refresh handle → (provider, user_id, provider refresh token,
-        # issued_at); see refresh().
+        # issued_at); see refresh().  Guarded by _grants_mu: the REST
+        # server handles requests on concurrent threads, and two
+        # refreshes racing the same handle must not BOTH redeem the
+        # provider token (rotation-strict IdPs invalidate the grant
+        # family on the second redemption).
         self._grants: Dict[str, tuple] = {}
+        import threading
+
+        self._grants_mu = threading.Lock()
 
     def register(self, provider: OAuthProvider) -> None:
         self._providers[provider.name] = provider
@@ -206,15 +213,16 @@ class OAuthSignin:
         import time
 
         now = time.time()
-        for rid_ in [
-            r for r, (_, _, _, t) in self._grants.items()
-            if now - t > self.GRANT_TTL_S
-        ]:
-            self._grants.pop(rid_, None)
-        rid = secrets.token_urlsafe(24)
-        self._grants[rid] = (provider, user_id, refresh_token, now)
-        while len(self._grants) > self.MAX_GRANTS:
-            self._grants.pop(next(iter(self._grants)))
+        with self._grants_mu:
+            for rid_ in [
+                r for r, (_, _, _, t) in self._grants.items()
+                if now - t > self.GRANT_TTL_S
+            ]:
+                self._grants.pop(rid_, None)
+            rid = secrets.token_urlsafe(24)
+            self._grants[rid] = (provider, user_id, refresh_token, now)
+            while len(self._grants) > self.MAX_GRANTS:
+                self._grants.pop(next(iter(self._grants)))
         return rid
 
     def refresh(self, refresh_id: str):
@@ -223,25 +231,43 @@ class OAuthSignin:
         revoked — or a deleted/disabled account — degrades to
         re-authentication, never to a silent session).  Rotates both the
         handle and, when the IdP sends one, the provider refresh token.
-        Returns (user, new_refresh_id)."""
-        entry = self._grants.get(refresh_id)
+        Returns (user, new_refresh_id).
+
+        The handle is SINGLE-USE: popped under the lock before the IdP
+        call, restored only on transient (OAuthUnavailable) outcomes.
+        A concurrent refresh with the same handle finds it gone and
+        degrades to re-authentication — never a double redemption that
+        a rotation-strict IdP would treat as token theft."""
+        with self._grants_mu:
+            entry = self._grants.pop(refresh_id, None)
         if entry is None:
             raise PermissionError("unknown refresh handle; re-authenticate")
         provider, user_id, refresh_token, issued = entry
+
+        def restore(rt: str) -> None:
+            # setdefault: never clobber state a concurrent signin/evict
+            # wrote under this handle while we held the IdP call open.
+            with self._grants_mu:
+                self._grants.setdefault(
+                    refresh_id, (provider, user_id, rt, issued)
+                )
+
         p = self._providers.get(provider)
         if p is None:
-            self._grants.pop(refresh_id, None)
             raise PermissionError(f"provider {provider!r} no longer configured")
-        # May raise OAuthUnavailable — grant INTACT, caller retries.
-        tokens = self._token_request(p, {
-            "refresh_token": refresh_token,
-            "grant_type": "refresh_token",
-        })
+        try:
+            # May raise OAuthUnavailable — grant restored, caller retries.
+            tokens = self._token_request(p, {
+                "refresh_token": refresh_token,
+                "grant_type": "refresh_token",
+            })
+        except OAuthUnavailable:
+            restore(refresh_token)
+            raise
         access = tokens.get("access_token", "")
         if not access:
-            # The IdP rejected (revoked/expired) the grant: destroy it —
-            # the console falls back to the authorize flow.
-            self._grants.pop(refresh_id, None)
+            # The IdP rejected (revoked/expired) the grant: it stays
+            # destroyed — the console falls back to the authorize flow.
             raise PermissionError(
                 "oauth refresh rejected by provider; re-authenticate"
             )
@@ -249,16 +275,34 @@ class OAuthSignin:
         # old handle immediately, so a crash/transport failure below
         # cannot strand the only copy of the rotated token.
         new_rt = tokens.get("refresh_token") or refresh_token
-        self._grants[refresh_id] = (provider, user_id, new_rt, issued)
+        restore(new_rt)
         try:
             user = self._map_profile(p, access)
+        except urllib.error.HTTPError as exc:
+            # HTTPError ⊂ URLError: without this arm a persistent 401/403
+            # from the profile endpoint (access revoked at the IdP while
+            # refresh still mints tokens, or a misconfigured profile_url)
+            # would classify as transient forever — the console looping
+            # 503s instead of degrading to re-authentication.
+            if exc.code in (401, 403):
+                with self._grants_mu:
+                    self._grants.pop(refresh_id, None)
+                raise PermissionError(
+                    f"profile endpoint rejected token (HTTP {exc.code}); "
+                    "re-authenticate"
+                ) from exc
+            raise OAuthUnavailable(
+                f"provider {provider} profile endpoint HTTP {exc.code}"
+            ) from exc
         except (urllib.error.URLError, TimeoutError, OSError) as exc:
             raise OAuthUnavailable(
                 f"provider {provider} unreachable: {exc}"
             ) from exc
         except PermissionError:
-            self._grants.pop(refresh_id, None)  # disabled/unusable account
+            with self._grants_mu:
+                self._grants.pop(refresh_id, None)  # disabled account
             raise
-        self._grants.pop(refresh_id, None)
+        with self._grants_mu:
+            self._grants.pop(refresh_id, None)
         new_rid = self._store_grant(provider, user.id, new_rt)
         return user, new_rid

@@ -30,6 +30,8 @@ import os
 import threading
 from typing import Dict, Optional
 
+from ..utils import faultinject
+
 
 class KVTable:
     """One namespace of JSON documents keyed by string."""
@@ -66,29 +68,35 @@ class StateBackend:
 
 
 class _MemTable(KVTable):
-    def __init__(self) -> None:
+    def __init__(self, ns: str = "") -> None:
+        self._ns = ns
         self._rows: Dict[str, dict] = {}
         self._mu = threading.Lock()
 
     def put(self, key: str, doc: dict) -> None:
+        faultinject.fire(f"state.put.{self._ns}")
         with self._mu:
             self._rows[key] = json.loads(json.dumps(doc))  # force-serializable
 
     def put_many(self, items: Dict[str, dict]) -> None:
+        faultinject.fire(f"state.put.{self._ns}")
         with self._mu:
             for k, v in items.items():
                 self._rows[k] = json.loads(json.dumps(v))
 
     def get(self, key: str) -> Optional[dict]:
+        faultinject.fire(f"state.get.{self._ns}")
         with self._mu:
             row = self._rows.get(key)
             return json.loads(json.dumps(row)) if row is not None else None
 
     def delete(self, key: str) -> None:
+        faultinject.fire(f"state.delete.{self._ns}")
         with self._mu:
             self._rows.pop(key, None)
 
     def load_all(self) -> Dict[str, dict]:
+        faultinject.fire(f"state.load_all.{self._ns}")
         with self._mu:
             return json.loads(json.dumps(self._rows))
 
@@ -101,7 +109,7 @@ class MemoryBackend(StateBackend):
     def table(self, namespace: str) -> KVTable:
         with self._mu:
             if namespace not in self._tables:
-                self._tables[namespace] = _MemTable()
+                self._tables[namespace] = _MemTable(namespace)
             return self._tables[namespace]
 
 
@@ -119,6 +127,9 @@ class _SQLiteTable(KVTable):
         self.put_many({key: doc})
 
     def put_many(self, items: Dict[str, dict]) -> None:
+        # Chaos seam BEFORE the transaction: an injected failure means
+        # the commit never happened — the atomicity contract holds.
+        faultinject.fire(f"state.put.{self._ns}")
         rows = [(self._ns, k, json.dumps(v)) for k, v in items.items()]
         with self._b._mu:
             self._b._conn.executemany(
@@ -128,6 +139,7 @@ class _SQLiteTable(KVTable):
             self._b._conn.commit()
 
     def get(self, key: str) -> Optional[dict]:
+        faultinject.fire(f"state.get.{self._ns}")
         with self._b._mu:
             row = self._b._conn.execute(
                 "SELECT value FROM kv WHERE ns=? AND key=?", (self._ns, key)
@@ -135,6 +147,7 @@ class _SQLiteTable(KVTable):
         return json.loads(row[0]) if row else None
 
     def delete(self, key: str) -> None:
+        faultinject.fire(f"state.delete.{self._ns}")
         with self._b._mu:
             self._b._conn.execute(
                 "DELETE FROM kv WHERE ns=? AND key=?", (self._ns, key)
@@ -142,6 +155,7 @@ class _SQLiteTable(KVTable):
             self._b._conn.commit()
 
     def load_all(self) -> Dict[str, dict]:
+        faultinject.fire(f"state.load_all.{self._ns}")
         with self._b._mu:
             rows = self._b._conn.execute(
                 "SELECT key, value FROM kv WHERE ns=?", (self._ns,)

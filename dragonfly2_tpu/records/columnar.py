@@ -65,14 +65,29 @@ def _header_from_meta(meta: dict) -> ColumnarHeader:
 
 
 def read_header(path: str) -> tuple[ColumnarHeader, int]:
-    """Returns (header, data_offset)."""
+    """Returns (header, data_offset).  Every malformed-prefix shape —
+    short magic, short length word, a header cut off mid-JSON, corrupt
+    JSON — raises ValueError (never struct/json errors or silent
+    garbage): callers distinguish exactly 'bad file' from IO errors."""
     with open(path, "rb") as f:
         magic = f.read(4)
         if magic != MAGIC:
             raise ValueError(f"{path}: bad magic {magic!r}")
-        (hlen,) = struct.unpack(_LEN_FMT, f.read(4))
-        meta = json.loads(f.read(hlen).decode("utf-8"))
-    return _header_from_meta(meta), 8 + hlen
+        raw_len = f.read(4)
+        if len(raw_len) < 4:
+            raise ValueError(f"{path}: truncated header length")
+        (hlen,) = struct.unpack(_LEN_FMT, raw_len)
+        raw = f.read(hlen)
+        if len(raw) < hlen:
+            raise ValueError(
+                f"{path}: truncated header ({len(raw)} of {hlen} bytes)"
+            )
+        try:
+            meta = json.loads(raw.decode("utf-8"))
+            header = _header_from_meta(meta)
+        except (UnicodeDecodeError, json.JSONDecodeError, KeyError, TypeError) as exc:
+            raise ValueError(f"{path}: corrupt header: {exc}") from exc
+    return header, 8 + hlen
 
 
 class ColumnarWriter:

@@ -29,6 +29,16 @@ class ThreadedHTTPService:
             timeout = 60
 
             def handle(self):
+                from ..utils import faultinject
+
+                try:
+                    # Server-side chaos seam: a drop/dferror here kills
+                    # the connection before any request is served — the
+                    # client sees a reset, exactly like a dying server.
+                    faultinject.fire(f"rpc.server.{name}")
+                except Exception:  # noqa: BLE001 — injected
+                    self.close_connection = True
+                    return
                 try:
                     super().handle()
                 except (ssl.SSLError, ConnectionError, TimeoutError):

@@ -429,8 +429,10 @@ class GRPCRemoteScheduler(RemoteScheduler):
         msg = dict_to_proto(req, req_cls)
 
         def once():
+            from ..utils import faultinject
             from ..utils.tracing import default_tracer
 
+            faultinject.fire(f"grpc.client.{method}")
             metadata = tuple(default_tracer.inject().items()) or None
             try:
                 return self._stubs[method](
@@ -1047,6 +1049,9 @@ class GRPCRemoteRegistry:
         )
 
         def once():
+            from ..utils import faultinject
+
+            faultinject.fire(f"grpc.manager.{name}")
             try:
                 return self._stubs[name](
                     msg, timeout=self.timeout, metadata=metadata

@@ -62,8 +62,13 @@ class PieceHTTPServer:
                 parts = split.path.strip("/").split("/")
                 try:
                     if len(parts) == 3 and parts[0] == "pieces":
+                        from ..utils import faultinject
+
                         task_id, number = parts[1], int(parts[2])
                         data = upload_ref.serve_piece(task_id, number)
+                        # Torn-body seam: a truncate fault serves a SHORT
+                        # 200 — the client's length check must catch it.
+                        data = faultinject.fire("piece.server.body", data)
                         self._send(200, data)
                         return
                     if len(parts) == 3 and parts[0] == "tasks" and parts[2] == "pieces":
@@ -254,9 +259,25 @@ class HTTPPieceFetcher:
         timeout: float = 30.0,
         metadata_timeout: float = 2.0,
         ssl_context=None,
+        breaker_threshold: int = 6,
+        breaker_reset_s: float = 2.0,
     ):
         self._resolve = resolve
         self.timeout = timeout
+        # Per-parent circuit breakers: a dead parent's piece port fails
+        # fast after `breaker_threshold` consecutive connect failures
+        # instead of burning a connect timeout per piece — the conductor
+        # sees the fast ConnectionError and reschedules immediately.
+        # breaker_threshold=0 disables.
+        import threading
+
+        from .retry import CircuitBreaker
+
+        self._breaker_mu = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._breaker_threshold = breaker_threshold
+        self._breaker_reset_s = breaker_reset_s
+        self._breaker_cls = CircuitBreaker
         # Bitmap queries are a pre-fetch optimization — a blackholed parent
         # must not stall the download for the full piece timeout.
         self.metadata_timeout = metadata_timeout
@@ -265,7 +286,22 @@ class HTTPPieceFetcher:
         self.ssl_context = ssl_context
         self._scheme = "https" if ssl_context is not None else "http"
 
+    def _breaker(self, parent_host_id: str):
+        if not self._breaker_threshold:
+            return None
+        with self._breaker_mu:
+            b = self._breakers.get(parent_host_id)
+            if b is None:
+                b = self._breaker_cls(
+                    failure_threshold=self._breaker_threshold,
+                    reset_timeout_s=self._breaker_reset_s,
+                )
+                self._breakers[parent_host_id] = b
+            return b
+
     def fetch(self, parent_host_id: str, task_id: str, number: int) -> bytes:
+        from ..utils import faultinject
+
         ip, port = self._resolve(parent_host_id)
         url = f"{self._scheme}://{ip}:{port}/pieces/{task_id}/{number}"
 
@@ -273,11 +309,12 @@ class HTTPPieceFetcher:
             pass
 
         def once() -> bytes:
+            faultinject.fire("piece.fetch")
             try:
                 with urllib.request.urlopen(
                     url, timeout=self.timeout, context=self.ssl_context
                 ) as resp:
-                    return resp.read()
+                    return faultinject.fire("piece.fetch.body", resp.read())
             except urllib.error.HTTPError as exc:
                 if exc.code == 503:
                     raise ConnectionError("parent busy") from exc  # retried
@@ -286,7 +323,10 @@ class HTTPPieceFetcher:
                 # subclass, which retry_call's default would retry).
                 raise _PieceUnavailable(f"HTTP {exc.code} from {url}") from exc
 
-        return retry_call(once, attempts=2, retry_on=(ConnectionError, TimeoutError))
+        return retry_call(
+            once, attempts=2, retry_on=(ConnectionError, TimeoutError),
+            breaker=self._breaker(parent_host_id),
+        )
 
     def piece_bitmap(self, parent_host_id: str, task_id: str):
         """Which pieces the parent holds (None when unknown/unreachable)."""

@@ -1,33 +1,188 @@
-"""Retry with exponential backoff (reference: pkg/retry + the rpc clients'
-retry interceptors, pkg/rpc/interceptor.go)."""
+"""Retry with bounded exponential backoff, full jitter, per-attempt
+deadlines, and a circuit breaker (reference: pkg/retry + the rpc
+clients' retry interceptors, pkg/rpc/interceptor.go).
+
+Backoff is AWS-style FULL jitter: attempt i sleeps uniform(0,
+min(base·2^i, max_delay)).  ``deadline_s`` bounds the WHOLE call
+(attempts + sleeps); a callable that accepts a ``deadline_s`` kwarg
+receives the remaining budget each attempt so the transport can clamp
+its own timeout to what's left (deadline propagation) instead of
+overshooting the caller's budget on the last attempt.
+
+``CircuitBreaker`` guards a repeatedly-failing dependency (a dead
+parent's piece port, an unreachable manager backend): after
+``failure_threshold`` consecutive failures the circuit OPENS and calls
+fail fast with ``CircuitOpenError`` (no connect timeout burned per
+call) until ``reset_timeout_s`` passes, when ONE half-open probe is let
+through — success closes the circuit, failure re-opens it.
+"""
 
 from __future__ import annotations
 
 import random
+import threading
 import time
-from typing import Callable, Tuple, Type, TypeVar
+from typing import Callable, Optional, Tuple, Type, TypeVar
 
 T = TypeVar("T")
 
 
+class RetryBudgetExceeded(TimeoutError):
+    """The overall ``deadline_s`` expired before an attempt succeeded."""
+
+
+class CircuitOpenError(ConnectionError):
+    """Fast-fail: the breaker is OPEN for this dependency."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open recovery.
+
+    States: ``closed`` (calls flow; failures count), ``open`` (calls
+    fail fast until ``reset_timeout_s`` since the trip), ``half_open``
+    (one probe in flight; its outcome decides).  Thread-safe; the clock
+    is injectable so tests drive recovery without sleeping.
+    """
+
+    def __init__(
+        self,
+        *,
+        failure_threshold: int = 5,
+        reset_timeout_s: float = 2.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_timeout_s = reset_timeout_s
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._failures = 0
+        self._state = "closed"
+        self._opened_at = 0.0
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call proceed right now?  An allowed call while OPEN
+        transitions to HALF_OPEN (that call is the recovery probe)."""
+        with self._mu:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if self._clock() - self._opened_at >= self.reset_timeout_s:
+                    self._state = "half_open"
+                    return True
+                return False
+            # half_open: one probe at a time — concurrent callers wait
+            # out the probe as if still open.
+            return False
+
+    def record_success(self) -> None:
+        with self._mu:
+            self._failures = 0
+            self._state = "closed"
+
+    def record_failure(self) -> None:
+        with self._mu:
+            self._failures += 1
+            if self._state == "half_open" or (
+                self._failures >= self.failure_threshold
+            ):
+                self._state = "open"
+                self._opened_at = self._clock()
+
+
+def _accepts_deadline(fn) -> bool:
+    """True when ``fn`` takes a ``deadline_s`` kwarg — inspected once and
+    cached on the callable (source/client._accepts_headers pattern)."""
+    try:
+        cached = fn.__dict__.get("_df_accepts_deadline")
+    except AttributeError:
+        cached = None
+    if cached is not None:
+        return cached
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+        ok = "deadline_s" in sig.parameters or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values()
+        )
+    except (ValueError, TypeError):
+        ok = False
+    try:
+        fn.__dict__["_df_accepts_deadline"] = ok
+    except AttributeError:
+        pass
+    return ok
+
+
 def retry_call(
-    fn: Callable[[], T],
+    fn: Callable[..., T],
     *,
     attempts: int = 3,
     base_delay: float = 0.1,
     max_delay: float = 2.0,
     retry_on: Tuple[Type[BaseException], ...] = (ConnectionError, TimeoutError, OSError),
     sleep: Callable[[float], None] = time.sleep,
+    deadline_s: Optional[float] = None,
+    breaker: Optional[CircuitBreaker] = None,
+    rng: Optional[random.Random] = None,
+    clock: Callable[[], float] = time.monotonic,
 ) -> T:
+    """Call ``fn`` with bounded, fully-jittered exponential backoff.
+
+    - ``deadline_s``: overall budget.  Attempts stop (RetryBudgetExceeded,
+      chained to the last failure) once it's spent, and a deadline-aware
+      ``fn`` receives the remaining budget via ``deadline_s=``.
+    - ``breaker``: consulted before every attempt (CircuitOpenError when
+      open) and told each outcome.
+    - ``rng``: injectable jitter source — pass a seeded ``random.Random``
+      for deterministic schedules (chaos drills replay exact timings).
+    """
+    rand = rng.uniform if rng is not None else random.uniform
+    pass_deadline = deadline_s is not None and _accepts_deadline(fn)
+    start = clock()
     last: BaseException | None = None
     for i in range(attempts):
+        if deadline_s is not None:
+            remaining = deadline_s - (clock() - start)
+            if remaining <= 0:
+                exc = RetryBudgetExceeded(
+                    f"retry budget {deadline_s}s spent after {i} attempts"
+                )
+                if last is not None:
+                    raise exc from last
+                raise exc
+        if breaker is not None and not breaker.allow():
+            exc = CircuitOpenError("circuit open; failing fast")
+            if last is not None:
+                raise exc from last
+            raise exc
         try:
-            return fn()
+            if pass_deadline:
+                out = fn(deadline_s=max(deadline_s - (clock() - start), 0.0))
+            else:
+                out = fn()
         except retry_on as exc:  # noqa: PERF203
+            if breaker is not None:
+                breaker.record_failure()
             last = exc
             if i == attempts - 1:
                 break
-            delay = min(base_delay * (2**i), max_delay)
-            sleep(delay * (0.5 + random.random() / 2))  # jitter
+            delay = rand(0.0, min(base_delay * (2**i), max_delay))
+            if deadline_s is not None:
+                # Never sleep past the budget — the NEXT attempt should
+                # get a chance (or the budget check should fire), not a
+                # sleep that silently overshoots the caller's deadline.
+                delay = min(delay, max(deadline_s - (clock() - start), 0.0))
+            sleep(delay)
+        else:
+            if breaker is not None:
+                breaker.record_success()
+            return out
     assert last is not None
     raise last

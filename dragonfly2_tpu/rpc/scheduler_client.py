@@ -65,7 +65,13 @@ class RemoteScheduler:
 
     def _call(self, method: str, req: dict) -> dict:
         def once() -> dict:
+            from ..utils import faultinject
             from ..utils.tracing import default_tracer
+
+            # Chaos seam: drop/delay/typed-error per call site, fired
+            # INSIDE the retried attempt so injected faults exercise the
+            # same retry machinery real transport failures do.
+            faultinject.fire(f"rpc.client.{method}")
 
             body = json.dumps(req).encode()
             # Trace propagation (otelgrpc client-interceptor analog): the

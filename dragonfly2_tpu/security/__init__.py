@@ -11,6 +11,15 @@ schedulers/daemons use for auto-provisioned mTLS, scheduler/scheduler.go:186-222
   the HTTP servers/clients for mutual TLS.
 """
 
-from .ca import CertificateAuthority, PeerIdentity  # noqa: F401
 from .tokens import Role, TokenIssuer, TokenVerifier  # noqa: F401
-from .tls import client_context, server_context  # noqa: F401
+
+try:  # pragma: no cover - environment-dependent
+    from .ca import CertificateAuthority, PeerIdentity  # noqa: F401
+    from .tls import client_context, server_context  # noqa: F401
+except ImportError:  # `cryptography` absent: token auth (and everything
+    # that merely imports the manager package) must keep working — only
+    # the mTLS/CA surface itself is gated off.  Callers that configure
+    # auto-issue get the ImportError at use, not at import of unrelated
+    # modules.
+    CertificateAuthority = PeerIdentity = None  # type: ignore[assignment]
+    client_context = server_context = None  # type: ignore[assignment]

@@ -10,3 +10,13 @@ the reference never closed, deterministically and without sockets.
 """
 
 from .swarm import SwarmSimulator, SwarmConfig  # noqa: F401
+from .chaos import (  # noqa: F401
+    ChaosProcess,
+    ChaosScenario,
+    crash_at,
+    drop_storm,
+    replay_history,
+    sha256_hex,
+    task_digest,
+    wait_until,
+)

@@ -220,8 +220,14 @@ class PieceSourceFetcher:
         self, url: str, number: int, piece_size: int,
         headers: Optional[dict] = None,
     ) -> bytes:
+        from ..utils import faultinject
+
+        # Back-to-source chaos seam: every origin scheme funnels through
+        # here, so one site covers http/s3/oss/oci/hdfs/file alike.
+        faultinject.fire("source.fetch")
         client = self.registry.client_for(url)
-        return call_with_optional_headers(
+        data = call_with_optional_headers(
             client.read_range, url, number * piece_size, piece_size,
             headers=headers,
         )
+        return faultinject.fire("source.fetch.body", data)

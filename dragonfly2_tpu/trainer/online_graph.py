@@ -1019,6 +1019,12 @@ class OnlineGraphTrainer:
             block = self._next_dispatch_block(timeout=idle_timeout)
             if block is None:
                 break
+            # Chaos seam: the trainer-crash drill SIGKILLs here at a
+            # deterministic dispatch index — after the previous
+            # checkpoint committed, before this block trains.
+            from ..utils import faultinject
+
+            faultinject.fire("trainer.dispatch")
             self.apply_pending_recycles()
             es, ed, y = block
             self.state, loss = self._dispatch_fn(
@@ -1149,8 +1155,14 @@ class OnlineGraphTrainer:
         ckptr = ocp.StandardCheckpointer()
         abstract = self._payload()
         # Window length varies run to run — restore against the saved
-        # shapes, not the current ones.
-        meta = ckptr.metadata(self._ckpt_path()).item_metadata.tree
+        # shapes, not the current ones.  Orbax's metadata() return shape
+        # differs across versions: older releases hand back the tree
+        # dict directly, newer ones wrap it in CheckpointMetadata with
+        # .item_metadata.tree — accept both (the trainer-crash chaos
+        # drill runs resume in whatever orbax the image bakes in).
+        meta = ckptr.metadata(self._ckpt_path())
+        if not isinstance(meta, dict):
+            meta = meta.item_metadata.tree
         for k in (
             "window_src", "window_dst", "window_rtt",
             "pending_src", "pending_dst", "pending_rtt",

@@ -1,0 +1,884 @@
+"""Chaos e2e drills + fault-injection layer contract (ISSUE 1).
+
+The reference proves resilience with e2e drills (test/e2e inside kind);
+these are the failure-mode analogs against REAL processes and the real
+wire, all driven by the deterministic fault layer (utils/faultinject +
+sim/chaos):
+
+- determinism: same scenario seed ⇒ byte-identical fault sequence;
+- retry hardening: full jitter, per-attempt deadline propagation,
+  circuit breaker give-up/half-open recovery;
+- drill 1 — scheduler SIGKILLed mid-download: the late peer finishes
+  through pex gossip fallback, digest verified;
+- drill 2 — manager SIGKILLed: dynconfig's disk cache keeps the
+  scheduler scheduling with the manager's cluster limits;
+- drill 3 — daemon SIGKILLed mid-upload: its children reschedule onto
+  the surviving parent, digest verified;
+- drill 4 — trainer SIGKILLed mid-online-ingest (self-inflicted at a
+  deterministic dispatch): orbax resume continues exactly-once — no
+  duplicate, no lost records;
+- truncation: injected torn piece bodies NEVER commit (length guard →
+  refetch), digest verified;
+- satellites: bench backend-init failure JSON, OAuth refresh race +
+  HTTPError classification, job results that don't serialize.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dragonfly2_tpu.rpc.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryBudgetExceeded,
+    retry_call,
+)
+from dragonfly2_tpu.sim.chaos import (
+    ChaosProcess,
+    ChaosScenario,
+    crash_at,
+    drop_storm,
+    free_port,
+    replay_history,
+    sha256_hex,
+    wait_until,
+)
+from dragonfly2_tpu.utils import faultinject
+from dragonfly2_tpu.utils.faultinject import FaultInjected, FaultSpec
+
+PIECE = 64 * 1024
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faultinject.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# Fault layer contract
+# ---------------------------------------------------------------------------
+
+
+class TestFaultLayerDeterminism:
+    def _drive(self, inj):
+        for _ in range(60):
+            for site in ("rpc.client.register_peer", "piece.fetch",
+                         "state.put.jobs"):
+                try:
+                    inj.fire(site)
+                except Exception:  # noqa: BLE001 — injected
+                    pass
+
+    def test_same_seed_same_fault_sequence(self):
+        sc = ChaosScenario(seed=7, faults=[
+            FaultSpec(site="rpc.client.*", kind="drop", probability=0.3),
+            FaultSpec(site="piece.*", kind="dferror", probability=0.2),
+            FaultSpec(site="state.put.*", kind="drop", probability=0.1),
+        ])
+        h1 = replay_history(sc, self._drive)
+        h2 = replay_history(sc, self._drive)
+        assert h1 and h1 == h2
+        h3 = replay_history(
+            ChaosScenario(seed=8, faults=list(sc.faults)), self._drive
+        )
+        assert h3 != h1
+
+    def test_explicit_indices_modulus_and_caps(self):
+        inj = ChaosScenario(faults=[
+            FaultSpec(site="a", kind="drop", at=(1, 3)),
+            FaultSpec(site="b", kind="drop", every=2, max_fires=2),
+        ]).injector()
+        outcomes = []
+        for _ in range(5):
+            try:
+                inj.fire("a")
+                outcomes.append("ok")
+            except FaultInjected:
+                outcomes.append("drop")
+        assert outcomes == ["ok", "drop", "ok", "drop", "ok"]
+        dropped = 0
+        for _ in range(8):
+            try:
+                inj.fire("b")
+            except FaultInjected:
+                dropped += 1
+        assert dropped == 2  # every=2 would fire 4×; max_fires caps at 2
+
+    def test_typed_dferror_and_truncate_and_env(self):
+        from dragonfly2_tpu.utils.dferrors import Code, DfError, UnavailableError
+
+        sc = ChaosScenario(seed=3, faults=[
+            FaultSpec(site="rpc.*", kind="dferror", at=(0,), code=14),
+            FaultSpec(site="rpc.*", kind="dferror", at=(1,),
+                      code=int(Code.NOT_FOUND)),
+            FaultSpec(site="*.body", kind="truncate", at=(0,), keep_bytes=2),
+        ])
+        inj = faultinject.install_from_env({faultinject.ENV_VAR: sc.to_json()})
+        try:
+            with pytest.raises(UnavailableError):
+                inj.fire("rpc.client.x")
+            with pytest.raises(DfError) as ei:
+                inj.fire("rpc.client.x")
+            assert ei.value.code is Code.NOT_FOUND
+            assert inj.fire("piece.fetch.body", b"abcdef") == b"ab"
+            assert inj.fire("piece.fetch.body", b"abcdef") == b"abcdef"
+        finally:
+            faultinject.uninstall()
+
+    def test_crash_kind_uses_kill_hook(self):
+        killed = []
+        inj = faultinject.FaultInjector(
+            [FaultSpec(site="trainer.dispatch", kind="crash", at=(2,))],
+            kill=lambda: killed.append(True),
+        )
+        for _ in range(4):
+            inj.fire("trainer.dispatch")
+        assert killed == [True]
+        assert [k[:3] for k in inj.history_keys()] == [
+            ("trainer.dispatch", 2, "crash")
+        ]
+
+    def test_delay_uses_sleep_hook_and_uninstalled_is_noop(self):
+        slept = []
+        inj = faultinject.FaultInjector(
+            [FaultSpec(site="s", kind="delay", at=(0,), delay_s=1.5)],
+            sleep=slept.append,
+        )
+        inj.fire("s")
+        assert slept == [1.5]
+        # No injector installed: fire is a passthrough.
+        assert faultinject.fire("anything", b"xy") == b"xy"
+
+
+# ---------------------------------------------------------------------------
+# Retry hardening (ISSUE acceptance: give-up, half-open, deadlines)
+# ---------------------------------------------------------------------------
+
+
+class TestRetryHardening:
+    def test_gives_up_after_attempts_with_last_error(self):
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            retry_call(dead, attempts=4, sleep=lambda s: None)
+        assert len(calls) == 4
+
+    def test_full_jitter_bounded_by_exponential_envelope(self):
+        import random
+
+        delays = []
+
+        def flaky():
+            raise TimeoutError("t")
+
+        with pytest.raises(TimeoutError):
+            retry_call(
+                flaky, attempts=5, base_delay=0.1, max_delay=0.6,
+                sleep=delays.append, rng=random.Random(0),
+            )
+        assert len(delays) == 4
+        for i, d in enumerate(delays):
+            assert 0.0 <= d <= min(0.1 * 2**i, 0.6)
+        # Deterministic with a seeded rng: replay gives the same schedule.
+        delays2 = []
+        with pytest.raises(TimeoutError):
+            retry_call(
+                flaky, attempts=5, base_delay=0.1, max_delay=0.6,
+                sleep=delays2.append, rng=random.Random(0),
+            )
+        assert delays == delays2
+
+    def test_budget_exceeded_raises_chained(self):
+        clock = [0.0]
+
+        def tick_sleep(s):
+            clock[0] += s
+
+        def dead():
+            clock[0] += 0.4
+            raise ConnectionError("down")
+
+        with pytest.raises(RetryBudgetExceeded) as ei:
+            retry_call(
+                dead, attempts=50, base_delay=0.4, max_delay=0.4,
+                deadline_s=1.0, sleep=tick_sleep, clock=lambda: clock[0],
+            )
+        assert isinstance(ei.value.__cause__, ConnectionError)
+
+    def test_deadline_propagates_remaining_budget(self):
+        clock = [0.0]
+        seen = []
+
+        def fn(deadline_s=None):
+            seen.append(round(deadline_s, 6))
+            clock[0] += 0.25
+            raise TimeoutError("t")
+
+        with pytest.raises((TimeoutError, RetryBudgetExceeded)):
+            retry_call(
+                fn, attempts=10, base_delay=0.0, deadline_s=1.0,
+                sleep=lambda s: None, clock=lambda: clock[0],
+            )
+        # Each attempt saw the SHRINKING remainder, never the full budget
+        # again — the transport can clamp its socket timeout to it.
+        assert seen[0] == 1.0
+        assert all(seen[i] > seen[i + 1] for i in range(len(seen) - 1))
+        assert all(0 <= s <= 1.0 for s in seen)
+
+    def test_breaker_opens_then_half_open_recovers(self):
+        clock = [0.0]
+        b = CircuitBreaker(
+            failure_threshold=3, reset_timeout_s=5.0, clock=lambda: clock[0]
+        )
+        for _ in range(3):
+            assert b.allow()
+            b.record_failure()
+        assert b.state == "open"
+        # Open: fail fast, no call attempted.
+        calls = []
+
+        def fn():
+            calls.append(1)
+            return "ok"
+
+        with pytest.raises(CircuitOpenError):
+            retry_call(fn, attempts=3, sleep=lambda s: None, breaker=b)
+        assert calls == []
+        # Reset window passes → HALF-OPEN probe; success closes.
+        clock[0] += 5.0
+        assert retry_call(fn, attempts=1, breaker=b) == "ok"
+        assert b.state == "closed" and calls == [1]
+
+    def test_half_open_failure_reopens(self):
+        clock = [0.0]
+        b = CircuitBreaker(
+            failure_threshold=1, reset_timeout_s=5.0, clock=lambda: clock[0]
+        )
+        b.record_failure()
+        assert b.state == "open"
+        clock[0] += 5.0
+        assert b.allow()  # the probe
+        b.record_failure()
+        assert b.state == "open"  # single probe failure re-trips
+        assert not b.allow()
+
+
+# ---------------------------------------------------------------------------
+# Truncation: no silent corruption (in-process swarm, injected torn body)
+# ---------------------------------------------------------------------------
+
+
+class TestTruncationNoSilentCorruption:
+    def test_torn_piece_body_refetched_digest_intact(self, tmp_path):
+        from dragonfly2_tpu.daemon import Daemon
+        from dragonfly2_tpu.daemon.pex import GossipBus
+        from dragonfly2_tpu.scheduler import (
+            Evaluator,
+            NetworkTopology,
+            Resource,
+            SchedulerService,
+            Scheduling,
+            SchedulingConfig,
+        )
+        from dragonfly2_tpu.scheduler.resource import Host
+
+        resource = Resource()
+        service = SchedulerService(
+            resource,
+            Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+            None,
+            NetworkTopology(resource.host_manager),
+        )
+
+        class Origin:
+            def fetch(self, url, number, piece_size):
+                return bytes((number + i) % 251 for i in range(PIECE))
+
+        registry, bus = {}, GossipBus()
+        daemons = []
+        for i in range(3):
+            h = Host(id=f"tr-host-{i}", hostname=f"tr{i}", ip=f"10.9.0.{i}",
+                     port=8002, download_port=8001)
+            h.stats.network.idc = "idc-a"
+            resource.store_host(h)
+            daemons.append(Daemon(
+                h, service, storage_root=str(tmp_path / f"d{i}"),
+                daemon_registry=registry, gossip_bus=bus,
+                # The child (d2) has NO origin: it can only finish P2P.
+                source_fetcher=Origin() if i < 2 else None,
+                prefer_native=False,
+            ))
+        url = "https://origin/torn-blob"
+        r0 = daemons[0].download(url, piece_size=PIECE, content_length=4 * PIECE)
+        r1 = daemons[1].download(url, piece_size=PIECE, content_length=4 * PIECE)
+        assert r0.ok and r1.ok
+        want = sha256_hex(daemons[0].read_task_bytes(r0.task_id))
+
+        # Child downloads P2P with the serving parent's upload body TORN
+        # once on the first serve: the length guard must detect it, count
+        # a failure, and refetch/reschedule — never commit a short body.
+        scenario = ChaosScenario(faults=[
+            FaultSpec(site="daemon.upload.body", kind="truncate",
+                      at=(0,), keep_bytes=100),
+        ])
+        with faultinject.installed(scenario.injector()):
+            r2 = daemons[2].download(
+                url, piece_size=PIECE, content_length=4 * PIECE
+            )
+        assert r2.ok and not r2.back_to_source
+        assert sha256_hex(daemons[2].read_task_bytes(r2.task_id)) == want
+        assert r2.failed_pieces >= 1  # the torn body surfaced as a failure
+
+
+# ---------------------------------------------------------------------------
+# Drill 1 — scheduler SIGKILL mid-download → pex fallback, digest verified
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerKillDrill:
+    def test_peer_finishes_via_pex_after_scheduler_sigkill(self, tmp_path):
+        from dragonfly2_tpu.daemon import Daemon
+        from dragonfly2_tpu.daemon.pex import GossipBus
+        from dragonfly2_tpu.rpc import RemoteScheduler
+        from dragonfly2_tpu.scheduler.resource import Host
+        from dragonfly2_tpu.utils import idgen
+
+        cfg = tmp_path / "sched.yaml"
+        cfg.write_text(
+            "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
+            "scheduling: {retry_interval_s: 0.0}\n"
+            f"storage: {{dir: {tmp_path / 'records'}, buffer_size: 1}}\n"
+        )
+        sched = ChaosProcess(
+            ["-m", "dragonfly2_tpu.cli.scheduler", "--config", str(cfg)],
+            ready_prefixes=["scheduler: serving"],
+        ).start()
+        try:
+            line = sched.wait_ready(60)["scheduler: serving"]
+            sched_url = re.search(r"rpc on (\S+)", line).group(1)
+
+            class Origin:
+                def fetch(self, url, number, piece_size):
+                    return bytes((number * 7 + i) % 251 for i in range(PIECE))
+
+            registry, bus = {}, GossipBus()
+
+            def make_daemon(i, source):
+                h = Host(id=f"ck-host-{i}", hostname=f"ck{i}",
+                         ip=f"10.8.0.{i}", port=8002, download_port=8001)
+                h.stats.network.idc = "idc-a"
+                return Daemon(
+                    h, RemoteScheduler(sched_url, timeout=2.0),
+                    storage_root=str(tmp_path / f"ck{i}"),
+                    daemon_registry=registry, gossip_bus=bus,
+                    source_fetcher=source, prefer_native=False,
+                )
+
+            a = make_daemon(0, Origin())
+            b = make_daemon(1, None)  # no origin: pex is its ONLY fallback
+
+            url = "https://origin/chaos-blob"
+            tid = idgen.task_id(url)
+            r0 = a.download(url, piece_size=PIECE, content_length=4 * PIECE)
+            assert r0.ok
+            want = sha256_hex(a.read_task_bytes(tid))
+
+            # B's download starts CONCURRENTLY; its first scheduler RPC
+            # (announce, site index 1 — A consumed index 0) is delayed by
+            # the injector, and the scheduler is SIGKILLed inside that
+            # window: a mid-download control-plane death, deterministic.
+            scenario = ChaosScenario(faults=[
+                FaultSpec(site="rpc.client.announce_host", kind="delay",
+                          at=(1,), delay_s=0.6),
+            ])
+            result = {}
+
+            def download_b():
+                result["r"] = b.download(
+                    url, piece_size=PIECE, content_length=4 * PIECE
+                )
+
+            with faultinject.installed(scenario.injector()):
+                t = threading.Thread(target=download_b)
+                t.start()
+                time.sleep(0.1)  # inside B's injected delay window
+                sched.sigkill()
+                assert sched.proc.returncode == -9
+                t.join(timeout=60)
+            assert not t.is_alive(), "download hung after scheduler kill"
+            r1 = result["r"]
+            # Control plane dead → gossip-discovered holder served it.
+            assert r1.ok, r1
+            assert sha256_hex(b.read_task_bytes(tid)) == want
+        finally:
+            sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# Drill 2 — manager SIGKILL → dynconfig disk fallback keeps scheduling
+# ---------------------------------------------------------------------------
+
+
+class TestManagerKillDrill:
+    def test_dynconfig_disk_fallback_keeps_scheduling(self, tmp_path):
+        from dragonfly2_tpu.manager.dynconfig import Dynconfig
+        from dragonfly2_tpu.records.storage import Storage
+        from dragonfly2_tpu.sim import SwarmConfig, SwarmSimulator
+
+        port = free_port()
+        cfg = tmp_path / "manager.yaml"
+        cfg.write_text(
+            f"server: {{host: 127.0.0.1, port: {port}, grpc_port: -1}}\n"
+            f"registry: {{blob_dir: {tmp_path / 'mgr'}}}\n"
+        )
+        mgr = ChaosProcess(
+            ["-m", "dragonfly2_tpu.cli.manager", "--config", str(cfg)],
+            ready_prefixes=["manager: serving"],
+        ).start()
+        url = f"http://127.0.0.1:{port}"
+        cache_path = str(tmp_path / "dynconfig-cache.json")
+
+        def fetch():
+            with urllib.request.urlopen(
+                url + "/api/v1/clusters/c1:config", timeout=5
+            ) as r:
+                return json.loads(r.read())
+
+        try:
+            mgr.wait_ready(60)
+            body = json.dumps({
+                "id": "c1", "name": "c1",
+                "scheduler_cluster_config": {"candidate_parent_limit": 2,
+                                             "filter_parent_limit": 10},
+            }).encode()
+            req = urllib.request.Request(
+                url + "/api/v1/clusters", data=body,
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10):
+                pass
+            # A running client fetched once (writing the disk cache)...
+            dyn0 = Dynconfig(fetch, cache_path=cache_path)
+            assert dyn0.refresh() is True
+
+            # ...then the manager dies.
+            mgr.sigkill()
+            with pytest.raises((urllib.error.URLError, ConnectionError)):
+                fetch()
+
+            # A RESTARTED scheduler's dynconfig (fresh instance, no
+            # memory) has only the disk cache — which must still apply
+            # the manager's cluster limits to live scheduling.
+            sim = SwarmSimulator(
+                Storage(str(tmp_path / "rec"), buffer_size=4),
+                config=SwarmConfig(num_hosts=12, seed=3),
+            )
+            assert sim.scheduling.config.candidate_parent_limit == 4
+
+            applied = []
+
+            def observer(data):
+                limit = data["scheduler_cluster_config"]["candidate_parent_limit"]
+                sim.scheduling.config.candidate_parent_limit = limit
+                applied.append(limit)
+
+            dyn1 = Dynconfig(fetch, cache_path=cache_path)
+            dyn1.register(observer)
+            assert dyn1.refresh() is False  # fetch failed — disk fallback
+            assert applied == [2]
+            assert dyn1.get()["scheduler_cluster_config"][
+                "candidate_parent_limit"] == 2
+
+            # Scheduling CONTINUES under the cached config: a fresh child
+            # gets parents, capped at the manager-set limit.
+            url_task = "https://origin.example.com/mgr-drill"
+            sim.seed_task(url_task, n_seeds=5)
+            reg = sim.service.register_peer(host=sim.hosts[7], url=url_task)
+            assert reg.schedule is not None and reg.schedule.parents
+            assert 1 <= len(reg.schedule.parents) <= 2
+        finally:
+            mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# Drill 3 — daemon SIGKILL mid-upload → children reschedule, digest verified
+# ---------------------------------------------------------------------------
+
+
+class TestDaemonKillMidUploadDrill:
+    def test_children_reschedule_onto_surviving_parent(self, tmp_path):
+        from dragonfly2_tpu.daemon import DaemonStorage
+        from dragonfly2_tpu.daemon.conductor import Conductor
+        from dragonfly2_tpu.records.storage import Storage
+        from dragonfly2_tpu.rpc import HTTPPieceFetcher, RemoteScheduler
+        from dragonfly2_tpu.rpc.daemon_control import (
+            download_via_daemon,
+            read_state,
+        )
+        from dragonfly2_tpu.rpc.scheduler_server import SchedulerHTTPServer
+        from dragonfly2_tpu.scheduler import (
+            Evaluator,
+            NetworkTopology,
+            Resource,
+            SchedulerService,
+            Scheduling,
+            SchedulingConfig,
+        )
+        from dragonfly2_tpu.scheduler.resource import Host
+        from dragonfly2_tpu.utils import idgen
+
+        # Control plane IN-PROCESS (it must survive the daemon kill and
+        # is where we watch rescheduling happen); parents are REAL
+        # dfdaemon processes serving the piece plane over HTTP.
+        resource = Resource()
+        service = SchedulerService(
+            resource,
+            Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+            Storage(str(tmp_path / "records"), buffer_size=4),
+            NetworkTopology(resource.host_manager),
+        )
+        server = SchedulerHTTPServer(service)
+        server.serve()
+
+        blob = bytes(i % 249 for i in range(8 * PIECE))
+        blob_path = tmp_path / "blob.bin"
+        blob_path.write_bytes(blob)
+        url = f"file://{blob_path}"
+        tid = idgen.task_id(url)
+
+        daemons = []
+        try:
+            for i in range(2):
+                dcfg = tmp_path / f"daemon{i}.yaml"
+                dcfg.write_text(
+                    "server: {host: 127.0.0.1, port: 0, "
+                    "advertise_ip: 127.0.0.1}\n"
+                    f"storage: {{dir: {tmp_path / f'dstore{i}'}}}\n"
+                    f"piece_size: {PIECE}\n"
+                )
+                d = ChaosProcess(
+                    ["-m", "dragonfly2_tpu.cli.dfdaemon",
+                     "--scheduler", server.url, "--config", str(dcfg)],
+                    ready_prefixes=["dfdaemon: serving"],
+                    env={**__import__("os").environ,
+                         "DF_DAEMON_STATE": str(tmp_path / f"d{i}.json")},
+                ).start()
+                daemons.append(d)
+            for i, d in enumerate(daemons):
+                d.wait_ready(90)
+                control = read_state(str(tmp_path / f"d{i}.json"))["url"]
+                r = download_via_daemon(url, control)
+                assert r["ok"], r
+
+            # The child: in-process conductor on the wire, no source
+            # fetcher — it can ONLY finish from surviving parents.
+            child_host = Host(id="chaos-child", hostname="cc",
+                              ip="127.0.0.1", port=8002, download_port=1)
+            child_host.stats.network.idc = "idc-a"
+            client = RemoteScheduler(server.url, timeout=3.0)
+            storage = DaemonStorage(
+                str(tmp_path / "childstore"), prefer_native=False
+            )
+            conductor = Conductor(
+                child_host, storage, client,
+                piece_fetcher=HTTPPieceFetcher(
+                    client.resolve_host, timeout=3.0
+                ),
+                source_fetcher=None,
+                max_piece_retries=8,
+                piece_wait_timeout_s=20.0,
+            )
+
+            # Pace the child's fetches so the kill lands mid-download.
+            scenario = ChaosScenario(faults=[
+                FaultSpec(site="piece.fetch", kind="delay", every=1,
+                          delay_s=0.15),
+            ])
+            result = {}
+
+            def run_child():
+                result["r"] = conductor.download(
+                    url, piece_size=PIECE, content_length=len(blob)
+                )
+
+            with faultinject.installed(scenario.injector()):
+                t = threading.Thread(target=run_child)
+                t.start()
+                # Mid-upload: the child has committed ≥1 piece and the
+                # swarm is still serving it when parent 0 dies.
+                wait_until(
+                    lambda: storage.held_pieces(tid) >= 1,
+                    timeout=60, desc="first piece committed",
+                )
+                daemons[0].sigkill()
+                assert daemons[0].proc.returncode == -9
+                t.join(timeout=120)
+            assert not t.is_alive(), "child hung after parent kill"
+            r = result["r"]
+            assert r.ok, r
+            assert not r.back_to_source  # finished from the swarm
+            assert sha256_hex(storage.read_task_bytes(tid)) == sha256_hex(blob)
+            # The dead parent was actually in play: failures were
+            # reported and rescheduling happened around them.
+            assert r.failed_pieces >= 1
+        finally:
+            for d in daemons:
+                d.stop()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Drill 4 — trainer crash mid-online-ingest → orbax resume, exactly-once
+# ---------------------------------------------------------------------------
+
+
+class TestTrainerCrashDrill:
+    TOTAL_DISPATCHES = 6
+    CRASH_AT = 3
+
+    def test_orbax_resume_no_duplicate_no_lost_records(self, tmp_path):
+        import os
+        import sys
+
+        child = os.path.join(os.path.dirname(__file__), "_chaos_child.py")
+        ckpt = str(tmp_path / "ckpt")
+
+        # Phase 1: the trainer SIGKILLs ITSELF at dispatch index 3 (the
+        # crash fault on the trainer.dispatch seam) — dispatches 0..2
+        # trained and checkpointed, the stream position mid-flight.
+        p1 = ChaosProcess(
+            [child, "fresh", ckpt, str(self.TOTAL_DISPATCHES)],
+            scenario=crash_at("trainer.dispatch", self.CRASH_AT),
+            ready_prefixes=["chaos-child: ready"],
+        ).start()
+        p1.wait_ready(120)
+        assert p1.wait_dead(300) == -9, p1.lines[-5:]
+        assert os.path.isdir(os.path.join(ckpt, "online_graph"))
+
+        # Phase 2: a fresh process resumes from the checkpoint and
+        # finishes the stream, skipping exactly what was already trained.
+        p2 = ChaosProcess(
+            [child, "resume", ckpt, str(self.TOTAL_DISPATCHES)],
+        ).start()
+        assert p2.wait_dead(300) == 0, p2.lines[-8:]
+        out = json.loads([l for l in p2.lines if l.startswith("{")][-1])
+        resumed = [l for l in p2.lines if "resumed at dispatch" in l]
+        assert resumed and resumed[0].endswith(str(self.CRASH_AT))
+
+        # Exactly-once accounting: every record trained once, none lost.
+        import _chaos_child as cc
+
+        assert out["dispatch"] == self.TOTAL_DISPATCHES
+        assert out["records_seen"] == self.TOTAL_DISPATCHES * cc.PER_DISPATCH
+
+        # Byte-identity against an UNINTERRUPTED run of the same stream
+        # (in-process — same platform config as the children).
+        ref = cc.run("fresh", str(tmp_path / "ref_ckpt"), self.TOTAL_DISPATCHES)
+        assert ref["records_seen"] == out["records_seen"]
+        assert ref["state_hash"] == out["state_hash"]
+
+
+# ---------------------------------------------------------------------------
+# Satellites
+# ---------------------------------------------------------------------------
+
+
+class TestBenchInitFailure:
+    def test_persistent_unavailable_emits_one_json_line(self, capsys):
+        import bench
+
+        calls = []
+
+        def busy():
+            calls.append(1)
+            raise RuntimeError("UNAVAILABLE: TPU runtime busy")
+
+        rc = bench.main(
+            acquire=lambda: bench.acquire_backend(
+                busy, attempts=3, sleep=lambda s: None
+            )
+        )
+        out_lines = capsys.readouterr().out.strip().splitlines()
+        assert rc == 1 and len(out_lines) == 1
+        line = json.loads(out_lines[0])
+        assert line["ok"] is False
+        assert line["failure"] == "backend_unavailable"
+        assert len(calls) == 3  # bounded backoff actually retried
+
+    def test_transient_unavailable_recovers(self):
+        import bench
+
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("UNAVAILABLE: borrowed")
+            return "backend"
+
+        assert bench.acquire_backend(
+            flaky, attempts=5, sleep=lambda s: None
+        ) == "backend"
+        assert len(calls) == 3
+
+
+class _FakeIdPTransport:
+    """OAuth transport double: token endpoint + profile endpoint with
+    scriptable outcomes."""
+
+    def __init__(self):
+        self.token_hits = 0
+        self.profile_hits = 0
+        self.token_delay_s = 0.0
+        self.profile_error = None  # HTTP status to raise, or None
+        self.rotate_to = None      # refresh_token rotation
+
+    def __call__(self, req, timeout):
+        url = req.full_url
+        if "token" in url:
+            self.token_hits += 1
+            if self.token_delay_s:
+                time.sleep(self.token_delay_s)
+            body = {"access_token": "at-1"}
+            if self.rotate_to:
+                body["refresh_token"] = self.rotate_to
+            return _Resp(body)
+        self.profile_hits += 1
+        if self.profile_error is not None:
+            import io
+
+            raise urllib.error.HTTPError(
+                url, self.profile_error, "err", None, io.BytesIO(b"")
+            )
+        return _Resp({"email": "u@x", "login": "u"})
+
+
+class _Resp:
+    def __init__(self, body):
+        self._body = json.dumps(body).encode()
+
+    def read(self):
+        return self._body
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _oauth(transport):
+    from dragonfly2_tpu.manager.oauth import OAuthProvider, OAuthSignin
+    from dragonfly2_tpu.manager.users import UserStore
+
+    users = UserStore(db_path=None)
+    oauth = OAuthSignin(users, transport=transport)
+    oauth.register(OAuthProvider(
+        name="prov", client_id="c", client_secret="s",
+        auth_url="https://idp/auth", token_url="https://idp/token",
+        profile_url="https://idp/profile",
+    ))
+    return oauth
+
+
+class TestOAuthRefreshHardening:
+    def test_handle_single_use_one_idp_redemption_under_race(self):
+        tr = _FakeIdPTransport()
+        tr.token_delay_s = 0.3
+        oauth = _oauth(tr)
+        rid = oauth._store_grant("prov", "uid-1", "rt-0")
+        outcomes = []
+
+        def go():
+            try:
+                outcomes.append(("ok", oauth.refresh(rid)[1]))
+            except PermissionError as exc:
+                outcomes.append(("denied", str(exc)))
+
+        threads = [threading.Thread(target=go) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        # Exactly ONE redemption reached the IdP: a rotation-strict
+        # provider sees one use of the refresh token, not token theft.
+        assert tr.token_hits == 1
+        assert sorted(o[0] for o in outcomes) == ["denied", "ok"]
+
+    def test_profile_401_destroys_grant(self):
+        tr = _FakeIdPTransport()
+        tr.profile_error = 401
+        oauth = _oauth(tr)
+        rid = oauth._store_grant("prov", "uid-1", "rt-0")
+        with pytest.raises(PermissionError):
+            oauth.refresh(rid)
+        assert rid not in oauth._grants  # destroyed → re-authenticate
+        with pytest.raises(PermissionError):
+            oauth.refresh(rid)  # unknown handle now
+
+    def test_profile_5xx_is_transient_and_keeps_rotated_token(self):
+        from dragonfly2_tpu.manager.oauth import OAuthUnavailable
+
+        tr = _FakeIdPTransport()
+        tr.profile_error = 503
+        tr.rotate_to = "rt-1"
+        oauth = _oauth(tr)
+        rid = oauth._store_grant("prov", "uid-1", "rt-0")
+        with pytest.raises(OAuthUnavailable):
+            oauth.refresh(rid)
+        # Grant survived AND carries the ROTATED token (rt-0 is dead at
+        # the IdP after the redemption above).
+        assert oauth._grants[rid][2] == "rt-1"
+        # IdP recovers → the same handle refreshes fine.
+        tr.profile_error = None
+        user, new_rid = oauth.refresh(rid)
+        assert user.name == "prov:u" and new_rid
+        assert rid not in oauth._grants  # rotated handle
+
+    def test_token_endpoint_outage_restores_grant(self):
+        from dragonfly2_tpu.manager.oauth import OAuthUnavailable
+
+        calls = []
+
+        def down(req, timeout):
+            calls.append(req.full_url)
+            raise urllib.error.URLError("connection refused")
+
+        oauth = _oauth(down)
+        rid = oauth._store_grant("prov", "uid-1", "rt-0")
+        with pytest.raises(OAuthUnavailable):
+            oauth.refresh(rid)
+        assert oauth._grants[rid][2] == "rt-0"  # intact, caller retries
+
+
+class TestJobResultPersistence:
+    def test_unserializable_result_persists_completion(self):
+        from dragonfly2_tpu.jobs.queue import JobQueue, JobState
+        from dragonfly2_tpu.manager.state import MemoryBackend
+
+        backend = MemoryBackend()
+        q = JobQueue(backend=backend)
+        job = q.enqueue("preheat", {"urls": ["u"]}, queue_name="q-s")
+        popped = q.poll("q-s", timeout=1.0)
+        assert popped.id == job.id
+
+        q.set_result(job.id, JobState.SUCCESS, result=object())  # not JSON
+
+        # A restarted manager reloads the broker from the same backend:
+        # the job is SUCCESS with result=None — NOT a STARTED row that
+        # the stale-visibility requeue would guarantee-redeliver.
+        q2 = JobQueue(backend=backend)
+        reloaded = q2.jobs[job.id]
+        assert reloaded.state is JobState.SUCCESS
+        assert reloaded.result is None
+        assert q2.poll("q-s", timeout=0.2, requeue_started_after_s=0.01) is None

@@ -1,0 +1,305 @@
+"""Wire-codec fuzz/property tests (VERDICT r5 ask #7).
+
+Three codecs carry training data across processes and deployments; each
+gets seeded property coverage rather than single golden cases:
+
+- reference-CSV ↔ schema: randomized Download / NetworkTopologyRecord
+  instances (random scalars, list lengths up to the reference's fixed
+  caps, strings with CSV metacharacters) roundtrip to full dataclass
+  equality;
+- DFC1 container: truncation at EVERY header boundary and seeded data
+  offsets either raises ValueError or yields exactly the complete-row
+  prefix — never an exception of another type, never garbage rows;
+  bit-flips in the magic/header fail loudly, bit-flips in the data
+  region never break framing;
+- StreamingRowDecoder: arbitrary seeded chunkings of the same byte
+  stream (including 1-byte chunks) decode to identical rows.
+"""
+
+import os
+import string
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.records.columnar import (
+    ColumnarReader,
+    ColumnarWriter,
+    StreamingRowDecoder,
+    read_header,
+)
+from dragonfly2_tpu.records.csv_compat import (
+    download_from_row,
+    download_to_row,
+    read_download_csv,
+    read_topology_csv,
+    topology_from_row,
+    topology_to_row,
+    write_download_csv,
+    write_topology_csv,
+)
+from dragonfly2_tpu.records.schema import (
+    Download,
+    DownloadError,
+    HostRecord,
+    NetworkTopologyRecord,
+    Parent,
+    Piece,
+    ProbeStats,
+    TaskRecord,
+    TopoHost,
+)
+
+# Deliberately includes CSV metacharacters: commas, quotes, spaces —
+# the codec must quote its way through them like gocsv does.
+_CHARS = string.ascii_letters + string.digits + ' ,"-_.:/'
+
+
+def _s(rng) -> str:
+    n = int(rng.integers(0, 24))
+    return "".join(_CHARS[int(i)] for i in rng.integers(0, len(_CHARS), n))
+
+
+def _i(rng) -> int:
+    return int(rng.integers(0, 1 << 48))
+
+
+def _f(rng) -> float:
+    # round() keeps the values inside the codec's %g-style formatting
+    # precision; full 17-digit doubles are covered by the dedicated
+    # precision test in test_csv_compat.
+    return round(float(rng.uniform(0, 1e9)), 6)
+
+
+def _host(rng) -> HostRecord:
+    h = HostRecord(
+        id=_s(rng), hostname=_s(rng), ip=_s(rng), port=_i(rng),
+        download_port=_i(rng), concurrent_upload_limit=_i(rng),
+    )
+    h.cpu.logical_count = _i(rng)
+    h.cpu.percent = _f(rng)
+    h.cpu.times.user = _f(rng)
+    h.cpu.times.iowait = _f(rng)
+    h.memory.total = _i(rng)
+    h.memory.used_percent = _f(rng)
+    h.network.idc = _s(rng)
+    h.network.location = _s(rng)
+    h.disk.total = _i(rng)
+    h.build.git_version = _s(rng)
+    return h
+
+
+def random_download(rng) -> Download:
+    parents = []
+    for p in range(int(rng.integers(0, 21))):  # reference cap: 20
+        pieces = [
+            Piece(length=_i(rng), cost=_i(rng), created_at=_i(rng))
+            for _ in range(int(rng.integers(0, 11)))  # cap: 10
+        ]
+        parents.append(Parent(
+            id=_s(rng), state=_s(rng), cost=_i(rng),
+            upload_piece_count=_i(rng), finished_piece_count=_i(rng),
+            host=_host(rng), pieces=pieces,
+            created_at=_i(rng), updated_at=_i(rng),
+        ))
+    return Download(
+        id=_s(rng), tag=_s(rng), application=_s(rng), state=_s(rng),
+        error=DownloadError(code=_s(rng), message=_s(rng)),
+        cost=_i(rng), finished_piece_count=_i(rng),
+        task=TaskRecord(
+            id=_s(rng), url=_s(rng), type=_s(rng), content_length=_i(rng),
+            total_piece_count=_i(rng), state=_s(rng),
+            created_at=_i(rng), updated_at=_i(rng),
+        ),
+        host=_host(rng), parents=parents,
+        created_at=_i(rng), updated_at=_i(rng),
+    )
+
+
+def random_topology(rng) -> NetworkTopologyRecord:
+    src = TopoHost(id=_s(rng), type=_s(rng), hostname=_s(rng), ip=_s(rng),
+                   port=_i(rng))
+    src.network.idc = _s(rng)
+    dests = [
+        TopoHost(
+            id=_s(rng), type=_s(rng), hostname=_s(rng), ip=_s(rng),
+            port=_i(rng),
+            probes=ProbeStats(average_rtt=_i(rng), created_at=_i(rng),
+                              updated_at=_i(rng)),
+        )
+        for _ in range(int(rng.integers(0, 6)))  # reference cap: 5
+    ]
+    return NetworkTopologyRecord(id=_s(rng), host=src, dest_hosts=dests,
+                                 created_at=_i(rng))
+
+
+class TestReferenceCSVProperty:
+    def test_download_roundtrip_randomized(self, tmp_path):
+        rng = np.random.default_rng(1234)
+        records = [random_download(rng) for _ in range(12)] + [Download()]
+        path = str(tmp_path / "dl.csv")
+        assert write_download_csv(records, path) == len(records)
+        assert read_download_csv(path) == records
+
+    def test_download_row_roundtrip_per_record(self):
+        rng = np.random.default_rng(99)
+        for _ in range(25):
+            rec = random_download(rng)
+            assert download_from_row(download_to_row(rec)) == rec
+
+    def test_topology_roundtrip_randomized(self, tmp_path):
+        rng = np.random.default_rng(4321)
+        records = [random_topology(rng) for _ in range(12)]
+        records.append(NetworkTopologyRecord())
+        path = str(tmp_path / "nt.csv")
+        assert write_topology_csv(records, path) == len(records)
+        assert read_topology_csv(path) == records
+
+    def test_topology_row_roundtrip_per_record(self):
+        rng = np.random.default_rng(7)
+        for _ in range(25):
+            rec = random_topology(rng)
+            assert topology_from_row(topology_to_row(rec)) == rec
+
+
+def _write_dfc(path: str, rows: np.ndarray) -> bytes:
+    with ColumnarWriter(path, [f"c{i}" for i in range(rows.shape[1])]) as w:
+        w.append(rows)
+    with open(path, "rb") as f:
+        return f.read()
+
+
+class TestDFC1Truncation:
+    N_ROWS, N_COLS = 16, 5
+
+    @pytest.fixture()
+    def dfc(self, tmp_path):
+        rng = np.random.default_rng(0)
+        rows = rng.normal(size=(self.N_ROWS, self.N_COLS)).astype(np.float32)
+        path = str(tmp_path / "full.dfc")
+        blob = _write_dfc(path, rows)
+        data_off = read_header(path)[1]
+        return path, rows, blob, data_off
+
+    def test_every_header_truncation_raises_valueerror(self, dfc, tmp_path):
+        _, _, blob, data_off = dfc
+        for cut in range(0, data_off):
+            p = str(tmp_path / "cut.dfc")
+            with open(p, "wb") as f:
+                f.write(blob[:cut])
+            # ValueError EXACTLY — no struct.error / JSONDecodeError /
+            # silent empty-file success escapes the header parser.
+            with pytest.raises(ValueError):
+                read_header(p)
+
+    def test_data_truncation_yields_complete_row_prefix(self, dfc, tmp_path):
+        _, rows, blob, data_off = dfc
+        row_nbytes = 4 * self.N_COLS
+        rng = np.random.default_rng(5)
+        cuts = set(rng.integers(data_off, len(blob), 20).tolist())
+        cuts |= {data_off, data_off + 1, data_off + row_nbytes, len(blob)}
+        for cut in cuts:
+            p = str(tmp_path / "cut.dfc")
+            with open(p, "wb") as f:
+                f.write(blob[:cut])
+            r = ColumnarReader(p)
+            n_complete = (cut - data_off) // row_nbytes
+            assert r.num_rows == n_complete
+            np.testing.assert_array_equal(r.to_array(), rows[:n_complete])
+
+    def test_bit_flips_in_prefix_fail_loudly(self, dfc, tmp_path):
+        _, _, blob, data_off = dfc
+        rng = np.random.default_rng(11)
+        for _ in range(30):
+            pos = int(rng.integers(0, data_off))
+            bit = 1 << int(rng.integers(0, 8))
+            flipped = bytearray(blob)
+            flipped[pos] ^= bit
+            p = str(tmp_path / "flip.dfc")
+            with open(p, "wb") as f:
+                f.write(bytes(flipped))
+            try:
+                header, off = read_header(p)
+                # A flip that survives parsing must not have corrupted
+                # framing: either the header still describes the same
+                # layout, or construction fails loudly below.
+                reader = ColumnarReader(p)
+                assert reader.num_rows * header.row_nbytes <= len(blob) - off
+            except (ValueError, TypeError):
+                pass  # loud failure is the accepted outcome
+
+    def test_bit_flips_in_data_never_break_framing(self, dfc, tmp_path):
+        _, _, blob, data_off = dfc
+        rng = np.random.default_rng(13)
+        for _ in range(30):
+            pos = int(rng.integers(data_off, len(blob)))
+            flipped = bytearray(blob)
+            flipped[pos] ^= 1 << int(rng.integers(0, 8))
+            p = str(tmp_path / "flip.dfc")
+            with open(p, "wb") as f:
+                f.write(bytes(flipped))
+            r = ColumnarReader(p)
+            assert r.num_rows == self.N_ROWS
+            assert r.to_array().shape == (self.N_ROWS, self.N_COLS)
+
+
+class TestStreamingDecoderChunking:
+    def _encoded(self):
+        rng = np.random.default_rng(21)
+        rows = rng.normal(size=(64, 7)).astype(np.float32)
+        import io
+        import json as _json
+        import struct as _struct
+
+        payload = _json.dumps(
+            {"columns": [f"c{i}" for i in range(7)], "dtype": "float32",
+             "created_at_ns": 0}
+        ).encode()
+        buf = io.BytesIO()
+        buf.write(b"DFC1" + _struct.pack("<I", len(payload)) + payload)
+        buf.write(rows.tobytes())
+        return rows, buf.getvalue()
+
+    def _chunks(self, blob, rng):
+        out, pos = [], 0
+        while pos < len(blob):
+            n = int(rng.integers(1, 97))
+            out.append(blob[pos : pos + n])
+            pos += n
+        return out
+
+    def test_arbitrary_chunk_boundaries_decode_identically(self):
+        rows, blob = self._encoded()
+        for seed in range(8):
+            rng = np.random.default_rng(seed)
+            dec = StreamingRowDecoder()
+            got = [dec.feed(c) for c in self._chunks(blob, rng)]
+            got = np.concatenate([g for g in got if len(g)], axis=0)
+            np.testing.assert_array_equal(got, rows)
+            assert dec.rows_decoded == len(rows)
+
+    def test_one_byte_chunks(self):
+        rows, blob = self._encoded()
+        dec = StreamingRowDecoder()
+        got = [dec.feed(blob[i : i + 1]) for i in range(len(blob))]
+        got = np.concatenate([g for g in got if len(g)], axis=0)
+        np.testing.assert_array_equal(got, rows)
+
+    def test_truncated_stream_yields_only_complete_rows(self):
+        rows, blob = self._encoded()
+        dec = StreamingRowDecoder()
+        cut = len(blob) - 11  # mid-row
+        out = dec.feed(blob[:cut])
+        n_complete = len(out)
+        np.testing.assert_array_equal(out, rows[:n_complete])
+        assert n_complete < len(rows)
+        # The tail stays buffered; completing the stream completes rows.
+        rest = dec.feed(blob[cut:])
+        np.testing.assert_array_equal(
+            np.concatenate([out, rest], axis=0), rows
+        )
+
+    def test_bad_magic_raises(self):
+        dec = StreamingRowDecoder()
+        with pytest.raises(ValueError):
+            dec.feed(b"NOPE" + os.urandom(32))

@@ -6,7 +6,15 @@ import os
 import subprocess
 import sys
 
+import pytest
 import yaml
+
+try:
+    import cryptography  # noqa: F401
+
+    _HAS_CRYPTO = True
+except ImportError:
+    _HAS_CRYPTO = False
 
 DEPLOY = os.path.join(os.path.dirname(os.path.dirname(__file__)), "deploy")
 
@@ -142,6 +150,9 @@ class TestClusterE2E:
         assert "landed on their ring owners" in r.stdout
         assert "ranks on replica B" in r.stdout
 
+    @pytest.mark.skipif(
+        not _HAS_CRYPTO, reason="mTLS issuance needs `cryptography`"
+    )
     def test_run_local_cluster_loop_mtls(self):
         """The SAME composed topology with auto-issued mTLS on: every
         daemon bootstraps its identity from the manager's cluster CA at

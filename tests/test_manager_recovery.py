@@ -37,6 +37,13 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+try:
+    import cryptography  # noqa: F401
+
+    _HAS_CRYPTO = True
+except ImportError:
+    _HAS_CRYPTO = False
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -108,6 +115,10 @@ class _Manager:
             self.proc.wait(timeout=30)
 
 
+@pytest.mark.skipif(
+    not _HAS_CRYPTO,
+    reason="drill verifies CA trust-root survival; needs `cryptography`",
+)
 def test_kill_manager_mid_preheat_recovers(tmp_path):
     from dragonfly2_tpu.jobs.remote import RemoteJobClient, RemoteJobWorker
     from dragonfly2_tpu.security.ca import PeerIdentity

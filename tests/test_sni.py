@@ -5,6 +5,12 @@ import socket
 import ssl
 import threading
 
+import pytest
+
+pytest.importorskip(
+    "cryptography", reason="SNI interception needs the gated CA surface"
+)
+
 from dragonfly2_tpu.daemon.sni import SNIProxy, parse_client_hello_sni
 from dragonfly2_tpu.security.ca import CertificateAuthority, PeerIdentity
 from dragonfly2_tpu.utils import idgen
