@@ -373,8 +373,12 @@ def run(argv=None) -> int:
                         results.append((t.id, rtt))
                 if results:
                     parts["client"].sync_probes_finished(parts["host"], results)
-            except Exception:  # noqa: BLE001 — probe failures must not kill the daemon
-                pass
+            except Exception as exc:  # noqa: BLE001 — probe failures must not kill the daemon
+                import logging
+
+                logging.getLogger("dragonfly2_tpu.cli.dfdaemon").debug(
+                    "probe sweep failed: %s", exc
+                )
     except KeyboardInterrupt:
         parts["piece_server"].stop()
         return 0

@@ -30,6 +30,7 @@ origin path.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -627,7 +628,10 @@ class Conductor:
                     continue
                 try:
                     data = self.piece_fetcher.fetch(holder, task_id, number)
-                except Exception:  # noqa: BLE001 — next holder
+                except Exception as exc:  # noqa: BLE001 — next holder
+                    logging.getLogger(__name__).debug(
+                        "pex fetch piece %d from %s: %s", number, holder, exc
+                    )
                     continue
                 if len(data) != _expected_piece_len(
                     content_length, piece_size, number
@@ -843,7 +847,10 @@ class Conductor:
                     bm = wait(p.host.id, task_id, have, per_parent_wait)
                 else:
                     bm = self.piece_fetcher.piece_bitmap(p.host.id, task_id)
-            except Exception:  # noqa: BLE001 — a dead parent just has no bitmap
+            except Exception as exc:  # noqa: BLE001 — a dead parent just has no bitmap
+                logging.getLogger(__name__).debug(
+                    "bitmap from %s: %s", p.host.id, exc
+                )
                 bm = None
             if bm is not None:
                 with state.lock:

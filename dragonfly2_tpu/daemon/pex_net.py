@@ -135,7 +135,10 @@ class NetworkedGossipBus:
         }
 
     def _send(self, msg: dict, addr: Tuple[str, int]) -> None:
+        from ..utils import faultinject
+
         try:
+            faultinject.fire("pex.send")
             data = json.dumps(msg).encode()
             if len(data) > _MAX_DGRAM:
                 logger.warning(
@@ -145,7 +148,7 @@ class NetworkedGossipBus:
                 return
             self._sock.sendto(data, tuple(addr))
         except OSError:
-            pass
+            pass  # dflint: disable=DF001 — UDP gossip: drop is the semantics
 
     def _broadcast(self, msg: dict) -> None:
         with self._mu:
@@ -154,11 +157,20 @@ class NetworkedGossipBus:
             self._send(msg, addr)
 
     def _recv_loop(self) -> None:
+        from ..utils import faultinject
+
         while not self._stop.is_set():
             try:
                 data, addr = self._sock.recvfrom(_MAX_DGRAM + 4096)
             except OSError:
                 return
+            try:
+                # Drop = datagram lost (skip), truncate = torn datagram
+                # that must parse-fail cleanly, never poison the
+                # membership table.
+                data = faultinject.fire("pex.recv", data)
+            except ConnectionError:
+                continue
             try:
                 msg = json.loads(data)
                 self._handle(msg, addr)

@@ -138,6 +138,9 @@ class P2PProxy:
                 proxy.stats["tunnel"] += 1
                 client = self.connection
                 try:
+                    from ..utils import faultinject
+
+                    faultinject.fire("proxy.tunnel")
                     # Bytes the client pipelined behind the CONNECT headers
                     # (e.g. a TLS ClientHello racing the 200) are sitting in
                     # rfile's buffer, NOT the socket — forward them first or
@@ -169,8 +172,11 @@ class P2PProxy:
         )
 
     def _fetch_direct(self, url: str) -> bytes:
+        from ..utils import faultinject
+
+        faultinject.fire("proxy.direct")
         with urllib.request.urlopen(url, timeout=self.direct_timeout) as resp:
-            return resp.read()
+            return faultinject.fire("proxy.direct.body", resp.read())
 
     @property
     def port(self) -> int:

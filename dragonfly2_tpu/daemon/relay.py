@@ -13,6 +13,8 @@ import socket
 
 
 def relay_bytes(a: socket.socket, b: socket.socket, idle_timeout: float) -> None:
+    from ..utils import faultinject
+
     open_dirs = {a: b, b: a}
     while open_dirs:
         readable, _, _ = select.select(list(open_dirs), [], [], idle_timeout)
@@ -23,7 +25,9 @@ def relay_bytes(a: socket.socket, b: socket.socket, idle_timeout: float) -> None
             if dst is None:
                 continue
             try:
-                data = sock.recv(65536)
+                # Drop/truncate here = mid-tunnel reset/torn pump: the
+                # half-close teardown below must run, not leak the pair.
+                data = faultinject.fire("relay.pump", sock.recv(65536))
             except OSError:
                 data = b""
             if not data:

@@ -25,11 +25,14 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..utils.types import Priority
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass(order=True)
@@ -91,8 +94,8 @@ class SeedQueue:
                 self._active += 1
             try:
                 job.run()
-            except Exception:  # noqa: BLE001 — job errors surface via its own stream
-                pass
+            except Exception as exc:  # noqa: BLE001 — job errors surface via its own stream
+                logger.warning("seed job failed: %s", exc)
             finally:
                 with self._mu:
                     self._active -= 1

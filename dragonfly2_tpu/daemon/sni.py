@@ -75,11 +75,14 @@ def _peek_client_hello(conn: socket.socket, timeout: float) -> bytes:
     socket timeout never fires while data is queued — so progress is
     tracked explicitly: no growth → short sleep, hard deadline overall
     (otherwise one stalled client pins a core)."""
+    from ..utils import faultinject
+
     conn.settimeout(timeout)
     deadline = time.monotonic() + timeout
     prev = -1
     data = b""
     while True:
+        faultinject.fire("sni.peek")
         data = conn.recv(MAX_HELLO, socket.MSG_PEEK)
         if not data:
             return b""
@@ -218,6 +221,9 @@ class SNIProxy:
     # -- hijack: terminate TLS, serve the inner request from P2P ------------
 
     def _hijack(self, conn: socket.socket, sni: str) -> None:
+        from ..utils import faultinject
+
+        faultinject.fire("sni.hijack")
         ctx = self.certs.context_for(sni)
         with ctx.wrap_socket(conn, server_side=True) as tls:
             tls.settimeout(self.handshake_timeout)

@@ -9,6 +9,7 @@ Reference: client/daemon/storage/storage_manager.go (TaskStorageDriver
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -18,6 +19,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import native
+
+logger = logging.getLogger(__name__)
 
 
 class _PyPieceStore:
@@ -247,7 +250,8 @@ class DaemonStorage:
         (n_pieces): progress reporting must count data on disk."""
         try:
             return self.engine.piece_count(task_id)
-        except Exception:  # noqa: BLE001 — unknown task → nothing held
+        except Exception as exc:  # noqa: BLE001 — unknown task → nothing held
+            logger.debug("piece_count(%s): %s", task_id, exc)
             return 0
 
     def content_length(self, task_id: str) -> int:

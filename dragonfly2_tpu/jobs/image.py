@@ -43,6 +43,9 @@ def parse_manifest_url(url: str) -> Tuple[str, str, str]:
 
 
 def _default_transport(req: urllib.request.Request, timeout: float):
+    from ..utils import faultinject
+
+    faultinject.fire("jobs.image.fetch")
     return urllib.request.urlopen(req, timeout=timeout)
 
 

@@ -53,6 +53,9 @@ class RemoteJobClient:
         req = urllib.request.Request(
             self.base + path, data=data, headers=headers, method=method
         )
+        from ..utils import faultinject
+
+        faultinject.fire("jobs.remote.call")
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             if resp.status == 204:
                 return {}

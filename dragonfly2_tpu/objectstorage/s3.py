@@ -111,6 +111,9 @@ class _HTTPBackendBase:
             url, data=payload if method in ("PUT", "POST") else None,
             headers=headers, method=method,
         )
+        from ..utils import faultinject
+
+        faultinject.fire("objectstorage.request")
         return urllib.request.urlopen(req, timeout=self.timeout)
 
     def _head_meta(self, bucket: str, key: str) -> ObjectMetadata:

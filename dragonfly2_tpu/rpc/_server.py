@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import logging
 import ssl
 import threading
 from http.server import ThreadingHTTPServer
@@ -36,7 +37,10 @@ class ThreadedHTTPService:
                     # the connection before any request is served — the
                     # client sees a reset, exactly like a dying server.
                     faultinject.fire(f"rpc.server.{name}")
-                except Exception:  # noqa: BLE001 — injected
+                except Exception as exc:  # noqa: BLE001 — injected
+                    logging.getLogger(__name__).debug(
+                        "injected fault at rpc.server.%s: %s", name, exc
+                    )
                     self.close_connection = True
                     return
                 try:

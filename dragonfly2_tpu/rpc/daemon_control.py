@@ -16,6 +16,7 @@ running daemon or know to spawn one.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import urllib.parse
@@ -318,10 +319,14 @@ class DaemonControlServer:
 
 
 def daemon_healthy(url: str, timeout: float = 2.0) -> bool:
+    from ..utils import faultinject
+
     try:
+        faultinject.fire("daemon.control.healthy")
         with urllib.request.urlopen(url + "/healthy", timeout=timeout) as r:
             return bool(json.loads(r.read()).get("ok"))
-    except Exception:  # noqa: BLE001 — any failure means "not healthy"
+    except Exception as exc:  # noqa: BLE001 — any failure means "not healthy"
+        logging.getLogger(__name__).debug("health probe %s: %s", url, exc)
         return False
 
 
@@ -338,6 +343,9 @@ def download_via_daemon(
         daemon_url + "/download", data=json.dumps(payload).encode(),
         headers={"Content-Type": "application/json"}, method="POST",
     )
+    from ..utils import faultinject
+
+    faultinject.fire("daemon.control.download")
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return json.loads(r.read())

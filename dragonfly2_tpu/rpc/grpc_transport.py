@@ -22,6 +22,7 @@ module binds the SAME adapters the HTTP/JSON servers use onto grpc:
 from __future__ import annotations
 
 import json
+import logging
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
 
@@ -420,7 +421,9 @@ class GRPCRemoteScheduler(RemoteScheduler):
                 response_deserializer=resp_cls.FromString,
             )
 
-    def _call(self, method: str, req: dict) -> dict:
+    def _call(
+        self, method: str, req: dict, *, deadline_s: Optional[float] = None
+    ) -> dict:
         from .retry import retry_call
 
         req_cls, _ = SCHEDULER_METHODS[method]
@@ -454,7 +457,11 @@ class GRPCRemoteScheduler(RemoteScheduler):
                     code=_GRPC_TO_DFCODE.get(code, 0),
                 ) from exc
 
-        resp = retry_call(once, retry_on=(ConnectionError, TimeoutError, OSError))
+        resp = retry_call(
+            once,
+            retry_on=(ConnectionError, TimeoutError, OSError),
+            deadline_s=deadline_s,
+        )
         return proto_to_dict(resp)
 
     def close(self) -> None:
@@ -555,8 +562,10 @@ class GRPCStreamingScheduler(GRPCRemoteScheduler):
                         if waiter is not None:
                             waiter[1].append(resp)
                             waiter[0].set()
-                except Exception:  # noqa: BLE001 — stream died
-                    pass
+                except Exception as exc:  # noqa: BLE001 — stream died
+                    logging.getLogger(__name__).debug(
+                        "announce stream read loop died: %s", exc
+                    )
                 finally:
                     # Wake every in-flight caller so they fall back to unary
                     # instead of blocking out the timeout.  Only clear the
@@ -1037,7 +1046,10 @@ class GRPCRemoteRegistry:
                 response_deserializer=resp_cls.FromString,
             )
 
-    def _call(self, name, msg, *, not_found_none: bool = False):
+    def _call(
+        self, name, msg, *, not_found_none: bool = False,
+        deadline_s: Optional[float] = None,
+    ):
         """Same exception contract as RemoteRegistry._translate — callers
         written against the local ModelRegistry behave identically:
         NOT_FOUND → KeyError (or None), INVALID_ARGUMENT → ValueError,
@@ -1076,7 +1088,11 @@ class GRPCRemoteRegistry:
                     code=_GRPC_TO_DFCODE.get(code, 0),
                 ) from exc
 
-        return retry_call(once, retry_on=(ConnectionError, TimeoutError, OSError))
+        return retry_call(
+            once,
+            retry_on=(ConnectionError, TimeoutError, OSError),
+            deadline_s=deadline_s,
+        )
 
     @staticmethod
     def _model(m):

@@ -24,7 +24,7 @@ from __future__ import annotations
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from ..daemon.upload import UploadBusy, UploadManager
 from ._server import ThreadedHTTPService
@@ -239,8 +239,13 @@ def make_piece_server(
                 upload, host, port,
                 concurrent_limit=getattr(upload, "concurrent_limit", 64),
             )
-        except Exception:  # noqa: BLE001 — unresolvable host / engine error
-            pass  # Python server below handles what the engine cannot
+        except Exception as exc:  # noqa: BLE001 — unresolvable host / engine error
+            import logging
+
+            # Python server below handles what the engine cannot.
+            logging.getLogger(__name__).warning(
+                "native piece server unavailable, falling back: %s", exc
+            )
     return PieceHTTPServer(upload, host, port, ssl_context=ssl_context)
 
 
@@ -299,7 +304,14 @@ class HTTPPieceFetcher:
                 self._breakers[parent_host_id] = b
             return b
 
-    def fetch(self, parent_host_id: str, task_id: str, number: int) -> bytes:
+    def fetch(
+        self,
+        parent_host_id: str,
+        task_id: str,
+        number: int,
+        *,
+        deadline_s: Optional[float] = None,
+    ) -> bytes:
         from ..utils import faultinject
 
         ip, port = self._resolve(parent_host_id)
@@ -326,6 +338,7 @@ class HTTPPieceFetcher:
         return retry_call(
             once, attempts=2, retry_on=(ConnectionError, TimeoutError),
             breaker=self._breaker(parent_host_id),
+            deadline_s=deadline_s,
         )
 
     def piece_bitmap(self, parent_host_id: str, task_id: str):
@@ -346,16 +359,21 @@ class HTTPPieceFetcher:
         )
 
     def _bitmap_get(self, parent_host_id: str, path: str, timeout: float):
+        from ..utils import faultinject
+
         try:
             ip, port = self._resolve(parent_host_id)
         except KeyError:
             return None
         url = f"{self._scheme}://{ip}:{port}{path}"
         try:
+            faultinject.fire("piece.bitmap")
             with urllib.request.urlopen(
                 url, timeout=timeout, context=self.ssl_context
             ) as resp:
-                return resp.read()
+                # Truncate seam: a torn bitmap body must be survivable
+                # (the conductor treats a short bitmap as fewer pieces).
+                return faultinject.fire("piece.bitmap.body", resp.read())
         except (urllib.error.URLError, OSError):
             return None
 

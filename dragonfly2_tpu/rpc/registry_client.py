@@ -63,8 +63,11 @@ class RemoteRegistry:
             return ValueError(message or "bad request")
         return RuntimeError(f"manager: HTTP {exc.code}: {message}")
 
-    def _get(self, path: str) -> Optional[dict]:
+    def _get(self, path: str, *, deadline_s: Optional[float] = None) -> Optional[dict]:
         def once():
+            from ..utils import faultinject
+
+            faultinject.fire("rpc.registry.get")
             try:
                 with urllib.request.urlopen(
                     self.base_url + path, timeout=self.timeout
@@ -79,10 +82,19 @@ class RemoteRegistry:
         # URLError (an OSError, NOT ConnectionError) — include OSError so
         # transient manager restarts actually retry (scheduler_client's
         # pattern).
-        return retry_call(once, retry_on=(ConnectionError, TimeoutError, OSError))
+        return retry_call(
+            once,
+            retry_on=(ConnectionError, TimeoutError, OSError),
+            deadline_s=deadline_s,
+        )
 
-    def _post(self, path: str, payload: dict) -> dict:
+    def _post(
+        self, path: str, payload: dict, *, deadline_s: Optional[float] = None
+    ) -> dict:
         def once():
+            from ..utils import faultinject
+
+            faultinject.fire("rpc.registry.post")
             req = urllib.request.Request(
                 self.base_url + path,
                 data=json.dumps(payload).encode(),
@@ -95,7 +107,11 @@ class RemoteRegistry:
             except urllib.error.HTTPError as exc:
                 raise self._translate(exc) from exc
 
-        return retry_call(once, retry_on=(ConnectionError, TimeoutError, OSError))
+        return retry_call(
+            once,
+            retry_on=(ConnectionError, TimeoutError, OSError),
+            deadline_s=deadline_s,
+        )
 
     # -- the surfaces TrainerService / ModelSubscriber use -------------------
 

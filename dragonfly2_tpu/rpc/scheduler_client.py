@@ -63,7 +63,9 @@ class RemoteScheduler:
 
     # -- wire ---------------------------------------------------------------
 
-    def _call(self, method: str, req: dict) -> dict:
+    def _call(
+        self, method: str, req: dict, *, deadline_s: Optional[float] = None
+    ) -> dict:
         def once() -> dict:
             from ..utils import faultinject
             from ..utils.tracing import default_tracer
@@ -101,7 +103,11 @@ class RemoteScheduler:
                     f"{method}: HTTP {exc.code}: {message}", code=code
                 ) from exc
 
-        return retry_call(once, retry_on=(ConnectionError, TimeoutError, OSError))
+        return retry_call(
+            once,
+            retry_on=(ConnectionError, TimeoutError, OSError),
+            deadline_s=deadline_s,
+        )
 
     # -- mirrors ------------------------------------------------------------
 

@@ -20,9 +20,12 @@ drop-in for the Conductor's single-scheduler client surface:
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Dict, List, Optional, Sequence
 
 from .balancer import HashRing
+
+logger = logging.getLogger(__name__)
 
 
 def default_scheduler_factory(url: str):
@@ -85,8 +88,8 @@ class SteeringSchedulerClient:
                 continue
             try:
                 leave(host)
-            except Exception:  # noqa: BLE001 — best-effort on shutdown
-                pass
+            except Exception as exc:  # noqa: BLE001 — best-effort on shutdown
+                logger.debug("leave_host on replica failed: %s", exc)
 
     def sync_probes_start(self, host):
         return self._owner(host.id).sync_probes_start(host)

@@ -23,7 +23,7 @@ import threading
 import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 from ..trainer.service import TrainerService, TrainSession
 from ._server import ThreadedHTTPService
@@ -198,20 +198,30 @@ class RemoteTrainer:
         self.timeout = timeout
         self.runs: "_RemoteRuns" = _RemoteRuns(self)
 
-    def _post_raw(self, path: str, data: bytes) -> dict:
+    def _post_raw(
+        self, path: str, data: bytes, *, deadline_s: Optional[float] = None
+    ) -> dict:
         def once() -> dict:
+            from ..utils import faultinject
+
+            faultinject.fire("trainer.rpc.post")
             req = urllib.request.Request(
                 self.base_url + path, data=data, method="POST"
             )
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return json.loads(resp.read())
 
-        return retry_call(once, retry_on=(ConnectionError, TimeoutError))
+        return retry_call(
+            once, retry_on=(ConnectionError, TimeoutError), deadline_s=deadline_s
+        )
 
     def _post_json(self, path: str, payload: dict) -> dict:
         return self._post_raw(path, json.dumps(payload).encode())
 
     def _get(self, path: str) -> dict:
+        from ..utils import faultinject
+
+        faultinject.fire("trainer.rpc.get")
         with urllib.request.urlopen(self.base_url + path, timeout=self.timeout) as resp:
             return json.loads(resp.read())
 

@@ -16,11 +16,14 @@ when scheduling decisions happen OUTSIDE the peer's own request cycle
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Dict, Optional
 
 from .scheduling import ScheduleResult
+
+logger = logging.getLogger(__name__)
 
 
 class PeerStreamHub:
@@ -130,5 +133,5 @@ class StallMonitor:
         while not self._stop.wait(self.interval_s):
             try:
                 self.service.reschedule_stalled(self.max_idle_s)
-            except Exception:  # noqa: BLE001 — sweep must survive races
-                pass
+            except Exception as exc:  # noqa: BLE001 — sweep must survive races
+                logger.warning("stall sweep failed: %s", exc)

@@ -77,7 +77,10 @@ class RemoteSeedPeerClient:
             endpoint, data=body,
             headers={"Content-Type": "application/json"}, method="POST",
         )
+        from ..utils import faultinject
+
         try:
+            faultinject.fire("seed.trigger")
             resp = urllib.request.urlopen(req, timeout=self.first_piece_timeout_s)
         except Exception as exc:  # noqa: BLE001 — trigger failure → back-to-source
             logger.warning("seed trigger %s failed: %s", endpoint, exc)
@@ -112,8 +115,8 @@ class RemoteSeedPeerClient:
             if not drained:
                 try:
                     resp.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:  # noqa: BLE001
+                    logger.debug("seed stream close: %s", exc)
         return False
 
     @staticmethod
@@ -121,10 +124,10 @@ class RemoteSeedPeerClient:
         try:
             for _ in resp:
                 pass
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:  # noqa: BLE001
+            logger.debug("seed stream drain died: %s", exc)
         finally:
             try:
                 resp.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:  # noqa: BLE001
+                logger.debug("seed stream close: %s", exc)

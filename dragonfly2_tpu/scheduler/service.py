@@ -20,6 +20,7 @@ demuxes stream messages:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass
@@ -34,6 +35,8 @@ from . import metrics
 from .networktopology import NetworkTopology, Probe
 from .resource import Host, Peer, Piece, Resource, Task
 from .scheduling import ScheduleResult, ScheduleResultKind, Scheduling
+
+logger = logging.getLogger(__name__)
 
 
 def _try_event(fsm: FSM, name: str) -> bool:
@@ -172,7 +175,8 @@ class SchedulerService:
             if first:
                 try:
                     triggered = self.seed_peer_trigger(task.url, task.id)
-                except Exception:  # noqa: BLE001 — trigger failure → back-to-source
+                except Exception as exc:  # noqa: BLE001 — trigger failure → back-to-source
+                    logger.warning("seed trigger for %s failed: %s", task.id, exc)
                     triggered = False
             if triggered:
                 schedule = self.scheduling.schedule_candidate_parents(peer, blocklist)
@@ -353,7 +357,8 @@ class SchedulerService:
             return
         try:
             children = parent.task.load_children(parent.id)
-        except Exception:  # noqa: BLE001 — parent may already be off the DAG
+        except Exception as exc:  # noqa: BLE001 — parent may already be off the DAG
+            logger.debug("load_children(%s): %s", parent.id, exc)
             return
         for child in children or []:
             if child.id == parent.id or child.is_done():
@@ -388,7 +393,8 @@ class SchedulerService:
                 continue
             try:
                 current = peer.task.load_parents(peer.id)
-            except Exception:  # noqa: BLE001 — raced with GC
+            except Exception as exc:  # noqa: BLE001 — raced with GC
+                logger.debug("load_parents(%s): %s", peer.id, exc)
                 continue
             if not current:
                 continue

@@ -62,8 +62,11 @@ class TopologySync:
         """Push local edges, pull + merge the other replicas'; returns the
         number of remote edges adopted.  Manager outages degrade to the
         local store (and the disk state keeps durability)."""
+        from ..utils import faultinject
+
         adopted = 0
         try:
+            faultinject.fire("scheduler.topology.sync")
             body = json.dumps({
                 "scheduler_id": self.scheduler_id,
                 "edges": self.topology.export_edges(),

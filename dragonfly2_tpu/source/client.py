@@ -3,6 +3,7 @@ clients/httpprotocol, loader/*.go)."""
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import urllib.parse
@@ -22,6 +23,9 @@ class SourceClient(Protocol):
 def default_transport(req: urllib.request.Request, timeout: float):
     """The injectable-transport default shared by the cloud clients
     (tests swap in local fixture servers)."""
+    from ..utils import faultinject
+
+    faultinject.fire("source.transport")
     return urllib.request.urlopen(req, timeout=timeout)
 
 
@@ -156,20 +160,27 @@ class HTTPSourceClient:
         self.timeout = timeout
 
     def content_length(self, url: str, headers: Optional[dict] = None) -> int:
+        from ..utils import faultinject
+
         req = urllib.request.Request(url, headers=headers or {}, method="HEAD")
         try:
+            faultinject.fire("source.content_length")
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 cl = resp.headers.get("Content-Length")
                 return int(cl) if cl is not None else -1
-        except Exception:
+        except Exception as exc:  # noqa: BLE001 — origin won't say → -1
+            logging.getLogger(__name__).debug("HEAD %s: %s", url, exc)
             return -1
 
     def read_range(
         self, url: str, start: int, length: int,
         headers: Optional[dict] = None,
     ) -> bytes:
+        from ..utils import faultinject
+
         all_headers = {"Range": f"bytes={start}-{start + length - 1}"}
         all_headers.update(headers or {})
+        faultinject.fire("source.read_range")
         with urllib.request.urlopen(
             urllib.request.Request(url, headers=all_headers),
             timeout=self.timeout,

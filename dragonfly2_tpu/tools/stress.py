@@ -9,6 +9,7 @@ Library + CLI:  ``python -m dragonfly2_tpu.tools.stress --help``.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -87,7 +88,8 @@ def run_stress(
                 result = download(url)
                 ok = bool(getattr(result, "ok", False))
                 nbytes = int(getattr(result, "bytes", 0))
-            except Exception:  # noqa: BLE001 — load-gen counts failures
+            except Exception as exc:  # noqa: BLE001 — load-gen counts failures
+                logging.getLogger(__name__).debug("download %s failed: %s", url, exc)
                 ok, nbytes = False, 0
             dt = time.perf_counter() - t0
             with lock:
@@ -99,7 +101,9 @@ def run_stress(
                     report.failed += 1
 
     t0 = time.perf_counter()
-    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    threads = [
+        threading.Thread(target=worker, daemon=True) for _ in range(concurrency)
+    ]
     for t in threads:
         t.start()
     for t in threads:

@@ -1,0 +1,754 @@
+"""dflint self-tests: every DF rule fires on a minimal true-positive
+fixture and stays quiet on the accepted shapes, pragmas, and baseline
+entries (tools/dflint — the tier-1 invariant gate's own coverage)."""
+
+from __future__ import annotations
+
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest  # noqa: F401  (parity with the suite's import style)
+
+_REPO = Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:  # `python -m pytest` from elsewhere
+    sys.path.insert(0, str(_REPO))
+
+from tools.dflint.baseline import Baseline, parse_toml_subset, render  # noqa: E402
+from tools.dflint.core import Module, run_checkers  # noqa: E402
+
+
+def lint(source: str, relpath: str = "dragonfly2_tpu/daemon/fixture.py"):
+    src = textwrap.dedent(source)
+    module = Module(Path("/fixture.py"), relpath, src)
+    return run_checkers(module)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# DF001 — exception swallowing
+# ---------------------------------------------------------------------------
+
+
+class TestDF001:
+    def test_silent_pass_fires(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """)
+        assert rules_of(fs) == ["DF001"]
+
+    def test_bare_except_fires(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except:
+                    return None
+        """)
+        assert "DF001" in rules_of(fs)
+
+    def test_logging_call_is_handled(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except Exception as exc:
+                    log.warning("failed: %s", exc)
+        """)
+        assert fs == []
+
+    def test_reraise_is_handled(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except BaseException:
+                    raise
+        """)
+        assert fs == []
+
+    def test_bound_name_use_is_handled(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except Exception as exc:
+                    result = exc
+                return result
+        """)
+        assert fs == []
+
+    def test_narrow_except_is_exempt(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except KeyError:
+                    pass
+        """)
+        assert fs == []
+
+    def test_pragma_suppresses(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except Exception:  # dflint: disable=DF001
+                    pass
+        """)
+        assert fs == []
+
+    def test_file_pragma_suppresses(self):
+        fs = lint("""
+            # dflint: disable-file=DF001
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# DF002 — thread hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestDF002:
+    def test_thread_without_daemon_fires(self):
+        fs = lint("""
+            import threading
+
+            def start():
+                t = threading.Thread(target=loop)
+                t.start()
+        """)
+        assert rules_of(fs) == ["DF002"]
+
+    def test_daemon_kwarg_ok(self):
+        fs = lint("""
+            import threading
+
+            def start():
+                threading.Thread(target=loop, daemon=True).start()
+        """)
+        assert fs == []
+
+    def test_joined_thread_still_needs_explicit_daemon(self):
+        fs = lint("""
+            import threading
+
+            def run_all():
+                ts = [threading.Thread(target=loop) for _ in range(4)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+        """)
+        assert rules_of(fs) == ["DF002"]
+        assert any("implicit" in f.message for f in fs)
+
+    def test_joined_thread_with_explicit_daemon_false_ok(self):
+        fs = lint("""
+            import threading
+
+            def run_all():
+                ts = [threading.Thread(target=loop, daemon=False) for _ in range(4)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+        """)
+        assert fs == []
+
+    def test_unlocked_shared_mutation_fires(self):
+        fs = lint("""
+            import threading
+
+            class Worker:
+                def start(self):
+                    threading.Thread(target=self._loop, daemon=True).start()
+
+                def _loop(self):
+                    self.count += 1
+
+                def reset(self):
+                    self.count = 0
+        """)
+        assert "DF002" in rules_of(fs)
+        assert any("reset" in f.message for f in fs)
+
+    def test_locked_mutation_ok(self):
+        fs = lint("""
+            import threading
+
+            class Worker:
+                def start(self):
+                    threading.Thread(target=self._loop, daemon=True).start()
+
+                def _loop(self):
+                    with self._mu:
+                        self.count += 1
+
+                def reset(self):
+                    with self._mu:
+                        self.count = 0
+        """)
+        assert fs == []
+
+    def test_private_method_mutation_not_flagged(self):
+        fs = lint("""
+            import threading
+
+            class Worker:
+                def start(self):
+                    threading.Thread(target=self._loop, daemon=True).start()
+
+                def _loop(self):
+                    self.count += 1
+
+                def _internal(self):
+                    self.count = 0
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# DF003 — JAX trace purity
+# ---------------------------------------------------------------------------
+
+
+class TestDF003:
+    def test_time_in_jit_decorator_fires(self):
+        fs = lint("""
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                t0 = time.time()
+                return x + t0
+        """)
+        assert rules_of(fs) == ["DF003"]
+
+    def test_wrapped_method_resolution(self):
+        fs = lint("""
+            import jax
+
+            class Trainer:
+                def __init__(self):
+                    self._fn = jax.jit(self._step)
+
+                def _step(self, x):
+                    print(x)
+                    return x
+        """)
+        assert rules_of(fs) == ["DF003"]
+
+    def test_partial_jit_decorator(self):
+        fs = lint("""
+            import random
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, static_argnames="n")
+            def step(x, n):
+                return x * random.random()
+        """)
+        assert rules_of(fs) == ["DF003"]
+
+    def test_item_escape_fires(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                return float(x.sum().item())
+        """)
+        assert "DF003" in rules_of(fs)
+
+    def test_np_asarray_fires(self):
+        fs = lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                return np.asarray(x)
+        """)
+        assert "DF003" in rules_of(fs)
+
+    def test_jax_random_exempt(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def step(key, x):
+                noise = jax.random.normal(key, x.shape)
+                return x + noise
+        """)
+        assert fs == []
+
+    def test_untraced_function_free(self):
+        fs = lint("""
+            import time
+
+            def host_loop(x):
+                time.sleep(1)
+                print(x)
+        """)
+        assert fs == []
+
+    def test_pallas_kernel_resolution(self):
+        fs = lint("""
+            import time
+            import jax
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                time.sleep(0.1)
+                o_ref[...] = x_ref[...]
+
+            def run(x):
+                return pl.pallas_call(kernel, out_shape=x)(x)
+        """)
+        assert rules_of(fs) == ["DF003"]
+
+
+# ---------------------------------------------------------------------------
+# DF004 — fault-seam coverage
+# ---------------------------------------------------------------------------
+
+
+class TestDF004:
+    def test_urlopen_without_fire_fires(self):
+        fs = lint("""
+            import urllib.request
+
+            def fetch(url):
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    return resp.read()
+        """)
+        assert rules_of(fs) == ["DF004"]
+
+    def test_urlopen_with_fire_ok(self):
+        fs = lint("""
+            import urllib.request
+            from dragonfly2_tpu.utils import faultinject
+
+            def fetch(url):
+                faultinject.fire("fixture.fetch")
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    return resp.read()
+        """)
+        assert fs == []
+
+    def test_socket_send_without_fire_fires(self):
+        fs = lint("""
+            def push(sock, data):
+                sock.sendall(data)
+        """)
+        assert rules_of(fs) == ["DF004"]
+
+    def test_allowlisted_module_exempt(self):
+        fs = lint(
+            """
+            import urllib.request
+
+            def export(url):
+                urllib.request.urlopen(url, timeout=5).close()
+            """,
+            relpath="dragonfly2_tpu/utils/tracing.py",
+        )
+        assert fs == []
+
+    def test_fire_in_other_function_does_not_cover(self):
+        fs = lint("""
+            from dragonfly2_tpu.utils import faultinject
+
+            def seam():
+                faultinject.fire("fixture.other")
+
+            def push(sock, data):
+                sock.sendall(data)
+        """)
+        assert rules_of(fs) == ["DF004"]
+
+    def test_seam_inventory_missing_site_fires(self):
+        # daemon/upload.py owns two required sites; a module with only
+        # one of them must be flagged for the other.
+        fs = lint(
+            """
+            from ..utils import faultinject
+
+            def serve_piece(task_id, number):
+                faultinject.fire("daemon.upload.serve_piece")
+                return b""
+            """,
+            relpath="dragonfly2_tpu/daemon/upload.py",
+        )
+        assert rules_of(fs) == ["DF004"]
+        assert any("daemon.upload.body" in f.message for f in fs)
+
+    def test_seam_inventory_fstring_prefix_matches(self):
+        fs = lint(
+            """
+            from ..utils import faultinject
+
+            def call(self, method):
+                faultinject.fire(f"rpc.client.{method}")
+            """,
+            relpath="dragonfly2_tpu/rpc/scheduler_client.py",
+        )
+        assert [f for f in fs if f.rule == "DF004"] == []
+
+    def test_real_seam_modules_satisfy_inventory(self):
+        from tools.dflint.checkers.df004_fault_seams import (
+            REQUIRED_SEAMS, fire_sites,
+        )
+        from tools.dflint.core import load_module
+
+        repo = Path(__file__).resolve().parents[1]
+        for relpath, required in REQUIRED_SEAMS.items():
+            module = load_module(repo / relpath, repo)
+            present = fire_sites(module)
+            missing = [s for s in required if s not in present]
+            assert not missing, f"{relpath}: missing seams {missing}"
+
+
+# ---------------------------------------------------------------------------
+# DF005 — resource hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestDF005:
+    def test_discarded_open_fires(self):
+        fs = lint("""
+            def touch(path):
+                f = open(path, "w")
+                f.write("x")
+        """)
+        assert rules_of(fs) == ["DF005"]
+
+    def test_with_ok(self):
+        fs = lint("""
+            def touch(path):
+                with open(path, "w") as f:
+                    f.write("x")
+        """)
+        assert fs == []
+
+    def test_immediate_close_ok(self):
+        fs = lint("""
+            def touch(path):
+                open(path, "wb").close()
+        """)
+        assert fs == []
+
+    def test_tracked_close_in_finally_ok(self):
+        fs = lint("""
+            import socket
+
+            def probe():
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                try:
+                    s.connect(("10.0.0.1", 1))
+                    return s.getsockname()[0]
+                finally:
+                    s.close()
+        """)
+        assert fs == []
+
+    def test_self_owned_ok(self):
+        fs = lint("""
+            class Store:
+                def __init__(self, path):
+                    self._f = open(path, "ab")
+
+                def close(self):
+                    self._f.close()
+        """)
+        assert fs == []
+
+    def test_factory_return_ok(self):
+        fs = lint("""
+            import socket
+
+            def connect(cid, port):
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.connect((cid, port))
+                return s
+        """)
+        assert fs == []
+
+    def test_expression_statement_open_fires(self):
+        fs = lint("""
+            def leak(path):
+                open(path, "w").read()
+        """)
+        assert rules_of(fs) == ["DF005"]
+
+
+# ---------------------------------------------------------------------------
+# DF006 — deadline propagation in rpc/
+# ---------------------------------------------------------------------------
+
+RPC_PATH = "dragonfly2_tpu/rpc/fixture.py"
+
+
+class TestDF006:
+    def test_retry_without_deadline_fires(self):
+        fs = lint(
+            """
+            from .retry import retry_call
+
+            def call(fn):
+                return retry_call(fn, attempts=3)
+            """,
+            relpath=RPC_PATH,
+        )
+        assert rules_of(fs) == ["DF006"]
+
+    def test_deadline_passed_but_not_accepted_fires(self):
+        fs = lint(
+            """
+            from .retry import retry_call
+
+            def call(fn):
+                return retry_call(fn, deadline_s=5.0)
+            """,
+            relpath=RPC_PATH,
+        )
+        assert rules_of(fs) == ["DF006"]
+
+    def test_threaded_deadline_ok(self):
+        fs = lint(
+            """
+            from .retry import retry_call
+
+            def call(fn, *, deadline_s=None):
+                return retry_call(fn, deadline_s=deadline_s)
+            """,
+            relpath=RPC_PATH,
+        )
+        assert fs == []
+
+    def test_urlopen_without_timeout_fires(self):
+        fs = lint(
+            """
+            import urllib.request
+            from dragonfly2_tpu.utils import faultinject
+
+            def get(url):
+                faultinject.fire("rpc.fixture.get")
+                with urllib.request.urlopen(url) as resp:
+                    return resp.read()
+            """,
+            relpath=RPC_PATH,
+        )
+        assert rules_of(fs) == ["DF006"]
+
+    def test_outside_rpc_exempt(self):
+        fs = lint("""
+            from dragonfly2_tpu.rpc.retry import retry_call
+
+            def call(fn):
+                return retry_call(fn, attempts=3)
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline machinery
+# ---------------------------------------------------------------------------
+
+
+class TestBaseline:
+    def _findings(self):
+        return lint("""
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+
+            def g():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """)
+
+    def test_split_budget(self):
+        findings = self._findings()
+        assert len(findings) == 2
+        key_f = next(f for f in findings if f.qual == "f").key()
+        bl = Baseline({key_f: 1})
+        new, accepted = bl.split(findings)
+        assert [f.qual for f in accepted] == ["f"]
+        assert [f.qual for f in new] == ["g"]
+
+    def test_budget_overflow_is_new(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+                try:
+                    more()
+                except Exception:
+                    pass
+        """)
+        assert len(fs) == 2
+        bl = Baseline({fs[0].key(): 1})   # both share the key (same qual)
+        new, accepted = bl.split(fs)
+        assert len(accepted) == 1 and len(new) == 1
+
+    def test_stale_keys_reported(self):
+        bl = Baseline({"DF001:gone.py:f": 1})
+        assert bl.stale_keys([]) == ["DF001:gone.py:f"]
+
+    def test_round_trip_through_toml(self, tmp_path):
+        findings = self._findings()
+        path = tmp_path / "baseline.toml"
+        path.write_text(render(findings), encoding="utf-8")
+        bl = Baseline.load(path)
+        new, accepted = bl.split(findings)
+        assert new == [] and len(accepted) == 2
+
+    def test_toml_subset_parser(self):
+        data = parse_toml_subset(
+            '# comment\n[accepted]\n"DF001:a.py:f" = 2  # trailing\nplain = "x"\n'
+        )
+        assert data["accepted"]["DF001:a.py:f"] == 2
+        assert data["accepted"]["plain"] == "x"
+
+    def test_checked_in_baseline_parses(self):
+        from tools.dflint.baseline import DEFAULT_PATH
+
+        bl = Baseline.load(DEFAULT_PATH)
+        assert isinstance(bl.budgets, dict)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+class TestCLI:
+    def test_exit_zero_on_clean_file(self, tmp_path, capsys):
+        from tools.dflint.__main__ import main
+
+        clean = tmp_path / "clean.py"
+        clean.write_text("def f():\n    return 1\n")
+        assert main([str(clean)]) == 0
+
+    def test_exit_nonzero_on_finding(self, tmp_path, capsys):
+        from tools.dflint.__main__ import main
+
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(
+            "def f():\n    try:\n        g()\n    except Exception:\n        pass\n"
+        )
+        assert main([str(dirty)]) == 1
+        out = capsys.readouterr().out
+        assert "DF001" in out
+
+    def test_select_filters_rules(self, tmp_path, capsys):
+        from tools.dflint.__main__ import main
+
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(
+            "def f():\n    try:\n        g()\n    except Exception:\n        pass\n"
+        )
+        assert main([str(dirty), "--select", "DF004"]) == 0
+
+    def test_parse_error_exit_code(self, tmp_path, capsys):
+        from tools.dflint.__main__ import main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f(:\n")
+        assert main([str(bad)]) == 2
+
+    def test_list_rules(self, capsys):
+        from tools.dflint.__main__ import main
+
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("DF001", "DF002", "DF003", "DF004", "DF005", "DF006"):
+            assert rule in out
+
+
+# ---------------------------------------------------------------------------
+# Mutation sensitivity against the REAL tree (the acceptance contract:
+# deleting a seam or a daemon= kwarg must fail the lint test by name)
+# ---------------------------------------------------------------------------
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+class TestMutationSensitivity:
+    def _lint_source(self, relpath: str, source: str):
+        module = Module(REPO / relpath, relpath, source)
+        return run_checkers(module)
+
+    def test_current_tree_is_clean(self):
+        src_path = REPO / "dragonfly2_tpu/rpc/piece_transport.py"
+        fs = self._lint_source(
+            "dragonfly2_tpu/rpc/piece_transport.py",
+            src_path.read_text(encoding="utf-8"),
+        )
+        assert fs == []
+
+    def test_deleting_fire_seam_fails_df004(self):
+        # download_via_daemon has exactly one seam guarding its urlopen;
+        # removing it must re-expose the raw network call.
+        relpath = "dragonfly2_tpu/rpc/daemon_control.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        assert 'faultinject.fire("daemon.control.download")' in source
+        mutated = source.replace(
+            'faultinject.fire("daemon.control.download")', "pass"
+        )
+        fs = self._lint_source(relpath, mutated)
+        assert "DF004" in {f.rule for f in fs}
+
+    def test_deleting_both_piece_fetch_seams_fails_df004(self):
+        relpath = "dragonfly2_tpu/rpc/piece_transport.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        mutated = source.replace(
+            'faultinject.fire("piece.fetch")', "pass"
+        ).replace('faultinject.fire("piece.fetch.body", resp.read())',
+                  "resp.read()")
+        assert mutated != source
+        fs = self._lint_source(relpath, mutated)
+        assert "DF004" in {f.rule for f in fs}
+
+    def test_deleting_daemon_kwarg_fails_df002(self):
+        relpath = "dragonfly2_tpu/scheduler/push.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        assert "daemon=True" in source
+        mutated = source.replace("daemon=True", "").replace(
+            ", \n", "\n"
+        )
+        fs = self._lint_source(relpath, mutated)
+        assert "DF002" in {f.rule for f in fs}
+
+    def test_deleting_daemon_kwarg_on_joined_thread_fails_df002(self):
+        # conductor's piece workers are join()ed, but the daemon flag must
+        # still be explicit — deleting it is a lint regression, not a pass.
+        relpath = "dragonfly2_tpu/daemon/conductor.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        assert ", daemon=True)" in source
+        mutated = source.replace(", daemon=True)", ")")
+        assert mutated != source
+        fs = self._lint_source(relpath, mutated)
+        assert "DF002" in {f.rule for f in fs}

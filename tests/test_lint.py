@@ -1,0 +1,48 @@
+"""Tier-1 invariant gate: dflint over every dragonfly2_tpu source file,
+one parametrized test per file so a regression names the file that
+broke.  A finding here means a project invariant was violated —
+exception swallowing (DF001), thread hygiene (DF002), JAX trace purity
+(DF003), a fault seam deleted (DF004), a leaked fd (DF005), or deadline
+propagation dropped in rpc/ (DF006).
+
+Accepted pre-existing findings live in tools/dflint/baseline.toml;
+reviewed contract-true silences carry `# dflint: disable=DFxxx`
+pragmas inline.  Everything else fails.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:  # `python -m pytest` from elsewhere
+    sys.path.insert(0, str(REPO))
+
+from tools.dflint.baseline import Baseline  # noqa: E402
+from tools.dflint.core import collect_files, load_module, run_checkers  # noqa: E402
+
+SOURCE_FILES = collect_files([REPO / "dragonfly2_tpu"], REPO)
+BASELINE = Baseline.load()
+
+
+@pytest.mark.parametrize(
+    "path",
+    SOURCE_FILES,
+    ids=[p.resolve().relative_to(REPO).as_posix() for p in SOURCE_FILES],
+)
+def test_dflint_clean(path):
+    module = load_module(path, REPO)
+    new, _accepted = BASELINE.split(run_checkers(module))
+    assert not new, "dflint findings:\n" + "\n".join(f.render() for f in new)
+
+
+def test_no_stale_baseline_entries():
+    """Fixed violations must leave the baseline too, or the budget
+    silently covers the NEXT regression in that function."""
+    findings = []
+    for path in SOURCE_FILES:
+        findings.extend(run_checkers(load_module(path, REPO)))
+    assert BASELINE.stale_keys(findings) == []

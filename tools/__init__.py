@@ -1,0 +1,3 @@
+"""Measurement harnesses (scripts) and the dflint static analyzer
+(``python -m tools.dflint``).  The scripts stay directly runnable; this
+package marker exists so dflint is importable as ``tools.dflint``."""

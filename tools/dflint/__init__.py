@@ -1,0 +1,27 @@
+"""dflint — AST-based project invariant checker for dragonfly2_tpu.
+
+Run: ``python -m tools.dflint dragonfly2_tpu/`` (exit 0 = no findings
+beyond the checked-in baseline).  Tier-1 runs the same checks per file
+via ``tests/test_lint.py``.
+
+Rules:
+
+- DF001 exception swallowing
+- DF002 thread hygiene (daemon=/join, locked shared mutation)
+- DF003 JAX trace purity
+- DF004 fault-seam coverage (faultinject.fire adjacency)
+- DF005 resource hygiene (open/socket lifetime)
+- DF006 deadline propagation in rpc/
+"""
+
+from .baseline import Baseline
+from .core import Finding, Module, load_module, run_checkers, run_paths
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "Module",
+    "load_module",
+    "run_checkers",
+    "run_paths",
+]

@@ -1,0 +1,85 @@
+"""CLI: ``python -m tools.dflint <paths...>``.
+
+Exit codes: 0 — no new findings (baseline-accepted ones are counted but
+don't fail); 1 — new findings (the CI gate); 2 — usage/parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .baseline import DEFAULT_PATH, Baseline, render
+from .checkers import CHECKERS
+from .core import run_paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.dflint",
+        description="AST-based project invariant checker (DF001-DF006)",
+    )
+    parser.add_argument("paths", nargs="*", default=["dragonfly2_tpu"],
+                        help="files/directories to check (default: dragonfly2_tpu)")
+    parser.add_argument("--baseline", default=str(DEFAULT_PATH),
+                        help="baseline file (accepted pre-existing findings)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, accepted or not")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="accept ALL current findings into the baseline file")
+    parser.add_argument("--select", default="",
+                        help="comma-separated rules to run (e.g. DF001,DF004)")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="summary only, no per-finding lines")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for c in CHECKERS:
+            print(f"{c.RULE}  {c.TITLE}")
+        return 0
+
+    checkers = None
+    if args.select:
+        wanted = {r.strip().upper() for r in args.select.split(",") if r.strip()}
+        unknown = wanted - {c.RULE for c in CHECKERS}
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+        checkers = [c for c in CHECKERS if c.RULE in wanted]
+
+    root = Path.cwd()
+    result = run_paths([Path(p) for p in args.paths], root, checkers)
+    for err in result.errors:
+        print(f"error: {err}", file=sys.stderr)
+
+    if args.write_baseline:
+        Path(args.baseline).write_text(render(result.findings), encoding="utf-8")
+        print(f"wrote {len(result.findings)} accepted finding(s) to {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        new, accepted = list(result.findings), []
+        stale = []
+    else:
+        baseline = Baseline.load(Path(args.baseline))
+        new, accepted = baseline.split(result.findings)
+        stale = baseline.stale_keys(result.findings)
+
+    if not args.quiet:
+        for f in new:
+            print(f.render())
+        for key in stale:
+            print(f"note: stale baseline entry (violation fixed?): {key}")
+    print(
+        f"dflint: {len(new)} new finding(s), {len(accepted)} baseline-accepted, "
+        f"{len(result.errors)} parse error(s)"
+    )
+    if result.errors:
+        return 2
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

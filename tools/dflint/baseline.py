@@ -1,0 +1,120 @@
+"""Baseline (accepted pre-existing findings) for dflint.
+
+``baseline.toml`` pins the findings that predate a rule or were reviewed
+and accepted, keyed by ``RULE:relpath:qualname`` (see
+``Finding.key()``) — stable across line-number churn, while any NEW
+violation in the same file still fails the gate.  Each key carries an
+integer budget: a file may hold at most that many findings with the key,
+so adding a second violation next to an accepted one is caught too.
+
+The file is real TOML, but the interpreter here is a deliberate subset
+(Python 3.10 ships no ``tomllib`` and the container must not grow deps):
+``[section]`` headers, ``key = int``, ``key = "str"`` and
+``key = [ "str", ... ]`` arrays, ``#`` comments.  Keys with dots/colons
+must be quoted — the writer below always quotes.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from pathlib import Path
+from typing import Dict, Iterable, List, Tuple
+
+from .core import Finding
+
+DEFAULT_PATH = Path(__file__).with_name("baseline.toml")
+
+_SECTION = re.compile(r"^\[([^\]]+)\]\s*$")
+_KV = re.compile(r'^(?:"([^"]+)"|([A-Za-z0-9_.:-]+))\s*=\s*(.+)$')
+
+
+def _parse_value(raw: str):
+    raw = raw.strip()
+    if raw.startswith("["):
+        inner = raw.strip()[1:-1]
+        return [v.strip().strip('"') for v in inner.split(",") if v.strip()]
+    if raw.startswith('"'):
+        return raw.strip('"')
+    return int(raw)
+
+
+def parse_toml_subset(text: str) -> Dict[str, dict]:
+    data: Dict[str, dict] = {}
+    section: Dict[str, object] = data.setdefault("", {})  # top level
+    for i, line in enumerate(text.splitlines(), 1):
+        # Strip a trailing comment: the first '#' preceded by an even
+        # number of quotes is outside any string.
+        cut = len(line)
+        for j, ch in enumerate(line):
+            if ch == "#" and line[:j].count('"') % 2 == 0:
+                cut = j
+                break
+        stripped = line[:cut].strip()
+        if not stripped:
+            continue
+        m = _SECTION.match(stripped)
+        if m:
+            section = data.setdefault(m.group(1), {})
+            continue
+        m = _KV.match(stripped)
+        if not m:
+            raise ValueError(f"baseline.toml:{i}: cannot parse {line!r}")
+        key = m.group(1) or m.group(2)
+        section[key] = _parse_value(m.group(3))
+    return data
+
+
+class Baseline:
+    """Budgeted accepted-finding set: ``key -> max count``."""
+
+    def __init__(self, budgets: Dict[str, int]) -> None:
+        self.budgets = dict(budgets)
+
+    @classmethod
+    def load(cls, path: Path = DEFAULT_PATH) -> "Baseline":
+        if not path.exists():
+            return cls({})
+        data = parse_toml_subset(path.read_text(encoding="utf-8"))
+        budgets: Dict[str, int] = {}
+        for key, value in data.get("accepted", {}).items():
+            budgets[key] = int(value)
+        return cls(budgets)
+
+    def split(
+        self, findings: Iterable[Finding]
+    ) -> Tuple[List[Finding], List[Finding]]:
+        """(new, accepted): per key, the first ``budget`` findings are
+        accepted (source order), the overflow is new."""
+        used: Counter = Counter()
+        new: List[Finding] = []
+        accepted: List[Finding] = []
+        for f in findings:
+            key = f.key()
+            if used[key] < self.budgets.get(key, 0):
+                used[key] += 1
+                accepted.append(f)
+            else:
+                new.append(f)
+        return new, accepted
+
+    def stale_keys(self, findings: Iterable[Finding]) -> List[str]:
+        """Baseline entries no finding matched — candidates for removal
+        (the violation was fixed; keep the file honest)."""
+        present = Counter(f.key() for f in findings)
+        return sorted(k for k in self.budgets if not present.get(k))
+
+
+def render(findings: Iterable[Finding]) -> str:
+    """Serialize findings as a fresh baseline.toml body."""
+    counts = Counter(f.key() for f in findings)
+    lines = [
+        "# dflint baseline — accepted pre-existing findings.",
+        '# Key: "RULE:relpath:qualname" = <max findings with this key>.',
+        "# Regenerate: python -m tools.dflint <paths> --write-baseline",
+        "",
+        "[accepted]",
+    ]
+    for key in sorted(counts):
+        lines.append(f'"{key}" = {counts[key]}')
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,22 @@
+"""Checker registry.  Each module exposes ``RULE``, ``TITLE`` and
+``check(module) -> Iterable[Finding]``; order here is report order."""
+
+from . import (
+    df001_exceptions,
+    df002_threads,
+    df003_jax_purity,
+    df004_fault_seams,
+    df005_resources,
+    df006_deadlines,
+)
+
+CHECKERS = (
+    df001_exceptions,
+    df002_threads,
+    df003_jax_purity,
+    df004_fault_seams,
+    df005_resources,
+    df006_deadlines,
+)
+
+RULES = {c.RULE: c for c in CHECKERS}

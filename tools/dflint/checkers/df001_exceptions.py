@@ -1,0 +1,73 @@
+"""DF001 — exception swallowing.
+
+A broad handler (bare ``except:``, ``except BaseException``,
+``except Exception``) that discards the error — no re-raise, no call
+(logging, metric, cleanup, error response), no use of the bound
+exception — hides real failures.  PR 1's chaos drills inject typed
+errors precisely so they surface; a silent ``except Exception: pass``
+at a seam turns an injected fault into a wrong answer.
+
+Fix by logging (``log.warning("...: %s", exc)``) and continuing, or by
+narrowing the except type, or by re-raising.  A site where silence IS
+the contract gets ``# dflint: disable=DF001`` with a justifying comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, Module
+
+RULE = "DF001"
+TITLE = "broad except swallows the error (no log / re-raise / use)"
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:
+        return True  # bare except
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(elt) for elt in type_node.elts)
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Attribute):
+        return type_node.attr in _BROAD
+    return False
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    """Does the body do ANYTHING with the failure?  A raise, any call
+    (logging / metric / fallback work), or a read of the bound name all
+    count — the goal is catching pure discards, not auditing style."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Call)):
+            return True
+        if (
+            handler.name
+            and isinstance(node, ast.Name)
+            and node.id == handler.name
+            and isinstance(node.ctx, ast.Load)
+        ):
+            return True
+    return False
+
+
+def check(module: Module) -> Iterator[Finding]:
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node.type):
+            continue
+        if _handles(node):
+            continue
+        shape = (
+            "bare except" if node.type is None
+            else f"except {ast.unparse(node.type)}"
+        )
+        yield module.finding(
+            RULE,
+            node,
+            f"{shape} discards the error silently — log it, use it, or re-raise",
+        )

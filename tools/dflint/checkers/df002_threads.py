@@ -1,0 +1,170 @@
+"""DF002 — thread hygiene.
+
+Two invariants, both standing in for Go's ``-race`` + structured
+goroutine shutdown:
+
+1. **Explicit daemon flag.**  ``threading.Thread(...)`` must pass
+   ``daemon=`` explicitly — ``daemon=False`` is fine when the starter
+   also ``join()``s, but the choice has to be written down.  A
+   non-daemon thread someone forgot about keeps the interpreter alive —
+   test runs and CLI shutdown hang on stray threads instead of exiting —
+   and an implicit default hides which behaviour the author intended.
+   A ``join()``-only site additionally flags until the flag is spelled
+   out, so deleting a ``daemon=`` kwarg anywhere is a lint regression.
+
+2. **Lock shared mutations.**  Within a class that starts a thread with
+   ``target=self._x``, an attribute assigned both inside the thread
+   target and inside a public (externally-called) method is a data race
+   unless at least the unguarded side sits under a ``with self.<lock>``
+   block.  (Heuristic: any ``with`` over a ``self.*`` attribute counts
+   as a lock scope; single-assignment handshakes belong under one.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, Module, has_kwarg, walk_calls
+
+RULE = "DF002"
+TITLE = "thread started without explicit daemon=, or unlocked shared mutation"
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread":
+        return True
+    if isinstance(f, ast.Name) and f.id == "Thread":
+        return True
+    return False
+
+
+def _scope_has_join(module: Module, node: ast.AST) -> bool:
+    scope = module.enclosing_function(node) or module.tree
+    for call in walk_calls(scope):
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "join":
+            return True
+    return False
+
+
+# -- invariant 2: shared-attribute mutations --------------------------------
+
+
+def _thread_target_methods(cls: ast.ClassDef) -> Set[str]:
+    """Names of ``self._x`` methods used as ``Thread(target=self._x)``."""
+    targets: Set[str] = set()
+    for call in walk_calls(cls):
+        if not _is_thread_ctor(call):
+            continue
+        for kw in call.keywords:
+            if kw.arg == "target" and isinstance(kw.value, ast.Attribute):
+                v = kw.value
+                if isinstance(v.value, ast.Name) and v.value.id == "self":
+                    targets.add(v.attr)
+    return targets
+
+
+def _under_self_with(module: Module, node: ast.AST) -> bool:
+    """Is ``node`` lexically inside ``with self.<attr>`` (a lock scope)?"""
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                if (
+                    isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"
+                ):
+                    return True
+        cur = module.parent(cur)
+    return False
+
+
+def _self_attr_writes(
+    module: Module, fn: ast.FunctionDef
+) -> List[Tuple[str, ast.AST, bool]]:
+    """(attr, node, guarded) for every ``self.attr`` assignment in ``fn``
+    proper (nested defs are their own scope, not this thread's body)."""
+    out: List[Tuple[str, ast.AST, bool]] = []
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            targets: List[ast.AST] = []
+            if isinstance(child, ast.Assign):
+                targets = child.targets
+            elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+                targets = [child.target]
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    out.append((t.attr, child, _under_self_with(module, child)))
+            visit(child)
+
+    visit(fn)
+    return out
+
+
+def check(module: Module) -> Iterator[Finding]:
+    # 1. explicit-daemon discipline
+    for call in walk_calls(module.tree):
+        if not _is_thread_ctor(call):
+            continue
+        if has_kwarg(call, "daemon"):
+            continue
+        if _scope_has_join(module, call):
+            yield module.finding(
+                RULE,
+                call,
+                "Thread() join()ed here but daemon= left implicit — spell "
+                "out daemon=True/False so the shutdown contract is explicit",
+            )
+        else:
+            yield module.finding(
+                RULE,
+                call,
+                "Thread() without daemon= and never join()ed here — a stray "
+                "non-daemon thread blocks interpreter exit",
+            )
+
+    # 2. unlocked mutation shared between a thread target and a public method
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        targets = _thread_target_methods(node)
+        if not targets:
+            continue
+        methods = {
+            m.name: m
+            for m in node.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        target_writes: Dict[str, List[Tuple[ast.AST, bool]]] = {}
+        for name in targets & set(methods):
+            for attr, site, guarded in _self_attr_writes(module, methods[name]):
+                target_writes.setdefault(attr, []).append((site, guarded))
+        if not target_writes:
+            continue
+        for name, m in methods.items():
+            if name.startswith("_") or name in targets:
+                continue
+            for attr, site, guarded in _self_attr_writes(module, m):
+                if attr not in target_writes or guarded:
+                    continue
+                # Even when the thread side always holds the lock, a
+                # racing unguarded public write is still a race.
+                yield module.finding(
+                    RULE,
+                    site,
+                    f"self.{attr} is written by thread target(s) "
+                    f"{sorted(targets & set(methods))} and by public "
+                    f"{name}() without a lock held here",
+                )

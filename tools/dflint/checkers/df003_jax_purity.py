@@ -1,0 +1,133 @@
+"""DF003 — JAX trace purity.
+
+Functions handed to ``jax.jit`` / ``pjit`` / ``shard_map`` /
+``pl.pallas_call`` run ONCE at trace time; side effects inside them
+execute at trace, silently vanish on cache hits, and — for value
+escapes like ``.item()`` / ``np.asarray`` on tracers — raise
+``TracerArrayConversionError`` only on the first real input.  The
+ROADMAP's TPU north-star leans on these staying pure; this rule takes
+them off the honor system.
+
+Flagged inside a traced function: ``time.*``, ``random.*`` /
+``np.random.*`` (module-level RNG: trace-frozen randomness), ``print``,
+file I/O (``open``), ``.item()`` / ``.tolist()``, ``np.asarray`` /
+``np.array`` / ``float()`` / ``int()`` on non-literal values, and
+``os.environ`` reads.  ``jax.random`` (keyed, functional) and
+``jax.debug.*`` (trace-aware) are exempt.
+
+Traced functions are found both by decorator (``@jax.jit``,
+``@partial(jax.jit, ...)``) and by wrapping-call resolution:
+``jax.jit(self._step)`` / ``jax.jit(fn)`` / ``pl.pallas_call(kernel,
+...)`` resolve the named def in the same module/class.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from ..core import Finding, Module, dotted, walk_calls
+
+RULE = "DF003"
+TITLE = "impure operation inside a jit/pjit/shard_map/pallas function"
+
+_TRACE_ENTRY = {"jit", "pjit", "shard_map", "pallas_call"}
+
+
+def _is_trace_wrapper(node: ast.AST) -> bool:
+    """Is this expression jax.jit / pjit / shard_map / pallas_call or a
+    functools.partial over one of them?"""
+    name = dotted(node)
+    if name and name.split(".")[-1] in _TRACE_ENTRY:
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted(node.func)
+        if fname and fname.split(".")[-1] == "partial" and node.args:
+            return _is_trace_wrapper(node.args[0])
+        # Decorator factories like jax.jit(static_argnames=...) applied
+        # via @jax.jit(...)(fn) shapes.
+        return _is_trace_wrapper(node.func)
+    return False
+
+
+def _wrapped_function_names(module: Module) -> Set[str]:
+    """Bare names / method names passed as first arg to a trace wrapper:
+    ``jax.jit(step)`` -> {"step"}, ``jax.jit(self._step)`` -> {"_step"}."""
+    out: Set[str] = set()
+    for call in walk_calls(module.tree):
+        if not _is_trace_wrapper(call.func):
+            continue
+        if not call.args:
+            continue
+        arg = call.args[0]
+        # Unwrap partial(fn, ...)
+        if isinstance(arg, ast.Call):
+            fname = dotted(arg.func)
+            if fname and fname.split(".")[-1] == "partial" and arg.args:
+                arg = arg.args[0]
+        if isinstance(arg, ast.Name):
+            out.add(arg.id)
+        elif isinstance(arg, ast.Attribute):
+            out.add(arg.attr)
+    return out
+
+
+def _traced_defs(module: Module) -> List[ast.FunctionDef]:
+    wrapped = _wrapped_function_names(module)
+    defs: List[ast.FunctionDef] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if any(_is_trace_wrapper(d) for d in node.decorator_list):
+            defs.append(node)
+        elif node.name in wrapped:
+            defs.append(node)
+    return defs
+
+
+_IMPURE_ROOTS = {"time", "random"}
+_IMPURE_DOTTED_PREFIXES = (
+    "np.random.", "numpy.random.", "os.environ", "os.getenv",
+)
+_VALUE_ESCAPES = {"item", "tolist"}
+_HOST_ARRAY = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+_EXEMPT_PREFIXES = ("jax.random.", "jax.debug.", "jax.experimental.",
+                    "random.PRNGKey")
+
+
+def _impurity(call: ast.Call) -> Optional[str]:
+    name = dotted(call.func)
+    if name:
+        if any(name.startswith(p) for p in _EXEMPT_PREFIXES):
+            return None
+        root = name.split(".")[0]
+        if root in _IMPURE_ROOTS and "." in name:
+            return f"{name}() is host-side (runs at trace time only)"
+        if any(name.startswith(p) for p in _IMPURE_DOTTED_PREFIXES):
+            return f"{name} is host-side (runs at trace time only)"
+        if name == "print":
+            return "print() inside a traced function (use jax.debug.print)"
+        if name == "open":
+            return "file I/O inside a traced function"
+        if name in _HOST_ARRAY:
+            return f"{name}() forces the tracer to host memory"
+    if isinstance(call.func, ast.Attribute) and call.func.attr in _VALUE_ESCAPES:
+        return (
+            f".{call.func.attr}() escapes the tracer to a Python value "
+            "(TracerArrayConversionError on real inputs)"
+        )
+    return None
+
+
+def check(module: Module) -> Iterator[Finding]:
+    seen: Set[int] = set()
+    for fn in _traced_defs(module):
+        for call in walk_calls(fn):
+            if id(call) in seen:
+                continue
+            seen.add(id(call))
+            msg = _impurity(call)
+            if msg:
+                yield module.finding(
+                    RULE, call, f"in traced {fn.name}(): {msg}"
+                )

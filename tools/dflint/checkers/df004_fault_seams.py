@@ -1,0 +1,210 @@
+"""DF004 — fault-seam coverage.
+
+PR 1's chaos drills only prove what the seams cover: every raw network
+operation on the P2P control/data planes must sit in a function that
+also calls ``faultinject.fire(...)``, or the drills silently stop
+exercising that path.  This rule is the enforcement the fault-injection
+layer was missing — deleting a seam now fails tier-1 by name.
+
+Two sub-rules:
+
+1. **Adjacency** — raw network operations (socket ``send``/``sendall``/
+   ``sendto`` / ``recv``/``recvfrom``/``recv_into``,
+   ``urllib.request.urlopen``, ``http.client`` request/response calls)
+   must share an enclosing function with a ``faultinject.fire(...)``
+   call, matching how every existing seam is laid out.
+
+2. **Inventory** — ``REQUIRED_SEAMS`` pins each seam-bearing module to
+   the site names it must fire.  Some seams guard LOGICAL planes with
+   no raw socket in the same function (the upload manager's
+   ``daemon.upload.serve_piece``, the StateBackend's ``state.*``, the
+   trainer's ``trainer.dispatch``); adjacency can't see those, so the
+   inventory is what makes deleting ANY seam a named tier-1 failure.
+   F-string sites are matched on their constant prefix
+   (``rpc.client.*``).  New seams: add the site here when you add the
+   ``fire`` call.
+
+Modules on ``ALLOWLIST`` are exempt from adjacency: observability
+exporters, liveness probes, CLIs, the chaos harness itself, and
+pure-helper socket plumbing where a seam would fire on the injector's
+own machinery.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from ..core import Finding, Module, dotted, walk_calls
+
+RULE = "DF004"
+TITLE = "raw network call with no faultinject.fire seam in scope"
+
+# fnmatch-style module relpath globs exempt from the seam requirement.
+ALLOWLIST = (
+    "*/utils/ping.py",       # ICMP liveness probe — below the fault model
+    "*/utils/hostinfo.py",   # route discovery, no payload moves
+    "*/utils/tracing.py",    # OTLP export: observability, not the plane
+    "*/security/ca.py",      # CSR bootstrap: one-shot, pre-plane
+    "*/sim/*",               # the chaos harness itself
+    "*/cli/*",               # one-shot CLI conveniences
+    "*/manager/oauth.py",    # third-party IdP exchange, not the P2P plane
+    "*/rpc/vsock.py",        # transport constructor plumbing; the seams
+                             # live in the clients riding it
+    "tools/*",
+    "deploy/*",
+    "tests/*",
+)
+
+_SOCKET_VERBS = {"sendall", "sendto", "recvfrom", "recv_into", "recv", "send"}
+_HTTP_CALLS = {"urlopen", "getresponse"}
+
+# relpath -> site names that module must fire (f-string sites as
+# ``prefix.*``).  The chaos drills' coverage contract, checked in.
+REQUIRED_SEAMS = {
+    "dragonfly2_tpu/source/client.py": (
+        "source.transport", "source.fetch", "source.fetch.body",
+        "source.content_length", "source.read_range",
+    ),
+    "dragonfly2_tpu/daemon/upload.py": (
+        "daemon.upload.serve_piece", "daemon.upload.body",
+    ),
+    "dragonfly2_tpu/trainer/online_graph.py": ("trainer.dispatch",),
+    "dragonfly2_tpu/rpc/grpc_transport.py": (
+        "grpc.client.*", "grpc.manager.*",
+    ),
+    "dragonfly2_tpu/rpc/piece_transport.py": (
+        "piece.server.body", "piece.fetch", "piece.fetch.body",
+        "piece.bitmap", "piece.bitmap.body",
+    ),
+    "dragonfly2_tpu/rpc/_server.py": ("rpc.server.*",),
+    "dragonfly2_tpu/rpc/scheduler_client.py": ("rpc.client.*",),
+    "dragonfly2_tpu/rpc/registry_client.py": (
+        "rpc.registry.get", "rpc.registry.post",
+    ),
+    "dragonfly2_tpu/rpc/trainer_transport.py": (
+        "trainer.rpc.post", "trainer.rpc.get",
+    ),
+    "dragonfly2_tpu/rpc/daemon_control.py": (
+        "daemon.control.healthy", "daemon.control.download",
+    ),
+    "dragonfly2_tpu/manager/state.py": (
+        "state.put.*", "state.get.*", "state.delete.*", "state.load_all.*",
+    ),
+    "dragonfly2_tpu/daemon/pex_net.py": ("pex.send", "pex.recv"),
+    "dragonfly2_tpu/daemon/relay.py": ("relay.pump",),
+    "dragonfly2_tpu/daemon/proxy.py": (
+        "proxy.tunnel", "proxy.direct", "proxy.direct.body",
+    ),
+    "dragonfly2_tpu/daemon/sni.py": ("sni.peek", "sni.hijack"),
+    "dragonfly2_tpu/scheduler/topology_sync.py": ("scheduler.topology.sync",),
+    "dragonfly2_tpu/scheduler/seed_client.py": ("seed.trigger",),
+    "dragonfly2_tpu/jobs/image.py": ("jobs.image.fetch",),
+    "dragonfly2_tpu/jobs/remote.py": ("jobs.remote.call",),
+    "dragonfly2_tpu/objectstorage/s3.py": ("objectstorage.request",),
+}
+
+
+def _is_raw_net_call(call: ast.Call) -> Optional[str]:
+    name = dotted(call.func)
+    if name:
+        leaf = name.split(".")[-1]
+        if leaf == "urlopen":
+            return name
+        if leaf == "getresponse" or (
+            leaf == "request" and ("conn" in name or "http" in name.lower())
+        ):
+            return name
+    if isinstance(call.func, ast.Attribute) and call.func.attr in _SOCKET_VERBS:
+        # Heuristic receiver filter: generator .send()/queue .send() false
+        # positives are excluded by requiring a socket-ish receiver name.
+        recv = dotted(call.func.value) or ""
+        leaf = recv.split(".")[-1].lstrip("_")
+        if call.func.attr in ("send", "recv") and not (
+            "sock" in leaf or "conn" in leaf or leaf in ("s", "tls", "client")
+        ):
+            return None
+        return f"{recv or '<expr>'}.{call.func.attr}"
+    return None
+
+
+def _scope_has_fire(module: Module, node: ast.AST) -> bool:
+    scope = module.enclosing_function(node) or module.tree
+    for call in walk_calls(scope):
+        name = dotted(call.func)
+        if name and name.split(".")[-1] == "fire" and "faultinject" in name:
+            return True
+        # `from ..utils.faultinject import fire` style
+        if name == "fire":
+            return True
+    return False
+
+
+def allowlisted(relpath: str) -> bool:
+    import fnmatch
+
+    return any(fnmatch.fnmatch(relpath, pat) for pat in ALLOWLIST)
+
+
+def _is_fire(call: ast.Call) -> bool:
+    name = dotted(call.func)
+    return bool(
+        name
+        and name.split(".")[-1] == "fire"
+        and ("faultinject" in name or name == "fire")
+    )
+
+
+def fire_sites(module: Module) -> Set[str]:
+    """Site names fired in this module; f-string sites normalize to
+    their constant prefix + ``*`` (``fire(f"rpc.client.{m}")`` →
+    ``rpc.client.*``)."""
+    sites: Set[str] = set()
+    for call in walk_calls(module.tree):
+        if not _is_fire(call) or not call.args:
+            continue
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            sites.add(arg.value)
+        elif isinstance(arg, ast.JoinedStr):
+            prefix = ""
+            for part in arg.values:
+                if isinstance(part, ast.Constant):
+                    prefix += str(part.value)
+                else:
+                    break
+            sites.add(prefix + "*")
+    return sites
+
+
+def check(module: Module) -> Iterator[Finding]:
+    # Sub-rule 2: seam inventory (runs even for allowlisted modules —
+    # a module listed here owns its sites regardless).
+    required = REQUIRED_SEAMS.get(module.relpath, ())
+    if required:
+        present = fire_sites(module)
+        for site in required:
+            if site not in present:
+                yield module.finding(
+                    RULE,
+                    module.tree,
+                    f"required fault seam {site!r} is missing — the chaos "
+                    "drills lost coverage of this plane (REQUIRED_SEAMS in "
+                    "tools/dflint/checkers/df004_fault_seams.py)",
+                )
+
+    # Sub-rule 1: adjacency.
+    if allowlisted(module.relpath):
+        return
+    for call in walk_calls(module.tree):
+        op = _is_raw_net_call(call)
+        if op is None:
+            continue
+        if _scope_has_fire(module, call):
+            continue
+        yield module.finding(
+            RULE,
+            call,
+            f"raw network call {op} has no faultinject.fire(...) seam in "
+            "the same function — chaos drills cannot exercise this path",
+        )

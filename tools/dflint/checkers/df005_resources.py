@@ -1,0 +1,108 @@
+"""DF005 — resource hygiene.
+
+``open(...)`` / ``socket.socket(...)`` must not leak on the error path:
+acquire under ``with``, close in ``finally``, or hand ownership away
+explicitly.  A leaked fd per failed piece fetch is invisible locally and
+an fd-exhaustion outage at daemon scale.
+
+Accepted shapes (not flagged):
+
+- ``with open(...) as f:`` / ``with socket.socket(...) as s:``
+- ``open(path, "wb").close()`` — immediate chained close
+- ``f = open(...)`` then ``f.close()`` in the same function (incl. a
+  ``finally`` block)
+- ``self._f = open(...)`` — object-owned; lifetime is the object's
+  (pair with a ``close()``/``stop()`` method)
+- ``return socket.socket(...)`` / ``return s`` — factory: caller owns
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import Finding, Module, dotted, walk_calls
+
+RULE = "DF005"
+TITLE = "open()/socket() without context manager, tracked close, or owner"
+
+
+def _resource_kind(call: ast.Call) -> Optional[str]:
+    name = dotted(call.func)
+    if name == "open":
+        return "open()"
+    if name and name.split(".")[-1] == "socket" and (
+        "." in name or name == "socket"
+    ):
+        root = name.split(".")[0]
+        if root in ("socket", "_socket"):
+            return f"{name}()"
+    return None
+
+
+def check(module: Module) -> Iterator[Finding]:
+    # Index every call used as a `with` context or immediately closed.
+    in_with = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                for n in ast.walk(item.context_expr):
+                    in_with.add(id(n))
+
+    for call in walk_calls(module.tree):
+        kind = _resource_kind(call)
+        if kind is None or id(call) in in_with:
+            continue
+        parent = module.parent(call)
+        # open(...).close() — immediate close; open(...).read() chains
+        # are still leaks and stay flagged.
+        if isinstance(parent, ast.Attribute) and parent.attr == "close":
+            continue
+        # `return open(...)` — factory, caller owns.
+        if isinstance(parent, ast.Return):
+            continue
+        target: Optional[str] = None
+        owned = False
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            t = parent.targets[0]
+            if isinstance(t, ast.Name):
+                target = t.id
+            elif isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                owned = True  # object-owned; its close()/stop() is the pair
+        if owned:
+            continue
+        if target is not None:
+            scope = module.enclosing_function(call) or module.tree
+            for inner in walk_calls(scope):
+                f = inner.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "close"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == target
+                ):
+                    break
+            else:
+                # `return s` — ownership handed to the caller.
+                returned = any(
+                    isinstance(n, ast.Return)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == target
+                    for n in ast.walk(scope)
+                )
+                if not returned:
+                    yield module.finding(
+                        RULE,
+                        call,
+                        f"{kind} result '{target}' is never closed in this "
+                        "function — use `with`, close in `finally`, or "
+                        "return ownership",
+                    )
+            continue
+        yield module.finding(
+            RULE,
+            call,
+            f"{kind} result is discarded without close() — use `with` or "
+            "a tracked variable",
+        )

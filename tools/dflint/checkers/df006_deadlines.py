@@ -1,0 +1,74 @@
+"""DF006 — deadline propagation in rpc/.
+
+``rpc/retry.py`` implements deadline propagation: ``retry_call``'s
+``deadline_s`` bounds the WHOLE call and is forwarded to deadline-aware
+callables so the transport clamps its own timeout to the remaining
+budget.  That only works if every retry site in the RPC layer actually
+threads the parameter — an rpc/ function that calls ``retry_call``
+without ``deadline_s=`` silently caps nothing, and an ``urlopen``
+without ``timeout=`` can hang a worker forever.
+
+Two sub-rules, scoped to ``rpc/`` modules:
+
+1. every ``retry_call(...)`` passes ``deadline_s=`` (``None`` is fine —
+   the plumbing must exist so callers CAN bound the call), and the
+   enclosing function accepts a ``deadline_s`` parameter to forward;
+2. every ``urlopen(...)`` passes ``timeout=``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, Module, dotted, has_kwarg, walk_calls
+
+RULE = "DF006"
+TITLE = "rpc/ call without deadline/timeout propagation"
+
+
+def _in_rpc(module: Module) -> bool:
+    return "/rpc/" in f"/{module.relpath}"
+
+
+def _accepts_deadline(fn) -> bool:
+    args = fn.args
+    names = [a.arg for a in args.args + args.kwonlyargs + args.posonlyargs]
+    return "deadline_s" in names or args.kwarg is not None
+
+
+def check(module: Module) -> Iterator[Finding]:
+    if not _in_rpc(module):
+        return
+    for call in walk_calls(module.tree):
+        name = dotted(call.func)
+        if not name:
+            continue
+        leaf = name.split(".")[-1]
+        if leaf == "retry_call":
+            if not has_kwarg(call, "deadline_s"):
+                yield module.finding(
+                    RULE,
+                    call,
+                    "retry_call(...) without deadline_s= — the overall "
+                    "budget cannot be bounded by callers",
+                )
+                continue
+            fn = module.enclosing_function(call)
+            if fn is not None and not _accepts_deadline(fn):
+                # The seam passes a deadline but callers can't set it:
+                # the budget is hardcoded where policy belongs upstream.
+                yield module.finding(
+                    RULE,
+                    call,
+                    f"{fn.name}() calls retry_call(deadline_s=...) but "
+                    "takes no deadline_s parameter to forward",
+                )
+        elif leaf == "urlopen":
+            if not has_kwarg(call, "timeout"):
+                yield module.finding(
+                    RULE,
+                    call,
+                    "urlopen(...) without timeout= — an unresponsive peer "
+                    "hangs this worker forever",
+                )

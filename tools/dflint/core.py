@@ -1,0 +1,255 @@
+"""dflint core: file model, findings, pragmas, and the checker runner.
+
+The analyzer mirrors what Go's ``vet``/``-race`` buy the reference
+Dragonfly2: project-specific invariants enforced by AST inspection, not
+convention.  Each checker module exposes ``RULE`` (the ``DFxxx`` id),
+``TITLE`` and ``check(module) -> Iterable[Finding]``; the runner parses
+each file once into a :class:`Module` (tree + parent/qualname maps +
+pragma table) and hands it to every registered checker.
+
+Suppression layers, narrowest wins:
+
+- ``# dflint: disable=DF001`` (or ``disable=DF001,DF004``) on the
+  reported line — point suppression for a reviewed, accepted site;
+- ``# dflint: disable-file=DF003`` anywhere in the file — the whole
+  file opts out of one rule (e.g. a simulator that legitimately sleeps);
+- ``tools/dflint/baseline.toml`` — accepted pre-existing findings keyed
+  by ``RULE:relpath:qualname`` so history doesn't block the gate while
+  NEW findings in the same file still fail.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+_PRAGMA = re.compile(
+    r"#\s*dflint:\s*(disable|disable-file)\s*=\s*([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation.  ``key()`` is the baseline identity: rule +
+    file + enclosing qualname — line numbers shift too easily to pin."""
+
+    rule: str
+    path: str          # repo-relative, "/"-separated
+    line: int
+    col: int
+    message: str
+    qual: str          # enclosing "Class.method" / "function" / "<module>"
+
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.qual}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} [{self.qual}] {self.message}"
+
+
+class Module:
+    """One parsed source file plus the lookup tables checkers share."""
+
+    def __init__(self, path: Path, relpath: str, source: str) -> None:
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        # Parent links + dotted qualnames for every function/class scope.
+        self.parents: Dict[int, ast.AST] = {}
+        self.qualnames: Dict[int, str] = {}
+        self._scope_of: Dict[int, Optional[ast.AST]] = {}
+        self._index(self.tree, None, [])
+        # rule -> set of suppressed physical lines; "" key = whole file.
+        self.pragmas: Dict[str, set] = {}
+        self.file_pragmas: set = set()
+        self._scan_pragmas()
+
+    # -- structure ----------------------------------------------------------
+
+    def _index(self, node: ast.AST, scope: Optional[ast.AST], stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            self.parents[id(child)] = node
+            self._scope_of[id(child)] = scope
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                qual = stack + [child.name]
+                self.qualnames[id(child)] = ".".join(qual)
+                self._index(child, child, qual)
+            else:
+                self._index(child, scope, stack)
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted name of the scope enclosing ``node`` (itself, when the
+        node IS a def/class)."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if id(cur) in self.qualnames:
+                return self.qualnames[id(cur)]
+            cur = self.parents.get(id(cur))
+        return "<module>"
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self._scope_of.get(id(node))
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            cur = self._scope_of.get(id(cur))
+        return cur
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = self._scope_of.get(id(node))
+        while cur is not None and not isinstance(cur, ast.ClassDef):
+            cur = self._scope_of.get(id(cur))
+        return cur
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(id(node))
+
+    # -- pragmas ------------------------------------------------------------
+
+    def _scan_pragmas(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(StringIO(self.source).readline)
+            comments = [
+                (tok.start[0], tok.string)
+                for tok in tokens
+                if tok.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError):
+            comments = [
+                (i + 1, line)
+                for i, line in enumerate(self.lines)
+                if "#" in line
+            ]
+        for lineno, text in comments:
+            m = _PRAGMA.search(text)
+            if not m:
+                continue
+            kind, rules = m.group(1), [r.strip() for r in m.group(2).split(",")]
+            for rule in rules:
+                if kind == "disable-file":
+                    self.file_pragmas.add(rule)
+                else:
+                    self.pragmas.setdefault(rule, set()).add(lineno)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_pragmas:
+            return True
+        return line in self.pragmas.get(rule, set())
+
+    # -- finding constructor ------------------------------------------------
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.relpath,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            qual=self.qualname(node),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers (the checkers' common vocabulary)
+# ---------------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(k.arg == name for k in call.keywords)
+
+
+def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RunResult:
+    findings: List[Finding] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)   # unparseable files
+
+
+def collect_files(paths: Iterable[Path], root: Path) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    # De-dup while keeping order; skip generated protobuf code.
+    seen = set()
+    files = []
+    for f in out:
+        rf = f.resolve()
+        if rf in seen or f.name.endswith("_pb2.py"):
+            continue
+        seen.add(rf)
+        files.append(f)
+    return files
+
+
+def relpath_of(path: Path, root: Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def load_module(path: Path, root: Path) -> Module:
+    source = path.read_text(encoding="utf-8")
+    return Module(path, relpath_of(path, root), source)
+
+
+def run_checkers(module: Module, checkers=None) -> List[Finding]:
+    """All non-suppressed findings for one parsed module."""
+    from .checkers import CHECKERS
+
+    out: List[Finding] = []
+    for checker in checkers if checkers is not None else CHECKERS:
+        for f in checker.check(module):
+            if not module.suppressed(f.rule, f.line):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def run_paths(paths: Iterable[Path], root: Path, checkers=None) -> RunResult:
+    result = RunResult()
+    for path in collect_files(paths, root):
+        try:
+            module = load_module(path, root)
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            result.errors.append(f"{relpath_of(path, root)}: {exc}")
+            continue
+        result.findings.extend(run_checkers(module, checkers))
+    return result
