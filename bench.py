@@ -81,8 +81,15 @@ def acquire_backend(
 
 
 def main(acquire=acquire_backend) -> int:
+    # EVERY backend touch — acquisition AND the benchmark body (device
+    # queries, device_put, compiles, chain runs) — sits inside the
+    # structured-failure path: a backend UNAVAILABLE at any point emits
+    # the single parseable ok:false line, never a raw traceback (the
+    # round-5 artifact was lost to a post-acquire jax.devices() call
+    # dying outside this net).
     try:
         jax = acquire()
+        _run_benchmark(jax)
     except Exception as exc:  # noqa: BLE001 — report, never traceback
         print(json.dumps({
             "ok": False,
@@ -91,7 +98,6 @@ def main(acquire=acquire_backend) -> int:
             "error": f"{type(exc).__name__}: {exc}"[:300],
         }))
         return 1
-    _run_benchmark(jax)
     return 0
 
 

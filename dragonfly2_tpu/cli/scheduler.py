@@ -44,7 +44,25 @@ def build(cfg: SchedulerConfigFile):
                 collect_interval=cfg.network_topology.collect_interval_s,
             ),
         )
-    evaluator = new_evaluator(cfg.scheduling.algorithm, networktopology=topology)
+    # ml algorithm gets the full serving engine: host-feature cache +
+    # cross-request scorer micro-batching (DESIGN.md §14).  Sized/paced
+    # from config so operators can tune linger vs latency per cluster.
+    feature_cache = batcher = None
+    if cfg.scheduling.algorithm == "ml":
+        from ..scheduler import HostFeatureCache, ScorerBatcher
+
+        feature_cache = HostFeatureCache(
+            max_hosts=cfg.scheduling.eval_feature_cache_hosts
+        )
+        batcher = ScorerBatcher(
+            linger_s=cfg.scheduling.eval_batch_linger_ms / 1e3
+        )
+    evaluator = new_evaluator(
+        cfg.scheduling.algorithm,
+        networktopology=topology,
+        feature_cache=feature_cache,
+        batcher=batcher,
+    )
     scheduling = Scheduling(
         evaluator,
         SchedulingConfig(

@@ -83,6 +83,12 @@ class SchedulingSection:
     # 0 disables the monitor.
     stall_max_idle_s: float = 10.0
     stall_sweep_interval_s: float = 2.0
+    # Serving engine (ml algorithm, DESIGN.md §14): bounded linger the
+    # cross-request micro-batcher waits to coalesce concurrent announce
+    # evaluations into one padded scorer call (0 = flush immediately),
+    # and the host-feature cache's LRU bound.
+    eval_batch_linger_ms: float = 1.5
+    eval_feature_cache_hosts: int = 65536
 
     def validate(self) -> None:
         if self.algorithm not in ("default", "nt", "ml"):
@@ -91,6 +97,10 @@ class SchedulingSection:
             raise ConfigError("candidate_parent_limit > filter_parent_limit")
         if self.candidate_parent_limit < 1:
             raise ConfigError("candidate_parent_limit < 1")
+        if self.eval_batch_linger_ms < 0:
+            raise ConfigError("eval_batch_linger_ms < 0")
+        if self.eval_feature_cache_hosts < 1:
+            raise ConfigError("eval_feature_cache_hosts < 1")
 
 
 @dataclass

@@ -17,6 +17,7 @@ Feature engineering notes (TPU-first):
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import List, Optional
 
@@ -64,9 +65,14 @@ def host_features(h: HostRecord) -> np.ndarray:
     return out
 
 
+@functools.lru_cache(maxsize=65536)
 def _location_affinity(a: str, b: str) -> float:
     """Fraction of matching location path segments (reference scores location
-    affinity by shared '|'-separated prefix, evaluator_base.go)."""
+    affinity by shared '|'-separated prefix, evaluator_base.go).
+
+    lru_cache: location strings come from a small fleet-topology
+    vocabulary and recur on every announce — the split-and-compare was a
+    measurable slice of the serving featurize profile (BENCHMARKS.md)."""
     if not a or not b:
         return 0.0
     pa, pb = a.split("|"), b.split("|")
@@ -119,10 +125,13 @@ POST_HOC_FEATURE_IDX = tuple(
 )
 
 
+_POST_HOC_IDX_ARR = np.asarray(POST_HOC_FEATURE_IDX, dtype=np.intp)
+
+
 def mask_post_hoc(features: np.ndarray) -> np.ndarray:
     """Zero the post-hoc columns of [n, DOWNLOAD_FEATURE_DIM] rows (copy)."""
     out = np.array(features, dtype=np.float32, copy=True)
-    out[..., list(POST_HOC_FEATURE_IDX)] = 0.0
+    out[..., _POST_HOC_IDX_ARR] = 0.0
     return out
 
 # Full columnar row = src hash bucket, dst hash bucket, features..., target.
@@ -177,6 +186,62 @@ def edge_features(download: Download, parent: Parent) -> np.ndarray:
     out[5] = min(parent.finished_piece_count / total_pieces, 1.0)
     out[6] = math.log1p(max(parent.cost, 0) / 1e9)
     out[7] = math.log1p(max(parent.upload_piece_count, 0))
+    return out
+
+
+def edge_features_batch(  # dflint: hotpath
+    *,
+    same_idc: np.ndarray,
+    location_affinity: np.ndarray,
+    served_counts: np.ndarray,
+    served_len_sums: np.ndarray,
+    content_length: int,
+    finished_piece_counts: np.ndarray,
+    total_piece_count: int,
+    cost_ns: np.ndarray,
+    upload_piece_counts: np.ndarray,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Vectorized ``edge_features`` over n parent edges (the scheduler
+    serving hot path, DESIGN.md §14).
+
+    Inputs mirror what ``Peer.to_parent_record`` would have materialized
+    per edge: ``served_counts``/``served_len_sums`` are the child's
+    pieces attributed to each parent AFTER the ``MAX_PIECES_PER_PARENT``
+    truncation (they feed columns 2-3), while ``upload_piece_counts`` is
+    the untruncated per-parent serve count (column 7) — exactly the
+    record's split.  Column-for-column byte-identical to stacking scalar
+    ``edge_features`` rows (asserted in tests/test_sched_vectorized.py):
+    every column runs the same float64 math and takes one float32
+    rounding on assignment, like the scalar path's array fill.
+
+    ``out`` (optional, [n, EDGE_FEATURE_DIM] float32, may be a column
+    slice of a larger matrix): written in place and returned — the
+    serving path lands edge features directly in its feature matrix
+    instead of paying a temp + copy.  Every column is assigned.
+    """
+    n = len(finished_piece_counts)
+    if out is None:
+        out = np.empty((n, EDGE_FEATURE_DIM), dtype=np.float32)
+    out[:, 0] = same_idc
+    out[:, 1] = location_affinity
+    counts = np.asarray(served_counts, dtype=np.float64)
+    lens = np.asarray(served_len_sums, dtype=np.float64)
+    out[:, 2] = np.log1p(counts)
+    out[:, 3] = np.where(
+        counts > 0, np.log1p(lens / np.maximum(counts, 1.0)), 0.0
+    )
+    out[:, 4] = math.log1p(max(content_length, 0))
+    total = max(total_piece_count, 1)
+    out[:, 5] = np.minimum(
+        np.asarray(finished_piece_counts, dtype=np.float64) / total, 1.0
+    )
+    out[:, 6] = np.log1p(
+        np.maximum(np.asarray(cost_ns, dtype=np.float64), 0) / 1e9
+    )
+    out[:, 7] = np.log1p(
+        np.maximum(np.asarray(upload_piece_counts, dtype=np.float64), 0)
+    )
     return out
 
 

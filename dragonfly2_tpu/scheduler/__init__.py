@@ -21,6 +21,8 @@ from .resource import (  # noqa: F401
 )
 from .announcer import Announcer  # noqa: F401
 from .evaluator import Evaluator, MLEvaluator, new_evaluator  # noqa: F401
+from .featcache import HostFeatureCache  # noqa: F401
+from .microbatch import ScorerBatcher, ScorerUnavailable  # noqa: F401
 from .model_loader import ModelSubscriber  # noqa: F401
 from .networktopology import NetworkTopology, Probe, ProbeAgent, TopologyConfig  # noqa: F401
 from .scheduling import ScheduleResult, ScheduleResultKind, Scheduling, SchedulingConfig  # noqa: F401

@@ -20,19 +20,37 @@ pkg/rpc/inference/client/client_v1.go:86-100), the trainer exports a
 cost, no RPC on the hot path).  See ``trainer/export.py`` for the scorer
 artifact.  When no model is loaded the ML evaluator degrades to the base
 rules, exactly like the reference's fallback.
+
+Serving engine (DESIGN.md §14): ``evaluate_parents`` is the announce hot
+path, so ranking runs **vectorized** — per-parent inputs are gathered
+into arrays once and the weighted sum / featurization is numpy over all
+candidates, with per-host feature rows served from ``HostFeatureCache``
+and scorer calls optionally coalesced across concurrent announces by
+``ScorerBatcher``.  The pre-vectorization scalar implementations are
+kept verbatim as ``*_reference`` ordering oracles: the vectorized paths
+are required (tests/test_sched_vectorized.py) to reproduce their
+orderings byte-for-byte, including argsort tie-break stability.
 """
 
 from __future__ import annotations
 
+import functools
+import logging
 import statistics
+import time
+from collections import OrderedDict
 from typing import TYPE_CHECKING, List, Optional, Protocol, Sequence
 
 import numpy as np
 
+from ..records.features import EDGE_FEATURE_DIM as _EDGE_DIM
 from ..records.features import edge_features as _edge_features
+from ..records.features import edge_features_batch as _edge_features_batch
 from ..records.features import host_features as _host_features
-from ..records.schema import Download
+from ..records.schema import MAX_PIECES_PER_PARENT, Download
 from ..utils.types import HostType
+from . import metrics
+from .featcache import HostFeatureCache
 from .resource import (
     PEER_BACK_TO_SOURCE,
     PEER_FAILED,
@@ -47,7 +65,10 @@ from .resource import (
 )
 
 if TYPE_CHECKING:
+    from .microbatch import ScorerBatcher
     from .networktopology import NetworkTopology
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_ALGORITHM = "default"
 NETWORK_TOPOLOGY_ALGORITHM = "nt"
@@ -115,7 +136,10 @@ def idc_affinity_score(dst: str, src: str) -> float:
     return MAX_SCORE if dst.lower() == src.lower() else MIN_SCORE
 
 
+@functools.lru_cache(maxsize=65536)
 def location_affinity_score(dst: str, src: str) -> float:
+    # lru_cache: the location vocabulary is small and recurs on every
+    # announce; the split/lower loop showed up in the serving profile.
     if not dst or not src:
         return MIN_SCORE
     if dst.lower() == src.lower():
@@ -131,7 +155,15 @@ def location_affinity_score(dst: str, src: str) -> float:
 
 
 class Evaluator:
-    """Base (rule-based) evaluator + shared bad-node detection."""
+    """Base (rule-based) evaluator + shared bad-node detection.
+
+    ``evaluate`` (scalar, per-parent) is the semantic source of truth;
+    ``evaluate_all`` computes the same weighted sum for ALL parents in
+    one set of numpy expressions — identical operation order per
+    element, so scores (and therefore orderings) match bit-for-bit.
+    """
+
+    ALGORITHM = DEFAULT_ALGORITHM
 
     def evaluate(self, parent: Peer, child: Peer, total_piece_count: int) -> float:
         return (
@@ -146,14 +178,114 @@ class Evaluator:
             )
         )
 
-    def evaluate_parents(
+    # -- vectorized scoring (the serving path) -------------------------------
+
+    def _component_arrays(
+        self, parents: Sequence[Peer], child: Peer, total_piece_count: int
+    ):
+        """The 6 base score components as float64 arrays, one entry per
+        parent, each computed exactly like its scalar counterpart."""
+        n = len(parents)
+        # Direct field reads, not the locked accessors: a GIL-atomic
+        # snapshot of an int is exactly as consistent as the scalar
+        # path's lock-per-parent reads taken at 50 different instants,
+        # and the lock round-trips dominated this gather's profile.
+        # TWO gather passes total (one numeric, one for the python-scored
+        # terms) — eight separate fromiter loops dominated the old one.
+        child_idc = child.host.stats.network.idc
+        child_loc = child.host.stats.network.location
+        nums = np.fromiter(
+            (
+                (
+                    len(p.finished_pieces),
+                    p.host.upload_count,
+                    p.host.upload_failed_count,
+                    p.host.concurrent_upload_limit,
+                    p.host.concurrent_upload_count,
+                )
+                for p in parents
+            ),
+            dtype=np.dtype((np.float64, 5)),
+            count=n,
+        )
+        scored = np.fromiter(
+            (
+                (
+                    host_type_score(p),
+                    idc_affinity_score(p.host.stats.network.idc, child_idc),
+                    location_affinity_score(
+                        p.host.stats.network.location, child_loc
+                    ),
+                )
+                for p in parents
+            ),
+            dtype=np.dtype((np.float64, 3)),
+            count=n,
+        )
+        finished = nums[:, 0]
+        uploads = nums[:, 1]
+        failed = nums[:, 2]
+        limit = nums[:, 3]
+        free = limit - nums[:, 4]
+
+        if total_piece_count > 0:
+            ps = finished / total_piece_count
+        else:
+            ps = finished - float(child.finished_piece_count())
+
+        us = np.where(
+            uploads < failed,
+            MIN_SCORE,
+            np.where(
+                (uploads == 0.0) & (failed == 0.0),
+                MAX_SCORE,
+                (uploads - failed) / np.maximum(uploads, 1.0),
+            ),
+        )
+        fs = np.where(
+            (limit > 0) & (free > 0), free / np.maximum(limit, 1.0), MIN_SCORE
+        )
+        return ps, us, fs, scored[:, 0], scored[:, 1], scored[:, 2]
+
+    def evaluate_all(  # dflint: hotpath
+        self, parents: Sequence[Peer], child: Peer, total_piece_count: int
+    ) -> np.ndarray:
+        """[n] float64 scores — one numpy expression over all parents,
+        term order matching ``evaluate`` so every element is bit-equal."""
+        ps, us, fs, hts, idcs, locs = self._component_arrays(
+            parents, child, total_piece_count
+        )
+        return (
+            0.2 * ps + 0.2 * us + 0.15 * fs + 0.15 * hts + 0.15 * idcs + 0.15 * locs
+        )
+
+    def evaluate_parents(  # dflint: hotpath
         self, parents: List[Peer], child: Peer, total_piece_count: int
     ) -> List[Peer]:
+        if len(parents) <= 1:
+            return list(parents)
+        t0 = time.perf_counter()
+        scores = self.evaluate_all(parents, child, total_piece_count)
+        # Stable descending sort == sorted(reverse=True): ties keep their
+        # candidate-sample order on both paths.
+        order = np.argsort(-scores, kind="stable")
+        metrics.EVAL_SECONDS.observe(
+            time.perf_counter() - t0, algorithm=self.ALGORITHM
+        )
+        return [parents[i] for i in order]
+
+    def evaluate_parents_reference(
+        self, parents: List[Peer], child: Peer, total_piece_count: int
+    ) -> List[Peer]:
+        """Pre-vectorization scalar path, kept verbatim: the ordering
+        oracle for the property tests and bench_sched's baseline."""
         return sorted(
             parents,
             key=lambda p: self.evaluate(p, child, total_piece_count),
             reverse=True,
         )
+
+    # -- bad-node detection ---------------------------------------------------
 
     def is_bad_node(self, peer: Peer) -> bool:
         if peer.fsm.current in _BAD_STATES:
@@ -169,9 +301,56 @@ class Evaluator:
         stdev = statistics.pstdev(costs[:-1])
         return last > mean + 3 * stdev
 
+    def is_bad_nodes(self, peers: Sequence[Peer]) -> np.ndarray:
+        """[n] bool — ``is_bad_node`` for a whole candidate set with the
+        cost statistics vectorized (segment reductions over one flat
+        array instead of ``statistics`` per peer).  Equivalent to the
+        scalar test; the 3σ threshold is computed with the numerically
+        stable two-pass formula, so verdicts can differ from the scalar
+        oracle only for a sample sitting within float rounding of the
+        exact threshold (asserted equal over random populations in
+        tests/test_sched_vectorized.py)."""
+        n = len(peers)
+        bad = np.zeros(n, dtype=bool)
+        rows: List[int] = []
+        lens: List[int] = []
+        flat: List[int] = []
+        for i, p in enumerate(peers):
+            if p.fsm.current in _BAD_STATES:
+                bad[i] = True
+                continue
+            costs = p.piece_costs()
+            if len(costs) < MIN_AVAILABLE_COST_LEN:
+                continue
+            rows.append(i)
+            lens.append(len(costs))
+            flat.extend(costs)
+        if not rows:
+            return bad
+        lens_a = np.asarray(lens, dtype=np.int64)
+        flat_a = np.asarray(flat, dtype=np.float64)
+        ends = np.cumsum(lens_a)
+        starts = ends - lens_a
+        last = flat_a[ends - 1]
+        m = (lens_a - 1).astype(np.float64)
+        head_sum = np.add.reduceat(flat_a, starts) - last
+        mean = head_sum / m
+        verdict = last > mean * 20
+        big = lens_a >= NORMAL_DISTRIBUTION_LEN
+        if np.any(big):
+            centered = flat_a - np.repeat(mean, lens_a)
+            centered[ends - 1] = 0.0  # the probe sample is not in the window
+            sq = np.add.reduceat(centered * centered, starts)
+            std = np.sqrt(sq / m)
+            verdict = np.where(big, last > mean + 3 * std, verdict)
+        bad[np.asarray(rows, dtype=np.int64)] = verdict
+        return bad
+
 
 class NetworkTopologyEvaluator(Evaluator):
     """Adds probe-RTT affinity (evaluator_network_topology.go)."""
+
+    ALGORITHM = NETWORK_TOPOLOGY_ALGORITHM
 
     def __init__(self, networktopology: "NetworkTopology") -> None:
         self._nt = networktopology
@@ -196,14 +375,38 @@ class NetworkTopologyEvaluator(Evaluator):
             + 0.12 * self._rtt_score(parent.host.id, child.host.id)
         )
 
+    def evaluate_all(  # dflint: hotpath
+        self, parents: Sequence[Peer], child: Peer, total_piece_count: int
+    ) -> np.ndarray:
+        ps, us, fs, hts, idcs, locs = self._component_arrays(
+            parents, child, total_piece_count
+        )
+        child_id = child.host.id
+        rtts = np.fromiter(
+            (self._rtt_score(p.host.id, child_id) for p in parents),
+            np.float64,
+            count=len(parents),
+        )
+        return (
+            0.2 * ps
+            + 0.2 * us
+            + 0.15 * fs
+            + 0.11 * hts
+            + 0.11 * idcs
+            + 0.11 * locs
+            + 0.12 * rtts
+        )
+
 
 class EdgeScorer(Protocol):
     """What the trainer exports for the scheduler (trainer/export.py).
 
     Scores [n] candidate edges given featurized inputs; higher = better
     parent.  Implementations must be cheap (numpy, no device transfer) —
-    this sits on the scheduling hot path.
-    """
+    this sits on the scheduling hot path — and must score each row
+    independently of its batch-mates (the batched-score contract:
+    ``ScorerBatcher`` pads and coalesces rows from concurrent announces
+    into one call)."""
 
     def score(
         self,
@@ -227,21 +430,156 @@ class MLEvaluator(Evaluator):
     edges exactly like training rows (records/features.py) and apply the
     exported model locally.  No model → base-rule fallback, mirroring the
     reference's fallback behavior.
+
+    Serving engine wiring: host feature rows come from a
+    ``HostFeatureCache`` gather, edge features are computed in one
+    vectorized pass, and — when a ``ScorerBatcher`` is attached —
+    concurrent announces coalesce into one padded scorer call.  The
+    scorer reference is read ONCE per evaluate (immutable snapshot), so
+    ``ModelSubscriber.refresh`` hot-swapping mid-call can never fault the
+    ranking; any scorer-path failure degrades to rule ranking instead of
+    failing the announce.
     """
 
-    def __init__(self, scorer: Optional[EdgeScorer] = None) -> None:
+    ALGORITHM = ML_ALGORITHM
+    _SERVED_CACHE_MAX = 4096
+
+    def __init__(
+        self,
+        scorer: Optional[EdgeScorer] = None,
+        *,
+        feature_cache: Optional[HostFeatureCache] = None,
+        batcher: Optional["ScorerBatcher"] = None,
+    ) -> None:
         self._scorer = scorer
+        # child peer id -> (piece count, served-piece groups); see
+        # _served_groups.  Only touched from evaluate (GIL-serialized
+        # dict ops on a private map).
+        self._served_cache: "OrderedDict[str, tuple]" = OrderedDict()
+        # `is None`, not `or`: an empty cache is len()==0 and falsy.
+        self._feature_cache = (
+            feature_cache if feature_cache is not None else HostFeatureCache()
+        )
+        self._batcher = batcher
+        if batcher is not None:
+            batcher.set_scorer(scorer)
 
     def set_scorer(self, scorer: Optional[EdgeScorer]) -> None:
         self._scorer = scorer
+        if self._batcher is not None:
+            self._batcher.set_scorer(scorer)
 
     @property
     def has_model(self) -> bool:
         return self._scorer is not None
 
-    def _featurize(self, parents: Sequence[Peer], child: Peer) -> np.ndarray:
-        """Build [n, DOWNLOAD_FEATURE_DIM] rows matching features.py layout
-        (child host feats ++ parent host feats ++ edge feats)."""
+    @property
+    def feature_cache(self) -> HostFeatureCache:
+        return self._feature_cache
+
+    @property
+    def batcher(self) -> Optional["ScorerBatcher"]:
+        return self._batcher
+
+    # -- featurization --------------------------------------------------------
+
+    def _served_groups(self, child: Peer, piece_size: int) -> dict:
+        """parent-id → (truncated count, truncated length sum, full count)
+        of the child's pieces attributed to that parent — ONE pass over
+        the child's pieces instead of ``to_parent_record``'s scan per
+        parent, mirroring the record's ``MAX_PIECES_PER_PARENT`` split.
+        Memoized per child against its piece count: pieces only accrue
+        during a download, so an unchanged count means unchanged groups
+        (re-announces between piece finishes are the common case)."""
+        n_pieces = len(child.pieces)  # GIL-atomic len read
+        cached = self._served_cache.get(child.id)
+        if cached is not None and cached[0] == n_pieces:
+            # No move_to_end on hits: eviction order is least-recently-
+            # REBUILT, which keeps active downloaders (their piece count
+            # moves) and is race-free for concurrent announce threads.
+            return cached[1]
+        raw: dict = {}
+        for pc in child.snapshot_pieces():
+            raw.setdefault(pc.parent_id, []).append(pc.length or piece_size)
+        groups = {}
+        for parent_id, lens in raw.items():
+            kept = lens[:MAX_PIECES_PER_PARENT]
+            groups[parent_id] = (len(kept), sum(kept), len(lens))
+        self._served_cache[child.id] = (n_pieces, groups)
+        self._served_cache.move_to_end(child.id)
+        while len(self._served_cache) > self._SERVED_CACHE_MAX:
+            self._served_cache.popitem(last=False)
+        return groups
+
+    def _served_stats(self, child: Peer, parents: Sequence[Peer], piece_size: int):
+        """Per-parent arrays of ``_served_groups`` for a candidate set."""
+        groups = self._served_groups(child, piece_size)
+        n = len(parents)
+        trunc_counts = np.zeros(n, dtype=np.int64)
+        trunc_lens = np.zeros(n, dtype=np.int64)
+        full_counts = np.zeros(n, dtype=np.int64)
+        if groups:
+            for i, p in enumerate(parents):
+                g = groups.get(p.id)
+                if g is not None:
+                    trunc_counts[i] = g[0]
+                    trunc_lens[i] = g[1]
+                    full_counts[i] = g[2]
+        return trunc_counts, trunc_lens, full_counts
+
+    def _featurize(  # dflint: hotpath
+        self, parents: Sequence[Peer], child: Peer
+    ) -> np.ndarray:
+        """[n, DOWNLOAD_FEATURE_DIM] rows matching features.py layout
+        (child host feats ++ parent host feats ++ edge feats): a cache
+        serve (one fancy-index gather + vectorized affinity terms) + one
+        vectorized edge-feature pass.  Byte-identical to
+        ``_featurize_reference``."""
+        return self._featurize_batch(parents, child)[0]
+
+    def _featurize_batch(  # dflint: hotpath
+        self, parents: Sequence[Peer], child: Peer
+    ):
+        """(_featurize rows, src hash buckets [n], child hash bucket) —
+        buckets and the idc/location affinity terms all ride the cache's
+        single-lock serve sweep (featcache.ServingGather)."""
+        n = len(parents)
+        sv = self._feature_cache.serve(child.host, [p.host for p in parents])
+        task = child.task
+        piece_size = task.piece_size or (4 << 20)
+        trunc_counts, trunc_lens, full_counts = self._served_stats(
+            child, parents, piece_size
+        )
+        # ONE python pass for both per-peer reads (direct len() read —
+        # GIL-atomic, see _component_arrays).
+        fin_cost = np.fromiter(
+            ((len(p.finished_pieces), p.cost_ns) for p in parents),
+            dtype=np.dtype((np.int64, 2)),
+            count=n,
+        )
+        h = sv.child_row.shape[0]
+        out = np.empty((n, 2 * h + _EDGE_DIM), dtype=np.float32)
+        out[:, :h] = sv.child_row
+        out[:, h : 2 * h] = sv.rows
+        _edge_features_batch(
+            same_idc=sv.same_idc,
+            location_affinity=sv.location_affinity,
+            served_counts=trunc_counts,
+            served_len_sums=trunc_lens,
+            content_length=task.content_length,
+            finished_piece_counts=fin_cost[:, 0],
+            total_piece_count=max(task.total_piece_count, 0),
+            cost_ns=fin_cost[:, 1],
+            upload_piece_counts=full_counts,
+            out=out[:, 2 * h :],  # written in place, no temp + copy
+        )
+        return out, sv.src_buckets, sv.dst_bucket
+
+    def _featurize_reference(self, parents: Sequence[Peer], child: Peer) -> np.ndarray:
+        """Pre-vectorization featurizer, kept verbatim: one
+        ``to_parent_record`` + ``np.concatenate`` per parent.  The
+        byte-equality oracle for ``_featurize`` (property tests) and
+        bench_sched's scalar baseline."""
         child_rec = child.host.to_record()
         child_f = _host_features(child_rec)
         # A lightweight Download shell so edge_features sees task context.
@@ -258,17 +596,63 @@ class MLEvaluator(Evaluator):
         # (MLPScorer.score) so the train/serve contract travels with it.
         return np.stack(rows).astype(np.float32)
 
-    def evaluate_parents(
+    # -- ranking --------------------------------------------------------------
+
+    def evaluate_parents(  # dflint: hotpath
         self, parents: List[Peer], child: Peer, total_piece_count: int
     ) -> List[Peer]:
-        if self._scorer is None or not parents:
+        scorer = self._scorer  # ONE snapshot: refresh() swaps can't race us
+        if scorer is None or not parents:
             return super().evaluate_parents(parents, child, total_piece_count)
+        if len(parents) == 1:
+            return list(parents)
+        t0 = time.perf_counter()
+        try:
+            cache = self._feature_cache
+            # Identity-only scorers (GNN embedding lookup) skip featurization —
+            # building the feature matrix is the expensive part of this path.
+            if getattr(scorer, "wants_features", True):
+                feats, src_buckets, dst_bucket = self._featurize_batch(
+                    parents, child
+                )
+            else:
+                feats = np.zeros((len(parents), 0), dtype=np.float32)
+                src_buckets = np.fromiter(
+                    (cache.bucket(p.host) for p in parents),
+                    np.int64,
+                    count=len(parents),
+                )
+                dst_bucket = cache.bucket(child.host)
+            # broadcast_to: the scorer only reads the buckets — no
+            # per-announce materialized array.
+            dst_buckets = np.broadcast_to(
+                np.int64(dst_bucket), (len(parents),)
+            )
+            engine = self._batcher if self._batcher is not None else scorer
+            scores = np.asarray(
+                engine.score(feats, src_buckets=src_buckets, dst_buckets=dst_buckets)
+            )
+        except Exception as exc:  # noqa: BLE001 — degrade to rules, never fail the announce
+            logger.warning("ML scorer path failed (%s); ranking with rules", exc)
+            return super().evaluate_parents(parents, child, total_piece_count)
+        order = np.argsort(-scores, kind="stable")
+        metrics.EVAL_SECONDS.observe(
+            time.perf_counter() - t0, algorithm=self.ALGORITHM
+        )
+        return [parents[i] for i in order]
+
+    def _evaluate_parents_reference(
+        self, parents: List[Peer], child: Peer, total_piece_count: int
+    ) -> List[Peer]:
+        """Pre-vectorization ML path (scalar featurize + direct scorer):
+        the ordering oracle and bench_sched's scalar-ML baseline."""
+        scorer = self._scorer
+        if scorer is None or not parents:
+            return self.evaluate_parents_reference(parents, child, total_piece_count)
         from ..records.features import host_bucket
 
-        # Identity-only scorers (GNN embedding lookup) skip featurization —
-        # building the feature matrix is the expensive part of this path.
-        if getattr(self._scorer, "wants_features", True):
-            feats = self._featurize(parents, child)
+        if getattr(scorer, "wants_features", True):
+            feats = self._featurize_reference(parents, child)
         else:
             feats = np.zeros((len(parents), 0), dtype=np.float32)
         src_buckets = np.asarray([host_bucket(p.host.id) for p in parents], np.int64)
@@ -276,7 +660,7 @@ class MLEvaluator(Evaluator):
             len(parents), host_bucket(child.host.id), dtype=np.int64
         )
         scores = np.asarray(
-            self._scorer.score(feats, src_buckets=src_buckets, dst_buckets=dst_buckets)
+            scorer.score(feats, src_buckets=src_buckets, dst_buckets=dst_buckets)
         )
         order = np.argsort(-scores, kind="stable")
         return [parents[i] for i in order]
@@ -287,10 +671,12 @@ def new_evaluator(
     *,
     networktopology: Optional["NetworkTopology"] = None,
     scorer: Optional[EdgeScorer] = None,
+    feature_cache: Optional[HostFeatureCache] = None,
+    batcher: Optional["ScorerBatcher"] = None,
 ) -> Evaluator:
     """Algorithm dispatch (evaluator.go:76-90)."""
     if algorithm == NETWORK_TOPOLOGY_ALGORITHM and networktopology is not None:
         return NetworkTopologyEvaluator(networktopology)
     if algorithm == ML_ALGORITHM:
-        return MLEvaluator(scorer)
+        return MLEvaluator(scorer, feature_cache=feature_cache, batcher=batcher)
     return Evaluator()

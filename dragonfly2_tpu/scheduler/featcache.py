@@ -1,0 +1,347 @@
+"""Per-host feature cache for the scheduler serving path.
+
+``MLEvaluator._featurize`` used to rebuild every host's 12-dim feature
+vector — including a full ``Host.to_record()`` dataclass construction —
+once per candidate per announce.  Host state changes on announce cadence
+(seconds), not evaluate cadence (sub-millisecond under load), so the
+vectors are overwhelmingly reusable: this cache keys them by host id and
+validates each entry against a cheap *stamp* of every mutable input the
+feature function reads.
+
+Layout: an entry is ``(stamp, slot)`` and everything derived from the
+host lives in preallocated per-slot arrays — the ``[max_hosts, H]``
+float32 feature matrix plus int64 columns for the hash bucket and the
+interned idc/location ids.  The per-announce sweep therefore only
+collects slot indices in Python; rows, buckets and affinity inputs all
+come out as fancy-index gathers.  Interning the idc/location strings
+turns the per-announce affinity terms into one vectorized id-compare
+(``same_idc``) and one table lookup (``location_affinity`` against a
+per-child-location affinity row, built lazily over the location
+vocabulary) — the two per-parent Python loops that dominated the
+serving featurize profile (BENCHMARKS.md).
+
+Invalidation rules (DESIGN.md §14):
+
+- **announce / host-update** — any path that mutates feature inputs also
+  moves the stamp (``Host.touch()`` on announce, upload-slot accounting
+  on edge churn), so a stale entry can never be served: the stamp
+  mismatch recomputes in place.  Correctness never depends on an
+  explicit invalidate call.
+- **eviction** — least-recently-REFRESHED past ``max_hosts`` (bounded
+  memory on million-host managers; the freed row slot is recycled):
+  every recompute moves a host to the back of the order, so live hosts
+  keep re-queueing on announce cadence and the front of the order is the
+  hosts that have gone quiet longest.  Plus explicit
+  ``invalidate(host_id)`` from ``SchedulerService.leave_host`` so
+  departed hosts free their slot immediately instead of aging out.
+
+The cached row is produced by the *same* ``records.features.host_features``
+code the scalar path used, so cache-path features are byte-identical to
+reference-path features (asserted in tests/test_sched_vectorized.py).
+
+Lock ordering: the cache lock is taken before any per-host lock
+(``Host.to_record`` on the miss path); no caller may enter the cache
+while holding a host lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, namedtuple
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..records.features import HOST_FEATURE_DIM, _location_affinity, host_bucket
+from ..records.features import host_features as _host_features
+from . import metrics
+
+_Stamp = Tuple[float, int, int, int, int]
+
+# One announce's cache product: everything the ML featurizer needs that
+# is a function of host identity/state alone, gathered in one locked
+# sweep.  ``rows``/``child_row`` are private copies (fancy-indexed out
+# of the slot matrix), never views into it.
+ServingGather = namedtuple(
+    "ServingGather",
+    (
+        "child_row",      # [H] float32
+        "rows",           # [n, H] float32, one per parent host
+        "src_buckets",    # [n] int64 hash buckets (parents)
+        "dst_bucket",     # int hash bucket (child)
+        "same_idc",       # [n] float64 — 1.0 iff non-empty idc match
+        "location_affinity",  # [n] float64 — shared '|'-prefix fraction
+        "n_hits",
+        "n_misses",
+    ),
+)
+
+
+class HostFeatureCache:
+    """host-id → (stamp, row slot) + per-slot feature/bucket/id columns."""
+
+    def __init__(self, max_hosts: int = 65536) -> None:
+        self.max_hosts = max_hosts
+        self._mu = threading.Lock()
+        self._entries: "OrderedDict[str, Tuple[_Stamp, int]]" = OrderedDict()
+        # Per-slot columns, indexed by an entry's slot.
+        self._matrix = np.empty((max_hosts, HOST_FEATURE_DIM), dtype=np.float32)
+        self._bucket_col = np.empty(max_hosts, dtype=np.int64)
+        self._idc_col = np.empty(max_hosts, dtype=np.int64)
+        self._loc_col = np.empty(max_hosts, dtype=np.int64)
+        # Stack of recyclable row slots; pop() hands out high slots first.
+        self._free: List[int] = list(range(max_hosts))
+        # Interning tables.  The idc/location vocabulary is the fleet's
+        # topology labels — bounded by deployment shape, not host count.
+        self._idcs: List[str] = []
+        self._idc_ids: Dict[str, int] = {}
+        self._locs: List[str] = []
+        self._loc_ids: Dict[str, int] = {}
+        # child loc id -> affinity row over the loc vocabulary (float64),
+        # extended lazily as the vocabulary grows; at most vocab² floats.
+        self._aff_rows: Dict[int, np.ndarray] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @staticmethod
+    def _stamp(host) -> _Stamp:
+        # Every mutable field host_features() reads, cheap attribute reads
+        # only.  stats.* writers go through Host.touch() (announce paths),
+        # which moves updated_at; the upload counters move on their own.
+        return (
+            host.updated_at,
+            host.concurrent_upload_count,
+            host.upload_count,
+            host.upload_failed_count,
+            host.concurrent_upload_limit,
+        )
+
+    # -- locked internals ----------------------------------------------------
+
+    def _intern_locked(self, s: str, strings: List[str], ids: Dict[str, int]) -> int:
+        i = ids.get(s)
+        if i is None:
+            i = len(strings)
+            strings.append(s)
+            ids[s] = i
+        return i
+
+    def _miss_locked(self, h) -> int:
+        """(Re)compute one host's entry; returns its row slot.  Stamp is
+        read BEFORE featurizing: a host mutating mid-computation leaves an
+        old stamp behind, so the next lookup recomputes — the cache can
+        never serve a row fresher than its stamp."""
+        stamp = self._stamp(h)
+        # Same code path as the scalar reference (to_record() +
+        # host_features()), so rows are byte-identical to it.
+        row = _host_features(h.to_record())
+        old = self._entries.get(h.id)
+        if old is not None:
+            slot = old[1]
+        elif self._free:
+            slot = self._free.pop()
+        else:
+            _, evicted = self._entries.popitem(last=False)
+            slot = evicted[1]
+            self.evictions += 1
+        self._matrix[slot] = row
+        self._bucket_col[slot] = host_bucket(h.id)
+        self._idc_col[slot] = self._intern_locked(
+            h.stats.network.idc, self._idcs, self._idc_ids
+        )
+        self._loc_col[slot] = self._intern_locked(
+            h.stats.network.location, self._locs, self._loc_ids
+        )
+        self._entries[h.id] = (stamp, slot)
+        self._entries.move_to_end(h.id)
+        return slot
+
+    def _slot_locked(self, h) -> int:
+        entry = self._entries.get(h.id)
+        # _stamp() inlined: a method call + tuple per host showed in the
+        # gather profile at 50 candidates/announce.
+        if entry is not None and entry[0] == (
+            h.updated_at,
+            h.concurrent_upload_count,
+            h.upload_count,
+            h.upload_failed_count,
+            h.concurrent_upload_limit,
+        ):
+            # No move_to_end on hits: eviction order is least-recently-
+            # REFRESHED — hosts re-announce on a cadence, so live hosts
+            # keep moving to the back via the miss path, and the hit
+            # sweep saves an OrderedDict relink per candidate.
+            self.hits += 1
+            return entry[1]
+        self.misses += 1
+        return self._miss_locked(h)
+
+    def _aff_row_locked(self, loc_id: int) -> np.ndarray:
+        """Affinity of ``loc_id``'s location string against every interned
+        location — each cell is the SAME ``_location_affinity`` the scalar
+        path calls per pair, so table lookups are byte-identical to it."""
+        row = self._aff_rows.get(loc_id)
+        if row is None or len(row) < len(self._locs):
+            src = self._locs[loc_id]
+            row = np.fromiter(
+                (_location_affinity(src, dst) for dst in self._locs),
+                np.float64,
+                count=len(self._locs),
+            )
+            self._aff_rows[loc_id] = row
+        return row
+
+    # -- the serving surface -------------------------------------------------
+
+    def serve(self, child_host, hosts) -> ServingGather:
+        """ONE locked sweep per announce: the Python loop only resolves
+        slot indices; rows, hash buckets and the vectorized idc/location
+        affinity terms all come out as fancy-index gathers over the
+        per-slot columns (the per-host numpy scalar stores and affinity
+        genexprs dominated the old gather profile)."""
+        n = len(hosts)
+        if n + 1 > self.max_hosts:
+            # A candidate set larger than the cache would evict-and-reuse
+            # slots mid-sweep; serve it uncached (never hit in practice —
+            # filter_parent_limit is orders below max_hosts).
+            return self._serve_uncached(child_host, hosts)
+        slots: List[int] = []
+        append = slots.append
+        with self._mu:
+            hits0 = self.hits  # inside the lock: counters are shared
+            cslot = self._slot_locked(child_host)
+            entries = self._entries
+            n_hit = 0
+            for h in hosts:
+                e = entries.get(h.id)
+                # Hit path fully inlined (stamp tuple + method call per
+                # host showed in the serve profile at 50 candidates).
+                if e is not None and e[0] == (
+                    h.updated_at,
+                    h.concurrent_upload_count,
+                    h.upload_count,
+                    h.upload_failed_count,
+                    h.concurrent_upload_limit,
+                ):
+                    # No move_to_end on hits — see _slot_locked.
+                    n_hit += 1
+                    append(e[1])
+                else:
+                    append(self._miss_locked(h))
+            self.hits += n_hit
+            self.misses += n - n_hit
+            idx = np.asarray(slots, dtype=np.intp)
+            rows = self._matrix[idx]             # fancy index == copy
+            child_row = self._matrix[cslot].copy()
+            src_buckets = self._bucket_col[idx]
+            dst_bucket = int(self._bucket_col[cslot])
+            child_idc = self._idc_col[cslot]
+            if self._idcs[child_idc]:
+                same_idc = (self._idc_col[idx] == child_idc).astype(np.float64)
+            else:
+                same_idc = np.zeros(n, dtype=np.float64)
+            location_affinity = self._aff_row_locked(
+                int(self._loc_col[cslot])
+            )[self._loc_col[idx]]
+            n_hits = self.hits - hits0
+        n_misses = (n + 1) - n_hits
+        metrics.EVAL_CACHE_TOTAL.inc(n_hits, result="hit")
+        metrics.EVAL_CACHE_TOTAL.inc(n_misses, result="miss")
+        return ServingGather(
+            child_row, rows, src_buckets, dst_bucket, same_idc,
+            location_affinity, n_hits, n_misses,
+        )
+
+    def _serve_uncached(self, child_host, hosts) -> ServingGather:
+        child_row = _host_features(child_host.to_record())
+        rows = np.stack([_host_features(h.to_record()) for h in hosts])
+        src_buckets = np.asarray([host_bucket(h.id) for h in hosts], np.int64)
+        child_idc = child_host.stats.network.idc
+        same_idc = np.asarray(
+            [
+                1.0 if (child_idc and child_idc == h.stats.network.idc) else 0.0
+                for h in hosts
+            ],
+            np.float64,
+        )
+        child_loc = child_host.stats.network.location
+        location_affinity = np.asarray(
+            [_location_affinity(child_loc, h.stats.network.location) for h in hosts],
+            np.float64,
+        )
+        n = len(hosts)
+        metrics.EVAL_CACHE_TOTAL.inc(n + 1, result="miss")
+        with self._mu:
+            self.misses += n + 1
+        return ServingGather(
+            child_row, rows, src_buckets, host_bucket(child_host.id),
+            same_idc, location_affinity, 0, n + 1,
+        )
+
+    def features(self, host) -> np.ndarray:
+        with self._mu:
+            hit = self.hits
+            slot = self._slot_locked(host)
+            row = self._matrix[slot].copy()  # copy: slots get recycled
+            hit = self.hits - hit
+        metrics.EVAL_CACHE_TOTAL.inc(result="hit" if hit else "miss")
+        return row
+
+    def gather(self, hosts) -> np.ndarray:  # dflint: hotpath
+        """[n, HOST_FEATURE_DIM] float32 — one cached row per host, one
+        fancy-index copy; metrics batched into two counter bumps."""
+        return self.gather_with_buckets(hosts)[0]
+
+    def gather_with_buckets(self, hosts) -> Tuple[np.ndarray, np.ndarray]:
+        """(features [n, H] float32, hash buckets [n] int64) in one
+        locked sweep."""
+        n = len(hosts)
+        if not n:
+            return (
+                np.zeros((0, HOST_FEATURE_DIM), dtype=np.float32),
+                np.zeros(0, dtype=np.int64),
+            )
+        if n > self.max_hosts:
+            sv = self._serve_uncached(hosts[0], hosts)
+            return sv.rows, sv.src_buckets
+        with self._mu:
+            hits0 = self.hits  # inside the lock: counters are shared
+            idx = np.fromiter(
+                (self._slot_locked(h) for h in hosts), np.intp, count=n
+            )
+            rows = self._matrix[idx]
+            buckets = self._bucket_col[idx]
+            n_hits = self.hits - hits0
+        metrics.EVAL_CACHE_TOTAL.inc(n_hits, result="hit")
+        metrics.EVAL_CACHE_TOTAL.inc(n - n_hits, result="miss")
+        return rows, buckets
+
+    def bucket(self, host) -> int:
+        """Memoized ``host_bucket(host.id)`` (crc32 skipped on hits)."""
+        with self._mu:
+            entry = self._entries.get(host.id)
+            if entry is not None:
+                return int(self._bucket_col[entry[1]])
+        return host_bucket(host.id)
+
+    # -- maintenance ---------------------------------------------------------
+
+    def invalidate(self, host_id: str) -> None:
+        with self._mu:
+            entry = self._entries.pop(host_id, None)
+            if entry is not None:
+                self._free.append(entry[1])
+
+    def clear(self) -> None:
+        with self._mu:
+            self._entries.clear()
+            self._free = list(range(self.max_hosts))
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0

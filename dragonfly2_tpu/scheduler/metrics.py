@@ -36,3 +36,23 @@ PROBE_SYNC_TOTAL = _reg.counter(
 HOSTS_GAUGE = _reg.gauge("scheduler_hosts", "Registered hosts")
 PEERS_GAUGE = _reg.gauge("scheduler_peers", "Live peers")
 TASKS_GAUGE = _reg.gauge("scheduler_tasks", "Live tasks")
+
+# -- serving engine (DESIGN.md §14: vectorized evaluate path) ----------------
+EVAL_SECONDS = _reg.histogram(
+    "scheduler_eval_seconds", "evaluate_parents latency", ["algorithm"],
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25),
+)
+EVAL_CACHE_TOTAL = _reg.counter(
+    "scheduler_eval_cache_hits_total",
+    "Host-feature cache lookups by outcome", ["result"],
+)
+EVAL_BATCH_SIZE = _reg.histogram(
+    "scheduler_eval_batch_size",
+    "Requests coalesced per scorer micro-batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+EVAL_BATCH_FALLBACK_TOTAL = _reg.counter(
+    "scheduler_eval_batch_fallback_total",
+    "Coalesced scorer batches degraded to per-request scoring",
+)

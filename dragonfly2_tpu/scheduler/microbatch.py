@@ -1,0 +1,246 @@
+"""Cross-request scorer micro-batching for the scheduler serving path.
+
+The reference reserved a Triton/KServe *batched* inference seam for the
+parent evaluator (``GRPCInferenceService``, ``model.graphdef`` +
+``config.pbtxt``) but never wired it; our in-process scorer was called
+once per announce.  ``ScorerBatcher`` restores the batched-inference
+shape without the RPC: concurrent ``score()`` calls from the RPC handler
+threads coalesce into ONE padded scorer call.
+
+Mechanics (DESIGN.md §14):
+
+- **leader/follower coalescing** — the first thread to enqueue becomes
+  the flush leader; it lingers a bounded ``linger_s`` (~1-2 ms) while
+  followers pile on, then takes the whole queue in one swap.  No
+  background dispatcher thread: an idle batcher costs nothing and there
+  is nothing to shut down.
+- **bucketed pad sizes** — for scorers that declare ``static_shapes =
+  True`` (jit-compiled / TPU inference backends), the concatenated rows
+  are zero-padded up to a fixed bucket ladder so the backend sees a
+  handful of static shapes instead of a recompile per occupancy.  Plain
+  numpy scorers are shape-indifferent, so they get exact-size batches —
+  padding them is pure wasted compute.
+- **singleton bypass** — a flush that collected exactly one request
+  calls the scorer on the raw, unpadded arrays.
+- **atomic hot-swap** — the scorer reference is snapshotted once per
+  flush, so ``ModelSubscriber.refresh`` swapping mid-batch can never
+  hand half a batch to each model version.
+- **fault seam** — dispatch fires ``scheduler.eval.batch``
+  (utils.faultinject, DF004 inventory).  A dropped/failed coalesced call
+  degrades to per-request scoring; announces never stall on the batcher
+  (chaos drill in tests/test_chaos.py).
+
+The scorer contract this relies on is row-independence: ``score`` must
+score each row from that row (+ its buckets) alone, so padded rows and
+co-batched strangers cannot bleed into each other (trainer/export.py
+``EdgeScorer`` docstring — the batched-score contract).
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import faultinject
+from . import metrics
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PAD_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+class ScorerUnavailable(RuntimeError):
+    """No scorer installed at flush time (deactivated mid-queue); the
+    evaluator catches this and falls back to rule-based ranking."""
+
+
+class _Request:
+    __slots__ = ("features", "src", "dst", "done", "result", "error")
+
+    def __init__(self, features, src, dst) -> None:
+        self.features = features
+        self.src = src
+        self.dst = dst
+        self.done = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+class ScorerBatcher:
+    """EdgeScorer wrapper: same ``score`` surface, coalesced execution."""
+
+    def __init__(
+        self,
+        scorer=None,
+        *,
+        linger_s: float = 0.0015,
+        max_batch_rows: int = 4096,
+        pad_buckets=DEFAULT_PAD_BUCKETS,
+    ) -> None:
+        self._cv = threading.Condition()
+        self._pending: List[_Request] = []
+        self._pending_rows = 0
+        self._leader_active = False
+        self._scorer = scorer
+        self.linger_s = linger_s
+        self.max_batch_rows = max_batch_rows
+        self.pad_buckets = tuple(sorted(pad_buckets))
+        # Occupancy stats (bench_sched reads these; prometheus gets the
+        # histogram in _dispatch).
+        self.batches = 0
+        self.batched_requests = 0
+        self.fallbacks = 0
+
+    # -- hot-swap (ModelSubscriber.refresh) ----------------------------------
+
+    def set_scorer(self, scorer) -> None:
+        with self._cv:
+            self._scorer = scorer
+
+    @property
+    def has_scorer(self) -> bool:
+        return self._scorer is not None
+
+    @property
+    def wants_features(self) -> bool:
+        return getattr(self._scorer, "wants_features", True)
+
+    # -- the EdgeScorer surface ----------------------------------------------
+
+    def score(self, features, *, src_buckets=None, dst_buckets=None):  # dflint: hotpath
+        features = np.asarray(features, dtype=np.float32)
+        req = _Request(features, src_buckets, dst_buckets)
+        with self._cv:
+            self._pending.append(req)
+            self._pending_rows += features.shape[0]
+            lead = not self._leader_active
+            if lead:
+                self._leader_active = True
+            elif self._pending_rows >= self.max_batch_rows:
+                # Only a FULL queue is worth interrupting the leader's
+                # linger for; waking it per enqueue burned a context
+                # switch per follower on the serving profile.
+                self._cv.notify_all()
+        if lead:
+            self._flush_as_leader()
+        req.done.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- flush machinery -----------------------------------------------------
+
+    def _flush_as_leader(self) -> None:
+        deadline = time.monotonic() + self.linger_s
+        with self._cv:
+            try:
+                while self._pending_rows < self.max_batch_rows:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                batch = self._pending
+                self._pending = []
+                self._pending_rows = 0
+                scorer = self._scorer  # ONE snapshot for the whole flush
+            finally:
+                self._leader_active = False
+        self._dispatch(batch, scorer)
+
+    def _pad_size(self, rows: int) -> int:
+        i = bisect.bisect_left(self.pad_buckets, rows)
+        if i < len(self.pad_buckets):
+            return self.pad_buckets[i]
+        top = self.pad_buckets[-1]
+        return ((rows + top - 1) // top) * top
+
+    def _dispatch(self, batch: List[_Request], scorer) -> None:
+        try:
+            if scorer is None:
+                raise ScorerUnavailable("scorer deactivated while queued")
+            feat_dim = batch[0].features.shape[1]
+            if len(batch) == 1 or any(
+                r.features.shape[1] != feat_dim for r in batch
+            ):
+                # Singleton bypass — and the hot-swap corner where queued
+                # requests were featurized for scorers with different
+                # input widths (no common padded matrix exists).
+                self._score_each(batch, scorer)
+                return
+            rows = [r.features.shape[0] for r in batch]
+            total = sum(rows)
+            # Pad ladder only for static-shape (jit/TPU) backends; a
+            # numpy scorer runs the exact concatenated size — padding it
+            # is pure wasted compute (BENCHMARKS.md).
+            if getattr(scorer, "static_shapes", False):
+                padded = self._pad_size(total)
+                feats = np.zeros((padded, feat_dim), dtype=np.float32)
+                src = np.zeros(padded, dtype=np.int64)
+                dst = np.zeros(padded, dtype=np.int64)
+            else:
+                padded = total
+                feats = np.empty((total, feat_dim), dtype=np.float32)
+                src = np.empty(total, dtype=np.int64)
+                dst = np.empty(total, dtype=np.int64)
+            off = 0
+            for r in batch:
+                n = r.features.shape[0]
+                feats[off : off + n] = r.features
+                src[off : off + n] = r.src if r.src is not None else 0
+                dst[off : off + n] = r.dst if r.dst is not None else 0
+                off += n
+            faultinject.fire("scheduler.eval.batch")
+            scores = np.asarray(
+                scorer.score(feats, src_buckets=src, dst_buckets=dst)
+            )
+            off = 0
+            for r, n in zip(batch, rows):
+                r.result = scores[off : off + n]
+                off += n
+            self._note_batch(len(batch))
+        except ScorerUnavailable as exc:
+            for r in batch:
+                r.error = exc
+        except Exception as exc:  # noqa: BLE001 — degrade, never stall announces
+            logger.warning(
+                "coalesced scorer batch of %d request(s) failed (%s); "
+                "degrading to per-request scoring", len(batch), exc,
+            )
+            with self._cv:
+                self.fallbacks += 1
+            metrics.EVAL_BATCH_FALLBACK_TOTAL.inc()
+            self._score_each(batch, scorer)
+        finally:
+            for r in batch:
+                r.done.set()
+
+    def _score_each(self, batch: List[_Request], scorer) -> None:
+        """Per-request scoring: the singleton bypass and the degraded mode
+        after a failed coalesced call (one bad request must not sink its
+        batch-mates)."""
+        for r in batch:
+            try:
+                r.result = np.asarray(
+                    scorer.score(
+                        r.features, src_buckets=r.src, dst_buckets=r.dst
+                    )
+                )
+            except Exception as exc:  # noqa: BLE001 — per-request verdicts
+                logger.warning("per-request scoring failed: %s", exc)
+                r.error = exc
+        self._note_batch(len(batch))
+
+    def _note_batch(self, n_requests: int) -> None:
+        metrics.EVAL_BATCH_SIZE.observe(n_requests)
+        with self._cv:
+            self.batches += 1
+            self.batched_requests += n_requests
+
+    def mean_occupancy(self) -> float:
+        with self._cv:
+            return self.batched_requests / self.batches if self.batches else 0.0

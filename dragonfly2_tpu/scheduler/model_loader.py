@@ -5,6 +5,15 @@ evaluation (evaluator.go:84 TODO + the unwired KServe client); instead the
 scheduler polls the manager registry (via dynconfig cadence) for the
 active scorer version and hot-swaps the local MLEvaluator's scorer — a
 pointer flip, never an RPC during scheduling.
+
+Hot-swap atomicity (DESIGN.md §14): ``MLEvaluator.set_scorer`` is an
+atomic reference flip that also re-targets the attached
+``ScorerBatcher``; the evaluate path reads the scorer ONCE per call and
+the batcher snapshots it ONCE per flush, so a refresh landing mid-announce
+or mid-batch serves every in-flight ranking entirely from one model
+version (concurrency drill: tests/test_sched_vectorized.py
+refresh-under-load).  ``refresh`` itself is serialized by a lock so two
+overlapping polls cannot interleave version bookkeeping.
 """
 
 from __future__ import annotations
@@ -37,9 +46,16 @@ class ModelSubscriber:
         self._loaded_version: Optional[int] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._refresh_mu = threading.Lock()
 
     def refresh(self) -> bool:
-        """Pull the active version if it changed; returns True on swap."""
+        """Pull the active version if it changed; returns True on swap.
+        Safe against concurrent callers (lock) and against RPC threads
+        mid-``score`` (the evaluator/batcher snapshot the scorer)."""
+        with self._refresh_mu:
+            return self._refresh_locked()
+
+    def _refresh_locked(self) -> bool:
         model = self.registry.active_model(self.scheduler_id, self.model_name)
         if model is None:
             if self._loaded_version is not None:

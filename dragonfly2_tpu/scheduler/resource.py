@@ -536,6 +536,13 @@ class Peer:
         with self._mu:
             return len(self.finished_pieces)
 
+    def snapshot_pieces(self) -> List[Piece]:
+        """Consistent copy of this peer's downloaded pieces (insertion
+        order) — the serving-path featurizer groups them by serving
+        parent in one pass (evaluator.MLEvaluator._served_stats)."""
+        with self._mu:
+            return list(self.pieces.values())
+
     def is_done(self) -> bool:
         return self.fsm.current in (PEER_SUCCEEDED, PEER_FAILED, PEER_LEAVE)
 

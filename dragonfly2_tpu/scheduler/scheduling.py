@@ -79,7 +79,7 @@ class Scheduling:
         self, peer: Peer, blocklist: Optional[Set[str]] = None
     ) -> List[Peer]:
         blocklist = blocklist or set()
-        candidates: List[Peer] = []
+        prelim: List[Peer] = []
         for cand in peer.task.load_random_peers(self.config.filter_parent_limit):
             if cand.id in blocklist or cand.id in peer.block_parents:
                 continue
@@ -101,7 +101,17 @@ class Scheduling:
                 and cand.fsm.current not in (PEER_BACK_TO_SOURCE, PEER_SUCCEEDED)
             ):
                 continue
-            if self.evaluator.is_bad_node(cand):
+            prelim.append(cand)
+        if not prelim:
+            return []
+        # One vectorized bad-node pass over the survivors (the cost
+        # statistics dominate this filter); every check is per-candidate
+        # independent, so batching it after the cheap screens keeps the
+        # accepted set identical to the reference's one-at-a-time order.
+        bad = self.evaluator.is_bad_nodes(prelim)
+        candidates: List[Peer] = []
+        for cand, cand_bad in zip(prelim, bad):
+            if cand_bad:
                 continue
             if cand.host.free_upload_count() <= 0:
                 continue

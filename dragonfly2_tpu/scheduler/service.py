@@ -340,6 +340,11 @@ class SchedulerService:
         host.leave_peers()
         if self.networktopology is not None:
             self.networktopology.delete_host(host.id)
+        # A departed host frees its feature-cache slot immediately instead
+        # of aging out of the LRU (featcache invalidation rule, DESIGN §14).
+        cache = getattr(self.scheduling.evaluator, "feature_cache", None)
+        if cache is not None:
+            cache.invalidate(host.id)
         self._refresh_gauges()
 
     # -- server push (service_v2.go stream.Send semantics) -------------------

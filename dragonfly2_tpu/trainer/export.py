@@ -35,7 +35,13 @@ SCORER_SCHEMA_VERSION = 1
 @dataclass
 class MLPScorer:
     """EdgeScorer implementation (scheduler/evaluator.py protocol): gelu MLP
-    with the training-time feature standardization baked in."""
+    with the training-time feature standardization baked in.
+
+    Batched-score contract: every row of ``features`` is scored from that
+    row alone (row-wise standardize → row-wise dense stack), so the
+    scheduler's ``ScorerBatcher`` may pad the matrix and coalesce rows
+    from unrelated announces into one call — padded/stranger rows cannot
+    perturb a request's scores."""
 
     weights: List[Tuple[np.ndarray, np.ndarray]]  # [(W, b), ...]
     feat_mean: Optional[np.ndarray] = None
@@ -49,22 +55,46 @@ class MLPScorer:
     model_type: str = "mlp"
     version: int = SCORER_SCHEMA_VERSION
 
-    def score(self, features: np.ndarray, **_buckets) -> np.ndarray:
+    def _serving_weights(self) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Serving fast path: with no standardization in front, zeroing the
+        post-hoc feature COLUMNS of x is bit-identical to zeroing those
+        input ROWS of W1 (both make the dot-product terms exact 0.0), so
+        the per-call mask copy folds into the weights once.  Cached on
+        first use; scorer artifacts are immutable after load."""
+        folded = getattr(self, "_folded_weights", None)
+        if folded is None:
+            from ..records.features import POST_HOC_FEATURE_IDX
+
+            w0, b0 = self.weights[0]
+            w0 = w0.copy()
+            w0[list(POST_HOC_FEATURE_IDX), :] = 0.0
+            folded = [(w0, b0)] + list(self.weights[1:])
+            object.__setattr__(self, "_folded_weights", folded)
+        return folded
+
+    def score(self, features: np.ndarray, **_buckets) -> np.ndarray:  # dflint: hotpath
         # _buckets: src/dst host buckets offered uniformly by the evaluator;
         # the feature-based MLP ignores them (the GNN scorer consumes them).
         x = np.asarray(features, dtype=np.float32)
-        if self.post_hoc_masked:
-            from ..records.features import mask_post_hoc
-
-            x = mask_post_hoc(x)
         if self.feat_mean is not None:
+            # Standardization sits BETWEEN mask and stack: masked columns
+            # become (0-mean)/std ≠ 0, so the mask cannot fold into W1 —
+            # apply it per call, exactly as trained.
+            if self.post_hoc_masked:
+                from ..records.features import mask_post_hoc
+
+                x = mask_post_hoc(x)
             x = (x - self.feat_mean) / self.feat_std
-        n = len(self.weights)
-        for i, (w, b) in enumerate(self.weights):
+            weights = self.weights
+        elif self.post_hoc_masked:
+            weights = self._serving_weights()
+        else:
+            weights = self.weights
+        n = len(weights)
+        for i, (w, b) in enumerate(weights):  # dflint: disable=DF007 — per-LAYER (3 fixed), not per-item
             x = x @ w + b
             if i < n - 1:
-                # gelu (tanh approx — matches flax nn.gelu default)
-                x = 0.5 * x * (1.0 + np.tanh(0.7978845608 * (x + 0.044715 * x**3)))
+                x = _np_gelu(x)
         return x[..., 0]
 
 
@@ -179,7 +209,12 @@ def load_scorer(path_or_bytes):
 
 
 def _np_gelu(x: np.ndarray) -> np.ndarray:
-    return 0.5 * x * (1.0 + np.tanh(0.7978845608 * (x + 0.044715 * x**3)))
+    """gelu (tanh approx — matches flax nn.gelu default).  ``x * x * x``,
+    NOT ``x**3``: float32 integer-power lowers to a per-element libm
+    ``powf`` call (~100× the cost of two multiplies) and was the single
+    largest term in the serving path's scorer profile (BENCHMARKS.md)."""
+    x3 = x * x * x
+    return 0.5 * x * (1.0 + np.tanh(0.7978845608 * (x + 0.044715 * x3)))
 
 
 @dataclass
@@ -213,20 +248,24 @@ class GNNScorer:
         emb[~hit] = self._mean_emb
         return emb
 
-    def score(
+    def score(  # dflint: hotpath
         self,
         features: np.ndarray,
         *,
         src_buckets: Optional[np.ndarray] = None,
         dst_buckets: Optional[np.ndarray] = None,
     ) -> np.ndarray:
+        # Batched-score contract (EdgeScorer): rows score independently —
+        # two table lookups + a row-wise head — so padded micro-batches
+        # are safe.  The feature-axis concatenate below is per-CALL
+        # column assembly on [n, 3D], not a per-item build loop.
         if src_buckets is None or dst_buckets is None:
             raise ValueError("GNNScorer needs src/dst host buckets")
         s = self._lookup(np.asarray(src_buckets, np.int64))
         d = self._lookup(np.asarray(dst_buckets, np.int64))
-        x = np.concatenate([s, d, s * d], axis=-1).astype(np.float32)
+        x = np.concatenate([s, d, s * d], axis=-1).astype(np.float32)  # dflint: disable=DF007
         n = len(self.head_weights)
-        for i, (w, b) in enumerate(self.head_weights):
+        for i, (w, b) in enumerate(self.head_weights):  # dflint: disable=DF007 — per-LAYER (3 fixed), not per-item
             x = x @ w + b
             if i < n - 1:
                 x = _np_gelu(x)

@@ -21,11 +21,17 @@ class _Metric:
         self._mu = threading.Lock()
 
     def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
-        if set(labels) != set(self.label_names):
-            raise ValueError(
-                f"{self.name}: labels {sorted(labels)} != {sorted(self.label_names)}"
-            )
-        return tuple(labels[n] for n in self.label_names)
+        # Hot path (per-observe): equal length + every name present is
+        # equivalent to set equality without building two sets per call.
+        names = self.label_names
+        if len(labels) == len(names):
+            try:
+                return tuple([labels[n] for n in names])
+            except KeyError:
+                pass
+        raise ValueError(
+            f"{self.name}: labels {sorted(labels)} != {sorted(self.label_names)}"
+        )
 
     def _fmt_labels(self, key: Tuple[str, ...]) -> str:
         if not key:
@@ -105,12 +111,17 @@ class Histogram(_Metric):
         self._totals: Dict[Tuple[str, ...], int] = {}
 
     def observe(self, value: float, **labels: str) -> None:
+        # Counts are stored PER-BUCKET (one increment per observe) and
+        # cumulated at expose time — the cumulative-update loop over the
+        # bucket ladder showed up on the scheduler's per-announce path.
         key = self._key(labels)
+        idx = bisect.bisect_left(self.buckets, value)
         with self._mu:
-            counts = self._counts.setdefault(key, [0] * len(self.buckets))
-            idx = bisect.bisect_left(self.buckets, value)
-            for i in range(idx, len(self.buckets)):
-                counts[i] += 1
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * len(self.buckets)
+            if idx < len(counts):
+                counts[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
 
@@ -119,9 +130,11 @@ class Histogram(_Metric):
         with self._mu:
             for key, counts in sorted(self._counts.items()):
                 base = self._fmt_labels(key)[1:-1] if key else ""
+                running = 0
                 for le, c in zip(self.buckets, counts):
+                    running += c
                     sep = "," if base else ""
-                    out.append(f'{self.name}_bucket{{{base}{sep}le="{le}"}} {c}')
+                    out.append(f'{self.name}_bucket{{{base}{sep}le="{le}"}} {running}')
                 sep = "," if base else ""
                 out.append(f'{self.name}_bucket{{{base}{sep}le="+Inf"}} {self._totals[key]}')
                 lbl = "{" + base + "}" if base else ""

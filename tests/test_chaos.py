@@ -731,6 +731,121 @@ class TestBenchInitFailure:
         ) == "backend"
         assert len(calls) == 3
 
+    def test_post_acquire_backend_failure_still_one_json_line(self, capsys):
+        # Acquisition succeeds but the benchmark body dies on a backend
+        # touch (the round-5 failure shape: jax.devices() after acquire):
+        # still rc=1 with ONE parseable ok:false line, never a traceback.
+        import bench
+
+        class ExplodesOnTouch:
+            def __getattr__(self, name):
+                raise RuntimeError("UNAVAILABLE: TPU runtime went away")
+
+        rc = bench.main(acquire=lambda: ExplodesOnTouch())
+        out_lines = capsys.readouterr().out.strip().splitlines()
+        assert rc == 1 and len(out_lines) == 1
+        line = json.loads(out_lines[0])
+        assert line["ok"] is False
+        assert line["failure"] == "backend_unavailable"
+
+
+class TestSchedulerBatcherFaultSeam:
+    """ISSUE 3 satellite: a dropped/delayed coalesced scorer batch
+    degrades to per-request scoring — announces never stall on the
+    batcher (seam ``scheduler.eval.batch``, DF004 inventory)."""
+
+    def _swarm(self):
+        import numpy as np
+
+        from dragonfly2_tpu.scheduler import (
+            HostFeatureCache,
+            MLEvaluator,
+            ScorerBatcher,
+        )
+        from dragonfly2_tpu.sim.swarm import build_announce_swarm
+
+        task, peers = build_announce_swarm(48, seed=11)
+
+        class MLP:
+            def __init__(self):
+                rng = np.random.default_rng(0)
+                self.w = rng.standard_normal((32, 1)).astype(np.float32)
+
+            def score(self, features, **_buckets):
+                return (np.asarray(features, np.float32) @ self.w)[..., 0]
+
+        batcher = ScorerBatcher(linger_s=0.005)
+        ml = MLEvaluator(
+            MLP(), feature_cache=HostFeatureCache(max_hosts=256),
+            batcher=batcher,
+        )
+        return task, peers, ml, batcher
+
+    def _announce_storm(self, task, peers, ml, n_threads=8, per_thread=12):
+        import numpy as np
+
+        results, errs = [], []
+
+        def worker(tid):
+            rng = np.random.default_rng(tid)
+            try:
+                for _ in range(per_thread):
+                    child_i = int(rng.integers(0, len(peers)))
+                    cand = rng.choice(len(peers) - 1, size=9, replace=False)
+                    cand = [c if c < child_i else c + 1 for c in cand]
+                    ranked = ml.evaluate_parents(
+                        [peers[c] for c in cand], peers[child_i],
+                        task.total_piece_count,
+                    )
+                    results.append((child_i, tuple(cand),
+                                    tuple(p.id for p in ranked)))
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(n_threads)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return results, errs, time.monotonic() - t0
+
+    def test_dropped_batch_degrades_to_per_request(self):
+        task, peers, ml, batcher = self._swarm()
+        scenario = ChaosScenario(faults=[
+            FaultSpec(site="scheduler.eval.batch", kind="drop", every=2),
+        ])
+        with faultinject.installed(scenario.injector()) as inj:
+            results, errs, _ = self._announce_storm(task, peers, ml)
+        assert errs == []
+        assert len(results) == 8 * 12          # every announce completed
+        assert batcher.fallbacks >= 1          # the degrade path actually ran
+        assert any(k[0] == "scheduler.eval.batch" for k in inj.history_keys())
+        # Degraded (per-request) rankings are the SAME rankings the intact
+        # coalesced path produces — the fault changes latency, not order.
+        for child_i, cand, ranked in results:
+            ref = ml._evaluate_parents_reference(
+                [peers[c] for c in cand], peers[child_i],
+                task.total_piece_count,
+            )
+            assert tuple(p.id for p in ref) == ranked
+
+    def test_delayed_batch_does_not_stall_announces(self):
+        task, peers, ml, batcher = self._swarm()
+        scenario = ChaosScenario(faults=[
+            FaultSpec(site="scheduler.eval.batch", kind="delay",
+                      every=3, delay_s=0.05),
+        ])
+        with faultinject.installed(scenario.injector()):
+            results, errs, wall = self._announce_storm(task, peers, ml)
+        assert errs == []
+        assert len(results) == 8 * 12
+        # Delays pushed through the coalesced path, bounded, not a stall.
+        assert wall < 30.0
+
 
 class _FakeIdPTransport:
     """OAuth transport double: token endpoint + profile endpoint with

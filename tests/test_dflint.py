@@ -567,8 +567,99 @@ class TestDF006:
 
 
 # ---------------------------------------------------------------------------
-# Baseline machinery
+# DF007 — hot-path hygiene
 # ---------------------------------------------------------------------------
+
+
+class TestDF007:
+    def test_loop_in_marked_function_fires(self):
+        fs = lint("""
+            import numpy as np
+
+            def gather(rows):  # dflint: hotpath
+                out = []
+                for r in rows:
+                    out.append(r * 2)
+                return np.stack(out)
+        """)
+        assert "DF007" in rules_of(fs)
+
+    def test_concatenate_in_marked_function_fires(self):
+        fs = lint("""
+            import numpy as np
+
+            def featurize(a, b):  # dflint: hotpath
+                return np.concatenate([a, b])
+        """)
+        assert "DF007" in rules_of(fs)
+
+    def test_mark_on_line_above_def_applies(self):
+        fs = lint("""
+            import numpy as np
+
+            # dflint: hotpath
+            def featurize(a, b):
+                return np.vstack([a, b])
+        """)
+        assert "DF007" in rules_of(fs)
+
+    def test_comprehension_and_fromiter_are_accepted(self):
+        fs = lint("""
+            import numpy as np
+
+            def score_all(parents):  # dflint: hotpath
+                vals = np.fromiter((p.x for p in parents), np.float64)
+                ids = [p.id for p in parents]
+                return vals, ids
+        """)
+        assert fs == []
+
+    def test_unmarked_function_is_free(self):
+        fs = lint("""
+            import numpy as np
+
+            def build(rows):
+                out = []
+                for r in rows:
+                    out.append(np.concatenate([r, r]))
+                return out
+        """)
+        assert fs == []
+
+    def test_pragma_suppresses_reviewed_constant_loop(self):
+        fs = lint("""
+            def mlp(x, weights):  # dflint: hotpath
+                for w, b in weights:  # dflint: disable=DF007 — per-LAYER
+                    x = x @ w + b
+                return x
+        """)
+        assert fs == []
+
+    def test_inventory_missing_function_fires_by_name(self):
+        fs = lint(
+            """
+            def unrelated():
+                return 1
+            """,
+            relpath="dragonfly2_tpu/scheduler/featcache.py",
+        )
+        assert any(
+            f.rule == "DF007" and "HostFeatureCache.gather" in f.message
+            for f in fs
+        )
+
+    def test_inventory_unmarked_function_fires(self):
+        fs = lint(
+            """
+            class HostFeatureCache:
+                def gather(self, hosts):
+                    return hosts
+            """,
+            relpath="dragonfly2_tpu/scheduler/featcache.py",
+        )
+        assert any(
+            f.rule == "DF007" and "lost its" in f.message for f in fs
+        )
 
 
 class TestBaseline:
@@ -752,3 +843,32 @@ class TestMutationSensitivity:
         assert mutated != source
         fs = self._lint_source(relpath, mutated)
         assert "DF002" in {f.rule for f in fs}
+
+    def test_unmarking_hotpath_inventory_fails_df007(self):
+        # The serving-engine hygiene inventory pins evaluate_parents &co.;
+        # stripping the hotpath marks must fail tier-1 by name.
+        relpath = "dragonfly2_tpu/scheduler/evaluator.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        assert "# dflint: hotpath" in source
+        mutated = source.replace("# dflint: hotpath", "")
+        fs = self._lint_source(relpath, mutated)
+        assert any(
+            f.rule == "DF007" and "lost its" in f.message for f in fs
+        )
+
+    def test_looping_a_marked_hotpath_fails_df007(self):
+        # Re-introducing the per-parent concatenate featurize (the exact
+        # pre-PR shape) inside the marked function must be caught.
+        relpath = "dragonfly2_tpu/scheduler/featcache.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        needle = "return self.gather_with_buckets(hosts)[0]"
+        assert needle in source
+        mutated = source.replace(
+            needle,
+            "rows = []\n"
+            "        for h in hosts:\n"
+            "            rows.append(self.features(h))\n"
+            "        return np.stack(rows)",
+        )
+        fs = self._lint_source(relpath, mutated)
+        assert "DF007" in {f.rule for f in fs}

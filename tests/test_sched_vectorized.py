@@ -1,0 +1,630 @@
+"""Serving-engine property tests (ISSUE 3): the vectorized scheduler
+paths must be byte-identical to the scalar reference implementations.
+
+Covers:
+- rule ``Evaluator.evaluate_all`` vs scalar ``evaluate`` — bit-equal
+  scores, identical orderings (incl. argsort(kind="stable") tie-breaks);
+- ``MLEvaluator._featurize`` (cache gather) vs ``_featurize_reference``
+  — byte-identical feature matrices, identical orderings;
+- ``is_bad_nodes`` vs per-peer ``is_bad_node`` across randomized cost
+  populations (both the <30-sample 20× rule and the ≥30-sample 3σ rule);
+- ``HostFeatureCache`` invalidation rules (stamp movement, explicit
+  invalidate, eviction bound + slot recycling);
+- ``ScorerBatcher`` coalescing, singleton bypass, scorer hot-swap
+  atomicity under load (no mixed-version batch), degrade-to-per-request;
+- ``ModelSubscriber.refresh`` concurrent refresh-under-load;
+- ``tools/bench_sched.py --smoke`` JSON schema (tier-1 gate).
+
+The randomized sweeps are hypothesis-style seed sweeps: every case is a
+fixed list of seeds driving ``np.random.default_rng``, so a failure
+reproduces exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.records.features import host_bucket
+from dragonfly2_tpu.scheduler import (
+    Evaluator,
+    HostFeatureCache,
+    MLEvaluator,
+    ModelSubscriber,
+    ScorerBatcher,
+)
+from dragonfly2_tpu.scheduler.resource import Host, Peer, Task
+from dragonfly2_tpu.sim.swarm import build_announce_swarm
+from dragonfly2_tpu.utils.types import HostType
+
+REPO = Path(__file__).resolve().parents[1]
+
+SEEDS = [0, 1, 7, 1234]
+
+
+def _draw_announces(n_hosts, rng, *, count=12, parents=17):
+    """(child index, candidate index list) pairs, no self-candidacy."""
+    out = []
+    for _ in range(count):
+        child_i = int(rng.integers(0, n_hosts))
+        cand = rng.choice(n_hosts - 1, size=min(parents, n_hosts - 1),
+                         replace=False)
+        out.append((child_i, [c if c < child_i else c + 1 for c in cand]))
+    return out
+
+
+class _MLP:
+    """Tiny deterministic scorer honouring the batched-score contract."""
+
+    def __init__(self, seed=0, dim=32):
+        rng = np.random.default_rng(seed)
+        self.w = rng.standard_normal((dim, 1)).astype(np.float32)
+
+    def score(self, features, **_buckets):
+        return (np.asarray(features, np.float32) @ self.w)[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# Ordering equivalence: vectorized vs scalar reference
+# ---------------------------------------------------------------------------
+
+
+class TestRuleEvaluatorEquivalence:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_scores_bit_equal_and_ordering_identical(self, seed):
+        task, peers = build_announce_swarm(160, seed=seed)
+        rule = Evaluator()
+        rng = np.random.default_rng(seed + 100)
+        for child_i, cand in _draw_announces(len(peers), rng):
+            child = peers[child_i]
+            parents = [peers[c] for c in cand]
+            vec = rule.evaluate_all(parents, child, task.total_piece_count)
+            ref = np.array(
+                [rule.evaluate(p, child, task.total_piece_count) for p in parents]
+            )
+            assert np.array_equal(vec, ref)  # bit-equal, not just close
+            assert [p.id for p in rule.evaluate_parents(
+                parents, child, task.total_piece_count)] == \
+                [p.id for p in rule.evaluate_parents_reference(
+                    parents, child, task.total_piece_count)]
+
+    def test_tie_break_keeps_candidate_order(self):
+        # Identical hosts ⇒ identical scores for every parent: the stable
+        # descending argsort must preserve the candidate sample order,
+        # exactly like sorted(reverse=True).
+        task = Task("t-tie", "https://example.com/blob")
+        task.total_piece_count = 8
+        parents = []
+        for i in range(9):
+            h = Host(id=f"tie-{i}", hostname=f"tie-{i}", ip="10.0.0.9",
+                     concurrent_upload_limit=10)
+            h.stats.network.idc = "idc-x"
+            h.stats.network.location = "r|z"
+            p = Peer(f"tiepeer-{i}", task, h)
+            p.fsm.event("RegisterNormal")
+            p.fsm.event("Download")
+            parents.append(p)
+        ch = Host(id="tie-child", hostname="tie-child", ip="10.0.0.10")
+        child = Peer("tie-child-peer", task, ch)
+        rule = Evaluator()
+        ranked = rule.evaluate_parents(list(parents), child, 8)
+        assert [p.id for p in ranked] == [p.id for p in parents]
+        assert [p.id for p in rule.evaluate_parents_reference(
+            list(parents), child, 8)] == [p.id for p in parents]
+
+    def test_empty_and_singleton_passthrough(self):
+        task, peers = build_announce_swarm(4, seed=0)
+        rule = Evaluator()
+        assert rule.evaluate_parents([], peers[0], 16) == []
+        assert rule.evaluate_parents([peers[1]], peers[0], 16) == [peers[1]]
+
+
+class TestMLEvaluatorEquivalence:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_featurize_byte_identical(self, seed):
+        task, peers = build_announce_swarm(160, seed=seed)
+        ml = MLEvaluator(_MLP(), feature_cache=HostFeatureCache(max_hosts=512))
+        rng = np.random.default_rng(seed + 200)
+        for child_i, cand in _draw_announces(len(peers), rng):
+            child = peers[child_i]
+            parents = [peers[c] for c in cand]
+            vec = ml._featurize(parents, child)
+            ref = ml._featurize_reference(parents, child)
+            assert vec.dtype == ref.dtype == np.float32
+            assert np.array_equal(vec, ref)
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_ordering_identical_with_and_without_batcher(self, seed):
+        task, peers = build_announce_swarm(120, seed=seed)
+        scorer = _MLP(seed)
+        plain = MLEvaluator(scorer)
+        batched = MLEvaluator(
+            scorer,
+            feature_cache=HostFeatureCache(max_hosts=512),
+            batcher=ScorerBatcher(linger_s=0.0),
+        )
+        rng = np.random.default_rng(seed + 300)
+        for child_i, cand in _draw_announces(len(peers), rng):
+            child = peers[child_i]
+            parents = [peers[c] for c in cand]
+            ref = [p.id for p in plain._evaluate_parents_reference(
+                parents, child, task.total_piece_count)]
+            assert [p.id for p in plain.evaluate_parents(
+                parents, child, task.total_piece_count)] == ref
+            assert [p.id for p in batched.evaluate_parents(
+                parents, child, task.total_piece_count)] == ref
+
+    def test_cache_stays_byte_identical_after_host_mutation(self):
+        # Stamp movement (announce/host-update) must recompute in place:
+        # the cache path may never serve a stale row.
+        task, peers = build_announce_swarm(40, seed=3)
+        ml = MLEvaluator(_MLP(), feature_cache=HostFeatureCache(max_hosts=128))
+        child, parents = peers[0], peers[1:20]
+        before = ml._featurize(parents, child)
+        assert np.array_equal(before, ml._featurize_reference(parents, child))
+        for p in parents[:7]:  # mutate feature inputs mid-stream
+            p.host.upload_count += 3
+            p.host.concurrent_upload_count += 1
+        after = ml._featurize(parents, child)
+        assert np.array_equal(after, ml._featurize_reference(parents, child))
+        assert not np.array_equal(before, after)
+
+
+class TestIsBadNodesEquivalence:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_matches_scalar_over_random_populations(self, seed):
+        rng = np.random.default_rng(seed)
+        task = Task("t-bad", "https://example.com/blob")
+        task.total_piece_count = 64
+        ev = Evaluator()
+        peers = []
+        for i in range(60):
+            h = Host(id=f"bh-{i}", hostname=f"bh-{i}", ip="10.1.0.1")
+            p = Peer(f"bp-{i}", task, h)
+            p.fsm.event("RegisterNormal")
+            p.fsm.event("Download")
+            # Mixed regimes: no samples / below MIN / short (20× rule) /
+            # long (3σ rule), with occasional outlier last costs.
+            n_costs = int(rng.choice([0, 1, 2, 5, 29, 30, 31, 45]))
+            for n in range(n_costs):
+                cost = int(rng.integers(1_000_000, 50_000_000))
+                if n == n_costs - 1 and rng.random() < 0.4:
+                    cost *= int(rng.integers(10, 60))  # probe outlier
+                p.finish_piece(n, cost)
+            peers.append(p)
+        vec = ev.is_bad_nodes(peers)
+        ref = np.array([ev.is_bad_node(p) for p in peers])
+        assert np.array_equal(vec, ref)
+
+    def test_bad_states_flagged_without_costs(self):
+        task = Task("t-bad2", "https://example.com/blob")
+        h = Host(id="bs-0", hostname="bs-0", ip="10.1.0.2")
+        p = Peer("bsp-0", task, h)  # Pending is a bad state
+        ev = Evaluator()
+        assert ev.is_bad_nodes([p]).tolist() == [True]
+        assert ev.is_bad_node(p) is True
+
+
+# ---------------------------------------------------------------------------
+# HostFeatureCache invalidation rules
+# ---------------------------------------------------------------------------
+
+
+class TestHostFeatureCache:
+    def _host(self, i, idc="idc-a", loc="r1|z1"):
+        h = Host(id=f"fc-{i}", hostname=f"fc-{i}", ip="10.2.0.1",
+                 concurrent_upload_limit=8)
+        h.stats.network.idc = idc
+        h.stats.network.location = loc
+        return h
+
+    def test_hit_miss_and_stamp_invalidation(self):
+        cache = HostFeatureCache(max_hosts=16)
+        h = self._host(0)
+        r1 = cache.features(h)
+        r2 = cache.features(h)
+        assert cache.misses == 1 and cache.hits == 1
+        assert np.array_equal(r1, r2)
+        h.touch()  # announce path moves updated_at → stamp mismatch
+        cache.features(h)
+        assert cache.misses == 2
+
+    def test_explicit_invalidate_frees_slot(self):
+        cache = HostFeatureCache(max_hosts=4)
+        hosts = [self._host(i) for i in range(4)]
+        cache.gather(hosts)
+        assert len(cache) == 4
+        cache.invalidate(hosts[0].id)
+        assert len(cache) == 3
+        # The freed slot is recycled without clobbering live entries.
+        h_new = self._host(99)
+        cache.features(h_new)
+        rows, buckets = cache.gather_with_buckets(hosts[1:] + [h_new])
+        for host, row, bucket in zip(hosts[1:] + [h_new], rows, buckets):
+            assert np.array_equal(
+                row, MLEvaluator(None).feature_cache.features(host)
+            )
+            assert bucket == host_bucket(host.id)
+
+    def test_eviction_bounded_and_correct_after_reuse(self):
+        cache = HostFeatureCache(max_hosts=8)
+        hosts = [self._host(i) for i in range(30)]
+        for h in hosts:
+            cache.features(h)
+        assert len(cache) == 8
+        assert cache.evictions == 22
+        # Every surviving or re-computed row is still byte-correct.
+        fresh = HostFeatureCache(max_hosts=64)
+        rows, _ = cache.gather_with_buckets(hosts)
+        ref_rows, _ = fresh.gather_with_buckets(hosts)
+        assert np.array_equal(rows, ref_rows)
+
+    def test_serve_matches_uncached_and_interning(self):
+        cache = HostFeatureCache(max_hosts=64)
+        child = self._host(100, idc="idc-a", loc="r1|z1|rk1")
+        hosts = (
+            [self._host(i, idc="idc-a", loc="r1|z1|rk2") for i in range(5)]
+            + [self._host(i + 5, idc="idc-b", loc="r2|z9") for i in range(5)]
+            + [self._host(10, idc="", loc="")]
+        )
+        sv = cache.serve(child, hosts)
+        ref = cache._serve_uncached(child, hosts)
+        assert np.array_equal(sv.rows, ref.rows)
+        assert np.array_equal(sv.child_row, ref.child_row)
+        assert np.array_equal(sv.src_buckets, ref.src_buckets)
+        assert sv.dst_bucket == ref.dst_bucket
+        assert np.array_equal(sv.same_idc, ref.same_idc)
+        assert np.array_equal(sv.location_affinity, ref.location_affinity)
+        # Second serve is all hits and still identical.
+        sv2 = cache.serve(child, hosts)
+        assert sv2.n_misses == 0
+        assert np.array_equal(sv2.same_idc, ref.same_idc)
+        assert np.array_equal(sv2.location_affinity, ref.location_affinity)
+
+    def test_empty_idc_never_matches(self):
+        cache = HostFeatureCache(max_hosts=16)
+        child = self._host(0, idc="")
+        hosts = [self._host(1, idc=""), self._host(2, idc="idc-a")]
+        sv = cache.serve(child, hosts)
+        assert sv.same_idc.tolist() == [0.0, 0.0]
+
+    def test_oversized_candidate_set_served_uncached(self):
+        cache = HostFeatureCache(max_hosts=4)
+        child = self._host(0)
+        hosts = [self._host(i + 1) for i in range(8)]
+        sv = cache.serve(child, hosts)
+        assert sv.rows.shape[0] == 8 and sv.n_hits == 0
+        fresh = HostFeatureCache(max_hosts=64)
+        ref = fresh.serve(child, hosts)
+        assert np.array_equal(sv.rows, ref.rows)
+
+
+# ---------------------------------------------------------------------------
+# ScorerBatcher: coalescing, hot-swap atomicity, degrade modes
+# ---------------------------------------------------------------------------
+
+
+class _VersionScorer:
+    """Returns a constant per-row value == its version: a mixed-version
+    batch would show up as a non-constant result vector."""
+
+    def __init__(self, version):
+        self.version = float(version)
+
+    def score(self, features, **_buckets):
+        return np.full(np.asarray(features).shape[0], self.version)
+
+
+class TestScorerBatcher:
+    def test_coalesces_concurrent_requests(self):
+        calls = []
+
+        class Recording:
+            def score(self, features, **_buckets):
+                calls.append(np.asarray(features).shape[0])
+                return np.zeros(np.asarray(features).shape[0])
+
+        b = ScorerBatcher(Recording(), linger_s=0.05)
+        results, errs = [], []
+
+        def worker():
+            try:
+                results.append(b.score(np.ones((3, 4), np.float32)))
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=worker, daemon=True) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs and len(results) == 8
+        assert all(r.shape == (3,) for r in results)
+        # 8 × 3 rows coalesced into far fewer scorer calls than requests.
+        assert sum(calls) == 24 and len(calls) < 8
+        assert b.mean_occupancy() > 1.0
+
+    def test_singleton_bypass_unpadded(self):
+        shapes = []
+
+        class Recording:
+            def score(self, features, **_buckets):
+                shapes.append(np.asarray(features).shape)
+                return np.zeros(np.asarray(features).shape[0])
+
+        b = ScorerBatcher(Recording(), linger_s=0.0)
+        out = b.score(np.ones((5, 4), np.float32))
+        assert out.shape == (5,) and shapes == [(5, 4)]  # raw, no padding
+
+    def test_pad_ladder_only_for_static_shape_scorers(self):
+        shapes = []
+
+        class StaticShapes:
+            static_shapes = True
+
+            def score(self, features, **_buckets):
+                shapes.append(np.asarray(features).shape[0])
+                return np.zeros(np.asarray(features).shape[0])
+
+        b = ScorerBatcher(StaticShapes(), linger_s=0.05)
+
+        def worker():
+            b.score(np.ones((3, 4), np.float32))
+
+        threads = [threading.Thread(target=worker, daemon=True) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # Any coalesced (non-singleton) call landed on the bucket ladder.
+        assert shapes
+        for n in shapes:
+            assert n == 3 or n in b.pad_buckets
+
+    def test_hot_swap_never_splits_a_batch(self):
+        b = ScorerBatcher(_VersionScorer(1), linger_s=0.002)
+        stop = threading.Event()
+        bad, errs = [], []
+
+        def swapper():
+            v = 1
+            while not stop.is_set():
+                v += 1
+                b.set_scorer(_VersionScorer(v))
+
+        def worker():
+            try:
+                for _ in range(200):
+                    out = b.score(np.ones((4, 2), np.float32))
+                    u = np.unique(out)
+                    if len(u) != 1:  # rows from two model versions
+                        bad.append(out.tolist())
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        sw = threading.Thread(target=swapper, daemon=True)
+        workers = [threading.Thread(target=worker, daemon=True) for _ in range(6)]
+        sw.start()
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join()
+        stop.set()
+        sw.join()
+        assert not errs and bad == []
+
+    def test_failed_batch_degrades_to_per_request(self):
+        class FlakyBatch:
+            def __init__(self):
+                self.calls = 0
+
+            def score(self, features, **_buckets):
+                self.calls += 1
+                n = np.asarray(features).shape[0]
+                if n > 4:  # the coalesced call dies; per-request succeeds
+                    raise RuntimeError("batched backend exploded")
+                return np.ones(n)
+
+        b = ScorerBatcher(FlakyBatch(), linger_s=0.05)
+        results, errs = [], []
+
+        def worker():
+            try:
+                results.append(b.score(np.ones((4, 3), np.float32)))
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=worker, daemon=True) for _ in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs and len(results) == 5
+        assert all(np.array_equal(r, np.ones(4)) for r in results)
+        assert b.fallbacks >= 1
+
+    def test_no_scorer_raises_and_evaluator_falls_back_to_rules(self):
+        b = ScorerBatcher(None, linger_s=0.0)
+        from dragonfly2_tpu.scheduler import ScorerUnavailable
+
+        with pytest.raises(ScorerUnavailable):
+            b.score(np.ones((2, 2), np.float32))
+
+        task, peers = build_announce_swarm(20, seed=5)
+
+        class Dead:
+            def score(self, features, **_buckets):
+                raise RuntimeError("scorer gone")
+
+        ml = MLEvaluator(Dead(), batcher=ScorerBatcher(linger_s=0.0))
+        child, parents = peers[0], peers[1:9]
+        ranked = ml.evaluate_parents(parents, child, task.total_piece_count)
+        rule_ref = Evaluator().evaluate_parents_reference(
+            parents, child, task.total_piece_count
+        )
+        assert [p.id for p in ranked] == [p.id for p in rule_ref]
+
+
+# ---------------------------------------------------------------------------
+# ModelSubscriber: concurrent refresh under announce load
+# ---------------------------------------------------------------------------
+
+
+class _FakeModel:
+    def __init__(self, version):
+        self.version = version
+        self.id = f"m-{version}"
+        self.name = "parent-bandwidth-mlp"
+
+
+class _FlippingRegistry:
+    """active_model cycles versions; load_artifact hands version bytes."""
+
+    def __init__(self):
+        self.version = 1
+
+    def active_model(self, scheduler_id, name):
+        return _FakeModel(self.version)
+
+    def load_artifact(self, model):
+        return b"v%d" % model.version
+
+
+class TestModelSubscriberRefreshUnderLoad:
+    def test_concurrent_refresh_and_scoring(self, monkeypatch):
+        from dragonfly2_tpu.scheduler import model_loader
+
+        monkeypatch.setattr(
+            model_loader,
+            "ModelRegistry",
+            _FlippingRegistry,
+            raising=False,
+        )
+        import dragonfly2_tpu.trainer.export as export
+
+        monkeypatch.setattr(
+            export,
+            "load_scorer",
+            lambda blob: _VersionScorer(int(bytes(blob)[1:])),
+        )
+
+        task, peers = build_announce_swarm(60, seed=9)
+        batcher = ScorerBatcher(linger_s=0.001)
+        ml = MLEvaluator(
+            None, feature_cache=HostFeatureCache(max_hosts=256), batcher=batcher
+        )
+        registry = _FlippingRegistry()
+        sub = ModelSubscriber(registry, ml, scheduler_id="sched-1")
+        assert sub.refresh() is True  # v1 loaded
+
+        stop = threading.Event()
+        errs = []
+
+        def refresher():
+            while not stop.is_set():
+                registry.version += 1
+                try:
+                    sub.refresh()
+                except Exception as exc:  # noqa: BLE001
+                    errs.append(exc)
+
+        def announcer(tid):
+            rng = np.random.default_rng(tid)
+            try:
+                for child_i, cand in _draw_announces(len(peers), rng, count=40,
+                                                     parents=9):
+                    ranked = ml.evaluate_parents(
+                        [peers[c] for c in cand], peers[child_i],
+                        task.total_piece_count,
+                    )
+                    assert len(ranked) == len(cand)
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        ref = threading.Thread(target=refresher, daemon=True)
+        workers = [
+            threading.Thread(target=announcer, args=(i,), daemon=True)
+            for i in range(6)
+        ]
+        ref.start()
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join()
+        stop.set()
+        ref.join()
+        assert errs == []
+        # Quiesced: one final refresh converges on the registry's version.
+        sub.refresh()
+        assert sub._loaded_version == registry.version
+        assert ml._scorer.version == float(registry.version)
+
+    def test_refresh_serialized_against_itself(self, monkeypatch):
+        import dragonfly2_tpu.trainer.export as export
+
+        monkeypatch.setattr(
+            export,
+            "load_scorer",
+            lambda blob: _VersionScorer(int(bytes(blob)[1:])),
+        )
+        registry = _FlippingRegistry()
+        ml = MLEvaluator(None)
+        sub = ModelSubscriber(registry, ml, scheduler_id="sched-2")
+        errs = []
+
+        def hammer():
+            try:
+                for _ in range(50):
+                    registry.version += 1
+                    sub.refresh()
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=hammer, daemon=True) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errs == []
+        sub.refresh()
+        assert sub._loaded_version == registry.version
+
+
+# ---------------------------------------------------------------------------
+# bench_sched smoke: the tier-1 JSON schema gate
+# ---------------------------------------------------------------------------
+
+
+class TestBenchSchedSmoke:
+    def test_smoke_emits_schema_json(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "bench_sched.py"), "--smoke"],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            cwd=str(REPO),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = proc.stdout.strip().splitlines()[-1]
+        out = json.loads(line)
+        sys.path.insert(0, str(REPO / "tools"))
+        try:
+            from bench_sched import SCHEMA_KEYS
+        finally:
+            sys.path.pop(0)
+        for key in SCHEMA_KEYS:
+            assert key in out, key
+        assert out["ok"] is True
+        for path in ("scalar_rule", "vector_rule", "scalar_ml", "vector_ml"):
+            stats = out["paths"][path]
+            assert stats["announces"] > 0
+            assert stats["announces_per_sec"] > 0
+            assert stats["p50_ms"] <= stats["p99_ms"]
+        assert 0.0 <= out["cache_hit_rate"] <= 1.0
+        assert out["mean_batch_occupancy"] >= 0.0

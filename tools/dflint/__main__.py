@@ -18,7 +18,7 @@ from .core import run_paths
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.dflint",
-        description="AST-based project invariant checker (DF001-DF006)",
+        description="AST-based project invariant checker (DF001-DF007)",
     )
     parser.add_argument("paths", nargs="*", default=["dragonfly2_tpu"],
                         help="files/directories to check (default: dragonfly2_tpu)")

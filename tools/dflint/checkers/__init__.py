@@ -8,6 +8,7 @@ from . import (
     df004_fault_seams,
     df005_resources,
     df006_deadlines,
+    df007_hotpath,
 )
 
 CHECKERS = (
@@ -17,6 +18,7 @@ CHECKERS = (
     df004_fault_seams,
     df005_resources,
     df006_deadlines,
+    df007_hotpath,
 )
 
 RULES = {c.RULE: c for c in CHECKERS}

@@ -98,6 +98,7 @@ REQUIRED_SEAMS = {
     ),
     "dragonfly2_tpu/daemon/sni.py": ("sni.peek", "sni.hijack"),
     "dragonfly2_tpu/scheduler/topology_sync.py": ("scheduler.topology.sync",),
+    "dragonfly2_tpu/scheduler/microbatch.py": ("scheduler.eval.batch",),
     "dragonfly2_tpu/scheduler/seed_client.py": ("seed.trigger",),
     "dragonfly2_tpu/jobs/image.py": ("jobs.image.fetch",),
     "dragonfly2_tpu/jobs/remote.py": ("jobs.remote.call",),

@@ -1,0 +1,133 @@
+"""DF007 — hot-path hygiene.
+
+The scheduler serving engine (DESIGN.md §14) got its ≥5× announces/sec
+by replacing per-parent Python work with vectorized numpy; this rule is
+what keeps that true.  Functions carrying a ``# dflint: hotpath`` mark
+(on the ``def`` line, inside the signature, or on the line directly
+above) promise to be **per-item-loop-free**:
+
+1. **No loop statements** — a ``for``/``while``/``async for`` inside a
+   marked function is flagged.  A hot-path function operates on whole
+   arrays; per-item iteration belongs in a build-side helper outside the
+   mark.  Comprehensions/generators are exempt: they are the accepted
+   gather idiom for attribute reads feeding ``np.fromiter``.  Reviewed
+   constant-trip loops (an MLP's per-LAYER stack) carry an inline
+   ``# dflint: disable=DF007`` with a justification.
+2. **No per-call array concatenation** — ``np.concatenate`` /
+   ``np.append`` / ``np.vstack`` / ``np.hstack`` in a marked function is
+   flagged: each call reallocates; hot paths preallocate and fill (the
+   old ``_featurize`` built N ``np.concatenate`` rows per announce).
+
+3. **Inventory** — ``REQUIRED_HOTPATH`` pins the serving-path functions
+   that MUST stay marked (seeded with ``evaluate_parents`` /
+   ``_featurize`` / ``score``).  Un-marking (or renaming away) any of
+   them fails tier-1 by name, so the hygiene contract cannot be dropped
+   silently.  New hot paths: mark the function and add it here.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, Set
+
+from ..core import Finding, Module, dotted
+
+RULE = "DF007"
+TITLE = "per-item Python loop / per-call concatenate in a hot-path function"
+
+_MARK = re.compile(r"#\s*dflint:\s*hotpath\b")
+
+_BANNED_NP_CALLS = {"concatenate", "append", "vstack", "hstack"}
+_NP_PREFIXES = {"np", "numpy", "jnp"}
+
+# relpath -> qualnames that must carry the hotpath mark.  The serving
+# engine's contract, checked in.
+REQUIRED_HOTPATH = {
+    "dragonfly2_tpu/scheduler/evaluator.py": (
+        "Evaluator.evaluate_parents",
+        "Evaluator.evaluate_all",
+        "NetworkTopologyEvaluator.evaluate_all",
+        "MLEvaluator.evaluate_parents",
+        "MLEvaluator._featurize",
+    ),
+    "dragonfly2_tpu/scheduler/featcache.py": ("HostFeatureCache.gather",),
+    "dragonfly2_tpu/scheduler/microbatch.py": ("ScorerBatcher.score",),
+    "dragonfly2_tpu/records/features.py": ("edge_features_batch",),
+    "dragonfly2_tpu/trainer/export.py": ("MLPScorer.score", "GNNScorer.score"),
+}
+
+
+def _mark_lines(module: Module) -> Set[int]:
+    return {
+        i + 1 for i, line in enumerate(module.lines) if _MARK.search(line)
+    }
+
+
+def _is_marked(func: ast.AST, marks: Set[int]) -> bool:
+    """Marked when the hotpath comment sits on the line above the def,
+    anywhere across the (possibly multi-line) signature, or on the first
+    body statement's line."""
+    first_body = func.body[0].lineno if func.body else func.lineno
+    return any(
+        func.lineno - 1 <= line <= first_body for line in marks
+    )
+
+
+def _banned_np_call(node: ast.Call) -> bool:
+    name = dotted(node.func)
+    if not name or "." not in name:
+        return False
+    parts = name.split(".")
+    return parts[0] in _NP_PREFIXES and parts[-1] in _BANNED_NP_CALLS
+
+
+def check(module: Module) -> Iterator[Finding]:
+    marks = _mark_lines(module)
+    funcs: Dict[str, ast.AST] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs[module.qualname(node)] = node
+
+    # Sub-rule 3: the inventory — required hot paths must exist AND stay
+    # marked (deleting the mark is a named tier-1 failure).
+    for qual in REQUIRED_HOTPATH.get(module.relpath, ()):
+        func = funcs.get(qual)
+        if func is None:
+            yield module.finding(
+                RULE,
+                module.tree,
+                f"required hot-path function {qual!r} is missing — the "
+                "serving-engine hygiene inventory names it "
+                "(REQUIRED_HOTPATH in tools/dflint/checkers/df007_hotpath.py)",
+            )
+        elif not _is_marked(func, marks):
+            yield module.finding(
+                RULE,
+                func,
+                f"{qual} lost its '# dflint: hotpath' mark — the "
+                "serving-engine hygiene inventory requires it "
+                "(REQUIRED_HOTPATH in tools/dflint/checkers/df007_hotpath.py)",
+            )
+
+    # Sub-rules 1-2: hygiene inside every marked function.
+    for qual, func in funcs.items():
+        if not _is_marked(func, marks):
+            continue
+        for node in ast.walk(func):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                yield module.finding(
+                    RULE,
+                    node,
+                    f"per-item Python loop in hot-path function {qual} — "
+                    "vectorize it or move the iteration to an unmarked "
+                    "build-side helper",
+                )
+            elif isinstance(node, ast.Call) and _banned_np_call(node):
+                yield module.finding(
+                    RULE,
+                    node,
+                    f"{dotted(node.func)} in hot-path function {qual} "
+                    "reallocates per call — preallocate and fill "
+                    "(np.empty + slice assignment) or np.stack once",
+                )
