@@ -53,6 +53,24 @@ def build(cfg: ManagerConfig):
 
         kwargs = dict(cfg.objectstorage)
         objectstorage = make_backend(kwargs.pop("kind", "fs"), **kwargs)
+    # Rollout controller (rollout/controller.py): evidence-gated
+    # SHADOW→CANARY→ACTIVE promotion with auto-rollback; its rows ride
+    # the same state backend, so in-flight rollouts survive a bounce.
+    from ..rollout import RolloutController, RolloutGuardrails
+
+    rollout = RolloutController(
+        registry,
+        guardrails=RolloutGuardrails(
+            min_shadow_samples=cfg.rollout.min_shadow_samples,
+            min_canary_samples=cfg.rollout.min_canary_samples,
+            max_regret_ratio=cfg.rollout.max_regret_ratio,
+            regret_slack=cfg.rollout.regret_slack,
+            max_inversion_ratio=cfg.rollout.max_inversion_ratio,
+            max_psi=cfg.rollout.max_psi,
+            canary_percent=cfg.rollout.canary_percent,
+        ),
+        backend=backend,
+    )
     # NOTE: no DynconfigServer here — the dynconfig payload schedulers
     # poll is served straight from the CrudStore's cluster rows
     # (/api/v1/clusters/<id>:config), one source of truth.
@@ -64,6 +82,7 @@ def build(cfg: ManagerConfig):
         "crud": crud,
         "objectstorage": objectstorage,
         "state_backend": backend,
+        "rollout": rollout,
     }
 
 
@@ -148,6 +167,7 @@ def run(argv=None) -> int:
         ca=ca,
         state_backend=parts["state_backend"],
         jobs_min_requeue_s=cfg.jobs_min_requeue_s,
+        rollout=parts["rollout"],
         **auth,
     )
     rest.serve()

@@ -250,6 +250,8 @@ def run(argv=None) -> int:
     cluster_link = None
     dynconfig = None
     topology_sync = None
+    model_subscriber = None
+    rollout_reporter = None
     if cfg.manager_addr:
         from ..jobs.preheat import PREHEAT
         from ..jobs.remote import RemoteJobWorker
@@ -378,6 +380,36 @@ def run(argv=None) -> int:
             )
             topology_sync.serve()
 
+        # Model rollout plane (DESIGN.md §15): the ml evaluator polls the
+        # manager registry for the active AND candidate versions (seeded
+        # ±jitter so a fleet never herds the registry), shadow-scores a
+        # sampled announce slice into a replay log, and reports joined
+        # outcome quality back to the rollout controller.
+        if cfg.scheduling.algorithm == "ml":
+            from ..rollout import RolloutReporter, RolloutRESTClient
+            from ..rpc.registry_client import RemoteRegistry
+            from ..scheduler import ModelSubscriber
+
+            model_subscriber = ModelSubscriber(
+                RemoteRegistry(cfg.manager_addr, token=token),
+                service.scheduling.evaluator,
+                scheduler_id=scheduler_id,
+                refresh_interval=cfg.scheduling.model_poll_interval_s,
+                jitter=cfg.scheduling.model_poll_jitter,
+                rollout_client=RolloutRESTClient(cfg.manager_addr, token=token),
+                shadow_sample_rate=cfg.scheduling.shadow_sample_rate,
+                shadow_log_path=_os.path.join(
+                    cfg.storage.dir, "shadow_replay.dfc"
+                ),
+            )
+            model_subscriber.serve()
+            rollout_reporter = RolloutReporter(
+                model_subscriber, storage,
+                RolloutRESTClient(cfg.manager_addr, token=token),
+                interval_s=cfg.scheduling.rollout_report_interval_s,
+            )
+            rollout_reporter.serve()
+
     # Periodic dataset upload to the trainer (announcer.go:127-142 train
     # ticker, default 7d) — the link that feeds the learning loop in a
     # real deployment.
@@ -471,6 +503,10 @@ def run(argv=None) -> int:
             cluster_link.stop()
         if dynconfig is not None:
             dynconfig.stop()
+        if rollout_reporter is not None:
+            rollout_reporter.stop()
+        if model_subscriber is not None:
+            model_subscriber.stop()
         if topology_sync is not None:
             topology_sync.stop()  # final disk checkpoint
         elif topology_state_path is not None and service.networktopology:

@@ -89,6 +89,13 @@ class SchedulingSection:
     # and the host-feature cache's LRU bound.
     eval_batch_linger_ms: float = 1.5
     eval_feature_cache_hosts: int = 65536
+    # Rollout plane (DESIGN.md §15): registry poll cadence with seeded
+    # anti-herd jitter, the shadow-scoring sample fraction, and the
+    # evaluate→report cycle interval.
+    model_poll_interval_s: float = 300.0
+    model_poll_jitter: float = 0.1
+    shadow_sample_rate: float = 0.1
+    rollout_report_interval_s: float = 60.0
 
     def validate(self) -> None:
         if self.algorithm not in ("default", "nt", "ml"):
@@ -101,6 +108,10 @@ class SchedulingSection:
             raise ConfigError("eval_batch_linger_ms < 0")
         if self.eval_feature_cache_hosts < 1:
             raise ConfigError("eval_feature_cache_hosts < 1")
+        if not (0.0 <= self.shadow_sample_rate <= 1.0):
+            raise ConfigError("shadow_sample_rate must be in [0, 1]")
+        if not (0.0 <= self.model_poll_jitter < 0.5):
+            raise ConfigError("model_poll_jitter must be in [0, 0.5)")
 
 
 @dataclass
@@ -214,9 +225,32 @@ class ModelRegistrySection:
 
 
 @dataclass
+class RolloutSection:
+    """Rollout-controller guardrails (rollout/controller.py
+    RolloutGuardrails; DESIGN.md §15 documents each threshold)."""
+
+    min_shadow_samples: int = 200
+    min_canary_samples: int = 200
+    max_regret_ratio: float = 1.10
+    regret_slack: float = 0.02
+    max_inversion_ratio: float = 1.10
+    max_psi: float = 0.25
+    canary_percent: int = 10
+
+    def validate(self) -> None:
+        if not (0 <= self.canary_percent <= 100):
+            raise ConfigError("rollout.canary_percent must be in [0, 100]")
+        if self.max_regret_ratio < 1.0 or self.max_inversion_ratio < 1.0:
+            raise ConfigError("rollout ratio guardrails must be >= 1.0")
+        if self.min_shadow_samples < 1 or self.min_canary_samples < 1:
+            raise ConfigError("rollout sample floors must be >= 1")
+
+
+@dataclass
 class ManagerConfig:
     server: ServerConfig = field(default_factory=lambda: ServerConfig(port=65003))
     registry: ModelRegistrySection = field(default_factory=ModelRegistrySection)
+    rollout: RolloutSection = field(default_factory=RolloutSection)
     keepalive_ttl_s: float = 60.0
     # RBAC (manager users + PATs): token_secret (>=16 bytes) turns auth
     # on; users_db persists accounts; root_password seeds the first admin.
@@ -247,6 +281,7 @@ class ManagerConfig:
     def validate(self) -> None:
         self.server.validate()
         self.log.validate()
+        self.rollout.validate()
         if self.token_secret and len(self.token_secret.encode()) < 16:
             raise ConfigError("token_secret must be >= 16 bytes")
         for p in self.oauth_providers:

@@ -22,7 +22,7 @@ on, rebuilt as an embeddable runtime:
   (manager/models/oauth.go).
 """
 
-from .registry import Model, ModelRegistry, ModelState  # noqa: F401
+from .registry import ArtifactDigestError, Model, ModelRegistry, ModelState  # noqa: F401
 from .searcher import ClusterScopes, SchedulerCluster, Searcher  # noqa: F401
 from .dynconfig import Dynconfig, DynconfigServer  # noqa: F401
 from .cluster import ClusterManager, SchedulerInstance, SeedPeerInstance  # noqa: F401

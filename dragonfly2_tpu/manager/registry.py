@@ -26,8 +26,27 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 class ModelState(str, enum.Enum):
+    """Version lifecycle.  The reference knows only active/inactive
+    (models/model.go); SHADOW and CANARY are the rollout plane's
+    intermediate gates (rollout/controller.py): a SHADOW version is
+    re-scored against the active one off the hot path, a CANARY version
+    serves a deterministic hash-bucketed slice of announces.  At most
+    one version per (scheduler_id, name) holds each of ACTIVE / SHADOW /
+    CANARY."""
+
     ACTIVE = "active"
     INACTIVE = "inactive"
+    SHADOW = "shadow"
+    CANARY = "canary"
+
+
+# States a rollout candidate occupies while under evaluation.
+CANDIDATE_STATES = (ModelState.SHADOW, ModelState.CANARY)
+
+
+class ArtifactDigestError(ValueError):
+    """Stored blob bytes do not hash to the digest recorded at
+    create_model — the artifact was corrupted or swapped in place."""
 
 
 @dataclass
@@ -42,6 +61,9 @@ class Model:
     state: ModelState = ModelState.INACTIVE
     evaluation: Dict[str, float] = field(default_factory=dict)
     blob_key: str = ""
+    # sha256 hex of the artifact bytes, recorded at create_model and
+    # verified on every load_artifact (rows predating the field carry "").
+    artifact_digest: str = ""
     created_at: float = field(default_factory=time.time)
     updated_at: float = field(default_factory=time.time)
 
@@ -85,6 +107,7 @@ def _model_to_doc(m: Model) -> dict:
         "id": m.id, "name": m.name, "type": m.type, "version": m.version,
         "scheduler_id": m.scheduler_id, "state": m.state.value,
         "evaluation": m.evaluation, "blob_key": m.blob_key,
+        "artifact_digest": m.artifact_digest,
         "created_at": m.created_at, "updated_at": m.updated_at,
     }
 
@@ -94,6 +117,7 @@ def _model_from_doc(d: dict) -> Model:
         id=d["id"], name=d["name"], type=d["type"], version=d["version"],
         scheduler_id=d["scheduler_id"], state=ModelState(d["state"]),
         evaluation=dict(d["evaluation"]), blob_key=d["blob_key"],
+        artifact_digest=d.get("artifact_digest", ""),  # pre-digest rows
         created_at=d["created_at"], updated_at=d["updated_at"],
     )
 
@@ -172,6 +196,8 @@ class ModelRegistry:
             sched_key = sha256_from_strings(scheduler_id)[:24]
             blob_key = f"{name}-{sched_key}-v{version}.npz"
             self.blobs.put(blob_key, artifact)
+            import hashlib
+
             model = Model(
                 id=f"{model_id}-v{version}",
                 name=name,
@@ -180,6 +206,9 @@ class ModelRegistry:
                 scheduler_id=scheduler_id,
                 evaluation=dict(evaluation or {}),
                 blob_key=blob_key,
+                # Content address for REAL: the row pins the bytes it was
+                # created with, and load_artifact refuses anything else.
+                artifact_digest=hashlib.sha256(artifact).hexdigest(),
             )
             self._models[model.id] = model
             self._persist(model)
@@ -215,6 +244,35 @@ class ModelRegistry:
             model.state = ModelState.INACTIVE
             model.updated_at = time.time()
             self._persist(model)
+            return model
+
+    def set_state(self, model_id: str, state: ModelState) -> Model:
+        """Rollout-plane transitions (SHADOW/CANARY/INACTIVE).  Like
+        ``activate``, the flip is exclusive per (scheduler_id, name) for
+        SHADOW and CANARY — one candidate at a time — and all touched
+        rows persist in ONE transaction.  ACTIVE must go through
+        ``activate`` (it owns the single-active flip)."""
+        if state is ModelState.ACTIVE:
+            return self.activate(model_id)
+        with self._mu:
+            model = self._models.get(model_id)
+            if model is None:
+                raise KeyError(model_id)
+            changed = [model]
+            if state in CANDIDATE_STATES:
+                for other in self._models.values():
+                    if (
+                        other is not model
+                        and other.scheduler_id == model.scheduler_id
+                        and other.name == model.name
+                        and other.state in CANDIDATE_STATES
+                    ):
+                        other.state = ModelState.INACTIVE
+                        other.updated_at = time.time()
+                        changed.append(other)
+            model.state = state
+            model.updated_at = time.time()
+            self._persist(*changed)
             return model
 
     def delete(self, model_id: str) -> None:
@@ -263,5 +321,28 @@ class ModelRegistry:
                     return m
             return None
 
+    def candidate_model(self, scheduler_id: str, name: str) -> Optional[Model]:
+        """The version under rollout evaluation (SHADOW or CANARY), if
+        any — what the scheduler's candidate poll asks."""
+        with self._mu:
+            for m in self._models.values():
+                if (
+                    m.scheduler_id == scheduler_id
+                    and m.name == name
+                    and m.state in CANDIDATE_STATES
+                ):
+                    return m
+            return None
+
     def load_artifact(self, model: Model) -> bytes:
-        return self.blobs.get(model.blob_key)
+        data = self.blobs.get(model.blob_key)
+        if model.artifact_digest:
+            import hashlib
+
+            got = hashlib.sha256(data).hexdigest()
+            if got != model.artifact_digest:
+                raise ArtifactDigestError(
+                    f"{model.id}: artifact sha256 {got[:12]}… != recorded "
+                    f"{model.artifact_digest[:12]}… — blob corrupted or swapped"
+                )
+        return data

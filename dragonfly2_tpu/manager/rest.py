@@ -6,6 +6,11 @@ Minimal JSON binding over stdlib HTTP:
   GET    /api/v1/models?scheduler_id=&name=      list models
   POST   /api/v1/models/<id>:activate            single-active activation
   POST   /api/v1/models/<id>:deactivate
+  POST   /api/v1/models/<id>:rollout             begin evidence-gated rollout (OPERATOR)
+  GET    /api/v1/models:candidate?scheduler_id=&name=   the SHADOW/CANARY candidate
+  GET    /api/v1/rollouts                        rollout state machines
+  GET    /api/v1/rollouts:get?scheduler_id=&name=
+  POST   /api/v1/rollouts:report                 scheduler evaluation report (PEER)
   GET    /api/v1/schedulers                      active scheduler instances
   POST   /api/v1/schedulers                      register a scheduler instance
   POST   /api/v1/schedulers/<id>:keepalive       liveness tick → {known}
@@ -96,6 +101,7 @@ def _model_to_json(m: Model) -> dict:
         "scheduler_id": m.scheduler_id,
         "state": m.state.value,
         "evaluation": m.evaluation,
+        "artifact_digest": m.artifact_digest,
     }
 
 
@@ -120,9 +126,13 @@ class ManagerRESTServer:
         ca=None,
         state_backend=None,
         jobs_min_requeue_s: float = 30.0,
+        rollout=None,
     ):
         self.registry = registry
         self.clusters = clusters
+        # Rollout controller (rollout/controller.py): serves the
+        # candidate poll + evaluation-report routes; None → 404s.
+        self.rollout = rollout
         # Cluster CA (security/ca.py CertificateAuthority): with one
         # attached, peers self-provision their mTLS identity over the
         # wire at boot — POST /api/v1/certs:issue (the reference's
@@ -260,11 +270,14 @@ class ManagerRESTServer:
                     else:
                         try:
                             blob = server.registry.load_artifact(m)
-                        except (KeyError, OSError) as exc:
+                        except (KeyError, OSError, ValueError) as exc:
                             # Row exists but the blob is gone (mismatched
-                            # blob dir after restart) — a clean 404 beats a
-                            # dead handler thread + connection reset.
-                            self._json(404, {"error": f"artifact missing: {exc}"})
+                            # blob dir after restart) or fails its digest
+                            # check (ArtifactDigestError) — a clean 404
+                            # beats a dead handler thread + connection
+                            # reset, and no client ever receives bytes
+                            # the manager itself cannot verify.
+                            self._json(404, {"error": f"artifact unavailable: {exc}"})
                             return
                         self._json(
                             200, {"artifact_b64": base64.b64encode(blob).decode()}
@@ -275,6 +288,47 @@ class ManagerRESTServer:
                         self._json(404, {"error": "model not found"})
                     else:
                         self._json(200, _model_to_json(m))
+                elif path == "/api/v1/models:candidate":
+                    # The scheduler's rollout poll: the version under
+                    # evaluation (SHADOW/CANARY) + its routing percent.
+                    m = server.registry.candidate_model(
+                        q.get("scheduler_id", ""), q.get("name", "")
+                    )
+                    if m is None:
+                        self._json(404, {"error": "no candidate model"})
+                    else:
+                        rollout = (
+                            server.rollout.get(m.scheduler_id, m.name)
+                            if server.rollout is not None
+                            else None
+                        )
+                        self._json(200, {
+                            "model": _model_to_json(m),
+                            "phase": m.state.value,
+                            "canary_percent": (
+                                rollout.canary_percent if rollout else 0
+                            ),
+                        })
+                elif path == "/api/v1/rollouts":
+                    if server.rollout is None:
+                        self._json(404, {"error": "rollout controller not configured"})
+                    else:
+                        self._json(200, [
+                            server.rollout.to_json(r)
+                            for r in server.rollout.list()
+                        ])
+                elif path == "/api/v1/rollouts:get":
+                    r = (
+                        server.rollout.get(
+                            q.get("scheduler_id", ""), q.get("name", "")
+                        )
+                        if server.rollout is not None
+                        else None
+                    )
+                    if r is None:
+                        self._json(404, {"error": "no such rollout"})
+                    else:
+                        self._json(200, server.rollout.to_json(r))
                 elif path == "/api/v1/schedulers":
                     self._json(
                         200,
@@ -454,7 +508,15 @@ class ManagerRESTServer:
                 # scheduler workers' automated flow → PEER.
                 if path == "/api/v1/models":
                     required = Role.PEER
-                elif path.endswith(":activate") or path.endswith(":deactivate"):
+                elif path == "/api/v1/rollouts:report":
+                    # Shadow/canary evaluation reports are the scheduler's
+                    # automated flow (like keepalive/job-poll) → PEER.
+                    required = Role.PEER
+                elif (
+                    path.endswith(":activate")
+                    or path.endswith(":deactivate")
+                    or path.endswith(":rollout")
+                ):
                     required = Role.OPERATOR
                 elif path == "/api/v1/jobs":
                     required = Role.OPERATOR
@@ -629,6 +691,24 @@ class ManagerRESTServer:
                     except (KeyError, ValueError) as exc:
                         self._json(400, {"error": str(exc)})
                     return
+                if path == "/api/v1/rollouts:report":
+                    # One evaluation report from a scheduler → the
+                    # controller's decision (rollout/controller.py).
+                    if server.rollout is None:
+                        self._json(404, {"error": "rollout controller not configured"})
+                        return
+                    try:
+                        req = self._body()
+                        decision = server.rollout.report(
+                            req["scheduler_id"], req["name"],
+                            dict(req.get("report") or {}),
+                        )
+                        self._json(200, decision)
+                    except KeyError as exc:
+                        self._json(404, {"error": str(exc)})
+                    except (ValueError, TypeError) as exc:
+                        self._json(400, {"error": str(exc)})
+                    return
                 if path.startswith("/api/v1/models/") and ":" in path:
                     model_id, _, action = path[len("/api/v1/models/") :].rpartition(":")
                     try:
@@ -636,12 +716,30 @@ class ManagerRESTServer:
                             m = server.registry.activate(model_id)
                         elif action == "deactivate":
                             m = server.registry.deactivate(model_id)
+                        elif action == "rollout":
+                            # Begin the evidence-gated rollout for this
+                            # version (CANDIDATE → SHADOW).
+                            if server.rollout is None:
+                                self._json(
+                                    404,
+                                    {"error": "rollout controller not configured"},
+                                )
+                                return
+                            req = self._body()
+                            r = server.rollout.begin(
+                                model_id,
+                                canary_percent=req.get("canary_percent"),
+                            )
+                            self._json(200, server.rollout.to_json(r))
+                            return
                         else:
                             self._json(404, {"error": f"unknown action {action}"})
                             return
                         self._json(200, _model_to_json(m))
                     except KeyError:
                         self._json(404, {"error": f"model {model_id} not found"})
+                    except ValueError as exc:
+                        self._json(400, {"error": str(exc)})
                     return
                 self._json(404, {"error": "not found"})
 

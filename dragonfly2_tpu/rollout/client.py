@@ -1,0 +1,141 @@
+"""Rollout-plane clients: how a scheduler talks to the rollout
+controller.
+
+Two implementations of one small surface:
+
+- ``candidate(scheduler_id, name)`` — the version under evaluation (a
+  ``CandidateInfo`` with the model row, rollout phase and canary
+  percent), or None;
+- ``report(scheduler_id, name, payload)`` — post one evaluation report
+  (rollout/evaluation.py ``evaluate_shadow`` output) and get the
+  controller's decision back.
+
+``LocalRolloutClient`` wraps an in-process ``RolloutController`` (tests,
+embedded runs, deploy/e2e_loop).  ``RolloutRESTClient`` rides the
+manager's REST surface with the same retry/translate discipline as
+rpc/registry_client.py, and fires the ``rollout.fetch`` /
+``rollout.report`` chaos seams (DF004 REQUIRED_SEAMS) so the drills can
+cut the quality plane deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Optional
+
+from ..manager.registry import Model
+from ..rpc.retry import retry_call
+
+
+@dataclass
+class CandidateInfo:
+    model: Model
+    phase: str                # "shadow" | "canary"
+    canary_percent: int
+
+
+class LocalRolloutClient:
+    """In-process controller + registry (same process as the manager)."""
+
+    def __init__(self, controller) -> None:
+        self.controller = controller
+        self.registry = controller.registry
+
+    def candidate(self, scheduler_id: str, name: str) -> Optional[CandidateInfo]:
+        model = self.registry.candidate_model(scheduler_id, name)
+        if model is None:
+            return None
+        rollout = self.controller.get(scheduler_id, name)
+        return CandidateInfo(
+            model=model,
+            phase=model.state.value,
+            canary_percent=rollout.canary_percent if rollout else 0,
+        )
+
+    def report(self, scheduler_id: str, name: str, payload: dict) -> dict:
+        return self.controller.report(scheduler_id, name, payload)
+
+    def load_artifact(self, model: Model) -> bytes:
+        return self.registry.load_artifact(model)
+
+
+class RolloutRESTClient:
+    """The wire form (manager/rest.py rollout routes)."""
+
+    def __init__(
+        self, base_url: str, *, timeout: float = 15.0, token: Optional[str] = None
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self.token = token
+
+    def _headers(self) -> dict:
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        return headers
+
+    def candidate(self, scheduler_id: str, name: str) -> Optional[CandidateInfo]:
+        from ..rpc.registry_client import _model_from_json
+        from ..utils import faultinject
+
+        def once():
+            faultinject.fire("rollout.fetch")
+            url = (
+                self.base_url
+                + "/api/v1/models:candidate?"
+                + urllib.parse.urlencode(
+                    {"scheduler_id": scheduler_id, "name": name}
+                )
+            )
+            try:
+                with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as exc:
+                if exc.code == 404:
+                    return None
+                raise RuntimeError(f"manager: HTTP {exc.code}") from exc
+
+        data = retry_call(
+            once, retry_on=(ConnectionError, TimeoutError, OSError)
+        )
+        if data is None:
+            return None
+        return CandidateInfo(
+            model=_model_from_json(data["model"]),
+            phase=data["phase"],
+            canary_percent=int(data.get("canary_percent", 0)),
+        )
+
+    def report(self, scheduler_id: str, name: str, payload: dict) -> dict:
+        from ..utils import faultinject
+
+        def once():
+            faultinject.fire("rollout.report")
+            req = urllib.request.Request(
+                self.base_url + "/api/v1/rollouts:report",
+                data=json.dumps(
+                    {
+                        "scheduler_id": scheduler_id,
+                        "name": name,
+                        "report": payload,
+                    }
+                ).encode(),
+                headers=self._headers(),
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as exc:
+                if exc.code == 404:
+                    raise KeyError(f"no rollout for {scheduler_id}:{name}") from exc
+                raise RuntimeError(f"manager: HTTP {exc.code}") from exc
+
+        return retry_call(
+            once, retry_on=(ConnectionError, TimeoutError, OSError)
+        )

@@ -938,8 +938,11 @@ class ManagerGRPCServer:
             context.abort(grpc.StatusCode.NOT_FOUND, f"model {req.id}")
         try:
             blob = self.registry.load_artifact(m)
-        except (KeyError, OSError) as exc:
-            context.abort(grpc.StatusCode.NOT_FOUND, f"artifact missing: {exc}")
+        except (KeyError, OSError, ValueError) as exc:
+            # Missing blob OR a failed digest check (ArtifactDigestError):
+            # a clean NOT_FOUND — unverifiable bytes never leave the
+            # registry on this wire either.
+            context.abort(grpc.StatusCode.NOT_FOUND, f"artifact unavailable: {exc}")
         return pb.ArtifactReply(artifact=blob)
 
     # -- certificate issuance (pkg/issuer, security_server.go) --------------
@@ -1141,6 +1144,9 @@ class GRPCRemoteRegistry:
         )
 
     def load_artifact(self, model):
+        # WireModel carries no artifact_digest, so client-side digest
+        # verification rides the REST registry path (registry_client.py);
+        # the manager itself still verifies before serving either wire.
         reply = self._call("model_artifact", pb.ModelIdRequest(id=model.id))
         return bytes(reply.artifact)
 

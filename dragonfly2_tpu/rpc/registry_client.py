@@ -30,6 +30,7 @@ def _model_from_json(data: dict) -> Model:
         scheduler_id=data["scheduler_id"],
         state=ModelState(data["state"]),
         evaluation=data.get("evaluation") or {},
+        artifact_digest=data.get("artifact_digest", ""),  # pre-digest managers
     )
 
 
@@ -144,13 +145,35 @@ class RemoteRegistry:
         )
         return None if data is None else _model_from_json(data)
 
+    def candidate_model(self, scheduler_id: str, name: str) -> Optional[Model]:
+        data = self._get(
+            "/api/v1/models:candidate?"
+            + urllib.parse.urlencode({"scheduler_id": scheduler_id, "name": name})
+        )
+        return None if data is None else _model_from_json(data["model"])
+
     def load_artifact(self, model: Model) -> bytes:
         data = self._get(
             "/api/v1/models:artifact?" + urllib.parse.urlencode({"id": model.id})
         )
         if data is None:
             raise KeyError(f"artifact for {model.id} not found")
-        return base64.b64decode(data["artifact_b64"])
+        blob = base64.b64decode(data["artifact_b64"])
+        if model.artifact_digest:
+            # Same end-to-end verification as the local registry — the
+            # wire and the manager's blob store are both inside the
+            # tamper/corruption perimeter this digest closes.
+            import hashlib
+
+            from ..manager.registry import ArtifactDigestError
+
+            got = hashlib.sha256(blob).hexdigest()
+            if got != model.artifact_digest:
+                raise ArtifactDigestError(
+                    f"{model.id}: artifact sha256 {got[:12]}… != recorded "
+                    f"{model.artifact_digest[:12]}…"
+                )
+        return blob
 
     def list(
         self,

@@ -20,7 +20,7 @@ from .resource import (  # noqa: F401
     TaskManager,
 )
 from .announcer import Announcer  # noqa: F401
-from .evaluator import Evaluator, MLEvaluator, new_evaluator  # noqa: F401
+from .evaluator import CanaryRoute, Evaluator, MLEvaluator, new_evaluator  # noqa: F401
 from .featcache import HostFeatureCache  # noqa: F401
 from .microbatch import ScorerBatcher, ScorerUnavailable  # noqa: F401
 from .model_loader import ModelSubscriber  # noqa: F401

@@ -422,6 +422,29 @@ class EdgeScorer(Protocol):
         ...
 
 
+class CanaryRoute:
+    """Atomic canary routing state: one immutable object per (candidate
+    scorer, percent, version), swapped whole by ``MLEvaluator.set_canary``
+    — the same single-reference-read discipline as the scorer hot-swap,
+    so an announce can never see half a canary config.
+
+    Bucketing is deterministic per child host: ``crc32(host_id) % 100 <
+    percent`` — a child stays on one arm for the whole canary (outcome
+    attribution stays clean) and drills can predict the split."""
+
+    __slots__ = ("scorer", "percent", "version")
+
+    def __init__(self, scorer, percent: int, version: int) -> None:
+        self.scorer = scorer
+        self.percent = int(percent)
+        self.version = int(version)
+
+    def routes_to_candidate(self, host_id: str) -> bool:
+        import zlib
+
+        return (zlib.crc32(host_id.encode("utf-8")) % 100) < self.percent
+
+
 class MLEvaluator(Evaluator):
     """Learned evaluator: ranks parents with the trainer's exported scorer.
 
@@ -463,11 +486,37 @@ class MLEvaluator(Evaluator):
         self._batcher = batcher
         if batcher is not None:
             batcher.set_scorer(scorer)
+        # Rollout plane (DESIGN.md §15): both references are read ONCE
+        # per evaluate (atomic snapshot, like the scorer) and cost a
+        # None-check when no rollout is in flight.
+        self._shadow = None            # rollout.shadow.ShadowScorer
+        self._canary: Optional[CanaryRoute] = None
 
     def set_scorer(self, scorer: Optional[EdgeScorer]) -> None:
         self._scorer = scorer
         if self._batcher is not None:
             self._batcher.set_scorer(scorer)
+
+    # -- rollout plane (ModelSubscriber candidate poll) ----------------------
+
+    def set_shadow(self, shadow) -> None:
+        """Attach/detach the shadow comparison engine (None = off)."""
+        self._shadow = shadow
+
+    @property
+    def shadow(self):
+        return self._shadow
+
+    def set_canary(self, route: Optional[CanaryRoute]) -> None:
+        """Install/clear canary routing; the batcher gets the candidate
+        scorer so canaried announces keep coalescing (per-arm groups)."""
+        self._canary = route
+        if self._batcher is not None:
+            self._batcher.set_candidate(route.scorer if route else None)
+
+    @property
+    def canary(self) -> Optional[CanaryRoute]:
+        return self._canary
 
     @property
     def has_model(self) -> bool:
@@ -607,6 +656,15 @@ class MLEvaluator(Evaluator):
         if len(parents) == 1:
             return list(parents)
         t0 = time.perf_counter()
+        # Canary routing: one snapshot read; with no rollout in flight
+        # this is a None-compare and the path below is unchanged.
+        canary = self._canary
+        use_candidate = False
+        if canary is not None:
+            use_candidate = canary.routes_to_candidate(child.host.id)
+            metrics.CANARY_ANNOUNCES_TOTAL.inc(
+                arm="candidate" if use_candidate else "active"
+            )
         try:
             cache = self._feature_cache
             # Identity-only scorers (GNN embedding lookup) skip featurization —
@@ -628,13 +686,31 @@ class MLEvaluator(Evaluator):
             dst_buckets = np.broadcast_to(
                 np.int64(dst_bucket), (len(parents),)
             )
-            engine = self._batcher if self._batcher is not None else scorer
-            scores = np.asarray(
-                engine.score(feats, src_buckets=src_buckets, dst_buckets=dst_buckets)
-            )
+            if self._batcher is not None:
+                scores = np.asarray(
+                    self._batcher.score(
+                        feats,
+                        src_buckets=src_buckets,
+                        dst_buckets=dst_buckets,
+                        candidate=use_candidate,
+                    )
+                )
+            else:
+                engine = canary.scorer if use_candidate else scorer
+                scores = np.asarray(
+                    engine.score(
+                        feats, src_buckets=src_buckets, dst_buckets=dst_buckets
+                    )
+                )
         except Exception as exc:  # noqa: BLE001 — degrade to rules, never fail the announce
             logger.warning("ML scorer path failed (%s); ranking with rules", exc)
             return super().evaluate_parents(parents, child, total_piece_count)
+        # Shadow comparison rides the arrays this announce already built
+        # (zero extra featurization); only active-armed announces offer —
+        # the comparison needs the ACTIVE scores as its baseline.
+        shadow = self._shadow
+        if shadow is not None and not use_candidate:
+            shadow.offer(child.host.id, feats, src_buckets, dst_buckets, scores)
         order = np.argsort(-scores, kind="stable")
         metrics.EVAL_SECONDS.observe(
             time.perf_counter() - t0, algorithm=self.ALGORITHM

@@ -56,3 +56,18 @@ EVAL_BATCH_FALLBACK_TOTAL = _reg.counter(
     "scheduler_eval_batch_fallback_total",
     "Coalesced scorer batches degraded to per-request scoring",
 )
+
+# -- rollout plane (DESIGN.md §15: shadow scoring + canary serving) ----------
+SHADOW_ANNOUNCES_TOTAL = _reg.counter(
+    "scheduler_shadow_announces_total",
+    "Shadow-scoring outcomes per announce", ["result"],  # scored|sampled_out|dropped|error
+)
+CANARY_ANNOUNCES_TOTAL = _reg.counter(
+    "scheduler_canary_announces_total",
+    "Announces routed per canary arm", ["arm"],  # candidate|active
+)
+ROLLOUT_SERVING_STATE = _reg.gauge(
+    "scheduler_rollout_state",
+    "Local rollout serving state per model name: 0 active-only, "
+    "2 shadow, 3 canary (codes match manager rollout_state)", ["name"],
+)

@@ -29,6 +29,11 @@ Mechanics (DESIGN.md §14):
   (utils.faultinject, DF004 inventory).  A dropped/failed coalesced call
   degrades to per-request scoring; announces never stall on the batcher
   (chaos drill in tests/test_chaos.py).
+- **canary arms** — requests carry a ``candidate`` flag (DESIGN.md §15
+  canary serving); a flush splits by arm and scores each group with its
+  own scorer snapshot, so coalescing survives a canary without ever
+  mixing model versions inside one call.  A candidate uninstalled
+  mid-queue pins its requests to the active scorer.
 
 The scorer contract this relies on is row-independence: ``score`` must
 score each row from that row (+ its buckets) alone, so padded rows and
@@ -60,12 +65,15 @@ class ScorerUnavailable(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("features", "src", "dst", "done", "result", "error")
+    __slots__ = ("features", "src", "dst", "candidate", "done", "result", "error")
 
-    def __init__(self, features, src, dst) -> None:
+    def __init__(self, features, src, dst, candidate=False) -> None:
         self.features = features
         self.src = src
         self.dst = dst
+        # Canary arm (DESIGN.md §15): True routes this request to the
+        # flush's candidate-scorer snapshot instead of the active one.
+        self.candidate = candidate
         self.done = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
@@ -87,6 +95,9 @@ class ScorerBatcher:
         self._pending_rows = 0
         self._leader_active = False
         self._scorer = scorer
+        # Canary candidate scorer (None = no canary in flight); snapshotted
+        # per flush exactly like the active scorer.
+        self._candidate = None
         self.linger_s = linger_s
         self.max_batch_rows = max_batch_rows
         self.pad_buckets = tuple(sorted(pad_buckets))
@@ -102,6 +113,11 @@ class ScorerBatcher:
         with self._cv:
             self._scorer = scorer
 
+    def set_candidate(self, scorer) -> None:
+        """Install/clear the canary candidate scorer (MLEvaluator.set_canary)."""
+        with self._cv:
+            self._candidate = scorer
+
     @property
     def has_scorer(self) -> bool:
         return self._scorer is not None
@@ -112,9 +128,9 @@ class ScorerBatcher:
 
     # -- the EdgeScorer surface ----------------------------------------------
 
-    def score(self, features, *, src_buckets=None, dst_buckets=None):  # dflint: hotpath
+    def score(self, features, *, src_buckets=None, dst_buckets=None, candidate=False):  # dflint: hotpath
         features = np.asarray(features, dtype=np.float32)
-        req = _Request(features, src_buckets, dst_buckets)
+        req = _Request(features, src_buckets, dst_buckets, candidate)
         with self._cv:
             self._pending.append(req)
             self._pending_rows += features.shape[0]
@@ -147,10 +163,15 @@ class ScorerBatcher:
                 batch = self._pending
                 self._pending = []
                 self._pending_rows = 0
-                scorer = self._scorer  # ONE snapshot for the whole flush
+                # ONE snapshot of BOTH scorers for the whole flush; a
+                # canary uninstalled mid-queue pins its requests to the
+                # active scorer (never an error, never half-a-batch on
+                # each model version).
+                scorer = self._scorer
+                candidate = self._candidate if self._candidate is not None else scorer
             finally:
                 self._leader_active = False
-        self._dispatch(batch, scorer)
+        self._dispatch(batch, scorer, candidate)
 
     def _pad_size(self, rows: int) -> int:
         i = bisect.bisect_left(self.pad_buckets, rows)
@@ -159,7 +180,22 @@ class ScorerBatcher:
         top = self.pad_buckets[-1]
         return ((rows + top - 1) // top) * top
 
-    def _dispatch(self, batch: List[_Request], scorer) -> None:
+    def _dispatch(self, batch: List[_Request], scorer, candidate=None) -> None:
+        """Split the flush by canary arm (requests for different model
+        versions must not share a scorer call) and score each group
+        coalesced with its own scorer snapshot."""
+        cand_group = [r for r in batch if r.candidate]
+        if not cand_group:
+            self._dispatch_group(batch, scorer)
+            return
+        active_group = [r for r in batch if not r.candidate]
+        if active_group:
+            self._dispatch_group(active_group, scorer)
+        self._dispatch_group(
+            cand_group, candidate if candidate is not None else scorer
+        )
+
+    def _dispatch_group(self, batch: List[_Request], scorer) -> None:
         try:
             if scorer is None:
                 raise ScorerUnavailable("scorer deactivated while queued")

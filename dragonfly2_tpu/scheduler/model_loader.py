@@ -14,16 +14,38 @@ or mid-batch serves every in-flight ranking entirely from one model
 version (concurrency drill: tests/test_sched_vectorized.py
 refresh-under-load).  ``refresh`` itself is serialized by a lock so two
 overlapping polls cannot interleave version bookkeeping.
+
+Rollout plane (DESIGN.md §15), when a ``rollout_client`` is attached:
+
+- the same poll also fetches the CANDIDATE version (registry state
+  SHADOW/CANARY) and installs a ``ShadowScorer`` — and, in the canary
+  phase, a ``CanaryRoute`` — on the evaluator;
+- **digest refusal**: artifacts are verified against the sha256 the
+  registry recorded at create_model (``Registry.load_artifact`` /
+  ``RemoteRegistry.load_artifact``); a mismatch logs and KEEPS the
+  current scorer — a corrupted blob can demote serving quality, never
+  scheduling itself;
+- **pin on manager loss**: a failed poll drops canary routing and
+  shadow scoring and keeps serving the last ACTIVE scorer.  The pin is
+  sticky until a poll SUCCEEDS (no flapping while the manager is down);
+  a re-appearing candidate of the same version re-attaches the parked
+  shadow engine with its counters intact;
+- **poll jitter**: each wait is ``interval · (1 ± jitter)`` drawn from
+  an RNG seeded by (scheduler_id, model_name), so a fleet of schedulers
+  booted together never synchronizes into a registry thundering herd,
+  while any single scheduler's schedule stays reproducible.
 """
 
 from __future__ import annotations
 
 import logging
+import random
 import threading
 from typing import Optional
 
 from ..manager.registry import ModelRegistry
-from .evaluator import MLEvaluator
+from . import metrics
+from .evaluator import CanaryRoute, MLEvaluator
 
 logger = logging.getLogger(__name__)
 
@@ -37,23 +59,57 @@ class ModelSubscriber:
         scheduler_id: str,
         model_name: str = "parent-bandwidth-mlp",
         refresh_interval: float = 300.0,
+        jitter: float = 0.1,
+        rollout_client=None,
+        shadow_sample_rate: float = 0.1,
+        shadow_log_path: Optional[str] = None,
     ) -> None:
         self.registry = registry
         self.evaluator = evaluator
         self.scheduler_id = scheduler_id
         self.model_name = model_name
         self.refresh_interval = refresh_interval
+        self.jitter = max(0.0, float(jitter))
+        self.rollout_client = rollout_client
+        self.shadow_sample_rate = shadow_sample_rate
+        self.shadow_log_path = shadow_log_path
         self._loaded_version: Optional[int] = None
+        self._candidate_version: Optional[int] = None
+        self._candidate_scorer = None
+        self._shadow = None
+        self._pinned = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._refresh_mu = threading.Lock()
+        # Seeded per (scheduler, model): deterministic for THIS instance,
+        # decorrelated across a fleet (the anti-thundering-herd draw).
+        self._rng = random.Random(f"{scheduler_id}:{model_name}")
+
+    def _next_interval(self) -> float:
+        if not self.jitter:
+            return self.refresh_interval
+        return self.refresh_interval * (
+            1.0 + self._rng.uniform(-self.jitter, self.jitter)
+        )
 
     def refresh(self) -> bool:
-        """Pull the active version if it changed; returns True on swap.
-        Safe against concurrent callers (lock) and against RPC threads
-        mid-``score`` (the evaluator/batcher snapshot the scorer)."""
+        """Pull the active (and candidate) version if changed; returns
+        True on an active-scorer swap.  Safe against concurrent callers
+        (lock) and against RPC threads mid-``score`` (the evaluator/
+        batcher snapshot the scorer).  A failed poll PINS the evaluator
+        to the last ACTIVE version (canary + shadow detached) instead of
+        raising — scheduling never depends on manager liveness."""
         with self._refresh_mu:
-            return self._refresh_locked()
+            try:
+                changed = self._refresh_locked()
+            except Exception as exc:  # noqa: BLE001 — manager outage → pin
+                self._pin_locked(exc)
+                return False
+            try:
+                self._refresh_candidate_locked()
+            except Exception as exc:  # noqa: BLE001 — candidate poll is best-effort
+                self._pin_locked(exc)
+            return changed
 
     def _refresh_locked(self) -> bool:
         model = self.registry.active_model(self.scheduler_id, self.model_name)
@@ -68,14 +124,121 @@ class ModelSubscriber:
         from ..trainer.export import load_scorer
 
         try:
+            # load_artifact verifies the recorded sha256 (ArtifactDigestError
+            # on mismatch): a corrupted/swapped blob is REFUSED here and the
+            # current scorer keeps serving.
             scorer = load_scorer(self.registry.load_artifact(model))
         except Exception:  # noqa: BLE001 — a bad artifact must not break scheduling
-            logger.exception("loading model %s failed", model.id)
+            logger.exception("loading model %s failed; keeping current scorer", model.id)
             return False
         self.evaluator.set_scorer(scorer)
         self._loaded_version = model.version
         logger.info("ML evaluator now serving %s v%d", model.name, model.version)
         return True
+
+    # -- rollout candidate (shadow / canary) ---------------------------------
+
+    def _refresh_candidate_locked(self) -> None:
+        if self.rollout_client is None:
+            return
+        info = self.rollout_client.candidate(self.scheduler_id, self.model_name)
+        if self._pinned:
+            self._pinned = False
+            logger.info("manager poll recovered; rollout state unpinned")
+        if info is None:
+            self._drop_candidate_locked()
+            return
+        if info.model.version != self._candidate_version:
+            from ..rollout.shadow import ShadowScorer
+            from ..trainer.export import load_scorer
+
+            try:
+                scorer = load_scorer(self.registry.load_artifact(info.model))
+            except Exception:  # noqa: BLE001 — refuse the candidate, keep serving
+                logger.exception(
+                    "loading candidate %s failed; rollout state unchanged",
+                    info.model.id,
+                )
+                return
+            if self._shadow is not None:
+                self._shadow.close()
+            self._shadow = ShadowScorer(
+                scorer,
+                candidate_version=info.model.version,
+                active_version=self._loaded_version or 0,
+                sample_rate=self.shadow_sample_rate,
+                log_path=self.shadow_log_path,
+            )
+            self._candidate_scorer = scorer
+            self._candidate_version = info.model.version
+            logger.info(
+                "shadow scoring %s v%d against active v%s",
+                info.model.name, info.model.version, self._loaded_version,
+            )
+        elif self._shadow is not None:
+            # Same candidate; keep the engine but track active swaps.
+            self._shadow.active_version = self._loaded_version or 0
+        self.evaluator.set_shadow(self._shadow)
+        if info.phase == "canary" and info.canary_percent > 0:
+            canary = self.evaluator.canary
+            if (
+                canary is None
+                or canary.version != self._candidate_version
+                or canary.percent != info.canary_percent
+            ):
+                self.evaluator.set_canary(
+                    CanaryRoute(
+                        self._candidate_scorer,
+                        info.canary_percent,
+                        self._candidate_version,
+                    )
+                )
+                logger.info(
+                    "canary serving %s v%d at %d%%",
+                    self.model_name, self._candidate_version, info.canary_percent,
+                )
+            metrics.ROLLOUT_SERVING_STATE.set(3, name=self.model_name)
+        else:
+            self.evaluator.set_canary(None)
+            metrics.ROLLOUT_SERVING_STATE.set(2, name=self.model_name)
+
+    def _drop_candidate_locked(self) -> None:
+        """Candidate gone from the registry (promoted or rolled back):
+        detach + dispose the local rollout state."""
+        self.evaluator.set_canary(None)
+        self.evaluator.set_shadow(None)
+        if self._shadow is not None:
+            self._shadow.close()
+            self._shadow = None
+        self._candidate_scorer = None
+        self._candidate_version = None
+        metrics.ROLLOUT_SERVING_STATE.set(0, name=self.model_name)
+
+    def _pin_locked(self, exc: BaseException) -> None:
+        """Manager unreachable: pin serving to the last ACTIVE version.
+        Canary routing and shadow scoring DETACH (an unverified candidate
+        must not take traffic while its judge is absent) but the shadow
+        engine parks — a recovered poll for the same candidate version
+        re-attaches it with its counters and replay log intact."""
+        had_rollout = (
+            self.evaluator.canary is not None or self.evaluator.shadow is not None
+        )
+        self.evaluator.set_canary(None)
+        self.evaluator.set_shadow(None)
+        metrics.ROLLOUT_SERVING_STATE.set(0, name=self.model_name)
+        if not self._pinned:
+            self._pinned = True
+            if had_rollout:
+                logger.warning(
+                    "model poll failed (%s); pinned to last ACTIVE v%s — "
+                    "canary/shadow detached until the manager returns",
+                    exc, self._loaded_version,
+                )
+            else:
+                logger.warning(
+                    "model poll failed (%s); keeping scorer v%s",
+                    exc, self._loaded_version,
+                )
 
     def serve(self) -> None:
         if self._thread is not None:
@@ -83,7 +246,7 @@ class ModelSubscriber:
         self.refresh()
 
         def loop() -> None:
-            while not self._stop.wait(self.refresh_interval):
+            while not self._stop.wait(self._next_interval()):
                 try:
                     self.refresh()
                 except Exception:  # noqa: BLE001
@@ -94,3 +257,5 @@ class ModelSubscriber:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._shadow is not None:
+            self._shadow.close()

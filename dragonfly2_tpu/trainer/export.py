@@ -51,6 +51,13 @@ class MLPScorer:
     # serve time so the train/serve contract travels WITH the artifact —
     # callers never pre-mask.
     post_hoc_masked: bool = True
+    # Training-snapshot feature histograms (rollout/shadow.py drift PSI):
+    # per-feature quantile bin edges [D, B+1] and the expected bin mass
+    # [D, B] over the rows this model trained on.  Stamped INTO the blob
+    # so the drift baseline always matches the weights it ships with;
+    # None on artifacts exported without rows (drift gating then skips).
+    train_bin_edges: Optional[np.ndarray] = None
+    train_bin_fracs: Optional[np.ndarray] = None
     feature_names: Tuple[str, ...] = DOWNLOAD_FEATURE_NAMES
     model_type: str = "mlp"
     version: int = SCORER_SCHEMA_VERSION
@@ -125,19 +132,52 @@ def export_mlp_scorer(
     )
 
 
-def export_from_state(state, *, post_hoc_masked: bool = True) -> MLPScorer:
+DRIFT_BINS = 10
+
+
+def feature_snapshot_stats(
+    feature_rows: np.ndarray, n_bins: int = DRIFT_BINS
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(bin edges [D, n_bins+1], bin fractions [D, n_bins]) of the
+    training feature distribution — the drift baseline the rollout
+    plane's PSI check runs against (rollout/shadow.py).  Quantile edges
+    so every feature's expected mass is ~uniform regardless of scale;
+    constant features degenerate to one occupied bin, which PSI handles
+    (the serve side bins with the SAME edges)."""
+    rows = np.asarray(feature_rows, dtype=np.float64)
+    d = rows.shape[1]
+    qs = np.linspace(0.0, 1.0, n_bins + 1)
+    edges = np.quantile(rows, qs, axis=0).T  # [D, B+1]
+    fracs = np.empty((d, n_bins), dtype=np.float64)
+    for j in range(d):  # per-FEATURE (32 fixed), export time only
+        idx = np.searchsorted(edges[j, 1:-1], rows[:, j])
+        fracs[j] = np.bincount(idx, minlength=n_bins) / rows.shape[0]
+    return edges.astype(np.float32), fracs.astype(np.float32)
+
+
+def export_from_state(
+    state, *, post_hoc_masked: bool = True, train_feature_rows=None
+) -> MLPScorer:
     """TrainState (trainer/train.py) → scorer with its normalizer.
 
     ``post_hoc_masked`` must state how the training rows were prepared:
     True when they went through features.mask_post_hoc (the deployment
     pipeline, trainer/service.py), False for raw-row experiments.
+    ``train_feature_rows`` ([n, DOWNLOAD_FEATURE_DIM], already prepared
+    exactly as trained) stamps the drift-baseline histograms into the
+    artifact.
     """
-    return export_mlp_scorer(
+    scorer = export_mlp_scorer(
         state.params,
         feat_mean=state.feat_mean,
         feat_std=state.feat_std,
         post_hoc_masked=post_hoc_masked,
     )
+    if train_feature_rows is not None and len(train_feature_rows):
+        edges, fracs = feature_snapshot_stats(train_feature_rows)
+        scorer.train_bin_edges = edges
+        scorer.train_bin_fracs = fracs
+    return scorer
 
 
 def _pack(scorer: MLPScorer) -> Dict[str, np.ndarray]:
@@ -148,6 +188,9 @@ def _pack(scorer: MLPScorer) -> Dict[str, np.ndarray]:
     if scorer.feat_mean is not None:
         arrays["feat_mean"] = scorer.feat_mean
         arrays["feat_std"] = scorer.feat_std
+    if scorer.train_bin_edges is not None:
+        arrays["train_bin_edges"] = scorer.train_bin_edges
+        arrays["train_bin_fracs"] = scorer.train_bin_fracs
     meta = json.dumps(
         {
             "model_type": scorer.model_type,
@@ -192,11 +235,15 @@ def load_scorer(path_or_bytes):
         ]
         feat_mean = data["feat_mean"] if "feat_mean" in data else None
         feat_std = data["feat_std"] if "feat_std" in data else None
+        bin_edges = data["train_bin_edges"] if "train_bin_edges" in data else None
+        bin_fracs = data["train_bin_fracs"] if "train_bin_fracs" in data else None
     return MLPScorer(
         weights=weights,
         feat_mean=feat_mean,
         feat_std=feat_std,
         post_hoc_masked=meta.get("post_hoc_masked", True),
+        train_bin_edges=bin_edges,
+        train_bin_fracs=bin_fracs,
         feature_names=tuple(meta["feature_names"]),
         model_type=meta["model_type"],
         version=meta["version"],

@@ -345,7 +345,12 @@ class TrainerService:
             # model rather than registering untrained weights.
             logger.warning("run %s: MLP skipped: %s", run.key, exc)
             return
-        scorer = export_from_state(state)
+        # Stamp the drift baseline (rollout PSI gate) over the SAME
+        # prepared rows the model trained on.
+        scorer = export_from_state(
+            state,
+            train_feature_rows=train_rows[:, 2 : 2 + DOWNLOAD_FEATURE_DIM],
+        )
         model = self.registry.create_model(
             name=MLP_MODEL_NAME,
             type=TrainingModelType.MLP.value,

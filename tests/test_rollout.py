@@ -1,0 +1,1043 @@
+"""Model rollout & quality plane (ISSUE 4, DESIGN.md §15).
+
+Covers the new subsystem end to end:
+
+- ShadowScorer: deterministic sampling, zero-copy reuse of the serving
+  feature matrix, replay-log contents, bounded-queue drops, seq resume,
+  PSI drift against blob-stamped training snapshots;
+- replay evaluation: outcome join, regret@k, pairwise inversions (all
+  vs naive references);
+- canary serving: deterministic bucketing, per-arm batcher dispatch,
+  atomic pin-to-active when the candidate vanishes mid-queue;
+- ModelSubscriber satellites: seeded ±jitter poll spread, digest-refused
+  corrupted artifacts, candidate install/promote/drop, manager-loss pin;
+- RolloutController: guardrail holds/advances/rollbacks, post-promotion
+  auto-rollback to last-good, StateBackend persistence;
+- registry lifecycle durability: activation crash atomicity, dangling
+  active pointer on delete, artifact digest verification;
+- the two acceptance drills: injected-regression auto-rollback and
+  manager-kill-mid-canary pinning, both read out of rollout_state
+  metrics;
+- tools/bench_shadow.py --smoke JSON schema gate (tier-1).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+from dragonfly2_tpu.manager import ArtifactDigestError, ModelRegistry, ModelState
+from dragonfly2_tpu.manager.registry import BlobStore
+from dragonfly2_tpu.records.columnar import ColumnarWriter
+from dragonfly2_tpu.records.features import (
+    DOWNLOAD_COLUMNS,
+    DOWNLOAD_FEATURE_DIM,
+)
+from dragonfly2_tpu.rollout import (
+    LocalRolloutClient,
+    RolloutController,
+    RolloutGuardrails,
+    RolloutPhase,
+    RolloutReporter,
+    ShadowScorer,
+    evaluate_shadow,
+    join_outcomes,
+    pairwise_inversion_rate,
+    population_stability_index,
+    regret_at_k,
+)
+from dragonfly2_tpu.rollout import metrics as rollout_metrics
+from dragonfly2_tpu.rollout.shadow import SHADOW_COLUMNS, sampled
+from dragonfly2_tpu.scheduler import (
+    CanaryRoute,
+    HostFeatureCache,
+    MLEvaluator,
+    ModelSubscriber,
+    ScorerBatcher,
+)
+from dragonfly2_tpu.scheduler import metrics as sched_metrics
+from dragonfly2_tpu.sim.swarm import build_announce_swarm
+from dragonfly2_tpu.trainer.export import (
+    MLPScorer,
+    feature_snapshot_stats,
+    load_scorer,
+    scorer_to_bytes,
+)
+
+MODEL_NAME = "parent-bandwidth-mlp"
+
+_COL = {name: i for i, name in enumerate(SHADOW_COLUMNS)}
+
+
+def _mk_weights(seed, invert=False):
+    rng = np.random.default_rng(seed)
+    dims = (DOWNLOAD_FEATURE_DIM, 16, 1)
+    ws = [
+        (
+            rng.standard_normal((dims[i], dims[i + 1])).astype(np.float32) * 0.3,
+            rng.standard_normal(dims[i + 1]).astype(np.float32) * 0.05,
+        )
+        for i in range(len(dims) - 1)
+    ]
+    if invert:
+        ws[-1] = (-ws[-1][0], -ws[-1][1])
+    return ws
+
+
+def _mk_scorer(seed, invert=False, **kw):
+    return MLPScorer(weights=_mk_weights(seed, invert), **kw)
+
+
+class _ConstScorer:
+    """Scores row i as base + step*i — rankings are predictable."""
+
+    def __init__(self, base=0.0, step=1.0):
+        self.base, self.step = base, step
+        self.calls = 0
+
+    def score(self, features, **_buckets):
+        self.calls += 1
+        n = features.shape[0]
+        return self.base + self.step * np.arange(n, dtype=np.float64)
+
+
+def _drive_announces(ml, task, peers, count=30, parents=8, start=0):
+    for i in range(start, start + count):
+        child = peers[i % len(peers)]
+        cands = [peers[(i + j + 1) % len(peers)] for j in range(parents)]
+        ml.evaluate_parents(cands, child, task.total_piece_count)
+
+
+def _write_download_rows(path, src, dst, target_log_bw):
+    rows = np.zeros((len(src), len(DOWNLOAD_COLUMNS)), np.float32)
+    rows[:, 0] = src
+    rows[:, 1] = dst
+    rows[:, -1] = target_log_bw
+    with ColumnarWriter(path, DOWNLOAD_COLUMNS) as w:
+        w.append(rows)
+
+
+class _StorageStub:
+    """Just enough of records.storage.Storage for RolloutReporter."""
+
+    def __init__(self, paths):
+        self._paths = list(paths)
+
+    def download_columnar_paths(self):
+        return list(self._paths)
+
+
+# ---------------------------------------------------------------------------
+# ShadowScorer
+# ---------------------------------------------------------------------------
+
+
+class TestShadowScorer:
+    def test_sampling_is_deterministic_and_respects_rate(self):
+        picks = [sampled("child-7", seq, 0.1) for seq in range(5000)]
+        assert picks == [sampled("child-7", seq, 0.1) for seq in range(5000)]
+        frac = sum(picks) / len(picks)
+        assert 0.07 < frac < 0.13
+        assert not any(sampled("c", s, 0.0) for s in range(100))
+        assert all(sampled("c", s, 1.0) for s in range(100))
+
+    def test_candidate_scores_the_exact_serving_matrix(self):
+        seen = []
+
+        class Recorder:
+            def score(self, features, **_b):
+                seen.append(features)
+                return np.zeros(features.shape[0])
+
+        sh = ShadowScorer(Recorder(), candidate_version=2, sample_rate=1.0)
+        feats = np.random.default_rng(0).standard_normal((6, 4)).astype(np.float32)
+        assert sh.offer("c", feats, np.arange(6), np.zeros(6, np.int64),
+                        np.arange(6, dtype=float))
+        assert sh.drain()
+        sh.close()
+        # Zero extra featurization: the worker scored the VERY array the
+        # announce path built, not a copy.
+        assert len(seen) == 1 and seen[0] is feats
+
+    def test_replay_log_rows_and_ranks(self):
+        sh = ShadowScorer(
+            _ConstScorer(step=1.0),  # candidate prefers HIGH index
+            candidate_version=3, active_version=1, sample_rate=1.0,
+        )
+        active_scores = np.array([5.0, 1.0, 3.0])  # active rank: 0,2,1
+        sh.offer("c", np.zeros((3, 2), np.float32), np.array([11, 12, 13]),
+                 np.array([7, 7, 7]), active_scores)
+        sh.drain()
+        sh.close()
+        rows = sh.replay_rows()
+        assert rows.shape == (3, len(SHADOW_COLUMNS))
+        assert rows[0, _COL["candidate_version"]] == 3.0
+        assert rows[0, _COL["active_version"]] == 1.0
+        assert list(rows[:, _COL["src_bucket"]]) == [11.0, 12.0, 13.0]
+        assert list(rows[:, _COL["active_rank"]]) == [0.0, 2.0, 1.0]
+        # candidate = ascending scores → best is the LAST row.
+        assert list(rows[:, _COL["candidate_rank"]]) == [2.0, 1.0, 0.0]
+
+    def test_batched_drain_matches_per_sample_ranks(self):
+        # A long worker linger forces ONE drain over many announces: the
+        # vectorized lexsort rank path must agree with per-sample
+        # stable argsort ranks.
+        rng = np.random.default_rng(3)
+        cand = _mk_scorer(4)
+        sh = ShadowScorer(cand, candidate_version=2, sample_rate=1.0,
+                          batch_linger_s=0.25)
+        per_announce = []
+        for a in range(6):
+            n = 4 + a  # varying group sizes
+            feats = rng.standard_normal((n, DOWNLOAD_FEATURE_DIM)).astype(np.float32)
+            active = rng.standard_normal(n)
+            sh.offer(f"c{a}", feats, np.arange(n, dtype=np.int64) + 100 * a,
+                     np.full(n, a, np.int64), active)
+            per_announce.append((feats, active))
+        sh.drain(timeout=10.0)
+        rows = sh.replay_rows()
+        sh.close()
+        assert rows.shape[0] == sum(4 + a for a in range(6))
+        for a, (feats, active) in enumerate(per_announce):
+            grp = rows[rows[:, _COL["dst_bucket"]] == a]
+            cand_scores = cand.score(feats)
+            n = len(active)
+            exp_a = np.empty(n, np.int64)
+            exp_a[np.argsort(-active, kind="stable")] = np.arange(n)
+            exp_c = np.empty(n, np.int64)
+            exp_c[np.argsort(-cand_scores, kind="stable")] = np.arange(n)
+            assert list(grp[:, _COL["active_rank"]]) == list(exp_a.astype(float))
+            assert list(grp[:, _COL["candidate_rank"]]) == list(exp_c.astype(float))
+            assert np.allclose(grp[:, _COL["candidate_score"]], cand_scores,
+                               rtol=1e-5)
+
+    def test_bounded_queue_drops_instead_of_blocking(self):
+        release = threading.Event()
+
+        class Slow:
+            def score(self, features, **_b):
+                release.wait(5.0)
+                return np.zeros(features.shape[0])
+
+        sh = ShadowScorer(Slow(), candidate_version=2, sample_rate=1.0,
+                          max_queue=1)
+        feats = np.zeros((2, 2), np.float32)
+        args = (np.zeros(2, np.int64), np.zeros(2, np.int64), np.zeros(2))
+        for _ in range(6):
+            sh.offer("c", feats, *args)
+        release.set()
+        sh.drain()
+        stats = sh.stats()
+        sh.close()
+        assert stats["dropped"] > 0
+        assert stats["offered"] == 6
+        assert stats["scored_announces"] + stats["dropped"] == 6
+
+    def test_seq_resumes_past_existing_log(self, tmp_path):
+        log = str(tmp_path / "shadow.dfc")
+        sh = ShadowScorer(_ConstScorer(), candidate_version=2,
+                          sample_rate=1.0, log_path=log)
+        sh.offer("c", np.zeros((2, 2), np.float32), np.zeros(2, np.int64),
+                 np.zeros(2, np.int64), np.zeros(2))
+        sh.drain()
+        sh.close()
+        sh2 = ShadowScorer(_ConstScorer(), candidate_version=2,
+                           sample_rate=1.0, log_path=log)
+        assert sh2.offered == 1  # continues past logged announce_seq 0
+        sh2.offer("c", np.zeros((2, 2), np.float32), np.zeros(2, np.int64),
+                  np.zeros(2, np.int64), np.zeros(2))
+        sh2.drain()
+        sh2.close()
+        rows = sh2.replay_rows()
+        assert set(rows[:, _COL["announce_seq"]]) == {0.0, 1.0}
+
+    def test_psi_flags_shifted_serving_distribution(self):
+        rng = np.random.default_rng(0)
+        train = rng.standard_normal((4000, 5)).astype(np.float32)
+        edges, fracs = feature_snapshot_stats(train)
+        cand = _ConstScorer()
+        cand.train_bin_edges, cand.train_bin_fracs = edges, fracs
+        cand.post_hoc_masked = False
+
+        def feed(sh, rows):
+            sh.offer("c", rows, np.zeros(len(rows), np.int64),
+                     np.zeros(len(rows), np.int64), np.zeros(len(rows)))
+            sh.drain()
+
+        same = ShadowScorer(cand, candidate_version=2, sample_rate=1.0)
+        feed(same, rng.standard_normal((2000, 5)).astype(np.float32))
+        psi_same = same.psi()
+        same.close()
+        assert psi_same is not None and psi_same.max() < 0.05
+
+        shifted = ShadowScorer(cand, candidate_version=2, sample_rate=1.0)
+        feed(shifted, (rng.standard_normal((2000, 5)) + 2.0).astype(np.float32))
+        psi_shift = shifted.psi()
+        shifted.close()
+        assert psi_shift.max() > 1.0
+
+    def test_psi_none_without_snapshot(self):
+        sh = ShadowScorer(_ConstScorer(), candidate_version=2, sample_rate=1.0)
+        assert sh.psi() is None
+        assert sh.stats()["psi_max"] is None
+        sh.close()
+
+
+# ---------------------------------------------------------------------------
+# Replay evaluation
+# ---------------------------------------------------------------------------
+
+
+def _shadow_rows(per_announce, announces, cand_rank_fn, active_rank_fn,
+                 version=2):
+    """Synthesize a replay log: one group per announce."""
+    rows = []
+    for a in range(announces):
+        n = per_announce
+        r = np.zeros((n, len(SHADOW_COLUMNS)), np.float32)
+        r[:, _COL["announce_seq"]] = a
+        r[:, _COL["candidate_version"]] = version
+        r[:, _COL["src_bucket"]] = np.arange(n) + a * n
+        r[:, _COL["dst_bucket"]] = 99_000 + a
+        r[:, _COL["active_rank"]] = active_rank_fn(n)
+        r[:, _COL["candidate_rank"]] = cand_rank_fn(n)
+        rows.append(r)
+    return np.concatenate(rows, axis=0)
+
+
+class TestReplayEvaluation:
+    def test_join_outcomes_matches_and_averages(self, tmp_path):
+        sh = np.zeros((3, len(SHADOW_COLUMNS)), np.float32)
+        sh[:, _COL["src_bucket"]] = [1, 2, 3]
+        sh[:, _COL["dst_bucket"]] = [9, 9, 9]
+        dl = np.zeros((3, len(DOWNLOAD_COLUMNS)), np.float32)
+        dl[:, 0] = [1, 1, 2]   # src
+        dl[:, 1] = [9, 9, 9]   # dst
+        dl[:, -1] = [10.0, 20.0, 7.0]
+        realized = join_outcomes(sh, dl)
+        assert realized[0] == pytest.approx(15.0)  # duplicate pair averaged
+        assert realized[1] == pytest.approx(7.0)
+        assert np.isnan(realized[2])               # no record for (3, 9)
+
+    def test_regret_perfect_vs_inverted(self):
+        n, announces, k = 8, 10, 4
+        rows = _shadow_rows(
+            n, announces,
+            cand_rank_fn=lambda n: np.arange(n)[::-1],  # candidate inverted
+            active_rank_fn=lambda n: np.arange(n),      # active = ideal
+        )
+        # Realized bandwidth decreasing with index → active rank order is
+        # exactly the realized order.
+        realized = np.log1p(
+            np.tile(np.linspace(100.0, 10.0, n), announces)
+        )
+        out = regret_at_k(rows, realized, k=k)
+        assert out["announces"] == announces
+        assert out["active"] == pytest.approx(0.0, abs=1e-9)
+        bw = np.linspace(100.0, 10.0, n)
+        expected = 1.0 - bw[-k:].mean() / bw[:k].mean()
+        assert out["candidate"] == pytest.approx(expected, rel=1e-6)
+
+    def test_regret_ignores_unjoined_and_tiny_groups(self):
+        rows = _shadow_rows(2, 3, lambda n: np.arange(n), lambda n: np.arange(n))
+        realized = np.full(rows.shape[0], np.nan)
+        realized[0] = 5.0  # one joined edge → group too small to score
+        out = regret_at_k(rows, realized, k=2)
+        assert out["announces"] == 0
+
+    def test_inversion_rate_hand_example(self):
+        rows = _shadow_rows(
+            3, 1,
+            cand_rank_fn=lambda n: np.array([2, 1, 0]),  # prefers worst
+            active_rank_fn=lambda n: np.array([0, 1, 2]),
+        )
+        realized = np.log1p(np.array([30.0, 20.0, 10.0]))
+        out = pairwise_inversion_rate(rows, realized)
+        assert out["pairs"] == 3
+        assert out["active"] == 0.0
+        assert out["candidate"] == 1.0
+
+    def test_psi_formula_sanity(self):
+        expected = np.array([[0.25, 0.25, 0.25, 0.25]])
+        same = population_stability_index(expected, np.array([[25, 25, 25, 25]]))
+        skew = population_stability_index(expected, np.array([[97, 1, 1, 1]]))
+        assert same[0] == pytest.approx(0.0, abs=1e-9)
+        assert skew[0] > 1.0
+
+    def test_evaluate_shadow_report_shape(self):
+        rows = _shadow_rows(4, 5, lambda n: np.arange(n), lambda n: np.arange(n))
+        dl = np.zeros((rows.shape[0], len(DOWNLOAD_COLUMNS)), np.float32)
+        dl[:, 0] = rows[:, _COL["src_bucket"]]
+        dl[:, 1] = rows[:, _COL["dst_bucket"]]
+        dl[:, -1] = 5.0
+        report = evaluate_shadow(rows, dl, k=2, psi_max=0.03)
+        assert report["joined_edges"] == rows.shape[0]
+        assert report["announces"] == 5
+        assert report["psi_max"] == 0.03
+        assert report["regret_at_k"]["k"] == 2
+        assert report["candidate_version"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Canary serving
+# ---------------------------------------------------------------------------
+
+
+class TestCanaryServing:
+    def test_bucketing_deterministic_and_proportional(self):
+        route = CanaryRoute(_ConstScorer(), percent=10, version=2)
+        ids = [f"host-{i}" for i in range(4000)]
+        picks = [route.routes_to_candidate(h) for h in ids]
+        assert picks == [route.routes_to_candidate(h) for h in ids]
+        frac = sum(picks) / len(picks)
+        assert 0.07 < frac < 0.13
+        assert not any(
+            CanaryRoute(None, 0, 2).routes_to_candidate(h) for h in ids[:200]
+        )
+
+    def test_evaluator_routes_arms_and_counts(self):
+        task, peers = build_announce_swarm(40, seed=5)
+        active = _ConstScorer(step=1.0)      # prefers LAST candidate
+        candidate = _ConstScorer(step=-1.0)  # prefers FIRST candidate
+        ml = MLEvaluator(active)
+        ml.set_canary(CanaryRoute(candidate, percent=50, version=2))
+        before = {
+            arm: sched_metrics.CANARY_ANNOUNCES_TOTAL.value(arm=arm)
+            for arm in ("candidate", "active")
+        }
+        routed = unrouted = 0
+        for i in range(20):
+            child, cands = peers[i], [peers[(i + j + 1) % 40] for j in range(5)]
+            ranked = ml.evaluate_parents(cands, child, task.total_piece_count)
+            if ml.canary.routes_to_candidate(child.host.id):
+                routed += 1
+                assert [p.id for p in ranked] == [p.id for p in cands]
+            else:
+                unrouted += 1
+                assert [p.id for p in ranked] == [p.id for p in cands[::-1]]
+        assert routed and unrouted  # both arms exercised
+        assert (
+            sched_metrics.CANARY_ANNOUNCES_TOTAL.value(arm="candidate")
+            - before["candidate"]
+        ) == routed
+        assert (
+            sched_metrics.CANARY_ANNOUNCES_TOTAL.value(arm="active")
+            - before["active"]
+        ) == unrouted
+
+    def test_batcher_splits_arms_one_flush(self):
+        active = _ConstScorer(step=1.0)
+        candidate = _ConstScorer(step=-1.0)
+        b = ScorerBatcher(active, linger_s=0.05)
+        b.set_candidate(candidate)
+        results = {}
+
+        def call(arm, flag):
+            feats = np.zeros((4, 3), np.float32)
+            results[arm] = np.asarray(b.score(feats, candidate=flag))
+
+        threads = [
+            threading.Thread(target=call, args=("active", False), daemon=True),
+            threading.Thread(target=call, args=("candidate", True), daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert list(np.argsort(-results["active"])) == [3, 2, 1, 0]
+        assert list(np.argsort(-results["candidate"])) == [0, 1, 2, 3]
+        # Each arm's scorer was called exactly once: per-arm coalescing,
+        # never a mixed-version call.
+        assert active.calls == 1 and candidate.calls == 1
+
+    def test_batcher_pins_candidate_requests_when_candidate_gone(self):
+        active = _ConstScorer(step=1.0)
+        b = ScorerBatcher(active, linger_s=0.0)
+        # No candidate installed but a canary-tagged request arrives (the
+        # canary was uninstalled mid-flight): pin to active, no error.
+        scores = np.asarray(b.score(np.zeros((3, 2), np.float32), candidate=True))
+        assert list(np.argsort(-scores)) == [2, 1, 0]
+        assert active.calls == 1
+
+
+# ---------------------------------------------------------------------------
+# ModelSubscriber satellites: jitter, digest refusal, pinning
+# ---------------------------------------------------------------------------
+
+
+class TestSubscriberJitter:
+    def test_intervals_spread_within_bounds_and_across_instances(self):
+        ml = MLEvaluator(None)
+        a = ModelSubscriber(ModelRegistry(), ml, scheduler_id="sched-a",
+                            refresh_interval=300.0, jitter=0.1)
+        b = ModelSubscriber(ModelRegistry(), ml, scheduler_id="sched-b",
+                            refresh_interval=300.0, jitter=0.1)
+        seq_a = [a._next_interval() for _ in range(64)]
+        seq_b = [b._next_interval() for _ in range(64)]
+        for v in seq_a + seq_b:
+            assert 270.0 <= v <= 330.0  # ±10 %
+        # Decorrelated across the fleet and non-constant per instance —
+        # the herd actually spreads.
+        assert seq_a != seq_b
+        assert len(set(round(v, 6) for v in seq_a)) > 32
+        # Reproducible for one identity (seeded RNG).
+        a2 = ModelSubscriber(ModelRegistry(), ml, scheduler_id="sched-a",
+                             refresh_interval=300.0, jitter=0.1)
+        assert [a2._next_interval() for _ in range(64)] == seq_a
+
+    def test_zero_jitter_keeps_fixed_cadence(self):
+        sub = ModelSubscriber(ModelRegistry(), MLEvaluator(None),
+                              scheduler_id="s", jitter=0.0)
+        assert sub._next_interval() == sub.refresh_interval
+
+
+class TestArtifactDigest:
+    def _registry_with_model(self, tmp_path):
+        blobs = BlobStore(str(tmp_path / "blobs"))
+        reg = ModelRegistry(blobs)
+        m = reg.create_model(name=MODEL_NAME, type="mlp", scheduler_id="s1",
+                             artifact=scorer_to_bytes(_mk_scorer(1)))
+        return reg, blobs, m
+
+    def test_digest_recorded_and_verified(self, tmp_path):
+        reg, blobs, m = self._registry_with_model(tmp_path)
+        assert len(m.artifact_digest) == 64
+        assert load_scorer(reg.load_artifact(m)) is not None
+        blobs.put(m.blob_key, b"corrupted bytes")
+        with pytest.raises(ArtifactDigestError):
+            reg.load_artifact(m)
+
+    def test_subscriber_refuses_corrupted_blob_keeps_current(self, tmp_path):
+        reg, blobs, m1 = self._registry_with_model(tmp_path)
+        reg.activate(m1.id)
+        ml = MLEvaluator(None)
+        sub = ModelSubscriber(reg, ml, scheduler_id="s1")
+        assert sub.refresh() is True
+        serving = ml._scorer
+        assert serving is not None
+        # v2 lands corrupted: the swap must be REFUSED and v1 kept.
+        m2 = reg.create_model(name=MODEL_NAME, type="mlp", scheduler_id="s1",
+                              artifact=scorer_to_bytes(_mk_scorer(2)))
+        blobs.put(m2.blob_key, b"\x00" * 64)
+        reg.activate(m2.id)
+        assert sub.refresh() is False
+        assert ml._scorer is serving
+        assert sub._loaded_version == m1.version
+
+    def test_legacy_rows_without_digest_still_load(self, tmp_path):
+        reg, blobs, m = self._registry_with_model(tmp_path)
+        m.artifact_digest = ""  # a pre-digest row
+        blobs.put(m.blob_key, b"whatever")  # cannot be verified
+        assert reg.load_artifact(m) == b"whatever"
+
+
+# ---------------------------------------------------------------------------
+# Registry lifecycle durability (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestRegistryDurability:
+    def test_activate_crash_between_writes_never_splits_active(self, tmp_path):
+        from dragonfly2_tpu.utils import faultinject
+        from dragonfly2_tpu.utils.faultinject import FaultInjector, FaultSpec
+
+        db = str(tmp_path / "m.db")
+        blobs = str(tmp_path / "blobs")
+        reg = ModelRegistry(BlobStore(blobs), db_path=db)
+        m1 = reg.create_model(name="m", type="mlp", scheduler_id="s", artifact=b"1")
+        m2 = reg.create_model(name="m", type="mlp", scheduler_id="s", artifact=b"2")
+        reg.activate(m1.id)
+        # The very next models write dies (the crash-between-rows probe:
+        # put_many is one transaction, so the flip either fully lands or
+        # never does — there is no "between" to tear).
+        inj = FaultInjector([FaultSpec(site="state.put.models", kind="drop",
+                                       at=(0,))])
+        with faultinject.installed(inj):
+            with pytest.raises(ConnectionError):
+                reg.activate(m2.id)
+        # Reload from the backend, as a restarted manager would.
+        reg2 = ModelRegistry(BlobStore(blobs), db_path=db)
+        active = [m for m in reg2.list(scheduler_id="s", name="m")
+                  if m.state is ModelState.ACTIVE]
+        assert [m.id for m in active] == [m1.id]
+
+    def test_delete_active_leaves_no_dangling_pointer(self, tmp_path):
+        db = str(tmp_path / "m.db")
+        blobs = str(tmp_path / "blobs")
+        reg = ModelRegistry(BlobStore(blobs), db_path=db)
+        m1 = reg.create_model(name="m", type="mlp", scheduler_id="s", artifact=b"1")
+        m2 = reg.create_model(name="m", type="mlp", scheduler_id="s", artifact=b"2")
+        reg.activate(m2.id)
+        reg.delete(m2.id)
+        reg3 = ModelRegistry(BlobStore(blobs), db_path=db)
+        assert reg3.active_model("s", "m") is None
+        assert [m.id for m in reg3.list(scheduler_id="s", name="m")] == [m1.id]
+        # The survivor can be activated cleanly after the reload.
+        reg3.activate(m1.id)
+        assert reg3.active_model("s", "m").id == m1.id
+
+    def test_candidate_states_exclusive_and_persisted(self, tmp_path):
+        db = str(tmp_path / "m.db")
+        blobs = str(tmp_path / "blobs")
+        reg = ModelRegistry(BlobStore(blobs), db_path=db)
+        m1 = reg.create_model(name="m", type="mlp", scheduler_id="s", artifact=b"1")
+        m2 = reg.create_model(name="m", type="mlp", scheduler_id="s", artifact=b"2")
+        reg.set_state(m1.id, ModelState.SHADOW)
+        reg.set_state(m2.id, ModelState.CANARY)  # demotes m1
+        reg2 = ModelRegistry(BlobStore(blobs), db_path=db)
+        assert reg2.get(m1.id).state is ModelState.INACTIVE
+        assert reg2.get(m2.id).state is ModelState.CANARY
+        assert reg2.candidate_model("s", "m").id == m2.id
+
+
+# ---------------------------------------------------------------------------
+# Rollout controller
+# ---------------------------------------------------------------------------
+
+
+def _registry_v1_active_v2(reg=None, invert_v2=True, sched="s1", v2_seed=2):
+    reg = reg or ModelRegistry()
+    m1 = reg.create_model(name=MODEL_NAME, type="mlp", scheduler_id=sched,
+                          artifact=scorer_to_bytes(_mk_scorer(1)))
+    reg.activate(m1.id)
+    m2 = reg.create_model(
+        name=MODEL_NAME, type="mlp", scheduler_id=sched,
+        artifact=scorer_to_bytes(_mk_scorer(v2_seed, invert=invert_v2)),
+    )
+    return reg, m1, m2
+
+
+def _report(joined=500, cand_regret=0.1, active_regret=0.1,
+            cand_inv=0.2, active_inv=0.2, psi=0.01):
+    return {
+        "joined_edges": joined,
+        "announces": joined // 4,
+        "regret_at_k": {"k": 4, "candidate": cand_regret, "active": active_regret},
+        "inversion_rate": {"pairs": joined, "candidate": cand_inv,
+                           "active": active_inv},
+        "psi_max": psi,
+    }
+
+
+class TestRolloutController:
+    def test_begin_flips_to_shadow_and_records_last_good(self):
+        reg, m1, m2 = _registry_v1_active_v2()
+        ctrl = RolloutController(reg)
+        r = ctrl.begin(m2.id)
+        assert reg.get(m2.id).state is ModelState.SHADOW
+        assert r.previous_active_id == m1.id
+        assert r.phase == RolloutPhase.SHADOW.value
+        with pytest.raises(ValueError):
+            ctrl.begin(m1.id)  # already active
+
+    def test_hold_below_sample_floor(self):
+        reg, m1, m2 = _registry_v1_active_v2()
+        ctrl = RolloutController(reg, guardrails=RolloutGuardrails(
+            min_shadow_samples=100))
+        ctrl.begin(m2.id)
+        out = ctrl.report("s1", MODEL_NAME, _report(joined=10))
+        assert out["decision"] == "hold"
+        assert reg.get(m2.id).state is ModelState.SHADOW
+
+    def test_clean_reports_walk_shadow_canary_active(self):
+        reg, m1, m2 = _registry_v1_active_v2()
+        ctrl = RolloutController(reg, guardrails=RolloutGuardrails(
+            min_shadow_samples=50, min_canary_samples=50, canary_percent=25))
+        ctrl.begin(m2.id)
+        out = ctrl.report("s1", MODEL_NAME, _report(joined=60))
+        assert out["decision"] == "advance" and out["canary_percent"] == 25
+        assert reg.get(m2.id).state is ModelState.CANARY
+        # Canary needs NEW samples past the phase baseline.
+        out = ctrl.report("s1", MODEL_NAME, _report(joined=80))
+        assert out["decision"] == "hold"
+        out = ctrl.report("s1", MODEL_NAME, _report(joined=130))
+        assert out["decision"] == "promote"
+        assert reg.get(m2.id).state is ModelState.ACTIVE
+        assert reg.get(m1.id).state is ModelState.INACTIVE
+        assert ctrl.get("s1", MODEL_NAME).phase == RolloutPhase.ACTIVE.value
+
+    def test_regret_breach_rolls_back_candidate(self):
+        reg, m1, m2 = _registry_v1_active_v2()
+        ctrl = RolloutController(reg, guardrails=RolloutGuardrails(
+            min_shadow_samples=50))
+        ctrl.begin(m2.id)
+        out = ctrl.report("s1", MODEL_NAME,
+                          _report(joined=100, cand_regret=0.5, active_regret=0.1))
+        assert out["decision"] == "rollback"
+        assert "regret" in out["reason"]
+        assert reg.get(m2.id).state is ModelState.INACTIVE
+        assert reg.active_model("s1", MODEL_NAME).id == m1.id
+        assert rollout_metrics.ROLLOUT_STATE.value(
+            scheduler_id="s1", name=MODEL_NAME) == 5.0
+        # Further reports answer rolled_back without judging again.
+        out = ctrl.report("s1", MODEL_NAME, _report(joined=200))
+        assert out["decision"] == "rolled_back"
+
+    def test_psi_breach_rolls_back(self):
+        reg, m1, m2 = _registry_v1_active_v2()
+        ctrl = RolloutController(reg, guardrails=RolloutGuardrails(
+            min_shadow_samples=10, max_psi=0.25))
+        ctrl.begin(m2.id)
+        out = ctrl.report("s1", MODEL_NAME, _report(joined=50, psi=0.9))
+        assert out["decision"] == "rollback" and "drift" in out["reason"]
+
+    def test_post_promotion_regression_reactivates_last_good(self):
+        reg, m1, m2 = _registry_v1_active_v2()
+        ctrl = RolloutController(reg, guardrails=RolloutGuardrails(
+            min_shadow_samples=10, min_canary_samples=10))
+        ctrl.begin(m2.id)
+        ctrl.report("s1", MODEL_NAME, _report(joined=20))
+        ctrl.report("s1", MODEL_NAME, _report(joined=40))
+        assert reg.active_model("s1", MODEL_NAME).id == m2.id
+        out = ctrl.report("s1", MODEL_NAME,
+                          _report(joined=60, cand_regret=0.9, active_regret=0.1))
+        assert out["decision"] == "rollback"
+        assert reg.active_model("s1", MODEL_NAME).id == m1.id
+        assert reg.get(m2.id).state is ModelState.INACTIVE
+
+    def test_rollouts_persist_across_controller_restart(self, tmp_path):
+        from dragonfly2_tpu.manager.state import SQLiteBackend
+
+        backend = SQLiteBackend(str(tmp_path / "state.db"))
+        reg, m1, m2 = _registry_v1_active_v2()
+        ctrl = RolloutController(reg, backend=backend,
+                                 guardrails=RolloutGuardrails(min_shadow_samples=10))
+        ctrl.begin(m2.id)
+        ctrl.report("s1", MODEL_NAME, _report(joined=20))
+        ctrl2 = RolloutController(reg, backend=backend,
+                                  guardrails=RolloutGuardrails(min_canary_samples=10))
+        r = ctrl2.get("s1", MODEL_NAME)
+        assert r is not None and r.phase == RolloutPhase.CANARY.value
+        assert r.previous_active_id == m1.id
+        out = ctrl2.report("s1", MODEL_NAME, _report(joined=40))
+        assert out["decision"] == "promote"
+
+
+# ---------------------------------------------------------------------------
+# Subscriber ↔ rollout integration + reporter
+# ---------------------------------------------------------------------------
+
+
+def _serving_stack(reg, ctrl, shadow_rate=1.0, linger=0.0):
+    ml = MLEvaluator(
+        None,
+        feature_cache=HostFeatureCache(max_hosts=1024),
+        batcher=ScorerBatcher(linger_s=linger),
+    )
+    sub = ModelSubscriber(
+        reg, ml, scheduler_id="s1",
+        rollout_client=LocalRolloutClient(ctrl),
+        shadow_sample_rate=shadow_rate,
+    )
+    return ml, sub
+
+
+class TestSubscriberRolloutIntegration:
+    def test_candidate_installs_shadow_then_canary_then_promotes(self):
+        reg, m1, m2 = _registry_v1_active_v2(invert_v2=False)
+        ctrl = RolloutController(reg, guardrails=RolloutGuardrails(
+            min_shadow_samples=1, min_canary_samples=1, canary_percent=30))
+        ml, sub = _serving_stack(reg, ctrl)
+        sub.refresh()
+        assert ml.shadow is None  # no rollout yet
+        ctrl.begin(m2.id)
+        sub.refresh()
+        assert ml.shadow is not None and ml.canary is None
+        assert sched_metrics.ROLLOUT_SERVING_STATE.value(name=MODEL_NAME) == 2.0
+        ctrl.report("s1", MODEL_NAME, _report(joined=5))
+        sub.refresh()
+        assert ml.canary is not None and ml.canary.percent == 30
+        assert sched_metrics.ROLLOUT_SERVING_STATE.value(name=MODEL_NAME) == 3.0
+        ctrl.report("s1", MODEL_NAME, _report(joined=10))
+        sub.refresh()
+        # Promoted: candidate became the active scorer, rollout state clear.
+        assert ml.canary is None and ml.shadow is None
+        assert sub._loaded_version == m2.version
+        assert sched_metrics.ROLLOUT_SERVING_STATE.value(name=MODEL_NAME) == 0.0
+        sub.stop()
+
+    def test_reporter_cycle_reports_and_applies(self, tmp_path):
+        # v2 = same weights as v1 (a clean retrain): rankings agree, so
+        # outcome-joined quality cannot show a regression.
+        reg, m1, m2 = _registry_v1_active_v2(invert_v2=False, v2_seed=1)
+        ctrl = RolloutController(reg, guardrails=RolloutGuardrails(
+            min_shadow_samples=1, min_canary_samples=10**9))
+        ctrl.begin(m2.id)
+        ml, sub = _serving_stack(reg, ctrl)
+        sub.refresh()
+        task, peers = build_announce_swarm(40, seed=7)
+        _drive_announces(ml, task, peers, count=25, parents=6)
+        ml.shadow.drain()
+        rows = ml.shadow.replay_rows()
+        dl_path = str(tmp_path / "download.dfc")
+        # Outcomes that agree with the ACTIVE scores → no regression.
+        _write_download_rows(
+            dl_path, rows[:, _COL["src_bucket"]], rows[:, _COL["dst_bucket"]],
+            np.log1p(1000.0 - rows[:, _COL["active_rank"]] * 10.0),
+        )
+        reporter = RolloutReporter(
+            sub, _StorageStub([dl_path]), LocalRolloutClient(ctrl))
+        out = reporter.run_once()
+        assert out is not None
+        assert out["decision"]["decision"] == "advance"
+        assert out["report"]["joined_edges"] > 0
+        assert ml.canary is not None  # refresh applied the canary
+        sub.stop()
+
+    def test_reporter_none_without_shadow(self):
+        reg, m1, m2 = _registry_v1_active_v2()
+        ctrl = RolloutController(reg)
+        ml, sub = _serving_stack(reg, ctrl)
+        sub.refresh()
+        reporter = RolloutReporter(sub, _StorageStub([]), LocalRolloutClient(ctrl))
+        assert reporter.run_once() is None
+        sub.stop()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance drill 1: injected regression → automatic rollback
+# ---------------------------------------------------------------------------
+
+
+class TestRegressionAutoRollbackDrill:
+    def test_injected_regression_candidate_rolls_back(self, tmp_path):
+        # v1 active; v2 is v1 with the output layer INVERTED — a maximal
+        # ranking regression that shadow evaluation must catch before it
+        # ever serves an announce.
+        reg, m1, m2 = _registry_v1_active_v2(invert_v2=True)
+        ctrl = RolloutController(reg, guardrails=RolloutGuardrails(
+            min_shadow_samples=50))
+        ctrl.begin(m2.id)
+        ml, sub = _serving_stack(reg, ctrl)
+        sub.refresh()
+        assert ml.shadow is not None
+
+        task, peers = build_announce_swarm(60, seed=11)
+        _drive_announces(ml, task, peers, count=40, parents=8)
+        ml.shadow.drain()
+        rows = ml.shadow.replay_rows()
+        assert rows.shape[0] >= 50
+        # Ground truth sides with the ACTIVE model: realized bandwidth
+        # decreases with active rank (the model in production is good).
+        dl_path = str(tmp_path / "download.dfc")
+        _write_download_rows(
+            dl_path, rows[:, _COL["src_bucket"]], rows[:, _COL["dst_bucket"]],
+            np.log1p(1000.0 - rows[:, _COL["active_rank"]] * 50.0),
+        )
+        reporter = RolloutReporter(
+            sub, _StorageStub([dl_path]), LocalRolloutClient(ctrl))
+        out = reporter.run_once()
+        assert out is not None and out["decision"]["decision"] == "rollback"
+        # The candidate is out, the last-good version still serves, and
+        # the decision is visible in rollout_state.
+        assert reg.get(m2.id).state is ModelState.INACTIVE
+        assert reg.active_model("s1", MODEL_NAME).id == m1.id
+        assert ctrl.get("s1", MODEL_NAME).phase == RolloutPhase.ROLLED_BACK.value
+        assert "regret" in ctrl.get("s1", MODEL_NAME).reason
+        assert rollout_metrics.ROLLOUT_STATE.value(
+            scheduler_id="s1", name=MODEL_NAME) == 5.0
+        # The scheduler side dropped its rollout state too.
+        assert ml.shadow is None and ml.canary is None
+        assert sub._loaded_version == m1.version
+        sub.stop()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance drill 2: manager kill mid-canary → pinned to last ACTIVE
+# ---------------------------------------------------------------------------
+
+
+class TestManagerKillMidCanaryDrill:
+    def test_kill_pins_scheduler_to_last_active_no_flapping(self, tmp_path):
+        from dragonfly2_tpu.manager import ClusterManager
+        from dragonfly2_tpu.manager.rest import ManagerRESTServer
+        from dragonfly2_tpu.rollout import RolloutRESTClient
+        from dragonfly2_tpu.rpc.registry_client import RemoteRegistry
+
+        reg, m1, m2 = _registry_v1_active_v2(invert_v2=False, sched="s-kill")
+        ctrl = RolloutController(reg, guardrails=RolloutGuardrails(
+            min_shadow_samples=1, canary_percent=20))
+        server = ManagerRESTServer(reg, ClusterManager(), rollout=ctrl)
+        server.serve()
+        try:
+            remote = RemoteRegistry(server.url, timeout=3.0)
+            rollout_client = RolloutRESTClient(server.url, timeout=3.0)
+            ml = MLEvaluator(
+                None, feature_cache=HostFeatureCache(max_hosts=512),
+                batcher=ScorerBatcher(linger_s=0.0),
+            )
+            sub = ModelSubscriber(
+                remote, ml, scheduler_id="s-kill",
+                rollout_client=rollout_client, shadow_sample_rate=0.5,
+            )
+            sub.refresh()
+            assert sub._loaded_version == m1.version
+            # Walk the candidate to CANARY over the REAL wire.
+            ctrl.begin(m2.id)
+            decision = rollout_client.report(
+                "s-kill", MODEL_NAME, _report(joined=5))
+            assert decision["decision"] == "advance"
+            sub.refresh()
+            assert ml.canary is not None and ml.canary.percent == 20
+            assert sched_metrics.ROLLOUT_SERVING_STATE.value(
+                name=MODEL_NAME) == 3.0
+            serving = ml._scorer
+        finally:
+            server.stop()  # the KILL: manager gone mid-canary
+
+        # Next poll fails → the scheduler pins to the last ACTIVE version.
+        assert sub.refresh() is False
+        assert ml.canary is None and ml.shadow is None
+        assert ml._scorer is serving and sub._loaded_version == m1.version
+        assert sched_metrics.ROLLOUT_SERVING_STATE.value(name=MODEL_NAME) == 0.0
+        # No flapping: repeated failed polls keep the exact same state.
+        for _ in range(3):
+            assert sub.refresh() is False
+            assert ml.canary is None and ml._scorer is serving
+        # Announces keep ranking with the pinned active scorer.
+        task, peers = build_announce_swarm(30, seed=13)
+        ranked = ml.evaluate_parents(
+            [peers[i] for i in range(1, 9)], peers[0], task.total_piece_count
+        )
+        assert len(ranked) == 8
+        sub.stop()
+
+
+# ---------------------------------------------------------------------------
+# REST surface
+# ---------------------------------------------------------------------------
+
+
+class TestRolloutREST:
+    def _server(self):
+        from dragonfly2_tpu.manager import ClusterManager
+        from dragonfly2_tpu.manager.rest import ManagerRESTServer
+
+        reg, m1, m2 = _registry_v1_active_v2(sched="s-rest")
+        ctrl = RolloutController(reg, guardrails=RolloutGuardrails(
+            min_shadow_samples=1))
+        server = ManagerRESTServer(reg, ClusterManager(), rollout=ctrl)
+        server.serve()
+        return server, reg, ctrl, m1, m2
+
+    def _call(self, base, method, path, body=None):
+        import urllib.request
+
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            base + path, data=data,
+            headers={"Content-Type": "application/json"}, method=method,
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def test_rollout_routes_roundtrip(self):
+        import urllib.error
+
+        server, reg, ctrl, m1, m2 = self._server()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                self._call(server.url, "GET",
+                           "/api/v1/models:candidate?scheduler_id=s-rest"
+                           f"&name={MODEL_NAME}")
+            assert exc.value.code == 404
+            r = self._call(server.url, "POST", f"/api/v1/models/{m2.id}:rollout",
+                           {"canary_percent": 15})
+            assert r["phase"] == "shadow" and r["canary_percent"] == 15
+            cand = self._call(server.url, "GET",
+                              "/api/v1/models:candidate?scheduler_id=s-rest"
+                              f"&name={MODEL_NAME}")
+            assert cand["model"]["id"] == m2.id
+            assert cand["model"]["artifact_digest"]
+            assert cand["phase"] == "shadow" and cand["canary_percent"] == 15
+            out = self._call(server.url, "POST", "/api/v1/rollouts:report",
+                             {"scheduler_id": "s-rest", "name": MODEL_NAME,
+                              "report": _report(joined=5)})
+            assert out["decision"] == "advance"
+            listing = self._call(server.url, "GET", "/api/v1/rollouts")
+            assert [r["model_id"] for r in listing] == [m2.id]
+            one = self._call(server.url, "GET",
+                             "/api/v1/rollouts:get?scheduler_id=s-rest"
+                             f"&name={MODEL_NAME}")
+            assert one["phase"] == "canary"
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                self._call(server.url, "POST", "/api/v1/rollouts:report",
+                           {"scheduler_id": "ghost", "name": MODEL_NAME,
+                            "report": {}})
+            assert exc.value.code == 404
+        finally:
+            server.stop()
+
+    def test_remote_registry_verifies_digest_over_the_wire(self):
+        import dataclasses
+
+        from dragonfly2_tpu.rpc.registry_client import RemoteRegistry
+
+        server, reg, ctrl, m1, m2 = self._server()
+        try:
+            remote = RemoteRegistry(server.url, timeout=3.0)
+            model = remote.active_model("s-rest", MODEL_NAME)
+            assert model.artifact_digest == m1.artifact_digest
+            assert load_scorer(remote.load_artifact(model)) is not None
+            # CLIENT-side verification: the server serves good bytes, but
+            # the row the client holds pins a different digest → refused
+            # at the client boundary.
+            tampered = dataclasses.replace(model, artifact_digest="0" * 64)
+            with pytest.raises(ArtifactDigestError):
+                remote.load_artifact(tampered)
+            # SERVER-side verification: a corrupted blob is refused by the
+            # manager itself (clean 404, surfaced as KeyError here) — no
+            # unverifiable bytes ever leave the registry.
+            reg.blobs.put(m1.blob_key, b"tampered")
+            with pytest.raises(KeyError):
+                remote.load_artifact(model)
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# bench_shadow smoke: the tier-1 JSON schema gate
+# ---------------------------------------------------------------------------
+
+
+class TestBenchShadowSmoke:
+    def test_smoke_emits_schema_json(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "bench_shadow.py"), "--smoke"],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            cwd=str(REPO),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = proc.stdout.strip().splitlines()[-1]
+        out = json.loads(line)
+        sys.path.insert(0, str(REPO / "tools"))
+        try:
+            from bench_shadow import SCHEMA_KEYS
+        finally:
+            sys.path.pop(0)
+        for key in SCHEMA_KEYS:
+            assert key in out, key
+        assert out["ok"] is True
+        for path in ("shadow_off", "shadow_on"):
+            stats = out["paths"][path]
+            assert stats["announces"] > 0
+            assert stats["announces_per_sec"] > 0
+            assert stats["p50_ms"] <= stats["p99_ms"]
+        shadow = out["shadow"]
+        assert shadow["offered"] > 0
+        accounted = (shadow["scored_announces"] + shadow["dropped"]
+                     + shadow["sampled_out"] + shadow["errors"])
+        # offered/sampled_out are lock-free racy counters (shadow.py):
+        # allow a couple of lost increments under announcer contention.
+        assert abs(accounted - shadow["offered"]) <= 4
+        assert isinstance(out["overhead_pct"], float)

@@ -1,0 +1,173 @@
+"""Shadow-scoring overhead benchmark: announces/sec with shadow off vs on.
+
+Measures the rollout plane's marginal cost on the announce hot path
+(ISSUE 4 acceptance: shadow mode at a 10 % sample rate must cost < 5 %
+announces/s): the SAME vectorized ML serving path bench_sched.py
+measures — cache-gather featurize + micro-batched scoring under
+concurrent announcer threads — run in INTERLEAVED rounds with and
+without a ShadowScorer attached, so machine noise lands on both paths
+equally (the bench_sched discipline).
+
+The shadow engine runs for real: deterministic hash sampling, the
+worker thread re-scoring candidates, and the columnar replay log (to a
+temp file), so the measured overhead includes queue handoff and any GIL
+pressure from the worker — not just the sampling branch.
+
+Prints ONE JSON line: per-path announces/sec + latency percentiles,
+overhead percent, and the shadow engine's own accounting (sampled /
+scored / dropped / logged rows).
+
+Usage: PYTHONPATH=/root/repo python tools/bench_shadow.py
+       [--hosts 1000 --parents 50 --announcers 32 --announces 2048]
+       [--sample-rate 0.1] [--rounds 4] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from bench_sched import _make_plans, _make_weights, _run_round, _summarize  # noqa: E402
+
+SCHEMA_KEYS = (
+    "ok",
+    "metric",
+    "config",
+    "paths",
+    "overhead_pct",
+    "shadow",
+)
+
+
+def run(hosts: int, parents: int, announcers: int, announces: int,
+        sample_rate: float, linger_ms: float, seed: int = 0,
+        rounds: int = 4) -> dict:
+    import gc
+
+    from dragonfly2_tpu.rollout import ShadowScorer
+    from dragonfly2_tpu.scheduler import (
+        HostFeatureCache,
+        MLEvaluator,
+        ScorerBatcher,
+    )
+    from dragonfly2_tpu.sim.swarm import build_announce_swarm
+    from dragonfly2_tpu.trainer.export import MLPScorer
+
+    task, peers = build_announce_swarm(hosts, seed=seed)
+
+    def make_eval():
+        return MLEvaluator(
+            MLPScorer(weights=_make_weights(seed)),
+            feature_cache=HostFeatureCache(max_hosts=max(hosts * 2, 1024)),
+            batcher=ScorerBatcher(linger_s=linger_ms / 1e3),
+        )
+
+    ml_off = make_eval()
+    ml_on = make_eval()
+    log_dir = tempfile.mkdtemp(prefix="bench-shadow-")
+    log_path = os.path.join(log_dir, "shadow_replay.dfc")
+    shadow = ShadowScorer(
+        MLPScorer(weights=_make_weights(seed + 1)),  # a DIFFERENT candidate
+        candidate_version=2,
+        active_version=1,
+        sample_rate=sample_rate,
+        log_path=log_path,
+    )
+    ml_on.set_shadow(shadow)
+
+    named = (
+        ("shadow_off", ml_off.evaluate_parents),
+        ("shadow_on", ml_on.evaluate_parents),
+    )
+    rounds = max(rounds, 1)
+    per_round = max(announces // rounds, announcers)
+    walls = {name: 0.0 for name, _ in named}
+    lats = {name: [] for name, _ in named}
+    # Interleaved rounds + warm-up + GC quiesced: bench_sched's recipe.
+    for r in range(rounds + 1):
+        plans = _make_plans(
+            len(peers), parents_per_announce=parents,
+            announcers=announcers, announces=per_round, seed=seed + r,
+        )
+        measured = r > 0
+        if r == 1:
+            gc.collect()
+            gc.disable()
+        for name, evaluate in named:
+            wall, lat = _run_round(evaluate, task, peers, plans, announcers)
+            if measured:
+                walls[name] += wall
+                lats[name].extend(lat)
+    gc.enable()
+    shadow.drain(timeout=60.0)
+    stats = shadow.stats()
+    shadow.close()
+    paths = {name: _summarize(walls[name], lats[name]) for name, _ in named}
+    off = paths["shadow_off"]["announces_per_sec"]
+    on = paths["shadow_on"]["announces_per_sec"]
+    return {
+        "ok": True,
+        "metric": "scheduler_shadow_overhead_pct",
+        "config": {
+            "hosts": hosts,
+            "parents_per_announce": parents,
+            "announcers": announcers,
+            "announces_per_path": paths["shadow_on"]["announces"],
+            "sample_rate": sample_rate,
+            "rounds": rounds,
+            "linger_ms": linger_ms,
+            "seed": seed,
+        },
+        "paths": paths,
+        "overhead_pct": round((1.0 - on / off) * 100.0, 2) if off else 0.0,
+        "shadow": stats,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--hosts", type=int, default=1000)
+    p.add_argument("--parents", type=int, default=50)
+    p.add_argument("--announcers", type=int, default=32)
+    p.add_argument("--announces", type=int, default=2048,
+                   help="total announces per measured path")
+    p.add_argument("--sample-rate", type=float, default=0.1)
+    p.add_argument("--linger-ms", type=float, default=1.5)
+    p.add_argument("--rounds", type=int, default=4,
+                   help="interleaved measurement rounds per path "
+                        "(+1 unmeasured warm-up round)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny sizes: the tier-1 JSON-schema gate")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.hosts, args.parents = 64, 8
+        args.announcers, args.announces = 4, 64
+        args.linger_ms, args.rounds = 0.2, 1
+    try:
+        out = run(args.hosts, args.parents, args.announcers, args.announces,
+                  args.sample_rate, args.linger_ms, args.seed, args.rounds)
+        missing = [k for k in SCHEMA_KEYS if k not in out]
+        if missing:
+            raise RuntimeError(f"schema keys missing: {missing}")
+    except Exception as exc:  # noqa: BLE001 — one parseable line, never a traceback
+        print(json.dumps({
+            "ok": False,
+            "metric": "scheduler_shadow_overhead_pct",
+            "error": f"{type(exc).__name__}: {exc}"[:300],
+        }))
+        return 1
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

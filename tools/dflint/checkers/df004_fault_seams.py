@@ -82,6 +82,9 @@ REQUIRED_SEAMS = {
     "dragonfly2_tpu/rpc/registry_client.py": (
         "rpc.registry.get", "rpc.registry.post",
     ),
+    "dragonfly2_tpu/rollout/client.py": (
+        "rollout.fetch", "rollout.report",
+    ),
     "dragonfly2_tpu/rpc/trainer_transport.py": (
         "trainer.rpc.post", "trainer.rpc.get",
     ),
