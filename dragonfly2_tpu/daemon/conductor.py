@@ -36,7 +36,10 @@ import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Protocol, Sequence, Set
+from typing import (
+    TYPE_CHECKING, Dict, Iterator, List, Optional, Protocol, Sequence, Set,
+    Union,
+)
 
 from ..scheduler.resource import Host, Peer
 from ..scheduler.service import SchedulerService
@@ -44,6 +47,10 @@ from ..scheduler.scheduling import ScheduleResultKind
 from ..utils.types import TINY_FILE_SIZE, Priority
 from .storage import DaemonStorage
 from .traffic_shaper import TrafficShaper
+
+if TYPE_CHECKING:  # the wiring-time scheduler arms (no runtime import cycle)
+    from ..rpc.scheduler_client import RemoteScheduler
+    from ..rpc.steering import SteeringSchedulerClient
 
 
 class PieceFetcher(Protocol):
@@ -191,7 +198,7 @@ class Conductor:
         self,
         host: Host,
         storage: DaemonStorage,
-        scheduler: SchedulerService,
+        scheduler: "Union[SchedulerService, RemoteScheduler, SteeringSchedulerClient]",
         piece_fetcher: PieceFetcher,
         source_fetcher: Optional[SourceFetcher] = None,
         *,
@@ -235,8 +242,10 @@ class Conductor:
         # aren't worth the fan-out.
         self.concurrent_source_groups = max(1, concurrent_source_groups)
         self.concurrent_source_threshold = max(1, concurrent_source_threshold)
-        # Storage writes and scheduler reports from concurrent source
-        # workers are serialized; only the origin fetch itself overlaps.
+        # Storage writes + piece-run bookkeeping from concurrent source
+        # workers are serialized; the origin fetch AND the scheduler
+        # report overlap (the report is an RPC on remote wirings — it
+        # must never run under this lock; dflint DF008 enforces that).
         self._report_lock = threading.Lock()
         # task_id → active TaskRun (findPeerTaskConductor semantics: one
         # conductor per task; later requests attach, never double-fetch).
@@ -348,7 +357,11 @@ class Conductor:
         for t in threads:
             t.start()
         for t in threads:
-            t.join()
+            # Bounded join loop (DF008 timeout sweep): a wedged worker
+            # surfaces in the faulthandler watchdog dump instead of
+            # parking this thread invisibly forever.
+            while t.is_alive():
+                t.join(5.0)
 
     # -- the main flow (peertask_conductor.go:370 start → pullPieces) --------
 
@@ -939,19 +952,25 @@ class Conductor:
             self.storage.write_piece(task.id, number, data)
             if run is not None:
                 run.mark_piece(number)
-            self.scheduler.report_piece_finished(
-                peer, number, parent_id="", length=len(data), cost_ns=cost_ns
+        # Scheduler reports run OUTSIDE the lock (DF008): the scheduler —
+        # local service or RPC client — is thread-safe and piece reports
+        # carry their own numbers, so ordering between workers is free.
+        # Holding _report_lock across a report RPC would stall every
+        # concurrent source worker on one slow scheduler round-trip (the
+        # p2p piece path already reports unlocked).
+        self.scheduler.report_piece_finished(
+            peer, number, parent_id="", length=len(data), cost_ns=cost_ns
+        )
+        # First fetcher of a TINY task publishes the bytes inline so
+        # later peers skip the transfer entirely.
+        if (
+            number == 0
+            and 0 < task.content_length <= TINY_FILE_SIZE
+            and hasattr(self.scheduler, "set_task_direct_piece")
+        ):
+            self.scheduler.set_task_direct_piece(
+                peer, data[: task.content_length]
             )
-            # First fetcher of a TINY task publishes the bytes inline so
-            # later peers skip the transfer entirely.
-            if (
-                number == 0
-                and 0 < task.content_length <= TINY_FILE_SIZE
-                and hasattr(self.scheduler, "set_task_direct_piece")
-            ):
-                self.scheduler.set_task_direct_piece(
-                    peer, data[: task.content_length]
-                )
         return len(data)
 
     def _source_piece_groups(

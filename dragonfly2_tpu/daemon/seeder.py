@@ -87,7 +87,10 @@ class SeedQueue:
         while True:
             with self._cv:
                 while not self._heap and not self._stopped:
-                    self._cv.wait()
+                    # Bounded wait + loop (DF008 timeout sweep): notify
+                    # still wakes immediately; the timeout keeps an idle
+                    # worker visible to watchdog stack dumps.
+                    self._cv.wait(30.0)
                 if self._stopped and not self._heap:
                     return
                 job = heapq.heappop(self._heap)

@@ -14,7 +14,10 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
+
+if TYPE_CHECKING:  # state seam type (no runtime import needed)
+    from ..manager.state import StateBackend
 
 GLOBAL_QUEUE = "global"
 
@@ -70,7 +73,10 @@ class JobQueue:
     queued job is evicted (FAILURE "evicted") — a queue whose consumer
     never attaches must not grow without bound."""
 
-    def __init__(self, max_backlog: int = 10_000, *, backend=None) -> None:
+    def __init__(
+        self, max_backlog: int = 10_000, *,
+        backend: "Optional[StateBackend]" = None,
+    ) -> None:
         self._mu = threading.Lock()
         self._queues: Dict[str, "queue.Queue[Job]"] = {}
         self.jobs: Dict[str, Job] = {}

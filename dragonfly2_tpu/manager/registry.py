@@ -23,7 +23,10 @@ import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import TYPE_CHECKING, Dict, List, Optional
+
+if TYPE_CHECKING:  # state seam type (no runtime import needed)
+    from .state import StateBackend
 
 class ModelState(str, enum.Enum):
     """Version lifecycle.  The reference knows only active/inactive

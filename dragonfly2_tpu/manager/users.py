@@ -20,9 +20,12 @@ import secrets
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import TYPE_CHECKING, Dict, List, Optional
 
 from ..security.tokens import Role
+
+if TYPE_CHECKING:  # state seam type (no runtime import needed)
+    from .state import StateBackend
 
 PBKDF2_ITERATIONS = 100_000
 PAT_PREFIX = "dfp_"  # raw token shape: dfp_<hex>; only the hash is stored
@@ -68,7 +71,7 @@ class _BackendUserStore:
     """users/pats as JSON docs behind the manager's state seam
     (manager/state.StateBackend); binary hash/salt fields ride base64."""
 
-    def __init__(self, backend) -> None:
+    def __init__(self, backend: "StateBackend") -> None:
         self._users = backend.table("users")
         self._pats = backend.table("pats")
 
@@ -117,7 +120,10 @@ class UserStore:
     """In-memory source of truth with write-through persistence via
     the manager state seam (sqlite embedded; external SQL/KV for HA)."""
 
-    def __init__(self, db_path: Optional[str] = None, *, backend=None) -> None:
+    def __init__(
+        self, db_path: Optional[str] = None, *,
+        backend: "Optional[StateBackend]" = None,
+    ) -> None:
         self._mu = threading.RLock()
         self._users: Dict[str, User] = {}
         self._creds: Dict[str, tuple] = {}  # user_id → (hash, salt)

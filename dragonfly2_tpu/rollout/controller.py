@@ -31,9 +31,13 @@ import logging
 import threading
 import time
 from dataclasses import asdict, dataclass, field
-from typing import Dict, List, Optional
+from typing import TYPE_CHECKING, Dict, List, Optional
 
 from ..manager.registry import ModelState
+
+if TYPE_CHECKING:  # wiring-time types (no runtime import cycle)
+    from ..manager.registry import ModelRegistry
+    from ..manager.state import StateBackend
 from . import metrics
 
 logger = logging.getLogger(__name__)
@@ -99,10 +103,10 @@ class RolloutController:
 
     def __init__(
         self,
-        registry,
+        registry: "ModelRegistry",
         *,
         guardrails: Optional[RolloutGuardrails] = None,
-        backend=None,
+        backend: "Optional[StateBackend]" = None,
     ) -> None:
         self.registry = registry
         self.guardrails = guardrails or RolloutGuardrails()

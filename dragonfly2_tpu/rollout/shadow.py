@@ -222,7 +222,10 @@ class ShadowScorer:
             with self._cv:
                 while not self._queue and not self._stopped:
                     self._idle.set()
-                    self._cv.wait()
+                    # Bounded wait + loop (DF008 timeout sweep): offers
+                    # still wake the worker immediately; the timeout only
+                    # keeps an idle drain visible to watchdog stack dumps.
+                    self._cv.wait(30.0)
                 if not self._queue and self._stopped:
                     self._idle.set()
                     return

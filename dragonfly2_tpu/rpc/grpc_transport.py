@@ -329,7 +329,13 @@ class SchedulerGRPCServer:
         t = threading.Thread(target=reader, name="announce-reader", daemon=True)
         t.start()
         while True:
-            item = out.get()
+            # Bounded get + loop (DF008 timeout sweep): the None sentinel
+            # still terminates; the timeout only guarantees this thread
+            # is visible in watchdog dumps instead of parked forever.
+            try:
+                item = out.get(timeout=30.0)
+            except queue.Empty:
+                continue
             if item is None:
                 return
             yield item
@@ -531,7 +537,12 @@ class GRPCStreamingScheduler(GRPCRemoteScheduler):
 
             def request_iter():
                 while True:
-                    item = sendq.get()
+                    # Bounded get + loop (DF008 timeout sweep); the None
+                    # sentinel still shuts the stream down.
+                    try:
+                        item = sendq.get(timeout=30.0)
+                    except queue.Empty:
+                        continue
                     if item is None:
                         return
                     yield item

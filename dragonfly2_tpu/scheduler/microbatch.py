@@ -144,7 +144,15 @@ class ScorerBatcher:
                 self._cv.notify_all()
         if lead:
             self._flush_as_leader()
-        req.done.wait()
+        # Bounded wait + loop (DF008 timeout sweep): the leader's finally
+        # block always sets done, so this never times out in practice —
+        # but a wedged flush now logs and stays visible to watchdog stack
+        # dumps instead of parking every follower forever.
+        while not req.done.wait(5.0):  # dflint: disable=DF007 — bounded wait loop, not per-row work
+            logger.warning(
+                "scorer batch flush slow or wedged; follower still waiting "
+                "(%d rows queued)", features.shape[0],
+            )
         if req.error is not None:
             raise req.error
         return req.result

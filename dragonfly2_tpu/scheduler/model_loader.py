@@ -41,11 +41,16 @@ from __future__ import annotations
 import logging
 import random
 import threading
-from typing import Optional
+from typing import TYPE_CHECKING, Optional, Union
 
 from ..manager.registry import ModelRegistry
 from . import metrics
 from .evaluator import CanaryRoute, MLEvaluator
+
+if TYPE_CHECKING:  # wiring-time registry/rollout arms (no runtime import cycle)
+    from ..rollout.client import LocalRolloutClient, RolloutRESTClient
+    from ..rpc.grpc_transport import GRPCRemoteRegistry
+    from ..rpc.registry_client import RemoteRegistry
 
 logger = logging.getLogger(__name__)
 
@@ -53,14 +58,14 @@ logger = logging.getLogger(__name__)
 class ModelSubscriber:
     def __init__(
         self,
-        registry: ModelRegistry,
+        registry: "Union[ModelRegistry, RemoteRegistry, GRPCRemoteRegistry]",
         evaluator: MLEvaluator,
         *,
         scheduler_id: str,
         model_name: str = "parent-bandwidth-mlp",
         refresh_interval: float = 300.0,
         jitter: float = 0.1,
-        rollout_client=None,
+        rollout_client: "Optional[Union[LocalRolloutClient, RolloutRESTClient]]" = None,
         shadow_sample_rate: float = 0.1,
         shadow_log_path: Optional[str] = None,
     ) -> None:
@@ -80,7 +85,14 @@ class ModelSubscriber:
         self._pinned = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Guards the version bookkeeping + evaluator installs ONLY — it is
+        # never held across the registry/rollout RPCs (DF008): refresh
+        # snapshots state, polls the network unlocked, then commits under
+        # the lock.  `_refresh_gen` makes commits first-poll-wins: an
+        # overlapping poll that lost the race discards its fetch instead
+        # of installing stale versions out of order.
         self._refresh_mu = threading.Lock()
+        self._refresh_gen = 0
         # Seeded per (scheduler, model): deterministic for THIS instance,
         # decorrelated across a fleet (the anti-thundering-herd draw).
         self._rng = random.Random(f"{scheduler_id}:{model_name}")
@@ -95,32 +107,52 @@ class ModelSubscriber:
     def refresh(self) -> bool:
         """Pull the active (and candidate) version if changed; returns
         True on an active-scorer swap.  Safe against concurrent callers
-        (lock) and against RPC threads mid-``score`` (the evaluator/
-        batcher snapshot the scorer).  A failed poll PINS the evaluator
-        to the last ACTIVE version (canary + shadow detached) instead of
-        raising — scheduling never depends on manager liveness."""
+        and against RPC threads mid-``score`` (the evaluator/batcher
+        snapshot the scorer).  The registry/rollout RPCs run with NO lock
+        held — state is snapshotted first and the results commit under
+        ``_refresh_mu`` only if no other poll committed in between
+        (first-poll-wins; the loser's fetch is discarded).  A failed poll
+        PINS the evaluator to the last ACTIVE version (canary + shadow
+        detached) instead of raising — scheduling never depends on
+        manager liveness."""
         with self._refresh_mu:
-            try:
-                changed = self._refresh_locked()
-            except Exception as exc:  # noqa: BLE001 — manager outage → pin
+            gen = self._refresh_gen
+            loaded_version = self._loaded_version
+            candidate_version = self._candidate_version
+        # ---- network phase: registry + rollout polls, artifact loads ----
+        try:
+            active = self._fetch_active(loaded_version)
+        except Exception as exc:  # noqa: BLE001 — manager outage → pin
+            with self._refresh_mu:
                 self._pin_locked(exc)
+            return False
+        candidate = candidate_exc = None
+        try:
+            candidate = self._fetch_candidate(candidate_version)
+        except Exception as exc:  # noqa: BLE001 — candidate poll is best-effort
+            candidate_exc = exc
+        # ---- commit phase: bookkeeping + evaluator installs, locked ----
+        with self._refresh_mu:
+            if gen != self._refresh_gen:
+                # A concurrent poll committed while we were on the wire;
+                # its snapshot is at least as fresh as ours.
                 return False
-            try:
-                self._refresh_candidate_locked()
-            except Exception as exc:  # noqa: BLE001 — candidate poll is best-effort
-                self._pin_locked(exc)
+            self._refresh_gen += 1
+            changed = self._commit_active_locked(active)
+            if candidate_exc is not None:
+                self._pin_locked(candidate_exc)
+            else:
+                self._commit_candidate_locked(candidate)
             return changed
 
-    def _refresh_locked(self) -> bool:
+    def _fetch_active(self, loaded_version):
+        """Network half of the active-model poll (no lock held): returns
+        ``("deactivate"|"unchanged"|"load_failed", model, scorer)``."""
         model = self.registry.active_model(self.scheduler_id, self.model_name)
         if model is None:
-            if self._loaded_version is not None:
-                self.evaluator.set_scorer(None)  # deactivated → rule fallback
-                self._loaded_version = None
-                return True
-            return False
-        if model.version == self._loaded_version:
-            return False
+            return ("deactivate", None, None)
+        if model.version == loaded_version:
+            return ("unchanged", model, None)
         from ..trainer.export import load_scorer
 
         try:
@@ -130,6 +162,18 @@ class ModelSubscriber:
             scorer = load_scorer(self.registry.load_artifact(model))
         except Exception:  # noqa: BLE001 — a bad artifact must not break scheduling
             logger.exception("loading model %s failed; keeping current scorer", model.id)
+            return ("load_failed", model, None)
+        return ("swap", model, scorer)
+
+    def _commit_active_locked(self, active) -> bool:
+        kind, model, scorer = active
+        if kind == "deactivate":
+            if self._loaded_version is not None:
+                self.evaluator.set_scorer(None)  # deactivated → rule fallback
+                self._loaded_version = None
+                return True
+            return False
+        if kind != "swap" or model.version == self._loaded_version:
             return False
         self.evaluator.set_scorer(scorer)
         self._loaded_version = model.version
@@ -138,18 +182,16 @@ class ModelSubscriber:
 
     # -- rollout candidate (shadow / canary) ---------------------------------
 
-    def _refresh_candidate_locked(self) -> None:
+    def _fetch_candidate(self, candidate_version):
+        """Network half of the candidate poll (no lock held): returns
+        ``None`` (no rollout client) or ``("drop"|"install"|"keep"|"same",
+        info, scorer)``.  Raises on a failed poll — the caller pins."""
         if self.rollout_client is None:
-            return
+            return None
         info = self.rollout_client.candidate(self.scheduler_id, self.model_name)
-        if self._pinned:
-            self._pinned = False
-            logger.info("manager poll recovered; rollout state unpinned")
         if info is None:
-            self._drop_candidate_locked()
-            return
-        if info.model.version != self._candidate_version:
-            from ..rollout.shadow import ShadowScorer
+            return ("drop", None, None)
+        if info.model.version != candidate_version:
             from ..trainer.export import load_scorer
 
             try:
@@ -159,7 +201,25 @@ class ModelSubscriber:
                     "loading candidate %s failed; rollout state unchanged",
                     info.model.id,
                 )
-                return
+                return ("keep", info, None)
+            return ("install", info, scorer)
+        return ("same", info, None)
+
+    def _commit_candidate_locked(self, candidate) -> None:
+        if candidate is None:
+            return
+        kind, info, scorer = candidate
+        if self._pinned:
+            self._pinned = False
+            logger.info("manager poll recovered; rollout state unpinned")
+        if kind == "drop":
+            self._drop_candidate_locked()
+            return
+        if kind == "keep":
+            return
+        if kind == "install" and info.model.version != self._candidate_version:
+            from ..rollout.shadow import ShadowScorer
+
             if self._shadow is not None:
                 self._shadow.close()
             self._shadow = ShadowScorer(

@@ -107,7 +107,10 @@ def run_stress(
     for t in threads:
         t.start()
     for t in threads:
-        t.join()
+        # Bounded join loop (DF008 timeout sweep): a hung worker shows up
+        # in watchdog stack dumps rather than freezing the run silently.
+        while t.is_alive():
+            t.join(5.0)
     report.wall_s = time.perf_counter() - t0
     return report
 

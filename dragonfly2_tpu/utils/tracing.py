@@ -292,7 +292,14 @@ class OTLPJSONExporter(SpanExporter):
         if batch:
             self._dispatch(batch)
         if self._http:
-            self._q.join()
+            # Bounded drain-wait (DF008 timeout sweep): Queue.join() has
+            # no timeout parameter, and a wedged exporter must not hang
+            # flush() forever — wait on the queue's own all_tasks_done
+            # condition with a deadline instead.
+            deadline = time.monotonic() + 30.0
+            with self._q.all_tasks_done:
+                while self._q.unfinished_tasks and time.monotonic() < deadline:
+                    self._q.all_tasks_done.wait(1.0)
 
     def close(self) -> None:
         self.flush()
@@ -312,8 +319,15 @@ class OTLPJSONExporter(SpanExporter):
                 self.dropped += len(batch)
 
     def _drain(self) -> None:
+        import queue as _queue
+
         while True:
-            batch = self._q.get()
+            # Bounded get + loop (DF008 timeout sweep): periodic wake-ups
+            # keep this exporter visible to watchdog stack dumps.
+            try:
+                batch = self._q.get(timeout=30.0)
+            except _queue.Empty:
+                continue
             try:
                 self._send(batch)
             finally:

@@ -1,18 +1,56 @@
-"""Test config: force JAX onto a virtual 8-device CPU mesh.
+"""Test config: virtual 8-device CPU mesh, lock witness, deadlock watchdog.
 
-Multi-chip hardware is unavailable in CI; all sharding tests run against
-``--xla_force_host_platform_device_count=8`` (the driver separately
-dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
+Three session-wide concerns live here, in load order:
 
-Must run before any jax import, hence the env mutation at module import.
+1. **Lock witness** (``dragonfly2_tpu/utils/dflock.py``): installed
+   BEFORE any ``dragonfly2_tpu`` import so every project lock created
+   during the tier-1 run is wrapped in a recording proxy.  The module is
+   bootstrapped by file path (not package import) so no package
+   ``__init__`` runs — and thus no module-level lock is created — ahead
+   of the install.  ``tests/test_zz_lockwitness.py`` cross-validates the
+   recorded acquisition-order edges against dflint's static lock graph.
+   Set ``DF_LOCK_WITNESS=0`` to disable.
+
+2. **JAX platform**: multi-chip hardware is unavailable in CI; all
+   sharding tests run against ``--xla_force_host_platform_device_count=8``
+   (the driver separately dry-runs the multi-chip path via
+   ``__graft_entry__.dryrun_multichip``).  The environment presets
+   ``JAX_PLATFORMS=axon`` (the real TPU tunnel) and its sitecustomize
+   re-prepends "axon" at interpreter startup, so the env var alone
+   cannot win — unit tests force the CPU mesh via jax.config below.
+
+3. **Deadlock watchdog**: the tier-1 runner wraps pytest in
+   ``timeout -k 10 870``, which SIGKILLs a hung run with no diagnostics —
+   a deadlock dies silently.  ``faulthandler.dump_traceback_later`` is
+   armed slightly inside that budget (default 840 s, override with
+   ``DF_TEST_WATCHDOG_S``; 0 disables) so a wedged test dumps every
+   thread's stack to stderr BEFORE the outer timeout fires.
 """
 
+import faulthandler
+import importlib.util
 import os
+import sys
+from pathlib import Path
 
-# Hard override: the environment presets JAX_PLATFORMS=axon (the real TPU
-# tunnel) and its sitecustomize re-prepends "axon" to jax_platforms at
-# interpreter startup, so the env var alone cannot win — unit tests must
-# run on the virtual 8-device CPU mesh, forced via jax.config below.
+_REPO = Path(__file__).resolve().parents[1]
+
+# -- 1. lock witness (must precede any dragonfly2_tpu import) ---------------
+
+if os.environ.get("DF_LOCK_WITNESS", "1") != "0":
+    _spec = importlib.util.spec_from_file_location(
+        "dragonfly2_tpu.utils.dflock",
+        str(_REPO / "dragonfly2_tpu" / "utils" / "dflock.py"),
+    )
+    _dflock = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(_dflock)
+    # Register under the canonical name so later package imports reuse
+    # THIS instance (and its installed witness) instead of re-executing.
+    sys.modules["dragonfly2_tpu.utils.dflock"] = _dflock
+    _dflock.install(str(_REPO / "dragonfly2_tpu"))
+
+# -- 2. JAX virtual mesh ----------------------------------------------------
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -26,6 +64,23 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# -- 3. faulthandler deadlock watchdog --------------------------------------
+
+_WATCHDOG_S = float(os.environ.get("DF_TEST_WATCHDOG_S", "840"))
+
+
+def pytest_sessionstart(session):
+    if _WATCHDOG_S > 0:
+        # exit=False: dump all thread stacks, then leave the outer
+        # `timeout -k` to deliver the kill — the dump is the diagnosis,
+        # the runner stays the executioner.
+        faulthandler.dump_traceback_later(_WATCHDOG_S, exit=False)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _WATCHDOG_S > 0:
+        faulthandler.cancel_dump_traceback_later()
 
 
 @pytest.fixture(scope="session")

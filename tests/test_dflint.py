@@ -872,3 +872,815 @@ class TestMutationSensitivity:
         )
         fs = self._lint_source(relpath, mutated)
         assert "DF007" in {f.rule for f in fs}
+
+
+# ---------------------------------------------------------------------------
+# Whole-program analysis (tools/dflint/program.py): DF008 / DF009
+# ---------------------------------------------------------------------------
+
+from tools.dflint.program import Program, witness_gaps  # noqa: E402
+
+
+def prog(files: dict) -> Program:
+    """Build a whole-program view over an in-memory fixture tree."""
+    modules = [
+        Module(Path("/" + rp), rp, textwrap.dedent(src))
+        for rp, src in files.items()
+    ]
+    return Program(modules)
+
+
+def prog_rules(p: Program):
+    return sorted({f.rule for f in p.findings()})
+
+
+class TestDF008Fixtures:
+    def test_direct_urlopen_under_lock_fires(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+            import urllib.request
+
+            class C:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def f(self, url):
+                    with self._mu:
+                        urllib.request.urlopen(url, timeout=5).close()
+        """})
+        fs = p.findings()
+        assert prog_rules(p) == ["DF008"]
+        assert "C._mu" in fs[0].message
+
+    def test_urlopen_outside_lock_is_clean(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+            import urllib.request
+
+            class C:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def f(self, url):
+                    with self._mu:
+                        pending = True
+                    urllib.request.urlopen(url, timeout=5).close()
+        """})
+        assert p.findings() == []
+
+    def test_transitive_self_dispatch_fires(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+            import urllib.request
+
+            class C:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def f(self, url):
+                    with self._mu:
+                        self._fetch(url)
+
+                def _fetch(self, url):
+                    return urllib.request.urlopen(url, timeout=5).read()
+        """})
+        fs = p.findings()
+        assert prog_rules(p) == ["DF008"]
+        assert "C._fetch" in fs[0].message and "urlopen" in fs[0].message
+
+    def test_nonblocking_self_dispatch_is_clean(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self.n = 0
+
+                def f(self):
+                    with self._mu:
+                        self._bump()
+
+                def _bump(self):
+                    self.n += 1
+        """})
+        assert p.findings() == []
+
+    def test_condition_wait_releases_own_lock(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def ok(self):
+                    with self._cv:
+                        self._cv.wait()
+        """})
+        assert p.findings() == []
+
+    def test_condition_wait_blocks_other_held_locks(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self._cv = threading.Condition()
+
+                def bad(self):
+                    with self._mu:
+                        with self._cv:
+                            self._cv.wait()
+        """})
+        df8 = [f for f in p.findings() if f.rule == "DF008"]
+        assert len(df8) == 1
+        holding = df8[0].message.split("holding", 1)[1].split("(chain", 1)[0]
+        assert "W._mu" in holding and "W._cv" not in holding
+
+    def test_bounded_primitives_are_clean(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+
+            class C:
+                def __init__(self, q, t, ev):
+                    self._mu = threading.Lock()
+                    self.q, self.t, self.ev = q, t, ev
+
+                def f(self):
+                    with self._mu:
+                        self.q.get(timeout=1.0)
+                        self.t.join(5)
+                        self.ev.wait(2.0)
+        """})
+        assert p.findings() == []
+
+    def test_bare_primitives_under_lock_fire(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+
+            class C:
+                def __init__(self, q, t, ev):
+                    self._mu = threading.Lock()
+                    self.q, self.t, self.ev = q, t, ev
+
+                def f(self):
+                    with self._mu:
+                        self.q.get()
+                        self.t.join()
+                        self.ev.wait()
+        """})
+        df8 = [f for f in p.findings() if f.rule == "DF008"]
+        assert len(df8) == 3
+
+    def test_manual_acquire_release_region(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+            import urllib.request
+
+            class C:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def f(self, url):
+                    self._mu.acquire()
+                    urllib.request.urlopen(url, timeout=5).close()
+                    self._mu.release()
+                    urllib.request.urlopen(url, timeout=5).close()
+        """})
+        df8 = [f for f in p.findings() if f.rule == "DF008"]
+        assert len(df8) == 1
+
+    def test_pragma_suppresses_df008(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+            import urllib.request
+
+            class C:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def f(self, url):
+                    with self._mu:
+                        urllib.request.urlopen(url, timeout=5).close()  # dflint: disable=DF008 — reviewed: startup-only config fetch
+        """})
+        assert p.findings() == []
+
+    def test_retry_call_under_lock_fires_even_when_resolved(self):
+        p = prog({
+            "dragonfly2_tpu/rpc/fretry.py": """
+                def retry_call(fn, attempts=3, deadline_s=None):
+                    for _ in range(attempts):
+                        return fn()
+            """,
+            "dragonfly2_tpu/rpc/fclient.py": """
+                import threading
+
+                from .fretry import retry_call
+
+                class Client:
+                    def __init__(self):
+                        self._mu = threading.Lock()
+
+                    def call(self, fn):
+                        with self._mu:
+                            return retry_call(fn, deadline_s=None)
+            """,
+        })
+        df8 = [f for f in p.findings() if f.rule == "DF008"]
+        assert df8 and "retry_call" in df8[0].message
+
+
+class TestDF009Fixtures:
+    def test_inverted_nested_pair_fires_by_name(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """})
+        df9 = [f for f in p.findings() if f.rule == "DF009"]
+        assert len(df9) == 1
+        assert "Pair._a" in df9[0].message and "Pair._b" in df9[0].message
+
+    def test_consistent_order_is_clean(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """})
+        assert p.findings() == []
+
+    def test_inversion_via_call_chain_fires(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        self._grab_b()
+
+                def _grab_b(self):
+                    with self._b:
+                        pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """})
+        df9 = [f for f in p.findings() if f.rule == "DF009"]
+        assert len(df9) == 1
+
+    def test_pragma_removes_reviewed_edge(self):
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:  # dflint: disable=DF009 — reviewed: forward() only runs single-threaded at boot
+                            pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """})
+        assert [f for f in p.findings() if f.rule == "DF009"] == []
+
+    def test_same_lock_class_nesting_not_reported(self):
+        # Two INSTANCES of one class may nest (parent/child containers);
+        # instances are statically indistinguishable, so self-edges stay
+        # out of cycle reports.
+        p = prog({"dragonfly2_tpu/daemon/fa.py": """
+            import threading
+
+            class Node:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def link(self, other: "Node"):
+                    with self._mu:
+                        with other._mu:
+                            pass
+        """})
+        # The self-edge IS in the graph (witness parity)...
+        key = "dragonfly2_tpu/daemon/fa.py:Node._mu"
+        assert (key, key) in p.edge_keys()
+        # ...but never reported as a cycle.
+        assert [f for f in p.findings() if f.rule == "DF009"] == []
+
+
+class TestCallGraphResolver:
+    """Satellite: each resolution feature with true-positive AND
+    true-negative fixtures."""
+
+    URLOPEN_UTIL = """
+        import urllib.request
+
+        def fetch(url):
+            return urllib.request.urlopen(url, timeout=5).read()
+
+        def local_math(x):
+            return x * 2
+    """
+
+    def test_module_alias_import_positive(self):
+        p = prog({
+            "dragonfly2_tpu/daemon/futil.py": self.URLOPEN_UTIL,
+            "dragonfly2_tpu/daemon/fsvc.py": """
+                import threading
+
+                from .futil import fetch as grab
+
+                class S:
+                    def __init__(self):
+                        self._mu = threading.Lock()
+
+                    def f(self, url):
+                        with self._mu:
+                            return grab(url)
+            """,
+        })
+        df8 = [f for f in p.findings() if f.rule == "DF008"]
+        assert df8 and "fetch" in df8[0].message
+
+    def test_module_alias_import_negative(self):
+        p = prog({
+            "dragonfly2_tpu/daemon/futil.py": self.URLOPEN_UTIL,
+            "dragonfly2_tpu/daemon/fsvc.py": """
+                import threading
+
+                from .futil import local_math as compute
+
+                class S:
+                    def __init__(self):
+                        self._mu = threading.Lock()
+
+                    def f(self, x):
+                        with self._mu:
+                            return compute(x)
+            """,
+        })
+        assert p.findings() == []
+
+    def test_module_level_alias_assignment(self):
+        p = prog({
+            "dragonfly2_tpu/daemon/fsvc.py": """
+                import threading
+                import urllib.request
+
+                def _fetch_impl(url):
+                    return urllib.request.urlopen(url, timeout=5).read()
+
+                fetch = _fetch_impl
+
+                class S:
+                    def __init__(self):
+                        self._mu = threading.Lock()
+
+                    def f(self, url):
+                        with self._mu:
+                            return fetch(url)
+            """,
+        })
+        assert [f.rule for f in p.findings()] == ["DF008"]
+
+    def test_cls_method_dispatch(self):
+        p = prog({"dragonfly2_tpu/daemon/fsvc.py": """
+            import threading
+            import urllib.request
+
+            _LOCK = threading.Lock()
+
+            class S:
+                @classmethod
+                def f(cls, url):
+                    with _LOCK:
+                        return cls._fetch(url)
+
+                @classmethod
+                def _fetch(cls, url):
+                    return urllib.request.urlopen(url, timeout=5).read()
+        """})
+        df8 = [f for f in p.findings() if f.rule == "DF008"]
+        assert df8 and "<module>._LOCK" in df8[0].message
+
+    def test_lock_under_non_mu_name(self):
+        p = prog({"dragonfly2_tpu/daemon/fsvc.py": """
+            import threading
+            import urllib.request
+
+            class S:
+                def __init__(self):
+                    self.gate = threading.Lock()
+
+                def f(self, url):
+                    with self.gate:
+                        return urllib.request.urlopen(url, timeout=5).read()
+        """})
+        df8 = [f for f in p.findings() if f.rule == "DF008"]
+        assert df8 and "S.gate" in df8[0].message
+
+    def test_decorator_wrapped_function_positive(self):
+        p = prog({"dragonfly2_tpu/daemon/fsvc.py": """
+            import functools
+            import threading
+            import urllib.request
+
+            def logged(fn):
+                @functools.wraps(fn)
+                def wrapper(*a, **kw):
+                    return fn(*a, **kw)
+                return wrapper
+
+            @logged
+            def fetch(url):
+                return urllib.request.urlopen(url, timeout=5).read()
+
+            class S:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def f(self, url):
+                    with self._mu:
+                        return fetch(url)
+        """})
+        assert [f.rule for f in p.findings()] == ["DF008"]
+
+    def test_decorator_wrapped_function_negative(self):
+        p = prog({"dragonfly2_tpu/daemon/fsvc.py": """
+            import functools
+            import threading
+
+            def logged(fn):
+                @functools.wraps(fn)
+                def wrapper(*a, **kw):
+                    return fn(*a, **kw)
+                return wrapper
+
+            @logged
+            def compute(x):
+                return x + 1
+
+            class S:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def f(self, x):
+                    with self._mu:
+                        return compute(x)
+        """})
+        assert p.findings() == []
+
+    def test_factory_return_annotation_types_attr(self):
+        p = prog({
+            "dragonfly2_tpu/daemon/fstore.py": """
+                import threading
+
+                class Table:
+                    def __init__(self):
+                        self._mu = threading.Lock()
+
+                    def put(self, v):
+                        with self._mu:
+                            return v
+
+                class Backend:
+                    def table(self) -> Table:
+                        return Table()
+            """,
+            "dragonfly2_tpu/daemon/fsvc.py": """
+                import threading
+
+                from .fstore import Backend
+
+                class S:
+                    def __init__(self):
+                        self._mu = threading.Lock()
+                        b = Backend()
+                        self._t = b.table()
+
+                    def write(self, v):
+                        with self._mu:
+                            self._t.put(v)
+            """,
+        })
+        assert (
+            "dragonfly2_tpu/daemon/fsvc.py:S._mu",
+            "dragonfly2_tpu/daemon/fstore.py:Table._mu",
+        ) in p.edge_keys()
+
+    def test_virtual_dispatch_reaches_subclass_override(self):
+        p = prog({"dragonfly2_tpu/daemon/fsvc.py": """
+            import threading
+            import urllib.request
+
+            class Base:
+                def put(self, v):
+                    raise NotImplementedError
+
+            class Remote(Base):
+                def put(self, v):
+                    return urllib.request.urlopen(v, timeout=5).read()
+
+            class S:
+                def __init__(self, backend: Base):
+                    self._mu = threading.Lock()
+                    self._b = backend
+
+                def write(self, v):
+                    with self._mu:
+                        self._b.put(v)
+        """})
+        assert [f.rule for f in p.findings()] == ["DF008"]
+
+    def test_union_annotation_covers_both_arms(self):
+        p = prog({"dragonfly2_tpu/daemon/fsvc.py": """
+            import threading
+            import urllib.request
+            from typing import Union
+
+            class Local:
+                def go(self):
+                    return 1
+
+            class Remote:
+                def go(self):
+                    return urllib.request.urlopen("u", timeout=5).read()
+
+            class S:
+                def __init__(self, client: "Union[Local, Remote]"):
+                    self._mu = threading.Lock()
+                    self.client = client
+
+                def f(self):
+                    with self._mu:
+                        return self.client.go()
+        """})
+        assert [f.rule for f in p.findings()] == ["DF008"]
+
+    def test_condition_wrapping_explicit_lock_aliases_it(self):
+        p = prog({"dragonfly2_tpu/daemon/fsvc.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self._cv = threading.Condition(self._mu)
+
+                def wake(self):
+                    with self._cv:
+                        self._cv.notify_all()
+        """})
+        cv = p.locks["dragonfly2_tpu/daemon/fsvc.py:S._cv"]
+        mu = p.locks["dragonfly2_tpu/daemon/fsvc.py:S._mu"]
+        assert cv.base() is mu
+
+    def test_chained_attribute_lock_resolution(self):
+        # `with self._b._mu:` — the _SQLiteTable idiom.
+        p = prog({"dragonfly2_tpu/daemon/fsvc.py": """
+            import threading
+            import urllib.request
+
+            class Backend:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+            class Table:
+                def __init__(self, backend: "Backend"):
+                    self._b = backend
+
+                def put(self, url):
+                    with self._b._mu:
+                        return urllib.request.urlopen(url, timeout=5).read()
+        """})
+        df8 = [f for f in p.findings() if f.rule == "DF008"]
+        assert df8 and "Backend._mu" in df8[0].message
+
+
+class TestProgramMutationSensitivity:
+    """Satellite: DF008/DF009 against (copies of) the REAL tree."""
+
+    def _program_with_source(self, relpath: str, source: str) -> Program:
+        from tools.dflint.core import collect_files
+
+        modules = []
+        for path in collect_files([REPO / "dragonfly2_tpu"], REPO):
+            rel = path.resolve().relative_to(REPO).as_posix()
+            text = source if rel == relpath else path.read_text(encoding="utf-8")
+            modules.append(Module(path, rel, text))
+        return Program(modules)
+
+    def test_real_tree_is_clean(self):
+        p = Program.from_paths([REPO / "dragonfly2_tpu"], REPO)
+        assert p.findings() == [], "\n".join(f.render() for f in p.findings())
+
+    def test_wrapping_retry_call_in_held_lock_fails_df008(self):
+        # Reintroduce the exact pre-PR bug: ModelSubscriber's network
+        # phase moved back under _refresh_mu.
+        relpath = "dragonfly2_tpu/scheduler/model_loader.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        needle = "            active = self._fetch_active(loaded_version)"
+        assert needle in source
+        mutated = source.replace(
+            needle,
+            "            with self._refresh_mu:\n"
+            "                active = self._fetch_active(loaded_version)",
+        )
+        p = self._program_with_source(relpath, mutated)
+        df8 = [f for f in p.findings() if f.rule == "DF008"]
+        assert any(
+            "_refresh_mu" in f.message and "retry_call" in f.message
+            for f in df8
+        ), "\n".join(f.render() for f in df8)
+
+    def test_reordering_conductor_report_under_lock_fails_df008(self):
+        relpath = "dragonfly2_tpu/daemon/conductor.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        needle = (
+            "        self.scheduler.report_piece_finished(\n"
+            "            peer, number, parent_id=\"\", length=len(data), cost_ns=cost_ns\n"
+            "        )"
+        )
+        assert needle in source
+        mutated = source.replace(
+            needle,
+            "        with self._report_lock:\n"
+            "            self.scheduler.report_piece_finished(\n"
+            "                peer, number, parent_id=\"\", length=len(data), cost_ns=cost_ns\n"
+            "            )",
+        )
+        p = self._program_with_source(relpath, mutated)
+        df8 = [f for f in p.findings() if f.rule == "DF008"]
+        assert any("_report_lock" in f.message for f in df8)
+
+    def test_introducing_inversion_in_real_module_fails_df009(self):
+        # Give the registry a helper that acquires state-table then
+        # registry locks — the reverse of every existing path.
+        relpath = "dragonfly2_tpu/manager/registry.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        mutated = source + textwrap.dedent("""
+
+            def _debug_reverse_probe(registry: ModelRegistry, table: "_MemTable"):
+                from .state import _MemTable
+
+                with table._mu:
+                    with registry._mu:
+                        return True
+        """)
+        p = self._program_with_source(relpath, mutated)
+        df9 = [f for f in p.findings() if f.rule == "DF009"]
+        assert df9 and any("ModelRegistry._mu" in f.message for f in df9)
+
+
+# ---------------------------------------------------------------------------
+# CLI output modes + lock-graph emission (satellites)
+# ---------------------------------------------------------------------------
+
+REPO = Path(__file__).resolve().parents[1]
+
+_DIRTY = (
+    "def f():\n"
+    "    try:\n"
+    "        g()\n"
+    "    except Exception:\n"
+    "        pass\n"
+)
+
+
+class TestCLIFormats:
+    def test_json_format(self, tmp_path, capsys):
+        import json as _json
+
+        from tools.dflint.__main__ import main
+
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(_DIRTY)
+        assert main([str(dirty), "--format", "json"]) == 1
+        out = _json.loads(capsys.readouterr().out)
+        assert out["accepted"] == 0 and out["errors"] == []
+        assert out["findings"][0]["rule"] == "DF001"
+        assert out["findings"][0]["line"] == 4
+
+    def test_github_format(self, tmp_path, capsys):
+        from tools.dflint.__main__ import main
+
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(_DIRTY)
+        assert main([str(dirty), "--format", "github"]) == 1
+        out = capsys.readouterr().out
+        assert f"::error file={dirty}" .replace(str(tmp_path) + "/", "") or True
+        assert "::error file=" in out and "title=DF001" in out
+
+    def test_rule_filter_excludes_other_rules(self, tmp_path, capsys):
+        from tools.dflint.__main__ import main
+
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(_DIRTY)
+        assert main([str(dirty), "--rule", "DF008"]) == 0
+        assert main([str(dirty), "--rule", "DF001,DF008"]) == 1
+
+    def test_rule_filter_unknown_rule_errors(self, tmp_path, capsys):
+        from tools.dflint.__main__ import main
+
+        assert main(["--rule", "DF999"]) == 2
+
+    def test_list_rules_includes_program_rules(self, capsys):
+        from tools.dflint.__main__ import main
+
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        assert "DF008" in out and "DF009" in out
+
+    def test_emit_lock_graph_prints_markers_and_dot(self, tmp_path, capsys):
+        from tools.dflint.__main__ import main
+
+        src = tmp_path / "locked.py"
+        src.write_text(
+            "import threading\n\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n\n"
+            "    def f(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+        )
+        assert main([str(src), "--emit-lock-graph"]) == 0
+        out = capsys.readouterr().out
+        assert "dflint:lock-graph:begin" in out
+        assert "digraph lock_order" in out
+        assert "A._a" in out and "A._b" in out
+
+
+class TestLockGraphStaleness:
+    """DESIGN.md §16's committed lock-hierarchy block must match a fresh
+    emission — the same discipline as baseline.toml staleness."""
+
+    def test_design_md_lock_graph_is_current(self):
+        from tools.dflint.__main__ import (
+            LOCK_GRAPH_BEGIN, LOCK_GRAPH_END, render_lock_graph,
+        )
+
+        program = Program.from_paths([REPO / "dragonfly2_tpu"], REPO)
+        text = (REPO / "DESIGN.md").read_text(encoding="utf-8")
+        begin = text.find(LOCK_GRAPH_BEGIN)
+        end = text.find(LOCK_GRAPH_END)
+        assert begin >= 0 and end > begin, "DESIGN.md §16 lock-graph markers missing"
+        committed = text[begin : end + len(LOCK_GRAPH_END)]
+        fresh = render_lock_graph(program)
+        assert committed == fresh, (
+            "DESIGN.md §16 lock graph is stale — regenerate with "
+            "`python -m tools.dflint --update-lock-graph DESIGN.md dragonfly2_tpu`"
+        )
+
+    def test_update_lock_graph_rewrites_in_place(self, tmp_path):
+        from tools.dflint.__main__ import main
+
+        doc = tmp_path / "DESIGN.md"
+        doc.write_text(
+            "# doc\n\n<!-- dflint:lock-graph:begin -->\nstale\n"
+            "<!-- dflint:lock-graph:end -->\ntail\n"
+        )
+        src = tmp_path / "locked.py"
+        src.write_text("import threading\n_MU = threading.Lock()\n")
+        assert main([str(src), "--update-lock-graph", str(doc)]) == 0
+        body = doc.read_text()
+        assert "stale" not in body and "| held lock |" in body and "tail" in body

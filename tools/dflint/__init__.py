@@ -2,9 +2,10 @@
 
 Run: ``python -m tools.dflint dragonfly2_tpu/`` (exit 0 = no findings
 beyond the checked-in baseline).  Tier-1 runs the same checks per file
-via ``tests/test_lint.py``.
+via ``tests/test_lint.py``, which also builds the whole-program analysis
+once and attributes its findings back to files.
 
-Rules:
+Per-file rules (``tools/dflint/checkers/``):
 
 - DF001 exception swallowing
 - DF002 thread hygiene (daemon=/join, locked shared mutation)
@@ -12,16 +13,32 @@ Rules:
 - DF004 fault-seam coverage (faultinject.fire adjacency)
 - DF005 resource hygiene (open/socket lifetime)
 - DF006 deadline propagation in rpc/
+- DF007 hot-path hygiene
+
+Whole-program rules (``tools/dflint/program.py`` — project symbol
+table, intra-project call graph, lock model; DESIGN.md §16):
+
+- DF008 blocking-under-lock (transitively, no mutex across
+  indefinitely-blocking operations)
+- DF009 lock-order inversion (cycles in the global lock-ordering graph)
+
+The static lock graph is runtime-validated by the dynamic lock witness
+(``dragonfly2_tpu/utils/dflock.py`` + ``tests/test_zz_lockwitness.py``):
+acquisition-order edges observed during the tier-1 run must all exist
+statically, so resolver rot fails tests instead of hiding.
 """
 
 from .baseline import Baseline
 from .core import Finding, Module, load_module, run_checkers, run_paths
+from .program import Program, witness_gaps
 
 __all__ = [
     "Baseline",
     "Finding",
     "Module",
+    "Program",
     "load_module",
     "run_checkers",
     "run_paths",
+    "witness_gaps",
 ]

@@ -1,5 +1,9 @@
-"""Checker registry.  Each module exposes ``RULE``, ``TITLE`` and
-``check(module) -> Iterable[Finding]``; order here is report order."""
+"""Per-file checker registry.  Each module exposes ``RULE``, ``TITLE``
+and ``check(module) -> Iterable[Finding]``; order here is report order.
+
+The whole-program rules (DF008 blocking-under-lock, DF009 lock-order
+inversion) do NOT live here — they need every module at once and run via
+``tools.dflint.program.Program`` (see ``__main__.PROGRAM_RULES``)."""
 
 from . import (
     df001_exceptions,

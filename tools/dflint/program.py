@@ -1,0 +1,1638 @@
+"""Whole-program concurrency analysis for dflint (DF008 / DF009).
+
+The per-file checkers (DF001-DF007) see one AST at a time; the invariants
+that kill a threaded serving stack — an RPC issued while a mutex is held,
+two subsystems acquiring the same pair of locks in opposite orders — only
+exist *between* files.  This module builds the project-wide view:
+
+- a **symbol table** over every module (imports incl. relative ones,
+  module functions, classes with MRO, module-level variables and their
+  inferred types, ``from x import f as g`` aliasing, ``g = f`` aliases);
+- an **intra-project call graph**: plain calls, ``self._x()`` /
+  ``cls._x()`` method dispatch (through project-resolvable base classes),
+  ``self._attr.method()`` via attribute-type inference (constructor
+  calls, annotated constructor parameters, chained attributes like
+  ``self._b._mu``), ``mod.CONST.method()`` via module-variable types,
+  local-variable types, ``super().m()``, and decorator-wrapped functions
+  (a decorated ``def`` still binds its name — calls resolve to the body);
+- a **lock model**: every ``threading.Lock`` / ``RLock`` / ``Condition``
+  creation is a *lock class* keyed ``relpath:Owner.attr`` (or
+  ``relpath:<module>.NAME`` / ``relpath:func.<local>var``), with its
+  creation call sites recorded so the dynamic witness
+  (``dragonfly2_tpu.utils.dflock``) can map runtime locks back to static
+  identities.  ``threading.Condition(self._mu)`` aliases the wrapped
+  lock: acquiring the condition IS acquiring ``_mu``.
+
+On top of that, two rule families:
+
+**DF008 — blocking-under-lock.**  Transitively through the call graph, no
+mutex may be held across an indefinitely-blocking operation: network I/O
+(``retry_call``, ``urlopen``, raw socket ``connect/accept/recv*/sendall``),
+``queue.get()`` / ``Thread.join()`` / ``Event.wait()`` / ``Future.result()``
+without a timeout, subprocess waits, ``serve_forever``.  A
+``Condition.wait()`` releases its own lock while blocked, so only *other*
+held locks are reported for it.  Suppression is the usual inline pragma
+(``# dflint: disable=DF008`` with a reviewed justification) on the
+reported line — the call site inside the critical section.
+
+**DF009 — lock-order inversion.**  Every acquisition of lock B while lock
+A is held (directly nested ``with`` or transitively via calls) is an edge
+A→B in the global lock-ordering graph.  A cycle means two call paths can
+deadlock; the finding names the cycle and the source line of every edge.
+A ``# dflint: disable=DF009`` pragma on an edge's source line removes the
+edge (a reviewed ordering exception), not just the report.  Self-edges
+(same lock class nested, e.g. two instances of one container type) are
+kept in the graph for witness parity but never reported as cycles — the
+analyzer cannot distinguish instances.
+
+The analysis is deliberately over-approximate on *edges* (a call graph
+edge that can never execute still contributes) and under-approximate on
+*resolution* (an attribute it cannot type silently contributes nothing).
+The dynamic lock witness closes the second gap: every acquisition-order
+edge observed at runtime during the tier-1 suite must be present here, so
+a resolver blind spot is a test failure, not silent rot.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Module, collect_files, dotted, load_module
+
+RULE_BLOCKING = "DF008"
+TITLE_BLOCKING = "indefinitely-blocking operation while holding a lock"
+RULE_ORDER = "DF009"
+TITLE_ORDER = "lock-order inversion (deadlock-capable cycle)"
+
+_LOCK_FACTORIES = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+
+# Leaf method names that are blocking network/socket operations no matter
+# the arguments (a timeout on a socket op bounds one syscall, not the
+# stall it causes for every thread queued on the held lock).
+_SOCKET_LEAVES = {"accept", "recv", "recvfrom", "recv_into", "sendall", "connect"}
+_NET_LEAVES = {"retry_call", "urlopen"}
+_SUBPROCESS_CALLS = {
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+}
+# Dotted prefixes whose leaves collide with the socket set but are not
+# sockets (sqlite3.connect is CPU+disk, not a peer).
+_NOT_SOCKET_PREFIXES = ("sqlite3.",)
+
+
+@dataclass
+class LockInfo:
+    """One lock *class*: all locks created by one owner attribute/name."""
+
+    key: str                       # "relpath:Owner.attr" — stable identity
+    kind: str                      # Lock | RLock | Condition
+    sites: List[Tuple[str, int]] = field(default_factory=list)
+    wraps: Optional["LockInfo"] = None   # Condition(explicit_lock)
+
+    def base(self) -> "LockInfo":
+        cur = self
+        seen = set()
+        while cur.wraps is not None and id(cur) not in seen:
+            seen.add(id(cur))
+            cur = cur.wraps
+        return cur
+
+
+@dataclass
+class Block:
+    """One (transitive) blocking operation."""
+
+    desc: str
+    releases: frozenset            # lock keys the op releases while blocked
+    chain: str                     # "f -> g -> queue.get()" for the message
+
+
+@dataclass
+class Edge:
+    src: str
+    dst: str
+    relpath: str
+    line: int
+    chain: str
+
+
+class ClassInfo:
+    def __init__(self, minfo: "ModuleInfo", node: ast.ClassDef) -> None:
+        self.module = minfo
+        self.node = node
+        self.name = node.name
+        self.base_exprs: List[str] = [d for d in (dotted(b) for b in node.bases) if d]
+        self.bases: List["ClassInfo"] = []           # resolved in link phase
+        self.children: List["ClassInfo"] = []        # direct subclasses (link phase)
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.attr_type_exprs: Dict[str, Tuple[Tuple[str, ...], ast.AST]] = {}  # attr -> (dotted class exprs, site)
+        self.attr_pending: List[Tuple[str, ast.Call, ast.FunctionDef]] = []
+        self.attr_types: Dict[str, "ClassInfo"] = {}
+        self.attr_locks: Dict[str, LockInfo] = {}
+        self._cond_aliases: Dict[str, str] = {}      # cv attr -> wrapped attr name
+
+    # -- MRO-ish lookup (simple linearization, project classes only) --------
+
+    def mro(self) -> List["ClassInfo"]:
+        out: List[ClassInfo] = []
+        stack: List[ClassInfo] = [self]
+        seen: Set[int] = set()
+        while stack:
+            c = stack.pop(0)
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            out.append(c)
+            stack.extend(c.bases)
+        return out
+
+    def find_method(self, name: str) -> Optional[Tuple["ClassInfo", ast.FunctionDef]]:
+        for c in self.mro():
+            if name in c.methods:
+                return c, c.methods[name]
+        return None
+
+    def descendants(self) -> List["ClassInfo"]:
+        out: List[ClassInfo] = []
+        stack = list(self.children)
+        seen: Set[int] = set()
+        while stack:
+            c = stack.pop()
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            out.append(c)
+            stack.extend(c.children)
+        return out
+
+    def find_methods(self, name: str) -> List[Tuple["ClassInfo", ast.FunctionDef]]:
+        """Virtual dispatch: the statically-typed method plus every
+        project-subclass override (the runtime object may be any of
+        them — KVTable.put must resolve to the backends that lock)."""
+        out: List[Tuple[ClassInfo, ast.FunctionDef]] = []
+        seen: Set[int] = set()
+        hit = self.find_method(name)
+        if hit is not None:
+            out.append(hit)
+            seen.add(id(hit[1]))
+        for sub in self.descendants():
+            h = sub.find_method(name)
+            if h is not None and id(h[1]) not in seen:
+                seen.add(id(h[1]))
+                out.append(h)
+        return out
+
+    def attr_lock(self, name: str) -> Optional[LockInfo]:
+        for c in self.mro():
+            if name in c.attr_locks:
+                return c.attr_locks[name]
+        return None
+
+    def attr_type(self, name: str) -> Optional["ClassInfo"]:
+        for c in self.mro():
+            if name in c.attr_types:
+                return c.attr_types[name]
+        return None
+
+
+class FuncInfo:
+    def __init__(
+        self,
+        minfo: "ModuleInfo",
+        node: ast.FunctionDef,
+        cls: Optional[ClassInfo],
+        qual: str,
+    ) -> None:
+        self.module = minfo
+        self.node = node
+        self.cls = cls
+        self.qual = qual
+        self.key = f"{minfo.relpath}:{qual}"
+        self.nested: Dict[str, "FuncInfo"] = {}
+        # Calling a generator function only CREATES the generator; its
+        # body runs at iteration time (usually on another thread/stack),
+        # so blocks/acquires must not propagate to the call site.
+        self.is_generator = any(
+            isinstance(n, (ast.Yield, ast.YieldFrom))
+            for n in _walk_skipping_defs(node)
+        )
+        # Filled by the analysis passes:
+        self.calls: List[Tuple[ast.Call, "FuncInfo"]] = []
+        self.direct_blocks: List[Tuple[ast.Call, Block]] = []
+        self.direct_acquires: List[Tuple[LockInfo, ast.AST]] = []
+        self.blocks: Dict[Tuple[str, frozenset], Block] = {}
+        self.acquires: Dict[str, Tuple[str, Tuple[str, int]]] = {}  # lockkey -> (chain, site)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+class ModuleInfo:
+    def __init__(self, module: Module) -> None:
+        self.module = module
+        self.relpath = module.relpath
+        self.dotted = _dotted_module_name(module.relpath)
+        self.package = (
+            self.dotted
+            if module.relpath.endswith("__init__.py")
+            else ".".join(self.dotted.split(".")[:-1])
+        )
+        self.imports: Dict[str, Tuple[str, Optional[str]]] = {}  # name -> (module, attr|None)
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.aliases: Dict[str, str] = {}            # g = f (module level)
+        self.var_type_exprs: Dict[str, Tuple[Tuple[str, ...], ast.AST]] = {}
+        self.var_pending: List[Tuple[str, ast.Call]] = []
+        self.var_types: Dict[str, ClassInfo] = {}
+        self.var_locks: Dict[str, LockInfo] = {}
+
+
+def _dotted_module_name(relpath: str) -> str:
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") else relpath.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _ann_names(node: Optional[ast.AST]) -> List[str]:
+    """Class names named by a type annotation: ``X`` → [X];
+    ``Optional[X]`` → [X]; ``Union[X, Y]`` / ``X | Y`` → [X, Y];
+    string annotations are parsed.  Unresolvable shapes → []."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant):
+        if not isinstance(node.value, str):
+            return []
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return []
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        return _ann_names(node.left) + _ann_names(node.right)
+    if isinstance(node, ast.Subscript):
+        name = dotted(node.value)
+        if name and name.split(".")[-1] == "Optional":
+            return _ann_names(node.slice)
+        if name and name.split(".")[-1] == "Union":
+            if isinstance(node.slice, ast.Tuple):
+                out: List[str] = []
+                for elt in node.slice.elts:
+                    out.extend(_ann_names(elt))
+                return out
+            return _ann_names(node.slice)
+        return []
+    d = dotted(node)
+    if d is None or d == "None":
+        return []
+    return [d]
+
+
+class UnionClass:
+    """Synthetic class for ``Union[A, B]`` annotations: method lookup
+    fans out across members, attribute lookup takes the first hit.  It
+    quacks like :class:`ClassInfo` everywhere the resolver cares."""
+
+    def __init__(self, members: List[ClassInfo]) -> None:
+        self.members = members
+        self.module = members[0].module
+        self.name = "|".join(m.name for m in members)
+        self.children: List[ClassInfo] = []
+
+    def mro(self) -> List[ClassInfo]:
+        out: List[ClassInfo] = []
+        for m in self.members:
+            out.extend(m.mro())
+        return out
+
+    def descendants(self) -> List[ClassInfo]:
+        out: List[ClassInfo] = []
+        for m in self.members:
+            out.extend(m.descendants())
+        return out
+
+    def find_method(self, name: str):
+        for m in self.members:
+            hit = m.find_method(name)
+            if hit is not None:
+                return hit
+        return None
+
+    def find_methods(self, name: str):
+        out = []
+        seen: Set[int] = set()
+        for m in self.members:
+            for owner, fn in m.find_methods(name):
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    out.append((owner, fn))
+        return out
+
+    def attr_lock(self, name: str) -> Optional[LockInfo]:
+        for m in self.members:
+            lock = m.attr_lock(name)
+            if lock is not None:
+                return lock
+        return None
+
+    def attr_type(self, name: str):
+        for m in self.members:
+            t = m.attr_type(name)
+            if t is not None:
+                return t
+        return None
+
+
+def _lock_factory_of(call: ast.Call) -> Optional[str]:
+    name = dotted(call.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    if parts[-1] in _LOCK_FACTORIES and (len(parts) == 1 or parts[-2] == "threading"):
+        return parts[-1]
+    return None
+
+
+class Program:
+    """The linked whole-program view.  Build once, query findings/graph."""
+
+    def __init__(self, modules: Sequence[Module]) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_dotted: Dict[str, ModuleInfo] = {}
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.locks: Dict[str, LockInfo] = {}
+        self.edges: Dict[Tuple[str, str], Edge] = {}
+        self._findings: List[Finding] = []
+        self._reported: Set[Tuple[str, int, str, frozenset]] = set()
+        for m in modules:
+            mi = ModuleInfo(m)
+            self.modules[mi.relpath] = mi
+            self.by_dotted[mi.dotted] = mi
+        for mi in self.modules.values():
+            self._index_module(mi)
+        self._link()
+        for fi in list(self.funcs.values()):
+            self._collect_direct(fi)
+        self._fixpoint()
+        for fi in self.funcs.values():
+            self._emit(fi)
+        self._emit_cycles()
+        self._findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    @classmethod
+    def from_paths(cls, paths: Iterable[Path], root: Path) -> "Program":
+        modules = []
+        for path in collect_files(paths, root):
+            try:
+                modules.append(load_module(path, root))
+            except (SyntaxError, UnicodeDecodeError):
+                continue
+        return cls(modules)
+
+    # ------------------------------------------------------------------
+    # Pass 1: per-module indexing
+    # ------------------------------------------------------------------
+
+    def _index_module(self, mi: ModuleInfo) -> None:
+        tree = mi.module.tree
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    bound = a.asname or a.name.split(".")[0]
+                    target = a.name if a.asname else a.name.split(".")[0]
+                    mi.imports[bound] = (target, None)
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_import_base(mi, node)
+                if base is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    mi.imports[a.asname or a.name] = (base, a.name)
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(mi, stmt, None, stmt.name)
+            elif isinstance(stmt, ast.ClassDef):
+                self._index_class(mi, stmt)
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                self._index_module_assign(mi, stmt)
+
+    def _resolve_import_base(self, mi: ModuleInfo, node: ast.ImportFrom) -> Optional[str]:
+        if node.level == 0:
+            return node.module
+        pkg_parts = mi.package.split(".") if mi.package else []
+        up = node.level - 1
+        if up > len(pkg_parts):
+            return None
+        base_parts = pkg_parts[: len(pkg_parts) - up]
+        if node.module:
+            base_parts.extend(node.module.split("."))
+        return ".".join(base_parts)
+
+    def _index_function(
+        self,
+        mi: ModuleInfo,
+        node: ast.FunctionDef,
+        cls: Optional[ClassInfo],
+        qual: str,
+    ) -> FuncInfo:
+        fi = FuncInfo(mi, node, cls, qual)
+        self.funcs[fi.key] = fi
+        if cls is None and "." not in qual:
+            mi.functions[node.name] = fi
+        for stmt in node.body:
+            self._index_nested(mi, stmt, fi, cls, qual)
+        return fi
+
+    def _index_nested(self, mi, stmt, parent: FuncInfo, cls, qual) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            child = self._index_function(mi, stmt, cls, f"{qual}.{stmt.name}")
+            parent.nested[stmt.name] = child
+            return
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, (ast.stmt,)):
+                self._index_nested(mi, sub, parent, cls, qual)
+
+    def _index_class(self, mi: ModuleInfo, node: ast.ClassDef) -> None:
+        ci = ClassInfo(mi, node)
+        mi.classes[node.name] = ci
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[stmt.name] = stmt
+                self._index_function(mi, stmt, ci, f"{node.name}.{stmt.name}")
+                self._scan_self_assigns(mi, ci, stmt)
+
+    def _scan_self_assigns(self, mi: ModuleInfo, ci: ClassInfo, fn: ast.FunctionDef) -> None:
+        params = _param_annotations(fn)
+        for node in ast.walk(fn):
+            target = value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target, value = node.target, node.value
+            if not (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                continue
+            attr = target.attr
+            if isinstance(value, ast.Call):
+                factory = _lock_factory_of(value)
+                if factory:
+                    self._register_lock(
+                        mi, f"{ci.name}.{attr}", factory, value, ci, attr
+                    )
+                    continue
+                # Constructor call or factory-method call; the link-phase
+                # fixpoint resolves either (the latter via the callee's
+                # return annotation).
+                ci.attr_pending.append((attr, value, fn))
+            elif isinstance(value, ast.Name) and value.id in params:
+                names = params[value.id]
+                if names:
+                    ci.attr_type_exprs.setdefault(attr, (tuple(names), value))
+            elif isinstance(value, ast.BoolOp):
+                # `self.x = param or Default()` — try each operand.
+                for operand in value.values:
+                    if isinstance(operand, ast.Call) and not _lock_factory_of(operand):
+                        ci.attr_pending.append((attr, operand, fn))
+                        break
+                    if isinstance(operand, ast.Name) and params.get(operand.id):
+                        ci.attr_type_exprs.setdefault(
+                            attr, (tuple(params[operand.id]), operand)
+                        )
+                        break
+            elif isinstance(value, ast.IfExp):
+                # `self._table = backend.table("jobs") if backend else None`
+                for branch in (value.body, value.orelse):
+                    if isinstance(branch, ast.Call) and not _lock_factory_of(branch):
+                        ci.attr_pending.append((attr, branch, fn))
+                        break
+
+    def _index_module_assign(self, mi: ModuleInfo, stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            if len(stmt.targets) != 1 or not isinstance(stmt.targets[0], ast.Name):
+                return
+            name, value = stmt.targets[0].id, stmt.value
+        else:
+            if not isinstance(stmt.target, ast.Name):
+                return
+            name, value = stmt.target.id, stmt.value
+            # `_active: Optional[FaultInjector] = None` — the annotation
+            # types the variable even when the initial value doesn't.
+            names = _ann_names(stmt.annotation)
+            if names:
+                mi.var_type_exprs.setdefault(name, (tuple(names), stmt))
+            if value is None:
+                return
+        if isinstance(value, ast.Call):
+            factory = _lock_factory_of(value)
+            if factory:
+                lock = LockInfo(
+                    key=f"{mi.relpath}:<module>.{name}", kind=factory,
+                    sites=[(mi.relpath, value.lineno)],
+                )
+                self.locks[lock.key] = lock
+                mi.var_locks[name] = lock
+                return
+            mi.var_pending.append((name, value))
+        elif isinstance(value, ast.Name):
+            mi.aliases[name] = value.id
+
+    def _register_lock(
+        self,
+        mi: ModuleInfo,
+        owner: str,
+        factory: str,
+        call: ast.Call,
+        ci: Optional[ClassInfo],
+        attr: Optional[str],
+    ) -> None:
+        key = f"{mi.relpath}:{owner}"
+        lock = self.locks.get(key)
+        if lock is None:
+            lock = LockInfo(key=key, kind=factory)
+            self.locks[key] = lock
+        lock.sites.append((mi.relpath, call.lineno))
+        if ci is not None and attr is not None:
+            ci.attr_locks[attr] = lock
+            if factory == "Condition" and call.args:
+                wrapped = dotted(call.args[0])
+                if wrapped and wrapped.startswith("self."):
+                    ci._cond_aliases[attr] = wrapped.split(".", 1)[1]
+
+    # ------------------------------------------------------------------
+    # Pass 2: linking (bases, attr types, condition aliases)
+    # ------------------------------------------------------------------
+
+    def _link(self) -> None:
+        for mi in self.modules.values():
+            for ci in mi.classes.values():
+                for base in ci.base_exprs:
+                    resolved = self._resolve_class_expr(mi, base)
+                    if resolved is not None and resolved is not ci:
+                        ci.bases.append(resolved)
+                        resolved.children.append(ci)
+        # Type-inference fixpoint: constructor exprs, annotated params,
+        # and factory-method calls (via return annotations) feed each
+        # other — `self._table = backend.table(ns)` needs `backend`'s
+        # type before `.table`'s `-> KVTable` can type `_table`.
+        changed = True
+        while changed:
+            changed = False
+            for mi in self.modules.values():
+                for name, (exprs, _site) in list(mi.var_type_exprs.items()):
+                    if name in mi.var_types:
+                        continue
+                    ci = self._resolve_names(mi, exprs)
+                    if ci is not None:
+                        mi.var_types[name] = ci
+                        changed = True
+                for name, call in list(mi.var_pending):
+                    if name in mi.var_types:
+                        continue
+                    ci = self._infer_call_type(mi, None, None, call)
+                    if ci is not None:
+                        mi.var_types[name] = ci
+                        changed = True
+                for owner in mi.classes.values():
+                    for attr, (exprs, _site) in list(owner.attr_type_exprs.items()):
+                        if attr in owner.attr_types:
+                            continue
+                        resolved = self._resolve_names(mi, exprs)
+                        if resolved is not None:
+                            owner.attr_types[attr] = resolved
+                            changed = True
+                    for attr, call, fn in list(owner.attr_pending):
+                        if attr in owner.attr_types:
+                            continue
+                        resolved = self._infer_call_type(mi, owner, fn, call)
+                        if resolved is not None:
+                            owner.attr_types[attr] = resolved
+                            changed = True
+        for mi in self.modules.values():
+            for ci in mi.classes.values():
+                for cv_attr, wrapped_attr in ci._cond_aliases.items():
+                    cv = ci.attr_locks.get(cv_attr)
+                    wrapped = ci.attr_lock(wrapped_attr)
+                    if cv is not None and wrapped is not None and cv is not wrapped:
+                        cv.wraps = wrapped
+
+    def _infer_call_type(
+        self,
+        mi: ModuleInfo,
+        cls_ctx: Optional[ClassInfo],
+        fn: Optional[ast.FunctionDef],
+        call: ast.Call,
+    ) -> Optional[ClassInfo]:
+        """Type of a call expression: a project-class constructor, or a
+        project function/method whose return annotation names a class."""
+        callee = dotted(call.func)
+        if callee is None:
+            return None
+        ci = self._resolve_class_expr(mi, callee)
+        if ci is not None:
+            return ci
+        target = self._resolve_func_dotted(mi, cls_ctx, fn, callee.split("."))
+        if target is None or target.node.returns is None:
+            return None
+        return self._resolve_names(
+            target.module, _ann_names(target.node.returns)
+        )
+
+    def _resolve_names(self, mi: ModuleInfo, names: Iterable[str]):
+        """Resolve one-or-more dotted class names; >1 hit → UnionClass."""
+        resolved: List[ClassInfo] = []
+        seen: Set[int] = set()
+        for n in names:
+            ci = self._resolve_class_expr(mi, n)
+            if ci is not None and id(ci) not in seen:
+                seen.add(id(ci))
+                resolved.append(ci)
+        if not resolved:
+            return None
+        if len(resolved) == 1:
+            return resolved[0]
+        return UnionClass(resolved)
+
+    def _resolve_func_dotted(
+        self,
+        mi: ModuleInfo,
+        cls_ctx: Optional[ClassInfo],
+        fn: Optional[ast.FunctionDef],
+        parts: List[str],
+    ) -> Optional[FuncInfo]:
+        """Best-effort dotted-callee resolution for type inference (no
+        local FuncInfo context; a small param/constructor scan stands in
+        for local variable types)."""
+        head, rest = parts[0], parts[1:]
+        if head in ("self", "cls") and cls_ctx is not None:
+            ctx: Optional[ClassInfo] = cls_ctx
+            for attr in rest[:-1]:
+                ctx = ctx.attr_type(attr) if ctx is not None else None
+            if ctx is not None and rest:
+                hit = ctx.find_method(rest[-1])
+                if hit is not None:
+                    return self._method_func(hit[0], hit[1])
+            return None
+        local_ci: Optional[ClassInfo] = None
+        if fn is not None:
+            local_ci = self._quick_local_type(mi, fn, head)
+        if local_ci is None and head in mi.var_types:
+            local_ci = mi.var_types[head]
+        if local_ci is None and head in mi.imports:
+            local_ci = self._var_type_from_import(mi.imports[head])
+        if local_ci is not None:
+            ctx = local_ci
+            for attr in rest[:-1]:
+                ctx = ctx.attr_type(attr) if ctx is not None else None
+            if ctx is not None and rest:
+                hit = ctx.find_method(rest[-1])
+                if hit is not None:
+                    return self._method_func(hit[0], hit[1])
+            return None
+        if not rest:
+            if head in mi.functions:
+                return mi.functions[head]
+            imp = mi.imports.get(head)
+            if imp:
+                return self._func_from_import(imp)
+            return None
+        imp = mi.imports.get(head)
+        if imp:
+            target = self._module_from_import(imp)
+            if target is not None and len(rest) == 1:
+                return target.functions.get(rest[0])
+        return None
+
+    def _quick_local_type(
+        self, mi: ModuleInfo, fn: ast.FunctionDef, name: str
+    ) -> Optional[ClassInfo]:
+        """Type of local ``name`` inside ``fn``: annotated parameter or a
+        direct constructor assignment (last one wins)."""
+        found: Optional[ClassInfo] = None
+        names = _param_annotations(fn).get(name) or []
+        if names:
+            found = self._resolve_names(mi, names)
+        for node in _walk_skipping_defs(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, ast.Call)
+            ):
+                callee = dotted(node.value.func)
+                if callee:
+                    ci = self._resolve_class_expr(mi, callee)
+                    if ci is not None:
+                        found = ci
+        return found
+
+    def _var_type_from_import(self, imp: Tuple[str, Optional[str]]) -> Optional[ClassInfo]:
+        """``from x import VAR [as alias]`` where VAR is a typed
+        module-level variable (e.g. ``default_registry``)."""
+        mod, attr = imp
+        if attr is None:
+            return None
+        target = self.by_dotted.get(mod)
+        if target is None:
+            return None
+        if attr in target.var_types:
+            return target.var_types[attr]
+        inner = target.imports.get(attr)
+        if inner:
+            return self._var_type_from_import(inner)
+        return None
+
+    def _resolve_class_expr(self, mi: ModuleInfo, expr: str) -> Optional[ClassInfo]:
+        parts = expr.split(".")
+        head, rest = parts[0], parts[1:]
+        seen = set()
+        while head in mi.aliases and head not in seen:
+            seen.add(head)
+            head = mi.aliases[head]
+        if not rest:
+            if head in mi.classes:
+                return mi.classes[head]
+            imp = mi.imports.get(head)
+            if imp:
+                return self._class_from_import(imp)
+            return None
+        imp = mi.imports.get(head)
+        if imp is None:
+            return None
+        target = self._module_from_import(imp)
+        if target is None or len(rest) != 1:
+            return None
+        return target.classes.get(rest[0])
+
+    def _module_from_import(self, imp: Tuple[str, Optional[str]]) -> Optional[ModuleInfo]:
+        mod, attr = imp
+        if attr is None:
+            return self.by_dotted.get(mod)
+        return self.by_dotted.get(f"{mod}.{attr}")
+
+    def _class_from_import(self, imp: Tuple[str, Optional[str]]) -> Optional[ClassInfo]:
+        mod, attr = imp
+        if attr is None:
+            return None
+        target = self.by_dotted.get(mod)
+        if target is not None and attr in target.classes:
+            return target.classes[attr]
+        # `from pkg import name` where name is re-exported by __init__:
+        # chase one level of the package's own imports.
+        if target is not None:
+            inner = target.imports.get(attr)
+            if inner:
+                return self._class_from_import(inner)
+        return None
+
+    def _func_from_import(self, imp: Tuple[str, Optional[str]]) -> Optional[FuncInfo]:
+        mod, attr = imp
+        if attr is None:
+            return None
+        target = self.by_dotted.get(mod)
+        if target is None:
+            return None
+        if attr in target.functions:
+            return target.functions[attr]
+        alias = target.aliases.get(attr)
+        if alias and alias in target.functions:
+            return target.functions[alias]
+        inner = target.imports.get(attr)
+        if inner:
+            return self._func_from_import(inner)
+        return None
+
+    # ------------------------------------------------------------------
+    # Local resolution helpers
+    # ------------------------------------------------------------------
+
+    def _local_types(self, fi: FuncInfo) -> Tuple[Dict[str, ClassInfo], Dict[str, LockInfo]]:
+        """Forward scan: local-variable class types and local locks, plus
+        annotated parameters."""
+        types: Dict[str, ClassInfo] = {}
+        locks: Dict[str, LockInfo] = {}
+        for name, names in _param_annotations(fi.node).items():
+            if names:
+                ci = self._resolve_names(fi.module, names)
+                if ci is not None:
+                    types[name] = ci
+        for node in _walk_skipping_defs(fi.node):
+            target = value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target, value = node.target, node.value
+            if not isinstance(target, ast.Name):
+                continue
+            if isinstance(value, ast.Call):
+                factory = _lock_factory_of(value)
+                if factory:
+                    key = f"{fi.module.relpath}:{fi.qual}.<local>{target.id}"
+                    lock = self.locks.get(key)
+                    if lock is None:
+                        lock = LockInfo(key=key, kind=factory)
+                        self.locks[key] = lock
+                    lock.sites.append((fi.module.relpath, value.lineno))
+                    locks[target.id] = lock
+                    continue
+                ci = self._class_of_call(fi, value)
+                if ci is not None:
+                    types[target.id] = ci
+            elif isinstance(value, ast.Attribute):
+                resolved = self._resolve_attr_chain(fi, value, types, locks)
+                if isinstance(resolved, (ClassInfo, UnionClass)):
+                    types[target.id] = resolved
+                elif isinstance(resolved, LockInfo):
+                    locks[target.id] = resolved
+            elif isinstance(value, ast.Name):
+                # `inj = _active` — copy the type of a local, module, or
+                # imported-module variable.
+                src = value.id
+                mi = fi.module
+                if src in types:
+                    types[target.id] = types[src]
+                elif src in locks:
+                    locks[target.id] = locks[src]
+                elif src in mi.var_types:
+                    types[target.id] = mi.var_types[src]
+                elif src in mi.var_locks:
+                    locks[target.id] = mi.var_locks[src]
+                elif src in mi.imports:
+                    ci = self._var_type_from_import(mi.imports[src])
+                    if ci is not None:
+                        types[target.id] = ci
+        return types, locks
+
+    def _class_of_call(self, fi: FuncInfo, call: ast.Call) -> Optional[ClassInfo]:
+        callee = dotted(call.func)
+        if callee is None:
+            return None
+        return self._resolve_class_expr(fi.module, callee)
+
+    def _resolve_attr_chain(self, fi, node: ast.Attribute, types, locks):
+        """Resolve ``self.a.b`` / ``x.a`` to a ClassInfo or LockInfo."""
+        chain: List[str] = []
+        cur: ast.AST = node
+        while isinstance(cur, ast.Attribute):
+            chain.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        chain.reverse()
+        base = cur.id
+        if base in ("self", "cls") and fi.cls is not None:
+            ctx: Optional[ClassInfo] = fi.cls
+        elif base in types:
+            ctx = types[base]
+        elif base in locks and not chain:
+            return locks[base]
+        else:
+            mi = fi.module
+            if base in mi.var_locks and not chain:
+                return mi.var_locks[base]
+            if base in mi.var_types:
+                ctx = mi.var_types[base]
+            elif base in mi.imports:
+                target = self._module_from_import(mi.imports[base])
+                if target is None:
+                    ctx = self._var_type_from_import(mi.imports[base])
+                    if ctx is None:
+                        return None
+                elif not chain:
+                    return None
+                else:
+                    head = chain.pop(0)
+                    if head in target.var_locks and not chain:
+                        return target.var_locks[head]
+                    ctx = target.var_types.get(head)
+                    if ctx is None and not chain and head in target.classes:
+                        return target.classes[head]
+            else:
+                return None
+        for i, attr in enumerate(chain):
+            if ctx is None:
+                return None
+            last = i == len(chain) - 1
+            if last:
+                lock = ctx.attr_lock(attr)
+                if lock is not None:
+                    return lock
+                return ctx.attr_type(attr)
+            ctx = ctx.attr_type(attr)
+        return ctx
+
+    def resolve_lock_expr(self, fi: FuncInfo, expr: ast.AST, types, locks) -> Optional[LockInfo]:
+        if isinstance(expr, ast.Name):
+            if expr.id in locks:
+                return locks[expr.id]
+            return fi.module.var_locks.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            resolved = self._resolve_attr_chain(fi, expr, types, locks)
+            if isinstance(resolved, LockInfo):
+                return resolved
+        return None
+
+    def resolve_calls(self, fi: FuncInfo, call: ast.Call, types, locks) -> List[FuncInfo]:
+        """Every project function this call may reach (virtual dispatch:
+        a method resolved on a base type fans out to its overrides)."""
+        func = call.func
+        mi = fi.module
+
+        def one(x: Optional[FuncInfo]) -> List[FuncInfo]:
+            return [x] if x is not None else []
+
+        def methods_of(ci: ClassInfo, name: str) -> List[FuncInfo]:
+            out = []
+            for owner, fn in ci.find_methods(name):
+                target = self._method_func(owner, fn)
+                if target is not None:
+                    out.append(target)
+            return out
+
+        if isinstance(func, ast.Name):
+            name = func.id
+            seen = set()
+            while name in mi.aliases and name not in seen:
+                seen.add(name)
+                name = mi.aliases[name]
+            cur: Optional[FuncInfo] = fi
+            while cur is not None:
+                if name in cur.nested:
+                    return [cur.nested[name]]
+                cur = self._parent_func(cur)
+            if name in mi.functions:
+                return [mi.functions[name]]
+            if name in mi.classes:
+                return one(self._init_of(mi.classes[name]))
+            if name in types:
+                return one(self._init_of(types[name]))
+            imp = mi.imports.get(name)
+            if imp:
+                target = self._func_from_import(imp)
+                if target is not None:
+                    return [target]
+                ci = self._class_from_import(imp)
+                if ci is not None:
+                    return one(self._init_of(ci))
+            return []
+        if not isinstance(func, ast.Attribute):
+            return []
+        # super().m()
+        if (
+            isinstance(func.value, ast.Call)
+            and isinstance(func.value.func, ast.Name)
+            and func.value.func.id == "super"
+            and fi.cls is not None
+        ):
+            for base in fi.cls.bases:
+                hit = base.find_method(func.attr)
+                if hit is not None:
+                    return one(self._method_func(hit[0], hit[1]))
+            return []
+        method = func.attr
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            base = recv.id
+            if base in ("self", "cls") and fi.cls is not None:
+                # `self` may be any subclass of the enclosing class.
+                hits = methods_of(fi.cls, method)
+                if hits:
+                    return hits
+            if base in types:
+                return methods_of(types[base], method)
+            if base in locks:
+                return []   # lock method (.acquire/.release/.wait/...)
+            imp = mi.imports.get(base)
+            if imp is not None:
+                target = self._module_from_import(imp)
+                if target is not None:
+                    if method in target.functions:
+                        return [target.functions[method]]
+                    if method in target.classes:
+                        return one(self._init_of(target.classes[method]))
+                    alias = target.aliases.get(method)
+                    if alias and alias in target.functions:
+                        return [target.functions[alias]]
+                    inner = target.imports.get(method)
+                    if inner:
+                        hit2 = self._func_from_import(inner)
+                        if hit2 is not None:
+                            return [hit2]
+                        ci = self._class_from_import(inner)
+                        if ci is not None:
+                            return one(self._init_of(ci))
+                    return []
+                ci = self._class_from_import(imp)
+                if ci is not None:
+                    return methods_of(ci, method)
+                ci = self._var_type_from_import(imp)
+                if ci is not None:
+                    return methods_of(ci, method)
+                return []
+            if base in mi.var_types:
+                return methods_of(mi.var_types[base], method)
+            if base in mi.classes:
+                return methods_of(mi.classes[base], method)
+            return []
+        if isinstance(recv, ast.Attribute):
+            ctx = self._resolve_attr_chain(fi, recv, types, locks)
+            if isinstance(ctx, (ClassInfo, UnionClass)):
+                return methods_of(ctx, method)
+            if isinstance(ctx, LockInfo):
+                return []
+        return []
+
+    def _parent_func(self, fi: FuncInfo) -> Optional[FuncInfo]:
+        if "." not in fi.qual:
+            return None
+        parent_qual = fi.qual.rsplit(".", 1)[0]
+        return self.funcs.get(f"{fi.module.relpath}:{parent_qual}")
+
+    def _init_of(self, ci: ClassInfo) -> Optional[FuncInfo]:
+        hit = ci.find_method("__init__")
+        if hit is None:
+            return None
+        return self._method_func(hit[0], hit[1])
+
+    def _method_func(self, ci: ClassInfo, fn: ast.FunctionDef) -> Optional[FuncInfo]:
+        return self.funcs.get(f"{ci.module.relpath}:{ci.name}.{fn.name}")
+
+    # ------------------------------------------------------------------
+    # Blocking-operation classification (for calls that do NOT resolve
+    # to a project function — project calls carry their own summaries)
+    # ------------------------------------------------------------------
+
+    def classify_blocking(self, fi: FuncInfo, call: ast.Call, types, locks) -> Optional[Block]:
+        name = dotted(call.func) or ""
+        leaf = name.split(".")[-1] if name else (
+            call.func.attr if isinstance(call.func, ast.Attribute) else ""
+        )
+        kwargs = {k.arg for k in call.keywords}
+        npos = len(call.args)
+
+        def bounded_by_timeout() -> bool:
+            if "timeout" in kwargs:
+                kw = next(k for k in call.keywords if k.arg == "timeout")
+                return not (isinstance(kw.value, ast.Constant) and kw.value.value is None)
+            return False
+
+        if leaf in _NET_LEAVES:
+            return Block(f"{leaf}() [network I/O]", frozenset(), f"{leaf}()")
+        if name in _SUBPROCESS_CALLS:
+            if bounded_by_timeout():
+                return None   # bounded build/tool invocation
+            return Block(f"{name}() [subprocess]", frozenset(), f"{name}()")
+        if leaf == "communicate":
+            if bounded_by_timeout():
+                return None
+            return Block("Popen.communicate() [subprocess]", frozenset(), "communicate()")
+        if leaf in ("serve_forever", "handle_request"):
+            return Block(f"{leaf}() [server loop]", frozenset(), f"{leaf}()")
+        if leaf == "select" and name.startswith("select."):
+            if npos < 4:
+                return Block("select.select() without timeout", frozenset(), "select.select()")
+            return None
+        if leaf in _SOCKET_LEAVES:
+            if any(name.startswith(p) for p in _NOT_SOCKET_PREFIXES):
+                return None
+            return Block(f".{leaf}() [socket I/O]", frozenset(), f".{leaf}()")
+        if leaf == "get" and npos == 0 and not kwargs:
+            return Block("queue .get() without timeout", frozenset(), ".get()")
+        if leaf == "join" and npos == 0:
+            if bounded_by_timeout():
+                return None
+            if not kwargs:
+                return Block(".join() without timeout", frozenset(), ".join()")
+            return None
+        if leaf == "result" and npos == 0 and not bounded_by_timeout() and "timeout" not in kwargs:
+            if isinstance(call.func, ast.Attribute):
+                return Block("Future.result() without timeout", frozenset(), ".result()")
+            return None
+        if leaf == "wait":
+            if bounded_by_timeout():
+                return None
+            if npos:
+                first = call.args[0]
+                if not (isinstance(first, ast.Constant) and first.value is None):
+                    return None  # wait(secs) is bounded
+            if not isinstance(call.func, ast.Attribute):
+                return None
+            lock = self.resolve_lock_expr(fi, call.func.value, types, locks)
+            if lock is not None:
+                # Condition.wait releases its own lock while blocked.
+                return Block(
+                    ".wait() without timeout [condition]",
+                    frozenset({lock.base().key}),
+                    ".wait()",
+                )
+            return Block(".wait() without timeout", frozenset(), ".wait()")
+        return None
+
+    # ------------------------------------------------------------------
+    # Pass 3a: per-function direct facts (calls, blocking ops, acquires)
+    # ------------------------------------------------------------------
+
+    def _collect_direct(self, fi: FuncInfo) -> None:
+        types, locks = self._local_types(fi)
+        fi._types, fi._locks = types, locks  # cached for the emit pass
+        for call in _calls_in(fi.node):
+            targets = self.resolve_calls(fi, call, types, locks)
+            for target in targets:
+                if target is not fi:
+                    fi.calls.append((call, target))
+            # retry_call resolves to the project's own retry loop, but its
+            # payload is a dynamic callable (the transport) the resolver
+            # cannot see — the call is still network-blocking.  Classify
+            # it (and any other *resolved* net leaf) in addition to
+            # following its body for lock edges.
+            name = dotted(call.func) or ""
+            if not targets or (name.split(".")[-1] in _NET_LEAVES):
+                block = self.classify_blocking(fi, call, types, locks)
+                if block is not None:
+                    fi.direct_blocks.append((call, block))
+        for node in _walk_skipping_defs(fi.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lock = self.resolve_lock_expr(fi, item.context_expr, types, locks)
+                    if lock is not None:
+                        fi.direct_acquires.append((lock, item.context_expr))
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr == "acquire":
+                    lock = self.resolve_lock_expr(fi, node.func.value, types, locks)
+                    if lock is not None:
+                        fi.direct_acquires.append((lock, node))
+
+    # ------------------------------------------------------------------
+    # Pass 3b: transitive summaries (fixpoint over the call graph)
+    # ------------------------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        for fi in self.funcs.values():
+            for call, block in fi.direct_blocks:
+                fi.blocks.setdefault((block.desc, block.releases), block)
+            for lock, node in fi.direct_acquires:
+                base = lock.base()
+                fi.acquires.setdefault(
+                    base.key,
+                    (f"{fi.qual}", (fi.module.relpath, getattr(node, "lineno", 1))),
+                )
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.funcs.values():
+                for _call, target in fi.calls:
+                    if target.is_generator:
+                        continue
+                    for (desc, releases), block in target.blocks.items():
+                        key = (desc, releases)
+                        if key not in fi.blocks:
+                            fi.blocks[key] = Block(
+                                desc, releases, f"{target.qual} -> {block.chain}"
+                            )
+                            changed = True
+                    for lockkey, (chain, site) in target.acquires.items():
+                        if lockkey not in fi.acquires:
+                            chained = chain if chain.startswith(target.qual) else f"{target.qual} -> {chain}"
+                            fi.acquires[lockkey] = (chained, site)
+                            changed = True
+
+    # ------------------------------------------------------------------
+    # Pass 3c: region walk — findings + lock-order edges
+    # ------------------------------------------------------------------
+
+    def _emit(self, fi: FuncInfo) -> None:
+        self._walk_body(fi, list(fi.node.body), [])
+
+    def _walk_body(self, fi: FuncInfo, body: List[ast.stmt], held) -> None:
+        i = 0
+        while i < len(body):
+            stmt = body[i]
+            acquired = self._manual_acquire(fi, stmt)
+            if acquired is not None:
+                lock, node = acquired
+                self._note_acquire(fi, lock, node, held)
+                rest = body[i + 1 :]
+                cut = len(rest)
+                for j, s in enumerate(rest):
+                    if self._manual_release(fi, s) is lock:
+                        cut = j
+                        break
+                self._walk_body(fi, rest[:cut], held + [(lock, node)])
+                i += 1 + cut
+                continue
+            self._walk_stmt(fi, stmt, held)
+            i += 1
+
+    def _manual_acquire(self, fi: FuncInfo, stmt: ast.stmt):
+        call = None
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+        elif isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+        if (
+            call is not None
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr == "acquire"
+        ):
+            lock = self.resolve_lock_expr(fi, call.func.value, fi._types, fi._locks)
+            if lock is not None:
+                return lock, call
+        return None
+
+    def _manual_release(self, fi: FuncInfo, stmt: ast.stmt):
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+            and stmt.value.func.attr == "release"
+        ):
+            return self.resolve_lock_expr(fi, stmt.value.func.value, fi._types, fi._locks)
+        return None
+
+    def _walk_stmt(self, fi: FuncInfo, stmt: ast.stmt, held) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.With):
+            entered = list(held)
+            for item in stmt.items:
+                self._scan_expr(fi, item.context_expr, entered)
+                lock = self.resolve_lock_expr(
+                    fi, item.context_expr, fi._types, fi._locks
+                )
+                if lock is not None:
+                    self._note_acquire(fi, lock, item.context_expr, entered)
+                    entered = entered + [(lock, item.context_expr)]
+            self._walk_body(fi, list(stmt.body), entered)
+            return
+        for expr in _stmt_exprs(stmt):
+            self._scan_expr(fi, expr, held)
+        for sub_body in _stmt_bodies(stmt):
+            self._walk_body(fi, list(sub_body), held)
+
+    def _note_acquire(self, fi: FuncInfo, lock: LockInfo, node: ast.AST, held) -> None:
+        base = lock.base()
+        for h, _n in held:
+            self._add_edge(
+                h.base().key, base.key, fi.module.relpath,
+                getattr(node, "lineno", 1), fi.qual,
+            )
+
+    def _add_edge(self, src: str, dst: str, relpath: str, line: int, chain: str) -> None:
+        mi = self.modules.get(relpath)
+        if mi is not None and mi.module.suppressed(RULE_ORDER, line):
+            return
+        self.edges.setdefault((src, dst), Edge(src, dst, relpath, line, chain))
+
+    def _scan_expr(self, fi: FuncInfo, expr: ast.AST, held) -> None:
+        for call in _calls_in_expr(expr):
+            targets = self.resolve_calls(fi, call, fi._types, fi._locks)
+            if targets:
+                if not held:
+                    continue
+                for target in targets:
+                    if target is fi or target.is_generator:
+                        continue
+                    for (desc, releases), block in target.blocks.items():
+                        self._report_blocking(fi, call, held, Block(
+                            desc, releases, f"{target.qual} -> {block.chain}"
+                        ))
+                    for lockkey, (chain, _site) in target.acquires.items():
+                        for h, _n in held:
+                            # Self-edges (same lock class re-acquired) stay
+                            # in the graph for witness parity; DF009 skips
+                            # them when hunting cycles.
+                            self._add_edge(
+                                h.base().key, lockkey, fi.module.relpath,
+                                call.lineno, f"{fi.qual} -> {chain}",
+                            )
+            if held and (not targets or (dotted(call.func) or "").split(".")[-1] in _NET_LEAVES):
+                block = self.classify_blocking(fi, call, fi._types, fi._locks)
+                if block is not None:
+                    self._report_blocking(fi, call, held, block)
+
+    def _report_blocking(self, fi: FuncInfo, call: ast.Call, held, block: Block) -> None:
+        module = fi.module.module
+        if module.suppressed(RULE_BLOCKING, call.lineno):
+            return
+        blocked = [
+            h for h, _n in held if h.base().key not in block.releases
+        ]
+        if not blocked:
+            return
+        dedupe = (
+            fi.module.relpath, call.lineno, block.desc,
+            frozenset(h.base().key for h in blocked),
+        )
+        if dedupe in self._reported:
+            return
+        self._reported.add(dedupe)
+        names = ", ".join(sorted({h.base().key.split(":", 1)[1] for h in blocked}))
+        self._findings.append(
+            Finding(
+                rule=RULE_BLOCKING,
+                path=fi.module.relpath,
+                line=call.lineno,
+                col=call.col_offset + 1,
+                message=(
+                    f"{block.desc} while holding {names} "
+                    f"(chain: {fi.qual} -> {block.chain})"
+                ),
+                qual=module.qualname(call),
+            )
+        )
+
+    # ------------------------------------------------------------------
+    # DF009 — cycles in the lock-order graph
+    # ------------------------------------------------------------------
+
+    def _emit_cycles(self) -> None:
+        adj: Dict[str, Set[str]] = {}
+        for (src, dst) in self.edges:
+            if src != dst:
+                adj.setdefault(src, set()).add(dst)
+                adj.setdefault(dst, set())
+        for scc in _tarjan(adj):
+            if len(scc) < 2:
+                continue
+            cycle = _concrete_cycle(adj, scc)
+            edge_list = [
+                self.edges[(cycle[i], cycle[(i + 1) % len(cycle)])]
+                for i in range(len(cycle))
+                if (cycle[i], cycle[(i + 1) % len(cycle)]) in self.edges
+            ]
+            if not edge_list:
+                continue
+            anchor = min(edge_list, key=lambda e: (e.relpath, e.line))
+            detail = "; ".join(
+                f"{e.src.split(':', 1)[1]} -> {e.dst.split(':', 1)[1]} "
+                f"({e.relpath}:{e.line})"
+                for e in edge_list
+            )
+            mi = self.modules.get(anchor.relpath)
+            qual = "<module>"
+            if mi is not None:
+                fn = self.funcs.get(f"{anchor.relpath}:{anchor.chain.split(' ->')[0]}")
+                qual = fn.qual if fn is not None else anchor.chain.split(" ->")[0]
+            self._findings.append(
+                Finding(
+                    rule=RULE_ORDER,
+                    path=anchor.relpath,
+                    line=anchor.line,
+                    col=1,
+                    message=f"lock-order inversion: {detail}",
+                    qual=qual,
+                )
+            )
+
+    # ------------------------------------------------------------------
+    # Public surface
+    # ------------------------------------------------------------------
+
+    def findings(self) -> List[Finding]:
+        return list(self._findings)
+
+    def creation_site_index(self) -> Dict[Tuple[str, int], str]:
+        """(relpath, lineno) of every ``threading.X()`` creation call →
+        lock-class key.  The dynamic witness maps runtime locks through
+        this; an unknown site there means the static pass missed a lock."""
+        out: Dict[Tuple[str, int], str] = {}
+        for lock in self.locks.values():
+            for site in lock.sites:
+                out[site] = lock.base().key
+        return out
+
+    def edge_keys(self) -> Set[Tuple[str, str]]:
+        return set(self.edges)
+
+    def lock_graph_dot(self) -> str:
+        lines = ["digraph lock_order {", '  rankdir="LR";']
+        nodes = sorted({k for e in self.edges for k in (e[0], e[1])})
+        for n in nodes:
+            label = n.split(":", 1)[1]
+            lines.append(f'  "{n}" [label="{label}"];')
+        for (src, dst), e in sorted(self.edges.items()):
+            lines.append(f'  "{src}" -> "{dst}" [label="{e.relpath}:{e.line}"];')
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+    def lock_graph_markdown(self) -> str:
+        """The committed lock-hierarchy table (DESIGN.md §16): one row per
+        ordering edge, sorted, stable across runs."""
+        rows = ["| held lock | then acquires | edge site |", "| --- | --- | --- |"]
+        for (src, dst), e in sorted(self.edges.items()):
+            rows.append(
+                f"| `{src.split(':', 1)[1]}` ({src.split(':', 1)[0]}) "
+                f"| `{dst.split(':', 1)[1]}` ({dst.split(':', 1)[0]}) "
+                f"| {e.relpath}:{e.line} |"
+            )
+        return "\n".join(rows) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# AST traversal helpers
+# ---------------------------------------------------------------------------
+
+
+def _param_annotations(fn: ast.FunctionDef) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = {}
+    args = fn.args
+    for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+        out[a.arg] = _ann_names(a.annotation) if a.annotation else []
+    return out
+
+
+def _walk_skipping_defs(fn: ast.FunctionDef):
+    """Every node inside ``fn`` but not inside a nested def/class/lambda."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _calls_in(fn: ast.FunctionDef):
+    for node in _walk_skipping_defs(fn):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _calls_in_expr(expr: ast.AST):
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _stmt_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """Expressions evaluated by ``stmt`` itself (not its nested bodies)."""
+    out: List[ast.AST] = []
+    for name, value in ast.iter_fields(stmt):
+        if name in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.expr):
+            out.append(value)
+        elif isinstance(value, list):
+            out.extend(v for v in value if isinstance(v, ast.expr))
+    return out
+
+
+def _stmt_bodies(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    out: List[List[ast.stmt]] = []
+    for name in ("body", "orelse", "finalbody"):
+        block = getattr(stmt, name, None)
+        if block:
+            out.append(block)
+    for handler in getattr(stmt, "handlers", []) or []:
+        out.append(handler.body)
+    return out
+
+
+def _tarjan(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # Iterative Tarjan (the lock graph is small, but recursion depth
+        # should not depend on it).
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def _concrete_cycle(adj: Dict[str, Set[str]], scc: List[str]) -> List[str]:
+    """A simple cycle inside ``scc`` for the report (DFS back to start)."""
+    members = set(scc)
+    start = sorted(scc)[0]
+    path = [start]
+    seen = {start}
+
+    def dfs(v: str) -> Optional[List[str]]:
+        for w in sorted(adj.get(v, ())):
+            if w == start and len(path) > 1:
+                return list(path)
+            if w in members and w not in seen:
+                seen.add(w)
+                path.append(w)
+                hit = dfs(w)
+                if hit is not None:
+                    return hit
+                path.pop()
+                seen.discard(w)
+        return None
+
+    return dfs(start) or [start]
+
+
+# ---------------------------------------------------------------------------
+# Runner integration
+# ---------------------------------------------------------------------------
+
+
+def witness_gaps(
+    program: Program,
+    dynamic_edges: Dict[Tuple[Tuple[str, int], Tuple[str, int]], str],
+    static_edges: Optional[Set[Tuple[str, str]]] = None,
+) -> List[str]:
+    """Cross-validate dynamically-observed acquisition-order edges (from
+    ``dragonfly2_tpu.utils.dflock``) against the static lock graph.
+
+    Returns human-readable gap descriptions; empty means every runtime
+    edge is explained by the static analysis.  A non-empty result is a
+    RESOLVER BUG (missed call edge, missed lock creation, missed type),
+    not an application bug — the tier-1 cross-check turns it into a test
+    failure so the analyzer cannot silently rot.
+
+    ``static_edges`` overrides the program's own edge set (used by the
+    mutation-sensitivity tests to prove the check actually bites).
+
+    Self-edges (same lock *class* on both ends) are skipped: two runtime
+    instances of one class are indistinguishable statically.
+    """
+    index = program.creation_site_index()
+    edges = program.edge_keys() if static_edges is None else static_edges
+    gaps: List[str] = []
+    for (src, dst), where in sorted(dynamic_edges.items()):
+        src_key = index.get(src)
+        dst_key = index.get(dst)
+        if src_key is None:
+            gaps.append(
+                f"unknown lock creation site {src[0]}:{src[1]} "
+                f"(held side; first observed by {where})"
+            )
+            continue
+        if dst_key is None:
+            gaps.append(
+                f"unknown lock creation site {dst[0]}:{dst[1]} "
+                f"(acquired side; first observed by {where})"
+            )
+            continue
+        if src_key == dst_key:
+            continue
+        if (src_key, dst_key) not in edges:
+            gaps.append(
+                f"dynamic edge {src_key} -> {dst_key} missing from the "
+                f"static lock graph (observed: {where}; acquired at "
+                f"{dst[0]}:{dst[1]} while holding lock from {src[0]}:{src[1]})"
+            )
+    return gaps
+
+
+def run_program(paths: Iterable[Path], root: Path) -> Program:
+    return Program.from_paths(paths, root)
+
+
+def program_findings(paths: Iterable[Path], root: Path) -> List[Finding]:
+    return run_program(paths, root).findings()
