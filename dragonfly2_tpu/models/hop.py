@@ -71,6 +71,15 @@ def precompute_hop_features(
     )
 
 
+# THE cached jit of the replicated precompute (static hops ⇒ one traced
+# program per hop count for the whole process).  Construct-per-call
+# (`jax.jit(precompute_hop_features)(...)`) throws the compile cache away
+# with the wrapper — dflint DF010 flags it; import this instead.
+precompute_hop_features_jit = jax.jit(
+    precompute_hop_features, static_argnames="hops"
+)
+
+
 def _hop_parts(x, mask, edge_feats, gather, hops: int) -> jax.Array:
     """THE hop-aggregation math, shared between the replicated precompute
     and the node-sharded one (parallel/graph_sharding.py) so the two stay

@@ -181,6 +181,12 @@ def segment_sum_pallas(
                 f"bucket_edges_by_block with the same block sizes"
             )
         vals = values
+    elif values.shape[0] == 0:
+        # Zero edges: every bucketed slot is padding (weight 0), but the
+        # pad perm indexes row 0, which doesn't exist — jnp.take would
+        # refuse.  The kernel still runs one all-padding block per node
+        # block so the is_first visit zero-inits every output tile.
+        vals = jnp.zeros((len(perm),) + tuple(values.shape[1:]), values.dtype)
     else:
         vals = jnp.take(values, jnp.asarray(perm), axis=0)   # [E_pad, D]
     return _segment_sum_bucketed(

@@ -1,0 +1,150 @@
+"""DF012 columnar dtype/shape contract registry — declared ONCE, checked twice.
+
+Every columnar surface the TPU loop depends on (DFC1 record files, the
+HostFeatureCache slot matrix, scorer blob arrays, the pallas kernel
+outputs) declares its dtype contract here, in one literal dict:
+
+- **statically**, ``tools/dflint/tracerules.py`` (rule DF012) parses this
+  file's AST (``ast.literal_eval`` — no import, dflint stays stdlib-only)
+  and checks every producer/consumer seam named below: creation-site
+  dtype pins for slot columns, constructor/param defaults, float64 leaks
+  (x64 is off — a float64 request silently truncates under jit, and on
+  host it doubles DFC1 row width), and implicit-float64 array
+  constructors (``np.zeros(n)`` defaults to float64).
+- **dynamically**, tests import this module and assert the live objects
+  agree: ``records.features.DOWNLOAD_COLUMNS`` must equal the declared
+  column list, kernel outputs must come back in the declared dtype for
+  empty/single/bf16 inputs (tests/test_ops.py), so kernel and contract
+  cannot drift apart.
+
+Because dflint evaluates ``CONTRACTS`` with ``ast.literal_eval``, the
+dict MUST stay a pure literal: no names, calls, or comprehensions.
+
+Entry shapes (all fields optional except the key):
+
+- ``file``      — repo-relative path the entry's code lives in;
+- ``columns``   — the declared column-name list (runtime-asserted);
+- ``dtype``     — the contract dtype for produced arrays;
+- ``allow``     — extra dtype names reviewed as legitimate in these
+                  functions (documented widened intermediate math, e.g.
+                  float64 accumulation that rounds once on assignment);
+- ``functions`` — producer/consumer functions scanned for dtype leaks;
+- ``attrs``     — ``"Class.attr" -> dtype`` creation-site pins;
+- ``defaults``  — ``"Class.field"`` / ``"Class.fn.param"`` -> required
+                  literal default.
+"""
+
+from __future__ import annotations
+
+CONTRACTS = {
+    # -- DFC1 download rows (records/features.py + records/columnar.py) ----
+    "dfc1.download": {
+        "file": "dragonfly2_tpu/records/features.py",
+        "dtype": "float32",
+        # STRICT: the reviewed float64 intermediates in
+        # edge_features_batch carry inline `# dflint: disable=DF012`
+        # pragmas instead of a blanket allow, so widening any OTHER
+        # construction to float64 still fails by contract name.
+        "functions": [
+            "download_to_rows",
+            "host_features",
+            "edge_features",
+            "edge_features_batch",
+            "mask_post_hoc",
+        ],
+        "columns": [
+            "src_bucket", "dst_bucket",
+            "child_cpu_percent", "child_mem_used_percent",
+            "child_disk_used_percent", "child_tcp_conn_log",
+            "child_upload_tcp_conn_log", "child_upload_load",
+            "child_upload_success_ratio", "child_upload_count_log",
+            "child_type_normal", "child_type_super", "child_type_strong",
+            "child_type_weak",
+            "parent_cpu_percent", "parent_mem_used_percent",
+            "parent_disk_used_percent", "parent_tcp_conn_log",
+            "parent_upload_tcp_conn_log", "parent_upload_load",
+            "parent_upload_success_ratio", "parent_upload_count_log",
+            "parent_type_normal", "parent_type_super", "parent_type_strong",
+            "parent_type_weak",
+            "same_idc", "location_affinity", "piece_count_log",
+            "mean_piece_size_log", "content_length_log",
+            "finished_piece_ratio", "parent_cost_log_s",
+            "parent_upload_pieces_log",
+            "target_log_bw",
+        ],
+    },
+    "dfc1.topology": {
+        "file": "dragonfly2_tpu/records/features.py",
+        "dtype": "float32",
+        "functions": ["topology_to_rows"],
+        "columns": [
+            "src_bucket", "dst_bucket", "avg_rtt_norm", "src_tcp_conn_log",
+            "dst_tcp_conn_log", "same_idc", "location_affinity", "freshness",
+        ],
+    },
+    "dfc1.file": {
+        "file": "dragonfly2_tpu/records/columnar.py",
+        "dtype": "float32",
+        "defaults": {
+            "ColumnarHeader.dtype": "float32",
+            "ColumnarWriter.__init__.dtype": "float32",
+        },
+    },
+    # -- HostFeatureCache slot matrix (scheduler/featcache.py) -------------
+    "featcache.slots": {
+        "file": "dragonfly2_tpu/scheduler/featcache.py",
+        "attrs": {
+            "HostFeatureCache._matrix": "float32",
+            "HostFeatureCache._bucket_col": "int64",
+            "HostFeatureCache._idc_col": "int64",
+            "HostFeatureCache._loc_col": "int64",
+        },
+    },
+    # -- scorer blob arrays (trainer/export.py) ----------------------------
+    "scorer.mlp": {
+        "file": "dragonfly2_tpu/trainer/export.py",
+        "dtype": "float32",
+        # STRICT: feature_snapshot_stats' float64 binning carries inline
+        # pragmas (rounds once on return) — see dfc1.download.
+        "functions": [
+            "_flatten_mlp_params",
+            "export_mlp_scorer",
+            "export_from_state",
+            "feature_snapshot_stats",
+            "_pack",
+            "load_scorer",
+            "MLPScorer.score",
+            "MLPScorer._serving_weights",
+        ],
+    },
+    "scorer.gnn": {
+        "file": "dragonfly2_tpu/trainer/export.py",
+        "dtype": "float32",
+        "functions": [
+            "export_gnn_scorer",
+            "gnn_scorer_to_bytes",
+            "GNNScorer.score",
+            "GNNScorer._lookup",
+            "GNNScorer.__post_init__",
+        ],
+    },
+    # -- TPU kernels (ops/) -------------------------------------------------
+    "ops.segment_sum": {
+        "file": "dragonfly2_tpu/ops/pallas_segment.py",
+        "dtype": "float32",
+        # exact=False runs native bf16 MXU passes with f32 accumulate.
+        "allow": ["bfloat16"],
+        "functions": [
+            "bucket_edges_by_block",
+            "_segment_kernel",
+            "segment_sum_pallas",
+            "_segment_sum_bucketed",
+            "make_neighbor_gather",
+        ],
+    },
+    "ops.transpose_gather": {
+        "file": "dragonfly2_tpu/ops/transpose_gather.py",
+        "dtype": "float32",
+        "functions": ["build_transpose_table", "make_transpose_gather"],
+    },
+}

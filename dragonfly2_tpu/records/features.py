@@ -225,8 +225,11 @@ def edge_features_batch(  # dflint: hotpath
         out = np.empty((n, EDGE_FEATURE_DIM), dtype=np.float32)
     out[:, 0] = same_idc
     out[:, 1] = location_affinity
-    counts = np.asarray(served_counts, dtype=np.float64)
-    lens = np.asarray(served_len_sums, dtype=np.float64)
+    # dflint: disable=DF012 pragmas below: reviewed float64 INTERMEDIATES
+    # — the scalar path's math runs in float64 and each column takes one
+    # float32 rounding on assignment into `out` (byte-parity contract).
+    counts = np.asarray(served_counts, dtype=np.float64)  # dflint: disable=DF012
+    lens = np.asarray(served_len_sums, dtype=np.float64)  # dflint: disable=DF012
     out[:, 2] = np.log1p(counts)
     out[:, 3] = np.where(
         counts > 0, np.log1p(lens / np.maximum(counts, 1.0)), 0.0
@@ -234,13 +237,13 @@ def edge_features_batch(  # dflint: hotpath
     out[:, 4] = math.log1p(max(content_length, 0))
     total = max(total_piece_count, 1)
     out[:, 5] = np.minimum(
-        np.asarray(finished_piece_counts, dtype=np.float64) / total, 1.0
+        np.asarray(finished_piece_counts, dtype=np.float64) / total, 1.0  # dflint: disable=DF012
     )
     out[:, 6] = np.log1p(
-        np.maximum(np.asarray(cost_ns, dtype=np.float64), 0) / 1e9
+        np.maximum(np.asarray(cost_ns, dtype=np.float64), 0) / 1e9  # dflint: disable=DF012
     )
     out[:, 7] = np.log1p(
-        np.maximum(np.asarray(upload_piece_counts, dtype=np.float64), 0)
+        np.maximum(np.asarray(upload_piece_counts, dtype=np.float64), 0)  # dflint: disable=DF012
     )
     return out
 

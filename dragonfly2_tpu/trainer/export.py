@@ -144,11 +144,13 @@ def feature_snapshot_stats(
     so every feature's expected mass is ~uniform regardless of scale;
     constant features degenerate to one occupied bin, which PSI handles
     (the serve side bins with the SAME edges)."""
-    rows = np.asarray(feature_rows, dtype=np.float64)
+    # Reviewed float64 binning intermediates: quantile edges/fractions
+    # compute in float64 and round ONCE to float32 on return.
+    rows = np.asarray(feature_rows, dtype=np.float64)  # dflint: disable=DF012
     d = rows.shape[1]
     qs = np.linspace(0.0, 1.0, n_bins + 1)
     edges = np.quantile(rows, qs, axis=0).T  # [D, B+1]
-    fracs = np.empty((d, n_bins), dtype=np.float64)
+    fracs = np.empty((d, n_bins), dtype=np.float64)  # dflint: disable=DF012
     for j in range(d):  # per-FEATURE (32 fixed), export time only
         idx = np.searchsorted(edges[j, 1:-1], rows[:, j])
         fracs[j] = np.bincount(idx, minlength=n_bins) / rows.shape[0]

@@ -46,14 +46,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.gnn import NeighborTable, build_neighbor_table
-from ..models.hop import HopConfig, HopRanker, precompute_hop_features
+from ..models.hop import HopConfig, HopRanker
+# Hoisted + static-hops so every snapshot build hits ONE traced program —
+# the single cached wrapper shared with trainer/train.py (one DF010
+# compile-budget site instead of one per importer).
+from ..models.hop import precompute_hop_features_jit as _precompute_jit
 from ..parallel.mesh import MODEL_AXIS
 from .train import TrainConfig, TrainState, _graph_train_step, _make_optimizer
 
 logger = logging.getLogger(__name__)
-
-# Hoisted + static-hops so every snapshot build hits ONE traced program.
-_precompute_jit = jax.jit(precompute_hop_features, static_argnames="hops")
 
 
 def state_hash(state) -> str:

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -347,7 +346,7 @@ def train_hop_ranker(
     once, use twice).  ``node_sharding="model"`` partitions the hop
     features and embedding table by node over the mesh's model axis —
     the config[4] scale mode where node tables exceed one chip's HBM."""
-    from ..models.hop import HopConfig, HopRanker, precompute_hop_features
+    from ..models.hop import HopConfig, HopRanker, precompute_hop_features_jit
 
     cfg = config or TrainConfig()
     mcfg = model_config or HopConfig()
@@ -375,9 +374,13 @@ def train_hop_ranker(
                 axis=MODEL_AXIS,
             )
         else:
+            # The module-level cached jit (models/hop.py): a per-call
+            # jax.jit(partial(...)) here compiled a throwaway program per
+            # train_hop_ranker invocation (DF010).
             hop_feats = np.asarray(
-                jax.jit(partial(precompute_hop_features, hops=mcfg.hops))(
-                    jnp.asarray(node_feats, jnp.float32), table
+                precompute_hop_features_jit(
+                    jnp.asarray(node_feats, jnp.float32), table,
+                    hops=mcfg.hops,
                 )
             )
     model = HopRanker(mcfg)

@@ -1,0 +1,217 @@
+"""Dynamic compile witness: runtime validation of jit trace discipline.
+
+``tools/dflint/tracerules.py`` (DF010) statically indexes every
+``jax.jit``/``pjit`` construction site and ``tools/dflint/
+compile_budget.toml`` bounds how many XLA compiles one creation at each
+site may trigger.  Static analysis can rot silently — a construction the
+resolver misses, or a cached callable that quietly starts retracing per
+call (shape churn, a lost ``static_argnums``), changes nothing in the
+lint.  This module closes that loop, in the mould of the lock witness
+(``utils/dflock.py``):
+
+in witness mode (installed by ``tests/conftest.py`` before any project
+import) ``jax.jit`` is replaced by a factory that, for constructions
+issued **from project code**, wraps the returned jitted callable in a
+counting proxy.  Per creation site ``(relpath, lineno)`` — exactly the
+identity the static index records — it tracks creations, calls, and the
+maximum number of XLA compiles any single creation triggered (read from
+the jitted function's own ``_cache_size()``; a signature-set fallback
+covers jax builds without it).
+
+``tests/test_zz_compilewitness.py`` then asserts that every observed
+creation site maps into the static index (an unknown site is a per-call
+construction or a resolver blind spot — fix tracerules, never the test)
+and that every per-creation compile count fits the checked-in budget (a
+steady-state path that recompiles per call fails BY FUNCTION NAME).
+
+Design constraints, mirroring dflock:
+
+- **foreign creations are untouched** — jit calls issued from jax, flax,
+  optax or test code get the raw jitted function back, zero overhead;
+- **the proxy is transparent** — ``lower``/``clear_cache``/attributes
+  delegate to the real jitted callable; only ``__call__`` adds a counter
+  read, and counting failures never break the call;
+- **recording is thread-safe** — the training threads that drive jitted
+  steps share one lock-guarded stats table.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+Site = Tuple[str, int]          # (repo-relative path, lineno) of the creation
+
+
+class SiteStats:
+    __slots__ = ("creations", "calls", "max_compiles")
+
+    def __init__(self) -> None:
+        self.creations = 0
+        self.calls = 0
+        self.max_compiles = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return {
+            "creations": self.creations,
+            "calls": self.calls,
+            "max_compiles": self.max_compiles,
+        }
+
+
+class CompileWitness:
+    """Global per-creation-site compile statistics."""
+
+    def __init__(self, package_dir: str) -> None:
+        self.package_dir = os.path.abspath(package_dir)
+        self.repo_root = os.path.dirname(self.package_dir)
+        self._mu = threading.Lock()
+        self.stats: Dict[Site, SiteStats] = {}
+
+    def site_of_frame(self, frame) -> Optional[Site]:
+        filename = os.path.abspath(frame.f_code.co_filename)
+        if not filename.startswith(self.package_dir + os.sep):
+            return None
+        rel = os.path.relpath(filename, self.repo_root).replace(os.sep, "/")
+        return (rel, frame.f_lineno)
+
+    def note_creation(self, site: Site) -> SiteStats:
+        with self._mu:
+            st = self.stats.get(site)
+            if st is None:
+                st = self.stats[site] = SiteStats()
+            st.creations += 1
+            return st
+
+    def note_call(self, site: Site, compiles: int) -> None:
+        with self._mu:
+            st = self.stats.get(site)
+            if st is None:  # pragma: no cover — creation always precedes
+                st = self.stats[site] = SiteStats()
+            st.calls += 1
+            if compiles > st.max_compiles:
+                st.max_compiles = compiles
+
+    def snapshot(self) -> Dict[Site, Dict[str, int]]:
+        with self._mu:
+            return {site: st.as_dict() for site, st in self.stats.items()}
+
+    def total_compiles(self) -> int:
+        """Sum of max-compiles over sites — a cheap monotone proxy for
+        'any steady-state recompile happened since the last snapshot'
+        (tools/bench_sched.py brackets measured rounds with it)."""
+        with self._mu:
+            return sum(st.max_compiles for st in self.stats.values())
+
+    def reset(self) -> None:
+        with self._mu:
+            self.stats.clear()
+
+
+class _JitProxy:
+    """Counts compiles around a real jitted callable; delegates the rest."""
+
+    __slots__ = ("_jitted", "_site", "_w", "_sigs", "_compiles")
+
+    def __init__(self, jitted, site: Site, witness: CompileWitness) -> None:
+        object.__setattr__(self, "_jitted", jitted)
+        object.__setattr__(self, "_site", site)
+        object.__setattr__(self, "_w", witness)
+        object.__setattr__(self, "_sigs", set())
+        object.__setattr__(self, "_compiles", 0)
+
+    def _count_compiles(self, args, kwargs) -> int:
+        jitted = self._jitted
+        cache_size = getattr(jitted, "_cache_size", None)
+        if cache_size is not None:
+            try:
+                return int(cache_size())
+            except Exception:  # dflint: disable=DF001 — diagnostics only; fall through to the signature fallback
+                pass
+        # Fallback: count distinct abstract signatures ourselves.
+        try:
+            import jax
+
+            leaves = jax.tree_util.tree_leaves((args, kwargs))
+            sig = tuple(
+                (getattr(x, "shape", None), str(getattr(x, "dtype", type(x))))
+                for x in leaves
+            )
+            self._sigs.add(sig)
+            return len(self._sigs)
+        except Exception:  # dflint: disable=DF001 — diagnostics only; never perturb the jitted call
+            return self._compiles
+
+    def __call__(self, *args, **kwargs):
+        out = self._jitted(*args, **kwargs)
+        try:
+            compiles = self._count_compiles(args, kwargs)
+            object.__setattr__(self, "_compiles", compiles)
+            self._w.note_call(self._site, compiles)
+        except Exception:  # dflint: disable=DF001 — diagnostics-only bookkeeping; the jitted result is already computed
+            pass
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+    def __repr__(self):  # pragma: no cover — debugging aid
+        return f"<dftrace proxy {self._site[0]}:{self._site[1]} of {self._jitted!r}>"
+
+
+_installed: Optional[CompileWitness] = None
+_real_jit: Optional[Callable[..., Any]] = None
+
+
+def witness() -> Optional[CompileWitness]:
+    return _installed
+
+
+def _default_package_dir() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def install(package_dir: Optional[str] = None) -> CompileWitness:
+    """Patch ``jax.jit`` with the site-aware counting factory.
+    Idempotent; returns the active witness.  Importing jax here is the
+    point — the caller (conftest) controls platform env beforehand."""
+    global _installed, _real_jit
+    if _installed is not None:
+        return _installed
+    import jax
+
+    w = CompileWitness(package_dir or _default_package_dir())
+    real_jit = jax.jit
+    _real_jit = real_jit
+
+    def counting_jit(fun=None, **kwargs):
+        if fun is None:
+            # jax.jit(static_argnames=...) factory form: defer until the
+            # function arrives, then re-enter with the original frame
+            # already gone — attribute the creation to the deferred call.
+            def deferred(f):
+                return counting_jit(f, **kwargs)
+
+            return deferred
+        jitted = real_jit(fun, **kwargs)
+        site = w.site_of_frame(sys._getframe(1))
+        if site is None:
+            return jitted
+        w.note_creation(site)
+        return _JitProxy(jitted, site, w)
+
+    jax.jit = counting_jit
+    _installed = w
+    return w
+
+
+def uninstall() -> None:
+    """Restore the stock ``jax.jit`` (existing proxies keep working)."""
+    global _installed
+    if _real_jit is not None:
+        import jax
+
+        jax.jit = _real_jit
+    _installed = None

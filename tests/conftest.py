@@ -1,6 +1,7 @@
-"""Test config: virtual 8-device CPU mesh, lock witness, deadlock watchdog.
+"""Test config: virtual 8-device CPU mesh, lock witness, compile witness,
+deadlock watchdog.
 
-Three session-wide concerns live here, in load order:
+Session-wide concerns live here, in load order:
 
 1. **Lock witness** (``dragonfly2_tpu/utils/dflock.py``): installed
    BEFORE any ``dragonfly2_tpu`` import so every project lock created
@@ -62,6 +63,26 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# -- 2b. compile witness (dftrace) ------------------------------------------
+# Installed AFTER jax exists but BEFORE any dragonfly2_tpu import, so every
+# module-level `jax.jit(...)` in project code is created through the
+# counting factory.  Bootstrapped by file path like dflock (no package
+# __init__ runs ahead of the install).  tests/test_zz_compilewitness.py
+# cross-validates the recorded per-creation compile counts against the
+# static jit-site index (tools/dflint/tracerules.py) and the checked-in
+# compile budget (tools/dflint/compile_budget.toml).
+# Set DF_COMPILE_WITNESS=0 to disable.
+
+if os.environ.get("DF_COMPILE_WITNESS", "1") != "0":
+    _tspec = importlib.util.spec_from_file_location(
+        "dragonfly2_tpu.utils.dftrace",
+        str(_REPO / "dragonfly2_tpu" / "utils" / "dftrace.py"),
+    )
+    _dftrace = importlib.util.module_from_spec(_tspec)
+    _tspec.loader.exec_module(_dftrace)
+    sys.modules["dragonfly2_tpu.utils.dftrace"] = _dftrace
+    _dftrace.install(str(_REPO / "dragonfly2_tpu"))
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -81,6 +102,27 @@ def pytest_sessionstart(session):
 def pytest_sessionfinish(session, exitstatus):
     if _WATCHDOG_S > 0:
         faulthandler.cancel_dump_traceback_later()
+    # Budget-calibration aid: DF_COMPILE_OBSERVED=<path> dumps the compile
+    # witness's per-site stats as JSON at session end (docs: DESIGN.md §17).
+    out_path = os.environ.get("DF_COMPILE_OBSERVED")
+    if out_path:
+        try:
+            from dragonfly2_tpu.utils import dftrace
+
+            w = dftrace.witness()
+            if w is not None:
+                import json
+
+                with open(out_path, "w", encoding="utf-8") as f:
+                    json.dump(
+                        {
+                            f"{site[0]}:{site[1]}": stats
+                            for site, stats in sorted(w.snapshot().items())
+                        },
+                        f, indent=2, sort_keys=True,
+                    )
+        except Exception as exc:  # noqa: BLE001 — diagnostics-only dump
+            print(f"DF_COMPILE_OBSERVED dump failed: {exc}", file=sys.stderr)
 
 
 @pytest.fixture(scope="session")

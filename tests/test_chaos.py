@@ -709,10 +709,14 @@ class TestBenchInitFailure:
             )
         )
         out_lines = capsys.readouterr().out.strip().splitlines()
-        assert rc == 1 and len(out_lines) == 1
+        # Unavailable hardware is a structured SKIP, not a failure exit:
+        # rc stays 0 so a busy TPU runtime can never cost the perf
+        # trajectory a round the way BENCH_r05 was lost (ISSUE 6).
+        assert rc == 0 and len(out_lines) == 1
         line = json.loads(out_lines[0])
         assert line["ok"] is False
         assert line["failure"] == "backend_unavailable"
+        assert line["skipped"] == "backend_unavailable"
         assert len(calls) == 3  # bounded backoff actually retried
 
     def test_transient_unavailable_recovers(self):
@@ -743,10 +747,27 @@ class TestBenchInitFailure:
 
         rc = bench.main(acquire=lambda: ExplodesOnTouch())
         out_lines = capsys.readouterr().out.strip().splitlines()
-        assert rc == 1 and len(out_lines) == 1
+        assert rc == 0 and len(out_lines) == 1
         line = json.loads(out_lines[0])
         assert line["ok"] is False
         assert line["failure"] == "backend_unavailable"
+        assert line["skipped"] == "backend_unavailable"
+
+    def test_non_backend_failure_is_still_rc_1(self, capsys):
+        # A genuine code/config error must NOT masquerade as a hardware
+        # skip: one parseable line, no "skipped" key, nonzero exit.
+        import bench
+
+        def broken():
+            raise ValueError("bad benchmark config")
+
+        rc = bench.main(acquire=broken)
+        out_lines = capsys.readouterr().out.strip().splitlines()
+        assert rc == 1 and len(out_lines) == 1
+        line = json.loads(out_lines[0])
+        assert line["ok"] is False
+        assert "skipped" not in line
+        assert line["failure"] == "ValueError"
 
 
 class TestSchedulerBatcherFaultSeam:

@@ -1684,3 +1684,588 @@ class TestLockGraphStaleness:
         assert main([str(src), "--update-lock-graph", str(doc)]) == 0
         body = doc.read_text()
         assert "stale" not in body and "| held lock |" in body and "tail" in body
+
+
+# ---------------------------------------------------------------------------
+# Trace-discipline analysis (tools/dflint/tracerules.py): DF010 / DF011 /
+# DF012 fixtures, plus mutation sensitivity against the REAL tree
+# ---------------------------------------------------------------------------
+
+from tools.dflint.tracerules import (  # noqa: E402
+    TraceAnalysis,
+    budget_staleness,
+    load_budget,
+    render_budget,
+)
+
+
+def trace(files: dict) -> TraceAnalysis:
+    return TraceAnalysis(prog(files))
+
+
+def trace_rules(a: TraceAnalysis):
+    return sorted({f.rule for f in a.findings()})
+
+
+class TestDF010Fixtures:
+    def test_immediate_invoke_fires(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+
+            def step(x):
+                return x + 1
+
+            def run(x):
+                return jax.jit(step)(x)
+        """})
+        assert any(
+            f.rule == "DF010" and "immediately invoked" in f.message
+            for f in a.findings()
+        )
+
+    def test_construction_in_loop_fires(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+
+            def step(x):
+                return x + 1
+
+            def run(xs):
+                out = []
+                for x in xs:
+                    f = jax.jit(step)
+                    out.append(f(x))
+                return out
+        """})
+        assert any(
+            f.rule == "DF010" and "loop body" in f.message for f in a.findings()
+        )
+
+    def test_init_cached_and_module_level_ok(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+
+            def top(x):
+                return x
+
+            _F = jax.jit(top)
+
+            class T:
+                def __init__(self):
+                    self._f = jax.jit(self._step, donate_argnums=(0,))
+
+                def _step(self, x):
+                    return x
+        """})
+        assert "DF010" not in trace_rules(a)
+
+    def test_module_array_closure_capture_fires(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+            import numpy as np
+
+            TABLE = np.zeros((4, 4), dtype=np.float32)
+
+            @jax.jit
+            def step(x):
+                return x @ TABLE
+        """})
+        fs = [f for f in a.findings() if f.rule == "DF010"]
+        assert len(fs) == 1 and "TABLE" in fs[0].message
+        assert "constant-folded" in fs[0].message
+
+    def test_argument_passing_is_not_capture(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x, table):
+                return x @ table
+        """})
+        assert "DF010" not in trace_rules(a)
+
+    def test_list_arg_to_jitted_module_var_fires(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+
+            def step(x):
+                return x
+
+            _F = jax.jit(step)
+
+            def call(v):
+                return _F([v, v, v])
+        """})
+        assert any(
+            f.rule == "DF010" and "pad-ladder" in f.message for f in a.findings()
+        )
+
+    def test_list_arg_to_jitted_self_attr_fires(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+
+            class T:
+                def __init__(self):
+                    self._f = jax.jit(self._step)
+
+                def _step(self, x):
+                    return x
+
+                def call(self, v):
+                    return self._f([v])
+        """})
+        assert any(
+            f.rule == "DF010" and "Python container" in f.message
+            for f in a.findings()
+        )
+
+    def test_nonstatic_branch_fires(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+
+            @jax.jit
+            def step(x, n):
+                if n > 2:
+                    return x
+                return -x
+        """})
+        fs = [f for f in a.findings() if f.rule == "DF010"]
+        assert len(fs) == 1 and "'n'" in fs[0].message
+
+    def test_range_over_nonstatic_param_fires(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+
+            @jax.jit
+            def step(x, hops):
+                for _ in range(hops):
+                    x = x + 1
+                return x
+        """})
+        assert any(
+            f.rule == "DF010" and "'hops'" in f.message for f in a.findings()
+        )
+
+    def test_declared_static_and_partial_bound_ok(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def step(x, n):
+                if n > 2:
+                    return x
+                return -x
+
+            def kernel(x, exact):
+                if exact:
+                    return x
+                return -x
+
+            def launch(x):
+                k = functools.partial(kernel, exact=True)
+                return jax.jit(k)(x)  # dflint: disable=DF010 — fixture: bound-kwarg negative
+        """})
+        assert "DF010" not in trace_rules(a)
+
+    def test_is_none_branch_is_exempt(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+
+            @jax.jit
+            def step(x, qef=None):
+                if qef is None:
+                    return x
+                return x + qef
+        """})
+        assert "DF010" not in trace_rules(a)
+
+    def test_construction_in_hotpath_fires(self):
+        a = trace({"dragonfly2_tpu/scheduler/fx.py": """
+            import jax
+
+            def serve(x):  # dflint: hotpath
+                f = jax.jit(lambda y: y + 1)
+                return f(x)
+        """})
+        assert any(
+            f.rule == "DF010" and "hotpath" in f.message for f in a.findings()
+        )
+
+    def test_pragma_suppresses(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+
+            def step(x):
+                return x
+
+            def run(x):
+                return jax.jit(step)(x)  # dflint: disable=DF010 — one-shot tool path, reviewed
+        """})
+        assert "DF010" not in trace_rules(a)
+
+
+class TestDF011Fixtures:
+    def test_reachable_helper_asarray_fires(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+            import numpy as np
+
+            def helper(x):
+                return np.asarray(x)
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+        """})
+        fs = [f for f in a.findings() if f.rule == "DF011"]
+        assert len(fs) == 1 and "reachable from traced" in fs[0].message
+
+    def test_traced_body_itself_is_df003s_beat(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                return np.asarray(x)
+        """})
+        assert "DF011" not in trace_rules(a)
+
+    def test_unreachable_helper_is_free(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+            import numpy as np
+
+            def helper(x):
+                return np.asarray(x).item()
+
+            @jax.jit
+            def step(x):
+                return x + 1
+        """})
+        assert "DF011" not in trace_rules(a)
+
+    def test_block_until_ready_in_reachable_fires(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+
+            def sync(x):
+                x.block_until_ready()
+                return x
+
+            @jax.jit
+            def step(x):
+                return sync(x)
+        """})
+        assert any(
+            f.rule == "DF011" and "block_until_ready" in f.message
+            for f in a.findings()
+        )
+
+    def test_item_in_hotpath_fires(self):
+        a = trace({"dragonfly2_tpu/scheduler/fx.py": """
+            def gather(rows):  # dflint: hotpath
+                return rows.sum().item()
+        """})
+        fs = [f for f in a.findings() if f.rule == "DF011"]
+        assert len(fs) == 1 and "hotpath" in fs[0].message
+
+    def test_hotpath_numpy_asarray_is_allowed(self):
+        # Host-side numpy marshalling is the hot path's JOB; only device
+        # syncs (.item/.tolist/device_get/block_until_ready) are leaks.
+        a = trace({"dragonfly2_tpu/scheduler/fx.py": """
+            import numpy as np
+
+            def gather(rows):  # dflint: hotpath
+                return np.asarray(rows, dtype=np.float32)
+        """})
+        assert "DF011" not in trace_rules(a)
+
+    def test_pragma_suppresses(self):
+        a = trace({"dragonfly2_tpu/scheduler/fx.py": """
+            def gather(rows):  # dflint: hotpath
+                return rows.sum().item()  # dflint: disable=DF011 — fixture: reviewed sync
+        """})
+        assert "DF011" not in trace_rules(a)
+
+
+_FX_CONTRACTS = """
+CONTRACTS = {
+    "fx.rows": {
+        "file": "dragonfly2_tpu/records/fx.py",
+        "dtype": "float32",
+        "functions": ["make_rows"],
+    },
+    "fx.slots": {
+        "file": "dragonfly2_tpu/records/fx.py",
+        "attrs": {"Cache._m": "float32"},
+    },
+    "fx.defaults": {
+        "file": "dragonfly2_tpu/records/fx.py",
+        "defaults": {"Writer.__init__.dtype": "float32"},
+    },
+}
+"""
+
+# Indented to match the method-level fixture strings it concatenates with
+# (one textwrap.dedent normalizes the whole file).
+_FX_CLEAN_TAIL = """
+            class Cache:
+                def __init__(self):
+                    self._m = np.empty((2, 2), dtype=np.float32)
+
+            class Writer:
+                def __init__(self, dtype="float32"):
+                    self.dtype = dtype
+"""
+
+
+def _df012(fx_body: str) -> TraceAnalysis:
+    # Dedent here: fixture bodies are written at method indent while
+    # _FX_CLEAN_TAIL is at module indent — prog()'s single dedent cannot
+    # normalize the concatenation.
+    return trace({
+        "dragonfly2_tpu/records/contracts.py": _FX_CONTRACTS,
+        "dragonfly2_tpu/records/fx.py": textwrap.dedent(fx_body),
+    })
+
+
+class TestDF012Fixtures:
+    def test_clean_contract_passes(self):
+        a = _df012("""
+            import numpy as np
+
+            def make_rows(n):
+                return np.zeros((n, 4), dtype=np.float32)
+        """ + _FX_CLEAN_TAIL)
+        assert "DF012" not in trace_rules(a)
+
+    def test_widened_producer_fires_by_contract_name(self):
+        a = _df012("""
+            import numpy as np
+
+            def make_rows(n):
+                return np.zeros((n, 4), dtype=np.float64)
+        """ + _FX_CLEAN_TAIL)
+        fs = [f for f in a.findings() if f.rule == "DF012"]
+        assert len(fs) == 1 and "'fx.rows'" in fs[0].message
+
+    def test_implicit_float64_constructor_fires(self):
+        a = _df012("""
+            import numpy as np
+
+            def make_rows(n):
+                return np.zeros((n, 4))
+        """ + _FX_CLEAN_TAIL)
+        assert any(
+            f.rule == "DF012" and "without an explicit dtype" in f.message
+            for f in a.findings()
+        )
+
+    def test_widened_attr_pin_fires(self):
+        a = _df012("""
+            import numpy as np
+
+            def make_rows(n):
+                return np.zeros((n, 4), dtype=np.float32)
+
+            class Cache:
+                def __init__(self):
+                    self._m = np.empty((2, 2), dtype=np.float64)
+
+            class Writer:
+                def __init__(self, dtype="float32"):
+                    self.dtype = dtype
+        """)
+        assert any(
+            f.rule == "DF012" and "'fx.slots'" in f.message
+            and "Cache._m" in f.message
+            for f in a.findings()
+        )
+
+    def test_missing_attr_pin_fires(self):
+        a = _df012("""
+            import numpy as np
+
+            def make_rows(n):
+                return np.zeros((n, 4), dtype=np.float32)
+
+            class Cache:
+                def __init__(self):
+                    self._m = {}
+
+            class Writer:
+                def __init__(self, dtype="float32"):
+                    self.dtype = dtype
+        """)
+        assert any(
+            f.rule == "DF012" and "no array-constructor assignment" in f.message
+            for f in a.findings()
+        )
+
+    def test_drifted_default_fires(self):
+        a = _df012("""
+            import numpy as np
+
+            def make_rows(n):
+                return np.zeros((n, 4), dtype=np.float32)
+
+            class Cache:
+                def __init__(self):
+                    self._m = np.empty((2, 2), dtype=np.float32)
+
+            class Writer:
+                def __init__(self, dtype="float64"):
+                    self.dtype = dtype
+        """)
+        assert any(
+            f.rule == "DF012" and "'fx.defaults'" in f.message
+            for f in a.findings()
+        )
+
+    def test_renamed_producer_fires(self):
+        a = _df012("""
+            import numpy as np
+
+            def build_rows(n):
+                return np.zeros((n, 4), dtype=np.float32)
+        """ + _FX_CLEAN_TAIL)
+        assert any(
+            f.rule == "DF012" and "'make_rows' missing" in f.message
+            for f in a.findings()
+        )
+
+    def test_float64_in_traced_def_fires(self):
+        a = trace({"dragonfly2_tpu/trainer/fx.py": """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                return x.astype(jnp.float64)
+        """})
+        fs = [f for f in a.findings() if f.rule == "DF012"]
+        assert len(fs) == 1 and "x64 is" in fs[0].message
+
+    def test_pragma_suppresses(self):
+        a = _df012("""
+            import numpy as np
+
+            def make_rows(n):
+                return np.zeros((n, 4), dtype=np.float64)  # dflint: disable=DF012 — fixture: reviewed widening
+        """ + _FX_CLEAN_TAIL)
+        assert "DF012" not in trace_rules(a)
+
+
+class TestTraceMutationSensitivity:
+    """The acceptance contract against the REAL tree: un-caching a jitted
+    step, adding an .item() to a hotpath, or widening a DFC1 column to
+    float64 must each fail BY RULE NAME."""
+
+    def _analyze_with(self, relpath: str, mutated: str) -> TraceAnalysis:
+        from tools.dflint.core import collect_files, load_module
+
+        modules = []
+        for path in collect_files([REPO / "dragonfly2_tpu"], REPO):
+            m = load_module(path, REPO)
+            if m.relpath == relpath:
+                m = Module(path, relpath, mutated)
+            modules.append(m)
+        return TraceAnalysis(Program(modules), REPO)
+
+    @pytest.fixture(scope="class")
+    def real_analysis(self):
+        return TraceAnalysis(
+            Program.from_paths([REPO / "dragonfly2_tpu"], REPO), REPO
+        )
+
+    def test_real_tree_is_clean(self, real_analysis):
+        assert real_analysis.findings() == []
+
+    def test_uncaching_streaming_step_fails_df010(self):
+        relpath = "dragonfly2_tpu/trainer/streaming.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        needle = "self.params, self.opt_state, loss = self._step_fn("
+        assert needle in source
+        mutated = source.replace(
+            needle,
+            "self.params, self.opt_state, loss = "
+            "jax.jit(self._train_step, donate_argnums=(0, 1))(",
+        )
+        a = self._analyze_with(relpath, mutated)
+        assert any(
+            f.rule == "DF010" and f.path == relpath for f in a.findings()
+        ), [f.render() for f in a.findings()]
+
+    def test_item_in_real_hotpath_fails_df011(self):
+        relpath = "dragonfly2_tpu/scheduler/featcache.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        needle = "return self.gather_with_buckets(hosts)[0]"
+        assert needle in source
+        mutated = source.replace(
+            needle,
+            "rows = self.gather_with_buckets(hosts)[0]\n"
+            "        _ = rows.sum().item()\n"
+            "        return rows",
+        )
+        a = self._analyze_with(relpath, mutated)
+        assert any(
+            f.rule == "DF011" and f.path == relpath
+            and "hotpath" in f.message
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+
+    def test_widening_dfc1_column_fails_df012(self):
+        relpath = "dragonfly2_tpu/records/features.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        needle = "out = np.zeros(HOST_FEATURE_DIM, dtype=np.float32)"
+        assert needle in source
+        mutated = source.replace(
+            needle, "out = np.zeros(HOST_FEATURE_DIM, dtype=np.float64)"
+        )
+        a = self._analyze_with(relpath, mutated)
+        assert any(
+            f.rule == "DF012" and "'dfc1.download'" in f.message
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+
+    def test_widening_columnar_writer_default_fails_df012(self):
+        relpath = "dragonfly2_tpu/records/columnar.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        mutated = source.replace('dtype: str = "float32"', 'dtype: str = "float64"')
+        assert mutated != source
+        a = self._analyze_with(relpath, mutated)
+        assert any(
+            f.rule == "DF012" and "'dfc1.file'" in f.message
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+
+
+class TestCompileBudgetFile:
+    def test_checked_in_budget_is_current(self):
+        analysis = TraceAnalysis(
+            Program.from_paths([REPO / "dragonfly2_tpu"], REPO), REPO
+        )
+        gaps = budget_staleness(analysis, load_budget())
+        assert not gaps, "\n".join(gaps)
+
+    def test_render_preserves_existing_bounds(self):
+        text = render_budget(["a.py:f", "b.py:g"], {"a.py:f": 9})
+        assert '"a.py:f" = 9' in text and '"b.py:g" = 4' in text
+
+    def test_cli_rule_filter_covers_trace_rules(self, tmp_path, capsys):
+        from tools.dflint.__main__ import main
+
+        src = tmp_path / "fx.py"
+        src.write_text(
+            "import jax\n\n"
+            "def step(x):\n    return x\n\n"
+            "def run(x):\n    return jax.jit(step)(x)\n"
+        )
+        rc = main([str(src), "--rule", "DF010", "--no-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "DF010" in out
+        rc = main([str(src), "--rule", "DF012", "--no-baseline"])
+        assert rc == 0

@@ -4,13 +4,17 @@ broke.  A finding here means a project invariant was violated —
 exception swallowing (DF001), thread hygiene (DF002), JAX trace purity
 (DF003), a fault seam deleted (DF004), a leaked fd (DF005), deadline
 propagation dropped in rpc/ (DF006), hot-path hygiene (DF007) — or a
-whole-program concurrency invariant broke: an indefinitely-blocking
-operation now runs under a mutex (DF008), or the global lock-ordering
-graph grew a deadlock-capable cycle (DF009).
+whole-program invariant broke: an indefinitely-blocking operation now
+runs under a mutex (DF008), the global lock-ordering graph grew a
+deadlock-capable cycle (DF009), a jit is constructed per call or a
+traced def branches on a non-static arg (DF010), a host-device sync
+leaked into a hot path or trace-reachable function (DF011), or a
+columnar dtype contract drifted from records/contracts.py (DF012).
 
-The per-file checkers see one AST; DF008/DF009 come from ONE
-whole-program analysis (tools/dflint/program.py) built here once and
-attributed back to files, so the failing test still names the file.
+The per-file checkers see one AST; DF008-DF012 come from ONE
+whole-program analysis (tools/dflint/program.py +
+tools/dflint/tracerules.py) built here once and attributed back to
+files, so the failing test still names the file.
 
 Accepted pre-existing findings live in tools/dflint/baseline.toml
 (currently EMPTY — the fix sweep shipped with the rules); reviewed
@@ -33,13 +37,15 @@ if str(REPO) not in sys.path:  # `python -m pytest` from elsewhere
 from tools.dflint.baseline import Baseline  # noqa: E402
 from tools.dflint.core import collect_files, load_module, run_checkers  # noqa: E402
 from tools.dflint.program import Program  # noqa: E402
+from tools.dflint.tracerules import TraceAnalysis  # noqa: E402
 
 SOURCE_FILES = collect_files([REPO / "dragonfly2_tpu"], REPO)
 BASELINE = Baseline.load()
 
 _PROGRAM = Program.from_paths([REPO / "dragonfly2_tpu"], REPO)
+_TRACE = TraceAnalysis(_PROGRAM, REPO)
 _PROGRAM_BY_PATH = defaultdict(list)
-for _f in _PROGRAM.findings():
+for _f in _PROGRAM.findings() + _TRACE.findings():
     _PROGRAM_BY_PATH[_f.path].append(_f)
 
 
@@ -59,7 +65,7 @@ def test_dflint_clean(path):
 def test_no_stale_baseline_entries():
     """Fixed violations must leave the baseline too, or the budget
     silently covers the NEXT regression in that function."""
-    findings = list(_PROGRAM.findings())
+    findings = list(_PROGRAM.findings()) + list(_TRACE.findings())
     for path in SOURCE_FILES:
         findings.extend(run_checkers(load_module(path, REPO)))
     assert BASELINE.stale_keys(findings) == []

@@ -511,3 +511,149 @@ class TestTransposeGather:
         )
         assert abs(l0 - l1) / max(abs(l0), 1e-6) < 1e-4
         assert abs(g0 - g1) / max(g0, 1e-6) < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# DF012 dtype/shape contracts: kernel outputs vs the declared registry
+# (dragonfly2_tpu/records/contracts.py) — kernel and contract cannot drift
+# apart, for the edge shapes that historically break pads/buckets: empty
+# segment sets, a single segment, and bf16 inputs.
+# ---------------------------------------------------------------------------
+
+
+class TestOpsDtypeContracts:
+    def _contract(self, key):
+        from dragonfly2_tpu.records.contracts import CONTRACTS
+
+        return CONTRACTS[key]
+
+    def test_registry_matches_live_dfc1_columns(self):
+        """The declared-once registry and the live featurizer must agree
+        on the DFC1 column schema — renaming/reordering/widening a column
+        without updating records/contracts.py fails by name here."""
+        from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS, TOPO_COLUMNS
+
+        dl = self._contract("dfc1.download")
+        assert list(DOWNLOAD_COLUMNS) == dl["columns"]
+        assert np.dtype(dl["dtype"]) == np.float32
+        topo = self._contract("dfc1.topology")
+        assert list(TOPO_COLUMNS) == topo["columns"]
+
+    def test_registry_matches_columnar_defaults(self):
+        from dragonfly2_tpu.records.columnar import ColumnarHeader, ColumnarWriter
+        import inspect
+
+        want = self._contract("dfc1.file")["defaults"]
+        assert ColumnarHeader(columns=("a",)).dtype == want["ColumnarHeader.dtype"]
+        sig = inspect.signature(ColumnarWriter.__init__)
+        assert sig.parameters["dtype"].default == \
+            want["ColumnarWriter.__init__.dtype"]
+
+    def test_registry_matches_featcache_slot_dtypes(self):
+        from dragonfly2_tpu.scheduler.featcache import HostFeatureCache
+
+        cache = HostFeatureCache(max_hosts=8)
+        attrs = self._contract("featcache.slots")["attrs"]
+        for attr_path, want in attrs.items():
+            attr = attr_path.split(".", 1)[1]
+            assert getattr(cache, attr).dtype == np.dtype(want), attr_path
+
+    def test_segment_sum_empty_edge_stream(self):
+        """Zero edges: every segment must come back an exact zero row of
+        the contract dtype (the all-padding block still zero-inits)."""
+        want_dtype = np.dtype(self._contract("ops.segment_sum")["dtype"])
+        vals = np.zeros((0, 8), np.float32)
+        ids = np.zeros(0, np.int64)
+        out = np.asarray(
+            segment_sum_pallas(jnp.asarray(vals), ids, 64, interpret=True)
+        )
+        assert out.shape == (64, 8)
+        assert out.dtype == want_dtype
+        assert not out.any()
+
+    def test_segment_sum_single_segment(self):
+        """Every edge lands in one segment: sum parity with numpy and the
+        contract dtype, others exactly zero."""
+        want_dtype = np.dtype(self._contract("ops.segment_sum")["dtype"])
+        rng = np.random.default_rng(3)
+        vals = rng.normal(size=(37, 8)).astype(np.float32)
+        ids = np.full(37, 5, np.int64)
+        out = np.asarray(
+            segment_sum_pallas(jnp.asarray(vals), ids, 16, exact=True,
+                               interpret=True)
+        )
+        assert out.dtype == want_dtype
+        np.testing.assert_allclose(out[5], vals.sum(axis=0), rtol=1e-5)
+        mask = np.ones(16, bool)
+        mask[5] = False
+        assert not out[mask].any()
+
+    def test_segment_sum_bf16_values_accumulate_f32(self):
+        """bf16 values (the allowed native-MXU mode) must still ACCUMULATE
+        and return in the contract float32 — the allow-list covers the
+        multiplicand cast, never the output."""
+        c = self._contract("ops.segment_sum")
+        assert "bfloat16" in c["allow"]
+        rng = np.random.default_rng(4)
+        vals = rng.normal(size=(64, 8)).astype(np.float32)
+        ids = rng.integers(0, 10, 64)
+        out = np.asarray(
+            segment_sum_pallas(
+                jnp.asarray(vals, jnp.bfloat16), ids, 10, exact=False,
+                interpret=True,
+            )
+        )
+        assert out.dtype == np.dtype(c["dtype"])
+        want = np.asarray(segment_sum(jnp.asarray(vals), jnp.asarray(ids), 10))
+        np.testing.assert_allclose(out, want, rtol=3e-2, atol=3e-2)
+
+    def test_transpose_gather_contract_dtypes_and_edges(self):
+        """TransposeTable carries int32 positions + float32 masks per the
+        registry; empty-mask (no real edges) and single-node tables build
+        and differentiate without spill garbage."""
+        from dragonfly2_tpu.ops.transpose_gather import (
+            build_transpose_table,
+            make_transpose_gather,
+        )
+
+        c = self._contract("ops.transpose_gather")
+        # Empty: all-padding mask.
+        idx = np.zeros((4, 3), np.int64)
+        tt = build_transpose_table(idx, np.zeros((4, 3), np.float32), 4)
+        assert np.asarray(tt.tmask).dtype == np.dtype(c["dtype"])
+        assert np.asarray(tt.tidx).dtype == np.int32
+        assert not np.asarray(tt.tmask).any()
+        assert tt.over_pos.shape[0] == 0
+
+        # Single node, self-loops: gradient of sum(gather) is the
+        # out-degree per node, in the contract dtype.
+        idx1 = np.zeros((1, 2), np.int64)
+        mask1 = np.ones((1, 2), np.float32)
+        g = make_transpose_gather(idx1, mask1, 1)
+        table = jnp.asarray(np.ones((1, 4), np.float32))
+
+        def loss(t):
+            return g(t).sum()
+
+        grad = np.asarray(jax.grad(loss)(table))
+        assert grad.dtype == np.dtype(c["dtype"])
+        np.testing.assert_allclose(grad, np.full((1, 4), 2.0, np.float32))
+
+    def test_transpose_gather_bf16_table(self):
+        """A bf16 parameter table must round-trip the VJP in bf16 (the
+        cotangent cast matches the primal dtype — no silent f32 widening
+        of gradients into the optimizer)."""
+        from dragonfly2_tpu.ops.transpose_gather import make_transpose_gather
+
+        rng = np.random.default_rng(5)
+        idx = rng.integers(0, 8, (8, 4))
+        mask = (rng.random((8, 4)) > 0.3).astype(np.float32)
+        g = make_transpose_gather(idx, mask, 8)
+        table = jnp.asarray(rng.normal(size=(8, 16)), jnp.bfloat16)
+
+        def loss(t):
+            return g(t).astype(jnp.float32).sum()
+
+        grad = jax.grad(loss)(table)
+        assert grad.dtype == jnp.bfloat16
+        assert grad.shape == (8, 16)

@@ -1,0 +1,245 @@
+"""Dynamic compile-witness cross-check (trace discipline, enforced).
+
+``tests/conftest.py`` installs ``dragonfly2_tpu.utils.dftrace`` before any
+project import, so every ``jax.jit`` constructed from project code during
+this pytest session records (creations, calls, max XLA compiles per
+creation) keyed by its creation site.  This module (named ``zz`` so it
+collects last and sees the whole session's stats) drives representative
+jitted workloads, then asserts:
+
+- every runtime creation site maps into dflint's STATIC jit-site index
+  (``tools/dflint/tracerules.py``) — an unknown site is a per-call
+  construction or a resolver blind spot: fix tracerules/DF010, never
+  this test;
+- every per-creation compile count fits the checked-in budget
+  (``tools/dflint/compile_budget.toml``) — a steady-state path that
+  recompiles per call fails BY FUNCTION NAME;
+- the budget's key set matches the static index exactly (staleness, the
+  baseline.toml / §16 lock-graph discipline).
+
+The acceptance mutation: un-caching ``streaming.py``'s ``self._step_fn``
+into a per-call ``jax.jit(...)(...)`` must fail BOTH the static rule
+(DF010) and this witness, by name.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from dragonfly2_tpu.utils import dftrace  # noqa: E402
+from tools.dflint.tracerules import (  # noqa: E402
+    TraceAnalysis,
+    budget_staleness,
+    load_budget,
+    witness_compile_gaps,
+)
+
+# Sites polluted by the deliberate-mutation test below; the clean-session
+# assertions subtract them so test selection order can't flake the gate.
+_MUTATION_SITES: set = set()
+
+
+def _witness():
+    w = dftrace.witness()
+    if w is None:
+        pytest.skip("compile witness disabled (DF_COMPILE_WITNESS=0)")
+    return w
+
+
+@pytest.fixture(scope="module")
+def analysis():
+    from tools.dflint.program import Program
+
+    program = Program.from_paths([REPO / "dragonfly2_tpu"], REPO)
+    return TraceAnalysis(program, REPO)
+
+
+def _drive_streaming_steps(n_steps: int = 3):
+    """A StreamingTrainer run: the canonical cached-jit workload (its
+    ``__init__`` construction site must be observed, steady-state)."""
+    from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
+    from dragonfly2_tpu.trainer.streaming import StreamingConfig, StreamingTrainer
+
+    cfg = StreamingConfig(batch_size=16, queue_capacity=8, checkpoint_every=10**9)
+    trainer = StreamingTrainer(cfg)
+    rng = np.random.default_rng(0)
+    rows = rng.standard_normal(
+        (cfg.batch_size * (n_steps + 1), len(DOWNLOAD_COLUMNS))
+    ).astype(np.float32)
+    trainer.feed(rows)
+    trainer.end_of_stream()
+    return trainer.run(max_steps=n_steps, idle_timeout=0.2)
+
+
+class TestCompileWitness:
+    def test_budget_is_current(self, analysis):
+        """Budget keys must mirror the static jit-site index exactly —
+        adding or removing a jit construction without regenerating the
+        budget fails here (python -m tools.dflint --update-compile-budget)."""
+        gaps = budget_staleness(analysis, load_budget())
+        assert not gaps, "\n".join(gaps)
+
+    def test_witness_is_installed_and_recording(self):
+        w = _witness()
+        steps = _drive_streaming_steps()
+        assert steps >= 2
+        snap = w.snapshot()
+        streaming = {
+            site: st for site, st in snap.items()
+            if site[0] == "dragonfly2_tpu/trainer/streaming.py"
+        }
+        assert streaming, f"no streaming jit creation observed; saw {sorted(snap)}"
+        st = next(iter(streaming.values()))
+        assert st["creations"] >= 1 and st["calls"] >= 2
+        assert st["max_compiles"] >= 1
+
+    def test_every_runtime_creation_is_known_and_within_budget(self, analysis):
+        w = _witness()
+        _drive_streaming_steps()
+        observed = {
+            site: st for site, st in w.snapshot().items()
+            if site not in _MUTATION_SITES
+        }
+        gaps = witness_compile_gaps(analysis, observed, load_budget())
+        assert not gaps, (
+            "compile-witness gaps (fix tools/dflint/tracerules.py or the "
+            "offending construction, not this test):\n  " + "\n  ".join(gaps)
+        )
+
+    def test_steady_state_is_compile_free(self):
+        """The shared hop-precompute jit must not add compiles on a
+        repeat call with identical shapes (the retrace signal the budget
+        exists to catch)."""
+        import jax.numpy as jnp
+
+        from dragonfly2_tpu.models.gnn import build_neighbor_table
+        from dragonfly2_tpu.models.hop import precompute_hop_features_jit
+
+        w = _witness()
+        rng = np.random.default_rng(1)
+        n = 32
+        src = rng.integers(0, n, 64).astype(np.int32)
+        dst = (src + 1 + rng.integers(0, n - 1, 64).astype(np.int32)) % n
+        table = build_neighbor_table(
+            n, src, dst, rng.random(64).astype(np.float32), max_neighbors=4
+        )
+        nf = jnp.asarray(rng.standard_normal((n, 6)).astype(np.float32))
+        precompute_hop_features_jit(nf, table, hops=2)
+
+        def hop_site_compiles():
+            return sum(
+                st["max_compiles"]
+                for site, st in w.snapshot().items()
+                if site[0] == "dragonfly2_tpu/models/hop.py"
+            )
+
+        warm = hop_site_compiles()
+        precompute_hop_features_jit(nf, table, hops=2)
+        precompute_hop_features_jit(nf, table, hops=2)
+        assert hop_site_compiles() == warm, "steady-state repeat call recompiled"
+
+    def test_overbudget_compile_count_fails_by_name(self, analysis):
+        """Mutation sensitivity: a budgeted site reporting more compiles
+        than its bound must be flagged by function name."""
+        budget = load_budget()
+        index = analysis.jit_site_index()
+        site, key = next(
+            (s, k) for s, k in sorted(index.items())
+            if s[0] == "dragonfly2_tpu/trainer/streaming.py"
+        )
+        assert key in budget, (site, key)
+        fake = {site: {"creations": 1, "calls": 50,
+                       "max_compiles": budget[key] + 7}}
+        gaps = witness_compile_gaps(analysis, fake, budget)
+        assert len(gaps) == 1 and key in gaps[0] and "retracing" in gaps[0]
+
+    def test_unknown_creation_site_is_a_gap(self, analysis):
+        fake = {("dragonfly2_tpu/daemon/nowhere.py", 7):
+                {"creations": 3, "calls": 3, "max_compiles": 3}}
+        gaps = witness_compile_gaps(analysis, fake, load_budget())
+        assert len(gaps) == 1 and "unknown to the static jit-site index" in gaps[0]
+
+    def test_uncaching_streaming_step_fails_static_and_witness(self, analysis):
+        """THE acceptance mutation: turn ``self._step_fn(...)`` into a
+        per-call ``jax.jit(self._train_step, ...)(...)`` inside the run
+        loop.  The static rule (DF010) must flag it, and actually running
+        the mutant under the witness must produce a creation site unknown
+        to the static index — both failures name streaming.py."""
+        relpath = "dragonfly2_tpu/trainer/streaming.py"
+        src_path = REPO / relpath
+        source = src_path.read_text(encoding="utf-8")
+        needle = "self.params, self.opt_state, loss = self._step_fn("
+        assert needle in source
+        mutated = source.replace(
+            needle,
+            "self.params, self.opt_state, loss = "
+            "jax.jit(self._train_step, donate_argnums=(0, 1))(",
+        )
+        assert mutated != source
+
+        # -- static half: DF010 fires on the mutated tree ------------------
+        from tools.dflint.core import Module, collect_files, load_module
+        from tools.dflint.program import Program
+
+        modules = []
+        for path in collect_files([REPO / "dragonfly2_tpu"], REPO):
+            m = load_module(path, REPO)
+            if m.relpath == relpath:
+                m = Module(path, relpath, mutated)
+            modules.append(m)
+        mutant_program = Program(modules)
+        mutant_findings = TraceAnalysis(mutant_program, REPO).findings()
+        assert any(
+            f.rule == "DF010" and f.path == relpath
+            and "immediately invoked" in f.message
+            for f in mutant_findings
+        ), [f.render() for f in mutant_findings]
+
+        # -- dynamic half: the witness sees an unindexed creation ----------
+        w = _witness()
+        before = set(w.snapshot())
+        import types
+
+        code = compile(mutated, str(src_path), "exec")
+        mod_name = "dragonfly2_tpu.trainer._streaming_df010_mutant"
+        mutant_mod = types.ModuleType(mod_name)
+        mutant_mod.__package__ = "dragonfly2_tpu.trainer"
+        mutant_mod.__file__ = str(src_path)
+        # dataclasses resolves string annotations via sys.modules[__module__].
+        sys.modules[mod_name] = mutant_mod
+        try:
+            exec(code, mutant_mod.__dict__)  # noqa: S102 — controlled mutant of our own module
+            trainer = mutant_mod.StreamingTrainer(
+                mutant_mod.StreamingConfig(
+                    batch_size=8, queue_capacity=4, checkpoint_every=10**9
+                )
+            )
+            from dragonfly2_tpu.records.features import DOWNLOAD_COLUMNS
+
+            rows = np.random.default_rng(2).standard_normal(
+                (24, len(DOWNLOAD_COLUMNS))
+            ).astype(np.float32)
+            trainer.feed(rows)
+            trainer.end_of_stream()
+            assert trainer.run(max_steps=2, idle_timeout=0.2) == 2
+        finally:
+            sys.modules.pop(mod_name, None)
+
+        delta = {
+            site: st for site, st in w.snapshot().items() if site not in before
+        }
+        _MUTATION_SITES.update(delta)
+        gaps = witness_compile_gaps(analysis, delta, load_budget())
+        assert any(
+            "dragonfly2_tpu/trainer/streaming.py" in g
+            and "unknown to the static jit-site index" in g
+            for g in gaps
+        ), (gaps, sorted(delta))

@@ -54,6 +54,7 @@ SCHEMA_KEYS = (
     "speedup_rule",
     "cache_hit_rate",
     "mean_batch_occupancy",
+    "steady_state_recompiles",
 )
 
 
@@ -186,6 +187,15 @@ def run(hosts: int, parents: int, announcers: int, announces: int,
         linger_ms: float, seed: int = 0, rounds: int = 4) -> dict:
     import gc
 
+    # Compile witness FIRST (patches jax.jit before any project module can
+    # construct one): the measured rounds are bracketed with compile-count
+    # snapshots, so the JSON line reports steady-state recompiles per path
+    # — the serving acceptance bar is vector_ml == 0 (a retrace mid-round
+    # would erase the micro-batching win on a jit/TPU scorer backend).
+    from dragonfly2_tpu.utils import dftrace
+
+    witness = dftrace.install()
+
     from dragonfly2_tpu.scheduler import (
         Evaluator,
         HostFeatureCache,
@@ -220,6 +230,7 @@ def run(hosts: int, parents: int, announcers: int, announces: int,
     per_round = max(announces // rounds, announcers)
     walls = {name: 0.0 for name, _ in named}
     lats = {name: [] for name, _ in named}
+    recompiles = {name: 0 for name, _ in named}
     # Warm-up round (caches, lru memos, numpy first-call machinery), then
     # GC quiesced for the measured rounds: collector pauses hit the
     # allocation-heavy scalar paths hardest and were a major variance
@@ -234,10 +245,12 @@ def run(hosts: int, parents: int, announcers: int, announces: int,
             gc.collect()
             gc.disable()
         for name, evaluate in named:
+            compiles_before = witness.total_compiles()
             wall, lat = _run_round(evaluate, task, peers, plans, announcers)
             if measured:
                 walls[name] += wall
                 lats[name].extend(lat)
+                recompiles[name] += witness.total_compiles() - compiles_before
     gc.enable()
     paths = {name: _summarize(walls[name], lats[name]) for name, _ in named}
 
@@ -264,6 +277,10 @@ def run(hosts: int, parents: int, announcers: int, announces: int,
         ),
         "cache_hit_rate": round(cache.hit_rate(), 4),
         "mean_batch_occupancy": round(batcher.mean_occupancy(), 2),
+        # XLA compiles observed DURING measured rounds, per path (compile
+        # witness, utils/dftrace.py).  The warm-up round absorbs first
+        # compiles; anything here is a steady-state retrace.
+        "steady_state_recompiles": recompiles,
     }
 
 

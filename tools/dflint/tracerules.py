@@ -1,0 +1,1196 @@
+"""Whole-program TPU trace-discipline analysis (DF010 / DF011 / DF012).
+
+The concurrency pass (``program.py``) guards the threaded serving stack;
+this module guards the JAX/XLA layer the ROADMAP's perf numbers live on.
+A single silent retrace, host↔device sync, or float64 leak erases the
+serving and trainer wins, and nothing before this PR watched the ~20
+``jax.jit`` / ``pjit`` / ``pallas_call`` sites across trainer, ops,
+parallel and scheduler.  Built on :class:`tools.dflint.program.Program`'s
+symbol table and call graph:
+
+**DF010 — retrace hazards.**  Jitted callables must be constructed once
+and cached; per-call construction throws the compile cache away with the
+object.  Flagged:
+
+- ``jax.jit(f)(x)`` — construct-and-immediately-invoke inside a function
+  (the compiled program is unreachable after the call returns);
+- trace-wrapper construction inside a ``for``/``while`` body;
+- trace-wrapper construction inside a ``# dflint: hotpath`` function or
+  any function reachable from one (compilation on the serving path);
+- a traced def capturing an array-valued module/closure variable — the
+  array is constant-folded into EVERY compile instead of shipped as an
+  operand (pass it as an argument);
+- Python ``list``/``dict``/comprehension arguments at call sites of a
+  known-jitted callable — shape varies with length, one compile per
+  occupancy (go through the pad ladder, ``scheduler/microbatch.py``);
+- a traced def branching (``if``/``while``/``range()``) on a parameter
+  not declared in ``static_argnums``/``static_argnames`` (and not bound
+  by ``functools.partial``): either a TracerBoolConversionError on real
+  inputs or a retrace per Python value.
+
+**DF011 — host-sync leaks in hot paths.**  Two scopes:
+
+- functions *reachable from a traced body* through the project call
+  graph (the traced def itself is DF003's beat): ``.item()`` /
+  ``.tolist()``, ``np.asarray`` / ``np.array``, ``jax.device_get``,
+  ``float()/int()/bool()`` on non-literals, ``.block_until_ready()`` —
+  each forces the tracer to host or silently freezes a value at trace
+  time;
+- ``# dflint: hotpath`` functions (the DF007 serving inventory):
+  ``.item()`` / ``.tolist()`` / ``jax.device_get`` /
+  ``.block_until_ready()`` — a device sync on the announce path stalls
+  every queued request behind one transfer.
+
+**DF012 — columnar dtype/shape contracts.**  The registry
+(``dragonfly2_tpu/records/contracts.py``, a pure literal this module
+reads with ``ast.literal_eval`` — no import, stdlib-only) declares each
+columnar surface once; producer/consumer seams are checked against it:
+slot-column creation-site dtype pins, constructor/param defaults,
+explicit non-contract float dtypes (float64 with x64 off is a silent
+truncation under jit and a row-width lie on host), implicit-float64
+constructors (``np.zeros(n)``), and float64 mentions inside any traced
+def.  Findings name the contract key, so a widened column fails *by
+column name*.
+
+The static pass is cross-validated at runtime by the **compile witness**
+(``dragonfly2_tpu/utils/dftrace.py`` + ``tests/test_zz_compilewitness.py``):
+every ``jax.jit`` creation observed during the tier-1 run must map onto
+this module's static jit-site index, and its per-creation compile count
+must fit ``tools/dflint/compile_budget.toml`` (whose key set is
+staleness-checked against the static index, like ``baseline.toml`` and
+the §16 lock graph).  A static blind spot is a witness failure — a
+resolver fix, never silent rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, dotted
+from .program import FuncInfo, ModuleInfo, Program, _walk_skipping_defs
+
+RULE_RETRACE = "DF010"
+TITLE_RETRACE = "retrace hazard: per-call jit construction / non-static branch arg"
+RULE_HOSTSYNC = "DF011"
+TITLE_HOSTSYNC = "host-device sync leak in a hot path or trace-reachable function"
+RULE_CONTRACT = "DF012"
+TITLE_CONTRACT = "columnar dtype/shape contract violation"
+
+CONTRACTS_RELPATH = "dragonfly2_tpu/records/contracts.py"
+
+_JIT_CTORS = {"jit", "pjit"}
+_TRACE_WRAPPERS = {"jit", "pjit", "shard_map", "pallas_call"}
+_HOTPATH_MARK = re.compile(r"#\s*dflint:\s*hotpath\b")
+
+_ARRAY_CTOR_LEAVES = {
+    "zeros", "ones", "empty", "full", "asarray", "array", "arange",
+    "linspace", "stack", "concatenate", "fromiter", "zeros_like",
+    "ones_like", "full_like", "load", "frombuffer", "memmap",
+}
+_ARRAY_PREFIXES = {"np", "numpy", "jnp"}
+# Constructors whose missing dtype defaults to float64 on numpy.
+_F64_DEFAULT_CTORS = {"zeros", "ones", "empty", "full"}
+_FLOAT_DTYPES = {"float16", "float32", "float64", "bfloat16", "half",
+                 "double", "longdouble"}
+
+_HOST_ESCAPES = {"item", "tolist"}
+_HOST_ARRAY_CALLS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+_SCALAR_CASTS = {"float", "int", "bool"}
+
+
+def _leaf(name: Optional[str]) -> str:
+    return name.split(".")[-1] if name else ""
+
+
+def _is_trace_ctor(node: ast.AST, names: Iterable[str] = _TRACE_WRAPPERS) -> bool:
+    """Is ``node`` an expression naming jax.jit / pjit / shard_map /
+    pallas_call (or functools.partial over one)?"""
+    name = dotted(node)
+    if name and _leaf(name) in names:
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted(node.func)
+        if fname and _leaf(fname) == "partial" and node.args:
+            return _is_trace_ctor(node.args[0], names)
+    return False
+
+
+def _partial_of(node: ast.AST) -> Optional[ast.Call]:
+    if isinstance(node, ast.Call):
+        fname = dotted(node.func)
+        if fname and _leaf(fname) == "partial":
+            return node
+    return None
+
+
+def _static_names_from_call(call: ast.Call, params: List[str]) -> Set[str]:
+    """``static_argnames`` / ``static_argnums`` declared on a jit
+    construction or decorator, mapped onto parameter names."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        out.add(elt.value)
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            nums: List[int] = []
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums = [
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                ]
+            for n in nums:
+                if 0 <= n < len(params):
+                    out.add(params[n])
+    return out
+
+
+def _bound_kwargs(partial_call: Optional[ast.Call]) -> Set[str]:
+    """Parameter names bound by ``functools.partial(f, hops=...)`` — no
+    longer traced arguments at all."""
+    if partial_call is None:
+        return set()
+    return {kw.arg for kw in partial_call.keywords if kw.arg}
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)] + [
+        p.arg for p in a.kwonlyargs
+    ]
+
+
+def _dtype_token(node: ast.AST) -> Optional[str]:
+    """The dtype a call argument names: ``np.float64`` -> "float64",
+    ``"float32"`` -> "float32", bare ``float`` -> "float64" (numpy
+    semantics).  None when it isn't a recognizable dtype expression."""
+    name = dotted(node)
+    if name:
+        leaf = _leaf(name)
+        if leaf in _FLOAT_DTYPES or leaf in (
+            "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+            "uint64", "intp", "bool_",
+        ):
+            return "float64" if leaf in ("double", "longdouble") else leaf
+        if name == "float":
+            return "float64"
+        if name in ("int", "bool"):
+            return name
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class TracedDef:
+    """One function that runs under trace: a def wrapped by jit / pjit /
+    shard_map / pallas_call (decorator or wrapping call), plus its
+    statically-declared / partial-bound parameter names."""
+
+    def __init__(self, fi: FuncInfo) -> None:
+        self.fi = fi
+        self.static: Set[str] = set()
+        self.bound: Set[str] = set()
+        self.wrap_sites: List[Tuple[str, int]] = []
+
+
+class TraceAnalysis:
+    """DF010-DF012 over a linked :class:`Program`."""
+
+    def __init__(self, program: Program, root: Optional[Path] = None) -> None:
+        self.program = program
+        self.root = root
+        self._findings: List[Finding] = []
+        self.contracts = self._load_contracts()
+        # traced defs + reachable closure, jitted-name tables, hotpaths
+        self.traced: Dict[str, TracedDef] = {}           # FuncInfo.key -> TracedDef
+        self._jitted_module_vars: Dict[str, Set[str]] = {}   # relpath -> names
+        self._jitted_attrs: Dict[str, Set[str]] = {}         # relpath -> self attrs
+        self._jit_sites: Dict[Tuple[str, int], str] = {}     # (relpath, line) -> key
+        self._jit_site_keys: Set[str] = set()
+        self._hotpath_funcs: Set[str] = set()            # FuncInfo.key
+        self._collect_traced_defs()
+        self._collect_jitted_names()
+        self._collect_hotpaths()
+        self._check_df010()
+        self._check_df011()
+        self._check_df012()
+        self._findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    # ------------------------------------------------------------------
+    # Shared plumbing
+    # ------------------------------------------------------------------
+
+    def findings(self) -> List[Finding]:
+        return list(self._findings)
+
+    def _emit(self, rule: str, mi: ModuleInfo, node: ast.AST, message: str) -> None:
+        module = mi.module
+        line = getattr(node, "lineno", 1)
+        if module.suppressed(rule, line):
+            return
+        self._findings.append(
+            Finding(
+                rule=rule,
+                path=mi.relpath,
+                line=line,
+                col=getattr(node, "col_offset", 0) + 1,
+                message=message,
+                qual=module.qualname(node),
+            )
+        )
+
+    def _load_contracts(self) -> dict:
+        mi = self.program.modules.get(CONTRACTS_RELPATH)
+        tree = None
+        if mi is not None:
+            tree = mi.module.tree
+        elif self.root is not None:
+            path = self.root / CONTRACTS_RELPATH
+            if path.exists():
+                tree = ast.parse(path.read_text(encoding="utf-8"))
+        if tree is None:
+            return {}
+        for stmt in tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "CONTRACTS"
+            ):
+                try:
+                    return ast.literal_eval(stmt.value)
+                except ValueError:
+                    if mi is not None:
+                        self._emit(
+                            RULE_CONTRACT, mi, stmt,
+                            "CONTRACTS must stay a pure literal "
+                            "(ast.literal_eval failed — dflint reads it "
+                            "without importing)",
+                        )
+                    return {}
+        return {}
+
+    # ------------------------------------------------------------------
+    # Traced-def discovery (program-wide DF003 resolution + statics)
+    # ------------------------------------------------------------------
+
+    def _func_of_def(self, mi: ModuleInfo, fn: ast.AST) -> Optional[FuncInfo]:
+        qual = mi.module.qualname(fn)
+        return self.program.funcs.get(f"{mi.relpath}:{qual}")
+
+    def _resolve_wrap_target(
+        self, mi: ModuleInfo, enclosing: Optional[FuncInfo], arg: ast.AST
+    ) -> Tuple[Optional[FuncInfo], Optional[ast.Call]]:
+        """The FuncInfo a trace wrapper's first argument names, chasing
+        ``partial(f, ...)``, local ``kernel = partial(f, ...)`` bindings,
+        ``self._method``, bare names and imports.  Returns
+        ``(target, partial_call)``."""
+        partial_call = _partial_of(arg)
+        if partial_call is not None and partial_call.args:
+            target, _ = self._resolve_wrap_target(
+                mi, enclosing, partial_call.args[0]
+            )
+            return target, partial_call
+        if isinstance(arg, ast.Name):
+            name = arg.id
+            # Chase one local/module assignment: `kernel = partial(f, ...)`.
+            assign = self._find_assignment(mi, enclosing, name)
+            if assign is not None:
+                inner_partial = _partial_of(assign)
+                if inner_partial is not None and inner_partial.args:
+                    target, _ = self._resolve_wrap_target(
+                        mi, enclosing, inner_partial.args[0]
+                    )
+                    return target, inner_partial
+            cur = enclosing
+            while cur is not None:
+                if name in cur.nested:
+                    return cur.nested[name], None
+                cur = self.program._parent_func(cur)
+            if name in mi.functions:
+                return mi.functions[name], None
+            imp = mi.imports.get(name)
+            if imp:
+                return self.program._func_from_import(imp), None
+            return None, None
+        if isinstance(arg, ast.Attribute):
+            # jax.jit(self._train_dispatch) / mod.fn
+            base = dotted(arg.value)
+            if base in ("self", "cls"):
+                cls = enclosing.cls if enclosing is not None else None
+                if cls is None:
+                    # Module.qualname can find the class even without a
+                    # FuncInfo (e.g. wrap at class body level) — skip.
+                    return None, None
+                hit = cls.find_method(arg.attr)
+                if hit is not None:
+                    return self.program._method_func(hit[0], hit[1]), None
+                return None, None
+            if base and base in mi.imports:
+                target_mi = self.program._module_from_import(mi.imports[base])
+                if target_mi is not None:
+                    return target_mi.functions.get(arg.attr), None
+        return None, None
+
+    def _find_assignment(
+        self, mi: ModuleInfo, enclosing: Optional[FuncInfo], name: str
+    ) -> Optional[ast.AST]:
+        scopes: List[ast.AST] = []
+        cur = enclosing
+        while cur is not None:
+            scopes.append(cur.node)
+            cur = self.program._parent_func(cur)
+        scopes.append(mi.module.tree)
+        for scope in scopes:
+            for node in ast.walk(scope):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == name
+                ):
+                    return node.value
+        return None
+
+    def _collect_traced_defs(self) -> None:
+        for mi in self.program.modules.values():
+            tree = mi.module.tree
+            # Decorated defs.
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for dec in node.decorator_list:
+                    if _is_trace_ctor(dec):
+                        fi = self._func_of_def(mi, node)
+                        if fi is None:
+                            continue
+                        td = self.traced.setdefault(fi.key, TracedDef(fi))
+                        td.wrap_sites.append((mi.relpath, dec.lineno))
+                        params = _param_names(node)
+                        if isinstance(dec, ast.Call):
+                            td.static |= _static_names_from_call(dec, params)
+                            inner = _partial_of(dec)
+                            if inner is not None:
+                                td.static |= _static_names_from_call(inner, params)
+                        # jit decorators are jit creations: index the
+                        # decorator-through-signature line range so the
+                        # runtime witness can map its creation frame.
+                        if _is_trace_ctor(dec, _JIT_CTORS):
+                            self._index_jit_site(
+                                mi, dec.lineno,
+                                (node.body[0].lineno if node.body else node.lineno),
+                                mi.module.qualname(node),
+                            )
+                        break
+            # Wrapping calls: jax.jit(f, ...) / pallas_call(kernel, ...).
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                if not name or _leaf(name) not in _TRACE_WRAPPERS:
+                    continue
+                enclosing_fn = mi.module.enclosing_function(node)
+                enclosing = (
+                    self._func_of_def(mi, enclosing_fn)
+                    if enclosing_fn is not None else None
+                )
+                if _leaf(name) in _JIT_CTORS:
+                    self._index_jit_site(
+                        mi, node.lineno,
+                        getattr(node, "end_lineno", node.lineno),
+                        mi.module.qualname(node),
+                    )
+                if not node.args:
+                    continue
+                target, partial_call = self._resolve_wrap_target(
+                    mi, enclosing, node.args[0]
+                )
+                if target is None:
+                    continue
+                td = self.traced.setdefault(target.key, TracedDef(target))
+                td.wrap_sites.append((mi.relpath, node.lineno))
+                params = _param_names(target.node)
+                td.static |= _static_names_from_call(node, params)
+                td.bound |= _bound_kwargs(partial_call)
+
+    def _index_jit_site(
+        self, mi: ModuleInfo, start: int, end: int, qual: str
+    ) -> None:
+        key = f"{mi.relpath}:{qual}"
+        self._jit_site_keys.add(key)
+        for line in range(start, max(end, start) + 1):
+            self._jit_sites.setdefault((mi.relpath, line), key)
+
+    # -- public surface for the compile witness -------------------------
+
+    def jit_site_index(self) -> Dict[Tuple[str, int], str]:
+        """(relpath, lineno) covered by any static jax.jit/pjit
+        construction → ``relpath:qual`` budget key.  The runtime compile
+        witness maps each observed creation frame through this; an
+        unknown frame is a resolver/static blind spot."""
+        return dict(self._jit_sites)
+
+    def jit_site_keys(self) -> Set[str]:
+        """Every static jit-construction budget key — the compile
+        budget's required key set (staleness contract)."""
+        return set(self._jit_site_keys)
+
+    # ------------------------------------------------------------------
+    # Hot-path marks + jitted-name tables
+    # ------------------------------------------------------------------
+
+    def _collect_hotpaths(self) -> None:
+        for mi in self.program.modules.values():
+            marks = {
+                i + 1
+                for i, line in enumerate(mi.module.lines)
+                if _HOTPATH_MARK.search(line)
+            }
+            if not marks:
+                continue
+            for node in ast.walk(mi.module.tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                first_body = node.body[0].lineno if node.body else node.lineno
+                if any(node.lineno - 1 <= m <= first_body for m in marks):
+                    fi = self._func_of_def(mi, node)
+                    if fi is not None:
+                        self._hotpath_funcs.add(fi.key)
+
+    def _collect_jitted_names(self) -> None:
+        for mi in self.program.modules.values():
+            mvars: Set[str] = set()
+            attrs: Set[str] = set()
+            for node in ast.walk(mi.module.tree):
+                target = value = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    target, value = node.target, node.value
+                if value is None or not isinstance(value, ast.Call):
+                    continue
+                if not _is_trace_ctor(value.func, _JIT_CTORS):
+                    continue
+                if isinstance(target, ast.Name):
+                    if mi.module.enclosing_function(node) is None:
+                        mvars.add(target.id)
+                elif (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    attrs.add(target.attr)
+            self._jitted_module_vars[mi.relpath] = mvars
+            self._jitted_attrs[mi.relpath] = attrs
+
+    # ------------------------------------------------------------------
+    # DF010 — retrace hazards
+    # ------------------------------------------------------------------
+
+    def _hotpath_reachable(self) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [
+            self.program.funcs[k] for k in self._hotpath_funcs
+            if k in self.program.funcs
+        ]
+        while stack:
+            fi = stack.pop()
+            if fi.key in seen:
+                continue
+            seen.add(fi.key)
+            for _call, target in fi.calls:
+                if target.key not in seen:
+                    stack.append(target)
+        return seen
+
+    def _check_df010(self) -> None:
+        hot = self._hotpath_reachable()
+        for mi in self.program.modules.values():
+            module = mi.module
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                # R1: jit(f)(x) — construct-and-invoke discards the cache.
+                if isinstance(node.func, ast.Call) and _is_trace_ctor(
+                    node.func.func, _JIT_CTORS
+                ):
+                    if module.enclosing_function(node) is not None:
+                        self._emit(
+                            RULE_RETRACE, mi, node,
+                            "jit constructed and immediately invoked — the "
+                            "compile cache dies with the call; construct "
+                            "once (module scope / __init__) and reuse the "
+                            "jitted callable",
+                        )
+                # R2/R3: trace-wrapper construction in a loop / hot path.
+                if _is_trace_ctor(node.func, _TRACE_WRAPPERS):
+                    wrapper = _leaf(dotted(node.func) or "")
+                    if wrapper not in _TRACE_WRAPPERS:
+                        continue
+                    if self._inside_loop(module, node):
+                        self._emit(
+                            RULE_RETRACE, mi, node,
+                            f"{wrapper} constructed inside a loop body — "
+                            "one compile per iteration; hoist the "
+                            "construction out of the loop",
+                        )
+                    fn = module.enclosing_function(node)
+                    if fn is not None:
+                        fi = self._func_of_def(mi, fn)
+                        if fi is not None and fi.key in hot:
+                            self._emit(
+                                RULE_RETRACE, mi, node,
+                                f"{wrapper} constructed on the serving hot "
+                                "path (reachable from a '# dflint: hotpath' "
+                                "function) — compilation stalls announces; "
+                                "construct at load/refresh time",
+                            )
+                # R5: shape-varying Python containers into jitted callables.
+                self._check_list_args(mi, node)
+        # R4 + R6 run per traced def.
+        for td in self.traced.values():
+            self._check_closure_capture(td)
+            self._check_nonstatic_branches(td)
+
+    def _inside_loop(self, module, node: ast.AST) -> bool:
+        fn = module.enclosing_function(node)
+        cur = module.parent(node)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                return False
+            cur = module.parent(cur)
+        return False
+
+    def _check_list_args(self, mi: ModuleInfo, call: ast.Call) -> None:
+        name: Optional[str] = None
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id in self._jitted_module_vars.get(mi.relpath, ()):
+                name = func.id
+        elif (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and func.attr in self._jitted_attrs.get(mi.relpath, ())
+        ):
+            name = func.attr
+        if name is None:
+            return
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, (ast.List, ast.ListComp, ast.Dict, ast.Set,
+                                ast.GeneratorExp)):
+                self._emit(
+                    RULE_RETRACE, mi, call,
+                    f"Python container passed to jitted {name!r} — the "
+                    "traced shape varies with length (one compile per "
+                    "occupancy); convert to a fixed-shape array or pad "
+                    "(scheduler/microbatch.py pad-ladder precedent)",
+                )
+                return
+
+    def _check_closure_capture(self, td: TracedDef) -> None:
+        fi = td.fi
+        mi = fi.module
+        fn = fi.node
+        bound: Set[str] = set(_param_names(fn))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                bound.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not fn:
+                    bound.add(node.name)
+        reported: Set[str] = set()
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id not in bound
+                and node.id not in reported
+            ):
+                continue
+            origin = self._array_binding(fi, node.id)
+            if origin is None:
+                continue
+            reported.add(node.id)
+            self._emit(
+                RULE_RETRACE, mi, node,
+                f"traced {fn.name}() captures array {node.id!r} "
+                f"({origin}) by closure — it is constant-folded into "
+                "every compile; pass it as an argument so it ships as "
+                "an operand",
+            )
+
+    def _array_binding(self, fi: FuncInfo, name: str) -> Optional[str]:
+        """Where ``name`` (free in a traced def) binds to an
+        array-constructor result: an enclosing function local or a
+        module-level variable."""
+
+        def is_array_ctor(value: ast.AST) -> bool:
+            if not isinstance(value, ast.Call):
+                return False
+            callee = dotted(value.func)
+            if not callee:
+                return isinstance(value.func, ast.Attribute) and \
+                    value.func.attr == "astype"
+            parts = callee.split(".")
+            return (
+                parts[0] in _ARRAY_PREFIXES and parts[-1] in _ARRAY_CTOR_LEAVES
+            ) or parts[-1] == "astype"
+
+        cur = self.program._parent_func(fi)
+        while cur is not None:
+            for node in ast.walk(cur.node):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == name
+                    and is_array_ctor(node.value)
+                ):
+                    return f"local of {cur.qual}"
+            cur = self.program._parent_func(cur)
+        for stmt in fi.module.module.tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == name
+                and is_array_ctor(stmt.value)
+            ):
+                return "module variable"
+        return None
+
+    def _check_nonstatic_branches(self, td: TracedDef) -> None:
+        fi = td.fi
+        params = set(_param_names(fi.node)) - td.static - td.bound
+        params.discard("self")
+        params.discard("cls")
+        if not params:
+            return
+        # Params compared with `is None` anywhere are Python-level
+        # optionals — their None-ness is fixed per trace, not traced.
+        optional: Set[str] = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and sub.id in params:
+                        optional.add(sub.id)
+        suspects = params - optional
+        if not suspects:
+            return
+        for node in ast.walk(fi.node):
+            test = None
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                test = node.test
+            elif (
+                isinstance(node, (ast.For, ast.AsyncFor))
+                and isinstance(node.iter, ast.Call)
+                and _leaf(dotted(node.iter.func) or "") == "range"
+            ):
+                test = node.iter
+            if test is None:
+                continue
+            if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+            ):
+                continue
+            for sub in ast.walk(test):
+                if isinstance(sub, ast.Name) and sub.id in suspects:
+                    self._emit(
+                        RULE_RETRACE, fi.module, node,
+                        f"traced {fi.node.name}() branches on arg "
+                        f"{sub.id!r} which is not in static_argnums/"
+                        "static_argnames — TracerBoolConversionError on "
+                        "real inputs, or a silent retrace per Python "
+                        "value; declare it static or rewrite with "
+                        "jnp.where/lax.cond",
+                    )
+                    break
+
+    # ------------------------------------------------------------------
+    # DF011 — host-sync leaks
+    # ------------------------------------------------------------------
+
+    def _traced_closure(self) -> Dict[str, Tuple[str, ...]]:
+        """FuncInfo.key -> call chain from a traced def, for every
+        function reachable from a traced body (nested defs of a traced
+        def trace too, so they seed the walk)."""
+        out: Dict[str, Tuple[str, ...]] = {}
+        stack: List[Tuple[FuncInfo, Tuple[str, ...]]] = []
+        seeds: List[FuncInfo] = []
+        for td in self.traced.values():
+            seeds.append(td.fi)
+            seeds.extend(self._all_nested(td.fi))
+        for fi in seeds:
+            out.setdefault(fi.key, (fi.qual,))
+            stack.append((fi, (fi.qual,)))
+        while stack:
+            fi, chain = stack.pop()
+            for _call, target in fi.calls:
+                if target.key in out:
+                    continue
+                tchain = chain + (target.qual,)
+                out[target.key] = tchain
+                stack.append((target, tchain))
+        return out
+
+    def _all_nested(self, fi: FuncInfo) -> List[FuncInfo]:
+        out: List[FuncInfo] = []
+        stack = list(fi.nested.values())
+        while stack:
+            cur = stack.pop()
+            out.append(cur)
+            stack.extend(cur.nested.values())
+        return out
+
+    def _host_sync_op(self, call: ast.Call, *, hotpath: bool) -> Optional[str]:
+        name = dotted(call.func) or ""
+        leaf = call.func.attr if isinstance(call.func, ast.Attribute) else ""
+        if leaf == "block_until_ready":
+            return (
+                ".block_until_ready() forces a device sync"
+                if not hotpath else
+                ".block_until_ready() stalls the serving path on a "
+                "device sync"
+            )
+        if _leaf(name) == "device_get" or name == "jax.device_get":
+            return "jax.device_get() copies device values to host"
+        if leaf in _HOST_ESCAPES and not call.args:
+            return (
+                f".{leaf}() escapes the array to a Python value "
+                "(host transfer + sync)"
+            )
+        if hotpath:
+            return None
+        if name in _HOST_ARRAY_CALLS:
+            return f"{name}() forces the traced value to host memory"
+        if (
+            name in _SCALAR_CASTS
+            and len(call.args) == 1
+            and not isinstance(call.args[0], ast.Constant)
+            and not call.keywords
+        ):
+            return (
+                f"{name}() on a traced value is a concretization "
+                "(ConcretizationTypeError / trace-frozen constant)"
+            )
+        return None
+
+    def _check_df011(self) -> None:
+        closure = self._traced_closure()
+        traced_keys = {td.fi.key for td in self.traced.values()}
+        for td in self.traced.values():
+            traced_keys.update(n.key for n in self._all_nested(td.fi))
+        for key, chain in closure.items():
+            if key in traced_keys:
+                continue  # directly-traced bodies are DF003's beat
+            fi = self.program.funcs.get(key)
+            if fi is None:
+                continue
+            self._scan_host_ops(fi, hotpath=False, chain=chain)
+        for key in self._hotpath_funcs:
+            fi = self.program.funcs.get(key)
+            if fi is None:
+                continue
+            self._scan_host_ops(fi, hotpath=True, chain=(fi.qual,))
+
+    def _scan_host_ops(
+        self, fi: FuncInfo, *, hotpath: bool, chain: Tuple[str, ...]
+    ) -> None:
+        mi = fi.module
+        seen_lines: Set[Tuple[int, str]] = set()
+        # Nested defs are their own FuncInfos (scanned via the closure
+        # walk when reachable), so skip their bodies here.
+        for node in _walk_skipping_defs(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = self._host_sync_op(node, hotpath=hotpath)
+            if msg is None:
+                continue
+            dedupe = (node.lineno, msg)
+            if dedupe in seen_lines:
+                continue
+            seen_lines.add(dedupe)
+            where = (
+                f"'# dflint: hotpath' function {fi.qual}"
+                if hotpath
+                else f"{fi.qual} (reachable from traced "
+                     f"{' -> '.join(chain)})"
+            )
+            self._emit(
+                RULE_HOSTSYNC, mi, node,
+                f"{msg} — in {where}; keep host syncs out of hot paths "
+                "(move to a build/export boundary or mark with "
+                "'# dflint: disable=DF011' + justification)",
+            )
+
+    # ------------------------------------------------------------------
+    # DF012 — columnar dtype contracts
+    # ------------------------------------------------------------------
+
+    def _check_df012(self) -> None:
+        for key, spec in sorted(self.contracts.items()):
+            relpath = spec.get("file")
+            mi = self.program.modules.get(relpath) if relpath else None
+            if relpath and mi is None:
+                # The contract's module isn't in the analyzed path set
+                # (e.g. a sub-tree lint run) — nothing to check against.
+                continue
+            if mi is not None:
+                self._check_contract_attrs(key, spec, mi)
+                self._check_contract_defaults(key, spec, mi)
+                self._check_contract_functions(key, spec, mi)
+        self._check_traced_float64()
+
+    def _funcs_by_qual(self, mi: ModuleInfo) -> Dict[str, ast.AST]:
+        out: Dict[str, ast.AST] = {}
+        for node in ast.walk(mi.module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[mi.module.qualname(node)] = node
+        return out
+
+    def _class_body(self, mi: ModuleInfo, cls_name: str) -> Optional[ast.ClassDef]:
+        for node in ast.walk(mi.module.tree):
+            if isinstance(node, ast.ClassDef) and node.name == cls_name:
+                return node
+        return None
+
+    def _ctor_dtype(self, call: ast.Call) -> Tuple[Optional[str], bool]:
+        """(dtype token, explicit?) of an array-constructor call.  The
+        positional dtype slot per numpy signature: zeros/ones/empty
+        (shape, dtype), full(shape, fill, dtype), asarray/array
+        (obj, dtype), fromiter(it, dtype)."""
+        for kw in call.keywords:
+            if kw.arg == "dtype":
+                return _dtype_token(kw.value), True
+        callee = dotted(call.func) or ""
+        leaf = _leaf(callee)
+        pos = {
+            "zeros": 1, "ones": 1, "empty": 1, "asarray": 1, "array": 1,
+            "fromiter": 1, "full": 2, "frombuffer": 1, "arange": None,
+        }.get(leaf)
+        if pos is not None and len(call.args) > pos:
+            tok = _dtype_token(call.args[pos])
+            if tok is not None:
+                return tok, True
+        return None, False
+
+    def _check_contract_attrs(self, key: str, spec: dict, mi: ModuleInfo) -> None:
+        for attr_path, want in sorted(spec.get("attrs", {}).items()):
+            cls_name, attr = attr_path.rsplit(".", 1)
+            cls = self._class_body(mi, cls_name)
+            if cls is None:
+                self._emit(
+                    RULE_CONTRACT, mi, mi.module.tree,
+                    f"contract {key!r}: class {cls_name} missing from "
+                    f"{mi.relpath} (registry: records/contracts.py)",
+                )
+                continue
+            sites = []
+            for node in ast.walk(cls):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id == "self"
+                    and node.targets[0].attr == attr
+                    and isinstance(node.value, ast.Call)
+                ):
+                    callee = dotted(node.value.func) or ""
+                    if (
+                        callee.split(".")[0] in _ARRAY_PREFIXES
+                        and _leaf(callee) in _ARRAY_CTOR_LEAVES
+                    ):
+                        sites.append(node)
+            if not sites:
+                self._emit(
+                    RULE_CONTRACT, mi, cls,
+                    f"contract {key!r}: column {attr_path!r} has no "
+                    f"array-constructor assignment in {cls_name} — the "
+                    "slot column the registry pins is gone",
+                )
+                continue
+            for node in sites:
+                tok, explicit = self._ctor_dtype(node.value)
+                if not explicit or tok != want:
+                    got = tok if explicit else "implicit (float64)"
+                    self._emit(
+                        RULE_CONTRACT, mi, node,
+                        f"contract {key!r}: column {attr_path!r} declared "
+                        f"{want} but created as {got} — widen the registry "
+                        "entry (reviewed) or fix the constructor",
+                    )
+
+    def _check_contract_defaults(self, key: str, spec: dict, mi: ModuleInfo) -> None:
+        for path, want in sorted(spec.get("defaults", {}).items()):
+            parts = path.split(".")
+            found = False
+            if len(parts) == 2:  # Class.field — dataclass/attr default
+                cls = self._class_body(mi, parts[0])
+                if cls is not None:
+                    for stmt in cls.body:
+                        if (
+                            isinstance(stmt, ast.AnnAssign)
+                            and isinstance(stmt.target, ast.Name)
+                            and stmt.target.id == parts[1]
+                            and isinstance(stmt.value, ast.Constant)
+                        ):
+                            found = True
+                            if stmt.value.value != want:
+                                self._emit(
+                                    RULE_CONTRACT, mi, stmt,
+                                    f"contract {key!r}: {path} defaults to "
+                                    f"{stmt.value.value!r}, registry "
+                                    f"declares {want!r}",
+                                )
+            elif len(parts) == 3:  # Class.fn.param default
+                cls = self._class_body(mi, parts[0])
+                fn = None
+                if cls is not None:
+                    for stmt in cls.body:
+                        if (
+                            isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                            and stmt.name == parts[1]
+                        ):
+                            fn = stmt
+                if fn is not None:
+                    args = fn.args
+                    names = [a.arg for a in args.args]
+                    defaults = list(args.defaults)
+                    pairs = list(zip(names[len(names) - len(defaults):], defaults))
+                    pairs += [
+                        (a.arg, d)
+                        for a, d in zip(args.kwonlyargs, args.kw_defaults)
+                        if d is not None
+                    ]
+                    for pname, default in pairs:
+                        if pname == parts[2]:
+                            found = True
+                            if not (
+                                isinstance(default, ast.Constant)
+                                and default.value == want
+                            ):
+                                self._emit(
+                                    RULE_CONTRACT, mi, default,
+                                    f"contract {key!r}: {path} default "
+                                    f"drifted from the declared {want!r}",
+                                )
+            if not found:
+                self._emit(
+                    RULE_CONTRACT, mi, mi.module.tree,
+                    f"contract {key!r}: pinned default {path} not found "
+                    f"in {mi.relpath} — registry and code drifted",
+                )
+
+    def _check_contract_functions(self, key: str, spec: dict, mi: ModuleInfo) -> None:
+        wanted = spec.get("functions", [])
+        if not wanted:
+            return
+        permitted = {spec.get("dtype", "float32")} | set(spec.get("allow", []))
+        by_qual = self._funcs_by_qual(mi)
+        for qual in wanted:
+            fn = by_qual.get(qual)
+            if fn is None:
+                self._emit(
+                    RULE_CONTRACT, mi, mi.module.tree,
+                    f"contract {key!r}: producer/consumer {qual!r} missing "
+                    f"from {mi.relpath} — update records/contracts.py with "
+                    "the rename",
+                )
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted(node.func) or ""
+                leaf = _leaf(callee)
+                is_ctor = (
+                    callee.split(".")[0] in _ARRAY_PREFIXES
+                    and leaf in _ARRAY_CTOR_LEAVES
+                )
+                is_astype = (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                )
+                if not (is_ctor or is_astype):
+                    continue
+                tok, explicit = self._ctor_dtype(node)
+                if is_astype and not explicit and node.args:
+                    tok = _dtype_token(node.args[0])
+                    explicit = tok is not None
+                if explicit and tok in _FLOAT_DTYPES | {"float64"}:
+                    if tok not in permitted:
+                        self._emit(
+                            RULE_CONTRACT, mi, node,
+                            f"contract {key!r}: {qual} produces {tok} but "
+                            f"the contract is "
+                            f"{spec.get('dtype', 'float32')} (x64 is off — "
+                            "float64 silently truncates under jit and "
+                            "doubles host row width); allowed: "
+                            f"{sorted(permitted)}",
+                        )
+                elif (
+                    not explicit
+                    and callee.split(".")[0] in ("np", "numpy")
+                    and leaf in _F64_DEFAULT_CTORS
+                ):
+                    self._emit(
+                        RULE_CONTRACT, mi, node,
+                        f"contract {key!r}: {qual} calls {callee}() without "
+                        "an explicit dtype — numpy defaults to float64; "
+                        f"pass dtype=np.{spec.get('dtype', 'float32')}",
+                    )
+
+    def _check_traced_float64(self) -> None:
+        """float64 anywhere inside a traced def: x64 is off, so the
+        request silently truncates — the code lies about its dtype."""
+        for td in self.traced.values():
+            fi = td.fi
+            for node in ast.walk(fi.node):
+                tok = None
+                if isinstance(node, ast.Attribute) and node.attr in (
+                    "float64", "double",
+                ):
+                    base = dotted(node.value)
+                    if base in ("np", "numpy", "jnp", "jax.numpy"):
+                        tok = node.attr
+                elif (
+                    isinstance(node, ast.Constant)
+                    and node.value == "float64"
+                ):
+                    tok = "float64"
+                if tok is None:
+                    continue
+                self._emit(
+                    RULE_CONTRACT, fi.module, node,
+                    f"{tok} inside traced {fi.node.name}() — x64 is "
+                    "disabled, the dtype silently truncates to float32 "
+                    "under jit; say float32 (or enable x64 deliberately)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# Compile-budget file (tools/dflint/compile_budget.toml)
+# ---------------------------------------------------------------------------
+
+BUDGET_PATH = Path(__file__).with_name("compile_budget.toml")
+DEFAULT_BUDGET = 4
+
+
+def load_budget(path: Path = BUDGET_PATH) -> Dict[str, int]:
+    from .baseline import parse_toml_subset
+
+    if not path.exists():
+        return {}
+    data = parse_toml_subset(path.read_text(encoding="utf-8"))
+    return {k: int(v) for k, v in data.get("budget", {}).items()}
+
+
+def render_budget(keys: Iterable[str], existing: Dict[str, int]) -> str:
+    lines = [
+        "# dflint compile budget — max XLA compiles per jit construction",
+        '# site "relpath:qual".  The underlying C++ pjit cache is shared per',
+        "# WRAPPED FUNCTION: for bound methods / nested defs (fresh identity",
+        "# per creation) the bound is effectively per creation; for",
+        "# module-level functions wrapped repeatedly it accumulates one entry",
+        "# per distinct signature the whole session drives — size those",
+        "# bounds to test-suite shape variety (a per-call retrace is orders",
+        "# of magnitude beyond any of them).  The key set is staleness-",
+        "# checked against tools/dflint/tracerules.py's static jit-site index",
+        "# (tests/test_zz_compilewitness.py), and the runtime compile witness",
+        "# (dragonfly2_tpu/utils/dftrace.py) validates observed counts during",
+        "# tier-1.  Calibrate: run tier-1 with DF_COMPILE_OBSERVED=<path>.",
+        "# Regenerate keys: python -m tools.dflint --update-compile-budget",
+        "# (existing bounds are preserved; new sites start at "
+        f"{DEFAULT_BUDGET}).",
+        "",
+        "[budget]",
+    ]
+    for key in sorted(set(keys)):
+        lines.append(f'"{key}" = {existing.get(key, DEFAULT_BUDGET)}')
+    return "\n".join(lines) + "\n"
+
+
+def budget_staleness(
+    analysis: TraceAnalysis, budget: Dict[str, int]
+) -> List[str]:
+    """Key-set drift between the checked-in budget and the static jit-site
+    index — same discipline as baseline.toml / the §16 lock graph."""
+    static = analysis.jit_site_keys()
+    out = []
+    for key in sorted(set(budget) - static):
+        out.append(
+            f"stale budget entry {key!r}: no static jit construction "
+            "there any more (site removed/moved — regenerate)"
+        )
+    for key in sorted(static - set(budget)):
+        out.append(
+            f"unbudgeted jit construction site {key!r}: add a budget "
+            "entry (python -m tools.dflint --update-compile-budget)"
+        )
+    return out
+
+
+def witness_compile_gaps(
+    analysis: TraceAnalysis,
+    observed: Dict[Tuple[str, int], dict],
+    budget: Dict[str, int],
+) -> List[str]:
+    """Cross-validate runtime jit creations (from
+    ``dragonfly2_tpu.utils.dftrace``) against the static site index and
+    the compile budget.  ``observed`` maps creation site (relpath,
+    lineno) -> {"creations", "calls", "max_compiles"}.
+
+    Empty result == every runtime creation is statically known and
+    within budget.  A gap is either a STATIC BLIND SPOT (unknown site —
+    fix the tracerules site indexer / cache the construction) or a
+    RETRACE (count over budget — a steady-state path is recompiling)."""
+    index = analysis.jit_site_index()
+    gaps: List[str] = []
+    for (relpath, lineno), stats in sorted(observed.items()):
+        key = index.get((relpath, lineno))
+        if key is None:
+            gaps.append(
+                f"jit created at {relpath}:{lineno} "
+                f"({stats.get('creations', '?')} creation(s), "
+                f"{stats.get('calls', '?')} call(s)) is unknown to the "
+                "static jit-site index — a per-call/uncached construction "
+                "or a tracerules resolver blind spot"
+            )
+            continue
+        limit = budget.get(key)
+        if limit is None:
+            gaps.append(
+                f"jit creation at {key} ({relpath}:{lineno}) has no "
+                "compile-budget entry — run "
+                "python -m tools.dflint --update-compile-budget"
+            )
+            continue
+        if stats.get("max_compiles", 0) > limit:
+            gaps.append(
+                f"{key} compiled {stats['max_compiles']}x (budget "
+                f"{limit}) over {stats.get('calls', '?')} call(s) — a "
+                "steady-state path is retracing; fix the shape/"
+                "static-arg churn or raise the budget with a review"
+            )
+    return gaps
+
+
+def trace_findings(program: Program, root: Optional[Path] = None) -> List[Finding]:
+    return TraceAnalysis(program, root).findings()
