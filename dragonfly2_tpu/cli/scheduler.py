@@ -44,16 +44,20 @@ def build(cfg: SchedulerConfigFile):
                 collect_interval=cfg.network_topology.collect_interval_s,
             ),
         )
-    # ml algorithm gets the full serving engine: host-feature cache +
-    # cross-request scorer micro-batching (DESIGN.md §14).  Sized/paced
-    # from config so operators can tune linger vs latency per cluster.
-    feature_cache = batcher = None
-    if cfg.scheduling.algorithm == "ml":
-        from ..scheduler import HostFeatureCache, ScorerBatcher
+    # Every algorithm gets the columnar host store (DESIGN.md §18): the
+    # slot matrix is the source of truth for host serving state, and
+    # announce decode writes columns on arrival for the rule path too.
+    # Only ml additionally gets cross-request scorer micro-batching.
+    # Sized/paced from config so operators can tune per cluster.
+    from ..scheduler import HostFeatureCache
 
-        feature_cache = HostFeatureCache(
-            max_hosts=cfg.scheduling.eval_feature_cache_hosts
-        )
+    feature_cache = HostFeatureCache(
+        max_hosts=cfg.scheduling.eval_feature_cache_hosts
+    )
+    batcher = None
+    if cfg.scheduling.algorithm == "ml":
+        from ..scheduler import ScorerBatcher
+
         batcher = ScorerBatcher(
             linger_s=cfg.scheduling.eval_batch_linger_ms / 1e3
         )

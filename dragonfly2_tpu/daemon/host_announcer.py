@@ -44,9 +44,12 @@ class HostAnnouncer:
             self.host.stats.disk = info.disk
         self.host.touch()
         if hasattr(self.scheduler, "announce_host"):
-            self.scheduler.announce_host(self.host)  # wire client
+            # Wire client AND the embedded SchedulerService (whose
+            # announce_host refreshes stats and writes the columnar host
+            # state on arrival, DESIGN.md §18).
+            self.scheduler.announce_host(self.host)
         else:
-            self.scheduler.resource.store_host(self.host)  # embedded
+            self.scheduler.resource.store_host(self.host)  # bare Resource shims
 
     def serve(self) -> None:
         if self._thread is not None:

@@ -175,7 +175,11 @@ class ModelRegistry:
         ip: str = "",
         hostname: str = "",
     ) -> Model:
-        if type not in ("gnn", "mlp"):
+        # mlp_int8 / mlp_bf16: post-training-quantized serving variants
+        # (trainer/export.quantize_scorer) — registered as CANDIDATEs and
+        # admitted to ACTIVE only through the rollout plane's replay
+        # gates (DESIGN.md §18).
+        if type not in ("gnn", "mlp", "mlp_int8", "mlp_bf16"):
             raise ValueError(f"unknown model type {type!r}")
         with self._mu:
             version = (

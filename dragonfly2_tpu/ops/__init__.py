@@ -11,6 +11,10 @@ the FLOPs-heavy core of the trainer, with three implementations:
   as a sequence of one-hot MXU matmuls over bucketed edge blocks (the
   TPU-native way to scatter-accumulate: the MXU does the reduction,
   no serialized scatter).
+- ``pallas_score``   — the scheduler serving plane's fused slot-row
+  gather + mask-folded MLP scoring kernel over the columnar host
+  store's slot matrix (DESIGN.md §18), plus the rule path's
+  weighted-sum matvec arm.
 - ``parallel.graph_sharding`` (sibling package) — shard_map-partitioned
   aggregation for graphs larger than one chip.
 """
@@ -24,6 +28,12 @@ from .pallas_segment import (  # noqa: F401
     bucket_edges_by_block,
     make_neighbor_gather,
     segment_sum_pallas,
+)
+from .pallas_score import (  # noqa: F401
+    FusedMLPScorer,
+    fold_post_hoc_weights,
+    rule_weighted_sum,
+    split_first_layer,
 )
 from .transpose_gather import (  # noqa: F401
     build_transpose_table,

@@ -90,15 +90,47 @@ CONTRACTS = {
             "ColumnarWriter.__init__.dtype": "float32",
         },
     },
-    # -- HostFeatureCache slot matrix (scheduler/featcache.py) -------------
+    # -- Columnar host store (scheduler/featcache.py, DESIGN.md §18) -------
+    # The slot matrix is the SOURCE OF TRUTH for host serving state:
+    # every column is creation-site pinned, so widening any of them (or
+    # adding an unpinned float64 construction to a producer) fails lint
+    # by contract name.  float64 is DELIBERATE for the timestamp and the
+    # pre-scaled rule-score columns: they must reproduce the scalar
+    # oracle's python-double math bit-for-bit (host code, never traced).
     "featcache.slots": {
         "file": "dragonfly2_tpu/scheduler/featcache.py",
+        "dtype": "float32",
+        "allow": ["float64"],
         "attrs": {
             "HostFeatureCache._matrix": "float32",
             "HostFeatureCache._bucket_col": "int64",
             "HostFeatureCache._idc_col": "int64",
+            "HostFeatureCache._idc_ci_col": "int64",
             "HostFeatureCache._loc_col": "int64",
+            "HostFeatureCache._upload_count_col": "int64",
+            "HostFeatureCache._upload_failed_col": "int64",
+            "HostFeatureCache._concurrent_upload_col": "int64",
+            "HostFeatureCache._upload_limit_col": "int64",
+            "HostFeatureCache._peer_count_col": "int64",
+            "HostFeatureCache._updated_at_col": "float64",
+            "HostFeatureCache._rule_w_cols": "float64",
+            "HostFeatureCache._pair_col": "int64",
+            "HostFeatureCache._type_normal_col": "int8",
+            "HostFeatureCache._stamp_col": "int64",
         },
+        "functions": [
+            "HostFeatureCache.serve",
+            "HostFeatureCache.rule_serve",
+            "HostFeatureCache.rule_scores",
+            "HostFeatureCache.gather_with_buckets",
+            "HostFeatureCache._fill_slot_locked",
+            "HostFeatureCache._derive_upload_cells",
+            "HostFeatureCache.write_upload_state",
+            "HostFeatureCache._serve_uncached",
+            "HostFeatureCache._rule_serve_uncached",
+            "HostFeatureCache._aff_row_locked",
+            "HostFeatureCache._pair_row_locked",
+        ],
     },
     # -- scorer blob arrays (trainer/export.py) ----------------------------
     "scorer.mlp": {
@@ -115,6 +147,21 @@ CONTRACTS = {
             "load_scorer",
             "MLPScorer.score",
             "MLPScorer._serving_weights",
+        ],
+    },
+    # int8/bf16 post-training-quantized serving variant: the blob packs
+    # quantized payloads + per-channel scales next to the drift
+    # histograms; scoring runs the float32 DEQUANTIZED weights, so every
+    # producer below must stay float32-out (int8/uint16 payloads are the
+    # storage form, not a compute dtype).
+    "scorer.quantized": {
+        "file": "dragonfly2_tpu/trainer/export.py",
+        "dtype": "float32",
+        "functions": [
+            "quantize_scorer",
+            "_int8_quantize",
+            "_bf16_round",
+            "_dequantize_layers",
         ],
     },
     "scorer.gnn": {
@@ -146,5 +193,24 @@ CONTRACTS = {
         "file": "dragonfly2_tpu/ops/transpose_gather.py",
         "dtype": "float32",
         "functions": ["build_transpose_table", "make_transpose_gather"],
+    },
+    # Fused slot-row gather + mask-folded MLP scoring kernel over the
+    # columnar host store's slot matrix (DESIGN.md §18): everything is
+    # float32 end to end (slot ids int32 are the storage/index form).
+    "ops.fused_score": {
+        "file": "dragonfly2_tpu/ops/pallas_score.py",
+        "dtype": "float32",
+        "functions": [
+            "fold_post_hoc_weights",
+            "split_first_layer",
+            "_fused_score_kernel",
+            "_fused_score_call",
+            "FusedMLPScorer.score",
+            "FusedMLPScorer.score_rows",
+            "FusedMLPScorer._sync_mirror",
+            "_rule_sum_kernel",
+            "_rule_sum_call",
+            "rule_weighted_sum",
+        ],
     },
 }

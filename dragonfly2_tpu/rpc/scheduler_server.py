@@ -124,18 +124,10 @@ class SchedulerRPCAdapter:
         negotiated = negotiate(int(req.get("protocol_version") or 1))
         host = host_from_wire(req["host"])
         host.protocol_version = negotiated
-        stored = self.service.resource.store_host(host)
-        if stored is not host:
-            # Refresh announce-time stats AND addresses on the existing
-            # record — a restarted daemon announces a fresh download_port
-            # and children must not be handed the dead one.
-            stored.protocol_version = negotiated
-            stored.stats = host.stats
-            stored.concurrent_upload_limit = host.concurrent_upload_limit
-            stored.ip = host.ip
-            stored.port = host.port
-            stored.download_port = host.download_port
-            stored.touch()
+        # The service owns the announce decode (stats refresh + columnar
+        # write-on-arrival, DESIGN.md §18) — the adapter only negotiates.
+        stored = self.service.announce_host(host)
+        stored.protocol_version = negotiated
         return {"protocol": protocol_info(negotiated, self.capabilities)}
 
     def register_peer(self, req: dict) -> dict:

@@ -154,6 +154,25 @@ def location_affinity_score(dst: str, src: str) -> float:
     return score / MAX_ELEMENT_LEN
 
 
+# Label-bound histogram children per algorithm: label resolution paid
+# once, not per announce (utils.metrics._HistogramChild).
+_EVAL_SECONDS_CHILDREN: dict = {}
+
+
+def _eval_seconds(algorithm: str):
+    child = _EVAL_SECONDS_CHILDREN.get(algorithm)
+    if child is None:
+        child = _EVAL_SECONDS_CHILDREN[algorithm] = metrics.EVAL_SECONDS.labels(
+            algorithm=algorithm
+        )
+    return child
+
+
+# Piece-score weight for the columnar rule path (the host-side term
+# weights are baked into the store's pre-scaled columns, featcache.py).
+_W_PIECE = 0.2
+
+
 class Evaluator:
     """Base (rule-based) evaluator + shared bad-node detection.
 
@@ -161,9 +180,25 @@ class Evaluator:
     ``evaluate_all`` computes the same weighted sum for ALL parents in
     one set of numpy expressions — identical operation order per
     element, so scores (and therefore orderings) match bit-for-bit.
+
+    With a columnar host store attached (``feature_cache``, DESIGN.md
+    §18), the host-side score terms come pre-scaled straight off the
+    slot columns (one locked gather), and the only per-parent Python
+    work left is one fromiter over the peers — the attribute gathers
+    that kept ``vector_rule`` at ~1× are gone.  Without a store the
+    PR-3 fromiter path is kept verbatim (NetworkTopologyEvaluator and
+    storeless constructions still use it).
     """
 
     ALGORITHM = DEFAULT_ALGORITHM
+    _feature_cache: Optional[HostFeatureCache] = None
+
+    def __init__(self, feature_cache: Optional[HostFeatureCache] = None) -> None:
+        self._feature_cache = feature_cache
+
+    @property
+    def feature_cache(self) -> Optional[HostFeatureCache]:
+        return self._feature_cache
 
     def evaluate(self, parent: Peer, child: Peer, total_piece_count: int) -> float:
         return (
@@ -251,13 +286,59 @@ class Evaluator:
         self, parents: Sequence[Peer], child: Peer, total_piece_count: int
     ) -> np.ndarray:
         """[n] float64 scores — one numpy expression over all parents,
-        term order matching ``evaluate`` so every element is bit-equal."""
-        ps, us, fs, hts, idcs, locs = self._component_arrays(
-            parents, child, total_piece_count
-        )
-        return (
-            0.2 * ps + 0.2 * us + 0.15 * fs + 0.15 * hts + 0.15 * idcs + 0.15 * locs
-        )
+        term order matching ``evaluate`` so every element is bit-equal.
+        With a columnar host store attached the host-side terms are
+        pre-scaled column gathers; fromiter fallback otherwise."""
+        cache = self._feature_cache
+        if cache is None:
+            ps, us, fs, hts, idcs, locs = self._component_arrays(
+                parents, child, total_piece_count
+            )
+            return (
+                0.2 * ps + 0.2 * us + 0.15 * fs + 0.15 * hts + 0.15 * idcs + 0.15 * locs
+            )
+        return self._evaluate_all_columnar(cache, parents, child, total_piece_count)
+
+    def _evaluate_all_columnar(  # dflint: hotpath
+        self, cache: HostFeatureCache, parents, child: Peer, total_piece_count: int
+    ) -> np.ndarray:
+        """Columnar rule scoring: host terms come pre-scaled off the slot
+        columns (``RuleGather``); the only per-parent Python pass reads
+        the two PEER-side inputs (finished-piece count, FSM-state
+        mirror).  Term order and every float product match ``evaluate``
+        bit-for-bit: the pre-scaled columns are written with the exact
+        per-host math the scalar path runs per call (featcache
+        write-through), and multiplication/addition order is preserved
+        below."""
+        n = len(parents)
+        if n == 0:
+            return np.zeros(0, dtype=np.float64)
+        # Steady state: one lock-free featcache call computes the whole
+        # score vector (slot gather + pre-scaled adds) — see
+        # HostFeatureCache.rule_scores for the seqlock discipline.
+        score = cache.rule_scores(child, parents, total_piece_count)
+        if score is not None:
+            return score
+        sv = cache.rule_serve(child.host, parents)
+        enc = sv.peer_enc
+        counts = enc >> 1
+        if total_piece_count > 0:
+            score = _W_PIECE * (counts / total_piece_count)
+        else:
+            score = _W_PIECE * (counts - child.finished_piece_count())
+        # In-place adds: bitwise identical to out-of-place, half the
+        # allocation churn on a path measured in numpy dispatches.  The
+        # host-type term is a pairwise gather — column 2 + elevated bit
+        # holds the exact scalar 0.15 * host_type_score product for that
+        # (host type, peer state) combination (featcache fill).
+        w = sv.w_host
+        np.add(score, w[:, 0], out=score)
+        np.add(score, w[:, 1], out=score)
+        np.add(score, sv.w_ht, out=score)
+        aff = sv.w_aff
+        np.add(score, aff[:, 0], out=score)
+        np.add(score, aff[:, 1], out=score)
+        return score
 
     def evaluate_parents(  # dflint: hotpath
         self, parents: List[Peer], child: Peer, total_piece_count: int
@@ -265,14 +346,27 @@ class Evaluator:
         if len(parents) <= 1:
             return list(parents)
         t0 = time.perf_counter()
-        scores = self.evaluate_all(parents, child, total_piece_count)
-        # Stable descending sort == sorted(reverse=True): ties keep their
-        # candidate-sample order on both paths.
-        order = np.argsort(-scores, kind="stable")
-        metrics.EVAL_SECONDS.observe(
-            time.perf_counter() - t0, algorithm=self.ALGORITHM
+        # Steady-state shortcut: one lock-free featcache call computes
+        # the whole score vector (rule_scores); evaluate_all covers every
+        # other condition with identical bit-level results.
+        cache = self._feature_cache
+        scores = (
+            cache.rule_scores(child, parents, total_piece_count)
+            if cache is not None
+            else None
         )
-        return [parents[i] for i in order]
+        if scores is None:
+            scores = self.evaluate_all(parents, child, total_piece_count)
+        # Stable descending sort == sorted(reverse=True): ties keep their
+        # candidate-sample order on both paths.  The negation runs in
+        # place (scores is this announce's private array) and the order
+        # iterates as python ints — both measured on the announce path.
+        np.negative(scores, out=scores)
+        order = scores.argsort(kind="stable")
+        _eval_seconds(self.ALGORITHM).observe(time.perf_counter() - t0)
+        # order is a host-side numpy array (no device transfer): tolist
+        # only converts to python ints for the C-level map/getitem.
+        return list(map(parents.__getitem__, order.tolist()))  # dflint: disable=DF011
 
     def evaluate_parents_reference(
         self, parents: List[Peer], child: Peer, total_piece_count: int
@@ -586,31 +680,22 @@ class MLEvaluator(Evaluator):
         ``_featurize_reference``."""
         return self._featurize_batch(parents, child)[0]
 
-    def _featurize_batch(  # dflint: hotpath
-        self, parents: Sequence[Peer], child: Peer
-    ):
-        """(_featurize rows, src hash buckets [n], child hash bucket) —
-        buckets and the idc/location affinity terms all ride the cache's
-        single-lock serve sweep (featcache.ServingGather)."""
-        n = len(parents)
-        sv = self._feature_cache.serve(child.host, [p.host for p in parents])
+    def _edge_inputs(self, sv, parents: Sequence[Peer], child: Peer, n: int) -> dict:
+        """The ``edge_features_batch`` kwargs for one candidate set —
+        shared by the assembled-matrix featurizer and the fused
+        slot-path featurizer.  ONE python pass for both per-peer reads
+        (direct len() read — GIL-atomic, see _component_arrays)."""
         task = child.task
         piece_size = task.piece_size or (4 << 20)
         trunc_counts, trunc_lens, full_counts = self._served_stats(
             child, parents, piece_size
         )
-        # ONE python pass for both per-peer reads (direct len() read —
-        # GIL-atomic, see _component_arrays).
         fin_cost = np.fromiter(
             ((len(p.finished_pieces), p.cost_ns) for p in parents),
             dtype=np.dtype((np.int64, 2)),
             count=n,
         )
-        h = sv.child_row.shape[0]
-        out = np.empty((n, 2 * h + _EDGE_DIM), dtype=np.float32)
-        out[:, :h] = sv.child_row
-        out[:, h : 2 * h] = sv.rows
-        _edge_features_batch(
+        return dict(
             same_idc=sv.same_idc,
             location_affinity=sv.location_affinity,
             served_counts=trunc_counts,
@@ -620,9 +705,39 @@ class MLEvaluator(Evaluator):
             total_piece_count=max(task.total_piece_count, 0),
             cost_ns=fin_cost[:, 1],
             upload_piece_counts=full_counts,
-            out=out[:, 2 * h :],  # written in place, no temp + copy
         )
+
+    def _featurize_batch(  # dflint: hotpath
+        self, parents: Sequence[Peer], child: Peer
+    ):
+        """(_featurize rows, src hash buckets [n], child hash bucket) —
+        buckets and the idc/location affinity terms all ride the cache's
+        single-lock serve sweep (featcache.ServingGather)."""
+        n = len(parents)
+        sv = self._feature_cache.serve(child.host, [p.host for p in parents])
+        kw = self._edge_inputs(sv, parents, child, n)
+        h = sv.child_row.shape[0]
+        out = np.empty((n, 2 * h + _EDGE_DIM), dtype=np.float32)
+        out[:, :h] = sv.child_row
+        out[:, h : 2 * h] = sv.rows
+        # written in place, no temp + copy
+        _edge_features_batch(out=out[:, 2 * h :], **kw)
         return out, sv.src_buckets, sv.dst_bucket
+
+    def _featurize_slots(  # dflint: hotpath
+        self, parents: Sequence[Peer], child: Peer
+    ):
+        """(edge block [n, E], parent slot ids, child slot id, buckets)
+        for a fused gather+score scorer (ops/pallas_score.py): the host
+        feature rows are NOT assembled host-side — the kernel gathers
+        them from its device mirror of the slot matrix by slot id, so
+        the per-announce host cost is the edge block alone.  Slot ids
+        are None when the store served uncached (oversized set)."""
+        n = len(parents)
+        sv = self._feature_cache.serve(child.host, [p.host for p in parents])
+        kw = self._edge_inputs(sv, parents, child, n)
+        edge = _edge_features_batch(**kw)
+        return edge, sv.src_slots, sv.child_slot, sv.src_buckets, sv.dst_bucket
 
     def _featurize_reference(self, parents: Sequence[Peer], child: Peer) -> np.ndarray:
         """Pre-vectorization featurizer, kept verbatim: one
@@ -657,7 +772,13 @@ class MLEvaluator(Evaluator):
             return list(parents)
         t0 = time.perf_counter()
         # Canary routing: one snapshot read; with no rollout in flight
-        # this is a None-compare and the path below is unchanged.
+        # this is a None-compare and the path below is unchanged.  The
+        # scorer that will score THIS announce (``engine``) is resolved
+        # HERE, atomically with the route decision, and — for candidate
+        # arms — carried into the batcher flush as a pinned snapshot: a
+        # rollout transition mid-linger (e.g. float → quantized
+        # candidate swap) can therefore never mix scorer snapshots
+        # inside one coalesced call (tests/test_rollout.py).
         canary = self._canary
         use_candidate = False
         if canary is not None:
@@ -665,56 +786,107 @@ class MLEvaluator(Evaluator):
             metrics.CANARY_ANNOUNCES_TOTAL.inc(
                 arm="candidate" if use_candidate else "active"
             )
+        engine = canary.scorer if use_candidate else scorer
+        shadow = self._shadow
         try:
             cache = self._feature_cache
-            # Identity-only scorers (GNN embedding lookup) skip featurization —
-            # building the feature matrix is the expensive part of this path.
-            if getattr(scorer, "wants_features", True):
-                feats, src_buckets, dst_bucket = self._featurize_batch(
-                    parents, child
+            feats = None
+            n = len(parents)
+            if getattr(engine, "wants_slots", False) and shadow is None:
+                # Fused gather+score: the scorer gathers host rows from
+                # its device mirror of the slot matrix by slot id — only
+                # the edge block is built host-side.  (With a shadow
+                # engine attached the assembled path below runs instead:
+                # the shadow comparison needs the full feature matrix.)
+                edge, src_slots, child_slot, src_buckets, dst_bucket = (
+                    self._featurize_slots(parents, child)
                 )
-            else:
-                feats = np.zeros((len(parents), 0), dtype=np.float32)
-                src_buckets = np.fromiter(
-                    (cache.bucket(p.host) for p in parents),
-                    np.int64,
-                    count=len(parents),
-                )
-                dst_bucket = cache.bucket(child.host)
-            # broadcast_to: the scorer only reads the buckets — no
-            # per-announce materialized array.
-            dst_buckets = np.broadcast_to(
-                np.int64(dst_bucket), (len(parents),)
-            )
-            if self._batcher is not None:
-                scores = np.asarray(
-                    self._batcher.score(
-                        feats,
-                        src_buckets=src_buckets,
-                        dst_buckets=dst_buckets,
-                        candidate=use_candidate,
+                if src_slots is not None:
+                    dst_slots = np.broadcast_to(np.int64(child_slot), (n,))
+                    if self._batcher is not None:
+                        # Slot-path requests ALWAYS pin their snapshot:
+                        # the payload shape is scorer-specific, so a
+                        # flush snapshot swap must not re-route them.
+                        scores = np.asarray(
+                            self._batcher.score(
+                                edge,
+                                src_buckets=src_slots,
+                                dst_buckets=dst_slots,
+                                candidate=use_candidate,
+                                scorer=engine,
+                            )
+                        )
+                    else:
+                        scores = np.asarray(
+                            engine.score(
+                                edge, src_buckets=src_slots, dst_buckets=dst_slots
+                            )
+                        )
+                else:
+                    # Store served uncached (oversized candidate set) —
+                    # no slots exist; score the assembled rows with the
+                    # scorer's reference path.
+                    feats, src_buckets, dst_bucket = self._featurize_batch(
+                        parents, child
                     )
-                )
+                    scores = np.asarray(engine.score_rows(feats))
             else:
-                engine = canary.scorer if use_candidate else scorer
-                scores = np.asarray(
-                    engine.score(
-                        feats, src_buckets=src_buckets, dst_buckets=dst_buckets
+                # Identity-only scorers (GNN embedding lookup) skip
+                # featurization — building the feature matrix is the
+                # expensive part of this path.
+                fused = getattr(engine, "wants_slots", False)
+                if getattr(engine, "wants_features", True):
+                    feats, src_buckets, dst_bucket = self._featurize_batch(
+                        parents, child
                     )
-                )
+                else:
+                    feats = np.zeros((n, 0), dtype=np.float32)
+                    src_buckets = np.fromiter(
+                        (cache.bucket(p.host) for p in parents),
+                        np.int64,
+                        count=n,
+                    )
+                    dst_bucket = cache.bucket(child.host)
+                # broadcast_to: the scorer only reads the buckets — no
+                # per-announce materialized array.
+                dst_buckets = np.broadcast_to(np.int64(dst_bucket), (n,))
+                if fused:
+                    # Fused scorer forced onto the assembled path (the
+                    # shadow engine needs the full feature matrix):
+                    # score via its reference path, off the batcher.
+                    scores = np.asarray(engine.score_rows(feats))
+                elif self._batcher is not None:
+                    scores = np.asarray(
+                        self._batcher.score(
+                            feats,
+                            src_buckets=src_buckets,
+                            dst_buckets=dst_buckets,
+                            candidate=use_candidate,
+                            # Candidate arms pin the snapshot resolved
+                            # with the route decision; active arms keep
+                            # the flush-snapshot coalescing economics.
+                            scorer=engine if use_candidate else None,
+                        )
+                    )
+                else:
+                    scores = np.asarray(
+                        engine.score(
+                            feats, src_buckets=src_buckets, dst_buckets=dst_buckets
+                        )
+                    )
         except Exception as exc:  # noqa: BLE001 — degrade to rules, never fail the announce
             logger.warning("ML scorer path failed (%s); ranking with rules", exc)
             return super().evaluate_parents(parents, child, total_piece_count)
         # Shadow comparison rides the arrays this announce already built
         # (zero extra featurization); only active-armed announces offer —
-        # the comparison needs the ACTIVE scores as its baseline.
-        shadow = self._shadow
-        if shadow is not None and not use_candidate:
+        # the comparison needs the ACTIVE scores as its baseline.  The
+        # fused fast path never offers (feats is None) — it only engages
+        # with no shadow attached.
+        if shadow is not None and not use_candidate and feats is not None:
+            dst_buckets = np.broadcast_to(np.int64(dst_bucket), (len(parents),))
             shadow.offer(child.host.id, feats, src_buckets, dst_buckets, scores)
         order = np.argsort(-scores, kind="stable")
-        metrics.EVAL_SECONDS.observe(
-            time.perf_counter() - t0, algorithm=self.ALGORITHM
-        )
+        _eval_seconds(self.ALGORITHM).observe(time.perf_counter() - t0)
         return [parents[i] for i in order]
 
     def _evaluate_parents_reference(
@@ -755,4 +927,7 @@ def new_evaluator(
         return NetworkTopologyEvaluator(networktopology)
     if algorithm == ML_ALGORITHM:
         return MLEvaluator(scorer, feature_cache=feature_cache, batcher=batcher)
-    return Evaluator()
+    # The rule evaluator gets the columnar host store too (DESIGN.md
+    # §18): with one attached, host-side score terms gather pre-scaled
+    # off the slot columns instead of per-parent attribute reads.
+    return Evaluator(feature_cache=feature_cache)

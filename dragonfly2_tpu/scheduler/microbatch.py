@@ -29,11 +29,15 @@ Mechanics (DESIGN.md §14):
   (utils.faultinject, DF004 inventory).  A dropped/failed coalesced call
   degrades to per-request scoring; announces never stall on the batcher
   (chaos drill in tests/test_chaos.py).
-- **canary arms** — requests carry a ``candidate`` flag (DESIGN.md §15
-  canary serving); a flush splits by arm and scores each group with its
-  own scorer snapshot, so coalescing survives a canary without ever
-  mixing model versions inside one call.  A candidate uninstalled
-  mid-queue pins its requests to the active scorer.
+- **canary arms / pinned snapshots** — requests carry a ``candidate``
+  flag (DESIGN.md §15 canary serving) and, when the caller resolved a
+  scorer atomically with its CanaryRoute decision, the exact scorer
+  snapshot (DESIGN.md §18).  A flush groups by SNAPSHOT and scores each
+  group with its own scorer, so coalescing survives a canary — or a
+  float→quantized rollout transition mid-linger — without ever mixing
+  model versions or precisions inside one call.  A candidate
+  uninstalled mid-queue pins its unpinned requests to the active
+  scorer.
 
 The scorer contract this relies on is row-independence: ``score`` must
 score each row from that row (+ its buckets) alone, so padded rows and
@@ -47,7 +51,8 @@ import bisect
 import logging
 import threading
 import time
-from typing import List, Optional
+from collections import OrderedDict
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -65,15 +70,26 @@ class ScorerUnavailable(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("features", "src", "dst", "candidate", "done", "result", "error")
+    __slots__ = (
+        "features", "src", "dst", "candidate", "scorer", "done", "result", "error",
+    )
 
-    def __init__(self, features, src, dst, candidate=False) -> None:
+    def __init__(self, features, src, dst, candidate=False, scorer=None) -> None:
         self.features = features
         self.src = src
         self.dst = dst
         # Canary arm (DESIGN.md §15): True routes this request to the
         # flush's candidate-scorer snapshot instead of the active one.
         self.candidate = candidate
+        # Pinned scorer snapshot, captured by the caller ATOMICALLY with
+        # its CanaryRoute decision (DESIGN.md §18): a rollout transition
+        # mid-linger (float → quantized candidate swap) must never score
+        # this request with a different snapshot than the one its route
+        # decision saw, and requests pinned to different snapshots must
+        # never share one coalesced call.  None = use the flush snapshot
+        # (legacy behavior, also what pins a candidate-gone request to
+        # the active scorer).
+        self.scorer = scorer
         self.done = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
@@ -128,9 +144,9 @@ class ScorerBatcher:
 
     # -- the EdgeScorer surface ----------------------------------------------
 
-    def score(self, features, *, src_buckets=None, dst_buckets=None, candidate=False):  # dflint: hotpath
+    def score(self, features, *, src_buckets=None, dst_buckets=None, candidate=False, scorer=None):  # dflint: hotpath
         features = np.asarray(features, dtype=np.float32)
-        req = _Request(features, src_buckets, dst_buckets, candidate)
+        req = _Request(features, src_buckets, dst_buckets, candidate, scorer)
         with self._cv:
             self._pending.append(req)
             self._pending_rows += features.shape[0]
@@ -189,19 +205,33 @@ class ScorerBatcher:
         return ((rows + top - 1) // top) * top
 
     def _dispatch(self, batch: List[_Request], scorer, candidate=None) -> None:
-        """Split the flush by canary arm (requests for different model
-        versions must not share a scorer call) and score each group
-        coalesced with its own scorer snapshot."""
-        cand_group = [r for r in batch if r.candidate]
-        if not cand_group:
-            self._dispatch_group(batch, scorer)
-            return
-        active_group = [r for r in batch if not r.candidate]
-        if active_group:
-            self._dispatch_group(active_group, scorer)
-        self._dispatch_group(
-            cand_group, candidate if candidate is not None else scorer
-        )
+        """Split the flush by SCORER SNAPSHOT (requests for different
+        model versions/precisions must not share a scorer call) and
+        score each group coalesced with its own snapshot.
+
+        A request's snapshot is, in priority order: the scorer it was
+        pinned to at enqueue time (captured atomically with its
+        CanaryRoute decision — a rollout transition mid-linger can
+        therefore never produce a mixed-precision call), else the
+        flush's candidate snapshot for canary-tagged requests (active
+        when the candidate vanished mid-queue — pinned, never an
+        error), else the flush's active snapshot."""
+        groups: "OrderedDict[int, Tuple[object, List[_Request]]]" = OrderedDict()
+        for r in batch:
+            if r.scorer is not None:
+                engine = r.scorer
+            elif r.candidate:
+                engine = candidate if candidate is not None else scorer
+            else:
+                engine = scorer
+            key = id(engine)
+            grp = groups.get(key)
+            if grp is None:
+                groups[key] = (engine, [r])
+            else:
+                grp[1].append(r)
+        for engine, group in groups.values():
+            self._dispatch_group(group, engine)
 
     def _dispatch_group(self, batch: List[_Request], scorer) -> None:
         try:

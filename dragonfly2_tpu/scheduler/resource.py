@@ -134,7 +134,21 @@ class HostStats:
 
 
 class Host:
-    """A peer machine (scheduler/resource/host.go)."""
+    """A peer machine (scheduler/resource/host.go).
+
+    Columnar ownership (DESIGN.md §18): when a ``HostFeatureCache`` binds
+    this host to a slot (``_cols = (store, slot)``), the store's slot
+    columns become the *source of truth* for the hot serving fields —
+    upload counters/limit, ``updated_at``, peer count — and the shadow
+    attributes here go stale until detach copies the columns back.  The
+    property accessors read/write through the binding, so every legacy
+    caller (``to_record``, the scalar ``*_reference`` oracles, tests)
+    observes exactly the column state; the serving gather never touches
+    this object at all.  The binding is flipped only while holding BOTH
+    the store lock and this host's lock (store → host order, §16), and
+    ``_mut`` is a monotonic mutation stamp bumped by every write so
+    non-owning caches can validate their copies.
+    """
 
     def __init__(
         self,
@@ -161,42 +175,178 @@ class Host:
         self.scheduler_cluster_id = scheduler_cluster_id
         self.stats = HostStats()
         self._mu = threading.Lock()
-        self.concurrent_upload_limit = concurrent_upload_limit
-        self.concurrent_upload_count = 0
-        self.upload_count = 0
-        self.upload_failed_count = 0
+        # Columnar binding + mutation stamp come FIRST: the property
+        # setters below consult them.
+        self._cols = None  # (HostFeatureCache, slot) when column-owned
+        # Slot in the process's PRIMARY store (featcache._primary_ref),
+        # -1 otherwise: the lock-free rule gather validates ownership
+        # with ONE attribute read per candidate instead of a binding
+        # tuple walk (maintained by bind/detach).
+        self._pslot = -1
+        self._mut = 0
+        self._concurrent_upload_limit = concurrent_upload_limit
+        self._concurrent_upload_count = 0
+        self._upload_count = 0
+        self._upload_failed_count = 0
         self.peers: Dict[str, "Peer"] = {}
         self.created_at = time.time()
-        self.updated_at = self.created_at
+        self._updated_at = self.created_at
         # Negotiated wire dialect for this host's connections
         # (rpc/version.py; 1 = the legacy unversioned dialect).
         self.protocol_version = 1
 
+    # -- columnar thin-view accessors ---------------------------------------
+    #
+    # Getters are lock-free: a single column read is as atomic as the old
+    # plain attribute read, and the re-check of `_cols` closes the detach/
+    # slot-recycle window (a detach copies columns back to the shadows
+    # BEFORE clearing the binding, so a raced read falls back to a value
+    # at least as fresh).  Setters serialize under the host lock against
+    # bind/detach, which also hold it.
+
+    def _col_read(self, col_name: str, shadow_name: str):
+        b = self._cols
+        if b is None:
+            return getattr(self, shadow_name)
+        v = getattr(b[0], col_name)[b[1]]
+        if self._cols is b:
+            return v
+        return getattr(self, shadow_name)
+
+    @property
+    def upload_count(self) -> int:
+        return int(self._col_read("_upload_count_col", "_upload_count"))
+
+    @upload_count.setter
+    def upload_count(self, v: int) -> None:
+        with self._mu:
+            self._mut += 1
+            b = self._cols
+            if b is None:
+                self._upload_count = int(v)
+            else:
+                b[0].write_upload_state(b[1], self._mut, upload_count=int(v))
+
+    @property
+    def upload_failed_count(self) -> int:
+        return int(self._col_read("_upload_failed_col", "_upload_failed_count"))
+
+    @upload_failed_count.setter
+    def upload_failed_count(self, v: int) -> None:
+        with self._mu:
+            self._mut += 1
+            b = self._cols
+            if b is None:
+                self._upload_failed_count = int(v)
+            else:
+                b[0].write_upload_state(b[1], self._mut, upload_failed_count=int(v))
+
+    @property
+    def concurrent_upload_count(self) -> int:
+        return int(self._col_read("_concurrent_upload_col", "_concurrent_upload_count"))
+
+    @concurrent_upload_count.setter
+    def concurrent_upload_count(self, v: int) -> None:
+        with self._mu:
+            self._mut += 1
+            b = self._cols
+            if b is None:
+                self._concurrent_upload_count = int(v)
+            else:
+                b[0].write_upload_state(b[1], self._mut, concurrent_upload_count=int(v))
+
+    @property
+    def concurrent_upload_limit(self) -> int:
+        return int(self._col_read("_upload_limit_col", "_concurrent_upload_limit"))
+
+    @concurrent_upload_limit.setter
+    def concurrent_upload_limit(self, v: int) -> None:
+        with self._mu:
+            self._mut += 1
+            b = self._cols
+            if b is None:
+                self._concurrent_upload_limit = int(v)
+            else:
+                b[0].write_upload_state(b[1], self._mut, concurrent_upload_limit=int(v))
+
+    @property
+    def updated_at(self) -> float:
+        return float(self._col_read("_updated_at_col", "_updated_at"))
+
+    @updated_at.setter
+    def updated_at(self, v: float) -> None:
+        with self._mu:
+            self._mut += 1
+            b = self._cols
+            if b is None:
+                self._updated_at = float(v)
+            else:
+                b[0].write_updated_at(b[1], self._mut, float(v))
+
     def free_upload_count(self) -> int:
         with self._mu:
-            return self.concurrent_upload_limit - self.concurrent_upload_count
+            b = self._cols
+            if b is None:
+                return self._concurrent_upload_limit - self._concurrent_upload_count
+            store, slot = b
+            return int(store._upload_limit_col[slot]) - int(
+                store._concurrent_upload_col[slot]
+            )
 
     def acquire_upload(self) -> bool:
         with self._mu:
-            if self.concurrent_upload_count >= self.concurrent_upload_limit:
+            b = self._cols
+            if b is None:
+                if self._concurrent_upload_count >= self._concurrent_upload_limit:
+                    return False
+                self._mut += 1
+                self._concurrent_upload_count += 1
+                return True
+            store, slot = b
+            cur = int(store._concurrent_upload_col[slot])
+            if cur >= int(store._upload_limit_col[slot]):
                 return False
-            self.concurrent_upload_count += 1
+            self._mut += 1
+            store.write_upload_state(slot, self._mut, concurrent_upload_count=cur + 1)
             return True
 
     def release_upload(self, succeeded: bool = True) -> None:
         with self._mu:
-            self.concurrent_upload_count = max(self.concurrent_upload_count - 1, 0)
-            self.upload_count += 1
-            if not succeeded:
-                self.upload_failed_count += 1
+            self._mut += 1
+            b = self._cols
+            if b is None:
+                self._concurrent_upload_count = max(
+                    self._concurrent_upload_count - 1, 0
+                )
+                self._upload_count += 1
+                if not succeeded:
+                    self._upload_failed_count += 1
+                return
+            store, slot = b
+            failed = int(store._upload_failed_col[slot]) + (0 if succeeded else 1)
+            store.write_upload_state(
+                slot,
+                self._mut,
+                concurrent_upload_count=max(
+                    int(store._concurrent_upload_col[slot]) - 1, 0
+                ),
+                upload_count=int(store._upload_count_col[slot]) + 1,
+                upload_failed_count=failed,
+            )
 
     def store_peer(self, peer: "Peer") -> None:
         with self._mu:
             self.peers[peer.id] = peer
+            b = self._cols
+            if b is not None:
+                b[0].write_peer_count(b[1], len(self.peers))
 
     def delete_peer(self, peer_id: str) -> None:
         with self._mu:
             self.peers.pop(peer_id, None)
+            b = self._cols
+            if b is not None:
+                b[0].write_peer_count(b[1], len(self.peers))
 
     def peer_count(self) -> int:
         with self._mu:
@@ -211,7 +361,16 @@ class Host:
                 p.fsm.event("Leave")
 
     def touch(self) -> None:
-        self.updated_at = time.time()
+        """Announce-path stats refresh: for a column-owned host this
+        recomputes the whole slot row in place (stats may have changed —
+        the same contract the PR-3 stamp expressed: every feature-input
+        mutation must be accompanied by a ``touch``)."""
+        self._mut += 1
+        b = self._cols
+        if b is None:
+            self._updated_at = time.time()
+        else:
+            b[0].refresh_row(self)
 
     def to_record(self) -> schema.HostRecord:
         return schema.HostRecord(
@@ -483,7 +642,23 @@ class Peer:
         self.tag = tag
         self.application = application
         self.range: Optional[tuple] = None
-        self.fsm = FSM(PEER_PENDING, PEER_EVENTS)
+        # Lock-free FSM-state mirrors for the vectorized serving gather:
+        # `fsm.current` takes the FSM's RLock per read, which the rule
+        # evaluator paid once per candidate per announce.  The mirrors
+        # are written by the FSM's own enter_state callback (after the
+        # transition commits) and read GIL-atomically — the same
+        # different-instants snapshot consistency the scalar path's
+        # per-candidate locked reads already had.  ``fsm_elevated``
+        # pre-computes the host_type_score state test.
+        self.fsm_state = PEER_PENDING
+        self.fsm_elevated = False
+        # Packed serving encoding (finished_piece_count << 1 | elevated),
+        # maintained by finish_piece and the FSM mirror — the rule
+        # gather reads ONE attribute per candidate (featcache.rule_serve).
+        self._enc = 0
+        self.fsm = FSM(
+            PEER_PENDING, PEER_EVENTS, callbacks={"enter_state": self._mirror_fsm}
+        )
         self._mu = threading.Lock()
         self.finished_pieces: set[int] = set()
         self.piece_costs_ns: List[int] = []
@@ -497,6 +672,12 @@ class Peer:
         self.cost_ns = 0
         self.created_at = time.time()
         self.updated_at = self.created_at
+
+    def _mirror_fsm(self, fsm, event: str, src: str, dst: str) -> None:
+        self.fsm_state = dst
+        elevated = dst in (PEER_RECEIVED_NORMAL, PEER_RUNNING)
+        self.fsm_elevated = elevated
+        self._enc = (len(self.finished_pieces) << 1) | elevated
 
     def append_piece_cost(self, cost_ns: int) -> None:
         with self._mu:
@@ -529,6 +710,7 @@ class Peer:
             self.pieces[number] = Piece(
                 number, parent_id=parent_id, length=length, cost_ns=cost_ns
             )
+            self._enc = (len(self.finished_pieces) << 1) | self.fsm_elevated
         self.updated_at = time.time()
         return True
 

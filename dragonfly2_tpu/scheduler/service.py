@@ -91,6 +91,11 @@ class SchedulerService:
         self.hub = hub
         self._mu = threading.Lock()
         self._seed_triggered: set = set()  # task ids already warmed
+        # Columnar host store (DESIGN.md §18): when the evaluator carries
+        # one, announce decode binds hosts on arrival so their serving
+        # state lives in slot columns from birth and the evaluate path
+        # never marshals objects into the matrix.
+        self._host_store = getattr(scheduling.evaluator, "feature_cache", None)
 
     # -- registration -------------------------------------------------------
 
@@ -107,6 +112,12 @@ class SchedulerService:
         blocklist: Optional[Set[str]] = None,
     ) -> RegisterResult:
         host = self.resource.store_host(host)
+        if self._host_store is not None:
+            # Columnar from birth: registration is an announce — the
+            # host's serving state moves into the slot columns NOW, so
+            # the evaluate path finds a bound host (pure gather, no
+            # object→matrix marshalling).
+            self._host_store.adopt(host)
         host.touch()
         tid = task_id or idgen.task_id(url)
         task = self.resource.store_task(Task(tid, url, tag=tag, application=application))
@@ -190,6 +201,30 @@ class SchedulerService:
         elif schedule.kind is ScheduleResultKind.PARENTS:
             _try_event(peer.fsm, "Download")
         return RegisterResult(peer=peer, size_scope=scope, schedule=schedule)
+
+    def announce_host(self, host: Host) -> Host:
+        """Host stats announce (service_v2 AnnounceHost): store-or-refresh
+        the host record and WRITE ITS COLUMNS on arrival (DESIGN.md §18)
+        — the announce decode is the marshalling point, not the evaluate
+        path.  Both wire adapters and the in-process
+        ``daemon.host_announcer`` land here."""
+        stored = self.resource.store_host(host)
+        if stored is not host:
+            # Refresh announce-time stats AND addresses on the existing
+            # record — a restarted daemon announces a fresh download_port
+            # and children must not be handed the dead one.
+            stored.stats = host.stats
+            stored.concurrent_upload_limit = host.concurrent_upload_limit
+            stored.ip = host.ip
+            stored.port = host.port
+            stored.download_port = host.download_port
+        if self._host_store is not None:
+            self._host_store.adopt(stored)
+        # touch() on a bound host recomputes the whole slot row in place
+        # (the stats just changed) — the announce pays the marshalling
+        # once so every subsequent serve is a pure fancy-index.
+        stored.touch()
+        return stored
 
     def _refresh_gauges(self) -> None:
         metrics.HOSTS_GAUGE.set(len(self.resource.host_manager))

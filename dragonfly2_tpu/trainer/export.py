@@ -105,6 +105,107 @@ class MLPScorer:
         return x[..., 0]
 
 
+# ---------------------------------------------------------------------------
+# Post-training quantization: int8 / bf16 serving variants
+# ---------------------------------------------------------------------------
+
+QUANT_MODES = ("int8", "bf16")
+
+
+def _bf16_round(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(bf16 bit pattern uint16, float32 round-trip) of ``w`` with
+    round-to-nearest-even — bf16 is the top 16 bits of float32, so the
+    round-trip is pure bit math (no ml_dtypes dependency)."""
+    u = np.ascontiguousarray(w, dtype=np.float32).view(np.uint32)
+    bits = ((u + np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1)))
+            >> np.uint32(16)).astype(np.uint16)
+    back = (bits.astype(np.uint32) << np.uint32(16)).view(np.float32)
+    return bits, back
+
+
+def _int8_quantize(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(int8 weights, per-output-column float32 scales, float32
+    dequantized round-trip) — symmetric per-channel weight-only PTQ:
+    ``W ≈ Wq * scale`` with scale_j = max|W[:, j]| / 127."""
+    w = np.asarray(w, dtype=np.float32)
+    amax = np.max(np.abs(w), axis=0)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    deq = (q.astype(np.float32) * scale).astype(np.float32)
+    return q, scale, deq
+
+
+@dataclass
+class QuantizedMLPScorer(MLPScorer):
+    """Post-training-quantized serving variant of ``MLPScorer``.
+
+    ``weights`` holds the DEQUANTIZED float32 weights, so the entire
+    serving machinery (mask-fold into W1, batched-score contract, gelu
+    stack) is inherited unchanged — the quantization effect on scores is
+    exactly the weight rounding, which is what the rollout plane's
+    replay evaluation judges (DESIGN.md §15/§18: a quantized scorer is
+    admitted to ACTIVE only through the CANDIDATE → replay-gate flow,
+    never assumed score-equivalent).  The blob stores the int8/bf16
+    payloads + scales (``_pack``), stamped next to the drift histograms.
+    """
+
+    quant_mode: str = "int8"
+    # Per-layer quantized payloads: [(int8 W, f32 scales)] for int8,
+    # [(uint16 bf16 bits, None)] for bf16.  Kept for packing; scoring
+    # uses the dequantized ``weights``.
+    qlayers: Optional[List[Tuple[np.ndarray, Optional[np.ndarray]]]] = None
+
+
+def quantize_scorer(scorer: MLPScorer, mode: str = "int8") -> QuantizedMLPScorer:
+    """PTQ an exported float scorer into an int8/bf16 serving variant.
+
+    Carries the ENTIRE serving contract over: post-hoc mask flag,
+    standardizer, feature names, and the training-snapshot drift
+    histograms (the scales are stamped next to them in the blob, so the
+    PSI gate judges the quantized artifact against its own baseline).
+    """
+    if mode not in QUANT_MODES:
+        raise ValueError(f"unknown quantization mode {mode!r}; use {QUANT_MODES}")
+    qlayers: List[Tuple[np.ndarray, Optional[np.ndarray]]] = []
+    deq_weights: List[Tuple[np.ndarray, np.ndarray]] = []
+    for w, b in scorer.weights:
+        if mode == "int8":
+            q, scale, deq = _int8_quantize(w)
+            qlayers.append((q, scale))
+        else:
+            bits, deq = _bf16_round(w)
+            qlayers.append((bits, None))
+        deq_weights.append((deq, np.asarray(b, np.float32)))
+    return QuantizedMLPScorer(
+        weights=deq_weights,
+        feat_mean=scorer.feat_mean,
+        feat_std=scorer.feat_std,
+        post_hoc_masked=scorer.post_hoc_masked,
+        train_bin_edges=scorer.train_bin_edges,
+        train_bin_fracs=scorer.train_bin_fracs,
+        feature_names=scorer.feature_names,
+        model_type=f"mlp_{mode}",
+        version=scorer.version,
+        quant_mode=mode,
+        qlayers=qlayers,
+    )
+
+
+def _dequantize_layers(
+    mode: str,
+    qlayers: List[Tuple[np.ndarray, Optional[np.ndarray]]],
+    biases: List[np.ndarray],
+) -> List[Tuple[np.ndarray, np.ndarray]]:
+    out: List[Tuple[np.ndarray, np.ndarray]] = []
+    for (payload, scale), b in zip(qlayers, biases):
+        if mode == "int8":
+            deq = (payload.astype(np.float32) * scale).astype(np.float32)
+        else:
+            deq = (payload.astype(np.uint32) << np.uint32(16)).view(np.float32)
+        out.append((deq, np.asarray(b, np.float32)))
+    return out
+
+
 def _flatten_mlp_params(params: Dict) -> List[Tuple[np.ndarray, np.ndarray]]:
     """flax MLPRegressor params → ordered [(W, b)] list."""
     layers = sorted(params.keys(), key=lambda k: int(k.split("_")[-1]) if "_" in k else 0)
@@ -184,9 +285,23 @@ def export_from_state(
 
 def _pack(scorer: MLPScorer) -> Dict[str, np.ndarray]:
     arrays: Dict[str, np.ndarray] = {}
-    for i, (w, b) in enumerate(scorer.weights):
-        arrays[f"w{i}"] = w
-        arrays[f"b{i}"] = b
+    quant_mode = None
+    if isinstance(scorer, QuantizedMLPScorer) and scorer.qlayers is not None:
+        # Quantized payloads + scales travel IN the blob (scales sit
+        # next to the drift histograms below — the artifact is
+        # self-contained exactly like the float one).
+        quant_mode = scorer.quant_mode
+        for i, ((payload, scale), (_, b)) in enumerate(
+            zip(scorer.qlayers, scorer.weights)
+        ):
+            arrays[f"wq{i}"] = payload
+            if scale is not None:
+                arrays[f"wscale{i}"] = scale
+            arrays[f"b{i}"] = b
+    else:
+        for i, (w, b) in enumerate(scorer.weights):
+            arrays[f"w{i}"] = w
+            arrays[f"b{i}"] = b
     if scorer.feat_mean is not None:
         arrays["feat_mean"] = scorer.feat_mean
         arrays["feat_std"] = scorer.feat_std
@@ -200,6 +315,7 @@ def _pack(scorer: MLPScorer) -> Dict[str, np.ndarray]:
             "n_layers": len(scorer.weights),
             "post_hoc_masked": scorer.post_hoc_masked,
             "feature_names": list(scorer.feature_names),
+            "quant_mode": quant_mode,
         }
     )
     arrays["meta"] = np.frombuffer(meta.encode("utf-8"), dtype=np.uint8)
@@ -232,15 +348,25 @@ def load_scorer(path_or_bytes):
                 ],
                 version=meta["version"],
             )
-        weights = [
-            (data[f"w{i}"], data[f"b{i}"]) for i in range(meta["n_layers"])
-        ]
+        quant_mode = meta.get("quant_mode")
+        if quant_mode:
+            qlayers = [
+                (
+                    data[f"wq{i}"],
+                    data[f"wscale{i}"] if f"wscale{i}" in data else None,
+                )
+                for i in range(meta["n_layers"])
+            ]
+            biases = [data[f"b{i}"] for i in range(meta["n_layers"])]
+        else:
+            weights = [
+                (data[f"w{i}"], data[f"b{i}"]) for i in range(meta["n_layers"])
+            ]
         feat_mean = data["feat_mean"] if "feat_mean" in data else None
         feat_std = data["feat_std"] if "feat_std" in data else None
         bin_edges = data["train_bin_edges"] if "train_bin_edges" in data else None
         bin_fracs = data["train_bin_fracs"] if "train_bin_fracs" in data else None
-    return MLPScorer(
-        weights=weights,
+    common = dict(
         feat_mean=feat_mean,
         feat_std=feat_std,
         post_hoc_masked=meta.get("post_hoc_masked", True),
@@ -250,6 +376,14 @@ def load_scorer(path_or_bytes):
         model_type=meta["model_type"],
         version=meta["version"],
     )
+    if quant_mode:
+        return QuantizedMLPScorer(
+            weights=_dequantize_layers(quant_mode, qlayers, biases),
+            quant_mode=quant_mode,
+            qlayers=qlayers,
+            **common,
+        )
+    return MLPScorer(weights=weights, **common)
 
 
 # ---------------------------------------------------------------------------

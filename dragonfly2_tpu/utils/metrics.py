@@ -40,6 +40,23 @@ class _Metric:
         return "{" + inner + "}"
 
 
+class _CounterChild:
+    """Label-bound counter handle: the per-call kwargs-dict build and
+    label validation are paid ONCE at bind time — serving hot paths
+    (scheduler featcache/evaluator) observe through these."""
+
+    __slots__ = ("_metric", "_key_t")
+
+    def __init__(self, metric: "Counter", key: Tuple[str, ...]) -> None:
+        self._metric = metric
+        self._key_t = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        m = self._metric
+        with m._mu:
+            m._values[self._key_t] = m._values.get(self._key_t, 0.0) + amount
+
+
 class Counter(_Metric):
     def __init__(self, name: str, help: str, label_names: Sequence[str] = ()) -> None:
         super().__init__(name, help, label_names)
@@ -51,6 +68,9 @@ class Counter(_Metric):
         key = self._key(labels)
         with self._mu:
             self._values[key] = self._values.get(key, 0.0) + amount
+
+    def labels(self, **labels: str) -> _CounterChild:
+        return _CounterChild(self, self._key(labels))
 
     def value(self, **labels: str) -> float:
         with self._mu:
@@ -96,6 +116,35 @@ class Gauge(_Metric):
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
 
 
+class _HistogramChild:
+    """Label-bound histogram handle (see _CounterChild).  Caches the
+    per-key bucket-count list so a hot-path observe is one bisect + one
+    locked region of three list/dict ops."""
+
+    __slots__ = ("_metric", "_key_t", "_counts")
+
+    def __init__(self, metric: "Histogram", key: Tuple[str, ...]) -> None:
+        self._metric = metric
+        self._key_t = key
+        self._counts = None
+
+    def observe(self, value: float) -> None:
+        m = self._metric
+        idx = bisect.bisect_left(m.buckets, value)
+        key = self._key_t
+        with m._mu:
+            counts = self._counts
+            if counts is None:
+                counts = m._counts.get(key)
+                if counts is None:
+                    counts = m._counts[key] = [0] * len(m.buckets)
+                self._counts = counts
+            if idx < len(counts):
+                counts[idx] += 1
+            m._sums[key] = m._sums.get(key, 0.0) + value
+            m._totals[key] = m._totals.get(key, 0) + 1
+
+
 class Histogram(_Metric):
     def __init__(
         self,
@@ -114,7 +163,9 @@ class Histogram(_Metric):
         # Counts are stored PER-BUCKET (one increment per observe) and
         # cumulated at expose time — the cumulative-update loop over the
         # bucket ladder showed up on the scheduler's per-announce path.
-        key = self._key(labels)
+        self._observe_key(self._key(labels), value)
+
+    def _observe_key(self, key: Tuple[str, ...], value: float) -> None:
         idx = bisect.bisect_left(self.buckets, value)
         with self._mu:
             counts = self._counts.get(key)
@@ -124,6 +175,9 @@ class Histogram(_Metric):
                 counts[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+
+    def labels(self, **labels: str) -> "_HistogramChild":
+        return _HistogramChild(self, self._key(labels))
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]

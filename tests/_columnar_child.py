@@ -1,0 +1,140 @@
+"""Subprocess body for the columnar host-state chaos drill
+(tests/test_chaos.py::TestColumnarRebuildDrill, DESIGN.md §18).
+
+Modes:
+
+- ``hammer``  build the serving plane (SchedulerService + columnar host
+  store + rule evaluator) and churn it from announcer threads — host
+  announces (column writes on arrival), upload accounting write-through,
+  evaluate_parents gathers, leave_host slot recycling — FOREVER.  Prints
+  ``columnar-child: ready`` once the storm is running; the parent
+  SIGKILLs the process mid-announce.
+- ``rebuild`` the restarted scheduler: a fresh process replays the SAME
+  deterministic announce stream (nothing is persisted — columnar state
+  is rebuilt from announces, which is the restart contract), then
+  validates that NO slot row is torn: ``validate_consistency`` must come
+  back empty, every bound row must byte-match a recompute off the
+  column-backed accessors, and the columnar rule scores must bit-match
+  the scalar oracle.  Prints ONE JSON verdict line.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+N_HOSTS = 48
+MAX_SLOTS = 32  # smaller than the fleet: eviction/recycle is exercised
+ANNOUNCERS = 6
+
+
+def build():
+    from dragonfly2_tpu.scheduler import (
+        Evaluator,
+        HostFeatureCache,
+        Resource,
+        SchedulerService,
+        Scheduling,
+        SchedulingConfig,
+    )
+    from dragonfly2_tpu.sim.swarm import build_announce_swarm
+
+    task, peers = build_announce_swarm(N_HOSTS, seed=0)
+    cache = HostFeatureCache(max_hosts=MAX_SLOTS)
+    evaluator = Evaluator(feature_cache=cache)
+    scheduling = Scheduling(evaluator, SchedulingConfig(retry_interval=0))
+    service = SchedulerService(Resource(), scheduling)
+    return task, peers, cache, evaluator, service
+
+
+def churn_step(rng, task, peers, evaluator, service):
+    """One deterministic slice of announce-path churn."""
+    p = peers[int(rng.integers(0, len(peers)))]
+    r = rng.random()
+    if r < 0.35:
+        cands = [peers[int(c)] for c in rng.integers(0, len(peers), size=9)]
+        evaluator.evaluate_parents(cands, p, task.total_piece_count)
+    elif r < 0.55:
+        service.announce_host(p.host)  # columns written on arrival
+    elif r < 0.7:
+        if p.host.acquire_upload():
+            p.host.release_upload(succeeded=rng.random() < 0.9)
+    elif r < 0.85:
+        p.host.upload_count += 1
+    else:
+        service.leave_host(p.host)  # detach + slot recycle
+
+
+def hammer():
+    task, peers, cache, evaluator, service = build()
+    stop = threading.Event()
+
+    def worker(tid):
+        rng = np.random.default_rng(tid)
+        while not stop.is_set():
+            churn_step(rng, task, peers, evaluator, service)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(ANNOUNCERS)
+    ]
+    for t in threads:
+        t.start()
+    print("columnar-child: ready", flush=True)
+    while True:  # the parent SIGKILLs us mid-announce
+        time.sleep(0.1)
+
+
+def rebuild():
+    from dragonfly2_tpu.records.features import host_features
+    from dragonfly2_tpu.scheduler import Evaluator
+
+    task, peers, cache, evaluator, service = build()
+    # The restarted scheduler rebuilds its columnar state from the
+    # announce stream alone (deterministic here so the verdict is too).
+    rng = np.random.default_rng(1234)
+    for _ in range(2000):
+        churn_step(rng, task, peers, evaluator, service)
+    problems = cache.validate_consistency()
+    rows_checked = 0
+    row_mismatch = 0
+    for p in peers:
+        h = p.host
+        if h._cols is None or h._cols[0] is not cache:
+            continue
+        rows_checked += 1
+        got = cache.features(h)
+        if not np.array_equal(got, host_features(h.to_record())):
+            row_mismatch += 1
+    oracle = Evaluator()
+    child, parents = peers[0], peers[1:17]
+    vec = evaluator.evaluate_all(parents, child, task.total_piece_count)
+    ref = np.array(
+        [oracle.evaluate(q, child, task.total_piece_count) for q in parents]
+    )
+    print(json.dumps({
+        "torn": problems,
+        "rows_checked": rows_checked,
+        "row_mismatch": row_mismatch,
+        "scores_bit_equal": bool(np.array_equal(vec, ref)),
+    }), flush=True)
+
+
+def main():
+    mode = sys.argv[1]
+    if mode == "hammer":
+        hammer()
+    elif mode == "rebuild":
+        rebuild()
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()

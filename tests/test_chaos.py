@@ -689,6 +689,47 @@ class TestTrainerCrashDrill:
 
 
 # ---------------------------------------------------------------------------
+# Drill 5 — scheduler SIGKILLed mid-announce → columnar rebuild, no torn rows
+# ---------------------------------------------------------------------------
+
+
+class TestColumnarRebuildDrill:
+    """ISSUE 7: the columnar host store is the source of truth for host
+    serving state, and it is IN-MEMORY — a scheduler killed mid-announce
+    loses it.  The restart contract is rebuild-from-announces: a fresh
+    process replaying the announce stream must end with zero torn slot
+    rows (every bound row byte-matches a recompute off the column-backed
+    accessors, write stamps agree with the hosts' mutation counters) and
+    with columnar rule scores still bit-equal to the scalar oracle."""
+
+    def test_kill_mid_announce_then_rebuild_has_no_torn_rows(self):
+        import os
+
+        child = os.path.join(os.path.dirname(__file__), "_columnar_child.py")
+
+        # Phase 1: announce storm against the live columnar store; the
+        # SIGKILL lands while announcer threads are mid-write.
+        p1 = ChaosProcess(
+            [child, "hammer"], ready_prefixes=["columnar-child: ready"],
+        ).start()
+        p1.wait_ready(120)
+        time.sleep(0.5)  # the storm is genuinely mid-announce
+        p1.sigkill()
+        assert p1.wait_dead(60) == -9
+
+        # Phase 2: the "restarted" scheduler — a fresh process — rebuilds
+        # columnar state from the (deterministic) announce stream and
+        # self-validates.
+        p2 = ChaosProcess([child, "rebuild"]).start()
+        assert p2.wait_dead(300) == 0, p2.lines[-8:]
+        verdict = json.loads([l for l in p2.lines if l.startswith("{")][-1])
+        assert verdict["torn"] == []
+        assert verdict["rows_checked"] > 0
+        assert verdict["row_mismatch"] == 0
+        assert verdict["scores_bit_equal"] is True
+
+
+# ---------------------------------------------------------------------------
 # Satellites
 # ---------------------------------------------------------------------------
 
@@ -752,6 +793,37 @@ class TestBenchInitFailure:
         assert line["ok"] is False
         assert line["failure"] == "backend_unavailable"
         assert line["skipped"] == "backend_unavailable"
+
+    def test_headline_regression_guard(self, tmp_path):
+        # ISSUE 7 satellite: a fresh round is compared against the last
+        # GOOD recorded round — >20% below it flags loudly in the JSON;
+        # skipped/value-less rounds (r05) and CPU-fallback rounds never
+        # become the bar.
+        import bench
+
+        def _round(n, parsed):
+            (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(
+                {"n": n, "rc": 0, "parsed": parsed}
+            ))
+
+        _round(3, {"value": 4.9e6})
+        _round(4, {"value": 4.78e6})
+        _round(5, None)                                  # the lost round
+        _round(6, {"value": 2600.0, "backend": "cpu"})   # smoke fallback
+        good = bench.last_good_headline(str(tmp_path))
+        assert good == {"round": 4, "value": 4.78e6, "file": "BENCH_r04.json"}
+
+        ok = bench.apply_regression_guard({"value": 4.6e6}, good)
+        assert "regression_warning" not in ok
+        assert ok["last_good"]["round"] == 4
+
+        bad = bench.apply_regression_guard({"value": 3.0e6}, good)
+        assert bad["regression_warning"]["vs_round"] == 4
+        assert bad["regression_warning"]["dropped_to"] < 0.8
+
+        # No good rounds at all → the guard stays silent, never crashes.
+        empty = bench.apply_regression_guard({"value": 1.0}, {})
+        assert "last_good" not in empty
 
     def test_non_backend_failure_is_still_rc_1(self, capsys):
         # A genuine code/config error must NOT masquerade as a hardware

@@ -657,3 +657,193 @@ class TestOpsDtypeContracts:
         grad = jax.grad(loss)(table)
         assert grad.dtype == jnp.bfloat16
         assert grad.shape == (8, 16)
+
+
+class TestFusedGatherScore:
+    """ops/pallas_score.py: fused slot-row gather + mask-folded MLP
+    scoring over the columnar host store (DESIGN.md §18) — jnp fallback,
+    the real pallas kernel in interpret mode, and the rule-arm matvec."""
+
+    def _weights(self, seed=0, dims=(32, 64, 64, 1)):
+        rng = np.random.default_rng(seed)
+        return [
+            (
+                rng.standard_normal((dims[i], dims[i + 1])).astype(np.float32) * 0.3,
+                rng.standard_normal(dims[i + 1]).astype(np.float32) * 0.05,
+            )
+            for i in range(len(dims) - 1)
+        ]
+
+    def _serving(self, n_hosts=120, seed=3, max_hosts=512):
+        from dragonfly2_tpu.scheduler import HostFeatureCache, MLEvaluator
+        from dragonfly2_tpu.sim.swarm import build_announce_swarm
+        from dragonfly2_tpu.trainer.export import MLPScorer
+
+        task, peers = build_announce_swarm(n_hosts, seed=seed)
+        cache = HostFeatureCache(max_hosts=max_hosts)
+        weights = self._weights(seed)
+        ref = MLPScorer(weights=weights)
+        ml_ref = MLEvaluator(ref, feature_cache=cache)
+        return task, peers, cache, weights, ref, ml_ref
+
+    def test_fused_fallback_ordering_equals_numpy_scorer(self):
+        from dragonfly2_tpu.ops.pallas_score import FusedMLPScorer
+        from dragonfly2_tpu.scheduler import MLEvaluator
+
+        task, peers, cache, weights, ref, ml_ref = self._serving()
+        fused = FusedMLPScorer(cache, weights, use_pallas=False)
+        ml_fused = MLEvaluator(fused, feature_cache=cache)
+        rng = np.random.default_rng(11)
+        for _ in range(12):
+            ci = int(rng.integers(0, len(peers)))
+            cand = [int(c) if c < ci else int(c) + 1
+                    for c in rng.choice(len(peers) - 1, size=24, replace=False)]
+            child, parents = peers[ci], [peers[c] for c in cand]
+            a = [p.id for p in ml_ref.evaluate_parents(
+                parents, child, task.total_piece_count)]
+            b = [p.id for p in ml_fused.evaluate_parents(
+                parents, child, task.total_piece_count)]
+            assert a == b
+
+    def test_pallas_kernel_interpret_matches_fallback(self):
+        from dragonfly2_tpu.ops.pallas_score import FusedMLPScorer
+
+        task, peers, cache, weights, ref, ml_ref = self._serving(n_hosts=60)
+        # Bind everyone, then score the same slots through both modes.
+        cache.gather([p.host for p in peers])
+        fb = FusedMLPScorer(cache, weights, use_pallas=False)
+        kern = FusedMLPScorer(cache, weights, use_pallas=True, interpret=True,
+                              cand_block=8)
+        edge, slots, cslot, _, _ = ml_ref._featurize_slots(
+            peers[1:25], peers[0]
+        )
+        dst = np.full(len(slots), cslot, dtype=np.int64)
+        a = fb.score(edge, src_buckets=slots, dst_buckets=dst)
+        b = kern.score(edge, src_buckets=slots, dst_buckets=dst)
+        assert a.dtype == b.dtype == np.float32
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+        # And both agree with the numpy serving scorer to float tolerance
+        # (sum order differs across the three partial matmuls).
+        feats, _, _ = ml_ref._featurize_batch(peers[1:25], peers[0])
+        want = ref.score(feats)
+        np.testing.assert_allclose(a, want, rtol=1e-4, atol=1e-4)
+
+    def test_mask_folding_post_hoc_columns_have_no_effect(self):
+        from dragonfly2_tpu.ops.pallas_score import fold_post_hoc_weights
+        from dragonfly2_tpu.records.features import POST_HOC_FEATURE_IDX
+        from dragonfly2_tpu.trainer.export import MLPScorer
+
+        weights = self._weights(5)
+        folded = fold_post_hoc_weights(weights)
+        for i in POST_HOC_FEATURE_IDX:
+            assert np.all(folded[0][0][i] == 0.0)
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((16, 32)).astype(np.float32)
+        x2 = np.array(x, copy=True)
+        x2[:, list(POST_HOC_FEATURE_IDX)] = rng.standard_normal(
+            (16, len(POST_HOC_FEATURE_IDX))
+        ).astype(np.float32)
+        s = MLPScorer(weights=folded, post_hoc_masked=False)
+        assert np.array_equal(s.score(x), s.score(x2))
+
+    def test_padding_rows_do_not_bleed(self):
+        from dragonfly2_tpu.ops.pallas_score import FusedMLPScorer
+
+        task, peers, cache, weights, ref, ml_ref = self._serving(n_hosts=40)
+        cache.gather([p.host for p in peers])
+        fused = FusedMLPScorer(cache, weights, use_pallas=False, cand_block=16)
+        edge, slots, cslot, _, _ = ml_ref._featurize_slots(peers[1:8], peers[0])
+        dst = np.full(len(slots), cslot, dtype=np.int64)
+        a = fused.score(edge, src_buckets=slots, dst_buckets=dst)   # n=7 → pad 16
+        assert a.shape == (7,)
+        # Same rows inside a differently-padded call score identically.
+        edge2, slots2, cslot2, _, _ = ml_ref._featurize_slots(
+            peers[1:20], peers[0]
+        )
+        dst2 = np.full(len(slots2), cslot2, dtype=np.int64)
+        b = fused.score(edge2, src_buckets=slots2, dst_buckets=dst2)[:7]
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+    def test_mirror_resyncs_on_column_writes(self):
+        from dragonfly2_tpu.ops.pallas_score import FusedMLPScorer
+
+        task, peers, cache, weights, ref, ml_ref = self._serving(n_hosts=30)
+        cache.gather([p.host for p in peers])
+        fused = FusedMLPScorer(cache, weights, use_pallas=False)
+        edge, slots, cslot, _, _ = ml_ref._featurize_slots(peers[1:9], peers[0])
+        dst = np.full(len(slots), cslot, dtype=np.int64)
+        before = fused.score(edge, src_buckets=slots, dst_buckets=dst)
+        ver = fused._mat_version
+        # Announce-path write-through moves the store's row version; the
+        # next flush re-uploads the mirror and the scores move.
+        for p in peers[1:9]:
+            p.host.upload_count += 50
+        after = fused.score(edge, src_buckets=slots, dst_buckets=dst)
+        assert fused._mat_version != ver
+        assert not np.array_equal(before, after)
+
+    def test_from_scorer_rejects_standardized_artifacts(self):
+        from dragonfly2_tpu.ops.pallas_score import FusedMLPScorer
+        from dragonfly2_tpu.scheduler import HostFeatureCache
+        from dragonfly2_tpu.trainer.export import MLPScorer
+
+        s = MLPScorer(
+            weights=self._weights(1),
+            feat_mean=np.zeros(32, np.float32),
+            feat_std=np.ones(32, np.float32),
+        )
+        with pytest.raises(ValueError):
+            FusedMLPScorer.from_scorer(HostFeatureCache(max_hosts=8), s)
+
+    def test_rule_weighted_sum_matches_numpy(self):
+        from dragonfly2_tpu.ops.pallas_score import (
+            RULE_COMPONENT_WEIGHTS,
+            rule_weighted_sum,
+        )
+
+        rng = np.random.default_rng(9)
+        comp = rng.standard_normal((37, 6)).astype(np.float32)
+        want = comp @ np.asarray(RULE_COMPONENT_WEIGHTS, np.float32)
+        got_fb = rule_weighted_sum(comp, use_pallas=False)
+        got_kern = rule_weighted_sum(comp, interpret=True)
+        assert got_fb.dtype == got_kern.dtype == np.float32
+        np.testing.assert_allclose(got_fb, want, rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(got_kern, want, rtol=1e-6, atol=1e-6)
+
+    def test_quantized_scorer_dtypes_and_roundtrip(self):
+        """The int8/bf16 quantized blob (scorer.quantized contract):
+        payload dtypes, scale stamping next to drift histograms, exact
+        dequantized-score roundtrip through the blob."""
+        from dragonfly2_tpu.trainer.export import (
+            MLPScorer,
+            QuantizedMLPScorer,
+            feature_snapshot_stats,
+            load_scorer,
+            quantize_scorer,
+            scorer_to_bytes,
+        )
+
+        rng = np.random.default_rng(4)
+        rows = rng.standard_normal((400, 32)).astype(np.float32)
+        edges, fracs = feature_snapshot_stats(rows)
+        base = MLPScorer(weights=self._weights(4), train_bin_edges=edges,
+                         train_bin_fracs=fracs)
+        want = base.score(rows)
+        for mode, payload_dtype in (("int8", np.int8), ("bf16", np.uint16)):
+            q = quantize_scorer(base, mode)
+            assert q.model_type == f"mlp_{mode}"
+            for payload, scale in q.qlayers:
+                assert payload.dtype == payload_dtype
+                if mode == "int8":
+                    assert scale.dtype == np.float32
+            for w, b in q.weights:
+                assert w.dtype == np.float32 and b.dtype == np.float32
+            got = q.score(rows)
+            rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+            assert rel < 0.05  # quantization error is bounded, not zero
+            q2 = load_scorer(scorer_to_bytes(q))
+            assert isinstance(q2, QuantizedMLPScorer)
+            assert q2.quant_mode == mode
+            assert np.array_equal(q2.score(rows), got)  # blob-exact
+            assert np.array_equal(q2.train_bin_edges, edges)  # scales ride
+            assert np.array_equal(q2.train_bin_fracs, fracs)  # w/ the drift baseline

@@ -1041,3 +1041,202 @@ class TestBenchShadowSmoke:
         # allow a couple of lost increments under announcer contention.
         assert abs(accounted - shadow["offered"]) <= 4
         assert isinstance(out["overhead_pct"], float)
+
+
+# ---------------------------------------------------------------------------
+# Scorer-snapshot pinning (ISSUE 7): arm split atomic with the route decision
+# ---------------------------------------------------------------------------
+
+
+class TestScorerSnapshotPinning:
+    def test_no_mixed_snapshot_flush_when_candidate_swaps_mid_linger(self):
+        """A rollout transition mid-linger (float candidate → quantized
+        candidate) must never re-route an already-enqueued request onto
+        the newer snapshot: each request is scored by the scorer captured
+        ATOMICALLY with its CanaryRoute decision, and requests pinned to
+        different snapshots never share one coalesced call."""
+        active = _ConstScorer(step=1.0)
+        float_cand = _ConstScorer(step=-1.0)   # "float" candidate arm
+        quant_cand = _ConstScorer(step=-2.0)   # "quantized" candidate arm
+        b = ScorerBatcher(active, linger_s=0.10)
+        b.set_candidate(float_cand)
+        results = {}
+        errs = []
+
+        def call(key, snapshot, delay):
+            try:
+                time.sleep(delay)
+                results[key] = np.asarray(
+                    b.score(np.zeros((4, 3), np.float32), candidate=True,
+                            scorer=snapshot)
+                )
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        def transition():
+            time.sleep(0.03)
+            b.set_candidate(quant_cand)  # the rollout flips mid-linger
+
+        threads = [
+            threading.Thread(target=call, args=("float", float_cand, 0.0),
+                             daemon=True),
+            threading.Thread(target=transition, daemon=True),
+            threading.Thread(target=call, args=("quant", quant_cand, 0.06),
+                             daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(15)
+        assert errs == []
+        # Both requests coalesced into the SAME flush window, yet each
+        # was scored by ITS snapshot — one call per snapshot, never a
+        # merged mixed-precision call, and the active arm untouched.
+        assert list(np.argsort(-results["float"])) == [0, 1, 2, 3]
+        assert list(np.argsort(-results["quant"])) == [0, 1, 2, 3]
+        assert float_cand.calls == 1
+        assert quant_cand.calls == 1
+        assert active.calls == 0
+
+    def test_unpinned_requests_keep_flush_snapshot_semantics(self):
+        # Legacy callers (no snapshot) still get the flush snapshot —
+        # including the candidate-gone → pinned-to-active behavior.
+        active = _ConstScorer(step=1.0)
+        b = ScorerBatcher(active, linger_s=0.0)
+        out = np.asarray(b.score(np.zeros((3, 2), np.float32), candidate=True))
+        assert list(np.argsort(-out)) == [2, 1, 0]
+        assert active.calls == 1
+
+    def test_evaluator_pins_candidate_snapshot_through_batcher(self):
+        """End to end: MLEvaluator resolves the candidate snapshot with
+        the route decision and carries it into the flush — a set_canary
+        swap between routing and flushing cannot change which scorer
+        scores the announce."""
+        task, peers = build_announce_swarm(30, seed=13)
+        active = _ConstScorer(step=1.0)
+        cand_v2 = _ConstScorer(step=-1.0)
+        batcher = ScorerBatcher(active, linger_s=0.0)
+        ml = MLEvaluator(active, feature_cache=HostFeatureCache(max_hosts=128),
+                         batcher=batcher)
+        ml.set_canary(CanaryRoute(cand_v2, percent=100, version=2))
+        child, cands = peers[0], [peers[i + 1] for i in range(5)]
+        ranked = ml.evaluate_parents(cands, child, task.total_piece_count)
+        # percent=100 → candidate arm; scored by cand_v2 (ascending step
+        # -1 → candidate prefers FIRST row).
+        assert [p.id for p in ranked] == [p.id for p in cands]
+        assert cand_v2.calls == 1
+
+
+# ---------------------------------------------------------------------------
+# Quantized serving scorer gated through the rollout plane (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def _measured_inversion(scores: np.ndarray, realized: np.ndarray, group: int) -> float:
+    """Fraction of within-announce pairs an arm ranks against the
+    realized order — the replay evaluator's inversion semantics on
+    plainly visible arrays."""
+    flips = pairs = 0
+    for g in range(0, len(scores), group):
+        s, r = scores[g:g + group], realized[g:g + group]
+        for i in range(len(s)):
+            for j in range(i + 1, len(s)):
+                if r[i] == r[j]:
+                    continue
+                pairs += 1
+                if (s[i] - s[j]) * (r[i] - r[j]) < 0:
+                    flips += 1
+    return flips / max(pairs, 1)
+
+
+class TestQuantizedScorerRollout:
+    GROUP = 8
+
+    def _arms(self, mode):
+        from dragonfly2_tpu.trainer.export import quantize_scorer
+
+        active = _mk_scorer(21)
+        quant = quantize_scorer(active, mode)
+        return active, quant
+
+    def _measured_report(self, active, candidate, joined=400, seed=5):
+        rng = np.random.default_rng(seed)
+        rows = rng.standard_normal((joined, DOWNLOAD_FEATURE_DIM)).astype(
+            np.float32
+        )
+        act = active.score(rows)
+        cand = candidate.score(rows)
+        # Realized bandwidth = the float model's signal + outcome noise:
+        # the active arm is imperfect against it, and the guardrail asks
+        # whether the candidate is MATERIALLY worse than active.
+        realized = act + rng.normal(0.0, 0.05 * np.std(act), size=act.shape)
+        a_inv = _measured_inversion(act, realized, self.GROUP)
+        c_inv = _measured_inversion(cand, realized, self.GROUP)
+        return {
+            "joined_edges": joined,
+            "announces": joined // self.GROUP,
+            "regret_at_k": {"k": 4, "candidate": c_inv, "active": a_inv},
+            "inversion_rate": {"pairs": joined, "candidate": c_inv,
+                               "active": a_inv},
+            "psi_max": 0.01,
+        }
+
+    @pytest.mark.parametrize("mode", ["int8", "bf16"])
+    def test_quantized_candidate_passes_gates_and_promotes(self, mode, tmp_path):
+        from dragonfly2_tpu.trainer.export import QuantizedMLPScorer
+
+        active, quant = self._arms(mode)
+        blobs = BlobStore(str(tmp_path / "blobs"))
+        reg = ModelRegistry(blobs)
+        m1 = reg.create_model(name=MODEL_NAME, type="mlp", scheduler_id="s1",
+                              artifact=scorer_to_bytes(active))
+        reg.activate(m1.id)
+        m2 = reg.create_model(name=MODEL_NAME, type=f"mlp_{mode}",
+                              scheduler_id="s1",
+                              artifact=scorer_to_bytes(quant))
+        # The artifact round-trips through the registry digest check and
+        # loads as the quantized class.
+        loaded = load_scorer(reg.load_artifact(m2))
+        assert isinstance(loaded, QuantizedMLPScorer)
+        ctrl = RolloutController(reg, guardrails=RolloutGuardrails(
+            min_shadow_samples=100, min_canary_samples=100))
+        ctrl.begin(m2.id)
+        assert reg.get(m2.id).state is ModelState.SHADOW
+        report = self._measured_report(active, quant)
+        # Quantization barely moves the rankings: the measured inversion
+        # delta sits inside the candidate ≤ active·1.10 + 0.02 guardrail.
+        out = ctrl.report("s1", MODEL_NAME, report)
+        assert out["decision"] == "advance", out
+        report2 = self._measured_report(active, quant, joined=800, seed=6)
+        out = ctrl.report("s1", MODEL_NAME, report2)
+        assert out["decision"] == "promote", out
+        assert reg.get(m2.id).state is ModelState.ACTIVE
+        assert reg.get(m1.id).state is ModelState.INACTIVE
+
+    def test_destroyed_quantization_rolls_back(self, tmp_path):
+        # A quantizer gone wrong (weights crushed to sign * amax — a
+        # 1-bit disaster) produces measurably inverted rankings: the
+        # replay gate must refuse it, never score-equivalence assumptions.
+        active = _mk_scorer(21)
+        bad_weights = [
+            (np.sign(w) * np.max(np.abs(w)), b) for w, b in active.weights
+        ]
+        bad = MLPScorer(weights=[(w.astype(np.float32), b) for w, b in bad_weights])
+        blobs = BlobStore(str(tmp_path / "blobs"))
+        reg = ModelRegistry(blobs)
+        m1 = reg.create_model(name=MODEL_NAME, type="mlp", scheduler_id="s1",
+                              artifact=scorer_to_bytes(active))
+        reg.activate(m1.id)
+        m2 = reg.create_model(name=MODEL_NAME, type="mlp_int8",
+                              scheduler_id="s1", artifact=scorer_to_bytes(bad))
+        ctrl = RolloutController(reg, guardrails=RolloutGuardrails(
+            min_shadow_samples=100))
+        ctrl.begin(m2.id)
+        report = self._measured_report(active, bad)
+        assert report["inversion_rate"]["candidate"] > (
+            report["inversion_rate"]["active"] * 1.10 + 0.02
+        )
+        out = ctrl.report("s1", MODEL_NAME, report)
+        assert out["decision"] == "rollback"
+        assert reg.get(m2.id).state is ModelState.INACTIVE
+        assert reg.active_model("s1", MODEL_NAME).id == m1.id

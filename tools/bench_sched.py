@@ -23,13 +23,16 @@ box lands on every path roughly equally and the speedup ratios stay
 meaningful even when absolute numbers wobble.
 
 Prints ONE JSON line: per-path announces/sec and p50/p99 evaluate
-latency, cache hit rate, mean batch occupancy, and the headline
-``speedup_ml`` / ``speedup_rule`` (acceptance bar: ≥ 5× at 1k hosts /
-50 parents per announce / 32 announcers — ISSUE 3).
+latency, cache hit rate, mean batch occupancy, per-path steady-state
+recompiles, the headline ``speedup_ml`` / ``speedup_rule``, and a
+per-shape ``sweep`` (default 50 and 400 candidates — the rule-path
+speedup is reported PER SHAPE; acceptance bars: rule ≥ 5× and ml ≥
+6.05× at 1k hosts / 50 parents / 32 announcers — ISSUE 3/7).
 
 Usage: PYTHONPATH=/root/repo python tools/bench_sched.py
        [--hosts 1000 --parents 50 --announcers 32 --announces 2048]
-       [--rounds 4] [--smoke]   # --smoke: tiny tier-1 schema gate
+       [--rounds 6] [--sweep-parents 50,400]
+       [--smoke]   # --smoke: tiny tier-1 schema gate
 """
 
 from __future__ import annotations
@@ -55,6 +58,7 @@ SCHEMA_KEYS = (
     "cache_hit_rate",
     "mean_batch_occupancy",
     "steady_state_recompiles",
+    "sweep",
 )
 
 
@@ -105,8 +109,12 @@ class _PrePRScorer:
 
 
 def _make_plans(n_hosts, *, parents_per_announce, announcers, announces, seed):
-    """Pre-draw every announce's (child, candidate set) so the measured
-    region is ranking work only, identical across paths per seed."""
+    """Pre-draw every announce's (child index, candidate index list) so
+    the measured region is ranking work only, identical across paths
+    per seed.  ``_resolve_plans`` turns indices into peer objects ONCE,
+    outside the timed region — the per-announce index→object listcomp
+    used to sit inside every ranked call's wall, a fixed ~3 µs that
+    taxed the fast paths several percent and the slow ones not at all."""
     rng = np.random.default_rng(seed)
     per_thread = max(announces // announcers, 1)
     plans = []
@@ -122,43 +130,95 @@ def _make_plans(n_hosts, *, parents_per_announce, announcers, announces, seed):
     return plans
 
 
-def _run_round(evaluate, task, peers, plans, announcers):
-    """Drive one round of ``evaluate(candidates, child, tpc)`` from
-    ``announcers`` concurrent threads; returns (wall_s, latencies)."""
-    latencies = [[] for _ in range(announcers)]
-    errors = []
-    start_barrier = threading.Barrier(announcers + 1)
-    tpc = task.total_piece_count
-
-    def announcer(tid):
-        lat = latencies[tid]
-        try:
-            start_barrier.wait()
-            for child_i, cand in plans[tid]:
-                child = peers[child_i]
-                candidates = [peers[c] for c in cand]
-                t0 = time.perf_counter()
-                ranked = evaluate(candidates, child, tpc)
-                lat.append(time.perf_counter() - t0)
-                if len(ranked) != len(candidates):
-                    raise RuntimeError("ranking dropped candidates")
-        except Exception as exc:  # noqa: BLE001 — surfaced to the main thread
-            errors.append(exc)
-
-    threads = [
-        threading.Thread(target=announcer, args=(i,), daemon=True)
-        for i in range(announcers)
+def _resolve_plans(plans, peers):
+    """Index plans → (child peer, [candidate peers]) plans."""
+    return [
+        [(peers[ci], [peers[c] for c in cand]) for ci, cand in tp]
+        for tp in plans
     ]
-    for t in threads:
-        t.start()
-    start_barrier.wait()
-    t0 = time.perf_counter()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
-    if errors:
-        raise errors[0]
-    return wall, [x for lat in latencies for x in lat]
+
+
+class _AnnouncerPool:
+    """Persistent announcer threads reused across every measured round.
+
+    Spawning 32 OS threads per round cost 2-4 ms — noise floor for the
+    slow paths but a systematic multi-percent tax on the fast ones
+    (a vectorized round is tens of ms of wall).  The pool parks workers
+    on a barrier between rounds, so a round's wall clock is pure ranking
+    work for every path alike."""
+
+    def __init__(self, announcers: int) -> None:
+        self.announcers = announcers
+        self._start = threading.Barrier(announcers + 1)
+        self._done = threading.Barrier(announcers + 1)
+        self._work = None
+        self._stop = False
+        self._threads = [
+            threading.Thread(target=self._loop, args=(i,), daemon=True)
+            for i in range(announcers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _loop(self, tid: int) -> None:
+        while True:
+            self._start.wait()
+            if self._stop:
+                return
+            evaluate, tpc, plans, latencies, spans, errors = self._work
+            lat = latencies[tid]
+            # The round's wall clock is measured INSIDE the workers
+            # (max end − min start): the main thread can sit unscheduled
+            # for tens of ms after the start barrier on a busy 1-CPU
+            # box, which silently shrank main-measured walls and
+            # inflated throughput for the fast paths.
+            t_start = time.perf_counter()
+            try:
+                for child, candidates in plans[tid]:
+                    t0 = time.perf_counter()
+                    ranked = evaluate(candidates, child, tpc)
+                    lat.append(time.perf_counter() - t0)
+                    if len(ranked) != len(candidates):
+                        raise RuntimeError("ranking dropped candidates")
+            except Exception as exc:  # noqa: BLE001 — surfaced to the main thread
+                errors.append(exc)
+            spans[tid] = (t_start, time.perf_counter())
+            self._done.wait()
+
+    def run_round(self, evaluate, task, peers, plans):
+        """One round of ``evaluate(candidates, child, tpc)`` across the
+        pool; ``plans`` are index plans (resolved here, untimed).
+        Returns (wall_s, latencies)."""
+        resolved = _resolve_plans(plans, peers)
+        latencies = [[] for _ in range(self.announcers)]
+        spans = [(0.0, 0.0)] * self.announcers
+        errors: list = []
+        self._work = (
+            evaluate, task.total_piece_count, resolved, latencies, spans,
+            errors,
+        )
+        self._start.wait()
+        self._done.wait()
+        if errors:
+            raise errors[0]
+        wall = max(s[1] for s in spans) - min(s[0] for s in spans)
+        return wall, [x for lat in latencies for x in lat]
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._start.wait()
+        for t in self._threads:
+            t.join()
+
+
+def _run_round(evaluate, task, peers, plans, announcers):
+    """One-shot convenience wrapper (kept for external callers): spins a
+    pool for a single round."""
+    pool = _AnnouncerPool(announcers)
+    try:
+        return pool.run_round(evaluate, task, peers, plans)
+    finally:
+        pool.shutdown()
 
 
 def _run_path(evaluate, task, peers, *, parents_per_announce, announcers,
@@ -207,11 +267,14 @@ def run(hosts: int, parents: int, announcers: int, announces: int,
     task, peers = build_announce_swarm(hosts, seed=seed)
     scorer = _make_scorer(seed)
 
-    rule = Evaluator()
+    # ONE columnar host store shared by the rule and ML serving paths
+    # (DESIGN.md §18: one service owns one store; hosts bind once and
+    # both vectorized paths ride owner gathers).
+    cache = HostFeatureCache(max_hosts=max(hosts * 2, 1024))
+    rule = Evaluator(feature_cache=cache)
     # The scalar baseline runs the seed commit's scorer internals too —
     # the serving PR's scorer fixes must not leak into the baseline.
     ml_scalar = MLEvaluator(_PrePRScorer(_make_weights(seed)))
-    cache = HostFeatureCache(max_hosts=max(hosts * 2, 1024))
     batcher = ScorerBatcher(linger_s=linger_ms / 1e3)
     ml_vec = MLEvaluator(scorer, feature_cache=cache, batcher=batcher)
     named = (
@@ -234,24 +297,30 @@ def run(hosts: int, parents: int, announcers: int, announces: int,
     # Warm-up round (caches, lru memos, numpy first-call machinery), then
     # GC quiesced for the measured rounds: collector pauses hit the
     # allocation-heavy scalar paths hardest and were a major variance
-    # source (p99 spikes of hundreds of ms).
-    for r in range(rounds + 1):
-        plans = _make_plans(
-            len(peers), parents_per_announce=parents,
-            announcers=announcers, announces=per_round, seed=seed + r,
-        )
-        measured = r > 0
-        if r == 1:
-            gc.collect()
-            gc.disable()
-        for name, evaluate in named:
-            compiles_before = witness.total_compiles()
-            wall, lat = _run_round(evaluate, task, peers, plans, announcers)
-            if measured:
-                walls[name] += wall
-                lats[name].extend(lat)
-                recompiles[name] += witness.total_compiles() - compiles_before
-    gc.enable()
+    # source (p99 spikes of hundreds of ms).  One persistent announcer
+    # pool serves every round — per-round thread spawns taxed the fast
+    # paths multiple percent.
+    pool = _AnnouncerPool(announcers)
+    try:
+        for r in range(rounds + 1):
+            plans = _make_plans(
+                len(peers), parents_per_announce=parents,
+                announcers=announcers, announces=per_round, seed=seed + r,
+            )
+            measured = r > 0
+            if r == 1:
+                gc.collect()
+                gc.disable()
+            for name, evaluate in named:
+                compiles_before = witness.total_compiles()
+                wall, lat = pool.run_round(evaluate, task, peers, plans)
+                if measured:
+                    walls[name] += wall
+                    lats[name].extend(lat)
+                    recompiles[name] += witness.total_compiles() - compiles_before
+    finally:
+        gc.enable()
+        pool.shutdown()
     paths = {name: _summarize(walls[name], lats[name]) for name, _ in named}
 
     return {
@@ -284,6 +353,22 @@ def run(hosts: int, parents: int, announcers: int, announces: int,
     }
 
 
+def _sweep_entry(result: dict, hosts: int, parents: int) -> dict:
+    """Per-shape summary line: the rule-path speedup PER SHAPE is the
+    headline (BENCHMARKS.md used to narrate the 50-candidate ~1× number
+    in prose only; now every shape reports it in the JSON)."""
+    paths = result["paths"]
+    return {
+        "hosts": hosts,
+        "parents": parents,
+        "speedup_rule": result["speedup_rule"],
+        "speedup_ml": result["speedup_ml"],
+        "scalar_rule_announces_per_sec": paths["scalar_rule"]["announces_per_sec"],
+        "vector_rule_announces_per_sec": paths["vector_rule"]["announces_per_sec"],
+        "vector_ml_announces_per_sec": paths["vector_ml"]["announces_per_sec"],
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--hosts", type=int, default=1000)
@@ -292,10 +377,15 @@ def main(argv=None) -> int:
     p.add_argument("--announces", type=int, default=2048,
                    help="total announces per measured path")
     p.add_argument("--linger-ms", type=float, default=1.5)
-    p.add_argument("--rounds", type=int, default=4,
+    p.add_argument("--rounds", type=int, default=6,
                    help="interleaved measurement rounds per path "
-                        "(+1 unmeasured warm-up round)")
+                        "(+1 unmeasured warm-up round); more rounds "
+                        "average shared-box noise out of the ratios")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--sweep-parents", default="50,400",
+                   help="comma-separated candidate-set sizes for the "
+                        "per-shape sweep (announces scale down so each "
+                        "shape does comparable total ranking work)")
     p.add_argument("--smoke", action="store_true",
                    help="tiny sizes: the tier-1 JSON-schema gate")
     args = p.parse_args(argv)
@@ -303,9 +393,22 @@ def main(argv=None) -> int:
         args.hosts, args.parents = 64, 8
         args.announcers, args.announces = 4, 64
         args.linger_ms, args.rounds = 0.2, 1
+        args.sweep_parents = "8,16"
     try:
         out = run(args.hosts, args.parents, args.announcers, args.announces,
                   args.linger_ms, args.seed, args.rounds)
+        sweep = [_sweep_entry(out, args.hosts, args.parents)]
+        for par in [int(x) for x in args.sweep_parents.split(",") if x]:
+            if par == args.parents:
+                continue  # primary shape already measured above
+            ann = max(
+                args.announces * args.parents // max(par, 1),
+                args.announcers * max(args.rounds, 1),
+            )
+            r = run(args.hosts, par, args.announcers, ann,
+                    args.linger_ms, args.seed, args.rounds)
+            sweep.append(_sweep_entry(r, args.hosts, par))
+        out["sweep"] = sweep
         missing = [k for k in SCHEMA_KEYS if k not in out]
         if missing:
             raise RuntimeError(f"schema keys missing: {missing}")

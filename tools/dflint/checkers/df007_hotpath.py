@@ -47,14 +47,25 @@ REQUIRED_HOTPATH = {
     "dragonfly2_tpu/scheduler/evaluator.py": (
         "Evaluator.evaluate_parents",
         "Evaluator.evaluate_all",
+        "Evaluator._evaluate_all_columnar",
         "NetworkTopologyEvaluator.evaluate_all",
         "MLEvaluator.evaluate_parents",
         "MLEvaluator._featurize",
+        "MLEvaluator._featurize_slots",
     ),
-    "dragonfly2_tpu/scheduler/featcache.py": ("HostFeatureCache.gather",),
+    "dragonfly2_tpu/scheduler/featcache.py": (
+        "HostFeatureCache.gather",
+        "HostFeatureCache.rule_scores",
+    ),
     "dragonfly2_tpu/scheduler/microbatch.py": ("ScorerBatcher.score",),
     "dragonfly2_tpu/records/features.py": ("edge_features_batch",),
     "dragonfly2_tpu/trainer/export.py": ("MLPScorer.score", "GNNScorer.score"),
+    # Fused gather+score serving entry points (ops/pallas_score.py): the
+    # one-dispatch-per-flush contract dies if these grow per-row python.
+    "dragonfly2_tpu/ops/pallas_score.py": (
+        "FusedMLPScorer.score",
+        "rule_weighted_sum",
+    ),
 }
 
 
