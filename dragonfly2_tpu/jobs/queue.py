@@ -148,8 +148,23 @@ class JobQueue:
             )
             self.jobs[j.id] = j
         for d in self._gtable.load_all().values():
-            self.groups[d["id"]] = GroupJob(d["id"], list(d["job_ids"]))
+            # A crash can strand half the pair: drop ids whose job row
+            # never committed (group row won the race pre-fix era), and
+            # re-adopt jobs whose row carries a group_id the group row
+            # missed (job commits first, DF014 write order).
+            ids = [i for i in d["job_ids"] if i in self.jobs]
+            self.groups[d["id"]] = GroupJob(d["id"], ids)
         for j in sorted(self.jobs.values(), key=lambda x: x.created_at):
+            if j.group_id is not None:
+                # Boot is single-threaded, but the repaired group row
+                # writes through the same locked path as live traffic.
+                with self._mu:
+                    group = self.groups.setdefault(
+                        j.group_id, GroupJob(j.group_id)
+                    )
+                    if j.id not in group.job_ids:
+                        group.job_ids.append(j.id)
+                        self._persist_group(group)
             if j.state is JobState.PENDING:
                 self._q(j.queue).put(j)
 
@@ -174,15 +189,19 @@ class JobQueue:
         )
         with self._mu:
             self.jobs[job.id] = job
+            # Persist under _mu, BEFORE the queue put: a worker can poll
+            # the job the instant it lands, and an unlocked write here
+            # could commit a torn STARTED/started_at=0 row that the
+            # stale-requeue can never redeliver after a crash.  The job
+            # row also commits BEFORE the group row that references its
+            # id (DF014 write order): a crash between the two leaves a
+            # complete job row the group reconciler re-adopts on reload,
+            # never a group pointing at a job that doesn't exist.
+            self._persist_job(job)
             if group_id is not None:
                 group = self.groups.setdefault(group_id, GroupJob(group_id))
                 group.job_ids.append(job.id)
                 self._persist_group(group)
-            # Persist under _mu, BEFORE the queue put: a worker can poll
-            # the job the instant it lands, and an unlocked write here
-            # could commit a torn STARTED/started_at=0 row that the
-            # stale-requeue can never redeliver after a crash.
-            self._persist_job(job)
         q = self._q(queue_name)
         while q.qsize() >= self.max_backlog:
             try:

@@ -516,6 +516,8 @@ class ManagerRESTServer:
                     path.endswith(":activate")
                     or path.endswith(":deactivate")
                     or path.endswith(":rollout")
+                    or (path.startswith("/api/v1/models/")
+                        and path.endswith(":delete"))
                 ):
                     required = Role.OPERATOR
                 elif path == "/api/v1/jobs":
@@ -731,6 +733,30 @@ class ManagerRESTServer:
                                 canary_percent=req.get("canary_percent"),
                             )
                             self._json(200, server.rollout.to_json(r))
+                            return
+                        elif action == "delete":
+                            # Model deletes flow through the rollout
+                            # controller's guarded cleanup (DF014 foreign
+                            # key models→rollouts): rollout rows must not
+                            # outlive the model row they reference.  An ad
+                            # hoc controller covers managers without a
+                            # rollout plane configured (no rows to strand,
+                            # same guarded path).
+                            controller = server.rollout
+                            if controller is None:
+                                from ..rollout.controller import (
+                                    RolloutController,
+                                )
+
+                                controller = RolloutController(server.registry)
+                            if server.registry.get(model_id) is None:
+                                self._json(
+                                    404,
+                                    {"error": f"model {model_id} not found"},
+                                )
+                                return
+                            controller.delete_model(model_id)
+                            self._json(200, {"deleted": model_id})
                             return
                         else:
                             self._json(404, {"error": f"unknown action {action}"})

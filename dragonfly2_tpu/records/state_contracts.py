@@ -1,0 +1,330 @@
+"""DF013-DF015 state-machine / crash-consistency / RPC-parity contract
+registry — declared ONCE, checked twice (DESIGN.md §19).
+
+The invariants the Manager-HA and sharded-scheduler roadmap items stand
+on live here as one literal dict, in the mould of
+``records/contracts.py`` (DF012):
+
+- **statically**, ``tools/dflint/staterules.py`` parses this file's AST
+  (``ast.literal_eval`` — no import, dflint stays stdlib-only) and
+  machine-checks every mutation site: FSM event legality and mirror
+  discipline (DF013), StateBackend persistence-site crash consistency —
+  one-transaction multi-row flips, owning-lock writes, recovery loaders,
+  write ordering, foreign-key delete cleanup (DF014), and RPC
+  client/server/transport method parity + retry idempotency
+  classification (DF015).
+- **dynamically**, the crash witness (``utils/dfcrash.py`` +
+  ``tests/test_zz_crashwitness.py``) records every KVTable write the
+  tier-1 suite performs and cross-validates it against the static
+  persistence inventory, then crash-injects at the declared multi-row
+  sites and asserts each namespace's declared invariant after reload.
+
+Because dflint evaluates ``STATE_CONTRACTS`` with ``ast.literal_eval``,
+the dict MUST stay a pure literal: no names, calls, or comprehensions.
+
+Sections:
+
+``machines``
+    One entry per state machine.  FSM-style machines (``kind: "fsm"``)
+    name the defining module/class, the FSM attribute, the
+    ``EventDesc`` tuple variable (cross-checked literal-for-literal —
+    drift between this registry and the code fails DF013 by machine
+    name), declared mirror attributes with their allowed writer
+    functions, and the modules allowed to call ``fsm.set_state`` (wire
+    mirrors).  Enum-style machines (``kind: "enum"``) name the enum
+    class, the attribute carrying the state, the owning modules (the
+    only places a direct ``.state =`` write is legal), the declared
+    edge list, and ``mutators``: which module may request which target
+    state through the registry gateways (``set_state``/``activate``/
+    ``deactivate``).
+
+``persistence``
+    ``namespaces``: every StateBackend table namespace ever written,
+    with its owning module, owning lock (``Class.attr``), recovery
+    loader (a ``load_all`` consumer reachable from a constructor),
+    declared multi-row transaction sites (must be ONE ``put_many``,
+    never sequential ``put``s), boot-time writers exempt from the lock
+    rule, and the invariant name the crash witness asserts after a
+    reload.  ``write_order``: ordered namespace pairs — in any function
+    writing both, the first write to the second namespace must not
+    precede the first write to the first (a crash between them must
+    leave the referencing row absent, not dangling).
+    ``foreign_keys``: parent/child delete coupling — the child cleanup
+    must be the only caller of the parent's delete primitive.
+    ``implementation``: modules whose table-class bodies ARE the
+    backend (exempt from consumer rules).
+
+``rpc``
+    Per logical service: the client classes whose ``_call`` literals
+    are the method inventory, the inproc server dispatch set, the gRPC
+    transport method table, and the idempotency classification every
+    retried method must carry — ``idempotent`` (blind retry safe) or
+    ``deduped`` (named server-side dedup seam, verified to exist).
+"""
+
+from __future__ import annotations
+
+STATE_CONTRACTS = {
+    "machines": {
+        # -- scheduler peer lifecycle (peer.go:52-110) ----------------------
+        "peer": {
+            "kind": "fsm",
+            "file": "dragonfly2_tpu/scheduler/resource.py",
+            "class": "Peer",
+            "attr": "fsm",
+            "events_var": "PEER_EVENTS",
+            "initial": "Pending",
+            "states": [
+                "Pending", "ReceivedEmpty", "ReceivedTiny", "ReceivedSmall",
+                "ReceivedNormal", "Running", "BackToSource", "Succeeded",
+                "Failed", "Leave",
+            ],
+            "events": {
+                "RegisterEmpty": [["Pending", "ReceivedEmpty"]],
+                "RegisterTiny": [["Pending", "ReceivedTiny"]],
+                "RegisterSmall": [["Pending", "ReceivedSmall"]],
+                "RegisterNormal": [["Pending", "ReceivedNormal"]],
+                "Download": [
+                    ["ReceivedEmpty", "Running"], ["ReceivedTiny", "Running"],
+                    ["ReceivedSmall", "Running"], ["ReceivedNormal", "Running"],
+                ],
+                "DownloadBackToSource": [
+                    ["ReceivedEmpty", "BackToSource"],
+                    ["ReceivedTiny", "BackToSource"],
+                    ["ReceivedSmall", "BackToSource"],
+                    ["ReceivedNormal", "BackToSource"],
+                    ["Running", "BackToSource"],
+                ],
+                "DownloadSucceeded": [
+                    ["ReceivedEmpty", "Succeeded"], ["ReceivedTiny", "Succeeded"],
+                    ["ReceivedSmall", "Succeeded"],
+                    ["ReceivedNormal", "Succeeded"], ["Running", "Succeeded"],
+                    ["BackToSource", "Succeeded"],
+                ],
+                "DownloadFailed": [
+                    ["Pending", "Failed"], ["ReceivedEmpty", "Failed"],
+                    ["ReceivedTiny", "Failed"], ["ReceivedSmall", "Failed"],
+                    ["ReceivedNormal", "Failed"], ["Running", "Failed"],
+                    ["BackToSource", "Failed"], ["Succeeded", "Failed"],
+                ],
+                "Leave": [
+                    ["Pending", "Leave"], ["ReceivedEmpty", "Leave"],
+                    ["ReceivedTiny", "Leave"], ["ReceivedSmall", "Leave"],
+                    ["ReceivedNormal", "Leave"], ["Running", "Leave"],
+                    ["BackToSource", "Leave"], ["Failed", "Leave"],
+                    ["Succeeded", "Leave"],
+                ],
+            },
+            # Lock-free serving mirrors (DESIGN.md §18): written ONLY at
+            # construction and inside the FSM's enter_state callback.
+            "mirrors": {
+                "fsm_state": ["Peer.__init__", "Peer._mirror_fsm"],
+                "fsm_elevated": ["Peer.__init__", "Peer._mirror_fsm"],
+            },
+            # Wire-mirror peers (client-side stand-ins for remote state)
+            # may force-set; nothing else calls fsm.set_state.
+            "set_state_modules": ["dragonfly2_tpu/rpc/scheduler_client.py"],
+        },
+        # -- scheduler task lifecycle (task.go:57-85) -----------------------
+        "task": {
+            "kind": "fsm",
+            "file": "dragonfly2_tpu/scheduler/resource.py",
+            "class": "Task",
+            "attr": "fsm",
+            "events_var": "TASK_EVENTS",
+            "initial": "Pending",
+            "states": ["Pending", "Running", "Succeeded", "Failed", "Leave"],
+            "events": {
+                "Download": [
+                    ["Pending", "Running"], ["Succeeded", "Running"],
+                    ["Failed", "Running"], ["Leave", "Running"],
+                ],
+                "DownloadSucceeded": [
+                    ["Leave", "Succeeded"], ["Running", "Succeeded"],
+                    ["Failed", "Succeeded"],
+                ],
+                "DownloadFailed": [["Running", "Failed"]],
+                "Leave": [
+                    ["Pending", "Leave"], ["Running", "Leave"],
+                    ["Succeeded", "Leave"], ["Failed", "Leave"],
+                ],
+            },
+            "mirrors": {},
+            "set_state_modules": [],
+        },
+        # -- model version lifecycle (manager registry + rollout plane) -----
+        "model_state": {
+            "kind": "enum",
+            "file": "dragonfly2_tpu/manager/registry.py",
+            "enum": "ModelState",
+            "owner_class": "Model",
+            "state_attr": "state",
+            # Direct `.state = ModelState.X` writes are legal ONLY here —
+            # every other module must go through the registry gateways.
+            "owner_modules": ["dragonfly2_tpu/manager/registry.py"],
+            "states": ["active", "inactive", "shadow", "canary"],
+            "edges": [
+                ["inactive", "active"],    # activate (operator / promote)
+                ["active", "inactive"],    # demotion half of the flip
+                ["inactive", "shadow"],    # rollout begin
+                ["shadow", "canary"],      # rollout advance
+                ["shadow", "inactive"],    # rollback / displaced candidate
+                ["canary", "active"],      # rollout promote
+                ["canary", "inactive"],    # rollback / displaced candidate
+            ],
+            # Gateway calls (`registry.set_state(id, ModelState.X)` /
+            # `registry.activate/deactivate`): which module may request
+            # which target state.  The receiver is recognized by type
+            # (ModelRegistry) or by the declared gateway attribute name.
+            "gateway_attrs": ["registry"],
+            "mutators": {
+                "dragonfly2_tpu/manager/registry.py": [
+                    "active", "inactive", "shadow", "canary",
+                ],
+                "dragonfly2_tpu/rollout/controller.py": [
+                    "active", "inactive", "shadow", "canary",
+                ],
+                "dragonfly2_tpu/manager/rest.py": ["active", "inactive"],
+                "dragonfly2_tpu/rpc/grpc_transport.py": ["active", "inactive"],
+            },
+        },
+        # -- rollout phase machine (rollout/controller.py) ------------------
+        "rollout_phase": {
+            "kind": "enum",
+            "file": "dragonfly2_tpu/rollout/controller.py",
+            "enum": "RolloutPhase",
+            "owner_class": "Rollout",
+            "state_attr": "phase",
+            "owner_modules": ["dragonfly2_tpu/rollout/controller.py"],
+            "states": ["shadow", "canary", "active", "rolled_back"],
+            "edges": [
+                ["shadow", "canary"],
+                ["canary", "active"],
+                ["shadow", "rolled_back"],
+                ["canary", "rolled_back"],
+                ["active", "rolled_back"],
+            ],
+            "gateway_attrs": [],
+            "mutators": {},
+        },
+    },
+    "persistence": {
+        "namespaces": {
+            "models": {
+                "owner": "dragonfly2_tpu/manager/registry.py",
+                "lock": ["dragonfly2_tpu/manager/registry.py",
+                         "ModelRegistry", "_mu"],
+                "loader": "ModelRegistry.__init__",
+                # The single-ACTIVE flip touches two rows; a crash
+                # between separate commits would leave two ACTIVEs.
+                "multi_row": ["ModelRegistry._persist"],
+                "unlocked_ok": ["migrate_legacy_sqlite"],
+                "invariant": "single_active",
+            },
+            "rollouts": {
+                "owner": "dragonfly2_tpu/rollout/controller.py",
+                "lock": ["dragonfly2_tpu/rollout/controller.py",
+                         "RolloutController", "_mu"],
+                "loader": "RolloutController.__init__",
+                "multi_row": [],
+                "unlocked_ok": [],
+                "invariant": "no_dangling_rollout",
+            },
+            "jobs": {
+                "owner": "dragonfly2_tpu/jobs/queue.py",
+                "lock": ["dragonfly2_tpu/jobs/queue.py", "JobQueue", "_mu"],
+                "loader": "JobQueue._reload",
+                "multi_row": [],
+                "unlocked_ok": [],
+                "invariant": "jobs_absent_or_complete",
+            },
+            "job_groups": {
+                "owner": "dragonfly2_tpu/jobs/queue.py",
+                "lock": ["dragonfly2_tpu/jobs/queue.py", "JobQueue", "_mu"],
+                "loader": "JobQueue._reload",
+                "multi_row": [],
+                "unlocked_ok": [],
+                "invariant": "jobs_absent_or_complete",
+            },
+            "users": {
+                "owner": "dragonfly2_tpu/manager/users.py",
+                "lock": ["dragonfly2_tpu/manager/users.py", "UserStore", "_mu"],
+                "loader": "_BackendUserStore.load_all",
+                "multi_row": [],
+                "unlocked_ok": ["migrate_legacy_sqlite"],
+                "invariant": "rows_load",
+            },
+            "pats": {
+                "owner": "dragonfly2_tpu/manager/users.py",
+                "lock": ["dragonfly2_tpu/manager/users.py", "UserStore", "_mu"],
+                "loader": "_BackendUserStore.load_all",
+                "multi_row": [],
+                "unlocked_ok": ["migrate_legacy_sqlite"],
+                "invariant": "rows_load",
+            },
+            "crud": {
+                "owner": "dragonfly2_tpu/manager/crud.py",
+                "lock": ["dragonfly2_tpu/manager/crud.py", "CrudStore", "_mu"],
+                "loader": "CrudStore.__init__",
+                "multi_row": [],
+                "unlocked_ok": ["migrate_legacy_sqlite"],
+                "invariant": "rows_load",
+            },
+            "topology": {
+                "owner": "dragonfly2_tpu/manager/rest.py",
+                "lock": ["dragonfly2_tpu/manager/rest.py",
+                         "ManagerRESTServer", "_topology_mu"],
+                "loader": "ManagerRESTServer.__init__",
+                "multi_row": [],
+                "unlocked_ok": [],
+                "invariant": "rows_load",
+            },
+        },
+        # A crash between the two writes must leave the REFERENCING row
+        # absent (recoverable), never dangling: the job row commits
+        # before the group row that names its id.
+        "write_order": [["jobs", "job_groups"]],
+        "foreign_keys": [
+            {
+                # Deleting a model must not strand its rollout row: the
+                # controller's delete_model is the only legal entry.
+                "parent": "models",
+                "child": "rollouts",
+                "primitive": "ModelRegistry.delete",
+                "cleanup": "RolloutController.delete_model",
+                "cleanup_file": "dragonfly2_tpu/rollout/controller.py",
+            },
+        ],
+        "implementation": ["dragonfly2_tpu/manager/state.py"],
+    },
+    "rpc": {
+        "scheduler": {
+            "clients": {
+                "dragonfly2_tpu/rpc/scheduler_client.py": ["RemoteScheduler"],
+            },
+            "server": ["dragonfly2_tpu/rpc/scheduler_server.py",
+                       "SchedulerRPCAdapter", "METHODS"],
+            "grpc": ["dragonfly2_tpu/rpc/grpc_transport.py",
+                     "SCHEDULER_METHODS"],
+            # Blind-retry-safe: the handler is an absolute upsert, a
+            # first-writer-wins guard, or a pure read.
+            "idempotent": [
+                "announce_host", "set_task_info", "set_task_direct_piece",
+                "sync_probes_start", "sync_probes_finished",
+                "report_piece_failed", "topology_rtt",
+            ],
+            # Retried non-idempotent methods carry a named server-side
+            # dedup seam (verified to exist by DF015).
+            "deduped": {
+                "register_peer": "SchedulerService.register_peer",
+                "report_piece_finished": "Peer.finish_piece",
+                "report_peer_finished": "_try_event",
+                "report_peer_failed": "_try_event",
+                "mark_back_to_source": "_try_event",
+                "leave_peer": "_try_event",
+            },
+            "seam_files": ["dragonfly2_tpu/scheduler/service.py",
+                           "dragonfly2_tpu/scheduler/resource.py"],
+        },
+    },
+}

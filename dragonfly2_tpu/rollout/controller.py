@@ -121,6 +121,91 @@ class RolloutController:
                 metrics.ROLLOUT_STATE.set(
                     _state_code(r.phase), scheduler_id=r.scheduler_id, name=r.name
                 )
+        # Crash-between-rows recovery (DF014): the registry flip and the
+        # rollout row live in different tables, so a crash between the
+        # two commits can leave them disagreeing.  The registry is the
+        # source of truth — reconcile the rows to it on every load.
+        self._reconcile()
+
+    def _reconcile(self) -> None:
+        """Repair rollout rows against the registry after a restart.
+
+        Covers every tear a crash between the registry's transactional
+        flip and the rollout-row put can leave:
+
+        - a candidate model (SHADOW/CANARY) with NO rollout row (crash
+          in ``begin``/after a lost row): the row is ADOPTED — without
+          it, every evaluation report would KeyError forever;
+        - a row whose model is gone (crash inside ``delete_model``
+          between the child and parent deletes): the row is dropped;
+        - a row whose phase disagrees with the model state (crash in
+          ``_advance``/``_rollback`` after the registry commit): the
+          phase follows the registry.
+        """
+        with self._mu:
+            for key, rollout in list(self._rollouts.items()):
+                model = self.registry.get(rollout.model_id)
+                if model is None:
+                    # Parent row deleted; drop the dangling child.
+                    del self._rollouts[key]
+                    if self._table is not None:
+                        self._table.delete(key)
+                    logger.warning(
+                        "rollout %s: model %s gone; dropped dangling row",
+                        key, rollout.model_id,
+                    )
+                    continue
+                state = model.state.value
+                if rollout.phase in (
+                    RolloutPhase.SHADOW.value, RolloutPhase.CANARY.value,
+                    RolloutPhase.ACTIVE.value,
+                ) and rollout.phase != state:
+                    if state in (
+                        RolloutPhase.SHADOW.value, RolloutPhase.CANARY.value,
+                        RolloutPhase.ACTIVE.value,
+                    ):
+                        # The registry committed an advance the row missed.
+                        rollout.phase = state
+                        rollout.phase_baseline = rollout.joined_edges
+                        rollout.reason = "phase reconciled to registry after restart"
+                    else:
+                        # Candidate was demoted (rollback committed to the
+                        # registry only).
+                        rollout.phase = RolloutPhase.ROLLED_BACK.value
+                        rollout.reason = (
+                            "rolled back during crash recovery: registry "
+                            f"shows {state!r}"
+                        )
+                    self._persist(rollout)
+                    logger.warning("rollout %s: %s", key, rollout.reason)
+            for model in self.registry.list():
+                if model.state.value not in (
+                    RolloutPhase.SHADOW.value, RolloutPhase.CANARY.value,
+                ):
+                    continue
+                key = f"{model.scheduler_id}:{model.name}"
+                if key in self._rollouts and self._rollouts[key].phase != \
+                        RolloutPhase.ROLLED_BACK.value:
+                    continue
+                previous = self.registry.active_model(
+                    model.scheduler_id, model.name
+                )
+                adopted = Rollout(
+                    scheduler_id=model.scheduler_id,
+                    name=model.name,
+                    model_id=model.id,
+                    version=model.version,
+                    phase=model.state.value,
+                    previous_active_id=previous.id if previous else "",
+                    canary_percent=self.guardrails.canary_percent,
+                    reason="adopted during crash recovery",
+                )
+                self._rollouts[key] = adopted
+                self._persist(adopted)
+                logger.warning(
+                    "rollout %s v%d: adopted orphan %s candidate after "
+                    "restart", key, adopted.version, model.state.value,
+                )
 
     def _persist(self, rollout: Rollout) -> None:
         rollout.updated_at = time.time()
@@ -167,6 +252,25 @@ class RolloutController:
                 rollout.key, rollout.version, rollout.previous_active_id or "none",
             )
             return rollout
+
+    def delete_model(self, model_id: str) -> None:
+        """The ONLY legal model-delete entry (DF014 foreign key
+        models→rollouts, records/state_contracts.py): rollout rows
+        referencing the model are dropped BEFORE the registry row, so a
+        crash between the two deletes leaves at worst a model without
+        rollout rows — never a rollout row pointing at a deleted model
+        (and even that tear is repaired by ``_reconcile`` on reload)."""
+        with self._mu:
+            for key, rollout in list(self._rollouts.items()):
+                if rollout.model_id != model_id:
+                    continue
+                del self._rollouts[key]
+                if self._table is not None:
+                    self._table.delete(key)
+                metrics.ROLLOUT_STATE.set(
+                    0, scheduler_id=rollout.scheduler_id, name=rollout.name
+                )
+            self.registry.delete(model_id)
 
     def get(self, scheduler_id: str, name: str) -> Optional[Rollout]:
         with self._mu:

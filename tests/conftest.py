@@ -1,5 +1,5 @@
 """Test config: virtual 8-device CPU mesh, lock witness, compile witness,
-deadlock watchdog.
+crash witness, deadlock watchdog.
 
 Session-wide concerns live here, in load order:
 
@@ -82,6 +82,22 @@ if os.environ.get("DF_COMPILE_WITNESS", "1") != "0":
     _tspec.loader.exec_module(_dftrace)
     sys.modules["dragonfly2_tpu.utils.dftrace"] = _dftrace
     _dftrace.install(str(_REPO / "dragonfly2_tpu"))
+
+# -- 2c. crash witness (dfcrash) --------------------------------------------
+# Installed AFTER dflock/dftrace (so the state module's import is itself
+# witnessed) and BEFORE any test imports: every KVTable write the suite
+# performs from project code records (namespace, caller site, method,
+# rows).  tests/test_zz_crashwitness.py cross-validates the observations
+# against DF014's static persistence inventory
+# (tools/dflint/staterules.py) and crash-injects at the declared
+# multi-row sites.  Set DF_CRASH_WITNESS=0 to disable.
+
+if os.environ.get("DF_CRASH_WITNESS", "1") != "0":
+    if str(_REPO) not in sys.path:
+        sys.path.insert(0, str(_REPO))
+    from dragonfly2_tpu.utils import dfcrash as _dfcrash
+
+    _dfcrash.install(str(_REPO / "dragonfly2_tpu"))
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -2269,3 +2269,1113 @@ class TestCompileBudgetFile:
         assert rc == 1 and "DF010" in out
         rc = main([str(src), "--rule", "DF012", "--no-baseline"])
         assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# State-machine / crash-consistency / RPC-parity analysis
+# (tools/dflint/staterules.py): DF013 / DF014 / DF015 fixtures, contract
+# staleness, plus mutation sensitivity against the REAL tree
+# ---------------------------------------------------------------------------
+
+from tools.dflint.staterules import (  # noqa: E402
+    StateAnalysis,
+    crash_witness_gaps,
+)
+
+_SC_PATH = "dragonfly2_tpu/records/state_contracts.py"
+
+
+def state(files: dict) -> StateAnalysis:
+    return StateAnalysis(prog(files))
+
+
+def state_rules(a: StateAnalysis):
+    return sorted({f.rule for f in a.findings()})
+
+
+_FSM_CONTRACT = """
+STATE_CONTRACTS = {
+    "machines": {
+        "widget": {
+            "kind": "fsm",
+            "file": "dragonfly2_tpu/daemon/w.py",
+            "class": "Widget",
+            "attr": "fsm",
+            "events_var": "W_EVENTS",
+            "initial": "Idle",
+            "states": ["Idle", "Busy"],
+            "events": {
+                "Start": [["Idle", "Busy"]],
+                "Stop": [["Busy", "Idle"]],
+            },
+            "mirrors": {"fsm_state": ["Widget.__init__", "Widget._mirror"]},
+            "set_state_modules": ["dragonfly2_tpu/daemon/mirror.py"],
+        },
+    },
+}
+"""
+
+_W_SRC = """
+from ..utils.fsm import FSM, EventDesc
+
+W_IDLE = "Idle"
+W_BUSY = "Busy"
+W_EVENTS = (
+    EventDesc("Start", (W_IDLE,), W_BUSY),
+    EventDesc("Stop", (W_BUSY,), W_IDLE),
+)
+
+
+class Widget:
+    def __init__(self):
+        self.fsm_state = W_IDLE
+        self.fsm = FSM(W_IDLE, W_EVENTS,
+                       callbacks={"enter_state": self._mirror})
+
+    def _mirror(self, fsm, event, src, dst):
+        self.fsm_state = dst
+
+    def go(self):
+        self.fsm.event("Start")
+"""
+
+
+class TestDF013FsmFixtures:
+    def test_clean_machine_passes(self):
+        a = state({_SC_PATH: _FSM_CONTRACT, "dragonfly2_tpu/daemon/w.py": _W_SRC})
+        assert a.findings() == [], [f.render() for f in a.findings()]
+
+    def test_undeclared_event_fires_by_machine_name(self):
+        src = _W_SRC + """
+
+    def explode(self):
+        self.fsm.event("Explode")
+"""
+        a = state({_SC_PATH: _FSM_CONTRACT, "dragonfly2_tpu/daemon/w.py": src})
+        assert any(
+            f.rule == "DF013" and "'widget'" in f.message
+            and "'Explode'" in f.message
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+
+    def test_code_event_missing_from_contract_fires(self):
+        src = _W_SRC.replace(
+            'EventDesc("Stop", (W_BUSY,), W_IDLE),',
+            'EventDesc("Stop", (W_BUSY,), W_IDLE),\n'
+            '    EventDesc("Kill", (W_BUSY,), W_IDLE),',
+        )
+        a = state({_SC_PATH: _FSM_CONTRACT, "dragonfly2_tpu/daemon/w.py": src})
+        assert any(
+            f.rule == "DF013" and "'Kill'" in f.message
+            and "not declared" in f.message
+            for f in a.findings()
+        )
+
+    def test_stale_contract_event_fires(self):
+        contract = _FSM_CONTRACT.replace(
+            '"Stop": [["Busy", "Idle"]],',
+            '"Stop": [["Busy", "Idle"]],\n                "Pause": [["Busy", "Idle"]],',
+        )
+        a = state({_SC_PATH: contract, "dragonfly2_tpu/daemon/w.py": _W_SRC})
+        assert any(
+            f.rule == "DF013" and "'Pause'" in f.message
+            and "stale" in f.message
+            for f in a.findings()
+        )
+
+    def test_edge_drift_fires(self):
+        contract = _FSM_CONTRACT.replace(
+            '"Stop": [["Busy", "Idle"]],', '"Stop": [["Idle", "Idle"]],'
+        )
+        a = state({_SC_PATH: contract, "dragonfly2_tpu/daemon/w.py": _W_SRC})
+        assert any(
+            f.rule == "DF013" and "edges drifted" in f.message
+            for f in a.findings()
+        )
+
+    def test_forwarder_literal_is_checked(self):
+        src = _W_SRC + """
+
+def try_event(fsm, name):
+    fsm.event(name)
+
+
+def drive(w: "Widget"):
+    try_event(w.fsm, "Vanish")
+"""
+        a = state({_SC_PATH: _FSM_CONTRACT, "dragonfly2_tpu/daemon/w.py": src})
+        assert any(
+            f.rule == "DF013" and "'Vanish'" in f.message
+            for f in a.findings()
+        )
+
+    def test_set_state_in_mirror_module_with_declared_state_ok(self):
+        mirror = """
+from .w import Widget
+
+
+def force(w: "Widget"):
+    w.fsm.set_state("Idle")
+"""
+        a = state({
+            _SC_PATH: _FSM_CONTRACT,
+            "dragonfly2_tpu/daemon/w.py": _W_SRC,
+            "dragonfly2_tpu/daemon/mirror.py": mirror,
+        })
+        assert a.findings() == [], [f.render() for f in a.findings()]
+
+    def test_set_state_outside_mirror_modules_fires(self):
+        rogue = """
+from .w import Widget
+
+
+def force(w: "Widget"):
+    w.fsm.set_state("Idle")
+"""
+        a = state({
+            _SC_PATH: _FSM_CONTRACT,
+            "dragonfly2_tpu/daemon/w.py": _W_SRC,
+            "dragonfly2_tpu/daemon/rogue.py": rogue,
+        })
+        assert any(
+            f.rule == "DF013" and "set_state" in f.message
+            and f.path == "dragonfly2_tpu/daemon/rogue.py"
+            for f in a.findings()
+        )
+
+    def test_set_state_to_undeclared_state_fires(self):
+        mirror = """
+from .w import Widget
+
+
+def force(w: "Widget"):
+    w.fsm.set_state("Haunted")
+"""
+        a = state({
+            _SC_PATH: _FSM_CONTRACT,
+            "dragonfly2_tpu/daemon/w.py": _W_SRC,
+            "dragonfly2_tpu/daemon/mirror.py": mirror,
+        })
+        assert any(
+            f.rule == "DF013" and "'Haunted'" in f.message
+            for f in a.findings()
+        )
+
+    def test_mirror_write_outside_writers_fires(self):
+        src = _W_SRC + """
+
+def rogue(w):
+    w.fsm_state = W_BUSY
+"""
+        a = state({_SC_PATH: _FSM_CONTRACT, "dragonfly2_tpu/daemon/w.py": src})
+        assert any(
+            f.rule == "DF013" and "mirror 'fsm_state'" in f.message
+            for f in a.findings()
+        )
+
+    def test_pragma_suppresses(self):
+        src = _W_SRC + """
+
+def rogue(w):
+    w.fsm_state = W_BUSY  # dflint: disable=DF013
+"""
+        a = state({_SC_PATH: _FSM_CONTRACT, "dragonfly2_tpu/daemon/w.py": src})
+        assert a.findings() == []
+
+
+_ENUM_CONTRACT = """
+STATE_CONTRACTS = {
+    "machines": {
+        "light": {
+            "kind": "enum",
+            "file": "dragonfly2_tpu/daemon/light.py",
+            "enum": "LightState",
+            "owner_class": "Light",
+            "state_attr": "state",
+            "owner_modules": ["dragonfly2_tpu/daemon/light.py"],
+            "states": ["on", "off"],
+            "edges": [["off", "on"], ["on", "off"]],
+            "gateway_attrs": ["lights"],
+            "mutators": {
+                "dragonfly2_tpu/daemon/light.py": ["on", "off"],
+                "dragonfly2_tpu/daemon/ctrl.py": ["off"],
+            },
+        },
+    },
+}
+"""
+
+_LIGHT_SRC = """
+import enum
+
+
+class LightState(str, enum.Enum):
+    ON = "on"
+    OFF = "off"
+
+
+class Light:
+    def __init__(self):
+        self.state = LightState.OFF
+
+
+class LightRegistry:
+    def activate(self, light):
+        light.state = LightState.ON
+"""
+
+
+class TestDF013EnumFixtures:
+    def test_clean_passes(self):
+        a = state({
+            _SC_PATH: _ENUM_CONTRACT,
+            "dragonfly2_tpu/daemon/light.py": _LIGHT_SRC,
+        })
+        assert a.findings() == [], [f.render() for f in a.findings()]
+
+    def test_direct_state_write_outside_owner_fires(self):
+        ctrl = """
+from .light import LightState
+
+
+def rogue(light):
+    light.state = LightState.ON
+"""
+        a = state({
+            _SC_PATH: _ENUM_CONTRACT,
+            "dragonfly2_tpu/daemon/light.py": _LIGHT_SRC,
+            "dragonfly2_tpu/daemon/ctrl.py": ctrl,
+        })
+        assert any(
+            f.rule == "DF013" and "outside the owning module" in f.message
+            for f in a.findings()
+        )
+
+    def test_gateway_call_with_allowed_state_ok(self):
+        ctrl = """
+from .light import LightRegistry, LightState
+
+
+def shutdown(lights: "LightRegistry", light):
+    lights.set_state(light, LightState.OFF)
+"""
+        a = state({
+            _SC_PATH: _ENUM_CONTRACT,
+            "dragonfly2_tpu/daemon/light.py": _LIGHT_SRC
+            + """
+    def set_state(self, light, st):
+        light.state = st
+""",
+            "dragonfly2_tpu/daemon/ctrl.py": ctrl,
+        })
+        assert a.findings() == [], [f.render() for f in a.findings()]
+
+    def test_gateway_call_with_forbidden_state_fires(self):
+        ctrl = """
+from .light import LightRegistry, LightState
+
+
+def rogue(lights: "LightRegistry", light):
+    lights.set_state(light, LightState.ON)
+"""
+        a = state({
+            _SC_PATH: _ENUM_CONTRACT,
+            "dragonfly2_tpu/daemon/light.py": _LIGHT_SRC
+            + """
+    def set_state(self, light, st):
+        light.state = st
+""",
+            "dragonfly2_tpu/daemon/ctrl.py": ctrl,
+        })
+        assert any(
+            f.rule == "DF013" and "may not request state 'on'" in f.message
+            for f in a.findings()
+        )
+
+    def test_gateway_call_from_undeclared_module_fires(self):
+        rogue = """
+from .light import LightState
+
+
+def flip(registry, light):
+    registry.set_state(light, LightState.OFF)
+"""
+        a = state({
+            _SC_PATH: _ENUM_CONTRACT,
+            "dragonfly2_tpu/daemon/light.py": _LIGHT_SRC,
+            "dragonfly2_tpu/daemon/zzz.py": rogue,
+        })
+        assert any(
+            f.rule == "DF013" and "not a declared mutator module" in f.message
+            for f in a.findings()
+        )
+
+    def test_stale_declared_state_fires(self):
+        contract = _ENUM_CONTRACT.replace(
+            '"states": ["on", "off"],', '"states": ["on", "off", "dim"],'
+        )
+        a = state({
+            _SC_PATH: contract,
+            "dragonfly2_tpu/daemon/light.py": _LIGHT_SRC,
+        })
+        assert any(
+            f.rule == "DF013" and "'dim'" in f.message
+            and "no enum member" in f.message
+            for f in a.findings()
+        )
+
+    def test_new_enum_member_not_declared_fires(self):
+        src = _LIGHT_SRC.replace('OFF = "off"', 'OFF = "off"\n    DIM = "dim"')
+        a = state({
+            _SC_PATH: _ENUM_CONTRACT,
+            "dragonfly2_tpu/daemon/light.py": src,
+        })
+        assert any(
+            f.rule == "DF013" and "'dim'" in f.message
+            and "not declared" in f.message
+            for f in a.findings()
+        )
+
+
+_P_CONTRACT = """
+STATE_CONTRACTS = {
+    "machines": {},
+    "persistence": {
+        "namespaces": {
+            "widgets": {
+                "owner": "dragonfly2_tpu/daemon/store.py",
+                "lock": ["dragonfly2_tpu/daemon/store.py", "WidgetStore", "_mu"],
+                "loader": "WidgetStore.__init__",
+                "multi_row": ["WidgetStore._flip"],
+                "unlocked_ok": [],
+                "invariant": "x",
+            },
+        },
+        "write_order": [],
+        "foreign_keys": [],
+        "implementation": [],
+    },
+}
+"""
+
+_STORE_SRC = """
+import threading
+
+
+class WidgetStore:
+    def __init__(self, backend):
+        self._mu = threading.Lock()
+        self._table = backend.table("widgets")
+        self._rows = self._table.load_all()
+
+    def flip_two(self, a, b):
+        with self._mu:
+            self._flip(a, b)
+
+    def _flip(self, a, b):
+        self._table.put_many({a: {}, b: {}})
+"""
+
+
+class TestDF014Fixtures:
+    def test_clean_store_passes(self):
+        a = state({
+            _SC_PATH: _P_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": _STORE_SRC,
+        })
+        assert a.findings() == [], [f.render() for f in a.findings()]
+
+    def test_split_put_in_multi_row_site_fires(self):
+        src = _STORE_SRC.replace(
+            "        self._table.put_many({a: {}, b: {}})",
+            "        self._table.put(a, {})\n        self._table.put(b, {})",
+        )
+        a = state({
+            _SC_PATH: _P_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": src,
+        })
+        assert any(
+            f.rule == "DF014" and "multi-row site WidgetStore._flip"
+            in f.message
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+
+    def test_multi_row_site_without_put_many_fires(self):
+        src = _STORE_SRC.replace(
+            "        self._table.put_many({a: {}, b: {}})",
+            "        pass",
+        )
+        a = state({
+            _SC_PATH: _P_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": src,
+        })
+        assert any(
+            f.rule == "DF014" and "no put_many" in f.message
+            for f in a.findings()
+        )
+
+    def test_unlocked_write_fires(self):
+        src = _STORE_SRC + """
+
+    def rogue(self, k):
+        self._table.put(k, {})
+"""
+        a = state({
+            _SC_PATH: _P_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": src,
+        })
+        assert any(
+            f.rule == "DF014" and "without the owning lock" in f.message
+            for f in a.findings()
+        )
+
+    def test_lock_inherited_from_all_callers_is_clean(self):
+        # _flip writes without a lexical lock; flip_two covers it.  The
+        # clean fixture already proves this — assert the negative
+        # explicitly: removing the caller's lock flips it to a finding.
+        src = _STORE_SRC.replace(
+            "        with self._mu:\n            self._flip(a, b)",
+            "        self._flip(a, b)",
+        )
+        a = state({
+            _SC_PATH: _P_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": src,
+        })
+        assert any(f.rule == "DF014" for f in a.findings())
+
+    def test_unlocked_read_in_writing_function_fires(self):
+        src = _STORE_SRC + """
+
+    def bump(self, k):
+        row = self._table.get(k)
+        with self._mu:
+            self._table.put(k, row or {})
+"""
+        a = state({
+            _SC_PATH: _P_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": src,
+        })
+        assert any(
+            f.rule == "DF014" and "read (in a writing function)" in f.message
+            for f in a.findings()
+        )
+
+    def test_unlocked_ok_declaration_exempts(self):
+        contract = _P_CONTRACT.replace(
+            '"unlocked_ok": [],', '"unlocked_ok": ["WidgetStore.rogue"],'
+        )
+        src = _STORE_SRC + """
+
+    def rogue(self, k):
+        self._table.put(k, {})
+"""
+        a = state({
+            _SC_PATH: contract,
+            "dragonfly2_tpu/daemon/store.py": src,
+        })
+        assert a.findings() == []
+
+    def test_undeclared_namespace_fires(self):
+        src = _STORE_SRC + """
+
+    def scratch(self, backend, k):
+        with self._mu:
+            t = backend.table("scratch")
+            t.put(k, {})
+"""
+        a = state({
+            _SC_PATH: _P_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": src,
+        })
+        assert any(
+            f.rule == "DF014" and "'scratch'" in f.message
+            and "not declared" in f.message
+            for f in a.findings()
+        )
+
+    def test_stale_declared_namespace_fires(self):
+        contract = _P_CONTRACT.replace(
+            '"invariant": "x",\n            },',
+            '"invariant": "x",\n            },\n'
+            '            "ghosts": {\n'
+            '                "owner": "dragonfly2_tpu/daemon/store.py",\n'
+            '                "lock": ["dragonfly2_tpu/daemon/store.py",\n'
+            '                         "WidgetStore", "_mu"],\n'
+            '                "loader": "WidgetStore.__init__",\n'
+            '                "multi_row": [],\n'
+            '                "unlocked_ok": [],\n'
+            '                "invariant": "x",\n'
+            '            },',
+        )
+        a = state({
+            _SC_PATH: contract,
+            "dragonfly2_tpu/daemon/store.py": _STORE_SRC,
+        })
+        assert any(
+            f.rule == "DF014" and "'ghosts'" in f.message
+            and "never bound" in f.message
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+
+    def test_loader_without_load_all_fires(self):
+        src = _STORE_SRC.replace(
+            "        self._rows = self._table.load_all()",
+            "        self._rows = {}",
+        )
+        a = state({
+            _SC_PATH: _P_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": src,
+        })
+        assert any(
+            f.rule == "DF014" and "no longer calls load_all" in f.message
+            for f in a.findings()
+        )
+
+    def test_loader_unreachable_from_constructor_fires(self):
+        contract = _P_CONTRACT.replace(
+            '"loader": "WidgetStore.__init__",',
+            '"loader": "WidgetStore.reload",',
+        )
+        src = _STORE_SRC + """
+
+    def reload(self):
+        self._rows = self._table.load_all()
+"""
+        a = state({
+            _SC_PATH: contract,
+            "dragonfly2_tpu/daemon/store.py": src,
+        })
+        assert any(
+            f.rule == "DF014" and "not reachable from any constructor"
+            in f.message
+            for f in a.findings()
+        )
+
+    _ORDER_CONTRACT = '''
+STATE_CONTRACTS = {
+    "machines": {},
+    "persistence": {
+        "namespaces": {
+            "widgets": {
+                "owner": "dragonfly2_tpu/daemon/store.py",
+                "lock": ["dragonfly2_tpu/daemon/store.py", "WidgetStore", "_mu"],
+                "loader": "WidgetStore.__init__",
+                "multi_row": [],
+                "unlocked_ok": [],
+                "invariant": "x",
+            },
+            "refs": {
+                "owner": "dragonfly2_tpu/daemon/store.py",
+                "lock": ["dragonfly2_tpu/daemon/store.py", "WidgetStore", "_mu"],
+                "loader": "WidgetStore.__init__",
+                "multi_row": [],
+                "unlocked_ok": [],
+                "invariant": "x",
+            },
+        },
+        "write_order": [["widgets", "refs"]],
+        "foreign_keys": [],
+        "implementation": [],
+    },
+}
+'''
+
+    def test_write_order_violation_fires_and_fix_passes(self):
+        bad = _STORE_SRC.replace(
+            "        self._table = backend.table(\"widgets\")\n"
+            "        self._rows = self._table.load_all()",
+            "        self._table = backend.table(\"widgets\")\n"
+            "        self._refs = backend.table(\"refs\")\n"
+            "        self._rows = self._table.load_all()\n"
+            "        self._refs.load_all()",
+        ) + '''
+
+    def add(self, k):
+        with self._mu:
+            self._refs.put(k, {})
+            self._table.put(k, {})
+'''
+        a = state({
+            _SC_PATH: self._ORDER_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": bad,
+        })
+        assert any(
+            f.rule == "DF014" and "write-order violation" in f.message
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+        good = bad.replace(
+            "            self._refs.put(k, {})\n"
+            "            self._table.put(k, {})",
+            "            self._table.put(k, {})\n"
+            "            self._refs.put(k, {})",
+        )
+        a2 = state({
+            _SC_PATH: self._ORDER_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": good,
+        })
+        assert not any(
+            "write-order" in f.message for f in a2.findings()
+        ), [f.render() for f in a2.findings()]
+
+    _FK_CONTRACT = '''
+STATE_CONTRACTS = {
+    "machines": {},
+    "persistence": {
+        "namespaces": {
+            "widgets": {
+                "owner": "dragonfly2_tpu/daemon/store.py",
+                "lock": ["dragonfly2_tpu/daemon/store.py", "WidgetStore", "_mu"],
+                "loader": "WidgetStore.__init__",
+                "multi_row": [],
+                "unlocked_ok": [],
+                "invariant": "x",
+            },
+            "refs": {
+                "owner": "dragonfly2_tpu/daemon/refs.py",
+                "lock": ["dragonfly2_tpu/daemon/refs.py", "RefStore", "_mu"],
+                "loader": "RefStore.__init__",
+                "multi_row": [],
+                "unlocked_ok": [],
+                "invariant": "x",
+            },
+        },
+        "write_order": [],
+        "foreign_keys": [
+            {
+                "parent": "widgets",
+                "child": "refs",
+                "primitive": "WidgetStore.drop",
+                "cleanup": "RefStore.drop_widget",
+                "cleanup_file": "dragonfly2_tpu/daemon/refs.py",
+            },
+        ],
+        "implementation": [],
+    },
+}
+'''
+
+    def test_foreign_key_primitive_called_outside_cleanup_fires(self):
+        store = _STORE_SRC + '''
+
+    def drop(self, k):
+        with self._mu:
+            self._table.delete(k)
+'''
+        refs = '''
+import threading
+
+from .store import WidgetStore
+
+
+class RefStore:
+    def __init__(self, backend, store: "WidgetStore"):
+        self._mu = threading.Lock()
+        self._refs = backend.table("refs")
+        self._rows = self._refs.load_all()
+        self.store = store
+
+    def drop_widget(self, k):
+        with self._mu:
+            self._refs.delete(k)
+            self.store.drop(k)
+'''
+        rogue = refs + '''
+
+def bypass(store: "WidgetStore", k):
+    store.drop(k)
+'''
+        a = state({
+            _SC_PATH: self._FK_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": store,
+            "dragonfly2_tpu/daemon/refs.py": rogue,
+        })
+        assert any(
+            f.rule == "DF014" and "outside the declared cleanup" in f.message
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+        a2 = state({
+            _SC_PATH: self._FK_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": store,
+            "dragonfly2_tpu/daemon/refs.py": refs,
+        })
+        assert a2.findings() == [], [f.render() for f in a2.findings()]
+
+    def test_pragma_suppresses(self):
+        src = _STORE_SRC + """
+
+    def rogue(self, k):
+        self._table.put(k, {})  # dflint: disable=DF014
+"""
+        a = state({
+            _SC_PATH: _P_CONTRACT,
+            "dragonfly2_tpu/daemon/store.py": src,
+        })
+        assert a.findings() == []
+
+
+_R_CONTRACT = """
+STATE_CONTRACTS = {
+    "machines": {},
+    "persistence": {"namespaces": {}, "write_order": [],
+                    "foreign_keys": [], "implementation": []},
+    "rpc": {
+        "svc": {
+            "clients": {"dragonfly2_tpu/rpc/cl.py": ["Client"]},
+            "server": ["dragonfly2_tpu/rpc/srv.py", "Adapter", "METHODS"],
+            "grpc": ["dragonfly2_tpu/rpc/g.py", "G_METHODS"],
+            "idempotent": ["ping"],
+            "deduped": {"push": "dedup_push"},
+            "seam_files": ["dragonfly2_tpu/rpc/srv.py"],
+        },
+    },
+}
+"""
+
+_SRV_SRC = """
+def dedup_push():
+    pass
+
+
+class Adapter:
+    METHODS = frozenset({"ping", "push"})
+
+    def ping(self, req):
+        return {}
+
+    def push(self, req):
+        return {}
+"""
+
+_G_SRC = """
+G_METHODS = {
+    "ping": ("PingReq", "PingResp"),
+    "push": ("PushReq", "PushResp"),
+}
+"""
+
+_CL_SRC = """
+class Client:
+    def _call(self, method, req):
+        return {}
+
+    def ping(self):
+        return self._call("ping", {})
+
+    def push(self):
+        return self._call("push", {})
+"""
+
+
+class TestDF015Fixtures:
+    def _files(self, srv=_SRV_SRC, g=_G_SRC, cl=_CL_SRC, contract=_R_CONTRACT):
+        return {
+            _SC_PATH: contract,
+            "dragonfly2_tpu/rpc/srv.py": srv,
+            "dragonfly2_tpu/rpc/g.py": g,
+            "dragonfly2_tpu/rpc/cl.py": cl,
+        }
+
+    def test_clean_parity_passes(self):
+        a = state(self._files())
+        assert a.findings() == [], [f.render() for f in a.findings()]
+
+    def test_deleted_grpc_entry_fires_by_method_name(self):
+        g = _G_SRC.replace('    "push": ("PushReq", "PushResp"),\n', "")
+        a = state(self._files(g=g))
+        assert any(
+            f.rule == "DF015" and "'push'" in f.message
+            and "gRPC transport table" in f.message
+            for f in a.findings()
+        )
+
+    def test_deleted_dispatch_entry_fires(self):
+        srv = _SRV_SRC.replace(
+            'METHODS = frozenset({"ping", "push"})',
+            'METHODS = frozenset({"ping"})',
+        )
+        a = state(self._files(srv=srv))
+        assert any(
+            f.rule == "DF015" and "'push'" in f.message
+            and "no registered server dispatch handler" in f.message
+            for f in a.findings()
+        )
+
+    def test_methods_entry_without_handler_def_fires(self):
+        srv = _SRV_SRC.replace(
+            'METHODS = frozenset({"ping", "push"})',
+            'METHODS = frozenset({"ping", "push", "vanish"})',
+        )
+        a = state(self._files(srv=srv))
+        assert any(
+            f.rule == "DF015" and "'vanish'" in f.message
+            and "no handler def" in f.message
+            for f in a.findings()
+        )
+
+    def test_unclassified_retried_method_fires(self):
+        srv = _SRV_SRC.replace(
+            'METHODS = frozenset({"ping", "push"})',
+            'METHODS = frozenset({"ping", "push", "zap"})',
+        ) + """
+
+    def zap(self, req):
+        return {}
+"""
+        g = _G_SRC.replace(
+            '    "push": ("PushReq", "PushResp"),',
+            '    "push": ("PushReq", "PushResp"),\n'
+            '    "zap": ("ZapReq", "ZapResp"),',
+        )
+        cl = _CL_SRC + """
+
+    def zap(self):
+        return self._call("zap", {})
+"""
+        a = state(self._files(srv=srv, g=g, cl=cl))
+        assert any(
+            f.rule == "DF015" and "'zap'" in f.message
+            and "neither declared idempotent nor deduped" in f.message
+            for f in a.findings()
+        )
+
+    def test_missing_dedup_seam_fires(self):
+        srv = _SRV_SRC.replace("def dedup_push():\n    pass\n", "")
+        a = state(self._files(srv=srv))
+        assert any(
+            f.rule == "DF015" and "'dedup_push'" in f.message
+            and "not found" in f.message
+            for f in a.findings()
+        )
+
+    def test_stale_classification_fires(self):
+        contract = _R_CONTRACT.replace(
+            '"idempotent": ["ping"],', '"idempotent": ["ping", "ghost"],'
+        )
+        a = state(self._files(contract=contract))
+        assert any(
+            f.rule == "DF015" and "'ghost'" in f.message
+            and "stale" in f.message
+            for f in a.findings()
+        )
+
+    def test_pragma_suppresses(self):
+        cl = _CL_SRC + """
+
+    def zap(self):
+        return self._call("zap", {})  # dflint: disable=DF015
+"""
+        a = state(self._files(cl=cl))
+        assert a.findings() == []
+
+
+class TestStateMutationSensitivity:
+    """The acceptance contract against the REAL tree: an illegal
+    ModelState edge, the ACTIVE-flip put_many split into puts, and a
+    deleted gRPC handler for a live client method must each fail BY
+    RULE NAME."""
+
+    def _analyze_with(self, relpath: str, mutated: str) -> StateAnalysis:
+        from tools.dflint.core import collect_files, load_module
+
+        modules = []
+        for path in collect_files([REPO / "dragonfly2_tpu"], REPO):
+            m = load_module(path, REPO)
+            if m.relpath == relpath:
+                m = Module(path, relpath, mutated)
+            modules.append(m)
+        return StateAnalysis(Program(modules), REPO)
+
+    @pytest.fixture(scope="class")
+    def real_state(self):
+        return StateAnalysis(
+            Program.from_paths([REPO / "dragonfly2_tpu"], REPO), REPO
+        )
+
+    def test_real_tree_is_clean(self, real_state):
+        assert real_state.findings() == [], [
+            f.render() for f in real_state.findings()
+        ]
+
+    def test_illegal_model_state_edge_fails_df013(self):
+        # A scheduler-side module flipping model state: the scheduler
+        # may POLL the registry, never mutate it.
+        relpath = "dragonfly2_tpu/scheduler/model_loader.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        mutated = source + (
+            "\n\ndef _rogue_promote(registry, model_id):\n"
+            "    from ..manager.registry import ModelState\n"
+            "    registry.set_state(model_id, ModelState.ACTIVE)\n"
+        )
+        a = self._analyze_with(relpath, mutated)
+        assert any(
+            f.rule == "DF013" and "'model_state'" in f.message
+            and f.path == relpath
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+
+    def test_put_many_split_fails_df014_by_site_name(self):
+        relpath = "dragonfly2_tpu/manager/registry.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        needle = (
+            "            self._table.put_many("
+            "{m.id: _model_to_doc(m) for m in models})"
+        )
+        assert needle in source
+        mutated = source.replace(
+            needle,
+            "            for m in models:\n"
+            "                self._table.put(m.id, _model_to_doc(m))",
+        )
+        a = self._analyze_with(relpath, mutated)
+        assert any(
+            f.rule == "DF014"
+            and "multi-row site ModelRegistry._persist" in f.message
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+
+    def test_deleted_grpc_handler_fails_df015_by_method_name(self):
+        relpath = "dragonfly2_tpu/rpc/grpc_transport.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        needle = '    "leave_peer": (pb.PeerRequest, pb.Empty),\n'
+        assert needle in source
+        a = self._analyze_with(relpath, source.replace(needle, ""))
+        assert any(
+            f.rule == "DF015" and "'leave_peer'" in f.message
+            and "gRPC transport table" in f.message
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+
+    def test_appended_peer_event_fails_df013_staleness(self):
+        relpath = "dragonfly2_tpu/scheduler/resource.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        needle = "PEER_EVENTS = (\n"
+        assert needle in source
+        mutated = source.replace(
+            needle,
+            "PEER_EVENTS = (\n"
+            '    EventDesc("Hijack", (PEER_SUCCEEDED,), PEER_RUNNING),\n',
+        )
+        a = self._analyze_with(relpath, mutated)
+        assert any(
+            f.rule == "DF013" and "'Hijack'" in f.message
+            for f in a.findings()
+        )
+
+    def test_fsm_mirror_write_outside_callback_fails_df013(self):
+        relpath = "dragonfly2_tpu/scheduler/resource.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        mutated = source + (
+            "\n\ndef _rogue_mirror(peer):\n"
+            "    peer.fsm_state = PEER_RUNNING\n"
+        )
+        a = self._analyze_with(relpath, mutated)
+        assert any(
+            f.rule == "DF013" and "mirror 'fsm_state'" in f.message
+            for f in a.findings()
+        )
+
+    def test_witness_catches_pruned_inventory(self, real_state):
+        """A write the static inventory cannot explain is a gap (the
+        dynamic cross-check in tests/test_zz_crashwitness.py leans on
+        this exact function)."""
+        gaps = crash_witness_gaps(real_state, {
+            ("dragonfly2_tpu/daemon/nowhere.py", 3): [
+                {"namespace": "models", "method": "put",
+                 "writes": 1, "max_rows": 1},
+            ],
+        })
+        assert len(gaps) == 1 and "unknown to the static" in gaps[0]
+
+
+class TestFsmGraphStaleness:
+    """DESIGN.md §19's committed machine block must match a fresh
+    emission — the same discipline as the §16 lock graph."""
+
+    def test_design_md_fsm_graph_is_current(self):
+        from tools.dflint.__main__ import (
+            FSM_GRAPH_BEGIN, FSM_GRAPH_END, render_fsm_graph,
+        )
+
+        analysis = StateAnalysis(
+            Program.from_paths([REPO / "dragonfly2_tpu"], REPO), REPO
+        )
+        text = (REPO / "DESIGN.md").read_text(encoding="utf-8")
+        begin = text.find(FSM_GRAPH_BEGIN)
+        end = text.find(FSM_GRAPH_END)
+        assert begin >= 0 and end > begin, "DESIGN.md §19 fsm-graph markers missing"
+        committed = text[begin : end + len(FSM_GRAPH_END)]
+        fresh = render_fsm_graph(analysis)
+        assert committed == fresh, (
+            "DESIGN.md §19 fsm graph is stale — regenerate with "
+            "`python -m tools.dflint dragonfly2_tpu --update-fsm-graph DESIGN.md`"
+        )
+
+    def test_update_fsm_graph_rewrites_in_place(self, tmp_path):
+        from tools.dflint.__main__ import main
+
+        doc = tmp_path / "DESIGN.md"
+        doc.write_text(
+            "# doc\n\n<!-- dflint:fsm-graph:begin -->\nstale\n"
+            "<!-- dflint:fsm-graph:end -->\ntail\n"
+        )
+        src = tmp_path / "empty.py"
+        src.write_text("X = 1\n")
+        assert main([str(src), "--update-fsm-graph", str(doc)]) == 0
+        body = doc.read_text()
+        assert "stale" not in body and "tail" in body
+
+    def test_graph_renders_every_declared_machine(self):
+        analysis = StateAnalysis(
+            Program.from_paths([REPO / "dragonfly2_tpu"], REPO), REPO
+        )
+        md = analysis.fsm_graph_markdown()
+        dot = analysis.fsm_graph_dot()
+        for key in ("peer", "task", "model_state", "rollout_phase"):
+            assert f"machine `{key}`" in md
+            assert f"digraph {key} {{" in dot
+
+
+class TestCLIStateRules:
+    def test_rule_filter_covers_state_rules(self, tmp_path, capsys):
+        from tools.dflint.__main__ import main
+
+        src = tmp_path / "clean.py"
+        src.write_text("X = 1\n")
+        assert main([str(src), "--rule", "DF013,DF014,DF015", "-q"]) == 0
+
+    def test_jobs_parallel_matches_serial(self, tmp_path):
+        from tools.dflint.core import run_paths, run_paths_parallel
+
+        for i in range(4):
+            (tmp_path / f"f{i}.py").write_text(
+                "def f():\n"
+                "    try:\n"
+                "        work()\n"
+                "    except Exception:\n"
+                "        pass\n"
+            )
+        serial = run_paths([tmp_path], tmp_path)
+        parallel = run_paths_parallel([tmp_path], tmp_path, jobs=3)
+        key = lambda f: (f.path, f.line, f.col, f.rule)  # noqa: E731
+        assert sorted(serial.findings, key=key) == sorted(
+            parallel.findings, key=key
+        )
+        assert len(serial.findings) == 4
+
+    def test_profile_prints_phase_timings(self, tmp_path, capsys):
+        from tools.dflint.__main__ import main
+
+        src = tmp_path / "clean.py"
+        src.write_text("X = 1\n")
+        assert main([str(src), "--profile", "-q"]) == 0
+        err = capsys.readouterr().err
+        assert "profile: per-file rules" in err
+        assert "profile: state rules DF013-DF015" in err
+
+    def test_emit_fsm_graph_prints_markers(self, capsys):
+        from tools.dflint.__main__ import main
+
+        assert main(["dragonfly2_tpu", "--emit-fsm-graph"]) == 0
+        out = capsys.readouterr().out
+        assert "<!-- dflint:fsm-graph:begin -->" in out
+        assert "digraph peer {" in out

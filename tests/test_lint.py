@@ -8,13 +8,19 @@ whole-program invariant broke: an indefinitely-blocking operation now
 runs under a mutex (DF008), the global lock-ordering graph grew a
 deadlock-capable cycle (DF009), a jit is constructed per call or a
 traced def branches on a non-static arg (DF010), a host-device sync
-leaked into a hot path or trace-reachable function (DF011), or a
-columnar dtype contract drifted from records/contracts.py (DF012).
+leaked into a hot path or trace-reachable function (DF011), a
+columnar dtype contract drifted from records/contracts.py (DF012), a
+state machine gained an illegal transition or mirror write (DF013), a
+persistence site lost its crash-consistency discipline — torn
+multi-row flip, unlocked write, orphan table, dangling foreign key
+(DF014), or the RPC client/server/transport method inventories
+drifted apart (DF015).
 
-The per-file checkers see one AST; DF008-DF012 come from ONE
+The per-file checkers see one AST; DF008-DF015 come from ONE
 whole-program analysis (tools/dflint/program.py +
-tools/dflint/tracerules.py) built here once and attributed back to
-files, so the failing test still names the file.
+tools/dflint/tracerules.py + tools/dflint/staterules.py) built here
+once and attributed back to files, so the failing test still names
+the file.
 
 Accepted pre-existing findings live in tools/dflint/baseline.toml
 (currently EMPTY — the fix sweep shipped with the rules); reviewed
@@ -37,6 +43,7 @@ if str(REPO) not in sys.path:  # `python -m pytest` from elsewhere
 from tools.dflint.baseline import Baseline  # noqa: E402
 from tools.dflint.core import collect_files, load_module, run_checkers  # noqa: E402
 from tools.dflint.program import Program  # noqa: E402
+from tools.dflint.staterules import StateAnalysis  # noqa: E402
 from tools.dflint.tracerules import TraceAnalysis  # noqa: E402
 
 SOURCE_FILES = collect_files([REPO / "dragonfly2_tpu"], REPO)
@@ -44,8 +51,9 @@ BASELINE = Baseline.load()
 
 _PROGRAM = Program.from_paths([REPO / "dragonfly2_tpu"], REPO)
 _TRACE = TraceAnalysis(_PROGRAM, REPO)
+_STATE = StateAnalysis(_PROGRAM, REPO)
 _PROGRAM_BY_PATH = defaultdict(list)
-for _f in _PROGRAM.findings() + _TRACE.findings():
+for _f in _PROGRAM.findings() + _TRACE.findings() + _STATE.findings():
     _PROGRAM_BY_PATH[_f.path].append(_f)
 
 
@@ -65,7 +73,10 @@ def test_dflint_clean(path):
 def test_no_stale_baseline_entries():
     """Fixed violations must leave the baseline too, or the budget
     silently covers the NEXT regression in that function."""
-    findings = list(_PROGRAM.findings()) + list(_TRACE.findings())
+    findings = (
+        list(_PROGRAM.findings()) + list(_TRACE.findings())
+        + list(_STATE.findings())
+    )
     for path in SOURCE_FILES:
         findings.extend(run_checkers(load_module(path, REPO)))
     assert BASELINE.stale_keys(findings) == []

@@ -314,3 +314,119 @@ def test_legacy_sqlite_layouts_migrate_once(tmp_path):
     assert migrate_legacy_sqlite(
         backend, models_db=models_db, crud_db=crud_db, users_db=users_db
     ) == {}
+
+
+def test_crash_between_registry_flip_and_rollout_row(tmp_path):
+    """DF014 crash-between-rows drill for the ``rollouts`` table: the
+    registry flip (models table, transactional) and the rollout row
+    (rollouts table) cannot share a transaction, so ``begin`` can crash
+    AFTER the candidate went SHADOW but BEFORE its rollout row
+    committed.  Without repair, every evaluation report would KeyError
+    forever against a candidate the scheduler can see.  The reloaded
+    controller must reconcile: adopt the orphan candidate so the
+    rollout is judgeable again (declared invariant
+    'no_dangling_rollout')."""
+    from dragonfly2_tpu.manager.registry import ModelRegistry, ModelState
+    from dragonfly2_tpu.manager.state import SQLiteBackend
+    from dragonfly2_tpu.rollout.controller import RolloutController
+    from dragonfly2_tpu.utils import faultinject
+
+    db = str(tmp_path / "state.db")
+    backend = SQLiteBackend(db)
+    registry = ModelRegistry(backend=backend)
+    active = registry.create_model(
+        name="ranker", type="mlp", scheduler_id="s1", artifact=b"\x01" * 4,
+    )
+    registry.activate(active.id)
+    candidate = registry.create_model(
+        name="ranker", type="mlp", scheduler_id="s1", artifact=b"\x02" * 4,
+    )
+    controller = RolloutController(registry, backend=backend)
+    inj = faultinject.FaultInjector([
+        faultinject.FaultSpec(site="state.put.rollouts", kind="drop", at=(0,)),
+    ])
+    with faultinject.installed(inj):
+        with pytest.raises(ConnectionError):
+            controller.begin(candidate.id)
+    # The tear is real: the registry committed the SHADOW flip, the
+    # rollouts table has no row.
+    assert registry.get(candidate.id).state is ModelState.SHADOW
+    assert backend.table("rollouts").load_all() == {}
+    backend.close()
+
+    # Restart: reload BOTH consumers from the same file.
+    backend = SQLiteBackend(db)
+    registry2 = ModelRegistry(backend=backend)
+    controller2 = RolloutController(registry2, backend=backend)
+    rollout = controller2.get("s1", "ranker")
+    assert rollout is not None, "orphan SHADOW candidate was not adopted"
+    assert rollout.model_id == candidate.id
+    assert rollout.phase == "shadow"
+    assert rollout.previous_active_id == active.id
+    # The adopted row is durable AND judgeable: a report flows.
+    decision = controller2.report("s1", "ranker", {"joined_edges": 1})
+    assert decision["decision"] == "hold"
+    backend.close()
+
+    # And the row survives the NEXT restart as a plain reload (no
+    # re-adoption path needed).
+    backend = SQLiteBackend(db)
+    registry3 = ModelRegistry(backend=backend)
+    controller3 = RolloutController(registry3, backend=backend)
+    r3 = controller3.get("s1", "ranker")
+    assert r3 is not None and r3.model_id == candidate.id
+    assert r3.reason == "adopted during crash recovery"
+    backend.close()
+
+
+def test_crash_between_promote_and_rollout_row(tmp_path):
+    """The other tear direction: ``_advance`` to ACTIVE commits the
+    registry's single-active flip, then crashes before the rollout row
+    records the phase.  On reload the row must follow the registry
+    (phase 'active'), not replay the canary judgement."""
+    from dragonfly2_tpu.manager.registry import ModelRegistry, ModelState
+    from dragonfly2_tpu.manager.state import SQLiteBackend
+    from dragonfly2_tpu.rollout.controller import (
+        RolloutController, RolloutGuardrails,
+    )
+    from dragonfly2_tpu.utils import faultinject
+
+    db = str(tmp_path / "state.db")
+    backend = SQLiteBackend(db)
+    registry = ModelRegistry(backend=backend)
+    candidate = registry.create_model(
+        name="ranker", type="mlp", scheduler_id="s1", artifact=b"\x02" * 4,
+    )
+    rails = RolloutGuardrails(min_shadow_samples=1, min_canary_samples=1)
+    controller = RolloutController(registry, guardrails=rails, backend=backend)
+    controller.begin(candidate.id)
+    clean = {
+        "joined_edges": 10,
+        "regret_at_k": {"candidate": 0.0, "active": 0.0, "k": 3},
+        "inversion_rate": {"candidate": 0.0, "active": 0.0},
+        "psi_max": 0.0,
+    }
+    assert controller.report("s1", "ranker", clean)["decision"] == "advance"
+    # Promote: the registry flip (put_many on models) commits, the
+    # rollout-row put is dropped.
+    clean2 = dict(clean, joined_edges=20)
+    inj = faultinject.FaultInjector([
+        faultinject.FaultSpec(site="state.put.rollouts", kind="drop", at=(0,)),
+    ])
+    with faultinject.installed(inj):
+        with pytest.raises(ConnectionError):
+            controller.report("s1", "ranker", clean2)
+    assert registry.get(candidate.id).state is ModelState.ACTIVE
+    backend.close()
+
+    backend = SQLiteBackend(db)
+    registry2 = ModelRegistry(backend=backend)
+    controller2 = RolloutController(registry2, backend=backend)
+    rollout = controller2.get("s1", "ranker")
+    assert rollout is not None
+    assert rollout.phase == "active", (
+        "rollout row must follow the committed registry promote",
+        rollout.phase,
+    )
+    assert "reconciled" in rollout.reason
+    backend.close()

@@ -1,0 +1,343 @@
+"""Dynamic crash-witness cross-check (persistence inventory, enforced).
+
+``tests/conftest.py`` installs ``dragonfly2_tpu.utils.dfcrash`` before
+any test import, so every KVTable write issued from project code during
+this pytest session records (namespace, caller site, method, rows).
+This module (named ``zz`` so it collects last and sees the whole
+session's writes) drives the durable surfaces, then asserts:
+
+- every observed write site maps into DF014's static persistence
+  inventory (``tools/dflint/staterules.py``) with the same namespace —
+  a stale inventory is a test failure, not silent rot;
+- the declared multi-row sites (the registry's single-ACTIVE flip) are
+  only ever observed as ONE ``put_many``;
+- a crash injected at each declared multi-row site — through the
+  existing ``state.put.*`` fault seams — leaves the namespace's
+  declared invariant intact after the consumer reloads;
+- the acceptance mutation (splitting the ACTIVE-flip ``put_many`` into
+  sequential ``put``s) fails BOTH halves: statically by DF014 rule
+  name, and dynamically as a witness gap naming the multi-row site —
+  and the crash drill against the mutant really does tear the
+  exactly-one-ACTIVE invariant on disk.
+
+A gap here means the static resolver (or the contract registry) has a
+blind spot — fix ``tools/dflint/staterules.py`` /
+``records/state_contracts.py``, never this test.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from dragonfly2_tpu.utils import dfcrash, faultinject  # noqa: E402
+
+REGISTRY_RELPATH = "dragonfly2_tpu/manager/registry.py"
+# Single-line-for-single-line replacement: the split puts land on the
+# SAME line the real put_many occupies, so the mutant's writes map to
+# the real _persist span in the static inventory — the witness then
+# fails it on METHOD, which is the claim under test.
+PUT_MANY_NEEDLE = (
+    "            self._table.put_many({m.id: _model_to_doc(m) for m in models})"
+)
+PUT_SPLIT_REPL = (
+    "            [self._table.put(m.id, _model_to_doc(m)) for m in models]"
+)
+
+
+def _witness():
+    w = dfcrash.witness()
+    if w is None:
+        pytest.skip("crash witness disabled (DF_CRASH_WITNESS=0)")
+    return w
+
+
+@pytest.fixture(scope="module")
+def analysis():
+    from tools.dflint.program import Program
+    from tools.dflint.staterules import StateAnalysis
+
+    return StateAnalysis(
+        Program.from_paths([REPO / "dragonfly2_tpu"], REPO), REPO
+    )
+
+
+def _drive_workloads(tmp_path=None):
+    """Writes across the durable surfaces the inventory declares:
+    registry create/activate (the multi-row flip), jobs + groups
+    (declared write order), rollout rows."""
+    from dragonfly2_tpu.jobs.queue import JobQueue
+    from dragonfly2_tpu.manager.registry import ModelRegistry
+    from dragonfly2_tpu.manager.state import MemoryBackend
+    from dragonfly2_tpu.rollout.controller import RolloutController
+
+    backend = MemoryBackend()
+    registry = ModelRegistry(backend=backend)
+    m1 = registry.create_model(
+        name="parent-bandwidth-mlp", type="mlp", scheduler_id="cw-sched",
+        artifact=b"\x01" * 8,
+    )
+    m2 = registry.create_model(
+        name="parent-bandwidth-mlp", type="mlp", scheduler_id="cw-sched",
+        artifact=b"\x02" * 8,
+    )
+    registry.activate(m1.id)
+    registry.activate(m2.id)          # two-row flip: ONE put_many, 2 rows
+
+    controller = RolloutController(registry, backend=backend)
+    m3 = registry.create_model(
+        name="parent-bandwidth-mlp", type="mlp", scheduler_id="cw-sched",
+        artifact=b"\x03" * 8,
+    )
+    controller.begin(m3.id)
+    controller.delete_model(m3.id)
+
+    q = JobQueue(backend=backend)
+    q.enqueue("preheat", {"url": "http://x/1"}, group_id="cw-group")
+    q.enqueue("preheat", {"url": "http://x/2"}, group_id="cw-group")
+    return backend
+
+
+class TestCrashWitness:
+    def test_witness_is_installed_and_recording(self):
+        w = _witness()
+        _drive_workloads()
+        assert w.snapshot(), "no KVTable writes recorded all session"
+
+    def test_every_observed_write_is_in_the_static_inventory(self, analysis):
+        from tools.dflint.staterules import crash_witness_gaps
+
+        w = _witness()
+        _drive_workloads()
+        gaps = crash_witness_gaps(analysis, w.snapshot())
+        assert not gaps, (
+            "static persistence-inventory gaps (fix "
+            "tools/dflint/staterules.py / records/state_contracts.py, "
+            "not this test):\n  " + "\n  ".join(gaps)
+        )
+
+    def test_multi_row_flip_observed_as_one_put_many(self, analysis):
+        """The ACTIVE swap must be OBSERVED as a single two-row
+        put_many (if the workload stops exercising it, the cross-check
+        goes vacuous)."""
+        w = _witness()
+        _drive_workloads()
+        multi = analysis.multi_row_sites()
+        assert multi, "no declared multi-row sites in the contract registry"
+        fi = analysis.program.funcs.get(
+            f"{REGISTRY_RELPATH}:ModelRegistry._persist"
+        )
+        assert fi is not None
+        span = range(fi.node.lineno, (fi.node.end_lineno or fi.node.lineno) + 1)
+        seen = [
+            r
+            for (relpath, line), records in w.snapshot().items()
+            if relpath == REGISTRY_RELPATH and line in span
+            for r in records
+        ]
+        assert seen, "registry._persist writes not observed"
+        assert all(r["method"] == "put_many" for r in seen), seen
+        assert any(r["max_rows"] >= 2 for r in seen), (
+            "the two-row ACTIVE flip was never observed", seen,
+        )
+
+    def test_unknown_write_site_is_a_gap(self, analysis):
+        from tools.dflint.staterules import crash_witness_gaps
+
+        _witness()
+        fake = {
+            ("dragonfly2_tpu/daemon/nowhere.py", 7): [
+                {"namespace": "models", "method": "put", "writes": 1,
+                 "max_rows": 1},
+            ],
+        }
+        gaps = crash_witness_gaps(analysis, fake)
+        assert len(gaps) == 1 and "unknown to the static" in gaps[0]
+
+    # -- crash drills against the declared invariants -------------------
+
+    def test_crash_at_active_flip_keeps_exactly_one_active(self, tmp_path):
+        """Drop the state.put.models seam mid-activate: the transaction
+        never commits, and a reloaded registry still shows exactly one
+        ACTIVE (the declared 'single_active' invariant)."""
+        from dragonfly2_tpu.manager.registry import ModelRegistry, ModelState
+        from dragonfly2_tpu.manager.state import SQLiteBackend
+
+        db = str(tmp_path / "state.db")
+        backend = SQLiteBackend(db)
+        registry = ModelRegistry(backend=backend)
+        m1 = registry.create_model(
+            name="m", type="mlp", scheduler_id="s", artifact=b"\x01" * 4,
+        )
+        m2 = registry.create_model(
+            name="m", type="mlp", scheduler_id="s", artifact=b"\x02" * 4,
+        )
+        registry.activate(m1.id)
+        backend.close()
+
+        backend = SQLiteBackend(db)
+        registry = ModelRegistry(backend=backend)
+        inj = faultinject.FaultInjector([
+            faultinject.FaultSpec(site="state.put.models", kind="drop", at=(0,)),
+        ])
+        with faultinject.installed(inj):
+            with pytest.raises(ConnectionError):
+                registry.activate(m2.id)
+        backend.close()
+
+        backend = SQLiteBackend(db)
+        reloaded = ModelRegistry(backend=backend)
+        active = [
+            m for m in reloaded.list(scheduler_id="s", name="m")
+            if m.state is ModelState.ACTIVE
+        ]
+        assert [m.id for m in active] == [m1.id], (
+            "exactly-one-ACTIVE torn by a crash at the flip", active,
+        )
+        backend.close()
+
+    def test_crash_between_job_and_group_rows_reconciles(self, tmp_path):
+        """Drop the group-row put after the job row committed: the
+        reloaded queue re-adopts the job into its group from the job
+        row's group_id (the declared 'jobs_absent_or_complete'
+        invariant — no group may reference a missing job)."""
+        from dragonfly2_tpu.jobs.queue import JobQueue
+        from dragonfly2_tpu.manager.state import SQLiteBackend
+
+        db = str(tmp_path / "state.db")
+        backend = SQLiteBackend(db)
+        q = JobQueue(backend=backend)
+        inj = faultinject.FaultInjector([
+            faultinject.FaultSpec(
+                site="state.put.job_groups", kind="drop", at=(0,)
+            ),
+        ])
+        with faultinject.installed(inj):
+            with pytest.raises(ConnectionError):
+                q.enqueue("preheat", {"url": "u"}, group_id="g1")
+        backend.close()
+
+        backend = SQLiteBackend(db)
+        q2 = JobQueue(backend=backend)
+        jobs = [j for j in q2.jobs.values() if j.group_id == "g1"]
+        assert len(jobs) == 1, "job row must have committed before the tear"
+        group = q2.groups.get("g1")
+        assert group is not None and group.job_ids == [jobs[0].id], (
+            "group not reconciled from the committed job row",
+            group and group.job_ids,
+        )
+        assert all(i in q2.jobs for i in group.job_ids)
+        backend.close()
+
+    # -- acceptance mutation: the split-put registry ---------------------
+
+    def _mutant_registry_module(self):
+        src = (REPO / REGISTRY_RELPATH).read_text(encoding="utf-8")
+        assert PUT_MANY_NEEDLE in src
+        mutated = src.replace(PUT_MANY_NEEDLE, PUT_SPLIT_REPL)
+        code = compile(mutated, str(REPO / REGISTRY_RELPATH), "exec")
+        import types
+
+        mod = types.ModuleType("dragonfly2_tpu.manager._registry_split_mutant")
+        mod.__package__ = "dragonfly2_tpu.manager"
+        mod.__file__ = str(REPO / REGISTRY_RELPATH)
+        # dataclass string-annotation resolution reads
+        # sys.modules[cls.__module__] at exec time.
+        sys.modules[mod.__name__] = mod
+        exec(code, mod.__dict__)  # noqa: S102 — controlled project-source mutant
+        return mod.__dict__
+
+    def test_put_many_split_fails_static_df014_by_name(self):
+        from tools.dflint.core import Module, collect_files
+        from tools.dflint.program import Program
+        from tools.dflint.staterules import StateAnalysis
+
+        mutated = (REPO / REGISTRY_RELPATH).read_text(encoding="utf-8").replace(
+            PUT_MANY_NEEDLE, PUT_SPLIT_REPL
+        )
+        modules = []
+        for path in collect_files([REPO / "dragonfly2_tpu"], REPO):
+            rel = path.resolve().relative_to(REPO).as_posix()
+            text = mutated if rel == REGISTRY_RELPATH else path.read_text(
+                encoding="utf-8"
+            )
+            modules.append(Module(path, rel, text))
+        a = StateAnalysis(Program(modules), REPO)
+        hits = [
+            f for f in a.findings()
+            if f.rule == "DF014" and "multi-row site ModelRegistry._persist"
+            in f.message and "models" in f.message
+        ]
+        assert hits, [f.render() for f in a.findings()]
+
+    def test_put_many_split_fails_the_witness_by_site(self, analysis):
+        """Dynamic half: drive the torn registry through the LIVE
+        witness (records isolated from the session inventory) — the
+        observed put() at the declared multi-row site is a gap."""
+        from tools.dflint.staterules import crash_witness_gaps
+
+        _witness()
+        from dragonfly2_tpu.manager.state import MemoryBackend
+
+        ns = self._mutant_registry_module()
+        with dfcrash.isolated() as w:
+            registry = ns["ModelRegistry"](backend=MemoryBackend())
+            m1 = registry.create_model(
+                name="m", type="mlp", scheduler_id="s", artifact=b"\x01" * 4,
+            )
+            m2 = registry.create_model(
+                name="m", type="mlp", scheduler_id="s", artifact=b"\x02" * 4,
+            )
+            registry.activate(m1.id)
+            registry.activate(m2.id)
+            snap = w.snapshot()
+        gaps = crash_witness_gaps(analysis, snap)
+        assert any(
+            "multi-row site" in g and "ModelRegistry._persist" in g
+            and "put()" in g
+            for g in gaps
+        ), gaps
+
+    def test_put_many_split_tears_the_invariant_on_crash(self, tmp_path):
+        """The drill that motivates the rule: with the split mutant, a
+        drop on the SECOND row's put leaves TWO ACTIVE versions on disk
+        — the exact corruption the one-transaction contract prevents."""
+        from dragonfly2_tpu.manager.registry import ModelRegistry, ModelState
+        from dragonfly2_tpu.manager.state import SQLiteBackend
+
+        ns = self._mutant_registry_module()
+        db = str(tmp_path / "state.db")
+        backend = SQLiteBackend(db)
+        registry = ns["ModelRegistry"](backend=backend)
+        m1 = registry.create_model(
+            name="m", type="mlp", scheduler_id="s", artifact=b"\x01" * 4,
+        )
+        m2 = registry.create_model(
+            name="m", type="mlp", scheduler_id="s", artifact=b"\x02" * 4,
+        )
+        registry.activate(m1.id)
+        inj = faultinject.FaultInjector([
+            faultinject.FaultSpec(site="state.put.models", kind="drop", at=(1,)),
+        ])
+        with faultinject.installed(inj):
+            with pytest.raises(ConnectionError):
+                registry.activate(m2.id)
+        backend.close()
+
+        backend = SQLiteBackend(db)
+        reloaded = ModelRegistry(backend=backend)
+        active = [
+            m for m in reloaded.list(scheduler_id="s", name="m")
+            if m.state is ModelState.ACTIVE
+        ]
+        assert len(active) == 2, (
+            "the mutant was supposed to tear exactly-one-ACTIVE; the "
+            "drill lost its sensitivity", [m.id for m in active],
+        )
+        backend.close()

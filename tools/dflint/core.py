@@ -253,3 +253,54 @@ def run_paths(paths: Iterable[Path], root: Path, checkers=None) -> RunResult:
             continue
         result.findings.extend(run_checkers(module, checkers))
     return result
+
+
+def _check_one_file(args: Tuple[str, str, Optional[Tuple[str, ...]]]):
+    """Worker for ``run_paths_parallel`` — module-level so it pickles.
+    Checker objects don't cross the process boundary; rule names do."""
+    path_str, root_str, rule_names = args
+    from .checkers import CHECKERS
+
+    checkers = (
+        None if rule_names is None
+        else [c for c in CHECKERS if c.RULE in rule_names]
+    )
+    path, root = Path(path_str), Path(root_str)
+    try:
+        module = load_module(path, root)
+    except (SyntaxError, UnicodeDecodeError) as exc:
+        return [], f"{relpath_of(path, root)}: {exc}"
+    return run_checkers(module, checkers), None
+
+
+def run_paths_parallel(
+    paths: Iterable[Path], root: Path, checkers=None, jobs: int = 1
+) -> RunResult:
+    """Per-file checking fanned out over ``jobs`` worker processes.
+    Only the embarrassingly-parallel per-file rules run here — the
+    whole-program analyses (DF008+) stay single-pass in the caller.
+    Findings come back deterministic: workers are mapped in collection
+    order and results re-sorted the same way as the serial path."""
+    files = collect_files(paths, root)
+    if jobs <= 1 or len(files) < 2:
+        return run_paths(paths, root, checkers)
+    rule_names = (
+        None if checkers is None else tuple(c.RULE for c in checkers)
+    )
+    work = [(str(f), str(root), rule_names) for f in files]
+    result = RunResult()
+    import concurrent.futures
+
+    try:
+        with concurrent.futures.ProcessPoolExecutor(
+            max_workers=min(jobs, len(files))
+        ) as pool:
+            for findings, error in pool.map(_check_one_file, work):
+                result.findings.extend(findings)
+                if error is not None:
+                    result.errors.append(error)
+    except (OSError, concurrent.futures.process.BrokenProcessPool):
+        # Constrained environments (no /dev/shm, fork limits): the
+        # parallel path is an optimization, never a correctness gate.
+        return run_paths(paths, root, checkers)
+    return result

@@ -1,0 +1,1519 @@
+"""Whole-program state-machine & crash-consistency analysis (DF013-DF015).
+
+The concurrency pass (``program.py``) guards locks, the trace pass
+(``tracerules.py``) guards the XLA layer; this module guards the
+*stateful* invariants the Manager-HA and sharded-scheduler roadmap items
+stand on — invariants that until now lived only in docstrings.  All
+three rule families key off ONE declared-once literal registry,
+``dragonfly2_tpu/records/state_contracts.py``, read with
+``ast.literal_eval`` (no import — dflint stays stdlib-only), and are
+built on :class:`tools.dflint.program.Program`'s symbol table and call
+graph.
+
+**DF013 — FSM transition legality.**  For each declared machine:
+
+- the ``EventDesc`` literals in the defining module are cross-checked
+  edge-for-edge against the registry (drift fails BY MACHINE+EVENT
+  name, so neither side can rot);
+- every ``fsm.event("X")`` site (including declared forwarders like
+  ``_try_event(peer.fsm, "X")``) must name a declared event of the
+  machine the receiver resolves to;
+- ``fsm.set_state("S")`` is legal only in the machine's declared
+  mirror modules and only with a declared state;
+- mirror attributes (``fsm_state``/``fsm_elevated``) are written only
+  by the declared writers (construction + the ``enter_state``
+  callback);
+- enum machines (ModelState, RolloutPhase): a direct ``.state = Enum.X``
+  write outside the owning module fails; registry gateway calls
+  (``set_state``/``activate``/``deactivate``) are checked against the
+  per-module mutator table — an undeclared (module, target-state) pair
+  fails by machine and state name.
+
+**DF014 — crash-consistency over StateBackend/KVTable.**
+
+- every ``.table("ns")`` namespace must be declared (with owner, lock,
+  loader, invariant);
+- declared multi-row sites must persist through ONE ``put_many`` —
+  a single ``put`` inside one fails (the split-transaction mutation);
+- every write site must hold the namespace's owning lock, either
+  lexically or inherited from all callers (boot-time writers are
+  declared ``unlocked_ok``); a read in a writing function is held to
+  the same bar (get→mutate→put races);
+- every namespace must have a recovery loader: a ``load_all`` consumer
+  reachable from a constructor — an orphan table fails by namespace;
+- declared write-order pairs: in a function writing both namespaces,
+  the referencing row's namespace must not commit first;
+- declared foreign keys: the parent's delete primitive may only be
+  called by the declared cleanup (which must delete child rows).
+
+**DF015 — RPC contract parity.**
+
+- every client ``_call("method", ...)`` literal must have a dispatch
+  handler in the inproc server's METHODS set AND a message mapping in
+  the gRPC transport's method table (a deleted handler fails by method
+  name);
+- every gRPC table entry must map onto a server handler, and every
+  METHODS entry onto a defined adapter method;
+- every retried client method must be classified ``idempotent`` or
+  ``deduped`` (with the named server-side dedup seam verified to
+  exist); stale classifications fail.
+
+The static inventory is cross-validated at runtime by the **crash
+witness** (``dragonfly2_tpu/utils/dfcrash.py`` +
+``tests/test_zz_crashwitness.py``): every KVTable write observed during
+tier-1 must map into :meth:`StateAnalysis.persistence_site_index`, and
+declared multi-row sites must only ever be observed as ``put_many``.
+A static blind spot is a witness failure — a resolver fix, never
+silent rot.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, dotted
+from .program import (
+    ClassInfo,
+    FuncInfo,
+    ModuleInfo,
+    Program,
+    _calls_in,
+    _calls_in_expr,
+    _stmt_bodies,
+    _stmt_exprs,
+    _walk_skipping_defs,
+)
+
+RULE_FSM = "DF013"
+TITLE_FSM = "illegal state-machine transition / mirror write"
+RULE_CRASH = "DF014"
+TITLE_CRASH = "crash-consistency violation at a persistence site"
+RULE_RPC = "DF015"
+TITLE_RPC = "RPC contract parity / idempotency violation"
+
+STATE_CONTRACTS_RELPATH = "dragonfly2_tpu/records/state_contracts.py"
+
+_TABLE_METHODS = {"put", "put_many", "get", "delete", "load_all"}
+_WRITE_METHODS = {"put", "put_many", "delete"}
+
+
+class TableOp:
+    """One statically-resolved KVTable operation site."""
+
+    __slots__ = ("ns", "method", "node", "held", "fi")
+
+    def __init__(self, ns: str, method: str, node: ast.Call,
+                 held: FrozenSet[str], fi: FuncInfo) -> None:
+        self.ns = ns
+        self.method = method
+        self.node = node
+        self.held = held
+        self.fi = fi
+
+
+class StateAnalysis:
+    """DF013-DF015 over a linked :class:`Program`."""
+
+    def __init__(self, program: Program, root: Optional[Path] = None) -> None:
+        self.program = program
+        self.root = root
+        self._findings: List[Finding] = []
+        self.contracts = self._load_contracts()
+        self.machines: Dict[str, dict] = dict(
+            self.contracts.get("machines", {})
+        )
+        self.persistence: dict = dict(self.contracts.get("persistence", {}))
+        self.rpc: Dict[str, dict] = dict(self.contracts.get("rpc", {}))
+        # -- persistence model ------------------------------------------
+        # (relpath, class name) -> {attr: ns}
+        self._class_bindings: Dict[Tuple[str, str], Dict[str, str]] = {}
+        # attr name -> ns (only when unique project-wide), for receivers
+        # the type resolver cannot follow (closure aliases like
+        # `server._topology_table`).
+        self._attr_bindings: Dict[str, Optional[str]] = {}
+        # FuncInfo.key -> {local var: [(lineno, ns), ...]} — flow
+        # sensitive: migrate_legacy_sqlite rebinds one local per table.
+        self._local_tables: Dict[str, Dict[str, List[Tuple[int, str]]]] = {}
+        self._binding_sites: List[Tuple[str, ast.AST, ModuleInfo]] = []
+        self._ops: List[TableOp] = []
+        self._call_edges: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        # enum machines: enum class name -> (machine key, {MEMBER: value})
+        self._enums: Dict[str, Tuple[str, Dict[str, str]]] = {}
+        if self.contracts:
+            self._collect_bindings()
+            self._collect_enum_members()
+            for fi in self.program.funcs.values():
+                self._walk_function(fi)
+            self._check_df013()
+            self._check_df014()
+            self._check_df015()
+        self._findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    # ------------------------------------------------------------------
+    # Shared plumbing
+    # ------------------------------------------------------------------
+
+    def findings(self) -> List[Finding]:
+        return list(self._findings)
+
+    def _emit(self, rule: str, mi: ModuleInfo, node: ast.AST, message: str) -> None:
+        module = mi.module
+        line = getattr(node, "lineno", 1)
+        if module.suppressed(rule, line):
+            return
+        self._findings.append(
+            Finding(
+                rule=rule,
+                path=mi.relpath,
+                line=line,
+                col=getattr(node, "col_offset", 0) + 1,
+                message=message,
+                qual=module.qualname(node),
+            )
+        )
+
+    def _load_contracts(self) -> dict:
+        mi = self.program.modules.get(STATE_CONTRACTS_RELPATH)
+        tree = None
+        if mi is not None:
+            tree = mi.module.tree
+        elif self.root is not None:
+            path = self.root / STATE_CONTRACTS_RELPATH
+            if path.exists():
+                tree = ast.parse(path.read_text(encoding="utf-8"))
+        if tree is None:
+            return {}
+        for stmt in tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "STATE_CONTRACTS"
+            ):
+                try:
+                    return ast.literal_eval(stmt.value)
+                except ValueError:
+                    if mi is not None:
+                        self._emit(
+                            RULE_FSM, mi, stmt,
+                            "STATE_CONTRACTS must stay a pure literal "
+                            "(ast.literal_eval failed — dflint reads it "
+                            "without importing)",
+                        )
+                    return {}
+        return {}
+
+    # ------------------------------------------------------------------
+    # Persistence model: table bindings + lock-region walk
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _table_ns_of(value: ast.AST) -> Optional[Tuple[str, ast.Call]]:
+        """The namespace literal when ``value`` contains a
+        ``<backend>.table("ns")`` call (direct, IfExp branch, or BoolOp
+        operand)."""
+        candidates: List[ast.AST] = [value]
+        if isinstance(value, ast.IfExp):
+            candidates = [value.body, value.orelse]
+        elif isinstance(value, ast.BoolOp):
+            candidates = list(value.values)
+        for cand in candidates:
+            if (
+                isinstance(cand, ast.Call)
+                and isinstance(cand.func, ast.Attribute)
+                and cand.func.attr == "table"
+                and cand.args
+                and isinstance(cand.args[0], ast.Constant)
+                and isinstance(cand.args[0].value, str)
+            ):
+                return cand.args[0].value, cand
+        return None
+
+    def _collect_bindings(self) -> None:
+        ambiguous: Set[str] = set()
+        for mi in self.program.modules.values():
+            for node in ast.walk(mi.module.tree):
+                target = value = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    target, value = node.target, node.value
+                if value is None:
+                    continue
+                hit = self._table_ns_of(value)
+                if hit is None:
+                    continue
+                ns, call = hit
+                self._binding_sites.append((ns, call, mi))
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id in ("self", "cls")
+                ):
+                    cls = mi.module.enclosing_class(node)
+                    if cls is not None:
+                        self._class_bindings.setdefault(
+                            (mi.relpath, cls.name), {}
+                        )[target.attr] = ns
+                    prev = self._attr_bindings.get(target.attr)
+                    if prev is not None and prev != ns:
+                        ambiguous.add(target.attr)
+                    self._attr_bindings[target.attr] = ns
+                elif isinstance(target, ast.Name):
+                    fn = mi.module.enclosing_function(node)
+                    if fn is not None:
+                        qual = mi.module.qualname(fn)
+                        self._local_tables.setdefault(
+                            f"{mi.relpath}:{qual}", {}
+                        ).setdefault(target.id, []).append((node.lineno, ns))
+        for attr in ambiguous:
+            self._attr_bindings[attr] = None
+
+    def _binding_of_class(self, ci: Optional[ClassInfo], attr: str) -> Optional[str]:
+        if ci is None:
+            return None
+        for c in ci.mro():
+            ns = self._class_bindings.get((c.module.relpath, c.name), {}).get(attr)
+            if ns is not None:
+                return ns
+        return None
+
+    def _table_op_of(self, fi: FuncInfo, call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(namespace, method) when ``call`` is a KVTable op on a bound
+        table receiver, else None."""
+        func = call.func
+        if not isinstance(func, ast.Attribute) or func.attr not in _TABLE_METHODS:
+            return None
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            rebinds = self._local_tables.get(fi.key, {}).get(recv.id)
+            if not rebinds:
+                return None
+            # Nearest preceding rebinding wins (flow sensitivity for
+            # one local reused across tables, e.g. migrate_legacy_sqlite).
+            ns = None
+            for line, bound in rebinds:
+                if line <= call.lineno:
+                    ns = bound
+            return (ns, func.attr) if ns is not None else None
+        if not isinstance(recv, ast.Attribute):
+            return None
+        attr = recv.attr
+        base = recv.value
+        if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+            # Class-scoped lookup ONLY: a same-named plain attribute on
+            # another class (UserStore._users, a dict) must not alias the
+            # table binding.
+            ns = self._binding_of_class(fi.cls, attr)
+            return (ns, func.attr) if ns is not None else None
+        ns = self._attr_bindings.get(attr)
+        if ns is not None:
+            return ns, func.attr
+        return None
+
+    # -- lock tokens ----------------------------------------------------
+
+    def _lock_tokens(self, fi: FuncInfo, expr: ast.AST) -> Set[str]:
+        toks: Set[str] = set()
+        lock = self.program.resolve_lock_expr(fi, expr, fi._types, fi._locks)
+        if lock is not None:
+            toks.add(lock.base().key)
+        if isinstance(expr, ast.Attribute):
+            toks.add(f"tail::{expr.attr}")
+        return toks
+
+    def _walk_function(self, fi: FuncInfo) -> None:
+        if not hasattr(fi, "_types"):
+            return
+        self._walk_body(fi, list(fi.node.body), frozenset())
+
+    def _walk_body(self, fi: FuncInfo, body: List[ast.stmt], held: FrozenSet[str]) -> None:
+        i = 0
+        while i < len(body):
+            stmt = body[i]
+            acquired = self.program._manual_acquire(fi, stmt)
+            if acquired is not None:
+                lock, node = acquired
+                rest = body[i + 1:]
+                cut = len(rest)
+                for j, s in enumerate(rest):
+                    if self.program._manual_release(fi, s) is lock:
+                        cut = j
+                        break
+                self._walk_body(fi, rest[:cut], held | {lock.base().key})
+                i += 1 + cut
+                continue
+            self._walk_stmt(fi, stmt, held)
+            i += 1
+
+    def _walk_stmt(self, fi: FuncInfo, stmt: ast.stmt, held: FrozenSet[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            entered = set(held)
+            for item in stmt.items:
+                self._scan_expr(fi, item.context_expr, frozenset(entered))
+                entered |= self._lock_tokens(fi, item.context_expr)
+            self._walk_body(fi, list(stmt.body), frozenset(entered))
+            return
+        for expr in _stmt_exprs(stmt):
+            self._scan_expr(fi, expr, held)
+        for sub_body in _stmt_bodies(stmt):
+            self._walk_body(fi, list(sub_body), held)
+
+    def _scan_expr(self, fi: FuncInfo, expr: ast.AST, held: FrozenSet[str]) -> None:
+        for call in _calls_in_expr(expr):
+            op = self._table_op_of(fi, call)
+            if op is not None:
+                self._ops.append(TableOp(op[0], op[1], call, held, fi))
+            for target in self.program.resolve_calls(fi, call, fi._types, fi._locks):
+                if target is not fi:
+                    self._call_edges.setdefault(target.key, []).append(
+                        (fi.key, held)
+                    )
+
+    # ------------------------------------------------------------------
+    # DF013 — FSM transition legality
+    # ------------------------------------------------------------------
+
+    def _collect_enum_members(self) -> None:
+        for key, m in self.machines.items():
+            if m.get("kind") != "enum":
+                continue
+            mi = self.program.modules.get(m.get("file", ""))
+            if mi is None:
+                continue
+            members: Dict[str, str] = {}
+            for node in ast.walk(mi.module.tree):
+                if not (isinstance(node, ast.ClassDef) and node.name == m["enum"]):
+                    continue
+                for stmt in node.body:
+                    if (
+                        isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, str)
+                    ):
+                        members[stmt.targets[0].id] = stmt.value.value
+            self._enums[m["enum"]] = (key, members)
+
+    def _check_df013(self) -> None:
+        for key, m in self.machines.items():
+            if m.get("kind") == "fsm":
+                self._check_fsm_literals(key, m)
+            else:
+                self._check_enum_literals(key, m)
+        self._check_event_sites()
+        self._check_mirror_writes()
+        self._check_enum_writes()
+        self._check_gateway_calls()
+
+    # -- declared-graph ↔ code staleness --------------------------------
+
+    def _check_fsm_literals(self, key: str, m: dict) -> None:
+        """The EventDesc tuple in the defining module must match the
+        registry edge-for-edge (mini-evaluation of the module's simple
+        string/tuple constants)."""
+        mi = self.program.modules.get(m.get("file", ""))
+        if mi is None:
+            return
+        env: Dict[str, object] = {}
+        tree = mi.module.tree
+
+        def ev(node: ast.AST):
+            if isinstance(node, ast.Constant):
+                return node.value
+            if isinstance(node, ast.Name):
+                return env.get(node.id)
+            if isinstance(node, ast.Tuple):
+                parts = [ev(e) for e in node.elts]
+                return None if any(p is None for p in parts) else tuple(parts)
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+                left, right = ev(node.left), ev(node.right)
+                if isinstance(left, tuple) and isinstance(right, tuple):
+                    return left + right
+            return None
+
+        events_node = None
+        for stmt in tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+            ):
+                name = stmt.targets[0].id
+                if name == m.get("events_var"):
+                    events_node = stmt.value
+                else:
+                    val = ev(stmt.value)
+                    if val is not None:
+                        env[name] = val
+        if events_node is None or not isinstance(events_node, ast.Tuple):
+            self._emit(
+                RULE_FSM, mi, tree,
+                f"machine {key!r}: declared events_var "
+                f"{m.get('events_var')!r} not found in {mi.relpath} — "
+                "registry and code drifted",
+            )
+            return
+        code_events: Dict[str, Set[Tuple[str, str]]] = {}
+        for elt in events_node.elts:
+            if not (isinstance(elt, ast.Call) and elt.args):
+                continue
+            args = list(elt.args)
+            kwargs = {k.arg: k.value for k in elt.keywords}
+            name = ev(args[0] if args else kwargs.get("name"))
+            src = ev(args[1] if len(args) > 1 else kwargs.get("src"))
+            dst = ev(args[2] if len(args) > 2 else kwargs.get("dst"))
+            if not isinstance(name, str) or not isinstance(dst, str) or \
+                    not isinstance(src, tuple):
+                self._emit(
+                    RULE_FSM, mi, elt,
+                    f"machine {key!r}: EventDesc not statically evaluable "
+                    "— keep sources as module-level string/tuple constants",
+                )
+                continue
+            code_events.setdefault(name, set()).update(
+                (s, dst) for s in src
+            )
+        declared = {
+            name: {tuple(edge) for edge in edges}
+            for name, edges in m.get("events", {}).items()
+        }
+        for name in sorted(set(code_events) | set(declared)):
+            got = code_events.get(name)
+            want = declared.get(name)
+            if got is None:
+                self._emit(
+                    RULE_FSM, mi, events_node,
+                    f"machine {key!r}: event {name!r} declared in "
+                    "records/state_contracts.py but missing from "
+                    f"{m.get('events_var')} — stale registry entry",
+                )
+            elif want is None:
+                self._emit(
+                    RULE_FSM, mi, events_node,
+                    f"machine {key!r}: event {name!r} defined in code but "
+                    "not declared in records/state_contracts.py — declare "
+                    "the new edge(s) with a review",
+                )
+            elif got != want:
+                drift = sorted(got ^ want)
+                self._emit(
+                    RULE_FSM, mi, events_node,
+                    f"machine {key!r}: event {name!r} edges drifted from "
+                    f"the registry (difference: {drift}) — update "
+                    "records/state_contracts.py with a review",
+                )
+        states = set(m.get("states", []))
+        code_states = {s for edges in code_events.values() for e in edges for s in e}
+        code_states |= {m.get("initial", "")} - {""}
+        for s in sorted(code_states - states):
+            self._emit(
+                RULE_FSM, mi, events_node,
+                f"machine {key!r}: state {s!r} used by the code but not "
+                "declared in records/state_contracts.py",
+            )
+
+    def _check_enum_literals(self, key: str, m: dict) -> None:
+        mi = self.program.modules.get(m.get("file", ""))
+        if mi is None:
+            return
+        hit = self._enums.get(m.get("enum", ""))
+        if hit is None or not hit[1]:
+            self._emit(
+                RULE_FSM, mi, mi.module.tree,
+                f"machine {key!r}: enum {m.get('enum')!r} not found in "
+                f"{mi.relpath} — registry and code drifted",
+            )
+            return
+        members = set(hit[1].values())
+        declared = set(m.get("states", []))
+        for s in sorted(members - declared):
+            self._emit(
+                RULE_FSM, mi, mi.module.tree,
+                f"machine {key!r}: enum member value {s!r} not declared in "
+                "records/state_contracts.py — declare the new state (and "
+                "its edges) with a review",
+            )
+        for s in sorted(declared - members):
+            self._emit(
+                RULE_FSM, mi, mi.module.tree,
+                f"machine {key!r}: declared state {s!r} has no enum member "
+                f"in {m.get('enum')} — stale registry entry",
+            )
+        for src, dst in m.get("edges", []):
+            if src not in declared or dst not in declared:
+                self._emit(
+                    RULE_FSM, mi, mi.module.tree,
+                    f"machine {key!r}: edge {src!r}->{dst!r} names an "
+                    "undeclared state",
+                )
+
+    # -- event / set_state sites ----------------------------------------
+
+    def _fsm_machines(self) -> List[Tuple[str, dict]]:
+        return [(k, m) for k, m in self.machines.items() if m.get("kind") == "fsm"]
+
+    def _machine_of_receiver(self, fi: FuncInfo, recv: ast.AST) -> Optional[Tuple[str, dict]]:
+        """Which FSM machine ``<recv>.event(...)`` belongs to, via the
+        receiver's resolved class (``peer.fsm`` → Peer → "peer")."""
+        if not (isinstance(recv, ast.Attribute)):
+            return None
+        base = recv.value
+        ci = None
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls"):
+                ci = fi.cls
+            else:
+                ci = getattr(fi, "_types", {}).get(base.id)
+        elif isinstance(base, ast.Attribute):
+            resolved = self.program._resolve_attr_chain(
+                fi, base, getattr(fi, "_types", {}), getattr(fi, "_locks", {})
+            )
+            if isinstance(resolved, ClassInfo):
+                ci = resolved
+        if ci is None:
+            return None
+        names = {c.name for c in ci.mro()}
+        for key, m in self._fsm_machines():
+            if m.get("class") in names and m.get("attr") == recv.attr:
+                return key, m
+        return None
+
+    def _is_fsm_receiver(self, fi: FuncInfo, recv: ast.AST) -> bool:
+        if isinstance(recv, ast.Attribute) and recv.attr == "fsm":
+            return True
+        if isinstance(recv, ast.Name) and recv.id == "fsm":
+            return True
+        return False
+
+    def _check_event_sites(self) -> None:
+        fsm_ms = self._fsm_machines()
+        if not fsm_ms:
+            return
+        all_events: Set[str] = set()
+        all_states: Set[str] = set()
+        all_set_state_modules: Set[str] = set()
+        for _k, m in fsm_ms:
+            all_events |= set(m.get("events", {}))
+            all_states |= set(m.get("states", []))
+            all_set_state_modules |= set(m.get("set_state_modules", []))
+        # Declared forwarders: project functions whose first param is the
+        # FSM and whose second arg is the event literal (e.g. _try_event).
+        forwarders: Set[str] = set()
+        for fi in self.program.funcs.values():
+            params = [a.arg for a in fi.node.args.args]
+            if params[:1] == ["fsm"] and len(params) >= 2:
+                forwarders.add(fi.key)
+        for fi in self.program.funcs.values():
+            if not hasattr(fi, "_types"):
+                continue
+            mi = fi.module
+            if mi.relpath == "dragonfly2_tpu/utils/fsm.py":
+                continue  # the FSM implementation itself
+            for call in _calls_in(fi.node):
+                func = call.func
+                if isinstance(func, ast.Attribute) and func.attr == "event":
+                    if not self._is_fsm_receiver(fi, func.value):
+                        continue
+                    if not (call.args and isinstance(call.args[0], ast.Constant)
+                            and isinstance(call.args[0].value, str)):
+                        continue
+                    self._check_event_name(
+                        fi, call, func.value, call.args[0].value,
+                        all_events,
+                    )
+                elif isinstance(func, ast.Attribute) and func.attr == "set_state":
+                    if not self._is_fsm_receiver(fi, func.value):
+                        continue
+                    hit = self._machine_of_receiver(fi, func.value)
+                    modules = (
+                        set(hit[1].get("set_state_modules", []))
+                        if hit is not None else all_set_state_modules
+                    )
+                    states = (
+                        set(hit[1].get("states", []))
+                        if hit is not None else all_states
+                    )
+                    mname = hit[0] if hit is not None else "?"
+                    if mi.relpath not in modules:
+                        self._emit(
+                            RULE_FSM, mi, call,
+                            f"machine {mname!r}: fsm.set_state() outside the "
+                            "declared mirror modules — transitions must go "
+                            "through fsm.event() so illegal states stay "
+                            "unrepresentable",
+                        )
+                    if (call.args and isinstance(call.args[0], ast.Constant)
+                            and isinstance(call.args[0].value, str)
+                            and call.args[0].value not in states):
+                        self._emit(
+                            RULE_FSM, mi, call,
+                            f"machine {mname!r}: set_state targets "
+                            f"undeclared state {call.args[0].value!r}",
+                        )
+                else:
+                    # Forwarder: _try_event(peer.fsm, "Download").
+                    targets = self.program.resolve_calls(
+                        fi, call, fi._types, fi._locks
+                    )
+                    if not any(t.key in forwarders for t in targets):
+                        continue
+                    if len(call.args) < 2:
+                        continue
+                    recv, name_arg = call.args[0], call.args[1]
+                    if not (isinstance(name_arg, ast.Constant)
+                            and isinstance(name_arg.value, str)):
+                        continue
+                    if not self._is_fsm_receiver(fi, recv):
+                        continue
+                    self._check_event_name(
+                        fi, call, recv, name_arg.value, all_events,
+                    )
+
+    def _check_event_name(
+        self, fi: FuncInfo, call: ast.Call, recv: ast.AST, event: str,
+        all_events: Set[str],
+    ) -> None:
+        hit = self._machine_of_receiver(fi, recv)
+        if hit is not None:
+            key, m = hit
+            if event not in m.get("events", {}):
+                self._emit(
+                    RULE_FSM, fi.module, call,
+                    f"machine {key!r}: event {event!r} is not a declared "
+                    "transition — add the edge to "
+                    "records/state_contracts.py (and the EventDesc) with "
+                    "a review",
+                )
+        elif event not in all_events:
+            self._emit(
+                RULE_FSM, fi.module, call,
+                f"event {event!r} is not declared by any state machine in "
+                "records/state_contracts.py",
+            )
+
+    def _check_mirror_writes(self) -> None:
+        mirrors: Dict[str, Tuple[str, Set[str]]] = {}
+        for key, m in self._fsm_machines():
+            for attr, writers in m.get("mirrors", {}).items():
+                mirrors[attr] = (key, set(writers))
+        if not mirrors:
+            return
+        for mi in self.program.modules.values():
+            for node in ast.walk(mi.module.tree):
+                if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                    continue
+                target = node.targets[0]
+                if not (isinstance(target, ast.Attribute)
+                        and target.attr in mirrors):
+                    continue
+                key, writers = mirrors[target.attr]
+                qual = mi.module.qualname(node)
+                if qual not in writers:
+                    self._emit(
+                        RULE_FSM, mi, node,
+                        f"machine {key!r}: mirror {target.attr!r} written "
+                        f"outside its declared writers ({sorted(writers)}) "
+                        "— mirrors are maintained ONLY by the enter_state "
+                        "callback",
+                    )
+
+    # -- enum machines ---------------------------------------------------
+
+    def _enum_member_of(self, value: ast.AST) -> Optional[Tuple[str, dict, str]]:
+        """(machine key, machine, state value) when ``value`` references
+        ``<Enum>.<MEMBER>`` (optionally ``.value``) of a declared enum."""
+        name = dotted(value)
+        if not name:
+            return None
+        parts = name.split(".")
+        if parts and parts[-1] == "value":
+            parts = parts[:-1]
+        if len(parts) < 2:
+            return None
+        enum_name, member = parts[-2], parts[-1]
+        hit = self._enums.get(enum_name)
+        if hit is None:
+            return None
+        key, members = hit
+        m = self.machines.get(key, {})
+        return key, m, members.get(member, member.lower())
+
+    def _check_enum_writes(self) -> None:
+        for mi in self.program.modules.values():
+            for node in ast.walk(mi.module.tree):
+                if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                    continue
+                target = node.targets[0]
+                if not isinstance(target, ast.Attribute):
+                    continue
+                hit = self._enum_member_of(node.value)
+                if hit is None:
+                    continue
+                key, m, state = hit
+                if target.attr != m.get("state_attr"):
+                    continue
+                if mi.relpath not in m.get("owner_modules", []):
+                    self._emit(
+                        RULE_FSM, mi, node,
+                        f"machine {key!r}: direct .{target.attr} = write "
+                        f"outside the owning module "
+                        f"({m.get('owner_modules')}) — go through the "
+                        "registry gateway so the flip persists in one "
+                        "transaction",
+                    )
+                elif state not in m.get("states", []):
+                    self._emit(
+                        RULE_FSM, mi, node,
+                        f"machine {key!r}: write targets undeclared state "
+                        f"{state!r}",
+                    )
+
+    def _check_gateway_calls(self) -> None:
+        gateway_attrs: Set[str] = set()
+        for _k, m in self.machines.items():
+            gateway_attrs |= set(m.get("gateway_attrs", []))
+        for fi in self.program.funcs.values():
+            if not hasattr(fi, "_types"):
+                continue
+            mi = fi.module
+            for call in _calls_in(fi.node):
+                func = call.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                if func.attr not in ("set_state", "activate", "deactivate"):
+                    continue
+                machine = None
+                state: Optional[str] = None
+                for arg in list(call.args) + [k.value for k in call.keywords]:
+                    hit = self._enum_member_of(arg)
+                    if hit is not None:
+                        machine, state = (hit[0], hit[1]), hit[2]
+                        break
+                if machine is None:
+                    if func.attr == "set_state":
+                        continue  # no enum arg: a different set_state
+                    owner = self._receiver_owner_machine(fi, func.value)
+                    if owner is None:
+                        continue
+                    machine = owner
+                    state = "active" if func.attr == "activate" else "inactive"
+                key, m = machine
+                if m.get("kind") != "enum":
+                    continue
+                mutators = m.get("mutators", {})
+                allowed = mutators.get(mi.relpath)
+                if allowed is None:
+                    self._emit(
+                        RULE_FSM, mi, call,
+                        f"machine {key!r}: {func.attr}() from "
+                        f"{mi.relpath}, which is not a declared mutator "
+                        "module — state flips are restricted to the "
+                        "registry/rollout/REST/gRPC gateways",
+                    )
+                elif state is not None and state not in allowed:
+                    self._emit(
+                        RULE_FSM, mi, call,
+                        f"machine {key!r}: {mi.relpath} may not request "
+                        f"state {state!r} (allowed: {sorted(allowed)})",
+                    )
+                elif state is not None and state not in m.get("states", []):
+                    self._emit(
+                        RULE_FSM, mi, call,
+                        f"machine {key!r}: {func.attr}() targets "
+                        f"undeclared state {state!r}",
+                    )
+
+    def _receiver_owner_machine(self, fi: FuncInfo, recv: ast.AST) -> Optional[Tuple[str, dict]]:
+        """Machine for an activate()/deactivate() receiver: resolved
+        registry type, or the declared gateway attribute name."""
+        chain_attrs: Set[str] = set()
+        cur = recv
+        while isinstance(cur, ast.Attribute):
+            chain_attrs.add(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            chain_attrs.add(cur.id)
+        resolved = None
+        if isinstance(recv, ast.Attribute):
+            resolved = self.program._resolve_attr_chain(
+                fi, recv, getattr(fi, "_types", {}), getattr(fi, "_locks", {})
+            )
+        elif isinstance(recv, ast.Name):
+            if recv.id in ("self", "cls"):
+                resolved = fi.cls
+            else:
+                resolved = getattr(fi, "_types", {}).get(recv.id)
+        for key, m in self.machines.items():
+            if m.get("kind") != "enum":
+                continue
+            if isinstance(resolved, ClassInfo):
+                owner_file = m.get("file")
+                if resolved.module.relpath == owner_file:
+                    return key, m
+            if chain_attrs & set(m.get("gateway_attrs", [])):
+                return key, m
+        return None
+
+    # ------------------------------------------------------------------
+    # DF014 — crash consistency
+    # ------------------------------------------------------------------
+
+    def _declared_lock_key(self, spec: List[str]) -> Optional[str]:
+        relpath, cls_name, attr = spec
+        mi = self.program.modules.get(relpath)
+        if mi is None:
+            return None
+        ci = mi.classes.get(cls_name)
+        if ci is None:
+            return None
+        lock = ci.attr_lock(attr)
+        return lock.base().key if lock is not None else None
+
+    def _held_ok(self, held: FrozenSet[str], lock_key: Optional[str],
+                 lock_attr: str) -> bool:
+        if lock_key is not None and lock_key in held:
+            return True
+        return f"tail::{lock_attr}" in held
+
+    def _covered_by_callers(
+        self, fkey: str, lock_key: Optional[str], lock_attr: str,
+        memo: Dict[str, bool],
+    ) -> bool:
+        """True when every project call path into ``fkey`` holds the
+        lock at the call site (transitively)."""
+        if fkey in memo:
+            return memo[fkey]
+        memo[fkey] = True  # optimistic on cycles (greatest fixpoint)
+        edges = self._call_edges.get(fkey)
+        if not edges:
+            memo[fkey] = False
+            return False
+        ok = all(
+            self._held_ok(held, lock_key, lock_attr)
+            or self._covered_by_callers(caller, lock_key, lock_attr, memo)
+            for caller, held in edges
+        )
+        memo[fkey] = ok
+        return ok
+
+    def _check_df014(self) -> None:
+        namespaces: Dict[str, dict] = self.persistence.get("namespaces", {})
+        impl = set(self.persistence.get("implementation", []))
+        # 1. every namespace in code is declared
+        seen_ns: Set[str] = set()
+        for ns, call, mi in self._binding_sites:
+            seen_ns.add(ns)
+            if ns not in namespaces:
+                self._emit(
+                    RULE_CRASH, mi, call,
+                    f"namespace {ns!r} is not declared in "
+                    "records/state_contracts.py — every durable table "
+                    "needs an owner, lock, recovery loader and invariant",
+                )
+        for ns in sorted(set(namespaces) - seen_ns):
+            mi = self.program.modules.get(namespaces[ns].get("owner", ""))
+            if mi is not None:
+                self._emit(
+                    RULE_CRASH, mi, mi.module.tree,
+                    f"namespace {ns!r} declared in "
+                    "records/state_contracts.py but never bound by a "
+                    ".table() call — stale registry entry",
+                )
+        ops_by_ns: Dict[str, List[TableOp]] = {}
+        for op in self._ops:
+            ops_by_ns.setdefault(op.ns, []).append(op)
+        # 2-4. per-namespace rules
+        for ns, spec in sorted(namespaces.items()):
+            ops = ops_by_ns.get(ns, [])
+            self._check_ns_locks(ns, spec, ops, impl)
+            self._check_ns_multirow(ns, spec, ops)
+            self._check_ns_loader(ns, spec, ops)
+        self._check_write_order()
+        self._check_foreign_keys(ops_by_ns)
+
+    def _check_ns_locks(self, ns: str, spec: dict, ops: List[TableOp],
+                        impl: Set[str]) -> None:
+        lock_spec = spec.get("lock")
+        if not lock_spec:
+            return
+        lock_key = self._declared_lock_key(list(lock_spec))
+        lock_attr = lock_spec[2]
+        unlocked_ok = set(spec.get("unlocked_ok", []))
+        memo: Dict[str, bool] = {}
+        writers = {op.fi.key for op in ops if op.method in _WRITE_METHODS}
+        for op in ops:
+            if op.fi.module.relpath in impl and op.fi.qual in unlocked_ok:
+                continue
+            if op.fi.qual in unlocked_ok or op.fi.name in unlocked_ok:
+                continue
+            is_write = op.method in _WRITE_METHODS
+            if not is_write:
+                # Reads are held to the lock bar only in read-modify-write
+                # functions (get→mutate→put races); loaders are free.
+                if op.fi.key not in writers:
+                    continue
+            if self._held_ok(op.held, lock_key, lock_attr):
+                continue
+            if self._covered_by_callers(op.fi.key, lock_key, lock_attr, memo):
+                continue
+            kind = "write" if is_write else "read (in a writing function)"
+            self._emit(
+                RULE_CRASH, op.fi.module, op.node,
+                f"namespace {ns!r}: {op.method}() {kind} without the "
+                f"owning lock {lock_spec[1]}.{lock_attr} — a concurrent "
+                "get→mutate→put tears the row (declare the site "
+                "unlocked_ok only for single-threaded boot paths)",
+            )
+
+    def _check_ns_multirow(self, ns: str, spec: dict, ops: List[TableOp]) -> None:
+        for qual in spec.get("multi_row", []):
+            fkey = f"{spec.get('owner')}:{qual}"
+            fi = self.program.funcs.get(fkey)
+            if fi is None:
+                mi = self.program.modules.get(spec.get("owner", ""))
+                if mi is not None:
+                    self._emit(
+                        RULE_CRASH, mi, mi.module.tree,
+                        f"namespace {ns!r}: declared multi-row site "
+                        f"{qual!r} missing from {spec.get('owner')} — "
+                        "update records/state_contracts.py with the rename",
+                    )
+                continue
+            mine = [op for op in ops if op.fi is fi]
+            puts = [op for op in mine if op.method == "put"]
+            put_manys = [op for op in mine if op.method == "put_many"]
+            if puts:
+                for op in puts:
+                    self._emit(
+                        RULE_CRASH, fi.module, op.node,
+                        f"namespace {ns!r}: single put() inside declared "
+                        f"multi-row site {qual} — a crash between rows "
+                        "tears the invariant; batch every touched row "
+                        "into ONE put_many()",
+                    )
+            elif not put_manys:
+                self._emit(
+                    RULE_CRASH, fi.module, fi.node,
+                    f"namespace {ns!r}: declared multi-row site {qual} "
+                    "performs no put_many() — the transactional flip is "
+                    "gone",
+                )
+
+    def _check_ns_loader(self, ns: str, spec: dict, ops: List[TableOp]) -> None:
+        owner = spec.get("owner", "")
+        mi = self.program.modules.get(owner)
+        loader_qual = spec.get("loader", "")
+        fkey = f"{owner}:{loader_qual}"
+        fi = self.program.funcs.get(fkey)
+        if fi is None:
+            if mi is not None:
+                self._emit(
+                    RULE_CRASH, mi, mi.module.tree,
+                    f"namespace {ns!r}: declared recovery loader "
+                    f"{loader_qual!r} missing from {owner} — an "
+                    "unreloaded table is an orphan after restart",
+                )
+            return
+        has_load = any(
+            op.fi is fi and op.method == "load_all" for op in ops
+        )
+        if not has_load:
+            self._emit(
+                RULE_CRASH, fi.module, fi.node,
+                f"namespace {ns!r}: recovery loader {loader_qual} no "
+                "longer calls load_all() on the table — rows written "
+                "before a restart are never read back",
+            )
+            return
+        if not self._reachable_from_constructor(fi):
+            self._emit(
+                RULE_CRASH, fi.module, fi.node,
+                f"namespace {ns!r}: recovery loader {loader_qual} is not "
+                "reachable from any constructor — recovery never runs",
+            )
+        if not spec.get("invariant"):
+            if mi is not None:
+                self._emit(
+                    RULE_CRASH, mi, mi.module.tree,
+                    f"namespace {ns!r}: no declared recovery invariant — "
+                    "the crash witness has nothing to assert after reload",
+                )
+
+    def _reachable_from_constructor(self, target: FuncInfo) -> bool:
+        if target.name == "__init__":
+            return True
+        seen: Set[str] = set()
+        stack = [
+            fi for fi in self.program.funcs.values() if fi.name == "__init__"
+        ]
+        while stack:
+            fi = stack.pop()
+            if fi.key in seen:
+                continue
+            seen.add(fi.key)
+            for _call, t in fi.calls:
+                if t is target:
+                    return True
+                if t.key not in seen:
+                    stack.append(t)
+        return False
+
+    def _trans_ns_writes(self) -> Dict[str, Set[str]]:
+        """FuncInfo.key -> namespaces (transitively) written."""
+        out: Dict[str, Set[str]] = {}
+        for op in self._ops:
+            if op.method in _WRITE_METHODS:
+                out.setdefault(op.fi.key, set()).add(op.ns)
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.program.funcs.values():
+                mine = out.setdefault(fi.key, set())
+                for _call, target in fi.calls:
+                    extra = out.get(target.key, set()) - mine
+                    if extra:
+                        mine |= extra
+                        changed = True
+        return out
+
+    def _check_write_order(self) -> None:
+        pairs = [tuple(p) for p in self.persistence.get("write_order", [])]
+        if not pairs:
+            return
+        trans = self._trans_ns_writes()
+        for fi in self.program.funcs.values():
+            events: List[Tuple[int, str, ast.AST]] = []
+            for op in self._ops:
+                if op.fi is fi and op.method in _WRITE_METHODS:
+                    events.append((op.node.lineno, op.ns, op.node))
+            for call, target in fi.calls:
+                for ns in trans.get(target.key, ()):
+                    events.append((call.lineno, ns, call))
+            if not events:
+                continue
+            events.sort(key=lambda e: e[0])
+            for first_ns, then_ns in pairs:
+                first_a = next((e for e in events if e[1] == first_ns), None)
+                first_b = next((e for e in events if e[1] == then_ns), None)
+                if first_a is None or first_b is None:
+                    continue
+                if first_b[0] < first_a[0]:
+                    self._emit(
+                        RULE_CRASH, fi.module, first_b[2],
+                        f"write-order violation: {then_ns!r} row committed "
+                        f"before the {first_ns!r} row it references "
+                        f"(declared order: {first_ns} before {then_ns}) — "
+                        "a crash between them leaves a dangling reference",
+                    )
+
+    def _check_foreign_keys(self, ops_by_ns: Dict[str, List[TableOp]]) -> None:
+        for fk in self.persistence.get("foreign_keys", []):
+            parent, child = fk.get("parent"), fk.get("child")
+            parent_spec = self.persistence.get("namespaces", {}).get(parent, {})
+            owner = parent_spec.get("owner", "")
+            prim_key = f"{owner}:{fk.get('primitive')}"
+            prim = self.program.funcs.get(prim_key)
+            cleanup_key = f"{fk.get('cleanup_file')}:{fk.get('cleanup')}"
+            cleanup = self.program.funcs.get(cleanup_key)
+            anchor_mi = self.program.modules.get(owner)
+            if prim is None:
+                if anchor_mi is not None:
+                    self._emit(
+                        RULE_CRASH, anchor_mi, anchor_mi.module.tree,
+                        f"foreign key {parent}->{child}: delete primitive "
+                        f"{fk.get('primitive')!r} missing from {owner}",
+                    )
+                continue
+            if cleanup is None:
+                if anchor_mi is not None:
+                    self._emit(
+                        RULE_CRASH, anchor_mi, anchor_mi.module.tree,
+                        f"foreign key {parent}->{child}: declared cleanup "
+                        f"{fk.get('cleanup')!r} missing from "
+                        f"{fk.get('cleanup_file')} — a model delete "
+                        "strands its rollout rows",
+                    )
+                continue
+            # Cleanup must (transitively) delete child rows.
+            if not self._reaches_child_delete(cleanup, child):
+                self._emit(
+                    RULE_CRASH, cleanup.module, cleanup.node,
+                    f"foreign key {parent}->{child}: cleanup "
+                    f"{fk.get('cleanup')} never deletes {child!r} rows — "
+                    "the dangling-reference guard is vacuous",
+                )
+            # Every caller of the primitive must be the cleanup.
+            for caller_key, _held in self._call_edges.get(prim.key, []):
+                if caller_key == cleanup.key:
+                    continue
+                caller = self.program.funcs.get(caller_key)
+                if caller is None:
+                    continue
+                self._emit(
+                    RULE_CRASH, caller.module, caller.node,
+                    f"foreign key {parent}->{child}: "
+                    f"{fk.get('primitive')} called outside the declared "
+                    f"cleanup {fk.get('cleanup')} — this delete path can "
+                    f"strand {child!r} rows",
+                )
+            # No raw delete-site on the parent table outside the primitive.
+            for op in ops_by_ns.get(parent, []):
+                if op.method == "delete" and op.fi is not prim:
+                    self._emit(
+                        RULE_CRASH, op.fi.module, op.node,
+                        f"foreign key {parent}->{child}: raw delete on "
+                        f"{parent!r} outside {fk.get('primitive')} — all "
+                        "deletes must flow through the guarded primitive",
+                    )
+
+    def _reaches_child_delete(self, fi: FuncInfo, child: str) -> bool:
+        seen: Set[str] = set()
+        stack = [fi]
+        while stack:
+            cur = stack.pop()
+            if cur.key in seen:
+                continue
+            seen.add(cur.key)
+            for op in self._ops:
+                if op.fi is cur and op.ns == child and op.method in ("delete", "put", "put_many"):
+                    return True
+            for _call, t in cur.calls:
+                stack.append(t)
+        return False
+
+    # ------------------------------------------------------------------
+    # DF015 — RPC contract parity
+    # ------------------------------------------------------------------
+
+    def _literal_set_of(self, mi: ModuleInfo, container: str, name: str) -> Optional[Tuple[Set[str], ast.AST]]:
+        """String literals of ``name = frozenset({...})`` /
+        ``name = {...dict...}`` assigned at module level or inside class
+        ``container`` (empty container name = module level)."""
+        tree: ast.AST = mi.module.tree
+        if container:
+            found = None
+            for node in ast.walk(mi.module.tree):
+                if isinstance(node, ast.ClassDef) and node.name == container:
+                    found = node
+                    break
+            if found is None:
+                return None
+            tree = found
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            target = node.targets[0]
+            if not (isinstance(target, ast.Name) and target.id == name):
+                continue
+            value = node.value
+            if isinstance(value, ast.Call) and value.args:
+                value = value.args[0]
+            out: Set[str] = set()
+            if isinstance(value, ast.Dict):
+                for k in value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        out.add(k.value)
+                return out, node
+            if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+                for e in value.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        out.add(e.value)
+                return out, node
+        return None
+
+    def _client_call_literals(
+        self, mi: ModuleInfo, cls_name: str
+    ) -> List[Tuple[str, ast.Call]]:
+        ci = mi.classes.get(cls_name)
+        if ci is None:
+            return []
+        out: List[Tuple[str, ast.Call]] = []
+        for fn in ci.methods.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr == "_call"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    out.append((node.args[0].value, node))
+        return out
+
+    def _check_df015(self) -> None:
+        for service, spec in sorted(self.rpc.items()):
+            self._check_service_parity(service, spec)
+
+    def _check_service_parity(self, service: str, spec: dict) -> None:
+        server_file, server_cls, server_var = spec.get("server", ("", "", ""))
+        grpc_file, grpc_var = spec.get("grpc", ("", ""))
+        server_mi = self.program.modules.get(server_file)
+        grpc_mi = self.program.modules.get(grpc_file)
+        if server_mi is None:
+            return  # sub-tree lint run without the rpc layer
+        server_hit = self._literal_set_of(server_mi, server_cls, server_var)
+        if server_hit is None:
+            self._emit(
+                RULE_RPC, server_mi, server_mi.module.tree,
+                f"service {service!r}: dispatch set "
+                f"{server_cls}.{server_var} not found — the wire has no "
+                "method inventory to check against",
+            )
+            return
+        server_methods, server_node = server_hit
+        grpc_methods: Optional[Set[str]] = None
+        grpc_node: Optional[ast.AST] = None
+        if grpc_mi is not None:
+            grpc_hit = self._literal_set_of(grpc_mi, "", grpc_var)
+            if grpc_hit is None:
+                self._emit(
+                    RULE_RPC, grpc_mi, grpc_mi.module.tree,
+                    f"service {service!r}: transport table {grpc_var} not "
+                    f"found in {grpc_file}",
+                )
+            else:
+                grpc_methods, grpc_node = grpc_hit
+        # Adapter handler defs behind every METHODS entry.
+        adapter = server_mi.classes.get(server_cls)
+        for name in sorted(server_methods):
+            if adapter is not None and adapter.find_method(name) is None:
+                self._emit(
+                    RULE_RPC, server_mi, server_node,
+                    f"service {service!r}: METHODS entry {name!r} has no "
+                    f"handler def on {server_cls} — dispatch would "
+                    "AttributeError",
+                )
+        # gRPC table entries must be dispatchable.
+        if grpc_methods is not None and grpc_node is not None:
+            for name in sorted(grpc_methods - server_methods):
+                self._emit(
+                    RULE_RPC, grpc_mi, grpc_node,
+                    f"service {service!r}: gRPC method {name!r} has no "
+                    "inproc dispatch handler — the two transports drifted",
+                )
+        # Client literals against both transports + classification.
+        idempotent = set(spec.get("idempotent", []))
+        deduped: Dict[str, str] = dict(spec.get("deduped", {}))
+        client_literals: Set[str] = set()
+        for client_file, classes in spec.get("clients", {}).items():
+            client_mi = self.program.modules.get(client_file)
+            if client_mi is None:
+                continue
+            for cls_name in classes:
+                for name, node in self._client_call_literals(client_mi, cls_name):
+                    client_literals.add(name)
+                    if name not in server_methods:
+                        self._emit(
+                            RULE_RPC, client_mi, node,
+                            f"service {service!r}: client method {name!r} "
+                            "has no registered server dispatch handler "
+                            f"({server_cls}.{server_var}) — the call can "
+                            "only 404",
+                        )
+                    if grpc_methods is not None and name not in grpc_methods:
+                        self._emit(
+                            RULE_RPC, client_mi, node,
+                            f"service {service!r}: client method {name!r} "
+                            f"missing from the gRPC transport table "
+                            f"({grpc_var}) — the gRPC binding of this "
+                            "client KeyErrors",
+                        )
+                    if name not in idempotent and name not in deduped:
+                        self._emit(
+                            RULE_RPC, client_mi, node,
+                            f"service {service!r}: retried method {name!r} "
+                            "is neither declared idempotent nor deduped in "
+                            "records/state_contracts.py — a wire retry "
+                            "may double-apply it; classify it (and add a "
+                            "dedup seam if needed)",
+                        )
+        # Dedup seams must exist.
+        seam_files = list(spec.get("seam_files", []))
+        for method, seam in sorted(deduped.items()):
+            if not self._seam_exists(seam, seam_files):
+                self._emit(
+                    RULE_RPC, server_mi, server_node,
+                    f"service {service!r}: declared dedup seam {seam!r} "
+                    f"for {method!r} not found in {seam_files} — the "
+                    "idempotency claim is vacuous",
+                )
+        # Stale classification entries.
+        known = client_literals | server_methods
+        for name in sorted((idempotent | set(deduped)) - known):
+            self._emit(
+                RULE_RPC, server_mi, server_node,
+                f"service {service!r}: classified method {name!r} is "
+                "neither client-called nor server-dispatched — stale "
+                "registry entry",
+            )
+
+    def _seam_exists(self, seam: str, seam_files: List[str]) -> bool:
+        suffix = f":{seam}"
+        for key in self.program.funcs:
+            relpath = key.split(":", 1)[0]
+            if seam_files and relpath not in seam_files:
+                continue
+            if key.endswith(suffix):
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    # Public surface (crash witness + FSM graph)
+    # ------------------------------------------------------------------
+
+    def persistence_site_index(self) -> Dict[Tuple[str, int], Tuple[str, str]]:
+        """(relpath, lineno) covered by any static KVTable op →
+        (namespace, method).  The runtime crash witness maps each
+        observed write's caller frame through this; an unknown frame is
+        a stale static inventory."""
+        out: Dict[Tuple[str, int], Tuple[str, str]] = {}
+        for op in self._ops:
+            start = op.node.lineno
+            end = getattr(op.node, "end_lineno", start) or start
+            for line in range(start, end + 1):
+                out.setdefault(
+                    (op.fi.module.relpath, line), (op.ns, op.method)
+                )
+        return out
+
+    def multi_row_sites(self) -> Dict[str, str]:
+        """Declared multi-row transaction sites: "relpath:qual" →
+        namespace.  The witness asserts these are only ever observed as
+        put_many."""
+        out: Dict[str, str] = {}
+        for ns, spec in self.persistence.get("namespaces", {}).items():
+            for qual in spec.get("multi_row", []):
+                out[f"{spec.get('owner')}:{qual}"] = ns
+        return out
+
+    def namespace_invariants(self) -> Dict[str, str]:
+        return {
+            ns: spec.get("invariant", "")
+            for ns, spec in self.persistence.get("namespaces", {}).items()
+        }
+
+    def fsm_graph_markdown(self) -> str:
+        """The committed DESIGN.md §19 block: one table per declared
+        machine, sorted, stable across runs."""
+        lines: List[str] = []
+        for key in sorted(self.machines):
+            m = self.machines[key]
+            lines.append(f"**machine `{key}`** — "
+                         + ("event-driven FSM" if m.get("kind") == "fsm"
+                            else f"enum `{m.get('enum')}`")
+                         + f" ({m.get('file')})")
+            lines.append("")
+            if m.get("kind") == "fsm":
+                lines.append("| event | transition |")
+                lines.append("| --- | --- |")
+                for name in sorted(m.get("events", {})):
+                    for src, dst in sorted(map(tuple, m["events"][name])):
+                        lines.append(f"| `{name}` | {src} → {dst} |")
+            else:
+                lines.append("| from | to |")
+                lines.append("| --- | --- |")
+                for src, dst in sorted(map(tuple, m.get("edges", []))):
+                    lines.append(f"| {src} | {dst} |")
+            lines.append("")
+        return "\n".join(lines)
+
+    def fsm_graph_dot(self) -> str:
+        out: List[str] = []
+        for key in sorted(self.machines):
+            m = self.machines[key]
+            out.append(f"digraph {key} {{")
+            out.append('  rankdir="LR";')
+            edges: List[Tuple[str, str, str]] = []
+            if m.get("kind") == "fsm":
+                for name in sorted(m.get("events", {})):
+                    for src, dst in sorted(map(tuple, m["events"][name])):
+                        edges.append((src, dst, name))
+            else:
+                for src, dst in sorted(map(tuple, m.get("edges", []))):
+                    edges.append((src, dst, ""))
+            nodes = sorted({n for e in edges for n in (e[0], e[1])})
+            for n in nodes:
+                out.append(f'  "{n}";')
+            for src, dst, label in edges:
+                suffix = f' [label="{label}"]' if label else ""
+                out.append(f'  "{src}" -> "{dst}"{suffix};')
+            out.append("}")
+        return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Runner integration
+# ---------------------------------------------------------------------------
+
+
+def crash_witness_gaps(
+    analysis: StateAnalysis,
+    observed: Dict[Tuple[str, int], List[dict]],
+) -> List[str]:
+    """Cross-validate runtime KVTable writes (from
+    ``dragonfly2_tpu.utils.dfcrash``) against the static persistence
+    inventory.  ``observed`` maps write site (relpath, lineno) → list of
+    {"namespace", "method", "rows"} records.
+
+    Empty result == every runtime write is statically known, its
+    namespace matches, and declared multi-row sites were only observed
+    as one-transaction ``put_many`` calls.  A gap is a STALE INVENTORY
+    (fix staterules' binding resolution or declare the namespace) or a
+    TORN MULTI-ROW FLIP (the split-put mutation) — never a thing to
+    silence in the test."""
+    index = analysis.persistence_site_index()
+    multi = analysis.multi_row_sites()
+    multi_lines: Dict[Tuple[str, int], str] = {}
+    for key, ns in multi.items():
+        relpath, qual = key.split(":", 1)
+        fi = analysis.program.funcs.get(key)
+        if fi is None:
+            continue
+        start = fi.node.lineno
+        end = getattr(fi.node, "end_lineno", start) or start
+        for line in range(start, end + 1):
+            multi_lines[(relpath, line)] = key
+    gaps: List[str] = []
+    for (relpath, lineno), records in sorted(observed.items()):
+        known = index.get((relpath, lineno))
+        if known is None:
+            nss = sorted({r.get("namespace", "?") for r in records})
+            gaps.append(
+                f"KVTable write at {relpath}:{lineno} (namespaces {nss}) "
+                "is unknown to the static persistence inventory — a "
+                "binding the resolver missed or an undeclared table"
+            )
+            continue
+        ns, _method = known
+        for r in records:
+            if r.get("namespace") != ns:
+                gaps.append(
+                    f"{relpath}:{lineno}: observed namespace "
+                    f"{r.get('namespace')!r} but the static inventory "
+                    f"says {ns!r}"
+                )
+        site_key = multi_lines.get((relpath, lineno))
+        if site_key is not None:
+            for r in records:
+                if r.get("method") != "put_many":
+                    gaps.append(
+                        f"declared multi-row site {site_key} observed "
+                        f"issuing {r.get('method')}() — the transactional "
+                        "flip has been split; a crash between rows tears "
+                        f"the {multi[site_key]!r} invariant"
+                    )
+                    break
+    return gaps
+
+
+def state_findings(program: Program, root: Optional[Path] = None) -> List[Finding]:
+    return StateAnalysis(program, root).findings()
