@@ -30,6 +30,9 @@ PIECE = 64 * 1024
 
 def main() -> int:
     mtls = "--mtls" in sys.argv[1:]
+    # --manager-standby: launch a leader+hot-standby manager pair
+    # (manager/replication.py); clients get BOTH urls and fail over.
+    manager_standby = "--manager-standby" in sys.argv[1:]
     replicas = 1
     argv = sys.argv[1:]
     if "--replicas" in argv:
@@ -103,11 +106,32 @@ def main() -> int:
         mcfg = write("manager.yaml", (
             "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
             f"registry: {{blob_dir: {tmp}/manager}}\n"
+            + ("ha: {enable: true, lease_ttl_s: 5.0}\n" if manager_standby
+               else "")
             + (f"ca_dir: {tmp}/ca\n" if mtls else "")
         ))
         mout = spawn("manager", ["dragonfly2_tpu.cli.manager", "--config", mcfg],
                      ["manager: serving"])
         manager_url = re.search(r"REST on (\S+)", mout["manager: serving"]).group(1)
+        manager_urls = manager_url
+        if manager_standby:
+            sbmcfg = write("manager-standby.yaml", (
+                "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
+                f"registry: {{blob_dir: {tmp}/manager-standby}}\n"
+                "ha: {enable: true, lease_ttl_s: 5.0}\n"
+            ))
+            sbout = spawn(
+                "manager-standby",
+                ["dragonfly2_tpu.cli.manager", "--config", sbmcfg,
+                 "--replicate-from", manager_url],
+                ["manager: serving"],
+            )
+            standby_url = re.search(
+                r"REST on (\S+)", sbout["manager: serving"]
+            ).group(1)
+            # Every manager client takes the pair: comma-separated spec
+            # feeds rpc/resolver.ManagerEndpoints.
+            manager_urls = f"{manager_url},{standby_url}"
 
         tcfg = write("trainer.yaml", (
             "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
@@ -125,7 +149,7 @@ def main() -> int:
             "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
             "scheduling: {retry_interval_s: 0.1}\n"
             f"storage: {{dir: {tmp}/records, buffer_size: 1}}\n"
-            f"manager_addr: {manager_url}\n"
+            f"manager_addr: {manager_urls}\n"
             "dynconfig_refresh_s: 5.0\n"
             + ("topology_sync_interval_s: 3.0\n" if replicas > 1
                else "topology_sync_interval_s: 10.0\n")
@@ -144,7 +168,7 @@ def main() -> int:
                 "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
                 "scheduling: {retry_interval_s: 0.1}\n"
                 f"storage: {{dir: {tmp}/records-{n}, buffer_size: 1}}\n"
-                f"manager_addr: {manager_url}\n"
+                f"manager_addr: {manager_urls}\n"
                 "dynconfig_refresh_s: 5.0\n"
                 "topology_sync_interval_s: 3.0\n"
                 + ("security: {auto_issue: true}\n" if mtls else "")
@@ -206,6 +230,7 @@ def main() -> int:
         e2e_env = {
             **env,
             "MANAGER_URL": manager_url,
+            "MANAGER_URLS": manager_urls,
             "SCHEDULER_URL": scheduler_url,
             "SCHEDULER_B_URL": scheduler_b_url,
             "TRAINER_URL": trainer_url,

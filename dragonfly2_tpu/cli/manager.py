@@ -18,46 +18,15 @@ from ..manager.registry import BlobStore
 from .common import base_parser, init_debug, init_logging, init_tracing
 
 
-def build(cfg: ManagerConfig):
-    import os
-
-    # ONE durable state backend for every manager surface (manager/
-    # state.py seam): registry rows, CRUD rows, the job broker, the
-    # shared topology cache, users — a restart reloads all of it from
-    # one place, and the HA story swaps one backend, not five files.
-    from ..manager.state import make_state_backend, migrate_legacy_sqlite
-
-    backend = make_state_backend(
-        os.path.join(cfg.registry.blob_dir, "manager-state.db")
-    )
-    # Pre-seam deployments kept per-store files; import them once so an
-    # upgrade never silently drops models/CRUD rows.
-    migrated = migrate_legacy_sqlite(
-        backend,
-        models_db=os.path.join(cfg.registry.blob_dir, "manager.db"),
-        crud_db=os.path.join(cfg.registry.blob_dir, "crud.db"),
-    )
-    if migrated:
-        print(f"manager: migrated legacy state {migrated}", flush=True)
-    registry = ModelRegistry(
-        BlobStore(cfg.registry.blob_dir), backend=backend,
-    )
-    clusters = ClusterManager(keepalive_ttl=cfg.keepalive_ttl_s)
+def _build_consumers(cfg: ManagerConfig, backend, blob_store):
+    """The backend-fed composition pieces: rebuilt wholesale by the
+    standby every time the replication follower applies a batch (their
+    in-memory caches must track the replicated rows)."""
     from ..manager.crud import CrudStore
-
-    crud = CrudStore(backend=backend)
-    crud.ensure_default_cluster()
-    objectstorage = None
-    if cfg.objectstorage:
-        from ..objectstorage import make_backend
-
-        kwargs = dict(cfg.objectstorage)
-        objectstorage = make_backend(kwargs.pop("kind", "fs"), **kwargs)
-    # Rollout controller (rollout/controller.py): evidence-gated
-    # SHADOW→CANARY→ACTIVE promotion with auto-rollback; its rows ride
-    # the same state backend, so in-flight rollouts survive a bounce.
     from ..rollout import RolloutController, RolloutGuardrails
 
+    registry = ModelRegistry(blob_store, backend=backend)
+    crud = CrudStore(backend=backend)
     rollout = RolloutController(
         registry,
         guardrails=RolloutGuardrails(
@@ -71,31 +40,113 @@ def build(cfg: ManagerConfig):
         ),
         backend=backend,
     )
+    return {
+        "registry": registry,
+        "crud": crud,
+        "rollout": rollout,
+        "jobs": JobQueue(backend=backend),
+    }
+
+
+def build(cfg: ManagerConfig, *, replicate_from: str = ""):
+    import os
+    import socket as _socket
+
+    # ONE durable state backend for every manager surface (manager/
+    # state.py seam): registry rows, CRUD rows, the job broker, the
+    # shared topology cache, users — a restart reloads all of it from
+    # one place, and the HA story swaps one backend, not five files.
+    from ..manager.state import make_state_backend, migrate_legacy_sqlite
+
+    replicate_from = replicate_from or cfg.ha.replicate_from
+    ha_enabled = bool(cfg.ha.enable or replicate_from)
+    backend = make_state_backend(
+        os.path.join(cfg.registry.blob_dir, "manager-state.db")
+    )
+    ha = None
+    if ha_enabled:
+        from ..manager.replication import ReplicatedStateBackend
+
+        role = "standby" if replicate_from else "leader"
+        node_id = cfg.ha.node_id or (
+            f"mgr-{_socket.gethostname()}-{cfg.server.port}"
+        )
+        ha = backend = ReplicatedStateBackend(
+            backend,
+            node_id=node_id,
+            role=role,
+            lease_ttl_s=cfg.ha.lease_ttl_s,
+            lease_secret=cfg.ha.lease_secret,
+        )
+    if not replicate_from:
+        # Pre-seam deployments kept per-store files; import them once so
+        # an upgrade never silently drops models/CRUD rows.  A standby
+        # never migrates — its state comes from the leader's snapshot.
+        migrated = migrate_legacy_sqlite(
+            backend,
+            models_db=os.path.join(cfg.registry.blob_dir, "manager.db"),
+            crud_db=os.path.join(cfg.registry.blob_dir, "crud.db"),
+        )
+        if migrated:
+            print(f"manager: migrated legacy state {migrated}", flush=True)
+    # HA replicates artifacts WITH their registry rows (KVBlobStore rides
+    # the same log); the single-node form keeps the blob directory.
+    if ha_enabled:
+        from ..manager.registry import KVBlobStore
+
+        blob_store = KVBlobStore(backend)
+    else:
+        blob_store = BlobStore(cfg.registry.blob_dir)
+    clusters = ClusterManager(keepalive_ttl=cfg.keepalive_ttl_s)
+    objectstorage = None
+    if cfg.objectstorage:
+        from ..objectstorage import make_backend
+
+        kwargs = dict(cfg.objectstorage)
+        objectstorage = make_backend(kwargs.pop("kind", "fs"), **kwargs)
+    # Rollout controller (rollout/controller.py): evidence-gated
+    # SHADOW→CANARY→ACTIVE promotion with auto-rollback; its rows ride
+    # the same state backend, so in-flight rollouts survive a bounce.
+    # On a standby the boot-time reconciliation runs under applying()
+    # (derived state, not new client mutations).
+    if ha is not None and ha.role == "standby":
+        with ha.applying():
+            consumers = _build_consumers(cfg, backend, blob_store)
+    else:
+        consumers = _build_consumers(cfg, backend, blob_store)
+        consumers["crud"].ensure_default_cluster()
     # NOTE: no DynconfigServer here — the dynconfig payload schedulers
     # poll is served straight from the CrudStore's cluster rows
     # (/api/v1/clusters/<id>:config), one source of truth.
     return {
-        "registry": registry,
+        "registry": consumers["registry"],
         "clusters": clusters,
         "searcher": Searcher(),
-        "jobs": JobQueue(backend=backend),
-        "crud": crud,
+        "jobs": consumers["jobs"],
+        "crud": consumers["crud"],
         "objectstorage": objectstorage,
         "state_backend": backend,
-        "rollout": rollout,
+        "rollout": consumers["rollout"],
+        "ha": ha,
+        "blob_store": blob_store,
     }
 
 
 def run(argv=None) -> int:
     p = base_parser("manager", "Control-plane manager service")
     p.add_argument("--list-models", action="store_true")
+    p.add_argument(
+        "--replicate-from", default="", metavar="URL",
+        help="boot as a hot standby tailing this leader's replication "
+             "log; promotes itself when the leader's lease expires",
+    )
     args = p.parse_args(argv)
     init_logging(args, "manager")
     init_debug(args)
     init_tracing(args)
 
     cfg = load_config(ManagerConfig, args.config)
-    parts = build(cfg)
+    parts = build(cfg, replicate_from=args.replicate_from)
 
     if args.list_models:
         models = parts["registry"].list()
@@ -127,7 +178,11 @@ def run(argv=None) -> int:
             users = UserStore(backend=user_backend)
         else:
             users = UserStore(backend=parts["state_backend"])
-        if cfg.root_password:
+        if cfg.root_password and not (
+            parts["ha"] is not None and parts["ha"].role == "standby"
+        ):
+            # A standby never seeds accounts — the root user replicates
+            # from the leader like every other row.
             users.ensure_root(cfg.root_password)
         auth = {
             "token_verifier": TokenVerifier(secret),
@@ -168,9 +223,64 @@ def run(argv=None) -> int:
         state_backend=parts["state_backend"],
         jobs_min_requeue_s=cfg.jobs_min_requeue_s,
         rollout=parts["rollout"],
+        ha=parts["ha"],
         **auth,
     )
     rest.serve()
+    # -- replication role (manager/replication.py, DESIGN.md §20) -------
+    ha = parts["ha"]
+    lease_keeper = None
+    follower = None
+    if ha is not None and ha.role == "leader":
+        from ..manager.replication import LeaseKeeper
+
+        lease_keeper = LeaseKeeper(ha)
+        lease_keeper.serve()
+    elif ha is not None:
+        from ..manager.replication import LeaseKeeper, LogFollower
+
+        replicate_from = args.replicate_from or cfg.ha.replicate_from
+
+        def _rebuild(_touched) -> None:
+            # Replicated rows changed: swap the REST surface onto fresh
+            # consumers (their in-memory caches reload from the backend).
+            with ha.applying():
+                fresh = _build_consumers(
+                    cfg, parts["state_backend"], parts["blob_store"]
+                )
+            rest.registry = fresh["registry"]
+            rest.rollout = fresh["rollout"]
+            rest.crud = fresh["crud"]
+            rest.jobqueue = fresh["jobs"]
+            if rest._topology_table is not None:
+                with rest._topology_mu:
+                    rest.topology_shared = rest._topology_table.load_all()
+
+        def _on_promote() -> None:
+            # Now the leader: reconcile as a leader would at boot, start
+            # renewing the lease, and let the standing 503 gate fall
+            # away (the REST handler reads ha.role per request).
+            fresh = _build_consumers(
+                cfg, parts["state_backend"], parts["blob_store"]
+            )
+            fresh["crud"].ensure_default_cluster()
+            rest.registry = fresh["registry"]
+            rest.rollout = fresh["rollout"]
+            rest.crud = fresh["crud"]
+            rest.jobqueue = fresh["jobs"]
+            keeper = LeaseKeeper(ha)
+            keeper.serve()
+            print(
+                f"manager: promoted to leader (term {ha.term})", flush=True
+            )
+
+        follower = LogFollower(
+            ha, replicate_from,
+            poll_interval_s=cfg.ha.poll_interval_s,
+            on_apply=_rebuild,
+            on_promote=_on_promote,
+        )
+        follower.serve()
     grpc_server = None
     if cfg.server.grpc_port >= 0:
         from ..rpc.grpc_transport import ManagerGRPCServer
@@ -191,6 +301,10 @@ def run(argv=None) -> int:
     print(
         f"manager: serving REST on {rest.url}"
         + (f" and grpc on {grpc_server.target}" if grpc_server else "")
+        + (
+            f" as {parts['ha'].role} (term {parts['ha'].term})"
+            if parts["ha"] is not None else ""
+        )
         + " (ctrl-c to stop)",
         flush=True,
     )
@@ -201,6 +315,10 @@ def run(argv=None) -> int:
         rest.stop()
         if grpc_server is not None:
             grpc_server.stop()
+        if lease_keeper is not None:
+            lease_keeper.stop()
+        if follower is not None:
+            follower.stop()
         return 0
 
 

@@ -187,7 +187,10 @@ def run(argv=None) -> int:
         except ValueError:
             san_ips, san_names = [local_ip()], [dial, _sock.gethostname()]
         identity = PeerIdentity.request_from_manager(
-            cfg.manager_addr,
+            # One-shot bootstrap: the first replica in a comma-separated
+            # manager_addr list (issuance needs the leader; a standby
+            # would 503 and boot retries anyway).
+            cfg.manager_addr.split(",")[0].strip(),
             common_name=f"sched-{_sock.gethostname()}",
             hostnames=san_names,
             ips=san_ips,
@@ -259,25 +262,34 @@ def run(argv=None) -> int:
     if cfg.manager_addr:
         from ..jobs.preheat import PREHEAT
         from ..jobs.remote import RemoteJobWorker
-        from ..jobs.sync_peers import SYNC_PEERS, make_sync_peers_handler
         from ..rpc.cluster_client import RemoteClusterClient
+        from ..jobs.sync_peers import SYNC_PEERS, make_sync_peers_handler
+        from ..rpc.resolver import ManagerEndpoints
         from ..utils import idgen
 
         token = cfg.manager_token or None
+        # ONE shared multi-endpoint resolver for every manager-facing
+        # client in this process (manager_addr accepts a comma-separated
+        # replica list): the first client to fail over to the surviving
+        # manager replica moves keepalives, dynconfig polls, model/
+        # rollout fetches, job polls, and topology sync with it.
+        manager_endpoints = ManagerEndpoints(
+            cfg.manager_addr, client="scheduler"
+        )
         # Register THIS instance with the manager so the manager-side
         # producers (SyncPeers fans to f"scheduler:{sched.id}" for
         # *registered* schedulers, jobs/sync_peers.py) target the queue
         # this worker polls; the keepalive loop re-registers after a
         # manager restart.  A failed first registration only warns — the
         # loop keeps retrying while the worker polls.
-        cluster_link = RemoteClusterClient(cfg.manager_addr, token=token)
+        cluster_link = RemoteClusterClient(manager_endpoints, token=token)
         cluster_link.register_scheduler(
             id=scheduler_id, cluster_id=cfg.cluster_id,
             hostname=_socket.gethostname(), ip=cfg.server.host,
             port=cfg.server.port,
         )
         job_worker = RemoteJobWorker(
-            cfg.manager_addr, f"scheduler:{scheduler_id}", token=token
+            manager_endpoints, f"scheduler:{scheduler_id}", token=token
         )
 
         def preheat_handler(args):
@@ -314,9 +326,9 @@ def run(argv=None) -> int:
         _dynlog = _logging.getLogger("dragonfly2_tpu.cli.scheduler.dynconfig")
         _warned_404 = []
 
-        def _fetch_cluster_config():
+        def _fetch_one_endpoint(base):
             req = _request.Request(
-                f"{cfg.manager_addr}/api/v1/clusters/{cfg.cluster_id}:config"
+                f"{base}/api/v1/clusters/{cfg.cluster_id}:config"
             )
             try:
                 with _request.urlopen(req, timeout=10) as resp:
@@ -334,6 +346,12 @@ def run(argv=None) -> int:
                         "is created (POST /api/v1/clusters)", cfg.cluster_id,
                     )
                 raise
+
+        def _fetch_cluster_config():
+            # Sweep the replica list before giving up: the disk cache is
+            # the LAST resort (all replicas down), not the answer to one
+            # dead leader.
+            return manager_endpoints.call(_fetch_one_endpoint)
 
         def _apply_cluster_config(data):
             scc = data.get("scheduler_cluster_config")
@@ -378,7 +396,7 @@ def run(argv=None) -> int:
             from ..scheduler.topology_sync import TopologySync
 
             topology_sync = TopologySync(
-                service.networktopology, cfg.manager_addr, scheduler_id,
+                service.networktopology, manager_endpoints, scheduler_id,
                 token=token, interval_s=cfg.topology_sync_interval_s,
                 state_path=topology_state_path,
             )
@@ -395,12 +413,12 @@ def run(argv=None) -> int:
             from ..scheduler import ModelSubscriber
 
             model_subscriber = ModelSubscriber(
-                RemoteRegistry(cfg.manager_addr, token=token),
+                RemoteRegistry(manager_endpoints, token=token),
                 service.scheduling.evaluator,
                 scheduler_id=scheduler_id,
                 refresh_interval=cfg.scheduling.model_poll_interval_s,
                 jitter=cfg.scheduling.model_poll_jitter,
-                rollout_client=RolloutRESTClient(cfg.manager_addr, token=token),
+                rollout_client=RolloutRESTClient(manager_endpoints, token=token),
                 shadow_sample_rate=cfg.scheduling.shadow_sample_rate,
                 shadow_log_path=_os.path.join(
                     cfg.storage.dir, "shadow_replay.dfc"
@@ -409,7 +427,7 @@ def run(argv=None) -> int:
             model_subscriber.serve()
             rollout_reporter = RolloutReporter(
                 model_subscriber, storage,
-                RolloutRESTClient(cfg.manager_addr, token=token),
+                RolloutRESTClient(manager_endpoints, token=token),
                 interval_s=cfg.scheduling.rollout_report_interval_s,
             )
             rollout_reporter.serve()

@@ -247,6 +247,29 @@ class RolloutSection:
 
 
 @dataclass
+class HASection:
+    """Manager control-plane replication (manager/replication.py,
+    DESIGN.md §20).  ``enable`` turns on log-shipping + the
+    /api/v1/replication:* surface on a leader; ``replicate_from`` boots
+    this process as a hot standby tailing that leader (implies enable).
+    ``lease_secret`` must match across the pair — it signs the leader
+    lease followers defer to."""
+
+    enable: bool = False
+    replicate_from: str = ""
+    node_id: str = ""
+    lease_ttl_s: float = 10.0
+    lease_secret: str = "dragonfly-manager-lease"
+    poll_interval_s: float = 1.0
+
+    def validate(self) -> None:
+        if self.lease_ttl_s <= 0:
+            raise ConfigError("ha.lease_ttl_s must be > 0")
+        if self.poll_interval_s <= 0:
+            raise ConfigError("ha.poll_interval_s must be > 0")
+
+
+@dataclass
 class ManagerConfig:
     server: ServerConfig = field(default_factory=lambda: ServerConfig(port=65003))
     registry: ModelRegistrySection = field(default_factory=ModelRegistrySection)
@@ -275,6 +298,7 @@ class ManagerConfig:
     # not duplicate every in-flight job on its queue.  Operators shrink
     # it for recovery drills/tests.
     jobs_min_requeue_s: float = 30.0
+    ha: HASection = field(default_factory=HASection)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     log: LogConfig = field(default_factory=LogConfig)
 
@@ -282,6 +306,7 @@ class ManagerConfig:
         self.server.validate()
         self.log.validate()
         self.rollout.validate()
+        self.ha.validate()
         if self.token_secret and len(self.token_secret.encode()) < 16:
             raise ConfigError("token_secret must be >= 16 bytes")
         for p in self.oauth_providers:

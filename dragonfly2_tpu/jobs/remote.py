@@ -34,13 +34,25 @@ logger = logging.getLogger(__name__)
 
 
 class RemoteJobClient:
-    """Producer/observer side (manager CLI, tests, consoles)."""
+    """Producer/observer side (manager CLI, tests, consoles).
 
-    def __init__(self, manager_url: str, *, token: Optional[str] = None,
+    ``manager_url`` may be a single URL, a comma-separated replica list,
+    or a shared ``ManagerEndpoints`` — calls fail over to the next
+    manager replica on connection errors and on a standby's 503
+    (rpc/resolver.ManagerEndpoints), so keepalives, job polls, and
+    preheat submissions survive a leader bounce mid-flight."""
+
+    def __init__(self, manager_url, *, token: Optional[str] = None,
                  timeout: float = 10.0) -> None:
-        self.base = manager_url.rstrip("/")
+        from ..rpc.resolver import ManagerEndpoints
+
+        self.endpoints = ManagerEndpoints.of(manager_url, client="jobs")
         self.token = token
         self.timeout = timeout
+
+    @property
+    def base(self) -> str:
+        return self.endpoints.current()
 
     def call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
         """Bearer-authed JSON request against the manager REST surface —
@@ -50,16 +62,20 @@ class RemoteJobClient:
         headers = {"Content-Type": "application/json"}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
-        req = urllib.request.Request(
-            self.base + path, data=data, headers=headers, method=method
-        )
-        from ..utils import faultinject
 
-        faultinject.fire("jobs.remote.call")
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            if resp.status == 204:
-                return {}
-            return json.loads(resp.read() or b"{}")
+        def once(base: str) -> dict:
+            from ..utils import faultinject
+
+            faultinject.fire("jobs.remote.call")
+            req = urllib.request.Request(
+                base + path, data=data, headers=headers, method=method
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                if resp.status == 204:
+                    return {}
+                return json.loads(resp.read() or b"{}")
+
+        return self.endpoints.call(once)
 
     def create_group(self, type: str, args: Dict[str, Any], queues) -> dict:
         return self.call(

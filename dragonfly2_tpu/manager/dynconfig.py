@@ -54,7 +54,10 @@ class Dynconfig:
         *,
         refresh_interval: float = 300.0,
         cache_path: Optional[str] = None,
+        backoff_rng=None,
     ) -> None:
+        from ..rpc.retry import DecorrelatedJitterBackoff
+
         self._fetch = fetch
         self._interval = refresh_interval
         self._cache_path = cache_path
@@ -63,6 +66,16 @@ class Dynconfig:
         self._fetched_at = 0.0
         self._notified = False  # observers have seen SOME config
         self._observers: List[Callable[[Dict[str, Any]], None]] = []
+        # Refresh FAILURES back off with capped decorrelated jitter so a
+        # restarting manager is not met by the whole fleet's polls in one
+        # synchronized wave; a success resets to the normal cadence.
+        # Seeded rng => reproducible per-instance schedule.
+        self._backoff = DecorrelatedJitterBackoff(
+            base=min(2.0, refresh_interval),
+            cap=max(refresh_interval, 2.0),
+            rng=backoff_rng,
+        )
+        self.last_refresh_ok = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -113,6 +126,7 @@ class Dynconfig:
         try:
             data = self._fetch()
         except Exception:  # noqa: BLE001 — manager outage must not kill clients
+            self.last_refresh_ok = False
             observers: List[Callable[[Dict[str, Any]], None]] = []
             with self._mu:
                 if self._data is None:
@@ -126,6 +140,8 @@ class Dynconfig:
             for obs in observers:
                 obs(dict(fallback))
             return False
+        self.last_refresh_ok = True
+        self._backoff.reset()
         with self._mu:
             changed = data != self._data or not self._notified
             self._data = data
@@ -164,13 +180,20 @@ class Dynconfig:
         self.refresh()
 
         def loop() -> None:
-            while not self._stop.wait(self._interval):
+            wait = self._interval if self.last_refresh_ok else self._backoff.next()
+            while not self._stop.wait(wait):
                 try:
                     self.refresh()
                 except Exception:  # noqa: BLE001 — the refresh thread is forever
                     import logging
 
                     logging.getLogger(__name__).exception("dynconfig refresh failed")
+                # Failure cadence: decorrelated-jitter backoff until the
+                # manager answers again (anti-thundering-herd).
+                wait = (
+                    self._interval if self.last_refresh_ok
+                    else self._backoff.next()
+                )
 
         self._thread = threading.Thread(target=loop, name="dynconfig", daemon=True)
         self._thread.start()

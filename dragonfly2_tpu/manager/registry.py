@@ -105,6 +105,42 @@ class BlobStore:
         return key in self._mem
 
 
+class KVBlobStore:
+    """Artifact store riding the manager's StateBackend (one row per
+    blob, base64 docs).  The HA composition uses this instead of a blob
+    directory so artifacts flow through the SAME replication log as
+    their registry rows — a promoted standby can serve
+    ``models:artifact`` without a shared filesystem (the reference
+    stores artifacts in S3/OSS, which is externally HA the same way).
+
+    Single-writer discipline: ``put`` is only reached from
+    ``ModelRegistry.create_model`` under ``ModelRegistry._mu`` (the
+    registry row and its blob row are one logical write); no lock of
+    its own, so the lock hierarchy stays flat (§16)."""
+
+    def __init__(self, backend) -> None:
+        import base64 as _b64
+
+        self._b64 = _b64
+        self._table = backend.table("blobs")
+        # Recovery loader (DF014): blobs are fetched by key on demand;
+        # the boot-time load only proves the table reads back.
+        self._known = set(self._table.load_all())
+
+    def put(self, key: str, data: bytes) -> None:
+        self._table.put(key, {"b64": self._b64.b64encode(data).decode()})
+        self._known.add(key)
+
+    def get(self, key: str) -> bytes:
+        doc = self._table.get(key)
+        if doc is None:
+            raise KeyError(key)
+        return self._b64.b64decode(doc["b64"])
+
+    def exists(self, key: str) -> bool:
+        return self._table.get(key) is not None
+
+
 def _model_to_doc(m: Model) -> dict:
     return {
         "id": m.id, "name": m.name, "type": m.type, "version": m.version,

@@ -1,0 +1,731 @@
+"""Manager HA: log-shipping replication over the StateBackend seam.
+
+The reference gets control-plane HA for free from Redis+MySQL (the
+manager sits on externally HA-able stores, database.go:50-59); our
+embedded manager concentrates every durable surface behind ONE seam —
+``manager/state.py``'s ``StateBackend`` — which makes that seam the
+right place to replicate.  Three pieces (DESIGN.md §20):
+
+- **write-ahead op log** (``ReplicationLog``): every ``put``/
+  ``put_many``/``delete`` a leader commits is first appended to a
+  monotonic (term, seq) log riding two reserved namespaces of the same
+  backend (``replication_log`` / ``replication_meta``), THEN applied to
+  the data namespace.  Ops are absolute upserts/deletes, so boot-time
+  replay of the unapplied tail is idempotent — a crash between the log
+  append and the data commit converges on restart.
+
+- **roles + lease fencing** (``ReplicatedStateBackend``): a leader may
+  commit only while its lease (renewed every ``ttl/3`` by
+  ``LeaseKeeper``) is unexpired; an expired or fenced leader's writes
+  raise ``NotLeaderError`` — the zombie cannot commit.  The lease is
+  HMAC-signed with the shared ``lease_secret`` so a follower only
+  honours (and only defers to) a leader that holds the secret; terms
+  are fenced monotonically — observing a higher term permanently
+  demotes this node for that term.
+
+- **follower tailing + takeover** (``LogFollower``): a standby tails
+  the leader's ``/api/v1/replication:*`` REST surface (snapshot
+  bootstrap for pre-log rows, then incremental log pulls), applies ops
+  into its OWN backend, answers lag/health probes, and — when the last
+  fresh lease it saw has aged past expiry — promotes itself with
+  ``term+1``.  After promotion it rejects ops from any lower term
+  (``StaleTermError``), which is what makes a partitioned old leader's
+  history unshippable.
+
+Every network/commit edge here is a DF004 chaos seam
+(``state.replicate.*`` / ``manager.lease.*``) and every write path is
+inventoried in ``records/state_contracts.py`` (the ``replicators``
+section covers the dynamic-namespace apply sites) so the DF014 static
+pass and the runtime crash witness gate this subsystem like any other.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import logging
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional, Set
+
+from ..utils import faultinject
+from .state import KVTable, StateBackend
+
+logger = logging.getLogger(__name__)
+
+# Namespaces reserved for the replication machinery itself: never
+# shipped in snapshots, never re-replicated.
+REPLICATION_NAMESPACES = ("replication_log", "replication_meta")
+
+# How many lease intervals of silence a follower tolerates beyond the
+# advertised expiry before taking over (absorbs one lost poll).
+DEFAULT_TAKEOVER_GRACE = 0.5
+
+
+class NotLeaderError(RuntimeError):
+    """Write rejected: this node is a standby or its lease expired."""
+
+
+class StaleTermError(NotLeaderError):
+    """Op or write carries a term older than one already observed —
+    the sender is a fenced zombie leader."""
+
+
+def sign_lease(secret: str, leader_id: str, term: int) -> str:
+    """HMAC-SHA256 over the lease identity.  The signature authenticates
+    WHO holds WHICH term (a forged lease cannot defer a follower);
+    freshness is the transport's job — ``expires_in_s`` is relative to
+    the fetch that returned it, so no cross-host clock is compared."""
+    msg = f"{leader_id}:{term}".encode()
+    return hmac.new(secret.encode(), msg, hashlib.sha256).hexdigest()
+
+
+def verify_lease(secret: str, lease: dict) -> bool:
+    try:
+        want = sign_lease(secret, str(lease["leader_id"]), int(lease["term"]))
+        return hmac.compare_digest(want, str(lease.get("sig", "")))
+    except (KeyError, TypeError, ValueError):
+        return False
+
+
+class ReplicationLog:
+    """The durable op log + term/applied watermark, riding two reserved
+    namespaces of the inner backend.
+
+    ``append`` is the write-ahead half of every replicated commit; the
+    applied watermark is flushed lazily (every ``APPLIED_FLUSH_EVERY``
+    ops and at ``flush``) because replaying an already-applied absolute
+    op at boot is a no-op — lag in the watermark costs replay work,
+    never correctness.
+
+    Locking: this object is owned by ONE ``ReplicatedStateBackend`` and
+    every mutator runs under that backend's ``_mu`` (log order must BE
+    commit order, so a separate log lock could only reorder or
+    deadlock); ``seq``/``term``/``applied`` are single int reads (GIL
+    atomic) safe for health probes.
+    """
+
+    APPLIED_FLUSH_EVERY = 64
+
+    def __init__(self, backend: StateBackend) -> None:
+        self._log = backend.table("replication_log")
+        self._meta = backend.table("replication_meta")
+        rows = self._log.load_all()
+        self._seq = max((int(k) for k in rows), default=0)
+        state = self._meta.load_all().get("state") or {}
+        self._term = int(state.get("term", 1))
+        self._applied = int(state.get("applied", 0))
+        self._unflushed = 0
+
+    @staticmethod
+    def _key(seq: int) -> str:
+        return f"{seq:020d}"
+
+    def append(self, entry: dict) -> int:
+        """Assign the next seq and durably append ``entry`` (must carry
+        ``term``/``ns``/``op`` + payload).  Returns the assigned seq."""
+        self._seq += 1
+        entry = dict(entry, seq=self._seq)
+        self._log.put(self._key(self._seq), entry)
+        return self._seq
+
+    def append_at(self, entry: dict) -> None:
+        """Follower-side copy of a leader-assigned entry (keeps this
+        node's log shippable to a cascading follower after promotion)."""
+        seq = int(entry["seq"])
+        self._log.put(self._key(seq), entry)
+        if seq > self._seq:
+            self._seq = seq
+
+    def mark_applied(self, seq: int) -> None:
+        if seq > self._applied:
+            self._applied = seq
+        self._unflushed += 1
+        if self._unflushed >= self.APPLIED_FLUSH_EVERY:
+            self.flush()
+
+    def set_term(self, term: int) -> None:
+        self._term = int(term)
+        self.flush()
+
+    def flush(self) -> None:
+        self._meta.put(
+            "state", {"term": self._term, "applied": self._applied}
+        )
+        self._unflushed = 0
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    @property
+    def term(self) -> int:
+        return self._term
+
+    @property
+    def applied(self) -> int:
+        return self._applied
+
+    def entries_since(self, from_seq: int, limit: int = 500) -> List[dict]:
+        """Entries with seq > ``from_seq``, ascending, at most ``limit``.
+        Full-table scan per call — the log is an embedded test/deploy
+        scale structure, not a WAN-scale stream."""
+        rows = self._log.load_all()
+        out = [e for k, e in rows.items() if int(k) > from_seq]
+        out.sort(key=lambda e: int(e["seq"]))
+        return out[:limit]
+
+    def pending(self) -> List[dict]:
+        """The unapplied tail (crash between log append and data
+        commit): replayed idempotently at boot."""
+        return self.entries_since(self.applied)
+
+
+class ReplicatedStateBackend(StateBackend):
+    """StateBackend wrapper that write-ahead-logs every mutation and
+    enforces leader/lease/term fencing at the commit point.
+
+    Reads always pass through.  Writes require a live leader role
+    unless issued inside :meth:`applying` (the follower's apply path
+    and standby boot-time reconciliation)."""
+
+    def __init__(
+        self,
+        inner: StateBackend,
+        *,
+        node_id: str = "manager",
+        role: str = "leader",
+        lease_ttl_s: float = 10.0,
+        lease_secret: str = "dragonfly-manager-lease",
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if role not in ("leader", "standby"):
+            raise ValueError(f"unknown replication role {role!r}")
+        self._inner = inner
+        self.node_id = node_id
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.lease_secret = lease_secret
+        self._clock = clock
+        self._mu = threading.RLock()
+        self._local = threading.local()
+        self.log = ReplicationLog(inner)
+        self._role = role
+        self._term = self.log.term
+        self._lease_expires_at: Optional[float] = None
+        self.failovers = 0
+        if role == "leader":
+            self._lease_expires_at = self._clock() + self.lease_ttl_s
+            self._replay_pending()
+        self._set_role_metric()
+
+    # -- role / lease ---------------------------------------------------
+
+    def _set_role_metric(self) -> None:
+        from ..rpc.metrics import MANAGER_ROLE
+
+        for role in ("leader", "standby"):
+            MANAGER_ROLE.set(1.0 if role == self._role else 0.0, role=role)
+
+    @property
+    def role(self) -> str:
+        with self._mu:
+            return self._role
+
+    @property
+    def term(self) -> int:
+        with self._mu:
+            return self._term
+
+    def renew_lease(self) -> dict:
+        """Extend this leader's lease by one TTL; raises if no longer
+        leader (a fenced node cannot resurrect itself by renewing)."""
+        faultinject.fire(f"manager.lease.{'renew'}")
+        with self._mu:
+            if self._role != "leader":
+                raise NotLeaderError(
+                    f"{self.node_id}: cannot renew lease in role {self._role}"
+                )
+            self._lease_expires_at = self._clock() + self.lease_ttl_s
+            return self._lease_payload_locked()
+
+    def _lease_payload_locked(self) -> dict:
+        expires_in = 0.0
+        if self._lease_expires_at is not None:
+            expires_in = max(self._lease_expires_at - self._clock(), 0.0)
+        return {
+            "leader_id": self.node_id,
+            "term": self._term,
+            "ttl_s": self.lease_ttl_s,
+            "expires_in_s": expires_in,
+            "sig": sign_lease(self.lease_secret, self.node_id, self._term),
+        }
+
+    def lease_payload(self) -> dict:
+        with self._mu:
+            return self._lease_payload_locked()
+
+    def promote(self, term: Optional[int] = None) -> int:
+        """Standby → leader at ``term`` (default: observed term + 1).
+        Replays any unapplied log tail, persists the new term, and
+        starts a fresh lease."""
+        faultinject.fire(f"manager.lease.{'promote'}")
+        with self._mu:
+            new_term = int(term) if term is not None else self._term + 1
+            if new_term <= self._term and self._role == "leader":
+                return self._term
+            if new_term < self._term:
+                raise StaleTermError(
+                    f"promotion to term {new_term} below observed {self._term}"
+                )
+            self._term = new_term
+            self._role = "leader"
+            self._lease_expires_at = self._clock() + self.lease_ttl_s
+            self.log.set_term(new_term)
+            self.failovers += 1
+            self._replay_pending_locked()
+            self._set_role_metric()
+        from ..rpc.metrics import MANAGER_FAILOVERS_TOTAL
+
+        MANAGER_FAILOVERS_TOTAL.inc(node=self.node_id)
+        logger.warning(
+            "%s: promoted to leader (term %d)", self.node_id, new_term
+        )
+        return new_term
+
+    def step_down(self) -> None:
+        """Leader → standby (tests / graceful handover)."""
+        with self._mu:
+            self._role = "standby"
+            self._lease_expires_at = None
+            self._set_role_metric()
+
+    def observe_term(self, term: int) -> None:
+        """Fence: once a higher term is seen, this node can never commit
+        under its old term again."""
+        with self._mu:
+            if term > self._term:
+                if self._role == "leader":
+                    logger.warning(
+                        "%s: fenced by term %d (was leader at term %d)",
+                        self.node_id, term, self._term,
+                    )
+                self._term = term
+                self._role = "standby"
+                self._lease_expires_at = None
+                self.log.set_term(term)
+                self._set_role_metric()
+
+    # -- the write gate -------------------------------------------------
+
+    def applying(self) -> "_Applying":
+        """``with backend.applying(): ...`` — writes inside the block
+        bypass the leader gate (the follower's apply path and standby
+        boot-time reconciliation write replicated/derived state, not
+        new client mutations)."""
+        return _Applying(self)
+
+    def _is_applying(self) -> bool:
+        return getattr(self._local, "apply_depth", 0) > 0
+
+    def _check_writable_locked(self) -> None:
+        faultinject.fire(f"manager.lease.{'check'}")
+        if self._role != "leader":
+            raise NotLeaderError(
+                f"{self.node_id}: standby (term {self._term}) rejects writes"
+            )
+        if (
+            self._lease_expires_at is not None
+            and self._clock() >= self._lease_expires_at
+        ):
+            raise NotLeaderError(
+                f"{self.node_id}: lease expired at term {self._term} — "
+                "a successor may hold a higher term; refusing to commit"
+            )
+
+    def _commit_op(
+        self, ns: str, op: str, payload: dict, fn: Callable[[], None]
+    ) -> None:
+        """Write-ahead append (term+seq) then the data commit, under one
+        lock so the log order IS the commit order."""
+        faultinject.fire(f"state.replicate.{op}")
+        if self._is_applying():
+            fn()
+            return
+        with self._mu:
+            self._check_writable_locked()
+            entry = dict(payload, term=self._term, ns=ns, op=op)
+            seq = self.log.append(entry)
+            fn()
+            self.log.mark_applied(seq)
+
+    # -- follower application ------------------------------------------
+
+    def _apply_entry_locked(self, entry: dict) -> None:
+        table = self._inner.table(entry["ns"])
+        if entry["op"] == "delete":
+            table.delete(entry["key"])
+        else:
+            table.put_many(dict(entry["items"]))
+
+    def _replay_pending_locked(self) -> None:
+        replayed = 0
+        for entry in self.log.pending():
+            self._apply_entry_locked(entry)
+            self.log.mark_applied(int(entry["seq"]))
+            replayed += 1
+        if replayed:
+            self.log.flush()
+            logger.info(
+                "%s: replayed %d unapplied log entries at boot",
+                self.node_id, replayed,
+            )
+
+    def _replay_pending(self) -> None:
+        with self._mu:
+            self._replay_pending_locked()
+
+    def apply_ops(self, entries: List[dict]) -> Set[str]:
+        """Apply leader-shipped entries in seq order; returns the set of
+        touched namespaces.  Rejects any entry from a term below this
+        node's (the zombie fence) and skips already-applied seqs."""
+        faultinject.fire(f"state.replicate.{'apply'}")
+        touched: Set[str] = set()
+        with self._mu:
+            for entry in sorted(entries, key=lambda e: int(e["seq"])):
+                term = int(entry.get("term", 0))
+                if term < self._term:
+                    raise StaleTermError(
+                        f"op seq={entry.get('seq')} term={term} below "
+                        f"observed term {self._term} — rejecting zombie write"
+                    )
+                seq = int(entry["seq"])
+                if seq <= self.log.applied:
+                    continue
+                self._apply_entry_locked(entry)
+                self.log.append_at(entry)
+                self.log.mark_applied(seq)
+                touched.add(entry["ns"])
+        return touched
+
+    # -- snapshot bootstrap ---------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Consistent full-state snapshot for follower bootstrap: every
+        data namespace's rows + the (term, seq) frontier, assembled
+        under the commit lock so no append interleaves."""
+        faultinject.fire(f"state.replicate.{'snapshot'}")
+        with self._mu:
+            namespaces = {}
+            for ns in self._inner.namespaces():
+                if ns in REPLICATION_NAMESPACES:
+                    continue
+                namespaces[ns] = self._inner.table(ns).load_all()
+            return {
+                "term": self._term,
+                "seq": self.log.seq,
+                "namespaces": namespaces,
+            }
+
+    def apply_snapshot(self, snapshot: dict) -> Set[str]:
+        """Replace local data state with the leader's snapshot (rows
+        absent from the snapshot are deleted — a leader-side delete must
+        not survive locally), and fast-forward the applied watermark to
+        the snapshot frontier."""
+        faultinject.fire(f"state.replicate.{'snapshot'}")
+        incoming = snapshot.get("namespaces", {})
+        touched: Set[str] = set()
+        with self._mu:
+            self.observe_term(int(snapshot.get("term", self._term)))
+            locals_ = set(self._inner.namespaces()) - set(
+                REPLICATION_NAMESPACES
+            )
+            for ns in sorted(locals_ | set(incoming)):
+                table = self._inner.table(ns)
+                rows = incoming.get(ns, {})
+                stale = set(table.load_all()) - set(rows)
+                for key in stale:
+                    table.delete(key)
+                if rows:
+                    table.put_many(dict(rows))
+                touched.add(ns)
+            seq = int(snapshot.get("seq", 0))
+            if seq > self.log.applied:
+                self.log.mark_applied(seq)
+            self.log.flush()
+        return touched
+
+    def status(self) -> dict:
+        with self._mu:
+            return {
+                "node_id": self.node_id,
+                "role": self._role,
+                "term": self._term,
+                "seq": self.log.seq,
+                "applied_seq": self.log.applied,
+                "failovers": self.failovers,
+            }
+
+    # -- StateBackend surface -------------------------------------------
+
+    def table(self, namespace: str) -> KVTable:
+        return _ReplicatedTable(self, namespace)
+
+    def namespaces(self) -> List[str]:
+        return self._inner.namespaces()
+
+    def close(self) -> None:
+        with self._mu:
+            self.log.flush()
+        self._inner.close()
+
+
+class _Applying:
+    """Thread-local re-entrant apply scope (see
+    :meth:`ReplicatedStateBackend.applying`)."""
+
+    def __init__(self, backend: "ReplicatedStateBackend") -> None:
+        self._b = backend
+
+    def __enter__(self) -> "ReplicatedStateBackend":
+        local = self._b._local
+        local.apply_depth = getattr(local, "apply_depth", 0) + 1
+        return self._b
+
+    def __exit__(self, *exc) -> None:
+        self._b._local.apply_depth -= 1
+
+
+class _ReplicatedTable(KVTable):
+    """One namespace viewed through the replication gate."""
+
+    def __init__(self, backend: ReplicatedStateBackend, ns: str) -> None:
+        self._b = backend
+        self._ns = ns
+        self._table = backend._inner.table(ns)
+
+    def put(self, key: str, doc: dict) -> None:
+        self._b._commit_op(
+            self._ns, "put_many", {"items": {key: doc}},
+            lambda: self._table.put(key, doc),
+        )
+
+    def put_many(self, items: Dict[str, dict]) -> None:
+        self._b._commit_op(
+            self._ns, "put_many", {"items": dict(items)},
+            lambda: self._table.put_many(items),
+        )
+
+    def delete(self, key: str) -> None:
+        self._b._commit_op(
+            self._ns, "delete", {"key": key},
+            lambda: self._table.delete(key),
+        )
+
+    def get(self, key: str) -> Optional[dict]:
+        return self._table.get(key)
+
+    def load_all(self) -> Dict[str, dict]:
+        return self._table.load_all()
+
+
+class LeaseKeeper:
+    """Leader-side lease renewal loop (ttl/3 cadence, so two missed
+    renewals still leave headroom before followers take over)."""
+
+    def __init__(self, backend: ReplicatedStateBackend) -> None:
+        self._b = backend
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def serve(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop() -> None:
+            while not self._stop.wait(self._b.lease_ttl_s / 3.0):
+                try:
+                    self._b.renew_lease()
+                except NotLeaderError:
+                    logger.warning("lease keeper: no longer leader; stopping")
+                    return
+                except Exception:  # noqa: BLE001 — renewal loop is forever
+                    logger.exception("lease renewal failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="manager-lease-keeper", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+class LogFollower:
+    """Standby-side tailer: snapshot bootstrap, incremental log pulls,
+    lease watching, and lease-expiry takeover.
+
+    ``on_apply(namespaces)`` fires after each batch that changed data
+    namespaces (the standby composition rebuilds its in-memory
+    consumers); ``on_promote()`` fires once after takeover."""
+
+    def __init__(
+        self,
+        backend: ReplicatedStateBackend,
+        leader_url: str,
+        *,
+        poll_interval_s: float = 1.0,
+        timeout: float = 10.0,
+        takeover_grace: float = DEFAULT_TAKEOVER_GRACE,
+        on_apply: Optional[Callable[[Set[str]], None]] = None,
+        on_promote: Optional[Callable[[], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.backend = backend
+        self.leader_url = leader_url.rstrip("/")
+        self.poll_interval_s = poll_interval_s
+        self.timeout = timeout
+        self.takeover_grace = takeover_grace
+        self.on_apply = on_apply
+        self.on_promote = on_promote
+        self._clock = clock
+        self._mu = threading.Lock()
+        # Until the first fresh lease arrives, grant the leader one full
+        # TTL of benefit-of-the-doubt from follower boot.
+        self._lease_deadline = clock() + backend.lease_ttl_s * (
+            1.0 + takeover_grace
+        )
+        self._bootstrapped = False
+        self._last_caught_up = clock()
+        self._leader_seq = 0
+        self.promoted = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- wire -----------------------------------------------------------
+
+    def _get_json(self, path: str) -> dict:
+        faultinject.fire(f"state.replicate.{'fetch'}")
+        with urllib.request.urlopen(
+            self.leader_url + path, timeout=self.timeout
+        ) as resp:
+            return json.loads(resp.read())
+
+    # -- one poll -------------------------------------------------------
+
+    def poll_once(self) -> int:
+        """Fetch leader status + new log entries, apply them, track the
+        lease.  Returns the number of entries applied; raises nothing —
+        an unreachable leader just lets the lease age toward takeover."""
+        if self.promoted:
+            return 0
+        try:
+            status = self._get_json("/api/v1/replication:status")
+        except Exception as exc:  # noqa: BLE001 — outage ages the lease
+            logger.debug("follower poll: leader unreachable: %s", exc)
+            self._maybe_promote()
+            return 0
+        lease = status.get("lease") or {}
+        now = self._clock()
+        if verify_lease(self.backend.lease_secret, lease):
+            term = int(lease.get("term", 0))
+            self.backend.observe_term(term)
+            expires_in = float(lease.get("expires_in_s", 0.0))
+            ttl = float(lease.get("ttl_s", self.backend.lease_ttl_s))
+            with self._mu:
+                self._lease_deadline = now + expires_in + ttl * self.takeover_grace
+        applied = 0
+        try:
+            self._leader_seq = int(status.get("seq", 0))
+            if not self._bootstrapped:
+                snap = self._get_json("/api/v1/replication:snapshot")
+                touched = self.backend.apply_snapshot(snap)
+                self._bootstrapped = True
+                if touched and self.on_apply is not None:
+                    self.on_apply(touched)
+            while self.backend.log.applied < self._leader_seq:
+                batch = self._get_json(
+                    "/api/v1/replication:log?from_seq="
+                    f"{self.backend.log.applied}"
+                ).get("entries", [])
+                if not batch:
+                    break
+                touched = self.backend.apply_ops(batch)
+                applied += len(batch)
+                if touched and self.on_apply is not None:
+                    self.on_apply(touched)
+        except StaleTermError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — retry next poll
+            logger.warning("follower poll: log pull failed: %s", exc)
+        if self.backend.log.applied >= self._leader_seq:
+            with self._mu:
+                self._last_caught_up = self._clock()
+        self._export_lag()
+        return applied
+
+    def _export_lag(self) -> None:
+        from ..rpc.metrics import REPLICATION_LAG
+
+        REPLICATION_LAG.set(self.lag_seconds())
+
+    def lag_seconds(self) -> float:
+        """Seconds since this follower last matched the leader's log
+        frontier (≈0 while caught up; grows through an outage)."""
+        with self._mu:
+            if self.backend.log.applied >= self._leader_seq:
+                return 0.0
+            return max(self._clock() - self._last_caught_up, 0.0)
+
+    def health(self) -> dict:
+        with self._mu:
+            lease_remaining = self._lease_deadline - self._clock()
+        return {
+            "role": self.backend.role,
+            "term": self.backend.term,
+            "applied_seq": self.backend.log.applied,
+            "leader_seq": self._leader_seq,
+            "lag_seconds": self.lag_seconds(),
+            "lease_remaining_s": lease_remaining,
+            "promoted": self.promoted,
+        }
+
+    def _maybe_promote(self) -> bool:
+        with self._mu:
+            expired = self._clock() >= self._lease_deadline
+        if not expired or self.promoted:
+            return self.promoted
+        self.backend.promote()
+        self.promoted = True
+        if self.on_promote is not None:
+            self.on_promote()
+        return True
+
+    # -- background serve ----------------------------------------------
+
+    def serve(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop() -> None:
+            while not self._stop.wait(self.poll_interval_s):
+                try:
+                    if self.poll_once() == 0:
+                        self._maybe_promote()
+                    if self.promoted:
+                        return
+                except Exception:  # noqa: BLE001 — the tail loop is forever
+                    logger.exception("follower poll failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="manager-log-follower", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

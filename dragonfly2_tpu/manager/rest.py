@@ -127,9 +127,15 @@ class ManagerRESTServer:
         state_backend=None,
         jobs_min_requeue_s: float = 30.0,
         rollout=None,
+        ha=None,
     ):
         self.registry = registry
         self.clusters = clusters
+        # Replication role holder (manager/replication.py
+        # ReplicatedStateBackend): serves /api/v1/replication:* and, in
+        # the standby role, 503+Retry-After's every write until
+        # promotion (clients fail over via rpc/resolver.ManagerEndpoints).
+        self.ha = ha
         # Rollout controller (rollout/controller.py): serves the
         # candidate poll + evaluation-report routes; None → 404s.
         self.rollout = rollout
@@ -143,8 +149,11 @@ class ManagerRESTServer:
         # CRUD resources (applications + scheduler-cluster records whose
         # config blobs feed the schedulers' dynconfig).  The default
         # cluster always exists — dynconfig consumers need one to poll.
+        # A STANDBY never seeds it: the row replicates from the leader
+        # (writes are gated until promotion).
         self.crud = crud or CrudStore()
-        self.crud.ensure_default_cluster()
+        if ha is None or ha.role == "leader":
+            self.crud.ensure_default_cluster()
         # Optional ObjectStorageBackend the bucket routes proxy to
         # (manager/handlers/bucket.go semantics); None → 404s.
         self.objectstorage = objectstorage
@@ -194,13 +203,33 @@ class ManagerRESTServer:
             def log_message(self, *args):
                 pass
 
-            def _json(self, code: int, payload) -> None:
+            def _json(self, code: int, payload, headers=None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _standby_rejected(self) -> bool:
+                """Standby role: every mutation 503s with Retry-After
+                until promotion — a client that cannot fail over knows
+                exactly when to knock again (one follower poll)."""
+                ha = server.ha
+                if ha is None or ha.role == "leader":
+                    return False
+                self._json(
+                    503,
+                    {
+                        "error": "manager is a standby replica "
+                        f"(term {ha.term}); writes go to the leader",
+                        "role": ha.role,
+                    },
+                    headers={"Retry-After": "1"},
+                )
+                return True
 
             def _rate_limited(self) -> bool:
                 # Liveness-class routes stay exempt: the limiter must not
@@ -236,7 +265,46 @@ class ManagerRESTServer:
                     self.end_headers()
                     self.wfile.write(body)
                 elif path == "/api/v1/healthy":
-                    self._json(200, {"ok": True})
+                    payload = {"ok": True}
+                    if server.ha is not None:
+                        payload["role"] = server.ha.role
+                        payload["term"] = server.ha.term
+                    self._json(200, payload)
+                elif path == "/api/v1/replication:status":
+                    # Follower poll target: log frontier + the signed
+                    # lease (manager/replication.py LogFollower).
+                    if server.ha is None:
+                        self._json(404, {"error": "replication not configured"})
+                    else:
+                        status = server.ha.status()
+                        if server.ha.role == "leader":
+                            status["lease"] = server.ha.lease_payload()
+                        self._json(200, status)
+                elif path == "/api/v1/replication:log":
+                    if server.ha is None:
+                        self._json(404, {"error": "replication not configured"})
+                    else:
+                        try:
+                            from_seq = int(q.get("from_seq", 0))
+                            limit = min(int(q.get("limit", 500)), 2000)
+                        except ValueError as exc:
+                            self._json(400, {"error": str(exc)})
+                            return
+                        self._json(200, {
+                            "entries": server.ha.log.entries_since(
+                                from_seq, limit
+                            ),
+                            "seq": server.ha.log.seq,
+                            "term": server.ha.term,
+                        })
+                elif path == "/api/v1/replication:snapshot":
+                    # Follower bootstrap: full data-state snapshot for
+                    # rows that predate the log (legacy migrations,
+                    # pre-HA deployments).
+                    if server.ha is None:
+                        self._json(404, {"error": "replication not configured"})
+                    else:
+                        self._json(200, server.ha.snapshot())
                 elif path == "/api/v1/certs:ca":
                     # Trust-root fetch (open read: peers need the root
                     # BEFORE they can build a verified TLS context).
@@ -403,7 +471,15 @@ class ManagerRESTServer:
                         for sid in dead:
                             del server.topology_shared[sid]
                             if server._topology_table is not None:
-                                server._topology_table.delete(sid)
+                                from .replication import NotLeaderError
+
+                                try:
+                                    server._topology_table.delete(sid)
+                                except NotLeaderError:
+                                    # Standby: evict from memory only —
+                                    # the leader's replicated delete is
+                                    # the durable one.
+                                    pass
                         edges = [
                             e
                             for sid, entry in server.topology_shared.items()
@@ -491,6 +567,8 @@ class ManagerRESTServer:
 
             def do_POST(self):
                 if self._rate_limited():
+                    return
+                if self._standby_rejected():
                     return
                 path = urllib.parse.urlsplit(self.path).path
                 if (

@@ -28,7 +28,7 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..utils import faultinject
 
@@ -57,6 +57,19 @@ class KVTable:
 class StateBackend:
     def table(self, namespace: str) -> KVTable:
         raise NotImplementedError
+
+    def namespaces(self) -> List[str]:
+        """Every namespace holding rows — the replication layer's
+        snapshot enumeration (manager/replication.py)."""
+        raise NotImplementedError
+
+    def put_namespaces(self, staged: Dict[str, Dict[str, dict]]) -> None:
+        """Commit rows across namespaces; the base form is per-table
+        transactions, SQLite overrides with ONE transaction so a crash
+        mid-migration leaves nothing (migrate_legacy_sqlite's contract)."""
+        for ns, rows in staged.items():
+            if rows:
+                self.table(ns).put_many(rows)
 
     def close(self) -> None:  # pragma: no cover - trivial default
         pass
@@ -111,6 +124,10 @@ class MemoryBackend(StateBackend):
             if namespace not in self._tables:
                 self._tables[namespace] = _MemTable(namespace)
             return self._tables[namespace]
+
+    def namespaces(self) -> List[str]:
+        with self._mu:
+            return sorted(self._tables)
 
 
 # ---------------------------------------------------------------------------
@@ -168,16 +185,21 @@ class SQLiteBackend(StateBackend):
     everything from the same place, and swapping the HA backend swaps
     everything at once rather than chasing five files."""
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, *, busy_timeout_ms: int = 5000) -> None:
         import sqlite3
 
         os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._mu = threading.Lock()
+        self._closed = False
         with self._mu:
             # WAL: a reader (console listing jobs) must not block the
             # write path, and fsync'd commits survive SIGKILL.
             self._conn.execute("PRAGMA journal_mode=WAL")
+            # A second connection on the same file (a replication-role
+            # sidecar, an ops shell) must wait out a writer's commit,
+            # not throw "database is locked" into the manager hot path.
+            self._conn.execute(f"PRAGMA busy_timeout={int(busy_timeout_ms)}")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS kv ("
                 "ns TEXT NOT NULL, key TEXT NOT NULL, value TEXT NOT NULL, "
@@ -188,8 +210,36 @@ class SQLiteBackend(StateBackend):
     def table(self, namespace: str) -> KVTable:
         return _SQLiteTable(self, namespace)
 
-    def close(self) -> None:
+    def namespaces(self) -> List[str]:
         with self._mu:
+            rows = self._conn.execute("SELECT DISTINCT ns FROM kv").fetchall()
+        return sorted(r[0] for r in rows)
+
+    def put_namespaces(self, staged: Dict[str, Dict[str, dict]]) -> None:
+        """All namespaces' rows in ONE transaction: a crash mid-way
+        commits nothing — a partial legacy migration must never pass
+        the crash witness as a complete one."""
+        for ns in staged:
+            faultinject.fire(f"state.put.{ns}")
+        rows = [
+            (ns, k, json.dumps(v))
+            for ns, docs in staged.items()
+            for k, v in docs.items()
+        ]
+        with self._mu:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO kv (ns, key, value) VALUES (?,?,?)",
+                rows,
+            )
+            self._conn.commit()
+
+    def close(self) -> None:
+        # Idempotent: the replication role shares one backend between
+        # the REST composition and the follower; both shut it down.
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
             self._conn.close()
 
 
@@ -214,7 +264,13 @@ def migrate_legacy_sqlite(
     boot; a namespace that already has rows is never touched, so this is
     idempotent and a no-op on fresh or already-migrated deployments.
     Legacy files are left in place (read-only safety net).  Returns
-    per-namespace imported-row counts."""
+    per-namespace imported-row counts.
+
+    Every namespace's rows land in ONE transaction
+    (``StateBackend.put_namespaces``; SQLite commits them atomically):
+    a crash mid-migration must leave the backend looking unmigrated —
+    the next boot re-imports — never half-imported, which would make the
+    already-has-rows idempotency check skip the missing half forever."""
     import base64
     import sqlite3
 
@@ -230,17 +286,16 @@ def migrate_legacy_sqlite(
         except sqlite3.Error:
             return []  # no such table / not a legacy layout
 
-    counts: Dict[str, int] = {}
+    staged: Dict[str, Dict[str, dict]] = {}
 
-    t = backend.table("models")
-    if not t.load_all():
+    if not backend.table("models").load_all():
         found = rows(
             models_db,
             "SELECT id,name,type,version,scheduler_id,state,evaluation,"
             "blob_key,created_at,updated_at FROM models",
         )
         if found:
-            t.put_many({
+            staged["models"] = {
                 r[0]: {
                     "id": r[0], "name": r[1], "type": r[2], "version": r[3],
                     "scheduler_id": r[4], "state": r[5],
@@ -248,28 +303,24 @@ def migrate_legacy_sqlite(
                     "created_at": r[8], "updated_at": r[9],
                 }
                 for r in found
-            })
-            counts["models"] = len(found)
+            }
 
-    t = backend.table("crud")
-    if not t.load_all():
+    if not backend.table("crud").load_all():
         found = rows(crud_db, "SELECT kind,id,value FROM crud_rows")
         if found:
-            t.put_many({
+            staged["crud"] = {
                 f"{kind}:{id_}": json.loads(value)
                 for kind, id_, value in found
-            })
-            counts["crud"] = len(found)
+            }
 
-    t = backend.table("users")
-    if not t.load_all():
+    if not backend.table("users").load_all():
         found = rows(
             users_db,
             "SELECT id,name,email,role,state,password_hash,salt,created_at "
             "FROM users",
         )
         if found:
-            t.put_many({
+            staged["users"] = {
                 r[0]: {
                     "id": r[0], "name": r[1], "email": r[2],
                     "role": int(r[3]), "state": r[4],
@@ -278,18 +329,16 @@ def migrate_legacy_sqlite(
                     "created_at": r[7],
                 }
                 for r in found
-            })
-            counts["users"] = len(found)
+            }
 
-    t = backend.table("pats")
-    if not t.load_all():
+    if not backend.table("pats").load_all():
         found = rows(
             users_db,
             "SELECT id,user_id,name,role,token_hash,expires_at,revoked,"
             "created_at FROM pats",
         )
         if found:
-            t.put_many({
+            staged["pats"] = {
                 r[0]: {
                     "id": r[0], "user_id": r[1], "name": r[2],
                     "role": int(r[3]), "token_hash": r[4],
@@ -297,6 +346,8 @@ def migrate_legacy_sqlite(
                     "created_at": r[7],
                 }
                 for r in found
-            })
-            counts["pats"] = len(found)
-    return counts
+            }
+
+    if staged:
+        backend.put_namespaces(staged)
+    return {ns: len(rows_) for ns, rows_ in staged.items()}

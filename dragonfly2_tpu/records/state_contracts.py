@@ -279,6 +279,62 @@ STATE_CONTRACTS = {
                 "unlocked_ok": [],
                 "invariant": "rows_load",
             },
+            # Replicated artifact bytes (manager HA): one row per blob,
+            # riding the same log as the registry rows they back.  No
+            # lock of its own: put() is reached only from
+            # ModelRegistry.create_model under ModelRegistry._mu (the
+            # single writer), declared unlocked_ok accordingly.
+            "blobs": {
+                "owner": "dragonfly2_tpu/manager/registry.py",
+                "lock": ["dragonfly2_tpu/manager/registry.py",
+                         "ModelRegistry", "_mu"],
+                "loader": "KVBlobStore.__init__",
+                "multi_row": [],
+                "unlocked_ok": ["KVBlobStore.put"],
+                "invariant": "rows_load",
+            },
+            # Manager-HA write-ahead op log + (term, applied) watermark
+            # (manager/replication.py, DESIGN.md §20).
+            # ReplicationLog is owned by ONE ReplicatedStateBackend and
+            # every mutator runs under that backend's commit lock (log
+            # order IS commit order) — the declared lock reflects that.
+            "replication_log": {
+                "owner": "dragonfly2_tpu/manager/replication.py",
+                "lock": ["dragonfly2_tpu/manager/replication.py",
+                         "ReplicatedStateBackend", "_mu"],
+                "loader": "ReplicationLog.__init__",
+                "multi_row": [],
+                "unlocked_ok": [],
+                "invariant": "log_monotonic",
+            },
+            "replication_meta": {
+                "owner": "dragonfly2_tpu/manager/replication.py",
+                "lock": ["dragonfly2_tpu/manager/replication.py",
+                         "ReplicatedStateBackend", "_mu"],
+                "loader": "ReplicationLog.__init__",
+                "multi_row": [],
+                "unlocked_ok": [],
+                "invariant": "rows_load",
+            },
+        },
+        # Dynamic-namespace write paths: functions that legitimately
+        # write ANY declared namespace through a variable ``.table(ns)``
+        # binding — the replication layer's leader commit / follower
+        # apply / snapshot paths and the one-transaction legacy
+        # migration.  DF014 indexes their full spans as wildcard sites
+        # so the runtime crash witness can attribute their writes, and
+        # fails by name when an entry goes stale.
+        "replicators": {
+            "dragonfly2_tpu/manager/replication.py": [
+                "_ReplicatedTable.put",
+                "_ReplicatedTable.put_many",
+                "_ReplicatedTable.delete",
+                "ReplicatedStateBackend._apply_entry_locked",
+                "ReplicatedStateBackend.apply_snapshot",
+            ],
+            "dragonfly2_tpu/manager/state.py": [
+                "StateBackend.put_namespaces",
+            ],
         },
         # A crash between the two writes must leave the REFERENCING row
         # absent (recoverable), never dangling: the job row commits

@@ -64,14 +64,23 @@ class LocalRolloutClient:
 
 
 class RolloutRESTClient:
-    """The wire form (manager/rest.py rollout routes)."""
+    """The wire form (manager/rest.py rollout routes).  ``base_url``
+    accepts a replica list / shared ``ManagerEndpoints`` like
+    ``RemoteRegistry`` — candidate polls and evaluation reports fail
+    over to the surviving manager replica."""
 
     def __init__(
-        self, base_url: str, *, timeout: float = 15.0, token: Optional[str] = None
+        self, base_url, *, timeout: float = 15.0, token: Optional[str] = None
     ) -> None:
-        self.base_url = base_url.rstrip("/")
+        from ..rpc.resolver import ManagerEndpoints
+
+        self.endpoints = ManagerEndpoints.of(base_url, client="rollout")
         self.timeout = timeout
         self.token = token
+
+    @property
+    def base_url(self) -> str:
+        return self.endpoints.current()
 
     def _headers(self) -> dict:
         headers = {"Content-Type": "application/json"}
@@ -83,10 +92,10 @@ class RolloutRESTClient:
         from ..rpc.registry_client import _model_from_json
         from ..utils import faultinject
 
-        def once():
+        def one_endpoint(base: str):
             faultinject.fire("rollout.fetch")
             url = (
-                self.base_url
+                base
                 + "/api/v1/models:candidate?"
                 + urllib.parse.urlencode(
                     {"scheduler_id": scheduler_id, "name": name}
@@ -98,7 +107,12 @@ class RolloutRESTClient:
             except urllib.error.HTTPError as exc:
                 if exc.code == 404:
                     return None
+                if exc.code == 503:
+                    raise  # standby replica: endpoints.call fails over
                 raise RuntimeError(f"manager: HTTP {exc.code}") from exc
+
+        def once():
+            return self.endpoints.call(one_endpoint)
 
         data = retry_call(
             once, retry_on=(ConnectionError, TimeoutError, OSError)
@@ -114,10 +128,10 @@ class RolloutRESTClient:
     def report(self, scheduler_id: str, name: str, payload: dict) -> dict:
         from ..utils import faultinject
 
-        def once():
+        def one_endpoint(base: str):
             faultinject.fire("rollout.report")
             req = urllib.request.Request(
-                self.base_url + "/api/v1/rollouts:report",
+                base + "/api/v1/rollouts:report",
                 data=json.dumps(
                     {
                         "scheduler_id": scheduler_id,
@@ -134,7 +148,12 @@ class RolloutRESTClient:
             except urllib.error.HTTPError as exc:
                 if exc.code == 404:
                     raise KeyError(f"no rollout for {scheduler_id}:{name}") from exc
+                if exc.code == 503:
+                    raise  # standby replica: endpoints.call fails over
                 raise RuntimeError(f"manager: HTTP {exc.code}") from exc
+
+        def once():
+            return self.endpoints.call(one_endpoint)
 
         return retry_call(
             once, retry_on=(ConnectionError, TimeoutError, OSError)

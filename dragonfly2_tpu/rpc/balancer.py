@@ -60,3 +60,44 @@ class HashRing:
 
     def backends(self) -> List[str]:
         return sorted(self._backends)
+
+
+class StickyFailover:
+    """Ordered backend list with a sticky cursor: ``current()`` keeps
+    answering the last backend that worked; ``advance()`` rotates to the
+    next after a failure.  The manager-HA client policy (pkg/balancer's
+    pick-first semantics): every client in a process converges on the
+    live leader and stays there — no per-call round-robin that would
+    split one client's traffic across a leader and a 503ing standby."""
+
+    def __init__(self, backends: Sequence[str]) -> None:
+        self._backends: List[str] = [b for b in backends if b]
+        if not self._backends:
+            raise ValueError("StickyFailover needs at least one backend")
+        import threading
+
+        self._mu = threading.Lock()
+        self._idx = 0
+
+    def current(self) -> str:
+        with self._mu:
+            return self._backends[self._idx]
+
+    def advance(self, seen: Optional[str] = None) -> str:
+        """Rotate to the next backend.  With ``seen``, only rotate if
+        the cursor still points at it — concurrent failures over one
+        shared list advance once, not once per caller."""
+        with self._mu:
+            if seen is None or self._backends[self._idx] == seen:
+                self._idx = (self._idx + 1) % len(self._backends)
+            return self._backends[self._idx]
+
+    def all(self) -> List[str]:
+        """Every backend, current first (the failover try order)."""
+        with self._mu:
+            return (
+                self._backends[self._idx:] + self._backends[:self._idx]
+            )
+
+    def __len__(self) -> int:
+        return len(self._backends)

@@ -19,11 +19,13 @@ gets an immediate re-registration, whichever loop is ticking.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import urllib.error
 from typing import Optional
 
 from ..jobs.remote import RemoteJobClient
+from .retry import DecorrelatedJitterBackoff
 
 logger = logging.getLogger(__name__)
 
@@ -31,15 +33,27 @@ logger = logging.getLogger(__name__)
 class RemoteClusterClient:
     def __init__(
         self,
-        manager_url: str,
+        manager_url,
         *,
         token: Optional[str] = None,
         timeout: float = 10.0,
         keepalive_interval_s: float = 20.0,  # < manager TTL (60 s)
+        backoff_rng: Optional[random.Random] = None,
     ) -> None:
-        # One shared bearer-authed JSON wrapper with the job wire.
+        # One shared bearer-authed JSON wrapper with the job wire —
+        # manager_url may be a replica list / shared ManagerEndpoints
+        # (rpc/resolver), so keepalives fail over with everything else.
         self._http = RemoteJobClient(manager_url, token=token, timeout=timeout)
         self.keepalive_interval_s = keepalive_interval_s
+        # Failed keepalives back off with capped decorrelated jitter: a
+        # manager bounce must not get the whole fleet's keepalives back
+        # in one synchronized wave (thundering herd).  The RNG is
+        # injectable for reproducible schedules in tests.
+        self._backoff = DecorrelatedJitterBackoff(
+            base=min(2.0, keepalive_interval_s),
+            cap=max(keepalive_interval_s * 3.0, 2.0),
+            rng=backoff_rng,
+        )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._registration: Optional[dict] = None
@@ -109,15 +123,23 @@ class RemoteClusterClient:
 
     def serve(self) -> None:
         """Standalone keepalive loop — for compositions with no Announcer
-        (the Announcer runs the identical tick itself when present)."""
+        (the Announcer runs the identical tick itself when present).
+        Failed ticks wait a decorrelated-jitter backoff instead of the
+        fixed interval; a success resets to the normal cadence."""
         if self._thread is not None:
             return
 
         def loop() -> None:
-            while not self._stop.wait(self.keepalive_interval_s):
+            wait = self.keepalive_interval_s
+            while not self._stop.wait(wait):
                 reg = self._registration
-                if reg is not None:
-                    self.keepalive(reg["id"])
+                if reg is None:
+                    wait = self.keepalive_interval_s
+                elif self.keepalive(reg["id"]):
+                    self._backoff.reset()
+                    wait = self.keepalive_interval_s
+                else:
+                    wait = self._backoff.next()
 
         self._thread = threading.Thread(
             target=loop, name="cluster-keepalive", daemon=True

@@ -27,3 +27,28 @@ DAEMON_CONTROL_DOWNLOADS = _reg.counter(
     "daemon_control_downloads_total", "Downloads via the control API",
     ["result"],
 )
+# -- manager HA plane (manager/replication.py, DESIGN.md §20) ---------------
+MANAGER_ROLE = _reg.gauge(
+    "manager_role",
+    "1 for this process's current replication role, 0 otherwise",
+    ["role"],
+)
+REPLICATION_LAG = _reg.gauge(
+    "manager_replication_lag_seconds",
+    "Seconds since this follower last matched the leader's log frontier",
+)
+MANAGER_FAILOVERS_TOTAL = _reg.counter(
+    "manager_failovers_total",
+    "Standby-to-leader promotions performed by this process",
+    ["node"],
+)
+MANAGER_ENDPOINT_FAILOVERS_TOTAL = _reg.counter(
+    "manager_endpoint_failovers_total",
+    "Client-side manager endpoint rotations after a failed call",
+    ["client"],
+)
+CIRCUIT_BREAKER_STATE = _reg.gauge(
+    "rpc_circuit_breaker_state",
+    "Per-target breaker state: 0 closed, 1 half_open, 2 open",
+    ["target"],
+)

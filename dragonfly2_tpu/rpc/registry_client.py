@@ -35,14 +35,26 @@ def _model_from_json(data: dict) -> Model:
 
 
 class RemoteRegistry:
+    """``base_url`` may be one URL, a comma-separated replica list, or a
+    shared ``ManagerEndpoints`` — model polls and artifact fetches fail
+    over to the surviving manager replica mid-flight (the HA story's
+    zero-degraded-mode contract: a subscriber poll only pins when ALL
+    replicas are down)."""
+
     def __init__(
-        self, base_url: str, *, timeout: float = 30.0, token: Optional[str] = None
+        self, base_url, *, timeout: float = 30.0, token: Optional[str] = None
     ):
-        self.base_url = base_url.rstrip("/")
+        from .resolver import ManagerEndpoints
+
+        self.endpoints = ManagerEndpoints.of(base_url, client="registry")
         self.timeout = timeout
         # Bearer token for managers running RBAC (security/tokens.py); the
         # trainer's create_model needs PEER, activation needs OPERATOR.
         self.token = token
+
+    @property
+    def base_url(self) -> str:
+        return self.endpoints.current()
 
     def _headers(self) -> dict:
         headers = {"Content-Type": "application/json"}
@@ -65,24 +77,30 @@ class RemoteRegistry:
         return RuntimeError(f"manager: HTTP {exc.code}: {message}")
 
     def _get(self, path: str, *, deadline_s: Optional[float] = None) -> Optional[dict]:
-        def once():
+        def one_endpoint(base: str):
             from ..utils import faultinject
 
             faultinject.fire("rpc.registry.get")
             try:
                 with urllib.request.urlopen(
-                    self.base_url + path, timeout=self.timeout
+                    base + path, timeout=self.timeout
                 ) as resp:
                     return json.loads(resp.read())
             except urllib.error.HTTPError as exc:
                 if exc.code == 404:
                     return None
+                if exc.code == 503:
+                    raise  # standby replica: endpoints.call fails over
                 raise self._translate(exc) from exc
+
+        def once():
+            return self.endpoints.call(one_endpoint)
 
         # HTTPError is handled inside once(); connect-refused arrives as
         # URLError (an OSError, NOT ConnectionError) — include OSError so
         # transient manager restarts actually retry (scheduler_client's
-        # pattern).
+        # pattern).  The endpoint sweep runs INSIDE each retry attempt:
+        # backoff only engages once every replica has failed.
         return retry_call(
             once,
             retry_on=(ConnectionError, TimeoutError, OSError),
@@ -92,12 +110,12 @@ class RemoteRegistry:
     def _post(
         self, path: str, payload: dict, *, deadline_s: Optional[float] = None
     ) -> dict:
-        def once():
+        def one_endpoint(base: str):
             from ..utils import faultinject
 
             faultinject.fire("rpc.registry.post")
             req = urllib.request.Request(
-                self.base_url + path,
+                base + path,
                 data=json.dumps(payload).encode(),
                 headers=self._headers(),
                 method="POST",
@@ -106,7 +124,12 @@ class RemoteRegistry:
                 with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                     return json.loads(resp.read())
             except urllib.error.HTTPError as exc:
+                if exc.code == 503:
+                    raise  # standby replica: endpoints.call fails over
                 raise self._translate(exc) from exc
+
+        def once():
+            return self.endpoints.call(one_endpoint)
 
         return retry_call(
             once,

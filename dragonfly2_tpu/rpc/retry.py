@@ -35,6 +35,45 @@ class CircuitOpenError(ConnectionError):
     """Fast-fail: the breaker is OPEN for this dependency."""
 
 
+class DecorrelatedJitterBackoff:
+    """AWS-style decorrelated jitter: each delay is
+    ``uniform(base, min(cap, prev * 3))`` — successive failures spread a
+    fleet out instead of re-synchronizing it (the thundering-herd
+    failure mode of fixed-interval retry loops after a manager bounce).
+
+    ``rng`` is injectable, so a seeded ``random.Random`` makes the whole
+    schedule reproducible per instance while staying decorrelated across
+    a fleet seeded differently (the ModelSubscriber jitter discipline).
+    ``reset()`` after a success returns the next failure to ``base``.
+    """
+
+    def __init__(
+        self,
+        *,
+        base: float = 1.0,
+        cap: float = 60.0,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        if base <= 0 or cap < base:
+            raise ValueError(f"need 0 < base <= cap, got {base}/{cap}")
+        self.base = base
+        self.cap = cap
+        self._rand = rng.uniform if rng is not None else random.uniform
+        self._prev = base
+
+    def next(self) -> float:
+        delay = self._rand(self.base, min(self.cap, self._prev * 3.0))
+        self._prev = delay
+        return delay
+
+    def reset(self) -> None:
+        self._prev = self.base
+
+
+# Gauge codes for rpc_circuit_breaker_state{target}.
+_BREAKER_STATE_CODES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+
 class CircuitBreaker:
     """Consecutive-failure breaker with half-open recovery.
 
@@ -42,6 +81,11 @@ class CircuitBreaker:
     fail fast until ``reset_timeout_s`` since the trip), ``half_open``
     (one probe in flight; its outcome decides).  Thread-safe; the clock
     is injectable so tests drive recovery without sleeping.
+
+    With a ``name``, every state TRANSITION (never per-call) is exported
+    on the ``rpc_circuit_breaker_state{target=...}`` gauge and logged
+    once — a failover storm's open breakers are diagnosable from
+    metrics/logs instead of invisible fast-fails.
     """
 
     def __init__(
@@ -50,14 +94,42 @@ class CircuitBreaker:
         failure_threshold: int = 5,
         reset_timeout_s: float = 2.0,
         clock: Callable[[], float] = time.monotonic,
+        name: str = "",
     ) -> None:
         self.failure_threshold = max(1, failure_threshold)
         self.reset_timeout_s = reset_timeout_s
+        self.name = name
         self._clock = clock
         self._mu = threading.Lock()
         self._failures = 0
         self._state = "closed"
         self._opened_at = 0.0
+        if name:
+            self._export("closed")
+
+    def _export(self, state: str) -> None:
+        from .metrics import CIRCUIT_BREAKER_STATE
+
+        CIRCUIT_BREAKER_STATE.set(
+            _BREAKER_STATE_CODES[state], target=self.name
+        )
+
+    def _note_transition(self, old: str, new: str) -> None:
+        """OUTSIDE the lock: one gauge write + one log line per
+        transition, not per call."""
+        if old == new or not self.name:
+            return
+        import logging
+
+        self._export(new)
+        log = logging.getLogger(__name__)
+        if new == "open":
+            log.warning(
+                "circuit breaker %s: %s -> open (failing fast for %.1fs)",
+                self.name, old, self.reset_timeout_s,
+            )
+        else:
+            log.info("circuit breaker %s: %s -> %s", self.name, old, new)
 
     @property
     def state(self) -> str:
@@ -68,30 +140,41 @@ class CircuitBreaker:
         """May a call proceed right now?  An allowed call while OPEN
         transitions to HALF_OPEN (that call is the recovery probe)."""
         with self._mu:
+            old = self._state
             if self._state == "closed":
                 return True
             if self._state == "open":
                 if self._clock() - self._opened_at >= self.reset_timeout_s:
                     self._state = "half_open"
-                    return True
-                return False
-            # half_open: one probe at a time — concurrent callers wait
-            # out the probe as if still open.
-            return False
+                    out = True
+                else:
+                    out = False
+            else:
+                # half_open: one probe at a time — concurrent callers
+                # wait out the probe as if still open.
+                out = False
+            new = self._state
+        self._note_transition(old, new)
+        return out
 
     def record_success(self) -> None:
         with self._mu:
+            old = self._state
             self._failures = 0
             self._state = "closed"
+        self._note_transition(old, "closed")
 
     def record_failure(self) -> None:
         with self._mu:
+            old = self._state
             self._failures += 1
             if self._state == "half_open" or (
                 self._failures >= self.failure_threshold
             ):
                 self._state = "open"
                 self._opened_at = self._clock()
+            new = self._state
+        self._note_transition(old, new)
 
 
 def _accepts_deadline(fn) -> bool:

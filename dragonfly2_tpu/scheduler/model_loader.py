@@ -25,11 +25,16 @@ Rollout plane (DESIGN.md §15), when a ``rollout_client`` is attached:
   ``RemoteRegistry.load_artifact``); a mismatch logs and KEEPS the
   current scorer — a corrupted blob can demote serving quality, never
   scheduling itself;
-- **pin on manager loss**: a failed poll drops canary routing and
-  shadow scoring and keeps serving the last ACTIVE scorer.  The pin is
-  sticky until a poll SUCCEEDS (no flapping while the manager is down);
-  a re-appearing candidate of the same version re-attaches the parked
-  shadow engine with its counters intact;
+- **pin on TOTAL manager loss (last resort only)**: the registry/
+  rollout clients sweep the full manager replica list inside every poll
+  (rpc/resolver.ManagerEndpoints), so a leader bounce with a standby
+  attached fails over mid-poll and never degrades — the PR-4 pin
+  engages only when ALL replicas are down.  When it does, a failed poll
+  drops canary routing and shadow scoring and keeps serving the last
+  ACTIVE scorer.  The pin is sticky until a poll SUCCEEDS (no flapping
+  while the managers are down); a re-appearing candidate of the same
+  version re-attaches the parked shadow engine with its counters
+  intact;
 - **poll jitter**: each wait is ``interval · (1 ± jitter)`` drawn from
   an RNG seeded by (scheduler_id, model_name), so a fleet of schedulers
   booted together never synchronizes into a registry thundering herd,
@@ -96,6 +101,13 @@ class ModelSubscriber:
         # Seeded per (scheduler, model): deterministic for THIS instance,
         # decorrelated across a fleet (the anti-thundering-herd draw).
         self._rng = random.Random(f"{scheduler_id}:{model_name}")
+
+    @property
+    def pinned(self) -> bool:
+        """True only in the all-replicas-down last resort (the failover
+        drills assert this NEVER trips while a standby is reachable)."""
+        with self._refresh_mu:
+            return self._pinned
 
     def _next_interval(self) -> float:
         if not self.jitter:
@@ -275,7 +287,8 @@ class ModelSubscriber:
         metrics.ROLLOUT_SERVING_STATE.set(0, name=self.model_name)
 
     def _pin_locked(self, exc: BaseException) -> None:
-        """Manager unreachable: pin serving to the last ACTIVE version.
+        """EVERY manager replica unreachable (the client already swept
+        the endpoint list): pin serving to the last ACTIVE version.
         Canary routing and shadow scoring DETACH (an unverified candidate
         must not take traffic while its judge is absent) but the shadow
         engine parks — a recovered poll for the same candidate version

@@ -32,7 +32,7 @@ class TopologySync:
     def __init__(
         self,
         topology: NetworkTopology,
-        manager_url: str,
+        manager_url,
         scheduler_id: str,
         *,
         token: Optional[str] = None,
@@ -40,8 +40,12 @@ class TopologySync:
         timeout: float = 10.0,
         state_path: Optional[str] = None,
     ) -> None:
+        from ..rpc.resolver import ManagerEndpoints
+
         self.topology = topology
-        self.base = manager_url.rstrip("/")
+        # Replica list / shared ManagerEndpoints: sync fails over with
+        # every other manager client in the process.
+        self.endpoints = ManagerEndpoints.of(manager_url, client="topology")
         self.scheduler_id = scheduler_id
         self.token = token
         self.interval_s = interval_s
@@ -65,27 +69,30 @@ class TopologySync:
         from ..utils import faultinject
 
         adopted = 0
-        try:
+
+        def one_endpoint(base: str):
             faultinject.fire("scheduler.topology.sync")
             body = json.dumps({
                 "scheduler_id": self.scheduler_id,
                 "edges": self.topology.export_edges(),
             }).encode()
             req = urllib.request.Request(
-                self.base + "/api/v1/topology", data=body,
+                base + "/api/v1/topology", data=body,
                 headers=self._headers(), method="POST",
             )
             urllib.request.urlopen(req, timeout=self.timeout).close()
 
             with urllib.request.urlopen(
                 urllib.request.Request(
-                    self.base
-                    + f"/api/v1/topology?exclude={self.scheduler_id}",
+                    base + f"/api/v1/topology?exclude={self.scheduler_id}",
                     headers=self._headers(),
                 ),
                 timeout=self.timeout,
             ) as resp:
-                remote = json.loads(resp.read()).get("edges", [])
+                return json.loads(resp.read()).get("edges", [])
+
+        try:
+            remote = self.endpoints.call(one_endpoint)
             adopted = self.topology.merge_remote_edges(remote)
         except Exception as exc:  # noqa: BLE001 — outage ≠ crash
             logger.debug("topology sync failed: %s", exc)

@@ -511,6 +511,84 @@ class TestManagerKillDrill:
 
 
 # ---------------------------------------------------------------------------
+# Drill 2b — manager leader dies WITH a standby attached → dynconfig
+# fails over to the replica and never touches the disk fallback
+# (Manager HA, DESIGN.md §20; the pin/fallback is the ALL-replicas-down
+# last resort only)
+# ---------------------------------------------------------------------------
+
+
+class TestManagerFailoverDrill:
+    def test_dynconfig_fails_over_to_standby_without_disk_fallback(
+        self, tmp_path
+    ):
+        from dragonfly2_tpu.manager.cluster import ClusterManager
+        from dragonfly2_tpu.manager.crud import CrudStore
+        from dragonfly2_tpu.manager.dynconfig import Dynconfig
+        from dragonfly2_tpu.manager.registry import ModelRegistry
+        from dragonfly2_tpu.manager.replication import (
+            LogFollower, ReplicatedStateBackend,
+        )
+        from dragonfly2_tpu.manager.rest import ManagerRESTServer
+        from dragonfly2_tpu.manager.state import MemoryBackend
+        from dragonfly2_tpu.rpc.resolver import ManagerEndpoints
+
+        leader = ReplicatedStateBackend(
+            MemoryBackend(), node_id="L", lease_ttl_s=60.0
+        )
+        crud = CrudStore(backend=leader)
+        rest = ManagerRESTServer(
+            ModelRegistry(backend=leader), ClusterManager(), crud=crud,
+            state_backend=leader, ha=leader,
+        )
+        rest.serve()
+        crud.create("cluster", id="c1", name="c1", scheduler_cluster_config={
+            "candidate_parent_limit": 2, "filter_parent_limit": 10,
+        })
+
+        standby_backend = ReplicatedStateBackend(
+            MemoryBackend(), node_id="F", role="standby", lease_ttl_s=60.0
+        )
+        follower = LogFollower(standby_backend, rest.url)
+        follower.poll_once()
+        standby_rest = ManagerRESTServer(
+            ModelRegistry(backend=standby_backend), ClusterManager(),
+            crud=CrudStore(backend=standby_backend),
+            state_backend=standby_backend, ha=standby_backend,
+        )
+        standby_rest.serve()
+
+        endpoints = ManagerEndpoints(f"{rest.url},{standby_rest.url}")
+        cache_path = str(tmp_path / "dyn-cache.json")
+
+        def fetch():
+            def one(base):
+                with urllib.request.urlopen(
+                    base + "/api/v1/clusters/c1:config", timeout=5
+                ) as r:
+                    return json.loads(r.read())
+
+            return endpoints.call(one)
+
+        try:
+            dyn = Dynconfig(fetch, cache_path=cache_path)
+            assert dyn.refresh() is True
+            # The leader dies; the standby replica holds the same rows.
+            rest.stop()
+            dyn2 = Dynconfig(fetch, cache_path=str(tmp_path / "absent.json"))
+            assert dyn2.refresh() is True, (
+                "fetch did not fail over to the standby"
+            )
+            assert dyn2.last_refresh_ok is True  # live fetch, NOT fallback
+            assert dyn2.get()["scheduler_cluster_config"][
+                "candidate_parent_limit"] == 2
+            assert endpoints.current() == standby_rest.url
+        finally:
+            rest.stop()
+            standby_rest.stop()
+
+
+# ---------------------------------------------------------------------------
 # Drill 3 — daemon SIGKILL mid-upload → children reschedule, digest verified
 # ---------------------------------------------------------------------------
 

@@ -69,31 +69,37 @@ def _get(url: str, path: str) -> dict:
 
 class _Manager:
     """A real cli.manager process on a FIXED port + state dir, so a
-    restart is address-stable (clients retry the same endpoint)."""
+    restart is address-stable (clients retry the same endpoint).
+    ``ha_yaml``/``extra_args`` configure the replication role (the
+    leader+standby failover drill)."""
 
-    def __init__(self, tmp: str, port: int):
+    def __init__(self, tmp: str, port: int, *, name: str = "manager",
+                 ha_yaml: str = "", extra_args=()):
         self.tmp, self.port = tmp, port
-        cfg_path = os.path.join(tmp, "manager.yaml")
+        cfg_path = os.path.join(tmp, f"{name}.yaml")
         with open(cfg_path, "w") as f:
             f.write(
                 f"server: {{host: 127.0.0.1, port: {port}, grpc_port: -1}}\n"
-                f"registry: {{blob_dir: {tmp}/manager}}\n"
-                f"ca_dir: {tmp}/ca\n"
+                f"registry: {{blob_dir: {tmp}/{name}}}\n"
+                f"ca_dir: {tmp}/ca-{name}\n"
                 "jobs_min_requeue_s: 0.01\n"
+                + ha_yaml
             )
         self.cfg_path = cfg_path
+        self.extra_args = list(extra_args)
         self.proc = None
         self.url = f"http://127.0.0.1:{port}"
+        self.lines = []
 
     def start(self) -> None:
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "dragonfly2_tpu.cli.manager",
-             "--config", self.cfg_path],
+             "--config", self.cfg_path, *self.extra_args],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
         )
         ready = threading.Event()
-        lines = []
+        lines = self.lines = []
 
         def pump():
             for line in self.proc.stdout:
@@ -237,6 +243,132 @@ def test_started_job_redelivers_after_restart(tmp_path):
         assert job2["id"] == job["id"]
     finally:
         mgr.stop()
+
+
+def test_leader_sigkill_with_standby_fails_over_zero_pinning(tmp_path):
+    """The Manager-HA acceptance drill (ISSUE 9 / DESIGN.md §20): the
+    leader is SIGKILLed mid-preheat with a hot standby attached and is
+    NEVER restarted —
+
+    - the standby promotes itself on lease expiry (term 2);
+    - the in-flight preheat completes through the promoted follower
+      (job rows replicated, worker polls the endpoint pair);
+    - the dynconfig payload and the model registry (row + digest-checked
+      artifact) keep serving through the standby;
+    - the ModelSubscriber's poll NEVER engages the PR-4 pin-to-last-
+      ACTIVE degraded mode (``pinned`` stays False throughout).
+    """
+    import numpy as np
+
+    from dragonfly2_tpu.jobs.remote import RemoteJobClient, RemoteJobWorker
+    from dragonfly2_tpu.records.features import DOWNLOAD_FEATURE_DIM
+    from dragonfly2_tpu.rpc.registry_client import RemoteRegistry
+    from dragonfly2_tpu.scheduler import MLEvaluator, ModelSubscriber
+    from dragonfly2_tpu.trainer.export import MLPScorer, scorer_to_bytes
+
+    ha_yaml = (
+        "ha: {enable: true, lease_ttl_s: 2.0, poll_interval_s: 0.25, "
+        "lease_secret: drill-secret}\n"
+    )
+    leader = _Manager(str(tmp_path), _free_port(), name="leader",
+                      ha_yaml=ha_yaml)
+    leader.start()
+    standby = _Manager(
+        str(tmp_path), _free_port(), name="standby", ha_yaml=ha_yaml,
+        extra_args=["--replicate-from", leader.url],
+    )
+    standby.start()
+    pair = f"{leader.url},{standby.url}"
+    try:
+        client = RemoteJobClient(pair)
+
+        # --- stage the in-flight world on the LEADER --------------------
+        group = client.create_group(
+            "preheat", {"urls": ["https://origin/blob"]}, ["q-sched-a"]
+        )
+        gid = group["group_id"]
+        rng = np.random.default_rng(0)
+        weights = [(
+            rng.standard_normal((DOWNLOAD_FEATURE_DIM, 1)).astype(np.float32),
+            np.zeros(1, dtype=np.float32),
+        )]
+        artifact = scorer_to_bytes(MLPScorer(weights=weights))
+        import base64
+
+        created = _post(leader.url, "/api/v1/models", {
+            "name": "parent-bandwidth-mlp", "type": "mlp",
+            "scheduler_id": "sched-a",
+            "artifact_b64": base64.b64encode(artifact).decode(),
+        })
+        _post(leader.url, f"/api/v1/models/{created['id']}:activate", {})
+
+        # A subscriber polling through the endpoint pair, synced once
+        # while the leader is alive.
+        remote = RemoteRegistry(pair, timeout=5.0)
+        subscriber = ModelSubscriber(
+            remote, MLEvaluator(), scheduler_id="sched-a",
+        )
+        assert subscriber.refresh() is True
+        assert subscriber.pinned is False
+
+        # Give the follower a beat to tail the staged rows.
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            health = _get(standby.url, "/api/v1/replication:status")
+            if health["applied_seq"] >= 1 and health["role"] == "standby":
+                break
+            time.sleep(0.2)
+
+        # --- the crash: SIGKILL the leader, never restart it ------------
+        leader.sigkill()
+
+        # Reads fail over immediately (standby answers them pre-
+        # promotion); the poll must NOT pin.
+        assert subscriber.refresh() is False  # unchanged version
+        assert subscriber.pinned is False, (
+            "subscriber pinned with a live standby attached"
+        )
+
+        # The standby promotes on lease expiry and the in-flight preheat
+        # completes THROUGH it.
+        worker = RemoteJobWorker(pair, "q-sched-a", poll_timeout_s=0.5)
+        done = {}
+        worker.register(
+            "preheat", lambda args: done.setdefault("urls", args["urls"])
+        )
+        deadline = time.time() + 30
+        completed = False
+        while time.time() < deadline and not completed:
+            try:
+                completed = worker.poll_once()
+            except ConnectionError:
+                time.sleep(0.3)
+        assert completed, (
+            "preheat never drained through the promoted follower",
+            standby.lines[-10:],
+        )
+        assert done["urls"] == ["https://origin/blob"]
+        assert client.group_state(gid)["state"] == "SUCCESS"
+
+        # Promotion is observable: role leader, term advanced.
+        status = _get(standby.url, "/api/v1/replication:status")
+        assert status["role"] == "leader" and status["term"] >= 2
+
+        # Registry row + digest-verified artifact through the survivor.
+        model = remote.active_model("sched-a", "parent-bandwidth-mlp")
+        assert model is not None
+        assert remote.load_artifact(model) == artifact
+
+        # Dynconfig payload (cluster config) still serving.
+        cfg = _get(standby.url, "/api/v1/clusters/default:config")
+        assert "scheduler_cluster_config" in cfg
+
+        # And the subscriber STILL never pinned.
+        subscriber.refresh()
+        assert subscriber.pinned is False
+    finally:
+        leader.stop()
+        standby.stop()
 
 
 def test_legacy_sqlite_layouts_migrate_once(tmp_path):

@@ -94,6 +94,9 @@ REQUIRED_SEAMS = {
     "dragonfly2_tpu/manager/state.py": (
         "state.put.*", "state.get.*", "state.delete.*", "state.load_all.*",
     ),
+    "dragonfly2_tpu/manager/replication.py": (
+        "state.replicate.*", "manager.lease.*",
+    ),
     "dragonfly2_tpu/daemon/pex_net.py": ("pex.send", "pex.recv"),
     "dragonfly2_tpu/daemon/relay.py": ("relay.pump",),
     "dragonfly2_tpu/daemon/proxy.py": (

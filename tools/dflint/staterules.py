@@ -905,6 +905,21 @@ class StateAnalysis:
     def _check_df014(self) -> None:
         namespaces: Dict[str, dict] = self.persistence.get("namespaces", {})
         impl = set(self.persistence.get("implementation", []))
+        # Declared dynamic-namespace writers (replication apply paths,
+        # the one-transaction migration commit) must exist — a stale
+        # entry would silently widen the witness's wildcard coverage.
+        for relpath, quals in self.persistence.get("replicators", {}).items():
+            mi = self.program.modules.get(relpath)
+            for qual in quals:
+                if self.program.funcs.get(f"{relpath}:{qual}") is None:
+                    if mi is not None:
+                        self._emit(
+                            RULE_CRASH, mi, mi.module.tree,
+                            f"declared replicator {qual!r} missing from "
+                            f"{relpath} — stale records/state_contracts.py "
+                            "entry (the crash witness's wildcard coverage "
+                            "no longer matches the code)",
+                        )
         # 1. every namespace in code is declared
         seen_ns: Set[str] = set()
         for ns, call, mi in self._binding_sites:
@@ -1374,7 +1389,10 @@ class StateAnalysis:
         """(relpath, lineno) covered by any static KVTable op →
         (namespace, method).  The runtime crash witness maps each
         observed write's caller frame through this; an unknown frame is
-        a stale static inventory."""
+        a stale static inventory.  Declared replicator functions (the
+        dynamic-namespace apply/migration paths) index their whole span
+        as the wildcard namespace ``"*"`` — any declared namespace may
+        be observed there."""
         out: Dict[Tuple[str, int], Tuple[str, str]] = {}
         for op in self._ops:
             start = op.node.lineno
@@ -1383,6 +1401,15 @@ class StateAnalysis:
                 out.setdefault(
                     (op.fi.module.relpath, line), (op.ns, op.method)
                 )
+        for relpath, quals in self.persistence.get("replicators", {}).items():
+            for qual in quals:
+                fi = self.program.funcs.get(f"{relpath}:{qual}")
+                if fi is None:
+                    continue
+                start = fi.node.lineno
+                end = getattr(fi.node, "end_lineno", start) or start
+                for line in range(start, end + 1):
+                    out.setdefault((relpath, line), ("*", "*"))
         return out
 
     def multi_row_sites(self) -> Dict[str, str]:
@@ -1494,8 +1521,17 @@ def crash_witness_gaps(
             )
             continue
         ns, _method = known
+        declared_ns = set(analysis.persistence.get("namespaces", {}))
         for r in records:
-            if r.get("namespace") != ns:
+            if ns == "*":
+                # Replicator wildcard: any DECLARED namespace is fine;
+                # an undeclared one is still a gap.
+                if r.get("namespace") not in declared_ns:
+                    gaps.append(
+                        f"{relpath}:{lineno}: replicator wrote undeclared "
+                        f"namespace {r.get('namespace')!r}"
+                    )
+            elif r.get("namespace") != ns:
                 gaps.append(
                     f"{relpath}:{lineno}: observed namespace "
                     f"{r.get('namespace')!r} but the static inventory "
