@@ -103,11 +103,19 @@ def main() -> int:
         return found
 
     try:
+        # The HA pair shares a generated lease secret (config validation
+        # refuses the public default — it would let anyone forge leases
+        # or fetch the replicated state).
+        import secrets as _secrets
+
+        ha_yaml = (
+            "ha: {enable: true, lease_ttl_s: 5.0, "
+            f"lease_secret: {_secrets.token_hex(16)}}}\n"
+        )
         mcfg = write("manager.yaml", (
             "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
             f"registry: {{blob_dir: {tmp}/manager}}\n"
-            + ("ha: {enable: true, lease_ttl_s: 5.0}\n" if manager_standby
-               else "")
+            + (ha_yaml if manager_standby else "")
             + (f"ca_dir: {tmp}/ca\n" if mtls else "")
         ))
         mout = spawn("manager", ["dragonfly2_tpu.cli.manager", "--config", mcfg],
@@ -118,7 +126,7 @@ def main() -> int:
             sbmcfg = write("manager-standby.yaml", (
                 "server: {host: 127.0.0.1, port: 0, grpc_port: -1}\n"
                 f"registry: {{blob_dir: {tmp}/manager-standby}}\n"
-                "ha: {enable: true, lease_ttl_s: 5.0}\n"
+                + ha_yaml
             ))
             sbout = spawn(
                 "manager-standby",

@@ -161,6 +161,25 @@ def run(argv=None) -> int:
 
     from ..manager.rest import ManagerRESTServer
 
+    # A node configured as leader first asks its peers (if any) whether
+    # a higher term already exists: followers PULL, so nothing would
+    # otherwise deliver a successor's term to a restarted fenced leader
+    # — it would boot at its stale term and accept writes again.  With a
+    # higher term observed it demotes itself and tails that peer.
+    replicate_from = args.replicate_from or cfg.ha.replicate_from
+    ha = parts["ha"]
+    if ha is not None and ha.role == "leader" and cfg.ha.peers:
+        from ..manager.replication import probe_peer_term
+
+        peer_term, peer_url = probe_peer_term(cfg.ha.peers)
+        if peer_term > ha.term:
+            ha.observe_term(peer_term)
+            replicate_from = peer_url
+            print(
+                f"manager: peer {peer_url} holds term {peer_term}; "
+                "joining as standby", flush=True,
+            )
+
     auth = {}
     if cfg.token_secret:
         from ..manager.users import UserStore
@@ -228,7 +247,6 @@ def run(argv=None) -> int:
     )
     rest.serve()
     # -- replication role (manager/replication.py, DESIGN.md §20) -------
-    ha = parts["ha"]
     lease_keeper = None
     follower = None
     if ha is not None and ha.role == "leader":
@@ -236,10 +254,8 @@ def run(argv=None) -> int:
 
         lease_keeper = LeaseKeeper(ha)
         lease_keeper.serve()
-    elif ha is not None:
+    elif ha is not None and replicate_from:
         from ..manager.replication import LeaseKeeper, LogFollower
-
-        replicate_from = args.replicate_from or cfg.ha.replicate_from
 
         def _rebuild(_touched) -> None:
             # Replicated rows changed: swap the REST surface onto fresh

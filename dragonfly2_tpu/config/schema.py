@@ -246,6 +246,15 @@ class RolloutSection:
             raise ConfigError("rollout sample floors must be >= 1")
 
 
+# Placeholder default for HASection.lease_secret (kept in sync with the
+# ReplicatedStateBackend constructor default in manager/replication.py).
+# It is PUBLIC CODE: validate() refuses to enable HA with it in place —
+# anyone holding it can forge leases (fence a live leader via a fake
+# high term, keep a dead one looking alive) and fetch the replication
+# log/snapshot, credential rows included.
+DEFAULT_LEASE_SECRET = "dragonfly-manager-lease"
+
+
 @dataclass
 class HASection:
     """Manager control-plane replication (manager/replication.py,
@@ -253,20 +262,34 @@ class HASection:
     /api/v1/replication:* surface on a leader; ``replicate_from`` boots
     this process as a hot standby tailing that leader (implies enable).
     ``lease_secret`` must match across the pair — it signs the leader
-    lease followers defer to."""
+    lease followers defer to and authenticates log/snapshot fetches.
+    ``peers`` lists the other replicas' base URLs: a node booting as
+    leader probes them for a higher term first, so a restarted fenced
+    leader rejoins as a standby instead of resurrecting a stale term."""
 
     enable: bool = False
     replicate_from: str = ""
     node_id: str = ""
     lease_ttl_s: float = 10.0
-    lease_secret: str = "dragonfly-manager-lease"
+    lease_secret: str = DEFAULT_LEASE_SECRET
     poll_interval_s: float = 1.0
+    peers: list = field(default_factory=list)
 
     def validate(self) -> None:
         if self.lease_ttl_s <= 0:
             raise ConfigError("ha.lease_ttl_s must be > 0")
         if self.poll_interval_s <= 0:
             raise ConfigError("ha.poll_interval_s must be > 0")
+        if self.enable or self.replicate_from:
+            if self.lease_secret == DEFAULT_LEASE_SECRET:
+                raise ConfigError(
+                    "ha.lease_secret must be set to a private value when "
+                    "HA is enabled — the default is public code, so any "
+                    "peer could forge leases and fetch the replicated "
+                    "state (users/PATs rows included)"
+                )
+            if len(self.lease_secret.encode()) < 16:
+                raise ConfigError("ha.lease_secret must be >= 16 bytes")
 
 
 @dataclass

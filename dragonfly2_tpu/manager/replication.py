@@ -32,6 +32,14 @@ right place to replicate.  Three pieces (DESIGN.md §20):
   (``StaleTermError``), which is what makes a partitioned old leader's
   history unshippable.
 
+The data-bearing routes (``:log``/``:snapshot``) carry every namespace
+of the backend — including users/PATs credential rows on default
+deployments — so they require proof of the shared ``lease_secret``: an
+HMAC request token (:func:`sign_replication_request`) in the
+``X-DF-Replication-Auth`` header.  The log is compacted: entries far
+enough below the applied watermark truncate away, and a follower that
+has fallen behind the retained floor re-bootstraps from a snapshot.
+
 Every network/commit edge here is a DF004 chaos seam
 (``state.replicate.*`` / ``manager.lease.*``) and every write path is
 inventoried in ``records/state_contracts.py`` (the ``replicators``
@@ -90,6 +98,54 @@ def verify_lease(secret: str, lease: dict) -> bool:
         return False
 
 
+# Header carrying the replication-fetch auth token.  The ``:log`` and
+# ``:snapshot`` routes dump every namespace of the backend — users/PATs
+# credential rows included on default deployments — so they are gated
+# on possession of the shared ``lease_secret`` rather than left open
+# like the role/term health probe (``:status``).
+REPLICATION_AUTH_HEADER = "X-DF-Replication-Auth"
+
+
+def sign_replication_request(secret: str, path: str) -> str:
+    """HMAC-SHA256 token a replica presents to fetch ``path`` (the
+    route path, query excluded).  Proves possession of ``lease_secret``;
+    the routes are read-only, so there is no replay surface to bind —
+    an observer close enough to replay could read the response anyway."""
+    msg = f"replication-fetch:{path}".encode()
+    return hmac.new(secret.encode(), msg, hashlib.sha256).hexdigest()
+
+
+def verify_replication_request(secret: str, path: str, token: str) -> bool:
+    want = sign_replication_request(secret, path)
+    return hmac.compare_digest(want, str(token or ""))
+
+
+def probe_peer_term(urls, *, timeout: float = 3.0):
+    """Best-effort sweep of peer replicas' ``:status`` probes; returns
+    ``(term, url)`` for the highest term observed (``(0, "")`` when no
+    peer answers).  A node configured as leader calls this at boot so a
+    restarted fenced leader discovers the successor's term and rejoins
+    as a standby instead of resurrecting its stale term."""
+    best_term, best_url = 0, ""
+    for url in urls:
+        url = str(url).rstrip("/")
+        if not url:
+            continue
+        try:
+            faultinject.fire(f"state.replicate.{'probe'}")
+            with urllib.request.urlopen(
+                url + "/api/v1/replication:status", timeout=timeout
+            ) as resp:
+                status = json.loads(resp.read())
+            term = int(status.get("term", 0))
+        except Exception as exc:  # noqa: BLE001 — a dead peer is no vote
+            logger.debug("peer probe %s unreachable: %s", url, exc)
+            continue
+        if term > best_term:
+            best_term, best_url = term, url
+    return best_term, best_url
+
+
 class ReplicationLog:
     """The durable op log + term/applied watermark, riding two reserved
     namespaces of the inner backend.
@@ -117,6 +173,9 @@ class ReplicationLog:
         state = self._meta.load_all().get("state") or {}
         self._term = int(state.get("term", 1))
         self._applied = int(state.get("applied", 0))
+        # Lowest seq still retained: entries below it were compacted
+        # away (a follower that far behind re-bootstraps via snapshot).
+        self._floor = int(state.get("floor", 1))
         self._unflushed = 0
 
     @staticmethod
@@ -130,6 +189,14 @@ class ReplicationLog:
         entry = dict(entry, seq=self._seq)
         self._log.put(self._key(self._seq), entry)
         return self._seq
+
+    def discard(self, seq: int) -> None:
+        """Remove a just-appended entry whose data commit FAILED: the
+        caller was told the write failed, so the WAL row must not ship
+        to followers or replay at boot as a write that never happened.
+        The seq stays consumed (a gap) — reusing it could alias two
+        different ops at one position."""
+        self._log.delete(self._key(seq))
 
     def append_at(self, entry: dict) -> None:
         """Follower-side copy of a leader-assigned entry (keeps this
@@ -152,7 +219,9 @@ class ReplicationLog:
 
     def flush(self) -> None:
         self._meta.put(
-            "state", {"term": self._term, "applied": self._applied}
+            "state",
+            {"term": self._term, "applied": self._applied,
+             "floor": self._floor},
         )
         self._unflushed = 0
 
@@ -168,14 +237,30 @@ class ReplicationLog:
     def applied(self) -> int:
         return self._applied
 
+    @property
+    def floor(self) -> int:
+        return self._floor
+
     def entries_since(self, from_seq: int, limit: int = 500) -> List[dict]:
         """Entries with seq > ``from_seq``, ascending, at most ``limit``.
-        Full-table scan per call — the log is an embedded test/deploy
-        scale structure, not a WAN-scale stream."""
-        rows = self._log.load_all()
-        out = [e for k, e in rows.items() if int(k) > from_seq]
-        out.sort(key=lambda e: int(e["seq"]))
+        Keys are zero-padded, so the lexicographic range scan IS the
+        numeric one (SQLite serves it as an indexed WHERE key > ?)."""
+        rows = self._log.load_range(self._key(max(from_seq, 0)))
+        out = sorted(rows.values(), key=lambda e: int(e["seq"]))
         return out[:limit]
+
+    def truncate_below(self, seq: int) -> None:
+        """Compact: drop entries with seq < ``seq``, never past one
+        beyond the applied watermark (the unapplied tail is the boot
+        replay's crash-recovery record).  Growth stays bounded over a
+        deployment's lifetime; a follower behind the new floor falls
+        back to snapshot bootstrap."""
+        seq = min(int(seq), self._applied + 1)
+        if seq <= self._floor:
+            return
+        self._log.delete_range(self._key(seq))
+        self._floor = seq
+        self.flush()
 
     def pending(self) -> List[dict]:
         """The unapplied tail (crash between log append and data
@@ -240,14 +325,40 @@ class ReplicatedStateBackend(StateBackend):
 
     def renew_lease(self) -> dict:
         """Extend this leader's lease by one TTL; raises if no longer
-        leader (a fenced node cannot resurrect itself by renewing)."""
+        leader (a fenced node cannot resurrect itself by renewing).
+
+        An ALREADY-EXPIRED lease cannot be renewed either: past expiry a
+        standby may have promoted at ``term+1``, and since followers
+        pull (nothing pushes the successor's term back here), a paused/
+        partitioned leader that resumed would otherwise re-extend its
+        stale-term lease and keep committing forever — the split brain
+        the lease exists to prevent.  Instead the node steps down; it
+        rejoins via ``--replicate-from`` (or the ``ha.peers`` probe at
+        next boot)."""
         faultinject.fire(f"manager.lease.{'renew'}")
         with self._mu:
             if self._role != "leader":
                 raise NotLeaderError(
                     f"{self.node_id}: cannot renew lease in role {self._role}"
                 )
-            self._lease_expires_at = self._clock() + self.lease_ttl_s
+            now = self._clock()
+            if (
+                self._lease_expires_at is not None
+                and now >= self._lease_expires_at
+            ):
+                self._role = "standby"
+                self._lease_expires_at = None
+                self._set_role_metric()
+                logger.warning(
+                    "%s: lease expired before renewal at term %d — "
+                    "stepping down (a successor may hold a higher term)",
+                    self.node_id, self._term,
+                )
+                raise NotLeaderError(
+                    f"{self.node_id}: lease expired at term {self._term}; "
+                    "refusing to resurrect it — stepped down"
+                )
+            self._lease_expires_at = now + self.lease_ttl_s
             return self._lease_payload_locked()
 
     def _lease_payload_locked(self) -> dict:
@@ -344,6 +455,13 @@ class ReplicatedStateBackend(StateBackend):
                 "a successor may hold a higher term; refusing to commit"
             )
 
+    # Every COMPACT_EVERY commits, truncate log entries more than
+    # RETAIN_OPS below the applied watermark (followers further behind
+    # re-bootstrap via snapshot) — the log must not grow without bound
+    # when whole artifacts ride it (KVBlobStore).
+    COMPACT_EVERY = 256
+    RETAIN_OPS = 1024
+
     def _commit_op(
         self, ns: str, op: str, payload: dict, fn: Callable[[], None]
     ) -> None:
@@ -357,8 +475,35 @@ class ReplicatedStateBackend(StateBackend):
             self._check_writable_locked()
             entry = dict(payload, term=self._term, ns=ns, op=op)
             seq = self.log.append(entry)
-            fn()
+            try:
+                fn()
+            except BaseException:
+                # The caller is told this write FAILED: the WAL row must
+                # not outlive it — left in place it would ship to
+                # followers (and replay at boot) as a write the leader's
+                # own table never took, and the next successful commit
+                # would advance the watermark past it, making the
+                # divergence permanent.  A genuine crash (process death
+                # between append and commit) still replays at boot: the
+                # caller never got an answer there, so applying is the
+                # correct resolution of the ambiguity.
+                self.log.discard(seq)
+                raise
             self.log.mark_applied(seq)
+            if seq % self.COMPACT_EVERY == 0:
+                self.log.truncate_below(self.log.applied - self.RETAIN_OPS + 1)
+
+    def log_entries(self, from_seq: int, limit: int = 500) -> dict:
+        """The ``:log`` route's payload, read under the commit lock so a
+        concurrent commit's append-then-discard (failed data commit)
+        can never be observed half-done by a polling follower."""
+        with self._mu:
+            return {
+                "entries": self.log.entries_since(from_seq, limit),
+                "seq": self.log.seq,
+                "term": self._term,
+                "floor": self.log.floor,
+            }
 
     # -- follower application ------------------------------------------
 
@@ -609,9 +754,15 @@ class LogFollower:
 
     def _get_json(self, path: str) -> dict:
         faultinject.fire(f"state.replicate.{'fetch'}")
-        with urllib.request.urlopen(
-            self.leader_url + path, timeout=self.timeout
-        ) as resp:
+        # Auth: the data-bearing routes demand proof of the shared
+        # lease_secret (the token is over the route path, query aside).
+        route = path.split("?", 1)[0]
+        req = urllib.request.Request(self.leader_url + path, headers={
+            REPLICATION_AUTH_HEADER: sign_replication_request(
+                self.backend.lease_secret, route
+            ),
+        })
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             return json.loads(resp.read())
 
     # -- one poll -------------------------------------------------------
@@ -641,17 +792,26 @@ class LogFollower:
         try:
             self._leader_seq = int(status.get("seq", 0))
             if not self._bootstrapped:
-                snap = self._get_json("/api/v1/replication:snapshot")
-                touched = self.backend.apply_snapshot(snap)
-                self._bootstrapped = True
-                if touched and self.on_apply is not None:
-                    self.on_apply(touched)
+                self._bootstrap_snapshot()
             while self.backend.log.applied < self._leader_seq:
-                batch = self._get_json(
-                    "/api/v1/replication:log?from_seq="
-                    f"{self.backend.log.applied}"
-                ).get("entries", [])
+                from_seq = self.backend.log.applied
+                resp = self._get_json(
+                    f"/api/v1/replication:log?from_seq={from_seq}"
+                )
+                if int(resp.get("floor", 1)) > from_seq + 1:
+                    # Behind the leader's compaction floor: entries
+                    # between our watermark and the floor were truncated
+                    # away, and applying the retained tail would
+                    # silently skip them — re-bootstrap via snapshot
+                    # (fast-forwards the watermark past the gap).
+                    self._bootstrap_snapshot()
+                    continue
+                batch = resp.get("entries", [])
                 if not batch:
+                    # Nothing retained beyond our watermark: the head of
+                    # the leader's log is a gap (a discarded failed
+                    # commit) — we ARE caught up, don't report lag.
+                    self._leader_seq = from_seq
                     break
                 touched = self.backend.apply_ops(batch)
                 applied += len(batch)
@@ -666,6 +826,13 @@ class LogFollower:
                 self._last_caught_up = self._clock()
         self._export_lag()
         return applied
+
+    def _bootstrap_snapshot(self) -> None:
+        snap = self._get_json("/api/v1/replication:snapshot")
+        touched = self.backend.apply_snapshot(snap)
+        self._bootstrapped = True
+        if touched and self.on_apply is not None:
+            self.on_apply(touched)
 
     def _export_lag(self) -> None:
         from ..rpc.metrics import REPLICATION_LAG

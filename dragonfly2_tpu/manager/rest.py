@@ -213,6 +213,29 @@ class ManagerRESTServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _replication_auth_rejected(self, path: str) -> bool:
+                """The :log/:snapshot routes dump every namespace —
+                users/PATs credential rows included on default
+                deployments — so they demand proof of the shared
+                lease_secret (the follower signs each fetch); anything
+                else 403s instead of bypassing the ADMIN-gated user
+                routes."""
+                from .replication import (
+                    REPLICATION_AUTH_HEADER,
+                    verify_replication_request,
+                )
+
+                token = self.headers.get(REPLICATION_AUTH_HEADER, "")
+                if verify_replication_request(
+                    server.ha.lease_secret, path, token
+                ):
+                    return False
+                self._json(403, {
+                    "error": "replication fetch requires the shared "
+                    "lease_secret (X-DF-Replication-Auth)",
+                })
+                return True
+
             def _standby_rejected(self) -> bool:
                 """Standby role: every mutation 503s with Retry-After
                 until promotion — a client that cannot fail over knows
@@ -283,27 +306,25 @@ class ManagerRESTServer:
                 elif path == "/api/v1/replication:log":
                     if server.ha is None:
                         self._json(404, {"error": "replication not configured"})
-                    else:
+                    elif not self._replication_auth_rejected(path):
                         try:
                             from_seq = int(q.get("from_seq", 0))
                             limit = min(int(q.get("limit", 500)), 2000)
                         except ValueError as exc:
                             self._json(400, {"error": str(exc)})
                             return
-                        self._json(200, {
-                            "entries": server.ha.log.entries_since(
-                                from_seq, limit
-                            ),
-                            "seq": server.ha.log.seq,
-                            "term": server.ha.term,
-                        })
+                        # Read under the commit lock (log_entries): a
+                        # concurrent append-then-discard must never ship.
+                        self._json(
+                            200, server.ha.log_entries(from_seq, limit)
+                        )
                 elif path == "/api/v1/replication:snapshot":
                     # Follower bootstrap: full data-state snapshot for
                     # rows that predate the log (legacy migrations,
-                    # pre-HA deployments).
+                    # pre-HA deployments) or that compacted out of it.
                     if server.ha is None:
                         self._json(404, {"error": "replication not configured"})
-                    else:
+                    elif not self._replication_auth_rejected(path):
                         self._json(200, server.ha.snapshot())
                 elif path == "/api/v1/certs:ca":
                     # Trust-root fetch (open read: peers need the root

@@ -53,6 +53,19 @@ class KVTable:
     def load_all(self) -> Dict[str, dict]:
         raise NotImplementedError
 
+    def load_range(self, start_key: str) -> Dict[str, dict]:
+        """Rows with key > ``start_key`` (lexicographic).  Base form
+        filters ``load_all``; the concrete tables override with direct
+        range forms — the replication log's per-poll tail read must not
+        scan (and copy) the whole table forever (manager/replication.py)."""
+        return {k: v for k, v in self.load_all().items() if k > start_key}
+
+    def delete_range(self, end_key: str) -> None:
+        """Delete rows with key < ``end_key`` (log compaction)."""
+        for k in self.load_all():
+            if k < end_key:
+                self.delete(k)
+
 
 class StateBackend:
     def table(self, namespace: str) -> KVTable:
@@ -112,6 +125,23 @@ class _MemTable(KVTable):
         faultinject.fire(f"state.load_all.{self._ns}")
         with self._mu:
             return json.loads(json.dumps(self._rows))
+
+    def load_range(self, start_key: str) -> Dict[str, dict]:
+        faultinject.fire(f"state.load_all.{self._ns}")
+        with self._mu:
+            return {
+                k: json.loads(json.dumps(v))
+                for k, v in self._rows.items() if k > start_key
+            }
+
+    def delete_range(self, end_key: str) -> None:
+        # Direct row mutation (not a self.delete loop): bulk log
+        # compaction is backend maintenance, not a consumer write — it
+        # must not surface per-row in the crash-witness inventory.
+        faultinject.fire(f"state.delete.{self._ns}")
+        with self._mu:
+            for k in [k for k in self._rows if k < end_key]:
+                del self._rows[k]
 
 
 class MemoryBackend(StateBackend):
@@ -178,6 +208,23 @@ class _SQLiteTable(KVTable):
                 "SELECT key, value FROM kv WHERE ns=?", (self._ns,)
             ).fetchall()
         return {k: json.loads(v) for k, v in rows}
+
+    def load_range(self, start_key: str) -> Dict[str, dict]:
+        faultinject.fire(f"state.load_all.{self._ns}")
+        with self._b._mu:
+            rows = self._b._conn.execute(
+                "SELECT key, value FROM kv WHERE ns=? AND key>?",
+                (self._ns, start_key),
+            ).fetchall()
+        return {k: json.loads(v) for k, v in rows}
+
+    def delete_range(self, end_key: str) -> None:
+        faultinject.fire(f"state.delete.{self._ns}")
+        with self._b._mu:
+            self._b._conn.execute(
+                "DELETE FROM kv WHERE ns=? AND key<?", (self._ns, end_key)
+            )
+            self._b._conn.commit()
 
 
 class SQLiteBackend(StateBackend):

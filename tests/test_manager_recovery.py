@@ -268,7 +268,7 @@ def test_leader_sigkill_with_standby_fails_over_zero_pinning(tmp_path):
 
     ha_yaml = (
         "ha: {enable: true, lease_ttl_s: 2.0, poll_interval_s: 0.25, "
-        "lease_secret: drill-secret}\n"
+        "lease_secret: drill-secret-0123456789abcdef}\n"
     )
     leader = _Manager(str(tmp_path), _free_port(), name="leader",
                       ha_yaml=ha_yaml)

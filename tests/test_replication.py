@@ -19,11 +19,13 @@ import pytest
 from dragonfly2_tpu.manager.cluster import ClusterManager
 from dragonfly2_tpu.manager.registry import KVBlobStore, ModelRegistry
 from dragonfly2_tpu.manager.replication import (
+    REPLICATION_AUTH_HEADER,
     LogFollower,
     NotLeaderError,
     ReplicatedStateBackend,
     StaleTermError,
     sign_lease,
+    sign_replication_request,
     verify_lease,
 )
 from dragonfly2_tpu.manager.rest import ManagerRESTServer
@@ -79,21 +81,54 @@ class TestOpLog:
             "m1": {"id": "m1"}, "m2": {"id": "m2"},
         }
 
-    def test_crash_between_append_and_commit_replays_at_boot(self, tmp_path):
-        """The write-ahead contract: the log row commits first; a crash
-        before the data commit converges by idempotent replay."""
+    def test_failed_data_commit_discards_the_log_entry(self, tmp_path):
+        """The crash witness for a fault injected between the WAL
+        append and the data commit: the caller is TOLD the write failed,
+        so the appended entry must not survive — it would ship to
+        followers (and replay at boot) as a write the leader's own table
+        never took, and the next successful commit would advance the
+        applied watermark past it, making the divergence permanent."""
         db = str(tmp_path / "s.db")
         b = ReplicatedStateBackend(SQLiteBackend(db), node_id="L")
         b.table("models").put("m1", {"id": "m1"})
         # Drop exactly the DATA commit (the models-namespace put); the
-        # log rows (replication_log namespace) are untouched.
+        # log append (replication_log namespace) has already landed.
         inj = faultinject.FaultInjector([
             faultinject.FaultSpec(site="state.put.models", kind="drop", at=(0,)),
         ])
         with faultinject.installed(inj):
             with pytest.raises(ConnectionError):
                 b.table("models").put("m2", {"id": "m2"})
-        # Torn: log has seq 2, data does not.
+        # The failed write's entry is gone: nothing ships, and a later
+        # commit (seq 3 > failed seq 2) must not strand a divergence.
+        assert [e["seq"] for e in b.log.entries_since(0)] == [1]
+        b.table("models").put("m3", {"id": "m3"})
+        follower = _standby(_Clock())
+        follower.apply_ops(b.log.entries_since(0))
+        assert follower.table("models").load_all() == {
+            "m1": {"id": "m1"}, "m3": {"id": "m3"},
+        }
+        b.close()
+
+        b2 = ReplicatedStateBackend(SQLiteBackend(db), node_id="L")
+        assert b2.table("models").load_all() == {
+            "m1": {"id": "m1"}, "m3": {"id": "m3"},
+        }, "boot replay must not resurrect a write the caller saw fail"
+        b2.close()
+
+    def test_crash_between_append_and_commit_replays_at_boot(self, tmp_path):
+        """The write-ahead contract: a genuine CRASH (process death
+        after the log append, before the data commit — the caller never
+        got an answer) converges by idempotent replay at boot."""
+        db = str(tmp_path / "s.db")
+        b = ReplicatedStateBackend(SQLiteBackend(db), node_id="L")
+        b.table("models").put("m1", {"id": "m1"})
+        # Simulate the torn stop: the log row is durably appended but
+        # the process dies before fn() runs (no discard, no data row).
+        b.log.append({
+            "term": b.term, "ns": "models", "op": "put_many",
+            "items": {"m2": {"id": "m2"}},
+        })
         assert b.log.seq == 2
         b.close()
 
@@ -187,12 +222,53 @@ class TestLeaseAndFencing:
         clock = _Clock()
         leader = _leader(clock, lease_ttl_s=5.0)
         leader.table("models").put("m1", {"id": "m1"})
-        clock.t = 6.0  # past expiry, no renewal
-        with pytest.raises(NotLeaderError):
-            leader.table("models").put("m2", {"id": "m2"})
-        # Renewal restores the lease (no successor observed).
+        clock.t = 4.0  # inside the TTL: renewal extends
         leader.renew_lease()
+        clock.t = 8.0
         leader.table("models").put("m2", {"id": "m2"})
+        clock.t = 10.0  # past expiry, no renewal
+        with pytest.raises(NotLeaderError):
+            leader.table("models").put("m3", {"id": "m3"})
+
+    def test_renewing_an_expired_lease_steps_down_not_resurrects(self):
+        """The split-brain fix: a paused/partitioned leader's LeaseKeeper
+        must NOT re-extend a lease that already lapsed — past expiry a
+        standby may hold term+1 and nothing pushes that term back here
+        (followers pull).  Renewal past expiry demotes permanently."""
+        clock = _Clock()
+        leader = _leader(clock, lease_ttl_s=5.0)
+        leader.table("models").put("m1", {"id": "m1"})
+        clock.t = 20.0  # the pause: lease long dead
+        with pytest.raises(NotLeaderError):
+            leader.renew_lease()
+        assert leader.role == "standby"
+        with pytest.raises(NotLeaderError):
+            leader.table("models").put("z", {"id": "z"})
+        # No resurrection path: renewing again still refuses.
+        with pytest.raises(NotLeaderError):
+            leader.renew_lease()
+
+    def test_restarted_leader_defers_to_peer_with_higher_term(self):
+        """A restarted fenced leader (role=leader in its config) probes
+        ha.peers at boot and joins as a standby when a successor holds a
+        higher term."""
+        from dragonfly2_tpu.manager.replication import probe_peer_term
+
+        clock = _Clock()
+        successor = _standby(clock, lease_ttl_s=30.0)
+        successor.promote()  # term 2
+        rest = _rest_for(successor)
+        try:
+            term, url = probe_peer_term([rest.url, "http://127.0.0.1:9"])
+            assert (term, url) == (2, rest.url)
+            old = _leader(clock, lease_ttl_s=30.0)  # reboots at term 1
+            if term > old.term:
+                old.observe_term(term)
+            assert old.role == "standby"
+            with pytest.raises(NotLeaderError):
+                old.table("models").put("z", {"id": "z"})
+        finally:
+            rest.stop()
 
     def test_split_brain_old_leader_post_lease_write_rejected_by_term(self):
         """The acceptance split-brain fence: leader pauses past its
@@ -343,6 +419,162 @@ class TestFollowerOverREST:
         assert follower_backend.term == 2
         assert follower_backend.table("models").get("m1") == {"id": "m1"}
         follower_backend.table("models").put("m2", {"id": "m2"})
+
+
+# ---------------------------------------------------------------------------
+# Replication-fetch auth: the data routes demand the shared secret
+# ---------------------------------------------------------------------------
+
+
+class TestReplicationAuth:
+    def test_log_and_snapshot_refuse_unauthenticated_fetches(self):
+        """The :log/:snapshot routes carry every namespace — users/PATs
+        credential rows included on default deployments — so a fetch
+        without proof of the lease_secret must 403, not dump state."""
+        clock = _Clock()
+        leader = _leader(clock, lease_ttl_s=30.0)
+        leader.table("users").put("root", {"password_hash": "h", "salt": "s"})
+        rest = _rest_for(leader)
+        try:
+            for route in ("replication:snapshot", "replication:log"):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(
+                        f"{rest.url}/api/v1/{route}", timeout=5
+                    )
+                assert err.value.code == 403
+                # A token signed with the WRONG secret fails too.
+                req = urllib.request.Request(
+                    f"{rest.url}/api/v1/{route}",
+                    headers={REPLICATION_AUTH_HEADER: sign_replication_request(
+                        "not-the-secret", f"/api/v1/{route}"
+                    )},
+                )
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(req, timeout=5)
+                assert err.value.code == 403
+        finally:
+            rest.stop()
+
+    def test_secret_holder_fetches_and_follower_sends_the_header(self):
+        clock = _Clock()
+        leader = _leader(clock, lease_ttl_s=30.0)
+        leader.table("crud").put("m1", {"id": "m1"})
+        rest = _rest_for(leader)
+        try:
+            path = "/api/v1/replication:log"
+            req = urllib.request.Request(
+                rest.url + path + "?from_seq=0",
+                headers={REPLICATION_AUTH_HEADER: sign_replication_request(
+                    leader.lease_secret, path
+                )},
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                payload = json.loads(r.read())
+            assert [e["seq"] for e in payload["entries"]] == [1]
+            # The LogFollower authenticates transparently (shared secret).
+            follower = _standby(clock, lease_ttl_s=30.0)
+            LogFollower(follower, rest.url, clock=clock).poll_once()
+            assert follower.table("crud").get("m1") == {"id": "m1"}
+        finally:
+            rest.stop()
+
+    def test_ha_config_refuses_the_default_lease_secret(self):
+        from dragonfly2_tpu.config.schema import (
+            DEFAULT_LEASE_SECRET,
+            ConfigError,
+            HASection,
+        )
+
+        # The schema default and the backend constructor default are the
+        # same placeholder (kept in sync by hand across the layers).
+        import inspect
+
+        sig = inspect.signature(ReplicatedStateBackend.__init__)
+        assert sig.parameters["lease_secret"].default == DEFAULT_LEASE_SECRET
+
+        HASection().validate()  # HA off: the placeholder is fine
+        with pytest.raises(ConfigError):
+            HASection(enable=True).validate()
+        with pytest.raises(ConfigError):
+            HASection(replicate_from="http://leader:1").validate()
+        with pytest.raises(ConfigError):
+            HASection(enable=True, lease_secret="short").validate()
+        HASection(enable=True, lease_secret="x" * 16).validate()
+
+
+# ---------------------------------------------------------------------------
+# Log compaction: bounded growth, snapshot fallback past the floor
+# ---------------------------------------------------------------------------
+
+
+class TestLogCompaction:
+    def test_leader_truncates_below_the_retention_window(self):
+        clock = _Clock()
+        leader = _leader(clock, lease_ttl_s=3600.0)
+        leader.COMPACT_EVERY = 8
+        leader.RETAIN_OPS = 8
+        t = leader.table("models")
+        for i in range(40):
+            t.put(f"m{i}", {"id": f"m{i}"})
+        entries = leader.log.entries_since(0)
+        assert len(entries) <= 8 + leader.COMPACT_EVERY
+        assert leader.log.floor > 1
+        assert entries[0]["seq"] == leader.log.floor
+        # Data state is complete regardless of what the log retains.
+        assert len(t.load_all()) == 40
+
+    def test_truncation_never_eats_the_unapplied_tail(self, tmp_path):
+        db = str(tmp_path / "s.db")
+        b = ReplicatedStateBackend(SQLiteBackend(db), node_id="L")
+        b.table("models").put("m1", {"id": "m1"})
+        # A crash-pending entry (appended, data commit never ran)...
+        b.log.append({
+            "term": b.term, "ns": "models", "op": "put_many",
+            "items": {"m2": {"id": "m2"}},
+        })
+        # ...survives any truncation request, however aggressive.
+        b.log.truncate_below(10_000)
+        assert [e["seq"] for e in b.log.pending()] == [2]
+        b.close()
+        b2 = ReplicatedStateBackend(SQLiteBackend(db), node_id="L")
+        assert b2.table("models").get("m2") == {"id": "m2"}
+        b2.close()
+
+    def test_sqlite_range_scan_matches_the_base_filter(self, tmp_path):
+        sql = SQLiteBackend(str(tmp_path / "s.db")).table("ns")
+        mem = MemoryBackend().table("ns")
+        for t in (sql, mem):
+            for i in range(10):
+                t.put(f"{i:020d}", {"i": i})
+        assert sql.load_range(f"{4:020d}") == mem.load_range(f"{4:020d}")
+        sql.delete_range(f"{3:020d}")
+        mem.delete_range(f"{3:020d}")
+        assert sql.load_all() == mem.load_all()
+        assert sorted(sql.load_all()) == [f"{i:020d}" for i in range(3, 10)]
+
+    def test_follower_behind_the_floor_rebootstraps_via_snapshot(self):
+        clock = _Clock()
+        leader = _leader(clock, lease_ttl_s=3600.0)
+        leader.COMPACT_EVERY = 4
+        leader.RETAIN_OPS = 4
+        rest = _rest_for(leader)
+        follower_backend = _standby(clock, lease_ttl_s=3600.0)
+        follower = LogFollower(follower_backend, rest.url, clock=clock)
+        try:
+            leader.table("crud").put("m0", {"id": "m0"})
+            follower.poll_once()  # bootstrapped + caught up
+            assert follower_backend.log.applied == leader.log.seq
+            # The leader races far ahead; compaction truncates past the
+            # follower's watermark.
+            for i in range(1, 30):
+                leader.table("crud").put(f"m{i}", {"id": f"m{i}"})
+            assert leader.log.floor > follower_backend.log.applied + 1
+            follower.poll_once()
+            assert follower_backend.log.applied == leader.log.seq
+            assert len(follower_backend.table("crud").load_all()) == 30
+            assert follower.lag_seconds() == 0.0
+        finally:
+            rest.stop()
 
 
 # ---------------------------------------------------------------------------
