@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import argparse
+from typing import Optional
 
 from .. import __version__
 from ..utils import dflog
@@ -36,6 +37,14 @@ def base_parser(prog: str, description: str) -> argparse.ArgumentParser:
              "(cmd/dependency/dependency.go:263-297)",
     )
     p.add_argument(
+        "--trace-log", default=None, metavar="PATH",
+        help="flight recorder: append-only crash-safe trace log "
+             "(length-prefixed, digest-checked OTLP/JSON frames; "
+             "head-sampled by trace id per config tracing.sample_rate) — "
+             "feed per-process logs to tools/trace_assemble.py; "
+             "overrides config tracing.log_path",
+    )
+    p.add_argument(
         "--version", action="version", version=f"%(prog)s {__version__}"
     )
     return p
@@ -57,6 +66,65 @@ def init_tracing(args) -> None:
     from ..utils.tracing import JSONLExporter, default_tracer
 
     default_tracer.exporter = JSONLExporter(args.trace_file)
+
+
+def init_flight_recorder(args, tracing_cfg, service: Optional[str] = None):
+    """Config-driven tracer wiring, called AFTER load_config in every
+    binary (init_tracing handled the pre-config CLI flags): applies the
+    tracing.enable toggle, sizes the /debug/spans recent ring, keeps any
+    --otlp/--trace-file exporter, and attaches the durable flight
+    recorder when --trace-log or tracing.log_path names one.  Returns
+    the DurableSpanExporter (or None) so callers can flush on shutdown.
+    """
+    from ..utils import tracing as tr
+
+    service = service or getattr(args, "_prog", None) or "dragonfly"
+    tr.default_tracer.service = service
+    if tracing_cfg is not None:
+        tr.set_enabled(tracing_cfg.enable)
+    path = getattr(args, "trace_log", None) or (
+        tracing_cfg.log_path if tracing_cfg is not None else ""
+    )
+    ring_spans = tracing_cfg.ring_spans if tracing_cfg is not None else 4096
+    rate = tracing_cfg.sample_rate if tracing_cfg is not None else 1.0
+    exporters = [tr.InMemoryExporter(max_spans=ring_spans)]
+    current = tr.default_tracer.exporter
+    if not isinstance(current, (tr.InMemoryExporter, tr.CompositeExporter)):
+        exporters.append(current)  # the --otlp/--trace-file choice rides along
+    durable = None
+    if path:
+        durable = tr.DurableSpanExporter(path, service=service, sample_rate=rate)
+        exporters.append(durable)
+    tr.default_tracer.exporter = (
+        exporters[0] if len(exporters) == 1 else tr.CompositeExporter(exporters)
+    )
+    return durable
+
+
+def init_diagnostics(cfg_metrics, service: str):
+    """The uniform /metrics + /debug/spans + /debug/exemplars sidecar on
+    the scheduler and daemon (the manager serves the same routes on its
+    REST port).  Gated behind config ``metrics.enable``; port conflicts
+    degrade to a warning — diagnostics must never keep a plane down."""
+    if cfg_metrics is None or not cfg_metrics.enable:
+        return None
+    try:
+        from ..utils.diagnostics import DiagnosticsServer
+
+        srv = DiagnosticsServer(port=cfg_metrics.port)
+        srv.serve()
+        print(
+            f"{service}: diagnostics on {srv.url}/metrics "
+            f"(+ /debug/spans, /debug/exemplars)", flush=True,
+        )
+        return srv
+    except OSError as exc:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s: diagnostics endpoint not started (%s)", service, exc
+        )
+        return None
 
 
 def init_debug(args) -> None:

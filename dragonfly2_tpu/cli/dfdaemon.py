@@ -23,7 +23,14 @@ from ..scheduler.resource import Host
 from ..source import PieceSourceFetcher
 from ..utils import idgen
 from ..utils.ping import make_host_pinger
-from .common import base_parser, init_debug, init_logging, init_tracing
+from .common import (
+    base_parser,
+    init_debug,
+    init_diagnostics,
+    init_flight_recorder,
+    init_logging,
+    init_tracing,
+)
 
 
 def build(cfg: DaemonConfig, scheduler_url: str):
@@ -181,6 +188,8 @@ def run(argv=None) -> int:
     init_tracing(args)
 
     cfg = load_config(DaemonConfig, args.config)
+    init_flight_recorder(args, cfg.tracing, "dfdaemon")
+    init_diagnostics(cfg.metrics, "dfdaemon")
     parts = build(cfg, args.scheduler)
 
     pex = None

@@ -15,7 +15,13 @@ from ..config import ManagerConfig, load_config
 from ..jobs import JobQueue
 from ..manager import ClusterManager, ModelRegistry, Searcher
 from ..manager.registry import BlobStore
-from .common import base_parser, init_debug, init_logging, init_tracing
+from .common import (
+    base_parser,
+    init_debug,
+    init_flight_recorder,
+    init_logging,
+    init_tracing,
+)
 
 
 def _build_consumers(cfg: ManagerConfig, backend, blob_store):
@@ -146,6 +152,7 @@ def run(argv=None) -> int:
     init_tracing(args)
 
     cfg = load_config(ManagerConfig, args.config)
+    init_flight_recorder(args, cfg.tracing, "manager")
     parts = build(cfg, replicate_from=args.replicate_from)
 
     if args.list_models:

@@ -24,7 +24,14 @@ from ..scheduler import (
     new_evaluator,
 )
 from ..utils import gc as dfgc
-from .common import base_parser, init_debug, init_logging, init_tracing
+from .common import (
+    base_parser,
+    init_debug,
+    init_diagnostics,
+    init_flight_recorder,
+    init_logging,
+    init_tracing,
+)
 
 
 def build(cfg: SchedulerConfigFile):
@@ -114,6 +121,8 @@ def run(argv=None) -> int:
     init_tracing(args)
 
     cfg = load_config(SchedulerConfigFile, args.config)
+    init_flight_recorder(args, cfg.tracing, "scheduler")
+    init_diagnostics(cfg.metrics, "scheduler")
     service, storage, runner = build(cfg)
 
     # Durable probe graph (the Redis-persistence analog): reload the

@@ -17,7 +17,13 @@ from ..config import TrainerConfigFile, load_config
 from ..manager.registry import ModelRegistry
 from ..trainer.service import TrainerService
 from ..trainer.train import TrainConfig
-from .common import base_parser, init_debug, init_logging, init_tracing
+from .common import (
+    base_parser,
+    init_debug,
+    init_flight_recorder,
+    init_logging,
+    init_tracing,
+)
 
 
 def run(argv=None) -> int:
@@ -34,6 +40,7 @@ def run(argv=None) -> int:
     init_tracing(args)
 
     cfg = load_config(TrainerConfigFile, args.config)
+    init_flight_recorder(args, cfg.tracing, "trainer")
     manager_addr = args.manager or cfg.manager_addr
     if manager_addr and manager_addr.startswith("grpc://"):
         from ..rpc.grpc_transport import GRPCRemoteRegistry

@@ -49,6 +49,29 @@ class MetricsConfig:
 
 
 @dataclass
+class TracingSection:
+    """Flight recorder (utils/tracing.py DurableSpanExporter; DESIGN.md
+    §21).  ``log_path`` turns on the per-process crash-safe trace log —
+    append-only OTLP/JSON frames any plane's log feeds straight into
+    ``tools/trace_assemble.py``.  ``sample_rate`` head-samples BY TRACE
+    ID (deterministic across processes, so a kept trace is kept on every
+    plane); the default 0.1 holds serving-path overhead under the ≤3%
+    bar (BENCHMARKS.md).  ``ring_spans`` bounds the in-memory recent ring
+    the ``/debug/spans`` endpoint dumps."""
+
+    enable: bool = True
+    log_path: str = ""
+    sample_rate: float = 0.1
+    ring_spans: int = 4096
+
+    def validate(self) -> None:
+        if not (0.0 <= self.sample_rate <= 1.0):
+            raise ConfigError("tracing.sample_rate must be in [0, 1]")
+        if self.ring_spans < 1:
+            raise ConfigError("tracing.ring_spans must be >= 1")
+
+
+@dataclass
 class LogConfig:
     level: str = "info"
     dir: str = ""
@@ -168,6 +191,7 @@ class SchedulerConfigFile:
     trainer: TrainerLinkSection = field(default_factory=TrainerLinkSection)
     gc: GCSection = field(default_factory=GCSection)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    tracing: TracingSection = field(default_factory=TracingSection)
     log: LogConfig = field(default_factory=LogConfig)
     manager_addr: str = ""
     # Bearer credential (PAT or session token) for the manager's RBAC'd
@@ -187,6 +211,7 @@ class SchedulerConfigFile:
         self.server.validate()
         self.scheduling.validate()
         self.log.validate()
+        self.tracing.validate()
 
 
 @dataclass
@@ -211,12 +236,14 @@ class TrainerConfigFile:
     data_dir: str = "/var/lib/dragonfly/trainer"
     manager_addr: str = ""
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    tracing: TracingSection = field(default_factory=TracingSection)
     log: LogConfig = field(default_factory=LogConfig)
 
     def validate(self) -> None:
         self.server.validate()
         self.training.validate()
         self.log.validate()
+        self.tracing.validate()
 
 
 @dataclass
@@ -323,11 +350,13 @@ class ManagerConfig:
     jobs_min_requeue_s: float = 30.0
     ha: HASection = field(default_factory=HASection)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    tracing: TracingSection = field(default_factory=TracingSection)
     log: LogConfig = field(default_factory=LogConfig)
 
     def validate(self) -> None:
         self.server.validate()
         self.log.validate()
+        self.tracing.validate()
         self.rollout.validate()
         self.ha.validate()
         if self.token_secret and len(self.token_secret.encode()) < 16:
@@ -387,11 +416,13 @@ class DaemonConfig:
     total_rate_limit: float = 1e9
     probe_interval_s: float = 20 * 60.0
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    tracing: TracingSection = field(default_factory=TracingSection)
     log: LogConfig = field(default_factory=LogConfig)
 
     def validate(self) -> None:
         self.server.validate()
         self.log.validate()
+        self.tracing.validate()
         if self.piece_size < 4096:
             raise ConfigError(f"piece_size {self.piece_size} too small")
 

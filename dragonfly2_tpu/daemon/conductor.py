@@ -330,10 +330,12 @@ class Conductor:
         def worker() -> None:
             try:
                 if traceparent is not None:
-                    # ONE span per worker (not per piece — a 10k-piece
-                    # task must not emit 10k spans), linked into the
-                    # caller's download trace so the worker thread's own
-                    # RPCs propagate the same trace id.
+                    # One span per worker, linked into the caller's
+                    # download trace so the worker thread's own RPCs and
+                    # its per-piece ``daemon/piece`` spans propagate the
+                    # same trace id (the durable log head-samples by
+                    # trace id, so a 10k-piece task only lands in full
+                    # on sampled traces).
                     from ..utils.tracing import default_tracer
 
                     with default_tracer.remote_span(
@@ -728,7 +730,18 @@ class Conductor:
 
         def fetch_one(number: int) -> bool:
             """Fetch piece `number`; True on success, False → task-level
-            abort is set."""
+            abort is set.  One ``daemon/piece`` span per piece (bytes,
+            parent, retry count — the flight recorder's per-piece
+            evidence; head-sampling keeps a 10k-piece task from flooding
+            the durable log on every trace)."""
+            from ..utils.tracing import default_tracer
+
+            with default_tracer.span(
+                "daemon/piece", number=number, task_id=task.id
+            ) as piece_span:
+                return fetch_one_traced(number, piece_span)
+
+        def fetch_one_traced(number: int, piece_span) -> bool:
             deadline = time.monotonic() + self.piece_wait_timeout_s
             attempt = 0
             while not state.abort.is_set():
@@ -775,9 +788,13 @@ class Conductor:
                         return False
                     attempt += 1
                     if attempt > self.max_piece_retries:
+                        piece_span.set(retries=attempt, failed=True)
                         state.abort.set()
                         return False
                     continue
+                piece_span.set(
+                    parent=parent.id, bytes=len(data), retries=attempt
+                )
                 self.storage.write_piece(task.id, number, data)
                 run.mark_piece(number)
                 with state.lock:
@@ -928,16 +945,21 @@ class Conductor:
     ) -> int:
         """Fetch piece `number` from the origin, persist + report it."""
         from ..source.client import call_with_optional_headers
+        from ..utils.tracing import default_tracer
 
         task = peer.task
         t_piece = time.monotonic()
-        try:
-            data = call_with_optional_headers(
-                self.source_fetcher.fetch, task.url, number, piece_size,
-                headers=headers,
-            )
-        except Exception:
-            raise _SourceFetchError(f"source fetch piece {number}")
+        with default_tracer.span(
+            "daemon/source.piece", number=number, task_id=task.id
+        ) as piece_span:
+            try:
+                data = call_with_optional_headers(
+                    self.source_fetcher.fetch, task.url, number, piece_size,
+                    headers=headers,
+                )
+            except Exception:
+                raise _SourceFetchError(f"source fetch piece {number}")
+            piece_span.set(bytes=len(data))
         expected = _expected_piece_len(task.content_length, piece_size, number)
         if expected >= 0 and len(data) != expected:
             # A short origin body persisted as a full piece would be

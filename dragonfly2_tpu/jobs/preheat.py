@@ -35,11 +35,18 @@ def preheat(
     piece_size: int = 4 << 20,
 ) -> PreheatJob:
     """Fan a preheat of the URLs out to every target scheduler's queue."""
-    per_queue = {
-        q: {"urls": list(urls), "piece_size": piece_size} for q in scheduler_queues
-    }
-    group = broker.create_group_job(PREHEAT, per_queue)
-    return PreheatJob(group=group, urls=list(urls))
+    from ..utils.tracing import default_tracer
+
+    with default_tracer.span(
+        "jobs/preheat", urls=len(urls), queues=len(scheduler_queues)
+    ) as span:
+        per_queue = {
+            q: {"urls": list(urls), "piece_size": piece_size}
+            for q in scheduler_queues
+        }
+        group = broker.create_group_job(PREHEAT, per_queue)
+        span.set(group_id=group.id)
+        return PreheatJob(group=group, urls=list(urls))
 
 
 def preheat_image(
@@ -52,17 +59,23 @@ def preheat_image(
 ) -> PreheatJob:
     """Resolve an image's layer blobs and fan them out (the console's
     type=image preheat: manager/job/preheat.go:90-167)."""
-    resolved = resolver.resolve_layers(manifest_url)
-    per_queue = {
-        q: {
-            "urls": list(resolved.urls),
-            "piece_size": piece_size,
-            "headers": dict(resolved.headers),
+    from ..utils.tracing import default_tracer
+
+    with default_tracer.span(
+        "jobs/preheat", image=manifest_url, queues=len(scheduler_queues)
+    ) as span:
+        resolved = resolver.resolve_layers(manifest_url)
+        per_queue = {
+            q: {
+                "urls": list(resolved.urls),
+                "piece_size": piece_size,
+                "headers": dict(resolved.headers),
+            }
+            for q in scheduler_queues
         }
-        for q in scheduler_queues
-    }
-    group = broker.create_group_job(PREHEAT, per_queue)
-    return PreheatJob(group=group, urls=list(resolved.urls))
+        group = broker.create_group_job(PREHEAT, per_queue)
+        span.set(group_id=group.id, urls=len(resolved.urls))
+        return PreheatJob(group=group, urls=list(resolved.urls))
 
 
 def make_preheat_handler(seed_daemon, *, content_length_for=None):
@@ -73,6 +86,17 @@ def make_preheat_handler(seed_daemon, *, content_length_for=None):
     """
 
     def handler(args: Dict) -> Dict:
+        from ..utils.tracing import default_tracer
+
+        # The worker-side half of the fan-out: one span per executed
+        # preheat job, so the manager's jobs/preheat span and each
+        # scheduler's execution land in the same flight-recorder story.
+        with default_tracer.span(
+            "jobs/preheat.execute", urls=len(args["urls"])
+        ):
+            return _execute(args)
+
+    def _execute(args: Dict) -> Dict:
         from ..source.client import call_with_optional_headers
 
         headers = args.get("headers") or None

@@ -467,14 +467,29 @@ class ReplicatedStateBackend(StateBackend):
     ) -> None:
         """Write-ahead append (term+seq) then the data commit, under one
         lock so the log order IS the commit order."""
+        from ..utils.tracing import default_tracer
+
         faultinject.fire(f"state.replicate.{op}")
         if self._is_applying():
             fn()
             return
+        # Span OUTSIDE the commit lock: a span closing while a project
+        # lock is held would hand the lock witness a lock→exporter edge
+        # the static graph (which doesn't traverse generator
+        # contextmanagers) can never corroborate.
+        with default_tracer.span(
+            "manager/replicate.commit", ns=ns, op=op
+        ) as span:
+            self._commit_op_locked(ns, op, payload, fn, span)
+
+    def _commit_op_locked(
+        self, ns: str, op: str, payload: dict, fn: Callable[[], None], span
+    ) -> None:
         with self._mu:
             self._check_writable_locked()
             entry = dict(payload, term=self._term, ns=ns, op=op)
             seq = self.log.append(entry)
+            span.set(seq=seq, term=self._term)
             try:
                 fn()
             except BaseException:

@@ -272,6 +272,21 @@ class ManagerRESTServer:
                 return False
 
             def do_GET(self):
+                # Request span linked to the caller's trace (otelgrpc
+                # server-interceptor analog for the REST plane): the
+                # route rides as an attribute, not the span name, so
+                # cardinality stays bounded.
+                from ..utils.tracing import TRACEPARENT_HEADER, default_tracer
+
+                with default_tracer.remote_span(
+                    "manager/GET",
+                    self.headers.get(TRACEPARENT_HEADER),
+                    path=urllib.parse.urlsplit(self.path).path,
+                    transport="rest",
+                ):
+                    self._handle_GET()
+
+            def _handle_GET(self):
                 if self._rate_limited():
                     return
                 parsed = urllib.parse.urlsplit(self.path)
@@ -293,6 +308,31 @@ class ManagerRESTServer:
                         payload["role"] = server.ha.role
                         payload["term"] = server.ha.term
                     self._json(200, payload)
+                elif path == "/metrics":
+                    # Prometheus text exposition — the same diagnostics
+                    # surface the scheduler/daemon serve via
+                    # utils/diagnostics.py (DESIGN.md §21).
+                    from ..utils.metrics import default_registry
+
+                    body = default_registry.expose_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/debug/spans":
+                    # Recent-span ring as one OTLP/JSON request.
+                    from ..utils.tracing import recent_spans_otlp
+
+                    self._json(200, recent_spans_otlp())
+                elif path == "/debug/exemplars":
+                    # Histogram exemplars: last trace id per bucket, so a
+                    # slow-bucket latency joins to its trace.
+                    from ..utils.metrics import default_registry
+
+                    self._json(200, default_registry.exemplars())
                 elif path == "/api/v1/replication:status":
                     # Follower poll target: log frontier + the signed
                     # lease (manager/replication.py LogFollower).
@@ -587,6 +627,17 @@ class ManagerRESTServer:
                 return json.loads(self.rfile.read(length) or b"{}")
 
             def do_POST(self):
+                from ..utils.tracing import TRACEPARENT_HEADER, default_tracer
+
+                with default_tracer.remote_span(
+                    "manager/POST",
+                    self.headers.get(TRACEPARENT_HEADER),
+                    path=urllib.parse.urlsplit(self.path).path,
+                    transport="rest",
+                ):
+                    self._handle_POST()
+
+            def _handle_POST(self):
                 if self._rate_limited():
                     return
                 if self._standby_rejected():

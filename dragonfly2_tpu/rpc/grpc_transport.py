@@ -228,8 +228,18 @@ class SchedulerGRPCServer:
         import queue
         import threading
 
+        from ..utils.tracing import TRACEPARENT_HEADER, default_tracer
         from .metrics import GRPC_REQUESTS_TOTAL
         from .scheduler_server import schedule_to_wire
+
+        # The stream's traceparent arrives ONCE in the invocation
+        # metadata (one bidi stream per daemon); every dispatched message
+        # opens its handler span against it so the stream wire has the
+        # same flight-recorder coverage as the unary wire (DF016).
+        stream_traceparent = None
+        for key, value in context.invocation_metadata():
+            if key == TRACEPARENT_HEADER:
+                stream_traceparent = value
 
         out: "queue.Queue" = queue.Queue()
         registered: dict = {}  # peer_id → THIS stream's push callback
@@ -281,9 +291,13 @@ class SchedulerGRPCServer:
                         continue
                     method, body_field = entry
                     try:
-                        body = self.adapter.dispatch(
-                            method, proto_to_dict(getattr(req, kind))
-                        )
+                        with default_tracer.remote_span(
+                            f"rpc/{method}", stream_traceparent,
+                            transport="grpc-stream",
+                        ):
+                            body = self.adapter.dispatch(
+                                method, proto_to_dict(getattr(req, kind))
+                            )
                         dict_to_proto_into(body, getattr(resp, body_field))
                         GRPC_REQUESTS_TOTAL.inc(
                             service="scheduler", method=f"stream/{method}",
@@ -547,7 +561,16 @@ class GRPCStreamingScheduler(GRPCRemoteScheduler):
                         return
                     yield item
 
-            call = self._stream_stub(request_iter())
+            # Stream-scoped traceparent: the download span active at
+            # stream open rides the invocation metadata once; the server
+            # links every per-message handler span to it (the unary wire
+            # injects per call — a stream only gets this one chance).
+            from ..utils.tracing import default_tracer
+
+            call = self._stream_stub(
+                request_iter(),
+                metadata=tuple(default_tracer.inject().items()) or None,
+            )
 
             def read_loop():
                 try:

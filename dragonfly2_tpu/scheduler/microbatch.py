@@ -234,6 +234,26 @@ class ScorerBatcher:
             self._dispatch_group(group, engine)
 
     def _dispatch_group(self, batch: List[_Request], scorer) -> None:
+        # One ``scheduler/eval.flush`` span per coalesced scorer call
+        # (per flush, never per announce): batch size + the dftrace
+        # compile counter ride as attributes, so a slow flush in a trace
+        # is immediately attributable to a steady-state retrace
+        # (DESIGN.md §17/§21).
+        from ..utils import dftrace
+        from ..utils.tracing import default_tracer
+
+        witness = dftrace.witness()
+        with default_tracer.span(
+            "scheduler/eval.flush",
+            batch=len(batch),
+            rows=sum(r.features.shape[0] for r in batch),
+            jit_compiles=(
+                witness.total_compiles() if witness is not None else 0
+            ),
+        ):
+            self._dispatch_group_traced(batch, scorer)
+
+    def _dispatch_group_traced(self, batch: List[_Request], scorer) -> None:
         try:
             if scorer is None:
                 raise ScorerUnavailable("scorer deactivated while queued")

@@ -1026,12 +1026,21 @@ class OnlineGraphTrainer:
             from ..utils import faultinject
 
             faultinject.fire("trainer.dispatch")
-            self.apply_pending_recycles()
-            es, ed, y = block
-            self.state, loss = self._dispatch_fn(
-                self.state, self.hop_feats, self.table,
-                jnp.asarray(es), jnp.asarray(ed), jnp.asarray(y),
-            )
+            from ..utils.tracing import default_tracer
+
+            # Dispatch span (flight recorder, DESIGN.md §21): one per
+            # trained block, so online-training stalls line up against
+            # the download/announce traces feeding them.
+            with default_tracer.span(
+                "trainer/dispatch", dispatch=self.dispatch,
+                records=int(block[0].size),
+            ):
+                self.apply_pending_recycles()
+                es, ed, y = block
+                self.state, loss = self._dispatch_fn(
+                    self.state, self.hop_feats, self.table,
+                    jnp.asarray(es), jnp.asarray(ed), jnp.asarray(y),
+                )
             self.dispatch += 1
             ran += 1
             self.records_seen += es.size

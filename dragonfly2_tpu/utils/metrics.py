@@ -10,7 +10,31 @@ from __future__ import annotations
 
 import bisect
 import threading
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline (exposition-format spec).  Without this a hostile
+    label value (a URL with a quote, a multi-line error string) splits
+    the sample line and corrupts every series after it in the scrape."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _escape_help(v: str) -> str:
+    """# HELP text escaping: backslash and newline only (quotes are legal
+    in help text per the exposition format)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _current_trace_id() -> Optional[str]:
+    """Active trace id on this thread (exemplar hook): one thread-local
+    read through the tracer — cheap enough for per-observe use."""
+    from .tracing import current_trace_id
+
+    return current_trace_id()
 
 
 class _Metric:
@@ -36,7 +60,10 @@ class _Metric:
     def _fmt_labels(self, key: Tuple[str, ...]) -> str:
         if not key:
             return ""
-        inner = ",".join(f'{n}="{v}"' for n, v in zip(self.label_names, key))
+        inner = ",".join(
+            f'{n}="{_escape_label_value(v)}"'
+            for n, v in zip(self.label_names, key)
+        )
         return "{" + inner + "}"
 
 
@@ -77,7 +104,7 @@ class Counter(_Metric):
             return self._values.get(self._key(labels), 0.0)
 
     def expose(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        out = [f"# HELP {self.name} {_escape_help(self.help)}", f"# TYPE {self.name} counter"]
         with self._mu:
             for key, v in sorted(self._values.items()):
                 out.append(f"{self.name}{self._fmt_labels(key)} {v}")
@@ -106,7 +133,7 @@ class Gauge(_Metric):
             return self._values.get(self._key(labels), 0.0)
 
     def expose(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        out = [f"# HELP {self.name} {_escape_help(self.help)}", f"# TYPE {self.name} gauge"]
         with self._mu:
             for key, v in sorted(self._values.items()):
                 out.append(f"{self.name}{self._fmt_labels(key)} {v}")
@@ -132,6 +159,7 @@ class _HistogramChild:
         m = self._metric
         idx = bisect.bisect_left(m.buckets, value)
         key = self._key_t
+        tid = _current_trace_id()
         with m._mu:
             counts = self._counts
             if counts is None:
@@ -143,6 +171,8 @@ class _HistogramChild:
                 counts[idx] += 1
             m._sums[key] = m._sums.get(key, 0.0) + value
             m._totals[key] = m._totals.get(key, 0) + 1
+            if tid is not None:
+                m._exemplars.setdefault(key, {})[idx] = tid
 
 
 class Histogram(_Metric):
@@ -158,6 +188,11 @@ class Histogram(_Metric):
         self._counts: Dict[Tuple[str, ...], List[int]] = {}
         self._sums: Dict[Tuple[str, ...], float] = {}
         self._totals: Dict[Tuple[str, ...], int] = {}
+        # Exemplars: last trace id observed per (key, bucket) — recorded
+        # under the existing metric lock (one dict store when a span is
+        # active, nothing otherwise), exposed as /debug/exemplars JSON so
+        # a slow-bucket latency joins to its flight-recorder trace.
+        self._exemplars: Dict[Tuple[str, ...], Dict[int, str]] = {}
 
     def observe(self, value: float, **labels: str) -> None:
         # Counts are stored PER-BUCKET (one increment per observe) and
@@ -167,6 +202,7 @@ class Histogram(_Metric):
 
     def _observe_key(self, key: Tuple[str, ...], value: float) -> None:
         idx = bisect.bisect_left(self.buckets, value)
+        tid = _current_trace_id()
         with self._mu:
             counts = self._counts.get(key)
             if counts is None:
@@ -175,12 +211,29 @@ class Histogram(_Metric):
                 counts[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+            if tid is not None:
+                self._exemplars.setdefault(key, {})[idx] = tid
 
     def labels(self, **labels: str) -> "_HistogramChild":
         return _HistogramChild(self, self._key(labels))
 
+    def exemplars(self) -> Dict[str, Dict[str, str]]:
+        """``{label-set: {le: trace_id}}`` — the last trace id observed
+        per bucket (``le`` is the bucket's upper bound, ``+Inf`` for the
+        overflow bucket)."""
+        with self._mu:
+            snap = {k: dict(v) for k, v in self._exemplars.items()}
+        out: Dict[str, Dict[str, str]] = {}
+        for key, per_bucket in snap.items():
+            label_str = self._fmt_labels(key) or "{}"
+            out[label_str] = {
+                (str(self.buckets[i]) if i < len(self.buckets) else "+Inf"): tid
+                for i, tid in sorted(per_bucket.items())
+            }
+        return out
+
     def expose(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        out = [f"# HELP {self.name} {_escape_help(self.help)}", f"# TYPE {self.name} histogram"]
         with self._mu:
             for key, counts in sorted(self._counts.items()):
                 base = self._fmt_labels(key)[1:-1] if key else ""
@@ -234,6 +287,19 @@ class Registry:
         for m in metrics:
             lines.extend(m.expose())
         return "\n".join(lines) + "\n"
+
+    def exemplars(self) -> Dict[str, Dict[str, Dict[str, str]]]:
+        """Every histogram's per-bucket exemplars (``/debug/exemplars``):
+        {metric: {label-set: {le: trace_id}}}, empty sets omitted."""
+        with self._mu:
+            metrics = list(self._metrics.values())
+        out: Dict[str, Dict[str, Dict[str, str]]] = {}
+        for m in metrics:
+            if isinstance(m, Histogram):
+                ex = m.exemplars()
+                if ex:
+                    out[m.name] = ex
+        return out
 
 
 # Process-default registry (services may create their own for isolation).

@@ -1,0 +1,61 @@
+"""Subprocess body for the flight-recorder chaos drill
+(tests/test_trace_chaos.py).
+
+A wire daemon with its OWN durable trace log: registers with the
+parent's scheduler over HTTP, pulls pieces from the warm parent over the
+piece plane, and — via a ``crash`` FaultSpec on the
+``rpc.client.report_piece_finished`` seam (DF_FAULTINJECT) — SIGKILLs
+itself at a deterministic piece report, mid-download.  The spans that
+finished before the kill are already durable (the exporter writes one
+digest-checked frame per span at export time); everything in flight dies
+with the process, exactly like production.  The parent test then proves
+``tools/trace_assemble.py`` reconstructs the end-to-end trace from this
+log plus the scheduler's.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from dragonfly2_tpu.utils import faultinject, tracing  # noqa: E402
+
+
+def main():
+    scheduler_url, store_dir, trace_log, url = sys.argv[1:5]
+    content_length, piece_size = int(sys.argv[5]), int(sys.argv[6])
+    faultinject.install_from_env()
+    tracing.default_tracer.service = "dfdaemon"
+    tracing.default_tracer.exporter = tracing.DurableSpanExporter(
+        trace_log, service="dfdaemon", sample_rate=1.0
+    )
+
+    from dragonfly2_tpu.daemon import DaemonStorage
+    from dragonfly2_tpu.daemon.conductor import Conductor
+    from dragonfly2_tpu.rpc import HTTPPieceFetcher, RemoteScheduler
+    from dragonfly2_tpu.scheduler.resource import Host
+
+    host = Host(
+        id="trace-child", hostname="trace-child", ip="127.0.0.1",
+        port=8002, download_port=1,
+    )
+    host.stats.network.idc = "idc-a"
+    client = RemoteScheduler(scheduler_url, timeout=5.0)
+    storage = DaemonStorage(store_dir, prefer_native=False)
+    conductor = Conductor(
+        host, storage, client,
+        piece_fetcher=HTTPPieceFetcher(client.resolve_host, timeout=5.0),
+        source_fetcher=None,
+        piece_parallelism=2,
+    )
+    print("trace-child: ready", flush=True)
+    r = conductor.download(
+        url, piece_size=piece_size, content_length=content_length
+    )
+    print(json.dumps({"ok": r.ok, "pieces": r.pieces}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

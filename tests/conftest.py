@@ -99,6 +99,22 @@ if os.environ.get("DF_CRASH_WITNESS", "1") != "0":
 
     _dfcrash.install(str(_REPO / "dragonfly2_tpu"))
 
+# -- 2d. span witness (dfspan) ----------------------------------------------
+# Installed alongside dfcrash: wraps Tracer.span/remote_span so every
+# span OPENED from project code during the suite records its caller
+# module + name.  tests/test_zz_spanwitness.py cross-validates the
+# observations against DF016's REQUIRED_SPANS inventory
+# (tools/dflint/checkers/df016_spans.py) — the runtime half of the
+# span-coverage contract (DESIGN.md §21).  Set DF_SPAN_WITNESS=0 to
+# disable.
+
+if os.environ.get("DF_SPAN_WITNESS", "1") != "0":
+    if str(_REPO) not in sys.path:
+        sys.path.insert(0, str(_REPO))
+    from dragonfly2_tpu.utils import dfspan as _dfspan
+
+    _dfspan.install(str(_REPO / "dragonfly2_tpu"))
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -3379,3 +3379,162 @@ class TestCLIStateRules:
         out = capsys.readouterr().out
         assert "<!-- dflint:fsm-graph:begin -->" in out
         assert "digraph peer {" in out
+
+
+# ---------------------------------------------------------------------------
+# DF016 fixtures — span coverage (flight recorder, DESIGN.md §21) — plus
+# mutation sensitivity against the REAL tree
+# ---------------------------------------------------------------------------
+
+
+class TestDF016Fixtures:
+    def test_adapter_dispatch_without_remote_span_fires(self):
+        fs = lint(
+            """
+            def do_POST(self, adapter, method, req):
+                resp = adapter.dispatch(method, req)
+                return resp
+            """,
+            relpath="dragonfly2_tpu/rpc/fixture_server.py",
+        )
+        assert "DF016" in rules_of(fs)
+
+    def test_adapter_dispatch_under_remote_span_ok(self):
+        fs = lint(
+            """
+            from ..utils.tracing import default_tracer
+
+            def do_POST(self, adapter, method, req, headers):
+                with default_tracer.remote_span(
+                    f"rpc/{method}", headers.get("traceparent")
+                ):
+                    resp = adapter.dispatch(method, req)
+                return resp
+            """,
+            relpath="dragonfly2_tpu/rpc/fixture_server.py",
+        )
+        assert "DF016" not in rules_of(fs)
+
+    def test_non_adapter_dispatch_not_flagged(self):
+        # Dict/event dispatchers are not RPC server entries.
+        fs = lint(
+            """
+            def route(self, table, method, req):
+                return table.dispatch(method, req)
+            """,
+            relpath="dragonfly2_tpu/rpc/fixture_server.py",
+        )
+        assert "DF016" not in rules_of(fs)
+
+    def test_inventory_missing_site_fires_by_name(self):
+        fs = lint(
+            """
+            def quiet():
+                return 1
+            """,
+            relpath="dragonfly2_tpu/scheduler/microbatch.py",
+        )
+        assert any(
+            f.rule == "DF016" and "scheduler/eval.flush" in f.message
+            for f in fs
+        )
+
+    def test_inventory_fstring_prefix_matches(self):
+        fs = lint(
+            """
+            from ..utils.tracing import default_tracer
+
+            def handle(self, adapter, method, req, tp):
+                with default_tracer.remote_span(f"rpc/{method}", tp):
+                    return adapter.dispatch(method, req)
+            """,
+            relpath="dragonfly2_tpu/rpc/scheduler_server.py",
+        )
+        assert "DF016" not in rules_of(fs)
+
+    def test_pragma_suppresses(self):
+        fs = lint(
+            """
+            def do_POST(self, adapter, method, req):
+                return adapter.dispatch(method, req)  # dflint: disable=DF016
+            """,
+            relpath="dragonfly2_tpu/rpc/fixture_server.py",
+        )
+        assert "DF016" not in rules_of(fs)
+
+    def test_dict_span_lookalike_not_coverage(self):
+        # A non-tracer receiver's .span() must not satisfy the inventory.
+        fs = lint(
+            """
+            def quiet(layout):
+                layout.span("scheduler/eval.flush")
+            """,
+            relpath="dragonfly2_tpu/scheduler/microbatch.py",
+        )
+        assert any(f.rule == "DF016" for f in fs)
+
+    def test_real_span_modules_satisfy_inventory(self):
+        from tools.dflint.checkers.df016_spans import REQUIRED_SPANS, check
+        from tools.dflint.core import load_module
+
+        for rel in REQUIRED_SPANS:
+            module = load_module(REPO / rel, REPO)
+            findings = [f for f in check(module) if f.rule == "DF016"]
+            assert findings == [], f"{rel}: {[f.message for f in findings]}"
+
+    def test_inventory_not_stale(self):
+        from tools.dflint.checkers.df016_spans import stale_inventory_entries
+
+        assert stale_inventory_entries(REPO) == []
+
+
+class TestDF016MutationSensitivity:
+    def _lint_source(self, relpath: str, source: str):
+        module = Module(REPO / relpath, relpath, source)
+        return run_checkers(module)
+
+    def test_deleting_http_remote_span_fails_df016(self):
+        # The acceptance mutation: strip the HTTP transport's handler
+        # span — BOTH sub-rules must fire (inventory: rpc/* gone;
+        # adjacency: adapter.dispatch with no remote_span in scope).
+        relpath = "dragonfly2_tpu/rpc/scheduler_server.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        assert "remote_span" in source
+        mutated = source.replace(
+            "                    with default_tracer.remote_span(\n"
+            '                        f"rpc/{method}",\n'
+            "                        self.headers.get(TRACEPARENT_HEADER),\n"
+            '                        transport="http",\n'
+            "                    ):\n"
+            "                        resp = adapter.dispatch(method, req)",
+            "                    resp = adapter.dispatch(method, req)",
+        )
+        assert mutated != source, "mutation target drifted"
+        fs = [f for f in self._lint_source(relpath, mutated) if f.rule == "DF016"]
+        assert any("rpc/*" in f.message for f in fs)
+        assert any("remote_span in the same function" in f.message or
+                   "without a remote_span" in f.message for f in fs)
+
+    def test_deleting_piece_span_fails_df016(self):
+        relpath = "dragonfly2_tpu/daemon/conductor.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        assert '"daemon/piece"' in source
+        mutated = source.replace('"daemon/piece"', '"daemon/renamed"')
+        fs = [f for f in self._lint_source(relpath, mutated) if f.rule == "DF016"]
+        assert any("daemon/piece" in f.message for f in fs)
+
+    def test_deleting_flush_span_fails_df016(self):
+        relpath = "dragonfly2_tpu/scheduler/microbatch.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        assert '"scheduler/eval.flush"' in source
+        mutated = source.replace('"scheduler/eval.flush"', '"renamed"')
+        fs = [f for f in self._lint_source(relpath, mutated) if f.rule == "DF016"]
+        assert any("scheduler/eval.flush" in f.message for f in fs)
+
+    def test_cli_rule_filter_selects_df016(self, capsys):
+        from tools.dflint.__main__ import main
+
+        rc = main(["dragonfly2_tpu", "--rule", "DF016", "-q"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "0 new finding(s)" in out

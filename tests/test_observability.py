@@ -1,0 +1,252 @@
+"""Observability satellites (ISSUE 10): Prometheus exposition
+correctness under hostile label values, histogram exemplars, and the
+uniform diagnostics endpoints (/metrics + /debug/spans +
+/debug/exemplars) on every plane.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from dragonfly2_tpu.utils import tracing  # noqa: E402
+from dragonfly2_tpu.utils.metrics import Registry  # noqa: E402
+
+HOSTILE_VALUES = [
+    'quote"inside',
+    "back\\slash",
+    "new\nline",
+    'all\\of"them\ntogether',
+    "trailing\\",
+    '"""',
+    "\n\n",
+    "ünïcode-ok",
+]
+
+_SAMPLE = re.compile(r'^(\w+)\{(.*)\} ([-0-9.e+]+)$')
+_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
+
+
+def parse_exposition(text: str):
+    """Minimal Prometheus text-format consumer: {metric: {labels-tuple:
+    value}}.  Raises on any line that is neither a comment nor a
+    well-formed sample — a split line (unescaped newline in a label)
+    fails here, which is the point."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        if "{" not in line:
+            name, value = line.rsplit(" ", 1)
+            out.setdefault(name, {})[()] = float(value)
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        labels = tuple(
+            (k, _unescape(v)) for k, v in _LABEL.findall(m.group(2))
+        )
+        out.setdefault(m.group(1), {})[labels] = float(m.group(3))
+    return out
+
+
+class TestPromExposition:
+    @pytest.mark.parametrize("value", HOSTILE_VALUES)
+    def test_hostile_label_values_round_trip(self, value):
+        reg = Registry()
+        c = reg.counter("evil_total", "count", ["url"])
+        c.inc(url=value)
+        parsed = parse_exposition(reg.expose_text())
+        assert parsed["evil_total"][(("url", value),)] == 1.0
+
+    def test_hostile_values_do_not_split_following_series(self):
+        reg = Registry()
+        c = reg.counter("first_total", "a", ["v"])
+        g = reg.gauge("second_gauge", "b")
+        for v in HOSTILE_VALUES:
+            c.inc(v=v)
+        g.set(42.0)
+        parsed = parse_exposition(reg.expose_text())
+        assert len(parsed["first_total"]) == len(HOSTILE_VALUES)
+        assert parsed["second_gauge"][()] == 42.0
+
+    def test_help_and_type_lines_emitted_and_escaped(self):
+        reg = Registry()
+        reg.counter("c_total", "multi\nline \\help", ["x"])
+        reg.gauge("g", "gh")
+        reg.histogram("h_seconds", "hh")
+        text = reg.expose_text()
+        assert "# HELP c_total multi\\nline \\\\help\n" in text
+        for line in (
+            "# TYPE c_total counter",
+            "# HELP g gh", "# TYPE g gauge",
+            "# HELP h_seconds hh", "# TYPE h_seconds histogram",
+        ):
+            assert line in text
+        # The escaped HELP stays ONE line.
+        assert sum(1 for ln in text.splitlines() if ln.startswith("# HELP c_total")) == 1
+
+    def test_histogram_exposition_with_hostile_labels(self):
+        reg = Registry()
+        h = reg.histogram("lat_seconds", "lat", ["op"], buckets=(0.1, 1.0))
+        h.observe(0.05, op='a"b\nc\\d')
+        text = reg.expose_text()
+        parsed = parse_exposition(text)
+        key = (("op", 'a"b\nc\\d'), ("le", "0.1"))
+        assert parsed["lat_seconds_bucket"][key] == 1.0
+
+
+class TestHistogramExemplars:
+    def test_last_trace_id_per_bucket(self):
+        reg = Registry()
+        h = reg.histogram("x_seconds", "x", ["op"], buckets=(0.1, 1.0))
+        # Exemplars join to the PROCESS tracer's active span — the same
+        # context the service planes run under.
+        t = tracing.default_tracer
+        with t.span("slow-op") as s1:
+            h.observe(0.05, op="k")
+        with t.span("slower-op") as s2:
+            h.observe(0.5, op="k")
+            h.labels(op="k").observe(5.0)  # +Inf bucket, child path
+        ex = reg.exemplars()["x_seconds"]['{op="k"}']
+        assert ex["0.1"] == s1.trace_id
+        assert ex["1.0"] == s2.trace_id
+        assert ex["+Inf"] == s2.trace_id
+
+    def test_no_active_span_records_nothing(self):
+        reg = Registry()
+        h = reg.histogram("y_seconds", "y")
+        h.observe(0.05)
+        assert reg.exemplars() == {}
+
+    def test_last_write_wins_per_bucket(self):
+        reg = Registry()
+        h = reg.histogram("z_seconds", "z", buckets=(1.0,))
+        t = tracing.default_tracer
+        with t.span("a") as s1:
+            h.observe(0.1)
+        with t.span("b") as s2:
+            h.observe(0.2)
+        assert reg.exemplars()["z_seconds"]["{}"]["1.0"] == s2.trace_id
+        assert s1.trace_id != s2.trace_id
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), resp.read()
+
+
+class TestDiagnosticsServer:
+    @pytest.fixture()
+    def server(self):
+        from dragonfly2_tpu.utils.diagnostics import DiagnosticsServer
+
+        srv = DiagnosticsServer(port=0)
+        srv.serve()
+        yield srv
+        srv.stop()
+
+    def test_metrics_endpoint_serves_default_registry(self, server):
+        from dragonfly2_tpu.utils.metrics import default_registry
+
+        default_registry.counter("diag_probe_total", "probe").inc()
+        status, ctype, body = _get(server.url + "/metrics")
+        assert status == 200 and "text/plain" in ctype
+        assert b"diag_probe_total" in body
+        assert b"# HELP" in body and b"# TYPE" in body
+
+    def test_debug_spans_returns_otlp_request(self, server):
+        import jsonschema
+
+        from dragonfly2_tpu.utils.tracing import (
+            CompositeExporter,
+            InMemoryExporter,
+            default_tracer,
+            otlp_trace_schema,
+        )
+
+        prev = default_tracer.exporter
+        default_tracer.exporter = CompositeExporter(
+            [InMemoryExporter(max_spans=16), prev]
+        )
+        try:
+            with default_tracer.span("diag-probe"):
+                pass
+            status, ctype, body = _get(server.url + "/debug/spans")
+        finally:
+            default_tracer.exporter = prev
+        assert status == 200 and "json" in ctype
+        req = json.loads(body)
+        jsonschema.Draft202012Validator(otlp_trace_schema()).validate(req)
+        names = [
+            s["name"] for s in tracing.log_spans([req])
+        ]
+        assert "diag-probe" in names
+
+    def test_debug_exemplars_json(self, server):
+        from dragonfly2_tpu.utils.metrics import default_registry
+        from dragonfly2_tpu.utils.tracing import default_tracer
+
+        h = default_registry.histogram("diag_lat_seconds", "lat")
+        with default_tracer.span("diag-exemplar") as s:
+            h.observe(0.02)
+        status, _ctype, body = _get(server.url + "/debug/exemplars")
+        assert status == 200
+        payload = json.loads(body)
+        assert any(
+            s.trace_id in per_bucket.values()
+            for metric in payload.values()
+            for per_bucket in metric.values()
+        )
+
+    def test_unknown_route_404(self, server):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server.url + "/nope")
+        assert e.value.code == 404
+
+
+class TestManagerDiagnosticsRoutes:
+    """The manager serves the SAME surface on its REST port."""
+
+    def test_metrics_and_debug_spans(self, tmp_path):
+        from dragonfly2_tpu.manager.cluster import ClusterManager
+        from dragonfly2_tpu.manager.registry import ModelRegistry
+        from dragonfly2_tpu.manager.rest import ManagerRESTServer
+
+        server = ManagerRESTServer(ModelRegistry(), ClusterManager())
+        server.serve()
+        try:
+            status, ctype, body = _get(server.url + "/metrics")
+            assert status == 200 and "text/plain" in ctype
+            assert b"# TYPE" in body
+            status, _, body = _get(server.url + "/debug/spans")
+            assert status == 200
+            json.loads(body)["resourceSpans"]
+            status, _, body = _get(server.url + "/debug/exemplars")
+            assert status == 200
+            json.loads(body)
+        finally:
+            server.stop()

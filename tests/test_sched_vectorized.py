@@ -868,3 +868,10 @@ class TestBenchSchedSmoke:
         assert len(parents_seen) >= 2  # genuinely distinct shapes
         # Vectorized serving must never retrace on the steady state.
         assert out["steady_state_recompiles"]["vector_ml"] == 0
+        # Flight-recorder overhead rounds (ISSUE 10): both arms measured,
+        # the default sampling documented in the JSON.
+        trace = out["tracing_overhead"]
+        assert trace["on_announces_per_sec"] > 0
+        assert trace["off_announces_per_sec"] > 0
+        assert trace["sample_rate"] == 0.1
+        assert "overhead_pct" in trace

@@ -1,0 +1,200 @@
+"""Flight-recorder chaos drill (ISSUE 10 acceptance): SIGKILL a daemon
+mid-download, then reconstruct the end-to-end trace from the surviving
+per-process logs.
+
+Topology: the scheduler runs IN-PROCESS with its own durable trace log
+(handler spans land there); a warm parent daemon serves the piece plane
+over HTTP; the downloading daemon is a REAL subprocess
+(tests/_trace_child.py) with its own trace log, SIGKILLed by a
+deterministic crash fault on its Nth ``report_piece_finished`` RPC —
+mid-download, mid-trace.
+
+Proven:
+
+- ``tools/trace_assemble.py`` stitches the two surviving logs into ONE
+  trace spanning both services, critical path rendered;
+- no torn frame admitted: every replayed frame passed its digest, and
+  every admitted batch validates against the vendored OTLP schema
+  (``--validate``);
+- the kill's signature is visible as anomalies: the child's unexported
+  download/worker spans leave orphans behind.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from dragonfly2_tpu.utils import tracing  # noqa: E402
+from dragonfly2_tpu.utils.faultinject import FaultSpec  # noqa: E402
+
+PIECE = 32 * 1024
+N_PIECES = 8
+
+
+class _Origin:
+    def fetch(self, url, number, piece_size):
+        seed = number & 0xFF
+        return bytes((seed + i) % 251 for i in range(PIECE))
+
+
+class TestFlightRecorderKillDrill:
+    def test_sigkill_mid_download_trace_reassembles(self, tmp_path):
+        from dragonfly2_tpu.daemon import DaemonStorage, UploadManager
+        from dragonfly2_tpu.daemon.conductor import Conductor
+        from dragonfly2_tpu.records.storage import Storage
+        from dragonfly2_tpu.rpc import (
+            HTTPPieceFetcher,
+            PieceHTTPServer,
+            RemoteScheduler,
+            SchedulerHTTPServer,
+        )
+        from dragonfly2_tpu.scheduler import (
+            Evaluator,
+            NetworkTopology,
+            Resource,
+            SchedulerService,
+            Scheduling,
+            SchedulingConfig,
+        )
+        from dragonfly2_tpu.scheduler.resource import Host
+
+        resource = Resource()
+        service = SchedulerService(
+            resource,
+            Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+            Storage(str(tmp_path / "records"), buffer_size=1),
+            NetworkTopology(resource.host_manager),
+        )
+        server = SchedulerHTTPServer(service)
+        server.serve()
+
+        url = "drill://flight-recorder/blob"
+        content_length = N_PIECES * PIECE
+
+        # Warm parent (pieces on disk + registered with the scheduler)
+        # BEFORE the drill exporter installs — its spans stay out of the
+        # drill's logs.
+        pstore = DaemonStorage(str(tmp_path / "parent"), prefer_native=False)
+        upload = UploadManager(pstore)
+        piece_server = PieceHTTPServer(upload)
+        piece_server.serve()
+        phost = Host(
+            id="trace-parent", hostname="trace-parent", ip="127.0.0.1",
+            download_port=piece_server.port,
+        )
+        phost.stats.network.idc = "idc-a"
+        pclient = RemoteScheduler(server.url, timeout=5.0)
+        parent = Conductor(
+            phost, pstore, pclient,
+            piece_fetcher=HTTPPieceFetcher(pclient.resolve_host),
+            source_fetcher=_Origin(),
+        )
+        warm = parent.download(
+            url, piece_size=PIECE, content_length=content_length
+        )
+        assert warm.ok and warm.pieces == N_PIECES
+
+        sched_log = str(tmp_path / "scheduler.dftrace")
+        child_log = str(tmp_path / "daemon.dftrace")
+        prev_exporter = tracing.default_tracer.exporter
+        drill_exporter = tracing.DurableSpanExporter(
+            sched_log, service="scheduler", sample_rate=1.0
+        )
+        tracing.default_tracer.exporter = drill_exporter
+        try:
+            scenario = {
+                "seed": 0,
+                "faults": [
+                    FaultSpec(
+                        site="rpc.client.report_piece_finished",
+                        kind="crash", at=(2,),
+                    ).to_dict()
+                ],
+            }
+            proc = subprocess.Popen(
+                [
+                    sys.executable, str(REPO / "tests" / "_trace_child.py"),
+                    server.url, str(tmp_path / "childstore"), child_log,
+                    url, str(content_length), str(PIECE),
+                ],
+                env={
+                    **os.environ,
+                    "DF_FAULTINJECT": json.dumps(scenario),
+                    "JAX_PLATFORMS": "cpu",
+                    "DF_LOCK_WITNESS": "0",
+                },
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                cwd=str(REPO),
+            )
+            try:
+                out, err = proc.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, err = proc.communicate()
+                pytest.fail(f"child hung: {out!r} {err!r}")
+            # The crash fault SIGKILLs the child mid-download.
+            assert proc.returncode == -signal.SIGKILL, (
+                proc.returncode, out, err,
+            )
+            assert b'"ok"' not in out, "child finished before the kill"
+            # Let in-flight scheduler handler spans close + export.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if drill_exporter.exported >= 3:
+                    break
+                time.sleep(0.05)
+        finally:
+            tracing.default_tracer.exporter = prev_exporter
+            drill_exporter.close()
+            piece_server.stop()
+            server.stop()
+
+        from tools.trace_assemble import build_report, render_report
+
+        # --validate semantics: every admitted frame passes the vendored
+        # OTLP schema; a digest-bad frame would not be admitted at all.
+        report = build_report([sched_log, child_log], validate=True)
+        for log in report["logs"]:
+            assert log["corrupt"] == 0, log    # no torn frame admitted
+            assert log["frames"] > 0, log      # both processes left spans
+        trace = report["trace"]
+        # ONE trace id spans the killed daemon and the scheduler.
+        assert set(trace["services"]) == {"dfdaemon", "scheduler"}
+        # Cross-process reconstruction: the child's piece spans and the
+        # scheduler's handler spans share the trace.
+        assert "piece" in trace["phases"], trace["phases"]
+        assert any(
+            p.startswith(("schedule", "commit", "rpc"))
+            for p in trace["phases"]
+        ), trace["phases"]
+        # Critical path rendered from the surviving spans.
+        assert trace["critical_path"], trace
+        # The kill's signature: the child's download/worker spans never
+        # exported, so their children are orphans.
+        assert any("orphan" in a for a in trace["anomalies"]), trace["anomalies"]
+        # And the human rendering holds the whole story.
+        rendered = render_report(report)
+        assert "Critical path:" in rendered and "Anomalies:" in rendered
+
+        # The child really died mid-download: strictly fewer than
+        # N_PIECES piece spans made it to the durable log.
+        child_spans = list(
+            tracing.log_spans(tracing.replay_trace_log(child_log)[0])
+        )
+        piece_spans = [
+            s for s in child_spans if s["name"] == "daemon/piece"
+        ]
+        assert 0 < len(piece_spans) < N_PIECES

@@ -1,0 +1,388 @@
+"""Flight recorder (DESIGN.md §21): durable trace log framing, head
+sampling, the tracing toggle, traceparent fuzzing through the parser and
+both transports, and cross-process trace assembly with critical-path
+analysis (tools/trace_assemble.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import zlib
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from dragonfly2_tpu.utils import tracing  # noqa: E402
+
+
+@pytest.fixture()
+def tracer(tmp_path):
+    """A scoped tracer with a durable log; default tracer untouched."""
+    path = str(tmp_path / "proc.dftrace")
+    exporter = tracing.DurableSpanExporter(path, service="test")
+    t = tracing.Tracer("test", exporter)
+    yield t, path, exporter
+    exporter.close()
+
+
+class TestDurableTraceLog:
+    def test_roundtrip_and_schema(self, tracer):
+        import jsonschema
+
+        t, path, _ = tracer
+        with t.span("a", x=1, big=2**40, f=0.5, flag=True):
+            with t.span("b"):
+                pass
+        requests, stats = tracing.replay_trace_log(path)
+        assert stats == {"frames": 2, "corrupt": 0, "torn_tail": False}
+        spans = list(tracing.log_spans(requests))
+        assert {s["name"] for s in spans} == {"a", "b"}
+        assert all(s["service"] == "test" for s in spans)
+        # Every durable batch validates against the vendored OTLP schema.
+        validator = jsonschema.Draft202012Validator(
+            tracing.otlp_trace_schema()
+        )
+        for req in requests:
+            validator.validate(req)
+
+    def test_torn_tail_tolerated(self, tracer):
+        t, path, _ = tracer
+        with t.span("a"):
+            pass
+        with open(path, "ab") as f:
+            f.write(b"DFTL1 9999 00000000\n{\"resourceSpans")  # SIGKILL mid-append
+        requests, stats = tracing.replay_trace_log(path)
+        assert stats["frames"] == 1
+        assert stats["torn_tail"] is True
+        assert stats["corrupt"] == 0
+
+    def test_digest_bad_frame_never_admitted(self, tracer):
+        t, path, _ = tracer
+        with t.span("a"):
+            pass
+        with t.span("b"):
+            pass
+        data = open(path, "rb").read()
+        # Flip one payload byte of the FIRST frame: its crc fails, the
+        # second frame must still be admitted (resync on magic).
+        idx = data.find(b'"name": "a"')
+        assert idx > 0
+        mutated = data[:idx + 10] + b"X" + data[idx + 11:]
+        open(path, "wb").write(mutated)
+        requests, stats = tracing.replay_trace_log(path)
+        assert stats["corrupt"] == 1
+        names = [s["name"] for s in tracing.log_spans(requests)]
+        assert names == ["b"]
+
+    def test_truncated_frame_mid_file_resyncs(self, tracer):
+        t, path, _ = tracer
+        with t.span("a"):
+            pass
+        with open(path, "ab") as f:
+            f.write(b"DFTL1 500 deadbeef\n{\"partial")
+        with t.span("c"):
+            pass
+        requests, stats = tracing.replay_trace_log(path)
+        assert stats == {"frames": 2, "corrupt": 1, "torn_tail": False}
+
+    def test_frame_digest_matches_payload(self, tracer):
+        t, path, _ = tracer
+        with t.span("a"):
+            pass
+        raw = open(path, "rb").read()
+        header, rest = raw.split(b"\n", 1)
+        magic, length, crc = header.split(b" ")
+        assert magic == b"DFTL1"
+        payload = rest[: int(length)]
+        assert int(crc, 16) == (zlib.crc32(payload) & 0xFFFFFFFF)
+        json.loads(payload)  # the payload is one OTLP/JSON request
+
+    def test_missing_log_replays_empty(self, tmp_path):
+        requests, stats = tracing.replay_trace_log(str(tmp_path / "nope"))
+        assert requests == [] and stats["frames"] == 0
+
+
+class TestHeadSampling:
+    def test_deterministic_and_proportional(self):
+        import random
+
+        rng = random.Random(7)
+        ids = ["%032x" % rng.getrandbits(128) for _ in range(4000)]
+        kept = [t for t in ids if tracing.trace_sampled(t, 0.1)]
+        # Deterministic: the same decision on every "process".
+        assert kept == [t for t in ids if tracing.trace_sampled(t, 0.1)]
+        assert 0.05 < len(kept) / len(ids) < 0.2
+        assert all(tracing.trace_sampled(t, 1.0) for t in ids[:10])
+        assert not any(tracing.trace_sampled(t, 0.0) for t in ids[:10])
+
+    def test_sampling_keeps_whole_traces(self, tmp_path):
+        """Child spans share the root's trace id, so one decision keeps
+        or drops the whole per-process shard of a trace."""
+        path = str(tmp_path / "s.dftrace")
+        exporter = tracing.DurableSpanExporter(
+            path, service="t", sample_rate=0.5
+        )
+        t = tracing.Tracer("t", exporter)
+        for _ in range(50):
+            with t.span("root"):
+                with t.span("child"):
+                    pass
+        requests, _ = tracing.replay_trace_log(path)
+        spans = list(tracing.log_spans(requests))
+        by_trace = {}
+        for s in spans:
+            by_trace.setdefault(s["traceId"], set()).add(s["name"])
+        # Every kept trace kept BOTH spans.
+        assert by_trace and all(v == {"root", "child"} for v in by_trace.values())
+        assert exporter.sampled_out > 0
+
+
+class TestTracingToggle:
+    def test_disabled_spans_are_noops(self, tmp_path):
+        path = str(tmp_path / "t.dftrace")
+        exporter = tracing.DurableSpanExporter(path, service="t")
+        t = tracing.Tracer("t", exporter)
+        tracing.set_enabled(False)
+        try:
+            with t.span("invisible") as s:
+                s.set(x=1)
+                assert t.inject() == {}
+                assert t.current_trace_id() is None
+        finally:
+            tracing.set_enabled(True)
+        with t.span("visible"):
+            pass
+        names = [
+            s["name"]
+            for s in tracing.log_spans(tracing.replay_trace_log(path)[0])
+        ]
+        assert names == ["visible"]
+
+
+class TestCompositeExporter:
+    def test_ring_plus_durable_and_debug_dump(self, tmp_path):
+        path = str(tmp_path / "c.dftrace")
+        ring = tracing.InMemoryExporter(max_spans=8)
+        durable = tracing.DurableSpanExporter(path, service="svc")
+        t = tracing.Tracer("svc", tracing.CompositeExporter([ring, durable]))
+        with t.span("x"):
+            pass
+        assert len(ring.find("x")) == 1
+        assert tracing.replay_trace_log(path)[1]["frames"] == 1
+        dump = tracing.recent_spans_otlp(t)
+        names = [s["name"] for s in tracing.log_spans([dump])]
+        assert names == ["x"]
+        import jsonschema
+
+        jsonschema.Draft202012Validator(tracing.otlp_trace_schema()).validate(dump)
+
+
+HOSTILE_TRACEPARENTS = [
+    "",
+    "garbage",
+    "00",
+    "00-" + "g" * 32 + "-" + "a" * 16 + "-01",          # non-hex trace id
+    "00-" + "a" * 31 + "-" + "b" * 16 + "-01",          # short trace id
+    "00-" + "a" * 32 + "-" + "b" * 15 + "-01",          # short span id
+    "00-" + "a" * 32 + "-" + "b" * 16,                   # missing flags
+    "00-" + "a" * 33 + "-" + "b" * 17 + "-01-extra-extra",
+    "00--" + "b" * 16 + "-01",
+    "\x00\x01\x02",
+    "00-" + "a" * 32 + "-" + "b" * 16 + "-01" + "\n" * 50,
+    "トレース-ペアレント-ヘッダ-01",
+    "00-" + "A" * 32 + "-" + "B" * 16 + "-01",          # uppercase hex is valid
+    "a" * 10_000,
+    "-".join(["00"] * 200),
+]
+
+
+class TestTraceparentFuzz:
+    @pytest.mark.parametrize("value", HOSTILE_TRACEPARENTS)
+    def test_parse_never_raises(self, value):
+        parsed = tracing.parse_traceparent(value)
+        if parsed is not None:
+            trace_id, span_id = parsed
+            assert len(trace_id) == 32 and len(span_id) == 16
+            int(trace_id, 16), int(span_id, 16)
+
+    @pytest.mark.parametrize("value", HOSTILE_TRACEPARENTS)
+    def test_remote_span_falls_back_to_local_root(self, value):
+        t = tracing.Tracer("t", tracing.InMemoryExporter())
+        with t.remote_span("handler", value) as span:
+            assert len(span.trace_id) == 32
+            parsed = tracing.parse_traceparent(value)
+            if parsed is None:
+                assert span.parent_id is None  # clean local root
+            else:
+                assert span.trace_id == parsed[0]
+                assert span.parent_id == parsed[1]
+
+    def test_http_transport_survives_hostile_headers(self, tmp_path):
+        """Malformed traceparent on the wire: 200s, handler runs, local
+        root span — never a 500."""
+        from dragonfly2_tpu.records.storage import Storage
+        from dragonfly2_tpu.rpc import SchedulerHTTPServer
+        from dragonfly2_tpu.scheduler import (
+            Evaluator,
+            NetworkTopology,
+            Resource,
+            SchedulerService,
+            Scheduling,
+            SchedulingConfig,
+        )
+
+        resource = Resource()
+        service = SchedulerService(
+            resource,
+            Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+            Storage(str(tmp_path / "records"), buffer_size=1),
+            NetworkTopology(resource.host_manager),
+        )
+        server = SchedulerHTTPServer(service)
+        server.serve()
+        try:
+            import urllib.request
+
+            for value in HOSTILE_TRACEPARENTS:
+                body = json.dumps(
+                    {"host": {"id": "h-fuzz", "hostname": "h", "ip": "1.1.1.1"}}
+                ).encode()
+                headers = {"Content-Type": "application/json"}
+                # urllib forbids control chars in header values; that
+                # rejection IS the clean client-side fallback.
+                try:
+                    req = urllib.request.Request(
+                        server.url + "/rpc/announce_host",
+                        data=body,
+                        headers={**headers, "traceparent": value},
+                        method="POST",
+                    )
+                except ValueError:
+                    continue
+                try:
+                    with urllib.request.urlopen(req, timeout=5) as resp:
+                        assert resp.status == 200
+                except ValueError:
+                    continue
+        finally:
+            server.stop()
+
+
+class TestTraceAssembly:
+    def _two_process_logs(self, tmp_path, *, kill_parent_export=False):
+        """A daemon-side and scheduler-side log for ONE download trace.
+        With ``kill_parent_export`` the daemon's root span never exports
+        (the SIGKILL signature) and its log gets a torn tail."""
+        dlog = str(tmp_path / "daemon.dftrace")
+        slog = str(tmp_path / "sched.dftrace")
+        d_exp = tracing.DurableSpanExporter(dlog, service="dfdaemon")
+        s_exp = tracing.DurableSpanExporter(slog, service="scheduler")
+        daemon = tracing.Tracer("dfdaemon", d_exp)
+        sched = tracing.Tracer("scheduler", s_exp)
+        root_cm = daemon.span("daemon/download", task_id="t1")
+        root = root_cm.__enter__()
+        tp = root.traceparent
+        with sched.remote_span("rpc/register_peer", tp):
+            pass
+        for n in range(3):
+            with daemon.span("daemon/piece", number=n) as ps:
+                ps.set(bytes=4096, parent="p0", retries=0)
+                with sched.remote_span("rpc/report_piece_finished", daemon.inject()["traceparent"]):
+                    pass
+        if kill_parent_export:
+            # Root never exports; the log ends in a torn frame.  Sever
+            # the exporter too — otherwise the root contextmanager's GC
+            # finalization would "export after death", which no SIGKILLed
+            # process gets to do.
+            with open(dlog, "ab") as f:
+                f.write(b"DFTL1 4096 0badf00d\n{\"resourceSp")
+            d_exp.export = lambda span: None
+        else:
+            root_cm.__exit__(None, None, None)
+            with sched.remote_span("rpc/report_peer_finished", tp):
+                pass
+        return dlog, slog, root.trace_id
+
+    def test_critical_path_and_phases(self, tmp_path):
+        from tools.trace_assemble import build_report
+
+        dlog, slog, trace_id = self._two_process_logs(tmp_path)
+        report = build_report([dlog, slog], validate=True)
+        trace = report["trace"]
+        assert trace["trace_id"] == trace_id
+        assert set(trace["services"]) == {"dfdaemon", "scheduler"}
+        assert trace["critical_path"][0]["name"] == "daemon/download"
+        assert {"schedule", "piece", "commit", "download"} <= set(trace["phases"])
+        assert trace["anomalies"] == []
+
+    def test_torn_log_still_assembles_with_anomalies(self, tmp_path):
+        from tools.trace_assemble import build_report
+
+        dlog, slog, trace_id = self._two_process_logs(
+            tmp_path, kill_parent_export=True
+        )
+        report = build_report([dlog, slog], validate=True)
+        daemon_log = next(
+            log for log in report["logs"] if "daemon" in log["path"]
+        )
+        assert daemon_log["torn_tail"] is True
+        trace = report["trace"]
+        assert trace["trace_id"] == trace_id
+        # Orphans (the unexported download root) are flagged, and the
+        # critical path still renders from the surviving spans.
+        assert any("orphan" in a for a in trace["anomalies"])
+        assert trace["critical_path"]
+
+    def test_markdown_render_and_marker_update(self, tmp_path):
+        from tools.trace_assemble import (
+            ASSEMBLY_BEGIN,
+            ASSEMBLY_END,
+            build_report,
+            render_report,
+            update_file,
+        )
+
+        dlog, slog, _ = self._two_process_logs(tmp_path)
+        rendered = render_report(build_report([dlog, slog]))
+        assert rendered.startswith(ASSEMBLY_BEGIN)
+        assert rendered.endswith(ASSEMBLY_END)
+        assert "Critical path:" in rendered
+        doc = tmp_path / "OBS.md"
+        doc.write_text(f"# head\n{ASSEMBLY_BEGIN}\nstale\n{ASSEMBLY_END}\ntail\n")
+        assert update_file(doc, rendered) is True
+        assert update_file(doc, rendered) is False  # idempotent
+        text = doc.read_text()
+        assert "stale" not in text and "# head" in text and "tail" in text
+
+    def test_gap_detection(self, tmp_path):
+        from tools.trace_assemble import build_report
+
+        path = str(tmp_path / "gap.dftrace")
+        exp = tracing.DurableSpanExporter(path, service="svc")
+        t = tracing.Tracer("svc", exp)
+        import time as _time
+
+        with t.span("daemon/download"):
+            with t.span("daemon/piece", number=0):
+                pass
+            _time.sleep(0.08)  # nobody doing attributable work
+            with t.span("daemon/piece", number=1):
+                pass
+        report = build_report([path], gap_ms=50.0)
+        gaps = report["trace"]["gaps"]
+        assert gaps and gaps[0]["duration_ms"] >= 50.0
+
+    def test_cli_json_mode(self, tmp_path, capsys):
+        from tools.trace_assemble import main
+
+        dlog, slog, trace_id = self._two_process_logs(tmp_path)
+        assert main([dlog, slog, "--json", "--validate"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["trace"]["trace_id"] == trace_id
+        assert out["traces"] >= 1

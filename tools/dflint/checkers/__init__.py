@@ -13,6 +13,7 @@ from . import (
     df005_resources,
     df006_deadlines,
     df007_hotpath,
+    df016_spans,
 )
 
 CHECKERS = (
@@ -23,6 +24,7 @@ CHECKERS = (
     df005_resources,
     df006_deadlines,
     df007_hotpath,
+    df016_spans,
 )
 
 RULES = {c.RULE: c for c in CHECKERS}
