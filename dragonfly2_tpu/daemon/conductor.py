@@ -45,6 +45,12 @@ from ..scheduler.resource import Host, Peer
 from ..scheduler.service import SchedulerService
 from ..scheduler.scheduling import ScheduleResultKind
 from ..utils.types import TINY_FILE_SIZE, Priority
+from .piece_pipeline import (
+    CommitPipeline,
+    PieceLatencyTracker,
+    PieceReportBatcher,
+    hedged_fetch,
+)
 from .storage import DaemonStorage
 from .traffic_shaper import TrafficShaper
 
@@ -188,9 +194,11 @@ class _SwarmState:
     bitmaps: Dict[str, bytes] = field(default_factory=dict)
     failed: int = 0
     nbytes: int = 0
+    hedges: int = 0
     last_refresh: float = 0.0
     lock: threading.Lock = field(default_factory=threading.Lock)
     abort: threading.Event = field(default_factory=threading.Event)
+    latency: PieceLatencyTracker = field(default_factory=PieceLatencyTracker)
 
 
 class Conductor:
@@ -209,6 +217,13 @@ class Conductor:
         piece_wait_timeout_s: float = 60.0,
         concurrent_source_groups: int = 1,
         concurrent_source_threshold: int = 2,
+        pipeline_depth: int = 4,
+        batch_reports: bool = True,
+        report_linger_s: float = 0.02,
+        hedge_enabled: bool = True,
+        hedge_min_samples: int = 16,
+        hedge_floor_s: float = 0.05,
+        hedge_multiplier: float = 1.5,
         pex=None,
     ) -> None:
         self.host = host
@@ -242,6 +257,20 @@ class Conductor:
         # aren't worth the fan-out.
         self.concurrent_source_groups = max(1, concurrent_source_groups)
         self.concurrent_source_threshold = max(1, concurrent_source_threshold)
+        # Data-plane pipeline (DESIGN.md §22): commit piece N (digest +
+        # storage + report enqueue) on a committer thread while the
+        # worker fetches N+1; 0 = the pre-pipeline inline path (the
+        # benchmark's reference arm).  batch_reports coalesces per-piece
+        # finished reports into bounded-linger report_pieces_finished
+        # RPCs; hedging races a second parent for p99 stragglers once
+        # `hedge_min_samples` fetches have established a baseline.
+        self.pipeline_depth = max(0, pipeline_depth)
+        self.batch_reports = batch_reports
+        self.report_linger_s = report_linger_s
+        self.hedge_enabled = hedge_enabled
+        self.hedge_min_samples = hedge_min_samples
+        self.hedge_floor_s = hedge_floor_s
+        self.hedge_multiplier = hedge_multiplier
         # Storage writes + piece-run bookkeeping from concurrent source
         # workers are serialized; the origin fetch AND the scheduler
         # report overlap (the report is an RPC on remote wirings — it
@@ -694,7 +723,14 @@ class Conductor:
         adopt server-pushed reschedules for the whole pool.
         """
         task = peer.task
-        state = _SwarmState(parents=list(parents))
+        state = _SwarmState(
+            parents=list(parents),
+            latency=PieceLatencyTracker(
+                min_samples=self.hedge_min_samples,
+                floor_s=self.hedge_floor_s,
+                multiplier=self.hedge_multiplier,
+            ),
+        )
         self._refresh_bitmaps(task.id, state, force=True)
 
         # Resume: pieces already on disk are NOT refetched and NOT
@@ -705,6 +741,51 @@ class Conductor:
         # bitmaps, not from the scheduler.
         held = self.storage.piece_bitmap(task.id, n_pieces) if n_pieces > 0 else []
         pending = deque(n for n in range(n_pieces) if not held[n])
+
+        # Report path: batched (one report_pieces_finished per linger
+        # window) or direct per-piece calls.  Commit path: pipelined
+        # (digest piece N while N+1 is on the wire) or inline.  Both
+        # default ON; the benchmark's reference arm turns them off.
+        from ..utils.tracing import TRACEPARENT_HEADER, default_tracer
+
+        reporter = (
+            PieceReportBatcher(
+                self.scheduler, peer, linger_s=self.report_linger_s,
+                traceparent=default_tracer.inject().get(TRACEPARENT_HEADER),
+            )
+            if self.batch_reports
+            else None
+        )
+
+        def report_finished(number: int, parent_id: str, length: int,
+                            cost_ns: int) -> None:
+            if reporter is not None:
+                if not reporter.submit(number, parent_id, length, cost_ns):
+                    raise reporter.error or IOError("report batcher closed")
+            else:
+                self.scheduler.report_piece_finished(
+                    peer, number, parent_id=parent_id, length=length,
+                    cost_ns=cost_ns,
+                )
+
+        def commit_piece(number: int, data: bytes, parent_id: str,
+                         cost_ns: int) -> None:
+            """Digest (crc at write) + persist + mark + report enqueue:
+            runs on the committer thread when pipelined, inline in the
+            worker otherwise — identical semantics either way."""
+            self.storage.write_piece(task.id, number, data)
+            run.mark_piece(number)
+            with state.lock:
+                state.nbytes += len(data)
+            if self.traffic_shaper is not None:
+                self.traffic_shaper.record(task.id, len(data))
+            report_finished(number, parent_id, len(data), cost_ns)
+
+        pipeline = (
+            CommitPipeline(commit_piece, depth=self.pipeline_depth)
+            if self.pipeline_depth > 0
+            else None
+        )
 
         take_pushed = getattr(self.scheduler, "take_pushed_schedule", None)
 
@@ -763,11 +844,32 @@ class Conductor:
                     time.sleep(self.piece_poll_interval_s)
                     continue
                 parent = holders[(number + attempt) % len(holders)]
+                expected = _expected_piece_len(
+                    task.content_length, task.piece_size, number
+                )
+                # Hedge plan: once enough fetches establish a latency
+                # baseline, a straggler races a SECOND holder through the
+                # same fetch/breaker machinery — first valid body wins.
+                threshold = (
+                    state.latency.threshold_s() if self.hedge_enabled else None
+                )
+                by_id = {p.id: p for p in holders}
+                alt_id = None
+                if threshold is not None and len(holders) > 1:
+                    cand = holders[(number + attempt + 1) % len(holders)]
+                    if cand.id != parent.id:
+                        alt_id = cand.id
                 try:
                     t_piece = time.monotonic()
-                    data = self.piece_fetcher.fetch(parent.host.id, task.id, number)
-                    expected = _expected_piece_len(
-                        task.content_length, task.piece_size, number
+                    data, winner_id, hedged = hedged_fetch(
+                        lambda pid: self.piece_fetcher.fetch(
+                            by_id[pid].host.id, task.id, number
+                        ),
+                        lambda d: expected < 0 or len(d) == expected,
+                        parent.id,
+                        alt_id,
+                        threshold_s=threshold,
+                        wait_timeout_s=self.piece_wait_timeout_s,
                     )
                     if expected >= 0 and len(data) != expected:
                         raise IOError(
@@ -775,6 +877,14 @@ class Conductor:
                             f"({len(data)} != {expected} bytes)"
                         )
                     cost_ns = max(int((time.monotonic() - t_piece) * 1e9), 1)
+                    if hedged:
+                        with state.lock:
+                            state.hedges += 1
+                    else:
+                        # Only unhedged walls feed the baseline — a
+                        # straggler's wall would drag the p99 toward the
+                        # very tail the hedge exists to cut.
+                        state.latency.observe(time.monotonic() - t_piece)
                 except Exception:
                     with state.lock:
                         state.failed += 1
@@ -793,18 +903,17 @@ class Conductor:
                         return False
                     continue
                 piece_span.set(
-                    parent=parent.id, bytes=len(data), retries=attempt
+                    parent=winner_id, bytes=len(data), retries=attempt,
+                    hedged=hedged,
                 )
-                self.storage.write_piece(task.id, number, data)
-                run.mark_piece(number)
-                with state.lock:
-                    state.nbytes += len(data)
-                if self.traffic_shaper is not None:
-                    self.traffic_shaper.record(task.id, len(data))
-                self.scheduler.report_piece_finished(
-                    peer, number, parent_id=parent.id, length=len(data),
-                    cost_ns=cost_ns,
-                )
+                if pipeline is not None:
+                    # Hand off to the committer: this worker goes straight
+                    # to its next fetch while piece `number` digests.
+                    if not pipeline.submit(number, data, winner_id, cost_ns):
+                        state.abort.set()
+                        return False
+                else:
+                    commit_piece(number, data, winner_id, cost_ns)
                 return True
             return False
 
@@ -813,14 +922,27 @@ class Conductor:
         from ..utils.tracing import TRACEPARENT_HEADER, default_tracer
 
         download_tp = default_tracer.inject().get(TRACEPARENT_HEADER)
-        self._run_piece_pool(
-            pending, fetch_one, abort=state.abort, name="piece-worker",
-            traceparent=download_tp,
-        )
+        try:
+            self._run_piece_pool(
+                pending, fetch_one, abort=state.abort, name="piece-worker",
+                traceparent=download_tp,
+            )
+        finally:
+            # Drain in order: commits first (they enqueue reports), then
+            # the report flush — every piece report lands BEFORE the
+            # closing report_peer_finished, preserving the scheduler's
+            # observable event order.
+            commit_err = pipeline.close() if pipeline is not None else None
+            report_err = reporter.close() if reporter is not None else None
 
         with state.lock:
             failed, nbytes = state.failed, state.nbytes
-        if state.abort.is_set() or pending:
+        if state.abort.is_set() or pending or commit_err or report_err:
+            if commit_err or report_err:
+                logging.getLogger(__name__).warning(
+                    "p2p phase failed post-fetch (%s): falling to source",
+                    commit_err or report_err,
+                )
             return None  # fall to source (or honor pushed back-to-source)
         self.scheduler.report_peer_finished(peer)
         if self.pex is not None:

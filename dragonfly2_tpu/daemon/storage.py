@@ -14,7 +14,7 @@ import os
 import threading
 import time
 import zlib
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -118,12 +118,48 @@ class _PyPieceStore:
         if meta is None or number not in meta["pieces"]:
             raise KeyError(f"piece {number} of {task_id}")
         info = meta["pieces"][number]
+        length = info["length"] if max_len is None else min(max_len, info["length"])
         with open(os.path.join(self._dir(task_id), "data"), "rb") as f:
             f.seek(number * meta["piece_size"])
-            data = f.read(info["length"])
-        if verify and zlib.crc32(data) != info["crc"]:
+            data = f.read(length)
+        # A max_len-limited read can't cover the whole-piece digest; the
+        # write-time crc stands for it (read_piece_at documents the same).
+        if verify and length == info["length"] and zlib.crc32(data) != info["crc"]:
             raise IOError(f"crc mismatch piece {number} of {task_id}")
         return data
+
+    def read_piece_at(
+        self, task_id: str, number: int, offset: int, max_len: int
+    ) -> bytes:
+        """Sub-piece read: ``max_len`` bytes of piece ``number`` starting
+        ``offset`` bytes in — a Range request for 100 bytes reads 100
+        bytes, not a 4 MiB piece.  The whole-piece crc can't cover a
+        partial read; the write-time digest stands for the span."""
+        meta = self._load_meta(task_id)
+        if meta is None or number not in meta["pieces"]:
+            raise KeyError(f"piece {number} of {task_id}")
+        info = meta["pieces"][number]
+        if offset >= info["length"] or max_len <= 0:
+            return b""
+        take = min(max_len, info["length"] - offset)
+        with open(os.path.join(self._dir(task_id), "data"), "rb") as f:
+            f.seek(number * meta["piece_size"] + offset)
+            return f.read(take)
+
+    def piece_file_span(
+        self, task_id: str, number: int
+    ) -> Optional[Tuple[str, int, int]]:
+        """(path, byte offset, length) of a committed piece inside the
+        plain data file — the zero-copy (``os.sendfile``) serve handle.
+        None when the piece isn't committed."""
+        meta = self._load_meta(task_id)
+        if meta is None or number not in meta["pieces"]:
+            return None
+        return (
+            os.path.join(self._dir(task_id), "data"),
+            number * meta["piece_size"],
+            meta["pieces"][number]["length"],
+        )
 
     def piece_count(self, task_id: str) -> int:
         meta = self._load_meta(task_id)
@@ -234,6 +270,55 @@ class DaemonStorage:
             if task_id in self._tasks:
                 self._tasks[task_id]["atime"] = time.time()
         return self.engine.read_piece(task_id, number, verify=verify)
+
+    def read_piece_at(
+        self, task_id: str, number: int, offset: int, max_len: int
+    ) -> bytes:
+        """Sub-piece read for Range serving: only the requested span hits
+        the disk when the engine supports offset reads; engines without
+        them (the native store's ctypes surface) fall back to a
+        whole-piece read + slice."""
+        with self._mu:
+            if task_id in self._tasks:
+                self._tasks[task_id]["atime"] = time.time()
+        at = getattr(self.engine, "read_piece_at", None)
+        if at is not None:
+            return at(task_id, number, offset, max_len)
+        data = self.engine.read_piece(task_id, number)
+        return data[offset : offset + max_len]
+
+    def piece_file_span(
+        self, task_id: str, number: int
+    ) -> Optional[Tuple[str, int, int]]:
+        """Zero-copy serve handle: (path, offset, length) of a committed
+        piece inside the engine's plain data file, or None when the
+        engine doesn't expose one (native store — its own in-engine
+        server already serves via sendfile)."""
+        span_fn = getattr(self.engine, "piece_file_span", None)
+        return span_fn(task_id, number) if span_fn is not None else None
+
+    def range_file_span(
+        self, task_id: str, start: int, length: int
+    ) -> Optional[Tuple[str, int, int]]:
+        """Zero-copy handle for a BYTE RANGE: pieces are laid out at
+        ``number * piece_size`` in one data file, so a content byte range
+        maps 1:1 onto a contiguous file span — IF every overlapping piece
+        is committed.  None otherwise (serve falls back to piece reads)."""
+        ps = self.piece_size(task_id)
+        total = self.content_length(task_id)
+        if ps <= 0 or total < 0 or length <= 0 or start < 0:
+            return None
+        end = min(start + length, total)
+        if end <= start:
+            return None
+        first, last = start // ps, (end - 1) // ps
+        path = None
+        for num in range(first, last + 1):
+            span = self.piece_file_span(task_id, num)
+            if span is None:
+                return None
+            path = span[0]
+        return (path, start, end - start)
 
     def piece_bitmap(self, task_id: str, n_pieces: int) -> np.ndarray:
         return self.engine.piece_bitmap(task_id, n_pieces)

@@ -5,11 +5,24 @@ server answering range requests from peers.  Transport-neutral core: the
 in-process swarm calls ``serve_piece`` directly; an HTTP binding wraps the
 same method.  Concurrency is capped the way the scheduler models it
 (Host.concurrent_upload_limit).
+
+Two serve shapes (DESIGN.md §22):
+
+- **buffered** — ``serve_piece`` / ``serve_piece_span`` materialize the
+  bytes (the in-process transport, TLS serving, and every chaos drill
+  that tears bodies ride this path);
+- **zero-copy** — ``piece_sendfile_span`` / ``range_sendfile_span`` hand
+  the HTTP server a ``(path, offset, length)`` file span so the bytes go
+  kernel→socket via ``os.sendfile`` without ever entering Python.  Both
+  shapes share ONE accounting gate (``begin_upload``/``end_upload``), so
+  the concurrency cap and the upload counters mean the same thing on
+  either path — and tests prove the two byte-identical.
 """
 
 from __future__ import annotations
 
 import threading
+from typing import Optional, Tuple
 
 from .storage import DaemonStorage
 
@@ -26,12 +39,36 @@ class UploadManager:
         self._active = 0
         self.upload_count = 0
         self.upload_failed_count = 0
+        self.bytes_served = 0
 
     @property
     def active(self) -> int:
         with self._mu:
             return self._active
 
+    # -- shared accounting gate (both serve shapes) --------------------------
+
+    def begin_upload(self) -> None:
+        """Claim one upload slot; raises UploadBusy past the cap.  Callers
+        MUST pair with ``end_upload`` (the sendfile server path wraps its
+        own stream between the two)."""
+        with self._mu:
+            if self._active >= self.concurrent_limit:
+                raise UploadBusy(f"{self._active} active uploads")
+            self._active += 1
+
+    def end_upload(self, ok: bool, nbytes: int = 0) -> None:
+        with self._mu:
+            self._active -= 1
+            if ok:
+                self.upload_count += 1
+                self.bytes_served += nbytes
+            else:
+                self.upload_failed_count += 1
+
+    # -- buffered serving ----------------------------------------------------
+
+    # dflint: hotpath
     def serve_piece(self, task_id: str, number: int) -> bytes:
         """One piece upload; raises UploadBusy past the concurrency cap,
         KeyError when the piece isn't local."""
@@ -41,35 +78,83 @@ class UploadManager:
         # truncate on the body): covers BOTH piece transports — the HTTP
         # server and the in-process fetcher call through here.
         faultinject.fire("daemon.upload.serve_piece")
-        with self._mu:
-            if self._active >= self.concurrent_limit:
-                raise UploadBusy(f"{self._active} active uploads")
-            self._active += 1
+        self.begin_upload()
+        ok = False
         try:
             data = self.storage.read_piece(task_id, number)
-            with self._mu:
-                self.upload_count += 1
-            return faultinject.fire("daemon.upload.body", data)
-        except Exception:
-            with self._mu:
-                self.upload_failed_count += 1
-            raise
+            # The body seam may raise (injected drop): that upload FAILED.
+            data = faultinject.fire("daemon.upload.body", data)
+            ok = True
+            return data
         finally:
-            with self._mu:
-                self._active -= 1
+            self.end_upload(ok, len(data) if ok else 0)
+
+    def serve_piece_span(
+        self, task_id: str, number: int, offset: int, max_len: int
+    ) -> bytes:
+        """Buffered SUB-PIECE upload: only the requested span is read
+        (storage.read_piece_at) — a tiny Range request no longer
+        materializes a whole 4 MiB piece.  Same cap/counters/seams as
+        serve_piece."""
+        from ..utils import faultinject
+
+        faultinject.fire("daemon.upload.serve_piece")
+        self.begin_upload()
+        ok = False
+        try:
+            data = self.storage.read_piece_at(task_id, number, offset, max_len)
+            data = faultinject.fire("daemon.upload.body", data)
+            ok = True
+            return data
+        finally:
+            self.end_upload(ok, len(data) if ok else 0)
 
     def serve_range(self, task_id: str, start: int, length: int, piece_size: int) -> bytes:
-        """Byte-range read assembled from pieces (HTTP Range semantics)."""
+        """Byte-range read assembled from SUB-PIECE reads (HTTP Range
+        semantics): each overlapping piece contributes only its requested
+        span instead of a whole-piece materialize-then-slice."""
         out = bytearray()
         pos = start
         end = start + length
         while pos < end:
             num = pos // piece_size
-            piece = self.serve_piece(task_id, num)
             off = pos - num * piece_size
-            take = min(len(piece) - off, end - pos)
-            if take <= 0:
+            chunk = self.serve_piece_span(task_id, num, off, end - pos)
+            if not chunk:
                 break
-            out += piece[off : off + take]
-            pos += take
+            out += chunk
+            pos += len(chunk)
         return bytes(out)
+
+    # -- zero-copy serving ---------------------------------------------------
+
+    def piece_sendfile_span(
+        self, task_id: str, number: int
+    ) -> Optional[Tuple[str, int, int]]:
+        """Zero-copy serve handle for one piece, or None → caller uses the
+        buffered path.  A scenario that tears BODIES (truncate faults on
+        the upload/serve body seams) needs byte payloads to cut, so it
+        forces the buffered path; drop/delay/dferror/crash faults fire
+        right here and behave identically on either path."""
+        from ..utils import faultinject
+
+        faultinject.fire("daemon.upload.sendfile")
+        if faultinject.truncates("daemon.upload.body") or faultinject.truncates(
+            "piece.server.body"
+        ):
+            return None
+        return self.storage.piece_file_span(task_id, number)
+
+    def range_sendfile_span(
+        self, task_id: str, start: int, length: int
+    ) -> Optional[Tuple[str, int, int]]:
+        """Zero-copy handle for a byte range (pieces are contiguous in the
+        engine's data file); None → buffered serve_range fallback."""
+        from ..utils import faultinject
+
+        faultinject.fire("daemon.upload.sendfile")
+        if faultinject.truncates("daemon.upload.body") or faultinject.truncates(
+            "piece.server.body"
+        ):
+            return None
+        return self.storage.range_file_span(task_id, start, length)

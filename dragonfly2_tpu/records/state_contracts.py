@@ -374,6 +374,9 @@ STATE_CONTRACTS = {
             "deduped": {
                 "register_peer": "SchedulerService.register_peer",
                 "report_piece_finished": "Peer.finish_piece",
+                # The batch is N singles server-side: the same per-piece
+                # finish_piece dedup absorbs a blind-retried batch.
+                "report_pieces_finished": "Peer.finish_piece",
                 "report_peer_finished": "_try_event",
                 "report_peer_failed": "_try_event",
                 "mark_back_to_source": "_try_event",

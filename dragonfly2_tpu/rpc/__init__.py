@@ -21,7 +21,11 @@ data + a consistent-hashing client balancer.  Same split here, stdlib-only:
 """
 
 from .balancer import HashRing  # noqa: F401
-from .piece_transport import HTTPPieceFetcher, PieceHTTPServer  # noqa: F401
+from .piece_transport import (  # noqa: F401
+    HTTPPieceFetcher,
+    PieceConnectionPool,
+    PieceHTTPServer,
+)
 from .registry_client import RemoteRegistry  # noqa: F401
 from .retry import retry_call  # noqa: F401
 from .scheduler_client import RemoteScheduler  # noqa: F401

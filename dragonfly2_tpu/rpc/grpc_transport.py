@@ -30,6 +30,7 @@ import grpc
 from google.protobuf.json_format import MessageToDict, ParseDict
 
 from .protos import dragonfly_pb2 as pb
+from .protos.batch import ReportPiecesFinishedRequest
 from .scheduler_client import RemoteScheduler, RPCError
 
 SCHEDULER_SERVICE = "dragonfly2tpu.Scheduler"
@@ -71,6 +72,7 @@ SCHEDULER_METHODS = {
     "register_peer": (pb.RegisterPeerRequest, pb.RegisterPeerResponse),
     "set_task_info": (pb.SetTaskInfoRequest, pb.TaskInfoResponse),
     "report_piece_finished": (pb.ReportPieceFinishedRequest, pb.Empty),
+    "report_pieces_finished": (ReportPiecesFinishedRequest, pb.Empty),
     "report_piece_failed": (pb.ReportPieceFailedRequest, pb.ScheduleResponse),
     "report_peer_finished": (pb.PeerRequest, pb.Empty),
     "report_peer_failed": (pb.PeerRequest, pb.Empty),

@@ -5,7 +5,11 @@ Server: GET /pieces/<task_id>/<number> → piece bytes (whole-piece), plus
 GET /tasks/<task_id> with a Range header → assembled byte range
 (upload_manager.go range semantics).  503 when the upload concurrency cap
 is hit, 404 for missing pieces — the conductor treats both as piece
-failures and reschedules.
+failures and reschedules.  Speaks HTTP/1.1 with keep-alive, and streams
+piece/range bodies kernel→socket via ``os.sendfile`` from the storage
+engine's data file when the deployment allows it (plain TCP, plain-file
+engine; TLS and torn-body chaos scenarios ride the buffered path —
+byte-identical by test, DESIGN.md §22).
 
 Piece-metadata SUBSCRIPTION (peertask_piecetask_synchronizer.go):
 GET /tasks/<task_id>/pieces?have=N&wait_ms=M long-polls — the response
@@ -16,19 +20,30 @@ snapshots.
 
 Client: HTTPPieceFetcher resolves a parent host id to its announced
 (ip, download_port) — carried in the scheduler's parent responses — and
-range-GETs pieces with retry/backoff.
+GETs pieces over a per-parent KEEP-ALIVE connection pool
+(``PieceConnectionPool``) with retry/backoff: one dial amortizes over a
+whole task instead of a fresh TCP (+TLS) handshake per 4 MiB piece.
+Bodies land in a reusable per-thread buffer via ``readinto`` (no
+per-chunk allocate-and-join).  The pool invalidates on breaker-open and
+on parent re-resolve (a restarted parent announces a new port).
 """
 
 from __future__ import annotations
 
+import http.client
+import logging
+import os
+import threading
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..daemon.upload import UploadBusy, UploadManager
 from ._server import ThreadedHTTPService
 from .retry import retry_call
+
+logger = logging.getLogger(__name__)
 
 
 class PieceHTTPServer:
@@ -39,11 +54,32 @@ class PieceHTTPServer:
         port: int = 0,
         *,
         ssl_context=None,
+        use_sendfile: bool = True,
     ):
         self.upload = upload
         upload_ref = upload
+        # sendfile writes the raw fd — with TLS the bytes must pass the
+        # SSL layer, so TLS deployments keep the buffered path.
+        sendfile_ok = (
+            use_sendfile and ssl_context is None and hasattr(os, "sendfile")
+        )
+        self.sendfile_enabled = sendfile_ok
+        stats_mu = threading.Lock()
+        stats = {"connections": 0, "sendfile_serves": 0}
+        self._stats_mu = stats_mu
+        self._stats = stats
 
         class Handler(BaseHTTPRequestHandler):
+            # Keep-alive: the fetcher's connection pool reuses one TCP
+            # connection across a task's pieces; HTTP/1.0 would close per
+            # request and re-pay the handshake every 4 MiB.
+            protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                super().setup()
+                with stats_mu:
+                    stats["connections"] += 1
+
             def log_message(self, *args):
                 pass
 
@@ -54,17 +90,57 @@ class PieceHTTPServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_span(self, code: int, span: Tuple[str, int, int]) -> None:
+                """Zero-copy body: headers through the normal writer, then
+                the span kernel→socket via os.sendfile.  Headers are out
+                by the time the stream starts — a mid-stream failure tears
+                the connection (client length-checks catch it), exactly
+                like a dying parent."""
+                path, offset, length = span
+                with open(path, "rb") as src:
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(length))
+                    self.end_headers()
+                    self.wfile.flush()
+                    # socket.sendfile drives os.sendfile with proper
+                    # handling of the handler socket's timeout mode (a
+                    # raw os.sendfile on a timeout-mode fd EAGAINs once
+                    # the send buffer fills).
+                    sent = self.connection.sendfile(src, offset, length)
+                    if sent != length:
+                        raise BrokenPipeError(
+                            f"sendfile sent {sent} of {length} bytes"
+                        )
+                with stats_mu:
+                    stats["sendfile_serves"] += 1
+
             def do_GET(self):
                 import time as _time
                 import urllib.parse as _parse
 
                 split = _parse.urlsplit(self.path)
                 parts = split.path.strip("/").split("/")
+                streaming = False
                 try:
                     if len(parts) == 3 and parts[0] == "pieces":
                         from ..utils import faultinject
 
                         task_id, number = parts[1], int(parts[2])
+                        if sendfile_ok:
+                            span = upload_ref.piece_sendfile_span(task_id, number)
+                            if span is not None:
+                                upload_ref.begin_upload()
+                                ok = False
+                                try:
+                                    streaming = True
+                                    self._send_span(200, span)
+                                    ok = True
+                                finally:
+                                    upload_ref.end_upload(
+                                        ok, span[2] if ok else 0
+                                    )
+                                return
                         data = upload_ref.serve_piece(task_id, number)
                         # Torn-body seam: a truncate fault serves a SHORT
                         # 200 — the client's length check must catch it.
@@ -128,6 +204,22 @@ class PieceHTTPServer:
                         if start > end:
                             self.send_error(416)
                             return
+                        if sendfile_ok:
+                            span = upload_ref.range_sendfile_span(
+                                task_id, start, end - start + 1
+                            )
+                            if span is not None:
+                                upload_ref.begin_upload()
+                                ok = False
+                                try:
+                                    streaming = True
+                                    self._send_span(206, span)
+                                    ok = True
+                                finally:
+                                    upload_ref.end_upload(
+                                        ok, span[2] if ok else 0
+                                    )
+                                return
                         piece_size = upload_ref.storage.engine.piece_size(task_id)
                         data = upload_ref.serve_range(
                             task_id, start, end - start + 1, piece_size
@@ -140,6 +232,11 @@ class PieceHTTPServer:
                 except KeyError:
                     self.send_error(404)
                 except Exception:  # noqa: BLE001 — wire boundary
+                    if streaming:
+                        # Headers (and possibly a partial body) are out:
+                        # the only honest signal left is a torn stream.
+                        self.close_connection = True
+                        return
                     self.send_error(500)
 
         self._svc = ThreadedHTTPService(Handler, host, port, "piece-http", ssl_context)
@@ -148,6 +245,18 @@ class PieceHTTPServer:
     @property
     def port(self) -> int:
         return self._svc.port
+
+    @property
+    def connections_accepted(self) -> int:
+        """TCP connections this server has accepted — the pool-reuse
+        tests' server-side evidence (pieces served ≫ connections)."""
+        with self._stats_mu:
+            return self._stats["connections"]
+
+    @property
+    def sendfile_serves(self) -> int:
+        with self._stats_mu:
+            return self._stats["sendfile_serves"]
 
     def serve(self) -> None:
         self._svc.serve()
@@ -249,12 +358,125 @@ def make_piece_server(
     return PieceHTTPServer(upload, host, port, ssl_context=ssl_context)
 
 
+class PieceConnectionPool:
+    """Per-parent keep-alive HTTP connections to piece servers.
+
+    Invalidation rules (DESIGN.md §22):
+
+    - a connection that errored mid-roundtrip is DISCARDED, never pooled
+      (the retry re-dials);
+    - a parent whose resolved ``(ip, port)`` changed (restart → new
+      announce) drops every pooled connection to the old address;
+    - ``invalidate(parent)`` drains the parent outright — the fetcher
+      calls it when that parent's circuit breaker lands OPEN, so a dead
+      parent's sockets don't linger for the breaker's reset window.
+
+    The pool lock guards only the idle lists; dialing and every byte of
+    I/O happen OUTSIDE it (DF008).
+    """
+
+    def __init__(
+        self,
+        *,
+        timeout: float = 30.0,
+        ssl_context=None,
+        max_idle_per_parent: int = 4,
+    ) -> None:
+        self.timeout = timeout
+        self.ssl_context = ssl_context
+        self.max_idle_per_parent = max_idle_per_parent
+        self._mu = threading.Lock()
+        self._idle: Dict[str, List[http.client.HTTPConnection]] = {}
+        self._addr: Dict[str, Tuple[str, int]] = {}
+        self.dials = 0
+        self.reuses = 0
+
+    def _dial(self, ip: str, port: int) -> http.client.HTTPConnection:
+        from ..utils import faultinject
+
+        faultinject.fire("piece.pool.connect")
+        if self.ssl_context is not None:
+            conn: http.client.HTTPConnection = http.client.HTTPSConnection(
+                ip, port, timeout=self.timeout, context=self.ssl_context
+            )
+        else:
+            conn = http.client.HTTPConnection(ip, port, timeout=self.timeout)
+        conn.connect()
+        with self._mu:
+            self.dials += 1
+        return conn
+
+    def acquire(
+        self, parent_id: str, ip: str, port: int
+    ) -> http.client.HTTPConnection:
+        """An idle connection to the parent's CURRENT address, else a
+        fresh dial.  A changed address invalidates the stale pool first."""
+        stale: List[http.client.HTTPConnection] = []
+        conn = None
+        with self._mu:
+            if self._addr.get(parent_id) != (ip, port):
+                stale = self._idle.pop(parent_id, [])
+                self._addr[parent_id] = (ip, port)
+            else:
+                idle = self._idle.get(parent_id)
+                if idle:
+                    conn = idle.pop()
+                    self.reuses += 1
+        for s in stale:
+            s.close()
+        if conn is not None:
+            return conn
+        return self._dial(ip, port)
+
+    def release(
+        self, parent_id: str, conn: http.client.HTTPConnection, *, reusable: bool
+    ) -> None:
+        if reusable:
+            with self._mu:
+                # Address changed while this roundtrip was in flight →
+                # the connection points at the OLD parent incarnation.
+                addr_current = self._addr.get(parent_id) == (
+                    conn.host, conn.port
+                )
+                idle = self._idle.setdefault(parent_id, [])
+                if addr_current and len(idle) < self.max_idle_per_parent:
+                    idle.append(conn)
+                    return
+        conn.close()
+
+    def invalidate(self, parent_id: str) -> None:
+        with self._mu:
+            drained = self._idle.pop(parent_id, [])
+        for conn in drained:
+            conn.close()
+
+    def idle_count(self, parent_id: str) -> int:
+        with self._mu:
+            return len(self._idle.get(parent_id, []))
+
+    def close(self) -> None:
+        with self._mu:
+            drained = [c for conns in self._idle.values() for c in conns]
+            self._idle.clear()
+        for conn in drained:
+            conn.close()
+
+
+class _PieceUnavailable(Exception):
+    """Permanent-for-this-parent HTTP status (404/410/...): fail without
+    retry so the conductor reschedules immediately."""
+
+
 class HTTPPieceFetcher:
     """Conductor's PieceFetcher over HTTP.
 
     ``resolve(host_id) → (ip, port)``: in the wire flow the scheduler's
     parent entries carry the announced address (scheduler_client mirrors
     them into Host objects); an explicit table also works for tests.
+
+    ``pooled=True`` (default) rides the keep-alive connection pool;
+    ``pooled=False`` keeps the pre-pool one-urlopen-per-piece path — the
+    benchmark's reference arm and an operational escape hatch.
     """
 
     def __init__(
@@ -266,6 +488,7 @@ class HTTPPieceFetcher:
         ssl_context=None,
         breaker_threshold: int = 6,
         breaker_reset_s: float = 2.0,
+        pooled: bool = True,
     ):
         self._resolve = resolve
         self.timeout = timeout
@@ -274,8 +497,6 @@ class HTTPPieceFetcher:
         # instead of burning a connect timeout per piece — the conductor
         # sees the fast ConnectionError and reschedules immediately.
         # breaker_threshold=0 disables.
-        import threading
-
         from .retry import CircuitBreaker
 
         self._breaker_mu = threading.Lock()
@@ -290,6 +511,13 @@ class HTTPPieceFetcher:
         # TLS piece servers (security.tls.client_context).
         self.ssl_context = ssl_context
         self._scheme = "https" if ssl_context is not None else "http"
+        self.pooled = pooled
+        self.pool = PieceConnectionPool(
+            timeout=timeout, ssl_context=ssl_context
+        )
+        # Reusable per-thread body buffer: responses land via readinto
+        # instead of a fresh allocate-and-join per piece.
+        self._tls_buf = threading.local()
 
     def _breaker(self, parent_host_id: str):
         if not self._breaker_threshold:
@@ -304,6 +532,32 @@ class HTTPPieceFetcher:
                 self._breakers[parent_host_id] = b
             return b
 
+    # -- body read into the reusable buffer ----------------------------------
+
+    def _read_body(self, resp: http.client.HTTPResponse) -> bytes:
+        length = resp.length
+        if length is None:
+            return resp.read()
+        buf = getattr(self._tls_buf, "buf", None)
+        if buf is None or len(buf) < length:
+            buf = bytearray(max(length, 1 << 16))
+            self._tls_buf.buf = buf
+        view = memoryview(buf)
+        got = 0
+        while got < length:
+            n = resp.readinto(view[got:length])
+            if not n:
+                break
+            got += n
+        if got < length:
+            raise ConnectionError(
+                f"short body: {got} of {length} bytes"
+            )
+        return bytes(view[:length])
+
+    # -- piece fetch ---------------------------------------------------------
+
+    # dflint: hotpath
     def fetch(
         self,
         parent_host_id: str,
@@ -312,15 +566,69 @@ class HTTPPieceFetcher:
         *,
         deadline_s: Optional[float] = None,
     ) -> bytes:
-        from ..utils import faultinject
-
         ip, port = self._resolve(parent_host_id)
-        url = f"{self._scheme}://{ip}:{port}/pieces/{task_id}/{number}"
+        path = f"/pieces/{task_id}/{number}"
+        once = (
+            self._make_pooled_once(parent_host_id, ip, port, path)
+            if self.pooled
+            else self._make_urlopen_once(ip, port, path)
+        )
+        breaker = self._breaker(parent_host_id)
+        try:
+            return retry_call(
+                once, attempts=2, retry_on=(ConnectionError, TimeoutError),
+                breaker=breaker,
+                deadline_s=deadline_s,
+            )
+        except Exception:
+            # Breaker landed OPEN (this failure tripped it, or it was
+            # already open): drain the parent's pooled sockets — they
+            # point at a dependency now considered down.
+            if breaker is not None and breaker.state == "open":
+                self.pool.invalidate(parent_host_id)
+            raise
 
-        class _PieceUnavailable(Exception):
-            pass
+    def _make_pooled_once(
+        self, parent_host_id: str, ip: str, port: int, path: str
+    ):
+        def once() -> bytes:
+            from ..utils import faultinject
+
+            faultinject.fire("piece.fetch")
+            conn = self.pool.acquire(parent_host_id, ip, port)
+            reusable = False
+            try:
+                try:
+                    conn.request("GET", path)
+                    resp = conn.getresponse()
+                    body = self._read_body(resp)
+                except (http.client.HTTPException, OSError) as exc:
+                    if isinstance(exc, (ConnectionError, TimeoutError)):
+                        # Includes RemoteDisconnected: a server-closed
+                        # keep-alive socket — the retry re-dials.
+                        raise
+                    raise ConnectionError(f"piece GET {path}: {exc}") from exc
+                reusable = not resp.will_close
+                if resp.status == 503:
+                    raise ConnectionError("parent busy")  # retried
+                if resp.status != 200:
+                    # 404 etc.: permanent for this parent — fail at once
+                    # so the conductor reschedules.
+                    raise _PieceUnavailable(
+                        f"HTTP {resp.status} from {ip}:{port}{path}"
+                    )
+                return faultinject.fire("piece.fetch.body", body)
+            finally:
+                self.pool.release(parent_host_id, conn, reusable=reusable)
+
+        return once
+
+    def _make_urlopen_once(self, ip: str, port: int, path: str):
+        url = f"{self._scheme}://{ip}:{port}{path}"
 
         def once() -> bytes:
+            from ..utils import faultinject
+
             faultinject.fire("piece.fetch")
             try:
                 with urllib.request.urlopen(
@@ -335,11 +643,10 @@ class HTTPPieceFetcher:
                 # subclass, which retry_call's default would retry).
                 raise _PieceUnavailable(f"HTTP {exc.code} from {url}") from exc
 
-        return retry_call(
-            once, attempts=2, retry_on=(ConnectionError, TimeoutError),
-            breaker=self._breaker(parent_host_id),
-            deadline_s=deadline_s,
-        )
+        return once
+
+    def close(self) -> None:
+        self.pool.close()
 
     def piece_bitmap(self, parent_host_id: str, task_id: str):
         """Which pieces the parent holds (None when unknown/unreachable)."""

@@ -267,6 +267,25 @@ class RemoteScheduler:
              "length": length, "cost_ns": cost_ns},
         )
 
+    def report_pieces_finished(self, peer: Peer, pieces) -> None:
+        """Batched piece results: ONE wire call for a linger window of
+        finished pieces (the daemon's report batcher).  Mirror updates
+        (Peer.finish_piece) run per entry exactly like the singles path."""
+        items = []
+        for p in pieces:
+            number = int(p["number"])
+            parent_id = p.get("parent_id", "")
+            length = int(p.get("length", 0))
+            cost_ns = int(p.get("cost_ns", 0))
+            peer.finish_piece(number, cost_ns, parent_id=parent_id, length=length)
+            items.append(
+                {"number": number, "parent_id": parent_id,
+                 "length": length, "cost_ns": cost_ns}
+            )
+        self._call(
+            "report_pieces_finished", {"peer_id": peer.id, "pieces": items}
+        )
+
     def report_piece_failed(self, peer: Peer, parent_id: str) -> ScheduleResult:
         peer.block_parents.add(parent_id)
         resp = self._call(

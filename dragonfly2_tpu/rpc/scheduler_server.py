@@ -200,6 +200,21 @@ class SchedulerRPCAdapter:
         )
         return {}
 
+    def report_pieces_finished(self, req: dict) -> dict:
+        self.service.report_pieces_finished(
+            self._peer(req["peer_id"]),
+            [
+                {
+                    "number": int(p["number"]),
+                    "parent_id": p.get("parent_id", ""),
+                    "length": int(p.get("length", 0)),
+                    "cost_ns": int(p.get("cost_ns", 0)),
+                }
+                for p in req.get("pieces", [])
+            ],
+        )
+        return {}
+
     def report_piece_failed(self, req: dict) -> dict:
         res = self.service.report_piece_failed(
             self._peer(req["peer_id"]), req.get("parent_id", "")
@@ -260,6 +275,7 @@ class SchedulerRPCAdapter:
             "register_peer",
             "set_task_info",
             "report_piece_finished",
+            "report_pieces_finished",
             "report_piece_failed",
             "report_peer_finished",
             "report_peer_failed",

@@ -126,6 +126,9 @@ class SteeringSchedulerClient:
     def report_piece_finished(self, peer, *a, **kw):
         return self._peer_owner(peer).report_piece_finished(peer, *a, **kw)
 
+    def report_pieces_finished(self, peer, *a, **kw):
+        return self._peer_owner(peer).report_pieces_finished(peer, *a, **kw)
+
     def report_piece_failed(self, peer, *a, **kw):
         return self._peer_owner(peer).report_piece_failed(peer, *a, **kw)
 

@@ -309,6 +309,22 @@ class SchedulerService:
         if self.hub is not None and self.scheduling.evaluator.is_bad_node(parent):
             self._push_reschedule_children(parent)
 
+    def report_pieces_finished(self, peer: Peer, pieces) -> None:
+        """Batched piece results (the daemon's report batcher coalesces a
+        linger window of finished pieces into ONE call).  Each entry is a
+        dict with number/parent_id/length/cost_ns; semantics are exactly
+        N report_piece_finished calls — per-piece dedup (Peer.finish_piece)
+        and the bad-parent ejection check run for every entry, so a
+        retried batch is as blind-retry-safe as retried singles."""
+        for p in pieces:
+            self.report_piece_finished(
+                peer,
+                int(p["number"]),
+                parent_id=p.get("parent_id", ""),
+                length=int(p.get("length", 0)),
+                cost_ns=int(p.get("cost_ns", 0)),
+            )
+
     def report_piece_failed(self, peer: Peer, parent_id: str) -> ScheduleResult:
         """Piece failure → blocklist the parent and reschedule
         (service handleDownloadPieceFailedRequest)."""

@@ -225,6 +225,20 @@ def fire(site: str, payload=None):
     return inj.fire(site, payload)
 
 
+def truncates(site: str) -> bool:
+    """True when the installed scenario carries a TRUNCATE rule that
+    could match ``site``.  Zero-copy serve paths consult this: a torn-body
+    fault needs a byte payload to cut, so its presence forces the
+    buffered path (drop/delay/dferror/crash faults work on either)."""
+    inj = _active
+    if inj is None:
+        return False
+    return any(
+        spec.kind == "truncate" and fnmatch.fnmatchcase(site, spec.site)
+        for spec in inj.specs
+    )
+
+
 class installed:
     """``with installed(injector): ...`` — scoped installation for tests."""
 

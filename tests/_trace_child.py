@@ -4,8 +4,8 @@
 A wire daemon with its OWN durable trace log: registers with the
 parent's scheduler over HTTP, pulls pieces from the warm parent over the
 piece plane, and — via a ``crash`` FaultSpec on the
-``rpc.client.report_piece_finished`` seam (DF_FAULTINJECT) — SIGKILLs
-itself at a deterministic piece report, mid-download.  The spans that
+``rpc.client.report_pieces_finished`` seam (DF_FAULTINJECT) — SIGKILLs
+itself at a deterministic report flush, mid-download.  The spans that
 finished before the kill are already durable (the exporter writes one
 digest-checked frame per span at export time); everything in flight dies
 with the process, exactly like production.  The parent test then proves
@@ -49,6 +49,9 @@ def main():
         piece_fetcher=HTTPPieceFetcher(client.resolve_host, timeout=5.0),
         source_fetcher=None,
         piece_parallelism=2,
+        # Zero linger: report flushes track pieces closely, so the
+        # parent drill's crash-at-flush-2 fault lands mid-download.
+        report_linger_s=0.0,
     )
     print("trace-child: ready", flush=True)
     r = conductor.download(

@@ -16,9 +16,13 @@ if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
 from tools.bench_report import (  # noqa: E402
+    DOWNLOAD_BEGIN,
+    DOWNLOAD_END,
     TRAJECTORY_BEGIN,
     TRAJECTORY_END,
+    collect_download_rounds,
     collect_rounds,
+    render_download,
     render_trajectory,
     update_file,
 )
@@ -46,6 +50,26 @@ class TestTrajectoryStaleness:
         table = render_trajectory(rounds)
         for data in rounds:
             assert f"| r{data['round']:02d} |" in table
+
+    def test_committed_download_table_is_current(self):
+        """Same staleness gate for the download-plane rounds
+        (tools/bench_download.py → BENCH_DL_r*.json)."""
+        dl_rounds = collect_download_rounds(REPO)
+        assert dl_rounds, "no BENCH_DL_r*.json rounds found at the repo root"
+        text = (REPO / "BENCHMARKS.md").read_text(encoding="utf-8")
+        begin = text.find(DOWNLOAD_BEGIN)
+        end = text.find(DOWNLOAD_END)
+        assert begin >= 0 and end > begin, (
+            "BENCHMARKS.md download markers missing"
+        )
+        committed = text[begin : end + len(DOWNLOAD_END)]
+        fresh = render_download(dl_rounds)
+        assert committed == fresh, (
+            "BENCHMARKS.md download table is stale — regenerate with "
+            "`python -m tools.bench_report --update`"
+        )
+        for data in dl_rounds:
+            assert f"| r{data['round']:02d} |" in committed
 
 
 class TestRenderSemantics:

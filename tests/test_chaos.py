@@ -716,6 +716,226 @@ class TestDaemonKillMidUploadDrill:
 
 
 # ---------------------------------------------------------------------------
+# Drill 3b — piece data plane (PR 11): hedged straggler fetch + pooled
+# connection eviction on parent death
+# ---------------------------------------------------------------------------
+
+
+class _PlaneOrigin:
+    def content(self, url, number):
+        seed = (hash(url) ^ number) & 0xFF
+        return bytes((seed + i) % 251 for i in range(PIECE))
+
+    def fetch(self, url, number, piece_size):
+        return self.content(url, number)
+
+
+class _PlaneNode:
+    """In-process wire node for the data-plane drills: piece server +
+    remote scheduler client + conductor (test_rpc.WireNode shape)."""
+
+    def __init__(self, name, scheduler_url, tmp_path, origin=None, **conductor_kw):
+        from dragonfly2_tpu.daemon import DaemonStorage, UploadManager
+        from dragonfly2_tpu.daemon.conductor import Conductor
+        from dragonfly2_tpu.rpc import HTTPPieceFetcher, RemoteScheduler
+        from dragonfly2_tpu.rpc.piece_transport import PieceHTTPServer
+        from dragonfly2_tpu.scheduler.resource import Host
+
+        self.storage = DaemonStorage(str(tmp_path / name), prefer_native=False)
+        self.upload = UploadManager(self.storage)
+        self.server = PieceHTTPServer(self.upload)
+        self.server.serve()
+        self.host = Host(
+            id=name, hostname=name, ip="127.0.0.1",
+            download_port=self.server.port,
+        )
+        self.host.stats.network.idc = "idc-a"
+        self.client = RemoteScheduler(scheduler_url)
+        self.fetcher = HTTPPieceFetcher(self.client.resolve_host, timeout=5.0)
+        self.conductor = Conductor(
+            self.host, self.storage, self.client,
+            piece_fetcher=self.fetcher, source_fetcher=origin,
+            **conductor_kw,
+        )
+
+    def stop(self):
+        self.server.stop()
+        self.fetcher.close()
+
+
+def _plane_swarm(tmp_path):
+    from dragonfly2_tpu.records.storage import Storage
+    from dragonfly2_tpu.rpc.scheduler_server import SchedulerHTTPServer
+    from dragonfly2_tpu.scheduler import (
+        Evaluator,
+        NetworkTopology,
+        Resource,
+        SchedulerService,
+        Scheduling,
+        SchedulingConfig,
+    )
+
+    resource = Resource()
+    service = SchedulerService(
+        resource,
+        Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+        Storage(str(tmp_path / "records"), buffer_size=1),
+        NetworkTopology(resource.host_manager),
+    )
+    server = SchedulerHTTPServer(service)
+    server.serve()
+    return server
+
+
+class _CountingStore:
+    """DaemonStorage wrapper counting write_piece calls per number — the
+    exactly-one-commit-per-piece witness for the hedge drill."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.writes = {}
+        self._mu = threading.Lock()
+
+    def write_piece(self, task_id, number, data):
+        with self._mu:
+            self.writes[number] = self.writes.get(number, 0) + 1
+        return self._inner.write_piece(task_id, number, data)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TestHedgedStragglerDrill:
+    N_PIECES = 8
+
+    def test_slow_parent_hedge_wins_exactly_one_commit(self, tmp_path):
+        from dragonfly2_tpu.daemon.piece_pipeline import PIECE_HEDGE_TOTAL
+
+        server = _plane_swarm(tmp_path)
+        origin = _PlaneOrigin()
+        url = "https://origin/hedge-blob"
+        blob = b"".join(origin.content(url, n) for n in range(self.N_PIECES))
+        parents = [
+            _PlaneNode(f"hparent-{i}", server.url, tmp_path, origin)
+            for i in range(2)
+        ]
+        child = _PlaneNode(
+            "hchild", server.url, tmp_path, None,
+            # Aggressive hedging so the drill derives its threshold from
+            # the first couple of fetches: baseline ~ms, floor 0.15 s.
+            hedge_min_samples=2, hedge_floor_s=0.15, hedge_multiplier=3.0,
+            max_piece_retries=4,
+        )
+        try:
+            for p in parents:
+                r = p.conductor.download(
+                    url, piece_size=PIECE, content_length=len(blob)
+                )
+                assert r.ok  # first seeds from origin, second via p2p
+            counting = _CountingStore(child.storage)
+            child.conductor.storage = counting
+            fired0 = PIECE_HEDGE_TOTAL.value(outcome="fired")
+            # ONE straggler: piece.fetch call #5 stalls 2 s — far past
+            # the hedge threshold, far under the piece timeout.  The
+            # hedge (a later piece.fetch index) races the other parent.
+            scenario = ChaosScenario(faults=[
+                FaultSpec(site="piece.fetch", kind="delay", at=(5,),
+                          delay_s=2.0),
+            ])
+            with faultinject.installed(scenario.injector()):
+                result = child.conductor.download(url, piece_size=PIECE)
+            assert result.ok and not result.back_to_source, result
+            # Zero digest failures: crc checked at every read, whole
+            # content byte-identical to the origin.
+            assert sha256_hex(
+                child.storage.read_task_bytes(result.task_id)
+            ) == sha256_hex(blob)
+            # The hedge actually fired...
+            assert PIECE_HEDGE_TOTAL.value(outcome="fired") > fired0
+            # ...and NEVER double-committed: exactly one write per piece.
+            assert counting.writes == {
+                n: 1 for n in range(self.N_PIECES)
+            }, counting.writes
+        finally:
+            child.stop()
+            for p in parents:
+                p.stop()
+            server.stop()
+
+
+class TestParentDeathPoolEvictionDrill:
+    N_PIECES = 8
+
+    def test_dead_parent_evicted_from_pool_and_rescheduled(self, tmp_path):
+        server = _plane_swarm(tmp_path)
+        origin = _PlaneOrigin()
+        url = "https://origin/pool-evict-blob"
+        blob = b"".join(origin.content(url, n) for n in range(self.N_PIECES))
+        parents = [
+            _PlaneNode(f"kparent-{i}", server.url, tmp_path, origin)
+            for i in range(2)
+        ]
+        child = _PlaneNode(
+            "kchild", server.url, tmp_path, None,
+            hedge_enabled=False, max_piece_retries=8,
+            piece_wait_timeout_s=20.0, piece_parallelism=2,
+        )
+        try:
+            for p in parents:
+                r = p.conductor.download(
+                    url, piece_size=PIECE, content_length=len(blob)
+                )
+                assert r.ok
+            # Pace fetches so the kill lands mid-download (2 workers ×
+            # 0.25 s/fetch ≈ 1 s of download against a ~0.3 s kill).
+            scenario = ChaosScenario(faults=[
+                FaultSpec(site="piece.fetch", kind="delay", every=1,
+                          delay_s=0.25),
+            ])
+            result = {}
+
+            def run_child():
+                result["r"] = child.conductor.download(url, piece_size=PIECE)
+
+            victim = parents[0]
+            with faultinject.installed(scenario.injector()):
+                t = threading.Thread(target=run_child, daemon=True)
+                t.start()
+                wait_until(
+                    lambda: child.storage.held_pieces(
+                        child.conductor._task_id(url, None)
+                    ) >= 1,
+                    timeout=30, desc="first piece committed",
+                )
+                # Parent death: the listener closes AND its established
+                # keep-alive sockets sever (a SIGKILLed process's RSTs —
+                # stop() alone lets handler threads drain gracefully).
+                victim.server.stop()
+                for conn in list(
+                    child.fetcher.pool._idle.get(victim.host.id, [])
+                ):
+                    conn.sock.close()
+                t.join(timeout=60)
+            assert not t.is_alive(), "child hung after parent kill"
+            r = result["r"]
+            assert r.ok and not r.back_to_source, r
+            assert sha256_hex(
+                child.storage.read_task_bytes(r.task_id)
+            ) == sha256_hex(blob)
+            # The reschedule path ran: failures were reported against the
+            # dead parent and the pool holds NO connection to it.
+            assert r.failed_pieces >= 1
+            assert child.fetcher.pool.idle_count(victim.host.id) == 0
+            # The surviving parent's connection(s) are still pooled.
+            assert child.fetcher.pool.idle_count(parents[1].host.id) >= 1
+        finally:
+            child.stop()
+            for p in parents:
+                p.stop()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
 # Drill 4 — trainer crash mid-online-ingest → orbax resume, exactly-once
 # ---------------------------------------------------------------------------
 

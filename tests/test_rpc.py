@@ -604,11 +604,24 @@ class TestTracePropagation:
             assert linked, "no server span joined the download trace"
             assert linked[0].parent_id == dl.span_id
             assert linked[0].attributes.get("transport") == "http"
-        # Piece reports from WORKER THREADS stayed in-trace too (the
-        # p2p download's piece_finished handlers).
+        # Piece reports stayed in-trace too: they ride the report
+        # batcher's flush thread now, whose daemon/report.flush span
+        # carries the download context onto the batched RPC — the
+        # server-side report_pieces_finished handlers join the trace.
         p2p_trace = downloads[1].trace_id
         piece_handlers = [
-            h for h in exp.find("rpc/report_piece_finished")
+            h
+            for h in (
+                exp.find("rpc/report_pieces_finished")
+                + exp.find("rpc/report_piece_finished")
+            )
             if h.trace_id == p2p_trace
         ]
-        assert len(piece_handlers) >= 2
+        assert len(piece_handlers) >= 1
+        flushes = [
+            s for s in exp.find("daemon/report.flush")
+            if s.trace_id == p2p_trace
+        ]
+        assert flushes and sum(
+            s.attributes.get("reports", 0) for s in flushes
+        ) == 2

@@ -117,10 +117,20 @@ class TestFlightRecorderKillDrill:
             scenario = {
                 "seed": 0,
                 "faults": [
+                    # Piece reports ride the batched RPC now; the child
+                    # runs linger 0 so flushes track pieces closely and
+                    # the 3rd flush lands mid-download.
                     FaultSpec(
-                        site="rpc.client.report_piece_finished",
+                        site="rpc.client.report_pieces_finished",
                         kind="crash", at=(2,),
-                    ).to_dict()
+                    ).to_dict(),
+                    # Pace fetches well below flush cadence: the kill
+                    # must land with pieces still on the wire, not after
+                    # a loopback burst fetched everything.
+                    FaultSpec(
+                        site="piece.fetch", kind="delay", every=1,
+                        delay_s=0.05,
+                    ).to_dict(),
                 ],
             }
             proc = subprocess.Popen(

@@ -1,0 +1,442 @@
+"""End-to-end download throughput benchmark: wall-clock MB/s through the
+REAL piece data plane (scheduler RPC over HTTP + piece servers on
+loopback sockets), single-peer and N-peer swarm.
+
+Two arms per scenario, measured in INTERLEAVED rounds (bench_sched.py
+discipline: one unmeasured warm round, GC quiesced, walls measured in
+the downloading workers):
+
+- ``legacy``    — the pre-PR-11 path kept as the reference: one fresh
+  urllib connection per piece, whole-piece buffered serve, strictly
+  sequential fetch→digest→commit→report per worker;
+- ``pipelined`` — this PR's data plane: per-parent keep-alive connection
+  pool, ``os.sendfile`` zero-copy serve, commit pipeline (digest piece N
+  while N+1 is on the wire) and bounded-linger batched piece reports.
+
+Hedging is OFF in both arms (it is a tail-latency feature; a loopback
+bench would never trigger it and enabling it only on one arm would skew
+the comparison).
+
+Reports MB/s and p50/p99 per-piece fetch latency per arm, the
+``speedup_single`` / ``speedup_swarm`` ratios (acceptance bar:
+single ≥ 2×), pool reuse stats and server sendfile counts as evidence
+the fast arm really exercised the new plane, and a regression guard over
+``BENCH_DL_r*.json`` rounds at the repo root (bench.py's
+``apply_regression_guard`` applied to the download headline).
+
+Usage: PYTHONPATH=/root/repo python tools/bench_download.py
+       [--piece-mb 4] [--pieces 16] [--rounds 3] [--swarm 3]
+       [--parallelism 4] [--seed 0]
+       [--smoke]   # tiny sizes: the tier-1 JSON-schema gate
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import glob
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+SCHEMA_KEYS = (
+    "ok",
+    "metric",
+    "config",
+    "arms",
+    "speedup_single",
+    "speedup_swarm",
+    "pool",
+    "serve",
+)
+
+ARM_KEYS = ("MBps", "p50_ms", "p99_ms", "pieces", "bytes", "wall_s")
+
+
+def last_good_download(repo_dir: Optional[str] = None) -> dict:
+    """Most recent BENCH_DL_r*.json with a parsed single-peer headline —
+    the download plane's regression bar (bench.py discipline)."""
+    repo_dir = repo_dir or str(Path(__file__).resolve().parents[1])
+    best: dict = {}
+    for path in glob.glob(os.path.join(repo_dir, "BENCH_DL_r*.json")):
+        m = re.search(r"BENCH_DL_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        value = (data.get("arms", {}).get("pipelined_single") or {}).get("MBps")
+        if value is None:
+            continue
+        n = int(m.group(1))
+        if not best or n > best["round"]:
+            best = {
+                "round": n,
+                "value": float(value),
+                "file": os.path.basename(path),
+            }
+    return best
+
+
+class _Origin:
+    """Deterministic synthetic origin: piece N of a url is a seeded
+    numpy byte block (fast to generate, digest-stable)."""
+
+    def __init__(self, piece_size: int, n_pieces: int) -> None:
+        self.piece_size = piece_size
+        self.n_pieces = n_pieces
+
+    def content(self, url: str, number: int) -> bytes:
+        size = self.piece_size
+        if number == self.n_pieces - 1:
+            size = self.piece_size  # equal-size pieces keep sums trivial
+        seed = (hash(url) ^ (number * 2654435761)) & 0x7FFFFFFF
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+
+    def fetch(self, url: str, number: int, piece_size: int) -> bytes:
+        return self.content(url, number)
+
+
+class _TimingFetcher:
+    """PieceFetcher wrapper recording per-piece fetch wall times."""
+
+    def __init__(self, inner) -> None:
+        self.inner = inner
+        self.latencies: List[float] = []
+
+    def fetch(self, *a, **kw):
+        t0 = time.perf_counter()
+        data = self.inner.fetch(*a, **kw)
+        self.latencies.append(time.perf_counter() - t0)
+        return data
+
+    def piece_bitmap(self, *a, **kw):
+        return self.inner.piece_bitmap(*a, **kw)
+
+    def wait_piece_bitmap(self, *a, **kw):
+        return self.inner.wait_piece_bitmap(*a, **kw)
+
+
+class _Node:
+    """One bench 'machine': piece server + remote scheduler client +
+    conductor, configured as the legacy or the pipelined data plane."""
+
+    def __init__(
+        self,
+        name: str,
+        scheduler_url: str,
+        root: str,
+        origin,
+        *,
+        pipelined: bool,
+        parallelism: int,
+    ) -> None:
+        from dragonfly2_tpu.daemon import DaemonStorage, UploadManager
+        from dragonfly2_tpu.daemon.conductor import Conductor
+        from dragonfly2_tpu.rpc import HTTPPieceFetcher, RemoteScheduler
+        from dragonfly2_tpu.rpc.piece_transport import PieceHTTPServer
+        from dragonfly2_tpu.scheduler.resource import Host
+
+        self.storage = DaemonStorage(
+            os.path.join(root, name), prefer_native=False
+        )
+        self.upload = UploadManager(self.storage, concurrent_limit=64)
+        self.server = PieceHTTPServer(self.upload, use_sendfile=pipelined)
+        self.server.serve()
+        self.host = Host(
+            id=name, hostname=name, ip="127.0.0.1",
+            download_port=self.server.port,
+        )
+        self.host.stats.network.idc = "idc-a"
+        self.client = RemoteScheduler(scheduler_url)
+        self.fetcher = _TimingFetcher(
+            HTTPPieceFetcher(self.client.resolve_host, pooled=pipelined)
+        )
+        self.conductor = Conductor(
+            self.host,
+            self.storage,
+            self.client,
+            piece_fetcher=self.fetcher,
+            source_fetcher=origin,
+            piece_parallelism=parallelism,
+            pipeline_depth=4 if pipelined else 0,
+            batch_reports=pipelined,
+            hedge_enabled=False,
+        )
+
+    def stop(self) -> None:
+        self.server.stop()
+        self.fetcher.inner.close()
+        self.storage.close()
+
+
+def _summarize(nbytes: int, wall: float, latencies: List[float]) -> dict:
+    lat = np.sort(np.asarray(latencies)) if latencies else np.asarray([0.0])
+    total = len(lat)
+    return {
+        "MBps": round(nbytes / max(wall, 1e-9) / 1e6, 1),
+        "p50_ms": round(float(lat[int(total * 0.50)]) * 1e3, 3),
+        "p99_ms": round(float(lat[min(int(total * 0.99), total - 1)]) * 1e3, 3),
+        "pieces": total,
+        "bytes": nbytes,
+        "wall_s": round(wall, 4),
+    }
+
+
+def run(
+    piece_size: int,
+    n_pieces: int,
+    rounds: int,
+    swarm_n: int,
+    parallelism: int,
+    seed: int = 0,
+) -> dict:
+    from dragonfly2_tpu.records.storage import Storage
+    from dragonfly2_tpu.rpc.scheduler_server import SchedulerHTTPServer
+    from dragonfly2_tpu.scheduler import (
+        Evaluator,
+        NetworkTopology,
+        Resource,
+        SchedulerService,
+        Scheduling,
+        SchedulingConfig,
+    )
+
+    root = tempfile.mkdtemp(prefix="bench_dl_")
+    resource = Resource()
+    service = SchedulerService(
+        resource,
+        Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+        Storage(os.path.join(root, "records"), buffer_size=256),
+        NetworkTopology(resource.host_manager),
+    )
+    server = SchedulerHTTPServer(service)
+    server.serve()
+
+    origin = _Origin(piece_size, n_pieces)
+    content_length = piece_size * n_pieces
+    arms = ("legacy", "pipelined")
+    # One seed + clients per arm, reused across rounds (fresh task ids
+    # per round keep the piece plane cold; node setup stays untimed).
+    nodes: Dict[str, dict] = {}
+    for arm in arms:
+        pipelined = arm == "pipelined"
+        nodes[arm] = {
+            "seed": _Node(
+                f"seed-{arm}", server.url, root, origin,
+                pipelined=pipelined, parallelism=parallelism,
+            ),
+            "clients": [
+                _Node(
+                    f"client-{arm}-{i}", server.url, root, origin,
+                    pipelined=pipelined, parallelism=parallelism,
+                )
+                for i in range(swarm_n)
+            ],
+        }
+
+    walls = {f"{arm}_{scen}": 0.0 for arm in arms for scen in ("single", "swarm")}
+    nbytes = dict.fromkeys(walls, 0)
+    lats: Dict[str, List[float]] = {k: [] for k in walls}
+
+    def _seed_task(arm: str, url: str) -> None:
+        r = nodes[arm]["seed"].conductor.download(
+            url, piece_size=piece_size, content_length=content_length
+        )
+        if not (r.ok and r.pieces == n_pieces):
+            raise RuntimeError(f"seeding failed: {r}")
+
+    def _measure_single(arm: str, url: str) -> None:
+        client = nodes[arm]["clients"][0]
+        n0 = len(client.fetcher.latencies)
+        t0 = time.perf_counter()
+        r = client.conductor.download(url, piece_size=piece_size)
+        wall = time.perf_counter() - t0
+        if not (r.ok and not r.back_to_source and r.bytes == content_length):
+            raise RuntimeError(f"single download ({arm}) fell off p2p: {r}")
+        key = f"{arm}_single"
+        walls[key] += wall
+        nbytes[key] += r.bytes
+        lats[key].extend(client.fetcher.latencies[n0:])
+        client.storage.delete_task(r.task_id)
+
+    def _measure_swarm(arm: str, url: str) -> None:
+        clients = nodes[arm]["clients"]
+        marks = [len(c.fetcher.latencies) for c in clients]
+        spans = [(0.0, 0.0)] * len(clients)
+        results: List = [None] * len(clients)
+
+        def worker(i: int) -> None:
+            t0 = time.perf_counter()
+            results[i] = clients[i].conductor.download(
+                url, piece_size=piece_size
+            )
+            spans[i] = (t0, time.perf_counter())
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(len(clients))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = 0
+        for i, r in enumerate(results):
+            if r is None or not r.ok or r.back_to_source:
+                raise RuntimeError(f"swarm download ({arm}) failed: {r}")
+            total += r.bytes
+            lats[f"{arm}_swarm"].extend(clients[i].fetcher.latencies[marks[i]:])
+            clients[i].storage.delete_task(r.task_id)
+        wall = max(s[1] for s in spans) - min(s[0] for s in spans)
+        walls[f"{arm}_swarm"] += wall
+        nbytes[f"{arm}_swarm"] += total
+
+    try:
+        for r in range(rounds + 1):
+            measured = r > 0
+            if r == 1:
+                gc.collect()
+                gc.disable()
+            for arm in arms:
+                url_single = f"bench://dl-{seed}-{arm}-single-{r}"
+                url_swarm = f"bench://dl-{seed}-{arm}-swarm-{r}"
+                _seed_task(arm, url_single)
+                _seed_task(arm, url_swarm)
+                if measured:
+                    _measure_single(arm, url_single)
+                    _measure_swarm(arm, url_swarm)
+                else:
+                    # Warm pass: same code path, nothing recorded.
+                    _measure_single(arm, url_single)
+                    _measure_swarm(arm, url_swarm)
+                    for k in walls:
+                        walls[k] = 0.0
+                        nbytes[k] = 0
+                        lats[k].clear()
+                nodes[arm]["seed"].storage.delete_task(
+                    nodes[arm]["seed"].conductor._task_id(url_single, None)
+                )
+                nodes[arm]["seed"].storage.delete_task(
+                    nodes[arm]["seed"].conductor._task_id(url_swarm, None)
+                )
+        pool_stats = {
+            "dials": sum(
+                c.fetcher.inner.pool.dials for c in nodes["pipelined"]["clients"]
+            ),
+            "reuses": sum(
+                c.fetcher.inner.pool.reuses for c in nodes["pipelined"]["clients"]
+            ),
+        }
+        serve_stats = {
+            "sendfile_serves": nodes["pipelined"]["seed"].server.sendfile_serves
+            + sum(
+                c.server.sendfile_serves for c in nodes["pipelined"]["clients"]
+            ),
+            "legacy_sendfile_serves": nodes["legacy"]["seed"].server.sendfile_serves,
+        }
+    finally:
+        gc.enable()
+        for arm in arms:
+            nodes[arm]["seed"].stop()
+            for c in nodes[arm]["clients"]:
+                c.stop()
+        server.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+    arms_out = {k: _summarize(nbytes[k], walls[k], lats[k]) for k in walls}
+    out = {
+        "ok": True,
+        "metric": "download_MBps",
+        "config": {
+            "piece_size": piece_size,
+            "n_pieces": n_pieces,
+            "content_mb": round(content_length / 1e6, 2),
+            "rounds": rounds,
+            "swarm_clients": swarm_n,
+            "piece_parallelism": parallelism,
+            "seed": seed,
+            "cpus": os.cpu_count(),
+        },
+        "arms": arms_out,
+        "speedup_single": round(
+            arms_out["pipelined_single"]["MBps"]
+            / max(arms_out["legacy_single"]["MBps"], 1e-9),
+            2,
+        ),
+        "speedup_swarm": round(
+            arms_out["pipelined_swarm"]["MBps"]
+            / max(arms_out["legacy_swarm"]["MBps"], 1e-9),
+            2,
+        ),
+        "pool": pool_stats,
+        "serve": serve_stats,
+    }
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--piece-mb", type=float, default=4.0,
+                   help="piece size in MiB (daemon default: 4)")
+    p.add_argument("--pieces", type=int, default=16)
+    p.add_argument("--rounds", type=int, default=3,
+                   help="interleaved measured rounds (+1 unmeasured warm)")
+    p.add_argument("--swarm", type=int, default=3,
+                   help="concurrent clients in the swarm scenario")
+    p.add_argument("--parallelism", type=int, default=4,
+                   help="piece workers per download (both arms)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny sizes: the tier-1 JSON-schema gate")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.piece_mb, args.pieces = 0.0625, 4
+        args.rounds, args.swarm, args.parallelism = 1, 2, 2
+    try:
+        out = run(
+            int(args.piece_mb * (1 << 20)), args.pieces, max(args.rounds, 1),
+            max(args.swarm, 1), max(args.parallelism, 1), args.seed,
+        )
+        missing = [k for k in SCHEMA_KEYS if k not in out]
+        for arm, stats in out["arms"].items():
+            missing += [f"{arm}.{k}" for k in ARM_KEYS if k not in stats]
+        if missing:
+            raise RuntimeError(f"schema keys missing: {missing}")
+        # Regression guard (bench.py discipline) over the download
+        # headline: single-peer pipelined MB/s vs the last recorded
+        # BENCH_DL_r*.json round.
+        import bench
+
+        guard = {"value": out["arms"]["pipelined_single"]["MBps"]}
+        bench.apply_regression_guard(guard, last_good_download())
+        out["last_good"] = guard.get("last_good", {})
+        if "regression_warning" in guard:
+            out["regression_warning"] = guard["regression_warning"]
+    except Exception as exc:  # noqa: BLE001 — one parseable line, never a traceback
+        print(json.dumps({
+            "ok": False,
+            "metric": "download_MBps",
+            "error": f"{type(exc).__name__}: {exc}"[:300],
+        }))
+        return 1
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,6 +68,10 @@ REQUIRED_SEAMS = {
     ),
     "dragonfly2_tpu/daemon/upload.py": (
         "daemon.upload.serve_piece", "daemon.upload.body",
+        "daemon.upload.sendfile",
+    ),
+    "dragonfly2_tpu/daemon/piece_pipeline.py": (
+        "daemon.report.batch", "daemon.piece.hedge",
     ),
     "dragonfly2_tpu/trainer/online_graph.py": ("trainer.dispatch",),
     "dragonfly2_tpu/rpc/grpc_transport.py": (
@@ -75,7 +79,7 @@ REQUIRED_SEAMS = {
     ),
     "dragonfly2_tpu/rpc/piece_transport.py": (
         "piece.server.body", "piece.fetch", "piece.fetch.body",
-        "piece.bitmap", "piece.bitmap.body",
+        "piece.bitmap", "piece.bitmap.body", "piece.pool.connect",
     ),
     "dragonfly2_tpu/rpc/_server.py": ("rpc.server.*",),
     "dragonfly2_tpu/rpc/scheduler_client.py": ("rpc.client.*",),

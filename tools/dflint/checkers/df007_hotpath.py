@@ -66,6 +66,11 @@ REQUIRED_HOTPATH = {
         "FusedMLPScorer.score",
         "rule_weighted_sum",
     ),
+    # Piece data plane (PR 11): the per-piece serve/fetch entry points —
+    # per-item Python iteration belongs in their unmarked helpers (the
+    # readinto/sendfile loops), never in these inner functions.
+    "dragonfly2_tpu/rpc/piece_transport.py": ("HTTPPieceFetcher.fetch",),
+    "dragonfly2_tpu/daemon/upload.py": ("UploadManager.serve_piece",),
 }
 
 

@@ -48,6 +48,7 @@ REQUIRED_SPANS = {
     "dragonfly2_tpu/daemon/conductor.py": (
         "daemon/download", "daemon/piece", "daemon/source.piece", "daemon/*",
     ),
+    "dragonfly2_tpu/daemon/piece_pipeline.py": ("daemon/report.flush",),
     "dragonfly2_tpu/manager/rest.py": ("manager/GET", "manager/POST"),
     "dragonfly2_tpu/jobs/preheat.py": (
         "jobs/preheat", "jobs/preheat.execute",
