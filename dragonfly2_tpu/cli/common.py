@@ -45,6 +45,14 @@ def base_parser(prog: str, description: str) -> argparse.ArgumentParser:
              "overrides config tracing.log_path",
     )
     p.add_argument(
+        "--metric-journal", default=None, metavar="PATH",
+        help="fleet telemetry: append-only crash-safe metric journal "
+             "(length-prefixed, digest-checked DFMJ1 frames of periodic "
+             "counter/gauge/sketch snapshots + run identity) — feed "
+             "per-process journals to tools/fleet_assemble.py; overrides "
+             "config telemetry.journal_path",
+    )
+    p.add_argument(
         "--version", action="version", version=f"%(prog)s {__version__}"
     )
     return p
@@ -99,6 +107,41 @@ def init_flight_recorder(args, tracing_cfg, service: Optional[str] = None):
         exporters[0] if len(exporters) == 1 else tr.CompositeExporter(exporters)
     )
     return durable
+
+
+def init_telemetry(args, telemetry_cfg, service: Optional[str] = None):
+    """Config-driven metric journal + SLO engine, called AFTER
+    load_config in every binary next to ``init_flight_recorder``
+    (DESIGN.md §23): attaches the crash-safe metric journal when
+    ``--metric-journal`` or ``telemetry.journal_path`` names one, and —
+    when ``telemetry.slos`` declares objectives — starts the burn-rate
+    engine and installs it for the ``/debug/slo`` endpoints.  Returns
+    ``(journal, engine)`` (either may be None) so callers can flush and
+    stop on shutdown."""
+    service = service or getattr(args, "_prog", None) or "dragonfly"
+    path = getattr(args, "metric_journal", None) or (
+        telemetry_cfg.journal_path if telemetry_cfg is not None else ""
+    )
+    journal = None
+    if path:
+        from ..utils.metric_journal import MetricJournal
+
+        journal = MetricJournal(
+            path,
+            service=service,
+            interval_s=(
+                telemetry_cfg.journal_interval_s
+                if telemetry_cfg is not None else 10.0
+            ),
+        ).start()
+    engine = None
+    if telemetry_cfg is not None and telemetry_cfg.slos:
+        from ..utils import slo as slo_mod
+
+        engine = slo_mod.SLOEngine(telemetry_cfg.slos)
+        engine.start(telemetry_cfg.slo_interval_s)
+        slo_mod.install_engine(engine)
+    return journal, engine
 
 
 def init_diagnostics(cfg_metrics, service: str):

@@ -28,6 +28,7 @@ from .common import (
     init_debug,
     init_diagnostics,
     init_flight_recorder,
+    init_telemetry,
     init_logging,
     init_tracing,
 )
@@ -189,6 +190,7 @@ def run(argv=None) -> int:
 
     cfg = load_config(DaemonConfig, args.config)
     init_flight_recorder(args, cfg.tracing, "dfdaemon")
+    init_telemetry(args, cfg.telemetry, "dfdaemon")
     init_diagnostics(cfg.metrics, "dfdaemon")
     parts = build(cfg, args.scheduler)
 

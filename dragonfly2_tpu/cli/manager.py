@@ -19,6 +19,7 @@ from .common import (
     base_parser,
     init_debug,
     init_flight_recorder,
+    init_telemetry,
     init_logging,
     init_tracing,
 )
@@ -153,6 +154,7 @@ def run(argv=None) -> int:
 
     cfg = load_config(ManagerConfig, args.config)
     init_flight_recorder(args, cfg.tracing, "manager")
+    init_telemetry(args, cfg.telemetry, "manager")
     parts = build(cfg, replicate_from=args.replicate_from)
 
     if args.list_models:

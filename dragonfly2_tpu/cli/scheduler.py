@@ -29,6 +29,7 @@ from .common import (
     init_debug,
     init_diagnostics,
     init_flight_recorder,
+    init_telemetry,
     init_logging,
     init_tracing,
 )
@@ -122,6 +123,7 @@ def run(argv=None) -> int:
 
     cfg = load_config(SchedulerConfigFile, args.config)
     init_flight_recorder(args, cfg.tracing, "scheduler")
+    init_telemetry(args, cfg.telemetry, "scheduler")
     init_diagnostics(cfg.metrics, "scheduler")
     service, storage, runner = build(cfg)
 

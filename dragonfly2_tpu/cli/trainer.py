@@ -21,6 +21,7 @@ from .common import (
     base_parser,
     init_debug,
     init_flight_recorder,
+    init_telemetry,
     init_logging,
     init_tracing,
 )
@@ -41,6 +42,7 @@ def run(argv=None) -> int:
 
     cfg = load_config(TrainerConfigFile, args.config)
     init_flight_recorder(args, cfg.tracing, "trainer")
+    init_telemetry(args, cfg.telemetry, "trainer")
     manager_addr = args.manager or cfg.manager_addr
     if manager_addr and manager_addr.startswith("grpc://"):
         from ..rpc.grpc_transport import GRPCRemoteRegistry

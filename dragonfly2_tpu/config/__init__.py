@@ -19,6 +19,7 @@ from .schema import (  # noqa: F401
     SchedulerConfigFile,
     ServerConfig,
     StorageConfig,
+    TelemetrySection,
     TrainerConfigFile,
     load_config,
 )

@@ -72,6 +72,38 @@ class TracingSection:
 
 
 @dataclass
+class TelemetrySection:
+    """Fleet telemetry plane (utils/metric_journal.py + utils/slo.py;
+    DESIGN.md §23).  ``journal_path`` turns on the per-process crash-safe
+    metric journal — append-only digest-checked DFMJ1 frames of periodic
+    counter/gauge/sketch snapshots, merged fleet-wide by
+    ``tools/fleet_assemble.py``.  ``slos`` declares objectives the SLO
+    engine evaluates with multi-window burn-rate alerts (each entry:
+    ``name``, ``objective`` latency|availability, ``target``, plus
+    ``metric``+``threshold_ms`` or ``good_metric``+``total_metric``;
+    optional ``fast_window_s``/``slow_window_s``/``burn_threshold``) —
+    surfaced on ``/debug/slo`` and as ``slo_burn_rate{slo}`` /
+    ``slo_breached{slo}`` gauges."""
+
+    journal_path: str = ""
+    journal_interval_s: float = 10.0
+    slo_interval_s: float = 5.0
+    slos: list = field(default_factory=list)
+
+    def validate(self) -> None:
+        if self.journal_interval_s <= 0:
+            raise ConfigError("telemetry.journal_interval_s must be > 0")
+        if self.slo_interval_s <= 0:
+            raise ConfigError("telemetry.slo_interval_s must be > 0")
+        try:
+            from ..utils.slo import parse_slos
+
+            parse_slos(self.slos)
+        except ValueError as exc:
+            raise ConfigError(f"telemetry.slos: {exc}") from exc
+
+
+@dataclass
 class LogConfig:
     level: str = "info"
     dir: str = ""
@@ -192,6 +224,7 @@ class SchedulerConfigFile:
     gc: GCSection = field(default_factory=GCSection)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     tracing: TracingSection = field(default_factory=TracingSection)
+    telemetry: TelemetrySection = field(default_factory=TelemetrySection)
     log: LogConfig = field(default_factory=LogConfig)
     manager_addr: str = ""
     # Bearer credential (PAT or session token) for the manager's RBAC'd
@@ -212,6 +245,7 @@ class SchedulerConfigFile:
         self.scheduling.validate()
         self.log.validate()
         self.tracing.validate()
+        self.telemetry.validate()
 
 
 @dataclass
@@ -237,6 +271,7 @@ class TrainerConfigFile:
     manager_addr: str = ""
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     tracing: TracingSection = field(default_factory=TracingSection)
+    telemetry: TelemetrySection = field(default_factory=TelemetrySection)
     log: LogConfig = field(default_factory=LogConfig)
 
     def validate(self) -> None:
@@ -244,6 +279,7 @@ class TrainerConfigFile:
         self.training.validate()
         self.log.validate()
         self.tracing.validate()
+        self.telemetry.validate()
 
 
 @dataclass
@@ -351,12 +387,14 @@ class ManagerConfig:
     ha: HASection = field(default_factory=HASection)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     tracing: TracingSection = field(default_factory=TracingSection)
+    telemetry: TelemetrySection = field(default_factory=TelemetrySection)
     log: LogConfig = field(default_factory=LogConfig)
 
     def validate(self) -> None:
         self.server.validate()
         self.log.validate()
         self.tracing.validate()
+        self.telemetry.validate()
         self.rollout.validate()
         self.ha.validate()
         if self.token_secret and len(self.token_secret.encode()) < 16:
@@ -417,12 +455,14 @@ class DaemonConfig:
     probe_interval_s: float = 20 * 60.0
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     tracing: TracingSection = field(default_factory=TracingSection)
+    telemetry: TelemetrySection = field(default_factory=TelemetrySection)
     log: LogConfig = field(default_factory=LogConfig)
 
     def validate(self) -> None:
         self.server.validate()
         self.log.validate()
         self.tracing.validate()
+        self.telemetry.validate()
         if self.piece_size < 4096:
             raise ConfigError(f"piece_size {self.piece_size} too small")
 

@@ -32,25 +32,43 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from collections import deque
 from typing import Callable, List, Optional, Tuple
 
-from ..utils.metrics import Counter
+from ..utils.metrics import default_registry as _reg
 
 logger = logging.getLogger(__name__)
 
-PIECE_HEDGE_TOTAL = Counter(
+# Registered on the process-default registry (DF017: once, at module
+# scope) so the metric journal snapshots them alongside the sketches —
+# pre-§23 these were free-floating Counter instances invisible to
+# /metrics and the journal.
+PIECE_HEDGE_TOTAL = _reg.counter(
     "daemon_piece_hedge_total",
     "Hedged piece fetches by outcome (fired = second arm launched; "
     "won = the hedge arm's body was committed)",
     ("outcome",),
 )
 
-REPORT_BATCH_TOTAL = Counter(
+REPORT_BATCH_TOTAL = _reg.counter(
     "daemon_piece_report_batches_total",
     "Piece-report flushes by kind (batched = one report_pieces_finished "
     "RPC; fallback = per-piece calls, scheduler has no batch method)",
     ("kind",),
+)
+
+# Fleet telemetry sketches (DESIGN.md §23): the per-piece latency tail
+# and the report-batch linger, journaled crash-safe and merged
+# fleet-wide by tools/fleet_assemble.py — fixed-bucket histograms lose
+# exactly the tail these carry.
+PIECE_FETCH_SECONDS = _reg.sketch(
+    "daemon_piece_fetch_seconds",
+    "Per-piece fetch wall latency (hedge-plan baseline samples)",
+)
+REPORT_LINGER_SECONDS = _reg.sketch(
+    "daemon_report_linger_seconds",
+    "Piece-report batch linger: first enqueue to flush dispatch",
 )
 
 
@@ -186,6 +204,7 @@ class PieceReportBatcher:
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self._items: List[Tuple[int, str, int, int]] = []
+        self._first_ts = 0.0
         self._closed = False
         self._batch_unsupported = False
         self._error: Optional[BaseException] = None
@@ -205,14 +224,16 @@ class PieceReportBatcher:
         with self._cv:
             if self._error is not None or self._closed:
                 return False
+            if not self._items:
+                # First report of this batch: the linger clock starts
+                # here (REPORT_LINGER_SECONDS measures enqueue → flush).
+                self._first_ts = time.monotonic()
             self._items.append((number, parent_id, length, cost_ns))
             self._cv.notify_all()
         return True
 
     def _take_batch(self) -> Optional[List[Tuple[int, str, int, int]]]:
         """Linger until a batch is worth flushing (or close); None → done."""
-        import time
-
         with self._cv:
             while not self._items and not self._closed:
                 self._cv.wait(0.05)
@@ -231,7 +252,15 @@ class PieceReportBatcher:
                     self._cv.wait(left)
             batch = self._items[: self._max_batch]
             del self._items[: len(batch)]
-            return batch
+            linger = time.monotonic() - self._first_ts
+            if self._items:
+                # Remainder starts a fresh linger window now.
+                self._first_ts = time.monotonic()
+        # Observe OUTSIDE the cv (sketch lock never nests under batcher
+        # state): the fleet-mergeable record of how long reports waited
+        # to coalesce — the knob `linger_s` bounds, now measurable.
+        REPORT_LINGER_SECONDS.observe(linger)
+        return batch
 
     def _flush(self, batch: List[Tuple[int, str, int, int]]) -> None:
         from ..utils import faultinject
@@ -354,6 +383,10 @@ class PieceLatencyTracker:
         self._samples: deque = deque(maxlen=maxlen)
 
     def observe(self, latency_s: float) -> None:
+        # One sketch observe per fetch (outside this tracker's lock):
+        # the fleet-mergeable record of the same sample the hedge
+        # threshold derives from.
+        PIECE_FETCH_SECONDS.observe(latency_s)
         with self._mu:
             self._samples.append(latency_s)
 

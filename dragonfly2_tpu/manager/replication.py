@@ -476,11 +476,16 @@ class ReplicatedStateBackend(StateBackend):
         # Span OUTSIDE the commit lock: a span closing while a project
         # lock is held would hand the lock witness a lock→exporter edge
         # the static graph (which doesn't traverse generator
-        # contextmanagers) can never corroborate.
+        # contextmanagers) can never corroborate.  Same rule for the
+        # commit-lag sketch observe below.
+        from ..rpc.metrics import REPLICATION_COMMIT_SECONDS
+
+        t0 = time.monotonic()
         with default_tracer.span(
             "manager/replicate.commit", ns=ns, op=op
         ) as span:
             self._commit_op_locked(ns, op, payload, fn, span)
+        REPLICATION_COMMIT_SECONDS.observe(time.monotonic() - t0)
 
     def _commit_op_locked(
         self, ns: str, op: str, payload: dict, fn: Callable[[], None], span

@@ -333,6 +333,13 @@ class ManagerRESTServer:
                     from ..utils.metrics import default_registry
 
                     self._json(200, default_registry.exemplars())
+                elif path == "/debug/slo":
+                    # SLO burn rates + breach verdicts (DESIGN.md §23) —
+                    # the same surface the scheduler/daemon diagnostics
+                    # sidecar serves.
+                    from ..utils.slo import debug_state
+
+                    self._json(200, debug_state())
                 elif path == "/api/v1/replication:status":
                     # Follower poll target: log frontier + the signed
                     # lease (manager/replication.py LogFollower).

@@ -52,3 +52,10 @@ CIRCUIT_BREAKER_STATE = _reg.gauge(
     "Per-target breaker state: 0 closed, 1 half_open, 2 open",
     ["target"],
 )
+# Fleet telemetry sketch (DESIGN.md §23): write-ahead append + data
+# commit wall per replicated op — the control plane's commit-lag tail,
+# journaled crash-safe next to the data-plane sketches.
+REPLICATION_COMMIT_SECONDS = _reg.sketch(
+    "manager_replication_commit_seconds",
+    "Replicated commit wall (WAL append + data commit, per op)",
+)

@@ -34,16 +34,27 @@ import http.client
 import logging
 import os
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..daemon.upload import UploadBusy, UploadManager
+from ..utils.metrics import default_registry as _mreg
 from ._server import ThreadedHTTPService
 from .retry import retry_call
 
 logger = logging.getLogger(__name__)
+
+# Fleet telemetry sketch (DESIGN.md §23): the transport-level fetch wall
+# (dial + request + body, retries included) — the layer below the
+# conductor's hedge-plan samples, so a slow wire is distinguishable from
+# a slow schedule in the fleet view.
+PIECE_TRANSPORT_SECONDS = _mreg.sketch(
+    "rpc_piece_fetch_seconds",
+    "HTTPPieceFetcher.fetch wall latency (retries included)",
+)
 
 
 class PieceHTTPServer:
@@ -574,12 +585,15 @@ class HTTPPieceFetcher:
             else self._make_urlopen_once(ip, port, path)
         )
         breaker = self._breaker(parent_host_id)
+        t0 = time.monotonic()
         try:
-            return retry_call(
+            body = retry_call(
                 once, attempts=2, retry_on=(ConnectionError, TimeoutError),
                 breaker=breaker,
                 deadline_s=deadline_s,
             )
+            PIECE_TRANSPORT_SECONDS.observe(time.monotonic() - t0)
+            return body
         except Exception:
             # Breaker landed OPEN (this failure tripped it, or it was
             # already open): drain the parent's pooled sockets — they

@@ -57,6 +57,20 @@ EVAL_BATCH_FALLBACK_TOTAL = _reg.counter(
     "Coalesced scorer batches degraded to per-request scoring",
 )
 
+# -- fleet telemetry plane (DESIGN.md §23: mergeable percentile sketches) ----
+# Sketches carry the tail losslessly across processes (fixed-bucket
+# histograms cannot): journaled crash-safe (utils/metric_journal.py) and
+# merged fleet-wide by tools/fleet_assemble.py.
+ANNOUNCE_SECONDS = _reg.sketch(
+    "scheduler_announce_seconds",
+    "announce_host handling latency (store/refresh + column write)",
+)
+EVAL_FLUSH_SECONDS = _reg.sketch(
+    "scheduler_eval_flush_seconds",
+    "Coalesced scorer flush latency per dispatched group "
+    "(ScorerBatcher, DESIGN.md §14)",
+)
+
 # -- rollout plane (DESIGN.md §15: shadow scoring + canary serving) ----------
 SHADOW_ANNOUNCES_TOTAL = _reg.counter(
     "scheduler_shadow_announces_total",

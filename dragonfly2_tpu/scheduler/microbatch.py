@@ -243,6 +243,7 @@ class ScorerBatcher:
         from ..utils.tracing import default_tracer
 
         witness = dftrace.witness()
+        t0 = time.perf_counter()
         with default_tracer.span(
             "scheduler/eval.flush",
             batch=len(batch),
@@ -252,6 +253,10 @@ class ScorerBatcher:
             ),
         ):
             self._dispatch_group_traced(batch, scorer)
+        # Flush latency into the mergeable sketch (DESIGN.md §23): one
+        # observe per FLUSH, never per announce — the fleet p99 of the
+        # scorer path survives a SIGKILL via the metric journal.
+        metrics.EVAL_FLUSH_SECONDS.observe(time.perf_counter() - t0)
 
     def _dispatch_group_traced(self, batch: List[_Request], scorer) -> None:
         try:

@@ -208,6 +208,7 @@ class SchedulerService:
         — the announce decode is the marshalling point, not the evaluate
         path.  Both wire adapters and the in-process
         ``daemon.host_announcer`` land here."""
+        t0 = time.monotonic()
         stored = self.resource.store_host(host)
         if stored is not host:
             # Refresh announce-time stats AND addresses on the existing
@@ -224,6 +225,10 @@ class SchedulerService:
         # (the stats just changed) — the announce pays the marshalling
         # once so every subsequent serve is a pure fancy-index.
         stored.touch()
+        # Announce-handling latency into the mergeable sketch (DESIGN.md
+        # §23) — the fleet-scale scheduler's announces/sec signal rides
+        # the crash-safe journal, not the per-process scrape.
+        metrics.ANNOUNCE_SECONDS.observe(time.monotonic() - t0)
         return stored
 
     def _refresh_gauges(self) -> None:

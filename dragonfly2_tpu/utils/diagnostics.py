@@ -15,6 +15,10 @@ grpc_prometheus handler):
   GET /debug/exemplars  — histogram exemplars: last trace id per bucket,
                           joining a slow-bucket latency to its trace in
                           the flight recorder
+  GET /debug/slo        — the SLO engine's last evaluation (burn rates,
+                          breach verdicts per declared SLO; DESIGN.md
+                          §23) — the machine-readable overload signal
+                          the SLO autopilot consumes
 
 Gated behind config (``metrics.enable``); binds loopback by default —
 the exposition includes label values operators may consider internal.
@@ -63,6 +67,14 @@ class DiagnosticsServer:
                     self._body(
                         200,
                         json.dumps(default_registry.exemplars()).encode(),
+                        "application/json",
+                    )
+                elif self.path == "/debug/slo":
+                    from .slo import debug_state
+
+                    self._body(
+                        200,
+                        json.dumps(debug_state()).encode(),
                         "application/json",
                     )
                 else:

@@ -18,11 +18,15 @@ if str(REPO) not in sys.path:
 from tools.bench_report import (  # noqa: E402
     DOWNLOAD_BEGIN,
     DOWNLOAD_END,
+    TELEMETRY_BEGIN,
+    TELEMETRY_END,
     TRAJECTORY_BEGIN,
     TRAJECTORY_END,
     collect_download_rounds,
     collect_rounds,
+    collect_telemetry_rounds,
     render_download,
+    render_telemetry,
     render_trajectory,
     update_file,
 )
@@ -70,6 +74,41 @@ class TestTrajectoryStaleness:
         )
         for data in dl_rounds:
             assert f"| r{data['round']:02d} |" in committed
+
+    def test_committed_telemetry_table_is_current(self):
+        """Same staleness gate for the fleet-telemetry drill rounds
+        (python -m dragonfly2_tpu.sim.telemetry → TELEMETRY_r*.json)."""
+        tel_rounds = collect_telemetry_rounds(REPO)
+        assert tel_rounds, "no TELEMETRY_r*.json rounds found at the repo root"
+        text = (REPO / "BENCHMARKS.md").read_text(encoding="utf-8")
+        begin = text.find(TELEMETRY_BEGIN)
+        end = text.find(TELEMETRY_END)
+        assert begin >= 0 and end > begin, (
+            "BENCHMARKS.md telemetry markers missing"
+        )
+        committed = text[begin : end + len(TELEMETRY_END)]
+        fresh = render_telemetry(tel_rounds)
+        assert committed == fresh, (
+            "BENCHMARKS.md telemetry table is stale — regenerate with "
+            "`python -m tools.bench_report --update`"
+        )
+        for data in tel_rounds:
+            assert f"| r{data['round']:02d} |" in committed
+
+    def test_telemetry_round_drill_outcomes_recorded(self):
+        """The committed drill round really holds the acceptance
+        evidence: kill drill within the sketch bound, burn alert fired
+        and cleared, replay parity."""
+        for data in collect_telemetry_rounds(REPO):
+            assert data["ok"] is True, data.get("error")
+            kill = data["kill_drill"]
+            assert kill["victim_sigkilled"] and kill["torn_tail_tolerated"]
+            assert kill["corrupt_rejected"] >= 1
+            for chk in kill["quantile_checks"].values():
+                assert chk["rel_error"] <= kill["alpha"] * 1.0001
+            burn = data["burnrate_drill"]
+            assert burn["fired_within_fast_window"] is True
+            assert burn["replay_matches_live"] is True
 
 
 class TestRenderSemantics:

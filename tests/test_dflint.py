@@ -3544,3 +3544,239 @@ class TestDF016MutationSensitivity:
         out = capsys.readouterr().out
         assert rc == 0, out
         assert "0 new finding(s)" in out
+
+
+# ---------------------------------------------------------------------------
+# DF017 fixtures — metric hygiene (fleet telemetry plane, DESIGN.md §23) —
+# plus mutation sensitivity against the REAL tree
+# ---------------------------------------------------------------------------
+
+
+class TestDF017Fixtures:
+    def test_registration_inside_function_fires(self):
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            def handler():
+                c = _reg.counter("daemon_requests_total", "per-call!")
+                c.inc()
+            """,
+        )
+        assert any(
+            f.rule == "DF017" and "inside a function" in f.message for f in fs
+        )
+
+    def test_module_scope_registration_ok(self):
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            REQS = _reg.counter("daemon_requests_total", "requests", ["result"])
+            LAT = _reg.sketch("daemon_request_seconds", "latency")
+            DEPTH = _reg.gauge("daemon_queue_size", "depth")
+            """,
+        )
+        assert "DF017" not in rules_of(fs)
+
+    def test_direct_constructor_checked_too(self):
+        fs = lint(
+            """
+            from ..utils.metrics import Counter
+
+            def f():
+                return Counter("daemon_x_total", "per-call")
+            """,
+        )
+        assert any(f.rule == "DF017" for f in fs)
+
+    def test_duplicate_registration_fires(self):
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            A = _reg.counter("daemon_dup_total", "a")
+            B = _reg.counter("daemon_dup_total", "b")
+            """,
+        )
+        assert any(
+            f.rule == "DF017" and "twice" in f.message for f in fs
+        )
+
+    def test_unbounded_label_fires(self):
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            C = _reg.counter(
+                "daemon_fetch_total", "fetches", ["result", "peer_id"]
+            )
+            """,
+        )
+        assert any(
+            f.rule == "DF017" and "peer_id" in f.message for f in fs
+        )
+
+    def test_bounded_labels_ok(self):
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            C = _reg.counter(
+                "daemon_fetch_total", "fetches", ["result", "algorithm"]
+            )
+            """,
+        )
+        assert "DF017" not in rules_of(fs)
+
+    def test_naming_counter_without_total_fires(self):
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            C = _reg.counter("daemon_fetches", "count")
+            """,
+        )
+        assert any(
+            f.rule == "DF017" and "_total" in f.message for f in fs
+        )
+
+    def test_naming_unknown_subsystem_fires(self):
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            C = _reg.counter("frobnicator_ops_total", "count")
+            """,
+        )
+        assert any(
+            f.rule == "DF017" and "subsystem" in f.message for f in fs
+        )
+
+    def test_naming_sketch_needs_unit_suffix(self):
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            S = _reg.sketch("daemon_fetch_latency", "no unit")
+            """,
+        )
+        assert any(
+            f.rule == "DF017" and "unit suffix" in f.message for f in fs
+        )
+
+    def test_gauge_exempt_from_unit_but_not_prefix(self):
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            G = _reg.gauge("manager_role", "role flag")
+            """,
+        )
+        assert "DF017" not in rules_of(fs)
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            G = _reg.gauge("role", "one token only")
+            """,
+        )
+        assert any(f.rule == "DF017" for f in fs)
+
+    def test_dynamic_name_not_checked(self):
+        # Non-literal names (drill/test helpers) are out of scope.
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            def drill(name):
+                return _reg.sketch(name, "drill metric")
+            """,
+        )
+        assert "DF017" not in rules_of(fs)
+
+    def test_non_registry_receiver_not_checked(self):
+        fs = lint(
+            """
+            def f(store):
+                return store.counter("not_a_metric", "kv api lookalike")
+            """,
+        )
+        assert "DF017" not in rules_of(fs)
+
+    def test_inventory_missing_metric_fires_by_name(self):
+        fs = lint(
+            """
+            def quiet():
+                return 1
+            """,
+            relpath="dragonfly2_tpu/utils/slo.py",
+        )
+        assert any(
+            f.rule == "DF017" and "slo_burn_rate" in f.message for f in fs
+        )
+
+    def test_pragma_suppresses(self):
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            def f():
+                return _reg.counter("daemon_x_total", "ok")  # dflint: disable=DF017
+            """,
+        )
+        assert "DF017" not in rules_of(fs)
+
+    def test_real_metric_modules_satisfy_inventory(self):
+        from tools.dflint.checkers.df017_metrics import REQUIRED_METRICS, check
+        from tools.dflint.core import load_module
+
+        for rel in REQUIRED_METRICS:
+            module = load_module(REPO / rel, REPO)
+            findings = [f for f in check(module) if f.rule == "DF017"]
+            assert findings == [], f"{rel}: {[f.message for f in findings]}"
+
+    def test_inventory_not_stale(self):
+        from tools.dflint.checkers.df017_metrics import stale_inventory_entries
+
+        assert stale_inventory_entries(REPO) == []
+
+
+class TestDF017MutationSensitivity:
+    def _lint_source(self, relpath: str, source: str):
+        module = Module(REPO / relpath, relpath, source)
+        return run_checkers(module)
+
+    def test_deleting_piece_fetch_sketch_fails_df017(self):
+        # The acceptance mutation: delete the inventoried hot-path
+        # sketch — tier-1 fails BY NAME.
+        relpath = "dragonfly2_tpu/daemon/piece_pipeline.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        assert '"daemon_piece_fetch_seconds"' in source
+        mutated = source.replace(
+            '"daemon_piece_fetch_seconds"', '"daemon_piece_renamed_seconds"'
+        )
+        fs = [
+            f for f in self._lint_source(relpath, mutated)
+            if f.rule == "DF017"
+        ]
+        assert any("daemon_piece_fetch_seconds" in f.message for f in fs)
+
+    def test_deleting_slo_gauge_fails_df017(self):
+        relpath = "dragonfly2_tpu/utils/slo.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        assert '"slo_breached"' in source
+        mutated = source.replace('"slo_breached"', '"slo_gone"')
+        fs = [
+            f for f in self._lint_source(relpath, mutated)
+            if f.rule == "DF017"
+        ]
+        assert any("slo_breached" in f.message for f in fs)
+
+    def test_cli_rule_filter_selects_df017(self, capsys):
+        from tools.dflint.__main__ import main
+
+        rc = main(["dragonfly2_tpu", "--rule", "DF017", "-q"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "0 new finding(s)" in out

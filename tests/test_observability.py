@@ -157,6 +157,11 @@ def _get(url: str):
         return resp.status, resp.headers.get("Content-Type", ""), resp.read()
 
 
+def _get_slow(url: str):
+    with urllib.request.urlopen(url, timeout=60) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), resp.read()
+
+
 class TestDiagnosticsServer:
     @pytest.fixture()
     def server(self):
@@ -226,6 +231,93 @@ class TestDiagnosticsServer:
         with pytest.raises(urllib.error.HTTPError) as e:
             _get(server.url + "/nope")
         assert e.value.code == 404
+
+
+class TestConcurrentScrape:
+    """ISSUE 12 satellite: hammer ``/metrics`` while 8 threads mutate
+    counters/histograms/sketches — every scrape must parse through the
+    real text-format parser with monotonic counters and no torn lines."""
+
+    def test_scrapes_parse_and_counters_monotonic(self):
+        import threading
+
+        from dragonfly2_tpu.utils.diagnostics import DiagnosticsServer
+        from dragonfly2_tpu.utils.metrics import default_registry
+
+        c = default_registry.counter(
+            "scrape_storm_total", "storm", ["worker", "result"]
+        )
+        h = default_registry.histogram(
+            "scrape_storm_seconds", "storm", ["worker"]
+        )
+        s = default_registry.sketch(
+            "scrape_storm_lat_seconds", "storm", ["worker"]
+        )
+        srv = DiagnosticsServer(port=0)
+        srv.serve()
+        stop = threading.Event()
+        errors = []
+
+        def mutate(wid: int) -> None:
+            # Hostile label values included: escaping must hold under
+            # concurrency, not just in the single-threaded tests above.
+            label = f'w{wid}"evil\n' if wid % 2 else f"w{wid}"
+            child_h = h.labels(worker=label)
+            child_s = s.labels(worker=label)
+            i = 0
+            try:
+                while not stop.is_set():
+                    c.inc(worker=label, result="ok")
+                    child_h.observe(0.001 * (i % 50))
+                    child_s.observe(0.001 * (i % 50) + 1e-6)
+                    i += 1
+                    if i % 20 == 0:
+                        # Yield: 8 hot loops on a 1-CPU box would starve
+                        # the scrape thread via the GIL — the test is
+                        # about torn lines, not about out-scheduling it.
+                        stop.wait(0.001)
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=mutate, args=(i,), daemon=True)
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            last_totals = {}
+            parsed_rounds = 0
+            for _ in range(15):
+                # Generous timeout: late in the suite the default
+                # registry is large and the box has one CPU.
+                status, ctype, body = _get_slow(srv.url + "/metrics")
+                assert status == 200 and "text/plain" in ctype
+                parsed = parse_exposition(body.decode())
+                parsed_rounds += 1
+                # Counters never go backwards between scrapes.
+                for key, value in parsed.get("scrape_storm_total", {}).items():
+                    prev = last_totals.get(key, 0.0)
+                    assert value >= prev, (key, prev, value)
+                    last_totals[key] = value
+                # Histogram internal consistency per scrape: +Inf bucket
+                # equals _count (a torn line would break the pairing).
+                buckets = parsed.get("scrape_storm_seconds_bucket", {})
+                counts = parsed.get("scrape_storm_seconds_count", {})
+                for key, total in counts.items():
+                    inf_key = tuple(list(key) + [("le", "+Inf")])
+                    assert buckets.get(inf_key) == total
+                # Sketch summary lines parse with their quantile label.
+                for key in parsed.get("scrape_storm_lat_seconds", {}):
+                    assert any(k == "quantile" for k, _v in key)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(5.0)
+            srv.stop()
+        assert errors == []
+        assert parsed_rounds == 15
+        assert sum(last_totals.values()) > 0
 
 
 class TestManagerDiagnosticsRoutes:

@@ -1,0 +1,652 @@
+"""Fleet telemetry plane unit tests (ISSUE 12, DESIGN.md §23): the
+mergeable percentile Sketch, the crash-safe metric journal, the SLO
+burn-rate engine, and the /debug/slo endpoints."""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from dragonfly2_tpu.utils import metrics as m  # noqa: E402
+from dragonfly2_tpu.utils.metric_journal import (  # noqa: E402
+    MetricJournal,
+    final_snapshots_by_run,
+    replay_metric_journal,
+)
+from dragonfly2_tpu.utils.metrics import (  # noqa: E402
+    Registry,
+    Sketch,
+    merge_sketch_states,
+    sketch_state_count_below,
+    sketch_state_quantile,
+)
+from dragonfly2_tpu.utils.slo import (  # noqa: E402
+    SLO,
+    SLOEngine,
+    parse_slos,
+    replay_fleet,
+)
+
+
+def _exact_quantile(samples, q):
+    ordered = np.sort(np.asarray(samples))
+    rank = max(int(math.ceil(q * len(ordered))), 1) - 1
+    return float(ordered[rank])
+
+
+class TestSketch:
+    @pytest.mark.parametrize("dist", ["lognormal", "uniform", "bimodal"])
+    def test_relative_error_bound(self, dist):
+        rng = np.random.default_rng(7)
+        if dist == "lognormal":
+            samples = rng.lognormal(-3, 1.5, 8000)
+        elif dist == "uniform":
+            samples = rng.uniform(1e-4, 10.0, 8000)
+        else:
+            samples = np.concatenate(
+                [rng.normal(0.01, 0.001, 4000), rng.normal(2.0, 0.2, 4000)]
+            )
+            samples = np.abs(samples) + 1e-9
+        s = Sketch("t_seconds", "t", alpha=0.01)
+        for v in samples:
+            s.observe(float(v))
+        for q in (0.5, 0.9, 0.99, 0.999):
+            exact = _exact_quantile(samples, q)
+            est = s.quantile(q)
+            assert abs(est - exact) / exact <= 0.01 + 1e-9, (dist, q)
+
+    def test_deterministic_across_instances(self):
+        """Same stream → byte-identical state: the cross-process merge
+        precondition (two daemons observing the same latency classify it
+        into the same bucket)."""
+        rng = np.random.default_rng(3)
+        samples = [float(v) for v in rng.lognormal(-2, 1, 500)]
+        a, b = Sketch("a_seconds", ""), Sketch("b_seconds", "")
+        for v in samples:
+            a.observe(v)
+            b.observe(v)
+        assert a.aggregate_state() == b.aggregate_state()
+
+    def test_merge_is_lossless(self):
+        """Merging per-process states equals one sketch over the whole
+        stream: bucket counts add exactly (sum is float-rounding-equal)."""
+        rng = np.random.default_rng(1)
+        samples = [float(v) for v in rng.lognormal(-3, 1.2, 5000)]
+        parts = [Sketch(f"p{i}_seconds", "") for i in range(3)]
+        whole = Sketch("w_seconds", "")
+        for i, v in enumerate(samples):
+            parts[i % 3].observe(v)
+            whole.observe(v)
+        merged = merge_sketch_states([p.aggregate_state() for p in parts])
+        want = whole.aggregate_state()
+        for key in ("alpha", "zero", "counts", "total", "min", "max"):
+            assert merged[key] == want[key], key
+        assert merged["sum"] == pytest.approx(want["sum"])
+        for q in (0.5, 0.99):
+            assert sketch_state_quantile(merged, q) == pytest.approx(
+                whole.quantile(q)
+            )
+
+    def test_merge_rejects_alpha_mismatch(self):
+        a = Sketch("a_seconds", "", alpha=0.01)
+        b = Sketch("b_seconds", "", alpha=0.02)
+        a.observe(1.0)
+        b.observe(1.0)
+        with pytest.raises(ValueError, match="alpha"):
+            merge_sketch_states([a.aggregate_state(), b.aggregate_state()])
+
+    def test_serialization_roundtrip_exact(self):
+        s = Sketch("x_seconds", "", ["op"])
+        for v in (0.001, 0.5, 2.0, 0.0, 1e-15):
+            s.observe(v, op="k")
+        st = s.state()
+        assert st["type"] == "sketch"
+        # JSON roundtrip preserves the state exactly (ints + floats).
+        back = json.loads(json.dumps(st))
+        # json turns the [idx, count] pairs into lists — normalize.
+        assert back["series"][0][1] == json.loads(
+            json.dumps(st["series"][0][1])
+        )
+        restored = Sketch("y_seconds", "", ["op"])
+        restored.merge_state(st["series"][0][1], op="k")
+        assert restored.aggregate_state() == s.aggregate_state()
+
+    def test_zero_and_negative_values(self):
+        s = Sketch("z_seconds", "")
+        s.observe(0.0)
+        s.observe(-1.0)
+        s.observe(1.0)
+        agg = s.aggregate_state()
+        assert agg["zero"] == 2 and agg["total"] == 3
+        assert s.quantile(0.5) == 0.0
+
+    def test_fixed_size_collapse_bound(self):
+        """Past max_bins distinct buckets the LOW end collapses; the
+        high quantiles keep full resolution."""
+        s = Sketch("c_seconds", "", max_bins=32)
+        # Values spanning a huge dynamic range → many distinct buckets.
+        for i in range(2000):
+            s.observe(1e-9 * (1.13 ** (i % 300)))
+        agg = s.aggregate_state()
+        assert len(agg["counts"]) <= 32
+        assert agg["total"] == 2000
+        # Tail estimate still within bound of the exact tail.
+        samples = [1e-9 * (1.13 ** (i % 300)) for i in range(2000)]
+        exact = _exact_quantile(samples, 0.99)
+        assert abs(s.quantile(0.99) - exact) / exact <= 0.011
+
+    def test_count_below_within_resolution(self):
+        rng = np.random.default_rng(5)
+        samples = [float(v) for v in rng.lognormal(-3, 1, 4000)]
+        s = Sketch("cb_seconds", "")
+        for v in samples:
+            s.observe(v)
+        thr = 0.05
+        got = s.count_below(thr)
+        # Resolution is one bucket: everything ≤ thr counts, plus at
+        # most the remainder of thr's bucket (upper bound < thr·γ).
+        gamma = (1 + 0.01) / (1 - 0.01)
+        exact_lo = sum(1 for v in samples if v <= thr)
+        exact_hi = sum(1 for v in samples if v <= thr * gamma)
+        assert exact_lo <= got <= exact_hi
+
+    def test_sketch_toggle_disables_recording(self):
+        s = Sketch("tog_seconds", "")
+        m.set_sketches_enabled(False)
+        try:
+            s.observe(1.0)
+            s.labels().observe(1.0)
+        finally:
+            m.set_sketches_enabled(True)
+        assert s.total_count() == 0
+        s.observe(1.0)
+        assert s.total_count() == 1
+
+    def test_exposed_as_summary_and_parses(self):
+        from tests.test_observability import parse_exposition
+
+        reg = Registry()
+        s = reg.sketch("exp_fetch_seconds", "h", ["op"])
+        for v in (0.01, 0.02, 0.5):
+            s.observe(v, op='evil"op\n')
+        text = reg.expose_text()
+        assert "# TYPE exp_fetch_seconds summary" in text
+        parsed = parse_exposition(text)
+        key_count = (("op", 'evil"op\n'),)
+        assert parsed["exp_fetch_seconds_count"][key_count] == 3.0
+        assert any(
+            ("quantile", "0.5") in k for k in parsed["exp_fetch_seconds"]
+        )
+
+
+class TestRegistrySnapshot:
+    def test_counters_gauges_sketches_serialized(self):
+        reg = Registry()
+        reg.counter("s_ops_total", "", ["r"]).inc(r="ok")
+        reg.gauge("s_depth_rows", "").set(3.0)
+        reg.sketch("s_lat_seconds", "").observe(0.2)
+        reg.histogram("s_hist_seconds", "").observe(0.2)
+        snap = reg.snapshot()
+        assert snap["s_ops_total"]["type"] == "counter"
+        assert snap["s_ops_total"]["series"] == [[["ok"], 1.0]]
+        assert snap["s_depth_rows"]["series"] == [[[], 3.0]]
+        assert snap["s_lat_seconds"]["type"] == "sketch"
+        # Histograms are scrape-only (the sketch is the durable carrier).
+        assert "s_hist_seconds" not in snap
+        json.dumps(snap)  # journal payload must be JSON-clean
+
+
+class TestMetricJournal:
+    def _mk(self, tmp_path, interval_s=60.0):
+        reg = Registry()
+        c = reg.counter("j_ops_total", "")
+        s = reg.sketch("j_lat_seconds", "")
+        path = str(tmp_path / "m.dfmj")
+        j = MetricJournal(path, registry=reg, service="t",
+                          interval_s=interval_s)
+        return reg, c, s, path, j
+
+    def test_snapshots_cumulative_and_replayable(self, tmp_path):
+        _reg, c, s, path, j = self._mk(tmp_path)
+        c.inc()
+        s.observe(0.1)
+        j.write_snapshot()
+        c.inc(amount=2)
+        j.write_snapshot()
+        j.close()  # writes the final frame
+        snaps, stats = replay_metric_journal(path)
+        assert stats == {"frames": 3, "corrupt": 0, "torn_tail": False}
+        assert [s["seq"] for s in snaps] == [1, 2, 3]
+        assert snaps[1]["metrics"]["j_ops_total"]["series"] == [[[], 3.0]]
+        finals = final_snapshots_by_run(snaps)
+        assert list(finals.values())[0]["seq"] == 3
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        _reg, c, _s, path, j = self._mk(tmp_path)
+        c.inc()
+        j.write_snapshot()
+        j.write_snapshot()
+        j.close()
+        data = Path(path).read_bytes()
+        Path(path).write_bytes(data[:-20])  # SIGKILL mid-write signature
+        snaps, stats = replay_metric_journal(path)
+        assert stats["torn_tail"] is True
+        assert stats["corrupt"] == 0
+        assert stats["frames"] == 2
+
+    def test_digest_bad_frame_never_admitted(self, tmp_path):
+        _reg, c, _s, path, j = self._mk(tmp_path)
+        for _ in range(3):
+            c.inc()
+            j.write_snapshot()
+        j.close()
+        data = bytearray(Path(path).read_bytes())
+        i = data.find(b'"seq": 2')
+        assert i > 0
+        data[i + 8] ^= 0x01
+        Path(path).write_bytes(bytes(data))
+        snaps, stats = replay_metric_journal(path)
+        assert stats["corrupt"] == 1
+        assert [s["seq"] for s in snaps] == [1, 3, 4]
+
+    def test_garbage_between_frames_resyncs(self, tmp_path):
+        _reg, c, _s, path, j = self._mk(tmp_path)
+        c.inc()
+        j.write_snapshot()
+        with open(path, "ab") as f:
+            f.write(b"#### operator cat'd a logline in here ####\n")
+        j.write_snapshot()
+        j.close()
+        snaps, stats = replay_metric_journal(path)
+        assert stats["frames"] == 3 and stats["corrupt"] == 0
+
+    def test_missing_file(self, tmp_path):
+        snaps, stats = replay_metric_journal(str(tmp_path / "nope"))
+        assert snaps == [] and stats["frames"] == 0
+
+    def test_background_cadence_and_close_idempotent(self, tmp_path):
+        import time
+
+        _reg, c, _s, path, j = self._mk(tmp_path, interval_s=0.05)
+        j.start()
+        c.inc()
+        deadline = time.monotonic() + 5.0
+        while j.written < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        j.close()
+        written = j.written
+        assert written >= 2
+        j.close()  # no second final frame
+        snaps, _stats = replay_metric_journal(path)
+        assert len(snaps) == written
+
+    def test_run_identity_separates_restarts(self, tmp_path):
+        """Two runs of the 'same' service in one journal: the final
+        snapshot of EACH run survives — fleet counters sum both."""
+        reg = Registry()
+        c = reg.counter("r_ops_total", "")
+        path = str(tmp_path / "r.dfmj")
+        j1 = MetricJournal(path, registry=reg, service="d", run_id="run-a",
+                           interval_s=60)
+        c.inc(amount=5)
+        j1.close()
+        reg2 = Registry()  # restart: counters reset, fresh run id
+        c2 = reg2.counter("r_ops_total", "")
+        j2 = MetricJournal(path, registry=reg2, service="d", run_id="run-b",
+                           interval_s=60)
+        c2.inc(amount=2)
+        j2.close()
+        snaps, _ = replay_metric_journal(path)
+        finals = final_snapshots_by_run(snaps)
+        assert set(finals) == {("d", "run-a"), ("d", "run-b")}
+        total = sum(
+            v for f in finals.values()
+            for _k, v in f["metrics"]["r_ops_total"]["series"]
+        )
+        assert total == 7.0
+
+
+class TestSLOEngine:
+    def _slo(self, **kw):
+        d = dict(
+            name="s", objective="latency", metric="l_seconds",
+            threshold_ms=100.0, target=0.9, fast_window_s=10.0,
+            slow_window_s=60.0, burn_threshold=2.0,
+        )
+        d.update(kw)
+        return d
+
+    def test_parse_validates(self):
+        assert isinstance(parse_slos([self._slo()])[0], SLO)
+        with pytest.raises(ValueError, match="objective"):
+            parse_slos([self._slo(objective="vibes")])
+        with pytest.raises(ValueError, match="target"):
+            parse_slos([self._slo(target=1.0)])
+        with pytest.raises(ValueError, match="unknown keys"):
+            parse_slos([dict(self._slo(), extra=1)])
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_slos([self._slo(), self._slo()])
+        with pytest.raises(ValueError, match="threshold_ms"):
+            parse_slos([self._slo(threshold_ms=0)])
+        with pytest.raises(ValueError, match="good_metric"):
+            parse_slos([{"name": "a", "objective": "availability",
+                         "target": 0.9}])
+
+    def test_burn_rate_math_latency(self):
+        reg = Registry()
+        sk = reg.sketch("l_seconds", "")
+        eng = SLOEngine([self._slo()], registry=reg)
+        t0 = 1000.0
+        for i in range(10):
+            sk.observe(0.01)
+        eng.tick(now=t0)
+        # The window delta since the baseline tick is 10 NEW events, all
+        # bad; budget 0.1 → burn 10.
+        for i in range(10):
+            sk.observe(5.0)
+        state = eng.tick(now=t0 + 5.0)["s"]
+        assert state["burn_rate_fast"] == pytest.approx(10.0)
+        assert state["breached"] is True
+        # Mixed follow-up: 10 good / 0 bad since the last sample keeps
+        # the cumulative ratios honest (burn falls).
+        for i in range(20):
+            sk.observe(0.01)
+        state = eng.tick(now=t0 + 8.0)["s"]
+        # Fast window now spans both deltas: 10 bad of 30 → burn ~3.33.
+        assert state["burn_rate_fast"] == pytest.approx(10.0 / 30.0 / 0.1)
+
+    def test_availability_objective(self):
+        reg = Registry()
+        good = reg.counter("g_ok_total", "")
+        total = reg.counter("g_all_total", "")
+        slo = {
+            "name": "avail", "objective": "availability", "target": 0.99,
+            "good_metric": "g_ok_total", "total_metric": "g_all_total",
+            "fast_window_s": 10.0, "slow_window_s": 60.0,
+            "burn_threshold": 2.0,
+        }
+        eng = SLOEngine([slo], registry=reg)
+        good.inc(amount=100)
+        total.inc(amount=100)
+        eng.tick(now=0.0)
+        good.inc(amount=90)
+        total.inc(amount=100)
+        state = eng.tick(now=5.0)["avail"]
+        # 10% bad / 1% budget = burn 10.
+        assert state["burn_rate_fast"] == pytest.approx(10.0)
+        assert state["breached"] is True
+
+    def test_multiwindow_requires_both(self):
+        """A short spike trips the fast window but not the slow one →
+        no alert (the multi-window point)."""
+        reg = Registry()
+        sk = reg.sketch("l_seconds", "")
+        eng = SLOEngine([self._slo(fast_window_s=1.0, slow_window_s=600.0,
+                                   burn_threshold=3.0)], registry=reg)
+        t = 0.0
+        for _ in range(600):
+            sk.observe(0.01)
+        eng.tick(now=t)
+        # Long healthy history inside the slow window.
+        for i in range(20):
+            t += 10.0
+            for _ in range(50):
+                sk.observe(0.01)
+            eng.tick(now=t)
+        # One-second spike of pure badness.
+        t += 1.0
+        for _ in range(10):
+            sk.observe(5.0)
+        state = eng.tick(now=t)["s"]
+        assert state["burn_rate_fast"] > 3.0
+        assert state["burn_rate_slow"] < 3.0
+        assert state["breached"] is False
+
+    def test_gauges_exported(self):
+        from dragonfly2_tpu.utils.slo import SLO_BREACHED, SLO_BURN_RATE
+
+        reg = Registry()
+        sk = reg.sketch("l_seconds", "")
+        eng = SLOEngine([self._slo(name="gauge_probe")], registry=reg)
+        sk.observe(0.01)
+        eng.tick(now=0.0)
+        for _ in range(10):
+            sk.observe(5.0)
+        eng.tick(now=5.0)
+        assert SLO_BURN_RATE.value(slo="gauge_probe") > 2.0
+        assert SLO_BREACHED.value(slo="gauge_probe") == 1.0
+
+    def test_replay_fleet_merges_process_streams(self):
+        """Two processes each 95% good → fleet replay sees the sum."""
+        slo = self._slo(target=0.5, burn_threshold=1.5)
+        snaps = []
+        for pi, run in enumerate(("run-a", "run-b")):
+            reg = Registry()
+            sk = reg.sketch("l_seconds", "")
+            for i in range(20):
+                sk.observe(5.0 if i % 2 else 0.01)
+            snaps.append({
+                "service": f"d{pi}", "run_id": run, "seq": 1,
+                "ts": 100.0 + pi, "metrics": reg.snapshot(),
+            })
+        eng = replay_fleet(snaps, [slo])
+        state = eng.state()["slos"][0]
+        # Baseline = the first fleet sample (run-a alone, 20 events);
+        # the window delta is run-b's 20 events joining at t=101.
+        assert state["events_slow"] == 20.0
+        # run-b's delta is 50% bad / 50% budget = burn 1.0.
+        assert state["burn_rate_slow"] == pytest.approx(1.0)
+
+
+class TestDebugSLOEndpoints:
+    def test_diagnostics_route(self):
+        from dragonfly2_tpu.utils import slo as slo_mod
+        from dragonfly2_tpu.utils.diagnostics import DiagnosticsServer
+
+        reg = Registry()
+        sk = reg.sketch("d_seconds", "")
+        eng = SLOEngine(
+            [{"name": "ep", "objective": "latency", "metric": "d_seconds",
+              "threshold_ms": 100.0, "target": 0.9, "fast_window_s": 1.0,
+              "slow_window_s": 10.0}],
+            registry=reg,
+        )
+        sk.observe(0.01)
+        eng.tick(now=0.0)
+        for _ in range(10):
+            sk.observe(5.0)
+        eng.tick(now=0.5)
+        slo_mod.install_engine(eng)
+        srv = DiagnosticsServer(port=0)
+        srv.serve()
+        try:
+            with urllib.request.urlopen(srv.url + "/debug/slo", timeout=5) as r:
+                payload = json.loads(r.read())
+        finally:
+            srv.stop()
+            slo_mod.install_engine(None)
+        assert payload["installed"] is True
+        assert payload["slos"][0]["name"] == "ep"
+        assert payload["slos"][0]["breached"] is True
+        # The endpoint serves EXACTLY the engine's state.
+        assert payload["slos"] == eng.state()["slos"]
+
+    def test_uninstalled_engine_empty(self):
+        from dragonfly2_tpu.utils import slo as slo_mod
+        from dragonfly2_tpu.utils.diagnostics import DiagnosticsServer
+
+        slo_mod.install_engine(None)
+        srv = DiagnosticsServer(port=0)
+        srv.serve()
+        try:
+            with urllib.request.urlopen(srv.url + "/debug/slo", timeout=5) as r:
+                payload = json.loads(r.read())
+        finally:
+            srv.stop()
+        assert payload == {"slos": [], "installed": False}
+
+    def test_manager_rest_route(self):
+        from dragonfly2_tpu.manager.cluster import ClusterManager
+        from dragonfly2_tpu.manager.registry import ModelRegistry
+        from dragonfly2_tpu.manager.rest import ManagerRESTServer
+
+        server = ManagerRESTServer(ModelRegistry(), ClusterManager())
+        server.serve()
+        try:
+            with urllib.request.urlopen(
+                server.url + "/debug/slo", timeout=5
+            ) as r:
+                payload = json.loads(r.read())
+        finally:
+            server.stop()
+        assert "slos" in payload
+
+
+class TestTelemetryConfig:
+    def test_section_defaults_and_validation(self):
+        from dragonfly2_tpu.config import ConfigError, SchedulerConfigFile
+
+        cfg = SchedulerConfigFile()
+        cfg.validate()
+        cfg.telemetry.slos = [{"name": "x", "objective": "latency",
+                               "metric": "m_seconds", "threshold_ms": 10,
+                               "target": 0.9}]
+        cfg.validate()
+        cfg.telemetry.slos = [{"name": "x", "objective": "nope",
+                               "target": 0.9}]
+        with pytest.raises(ConfigError, match="telemetry.slos"):
+            cfg.validate()
+        cfg.telemetry.slos = []
+        cfg.telemetry.journal_interval_s = 0
+        with pytest.raises(ConfigError, match="journal_interval_s"):
+            cfg.validate()
+
+    def test_all_four_configs_carry_telemetry(self):
+        from dragonfly2_tpu.config import (
+            DaemonConfig,
+            ManagerConfig,
+            SchedulerConfigFile,
+            TrainerConfigFile,
+        )
+
+        for cls in (SchedulerConfigFile, DaemonConfig, ManagerConfig,
+                    TrainerConfigFile):
+            cfg = cls()
+            assert cfg.telemetry.journal_path == ""
+            cfg.validate() if cls is not ManagerConfig else None
+
+    def test_init_telemetry_wires_journal_and_engine(self, tmp_path):
+        import argparse
+
+        from dragonfly2_tpu.cli.common import init_telemetry
+        from dragonfly2_tpu.config import TelemetrySection
+        from dragonfly2_tpu.utils import slo as slo_mod
+
+        args = argparse.Namespace(metric_journal=None, _prog="scheduler")
+        cfg = TelemetrySection(
+            journal_path=str(tmp_path / "j.dfmj"),
+            journal_interval_s=60.0,
+            slos=[{"name": "wired", "objective": "latency",
+                   "metric": "w_seconds", "threshold_ms": 10,
+                   "target": 0.9}],
+        )
+        journal, engine = init_telemetry(args, cfg, "scheduler")
+        try:
+            assert journal is not None and engine is not None
+            assert slo_mod.current_engine() is engine
+            journal.write_snapshot()
+            snaps, _ = replay_metric_journal(str(tmp_path / "j.dfmj"))
+            assert snaps and snaps[0]["service"] == "scheduler"
+        finally:
+            journal.close()
+            engine.close()
+            slo_mod.install_engine(None)
+
+    def test_flag_overrides_config_path(self, tmp_path):
+        import argparse
+
+        from dragonfly2_tpu.cli.common import init_telemetry
+        from dragonfly2_tpu.config import TelemetrySection
+
+        flag_path = str(tmp_path / "flag.dfmj")
+        args = argparse.Namespace(metric_journal=flag_path, _prog="dfdaemon")
+        cfg = TelemetrySection(journal_path=str(tmp_path / "cfg.dfmj"))
+        journal, engine = init_telemetry(args, cfg)
+        try:
+            assert journal.path == flag_path
+            assert engine is None
+        finally:
+            journal.close()
+
+
+class TestHotPathSketchesRegistered:
+    """The §23 wiring contract: the hot-path sketches exist on the
+    default registry (DF017's REQUIRED_METRICS is the static half)."""
+
+    EXPECTED = (
+        "daemon_piece_fetch_seconds",
+        "daemon_report_linger_seconds",
+        "rpc_piece_fetch_seconds",
+        "scheduler_announce_seconds",
+        "scheduler_eval_flush_seconds",
+        "manager_replication_commit_seconds",
+    )
+
+    def test_sketches_on_default_registry(self):
+        import dragonfly2_tpu.daemon.piece_pipeline  # noqa: F401
+        import dragonfly2_tpu.rpc.metrics  # noqa: F401
+        import dragonfly2_tpu.rpc.piece_transport  # noqa: F401
+        import dragonfly2_tpu.scheduler.metrics  # noqa: F401
+        from dragonfly2_tpu.utils.metrics import default_registry
+
+        for name in self.EXPECTED:
+            assert isinstance(default_registry.get(name), Sketch), name
+
+    def test_piece_latency_tracker_feeds_sketch(self):
+        from dragonfly2_tpu.daemon.piece_pipeline import (
+            PIECE_FETCH_SECONDS,
+            PieceLatencyTracker,
+        )
+
+        before = PIECE_FETCH_SECONDS.total_count()
+        tracker = PieceLatencyTracker()
+        tracker.observe(0.123)
+        assert PIECE_FETCH_SECONDS.total_count() == before + 1
+
+    def test_announce_feeds_sketch(self):
+        from dragonfly2_tpu.scheduler import metrics as smetrics
+        from dragonfly2_tpu.scheduler.resource import Host
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+        from dragonfly2_tpu.scheduler import (
+            Evaluator,
+            NetworkTopology,
+            Resource,
+            Scheduling,
+            SchedulingConfig,
+        )
+        from dragonfly2_tpu.records.storage import Storage
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            resource = Resource()
+            service = SchedulerService(
+                resource,
+                Scheduling(Evaluator(), SchedulingConfig()),
+                Storage(d, buffer_size=10),
+                NetworkTopology(resource.host_manager),
+            )
+            before = smetrics.ANNOUNCE_SECONDS.total_count()
+            service.announce_host(
+                Host(id="h1", hostname="h1", ip="127.0.0.1")
+            )
+            assert smetrics.ANNOUNCE_SECONDS.total_count() == before + 1

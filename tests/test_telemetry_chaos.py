@@ -1,0 +1,144 @@
+"""Telemetry-plane chaos drills (ISSUE 12 acceptance; DESIGN.md §23).
+
+Kill drill: SIGKILL one of three journaling daemons mid-storm (crash
+fault on the ``metrics.journal.write`` seam), tear the dead journal's
+tail frame and bit-rot a survivor's mid-file frame — ``fleet_assemble``
+must still merge all three runs into fleet quantiles with 0 digest-bad
+frames admitted, and the merged sketch p50/p99 must sit within the
+declared relative-error bound of an EXACT oracle computed from the raw
+samples the admitted frames cover.
+
+Burn-rate drill: synthetic overload flips ``slo_breached`` within one
+fast window, clears after recovery, and the journal replay
+(``slo.replay_fleet``) reconstructs the same state ``/debug/slo``
+served live.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from dragonfly2_tpu.sim import telemetry  # noqa: E402
+
+
+class TestTelemetryKillDrill:
+    def test_sigkill_mid_storm_fleet_quantiles_survive(self, tmp_path):
+        report = telemetry.run_kill_drill(str(tmp_path / "kill"))
+        # The drill asserts the hard invariants internally (victim
+        # SIGKILLed, torn tail tolerated, digest-bad frame rejected,
+        # count parity with the oracle, quantiles within α); the test
+        # re-states the headline numbers for the failure report.
+        assert report["ok"] is True
+        assert report["children"] == 3
+        assert report["victim_sigkilled"] is True
+        assert report["corrupt_rejected"] == 1
+        assert report["torn_tail_tolerated"] is True
+        # The victim contributed a strict prefix of its storm: its
+        # admitted frames cover fewer samples than the survivors'.
+        covered = report["per_run_covered"]
+        assert covered["dfdaemon0"] < covered["dfdaemon1"]
+        for q, chk in report["quantile_checks"].items():
+            assert chk["rel_error"] <= report["alpha"] * 1.0001, (q, chk)
+
+    def test_fleet_assemble_renders_and_reports_slo(self, tmp_path):
+        """The CLI surface over a journal set: human rendering + JSON +
+        SLO replay through --slo-config."""
+        import json
+        import subprocess
+
+        from dragonfly2_tpu.utils.metric_journal import MetricJournal
+        from dragonfly2_tpu.utils.metrics import Registry
+
+        journals = []
+        for i in range(2):
+            reg = Registry()
+            sk = reg.sketch("drill_fetch_seconds", "")
+            c = reg.counter("drill_ops_total", "")
+            path = str(tmp_path / f"p{i}.dfmj")
+            j = MetricJournal(path, registry=reg, service=f"d{i}",
+                              interval_s=60, run_id=f"run-{i}")
+            for k in range(100):
+                sk.observe(0.01 if k % 10 else 0.5)
+                c.inc()
+            j.close()
+            journals.append(path)
+        slo_cfg = tmp_path / "slos.json"
+        slo_cfg.write_text(json.dumps([telemetry.DRILL_SLO]))
+        out = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "fleet_assemble.py"),
+             *journals, "--json", "--slo-config", str(slo_cfg)],
+            capture_output=True, text=True, cwd=str(REPO), timeout=60,
+        )
+        assert out.returncode == 0, out.stderr
+        report = json.loads(out.stdout)
+        assert report["total_corrupt"] == 0
+        assert len(report["runs"]) == 2
+        assert report["counters"]["drill_ops_total"]["total"] == 200.0
+        q = report["quantiles"]["drill_fetch_seconds"]
+        assert q["count"] == 200
+        assert q["p50"] is not None and q["p99"] is not None
+        assert report["slos"][0]["name"] == telemetry.DRILL_SLO["name"]
+        # Human rendering too.
+        out2 = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "fleet_assemble.py"),
+             *journals],
+            capture_output=True, text=True, cwd=str(REPO), timeout=60,
+        )
+        assert out2.returncode == 0, out2.stderr
+        assert "Fleet quantiles" in out2.stdout
+        assert "2 run(s) merged" in out2.stdout
+
+
+class TestBurnRateDrill:
+    def test_overload_fires_and_clears_and_replays(self, tmp_path):
+        report = telemetry.run_burnrate_drill(
+            str(tmp_path / "burn.dfmj")
+        )
+        assert report["ok"] is True
+        assert report["fired_within_fast_window"] is True
+        assert report["replay_matches_live"] is True
+        assert report["replay_breached_at_fire"] is True
+        assert report["replay_burn_drift"] <= 0.25
+        final = report["final_state"]
+        assert final["live"]["breached"] == final["replay"]["breached"]
+
+    def test_debug_slo_endpoint_matches_engine_during_drill(self):
+        """/debug/slo serves the installed engine's state verbatim —
+        the wire half of the live-vs-replay parity bar."""
+        import json
+        import urllib.request
+
+        from dragonfly2_tpu.utils import slo as slo_mod
+        from dragonfly2_tpu.utils.diagnostics import DiagnosticsServer
+        from dragonfly2_tpu.utils.metrics import Registry
+        from dragonfly2_tpu.utils.slo import SLOEngine
+
+        reg = Registry()
+        sk = reg.sketch("drill_fetch_seconds", "")
+        eng = SLOEngine([telemetry.DRILL_SLO], registry=reg)
+        for _ in range(50):
+            sk.observe(0.01)
+        eng.tick(now=0.0)
+        for _ in range(50):
+            sk.observe(0.5)
+        eng.tick(now=0.3)
+        slo_mod.install_engine(eng)
+        srv = DiagnosticsServer(port=0)
+        srv.serve()
+        try:
+            with urllib.request.urlopen(
+                srv.url + "/debug/slo", timeout=5
+            ) as r:
+                payload = json.loads(r.read())
+        finally:
+            srv.stop()
+            slo_mod.install_engine(None)
+        assert payload["slos"] == eng.state()["slos"]
+        assert payload["slos"][0]["breached"] is True

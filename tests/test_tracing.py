@@ -295,6 +295,13 @@ class TestTraceAssembly:
                 ps.set(bytes=4096, parent="p0", retries=0)
                 with sched.remote_span("rpc/report_piece_finished", daemon.inject()["traceparent"]):
                     pass
+        # The PR-11 batched-report window: one flush span carrying the
+        # download's context, with the batched RPC's handler span inside.
+        with daemon.remote_span("daemon/report.flush", tp, reports=3):
+            with sched.remote_span(
+                "rpc/report_pieces_finished", daemon.inject()["traceparent"]
+            ):
+                pass
         if kill_parent_export:
             # Root never exports; the log ends in a torn frame.  Sever
             # the exporter too — otherwise the root contextmanager's GC
@@ -320,6 +327,27 @@ class TestTraceAssembly:
         assert trace["critical_path"][0]["name"] == "daemon/download"
         assert {"schedule", "piece", "commit", "download"} <= set(trace["phases"])
         assert trace["anomalies"] == []
+
+    def test_data_plane_phase_breakdown(self, tmp_path):
+        """The per-download table splits the PR-11 data plane: piece
+        FETCH (daemon/piece), COMMIT acknowledgment (the scheduler's
+        report handlers, batched RPC included), and the REPORT-FLUSH
+        window (daemon/report.flush) each get their own phase row."""
+        from tools.trace_assemble import build_report, phase_of, render_report
+
+        assert phase_of("daemon/piece") == "piece"
+        assert phase_of("daemon/report.flush") == "report_flush"
+        assert phase_of("rpc/report_piece_finished") == "commit"
+        assert phase_of("rpc/report_pieces_finished") == "commit"
+        dlog, slog, _ = self._two_process_logs(tmp_path)
+        report = build_report([dlog, slog], validate=True)
+        phases = report["trace"]["phases"]
+        assert phases["piece"]["count"] == 3
+        assert phases["report_flush"]["count"] == 1
+        # Per-piece reports AND the batched flush RPC both land in commit.
+        assert phases["commit"]["count"] == 5
+        rendered = render_report(report)
+        assert "| report_flush | 1 |" in rendered
 
     def test_torn_log_still_assembles_with_anomalies(self, tmp_path):
         from tools.trace_assemble import build_report

@@ -59,6 +59,7 @@ SCHEMA_KEYS = (
     "mean_batch_occupancy",
     "steady_state_recompiles",
     "tracing_overhead",
+    "telemetry_overhead",
     "sweep",
 )
 
@@ -370,12 +371,48 @@ def run(hosts: int, parents: int, announcers: int, announces: int,
                 _os.unlink(trace_path)
             except OSError:
                 pass
+        # Telemetry-overhead rounds (ISSUE 12 acceptance: sketch
+        # recording ≤3% on vector_ml).  Same bench discipline as the
+        # tracing guard: unmeasured warm pass per plan set, interleaved
+        # on/off rounds with alternating arm order.  "on" = the §23
+        # sketches recording (scheduler_eval_flush_seconds fires per
+        # flush on this path); "off" = metrics.set_sketches_enabled(False),
+        # the operator's off switch.
+        from dragonfly2_tpu.utils import metrics as _metrics
+
+        sk_walls = {"on": 0.0, "off": 0.0}
+        sk_counts = {"on": 0, "off": 0}
+        from dragonfly2_tpu.scheduler.metrics import EVAL_FLUSH_SECONDS
+
+        sketch_before = EVAL_FLUSH_SECONDS.total_count()
+        try:
+            for r in range(rounds):
+                plans = _make_plans(
+                    len(peers), parents_per_announce=parents,
+                    announcers=announcers, announces=per_round,
+                    seed=seed + 2000 + r,
+                )
+                _metrics.set_sketches_enabled(False)
+                pool.run_round(ml_vec.evaluate_parents, task, peers, plans)
+                arms = ("on", "off") if r % 2 == 0 else ("off", "on")
+                for arm in arms:
+                    _metrics.set_sketches_enabled(arm == "on")
+                    wall, lat = pool.run_round(
+                        ml_vec.evaluate_parents, task, peers, plans
+                    )
+                    sk_walls[arm] += wall
+                    sk_counts[arm] += len(lat)
+        finally:
+            _metrics.set_sketches_enabled(True)
+        sketch_observed = EVAL_FLUSH_SECONDS.total_count() - sketch_before
     finally:
         gc.enable()
         pool.shutdown()
     paths = {name: _summarize(walls[name], lats[name]) for name, _ in named}
     on_aps = trace_counts["on"] / trace_walls["on"]
     off_aps = trace_counts["off"] / trace_walls["off"]
+    sk_on_aps = sk_counts["on"] / sk_walls["on"]
+    sk_off_aps = sk_counts["off"] / sk_walls["off"]
 
     return {
         "ok": True,
@@ -415,6 +452,18 @@ def run(hosts: int, parents: int, announcers: int, announces: int,
             "overhead_pct": round(100.0 * (off_aps - on_aps) / off_aps, 2),
             "sample_rate": 0.1,
             "spans_durable": durable.exported,
+        },
+        # Sketch-recording overhead on the vector_ml serving path
+        # (DESIGN.md §23 telemetry guard, ≤3% bar in BENCHMARKS.md):
+        # interleaved sketches-on vs sketches-off rounds; negative
+        # values are box noise.
+        "telemetry_overhead": {
+            "on_announces_per_sec": round(sk_on_aps, 1),
+            "off_announces_per_sec": round(sk_off_aps, 1),
+            "overhead_pct": round(
+                100.0 * (sk_off_aps - sk_on_aps) / sk_off_aps, 2
+            ),
+            "sketch_observes": sketch_observed,
         },
     }
 

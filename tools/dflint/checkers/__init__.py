@@ -14,6 +14,7 @@ from . import (
     df006_deadlines,
     df007_hotpath,
     df016_spans,
+    df017_metrics,
 )
 
 CHECKERS = (
@@ -25,6 +26,7 @@ CHECKERS = (
     df006_deadlines,
     df007_hotpath,
     df016_spans,
+    df017_metrics,
 )
 
 RULES = {c.RULE: c for c in CHECKERS}

@@ -113,6 +113,7 @@ REQUIRED_SEAMS = {
     "dragonfly2_tpu/jobs/image.py": ("jobs.image.fetch",),
     "dragonfly2_tpu/jobs/remote.py": ("jobs.remote.call",),
     "dragonfly2_tpu/objectstorage/s3.py": ("objectstorage.request",),
+    "dragonfly2_tpu/utils/metric_journal.py": ("metrics.journal.write",),
 }
 
 

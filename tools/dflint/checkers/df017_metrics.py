@@ -1,0 +1,252 @@
+"""DF017 — metric hygiene.
+
+The fleet telemetry plane (utils/metric_journal.py +
+tools/fleet_assemble.py, DESIGN.md §23) is only as trustworthy as the
+metric definitions feeding it: a metric registered inside a request
+handler allocates per call and may race its own re-registration; an
+unbounded label value (a raw peer id) explodes series cardinality until
+the scrape — and every journal frame — is megabytes; a misnamed metric
+breaks every dashboard that greps by convention; and deleting a
+hot-path metric silently blinds the fleet view — nothing else fails.
+
+Four sub-rules over literal-name registration sites (``_reg.counter(
+"name", ...)`` / ``Counter("name", ...)`` and the gauge/histogram/
+sketch twins):
+
+1. **Module scope, exactly once** — registration calls must sit at
+   module scope (constants, like the reference's metrics.go:44-180),
+   and a literal name must not be registered twice in one module.
+
+2. **Label-cardinality bound** — declared label names must not come
+   from the unbounded-identifier family (``peer_id``, ``task_id``,
+   ``url``, ``ip``, ...): those take one series per entity and a label
+   value per request.  Bounded enums (``result``, ``outcome``,
+   ``algorithm``) are the accepted shape.
+
+3. **Naming convention** — ``<subsystem>_<name>[_<unit>]``: the first
+   token must be a known subsystem, counters must end ``_total``, and
+   histograms/sketches must end in a declared unit suffix
+   (``_seconds``, ``_bytes``, ...).
+
+4. **Inventory** — ``REQUIRED_METRICS`` pins each instrumented module
+   to the metric names it must register; deleting an inventoried
+   hot-path metric fails tier-1 by name (the DF004/DF016 discipline).
+   Staleness is checked by ``stale_inventory_entries`` in tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterator, List, Optional, Tuple
+
+from ..core import Finding, Module, dotted, walk_calls
+
+RULE = "DF017"
+TITLE = "metric hygiene (module-scope registration, labels, naming, inventory)"
+
+REGISTER_METHODS = ("counter", "gauge", "histogram", "sketch")
+CONSTRUCTOR_KINDS = {
+    "Counter": "counter",
+    "Gauge": "gauge",
+    "Histogram": "histogram",
+    "Sketch": "sketch",
+}
+
+# The metric classes' own definition/registration plumbing.
+SELF_MODULE = "dragonfly2_tpu/utils/metrics.py"
+
+SUBSYSTEMS = (
+    "daemon", "scheduler", "manager", "rpc", "trainer", "rollout",
+    "jobs", "source", "slo", "fleet", "sim",
+)
+
+# Counter names must end _total; histogram/sketch names must end in one
+# of these unit tokens.  Gauges carry state (roles, counts-in-flight),
+# so they are exempt from the unit suffix but not from the subsystem
+# prefix.
+UNIT_SUFFIXES = (
+    "seconds", "bytes", "total", "ratio", "percent", "retries", "size",
+    "ms", "ns",
+)
+
+# Unbounded-identifier label names: one series per peer/task/host is a
+# cardinality explosion on a million-peer fleet.
+FORBIDDEN_LABELS = (
+    "peer_id", "host_id", "task_id", "trace_id", "span_id", "run_id",
+    "url", "ip", "addr", "address", "peer", "hostname",
+)
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
+
+# relpath -> metric names that module must register.  The telemetry
+# plane's coverage contract, checked in: deleting an inventoried
+# hot-path metric fails tier-1 by name.
+REQUIRED_METRICS = {
+    "dragonfly2_tpu/daemon/piece_pipeline.py": (
+        "daemon_piece_hedge_total",
+        "daemon_piece_report_batches_total",
+        "daemon_piece_fetch_seconds",
+        "daemon_report_linger_seconds",
+    ),
+    "dragonfly2_tpu/rpc/piece_transport.py": (
+        "rpc_piece_fetch_seconds",
+    ),
+    "dragonfly2_tpu/scheduler/metrics.py": (
+        "scheduler_eval_seconds",
+        "scheduler_announce_seconds",
+        "scheduler_eval_flush_seconds",
+    ),
+    "dragonfly2_tpu/rpc/metrics.py": (
+        "manager_replication_lag_seconds",
+        "manager_replication_commit_seconds",
+    ),
+    "dragonfly2_tpu/utils/slo.py": (
+        "slo_burn_rate",
+        "slo_breached",
+    ),
+}
+
+
+def _registration_of(call: ast.Call) -> Optional[str]:
+    """The metric KIND registered by this call, or None.
+
+    Matches ``<receiver>.counter|gauge|histogram|sketch("literal", ...)``
+    where the receiver looks like a registry, and direct
+    ``Counter("literal", ...)``-family constructors."""
+    if not call.args:
+        return None
+    first = call.args[0]
+    if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+        return None
+    if isinstance(call.func, ast.Attribute):
+        if call.func.attr not in REGISTER_METHODS:
+            return None
+        recv = dotted(call.func.value) or ""
+        leaf = recv.split(".")[-1].lower()
+        if "reg" not in leaf:
+            return None
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return CONSTRUCTOR_KINDS.get(call.func.id)
+    return None
+
+
+def _label_names(call: ast.Call) -> List[Tuple[ast.AST, str]]:
+    """Literal label names declared at the registration site (the third
+    positional arg / ``label_names=``)."""
+    node: Optional[ast.AST] = None
+    if len(call.args) >= 3:
+        node = call.args[2]
+    for kw in call.keywords:
+        if kw.arg == "label_names":
+            node = kw.value
+    if not isinstance(node, (ast.List, ast.Tuple)):
+        return []
+    out = []
+    for elt in node.elts:
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+            out.append((elt, elt.value))
+    return out
+
+
+def metric_sites(module: Module) -> List[Tuple[ast.Call, str, str]]:
+    """(call, kind, name) for every literal-name registration in the
+    module — shared with the inventory check and the tests."""
+    out = []
+    for call in walk_calls(module.tree):
+        kind = _registration_of(call)
+        if kind is not None:
+            out.append((call, kind, call.args[0].value))
+    return out
+
+
+def stale_inventory_entries(root: Path) -> List[str]:
+    """Inventory entries whose module no longer exists (tier-1 staleness
+    check, the DF004/DF016 discipline)."""
+    return [rel for rel in REQUIRED_METRICS if not (root / rel).is_file()]
+
+
+def _check_name(kind: str, name: str) -> Optional[str]:
+    if not _NAME_RE.match(name):
+        return (
+            f"metric name {name!r} breaks the <subsystem>_<name>_<unit> "
+            "convention (lowercase tokens joined by underscores)"
+        )
+    first = name.split("_", 1)[0]
+    if first not in SUBSYSTEMS:
+        return (
+            f"metric {name!r}: unknown subsystem prefix {first!r} "
+            f"(known: {', '.join(SUBSYSTEMS)})"
+        )
+    if kind == "counter" and not name.endswith("_total"):
+        return f"counter {name!r} must end in _total"
+    if kind in ("histogram", "sketch"):
+        unit = name.rsplit("_", 1)[-1]
+        if unit not in UNIT_SUFFIXES:
+            return (
+                f"{kind} {name!r} must end in a unit suffix "
+                f"({', '.join('_' + u for u in UNIT_SUFFIXES)})"
+            )
+    return None
+
+
+def check(module: Module) -> Iterator[Finding]:
+    if module.relpath == SELF_MODULE:
+        return
+
+    sites = metric_sites(module)
+    seen: dict = {}
+    for call, kind, name in sites:
+        # Sub-rule 1: module scope, exactly once.
+        if module.enclosing_function(call) is not None:
+            yield module.finding(
+                RULE,
+                call,
+                f"metric {name!r} registered inside a function — metrics "
+                "are module-scope constants (one registration per "
+                "process, like the reference's metrics.go)",
+            )
+        prev = seen.get(name)
+        if prev is not None:
+            yield module.finding(
+                RULE,
+                call,
+                f"metric {name!r} registered twice in this module "
+                f"(first at line {prev})",
+            )
+        else:
+            seen[name] = call.lineno
+
+        # Sub-rule 2: label-cardinality bound.
+        for node, label in _label_names(call):
+            if label in FORBIDDEN_LABELS:
+                yield module.finding(
+                    RULE,
+                    node,
+                    f"metric {name!r} declares unbounded label "
+                    f"{label!r} — one series per entity explodes "
+                    "cardinality on a fleet; aggregate or drop the "
+                    "label (sketches carry the distribution)",
+                )
+
+        # Sub-rule 3: naming convention.
+        msg = _check_name(kind, name)
+        if msg is not None:
+            yield module.finding(RULE, call, msg)
+
+    # Sub-rule 4: inventory.
+    required = REQUIRED_METRICS.get(module.relpath, ())
+    if required:
+        present = {name for _call, _kind, name in sites}
+        for name in required:
+            if name not in present:
+                yield module.finding(
+                    RULE,
+                    module.tree,
+                    f"required metric {name!r} is missing — the fleet "
+                    "telemetry plane lost this hot-path signal "
+                    "(REQUIRED_METRICS in "
+                    "tools/dflint/checkers/df017_metrics.py)",
+                )

@@ -63,9 +63,16 @@ PHASE_RULES: Tuple[Tuple[str, str], ...] = (
     ("rpc/register_peer", "schedule"),
     ("rpc/report_piece_failed", "schedule"),
     ("rpc/report_piece_finished", "commit"),
+    ("rpc/report_pieces_finished", "commit"),
     ("rpc/report_peer_finished", "commit"),
     ("rpc/", "rpc"),
     ("daemon/source.piece", "source"),
+    # The PR-11 data-plane spans, split so the per-download table reads
+    # piece-fetch vs commit vs report-flush instead of one blob:
+    # ``daemon/piece`` is the fetch wall (wire + hedge), the scheduler's
+    # report_piece(s)_finished handlers are the commit acknowledgment,
+    # and ``daemon/report.flush`` is the batched-report RPC window.
+    ("daemon/report.flush", "report_flush"),
     ("daemon/piece", "piece"),
     ("daemon/pex-worker", "piece"),
     ("daemon/download", "download"),
