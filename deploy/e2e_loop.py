@@ -192,16 +192,22 @@ def main() -> int:
          {"scheduler_cluster_config": {
              "candidate_parent_limit": 1, "filter_parent_limit": 15}})
     # Multi-replica: blob-1's swarm state lives on its consistent-hash
-    # owner — register the probe peer THERE (any other replica would
-    # see a brand-new task with no parents).
+    # owner — register the probe peer THERE (any other replica answers
+    # the wrong-shard steering redirect).  Ownership is the MANAGER's
+    # published shard ring (DESIGN.md §24), the same map the shards'
+    # guards enforce — never a locally invented hash.
     scheduler_for_blob1 = SCHEDULER
     if os.environ.get("SCHEDULER_B_URL"):
-        from dragonfly2_tpu.rpc.balancer import HashRing
+        from dragonfly2_tpu.scheduler.sharding import ShardRing
         from dragonfly2_tpu.utils import idgen
 
-        scheduler_for_blob1 = HashRing(
-            [SCHEDULER, os.environ["SCHEDULER_B_URL"]]
-        ).pick(idgen.task_id(url))
+        published = ShardRing.from_payload(
+            call(MANAGER, "GET", "/api/v1/clusters/default:config")
+            ["scheduler_ring"]
+        )
+        scheduler_for_blob1 = published.url_of(
+            published.owner(idgen.task_id(url))
+        )
     client = RemoteScheduler(scheduler_for_blob1)
     probe_host = Host(id="e2e-probe", hostname="e2e-probe", ip="127.0.0.1",
                       download_port=1)
@@ -220,19 +226,25 @@ def main() -> int:
     # -- 6. multi-replica: steering + cross-replica topology ----------------
     scheduler_b = os.environ.get("SCHEDULER_B_URL", "")
     if scheduler_b:
-        from dragonfly2_tpu.rpc.balancer import HashRing
+        from dragonfly2_tpu.scheduler.sharding import ShardRing
         from dragonfly2_tpu.utils import idgen
 
-        ring = HashRing([SCHEDULER, scheduler_b])
-        # Find a blob whose task hashes to EACH replica, download both
-        # through daemon A, and verify the swarm state lives exactly on
-        # the ring-predicted owner (a child registration there sees
-        # daemon A as a parent).
+        ring_payload = call(
+            MANAGER, "GET", "/api/v1/clusters/default:config"
+        )["scheduler_ring"]
+        assert len(ring_payload["members"]) == 2, ring_payload
+        shard_ring = ShardRing.from_payload(ring_payload)
+        # Find a blob whose task the PUBLISHED ring places on EACH
+        # replica, download both through daemon A, and verify the swarm
+        # state lives exactly on the ring-predicted owner (a child
+        # registration there sees daemon A as a parent).
         owners = {}
         i = 0
         while len(set(owners.values())) < 2 and i < 64:
             name = f"steer-{i}"
-            owners[name] = ring.pick(idgen.task_id(f"{ORIGIN_URL}/{name}"))
+            owners[name] = shard_ring.url_of(
+                shard_ring.owner(idgen.task_id(f"{ORIGIN_URL}/{name}"))
+            )
             i += 1
         assert len(set(owners.values())) == 2, "hash ring never split"
         picks = {}

@@ -264,6 +264,21 @@ def run(argv=None) -> int:
     import socket as _socket
 
     scheduler_id = f"sched-{_socket.gethostname()}-{rpc_server.address[1]}"
+    # Sharded-fleet guard (DESIGN.md §24): ownership steering + admission
+    # control on the task-scoped entry points.  The ring arrives through
+    # dynconfig (below) once a manager publishes it; until then the
+    # guard is pass-through (single-shard behavior).
+    from ..scheduler.sharding import AdmissionController, ShardGuard
+
+    shard_admission = None
+    if cfg.scheduling.shard_max_inflight > 0:
+        shard_admission = AdmissionController(
+            max_inflight=cfg.scheduling.shard_max_inflight,
+            p99_budget_s=cfg.scheduling.shard_p99_budget_ms / 1e3,
+        )
+    shard_guard = ShardGuard(scheduler_id, admission=shard_admission)
+    shard_guard.resource = service.resource
+    service.shard_guard = shard_guard
     job_worker = None
     cluster_link = None
     dynconfig = None
@@ -294,10 +309,13 @@ def run(argv=None) -> int:
         # manager restart.  A failed first registration only warns — the
         # loop keeps retrying while the worker polls.
         cluster_link = RemoteClusterClient(manager_endpoints, token=token)
+        # Register the BOUND port (port: 0 configs bind an ephemeral
+        # one): the manager publishes this address in the shard ring —
+        # an unroutable member would black-hole every task it owns.
         cluster_link.register_scheduler(
             id=scheduler_id, cluster_id=cfg.cluster_id,
             hostname=_socket.gethostname(), ip=cfg.server.host,
-            port=cfg.server.port,
+            port=rpc_server.address[1],
         )
         job_worker = RemoteJobWorker(
             manager_endpoints, f"scheduler:{scheduler_id}", token=token
@@ -398,6 +416,11 @@ def run(argv=None) -> int:
             cache_path=_os.path.join(cfg.storage.dir, "dynconfig_cache.json"),
         )
         dynconfig.register(_apply_cluster_config)
+        # Ring adoption: the manager publishes the shard ring with the
+        # cluster config; a version bump triggers the guard's handoff
+        # sweep (tasks this shard no longer owns steer to their new
+        # owner on the peers' next call).
+        dynconfig.register(shard_guard.on_config)
         dynconfig.serve()
 
         # Cross-replica topology sharing through the manager (the Redis
@@ -502,7 +525,7 @@ def run(argv=None) -> int:
             cluster_manager=cluster_link,
             cluster_id=cfg.cluster_id,
             ip=cfg.server.host,
-            port=cfg.server.port,
+            port=rpc_server.address[1],
             hostname=_socket.gethostname(),
             train_interval=cfg.trainer.interval_s,
         )

@@ -151,6 +151,12 @@ class SchedulingSection:
     model_poll_jitter: float = 0.1
     shadow_sample_rate: float = 0.1
     rollout_report_interval_s: float = 60.0
+    # Sharded fleet (DESIGN.md §24): admission control bounds for this
+    # shard — concurrent task-scoped requests past max_inflight (and
+    # announce p99 past the budget) start shedding the lowest priority
+    # classes with 503+Retry-After.  0 max_inflight disables admission.
+    shard_max_inflight: int = 512
+    shard_p99_budget_ms: float = 50.0
 
     def validate(self) -> None:
         if self.algorithm not in ("default", "nt", "ml"):
@@ -165,6 +171,10 @@ class SchedulingSection:
             raise ConfigError("eval_feature_cache_hosts < 1")
         if not (0.0 <= self.shadow_sample_rate <= 1.0):
             raise ConfigError("shadow_sample_rate must be in [0, 1]")
+        if self.shard_max_inflight < 0:
+            raise ConfigError("shard_max_inflight < 0")
+        if self.shard_p99_budget_ms <= 0:
+            raise ConfigError("shard_p99_budget_ms <= 0")
         if not (0.0 <= self.model_poll_jitter < 0.5):
             raise ConfigError("model_poll_jitter must be in [0, 0.5)")
 

@@ -179,6 +179,18 @@ class ManagerRESTServer:
         )
         if self._topology_table is not None:
             self.topology_shared = self._topology_table.load_all()
+        # Sharded-fleet membership directory (scheduler/sharding.py,
+        # DESIGN.md §24): the ACTIVE scheduler set per cluster, versioned
+        # and persisted (on the replicated backend it survives a leader
+        # bounce), published with the cluster dynconfig so every client
+        # converges on the same ring.  Without a state seam the ring is
+        # still published, from an in-memory backend (versions restart).
+        from ..manager.state import MemoryBackend
+        from ..scheduler.sharding import ShardDirectory
+
+        self.shards = ShardDirectory(
+            state_backend if state_backend is not None else MemoryBackend()
+        )
         # Job broker (machinery-over-Redis analog, jobs/remote.py): the
         # manager hosts the queues; remote scheduler workers poll them
         # over this REST surface.
@@ -588,7 +600,19 @@ class ManagerRESTServer:
                     # scheduling limits (scheduling.go:404-410).
                     cid = path[len("/api/v1/clusters/"):-len(":config")]
                     try:
-                        self._json(200, server.crud.cluster_config(cid))
+                        payload = server.crud.cluster_config(cid)
+                        # The shard ring rides the cluster dynconfig
+                        # (DESIGN.md §24): membership is the ACTIVE
+                        # scheduler set; a set change bumps the durable
+                        # ring version and every poller re-routes.
+                        payload["scheduler_ring"] = server.shards.publish(
+                            cid,
+                            [
+                                (s.id, f"http://{s.ip}:{s.port}")
+                                for s in server.clusters.active_schedulers(cid)
+                            ],
+                        )
+                        self._json(200, payload)
                     except KeyError as exc:
                         self._json(404, {"error": str(exc)})
                 elif path == "/api/v1/clusters:search":

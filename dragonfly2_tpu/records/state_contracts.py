@@ -316,6 +316,21 @@ STATE_CONTRACTS = {
                 "unlocked_ok": [],
                 "invariant": "rows_load",
             },
+            # Sharded-fleet membership (scheduler/sharding.py, DESIGN.md
+            # §24): one row per cluster — {version, members} — written on
+            # membership change under the directory lock; on the
+            # replicated backend the ring version survives a leader
+            # bounce, so a promoted standby publishes ring continuity
+            # instead of re-handing-off the whole fleet.
+            "shard_membership": {
+                "owner": "dragonfly2_tpu/scheduler/sharding.py",
+                "lock": ["dragonfly2_tpu/scheduler/sharding.py",
+                         "ShardDirectory", "_mu"],
+                "loader": "ShardDirectory.__init__",
+                "multi_row": [],
+                "unlocked_ok": [],
+                "invariant": "rows_load",
+            },
         },
         # Dynamic-namespace write paths: functions that legitimately
         # write ANY declared namespace through a variable ``.table(ns)``

@@ -19,8 +19,9 @@ everyone.
 from __future__ import annotations
 
 import threading
+import time
 import urllib.error
-from typing import Callable, Dict, List, Optional, Sequence, TypeVar, Union
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, TypeVar, Union
 
 from .balancer import HashRing, StickyFailover
 
@@ -63,6 +64,159 @@ class SchedulerResolver:
     def all_urls(self) -> List[str]:
         with self._mu:
             return sorted(self._urls.values())
+
+
+class ShardRouter:
+    """Dynconfig-fed sharded-scheduler router (DESIGN.md §24).
+
+    Holds the manager-published ``scheduler_ring`` (version + members)
+    and routes task-scoped calls to the owning shard with the bounded-
+    load pick.  ``call`` is the steering-aware wrapper the daemon/sim
+    uses:
+
+    - a **wrong-shard** answer (HTTP 421 → ``WrongShardError``) means
+      the ring moved under us: adopt the answer's owner hint and retry
+      there — the server's hint is fresher than our last dynconfig poll;
+    - a **saturated** answer (503 + Retry-After →
+      ``ShardSaturatedError``) honors the server's pacing once, then
+      propagates — the CALLER owns the drop-or-degrade decision;
+    - a **transport failure** demotes the member locally (the ring loses
+      it until a dynconfig refresh re-publishes it) and retries on the
+      task's next owner — the client half of task migration.
+
+    Per-shard in-flight counts feed the bounded-load pick, so a shard
+    answering slowly sheds new placements to its ring neighbors before
+    its admission controller ever 503s.
+    """
+
+    def __init__(
+        self,
+        factory: Optional[Callable[[str], object]] = None,
+        *,
+        load_factor: float = 1.25,
+    ) -> None:
+        from ..scheduler.sharding import ShardRing
+
+        self._mu = threading.Lock()
+        self._ring = ShardRing()
+        self._factory = factory
+        self.load_factor = load_factor
+        self._clients: Dict[str, object] = {}
+        self._inflight: Dict[str, int] = {}
+
+    # -- ring adoption (dynconfig observer) ----------------------------------
+
+    def on_config(self, config: dict) -> None:
+        payload = config.get("scheduler_ring")
+        if not isinstance(payload, dict):
+            return
+        from ..scheduler.sharding import ShardRing
+
+        try:
+            ring = ShardRing.from_payload(payload)
+        except (KeyError, TypeError, ValueError):
+            return
+        with self._mu:
+            if ring.version > self._ring.version and len(ring):
+                self._ring = ring
+
+    def update_ring(self, ring) -> None:
+        with self._mu:
+            self._ring = ring
+
+    @property
+    def version(self) -> int:
+        with self._mu:
+            return self._ring.version
+
+    def members(self) -> Dict[str, str]:
+        with self._mu:
+            return self._ring.members()
+
+    # -- routing -------------------------------------------------------------
+
+    def _load_of(self, sid: str) -> float:
+        return float(self._inflight.get(sid, 0))
+
+    def route(self, task_id: str) -> Tuple[str, str]:
+        """(shard_id, url) owning ``task_id`` under the bounded-load
+        pick; raises ``LookupError`` on an empty ring."""
+        with self._mu:
+            sid = self._ring.pick(
+                task_id, load_of=self._load_of, load_factor=self.load_factor
+            )
+            if sid is None:
+                raise LookupError("shard ring is empty")
+            return sid, self._ring.url_of(sid) or ""
+
+    def client_for(self, url: str):
+        with self._mu:
+            client = self._clients.get(url)
+            if client is None:
+                from .steering import default_scheduler_factory
+
+                factory = self._factory or default_scheduler_factory
+                client = self._clients[url] = factory(url)
+            return client
+
+    def _demote(self, sid: str) -> None:
+        """Drop a member that failed at the transport level; the next
+        dynconfig refresh re-publishes it if the manager still sees it."""
+        with self._mu:
+            self._ring.remove(sid)
+
+    # -- steering-aware call -------------------------------------------------
+
+    def call(self, task_id: str, fn: Callable[[object], T]) -> T:
+        """Run ``fn(client)`` against the owning shard, following wrong-
+        shard steering answers and transport-failure re-routes; honors
+        one saturation Retry-After before propagating it."""
+        from ..utils import faultinject
+        from ..scheduler.sharding import ShardSaturatedError, WrongShardError
+
+        waited = False
+        last: Optional[BaseException] = None
+        # One attempt per member + one slot for a steering hop: the walk
+        # terminates even when every shard answers with an error.
+        for _ in range(max(2, len(self.members()) + 1)):
+            sid, url = self.route(task_id)
+            # Chaos seam: route-time drop/delay exercises the same
+            # failover path a dying shard does.
+            faultinject.fire("shard.route")
+            client = self.client_for(url)
+            with self._mu:
+                self._inflight[sid] = self._inflight.get(sid, 0) + 1
+            try:
+                return fn(client)
+            except WrongShardError as exc:
+                last = exc
+                if exc.owner_url:
+                    # Server-side hint: route THIS task at the hinted
+                    # owner without waiting for the next dynconfig poll.
+                    with self._mu:
+                        self._ring.add(exc.owner_id or exc.owner_url,
+                                       exc.owner_url)
+                    try:
+                        return fn(self.client_for(exc.owner_url))
+                    except Exception as exc2:  # noqa: BLE001 — fall through
+                        last = exc2
+                        break
+            except ShardSaturatedError as exc:
+                last = exc
+                if waited:
+                    raise
+                waited = True
+                time.sleep(min(exc.retry_after_s, 2.0))
+            except (ConnectionError, TimeoutError, OSError) as exc:
+                last = exc
+                self._demote(sid)
+            finally:
+                with self._mu:
+                    self._inflight[sid] = max(
+                        0, self._inflight.get(sid, 1) - 1
+                    )
+        assert last is not None
+        raise last
 
 
 class ManagerEndpoints:

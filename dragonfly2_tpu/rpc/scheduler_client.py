@@ -53,6 +53,8 @@ class RemoteScheduler:
         # announce_host; assume own version until told otherwise).
         self.negotiated_version = self.protocol_version
         self.server_capabilities: tuple = ()
+        # Last ring payload the server re-published on announce (§24).
+        self.scheduler_ring: Optional[dict] = None
         self._mu = threading.Lock()
         self._tasks: Dict[str, Task] = {}
         self._hosts: Dict[str, Host] = {}
@@ -93,12 +95,32 @@ class RemoteScheduler:
             except urllib.error.HTTPError as exc:
                 payload = exc.read()
                 code = 0
+                parsed: dict = {}
                 try:
                     parsed = json.loads(payload)
                     message = parsed.get("error", "")
                     code = int(parsed.get("code", 0))
                 except json.JSONDecodeError:
                     message = payload[:200].decode(errors="replace")
+                # Sharded-fleet steering answers (DESIGN.md §24) surface
+                # as their typed exceptions so the ShardRouter can act on
+                # them; neither is retryable against THIS endpoint.
+                if exc.code == 421 and message == "wrong_shard":
+                    from ..scheduler.sharding import WrongShardError
+
+                    raise WrongShardError(
+                        str(parsed.get("task_id", "")),
+                        owner_id=str(parsed.get("owner_id", "")),
+                        owner_url=str(parsed.get("owner_url", "")),
+                        ring_version=int(parsed.get("ring_version", 0)),
+                    ) from exc
+                if exc.code == 503 and message == "shard_saturated":
+                    from ..scheduler.sharding import ShardSaturatedError
+
+                    raise ShardSaturatedError(
+                        retry_after_s=float(parsed.get("retry_after_s", 1.0)),
+                        reason=str(parsed.get("reason", "")),
+                    ) from exc
                 raise RPCError(
                     f"{method}: HTTP {exc.code}: {message}", code=code
                 ) from exc
@@ -171,6 +193,10 @@ class RemoteScheduler:
             # capabilities — they described a different server.
             self.negotiated_version = 1
             self.server_capabilities = ()
+        # Ring re-publication (DESIGN.md §24): the server's adopted
+        # shard ring rides the announce answer; steering compositions
+        # read it off the client after each announce fan-out.
+        self.scheduler_ring = resp.get("scheduler_ring")
         with self._mu:
             self._hosts[host.id] = host
             self._announced.add(host.id)

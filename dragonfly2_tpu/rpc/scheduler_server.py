@@ -36,6 +36,7 @@ from .version import (
 from ..scheduler.resource import Host, Peer
 from ..scheduler.scheduling import ScheduleResultKind
 from ..scheduler.service import SchedulerService
+from ..scheduler.sharding import ShardSaturatedError, WrongShardError
 from ..utils.dferrors import Code
 from ..utils.types import HostType
 
@@ -128,7 +129,17 @@ class SchedulerRPCAdapter:
         # write-on-arrival, DESIGN.md §18) — the adapter only negotiates.
         stored = self.service.announce_host(host)
         stored.protocol_version = negotiated
-        return {"protocol": protocol_info(negotiated, self.capabilities)}
+        out = {"protocol": protocol_info(negotiated, self.capabilities)}
+        # Ring re-publication (DESIGN.md §24): the announce answer
+        # carries the shard ring this scheduler adopted from dynconfig,
+        # so every announcing peer converges on the SAME versioned
+        # ownership map without its own manager dependency.
+        guard = self.service.shard_guard
+        if guard is not None:
+            ring = guard.ring()
+            if ring is not None and len(ring):
+                out["scheduler_ring"] = ring.to_payload()
+        return out
 
     def register_peer(self, req: dict) -> dict:
         host = self.service.resource.host_manager.load(req["host_id"])
@@ -363,6 +374,38 @@ class SchedulerHTTPServer:
                         {"error": str(exc), "code": int(exc.code)}
                     ).encode()
                     self.send_response(400)
+                except WrongShardError as exc:
+                    # REDIRECT-style steering answer (DESIGN.md §24): 421
+                    # Misdirected Request with the owning shard's address
+                    # — the router re-announces there, it never retries
+                    # here.
+                    body = json.dumps(
+                        {
+                            "error": "wrong_shard",
+                            "code": int(Code.FAILED_PRECONDITION),
+                            "task_id": exc.task_id,
+                            "owner_id": exc.owner_id,
+                            "owner_url": exc.owner_url,
+                            "ring_version": exc.ring_version,
+                        }
+                    ).encode()
+                    self.send_response(421)
+                except ShardSaturatedError as exc:
+                    # Load shed: 503 + Retry-After (the §20 standby
+                    # discipline) so a backlogged fleet backs off instead
+                    # of dogpiling a melting shard.
+                    body = json.dumps(
+                        {
+                            "error": "shard_saturated",
+                            "code": int(Code.RESOURCE_EXHAUSTED),
+                            "retry_after_s": exc.retry_after_s,
+                            "reason": exc.reason,
+                        }
+                    ).encode()
+                    self.send_response(503)
+                    self.send_header(
+                        "Retry-After", f"{exc.retry_after_s:.3f}"
+                    )
                 except Exception as exc:  # noqa: BLE001 — wire boundary
                     body = json.dumps(
                         {"error": str(exc), "code": int(Code.UNKNOWN)}

@@ -16,13 +16,25 @@ drop-in for the Conductor's single-scheduler client surface:
   exactly the cross-replica property the deployment e2e asserts;
 - ``resolve_host`` asks the task-agnostic replicas in ring order until
   one knows the host (parents may have announced anywhere).
+
+Sharded-fleet awareness (DESIGN.md §24): schedulers re-publish the
+manager's versioned shard ring on every announce answer.  The steering
+client adopts the newest payload after each announce fan-out and, once
+it has one, routes task-scoped calls by the PUBLISHED ring (scheduler
+ids, sha placement — the same map the shards' guards enforce) instead
+of the bootstrap url-hash ring; members it has no client for yet are
+dialed through the factory on first use.  A ``WrongShardError``
+steering answer (stale ring mid-membership-change) is followed to the
+hinted owner once.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..scheduler.sharding import ShardRing, WrongShardError
 from .balancer import HashRing
 
 logger = logging.getLogger(__name__)
@@ -49,13 +61,61 @@ class SteeringSchedulerClient:
         if not urls:
             raise ValueError("SteeringSchedulerClient needs >= 1 scheduler url")
         factory = factory or default_scheduler_factory
+        self._factory = factory
+        self._mu = threading.Lock()
         self._clients: Dict[str, object] = {u: factory(u) for u in urls}
         self._ring = HashRing(list(urls))
+        # Published shard ring (ids → urls), adopted from announce
+        # answers; None until a sharded scheduler answers one.
+        self._shard_ring: Optional[ShardRing] = None
 
     # -- routing -------------------------------------------------------------
 
+    def _client_for(self, url: str):
+        with self._mu:
+            client = self._clients.get(url)
+            if client is None:
+                client = self._clients[url] = self._factory(url)
+            return client
+
+    def _adopt_ring(self, payload) -> None:
+        if not isinstance(payload, dict) or not payload.get("members"):
+            return
+        try:
+            ring = ShardRing.from_payload(payload)
+        except (KeyError, TypeError, ValueError):
+            return
+        with self._mu:
+            current = self._shard_ring
+            if len(ring) and (current is None or ring.version > current.version):
+                self._shard_ring = ring
+
+    def ring_version(self) -> int:
+        with self._mu:
+            return self._shard_ring.version if self._shard_ring else 0
+
     def _owner(self, key: str):
+        with self._mu:
+            ring = self._shard_ring
+        if ring is not None:
+            url = ring.url_of(ring.owner(key))
+            if url:
+                return self._client_for(url)
         return self._clients[self._ring.pick(key)]
+
+    def _task_call(self, task_id: str, fn):
+        """Task-scoped call with steering: a wrong-shard answer (our
+        ring lost a membership race) is followed to the hinted owner."""
+        try:
+            return fn(self._owner(task_id))
+        except WrongShardError as exc:
+            if not exc.owner_url:
+                raise
+            logger.debug(
+                "task %s steered to %s (ring v%d)",
+                task_id, exc.owner_id, exc.ring_version,
+            )
+            return fn(self._client_for(exc.owner_url))
 
     def for_task(self, task_id: str):
         """The replica owning this task (exposed for tests/debugging)."""
@@ -72,17 +132,24 @@ class SteeringSchedulerClient:
         # daemon).  Raise only when EVERY replica failed.
         last_exc: Optional[Exception] = None
         ok = 0
-        for c in self._clients.values():
+        with self._mu:
+            clients = list(self._clients.values())
+        for c in clients:
             try:
                 c.announce_host(host)
                 ok += 1
+                # Adopt the newest re-published shard ring (§24): the
+                # announce fan-out doubles as the peer's ring poll.
+                self._adopt_ring(getattr(c, "scheduler_ring", None))
             except Exception as exc:  # noqa: BLE001 — replica outage
                 last_exc = exc
         if ok == 0 and last_exc is not None:
             raise last_exc
 
     def leave_host(self, host) -> None:
-        for c in self._clients.values():
+        with self._mu:
+            clients = list(self._clients.values())
+        for c in clients:
             leave = getattr(c, "leave_host", None)
             if leave is None:
                 continue
@@ -99,7 +166,9 @@ class SteeringSchedulerClient:
 
     def resolve_host(self, host_id: str):
         last_exc: Optional[Exception] = None
-        for c in self._clients.values():
+        with self._mu:
+            clients = list(self._clients.values())
+        for c in clients:
             try:
                 return c.resolve_host(host_id)
             except Exception as exc:  # noqa: BLE001 — try the next replica
@@ -113,39 +182,56 @@ class SteeringSchedulerClient:
             from ..utils import idgen
 
             task_id = idgen.task_id(url)
-        return self._owner(task_id).register_peer(
-            host=host, url=url, task_id=task_id, **kw
+        return self._task_call(
+            task_id,
+            lambda c: c.register_peer(host=host, url=url, task_id=task_id, **kw),
         )
 
     def _peer_owner(self, peer):
         return self._owner(peer.task.id)
 
     def set_task_info(self, peer, *a, **kw):
-        return self._peer_owner(peer).set_task_info(peer, *a, **kw)
+        return self._task_call(
+            peer.task.id, lambda c: c.set_task_info(peer, *a, **kw)
+        )
 
     def report_piece_finished(self, peer, *a, **kw):
-        return self._peer_owner(peer).report_piece_finished(peer, *a, **kw)
+        return self._task_call(
+            peer.task.id, lambda c: c.report_piece_finished(peer, *a, **kw)
+        )
 
     def report_pieces_finished(self, peer, *a, **kw):
-        return self._peer_owner(peer).report_pieces_finished(peer, *a, **kw)
+        return self._task_call(
+            peer.task.id, lambda c: c.report_pieces_finished(peer, *a, **kw)
+        )
 
     def report_piece_failed(self, peer, *a, **kw):
-        return self._peer_owner(peer).report_piece_failed(peer, *a, **kw)
+        return self._task_call(
+            peer.task.id, lambda c: c.report_piece_failed(peer, *a, **kw)
+        )
 
     def report_peer_finished(self, peer):
-        return self._peer_owner(peer).report_peer_finished(peer)
+        return self._task_call(
+            peer.task.id, lambda c: c.report_peer_finished(peer)
+        )
 
     def report_peer_failed(self, peer):
-        return self._peer_owner(peer).report_peer_failed(peer)
+        return self._task_call(
+            peer.task.id, lambda c: c.report_peer_failed(peer)
+        )
 
     def set_task_direct_piece(self, peer, data):
-        return self._peer_owner(peer).set_task_direct_piece(peer, data)
+        return self._task_call(
+            peer.task.id, lambda c: c.set_task_direct_piece(peer, data)
+        )
 
     def mark_back_to_source(self, peer):
-        return self._peer_owner(peer).mark_back_to_source(peer)
+        return self._task_call(
+            peer.task.id, lambda c: c.mark_back_to_source(peer)
+        )
 
     def leave_peer(self, peer):
-        return self._peer_owner(peer).leave_peer(peer)
+        return self._task_call(peer.task.id, lambda c: c.leave_peer(peer))
 
     def take_pushed_schedule(self, peer):
         """Server-push adoption: only streaming transports have it; a
@@ -154,7 +240,9 @@ class SteeringSchedulerClient:
         return take(peer) if take is not None else None
 
     def close(self) -> None:
-        for c in self._clients.values():
+        with self._mu:
+            clients = list(self._clients.values())
+        for c in clients:
             close = getattr(c, "close", None)
             if close is not None:
                 close()

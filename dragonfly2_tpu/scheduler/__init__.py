@@ -27,3 +27,11 @@ from .model_loader import ModelSubscriber  # noqa: F401
 from .networktopology import NetworkTopology, Probe, ProbeAgent, TopologyConfig  # noqa: F401
 from .scheduling import ScheduleResult, ScheduleResultKind, Scheduling, SchedulingConfig  # noqa: F401
 from .service import RegisterResult, SchedulerService  # noqa: F401
+from .sharding import (  # noqa: F401
+    AdmissionController,
+    ShardDirectory,
+    ShardGuard,
+    ShardRing,
+    ShardSaturatedError,
+    WrongShardError,
+)

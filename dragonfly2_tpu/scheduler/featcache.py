@@ -437,14 +437,38 @@ class HostFeatureCache:
             self._fill_slot_locked(h, slot, None)
             self._updated_at_col[slot] = now
 
-    def adopt(self, h: "Host") -> None:
+    def adopt(self, h: "Host") -> bool:
         """Announce decode writes columns on arrival: bind an unbound
         host (no-op when already bound here; a host owned elsewhere keeps
-        its owner — this store will serve it via stamped copies)."""
+        its owner — this store will serve it via stamped copies).
+
+        Returns True when THIS call bound the host — the bind just
+        computed the full row from the current stats, so the announce
+        path stamps ``updated_at`` instead of paying a second identical
+        row fill (the double-fill showed up as ~1.75 fills/announce in
+        the fleet-swarm profile)."""
         with self._mu:
             if h._cols is not None:
-                return
+                return False
+            before = self.misses
             self._slot_locked(h)
+            # _slot_locked counts a miss exactly when it (re)computed the
+            # row on the bind/foreign path; a hit means another store's
+            # binding already serves it and the caller must still touch.
+            return self.misses > before and h._cols is not None and h._cols[0] is self
+
+    def stamp_row(self, h: "Host") -> None:
+        """Freshness stamp for a row filled moments ago (the adopt→touch
+        announce sequence): updates ``updated_at`` without recomputing
+        feature cells.  Falls back to the shadow write on a raced
+        detach, exactly like ``refresh_row``."""
+        now = time.time()
+        with self._mu:
+            b = h._cols
+            if b is None or b[0] is not self:
+                h._updated_at = now
+                return
+            self._updated_at_col[b[1]] = now
 
     # -- slot resolution -----------------------------------------------------
 

@@ -71,6 +71,25 @@ EVAL_FLUSH_SECONDS = _reg.sketch(
     "(ScorerBatcher, DESIGN.md §14)",
 )
 
+# -- sharded fleet (DESIGN.md §24: ring routing, handoff, shedding) ----------
+SHARD_RING_VERSION = _reg.gauge(
+    "scheduler_shard_ring_version",
+    "Consistent-hash ring version this process has adopted", ["cluster"],
+)
+SHARD_REDIRECTS_TOTAL = _reg.counter(
+    "scheduler_shard_redirects_total",
+    "Task-scoped calls answered with a wrong-shard steering redirect",
+)
+SHARD_HANDOFFS_TOTAL = _reg.counter(
+    "scheduler_shard_handoffs_total",
+    "Tasks marked for cross-shard migration by membership-change sweeps",
+)
+SHARD_SHED_TOTAL = _reg.counter(
+    "scheduler_shard_shed_total",
+    "Requests refused by admission control, by priority class",
+    ["priority"],
+)
+
 # -- rollout plane (DESIGN.md §15: shadow scoring + canary serving) ----------
 SHADOW_ANNOUNCES_TOTAL = _reg.counter(
     "scheduler_shadow_announces_total",

@@ -372,6 +372,20 @@ class Host:
         else:
             b[0].refresh_row(self)
 
+    def touch_stamp(self) -> None:
+        """Freshness-only touch for the adopt→announce sequence: the
+        bind that just ran computed the row from these very stats, so
+        only ``updated_at`` needs writing (the full ``touch`` here was
+        a second identical row fill per cold announce).  The mutation
+        counter still advances — foreign stamped copies must revalidate
+        against the new stamp."""
+        self._mut += 1
+        b = self._cols
+        if b is None:
+            self._updated_at = time.time()
+        else:
+            b[0].stamp_row(self)
+
     def to_record(self) -> schema.HostRecord:
         return schema.HostRecord(
             id=self.id,

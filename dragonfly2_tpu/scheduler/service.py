@@ -75,11 +75,18 @@ class SchedulerService:
         *,
         seed_peer_trigger=None,
         hub=None,
+        shard_guard=None,
     ) -> None:
         self.resource = resource
         self.scheduling = scheduling
         self.storage = storage
         self.networktopology = networktopology
+        # Optional sharding.ShardGuard: ownership + admission checks at
+        # the task-scoped entry points (DESIGN.md §24).  The guard needs
+        # the resource to sweep live tasks on a membership change.
+        self.shard_guard = shard_guard
+        if shard_guard is not None:
+            shard_guard.resource = resource
         # Optional callable(url, task_id) -> bool: asks a seed peer to warm
         # the task (resource/seed_peer.go:93-229 TriggerDownloadTask; wired
         # to a seed daemon's conductor in-process, an RPC in deployments).
@@ -91,6 +98,7 @@ class SchedulerService:
         self.hub = hub
         self._mu = threading.Lock()
         self._seed_triggered: set = set()  # task ids already warmed
+        self._gauges_refreshed_at = float("-inf")
         # Columnar host store (DESIGN.md §18): when the evaluator carries
         # one, announce decode binds hosts on arrival so their serving
         # state lives in slot columns from birth and the evaluate path
@@ -111,14 +119,26 @@ class SchedulerService:
         application: str = "",
         blocklist: Optional[Set[str]] = None,
     ) -> RegisterResult:
+        if self.shard_guard is not None:
+            # Ownership before any state is created: a mis-routed
+            # register must steer to the owner, not seed a split-brain
+            # swarm here.  Admission next — lowest priority sheds first.
+            self.shard_guard.check_task(task_id or idgen.task_id(url))
+            self.shard_guard.admit(priority)
         host = self.resource.store_host(host)
+        freshly_bound = False
         if self._host_store is not None:
             # Columnar from birth: registration is an announce — the
             # host's serving state moves into the slot columns NOW, so
             # the evaluate path finds a bound host (pure gather, no
             # object→matrix marshalling).
-            self._host_store.adopt(host)
-        host.touch()
+            freshly_bound = self._host_store.adopt(host)
+        # A fresh bind just filled the row from these stats; stamp
+        # freshness instead of paying a second identical fill.
+        if freshly_bound:
+            host.touch_stamp()
+        else:
+            host.touch()
         tid = task_id or idgen.task_id(url)
         task = self.resource.store_task(Task(tid, url, tag=tag, application=application))
         task.touch()
@@ -209,6 +229,11 @@ class SchedulerService:
         path.  Both wire adapters and the in-process
         ``daemon.host_announcer`` land here."""
         t0 = time.monotonic()
+        if self.shard_guard is not None:
+            # Host-scoped: every shard accepts announces (each keeps its
+            # own host inventory) — only the shed gate applies, and the
+            # handling latency feeds the shard's windowed burn signal.
+            self.shard_guard.admit(Priority.LEVEL0)
         stored = self.resource.store_host(host)
         if stored is not host:
             # Refresh announce-time stats AND addresses on the existing
@@ -219,19 +244,39 @@ class SchedulerService:
             stored.ip = host.ip
             stored.port = host.port
             stored.download_port = host.download_port
+        freshly_bound = False
         if self._host_store is not None:
-            self._host_store.adopt(stored)
+            freshly_bound = self._host_store.adopt(stored)
         # touch() on a bound host recomputes the whole slot row in place
         # (the stats just changed) — the announce pays the marshalling
-        # once so every subsequent serve is a pure fancy-index.
-        stored.touch()
+        # once so every subsequent serve is a pure fancy-index.  When
+        # the adopt above BOUND the host, the bind already computed the
+        # row from these stats: only the freshness stamp remains (the
+        # double fill cost cold announces ~2× at fleet scale).
+        if freshly_bound:
+            stored.touch_stamp()
+        else:
+            stored.touch()
         # Announce-handling latency into the mergeable sketch (DESIGN.md
         # §23) — the fleet-scale scheduler's announces/sec signal rides
         # the crash-safe journal, not the per-process scrape.
         metrics.ANNOUNCE_SECONDS.observe(time.monotonic() - t0)
+        if self.shard_guard is not None and self.shard_guard.admission is not None:
+            self.shard_guard.admission.observe(time.monotonic() - t0)
         return stored
 
-    def _refresh_gauges(self) -> None:
+    # Lifecycle gauges refresh at most this often: every register/leave
+    # used to take all three resource-manager locks just to re-publish
+    # sizes — pure overhead at 100k-peer announce rates.
+    _GAUGE_REFRESH_S = 0.5
+
+    def _refresh_gauges(self) -> None:  # dflint: hotpath
+        now = time.monotonic()
+        if now - self._gauges_refreshed_at < self._GAUGE_REFRESH_S:
+            return
+        # Benign race: two concurrent refreshes both publish CURRENT
+        # sizes; the stamp write is a plain store either way.
+        self._gauges_refreshed_at = now
         metrics.HOSTS_GAUGE.set(len(self.resource.host_manager))
         metrics.PEERS_GAUGE.set(len(self.resource.peer_manager))
         metrics.TASKS_GAUGE.set(len(self.resource.task_manager))
@@ -287,6 +332,10 @@ class SchedulerService:
         cost_ns: int = 0,
     ) -> None:
         """DownloadPieceFinishedRequest (service_v2.go:1157)."""
+        if self.shard_guard is not None:
+            # A handed-off task's in-flight reports steer to the new
+            # owner instead of mutating a swarm this shard gave away.
+            self.shard_guard.check_task(peer.task.id)
         metrics.PIECE_RESULT_TOTAL.inc(result="finished")
         is_new = peer.finish_piece(
             piece_number, cost_ns, parent_id=parent_id, length=length
@@ -342,6 +391,8 @@ class SchedulerService:
 
     def report_peer_finished(self, peer: Peer) -> None:
         """handlePeerSuccess (:1284) + createDownloadRecord (:1418-1629)."""
+        if self.shard_guard is not None:
+            self.shard_guard.check_task(peer.task.id)
         metrics.PEER_RESULT_TOTAL.inc(result="succeeded")
         _try_event(peer.fsm, "DownloadSucceeded")
         peer.cost_ns = int((time.time() - peer.created_at) * 1e9)

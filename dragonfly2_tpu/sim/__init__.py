@@ -10,6 +10,12 @@ the reference never closed, deterministically and without sockets.
 """
 
 from .swarm import SwarmSimulator, SwarmConfig  # noqa: F401
+from .fleet import (  # noqa: F401
+    ColumnarPopulation,
+    FleetConfig,
+    FleetSwarmDriver,
+    ShardedFleet,
+)
 from .chaos import (  # noqa: F401
     ChaosProcess,
     ChaosScenario,

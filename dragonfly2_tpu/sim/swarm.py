@@ -215,15 +215,21 @@ class SwarmSimulator:
     # -- probe simulation (§3.3) ---------------------------------------------
 
     def run_probe_rounds(self, rounds: int = 3) -> None:
-        for _ in range(rounds):
-            for i, host in enumerate(self.hosts):
-                agent = ProbeAgent(
+        # Agents built once: reconstructing num_hosts ProbeAgents (and
+        # their ping closures) per round was pure allocation churn.
+        if not hasattr(self, "_probe_agents"):
+            self._probe_agents = [
+                ProbeAgent(
                     host,
                     self.topology,
                     ping=lambda target, i=i: int(
                         self.cluster.rtt_ns(i, self._host_index[target.id])
                     ),
                 )
+                for i, host in enumerate(self.hosts)
+            ]
+        for _ in range(rounds):
+            for agent in self._probe_agents:
                 agent.sync_probes()
 
     def snapshot_topology(self) -> int:
@@ -261,16 +267,27 @@ class SwarmSimulator:
             if p.fsm.can("DownloadSucceeded"):
                 p.fsm.event("DownloadSucceeded")
             candidates.append(p)
+        # Host-index → candidate position, computed ONCE: the per-trial
+        # linear scans (`next(c for c in candidates ...)` + a filtered
+        # rebuild of the pool) made every trial O(n_hosts).
+        cand_host_idx = np.fromiter(
+            (self._host_index[c.host.id] for c in candidates),
+            dtype=np.int64,
+            count=len(candidates),
+        )
+        peer_by_host_idx = {
+            int(idx): c for idx, c in zip(cand_host_idx, candidates)
+        }
         for _ in range(n_trials):
             child_i = int(r.integers(0, len(self.hosts)))
-            child_peer = next(
-                (c for c in candidates if self._host_index[c.host.id] == child_i), None
+            child_peer = peer_by_host_idx.get(child_i)
+            pool_positions = np.flatnonzero(cand_host_idx != child_i)
+            pool = r.choice(
+                pool_positions,
+                size=min(8, len(pool_positions)),
+                replace=False,
             )
-            pool_peers = [
-                c for c in candidates if self._host_index[c.host.id] != child_i
-            ]
-            pool = list(r.choice(len(pool_peers), size=min(8, len(pool_peers)), replace=False))
-            subset = [pool_peers[int(j)] for j in pool]
+            subset = [candidates[int(j)] for j in pool]
             probe_child = child_peer or reg.peer
             ranked = evaluator.evaluate_parents(subset, probe_child, task.total_piece_count)
             top_idx = self._host_index[ranked[0].host.id]

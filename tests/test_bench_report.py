@@ -18,14 +18,18 @@ if str(REPO) not in sys.path:
 from tools.bench_report import (  # noqa: E402
     DOWNLOAD_BEGIN,
     DOWNLOAD_END,
+    SWARM_BEGIN,
+    SWARM_END,
     TELEMETRY_BEGIN,
     TELEMETRY_END,
     TRAJECTORY_BEGIN,
     TRAJECTORY_END,
     collect_download_rounds,
     collect_rounds,
+    collect_swarm_rounds,
     collect_telemetry_rounds,
     render_download,
+    render_swarm,
     render_telemetry,
     render_trajectory,
     update_file,
@@ -94,6 +98,39 @@ class TestTrajectoryStaleness:
         )
         for data in tel_rounds:
             assert f"| r{data['round']:02d} |" in committed
+
+    def test_committed_swarm_table_is_current(self):
+        """Same staleness gate for the fleet-swarm rounds
+        (tools/bench_swarm.py → BENCH_SW_r*.json)."""
+        sw_rounds = collect_swarm_rounds(REPO)
+        assert sw_rounds, "no BENCH_SW_r*.json rounds found at the repo root"
+        text = (REPO / "BENCHMARKS.md").read_text(encoding="utf-8")
+        begin = text.find(SWARM_BEGIN)
+        end = text.find(SWARM_END)
+        assert begin >= 0 and end > begin, (
+            "BENCHMARKS.md swarm markers missing"
+        )
+        committed = text[begin : end + len(SWARM_END)]
+        fresh = render_swarm(sw_rounds)
+        assert committed == fresh, (
+            "BENCHMARKS.md swarm table is stale — regenerate with "
+            "`python -m tools.bench_report --update`"
+        )
+        for data in sw_rounds:
+            assert f"| r{data['round']:02d} |" in committed
+
+    def test_swarm_round_holds_the_acceptance_evidence(self):
+        """The committed fleet round really drove ≥100k simulated peers
+        through the sharded fleet, ran the membership drill, and lost no
+        downloads to migration."""
+        for data in collect_swarm_rounds(REPO):
+            assert data["ok"] is True, data.get("error")
+            assert data["peers"] >= 100_000
+            assert data["unique_hosts"] >= 90_000
+            drill = data["membership_drill"]
+            assert drill["ran"] is True
+            assert drill["handed_off_tasks"] >= 1
+            assert data["arms"]["sharded"]["downloads_failed"] == 0
 
     def test_telemetry_round_drill_outcomes_recorded(self):
         """The committed drill round really holds the acceptance

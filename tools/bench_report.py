@@ -47,10 +47,13 @@ DOWNLOAD_BEGIN = "<!-- bench:download:begin -->"
 DOWNLOAD_END = "<!-- bench:download:end -->"
 TELEMETRY_BEGIN = "<!-- bench:telemetry:begin -->"
 TELEMETRY_END = "<!-- bench:telemetry:end -->"
+SWARM_BEGIN = "<!-- bench:swarm:begin -->"
+SWARM_END = "<!-- bench:swarm:end -->"
 
 _ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _DL_ROUND_RE = re.compile(r"^BENCH_DL_r(\d+)\.json$")
 _TEL_ROUND_RE = re.compile(r"^TELEMETRY_r(\d+)\.json$")
+_SW_ROUND_RE = re.compile(r"^BENCH_SW_r(\d+)\.json$")
 
 
 def collect_rounds(root: Path) -> List[dict]:
@@ -109,6 +112,73 @@ def collect_telemetry_rounds(root: Path) -> List[dict]:
         out.append(data)
     out.sort(key=lambda d: d["round"])
     return out
+
+
+def collect_swarm_rounds(root: Path) -> List[dict]:
+    """All fleet-swarm rounds (``tools/bench_swarm.py`` →
+    ``BENCH_SW_r*.json``), sorted by round number."""
+    out: List[dict] = []
+    for path in sorted(root.glob("BENCH_SW_r*.json")):
+        m = _SW_ROUND_RE.match(path.name)
+        if m is None:
+            continue
+        try:
+            data = json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, json.JSONDecodeError):
+            data = {"ok": False, "error": "unparseable"}
+        data["round"] = int(m.group(1))
+        data["file"] = path.name
+        out.append(data)
+    out.sort(key=lambda d: d["round"])
+    return out
+
+
+def render_swarm(rounds: List[dict]) -> str:
+    """The generated fleet-swarm block, markers included (one row per
+    BENCH_SW round: aggregate announces/sec across shards, the honest
+    N-vs-1 ratio, peers driven, and the membership-drill outcome)."""
+    lines = [
+        SWARM_BEGIN,
+        "Generated by `python -m tools.bench_report --update` from the",
+        "`BENCH_SW_r*.json` rounds (tools/bench_swarm.py) — do not edit",
+        "by hand; tier-1 (`tests/test_bench_report.py`) fails if stale.",
+        "",
+        "| round | status | aggregate ann/s (1 shard → N) | N÷1 | "
+        "peers driven | max hosts/shard | drill (handoffs/redirects/"
+        "dl-fail) | note |",
+        "| --- | --- | --- | --- | --- | --- | --- | --- |",
+    ]
+    for data in rounds:
+        arms = data.get("arms") or {}
+        drill = data.get("membership_drill") or {}
+        if not data.get("ok") or not arms:
+            lines.append(
+                f"| r{data['round']:02d} | error | — | — | — | — | — | "
+                f"{str(data.get('error', ''))[:80]} |"
+            )
+            continue
+        status = "guarded" if data.get("regression_warning") else "ok"
+        single = arms.get("single", {})
+        sharded = arms.get("sharded", {})
+        note = str(data.get("note", "") or "").replace("|", "\\|")
+        drill_cell = (
+            f"{drill.get('handed_off_tasks', 0)}/"
+            f"{drill.get('redirects_followed', 0)}/"
+            f"{sharded.get('downloads_failed', 0)}"
+            if drill.get("ran") else "—"
+        )
+        lines.append(
+            f"| r{data['round']:02d} | {status} "
+            f"| {single.get('announces_per_sec', 0):,.0f} → "
+            f"{sharded.get('announces_per_sec', 0):,.0f} "
+            f"| {data.get('speedup_shards', 0):.2f}× "
+            f"| {data.get('unique_hosts', 0):,} "
+            f"| {sharded.get('hosts_per_shard_max', 0):,} "
+            f"| {drill_cell} "
+            f"| {note} |"
+        )
+    lines.append(SWARM_END)
+    return "\n".join(lines)
 
 
 def render_telemetry(rounds: List[dict]) -> str:
@@ -299,9 +369,10 @@ def update_file(
     rounds: List[dict],
     dl_rounds: Optional[List[dict]] = None,
     tel_rounds: Optional[List[dict]] = None,
+    sw_rounds: Optional[List[dict]] = None,
 ) -> bool:
     """Replace the marker-delimited block(s); True when the file changed.
-    The download/telemetry blocks are optional (docs without their
+    The download/telemetry/swarm blocks are optional (docs without their
     markers are left untouched)."""
     text = path.read_text(encoding="utf-8")
     new = _replace_block(
@@ -315,6 +386,11 @@ def update_file(
     if tel_rounds is not None:
         new = _replace_block(
             new, TELEMETRY_BEGIN, TELEMETRY_END, render_telemetry(tel_rounds),
+            required=False,
+        )
+    if sw_rounds is not None:
+        new = _replace_block(
+            new, SWARM_BEGIN, SWARM_END, render_swarm(sw_rounds),
             required=False,
         )
     if new != text:
@@ -342,16 +418,21 @@ def main(argv=None) -> int:
     rounds = collect_rounds(root)
     dl_rounds = collect_download_rounds(root)
     tel_rounds = collect_telemetry_rounds(root)
+    sw_rounds = collect_swarm_rounds(root)
     fresh = render_trajectory(rounds)
     fresh_dl = render_download(dl_rounds)
     fresh_tel = render_telemetry(tel_rounds)
+    fresh_sw = render_swarm(sw_rounds)
     if args.update:
-        changed = update_file(root / args.file, rounds, dl_rounds, tel_rounds)
+        changed = update_file(
+            root / args.file, rounds, dl_rounds, tel_rounds, sw_rounds
+        )
         print(
             f"{args.file}: tables "
             + ("updated" if changed else "already current")
             + f" ({len(rounds)} round(s), {len(dl_rounds)} download "
-            f"round(s), {len(tel_rounds)} telemetry round(s))"
+            f"round(s), {len(tel_rounds)} telemetry round(s), "
+            f"{len(sw_rounds)} swarm round(s))"
         )
         return 0
     if args.check:
@@ -362,6 +443,7 @@ def main(argv=None) -> int:
              not dl_rounds),
             ("telemetry", TELEMETRY_BEGIN, TELEMETRY_END, fresh_tel,
              not tel_rounds),
+            ("swarm", SWARM_BEGIN, SWARM_END, fresh_sw, not sw_rounds),
         ):
             begin = text.find(begin_m)
             end = text.find(end_m)
@@ -381,7 +463,8 @@ def main(argv=None) -> int:
         print(
             f"{args.file}: tables current ({len(rounds)} round(s), "
             f"{len(dl_rounds)} download round(s), "
-            f"{len(tel_rounds)} telemetry round(s))"
+            f"{len(tel_rounds)} telemetry round(s), "
+            f"{len(sw_rounds)} swarm round(s))"
         )
         return 0
     print(fresh)
@@ -389,6 +472,8 @@ def main(argv=None) -> int:
     print(fresh_dl)
     print()
     print(fresh_tel)
+    print()
+    print(fresh_sw)
     return 0
 
 

@@ -108,6 +108,11 @@ REQUIRED_SEAMS = {
     ),
     "dragonfly2_tpu/daemon/sni.py": ("sni.peek", "sni.hijack"),
     "dragonfly2_tpu/scheduler/topology_sync.py": ("scheduler.topology.sync",),
+    # Sharded fleet (DESIGN.md §24): the membership-change handoff sweep
+    # and the client-side ring routing are the cross-shard fault seams
+    # the SIGKILL drill steers through.
+    "dragonfly2_tpu/scheduler/sharding.py": ("shard.handoff",),
+    "dragonfly2_tpu/rpc/resolver.py": ("shard.route",),
     "dragonfly2_tpu/scheduler/microbatch.py": ("scheduler.eval.batch",),
     "dragonfly2_tpu/scheduler/seed_client.py": ("seed.trigger",),
     "dragonfly2_tpu/jobs/image.py": ("jobs.image.fetch",),

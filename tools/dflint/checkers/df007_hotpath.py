@@ -58,6 +58,12 @@ REQUIRED_HOTPATH = {
         "HostFeatureCache.rule_scores",
     ),
     "dragonfly2_tpu/scheduler/microbatch.py": ("ScorerBatcher.score",),
+    # Lifecycle-gauge refresh rides every register/leave at fleet scale:
+    # the rate-limit guard keeps it loop-free and lock-cheap (ISSUE 13 —
+    # it must never become the per-announce bottleneck at 100k peers).
+    "dragonfly2_tpu/scheduler/service.py": (
+        "SchedulerService._refresh_gauges",
+    ),
     "dragonfly2_tpu/records/features.py": ("edge_features_batch",),
     "dragonfly2_tpu/trainer/export.py": ("MLPScorer.score", "GNNScorer.score"),
     # Fused gather+score serving entry points (ops/pallas_score.py): the

@@ -57,6 +57,10 @@ REQUIRED_SPANS = {
     "dragonfly2_tpu/trainer/online_graph.py": ("trainer/dispatch",),
     "dragonfly2_tpu/manager/replication.py": ("manager/replicate.commit",),
     "dragonfly2_tpu/scheduler/microbatch.py": ("scheduler/eval.flush",),
+    # Cross-shard task migration (DESIGN.md §24): the handoff sweep is
+    # the edge trace_assemble must show on the chaos drill's critical
+    # path — losing the span loses the migration evidence.
+    "dragonfly2_tpu/scheduler/sharding.py": ("scheduler/shard.handoff",),
 }
 
 
