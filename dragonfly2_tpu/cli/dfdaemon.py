@@ -154,6 +154,7 @@ def build(cfg: DaemonConfig, scheduler_url: str):
         piece_fetcher=HTTPPieceFetcher(client.resolve_host, ssl_context=fetch_ssl),
         source_fetcher=PieceSourceFetcher(),
         concurrent_source_groups=cfg.concurrent_source_groups,
+        stream_tee_depth=cfg.stream_tee_depth,
     )
     announcer = HostAnnouncer(host, client)
     return {

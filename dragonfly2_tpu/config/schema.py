@@ -457,6 +457,10 @@ class DaemonConfig:
     # Concurrent back-to-source range groups (peerhost.go ConcurrentOption
     # GoroutineCount); 1 = sequential origin fetch.
     concurrent_source_groups: int = 1
+    # Pass-through read plane (DESIGN.md §25): commit-tee buffer depth
+    # in pieces per stream consumer; 0 = disable the tee (proxy/gateway
+    # streams read every piece back off disk).
+    stream_tee_depth: int = 8
     # Cloud back-to-source credentials by scheme (peerhost.go source
     # plugins): {"s3": {...}, "oss": {...}, "hdfs": {...}, "oras": {...}}
     # — see dragonfly2_tpu.source.configure_sources.
@@ -475,6 +479,10 @@ class DaemonConfig:
         self.telemetry.validate()
         if self.piece_size < 4096:
             raise ConfigError(f"piece_size {self.piece_size} too small")
+        if self.stream_tee_depth < 0:
+            raise ConfigError(
+                f"stream_tee_depth {self.stream_tee_depth} must be >= 0"
+            )
 
 
 # ---------------------------------------------------------------------------

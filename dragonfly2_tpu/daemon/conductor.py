@@ -47,8 +47,10 @@ from ..scheduler.scheduling import ScheduleResultKind
 from ..utils.types import TINY_FILE_SIZE, Priority
 from .piece_pipeline import (
     CommitPipeline,
+    CommitTee,
     PieceLatencyTracker,
     PieceReportBatcher,
+    TeeConsumer,
     hedged_fetch,
 )
 from .storage import DaemonStorage
@@ -124,6 +126,48 @@ class TaskRun:
         self.content_length = -1
         self.done = False
         self.result: Optional[DownloadResult] = None
+        # Pass-through read plane (DESIGN.md §25): every commit path
+        # publishes the verified body here; stream consumers (proxy,
+        # gateway) register before the download starts and serve bytes
+        # with zero disk reads on the fast path.
+        self.tee = CommitTee()
+        # Byte-range hints from ranged open_stream callers: the piece
+        # pull orders the overlapping piece window FIRST so a Range
+        # client's bytes arrive before the rest of the task.
+        self._range_hints: List[Tuple[int, Optional[int]]] = []
+        # The download span's context, recorded when the owned download
+        # starts — pass-through serves (the `daemon/stream` span) ride
+        # it so they land on the download's trace.
+        self.traceparent: Optional[str] = None
+
+    def publish(self, number: int, data: bytes) -> None:
+        """Offer a verified piece body to the stream consumers (commit
+        paths call this alongside the disk write)."""
+        self.tee.publish(number, data)
+
+    def add_range_hint(self, start: int, length: Optional[int]) -> None:
+        with self.cond:
+            self._range_hints.append((start, length))
+
+    def range_hints(self) -> List[Tuple[int, Optional[int]]]:
+        with self.cond:
+            return list(self._range_hints)
+
+    def priority_pieces(self, piece_size: int, n_pieces: int) -> Set[int]:
+        """Piece numbers covered by any registered byte-range hint."""
+        window: Set[int] = set()
+        if piece_size <= 0 or n_pieces <= 0:
+            return window
+        for start, length in self.range_hints():
+            first = max(start, 0) // piece_size
+            if length is None:
+                last = n_pieces - 1
+            elif length <= 0:
+                continue
+            else:
+                last = (start + length - 1) // piece_size
+            window.update(range(min(first, n_pieces), min(last + 1, n_pieces)))
+        return window
 
     def mark_sized(self, n_pieces: int, piece_size: int, content_length: int) -> None:
         with self.cond:
@@ -224,6 +268,7 @@ class Conductor:
         hedge_min_samples: int = 16,
         hedge_floor_s: float = 0.05,
         hedge_multiplier: float = 1.5,
+        stream_tee_depth: int = 8,
         pex=None,
     ) -> None:
         self.host = host
@@ -271,6 +316,10 @@ class Conductor:
         self.hedge_min_samples = hedge_min_samples
         self.hedge_floor_s = hedge_floor_s
         self.hedge_multiplier = hedge_multiplier
+        # Pass-through read plane (DESIGN.md §25): per-consumer tee
+        # buffer depth in pieces; 0 disables the tee (stream consumers
+        # read every piece back off disk — the bench's reference arm).
+        self.stream_tee_depth = max(0, stream_tee_depth)
         # Storage writes + piece-run bookkeeping from concurrent source
         # workers are serialized; the origin fetch AND the scheduler
         # report overlap (the report is an RPC on remote wirings — it
@@ -394,6 +443,22 @@ class Conductor:
             while t.is_alive():
                 t.join(5.0)
 
+    @staticmethod
+    def _order_pending(
+        numbers, run: Optional[TaskRun], piece_size: int, n_pieces: int
+    ) -> "deque":
+        """Range-priority piece ordering (DESIGN.md §25): pieces inside
+        any ranged stream's window come FIRST (ascending — the reader is
+        in-order), then the rest ascending.  No hints → natural order."""
+        nums = list(numbers)
+        if run is None:
+            return deque(nums)
+        window = run.priority_pieces(piece_size, n_pieces)
+        if not window or len(window) >= len(nums):
+            return deque(nums)
+        nums.sort(key=lambda n: (n not in window, n))
+        return deque(nums)
+
     # -- the main flow (peertask_conductor.go:370 start → pullPieces) --------
 
     def download(
@@ -449,15 +514,38 @@ class Conductor:
         priority: Priority = Priority.LEVEL0,
         task_id: Optional[str] = None,
         sizing_timeout_s: float = 30.0,
+        start: int = 0,
+        length: Optional[int] = None,
+        tee: bool = True,
     ) -> "StreamHandle":
         """Serve the task's bytes AS PIECES COMMIT: reuse a completed
         task, attach to a running one, or start the download in the
         background — the proxy and the object gateway consume this so a
-        response starts before the task finishes."""
+        response starts before the task finishes.
+
+        ``start``/``length`` open a RANGED stream: only the byte window
+        is served, and the overlapping piece window is scheduled FIRST
+        (range-priority ordering in the piece pull) so an HTTP Range
+        client's bytes arrive ahead of the rest of the task.  With
+        ``tee`` (default), the handle registers a commit-tee consumer
+        and serves published pieces with zero disk reads; ``tee=False``
+        (or ``stream_tee_depth=0``) keeps the disk round-trip path.
+        """
         tid = self._task_id(url, task_id)
         if self._complete_locally(tid):
-            return StreamHandle(self, tid, None)
+            return StreamHandle(self, tid, None, start=start, length=length)
         run, owner = self._claim(tid)
+        # Register the consumer and the range hint BEFORE the download
+        # thread starts: the piece pull then sees the hint when it
+        # orders its queue, and the tee never publishes past us (pieces
+        # committed before registration sit on disk — the spill path).
+        if start > 0 or length is not None:
+            run.add_range_hint(start, length)
+        consumer = (
+            run.tee.register(depth=self.stream_tee_depth)
+            if tee and self.stream_tee_depth > 0
+            else None
+        )
         if owner:
             t = threading.Thread(
                 target=self._download_quiet,
@@ -472,8 +560,12 @@ class Conductor:
             )
             t.start()
         if not run.wait_sized(sizing_timeout_s):
+            if consumer is not None:
+                consumer.close()
             raise IOError(f"stream {tid}: sizing timed out")
-        return StreamHandle(self, tid, run)
+        return StreamHandle(
+            self, tid, run, consumer=consumer, start=start, length=length
+        )
 
     def _download_quiet(self, run: TaskRun, url: str, **kw) -> None:
         """Background-thread face of _download_owned: failures land on the
@@ -539,6 +631,10 @@ class Conductor:
         with default_tracer.span(
             "daemon/download", task_id=run.task_id, url=url
         ) as span:
+            # Pass-through serves link here: the `daemon/stream` span
+            # carries this context so a proxy/gateway serve lands on the
+            # download's trace, not as an orphan root.
+            run.traceparent = span.traceparent
             result = self._download_registered(
                 run, url, piece_size=piece_size,
                 content_length=content_length,
@@ -584,6 +680,7 @@ class Conductor:
             self.storage.register_task(
                 task.id, piece_size=piece_size, content_length=len(reg.direct_piece)
             )
+            run.publish(0, reg.direct_piece)
             self.storage.write_piece(task.id, 0, reg.direct_piece)
             run.mark_sized(1, piece_size, len(reg.direct_piece))
             run.mark_piece(0)
@@ -654,12 +751,13 @@ class Conductor:
             task_id, piece_size=piece_size, content_length=content_length
         )
         run.mark_sized(n_pieces, piece_size, content_length)
-        pending = deque()
+        pending_nums = []
         for number in range(n_pieces):
             if self.storage.has_piece(task_id, number):
                 run.mark_piece(number)
             else:
-                pending.append(number)
+                pending_nums.append(number)
+        pending = self._order_pending(pending_nums, run, piece_size, n_pieces)
         lock = threading.Lock()
         abort = threading.Event()
         counters = {"nbytes": 0, "done": 0}
@@ -681,6 +779,7 @@ class Conductor:
                     content_length, piece_size, number
                 ):
                     continue  # torn body — try the next holder
+                run.publish(number, data)
                 self.storage.write_piece(task_id, number, data)
                 run.mark_piece(number)
                 with lock:
@@ -740,7 +839,10 @@ class Conductor:
         # and other children learn held pieces from the piece plane's
         # bitmaps, not from the scheduler.
         held = self.storage.piece_bitmap(task.id, n_pieces) if n_pieces > 0 else []
-        pending = deque(n for n in range(n_pieces) if not held[n])
+        pending = self._order_pending(
+            (n for n in range(n_pieces) if not held[n]), run,
+            task.piece_size, n_pieces,
+        )
 
         # Report path: batched (one report_pieces_finished per linger
         # window) or direct per-piece calls.  Commit path: pipelined
@@ -773,6 +875,10 @@ class Conductor:
             """Digest (crc at write) + persist + mark + report enqueue:
             runs on the committer thread when pipelined, inline in the
             worker otherwise — identical semantics either way."""
+            # Tee first (DESIGN.md §25): stream consumers get the
+            # verified body alongside the disk write — the pass-through
+            # fast path never reads back what was just written.
+            run.publish(number, data)
             self.storage.write_piece(task.id, number, data)
             run.mark_piece(number)
             with state.lock:
@@ -1027,9 +1133,10 @@ class Conductor:
         # on disk with their parent attribution intact — the origin only
         # serves what P2P didn't (piece_manager.go resumes from the
         # persisted piece bitmap the same way).
-        missing = [
-            n for n in range(n_pieces) if not self.storage.has_piece(task.id, n)
-        ]
+        missing = list(self._order_pending(
+            (n for n in range(n_pieces) if not self.storage.has_piece(task.id, n)),
+            run, task.piece_size or piece_size, n_pieces,
+        ))
         groups = min(self.concurrent_source_groups, len(missing))
         try:
             if groups > 1 and len(missing) >= self.concurrent_source_threshold:
@@ -1093,6 +1200,8 @@ class Conductor:
             )
         cost_ns = max(int((time.monotonic() - t_piece) * 1e9), 1)
         with self._report_lock:
+            if run is not None:
+                run.publish(number, data)
             self.storage.write_piece(task.id, number, data)
             if run is not None:
                 run.mark_piece(number)
@@ -1190,14 +1299,31 @@ class Conductor:
 class StreamHandle:
     """A started (or reused) stream task: sizing metadata now, bytes as
     pieces commit (peertask_manager.go StartStreamTask's ReadCloser +
-    attribute map)."""
+    attribute map).
+
+    With a registered :class:`TeeConsumer` (the default for live runs),
+    ``chunks`` serves each piece from the commit tee — ZERO disk reads
+    on the fast path; the disk is only touched for cache-hit replays
+    (``run is None``), pieces committed before this handle registered,
+    and slow-reader spills.  ``start``/``length`` narrow the handle to a
+    byte window (the ranged-stream serving half; the scheduling half is
+    the run's range hint).
+    """
 
     def __init__(
-        self, conductor: Conductor, task_id: str, run: Optional[TaskRun]
+        self,
+        conductor: Conductor,
+        task_id: str,
+        run: Optional[TaskRun],
+        *,
+        consumer: Optional[TeeConsumer] = None,
+        start: int = 0,
+        length: Optional[int] = None,
     ) -> None:
         self._conductor = conductor
         self.task_id = task_id
         self._run = run  # None → completed on disk (pure reuse)
+        self._consumer = consumer
         storage = conductor.storage
         if run is None:
             self.content_length = storage.content_length(task_id)
@@ -1209,32 +1335,137 @@ class StreamHandle:
             self.piece_size = run.piece_size
             self.n_pieces = run.n_pieces
             self.reused = False
+        # Byte window, clamped to the sized representation.
+        self.start = max(0, start)
+        if self.content_length >= 0:
+            self.start = min(self.start, self.content_length)
+            end = (
+                self.content_length
+                if length is None
+                else min(self.start + max(length, 0), self.content_length)
+            )
+        else:
+            end = -1 if length is None else self.start + max(length, 0)
+        self.end = end  # exclusive; -1 → to EOF of an unsized stream
+        # Serve-plane evidence for the zero-disk-read witness.
+        self.tee_hits = 0
+        self.disk_reads = 0
+
+    def close(self) -> None:
+        """Detach the tee consumer (released buffers, no more offers).
+        ``chunks`` closes automatically at exhaustion or generator
+        close; callers that never iterate must close explicitly."""
+        if self._consumer is not None:
+            self._consumer.close()
+
+    def narrow(self, start: int, end: int) -> "StreamHandle":
+        """Late-bound byte window (``end`` exclusive) for callers that
+        only learned the representation length from this stream's own
+        sizing (e.g. a Range request for an origin that won't answer a
+        length probe).  Registers the range hint with the live run —
+        best-effort priority: pieces already queued keep their order."""
+        self.start = max(0, start)
+        if self.content_length >= 0:
+            self.start = min(self.start, self.content_length)
+            self.end = min(end, self.content_length)
+        else:
+            self.end = end
+        if self._run is not None:
+            self._run.add_range_hint(self.start, max(self.end - self.start, 0))
+        return self
+
+    def __enter__(self) -> "StreamHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _piece_window(self) -> range:
+        """Piece numbers overlapping the byte window, in serve order."""
+        if self.n_pieces <= 0:
+            return range(0)
+        ps = self.piece_size
+        if ps <= 0:
+            return range(self.n_pieces)
+        first = self.start // ps
+        if self.end < 0:
+            return range(min(first, self.n_pieces), self.n_pieces)
+        if self.end <= self.start:
+            return range(0)
+        last = (self.end - 1) // ps
+        return range(min(first, self.n_pieces), min(last + 1, self.n_pieces))
 
     def chunks(self, *, piece_timeout_s: float = 60.0) -> Iterator[bytes]:
-        """Yield the task's content piece by piece, IN ORDER, waiting for
-        pieces that have not committed yet.  Raises IOError when the
-        underlying download fails or a piece times out."""
-        storage = self._conductor.storage
-        total = self.content_length
+        """Yield the handle's byte window piece by piece, IN ORDER,
+        waiting for pieces that have not committed yet.  Raises IOError
+        when the underlying download fails or a piece times out.  The
+        generator owns the tee consumer: it detaches at exhaustion or
+        close, so an abandoned response can't pin tee buffers."""
+        try:
+            for number in self._piece_window():
+                data = self._one_piece(number, piece_timeout_s)
+                if data is None:
+                    return  # eof on a shrunken run
+                data = self._clip(number, data)
+                if data:
+                    yield data
+        finally:
+            self._finish_stream()
+
+    def _one_piece(self, number: int, piece_timeout_s: float) -> Optional[bytes]:
+        if self._run is not None:
+            status = self._run.wait_piece(number, piece_timeout_s)
+            if status == "failed":
+                raise IOError(f"stream {self.task_id}: download failed")
+            if status == "timeout":
+                raise IOError(
+                    f"stream {self.task_id}: piece {number} timed out"
+                )
+            if status == "eof":
+                return None
+        if self._consumer is not None:
+            data = self._consumer.take(number)
+            if data is not None:
+                self.tee_hits += 1
+                return data
+        self.disk_reads += 1
+        return self._conductor.storage.read_piece(self.task_id, number)
+
+    def _clip(self, number: int, data: bytes) -> bytes:
+        """Trim a piece body to the handle's byte window + EOF."""
         ps = self.piece_size
-        for number in range(self.n_pieces):
-            if self._run is not None:
-                status = self._run.wait_piece(number, piece_timeout_s)
-                if status == "failed":
-                    raise IOError(f"stream {self.task_id}: download failed")
-                if status == "timeout":
-                    raise IOError(
-                        f"stream {self.task_id}: piece {number} timed out"
-                    )
-                if status == "eof":
-                    return
-            data = storage.read_piece(self.task_id, number)
-            if total >= 0 and ps > 0:
-                remaining = total - number * ps
-                if remaining < len(data):
-                    data = data[:max(remaining, 0)]
-            if data:
-                yield data
+        total = self.content_length
+        base = number * ps if ps > 0 else 0
+        lo = max(self.start - base, 0)
+        hi = len(data)
+        if total >= 0 and ps > 0:
+            hi = min(hi, max(total - base, 0))
+        if self.end >= 0:
+            hi = min(hi, max(self.end - base, 0))
+        return data[lo:hi] if (lo > 0 or hi < len(data)) else data
+
+    def _finish_stream(self) -> None:
+        """Detach the consumer and record the serve on the download's
+        trace: one `daemon/stream` span carrying the traceparent the
+        run's download span injected, so a pass-through serve is visible
+        on the SAME trace as the swarm transfer that fed it."""
+        consumer = self._consumer
+        self._consumer = None
+        if consumer is not None:
+            consumer.close()
+        from ..utils.tracing import default_tracer
+
+        traceparent = self._run.traceparent if self._run is not None else None
+        with default_tracer.remote_span(
+            "daemon/stream",
+            traceparent,
+            task_id=self.task_id,
+            start=self.start,
+            tee_hits=self.tee_hits,
+            disk_reads=self.disk_reads,
+            reused=self.reused,
+        ):
+            pass
 
     def read_all(self, *, piece_timeout_s: float = 60.0) -> bytes:
         return b"".join(self.chunks(piece_timeout_s=piece_timeout_s))

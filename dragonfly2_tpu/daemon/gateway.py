@@ -14,10 +14,11 @@ swarm's first parent.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 from ..objectstorage import ObjectMetadata, ObjectStorageBackend
 from ..utils import idgen
+from ..utils.httprange import RangeNotSatisfiable, parse_range
 
 
 @dataclass
@@ -83,11 +84,19 @@ class ObjectGateway:
             # P2P completely failed → straight backend read.
             return self.backend.get_object(self.config.bucket, key)
 
-    def get_object_stream(self, key: str):
-        """Streaming read (StartStreamTask consumer): chunks flow as the
-        P2P download commits pieces — a hot object starts serving before
-        the swarm transfer finishes.  Raises on P2P failure; ``get_object``
-        adds the backend fallback for byte-level callers."""
+    def get_object_stream(self, key: str, *, start: int = 0,
+                          length: Optional[int] = None):
+        """Streaming read (StartStreamTask consumer): chunks flow from
+        the commit tee as the P2P download commits pieces — a hot object
+        starts serving before the swarm transfer finishes, with no disk
+        round-trip on the fast path.  ``start``/``length`` serve a byte
+        window over the in-flight task (the overlapping pieces schedule
+        first).  Raises on P2P failure; ``get_object`` adds the backend
+        fallback for byte-level callers."""
+        return self._open_stream(key, start=start, length=length).chunks()
+
+    def _open_stream(self, key: str, *, start: int = 0,
+                     length: Optional[int] = None):
         url = self._object_url(key)
         meta = (
             self.backend.head_object(self.config.bucket, key)
@@ -95,12 +104,48 @@ class ObjectGateway:
             else None
         )
         content_length = meta.content_length if meta else None
-        handle = self.daemon.open_stream(
+        return self.daemon.open_stream(
             url,
             piece_size=self.config.piece_size,
             content_length=content_length,
+            start=start,
+            length=length,
         )
-        return handle.chunks()
+
+    def get_object_range(
+        self, key: str, range_header: Optional[str]
+    ) -> Tuple[Tuple[int, int, int], Iterator[bytes]]:
+        """RFC-7233 ranged read over the (possibly in-flight) task:
+        ``Range`` header → ``((start, end_inclusive, total), chunks)``.
+        A missing/ignorable header serves the full body (start=0,
+        end=total-1 — the caller answers 200 instead of 206); an
+        unsatisfiable range raises :class:`RangeNotSatisfiable` (416)
+        WITHOUT touching the swarm when the backend knows the length."""
+        meta = (
+            self.backend.head_object(self.config.bucket, key)
+            if self.backend.object_exists(self.config.bucket, key)
+            else None
+        )
+        if meta is not None:
+            total = meta.content_length
+            rng = parse_range(range_header, total)  # may raise 416
+            start, length = (
+                (rng[0], rng[1] - rng[0] + 1) if rng else (0, None)
+            )
+            handle = self._open_stream(key, start=start, length=length)
+        else:
+            # P2P-only object: the stream's own sizing is the total.
+            handle = self._open_stream(key)
+            total = handle.content_length
+            try:
+                rng = parse_range(range_header, total)
+            except RangeNotSatisfiable:
+                handle.close()
+                raise
+            if rng is not None:
+                handle.narrow(rng[0], rng[1] + 1)
+        span = rng if rng is not None else (0, max(total - 1, 0))
+        return (span[0], span[1], total), handle.chunks()
 
     def head_object(self, key: str) -> ObjectMetadata:
         return self.backend.head_object(self.config.bucket, key)

@@ -25,6 +25,18 @@ serialization without changing any correctness contract:
   body wins; the loser's body is discarded (its socket drains back to
   the pool or is dropped on error) and only the winner reaches the
   commit path — one commit per piece, by construction and by drill.
+
+- :class:`CommitTee` — the pass-through read plane (DESIGN.md §25).
+  The committer PUBLISHES each verified piece body to every registered
+  stream consumer alongside the disk write, so the proxy / object
+  gateway serve bytes straight from the commit path instead of reading
+  them back off the disk they were written to a microsecond earlier.
+  Buffers are refcounted across consumers; each consumer's buffer depth
+  is bounded, and a slow reader SPILLS (its pieces degrade to the disk
+  path) instead of backpressuring the committer — a stalled proxy
+  client can never wedge the download.  The tee is an optimization over
+  a durable source of truth: any delivery failure degrades to the disk
+  read, never to a download failure.
 """
 
 from __future__ import annotations
@@ -34,7 +46,7 @@ import queue
 import threading
 import time
 from collections import deque
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils.metrics import default_registry as _reg
 
@@ -69,6 +81,19 @@ PIECE_FETCH_SECONDS = _reg.sketch(
 REPORT_LINGER_SECONDS = _reg.sketch(
     "daemon_report_linger_seconds",
     "Piece-report batch linger: first enqueue to flush dispatch",
+)
+
+# Pass-through read plane (DESIGN.md §25): every published piece is
+# either DELIVERED into a consumer's bounded buffer (served with zero
+# disk reads) or SPILLED (slow/closed consumer — the piece degrades to
+# the disk path).  The zero-disk-read witness and the stream bench read
+# these to prove which plane actually served.
+STREAM_TEE_TOTAL = _reg.counter(
+    "daemon_stream_tee_pieces_total",
+    "Commit-tee piece offers by outcome (delivered = buffered for a "
+    "consumer; spilled = bounded buffer full or consumer closed — the "
+    "piece is served from disk instead)",
+    ("outcome",),
 )
 
 
@@ -475,3 +500,176 @@ def hedged_fetch(
     PIECE_HEDGE_TOTAL.inc(outcome="error")
     assert first_err is not None
     raise first_err
+
+
+# ---------------------------------------------------------------------------
+# Pass-through read plane: the commit tee (DESIGN.md §25)
+# ---------------------------------------------------------------------------
+
+
+class RefCountedBuffer:
+    """One verified piece body shared by every consumer that buffered it.
+
+    The commit path hands the SAME bytes object to N consumers; each
+    holds one reference and releases it on take/close.  When the last
+    reference drops, the buffer lets go of the bytes so tee memory is
+    bounded by live consumer buffers, never by publish history.
+    """
+
+    __slots__ = ("number", "_mu", "_data", "_refs")
+
+    def __init__(self, number: int, data: bytes, refs: int) -> None:
+        self.number = number
+        self._mu = threading.Lock()
+        self._data: Optional[bytes] = data
+        self._refs = max(refs, 0)
+        if self._refs == 0:
+            self._data = None
+
+    @property
+    def refs(self) -> int:
+        with self._mu:
+            return self._refs
+
+    @property
+    def data(self) -> Optional[bytes]:
+        with self._mu:
+            return self._data
+
+    def release(self) -> int:
+        """Drop one reference; the last release frees the bytes."""
+        with self._mu:
+            if self._refs > 0:
+                self._refs -= 1
+            if self._refs == 0:
+                self._data = None
+            return self._refs
+
+
+class TeeConsumer:
+    """One stream reader's bounded window onto the commit tee.
+
+    Pieces land out of order (parallel piece workers), so the buffer is
+    number-addressed: ``take(number)`` pops the piece when the in-order
+    reader reaches it.  The buffer never holds more than ``depth``
+    pieces — an offer past the bound is a SPILL (the reader serves that
+    piece from disk), which is what makes a stalled proxy client unable
+    to grow tee memory or stall the committer.  State is guarded by the
+    owning tee's lock (one lock for the whole tee plane).
+    """
+
+    def __init__(self, tee: "CommitTee", depth: int) -> None:
+        self._tee = tee
+        self.depth = max(1, depth)
+        self._buffered: Dict[int, RefCountedBuffer] = {}
+        self._closed = False
+        self.delivered = 0
+        self.spilled = 0
+
+    def _offer(self, buf: RefCountedBuffer) -> bool:
+        """Committer-side: buffer the piece or spill it.  Never blocks,
+        never raises — the commit path's wall is sacred."""
+        with self._tee._mu:
+            if not self._closed and len(self._buffered) < self.depth:
+                self._buffered[buf.number] = buf
+                self.delivered += 1
+                return True
+            self.spilled += 1
+        buf.release()
+        from ..utils import faultinject
+
+        # Slow-reader spill seam: a chaos scenario SIGKILLs here (crash
+        # kind) for the mid-tee kill drill; any raising kind is absorbed
+        # — the spill already happened, the disk path serves the piece.
+        try:
+            faultinject.fire("daemon.stream.spill")
+        except Exception:  # noqa: BLE001 — spill is bookkeeping, not delivery
+            logger.debug("injected fault at daemon.stream.spill", exc_info=True)
+        STREAM_TEE_TOTAL.inc(outcome="spilled")
+        return False
+
+    # dflint: hotpath
+    def take(self, number: int) -> Optional[bytes]:
+        """Reader-side: pop piece ``number`` if the tee delivered it
+        (zero disk reads), else None — the reader falls back to disk
+        (spill, pre-registration commit, or cache-hit replay)."""
+        with self._tee._mu:
+            buf = self._buffered.pop(number, None)
+        if buf is None:
+            return None
+        data = buf.data
+        buf.release()
+        return data
+
+    def buffered_count(self) -> int:
+        with self._tee._mu:
+            return len(self._buffered)
+
+    def close(self) -> None:
+        """Detach from the tee: release every held buffer and stop
+        receiving offers.  Idempotent; the committer may be mid-publish
+        concurrently (it snapshots consumers, `_offer` re-checks)."""
+        with self._tee._mu:
+            if self._closed:
+                return
+            self._closed = True
+            bufs = list(self._buffered.values())
+            self._buffered.clear()
+            if self in self._tee._consumers:
+                self._tee._consumers.remove(self)
+        for buf in bufs:
+            buf.release()
+
+
+class CommitTee:
+    """Publish verified pieces to N registered stream consumers alongside
+    the disk write (the pass-through read plane's producer half).
+
+    Delivery is strictly best-effort over a durable fallback: a delivery
+    failure (including an injected ``daemon.stream.tee`` fault) degrades
+    every consumer to the disk path for that piece — it can never fail
+    or slow the download beyond the bounded buffer insert.
+    """
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._consumers: List[TeeConsumer] = []
+        self.published = 0
+
+    def register(self, *, depth: int = 8) -> TeeConsumer:
+        consumer = TeeConsumer(self, depth)
+        with self._mu:
+            self._consumers.append(consumer)
+        return consumer
+
+    def consumer_count(self) -> int:
+        with self._mu:
+            return len(self._consumers)
+
+    # dflint: hotpath
+    def publish(self, number: int, data: bytes) -> int:
+        """Offer one verified piece to every registered consumer; returns
+        how many buffered it.  No consumers → pure no-op (the common
+        non-streaming download pays one lock round-trip)."""
+        with self._mu:
+            consumers = list(self._consumers)
+        if not consumers:
+            return 0
+        from ..utils import faultinject
+
+        try:
+            # Tee delivery seam: an injected drop models a failed
+            # delivery — consumers degrade to the disk path for this
+            # piece, the download is untouched.
+            faultinject.fire("daemon.stream.tee")
+        except Exception:  # noqa: BLE001 — tee is best-effort over disk
+            logger.debug("tee delivery faulted; piece %d spills", number)
+            STREAM_TEE_TOTAL.inc(outcome="spilled")
+            return 0
+        buf = RefCountedBuffer(number, data, len(consumers))
+        delivered = sum([c._offer(buf) for c in consumers])
+        with self._mu:
+            self.published += 1
+        if delivered:
+            STREAM_TEE_TOTAL.inc(outcome="delivered")
+        return delivered

@@ -9,6 +9,13 @@ P2P download via the daemon's conductor; unmatched GETs are fetched
 directly (urllib); CONNECT requests are tunneled as raw byte relays
 (HTTPS pass-through — proxy.go's tunnel path; SNI-hijack into P2P is a
 round-2 target).
+
+Pass-through serving (DESIGN.md §25): diverted GETs STREAM the task via
+``open_stream`` — the response body is fed from the commit tee while
+the swarm download runs (zero disk reads on the fast path) — and honor
+single-range ``Range:`` headers (RFC 7233 via utils/httprange) as 206
+responses over the IN-FLIGHT task: only the overlapping piece window is
+scheduled first, the client never waits for full completion.
 """
 
 from __future__ import annotations
@@ -21,6 +28,12 @@ from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Pattern, Tuple
 
+from ..utils.httprange import (
+    RangeNotSatisfiable,
+    content_range,
+    parse_range,
+    unsatisfiable_content_range,
+)
 from .relay import fetch_via_p2p, relay_bytes
 
 
@@ -78,37 +91,66 @@ class P2PProxy:
             def log_message(self, *args):
                 pass
 
+            def _send_416(self, total: int) -> None:
+                self.send_response(416)
+                self.send_header(
+                    "Content-Range", unsatisfiable_content_range(total)
+                )
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
             def do_GET(self):
                 # Absolute-form (true forward-proxy clients send
                 # `GET http://host/path`) or path-embedded
-                # (`GET /http://host/path`, gateway-style callers).
+                # (`GET /http://host/path`, gateway-style callers — any
+                # scheme the rule set routes, incl. dfstore://).
                 url = self.path
-                if url.startswith("/http://") or url.startswith("/https://"):
+                if re.match(r"^/[a-z][a-z0-9+.-]*://", url):
                     url = url[1:]
                 use_p2p, effective = proxy.router.route(url)
+                rng_header = self.headers.get("Range")
                 if use_p2p:
                     # STREAM the P2P task (StartStreamTask consumer): the
-                    # response body flows piece-by-piece as the download
-                    # commits — a client starts receiving long before the
-                    # task finishes.
+                    # response body flows from the commit tee as the
+                    # download commits — a client starts receiving long
+                    # before the task finishes, with no disk round-trip.
+                    # A Range request maps onto the overlapping piece
+                    # window of the IN-FLIGHT task (206 over a task that
+                    # may still be mid-swarm).
                     try:
-                        handle = proxy._open_p2p_stream(effective)
+                        handle, rng = proxy._open_p2p_stream(
+                            effective, rng_header
+                        )
+                    except RangeNotSatisfiable as exc:
+                        self._send_416(exc.total)
+                        return
                     except Exception:  # noqa: BLE001 — proxy boundary
                         self.send_error(502)
                         return
                     proxy.stats["p2p"] += 1
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Length", str(max(handle.content_length, 0))
-                    )
+                    total = max(handle.content_length, 0)
+                    if rng is not None:
+                        start, end = rng
+                        self.send_response(206)
+                        self.send_header(
+                            "Content-Range", content_range(start, end, total)
+                        )
+                        self.send_header(
+                            "Content-Length", str(end - start + 1)
+                        )
+                    else:
+                        self.send_response(200)
+                        self.send_header("Content-Length", str(total))
+                    self.send_header("Accept-Ranges", "bytes")
                     self.end_headers()
                     try:
                         for chunk in handle.chunks():
                             self.wfile.write(chunk)
                     except (IOError, OSError):
-                        # Mid-stream failure: the 200 is already on the
-                        # wire — dropping the connection is the only
+                        # Mid-stream failure: the status is already on
+                        # the wire — dropping the connection is the only
                         # honest signal (short body ≠ success).
+                        handle.close()
                         self.close_connection = True
                     return
                 try:
@@ -117,7 +159,23 @@ class P2PProxy:
                 except Exception:  # noqa: BLE001 — proxy boundary
                     self.send_error(502)
                     return
-                self.send_response(200)
+                # Direct fetches honor the same Range shapes so a rule
+                # flip (p2p ↔ direct) never changes range semantics.
+                try:
+                    rng = parse_range(rng_header, len(body))
+                except RangeNotSatisfiable:
+                    self._send_416(len(body))
+                    return
+                if rng is not None:
+                    start, end = rng
+                    self.send_response(206)
+                    self.send_header(
+                        "Content-Range", content_range(start, end, len(body))
+                    )
+                    body = body[start : end + 1]
+                else:
+                    self.send_response(200)
+                self.send_header("Accept-Ranges", "bytes")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -163,13 +221,35 @@ class P2PProxy:
     def _fetch_p2p(self, url: str) -> bytes:
         return fetch_via_p2p(self.daemon, url, self.piece_size)
 
-    def _open_p2p_stream(self, url: str):
+    def _open_p2p_stream(self, url: str, rng_header: Optional[str] = None):
         """Divert seam, streaming face: sizing now, bytes as pieces land
-        (conductor.open_stream)."""
-        return self.daemon.open_stream(
-            url, piece_size=self.piece_size,
-            content_length=self.daemon.conductor.probe_content_length(url),
-        )
+        (conductor.open_stream) → ``(handle, (start, end) | None)``.
+
+        When the origin answers a length probe, the Range header parses
+        BEFORE the stream opens (an unsatisfiable range never touches
+        the swarm, and the piece pull gets the priority hint up front);
+        otherwise the stream's own sizing provides the total and the
+        window narrows late (best-effort priority).
+        """
+        total = self.daemon.conductor.probe_content_length(url)
+        rng = None
+        if total is not None and total >= 0:
+            rng = parse_range(rng_header, total)  # may raise 416
+            start, length = (rng[0], rng[1] - rng[0] + 1) if rng else (0, None)
+            handle = self.daemon.open_stream(
+                url, piece_size=self.piece_size, content_length=total,
+                start=start, length=length,
+            )
+            return handle, rng
+        handle = self.daemon.open_stream(url, piece_size=self.piece_size)
+        try:
+            rng = parse_range(rng_header, handle.content_length)
+        except RangeNotSatisfiable:
+            handle.close()
+            raise
+        if rng is not None:
+            handle.narrow(rng[0], rng[1] + 1)
+        return handle, rng
 
     def _fetch_direct(self, url: str) -> bytes:
         from ..utils import faultinject

@@ -54,6 +54,117 @@ def _grpc_to_dfcode():
 _GRPC_TO_DFCODE = _grpc_to_dfcode()
 
 
+# -- sharded-fleet steering parity (DESIGN.md §24/§25) -----------------------
+#
+# The HTTP wire answers steering as 421/503 bodies; the gRPC wire maps
+# the SAME typed errors onto status codes + TRAILING METADATA so a
+# daemon behind either transport raises the identical exception and the
+# ShardRouter follows both without knowing which wire it rides:
+#
+#   WrongShardError     → FAILED_PRECONDITION, df-steering=wrong_shard,
+#                         df-owner-id / df-owner-url / df-ring-version
+#   ShardSaturatedError → RESOURCE_EXHAUSTED, df-steering=shard_saturated,
+#                         retry-after (seconds) / df-reason
+#
+# (RESOURCE_EXHAUSTED is shared with the rate limiter; the df-steering
+# key is what disambiguates — absence keeps the plain RPCError path.)
+
+def _steering_trailers(exc) -> tuple:
+    from ..scheduler.sharding import ShardSaturatedError, WrongShardError
+
+    if isinstance(exc, WrongShardError):
+        return (
+            ("df-steering", "wrong_shard"),
+            ("df-task-id", exc.task_id),
+            ("df-owner-id", exc.owner_id),
+            ("df-owner-url", exc.owner_url),
+            ("df-ring-version", str(exc.ring_version)),
+        )
+    assert isinstance(exc, ShardSaturatedError)
+    return (
+        ("df-steering", "shard_saturated"),
+        ("retry-after", f"{exc.retry_after_s:.3f}"),
+        ("df-reason", exc.reason),
+    )
+
+
+def _steering_error_from_metadata(metadata):
+    """Trailing metadata → the typed steering exception, or None."""
+    md = {k: v for k, v in (metadata or ())}
+    kind = md.get("df-steering")
+    if kind == "wrong_shard":
+        from ..scheduler.sharding import WrongShardError
+
+        try:
+            version = int(md.get("df-ring-version", 0) or 0)
+        except ValueError:
+            version = 0
+        return WrongShardError(
+            md.get("df-task-id", ""),
+            owner_id=md.get("df-owner-id", ""),
+            owner_url=md.get("df-owner-url", ""),
+            ring_version=version,
+        )
+    if kind == "shard_saturated":
+        from ..scheduler.sharding import ShardSaturatedError
+
+        try:
+            retry_after = float(md.get("retry-after", 1.0) or 1.0)
+        except ValueError:
+            retry_after = 1.0
+        return ShardSaturatedError(
+            retry_after_s=retry_after, reason=md.get("df-reason", "")
+        )
+    return None
+
+
+def _steering_error_to_stream(exc) -> str:
+    """Bidi-stream encoding: the response's ``error`` field carries the
+    steering payload as ``<kind>:<json>`` (streams have no per-message
+    trailers to ride)."""
+    from ..scheduler.sharding import WrongShardError
+
+    if isinstance(exc, WrongShardError):
+        return "wrong_shard:" + json.dumps({
+            "task_id": exc.task_id,
+            "owner_id": exc.owner_id,
+            "owner_url": exc.owner_url,
+            "ring_version": exc.ring_version,
+        })
+    return "shard_saturated:" + json.dumps({
+        "retry_after_s": exc.retry_after_s,
+        "reason": exc.reason,
+    })
+
+
+def _steering_error_from_stream(error: str):
+    """Stream ``error`` field → the typed steering exception, or None."""
+    for kind in ("wrong_shard", "shard_saturated"):
+        prefix = kind + ":"
+        if not error.startswith(prefix):
+            continue
+        try:
+            payload = json.loads(error[len(prefix):])
+        except (ValueError, TypeError):
+            return None
+        if kind == "wrong_shard":
+            from ..scheduler.sharding import WrongShardError
+
+            return WrongShardError(
+                str(payload.get("task_id", "")),
+                owner_id=str(payload.get("owner_id", "")),
+                owner_url=str(payload.get("owner_url", "")),
+                ring_version=int(payload.get("ring_version", 0) or 0),
+            )
+        from ..scheduler.sharding import ShardSaturatedError
+
+        return ShardSaturatedError(
+            retry_after_s=float(payload.get("retry_after_s", 1.0) or 1.0),
+            reason=str(payload.get("reason", "")),
+        )
+    return None
+
+
 def _iter_until_closed(request_iterator):
     """Drain a server-side request stream, treating client cancel/close
     (grpc.RpcError mid-iteration) as normal end-of-stream."""
@@ -230,6 +341,7 @@ class SchedulerGRPCServer:
         import queue
         import threading
 
+        from ..scheduler.sharding import ShardSaturatedError, WrongShardError
         from ..utils.tracing import TRACEPARENT_HEADER, default_tracer
         from .metrics import GRPC_REQUESTS_TOTAL
         from .scheduler_server import schedule_to_wire
@@ -323,6 +435,28 @@ class SchedulerGRPCServer:
                             service="scheduler", method=f"stream/{method}",
                             code="NOT_FOUND",
                         )
+                    except (WrongShardError, ShardSaturatedError) as exc:
+                        # Steering parity on the bidi wire: the typed
+                        # payload rides the response error field (streams
+                        # have no per-message trailers) and the client
+                        # re-raises the SAME exception the HTTP wire
+                        # would (§24/§25).
+                        from ..utils.dferrors import Code
+
+                        resp.error = _steering_error_to_stream(exc)
+                        resp.code = int(
+                            Code.FAILED_PRECONDITION
+                            if isinstance(exc, WrongShardError)
+                            else Code.RESOURCE_EXHAUSTED
+                        )
+                        GRPC_REQUESTS_TOTAL.inc(
+                            service="scheduler", method=f"stream/{method}",
+                            code=(
+                                "FAILED_PRECONDITION"
+                                if isinstance(exc, WrongShardError)
+                                else "RESOURCE_EXHAUSTED"
+                            ),
+                        )
                     except Exception as exc:  # noqa: BLE001 — wire boundary
                         resp.error, resp.code = str(exc), 0
                         GRPC_REQUESTS_TOTAL.inc(
@@ -381,6 +515,11 @@ class SchedulerGRPCServer:
                 req = proto_to_dict(request)
                 if method == "sync_probes_finished":
                     req = _from_wire_probe_results(req)
+                from ..scheduler.sharding import (
+                    ShardSaturatedError,
+                    WrongShardError,
+                )
+
                 try:
                     # otelgrpc server-interceptor analog: handler span
                     # linked into the caller's trace.
@@ -391,6 +530,22 @@ class SchedulerGRPCServer:
                 except KeyError as exc:
                     count("NOT_FOUND")
                     context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+                except WrongShardError as exc:
+                    # Steering parity with the HTTP 421 answer (§24): a
+                    # typed status + trailing metadata carrying the
+                    # owner hint, so the client re-announces there.
+                    count("FAILED_PRECONDITION")
+                    context.set_trailing_metadata(_steering_trailers(exc))
+                    context.abort(
+                        grpc.StatusCode.FAILED_PRECONDITION, "wrong_shard"
+                    )
+                except ShardSaturatedError as exc:
+                    # Load shed parity with HTTP 503 + Retry-After.
+                    count("RESOURCE_EXHAUSTED")
+                    context.set_trailing_metadata(_steering_trailers(exc))
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED, "shard_saturated"
+                    )
                 except (ValueError, TypeError) as exc:
                     count("INVALID_ARGUMENT")
                     context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
@@ -465,6 +620,15 @@ class GRPCRemoteScheduler(RemoteScheduler):
                 )
             except grpc.RpcError as exc:
                 code = exc.code()
+                # Steering answers surface as their typed exceptions on
+                # BOTH transports (§24/§25): the ShardRouter acts on
+                # them identically, never knowing which wire it rode.
+                steering = _steering_error_from_metadata(
+                    exc.trailing_metadata()
+                    if hasattr(exc, "trailing_metadata") else None
+                )
+                if steering is not None:
+                    raise steering from exc
                 if code in (
                     grpc.StatusCode.UNAVAILABLE,
                     grpc.StatusCode.DEADLINE_EXCEEDED,
@@ -658,6 +822,9 @@ class GRPCStreamingScheduler(GRPCRemoteScheduler):
                 self._pushed.pop(req.get("peer_id", ""), None)
         resp = slot[0]
         if resp.error:
+            steering = _steering_error_from_stream(resp.error)
+            if steering is not None:
+                raise steering
             raise RPCError(f"{method}: {resp.error}", code=resp.code)
         body = resp.WhichOneof("body")
         return proto_to_dict(getattr(resp, body)) if body else {}

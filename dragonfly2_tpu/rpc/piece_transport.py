@@ -191,30 +191,28 @@ class PieceHTTPServer:
                         self._send(200, bytes(bm))
                         return
                     if len(parts) == 2 and parts[0] == "tasks":
+                        from ..utils.httprange import (
+                            RangeNotSatisfiable,
+                            parse_range,
+                        )
+
                         task_id = parts[1]
-                        rng = self.headers.get("Range", "")
-                        if not rng.startswith("bytes="):
-                            self.send_error(416)
-                            return
                         total = upload_ref.storage.engine.content_length(task_id)
-                        spec = rng[len("bytes=") :]
+                        # Shared RFC-7233 parser (utils/httprange) keeps
+                        # this endpoint byte-identical with the proxy and
+                        # the gateway; a task endpoint without a servable
+                        # range has nothing to answer → 416 (its read IS
+                        # the range read).
                         try:
-                            start_s, end_s = spec.split("-", 1)
-                            if start_s == "":      # suffix: bytes=-N
-                                length = int(end_s)
-                                start, end = max(total - length, 0), total - 1
-                            elif end_s == "":      # open end: bytes=S-
-                                start, end = int(start_s), total - 1
-                            else:
-                                start, end = int(start_s), int(end_s)
-                        except ValueError:
+                            span_rng = parse_range(
+                                self.headers.get("Range", ""), total
+                            )
+                        except RangeNotSatisfiable:
+                            span_rng = None
+                        if span_rng is None:
                             self.send_error(416)
                             return
-                        if total >= 0:
-                            end = min(end, total - 1)
-                        if start > end:
-                            self.send_error(416)
-                            return
+                        start, end = span_rng
                         if sendfile_ok:
                             span = upload_ref.range_sendfile_span(
                                 task_id, start, end - start + 1

@@ -1,0 +1,61 @@
+"""Subprocess body for the mid-tee SIGKILL drill
+(tests/test_stream_tee.py).
+
+A wire daemon that opens a PASS-THROUGH stream (open_stream → tee
+consumer) of a task it is downloading from the parent's piece server,
+and consumes the chunks slowly.  The parent test installs a ``crash``
+FaultSpec on the ``daemon.stream.tee`` seam (DF_FAULTINJECT), so the
+process SIGKILLs itself ON THE COMMITTER THREAD, mid-publish,
+mid-download, mid-serve — the worst interleaving the tee can die in.
+The parent then proves the durable plane is untouched: a fresh
+conductor over the same store resumes the download, completes, and the
+reassembled bytes digest-check against the origin.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from dragonfly2_tpu.utils import faultinject  # noqa: E402
+
+
+def main():
+    scheduler_url, store_dir, url = sys.argv[1:4]
+    content_length, piece_size = int(sys.argv[4]), int(sys.argv[5])
+    faultinject.install_from_env()
+
+    from dragonfly2_tpu.daemon import DaemonStorage
+    from dragonfly2_tpu.daemon.conductor import Conductor
+    from dragonfly2_tpu.rpc import HTTPPieceFetcher, RemoteScheduler
+    from dragonfly2_tpu.scheduler.resource import Host
+
+    host = Host(
+        id="stream-child", hostname="stream-child", ip="127.0.0.1",
+        port=8002, download_port=1,
+    )
+    host.stats.network.idc = "idc-a"
+    client = RemoteScheduler(scheduler_url, timeout=5.0)
+    storage = DaemonStorage(store_dir, prefer_native=False)
+    conductor = Conductor(
+        host, storage, client,
+        piece_fetcher=HTTPPieceFetcher(client.resolve_host, timeout=5.0),
+        source_fetcher=None,
+        piece_parallelism=1,  # strictly sequential: the kill lands mid-task
+    )
+    print("stream-child: ready", flush=True)
+    handle = conductor.open_stream(
+        url, piece_size=piece_size, content_length=content_length
+    )
+    got = 0
+    for chunk in handle.chunks():
+        got += len(chunk)
+    # Reaching here means the crash fault never fired (drill failure —
+    # the parent asserts this line is absent).
+    print(json.dumps({"ok": True, "bytes": got}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -517,3 +517,195 @@ class TestBenchSwarmSmoke:
         assert data["ok"] is True
         assert data["membership_drill"]["ran"] is True
         assert data["arms"]["sharded"]["downloads_failed"] == 0
+
+
+class TestShardWireGRPCParity:
+    """ISSUE 14 satellite: the steering answers on the gRPC wire.
+
+    The HTTP wire carries wrong-shard as 421 + owner hints and shed as
+    503 + Retry-After; the gRPC wire maps the SAME typed errors onto
+    FAILED_PRECONDITION / RESOURCE_EXHAUSTED with trailing metadata
+    (``df-owner-id`` / ``df-owner-url`` / ``df-ring-version``,
+    ``retry-after``) — and on the bidi stream, onto the response error
+    field — so a client raises the identical exception on either
+    transport and the ShardRouter follows both without knowing which
+    wire it rides.
+    """
+
+    def _grpc_server(self, guard):
+        from dragonfly2_tpu.rpc.grpc_transport import SchedulerGRPCServer
+
+        service = _service(guard)
+        server = SchedulerGRPCServer(service)
+        server.serve()
+        return service, server
+
+    def _owned_by(self, ring, shard_id):
+        from dragonfly2_tpu.utils import idgen
+
+        return next(
+            f"https://origin/g{i}" for i in range(400)
+            if ring.owner(idgen.task_id(f"https://origin/g{i}")) == shard_id
+        )
+
+    def test_unary_wrong_shard_is_typed_with_owner_hint(self):
+        from dragonfly2_tpu.rpc.grpc_transport import GRPCRemoteScheduler
+
+        ring = _ring(2, version=4)
+        guard = ShardGuard("s0")
+        service, server = self._grpc_server(guard)
+        guard.update_ring(ring)
+        try:
+            client = GRPCRemoteScheduler(server.target, timeout=5.0)
+            url = self._owned_by(ring, "s1")
+            client.announce_host(_host(11))
+            with pytest.raises(WrongShardError) as exc:
+                client.register_peer(host=_host(11), url=url)
+            assert exc.value.owner_id == "s1"
+            assert exc.value.owner_url == "http://s1:8002"
+            assert exc.value.ring_version == 4
+            client.close()
+        finally:
+            server.stop()
+
+    def test_stream_wrong_shard_is_typed(self):
+        """register_peer rides the bidi announce stream on the streaming
+        client — the steering payload must survive that wire too."""
+        from dragonfly2_tpu.rpc.grpc_transport import GRPCStreamingScheduler
+
+        ring = _ring(2, version=7)
+        guard = ShardGuard("s0")
+        service, server = self._grpc_server(guard)
+        guard.update_ring(ring)
+        try:
+            client = GRPCStreamingScheduler(server.target, timeout=5.0)
+            url = self._owned_by(ring, "s1")
+            client.announce_host(_host(12))
+            with pytest.raises(WrongShardError) as exc:
+                client.register_peer(host=_host(12), url=url)
+            assert exc.value.owner_id == "s1"
+            assert exc.value.owner_url == "http://s1:8002"
+            assert exc.value.ring_version == 7
+            client.close()
+        finally:
+            server.stop()
+
+    def test_unary_saturated_carries_retry_after(self):
+        from dragonfly2_tpu.rpc.grpc_transport import GRPCRemoteScheduler
+
+        ctl = AdmissionController(max_inflight=4, p99_budget_s=0.001)
+        for _ in range(64):
+            ctl.observe(1.0)
+        guard = ShardGuard("s0", admission=ctl)
+        service, server = self._grpc_server(guard)
+        try:
+            client = GRPCRemoteScheduler(server.target, timeout=5.0)
+            client.announce_host(_host(13))
+            with pytest.raises(ShardSaturatedError) as exc:
+                client.register_peer(
+                    host=_host(13), url="https://origin/g-shed",
+                    priority=Priority.LEVEL6,
+                )
+            assert exc.value.retry_after_s > 0
+            assert exc.value.reason
+            client.close()
+        finally:
+            server.stop()
+
+    def test_router_follows_grpc_steering_like_http(self):
+        """A ShardRouter with a STALE ring routes to the wrong shard over
+        gRPC, follows the trailing-metadata owner hint, and lands the
+        register on the true owner — the exact walk the HTTP tests
+        prove, transport swapped."""
+        from dragonfly2_tpu.rpc.grpc_transport import GRPCRemoteScheduler
+        from dragonfly2_tpu.rpc.resolver import ShardRouter
+        from dragonfly2_tpu.utils import idgen
+
+        guard0, guard1 = ShardGuard("s0"), ShardGuard("s1")
+        service0, server0 = self._grpc_server(guard0)
+        service1, server1 = self._grpc_server(guard1)
+        clients = []
+
+        def factory(url):
+            c = GRPCRemoteScheduler(url[len("grpc://"):], timeout=5.0)
+            clients.append(c)
+            return c
+
+        try:
+            live = ShardRing(
+                {"s0": f"grpc://{server0.target}",
+                 "s1": f"grpc://{server1.target}"},
+                version=2,
+            )
+            guard0.update_ring(live)
+            guard1.update_ring(live)
+            url = self._owned_by(live, "s1")
+            task_id = idgen.task_id(url)
+            router = ShardRouter(factory=factory)
+            # Stale client view: only s0 exists → the first route is
+            # wrong and the steering hint must carry the call to s1.
+            router.update_ring(
+                ShardRing({"s0": f"grpc://{server0.target}"}, version=1)
+            )
+            host = _host(14)
+            reg = router.call(
+                task_id,
+                lambda c: (
+                    c.announce_host(host),
+                    c.register_peer(host=host, url=url, task_id=task_id),
+                )[1],
+            )
+            assert reg.peer is not None
+            # The register landed on the true owner, not the stale route.
+            assert len(service1.resource.peer_manager) == 1
+            assert len(service0.resource.peer_manager) == 0
+        finally:
+            for c in clients:
+                c.close()
+            server0.stop()
+            server1.stop()
+
+    def test_router_honors_grpc_retry_after_once(self):
+        from dragonfly2_tpu.rpc.grpc_transport import GRPCRemoteScheduler
+        from dragonfly2_tpu.rpc.resolver import ShardRouter
+        from dragonfly2_tpu.utils import idgen
+
+        ctl = AdmissionController(
+            max_inflight=4, p99_budget_s=0.001, retry_after_s=0.05
+        )
+        for _ in range(64):
+            ctl.observe(1.0)
+        guard = ShardGuard("s0", admission=ctl)
+        service, server = self._grpc_server(guard)
+        clients = []
+
+        def factory(url):
+            c = GRPCRemoteScheduler(url[len("grpc://"):], timeout=5.0)
+            clients.append(c)
+            return c
+
+        try:
+            router = ShardRouter(factory=factory)
+            router.update_ring(
+                ShardRing({"s0": f"grpc://{server.target}"}, version=1)
+            )
+            host = _host(15)
+            url = "https://origin/g-burn"
+            t0 = time.monotonic()
+            with pytest.raises(ShardSaturatedError):
+                router.call(
+                    idgen.task_id(url),
+                    lambda c: (
+                        c.announce_host(host),
+                        c.register_peer(
+                            host=host, url=url, priority=Priority.LEVEL6
+                        ),
+                    )[1],
+                )
+            # One Retry-After honored (≥ the server's 0.05 s pacing),
+            # then the typed error propagated to the caller.
+            assert time.monotonic() - t0 >= 0.05
+        finally:
+            for c in clients:
+                c.close()
+            server.stop()

@@ -1,0 +1,680 @@
+"""Pass-through streaming tests (ISSUE 14, DESIGN.md §25): the commit
+tee's refcount/spill lifecycle, the zero-disk-read witness on live
+streams, ranged task streams with range-priority piece ordering, the
+RFC-7233 conformance sweep proved byte-identical across the upload
+server / proxy / gateway, and the mid-tee SIGKILL drill."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from dragonfly2_tpu.daemon.piece_pipeline import (  # noqa: E402
+    CommitTee,
+    RefCountedBuffer,
+)
+from dragonfly2_tpu.utils import faultinject  # noqa: E402
+from dragonfly2_tpu.utils.faultinject import FaultInjector, FaultSpec  # noqa: E402
+from dragonfly2_tpu.utils.httprange import (  # noqa: E402
+    RangeNotSatisfiable,
+    parse_range,
+)
+
+from tests.test_daemon import PIECE, _Swarm  # noqa: E402
+
+
+def _count_engine_reads(storage):
+    """Wrap the engine's read_piece with a counter — the zero-disk-read
+    witness (serve-plane reads are the ONLY callers during a stream)."""
+    counts = {"n": 0}
+    orig = storage.engine.read_piece
+
+    def counting(*a, **kw):
+        counts["n"] += 1
+        return orig(*a, **kw)
+
+    storage.engine.read_piece = counting
+    return counts
+
+
+def _slow_fetcher(daemon, delay_s=0.05):
+    inner = daemon.conductor.piece_fetcher
+
+    class SlowFetcher:
+        def fetch(self, host_id, task_id, number):
+            time.sleep(delay_s)
+            return inner.fetch(host_id, task_id, number)
+
+        def piece_bitmap(self, host_id, task_id):
+            return inner.piece_bitmap(host_id, task_id)
+
+        def wait_piece_bitmap(self, *a, **kw):
+            wait = getattr(inner, "wait_piece_bitmap", None)
+            return wait(*a, **kw) if wait else None
+
+    daemon.conductor.piece_fetcher = SlowFetcher()
+
+
+def _seed(swarm, url, n_pieces):
+    r = swarm.daemons[0].download(
+        url, piece_size=PIECE, content_length=n_pieces * PIECE
+    )
+    assert r.ok and r.pieces == n_pieces
+    return r.task_id
+
+
+def _expected(swarm, url, n_pieces):
+    return b"".join(swarm.origin.content(url, n) for n in range(n_pieces))
+
+
+class TestRangeParser:
+    TOTAL = 1000
+
+    @pytest.mark.parametrize("header,want", [
+        ("bytes=0-999", (0, 999)),          # whole representation
+        ("bytes=0-99", (0, 99)),            # head
+        ("bytes=200-299", (200, 299)),      # middle
+        ("bytes=950-", (950, 999)),         # open-ended
+        ("bytes=-100", (900, 999)),         # suffix
+        ("bytes=-5000", (0, 999)),          # suffix > total clamps to all
+        ("bytes=999-999", (999, 999)),      # last byte
+        ("bytes=0-5000", (0, 999)),         # end clamps to total-1
+    ])
+    def test_satisfiable_shapes(self, header, want):
+        assert parse_range(header, self.TOTAL) == want
+
+    @pytest.mark.parametrize("header", [
+        None, "", "items=0-5", "bytes=", "bytes=abc-def",
+        "bytes=5-2",                 # inverted → RFC says ignore
+        "bytes=0-10,20-30",          # multi-range → ignore (single only)
+        "bytes=--5",
+    ])
+    def test_ignorable_headers_serve_full_body(self, header):
+        assert parse_range(header, self.TOTAL) is None
+
+    @pytest.mark.parametrize("header", [
+        "bytes=1000-", "bytes=1000-1005", "bytes=99999-", "bytes=-0",
+    ])
+    def test_unsatisfiable_raises_416(self, header):
+        with pytest.raises(RangeNotSatisfiable) as exc:
+            parse_range(header, self.TOTAL)
+        assert exc.value.total == self.TOTAL
+
+    def test_zero_length_representation_has_no_ranges(self):
+        with pytest.raises(RangeNotSatisfiable):
+            parse_range("bytes=0-", 0)
+        with pytest.raises(RangeNotSatisfiable):
+            parse_range("bytes=-5", 0)
+
+
+class TestCommitTeeUnit:
+    def test_publish_take_releases_refcounted_buffer(self):
+        tee = CommitTee()
+        c1 = tee.register(depth=4)
+        c2 = tee.register(depth=4)
+        body = b"piece-0" * 100
+        assert tee.publish(0, body) == 2
+        # Both consumers hold one ref on the SAME buffer.
+        buf = c1._buffered[0]
+        assert buf is c2._buffered[0]
+        assert buf.refs == 2 and buf.data == body
+        assert c1.take(0) == body
+        assert buf.refs == 1
+        assert c2.take(0) == body
+        # Last release frees the bytes.
+        assert buf.refs == 0 and buf.data is None
+        # Re-take → None (fall back to disk).
+        assert c1.take(0) is None
+
+    def test_depth_bound_spills_never_blocks(self):
+        tee = CommitTee()
+        c = tee.register(depth=2)
+        assert tee.publish(0, b"a") == 1
+        assert tee.publish(1, b"b") == 1
+        t0 = time.monotonic()
+        assert tee.publish(2, b"c") == 0  # full → spill, instantly
+        assert time.monotonic() - t0 < 0.5
+        assert c.spilled == 1 and c.delivered == 2
+        assert c.take(2) is None          # spilled piece: disk path
+        assert c.take(0) == b"a"
+        assert tee.publish(3, b"d") == 1  # space freed → delivered again
+
+    def test_closed_consumer_is_skipped_and_buffers_released(self):
+        tee = CommitTee()
+        c = tee.register(depth=4)
+        tee.publish(0, b"x")
+        buf = c._buffered[0]
+        c.close()
+        assert buf.refs == 0 and buf.data is None
+        assert tee.consumer_count() == 0
+        assert tee.publish(1, b"y") == 0  # no consumers → no-op
+        assert c.take(1) is None
+        c.close()  # idempotent
+
+    def test_no_consumers_is_a_cheap_noop(self):
+        tee = CommitTee()
+        assert tee.publish(0, b"x") == 0
+        assert tee.published == 0
+
+    def test_injected_tee_fault_degrades_not_raises(self):
+        """A drop on daemon.stream.tee models failed delivery: publish
+        absorbs it (consumers go to disk), the commit path never sees
+        an exception."""
+        tee = CommitTee()
+        c = tee.register(depth=4)
+        inj = FaultInjector(
+            [FaultSpec(site="daemon.stream.tee", kind="drop", at=(0,))]
+        )
+        with faultinject.installed(inj):
+            assert tee.publish(0, b"x") == 0   # faulted → spill-for-all
+            assert tee.publish(1, b"y") == 1   # next publish delivers
+        assert c.take(0) is None
+        assert c.take(1) == b"y"
+
+    def test_injected_spill_fault_is_absorbed(self):
+        tee = CommitTee()
+        tee.register(depth=1)
+        inj = FaultInjector(
+            [FaultSpec(site="daemon.stream.spill", kind="drop", every=1)]
+        )
+        with faultinject.installed(inj):
+            tee.publish(0, b"a")
+            assert tee.publish(1, b"b") == 0  # spill + injected drop → absorbed
+
+    def test_refcounted_buffer_zero_refs_frees_immediately(self):
+        buf = RefCountedBuffer(0, b"data", 0)
+        assert buf.data is None
+
+
+class TestStreamTeeE2E:
+    def test_zero_disk_reads_on_fast_path(self, tmp_path):
+        """The tentpole witness: a consumer registered before the
+        download starts serves EVERY piece from the tee — the engine
+        sees zero reads, and the bytes digest-check against origin."""
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        url = "https://origin/tee-zero-read"
+        n_pieces = 6
+        _seed(swarm, url, n_pieces)
+        child = swarm.daemons[2]
+        child.conductor.piece_parallelism = 1
+        _slow_fetcher(child, 0.02)
+        reads = _count_engine_reads(child.storage)
+        handle = child.open_stream(url, piece_size=PIECE)
+        body = handle.read_all()
+        assert body == _expected(swarm, url, n_pieces)
+        assert handle.tee_hits == n_pieces
+        assert handle.disk_reads == 0
+        assert reads["n"] == 0, "fast path touched the disk"
+        assert handle.wait_result(timeout_s=10).ok
+
+    def test_slow_consumer_spills_and_stays_correct(self, tmp_path):
+        """A stalled reader cannot wedge the download: its tee buffer
+        bounds, overflow spills to disk, bytes stay identical."""
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        url = "https://origin/tee-slow-consumer"
+        n_pieces = 8
+        tid = _seed(swarm, url, n_pieces)
+        child = swarm.daemons[2]
+        child.conductor.stream_tee_depth = 1  # tiny window → spills
+        handle = child.open_stream(url, piece_size=PIECE)
+        # Let the (loopback-fast) download finish while we stall.
+        run = child.conductor.active_run(tid)
+        if run is not None:
+            assert run.wait_done(30.0) is not None
+        body = handle.read_all()
+        assert body == _expected(swarm, url, n_pieces)
+        # The depth-1 window forced disk reads for the overflow…
+        assert handle.disk_reads > 0
+        # …and the download itself completed untouched.
+        assert child.storage.held_pieces(tid) == n_pieces
+
+    def test_consumer_disconnect_mid_download_releases_tee(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        url = "https://origin/tee-disconnect"
+        n_pieces = 6
+        tid = _seed(swarm, url, n_pieces)
+        child = swarm.daemons[2]
+        child.conductor.piece_parallelism = 1
+        _slow_fetcher(child, 0.03)
+        handle = child.open_stream(url, piece_size=PIECE)
+        run = child.conductor.active_run(tid)
+        assert run is not None
+        chunks = handle.chunks()
+        first = next(chunks)
+        assert first == swarm.origin.content(url, 0)
+        chunks.close()  # client hung up mid-response
+        # The consumer detached (no pinned buffers, no more offers)…
+        assert run.tee.consumer_count() == 0
+        # …and the download still completes and digest-checks.
+        result = run.wait_done(30.0)
+        assert result is not None and result.ok
+        assert child.read_task_bytes(tid) == _expected(swarm, url, n_pieces)
+
+    def test_two_consumers_share_refcounted_buffers(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        url = "https://origin/tee-two-consumers"
+        n_pieces = 5
+        _seed(swarm, url, n_pieces)
+        child = swarm.daemons[2]
+        child.conductor.piece_parallelism = 1
+        _slow_fetcher(child, 0.02)
+        h1 = child.open_stream(url, piece_size=PIECE)
+        h2 = child.open_stream(url, piece_size=PIECE)
+        out = {}
+
+        def drain(name, h):
+            out[name] = h.read_all()
+
+        threads = [
+            threading.Thread(target=drain, args=("a", h1), daemon=True),
+            threading.Thread(target=drain, args=("b", h2), daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        expected = _expected(swarm, url, n_pieces)
+        assert out["a"] == expected and out["b"] == expected
+        # Both rode the tee (second attaches to the running task).
+        assert h1.tee_hits + h2.tee_hits >= n_pieces
+
+    def test_reuse_handle_serves_from_disk(self, tmp_path):
+        """Cache-hit replay is the DOCUMENTED disk path: a completed
+        task's stream has no run and no consumer."""
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        url = "https://origin/tee-reuse"
+        n_pieces = 3
+        _seed(swarm, url, n_pieces)
+        handle = swarm.daemons[0].open_stream(url, piece_size=PIECE)
+        assert handle.reused
+        assert handle.read_all() == _expected(swarm, url, n_pieces)
+        assert handle.tee_hits == 0 and handle.disk_reads == n_pieces
+
+
+class TestRangedStreams:
+    def test_ranged_stream_yields_exact_window(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        url = "https://origin/range-window"
+        n_pieces = 6
+        _seed(swarm, url, n_pieces)
+        expected = _expected(swarm, url, n_pieces)
+        child = swarm.daemons[2]
+        # Straddles pieces 1-3, odd offsets.
+        start, length = PIECE + 17, 2 * PIECE + 100
+        handle = child.open_stream(
+            url, piece_size=PIECE, start=start, length=length
+        )
+        body = handle.read_all()
+        assert body == expected[start : start + length]
+        # Only the overlapping pieces were served.
+        assert handle.tee_hits + handle.disk_reads == 3
+
+    def test_range_priority_orders_window_pieces_first(self, tmp_path):
+        """The scheduling half: a tail range's pieces commit BEFORE the
+        rest of the task (range-priority ordering in the piece pull)."""
+        swarm = _Swarm(tmp_path, n_hosts=3)
+        url = "https://origin/range-priority"
+        n_pieces = 8
+        _seed(swarm, url, n_pieces)
+        child = swarm.daemons[2]
+        child.conductor.piece_parallelism = 1
+        _slow_fetcher(child, 0.02)
+        committed = []
+        orig_write = child.storage.write_piece
+
+        def recording_write(task_id, number, data):
+            committed.append(number)
+            return orig_write(task_id, number, data)
+
+        child.storage.write_piece = recording_write
+        start = 6 * PIECE + 10  # pieces 6..7
+        handle = child.open_stream(
+            url, piece_size=PIECE, start=start, length=None
+        )
+        body = handle.read_all()
+        assert body == _expected(swarm, url, n_pieces)[start:]
+        assert handle.wait_result(timeout_s=10).ok
+        # The window pieces {6, 7} were fetched before everything else.
+        assert set(committed[:2]) == {6, 7}, committed
+
+    def test_ranged_stream_of_completed_task(self, tmp_path):
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        url = "https://origin/range-reuse"
+        n_pieces = 4
+        _seed(swarm, url, n_pieces)
+        expected = _expected(swarm, url, n_pieces)
+        handle = swarm.daemons[0].open_stream(
+            url, piece_size=PIECE, start=PIECE - 5, length=10
+        )
+        assert handle.read_all() == expected[PIECE - 5 : PIECE + 5]
+
+
+class TestRangeConformance:
+    """The satellite sweep: every RFC-7233 shape byte-identical across
+    the three range-serving surfaces — the upload piece server's
+    ``/tasks/<id>`` endpoint, the dfdaemon proxy, and the object
+    gateway — all fed by the same content."""
+
+    SHAPES = [
+        "bytes=0-99",                        # head
+        "bytes={p}-{p2}",                    # exactly one piece
+        "bytes={pm50}-{pp49}",               # straddles a piece boundary
+        "bytes={tail}-",                     # open-ended
+        "bytes=-100",                        # suffix
+        "bytes={last}-{last}",               # single last byte
+        "bytes=0-{huge}",                    # end past EOF clamps
+    ]
+
+    def _shapes(self, total):
+        p = PIECE
+        subs = dict(
+            p=p, p2=2 * p - 1, pm50=p - 50, pp49=p + 49,
+            tail=total - 77, last=total - 1, huge=total * 10,
+        )
+        return [s.format(**subs) for s in self.SHAPES]
+
+    def _slice(self, blob, header):
+        rng = parse_range(header, len(blob))
+        assert rng is not None
+        return blob[rng[0] : rng[1] + 1]
+
+    def test_sweep_byte_identical_across_surfaces(self, tmp_path):
+        from dragonfly2_tpu.daemon.gateway import GatewayConfig, ObjectGateway
+        from dragonfly2_tpu.daemon.proxy import (
+            P2PProxy,
+            ProxyRouter,
+            ProxyRule,
+        )
+        from dragonfly2_tpu.objectstorage.backend import FilesystemBackend
+        from dragonfly2_tpu.rpc.piece_transport import PieceHTTPServer
+
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        d = swarm.daemons[0]
+        backend = FilesystemBackend(str(tmp_path / "objects"))
+        gw = ObjectGateway(d, backend, GatewayConfig(piece_size=PIECE))
+        blob = os.urandom(3 * PIECE + 123)
+        gw.put_object("sweep/blob.bin", blob)
+        total = len(blob)
+        task_id = gw._task_id("sweep/blob.bin")
+
+        upload_srv = PieceHTTPServer(d.upload)
+        upload_srv.serve()
+        # The proxy serves the gateway's dfstore:// task through the
+        # same conductor; route its url scheme into P2P.
+        proxy = P2PProxy(
+            d, ProxyRouter([ProxyRule.compile(r"^dfstore://")]),
+            piece_size=PIECE,
+        )
+        proxy.serve()
+        object_url = gw._object_url("sweep/blob.bin")
+        try:
+            for header in self._shapes(total):
+                want = self._slice(blob, header)
+                # 1) upload server /tasks/<id> (the piece plane's wire).
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{upload_srv.port}/tasks/{task_id}",
+                    headers={"Range": header},
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    assert resp.status == 206, header
+                    upload_body = resp.read()
+                # 2) proxy (pass-through streaming plane).
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{proxy.port}/{object_url}",
+                    headers={"Range": header},
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    assert resp.status == 206, header
+                    rng = parse_range(header, total)
+                    assert resp.headers["Content-Range"] == (
+                        f"bytes {rng[0]}-{rng[1]}/{total}"
+                    ), header
+                    proxy_body = resp.read()
+                # 3) gateway ranged read.
+                (s, e, t), chunks = gw.get_object_range(
+                    "sweep/blob.bin", header
+                )
+                gw_body = b"".join(chunks)
+                assert (s, e, t) == (rng[0], rng[1], total), header
+                assert upload_body == proxy_body == gw_body == want, header
+
+            # 416 parity: past-EOF start answers 416 on every surface.
+            bad = f"bytes={total + 5}-"
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{upload_srv.port}/tasks/{task_id}",
+                headers={"Range": bad},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 416
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{proxy.port}/{object_url}",
+                headers={"Range": bad},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 416
+            assert exc.value.headers["Content-Range"] == f"bytes */{total}"
+            with pytest.raises(RangeNotSatisfiable):
+                gw.get_object_range("sweep/blob.bin", bad)
+        finally:
+            proxy.stop()
+            upload_srv.stop()
+
+    def test_proxy_malformed_range_serves_full_200(self, tmp_path):
+        from dragonfly2_tpu.daemon.gateway import GatewayConfig, ObjectGateway
+        from dragonfly2_tpu.daemon.proxy import (
+            P2PProxy,
+            ProxyRouter,
+            ProxyRule,
+        )
+        from dragonfly2_tpu.objectstorage.backend import FilesystemBackend
+
+        swarm = _Swarm(tmp_path, n_hosts=2)
+        d = swarm.daemons[0]
+        backend = FilesystemBackend(str(tmp_path / "objects"))
+        gw = ObjectGateway(d, backend, GatewayConfig(piece_size=PIECE))
+        blob = os.urandom(PIECE + 17)
+        gw.put_object("sweep/full.bin", blob)
+        proxy = P2PProxy(
+            d, ProxyRouter([ProxyRule.compile(r"^dfstore://")]),
+            piece_size=PIECE,
+        )
+        proxy.serve()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{proxy.port}/"
+                f"{gw._object_url('sweep/full.bin')}",
+                headers={"Range": "bytes=9-2"},  # inverted → RFC: ignore
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.read() == blob
+        finally:
+            proxy.stop()
+
+
+class TestStreamChaosKill:
+    def test_sigkill_mid_tee_leaves_durable_plane_resumable(self, tmp_path):
+        """SIGKILL on the committer thread INSIDE a tee publish (the
+        daemon.stream.tee crash seam): the child dies mid-download,
+        mid-serve — then a fresh conductor over the same store resumes,
+        completes, and digest-checks.  The tee can die at its worst
+        moment without corrupting the durable plane."""
+        import numpy as np
+
+        from dragonfly2_tpu.daemon import DaemonStorage, UploadManager
+        from dragonfly2_tpu.daemon.conductor import Conductor
+        from dragonfly2_tpu.records.storage import Storage
+        from dragonfly2_tpu.rpc import HTTPPieceFetcher, RemoteScheduler
+        from dragonfly2_tpu.rpc.piece_transport import PieceHTTPServer
+        from dragonfly2_tpu.rpc.scheduler_server import SchedulerHTTPServer
+        from dragonfly2_tpu.scheduler import (
+            Evaluator,
+            NetworkTopology,
+            Resource,
+            SchedulerService,
+            Scheduling,
+            SchedulingConfig,
+        )
+        from dragonfly2_tpu.scheduler.resource import Host
+
+        n_pieces = 6
+        content_length = n_pieces * PIECE
+        url = "https://origin/tee-kill-blob"
+        rng = np.random.default_rng(5)
+        pieces = [
+            rng.integers(0, 256, PIECE, dtype=np.uint8).tobytes()
+            for _ in range(n_pieces)
+        ]
+
+        resource = Resource()
+        service = SchedulerService(
+            resource,
+            Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+            Storage(str(tmp_path / "records"), buffer_size=8),
+            NetworkTopology(resource.host_manager),
+        )
+        server = SchedulerHTTPServer(service)
+        server.serve()
+
+        # Warm wire parent holding every piece.
+        pstore = DaemonStorage(str(tmp_path / "parent"), prefer_native=False)
+        pstore.register_task(
+            "ignored", piece_size=PIECE, content_length=content_length
+        )
+        piece_server = PieceHTTPServer(UploadManager(pstore))
+        piece_server.serve()
+        phost = Host(
+            id="tee-parent", hostname="tee-parent", ip="127.0.0.1",
+            port=8002, download_port=piece_server.port,
+        )
+        phost.stats.network.idc = "idc-a"
+        pclient = RemoteScheduler(server.url, timeout=5.0)
+
+        class _Origin:
+            def fetch(self, u, number, piece_size):
+                return pieces[number]
+
+        parent = Conductor(
+            phost, pstore, pclient,
+            piece_fetcher=HTTPPieceFetcher(pclient.resolve_host),
+            source_fetcher=_Origin(),
+        )
+        warm = parent.download(
+            url, piece_size=PIECE, content_length=content_length
+        )
+        assert warm.ok and warm.pieces == n_pieces
+
+        child_store = str(tmp_path / "childstore")
+        scenario = {
+            "seed": 0,
+            "faults": [
+                # The 3rd tee publish dies ON the committer thread.
+                FaultSpec(
+                    site="daemon.stream.tee", kind="crash", at=(2,)
+                ).to_dict(),
+                # Pace fetches so the kill lands mid-download.
+                FaultSpec(
+                    site="piece.fetch", kind="delay", every=1, delay_s=0.03
+                ).to_dict(),
+            ],
+        }
+        try:
+            proc = subprocess.Popen(
+                [
+                    sys.executable, str(REPO / "tests" / "_stream_child.py"),
+                    server.url, child_store, url,
+                    str(content_length), str(PIECE),
+                ],
+                env={
+                    **os.environ,
+                    "DF_FAULTINJECT": json.dumps(scenario),
+                    "JAX_PLATFORMS": "cpu",
+                    "DF_LOCK_WITNESS": "0",
+                },
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                cwd=str(REPO),
+            )
+            try:
+                out, err = proc.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, err = proc.communicate()
+                pytest.fail(f"child hung: {out!r} {err!r}")
+            assert proc.returncode == -signal.SIGKILL, (
+                proc.returncode, out, err,
+            )
+            assert b'"ok"' not in out, "child finished before the kill"
+
+            # Resume over the same store: the tee's death left the
+            # durable plane intact — a fresh conductor completes the
+            # task and every byte digest-checks.
+            storage2 = DaemonStorage(child_store, prefer_native=False)
+            loaded = storage2.reload_persistent_tasks(
+                storage2.scan_disk_tasks()
+            )
+            assert loaded, "no partial task survived the kill"
+            held_before = storage2.held_pieces(loaded[0])
+            assert 0 < held_before < n_pieces, (
+                f"kill landed outside the download ({held_before} pieces)"
+            )
+            client2 = RemoteScheduler(server.url, timeout=5.0)
+            chost = Host(
+                id="stream-child-2", hostname="stream-child-2",
+                ip="127.0.0.1", port=8002, download_port=1,
+            )
+            chost.stats.network.idc = "idc-a"
+            resumer = Conductor(
+                chost, storage2, client2,
+                piece_fetcher=HTTPPieceFetcher(
+                    client2.resolve_host, timeout=5.0
+                ),
+                source_fetcher=None,
+            )
+            r = resumer.download(
+                url, piece_size=PIECE, content_length=content_length
+            )
+            assert r.ok
+            assert storage2.read_task_bytes(r.task_id) == b"".join(pieces)
+        finally:
+            piece_server.stop()
+            server.stop()
+
+
+class TestBenchStreamSmoke:
+    def test_smoke_schema_gates_stream_scenario(self, capsys):
+        from tools import bench_download
+
+        rc = bench_download.main(["--smoke"])
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert rc == 0 and out["ok"], out
+        for arm in ("stream_disk", "stream_tee"):
+            assert arm in out["arms"]
+            for k in bench_download.ARM_KEYS:
+                assert k in out["arms"][arm], (arm, k)
+        assert "speedup_stream" in out
+        st = out["stream"]
+        for k in ("consumers", "disk_reads_tee", "disk_reads_disk",
+                  "tee_delivered", "tee_spilled"):
+            assert k in st, k
+        # The tee arm really rode the tee; the disk arm really paid the
+        # round-trip.
+        assert st["tee_delivered"] > 0
+        assert st["disk_reads_disk"] > st["disk_reads_tee"]

@@ -1,6 +1,6 @@
 """End-to-end download throughput benchmark: wall-clock MB/s through the
 REAL piece data plane (scheduler RPC over HTTP + piece servers on
-loopback sockets), single-peer and N-peer swarm.
+loopback sockets), single-peer, N-peer swarm, and pass-through stream.
 
 Two arms per scenario, measured in INTERLEAVED rounds (bench_sched.py
 discipline: one unmeasured warm round, GC quiesced, walls measured in
@@ -9,24 +9,42 @@ the downloading workers):
 - ``legacy``    — the pre-PR-11 path kept as the reference: one fresh
   urllib connection per piece, whole-piece buffered serve, strictly
   sequential fetch→digest→commit→report per worker;
-- ``pipelined`` — this PR's data plane: per-parent keep-alive connection
+- ``pipelined`` — the PR-11 data plane: per-parent keep-alive connection
   pool, ``os.sendfile`` zero-copy serve, commit pipeline (digest piece N
   while N+1 is on the wire) and bounded-linger batched piece reports.
+
+The **stream** scenario (DESIGN.md §25) measures the PASS-THROUGH shape:
+N HTTP clients consume one task through the dfdaemon proxy WHILE the
+P2P download runs.  Its two arms differ only in the read plane:
+
+- ``stream_disk`` — every piece a consumer serves is read back off the
+  disk it was committed to (the pre-tee path, crc-verified read);
+- ``stream_tee``  — consumers ride the commit tee: the committer hands
+  each verified body to all N consumers in memory, zero disk reads on
+  the fast path (the per-round disk-read counts are reported as
+  evidence).  Time-to-last-byte at the slowest consumer is the wall.
+
+``--engine native`` drives the pipelined/stream arms through the C++
+in-engine piece server (native.cpp ps_serve — no Python on the serve
+path); the legacy arm keeps the Python reference server, so the ratio
+stays "new plane vs pre-PR plane".
 
 Hedging is OFF in both arms (it is a tail-latency feature; a loopback
 bench would never trigger it and enabling it only on one arm would skew
 the comparison).
 
 Reports MB/s and p50/p99 per-piece fetch latency per arm, the
-``speedup_single`` / ``speedup_swarm`` ratios (acceptance bar:
-single ≥ 2×), pool reuse stats and server sendfile counts as evidence
-the fast arm really exercised the new plane, and a regression guard over
-``BENCH_DL_r*.json`` rounds at the repo root (bench.py's
-``apply_regression_guard`` applied to the download headline).
+``speedup_single`` / ``speedup_swarm`` / ``speedup_stream`` ratios
+(acceptance bars: single ≥ 2×, stream ≥ 1.5×), pool reuse stats and
+server sendfile counts as evidence the fast arm really exercised the
+new plane, and a regression guard over ``BENCH_DL_r*.json`` rounds at
+the repo root (bench.py's ``apply_regression_guard`` applied to the
+download headline).
 
 Usage: PYTHONPATH=/root/repo python tools/bench_download.py
        [--piece-mb 4] [--pieces 16] [--rounds 3] [--swarm 3]
-       [--parallelism 4] [--seed 0]
+       [--parallelism 4] [--stream-consumers 3] [--engine py|native]
+       [--seed 0]
        [--smoke]   # tiny sizes: the tier-1 JSON-schema gate
 """
 
@@ -57,8 +75,10 @@ SCHEMA_KEYS = (
     "arms",
     "speedup_single",
     "speedup_swarm",
+    "speedup_stream",
     "pool",
     "serve",
+    "stream",
 )
 
 ARM_KEYS = ("MBps", "p50_ms", "p99_ms", "pieces", "bytes", "wall_s")
@@ -110,6 +130,11 @@ class _Origin:
     def fetch(self, url: str, number: int, piece_size: int) -> bytes:
         return self.content(url, number)
 
+    def content_length(self, url: str) -> int:
+        # Length probe (conductor.probe_content_length): the proxy's
+        # ranged/streamed opens size the task before the swarm runs.
+        return self.piece_size * self.n_pieces
+
 
 class _TimingFetcher:
     """PieceFetcher wrapper recording per-piece fetch wall times."""
@@ -133,7 +158,12 @@ class _TimingFetcher:
 
 class _Node:
     """One bench 'machine': piece server + remote scheduler client +
-    conductor, configured as the legacy or the pipelined data plane."""
+    conductor, configured as the legacy or the pipelined data plane.
+
+    ``engine="native"`` runs the C++ piece store AND serves through the
+    in-engine HTTP server (no Python on the serve path); the Python
+    reference server stays on the legacy arm regardless.
+    """
 
     def __init__(
         self,
@@ -144,19 +174,41 @@ class _Node:
         *,
         pipelined: bool,
         parallelism: int,
+        engine: str = "py",
+        stream_tee_depth: int = 0,
     ) -> None:
         from dragonfly2_tpu.daemon import DaemonStorage, UploadManager
         from dragonfly2_tpu.daemon.conductor import Conductor
         from dragonfly2_tpu.rpc import HTTPPieceFetcher, RemoteScheduler
-        from dragonfly2_tpu.rpc.piece_transport import PieceHTTPServer
+        from dragonfly2_tpu.rpc.piece_transport import (
+            PieceHTTPServer,
+            make_piece_server,
+        )
         from dragonfly2_tpu.scheduler.resource import Host
 
+        native = engine == "native" and pipelined
         self.storage = DaemonStorage(
-            os.path.join(root, name), prefer_native=False
+            os.path.join(root, name), prefer_native=native
         )
+        if native and not self.storage.is_native:
+            raise RuntimeError("--engine native: C++ engine did not build")
         self.upload = UploadManager(self.storage, concurrent_limit=64)
-        self.server = PieceHTTPServer(self.upload, use_sendfile=pipelined)
+        if native:
+            self.server = make_piece_server(self.upload)
+        else:
+            self.server = PieceHTTPServer(self.upload, use_sendfile=pipelined)
         self.server.serve()
+        # Zero-disk-read evidence for the stream scenario: count engine
+        # piece reads (the tee arm's fast path must not take any).
+        self.piece_reads = 0
+        eng = self.storage.engine
+        orig_read = eng.read_piece
+
+        def counting_read(*a, **kw):
+            self.piece_reads += 1
+            return orig_read(*a, **kw)
+
+        eng.read_piece = counting_read
         self.host = Host(
             id=name, hostname=name, ip="127.0.0.1",
             download_port=self.server.port,
@@ -176,12 +228,24 @@ class _Node:
             pipeline_depth=4 if pipelined else 0,
             batch_reports=pipelined,
             hedge_enabled=False,
+            stream_tee_depth=stream_tee_depth,
         )
 
     def stop(self) -> None:
         self.server.stop()
         self.fetcher.inner.close()
         self.storage.close()
+
+
+class _StreamFacade:
+    """The slice of the Daemon surface P2PProxy drives (open_stream +
+    conductor) — the bench's edge node is a bare conductor."""
+
+    def __init__(self, conductor) -> None:
+        self.conductor = conductor
+
+    def open_stream(self, url: str, **kw):
+        return self.conductor.open_stream(url, **kw)
 
 
 def _summarize(nbytes: int, wall: float, latencies: List[float]) -> dict:
@@ -204,7 +268,11 @@ def run(
     swarm_n: int,
     parallelism: int,
     seed: int = 0,
+    *,
+    stream_consumers: int = 3,
+    engine: str = "py",
 ) -> dict:
+    from dragonfly2_tpu.daemon.proxy import P2PProxy, ProxyRouter, ProxyRule
     from dragonfly2_tpu.records.storage import Storage
     from dragonfly2_tpu.rpc.scheduler_server import SchedulerHTTPServer
     from dragonfly2_tpu.scheduler import (
@@ -238,20 +306,47 @@ def run(
         nodes[arm] = {
             "seed": _Node(
                 f"seed-{arm}", server.url, root, origin,
-                pipelined=pipelined, parallelism=parallelism,
+                pipelined=pipelined, parallelism=parallelism, engine=engine,
             ),
             "clients": [
                 _Node(
                     f"client-{arm}-{i}", server.url, root, origin,
                     pipelined=pipelined, parallelism=parallelism,
+                    engine=engine,
                 )
                 for i in range(swarm_n)
             ],
         }
 
+    # Pass-through stream plane (DESIGN.md §25): one shared seed, one
+    # EDGE node per arm (identical pipelined data plane; the arms differ
+    # ONLY in the read plane — tee vs disk round-trip) each fronted by a
+    # real dfdaemon proxy that N HTTP consumers drain concurrently.
+    stream_arms = ("stream_disk", "stream_tee")
+    stream_seed = _Node(
+        "stream-seed", server.url, root, origin,
+        pipelined=True, parallelism=parallelism, engine=engine,
+    )
+    stream_nodes: Dict[str, dict] = {}
+    for arm in stream_arms:
+        edge = _Node(
+            f"edge-{arm}", server.url, root, origin,
+            pipelined=True, parallelism=parallelism, engine=engine,
+            stream_tee_depth=8 if arm == "stream_tee" else 0,
+        )
+        proxy = P2PProxy(
+            _StreamFacade(edge.conductor),
+            ProxyRouter([ProxyRule.compile(r"^http://bench\.origin/")]),
+            piece_size=piece_size,
+        )
+        proxy.serve()
+        stream_nodes[arm] = {"edge": edge, "proxy": proxy}
+
     walls = {f"{arm}_{scen}": 0.0 for arm in arms for scen in ("single", "swarm")}
+    walls.update(dict.fromkeys(stream_arms, 0.0))
     nbytes = dict.fromkeys(walls, 0)
     lats: Dict[str, List[float]] = {k: [] for k in walls}
+    stream_disk_reads = dict.fromkeys(stream_arms, 0)
 
     def _seed_task(arm: str, url: str) -> None:
         r = nodes[arm]["seed"].conductor.download(
@@ -306,6 +401,70 @@ def run(
         walls[f"{arm}_swarm"] += wall
         nbytes[f"{arm}_swarm"] += total
 
+    def _measure_stream(arm: str, url: str, *, measured: bool) -> None:
+        """N concurrent HTTP consumers drain the task through the proxy
+        WHILE the edge node's P2P download runs; the arm's wall is the
+        slowest consumer's time-to-last-byte."""
+        import urllib.request
+        import zlib
+
+        edge = stream_nodes[arm]["edge"]
+        proxy = stream_nodes[arm]["proxy"]
+        reads_before = edge.piece_reads
+        ttlbs = [0.0] * stream_consumers
+        got = [0] * stream_consumers
+        crcs = [0] * stream_consumers
+        errors: List[str] = []
+
+        def consume(i: int) -> None:
+            t0 = time.perf_counter()
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{proxy.port}/{url}"
+                )
+                crc = 0
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    while True:
+                        chunk = resp.read(1 << 16)
+                        if not chunk:
+                            break
+                        got[i] += len(chunk)
+                        crc = zlib.crc32(chunk, crc)
+                crcs[i] = crc
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(f"consumer {i}: {exc}")
+            ttlbs[i] = time.perf_counter() - t0
+
+        threads = [
+            threading.Thread(target=consume, args=(i,), daemon=True)
+            for i in range(stream_consumers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors or any(g != content_length for g in got):
+            raise RuntimeError(
+                f"stream ({arm}) failed: {errors or got}"
+            )
+        # Digest discipline: every consumer must hand the client the
+        # ORIGIN's bytes (tee == disk == origin), every round.
+        expected_crc = 0
+        for n in range(n_pieces):
+            expected_crc = zlib.crc32(origin.content(url, n), expected_crc)
+        if any(c != expected_crc for c in crcs):
+            raise RuntimeError(f"stream ({arm}) served corrupted bytes")
+        edge_tid = edge.conductor._task_id(url, None)
+        r = edge.conductor.active_run(edge_tid)
+        if r is not None:
+            r.wait_done(30.0)
+        if measured:
+            walls[arm] += max(ttlbs)
+            nbytes[arm] += sum(got)
+            lats[arm].extend(ttlbs)
+            stream_disk_reads[arm] += edge.piece_reads - reads_before
+        edge.storage.delete_task(edge_tid)
+
     try:
         for r in range(rounds + 1):
             measured = r > 0
@@ -334,6 +493,18 @@ def run(
                 nodes[arm]["seed"].storage.delete_task(
                     nodes[arm]["seed"].conductor._task_id(url_swarm, None)
                 )
+            for arm in stream_arms:
+                url_stream = f"http://bench.origin/dl-{seed}-{arm}-{r}"
+                res = stream_seed.conductor.download(
+                    url_stream, piece_size=piece_size,
+                    content_length=content_length,
+                )
+                if not (res.ok and res.pieces == n_pieces):
+                    raise RuntimeError(f"stream seeding failed: {res}")
+                _measure_stream(arm, url_stream, measured=measured)
+                stream_seed.storage.delete_task(
+                    stream_seed.conductor._task_id(url_stream, None)
+                )
         pool_stats = {
             "dials": sum(
                 c.fetcher.inner.pool.dials for c in nodes["pipelined"]["clients"]
@@ -343,11 +514,36 @@ def run(
             ),
         }
         serve_stats = {
-            "sendfile_serves": nodes["pipelined"]["seed"].server.sendfile_serves
+            "engine": engine,
+            "sendfile_serves": getattr(
+                nodes["pipelined"]["seed"].server, "sendfile_serves", 0
+            )
             + sum(
-                c.server.sendfile_serves for c in nodes["pipelined"]["clients"]
+                getattr(c.server, "sendfile_serves", 0)
+                for c in nodes["pipelined"]["clients"]
             ),
-            "legacy_sendfile_serves": nodes["legacy"]["seed"].server.sendfile_serves,
+            "legacy_sendfile_serves": getattr(
+                nodes["legacy"]["seed"].server, "sendfile_serves", 0
+            ),
+            # In-engine serve accounting (ps_serve_stats) when the
+            # native server carried the pipelined arms.
+            "native_serves": sum(
+                getattr(n.server, "upload_count", 0)
+                for n in [nodes["pipelined"]["seed"], stream_seed]
+                + nodes["pipelined"]["clients"]
+            ) if engine == "native" else 0,
+        }
+        from dragonfly2_tpu.daemon.piece_pipeline import STREAM_TEE_TOTAL
+
+        stream_stats = {
+            "consumers": stream_consumers,
+            # Engine piece reads on the edge node during measured stream
+            # rounds: the tee arm's zero-disk-read evidence (spills and
+            # late-attach pieces are the only legal nonzero sources).
+            "disk_reads_tee": stream_disk_reads["stream_tee"],
+            "disk_reads_disk": stream_disk_reads["stream_disk"],
+            "tee_delivered": int(STREAM_TEE_TOTAL.value(outcome="delivered")),
+            "tee_spilled": int(STREAM_TEE_TOTAL.value(outcome="spilled")),
         }
     finally:
         gc.enable()
@@ -355,6 +551,10 @@ def run(
             nodes[arm]["seed"].stop()
             for c in nodes[arm]["clients"]:
                 c.stop()
+        for arm in stream_arms:
+            stream_nodes[arm]["proxy"].stop()
+            stream_nodes[arm]["edge"].stop()
+        stream_seed.stop()
         server.stop()
         shutil.rmtree(root, ignore_errors=True)
 
@@ -369,6 +569,8 @@ def run(
             "rounds": rounds,
             "swarm_clients": swarm_n,
             "piece_parallelism": parallelism,
+            "stream_consumers": stream_consumers,
+            "engine": engine,
             "seed": seed,
             "cpus": os.cpu_count(),
         },
@@ -383,8 +585,16 @@ def run(
             / max(arms_out["legacy_swarm"]["MBps"], 1e-9),
             2,
         ),
+        # Time-to-last-byte ratio for the pass-through stream: bytes are
+        # identical, so the MB/s ratio IS the TTLB ratio (disk ÷ tee).
+        "speedup_stream": round(
+            arms_out["stream_tee"]["MBps"]
+            / max(arms_out["stream_disk"]["MBps"], 1e-9),
+            2,
+        ),
         "pool": pool_stats,
         "serve": serve_stats,
+        "stream": stream_stats,
     }
     return out
 
@@ -400,6 +610,11 @@ def main(argv=None) -> int:
                    help="concurrent clients in the swarm scenario")
     p.add_argument("--parallelism", type=int, default=4,
                    help="piece workers per download (both arms)")
+    p.add_argument("--stream-consumers", type=int, default=3,
+                   help="concurrent proxy consumers in the stream scenario")
+    p.add_argument("--engine", choices=("py", "native"), default="py",
+                   help="piece store/server for the pipelined+stream arms "
+                        "(native = the C++ in-engine server)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--smoke", action="store_true",
                    help="tiny sizes: the tier-1 JSON-schema gate")
@@ -407,10 +622,13 @@ def main(argv=None) -> int:
     if args.smoke:
         args.piece_mb, args.pieces = 0.0625, 4
         args.rounds, args.swarm, args.parallelism = 1, 2, 2
+        args.stream_consumers = 2
     try:
         out = run(
             int(args.piece_mb * (1 << 20)), args.pieces, max(args.rounds, 1),
             max(args.swarm, 1), max(args.parallelism, 1), args.seed,
+            stream_consumers=max(args.stream_consumers, 1),
+            engine=args.engine,
         )
         missing = [k for k in SCHEMA_KEYS if k not in out]
         for arm, stats in out["arms"].items():

@@ -229,23 +229,28 @@ def render_telemetry(rounds: List[dict]) -> str:
 
 def render_download(rounds: List[dict]) -> str:
     """The generated download-plane block, markers included (one row per
-    BENCH_DL round: single/swarm MB/s, speedups, p50/p99 piece latency)."""
+    BENCH_DL round: engine, single/swarm MB/s, speedups, the ISSUE-14
+    pass-through stream arms with their zero-disk-read evidence, and
+    p50/p99 piece latency).  Pre-stream rounds (r01) render ``—`` in the
+    stream cells."""
     lines = [
         DOWNLOAD_BEGIN,
         "Generated by `python -m tools.bench_report --update` from the",
         "`BENCH_DL_r*.json` rounds (tools/bench_download.py) — do not edit",
         "by hand; tier-1 (`tests/test_bench_report.py`) fails if stale.",
         "",
-        "| round | status | single MB/s (legacy → pipelined) | speedup | "
-        "swarm MB/s | speedup | piece p50/p99 ms | note |",
-        "| --- | --- | --- | --- | --- | --- | --- | --- |",
+        "| round | status | engine | single MB/s (legacy → pipelined) | "
+        "speedup | swarm MB/s | speedup | stream MB/s (disk → tee) | "
+        "stream× | tee disk reads | piece p50/p99 ms | note |",
+        "| --- | --- | --- | --- | --- | --- | --- | --- | --- | --- | "
+        "--- | --- |",
     ]
     for data in rounds:
         arms = data.get("arms") or {}
         if not data.get("ok") or not arms:
             lines.append(
-                f"| r{data['round']:02d} | error | — | — | — | — | — | "
-                f"{str(data.get('error', ''))[:80]} |"
+                f"| r{data['round']:02d} | error | — | — | — | — | — | — | "
+                f"— | — | — | {str(data.get('error', ''))[:80]} |"
             )
             continue
         status = (
@@ -256,12 +261,29 @@ def render_download(rounds: List[dict]) -> str:
         swarm = arms.get("pipelined_swarm", {})
         legacy_swarm = arms.get("legacy_swarm", {})
         note = str(data.get("note", "") or "").replace("|", "\\|")
+        engine = str((data.get("config") or {}).get("engine", "py"))
+        s_disk = arms.get("stream_disk")
+        s_tee = arms.get("stream_tee")
+        if s_disk and s_tee:
+            stream_cell = (
+                f"{s_disk.get('MBps', 0):.0f} → {s_tee.get('MBps', 0):.0f}"
+            )
+            stream_x = f"{data.get('speedup_stream', 0):.2f}×"
+            st = data.get("stream") or {}
+            reads_cell = (
+                f"{st.get('disk_reads_tee', 0)} vs "
+                f"{st.get('disk_reads_disk', 0)}"
+            )
+        else:
+            stream_cell = stream_x = reads_cell = "—"
         lines.append(
             f"| r{data['round']:02d} | {status} "
+            f"| {engine} "
             f"| {legacy.get('MBps', 0):.0f} → {single.get('MBps', 0):.0f} "
             f"| {data.get('speedup_single', 0):.2f}× "
             f"| {legacy_swarm.get('MBps', 0):.0f} → {swarm.get('MBps', 0):.0f} "
             f"| {data.get('speedup_swarm', 0):.2f}× "
+            f"| {stream_cell} | {stream_x} | {reads_cell} "
             f"| {single.get('p50_ms', 0):.1f} / {single.get('p99_ms', 0):.1f} "
             f"| {note} |"
         )

@@ -72,6 +72,10 @@ REQUIRED_SEAMS = {
     ),
     "dragonfly2_tpu/daemon/piece_pipeline.py": (
         "daemon.report.batch", "daemon.piece.hedge",
+        # Pass-through read plane (DESIGN.md §25): tee delivery (a drop
+        # degrades consumers to the disk path) and the slow-reader spill
+        # (where the mid-tee SIGKILL drill crashes).
+        "daemon.stream.tee", "daemon.stream.spill",
     ),
     "dragonfly2_tpu/trainer/online_graph.py": ("trainer.dispatch",),
     "dragonfly2_tpu/rpc/grpc_transport.py": (

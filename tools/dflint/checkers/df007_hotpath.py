@@ -77,6 +77,13 @@ REQUIRED_HOTPATH = {
     # readinto/sendfile loops), never in these inner functions.
     "dragonfly2_tpu/rpc/piece_transport.py": ("HTTPPieceFetcher.fetch",),
     "dragonfly2_tpu/daemon/upload.py": ("UploadManager.serve_piece",),
+    # Pass-through read plane (DESIGN.md §25): tee publish runs on the
+    # committer thread per piece, take on every stream read — per-item
+    # Python belongs in the unmarked _offer/close helpers.
+    "dragonfly2_tpu/daemon/piece_pipeline.py": (
+        "CommitTee.publish",
+        "TeeConsumer.take",
+    ),
 }
 
 

@@ -46,7 +46,11 @@ REQUIRED_SPANS = {
     "dragonfly2_tpu/rpc/scheduler_server.py": ("rpc/*",),
     "dragonfly2_tpu/rpc/grpc_transport.py": ("rpc/*",),
     "dragonfly2_tpu/daemon/conductor.py": (
-        "daemon/download", "daemon/piece", "daemon/source.piece", "daemon/*",
+        "daemon/download", "daemon/piece", "daemon/source.piece",
+        # Pass-through serve (DESIGN.md §25): rides the download span's
+        # traceparent so a proxy/gateway serve lands on the SAME trace
+        # as the swarm transfer that fed it.
+        "daemon/stream", "daemon/*",
     ),
     "dragonfly2_tpu/daemon/piece_pipeline.py": ("daemon/report.flush",),
     "dragonfly2_tpu/manager/rest.py": ("manager/GET", "manager/POST"),
