@@ -147,6 +147,10 @@ def build(cfg: DaemonConfig, scheduler_url: str):
         )
     else:
         client = scheduler_client_cls(scheduler_urls[0])
+    # Declared tenant identity (DESIGN.md §26): stamped on announces and
+    # registers; the wire client carries it as client state.
+    if cfg.tenant and hasattr(client, "tenant"):
+        client.tenant = cfg.tenant
     conductor = Conductor(
         host,
         storage,
@@ -155,8 +159,32 @@ def build(cfg: DaemonConfig, scheduler_url: str):
         source_fetcher=PieceSourceFetcher(),
         concurrent_source_groups=cfg.concurrent_source_groups,
         stream_tee_depth=cfg.stream_tee_depth,
+        tenant=cfg.tenant,
     )
-    announcer = HostAnnouncer(host, client)
+    announcer = HostAnnouncer(host, client, tenant=cfg.tenant)
+
+    # Tenant QoS adoption (DESIGN.md §26): schedulers re-publish the
+    # manager's tenant_qos table on announce answers (the §24 ring
+    # discipline); each announce adopts the newest payload into the
+    # upload-path bandwidth caps.  Payload-version comparison is cheap
+    # (dict equality on a small table) and malformed payloads are
+    # skipped — an adoption bug must not kill the announcer loop.
+    adopted: list = [None]
+
+    def _adopt_tenant_qos() -> None:
+        payload = getattr(client, "tenant_qos", None)
+        if not isinstance(payload, dict) or payload == adopted[0]:
+            return
+        from ..qos.policy import QoSPolicy
+
+        try:
+            policy = QoSPolicy.from_payload(payload)
+        except (KeyError, TypeError, ValueError):
+            return
+        adopted[0] = payload
+        upload.set_qos_policy(policy)
+
+    announcer.on_announced = _adopt_tenant_qos
     return {
         "storage": storage,
         "upload": upload,

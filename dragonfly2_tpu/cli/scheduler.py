@@ -123,7 +123,7 @@ def run(argv=None) -> int:
 
     cfg = load_config(SchedulerConfigFile, args.config)
     init_flight_recorder(args, cfg.tracing, "scheduler")
-    init_telemetry(args, cfg.telemetry, "scheduler")
+    qos_journal, _qos_engine = init_telemetry(args, cfg.telemetry, "scheduler")
     init_diagnostics(cfg.metrics, "scheduler")
     service, storage, runner = build(cfg)
 
@@ -271,11 +271,34 @@ def run(argv=None) -> int:
     from ..scheduler.sharding import AdmissionController, ShardGuard
 
     shard_admission = None
+    qos_autopilot = None
     if cfg.scheduling.shard_max_inflight > 0:
+        from ..qos.accounting import TenantAccounting
+
+        # Tenant accounting rides admission from boot (DESIGN.md §26):
+        # per-tenant usage/caps start on the default policy and adopt
+        # the manager-published tenant_qos via dynconfig below.
         shard_admission = AdmissionController(
             max_inflight=cfg.scheduling.shard_max_inflight,
             p99_budget_s=cfg.scheduling.shard_p99_budget_ms / 1e3,
+            accounting=TenantAccounting(),
         )
+        if (
+            cfg.scheduling.qos_autopilot
+            and qos_journal is not None
+            and cfg.telemetry.slos
+        ):
+            # SLO autopilot (qos/autopilot.py): rides the metric
+            # journal's cadence — every written frame is ingested live,
+            # so journal replay reproduces the decisions exactly.
+            from ..qos.autopilot import SLOAutopilot
+
+            qos_autopilot = SLOAutopilot(
+                cfg.telemetry.slos,
+                admission=shard_admission,
+                accounting=shard_admission.accounting,
+            )
+            qos_journal.on_snapshot = qos_autopilot.ingest
     shard_guard = ShardGuard(scheduler_id, admission=shard_admission)
     shard_guard.resource = service.resource
     service.shard_guard = shard_guard
@@ -421,6 +444,11 @@ def run(argv=None) -> int:
         # sweep (tasks this shard no longer owns steer to their new
         # owner on the peers' next call).
         dynconfig.register(shard_guard.on_config)
+        # Tenant QoS adoption (DESIGN.md §26): the manager publishes the
+        # per-tenant table with the same payload; the service installs
+        # it across admission accounting + the batcher's DRR weights and
+        # re-publishes it on announce answers.
+        dynconfig.register(service.on_qos_config)
         dynconfig.serve()
 
         # Cross-replica topology sharing through the manager (the Redis
@@ -559,6 +587,8 @@ def run(argv=None) -> int:
             cluster_link.stop()
         if dynconfig is not None:
             dynconfig.stop()
+        if qos_autopilot is not None:
+            qos_autopilot.close()
         if rollout_reporter is not None:
             rollout_reporter.stop()
         if model_subscriber is not None:

@@ -157,6 +157,11 @@ class SchedulingSection:
     # classes with 503+Retry-After.  0 max_inflight disables admission.
     shard_max_inflight: int = 512
     shard_p99_budget_ms: float = 50.0
+    # Tenant QoS plane (DESIGN.md §26): with telemetry.slos declared, a
+    # metric journal configured and admission enabled, the SLO autopilot
+    # feeds burn verdicts back into the shed floor + over-quota tenants'
+    # announce caps; False leaves admission on the measured signals only.
+    qos_autopilot: bool = True
 
     def validate(self) -> None:
         if self.algorithm not in ("default", "nt", "ml"):
@@ -447,6 +452,12 @@ class DaemonConfig:
     # -1 = disabled, 0 = OS-assigned.
     control_vsock_port: int = -1
     scheduler_addr: str = ""
+    # Declared tenant identity (DESIGN.md §26): stamped on registers and
+    # announces so the scheduler's per-tenant accounting, the upload
+    # caps, and the weighted-fair lanes key on it.  Authenticated
+    # deployments derive it from the manager credential instead
+    # (qos.derive_tenant); "" rides as the default tenant.
+    tenant: str = ""
     # Manager address for service-identity bootstrap (daemons otherwise
     # only talk to the scheduler); required when security.auto_issue is on.
     manager_addr: str = ""

@@ -269,11 +269,15 @@ class Conductor:
         hedge_floor_s: float = 0.05,
         hedge_multiplier: float = 1.5,
         stream_tee_depth: int = 8,
+        tenant: str = "",
         pex=None,
     ) -> None:
         self.host = host
         self.storage = storage
         self.scheduler = scheduler
+        # Tenant identity (DESIGN.md §26): stamped on every register
+        # this conductor makes; "" rides as the default tenant.
+        self.tenant = tenant
         self.piece_fetcher = piece_fetcher
         self.source_fetcher = source_fetcher
         # Optional PeerExchange (daemon/pex.py): piece-holder discovery
@@ -662,7 +666,7 @@ class Conductor:
         try:
             reg = self.scheduler.register_peer(
                 host=self.host, url=url, priority=priority,
-                task_id=run.task_id,
+                task_id=run.task_id, tenant=self.tenant,
             )
         except Exception:
             # Scheduler unreachable: gossip keeps the swarm serving

@@ -81,9 +81,14 @@ class Daemon:
         total_rate: float = 1e9,
         prefer_native: bool = True,
         concurrent_source_groups: int = 1,
+        tenant: str = "",
     ) -> None:
         self.host = host
         self.scheduler = scheduler
+        # Declared tenant (DESIGN.md §26): stamped on registers and
+        # announces; tasks this daemon downloads are owned by it, so
+        # serves of their pieces account (and throttle) against it.
+        self.tenant = tenant
         self.storage = DaemonStorage(
             storage_root, quota_bytes=quota_bytes, prefer_native=prefer_native
         )
@@ -101,6 +106,7 @@ class Daemon:
             source_fetcher=source_fetcher,
             traffic_shaper=self.traffic_shaper,
             concurrent_source_groups=concurrent_source_groups,
+            tenant=tenant,
         )
         self.pex: Optional[PeerExchange] = None
         if gossip_bus is not None:
@@ -126,7 +132,22 @@ class Daemon:
     def probe_round(self) -> int:
         return self.probe_agent.sync_probes() if self.probe_agent else 0
 
+    def set_qos_policy(self, policy) -> None:
+        """Adopt a tenant QoS policy (manager-published, re-published on
+        announce answers): upload-path bandwidth caps + the shaper's
+        tenant weight split (DESIGN.md §26)."""
+        self.upload.set_qos_policy(policy)
+        self.traffic_shaper.set_policy(policy)
+
     def download(self, url: str, **kwargs) -> DownloadResult:
+        from ..utils import idgen
+
+        # Stamp task ownership BEFORE any bytes move: serves of this
+        # task's pieces (to other peers, mid-download included) account
+        # against this daemon's tenant.
+        self.upload.register_task_tenant(
+            kwargs.get("task_id") or idgen.task_id(url), self.tenant
+        )
         result = self.conductor.download(url, **kwargs)
         # The conductor advertises every download it EXECUTED (all three
         # planes + tiny); only reuse results — served straight from disk,
@@ -140,6 +161,11 @@ class Daemon:
     def open_stream(self, url: str, **kwargs):
         """Stream-task entry (StartStreamTask analog): bytes flow as
         pieces commit — reuse, attach-to-running, or background download."""
+        from ..utils import idgen
+
+        self.upload.register_task_tenant(
+            kwargs.get("task_id") or idgen.task_id(url), self.tenant
+        )
         return self.conductor.open_stream(url, **kwargs)
 
     def read_task_bytes(self, task_id: str) -> bytes:

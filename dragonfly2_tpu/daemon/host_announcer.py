@@ -28,11 +28,21 @@ class HostAnnouncer:
         *,
         interval: float = DEFAULT_INTERVAL,
         collect_stats: bool = True,
+        tenant: str = "",
     ) -> None:
         self.host = host
         self.scheduler = scheduler
         self.interval = interval
         self.collect_stats = collect_stats
+        # Tenant identity stamped on announces (DESIGN.md §26): wire
+        # clients carry it as client state (.tenant), the embedded
+        # service takes it as a kwarg.
+        self.tenant = tenant
+        if tenant and hasattr(scheduler, "tenant"):
+            scheduler.tenant = tenant
+        # Optional post-announce hook (no args): the daemon CLI adopts
+        # announce-answer payloads (tenant_qos, §26) through it.
+        self.on_announced = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -43,13 +53,21 @@ class HostAnnouncer:
             self.host.stats.memory = info.memory
             self.host.stats.disk = info.disk
         self.host.touch()
-        if hasattr(self.scheduler, "announce_host"):
-            # Wire client AND the embedded SchedulerService (whose
-            # announce_host refreshes stats and writes the columnar host
-            # state on arrival, DESIGN.md §18).
+        from ..scheduler.service import SchedulerService
+
+        if isinstance(self.scheduler, SchedulerService):
+            # Embedded service: announce_host refreshes stats and writes
+            # the columnar host state on arrival (DESIGN.md §18); the
+            # tenant rides as a kwarg into admission accounting (§26).
+            self.scheduler.announce_host(self.host, tenant=self.tenant)
+        elif hasattr(self.scheduler, "announce_host"):
+            # Wire client: the tenant was stamped onto the client above.
             self.scheduler.announce_host(self.host)
         else:
             self.scheduler.resource.store_host(self.host)  # bare Resource shims
+        hook = self.on_announced
+        if hook is not None:
+            hook()
 
     def serve(self) -> None:
         if self._thread is not None:

@@ -17,22 +17,80 @@ Two serve shapes (DESIGN.md §22):
   shapes share ONE accounting gate (``begin_upload``/``end_upload``), so
   the concurrency cap and the upload counters mean the same thing on
   either path — and tests prove the two byte-identical.
+
+Tenant QoS (DESIGN.md §26): tasks are stamped with the tenant that
+created them (``register_task_tenant``); with a ``QoSPolicy`` installed,
+the shared gate also enforces each tenant's ``upload_rate_bytes_s`` cap
+with a post-paid token bucket — a request is admitted while the
+tenant's balance is positive and the ACTUAL bytes are charged at
+``end_upload`` (piece sizes are not known before the read), so a
+flooding tenant's serves go 503 (``UploadThrottled``) while other
+tenants' pieces keep flowing.  Per-tenant byte totals feed the bounded
+``tenant_class`` metric label, never raw tenant ids (DF017).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Optional, Tuple
+import time
+from typing import TYPE_CHECKING, Dict, Optional, Tuple
 
+from ..utils.metrics import default_registry as _reg
 from .storage import DaemonStorage
+
+if TYPE_CHECKING:  # duck-typed at runtime (no qos import on boot)
+    from ..qos.policy import QoSPolicy
+
+UPLOAD_THROTTLED_TOTAL = _reg.counter(
+    "daemon_upload_throttled_total",
+    "Piece serves refused by a tenant's upload-bandwidth cap",
+    ["tenant_class"],
+)
+UPLOAD_TENANT_BYTES_TOTAL = _reg.counter(
+    "daemon_upload_tenant_bytes_total",
+    "Bytes served from the upload path, by tenant class",
+    ["tenant_class"],
+)
+
+_DEFAULT_TENANT = "default"
 
 
 class UploadBusy(RuntimeError):
     pass
 
 
+class UploadThrottled(UploadBusy):
+    """A tenant's upload-bandwidth cap refused this serve (the wire
+    servers answer 503 exactly like the concurrency cap — the client's
+    reschedule/backoff machinery already knows the shape)."""
+
+
+class _TenantBandwidth:
+    """Post-paid byte bucket: admit while balance > 0, charge actual
+    bytes afterwards; the balance refills at the capped rate and may go
+    negative (the debt model standard for bandwidth shaping where sizes
+    are only known after the read)."""
+
+    __slots__ = ("rate", "balance", "last")
+
+    def __init__(self, rate: float) -> None:
+        self.rate = rate
+        self.balance = rate  # one second of burst headroom
+        self.last = time.monotonic()
+
+    def refill(self, now: float) -> None:
+        self.balance = min(self.rate, self.balance + (now - self.last) * self.rate)
+        self.last = now
+
+
 class UploadManager:
-    def __init__(self, storage: DaemonStorage, *, concurrent_limit: int = 50) -> None:
+    def __init__(
+        self,
+        storage: DaemonStorage,
+        *,
+        concurrent_limit: int = 50,
+        qos_policy: "Optional[QoSPolicy]" = None,
+    ) -> None:
         self.storage = storage
         self.concurrent_limit = concurrent_limit
         self._mu = threading.Lock()
@@ -40,29 +98,102 @@ class UploadManager:
         self.upload_count = 0
         self.upload_failed_count = 0
         self.bytes_served = 0
+        self.throttled_count = 0
+        # Tenant plane: task → owning tenant (stamped at download
+        # registration), per-tenant post-paid byte buckets, per-tenant
+        # served-byte totals (raw ids live HERE, never on metric labels).
+        self._policy = qos_policy
+        self._task_tenant: Dict[str, str] = {}
+        self._tenant_bw: Dict[str, _TenantBandwidth] = {}
+        self.tenant_bytes: Dict[str, int] = {}
 
     @property
     def active(self) -> int:
         with self._mu:
             return self._active
 
+    # -- tenant plane --------------------------------------------------------
+
+    def set_qos_policy(self, policy: "Optional[QoSPolicy]") -> None:
+        with self._mu:
+            self._policy = policy
+            self._tenant_bw.clear()  # rebuilt lazily from the new caps
+
+    def register_task_tenant(self, task_id: str, tenant: str) -> None:
+        """Stamp the tenant that created ``task_id`` — serves of the
+        task's pieces account (and throttle) against it."""
+        with self._mu:
+            self._task_tenant[task_id] = tenant or _DEFAULT_TENANT
+
+    def tenant_of(self, task_id: Optional[str]) -> str:
+        with self._mu:
+            return self._task_tenant.get(task_id or "", _DEFAULT_TENANT)
+
+    def _bw_locked(self, tenant: str) -> Optional[_TenantBandwidth]:
+        policy = self._policy
+        if policy is None:
+            return None
+        rate = float(policy.for_tenant(tenant).upload_rate_bytes_s)
+        if rate <= 0.0:
+            self._tenant_bw.pop(tenant, None)
+            return None
+        bw = self._tenant_bw.get(tenant)
+        if bw is None or bw.rate != rate:
+            bw = self._tenant_bw[tenant] = _TenantBandwidth(rate)
+        return bw
+
     # -- shared accounting gate (both serve shapes) --------------------------
 
-    def begin_upload(self) -> None:
-        """Claim one upload slot; raises UploadBusy past the cap.  Callers
-        MUST pair with ``end_upload`` (the sendfile server path wraps its
-        own stream between the two)."""
+    def begin_upload(self, task_id: Optional[str] = None) -> None:
+        """Claim one upload slot; raises UploadBusy past the cap and
+        UploadThrottled when the owning tenant's bandwidth cap is in
+        debt.  Callers MUST pair with ``end_upload`` (the sendfile
+        server path wraps its own stream between the two)."""
+        from ..utils import faultinject
+
+        # Throttle chaos seam (DF004): injected drops/delays here prove
+        # a wedged/refused gate degrades to the client's reschedule
+        # path, never a stuck serve.
+        faultinject.fire("daemon.upload.throttle")
         with self._mu:
             if self._active >= self.concurrent_limit:
                 raise UploadBusy(f"{self._active} active uploads")
+            tenant = self._task_tenant.get(task_id or "", _DEFAULT_TENANT)
+            bw = self._bw_locked(tenant)
+            if bw is not None:
+                bw.refill(time.monotonic())
+                if bw.balance <= 0.0:
+                    self.throttled_count += 1
+                    cls = (
+                        self._policy.class_of(tenant)
+                        if self._policy is not None else "silver"
+                    )
+                    UPLOAD_THROTTLED_TOTAL.inc(tenant_class=cls)
+                    raise UploadThrottled(
+                        f"tenant upload cap: {bw.balance:.0f} byte balance"
+                    )
             self._active += 1
 
-    def end_upload(self, ok: bool, nbytes: int = 0) -> None:
+    def end_upload(
+        self, ok: bool, nbytes: int = 0, task_id: Optional[str] = None
+    ) -> None:
         with self._mu:
             self._active -= 1
             if ok:
                 self.upload_count += 1
                 self.bytes_served += nbytes
+                tenant = self._task_tenant.get(task_id or "", _DEFAULT_TENANT)
+                self.tenant_bytes[tenant] = (
+                    self.tenant_bytes.get(tenant, 0) + nbytes
+                )
+                bw = self._bw_locked(tenant)
+                if bw is not None and nbytes:
+                    bw.refill(time.monotonic())
+                    bw.balance -= nbytes
+                if nbytes and self._policy is not None:
+                    UPLOAD_TENANT_BYTES_TOTAL.inc(
+                        amount=nbytes, tenant_class=self._policy.class_of(tenant)
+                    )
             else:
                 self.upload_failed_count += 1
 
@@ -78,7 +209,7 @@ class UploadManager:
         # truncate on the body): covers BOTH piece transports — the HTTP
         # server and the in-process fetcher call through here.
         faultinject.fire("daemon.upload.serve_piece")
-        self.begin_upload()
+        self.begin_upload(task_id)
         ok = False
         try:
             data = self.storage.read_piece(task_id, number)
@@ -87,7 +218,7 @@ class UploadManager:
             ok = True
             return data
         finally:
-            self.end_upload(ok, len(data) if ok else 0)
+            self.end_upload(ok, len(data) if ok else 0, task_id)
 
     def serve_piece_span(
         self, task_id: str, number: int, offset: int, max_len: int
@@ -99,7 +230,7 @@ class UploadManager:
         from ..utils import faultinject
 
         faultinject.fire("daemon.upload.serve_piece")
-        self.begin_upload()
+        self.begin_upload(task_id)
         ok = False
         try:
             data = self.storage.read_piece_at(task_id, number, offset, max_len)
@@ -107,7 +238,7 @@ class UploadManager:
             ok = True
             return data
         finally:
-            self.end_upload(ok, len(data) if ok else 0)
+            self.end_upload(ok, len(data) if ok else 0, task_id)
 
     def serve_range(self, task_id: str, start: int, length: int, piece_size: int) -> bytes:
         """Byte-range read assembled from SUB-PIECE reads (HTTP Range

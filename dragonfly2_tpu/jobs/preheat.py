@@ -16,9 +16,15 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Sequence
 
+from ..utils.types import Priority
 from .queue import GroupJob, JobQueue
 
 PREHEAT = "preheat"
+
+# Preheat is warm-ahead-of-demand BACKGROUND work (DESIGN.md §26): the
+# fan-out runs at the lowest priority class, so the seeder queue orders
+# it behind interactive pulls and overload admission sheds it FIRST.
+PREHEAT_PRIORITY = Priority.LEVEL6
 
 
 @dataclass
@@ -41,7 +47,11 @@ def preheat(
         "jobs/preheat", urls=len(urls), queues=len(scheduler_queues)
     ) as span:
         per_queue = {
-            q: {"urls": list(urls), "piece_size": piece_size}
+            q: {
+                "urls": list(urls),
+                "piece_size": piece_size,
+                "priority": int(PREHEAT_PRIORITY),
+            }
             for q in scheduler_queues
         }
         group = broker.create_group_job(PREHEAT, per_queue)
@@ -70,6 +80,7 @@ def preheat_image(
                 "urls": list(resolved.urls),
                 "piece_size": piece_size,
                 "headers": dict(resolved.headers),
+                "priority": int(PREHEAT_PRIORITY),
             }
             for q in scheduler_queues
         }
@@ -109,10 +120,14 @@ def make_preheat_handler(seed_daemon, *, content_length_for=None):
             else:
                 cl = args["piece_size"]
             # The registry pull token rides to the origin fetcher —
-            # private-registry blobs need it on every GET.
+            # private-registry blobs need it on every GET.  Preheat runs
+            # at the background class: the job args carry LEVEL6 so the
+            # seed's download (and its scheduler registration) yields to
+            # interactive pulls end-to-end (DESIGN.md §26).
             r = seed_daemon.download(
                 url, piece_size=args["piece_size"], content_length=cl,
                 source_headers=headers,
+                priority=Priority(int(args.get("priority", PREHEAT_PRIORITY))),
             )
             if not r.ok:
                 raise RuntimeError(f"preheat of {url} failed")

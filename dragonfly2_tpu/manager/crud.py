@@ -40,7 +40,11 @@ class ClusterRecord:
     ``scheduler_cluster_config`` carries the scheduling limits the
     scheduler's dynconfig applies live (candidate_parent_limit,
     filter_parent_limit); ``client_config`` the daemon-side knobs
-    (load_limit); ``scopes`` the searcher's affinity inputs.
+    (load_limit); ``scopes`` the searcher's affinity inputs;
+    ``tenant_qos`` the per-tenant QoS table (DESIGN.md §26: priority
+    class, weight, upload-bandwidth cap, announce-rate cap per tenant)
+    published with the cluster dynconfig and re-published by schedulers
+    on announce answers.
     """
 
     id: str
@@ -49,6 +53,7 @@ class ClusterRecord:
     scheduler_cluster_config: Dict[str, Any] = field(default_factory=dict)
     client_config: Dict[str, Any] = field(default_factory=dict)
     scopes: Dict[str, Any] = field(default_factory=dict)
+    tenant_qos: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass
@@ -94,6 +99,14 @@ def _validate_cluster_blobs(fields: Dict[str, Any]) -> None:
         for k in _CLUSTER_INT_KEYS:
             if k in blob and not isinstance(blob[k], int):
                 raise ValueError(f"{blob_key}.{k} must be an integer")
+    qos = fields.get("tenant_qos")
+    if qos is not None:
+        # Validation lives on the WRITE path (the scheduler/daemon side
+        # skips malformed payloads silently — a rejected write is loud,
+        # a half-applied policy is not).
+        from ..qos.policy import parse_tenant_qos
+
+        parse_tenant_qos(qos)
 
 
 class CrudStore:
@@ -172,7 +185,12 @@ class CrudStore:
             row = self._rows[kind].get(row_id)
             if row is None:
                 raise KeyError(f"{kind} {row_id!r} not found")
-            allowed = {f for f in row.keys() if f != "id"}
+            # Declared fields, not the row's keys: a row persisted before
+            # a schema gained a field (e.g. tenant_qos) must still accept
+            # updates to it.
+            import dataclasses as _dc
+
+            allowed = {f.name for f in _dc.fields(cls)} - {"id"}
             for k, v in fields.items():
                 if k not in allowed:
                     raise ValueError(f"unknown field {k!r} for {kind}")
@@ -220,4 +238,5 @@ class CrudStore:
             "cluster_id": cluster.id,
             "scheduler_cluster_config": dict(cluster.scheduler_cluster_config),
             "client_config": dict(cluster.client_config),
+            "tenant_qos": dict(cluster.tenant_qos),
         }

@@ -601,6 +601,18 @@ class ManagerRESTServer:
                     cid = path[len("/api/v1/clusters/"):-len(":config")]
                     try:
                         payload = server.crud.cluster_config(cid)
+                        # Tenant identity derivation (DESIGN.md §26): an
+                        # authenticated poll (PAT or session token) gets
+                        # its tenant id derived from the credential's
+                        # subject — the SAME derivation every service
+                        # applies, so one identity maps to one tenant
+                        # fleet-wide.  Unauthenticated clusters fall back
+                        # to their declared DaemonConfig.tenant.
+                        ident = self._identity()
+                        if ident is not None:
+                            from ..qos.policy import derive_tenant
+
+                            payload["tenant_id"] = derive_tenant(ident[0])
                         # The shard ring rides the cluster dynconfig
                         # (DESIGN.md §24): membership is the ACTIVE
                         # scheduler set; a set change bumps the durable

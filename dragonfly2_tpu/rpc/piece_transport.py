@@ -141,7 +141,7 @@ class PieceHTTPServer:
                         if sendfile_ok:
                             span = upload_ref.piece_sendfile_span(task_id, number)
                             if span is not None:
-                                upload_ref.begin_upload()
+                                upload_ref.begin_upload(task_id)
                                 ok = False
                                 try:
                                     streaming = True
@@ -149,7 +149,7 @@ class PieceHTTPServer:
                                     ok = True
                                 finally:
                                     upload_ref.end_upload(
-                                        ok, span[2] if ok else 0
+                                        ok, span[2] if ok else 0, task_id
                                     )
                                 return
                         data = upload_ref.serve_piece(task_id, number)
@@ -218,7 +218,7 @@ class PieceHTTPServer:
                                 task_id, start, end - start + 1
                             )
                             if span is not None:
-                                upload_ref.begin_upload()
+                                upload_ref.begin_upload(task_id)
                                 ok = False
                                 try:
                                     streaming = True
@@ -226,7 +226,7 @@ class PieceHTTPServer:
                                     ok = True
                                 finally:
                                     upload_ref.end_upload(
-                                        ok, span[2] if ok else 0
+                                        ok, span[2] if ok else 0, task_id
                                     )
                                 return
                         piece_size = upload_ref.storage.engine.piece_size(task_id)

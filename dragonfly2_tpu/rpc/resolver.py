@@ -78,8 +78,11 @@ class ShardRouter:
       the ring moved under us: adopt the answer's owner hint and retry
       there — the server's hint is fresher than our last dynconfig poll;
     - a **saturated** answer (503 + Retry-After →
-      ``ShardSaturatedError``) honors the server's pacing once, then
-      propagates — the CALLER owns the drop-or-degrade decision;
+      ``ShardSaturatedError``) honors the server's pacing through a
+      BOUNDED retry budget (``saturation_retries``, decorrelated-jitter
+      spaced and capped by the server's Retry-After), then propagates —
+      a briefly-saturated shard is a wait, not a hard failure; past the
+      budget the CALLER owns the drop-or-degrade decision;
     - a **transport failure** demotes the member locally (the ring loses
       it until a dynconfig refresh re-publishes it) and retries on the
       task's next owner — the client half of task migration.
@@ -94,6 +97,9 @@ class ShardRouter:
         factory: Optional[Callable[[str], object]] = None,
         *,
         load_factor: float = 1.25,
+        saturation_retries: int = 3,
+        max_retry_wait_s: float = 2.0,
+        backoff_rng=None,
     ) -> None:
         from ..scheduler.sharding import ShardRing
 
@@ -101,6 +107,14 @@ class ShardRouter:
         self._ring = ShardRing()
         self._factory = factory
         self.load_factor = load_factor
+        # Saturation retry budget: how many 503+Retry-After answers one
+        # call absorbs before propagating, each wait the MIN of the
+        # server's Retry-After and a decorrelated-jitter draw (seeded
+        # rng => reproducible schedules in tests, decorrelated across a
+        # fleet seeded differently).
+        self.saturation_retries = max(0, int(saturation_retries))
+        self.max_retry_wait_s = max_retry_wait_s
+        self._backoff_rng = backoff_rng
         self._clients: Dict[str, object] = {}
         self._inflight: Dict[str, int] = {}
 
@@ -169,16 +183,24 @@ class ShardRouter:
 
     def call(self, task_id: str, fn: Callable[[object], T]) -> T:
         """Run ``fn(client)`` against the owning shard, following wrong-
-        shard steering answers and transport-failure re-routes; honors
-        one saturation Retry-After before propagating it."""
+        shard steering answers and transport-failure re-routes; absorbs
+        up to ``saturation_retries`` Retry-After answers (jitter-spaced)
+        before propagating the saturation."""
+        from ..rpc.retry import DecorrelatedJitterBackoff
         from ..utils import faultinject
         from ..scheduler.sharding import ShardSaturatedError, WrongShardError
 
-        waited = False
+        waits = 0
+        backoff = DecorrelatedJitterBackoff(
+            base=0.01, cap=self.max_retry_wait_s, rng=self._backoff_rng
+        )
         last: Optional[BaseException] = None
-        # One attempt per member + one slot for a steering hop: the walk
-        # terminates even when every shard answers with an error.
-        for _ in range(max(2, len(self.members()) + 1)):
+        # One attempt per member + one slot per steering hop and per
+        # budgeted saturation retry: the walk terminates even when every
+        # shard answers with an error.
+        for _ in range(
+            max(2, len(self.members()) + 1) + self.saturation_retries
+        ):
             sid, url = self.route(task_id)
             # Chaos seam: route-time drop/delay exercises the same
             # failover path a dying shard does.
@@ -203,10 +225,19 @@ class ShardRouter:
                         break
             except ShardSaturatedError as exc:
                 last = exc
-                if waited:
+                if waits >= self.saturation_retries:
+                    # Budget spent: the shard is saturated beyond a
+                    # brief wait — the caller owns drop-or-degrade.
                     raise
-                waited = True
-                time.sleep(min(exc.retry_after_s, 2.0))
+                waits += 1
+                # Honor the server's pacing (never knock sooner than
+                # Retry-After), de-synchronized by the growing jitter
+                # draw, clamped to the local budget — a shard asking for
+                # minutes gets max_retry_wait_s, not a parked caller.
+                time.sleep(
+                    min(max(exc.retry_after_s, backoff.next()),
+                        self.max_retry_wait_s)
+                )
             except (ConnectionError, TimeoutError, OSError) as exc:
                 last = exc
                 self._demote(sid)

@@ -55,6 +55,11 @@ class RemoteScheduler:
         self.server_capabilities: tuple = ()
         # Last ring payload the server re-published on announce (§24).
         self.scheduler_ring: Optional[dict] = None
+        # Last tenant_qos payload re-published on announce (§26) and the
+        # tenant identity stamped on this client's announces/registers
+        # (the daemon's declared/derived tenant).
+        self.tenant_qos: Optional[dict] = None
+        self.tenant = ""
         self._mu = threading.Lock()
         self._tasks: Dict[str, Task] = {}
         self._hosts: Dict[str, Host] = {}
@@ -174,6 +179,8 @@ class RemoteScheduler:
 
     def announce_host(self, host: Host) -> None:
         req = {"host": host_to_wire(host)}
+        if self.tenant:
+            req["tenant"] = self.tenant
         if self.protocol_version >= 2:
             # The v1 shim sends NO version field — that absence is the
             # legacy dialect's signature (rpc/version.py).
@@ -197,6 +204,11 @@ class RemoteScheduler:
         # shard ring rides the announce answer; steering compositions
         # read it off the client after each announce fan-out.
         self.scheduler_ring = resp.get("scheduler_ring")
+        # Tenant QoS re-publication (DESIGN.md §26): the daemon adopts
+        # upload caps/weights off the same answer.
+        qos = resp.get("tenant_qos")
+        if isinstance(qos, dict) and qos:
+            self.tenant_qos = qos
         with self._mu:
             self._hosts[host.id] = host
             self._announced.add(host.id)
@@ -211,6 +223,7 @@ class RemoteScheduler:
         tag: str = "",
         application: str = "",
         priority=None,
+        tenant: str = "",
         **_ignored,
     ) -> RegisterResult:
         with self._mu:
@@ -227,6 +240,7 @@ class RemoteScheduler:
         peer_id = peer_id or idgen.peer_id(host.ip, host.hostname)
         req = {"host_id": host.id, "url": url, "peer_id": peer_id,
                "task_id": task_id, "tag": tag, "application": application,
+               "tenant": tenant or self.tenant,
                "priority": int(priority) if priority is not None else 0}
         try:
             resp = self._call("register_peer", req)

@@ -127,7 +127,9 @@ class SchedulerRPCAdapter:
         host.protocol_version = negotiated
         # The service owns the announce decode (stats refresh + columnar
         # write-on-arrival, DESIGN.md §18) — the adapter only negotiates.
-        stored = self.service.announce_host(host)
+        stored = self.service.announce_host(
+            host, tenant=str(req.get("tenant", "") or "")
+        )
         stored.protocol_version = negotiated
         out = {"protocol": protocol_info(negotiated, self.capabilities)}
         # Ring re-publication (DESIGN.md §24): the announce answer
@@ -139,6 +141,11 @@ class SchedulerRPCAdapter:
             ring = guard.ring()
             if ring is not None and len(ring):
                 out["scheduler_ring"] = ring.to_payload()
+        # Tenant QoS re-publication (DESIGN.md §26, same discipline):
+        # daemons adopt upload caps + weights off the announce answer.
+        policy = self.service.qos_policy
+        if policy is not None:
+            out["tenant_qos"] = policy.to_payload()
         return out
 
     def register_peer(self, req: dict) -> dict:
@@ -154,6 +161,7 @@ class SchedulerRPCAdapter:
             task_id=req.get("task_id"),
             tag=req.get("tag", ""),
             application=req.get("application", ""),
+            tenant=str(req.get("tenant", "") or ""),
             # Clamp: wire clients may send out-of-range levels; an invalid
             # priority must not fail the registration.
             priority=Priority(max(0, min(6, int(req.get("priority", 0) or 0)))),

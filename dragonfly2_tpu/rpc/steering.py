@@ -68,6 +68,26 @@ class SteeringSchedulerClient:
         # Published shard ring (ids → urls), adopted from announce
         # answers; None until a sharded scheduler answers one.
         self._shard_ring: Optional[ShardRing] = None
+        # Tenant identity stamped on every backend client (§26), and the
+        # newest tenant_qos payload re-published on announce answers —
+        # the daemon CLI adopts it into upload caps/shaper weights.
+        self._tenant = ""
+        self.tenant_qos: Optional[dict] = None
+
+    # -- tenant identity ------------------------------------------------------
+
+    @property
+    def tenant(self) -> str:
+        return self._tenant
+
+    @tenant.setter
+    def tenant(self, value: str) -> None:
+        with self._mu:
+            self._tenant = value or ""
+            clients = list(self._clients.values())
+        for c in clients:
+            if hasattr(c, "tenant"):
+                c.tenant = value or ""
 
     # -- routing -------------------------------------------------------------
 
@@ -76,6 +96,8 @@ class SteeringSchedulerClient:
             client = self._clients.get(url)
             if client is None:
                 client = self._clients[url] = self._factory(url)
+                if self._tenant and hasattr(client, "tenant"):
+                    client.tenant = self._tenant
             return client
 
     def _adopt_ring(self, payload) -> None:
@@ -141,6 +163,10 @@ class SteeringSchedulerClient:
                 # Adopt the newest re-published shard ring (§24): the
                 # announce fan-out doubles as the peer's ring poll.
                 self._adopt_ring(getattr(c, "scheduler_ring", None))
+                # Same discipline for the tenant QoS payload (§26).
+                qos = getattr(c, "tenant_qos", None)
+                if isinstance(qos, dict) and qos:
+                    self.tenant_qos = qos
             except Exception as exc:  # noqa: BLE001 — replica outage
                 last_exc = exc
         if ok == 0 and last_exc is not None:

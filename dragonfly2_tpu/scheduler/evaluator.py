@@ -814,6 +814,7 @@ class MLEvaluator(Evaluator):
                                 dst_buckets=dst_slots,
                                 candidate=use_candidate,
                                 scorer=engine,
+                                tenant=getattr(child, "tenant", ""),
                             )
                         )
                     else:
@@ -866,6 +867,8 @@ class MLEvaluator(Evaluator):
                             # with the route decision; active arms keep
                             # the flush-snapshot coalescing economics.
                             scorer=engine if use_candidate else None,
+                            # Weighted-fair lane key (DESIGN.md §26).
+                            tenant=getattr(child, "tenant", ""),
                         )
                     )
                 else:

@@ -38,6 +38,22 @@ Mechanics (DESIGN.md §14):
   model versions or precisions inside one call.  A candidate
   uninstalled mid-queue pins its unpinned requests to the active
   scorer.
+- **weighted-fair tenant lanes** (DESIGN.md §26) — requests queue in
+  per-tenant FIFO lanes and the leader drains them with deficit round
+  robin: each drain FIRST lands every backlogged lane's head request
+  (on credit — the deficit goes negative, charging it against the
+  lane's future share), then passes over the lanes growing each lane's
+  deficit by ``quantum × weight`` and draining whole requests while
+  the deficit covers their rows.  A 100-weight flood therefore cannot
+  starve a 1-weight tenant (every drain serves every backlogged lane
+  at least its head) while throughput still tracks the weights, and
+  per-tenant arrival order is preserved (lanes are deques, head pops
+  only).  Deficits carry across cap-limited flushes; a lane that
+  empties resets (classic DRR).  With ONE active tenant the drain is a
+  whole-queue swap — bit-equal to the pre-QoS single-queue behavior
+  (the §14 oracle discipline, property-tested).  A flush past
+  ``max_batch_rows`` leaves the excess queued and the leader loops
+  until the lanes are dry, so followers never stall leaderless.
 
 The scorer contract this relies on is row-independence: ``score`` must
 score each row from that row (+ its buckets) alone, so padded rows and
@@ -51,7 +67,7 @@ import bisect
 import logging
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -63,6 +79,12 @@ logger = logging.getLogger(__name__)
 
 DEFAULT_PAD_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
+# DRR quantum: rows of deficit a weight-1.0 lane earns per drain pass
+# (sized to a typical candidate set so one pass serves one announce).
+DEFAULT_DRR_QUANTUM = 32
+
+DEFAULT_LANE = "default"
+
 
 class ScorerUnavailable(RuntimeError):
     """No scorer installed at flush time (deactivated mid-queue); the
@@ -71,13 +93,19 @@ class ScorerUnavailable(RuntimeError):
 
 class _Request:
     __slots__ = (
-        "features", "src", "dst", "candidate", "scorer", "done", "result", "error",
+        "features", "src", "dst", "candidate", "scorer", "tenant", "rows",
+        "done", "result", "error",
     )
 
-    def __init__(self, features, src, dst, candidate=False, scorer=None) -> None:
+    def __init__(
+        self, features, src, dst, candidate=False, scorer=None, tenant=""
+    ) -> None:
         self.features = features
         self.src = src
         self.dst = dst
+        # Tenant lane key (DESIGN.md §26): "" rides the default lane.
+        self.tenant = tenant or DEFAULT_LANE
+        self.rows = int(features.shape[0])
         # Canary arm (DESIGN.md §15): True routes this request to the
         # flush's candidate-scorer snapshot instead of the active one.
         self.candidate = candidate
@@ -105,12 +133,25 @@ class ScorerBatcher:
         linger_s: float = 0.0015,
         max_batch_rows: int = 4096,
         pad_buckets=DEFAULT_PAD_BUCKETS,
+        drr_quantum: int = DEFAULT_DRR_QUANTUM,
+        qos_policy=None,
     ) -> None:
         self._cv = threading.Condition()
-        self._pending: List[_Request] = []
+        # Per-tenant FIFO lanes (DESIGN.md §26): an OrderedDict so the
+        # drain's round-robin order is arrival order of the lanes.
+        self._lanes: "OrderedDict[str, deque]" = OrderedDict()
+        # DRR deficit per backlogged lane; carries across cap-limited
+        # flushes, resets when a lane empties (classic DRR).
+        self._deficit: dict = {}
+        # Rotating start pointer for the drain's lane order.
+        self._rr = 0
         self._pending_rows = 0
         self._leader_active = False
         self._scorer = scorer
+        self.drr_quantum = max(1, int(drr_quantum))
+        # QoS policy (qos.policy.QoSPolicy, duck-typed on weight_of):
+        # None = every lane weighs 1.0.
+        self._qos_policy = qos_policy
         # Canary candidate scorer (None = no canary in flight); snapshotted
         # per flush exactly like the active scorer.
         self._candidate = None
@@ -134,6 +175,22 @@ class ScorerBatcher:
         with self._cv:
             self._candidate = scorer
 
+    def set_qos_policy(self, policy) -> None:
+        """Install/clear the tenant QoS policy feeding the DRR weights
+        (dynconfig observer; None = unweighted lanes)."""
+        with self._cv:
+            self._qos_policy = policy
+
+    def _weight(self, tenant: str) -> float:
+        policy = self._qos_policy
+        if policy is None:
+            return 1.0
+        try:
+            return max(float(policy.weight_of(tenant)), 1e-9)
+        except Exception as exc:  # noqa: BLE001 — a bad policy must not wedge flushes
+            logger.warning("qos policy weight_of(%r) failed: %s", tenant, exc)
+            return 1.0
+
     @property
     def has_scorer(self) -> bool:
         return self._scorer is not None
@@ -144,12 +201,15 @@ class ScorerBatcher:
 
     # -- the EdgeScorer surface ----------------------------------------------
 
-    def score(self, features, *, src_buckets=None, dst_buckets=None, candidate=False, scorer=None):  # dflint: hotpath
+    def score(self, features, *, src_buckets=None, dst_buckets=None, candidate=False, scorer=None, tenant=""):  # dflint: hotpath
         features = np.asarray(features, dtype=np.float32)
-        req = _Request(features, src_buckets, dst_buckets, candidate, scorer)
+        req = _Request(features, src_buckets, dst_buckets, candidate, scorer, tenant)
         with self._cv:
-            self._pending.append(req)
-            self._pending_rows += features.shape[0]
+            lane = self._lanes.get(req.tenant)
+            if lane is None:
+                lane = self._lanes[req.tenant] = deque()
+            lane.append(req)
+            self._pending_rows += req.rows
             lead = not self._leader_active
             if lead:
                 self._leader_active = True
@@ -177,25 +237,124 @@ class ScorerBatcher:
 
     def _flush_as_leader(self) -> None:
         deadline = time.monotonic() + self.linger_s
-        with self._cv:
-            try:
-                while self._pending_rows < self.max_batch_rows:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    self._cv.wait(remaining)
-                batch = self._pending
-                self._pending = []
-                self._pending_rows = 0
-                # ONE snapshot of BOTH scorers for the whole flush; a
-                # canary uninstalled mid-queue pins its requests to the
-                # active scorer (never an error, never half-a-batch on
-                # each model version).
-                scorer = self._scorer
-                candidate = self._candidate if self._candidate is not None else scorer
-            finally:
+        try:
+            while True:
+                with self._cv:
+                    while self._pending_rows < self.max_batch_rows:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(remaining)
+                    batch = self._drain_locked()
+                    leftover = self._pending_rows > 0
+                    # ONE snapshot of BOTH scorers for the whole flush; a
+                    # canary uninstalled mid-queue pins its requests to the
+                    # active scorer (never an error, never half-a-batch on
+                    # each model version).
+                    scorer = self._scorer
+                    candidate = self._candidate if self._candidate is not None else scorer
+                    if not leftover:
+                        self._leader_active = False
+                if batch:
+                    self._dispatch(batch, scorer, candidate)
+                if not leftover:
+                    return
+                # Cap-limited drain left requests queued: keep the
+                # leadership and flush again immediately (no second
+                # linger — the backlog IS the coalescing).
+                deadline = time.monotonic()
+        except BaseException:
+            # A dispatch escape must not leave the queue leaderless
+            # forever — followers would park on their done events.
+            with self._cv:
                 self._leader_active = False
-        self._dispatch(batch, scorer, candidate)
+            raise
+
+    def _drain_locked(self) -> List[_Request]:
+        """Take up to ``max_batch_rows`` rows off the lanes in
+        deficit-round-robin order (module doc).  Single active lane =
+        whole-queue swap, bit-equal to the pre-QoS behavior."""
+        lanes = self._lanes
+        if not lanes:
+            return []
+        if len(lanes) == 1:
+            tenant, dq = next(iter(lanes.items()))
+            batch = list(dq)
+            lanes.clear()
+            self._deficit.clear()
+            self._pending_rows = 0
+            return batch
+        batch: List[_Request] = []
+        rows = 0
+        # Rotating lane order: the guarantee pass's cap spillover must
+        # not always favor the same arrival-order prefix.
+        keys = list(lanes.keys())
+        start = self._rr % len(keys)
+        self._rr += 1
+        order = keys[start:] + keys[:start]
+        # Anti-starvation guarantee: every backlogged lane lands its
+        # HEAD request in every drain — deficit arithmetic alone can
+        # park a 1-weight lane behind a 100-weight flood for several
+        # cap-limited flushes (weight × quantum ≥ the row cap means the
+        # flood eats the whole batch before the small lane's turn).
+        for tenant in order:
+            dq = lanes.get(tenant)
+            if not dq or rows >= self.max_batch_rows:
+                continue
+            req = dq.popleft()
+            # The head rides on credit: the deficit goes negative so the
+            # DRR passes below charge it against the lane's future share
+            # (weights stay honest over time).
+            self._deficit[tenant] = (
+                self._deficit.get(tenant, 0.0) - req.rows
+            )
+            batch.append(req)
+            rows += req.rows
+            if not dq:
+                lanes.pop(tenant, None)
+                self._deficit.pop(tenant, None)
+        while rows < self.max_batch_rows and any(
+            lanes.get(t) for t in order
+        ):
+            progressed = False
+            for tenant in order:
+                dq = lanes.get(tenant)
+                if not dq:
+                    continue
+                self._deficit[tenant] = (
+                    self._deficit.get(tenant, 0.0)
+                    + self.drr_quantum * self._weight(tenant)
+                )
+                while (
+                    dq
+                    and rows < self.max_batch_rows
+                    and self._deficit[tenant] >= dq[0].rows
+                ):
+                    req = dq.popleft()
+                    self._deficit[tenant] -= req.rows
+                    batch.append(req)
+                    rows += req.rows
+                    progressed = True
+                if not dq:
+                    # Lane drained: drop it and reset its deficit
+                    # (classic DRR — an idle lane must not bank credit).
+                    lanes.pop(tenant, None)
+                    self._deficit.pop(tenant, None)
+            if not progressed and rows < self.max_batch_rows:
+                # Pathological quanta (microscopic weights vs a huge
+                # head request): force the first backlogged head through
+                # rather than spinning deficit passes — progress per
+                # pass is a structural guarantee, not a tuning outcome.
+                for tenant in order:
+                    dq = lanes.get(tenant)
+                    if dq:
+                        self._deficit[tenant] = max(
+                            self._deficit.get(tenant, 0.0),
+                            float(dq[0].rows),
+                        )
+                        break
+        self._pending_rows -= rows
+        return batch
 
     def _pad_size(self, rows: int) -> int:
         i = bisect.bisect_left(self.pad_buckets, rows)

@@ -648,6 +648,7 @@ class Peer:
         priority: Priority = Priority.LEVEL0,
         tag: str = "",
         application: str = "",
+        tenant: str = "",
     ) -> None:
         self.id = id
         self.task = task
@@ -655,6 +656,9 @@ class Peer:
         self.priority = priority
         self.tag = tag
         self.application = application
+        # Tenant identity (DESIGN.md §26): stamped from the daemon's
+        # declared/derived tenant at registration; "" = default tenant.
+        self.tenant = tenant
         self.range: Optional[tuple] = None
         # Lock-free FSM-state mirrors for the vectorized serving gather:
         # `fsm.current` takes the FSM's RLock per read, which the rule

@@ -104,6 +104,45 @@ class SchedulerService:
         # state lives in slot columns from birth and the evaluate path
         # never marshals objects into the matrix.
         self._host_store = getattr(scheduling.evaluator, "feature_cache", None)
+        # Tenant QoS policy (DESIGN.md §26): installed via dynconfig
+        # (set_qos_policy) and re-published on announce answers so
+        # daemons converge on it without their own manager dependency
+        # (the §24 ring re-publication discipline).
+        self.qos_policy = None
+
+    # -- tenant QoS (DESIGN.md §26) ------------------------------------------
+
+    def set_qos_policy(self, policy) -> None:
+        """Install a ``qos.QoSPolicy`` across this scheduler's
+        enforcement points: admission accounting (per-tenant caps +
+        over-quota shedding) and the scorer batcher's DRR weights."""
+        self.qos_policy = policy
+        guard = self.shard_guard
+        if guard is not None and guard.admission is not None:
+            acct = guard.admission.accounting
+            if acct is None:
+                from ..qos.accounting import TenantAccounting
+
+                guard.admission.accounting = TenantAccounting(policy)
+            else:
+                acct.set_policy(policy)
+        batcher = getattr(self.scheduling.evaluator, "batcher", None)
+        if batcher is not None:
+            batcher.set_qos_policy(policy)
+
+    def on_qos_config(self, config: dict) -> None:
+        """Dynconfig observer: adopt the manager-published ``tenant_qos``
+        blob.  Malformed payloads are skipped (an observer exception
+        would take down the dynconfig refresh for every observer)."""
+        payload = config.get("tenant_qos")
+        if not isinstance(payload, dict) or not payload:
+            return
+        from ..qos.policy import QoSPolicy
+
+        try:
+            self.set_qos_policy(QoSPolicy.from_payload(payload))
+        except (KeyError, TypeError, ValueError):
+            logger.warning("ignoring malformed tenant_qos payload")
 
     # -- registration -------------------------------------------------------
 
@@ -117,14 +156,16 @@ class SchedulerService:
         priority: Priority = Priority.LEVEL0,
         tag: str = "",
         application: str = "",
+        tenant: str = "",
         blocklist: Optional[Set[str]] = None,
     ) -> RegisterResult:
         if self.shard_guard is not None:
             # Ownership before any state is created: a mis-routed
             # register must steer to the owner, not seed a split-brain
-            # swarm here.  Admission next — lowest priority sheds first.
+            # swarm here.  Admission next — the noisy tenant's lowest
+            # priority band sheds first (DESIGN.md §26).
             self.shard_guard.check_task(task_id or idgen.task_id(url))
-            self.shard_guard.admit(priority)
+            self.shard_guard.admit(priority, tenant=tenant)
         host = self.resource.store_host(host)
         freshly_bound = False
         if self._host_store is not None:
@@ -149,6 +190,7 @@ class SchedulerService:
             priority=priority,
             tag=tag,
             application=application,
+            tenant=tenant,
         )
         # Resource.store_peer inserts into the task DAG and host peer map
         # for newly created peers — single insertion point.
@@ -222,18 +264,20 @@ class SchedulerService:
             _try_event(peer.fsm, "Download")
         return RegisterResult(peer=peer, size_scope=scope, schedule=schedule)
 
-    def announce_host(self, host: Host) -> Host:
+    def announce_host(self, host: Host, *, tenant: str = "") -> Host:
         """Host stats announce (service_v2 AnnounceHost): store-or-refresh
         the host record and WRITE ITS COLUMNS on arrival (DESIGN.md §18)
         — the announce decode is the marshalling point, not the evaluate
         path.  Both wire adapters and the in-process
-        ``daemon.host_announcer`` land here."""
+        ``daemon.host_announcer`` land here.  ``tenant`` feeds the
+        per-tenant accounting + announce-rate caps (DESIGN.md §26)."""
         t0 = time.monotonic()
         if self.shard_guard is not None:
             # Host-scoped: every shard accepts announces (each keeps its
-            # own host inventory) — only the shed gate applies, and the
-            # handling latency feeds the shard's windowed burn signal.
-            self.shard_guard.admit(Priority.LEVEL0)
+            # own host inventory) — only the shed gate applies (tenant
+            # announce caps included), and the handling latency feeds
+            # the shard's windowed burn signal.
+            self.shard_guard.admit(Priority.LEVEL0, tenant=tenant)
         stored = self.resource.store_host(host)
         if stored is not host:
             # Refresh announce-time stats AND addresses on the existing

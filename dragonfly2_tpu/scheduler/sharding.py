@@ -325,6 +325,21 @@ class AdmissionController:
     LEVEL0 does.  LEVEL0 (interactive) is never shed by the band (it
     only fails when the in-flight bound is exceeded at 2× — the hard
     wall protecting the process itself).
+
+    Tenant QoS (DESIGN.md §26), with a ``TenantAccounting`` attached:
+
+    - every request is accounted per tenant; a tenant past its declared
+      ``announce_qps`` cap (possibly autopilot-tightened) is refused
+      outright;
+    - a tenant's declared priority class FLOORS its requests' priority
+      (a "background" tenant cannot claim LEVEL0);
+    - under overload the shed floor scales by the tenant's
+      ``noise_factor`` — the over-quota tenant's lowest bands shed
+      FIRST, a within-quota tenant keeps its bands until overload
+      deepens;
+    - the SLO autopilot's ``shed_bias`` adds straight into the overload
+      fraction, tightening the floor fleet-wide while a declared SLO
+      burns (qos/autopilot.py).
     """
 
     def __init__(
@@ -334,12 +349,20 @@ class AdmissionController:
         p99_budget_s: float = 0.050,
         window_s: float = 5.0,
         retry_after_s: float = 1.0,
+        accounting=None,
     ) -> None:
         self._mu = threading.Lock()
         self.max_inflight = max_inflight
         self.p99_budget_s = p99_budget_s
         self.window_s = window_s
         self.retry_after_s = retry_after_s
+        # qos.accounting.TenantAccounting — the ONE object behind the
+        # announce path's per-tenant costs; None = tenant-blind admission
+        # (the pre-§26 behavior).
+        self.accounting = accounting
+        # Autopilot output: added into overload() while a declared SLO
+        # burns; 0.0 on the steady state.
+        self._shed_bias = 0.0
         self._inflight = 0
         # Private sketches (NOT the registry-global ANNOUNCE_SECONDS):
         # with N in-process shards (sim/bench) the default registry is
@@ -381,25 +404,67 @@ class AdmissionController:
             p99 = prev.quantile(0.99)
         return p99
 
+    def set_shed_bias(self, bias: float) -> None:
+        """Autopilot input: raises the effective overload fraction (the
+        shed floor tightens) while a declared SLO burns; 0 restores the
+        measured signals alone."""
+        with self._mu:
+            self._shed_bias = max(0.0, min(1.0, float(bias)))
+
+    def shed_bias(self) -> float:
+        with self._mu:
+            return self._shed_bias
+
     def overload(self) -> float:
-        """Saturation fraction in [0, 1]: max of the two burn signals,
-        0 while both are inside budget."""
+        """Saturation fraction in [0, 1]: max of the two burn signals
+        plus the autopilot's shed bias, 0 while inside budget with no
+        SLO burning."""
         with self._mu:
             inflight = self._inflight
+            bias = self._shed_bias
         q_burn = inflight / self.max_inflight if self.max_inflight else 0.0
         p99 = self._windowed_p99()
         l_burn = (p99 / self.p99_budget_s) if p99 else 0.0
         # Inside-budget readings are 0 overload; past budget the excess
         # maps linearly into (0, 1] (2× budget == fully overloaded).
-        return max(
-            0.0, min(1.0, max(q_burn, l_burn) - 1.0)
-        )
+        # The autopilot's bias ADDS to the normalized fraction — a
+        # burning fleet SLO tightens the floor even while this shard's
+        # own signals read healthy (the declared SLO may measure an
+        # end-to-end latency the admission sketch cannot see).
+        base = max(0.0, min(1.0, max(q_burn, l_burn) - 1.0))
+        return min(1.0, base + bias)
 
     # -- decision ------------------------------------------------------------
 
-    def admit(self, priority: Priority = Priority.LEVEL0) -> None:
+    def admit(
+        self, priority: Priority = Priority.LEVEL0, *, tenant: str = ""
+    ) -> None:
         """Raise ``ShardSaturatedError`` when this request's priority
-        class is in the current shed band (lowest classes first)."""
+        class is in the current shed band (lowest classes first; the
+        over-quota tenant's bands first among tenants)."""
+        accounting = self.accounting
+        noise = 1.0
+        if accounting is not None:
+            qos = accounting.policy.for_tenant(tenant)
+            # The tenant's declared class floors the request's priority:
+            # a background tenant cannot claim LEVEL0 interactivity.
+            priority = Priority(max(int(priority), int(qos.priority)))
+            if not accounting.note(tenant):
+                # Announce-rate cap (declared, or autopilot-tightened
+                # for over-quota tenants): refused outright, before any
+                # per-request work — the whole point of the cap.
+                faultinject.fire("scheduler.qos.shed")
+                from ..qos.metrics import QOS_RATE_CAPPED_TOTAL
+
+                accounting.record_shed(tenant)
+                QOS_RATE_CAPPED_TOTAL.inc(
+                    tenant_class=accounting.class_of(tenant)
+                )
+                raise ShardSaturatedError(
+                    retry_after_s=self.retry_after_s,
+                    reason="tenant announce-rate cap",
+                )
+            noise = accounting.noise_factor(tenant)
         over = self.overload()
         with self._mu:
             hard_wall = self._inflight >= 2 * self.max_inflight
@@ -411,9 +476,21 @@ class AdmissionController:
             )
         if over <= 0.0 or priority is Priority.LEVEL0:
             return
-        shed_floor = (1.0 - over) * int(Priority.LEVEL6)
+        # The noisy tenant's floor drops fastest: at the same overload a
+        # 3×-over-quota tenant sheds bands three times deeper than a
+        # within-quota one (noise ∈ [1, 3], qos/accounting.py).
+        shed_floor = (1.0 - min(1.0, over * noise)) * int(Priority.LEVEL6)
         if int(priority) >= shed_floor:
+            faultinject.fire("scheduler.qos.shed")
             metrics.SHARD_SHED_TOTAL.inc(priority=f"level{int(priority)}")
+            if accounting is not None:
+                from ..qos.metrics import QOS_SHED_TOTAL
+
+                accounting.record_shed(tenant)
+                QOS_SHED_TOTAL.inc(
+                    tenant_class=accounting.class_of(tenant),
+                    priority=f"level{int(priority)}",
+                )
             raise ShardSaturatedError(
                 retry_after_s=self.retry_after_s * (1.0 + over),
                 reason=(
@@ -564,9 +641,11 @@ class ShardGuard:
             ring_version=ring.version,
         )
 
-    def admit(self, priority: Priority = Priority.LEVEL0) -> None:
+    def admit(
+        self, priority: Priority = Priority.LEVEL0, *, tenant: str = ""
+    ) -> None:
         if self.admission is not None:
-            self.admission.admit(priority)
+            self.admission.admit(priority, tenant=tenant)
 
     def track(self):
         if self.admission is not None:

@@ -73,6 +73,14 @@ class MetricJournal:
         self.fsync = fsync
         self.written = 0
         self.dropped = 0
+        # Payload of the most recent write_snapshot (None before the
+        # first) — the autopilot's live ingest source.
+        self.last_snapshot = None
+        # Optional callable(snapshot_dict) invoked after each cadence
+        # write (qos/autopilot.py rides the journal's clock: its live
+        # input IS the frame replay will read back).  Exceptions are
+        # swallowed with a log — a consumer bug must not stop journaling.
+        self.on_snapshot = None
         self._seq = 0
         self._closed = False
         self._mu = _raw_lock()
@@ -99,10 +107,16 @@ class MetricJournal:
 
     def write_snapshot(self) -> bool:
         """Append one cumulative snapshot frame; False = write failed
-        (counted in ``dropped``)."""
+        (counted in ``dropped``).  The frame's payload stays readable on
+        ``last_snapshot`` — the SLO autopilot's live loop ingests the
+        SAME dict replay will read back off disk (qos/autopilot.py), so
+        live decisions and journal replay are identical by construction.
+        """
         from . import faultinject
 
-        payload = json.dumps(self._payload(), sort_keys=True).encode()
+        snapshot = self._payload()
+        self.last_snapshot = snapshot
+        payload = json.dumps(snapshot, sort_keys=True).encode()
         frame = (
             FRAME_MAGIC
             + f"{len(payload)} {zlib.crc32(payload) & 0xFFFFFFFF:08x}\n".encode()
@@ -143,7 +157,17 @@ class MetricJournal:
         # Bounded waits (DF008 timeout sweep): the stop event doubles as
         # the cadence clock, so close() never waits out a full interval.
         while not self._stop.wait(self.interval_s):
-            self.write_snapshot()
+            if self.write_snapshot():
+                sink = self.on_snapshot
+                if sink is not None:
+                    try:
+                        sink(self.last_snapshot)
+                    except Exception:  # noqa: BLE001 — consumer bug ≠ journal outage
+                        import logging
+
+                        logging.getLogger(__name__).exception(
+                            "metric-journal snapshot consumer failed"
+                        )
 
     def close(self) -> None:
         """Stop the cadence thread, write the final snapshot, close the

@@ -18,6 +18,8 @@ if str(REPO) not in sys.path:
 from tools.bench_report import (  # noqa: E402
     DOWNLOAD_BEGIN,
     DOWNLOAD_END,
+    QOS_BEGIN,
+    QOS_END,
     SWARM_BEGIN,
     SWARM_END,
     TELEMETRY_BEGIN,
@@ -25,10 +27,12 @@ from tools.bench_report import (  # noqa: E402
     TRAJECTORY_BEGIN,
     TRAJECTORY_END,
     collect_download_rounds,
+    collect_qos_rounds,
     collect_rounds,
     collect_swarm_rounds,
     collect_telemetry_rounds,
     render_download,
+    render_qos,
     render_swarm,
     render_telemetry,
     render_trajectory,
@@ -118,6 +122,48 @@ class TestTrajectoryStaleness:
         )
         for data in sw_rounds:
             assert f"| r{data['round']:02d} |" in committed
+
+    def test_committed_qos_table_is_current(self):
+        """Same staleness gate for the multi-tenant QoS rounds
+        (tools/bench_qos.py → BENCH_QOS_r*.json)."""
+        qos_rounds = collect_qos_rounds(REPO)
+        assert qos_rounds, "no BENCH_QOS_r*.json rounds found at the repo root"
+        text = (REPO / "BENCHMARKS.md").read_text(encoding="utf-8")
+        begin = text.find(QOS_BEGIN)
+        end = text.find(QOS_END)
+        assert begin >= 0 and end > begin, "BENCHMARKS.md qos markers missing"
+        committed = text[begin : end + len(QOS_END)]
+        fresh = render_qos(qos_rounds)
+        assert committed == fresh, (
+            "BENCHMARKS.md qos table is stale — regenerate with "
+            "`python -m tools.bench_report --update`"
+        )
+        for data in qos_rounds:
+            assert f"| r{data['round']:02d} |" in committed
+
+    def test_qos_round_holds_the_isolation_evidence(self):
+        """ISSUE 15 acceptance: the committed round's shaped burst moved
+        tenant A's announce p99 and TTLB by <10% while the unshaped arm
+        documents real interference, and the flood was actually
+        shed/capped."""
+        for data in collect_qos_rounds(REPO):
+            assert data["ok"] is True, data.get("error")
+            assert data["value"] >= 90.0, (
+                "isolation bar: shaped movement must stay <10%"
+            )
+            move = data["movement"]
+            assert max(
+                move["shaped_announce_p99_pct"], move["shaped_ttlb_pct"]
+            ) < 10.0
+            assert move["unshaped_ttlb_pct"] > 50.0, (
+                "the unshaped arm shows no interference — vacuous drill"
+            )
+            shaped = data["arms"]["shaped"]
+            assert shaped["b_sheds"] + shaped["b_throttled"] > 0
+            assert (
+                shaped["a_downloads_ok"]
+                == data["config"]["a_downloads"]
+            )
 
     def test_swarm_round_holds_the_acceptance_evidence(self):
         """The committed fleet round really drove ≥100k simulated peers

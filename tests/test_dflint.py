@@ -395,12 +395,13 @@ class TestDF004:
             """,
             relpath="dragonfly2_tpu/daemon/upload.py",
         )
-        # Two missing inventoried sites (body + sendfile), one finding
-        # each; PR 11's DF007 hotpath inventory on this relpath also
-        # fires for the absent UploadManager.serve_piece — filter to the
-        # seam rule under test.
+        # Three missing inventoried sites (body + sendfile + the PR-15
+        # throttle gate), one finding each; PR 11's DF007 hotpath
+        # inventory on this relpath also fires for the absent
+        # UploadManager.serve_piece — filter to the seam rule under
+        # test.
         df004 = [f for f in fs if f.rule == "DF004"]
-        assert len(df004) == 2
+        assert len(df004) == 3
         assert any("daemon.upload.body" in f.message for f in df004)
         assert any("daemon.upload.sendfile" in f.message for f in df004)
 
@@ -3629,6 +3630,46 @@ class TestDF017Fixtures:
         )
         assert "DF017" not in rules_of(fs)
 
+    def test_raw_tenant_id_label_fires_by_name(self):
+        """ISSUE 15 satellite: a raw tenant id is one series per tenant
+        on a million-user fleet — the fixture proves the ban fires BY
+        NAME, and that the bounded tenant_class label passes."""
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            C = _reg.counter(
+                "scheduler_qos_served_total", "per tenant!", ["tenant_id"]
+            )
+            """,
+        )
+        assert any(
+            f.rule == "DF017" and "tenant_id" in f.message for f in fs
+        )
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            C = _reg.counter(
+                "scheduler_qos_served_total", "by class", ["tenant_class"]
+            )
+            """,
+        )
+        assert "DF017" not in rules_of(fs)
+        # The bare spelling is banned too.
+        fs = lint(
+            """
+            from ..utils.metrics import default_registry as _reg
+
+            C = _reg.counter(
+                "scheduler_qos_served_total", "per tenant!", ["tenant"]
+            )
+            """,
+        )
+        assert any(
+            f.rule == "DF017" and "'tenant'" in f.message for f in fs
+        )
+
     def test_naming_counter_without_total_fires(self):
         fs = lint(
             """
@@ -3761,6 +3802,21 @@ class TestDF017MutationSensitivity:
             if f.rule == "DF017"
         ]
         assert any("daemon_piece_fetch_seconds" in f.message for f in fs)
+
+    def test_deleting_qos_shed_counter_fails_df017(self):
+        """ISSUE 15: the QoS metrics are inventoried — deleting the
+        tenant shed counter fails tier-1 by name."""
+        relpath = "dragonfly2_tpu/qos/metrics.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        assert '"scheduler_qos_shed_total"' in source
+        mutated = source.replace(
+            '"scheduler_qos_shed_total"', '"scheduler_qos_gone_total"'
+        )
+        fs = [
+            f for f in self._lint_source(relpath, mutated)
+            if f.rule == "DF017"
+        ]
+        assert any("scheduler_qos_shed_total" in f.message for f in fs)
 
     def test_deleting_slo_gauge_fails_df017(self):
         relpath = "dragonfly2_tpu/utils/slo.py"

@@ -1,0 +1,971 @@
+"""Multi-tenant QoS plane (DESIGN.md §26, ISSUE 15).
+
+Covers: tenant identity + policy parsing, the consolidated
+TenantAccounting (usage shares, announce caps, over-quota signal), the
+hierarchical TrafficShaper (and the add_task budget-reset regression),
+the upload-path bandwidth gate, the weighted-fair DRR drain property
+tests (no starvation / per-tenant FIFO / single-tenant oracle parity),
+tenant-aware admission shedding (noisy tenant's lowest band first), the
+SLO autopilot (tighten/hysteresis-relax + journal-replay parity), the
+manager's tenant_qos publication + tenant derivation, preheat's
+background class, the ShardRouter saturation retry budget, and the
+bench_qos --smoke schema gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from dragonfly2_tpu.qos import (  # noqa: E402
+    DEFAULT_TENANT,
+    QoSPolicy,
+    SLOAutopilot,
+    TenantAccounting,
+    TenantQoS,
+    derive_tenant,
+    parse_tenant_qos,
+)
+from dragonfly2_tpu.scheduler.microbatch import (  # noqa: E402
+    ScorerBatcher,
+    _Request,
+)
+from dragonfly2_tpu.scheduler.sharding import (  # noqa: E402
+    AdmissionController,
+    ShardSaturatedError,
+)
+from dragonfly2_tpu.utils.types import Priority  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# policy + identity
+# ---------------------------------------------------------------------------
+
+
+class TestTenantPolicy:
+    def test_derive_tenant_is_deterministic_and_sanitized(self):
+        assert derive_tenant("user-abc123") == "t-user-abc123"
+        assert derive_tenant("we ird/chars!") == "t-we-ird-chars"
+        assert derive_tenant("") == DEFAULT_TENANT
+        assert derive_tenant("x") == derive_tenant("x")
+
+    def test_parse_validates_entries(self):
+        with pytest.raises(ValueError, match="tenant_class"):
+            parse_tenant_qos({"t-a": {"tenant_class": "platinum"}})
+        with pytest.raises(ValueError, match="weight"):
+            parse_tenant_qos({"t-a": {"weight": 0}})
+        with pytest.raises(ValueError, match="priority"):
+            parse_tenant_qos({"t-a": {"priority": 9}})
+        with pytest.raises(ValueError, match="unknown keys"):
+            parse_tenant_qos({"t-a": {"upload_mbps": 1}})
+        with pytest.raises(ValueError, match="object"):
+            parse_tenant_qos({"t-a": 5})
+        with pytest.raises(ValueError, match="object"):
+            parse_tenant_qos([1, 2])
+
+    def test_payload_roundtrip_and_defaults(self):
+        p = QoSPolicy.from_payload({
+            "t-gold": {"tenant_class": "gold", "weight": 4.0},
+            "default": {"tenant_class": "bronze", "weight": 2.0},
+        })
+        p2 = QoSPolicy.from_payload(p.to_payload())
+        assert p2.to_payload() == p.to_payload()
+        # Unknown tenants inherit the default row under their own id.
+        row = p.for_tenant("t-unknown")
+        assert row.tenant == "t-unknown"
+        assert row.tenant_class == "bronze"
+        assert row.weight == 2.0
+        assert p.class_of("t-gold") == "gold"
+        assert p.weight_of("t-gold") == 4.0
+
+    def test_empty_policy_serves_defaults(self):
+        p = QoSPolicy()
+        row = p.for_tenant("anyone")
+        assert row.weight == 1.0
+        assert row.announce_qps == 0.0
+        row.validate()
+
+
+# ---------------------------------------------------------------------------
+# accounting
+# ---------------------------------------------------------------------------
+
+
+def _two_tenant_policy(**b_extra) -> QoSPolicy:
+    return QoSPolicy.from_payload({
+        "t-a": {"tenant_class": "gold", "weight": 4.0},
+        "t-b": {"tenant_class": "background", "weight": 1.0, **b_extra},
+    })
+
+
+class TestTenantAccounting:
+    def test_over_quota_and_noise_factor(self):
+        acct = TenantAccounting(_two_tenant_policy(), window_s=60.0)
+        for _ in range(100):
+            acct.note("t-b")
+        for _ in range(100):
+            acct.note("t-a")
+        # Equal usage on a 4:1 weight split: b is 2.5x over quota.
+        assert acct.over_quota("t-b") == pytest.approx(2.5, rel=0.01)
+        assert acct.over_quota("t-a") == pytest.approx(0.625, rel=0.01)
+        assert acct.noise_factor("t-a") == 1.0
+        assert 1.0 < acct.noise_factor("t-b") <= 3.0
+
+    def test_announce_cap_refuses_past_bucket(self):
+        acct = TenantAccounting(
+            _two_tenant_policy(announce_qps=10, announce_burst=5),
+            window_s=60.0,
+        )
+        # Make b over quota first (caps only tighten via autopilot, the
+        # declared cap applies regardless).
+        results = [acct.note("t-b") for _ in range(50)]
+        assert results.count(False) >= 40  # burst 5 + refill crumbs
+        snap = acct.snapshot()["t-b"]
+        assert snap["capped"] >= 40
+        assert snap["requests"] == 50  # capped requests still counted
+
+    def test_cap_factor_tightens_only_over_quota_tenants(self):
+        acct = TenantAccounting(
+            QoSPolicy.from_payload({
+                "t-a": {"tenant_class": "gold", "weight": 4.0,
+                        "announce_qps": 1000, "announce_burst": 1000},
+                "t-b": {"tenant_class": "background", "weight": 1.0,
+                        "announce_qps": 1000, "announce_burst": 1000},
+            }),
+            window_s=60.0,
+        )
+        # b floods; a trickles — b over quota, a inside.
+        for _ in range(400):
+            acct.note("t-b")
+        for _ in range(40):
+            acct.note("t-a")
+        # Autopilot tightening; the factor clamps at 0.05 (never a full
+        # blackout), so the effective cap is 50 qps / burst 50.
+        acct.set_cap_factor(0.01)
+        b_ok = sum(acct.note("t-b") for _ in range(200))
+        a_ok = sum(acct.note("t-a") for _ in range(200))
+        assert b_ok <= 60, "over-quota tenant kept its declared cap"
+        assert a_ok == 200, "within-quota tenant was tightened"
+
+    def test_snapshot_is_deterministic_in_the_stream(self):
+        def replay():
+            acct = TenantAccounting(_two_tenant_policy(), window_s=1e9)
+            for i in range(300):
+                acct.note("t-b" if i % 3 else "t-a", now=float(i))
+                if i % 7 == 0:
+                    acct.record_shed("t-b")
+                if i % 11 == 0:
+                    acct.record_bytes("t-a", 1024)
+            return acct.snapshot()
+
+        assert replay() == replay()
+
+
+# ---------------------------------------------------------------------------
+# traffic shaper (tentpole hierarchy + satellite fix)
+# ---------------------------------------------------------------------------
+
+
+class TestTrafficShaperQoS:
+    def test_hot_task_budget_survives_cold_join(self):
+        """Satellite regression: add_task used to reset EVERY budget to
+        an equal split, discarding allocate()'s history-weighted
+        proportions."""
+        from dragonfly2_tpu.daemon.traffic_shaper import TrafficShaper
+
+        sh = TrafficShaper(100.0, min_share=0.05)
+        sh.add_task("hot")
+        sh.add_task("warm")
+        sh.record("hot", 9000)
+        sh.record("warm", 1000)
+        alloc = sh.allocate()
+        assert alloc["hot"] > 70.0  # history-weighted
+        sh.add_task("cold")
+        # The joiner gets the min-share floor; the hot task keeps its
+        # proportional budget (scaled by the carve, NOT reset to 1/3).
+        assert sh.budget("cold") == pytest.approx(5.0)
+        assert sh.budget("hot") > 70.0
+        assert sh.budget("hot") / sh.budget("warm") == pytest.approx(
+            alloc["hot"] / alloc["warm"], rel=1e-6
+        )
+
+    def test_rejoin_is_idempotent(self):
+        from dragonfly2_tpu.daemon.traffic_shaper import TrafficShaper
+
+        sh = TrafficShaper(100.0)
+        sh.add_task("a")
+        sh.record("a", 500)
+        before = sh.budget("a")
+        sh.add_task("a")  # re-register must not carve again
+        assert sh.budget("a") == before
+
+    def test_tenant_weight_split_and_cap(self):
+        from dragonfly2_tpu.daemon.traffic_shaper import TrafficShaper
+
+        policy = QoSPolicy.from_payload({
+            "t-a": {"tenant_class": "gold", "weight": 3.0},
+            "t-b": {"tenant_class": "background", "weight": 1.0,
+                    "upload_rate_bytes_s": 10.0},
+        })
+        sh = TrafficShaper(100.0)
+        sh.set_policy(policy)
+        sh.add_task("a1", "t-a")
+        sh.add_task("b1", "t-b")
+        sh.record("a1", 100)
+        sh.record("b1", 100)
+        alloc = sh.allocate()
+        # b's 25-weight share clips at its 10 B/s cap; the surplus goes
+        # to the uncapped tenant.
+        assert alloc["b1"] == pytest.approx(10.0)
+        assert alloc["a1"] == pytest.approx(90.0)
+
+    def test_single_tenant_matches_policy_free_behavior(self):
+        from dragonfly2_tpu.daemon.traffic_shaper import TrafficShaper
+
+        def run(with_policy: bool):
+            sh = TrafficShaper(100.0)
+            if with_policy:
+                sh.set_policy(_two_tenant_policy())
+            sh.add_task("x", "t-a")
+            sh.add_task("y", "t-a")
+            sh.record("x", 900)
+            sh.record("y", 100)
+            return sh.allocate()
+
+        assert run(True) == run(False)
+
+
+# ---------------------------------------------------------------------------
+# upload path bandwidth gate
+# ---------------------------------------------------------------------------
+
+
+class TestUploadQoS:
+    def _um(self, tmp_path, policy=None):
+        from dragonfly2_tpu.daemon.storage import DaemonStorage
+        from dragonfly2_tpu.daemon.upload import UploadManager
+
+        st = DaemonStorage(str(tmp_path / "s"), prefer_native=False)
+        st.register_task("t", piece_size=1024, content_length=4096)
+        for n in range(4):
+            st.write_piece("t", n, bytes(1024))
+        um = UploadManager(st, concurrent_limit=8, qos_policy=policy)
+        return um
+
+    def test_tenant_cap_throttles_and_accounts(self, tmp_path):
+        from dragonfly2_tpu.daemon.upload import UploadThrottled
+
+        policy = QoSPolicy.from_payload({
+            "t-b": {"tenant_class": "background",
+                    "upload_rate_bytes_s": 2048.0},
+        })
+        um = self._um(tmp_path, policy)
+        um.register_task_tenant("t", "t-b")
+        # The post-paid bucket admits while balance > 0 (one second of
+        # headroom = 2048 bytes = 2 pieces), then throttles.
+        assert um.serve_piece("t", 0) == bytes(1024)
+        assert um.serve_piece("t", 1) == bytes(1024)
+        with pytest.raises(UploadThrottled):
+            for n in range(8):
+                um.serve_piece("t", n % 4)
+        assert um.tenant_bytes["t-b"] >= 2048
+        assert um.throttled_count >= 1
+
+    def test_uncapped_tenant_never_throttles(self, tmp_path):
+        um = self._um(tmp_path, QoSPolicy())
+        um.register_task_tenant("t", "t-free")
+        for n in range(16):
+            assert um.serve_piece("t", n % 4) == bytes(1024)
+        assert um.tenant_bytes["t-free"] == 16 * 1024
+
+    def test_no_policy_is_the_pre_qos_gate(self, tmp_path):
+        um = self._um(tmp_path, None)
+        for n in range(16):
+            um.serve_piece("t", n % 4)
+        assert um.throttled_count == 0
+
+    def test_throttle_seam_fires(self, tmp_path):
+        from dragonfly2_tpu.utils import faultinject
+
+        um = self._um(tmp_path, None)
+        inj = faultinject.FaultInjector(
+            [faultinject.FaultSpec(site="daemon.upload.throttle",
+                                   kind="drop", at=(0,))]
+        )
+        faultinject.install(inj)
+        try:
+            with pytest.raises(ConnectionError):
+                um.serve_piece("t", 0)
+        finally:
+            faultinject.install(None)
+        # The gate never claimed a slot on the injected refusal.
+        assert um.active == 0
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair DRR drain (satellite property tests)
+# ---------------------------------------------------------------------------
+
+
+def _mk_req(tenant: str, rows: int, tag: float) -> _Request:
+    return _Request(
+        np.full((rows, 2), tag, dtype=np.float32), None, None, tenant=tenant
+    )
+
+
+def _enqueue(b: ScorerBatcher, req: _Request) -> None:
+    lane = b._lanes.get(req.tenant)
+    if lane is None:
+        lane = b._lanes[req.tenant] = deque()
+    lane.append(req)
+    b._pending_rows += req.rows
+
+
+class TestDRRWeightedFair:
+    def test_flood_cannot_starve_one_weight_tenant(self):
+        """(a) a 100-weight flood vs a 1-weight tenant: every
+        cap-limited drain serves the small tenant SOMETHING."""
+        policy = QoSPolicy.from_payload({
+            "flood": {"tenant_class": "gold", "weight": 100.0},
+            "small": {"tenant_class": "bronze", "weight": 1.0},
+        })
+        b = ScorerBatcher(max_batch_rows=256, qos_policy=policy)
+        rng = np.random.default_rng(0)
+        for i in range(200):
+            _enqueue(b, _mk_req("flood", int(rng.integers(4, 16)), i))
+        for i in range(20):
+            _enqueue(b, _mk_req("small", 8, 1000 + i))
+        drains = 0
+        small_served_per_drain = []
+        while b._pending_rows > 0 and drains < 64:
+            batch = b._drain_locked()
+            drains += 1
+            small_served_per_drain.append(
+                sum(1 for r in batch if r.tenant == "small")
+            )
+            if not any(
+                r.tenant == "small"
+                for dq in [b._lanes.get("small", deque())] for r in dq
+            ):
+                break  # small lane fully drained — starvation impossible now
+        assert all(n >= 1 for n in small_served_per_drain), (
+            "a drain passed over the 1-weight lane entirely: "
+            f"{small_served_per_drain}"
+        )
+
+    def test_per_tenant_fifo_order_preserved(self):
+        """(b) within a tenant, service order is arrival order —
+        whatever the interleaving across tenants."""
+        policy = QoSPolicy.from_payload({
+            "x": {"tenant_class": "gold", "weight": 3.0},
+            "y": {"tenant_class": "silver", "weight": 1.0},
+        })
+        rng = np.random.default_rng(7)
+        b = ScorerBatcher(max_batch_rows=64, qos_policy=policy)
+        seq = {"x": [], "y": []}
+        for i in range(120):
+            tenant = "x" if rng.random() < 0.6 else "y"
+            req = _mk_req(tenant, int(rng.integers(1, 9)), i)
+            seq[tenant].append(req)
+            _enqueue(b, req)
+        served: list = []
+        while b._pending_rows > 0:
+            served.extend(b._drain_locked())
+        for tenant in ("x", "y"):
+            order = [r for r in served if r.tenant == tenant]
+            assert order == seq[tenant], f"{tenant} lane reordered"
+        assert len(served) == 120
+
+    def test_single_tenant_degrades_to_single_queue(self):
+        """(c) one active tenant: the drain is the whole-queue swap —
+        orderings AND scores bit-equal to the pre-QoS single-queue
+        behavior (the §14 scalar-oracle discipline)."""
+
+        class RecScorer:
+            wants_features = True
+
+            def __init__(self):
+                self.calls = []
+
+            def score(self, feats, src_buckets=None, dst_buckets=None):
+                self.calls.append(np.array(feats, copy=True))
+                return feats.sum(axis=1)
+
+        policy = QoSPolicy.from_payload({
+            "only": {"tenant_class": "gold", "weight": 2.0},
+        })
+        rng = np.random.default_rng(3)
+        reqs = [
+            _mk_req("only", int(rng.integers(1, 7)), i) for i in range(40)
+        ]
+        with_qos = ScorerBatcher(qos_policy=policy)
+        for r in reqs:
+            _enqueue(with_qos, r)
+        batch = with_qos._drain_locked()
+        assert batch == reqs, "single-lane drain is not arrival order"
+        assert with_qos._pending_rows == 0 and not with_qos._lanes
+        # End-to-end score parity vs the direct scorer (row independence
+        # + coalesced call on the exact arrival order).
+        scorer = RecScorer()
+        b = ScorerBatcher(scorer, linger_s=0.0, qos_policy=policy)
+        feats = rng.standard_normal((5, 3)).astype(np.float32)
+        out = b.score(feats, tenant="only")
+        np.testing.assert_array_equal(out, feats.sum(axis=1))
+
+    def test_two_tenant_throughput_share_tracks_weights(self):
+        """DRR proportionality: over a long backlog, rows served per
+        cap-limited drain track the declared weights (loosely — DRR is
+        packet-fair, not fluid-fair)."""
+        policy = QoSPolicy.from_payload({
+            "heavy": {"tenant_class": "gold", "weight": 3.0},
+            "light": {"tenant_class": "bronze", "weight": 1.0},
+        })
+        b = ScorerBatcher(max_batch_rows=128, qos_policy=policy)
+        for i in range(300):
+            _enqueue(b, _mk_req("heavy", 8, i))
+            _enqueue(b, _mk_req("light", 8, i))
+        batch = b._drain_locked()
+        heavy_rows = sum(r.rows for r in batch if r.tenant == "heavy")
+        light_rows = sum(r.rows for r in batch if r.tenant == "light")
+        assert light_rows > 0
+        ratio = heavy_rows / light_rows
+        assert 1.5 <= ratio <= 6.0, f"share ratio {ratio} vs weights 3:1"
+
+    def test_threaded_two_tenant_flushes_complete(self):
+        """End-to-end through score(): concurrent tenants coalesce and
+        every follower gets its own rows' scores back."""
+
+        class SumScorer:
+            wants_features = True
+
+            def score(self, feats, src_buckets=None, dst_buckets=None):
+                return feats.sum(axis=1)
+
+        policy = _two_tenant_policy()
+        b = ScorerBatcher(SumScorer(), linger_s=0.002, qos_policy=policy)
+        errors: list = []
+
+        def worker(tenant, tag):
+            rng = np.random.default_rng(tag)
+            for _ in range(30):
+                f = np.full((int(rng.integers(1, 6)), 2), float(tag),
+                            dtype=np.float32)
+                out = b.score(f, tenant=tenant)
+                if not np.array_equal(out, f.sum(axis=1)):
+                    errors.append((tenant, tag))
+
+        threads = [
+            threading.Thread(target=worker, args=("t-a", 1), daemon=True),
+            threading.Thread(target=worker, args=("t-b", 2), daemon=True),
+            threading.Thread(target=worker, args=("t-b", 3), daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            while t.is_alive():
+                t.join(5.0)
+        assert not errors
+        assert b._pending_rows == 0
+
+
+# ---------------------------------------------------------------------------
+# tenant-aware admission
+# ---------------------------------------------------------------------------
+
+
+def _overloaded_controller(policy, *, p99_ratio=1.3) -> AdmissionController:
+    """Controller whose latency burn sits at a controlled intermediate
+    overload (ratio× budget ⇒ overload = ratio − 1)."""
+    ctl = AdmissionController(
+        max_inflight=10_000, p99_budget_s=0.010,
+        accounting=TenantAccounting(policy, window_s=1e9),
+    )
+    for _ in range(300):
+        ctl.observe(0.010 * p99_ratio)
+    return ctl
+
+
+class TestTenantAdmission:
+    def test_noisy_tenant_lowest_band_sheds_first(self):
+        policy = _two_tenant_policy()
+        ctl = _overloaded_controller(policy, p99_ratio=1.3)
+        # Make t-b over quota (usage ≫ its 1/5 weight share).
+        for _ in range(400):
+            ctl.accounting.note("t-b")
+        for _ in range(40):
+            ctl.accounting.note("t-a")
+        over = ctl.overload()
+        assert 0.2 < over < 0.45, over
+        # At this overload a within-quota tenant's LEVEL3 is ADMITTED
+        # (floor ≈ 4.2) while the noisy tenant's LEVEL3 SHEDS (noise
+        # scales its floor down ~3x).
+        ctl.admit(Priority.LEVEL3, tenant="t-a")
+        with pytest.raises(ShardSaturatedError):
+            ctl.admit(Priority.LEVEL3, tenant="t-b")
+        snap = ctl.accounting.snapshot()
+        assert snap["t-b"]["sheds"] >= 1
+        assert snap["t-a"]["sheds"] == 0
+
+    def test_declared_class_floors_priority(self):
+        """A background-class tenant cannot claim LEVEL0: its requests
+        run at its declared priority, which sheds under overload."""
+        policy = QoSPolicy.from_payload({
+            "t-bg": {"tenant_class": "background", "weight": 1.0,
+                     "priority": 6},
+        })
+        ctl = _overloaded_controller(policy, p99_ratio=1.2)
+        with pytest.raises(ShardSaturatedError):
+            ctl.admit(Priority.LEVEL0, tenant="t-bg")
+
+    def test_announce_rate_cap_is_a_typed_refusal(self):
+        policy = QoSPolicy.from_payload({
+            "t-b": {"tenant_class": "background", "weight": 1.0,
+                    "announce_qps": 5, "announce_burst": 2},
+        })
+        ctl = AdmissionController(
+            max_inflight=100,
+            accounting=TenantAccounting(policy, window_s=1e9),
+        )
+        refusals = 0
+        for _ in range(40):
+            try:
+                ctl.admit(Priority.LEVEL0, tenant="t-b")
+            except ShardSaturatedError as exc:
+                refusals += 1
+                assert exc.retry_after_s > 0
+        assert refusals >= 30
+
+    def test_no_accounting_is_the_pre_qos_behavior(self):
+        ctl = AdmissionController(max_inflight=100)
+        for _ in range(50):
+            ctl.admit(Priority.LEVEL6, tenant="t-anything")
+
+    def test_shed_bias_tightens_the_floor(self):
+        ctl = AdmissionController(max_inflight=10_000, p99_budget_s=10.0)
+        ctl.admit(Priority.LEVEL6)  # healthy: everything admitted
+        ctl.set_shed_bias(0.3)
+        with pytest.raises(ShardSaturatedError):
+            ctl.admit(Priority.LEVEL6)
+        ctl.admit(Priority.LEVEL0)  # LEVEL0 never band-sheds
+        ctl.set_shed_bias(0.0)
+        ctl.admit(Priority.LEVEL6)
+
+
+# ---------------------------------------------------------------------------
+# SLO autopilot
+# ---------------------------------------------------------------------------
+
+
+_DRILL_SLO = {
+    "name": "announce-p99",
+    "objective": "latency",
+    "target": 0.9,
+    "metric": "scheduler_announce_seconds",
+    "threshold_ms": 10.0,
+    "fast_window_s": 0.3,
+    "slow_window_s": 1.0,
+    "burn_threshold": 2.0,
+}
+
+
+class TestAutopilot:
+    def test_tighten_and_hysteresis_relax(self):
+        pilot = SLOAutopilot([_DRILL_SLO], relax_after=3, max_level=4)
+        levels = [pilot._step(True, float(i)) for i in range(6)]
+        assert levels == [1, 2, 3, 4, 4, 4]
+        # Relax needs 3 consecutive healthy evaluations per step down.
+        levels = [pilot._step(False, 10.0 + i) for i in range(7)]
+        assert levels == [4, 4, 3, 3, 3, 2, 2]
+        # A breach mid-recovery resets the streak AND re-tightens.
+        assert pilot._step(True, 20.0) == 3
+        pilot.close()
+
+    def test_applies_to_admission_and_accounting(self):
+        ctl = AdmissionController(max_inflight=100)
+        acct = TenantAccounting(QoSPolicy())
+        pilot = SLOAutopilot(
+            [_DRILL_SLO], admission=ctl, accounting=acct,
+            shed_bias_step=0.25, cap_backoff=0.5,
+        )
+        pilot._step(True, 0.0)
+        assert ctl.shed_bias() == pytest.approx(0.25)
+        assert acct.cap_factor() == pytest.approx(0.5)
+        for i in range(10):
+            pilot._step(False, 1.0 + i)
+        assert ctl.shed_bias() == 0.0
+        assert acct.cap_factor() == 1.0
+        pilot.close()
+
+    def test_overload_drill_fires_tightens_relaxes_and_replays(self):
+        """ISSUE 15 acceptance: synthetic overload fires the declared
+        SLO within one fast window, the shed floor tightens, recovery
+        relaxes it, and journal replay reproduces the live decision
+        sequence exactly (drift 0 after settle)."""
+        from dragonfly2_tpu.utils.metric_journal import (
+            MetricJournal,
+            replay_metric_journal,
+        )
+        from dragonfly2_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        sketch = reg.sketch(_DRILL_SLO["metric"], "drill announce latency")
+        ctl = AdmissionController(max_inflight=100)
+        path = tempfile.mktemp(suffix=".dfmj")
+        journal = MetricJournal(
+            path, registry=reg, service="qos-drill", interval_s=3600.0
+        )
+        live = SLOAutopilot([_DRILL_SLO], admission=ctl)
+        good = _DRILL_SLO["threshold_ms"] / 1e3 * 0.1
+        bad = _DRILL_SLO["threshold_ms"] / 1e3 * 4.0
+
+        def step(latency: float):
+            for _ in range(5):
+                sketch.observe(latency)
+            journal.write_snapshot()
+            live.ingest(journal.last_snapshot)
+            time.sleep(0.01)
+
+        try:
+            # Healthy phase: one slow window.
+            deadline = time.monotonic() + _DRILL_SLO["slow_window_s"]
+            while time.monotonic() < deadline:
+                step(good)
+            assert live.level == 0 and ctl.shed_bias() == 0.0
+            # Overload: the breach (and the first tighten) must land
+            # within ~one fast window.
+            t0 = time.monotonic()
+            fired_after = None
+            deadline = t0 + _DRILL_SLO["fast_window_s"] * 1.5
+            while time.monotonic() < deadline:
+                step(bad)
+                if live.level > 0:
+                    fired_after = time.monotonic() - t0
+                    break
+            assert fired_after is not None, "autopilot never tightened"
+            assert fired_after <= _DRILL_SLO["fast_window_s"] * 1.25
+            # Keep burning: the bias must be tightened while breached.
+            for _ in range(5):
+                step(bad)
+            assert ctl.shed_bias() > 0.0
+            peak = live.level
+            assert peak >= 2
+            # Recovery: good traffic until fully relaxed, then settle.
+            deadline = time.monotonic() + _DRILL_SLO["slow_window_s"] * 3
+            while time.monotonic() < deadline and live.level > 0:
+                step(good)
+            assert live.level == 0, "autopilot never relaxed"
+            assert ctl.shed_bias() == 0.0
+            for _ in range(10):
+                step(good)  # settle
+        finally:
+            journal.close()
+        try:
+            snaps, stats = replay_metric_journal(path)
+            assert stats["corrupt"] == 0
+            replayed = SLOAutopilot.replay(snaps, [_DRILL_SLO])
+            n = len(live.decisions)
+            # Replay sees one extra frame (journal.close's final write);
+            # every LIVE decision must be reproduced exactly — breach
+            # verdicts, levels, and timestamps (drift 0).
+            assert replayed.decisions[:n] == live.decisions
+            assert replayed.levels()[:n] == live.levels()
+            assert max(replayed.levels()) == peak
+            replayed.close()
+        finally:
+            live.close()
+            os.unlink(path)
+
+
+# ---------------------------------------------------------------------------
+# service / wire / manager plumbing
+# ---------------------------------------------------------------------------
+
+
+def _service(with_batcher=False, policy=None):
+    from dragonfly2_tpu.scheduler import (
+        Evaluator,
+        HostFeatureCache,
+        Resource,
+        SchedulerService,
+        Scheduling,
+        SchedulingConfig,
+        ShardGuard,
+    )
+
+    ctl = AdmissionController(
+        max_inflight=100, accounting=TenantAccounting(policy or QoSPolicy())
+    )
+    guard = ShardGuard("qos-s0", admission=ctl)
+    service = SchedulerService(
+        Resource(),
+        Scheduling(
+            Evaluator(feature_cache=HostFeatureCache(max_hosts=256)),
+            SchedulingConfig(retry_interval=0),
+        ),
+        shard_guard=guard,
+    )
+    return service, ctl
+
+
+def _host(i: int):
+    from dragonfly2_tpu.scheduler.resource import Host
+
+    h = Host(
+        id=f"qh-{i}", hostname=f"qh-{i}", ip=f"10.8.0.{i}", port=8002,
+        download_port=8001,
+    )
+    h.stats.network.idc = "idc-q"
+    return h
+
+
+class TestServiceQoSWiring:
+    def test_register_stamps_tenant_and_set_policy_installs(self):
+        policy = _two_tenant_policy()
+        service, ctl = _service()
+        service.set_qos_policy(policy)
+        assert ctl.accounting.policy is policy
+        res = service.register_peer(
+            host=_host(1), url="https://o/x", tenant="t-b",
+        )
+        assert res.peer.tenant == "t-b"
+        assert "t-b" in ctl.accounting.snapshot()
+
+    def test_on_qos_config_skips_malformed(self):
+        service, ctl = _service()
+        service.on_qos_config({"tenant_qos": {"t-a": {"weight": -1}}})
+        assert service.qos_policy is None
+        service.on_qos_config({"tenant_qos": "nonsense"})
+        assert service.qos_policy is None
+        service.on_qos_config(
+            {"tenant_qos": {"t-a": {"tenant_class": "gold"}}}
+        )
+        assert service.qos_policy is not None
+
+    def test_announce_answer_republishes_tenant_qos(self):
+        from dragonfly2_tpu.rpc.scheduler_server import SchedulerRPCAdapter
+        from dragonfly2_tpu.rpc.scheduler_server import host_to_wire
+
+        policy = _two_tenant_policy()
+        service, _ctl = _service()
+        service.set_qos_policy(policy)
+        adapter = SchedulerRPCAdapter(service)
+        out = adapter.announce_host(
+            {"host": host_to_wire(_host(2)), "tenant": "t-a"}
+        )
+        assert out["tenant_qos"] == policy.to_payload()
+        # Tenant rode the wire into accounting.
+        snap = service.shard_guard.admission.accounting.snapshot()
+        assert snap["t-a"]["requests"] == 1
+
+    def test_wire_register_decodes_tenant(self):
+        from dragonfly2_tpu.rpc.scheduler_server import (
+            SchedulerRPCAdapter,
+            host_to_wire,
+        )
+
+        service, _ctl = _service()
+        adapter = SchedulerRPCAdapter(service)
+        h = _host(3)
+        adapter.announce_host({"host": host_to_wire(h)})
+        out = adapter.register_peer({
+            "host_id": h.id, "url": "https://o/y", "tenant": "t-b",
+        })
+        peer = service.resource.peer_manager.load(out["peer_id"])
+        assert peer.tenant == "t-b"
+
+
+class TestManagerTenantQoS:
+    def test_cluster_blob_validated_on_write(self):
+        from dragonfly2_tpu.manager.crud import CrudStore
+
+        crud = CrudStore()
+        with pytest.raises(ValueError):
+            crud.create(
+                "cluster", id="c1", tenant_qos={"t-a": {"weight": 0}}
+            )
+        crud.create(
+            "cluster", id="c1",
+            tenant_qos={"t-a": {"tenant_class": "gold", "weight": 2.0}},
+        )
+        cfg = crud.cluster_config("c1")
+        assert cfg["tenant_qos"]["t-a"]["weight"] == 2.0
+
+    def test_update_accepts_tenant_qos_on_legacy_rows(self):
+        """A cluster row persisted before tenant_qos existed still
+        accepts updates to it (declared fields, not row keys)."""
+        from dragonfly2_tpu.manager.crud import CrudStore
+        from dragonfly2_tpu.manager.state import MemoryBackend
+
+        backend = MemoryBackend()
+        # Simulate a pre-§26 persisted row (no tenant_qos key).
+        backend.table("crud").put("cluster:old", {
+            "id": "old", "name": "old", "is_default": False,
+            "scheduler_cluster_config": {}, "client_config": {},
+            "scopes": {},
+        })
+        crud = CrudStore(backend=backend)
+        crud.update(
+            "cluster", "old",
+            tenant_qos={"t-x": {"tenant_class": "bronze"}},
+        )
+        assert crud.cluster_config("old")["tenant_qos"]["t-x"][
+            "tenant_class"
+        ] == "bronze"
+
+    def test_config_route_derives_tenant_for_authenticated_poll(self):
+        import urllib.request
+
+        from dragonfly2_tpu.manager.cluster import ClusterManager
+        from dragonfly2_tpu.manager.crud import CrudStore
+        from dragonfly2_tpu.manager.registry import ModelRegistry
+        from dragonfly2_tpu.manager.rest import ManagerRESTServer
+        from dragonfly2_tpu.manager.users import UserStore
+        from dragonfly2_tpu.security.tokens import Role
+
+        users = UserStore()
+        user = users.create_user("daemon-bot", "password123", role=Role.PEER)
+        _pat, raw = users.create_pat(user.id, "qos")
+        server = ManagerRESTServer(
+            ModelRegistry(), ClusterManager(), crud=CrudStore(), users=users
+        )
+        server.serve()
+        try:
+            url = f"{server.url}/api/v1/clusters/default:config"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                anon = json.loads(resp.read())
+            assert "tenant_id" not in anon
+            req = urllib.request.Request(
+                url, headers={"Authorization": f"Bearer {raw}"}
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                authed = json.loads(resp.read())
+            assert authed["tenant_id"] == derive_tenant(user.id)
+            assert "tenant_qos" in authed
+        finally:
+            server.stop()
+
+
+class TestPreheatBackgroundClass:
+    def test_fanout_carries_level6_and_handler_applies_it(self):
+        from dragonfly2_tpu.jobs.preheat import (
+            PREHEAT_PRIORITY,
+            make_preheat_handler,
+            preheat,
+        )
+        from dragonfly2_tpu.jobs.queue import JobQueue
+
+        assert PREHEAT_PRIORITY is Priority.LEVEL6
+        broker = JobQueue()
+        job = preheat(broker, ["https://o/a"], ["scheduler:s1"])
+        queued = broker.get("scheduler:s1", timeout=1.0)
+        assert queued is not None
+        assert queued.args["priority"] == int(Priority.LEVEL6)
+        assert job.urls == ["https://o/a"]
+
+        calls = []
+
+        class SeedStub:
+            def download(self, url, **kw):
+                calls.append(kw)
+
+                class R:
+                    ok = True
+                    pieces = 1
+
+                return R()
+
+        handler = make_preheat_handler(SeedStub())
+        handler({"urls": ["https://o/a"], "piece_size": 4096,
+                 "priority": int(Priority.LEVEL6)})
+        assert calls[0]["priority"] is Priority.LEVEL6
+        # Legacy args without a priority key default to the background
+        # class too (an old manager fanning to a new scheduler).
+        handler({"urls": ["https://o/a"], "piece_size": 4096})
+        assert calls[1]["priority"] is Priority.LEVEL6
+
+
+class TestShardRouterRetryBudget:
+    """Satellite: a briefly-saturated shard is a wait, not a failure."""
+
+    def _router(self, answers, **kw):
+        from dragonfly2_tpu.rpc.resolver import ShardRouter
+        from dragonfly2_tpu.scheduler.sharding import ShardRing
+        import random
+
+        calls = {"n": 0}
+
+        class Client:
+            def hit(self):
+                i = calls["n"]
+                calls["n"] += 1
+                a = answers[min(i, len(answers) - 1)]
+                if isinstance(a, Exception):
+                    raise a
+                return a
+
+        router = ShardRouter(
+            factory=lambda url: Client(),
+            backoff_rng=random.Random(1),
+            **kw,
+        )
+        router.update_ring(ShardRing({"s0": "http://s0:1"}, version=1))
+        return router, calls
+
+    def test_second_retry_after_still_succeeds_within_budget(self):
+        router, calls = self._router([
+            ShardSaturatedError(retry_after_s=0.01),
+            ShardSaturatedError(retry_after_s=0.01),
+            "ok",
+        ])
+        t0 = time.monotonic()
+        assert router.call("task-1", lambda c: c.hit()) == "ok"
+        assert calls["n"] == 3
+        assert time.monotonic() - t0 < 2.0
+
+    def test_budget_bounds_the_waits(self):
+        router, calls = self._router(
+            [ShardSaturatedError(retry_after_s=0.005)] * 50,
+            saturation_retries=2,
+        )
+        with pytest.raises(ShardSaturatedError):
+            router.call("task-1", lambda c: c.hit())
+        assert calls["n"] == 3  # initial + 2 budgeted retries
+
+    def test_zero_budget_propagates_immediately(self):
+        router, calls = self._router(
+            [ShardSaturatedError(retry_after_s=0.005), "ok"],
+            saturation_retries=0,
+        )
+        with pytest.raises(ShardSaturatedError):
+            router.call("task-1", lambda c: c.hit())
+        assert calls["n"] == 1
+
+
+class TestBenchQoSSmoke:
+    def test_smoke_schema_gate(self):
+        out = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "bench_qos.py"), "--smoke"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=600, cwd=str(REPO),
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        data = json.loads(out.stdout.strip().splitlines()[-1])
+        assert data["ok"] is True
+        assert data["metric"] == "qos_isolation_score"
+        shaped = data["arms"]["shaped"]
+        assert shaped["b_sheds"] + shaped["b_throttled"] > 0
+        assert shaped["a_downloads_ok"] == data["config"]["a_downloads"]

@@ -49,11 +49,14 @@ TELEMETRY_BEGIN = "<!-- bench:telemetry:begin -->"
 TELEMETRY_END = "<!-- bench:telemetry:end -->"
 SWARM_BEGIN = "<!-- bench:swarm:begin -->"
 SWARM_END = "<!-- bench:swarm:end -->"
+QOS_BEGIN = "<!-- bench:qos:begin -->"
+QOS_END = "<!-- bench:qos:end -->"
 
 _ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _DL_ROUND_RE = re.compile(r"^BENCH_DL_r(\d+)\.json$")
 _TEL_ROUND_RE = re.compile(r"^TELEMETRY_r(\d+)\.json$")
 _SW_ROUND_RE = re.compile(r"^BENCH_SW_r(\d+)\.json$")
+_QOS_ROUND_RE = re.compile(r"^BENCH_QOS_r(\d+)\.json$")
 
 
 def collect_rounds(root: Path) -> List[dict]:
@@ -131,6 +134,65 @@ def collect_swarm_rounds(root: Path) -> List[dict]:
         out.append(data)
     out.sort(key=lambda d: d["round"])
     return out
+
+
+def collect_qos_rounds(root: Path) -> List[dict]:
+    """All multi-tenant QoS isolation rounds (``tools/bench_qos.py`` →
+    ``BENCH_QOS_r*.json``), sorted by round number."""
+    out: List[dict] = []
+    for path in sorted(root.glob("BENCH_QOS_r*.json")):
+        m = _QOS_ROUND_RE.match(path.name)
+        if m is None:
+            continue
+        try:
+            data = json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, json.JSONDecodeError):
+            data = {"ok": False, "error": "unparseable"}
+        data["round"] = int(m.group(1))
+        data["file"] = path.name
+        out.append(data)
+    out.sort(key=lambda d: d["round"])
+    return out
+
+
+def render_qos(rounds: List[dict]) -> str:
+    """The generated QoS-isolation block, markers included (one row per
+    BENCH_QOS round: the isolation score, tenant A's p99/TTLB movement
+    under the shaped burst vs the unshaped interference baseline, and
+    the shaped arm's shed/cap evidence)."""
+    lines = [
+        QOS_BEGIN,
+        "Generated by `python -m tools.bench_report --update` from the",
+        "`BENCH_QOS_r*.json` rounds (tools/bench_qos.py) — do not edit",
+        "by hand; tier-1 (`tests/test_bench_report.py`) fails if stale.",
+        "",
+        "| round | status | isolation score | shaped Δp99 / ΔTTLB | "
+        "unshaped Δp99 / ΔTTLB | flood shed/capped | note |",
+        "| --- | --- | --- | --- | --- | --- | --- |",
+    ]
+    for data in rounds:
+        move = data.get("movement") or {}
+        shaped = (data.get("arms") or {}).get("shaped") or {}
+        if not data.get("ok") or not move:
+            lines.append(
+                f"| r{data['round']:02d} | error | — | — | — | — | "
+                f"{str(data.get('error', ''))[:80]} |"
+            )
+            continue
+        status = "guarded" if data.get("regression_warning") else "ok"
+        note = str(data.get("note", "") or "").replace("|", "\\|")
+        lines.append(
+            f"| r{data['round']:02d} | {status} "
+            f"| {data.get('value', 0):.1f} "
+            f"| {move.get('shaped_announce_p99_pct', 0):+.1f}% / "
+            f"{move.get('shaped_ttlb_pct', 0):+.1f}% "
+            f"| {move.get('unshaped_announce_p99_pct', 0):+.1f}% / "
+            f"{move.get('unshaped_ttlb_pct', 0):+.1f}% "
+            f"| {shaped.get('b_sheds', 0)}/{shaped.get('b_throttled', 0)} "
+            f"| {note} |"
+        )
+    lines.append(QOS_END)
+    return "\n".join(lines)
 
 
 def render_swarm(rounds: List[dict]) -> str:
@@ -392,10 +454,11 @@ def update_file(
     dl_rounds: Optional[List[dict]] = None,
     tel_rounds: Optional[List[dict]] = None,
     sw_rounds: Optional[List[dict]] = None,
+    qos_rounds: Optional[List[dict]] = None,
 ) -> bool:
     """Replace the marker-delimited block(s); True when the file changed.
-    The download/telemetry/swarm blocks are optional (docs without their
-    markers are left untouched)."""
+    The download/telemetry/swarm/qos blocks are optional (docs without
+    their markers are left untouched)."""
     text = path.read_text(encoding="utf-8")
     new = _replace_block(
         text, TRAJECTORY_BEGIN, TRAJECTORY_END, render_trajectory(rounds)
@@ -413,6 +476,11 @@ def update_file(
     if sw_rounds is not None:
         new = _replace_block(
             new, SWARM_BEGIN, SWARM_END, render_swarm(sw_rounds),
+            required=False,
+        )
+    if qos_rounds is not None:
+        new = _replace_block(
+            new, QOS_BEGIN, QOS_END, render_qos(qos_rounds),
             required=False,
         )
     if new != text:
@@ -441,20 +509,24 @@ def main(argv=None) -> int:
     dl_rounds = collect_download_rounds(root)
     tel_rounds = collect_telemetry_rounds(root)
     sw_rounds = collect_swarm_rounds(root)
+    qos_rounds = collect_qos_rounds(root)
     fresh = render_trajectory(rounds)
     fresh_dl = render_download(dl_rounds)
     fresh_tel = render_telemetry(tel_rounds)
     fresh_sw = render_swarm(sw_rounds)
+    fresh_qos = render_qos(qos_rounds)
     if args.update:
         changed = update_file(
-            root / args.file, rounds, dl_rounds, tel_rounds, sw_rounds
+            root / args.file, rounds, dl_rounds, tel_rounds, sw_rounds,
+            qos_rounds,
         )
         print(
             f"{args.file}: tables "
             + ("updated" if changed else "already current")
             + f" ({len(rounds)} round(s), {len(dl_rounds)} download "
             f"round(s), {len(tel_rounds)} telemetry round(s), "
-            f"{len(sw_rounds)} swarm round(s))"
+            f"{len(sw_rounds)} swarm round(s), {len(qos_rounds)} qos "
+            f"round(s))"
         )
         return 0
     if args.check:
@@ -466,6 +538,7 @@ def main(argv=None) -> int:
             ("telemetry", TELEMETRY_BEGIN, TELEMETRY_END, fresh_tel,
              not tel_rounds),
             ("swarm", SWARM_BEGIN, SWARM_END, fresh_sw, not sw_rounds),
+            ("qos", QOS_BEGIN, QOS_END, fresh_qos, not qos_rounds),
         ):
             begin = text.find(begin_m)
             end = text.find(end_m)
@@ -486,7 +559,8 @@ def main(argv=None) -> int:
             f"{args.file}: tables current ({len(rounds)} round(s), "
             f"{len(dl_rounds)} download round(s), "
             f"{len(tel_rounds)} telemetry round(s), "
-            f"{len(sw_rounds)} swarm round(s))"
+            f"{len(sw_rounds)} swarm round(s), "
+            f"{len(qos_rounds)} qos round(s))"
         )
         return 0
     print(fresh)
@@ -496,6 +570,8 @@ def main(argv=None) -> int:
     print(fresh_tel)
     print()
     print(fresh_sw)
+    print()
+    print(fresh_qos)
     return 0
 
 

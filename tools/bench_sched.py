@@ -60,6 +60,7 @@ SCHEMA_KEYS = (
     "steady_state_recompiles",
     "tracing_overhead",
     "telemetry_overhead",
+    "qos_overhead",
     "sweep",
 )
 
@@ -405,6 +406,49 @@ def run(hosts: int, parents: int, announcers: int, announces: int,
         finally:
             _metrics.set_sketches_enabled(True)
         sketch_observed = EVAL_FLUSH_SECONDS.total_count() - sketch_before
+        # QoS-overhead rounds (ISSUE 15 acceptance: the §26 tenant plane
+        # ≤3% on vector_ml with ONE tenant and NO contention).  Same
+        # discipline: unmeasured warm pass per plan set, interleaved
+        # arms, alternating order.  "on" = a QoSPolicy installed on the
+        # batcher + every announce stamped with the tenant (the single
+        # active lane rides the whole-queue-swap fast path, so this
+        # measures the §26 plumbing, not DRR arbitration); "off" = no
+        # policy, default lane.
+        from dragonfly2_tpu.qos import QoSPolicy as _QoSPolicy
+
+        qos_policy = _QoSPolicy.from_payload(
+            {"t-bench": {"tenant_class": "gold", "weight": 2.0}}
+        )
+        qos_walls = {"on": 0.0, "off": 0.0}
+        qos_counts = {"on": 0, "off": 0}
+        try:
+            for r in range(rounds):
+                plans = _make_plans(
+                    len(peers), parents_per_announce=parents,
+                    announcers=announcers, announces=per_round,
+                    seed=seed + 3000 + r,
+                )
+                batcher.set_qos_policy(None)
+                pool.run_round(ml_vec.evaluate_parents, task, peers, plans)
+                arms = ("on", "off") if r % 2 == 0 else ("off", "on")
+                for arm in arms:
+                    if arm == "on":
+                        batcher.set_qos_policy(qos_policy)
+                        for p in peers:
+                            p.tenant = "t-bench"
+                    else:
+                        batcher.set_qos_policy(None)
+                        for p in peers:
+                            p.tenant = ""
+                    wall, lat = pool.run_round(
+                        ml_vec.evaluate_parents, task, peers, plans
+                    )
+                    qos_walls[arm] += wall
+                    qos_counts[arm] += len(lat)
+        finally:
+            batcher.set_qos_policy(None)
+            for p in peers:
+                p.tenant = ""
     finally:
         gc.enable()
         pool.shutdown()
@@ -413,6 +457,8 @@ def run(hosts: int, parents: int, announcers: int, announces: int,
     off_aps = trace_counts["off"] / trace_walls["off"]
     sk_on_aps = sk_counts["on"] / sk_walls["on"]
     sk_off_aps = sk_counts["off"] / sk_walls["off"]
+    qos_on_aps = qos_counts["on"] / qos_walls["on"]
+    qos_off_aps = qos_counts["off"] / qos_walls["off"]
 
     return {
         "ok": True,
@@ -464,6 +510,17 @@ def run(hosts: int, parents: int, announcers: int, announces: int,
                 100.0 * (sk_off_aps - sk_on_aps) / sk_off_aps, 2
             ),
             "sketch_observes": sketch_observed,
+        },
+        # Tenant-QoS overhead on the vector_ml serving path (DESIGN.md
+        # §26 guard, ≤3% bar in BENCHMARKS.md): single tenant, no
+        # contention — the weighted-fair lane plumbing with policy
+        # installed vs the default lane; negative values are box noise.
+        "qos_overhead": {
+            "on_announces_per_sec": round(qos_on_aps, 1),
+            "off_announces_per_sec": round(qos_off_aps, 1),
+            "overhead_pct": round(
+                100.0 * (qos_off_aps - qos_on_aps) / qos_off_aps, 2
+            ),
         },
     }
 

@@ -69,6 +69,9 @@ REQUIRED_SEAMS = {
     "dragonfly2_tpu/daemon/upload.py": (
         "daemon.upload.serve_piece", "daemon.upload.body",
         "daemon.upload.sendfile",
+        # Tenant QoS gate (DESIGN.md §26): the per-tenant bandwidth
+        # throttle at the shared begin_upload accounting gate.
+        "daemon.upload.throttle",
     ),
     "dragonfly2_tpu/daemon/piece_pipeline.py": (
         "daemon.report.batch", "daemon.piece.hedge",
@@ -115,7 +118,13 @@ REQUIRED_SEAMS = {
     # Sharded fleet (DESIGN.md §24): the membership-change handoff sweep
     # and the client-side ring routing are the cross-shard fault seams
     # the SIGKILL drill steers through.
-    "dragonfly2_tpu/scheduler/sharding.py": ("shard.handoff",),
+    "dragonfly2_tpu/scheduler/sharding.py": (
+        "shard.handoff",
+        # Tenant-aware shedding (DESIGN.md §26): fired on every QoS
+        # refusal (rate cap + priority-band shed) — the SIGKILL drill's
+        # deterministic kill point.
+        "scheduler.qos.shed",
+    ),
     "dragonfly2_tpu/rpc/resolver.py": ("shard.route",),
     "dragonfly2_tpu/scheduler/microbatch.py": ("scheduler.eval.batch",),
     "dragonfly2_tpu/scheduler/seed_client.py": ("seed.trigger",),

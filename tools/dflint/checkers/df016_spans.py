@@ -65,6 +65,11 @@ REQUIRED_SPANS = {
     # the edge trace_assemble must show on the chaos drill's critical
     # path — losing the span loses the migration evidence.
     "dragonfly2_tpu/scheduler/sharding.py": ("scheduler/shard.handoff",),
+    # SLO-autopilot adjustments (DESIGN.md §26): every shed-floor/cap
+    # change closes one span — the flight recorder's answer to "why did
+    # the autopilot shed at 12:03"; losing it loses the feedback-loop
+    # evidence.
+    "dragonfly2_tpu/qos/autopilot.py": ("scheduler/qos.autopilot",),
 }
 
 

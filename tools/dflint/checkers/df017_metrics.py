@@ -72,10 +72,14 @@ UNIT_SUFFIXES = (
 )
 
 # Unbounded-identifier label names: one series per peer/task/host is a
-# cardinality explosion on a million-peer fleet.
+# cardinality explosion on a million-peer fleet.  Raw tenant ids join
+# the family (DESIGN.md §26): tenant-shaped series must carry the
+# BOUNDED ``tenant_class`` label ("gold".."background"), never one
+# series per tenant on a million-user fleet.
 FORBIDDEN_LABELS = (
     "peer_id", "host_id", "task_id", "trace_id", "span_id", "run_id",
     "url", "ip", "addr", "address", "peer", "hostname",
+    "tenant", "tenant_id", "user", "user_id",
 )
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
@@ -105,6 +109,18 @@ REQUIRED_METRICS = {
     "dragonfly2_tpu/utils/slo.py": (
         "slo_burn_rate",
         "slo_breached",
+    ),
+    # Tenant QoS plane (DESIGN.md §26) — every tenant-shaped series
+    # carries the bounded tenant_class label, never raw tenant ids.
+    "dragonfly2_tpu/qos/metrics.py": (
+        "scheduler_qos_shed_total",
+        "scheduler_qos_rate_capped_total",
+        "scheduler_qos_autopilot_level",
+        "scheduler_qos_autopilot_adjustments_total",
+    ),
+    "dragonfly2_tpu/daemon/upload.py": (
+        "daemon_upload_throttled_total",
+        "daemon_upload_tenant_bytes_total",
     ),
 }
 
