@@ -62,6 +62,7 @@ class TenantAccounting:
         *,
         window_s: float = 5.0,
         over_quota_slack: float = 1.25,
+        now: Optional[float] = None,
     ) -> None:
         self._mu = threading.Lock()
         self._policy = policy or QoSPolicy()
@@ -71,7 +72,10 @@ class TenantAccounting:
         # band (hysteresis against share jitter at low volumes).
         self.over_quota_slack = over_quota_slack
         self._rows: Dict[str, _TenantRow] = {}
-        self._epoch_started = time.monotonic()
+        # ``now`` is a declared clock seam (DESIGN.md §27): the SIGKILL
+        # rebuild drill re-anchors the window epoch at the scripted
+        # replay clock so two rebuilds over the same stream agree.
+        self._epoch_started = time.monotonic() if now is None else now
         # Autopilot output (qos/autopilot.py): scales the EFFECTIVE
         # announce rate of over-quota tenants; 1.0 = declared caps.
         self._cap_factor = 1.0
@@ -119,15 +123,27 @@ class TenantAccounting:
             self._epoch_started = now
 
     def note(self, tenant: str, *, now: Optional[float] = None) -> bool:
-        """Account one request for ``tenant``; False when the tenant's
-        (possibly autopilot-tightened) announce-rate cap refuses it.
-        The request is counted either way — a capped flood still shows
-        up as usage, which is what keeps the over-quota signal honest.
+        """Live edge: samples the monotonic clock OUTSIDE the replay
+        path and delegates to ``note_at`` (the declared replay root —
+        DESIGN.md §27)."""
+        t = time.monotonic() if now is None else now
+        return self.note_at(tenant, t)
+
+    def note_at(self, tenant: str, now: float) -> bool:
+        """Account one request for ``tenant`` at clock reading ``now``;
+        False when the tenant's (possibly autopilot-tightened)
+        announce-rate cap refuses it.  The request is counted either way
+        — a capped flood still shows up as usage, which is what keeps
+        the over-quota signal honest.
+
+        A declared replay root: the verdict is a pure function of the
+        request stream and its timestamps, so the SIGKILL rebuild drill
+        can replay a scripted stream through the same door the live
+        plane uses and land on identical state.
         """
         tenant = tenant or DEFAULT_TENANT
-        t = time.monotonic() if now is None else now
         with self._mu:
-            self._rotate_locked(t)
+            self._rotate_locked(now)
             row = self._row_locked(tenant)
             row.requests += 1
             row.cur += 1
@@ -149,7 +165,7 @@ class TenantAccounting:
                 burst = max(1, int(burst * (qps / declared)))
                 bucket = row.bucket = TokenBucket(qps, burst)
                 row.bucket_rate = qps
-        if bucket.take():
+        if bucket.take_at(now):
             return True
         with self._mu:
             row.capped += 1

@@ -44,12 +44,15 @@ class ColumnarHeader:
 
 
 def _encode_header(header: ColumnarHeader) -> bytes:
+    # sort_keys pins canonical header bytes (DF019): equal headers must
+    # serialize identically regardless of dict hash order.
     payload = json.dumps(
         {
             "columns": list(header.columns),
             "dtype": header.dtype,
             "created_at_ns": header.created_at_ns,
-        }
+        },
+        sort_keys=True,
     ).encode("utf-8")
     return MAGIC + struct.pack(_LEN_FMT, len(payload)) + payload
 

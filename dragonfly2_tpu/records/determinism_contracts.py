@@ -1,0 +1,218 @@
+"""Replay-determinism contracts: declared once, checked twice (DESIGN.md §27).
+
+The replay-equals-live property (§23 burn-rate replay, §26 autopilot
+drift-0, the accounting rebuild drill) holds only while every function
+on a replay path stays free of *ambient* nondeterminism — wall-clock
+reads, unseeded randomness, ``hash()``/``id()``, set-iteration feeding
+ordered output — and every journal/replay artifact serializes
+canonically.  This registry is the single declaration of that boundary:
+
+- ``tools/dflint/detrules.py`` reads it with ``ast.literal_eval`` (never
+  imported — dflint stays stdlib-only) and enforces **DF018** (taint
+  every function reachable from a replay root; ambient nondeterminism
+  fails unless the value arrives through a declared injection seam) and
+  **DF019** (canonical serialization on every declared artifact writer:
+  ``json.dumps`` pins ``sort_keys=True``, frame payload keys come from
+  the bounded declared sets below);
+- ``dragonfly2_tpu/utils/dfdet.py`` imports it at runtime (the witness
+  side) to arm call-site recorders while a declared replay root is on
+  the stack; ``tests/test_zz_detwitness.py`` cross-validates the two
+  and re-runs every root twice over identical journal bytes under
+  different PYTHONHASHSEED values — decision output must be
+  byte-identical.
+
+Keep this a PURE LITERAL: one dict, no imports used in the value, no
+computed entries.  dflint emits a DF018 finding if ``ast.literal_eval``
+stops working on it.
+"""
+
+from __future__ import annotations
+
+DETERMINISM_CONTRACTS = {
+    # -- replay roots -------------------------------------------------------
+    # name -> {file, qual}: the functions whose output must be a pure
+    # function of their inputs (journal bytes, snapshots, scripted
+    # clocks).  Everything statically reachable from a root through the
+    # project call graph is tainted by DF018.  The name is the stable
+    # identity the runtime witness and the dual-run drill report by.
+    "replay_roots": {
+        "slo.ingest_snapshot": {
+            "file": "dragonfly2_tpu/utils/slo.py",
+            "qual": "SLOEngine.ingest_snapshot",
+        },
+        "slo.evaluate": {
+            "file": "dragonfly2_tpu/utils/slo.py",
+            "qual": "SLOEngine.evaluate",
+        },
+        "slo.replay_fleet": {
+            "file": "dragonfly2_tpu/utils/slo.py",
+            "qual": "replay_fleet",
+        },
+        "autopilot.ingest": {
+            "file": "dragonfly2_tpu/qos/autopilot.py",
+            "qual": "SLOAutopilot.ingest",
+        },
+        "autopilot.replay": {
+            "file": "dragonfly2_tpu/qos/autopilot.py",
+            "qual": "SLOAutopilot.replay",
+        },
+        "accounting.note_at": {
+            "file": "dragonfly2_tpu/qos/accounting.py",
+            "qual": "TenantAccounting.note_at",
+        },
+        "accounting.snapshot": {
+            "file": "dragonfly2_tpu/qos/accounting.py",
+            "qual": "TenantAccounting.snapshot",
+        },
+        "rollout.breach": {
+            "file": "dragonfly2_tpu/rollout/controller.py",
+            "qual": "RolloutController._breach",
+        },
+        "rollout.evaluate_shadow": {
+            "file": "dragonfly2_tpu/rollout/evaluation.py",
+            "qual": "evaluate_shadow",
+        },
+        "rollout.regret_at_k": {
+            "file": "dragonfly2_tpu/rollout/evaluation.py",
+            "qual": "regret_at_k",
+        },
+        "rollout.inversion_rate": {
+            "file": "dragonfly2_tpu/rollout/evaluation.py",
+            "qual": "pairwise_inversion_rate",
+        },
+        "sharding.owner": {
+            "file": "dragonfly2_tpu/scheduler/sharding.py",
+            "qual": "ShardRing.owner",
+        },
+        "sharding.pick": {
+            "file": "dragonfly2_tpu/scheduler/sharding.py",
+            "qual": "ShardRing.pick",
+        },
+        "fleet_assemble.merge_runs": {
+            "file": "tools/fleet_assemble.py",
+            "qual": "merge_runs",
+        },
+        "fleet_assemble.build_report": {
+            "file": "tools/fleet_assemble.py",
+            "qual": "build_report",
+        },
+        "trace_assemble.critical_path": {
+            "file": "tools/trace_assemble.py",
+            "qual": "critical_path",
+        },
+        "trace_assemble.summarize_trace": {
+            "file": "tools/trace_assemble.py",
+            "qual": "summarize_trace",
+        },
+    },
+    # -- injection seams ----------------------------------------------------
+    # The ONLY doors nondeterminism may enter a replay path through: a
+    # declared parameter on a declared function.  The live edge (tick(),
+    # note(), the journal cadence thread) samples the ambient source
+    # OUTSIDE the taint closure and passes the value in; replay passes
+    # journal timestamps / scripted clocks through the same door.  Each
+    # entry must name a real parameter of a real function — stale seams
+    # fail DF018 by name.
+    "injection_seams": [
+        {
+            "file": "dragonfly2_tpu/utils/slo.py",
+            "qual": "SLOEngine.evaluate",
+            "params": ["now"],
+            "kind": "clock",
+        },
+        {
+            "file": "dragonfly2_tpu/qos/accounting.py",
+            "qual": "TenantAccounting.note_at",
+            "params": ["now"],
+            "kind": "clock",
+        },
+        {
+            "file": "dragonfly2_tpu/qos/accounting.py",
+            "qual": "TenantAccounting.__init__",
+            "params": ["now"],
+            "kind": "clock",
+        },
+        {
+            "file": "dragonfly2_tpu/utils/metric_journal.py",
+            "qual": "MetricJournal.__init__",
+            "params": ["run_id"],
+            "kind": "identity",
+        },
+        {
+            "file": "dragonfly2_tpu/rpc/ratelimit.py",
+            "qual": "TokenBucket.take_at",
+            "params": ["now"],
+            "kind": "clock",
+        },
+        {
+            "file": "dragonfly2_tpu/sim/fleet.py",
+            "qual": "FleetConfig",
+            "params": ["seed"],
+            "kind": "rng",
+        },
+        {
+            "file": "dragonfly2_tpu/sim/qos.py",
+            "qual": "QoSDrillConfig",
+            "params": ["seed"],
+            "kind": "rng",
+        },
+    ],
+    # -- observability sinks -------------------------------------------------
+    # Fire-and-forget diagnostics reachable from replay paths whose
+    # values NEVER flow back into decision output: the flight recorder
+    # (span timestamps are wall-clock by design), metric gauge/counter
+    # writes, and the chaos seam.  DF018 taint does not descend into a
+    # callee matching one of these prefixes ("relpath:*" = whole
+    # module, "relpath:Qual" = one function/method), and the runtime
+    # witness excuses ambient reads observed inside their spans.
+    "sinks": [
+        "dragonfly2_tpu/utils/tracing.py:*",
+        "dragonfly2_tpu/utils/faultinject.py:*",
+        "dragonfly2_tpu/utils/metrics.py:Counter.inc",
+        "dragonfly2_tpu/utils/metrics.py:Gauge.set",
+        "dragonfly2_tpu/utils/metrics.py:Sketch.observe",
+    ],
+    # -- canonical serialization (DF019) -------------------------------------
+    # Every journal/replay artifact writer: each ``json.dumps`` in the
+    # writer must pin ``sort_keys=True``, and when a frame payload
+    # builder is declared, the dict literal it returns must carry
+    # exactly the declared key set (drift fails in BOTH directions).
+    "serialization": {
+        "metric_journal.frame": {
+            "file": "dragonfly2_tpu/utils/metric_journal.py",
+            "qual": "encode_frame",
+            "format": "DFMJ1",
+            "builder": "MetricJournal._payload",
+            "keys": ["metrics", "pid", "run_id", "seq", "service", "ts", "v"],
+        },
+        "trace_log.frame": {
+            "file": "dragonfly2_tpu/utils/tracing.py",
+            "qual": "DurableSpanExporter._write",
+            "format": "DFTL1",
+            "builder": "build_export_request",
+            "keys": ["resourceSpans"],
+        },
+        "columnar.header": {
+            "file": "dragonfly2_tpu/records/columnar.py",
+            "qual": "_encode_header",
+            "format": "DFC1",
+            "builder": "_encode_header",
+            "keys": ["columns", "created_at_ns", "dtype"],
+        },
+        "fleet_assemble.json": {
+            "file": "tools/fleet_assemble.py",
+            "qual": "main",
+            "format": "json",
+        },
+        "trace_assemble.json": {
+            "file": "tools/trace_assemble.py",
+            "qual": "main",
+            "format": "json",
+        },
+        "bench_sched.json": {
+            "file": "tools/bench_sched.py",
+            "qual": "main",
+            "format": "json",
+        },
+    },
+}

@@ -30,6 +30,7 @@ import grpc
 from google.protobuf.json_format import MessageToDict, ParseDict
 
 from .protos import dragonfly_pb2 as pb
+from .protos import tenantext as pbx
 from .protos.batch import ReportPiecesFinishedRequest
 from .scheduler_client import RemoteScheduler, RPCError
 
@@ -179,8 +180,11 @@ def _iter_until_closed(request_iterator):
 # method → (request message, response message); mirrors
 # SchedulerRPCAdapter.METHODS exactly.
 SCHEDULER_METHODS = {
-    "announce_host": (pb.AnnounceHostRequest, pb.AnnounceHostResponse),
-    "register_peer": (pb.RegisterPeerRequest, pb.RegisterPeerResponse),
+    # announce_host/register_peer ride the tenant-extended messages
+    # (protos/tenantext.py): same field numbers plus the §26 tenant
+    # stamp the JSON dialect already carries.
+    "announce_host": (pbx.AnnounceHostRequest, pb.AnnounceHostResponse),
+    "register_peer": (pbx.RegisterPeerRequest, pb.RegisterPeerResponse),
     "set_task_info": (pb.SetTaskInfoRequest, pb.TaskInfoResponse),
     "report_piece_finished": (pb.ReportPieceFinishedRequest, pb.Empty),
     "report_pieces_finished": (ReportPiecesFinishedRequest, pb.Empty),
@@ -308,7 +312,7 @@ class SchedulerGRPCServer:
             )
         handlers["announce_peer"] = grpc.stream_stream_rpc_method_handler(
             self._announce_peer,
-            request_deserializer=pb.AnnouncePeerRequest.FromString,
+            request_deserializer=pbx.AnnouncePeerRequest.FromString,
             response_serializer=lambda m: m.SerializeToString(),
         )
         self._server.add_generic_rpc_handlers(
@@ -670,7 +674,7 @@ class GRPCStreamingScheduler(GRPCRemoteScheduler):
 
     # adapter method → request oneof field
     _STREAM_FIELDS = {
-        "register_peer": ("register", pb.RegisterPeerRequest),
+        "register_peer": ("register", pbx.RegisterPeerRequest),
         "set_task_info": ("task_info", pb.SetTaskInfoRequest),
         "report_piece_finished": ("piece_finished", pb.ReportPieceFinishedRequest),
         "report_piece_failed": ("piece_failed", pb.ReportPieceFailedRequest),
@@ -789,7 +793,7 @@ class GRPCStreamingScheduler(GRPCRemoteScheduler):
             # Fire-and-forget: the acks correlate to seqs nobody waits on.
             for pid in self._active_peers:
                 self._seq += 1
-                msg = pb.AnnouncePeerRequest(seq=self._seq)
+                msg = pbx.AnnouncePeerRequest(seq=self._seq)
                 msg.resume.peer_id = pid
                 sendq.put(msg)
 
@@ -805,7 +809,7 @@ class GRPCStreamingScheduler(GRPCRemoteScheduler):
             slot: list = []
             self._waiters[seq] = (ev, slot)
             sendq = self._sendq
-        msg = pb.AnnouncePeerRequest(seq=seq)
+        msg = pbx.AnnouncePeerRequest(seq=seq)
         dict_to_proto_into(req, getattr(msg, field))
         try:
             if sendq is None:

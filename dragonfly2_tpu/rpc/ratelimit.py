@@ -24,15 +24,27 @@ class TokenBucket:
         self.qps = qps
         self.burst = float(burst)
         self._tokens = float(burst)
-        self._last = time.monotonic()
+        # Anchored at the first take, not here: buckets are built on
+        # replay paths (qos/accounting.py note_at) where ambient clock
+        # reads are DF018-banned, and the first take starts from a full
+        # burst either way.
+        self._last: Optional[float] = None
         self._mu = threading.Lock()
 
     def take(self, n: float = 1.0) -> bool:
+        """Live edge: samples the monotonic clock and delegates to
+        ``take_at`` (the declared clock seam — DESIGN.md §27)."""
+        return self.take_at(time.monotonic(), n)
+
+    def take_at(self, now: float, n: float = 1.0) -> bool:
         with self._mu:
-            now = time.monotonic()
-            self._tokens = min(
-                self.burst, self._tokens + (now - self._last) * self.qps
-            )
+            if self._last is not None:
+                # Scripted clocks may repeat a timestamp; never refill
+                # backwards.
+                elapsed = max(0.0, now - self._last)
+                self._tokens = min(
+                    self.burst, self._tokens + elapsed * self.qps
+                )
             self._last = now
             if self._tokens >= n:
                 self._tokens -= n

@@ -557,3 +557,21 @@ class FleetSwarmDriver:
             "cache_hit_rate": stats["cache_hit_rate"],
             "shards": stats["shards"],
         }
+
+
+# -- seed-sweep reproducibility (DESIGN.md §27) ------------------------------
+
+# Keys of the run report that measure WALL TIME rather than simulated
+# behavior.  Everything else is a pure function of (FleetConfig, ticks):
+# the population draws from a seeded numpy Generator and the fleet is
+# driven synchronously, so two runs with the same seed — even under
+# different PYTHONHASHSEED values — must agree byte-for-byte on the
+# projection below (tests/test_sim_determinism.py gates this in
+# subprocesses).
+TIMING_KEYS = ("wall_s", "announce_wall_s", "announces_per_sec")
+
+
+def deterministic_summary(report: Dict[str, object]) -> Dict[str, object]:
+    """The seed-reproducible core of ``FleetSwarmDriver.run``'s report:
+    the full report minus the wall-clock measurements."""
+    return {k: v for k, v in report.items() if k not in TIMING_KEYS}

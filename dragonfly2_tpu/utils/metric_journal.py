@@ -42,6 +42,21 @@ FRAME_MAGIC = b"DFMJ1 "
 SNAPSHOT_VERSION = 1
 
 
+def encode_frame(snapshot: Dict[str, Any]) -> bytes:
+    """One canonical DFMJ1 frame for ``snapshot`` — the declared DFMJ1
+    artifact writer of DESIGN.md §27.  ``sort_keys=True`` is
+    load-bearing: replay byte-identity (and the different-PYTHONHASHSEED
+    dual-run drill) holds only while equal snapshots serialize to equal
+    bytes regardless of dict insertion/hash order."""
+    payload = json.dumps(snapshot, sort_keys=True).encode()
+    return (
+        FRAME_MAGIC
+        + f"{len(payload)} {zlib.crc32(payload) & 0xFFFFFFFF:08x}\n".encode()
+        + payload
+        + b"\n"
+    )
+
+
 class MetricJournal:
     """Per-process append-only metric journal.
 
@@ -116,13 +131,7 @@ class MetricJournal:
 
         snapshot = self._payload()
         self.last_snapshot = snapshot
-        payload = json.dumps(snapshot, sort_keys=True).encode()
-        frame = (
-            FRAME_MAGIC
-            + f"{len(payload)} {zlib.crc32(payload) & 0xFFFFFFFF:08x}\n".encode()
-            + payload
-            + b"\n"
-        )
+        frame = encode_frame(snapshot)
         # Chaos seam: a ``crash`` fault here SIGKILLs the process at a
         # deterministic journal write — the telemetry kill drill's
         # "mid-storm, mid-journal" point (sim/telemetry.py).

@@ -253,8 +253,24 @@ class SLOEngine:
 
     def evaluate(self, now: Optional[float] = None) -> Dict[str, Dict[str, Any]]:
         """Burn rates + breach verdicts from the current sample history;
-        updates the ``slo_burn_rate``/``slo_breached`` gauges."""
-        t = time.time() if now is None else now
+        updates the ``slo_burn_rate``/``slo_breached`` gauges.
+
+        ``evaluate`` is a declared replay root (DESIGN.md §27): its
+        verdicts must be a pure function of the ingested samples and
+        ``now``.  The live edge (``tick``) samples the wall clock
+        OUTSIDE the replay path and passes it through the declared
+        ``now`` injection seam; when ``now`` is omitted the engine
+        anchors at the newest ingested sample instead of reading the
+        ambient clock (DF018) — identical verdicts either way, since
+        window ends are already clamped to the newest sample below."""
+        if now is None:
+            with self._mu:
+                t = max(
+                    (s[-1][0] for s in self._samples.values() if s),
+                    default=0.0,
+                )
+        else:
+            t = now
         out: Dict[str, Dict[str, Any]] = {}
         for slo in self.slos:
             with self._mu:

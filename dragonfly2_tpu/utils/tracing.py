@@ -546,7 +546,11 @@ class DurableSpanExporter(SpanExporter):
                 pass
 
     def _write(self, batch: List[Span]) -> None:
-        payload = json.dumps(build_export_request(self.service, batch)).encode()
+        # sort_keys pins canonical frame bytes (DF019): equal batches
+        # must serialize identically regardless of dict hash order.
+        payload = json.dumps(
+            build_export_request(self.service, batch), sort_keys=True
+        ).encode()
         frame = (
             FRAME_MAGIC
             + f"{len(payload)} {zlib.crc32(payload) & 0xFFFFFFFF:08x}\n".encode()

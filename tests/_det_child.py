@@ -1,0 +1,229 @@
+"""Dual-run determinism child (DESIGN.md §27).
+
+Runs OUTSIDE conftest (no witnesses): the parent test
+(``tests/test_zz_detwitness.py``) launches this script twice over the
+SAME on-disk inputs with different ``PYTHONHASHSEED`` values and asserts
+the stdout bytes are identical.  Every declared replay root
+(``dragonfly2_tpu/records/determinism_contracts.py``) is exercised and
+its decision output folded into one canonical JSON document.
+
+Modes:
+
+``roots <workdir>``
+    ``workdir`` holds ``*.dfmj`` metric journals (written once by the
+    parent via ``encode_frame``), ``slos.json`` and ``spans.json``.
+    Prints ``json.dumps(results, sort_keys=True)`` for all roots.
+
+``drill <metric_journal_source.py>``
+    Loads the given metric_journal SOURCE (real or mutated copy) as a
+    synthetic module and encodes one frame whose metrics dict is built
+    by iterating a **set** of metric names — the canonical-bytes
+    stressor.  With ``sort_keys=True`` intact the frame bytes are
+    hash-seed-independent; the sort_keys-dropped mutant diverges
+    across PYTHONHASHSEED values.  Prints the frame as hex.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run_roots(workdir: str) -> None:
+    import numpy as np
+
+    import tools.fleet_assemble as fa
+    import tools.trace_assemble as ta
+    from dragonfly2_tpu.qos.accounting import TenantAccounting
+    from dragonfly2_tpu.qos.autopilot import SLOAutopilot
+    from dragonfly2_tpu.rollout import evaluation as ev
+    from dragonfly2_tpu.rollout.controller import (
+        RolloutController,
+        RolloutGuardrails,
+    )
+    from dragonfly2_tpu.rollout.shadow import SHADOW_COLUMNS
+    from dragonfly2_tpu.scheduler.sharding import ShardRing
+    from dragonfly2_tpu.utils.metric_journal import replay_metric_journal
+    from dragonfly2_tpu.utils.slo import SLOEngine, replay_fleet
+
+    with open(os.path.join(workdir, "slos.json"), encoding="utf-8") as f:
+        slos = json.load(f)
+    with open(os.path.join(workdir, "spans.json"), encoding="utf-8") as f:
+        spans = json.load(f)
+    journals = sorted(glob.glob(os.path.join(workdir, "*.dfmj")))
+
+    results = {}
+
+    # -- slo.* roots: journal bytes -> snapshots -> engine verdicts ----------
+    snapshots = []
+    for path in journals:
+        snaps, _stats = replay_metric_journal(path)
+        snapshots.extend(snaps)
+    snapshots.sort(key=lambda s: (s["run_id"], s["seq"]))
+    eng = replay_fleet(snapshots, slos)  # ingest_snapshot + evaluate inside
+    results["slo.replay_fleet"] = eng.state()
+    eng2 = SLOEngine(slos)
+    for snap in snapshots:
+        eng2.ingest_snapshot(snap)
+    last_ts = max(float(s["ts"]) for s in snapshots)
+    # Mid-stream verdict: distinguishes ingest_snapshot's sample history
+    # from the final evaluate below.
+    results["slo.ingest_snapshot"] = eng2.evaluate(last_ts - 50.0)
+    results["slo.evaluate"] = eng2.evaluate(last_ts)
+
+    # -- autopilot.* ---------------------------------------------------------
+    ap = SLOAutopilot.replay(snapshots, slos)
+    results["autopilot.replay"] = {
+        "decisions": [list(d) for d in ap.decisions],
+        "levels": ap.levels(),
+    }
+    ap2 = SLOAutopilot(slos)
+    results["autopilot.ingest"] = [ap2.ingest(s) for s in snapshots]
+
+    # -- accounting.* --------------------------------------------------------
+    acct = TenantAccounting(now=0.0)
+    tenants = ["tenant-%02d" % i for i in range(8)]
+    verdicts = []
+    t = 0.0
+    for step in range(240):
+        t += 0.05
+        verdicts.append(acct.note_at(tenants[step % len(tenants)], t))
+    results["accounting.note_at"] = verdicts
+    results["accounting.snapshot"] = acct.snapshot()
+
+    # -- rollout.breach ------------------------------------------------------
+    ctl = RolloutController.__new__(RolloutController)
+    ctl.guardrails = RolloutGuardrails()
+    reports = [
+        {
+            "psi_max": 0.01,
+            "regret_at_k": {"candidate": 0.1, "active": 0.12, "k": 4},
+            "inversion_rate": {"candidate": 0.2, "active": 0.25},
+        },
+        {
+            "psi_max": 9.0,
+            "regret_at_k": {"candidate": 0.1, "active": 0.12, "k": 4},
+            "inversion_rate": {"candidate": 0.2, "active": 0.25},
+        },
+        {
+            "psi_max": 0.01,
+            "regret_at_k": {"candidate": 0.9, "active": 0.1, "k": 4},
+            "inversion_rate": {"candidate": 0.9, "active": 0.1},
+        },
+    ]
+    results["rollout.breach"] = [ctl._breach(r) for r in reports]
+
+    # -- rollout evaluation roots (seeded synthetic log) ---------------------
+    rng = np.random.default_rng(7)
+    n = 400
+    col = {name: i for i, name in enumerate(SHADOW_COLUMNS)}
+    shadow = np.zeros((n, len(SHADOW_COLUMNS)), dtype=np.float32)
+    shadow[:, col["announce_seq"]] = np.arange(n) // 8
+    shadow[:, col["candidate_version"]] = 3
+    shadow[:, col["active_version"]] = 2
+    shadow[:, col["src_bucket"]] = rng.integers(0, 48, n)
+    shadow[:, col["dst_bucket"]] = rng.integers(0, 48, n)
+    shadow[:, col["active_score"]] = rng.random(n)
+    shadow[:, col["candidate_score"]] = rng.random(n)
+    shadow[:, col["active_rank"]] = rng.integers(0, 8, n)
+    shadow[:, col["candidate_rank"]] = rng.integers(0, 8, n)
+    dl = np.zeros((n // 2, 3), dtype=np.float32)
+    dl[:, 0] = rng.integers(0, 48, n // 2)
+    dl[:, 1] = rng.integers(0, 48, n // 2)
+    dl[:, 2] = rng.random(n // 2) * 10.0
+    realized = ev.join_outcomes(shadow, dl)
+    results["rollout.regret_at_k"] = ev.regret_at_k(shadow, realized, k=3)
+    results["rollout.inversion_rate"] = ev.pairwise_inversion_rate(
+        shadow, realized
+    )
+    results["rollout.evaluate_shadow"] = ev.evaluate_shadow(
+        shadow, dl, k=3, psi_max=0.12
+    )
+
+    # -- sharding.* ----------------------------------------------------------
+    ring = ShardRing(
+        {"shard-%02d" % i: "http://s%d" % i for i in range(16)}, version=3
+    )
+    keys = ["host-%04d" % i for i in range(256)]
+    results["sharding.owner"] = [ring.owner(k) for k in keys]
+    loads = {"shard-%02d" % i: float((i * 37) % 11) for i in range(16)}
+    results["sharding.pick"] = [
+        ring.pick(k, load_of=lambda sid: loads[sid]) for k in keys
+    ]
+
+    # -- fleet_assemble.* ----------------------------------------------------
+    report = fa.build_report(journals, slo_config=slos)
+    # Journal paths live under the parent's tmpdir; identical for both
+    # child invocations but not across pytest runs — keep the decision
+    # payload, drop the path echo.
+    report.pop("journals", None)
+    results["fleet_assemble.build_report"] = report
+    results["fleet_assemble.merge_runs"] = fa.merge_runs(snapshots)
+
+    # -- trace_assemble.* ----------------------------------------------------
+    traces = ta.assemble(spans)
+    results["trace_assemble.critical_path"] = {
+        tid: ta.critical_path(tspans) for tid, tspans in sorted(traces.items())
+    }
+    results["trace_assemble.summarize_trace"] = [
+        ta.summarize_trace(tid, traces[tid]) for tid in sorted(traces)
+    ]
+
+    sys.stdout.write(json.dumps(results, sort_keys=True))
+
+
+def run_drill(source_path: str) -> None:
+    with open(source_path, encoding="utf-8") as f:
+        src = f.read()
+    code = compile(src, source_path, "exec")
+    mod = types.ModuleType("dragonfly2_tpu.utils._mj_drill")
+    mod.__package__ = "dragonfly2_tpu.utils"
+    mod.__file__ = source_path
+    sys.modules[mod.__name__] = mod
+    exec(code, mod.__dict__)
+
+    names = {
+        "announce_total", "rpc_tx_bytes", "sched_decisions", "qos_sheds",
+        "journal_frames", "trace_spans", "slo_breaches", "cache_hits",
+        "piece_bytes", "peer_churn", "probe_edges", "model_flips",
+    }
+    metrics = {}
+    for name in names:  # SET iteration: order depends on PYTHONHASHSEED
+        metrics[name] = {
+            "type": "counter",
+            "series": [[name, float(len(name))]],
+        }
+    snapshot = {
+        "v": 1,
+        "service": "drill",
+        "run_id": "run-fixed",
+        "pid": 1,
+        "seq": 1,
+        "ts": 0.0,
+        "metrics": metrics,
+    }
+    sys.stdout.write(mod.encode_frame(snapshot).hex())
+
+
+def main() -> int:
+    mode = sys.argv[1]
+    if mode == "roots":
+        run_roots(sys.argv[2])
+    elif mode == "drill":
+        run_drill(sys.argv[2])
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

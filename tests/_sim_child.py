@@ -1,0 +1,81 @@
+"""Seed-sweep reproducibility child (DESIGN.md §27).
+
+Run OUTSIDE conftest by ``tests/test_sim_determinism.py``: the parent
+launches this script twice with different ``PYTHONHASHSEED`` values and
+asserts stdout is byte-identical — same seed, same simulated behavior,
+regardless of interpreter hash salting.
+
+Modes:
+
+``fleet``  — drive the columnar swarm (``sim/fleet.py``) for a few
+    ticks against two real scheduler shards; print the deterministic
+    projection of the run report (wall-time keys dropped).
+``qos``    — run one baseline arm of the QoS drill (``sim/qos.py``,
+    no flood threads) plus a digest sweep over the synthetic origin
+    content (the ``hash(url)`` regression this gate was built for);
+    print the deterministic arm projection + digest.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run_fleet() -> None:
+    from dragonfly2_tpu.sim.fleet import (
+        ColumnarPopulation,
+        FleetConfig,
+        FleetSwarmDriver,
+        ShardedFleet,
+        deterministic_summary,
+    )
+
+    cfg = FleetConfig(
+        num_peers=1500, seed=11, download_rate=0.01, task_catalog=16
+    )
+    driver = FleetSwarmDriver(ColumnarPopulation(cfg), ShardedFleet(2))
+    report = driver.run(5)
+    sys.stdout.write(json.dumps(deterministic_summary(report), sort_keys=True))
+
+
+def run_qos() -> None:
+    from dragonfly2_tpu.sim import qos as simqos
+
+    cfg = simqos.QoSDrillConfig(
+        a_announces=80, a_downloads=2, pieces_per_task=2,
+        piece_size=16 * 1024, b_threads=1,
+    )
+    arm = simqos._run_arm(cfg, shaped=False, burst=False)
+    out = {"baseline": simqos.deterministic_summary(arm)}
+    origin = simqos._Origin(4096)
+    digest = hashlib.sha256()
+    for url in ("https://origin.qos/a-0", "https://origin.qos/b-1",
+                "https://origin.qos/warm"):
+        for number in range(4):
+            digest.update(origin.fetch(url, number, 4096))
+    out["origin_sha256"] = digest.hexdigest()
+    sys.stdout.write(json.dumps(out, sort_keys=True))
+
+
+def main() -> int:
+    mode = sys.argv[1]
+    if mode == "fleet":
+        run_fleet()
+    elif mode == "qos":
+        run_qos()
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

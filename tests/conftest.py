@@ -115,6 +115,25 @@ if os.environ.get("DF_SPAN_WITNESS", "1") != "0":
 
     _dfspan.install(str(_REPO / "dragonfly2_tpu"))
 
+# -- 2e. determinism witness (dfdet) ----------------------------------------
+# Installed last of the witnesses: patches the ambient nondeterminism
+# sources (time.time/monotonic/perf_counter + _ns, os.urandom,
+# uuid.uuid1/uuid4, ambient random draws) with call-site recorders and
+# wraps every declared replay root (records/determinism_contracts.py)
+# so the recorder is ARMED only while a root is on the stack.
+# tests/test_zz_detwitness.py cross-validates the observations against
+# DF018's static taint report (tools/dflint/detrules.py) and re-runs
+# every root under different PYTHONHASHSEED — the runtime half of the
+# replay-determinism contract (DESIGN.md §27).  Set DF_DET_WITNESS=0 to
+# disable.
+
+if os.environ.get("DF_DET_WITNESS", "1") != "0":
+    if str(_REPO) not in sys.path:
+        sys.path.insert(0, str(_REPO))
+    from dragonfly2_tpu.utils import dfdet as _dfdet
+
+    _dfdet.install(str(_REPO / "dragonfly2_tpu"))
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -3836,3 +3836,486 @@ class TestDF017MutationSensitivity:
         out = capsys.readouterr().out
         assert rc == 0, out
         assert "0 new finding(s)" in out
+
+
+
+# ---------------------------------------------------------------------------
+# Replay-determinism analysis (tools/dflint/detrules.py): DF018 / DF019
+# fixtures, plus the DESIGN.md §27 inventory staleness gate
+# ---------------------------------------------------------------------------
+
+from tools.dflint.detrules import DetAnalysis, det_witness_gaps  # noqa: E402
+
+# A minimal contracts registry for fixture trees: one replay root with a
+# declared `now` clock seam, a whole-module observability sink, and one
+# artifact writer with a bounded two-key payload.  Fixture sources below
+# are zero-indented strings (concatenation-friendly; textwrap.dedent in
+# `prog` is a no-op on them).
+DET_CONTRACTS_FIXTURE = '''
+DETERMINISM_CONTRACTS = {
+    "replay_roots": {
+        "eng.run": {
+            "file": "dragonfly2_tpu/utils/eng.py",
+            "qual": "Engine.run",
+        },
+    },
+    "injection_seams": [
+        {
+            "file": "dragonfly2_tpu/utils/eng.py",
+            "qual": "Engine.run",
+            "params": ["now"],
+            "kind": "clock",
+        },
+    ],
+    "sinks": [
+        "dragonfly2_tpu/utils/obs.py:*",
+    ],
+    "serialization": {
+        "eng.frame": {
+            "file": "dragonfly2_tpu/utils/eng.py",
+            "qual": "write_frame",
+            "format": "J1",
+            "builder": "build_payload",
+            "keys": ["a", "b"],
+        },
+    },
+}
+'''
+
+DET_CONTRACTS_RELPATH = "dragonfly2_tpu/records/determinism_contracts.py"
+
+
+DET_SINK_FIXTURE = '''
+import time
+
+def record(event):
+    return (event, time.time())
+'''
+
+
+def det(files: dict) -> DetAnalysis:
+    tree = dict(files)
+    tree.setdefault(DET_CONTRACTS_RELPATH, DET_CONTRACTS_FIXTURE)
+    # The declared sink module must resolve or every tree would carry a
+    # staleness finding.
+    tree.setdefault("dragonfly2_tpu/utils/obs.py", DET_SINK_FIXTURE)
+    return DetAnalysis(prog(tree))
+
+
+def det_rules(a: DetAnalysis):
+    return sorted({f.rule for f in a.findings()})
+
+
+CLEAN_WRITER = '''
+import json
+
+def build_payload(state):
+    return {"a": state[0], "b": state[1]}
+
+def write_frame(state):
+    return json.dumps(build_payload(state), sort_keys=True).encode()
+'''
+
+
+class TestDF018Fixtures:
+    def test_wall_clock_in_root_fires(self):
+        a = det({"dragonfly2_tpu/utils/eng.py": "import time\n" + CLEAN_WRITER + '''
+class Engine:
+    def run(self, now):
+        return now - time.time()
+'''})
+        fs = a.findings()
+        assert det_rules(a) == ["DF018"]
+        assert "time.time" in fs[0].message
+        assert "eng.run" in fs[0].message
+
+    def test_clock_through_declared_seam_is_clean(self):
+        a = det({"dragonfly2_tpu/utils/eng.py": "import time\n" + CLEAN_WRITER + '''
+class Engine:
+    def run(self, now):
+        return now * 2.0
+
+def live_edge(eng):
+    # Ambient sampling OUTSIDE the closure, value through the
+    # declared seam: the blessed pattern.
+    return eng.run(time.time())
+'''})
+        assert a.findings() == []
+
+    def test_transitive_taint_fires_with_chain(self):
+        a = det({"dragonfly2_tpu/utils/eng.py": "import time\n" + CLEAN_WRITER + '''
+def _stamp():
+    return time.time()
+
+class Engine:
+    def run(self, now):
+        return _stamp() - now
+'''})
+        fs = a.findings()
+        assert det_rules(a) == ["DF018"]
+        assert "->" in fs[0].message and "_stamp" in fs[0].message
+
+    def test_declared_sink_stops_taint(self):
+        a = det({
+            "dragonfly2_tpu/utils/eng.py":
+                "from .obs import record\n" + CLEAN_WRITER + '''
+class Engine:
+    def run(self, now):
+        record("run")
+        return now * 2.0
+''',
+            "dragonfly2_tpu/utils/obs.py": '''
+import time
+
+def record(event):
+    return (event, time.time())
+''',
+        })
+        assert a.findings() == []
+
+    def test_unseeded_rng_factory_fires_seeded_is_clean(self):
+        dirty = det({"dragonfly2_tpu/utils/eng.py": "import numpy as np\n" + CLEAN_WRITER + '''
+class Engine:
+    def run(self, now):
+        rng = np.random.default_rng()
+        return rng.random() + now
+'''})
+        assert "DF018" in det_rules(dirty)
+        assert any("default_rng" in f.message for f in dirty.findings())
+        clean = det({"dragonfly2_tpu/utils/eng.py": "import numpy as np\n" + CLEAN_WRITER + '''
+class Engine:
+    def run(self, now):
+        rng = np.random.default_rng(7)
+        return rng.random() + now
+'''})
+        assert clean.findings() == []
+
+    def test_ambient_module_rng_fires(self):
+        a = det({"dragonfly2_tpu/utils/eng.py": "import random\n" + CLEAN_WRITER + '''
+class Engine:
+    def run(self, now):
+        return random.random() + now
+'''})
+        assert det_rules(a) == ["DF018"]
+        assert "ambient global RNG" in a.findings()[0].message
+
+    def test_hash_builtin_fires(self):
+        a = det({"dragonfly2_tpu/utils/eng.py": CLEAN_WRITER + '''
+class Engine:
+    def run(self, now):
+        return hash(str(now)) & 0xFF
+'''})
+        assert det_rules(a) == ["DF018"]
+        assert "PYTHONHASHSEED" in a.findings()[0].message
+
+    def test_set_iteration_fires_sorted_is_clean(self):
+        dirty = det({"dragonfly2_tpu/utils/eng.py": CLEAN_WRITER + '''
+class Engine:
+    def run(self, now):
+        return [k for k in {"b", "a"}]
+'''})
+        assert det_rules(dirty) == ["DF018"]
+        assert "set iteration" in dirty.findings()[0].message
+        clean = det({"dragonfly2_tpu/utils/eng.py": CLEAN_WRITER + '''
+class Engine:
+    def run(self, now):
+        return [k for k in sorted({"b", "a"})]
+'''})
+        assert clean.findings() == []
+
+    def test_pragma_suppresses_but_site_stays_indexed(self):
+        a = det({"dragonfly2_tpu/utils/eng.py": "import time\n" + CLEAN_WRITER + '''
+class Engine:
+    def run(self, now):
+        return time.time() - now  # dflint: disable=DF018
+'''})
+        assert a.findings() == []
+        # The witness maps observations against *knowledge*: the
+        # reviewed site still appears in the ambient index.
+        assert any(
+            "time.time" in sources
+            for sources in a.ambient_site_index().values()
+        )
+
+    def test_stale_root_fails_by_name(self):
+        a = det({"dragonfly2_tpu/utils/eng.py": CLEAN_WRITER + '''
+class Renamed:
+    def run(self, now):
+        return now
+'''})
+        fs = [f for f in a.findings() if f.rule == "DF018"]
+        assert any(
+            "eng.run" in f.message and "does not resolve" in f.message
+            for f in fs
+        )
+
+    def test_stale_seam_param_fails(self):
+        a = det({"dragonfly2_tpu/utils/eng.py": CLEAN_WRITER + '''
+class Engine:
+    def run(self, clock):
+        return clock
+'''})
+        fs = [f for f in a.findings() if f.rule == "DF018"]
+        assert any(
+            "no parameter" in f.message and "'now'" in f.message
+            for f in fs
+        )
+
+
+class TestDF019Fixtures:
+    def test_unsorted_dumps_in_writer_fires(self):
+        a = det({"dragonfly2_tpu/utils/eng.py": '''
+import json
+
+def build_payload(state):
+    return {"a": state[0], "b": state[1]}
+
+def write_frame(state):
+    return json.dumps(build_payload(state)).encode()
+
+class Engine:
+    def run(self, now):
+        return now
+'''})
+        assert det_rules(a) == ["DF019"]
+        assert "sort_keys=True" in a.findings()[0].message
+
+    def test_canonical_writer_is_clean(self):
+        a = det({"dragonfly2_tpu/utils/eng.py": CLEAN_WRITER + '''
+class Engine:
+    def run(self, now):
+        return now
+'''})
+        assert a.findings() == []
+
+    def test_payload_key_drift_fails_both_directions(self):
+        extra = det({"dragonfly2_tpu/utils/eng.py": '''
+import json
+
+def build_payload(state):
+    return {"a": state[0], "b": state[1], "c": 3}
+
+def write_frame(state):
+    return json.dumps(build_payload(state), sort_keys=True).encode()
+
+class Engine:
+    def run(self, now):
+        return now
+'''})
+        assert any(
+            "'c'" in f.message and "declared bounded key set" in f.message
+            for f in extra.findings()
+        )
+        missing = det({"dragonfly2_tpu/utils/eng.py": '''
+import json
+
+def build_payload(state):
+    return {"a": state[0]}
+
+def write_frame(state):
+    return json.dumps(build_payload(state), sort_keys=True).encode()
+
+class Engine:
+    def run(self, now):
+        return now
+'''})
+        assert any(
+            "'b'" in f.message and "no longer builds" in f.message
+            for f in missing.findings()
+        )
+
+    def test_dumps_in_taint_closure_must_sort(self):
+        a = det({"dragonfly2_tpu/utils/eng.py": CLEAN_WRITER + '''
+def _render(state):
+    return json.dumps({"x": state})
+
+class Engine:
+    def run(self, now):
+        return _render(now)
+'''})
+        fs = [f for f in a.findings() if f.rule == "DF019"]
+        assert any("replay path" in f.message for f in fs)
+
+    def test_stale_writer_fails_by_name(self):
+        a = det({"dragonfly2_tpu/utils/eng.py": '''
+class Engine:
+    def run(self, now):
+        return now
+'''})
+        fs = [f for f in a.findings() if f.rule == "DF019"]
+        assert any(
+            "eng.frame" in f.message and "does not resolve" in f.message
+            for f in fs
+        )
+
+
+class TestDetWitnessGapsFixtures:
+    def _analysis(self):
+        return det({"dragonfly2_tpu/utils/eng.py": "import time\n" + CLEAN_WRITER + '''
+class Engine:
+    def run(self, now):
+        return time.time() - now  # dflint: disable=DF018
+'''})
+
+    def test_known_site_is_excused(self):
+        a = self._analysis()
+        (site,) = list(a.ambient_site_index())
+        observed = [
+            {"relpath": site[0], "lineno": site[1],
+             "source": "time.time", "root": "eng.run"},
+        ]
+        assert det_witness_gaps(a, observed) == []
+
+    def test_sink_module_is_excused(self):
+        a = self._analysis()
+        observed = [
+            {"relpath": "dragonfly2_tpu/utils/obs.py", "lineno": 42,
+             "source": "time.time", "root": "eng.run"},
+        ]
+        assert det_witness_gaps(a, observed) == []
+
+    def test_unknown_site_is_a_gap(self):
+        a = self._analysis()
+        observed = [
+            {"relpath": "dragonfly2_tpu/utils/eng.py", "lineno": 9999,
+             "source": "time.time", "root": "eng.run"},
+        ]
+        gaps = det_witness_gaps(a, observed)
+        assert len(gaps) == 1 and "resolver missed" in gaps[0]
+
+    def test_undeclared_root_is_a_stale_contract_gap(self):
+        a = self._analysis()
+        observed = [
+            {"relpath": "dragonfly2_tpu/utils/eng.py", "lineno": 1,
+             "source": "time.time", "root": "ghost.root"},
+        ]
+        gaps = det_witness_gaps(a, observed)
+        assert len(gaps) == 1 and "stale contract" in gaps[0]
+
+
+_REAL_DET_MODULES = None
+_REAL_DET_ANALYSIS = None
+
+
+def _real_tree_modules():
+    """Parsed Modules for the full tree, loaded ONCE per session — the
+    det batteries below build several whole-program views and the parse
+    dominates; Program never mutates the Modules so they are shareable."""
+    global _REAL_DET_MODULES
+    if _REAL_DET_MODULES is None:
+        from tools.dflint.core import collect_files, load_module
+
+        _REAL_DET_MODULES = [
+            load_module(p, REPO)
+            for p in collect_files(
+                [REPO / "dragonfly2_tpu", REPO / "tools"], REPO
+            )
+        ]
+    return _REAL_DET_MODULES
+
+
+def _real_det_analysis():
+    global _REAL_DET_ANALYSIS
+    if _REAL_DET_ANALYSIS is None:
+        _REAL_DET_ANALYSIS = DetAnalysis(
+            Program(list(_real_tree_modules())), REPO
+        )
+    return _REAL_DET_ANALYSIS
+
+
+class TestDetInventoryStaleness:
+    """DESIGN.md §27's committed det-inventory block must match a fresh
+    emission — same discipline as the §16 lock graph and baseline.toml."""
+
+    def test_design_md_det_inventory_is_current(self):
+        from tools.dflint.__main__ import (
+            DET_INVENTORY_BEGIN, DET_INVENTORY_END, render_det_inventory,
+        )
+
+        analysis = _real_det_analysis()
+        text = (REPO / "DESIGN.md").read_text(encoding="utf-8")
+        begin = text.find(DET_INVENTORY_BEGIN)
+        end = text.find(DET_INVENTORY_END)
+        assert begin >= 0 and end > begin, (
+            "DESIGN.md §27 det-inventory markers missing"
+        )
+        committed = text[begin : end + len(DET_INVENTORY_END)]
+        fresh = render_det_inventory(analysis)
+        assert committed == fresh, (
+            "DESIGN.md §27 det inventory is stale — regenerate with "
+            "`python -m tools.dflint --update-det-inventory DESIGN.md "
+            "dragonfly2_tpu tools`"
+        )
+
+    def test_update_det_inventory_rewrites_in_place(self, tmp_path):
+        from tools.dflint.__main__ import main
+
+        doc = tmp_path / "DESIGN.md"
+        doc.write_text(
+            "# doc\n\n<!-- dflint:det-inventory:begin -->\nstale\n"
+            "<!-- dflint:det-inventory:end -->\ntail\n"
+        )
+        src = tmp_path / "eng.py"
+        src.write_text("def run(now):\n    return now\n")
+        assert main([str(src), "--update-det-inventory", str(doc)]) == 0
+        body = doc.read_text()
+        assert "stale" not in body and "replay root" in body and "tail" in body
+
+
+class TestDetMutationSensitivity:
+    """The acceptance contract against the REAL tree: a wall-clock read
+    inserted into a declared replay root and a dropped ``sort_keys`` in
+    a declared artifact writer must each fail BY RULE NAME (the same
+    mutations the runtime witness catches in tests/test_zz_detwitness.py)."""
+
+    def _analyze_with(self, relpath: str, mutated: str) -> DetAnalysis:
+        modules = [
+            Module(m.path, relpath, mutated) if m.relpath == relpath else m
+            for m in _real_tree_modules()
+        ]
+        return DetAnalysis(Program(modules), REPO)
+
+    @pytest.fixture(scope="class")
+    def real_det(self):
+        return _real_det_analysis()
+
+    def test_real_tree_is_clean(self, real_det):
+        assert real_det.findings() == [], [
+            f.render() for f in real_det.findings()
+        ]
+
+    def test_wall_clock_in_slo_evaluate_fails_df018(self):
+        relpath = "dragonfly2_tpu/utils/slo.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        needle = "        else:\n            t = now"
+        assert needle in source, "SLOEngine.evaluate clock seam drifted"
+        mutated = source.replace(needle, needle + "\n        t = time.time()")
+        a = self._analyze_with(relpath, mutated)
+        assert any(
+            f.rule == "DF018" and "time.time" in f.message
+            and f.path == relpath
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+
+    def test_sort_keys_drop_in_journal_writer_fails_df019(self):
+        relpath = "dragonfly2_tpu/utils/metric_journal.py"
+        source = (REPO / relpath).read_text(encoding="utf-8")
+        needle = "payload = json.dumps(snapshot, sort_keys=True).encode()"
+        assert needle in source, "encode_frame writer drifted"
+        mutated = source.replace(
+            needle, "payload = json.dumps(snapshot).encode()"
+        )
+        a = self._analyze_with(relpath, mutated)
+        assert any(
+            f.rule == "DF019" and "sort_keys" in f.message
+            and f.path == relpath
+            for f in a.findings()
+        ), [f.render() for f in a.findings()]
+
+    def test_cli_rule_filter_selects_df018_df019(self, capsys):
+        from tools.dflint.__main__ import main
+
+        rc = main(["dragonfly2_tpu", "tools", "--rule", "DF018,DF019", "-q"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "0 new finding(s)" in out

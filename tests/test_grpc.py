@@ -974,3 +974,146 @@ class TestAnnouncePeerStream:
             for n in nodes:
                 n.stop()
             server.stop()
+
+
+class TestTenantOnWire:
+    """Tenant identity over the binary dialect (DESIGN.md §26).
+
+    The JSON wire has carried ``tenant`` since the QoS plane landed, but
+    the checked-in pb2 predates the field and ``dict_to_proto`` parses
+    with ``ignore_unknown_fields`` — so gRPC deployments silently dropped
+    the stamp and degraded to the default tenant.  The runtime-assembled
+    messages in protos/tenantext.py close that gap; these tests pin both
+    the JSON parity and the wire compatibility story."""
+
+    REGISTER = {
+        "host_id": "h-1", "url": "https://origin/t", "peer_id": "p-1",
+        "task_id": "task-1", "tag": "", "application": "", "priority": 2,
+        "tenant": "t-gold",
+    }
+
+    def test_register_dict_round_trips_tenant(self):
+        from dragonfly2_tpu.rpc.grpc_transport import (
+            dict_to_proto,
+            proto_to_dict,
+        )
+        from dragonfly2_tpu.rpc.protos import tenantext as pbx
+
+        out = proto_to_dict(dict_to_proto(self.REGISTER, pbx.RegisterPeerRequest))
+        assert out["tenant"] == "t-gold"
+        assert out["host_id"] == "h-1"
+        assert out["priority"] == 2
+
+    def test_announce_dict_round_trips_tenant(self):
+        from dragonfly2_tpu.rpc.grpc_transport import (
+            dict_to_proto,
+            proto_to_dict,
+        )
+        from dragonfly2_tpu.rpc.protos import tenantext as pbx
+
+        req = {
+            "host": {"id": "h-1", "hostname": "h-1", "ip": "127.0.0.1"},
+            "protocol_version": 2,
+            "tenant": "t-gold",
+        }
+        out = proto_to_dict(dict_to_proto(req, pbx.AnnounceHostRequest))
+        assert out["tenant"] == "t-gold"
+        assert out["host"]["id"] == "h-1"
+        assert out["protocol_version"] == 2
+
+    def test_wire_compat_with_pre_tenant_binaries(self):
+        """Field addition is compatible both ways: old bytes parse with
+        tenant empty; new bytes parse on the old message with the unknown
+        field skipped (the documented degradation)."""
+        from dragonfly2_tpu.rpc.grpc_transport import dict_to_proto
+        from dragonfly2_tpu.rpc.protos import dragonfly_pb2 as pb
+        from dragonfly2_tpu.rpc.protos import tenantext as pbx
+
+        base = {k: v for k, v in self.REGISTER.items() if k != "tenant"}
+        old_bytes = dict_to_proto(base, pb.RegisterPeerRequest).SerializeToString()
+        new_msg = pbx.RegisterPeerRequest.FromString(old_bytes)
+        assert new_msg.tenant == ""
+        assert new_msg.host_id == "h-1"
+
+        new_bytes = dict_to_proto(
+            self.REGISTER, pbx.RegisterPeerRequest
+        ).SerializeToString()
+        old_msg = pb.RegisterPeerRequest.FromString(new_bytes)
+        assert old_msg.host_id == "h-1"
+        assert old_msg.priority == 2
+        assert "tenant" not in type(old_msg).DESCRIPTOR.fields_by_name
+
+    def test_stream_envelope_register_arm_compat(self):
+        """The bidi envelope's extended register arm still decodes on a
+        pre-tenant AnnouncePeerRequest (tail field skipped)."""
+        from dragonfly2_tpu.rpc.grpc_transport import dict_to_proto_into
+        from dragonfly2_tpu.rpc.protos import dragonfly_pb2 as pb
+        from dragonfly2_tpu.rpc.protos import tenantext as pbx
+
+        env = pbx.AnnouncePeerRequest(seq=7)
+        dict_to_proto_into(self.REGISTER, env.register)
+        assert env.register.tenant == "t-gold"
+        old_env = pb.AnnouncePeerRequest.FromString(env.SerializeToString())
+        assert old_env.seq == 7
+        assert old_env.WhichOneof("payload") == "register"
+        assert old_env.register.host_id == "h-1"
+
+    def test_register_over_grpc_carries_tenant(self, grpc_swarm):
+        """End to end: the daemon's tenant stamp survives the binary wire
+        and lands on the server-side Peer (it used to arrive as ""), so
+        §26 accounting attributes gRPC traffic to the real tenant."""
+        node = grpc_swarm["nodes"][0]
+        node.client.tenant = "t-gold"
+        res = node.client.register_peer(
+            host=node.host, url="https://origin/tenant-blob"
+        )
+        service = grpc_swarm["service"]
+        peer = service.resource.peer_manager.load(res.peer.id)
+        assert peer is not None
+        assert peer.tenant == "t-gold"
+
+    def test_announce_over_grpc_carries_tenant(self, grpc_swarm, monkeypatch):
+        service = grpc_swarm["service"]
+        seen = {}
+        orig = service.announce_host
+
+        def spy(host, *, tenant=""):
+            seen["tenant"] = tenant
+            return orig(host, tenant=tenant)
+
+        monkeypatch.setattr(service, "announce_host", spy)
+        node = grpc_swarm["nodes"][1]
+        node.client.tenant = "t-silver"
+        node.client.announce_host(node.host)
+        assert seen["tenant"] == "t-silver"
+
+    def test_register_over_stream_carries_tenant(self, tmp_path):
+        """Same guarantee on the bidi stream dialect: register rides the
+        extended envelope arm."""
+        from dragonfly2_tpu.rpc.grpc_transport import GRPCStreamingScheduler
+
+        resource = Resource()
+        service = SchedulerService(
+            resource,
+            Scheduling(Evaluator(), SchedulingConfig(retry_interval=0)),
+            Storage(str(tmp_path / "records"), buffer_size=1),
+            NetworkTopology(resource.host_manager),
+        )
+        server = SchedulerGRPCServer(service)
+        server.serve()
+        try:
+            client = GRPCStreamingScheduler(server.target)
+            client.tenant = "t-stream"
+            host = Host(
+                id="stream-h", hostname="stream-h", ip="127.0.0.1",
+                download_port=1,
+            )
+            res = client.register_peer(
+                host=host, url="https://origin/stream-tenant"
+            )
+            peer = service.resource.peer_manager.load(res.peer.id)
+            assert peer is not None
+            assert peer.tenant == "t-stream"
+            client.close()
+        finally:
+            server.stop()

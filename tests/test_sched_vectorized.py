@@ -868,6 +868,9 @@ class TestBenchSchedSmoke:
         assert len(parents_seen) >= 2  # genuinely distinct shapes
         # Vectorized serving must never retrace on the steady state.
         assert out["steady_state_recompiles"]["vector_ml"] == 0
+        # Standalone bench process: no conftest, so the determinism
+        # witness is not installed — the report must say so (§27).
+        assert out["det_witness_disarmed"] is True
         # Flight-recorder overhead rounds (ISSUE 10): both arms measured,
         # the default sampling documented in the JSON.
         trace = out["tracing_overhead"]

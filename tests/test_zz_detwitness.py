@@ -1,0 +1,425 @@
+"""Dynamic replay-determinism cross-check (DF018/DF019, enforced).
+
+``tests/conftest.py`` installs ``dragonfly2_tpu.utils.dfdet`` before any
+test import: the ambient nondeterminism sources (time.time/monotonic/
+perf_counter + _ns, os.urandom, uuid.uuid1/uuid4, ambient random draws)
+are patched with call-site recorders that are ARMED only while a
+declared replay root (``records/determinism_contracts.py``) is on the
+stack.  This module (named ``zz`` so it collects last and sees the whole
+session) drives the replay surfaces, then asserts:
+
+- every ambient read observed under an armed root maps into DF018's
+  static taint knowledge (``tools/dflint/detrules.py``) — a resolver
+  blind spot is a tier-1 failure, not silent rot;
+- stale contracts fail in both directions (an undeclared root name in an
+  observation is a gap; every declared root resolves statically);
+- the acceptance mutations fail BOTH halves: ``time.time()`` inserted
+  into ``SLOEngine.evaluate`` fails static DF018 by name AND surfaces as
+  a witness gap when the mutant runs armed; dropping ``sort_keys`` from
+  the journal writer fails static DF019 by name AND makes the dual-run
+  drill diverge across PYTHONHASHSEED values;
+- the dual-run harness holds: every declared replay root re-executed in
+  two subprocesses (``tests/_det_child.py``) over identical journal
+  bytes with different PYTHONHASHSEED produces byte-identical decision
+  JSON.
+
+A gap here means the static resolver (or the contract registry) has a
+blind spot — fix ``tools/dflint/detrules.py`` /
+``records/determinism_contracts.py``, never this test.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import types
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from dragonfly2_tpu.utils import dfdet  # noqa: E402
+
+SLO_RELPATH = "dragonfly2_tpu/utils/slo.py"
+MJ_RELPATH = "dragonfly2_tpu/utils/metric_journal.py"
+# Acceptance mutation 1: ambient wall-clock read on the replay path of
+# SLOEngine.evaluate (the declared seam discipline says `now` is the
+# only clock door).
+SLO_NEEDLE = "        else:\n            t = now"
+SLO_MUTANT = SLO_NEEDLE + "\n        t = time.time()"
+# Acceptance mutation 2: drop canonical ordering from the DFMJ1 frame
+# writer.
+MJ_NEEDLE = "payload = json.dumps(snapshot, sort_keys=True).encode()"
+MJ_MUTANT = "payload = json.dumps(snapshot).encode()"
+
+SLOS = [
+    {
+        "name": "dw_avail",
+        "objective": "availability",
+        "good_metric": "dw_good_total",
+        "total_metric": "dw_all_total",
+        "target": 0.9,
+        "fast_window_s": 60.0,
+        "slow_window_s": 600.0,
+    }
+]
+
+
+def _witness():
+    w = dfdet.witness()
+    if w is None:
+        pytest.skip("determinism witness disabled (DF_DET_WITNESS=0)")
+    return w
+
+
+_REAL_MODULES = None
+
+
+def _real_modules():
+    """Parsed Modules for the full tree, loaded once per session — the
+    clean analysis and both static acceptance mutants below each need a
+    whole-program view and the parse dominates the build."""
+    global _REAL_MODULES
+    if _REAL_MODULES is None:
+        from tools.dflint.core import collect_files, load_module
+
+        _REAL_MODULES = [
+            load_module(p, REPO)
+            for p in collect_files(
+                [REPO / "dragonfly2_tpu", REPO / "tools"], REPO
+            )
+        ]
+    return _REAL_MODULES
+
+
+@pytest.fixture(scope="module")
+def analysis():
+    from tools.dflint.detrules import DetAnalysis
+    from tools.dflint.program import Program
+
+    return DetAnalysis(Program(list(_real_modules())), REPO)
+
+
+def _snapshots():
+    """Five cumulative journal-style snapshots of one synthetic run."""
+    from dragonfly2_tpu.utils.metrics import Registry
+
+    reg = Registry()
+    good = reg.counter("dw_good_total")
+    total = reg.counter("dw_all_total")
+    snaps = []
+    for seq in range(1, 6):
+        good.inc(9.0)
+        total.inc(10.0)
+        snaps.append({
+            "v": 1, "service": "dw", "run_id": "run-dw", "pid": 7,
+            "seq": seq, "ts": 100.0 * seq, "metrics": reg.snapshot(),
+        })
+    return snaps
+
+
+def _spans():
+    base = 1_000_000_000
+    mk = lambda sid, parent, name, svc, s, e: {  # noqa: E731
+        "trace_id": "t1", "span_id": sid, "parent_id": parent,
+        "name": name, "service": svc, "start_ns": base + s, "end_ns": base + e,
+        "status": "OK", "status_message": "", "attrs": {},
+    }
+    return [
+        mk("a", "", "announce", "scheduler", 0, 90_000_000),
+        mk("b", "a", "score", "scheduler", 5_000_000, 50_000_000),
+        mk("c", "a", "persist", "manager", 55_000_000, 85_000_000),
+    ]
+
+
+def _drive_workloads():
+    """Exercise every in-process replay root once, armed, through the
+    real public APIs."""
+    import numpy as np
+
+    import tools.trace_assemble as ta
+    from dragonfly2_tpu.qos.accounting import TenantAccounting
+    from dragonfly2_tpu.qos.autopilot import SLOAutopilot
+    from dragonfly2_tpu.rollout import evaluation as ev
+    from dragonfly2_tpu.rollout.controller import (
+        RolloutController,
+        RolloutGuardrails,
+    )
+    from dragonfly2_tpu.rollout.shadow import SHADOW_COLUMNS
+    from dragonfly2_tpu.scheduler.sharding import ShardRing
+    from dragonfly2_tpu.utils.slo import replay_fleet
+
+    snaps = _snapshots()
+    eng = replay_fleet(snaps, SLOS)
+    eng.evaluate(500.0)
+    ap = SLOAutopilot.replay(snaps, SLOS)
+    assert len(ap.decisions) == len(snaps)
+
+    acct = TenantAccounting(now=0.0)
+    for step in range(50):
+        acct.note_at("tenant-%d" % (step % 4), 0.05 * (step + 1))
+    acct.snapshot()
+
+    ctl = RolloutController.__new__(RolloutController)
+    ctl.guardrails = RolloutGuardrails()
+    ctl._breach({
+        "psi_max": 0.01,
+        "regret_at_k": {"candidate": 0.1, "active": 0.2, "k": 4},
+        "inversion_rate": {"candidate": 0.1, "active": 0.2},
+    })
+
+    rng = np.random.default_rng(3)
+    n = 64
+    col = {name: i for i, name in enumerate(SHADOW_COLUMNS)}
+    shadow = np.zeros((n, len(SHADOW_COLUMNS)), dtype=np.float32)
+    shadow[:, col["announce_seq"]] = np.arange(n) // 8
+    shadow[:, col["candidate_version"]] = 1
+    shadow[:, col["src_bucket"]] = rng.integers(0, 16, n)
+    shadow[:, col["dst_bucket"]] = rng.integers(0, 16, n)
+    shadow[:, col["active_rank"]] = rng.integers(0, 8, n)
+    shadow[:, col["candidate_rank"]] = rng.integers(0, 8, n)
+    dl = np.zeros((32, 3), dtype=np.float32)
+    dl[:, 0] = rng.integers(0, 16, 32)
+    dl[:, 1] = rng.integers(0, 16, 32)
+    dl[:, 2] = rng.random(32)
+    ev.evaluate_shadow(shadow, dl, k=3, psi_max=0.05)
+
+    ring = ShardRing({"s-%d" % i: "" for i in range(4)})
+    loads = {"s-%d" % i: float(i) for i in range(4)}
+    for i in range(32):
+        ring.owner("key-%d" % i)
+        ring.pick("key-%d" % i, load_of=lambda sid: loads[sid])
+
+    traces = ta.assemble(_spans())
+    for tid, tspans in traces.items():
+        ta.critical_path(tspans)
+        ta.summarize_trace(tid, tspans)
+
+
+class TestDetWitness:
+    def test_witness_wraps_every_declared_root(self, analysis):
+        w = _witness()
+        declared = set(analysis.replay_root_index())
+        assert declared, "no replay roots resolved statically"
+        assert declared == set(w.wrapped_roots), (
+            "runtime witness and static resolver disagree on the root "
+            f"set: static={sorted(declared)} "
+            f"runtime={sorted(w.wrapped_roots)}"
+        )
+
+    def test_recorder_is_armed_only_under_a_root(self):
+        _witness()
+        with dfdet.isolated() as w:
+            time.time()  # disarmed: must NOT record
+            assert w.snapshot() == []
+            with dfdet.armed("slo.evaluate"):
+                time.time()
+            snap = w.snapshot()
+        assert len(snap) == 1
+        assert snap[0]["source"] == "time.time"
+        assert snap[0]["root"] == "slo.evaluate"
+        assert snap[0]["relpath"] == "tests/test_zz_detwitness.py"
+
+    def test_session_observations_have_no_static_gaps(self, analysis):
+        from tools.dflint.detrules import det_witness_gaps
+
+        w = _witness()
+        _drive_workloads()
+        gaps = det_witness_gaps(analysis, w.snapshot())
+        assert not gaps, (
+            "static taint-report gaps (fix tools/dflint/detrules.py / "
+            "records/determinism_contracts.py, not this test):\n  "
+            + "\n  ".join(gaps)
+        )
+
+    def test_unknown_site_is_a_gap(self, analysis):
+        from tools.dflint.detrules import det_witness_gaps
+
+        fake = [{
+            "relpath": SLO_RELPATH, "lineno": 1,
+            "source": "time.time", "root": "slo.evaluate", "count": 1,
+        }]
+        gaps = det_witness_gaps(analysis, fake)
+        assert gaps and "time.time" in gaps[0]
+
+    def test_undeclared_root_name_is_a_gap(self, analysis):
+        from tools.dflint.detrules import det_witness_gaps
+
+        fake = [{
+            "relpath": SLO_RELPATH, "lineno": 1,
+            "source": "time.time", "root": "no.such_root", "count": 1,
+        }]
+        gaps = det_witness_gaps(analysis, fake)
+        assert gaps and "no.such_root" in gaps[0]
+
+    def test_clean_tree_has_empty_findings(self, analysis):
+        assert analysis.findings() == []
+
+    def test_bench_disarm_stamp_sees_installed_witness(self):
+        """bench_sched stamps ``det_witness_disarmed`` into its report;
+        in this process (conftest installed the witness) the stamp must
+        read armed, so only genuinely witness-less bench runs carry the
+        True flag."""
+        _witness()  # skip when DF_DET_WITNESS=0
+        sys.path.insert(0, str(REPO / "tools"))
+        try:
+            from bench_sched import _det_witness_disarmed
+        finally:
+            sys.path.pop(0)
+        assert _det_witness_disarmed() is False
+
+
+def _mutated_analysis(relpath, needle, repl):
+    from tools.dflint.core import Module
+    from tools.dflint.detrules import DetAnalysis
+    from tools.dflint.program import Program
+
+    modules = []
+    hit = False
+    for m in _real_modules():
+        if m.relpath == relpath:
+            assert needle in m.source, f"needle drifted in {relpath}"
+            m = Module(m.path, relpath, m.source.replace(needle, repl))
+            hit = True
+        modules.append(m)
+    assert hit, f"{relpath} not collected"
+    return DetAnalysis(Program(modules), REPO)
+
+
+class TestAcceptanceMutationsStatic:
+    def test_wall_clock_in_evaluate_fails_df018(self):
+        from tools.dflint.detrules import RULE_DET
+
+        a = _mutated_analysis(SLO_RELPATH, SLO_NEEDLE, SLO_MUTANT)
+        hits = [f for f in a.findings() if f.rule == RULE_DET]
+        assert len(hits) == 1
+        f = hits[0]
+        assert f.path == SLO_RELPATH
+        assert "time.time" in f.message
+        assert "SLOEngine.evaluate" in (f.qual or "")
+
+    def test_sort_keys_drop_fails_df019(self):
+        from tools.dflint.detrules import RULE_CANON
+
+        a = _mutated_analysis(MJ_RELPATH, MJ_NEEDLE, MJ_MUTANT)
+        hits = [f for f in a.findings() if f.rule == RULE_CANON]
+        assert len(hits) == 1
+        f = hits[0]
+        assert f.path == MJ_RELPATH
+        assert "sort_keys" in f.message
+        assert "metric_journal.frame" in f.message
+
+
+class TestAcceptanceMutationDynamic:
+    def test_mutant_evaluate_observed_and_flagged(self, analysis):
+        """The time.time() mutant, executed ARMED, is recorded at its
+        call site — and that site maps nowhere in the static taint
+        knowledge of the REAL tree, so the cross-check flags it."""
+        from tools.dflint.detrules import det_witness_gaps
+
+        _witness()
+        src = (REPO / SLO_RELPATH).read_text(encoding="utf-8")
+        assert SLO_NEEDLE in src
+        mutated = src.replace(SLO_NEEDLE, SLO_MUTANT)
+        mod = types.ModuleType("dragonfly2_tpu.utils._slo_det_mutant")
+        mod.__package__ = "dragonfly2_tpu.utils"
+        mod.__file__ = str(REPO / SLO_RELPATH)
+        sys.modules[mod.__name__] = mod
+        try:
+            exec(compile(mutated, mod.__file__, "exec"), mod.__dict__)
+            eng = mod.SLOEngine(SLOS)
+            for snap in _snapshots():
+                eng.ingest_snapshot(snap)
+            with dfdet.isolated() as w:
+                with dfdet.armed("slo.evaluate"):
+                    eng.evaluate(600.0)
+                observed = w.snapshot()
+        finally:
+            sys.modules.pop(mod.__name__, None)
+        times = [o for o in observed if o["source"] == "time.time"]
+        assert times, f"mutant clock read not observed: {observed}"
+        assert times[0]["relpath"] == SLO_RELPATH
+        gaps = det_witness_gaps(analysis, times)
+        assert gaps, "mutant ambient read excused by the static report"
+        assert "time.time" in gaps[0]
+
+
+def _run_child(args, hashseed, cwd=None):
+    env = dict(os.environ)
+    env["PYTHONHASHSEED"] = str(hashseed)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tests" / "_det_child.py"), *args],
+        capture_output=True, timeout=240, cwd=cwd or str(REPO), env=env,
+    )
+    assert proc.returncode == 0, (
+        f"det child failed (seed {hashseed}):\n{proc.stderr.decode()}"
+    )
+    return proc.stdout
+
+
+@pytest.fixture(scope="module")
+def det_workdir(tmp_path_factory):
+    """Journal bytes written ONCE; both child invocations replay the
+    same files."""
+    from dragonfly2_tpu.utils.metric_journal import encode_frame
+
+    work = tmp_path_factory.mktemp("detrun")
+    for pi in range(2):
+        frames = b""
+        for snap in _snapshots():
+            snap = dict(snap)
+            snap["service"] = f"svc-{pi}"
+            snap["run_id"] = f"run-{pi}"
+            snap["pid"] = 100 + pi
+            snap["ts"] = float(snap["ts"]) + pi
+            frames += encode_frame(snap)
+        (work / f"proc{pi}.dfmj").write_bytes(frames)
+    (work / "slos.json").write_text(json.dumps(SLOS), encoding="utf-8")
+    (work / "spans.json").write_text(json.dumps(_spans()), encoding="utf-8")
+    return work
+
+
+class TestDualRun:
+    def test_all_roots_byte_identical_across_hashseeds(self, det_workdir):
+        out0 = _run_child(["roots", str(det_workdir)], hashseed=0)
+        out42 = _run_child(["roots", str(det_workdir)], hashseed=42)
+        assert out0, "child produced no output"
+        decisions = json.loads(out0)
+        # Every declared root reported a decision payload.
+        from dragonfly2_tpu.records.determinism_contracts import (
+            DETERMINISM_CONTRACTS,
+        )
+
+        assert set(decisions) == set(DETERMINISM_CONTRACTS["replay_roots"])
+        assert out0 == out42, (
+            "replay-root decision JSON diverged across PYTHONHASHSEED"
+        )
+
+    def test_real_writer_frame_bytes_are_seed_independent(self):
+        real = str(REPO / MJ_RELPATH)
+        outs = {_run_child(["drill", real], hashseed=s) for s in (0, 42)}
+        assert len(outs) == 1, "canonical DFMJ1 frame diverged across seeds"
+
+    def test_sort_keys_drop_diverges_across_hashseeds(self, tmp_path):
+        src = (REPO / MJ_RELPATH).read_text(encoding="utf-8")
+        assert MJ_NEEDLE in src
+        mutant = tmp_path / "metric_journal_mutant.py"
+        mutant.write_text(
+            src.replace(MJ_NEEDLE, MJ_MUTANT), encoding="utf-8"
+        )
+        outs = {
+            _run_child(["drill", str(mutant)], hashseed=s)
+            for s in (0, 1, 2, 42)
+        }
+        assert len(outs) > 1, (
+            "sort_keys-dropped writer still produced identical bytes "
+            "across PYTHONHASHSEED values — the divergence drill lost "
+            "its teeth"
+        )

@@ -62,7 +62,20 @@ SCHEMA_KEYS = (
     "telemetry_overhead",
     "qos_overhead",
     "sweep",
+    "det_witness_disarmed",
 )
+
+
+def _det_witness_disarmed() -> bool:
+    """True when the determinism witness (utils/dfdet.py) is absent or
+    off for this process — stamped into the report so a benchmark run
+    measured without the replay-determinism guard is visible in the
+    artifact (DESIGN.md §27)."""
+    mod = sys.modules.get("dragonfly2_tpu.utils.dfdet")
+    if mod is None:
+        return True
+    w = getattr(mod, "witness", lambda: None)()
+    return w is None
 
 
 def _make_weights(seed: int = 0):
@@ -581,6 +594,7 @@ def main(argv=None) -> int:
                     args.linger_ms, args.seed, args.rounds)
             sweep.append(_sweep_entry(r, args.hosts, par))
         out["sweep"] = sweep
+        out["det_witness_disarmed"] = _det_witness_disarmed()
         missing = [k for k in SCHEMA_KEYS if k not in out]
         if missing:
             raise RuntimeError(f"schema keys missing: {missing}")
@@ -589,9 +603,9 @@ def main(argv=None) -> int:
             "ok": False,
             "metric": "scheduler_announces_per_sec",
             "error": f"{type(exc).__name__}: {exc}"[:300],
-        }))
+        }, sort_keys=True))
         return 1
-    print(json.dumps(out))
+    print(json.dumps(out, sort_keys=True))
     return 0
 
 

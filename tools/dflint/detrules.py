@@ -1,0 +1,819 @@
+"""Whole-program replay-determinism analysis (DF018 / DF019).
+
+The concurrency pass (``program.py``) guards locks, the trace pass
+(``tracerules.py``) guards the XLA layer, the state pass
+(``staterules.py``) guards persistence; this module guards the property
+every autonomous decision stands on — **replay equals live off the
+journal** (§23 burn-rate replay, §26 autopilot drift-0, the accounting
+rebuild drill).  Both rule families key off ONE declared-once literal
+registry, ``dragonfly2_tpu/records/determinism_contracts.py``, read
+with ``ast.literal_eval`` (no import — dflint stays stdlib-only), and
+are built on :class:`tools.dflint.program.Program`'s symbol table and
+call graph.
+
+**DF018 — ambient nondeterminism on a replay path.**  Every function
+statically reachable from a declared replay root is *tainted*.  Inside
+the taint closure the analyzer fails:
+
+- wall-clock reads (``time.time``/``monotonic``/``perf_counter`` and
+  their ``_ns`` twins, ``datetime.now``/``utcnow``/``today``);
+- unseeded randomness: ambient ``random.*`` / ``numpy.random.*`` module
+  calls, unseeded ``random.Random()`` / ``numpy.random.default_rng()``
+  factories, ``random.SystemRandom`` / ``os.urandom`` / ``uuid.uuid1``/
+  ``uuid4`` / ``secrets.*`` entropy;
+- the randomized builtins ``hash()`` and ``id()`` (PYTHONHASHSEED /
+  address-order leaks);
+- set-iteration feeding ordered output (a ``for`` / comprehension
+  iterating a set display, set comprehension, or ``set()``/
+  ``frozenset()`` call directly — ``sorted(...)`` around it is the
+  canonical fix and is naturally clean).
+
+Nondeterminism enters a replay path ONLY through a declared **injection
+seam** — a declared parameter (clock params like ``now``, seeded-RNG
+factories, ``run_id`` identity) on a declared function.  The live edge
+samples the ambient source *outside* the closure and passes the value
+through the seam; replay passes journal timestamps through the same
+door.  Declared-but-unresolvable roots/seams/sinks fail by name (a
+stale contract is a finding, not silent rot).  Declared observability
+*sinks* (the flight recorder, gauge/counter writes, the chaos seam)
+stop taint propagation: their values never flow back into decision
+output.
+
+**DF019 — canonical serialization on artifact paths.**  Every declared
+journal/replay artifact writer (DFMJ1 metric frames, DFTL1 trace
+frames, DFC1 columnar headers, the assemble/bench JSON reports) and
+every function in the DF018 taint closure must pin
+``sort_keys=True`` on each ``json.dumps``; declared frame-payload
+builders must build their payload dict from exactly the declared
+bounded key set (drift fails in BOTH directions).
+
+The static inventory is cross-validated at runtime by the determinism
+witness (``dragonfly2_tpu/utils/dfdet.py`` +
+``tests/test_zz_detwitness.py``): ambient sources are patched with
+call-site recorders armed while a declared replay root is on the
+stack.  Every runtime observation must map to a static DF018 site or a
+declared sink span (:func:`det_witness_gaps`) — a resolver blind spot
+is a tier-1 failure.  The same test re-runs every root twice over
+identical journal bytes in subprocesses with different PYTHONHASHSEED;
+decision output must be byte-identical.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, collect_files, dotted, load_module
+from .program import (
+    FuncInfo,
+    ModuleInfo,
+    Program,
+    _calls_in,
+    _walk_skipping_defs,
+)
+
+RULE_DET = "DF018"
+TITLE_DET = "ambient nondeterminism on a replay path"
+RULE_CANON = "DF019"
+TITLE_CANON = "non-canonical serialization on a journal/replay artifact path"
+
+DETERMINISM_CONTRACTS_RELPATH = (
+    "dragonfly2_tpu/records/determinism_contracts.py"
+)
+
+# -- ambient-source classification tables -----------------------------------
+
+# Canonical dotted names (import-resolved) that read the wall clock.
+_WALL_CLOCK = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "time.process_time", "time.process_time_ns",
+    "time.clock_gettime", "time.clock_gettime_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+# Canonical names that are entropy sources no matter the arguments.
+_ENTROPY = {
+    "os.urandom",
+    "uuid.uuid1", "uuid.uuid4",
+    "secrets.token_bytes", "secrets.token_hex", "secrets.token_urlsafe",
+    "secrets.randbits", "secrets.choice", "secrets.randbelow",
+    "random.SystemRandom",
+}
+
+# RNG *factories*: deterministic iff called with an explicit seed.
+_RNG_FACTORIES = {
+    "random.Random",
+    "numpy.random.default_rng",
+    "numpy.random.RandomState",
+    "numpy.random.Generator",
+    "jax.random.PRNGKey", "jax.random.key",
+}
+
+# Module prefixes whose bare function calls hit the AMBIENT global RNG.
+_AMBIENT_RNG_PREFIXES = ("random.", "numpy.random.")
+
+# numpy.random attributes that are types/helpers, not ambient draws.
+_RNG_NON_DRAWS = {
+    "numpy.random.Generator", "numpy.random.BitGenerator",
+    "numpy.random.SeedSequence", "numpy.random.Philox",
+    "numpy.random.PCG64",
+}
+
+_HASHSEED_BUILTINS = {"hash", "id"}
+
+
+class AmbientSite:
+    """One statically-detected ambient-nondeterminism call site."""
+
+    __slots__ = ("relpath", "line", "source", "root", "chain", "node", "fi")
+
+    def __init__(self, relpath: str, line: int, source: str, root: str,
+                 chain: str, node: ast.AST, fi: FuncInfo) -> None:
+        self.relpath = relpath
+        self.line = line
+        self.source = source
+        self.root = root
+        self.chain = chain
+        self.node = node
+        self.fi = fi
+
+
+class DetAnalysis:
+    """DF018-DF019 over a linked :class:`Program`.
+
+    The declared roots span ``dragonfly2_tpu/`` *and* ``tools/`` (the
+    assemble CLIs are replay consumers); when the supplied program does
+    not hold a declared file, the analysis transparently rebuilds an
+    extended program over the union so tool-side roots resolve without
+    widening the caller's program (and its DF008/DF009 scope).
+    """
+
+    def __init__(self, program: Program, root: Optional[Path] = None) -> None:
+        self.root = root
+        self._findings: List[Finding] = []
+        self.contracts = self._load_contracts(program)
+        self.program = self._extend_program(program)
+        self.roots: Dict[str, FuncInfo] = {}
+        # FuncInfo.key -> (root name, human call chain)
+        self.closure: Dict[str, Tuple[str, str]] = {}
+        self.ambient_sites: List[AmbientSite] = []
+        self._sink_prefixes: List[Tuple[str, str]] = []
+        if self.contracts:
+            self._sink_prefixes = self._parse_sinks()
+            self._resolve_roots()
+            self._build_closure()
+            self._check_df018()
+            self._check_seams()
+            self._check_df019()
+        self._findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    # ------------------------------------------------------------------
+    # Shared plumbing
+    # ------------------------------------------------------------------
+
+    def findings(self) -> List[Finding]:
+        return list(self._findings)
+
+    def _emit(self, rule: str, mi: ModuleInfo, node: ast.AST, message: str) -> None:
+        module = mi.module
+        line = getattr(node, "lineno", 1)
+        if module.suppressed(rule, line):
+            return
+        self._findings.append(
+            Finding(
+                rule=rule,
+                path=mi.relpath,
+                line=line,
+                col=getattr(node, "col_offset", 0) + 1,
+                message=message,
+                qual=module.qualname(node),
+            )
+        )
+
+    def _load_contracts(self, program: Program) -> dict:
+        mi = program.modules.get(DETERMINISM_CONTRACTS_RELPATH)
+        tree = None
+        if mi is not None:
+            tree = mi.module.tree
+        elif self.root is not None and any(
+            rp.startswith(("dragonfly2_tpu/", "tools/"))
+            for rp in program.modules
+        ):
+            # Fall back to the on-disk registry only when the analyzed
+            # program is actually part of the project tree — an
+            # out-of-tree run (absolute relpaths) gets no det contracts,
+            # otherwise every declared root would report as stale.
+            path = self.root / DETERMINISM_CONTRACTS_RELPATH
+            if path.exists():
+                tree = ast.parse(path.read_text(encoding="utf-8"))
+        if tree is None:
+            return {}
+        for stmt in tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "DETERMINISM_CONTRACTS"
+            ):
+                try:
+                    return ast.literal_eval(stmt.value)
+                except ValueError:
+                    if mi is not None:
+                        self._emit(
+                            RULE_DET, mi, stmt,
+                            "DETERMINISM_CONTRACTS must stay a pure literal "
+                            "(ast.literal_eval failed — dflint reads it "
+                            "without importing)",
+                        )
+                    return {}
+        return {}
+
+    def _declared_files(self) -> Set[str]:
+        files: Set[str] = set()
+        for spec in self.contracts.get("replay_roots", {}).values():
+            files.add(str(spec.get("file", "")))
+        for spec in self.contracts.get("serialization", {}).values():
+            files.add(str(spec.get("file", "")))
+        files.discard("")
+        return files
+
+    def _extend_program(self, program: Program) -> Program:
+        """Rebuild with the declared tool-side files added when absent.
+        No-op (same object) when every declared file is already loaded."""
+        missing = [
+            f for f in sorted(self._declared_files())
+            if f not in program.modules
+        ]
+        if not missing or self.root is None:
+            return program
+        modules = [mi.module for mi in program.modules.values()]
+        have = {m.relpath for m in modules}
+        for relpath in missing:
+            path = self.root / relpath
+            if not path.exists():
+                continue  # staleness finding fires in _resolve_roots
+            for loaded in collect_files([path], self.root):
+                try:
+                    module = load_module(loaded, self.root)
+                except (SyntaxError, UnicodeDecodeError):
+                    continue
+                if module.relpath not in have:
+                    have.add(module.relpath)
+                    modules.append(module)
+        return Program(modules)
+
+    def _contracts_mi(self) -> Optional[ModuleInfo]:
+        return self.program.modules.get(DETERMINISM_CONTRACTS_RELPATH)
+
+    def _emit_contract(self, rule: str, message: str) -> None:
+        """A staleness finding anchored on the registry itself."""
+        mi = self._contracts_mi()
+        if mi is None:
+            # Registry outside the analyzed tree: surface on the first
+            # analyzed module so the finding is not silently dropped.
+            for mi in self.program.modules.values():
+                break
+            else:
+                return
+        self._emit(rule, mi, mi.module.tree, message)
+
+    # ------------------------------------------------------------------
+    # Roots, sinks, taint closure
+    # ------------------------------------------------------------------
+
+    def _parse_sinks(self) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        for entry in self.contracts.get("sinks", []):
+            if ":" not in str(entry):
+                self._emit_contract(
+                    RULE_DET,
+                    f"declared sink {entry!r} must be 'relpath:qual' or "
+                    "'relpath:*'",
+                )
+                continue
+            relpath, qual = str(entry).rsplit(":", 1)
+            if relpath not in self.program.modules:
+                self._emit_contract(
+                    RULE_DET,
+                    f"declared sink module {relpath!r} is not in the "
+                    "analyzed tree — stale contract",
+                )
+                continue
+            if qual != "*" and (
+                f"{relpath}:{qual}" not in self.program.funcs
+            ):
+                self._emit_contract(
+                    RULE_DET,
+                    f"declared sink {relpath}:{qual} does not resolve to a "
+                    "function — stale contract",
+                )
+                continue
+            out.append((relpath, qual))
+        return out
+
+    def _is_sink(self, key: str) -> bool:
+        relpath, _, qual = key.partition(":")
+        for s_rel, s_qual in self._sink_prefixes:
+            if relpath != s_rel:
+                continue
+            if s_qual == "*" or qual == s_qual or qual.startswith(s_qual + "."):
+                return True
+        return False
+
+    def _resolve_roots(self) -> None:
+        for name in sorted(self.contracts.get("replay_roots", {})):
+            spec = self.contracts["replay_roots"][name]
+            key = f"{spec.get('file')}:{spec.get('qual')}"
+            fi = self.program.funcs.get(key)
+            if fi is None:
+                self._emit_contract(
+                    RULE_DET,
+                    f"declared replay root {name!r} ({key}) does not "
+                    "resolve to a project function — stale contract",
+                )
+                continue
+            self.roots[name] = fi
+
+    def _build_closure(self) -> None:
+        for name in sorted(self.roots):
+            fi = self.roots[name]
+            if fi.key not in self.closure:
+                self.closure[fi.key] = (name, fi.qual)
+            stack = [fi]
+            while stack:
+                cur = stack.pop()
+                root, chain = self.closure[cur.key]
+                if root != name:
+                    continue  # claimed by an earlier root; already walked
+                for _call, target in cur.calls:
+                    if target.key in self.closure:
+                        continue
+                    if self._is_sink(target.key):
+                        continue
+                    self.closure[target.key] = (
+                        name, f"{chain} -> {target.qual}"
+                    )
+                    stack.append(target)
+
+    # ------------------------------------------------------------------
+    # DF018: ambient-source scan over the closure
+    # ------------------------------------------------------------------
+
+    def _canonical_callee(self, mi: ModuleInfo, call: ast.Call) -> Optional[str]:
+        """Import-resolved dotted name of the callee:
+        ``time.time()`` / ``from time import time; time()`` both map to
+        ``"time.time"``; ``np.random.default_rng`` maps to
+        ``"numpy.random.default_rng"``."""
+        name = dotted(call.func)
+        if not name:
+            return None
+        head, _, rest = name.partition(".")
+        imp = mi.imports.get(head)
+        if imp is None:
+            return name
+        base, attr = imp
+        parts = [base]
+        if attr:
+            parts.append(attr)
+        if rest:
+            parts.append(rest)
+        return ".".join(parts)
+
+    @staticmethod
+    def _seeded(call: ast.Call) -> bool:
+        """An RNG factory call is deterministic iff it receives an
+        explicit non-None seed (positionally or by keyword)."""
+        for arg in call.args:
+            if not (isinstance(arg, ast.Constant) and arg.value is None):
+                return True
+        for kw in call.keywords:
+            if kw.arg in ("seed", "x") and not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None
+            ):
+                return True
+        return False
+
+    def _classify_ambient(
+        self, fi: FuncInfo, call: ast.Call
+    ) -> Optional[Tuple[str, str]]:
+        """(canonical source, human description) when ``call`` reads an
+        ambient nondeterminism source, else None."""
+        mi = fi.module
+        canon = self._canonical_callee(mi, call)
+        if canon is None:
+            return None
+        if canon in _WALL_CLOCK:
+            return canon, f"wall-clock read {canon}()"
+        if canon in _ENTROPY:
+            return canon, f"entropy source {canon}()"
+        if canon in _RNG_FACTORIES:
+            if self._seeded(call):
+                return None
+            return canon, f"unseeded RNG factory {canon}()"
+        for prefix in _AMBIENT_RNG_PREFIXES:
+            if canon.startswith(prefix) and canon not in _RNG_NON_DRAWS:
+                return canon, (
+                    f"{canon}() draws from the ambient global RNG "
+                    "(seed a Generator through a declared seam instead)"
+                )
+        if (
+            canon in _HASHSEED_BUILTINS
+            and isinstance(call.func, ast.Name)
+            and canon not in mi.functions
+            and canon not in mi.imports
+            and canon not in mi.aliases
+        ):
+            return f"builtins.{canon}", (
+                f"builtin {canon}() is randomized per process "
+                "(PYTHONHASHSEED / address order)"
+            )
+        return None
+
+    def _is_set_expr(self, mi: ModuleInfo, expr: ast.AST) -> bool:
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
+            name = expr.func.id
+            if name in ("set", "frozenset") and (
+                name not in mi.functions
+                and name not in mi.imports
+                and name not in mi.aliases
+            ):
+                return True
+        return False
+
+    def _scan_function(self, fi: FuncInfo, root: str, chain: str) -> None:
+        mi = fi.module
+        for call in _calls_in(fi.node):
+            hit = self._classify_ambient(fi, call)
+            if hit is None:
+                continue
+            source, desc = hit
+            site = AmbientSite(
+                mi.relpath, getattr(call, "lineno", 1), source,
+                root, chain, call, fi,
+            )
+            self.ambient_sites.append(site)
+            self._emit(
+                RULE_DET, mi, call,
+                f"{desc} on the replay path of root {root!r} "
+                f"(chain: {chain}) — thread the value through a declared "
+                "injection seam (records/determinism_contracts.py)",
+            )
+        for node in _walk_skipping_defs(fi.node):
+            iters: List[ast.AST] = []
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iters.extend(gen.iter for gen in node.generators)
+            for it in iters:
+                if self._is_set_expr(mi, it):
+                    self._emit(
+                        RULE_DET, mi, it,
+                        "set iteration feeds ordered output on the replay "
+                        f"path of root {root!r} (chain: {chain}) — wrap in "
+                        "sorted(...) to pin the order",
+                    )
+
+    def _check_df018(self) -> None:
+        for key in sorted(self.closure):
+            fi = self.program.funcs.get(key)
+            if fi is None:
+                continue
+            root, chain = self.closure[key]
+            self._scan_function(fi, root, chain)
+
+    # ------------------------------------------------------------------
+    # Injection-seam staleness (both directions)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _param_names(node: ast.FunctionDef) -> Set[str]:
+        args = node.args
+        names = {a.arg for a in args.args}
+        names.update(a.arg for a in args.posonlyargs)
+        names.update(a.arg for a in args.kwonlyargs)
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+        return names
+
+    def _class_field_names(self, relpath: str, qual: str) -> Optional[Set[str]]:
+        """Annotated field names of class ``qual`` in ``relpath`` (the
+        dataclass case — no explicit __init__ to hold the seam param)."""
+        mi = self.program.modules.get(relpath)
+        if mi is None:
+            return None
+        ci = mi.classes.get(qual)
+        if ci is None:
+            return None
+        names: Set[str] = set()
+        for stmt in ci.node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                names.add(stmt.target.id)
+        return names
+
+    def _check_seams(self) -> None:
+        for seam in self.contracts.get("injection_seams", []):
+            relpath = str(seam.get("file", ""))
+            qual = str(seam.get("qual", ""))
+            params = [str(p) for p in seam.get("params", [])]
+            key = f"{relpath}:{qual}"
+            fi = self.program.funcs.get(key)
+            if fi is not None:
+                have = self._param_names(fi.node)
+            else:
+                have = self._class_field_names(relpath, qual)
+            if have is None:
+                self._emit_contract(
+                    RULE_DET,
+                    f"declared injection seam {key} does not resolve to a "
+                    "function or class — stale contract",
+                )
+                continue
+            for param in params:
+                if param not in have:
+                    self._emit_contract(
+                        RULE_DET,
+                        f"declared injection seam {key} has no parameter/"
+                        f"field {param!r} — stale contract",
+                    )
+
+    # ------------------------------------------------------------------
+    # DF019: canonical serialization
+    # ------------------------------------------------------------------
+
+    def _dumps_calls(self, fi: FuncInfo) -> List[ast.Call]:
+        out = []
+        for call in _calls_in(fi.node):
+            if self._canonical_callee(fi.module, call) == "json.dumps":
+                out.append(call)
+        return out
+
+    @staticmethod
+    def _pins_sort_keys(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "sort_keys":
+                return (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                )
+        return False
+
+    def _payload_literal_keys(self, fi: FuncInfo) -> Optional[Set[str]]:
+        """Constant keys of the payload dict a builder produces: a
+        returned dict literal, or a dict literal passed straight into
+        ``json.dumps``.  None when no statically-visible literal exists."""
+        dicts: List[ast.Dict] = []
+        for node in _walk_skipping_defs(fi.node):
+            if isinstance(node, ast.Return) and isinstance(node.value, ast.Dict):
+                dicts.append(node.value)
+        for call in self._dumps_calls(fi):
+            if call.args and isinstance(call.args[0], ast.Dict):
+                dicts.append(call.args[0])
+        if not dicts:
+            return None
+        keys: Set[str] = set()
+        for d in dicts:
+            for k in d.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+                else:
+                    return None  # computed key: not a bounded literal
+        return keys
+
+    def _check_df019(self) -> None:
+        serialization = self.contracts.get("serialization", {})
+        writer_keys: Set[str] = set()
+        for name in sorted(serialization):
+            spec = serialization[name]
+            relpath = str(spec.get("file", ""))
+            qual = str(spec.get("qual", ""))
+            key = f"{relpath}:{qual}"
+            writer_keys.add(key)
+            fi = self.program.funcs.get(key)
+            if fi is None:
+                self._emit_contract(
+                    RULE_CANON,
+                    f"declared artifact writer {name!r} ({key}) does not "
+                    "resolve to a project function — stale contract",
+                )
+                continue
+            for call in self._dumps_calls(fi):
+                if not self._pins_sort_keys(call):
+                    self._emit(
+                        RULE_CANON, fi.module, call,
+                        f"json.dumps in declared artifact writer {name!r} "
+                        "must pin sort_keys=True — replay byte-identity "
+                        "depends on canonical key order",
+                    )
+            declared = spec.get("keys")
+            builder_qual = spec.get("builder")
+            if declared is None or builder_qual is None:
+                continue
+            b_fi = self.program.funcs.get(f"{relpath}:{builder_qual}")
+            if b_fi is None:
+                self._emit_contract(
+                    RULE_CANON,
+                    f"declared payload builder {relpath}:{builder_qual} "
+                    f"for writer {name!r} does not resolve — stale contract",
+                )
+                continue
+            built = self._payload_literal_keys(b_fi)
+            if built is None:
+                self._emit(
+                    RULE_CANON, b_fi.module, b_fi.node,
+                    f"payload builder {builder_qual} of writer {name!r} has "
+                    "no statically-visible payload dict literal — the "
+                    "declared bounded key set cannot be checked",
+                )
+                continue
+            declared_set = {str(k) for k in declared}
+            for extra in sorted(built - declared_set):
+                self._emit(
+                    RULE_CANON, b_fi.module, b_fi.node,
+                    f"frame payload key {extra!r} built by {builder_qual} "
+                    f"is not in writer {name!r}'s declared bounded key set "
+                    "— declare it in records/determinism_contracts.py",
+                )
+            for missing in sorted(declared_set - built):
+                self._emit_contract(
+                    RULE_CANON,
+                    f"writer {name!r} declares frame key {missing!r} that "
+                    f"{builder_qual} no longer builds — stale contract",
+                )
+        # Sweep: any json.dumps inside the DF018 closure must be
+        # canonical too (assemble/report helpers feeding artifacts).
+        for key in sorted(self.closure):
+            if key in writer_keys:
+                continue
+            fi = self.program.funcs.get(key)
+            if fi is None:
+                continue
+            root, chain = self.closure[key]
+            for call in self._dumps_calls(fi):
+                if not self._pins_sort_keys(call):
+                    self._emit(
+                        RULE_CANON, fi.module, call,
+                        "json.dumps on the replay path of root "
+                        f"{root!r} (chain: {chain}) must pin "
+                        "sort_keys=True",
+                    )
+
+    # ------------------------------------------------------------------
+    # Public surface (determinism witness + DESIGN.md §27 inventory)
+    # ------------------------------------------------------------------
+
+    def replay_root_index(self) -> Dict[str, Tuple[str, str]]:
+        """root name -> (relpath, qual) for every resolved root — the
+        runtime witness wraps exactly these."""
+        return {
+            name: (fi.module.relpath, fi.qual)
+            for name, fi in self.roots.items()
+        }
+
+    def ambient_site_index(self) -> Dict[Tuple[str, int], List[str]]:
+        """(relpath, line) -> ambient source names statically known
+        there (pragma-suppressed sites included — the witness maps
+        observations against *knowledge*, not against open findings)."""
+        out: Dict[Tuple[str, int], List[str]] = {}
+        for site in self.ambient_sites:
+            out.setdefault((site.relpath, site.line), []).append(site.source)
+        return out
+
+    def sink_spans(self) -> List[Tuple[str, int, int]]:
+        """(relpath, first line, last line) per declared-sink function —
+        plus (relpath, 0, 0) wildcards for whole-module sinks.  Runtime
+        ambient reads observed inside one of these are excused."""
+        out: List[Tuple[str, int, int]] = []
+        for relpath, qual in self._sink_prefixes:
+            if qual == "*":
+                out.append((relpath, 0, 0))
+                continue
+            for key, fi in self.program.funcs.items():
+                k_rel, _, k_qual = key.partition(":")
+                if k_rel != relpath:
+                    continue
+                if k_qual == qual or k_qual.startswith(qual + "."):
+                    start = fi.node.lineno
+                    end = getattr(fi.node, "end_lineno", start) or start
+                    out.append((relpath, start, end))
+        return sorted(out)
+
+    def taint_report(self) -> Dict[str, Tuple[str, str]]:
+        """FuncInfo.key -> (root, chain) for the whole closure."""
+        return dict(self.closure)
+
+    def det_inventory_markdown(self) -> str:
+        """The committed DESIGN.md §27 block: declared roots with their
+        closure sizes, seams, and artifact writers.  Sorted, stable."""
+        per_root: Dict[str, int] = {name: 0 for name in self.roots}
+        for _key, (root, _chain) in self.closure.items():
+            if root in per_root:
+                per_root[root] += 1
+        lines = [
+            "| replay root | function | tainted functions |",
+            "| --- | --- | --- |",
+        ]
+        for name in sorted(self.roots):
+            fi = self.roots[name]
+            lines.append(
+                f"| `{name}` | `{fi.module.relpath}:{fi.qual}` | "
+                f"{per_root.get(name, 0)} |"
+            )
+        lines += ["", "| injection seam | params | kind |", "| --- | --- | --- |"]
+        for seam in sorted(
+            self.contracts.get("injection_seams", []),
+            key=lambda s: (str(s.get("file")), str(s.get("qual"))),
+        ):
+            lines.append(
+                f"| `{seam.get('file')}:{seam.get('qual')}` | "
+                f"`{', '.join(str(p) for p in seam.get('params', []))}` | "
+                f"{seam.get('kind', '')} |"
+            )
+        lines += ["", "| artifact writer | format | bounded keys |",
+                  "| --- | --- | --- |"]
+        serialization = self.contracts.get("serialization", {})
+        for name in sorted(serialization):
+            spec = serialization[name]
+            keys = spec.get("keys")
+            lines.append(
+                f"| `{spec.get('file')}:{spec.get('qual')}` | "
+                f"{spec.get('format', '')} | "
+                + (f"`{', '.join(str(k) for k in keys)}`" if keys else "—")
+                + " |"
+            )
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Runner integration
+# ---------------------------------------------------------------------------
+
+
+def det_witness_gaps(
+    analysis: DetAnalysis,
+    observed: Sequence[dict],
+) -> List[str]:
+    """Cross-validate runtime ambient-source observations (from
+    ``dragonfly2_tpu.utils.dfdet``) against the static taint report.
+    ``observed`` entries carry ``relpath``, ``lineno``, ``source`` and
+    the armed ``root`` name.
+
+    Empty result == every ambient read that happened while a replay
+    root was on the stack is either statically known at that site
+    (a DF018 finding or a pragma-reviewed site) or sits inside a
+    declared observability sink.  A gap is a RESOLVER BLIND SPOT (the
+    static taint closure missed a call edge) or a STALE CONTRACT (a
+    root the registry does not declare) — never a thing to silence in
+    the test."""
+    index = analysis.ambient_site_index()
+    sink_modules = {rel for rel, s, e in analysis.sink_spans() if s == 0}
+    sink_ranges: Dict[str, List[Tuple[int, int]]] = {}
+    for rel, start, end in analysis.sink_spans():
+        if start:
+            sink_ranges.setdefault(rel, []).append((start, end))
+    declared_roots = set(analysis.replay_root_index())
+    gaps: List[str] = []
+    for rec in sorted(
+        observed,
+        key=lambda r: (str(r.get("relpath")), int(r.get("lineno", 0))),
+    ):
+        relpath = str(rec.get("relpath", ""))
+        lineno = int(rec.get("lineno", 0))
+        source = str(rec.get("source", ""))
+        root = str(rec.get("root", ""))
+        if root and root not in declared_roots:
+            gaps.append(
+                f"runtime witness armed by root {root!r} that the "
+                "determinism contracts no longer declare — stale contract"
+            )
+            continue
+        if relpath in sink_modules:
+            continue
+        if any(
+            start <= lineno <= end
+            for start, end in sink_ranges.get(relpath, [])
+        ):
+            continue
+        if (relpath, lineno) in index:
+            continue
+        gaps.append(
+            f"ambient read {source} at {relpath}:{lineno} observed at "
+            f"runtime under replay root {root!r} is unknown to the static "
+            "taint report — a call edge the resolver missed or an "
+            "undeclared path into the root"
+        )
+    return gaps
+
+
+def det_findings(program: Program, root: Optional[Path] = None) -> List[Finding]:
+    return DetAnalysis(program, root).findings()
