@@ -155,10 +155,13 @@ def build(cfg: DaemonConfig, scheduler_url: str):
         host,
         storage,
         client,
-        piece_fetcher=HTTPPieceFetcher(client.resolve_host, ssl_context=fetch_ssl),
+        piece_fetcher=HTTPPieceFetcher(
+            client.resolve_host, ssl_context=fetch_ssl, tenant=cfg.tenant
+        ),
         source_fetcher=PieceSourceFetcher(),
         concurrent_source_groups=cfg.concurrent_source_groups,
         stream_tee_depth=cfg.stream_tee_depth,
+        native_fetch=cfg.native_fetch,
         tenant=cfg.tenant,
     )
     announcer = HostAnnouncer(host, client, tenant=cfg.tenant)
@@ -264,10 +267,12 @@ def run(argv=None) -> int:
 
         from ..rpc import HTTPPieceFetcher
 
-        # Keep the mTLS client identity through the resolver swap.
+        # Keep the mTLS client identity (and the requester-pays tenant
+        # stamp) through the resolver swap.
         old_fetcher = parts["conductor"].piece_fetcher
         parts["conductor"].piece_fetcher = HTTPPieceFetcher(
-            resolve, ssl_context=getattr(old_fetcher, "ssl_context", None)
+            resolve, ssl_context=getattr(old_fetcher, "ssl_context", None),
+            tenant=getattr(old_fetcher, "tenant", ""),
         )
         print(f"dfdaemon: pex gossip on udp:{bus.address[1]}", flush=True)
 

@@ -472,6 +472,11 @@ class DaemonConfig:
     # in pieces per stream consumer; 0 = disable the tee (proxy/gateway
     # streams read every piece back off disk).
     stream_tee_depth: int = 8
+    # In-engine piece fetch loop (DESIGN.md §28): the conductor drains a
+    # piece window through native pf_* workers when the whole fallback
+    # matrix allows (native storage, plain-HTTP transport, no stream
+    # consumers, no piece-plane faults).  Off → always the Python arm.
+    native_fetch: bool = True
     # Cloud back-to-source credentials by scheme (peerhost.go source
     # plugins): {"s3": {...}, "oss": {...}, "hdfs": {...}, "oras": {...}}
     # — see dragonfly2_tpu.source.configure_sources.

@@ -265,6 +265,15 @@ class DaemonStorage:
                 self._tasks[task_id]["atime"] = time.time()
         return self.engine.write_piece(task_id, number, data)
 
+    def touch_task(self, task_id: str) -> None:
+        """LRU-evidence touch for commits that bypassed ``write_piece`` —
+        the in-engine fetch loop (DESIGN.md §28) writes pieces directly
+        through the native engine; without the touch a task filled that
+        way would look idle to quota reclaim."""
+        with self._mu:
+            if task_id in self._tasks:
+                self._tasks[task_id]["atime"] = time.time()
+
     def read_piece(self, task_id: str, number: int, *, verify: bool = True) -> bytes:
         with self._mu:
             if task_id in self._tasks:

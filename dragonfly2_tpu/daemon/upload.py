@@ -144,11 +144,28 @@ class UploadManager:
 
     # -- shared accounting gate (both serve shapes) --------------------------
 
-    def begin_upload(self, task_id: Optional[str] = None) -> None:
+    def _charged_tenant_locked(
+        self, task_id: Optional[str], requester_tenant: Optional[str]
+    ) -> str:
+        """Who pays for this serve: the REQUESTING tenant when the wire
+        carried one (X-Dragonfly-Tenant), else the task's owner.  Before
+        requester attribution existed, a stranger's cross-tenant pulls
+        drained the owner's byte bucket — the victim got throttled for
+        traffic it never asked for (DESIGN.md §28)."""
+        if requester_tenant:
+            return requester_tenant
+        return self._task_tenant.get(task_id or "", _DEFAULT_TENANT)
+
+    def begin_upload(
+        self,
+        task_id: Optional[str] = None,
+        requester_tenant: Optional[str] = None,
+    ) -> None:
         """Claim one upload slot; raises UploadBusy past the cap and
-        UploadThrottled when the owning tenant's bandwidth cap is in
-        debt.  Callers MUST pair with ``end_upload`` (the sendfile
-        server path wraps its own stream between the two)."""
+        UploadThrottled when the charged tenant's bandwidth cap is in
+        debt (the requester when known, else the task owner).  Callers
+        MUST pair with ``end_upload`` (the sendfile server path wraps
+        its own stream between the two)."""
         from ..utils import faultinject
 
         # Throttle chaos seam (DF004): injected drops/delays here prove
@@ -158,7 +175,7 @@ class UploadManager:
         with self._mu:
             if self._active >= self.concurrent_limit:
                 raise UploadBusy(f"{self._active} active uploads")
-            tenant = self._task_tenant.get(task_id or "", _DEFAULT_TENANT)
+            tenant = self._charged_tenant_locked(task_id, requester_tenant)
             bw = self._bw_locked(tenant)
             if bw is not None:
                 bw.refill(time.monotonic())
@@ -175,14 +192,18 @@ class UploadManager:
             self._active += 1
 
     def end_upload(
-        self, ok: bool, nbytes: int = 0, task_id: Optional[str] = None
+        self,
+        ok: bool,
+        nbytes: int = 0,
+        task_id: Optional[str] = None,
+        requester_tenant: Optional[str] = None,
     ) -> None:
         with self._mu:
             self._active -= 1
             if ok:
                 self.upload_count += 1
                 self.bytes_served += nbytes
-                tenant = self._task_tenant.get(task_id or "", _DEFAULT_TENANT)
+                tenant = self._charged_tenant_locked(task_id, requester_tenant)
                 self.tenant_bytes[tenant] = (
                     self.tenant_bytes.get(tenant, 0) + nbytes
                 )
@@ -200,7 +221,10 @@ class UploadManager:
     # -- buffered serving ----------------------------------------------------
 
     # dflint: hotpath
-    def serve_piece(self, task_id: str, number: int) -> bytes:
+    def serve_piece(
+        self, task_id: str, number: int,
+        requester_tenant: Optional[str] = None,
+    ) -> bytes:
         """One piece upload; raises UploadBusy past the concurrency cap,
         KeyError when the piece isn't local."""
         from ..utils import faultinject
@@ -209,7 +233,7 @@ class UploadManager:
         # truncate on the body): covers BOTH piece transports — the HTTP
         # server and the in-process fetcher call through here.
         faultinject.fire("daemon.upload.serve_piece")
-        self.begin_upload(task_id)
+        self.begin_upload(task_id, requester_tenant)
         ok = False
         try:
             data = self.storage.read_piece(task_id, number)
@@ -218,10 +242,12 @@ class UploadManager:
             ok = True
             return data
         finally:
-            self.end_upload(ok, len(data) if ok else 0, task_id)
+            self.end_upload(ok, len(data) if ok else 0, task_id,
+                            requester_tenant)
 
     def serve_piece_span(
-        self, task_id: str, number: int, offset: int, max_len: int
+        self, task_id: str, number: int, offset: int, max_len: int,
+        requester_tenant: Optional[str] = None,
     ) -> bytes:
         """Buffered SUB-PIECE upload: only the requested span is read
         (storage.read_piece_at) — a tiny Range request no longer
@@ -230,7 +256,7 @@ class UploadManager:
         from ..utils import faultinject
 
         faultinject.fire("daemon.upload.serve_piece")
-        self.begin_upload(task_id)
+        self.begin_upload(task_id, requester_tenant)
         ok = False
         try:
             data = self.storage.read_piece_at(task_id, number, offset, max_len)
@@ -238,9 +264,13 @@ class UploadManager:
             ok = True
             return data
         finally:
-            self.end_upload(ok, len(data) if ok else 0, task_id)
+            self.end_upload(ok, len(data) if ok else 0, task_id,
+                            requester_tenant)
 
-    def serve_range(self, task_id: str, start: int, length: int, piece_size: int) -> bytes:
+    def serve_range(
+        self, task_id: str, start: int, length: int, piece_size: int,
+        requester_tenant: Optional[str] = None,
+    ) -> bytes:
         """Byte-range read assembled from SUB-PIECE reads (HTTP Range
         semantics): each overlapping piece contributes only its requested
         span instead of a whole-piece materialize-then-slice."""
@@ -250,7 +280,8 @@ class UploadManager:
         while pos < end:
             num = pos // piece_size
             off = pos - num * piece_size
-            chunk = self.serve_piece_span(task_id, num, off, end - pos)
+            chunk = self.serve_piece_span(task_id, num, off, end - pos,
+                                          requester_tenant)
             if not chunk:
                 break
             out += chunk

@@ -12,6 +12,7 @@ from __future__ import annotations
 import ctypes
 import json
 import os
+import struct
 import subprocess
 import threading
 from typing import Optional
@@ -71,6 +72,26 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ps_serve_stats.argtypes = [
         i64, ctypes.POINTER(i64), ctypes.POINTER(i64)
     ]
+    lib.ps_serve_stats2.restype = i32
+    lib.ps_serve_stats2.argtypes = [
+        i64, ctypes.POINTER(i64), ctypes.POINTER(i64),
+        ctypes.POINTER(i64), ctypes.POINTER(i64)
+    ]
+    lib.ps_leak_stats.restype = i32
+    lib.ps_leak_stats.argtypes = [ctypes.POINTER(i64), ctypes.POINTER(i64)]
+
+    lib.pf_open.restype = i64
+    lib.pf_open.argtypes = [i64, i32, ctypes.c_char_p]
+    lib.pf_parent.restype = i32
+    lib.pf_parent.argtypes = [i64, i32, ctypes.c_char_p, ctypes.c_uint16]
+    lib.pf_submit.restype = i32
+    lib.pf_submit.argtypes = [i64, ctypes.c_char_p, i32, u32, u32]
+    lib.pf_complete.restype = i32
+    lib.pf_complete.argtypes = [i64, p8, i32, i32]
+    lib.pf_pending.restype = i64
+    lib.pf_pending.argtypes = [i64]
+    lib.pf_close.restype = i32
+    lib.pf_close.argtypes = [i64]
 
     f32p = ctypes.POINTER(ctypes.c_float)
     i32p = ctypes.POINTER(ctypes.c_int32)
@@ -161,6 +182,23 @@ def available() -> bool:
 
 def build_error() -> Optional[str]:
     return _build_error
+
+
+def leaked_servers() -> tuple:
+    """(leaked_servers, stuck_conns): process-wide wedged-shutdown counters.
+
+    A ``ps_serve_stop`` that times out past its grace leaks the server
+    struct rather than freeing memory live threads still reference; this
+    surfaces the count so teardowns can ASSERT it stayed zero instead of
+    scraping stderr.  (0, 0) when the library never loaded.
+    """
+    lib = load()
+    if lib is None:
+        return (0, 0)
+    s = ctypes.c_int64(0)
+    c = ctypes.c_int64(0)
+    lib.ps_leak_stats(ctypes.byref(s), ctypes.byref(c))
+    return (int(s.value), int(c.value))
 
 
 # ---------------------------------------------------------------------------
@@ -339,9 +377,93 @@ class NativePieceStore:
         self._lib.ps_serve_stats(self._h, ctypes.byref(p), ctypes.byref(b))
         return int(p.value), int(b.value)
 
+    def serve_stats_full(self) -> dict:
+        """Extended counters: adds the batched-burst piece count and the
+        live connection-thread count (ps_serve_stats2)."""
+        vals = [ctypes.c_int64(0) for _ in range(4)]
+        rc = self._lib.ps_serve_stats2(
+            self._h, *[ctypes.byref(v) for v in vals]
+        )
+        if rc != 0:
+            return {"pieces": 0, "bytes": 0, "batched": 0, "conns": 0}
+        return {
+            "pieces": int(vals[0].value),
+            "bytes": int(vals[1].value),
+            "batched": int(vals[2].value),
+            "conns": int(vals[3].value),
+        }
+
     def close(self) -> None:
         if self._h >= 0:
             self._lib.ps_close(self._h)
+            self._h = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NativePieceFetcher:
+    """The in-engine piece fetch loop (pf_* in native.cpp, DESIGN.md §28).
+
+    Python keeps scheduling ownership — it registers parents into slots,
+    submits (piece, slot) pairs, and drains a bounded completion queue;
+    the engine runs the pooled keep-alive fetch → length check →
+    crc+fsync commit per piece with zero Python per-piece overhead.
+    Every non-zero completion status simply returns the piece to the
+    ordinary Python retry/hedge path (conductor fetch_one is the spec).
+    """
+
+    # Mirrors native.cpp FetchDone: u32 number, i32 status, u32 length,
+    # i32 parent slot, i64 cost_ns.
+    RECORD = "<IiIiq"
+    RECORD_SIZE = 24
+    MAX_DRAIN = 256
+
+    def __init__(self, store: "NativePieceStore", *, workers: int = 4,
+                 tenant: str = ""):
+        lib = load()
+        if lib is None:
+            raise NativeError(f"native library unavailable: {_build_error}")
+        self._lib = lib
+        self._h = lib.pf_open(store._h, workers, tenant.encode())
+        if self._h < 0:
+            raise NativeError(f"pf_open -> {self._h}")
+        self._buf = (ctypes.c_uint8 * (self.RECORD_SIZE * self.MAX_DRAIN))()
+
+    def set_parent(self, slot: int, ip: str, port: int) -> None:
+        rc = self._lib.pf_parent(self._h, slot, ip.encode(), port)
+        if rc != 0:
+            raise NativeError(f"pf_parent({slot}, {ip}:{port}) -> {rc}")
+
+    # dflint: hotpath submit
+    def submit(self, task_id: str, slot: int, number: int,
+               expected_len: int) -> bool:
+        return self._lib.pf_submit(
+            self._h, task_id.encode(), slot, number, expected_len
+        ) == 0
+
+    # dflint: hotpath complete
+    def complete(self, *, timeout_ms: int = 1000) -> list:
+        """Drain completions: [(number, status, length, slot, cost_ns)].
+        Blocks up to timeout_ms for the first record; [] on timeout."""
+        n = self._lib.pf_complete(
+            self._h, self._buf, self.MAX_DRAIN, timeout_ms
+        )
+        if n < 0:
+            raise NativeError(f"pf_complete -> {n}")
+        return list(struct.iter_unpack(
+            self.RECORD, ctypes.string_at(self._buf, n * self.RECORD_SIZE)
+        ))
+
+    def pending(self) -> int:
+        return max(int(self._lib.pf_pending(self._h)), 0)
+
+    def close(self) -> None:
+        if self._h >= 0:
+            self._lib.pf_close(self._h)
             self._h = -1
 
     def __enter__(self):

@@ -19,10 +19,13 @@
 #include <algorithm>
 #include <atomic>
 #include <cerrno>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <ctime>
+#include <deque>
 #include <dirent.h>
 #include <map>
 #include <memory>
@@ -35,6 +38,7 @@
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/types.h>
+#include <sys/uio.h>
 #include <thread>
 #include <unistd.h>
 #include <vector>
@@ -506,6 +510,7 @@ struct HttpServer {
   std::atomic<int> conn_count{0};   // live connection threads
   std::atomic<int64_t> pieces_served{0};
   std::atomic<int64_t> bytes_served{0};
+  std::atomic<int64_t> batched_pieces{0};  // pieces served via burst path
   int limit = 64;
   int64_t store_handle = 0;
   std::thread accept_th;
@@ -516,6 +521,13 @@ struct HttpServer {
 
 std::mutex g_servers_mu;
 std::map<int64_t, HttpServer*> g_servers;  // keyed by store handle
+
+// Wedged-shutdown accounting (ps_serve_stop past the 5 s grace): the
+// struct is intentionally leaked rather than freed under live threads,
+// but the *fact* must be observable — bench/test teardowns assert these
+// stay zero instead of grepping stderr.
+std::atomic<int64_t> g_leaked_servers{0};
+std::atomic<int64_t> g_leaked_conns{0};
 
 // Append more bytes until `acc` holds at least one full request head.
 // Residual bytes from a previous read stay in `acc` — pipelined or
@@ -637,11 +649,170 @@ int dup_data_fd(TaskStore* ts) {
   return dup(fileno(ts->data));
 }
 
+// Gather-write a full iovec array.  sendmsg (not writev) so MSG_NOSIGNAL
+// holds — a peer that hangs up mid-burst must surface as an error, not a
+// process-killing SIGPIPE (native_test runs without Python's handler).
+bool sendv_all(int fd, iovec* iov, size_t n) {
+  size_t i = 0;
+  while (i < n) {
+    msghdr msg{};
+    msg.msg_iov = iov + i;
+    msg.msg_iovlen = std::min(n - i, (size_t)64);
+    ssize_t w = sendmsg(fd, &msg, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    while (i < n && (size_t)w >= iov[i].iov_len) {
+      w -= (ssize_t)iov[i].iov_len;
+      i++;
+    }
+    if (i < n && w > 0) {
+      iov[i].iov_base = (char*)iov[i].iov_base + w;
+      iov[i].iov_len -= (size_t)w;
+    }
+  }
+  return true;
+}
+
+// Batched submission (DESIGN.md §28): a pipelined run of piece GETs
+// already buffered in `acc` is served as ONE gather-write burst —
+// headers and bodies interleaved in a single sendmsg — instead of a
+// head+sendfile syscall pair per piece.  Only the happy path batches:
+// any request that is not a plain keep-alive piece GET, or any piece
+// that is missing/unverified, sends the whole run back to the
+// per-request path so error semantics (404/500/503 ordering) stay
+// byte-identical with the Python server.  Returns the number of
+// requests consumed, 0 when the normal path should take over, -1 on a
+// send failure (caller drops the connection).
+int try_piece_batch(HttpServer* srv, int fd, std::string& acc) {
+  constexpr size_t kBatchMax = 16;
+  struct PieceReq {
+    std::string task;
+    uint32_t number;
+    size_t head_len;
+  };
+  std::vector<PieceReq> reqs;
+  size_t pos = 0;
+  while (reqs.size() < kBatchMax) {
+    size_t head_end = acc.find("\r\n\r\n", pos);
+    if (head_end == std::string::npos) break;
+    size_t head_len = head_end + 4 - pos;
+    size_t line_end = acc.find("\r\n", pos);
+    std::string line = acc.substr(pos, line_end - pos);
+    std::string lower = acc.substr(pos, head_len);
+    for (auto& c : lower) c = (char)tolower(c);
+    if (lower.find("connection: close") != std::string::npos) break;
+    if (line.rfind("GET /pieces/", 0) != 0) break;
+    size_t sp = line.find(' ', 4);
+    if (sp == std::string::npos) break;
+    std::string path = line.substr(4, sp - 4);
+    if (path.find('?') != std::string::npos) break;
+    std::string rest = path.substr(8);
+    size_t slash = rest.find('/');
+    int64_t number = -1;
+    if (slash == std::string::npos ||
+        !parse_i64(rest.substr(slash + 1), &number) ||
+        !valid_task_id(rest.substr(0, slash)))
+      break;
+    reqs.push_back({rest.substr(0, slash), (uint32_t)number, head_len});
+    pos += head_len;
+  }
+  if (reqs.size() < 2) return 0;
+  PieceStore* ps = get_store(srv->store_handle);
+  if (!ps) return 0;
+  // A burst occupies ONE data-plane slot (it is one continuous write on
+  // one connection); over the cap the per-request path owns the 503s.
+  if (srv->active.fetch_add(1) >= srv->limit) {
+    srv->active.fetch_sub(1);
+    return 0;
+  }
+  struct Entry {
+    PieceMeta pm;
+    TaskPtr ts;
+  };
+  std::vector<Entry> entries;
+  for (auto& r : reqs) {
+    TaskPtr ts = open_task(ps, r.task.c_str(), 0, 0, false);
+    PieceMeta pm{};
+    bool found = false;
+    if (ts) {
+      std::lock_guard<std::mutex> lk(ts->mu);
+      auto it = ts->pieces.find(r.number);
+      if (it != ts->pieces.end() && !ts->closed) {
+        pm = it->second;
+        found = true;
+      }
+    }
+    if (!found || !piece_verified(ts.get(), pm)) {
+      srv->active.fetch_sub(1);
+      return 0;
+    }
+    entries.push_back({pm, ts});
+  }
+  int64_t total = 0;
+  for (auto& e : entries) total += e.pm.length;
+  std::vector<uint8_t> scratch((size_t)total);
+  std::vector<std::string> heads(entries.size());
+  size_t off = 0;
+  for (size_t i = 0; i < entries.size(); i++) {
+    int dfd = dup_data_fd(entries[i].ts.get());
+    bool ok = dfd >= 0;
+    if (ok) {
+      int64_t got = 0;
+      while (got < (int64_t)entries[i].pm.length) {
+        ssize_t n = pread(dfd, scratch.data() + off + got,
+                          (size_t)(entries[i].pm.length - got),
+                          (off_t)(entries[i].pm.offset + got));
+        if (n <= 0) {
+          ok = false;
+          break;
+        }
+        got += n;
+      }
+      close(dfd);
+    }
+    if (!ok) {
+      srv->active.fetch_sub(1);
+      return 0;
+    }
+    char h[256];
+    int n = snprintf(h, sizeof(h),
+                     "HTTP/1.1 200 OK\r\n"
+                     "Content-Type: application/octet-stream\r\n"
+                     "Content-Length: %u\r\n\r\n",
+                     entries[i].pm.length);
+    heads[i].assign(h, (size_t)n);
+    off += entries[i].pm.length;
+  }
+  std::vector<iovec> iov(entries.size() * 2);
+  off = 0;
+  for (size_t i = 0; i < entries.size(); i++) {
+    iov[2 * i].iov_base = (void*)heads[i].data();
+    iov[2 * i].iov_len = heads[i].size();
+    iov[2 * i + 1].iov_base = scratch.data() + off;
+    iov[2 * i + 1].iov_len = entries[i].pm.length;
+    off += entries[i].pm.length;
+  }
+  bool sent = sendv_all(fd, iov.data(), iov.size());
+  srv->active.fetch_sub(1);
+  if (!sent) return -1;
+  srv->pieces_served.fetch_add((int64_t)entries.size());
+  srv->bytes_served.fetch_add(total);
+  srv->batched_pieces.fetch_add((int64_t)entries.size());
+  size_t consumed = 0;
+  for (auto& r : reqs) consumed += r.head_len;
+  acc.erase(0, consumed);
+  return (int)entries.size();
+}
+
 void handle_conn(HttpServer* srv, int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   std::string acc;
   while (!srv->stopping.load() && read_request(fd, acc)) {
+    // Batched-submission fast path first: a pipelined run of piece GETs
+    // goes out as one gather-write burst.
+    int batched = try_piece_batch(srv, fd, acc);
+    if (batched < 0) break;
+    if (batched > 0) continue;
     // Consume exactly one request head (GETs carry no body); residual
     // bytes stay in `acc` for the next iteration (pipelining).
     size_t head_end = acc.find("\r\n\r\n");
@@ -971,11 +1142,37 @@ int ps_serve_stop(int64_t handle) {
     usleep(10 * 1000);
   if (srv->conn_count.load() > 0) {
     // A thread is wedged past the 5 s grace: leak the server struct
-    // rather than free memory it still references.
-    fprintf(stderr, "ps_serve_stop: leaking server (stuck connections)\n");
+    // rather than free memory it still references — and COUNT it, so
+    // teardowns can assert the condition never happened (ps_leak_stats)
+    // instead of scraping stderr.
+    g_leaked_servers.fetch_add(1);
+    g_leaked_conns.fetch_add(srv->conn_count.load());
     return 1;
   }
   delete srv;
+  return 0;
+}
+
+// Extended serving counters: adds the batched-burst piece count and the
+// live connection-thread count to ps_serve_stats.
+int ps_serve_stats2(int64_t handle, int64_t* pieces, int64_t* bytes,
+                    int64_t* batched, int64_t* conns) {
+  std::lock_guard<std::mutex> lk(g_servers_mu);
+  auto it = g_servers.find(handle);
+  if (it == g_servers.end()) return -1;
+  *pieces = it->second->pieces_served.load();
+  *bytes = it->second->bytes_served.load();
+  *batched = it->second->batched_pieces.load();
+  *conns = (int64_t)it->second->conn_count.load();
+  return 0;
+}
+
+// Process-wide wedged-shutdown counters (never reset): servers leaked by
+// ps_serve_stop past the stop grace, and the stuck connection threads
+// they held.  Zero on a healthy run — test/bench teardowns assert it.
+int ps_leak_stats(int64_t* servers, int64_t* conns) {
+  *servers = g_leaked_servers.load();
+  *conns = g_leaked_conns.load();
   return 0;
 }
 
@@ -987,10 +1184,7 @@ int ps_close(int64_t handle) {
   if (ps_serve_stop(handle) == 1) {  // no-op (-1) when no server attached
     std::lock_guard<std::mutex> lk(g_stores_mu);
     auto it = g_stores.find(handle);
-    if (it != g_stores.end()) {
-      fprintf(stderr, "ps_close: leaking store (stuck connections)\n");
-      g_stores.erase(it);
-    }
+    if (it != g_stores.end()) g_stores.erase(it);  // counted via ps_leak_stats
     return -2;
   }
   PieceStore* ps;
@@ -1016,6 +1210,360 @@ int ps_close(int64_t handle) {
     ps->tasks.clear();
   }
   delete ps;
+  return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// In-engine piece fetch loop (pf_*): the CLIENT half of the native data
+// plane (DESIGN.md §28).  The Python per-piece loop (conductor fetch_one →
+// HTTPPieceFetcher → CommitPipeline) is the semantic spec and stays as the
+// byte-identical fallback arm; this engine drains a piece *window* with
+// zero Python per-piece overhead:
+//
+//   worker thread:  pooled keep-alive socket per parent slot → pipelined
+//   GET burst (up to 8 pieces; triggers the server's batched-submission
+//   path) → length-check → ps_write_piece (crc + fsync-ordered commit,
+//   the same durability contract as every other write) → completion.
+//
+// Python keeps scheduling OWNERSHIP: it picks parents (slots), submits
+// pieces, and drains a bounded completion queue — any non-zero status
+// simply puts the piece back into the ordinary Python retry/hedge path.
+// Completion records are fixed 24-byte structs so the ctypes drain is one
+// memcpy + struct.iter_unpack, not a per-field FFI round-trip.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct FetchJob {
+  std::string task;
+  uint32_t number = 0;
+  int32_t slot = 0;
+  uint32_t expected_len = 0;
+};
+
+#pragma pack(push, 1)
+struct FetchDone {        // 24 bytes; mirrored by NativePieceFetcher.RECORD
+  uint32_t number;
+  int32_t status;         // 0 ok; >0 HTTP status; -1 conn; -2 proto/len; -3 commit
+  uint32_t length;
+  int32_t slot;
+  int64_t cost_ns;
+};
+#pragma pack(pop)
+
+struct PieceFetcher {
+  int64_t store_handle = 0;
+  std::string tenant;
+  std::mutex mu;
+  std::condition_variable cv_jobs, cv_done;
+  std::deque<FetchJob> jobs;
+  std::deque<FetchDone> done;
+  std::vector<std::pair<std::string, uint16_t>> parents;  // slot-indexed
+  bool closing = false;
+  std::vector<std::thread> workers;
+};
+
+std::mutex g_fetchers_mu;
+std::map<int64_t, PieceFetcher*> g_fetchers;
+
+PieceFetcher* get_fetcher(int64_t handle) {
+  std::lock_guard<std::mutex> lk(g_fetchers_mu);
+  auto it = g_fetchers.find(handle);
+  return it == g_fetchers.end() ? nullptr : it->second;
+}
+
+int64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int connect_parent(const std::string& ip, uint16_t port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, ip.c_str(), &addr.sin_addr) != 1 ||
+      connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // A wedged parent must park a worker for at most the recv timeout —
+  // Python owns rescheduling, it just needs the error completion.
+  timeval tv{30, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  return fd;
+}
+
+// One HTTP response (head + Content-Length body) off a keep-alive client
+// socket.  Residual bytes persist in `acc` across calls so pipelined
+// responses are never dropped.  Returns the HTTP status with the body in
+// *body, or <0 on socket/protocol error.
+int read_response(int fd, std::string& acc, std::string* body) {
+  char buf[65536];
+  size_t head_end;
+  while ((head_end = acc.find("\r\n\r\n")) == std::string::npos) {
+    if (acc.size() > 65536) return -2;
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n <= 0) return -1;
+    acc.append(buf, (size_t)n);
+  }
+  std::string head = acc.substr(0, head_end + 4);
+  acc.erase(0, head_end + 4);
+  if (head.rfind("HTTP/1.", 0) != 0 || head.size() < 12) return -2;
+  int status = atoi(head.c_str() + 9);
+  if (status < 100) return -2;
+  std::string lower = head;
+  for (auto& c : lower) c = (char)tolower(c);
+  size_t p = lower.find("content-length:");
+  int64_t clen = -1;
+  if (p != std::string::npos) {
+    size_t e = lower.find("\r\n", p);
+    std::string v = head.substr(p + 15, e - p - 15);
+    while (!v.empty() && v.front() == ' ') v.erase(0, 1);
+    if (!parse_i64(v, &clen)) return -2;
+  }
+  if (clen < 0) return -2;
+  // Bulk path: splice whatever body bytes already rode in with the head,
+  // then recv the remainder straight into the body buffer — one copy per
+  // byte instead of append+assign, and length-capped reads never overshoot
+  // into the next pipelined response (overshoot stays in the socket).
+  size_t have = acc.size() > (size_t)clen ? (size_t)clen : acc.size();
+  body->resize((size_t)clen);
+  if (have) memcpy(&(*body)[0], acc.data(), have);
+  acc.erase(0, have);
+  size_t got = have;
+  while ((int64_t)got < clen) {
+    ssize_t n = recv(fd, &(*body)[got], (size_t)clen - got, 0);
+    if (n <= 0) return -1;
+    got += (size_t)n;
+  }
+  return status;
+}
+
+void fetch_worker(PieceFetcher* pf) {
+  // Worker-local keep-alive sockets, one per parent slot — the pooled
+  // reuse that makes a piece fetch cost ~one syscall pair, plus the
+  // residual-byte accumulator that makes pipelining safe.
+  std::map<int32_t, int> socks;
+  std::map<int32_t, std::string> residual;
+  for (;;) {
+    std::vector<FetchJob> burst;
+    {
+      std::unique_lock<std::mutex> lk(pf->mu);
+      pf->cv_jobs.wait(lk, [&] { return pf->closing || !pf->jobs.empty(); });
+      if (pf->jobs.empty()) break;  // closing, queue drained
+      burst.push_back(std::move(pf->jobs.front()));
+      pf->jobs.pop_front();
+      // Opportunistic pipelining: pull queued jobs bound for the SAME
+      // parent+task into one request burst (up to 8) — back-to-back GETs
+      // on one socket are what trigger the server's batched submission.
+      // Byte-capped: a burst serializes its responses on ONE connection,
+      // so big pieces must spread across workers instead (an 8 x 4 MiB
+      // burst on one socket idles the other workers and LOSES to the
+      // parallel Python arm); unknown-size pieces never pipeline.
+      size_t burst_bytes = burst[0].expected_len;
+      for (auto it = pf->jobs.begin();
+           it != pf->jobs.end() && burst.size() < 8 &&
+           burst[0].expected_len > 0 && burst_bytes < 512 * 1024;) {
+        if (it->slot == burst[0].slot && it->task == burst[0].task &&
+            it->expected_len > 0 &&
+            burst_bytes + it->expected_len <= 512 * 1024) {
+          burst_bytes += it->expected_len;
+          burst.push_back(std::move(*it));
+          it = pf->jobs.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
+    int32_t slot = burst[0].slot;
+    std::string ip;
+    uint16_t port = 0;
+    {
+      std::lock_guard<std::mutex> lk(pf->mu);
+      if (slot >= 0 && (size_t)slot < pf->parents.size()) {
+        ip = pf->parents[slot].first;
+        port = pf->parents[slot].second;
+      }
+    }
+    int64_t t0 = now_ns();
+    auto fail_all = [&](size_t from, int32_t status) {
+      std::lock_guard<std::mutex> lk(pf->mu);
+      for (size_t i = from; i < burst.size(); i++)
+        pf->done.push_back(
+            {burst[i].number, status, 0, slot, now_ns() - t0});
+    };
+    if (ip.empty() || port == 0) {
+      fail_all(0, -1);
+      pf->cv_done.notify_all();
+      continue;
+    }
+    // Send the whole burst; one reconnect retry covers a parent having
+    // dropped the idle pooled socket between windows (same shape as the
+    // Python pool's retry_call(attempts=2)).
+    bool sent = false;
+    for (int attempt = 0; attempt < 2 && !sent; attempt++) {
+      auto it = socks.find(slot);
+      if (it == socks.end() || it->second < 0) {
+        int nfd = connect_parent(ip, port);
+        socks[slot] = nfd;
+        residual[slot].clear();
+        if (nfd < 0) break;
+      }
+      std::string reqs;
+      for (auto& b : burst) {
+        char req[512];
+        int n = snprintf(req, sizeof(req),
+                         "GET /pieces/%s/%u HTTP/1.1\r\n"
+                         "Host: %s:%u\r\n"
+                         "X-Dragonfly-Tenant: %s\r\n\r\n",
+                         b.task.c_str(), b.number, ip.c_str(), (unsigned)port,
+                         pf->tenant.c_str());
+        reqs.append(req, (size_t)n);
+      }
+      if (send_all(socks[slot], reqs.data(), reqs.size())) {
+        sent = true;
+      } else {
+        close(socks[slot]);
+        socks[slot] = -1;
+      }
+    }
+    if (!sent) {
+      fail_all(0, -1);
+      pf->cv_done.notify_all();
+      continue;
+    }
+    // Read responses in order; commit each good body through the same
+    // crc+fsync write path every other commit uses.
+    for (size_t i = 0; i < burst.size(); i++) {
+      std::string body;
+      int status = read_response(socks[slot], residual[slot], &body);
+      if (status < 0) {
+        close(socks[slot]);
+        socks[slot] = -1;
+        fail_all(i, status);
+        break;
+      }
+      FetchDone d{burst[i].number, 0, 0, slot, 0};
+      if (status != 200) {
+        d.status = status;
+      } else if (burst[i].expected_len > 0 &&
+                 body.size() != burst[i].expected_len) {
+        d.status = -2;
+      } else {
+        int64_t wrote = ps_write_piece(
+            pf->store_handle, burst[i].task.c_str(), burst[i].number,
+            (const uint8_t*)body.data(), (uint32_t)body.size());
+        d.status = wrote < 0 ? -3 : 0;
+        d.length = (uint32_t)body.size();
+      }
+      d.cost_ns = now_ns() - t0;
+      std::lock_guard<std::mutex> lk(pf->mu);
+      pf->done.push_back(d);
+    }
+    pf->cv_done.notify_all();
+  }
+  for (auto& kv : socks)
+    if (kv.second >= 0) close(kv.second);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open a fetch engine bound to a local piece store.  `workers` threads
+// drain the submit queue; `tenant` rides every request as the
+// X-Dragonfly-Tenant header (requester-pays upload accounting, §26/§28).
+int64_t pf_open(int64_t store_handle, int workers, const char* tenant) {
+  if (!get_store(store_handle)) return -1;
+  if (workers <= 0) workers = 4;
+  if (workers > 64) workers = 64;
+  PieceFetcher* pf = new PieceFetcher();
+  pf->store_handle = store_handle;
+  pf->tenant = tenant ? tenant : "";
+  for (int i = 0; i < workers; i++) pf->workers.emplace_back(fetch_worker, pf);
+  std::lock_guard<std::mutex> lk(g_fetchers_mu);
+  int64_t h = g_next_handle++;
+  g_fetchers[h] = pf;
+  return h;
+}
+
+// Register/replace the parent endpoint behind `slot` (Python owns parent
+// selection; slots keep the per-piece submit free of string churn).
+int pf_parent(int64_t fh, int slot, const char* ip, uint16_t port) {
+  PieceFetcher* pf = get_fetcher(fh);
+  if (!pf || slot < 0 || slot > 255 || !ip) return -1;
+  std::lock_guard<std::mutex> lk(pf->mu);
+  if ((size_t)slot >= pf->parents.size()) pf->parents.resize((size_t)slot + 1);
+  pf->parents[(size_t)slot] = {ip, port};
+  return 0;
+}
+
+int pf_submit(int64_t fh, const char* task_id, int slot, uint32_t number,
+              uint32_t expected_len) {
+  PieceFetcher* pf = get_fetcher(fh);
+  if (!pf || !task_id) return -1;
+  {
+    std::lock_guard<std::mutex> lk(pf->mu);
+    if (pf->closing) return -2;
+    pf->jobs.push_back({task_id, number, slot, expected_len});
+  }
+  pf->cv_jobs.notify_one();
+  return 0;
+}
+
+// Drain up to `max_records` completions into `out` (packed FetchDone
+// records).  Blocks up to timeout_ms for the first one; 0 on timeout.
+int pf_complete(int64_t fh, uint8_t* out, int max_records, int timeout_ms) {
+  PieceFetcher* pf = get_fetcher(fh);
+  if (!pf || !out || max_records <= 0) return -1;
+  std::unique_lock<std::mutex> lk(pf->mu);
+  if (!pf->cv_done.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                            [&] { return !pf->done.empty(); }))
+    return 0;
+  int n = 0;
+  while (n < max_records && !pf->done.empty()) {
+    memcpy(out + (size_t)n * sizeof(FetchDone), &pf->done.front(),
+           sizeof(FetchDone));
+    pf->done.pop_front();
+    n++;
+  }
+  return n;
+}
+
+// Jobs not yet completed (queued + in flight is Python's submitted-minus-
+// drained count; this exposes just the queue for diagnostics).
+int64_t pf_pending(int64_t fh) {
+  PieceFetcher* pf = get_fetcher(fh);
+  if (!pf) return -1;
+  std::lock_guard<std::mutex> lk(pf->mu);
+  return (int64_t)pf->jobs.size();
+}
+
+// Drain the queue (workers finish in-flight jobs), join workers, free.
+int pf_close(int64_t fh) {
+  PieceFetcher* pf;
+  {
+    std::lock_guard<std::mutex> lk(g_fetchers_mu);
+    auto it = g_fetchers.find(fh);
+    if (it == g_fetchers.end()) return -1;
+    pf = it->second;
+    g_fetchers.erase(it);
+  }
+  {
+    std::lock_guard<std::mutex> lk(pf->mu);
+    pf->closing = true;
+  }
+  pf->cv_jobs.notify_all();
+  for (auto& t : pf->workers)
+    if (t.joinable()) t.join();
+  delete pf;
   return 0;
 }
 

@@ -232,6 +232,86 @@ int main() {
     blocked.join();
   }
 
+  // 5. In-engine client fetch loop (pf_*, DESIGN.md §28) against the
+  //    in-engine server: pipelined bursts must trigger the server's
+  //    batched submission, commits must be byte-exact in the client
+  //    store, error completions must carry the right status, and the
+  //    process-wide leak counters must stay zero.
+  {
+    char src_tmpl[] = "/tmp/native_test_src_XXXXXX";
+    char dst_tmpl[] = "/tmp/native_test_dst_XXXXXX";
+    int64_t src = ps_open(mkdtemp(src_tmpl));
+    int64_t dst = ps_open(mkdtemp(dst_tmpl));
+    assert(src > 0 && dst > 0);
+    const uint32_t kSmall = 16 * 1024;
+    const uint32_t kN = 32;
+    assert(ps_create_task(src, "pf-task", kSmall, kN * kSmall) == 0);
+    for (uint32_t n = 0; n < kN; n++) {
+      auto data = piece_bytes(5, n, kSmall);
+      assert(ps_write_piece(src, "pf-task", n, data.data(), kSmall) ==
+             (int64_t)kSmall);
+    }
+    int64_t port = ps_serve(src, "127.0.0.1", 0, 64);
+    assert(port > 0);
+    assert(ps_create_task(dst, "pf-task", kSmall, kN * kSmall) == 0);
+
+    // One worker keeps the burst assembly deterministic: 32 queued
+    // 16 KiB jobs form 8-deep bursts under the 512 KiB byte cap, and
+    // each burst lands at the server as back-to-back GETs -> writev.
+    int64_t fh = pf_open(dst, 1, "tenant-test");
+    assert(fh > 0);
+    assert(pf_parent(fh, 0, "127.0.0.1", (uint16_t)port) == 0);
+    assert(pf_parent(fh, 1, "127.0.0.1", 1) == 0);  // dead parent slot
+    for (uint32_t n = 0; n < kN; n++)
+      assert(pf_submit(fh, "pf-task", 0, n, kSmall) == 0);
+    assert(pf_submit(fh, "ghost", 0, 0, 0) == 0);          // server 404
+    assert(pf_submit(fh, "pf-task", 0, 3, kSmall - 1) == 0);  // len mismatch
+    assert(pf_submit(fh, "pf-task", 1, 0, kSmall) == 0);   // conn refused
+    int ok = 0, st404 = 0, stlen = 0, stconn = 0, drained = 0;
+    FetchDone recs[64];
+    for (int spin = 0; spin < 200 && drained < (int)kN + 3; spin++) {
+      int n = pf_complete(fh, (uint8_t*)recs, 64, 100);
+      assert(n >= 0);
+      for (int i = 0; i < n; i++) {
+        drained++;
+        if (recs[i].status == 0) {
+          assert(recs[i].length == kSmall && recs[i].slot == 0);
+          assert(recs[i].cost_ns > 0);
+          ok++;
+        } else if (recs[i].status == 404) {
+          st404++;
+        } else if (recs[i].status == -2) {
+          stlen++;
+        } else if (recs[i].status == -1) {
+          assert(recs[i].slot == 1);
+          stconn++;
+        }
+      }
+    }
+    assert(drained == (int)kN + 3);
+    assert(ok == (int)kN && st404 == 1 && stlen == 1 && stconn == 1);
+    assert(pf_pending(fh) == 0);
+    std::vector<uint8_t> buf(kSmall);
+    for (uint32_t n = 0; n < kN; n++) {
+      assert(ps_read_piece(dst, "pf-task", n, buf.data(), kSmall, 1) ==
+             (int64_t)kSmall);
+      auto want = piece_bytes(5, n, kSmall);
+      assert(memcmp(buf.data(), want.data(), kSmall) == 0);
+    }
+    int64_t pieces = 0, bytes = 0, batched = 0, conns = 0;
+    assert(ps_serve_stats2(src, &pieces, &bytes, &batched, &conns) == 0);
+    assert(pieces >= (int64_t)kN);
+    assert(batched > 0);  // the §28 coalesced-writev evidence
+    assert(pf_close(fh) == 0);
+    assert(pf_submit(fh, "pf-task", 0, 0, kSmall) == -1);  // handle gone
+    assert(ps_serve_stop(src) == 0);
+    assert(ps_close(src) == 0);
+    assert(ps_close(dst) == 0);
+    int64_t leaked_servers = 0, leaked_conns = 0;
+    assert(ps_leak_stats(&leaked_servers, &leaked_conns) == 0);
+    assert(leaked_servers == 0 && leaked_conns == 0);
+  }
+
   printf("native_test: OK\n");
   return 0;
 }

@@ -214,5 +214,10 @@ DETERMINISM_CONTRACTS = {
             "qual": "main",
             "format": "json",
         },
+        "bench_download.json": {
+            "file": "tools/bench_download.py",
+            "qual": "main",
+            "format": "json",
+        },
     },
 }

@@ -133,6 +133,10 @@ class PieceHTTPServer:
                 split = _parse.urlsplit(self.path)
                 parts = split.path.strip("/").split("/")
                 streaming = False
+                # Requester-pays accounting (§26/§28): the fetching peer
+                # stamps its tenant on the wire; the upload gate charges
+                # THAT tenant's byte bucket, not the task owner's.
+                req_tenant = self.headers.get("X-Dragonfly-Tenant") or None
                 try:
                     if len(parts) == 3 and parts[0] == "pieces":
                         from ..utils import faultinject
@@ -141,7 +145,7 @@ class PieceHTTPServer:
                         if sendfile_ok:
                             span = upload_ref.piece_sendfile_span(task_id, number)
                             if span is not None:
-                                upload_ref.begin_upload(task_id)
+                                upload_ref.begin_upload(task_id, req_tenant)
                                 ok = False
                                 try:
                                     streaming = True
@@ -149,10 +153,12 @@ class PieceHTTPServer:
                                     ok = True
                                 finally:
                                     upload_ref.end_upload(
-                                        ok, span[2] if ok else 0, task_id
+                                        ok, span[2] if ok else 0, task_id,
+                                        req_tenant,
                                     )
                                 return
-                        data = upload_ref.serve_piece(task_id, number)
+                        data = upload_ref.serve_piece(task_id, number,
+                                                      req_tenant)
                         # Torn-body seam: a truncate fault serves a SHORT
                         # 200 — the client's length check must catch it.
                         data = faultinject.fire("piece.server.body", data)
@@ -218,7 +224,7 @@ class PieceHTTPServer:
                                 task_id, start, end - start + 1
                             )
                             if span is not None:
-                                upload_ref.begin_upload(task_id)
+                                upload_ref.begin_upload(task_id, req_tenant)
                                 ok = False
                                 try:
                                     streaming = True
@@ -226,12 +232,14 @@ class PieceHTTPServer:
                                     ok = True
                                 finally:
                                     upload_ref.end_upload(
-                                        ok, span[2] if ok else 0, task_id
+                                        ok, span[2] if ok else 0, task_id,
+                                        req_tenant,
                                     )
                                 return
                         piece_size = upload_ref.storage.engine.piece_size(task_id)
                         data = upload_ref.serve_range(
-                            task_id, start, end - start + 1, piece_size
+                            task_id, start, end - start + 1, piece_size,
+                            req_tenant,
                         )
                         self._send(206, data)
                         return
@@ -324,6 +332,12 @@ class NativePieceServer:
     @property
     def bytes_served(self) -> int:
         return self._engine.serve_stats()[1]
+
+    @property
+    def batched_pieces(self) -> int:
+        """Pieces served through a coalesced writev burst (§28 batched
+        submission) — the bench's both-ends-amortized evidence."""
+        return self._engine.serve_stats_full()["batched"]
 
     def serve(self) -> None:  # already serving — interface parity
         pass
@@ -498,9 +512,14 @@ class HTTPPieceFetcher:
         breaker_threshold: int = 6,
         breaker_reset_s: float = 2.0,
         pooled: bool = True,
+        tenant: str = "",
     ):
         self._resolve = resolve
         self.timeout = timeout
+        # Requester-pays QoS (§26/§28): this daemon's tenant rides every
+        # piece GET as X-Dragonfly-Tenant so the serving peer charges the
+        # REQUESTER's upload bucket, not the task owner's.
+        self.tenant = tenant or ""
         # Per-parent circuit breakers: a dead parent's piece port fails
         # fast after `breaker_threshold` consecutive connect failures
         # instead of burning a connect timeout per piece — the conductor
@@ -611,7 +630,10 @@ class HTTPPieceFetcher:
             reusable = False
             try:
                 try:
-                    conn.request("GET", path)
+                    conn.request("GET", path, headers=(
+                        {"X-Dragonfly-Tenant": self.tenant}
+                        if self.tenant else {}
+                    ))
                     resp = conn.getresponse()
                     body = self._read_body(resp)
                 except (http.client.HTTPException, OSError) as exc:
@@ -642,9 +664,12 @@ class HTTPPieceFetcher:
             from ..utils import faultinject
 
             faultinject.fire("piece.fetch")
+            req = urllib.request.Request(url, headers=(
+                {"X-Dragonfly-Tenant": self.tenant} if self.tenant else {}
+            ))
             try:
                 with urllib.request.urlopen(
-                    url, timeout=self.timeout, context=self.ssl_context
+                    req, timeout=self.timeout, context=self.ssl_context
                 ) as resp:
                     return faultinject.fire("piece.fetch.body", resp.read())
             except urllib.error.HTTPError as exc:
@@ -659,6 +684,19 @@ class HTTPPieceFetcher:
 
     def close(self) -> None:
         self.pool.close()
+
+    def native_endpoint(self, parent_host_id: str):
+        """(ip, port) the in-engine fetch loop (native.pf_*) can dial for
+        this parent, or None when the transport cannot be represented
+        natively — TLS deployments (the engine speaks plain HTTP) and
+        unresolvable parents stay on the Python path (§28 fallback
+        matrix)."""
+        if self.ssl_context is not None:
+            return None
+        try:
+            return self._resolve(parent_host_id)
+        except KeyError:
+            return None
 
     def piece_bitmap(self, parent_host_id: str, task_id: str):
         """Which pieces the parent holds (None when unknown/unreachable)."""

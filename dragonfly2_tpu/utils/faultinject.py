@@ -239,6 +239,23 @@ def truncates(site: str) -> bool:
     )
 
 
+def targets(*sites: str) -> bool:
+    """True when the installed scenario carries ANY rule that could match
+    one of ``sites``.  The native fetch dispatch consults this: the
+    in-engine loop cannot fire Python seams per piece, so a scenario
+    aimed at the piece plane (``piece.fetch``, ``piece.fetch.body``,
+    ``daemon.stream.tee``, ...) forces the byte-identical Python arm,
+    keeping every chaos drill's faults biting (DESIGN.md §28)."""
+    inj = _active
+    if inj is None:
+        return False
+    return any(
+        fnmatch.fnmatchcase(site, spec.site)
+        for spec in inj.specs
+        for site in sites
+    )
+
+
 class installed:
     """``with installed(injector): ...`` — scoped installation for tests."""
 

@@ -44,6 +44,10 @@ def served(tmp_path):
     yield {"server": server, "task": task, "pieces": pieces, "storage": storage}
     server.stop()
     storage.close()
+    # A wedged shutdown used to be a stderr print nobody read; now it is
+    # a process-global counter (ps_leak_stats) this teardown turns into
+    # a hard failure.
+    assert native.leaked_servers() == (0, 0)
 
 
 class TestNativePieceServer:

@@ -315,6 +315,76 @@ class TestUploadQoS:
         # The gate never claimed a slot on the injected refusal.
         assert um.active == 0
 
+    def test_requester_pays_charges_requester_not_owner(self, tmp_path):
+        """Requester-pays (§28 fix): a piece pull carrying a requester
+        tenant charges THAT tenant's bucket — the task owner's bucket
+        stays untouched, so a cross-tenant flood cannot starve the
+        owner's own budget."""
+        from dragonfly2_tpu.daemon.upload import UploadThrottled
+
+        policy = QoSPolicy.from_payload({
+            "t-owner": {"tenant_class": "background",
+                        "upload_rate_bytes_s": 2048.0},
+        })
+        um = self._um(tmp_path, policy)
+        um.register_task_tenant("t", "t-owner")
+        # A flood of requester-tagged pulls well past the owner's cap.
+        for n in range(16):
+            assert um.serve_piece(
+                "t", n % 4, requester_tenant="t-req"
+            ) == bytes(1024)
+        assert um.tenant_bytes["t-req"] == 16 * 1024
+        assert um.tenant_bytes.get("t-owner", 0) == 0
+        # The owner's untagged pull still has its full budget; pre-fix
+        # the flood above drained it and this raised UploadThrottled.
+        assert um.serve_piece("t", 0) == bytes(1024)
+        assert um.tenant_bytes["t-owner"] == 1024
+        # And the requester's class throttles the requester, not the
+        # owner, when ITS OWN bucket runs dry.
+        policy2 = QoSPolicy.from_payload({
+            "t-cheap": {"tenant_class": "background",
+                        "upload_rate_bytes_s": 2048.0},
+        })
+        um2 = self._um(tmp_path / "2", policy2)
+        um2.register_task_tenant("t", "t-free")
+        with pytest.raises(UploadThrottled):
+            for n in range(8):
+                um2.serve_piece("t", n % 4, requester_tenant="t-cheap")
+        assert um2.tenant_bytes.get("t-free", 0) == 0
+
+    def test_requester_pays_rides_the_wire_header(self, tmp_path):
+        """X-Dragonfly-Tenant on a piece GET reaches begin/end_upload:
+        the serving peer's accounting lands on the requester over both
+        transports (piece GET and Range GET)."""
+        import urllib.request
+
+        from dragonfly2_tpu.rpc.piece_transport import (
+            HTTPPieceFetcher,
+            PieceHTTPServer,
+        )
+
+        um = self._um(tmp_path, QoSPolicy())
+        um.register_task_tenant("t", "t-owner")
+        server = PieceHTTPServer(um)
+        server.serve()
+        try:
+            fetcher = HTTPPieceFetcher(
+                lambda hid: ("127.0.0.1", server.port), tenant="t-req"
+            )
+            assert fetcher.fetch("h", "t", 0) == bytes(1024)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/tasks/t",
+                headers={"Range": "bytes=0-511",
+                         "X-Dragonfly-Tenant": "t-req"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 206 and len(resp.read()) == 512
+            assert um.tenant_bytes["t-req"] == 1024 + 512
+            assert um.tenant_bytes.get("t-owner", 0) == 0
+        finally:
+            fetcher.close()
+            server.stop()
+
 
 # ---------------------------------------------------------------------------
 # weighted-fair DRR drain (satellite property tests)

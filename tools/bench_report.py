@@ -294,7 +294,9 @@ def render_download(rounds: List[dict]) -> str:
     BENCH_DL round: engine, single/swarm MB/s, speedups, the ISSUE-14
     pass-through stream arms with their zero-disk-read evidence, and
     p50/p99 piece latency).  Pre-stream rounds (r01) render ``—`` in the
-    stream cells."""
+    stream cells; pre-§28 rounds render ``—`` in the per-core/native
+    cells (``MB/s/core`` = the guarded per-core headline; ``native×`` =
+    the in-engine client arm's single-peer per-core ratio)."""
     lines = [
         DOWNLOAD_BEGIN,
         "Generated by `python -m tools.bench_report --update` from the",
@@ -302,17 +304,18 @@ def render_download(rounds: List[dict]) -> str:
         "by hand; tier-1 (`tests/test_bench_report.py`) fails if stale.",
         "",
         "| round | status | engine | single MB/s (legacy → pipelined) | "
-        "speedup | swarm MB/s | speedup | stream MB/s (disk → tee) | "
+        "speedup | MB/s/core | native× | swarm MB/s | speedup | "
+        "stream MB/s (disk → tee) | "
         "stream× | tee disk reads | piece p50/p99 ms | note |",
         "| --- | --- | --- | --- | --- | --- | --- | --- | --- | --- | "
-        "--- | --- |",
+        "--- | --- | --- | --- |",
     ]
     for data in rounds:
         arms = data.get("arms") or {}
         if not data.get("ok") or not arms:
             lines.append(
                 f"| r{data['round']:02d} | error | — | — | — | — | — | — | "
-                f"— | — | — | {str(data.get('error', ''))[:80]} |"
+                f"— | — | — | — | — | {str(data.get('error', ''))[:80]} |"
             )
             continue
         status = (
@@ -338,11 +341,16 @@ def render_download(rounds: List[dict]) -> str:
             )
         else:
             stream_cell = stream_x = reads_cell = "—"
+        per_core = single.get("MBps_per_core")
+        per_core_cell = "—" if per_core is None else f"{per_core:.0f}"
+        native_x = (data.get("native") or {}).get("speedup_native_single")
+        native_cell = "—" if native_x is None else f"{native_x:.2f}×"
         lines.append(
             f"| r{data['round']:02d} | {status} "
             f"| {engine} "
             f"| {legacy.get('MBps', 0):.0f} → {single.get('MBps', 0):.0f} "
             f"| {data.get('speedup_single', 0):.2f}× "
+            f"| {per_core_cell} | {native_cell} "
             f"| {legacy_swarm.get('MBps', 0):.0f} → {swarm.get('MBps', 0):.0f} "
             f"| {data.get('speedup_swarm', 0):.2f}× "
             f"| {stream_cell} | {stream_x} | {reads_cell} "

@@ -80,6 +80,12 @@ REQUIRED_SEAMS = {
         # (where the mid-tee SIGKILL drill crashes).
         "daemon.stream.tee", "daemon.stream.spill",
     ),
+    "dragonfly2_tpu/daemon/conductor.py": (
+        # In-engine fetch dispatch (DESIGN.md §28): a raising fault here
+        # forces the byte-identical Python arm; the crash kind is the
+        # mid-native-window SIGKILL drill's deterministic kill point.
+        "daemon.piece.native_fetch",
+    ),
     "dragonfly2_tpu/trainer/online_graph.py": ("trainer.dispatch",),
     "dragonfly2_tpu/rpc/grpc_transport.py": (
         "grpc.client.*", "grpc.manager.*",

@@ -84,6 +84,14 @@ REQUIRED_HOTPATH = {
         "CommitTee.publish",
         "TeeConsumer.take",
     ),
+    # In-engine fetch loop bindings (DESIGN.md §28): the submit/complete
+    # wrappers ride once per piece / once per drain on the conductor's
+    # window — batch record decode lives in struct.iter_unpack, never a
+    # per-record Python loop.
+    "dragonfly2_tpu/native/__init__.py": (
+        "NativePieceFetcher.submit",
+        "NativePieceFetcher.complete",
+    ),
 }
 
 
