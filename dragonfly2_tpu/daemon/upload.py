@@ -54,6 +54,13 @@ UPLOAD_TENANT_BYTES_TOTAL = _reg.counter(
 
 _DEFAULT_TENANT = "default"
 
+# Hard bound on tenant-keyed accounting state (buckets + byte totals).
+# Requester attribution is already gated on KNOWN tenants, so this only
+# bites if a runaway registrar stamps thousands of distinct owners —
+# overflow folds into the default bucket instead of growing without
+# limit (the DF017 discipline applied to memory, not just labels).
+_MAX_TRACKED_TENANTS = 4096
+
 
 class UploadBusy(RuntimeError):
     pass
@@ -104,6 +111,7 @@ class UploadManager:
         # served-byte totals (raw ids live HERE, never on metric labels).
         self._policy = qos_policy
         self._task_tenant: Dict[str, str] = {}
+        self._registered_tenants: set = set()
         self._tenant_bw: Dict[str, _TenantBandwidth] = {}
         self.tenant_bytes: Dict[str, int] = {}
 
@@ -121,9 +129,12 @@ class UploadManager:
 
     def register_task_tenant(self, task_id: str, tenant: str) -> None:
         """Stamp the tenant that created ``task_id`` — serves of the
-        task's pieces account (and throttle) against it."""
+        task's pieces account (and throttle) against it.  Registration
+        also marks the tenant as KNOWN, so its wire-stamped requests on
+        other tenants' tasks are honored by requester-pays."""
         with self._mu:
             self._task_tenant[task_id] = tenant or _DEFAULT_TENANT
+            self._registered_tenants.add(tenant or _DEFAULT_TENANT)
 
     def tenant_of(self, task_id: Optional[str]) -> str:
         with self._mu:
@@ -144,17 +155,49 @@ class UploadManager:
 
     # -- shared accounting gate (both serve shapes) --------------------------
 
+    def _known_tenant_locked(self, tenant: str) -> bool:
+        """A tenant this daemon can vouch for: a QoS-policy row or a
+        locally registered task owner."""
+        if tenant in self._registered_tenants:
+            return True
+        policy = self._policy
+        return policy is not None and tenant in policy
+
+    def _tracked_tenant_locked(self, tenant: str) -> str:
+        """Accounting key for ``tenant``, folding overflow into the
+        default bucket once the per-tenant maps hit their bound."""
+        if (
+            tenant == _DEFAULT_TENANT
+            or tenant in self.tenant_bytes
+            or tenant in self._tenant_bw
+        ):
+            return tenant
+        if (
+            len(self.tenant_bytes) >= _MAX_TRACKED_TENANTS
+            or len(self._tenant_bw) >= _MAX_TRACKED_TENANTS
+        ):
+            return _DEFAULT_TENANT
+        return tenant
+
     def _charged_tenant_locked(
         self, task_id: Optional[str], requester_tenant: Optional[str]
     ) -> str:
         """Who pays for this serve: the REQUESTING tenant when the wire
-        carried one (X-Dragonfly-Tenant), else the task's owner.  Before
-        requester attribution existed, a stranger's cross-tenant pulls
-        drained the owner's byte bucket — the victim got throttled for
-        traffic it never asked for (DESIGN.md §28)."""
-        if requester_tenant:
-            return requester_tenant
-        return self._task_tenant.get(task_id or "", _DEFAULT_TENANT)
+        carried one (X-Dragonfly-Tenant) AND it names a tenant this
+        daemon already knows — a QoS-policy row or a registered task
+        owner — else the task's owner.  Before requester attribution
+        existed, a stranger's cross-tenant pulls drained the owner's
+        byte bucket (DESIGN.md §28); but the header is UNAUTHENTICATED,
+        so an unknown name is treated as absent: honoring it verbatim
+        would let any client spoof a victim tenant's bucket into debt
+        (the very attack requester-pays fixes, now remotely steerable)
+        or rotate fabricated names into fresh default-class buckets
+        past their real cap."""
+        if requester_tenant and self._known_tenant_locked(requester_tenant):
+            return self._tracked_tenant_locked(requester_tenant)
+        return self._tracked_tenant_locked(
+            self._task_tenant.get(task_id or "", _DEFAULT_TENANT)
+        )
 
     def begin_upload(
         self,

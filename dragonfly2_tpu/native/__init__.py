@@ -462,6 +462,10 @@ class NativePieceFetcher:
         return max(int(self._lib.pf_pending(self._h)), 0)
 
     def close(self) -> None:
+        """Release the engine handle.  Queued (not yet in-flight) jobs
+        are DISCARDED, not fetched — by the time the conductor closes,
+        its window deadline has already routed unfinished pieces to the
+        Python retry path, so close never stalls on a wedged parent."""
         if self._h >= 0:
             self._lib.pf_close(self._h)
             self._h = -1

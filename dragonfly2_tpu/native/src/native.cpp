@@ -27,12 +27,14 @@
 #include <ctime>
 #include <deque>
 #include <dirent.h>
+#include <fcntl.h>
 #include <map>
 #include <memory>
 #include <mutex>
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <string>
 #include <sys/sendfile.h>
 #include <sys/socket.h>
@@ -684,6 +686,13 @@ bool sendv_all(int fd, iovec* iov, size_t n) {
 // send failure (caller drops the connection).
 int try_piece_batch(HttpServer* srv, int fd, std::string& acc) {
   constexpr size_t kBatchMax = 16;
+  // Byte cap on the gather buffer (mirrors the native client's 512 KiB
+  // pipelining cap): the scratch allocation is the batch's whole RSS
+  // cost, and a foreign client pipelining 16 x 4 MiB GETs must not make
+  // every connection thread stage 64 MiB (or throw bad_alloc).  Pieces
+  // past the cap stay in `acc` for the next iteration — they re-batch
+  // or ride the per-request sendfile path.
+  constexpr int64_t kBatchBytesMax = 512 * 1024;
   struct PieceReq {
     std::string task;
     uint32_t number;
@@ -747,8 +756,21 @@ int try_piece_batch(HttpServer* srv, int fd, std::string& acc) {
     }
     entries.push_back({pm, ts});
   }
+  // Trim to the longest prefix under the byte cap (sizes are only known
+  // after the meta lookups above); under 2 the batch gains nothing.
+  size_t keep = 0;
   int64_t total = 0;
-  for (auto& e : entries) total += e.pm.length;
+  while (keep < entries.size() &&
+         total + (int64_t)entries[keep].pm.length <= kBatchBytesMax) {
+    total += entries[keep].pm.length;
+    keep++;
+  }
+  if (keep < 2) {
+    srv->active.fetch_sub(1);
+    return 0;
+  }
+  entries.resize(keep);
+  reqs.resize(keep);
   std::vector<uint8_t> scratch((size_t)total);
   std::vector<std::string> heads(entries.size());
   size_t off = 0;
@@ -1265,10 +1287,17 @@ struct PieceFetcher {
   std::vector<std::thread> workers;
 };
 
-std::mutex g_fetchers_mu;
-std::map<int64_t, PieceFetcher*> g_fetchers;
+// shared_ptr holders (the TaskPtr discipline): a caller blocked inside
+// pf_complete's cv_done wait keeps the fetcher alive across a concurrent
+// pf_close — close erases the handle, wakes waiters, joins workers, and
+// the LAST reference frees.  The conductor happens to use the handle
+// single-threaded, but the extern-C ABI makes no such promise.
+using FetcherPtr = std::shared_ptr<PieceFetcher>;
 
-PieceFetcher* get_fetcher(int64_t handle) {
+std::mutex g_fetchers_mu;
+std::map<int64_t, FetcherPtr> g_fetchers;
+
+FetcherPtr get_fetcher(int64_t handle) {
   std::lock_guard<std::mutex> lk(g_fetchers_mu);
   auto it = g_fetchers.find(handle);
   return it == g_fetchers.end() ? nullptr : it->second;
@@ -1286,11 +1315,35 @@ int connect_parent(const std::string& ip, uint16_t port) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(port);
-  if (inet_pton(AF_INET, ip.c_str(), &addr.sin_addr) != 1 ||
-      connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+  if (inet_pton(AF_INET, ip.c_str(), &addr.sin_addr) != 1) {
     close(fd);
     return -1;
   }
+  // Non-blocking connect with a bounded poll: a black-holed parent must
+  // cost a worker at most this dial timeout, not the kernel's minutes-
+  // long SYN retry ladder — pf_close joins workers, so an unbounded
+  // connect here would stall the conductor's `finally: fetcher.close()`
+  // long past piece_wait_timeout_s.
+  int flags = fcntl(fd, F_GETFL, 0);
+  if (flags < 0 || fcntl(fd, F_SETFL, flags | O_NONBLOCK) != 0) {
+    close(fd);
+    return -1;
+  }
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    if (errno != EINPROGRESS) {
+      close(fd);
+      return -1;
+    }
+    pollfd pfd{fd, POLLOUT, 0};
+    int err = 0;
+    socklen_t elen = sizeof(err);
+    if (poll(&pfd, 1, 5000) != 1 ||
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) != 0 || err != 0) {
+      close(fd);
+      return -1;
+    }
+  }
+  fcntl(fd, F_SETFL, flags);
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   // A wedged parent must park a worker for at most the recv timeout —
@@ -1300,11 +1353,21 @@ int connect_parent(const std::string& ip, uint16_t port) {
   return fd;
 }
 
+// Largest body read_response will ever allocate when the caller does not
+// know the piece length (16x the common 4 MiB piece): a hostile or
+// corrupt parent advertising `Content-Length: 9e15` must be a -2
+// protocol error, not a bad_alloc in std::string::resize — an exception
+// escaping a worker thread entry would std::terminate the whole daemon.
+constexpr int64_t kMaxFetchBody = 64LL * 1024 * 1024;
+
 // One HTTP response (head + Content-Length body) off a keep-alive client
 // socket.  Residual bytes persist in `acc` across calls so pipelined
 // responses are never dropped.  Returns the HTTP status with the body in
-// *body, or <0 on socket/protocol error.
-int read_response(int fd, std::string& acc, std::string* body) {
+// *body, or <0 on socket/protocol error.  `expected_len` (when > 0)
+// bounds the body allocation up front; error bodies still get a small
+// floor so a verbose 404/503 page doesn't masquerade as -2.
+int read_response(int fd, std::string& acc, std::string* body,
+                  uint32_t expected_len) {
   char buf[65536];
   size_t head_end;
   while ((head_end = acc.find("\r\n\r\n")) == std::string::npos) {
@@ -1329,6 +1392,10 @@ int read_response(int fd, std::string& acc, std::string* body) {
     if (!parse_i64(v, &clen)) return -2;
   }
   if (clen < 0) return -2;
+  int64_t cap = expected_len > 0
+                    ? std::max<int64_t>(expected_len, 64 * 1024)
+                    : kMaxFetchBody;
+  if (clen > cap) return -2;
   // Bulk path: splice whatever body bytes already rode in with the head,
   // then recv the remainder straight into the body buffer — one copy per
   // byte instead of append+assign, and length-capped reads never overshoot
@@ -1357,7 +1424,22 @@ void fetch_worker(PieceFetcher* pf) {
     {
       std::unique_lock<std::mutex> lk(pf->mu);
       pf->cv_jobs.wait(lk, [&] { return pf->closing || !pf->jobs.empty(); });
-      if (pf->jobs.empty()) break;  // closing, queue drained
+      if (pf->closing) {
+        // Close DISCARDS the queue: each queued job becomes a -1
+        // completion (Python's submitted-minus-drained ledger stays
+        // balanced for any concurrent pf_complete) and only in-flight
+        // bursts finish — pf_close joins workers, so fetching a whole
+        // backlog from a wedged parent here would stall the conductor's
+        // `finally: fetcher.close()` for minutes after the window's
+        // deadline already fired.
+        while (!pf->jobs.empty()) {
+          FetchJob& j = pf->jobs.front();
+          pf->done.push_back({j.number, -1, 0, j.slot, 0});
+          pf->jobs.pop_front();
+        }
+        pf->cv_done.notify_all();
+        break;
+      }
       burst.push_back(std::move(pf->jobs.front()));
       pf->jobs.pop_front();
       // Opportunistic pipelining: pull queued jobs bound for the SAME
@@ -1383,91 +1465,114 @@ void fetch_worker(PieceFetcher* pf) {
       }
     }
     int32_t slot = burst[0].slot;
-    std::string ip;
-    uint16_t port = 0;
-    {
-      std::lock_guard<std::mutex> lk(pf->mu);
-      if (slot >= 0 && (size_t)slot < pf->parents.size()) {
-        ip = pf->parents[slot].first;
-        port = pf->parents[slot].second;
-      }
-    }
     int64_t t0 = now_ns();
-    auto fail_all = [&](size_t from, int32_t status) {
+    size_t completed = 0;  // completions already pushed for this burst
+    auto fail_rest = [&](int32_t status) {
       std::lock_guard<std::mutex> lk(pf->mu);
-      for (size_t i = from; i < burst.size(); i++)
+      while (completed < burst.size()) {
         pf->done.push_back(
-            {burst[i].number, status, 0, slot, now_ns() - t0});
+            {burst[completed].number, status, 0, slot, now_ns() - t0});
+        completed++;
+      }
     };
-    if (ip.empty() || port == 0) {
-      fail_all(0, -1);
+    // Every job in the burst completes exactly once, even on a C++
+    // exception: an exception escaping a std::thread entry would
+    // std::terminate the whole daemon, so one bad peer response must
+    // cost error completions (Python reschedules), never the process.
+    try {
+      std::string ip;
+      uint16_t port = 0;
+      {
+        std::lock_guard<std::mutex> lk(pf->mu);
+        if (slot >= 0 && (size_t)slot < pf->parents.size()) {
+          ip = pf->parents[slot].first;
+          port = pf->parents[slot].second;
+        }
+      }
+      if (ip.empty() || port == 0) {
+        fail_rest(-1);
+        pf->cv_done.notify_all();
+        continue;
+      }
+      // Send the whole burst; one reconnect retry covers a parent having
+      // dropped the idle pooled socket between windows (same shape as the
+      // Python pool's retry_call(attempts=2)).
+      bool sent = false;
+      for (int attempt = 0; attempt < 2 && !sent; attempt++) {
+        auto it = socks.find(slot);
+        if (it == socks.end() || it->second < 0) {
+          int nfd = connect_parent(ip, port);
+          socks[slot] = nfd;
+          residual[slot].clear();
+          if (nfd < 0) break;
+        }
+        std::string reqs;
+        for (auto& b : burst) {
+          char req[512];
+          int n = snprintf(req, sizeof(req),
+                           "GET /pieces/%s/%u HTTP/1.1\r\n"
+                           "Host: %s:%u\r\n"
+                           "X-Dragonfly-Tenant: %s\r\n\r\n",
+                           b.task.c_str(), b.number, ip.c_str(),
+                           (unsigned)port, pf->tenant.c_str());
+          reqs.append(req, (size_t)n);
+        }
+        if (send_all(socks[slot], reqs.data(), reqs.size())) {
+          sent = true;
+        } else {
+          close(socks[slot]);
+          socks[slot] = -1;
+        }
+      }
+      if (!sent) {
+        fail_rest(-1);
+        pf->cv_done.notify_all();
+        continue;
+      }
+      // Read responses in order; commit each good body through the same
+      // crc+fsync write path every other commit uses.
+      for (size_t i = 0; i < burst.size(); i++) {
+        std::string body;
+        int status = read_response(socks[slot], residual[slot], &body,
+                                   burst[i].expected_len);
+        if (status < 0) {
+          close(socks[slot]);
+          socks[slot] = -1;
+          fail_rest(status);
+          break;
+        }
+        FetchDone d{burst[i].number, 0, 0, slot, 0};
+        if (status != 200) {
+          d.status = status;
+        } else if (burst[i].expected_len > 0 &&
+                   body.size() != burst[i].expected_len) {
+          d.status = -2;
+        } else {
+          int64_t wrote = ps_write_piece(
+              pf->store_handle, burst[i].task.c_str(), burst[i].number,
+              (const uint8_t*)body.data(), (uint32_t)body.size());
+          d.status = wrote < 0 ? -3 : 0;
+          d.length = (uint32_t)body.size();
+        }
+        d.cost_ns = now_ns() - t0;
+        {
+          std::lock_guard<std::mutex> lk(pf->mu);
+          pf->done.push_back(d);
+        }
+        completed++;
+      }
       pf->cv_done.notify_all();
-      continue;
-    }
-    // Send the whole burst; one reconnect retry covers a parent having
-    // dropped the idle pooled socket between windows (same shape as the
-    // Python pool's retry_call(attempts=2)).
-    bool sent = false;
-    for (int attempt = 0; attempt < 2 && !sent; attempt++) {
+    } catch (...) {
+      // The socket's stream position is unknown mid-exception: drop it
+      // so the next burst starts on a clean connection.
       auto it = socks.find(slot);
-      if (it == socks.end() || it->second < 0) {
-        int nfd = connect_parent(ip, port);
-        socks[slot] = nfd;
-        residual[slot].clear();
-        if (nfd < 0) break;
+      if (it != socks.end() && it->second >= 0) {
+        close(it->second);
+        it->second = -1;
       }
-      std::string reqs;
-      for (auto& b : burst) {
-        char req[512];
-        int n = snprintf(req, sizeof(req),
-                         "GET /pieces/%s/%u HTTP/1.1\r\n"
-                         "Host: %s:%u\r\n"
-                         "X-Dragonfly-Tenant: %s\r\n\r\n",
-                         b.task.c_str(), b.number, ip.c_str(), (unsigned)port,
-                         pf->tenant.c_str());
-        reqs.append(req, (size_t)n);
-      }
-      if (send_all(socks[slot], reqs.data(), reqs.size())) {
-        sent = true;
-      } else {
-        close(socks[slot]);
-        socks[slot] = -1;
-      }
-    }
-    if (!sent) {
-      fail_all(0, -1);
+      fail_rest(-2);
       pf->cv_done.notify_all();
-      continue;
     }
-    // Read responses in order; commit each good body through the same
-    // crc+fsync write path every other commit uses.
-    for (size_t i = 0; i < burst.size(); i++) {
-      std::string body;
-      int status = read_response(socks[slot], residual[slot], &body);
-      if (status < 0) {
-        close(socks[slot]);
-        socks[slot] = -1;
-        fail_all(i, status);
-        break;
-      }
-      FetchDone d{burst[i].number, 0, 0, slot, 0};
-      if (status != 200) {
-        d.status = status;
-      } else if (burst[i].expected_len > 0 &&
-                 body.size() != burst[i].expected_len) {
-        d.status = -2;
-      } else {
-        int64_t wrote = ps_write_piece(
-            pf->store_handle, burst[i].task.c_str(), burst[i].number,
-            (const uint8_t*)body.data(), (uint32_t)body.size());
-        d.status = wrote < 0 ? -3 : 0;
-        d.length = (uint32_t)body.size();
-      }
-      d.cost_ns = now_ns() - t0;
-      std::lock_guard<std::mutex> lk(pf->mu);
-      pf->done.push_back(d);
-    }
-    pf->cv_done.notify_all();
   }
   for (auto& kv : socks)
     if (kv.second >= 0) close(kv.second);
@@ -1484,10 +1589,13 @@ int64_t pf_open(int64_t store_handle, int workers, const char* tenant) {
   if (!get_store(store_handle)) return -1;
   if (workers <= 0) workers = 4;
   if (workers > 64) workers = 64;
-  PieceFetcher* pf = new PieceFetcher();
+  FetcherPtr pf = std::make_shared<PieceFetcher>();
   pf->store_handle = store_handle;
   pf->tenant = tenant ? tenant : "";
-  for (int i = 0; i < workers; i++) pf->workers.emplace_back(fetch_worker, pf);
+  // Raw pointer is safe: pf_close joins the workers while still holding
+  // a reference, so the object outlives every worker thread.
+  for (int i = 0; i < workers; i++)
+    pf->workers.emplace_back(fetch_worker, pf.get());
   std::lock_guard<std::mutex> lk(g_fetchers_mu);
   int64_t h = g_next_handle++;
   g_fetchers[h] = pf;
@@ -1497,7 +1605,7 @@ int64_t pf_open(int64_t store_handle, int workers, const char* tenant) {
 // Register/replace the parent endpoint behind `slot` (Python owns parent
 // selection; slots keep the per-piece submit free of string churn).
 int pf_parent(int64_t fh, int slot, const char* ip, uint16_t port) {
-  PieceFetcher* pf = get_fetcher(fh);
+  FetcherPtr pf = get_fetcher(fh);
   if (!pf || slot < 0 || slot > 255 || !ip) return -1;
   std::lock_guard<std::mutex> lk(pf->mu);
   if ((size_t)slot >= pf->parents.size()) pf->parents.resize((size_t)slot + 1);
@@ -1507,7 +1615,7 @@ int pf_parent(int64_t fh, int slot, const char* ip, uint16_t port) {
 
 int pf_submit(int64_t fh, const char* task_id, int slot, uint32_t number,
               uint32_t expected_len) {
-  PieceFetcher* pf = get_fetcher(fh);
+  FetcherPtr pf = get_fetcher(fh);
   if (!pf || !task_id) return -1;
   {
     std::lock_guard<std::mutex> lk(pf->mu);
@@ -1521,12 +1629,26 @@ int pf_submit(int64_t fh, const char* task_id, int slot, uint32_t number,
 // Drain up to `max_records` completions into `out` (packed FetchDone
 // records).  Blocks up to timeout_ms for the first one; 0 on timeout.
 int pf_complete(int64_t fh, uint8_t* out, int max_records, int timeout_ms) {
-  PieceFetcher* pf = get_fetcher(fh);
+  FetcherPtr pf = get_fetcher(fh);
   if (!pf || !out || max_records <= 0) return -1;
   std::unique_lock<std::mutex> lk(pf->mu);
-  if (!pf->cv_done.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                            [&] { return !pf->done.empty(); }))
-    return 0;
+  // `closing` in the predicate: a concurrent pf_close wakes this waiter
+  // immediately (it drains whatever landed) instead of parking it for
+  // the full timeout on an object about to go away.
+  //
+  // system_clock wait_until, NOT wait_for: libstdc++'s steady-clock
+  // timed waits compile to pthread_cond_clockwait, which this
+  // toolchain's libtsan does not intercept — TSAN then misses the
+  // unlock inside the wait and every later report in the run is
+  // poisoned (spurious double-lock/data-race).  The system-clock path
+  // uses the intercepted pthread_cond_timedwait; a wall-clock jump can
+  // only stretch/cut one bounded drain timeout, which callers retry.
+  pf->cv_done.wait_until(
+      lk,
+      std::chrono::system_clock::now() +
+          std::chrono::milliseconds(timeout_ms),
+      [&] { return pf->closing || !pf->done.empty(); });
+  if (pf->done.empty()) return 0;
   int n = 0;
   while (n < max_records && !pf->done.empty()) {
     memcpy(out + (size_t)n * sizeof(FetchDone), &pf->done.front(),
@@ -1540,15 +1662,18 @@ int pf_complete(int64_t fh, uint8_t* out, int max_records, int timeout_ms) {
 // Jobs not yet completed (queued + in flight is Python's submitted-minus-
 // drained count; this exposes just the queue for diagnostics).
 int64_t pf_pending(int64_t fh) {
-  PieceFetcher* pf = get_fetcher(fh);
+  FetcherPtr pf = get_fetcher(fh);
   if (!pf) return -1;
   std::lock_guard<std::mutex> lk(pf->mu);
   return (int64_t)pf->jobs.size();
 }
 
-// Drain the queue (workers finish in-flight jobs), join workers, free.
+// Discard queued jobs (each becomes a -1 completion; in-flight bursts
+// finish), join workers, release the handle.  The object itself is
+// freed by the last shared_ptr holder — a racing pf_complete keeps it
+// alive past this return.
 int pf_close(int64_t fh) {
-  PieceFetcher* pf;
+  FetcherPtr pf;
   {
     std::lock_guard<std::mutex> lk(g_fetchers_mu);
     auto it = g_fetchers.find(fh);
@@ -1561,9 +1686,9 @@ int pf_close(int64_t fh) {
     pf->closing = true;
   }
   pf->cv_jobs.notify_all();
+  pf->cv_done.notify_all();
   for (auto& t : pf->workers)
     if (t.joinable()) t.join();
-  delete pf;
   return 0;
 }
 
@@ -1877,13 +2002,15 @@ int64_t oi_take_edges(int64_t h, int64_t need, int32_t* src, int32_t* dst,
   // The timeout is an IDLE timeout (the Python queue path renews it per
   // arriving chunk): any progress since the last wake resets the clock,
   // so slow-but-steady ingest never ends the run mid-stream.
-  auto deadline = std::chrono::steady_clock::now() +
+  // system_clock (not steady): keeps the wait on the TSAN-intercepted
+  // pthread_cond_timedwait — see pf_complete for the full story.
+  auto deadline = std::chrono::system_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
   int64_t last_size = e->size;
   while (e->size < need && !e->eof && !e->closed) {
     if (e->size != last_size) {
       last_size = e->size;
-      deadline = std::chrono::steady_clock::now() +
+      deadline = std::chrono::system_clock::now() +
                  std::chrono::milliseconds(timeout_ms);
     }
     if (e->cv_data.wait_until(lk, deadline) == std::cv_status::timeout) {

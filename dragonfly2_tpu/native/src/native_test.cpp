@@ -47,6 +47,47 @@ std::vector<uint8_t> piece_bytes(uint32_t task, uint32_t number, size_t len) {
   return v;
 }
 
+// Minimal hostile/slow parent for the pf_* robustness tests: listens on
+// an ephemeral loopback port, accepts ONE connection, and answers every
+// received request head per `reply` after `delay_us`.
+int listen_local(uint16_t* port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = 0;
+  inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 || listen(fd, 8) != 0) {
+    close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &alen);
+  *port = ntohs(addr.sin_port);
+  return fd;
+}
+
+void fake_parent(int lfd, const std::string& reply, int delay_us) {
+  int cfd = accept(lfd, nullptr, nullptr);
+  if (cfd < 0) return;
+  std::string acc;
+  char buf[8192];
+  for (;;) {
+    ssize_t n = recv(cfd, buf, sizeof(buf), 0);
+    if (n <= 0) break;
+    acc.append(buf, (size_t)n);
+    size_t nreq = 0, pos = 0;
+    while ((pos = acc.find("\r\n\r\n")) != std::string::npos) {
+      acc.erase(0, pos + 4);
+      nreq++;
+    }
+    if (nreq == 0) continue;
+    if (delay_us > 0) usleep(delay_us);
+    for (size_t i = 0; i < nreq; i++)
+      if (!send_all(cfd, reply.data(), reply.size())) break;
+  }
+  close(cfd);
+}
+
 }  // namespace
 
 int main() {
@@ -310,6 +351,130 @@ int main() {
     int64_t leaked_servers = 0, leaked_conns = 0;
     assert(ps_leak_stats(&leaked_servers, &leaked_conns) == 0);
     assert(leaked_servers == 0 && leaked_conns == 0);
+  }
+
+  // 6. pf_* robustness against hostile/wedged parents (REVIEW fixes):
+  //    a) an absurd Content-Length is a -2 completion, not a bad_alloc
+  //       that std::terminates the daemon;
+  //    b) pf_close DISCARDS the queued backlog (only in-flight bursts
+  //       finish) and safely wakes a concurrently blocked pf_complete;
+  //    c) a foreign client pipelining piece GETs past the server's
+  //       512 KiB batch byte cap still gets byte-exact bodies, and the
+  //       batched counter never covers the over-cap tail.
+  {
+    char dst_tmpl[] = "/tmp/native_test_rb_XXXXXX";
+    int64_t dst = ps_open(mkdtemp(dst_tmpl));
+    assert(dst > 0);
+    const uint32_t kSmall = 16 * 1024;
+    assert(ps_create_task(dst, "rb-task", kSmall, 64 * kSmall) == 0);
+
+    // a) hostile Content-Length.
+    {
+      uint16_t port = 0;
+      int lfd = listen_local(&port);
+      assert(lfd >= 0);
+      std::thread parent(fake_parent, lfd,
+                         "HTTP/1.1 200 OK\r\n"
+                         "Content-Length: 9000000000000000\r\n\r\n",
+                         0);
+      int64_t fh = pf_open(dst, 1, "tenant-test");
+      assert(fh > 0);
+      assert(pf_parent(fh, 0, "127.0.0.1", port) == 0);
+      // expected_len 0: even the unknown-size path must cap the body.
+      assert(pf_submit(fh, "rb-task", 0, 0, 0) == 0);
+      FetchDone rec{};
+      int drained = 0;
+      for (int spin = 0; spin < 100 && drained == 0; spin++)
+        drained = pf_complete(fh, (uint8_t*)&rec, 1, 100);
+      assert(drained == 1 && rec.status == -2);
+      assert(pf_close(fh) == 0);
+      parent.join();
+      close(lfd);
+    }
+
+    // b) close-discards-queue + concurrent pf_complete lifetime.
+    {
+      uint16_t port = 0;
+      int lfd = listen_local(&port);
+      assert(lfd >= 0);
+      // 400 ms per burst: fetching the whole 64-job backlog (8 bursts on
+      // 1 worker) would take >= 3.2 s; discard must close far sooner.
+      std::thread parent(fake_parent, lfd,
+                         "HTTP/1.1 404 Not Found\r\n"
+                         "Content-Length: 0\r\n\r\n",
+                         400 * 1000);
+      int64_t fh = pf_open(dst, 1, "tenant-test");
+      assert(fh > 0);
+      assert(pf_parent(fh, 0, "127.0.0.1", port) == 0);
+      for (uint32_t n = 0; n < 64; n++)
+        assert(pf_submit(fh, "rb-task", 0, n, kSmall) == 0);
+      // A waiter parked inside pf_complete across the close: the
+      // shared_ptr holder + closing-wake must make this return cleanly
+      // (ASAN would flag the old raw-pointer use-after-free here).
+      std::thread waiter([&] {
+        FetchDone recs[64];
+        (void)pf_complete(fh, (uint8_t*)recs, 64, 10000);
+      });
+      usleep(50 * 1000);  // let the first burst go in-flight
+      timespec c0, c1;
+      clock_gettime(CLOCK_MONOTONIC, &c0);
+      assert(pf_close(fh) == 0);
+      clock_gettime(CLOCK_MONOTONIC, &c1);
+      int64_t close_ms = (c1.tv_sec - c0.tv_sec) * 1000 +
+                         (c1.tv_nsec - c0.tv_nsec) / 1000000;
+      assert(close_ms < 2000);  // one in-flight burst, not the backlog
+      waiter.join();
+      parent.join();
+      close(lfd);
+    }
+
+    // c) server batch byte cap under foreign pipelining.
+    {
+      char src_tmpl[] = "/tmp/native_test_cap_XXXXXX";
+      int64_t src = ps_open(mkdtemp(src_tmpl));
+      assert(src > 0);
+      const uint32_t kBig = 200 * 1024;  // 3 pipelined > the 512 KiB cap
+      assert(ps_create_task(src, "cap-task", kBig, 3 * kBig) == 0);
+      for (uint32_t n = 0; n < 3; n++) {
+        auto data = piece_bytes(9, n, kBig);
+        assert(ps_write_piece(src, "cap-task", n, data.data(), kBig) ==
+               (int64_t)kBig);
+      }
+      int64_t port = ps_serve(src, "127.0.0.1", 0, 16);
+      assert(port > 0);
+      int fd = socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons((uint16_t)port);
+      inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+      assert(connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0);
+      std::string reqs;
+      for (int n = 0; n < 3; n++)
+        reqs += "GET /pieces/cap-task/" + std::to_string(n) +
+                " HTTP/1.1\r\nHost: x\r\n\r\n";
+      assert(send_all(fd, reqs.data(), reqs.size()));  // one segment
+      std::string acc;
+      for (uint32_t n = 0; n < 3; n++) {
+        std::string body;
+        assert(read_response(fd, acc, &body, kBig) == 200);
+        auto want = piece_bytes(9, n, kBig);
+        assert(body.size() == kBig &&
+               memcmp(body.data(), want.data(), kBig) == 0);
+      }
+      close(fd);
+      // The conn thread bumps the counters AFTER the last body bytes
+      // are already readable client-side — poll briefly.
+      int64_t pieces = 0, bytes = 0, batched = 0, conns = 0;
+      for (int spin = 0; spin < 200 && pieces < 3; spin++) {
+        assert(ps_serve_stats2(src, &pieces, &bytes, &batched, &conns) == 0);
+        if (pieces < 3) usleep(5000);
+      }
+      assert(pieces == 3 && bytes == 3 * (int64_t)kBig);
+      assert(batched <= 2);  // the over-cap tail never rode the batch
+      assert(ps_serve_stop(src) == 0);
+      assert(ps_close(src) == 0);
+    }
+    assert(ps_close(dst) == 0);
   }
 
   printf("native_test: OK\n");

@@ -325,6 +325,9 @@ class TestUploadQoS:
         policy = QoSPolicy.from_payload({
             "t-owner": {"tenant_class": "background",
                         "upload_rate_bytes_s": 2048.0},
+            # The requester must be a KNOWN tenant (policy row) for the
+            # unauthenticated wire header to be honored at all.
+            "t-req": {"tenant_class": "silver"},
         })
         um = self._um(tmp_path, policy)
         um.register_task_tenant("t", "t-owner")
@@ -352,6 +355,36 @@ class TestUploadQoS:
                 um2.serve_piece("t", n % 4, requester_tenant="t-cheap")
         assert um2.tenant_bytes.get("t-free", 0) == 0
 
+    def test_spoofed_requester_tenant_falls_back_to_owner(self, tmp_path):
+        """The X-Dragonfly-Tenant header is unauthenticated: a name the
+        daemon cannot vouch for (no QoS-policy row, never registered as
+        a task owner) is treated as ABSENT — attribution falls back to
+        the task owner, the fabricated name gets no bucket or byte-total
+        entry, and a stranger cannot steer a victim's bucket into debt
+        by stamping the victim's id."""
+        policy = QoSPolicy.from_payload({
+            "t-owner": {"tenant_class": "background",
+                        "upload_rate_bytes_s": 1 << 20},
+        })
+        um = self._um(tmp_path, policy)
+        um.register_task_tenant("t", "t-owner")
+        # Rotating fabricated names: all serves bill the owner, and the
+        # accounting maps never learn the fabricated ids.
+        for n in range(8):
+            assert um.serve_piece(
+                "t", n % 4, requester_tenant=f"t-forged-{n}"
+            ) == bytes(1024)
+        assert um.tenant_bytes == {"t-owner": 8 * 1024}
+        assert not any(t.startswith("t-forged") for t in um._tenant_bw)
+        # A tenant KNOWN from local task registration (no policy row) is
+        # still honored — same-cluster cross-tenant pulls keep working.
+        um.register_task_tenant("t-other-task", "t-neighbor")
+        assert um.serve_piece(
+            "t", 0, requester_tenant="t-neighbor"
+        ) == bytes(1024)
+        assert um.tenant_bytes["t-neighbor"] == 1024
+        assert um.tenant_bytes["t-owner"] == 8 * 1024
+
     def test_requester_pays_rides_the_wire_header(self, tmp_path):
         """X-Dragonfly-Tenant on a piece GET reaches begin/end_upload:
         the serving peer's accounting lands on the requester over both
@@ -363,7 +396,9 @@ class TestUploadQoS:
             PieceHTTPServer,
         )
 
-        um = self._um(tmp_path, QoSPolicy())
+        um = self._um(
+            tmp_path, QoSPolicy.from_payload({"t-req": {}})
+        )
         um.register_task_tenant("t", "t-owner")
         server = PieceHTTPServer(um)
         server.serve()
