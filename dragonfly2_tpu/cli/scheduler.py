@@ -478,6 +478,7 @@ def run(argv=None) -> int:
                 RemoteRegistry(manager_endpoints, token=token),
                 service.scheduling.evaluator,
                 scheduler_id=scheduler_id,
+                idc=cfg.scheduling.idc or None,
                 refresh_interval=cfg.scheduling.model_poll_interval_s,
                 jitter=cfg.scheduling.model_poll_jitter,
                 rollout_client=RolloutRESTClient(manager_endpoints, token=token),

@@ -104,6 +104,50 @@ def run(argv=None) -> int:
         service, host=cfg.server.host, port=cfg.server.port
     )
     http_server.serve()
+    # Self-driving lifecycle plane (DESIGN.md §29): with a REST manager
+    # attached, every ingested record also streams into the continuous
+    # train→export→rollout loop — candidates register and walk
+    # SHADOW→CANARY→ACTIVE with zero human steps (schedulers' rollout
+    # reporters supply the evaluation evidence).
+    lifecycle_daemon = None
+    if (
+        cfg.lifecycle.enable
+        and manager_addr
+        and not manager_addr.startswith("grpc://")
+    ):
+        from ..lifecycle import LifecycleConfig, LifecycleDaemon
+        from ..rollout.client import RolloutRESTClient
+
+        lc = cfg.lifecycle
+        lifecycle_daemon = LifecycleDaemon(
+            registry,
+            RolloutRESTClient(manager_addr, token=args.manager_token),
+            config=LifecycleConfig(
+                scheduler_id=args.scheduler_id,
+                model_name=lc.model_name,
+                regions=tuple(lc.regions),
+                epoch_records=lc.epoch_records,
+                max_steps_per_epoch=lc.max_steps_per_epoch,
+                min_joined=lc.min_joined,
+                arbitration_margin=lc.arbitration_margin,
+                canary_percent=lc.canary_percent,
+                interval_s=lc.interval_s,
+                trainer_batch_size=lc.trainer_batch_size,
+            ),
+        )
+        service.online_sink = lifecycle_daemon
+        lifecycle_daemon.serve()
+        print(
+            f"trainer: lifecycle daemon on (epoch every {lc.epoch_records} "
+            f"records, regions={list(lc.regions) or ['global only']})",
+            flush=True,
+        )
+    elif cfg.lifecycle.enable:
+        print(
+            "trainer: lifecycle.enable set but no REST manager attached; "
+            "lifecycle daemon not started",
+            flush=True,
+        )
     grpc_server = None
     if cfg.server.grpc_port >= 0:
         from ..rpc.grpc_transport import TrainerGRPCServer

@@ -151,6 +151,11 @@ class SchedulingSection:
     model_poll_jitter: float = 0.1
     shadow_sample_rate: float = 0.1
     rollout_report_interval_s: float = 60.0
+    # Regional model keys (DESIGN.md §29): this scheduler's idc/region.
+    # Set, the model subscriber polls the per-region specialization
+    # ``<model>@<idc>`` first and falls back to the global model; empty
+    # keeps the reference's fleet-wide single-key behaviour.
+    idc: str = ""
     # Sharded fleet (DESIGN.md §24): admission control bounds for this
     # shard — concurrent task-scoped requests past max_inflight (and
     # announce p99 past the budget) start shedding the lowest priority
@@ -279,9 +284,44 @@ class TrainingSection:
 
 
 @dataclass
+class LifecycleSection:
+    """Self-driving lifecycle plane (lifecycle/daemon.py, DESIGN.md §29):
+    continuous train → export → rollout cadence and the global-vs-regional
+    CANARY arbitration knobs."""
+
+    enable: bool = False
+    model_name: str = "parent-bandwidth-mlp"
+    # Comma-free region list: one regional arm (``model_name@region``)
+    # is trained next to the global arm per entry.
+    regions: tuple = ()
+    epoch_records: int = 1024          # records per key between epochs
+    max_steps_per_epoch: int = 50
+    min_joined: int = 50               # arbitration evidence floor
+    arbitration_margin: float = 0.02   # regional must beat global by this
+    canary_percent: int = 10
+    interval_s: float = 30.0           # daemon loop cadence
+    trainer_batch_size: int = 256
+
+    def validate(self) -> None:
+        # YAML hands lists in; the daemon wants a hashable tuple.
+        self.regions = tuple(self.regions or ())
+        if self.epoch_records < 1:
+            raise ConfigError("lifecycle.epoch_records must be >= 1")
+        if self.max_steps_per_epoch < 1:
+            raise ConfigError("lifecycle.max_steps_per_epoch must be >= 1")
+        if not (0 <= self.canary_percent <= 100):
+            raise ConfigError("lifecycle.canary_percent must be in [0, 100]")
+        if self.arbitration_margin < 0:
+            raise ConfigError("lifecycle.arbitration_margin must be >= 0")
+        if self.interval_s <= 0:
+            raise ConfigError("lifecycle.interval_s must be > 0")
+
+
+@dataclass
 class TrainerConfigFile:
     server: ServerConfig = field(default_factory=lambda: ServerConfig(port=9090))
     training: TrainingSection = field(default_factory=TrainingSection)
+    lifecycle: LifecycleSection = field(default_factory=LifecycleSection)
     data_dir: str = "/var/lib/dragonfly/trainer"
     manager_addr: str = ""
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
@@ -292,6 +332,7 @@ class TrainerConfigFile:
     def validate(self) -> None:
         self.server.validate()
         self.training.validate()
+        self.lifecycle.validate()
         self.log.validate()
         self.tracing.validate()
         self.telemetry.validate()

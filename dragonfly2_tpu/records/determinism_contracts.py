@@ -104,6 +104,14 @@ DETERMINISM_CONTRACTS = {
             "file": "tools/trace_assemble.py",
             "qual": "summarize_trace",
         },
+        "lifecycle.arbitrate": {
+            "file": "dragonfly2_tpu/lifecycle/arbiter.py",
+            "qual": "arbitrate_candidates",
+        },
+        "lifecycle.epoch_plan": {
+            "file": "dragonfly2_tpu/lifecycle/arbiter.py",
+            "qual": "plan_epoch",
+        },
     },
     # -- injection seams ----------------------------------------------------
     # The ONLY doors nondeterminism may enter a replay path through: a
@@ -153,6 +161,12 @@ DETERMINISM_CONTRACTS = {
         {
             "file": "dragonfly2_tpu/sim/qos.py",
             "qual": "QoSDrillConfig",
+            "params": ["seed"],
+            "kind": "rng",
+        },
+        {
+            "file": "dragonfly2_tpu/sim/lifecycle.py",
+            "qual": "LifecycleDrillConfig",
             "params": ["seed"],
             "kind": "rng",
         },

@@ -331,6 +331,20 @@ STATE_CONTRACTS = {
                 "unlocked_ok": [],
                 "invariant": "rows_load",
             },
+            # Lifecycle plane progress (lifecycle/state.py, DESIGN.md
+            # §29): one row per model key — epoch counter, ingest
+            # watermark, in-flight candidate identity, bounded decision
+            # history — so a manager bounce mid-promotion resumes the
+            # train→export→rollout loop instead of restarting it.
+            "lifecycle": {
+                "owner": "dragonfly2_tpu/lifecycle/state.py",
+                "lock": ["dragonfly2_tpu/lifecycle/state.py",
+                         "LifecycleStore", "_mu"],
+                "loader": "LifecycleStore.__init__",
+                "multi_row": [],
+                "unlocked_ok": [],
+                "invariant": "rows_load",
+            },
         },
         # Dynamic-namespace write paths: functions that legitimately
         # write ANY declared namespace through a variable ``.table(ns)``

@@ -8,14 +8,18 @@ Two implementations of one small surface:
   percent), or None;
 - ``report(scheduler_id, name, payload)`` — post one evaluation report
   (rollout/evaluation.py ``evaluate_shadow`` output) and get the
-  controller's decision back.
+  controller's decision back;
+- ``begin(model_id)`` — start the evidence-gated rollout for a freshly
+  registered version (CANDIDATE → SHADOW), the lifecycle daemon's
+  zero-human entry into the promotion plane (lifecycle/daemon.py).
 
 ``LocalRolloutClient`` wraps an in-process ``RolloutController`` (tests,
 embedded runs, deploy/e2e_loop).  ``RolloutRESTClient`` rides the
 manager's REST surface with the same retry/translate discipline as
 rpc/registry_client.py, and fires the ``rollout.fetch`` /
-``rollout.report`` chaos seams (DF004 REQUIRED_SEAMS) so the drills can
-cut the quality plane deterministically.
+``rollout.report`` / ``rollout.begin`` chaos seams (DF004
+REQUIRED_SEAMS) so the drills can cut the quality plane
+deterministically.
 """
 
 from __future__ import annotations
@@ -58,6 +62,11 @@ class LocalRolloutClient:
 
     def report(self, scheduler_id: str, name: str, payload: dict) -> dict:
         return self.controller.report(scheduler_id, name, payload)
+
+    def begin(self, model_id: str, *, canary_percent: Optional[int] = None) -> dict:
+        return self.controller.to_json(
+            self.controller.begin(model_id, canary_percent=canary_percent)
+        )
 
     def load_artifact(self, model: Model) -> bytes:
         return self.registry.load_artifact(model)
@@ -148,6 +157,39 @@ class RolloutRESTClient:
             except urllib.error.HTTPError as exc:
                 if exc.code == 404:
                     raise KeyError(f"no rollout for {scheduler_id}:{name}") from exc
+                if exc.code == 503:
+                    raise  # standby replica: endpoints.call fails over
+                raise RuntimeError(f"manager: HTTP {exc.code}") from exc
+
+        def once():
+            return self.endpoints.call(one_endpoint)
+
+        return retry_call(
+            once, retry_on=(ConnectionError, TimeoutError, OSError)
+        )
+
+    def begin(self, model_id: str, *, canary_percent: Optional[int] = None) -> dict:
+        from ..utils import faultinject
+
+        def one_endpoint(base: str):
+            faultinject.fire("rollout.begin")
+            body: dict = {}
+            if canary_percent is not None:
+                body["canary_percent"] = int(canary_percent)
+            req = urllib.request.Request(
+                base + f"/api/v1/models/{model_id}:rollout",
+                data=json.dumps(body).encode(),
+                headers=self._headers(),
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as exc:
+                if exc.code == 404:
+                    raise KeyError(model_id) from exc
+                if exc.code == 400:
+                    raise ValueError(f"rollout begin refused: {model_id}") from exc
                 if exc.code == 503:
                     raise  # standby replica: endpoints.call fails over
                 raise RuntimeError(f"manager: HTTP {exc.code}") from exc

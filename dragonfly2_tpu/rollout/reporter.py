@@ -60,9 +60,16 @@ class RolloutReporter:
             psi_max=float(psi.max()) if psi is not None and psi.size else None,
         )
         report["shadow"] = shadow.stats()
+        # Report against the key actually under evaluation: with an
+        # idc-scoped subscriber the candidate may be the regional
+        # specialization (model_loader.candidate_name), whose rollout
+        # row the controller keys by the composed name.
+        report_name = getattr(
+            self.subscriber, "candidate_name", self.subscriber.model_name
+        )
         try:
             decision = self.client.report(
-                self.subscriber.scheduler_id, self.subscriber.model_name, report
+                self.subscriber.scheduler_id, report_name, report
             )
         except KeyError:
             logger.debug("no rollout registered for this candidate yet")

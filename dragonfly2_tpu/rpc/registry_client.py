@@ -218,6 +218,11 @@ class RemoteRegistry:
             self._post(f"/api/v1/models/{model_id}:activate", {})
         )
 
+    def deactivate(self, model_id: str) -> Model:
+        return _model_from_json(
+            self._post(f"/api/v1/models/{model_id}:deactivate", {})
+        )
+
     def get(self, model_id: str) -> Optional[Model]:
         data = self._get(
             "/api/v1/models:get?" + urllib.parse.urlencode({"id": model_id})

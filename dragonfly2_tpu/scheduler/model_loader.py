@@ -39,6 +39,18 @@ Rollout plane (DESIGN.md §15), when a ``rollout_client`` is attached:
   an RNG seeded by (scheduler_id, model_name), so a fleet of schedulers
   booted together never synchronizes into a registry thundering herd,
   while any single scheduler's schedule stays reproducible.
+
+Regional model keys (DESIGN.md §29), when an ``idc`` is configured: the
+lifecycle plane registers per-region specializations under the composed
+name ``model_name@idc`` next to the fleet-wide global arm.  Every poll
+asks for the idc-scoped name FIRST and falls back to the global name —
+so a region with a promoted specialization serves it, and every other
+region keeps serving the global model (no cross-region bleed: a
+subscriber only ever requests its own two names).  Versions are
+per-(scheduler_id, name) registry keys, so the subscriber tracks the
+NAME its loaded/candidate versions belong to and never compares version
+numbers across keys; the pin above likewise pins to the last ACTIVE of
+whichever key was serving.
 """
 
 from __future__ import annotations
@@ -68,23 +80,35 @@ class ModelSubscriber:
         *,
         scheduler_id: str,
         model_name: str = "parent-bandwidth-mlp",
+        idc: Optional[str] = None,
         refresh_interval: float = 300.0,
         jitter: float = 0.1,
         rollout_client: "Optional[Union[LocalRolloutClient, RolloutRESTClient]]" = None,
         shadow_sample_rate: float = 0.1,
         shadow_log_path: Optional[str] = None,
     ) -> None:
+        from ..lifecycle.arbiter import regional_model_name
+
         self.registry = registry
         self.evaluator = evaluator
         self.scheduler_id = scheduler_id
         self.model_name = model_name
+        self.idc = idc or None
+        # Poll order: idc-scoped specialization first, global fallback.
+        self._names = (
+            (regional_model_name(model_name, self.idc), model_name)
+            if self.idc
+            else (model_name,)
+        )
         self.refresh_interval = refresh_interval
         self.jitter = max(0.0, float(jitter))
         self.rollout_client = rollout_client
         self.shadow_sample_rate = shadow_sample_rate
         self.shadow_log_path = shadow_log_path
         self._loaded_version: Optional[int] = None
+        self._loaded_key: Optional[str] = None
         self._candidate_version: Optional[int] = None
+        self._candidate_key: Optional[str] = None
         self._candidate_scorer = None
         self._shadow = None
         self._pinned = False
@@ -98,9 +122,23 @@ class ModelSubscriber:
         # of installing stale versions out of order.
         self._refresh_mu = threading.Lock()
         self._refresh_gen = 0
-        # Seeded per (scheduler, model): deterministic for THIS instance,
-        # decorrelated across a fleet (the anti-thundering-herd draw).
-        self._rng = random.Random(f"{scheduler_id}:{model_name}")
+        # Seeded per (scheduler, model, idc): deterministic for THIS
+        # instance, decorrelated across a fleet (the anti-thundering-herd
+        # draw).  The idc-less seed string is unchanged so existing
+        # deployments keep their schedules.
+        seed = f"{scheduler_id}:{model_name}"
+        if self.idc:
+            seed += f"@{self.idc}"
+        self._rng = random.Random(seed)
+
+    @property
+    def candidate_name(self) -> str:
+        """Registry name of the candidate currently under evaluation —
+        the scoped name when a regional specialization is in flight.
+        Reports (rollout/reporter.py) must target THIS key or the
+        controller would judge the wrong rollout row."""
+        with self._refresh_mu:
+            return self._candidate_key or self.model_name
 
     @property
     def pinned(self) -> bool:
@@ -129,18 +167,18 @@ class ModelSubscriber:
         manager liveness."""
         with self._refresh_mu:
             gen = self._refresh_gen
-            loaded_version = self._loaded_version
-            candidate_version = self._candidate_version
+            loaded = (self._loaded_key, self._loaded_version)
+            candidate = (self._candidate_key, self._candidate_version)
         # ---- network phase: registry + rollout polls, artifact loads ----
         try:
-            active = self._fetch_active(loaded_version)
+            active = self._fetch_active(loaded)
         except Exception as exc:  # noqa: BLE001 — manager outage → pin
             with self._refresh_mu:
                 self._pin_locked(exc)
             return False
-        candidate = candidate_exc = None
+        candidate_state = candidate_exc = None
         try:
-            candidate = self._fetch_candidate(candidate_version)
+            candidate_state = self._fetch_candidate(candidate)
         except Exception as exc:  # noqa: BLE001 — candidate poll is best-effort
             candidate_exc = exc
         # ---- commit phase: bookkeeping + evaluator installs, locked ----
@@ -154,16 +192,23 @@ class ModelSubscriber:
             if candidate_exc is not None:
                 self._pin_locked(candidate_exc)
             else:
-                self._commit_candidate_locked(candidate)
+                self._commit_candidate_locked(candidate_state)
             return changed
 
-    def _fetch_active(self, loaded_version):
+    def _fetch_active(self, loaded):
         """Network half of the active-model poll (no lock held): returns
-        ``("deactivate"|"unchanged"|"load_failed", model, scorer)``."""
-        model = self.registry.active_model(self.scheduler_id, self.model_name)
+        ``("deactivate"|"unchanged"|"load_failed", model, scorer)``.
+        Tries the idc-scoped name first, then the global fallback; the
+        first ACTIVE found wins.  A failed scoped poll raises (→ pin);
+        ``None`` falls through to the next name."""
+        model = None
+        for name in self._names:
+            model = self.registry.active_model(self.scheduler_id, name)
+            if model is not None:
+                break
         if model is None:
             return ("deactivate", None, None)
-        if model.version == loaded_version:
+        if (model.name, model.version) == loaded:
             return ("unchanged", model, None)
         from ..trainer.export import load_scorer
 
@@ -183,27 +228,38 @@ class ModelSubscriber:
             if self._loaded_version is not None:
                 self.evaluator.set_scorer(None)  # deactivated → rule fallback
                 self._loaded_version = None
+                self._loaded_key = None
                 return True
             return False
-        if kind != "swap" or model.version == self._loaded_version:
+        if kind != "swap" or (
+            model.name == self._loaded_key and model.version == self._loaded_version
+        ):
             return False
         self.evaluator.set_scorer(scorer)
         self._loaded_version = model.version
+        self._loaded_key = model.name
         logger.info("ML evaluator now serving %s v%d", model.name, model.version)
         return True
 
     # -- rollout candidate (shadow / canary) ---------------------------------
 
-    def _fetch_candidate(self, candidate_version):
+    def _fetch_candidate(self, candidate):
         """Network half of the candidate poll (no lock held): returns
         ``None`` (no rollout client) or ``("drop"|"install"|"keep"|"same",
-        info, scorer)``.  Raises on a failed poll — the caller pins."""
+        info, scorer)``.  Raises on a failed poll — the caller pins.
+        Same idc-scoped-then-global name order as the active poll, so a
+        region shadow-scores its own specialization when one is in
+        flight and the global candidate otherwise."""
         if self.rollout_client is None:
             return None
-        info = self.rollout_client.candidate(self.scheduler_id, self.model_name)
+        info = None
+        for name in self._names:
+            info = self.rollout_client.candidate(self.scheduler_id, name)
+            if info is not None:
+                break
         if info is None:
             return ("drop", None, None)
-        if info.model.version != candidate_version:
+        if (info.model.name, info.model.version) != candidate:
             from ..trainer.export import load_scorer
 
             try:
@@ -229,7 +285,10 @@ class ModelSubscriber:
             return
         if kind == "keep":
             return
-        if kind == "install" and info.model.version != self._candidate_version:
+        if kind == "install" and (
+            info.model.name != self._candidate_key
+            or info.model.version != self._candidate_version
+        ):
             from ..rollout.shadow import ShadowScorer
 
             if self._shadow is not None:
@@ -243,6 +302,7 @@ class ModelSubscriber:
             )
             self._candidate_scorer = scorer
             self._candidate_version = info.model.version
+            self._candidate_key = info.model.name
             logger.info(
                 "shadow scoring %s v%d against active v%s",
                 info.model.name, info.model.version, self._loaded_version,
@@ -284,6 +344,7 @@ class ModelSubscriber:
             self._shadow = None
         self._candidate_scorer = None
         self._candidate_version = None
+        self._candidate_key = None
         metrics.ROLLOUT_SERVING_STATE.set(0, name=self.model_name)
 
     def _pin_locked(self, exc: BaseException) -> None:

@@ -16,6 +16,10 @@ from .fleet import (  # noqa: F401
     FleetSwarmDriver,
     ShardedFleet,
 )
+from .lifecycle import (  # noqa: F401
+    LifecycleDrillConfig,
+    run_lifecycle_drill,
+)
 from .chaos import (  # noqa: F401
     ChaosProcess,
     ChaosScenario,

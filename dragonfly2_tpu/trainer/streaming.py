@@ -44,6 +44,12 @@ class StreamingConfig:
     warmup_steps: int = 100
     decay_steps: int = 100_000
     seed: int = 0
+    # Drift-baseline window: the most recent masked feature rows kept for
+    # stamping train_bin_edges/train_bin_fracs into exported scorers
+    # (trainer/export.feature_snapshot_stats).  A stream has no fixed
+    # training split, so the baseline IS the trailing window the weights
+    # were last fitted against.  0 disables stamping.
+    snapshot_rows: int = 4096
 
 
 class RunningMoments:
@@ -112,6 +118,10 @@ class StreamingTrainer:
         self.records_seen = 0
         self._leftover: Optional[np.ndarray] = None
         self._bias_initialized = False
+        # Trailing-window feature ring for the exported drift baseline.
+        self._snapshot: Optional[np.ndarray] = None
+        self._snapshot_pos = 0
+        self._snapshot_count = 0
         self._init_state()
         self._step_fn = jax.jit(self._train_step, donate_argnums=(0, 1))
 
@@ -214,6 +224,7 @@ class StreamingTrainer:
                 )
                 self._bias_initialized = True
             self.moments.update(feats)
+            self._note_features(feats)
             self.records_seen += len(batch)
             self.params, self.opt_state, loss = self._step_fn(
                 self.params,
@@ -232,6 +243,40 @@ class StreamingTrainer:
                 self.checkpoint()
         return steps_run
 
+    # -- drift-baseline window ------------------------------------------------
+
+    def _note_features(self, feats: np.ndarray) -> None:
+        """Ring-append trained (masked) feature rows for the drift
+        baseline.  Order inside the ring is irrelevant: the baseline is
+        quantile histograms, a pure function of the row multiset."""
+        cap = self.config.snapshot_rows
+        if cap <= 0 or feats.shape[0] == 0:
+            return
+        if self._snapshot is None:
+            self._snapshot = np.zeros((cap, feats.shape[1]), np.float32)
+        n = len(feats)
+        if n >= cap:
+            self._snapshot[:] = feats[-cap:]
+            self._snapshot_pos = 0
+            self._snapshot_count = cap
+            return
+        pos = self._snapshot_pos
+        end = pos + n
+        if end <= cap:
+            self._snapshot[pos:end] = feats
+        else:
+            k = cap - pos
+            self._snapshot[pos:] = feats[:k]
+            self._snapshot[: end - cap] = feats[k:]
+        self._snapshot_pos = end % cap
+        self._snapshot_count = min(cap, self._snapshot_count + n)
+
+    def snapshot_feature_rows(self) -> Optional[np.ndarray]:
+        """The trailing feature window (None before any training step)."""
+        if self._snapshot is None or self._snapshot_count == 0:
+            return None
+        return self._snapshot[: self._snapshot_count]
+
     # -- checkpoint / resume (orbax) -----------------------------------------
 
     def _ckpt_path(self) -> str:
@@ -248,6 +293,18 @@ class StreamingTrainer:
             "records_seen": self.records_seen,
             "bias_initialized": int(self._bias_initialized),
             "moments": self.moments.to_arrays(),
+            # Drift window travels with the weights: a resumed trainer
+            # exports the SAME baseline it would have exported pre-crash.
+            "snapshot": (
+                self._snapshot
+                if self._snapshot is not None
+                else np.zeros(
+                    (max(self.config.snapshot_rows, 1), self.model_config.in_dim),
+                    np.float32,
+                )
+            ),
+            "snapshot_pos": self._snapshot_pos,
+            "snapshot_count": self._snapshot_count,
         }
         ckptr.save(self._ckpt_path(), payload, force=True)
         ckptr.wait_until_finished()
@@ -267,16 +324,36 @@ class StreamingTrainer:
             "records_seen": 0,
             "bias_initialized": 0,
             "moments": self.moments.to_arrays(),
+            "snapshot": np.zeros(
+                (max(self.config.snapshot_rows, 1), self.model_config.in_dim),
+                np.float32,
+            ),
+            "snapshot_pos": 0,
+            "snapshot_count": 0,
         }
         try:
             restored = ckptr.restore(path, abstract)
             self._bias_initialized = bool(restored["bias_initialized"])
-        except Exception:  # noqa: BLE001 — legacy checkpoint (pre-flag)
-            del abstract["bias_initialized"]
-            restored = ckptr.restore(path, abstract)
-            # A legacy checkpoint has trained params: the bias offset is
-            # already baked in — re-applying it would corrupt the model.
-            self._bias_initialized = True
+        except Exception:  # noqa: BLE001 — legacy checkpoint (pre-snapshot)
+            for key in ("snapshot", "snapshot_pos", "snapshot_count"):
+                del abstract[key]
+            try:
+                restored = ckptr.restore(path, abstract)
+                self._bias_initialized = bool(restored["bias_initialized"])
+            except Exception:  # noqa: BLE001 — legacy checkpoint (pre-flag)
+                del abstract["bias_initialized"]
+                restored = ckptr.restore(path, abstract)
+                # A legacy checkpoint has trained params: the bias offset is
+                # already baked in — re-applying it would corrupt the model.
+                self._bias_initialized = True
+        if "snapshot" in restored:
+            self._snapshot_count = int(restored["snapshot_count"])
+            self._snapshot_pos = int(restored["snapshot_pos"])
+            self._snapshot = (
+                np.asarray(restored["snapshot"], np.float32).copy()
+                if self._snapshot_count
+                else None
+            )
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         self.step = int(restored["step"])
@@ -287,11 +364,20 @@ class StreamingTrainer:
     # -- export --------------------------------------------------------------
 
     def export_scorer(self):
-        from .export import export_mlp_scorer
+        from .export import export_mlp_scorer, feature_snapshot_stats
 
-        return export_mlp_scorer(
+        scorer = export_mlp_scorer(
             self.params,
             feat_mean=self.moments.mean.astype(np.float32),
             feat_std=self.moments.std.astype(np.float32),
             post_hoc_masked=True,
         )
+        # Stamp the drift baseline exactly like trainer/export's batch
+        # path (export_from_state): without it a streaming-trained
+        # candidate would sail past the rollout plane's PSI gate blind.
+        rows = self.snapshot_feature_rows()
+        if rows is not None and len(rows):
+            edges, fracs = feature_snapshot_stats(rows)
+            scorer.train_bin_edges = edges
+            scorer.train_bin_fracs = fracs
+        return scorer

@@ -169,6 +169,38 @@ def run_roots(workdir: str) -> None:
     results["fleet_assemble.build_report"] = report
     results["fleet_assemble.merge_runs"] = fa.merge_runs(snapshots)
 
+    # -- lifecycle.* ---------------------------------------------------------
+    from dragonfly2_tpu.lifecycle import arbitrate_candidates, plan_epoch
+
+    results["lifecycle.epoch_plan"] = [
+        plan_epoch(
+            records_seen=seen,
+            watermark=mark,
+            epoch_records=256,
+            candidate_in_flight=busy,
+        )
+        for seen, mark, busy in [
+            (100, 0, False),
+            (300, 0, False),
+            (300, 0, True),
+            (900, 512, False),
+        ]
+    ]
+    # Reports built by iterating a SET of keys — arbitration output must
+    # not depend on dict-insertion/hash order.
+    lc_reports = {}
+    for key in {"global", "idc-a", "idc-b", "idc-c"}:
+        rk = {
+            "global": 0.30, "idc-a": 0.21, "idc-b": 0.35, "idc-c": 0.29,
+        }[key]
+        lc_reports[key] = {
+            "joined_edges": 10 if key == "idc-c" else 120,
+            "regret_at_k": {"candidate": rk, "active": 0.33, "k": 4},
+        }
+    results["lifecycle.arbitrate"] = arbitrate_candidates(
+        lc_reports, min_joined=50, margin=0.02
+    )
+
     # -- trace_assemble.* ----------------------------------------------------
     traces = ta.assemble(spans)
     results["trace_assemble.critical_path"] = {

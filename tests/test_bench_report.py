@@ -18,6 +18,8 @@ if str(REPO) not in sys.path:
 from tools.bench_report import (  # noqa: E402
     DOWNLOAD_BEGIN,
     DOWNLOAD_END,
+    LIFECYCLE_BEGIN,
+    LIFECYCLE_END,
     QOS_BEGIN,
     QOS_END,
     SWARM_BEGIN,
@@ -27,11 +29,13 @@ from tools.bench_report import (  # noqa: E402
     TRAJECTORY_BEGIN,
     TRAJECTORY_END,
     collect_download_rounds,
+    collect_lifecycle_rounds,
     collect_qos_rounds,
     collect_rounds,
     collect_swarm_rounds,
     collect_telemetry_rounds,
     render_download,
+    render_lifecycle,
     render_qos,
     render_swarm,
     render_telemetry,
@@ -140,6 +144,40 @@ class TestTrajectoryStaleness:
         )
         for data in qos_rounds:
             assert f"| r{data['round']:02d} |" in committed
+
+    def test_committed_lifecycle_table_is_current(self):
+        """Same staleness gate for the self-driving-lifecycle rounds
+        (tools/bench_lifecycle.py → BENCH_LC_r*.json)."""
+        lc_rounds = collect_lifecycle_rounds(REPO)
+        assert lc_rounds, "no BENCH_LC_r*.json rounds found at the repo root"
+        text = (REPO / "BENCHMARKS.md").read_text(encoding="utf-8")
+        begin = text.find(LIFECYCLE_BEGIN)
+        end = text.find(LIFECYCLE_END)
+        assert begin >= 0 and end > begin, (
+            "BENCHMARKS.md lifecycle markers missing"
+        )
+        committed = text[begin : end + len(LIFECYCLE_END)]
+        fresh = render_lifecycle(lc_rounds)
+        assert committed == fresh, (
+            "BENCHMARKS.md lifecycle table is stale — regenerate with "
+            "`python -m tools.bench_report --update`"
+        )
+        for data in lc_rounds:
+            assert f"| r{data['round']:02d} |" in committed
+
+    def test_lifecycle_round_holds_the_acceptance_evidence(self):
+        """ISSUE 19 acceptance: every committed round's drill promoted
+        unattended, rolled the injected regression back, and resumed the
+        bounce to exactly one ACTIVE."""
+        for data in collect_lifecycle_rounds(REPO):
+            assert data["ok"] is True, data.get("error")
+            assert data["drill_ok"] is True
+            stages = data["stages"]
+            assert stages["stage1"]["active_version"] == 1
+            assert stages["stage2"]["rolled_back"] is True
+            assert stages["stage2"]["active_version"] == 1
+            assert stages["stage3"]["active_count"] == 1
+            assert stages["stage3"]["promoted_resumed_candidate"] is True
 
     def test_qos_round_holds_the_isolation_evidence(self):
         """ISSUE 15 acceptance: the committed round's shaped burst moved

@@ -887,6 +887,45 @@ class TestMutationSensitivity:
 
 from tools.dflint.program import Program, witness_gaps  # noqa: E402
 
+# Session caches for the real-tree batteries: the parse + link of the
+# full dragonfly2_tpu/ tree dominates each whole-program view (~5s a
+# build, dozens of builds across this file).  Program and the analyses
+# treat Modules as read-only (same shareability argument as
+# _real_tree_modules below), so the pristine tree is loaded and linked
+# ONCE; mutation tests swap in a single re-parsed Module and relink.
+_DF_TREE_MODULES = None
+_DF_TREE_PROGRAM = None
+
+
+def _df_tree_modules():
+    global _DF_TREE_MODULES
+    if _DF_TREE_MODULES is None:
+        from tools.dflint.core import collect_files, load_module
+
+        _DF_TREE_MODULES = [
+            load_module(p, REPO)
+            for p in collect_files([REPO / "dragonfly2_tpu"], REPO)
+        ]
+    return _DF_TREE_MODULES
+
+
+def _df_tree_program() -> Program:
+    """The pristine whole-tree Program, linked once and shared."""
+    global _DF_TREE_PROGRAM
+    if _DF_TREE_PROGRAM is None:
+        _DF_TREE_PROGRAM = Program(list(_df_tree_modules()))
+    return _DF_TREE_PROGRAM
+
+
+def _df_tree_program_with(relpath: str, source: str) -> Program:
+    """Whole-tree Program with ONE file's text replaced (mutation
+    batteries): only the mutated file re-parses."""
+    modules = [
+        Module(m.path, m.relpath, source) if m.relpath == relpath else m
+        for m in _df_tree_modules()
+    ]
+    return Program(modules)
+
 
 def prog(files: dict) -> Program:
     """Build a whole-program view over an in-memory fixture tree."""
@@ -1505,17 +1544,10 @@ class TestProgramMutationSensitivity:
     """Satellite: DF008/DF009 against (copies of) the REAL tree."""
 
     def _program_with_source(self, relpath: str, source: str) -> Program:
-        from tools.dflint.core import collect_files
-
-        modules = []
-        for path in collect_files([REPO / "dragonfly2_tpu"], REPO):
-            rel = path.resolve().relative_to(REPO).as_posix()
-            text = source if rel == relpath else path.read_text(encoding="utf-8")
-            modules.append(Module(path, rel, text))
-        return Program(modules)
+        return _df_tree_program_with(relpath, source)
 
     def test_real_tree_is_clean(self):
-        p = Program.from_paths([REPO / "dragonfly2_tpu"], REPO)
+        p = _df_tree_program()
         assert p.findings() == [], "\n".join(f.render() for f in p.findings())
 
     def test_wrapping_retry_call_in_held_lock_fails_df008(self):
@@ -1523,12 +1555,12 @@ class TestProgramMutationSensitivity:
         # phase moved back under _refresh_mu.
         relpath = "dragonfly2_tpu/scheduler/model_loader.py"
         source = (REPO / relpath).read_text(encoding="utf-8")
-        needle = "            active = self._fetch_active(loaded_version)"
+        needle = "            active = self._fetch_active(loaded)"
         assert needle in source
         mutated = source.replace(
             needle,
             "            with self._refresh_mu:\n"
-            "                active = self._fetch_active(loaded_version)",
+            "                active = self._fetch_active(loaded)",
         )
         p = self._program_with_source(relpath, mutated)
         df8 = [f for f in p.findings() if f.rule == "DF008"]
@@ -1666,7 +1698,7 @@ class TestLockGraphStaleness:
             LOCK_GRAPH_BEGIN, LOCK_GRAPH_END, render_lock_graph,
         )
 
-        program = Program.from_paths([REPO / "dragonfly2_tpu"], REPO)
+        program = _df_tree_program()
         text = (REPO / "DESIGN.md").read_text(encoding="utf-8")
         begin = text.find(LOCK_GRAPH_BEGIN)
         end = text.find(LOCK_GRAPH_END)
@@ -2172,21 +2204,11 @@ class TestTraceMutationSensitivity:
     float64 must each fail BY RULE NAME."""
 
     def _analyze_with(self, relpath: str, mutated: str) -> TraceAnalysis:
-        from tools.dflint.core import collect_files, load_module
-
-        modules = []
-        for path in collect_files([REPO / "dragonfly2_tpu"], REPO):
-            m = load_module(path, REPO)
-            if m.relpath == relpath:
-                m = Module(path, relpath, mutated)
-            modules.append(m)
-        return TraceAnalysis(Program(modules), REPO)
+        return TraceAnalysis(_df_tree_program_with(relpath, mutated), REPO)
 
     @pytest.fixture(scope="class")
     def real_analysis(self):
-        return TraceAnalysis(
-            Program.from_paths([REPO / "dragonfly2_tpu"], REPO), REPO
-        )
+        return TraceAnalysis(_df_tree_program(), REPO)
 
     def test_real_tree_is_clean(self, real_analysis):
         assert real_analysis.findings() == []
@@ -2252,9 +2274,7 @@ class TestTraceMutationSensitivity:
 
 class TestCompileBudgetFile:
     def test_checked_in_budget_is_current(self):
-        analysis = TraceAnalysis(
-            Program.from_paths([REPO / "dragonfly2_tpu"], REPO), REPO
-        )
+        analysis = TraceAnalysis(_df_tree_program(), REPO)
         gaps = budget_staleness(analysis, load_budget())
         assert not gaps, "\n".join(gaps)
 
@@ -3182,21 +3202,11 @@ class TestStateMutationSensitivity:
     RULE NAME."""
 
     def _analyze_with(self, relpath: str, mutated: str) -> StateAnalysis:
-        from tools.dflint.core import collect_files, load_module
-
-        modules = []
-        for path in collect_files([REPO / "dragonfly2_tpu"], REPO):
-            m = load_module(path, REPO)
-            if m.relpath == relpath:
-                m = Module(path, relpath, mutated)
-            modules.append(m)
-        return StateAnalysis(Program(modules), REPO)
+        return StateAnalysis(_df_tree_program_with(relpath, mutated), REPO)
 
     @pytest.fixture(scope="class")
     def real_state(self):
-        return StateAnalysis(
-            Program.from_paths([REPO / "dragonfly2_tpu"], REPO), REPO
-        )
+        return StateAnalysis(_df_tree_program(), REPO)
 
     def test_real_tree_is_clean(self, real_state):
         assert real_state.findings() == [], [
@@ -3303,9 +3313,7 @@ class TestFsmGraphStaleness:
             FSM_GRAPH_BEGIN, FSM_GRAPH_END, render_fsm_graph,
         )
 
-        analysis = StateAnalysis(
-            Program.from_paths([REPO / "dragonfly2_tpu"], REPO), REPO
-        )
+        analysis = StateAnalysis(_df_tree_program(), REPO)
         text = (REPO / "DESIGN.md").read_text(encoding="utf-8")
         begin = text.find(FSM_GRAPH_BEGIN)
         end = text.find(FSM_GRAPH_END)
@@ -3332,9 +3340,7 @@ class TestFsmGraphStaleness:
         assert "stale" not in body and "tail" in body
 
     def test_graph_renders_every_declared_machine(self):
-        analysis = StateAnalysis(
-            Program.from_paths([REPO / "dragonfly2_tpu"], REPO), REPO
-        )
+        analysis = StateAnalysis(_df_tree_program(), REPO)
         md = analysis.fsm_graph_markdown()
         dot = analysis.fsm_graph_dot()
         for key in ("peer", "task", "model_state", "rollout_phase"):

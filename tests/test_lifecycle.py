@@ -1,0 +1,513 @@
+"""Self-driving model lifecycle plane (ISSUE 19, DESIGN.md §29).
+
+Covers the subsystem end to end:
+
+- arbiter decision kernel: epoch cadence (plan_epoch) and the
+  global-vs-regional CANARY admission gate (arbitrate_candidates),
+  including input-order determinism — both are DF018 replay roots;
+- LifecycleStore durability: row defaults, resume-from-backend, the
+  bounded promotion-history tail;
+- LifecycleDaemon units: epoch deferral without a full batch, the
+  crash-between-register-and-begin re-entry, regional arbitration
+  retiring a specialization that buys nothing;
+- the zero-human acceptance drill (sim/lifecycle.py): unattended
+  train→export→register→SHADOW→CANARY→ACTIVE, injected-regression
+  auto-rollback, bounce-resume to exactly one ACTIVE;
+- ModelSubscriber regional keys: a scheduler serves ITS region's
+  promoted specialization and every other region keeps the global arm
+  (no cross-region bleed), with per-key version bookkeeping;
+- tools/bench_lifecycle.py --smoke JSON schema gate (tier-1).
+
+The HA leader-kill-mid-promotion chaos drill lives in
+tests/test_lifecycle_failover.py.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+from dragonfly2_tpu.lifecycle import (
+    GLOBAL_KEY,
+    LifecycleConfig,
+    LifecycleDaemon,
+    LifecycleStore,
+    arbitrate_candidates,
+    plan_epoch,
+    regional_model_name,
+)
+from dragonfly2_tpu.lifecycle.state import HISTORY_KEEP
+from dragonfly2_tpu.manager import ModelRegistry, ModelState
+from dragonfly2_tpu.manager.state import MemoryBackend
+from dragonfly2_tpu.records.features import DOWNLOAD_FEATURE_DIM
+from dragonfly2_tpu.rollout import (
+    LocalRolloutClient,
+    RolloutController,
+    RolloutGuardrails,
+)
+from dragonfly2_tpu.scheduler import MLEvaluator, ModelSubscriber
+from dragonfly2_tpu.sim.lifecycle import (
+    LifecycleDrillConfig,
+    _World,
+    run_lifecycle_drill,
+)
+from dragonfly2_tpu.trainer.export import MLPScorer, scorer_to_bytes
+from dragonfly2_tpu.trainer.streaming import StreamingConfig, StreamingTrainer
+
+MODEL_NAME = "parent-bandwidth-mlp"
+
+
+def _mk_scorer(seed):
+    rng = np.random.default_rng(seed)
+    dims = (DOWNLOAD_FEATURE_DIM, 16, 1)
+    weights = [
+        (
+            rng.standard_normal((dims[i], dims[i + 1])).astype(np.float32) * 0.3,
+            rng.standard_normal(dims[i + 1]).astype(np.float32) * 0.05,
+        )
+        for i in range(len(dims) - 1)
+    ]
+    return MLPScorer(weights=weights)
+
+
+def _shadow_report(joined=500, regret=0.05):
+    return {
+        "joined_edges": joined,
+        "announces": joined // 4,
+        "regret_at_k": {"k": 4, "candidate": regret, "active": 0.3},
+        "inversion_rate": {"pairs": joined, "candidate": 0.1, "active": 0.3},
+        "psi_max": 0.01,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Arbiter: the pure decision kernel (DF018 replay roots)
+# ---------------------------------------------------------------------------
+
+
+class TestPlanEpoch:
+    def test_holds_below_cadence(self):
+        plan = plan_epoch(records_seen=100, watermark=0, epoch_records=256,
+                          candidate_in_flight=False)
+        assert plan["train"] is False
+        assert plan["watermark"] == 0
+        assert "100/256" in plan["reason"]
+
+    def test_cuts_when_cadence_reached_and_advances_watermark(self):
+        plan = plan_epoch(records_seen=300, watermark=0, epoch_records=256,
+                          candidate_in_flight=False)
+        assert plan["train"] is True
+        assert plan["watermark"] == 300  # next epoch measures from HERE
+
+    def test_one_candidate_in_flight_blocks_the_next_epoch(self):
+        plan = plan_epoch(records_seen=10_000, watermark=0, epoch_records=256,
+                          candidate_in_flight=True)
+        assert plan["train"] is False
+        assert plan["reason"] == "candidate still in flight"
+
+    def test_disabled_cadence_never_trains(self):
+        plan = plan_epoch(records_seen=10_000, watermark=0, epoch_records=0,
+                          candidate_in_flight=False)
+        assert plan["train"] is False
+
+
+class TestArbitrateCandidates:
+    def test_thin_evidence_holds(self):
+        verdict = arbitrate_candidates(
+            {GLOBAL_KEY: _shadow_report(joined=10)}, min_joined=50,
+        )
+        assert verdict["advance"] == []
+        assert verdict["hold"] == {GLOBAL_KEY: "10/50 joined samples"}
+        assert verdict["retire"] == {}
+
+    def test_regional_must_beat_global_by_margin_ties_go_to_global(self):
+        verdict = arbitrate_candidates(
+            {
+                GLOBAL_KEY: _shadow_report(regret=0.30),
+                "idc-a": _shadow_report(regret=0.21),   # beats by > 0.02
+                "idc-b": _shadow_report(regret=0.29),   # within the margin
+            },
+            min_joined=50, margin=0.02,
+        )
+        assert verdict["advance"] == [GLOBAL_KEY, "idc-a"]
+        assert "idc-b" in verdict["retire"]
+        assert "does not beat global" in verdict["retire"]["idc-b"]
+
+    def test_global_retired_only_when_beaten_everywhere(self):
+        verdict = arbitrate_candidates(
+            {
+                GLOBAL_KEY: _shadow_report(regret=0.50),
+                "idc-a": _shadow_report(regret=0.10),
+                "idc-b": _shadow_report(regret=0.20),
+            },
+            min_joined=50, margin=0.02,
+        )
+        assert verdict["advance"] == ["idc-a", "idc-b"]
+        assert GLOBAL_KEY in verdict["retire"]
+
+    def test_regional_without_global_candidate_advances(self):
+        verdict = arbitrate_candidates(
+            {"idc-a": _shadow_report(regret=0.4)}, min_joined=50,
+        )
+        assert verdict["advance"] == ["idc-a"]
+        assert verdict["retire"] == {}
+
+    def test_verdict_ignores_input_insertion_order(self):
+        """The replay root must be a pure function of the report VALUES:
+        two daemons assembling the same reports in different dict orders
+        (hash-seed skew) must emit byte-identical verdicts (DF019)."""
+        reports = {
+            GLOBAL_KEY: _shadow_report(regret=0.30),
+            "idc-a": _shadow_report(regret=0.21),
+            "idc-b": _shadow_report(regret=0.35),
+            "idc-c": _shadow_report(joined=10),
+        }
+        forward = arbitrate_candidates(dict(reports))
+        reversed_order = arbitrate_candidates(
+            {k: reports[k] for k in reversed(list(reports))}
+        )
+        assert json.dumps(forward, sort_keys=True) == json.dumps(
+            reversed_order, sort_keys=True
+        )
+
+
+class TestRegionalModelName:
+    def test_global_key_is_the_bare_name(self):
+        assert regional_model_name(MODEL_NAME, None) == MODEL_NAME
+        assert regional_model_name(MODEL_NAME, GLOBAL_KEY) == MODEL_NAME
+
+    def test_regions_compose_the_registry_key(self):
+        assert regional_model_name(MODEL_NAME, "idc-a") == f"{MODEL_NAME}@idc-a"
+
+
+# ---------------------------------------------------------------------------
+# LifecycleStore: the DF014 `lifecycle` namespace
+# ---------------------------------------------------------------------------
+
+
+class TestLifecycleStore:
+    def test_unknown_key_returns_a_default_row(self):
+        store = LifecycleStore(MemoryBackend())
+        row = store.row("global")
+        assert row == {"epoch": 0, "watermark": 0, "candidate_id": "",
+                       "candidate_version": 0, "history": []}
+
+    def test_rows_survive_a_reload_from_the_backend(self):
+        backend = MemoryBackend()
+        store = LifecycleStore(backend)
+        store.update("global", epoch=3, watermark=4096,
+                     candidate_id="m-7", candidate_version=7)
+        store.append_history("global", {"epoch": 3, "event": "registered"})
+        resumed = LifecycleStore(backend)  # the manager bounce
+        row = resumed.row("global")
+        assert row["epoch"] == 3 and row["watermark"] == 4096
+        assert resumed.candidate("global") == "m-7"
+        assert row["history"] == [{"epoch": 3, "event": "registered"}]
+
+    def test_history_tail_is_bounded(self):
+        store = LifecycleStore(MemoryBackend())
+        for i in range(HISTORY_KEEP + 20):
+            store.append_history("global", {"epoch": i, "event": "registered"})
+        history = store.row("global")["history"]
+        assert len(history) == HISTORY_KEEP
+        assert history[-1]["epoch"] == HISTORY_KEEP + 19  # newest kept
+
+    def test_cleared_candidate_reads_as_none(self):
+        store = LifecycleStore(MemoryBackend())
+        store.update("global", candidate_id="m-1")
+        store.update("global", candidate_id="")
+        assert store.candidate("global") is None
+
+
+# ---------------------------------------------------------------------------
+# LifecycleDaemon units
+# ---------------------------------------------------------------------------
+
+
+def _drill_cfg(**kw):
+    kw.setdefault("epoch_records", 128)
+    kw.setdefault("batch_size", 32)
+    kw.setdefault("announces", 24)
+    kw.setdefault("parents", 4)
+    kw.setdefault("min_shadow_samples", 40)
+    kw.setdefault("min_canary_samples", 40)
+    return LifecycleDrillConfig(**kw)
+
+
+def _small_trainer(_key):
+    return StreamingTrainer(
+        StreamingConfig(batch_size=32, warmup_steps=4, learning_rate=3e-3,
+                        snapshot_rows=512, seed=11)
+    )
+
+
+def _replay_source_for(registry, world, cfg, sid):
+    """The sim drill's honest read side, re-pointed at ``registry``:
+    scores REAL exported blobs and accumulates per candidate version so
+    the controller sees joined counts grow across pumps."""
+    from dragonfly2_tpu.trainer.export import load_scorer
+
+    acc = {}
+
+    def source(key):
+        name = regional_model_name(cfg.model_name, key)
+        cand = registry.candidate_model(sid, name)
+        if cand is None:
+            return None
+        active = registry.active_model(sid, name)
+        shadow, dl, _ = world.shadow_batch(
+            load_scorer(registry.load_artifact(cand)), cand.version,
+            load_scorer(registry.load_artifact(active)) if active else None,
+            active.version if active else 0,
+        )
+        slot = acc.get(key)
+        if slot is None or slot["version"] != cand.version:
+            slot = {"version": cand.version, "shadow": [], "dl": []}
+            acc[key] = slot
+        slot["shadow"].append(shadow)
+        slot["dl"].append(dl)
+        return (np.concatenate(slot["shadow"]), np.concatenate(slot["dl"]))
+
+    return source
+
+
+class TestLifecycleDaemon:
+    def test_epoch_defers_until_a_full_batch_lands(self):
+        """Cadence fires on record count but the trainer needs one full
+        batch: a thin feed leaves the watermark so the epoch re-fires
+        once the rest arrives, instead of exporting an untrained net."""
+        backend = MemoryBackend()
+        registry = ModelRegistry(backend=backend)
+        controller = RolloutController(registry, backend=backend)
+        world = _World(_drill_cfg())
+        daemon = LifecycleDaemon(
+            registry, LocalRolloutClient(controller),
+            config=LifecycleConfig(scheduler_id="s1", epoch_records=16),
+            backend=backend, trainer_factory=_small_trainer,
+        )
+        daemon.feed(world.record_rows(20))  # past cadence, below batch 32
+        assert daemon.step()["epochs"] == []
+        assert daemon.store.row(GLOBAL_KEY)["epoch"] == 0
+        daemon.feed(world.record_rows(44))
+        assert daemon.step()["epochs"], "deferred epoch never re-fired"
+        assert daemon.store.row(GLOBAL_KEY)["epoch"] == 1
+        assert registry.candidate_model("s1", daemon.config.model_name)
+
+    def test_orphan_shadow_candidate_is_reentered(self):
+        """A candidate that reached SHADOW without a rollout row (crash
+        between create_model and begin on a remote manager): the report
+        KeyErrors and the daemon re-begins the rollout."""
+        registry = ModelRegistry()
+        m1 = registry.create_model(name=MODEL_NAME, type="mlp",
+                                   scheduler_id="s1",
+                                   artifact=scorer_to_bytes(_mk_scorer(1)))
+        registry.activate(m1.id)
+        controller = RolloutController(registry)
+        m2 = registry.create_model(name=MODEL_NAME, type="mlp",
+                                   scheduler_id="s1",
+                                   artifact=scorer_to_bytes(_mk_scorer(2)))
+        # The tear: SHADOW in the registry, no rollout row anywhere.
+        registry.set_state(m2.id, ModelState.SHADOW)
+        assert controller.get("s1", MODEL_NAME) is None
+        world = _World(_drill_cfg())
+        cfg = _drill_cfg()
+        daemon = LifecycleDaemon(
+            registry, LocalRolloutClient(controller),
+            config=LifecycleConfig(scheduler_id="s1", min_joined=10),
+            backend=MemoryBackend(), trainer_factory=_small_trainer,
+            replay_source=_replay_source_for(world=world, registry=registry,
+                                             cfg=cfg, sid="s1"),
+        )
+        daemon.pump_rollouts()
+        repaired = controller.get("s1", MODEL_NAME)
+        assert repaired is not None and repaired.model_id == m2.id
+        assert repaired.phase == "shadow"
+
+    def test_arbitration_retires_a_specialization_that_buys_nothing(self):
+        """Regional arm trained on the SAME records as the global arm:
+        identical quality cannot beat global by the margin, so the
+        arbiter retires it before CANARY and the global candidate walks
+        to ACTIVE alone."""
+        cfg = _drill_cfg()
+        world = _World(cfg)
+        backend = MemoryBackend()
+        registry = ModelRegistry(backend=backend)
+        controller = RolloutController(
+            registry, backend=backend,
+            guardrails=RolloutGuardrails(min_shadow_samples=40,
+                                         min_canary_samples=40),
+        )
+        daemon = LifecycleDaemon(
+            registry, LocalRolloutClient(controller),
+            config=LifecycleConfig(
+                scheduler_id="s1", regions=("idc-a",), epoch_records=128,
+                max_steps_per_epoch=20, min_joined=10,
+                arbitration_margin=0.25,
+            ),
+            backend=backend, trainer_factory=_small_trainer,
+            replay_source=_replay_source_for(world=world, registry=registry,
+                                             cfg=cfg, sid="s1"),
+        )
+        regional_name = f"{daemon.config.model_name}@idc-a"
+        daemon.feed(world.record_rows(160), region="idc-a")
+        for _ in range(6):
+            daemon.step()
+            if registry.active_model("s1", daemon.config.model_name):
+                break
+        assert registry.active_model("s1", daemon.config.model_name), (
+            "global candidate never promoted"
+        )
+        # The specialization was retired, not promoted and not left
+        # dangling: no ACTIVE, no candidate under the regional key.
+        assert registry.active_model("s1", regional_name) is None
+        assert registry.candidate_model("s1", regional_name) is None
+        events = [h["event"] for h in daemon.store.row("idc-a")["history"]]
+        assert "arbitration_retired" in events
+        assert daemon.store.candidate("idc-a") is None
+
+
+# ---------------------------------------------------------------------------
+# The zero-human acceptance drill (sim/lifecycle.py)
+# ---------------------------------------------------------------------------
+
+
+class TestLifecycleDrill:
+    def test_full_loop_regression_and_bounce_resume(self):
+        out = run_lifecycle_drill(_drill_cfg())
+        assert out["ok"], out
+        s1, s2, s3 = out["stage1"], out["stage2"], out["stage3"]
+        # Stage 1: train→export→register→SHADOW→CANARY→ACTIVE, no hands.
+        assert s1["active_version"] == 1 and s1["candidate_clear"]
+        # Stage 2: the inverted head was caught by the REAL guardrails
+        # and stage 1's model stayed ACTIVE (last-good).
+        assert s2["rolled_back"] and s2["active_version"] == 1
+        assert "regression" in s2["rollback_reason"]
+        # Stage 3: the bounce resumed — same epoch counter (no retrain),
+        # the in-flight candidate promoted, exactly one ACTIVE row with
+        # a digest-verified artifact.
+        assert s3["had_in_flight"] and s3["promoted_resumed_candidate"]
+        assert s3["resumed_epoch"] == s3["pre_bounce_epoch"]
+        assert s3["active_count"] == 1 and s3["artifact_ok"]
+        # Promotion lineage landed in the durable history.
+        assert out["events"][:3] == ["registered", "advance", "promote"]
+        assert "rollback" in out["events"]
+        assert out["events"][-1] == "promote"
+
+
+# ---------------------------------------------------------------------------
+# ModelSubscriber regional keys: no cross-region bleed (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestModelSubscriberRegionalKeys:
+    def _registry_two_arms(self):
+        reg = ModelRegistry()
+        mg = reg.create_model(name=MODEL_NAME, type="mlp", scheduler_id="s1",
+                              artifact=scorer_to_bytes(_mk_scorer(1)))
+        reg.activate(mg.id)
+        ma = reg.create_model(name=f"{MODEL_NAME}@idc-a", type="mlp",
+                              scheduler_id="s1",
+                              artifact=scorer_to_bytes(_mk_scorer(2)))
+        reg.activate(ma.id)
+        return reg, mg, ma
+
+    def test_region_serves_its_promoted_specialization(self):
+        reg, _mg, ma = self._registry_two_arms()
+        sub = ModelSubscriber(reg, MLEvaluator(None), scheduler_id="s1",
+                              idc="idc-a")
+        assert sub.refresh() is True
+        assert sub._loaded_key == f"{MODEL_NAME}@idc-a"
+        assert sub._loaded_version == ma.version
+
+    def test_no_cross_region_bleed(self):
+        """idc-a's specialization must never reach idc-b (or an
+        idc-less scheduler): they only ever ask for their own two
+        names and fall back to the global arm."""
+        reg, mg, _ma = self._registry_two_arms()
+        for idc in ("idc-b", None):
+            sub = ModelSubscriber(reg, MLEvaluator(None), scheduler_id="s1",
+                                  idc=idc)
+            assert sub.refresh() is True
+            assert sub._loaded_key == MODEL_NAME
+            assert sub._loaded_version == mg.version
+
+    def test_versions_never_compare_across_keys(self):
+        """A NEWER global version must not displace a region's loaded
+        specialization: versions are per-(scheduler_id, name) counters,
+        so the scoped poll wins regardless of version arithmetic."""
+        reg, _mg, ma = self._registry_two_arms()
+        sub = ModelSubscriber(reg, MLEvaluator(None), scheduler_id="s1",
+                              idc="idc-a")
+        sub.refresh()
+        mg2 = reg.create_model(name=MODEL_NAME, type="mlp", scheduler_id="s1",
+                               artifact=scorer_to_bytes(_mk_scorer(3)))
+        reg.activate(mg2.id)
+        assert mg2.version > ma.version
+        assert sub.refresh() is False  # no swap: the scoped arm still wins
+        assert sub._loaded_key == f"{MODEL_NAME}@idc-a"
+        assert sub._loaded_version == ma.version
+
+    def test_retired_specialization_falls_back_to_global(self):
+        reg, mg, ma = self._registry_two_arms()
+        sub = ModelSubscriber(reg, MLEvaluator(None), scheduler_id="s1",
+                              idc="idc-a")
+        sub.refresh()
+        reg.deactivate(ma.id)
+        assert sub.refresh() is True
+        assert sub._loaded_key == MODEL_NAME
+        assert sub._loaded_version == mg.version
+
+    def test_regional_candidate_scopes_shadow_and_reports(self):
+        """A regional candidate in flight shadow-scores in ITS region
+        only, and candidate_name hands the reporter the scoped key so
+        the controller judges the right rollout row."""
+        reg, _mg, _ma = self._registry_two_arms()
+        controller = RolloutController(reg)
+        client = LocalRolloutClient(controller)
+        m3 = reg.create_model(name=f"{MODEL_NAME}@idc-a", type="mlp",
+                              scheduler_id="s1",
+                              artifact=scorer_to_bytes(_mk_scorer(4)))
+        controller.begin(m3.id)
+        ml_a, ml_b = MLEvaluator(None), MLEvaluator(None)
+        sub_a = ModelSubscriber(reg, ml_a, scheduler_id="s1", idc="idc-a",
+                                rollout_client=client)
+        sub_b = ModelSubscriber(reg, ml_b, scheduler_id="s1", idc="idc-b",
+                                rollout_client=client)
+        sub_a.refresh()
+        sub_b.refresh()
+        assert ml_a.shadow is not None
+        assert sub_a.candidate_name == f"{MODEL_NAME}@idc-a"
+        assert ml_b.shadow is None, "idc-a's candidate bled into idc-b"
+        assert sub_b.candidate_name == MODEL_NAME
+        sub_a.stop()
+        sub_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# bench_lifecycle smoke: the tier-1 JSON schema gate
+# ---------------------------------------------------------------------------
+
+
+class TestBenchLifecycleSmoke:
+    def test_smoke_emits_schema_json(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "bench_lifecycle.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = proc.stdout.strip().splitlines()[-1]
+        out = json.loads(line)
+        sys.path.insert(0, str(REPO / "tools"))
+        try:
+            from bench_lifecycle import SCHEMA_KEYS
+        finally:
+            sys.path.pop(0)
+        assert all(k in out for k in SCHEMA_KEYS), out
+        assert out["ok"] is True and out["drill_ok"] is True
+        assert out["records_per_sec"] > 0

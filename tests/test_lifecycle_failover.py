@@ -1,0 +1,225 @@
+"""Lifecycle HA chaos drill (ISSUE 19 satellite): kill the manager
+leader mid-promotion and let the promoted standby's reconciler resume.
+
+The tear under test is the worst one the promotion path can take: the
+registry's CANARY flip is committed (and replicated) but the injected
+fault drops the rollout-row persist — the leader dies with the two
+tables disagreeing.  The promoted standby must:
+
+- repair the rollout row to the registry's phase (``_reconcile``);
+- hand the resumed daemon its watermark and in-flight candidate back
+  from the replicated ``lifecycle`` namespace (no retrain);
+- finish the walk to exactly one ACTIVE per (region, name) — the
+  arbitration-retired regional arm stays retired — with the artifact
+  still digest-verified.
+
+Built on the in-process HA idioms of tests/test_replication.py: a
+shared fake clock, a leader ``ReplicatedStateBackend`` tailed over REST
+by a ``LogFollower``, and lease-expiry promotion.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tests.test_replication import _Clock, _leader, _rest_for, _standby
+
+from dragonfly2_tpu.lifecycle import (
+    GLOBAL_KEY,
+    LifecycleConfig,
+    LifecycleDaemon,
+    LifecycleStore,
+    regional_model_name,
+)
+from dragonfly2_tpu.manager.registry import KVBlobStore, ModelRegistry
+from dragonfly2_tpu.manager.replication import LogFollower
+from dragonfly2_tpu.manager.state import MemoryBackend
+from dragonfly2_tpu.manager import ModelState
+from dragonfly2_tpu.rollout import (
+    LocalRolloutClient,
+    RolloutController,
+    RolloutGuardrails,
+)
+from dragonfly2_tpu.sim.lifecycle import LifecycleDrillConfig, _World
+from dragonfly2_tpu.trainer.export import load_scorer
+from dragonfly2_tpu.trainer.streaming import StreamingConfig, StreamingTrainer
+from dragonfly2_tpu.utils import faultinject
+
+MODEL_NAME = "parent-bandwidth-mlp"
+SID = "scheduler-ha"
+REGION = "idc-a"
+
+
+def _drill_world():
+    return _World(LifecycleDrillConfig(
+        seed=11, scheduler_id=SID, epoch_records=128, batch_size=32,
+        announces=24, parents=4,
+    ))
+
+
+def _trainer(_key):
+    return StreamingTrainer(
+        StreamingConfig(batch_size=32, warmup_steps=4, learning_rate=3e-3,
+                        snapshot_rows=512, seed=11)
+    )
+
+
+def _replay_source(registry, world):
+    """Honest read side (same shape as sim/lifecycle.py): score the REAL
+    registry blobs, accumulate per candidate version so joined counts
+    grow across pumps."""
+    acc = {}
+
+    def source(key):
+        name = regional_model_name(MODEL_NAME, key)
+        cand = registry.candidate_model(SID, name)
+        if cand is None:
+            return None
+        active = registry.active_model(SID, name)
+        shadow, dl, _ = world.shadow_batch(
+            load_scorer(registry.load_artifact(cand)), cand.version,
+            load_scorer(registry.load_artifact(active)) if active else None,
+            active.version if active else 0,
+        )
+        slot = acc.get(key)
+        if slot is None or slot["version"] != cand.version:
+            slot = {"version": cand.version, "shadow": [], "dl": []}
+            acc[key] = slot
+        slot["shadow"].append(shadow)
+        slot["dl"].append(dl)
+        return (np.concatenate(slot["shadow"]), np.concatenate(slot["dl"]))
+
+    return source
+
+
+def _plane(backend, world):
+    """One manager+daemon composition over ``backend`` (the standby
+    builds a second one after promotion — the 'manager process')."""
+    registry = ModelRegistry(KVBlobStore(backend), backend=backend)
+    controller = RolloutController(
+        registry, backend=backend,
+        guardrails=RolloutGuardrails(
+            min_shadow_samples=150, min_canary_samples=150, canary_percent=25,
+        ),
+    )
+    daemon = LifecycleDaemon(
+        registry, LocalRolloutClient(controller),
+        config=LifecycleConfig(
+            scheduler_id=SID, regions=(REGION,), epoch_records=128,
+            max_steps_per_epoch=20, min_joined=10, arbitration_margin=0.25,
+            canary_percent=25,
+        ),
+        backend=backend, trainer_factory=_trainer,
+        replay_source=_replay_source(registry, world),
+    )
+    return registry, controller, daemon
+
+
+class TestLeaderKillMidPromotion:
+    def test_promoted_standby_resumes_to_exactly_one_active(self):
+        clock = _Clock()
+        leader = _leader(clock)
+        world = _drill_world()
+        registry, controller, daemon = _plane(leader, world)
+        rest = _rest_for(leader, registry)
+        follower_backend = _standby(clock)
+        follower = LogFollower(
+            follower_backend, rest.url, clock=clock, poll_interval_s=0.05
+        )
+        regional_name = regional_model_name(MODEL_NAME, REGION)
+        try:
+            # Epoch 1 on BOTH arms: candidates registered, SHADOW begun.
+            # The same pump crosses the arbitration evidence floor
+            # (min_joined=10 < 96 joined) and retires the regional arm —
+            # same data → identical quality cannot beat global by the
+            # margin — while the global report HOLDS below the
+            # controller's 150-sample floor.
+            daemon.feed(world.record_rows(160), region=REGION)
+            daemon.step()
+            cand = registry.candidate_model(SID, MODEL_NAME)
+            assert cand is not None and cand.state is ModelState.SHADOW
+            assert registry.candidate_model(SID, regional_name) is None
+            assert daemon.store.candidate(GLOBAL_KEY) == cand.id
+
+            # The kill step: the global candidate's evidence crosses the
+            # floor and it advances — and the injected fault drops the
+            # rollout-row persist AFTER the registry's CANARY flip
+            # committed.  The daemon survives the failed report
+            # (retry-next-cycle), but we kill the leader before any
+            # retry.
+            inj = faultinject.FaultInjector([
+                faultinject.FaultSpec(site="state.put.rollouts", kind="drop",
+                                      at=(0,)),
+            ])
+            with faultinject.installed(inj):
+                daemon.step()
+            assert registry.get(cand.id).state is ModelState.CANARY
+            torn = leader.table("rollouts").load_all()[f"{SID}:{MODEL_NAME}"]
+            assert torn["phase"] == "shadow", (
+                "the drill needs the tear: registry CANARY, row SHADOW"
+            )
+            assert registry.candidate_model(SID, regional_name) is None
+
+            follower.poll_once()  # the standby tails everything committed
+        finally:
+            rest.stop()  # SIGKILL stand-in: the leader process is gone
+
+        # Lease ages out with the leader dark → the standby promotes.
+        clock.t = 30.0
+        follower.poll_once()
+        assert follower.promoted and follower_backend.role == "leader"
+
+        # The promoted manager boots a fresh plane over the replicated
+        # state.  The controller's reconciler repairs the torn row to
+        # the registry's phase; the daemon resumes from the lifecycle
+        # namespace instead of retraining.
+        registry2, controller2, daemon2 = _plane(follower_backend, world)
+        repaired = controller2.get(SID, MODEL_NAME)
+        assert repaired is not None and repaired.phase == "canary"
+        assert "reconciled" in repaired.reason
+        assert daemon2.store.candidate(GLOBAL_KEY) == cand.id
+        assert daemon2.store.row(GLOBAL_KEY)["watermark"] == 160
+        pre_models = len(registry2.list(scheduler_id=SID))
+
+        for _ in range(8):
+            daemon2.step()
+            if registry2.active_model(SID, MODEL_NAME) is not None:
+                break
+
+        # Exactly one ACTIVE per (region, name): the resumed candidate
+        # holds the global key, the retired specialization stays retired.
+        actives = registry2.list(
+            scheduler_id=SID, name=MODEL_NAME, state=ModelState.ACTIVE
+        )
+        assert [m.id for m in actives] == [cand.id]
+        assert registry2.list(
+            scheduler_id=SID, name=regional_name, state=ModelState.ACTIVE
+        ) == []
+        assert registry2.candidate_model(SID, regional_name) is None
+        # Digest-checked artifact: load_artifact verifies the sha256
+        # recorded at create_model against the replicated blob.
+        assert load_scorer(registry2.load_artifact(actives[0])) is not None
+        # Resume, not restart: same epoch counter, no re-registered
+        # models, candidate slot cleared, promotion in the lineage.
+        assert daemon2.store.row(GLOBAL_KEY)["epoch"] == 1
+        assert len(registry2.list(scheduler_id=SID)) == pre_models
+        assert daemon2.store.candidate(GLOBAL_KEY) is None
+        events = [h["event"] for h in daemon2.store.row(GLOBAL_KEY)["history"]]
+        assert events[0] == "registered" and events[-1] == "promote"
+
+
+class TestLifecycleRowsRideTheWAL:
+    def test_store_rows_replicate_and_reload_on_the_standby(self):
+        clock = _Clock()
+        leader = _leader(clock)
+        store = LifecycleStore(leader)
+        store.update(GLOBAL_KEY, epoch=2, watermark=2048, candidate_id="m-9",
+                     candidate_version=9)
+        store.append_history(GLOBAL_KEY, {"epoch": 2, "event": "registered"})
+        follower = _standby(clock)
+        follower.apply_ops(leader.log.entries_since(0))
+        resumed = LifecycleStore(follower)
+        row = resumed.row(GLOBAL_KEY)
+        assert row["epoch"] == 2 and row["watermark"] == 2048
+        assert resumed.candidate(GLOBAL_KEY) == "m-9"
+        assert row["history"] == [{"epoch": 2, "event": "registered"}]

@@ -49,12 +49,26 @@ from tools.dflint.tracerules import TraceAnalysis  # noqa: E402
 SOURCE_FILES = collect_files([REPO / "dragonfly2_tpu"], REPO)
 BASELINE = Baseline.load()
 
-_PROGRAM = Program.from_paths([REPO / "dragonfly2_tpu"], REPO)
+# Whole-tree view shared with test_dflint's session cache (read-only);
+# per-file checker runs are memoized so the staleness sweep below reuses
+# the parametrized tests' work instead of re-parsing the tree.
+from tests.test_dflint import _df_tree_program  # noqa: E402
+
+_PROGRAM = _df_tree_program()
 _TRACE = TraceAnalysis(_PROGRAM, REPO)
 _STATE = StateAnalysis(_PROGRAM, REPO)
 _PROGRAM_BY_PATH = defaultdict(list)
 for _f in _PROGRAM.findings() + _TRACE.findings() + _STATE.findings():
     _PROGRAM_BY_PATH[_f.path].append(_f)
+
+_CHECKED = {}
+
+
+def _per_file_findings(path):
+    if path not in _CHECKED:
+        module = load_module(path, REPO)
+        _CHECKED[path] = (module.relpath, run_checkers(module))
+    return _CHECKED[path]
 
 
 @pytest.mark.parametrize(
@@ -63,9 +77,9 @@ for _f in _PROGRAM.findings() + _TRACE.findings() + _STATE.findings():
     ids=[p.resolve().relative_to(REPO).as_posix() for p in SOURCE_FILES],
 )
 def test_dflint_clean(path):
-    module = load_module(path, REPO)
-    findings = run_checkers(module)
-    findings.extend(_PROGRAM_BY_PATH.get(module.relpath, []))
+    relpath, checked = _per_file_findings(path)
+    findings = list(checked)
+    findings.extend(_PROGRAM_BY_PATH.get(relpath, []))
     new, _accepted = BASELINE.split(findings)
     assert not new, "dflint findings:\n" + "\n".join(f.render() for f in new)
 
@@ -78,5 +92,5 @@ def test_no_stale_baseline_entries():
         + list(_STATE.findings())
     )
     for path in SOURCE_FILES:
-        findings.extend(run_checkers(load_module(path, REPO)))
+        findings.extend(_per_file_findings(path)[1])
     assert BASELINE.stale_keys(findings) == []

@@ -414,6 +414,14 @@ class TestUploadQoS:
             )
             with urllib.request.urlopen(req, timeout=10) as resp:
                 assert resp.status == 206 and len(resp.read()) == 512
+            # The sendfile arm bills in the handler thread's ``finally``,
+            # which can land a beat after the client drains the body.
+            deadline = time.monotonic() + 5.0
+            while (
+                um.tenant_bytes["t-req"] != 1024 + 512
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
             assert um.tenant_bytes["t-req"] == 1024 + 512
             assert um.tenant_bytes.get("t-owner", 0) == 0
         finally:

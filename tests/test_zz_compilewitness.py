@@ -56,10 +56,9 @@ def _witness():
 
 @pytest.fixture(scope="module")
 def analysis():
-    from tools.dflint.program import Program
+    from tests.test_dflint import _df_tree_program
 
-    program = Program.from_paths([REPO / "dragonfly2_tpu"], REPO)
-    return TraceAnalysis(program, REPO)
+    return TraceAnalysis(_df_tree_program(), REPO)
 
 
 def _drive_streaming_steps(n_steps: int = 3):
@@ -186,16 +185,9 @@ class TestCompileWitness:
         assert mutated != source
 
         # -- static half: DF010 fires on the mutated tree ------------------
-        from tools.dflint.core import Module, collect_files, load_module
-        from tools.dflint.program import Program
+        from tests.test_dflint import _df_tree_program_with
 
-        modules = []
-        for path in collect_files([REPO / "dragonfly2_tpu"], REPO):
-            m = load_module(path, REPO)
-            if m.relpath == relpath:
-                m = Module(path, relpath, mutated)
-            modules.append(m)
-        mutant_program = Program(modules)
+        mutant_program = _df_tree_program_with(relpath, mutated)
         mutant_findings = TraceAnalysis(mutant_program, REPO).findings()
         assert any(
             f.rule == "DF010" and f.path == relpath

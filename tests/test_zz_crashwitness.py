@@ -60,12 +60,10 @@ def _witness():
 
 @pytest.fixture(scope="module")
 def analysis():
-    from tools.dflint.program import Program
+    from tests.test_dflint import _df_tree_program
     from tools.dflint.staterules import StateAnalysis
 
-    return StateAnalysis(
-        Program.from_paths([REPO / "dragonfly2_tpu"], REPO), REPO
-    )
+    return StateAnalysis(_df_tree_program(), REPO)
 
 
 def _drive_workloads(tmp_path=None):
@@ -254,21 +252,15 @@ class TestCrashWitness:
         return mod.__dict__
 
     def test_put_many_split_fails_static_df014_by_name(self):
-        from tools.dflint.core import Module, collect_files
-        from tools.dflint.program import Program
+        from tests.test_dflint import _df_tree_program_with
         from tools.dflint.staterules import StateAnalysis
 
         mutated = (REPO / REGISTRY_RELPATH).read_text(encoding="utf-8").replace(
             PUT_MANY_NEEDLE, PUT_SPLIT_REPL
         )
-        modules = []
-        for path in collect_files([REPO / "dragonfly2_tpu"], REPO):
-            rel = path.resolve().relative_to(REPO).as_posix()
-            text = mutated if rel == REGISTRY_RELPATH else path.read_text(
-                encoding="utf-8"
-            )
-            modules.append(Module(path, rel, text))
-        a = StateAnalysis(Program(modules), REPO)
+        a = StateAnalysis(
+            _df_tree_program_with(REGISTRY_RELPATH, mutated), REPO
+        )
         hits = [
             f for f in a.findings()
             if f.rule == "DF014" and "multi-row site ModelRegistry._persist"

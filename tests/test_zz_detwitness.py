@@ -87,14 +87,11 @@ def _real_modules():
     whole-program view and the parse dominates the build."""
     global _REAL_MODULES
     if _REAL_MODULES is None:
-        from tools.dflint.core import collect_files, load_module
+        # Same roots as test_dflint's det battery — share its session
+        # cache (Modules are read-only to Program and the analyses).
+        from tests.test_dflint import _real_tree_modules
 
-        _REAL_MODULES = [
-            load_module(p, REPO)
-            for p in collect_files(
-                [REPO / "dragonfly2_tpu", REPO / "tools"], REPO
-            )
-        ]
+        _REAL_MODULES = _real_tree_modules()
     return _REAL_MODULES
 
 

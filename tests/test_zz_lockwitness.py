@@ -39,9 +39,11 @@ def _witness():
 
 @pytest.fixture(scope="module")
 def program():
-    from tools.dflint.program import Program
+    # The suite builds this same whole-tree view in test_dflint.py;
+    # reuse its session cache (read-only) instead of re-linking.
+    from tests.test_dflint import _df_tree_program
 
-    return Program.from_paths([REPO / "dragonfly2_tpu"], REPO)
+    return _df_tree_program()
 
 
 class _StubScorer:

@@ -1,0 +1,128 @@
+"""Lifecycle-plane benchmark: records-in → ACTIVE-out loop latency.
+
+Times the self-driving model lifecycle (DESIGN.md §29) end to end by
+running the REAL zero-human drill (sim/lifecycle.py — StreamingTrainer
+epochs, digest-checked registry artifacts, guardrailed rollout walks,
+honest regret@k verdicts) and reading its per-stage walls:
+
+- **records_to_active_s** — fresh records fed → trained candidate
+  registered → SHADOW → CANARY → ACTIVE, fully unattended (stage 1);
+- **regression_to_rollback_s** — inverted-head candidate registered →
+  guardrail breach → rolled back with last-good still ACTIVE (stage 2);
+- **bounce_resume_s** — manager bounce mid-promotion → resumed plane
+  promotes the surviving candidate to exactly one ACTIVE (stage 3);
+- **records_per_sec** — training-records throughput over stage 1's
+  ingest+train+promote wall (the loop's feed-side budget).
+
+Prints ONE JSON line.  ``--smoke`` shrinks every size for the tier-1
+schema gate (tests/test_lifecycle.py runs it in a subprocess).
+
+Usage: PYTHONPATH=/root/repo python tools/bench_lifecycle.py
+       [--epoch-records 512 --batch-size 64 --announces 80 --parents 6]
+       [--seed 11] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+SCHEMA_KEYS = (
+    "ok",
+    "metric",
+    "config",
+    "stages",
+    "records_to_active_s",
+    "regression_to_rollback_s",
+    "bounce_resume_s",
+    "records_per_sec",
+    "drill_ok",
+)
+
+
+def run(epoch_records: int, batch_size: int, announces: int, parents: int,
+        min_samples: int, seed: int) -> dict:
+    from dragonfly2_tpu.sim.lifecycle import (
+        LifecycleDrillConfig,
+        run_lifecycle_drill,
+    )
+
+    cfg = LifecycleDrillConfig(
+        seed=seed,
+        epoch_records=epoch_records,
+        batch_size=batch_size,
+        announces=announces,
+        parents=parents,
+        min_shadow_samples=min_samples,
+        min_canary_samples=min_samples,
+    )
+    out = run_lifecycle_drill(cfg)
+    s1 = out["stage1"]
+    fed = epoch_records + batch_size
+    wall1 = float(s1["wall_s"]) or 1e-9
+    return {
+        "ok": True,
+        "metric": "lifecycle_records_to_active_seconds",
+        "config": {
+            "epoch_records": epoch_records,
+            "batch_size": batch_size,
+            "announces": announces,
+            "parents": parents,
+            "min_samples": min_samples,
+            "seed": seed,
+        },
+        "stages": {
+            "stage1": s1,
+            "stage2": out["stage2"],
+            "stage3": out["stage3"],
+        },
+        "records_to_active_s": wall1,
+        "regression_to_rollback_s": float(out["stage2"]["wall_s"]),
+        "bounce_resume_s": float(out["stage3"]["wall_s"]),
+        "records_per_sec": round(fed / wall1, 1),
+        "drill_ok": bool(out["ok"]),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--epoch-records", type=int, default=512)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--announces", type=int, default=80,
+                   help="shadow announce groups generated per pump")
+    p.add_argument("--parents", type=int, default=6)
+    p.add_argument("--min-samples", type=int, default=200,
+                   help="shadow/canary joined-sample floors")
+    p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny sizes: the tier-1 JSON-schema gate")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.epoch_records, args.batch_size = 128, 32
+        args.announces, args.parents = 24, 4
+        args.min_samples = 40
+    try:
+        out = run(args.epoch_records, args.batch_size, args.announces,
+                  args.parents, args.min_samples, args.seed)
+        missing = [k for k in SCHEMA_KEYS if k not in out]
+        if missing:
+            raise RuntimeError(f"schema keys missing: {missing}")
+        if not out["drill_ok"]:
+            raise RuntimeError(f"drill failed: {json.dumps(out['stages'])[:200]}")
+    except Exception as exc:  # noqa: BLE001 — one parseable line, never a traceback
+        print(json.dumps({
+            "ok": False,
+            "metric": "lifecycle_records_to_active_seconds",
+            "error": f"{type(exc).__name__}: {exc}"[:300],
+        }))
+        return 1
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

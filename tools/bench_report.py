@@ -51,12 +51,15 @@ SWARM_BEGIN = "<!-- bench:swarm:begin -->"
 SWARM_END = "<!-- bench:swarm:end -->"
 QOS_BEGIN = "<!-- bench:qos:begin -->"
 QOS_END = "<!-- bench:qos:end -->"
+LIFECYCLE_BEGIN = "<!-- bench:lifecycle:begin -->"
+LIFECYCLE_END = "<!-- bench:lifecycle:end -->"
 
 _ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _DL_ROUND_RE = re.compile(r"^BENCH_DL_r(\d+)\.json$")
 _TEL_ROUND_RE = re.compile(r"^TELEMETRY_r(\d+)\.json$")
 _SW_ROUND_RE = re.compile(r"^BENCH_SW_r(\d+)\.json$")
 _QOS_ROUND_RE = re.compile(r"^BENCH_QOS_r(\d+)\.json$")
+_LC_ROUND_RE = re.compile(r"^BENCH_LC_r(\d+)\.json$")
 
 
 def collect_rounds(root: Path) -> List[dict]:
@@ -192,6 +195,61 @@ def render_qos(rounds: List[dict]) -> str:
             f"| {note} |"
         )
     lines.append(QOS_END)
+    return "\n".join(lines)
+
+
+def collect_lifecycle_rounds(root: Path) -> List[dict]:
+    """All self-driving-lifecycle rounds (``tools/bench_lifecycle.py`` →
+    ``BENCH_LC_r*.json``), sorted by round number."""
+    out: List[dict] = []
+    for path in sorted(root.glob("BENCH_LC_r*.json")):
+        m = _LC_ROUND_RE.match(path.name)
+        if m is None:
+            continue
+        try:
+            data = json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, json.JSONDecodeError):
+            data = {"ok": False, "error": "unparseable"}
+        data["round"] = int(m.group(1))
+        data["file"] = path.name
+        out.append(data)
+    out.sort(key=lambda d: d["round"])
+    return out
+
+
+def render_lifecycle(rounds: List[dict]) -> str:
+    """The generated lifecycle block, markers included (one row per
+    BENCH_LC round: the records-in → ACTIVE-out loop latency, the
+    regression-to-rollback and bounce-resume walls, and the feed-side
+    records/sec)."""
+    lines = [
+        LIFECYCLE_BEGIN,
+        "Generated by `python -m tools.bench_report --update` from the",
+        "`BENCH_LC_r*.json` rounds (tools/bench_lifecycle.py) — do not",
+        "edit by hand; tier-1 (`tests/test_bench_report.py`) fails if stale.",
+        "",
+        "| round | status | records→ACTIVE | regression→rollback | "
+        "bounce resume | records/s | drill | note |",
+        "| --- | --- | --- | --- | --- | --- | --- | --- |",
+    ]
+    for data in rounds:
+        if not data.get("ok"):
+            lines.append(
+                f"| r{data['round']:02d} | error | — | — | — | — | — | "
+                f"{str(data.get('error', ''))[:80]} |"
+            )
+            continue
+        note = str(data.get("note", "") or "").replace("|", "\\|")
+        lines.append(
+            f"| r{data['round']:02d} | ok "
+            f"| {data.get('records_to_active_s', 0):.2f} s "
+            f"| {data.get('regression_to_rollback_s', 0):.2f} s "
+            f"| {data.get('bounce_resume_s', 0):.2f} s "
+            f"| {data.get('records_per_sec', 0):.0f} "
+            f"| {'pass' if data.get('drill_ok') else 'FAIL'} "
+            f"| {note} |"
+        )
+    lines.append(LIFECYCLE_END)
     return "\n".join(lines)
 
 
@@ -463,6 +521,7 @@ def update_file(
     tel_rounds: Optional[List[dict]] = None,
     sw_rounds: Optional[List[dict]] = None,
     qos_rounds: Optional[List[dict]] = None,
+    lc_rounds: Optional[List[dict]] = None,
 ) -> bool:
     """Replace the marker-delimited block(s); True when the file changed.
     The download/telemetry/swarm/qos blocks are optional (docs without
@@ -489,6 +548,11 @@ def update_file(
     if qos_rounds is not None:
         new = _replace_block(
             new, QOS_BEGIN, QOS_END, render_qos(qos_rounds),
+            required=False,
+        )
+    if lc_rounds is not None:
+        new = _replace_block(
+            new, LIFECYCLE_BEGIN, LIFECYCLE_END, render_lifecycle(lc_rounds),
             required=False,
         )
     if new != text:
@@ -518,15 +582,17 @@ def main(argv=None) -> int:
     tel_rounds = collect_telemetry_rounds(root)
     sw_rounds = collect_swarm_rounds(root)
     qos_rounds = collect_qos_rounds(root)
+    lc_rounds = collect_lifecycle_rounds(root)
     fresh = render_trajectory(rounds)
     fresh_dl = render_download(dl_rounds)
     fresh_tel = render_telemetry(tel_rounds)
     fresh_sw = render_swarm(sw_rounds)
     fresh_qos = render_qos(qos_rounds)
+    fresh_lc = render_lifecycle(lc_rounds)
     if args.update:
         changed = update_file(
             root / args.file, rounds, dl_rounds, tel_rounds, sw_rounds,
-            qos_rounds,
+            qos_rounds, lc_rounds,
         )
         print(
             f"{args.file}: tables "
@@ -534,7 +600,7 @@ def main(argv=None) -> int:
             + f" ({len(rounds)} round(s), {len(dl_rounds)} download "
             f"round(s), {len(tel_rounds)} telemetry round(s), "
             f"{len(sw_rounds)} swarm round(s), {len(qos_rounds)} qos "
-            f"round(s))"
+            f"round(s), {len(lc_rounds)} lifecycle round(s))"
         )
         return 0
     if args.check:
@@ -547,6 +613,8 @@ def main(argv=None) -> int:
              not tel_rounds),
             ("swarm", SWARM_BEGIN, SWARM_END, fresh_sw, not sw_rounds),
             ("qos", QOS_BEGIN, QOS_END, fresh_qos, not qos_rounds),
+            ("lifecycle", LIFECYCLE_BEGIN, LIFECYCLE_END, fresh_lc,
+             not lc_rounds),
         ):
             begin = text.find(begin_m)
             end = text.find(end_m)
@@ -568,7 +636,8 @@ def main(argv=None) -> int:
             f"{len(dl_rounds)} download round(s), "
             f"{len(tel_rounds)} telemetry round(s), "
             f"{len(sw_rounds)} swarm round(s), "
-            f"{len(qos_rounds)} qos round(s))"
+            f"{len(qos_rounds)} qos round(s), "
+            f"{len(lc_rounds)} lifecycle round(s))"
         )
         return 0
     print(fresh)
@@ -580,6 +649,8 @@ def main(argv=None) -> int:
     print(fresh_sw)
     print()
     print(fresh_qos)
+    print()
+    print(fresh_lc)
     return 0
 
 

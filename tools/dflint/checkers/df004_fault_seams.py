@@ -100,7 +100,10 @@ REQUIRED_SEAMS = {
         "rpc.registry.get", "rpc.registry.post",
     ),
     "dragonfly2_tpu/rollout/client.py": (
-        "rollout.fetch", "rollout.report",
+        "rollout.fetch", "rollout.report", "rollout.begin",
+    ),
+    "dragonfly2_tpu/lifecycle/daemon.py": (
+        "lifecycle.register", "lifecycle.report",
     ),
     "dragonfly2_tpu/rpc/trainer_transport.py": (
         "trainer.rpc.post", "trainer.rpc.get",

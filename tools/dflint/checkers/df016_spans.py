@@ -70,6 +70,12 @@ REQUIRED_SPANS = {
     # the autopilot shed at 12:03"; losing it loses the feedback-loop
     # evidence.
     "dragonfly2_tpu/qos/autopilot.py": ("scheduler/qos.autopilot",),
+    # Lifecycle plane (DESIGN.md §29): every unattended train→export→
+    # register epoch and every arbitration/promotion sweep closes one
+    # span — the evidence trail for "who promoted this model at 12:03".
+    "dragonfly2_tpu/lifecycle/daemon.py": (
+        "lifecycle/epoch", "lifecycle/promote",
+    ),
 }
 
 

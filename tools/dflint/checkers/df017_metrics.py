@@ -59,7 +59,7 @@ SELF_MODULE = "dragonfly2_tpu/utils/metrics.py"
 
 SUBSYSTEMS = (
     "daemon", "scheduler", "manager", "rpc", "trainer", "rollout",
-    "jobs", "source", "slo", "fleet", "sim",
+    "jobs", "source", "slo", "fleet", "sim", "lifecycle",
 )
 
 # Counter names must end _total; histogram/sketch names must end in one
@@ -93,6 +93,12 @@ REQUIRED_METRICS = {
         "daemon_piece_report_batches_total",
         "daemon_piece_fetch_seconds",
         "daemon_report_linger_seconds",
+    ),
+    "dragonfly2_tpu/lifecycle/metrics.py": (
+        "lifecycle_epochs_total",
+        "lifecycle_promotions_total",
+        "lifecycle_rollbacks_total",
+        "lifecycle_epoch_seconds",
     ),
     "dragonfly2_tpu/rpc/piece_transport.py": (
         "rpc_piece_fetch_seconds",
