@@ -119,6 +119,10 @@ def run(argv=None) -> int:
         from ..rollout.client import RolloutRESTClient
 
         lc = cfg.lifecycle
+        # No StateBackend here (that is the manager's): lifecycle
+        # watermarks/lineage live in the daemon's in-memory store, so
+        # the epoch cadence holds for the life of this process; the
+        # manager-side rollout rows stay durable either way.
         lifecycle_daemon = LifecycleDaemon(
             registry,
             RolloutRESTClient(manager_addr, token=args.manager_token),

@@ -16,11 +16,16 @@ region's traffic.  Admission to CANARY is regret@k-gated:
 
 - a candidate below ``min_joined`` joined samples is **held** (not
   enough evidence to judge either way);
+- while a global candidate exists but is itself below the evidence
+  floor, every regional candidate is **held** too — a regional may only
+  advance by BEATING the global arm, never by out-accumulating joined
+  samples while the global arm is still unjudged;
 - an eligible regional candidate **advances** only if its regret beats
   the global candidate's by ``margin`` — ties go to global (one model
   for the whole fleet is cheaper than a specialization that buys
   nothing) — otherwise it is **retired** (deactivated, freeing the
-  region's candidate slot);
+  region's candidate slot); with no global candidate in the report set
+  at all there is nothing to beat and eligible regionals advance;
 - the eligible global candidate advances unless EVERY eligible regional
   candidate beat it, in which case it is retired.
 
@@ -100,6 +105,18 @@ def arbitrate_candidates(
     advance = []
     global_regret = eligible.get(GLOBAL_KEY)
     regional = [k for k in sorted(eligible) if k != GLOBAL_KEY]
+    if GLOBAL_KEY in hold:
+        # A global candidate exists but is below the evidence floor:
+        # "no eligible global" must not read as "nothing to beat", or
+        # admission would depend on which arm accumulates joined
+        # samples first.  Hold the eligible regionals until the global
+        # arm can be judged.
+        for key in regional:
+            hold[key] = (
+                f"global candidate below evidence floor "
+                f"({hold[GLOBAL_KEY]})"
+            )
+        return {"advance": [], "hold": hold, "retire": retire}
     beaten_everywhere = bool(regional)
     for key in regional:
         if global_regret is None or eligible[key] + margin < global_regret:

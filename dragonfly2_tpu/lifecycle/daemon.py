@@ -24,7 +24,10 @@ persist in the DF014-checked ``lifecycle`` StateBackend namespace
 (lifecycle/state.py) — on the replicated backend a manager bounce
 mid-promotion RESUMES (the controller's ``_reconcile`` repairs rollout
 rows, the store hands the daemon its watermarks and in-flight candidate
-back) instead of restarting the loop.
+back) instead of restarting the loop.  Without a backend the store runs
+in-memory: the cadence contract (epoch every ``epoch_records`` NEW
+records) still holds for the life of the process — that is the trainer
+CLI wiring, which has no StateBackend of its own.
 
 Every decision is computed in lifecycle/arbiter.py pure functions; the
 daemon only samples the world (record counters, replay logs) and carries
@@ -94,9 +97,9 @@ class LifecycleDaemon:
         self.registry = registry
         self.client = rollout_client
         self.config = config or LifecycleConfig()
-        self.store: Optional[LifecycleStore] = (
-            LifecycleStore(backend) if backend is not None else None
-        )
+        # backend=None → in-memory rows: watermarks/lineage still advance
+        # (the cadence contract needs them) but die with the process.
+        self.store = LifecycleStore(backend)
         self.replay_source = replay_source
         # Chaos/drill hook: transforms the exported scorer before it is
         # registered (sim/lifecycle.py injects an inverted head through
@@ -107,11 +110,11 @@ class LifecycleDaemon:
         self._trainers = {key: factory(key) for key in self._keys}
         self._mu = threading.Lock()
         self._records: Dict[str, int] = {}
+        self._dropped: Dict[str, int] = {}
         for key in self._keys:
-            row = self.store.row(key) if self.store else {}
             # Un-flushed feeds die with the process; cadence restarts
             # from the persisted watermark.
-            self._records[key] = int(row.get("watermark", 0))
+            self._records[key] = int(self.store.row(key).get("watermark", 0))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -145,13 +148,31 @@ class LifecycleDaemon:
         if region and region in self._trainers:
             targets.append(region)
         for key in targets:
-            self._trainers[key].feed(rows, block=False)
-            with self._mu:
-                self._records[key] = self._records.get(key, 0) + n
+            if self._trainers[key].feed(rows, block=False):
+                with self._mu:
+                    self._records[key] = self._records.get(key, 0) + n
+            else:
+                # Queue full: the rows never reached the trainer, so
+                # they must not advance the epoch cadence either — an
+                # epoch cut on phantom records would train on an empty
+                # queue and export unchanged weights.
+                with self._mu:
+                    self._dropped[key] = dropped = self._dropped.get(key, 0) + n
+                metrics.LIFECYCLE_DROPPED_RECORDS_TOTAL.inc(
+                    n, name=self.model_name_for(key)
+                )
+                logger.warning(
+                    "lifecycle %s: trainer queue full, dropped %d rows "
+                    "(%d total)", key, n, dropped,
+                )
 
     def records_seen(self, key: str) -> int:
         with self._mu:
             return self._records.get(key, 0)
+
+    def records_dropped(self, key: str) -> int:
+        with self._mu:
+            return self._dropped.get(key, 0)
 
     # online_sink surface (trainer/service.py): the lifecycle ingest
     # rides the same wire adapter as the online graph trainer, so every
@@ -174,7 +195,7 @@ class LifecycleDaemon:
     def maybe_epoch(self, key: str) -> Optional[dict]:
         """Cut one training epoch for ``key`` if the cadence decision
         (arbiter.plan_epoch, a replay root) says so."""
-        row = self.store.row(key) if self.store else {"watermark": 0, "epoch": 0}
+        row = self.store.row(key)
         try:
             in_flight = self._candidate_in_flight(key)
         except Exception as exc:  # noqa: BLE001 — manager outage: retry next cycle
@@ -197,18 +218,20 @@ class LifecycleDaemon:
 
         cfg = self.config
         name = self.model_name_for(key)
-        row = self.store.row(key) if self.store else {"epoch": 0}
-        epoch = int(row.get("epoch", 0)) + 1
+        epoch = int(self.store.row(key).get("epoch", 0)) + 1
         t0 = time.monotonic()
         with default_tracer.span(
             "lifecycle/epoch",
             key=key, model_name=name, epoch=epoch, watermark=watermark,
         ):
             trainer = self._trainers[key]
-            trainer.run(max_steps=cfg.max_steps_per_epoch, idle_timeout=0.01)
-            if trainer.step == 0:
+            # trainer.step is cumulative across epochs — only THIS
+            # call's step count says whether the epoch trained anything.
+            steps = trainer.run(max_steps=cfg.max_steps_per_epoch, idle_timeout=0.01)
+            if steps == 0:
                 # Not enough queued rows for one full batch yet: leave
-                # the watermark so the cadence re-fires once they land.
+                # the watermark so the cadence re-fires once they land,
+                # instead of exporting unchanged weights.
                 logger.info("lifecycle %s: no full batch yet; epoch deferred", key)
                 return None
             scorer = trainer.export_scorer()
@@ -227,19 +250,18 @@ class LifecycleDaemon:
             except Exception as exc:  # noqa: BLE001 — retry on the next cycle
                 logger.warning("lifecycle %s: register/begin failed: %s", key, exc)
                 return None
-        if self.store:
-            self.store.update(
-                key,
-                epoch=epoch,
-                watermark=watermark,
-                candidate_id=model.id,
-                candidate_version=model.version,
-            )
-            self.store.append_history(
-                key,
-                {"epoch": epoch, "event": "registered",
-                 "model_id": model.id, "version": model.version},
-            )
+        self.store.update(
+            key,
+            epoch=epoch,
+            watermark=watermark,
+            candidate_id=model.id,
+            candidate_version=model.version,
+        )
+        self.store.append_history(
+            key,
+            {"epoch": epoch, "event": "registered",
+             "model_id": model.id, "version": model.version},
+        )
         metrics.LIFECYCLE_EPOCHS_TOTAL.inc(name=name)
         metrics.LIFECYCLE_EPOCH_SECONDS.observe(time.monotonic() - t0)
         logger.info(
@@ -255,7 +277,7 @@ class LifecycleDaemon:
         """The in-flight candidate disappeared from the registry: record
         how it resolved (promoted by the controller, or rolled back) so
         lineage survives a manager bounce the daemon never witnessed."""
-        if not self.store or not row.get("candidate_id"):
+        if not row.get("candidate_id"):
             return
         try:
             active = self.registry.active_model(
@@ -289,7 +311,7 @@ class LifecycleDaemon:
         reports: Dict[str, dict] = {}
         for key in self._keys:
             name = self.model_name_for(key)
-            row = self.store.row(key) if self.store else {}
+            row = self.store.row(key)
             try:
                 info = self.client.candidate(cfg.scheduler_id, name)
             except Exception as exc:  # noqa: BLE001 — manager outage
@@ -349,15 +371,14 @@ class LifecycleDaemon:
             except Exception as exc:  # noqa: BLE001 — retire on a later cycle
                 logger.warning("lifecycle %s: retire failed: %s", key, exc)
                 continue
-            if self.store:
-                row = self.store.row(key)
-                self.store.append_history(
-                    key,
-                    {"epoch": int(row.get("epoch", 0)),
-                     "event": "arbitration_retired", "reason": reason,
-                     "model_id": row.get("candidate_id", "")},
-                )
-                self.store.update(key, candidate_id="", candidate_version=0)
+            row = self.store.row(key)
+            self.store.append_history(
+                key,
+                {"epoch": int(row.get("epoch", 0)),
+                 "event": "arbitration_retired", "reason": reason,
+                 "model_id": row.get("candidate_id", "")},
+            )
+            self.store.update(key, candidate_id="", candidate_version=0)
             metrics.LIFECYCLE_ROLLBACKS_TOTAL.inc(name=name)
             outcomes.append({"key": key, "decision": "retired", "reason": reason})
             logger.info("lifecycle %s: candidate retired by arbitration: %s",
@@ -388,18 +409,17 @@ class LifecycleDaemon:
                        "phase": decision.get("phase"),
                        "reason": decision.get("reason", "")}
             outcomes.append(outcome)
-            if self.store:
-                row = self.store.row(key)
-                if decision.get("decision") in ("advance", "promote", "rollback"):
-                    self.store.append_history(
-                        key,
-                        {"epoch": int(row.get("epoch", 0)),
-                         "event": decision.get("decision"),
-                         "phase": decision.get("phase"),
-                         "model_id": row.get("candidate_id", "")},
-                    )
-                if decision.get("decision") in ("promote", "rollback"):
-                    self.store.update(key, candidate_id="", candidate_version=0)
+            row = self.store.row(key)
+            if decision.get("decision") in ("advance", "promote", "rollback"):
+                self.store.append_history(
+                    key,
+                    {"epoch": int(row.get("epoch", 0)),
+                     "event": decision.get("decision"),
+                     "phase": decision.get("phase"),
+                     "model_id": row.get("candidate_id", "")},
+                )
+            if decision.get("decision") in ("promote", "rollback"):
+                self.store.update(key, candidate_id="", candidate_version=0)
             if decision.get("decision") == "promote":
                 metrics.LIFECYCLE_PROMOTIONS_TOTAL.inc(name=name)
             elif decision.get("decision") == "rollback":

@@ -28,6 +28,13 @@ LIFECYCLE_ROLLBACKS_TOTAL = _reg.counter(
     ["name"],
 )
 
+LIFECYCLE_DROPPED_RECORDS_TOTAL = _reg.counter(
+    "lifecycle_dropped_records_total",
+    "records dropped at the trainer-queue boundary (never trained on, "
+    "never counted toward the epoch cadence)",
+    ["name"],
+)
+
 LIFECYCLE_EPOCH_SECONDS = _reg.sketch(
     "lifecycle_epoch_seconds",
     "one epoch's train → export → register → rollout-begin latency",

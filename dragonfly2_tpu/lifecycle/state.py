@@ -12,6 +12,12 @@ mid-promotion resumes the loop exactly where it was (the daemon reads the
 watermark and in-flight candidate back instead of retraining from
 scratch).  Every mutation is one ``put`` under ``_mu`` and the loader is
 the constructor, per records/state_contracts.py.
+
+``backend=None`` runs the store in-memory: rows behave identically
+within the process (watermarks advance, lineage accumulates) but die
+with it.  The trainer CLI wiring uses this mode — it has no
+StateBackend of its own — so the epoch cadence contract holds even
+without durability; only crash-resume needs the backend.
 """
 
 from __future__ import annotations
@@ -40,14 +46,15 @@ def _default_row() -> dict:
 class LifecycleStore:
     """Owner of the ``lifecycle`` namespace (records/state_contracts.py)."""
 
-    def __init__(self, backend: "StateBackend") -> None:
+    def __init__(self, backend: Optional["StateBackend"] = None) -> None:
         self._mu = threading.Lock()
         self._rows: Dict[str, dict] = {}
-        self._table = backend.table("lifecycle")
-        for key, doc in self._table.load_all().items():
-            row = _default_row()
-            row.update(doc)
-            self._rows[key] = row
+        self._table = backend.table("lifecycle") if backend is not None else None
+        if self._table is not None:
+            for key, doc in self._table.load_all().items():
+                row = _default_row()
+                row.update(doc)
+                self._rows[key] = row
 
     def keys(self) -> List[str]:
         with self._mu:
@@ -63,7 +70,8 @@ class LifecycleStore:
             row = dict(self._rows.get(key) or _default_row())
             row.update(fields)
             self._rows[key] = row
-            self._table.put(key, row)
+            if self._table is not None:
+                self._table.put(key, row)
             return dict(row)
 
     def append_history(self, key: str, event: dict) -> dict:
@@ -73,7 +81,8 @@ class LifecycleStore:
             history.append(dict(event))
             row["history"] = history[-HISTORY_KEEP:]
             self._rows[key] = row
-            self._table.put(key, row)
+            if self._table is not None:
+                self._table.put(key, row)
             return dict(row)
 
     def candidate(self, key: str) -> Optional[str]:

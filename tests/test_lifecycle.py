@@ -157,6 +157,22 @@ class TestArbitrateCandidates:
         assert verdict["advance"] == ["idc-a"]
         assert verdict["retire"] == {}
 
+    def test_held_global_holds_eligible_regionals(self):
+        """A global candidate below the evidence floor is NOT absent:
+        regionals must beat it, not outrace its sample accumulation —
+        everyone holds until the global arm can be judged."""
+        verdict = arbitrate_candidates(
+            {
+                GLOBAL_KEY: _shadow_report(joined=10),
+                "idc-a": _shadow_report(regret=0.01),  # eligible + excellent
+            },
+            min_joined=50, margin=0.02,
+        )
+        assert verdict["advance"] == []
+        assert verdict["retire"] == {}
+        assert "global candidate below evidence floor" in verdict["hold"]["idc-a"]
+        assert verdict["hold"][GLOBAL_KEY] == "10/50 joined samples"
+
     def test_verdict_ignores_input_insertion_order(self):
         """The replay root must be a pure function of the report VALUES:
         two daemons assembling the same reports in different dict orders
@@ -297,6 +313,81 @@ class TestLifecycleDaemon:
         assert daemon.step()["epochs"], "deferred epoch never re-fired"
         assert daemon.store.row(GLOBAL_KEY)["epoch"] == 1
         assert registry.candidate_model("s1", daemon.config.model_name)
+
+    def test_storeless_daemon_keeps_watermark_in_memory(self):
+        """The production CLI wiring (cli/trainer.py) passes no backend:
+        the cadence contract — an epoch per ``epoch_records`` NEW
+        records — must still hold, with watermarks in the in-memory
+        store instead of reading 0 every cycle and cutting an epoch the
+        moment each candidate resolves."""
+        registry = ModelRegistry()
+        controller = RolloutController(registry)
+        world = _World(_drill_cfg())
+        daemon = LifecycleDaemon(
+            registry, LocalRolloutClient(controller),
+            config=LifecycleConfig(scheduler_id="s1", epoch_records=16),
+            trainer_factory=_small_trainer,
+        )
+        daemon.feed(world.record_rows(64))
+        assert daemon.step()["epochs"], "first epoch never cut"
+        row = daemon.store.row(GLOBAL_KEY)
+        assert row["epoch"] == 1 and row["watermark"] == 64
+        # Resolve the candidate; with NO new records the loop must idle
+        # instead of endlessly re-registering candidates.
+        cand = registry.candidate_model("s1", daemon.config.model_name)
+        registry.deactivate(cand.id)
+        assert daemon.step()["epochs"] == []
+        assert daemon.store.row(GLOBAL_KEY)["epoch"] == 1
+        assert registry.candidate_model("s1", daemon.config.model_name) is None
+
+    def test_starved_second_epoch_defers_not_reexports(self):
+        """trainer.step is cumulative: an epoch-2 cycle whose queue has
+        no full batch must defer on THIS call's step count, not export
+        unchanged weights because epoch 1 trained."""
+        backend = MemoryBackend()
+        registry = ModelRegistry(backend=backend)
+        controller = RolloutController(registry, backend=backend)
+        world = _World(_drill_cfg())
+        daemon = LifecycleDaemon(
+            registry, LocalRolloutClient(controller),
+            config=LifecycleConfig(scheduler_id="s1", epoch_records=16),
+            backend=backend, trainer_factory=_small_trainer,
+        )
+        daemon.feed(world.record_rows(64))
+        assert daemon.step()["epochs"]
+        assert daemon._trainers[GLOBAL_KEY].step > 0  # cumulative from now on
+        cand = registry.candidate_model("s1", daemon.config.model_name)
+        registry.deactivate(cand.id)  # epoch 1's candidate resolves
+        daemon.feed(world.record_rows(20))  # past cadence, below batch 32
+        assert daemon.step()["epochs"] == [], "starved epoch must defer"
+        assert daemon.store.row(GLOBAL_KEY)["epoch"] == 1
+        assert registry.candidate_model("s1", daemon.config.model_name) is None
+        daemon.feed(world.record_rows(44))  # the rest of the batch lands
+        assert daemon.step()["epochs"], "deferred epoch never re-fired"
+        assert daemon.store.row(GLOBAL_KEY)["epoch"] == 2
+
+    def test_full_trainer_queue_does_not_advance_cadence(self):
+        """Rows the trainer queue rejected never train anything: they
+        must not count toward the epoch cadence either."""
+        registry = ModelRegistry()
+        controller = RolloutController(registry)
+        world = _World(_drill_cfg())
+
+        def tiny_queue_trainer(_key):
+            return StreamingTrainer(
+                StreamingConfig(batch_size=32, queue_capacity=1,
+                                snapshot_rows=512, seed=11)
+            )
+
+        daemon = LifecycleDaemon(
+            registry, LocalRolloutClient(controller),
+            config=LifecycleConfig(scheduler_id="s1", epoch_records=16),
+            trainer_factory=tiny_queue_trainer,
+        )
+        daemon.feed(world.record_rows(8))   # enqueued
+        daemon.feed(world.record_rows(8))   # queue full → dropped
+        assert daemon.records_seen(GLOBAL_KEY) == 8
+        assert daemon.records_dropped(GLOBAL_KEY) == 8
 
     def test_orphan_shadow_candidate_is_reentered(self):
         """A candidate that reached SHADOW without a rollout row (crash

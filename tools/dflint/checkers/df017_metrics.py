@@ -98,6 +98,7 @@ REQUIRED_METRICS = {
         "lifecycle_epochs_total",
         "lifecycle_promotions_total",
         "lifecycle_rollbacks_total",
+        "lifecycle_dropped_records_total",
         "lifecycle_epoch_seconds",
     ),
     "dragonfly2_tpu/rpc/piece_transport.py": (
