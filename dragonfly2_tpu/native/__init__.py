@@ -19,11 +19,22 @@ from typing import Optional
 
 import numpy as np
 
+from ..records import abi_contracts as _abi
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libdragonfly_native.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
+
+# Shared engine constants, sourced from the ABI registry so the Python
+# side can never restate a value the C++ side has moved away from
+# (records/abi_contracts.py is the single source; DF020 pins both sides
+# to it).
+BATCH_MAX = _abi.constant("kBatchMax")
+BATCH_BYTES_MAX = _abi.constant("kBatchBytesMax")
+FETCH_BURST_MAX = _abi.constant("kFetchBurstMax")
+MAX_FETCH_BODY = _abi.constant("kMaxFetchBody")
 
 
 def _declare(lib: ctypes.CDLL) -> None:
@@ -68,10 +79,6 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ps_serve.argtypes = [i64, ctypes.c_char_p, ctypes.c_uint16, i32]
     lib.ps_serve_stop.restype = i32
     lib.ps_serve_stop.argtypes = [i64]
-    lib.ps_serve_stats.restype = i32
-    lib.ps_serve_stats.argtypes = [
-        i64, ctypes.POINTER(i64), ctypes.POINTER(i64)
-    ]
     lib.ps_serve_stats2.restype = i32
     lib.ps_serve_stats2.argtypes = [
         i64, ctypes.POINTER(i64), ctypes.POINTER(i64),
@@ -127,6 +134,13 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.oi_import_state.restype = i32
     lib.oi_import_state.argtypes = [i64, i32p, i64p, f64p, i32p, i64,
                                     f32p, f32p, i64, i64, i64]
+
+    # ABI manifest witness (DESIGN.md §30): df_abi_manifest returns a
+    # process-lifetime static string — c_char_p is safe (no free).
+    lib.df_abi_manifest.restype = ctypes.c_char_p
+    lib.df_abi_manifest.argtypes = []
+    lib.df_abi_probe_fetchdone.restype = ctypes.c_int32
+    lib.df_abi_probe_fetchdone.argtypes = [p8, u32]
 
 
 def load(rebuild: bool = False) -> Optional[ctypes.CDLL]:
@@ -371,11 +385,13 @@ class NativePieceStore:
         self._lib.ps_serve_stop(self._h)
 
     def serve_stats(self) -> tuple:
-        """(pieces_served, bytes_served) while the server runs."""
-        p = ctypes.c_int64(0)
-        b = ctypes.c_int64(0)
-        self._lib.ps_serve_stats(self._h, ctypes.byref(p), ctypes.byref(b))
-        return int(p.value), int(b.value)
+        """(pieces_served, bytes_served) while the server runs.
+
+        Narrow view over ``serve_stats_full`` — the legacy two-pointer
+        ``ps_serve_stats`` export is gone (one out-pointer list fewer to
+        keep in sync with the ABI registry)."""
+        full = self.serve_stats_full()
+        return full["pieces"], full["bytes"]
 
     def serve_stats_full(self) -> dict:
         """Extended counters: adds the batched-burst piece count and the
@@ -416,10 +432,12 @@ class NativePieceFetcher:
     ordinary Python retry/hedge path (conductor fetch_one is the spec).
     """
 
-    # Mirrors native.cpp FetchDone: u32 number, i32 status, u32 length,
-    # i32 parent slot, i64 cost_ns.
-    RECORD = "<IiIiq"
-    RECORD_SIZE = 24
+    # native.cpp FetchDone: u32 number, i32 status, u32 length,
+    # i32 parent slot, i64 cost_ns — format and size come from the ABI
+    # registry (DF020 + the runtime witness pin both to the compiled
+    # struct).
+    RECORD = _abi.record_format("FetchDone")
+    RECORD_SIZE = _abi.record_size("FetchDone")
     MAX_DRAIN = 256
 
     def __init__(self, store: "NativePieceStore", *, workers: int = 4,

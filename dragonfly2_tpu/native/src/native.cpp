@@ -21,6 +21,7 @@
 #include <cerrno>
 #include <chrono>
 #include <condition_variable>
+#include <cstddef>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -42,10 +43,60 @@
 #include <sys/types.h>
 #include <sys/uio.h>
 #include <thread>
+#include <type_traits>
 #include <unistd.h>
 #include <vector>
 
 namespace {
+
+// ---------------------------------------------------------------------------
+// Shared ABI constants (DESIGN.md §30).  Every `kName` below is declared
+// once more in records/abi_contracts.py; dflint DF020 cross-checks the two
+// texts and the df_abi_manifest() witness re-emits the COMPILED values —
+// change either side alone and tier-1 fails by constant name.
+// ---------------------------------------------------------------------------
+
+constexpr char kMagic[] = "DFC1";      // columnar record file magic
+constexpr char kTaskMagic[] = "DFPS";  // piece-store task header magic
+
+// Batched submission / pipelining caps.  The server coalesces up to
+// kBatchMax pipelined piece GETs into one gather-write burst, byte-capped
+// at kBatchBytesMax (the batch's whole scratch RSS cost: a foreign client
+// pipelining 16 x 4 MiB GETs must not make every connection thread stage
+// 64 MiB or throw bad_alloc).  The client's fetch workers pipeline up to
+// kFetchBurstMax GETs under the SAME byte cap — a burst serializes its
+// responses on one connection, so big pieces spread across workers
+// instead.  kMaxFetchBody bounds any single body allocation when the
+// caller doesn't know the piece length (16x the common 4 MiB piece): a
+// hostile parent advertising `Content-Length: 9e15` must be a protocol
+// error, not a bad_alloc.
+constexpr size_t kBatchMax = 16;
+constexpr int64_t kBatchBytesMax = 512 * 1024;
+constexpr size_t kFetchBurstMax = 8;
+constexpr int64_t kMaxFetchBody = 64LL * 1024 * 1024;
+
+// Worker / slot / serving caps shared with the bindings' docstrings and
+// the Python server's wire behavior (long-poll bound).
+constexpr int kFetchWorkersDefault = 4;
+constexpr int kFetchWorkersMax = 64;
+constexpr int kParentSlotMax = 255;
+constexpr int kServeLimitDefault = 64;
+constexpr int64_t kLongPollMaxMs = 30000;
+
+// FetchDone.status codes: 0 ok, >0 raw HTTP status, negatives local.
+constexpr int32_t kFetchStatusOk = 0;
+constexpr int32_t kFetchStatusConn = -1;    // dial/socket error; queued jobs discarded on close
+constexpr int32_t kFetchStatusProto = -2;   // protocol / length mismatch / oversized body
+constexpr int32_t kFetchStatusCommit = -3;  // local ps_write_piece failure
+
+// Catch-all containment sentinel (DF021): an extern "C" accessor that
+// swallows an exception returns this instead of letting it escape the C
+// ABI — an escaping exception would std::terminate the embedding daemon.
+constexpr int32_t kAbiTrap = -125;
+
+// PieceMeta.flags bits.
+constexpr uint32_t kPieceFlagCommitted = 1;
+constexpr uint32_t kPieceFlagVerified = 2;  // CRC checked on first serve
 
 // ---------------------------------------------------------------------------
 // crc32 (IEEE).  Slice-by-8: processes 8 bytes per step through 8 derived
@@ -93,8 +144,6 @@ uint32_t crc32(const uint8_t* data, size_t len) {
 // Columnar record engine (DFC1; spec: records/columnar.py)
 // ---------------------------------------------------------------------------
 
-constexpr char kMagic[4] = {'D', 'F', 'C', '1'};
-
 struct RecordFile {
   FILE* f = nullptr;
   uint32_t width = 0;       // columns
@@ -119,7 +168,7 @@ struct PieceMeta {
   uint32_t length;
   int64_t offset;
   uint32_t crc;
-  uint32_t flags;  // 1 = committed
+  uint32_t flags;  // kPieceFlagCommitted | kPieceFlagVerified
 };
 
 struct TaskHeader {
@@ -161,12 +210,12 @@ bool load_task(TaskStore* ts) {
   if (size < (off_t)sizeof(TaskHeader)) return false;
   fseeko(ts->meta, 0, SEEK_SET);
   if (fread(&ts->header, sizeof(TaskHeader), 1, ts->meta) != 1) return false;
-  if (memcmp(ts->header.magic, "DFPS", 4) != 0) return false;
+  if (memcmp(ts->header.magic, kTaskMagic, 4) != 0) return false;
   size_t n = (size - sizeof(TaskHeader)) / sizeof(PieceMeta);
   for (size_t i = 0; i < n; i++) {
     PieceMeta pm;
     if (fread(&pm, sizeof(PieceMeta), 1, ts->meta) != 1) break;
-    if (pm.flags & 1) ts->pieces[pm.number] = pm;
+    if (pm.flags & kPieceFlagCommitted) ts->pieces[pm.number] = pm;
   }
   fseeko(ts->meta, 0, SEEK_END);
   return true;
@@ -204,7 +253,7 @@ TaskPtr open_task(PieceStore* ps, const char* task_id, uint32_t piece_size,
       return nullptr;
     }
   } else {
-    memcpy(ts->header.magic, "DFPS", 4);
+    memcpy(ts->header.magic, kTaskMagic, 4);
     ts->header.piece_size = piece_size;
     ts->header.content_length = content_length;
     fwrite(&ts->header, sizeof(TaskHeader), 1, ts->meta);
@@ -233,7 +282,7 @@ extern "C" {
 
 // -- record engine ----------------------------------------------------------
 
-int64_t re_open(const char* path, const char* header_json, uint32_t width) {
+int64_t re_open(const char* path, const char* header_json, uint32_t width) try {
   struct stat st;
   bool exists = stat(path, &st) == 0 && st.st_size > 0;
   FILE* f = fopen(path, exists ? "r+b" : "w+b");
@@ -271,9 +320,11 @@ int64_t re_open(const char* path, const char* header_json, uint32_t width) {
   int64_t h = g_next_handle++;
   g_records[h] = rf;
   return h;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int64_t re_append(int64_t handle, const float* rows, int64_t n_rows) {
+int64_t re_append(int64_t handle, const float* rows, int64_t n_rows) try {
   RecordPtr rf;
   {
     std::lock_guard<std::mutex> lk(g_records_mu);
@@ -285,9 +336,11 @@ int64_t re_append(int64_t handle, const float* rows, int64_t n_rows) {
   if (rf->closed) return -2;
   size_t wrote = fwrite(rows, sizeof(float) * rf->width, n_rows, rf->f);
   return (int64_t)wrote;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int re_flush(int64_t handle) {
+int re_flush(int64_t handle) try {
   RecordPtr rf;
   {
     std::lock_guard<std::mutex> lk(g_records_mu);
@@ -299,9 +352,11 @@ int re_flush(int64_t handle) {
   if (rf->closed) return -2;
   fflush(rf->f);
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int64_t re_rows(int64_t handle) {
+int64_t re_rows(int64_t handle) try {
   RecordPtr rf;
   {
     std::lock_guard<std::mutex> lk(g_records_mu);
@@ -314,9 +369,11 @@ int64_t re_rows(int64_t handle) {
   fflush(rf->f);
   off_t end = ftello(rf->f);
   return (end - rf->data_offset) / (sizeof(float) * rf->width);
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int re_close(int64_t handle) {
+int re_close(int64_t handle) try {
   RecordPtr rf;
   {
     std::lock_guard<std::mutex> lk(g_records_mu);
@@ -331,11 +388,13 @@ int re_close(int64_t handle) {
     rf->closed = true;
   }
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // -- piece store ------------------------------------------------------------
 
-int64_t ps_open(const char* root) {
+int64_t ps_open(const char* root) try {
   if (mkdir(root, 0755) != 0 && errno != EEXIST) return -1;
   PieceStore* ps = new PieceStore();
   ps->root = root;
@@ -343,6 +402,8 @@ int64_t ps_open(const char* root) {
   int64_t h = g_next_handle++;
   g_stores[h] = ps;
   return h;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 static PieceStore* get_store(int64_t handle) {
@@ -352,22 +413,26 @@ static PieceStore* get_store(int64_t handle) {
 }
 
 int ps_create_task(int64_t handle, const char* task_id, uint32_t piece_size,
-                   int64_t content_length) {
+                   int64_t content_length) try {
   PieceStore* ps = get_store(handle);
   if (!ps) return -1;
   TaskPtr ts = open_task(ps, task_id, piece_size, content_length, true);
   return ts ? 0 : -2;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int ps_load_task(int64_t handle, const char* task_id) {
+int ps_load_task(int64_t handle, const char* task_id) try {
   PieceStore* ps = get_store(handle);
   if (!ps) return -1;
   TaskPtr ts = open_task(ps, task_id, 0, 0, false);
   return ts ? 0 : -2;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 int64_t ps_write_piece(int64_t handle, const char* task_id, uint32_t number,
-                       const uint8_t* data, uint32_t length) {
+                       const uint8_t* data, uint32_t length) try {
   PieceStore* ps = get_store(handle);
   if (!ps) return -1;
   TaskPtr ts = open_task(ps, task_id, 0, 0, false);
@@ -381,17 +446,20 @@ int64_t ps_write_piece(int64_t handle, const char* task_id, uint32_t number,
   // Data durable before metadata commit: a crash between the two leaves an
   // uncommitted piece that reload simply redownloads.
   fsync(fileno(ts->data));
-  PieceMeta pm{number, length, offset, crc32(data, length), 1};
+  PieceMeta pm{number, length, offset, crc32(data, length),
+               kPieceFlagCommitted};
   fseeko(ts->meta, 0, SEEK_END);
   if (fwrite(&pm, sizeof(PieceMeta), 1, ts->meta) != 1) return -4;
   fflush(ts->meta);
   fsync(fileno(ts->meta));
   ts->pieces[number] = pm;
   return (int64_t)length;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 int64_t ps_read_piece(int64_t handle, const char* task_id, uint32_t number,
-                      uint8_t* buf, uint32_t buf_len, int verify) {
+                      uint8_t* buf, uint32_t buf_len, int verify) try {
   PieceStore* ps = get_store(handle);
   if (!ps) return -1;
   TaskPtr ts = open_task(ps, task_id, 0, 0, false);
@@ -406,20 +474,24 @@ int64_t ps_read_piece(int64_t handle, const char* task_id, uint32_t number,
   if (fread(buf, 1, pm.length, ts->data) != pm.length) return -5;
   if (verify && crc32(buf, pm.length) != pm.crc) return -6;
   return (int64_t)pm.length;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int64_t ps_piece_count(int64_t handle, const char* task_id) {
+int64_t ps_piece_count(int64_t handle, const char* task_id) try {
   PieceStore* ps = get_store(handle);
   if (!ps) return -1;
   TaskPtr ts = open_task(ps, task_id, 0, 0, false);
   if (!ts) return -2;
   std::lock_guard<std::mutex> lk(ts->mu);
   return (int64_t)ts->pieces.size();
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // Fill `bitmap` (caller-allocated, n_pieces bytes) with 1 per present piece.
 int ps_piece_bitmap(int64_t handle, const char* task_id, uint8_t* bitmap,
-                    uint32_t n_pieces) {
+                    uint32_t n_pieces) try {
   PieceStore* ps = get_store(handle);
   if (!ps) return -1;
   TaskPtr ts = open_task(ps, task_id, 0, 0, false);
@@ -429,9 +501,11 @@ int ps_piece_bitmap(int64_t handle, const char* task_id, uint8_t* bitmap,
   for (auto& kv : ts->pieces)
     if (kv.first < n_pieces) bitmap[kv.first] = 1;
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int64_t ps_task_bytes(int64_t handle, const char* task_id) {
+int64_t ps_task_bytes(int64_t handle, const char* task_id) try {
   PieceStore* ps = get_store(handle);
   if (!ps) return -1;
   TaskPtr ts = open_task(ps, task_id, 0, 0, false);
@@ -440,25 +514,31 @@ int64_t ps_task_bytes(int64_t handle, const char* task_id) {
   int64_t total = 0;
   for (auto& kv : ts->pieces) total += kv.second.length;
   return total;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int64_t ps_content_length(int64_t handle, const char* task_id) {
+int64_t ps_content_length(int64_t handle, const char* task_id) try {
   PieceStore* ps = get_store(handle);
   if (!ps) return -1;
   TaskPtr ts = open_task(ps, task_id, 0, 0, false);
   if (!ts) return -2;
   return ts->header.content_length;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int64_t ps_piece_size(int64_t handle, const char* task_id) {
+int64_t ps_piece_size(int64_t handle, const char* task_id) try {
   PieceStore* ps = get_store(handle);
   if (!ps) return -1;
   TaskPtr ts = open_task(ps, task_id, 0, 0, false);
   if (!ts) return -2;
   return (int64_t)ts->header.piece_size;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int ps_delete_task(int64_t handle, const char* task_id) {
+int ps_delete_task(int64_t handle, const char* task_id) try {
   PieceStore* ps = get_store(handle);
   if (!ps) return -1;
   TaskPtr ts;
@@ -477,6 +557,8 @@ int ps_delete_task(int64_t handle, const char* task_id) {
     ts->closed = true;
   }
   return remove_tree(task_dir(ps, task_id));
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 }  // extern "C"
@@ -513,7 +595,7 @@ struct HttpServer {
   std::atomic<int64_t> pieces_served{0};
   std::atomic<int64_t> bytes_served{0};
   std::atomic<int64_t> batched_pieces{0};  // pieces served via burst path
-  int limit = 64;
+  int limit = kServeLimitDefault;
   int64_t store_handle = 0;
   std::thread accept_th;
   uint16_t port = 0;
@@ -596,7 +678,7 @@ bool parse_i64(const std::string& s, int64_t* out) {
 
 // Verify a piece's CRC once; afterwards flags bit 2 short-circuits.
 bool piece_verified(TaskStore* ts, PieceMeta& pm) {
-  if (pm.flags & 2) return true;
+  if (pm.flags & kPieceFlagVerified) return true;
   std::vector<uint8_t> buf(pm.length);
   {
     std::lock_guard<std::mutex> lk(ts->mu);
@@ -607,8 +689,8 @@ bool piece_verified(TaskStore* ts, PieceMeta& pm) {
   if (crc32(buf.data(), pm.length) != pm.crc) return false;
   std::lock_guard<std::mutex> lk(ts->mu);
   auto it = ts->pieces.find(pm.number);
-  if (it != ts->pieces.end()) it->second.flags |= 2;
-  pm.flags |= 2;
+  if (it != ts->pieces.end()) it->second.flags |= kPieceFlagVerified;
+  pm.flags |= kPieceFlagVerified;
   return true;
 }
 
@@ -685,14 +767,9 @@ bool sendv_all(int fd, iovec* iov, size_t n) {
 // requests consumed, 0 when the normal path should take over, -1 on a
 // send failure (caller drops the connection).
 int try_piece_batch(HttpServer* srv, int fd, std::string& acc) {
-  constexpr size_t kBatchMax = 16;
-  // Byte cap on the gather buffer (mirrors the native client's 512 KiB
-  // pipelining cap): the scratch allocation is the batch's whole RSS
-  // cost, and a foreign client pipelining 16 x 4 MiB GETs must not make
-  // every connection thread stage 64 MiB (or throw bad_alloc).  Pieces
-  // past the cap stay in `acc` for the next iteration — they re-batch
-  // or ride the per-request sendfile path.
-  constexpr int64_t kBatchBytesMax = 512 * 1024;
+  // kBatchMax/kBatchBytesMax caps: see the shared-constants block.
+  // Pieces past the byte cap stay in `acc` for the next iteration —
+  // they re-batch or ride the per-request sendfile path.
   struct PieceReq {
     std::string task;
     uint32_t number;
@@ -826,6 +903,13 @@ int try_piece_batch(HttpServer* srv, int fd, std::string& acc) {
 }
 
 void handle_conn(HttpServer* srv, int fd) {
+  // Whole serving loop inside a catch-all (DF021): one hostile request
+  // that lands a bad_alloc (oversized batch staging, header churn) must
+  // cost THIS connection, never std::terminate the embedding daemon.
+  // The shared cleanup below the try runs on every exit path, so the
+  // conns map / conn_count accounting that ps_serve_stop joins on stays
+  // exact.
+  try {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   std::string acc;
@@ -946,7 +1030,7 @@ void handle_conn(HttpServer* srv, int fd) {
         int64_t have = -1, wait_ms = 0;
         parse_query_i64(query, "have", &have);
         parse_query_i64(query, "wait_ms", &wait_ms);
-        if (wait_ms > 30000) wait_ms = 30000;
+        if (wait_ms > kLongPollMaxMs) wait_ms = kLongPollMaxMs;
         // Long-polls don't consume data-plane slots, but they are not
         // unbounded either: past 4x the serving cap of PARKED pollers,
         // the subscription degrades to an immediate snapshot (clients
@@ -1055,6 +1139,12 @@ void handle_conn(HttpServer* srv, int fd) {
     if (!metadata) srv->active.fetch_sub(1);
     if (!ok_conn || !keep_alive) break;
   }
+  } catch (...) {
+    // Contained: the request that threw gets no response (the client
+    // sees a dropped connection and retries); the data-plane slot was
+    // already released on the normal paths above, and a throw between
+    // fetch_add and fetch_sub cannot happen (no allocation in between).
+  }
   {
     std::lock_guard<std::mutex> lk(srv->conns_mu);
     srv->conns.erase(fd);
@@ -1063,7 +1153,7 @@ void handle_conn(HttpServer* srv, int fd) {
   srv->conn_count.fetch_sub(1);
 }
 
-void accept_loop(HttpServer* srv) {
+void accept_loop(HttpServer* srv) try {
   while (!srv->stopping.load()) {
     int fd = accept(srv->lfd, nullptr, nullptr);
     if (fd < 0) {
@@ -1079,6 +1169,12 @@ void accept_loop(HttpServer* srv) {
     srv->conn_count.fetch_add(1);
     std::thread(handle_conn, srv, fd).detach();
   }
+} catch (...) {
+  // DF021 containment: a std::thread construction failure (EAGAIN under
+  // fd/thread pressure) must stop accepting, not terminate the process.
+  // ps_serve_stop still joins this thread and closes the listener; the
+  // one connection that failed to spawn leaks its fd accounting into
+  // conn_count, which stop's bounded grace tolerates.
 }
 
 }  // namespace
@@ -1087,7 +1183,7 @@ extern "C" {
 
 // Start serving the store's pieces on host:port (port 0 = ephemeral).
 // Returns the bound port, or <0 on error.  One server per store handle.
-int64_t ps_serve(int64_t handle, const char* host, uint16_t port, int limit) {
+int64_t ps_serve(int64_t handle, const char* host, uint16_t port, int limit) try {
   // Serialize whole-call: two concurrent ps_serve on one handle must not
   // both pass the duplicate check and leak the loser's live server.
   static std::mutex serve_setup_mu;
@@ -1117,26 +1213,18 @@ int64_t ps_serve(int64_t handle, const char* host, uint16_t port, int limit) {
   getsockname(lfd, (sockaddr*)&addr, &alen);
   HttpServer* srv = new HttpServer();
   srv->lfd = lfd;
-  srv->limit = limit > 0 ? limit : 64;
+  srv->limit = limit > 0 ? limit : kServeLimitDefault;
   srv->store_handle = handle;
   srv->port = ntohs(addr.sin_port);
   srv->accept_th = std::thread(accept_loop, srv);
   std::lock_guard<std::mutex> lk(g_servers_mu);
   g_servers[handle] = srv;
   return (int64_t)srv->port;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-// Serving counters (metrics parity with the Python UploadManager).
-int ps_serve_stats(int64_t handle, int64_t* pieces, int64_t* bytes) {
-  std::lock_guard<std::mutex> lk(g_servers_mu);
-  auto it = g_servers.find(handle);
-  if (it == g_servers.end()) return -1;
-  *pieces = it->second->pieces_served.load();
-  *bytes = it->second->bytes_served.load();
-  return 0;
-}
-
-int ps_serve_stop(int64_t handle) {
+int ps_serve_stop(int64_t handle) try {
   HttpServer* srv;
   {
     std::lock_guard<std::mutex> lk(g_servers_mu);
@@ -1173,12 +1261,14 @@ int ps_serve_stop(int64_t handle) {
   }
   delete srv;
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // Extended serving counters: adds the batched-burst piece count and the
 // live connection-thread count to ps_serve_stats.
 int ps_serve_stats2(int64_t handle, int64_t* pieces, int64_t* bytes,
-                    int64_t* batched, int64_t* conns) {
+                    int64_t* batched, int64_t* conns) try {
   std::lock_guard<std::mutex> lk(g_servers_mu);
   auto it = g_servers.find(handle);
   if (it == g_servers.end()) return -1;
@@ -1187,18 +1277,22 @@ int ps_serve_stats2(int64_t handle, int64_t* pieces, int64_t* bytes,
   *batched = it->second->batched_pieces.load();
   *conns = (int64_t)it->second->conn_count.load();
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // Process-wide wedged-shutdown counters (never reset): servers leaked by
 // ps_serve_stop past the stop grace, and the stuck connection threads
 // they held.  Zero on a healthy run — test/bench teardowns assert it.
-int ps_leak_stats(int64_t* servers, int64_t* conns) {
+int ps_leak_stats(int64_t* servers, int64_t* conns) try {
   *servers = g_leaked_servers.load();
   *conns = g_leaked_conns.load();
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int ps_close(int64_t handle) {
+int ps_close(int64_t handle) try {
   // A wedged server (ps_serve_stop → 1: connection threads alive past the
   // grace) still references the store's TaskStore FILE*s — freeing it here
   // would be a use-after-free.  Leak the store alongside the leaked server
@@ -1233,6 +1327,8 @@ int ps_close(int64_t handle) {
   }
   delete ps;
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 }  // extern "C"
@@ -1268,7 +1364,7 @@ struct FetchJob {
 #pragma pack(push, 1)
 struct FetchDone {        // 24 bytes; mirrored by NativePieceFetcher.RECORD
   uint32_t number;
-  int32_t status;         // 0 ok; >0 HTTP status; -1 conn; -2 proto/len; -3 commit
+  int32_t status;         // kFetchStatusOk / >0 HTTP / kFetchStatus{Conn,Proto,Commit}
   uint32_t length;
   int32_t slot;
   int64_t cost_ns;
@@ -1353,13 +1449,6 @@ int connect_parent(const std::string& ip, uint16_t port) {
   return fd;
 }
 
-// Largest body read_response will ever allocate when the caller does not
-// know the piece length (16x the common 4 MiB piece): a hostile or
-// corrupt parent advertising `Content-Length: 9e15` must be a -2
-// protocol error, not a bad_alloc in std::string::resize — an exception
-// escaping a worker thread entry would std::terminate the whole daemon.
-constexpr int64_t kMaxFetchBody = 64LL * 1024 * 1024;
-
 // One HTTP response (head + Content-Length body) off a keep-alive client
 // socket.  Residual bytes persist in `acc` across calls so pipelined
 // responses are never dropped.  Returns the HTTP status with the body in
@@ -1371,16 +1460,17 @@ int read_response(int fd, std::string& acc, std::string* body,
   char buf[65536];
   size_t head_end;
   while ((head_end = acc.find("\r\n\r\n")) == std::string::npos) {
-    if (acc.size() > 65536) return -2;
+    if (acc.size() > 65536) return kFetchStatusProto;
     ssize_t n = recv(fd, buf, sizeof(buf), 0);
-    if (n <= 0) return -1;
+    if (n <= 0) return kFetchStatusConn;
     acc.append(buf, (size_t)n);
   }
   std::string head = acc.substr(0, head_end + 4);
   acc.erase(0, head_end + 4);
-  if (head.rfind("HTTP/1.", 0) != 0 || head.size() < 12) return -2;
+  if (head.rfind("HTTP/1.", 0) != 0 || head.size() < 12)
+    return kFetchStatusProto;
   int status = atoi(head.c_str() + 9);
-  if (status < 100) return -2;
+  if (status < 100) return kFetchStatusProto;
   std::string lower = head;
   for (auto& c : lower) c = (char)tolower(c);
   size_t p = lower.find("content-length:");
@@ -1389,13 +1479,13 @@ int read_response(int fd, std::string& acc, std::string* body,
     size_t e = lower.find("\r\n", p);
     std::string v = head.substr(p + 15, e - p - 15);
     while (!v.empty() && v.front() == ' ') v.erase(0, 1);
-    if (!parse_i64(v, &clen)) return -2;
+    if (!parse_i64(v, &clen)) return kFetchStatusProto;
   }
-  if (clen < 0) return -2;
+  if (clen < 0) return kFetchStatusProto;
   int64_t cap = expected_len > 0
                     ? std::max<int64_t>(expected_len, 64 * 1024)
                     : kMaxFetchBody;
-  if (clen > cap) return -2;
+  if (clen > cap) return kFetchStatusProto;
   // Bulk path: splice whatever body bytes already rode in with the head,
   // then recv the remainder straight into the body buffer — one copy per
   // byte instead of append+assign, and length-capped reads never overshoot
@@ -1407,7 +1497,7 @@ int read_response(int fd, std::string& acc, std::string* body,
   size_t got = have;
   while ((int64_t)got < clen) {
     ssize_t n = recv(fd, &(*body)[got], (size_t)clen - got, 0);
-    if (n <= 0) return -1;
+    if (n <= 0) return kFetchStatusConn;
     got += (size_t)n;
   }
   return status;
@@ -1419,6 +1509,12 @@ void fetch_worker(PieceFetcher* pf) {
   // residual-byte accumulator that makes pipelining safe.
   std::map<int32_t, int> socks;
   std::map<int32_t, std::string> residual;
+  // Whole drain loop inside a catch-all (DF021): the per-burst handler
+  // below already converts a throwing burst into error completions, so
+  // this outer net only catches allocation failure in the loop plumbing
+  // itself — the worker exits (sockets still closed below) and pf_close
+  // discards its queued jobs as kFetchStatusConn completions.
+  try {
   for (;;) {
     std::vector<FetchJob> burst;
     {
@@ -1434,7 +1530,7 @@ void fetch_worker(PieceFetcher* pf) {
         // deadline already fired.
         while (!pf->jobs.empty()) {
           FetchJob& j = pf->jobs.front();
-          pf->done.push_back({j.number, -1, 0, j.slot, 0});
+          pf->done.push_back({j.number, kFetchStatusConn, 0, j.slot, 0});
           pf->jobs.pop_front();
         }
         pf->cv_done.notify_all();
@@ -1443,19 +1539,20 @@ void fetch_worker(PieceFetcher* pf) {
       burst.push_back(std::move(pf->jobs.front()));
       pf->jobs.pop_front();
       // Opportunistic pipelining: pull queued jobs bound for the SAME
-      // parent+task into one request burst (up to 8) — back-to-back GETs
-      // on one socket are what trigger the server's batched submission.
-      // Byte-capped: a burst serializes its responses on ONE connection,
-      // so big pieces must spread across workers instead (an 8 x 4 MiB
-      // burst on one socket idles the other workers and LOSES to the
-      // parallel Python arm); unknown-size pieces never pipeline.
-      size_t burst_bytes = burst[0].expected_len;
+      // parent+task into one request burst (up to kFetchBurstMax) —
+      // back-to-back GETs on one socket are what trigger the server's
+      // batched submission.  Byte-capped at kBatchBytesMax: a burst
+      // serializes its responses on ONE connection, so big pieces must
+      // spread across workers instead (an 8 x 4 MiB burst on one socket
+      // idles the other workers and LOSES to the parallel Python arm);
+      // unknown-size pieces never pipeline.
+      int64_t burst_bytes = burst[0].expected_len;
       for (auto it = pf->jobs.begin();
-           it != pf->jobs.end() && burst.size() < 8 &&
-           burst[0].expected_len > 0 && burst_bytes < 512 * 1024;) {
+           it != pf->jobs.end() && burst.size() < kFetchBurstMax &&
+           burst[0].expected_len > 0 && burst_bytes < kBatchBytesMax;) {
         if (it->slot == burst[0].slot && it->task == burst[0].task &&
             it->expected_len > 0 &&
-            burst_bytes + it->expected_len <= 512 * 1024) {
+            burst_bytes + it->expected_len <= kBatchBytesMax) {
           burst_bytes += it->expected_len;
           burst.push_back(std::move(*it));
           it = pf->jobs.erase(it);
@@ -1490,7 +1587,7 @@ void fetch_worker(PieceFetcher* pf) {
         }
       }
       if (ip.empty() || port == 0) {
-        fail_rest(-1);
+        fail_rest(kFetchStatusConn);
         pf->cv_done.notify_all();
         continue;
       }
@@ -1525,7 +1622,7 @@ void fetch_worker(PieceFetcher* pf) {
         }
       }
       if (!sent) {
-        fail_rest(-1);
+        fail_rest(kFetchStatusConn);
         pf->cv_done.notify_all();
         continue;
       }
@@ -1541,17 +1638,17 @@ void fetch_worker(PieceFetcher* pf) {
           fail_rest(status);
           break;
         }
-        FetchDone d{burst[i].number, 0, 0, slot, 0};
+        FetchDone d{burst[i].number, kFetchStatusOk, 0, slot, 0};
         if (status != 200) {
           d.status = status;
         } else if (burst[i].expected_len > 0 &&
                    body.size() != burst[i].expected_len) {
-          d.status = -2;
+          d.status = kFetchStatusProto;
         } else {
           int64_t wrote = ps_write_piece(
               pf->store_handle, burst[i].task.c_str(), burst[i].number,
               (const uint8_t*)body.data(), (uint32_t)body.size());
-          d.status = wrote < 0 ? -3 : 0;
+          d.status = wrote < 0 ? kFetchStatusCommit : kFetchStatusOk;
           d.length = (uint32_t)body.size();
         }
         d.cost_ns = now_ns() - t0;
@@ -1570,9 +1667,12 @@ void fetch_worker(PieceFetcher* pf) {
         close(it->second);
         it->second = -1;
       }
-      fail_rest(-2);
+      fail_rest(kFetchStatusProto);
       pf->cv_done.notify_all();
     }
+  }
+  } catch (...) {
+    // Last-resort containment; see the comment above the loop.
   }
   for (auto& kv : socks)
     if (kv.second >= 0) close(kv.second);
@@ -1585,10 +1685,10 @@ extern "C" {
 // Open a fetch engine bound to a local piece store.  `workers` threads
 // drain the submit queue; `tenant` rides every request as the
 // X-Dragonfly-Tenant header (requester-pays upload accounting, §26/§28).
-int64_t pf_open(int64_t store_handle, int workers, const char* tenant) {
+int64_t pf_open(int64_t store_handle, int workers, const char* tenant) try {
   if (!get_store(store_handle)) return -1;
-  if (workers <= 0) workers = 4;
-  if (workers > 64) workers = 64;
+  if (workers <= 0) workers = kFetchWorkersDefault;
+  if (workers > kFetchWorkersMax) workers = kFetchWorkersMax;
   FetcherPtr pf = std::make_shared<PieceFetcher>();
   pf->store_handle = store_handle;
   pf->tenant = tenant ? tenant : "";
@@ -1600,21 +1700,25 @@ int64_t pf_open(int64_t store_handle, int workers, const char* tenant) {
   int64_t h = g_next_handle++;
   g_fetchers[h] = pf;
   return h;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // Register/replace the parent endpoint behind `slot` (Python owns parent
 // selection; slots keep the per-piece submit free of string churn).
-int pf_parent(int64_t fh, int slot, const char* ip, uint16_t port) {
+int pf_parent(int64_t fh, int slot, const char* ip, uint16_t port) try {
   FetcherPtr pf = get_fetcher(fh);
-  if (!pf || slot < 0 || slot > 255 || !ip) return -1;
+  if (!pf || slot < 0 || slot > kParentSlotMax || !ip) return -1;
   std::lock_guard<std::mutex> lk(pf->mu);
   if ((size_t)slot >= pf->parents.size()) pf->parents.resize((size_t)slot + 1);
   pf->parents[(size_t)slot] = {ip, port};
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 int pf_submit(int64_t fh, const char* task_id, int slot, uint32_t number,
-              uint32_t expected_len) {
+              uint32_t expected_len) try {
   FetcherPtr pf = get_fetcher(fh);
   if (!pf || !task_id) return -1;
   {
@@ -1624,11 +1728,13 @@ int pf_submit(int64_t fh, const char* task_id, int slot, uint32_t number,
   }
   pf->cv_jobs.notify_one();
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // Drain up to `max_records` completions into `out` (packed FetchDone
 // records).  Blocks up to timeout_ms for the first one; 0 on timeout.
-int pf_complete(int64_t fh, uint8_t* out, int max_records, int timeout_ms) {
+int pf_complete(int64_t fh, uint8_t* out, int max_records, int timeout_ms) try {
   FetcherPtr pf = get_fetcher(fh);
   if (!pf || !out || max_records <= 0) return -1;
   std::unique_lock<std::mutex> lk(pf->mu);
@@ -1657,22 +1763,26 @@ int pf_complete(int64_t fh, uint8_t* out, int max_records, int timeout_ms) {
     n++;
   }
   return n;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // Jobs not yet completed (queued + in flight is Python's submitted-minus-
 // drained count; this exposes just the queue for diagnostics).
-int64_t pf_pending(int64_t fh) {
+int64_t pf_pending(int64_t fh) try {
   FetcherPtr pf = get_fetcher(fh);
   if (!pf) return -1;
   std::lock_guard<std::mutex> lk(pf->mu);
   return (int64_t)pf->jobs.size();
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // Discard queued jobs (each becomes a -1 completion; in-flight bursts
 // finish), join workers, release the handle.  The object itself is
 // freed by the last shared_ptr holder — a racing pf_complete keeps it
 // alive past this return.
-int pf_close(int64_t fh) {
+int pf_close(int64_t fh) try {
   FetcherPtr pf;
   {
     std::lock_guard<std::mutex> lk(g_fetchers_mu);
@@ -1690,6 +1800,8 @@ int pf_close(int64_t fh) {
   for (auto& t : pf->workers)
     if (t.joinable()) t.join();
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 }  // extern "C"
@@ -1862,7 +1974,7 @@ void oi_map_locked(OnlineIngest* e, const float* buckets, int64_t n,
 extern "C" {
 
 int64_t oi_create(int32_t num_nodes, int64_t n_buckets, int32_t feat_dim,
-                  int32_t row_width, double ttl, int64_t ring_cap) {
+                  int32_t row_width, double ttl, int64_t ring_cap) try {
   if (num_nodes <= 0 || n_buckets <= 0 || feat_dim <= 0 ||
       row_width < 2 + 2 * feat_dim + 1 || ring_cap <= 0)
     return -1;
@@ -1885,6 +1997,8 @@ int64_t oi_create(int32_t num_nodes, int64_t n_buckets, int32_t feat_dim,
   int64_t h = g_oi_next++;
   g_oi[h] = e;
   return h;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // Map + accumulate + ring-append one chunk of download rows ([n, row_width]
@@ -1892,7 +2006,7 @@ int64_t oi_create(int32_t num_nodes, int64_t n_buckets, int32_t feat_dim,
 // space (backpressure) when block != 0.  Returns edges kept (overflow rows
 // dropped+counted), -1 on bad handle / closed.
 int64_t oi_feed_download_rows(int64_t h, const float* rows, int64_t n,
-                              double now, int32_t block) {
+                              double now, int32_t block) try {
   IngestPtr e = oi_get(h);
   if (!e || n < 0) return -1;
   if (n == 0) return 0;
@@ -1966,21 +2080,25 @@ int64_t oi_feed_download_rows(int64_t h, const float* rows, int64_t n,
   }
   e->cv_data.notify_all();
   return kept;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // Topology-path mapping (probe edges don't carry host features); same
 // allocation/touch semantics as the download path.
 int32_t oi_map_buckets(int64_t h, const float* buckets, int64_t n, double now,
-                       int32_t* out) {
+                       int32_t* out) try {
   IngestPtr e = oi_get(h);
   if (!e) return -1;
   std::lock_guard<std::mutex> lk(e->mu);
   oi_map_locked(e.get(), buckets, n, now, out);
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // Read-only probe (tests/diagnostics): current mapping, no allocation.
-int32_t oi_lookup(int64_t h, const float* buckets, int64_t n, int32_t* out) {
+int32_t oi_lookup(int64_t h, const float* buckets, int64_t n, int32_t* out) try {
   IngestPtr e = oi_get(h);
   if (!e) return -1;
   std::lock_guard<std::mutex> lk(e->mu);
@@ -1989,13 +2107,15 @@ int32_t oi_lookup(int64_t h, const float* buckets, int64_t n, int32_t* out) {
     out[i] = (b < 0 || b >= e->n_buckets) ? -1 : e->id_table[b];
   }
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // All-or-nothing dispatch block: copies exactly `need` edges once enough
 // have accumulated; 0 on timeout/eof-with-partial (the partial stays for
 // a later taker — same leftover semantics as the Python queue path).
 int64_t oi_take_edges(int64_t h, int64_t need, int32_t* src, int32_t* dst,
-                      float* y, int64_t timeout_ms) {
+                      float* y, int64_t timeout_ms) try {
   IngestPtr e = oi_get(h);
   if (!e || need <= 0 || need > e->cap) return -1;
   std::unique_lock<std::mutex> lk(e->mu);
@@ -2032,17 +2152,21 @@ int64_t oi_take_edges(int64_t h, int64_t need, int32_t* src, int32_t* dst,
   e->size -= need;
   e->cv_space.notify_all();
   return need;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-void oi_eof(int64_t h) {
+void oi_eof(int64_t h) try {
   IngestPtr e = oi_get(h);
   if (!e) return;
   std::lock_guard<std::mutex> lk(e->mu);
   e->eof = true;
   e->cv_data.notify_all();
+} catch (...) {
+  // DF021: never unwind through the C boundary.
 }
 
-int32_t oi_node_features(int64_t h, float* out) {
+int32_t oi_node_features(int64_t h, float* out) try {
   IngestPtr e = oi_get(h);
   if (!e) return -1;
   std::lock_guard<std::mutex> lk(e->mu);
@@ -2053,9 +2177,11 @@ int32_t oi_node_features(int64_t h, float* out) {
     for (int32_t j = 0; j < e->feat_dim; j++) o[j] = (float)(s[j] / c);
   }
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int64_t oi_take_recycled(int64_t h, int32_t* out, int64_t cap) {
+int64_t oi_take_recycled(int64_t h, int32_t* out, int64_t cap) try {
   IngestPtr e = oi_get(h);
   if (!e) return -1;
   std::lock_guard<std::mutex> lk(e->mu);
@@ -2064,17 +2190,21 @@ int64_t oi_take_recycled(int64_t h, int32_t* out, int64_t cap) {
   e->pending_recycle.erase(e->pending_recycle.begin(),
                            e->pending_recycle.begin() + k);
   return k;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int64_t oi_pending_recycled(int64_t h) {
+int64_t oi_pending_recycled(int64_t h) try {
   IngestPtr e = oi_get(h);
   if (!e) return -1;
   std::lock_guard<std::mutex> lk(e->mu);
   return (int64_t)e->pending_recycle.size();
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 int32_t oi_stats(int64_t h, int64_t* overflow, int64_t* evicted,
-                 int64_t* next_id, int64_t* rows_in) {
+                 int64_t* next_id, int64_t* rows_in) try {
   IngestPtr e = oi_get(h);
   if (!e) return -1;
   std::lock_guard<std::mutex> lk(e->mu);
@@ -2083,6 +2213,8 @@ int32_t oi_stats(int64_t h, int64_t* overflow, int64_t* evicted,
   *next_id = e->next_id;
   *rows_in = e->rows_in;
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 // Checkpoint export: refuses (-1) while recycled ids await their row reset
@@ -2090,7 +2222,7 @@ int32_t oi_stats(int64_t h, int64_t* overflow, int64_t* evicted,
 // never outrun its embedding resets.  Returns the free-list length.
 int64_t oi_export_state(int64_t h, int32_t* id_table, int64_t* bucket_of,
                         double* last_seen, int32_t* free_out, int64_t free_cap,
-                        float* feat_sum, float* feat_cnt, int64_t* scalars) {
+                        float* feat_sum, float* feat_cnt, int64_t* scalars) try {
   IngestPtr e = oi_get(h);
   if (!e) return -3;
   std::lock_guard<std::mutex> lk(e->mu);
@@ -2110,13 +2242,15 @@ int64_t oi_export_state(int64_t h, int32_t* id_table, int64_t* bucket_of,
   scalars[1] = e->overflow_edges;
   scalars[2] = e->evicted_nodes;
   return (int64_t)e->free_ids.size();
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 int32_t oi_import_state(int64_t h, const int32_t* id_table,
                         const int64_t* bucket_of, const double* last_seen,
                         const int32_t* free_in, int64_t free_len,
                         const float* feat_sum, const float* feat_cnt,
-                        int64_t next_id, int64_t overflow, int64_t evicted) {
+                        int64_t next_id, int64_t overflow, int64_t evicted) try {
   IngestPtr e = oi_get(h);
   if (!e) return -1;
   std::lock_guard<std::mutex> lk(e->mu);
@@ -2144,9 +2278,11 @@ int32_t oi_import_state(int64_t h, const int32_t* id_table,
   e->pending_recycle.clear();
   e->last_scan = -1e300;
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
-int32_t oi_destroy(int64_t h) {
+int32_t oi_destroy(int64_t h) try {
   IngestPtr e;
   {
     std::lock_guard<std::mutex> lk(g_oi_mu);
@@ -2164,6 +2300,291 @@ int32_t oi_destroy(int64_t h) {
   // Blocked feeders/takers hold their own shared_ptr; the engine frees
   // when the last of them returns.
   return 0;
+} catch (...) {
+  return kAbiTrap;  // DF021: never unwind through the C boundary
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// ABI manifest witness (DESIGN.md §30).
+//
+// DF_ABI_EXPORTS is the X-macro table of every exported symbol in the
+// canonical type vocabulary shared with records/abi_contracts.py
+// (i32/i64/u16/u32/f64/cstr/u8p/f32p/i32p/i64p/f64p/void; const dropped).
+// It is expanded twice:
+//
+//  * compile time — a static_assert per symbol pins the REAL prototype
+//    (via decltype) to the table entry, so the table cannot drift from
+//    the definitions it describes;
+//  * df_abi_manifest() — emits canonical JSON (sorted keys, compact
+//    separators, the exact bytes of Python's
+//    json.dumps(..., sort_keys=True, separators=(",", ":"))) carrying
+//    the prototype table, compiler-computed sizeof/offsetof of every
+//    packed record, and the shared-constant values.  utils/dfabi.py
+//    renders the same JSON from the registry; tests/test_zz_abiwitness
+//    requires the two byte-equal, so a compiler/padding surprise fails
+//    even when both source texts agree.
+//
+// df_abi_probe_fetchdone() additionally round-trips a sentinel FetchDone
+// record through the real struct layout (memcpy of the compiled struct,
+// not a re-statement of offsets).
+// ---------------------------------------------------------------------------
+
+#define DF_ABI_EXPORTS(X)                                                    \
+  X(i64, re_open, cstr, cstr, u32)                                           \
+  X(i64, re_append, i64, f32p, i64)                                          \
+  X(i32, re_flush, i64)                                                      \
+  X(i64, re_rows, i64)                                                       \
+  X(i32, re_close, i64)                                                      \
+  X(i64, ps_open, cstr)                                                      \
+  X(i32, ps_create_task, i64, cstr, u32, i64)                                \
+  X(i32, ps_load_task, i64, cstr)                                            \
+  X(i64, ps_write_piece, i64, cstr, u32, u8p, u32)                           \
+  X(i64, ps_read_piece, i64, cstr, u32, u8p, u32, i32)                       \
+  X(i64, ps_piece_count, i64, cstr)                                          \
+  X(i32, ps_piece_bitmap, i64, cstr, u8p, u32)                               \
+  X(i64, ps_task_bytes, i64, cstr)                                           \
+  X(i64, ps_content_length, i64, cstr)                                       \
+  X(i64, ps_piece_size, i64, cstr)                                           \
+  X(i32, ps_delete_task, i64, cstr)                                          \
+  X(i64, ps_serve, i64, cstr, u16, i32)                                      \
+  X(i32, ps_serve_stop, i64)                                                 \
+  X(i32, ps_serve_stats2, i64, i64p, i64p, i64p, i64p)                       \
+  X(i32, ps_leak_stats, i64p, i64p)                                          \
+  X(i32, ps_close, i64)                                                      \
+  X(i64, pf_open, i64, i32, cstr)                                            \
+  X(i32, pf_parent, i64, i32, cstr, u16)                                     \
+  X(i32, pf_submit, i64, cstr, i32, u32, u32)                                \
+  X(i32, pf_complete, i64, u8p, i32, i32)                                    \
+  X(i64, pf_pending, i64)                                                    \
+  X(i32, pf_close, i64)                                                      \
+  X(i64, oi_create, i32, i64, i32, i32, f64, i64)                            \
+  X(i64, oi_feed_download_rows, i64, f32p, i64, f64, i32)                    \
+  X(i32, oi_map_buckets, i64, f32p, i64, f64, i32p)                          \
+  X(i32, oi_lookup, i64, f32p, i64, i32p)                                    \
+  X(i64, oi_take_edges, i64, i64, i32p, i32p, f32p, i64)                     \
+  X(void, oi_eof, i64)                                                       \
+  X(i32, oi_node_features, i64, f32p)                                        \
+  X(i64, oi_take_recycled, i64, i32p, i64)                                   \
+  X(i64, oi_pending_recycled, i64)                                           \
+  X(i32, oi_stats, i64, i64p, i64p, i64p, i64p)                              \
+  X(i64, oi_export_state, i64, i32p, i64p, f64p, i32p, i64, f32p, f32p,      \
+    i64p)                                                                    \
+  X(i32, oi_import_state, i64, i32p, i64p, f64p, i32p, i64, f32p, f32p,      \
+    i64, i64, i64)                                                           \
+  X(i32, oi_destroy, i64)                                                    \
+  X(cstr, df_abi_manifest)                                                   \
+  X(i32, df_abi_probe_fetchdone, u8p, u32)
+
+// Shared integer constants re-emitted by the manifest (the string magics
+// kMagic/kTaskMagic are added by hand below — different JSON rendering).
+#define DF_ABI_CONSTANTS(X)                                                  \
+  X(kAbiTrap) X(kBatchBytesMax) X(kBatchMax) X(kFetchBurstMax)               \
+  X(kFetchStatusCommit) X(kFetchStatusConn) X(kFetchStatusOk)                \
+  X(kFetchStatusProto) X(kFetchWorkersDefault) X(kFetchWorkersMax)           \
+  X(kLongPollMaxMs) X(kMaxFetchBody) X(kParentSlotMax)                       \
+  X(kPieceFlagCommitted) X(kPieceFlagVerified) X(kServeLimitDefault)
+
+namespace dfabi {
+
+// Canonical type vocabulary.  Pointer aliases are spelled without const;
+// norm_fn below drops const from the real prototypes before comparison,
+// so `const float*` in a definition still matches f32p.
+using i32 = int32_t;
+using i64 = int64_t;
+using u16 = uint16_t;
+using u32 = uint32_t;
+using f64 = double;
+using cstr = const char*;
+using u8p = uint8_t*;
+using f32p = float*;
+using i32p = int32_t*;
+using i64p = int64_t*;
+using f64p = double*;
+
+template <typename T>
+struct norm_t {
+  using type = T;
+};
+template <typename T>
+struct norm_t<const T*> {
+  using type = T*;
+};
+template <typename F>
+struct norm_fn;
+template <typename R, typename... A>
+struct norm_fn<R (*)(A...)> {
+  using type = typename norm_t<R>::type (*)(typename norm_t<A>::type...);
+};
+
+// {"k":v,...} from pre-rendered JSON values; std::map iterates sorted,
+// which IS the canonical key order.
+inline std::string json_obj(const std::map<std::string, std::string>& m) {
+  std::string s = "{";
+  bool first = true;
+  for (const auto& kv : m) {
+    if (!first) s += ",";
+    first = false;
+    s += "\"";
+    s += kv.first;
+    s += "\":";
+    s += kv.second;
+  }
+  s += "}";
+  return s;
+}
+
+// ["ret","arg",...] from the stringified X-macro entry ("i64, cstr, u32").
+inline std::string json_sig(const char* ret, const char* args) {
+  std::string s = "[\"";
+  s += ret;
+  s += "\"";
+  std::string a(args);
+  size_t i = 0;
+  while (i < a.size()) {
+    while (i < a.size() && (a[i] == ' ' || a[i] == ',')) i++;
+    size_t j = i;
+    while (j < a.size() && a[j] != ',' && a[j] != ' ') j++;
+    if (j > i) {
+      s += ",\"";
+      s.append(a, i, j - i);
+      s += "\"";
+    }
+    i = j;
+  }
+  s += "]";
+  return s;
+}
+
+struct FieldInfo {
+  const char* name;
+  long long off;
+  long long size;
+};
+
+// {"fields":[["name",off,size],...],"size":N} — field order is layout
+// order, NOT sorted; "fields" < "size" keeps the object keys canonical.
+inline std::string json_record(const FieldInfo* f, size_t n,
+                               long long total) {
+  std::string s = "{\"fields\":[";
+  for (size_t i = 0; i < n; i++) {
+    if (i) s += ",";
+    s += "[\"";
+    s += f[i].name;
+    s += "\",";
+    s += std::to_string(f[i].off);
+    s += ",";
+    s += std::to_string(f[i].size);
+    s += "]";
+  }
+  s += "],\"size\":";
+  s += std::to_string(total);
+  s += "}";
+  return s;
+}
+
+#define DF_ABI_FIELD(rec_t, fld)                                   \
+  {#fld, (long long)offsetof(rec_t, fld),                          \
+   (long long)sizeof(((rec_t*)nullptr)->fld)}
+
+inline const std::string& manifest_json() {
+  static const std::string out = [] {
+    std::map<std::string, std::string> exports;
+#define DF_ABI_EXPORT_JSON(ret, name, ...) \
+  exports[#name] = json_sig(#ret, "" #__VA_ARGS__);
+    DF_ABI_EXPORTS(DF_ABI_EXPORT_JSON)
+#undef DF_ABI_EXPORT_JSON
+
+    std::map<std::string, std::string> constants;
+#define DF_ABI_CONST_JSON(name) \
+  constants[#name] = std::to_string((long long)(name));
+    DF_ABI_CONSTANTS(DF_ABI_CONST_JSON)
+#undef DF_ABI_CONST_JSON
+    constants["kMagic"] = std::string("\"") + kMagic + "\"";
+    constants["kTaskMagic"] = std::string("\"") + kTaskMagic + "\"";
+
+    std::map<std::string, std::string> records;
+    {
+      const FieldInfo f[] = {
+          DF_ABI_FIELD(FetchDone, number), DF_ABI_FIELD(FetchDone, status),
+          DF_ABI_FIELD(FetchDone, length), DF_ABI_FIELD(FetchDone, slot),
+          DF_ABI_FIELD(FetchDone, cost_ns),
+      };
+      records["FetchDone"] = json_record(f, 5, (long long)sizeof(FetchDone));
+    }
+    {
+      const FieldInfo f[] = {
+          DF_ABI_FIELD(PieceMeta, number), DF_ABI_FIELD(PieceMeta, length),
+          DF_ABI_FIELD(PieceMeta, offset), DF_ABI_FIELD(PieceMeta, crc),
+          DF_ABI_FIELD(PieceMeta, flags),
+      };
+      records["PieceMeta"] = json_record(f, 5, (long long)sizeof(PieceMeta));
+    }
+    {
+      const FieldInfo f[] = {
+          DF_ABI_FIELD(TaskHeader, magic),
+          DF_ABI_FIELD(TaskHeader, piece_size),
+          DF_ABI_FIELD(TaskHeader, content_length),
+      };
+      records["TaskHeader"] =
+          json_record(f, 3, (long long)sizeof(TaskHeader));
+    }
+
+    std::string s = "{\"constants\":";
+    s += json_obj(constants);
+    s += ",\"exports\":";
+    s += json_obj(exports);
+    s += ",\"records\":";
+    s += json_obj(records);
+    s += ",\"version\":1}";
+    return s;
+  }();
+  return out;
+}
+
+}  // namespace dfabi
+
+extern "C" {
+
+// Self-description of the compiled ABI surface (canonical JSON; see the
+// section comment).  The string is owned by a function-local static —
+// valid for the life of the process, never freed through the ABI.
+const char* df_abi_manifest() try {
+  return dfabi::manifest_json().c_str();
+} catch (...) {
+  return nullptr;
+}
+
+// Fill `out` with a sentinel FetchDone record (memcpy of the compiled
+// struct): every field carries a distinguishable value so the ctypes
+// side can prove its unpack format reads each field from the right
+// bytes.  Returns sizeof(FetchDone), or -1 when out_len is short.
+int32_t df_abi_probe_fetchdone(uint8_t* out, uint32_t out_len) try {
+  if (!out || out_len < sizeof(FetchDone)) return -1;
+  FetchDone d{};
+  d.number = 0xA1B2C3D4u;
+  d.status = kFetchStatusProto;  // a real status constant crosses too
+  d.length = 0x00C0FFEEu;
+  d.slot = -7;
+  d.cost_ns = 0x0102030405060708LL;
+  memcpy(out, &d, sizeof(FetchDone));
+  return (int32_t)sizeof(FetchDone);
+} catch (...) {
+  return kAbiTrap;
+}
+
+}  // extern "C"
+
+// Compile-time prototype pinning: the table cannot drift from the real
+// definitions (a changed parameter type here is a build break naming the
+// symbol, before any test runs).
+namespace dfabi {
+#define DF_ABI_ASSERT(ret, name, ...)                                    \
+  static_assert(                                                         \
+      std::is_same<norm_fn<decltype(&::name)>::type,                     \
+                   norm_fn<ret (*)(__VA_ARGS__)>::type>::value,          \
+      "ABI drift: " #name " does not match the DF_ABI_EXPORTS table");
+DF_ABI_EXPORTS(DF_ABI_ASSERT)
+#undef DF_ABI_ASSERT
+}  // namespace dfabi

@@ -477,6 +477,31 @@ int main() {
     assert(ps_close(dst) == 0);
   }
 
+  // 7. ABI manifest witness (DESIGN.md §30): the compiled self-description
+  // must exist and carry the layout facts the ctypes side depends on, and
+  // the probe export must round-trip the sentinel through the REAL struct.
+  {
+    static_assert(sizeof(FetchDone) == 24, "FetchDone wire size");
+    const char* m = df_abi_manifest();
+    assert(m != nullptr);
+    std::string mj(m);
+    assert(mj.find("\"version\":1") != std::string::npos);
+    assert(mj.find("\"df_abi_probe_fetchdone\"") != std::string::npos);
+    assert(mj.find("\"kBatchBytesMax\":524288") != std::string::npos);
+    assert(df_abi_manifest() == m);  // stable pointer, never freed
+
+    uint8_t buf[sizeof(FetchDone)];
+    assert(df_abi_probe_fetchdone(buf, sizeof(buf)) ==
+           (int32_t)sizeof(FetchDone));
+    FetchDone d;
+    memcpy(&d, buf, sizeof(d));
+    assert(d.number == 0xA1B2C3D4u && d.status == kFetchStatusProto &&
+           d.length == 0x00C0FFEEu && d.slot == -7 &&
+           d.cost_ns == 0x0102030405060708LL);
+    assert(df_abi_probe_fetchdone(buf, sizeof(buf) - 1) == -1);
+    assert(df_abi_probe_fetchdone(nullptr, 64) == -1);
+  }
+
   printf("native_test: OK\n");
   return 0;
 }

@@ -28,7 +28,11 @@ from typing import Iterator, Sequence
 
 import numpy as np
 
-MAGIC = b"DFC1"
+# Shared with the C++ record engine (native.cpp kMagic) — the ABI
+# registry pins both sides to the same 4 bytes (DF020).
+from . import abi_contracts as _abi
+
+MAGIC = _abi.constant("kMagic").encode("ascii")
 _LEN_FMT = "<I"
 
 

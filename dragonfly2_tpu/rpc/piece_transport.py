@@ -41,11 +41,17 @@ from http.server import BaseHTTPRequestHandler
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..daemon.upload import UploadBusy, UploadManager
+from ..records import abi_contracts as _abi
 from ..utils.metrics import default_registry as _mreg
 from ._server import ThreadedHTTPService
 from .retry import retry_call
 
 logger = logging.getLogger(__name__)
+
+# Long-poll wait clamp for /tasks/<id>/pieces — shared with the native
+# in-engine server (native.cpp kLongPollMaxMs) via the ABI registry so
+# both planes defer at most the same bound (DF020).
+LONG_POLL_MAX_MS = _abi.constant("kLongPollMaxMs")
 
 # Fleet telemetry sketch (DESIGN.md §23): the transport-level fetch wall
 # (dial + request + body, retries included) — the layer below the
@@ -175,7 +181,9 @@ class PieceHTTPServer:
                         q = dict(_parse.parse_qsl(split.query))
                         try:
                             have = int(q.get("have", -1))
-                            wait_ms = min(int(q.get("wait_ms", 0)), 30_000)
+                            wait_ms = min(
+                                int(q.get("wait_ms", 0)), LONG_POLL_MAX_MS
+                            )
                         except ValueError:
                             self.send_error(400)
                             return
@@ -326,7 +334,7 @@ class NativePieceServer:
     @property
     def upload_count(self) -> int:
         """Pieces served (UploadManager.upload_count parity — the C++
-        server accounts in-engine, ps_serve_stats)."""
+        server accounts in-engine, ps_serve_stats2)."""
         return self._engine.serve_stats()[0]
 
     @property

@@ -134,6 +134,22 @@ if os.environ.get("DF_DET_WITNESS", "1") != "0":
 
     _dfdet.install(str(_REPO / "dragonfly2_tpu"))
 
+# -- 2f. ABI witness (dfabi) -------------------------------------------------
+# Bookkeeping-only install (the native .so is NOT built or loaded here —
+# most tier-1 tests never touch native; the witness test triggers the
+# lazy load itself).  tests/test_zz_abiwitness.py requires the compiled
+# library's df_abi_manifest() to byte-match the canonical JSON rendered
+# from records/abi_contracts.py and round-trips a sentinel FetchDone
+# through df_abi_probe_fetchdone() — the runtime half of the DF020/DF021
+# ABI contract (DESIGN.md §30).  Set DF_ABI_WITNESS=0 to disable.
+
+if os.environ.get("DF_ABI_WITNESS", "1") != "0":
+    if str(_REPO) not in sys.path:
+        sys.path.insert(0, str(_REPO))
+    from dragonfly2_tpu.utils import dfabi as _dfabi
+
+    _dfabi.install(str(_REPO / "dragonfly2_tpu"))
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

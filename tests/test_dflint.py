@@ -4325,3 +4325,401 @@ class TestDetMutationSensitivity:
         out = capsys.readouterr().out
         assert rc == 0, out
         assert "0 new finding(s)" in out
+
+
+# ---------------------------------------------------------------------------
+# DF020 / DF021 — native ABI contract parity + exception containment
+# (tools/dflint/checkers/df020_abi.py / df021_nativeexc.py, DESIGN.md §30)
+# ---------------------------------------------------------------------------
+
+import ast  # noqa: E402
+
+from tools.dflint.checkers import df020_abi, df021_nativeexc  # noqa: E402
+
+
+_ABI_FX_CPP = """\
+constexpr int32_t kFoo = 3 + 4;
+constexpr int64_t kBig = 512 * 1024;
+constexpr char kTag[] = "ABCD";
+
+#pragma pack(push, 1)
+struct Rec {
+  uint32_t a;
+  int64_t b;
+};
+#pragma pack(pop)
+
+static std::map<int64_t, RecPtr> g_recs;
+static std::map<int64_t, Widget*> g_widgets;
+
+extern "C" {
+
+int32_t do_thing(int64_t handle, const uint8_t* buf, uint32_t len) try {
+  return 0;
+} catch (...) {
+  return kAbiTrap;
+}
+
+}  // extern "C"
+"""
+
+_ABI_FX_CONTRACTS = {
+    "exports": {"do_thing": ["i32", "i64", "u8p", "u32"]},
+    "records": {
+        "Rec": {"fields": [["a", "u32"], ["b", "i64"]], "size": 12},
+    },
+    "constants": {"kFoo": 7, "kBig": 524288, "kTag": "ABCD"},
+    "handle_families": {
+        "rec_": {"registry": "g_recs", "lifetime": "shared_ptr"},
+        "widget_": {"registry": "g_widgets", "lifetime": "raw"},
+    },
+}
+
+_ABI_FX_BINDINGS = """\
+import ctypes
+
+i32 = ctypes.c_int32
+i64 = ctypes.c_int64
+u32 = ctypes.c_uint32
+p8 = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _declare(lib):
+    lib.do_thing.restype = i32
+    lib.do_thing.argtypes = [i64, p8, u32]
+"""
+
+
+def _abi_fixture_findings(cpp_src=None, contracts=None, bindings_src=None):
+    cpp = df020_abi.extract_cpp(cpp_src if cpp_src is not None else _ABI_FX_CPP)
+    py = df020_abi.extract_bindings(
+        ast.parse(bindings_src if bindings_src is not None else _ABI_FX_BINDINGS)
+    )
+    got = df020_abi.compare_all(
+        contracts if contracts is not None else _ABI_FX_CONTRACTS, cpp, py
+    )
+    return [msg for _node, msg in got]
+
+
+class TestDF020Fixtures:
+    def test_consistent_fixture_is_clean(self):
+        assert _abi_fixture_findings() == []
+
+    def test_widened_c_param_named(self):
+        msgs = _abi_fixture_findings(
+            cpp_src=_ABI_FX_CPP.replace("uint32_t len", "uint64_t len")
+        )
+        assert any("do_thing" in m and "C parameters" in m for m in msgs)
+
+    def test_c_return_drift_named(self):
+        msgs = _abi_fixture_findings(
+            cpp_src=_ABI_FX_CPP.replace("int32_t do_thing", "int64_t do_thing")
+        )
+        assert any("do_thing" in m and "return type" in m for m in msgs)
+
+    def test_record_field_swap_named(self):
+        msgs = _abi_fixture_findings(
+            cpp_src=_ABI_FX_CPP.replace(
+                "  uint32_t a;\n  int64_t b;", "  int64_t b;\n  uint32_t a;"
+            )
+        )
+        assert any("record Rec" in m and "layout" in m for m in msgs)
+
+    def test_record_size_mismatch_named(self):
+        bad = {**_ABI_FX_CONTRACTS,
+               "records": {"Rec": {"fields": [["a", "u32"], ["b", "i64"]],
+                                   "size": 16}}}
+        msgs = _abi_fixture_findings(contracts=bad)
+        assert any("record Rec" in m and "size 16" in m for m in msgs)
+
+    def test_int_constant_drift_named(self):
+        bad = {**_ABI_FX_CONTRACTS,
+               "constants": {**_ABI_FX_CONTRACTS["constants"], "kBig": 262144}}
+        msgs = _abi_fixture_findings(contracts=bad)
+        assert any("kBig" in m and "262144" in m for m in msgs)
+
+    def test_string_constant_drift_named(self):
+        msgs = _abi_fixture_findings(
+            cpp_src=_ABI_FX_CPP.replace('kTag[] = "ABCD"', 'kTag[] = "ABCE"')
+        )
+        assert any("kTag" in m for m in msgs)
+
+    def test_undeclared_constant_named(self):
+        msgs = _abi_fixture_findings(
+            cpp_src=_ABI_FX_CPP + "\nconstexpr int32_t kGhost = 9;\n"
+        )
+        assert any("kGhost" in m and "undeclared shared constant" in m
+                   for m in msgs)
+
+    def test_stale_registry_export_named(self):
+        bad = {**_ABI_FX_CONTRACTS,
+               "exports": {**_ABI_FX_CONTRACTS["exports"],
+                           "ghost_fn": ["i32", "i64"]}}
+        msgs = _abi_fixture_findings(contracts=bad)
+        assert any("stale registry export: ghost_fn" in m for m in msgs)
+
+    def test_exported_but_undeclared_named(self):
+        extra = _ABI_FX_CPP.replace(
+            "}  // extern \"C\"",
+            "int32_t rogue_fn(int64_t h) try { return 0; } "
+            "catch (...) { return kAbiTrap; }\n\n}  // extern \"C\"",
+        )
+        msgs = _abi_fixture_findings(cpp_src=extra)
+        assert any("exported-but-undeclared: rogue_fn" in m for m in msgs)
+
+    def test_exported_but_unbound_named(self):
+        stripped = _ABI_FX_BINDINGS.replace(
+            "    lib.do_thing.restype = i32\n"
+            "    lib.do_thing.argtypes = [i64, p8, u32]\n",
+            "    pass\n",
+        )
+        msgs = _abi_fixture_findings(bindings_src=stripped)
+        assert any("exported-but-unbound: do_thing" in m for m in msgs)
+
+    def test_bound_but_undeclared_named(self):
+        extra = _ABI_FX_BINDINGS + (
+            "    lib.mystery_fn.restype = i32\n"
+            "    lib.mystery_fn.argtypes = [i64]\n"
+        )
+        msgs = _abi_fixture_findings(bindings_src=extra)
+        assert any("bound-but-undeclared" in m and "mystery_fn" in m
+                   for m in msgs)
+
+    def test_ctypes_argtype_drift_named(self):
+        drift = _ABI_FX_BINDINGS.replace("[i64, p8, u32]", "[i64, p8, i64]")
+        msgs = _abi_fixture_findings(bindings_src=drift)
+        assert any("do_thing" in m and "ctypes argtypes" in m for m in msgs)
+
+    def test_handle_lifetime_mismatch_named(self):
+        bad = {**_ABI_FX_CONTRACTS,
+               "handle_families": {"widget_": {"registry": "g_widgets",
+                                               "lifetime": "shared_ptr"}}}
+        msgs = _abi_fixture_findings(contracts=bad)
+        assert any("handle family widget_" in m for m in msgs)
+
+    def test_missing_handle_registry_named(self):
+        bad = {**_ABI_FX_CONTRACTS,
+               "handle_families": {"gone_": {"registry": "g_gone",
+                                             "lifetime": "raw"}}}
+        msgs = _abi_fixture_findings(contracts=bad)
+        assert any("handle family gone_" in m and "g_gone" in m for m in msgs)
+
+
+class TestDF021Fixtures:
+    def _msgs(self, cpp_src):
+        return list(
+            df021_nativeexc.findings_for_cpp(df020_abi.extract_cpp(cpp_src))
+        )
+
+    def test_function_try_block_is_clean(self):
+        assert self._msgs(_ABI_FX_CPP) == []
+
+    def test_depth1_try_catch_all_is_clean(self):
+        src = _ABI_FX_CPP.replace(
+            ") try {\n  return 0;\n} catch (...) {\n  return kAbiTrap;\n}",
+            ") {\n  try {\n    return 0;\n  } catch (...) {\n"
+            "    return kAbiTrap;\n  }\n}",
+        )
+        assert src != _ABI_FX_CPP
+        assert self._msgs(src) == []
+
+    def test_uncontained_export_named(self):
+        src = _ABI_FX_CPP.replace(
+            ") try {\n  return 0;\n} catch (...) {\n  return kAbiTrap;\n}",
+            ") {\n  return 0;\n}",
+        )
+        assert src != _ABI_FX_CPP
+        msgs = self._msgs(src)
+        assert any("do_thing" in m and "no catch-all" in m for m in msgs)
+
+    def test_typed_catch_only_is_not_containment(self):
+        src = _ABI_FX_CPP.replace(
+            "} catch (...) {\n  return kAbiTrap;\n}",
+            "} catch (const std::exception&) {\n  return kAbiTrap;\n}",
+        )
+        assert src != _ABI_FX_CPP
+        msgs = self._msgs(src)
+        assert any("do_thing" in m for m in msgs)
+
+    def test_pragma_suppresses(self):
+        src = _ABI_FX_CPP.replace(
+            ") try {\n  return 0;\n} catch (...) {\n  return kAbiTrap;\n}",
+            ") {  // dflint: disable=DF021\n  return 0;\n}",
+        )
+        assert src != _ABI_FX_CPP
+        assert self._msgs(src) == []
+
+    def test_uncontained_thread_entry_named(self):
+        src = _ABI_FX_CPP + (
+            "\nstatic void worker(int64_t h) {\n  spin(h);\n}\n"
+            "static void start() {\n  std::thread(worker, 1).detach();\n}\n"
+        )
+        msgs = self._msgs(src)
+        assert any("thread entry worker" in m and "std::terminate" in m
+                   for m in msgs)
+
+    def test_contained_thread_entry_is_clean(self):
+        src = _ABI_FX_CPP + (
+            "\nstatic void worker(int64_t h) {\n  try {\n    spin(h);\n"
+            "  } catch (...) {\n  }\n}\n"
+            "static void start() {\n  std::thread(worker, 1).detach();\n}\n"
+        )
+        assert self._msgs(src) == []
+
+
+def _abi_real_inputs():
+    cpp_text = (REPO / df020_abi.NATIVE_RELPATH).read_text(encoding="utf-8")
+    contracts_text = (REPO / df020_abi.CONTRACTS_RELPATH).read_text(
+        encoding="utf-8"
+    )
+    bindings_text = (REPO / df020_abi.BINDINGS_RELPATH).read_text(
+        encoding="utf-8"
+    )
+    return cpp_text, contracts_text, bindings_text
+
+
+def _abi_real_findings(cpp_text, contracts_text, bindings_text):
+    contracts = df020_abi.load_contracts_text(contracts_text)
+    assert contracts is not None, "ABI_CONTRACTS must stay a pure literal"
+    cpp = df020_abi.extract_cpp(cpp_text)
+    tree = ast.parse(bindings_text)
+
+    def read_tree(relpath):
+        p = REPO / relpath
+        if not p.exists():
+            return None
+        return ast.parse(p.read_text(encoding="utf-8"))
+
+    msgs = [
+        m
+        for _n, m in df020_abi.compare_all(
+            contracts, cpp, df020_abi.extract_bindings(tree),
+            tree=tree, read_tree=read_tree,
+        )
+    ]
+    msgs += list(df021_nativeexc.findings_for_cpp(cpp))
+    return msgs
+
+
+class TestAbiMutationSensitivity:
+    """ISSUE acceptance: the four canonical ABI drifts against the REAL
+    tree each fail by rule/symbol name, and the pristine tree is clean
+    (the checkers run on disk state, so mutations are applied to in-
+    memory copies of the real sources)."""
+
+    def test_real_tree_is_clean(self):
+        cpp_text, contracts_text, bindings_text = _abi_real_inputs()
+        assert _abi_real_findings(cpp_text, contracts_text, bindings_text) == []
+
+    def test_real_tree_sweep_emits_no_df020_df021(self):
+        relpath = df020_abi.BINDINGS_RELPATH
+        module = Module(
+            REPO / relpath, relpath,
+            (REPO / relpath).read_text(encoding="utf-8"),
+        )
+        fs = [f for f in run_checkers(module) if f.rule in ("DF020", "DF021")]
+        assert fs == [], [f.render() for f in fs]
+
+    def test_widening_ps_write_piece_argtype_fails_df020(self):
+        cpp_text, contracts_text, bindings_text = _abi_real_inputs()
+        needle = "const uint8_t* data, uint32_t length) try {"
+        assert needle in cpp_text, "ps_write_piece signature drifted"
+        msgs = _abi_real_findings(
+            cpp_text.replace(
+                needle, "const uint8_t* data, uint64_t length) try {", 1
+            ),
+            contracts_text, bindings_text,
+        )
+        assert any("ps_write_piece" in m and "C parameters" in m
+                   for m in msgs), msgs
+
+    def test_reordering_fetchdone_fields_fails_df020(self):
+        cpp_text, contracts_text, bindings_text = _abi_real_inputs()
+        status_line = (
+            "  int32_t status;         // kFetchStatusOk / >0 HTTP / "
+            "kFetchStatus{Conn,Proto,Commit}\n"
+        )
+        needle = status_line + "  uint32_t length;"
+        assert needle in cpp_text, "FetchDone layout anchor drifted"
+        msgs = _abi_real_findings(
+            cpp_text.replace(needle, "  uint32_t length;\n" + status_line.rstrip("\n")),
+            contracts_text, bindings_text,
+        )
+        assert any("record FetchDone" in m and "layout" in m for m in msgs), msgs
+
+    def test_registry_constant_drift_fails_df020(self):
+        cpp_text, contracts_text, bindings_text = _abi_real_inputs()
+        needle = '"kBatchBytesMax": 524288,'
+        assert needle in contracts_text, "registry constant anchor drifted"
+        msgs = _abi_real_findings(
+            cpp_text,
+            contracts_text.replace(needle, '"kBatchBytesMax": 262144,'),
+            bindings_text,
+        )
+        assert any("kBatchBytesMax" in m and "262144" in m for m in msgs), msgs
+
+    def test_stripping_accept_loop_catch_fails_df021(self):
+        cpp_text, contracts_text, bindings_text = _abi_real_inputs()
+        needle = "void accept_loop(HttpServer* srv) try {"
+        assert needle in cpp_text, "accept_loop signature drifted"
+        mutated = cpp_text.replace(
+            needle, "void accept_loop(HttpServer* srv) {", 1
+        )
+        msgs = _abi_real_findings(mutated, contracts_text, bindings_text)
+        assert any("thread entry accept_loop" in m for m in msgs), msgs
+
+
+class TestAbiManifestStaleness:
+    """DESIGN.md §30's committed manifest block must match a fresh
+    emission — same discipline as the lock-graph and det-inventory
+    blocks."""
+
+    def test_design_md_abi_manifest_is_current(self):
+        from tools.dflint.__main__ import (
+            ABI_MANIFEST_BEGIN, ABI_MANIFEST_END, render_abi_manifest,
+        )
+
+        text = (REPO / "DESIGN.md").read_text(encoding="utf-8")
+        begin = text.find(ABI_MANIFEST_BEGIN)
+        end = text.find(ABI_MANIFEST_END)
+        assert begin >= 0 and end > begin, "DESIGN.md §30 manifest markers missing"
+        committed = text[begin : end + len(ABI_MANIFEST_END)]
+        fresh = render_abi_manifest(REPO)
+        assert committed == fresh, (
+            "DESIGN.md §30 abi manifest is stale — regenerate with "
+            "`python -m tools.dflint --update-abi-manifest DESIGN.md`"
+        )
+
+    def test_update_abi_manifest_rewrites_in_place(self, tmp_path):
+        from tools.dflint.__main__ import update_abi_manifest_file
+
+        doc = tmp_path / "DESIGN.md"
+        doc.write_text(
+            "# doc\n\n<!-- dflint:abi-manifest:begin -->\nstale\n"
+            "<!-- dflint:abi-manifest:end -->\ntail\n"
+        )
+        assert update_abi_manifest_file(doc, REPO) is True
+        body = doc.read_text()
+        assert "stale" not in body and '"version": 1' in body and "tail" in body
+        # idempotent: a second run reports no change
+        assert update_abi_manifest_file(doc, REPO) is False
+
+
+class TestCLIAbiRules:
+    def test_cli_rule_filter_selects_df020_df021(self, capsys):
+        from tools.dflint.__main__ import main
+
+        # Both rules anchor on the bindings module, so sweeping just
+        # native/ exercises them fully without re-parsing the tree.
+        rc = main(["dragonfly2_tpu/native", "--rule", "DF020,DF021", "-q"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "0 new finding(s)" in out
+
+    def test_cli_emit_abi_manifest(self, capsys):
+        from tools.dflint.__main__ import main
+
+        rc = main(["--emit-abi-manifest"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "df_abi_manifest" in out and "sha256" in out

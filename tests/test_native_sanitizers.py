@@ -1,0 +1,78 @@
+"""Sanitizer tier-1 gate for the C++ engine (DESIGN.md §30).
+
+``make -C dragonfly2_tpu/native check/asan/tsan/ubsan`` build and run
+the native self-test under each sanitizer; this module makes the RESULT
+part of the Python tier-1 bar by re-running whichever instrumented
+binaries are already built.  Compilation stays out of tier-1 (the asan
+link alone is ~10s and needs the toolchain) — each test runs an
+existing binary or skips clean, so a checkout without the build step
+loses coverage but not greenness, while any tree that ran the Makefile
+gates (CI does) gets the sanitizer verdicts enforced, not just logged.
+
+The binaries exercise the full engine surface including the §30 ABI
+manifest section (static_asserts compile into every build; section 7 of
+native_test checks df_abi_manifest/df_abi_probe_fetchdone at runtime),
+so a sanitizer hit in the witness path fails here by name.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from pathlib import Path
+
+import pytest
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent / "dragonfly2_tpu" / "native"
+
+# (binary, env the Makefile target runs it with)
+GATES = {
+    "plain": ("native_test", {}),
+    "asan": ("native_test_asan", {"ASAN_OPTIONS": "detect_leaks=1"}),
+    "tsan": ("native_test_tsan", {"TSAN_OPTIONS": "halt_on_error=1"}),
+    "ubsan": (
+        "native_test_ubsan",
+        {"UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1"},
+    ),
+}
+
+
+def _run_gate(kind: str) -> None:
+    binary, extra_env = GATES[kind]
+    path = NATIVE_DIR / binary
+    if not path.exists():
+        pytest.skip(f"{binary} not built (run `make -C dragonfly2_tpu/native "
+                    f"{'test' if kind == 'plain' else kind}`)")
+    env = dict(os.environ, **extra_env)
+    proc = subprocess.run(
+        [str(path)],
+        cwd=str(NATIVE_DIR),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, (
+        f"{binary} exited {proc.returncode}\n"
+        f"--- stdout ---\n{proc.stdout[-4000:]}\n"
+        f"--- stderr ---\n{proc.stderr[-4000:]}"
+    )
+    # the binary's own success marker, so a crash after the last assert
+    # (or an exec of the wrong file) cannot pass on exit-code luck
+    assert "native_test: OK" in proc.stdout, (
+        f"{binary} exited 0 without the success marker:\n{proc.stdout[-2000:]}"
+    )
+
+
+class TestNativeSanitizerGates:
+    def test_plain_self_test(self):
+        _run_gate("plain")
+
+    def test_asan_gate(self):
+        _run_gate("asan")
+
+    def test_tsan_gate(self):
+        _run_gate("tsan")
+
+    def test_ubsan_gate(self):
+        _run_gate("ubsan")

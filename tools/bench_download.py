@@ -646,7 +646,7 @@ def run(
             "legacy_sendfile_serves": getattr(
                 nodes["legacy"]["seed"].server, "sendfile_serves", 0
             ),
-            # In-engine serve accounting (ps_serve_stats) when the
+            # In-engine serve accounting (ps_serve_stats2) when the
             # native server carried the pipelined arms.
             "native_serves": sum(
                 getattr(n.server, "upload_count", 0)

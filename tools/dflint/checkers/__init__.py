@@ -15,6 +15,8 @@ from . import (
     df007_hotpath,
     df016_spans,
     df017_metrics,
+    df020_abi,
+    df021_nativeexc,
 )
 
 CHECKERS = (
@@ -27,6 +29,8 @@ CHECKERS = (
     df007_hotpath,
     df016_spans,
     df017_metrics,
+    df020_abi,
+    df021_nativeexc,
 )
 
 RULES = {c.RULE: c for c in CHECKERS}

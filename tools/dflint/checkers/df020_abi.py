@@ -1,0 +1,952 @@
+"""DF020 — native ABI contract parity (DESIGN.md §30).
+
+The native data plane crosses a C ABI: ``native/src/native.cpp`` exports
+~40 ``extern "C"`` symbols that the hand-maintained ctypes table in
+``native/__init__.py`` binds, plus packed records and shared constants
+both sides restate.  Drift on either side compiles clean and corrupts
+memory at runtime — a widened parameter, a reordered field in the packed
+24-byte FetchDone completion, a constant changed on one side.
+
+``records/abi_contracts.py`` (read with ``ast.literal_eval`` — dflint
+never imports project code) is the single declaration.  This checker
+anchors on the bindings module and cross-checks THREE views of the
+boundary against each other, by name:
+
+1. **C side** — a declaration extractor over native.cpp: ``extern "C"``
+   block function definitions (prototypes canonicalized into the shared
+   type vocabulary, ``const`` dropped), ``constexpr`` ``k``-prefixed
+   constants (tiny int-expression evaluator: ``512 * 1024`` and LL/u
+   suffixes fold), ``#pragma pack(push, 1)`` struct layouts, and the
+   ``std::map<int64_t, T> g_*`` handle registries.
+2. **Python side** — an AST pass over the ctypes bindings: per-symbol
+   ``restype``/``argtypes`` (local aliases like ``i64 = ctypes.c_int64``
+   resolve), the registry-derived struct format attributes, the stats
+   dict builders, and every declared constant mirror (which must read
+   through ``abi_contracts.constant()``, not restate a literal).
+3. **The registry itself** — entries naming symbols/constants/records/
+   maps that no longer exist on either side fail as stale, the
+   baseline.toml discipline.
+
+Exported-but-unbound, bound-but-unexported, and any prototype/layout/
+value mismatch all fail tier-1 naming the symbol/field/constant.  The
+extractor grammar is deliberately small (see DESIGN.md §30 for its
+limits); the runtime witness (``utils/dfabi.py`` + the compiled-in
+``df_abi_manifest()``) covers what a text extractor cannot — the
+compiler's actual sizeof/offsetof and the built .so's symbol table.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..core import Finding, Module, dotted
+
+RULE = "DF020"
+TITLE = "native ABI contract parity (registry <-> C++ exports <-> ctypes)"
+
+BINDINGS_RELPATH = "dragonfly2_tpu/native/__init__.py"
+CONTRACTS_RELPATH = "dragonfly2_tpu/records/abi_contracts.py"
+NATIVE_RELPATH = "dragonfly2_tpu/native/src/native.cpp"
+
+# ---------------------------------------------------------------------------
+# Canonical type vocabulary (mirrors the table in records/abi_contracts.py
+# and the using-aliases in native.cpp's manifest section).
+# ---------------------------------------------------------------------------
+
+_CPP_SCALARS = {
+    "void": "void",
+    "int": "i32",
+    "int32_t": "i32",
+    "int64_t": "i64",
+    "uint16_t": "u16",
+    "uint32_t": "u32",
+    "uint64_t": "u64",
+    "double": "f64",
+    "float": "f32",
+    "char": "char",
+    "uint8_t": "u8",
+    "size_t": "u64",
+}
+
+_POINTER_CANON = {
+    "char": "cstr",
+    "u8": "u8p",
+    "f32": "f32p",
+    "i32": "i32p",
+    "i64": "i64p",
+    "f64": "f64p",
+}
+
+_CTYPES_SCALARS = {
+    "c_int": "i32",
+    "c_int32": "i32",
+    "c_int64": "i64",
+    "c_uint16": "u16",
+    "c_uint32": "u32",
+    "c_uint64": "u64",
+    "c_uint8": "u8",
+    "c_float": "f32",
+    "c_double": "f64",
+    "c_char_p": "cstr",
+}
+
+
+def canon_cpp_type(text: str) -> str:
+    """``const char*`` / ``uint8_t *`` / ``int32_t`` -> canonical name.
+    Unknown shapes come back verbatim so the mismatch message shows them.
+    """
+    t = text.replace("*", " * ").split()
+    t = [w for w in t if w != "const"]
+    stars = t.count("*")
+    t = [w for w in t if w != "*"]
+    base = " ".join(t)
+    scalar = _CPP_SCALARS.get(base, base)
+    if stars == 0:
+        return scalar
+    if stars == 1 and scalar in _POINTER_CANON:
+        return _POINTER_CANON[scalar]
+    return text.strip()
+
+
+# ---------------------------------------------------------------------------
+# C++ declaration extractor
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CppFunction:
+    name: str
+    ret: str                  # canonical
+    params: List[str]         # canonical
+    line: int
+    extern_c: bool = False
+    static: bool = False
+    function_try: bool = False
+    contained: bool = False   # function-try-block OR depth-1 try/catch(...)
+    suppressed: bool = False  # `// dflint: disable=DF021` on the signature
+
+
+@dataclass
+class CppDecls:
+    exports: Dict[str, CppFunction] = field(default_factory=dict)
+    constants: Dict[str, object] = field(default_factory=dict)  # int or str
+    records: Dict[str, List[Tuple[str, str]]] = field(default_factory=dict)
+    record_lines: Dict[str, int] = field(default_factory=dict)
+    handle_maps: Dict[str, str] = field(default_factory=dict)   # g_x -> T
+    thread_entries: Dict[str, CppFunction] = field(default_factory=dict)
+    parse_errors: List[str] = field(default_factory=list)
+
+
+def _mask_literals(s: str) -> str:
+    """Blank out comment and string/char-literal BODIES (delimiters and
+    length preserved) so brace/paren scans can't be fooled.  Records
+    DF021 pragma lines first — they live inside comments."""
+    out = list(s)
+    i, n = 0, len(s)
+    while i < n:
+        c = s[i]
+        if c == "/" and i + 1 < n and s[i + 1] == "/":
+            j = s.find("\n", i)
+            j = n if j < 0 else j
+            for k in range(i, j):
+                out[k] = " "
+            i = j
+        elif c == "/" and i + 1 < n and s[i + 1] == "*":
+            j = s.find("*/", i + 2)
+            j = n - 2 if j < 0 else j
+            for k in range(i, j + 2):
+                out[k] = " "
+            i = j + 2
+        elif c in ('"', "'"):
+            q = c
+            j = i + 1
+            while j < n:
+                if s[j] == "\\":
+                    j += 2
+                    continue
+                if s[j] == q:
+                    break
+                j += 1
+            for k in range(i + 1, min(j, n)):
+                out[k] = " "
+            i = j + 1
+        else:
+            i += 1
+    return "".join(out)
+
+
+_DF021_PRAGMA = re.compile(r"//\s*dflint:\s*disable\s*=\s*DF021")
+
+_INT_SUFFIX = re.compile(r"(?<=\d)(?:[uU]|[lL]{1,2})+")
+
+_ALLOWED_OPS = (ast.Add, ast.Sub, ast.Mult, ast.LShift, ast.FloorDiv)
+
+
+def _eval_int_expr(expr: str) -> Optional[int]:
+    """Fold a constexpr initializer: integer literals (LL/u suffixes
+    stripped), + - * << and unary minus.  None when outside the grammar."""
+    text = _INT_SUFFIX.sub("", expr.strip())
+    try:
+        node = ast.parse(text, mode="eval").body
+    except SyntaxError:
+        return None
+
+    def ev(n: ast.AST) -> Optional[int]:
+        if isinstance(n, ast.Constant) and isinstance(n.value, int):
+            return n.value
+        if isinstance(n, ast.UnaryOp) and isinstance(n.op, (ast.USub, ast.UAdd)):
+            v = ev(n.operand)
+            if v is None:
+                return None
+            return -v if isinstance(n.op, ast.USub) else v
+        if isinstance(n, ast.BinOp) and isinstance(n.op, _ALLOWED_OPS):
+            a, b = ev(n.left), ev(n.right)
+            if a is None or b is None:
+                return None
+            if isinstance(n.op, ast.Add):
+                return a + b
+            if isinstance(n.op, ast.Sub):
+                return a - b
+            if isinstance(n.op, ast.Mult):
+                return a * b
+            if isinstance(n.op, ast.LShift):
+                return a << b
+            return a // b
+        return None
+
+    return ev(node)
+
+
+_CONST_INT = re.compile(
+    r"constexpr\s+(?:unsigned\s+)?[A-Za-z_]\w*\s+(k[A-Z]\w*)\s*=\s*([^;]+);"
+)
+_CONST_STR = re.compile(r'constexpr\s+char\s+(k[A-Z]\w*)\s*\[\]\s*=\s*"([^"]*)"\s*;')
+_HANDLE_MAP = re.compile(r"std::map<\s*int64_t\s*,\s*([\w:]+\s*\*?)\s*>\s+(g_\w+)\s*;")
+_PACK_REGION = re.compile(
+    r"#pragma\s+pack\(push,\s*1\)(.*?)#pragma\s+pack\(pop\)", re.S
+)
+_STRUCT = re.compile(r"struct\s+(\w+)\s*\{([^}]*)\}\s*;", re.S)
+_STRUCT_FIELD = re.compile(
+    r"^\s*([A-Za-z_][\w:]*)\s+(\w+)(\[(\d+)\])?\s*;", re.M
+)
+_FN_SIG = re.compile(
+    r"^[ \t]*(static\s+)?"
+    r"(void|int|int32_t|int64_t|uint32_t|uint64_t|uint16_t|double|float|"
+    r"const\s+char\s*\*|char\s*\*)\s+"
+    r"(\w+)\s*\(([^()]*)\)",
+    re.M,
+)
+_THREAD_REF = re.compile(r"(?:std::thread\s*\(|emplace_back\s*\()\s*(\w+)\s*[,)]")
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def _params_of(raw: str) -> List[str]:
+    raw = raw.strip()
+    if not raw or raw == "void":
+        return []
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        # drop the trailing identifier (the parameter name), if any
+        m = re.match(r"^(.*?[\s*&])([A-Za-z_]\w*)$", part)
+        ty = m.group(1).strip() if m else part
+        out.append(canon_cpp_type(ty))
+    return out
+
+
+def _containment(masked: str, body_start: int, body_end: int) -> bool:
+    """True when the body [start, end) carries a depth-1 ``try`` whose
+    handlers include ``catch (...)``."""
+    depth = 0
+    i = body_start
+    while i < body_end:
+        c = masked[i]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+        elif depth == 0 and masked.startswith("try", i) and (
+            i == 0 or not (masked[i - 1].isalnum() or masked[i - 1] == "_")
+        ) and not (
+            i + 3 < len(masked)
+            and (masked[i + 3].isalnum() or masked[i + 3] == "_")
+        ):
+            # scan this try's block + handlers for catch (...)
+            j = masked.find("{", i)
+            if j < 0 or j >= body_end:
+                return False
+            d = 1
+            j += 1
+            while j < body_end and d:
+                if masked[j] == "{":
+                    d += 1
+                elif masked[j] == "}":
+                    d -= 1
+                j += 1
+            rest = masked[j:body_end]
+            if re.match(r"\s*catch\s*\(\s*\.\.\.\s*\)", rest):
+                return True
+            # walk catch chains: catch (X&) {...} catch (...) {...}
+            while True:
+                m = re.match(r"\s*catch\s*\(([^)]*)\)\s*\{", rest)
+                if not m:
+                    break
+                if m.group(1).strip() == "...":
+                    return True
+                d = 1
+                k = m.end()
+                while k < len(rest) and d:
+                    if rest[k] == "{":
+                        d += 1
+                    elif rest[k] == "}":
+                        d -= 1
+                    k += 1
+                rest = rest[k:]
+            i = j
+            continue
+        i += 1
+    return False
+
+
+def _match_brace(masked: str, open_pos: int) -> int:
+    """Index just past the brace matching ``masked[open_pos] == '{'``."""
+    depth = 1
+    i = open_pos + 1
+    while i < len(masked) and depth:
+        if masked[i] == "{":
+            depth += 1
+        elif masked[i] == "}":
+            depth -= 1
+        i += 1
+    return i
+
+
+def _parse_function_at(
+    src: str, masked: str, m: "re.Match", extern_c: bool
+) -> Optional[CppFunction]:
+    """One ``_FN_SIG`` match -> a CppFunction, or None for declarations."""
+    sig_line = _line_of(src, m.start())
+    after = m.end()
+    j = after
+    while j < len(masked) and masked[j] in " \t\n":
+        j += 1
+    function_try = masked.startswith("try", j)
+    if function_try:
+        j += 3
+        while j < len(masked) and masked[j] in " \t\n":
+            j += 1
+    if j >= len(masked) or masked[j] != "{":
+        return None  # declaration (`;`) or something the grammar skips
+    body_end = _match_brace(masked, j)
+    if function_try:
+        # the handlers sit after the body close; require catch (...)
+        contained = bool(
+            re.match(r"\s*catch\s*\(\s*\.\.\.\s*\)", masked[body_end:])
+        )
+    else:
+        contained = _containment(masked, j + 1, body_end - 1)
+    lines = src.splitlines()
+    line_text = lines[sig_line - 1] if sig_line - 1 < len(lines) else ""
+    return CppFunction(
+        name=m.group(3),
+        ret=canon_cpp_type(m.group(2)),
+        params=_params_of(m.group(4)),
+        line=sig_line,
+        extern_c=extern_c,
+        static=bool(m.group(1)),
+        function_try=function_try,
+        contained=contained,
+        suppressed=bool(_DF021_PRAGMA.search(line_text)),
+    )
+
+
+def extract_cpp(src: str) -> CppDecls:
+    """Parse native.cpp's declaration surface (grammar per DESIGN.md §30)."""
+    decls = CppDecls()
+    masked = _mask_literals(src)
+
+    # extern "C" block spans (found on the RAW text — the literal is a
+    # string; masking blanks it).
+    spans: List[Tuple[int, int]] = []
+    for m in re.finditer(r'extern\s+"C"\s*\{', src):
+        end = _match_brace(masked, m.end() - 1)
+        spans.append((m.end(), end - 1))
+
+    def in_extern_c(pos: int) -> bool:
+        return any(a <= pos < b for a, b in spans)
+
+    for m in _FN_SIG.finditer(masked):
+        if not in_extern_c(m.start()):
+            continue
+        fn = _parse_function_at(src, masked, m, extern_c=True)
+        if fn is None or fn.static:
+            continue
+        if fn.name in decls.exports:
+            decls.parse_errors.append(
+                f"duplicate extern \"C\" definition of {fn.name}"
+            )
+        decls.exports[fn.name] = fn
+
+    # constants (comment-stripped text so commented-out declarations
+    # don't count; string constants need the RAW text for their value)
+    for m in _CONST_INT.finditer(masked):
+        if m.group(1) in decls.constants:
+            continue
+        value = _eval_int_expr(m.group(2))
+        if value is None:
+            decls.parse_errors.append(
+                f"constexpr {m.group(1)}: initializer "
+                f"{m.group(2).strip()!r} outside the DF020 int-expression "
+                "grammar"
+            )
+        else:
+            decls.constants[m.group(1)] = value
+    for m in _CONST_STR.finditer(src):
+        # raw-text match (masking blanks the value); skip commented-out
+        # declarations by requiring the keyword to survive masking
+        if masked[m.start():m.start() + 9] == "constexpr":
+            decls.constants.setdefault(m.group(1), m.group(2))
+
+    # packed records
+    for region in _PACK_REGION.finditer(masked):
+        for sm in _STRUCT.finditer(region.group(1)):
+            fields: List[Tuple[str, str]] = []
+            for fm in _STRUCT_FIELD.finditer(sm.group(2)):
+                base = _CPP_SCALARS.get(fm.group(1), fm.group(1))
+                if fm.group(4):  # array field
+                    base = f"{base}{fm.group(4)}"
+                fields.append((fm.group(2), base))
+            decls.records[sm.group(1)] = fields
+            decls.record_lines[sm.group(1)] = _line_of(
+                src, region.start(1) + sm.start()
+            )
+
+    # handle registries
+    for m in _HANDLE_MAP.finditer(masked):
+        decls.handle_maps[m.group(2)] = m.group(1).replace(" ", "")
+
+    # thread entries: every function handed to std::thread/emplace_back
+    entry_names = {m.group(1) for m in _THREAD_REF.finditer(masked)}
+    for m in _FN_SIG.finditer(masked):
+        if m.group(3) in entry_names and m.group(3) not in decls.thread_entries:
+            fn = _parse_function_at(src, masked, m, extern_c=in_extern_c(m.start()))
+            if fn is not None:
+                decls.thread_entries[fn.name] = fn
+
+    return decls
+
+
+# ---------------------------------------------------------------------------
+# Registry loading (ast.literal_eval — never imported)
+# ---------------------------------------------------------------------------
+
+
+def load_contracts_text(text: str) -> Optional[dict]:
+    tree = ast.parse(text)
+    for stmt in tree.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and stmt.targets[0].id == "ABI_CONTRACTS"
+        ):
+            try:
+                return ast.literal_eval(stmt.value)
+            except ValueError:
+                return None
+    return None
+
+
+def record_layout(spec: dict) -> List[Tuple[str, str, int, int]]:
+    """[(field, ctype, offset, size)] with cumulative pack(1) offsets."""
+    sizes = {
+        "u8": 1, "i8": 1, "u16": 2, "i16": 2, "u32": 4, "i32": 4,
+        "u64": 8, "i64": 8, "f32": 4, "f64": 8, "char4": 4,
+    }
+    out = []
+    offset = 0
+    for fname, ctype in spec["fields"]:
+        size = sizes.get(ctype, 0)
+        out.append((fname, ctype, offset, size))
+        offset += size
+    return out
+
+
+_STRUCT_FMT = {
+    "u8": "B", "i8": "b", "u16": "H", "i16": "h", "u32": "I", "i32": "i",
+    "u64": "Q", "i64": "q", "f32": "f", "f64": "d", "char4": "4s",
+}
+
+
+def record_struct_format(spec: dict) -> str:
+    return "<" + "".join(_STRUCT_FMT.get(t, "?") for _, t in spec["fields"])
+
+
+def expected_manifest(contracts: dict) -> dict:
+    """The manifest ``df_abi_manifest()`` must emit (same shape as
+    ``records.abi_contracts.expected_manifest`` — a tier-1 test pins the
+    two renderings to each other)."""
+    records = {}
+    for rname, spec in contracts.get("records", {}).items():
+        records[rname] = {
+            "fields": [
+                [f, off, size] for f, _t, off, size in record_layout(spec)
+            ],
+            "size": spec["size"],
+        }
+    return {
+        "constants": dict(contracts.get("constants", {})),
+        "exports": {k: list(v) for k, v in contracts.get("exports", {}).items()},
+        "records": records,
+        "version": 1,
+    }
+
+
+def manifest_json(contracts: dict) -> str:
+    import json
+
+    return json.dumps(
+        expected_manifest(contracts), sort_keys=True, separators=(",", ":")
+    )
+
+
+# ---------------------------------------------------------------------------
+# Python bindings extraction
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PyBindings:
+    # symbol -> ("restype"/"argtypes", canonical or list, AST node)
+    restypes: Dict[str, Tuple[str, ast.AST]] = field(default_factory=dict)
+    argtypes: Dict[str, Tuple[List[str], ast.AST]] = field(default_factory=dict)
+
+
+def _canon_ctypes(node: ast.AST, aliases: Dict[str, str]) -> str:
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "void"
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id, node.id)
+    d = dotted(node)
+    if d is not None:
+        leaf = d.rsplit(".", 1)[-1]
+        return _CTYPES_SCALARS.get(leaf, d)
+    if isinstance(node, ast.Call):
+        fn = dotted(node.func)
+        if fn is not None and fn.rsplit(".", 1)[-1] == "POINTER" and node.args:
+            inner = _canon_ctypes(node.args[0], aliases)
+            return _POINTER_CANON.get(inner, f"{inner}p")
+    return "<unresolved>"
+
+
+def extract_bindings(tree: ast.AST) -> PyBindings:
+    """Collect every ``<lib>.<sym>.restype/argtypes = ...`` assignment,
+    resolving single-name aliases assigned in the same module."""
+    out = PyBindings()
+    aliases: Dict[str, str] = {}
+    # pass 1: aliases (`i64 = ctypes.c_int64`, tuple unpacks included)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = node.targets[0]
+        if isinstance(targets, ast.Tuple) and isinstance(node.value, ast.Tuple):
+            pairs = list(zip(targets.elts, node.value.elts))
+        else:
+            pairs = [(node.targets[0], node.value)]
+        for tgt, val in pairs:
+            if isinstance(tgt, ast.Name):
+                canon = _canon_ctypes(val, {})
+                if canon != "<unresolved>" and (
+                    canon in _CTYPES_SCALARS.values()
+                    or canon in _POINTER_CANON.values()
+                ):
+                    aliases[tgt.id] = canon
+    # pass 2: bindings
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Attribute)
+            and isinstance(tgt.value.value, ast.Name)
+        ):
+            continue
+        sym, what = tgt.value.attr, tgt.attr
+        if what == "restype":
+            out.restypes[sym] = (_canon_ctypes(node.value, aliases), node)
+        elif what == "argtypes":
+            if isinstance(node.value, (ast.List, ast.Tuple)):
+                out.argtypes[sym] = (
+                    [_canon_ctypes(e, aliases) for e in node.value.elts],
+                    node,
+                )
+            else:
+                out.argtypes[sym] = ([], node)
+    return out
+
+
+def _is_accessor_call(node: ast.AST, accessor: str, arg: str) -> bool:
+    """``<mod>.accessor("arg")`` (optionally wrapped in ``.encode(...)``)."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "encode"
+    ):
+        return _is_accessor_call(node.func.value, accessor, arg)
+    if not isinstance(node, ast.Call) or not node.args:
+        return False
+    fn = dotted(node.func)
+    if fn is None or fn.rsplit(".", 1)[-1] != accessor:
+        return False
+    a0 = node.args[0]
+    return isinstance(a0, ast.Constant) and a0.value == arg
+
+
+# ---------------------------------------------------------------------------
+# Cross-checks
+# ---------------------------------------------------------------------------
+
+
+def compare_exports(
+    contracts: dict, cpp: CppDecls, py: PyBindings
+) -> List[Tuple[Optional[ast.AST], str]]:
+    out: List[Tuple[Optional[ast.AST], str]] = []
+    declared = contracts.get("exports", {})
+
+    for name, proto in declared.items():
+        ret, args = proto[0], list(proto[1:])
+        fn = cpp.exports.get(name)
+        if fn is None:
+            out.append((None, f"stale registry export: {name} is not "
+                              f"defined in an extern \"C\" block of native.cpp"))
+        else:
+            if fn.ret != ret:
+                out.append((None, f"{name}: C return type {fn.ret} != "
+                                  f"declared {ret} (native.cpp:{fn.line})"))
+            if fn.params != args:
+                out.append((None, f"{name}: C parameters {fn.params} != "
+                                  f"declared {args} (native.cpp:{fn.line})"))
+        rt = py.restypes.get(name)
+        at = py.argtypes.get(name)
+        if rt is None and at is None:
+            out.append((None, f"exported-but-unbound: {name} has no ctypes "
+                              f"restype/argtypes declaration"))
+            continue
+        if rt is not None and rt[0] != ret:
+            out.append((rt[1], f"{name}: ctypes restype {rt[0]} != "
+                               f"declared {ret}"))
+        if rt is None:
+            out.append((None, f"{name}: argtypes declared but restype missing"))
+        if at is not None and at[0] != args:
+            out.append((at[1], f"{name}: ctypes argtypes {at[0]} != "
+                               f"declared {args}"))
+        if at is None and args:
+            out.append((None, f"{name}: restype declared but argtypes missing"))
+
+    for name, fn in cpp.exports.items():
+        if name not in declared:
+            out.append((None, f"exported-but-undeclared: {name} "
+                              f"(native.cpp:{fn.line}) is missing from "
+                              f"records/abi_contracts.py exports"))
+    for name in set(py.restypes) | set(py.argtypes):
+        if name not in declared:
+            node = (py.restypes.get(name) or py.argtypes.get(name))[1]
+            out.append((node, f"bound-but-undeclared: ctypes declares {name} "
+                              f"but records/abi_contracts.py does not"))
+    return out
+
+
+def compare_constants(
+    contracts: dict, cpp: CppDecls
+) -> List[Tuple[Optional[ast.AST], str]]:
+    out: List[Tuple[Optional[ast.AST], str]] = []
+    declared = contracts.get("constants", {})
+    for name, value in declared.items():
+        got = cpp.constants.get(name)
+        if got is None:
+            out.append((None, f"stale registry constant: {name} has no "
+                              f"constexpr declaration in native.cpp"))
+        elif got != value:
+            out.append((None, f"constant {name}: native.cpp value {got!r} != "
+                              f"declared {value!r}"))
+    for name, got in cpp.constants.items():
+        if name not in declared:
+            out.append((None, f"undeclared shared constant: constexpr {name} "
+                              f"= {got!r} in native.cpp is missing from "
+                              f"records/abi_contracts.py constants"))
+    return out
+
+
+def compare_records(
+    contracts: dict, cpp: CppDecls
+) -> List[Tuple[Optional[ast.AST], str]]:
+    out: List[Tuple[Optional[ast.AST], str]] = []
+    declared = contracts.get("records", {})
+    for name, spec in declared.items():
+        got = cpp.records.get(name)
+        if got is None:
+            out.append((None, f"stale registry record: {name} has no "
+                              f"pack(1) struct in native.cpp"))
+            continue
+        want = [(f, t) for f, t in (tuple(x) for x in spec["fields"])]
+        if got != want:
+            out.append((None, f"record {name}: native.cpp layout {got} != "
+                              f"declared {want} "
+                              f"(native.cpp:{cpp.record_lines.get(name, '?')})"))
+        total = sum(s for _f, _t, _o, s in record_layout(spec))
+        if total != spec["size"]:
+            out.append((None, f"record {name}: declared size {spec['size']} "
+                              f"!= sum of field sizes {total}"))
+    for name in cpp.records:
+        if name not in declared:
+            out.append((None, f"undeclared packed record: struct {name} sits "
+                              f"in a pack(1) region of native.cpp but is "
+                              f"missing from records/abi_contracts.py"))
+    return out
+
+
+def compare_handles(
+    contracts: dict, cpp: CppDecls
+) -> List[Tuple[Optional[ast.AST], str]]:
+    out: List[Tuple[Optional[ast.AST], str]] = []
+    for prefix, spec in contracts.get("handle_families", {}).items():
+        reg = spec.get("registry")
+        if reg is None:
+            continue
+        vt = cpp.handle_maps.get(reg)
+        if vt is None:
+            out.append((None, f"handle family {prefix}: registry map {reg} "
+                              f"not found in native.cpp"))
+            continue
+        raw = vt.endswith("*")
+        want_raw = spec.get("lifetime") == "raw"
+        if raw != want_raw:
+            out.append((None, f"handle family {prefix}: {reg} holds "
+                              f"{vt} but the registry declares lifetime "
+                              f"{spec.get('lifetime')!r}"))
+    return out
+
+
+def compare_stats(
+    contracts: dict, tree: Optional[ast.AST]
+) -> List[Tuple[Optional[ast.AST], str]]:
+    out: List[Tuple[Optional[ast.AST], str]] = []
+    declared = contracts.get("stats_fields", {})
+    exports = contracts.get("exports", {})
+    for sym, spec in declared.items():
+        fields = list(spec.get("fields", []))
+        proto = exports.get(sym)
+        if proto is None:
+            out.append((None, f"stats_fields {sym}: not a declared export"))
+            continue
+        outptrs = [a for a in proto[1:] if a == "i64p"]
+        if len(outptrs) != len(fields):
+            out.append((None, f"stats_fields {sym}: {len(fields)} field "
+                              f"name(s) vs {len(outptrs)} i64p out-pointer "
+                              f"parameter(s) in the declared prototype"))
+        builder = spec.get("py_builder")
+        if builder is None or tree is None:
+            continue
+        cls_name, meth_name = builder.split(".", 1)
+        meth = None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == cls_name:
+                for sub in node.body:
+                    if (
+                        isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and sub.name == meth_name
+                    ):
+                        meth = sub
+        if meth is None:
+            out.append((None, f"stats_fields {sym}: py_builder {builder} "
+                              f"not found in the bindings module"))
+            continue
+        for node in ast.walk(meth):
+            if isinstance(node, ast.Return) and isinstance(node.value, ast.Dict):
+                keys = [
+                    k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                ]
+                if keys != fields:
+                    out.append((node, f"stats_fields {sym}: {builder} returns "
+                                      f"dict keys {keys} != declared field "
+                                      f"order {fields}"))
+    return out
+
+
+def compare_mirrors(
+    contracts: dict,
+    module_relpath: str,
+    module_tree: ast.AST,
+    read_tree,  # (relpath) -> Optional[ast.AST]
+) -> List[Tuple[Optional[ast.AST], str]]:
+    out: List[Tuple[Optional[ast.AST], str]] = []
+    constants = contracts.get("constants", {})
+    for spec in contracts.get("constant_mirrors", []):
+        cname, relpath, attr = spec["constant"], spec["file"], spec["attr"]
+        if cname not in constants:
+            out.append((None, f"constant mirror {attr}: mirrored constant "
+                              f"{cname} is not declared"))
+            continue
+        tree = module_tree if relpath == module_relpath else read_tree(relpath)
+        if tree is None:
+            out.append((None, f"stale constant mirror: {relpath} "
+                              f"missing/unparseable (mirror for {cname})"))
+            continue
+        assign = None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == attr:
+                        assign = node
+        if assign is None:
+            out.append((None, f"stale constant mirror: {relpath} no longer "
+                              f"assigns {attr} (mirror for {cname})"))
+            continue
+        node = assign if relpath == module_relpath else None
+        if not _is_accessor_call(assign.value, "constant", cname):
+            if isinstance(assign.value, ast.Constant):
+                out.append((node, f"{relpath}:{assign.lineno}: {attr} "
+                                  f"restates shared constant {cname} as a "
+                                  f"literal — read it through "
+                                  f"records/abi_contracts.constant()"))
+            else:
+                out.append((node, f"{relpath}:{assign.lineno}: {attr} "
+                                  f"(mirror for {cname}) is not derived via "
+                                  f"records/abi_contracts.constant()"))
+    return out
+
+
+def compare_py_structs(
+    contracts: dict, tree: ast.AST
+) -> List[Tuple[Optional[ast.AST], str]]:
+    out: List[Tuple[Optional[ast.AST], str]] = []
+    for rname, spec in contracts.get("records", {}).items():
+        py = spec.get("py_struct")
+        if py is None:
+            continue
+        cls = None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == py["qual"]:
+                cls = node
+        if cls is None:
+            out.append((None, f"record {rname}: py_struct class "
+                              f"{py['qual']} not found in bindings"))
+            continue
+        for attr, accessor in (
+            (py["fmt_attr"], "record_format"),
+            (py["size_attr"], "record_size"),
+        ):
+            assign = None
+            for sub in cls.body:
+                if isinstance(sub, ast.Assign):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name) and tgt.id == attr:
+                            assign = sub
+            if assign is None:
+                out.append((None, f"record {rname}: {py['qual']}.{attr} "
+                                  f"missing from the bindings module"))
+                continue
+            if not _is_accessor_call(assign.value, accessor, rname):
+                out.append((assign, f"record {rname}: {py['qual']}.{attr} "
+                                    f"must be derived via records/"
+                                    f"abi_contracts.{accessor}({rname!r}), "
+                                    f"not restated"))
+    return out
+
+
+def compare_all(
+    contracts: dict,
+    cpp: CppDecls,
+    py: PyBindings,
+    tree: Optional[ast.AST] = None,
+    module_relpath: str = BINDINGS_RELPATH,
+    read_tree=lambda relpath: None,
+) -> List[Tuple[Optional[ast.AST], str]]:
+    """Every DF020 cross-check; fixture tests drive this directly."""
+    out = []
+    out.extend(compare_exports(contracts, cpp, py))
+    out.extend(compare_constants(contracts, cpp))
+    out.extend(compare_records(contracts, cpp))
+    out.extend(compare_handles(contracts, cpp))
+    out.extend(compare_stats(contracts, tree))
+    if tree is not None:
+        out.extend(compare_py_structs(contracts, tree))
+        out.extend(
+            compare_mirrors(contracts, module_relpath, tree, read_tree)
+        )
+    for err in cpp.parse_errors:
+        out.append((None, f"extractor: {err}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Checker entry point
+# ---------------------------------------------------------------------------
+
+
+def _project_root(module: Module) -> Optional[Path]:
+    # module.path ends with dragonfly2_tpu/native/__init__.py
+    p = module.path.resolve()
+    if len(p.parents) < 3:
+        return None
+    return p.parents[2]
+
+
+def check(module: Module) -> Iterator[Finding]:
+    if module.relpath != BINDINGS_RELPATH:
+        return
+    root = _project_root(module)
+    if root is None:
+        return
+    contracts_path = root / CONTRACTS_RELPATH
+    native_path = root / NATIVE_RELPATH
+    if not contracts_path.exists() or not native_path.exists():
+        yield module.finding(
+            RULE,
+            module.tree,
+            f"ABI registry or native source missing "
+            f"({CONTRACTS_RELPATH} / {NATIVE_RELPATH}) — the bindings "
+            f"module cannot be checked",
+        )
+        return
+    contracts = load_contracts_text(
+        contracts_path.read_text(encoding="utf-8")
+    )
+    if contracts is None:
+        yield module.finding(
+            RULE,
+            module.tree,
+            "ABI_CONTRACTS must stay a pure literal (ast.literal_eval "
+            "failed — dflint reads it without importing)",
+        )
+        return
+    cpp = extract_cpp(native_path.read_text(encoding="utf-8"))
+    py = extract_bindings(module.tree)
+
+    _tree_cache: Dict[str, Optional[ast.AST]] = {}
+
+    def read_tree(relpath: str) -> Optional[ast.AST]:
+        if relpath not in _tree_cache:
+            p = root / relpath
+            try:
+                _tree_cache[relpath] = ast.parse(
+                    p.read_text(encoding="utf-8")
+                )
+            except (OSError, SyntaxError, UnicodeDecodeError):
+                _tree_cache[relpath] = None
+        return _tree_cache[relpath]
+
+    for node, message in compare_all(
+        contracts, cpp, py, module.tree, module.relpath, read_tree
+    ):
+        yield module.finding(RULE, node if node is not None else module.tree,
+                             message)

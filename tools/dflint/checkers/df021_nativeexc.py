@@ -1,0 +1,75 @@
+"""DF021 — native exception containment.
+
+An exception escaping an ``extern "C"`` function is undefined behavior
+at the ABI boundary, and one escaping a ``std::thread`` entry calls
+``std::terminate`` — either way the embedding daemon dies, which is
+exactly the PR-17 review finding class (a throwing burst handler took
+the whole fetch pool down).  This rule makes the containment discipline
+machine-checked:
+
+- every ``extern "C"`` function defined in native.cpp must be a
+  function-try-block (``) try { ... } catch (...) { return kAbiTrap; }``)
+  or carry a top-level (depth-1) ``try`` whose handlers include
+  ``catch (...)``;
+- every function handed to ``std::thread(...)`` / ``emplace_back(...)``
+  must satisfy the same shape, with its completion accounting (error
+  completions, counter decrements, socket closes) placed so it runs
+  exactly once whether the body completed or threw.
+
+The exactly-once part is a review property the rule's comment anchors —
+statically we enforce the catch-all's presence and position.  Suppress a
+reviewed exception with ``// dflint: disable=DF021`` on the function's
+signature line in native.cpp (the C++ twin of the Python pragma; the
+extractor honors it because Python-side line pragmas cannot reach a
+.cpp file).
+
+The shared declaration extractor lives in ``df020_abi`` (one grammar,
+two rules); like DF020 this anchors on the bindings module so the sweep
+runs it exactly once.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import Finding, Module
+from .df020_abi import BINDINGS_RELPATH, NATIVE_RELPATH, _project_root, extract_cpp
+
+RULE = "DF021"
+TITLE = "native exception containment (extern \"C\" + thread-entry catch-alls)"
+
+
+def findings_for_cpp(cpp) -> Iterator[str]:
+    """Messages for uncontained functions (fixture tests drive this)."""
+    for name, fn in sorted(cpp.exports.items()):
+        if fn.suppressed or fn.contained:
+            continue
+        yield (
+            f"extern \"C\" {name} (native.cpp:{fn.line}) has no catch-all: "
+            f"an escaping exception is UB at the ABI boundary — make it a "
+            f"function-try-block returning kAbiTrap (or suppress with "
+            f"// dflint: disable=DF021 on the signature)"
+        )
+    for name, fn in sorted(cpp.thread_entries.items()):
+        if fn.suppressed or fn.contained or (fn.extern_c and name in cpp.exports):
+            continue
+        yield (
+            f"thread entry {name} (native.cpp:{fn.line}) has no top-level "
+            f"catch-all: an escaping exception calls std::terminate — wrap "
+            f"the body in try/catch (...) with exactly-once completion "
+            f"accounting"
+        )
+
+
+def check(module: Module) -> Iterator[Finding]:
+    if module.relpath != BINDINGS_RELPATH:
+        return
+    root = _project_root(module)
+    if root is None:
+        return
+    native_path = root / NATIVE_RELPATH
+    if not native_path.exists():
+        return  # DF020 reports the missing source
+    cpp = extract_cpp(native_path.read_text(encoding="utf-8"))
+    for message in findings_for_cpp(cpp):
+        yield module.finding(RULE, module.tree, message)
